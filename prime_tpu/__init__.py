@@ -1,0 +1,22 @@
+"""prime-tpu: a TPU-native compute-platform CLI + SDK suite.
+
+Capability surface modeled on PrimeIntellect's `prime` monorepo (see SURVEY.md),
+re-designed TPU-first: TPU slices (v5e/v5p, ICI topologies) are first-class
+compute, sandboxes are JAX/XLA-preloaded, and the evals runner drives inference
+through a native JAX backend (`prime_tpu.models` / `prime_tpu.parallel`).
+
+Layout (strictly downward dependencies, reference: SURVEY.md §1):
+  core/       config + HTTP transport (L0/L1)
+  api/        resource API clients (L2)
+  sandboxes/  remote code-execution SDK (control plane + gateway data plane)
+  evals/      Evals Hub SDK + native JAX eval runner
+  tunnel/     managed reverse-tunnel SDK
+  envhub/     environment packaging + hub client
+  commands/   click CLI (L3)
+  models/     JAX model zoo (Llama family) — the inference/eval compute path
+  ops/        TPU kernels: attention, RMSNorm, RoPE (pallas + XLA reference)
+  parallel/   mesh/sharding, ring attention, distributed init
+  testing/    in-process fake control plane for hermetic tests
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,40 @@
+"""`prime` CLI entry point.
+
+Command groups are assembled here in three help panels mirroring the reference
+(prime_cli/main.py:36-84): Lab, Compute, Account. Subcommand modules register
+lazily to keep CLI startup fast (the reference enforces this with a startup
+test, tests/test_windows_cli.py:6-40).
+"""
+
+from __future__ import annotations
+
+import click
+
+import prime_tpu
+
+
+@click.group(name="prime")
+@click.version_option(prime_tpu.__version__, prog_name="prime-tpu")
+@click.option(
+    "--context",
+    default=None,
+    envvar="PRIME_CONTEXT",
+    help="Use a named config context for this invocation.",
+)
+@click.pass_context
+def cli(ctx: click.Context, context: str | None) -> None:
+    """prime — TPU-native compute platform CLI."""
+    ctx.ensure_object(dict)
+    ctx.obj["context"] = context
+    if context:
+        import os
+
+        os.environ["PRIME_CONTEXT"] = context
+
+
+def main() -> None:  # pragma: no cover - exercised via subprocess
+    cli(prog_name="prime")
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

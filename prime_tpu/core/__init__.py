@@ -1,0 +1,23 @@
+from prime_tpu.core.config import Config
+from prime_tpu.core.exceptions import (
+    APIError,
+    APIConnectionError,
+    APITimeoutError,
+    NotFoundError,
+    PaymentRequiredError,
+    RateLimitError,
+    UnauthorizedError,
+    ValidationError,
+)
+
+__all__ = [
+    "Config",
+    "APIError",
+    "APIConnectionError",
+    "APITimeoutError",
+    "NotFoundError",
+    "PaymentRequiredError",
+    "RateLimitError",
+    "UnauthorizedError",
+    "ValidationError",
+]

@@ -1,0 +1,443 @@
+"""Sync + async HTTP transport clients with typed errors and tiered retries.
+
+This merges the reference's two client variants into one good client
+(SURVEY.md §7 stage 1): the CLI client's /api/v1 prefixing + bearer auth +
+status→exception mapping (prime_cli/core/client.py:70,206,17-67) and the
+sandboxes SDK client's idempotency-aware retry tiers
+(prime_sandboxes/core/client.py:35,76,106-193):
+
+- idempotent verbs (GET/HEAD/PUT/DELETE) retry on connection errors, read
+  errors, and retryable 5xx ({500,502,503,504,524});
+- POST retries on connection errors only (request provably never sent), unless
+  the caller opts into ``idempotent_post=True``, which auto-generates an
+  ``Idempotency-Key`` header (uuid4) when the caller didn't supply one;
+- requests carrying file objects are never re-sent after a failed attempt
+  (the stream may be partially consumed — a retry would upload truncated data).
+
+Both clients share one request-building/response-mapping core so the async
+surface cannot drift from the sync one (the reference duplicates ~1,100 lines
+between its mirrors; see SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import platform
+import random
+import time
+import uuid
+from typing import Any, AsyncIterator, Iterator
+
+import httpx
+
+import prime_tpu
+from prime_tpu.core.config import Config
+from prime_tpu.core.exceptions import (
+    APIConnectionError,
+    APIError,
+    APITimeoutError,
+    NotFoundError,
+    PaymentRequiredError,
+    RateLimitError,
+    UnauthorizedError,
+    ValidationError,
+)
+
+RETRYABLE_STATUS = frozenset({500, 502, 503, 504, 524})
+DEFAULT_TIMEOUT = httpx.Timeout(30.0, connect=10.0)
+API_PREFIX = "/api/v1"
+MAX_ATTEMPTS = 4
+BACKOFF_BASE = 0.5
+BACKOFF_MAX = 30.0
+IDEMPOTENT_METHODS = frozenset({"GET", "HEAD", "PUT", "DELETE"})
+
+
+def user_agent() -> str:
+    return (
+        f"prime-tpu/{prime_tpu.__version__} "
+        f"python/{platform.python_version()} {platform.system().lower()}"
+    )
+
+
+def _backoff(attempt: int) -> float:
+    """Exponential backoff with full jitter, capped at BACKOFF_MAX."""
+    return random.uniform(0, min(BACKOFF_MAX, BACKOFF_BASE * (2**attempt)))
+
+
+def raise_for_status(response: httpx.Response) -> None:
+    """Map HTTP status to the typed exception taxonomy."""
+    if response.status_code < 400:
+        return
+    try:
+        body = response.json()
+    except Exception:
+        body = response.text
+    detail = body.get("detail") if isinstance(body, dict) else None
+    message = None
+    if isinstance(detail, str):
+        message = detail
+    elif isinstance(body, dict):
+        message = body.get("message") or body.get("error")
+
+    status = response.status_code
+    if status == 401:
+        raise UnauthorizedError(message or "Unauthorized. Run `prime login` or set PRIME_API_KEY.")
+    if status == 402:
+        raise PaymentRequiredError(message or "Payment required: insufficient wallet balance.")
+    if status == 404:
+        raise NotFoundError(message or f"Resource not found: {response.request.url.path}")
+    if status == 422:
+        raise ValidationError(message or "Validation error.", errors=detail)
+    if status == 429:
+        retry_after = None
+        ra = response.headers.get("Retry-After")
+        if ra:
+            try:
+                retry_after = float(ra)
+            except ValueError:
+                retry_after = None
+        raise RateLimitError(message or "Rate limited.", retry_after=retry_after)
+    raise APIError(
+        message or f"API request failed with status {status}",
+        status_code=status,
+        body=body,
+    )
+
+
+def _should_retry_exception(
+    exc: Exception, method: str, idempotent_post: bool, replayable: bool
+) -> bool:
+    if isinstance(exc, httpx.ConnectError | httpx.ConnectTimeout):
+        return True  # request never reached the server — always safe
+    if not replayable:
+        # A file-object payload may be partially consumed after a failed send;
+        # re-sending it would silently upload truncated/empty content.
+        return False
+    if method in IDEMPOTENT_METHODS or idempotent_post:
+        return isinstance(exc, httpx.TransportError)
+    return False
+
+
+def _should_retry_status(status: int, method: str, idempotent_post: bool, replayable: bool) -> bool:
+    if status not in RETRYABLE_STATUS or not replayable:
+        return False
+    return method in IDEMPOTENT_METHODS or idempotent_post
+
+
+class _RequestCore:
+    """Shared request building + response mapping for sync and async clients."""
+
+    def __init__(
+        self,
+        config: Config | None = None,
+        base_url: str | None = None,
+        api_key: str | None = None,
+        api_prefix: str = API_PREFIX,
+        team_id: str | None = None,
+    ) -> None:
+        self.config = config or Config()
+        self.base_url = (base_url or self.config.base_url).rstrip("/")
+        self.api_key = api_key if api_key is not None else self.config.api_key
+        self.api_prefix = api_prefix
+        self.team_id = team_id if team_id is not None else self.config.team_id
+
+    def url(self, path: str) -> str:
+        if path.startswith(("http://", "https://")):
+            return path
+        if not path.startswith("/"):
+            path = "/" + path
+        if self.api_prefix and not path.startswith(self.api_prefix):
+            path = self.api_prefix + path
+        return self.base_url + path
+
+    def headers(self, extra: dict[str, str] | None = None) -> dict[str, str]:
+        headers = {
+            "User-Agent": user_agent(),
+            "Accept": "application/json",
+        }
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        if self.team_id:
+            headers["X-Prime-Team-ID"] = self.team_id
+        if extra:
+            headers.update(extra)
+        return headers
+
+    @staticmethod
+    def parse(response: httpx.Response) -> Any:
+        raise_for_status(response)
+        if response.status_code == 204 or not response.content:
+            return None
+        ctype = response.headers.get("Content-Type", "")
+        if "application/json" in ctype:
+            return response.json()
+        return response.text
+
+
+class APIClient:
+    """Synchronous backend API client."""
+
+    def __init__(
+        self,
+        config: Config | None = None,
+        base_url: str | None = None,
+        api_key: str | None = None,
+        timeout: httpx.Timeout | float = DEFAULT_TIMEOUT,
+        transport: httpx.BaseTransport | None = None,
+        api_prefix: str = API_PREFIX,
+        team_id: str | None = None,
+        max_attempts: int = MAX_ATTEMPTS,
+    ) -> None:
+        self._core = _RequestCore(config, base_url, api_key, api_prefix, team_id)
+        self.max_attempts = max_attempts
+        self._client = httpx.Client(timeout=timeout, transport=transport)
+
+    @property
+    def config(self) -> Config:
+        return self._core.config
+
+    @property
+    def team_id(self) -> str | None:
+        return self._core.team_id
+
+    def close(self) -> None:
+        self._client.close()
+
+    def __enter__(self) -> "APIClient":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        *,
+        json: Any = None,
+        params: dict[str, Any] | None = None,
+        headers: dict[str, str] | None = None,
+        content: bytes | None = None,
+        files: Any = None,
+        idempotent_post: bool = False,
+        timeout: httpx.Timeout | float | None = None,
+    ) -> Any:
+        method = method.upper()
+        url = self._core.url(path)
+        if idempotent_post and not (headers and "Idempotency-Key" in headers):
+            headers = {**(headers or {}), "Idempotency-Key": str(uuid.uuid4())}
+        hdrs = self._core.headers(headers)
+        replayable = files is None
+        last_exc: Exception | None = None
+        for attempt in range(self.max_attempts):
+            try:
+                response = self._client.request(
+                    method,
+                    url,
+                    json=json,
+                    params=params,
+                    headers=hdrs,
+                    content=content,
+                    files=files,
+                    timeout=timeout if timeout is not None else httpx.USE_CLIENT_DEFAULT,
+                )
+            except httpx.TimeoutException as exc:
+                last_exc = exc
+                if (
+                    not _should_retry_exception(exc, method, idempotent_post, replayable)
+                    or attempt == self.max_attempts - 1
+                ):
+                    raise APITimeoutError(f"{method} {url} timed out: {exc}") from exc
+                time.sleep(_backoff(attempt))
+                continue
+            except httpx.TransportError as exc:
+                last_exc = exc
+                if (
+                    not _should_retry_exception(exc, method, idempotent_post, replayable)
+                    or attempt == self.max_attempts - 1
+                ):
+                    raise APIConnectionError(f"Could not reach {url}: {exc}") from exc
+                time.sleep(_backoff(attempt))
+                continue
+            if (
+                _should_retry_status(response.status_code, method, idempotent_post, replayable)
+                and attempt < self.max_attempts - 1
+            ):
+                time.sleep(_backoff(attempt))
+                continue
+            return self._core.parse(response)
+        raise APIConnectionError(f"Could not reach {url}: {last_exc}")  # pragma: no cover
+
+    def get(self, path: str, **kw: Any) -> Any:
+        return self.request("GET", path, **kw)
+
+    def post(self, path: str, **kw: Any) -> Any:
+        return self.request("POST", path, **kw)
+
+    def put(self, path: str, **kw: Any) -> Any:
+        return self.request("PUT", path, **kw)
+
+    def patch(self, path: str, **kw: Any) -> Any:
+        return self.request("PATCH", path, **kw)
+
+    def delete(self, path: str, **kw: Any) -> Any:
+        return self.request("DELETE", path, **kw)
+
+    def stream_lines(
+        self,
+        method: str,
+        path: str,
+        *,
+        json: Any = None,
+        params: dict[str, Any] | None = None,
+        headers: dict[str, str] | None = None,
+        timeout: httpx.Timeout | float | None = None,
+    ) -> Iterator[str]:
+        """Stream response lines (SSE / JSONL endpoints). No retries."""
+        with self._client.stream(
+            method.upper(),
+            self._core.url(path),
+            json=json,
+            params=params,
+            headers=self._core.headers(headers),
+            timeout=timeout if timeout is not None else httpx.USE_CLIENT_DEFAULT,
+        ) as response:
+            if response.status_code >= 400:
+                response.read()
+                raise_for_status(response)
+            yield from response.iter_lines()
+
+
+class AsyncAPIClient:
+    """Asynchronous mirror of :class:`APIClient` (same retry semantics)."""
+
+    def __init__(
+        self,
+        config: Config | None = None,
+        base_url: str | None = None,
+        api_key: str | None = None,
+        timeout: httpx.Timeout | float = DEFAULT_TIMEOUT,
+        transport: httpx.AsyncBaseTransport | None = None,
+        api_prefix: str = API_PREFIX,
+        team_id: str | None = None,
+        max_attempts: int = MAX_ATTEMPTS,
+    ) -> None:
+        self._core = _RequestCore(config, base_url, api_key, api_prefix, team_id)
+        self.max_attempts = max_attempts
+        self._client = httpx.AsyncClient(timeout=timeout, transport=transport)
+
+    @property
+    def config(self) -> Config:
+        return self._core.config
+
+    @property
+    def team_id(self) -> str | None:
+        return self._core.team_id
+
+    async def close(self) -> None:
+        await self._client.aclose()
+
+    async def __aenter__(self) -> "AsyncAPIClient":
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.close()
+
+    async def request(
+        self,
+        method: str,
+        path: str,
+        *,
+        json: Any = None,
+        params: dict[str, Any] | None = None,
+        headers: dict[str, str] | None = None,
+        content: bytes | None = None,
+        files: Any = None,
+        idempotent_post: bool = False,
+        timeout: httpx.Timeout | float | None = None,
+    ) -> Any:
+        import anyio
+
+        method = method.upper()
+        url = self._core.url(path)
+        if idempotent_post and not (headers and "Idempotency-Key" in headers):
+            headers = {**(headers or {}), "Idempotency-Key": str(uuid.uuid4())}
+        hdrs = self._core.headers(headers)
+        replayable = files is None
+        last_exc: Exception | None = None
+        for attempt in range(self.max_attempts):
+            try:
+                response = await self._client.request(
+                    method,
+                    url,
+                    json=json,
+                    params=params,
+                    headers=hdrs,
+                    content=content,
+                    files=files,
+                    timeout=timeout if timeout is not None else httpx.USE_CLIENT_DEFAULT,
+                )
+            except httpx.TimeoutException as exc:
+                last_exc = exc
+                if (
+                    not _should_retry_exception(exc, method, idempotent_post, replayable)
+                    or attempt == self.max_attempts - 1
+                ):
+                    raise APITimeoutError(f"{method} {url} timed out: {exc}") from exc
+                await anyio.sleep(_backoff(attempt))
+                continue
+            except httpx.TransportError as exc:
+                last_exc = exc
+                if (
+                    not _should_retry_exception(exc, method, idempotent_post, replayable)
+                    or attempt == self.max_attempts - 1
+                ):
+                    raise APIConnectionError(f"Could not reach {url}: {exc}") from exc
+                await anyio.sleep(_backoff(attempt))
+                continue
+            if (
+                _should_retry_status(response.status_code, method, idempotent_post, replayable)
+                and attempt < self.max_attempts - 1
+            ):
+                await anyio.sleep(_backoff(attempt))
+                continue
+            return self._core.parse(response)
+        raise APIConnectionError(f"Could not reach {url}: {last_exc}")  # pragma: no cover
+
+    async def get(self, path: str, **kw: Any) -> Any:
+        return await self.request("GET", path, **kw)
+
+    async def post(self, path: str, **kw: Any) -> Any:
+        return await self.request("POST", path, **kw)
+
+    async def put(self, path: str, **kw: Any) -> Any:
+        return await self.request("PUT", path, **kw)
+
+    async def patch(self, path: str, **kw: Any) -> Any:
+        return await self.request("PATCH", path, **kw)
+
+    async def delete(self, path: str, **kw: Any) -> Any:
+        return await self.request("DELETE", path, **kw)
+
+    async def stream_lines(
+        self,
+        method: str,
+        path: str,
+        *,
+        json: Any = None,
+        params: dict[str, Any] | None = None,
+        headers: dict[str, str] | None = None,
+        timeout: httpx.Timeout | float | None = None,
+    ) -> AsyncIterator[str]:
+        async with self._client.stream(
+            method.upper(),
+            self._core.url(path),
+            json=json,
+            params=params,
+            headers=self._core.headers(headers),
+            timeout=timeout if timeout is not None else httpx.USE_CLIENT_DEFAULT,
+        ) as response:
+            if response.status_code >= 400:
+                await response.aread()
+                raise_for_status(response)
+            async for line in response.aiter_lines():
+                yield line

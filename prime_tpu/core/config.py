@@ -1,0 +1,231 @@
+"""Persistent configuration + named environment contexts.
+
+Capability parity with the reference Config (prime_cli/core/config.py:10-389):
+- JSON persistence under a config dir (default ``~/.prime``, override with
+  ``PRIME_CONFIG_DIR``)
+- env-var precedence over file values (``PRIME_API_KEY`` > file, etc.,
+  reference core/config.py:81-82)
+- named environment *contexts* under ``<config_dir>/environments/*.json`` with
+  save/use/delete/list and path-traversal-safe names (reference :215-224,244-389)
+- team/user identity, SSH key path, base/frontend/inference URLs
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+from pathlib import Path
+from typing import Any
+
+from pydantic import BaseModel, Field
+
+DEFAULT_BASE_URL = "https://api.primeintellect.ai"
+DEFAULT_FRONTEND_URL = "https://app.primeintellect.ai"
+DEFAULT_INFERENCE_URL = "https://api.pinference.ai/api/v1"
+
+_CONTEXT_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+
+class ConfigModel(BaseModel):
+    """On-disk schema for config.json and context files."""
+
+    api_key: str = ""
+    team_id: str = ""
+    user_id: str = ""
+    base_url: str = DEFAULT_BASE_URL
+    frontend_url: str = DEFAULT_FRONTEND_URL
+    inference_url: str = DEFAULT_INFERENCE_URL
+    ssh_key_path: str = Field(default_factory=lambda: str(Path.home() / ".ssh" / "id_rsa"))
+    # TPU-native defaults: which accelerator generation the create-wizard proposes.
+    default_tpu_type: str = "v5e"
+
+
+class InvalidContextName(ValueError):
+    pass
+
+
+def sanitize_context_name(name: str) -> str:
+    """Reject path-traversal / hidden-file context names (reference :215-224)."""
+    name = name.strip()
+    if not _CONTEXT_NAME_RE.match(name) or ".." in name:
+        raise InvalidContextName(
+            f"Invalid context name {name!r}: use letters, digits, '.', '_', '-' "
+            "(max 64 chars, must not start with '.')"
+        )
+    return name
+
+
+class Config:
+    """Read-write config store with env-var precedence and named contexts."""
+
+    ENV_VARS = {
+        "api_key": "PRIME_API_KEY",
+        "team_id": "PRIME_TEAM_ID",
+        "base_url": "PRIME_BASE_URL",
+        "frontend_url": "PRIME_FRONTEND_URL",
+        "inference_url": "PRIME_INFERENCE_URL",
+        "ssh_key_path": "PRIME_SSH_KEY_PATH",
+    }
+
+    def __init__(self, config_dir: str | Path | None = None) -> None:
+        env_dir = os.environ.get("PRIME_CONFIG_DIR")
+        base = Path(config_dir) if config_dir else (Path(env_dir) if env_dir else Path.home() / ".prime")
+        self.config_dir = base
+        self.config_file = base / "config.json"
+        self.environments_dir = base / "environments"
+        self._model = self._load()
+        # `PRIME_CONTEXT` switches the active context for a single invocation
+        # (reference main.py:87-117) without rewriting config.json.
+        ctx = os.environ.get("PRIME_CONTEXT")
+        if ctx:
+            # An unusable PRIME_CONTEXT must not brick every invocation — fall
+            # back to config.json the same way _load() tolerates corruption.
+            try:
+                self._model = self._load_context_model(sanitize_context_name(ctx))
+            except (FileNotFoundError, InvalidContextName, json.JSONDecodeError, ValueError):
+                pass
+
+    # -- persistence ---------------------------------------------------------
+
+    def _load(self) -> ConfigModel:
+        if self.config_file.exists():
+            try:
+                return ConfigModel.model_validate(json.loads(self.config_file.read_text()))
+            except (json.JSONDecodeError, ValueError):
+                return ConfigModel()
+        return ConfigModel()
+
+    def save(self) -> None:
+        self.config_dir.mkdir(parents=True, exist_ok=True)
+        self._atomic_write(self.config_file, self._model.model_dump())
+
+    @staticmethod
+    def _atomic_write(path: Path, data: dict[str, Any]) -> None:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=str(path.parent), prefix=".tmp-cfg-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(data, f, indent=2)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    # -- value access with env precedence ------------------------------------
+
+    def _get(self, field: str) -> str:
+        env_name = self.ENV_VARS.get(field)
+        if env_name:
+            env_val = os.environ.get(env_name)
+            if env_val:
+                return env_val
+        return getattr(self._model, field)
+
+    @property
+    def api_key(self) -> str:
+        return self._get("api_key")
+
+    @api_key.setter
+    def api_key(self, value: str) -> None:
+        self._model.api_key = value
+
+    @property
+    def team_id(self) -> str:
+        return self._get("team_id")
+
+    @team_id.setter
+    def team_id(self, value: str) -> None:
+        self._model.team_id = value
+
+    @property
+    def user_id(self) -> str:
+        return self._model.user_id
+
+    @user_id.setter
+    def user_id(self, value: str) -> None:
+        self._model.user_id = value
+
+    @property
+    def base_url(self) -> str:
+        return self._get("base_url").rstrip("/")
+
+    @base_url.setter
+    def base_url(self, value: str) -> None:
+        self._model.base_url = value
+
+    @property
+    def frontend_url(self) -> str:
+        return self._get("frontend_url").rstrip("/")
+
+    @property
+    def inference_url(self) -> str:
+        return self._get("inference_url").rstrip("/")
+
+    @inference_url.setter
+    def inference_url(self, value: str) -> None:
+        self._model.inference_url = value
+
+    @property
+    def ssh_key_path(self) -> str:
+        return self._get("ssh_key_path")
+
+    @ssh_key_path.setter
+    def ssh_key_path(self, value: str) -> None:
+        self._model.ssh_key_path = value
+
+    @property
+    def default_tpu_type(self) -> str:
+        return self._model.default_tpu_type
+
+    @default_tpu_type.setter
+    def default_tpu_type(self, value: str) -> None:
+        self._model.default_tpu_type = value
+
+    def view(self) -> dict[str, Any]:
+        """Current effective values (env overrides applied), api_key masked."""
+        data = self._model.model_dump()
+        for field in self.ENV_VARS:
+            data[field] = self._get(field)
+        if data.get("api_key"):
+            key = data["api_key"]
+            data["api_key"] = key[:4] + "..." + key[-4:] if len(key) > 12 else "***"
+        return data
+
+    # -- named contexts ------------------------------------------------------
+
+    def _context_path(self, name: str) -> Path:
+        return self.environments_dir / f"{sanitize_context_name(name)}.json"
+
+    def _load_context_model(self, name: str) -> ConfigModel:
+        path = self._context_path(name)
+        if not path.exists():
+            raise FileNotFoundError(f"No saved context named {name!r}")
+        return ConfigModel.model_validate(json.loads(path.read_text()))
+
+    def save_context(self, name: str) -> Path:
+        """Snapshot the current (file) config as a named context."""
+        path = self._context_path(name)
+        self._atomic_write(path, self._model.model_dump())
+        return path
+
+    def use_context(self, name: str) -> None:
+        """Load a named context and make it the active config.json."""
+        self._model = self._load_context_model(name)
+        self.save()
+
+    def delete_context(self, name: str) -> bool:
+        path = self._context_path(name)
+        if path.exists():
+            path.unlink()
+            return True
+        return False
+
+    def list_contexts(self) -> list[str]:
+        if not self.environments_dir.exists():
+            return []
+        return sorted(p.stem for p in self.environments_dir.glob("*.json"))

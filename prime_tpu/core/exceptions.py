@@ -1,0 +1,95 @@
+"""Typed API error taxonomy.
+
+Capability parity with the reference's status→exception mapping
+(prime_cli/core/client.py:17-67): 401/402/404/422 get dedicated types, 422
+carries structured per-field errors, timeouts and connection failures are
+distinguished so retry policy can key on them.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class APIError(Exception):
+    """Base class for all backend API errors."""
+
+    def __init__(
+        self,
+        message: str,
+        status_code: int | None = None,
+        body: Any = None,
+    ) -> None:
+        super().__init__(message)
+        self.message = message
+        self.status_code = status_code
+        self.body = body
+
+
+class UnauthorizedError(APIError):
+    """401 — missing/invalid API key."""
+
+    def __init__(self, message: str = "Unauthorized. Run `prime login` or set PRIME_API_KEY.") -> None:
+        super().__init__(message, status_code=401)
+
+
+class PaymentRequiredError(APIError):
+    """402 — insufficient balance."""
+
+    def __init__(self, message: str = "Payment required: insufficient wallet balance.") -> None:
+        super().__init__(message, status_code=402)
+
+
+class NotFoundError(APIError):
+    """404 — resource does not exist."""
+
+    def __init__(self, message: str = "Resource not found.") -> None:
+        super().__init__(message, status_code=404)
+
+
+class RateLimitError(APIError):
+    """429 — rate limited; carries Retry-After when the server sent one."""
+
+    def __init__(self, message: str = "Rate limited.", retry_after: float | None = None) -> None:
+        super().__init__(message, status_code=429)
+        self.retry_after = retry_after
+
+
+class ValidationError(APIError):
+    """422 — structured field errors.
+
+    `errors` is a list of {"loc": [...], "msg": str, "type": str} dicts when the
+    backend returns FastAPI-style detail; otherwise the raw detail payload.
+    """
+
+    def __init__(self, message: str = "Validation error.", errors: Any = None) -> None:
+        super().__init__(message, status_code=422)
+        self.errors = errors or []
+
+    def field_messages(self) -> list[str]:
+        out: list[str] = []
+        if isinstance(self.errors, list):
+            for err in self.errors:
+                if isinstance(err, dict):
+                    loc = ".".join(str(p) for p in err.get("loc", []) if p != "body")
+                    msg = err.get("msg", "")
+                    out.append(f"{loc}: {msg}" if loc else str(msg))
+                else:
+                    out.append(str(err))
+        elif self.errors:
+            out.append(str(self.errors))
+        return out
+
+
+class APITimeoutError(APIError):
+    """Request exceeded its deadline (client side)."""
+
+    def __init__(self, message: str = "Request timed out.") -> None:
+        super().__init__(message, status_code=None)
+
+
+class APIConnectionError(APIError):
+    """Could not reach the backend at all."""
+
+    def __init__(self, message: str = "Could not connect to the API.") -> None:
+        super().__init__(message, status_code=None)

@@ -1,0 +1,33 @@
+"""Test harness config.
+
+- Forces JAX onto a virtual 8-device CPU mesh (set BEFORE any jax import) so
+  multi-chip sharding logic is testable without TPU hardware (SURVEY.md §4).
+- Isolates HOME / PRIME_CONFIG_DIR per test so no test touches ~/.prime
+  (mirrors the reference's HOME->tmp_path isolation, tests/test_pods_create.py).
+- Provides the anyio backend fixture so async tests run under pytest without
+  pytest-asyncio.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+@pytest.fixture(autouse=True)
+def _isolate_config(tmp_path, monkeypatch):
+    monkeypatch.setenv("PRIME_CONFIG_DIR", str(tmp_path / ".prime"))
+    monkeypatch.delenv("PRIME_API_KEY", raising=False)
+    monkeypatch.delenv("PRIME_TEAM_ID", raising=False)
+    monkeypatch.delenv("PRIME_BASE_URL", raising=False)
+    monkeypatch.delenv("PRIME_CONTEXT", raising=False)
+    yield

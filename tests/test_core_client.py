@@ -1,0 +1,272 @@
+"""Transport-level client tests with httpx mock transports.
+
+Mirrors the reference's retry-semantics pinning approach
+(prime-sandboxes/tests/test_client_retry.py:19-60): fail-then-succeed
+transports, status-sequence transports, and error-mapping assertions, for both
+the sync and async clients.
+"""
+
+import httpx
+import pytest
+
+from prime_tpu.core.client import APIClient, AsyncAPIClient, user_agent
+from prime_tpu.core.config import Config
+from prime_tpu.core.exceptions import (
+    APIConnectionError,
+    APIError,
+    NotFoundError,
+    PaymentRequiredError,
+    RateLimitError,
+    UnauthorizedError,
+    ValidationError,
+)
+
+
+def make_client(handler, **kw) -> APIClient:
+    cfg = Config()
+    cfg.api_key = "test-key"
+    return APIClient(
+        config=cfg,
+        base_url="https://api.test",
+        transport=httpx.MockTransport(handler),
+        **kw,
+    )
+
+
+def make_async_client(handler, **kw) -> AsyncAPIClient:
+    cfg = Config()
+    cfg.api_key = "test-key"
+    return AsyncAPIClient(
+        config=cfg,
+        base_url="https://api.test",
+        transport=httpx.MockTransport(handler),
+        **kw,
+    )
+
+
+class SeqTransport(httpx.BaseTransport, httpx.AsyncBaseTransport):
+    """Yields a scripted sequence of responses/exceptions, then repeats last."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+
+    def _next(self, request):
+        item = self.script[min(self.calls, len(self.script) - 1)]
+        self.calls += 1
+        if isinstance(item, Exception):
+            raise item
+        status, body = item
+        return httpx.Response(status, json=body, request=request)
+
+    def handle_request(self, request):
+        return self._next(request)
+
+    async def handle_async_request(self, request):
+        return self._next(request)
+
+
+def seq_client(script, **kw):
+    cfg = Config()
+    cfg.api_key = "k"
+    transport = SeqTransport(script)
+    client = APIClient(config=cfg, base_url="https://api.test", transport=transport, **kw)
+    return client, transport
+
+
+# -- request shape -----------------------------------------------------------
+
+
+def test_prefix_auth_and_user_agent():
+    seen = {}
+
+    def handler(request: httpx.Request) -> httpx.Response:
+        seen["url"] = str(request.url)
+        seen["auth"] = request.headers.get("Authorization")
+        seen["ua"] = request.headers.get("User-Agent")
+        return httpx.Response(200, json={"ok": True})
+
+    client = make_client(handler)
+    assert client.get("/pods") == {"ok": True}
+    assert seen["url"] == "https://api.test/api/v1/pods"
+    assert seen["auth"] == "Bearer test-key"
+    assert seen["ua"] == user_agent()
+    assert "prime-tpu/" in seen["ua"]
+
+
+def test_team_header_injected():
+    seen = {}
+
+    def handler(request):
+        seen["team"] = request.headers.get("X-Prime-Team-ID")
+        return httpx.Response(200, json={})
+
+    client = make_client(handler, team_id="team-42")
+    client.get("/pods")
+    assert seen["team"] == "team-42"
+
+
+def test_no_double_prefix():
+    def handler(request):
+        assert request.url.path == "/api/v1/pods"
+        return httpx.Response(200, json={})
+
+    make_client(handler).get("/api/v1/pods")
+
+
+# -- error mapping -----------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "status,exc",
+    [(401, UnauthorizedError), (402, PaymentRequiredError), (404, NotFoundError), (418, APIError)],
+)
+def test_status_error_mapping(status, exc):
+    client = make_client(lambda r: httpx.Response(status, json={"detail": "boom"}))
+    with pytest.raises(exc):
+        client.get("/x")
+
+
+def test_validation_error_field_messages():
+    detail = [{"loc": ["body", "tpu_type"], "msg": "unknown TPU type", "type": "value_error"}]
+    client = make_client(lambda r: httpx.Response(422, json={"detail": detail}))
+    with pytest.raises(ValidationError) as ei:
+        client.post("/pods")
+    assert ei.value.field_messages() == ["tpu_type: unknown TPU type"]
+
+
+def test_rate_limit_carries_retry_after():
+    client = make_client(
+        lambda r: httpx.Response(429, json={"detail": "slow down"}, headers={"Retry-After": "7"})
+    )
+    with pytest.raises(RateLimitError) as ei:
+        client.get("/x")
+    assert ei.value.retry_after == 7.0
+
+
+# -- retry tiers -------------------------------------------------------------
+
+
+def test_get_retries_5xx_then_succeeds(monkeypatch):
+    monkeypatch.setattr("prime_tpu.core.client._backoff", lambda a: 0)
+    client, transport = seq_client([(502, {}), (503, {}), (200, {"ok": 1})])
+    assert client.get("/x") == {"ok": 1}
+    assert transport.calls == 3
+
+
+def test_get_does_not_retry_non_retryable_5xx(monkeypatch):
+    monkeypatch.setattr("prime_tpu.core.client._backoff", lambda a: 0)
+    client, transport = seq_client([(501, {}), (200, {})])
+    with pytest.raises(APIError):
+        client.get("/x")
+    assert transport.calls == 1
+
+
+def test_post_does_not_retry_5xx(monkeypatch):
+    monkeypatch.setattr("prime_tpu.core.client._backoff", lambda a: 0)
+    client, transport = seq_client([(502, {}), (200, {})])
+    with pytest.raises(APIError):
+        client.post("/x")
+    assert transport.calls == 1
+
+
+def test_idempotent_post_retries_5xx(monkeypatch):
+    monkeypatch.setattr("prime_tpu.core.client._backoff", lambda a: 0)
+    client, transport = seq_client([(502, {}), (200, {"ok": 1})])
+    assert client.post("/x", idempotent_post=True) == {"ok": 1}
+    assert transport.calls == 2
+
+
+def test_post_retries_connect_error(monkeypatch):
+    monkeypatch.setattr("prime_tpu.core.client._backoff", lambda a: 0)
+    client, transport = seq_client([httpx.ConnectError("refused"), (200, {"ok": 1})])
+    assert client.post("/x") == {"ok": 1}
+    assert transport.calls == 2
+
+
+def test_post_does_not_retry_read_timeout(monkeypatch):
+    monkeypatch.setattr("prime_tpu.core.client._backoff", lambda a: 0)
+    from prime_tpu.core.exceptions import APITimeoutError
+
+    client, transport = seq_client([httpx.ReadTimeout("slow"), (200, {})])
+    with pytest.raises(APITimeoutError):
+        client.post("/x")
+    assert transport.calls == 1
+
+
+def test_get_retries_read_timeout(monkeypatch):
+    monkeypatch.setattr("prime_tpu.core.client._backoff", lambda a: 0)
+    client, transport = seq_client([httpx.ReadTimeout("slow"), (200, {"ok": 1})])
+    assert client.get("/x") == {"ok": 1}
+    assert transport.calls == 2
+
+
+def test_retries_exhaust(monkeypatch):
+    monkeypatch.setattr("prime_tpu.core.client._backoff", lambda a: 0)
+    client, transport = seq_client([httpx.ConnectError("down")], max_attempts=3)
+    with pytest.raises(APIConnectionError):
+        client.get("/x")
+    assert transport.calls == 3
+
+
+# -- async mirror ------------------------------------------------------------
+
+
+@pytest.mark.anyio
+async def test_async_basic_and_retry(monkeypatch):
+    monkeypatch.setattr("prime_tpu.core.client._backoff", lambda a: 0)
+    cfg = Config()
+    cfg.api_key = "k"
+    transport = SeqTransport([(503, {}), (200, {"ok": 2})])
+    client = AsyncAPIClient(config=cfg, base_url="https://api.test", transport=transport)
+    assert await client.get("/pods") == {"ok": 2}
+    assert transport.calls == 2
+    await client.close()
+
+
+@pytest.mark.anyio
+async def test_async_error_mapping():
+    client = make_async_client(lambda r: httpx.Response(401, json={}))
+    with pytest.raises(UnauthorizedError):
+        await client.get("/x")
+    await client.close()
+
+
+# -- review-finding regressions ----------------------------------------------
+
+
+def test_idempotent_post_autogenerates_idempotency_key():
+    seen = {}
+
+    def handler(request):
+        seen["key"] = request.headers.get("Idempotency-Key")
+        return httpx.Response(200, json={})
+
+    make_client(handler).post("/x", idempotent_post=True)
+    assert seen["key"] and len(seen["key"]) == 36  # uuid4
+
+    make_client(handler).post("/x", idempotent_post=True, headers={"Idempotency-Key": "mine"})
+    assert seen["key"] == "mine"
+
+
+def test_file_uploads_never_retried(monkeypatch, tmp_path):
+    monkeypatch.setattr("prime_tpu.core.client._backoff", lambda a: 0)
+    f = tmp_path / "payload.bin"
+    f.write_bytes(b"x" * 100)
+    client, transport = seq_client([(503, {}), (200, {})])
+    with open(f, "rb") as fh, pytest.raises(APIError):
+        client.put("/upload", files={"file": fh})
+    assert transport.calls == 1
+
+
+def test_invalid_prime_context_does_not_crash(tmp_path, monkeypatch):
+    cfg = Config(tmp_path / "prime")
+    cfg.api_key = "base"
+    cfg.save()
+    monkeypatch.setenv("PRIME_CONTEXT", "../../evil")
+    assert Config(tmp_path / "prime").api_key == "base"
+    # corrupt context file
+    monkeypatch.setenv("PRIME_CONTEXT", "broken")
+    (tmp_path / "prime" / "environments").mkdir(parents=True, exist_ok=True)
+    (tmp_path / "prime" / "environments" / "broken.json").write_text("{nope")
+    assert Config(tmp_path / "prime").api_key == "base"

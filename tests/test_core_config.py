@@ -1,0 +1,96 @@
+"""Config store: persistence, env precedence, named contexts, name sanitizing.
+
+Mirrors reference behaviors at prime_cli/core/config.py:81-82 (env precedence),
+:215-224 (path-traversal-safe context names), :244-389 (context CRUD).
+"""
+
+import json
+
+import pytest
+
+from prime_tpu.core.config import Config, InvalidContextName, sanitize_context_name
+
+
+def test_defaults_and_roundtrip(tmp_path):
+    cfg = Config(tmp_path / "prime")
+    assert cfg.api_key == ""
+    assert cfg.base_url.startswith("https://")
+    cfg.api_key = "pk-123"
+    cfg.team_id = "team-9"
+    cfg.save()
+
+    cfg2 = Config(tmp_path / "prime")
+    assert cfg2.api_key == "pk-123"
+    assert cfg2.team_id == "team-9"
+    data = json.loads((tmp_path / "prime" / "config.json").read_text())
+    assert data["api_key"] == "pk-123"
+
+
+def test_env_var_precedence(tmp_path, monkeypatch):
+    cfg = Config(tmp_path / "prime")
+    cfg.api_key = "from-file"
+    cfg.save()
+    monkeypatch.setenv("PRIME_API_KEY", "from-env")
+    assert Config(tmp_path / "prime").api_key == "from-env"
+    monkeypatch.delenv("PRIME_API_KEY")
+    assert Config(tmp_path / "prime").api_key == "from-file"
+
+
+def test_view_masks_api_key(tmp_path):
+    cfg = Config(tmp_path / "prime")
+    cfg.api_key = "pk-aaaaaaaaaaaaaaaabbbb"
+    view = cfg.view()
+    assert "aaaaaaaa" not in view["api_key"]
+    assert view["api_key"].startswith("pk-a")
+
+
+def test_context_save_use_delete_list(tmp_path):
+    cfg = Config(tmp_path / "prime")
+    cfg.api_key = "key-prod"
+    cfg.save()
+    cfg.save_context("prod")
+    cfg.api_key = "key-dev"
+    cfg.save()
+    cfg.save_context("dev")
+    assert cfg.list_contexts() == ["dev", "prod"]
+
+    cfg.use_context("prod")
+    assert cfg.api_key == "key-prod"
+    assert Config(tmp_path / "prime").api_key == "key-prod"
+
+    assert cfg.delete_context("dev") is True
+    assert cfg.delete_context("dev") is False
+    assert cfg.list_contexts() == ["prod"]
+
+
+def test_prime_context_env_switches_active(tmp_path, monkeypatch):
+    cfg = Config(tmp_path / "prime")
+    cfg.api_key = "default-key"
+    cfg.save()
+    cfg.api_key = "ctx-key"
+    cfg.save_context("alt")
+    cfg.api_key = "default-key"
+    cfg.save()
+
+    monkeypatch.setenv("PRIME_CONTEXT", "alt")
+    assert Config(tmp_path / "prime").api_key == "ctx-key"
+    # config.json untouched
+    monkeypatch.delenv("PRIME_CONTEXT")
+    assert Config(tmp_path / "prime").api_key == "default-key"
+
+
+@pytest.mark.parametrize("bad", ["../evil", "a/b", ".hidden", "", "x" * 80, "a\\b"])
+def test_context_name_sanitizer_rejects(bad):
+    with pytest.raises(InvalidContextName):
+        sanitize_context_name(bad)
+
+
+def test_context_name_sanitizer_accepts():
+    assert sanitize_context_name(" prod-2.x ") == "prod-2.x"
+
+
+def test_corrupt_config_file_falls_back_to_defaults(tmp_path):
+    d = tmp_path / "prime"
+    d.mkdir()
+    (d / "config.json").write_text("{not json")
+    assert Config(d).api_key == ""
