@@ -1,0 +1,5 @@
+"""Resource API clients (L2): pydantic models + thin REST wrappers.
+
+One module per backend resource, mirroring the reference's surface
+(prime_cli/api/, SURVEY.md §2.2) with TPU slices replacing GPU types.
+"""

@@ -1,0 +1,126 @@
+"""TPU availability queries.
+
+Capability parity with the reference availability client
+(prime_cli/api/availability.py:53-204: paginated GPU/cluster/disk availability,
+single- + multi-node merge) re-keyed on TPU slices: an offer is a
+(slice, provider, region, pricing, stock) row, single- and multi-host slices
+are one namespace (the slice spec itself says whether it spans hosts), and
+multi-slice (DCN-pooled) capacity is a first-class field instead of the
+reference's separate multi-node endpoint.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from prime_tpu.core.client import APIClient
+from prime_tpu.parallel.topology import SliceSpec, parse_slice
+
+
+class TpuOffer(BaseModel):
+    """One rentable TPU slice configuration at one provider/region."""
+
+    model_config = ConfigDict(populate_by_name=True)
+
+    offer_id: str = Field(alias="offerId")
+    slice_name: str = Field(alias="sliceName")          # e.g. "v5e-8"
+    tpu_type: str = Field(alias="tpuType")              # e.g. "v5e"
+    chips: int
+    hosts: int
+    ici_topology: str = Field(alias="iciTopology")      # e.g. "2x4"
+    provider: str
+    region: str
+    zone: str | None = None
+    price_hourly: float = Field(alias="priceHourly")    # USD per slice-hour
+    spot: bool = False
+    stock_status: str = Field(alias="stockStatus")      # available|low|unavailable
+    dcn_pool: str | None = Field(default=None, alias="dcnPool")
+    max_slices_in_pool: int = Field(default=1, alias="maxSlicesInPool")
+    hbm_gib: int | None = Field(default=None, alias="hbmGib")
+    bf16_tflops: float | None = Field(default=None, alias="bf16Tflops")
+
+    @property
+    def spec(self) -> SliceSpec:
+        return parse_slice(self.slice_name)
+
+    @property
+    def price_per_chip_hour(self) -> float:
+        return self.price_hourly / max(1, self.chips)
+
+
+class DiskAvailability(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    provider: str
+    region: str
+    disk_type: str = Field(alias="diskType")
+    min_size_gib: int = Field(alias="minSizeGib")
+    max_size_gib: int = Field(alias="maxSizeGib")
+    price_gib_month: float = Field(alias="priceGibMonth")
+
+
+class AvailabilityClient:
+    """Client for /availability/* endpoints."""
+
+    def __init__(self, client: APIClient) -> None:
+        self.client = client
+
+    def _fetch_paginated(self, path: str, params: dict[str, Any]) -> list[dict[str, Any]]:
+        """Walk offset/limit pages until the backend reports the end.
+
+        Mirrors the reference's pagination walk (api/availability.py:115
+        `_fetch_paginaged`).
+        """
+        rows: list[dict[str, Any]] = []
+        offset = 0
+        limit = int(params.pop("limit", 100))
+        while True:
+            page = self.client.get(path, params={**params, "offset": offset, "limit": limit})
+            items = page.get("items", []) if isinstance(page, dict) else page
+            rows.extend(items)
+            total = page.get("total") if isinstance(page, dict) else None
+            offset += len(items)
+            if not items or (total is not None and offset >= total):
+                return rows
+
+    def list_tpus(
+        self,
+        tpu_type: str | None = None,
+        min_chips: int | None = None,
+        region: str | None = None,
+        provider: str | None = None,
+        spot: bool | None = None,
+        multi_host: bool | None = None,
+    ) -> list[TpuOffer]:
+        params: dict[str, Any] = {}
+        if tpu_type:
+            params["tpu_type"] = tpu_type
+        if min_chips:
+            params["min_chips"] = min_chips
+        if region:
+            params["region"] = region
+        if provider:
+            params["provider"] = provider
+        if spot is not None:
+            params["spot"] = spot
+        offers = [TpuOffer.model_validate(r) for r in self._fetch_paginated("/availability/tpus", params)]
+        if multi_host is not None:
+            offers = [o for o in offers if (o.hosts > 1) == multi_host]
+        return sorted(offers, key=lambda o: (o.tpu_type, o.chips, o.price_hourly))
+
+    def list_tpu_types(self) -> list[dict[str, Any]]:
+        """Distinct generations with chip counts/pricing ranges, for the picker."""
+        return self.client.get("/availability/tpu-types")
+
+    def list_disks(self, region: str | None = None, provider: str | None = None) -> list[DiskAvailability]:
+        params: dict[str, Any] = {}
+        if region:
+            params["region"] = region
+        if provider:
+            params["provider"] = provider
+        return [
+            DiskAvailability.model_validate(r)
+            for r in self._fetch_paginated("/availability/disks", params)
+        ]

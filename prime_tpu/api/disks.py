@@ -1,0 +1,55 @@
+"""Persistent disk CRUD client (reference: prime_cli/api/disks.py:19-150)."""
+
+from __future__ import annotations
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from prime_tpu.core.client import APIClient
+
+
+class Disk(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    disk_id: str = Field(alias="diskId")
+    name: str
+    size_gib: int = Field(alias="sizeGib")
+    disk_type: str = Field(alias="diskType")
+    provider: str
+    region: str
+    status: str                              # CREATING|READY|ATTACHED|DELETING
+    attached_pod_id: str | None = Field(default=None, alias="attachedPodId")
+    team_id: str | None = Field(default=None, alias="teamId")
+    created_at: str | None = Field(default=None, alias="createdAt")
+
+
+class CreateDiskRequest(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    name: str
+    size_gib: int = Field(alias="sizeGib")
+    disk_type: str = Field(default="hyperdisk-balanced", alias="diskType")
+    provider: str | None = None
+    region: str | None = None
+    team_id: str | None = Field(default=None, alias="teamId")
+
+
+class DisksClient:
+    def __init__(self, client: APIClient) -> None:
+        self.client = client
+
+    def create(self, request: CreateDiskRequest) -> Disk:
+        payload = request.model_dump(by_alias=True, exclude_none=True)
+        if "teamId" not in payload and self.client.team_id:
+            payload["teamId"] = self.client.team_id
+        return Disk.model_validate(self.client.post("/disks", json=payload))
+
+    def list(self) -> list[Disk]:
+        data = self.client.get("/disks")
+        items = data.get("items", []) if isinstance(data, dict) else data
+        return [Disk.model_validate(d) for d in items]
+
+    def get(self, disk_id: str) -> Disk:
+        return Disk.model_validate(self.client.get(f"/disks/{disk_id}"))
+
+    def delete(self, disk_id: str) -> None:
+        self.client.delete(f"/disks/{disk_id}")

@@ -1,0 +1,112 @@
+"""TPU pod (slice VM) lifecycle client.
+
+Capability parity with the reference pods client (prime_cli/api/pods.py:66-240:
+CRUD + status + history, team auto-injection, ssh normalization) with the
+TPU-native twist: a pod is a **TPU VM slice**. Multi-host slices expose one SSH
+endpoint per worker host (`ssh_connections: list[str]` — the reference's
+multi-node `ssh_connection: List[str]` pattern, api/pods.py:10
+`clean_connection_fields`), and slice/ICI metadata rides on the pod.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pydantic import BaseModel, ConfigDict, Field, field_validator
+
+from prime_tpu.core.client import APIClient
+
+
+class PodStatus(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    pod_id: str = Field(alias="podId")
+    status: str                                     # PENDING|PROVISIONING|ACTIVE|ERROR|TERMINATED
+    ssh_connections: list[str] | None = Field(default=None, alias="sshConnections")
+    installation_status: str | None = Field(default=None, alias="installationStatus")
+    installation_progress: int | None = Field(default=None, alias="installationProgress")
+    installation_failure: str | None = Field(default=None, alias="installationFailure")
+
+    @field_validator("ssh_connections", mode="before")
+    @classmethod
+    def clean_connections(cls, v: Any) -> Any:
+        """Normalize backend quirks: [None]/[""] → None, str → [str]."""
+        if v is None:
+            return None
+        if isinstance(v, str):
+            v = [v]
+        cleaned = [c for c in v if c]
+        return cleaned or None
+
+
+class Pod(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    pod_id: str = Field(alias="podId")
+    name: str
+    status: str
+    slice_name: str = Field(alias="sliceName")
+    tpu_type: str = Field(alias="tpuType")
+    chips: int
+    hosts: int
+    ici_topology: str = Field(alias="iciTopology")
+    provider: str
+    region: str
+    zone: str | None = None
+    runtime_version: str | None = Field(default=None, alias="runtimeVersion")  # TPU VM image
+    price_hourly: float | None = Field(default=None, alias="priceHourly")
+    spot: bool = False
+    team_id: str | None = Field(default=None, alias="teamId")
+    created_at: str | None = Field(default=None, alias="createdAt")
+    ssh_connections: list[str] | None = Field(default=None, alias="sshConnections")
+    disk_ids: list[str] = Field(default_factory=list, alias="diskIds")
+    dcn_pool: str | None = Field(default=None, alias="dcnPool")
+
+    _clean = field_validator("ssh_connections", mode="before")(PodStatus.clean_connections.__func__)  # type: ignore[arg-type]
+
+
+class CreatePodRequest(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    name: str
+    offer_id: str | None = Field(default=None, alias="offerId")
+    slice_name: str = Field(alias="sliceName")
+    provider: str | None = None
+    region: str | None = None
+    runtime_version: str | None = Field(default=None, alias="runtimeVersion")
+    disk_size_gib: int | None = Field(default=None, alias="diskSizeGib")
+    spot: bool = False
+    team_id: str | None = Field(default=None, alias="teamId")
+    env_vars: dict[str, str] = Field(default_factory=dict, alias="envVars")
+
+
+class PodsClient:
+    """Client for /pods endpoints. Injects the configured team automatically."""
+
+    def __init__(self, client: APIClient) -> None:
+        self.client = client
+
+    def create(self, request: CreatePodRequest) -> Pod:
+        payload = request.model_dump(by_alias=True, exclude_none=True)
+        if "teamId" not in payload and self.client.team_id:
+            payload["teamId"] = self.client.team_id
+        return Pod.model_validate(self.client.post("/pods", json=payload))
+
+    def list(self, limit: int = 100, offset: int = 0) -> list[Pod]:
+        data = self.client.get("/pods", params={"limit": limit, "offset": offset})
+        items = data.get("items", []) if isinstance(data, dict) else data
+        return [Pod.model_validate(p) for p in items]
+
+    def get(self, pod_id: str) -> Pod:
+        return Pod.model_validate(self.client.get(f"/pods/{pod_id}"))
+
+    def get_status(self, pod_id: str) -> PodStatus:
+        return PodStatus.model_validate(self.client.get(f"/pods/{pod_id}/status"))
+
+    def terminate(self, pod_id: str) -> None:
+        self.client.delete(f"/pods/{pod_id}")
+
+    def history(self, limit: int = 100) -> list[Pod]:
+        data = self.client.get("/pods/history", params={"limit": limit})
+        items = data.get("items", []) if isinstance(data, dict) else data
+        return [Pod.model_validate(p) for p in items]

@@ -1,0 +1,23 @@
+"""Shared client construction for CLI commands.
+
+``transport_override`` exists so CLI tests can point every command at the
+in-process fake control plane without sockets or monkeypatching client methods
+(SURVEY.md §4's hermetic-tier upgrade).
+"""
+
+from __future__ import annotations
+
+import httpx
+
+from prime_tpu.core.client import APIClient
+from prime_tpu.core.config import Config
+
+transport_override: httpx.BaseTransport | None = None
+
+
+def build_config() -> Config:
+    return Config()
+
+
+def build_client(config: Config | None = None) -> APIClient:
+    return APIClient(config=config or build_config(), transport=transport_override)

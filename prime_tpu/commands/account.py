@@ -1,0 +1,56 @@
+"""`prime whoami` / `prime teams` / `prime wallet` — identity + billing.
+
+Reference surface: prime_cli/commands/{whoami,teams,switch,wallet}.py.
+"""
+
+from __future__ import annotations
+
+import click
+
+from prime_tpu.commands._deps import build_client, build_config
+from prime_tpu.utils.render import Renderer, output_options
+
+
+@click.command("whoami")
+@output_options
+def whoami(render: Renderer) -> None:
+    """Show the authenticated identity."""
+    info = build_client().get("/user/whoami")
+    cfg = build_config()
+    info["teamId"] = cfg.team_id or None
+    render.detail(info, title="Identity")
+
+
+@click.group(name="teams")
+def teams_group() -> None:
+    """List and switch teams."""
+
+
+@teams_group.command("list")
+@output_options
+def teams_list(render: Renderer) -> None:
+    teams = build_client().get("/teams")
+    cfg = build_config()
+    render.table(
+        ["TEAM ID", "NAME", "ACTIVE"],
+        [[t["teamId"], t["name"], "*" if t["teamId"] == cfg.team_id else ""] for t in teams],
+        title="Teams",
+        json_rows=teams,
+    )
+
+
+@teams_group.command("switch")
+@click.argument("team_id", required=False)
+def teams_switch(team_id: str | None) -> None:
+    """Switch the active team (pass no argument for personal scope)."""
+    cfg = build_config()
+    cfg.team_id = team_id or ""
+    cfg.save()
+    click.echo(f"Active team: {team_id or '(personal)'}")
+
+
+@click.command("wallet")
+@output_options
+def wallet(render: Renderer) -> None:
+    """Show wallet balance."""
+    render.detail(build_client().get("/wallet"), title="Wallet")

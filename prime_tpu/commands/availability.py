@@ -1,0 +1,101 @@
+"""`prime availability` — TPU slice / disk capacity queries.
+
+Reference surface: prime_cli/commands/availability.py (gpu-types/list/disks
+tables with short IDs), re-keyed on TPU slices.
+"""
+
+from __future__ import annotations
+
+import click
+
+from prime_tpu.api.availability import AvailabilityClient
+from prime_tpu.commands._deps import build_client
+from prime_tpu.utils.render import Renderer, output_options
+from prime_tpu.utils.short_id import shorten
+
+
+@click.group(name="availability")
+def availability_group() -> None:
+    """Query available TPU slices, generations, and disks."""
+
+
+@availability_group.command("tpu-types")
+@output_options
+def tpu_types(render: Renderer) -> None:
+    """List TPU generations with size and price ranges."""
+    rows = AvailabilityClient(build_client()).list_tpu_types()
+    render.table(
+        ["TPU TYPE", "MIN CHIPS", "MAX CHIPS", "FROM $/HR", "PROVIDERS"],
+        [
+            [r["tpuType"], r["minChips"], r["maxChips"], f"{r['minPriceHourly']:.2f}", ",".join(r["providers"])]
+            for r in rows
+        ],
+        title="TPU generations",
+        json_rows=rows,
+    )
+
+
+@availability_group.command("list")
+@click.option("--tpu-type", default=None, help="Filter by generation (v4, v5e, v5p, v6e).")
+@click.option("--min-chips", type=int, default=None, help="Minimum chips in the slice.")
+@click.option("--region", default=None)
+@click.option("--provider", default=None)
+@click.option("--spot/--on-demand", "spot", default=None, help="Only spot / only on-demand offers.")
+@click.option("--multi-host/--single-host", "multi_host", default=None)
+@output_options
+def list_offers(
+    render: Renderer,
+    tpu_type: str | None,
+    min_chips: int | None,
+    region: str | None,
+    provider: str | None,
+    spot: bool | None,
+    multi_host: bool | None,
+) -> None:
+    """List rentable TPU slice offers (sorted by generation, size, price)."""
+    offers = AvailabilityClient(build_client()).list_tpus(
+        tpu_type=tpu_type,
+        min_chips=min_chips,
+        region=region,
+        provider=provider,
+        spot=spot,
+        multi_host=multi_host,
+    )
+    render.table(
+        ["ID", "SLICE", "CHIPS", "HOSTS", "ICI", "PROVIDER", "REGION", "$/HR", "SPOT", "STOCK"],
+        [
+            [
+                shorten(o.offer_id),
+                o.slice_name,
+                o.chips,
+                o.hosts,
+                o.ici_topology,
+                o.provider,
+                o.region,
+                f"{o.price_hourly:.2f}",
+                "yes" if o.spot else "",
+                o.stock_status,
+            ]
+            for o in offers
+        ],
+        title="TPU slice offers",
+        json_rows=[o.model_dump(by_alias=True) for o in offers],
+    )
+
+
+@availability_group.command("disks")
+@click.option("--region", default=None)
+@click.option("--provider", default=None)
+@output_options
+def disks(render: Renderer, region: str | None, provider: str | None) -> None:
+    """List available persistent disk configurations."""
+    rows = AvailabilityClient(build_client()).list_disks(region=region, provider=provider)
+    render.table(
+        ["PROVIDER", "REGION", "TYPE", "MIN GiB", "MAX GiB", "$/GiB-MO"],
+        [
+            [d.provider, d.region, d.disk_type, d.min_size_gib, d.max_size_gib, f"{d.price_gib_month:.2f}"]
+            for d in rows
+        ],
+        title="Disk availability",
+        json_rows=[d.model_dump(by_alias=True) for d in rows],
+    )

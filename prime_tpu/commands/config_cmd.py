@@ -1,0 +1,109 @@
+"""`prime config` — view/set config + named context management.
+
+Reference surface: prime_cli/commands/config.py (view/set-api-key/set-team-id/
+set-ssh-key-path/set-base-url/envs save/use/delete).
+"""
+
+from __future__ import annotations
+
+import click
+
+from prime_tpu.commands._deps import build_config
+from prime_tpu.core.config import InvalidContextName
+from prime_tpu.utils.render import Renderer, output_options
+
+
+@click.group(name="config")
+def config_group() -> None:
+    """View and edit CLI configuration."""
+
+
+@config_group.command("view")
+@output_options
+def view(render: Renderer) -> None:
+    """Show the effective configuration (env overrides applied, key masked)."""
+    render.detail(build_config().view(), title="Configuration")
+
+
+def _set(field: str, value: str) -> None:
+    cfg = build_config()
+    setattr(cfg, field, value)
+    cfg.save()
+    click.echo(f"{field} updated.")
+
+
+@config_group.command("set-api-key")
+@click.argument("value", required=False)
+def set_api_key(value: str | None) -> None:
+    """Set the API key (prompts with hidden input when omitted)."""
+    if value is None:
+        value = click.prompt("API key", hide_input=True)
+    _set("api_key", value)
+
+
+@config_group.command("set-team-id")
+@click.argument("value")
+def set_team_id(value: str) -> None:
+    _set("team_id", value)
+
+
+@config_group.command("set-base-url")
+@click.argument("value")
+def set_base_url(value: str) -> None:
+    _set("base_url", value)
+
+
+@config_group.command("set-inference-url")
+@click.argument("value")
+def set_inference_url(value: str) -> None:
+    _set("inference_url", value)
+
+
+@config_group.command("set-ssh-key-path")
+@click.argument("value", type=click.Path())
+def set_ssh_key_path(value: str) -> None:
+    _set("ssh_key_path", value)
+
+
+@config_group.group("envs")
+def envs_group() -> None:
+    """Manage named config contexts."""
+
+
+@envs_group.command("save")
+@click.argument("name")
+def envs_save(name: str) -> None:
+    """Save the current config as a named context."""
+    try:
+        path = build_config().save_context(name)
+    except InvalidContextName as e:
+        raise click.ClickException(str(e)) from None
+    click.echo(f"Context '{name}' saved to {path}")
+
+
+@envs_group.command("use")
+@click.argument("name")
+def envs_use(name: str) -> None:
+    """Switch the active config to a named context."""
+    try:
+        build_config().use_context(name)
+    except (FileNotFoundError, InvalidContextName) as e:
+        raise click.ClickException(str(e)) from None
+    click.echo(f"Switched to context '{name}'")
+
+
+@envs_group.command("delete")
+@click.argument("name")
+def envs_delete(name: str) -> None:
+    try:
+        deleted = build_config().delete_context(name)
+    except InvalidContextName as e:
+        raise click.ClickException(str(e)) from None
+    click.echo(f"Context '{name}' deleted." if deleted else f"No context named '{name}'.")
+
+
+@envs_group.command("list")
+@output_options
+def envs_list(render: Renderer) -> None:
+    contexts = build_config().list_contexts()
+    render.table(["CONTEXT"], [[c] for c in contexts], title="Contexts", json_rows=contexts)

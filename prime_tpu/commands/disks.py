@@ -1,0 +1,88 @@
+"""`prime disks` — persistent disk CRUD (reference: prime_cli/commands/disks.py)."""
+
+from __future__ import annotations
+
+import click
+
+from prime_tpu.api.disks import CreateDiskRequest, DisksClient
+from prime_tpu.commands._deps import build_client
+from prime_tpu.utils.render import Renderer, output_options
+from prime_tpu.utils.short_id import resolve, shorten
+
+
+@click.group(name="disks")
+def disks_group() -> None:
+    """Manage persistent disks."""
+
+
+def _resolve(client: DisksClient, disk_id: str) -> str:
+    try:
+        return resolve(disk_id, [d.disk_id for d in client.list()])
+    except ValueError as e:
+        raise click.ClickException(str(e)) from None
+
+
+@disks_group.command("list")
+@output_options
+def list_disks(render: Renderer) -> None:
+    disks = DisksClient(build_client()).list()
+    render.table(
+        ["ID", "NAME", "SIZE GiB", "TYPE", "PROVIDER", "REGION", "STATUS", "ATTACHED TO"],
+        [
+            [
+                shorten(d.disk_id),
+                d.name,
+                d.size_gib,
+                d.disk_type,
+                d.provider,
+                d.region,
+                d.status,
+                shorten(d.attached_pod_id) if d.attached_pod_id else "",
+            ]
+            for d in disks
+        ],
+        title="Disks",
+        json_rows=[d.model_dump(by_alias=True) for d in disks],
+    )
+
+
+@disks_group.command("create")
+@click.option("--name", required=True)
+@click.option("--size-gib", type=int, required=True)
+@click.option("--disk-type", default="hyperdisk-balanced")
+@click.option("--provider", default=None)
+@click.option("--region", default=None)
+@output_options
+def create_disk(
+    render: Renderer, name: str, size_gib: int, disk_type: str, provider: str | None, region: str | None
+) -> None:
+    disk = DisksClient(build_client()).create(
+        CreateDiskRequest(name=name, size_gib=size_gib, disk_type=disk_type, provider=provider, region=region)
+    )
+    if render.is_json:
+        render.json(disk.model_dump(by_alias=True))
+    else:
+        render.message(f"Disk {shorten(disk.disk_id)} ({disk.name}, {disk.size_gib} GiB) created: {disk.status}")
+
+
+@disks_group.command("get")
+@click.argument("disk_id")
+@output_options
+def get_disk(render: Renderer, disk_id: str) -> None:
+    client = DisksClient(build_client())
+    disk = client.get(_resolve(client, disk_id))
+    render.detail(disk.model_dump(by_alias=True), title=f"Disk {shorten(disk.disk_id)}")
+
+
+@disks_group.command("delete")
+@click.argument("disk_id")
+@click.option("--yes", "-y", is_flag=True)
+@output_options
+def delete_disk(render: Renderer, disk_id: str, yes: bool) -> None:
+    client = DisksClient(build_client())
+    full_id = _resolve(client, disk_id)
+    if not yes and not click.confirm(f"Delete disk {shorten(full_id)}?"):
+        render.message("Aborted.")
+        return
+    client.delete(full_id)
+    render.message(f"Disk {shorten(full_id)} deleted.")

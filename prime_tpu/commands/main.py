@@ -1,34 +1,96 @@
 """`prime` CLI entry point.
 
-Command groups are assembled here in three help panels mirroring the reference
-(prime_cli/main.py:36-84): Lab, Compute, Account. Subcommand modules register
-lazily to keep CLI startup fast (the reference enforces this with a startup
-test, tests/test_windows_cli.py:6-40).
+Command groups are assembled into three help panels mirroring the reference
+(prime_cli/main.py:36-84): Lab, Compute, Account. Subcommand modules load
+lazily so CLI startup stays fast — the reference enforces the same contract
+with a startup test (tests/test_windows_cli.py:6-40); ours asserts `--help`
+never imports jax or the SDK heavyweights (tests/test_cli.py).
 """
 
 from __future__ import annotations
+
+import importlib
+import os
 
 import click
 
 import prime_tpu
 
+# command name → (module, attribute). Modules import only on dispatch.
+_LAZY_COMMANDS: dict[str, tuple[str, str]] = {
+    # Compute
+    "availability": ("prime_tpu.commands.availability", "availability_group"),
+    "pods": ("prime_tpu.commands.pods", "pods_group"),
+    "disks": ("prime_tpu.commands.disks", "disks_group"),
+    "sandbox": ("prime_tpu.commands.sandbox", "sandbox_group"),
+    "tunnel": ("prime_tpu.commands.tunnel", "tunnel_group"),
+    "images": ("prime_tpu.commands.images", "images_group"),
+    "inference": ("prime_tpu.commands.inference", "inference_group"),
+    # Lab
+    "env": ("prime_tpu.commands.env", "env_group"),
+    "eval": ("prime_tpu.commands.evals", "eval_group"),
+    "train": ("prime_tpu.commands.train", "train_group"),
+    "rl": ("prime_tpu.commands.train", "train_group"),
+    "lab": ("prime_tpu.commands.lab", "lab_group"),
+    "deployments": ("prime_tpu.commands.deployments", "deployments_group"),
+    # Account
+    "login": ("prime_tpu.commands.login", "login"),
+    "logout": ("prime_tpu.commands.login", "logout"),
+    "whoami": ("prime_tpu.commands.account", "whoami"),
+    "teams": ("prime_tpu.commands.account", "teams_group"),
+    "config": ("prime_tpu.commands.config_cmd", "config_group"),
+    "wallet": ("prime_tpu.commands.account", "wallet"),
+    "secrets": ("prime_tpu.commands.secrets", "secrets_group"),
+}
 
-@click.group(name="prime")
+
+class LazyGroup(click.Group):
+    def list_commands(self, ctx: click.Context) -> list[str]:
+        return sorted(_LAZY_COMMANDS)
+
+    def get_command(self, ctx: click.Context, name: str) -> click.Command | None:
+        spec = _LAZY_COMMANDS.get(name)
+        if spec is None:
+            return None
+        module_name, attr = spec
+        try:
+            module = importlib.import_module(module_name)
+        except ModuleNotFoundError as e:
+            if e.name == module_name:
+                return None  # subcommand module not built yet
+            raise  # a real dependency is missing — surface it, don't mask as "no such command"
+        return getattr(module, attr)
+
+    def invoke(self, ctx: click.Context):
+        # Backend errors must never reach the user as tracebacks.
+        from prime_tpu.core.exceptions import APIError, ValidationError
+
+        try:
+            return super().invoke(ctx)
+        except ValidationError as e:
+            fields = "; ".join(e.field_messages())
+            raise click.ClickException(f"{e.message}" + (f" ({fields})" if fields else "")) from e
+        except APIError as e:
+            raise click.ClickException(e.message) from e
+
+
+@click.group(name="prime", cls=LazyGroup)
 @click.version_option(prime_tpu.__version__, prog_name="prime-tpu")
 @click.option(
     "--context",
     default=None,
-    envvar="PRIME_CONTEXT",
     help="Use a named config context for this invocation.",
 )
-@click.pass_context
-def cli(ctx: click.Context, context: str | None) -> None:
-    """prime — TPU-native compute platform CLI."""
-    ctx.ensure_object(dict)
-    ctx.obj["context"] = context
-    if context:
-        import os
+def cli(context: str | None) -> None:
+    """prime — TPU-native compute platform CLI.
 
+    Compute: availability, pods, disks, sandbox, tunnel, images, inference.
+    Lab: env, eval, train/rl, deployments, lab.
+    Account: login, whoami, teams, config, wallet, secrets.
+
+    Tip for scripts and AI agents: pass --plain or --output json.
+    """
+    if context:
         os.environ["PRIME_CONTEXT"] = context
 
 

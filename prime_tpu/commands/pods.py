@@ -1,0 +1,309 @@
+"""`prime pods` — TPU slice VM lifecycle.
+
+Reference surface: prime_cli/commands/pods.py:401 (interactive create wizard,
+``--yes`` bypass), :1048 (connect: poll for SSH then exec ssh), :1096-1110
+(multi-node picker). TPU-native: the wizard walks generation → slice size →
+offer (price-sorted), and ``connect`` offers a per-host worker picker for
+multi-host slices (every TPU VM worker is individually SSH-able).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import time
+
+import click
+
+from prime_tpu.api.availability import AvailabilityClient
+from prime_tpu.api.pods import CreatePodRequest, PodsClient
+from prime_tpu.commands._deps import build_client, build_config
+from prime_tpu.parallel.topology import list_slice_names, parse_slice
+from prime_tpu.utils.render import Renderer, output_options
+from prime_tpu.utils.short_id import resolve, shorten
+
+# Injection point for tests (no real ssh in CI).
+ssh_runner = subprocess.run
+
+POLL_INTERVAL_S = 5.0
+CONNECT_WAIT_ATTEMPTS = 60
+
+
+@click.group(name="pods")
+def pods_group() -> None:
+    """Create, inspect, and connect to TPU slice pods."""
+
+
+def _resolve_pod_id(client: PodsClient, pod_id: str) -> str:
+    ids = [p.pod_id for p in client.list()]
+    try:
+        return resolve(pod_id, ids)
+    except ValueError as e:
+        raise click.ClickException(str(e)) from None
+
+
+def _pod_rows(pods: list) -> list[list]:
+    return [
+        [
+            shorten(p.pod_id),
+            p.name,
+            p.slice_name,
+            p.hosts,
+            p.ici_topology,
+            p.status,
+            p.provider,
+            p.region,
+            f"{p.price_hourly:.2f}" if p.price_hourly is not None else "",
+        ]
+        for p in pods
+    ]
+
+
+_POD_COLUMNS = ["ID", "NAME", "SLICE", "HOSTS", "ICI", "STATUS", "PROVIDER", "REGION", "$/HR"]
+
+
+@pods_group.command("list")
+@output_options
+def list_pods(render: Renderer) -> None:
+    """List running pods."""
+    pods = PodsClient(build_client()).list()
+    render.table(
+        _POD_COLUMNS,
+        _pod_rows(pods),
+        title="Pods",
+        json_rows=[p.model_dump(by_alias=True) for p in pods],
+    )
+
+
+@pods_group.command("history")
+@output_options
+def history(render: Renderer) -> None:
+    """List terminated pods."""
+    pods = PodsClient(build_client()).history()
+    render.table(
+        _POD_COLUMNS,
+        _pod_rows(pods),
+        title="Pod history",
+        json_rows=[p.model_dump(by_alias=True) for p in pods],
+    )
+
+
+@pods_group.command("get")
+@click.argument("pod_id")
+@output_options
+def get_pod(render: Renderer, pod_id: str) -> None:
+    """Show a pod's full metadata."""
+    client = PodsClient(build_client())
+    pod = client.get(_resolve_pod_id(client, pod_id))
+    render.detail(pod.model_dump(by_alias=True), title=f"Pod {shorten(pod.pod_id)}")
+
+
+@pods_group.command("status")
+@click.argument("pod_id")
+@output_options
+def status(render: Renderer, pod_id: str) -> None:
+    """Show a pod's provisioning status and SSH endpoints."""
+    client = PodsClient(build_client())
+    st = client.get_status(_resolve_pod_id(client, pod_id))
+    render.detail(st.model_dump(by_alias=True), title=f"Status {shorten(st.pod_id)}")
+
+
+@pods_group.command("terminate")
+@click.argument("pod_id")
+@click.option("--yes", "-y", is_flag=True, help="Skip the confirmation prompt.")
+@output_options
+def terminate(render: Renderer, pod_id: str, yes: bool) -> None:
+    """Terminate a pod."""
+    client = PodsClient(build_client())
+    full_id = _resolve_pod_id(client, pod_id)
+    if not yes and not click.confirm(f"Terminate pod {shorten(full_id)}?"):
+        render.message("Aborted.")
+        return
+    client.terminate(full_id)
+    if render.is_json:
+        render.json({"podId": full_id, "status": "TERMINATED"})
+    else:
+        render.message(f"Pod {shorten(full_id)} terminated.")
+
+
+@pods_group.command("create")
+@click.option("--name", default=None, help="Pod name (generated when omitted).")
+@click.option("--slice", "slice_name", default=None, help="TPU slice, e.g. v5e-8.")
+@click.option("--provider", default=None)
+@click.option("--region", default=None)
+@click.option("--runtime-version", default=None, help="TPU VM runtime image.")
+@click.option("--disk-size-gib", type=int, default=None)
+@click.option("--spot", is_flag=True, default=False)
+@click.option("--yes", "-y", is_flag=True, help="Skip confirmation (non-interactive).")
+@output_options
+def create(
+    render: Renderer,
+    name: str | None,
+    slice_name: str | None,
+    provider: str | None,
+    region: str | None,
+    runtime_version: str | None,
+    disk_size_gib: int | None,
+    spot: bool,
+    yes: bool,
+) -> None:
+    """Create a TPU slice pod (interactive wizard unless --slice is given)."""
+    api = build_client()
+    avail = AvailabilityClient(api)
+
+    if slice_name is None:
+        # Wizard: generation → slice size → offer by price.
+        types = avail.list_tpu_types()
+        click.echo("TPU generations:")
+        for i, t in enumerate(types, 1):
+            click.echo(
+                f"  {i}. {t['tpuType']}  ({t['minChips']}-{t['maxChips']} chips, "
+                f"from ${t['minPriceHourly']:.2f}/hr)"
+            )
+        idx = click.prompt("Select generation", type=click.IntRange(1, len(types)))
+        gen = types[idx - 1]["tpuType"]
+        sizes = list_slice_names(gen)
+        click.echo("Slice sizes:")
+        for i, s in enumerate(sizes, 1):
+            spec = parse_slice(s)
+            click.echo(f"  {i}. {s}  ({spec.chips} chips, {spec.hosts} host(s), ICI {spec.topology})")
+        idx = click.prompt("Select slice", type=click.IntRange(1, len(sizes)))
+        slice_name = sizes[idx - 1]
+
+    try:
+        spec = parse_slice(slice_name)
+    except ValueError as e:
+        raise click.ClickException(str(e)) from None
+
+    offer = None
+    if provider is None or region is None:
+        # spot is always a concrete bool here: on-demand users must never be
+        # auto-matched to a cheaper preemptible offer by the price sort.
+        offers = avail.list_tpus(tpu_type=spec.generation.value, spot=spot)
+        offers = [o for o in offers if o.slice_name == spec.name and o.stock_status != "unavailable"]
+        if region:
+            offers = [o for o in offers if o.region == region]
+        if not offers:
+            raise click.ClickException(f"No available offers for {spec.name}")
+        offers.sort(key=lambda o: o.price_hourly)
+        if yes:
+            offer = offers[0]
+        else:
+            click.echo("Offers (price-sorted):")
+            for i, o in enumerate(offers, 1):
+                click.echo(
+                    f"  {i}. {o.provider}/{o.region}  ${o.price_hourly:.2f}/hr"
+                    f"{'  [spot]' if o.spot else ''}"
+                )
+            idx = click.prompt("Select offer", type=click.IntRange(1, len(offers)), default=1)
+            offer = offers[idx - 1]
+        provider, region = offer.provider, offer.region
+
+    name = name or f"{spec.name}-{int(time.time()) % 100000}"
+    summary = (
+        f"{spec.name} ({spec.chips} chips / {spec.hosts} host(s), ICI {spec.topology}) "
+        f"on {provider}/{region}{' [spot]' if spot else ''}"
+    )
+    if not yes and not click.confirm(f"Create pod '{name}': {summary}?", default=True):
+        render.message("Aborted.")
+        return
+
+    pod = PodsClient(api).create(
+        CreatePodRequest(
+            name=name,
+            slice_name=spec.name,
+            offer_id=offer.offer_id if offer else None,
+            provider=provider,
+            region=region,
+            runtime_version=runtime_version,
+            disk_size_gib=disk_size_gib,
+            spot=spot,
+        )
+    )
+    if render.is_json:
+        render.json(pod.model_dump(by_alias=True))
+    else:
+        render.message(f"Pod {shorten(pod.pod_id)} ({pod.name}) created: {pod.status}")
+        render.message(f"Track it with: prime pods status {shorten(pod.pod_id)}")
+
+
+@pods_group.command("connect")
+@click.argument("pod_id")
+@click.option("--worker", type=int, default=None, help="Worker host index for multi-host slices.")
+@click.option("--command", "remote_command", default=None, help="Run a command instead of a shell.")
+@click.option("--all-workers", is_flag=True, help="Run --command on every worker host (SPMD fan-out).")
+@output_options
+def connect(
+    render: Renderer,
+    pod_id: str,
+    worker: int | None,
+    remote_command: str | None,
+    all_workers: bool,
+) -> None:
+    """SSH into a pod (waits for it to become reachable first)."""
+    config = build_config()
+    client = PodsClient(build_client(config))
+    full_id = _resolve_pod_id(client, pod_id)
+
+    ssh_connections = None
+    for _ in range(CONNECT_WAIT_ATTEMPTS):
+        st = client.get_status(full_id)
+        if st.status in ("ERROR", "TERMINATED"):
+            raise click.ClickException(f"Pod is {st.status}" + (f": {st.installation_failure}" if st.installation_failure else ""))
+        if st.ssh_connections:
+            ssh_connections = st.ssh_connections
+            break
+        render.message(f"Pod {shorten(full_id)} is {st.status}; waiting for SSH...")
+        time.sleep(POLL_INTERVAL_S)
+    if not ssh_connections:
+        raise click.ClickException("Timed out waiting for the pod to become reachable.")
+
+    if all_workers:
+        if not remote_command:
+            raise click.ClickException("--all-workers requires --command (SPMD fan-out runs the same command on every worker).")
+        targets = list(enumerate(ssh_connections))
+    elif len(ssh_connections) > 1 and worker is None:
+        click.echo(f"Slice spans {len(ssh_connections)} worker hosts:")
+        for i, conn in enumerate(ssh_connections):
+            click.echo(f"  {i}. {conn}")
+        worker = click.prompt("Select worker", type=click.IntRange(0, len(ssh_connections) - 1), default=0)
+        targets = [(worker, ssh_connections[worker])]
+    else:
+        w = worker or 0
+        if w >= len(ssh_connections):
+            raise click.ClickException(f"Worker {w} out of range (slice has {len(ssh_connections)} hosts)")
+        targets = [(w, ssh_connections[w])]
+
+    failures: list[tuple[int, int]] = []
+    for idx, conn in targets:
+        user_host, _, port = conn.partition(":")
+        args = [
+            "ssh",
+            "-i",
+            config.ssh_key_path,
+            "-o",
+            "StrictHostKeyChecking=no",
+            "-p",
+            port or "22",
+            user_host,
+        ]
+        if remote_command:
+            args.append(remote_command)
+        if len(targets) > 1:
+            render.message(f"[worker {idx}] {conn}")
+        result = ssh_runner(args)
+        rc = getattr(result, "returncode", 0)
+        if rc != 0:
+            failures.append((idx, rc))
+    if failures:
+        if len(targets) > 1:
+            detail = ", ".join(f"worker {i} rc={rc}" for i, rc in failures)
+            render.message(f"SPMD fan-out failed on {len(failures)}/{len(targets)} workers: {detail}", err=True)
+        raise SystemExit(failures[0][1])
+
+
+@pods_group.command("ssh", hidden=True)
+@click.argument("pod_id")
+@click.pass_context
+def ssh_alias(ctx: click.Context, pod_id: str) -> None:
+    """Alias for connect."""
+    ctx.invoke(connect, pod_id=pod_id, worker=None, remote_command=None, all_workers=False, plain=False, output="table")

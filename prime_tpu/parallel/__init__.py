@@ -1,0 +1,15 @@
+"""TPU parallelism: slice topology modeling, device meshes, sharding rules.
+
+``topology`` is pure Python (no JAX import) so the platform-client layers can
+use slice math without pulling in the compute stack. JAX-dependent modules
+(mesh, sharding, ring attention) import lazily.
+"""
+
+from prime_tpu.parallel.topology import (
+    SliceSpec,
+    TpuGeneration,
+    list_slice_names,
+    parse_slice,
+)
+
+__all__ = ["SliceSpec", "TpuGeneration", "list_slice_names", "parse_slice"]

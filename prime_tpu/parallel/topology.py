@@ -1,0 +1,209 @@
+"""TPU slice topology: the TPU-native replacement for GPU-type metadata.
+
+The reference models compute as ``gpu_type/gpu_count/socket/interconnect``
+(prime_cli/api/availability.py:53-83). Here the first-class unit is a **TPU
+slice**: a named accelerator like ``v5e-16`` that expands to chips, hosts,
+an ICI mesh topology (e.g. ``4x4``), and — for multi-slice jobs — a DCN pool.
+This module is pure Python (no JAX) so every platform layer can do slice math;
+`prime_tpu.parallel.mesh` maps these specs onto `jax.sharding.Mesh` axes.
+
+Ground truth per generation (public Cloud TPU system architecture):
+
+- **v4**: 3D torus, 4 chips/host, 2 TensorCores/chip, suffix counts *cores*
+  (``v4-8`` = 4 chips = 1 host).
+- **v5e**: 2D torus, up to 8 chips/host, 1 core/chip, suffix counts *chips*
+  (``v5e-8`` = 8 chips = 1 host; ``v5e-256`` = 256 chips = 32 hosts).
+- **v5p**: 3D torus, 4 chips/host, 2 cores/chip, suffix counts *cores*
+  (``v5p-8`` = 4 chips = 1 host).
+- **v6e**: 2D torus, same shape rules as v5e.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from enum import Enum
+
+
+class TpuGeneration(str, Enum):
+    V4 = "v4"
+    V5E = "v5e"
+    V5P = "v5p"
+    V6E = "v6e"
+
+    @property
+    def cores_per_chip(self) -> int:
+        return 1 if self in (TpuGeneration.V5E, TpuGeneration.V6E) else 2
+
+    @property
+    def chips_per_host(self) -> int:
+        return 8 if self in (TpuGeneration.V5E, TpuGeneration.V6E) else 4
+
+    @property
+    def suffix_counts_cores(self) -> bool:
+        """v4/v5p slice names count TensorCores; v5e/v6e count chips."""
+        return self in (TpuGeneration.V4, TpuGeneration.V5P)
+
+    @property
+    def torus_rank(self) -> int:
+        return 2 if self in (TpuGeneration.V5E, TpuGeneration.V6E) else 3
+
+    @property
+    def hbm_gib_per_chip(self) -> int:
+        return {"v4": 32, "v5e": 16, "v5p": 95, "v6e": 32}[self.value]
+
+    @property
+    def bf16_tflops_per_chip(self) -> float:
+        return {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}[self.value]
+
+
+def _factor_2d(chips: int) -> tuple[int, int]:
+    """Most-square 2D power-of-two grid, x <= y."""
+    x = 2 ** (int(math.log2(chips)) // 2)
+    return x, chips // x
+
+
+def _factor_3d(chips: int) -> tuple[int, int, int]:
+    """Most-cubic 3D power-of-two grid, x <= y <= z."""
+    exp = int(math.log2(chips))
+    a = exp // 3
+    rem = exp - 3 * a
+    dims = [a, a, a]
+    for i in range(rem):
+        dims[2 - i] += 1
+    return tuple(2**d for d in dims)  # type: ignore[return-value]
+
+
+@dataclass(frozen=True)
+class SliceSpec:
+    """A concrete TPU slice: the unit `prime pods create` provisions."""
+
+    name: str                 # e.g. "v5e-16"
+    generation: TpuGeneration
+    chips: int
+    cores: int
+    hosts: int
+    topology: str             # ICI mesh, e.g. "4x4" or "2x2x2"
+    multi_host: bool
+
+    @property
+    def hbm_gib(self) -> int:
+        return self.chips * self.generation.hbm_gib_per_chip
+
+    @property
+    def bf16_tflops(self) -> float:
+        return self.chips * self.generation.bf16_tflops_per_chip
+
+    @property
+    def ici_link_count(self) -> int:
+        """Bidirectional ICI links in the (possibly wrapped) torus."""
+        dims = [int(d) for d in self.topology.split("x")]
+        links = 0
+        for i, d in enumerate(dims):
+            others = 1
+            for j, o in enumerate(dims):
+                if j != i:
+                    others *= o
+            # a dimension of size d contributes d-1 links per line, or d when
+            # the torus wraps (only closed for full-size dims >= 4 in practice;
+            # we model the unwrapped mesh, which is the conservative count)
+            links += (d - 1) * others
+        return links
+
+    def to_metadata(self) -> dict:
+        """Wire-format slice metadata (what the control plane returns)."""
+        return {
+            "name": self.name,
+            "tpu_type": self.generation.value,
+            "chips": self.chips,
+            "cores": self.cores,
+            "hosts": self.hosts,
+            "ici_topology": self.topology,
+            "multi_host": self.multi_host,
+            "hbm_gib": self.hbm_gib,
+            "bf16_tflops": self.bf16_tflops,
+        }
+
+
+# Largest slice per generation, in chips (full-pod sizes from public docs:
+# v4 pod = 4096 chips, v5e pod = 256 chips, v5p pod = 8960 chips, v6e = 256).
+_MAX_CHIPS = {
+    TpuGeneration.V4: 4096,
+    TpuGeneration.V5E: 256,
+    TpuGeneration.V5P: 8960,
+    TpuGeneration.V6E: 256,
+}
+
+
+def parse_slice(name: str) -> SliceSpec:
+    """Parse an accelerator name like ``v5e-16`` into a full :class:`SliceSpec`.
+
+    Raises ``ValueError`` with an actionable message for unknown generations,
+    malformed names, non-power-of-two counts, and out-of-range sizes.
+    """
+    name = name.strip().lower()
+    if "-" not in name:
+        raise ValueError(
+            f"Malformed TPU slice name {name!r}: expected '<generation>-<count>' like 'v5e-8'"
+        )
+    gen_str, _, count_str = name.partition("-")
+    try:
+        gen = TpuGeneration(gen_str)
+    except ValueError:
+        valid = ", ".join(g.value for g in TpuGeneration)
+        raise ValueError(f"Unknown TPU generation {gen_str!r}: expected one of {valid}") from None
+    try:
+        count = int(count_str)
+    except ValueError:
+        raise ValueError(f"Malformed TPU slice name {name!r}: {count_str!r} is not a number") from None
+    if count <= 0 or (count & (count - 1)) != 0:
+        raise ValueError(f"Invalid slice size {count} in {name!r}: must be a power of two")
+
+    if gen.suffix_counts_cores:
+        # v4/v5p rent whole boards (4 chips): the smallest slice is <gen>-8.
+        if count < gen.cores_per_chip * 4:
+            raise ValueError(
+                f"Invalid slice size {count} in {name!r}: {gen.value} slices count cores "
+                f"({gen.cores_per_chip}/chip), minimum is {gen.value}-{gen.cores_per_chip * 4}"
+            )
+        chips = count // gen.cores_per_chip
+    else:
+        chips = count
+    cores = chips * gen.cores_per_chip
+    if chips > _MAX_CHIPS[gen]:
+        raise ValueError(
+            f"Slice {name!r} exceeds the largest {gen.value} pod ({_MAX_CHIPS[gen]} chips)"
+        )
+
+    hosts = max(1, math.ceil(chips / gen.chips_per_host))
+    if gen.torus_rank == 2:
+        x, y = _factor_2d(chips)
+        topology = f"{x}x{y}"
+    else:
+        x, y, z = _factor_3d(chips)
+        topology = f"{x}x{y}x{z}"
+    return SliceSpec(
+        name=f"{gen.value}-{count}",
+        generation=gen,
+        chips=chips,
+        cores=cores,
+        hosts=hosts,
+        topology=topology,
+        multi_host=hosts > 1,
+    )
+
+
+def list_slice_names(generation: TpuGeneration | str | None = None) -> list[str]:
+    """Enumerate valid slice names (the catalog `prime availability` serves)."""
+    gens = [TpuGeneration(generation)] if generation else list(TpuGeneration)
+    out: list[str] = []
+    for gen in gens:
+        chips = 1
+        while chips <= _MAX_CHIPS[gen]:
+            if gen.suffix_counts_cores:
+                if chips >= 4:  # v4/v5p minimum rentable slice is one board
+                    out.append(f"{gen.value}-{chips * gen.cores_per_chip}")
+            else:
+                out.append(f"{gen.value}-{chips}")
+            chips *= 2
+    return out

@@ -1,0 +1,3 @@
+from prime_tpu.testing.fake_backend import FakeControlPlane
+
+__all__ = ["FakeControlPlane"]

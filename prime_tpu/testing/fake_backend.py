@@ -1,0 +1,362 @@
+"""In-process fake control plane for hermetic tests.
+
+The reference has no fake backend — its tests monkeypatch client methods
+(SURVEY.md §4 "weakest spot"). This stateful fake implements the REST surface
+we consume as an ``httpx`` transport handler (works for both sync and async
+clients via ``httpx.MockTransport``), so TPU-topology behaviors — slice math,
+multi-host SSH fan-out, pod readiness polling — are testable end-to-end with
+no sockets and no monkeypatching.
+
+Lifecycle realism knobs:
+- pods advance PENDING → PROVISIONING → ACTIVE across successive status polls
+  (``pod_ready_after_polls``), growing per-host SSH endpoints when ACTIVE;
+- auth is enforced (401 without the expected bearer key);
+- every request is logged to ``.requests`` for assertion.
+
+Sandbox control-plane + gateway data-plane routes live in
+:mod:`prime_tpu.testing.fake_sandbox_plane` and are mounted by this router.
+"""
+
+from __future__ import annotations
+
+import json as jsonlib
+import re
+import uuid
+from typing import Any, Callable
+
+import httpx
+
+from prime_tpu.parallel.topology import list_slice_names, parse_slice
+
+# Rough public on-demand USD/chip-hour list prices, used to seed the catalog.
+_CHIP_HOUR_PRICE = {"v4": 3.22, "v5e": 1.20, "v5p": 4.20, "v6e": 2.70}
+_REGIONS = {
+    "gcp": ["us-central2", "us-east5", "europe-west4"],
+    "tpucloud": ["us-west1"],
+}
+_DEFAULT_RUNTIME = "v2-alpha-tpuv5-lite"
+
+
+def _json_response(status: int, payload: Any, headers: dict[str, str] | None = None) -> httpx.Response:
+    return httpx.Response(status, json=payload, headers=headers)
+
+
+class FakeControlPlane:
+    """Stateful fake of the prime-tpu backend REST API."""
+
+    def __init__(
+        self,
+        api_key: str = "test-key",
+        team_id: str | None = None,
+        pod_ready_after_polls: int = 2,
+    ) -> None:
+        self.api_key = api_key
+        self.team_id = team_id
+        self.pod_ready_after_polls = pod_ready_after_polls
+        self.pods: dict[str, dict[str, Any]] = {}
+        self.terminated_pods: dict[str, dict[str, Any]] = {}
+        self.disks: dict[str, dict[str, Any]] = {}
+        self._pod_polls: dict[str, int] = {}
+        self.requests: list[tuple[str, str]] = []
+        self.offers = self._seed_offers()
+        self.wallet = {"balanceUsd": 100.0, "currency": "USD"}
+        self.user = {"userId": "user_1", "email": "dev@example.com", "name": "Dev"}
+        self.teams = [{"teamId": "team_1", "name": "research"}]
+        self.secrets: dict[str, str] = {}
+        self._routes: list[tuple[str, re.Pattern[str], Callable[..., httpx.Response]]] = []
+        self._register_routes()
+        self._mounts: list[Callable[[httpx.Request], httpx.Response | None]] = []
+
+    # -- catalog seeding -----------------------------------------------------
+
+    @staticmethod
+    def _seed_offers() -> list[dict[str, Any]]:
+        offers = []
+        i = 0
+        for name in list_slice_names():
+            spec = parse_slice(name)
+            for provider, regions in _REGIONS.items():
+                for region in regions:
+                    if provider == "tpucloud" and spec.generation.value not in ("v5e", "v6e"):
+                        continue
+                    for spot in (False, True):
+                        i += 1
+                        price = _CHIP_HOUR_PRICE[spec.generation.value] * spec.chips
+                        offers.append(
+                            {
+                                "offerId": f"offer_{i}",
+                                "sliceName": spec.name,
+                                "tpuType": spec.generation.value,
+                                "chips": spec.chips,
+                                "hosts": spec.hosts,
+                                "iciTopology": spec.topology,
+                                "provider": provider,
+                                "region": region,
+                                "zone": f"{region}-b",
+                                "priceHourly": round(price * (0.4 if spot else 1.0), 2),
+                                "spot": spot,
+                                "stockStatus": "available" if spec.chips <= 64 else "low",
+                                "dcnPool": f"{region}-pool" if spec.multi_host else None,
+                                "maxSlicesInPool": 8 if spec.multi_host else 1,
+                                "hbmGib": spec.hbm_gib,
+                                "bf16Tflops": spec.bf16_tflops,
+                            }
+                        )
+        return offers
+
+    # -- transport plumbing --------------------------------------------------
+
+    @property
+    def transport(self) -> httpx.MockTransport:
+        return httpx.MockTransport(self.handle)
+
+    def mount(self, handler: Callable[[httpx.Request], httpx.Response | None]) -> None:
+        """Attach an auxiliary route handler (e.g. the sandbox gateway plane)."""
+        self._mounts.append(handler)
+
+    def route(self, method: str, pattern: str) -> Callable:
+        def deco(fn: Callable[..., httpx.Response]) -> Callable[..., httpx.Response]:
+            self._routes.append((method, re.compile(pattern + r"$"), fn))
+            return fn
+
+        return deco
+
+    def handle(self, request: httpx.Request) -> httpx.Response:
+        path = request.url.path
+        self.requests.append((request.method, path))
+        for mounted in self._mounts:
+            resp = mounted(request)
+            if resp is not None:
+                return resp
+        if not path.startswith("/api/v1"):
+            return _json_response(404, {"detail": f"no route {path}"})
+        auth = request.headers.get("Authorization", "")
+        if auth != f"Bearer {self.api_key}":
+            return _json_response(401, {"detail": "invalid or missing API key"})
+        sub = path[len("/api/v1"):]
+        for method, pattern, fn in self._routes:
+            if method == request.method:
+                m = pattern.match(sub)
+                if m:
+                    return fn(request, **m.groupdict())
+        return _json_response(404, {"detail": f"no route {request.method} {sub}"})
+
+    @staticmethod
+    def _body(request: httpx.Request) -> dict[str, Any]:
+        if not request.content:
+            return {}
+        return jsonlib.loads(request.content.decode())
+
+    @staticmethod
+    def _paginate(request: httpx.Request, rows: list[dict[str, Any]]) -> httpx.Response:
+        params = request.url.params
+        offset = int(params.get("offset", 0))
+        limit = int(params.get("limit", 100))
+        return _json_response(
+            200, {"items": rows[offset : offset + limit], "total": len(rows), "offset": offset}
+        )
+
+    # -- routes --------------------------------------------------------------
+
+    def _register_routes(self) -> None:
+        route = self.route
+
+        @route("GET", r"/availability/tpus")
+        def availability_tpus(request: httpx.Request) -> httpx.Response:
+            params = request.url.params
+            rows = self.offers
+            if params.get("tpu_type"):
+                rows = [r for r in rows if r["tpuType"] == params["tpu_type"]]
+            if params.get("min_chips"):
+                rows = [r for r in rows if r["chips"] >= int(params["min_chips"])]
+            if params.get("region"):
+                rows = [r for r in rows if r["region"] == params["region"]]
+            if params.get("provider"):
+                rows = [r for r in rows if r["provider"] == params["provider"]]
+            if params.get("spot"):
+                want = params["spot"].lower() == "true"
+                rows = [r for r in rows if r["spot"] == want]
+            return self._paginate(request, rows)
+
+        @route("GET", r"/availability/tpu-types")
+        def availability_tpu_types(request: httpx.Request) -> httpx.Response:
+            out = []
+            for gen in ("v4", "v5e", "v5p", "v6e"):
+                gen_offers = [o for o in self.offers if o["tpuType"] == gen]
+                if not gen_offers:
+                    continue
+                out.append(
+                    {
+                        "tpuType": gen,
+                        "minChips": min(o["chips"] for o in gen_offers),
+                        "maxChips": max(o["chips"] for o in gen_offers),
+                        "minPriceHourly": min(o["priceHourly"] for o in gen_offers),
+                        "providers": sorted({o["provider"] for o in gen_offers}),
+                    }
+                )
+            return _json_response(200, out)
+
+        @route("GET", r"/availability/disks")
+        def availability_disks(request: httpx.Request) -> httpx.Response:
+            rows = [
+                {
+                    "provider": provider,
+                    "region": region,
+                    "diskType": dt,
+                    "minSizeGib": 10,
+                    "maxSizeGib": 65536,
+                    "priceGibMonth": price,
+                }
+                for provider, regions in _REGIONS.items()
+                for region in regions
+                for dt, price in [("hyperdisk-balanced", 0.10), ("pd-ssd", 0.17)]
+            ]
+            return self._paginate(request, rows)
+
+        @route("POST", r"/pods")
+        def create_pod(request: httpx.Request) -> httpx.Response:
+            body = self._body(request)
+            slice_name = body.get("sliceName", "")
+            try:
+                spec = parse_slice(slice_name)
+            except ValueError as e:
+                return _json_response(
+                    422,
+                    {"detail": [{"loc": ["body", "sliceName"], "msg": str(e), "type": "value_error"}]},
+                )
+            pod_id = f"pod_{uuid.uuid4().hex[:8]}"
+            pod = {
+                "podId": pod_id,
+                "name": body.get("name") or pod_id,
+                "status": "PENDING",
+                "sliceName": spec.name,
+                "tpuType": spec.generation.value,
+                "chips": spec.chips,
+                "hosts": spec.hosts,
+                "iciTopology": spec.topology,
+                "provider": body.get("provider") or "gcp",
+                "region": body.get("region") or "us-central2",
+                "zone": (body.get("region") or "us-central2") + "-b",
+                "runtimeVersion": body.get("runtimeVersion") or _DEFAULT_RUNTIME,
+                "priceHourly": _CHIP_HOUR_PRICE[spec.generation.value] * spec.chips,
+                "spot": bool(body.get("spot", False)),
+                "teamId": body.get("teamId"),
+                "createdAt": "2026-07-28T00:00:00Z",
+                "sshConnections": None,
+                "diskIds": [],
+                "dcnPool": f"{body.get('region') or 'us-central2'}-pool" if spec.multi_host else None,
+            }
+            self.pods[pod_id] = pod
+            self._pod_polls[pod_id] = 0
+            return _json_response(200, pod)
+
+        @route("GET", r"/pods/history")
+        def pods_history(request: httpx.Request) -> httpx.Response:
+            return self._paginate(request, list(self.terminated_pods.values()))
+
+        @route("GET", r"/pods/(?P<pod_id>[^/]+)/status")
+        def pod_status(request: httpx.Request, pod_id: str) -> httpx.Response:
+            pod = self.pods.get(pod_id)
+            if not pod:
+                return _json_response(404, {"detail": f"pod {pod_id} not found"})
+            self._advance_pod(pod_id)
+            return _json_response(
+                200,
+                {
+                    "podId": pod_id,
+                    "status": pod["status"],
+                    "sshConnections": pod["sshConnections"],
+                    "installationStatus": "done" if pod["status"] == "ACTIVE" else "installing",
+                    "installationProgress": 100 if pod["status"] == "ACTIVE" else 40,
+                },
+            )
+
+        @route("GET", r"/pods/(?P<pod_id>[^/]+)")
+        def get_pod(request: httpx.Request, pod_id: str) -> httpx.Response:
+            pod = self.pods.get(pod_id) or self.terminated_pods.get(pod_id)
+            if not pod:
+                return _json_response(404, {"detail": f"pod {pod_id} not found"})
+            return _json_response(200, pod)
+
+        @route("GET", r"/pods")
+        def list_pods(request: httpx.Request) -> httpx.Response:
+            return self._paginate(request, list(self.pods.values()))
+
+        @route("DELETE", r"/pods/(?P<pod_id>[^/]+)")
+        def terminate_pod(request: httpx.Request, pod_id: str) -> httpx.Response:
+            pod = self.pods.pop(pod_id, None)
+            if not pod:
+                return _json_response(404, {"detail": f"pod {pod_id} not found"})
+            pod["status"] = "TERMINATED"
+            self.terminated_pods[pod_id] = pod
+            return httpx.Response(204)
+
+        @route("POST", r"/disks")
+        def create_disk(request: httpx.Request) -> httpx.Response:
+            body = self._body(request)
+            disk_id = f"disk_{uuid.uuid4().hex[:8]}"
+            disk = {
+                "diskId": disk_id,
+                "name": body.get("name") or disk_id,
+                "sizeGib": int(body.get("sizeGib", 100)),
+                "diskType": body.get("diskType", "hyperdisk-balanced"),
+                "provider": body.get("provider") or "gcp",
+                "region": body.get("region") or "us-central2",
+                "status": "READY",
+                "attachedPodId": None,
+                "teamId": body.get("teamId"),
+                "createdAt": "2026-07-28T00:00:00Z",
+            }
+            self.disks[disk_id] = disk
+            return _json_response(200, disk)
+
+        @route("GET", r"/disks")
+        def list_disks(request: httpx.Request) -> httpx.Response:
+            return self._paginate(request, list(self.disks.values()))
+
+        @route("GET", r"/disks/(?P<disk_id>[^/]+)")
+        def get_disk(request: httpx.Request, disk_id: str) -> httpx.Response:
+            disk = self.disks.get(disk_id)
+            if not disk:
+                return _json_response(404, {"detail": f"disk {disk_id} not found"})
+            return _json_response(200, disk)
+
+        @route("DELETE", r"/disks/(?P<disk_id>[^/]+)")
+        def delete_disk(request: httpx.Request, disk_id: str) -> httpx.Response:
+            if disk_id not in self.disks:
+                return _json_response(404, {"detail": f"disk {disk_id} not found"})
+            del self.disks[disk_id]
+            return httpx.Response(204)
+
+        @route("GET", r"/user/whoami")
+        def whoami(request: httpx.Request) -> httpx.Response:
+            return _json_response(200, self.user)
+
+        @route("GET", r"/teams")
+        def teams(request: httpx.Request) -> httpx.Response:
+            return _json_response(200, self.teams)
+
+        @route("GET", r"/wallet")
+        def wallet(request: httpx.Request) -> httpx.Response:
+            return _json_response(200, self.wallet)
+
+    # -- lifecycle simulation ------------------------------------------------
+
+    def _advance_pod(self, pod_id: str) -> None:
+        pod = self.pods[pod_id]
+        if pod["status"] in ("ACTIVE", "ERROR", "TERMINATED"):
+            return
+        self._pod_polls[pod_id] += 1
+        polls = self._pod_polls[pod_id]
+        if polls >= self.pod_ready_after_polls:
+            pod["status"] = "ACTIVE"
+            pod["sshConnections"] = [
+                f"root@10.130.{i // 250}.{i % 250 + 1}:22" for i in range(pod["hosts"])
+            ]
+        elif polls >= max(1, self.pod_ready_after_polls // 2):
+            pod["status"] = "PROVISIONING"
+
+    def make_pod_active(self, pod_id: str) -> None:
+        """Test helper: skip the poll dance."""
+        self._pod_polls[pod_id] = self.pod_ready_after_polls
+        self._advance_pod(pod_id)

@@ -1,0 +1,104 @@
+"""Serve a FakeControlPlane over a real socket.
+
+This is the self-hosted E2E harness shape from SURVEY.md §4 tier 3: point the
+actual ``prime`` CLI process at ``http://127.0.0.1:<port>`` and exercise every
+command against a live (but local, stateful, deterministic) control plane.
+
+Usage:
+    python -m prime_tpu.testing.live_server --port 8900 [--api-key test-key]
+or in-process:
+    server = LiveControlPlane(fake); server.start(); ...; server.stop()
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import httpx
+
+from prime_tpu.testing.fake_backend import FakeControlPlane
+
+
+class _Handler(BaseHTTPRequestHandler):
+    fake: FakeControlPlane  # set by server factory
+
+    def _serve(self) -> None:
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        request = httpx.Request(
+            self.command,
+            f"http://{self.headers.get('Host', 'localhost')}{self.path}",
+            headers=dict(self.headers.items()),
+            content=body,
+        )
+        response = self.fake.handle(request)
+        payload = response.content
+        self.send_response(response.status_code)
+        for key, value in response.headers.items():
+            if key.lower() not in ("content-length", "transfer-encoding"):
+                self.send_header(key, value)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        if payload:
+            self.wfile.write(payload)
+
+    do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _serve
+
+    def log_message(self, *args: object) -> None:  # quiet
+        pass
+
+
+class LiveControlPlane:
+    """Threaded HTTP server wrapping a FakeControlPlane."""
+
+    def __init__(self, fake: FakeControlPlane | None = None, port: int = 0) -> None:
+        self.fake = fake or FakeControlPlane()
+        handler = type("BoundHandler", (_Handler,), {"fake": self.fake})
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "LiveControlPlane":
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self) -> "LiveControlPlane":
+        return self.start()
+
+    def __exit__(self, *exc: object) -> None:
+        self.stop()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="Run a local fake prime-tpu control plane.")
+    parser.add_argument("--port", type=int, default=8900)
+    parser.add_argument("--api-key", default="test-key")
+    parser.add_argument("--pod-ready-after-polls", type=int, default=2)
+    args = parser.parse_args()
+    fake = FakeControlPlane(api_key=args.api_key, pod_ready_after_polls=args.pod_ready_after_polls)
+    server = LiveControlPlane(fake, port=args.port)
+    print(f"fake control plane listening on {server.url} (api key: {args.api_key})")
+    print(f"  export PRIME_BASE_URL={server.url} PRIME_API_KEY={args.api_key}")
+    try:
+        server.start()
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Dual-mode command output: rich tables, ``--plain`` text, ``--output json``.
+
+Capability parity with the reference's PlainTyper/PrimeConsole
+(prime_cli/utils/plain.py:17-37): every command renders human tables by
+default, tab-separated plain text for scripts/AI agents, or machine JSON.
+The ``--plain`` help note explicitly tells AI agents to prefer it.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+from typing import Any, Callable, Sequence
+
+import click
+from rich.console import Console
+from rich.table import Table
+
+PLAIN_HELP = "Plain text output (recommended for scripts and AI agents)."
+OUTPUT_HELP = "Output format: table (default) or json."
+
+
+class Renderer:
+    """Renders command results in the selected mode."""
+
+    def __init__(self, plain: bool = False, output: str = "table") -> None:
+        self.plain = plain
+        self.output = output
+        self.console = Console()
+
+    @property
+    def is_json(self) -> bool:
+        return self.output == "json"
+
+    def json(self, payload: Any) -> None:
+        click.echo(json.dumps(payload, indent=2, default=str))
+
+    def table(
+        self,
+        columns: Sequence[str],
+        rows: Sequence[Sequence[Any]],
+        *,
+        title: str | None = None,
+        json_rows: Any = None,
+    ) -> None:
+        if self.is_json:
+            if json_rows is not None:
+                self.json(json_rows)
+            else:
+                self.json([dict(zip(columns, row)) for row in rows])
+            return
+        if self.plain:
+            click.echo("\t".join(str(c) for c in columns))
+            for row in rows:
+                click.echo("\t".join("" if v is None else str(v) for v in row))
+            return
+        table = Table(title=title)
+        for col in columns:
+            table.add_column(str(col))
+        for row in rows:
+            table.add_row(*("" if v is None else str(v) for v in row))
+        self.console.print(table)
+
+    def detail(self, pairs: dict[str, Any], *, title: str | None = None, json_obj: Any = None) -> None:
+        if self.is_json:
+            self.json(json_obj if json_obj is not None else pairs)
+            return
+        if self.plain:
+            for k, v in pairs.items():
+                click.echo(f"{k}\t{'' if v is None else v}")
+            return
+        table = Table(title=title, show_header=False)
+        table.add_column("field", style="bold")
+        table.add_column("value")
+        for k, v in pairs.items():
+            table.add_row(str(k), "" if v is None else str(v))
+        self.console.print(table)
+
+    def message(self, text: str, *, err: bool = False) -> None:
+        if self.is_json:
+            return  # JSON mode emits only the payload
+        click.echo(text, err=err)
+
+    def error(self, text: str) -> None:
+        if self.is_json:
+            click.echo(json.dumps({"error": text}), err=False)
+        else:
+            click.echo(f"Error: {text}", err=True)
+
+
+def output_options(fn: Callable) -> Callable:
+    """Attach ``--plain`` / ``--output`` and inject a Renderer as ``render``."""
+
+    @click.option("--plain", is_flag=True, default=False, help=PLAIN_HELP)
+    @click.option(
+        "--output",
+        "output",
+        type=click.Choice(["table", "json"]),
+        default="table",
+        help=OUTPUT_HELP,
+    )
+    def wrapper(*args: Any, plain: bool, output: str, **kwargs: Any) -> Any:
+        return fn(*args, render=Renderer(plain=plain, output=output), **kwargs)
+
+    functools.update_wrapper(wrapper, fn, assigned=("__name__", "__doc__"), updated=())
+    return wrapper

@@ -1,0 +1,30 @@
+"""Short-ID display + prefix resolution (reference: helper/short_id.py:6).
+
+Long backend IDs (``offer_1234abcd``, ``pod_9f3a1c2b``) display as their first
+8 significant characters; user-typed prefixes resolve back to the unique full
+ID, with ambiguity and miss errors that name the candidates.
+"""
+
+from __future__ import annotations
+
+SHORT_LEN = 8
+
+
+def shorten(full_id: str) -> str:
+    if "_" in full_id:
+        prefix, _, rest = full_id.partition("_")
+        return f"{prefix}_{rest[:SHORT_LEN]}" if len(rest) > SHORT_LEN else full_id
+    return full_id[:SHORT_LEN] if len(full_id) > SHORT_LEN else full_id
+
+
+def resolve(prefix: str, candidates: list[str]) -> str:
+    """Resolve a (possibly short) ID against known candidates."""
+    if prefix in candidates:
+        return prefix
+    matches = [c for c in candidates if c.startswith(prefix)]
+    if len(matches) == 1:
+        return matches[0]
+    if not matches:
+        raise ValueError(f"No ID matches {prefix!r}")
+    sample = ", ".join(sorted(matches)[:5])
+    raise ValueError(f"Ambiguous ID {prefix!r}: matches {sample}")

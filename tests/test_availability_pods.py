@@ -1,0 +1,116 @@
+"""Availability + pods clients against the in-process fake control plane.
+
+This is the hermetic test layer SURVEY.md §4 calls for: no monkeypatched
+client methods — the real clients talk to a stateful fake backend through the
+real transport code path.
+"""
+
+import pytest
+
+from prime_tpu.api.availability import AvailabilityClient
+from prime_tpu.api.pods import CreatePodRequest, PodsClient
+from prime_tpu.core.client import APIClient
+from prime_tpu.core.config import Config
+from prime_tpu.core.exceptions import UnauthorizedError, ValidationError
+from prime_tpu.testing import FakeControlPlane
+
+
+@pytest.fixture
+def fake():
+    return FakeControlPlane(pod_ready_after_polls=2)
+
+
+@pytest.fixture
+def client(fake):
+    cfg = Config()
+    cfg.api_key = "test-key"
+    return APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+
+
+def test_auth_enforced(fake):
+    cfg = Config()
+    cfg.api_key = "wrong-key"
+    bad = APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    with pytest.raises(UnauthorizedError):
+        AvailabilityClient(bad).list_tpus()
+
+
+def test_list_tpus_filters_and_slice_metadata(client):
+    avail = AvailabilityClient(client)
+    offers = avail.list_tpus(tpu_type="v5e", min_chips=8, spot=False)
+    assert offers and all(o.tpu_type == "v5e" and o.chips >= 8 and not o.spot for o in offers)
+    v5e8 = [o for o in offers if o.slice_name == "v5e-8"][0]
+    assert v5e8.hosts == 1 and v5e8.ici_topology == "2x4"
+    assert v5e8.spec.chips == 8
+    v5e16 = [o for o in offers if o.slice_name == "v5e-16"][0]
+    assert v5e16.hosts == 2 and v5e16.dcn_pool  # multi-host rides a DCN pool
+    # price sanity: per-chip price constant within a generation
+    assert abs(v5e8.price_per_chip_hour - v5e16.price_per_chip_hour) < 1e-6
+
+
+def test_list_tpus_pagination_walks_all_pages(fake, client):
+    avail = AvailabilityClient(client)
+    all_offers = avail.list_tpus()
+    assert len(all_offers) == len(fake.offers)
+    # multiple GET pages were issued
+    pages = [p for m, p in fake.requests if m == "GET" and "availability/tpus" in p]
+    assert len(pages) >= 2
+
+
+def test_multi_host_filter(client):
+    avail = AvailabilityClient(client)
+    multi = avail.list_tpus(tpu_type="v5p", multi_host=True)
+    assert multi and all(o.hosts > 1 for o in multi)
+
+
+def test_tpu_types_catalog(client):
+    types = AvailabilityClient(client).list_tpu_types()
+    names = {t["tpuType"] for t in types}
+    assert {"v4", "v5e", "v5p", "v6e"} <= names
+
+
+def test_pod_lifecycle_multi_host_ssh(fake, client):
+    pods = PodsClient(client)
+    pod = pods.create(CreatePodRequest(name="train-16", slice_name="v5e-16"))
+    assert pod.status == "PENDING"
+    assert pod.hosts == 2 and pod.ici_topology == "4x4"
+
+    s1 = pods.get_status(pod.pod_id)
+    assert s1.status == "PROVISIONING" and s1.ssh_connections is None
+    s2 = pods.get_status(pod.pod_id)
+    assert s2.status == "ACTIVE"
+    # one SSH endpoint per worker host (the slice spans 2 hosts)
+    assert s2.ssh_connections is not None and len(s2.ssh_connections) == 2
+
+    listed = pods.list()
+    assert [p.pod_id for p in listed] == [pod.pod_id]
+
+    pods.terminate(pod.pod_id)
+    assert pods.list() == []
+    hist = pods.history()
+    assert hist[0].pod_id == pod.pod_id and hist[0].status == "TERMINATED"
+
+
+def test_pod_create_invalid_slice_is_422_with_field(client):
+    pods = PodsClient(client)
+    with pytest.raises(ValidationError) as ei:
+        pods.create(CreatePodRequest(name="x", slice_name="v5e-3"))
+    msgs = ei.value.field_messages()
+    assert msgs and "sliceName" in msgs[0]
+
+
+def test_pod_team_auto_injection(fake):
+    cfg = Config()
+    cfg.api_key = "test-key"
+    cfg.team_id = "team_1"
+    client = APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    pod = PodsClient(client).create(CreatePodRequest(name="t", slice_name="v5e-1"))
+    assert pod.team_id == "team_1"
+
+
+def test_ssh_connection_normalization():
+    from prime_tpu.api.pods import PodStatus
+
+    assert PodStatus.model_validate({"podId": "p", "status": "ACTIVE", "sshConnections": [None]}).ssh_connections is None
+    assert PodStatus.model_validate({"podId": "p", "status": "ACTIVE", "sshConnections": "root@h:22"}).ssh_connections == ["root@h:22"]
+    assert PodStatus.model_validate({"podId": "p", "status": "ACTIVE", "sshConnections": ["", "a"]}).ssh_connections == ["a"]

@@ -1,0 +1,74 @@
+"""Slice-topology math: the TPU-native core of the availability/pods model."""
+
+import pytest
+
+from prime_tpu.parallel.topology import SliceSpec, TpuGeneration, list_slice_names, parse_slice
+
+
+@pytest.mark.parametrize(
+    "name,chips,cores,hosts,topology",
+    [
+        ("v5e-1", 1, 1, 1, "1x1"),
+        ("v5e-4", 4, 4, 1, "2x2"),
+        ("v5e-8", 8, 8, 1, "2x4"),
+        ("v5e-16", 16, 16, 2, "4x4"),
+        ("v5e-64", 64, 64, 8, "8x8"),
+        ("v5e-256", 256, 256, 32, "16x16"),
+        ("v5p-8", 4, 8, 1, "1x2x2"),
+        ("v5p-16", 8, 16, 2, "2x2x2"),
+        ("v5p-128", 64, 128, 16, "4x4x4"),
+        ("v4-8", 4, 8, 1, "1x2x2"),
+        ("v6e-8", 8, 8, 1, "2x4"),
+    ],
+)
+def test_slice_math(name, chips, cores, hosts, topology):
+    s = parse_slice(name)
+    assert (s.chips, s.cores, s.hosts, s.topology) == (chips, cores, hosts, topology)
+    assert s.multi_host == (hosts > 1)
+
+
+def test_derived_capacity():
+    s = parse_slice("v5e-8")
+    assert s.hbm_gib == 8 * 16
+    assert s.bf16_tflops == pytest.approx(8 * 197.0)
+    assert parse_slice("v5p-8").hbm_gib == 4 * 95
+
+
+def test_ici_links_2d():
+    # 2x4 unwrapped mesh: rows 2*(4-1) + cols 4*(2-1) = 6 + 4 = 10
+    assert parse_slice("v5e-8").ici_link_count == 10
+
+
+def test_case_and_whitespace_tolerant():
+    assert parse_slice(" V5E-8 ").name == "v5e-8"
+
+
+@pytest.mark.parametrize(
+    "bad,fragment",
+    [
+        ("v5e-3", "power of two"),
+        ("h100-8", "Unknown TPU generation"),
+        ("v5e", "Malformed"),
+        ("v5e-x", "not a number"),
+        ("v5e-512", "exceeds"),
+        ("v5p-2", "count cores"),
+    ],
+)
+def test_parse_errors_are_actionable(bad, fragment):
+    with pytest.raises(ValueError, match=fragment):
+        parse_slice(bad)
+
+
+def test_catalog_roundtrips():
+    for name in list_slice_names():
+        spec = parse_slice(name)
+        assert isinstance(spec, SliceSpec)
+        assert spec.name == name
+        assert spec.to_metadata()["ici_topology"] == spec.topology
+
+
+def test_generation_properties():
+    assert TpuGeneration.V5E.chips_per_host == 8
+    assert TpuGeneration.V5P.cores_per_chip == 2
+    assert TpuGeneration.V5P.suffix_counts_cores
+    assert not TpuGeneration.V6E.suffix_counts_cores
