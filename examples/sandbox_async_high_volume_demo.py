@@ -1,0 +1,91 @@
+"""High-volume async sandbox fan-out (BASELINE: 50 sandboxes x 1000 commands).
+
+Reference workload: /root/reference/examples/sandbox_async_high_volume_demo.py
+(:76-110) — semaphore-bounded asyncio.gather across N sandboxes, reporting
+achieved req/s and average latency against a 2,000 req/min target. Here the
+concurrency primitive is an anyio CapacityLimiter and the same pattern scales
+to TPU-slice fan-out (one sandbox per v5p-64 worker host).
+
+Scale down for local runs:
+    PRIME_BASE_URL=http://127.0.0.1:8900 PRIME_API_KEY=test-key \
+        python examples/sandbox_async_high_volume_demo.py --sandboxes 5 --commands 20
+"""
+
+import argparse
+import pathlib
+import sys
+import time
+
+import anyio
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))  # repo-checkout runs
+
+from prime_tpu.sandboxes import AsyncSandboxClient, CreateSandboxRequest
+
+TARGET_REQ_PER_MIN = 2000
+
+
+async def run(n_sandboxes: int, n_commands: int, concurrency: int) -> None:
+    client = AsyncSandboxClient()
+    print(f"Creating {n_sandboxes} sandboxes...")
+    sandboxes = []
+    async with anyio.create_task_group() as tg:
+
+        async def create(i: int) -> None:
+            sb = await client.create(
+                CreateSandboxRequest(name=f"hv-{i}", docker_image="primetpu/python:3.12-slim")
+            )
+            sandboxes.append(sb.sandbox_id)
+
+        for i in range(n_sandboxes):
+            tg.start_soon(create, i)
+
+    await client.bulk_wait_for_creation(sandboxes)
+    print("All running. Executing commands...")
+
+    limiter = anyio.CapacityLimiter(concurrency)
+    latencies: list[float] = []
+    failures = 0
+
+    async def one(sid: str, i: int) -> None:
+        nonlocal failures
+        async with limiter:
+            t0 = time.monotonic()
+            try:
+                result = await client.execute_command(sid, f"echo {i}", timeout_s=30)
+                if not result.ok:
+                    failures += 1
+            except Exception:
+                failures += 1
+            latencies.append(time.monotonic() - t0)
+
+    t0 = time.monotonic()
+    async with anyio.create_task_group() as tg:
+        for i in range(n_commands):
+            tg.start_soon(one, sandboxes[i % len(sandboxes)], i)
+    elapsed = time.monotonic() - t0
+
+    total = len(latencies)
+    req_s = total / elapsed if elapsed else 0.0
+    avg_ms = 1000 * sum(latencies) / total if total else 0.0
+    print(f"  {total} commands in {elapsed:.1f}s -> {req_s:.1f} req/s ({req_s * 60:.0f} req/min)")
+    print(f"  avg latency {avg_ms:.1f} ms, failures {failures}")
+    met = failures == 0 and req_s * 60 >= TARGET_REQ_PER_MIN
+    print(f"  target {TARGET_REQ_PER_MIN} req/min: {'MET' if met else 'MISSED'}")
+
+    print("Cleaning up...")
+    await client.bulk_delete(sandboxes)
+    await client.close()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sandboxes", type=int, default=50)
+    parser.add_argument("--commands", type=int, default=1000)
+    parser.add_argument("--concurrency", type=int, default=64)
+    args = parser.parse_args()
+    anyio.run(run, args.sandboxes, args.commands, args.concurrency)
+
+
+if __name__ == "__main__":
+    main()

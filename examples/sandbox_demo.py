@@ -1,0 +1,49 @@
+"""Hello-world code exec in a sandbox (BASELINE config 1).
+
+Reference workload: /root/reference/examples/sandbox_demo.py — create a
+sandbox, wait for it, run commands, read the output, clean up. Point at a
+real control plane via PRIME_BASE_URL/PRIME_API_KEY, or at the local fake:
+
+    python -m prime_tpu.testing.live_server --port 8900 &
+    PRIME_BASE_URL=http://127.0.0.1:8900 PRIME_API_KEY=test-key \
+        python examples/sandbox_demo.py
+"""
+
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))  # repo-checkout runs
+
+from prime_tpu.sandboxes import CreateSandboxRequest, SandboxClient
+
+
+def main() -> None:
+    client = SandboxClient()
+    print("Creating sandbox (CPU image)...")
+    sandbox = client.create(
+        CreateSandboxRequest(
+            name="hello-demo",
+            docker_image="primetpu/python:3.12-slim",
+            timeout_minutes=10,
+        )
+    )
+    print(f"  created {sandbox.sandbox_id} ({sandbox.status})")
+
+    t0 = time.time()
+    sandbox = client.wait_for_creation(sandbox.sandbox_id)
+    print(f"  RUNNING after {time.time() - t0:.1f}s")
+
+    result = client.execute_command(sandbox.sandbox_id, "echo 'Hello from the sandbox!'")
+    print(f"  exec -> {result.stdout.strip()!r} (exit {result.exit_code})")
+
+    client.write_file(sandbox.sandbox_id, "/hello.py", b"print(6 * 7)")
+    result = client.execute_command(sandbox.sandbox_id, "python3 /hello.py 2>/dev/null || python3 hello.py")
+    print(f"  python -> {result.stdout.strip()!r}")
+
+    client.delete(sandbox.sandbox_id)
+    print("  deleted. done.")
+
+
+if __name__ == "__main__":
+    main()

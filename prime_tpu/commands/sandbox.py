@@ -1,0 +1,301 @@
+"""`prime sandbox` — sandbox lifecycle + exec + files over the SDK.
+
+Reference surface: prime_cli/commands/sandbox.py:258-1868 (list/get/create/
+delete incl. bulk preview+confirm, logs, run, upload/download, network,
+expose/unexpose/list-ports, reset-cache).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import click
+
+import prime_tpu.commands._deps as deps
+from prime_tpu.core.client import APIClient
+from prime_tpu.sandboxes import CreateSandboxRequest, EgressPolicy, SandboxClient
+from prime_tpu.sandboxes.auth import SandboxAuthCache
+from prime_tpu.utils.render import Renderer, output_options
+from prime_tpu.utils.short_id import resolve, shorten
+
+
+@click.group(name="sandbox")
+def sandbox_group() -> None:
+    """Run code in JAX/libtpu-preloaded sandboxes."""
+
+
+def build_sandbox_client() -> SandboxClient:
+    api = APIClient(config=deps.build_config(), transport=deps.transport_override)
+    return SandboxClient(client=api, gateway_transport=deps.transport_override)
+
+
+def _resolve_id(client: SandboxClient, sandbox_id: str) -> str:
+    return _resolve_ids(client, [sandbox_id])[0]
+
+
+def _resolve_ids(client: SandboxClient, sandbox_ids: list[str] | tuple[str, ...]) -> list[str]:
+    """Resolve many short IDs against ONE listing (no N+1 list calls)."""
+    candidates = [s.sandbox_id for s in client.list()]
+    try:
+        return [resolve(sid, candidates) for sid in sandbox_ids]
+    except ValueError as e:
+        raise click.ClickException(str(e)) from None
+
+
+def _parse_kv(pairs: tuple[str, ...], option: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for kv in pairs:
+        if "=" not in kv:
+            raise click.ClickException(f"Invalid {option} value {kv!r}: expected KEY=VALUE")
+        key, _, value = kv.partition("=")
+        out[key] = value
+    return out
+
+
+@sandbox_group.command("list")
+@click.option("--label", "labels", multiple=True, help="Filter by label key=value (repeatable).")
+@output_options
+def list_sandboxes(render: Renderer, labels: tuple[str, ...]) -> None:
+    label_map = _parse_kv(labels, "--label") if labels else None
+    sandboxes = build_sandbox_client().list(labels=label_map)
+    render.table(
+        ["ID", "NAME", "STATUS", "IMAGE", "TPU", "CREATED"],
+        [
+            [shorten(s.sandbox_id), s.name or "", s.status, s.docker_image, s.tpu_type or "-", s.created_at or ""]
+            for s in sandboxes
+        ],
+        title="Sandboxes",
+        json_rows=[s.model_dump(by_alias=True) for s in sandboxes],
+    )
+
+
+@sandbox_group.command("create")
+@click.option("--name", default=None)
+@click.option("--image", default=None, help="Docker image (defaults to the JAX/libtpu image).")
+@click.option("--tpu", "tpu_type", default=None, help="Attach a single-host TPU slice, e.g. v5e-1.")
+@click.option("--vm", "is_vm", is_flag=True, help="TPU-VM sandbox (streaming exec transport).")
+@click.option("--cpu", "cpu_cores", type=int, default=2)
+@click.option("--memory-gib", type=int, default=4)
+@click.option("--disk-gib", type=int, default=20)
+@click.option("--timeout-minutes", type=int, default=60)
+@click.option("--env", "env_vars", multiple=True, help="KEY=VALUE (repeatable).")
+@click.option("--label", "labels", multiple=True, help="key=value (repeatable).")
+@click.option("--wait/--no-wait", default=True, help="Wait until RUNNING.")
+@output_options
+def create_sandbox(
+    render: Renderer,
+    name: str | None,
+    image: str | None,
+    tpu_type: str | None,
+    is_vm: bool,
+    cpu_cores: int,
+    memory_gib: int,
+    disk_gib: int,
+    timeout_minutes: int,
+    env_vars: tuple[str, ...],
+    labels: tuple[str, ...],
+    wait: bool,
+) -> None:
+    """Create a sandbox (JAX/libtpu image by default)."""
+    try:
+        request = CreateSandboxRequest(
+            name=name,
+            tpu_type=tpu_type,
+            is_vm=is_vm,
+            cpu_cores=cpu_cores,
+            memory_gib=memory_gib,
+            disk_gib=disk_gib,
+            timeout_minutes=timeout_minutes,
+            env_vars=_parse_kv(env_vars, "--env"),
+            labels=_parse_kv(labels, "--label"),
+            **({"docker_image": image} if image else {}),
+        )
+    except ValueError as e:
+        import pydantic
+
+        if isinstance(e, pydantic.ValidationError):
+            msgs = "; ".join(
+                f"{'.'.join(str(p) for p in err['loc'])}: {err['msg'].removeprefix('Value error, ')}"
+                for err in e.errors()
+            )
+            raise click.ClickException(msgs) from None
+        raise click.ClickException(str(e)) from None
+    client = build_sandbox_client()
+    sandbox = client.create(request)
+    if wait:
+        render.message(f"Sandbox {shorten(sandbox.sandbox_id)} created; waiting for RUNNING...")
+        sandbox = client.wait_for_creation(sandbox.sandbox_id)
+    if render.is_json:
+        render.json(sandbox.model_dump(by_alias=True))
+    else:
+        render.message(f"Sandbox {shorten(sandbox.sandbox_id)} is {sandbox.status}")
+
+
+@sandbox_group.command("get")
+@click.argument("sandbox_id")
+@output_options
+def get_sandbox(render: Renderer, sandbox_id: str) -> None:
+    client = build_sandbox_client()
+    sandbox = client.get(_resolve_id(client, sandbox_id))
+    render.detail(sandbox.model_dump(by_alias=True), title=f"Sandbox {shorten(sandbox.sandbox_id)}")
+
+
+@sandbox_group.command("delete")
+@click.argument("sandbox_ids", nargs=-1, required=True)
+@click.option("--yes", "-y", is_flag=True)
+@output_options
+def delete_sandbox(render: Renderer, sandbox_ids: tuple[str, ...], yes: bool) -> None:
+    """Delete one or more sandboxes (bulk deletes show a preview first)."""
+    client = build_sandbox_client()
+    full_ids = _resolve_ids(client, sandbox_ids)
+    if len(full_ids) > 1 and not yes:
+        click.echo("Will delete:")
+        for sid in full_ids:
+            click.echo(f"  {shorten(sid)}")
+        if not click.confirm(f"Delete {len(full_ids)} sandboxes?"):
+            render.message("Aborted.")
+            return
+    if len(full_ids) == 1:
+        client.delete(full_ids[0])
+        render.message(f"Sandbox {shorten(full_ids[0])} deleted.")
+    else:
+        result = client.bulk_delete(full_ids)
+        render.message(f"Deleted {len(result.get('deleted', []))} sandboxes.")
+
+
+@sandbox_group.command("logs")
+@click.argument("sandbox_id")
+@output_options
+def logs(render: Renderer, sandbox_id: str) -> None:
+    client = build_sandbox_client()
+    click.echo(client.logs(_resolve_id(client, sandbox_id)))
+
+
+@sandbox_group.command("run")
+@click.argument("sandbox_id")
+@click.argument("command")
+@click.option("--timeout", "timeout_s", type=float, default=300.0)
+@click.option("--env", "env_vars", multiple=True, help="KEY=VALUE (repeatable).")
+@output_options
+def run_command(
+    render: Renderer, sandbox_id: str, command: str, timeout_s: float, env_vars: tuple[str, ...]
+) -> None:
+    """Execute a command and print its output (exit code is propagated)."""
+    client = build_sandbox_client()
+    result = client.execute_command(
+        _resolve_id(client, sandbox_id),
+        command,
+        timeout_s=timeout_s,
+        env=_parse_kv(env_vars, "--env") if env_vars else None,
+    )
+    if render.is_json:
+        render.json(result.model_dump(by_alias=True))
+    else:
+        if result.stdout:
+            click.echo(result.stdout, nl=False)
+        if result.stderr:
+            click.echo(result.stderr, nl=False, err=True)
+    if result.exit_code != 0:
+        sys.exit(result.exit_code)
+
+
+@sandbox_group.command("upload")
+@click.argument("sandbox_id")
+@click.argument("local_path", type=click.Path(exists=True))
+@click.argument("remote_path")
+@output_options
+def upload(render: Renderer, sandbox_id: str, local_path: str, remote_path: str) -> None:
+    client = build_sandbox_client()
+    client.upload_file(_resolve_id(client, sandbox_id), local_path, remote_path)
+    render.message(f"Uploaded {local_path} -> {remote_path}")
+
+
+@sandbox_group.command("download")
+@click.argument("sandbox_id")
+@click.argument("remote_path")
+@click.argument("local_path", type=click.Path())
+@output_options
+def download(render: Renderer, sandbox_id: str, remote_path: str, local_path: str) -> None:
+    client = build_sandbox_client()
+    client.download_file(_resolve_id(client, sandbox_id), remote_path, local_path)
+    render.message(f"Downloaded {remote_path} -> {local_path}")
+
+
+@sandbox_group.command("network")
+@click.argument("sandbox_id")
+@click.option("--default-action", type=click.Choice(["allow", "deny"]), default=None)
+@click.option("--allow", "allow_hosts", multiple=True)
+@click.option("--deny", "deny_hosts", multiple=True)
+@output_options
+def network(
+    render: Renderer,
+    sandbox_id: str,
+    default_action: str | None,
+    allow_hosts: tuple[str, ...],
+    deny_hosts: tuple[str, ...],
+) -> None:
+    """Show or update the egress policy."""
+    client = build_sandbox_client()
+    full_id = _resolve_id(client, sandbox_id)
+    if default_action is None and not allow_hosts and not deny_hosts:
+        policy = client.get_egress(full_id)
+    else:
+        current = client.get_egress(full_id)
+        try:
+            policy = client.set_egress(
+                full_id,
+                EgressPolicy(
+                    default_action=default_action or current.default_action,
+                    allow_hosts=list(allow_hosts) or current.allow_hosts,
+                    deny_hosts=list(deny_hosts) or current.deny_hosts,
+                ),
+            )
+        except ValueError as e:
+            raise click.ClickException(str(e)) from None
+    render.detail(policy.model_dump(by_alias=True), title="Egress policy")
+
+
+@sandbox_group.command("expose")
+@click.argument("sandbox_id")
+@click.argument("port", type=int)
+@click.option("--no-auth", is_flag=True, help="Expose without gateway auth.")
+@output_options
+def expose(render: Renderer, sandbox_id: str, port: int, no_auth: bool) -> None:
+    client = build_sandbox_client()
+    exposed = client.expose(_resolve_id(client, sandbox_id), port, auth_required=not no_auth)
+    if render.is_json:
+        render.json(exposed.model_dump(by_alias=True))
+    else:
+        render.message(f"Port {port} exposed at {exposed.url}")
+
+
+@sandbox_group.command("unexpose")
+@click.argument("sandbox_id")
+@click.argument("port", type=int)
+@output_options
+def unexpose(render: Renderer, sandbox_id: str, port: int) -> None:
+    client = build_sandbox_client()
+    client.unexpose(_resolve_id(client, sandbox_id), port)
+    render.message(f"Port {port} unexposed.")
+
+
+@sandbox_group.command("list-ports")
+@click.argument("sandbox_id")
+@output_options
+def list_ports(render: Renderer, sandbox_id: str) -> None:
+    client = build_sandbox_client()
+    ports = client.list_ports(_resolve_id(client, sandbox_id))
+    render.table(
+        ["PORT", "URL", "AUTH"],
+        [[p.port, p.url, "yes" if p.auth_required else "no"] for p in ports],
+        title="Exposed ports",
+        json_rows=[p.model_dump(by_alias=True) for p in ports],
+    )
+
+
+@sandbox_group.command("reset-cache")
+@output_options
+def reset_cache(render: Renderer) -> None:
+    """Clear the on-disk gateway auth-token cache."""
+    SandboxAuthCache().clear()
+    render.message("Sandbox auth cache cleared.")

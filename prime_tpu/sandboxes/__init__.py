@@ -1,0 +1,50 @@
+"""prime-tpu sandboxes SDK: remote JAX/XLA-preloaded code-execution sandboxes.
+
+Two-plane architecture (reference: prime_sandboxes, SURVEY.md §2.3):
+- **control plane** — backend REST (`/sandbox*`): lifecycle, auth-token mint,
+  logs, error context;
+- **data plane** — direct calls to a per-sandbox **gateway**
+  (`{gateway_url}/{user_ns}/{job_id}/...`) with short-lived bearer tokens:
+  command exec, files, background jobs, port exposure.
+
+TPU-native: sandboxes default to a JAX/libtpu image and can attach a TPU
+slice (``tpu_type="v5e-1"``); a TPU sandbox's exec environment has the chip
+visible to jax.devices().
+"""
+
+from prime_tpu.sandboxes.client import AsyncSandboxClient, SandboxClient
+from prime_tpu.sandboxes.exceptions import (
+    SandboxError,
+    SandboxImagePullError,
+    SandboxNotFoundError,
+    SandboxNotRunningError,
+    SandboxOOMError,
+    SandboxTimeoutError,
+)
+from prime_tpu.sandboxes.models import (
+    BackgroundJob,
+    CommandResult,
+    CreateSandboxRequest,
+    EgressPolicy,
+    ExposedPort,
+    Sandbox,
+    SandboxStatus,
+)
+
+__all__ = [
+    "AsyncSandboxClient",
+    "SandboxClient",
+    "Sandbox",
+    "SandboxStatus",
+    "CreateSandboxRequest",
+    "CommandResult",
+    "BackgroundJob",
+    "EgressPolicy",
+    "ExposedPort",
+    "SandboxError",
+    "SandboxOOMError",
+    "SandboxTimeoutError",
+    "SandboxImagePullError",
+    "SandboxNotRunningError",
+    "SandboxNotFoundError",
+]

@@ -1,0 +1,151 @@
+"""Gateway auth-token cache (reference: prime_sandboxes/sandbox.py:283-421).
+
+Tokens minted by ``POST /sandbox/{id}/auth`` are short-lived; this cache is
+- **disk-persisted** (``<config_dir>/sandbox_auth_cache.json``) so separate
+  CLI invocations reuse tokens,
+- **expiry-margined** (refreshes 60 s before expiry),
+- **coalescing**: concurrent callers for the same sandbox share one in-flight
+  mint instead of stampeding the control plane (sync: threading.Event; async:
+  anyio.Lock per the reference's asyncio.Lock).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from pathlib import Path
+from typing import Awaitable, Callable
+
+from prime_tpu.sandboxes.models import SandboxAuth
+
+AUTH_REFRESH_MARGIN_S = 60.0
+
+
+def default_cache_path() -> Path:
+    env_dir = os.environ.get("PRIME_CONFIG_DIR")
+    base = Path(env_dir) if env_dir else Path.home() / ".prime"
+    return base / "sandbox_auth_cache.json"
+
+
+class _CacheStore:
+    """Shared disk persistence for both cache variants."""
+
+    def __init__(self, cache_path: Path | None = None) -> None:
+        self.path = cache_path or default_cache_path()
+
+    def load(self) -> dict[str, dict]:
+        try:
+            return json.loads(self.path.read_text())
+        except (OSError, json.JSONDecodeError):
+            return {}
+
+    def save(self, entries: dict[str, dict]) -> None:
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=str(self.path.parent), prefix=".tmp-auth-")
+            with os.fdopen(fd, "w") as f:
+                json.dump(entries, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # cache is an optimization; never fail the operation
+
+    @staticmethod
+    def fresh(entry: dict | None) -> SandboxAuth | None:
+        if not entry:
+            return None
+        try:
+            auth = SandboxAuth.model_validate(entry)
+        except ValueError:
+            return None
+        if auth.expires_at - AUTH_REFRESH_MARGIN_S <= time.time():
+            return None
+        return auth
+
+
+class SandboxAuthCache:
+    """Thread-safe sync cache with in-flight request coalescing."""
+
+    def __init__(self, cache_path: Path | None = None) -> None:
+        self._store = _CacheStore(cache_path)
+        self._lock = threading.Lock()
+        self._entries: dict[str, dict] = self._store.load()
+        self._in_flight: dict[str, threading.Event] = {}
+
+    def get_or_refresh(self, sandbox_id: str, mint: Callable[[], SandboxAuth]) -> SandboxAuth:
+        while True:
+            with self._lock:
+                auth = self._store.fresh(self._entries.get(sandbox_id))
+                if auth:
+                    return auth
+                event = self._in_flight.get(sandbox_id)
+                if event is None:
+                    # we are the minter
+                    event = threading.Event()
+                    self._in_flight[sandbox_id] = event
+                    break
+            # someone else is minting — wait, then re-check
+            event.wait(timeout=30.0)
+        try:
+            auth = mint()
+            with self._lock:
+                self._entries[sandbox_id] = auth.model_dump(by_alias=True)
+                self._store.save(self._entries)
+            return auth
+        finally:
+            with self._lock:
+                self._in_flight.pop(sandbox_id, None)
+            event.set()
+
+    def invalidate(self, sandbox_id: str) -> None:
+        with self._lock:
+            if self._entries.pop(sandbox_id, None) is not None:
+                self._store.save(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            try:
+                self._store.path.unlink(missing_ok=True)
+            except OSError:
+                pass
+
+
+class AsyncSandboxAuthCache:
+    """Async mirror: one anyio.Lock per sandbox coalesces concurrent mints."""
+
+    def __init__(self, cache_path: Path | None = None) -> None:
+        import anyio
+
+        self._store = _CacheStore(cache_path)
+        self._entries: dict[str, dict] = self._store.load()
+        self._locks: dict[str, anyio.Lock] = {}
+        self._anyio = anyio
+
+    def _lock_for(self, sandbox_id: str):
+        lock = self._locks.get(sandbox_id)
+        if lock is None:
+            lock = self._anyio.Lock()
+            self._locks[sandbox_id] = lock
+        return lock
+
+    async def get_or_refresh(
+        self, sandbox_id: str, mint: Callable[[], Awaitable[SandboxAuth]]
+    ) -> SandboxAuth:
+        auth = self._store.fresh(self._entries.get(sandbox_id))
+        if auth:
+            return auth
+        async with self._lock_for(sandbox_id):
+            auth = self._store.fresh(self._entries.get(sandbox_id))  # re-check under lock
+            if auth:
+                return auth
+            auth = await mint()
+            self._entries[sandbox_id] = auth.model_dump(by_alias=True)
+            self._store.save(self._entries)
+            return auth
+
+    def invalidate(self, sandbox_id: str) -> None:
+        if self._entries.pop(sandbox_id, None) is not None:
+            self._store.save(self._entries)

@@ -1,0 +1,1008 @@
+"""Sandbox SDK clients: sync + async, control plane + gateway data plane.
+
+Reference: prime_sandboxes/sandbox.py:568-2780. The reference duplicates
+~1,100 lines between its sync and async mirrors; here everything that can be
+transport-agnostic — URL/payload builders, response parsing, retry policy
+decisions, background-job shell contracts, error classification — lives in
+module-level helpers and ``_SandboxOps``, so the sync/async classes contain
+only the I/O loops (SURVEY.md §7 "hard parts").
+
+Gateway state machine (reference sandbox.py:71-196, 642):
+- retryable 5xx {500, 502, 503, 504, 524} with exp backoff for idempotent ops;
+- 401 → invalidate cached token, re-auth ONCE, replay;
+- 409 (sandbox busy/starting) → probe error-context, short backoff, retry 4x;
+- 502 with ``sandbox_not_found`` body → terminal SandboxNotFoundError.
+"""
+
+from __future__ import annotations
+
+import shlex
+import time
+import uuid
+from pathlib import Path
+from typing import Any
+
+import httpx
+
+from prime_tpu.core.client import APIClient, AsyncAPIClient, _backoff
+from prime_tpu.core.exceptions import APIConnectionError, APIError, NotFoundError
+from prime_tpu.sandboxes.auth import AsyncSandboxAuthCache, SandboxAuthCache
+from prime_tpu.sandboxes.exceptions import (
+    CommandTimeoutError,
+    FileOperationError,
+    SandboxError,
+    SandboxNotFoundError,
+    SandboxNotRunningError,
+    classify_terminal_state,
+)
+from prime_tpu.sandboxes.models import (
+    BackgroundJob,
+    CommandResult,
+    CreateSandboxRequest,
+    EgressPolicy,
+    ExposedPort,
+    FileEntry,
+    Sandbox,
+    SandboxAuth,
+    SandboxStatus,
+)
+
+GATEWAY_RETRYABLE_STATUS = frozenset({500, 502, 503, 504, 524})
+GATEWAY_MAX_ATTEMPTS = 4
+CONFLICT_MAX_ATTEMPTS = 4
+CONFLICT_BACKOFF_S = 0.25
+DEFAULT_COMMAND_TIMEOUT_S = 300.0
+CLIENT_TIMEOUT_MARGIN_S = 5.0
+WAIT_MAX_ATTEMPTS = 60
+IMAGE_BUILD_BUDGET_S = 3000.0
+IMAGE_BUILD_POLL_S = 10.0
+BACKGROUND_OUTPUT_CAP = 10 * 1024 * 1024  # 10 MiB tail per stream
+_JOB_DIR = "/tmp/.prime_jobs"
+
+
+class _SandboxOps:
+    """Transport-agnostic request builders + response parsers."""
+
+    # -- control plane payloads ----------------------------------------------
+
+    @staticmethod
+    def create_payload(request: CreateSandboxRequest, team_id: str | None) -> dict[str, Any]:
+        payload = request.model_dump(by_alias=True, exclude_none=True)
+        if "teamId" not in payload and team_id:
+            payload["teamId"] = team_id
+        return payload
+
+    # -- gateway request specs ----------------------------------------------
+
+    @staticmethod
+    def gateway_url(auth: SandboxAuth, subpath: str) -> str:
+        base = auth.gateway_url.rstrip("/")
+        return f"{base}/{auth.user_namespace}/{auth.job_id}/{subpath.lstrip('/')}"
+
+    @staticmethod
+    def gateway_headers(auth: SandboxAuth) -> dict[str, str]:
+        return {"Authorization": f"Bearer {auth.token}"}
+
+    @staticmethod
+    def exec_payload(command: str, timeout_s: float, env: dict[str, str] | None) -> dict[str, Any]:
+        return {
+            "command": command,
+            "timeoutS": timeout_s,
+            "env": env or {},
+        }
+
+    @staticmethod
+    def is_sandbox_not_found(response: httpx.Response) -> bool:
+        """Gateway 502 whose body says the sandbox is gone (reference :244)."""
+        if response.status_code != 502:
+            return False
+        try:
+            return "sandbox_not_found" in response.text
+        except Exception:
+            return False
+
+    @staticmethod
+    def parse_exec(payload: dict[str, Any]) -> CommandResult:
+        return CommandResult.model_validate(payload)
+
+    # -- background-job shell contract (reference sandbox.py:1030-1192) ------
+
+    @staticmethod
+    def job_start_command(name: str, command: str) -> str:
+        d = f"{_JOB_DIR}/{name}"
+        inner = f"({command}) >{d}/out 2>{d}/err; echo $? >{d}/exit"
+        # setsid makes the wrapper a process-group leader so job_kill_command's
+        # group kill (`kill -- -pid`) reaps the whole tree, not just the shell.
+        return (
+            f"mkdir -p {d} && "
+            f"setsid nohup sh -c {shlex.quote(inner)} >/dev/null 2>&1 & echo $! >{d}/pid; cat {d}/pid"
+        )
+
+    @staticmethod
+    def job_status_command(name: str) -> str:
+        d = f"{_JOB_DIR}/{name}"
+        # prints: exit code (or RUNNING), then pid
+        return (
+            f"if [ -f {d}/exit ]; then cat {d}/exit; else echo RUNNING; fi; "
+            f"cat {d}/pid 2>/dev/null || echo -1"
+        )
+
+    @staticmethod
+    def job_tail_command(name: str, stream: str, max_bytes: int = BACKGROUND_OUTPUT_CAP) -> str:
+        return f"tail -c {max_bytes} {_JOB_DIR}/{name}/{stream} 2>/dev/null || true"
+
+    @staticmethod
+    def job_kill_command(name: str) -> str:
+        d = f"{_JOB_DIR}/{name}"
+        return f"[ -f {d}/pid ] && kill -- -$(cat {d}/pid) 2>/dev/null || kill $(cat {d}/pid) 2>/dev/null; true"
+
+    @staticmethod
+    def parse_job_status(name: str, sandbox_id: str, status_out: str, out_tail: str, err_tail: str) -> BackgroundJob:
+        lines = status_out.strip().splitlines() or ["RUNNING", "-1"]
+        first = lines[0].strip()
+        pid_str = lines[1].strip() if len(lines) > 1 else "-1"
+        pid = int(pid_str) if pid_str.isdigit() else None
+        running = first == "RUNNING"
+        if running and pid is None:
+            # no exit file AND no pid file: the job was never started
+            raise SandboxError(f"Background job {name!r} not found in sandbox {sandbox_id}", sandbox_id)
+        exit_code = None if running else int(first) if first.lstrip("-").isdigit() else 1
+        return BackgroundJob(
+            job_name=name,
+            sandbox_id=sandbox_id,
+            pid=pid,
+            running=running,
+            exit_code=exit_code,
+            stdout_tail=out_tail,
+            stderr_tail=err_tail,
+        )
+
+
+class SandboxClient:
+    """Synchronous sandbox client (control plane + gateway)."""
+
+    def __init__(
+        self,
+        client: APIClient | None = None,
+        auth_cache: SandboxAuthCache | None = None,
+        gateway_transport: httpx.BaseTransport | None = None,
+    ) -> None:
+        self.api = client or APIClient()
+        self.auth_cache = auth_cache or SandboxAuthCache()
+        self._gateway = httpx.Client(
+            timeout=httpx.Timeout(DEFAULT_COMMAND_TIMEOUT_S + CLIENT_TIMEOUT_MARGIN_S, connect=10.0),
+            transport=gateway_transport,
+        )
+
+    # ---- control plane -----------------------------------------------------
+
+    def create(self, request: CreateSandboxRequest, idempotency_key: str | None = None) -> Sandbox:
+        payload = _SandboxOps.create_payload(request, self.api.team_id)
+        headers = {"Idempotency-Key": idempotency_key or str(uuid.uuid4())}
+        data = self.api.post("/sandbox", json=payload, headers=headers, idempotent_post=True)
+        return Sandbox.model_validate(data)
+
+    def get(self, sandbox_id: str) -> Sandbox:
+        try:
+            return Sandbox.model_validate(self.api.get(f"/sandbox/{sandbox_id}"))
+        except NotFoundError as e:
+            raise SandboxNotFoundError(str(e), sandbox_id) from e
+
+    def list(self, labels: dict[str, str] | None = None, limit: int = 100, offset: int = 0) -> list[Sandbox]:
+        params: dict[str, Any] = {"limit": limit, "offset": offset}
+        if labels:
+            params["labels"] = ",".join(f"{k}={v}" for k, v in labels.items())
+        data = self.api.get("/sandbox", params=params)
+        items = data.get("items", []) if isinstance(data, dict) else data
+        return [Sandbox.model_validate(s) for s in items]
+
+    def list_all(self, labels: dict[str, str] | None = None, page_size: int = 100) -> list[Sandbox]:
+        """Walk every page of the list endpoint."""
+        out: list[Sandbox] = []
+        offset = 0
+        while True:
+            page = self.list(labels=labels, limit=page_size, offset=offset)
+            out.extend(page)
+            if len(page) < page_size:
+                return out
+            offset += len(page)
+
+    def delete(self, sandbox_id: str) -> None:
+        try:
+            self.api.delete(f"/sandbox/{sandbox_id}")
+        except NotFoundError:
+            pass  # already gone — delete is idempotent
+        self.auth_cache.invalidate(sandbox_id)
+
+    def bulk_delete(self, sandbox_ids: list[str]) -> dict[str, Any]:
+        result = self.api.post("/sandbox/bulk-delete", json={"sandboxIds": sandbox_ids}, idempotent_post=True)
+        for sid in sandbox_ids:
+            self.auth_cache.invalidate(sid)
+        return result or {}
+
+    def logs(self, sandbox_id: str) -> str:
+        data = self.api.get(f"/sandbox/{sandbox_id}/logs")
+        return data.get("logs", "") if isinstance(data, dict) else str(data)
+
+    def error_context(self, sandbox_id: str) -> dict[str, Any]:
+        try:
+            return self.api.get(f"/sandbox/{sandbox_id}/error-context") or {}
+        except APIError:
+            return {}
+
+    def _mint_auth(self, sandbox_id: str) -> SandboxAuth:
+        data = self.api.post(f"/sandbox/{sandbox_id}/auth", idempotent_post=True)
+        return SandboxAuth.model_validate(data)
+
+    def _auth(self, sandbox_id: str) -> SandboxAuth:
+        return self.auth_cache.get_or_refresh(sandbox_id, lambda: self._mint_auth(sandbox_id))
+
+    # ---- lifecycle waiting -------------------------------------------------
+
+    def wait_for_creation(
+        self,
+        sandbox_id: str,
+        max_attempts: int = WAIT_MAX_ATTEMPTS,
+        poll_interval_s: float = 1.0,
+    ) -> Sandbox:
+        """Poll until RUNNING + reachable; raise typed errors on terminal states.
+
+        A pending image build gets its own slow-poll budget (reference
+        sandbox.py:1237-1246) so cold image builds don't eat the normal wait.
+        """
+        image_build_deadline: float | None = None
+        for _ in range(max_attempts):
+            sandbox = self.get(sandbox_id)
+            if sandbox.status == SandboxStatus.RUNNING:
+                if self._is_reachable(sandbox_id):
+                    return sandbox
+            elif sandbox.is_terminal:
+                raise classify_terminal_state(sandbox.status, self.error_context(sandbox_id), sandbox_id)
+            elif sandbox.pending_image_build_id:
+                if image_build_deadline is None:
+                    image_build_deadline = time.monotonic() + IMAGE_BUILD_BUDGET_S
+                while time.monotonic() < image_build_deadline:
+                    sandbox = self.get(sandbox_id)
+                    if not sandbox.pending_image_build_id or sandbox.is_terminal:
+                        break
+                    time.sleep(IMAGE_BUILD_POLL_S)
+            time.sleep(poll_interval_s)
+        raise SandboxNotRunningError(
+            f"Sandbox {sandbox_id} not running after {max_attempts} attempts", sandbox_id
+        )
+
+    def bulk_wait_for_creation(
+        self,
+        sandbox_ids: list[str],
+        max_attempts: int = WAIT_MAX_ATTEMPTS,
+        poll_interval_s: float = 2.0,
+    ) -> list[Sandbox]:
+        """Wait on many sandboxes via the list endpoint (one request per poll
+        instead of N — dodges rate limits; reference sandbox.py:1254-1334)."""
+        pending = set(sandbox_ids)
+        done: dict[str, Sandbox] = {}
+        for _ in range(max_attempts):
+            listed = {s.sandbox_id: s for s in self.list_all()}
+            for sid in list(pending):
+                sandbox = listed.get(sid)
+                if sandbox is None:
+                    # dropped out of the listing (e.g. already terminal) —
+                    # check it directly so we fail fast instead of timing out
+                    sandbox = self.get(sid)
+                if sandbox.status == SandboxStatus.RUNNING:
+                    done[sid] = sandbox
+                    pending.discard(sid)
+                elif sandbox.is_terminal:
+                    raise classify_terminal_state(sandbox.status, self.error_context(sid), sid)
+            if not pending:
+                return [done[sid] for sid in sandbox_ids]
+            time.sleep(poll_interval_s)
+        raise SandboxNotRunningError(
+            f"{len(pending)} of {len(sandbox_ids)} sandboxes not running "
+            f"after {max_attempts} attempts: {sorted(pending)[:5]}"
+        )
+
+    def _is_reachable(self, sandbox_id: str) -> bool:
+        try:
+            return self.execute_command(sandbox_id, "echo ready", timeout_s=10.0).ok
+        except (SandboxNotRunningError, SandboxNotFoundError, APIError, CommandTimeoutError):
+            return False
+
+    # ---- gateway data plane ------------------------------------------------
+
+    def _gateway_request(
+        self,
+        sandbox_id: str,
+        method: str,
+        subpath: str,
+        *,
+        json: Any = None,
+        content: bytes | None = None,
+        params: dict[str, Any] | None = None,
+        timeout_s: float | None = None,
+        idempotent: bool = True,
+    ) -> httpx.Response:
+        """The gateway retry/auth state machine (shared by exec/files/ports)."""
+        auth = self._auth(sandbox_id)
+        reauthed = False
+        conflicts = 0
+        attempt = 0
+        while True:
+            try:
+                response = self._gateway.request(
+                    method,
+                    _SandboxOps.gateway_url(auth, subpath),
+                    json=json,
+                    content=content,
+                    params=params,
+                    headers=_SandboxOps.gateway_headers(auth),
+                    timeout=(timeout_s + CLIENT_TIMEOUT_MARGIN_S) if timeout_s else httpx.USE_CLIENT_DEFAULT,
+                )
+            except httpx.TimeoutException as e:
+                raise CommandTimeoutError(
+                    f"Gateway {method} {subpath} timed out for sandbox {sandbox_id}",
+                    sandbox_id,
+                    timeout_s,
+                ) from e
+            except httpx.TransportError as e:
+                if idempotent and attempt < GATEWAY_MAX_ATTEMPTS - 1:
+                    attempt += 1
+                    time.sleep(_backoff(attempt))
+                    continue
+                raise APIConnectionError(
+                    f"Could not reach gateway for sandbox {sandbox_id}: {e}"
+                ) from e
+
+            if response.status_code < 400:
+                return response
+            if _SandboxOps.is_sandbox_not_found(response):
+                self.auth_cache.invalidate(sandbox_id)
+                raise SandboxNotFoundError(f"Sandbox {sandbox_id} no longer exists", sandbox_id)
+            if response.status_code == 401 and not reauthed:
+                # token expired/revoked — re-auth exactly once (reference :940)
+                reauthed = True
+                self.auth_cache.invalidate(sandbox_id)
+                auth = self._auth(sandbox_id)
+                continue
+            if response.status_code == 409 and conflicts < CONFLICT_MAX_ATTEMPTS:
+                # sandbox busy/starting: probe control plane for a terminal cause
+                ctx = self.error_context(sandbox_id)
+                if ctx.get("terminal"):
+                    raise classify_terminal_state(ctx.get("status", "ERROR"), ctx, sandbox_id)
+                conflicts += 1
+                time.sleep(CONFLICT_BACKOFF_S * (2 ** (conflicts - 1)))
+                continue
+            if response.status_code in GATEWAY_RETRYABLE_STATUS and idempotent and attempt < GATEWAY_MAX_ATTEMPTS - 1:
+                attempt += 1
+                time.sleep(_backoff(attempt))
+                continue
+            raise APIError(
+                f"Gateway {method} {subpath} failed for sandbox {sandbox_id}: "
+                f"{response.status_code} {response.text[:200]}",
+                status_code=response.status_code,
+            )
+
+    def execute_command(
+        self,
+        sandbox_id: str,
+        command: str,
+        timeout_s: float = DEFAULT_COMMAND_TIMEOUT_S,
+        env: dict[str, str] | None = None,
+    ) -> CommandResult:
+        """Run a command in the sandbox and return its output.
+
+        Container sandboxes use single-shot REST exec; TPU-VM sandboxes use the
+        gateway's streaming endpoint (JSONL events; the reference's
+        Connect-RPC stream, sandbox.py:856-938, re-done as plain HTTP streaming).
+        """
+        auth = self._auth(sandbox_id)
+        if auth.is_vm:
+            return self._execute_streaming(sandbox_id, command, timeout_s, env)
+        response = self._gateway_request(
+            sandbox_id,
+            "POST",
+            "exec",
+            json=_SandboxOps.exec_payload(command, timeout_s, env),
+            timeout_s=timeout_s,
+            idempotent=False,
+        )
+        return _SandboxOps.parse_exec(response.json())
+
+    def _execute_streaming(
+        self,
+        sandbox_id: str,
+        command: str,
+        timeout_s: float,
+        env: dict[str, str] | None,
+    ) -> CommandResult:
+        """VM streaming exec under the same gateway state machine as REST exec:
+        401 re-auths once, 409 probes error-context and backs off, timeouts and
+        transport failures surface as typed errors. Exec itself is never
+        replayed after bytes were received (non-idempotent)."""
+        import json as jsonlib
+
+        reauthed = False
+        conflicts = 0
+        while True:
+            auth = self._auth(sandbox_id)
+            stdout: list[str] = []
+            stderr: list[str] = []
+            exit_code = 0
+            try:
+                with self._gateway.stream(
+                    "POST",
+                    _SandboxOps.gateway_url(auth, "exec/stream"),
+                    json=_SandboxOps.exec_payload(command, timeout_s, env),
+                    headers=_SandboxOps.gateway_headers(auth),
+                    timeout=timeout_s + CLIENT_TIMEOUT_MARGIN_S,
+                ) as response:
+                    if response.status_code >= 400:
+                        response.read()
+                        if _SandboxOps.is_sandbox_not_found(response):
+                            self.auth_cache.invalidate(sandbox_id)
+                            raise SandboxNotFoundError(f"Sandbox {sandbox_id} no longer exists", sandbox_id)
+                        if response.status_code == 401 and not reauthed:
+                            reauthed = True
+                            self.auth_cache.invalidate(sandbox_id)
+                            continue
+                        if response.status_code == 409 and conflicts < CONFLICT_MAX_ATTEMPTS:
+                            ctx = self.error_context(sandbox_id)
+                            if ctx.get("terminal"):
+                                raise classify_terminal_state(ctx.get("status", "ERROR"), ctx, sandbox_id)
+                            conflicts += 1
+                            time.sleep(CONFLICT_BACKOFF_S * (2 ** (conflicts - 1)))
+                            continue
+                        raise APIError(
+                            f"Streaming exec failed: {response.status_code}",
+                            status_code=response.status_code,
+                        )
+                    for line in response.iter_lines():
+                        if not line.strip():
+                            continue
+                        event = jsonlib.loads(line)
+                        kind = event.get("type")
+                        if kind == "stdout":
+                            stdout.append(event.get("data", ""))
+                        elif kind == "stderr":
+                            stderr.append(event.get("data", ""))
+                        elif kind == "exit":
+                            exit_code = int(event.get("code", 0))
+            except httpx.TimeoutException as e:
+                raise CommandTimeoutError(
+                    f"Streaming exec timed out for sandbox {sandbox_id}", sandbox_id, timeout_s
+                ) from e
+            except httpx.TransportError as e:
+                raise APIConnectionError(
+                    f"Could not reach gateway for sandbox {sandbox_id}: {e}"
+                ) from e
+            return CommandResult(stdout="".join(stdout), stderr="".join(stderr), exit_code=exit_code)
+
+    # ---- background jobs ---------------------------------------------------
+
+    def start_background_job(self, sandbox_id: str, name: str, command: str) -> BackgroundJob:
+        result = self.execute_command(sandbox_id, _SandboxOps.job_start_command(name, command))
+        pid = int(result.stdout.strip()) if result.stdout.strip().isdigit() else None
+        return BackgroundJob(job_name=name, sandbox_id=sandbox_id, pid=pid, running=True)
+
+    def get_background_job(self, sandbox_id: str, name: str) -> BackgroundJob:
+        status = self.execute_command(sandbox_id, _SandboxOps.job_status_command(name))
+        out = self.execute_command(sandbox_id, _SandboxOps.job_tail_command(name, "out"))
+        err = self.execute_command(sandbox_id, _SandboxOps.job_tail_command(name, "err"))
+        return _SandboxOps.parse_job_status(name, sandbox_id, status.stdout, out.stdout, err.stdout)
+
+    def kill_background_job(self, sandbox_id: str, name: str) -> None:
+        self.execute_command(sandbox_id, _SandboxOps.job_kill_command(name))
+
+    def wait_for_background_job(
+        self, sandbox_id: str, name: str, timeout_s: float = 3600.0, poll_interval_s: float = 2.0
+    ) -> BackgroundJob:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            job = self.get_background_job(sandbox_id, name)
+            if not job.running:
+                return job
+            time.sleep(poll_interval_s)
+        raise CommandTimeoutError(f"Background job {name} still running after {timeout_s}s", sandbox_id, timeout_s)
+
+    # ---- files -------------------------------------------------------------
+
+    def upload_file(self, sandbox_id: str, local_path: str | Path, remote_path: str) -> None:
+        data = Path(local_path).read_bytes()
+        self.write_file(sandbox_id, remote_path, data)
+
+    def write_file(self, sandbox_id: str, remote_path: str, data: bytes) -> None:
+        response = self._gateway_request(
+            sandbox_id,
+            "PUT",
+            "files",
+            content=data,
+            params={"path": remote_path},
+            idempotent=True,  # PUT of full content is replayable (bytes, not a stream)
+        )
+        if response.status_code >= 300:
+            raise FileOperationError(f"Upload to {remote_path} failed: {response.status_code}", sandbox_id)
+
+    def download_file(self, sandbox_id: str, remote_path: str, local_path: str | Path) -> None:
+        data = self.read_file_bytes(sandbox_id, remote_path)
+        Path(local_path).write_bytes(data)
+
+    def read_file_bytes(
+        self, sandbox_id: str, remote_path: str, offset: int | None = None, length: int | None = None
+    ) -> bytes:
+        """Windowed reads via offset/length (reference sandbox.py:1508)."""
+        params: dict[str, Any] = {"path": remote_path}
+        if offset is not None:
+            params["offset"] = offset
+        if length is not None:
+            params["length"] = length
+        response = self._gateway_request(sandbox_id, "GET", "files", params=params)
+        return response.content
+
+    def read_file(self, sandbox_id: str, remote_path: str, offset: int | None = None, length: int | None = None) -> str:
+        return self.read_file_bytes(sandbox_id, remote_path, offset, length).decode(errors="replace")
+
+    def list_files(self, sandbox_id: str, remote_path: str = "/") -> list[FileEntry]:
+        response = self._gateway_request(sandbox_id, "GET", "files/list", params={"path": remote_path})
+        return [FileEntry.model_validate(f) for f in response.json().get("files", [])]
+
+    # ---- egress + ports ----------------------------------------------------
+
+    def get_egress(self, sandbox_id: str) -> EgressPolicy:
+        return EgressPolicy.model_validate(self.api.get(f"/sandbox/{sandbox_id}/egress"))
+
+    def set_egress(self, sandbox_id: str, policy: EgressPolicy) -> EgressPolicy:
+        data = self.api.put(f"/sandbox/{sandbox_id}/egress", json=policy.model_dump(by_alias=True))
+        return EgressPolicy.model_validate(data)
+
+    def expose(self, sandbox_id: str, port: int, auth_required: bool = True) -> ExposedPort:
+        data = self.api.post(
+            f"/sandbox/{sandbox_id}/ports",
+            json={"port": port, "authRequired": auth_required},
+            idempotent_post=True,
+        )
+        return ExposedPort.model_validate(data)
+
+    def unexpose(self, sandbox_id: str, port: int) -> None:
+        self.api.delete(f"/sandbox/{sandbox_id}/ports/{port}")
+
+    def list_ports(self, sandbox_id: str) -> list[ExposedPort]:
+        data = self.api.get(f"/sandbox/{sandbox_id}/ports")
+        items = data.get("items", []) if isinstance(data, dict) else data
+        return [ExposedPort.model_validate(p) for p in items]
+
+    def close(self) -> None:
+        self._gateway.close()
+        self.api.close()
+
+    def __enter__(self) -> "SandboxClient":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+class AsyncSandboxClient:
+    """Async mirror of :class:`SandboxClient` (same policy, awaitable I/O)."""
+
+    def __init__(
+        self,
+        client: AsyncAPIClient | None = None,
+        auth_cache: AsyncSandboxAuthCache | None = None,
+        gateway_transport: httpx.AsyncBaseTransport | None = None,
+    ) -> None:
+        self.api = client or AsyncAPIClient()
+        self.auth_cache = auth_cache or AsyncSandboxAuthCache()
+        self._gateway = httpx.AsyncClient(
+            timeout=httpx.Timeout(DEFAULT_COMMAND_TIMEOUT_S + CLIENT_TIMEOUT_MARGIN_S, connect=10.0),
+            transport=gateway_transport,
+        )
+
+    # ---- control plane -----------------------------------------------------
+
+    async def create(self, request: CreateSandboxRequest, idempotency_key: str | None = None) -> Sandbox:
+        payload = _SandboxOps.create_payload(request, self.api.team_id)
+        headers = {"Idempotency-Key": idempotency_key or str(uuid.uuid4())}
+        data = await self.api.post("/sandbox", json=payload, headers=headers, idempotent_post=True)
+        return Sandbox.model_validate(data)
+
+    async def get(self, sandbox_id: str) -> Sandbox:
+        try:
+            return Sandbox.model_validate(await self.api.get(f"/sandbox/{sandbox_id}"))
+        except NotFoundError as e:
+            raise SandboxNotFoundError(str(e), sandbox_id) from e
+
+    async def list(self, labels: dict[str, str] | None = None, limit: int = 100, offset: int = 0) -> list[Sandbox]:
+        params: dict[str, Any] = {"limit": limit, "offset": offset}
+        if labels:
+            params["labels"] = ",".join(f"{k}={v}" for k, v in labels.items())
+        data = await self.api.get("/sandbox", params=params)
+        items = data.get("items", []) if isinstance(data, dict) else data
+        return [Sandbox.model_validate(s) for s in items]
+
+    async def list_all(self, labels: dict[str, str] | None = None, page_size: int = 100) -> list[Sandbox]:
+        """Walk every page of the list endpoint."""
+        out: list[Sandbox] = []
+        offset = 0
+        while True:
+            page = await self.list(labels=labels, limit=page_size, offset=offset)
+            out.extend(page)
+            if len(page) < page_size:
+                return out
+            offset += len(page)
+
+    async def delete(self, sandbox_id: str) -> None:
+        try:
+            await self.api.delete(f"/sandbox/{sandbox_id}")
+        except NotFoundError:
+            pass
+        self.auth_cache.invalidate(sandbox_id)
+
+    async def bulk_delete(self, sandbox_ids: list[str]) -> dict[str, Any]:
+        result = await self.api.post(
+            "/sandbox/bulk-delete", json={"sandboxIds": sandbox_ids}, idempotent_post=True
+        )
+        for sid in sandbox_ids:
+            self.auth_cache.invalidate(sid)
+        return result or {}
+
+    async def logs(self, sandbox_id: str) -> str:
+        data = await self.api.get(f"/sandbox/{sandbox_id}/logs")
+        return data.get("logs", "") if isinstance(data, dict) else str(data)
+
+    async def error_context(self, sandbox_id: str) -> dict[str, Any]:
+        try:
+            return (await self.api.get(f"/sandbox/{sandbox_id}/error-context")) or {}
+        except APIError:
+            return {}
+
+    async def _mint_auth(self, sandbox_id: str) -> SandboxAuth:
+        data = await self.api.post(f"/sandbox/{sandbox_id}/auth", idempotent_post=True)
+        return SandboxAuth.model_validate(data)
+
+    async def _auth(self, sandbox_id: str) -> SandboxAuth:
+        async def mint() -> SandboxAuth:
+            return await self._mint_auth(sandbox_id)
+
+        return await self.auth_cache.get_or_refresh(sandbox_id, mint)
+
+    # ---- lifecycle waiting -------------------------------------------------
+
+    async def wait_for_creation(
+        self,
+        sandbox_id: str,
+        max_attempts: int = WAIT_MAX_ATTEMPTS,
+        poll_interval_s: float = 1.0,
+    ) -> Sandbox:
+        import anyio
+
+        image_build_deadline: float | None = None
+        for _ in range(max_attempts):
+            sandbox = await self.get(sandbox_id)
+            if sandbox.status == SandboxStatus.RUNNING:
+                if await self._is_reachable(sandbox_id):
+                    return sandbox
+            elif sandbox.is_terminal:
+                raise classify_terminal_state(
+                    sandbox.status, await self.error_context(sandbox_id), sandbox_id
+                )
+            elif sandbox.pending_image_build_id:
+                if image_build_deadline is None:
+                    image_build_deadline = time.monotonic() + IMAGE_BUILD_BUDGET_S
+                while time.monotonic() < image_build_deadline:
+                    sandbox = await self.get(sandbox_id)
+                    if not sandbox.pending_image_build_id or sandbox.is_terminal:
+                        break
+                    await anyio.sleep(IMAGE_BUILD_POLL_S)
+            await anyio.sleep(poll_interval_s)
+        raise SandboxNotRunningError(
+            f"Sandbox {sandbox_id} not running after {max_attempts} attempts", sandbox_id
+        )
+
+    async def bulk_wait_for_creation(
+        self,
+        sandbox_ids: list[str],
+        max_attempts: int = WAIT_MAX_ATTEMPTS,
+        poll_interval_s: float = 2.0,
+    ) -> list[Sandbox]:
+        import anyio
+
+        pending = set(sandbox_ids)
+        done: dict[str, Sandbox] = {}
+        for _ in range(max_attempts):
+            listed = {s.sandbox_id: s for s in await self.list_all()}
+            for sid in list(pending):
+                sandbox = listed.get(sid)
+                if sandbox is None:
+                    sandbox = await self.get(sid)
+                if sandbox.status == SandboxStatus.RUNNING:
+                    done[sid] = sandbox
+                    pending.discard(sid)
+                elif sandbox.is_terminal:
+                    raise classify_terminal_state(sandbox.status, await self.error_context(sid), sid)
+            if not pending:
+                return [done[sid] for sid in sandbox_ids]
+            await anyio.sleep(poll_interval_s)
+        raise SandboxNotRunningError(
+            f"{len(pending)} of {len(sandbox_ids)} sandboxes not running "
+            f"after {max_attempts} attempts: {sorted(pending)[:5]}"
+        )
+
+    async def _is_reachable(self, sandbox_id: str) -> bool:
+        try:
+            return (await self.execute_command(sandbox_id, "echo ready", timeout_s=10.0)).ok
+        except (SandboxNotRunningError, SandboxNotFoundError, APIError, CommandTimeoutError):
+            return False
+
+    # ---- gateway data plane ------------------------------------------------
+
+    async def _gateway_request(
+        self,
+        sandbox_id: str,
+        method: str,
+        subpath: str,
+        *,
+        json: Any = None,
+        content: bytes | None = None,
+        params: dict[str, Any] | None = None,
+        timeout_s: float | None = None,
+        idempotent: bool = True,
+    ) -> httpx.Response:
+        import anyio
+
+        auth = await self._auth(sandbox_id)
+        reauthed = False
+        conflicts = 0
+        attempt = 0
+        while True:
+            try:
+                response = await self._gateway.request(
+                    method,
+                    _SandboxOps.gateway_url(auth, subpath),
+                    json=json,
+                    content=content,
+                    params=params,
+                    headers=_SandboxOps.gateway_headers(auth),
+                    timeout=(timeout_s + CLIENT_TIMEOUT_MARGIN_S) if timeout_s else httpx.USE_CLIENT_DEFAULT,
+                )
+            except httpx.TimeoutException as e:
+                raise CommandTimeoutError(
+                    f"Gateway {method} {subpath} timed out for sandbox {sandbox_id}",
+                    sandbox_id,
+                    timeout_s,
+                ) from e
+            except httpx.TransportError as e:
+                if idempotent and attempt < GATEWAY_MAX_ATTEMPTS - 1:
+                    attempt += 1
+                    await anyio.sleep(_backoff(attempt))
+                    continue
+                raise APIConnectionError(
+                    f"Could not reach gateway for sandbox {sandbox_id}: {e}"
+                ) from e
+
+            if response.status_code < 400:
+                return response
+            if _SandboxOps.is_sandbox_not_found(response):
+                self.auth_cache.invalidate(sandbox_id)
+                raise SandboxNotFoundError(f"Sandbox {sandbox_id} no longer exists", sandbox_id)
+            if response.status_code == 401 and not reauthed:
+                reauthed = True
+                self.auth_cache.invalidate(sandbox_id)
+                auth = await self._auth(sandbox_id)
+                continue
+            if response.status_code == 409 and conflicts < CONFLICT_MAX_ATTEMPTS:
+                ctx = await self.error_context(sandbox_id)
+                if ctx.get("terminal"):
+                    raise classify_terminal_state(ctx.get("status", "ERROR"), ctx, sandbox_id)
+                conflicts += 1
+                await anyio.sleep(CONFLICT_BACKOFF_S * (2 ** (conflicts - 1)))
+                continue
+            if (
+                response.status_code in GATEWAY_RETRYABLE_STATUS
+                and idempotent
+                and attempt < GATEWAY_MAX_ATTEMPTS - 1
+            ):
+                attempt += 1
+                await anyio.sleep(_backoff(attempt))
+                continue
+            raise APIError(
+                f"Gateway {method} {subpath} failed for sandbox {sandbox_id}: "
+                f"{response.status_code} {response.text[:200]}",
+                status_code=response.status_code,
+            )
+
+    async def execute_command(
+        self,
+        sandbox_id: str,
+        command: str,
+        timeout_s: float = DEFAULT_COMMAND_TIMEOUT_S,
+        env: dict[str, str] | None = None,
+    ) -> CommandResult:
+        auth = await self._auth(sandbox_id)
+        if auth.is_vm:
+            return await self._execute_streaming(sandbox_id, command, timeout_s, env)
+        response = await self._gateway_request(
+            sandbox_id,
+            "POST",
+            "exec",
+            json=_SandboxOps.exec_payload(command, timeout_s, env),
+            timeout_s=timeout_s,
+            idempotent=False,
+        )
+        return _SandboxOps.parse_exec(response.json())
+
+    async def _execute_streaming(
+        self,
+        sandbox_id: str,
+        command: str,
+        timeout_s: float,
+        env: dict[str, str] | None,
+    ) -> CommandResult:
+        """See the sync variant: same gateway state machine, awaitable I/O."""
+        import json as jsonlib
+
+        import anyio
+
+        reauthed = False
+        conflicts = 0
+        while True:
+            auth = await self._auth(sandbox_id)
+            stdout: list[str] = []
+            stderr: list[str] = []
+            exit_code = 0
+            try:
+                async with self._gateway.stream(
+                    "POST",
+                    _SandboxOps.gateway_url(auth, "exec/stream"),
+                    json=_SandboxOps.exec_payload(command, timeout_s, env),
+                    headers=_SandboxOps.gateway_headers(auth),
+                    timeout=timeout_s + CLIENT_TIMEOUT_MARGIN_S,
+                ) as response:
+                    if response.status_code >= 400:
+                        await response.aread()
+                        if _SandboxOps.is_sandbox_not_found(response):
+                            self.auth_cache.invalidate(sandbox_id)
+                            raise SandboxNotFoundError(f"Sandbox {sandbox_id} no longer exists", sandbox_id)
+                        if response.status_code == 401 and not reauthed:
+                            reauthed = True
+                            self.auth_cache.invalidate(sandbox_id)
+                            continue
+                        if response.status_code == 409 and conflicts < CONFLICT_MAX_ATTEMPTS:
+                            ctx = await self.error_context(sandbox_id)
+                            if ctx.get("terminal"):
+                                raise classify_terminal_state(ctx.get("status", "ERROR"), ctx, sandbox_id)
+                            conflicts += 1
+                            await anyio.sleep(CONFLICT_BACKOFF_S * (2 ** (conflicts - 1)))
+                            continue
+                        raise APIError(
+                            f"Streaming exec failed: {response.status_code}",
+                            status_code=response.status_code,
+                        )
+                    async for line in response.aiter_lines():
+                        if not line.strip():
+                            continue
+                        event = jsonlib.loads(line)
+                        kind = event.get("type")
+                        if kind == "stdout":
+                            stdout.append(event.get("data", ""))
+                        elif kind == "stderr":
+                            stderr.append(event.get("data", ""))
+                        elif kind == "exit":
+                            exit_code = int(event.get("code", 0))
+            except httpx.TimeoutException as e:
+                raise CommandTimeoutError(
+                    f"Streaming exec timed out for sandbox {sandbox_id}", sandbox_id, timeout_s
+                ) from e
+            except httpx.TransportError as e:
+                raise APIConnectionError(
+                    f"Could not reach gateway for sandbox {sandbox_id}: {e}"
+                ) from e
+            return CommandResult(stdout="".join(stdout), stderr="".join(stderr), exit_code=exit_code)
+
+    # ---- background jobs ---------------------------------------------------
+
+    async def start_background_job(self, sandbox_id: str, name: str, command: str) -> BackgroundJob:
+        result = await self.execute_command(sandbox_id, _SandboxOps.job_start_command(name, command))
+        pid = int(result.stdout.strip()) if result.stdout.strip().isdigit() else None
+        return BackgroundJob(job_name=name, sandbox_id=sandbox_id, pid=pid, running=True)
+
+    async def get_background_job(self, sandbox_id: str, name: str) -> BackgroundJob:
+        status = await self.execute_command(sandbox_id, _SandboxOps.job_status_command(name))
+        out = await self.execute_command(sandbox_id, _SandboxOps.job_tail_command(name, "out"))
+        err = await self.execute_command(sandbox_id, _SandboxOps.job_tail_command(name, "err"))
+        return _SandboxOps.parse_job_status(name, sandbox_id, status.stdout, out.stdout, err.stdout)
+
+    async def kill_background_job(self, sandbox_id: str, name: str) -> None:
+        await self.execute_command(sandbox_id, _SandboxOps.job_kill_command(name))
+
+    async def wait_for_background_job(
+        self, sandbox_id: str, name: str, timeout_s: float = 3600.0, poll_interval_s: float = 2.0
+    ) -> BackgroundJob:
+        import anyio
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            job = await self.get_background_job(sandbox_id, name)
+            if not job.running:
+                return job
+            await anyio.sleep(poll_interval_s)
+        raise CommandTimeoutError(
+            f"Background job {name} still running after {timeout_s}s", sandbox_id, timeout_s
+        )
+
+    # ---- files -------------------------------------------------------------
+
+    async def upload_file(self, sandbox_id: str, local_path: str | Path, remote_path: str) -> None:
+        import aiofiles
+
+        async with aiofiles.open(local_path, "rb") as f:
+            data = await f.read()
+        await self.write_file(sandbox_id, remote_path, data)
+
+    async def write_file(self, sandbox_id: str, remote_path: str, data: bytes) -> None:
+        response = await self._gateway_request(
+            sandbox_id, "PUT", "files", content=data, params={"path": remote_path}, idempotent=True
+        )
+        if response.status_code >= 300:
+            raise FileOperationError(f"Upload to {remote_path} failed: {response.status_code}", sandbox_id)
+
+    async def download_file(self, sandbox_id: str, remote_path: str, local_path: str | Path) -> None:
+        import aiofiles
+
+        data = await self.read_file_bytes(sandbox_id, remote_path)
+        async with aiofiles.open(local_path, "wb") as f:
+            await f.write(data)
+
+    async def read_file_bytes(
+        self, sandbox_id: str, remote_path: str, offset: int | None = None, length: int | None = None
+    ) -> bytes:
+        params: dict[str, Any] = {"path": remote_path}
+        if offset is not None:
+            params["offset"] = offset
+        if length is not None:
+            params["length"] = length
+        response = await self._gateway_request(sandbox_id, "GET", "files", params=params)
+        return response.content
+
+    async def read_file(
+        self, sandbox_id: str, remote_path: str, offset: int | None = None, length: int | None = None
+    ) -> str:
+        return (await self.read_file_bytes(sandbox_id, remote_path, offset, length)).decode(errors="replace")
+
+    async def list_files(self, sandbox_id: str, remote_path: str = "/") -> list[FileEntry]:
+        response = await self._gateway_request(sandbox_id, "GET", "files/list", params={"path": remote_path})
+        return [FileEntry.model_validate(f) for f in response.json().get("files", [])]
+
+    # ---- egress + ports ----------------------------------------------------
+
+    async def get_egress(self, sandbox_id: str) -> EgressPolicy:
+        return EgressPolicy.model_validate(await self.api.get(f"/sandbox/{sandbox_id}/egress"))
+
+    async def set_egress(self, sandbox_id: str, policy: EgressPolicy) -> EgressPolicy:
+        data = await self.api.put(f"/sandbox/{sandbox_id}/egress", json=policy.model_dump(by_alias=True))
+        return EgressPolicy.model_validate(data)
+
+    async def expose(self, sandbox_id: str, port: int, auth_required: bool = True) -> ExposedPort:
+        data = await self.api.post(
+            f"/sandbox/{sandbox_id}/ports",
+            json={"port": port, "authRequired": auth_required},
+            idempotent_post=True,
+        )
+        return ExposedPort.model_validate(data)
+
+    async def unexpose(self, sandbox_id: str, port: int) -> None:
+        await self.api.delete(f"/sandbox/{sandbox_id}/ports/{port}")
+
+    async def list_ports(self, sandbox_id: str) -> list[ExposedPort]:
+        data = await self.api.get(f"/sandbox/{sandbox_id}/ports")
+        items = data.get("items", []) if isinstance(data, dict) else data
+        return [ExposedPort.model_validate(p) for p in items]
+
+    async def close(self) -> None:
+        await self._gateway.aclose()
+        await self.api.close()
+
+    async def __aenter__(self) -> "AsyncSandboxClient":
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.close()

@@ -1,0 +1,73 @@
+"""Sandbox error taxonomy (reference: prime_sandboxes/exceptions.py:1-89).
+
+Terminal sandbox states map to typed exceptions so callers can branch on the
+*cause* (OOM vs image pull vs timeout) instead of string-matching. The cause
+is resolved via the control plane's ``/sandbox/{id}/error-context`` endpoint
+(reference sandbox.py:251-281).
+"""
+
+from __future__ import annotations
+
+
+class SandboxError(Exception):
+    """Base class for sandbox SDK errors."""
+
+    def __init__(self, message: str, sandbox_id: str | None = None) -> None:
+        super().__init__(message)
+        self.message = message
+        self.sandbox_id = sandbox_id
+
+
+class SandboxNotRunningError(SandboxError):
+    """The sandbox is in a terminal or not-yet-running state."""
+
+    def __init__(self, message: str, sandbox_id: str | None = None, status: str | None = None) -> None:
+        super().__init__(message, sandbox_id)
+        self.status = status
+
+
+class SandboxOOMError(SandboxNotRunningError):
+    """Terminated by the out-of-memory killer."""
+
+
+class SandboxTimeoutError(SandboxNotRunningError):
+    """Hit its lifetime timeout and was reaped."""
+
+
+class SandboxImagePullError(SandboxNotRunningError):
+    """The container/VM image could not be pulled."""
+
+
+class SandboxNotFoundError(SandboxError):
+    """The sandbox no longer exists (control plane 404, or gateway 502 with a
+    ``sandbox_not_found`` body — reference sandbox.py:244)."""
+
+
+class CommandTimeoutError(SandboxError):
+    """A command exceeded its execution timeout."""
+
+    def __init__(self, message: str, sandbox_id: str | None = None, timeout_s: float | None = None) -> None:
+        super().__init__(message, sandbox_id)
+        self.timeout_s = timeout_s
+
+
+class FileOperationError(SandboxError):
+    """Upload/download/read failed."""
+
+
+def classify_terminal_state(
+    status: str, error_context: dict | None, sandbox_id: str
+) -> SandboxNotRunningError:
+    """Build the most specific terminal-state exception available."""
+    reason = (error_context or {}).get("reason", "")
+    detail = (error_context or {}).get("detail", "")
+    base = f"Sandbox {sandbox_id} is {status}"
+    if detail:
+        base += f": {detail}"
+    if reason == "oom":
+        return SandboxOOMError(base, sandbox_id, status)
+    if reason == "timeout":
+        return SandboxTimeoutError(base, sandbox_id, status)
+    if reason == "image_pull":
+        return SandboxImagePullError(base, sandbox_id, status)
+    return SandboxNotRunningError(base, sandbox_id, status)

@@ -1,0 +1,170 @@
+"""Sandbox pydantic model zoo (reference: prime_sandboxes/models.py:124-637).
+
+TPU-native deltas vs the reference:
+- ``docker_image`` defaults to the JAX/libtpu-preloaded image — a fresh
+  sandbox can `import jax` and see its TPU immediately;
+- ``tpu_type`` attaches a TPU slice (``v5e-1`` … ``v5e-8``) to the sandbox;
+  ``None`` means CPU-only;
+- ``is_vm`` marks TPU-VM sandboxes (whole TPU VM, streaming exec transport)
+  vs container sandboxes (REST exec) — the reference's VM/container split.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Literal
+
+from pydantic import BaseModel, ConfigDict, Field, field_validator
+
+DEFAULT_TPU_IMAGE = "primetpu/jax-tpu:latest"
+DEFAULT_CPU_IMAGE = "primetpu/python:3.12-slim"
+
+_HOST_RE = re.compile(r"^\*?[A-Za-z0-9.\-]+(:\d+)?$")
+
+
+class SandboxStatus:
+    PENDING = "PENDING"
+    PROVISIONING = "PROVISIONING"
+    RUNNING = "RUNNING"
+    STOPPED = "STOPPED"
+    ERROR = "ERROR"
+    TERMINATED = "TERMINATED"
+    TIMEOUT = "TIMEOUT"
+
+    TERMINAL = {STOPPED, ERROR, TERMINATED, TIMEOUT}
+
+
+class EgressPolicy(BaseModel):
+    """Network egress allow/deny lists (reference models.py:77 validator)."""
+
+    model_config = ConfigDict(populate_by_name=True)
+
+    default_action: Literal["allow", "deny"] = Field(default="allow", alias="defaultAction")
+    allow_hosts: list[str] = Field(default_factory=list, alias="allowHosts")
+    deny_hosts: list[str] = Field(default_factory=list, alias="denyHosts")
+
+    @field_validator("allow_hosts", "deny_hosts")
+    @classmethod
+    def validate_hosts(cls, hosts: list[str]) -> list[str]:
+        for host in hosts:
+            if not _HOST_RE.match(host):
+                raise ValueError(
+                    f"Invalid host pattern {host!r}: expected hostname[:port], optionally "
+                    "with a leading '*' wildcard label (e.g. *.googleapis.com)"
+                )
+        return hosts
+
+
+class CreateSandboxRequest(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    name: str | None = None
+    docker_image: str = Field(default=DEFAULT_TPU_IMAGE, alias="dockerImage")
+    tpu_type: str | None = Field(default=None, alias="tpuType")  # e.g. "v5e-1"
+    is_vm: bool = Field(default=False, alias="isVm")             # TPU VM sandbox
+    cpu_cores: int = Field(default=2, alias="cpuCores")
+    memory_gib: int = Field(default=4, alias="memoryGib")
+    disk_gib: int = Field(default=20, alias="diskGib")
+    timeout_minutes: int = Field(default=60, alias="timeoutMinutes")
+    env_vars: dict[str, str] = Field(default_factory=dict, alias="envVars")
+    start_command: str | None = Field(default=None, alias="startCommand")
+    egress: EgressPolicy | None = None
+    team_id: str | None = Field(default=None, alias="teamId")
+    labels: dict[str, str] = Field(default_factory=dict)
+
+    @field_validator("tpu_type")
+    @classmethod
+    def validate_tpu_type(cls, v: str | None) -> str | None:
+        if v is None:
+            return None
+        from prime_tpu.parallel.topology import parse_slice
+
+        spec = parse_slice(v)  # raises ValueError with an actionable message
+        if spec.multi_host:
+            raise ValueError(
+                f"Sandbox TPU slices must be single-host ({v} spans {spec.hosts} hosts); "
+                "use `prime pods create` for multi-host slices"
+            )
+        return spec.name
+
+
+class Sandbox(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    sandbox_id: str = Field(alias="sandboxId")
+    name: str | None = None
+    status: str
+    docker_image: str = Field(alias="dockerImage")
+    tpu_type: str | None = Field(default=None, alias="tpuType")
+    is_vm: bool = Field(default=False, alias="isVm")
+    user_namespace: str | None = Field(default=None, alias="userNamespace")
+    job_id: str | None = Field(default=None, alias="jobId")
+    gateway_url: str | None = Field(default=None, alias="gatewayUrl")
+    created_at: str | None = Field(default=None, alias="createdAt")
+    timeout_minutes: int = Field(default=60, alias="timeoutMinutes")
+    team_id: str | None = Field(default=None, alias="teamId")
+    pending_image_build_id: str | None = Field(default=None, alias="pendingImageBuildId")
+    labels: dict[str, str] = Field(default_factory=dict)
+
+    @property
+    def is_terminal(self) -> bool:
+        return self.status in SandboxStatus.TERMINAL
+
+
+class CommandResult(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    stdout: str = ""
+    stderr: str = ""
+    exit_code: int = Field(default=0, alias="exitCode")
+
+    @property
+    def ok(self) -> bool:
+        return self.exit_code == 0
+
+
+class BackgroundJob(BaseModel):
+    """A long-running command detached from HTTP (reference models.py:618).
+
+    Implemented gateway-side as ``nohup sh -c '(cmd) >out 2>err; echo $? >exit'``
+    with windowed tail reads (reference sandbox.py:1030-1192).
+    """
+
+    model_config = ConfigDict(populate_by_name=True)
+
+    job_name: str = Field(alias="jobName")
+    sandbox_id: str = Field(alias="sandboxId")
+    pid: int | None = None
+    running: bool = True
+    exit_code: int | None = Field(default=None, alias="exitCode")
+    stdout_tail: str = Field(default="", alias="stdoutTail")
+    stderr_tail: str = Field(default="", alias="stderrTail")
+
+
+class ExposedPort(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    port: int
+    url: str
+    auth_required: bool = Field(default=True, alias="authRequired")
+
+
+class SandboxAuth(BaseModel):
+    """Short-lived gateway bearer token (control plane POST /sandbox/{id}/auth)."""
+
+    model_config = ConfigDict(populate_by_name=True)
+
+    token: str
+    expires_at: float = Field(alias="expiresAt")  # unix seconds
+    gateway_url: str = Field(alias="gatewayUrl")
+    user_namespace: str = Field(alias="userNamespace")
+    job_id: str = Field(alias="jobId")
+    is_vm: bool = Field(default=False, alias="isVm")
+
+
+class FileEntry(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    path: str
+    size: int = 0
+    is_dir: bool = Field(default=False, alias="isDir")

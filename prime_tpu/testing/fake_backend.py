@@ -66,6 +66,9 @@ class FakeControlPlane:
         self._routes: list[tuple[str, re.Pattern[str], Callable[..., httpx.Response]]] = []
         self._register_routes()
         self._mounts: list[Callable[[httpx.Request], httpx.Response | None]] = []
+        from prime_tpu.testing.fake_sandbox_plane import FakeSandboxPlane
+
+        self.sandbox_plane = FakeSandboxPlane(self)
 
     # -- catalog seeding -----------------------------------------------------
 

@@ -1,0 +1,339 @@
+"""Fake sandbox control plane + gateway data plane.
+
+Registers `/sandbox*` control-plane routes on a :class:`FakeControlPlane` and
+mounts a **gateway** host (``https://gw.fake``) that really executes commands
+via a local bash subprocess rooted in a per-sandbox temp dir — so background
+jobs (nohup + exit files), windowed file reads, and exec semantics are tested
+against real shell behavior, not canned strings.
+
+Fault-injection knobs (for pinning the retry/auth state machine):
+- ``gateway_faults``: list of status codes served (and consumed) before real
+  handling — e.g. ``[503, 503]`` exercises the 5xx retry tier;
+- ``expire_tokens()``: invalidates all minted tokens → next gateway call 401s
+  and must re-auth exactly once;
+- ``busy_conflicts[sandbox_id]``: number of 409s to serve before succeeding.
+"""
+
+from __future__ import annotations
+
+import json as jsonlib
+import subprocess
+import tempfile
+import time
+import uuid
+from pathlib import Path
+from typing import Any
+
+import httpx
+
+from prime_tpu.testing.fake_backend import FakeControlPlane, _json_response
+
+GATEWAY_HOST = "gw.fake"
+TOKEN_TTL_S = 900.0
+
+
+class FakeSandboxPlane:
+    def __init__(self, fake: FakeControlPlane, ready_after_polls: int = 1) -> None:
+        self.fake = fake
+        self.ready_after_polls = ready_after_polls
+        # Where minted tokens point the data plane. The in-process transport
+        # uses the sentinel host; LiveControlPlane rewrites this to its own
+        # http://127.0.0.1:<port> so real-socket clients can reach the gateway.
+        self.gateway_base_url = f"https://{GATEWAY_HOST}"
+        self.sandboxes: dict[str, dict[str, Any]] = {}
+        self.roots: dict[str, Path] = {}
+        self._polls: dict[str, int] = {}
+        self.tokens: dict[str, dict[str, Any]] = {}  # token -> {sandbox_id, expires_at}
+        self.idempotency: dict[str, str] = {}        # key -> sandbox_id
+        self.error_contexts: dict[str, dict[str, Any]] = {}
+        self.egress: dict[str, dict[str, Any]] = {}
+        self.ports: dict[str, list[dict[str, Any]]] = {}
+        self.gateway_faults: list[int] = []
+        self.busy_conflicts: dict[str, int] = {}
+        self.auth_mints = 0
+        self._register_control_routes()
+        fake.mount(self._handle_gateway)
+
+    # -- helpers -------------------------------------------------------------
+
+    def expire_tokens(self) -> None:
+        for tok in self.tokens.values():
+            tok["expires_at"] = 0.0
+
+    def make_running(self, sandbox_id: str) -> None:
+        self.sandboxes[sandbox_id]["status"] = "RUNNING"
+
+    def fail_sandbox(self, sandbox_id: str, reason: str = "oom", detail: str = "killed") -> None:
+        self.sandboxes[sandbox_id]["status"] = "ERROR"
+        self.error_contexts[sandbox_id] = {"reason": reason, "detail": detail, "terminal": True}
+
+    def _advance(self, sandbox_id: str) -> None:
+        sb = self.sandboxes[sandbox_id]
+        if sb["status"] in ("RUNNING", "ERROR", "TERMINATED", "TIMEOUT", "STOPPED"):
+            return
+        self._polls[sandbox_id] = self._polls.get(sandbox_id, 0) + 1
+        if self._polls[sandbox_id] >= self.ready_after_polls:
+            sb["status"] = "RUNNING"
+
+    def _root(self, sandbox_id: str) -> Path:
+        root = self.roots.get(sandbox_id)
+        if root is None:
+            root = Path(tempfile.mkdtemp(prefix=f"fakesb-{sandbox_id[-6:]}-"))
+            self.roots[sandbox_id] = root
+        return root
+
+    # -- control-plane routes ------------------------------------------------
+
+    def _register_control_routes(self) -> None:
+        route = self.fake.route
+        plane = self
+
+        @route("POST", r"/sandbox/bulk-delete")
+        def bulk_delete(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            deleted, missing = [], []
+            for sid in body.get("sandboxIds", []):
+                if sid in plane.sandboxes:
+                    plane.sandboxes[sid]["status"] = "TERMINATED"
+                    deleted.append(sid)
+                else:
+                    missing.append(sid)
+            return _json_response(200, {"deleted": deleted, "missing": missing})
+
+        @route("POST", r"/sandbox/(?P<sid>[^/]+)/auth")
+        def mint_auth(request: httpx.Request, sid: str) -> httpx.Response:
+            sb = plane.sandboxes.get(sid)
+            if not sb:
+                return _json_response(404, {"detail": f"sandbox {sid} not found"})
+            plane.auth_mints += 1
+            token = f"gwtok_{uuid.uuid4().hex}"
+            plane.tokens[token] = {"sandbox_id": sid, "expires_at": time.time() + TOKEN_TTL_S}
+            return _json_response(
+                200,
+                {
+                    "token": token,
+                    "expiresAt": plane.tokens[token]["expires_at"],
+                    "gatewayUrl": plane.gateway_base_url,
+                    "userNamespace": sb["userNamespace"],
+                    "jobId": sb["jobId"],
+                    "isVm": sb["isVm"],
+                },
+            )
+
+        @route("GET", r"/sandbox/(?P<sid>[^/]+)/logs")
+        def logs(request: httpx.Request, sid: str) -> httpx.Response:
+            if sid not in plane.sandboxes:
+                return _json_response(404, {"detail": "not found"})
+            return _json_response(200, {"logs": f"[fake] sandbox {sid} started\n"})
+
+        @route("GET", r"/sandbox/(?P<sid>[^/]+)/error-context")
+        def error_context(request: httpx.Request, sid: str) -> httpx.Response:
+            return _json_response(200, plane.error_contexts.get(sid, {}))
+
+        @route("GET", r"/sandbox/(?P<sid>[^/]+)/egress")
+        def get_egress(request: httpx.Request, sid: str) -> httpx.Response:
+            return _json_response(
+                200, plane.egress.get(sid, {"defaultAction": "allow", "allowHosts": [], "denyHosts": []})
+            )
+
+        @route("PUT", r"/sandbox/(?P<sid>[^/]+)/egress")
+        def set_egress(request: httpx.Request, sid: str) -> httpx.Response:
+            plane.egress[sid] = plane.fake._body(request)
+            return _json_response(200, plane.egress[sid])
+
+        @route("POST", r"/sandbox/(?P<sid>[^/]+)/ports")
+        def expose_port(request: httpx.Request, sid: str) -> httpx.Response:
+            body = plane.fake._body(request)
+            entry = {
+                "port": body["port"],
+                "url": f"https://{sid}-{body['port']}.ports.fake",
+                "authRequired": body.get("authRequired", True),
+            }
+            plane.ports.setdefault(sid, [])
+            plane.ports[sid] = [p for p in plane.ports[sid] if p["port"] != body["port"]] + [entry]
+            return _json_response(200, entry)
+
+        @route("DELETE", r"/sandbox/(?P<sid>[^/]+)/ports/(?P<port>\d+)")
+        def unexpose_port(request: httpx.Request, sid: str, port: str) -> httpx.Response:
+            plane.ports[sid] = [p for p in plane.ports.get(sid, []) if p["port"] != int(port)]
+            return httpx.Response(204)
+
+        @route("GET", r"/sandbox/(?P<sid>[^/]+)/ports")
+        def list_ports(request: httpx.Request, sid: str) -> httpx.Response:
+            return _json_response(200, {"items": plane.ports.get(sid, [])})
+
+        @route("POST", r"/sandbox")
+        def create_sandbox(request: httpx.Request) -> httpx.Response:
+            idem = request.headers.get("Idempotency-Key")
+            if idem and idem in plane.idempotency:
+                return _json_response(200, plane.sandboxes[plane.idempotency[idem]])
+            body = plane.fake._body(request)
+            sid = f"sbx_{uuid.uuid4().hex[:8]}"
+            sb = {
+                "sandboxId": sid,
+                "name": body.get("name") or sid,
+                "status": "PENDING",
+                "dockerImage": body.get("dockerImage", "primetpu/jax-tpu:latest"),
+                "tpuType": body.get("tpuType"),
+                "isVm": bool(body.get("isVm", False)),
+                "userNamespace": "ns-user1",
+                "jobId": f"job-{sid}",
+                "gatewayUrl": f"https://{GATEWAY_HOST}",
+                "createdAt": "2026-07-28T00:00:00Z",
+                "timeoutMinutes": body.get("timeoutMinutes", 60),
+                "teamId": body.get("teamId"),
+                "pendingImageBuildId": None,
+                "labels": body.get("labels", {}),
+            }
+            plane.sandboxes[sid] = sb
+            if idem:
+                plane.idempotency[idem] = sid
+            return _json_response(200, sb)
+
+        @route("GET", r"/sandbox/(?P<sid>[^/]+)")
+        def get_sandbox(request: httpx.Request, sid: str) -> httpx.Response:
+            sb = plane.sandboxes.get(sid)
+            if not sb:
+                return _json_response(404, {"detail": f"sandbox {sid} not found"})
+            plane._advance(sid)
+            return _json_response(200, sb)
+
+        @route("GET", r"/sandbox")
+        def list_sandboxes(request: httpx.Request) -> httpx.Response:
+            for sid in list(plane.sandboxes):
+                plane._advance(sid)
+            rows = [s for s in plane.sandboxes.values() if s["status"] != "TERMINATED"]
+            labels_param = request.url.params.get("labels")
+            if labels_param:
+                want = dict(kv.split("=", 1) for kv in labels_param.split(","))
+                rows = [s for s in rows if all(s.get("labels", {}).get(k) == v for k, v in want.items())]
+            return plane.fake._paginate(request, rows)
+
+        @route("DELETE", r"/sandbox/(?P<sid>[^/]+)")
+        def delete_sandbox(request: httpx.Request, sid: str) -> httpx.Response:
+            sb = plane.sandboxes.get(sid)
+            if not sb:
+                return _json_response(404, {"detail": f"sandbox {sid} not found"})
+            sb["status"] = "TERMINATED"
+            return httpx.Response(204)
+
+    # -- gateway data plane --------------------------------------------------
+
+    def _check_token(self, request: httpx.Request) -> tuple[str, httpx.Response | None]:
+        auth = request.headers.get("Authorization", "")
+        token = auth.removeprefix("Bearer ")
+        entry = self.tokens.get(token)
+        if not entry or entry["expires_at"] <= time.time():
+            return "", _json_response(401, {"detail": "token expired"})
+        return entry["sandbox_id"], None
+
+    def _handle_gateway(self, request: httpx.Request) -> httpx.Response | None:
+        if request.url.host != GATEWAY_HOST:
+            # Over a live socket the gateway shares the control plane's
+            # host:port — recognize gateway traffic by its /{ns}/{job}/ path.
+            first_segment = request.url.path.lstrip("/").split("/", 1)[0]
+            namespaces = {sb["userNamespace"] for sb in self.sandboxes.values()}
+            if first_segment not in namespaces:
+                return None
+        if self.gateway_faults:
+            status = self.gateway_faults.pop(0)
+            return _json_response(status, {"detail": f"injected fault {status}"})
+        sid, err = self._check_token(request)
+        if err is not None:
+            return err
+        sb = self.sandboxes.get(sid)
+        if not sb or sb["status"] in ("TERMINATED", "ERROR", "TIMEOUT"):
+            return httpx.Response(502, text='{"error": "sandbox_not_found"}')
+        if self.busy_conflicts.get(sid, 0) > 0:
+            self.busy_conflicts[sid] -= 1
+            return _json_response(409, {"detail": "sandbox busy"})
+
+        # path: /{ns}/{job_id}/<op...>
+        parts = request.url.path.lstrip("/").split("/")
+        if len(parts) < 3 or parts[0] != sb["userNamespace"] or parts[1] != sb["jobId"]:
+            return _json_response(404, {"detail": "bad gateway path"})
+        op = "/".join(parts[2:])
+
+        if op == "exec" and request.method == "POST":
+            return self._exec(sid, request, stream=False)
+        if op == "exec/stream" and request.method == "POST":
+            return self._exec(sid, request, stream=True)
+        if op == "files" and request.method == "PUT":
+            return self._put_file(sid, request)
+        if op == "files" and request.method == "GET":
+            return self._get_file(sid, request)
+        if op == "files/list" and request.method == "GET":
+            return self._list_files(sid, request)
+        return _json_response(404, {"detail": f"unknown gateway op {op}"})
+
+    def _exec(self, sid: str, request: httpx.Request, stream: bool) -> httpx.Response:
+        body = jsonlib.loads(request.content.decode())
+        command = body.get("command", "")
+        timeout_s = float(body.get("timeoutS", 300))
+        env = body.get("env") or {}
+        root = self._root(sid)
+        try:
+            proc = subprocess.run(
+                ["bash", "-c", command],
+                capture_output=True,
+                text=True,
+                timeout=min(timeout_s, 60.0),
+                cwd=str(root),
+                env={"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": str(root), **env},
+            )
+            stdout, stderr, code = proc.stdout, proc.stderr, proc.returncode
+        except subprocess.TimeoutExpired as e:
+            stdout = e.stdout or "" if isinstance(e.stdout, str) else ""
+            stderr = (e.stderr or "" if isinstance(e.stderr, str) else "") + "\n[timeout]"
+            code = 124
+        if not stream:
+            return _json_response(200, {"stdout": stdout, "stderr": stderr, "exitCode": code})
+        lines = []
+        if stdout:
+            lines.append(jsonlib.dumps({"type": "stdout", "data": stdout}))
+        if stderr:
+            lines.append(jsonlib.dumps({"type": "stderr", "data": stderr}))
+        lines.append(jsonlib.dumps({"type": "exit", "code": code}))
+        return httpx.Response(200, text="\n".join(lines) + "\n")
+
+    def _resolve_path(self, sid: str, path: str) -> Path | None:
+        root = self._root(sid)
+        target = (root / path.lstrip("/")).resolve()
+        if not str(target).startswith(str(root.resolve())):
+            return None
+        return target
+
+    def _put_file(self, sid: str, request: httpx.Request) -> httpx.Response:
+        path = request.url.params.get("path", "")
+        target = self._resolve_path(sid, path)
+        if target is None:
+            return _json_response(400, {"detail": "path escapes sandbox root"})
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_bytes(request.content)
+        return _json_response(200, {"ok": True, "size": len(request.content)})
+
+    def _get_file(self, sid: str, request: httpx.Request) -> httpx.Response:
+        params = request.url.params
+        target = self._resolve_path(sid, params.get("path", ""))
+        if target is None or not target.exists():
+            return _json_response(404, {"detail": "file not found"})
+        data = target.read_bytes()
+        offset = int(params.get("offset", 0))
+        length = params.get("length")
+        window = data[offset : offset + int(length)] if length is not None else data[offset:]
+        return httpx.Response(200, content=window, headers={"Content-Type": "application/octet-stream"})
+
+    def _list_files(self, sid: str, request: httpx.Request) -> httpx.Response:
+        target = self._resolve_path(sid, request.url.params.get("path", "/"))
+        if target is None or not target.exists():
+            return _json_response(200, {"files": []})
+        root = self._root(sid)
+        files = [
+            {
+                "path": "/" + str(p.relative_to(root)),
+                "size": p.stat().st_size if p.is_file() else 0,
+                "isDir": p.is_dir(),
+            }
+            for p in sorted(target.iterdir())
+        ]
+        return _json_response(200, {"files": files})

@@ -58,6 +58,8 @@ class LiveControlPlane:
         handler = type("BoundHandler", (_Handler,), {"fake": self.fake})
         self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self._thread: threading.Thread | None = None
+        # gateway tokens must point at this server, not the in-process sentinel
+        self.fake.sandbox_plane.gateway_base_url = self.url
 
     @property
     def port(self) -> int:

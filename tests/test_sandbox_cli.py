@@ -1,0 +1,91 @@
+"""`prime sandbox` CLI against the fake two-plane backend."""
+
+import json
+
+import pytest
+from click.testing import CliRunner
+
+import prime_tpu.commands._deps as deps
+from prime_tpu.commands.main import cli
+from prime_tpu.testing import FakeControlPlane
+
+
+@pytest.fixture
+def fake(monkeypatch):
+    fake = FakeControlPlane()
+    fake.sandbox_plane.ready_after_polls = 1
+    monkeypatch.setattr(deps, "transport_override", fake.transport)
+    monkeypatch.setenv("PRIME_API_KEY", "test-key")
+    monkeypatch.setenv("PRIME_BASE_URL", "https://api.fake")
+    return fake
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+def _create(runner, *args) -> str:
+    result = runner.invoke(cli, ["sandbox", "create", "--output", "json", *args])
+    assert result.exit_code == 0, result.output
+    return json.loads(result.output)["sandboxId"]
+
+
+def test_create_wait_run_roundtrip(runner, fake):
+    sid = _create(runner, "--name", "demo")
+    result = runner.invoke(cli, ["sandbox", "run", sid, "echo from-cli"])
+    assert result.exit_code == 0, result.output
+    assert "from-cli" in result.output
+
+
+def test_run_propagates_exit_code(runner, fake):
+    sid = _create(runner)
+    result = runner.invoke(cli, ["sandbox", "run", sid, "exit 9"])
+    assert result.exit_code == 9
+
+
+def test_create_with_tpu_and_list(runner, fake):
+    _create(runner, "--tpu", "v5e-1", "--label", "proj=demo")
+    result = runner.invoke(cli, ["sandbox", "list", "--label", "proj=demo", "--output", "json"])
+    rows = json.loads(result.output)
+    assert len(rows) == 1 and rows[0]["tpuType"] == "v5e-1"
+
+
+def test_create_multihost_tpu_rejected(runner, fake):
+    result = runner.invoke(cli, ["sandbox", "create", "--tpu", "v5e-16"])
+    assert result.exit_code != 0
+    assert "single-host" in result.output
+
+
+def test_upload_download(runner, fake, tmp_path):
+    sid = _create(runner)
+    src = tmp_path / "f.txt"
+    src.write_text("payload")
+    assert runner.invoke(cli, ["sandbox", "upload", sid, str(src), "/f.txt"]).exit_code == 0
+    dst = tmp_path / "out.txt"
+    assert runner.invoke(cli, ["sandbox", "download", sid, "/f.txt", str(dst)]).exit_code == 0
+    assert dst.read_text() == "payload"
+
+
+def test_bulk_delete_previews_and_confirms(runner, fake):
+    ids = [_create(runner) for _ in range(2)]
+    result = runner.invoke(cli, ["sandbox", "delete", *ids], input="n\n")
+    assert "Aborted" in result.output
+    result = runner.invoke(cli, ["sandbox", "delete", *ids], input="y\n")
+    assert result.exit_code == 0
+    assert "Deleted 2 sandboxes" in result.output
+
+
+def test_network_and_ports(runner, fake):
+    sid = _create(runner)
+    result = runner.invoke(
+        cli,
+        ["sandbox", "network", sid, "--default-action", "deny", "--allow", "pypi.org", "--output", "json"],
+    )
+    assert json.loads(result.output)["defaultAction"] == "deny"
+
+    result = runner.invoke(cli, ["sandbox", "expose", sid, "8080", "--output", "json"])
+    assert json.loads(result.output)["port"] == 8080
+    result = runner.invoke(cli, ["sandbox", "list-ports", sid, "--plain"])
+    assert "8080" in result.output
+    assert runner.invoke(cli, ["sandbox", "unexpose", sid, "8080"]).exit_code == 0

@@ -1,0 +1,411 @@
+"""Sandbox SDK tests against the fake two-plane backend.
+
+The gateway fake really executes commands (bash subprocess per sandbox root),
+so exec, background jobs (nohup + exit files), and windowed file reads are
+pinned against real shell behavior. Retry/auth state-machine tests mirror the
+reference's transport-fake approach (prime-sandboxes/tests/test_client_retry.py,
+test_gateway_error_mapping.py, test_command_transport_selection.py).
+"""
+
+import pytest
+
+from prime_tpu.core.client import APIClient, AsyncAPIClient
+from prime_tpu.core.config import Config
+from prime_tpu.core.exceptions import APIError
+from prime_tpu.sandboxes import (
+    AsyncSandboxClient,
+    CreateSandboxRequest,
+    EgressPolicy,
+    SandboxClient,
+    SandboxNotFoundError,
+    SandboxOOMError,
+)
+from prime_tpu.sandboxes.auth import AsyncSandboxAuthCache, SandboxAuthCache
+from prime_tpu.testing import FakeControlPlane
+
+
+@pytest.fixture
+def fake():
+    fake = FakeControlPlane()
+    fake.sandbox_plane.ready_after_polls = 1
+    return fake
+
+
+@pytest.fixture
+def client(fake, tmp_path):
+    cfg = Config()
+    cfg.api_key = "test-key"
+    api = APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    c = SandboxClient(
+        client=api,
+        auth_cache=SandboxAuthCache(tmp_path / "auth.json"),
+        gateway_transport=fake.transport,
+    )
+    yield c
+    c.close()
+
+
+def make_async_client(fake, tmp_path) -> AsyncSandboxClient:
+    cfg = Config()
+    cfg.api_key = "test-key"
+    api = AsyncAPIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    return AsyncSandboxClient(
+        client=api,
+        auth_cache=AsyncSandboxAuthCache(tmp_path / "auth-async.json"),
+        gateway_transport=fake.transport,
+    )
+
+
+def create_running(client, fake, **kw) -> str:
+    sb = client.create(CreateSandboxRequest(**kw))
+    fake.sandbox_plane.make_running(sb.sandbox_id)
+    return sb.sandbox_id
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+
+def test_create_defaults_to_tpu_image(client):
+    sb = client.create(CreateSandboxRequest())
+    assert sb.docker_image == "primetpu/jax-tpu:latest"
+    assert sb.status == "PENDING"
+
+
+def test_create_is_idempotent_with_key(client):
+    a = client.create(CreateSandboxRequest(name="one"), idempotency_key="k1")
+    b = client.create(CreateSandboxRequest(name="one"), idempotency_key="k1")
+    assert a.sandbox_id == b.sandbox_id
+
+
+def test_tpu_type_must_be_single_host():
+    with pytest.raises(ValueError, match="single-host"):
+        CreateSandboxRequest(tpu_type="v5e-16")
+    assert CreateSandboxRequest(tpu_type="v5e-8").tpu_type == "v5e-8"
+
+
+def test_wait_for_creation_polls_then_reachability(client, fake):
+    fake.sandbox_plane.ready_after_polls = 3
+    sb = client.create(CreateSandboxRequest())
+    ready = client.wait_for_creation(sb.sandbox_id, poll_interval_s=0)
+    assert ready.status == "RUNNING"
+
+
+def test_wait_for_creation_oom_is_typed(client, fake):
+    sb = client.create(CreateSandboxRequest())
+    fake.sandbox_plane.fail_sandbox(sb.sandbox_id, reason="oom", detail="container OOM-killed")
+    with pytest.raises(SandboxOOMError, match="OOM-killed"):
+        client.wait_for_creation(sb.sandbox_id, poll_interval_s=0)
+
+
+def test_bulk_wait_uses_list_endpoint(client, fake):
+    ids = [client.create(CreateSandboxRequest()).sandbox_id for _ in range(3)]
+    fake.requests.clear()
+    ready = client.bulk_wait_for_creation(ids, poll_interval_s=0)
+    assert [s.sandbox_id for s in ready] == ids
+    gets = [p for m, p in fake.requests if m == "GET" and p == "/api/v1/sandbox"]
+    per_id_gets = [p for m, p in fake.requests if m == "GET" and p.startswith("/api/v1/sandbox/")]
+    assert gets and not per_id_gets  # one list call per poll, no per-id polling
+
+
+def test_delete_and_bulk_delete(client, fake):
+    sid = create_running(client, fake)
+    client.delete(sid)
+    assert fake.sandbox_plane.sandboxes[sid]["status"] == "TERMINATED"
+    client.delete(sid)  # idempotent — no raise on already-deleted
+
+    ids = [client.create(CreateSandboxRequest()).sandbox_id for _ in range(2)]
+    result = client.bulk_delete(ids + ["sbx_missing"])
+    assert set(result["deleted"]) == set(ids)
+    assert result["missing"] == ["sbx_missing"]
+
+
+def test_logs(client, fake):
+    sid = create_running(client, fake)
+    assert "started" in client.logs(sid)
+
+
+# -- exec + transports -------------------------------------------------------
+
+
+def test_execute_command_real_shell(client, fake):
+    sid = create_running(client, fake)
+    result = client.execute_command(sid, "echo hello-tpu; echo oops >&2; exit 3")
+    assert result.stdout.strip() == "hello-tpu"
+    assert result.stderr.strip() == "oops"
+    assert result.exit_code == 3 and not result.ok
+
+
+def test_vm_sandbox_uses_streaming_transport(client, fake):
+    sid = create_running(client, fake, is_vm=True)
+    result = client.execute_command(sid, "echo streamed")
+    assert result.stdout.strip() == "streamed"
+    assert result.ok
+
+
+def test_exec_after_terminal_is_not_found(client, fake):
+    sid = create_running(client, fake)
+    client.execute_command(sid, "true")  # prime the auth cache
+    fake.sandbox_plane.sandboxes[sid]["status"] = "TERMINATED"
+    with pytest.raises(SandboxNotFoundError):
+        client.execute_command(sid, "echo nope")
+
+
+# -- gateway retry/auth state machine ----------------------------------------
+
+
+def test_gateway_401_reauths_exactly_once(client, fake):
+    sid = create_running(client, fake)
+    client.execute_command(sid, "true")
+    mints_before = fake.sandbox_plane.auth_mints
+    fake.sandbox_plane.expire_tokens()
+    result = client.execute_command(sid, "echo again")
+    assert result.ok
+    assert fake.sandbox_plane.auth_mints == mints_before + 1
+
+
+def test_gateway_409_busy_retries(client, fake, monkeypatch):
+    monkeypatch.setattr("prime_tpu.sandboxes.client.CONFLICT_BACKOFF_S", 0)
+    sid = create_running(client, fake)
+    fake.sandbox_plane.busy_conflicts[sid] = 2
+    assert client.execute_command(sid, "echo ok").ok
+
+
+def test_gateway_5xx_retries_idempotent_reads(client, fake, monkeypatch):
+    monkeypatch.setattr("prime_tpu.core.client._backoff", lambda a: 0)
+    sid = create_running(client, fake)
+    client.write_file(sid, "/data.txt", b"abc")
+    fake.sandbox_plane.gateway_faults = [503, 524]
+    assert client.read_file(sid, "/data.txt") == "abc"
+
+
+def test_gateway_5xx_does_not_retry_exec(client, fake, monkeypatch):
+    monkeypatch.setattr("prime_tpu.core.client._backoff", lambda a: 0)
+    sid = create_running(client, fake)
+    fake.sandbox_plane.gateway_faults = [500]
+    with pytest.raises(APIError):
+        client.execute_command(sid, "echo x")
+    assert fake.sandbox_plane.gateway_faults == []  # consumed exactly one fault
+
+
+def test_auth_cache_reuses_token_across_clients(fake, tmp_path):
+    cfg = Config()
+    cfg.api_key = "test-key"
+    path = tmp_path / "shared-auth.json"
+
+    def build():
+        api = APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+        return SandboxClient(client=api, auth_cache=SandboxAuthCache(path), gateway_transport=fake.transport)
+
+    c1 = build()
+    sb = c1.create(CreateSandboxRequest())
+    fake.sandbox_plane.make_running(sb.sandbox_id)
+    c1.execute_command(sb.sandbox_id, "true")
+    mints = fake.sandbox_plane.auth_mints
+    c2 = build()  # fresh client, same disk cache
+    c2.execute_command(sb.sandbox_id, "true")
+    assert fake.sandbox_plane.auth_mints == mints  # token came from disk
+
+
+# -- background jobs ---------------------------------------------------------
+
+
+def test_background_job_lifecycle(client, fake):
+    sid = create_running(client, fake)
+    job = client.start_background_job(sid, "train", "echo step1; sleep 0.2; echo done")
+    assert job.running and job.pid
+
+    finished = client.wait_for_background_job(sid, "train", timeout_s=10, poll_interval_s=0.1)
+    assert not finished.running
+    assert finished.exit_code == 0
+    assert "done" in finished.stdout_tail
+
+
+def test_background_job_failure_exit_code(client, fake):
+    sid = create_running(client, fake)
+    client.start_background_job(sid, "bad", "echo starting; exit 7")
+    job = client.wait_for_background_job(sid, "bad", timeout_s=10, poll_interval_s=0.1)
+    assert job.exit_code == 7
+
+
+# -- files -------------------------------------------------------------------
+
+
+def test_file_roundtrip_and_windowed_read(client, fake, tmp_path):
+    sid = create_running(client, fake)
+    src = tmp_path / "input.bin"
+    src.write_bytes(b"0123456789")
+    client.upload_file(sid, src, "/work/input.bin")
+
+    assert client.read_file_bytes(sid, "/work/input.bin") == b"0123456789"
+    assert client.read_file_bytes(sid, "/work/input.bin", offset=3, length=4) == b"3456"
+
+    dst = tmp_path / "out.bin"
+    client.download_file(sid, "/work/input.bin", dst)
+    assert dst.read_bytes() == b"0123456789"
+
+    files = client.list_files(sid, "/work")
+    assert [f.path for f in files] == ["/work/input.bin"]
+
+
+def test_file_upload_visible_to_exec(client, fake):
+    sid = create_running(client, fake)
+    client.write_file(sid, "/script.py", b"print(2 + 3)")
+    result = client.execute_command(sid, "python3 script.py || python script.py")
+    assert result.stdout.strip() == "5"
+
+
+def test_path_traversal_blocked(client, fake):
+    sid = create_running(client, fake)
+    with pytest.raises(APIError):
+        client.write_file(sid, "../../etc/passwd", b"x")
+
+
+# -- egress + ports ----------------------------------------------------------
+
+
+def test_egress_roundtrip(client, fake):
+    sid = create_running(client, fake)
+    policy = EgressPolicy(default_action="deny", allow_hosts=["*.googleapis.com", "pypi.org:443"])
+    saved = client.set_egress(sid, policy)
+    assert saved.default_action == "deny"
+    assert client.get_egress(sid).allow_hosts == ["*.googleapis.com", "pypi.org:443"]
+
+
+def test_egress_validator_rejects_bad_hosts():
+    with pytest.raises(ValueError, match="Invalid host pattern"):
+        EgressPolicy(allow_hosts=["not a host!"])
+
+
+def test_ports_expose_unexpose(client, fake):
+    sid = create_running(client, fake)
+    port = client.expose(sid, 8888, auth_required=False)
+    assert port.url.endswith(".ports.fake") and not port.auth_required
+    assert [p.port for p in client.list_ports(sid)] == [8888]
+    client.unexpose(sid, 8888)
+    assert client.list_ports(sid) == []
+
+
+# -- async mirror ------------------------------------------------------------
+
+
+@pytest.mark.anyio
+async def test_async_full_lifecycle(fake, tmp_path):
+    client = make_async_client(fake, tmp_path)
+    sb = await client.create(CreateSandboxRequest(name="async-sb"))
+    fake.sandbox_plane.make_running(sb.sandbox_id)
+    ready = await client.wait_for_creation(sb.sandbox_id, poll_interval_s=0)
+    assert ready.status == "RUNNING"
+
+    result = await client.execute_command(sb.sandbox_id, "echo async-hello")
+    assert result.stdout.strip() == "async-hello"
+
+    await client.write_file(sb.sandbox_id, "/a.txt", b"abc")
+    assert await client.read_file(sb.sandbox_id, "/a.txt") == "abc"
+
+    job = await client.start_background_job(sb.sandbox_id, "j1", "echo bg-done")
+    assert job.running
+    import anyio
+
+    for _ in range(50):
+        job = await client.get_background_job(sb.sandbox_id, "j1")
+        if not job.running:
+            break
+        await anyio.sleep(0.1)
+    assert job.exit_code == 0 and "bg-done" in job.stdout_tail
+
+    await client.delete(sb.sandbox_id)
+    await client.close()
+
+
+@pytest.mark.anyio
+async def test_async_vm_streaming_and_reauth(fake, tmp_path):
+    client = make_async_client(fake, tmp_path)
+    sb = await client.create(CreateSandboxRequest(is_vm=True))
+    fake.sandbox_plane.make_running(sb.sandbox_id)
+    result = await client.execute_command(sb.sandbox_id, "echo vm-stream")
+    assert result.stdout.strip() == "vm-stream"
+
+    mints = fake.sandbox_plane.auth_mints
+    fake.sandbox_plane.expire_tokens()
+    # VM streaming path re-auths via the shared _auth too: token refresh happens
+    # on the next non-stream gateway call; for stream we expect a clean 401 error
+    await client.write_file(sb.sandbox_id, "/x", b"1")
+    assert fake.sandbox_plane.auth_mints == mints + 1
+    await client.close()
+
+
+@pytest.mark.anyio
+async def test_async_auth_coalescing(fake, tmp_path):
+    """N concurrent commands on a fresh sandbox mint exactly one token."""
+    import anyio
+
+    client = make_async_client(fake, tmp_path)
+    sb = await client.create(CreateSandboxRequest())
+    fake.sandbox_plane.make_running(sb.sandbox_id)
+    mints_before = fake.sandbox_plane.auth_mints
+
+    async with anyio.create_task_group() as tg:
+        for i in range(8):
+            tg.start_soon(client.execute_command, sb.sandbox_id, f"echo {i}")
+    assert fake.sandbox_plane.auth_mints == mints_before + 1
+    await client.close()
+
+
+# -- review-finding regressions ----------------------------------------------
+
+
+def test_vm_streaming_reauths_once_on_401(client, fake):
+    sid = create_running(client, fake, is_vm=True)
+    client.execute_command(sid, "true")
+    mints = fake.sandbox_plane.auth_mints
+    fake.sandbox_plane.expire_tokens()
+    assert client.execute_command(sid, "echo back").stdout.strip() == "back"
+    assert fake.sandbox_plane.auth_mints == mints + 1
+
+
+def test_vm_streaming_409_retries(client, fake, monkeypatch):
+    monkeypatch.setattr("prime_tpu.sandboxes.client.CONFLICT_BACKOFF_S", 0)
+    sid = create_running(client, fake, is_vm=True)
+    client.execute_command(sid, "true")
+    fake.sandbox_plane.busy_conflicts[sid] = 2
+    assert client.execute_command(sid, "echo ok").ok
+
+
+def test_kill_background_job_reaps_process_tree(client, fake):
+    sid = create_running(client, fake)
+    client.start_background_job(sid, "lived", "sleep 30; echo never")
+    import time as _time
+
+    _time.sleep(0.2)
+    client.kill_background_job(sid, "lived")
+    _time.sleep(0.2)
+    # the group kill must have reaped the sleep: pgrep finds nothing
+    # ([3]0 so the probe's own cmdline doesn't match itself)
+    result = client.execute_command(sid, "pgrep -f 'sleep [3]0' || echo gone")
+    assert "gone" in result.stdout
+
+
+def test_get_unknown_background_job_raises(client, fake):
+    from prime_tpu.sandboxes.exceptions import SandboxError
+
+    sid = create_running(client, fake)
+    with pytest.raises(SandboxError, match="not found"):
+        client.get_background_job(sid, "never-started")
+
+
+def test_bulk_wait_walks_pages(client, fake):
+    ids = [client.create(CreateSandboxRequest()).sandbox_id for _ in range(7)]
+    # force tiny pages so the walk must paginate
+    ready = [s.sandbox_id for s in client.list_all(page_size=3)]
+    assert set(ids) <= set(ready)
+
+
+@pytest.mark.anyio
+async def test_async_wait_for_background_job(fake, tmp_path):
+    client = make_async_client(fake, tmp_path)
+    sb = await client.create(CreateSandboxRequest())
+    fake.sandbox_plane.make_running(sb.sandbox_id)
+    await client.start_background_job(sb.sandbox_id, "aw", "echo finished")
+    job = await client.wait_for_background_job(sb.sandbox_id, "aw", timeout_s=10, poll_interval_s=0.1)
+    assert job.exit_code == 0 and "finished" in job.stdout_tail
+    await client.close()
