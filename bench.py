@@ -1,0 +1,84 @@
+"""Benchmark: autoregressive generation throughput on the real TPU chip.
+
+Proxy for the north-star workload (gsm8k eval samples/sec, BASELINE.md): the
+eval runner's cost is dominated by batched prefill + greedy decode, which is
+exactly what this measures — llama3.2-1b architecture (random weights;
+throughput is weight-value independent), bf16, batch 8, 128-token prompts,
+128 new tokens.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+The reference publishes no numbers (BASELINE.json "published": {}), so
+vs_baseline is the ratio against PREV_DECODE_TOK_S below — the first recorded
+round of this repo; update it when the bench materially improves.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from prime_tpu.models import get_config
+from prime_tpu.models.llama import init_params
+from prime_tpu.models.sampler import generate
+
+# Round-1 anchor (v5e-1, this repo @ first bench). vs_baseline = value / this.
+PREV_DECODE_TOK_S = 1396.6
+
+BATCH = 8
+PROMPT_LEN = 128
+NEW_TOKENS = 128
+MODEL = "llama3.2-1b"
+
+
+def main() -> None:
+    config = get_config(MODEL)
+    rng = jax.random.PRNGKey(0)
+    params = init_params(rng, config, dtype=jnp.bfloat16)
+    prompts = jax.random.randint(jax.random.PRNGKey(1), (BATCH, PROMPT_LEN), 1, config.vocab_size)
+    lengths = jnp.full((BATCH,), PROMPT_LEN, dtype=jnp.int32)
+
+    def run():
+        result = generate(
+            params,
+            prompts,
+            lengths,
+            config,
+            jax.random.PRNGKey(2),
+            max_new_tokens=NEW_TOKENS,
+            temperature=0.0,
+        )
+        # fetch a scalar to force execution: on tunneled backends (axon)
+        # block_until_ready returns before the computation has run
+        float(jnp.sum(result.tokens))
+        return result
+
+    run()  # warmup + compile
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    decode_tok_s = BATCH * NEW_TOKENS / best
+    samples_per_sec = BATCH / best
+
+    print(
+        json.dumps(
+            {
+                "metric": f"decode_tokens_per_sec ({MODEL} bf16, b{BATCH}, p{PROMPT_LEN}+{NEW_TOKENS})",
+                "value": round(decode_tok_s, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(decode_tok_s / PREV_DECODE_TOK_S, 3),
+                "samples_per_sec": round(samples_per_sec, 2),
+                "gen_time_s": round(best, 3),
+                "backend": jax.default_backend(),
+                "device": str(jax.devices()[0]),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+"""JAX model zoo: the native inference/eval compute path.
+
+Pure-functional transformers (param pytrees + jitted apply fns), Llama-3
+family first. ``get_config(name)`` resolves presets; ``prime_tpu.models.llama``
+has init/forward; ``prime_tpu.models.sampler`` decodes with a KV cache.
+"""
+
+from prime_tpu.models.config import MODEL_PRESETS, ModelConfig, get_config
+
+__all__ = ["ModelConfig", "MODEL_PRESETS", "get_config"]

@@ -1,0 +1,111 @@
+"""Model architecture configs (Llama-3 family presets).
+
+Frozen + hashable so a config can ride as a static jit argument.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    d_ff: int
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def param_count(self) -> int:
+        embed = self.vocab_size * self.d_model
+        head = 0 if self.tie_embeddings else self.d_model * self.vocab_size
+        attn = self.d_model * self.head_dim * (2 * self.n_heads + 2 * self.n_kv_heads)
+        mlp = 3 * self.d_model * self.d_ff
+        norms = 2 * self.d_model
+        per_layer = attn + mlp + norms
+        return embed + head + self.n_layers * per_layer + self.d_model
+
+    def scaled(self, **overrides) -> "ModelConfig":
+        return replace(self, **overrides)
+
+
+MODEL_PRESETS: dict[str, ModelConfig] = {
+    "llama3-8b": ModelConfig(
+        name="llama3-8b",
+        vocab_size=128256,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+    ),
+    "llama3-70b": ModelConfig(
+        name="llama3-70b",
+        vocab_size=128256,
+        d_model=8192,
+        n_layers=80,
+        n_heads=64,
+        n_kv_heads=8,
+        d_ff=28672,
+    ),
+    "llama3.2-1b": ModelConfig(
+        name="llama3.2-1b",
+        vocab_size=128256,
+        d_model=2048,
+        n_layers=16,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=8192,
+        tie_embeddings=True,
+    ),
+    "llama3.2-3b": ModelConfig(
+        name="llama3.2-3b",
+        vocab_size=128256,
+        d_model=3072,
+        n_layers=28,
+        n_heads=24,
+        n_kv_heads=8,
+        d_ff=8192,
+        tie_embeddings=True,
+    ),
+    # small configs for tests / benches that still exercise every code path
+    "debug-128m": ModelConfig(
+        name="debug-128m",
+        vocab_size=32000,
+        d_model=768,
+        n_layers=12,
+        n_heads=12,
+        n_kv_heads=4,
+        d_ff=2048,
+        max_seq_len=2048,
+    ),
+    "tiny-test": ModelConfig(
+        name="tiny-test",
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=256,
+        max_seq_len=512,
+    ),
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    try:
+        return MODEL_PRESETS[name]
+    except KeyError:
+        valid = ", ".join(sorted(MODEL_PRESETS))
+        raise ValueError(f"Unknown model {name!r}: expected one of {valid}") from None

@@ -1,0 +1,193 @@
+"""Llama-family transformer: pure-functional JAX, scan-over-layers, KV cache.
+
+Design (TPU-first, not a port):
+- Layer parameters are **stacked** along a leading n_layers axis and the
+  decoder runs as one ``lax.scan`` — one compiled layer body regardless of
+  depth, fast compiles, and clean (L, ...) sharding.
+- One forward serves three regimes via static shape/flags: training (no
+  cache), prefill (writes the cache), decode (S=1 against the cache).
+- All matmuls in bf16 on the MXU with fp32 softmax/norm accumulation; the
+  causal prefill path dispatches to the pallas flash kernel on TPU
+  (prime_tpu.ops.pallas_attention).
+- SPMD: pure functions of pytrees — sharding comes from the caller via
+  NamedSharding on params/batch (prime_tpu.parallel.sharding), no mesh logic
+  in model code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from prime_tpu.models.config import ModelConfig
+from prime_tpu.ops.attention import decode_attention, multi_head_attention
+from prime_tpu.ops.norms import rms_norm
+from prime_tpu.ops.rope import apply_rope, rope_frequencies
+
+Params = dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    """Per-layer stacked KV cache: k/v are (L, B, KH, C, head_dim)."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    lengths: jnp.ndarray  # (B,) valid entries per sequence
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[3]
+
+
+def init_cache(config: ModelConfig, batch: int, capacity: int, dtype=jnp.bfloat16) -> KVCache:
+    shape = (config.n_layers, batch, config.n_kv_heads, capacity, config.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, dtype=dtype),
+        v=jnp.zeros(shape, dtype=dtype),
+        lengths=jnp.zeros((batch,), dtype=jnp.int32),
+    )
+
+
+def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Params:
+    """Random init (truncated-normal-ish scaled); checkpoint loaders overwrite."""
+    keys = jax.random.split(rng, 10)
+    d, hd = config.d_model, config.head_dim
+    h, kh, ff, layers = config.n_heads, config.n_kv_heads, config.d_ff, config.n_layers
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * (fan_in**-0.5)).astype(dtype)
+
+    params: Params = {
+        "embed": dense(keys[0], (config.vocab_size, d), d),
+        "layers": {
+            "attn_norm": jnp.ones((layers, d), dtype=dtype),
+            "wq": dense(keys[1], (layers, d, h * hd), d),
+            "wk": dense(keys[2], (layers, d, kh * hd), d),
+            "wv": dense(keys[3], (layers, d, kh * hd), d),
+            "wo": dense(keys[4], (layers, h * hd, d), h * hd),
+            "mlp_norm": jnp.ones((layers, d), dtype=dtype),
+            "w_gate": dense(keys[5], (layers, d, ff), d),
+            "w_up": dense(keys[6], (layers, d, ff), d),
+            "w_down": dense(keys[7], (layers, ff, d), ff),
+        },
+        "final_norm": jnp.ones((d,), dtype=dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = dense(keys[8], (d, config.vocab_size), d)
+    return params
+
+
+def _attention_block(
+    x: jnp.ndarray,               # (B, S, D)
+    lp: Params,                   # one layer's params
+    positions: jnp.ndarray,       # (B, S)
+    rope_tables: tuple[jnp.ndarray, jnp.ndarray],
+    config: ModelConfig,
+    k_cache: jnp.ndarray | None,  # (B, KH, C, hd) this layer
+    v_cache: jnp.ndarray | None,
+    cache_lengths: jnp.ndarray | None,
+    decode: bool,
+    attn_impl: str,
+):
+    batch, seq, _ = x.shape
+    h, kh, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    cos, sin = rope_tables
+
+    normed = rms_norm(x, lp["attn_norm"], config.rms_eps)
+    q = (normed @ lp["wq"]).reshape(batch, seq, h, hd)
+    k = (normed @ lp["wk"]).reshape(batch, seq, kh, hd)
+    v = (normed @ lp["wv"]).reshape(batch, seq, kh, hd)
+    q = apply_rope(q, positions, cos, sin)
+    k = apply_rope(k, positions, cos, sin)
+
+    q = q.transpose(0, 2, 1, 3)  # (B, H, S, hd)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+
+    new_k_cache, new_v_cache = k_cache, v_cache
+    if decode:
+        assert k_cache is not None and cache_lengths is not None
+        # scatter this step's k/v into each sequence's next free slot
+        def put(cache, new):  # cache (B, KH, C, hd), new (B, KH, 1, hd)
+            def one(c, n, idx):
+                return jax.lax.dynamic_update_slice(c, n, (0, idx, 0))
+
+            return jax.vmap(one)(cache, new, cache_lengths)
+
+        new_k_cache = put(k_cache, k)
+        new_v_cache = put(v_cache, v)
+        attn = decode_attention(q, new_k_cache, new_v_cache, cache_lengths + 1, hd**-0.5)
+    else:
+        attn = multi_head_attention(q, k, v, impl=attn_impl)
+        if k_cache is not None:
+            # prefill: stage the prompt's k/v at slots [0, S)
+            new_k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
+            new_v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
+
+    attn = attn.transpose(0, 2, 1, 3).reshape(batch, seq, h * hd)
+    return x + attn @ lp["wo"], new_k_cache, new_v_cache
+
+
+def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> jnp.ndarray:
+    normed = rms_norm(x, lp["mlp_norm"], config.rms_eps)
+    gate = jax.nn.silu(normed @ lp["w_gate"])
+    up = normed @ lp["w_up"]
+    return x + (gate * up) @ lp["w_down"]
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,                 # (B, S) int32
+    config: ModelConfig,
+    positions: jnp.ndarray | None = None,  # (B, S); default arange
+    cache: KVCache | None = None,
+    decode: bool = False,
+    attn_impl: str = "auto",
+) -> tuple[jnp.ndarray, KVCache | None]:
+    """Run the transformer. Returns (logits (B, S, V) fp32, updated cache).
+
+    - training:     cache=None, decode=False
+    - prefill:      cache=init_cache(...), decode=False
+    - decode step:  cache=<filled>, decode=True, S must be 1
+    """
+    batch, seq = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None, :], (batch, seq))
+    max_pos = cache.capacity if cache is not None else max(seq, config.max_seq_len)
+    rope_tables = rope_frequencies(config.head_dim, max_pos, config.rope_theta)
+
+    x = params["embed"][tokens]
+
+    layer_params = params["layers"]
+    cache_lengths = cache.lengths if cache is not None else None
+
+    def layer_fn(x, scanned):
+        lp, k_c, v_c = scanned
+        x, new_k, new_v = _attention_block(
+            x, lp, positions, rope_tables, config,
+            k_c, v_c, cache_lengths, decode, attn_impl,
+        )
+        x = _mlp_block(x, lp, config)
+        return x, (new_k, new_v)
+
+    if cache is not None:
+        x, (new_k, new_v) = jax.lax.scan(layer_fn, x, (layer_params, cache.k, cache.v))
+        new_lengths = cache.lengths + (1 if decode else seq)
+        new_cache = KVCache(k=new_k, v=new_v, lengths=new_lengths)
+    else:
+
+        def layer_fn_nocache(x, lp):
+            x, _, _ = _attention_block(
+                x, lp, positions, rope_tables, config, None, None, None, False, attn_impl
+            )
+            return _mlp_block(x, lp, config), None
+
+        x, _ = jax.lax.scan(layer_fn_nocache, x, layer_params)
+        new_cache = None
+
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    logits = (x @ head).astype(jnp.float32)
+    return logits, new_cache

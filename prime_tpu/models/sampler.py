@@ -1,0 +1,111 @@
+"""Autoregressive generation with a KV cache.
+
+TPU-first decode loop: prefill once over the padded prompt batch (flash
+attention), then ``lax.scan`` over decode steps — the whole generation is two
+compiled programs, no per-token Python dispatch. Right-padded prompts with
+per-sequence lengths; finished sequences keep emitting ``pad_id`` so shapes
+stay static.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from prime_tpu.models.config import ModelConfig
+from prime_tpu.models.llama import KVCache, forward, init_cache
+
+
+class GenerationResult(NamedTuple):
+    tokens: jnp.ndarray        # (B, max_new_tokens) generated ids (pad after EOS)
+    lengths: jnp.ndarray       # (B,) generated tokens before EOS (exclusive)
+    logprobs: jnp.ndarray      # (B, max_new_tokens) logprob of each sampled token
+
+
+def _sample(logits: jnp.ndarray, temperature: float, rng: jax.Array) -> jnp.ndarray:
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(rng, logits / temperature, axis=-1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "max_new_tokens", "temperature", "eos_id", "pad_id", "attn_impl"),
+)
+def generate(
+    params,
+    prompt_tokens: jnp.ndarray,    # (B, S) right-padded with pad_id
+    prompt_lengths: jnp.ndarray,   # (B,)
+    config: ModelConfig,
+    rng: jax.Array,
+    max_new_tokens: int = 128,
+    temperature: float = 0.0,
+    eos_id: int = -1,              # -1 disables EOS stopping
+    pad_id: int = 0,
+    attn_impl: str = "auto",
+) -> GenerationResult:
+    batch, prompt_len = prompt_tokens.shape
+    capacity = prompt_len + max_new_tokens
+    cache = init_cache(config, batch, capacity, dtype=params["embed"].dtype)
+
+    # ---- prefill ----
+    logits, cache = forward(
+        params, prompt_tokens, config, cache=cache, decode=False, attn_impl=attn_impl
+    )
+    # cache was filled for the padded length; true lengths are per-sequence
+    cache = cache._replace(lengths=prompt_lengths.astype(jnp.int32))
+    # next-token logits live at each sequence's last real position
+    last = jnp.take_along_axis(logits, (prompt_lengths - 1)[:, None, None], axis=1)[:, 0, :]
+
+    rng, step_rng = jax.random.split(rng)
+    first_tokens = _sample(last, temperature, step_rng)
+    first_logprobs = jnp.take_along_axis(
+        jax.nn.log_softmax(last, axis=-1), first_tokens[:, None], axis=1
+    )[:, 0]
+
+    # ---- decode loop ----
+    class Carry(NamedTuple):
+        cache: KVCache
+        tokens: jnp.ndarray      # (B,) last sampled
+        done: jnp.ndarray        # (B,) bool
+        rng: jax.Array
+
+    def step(carry: Carry, _):
+        logits, new_cache = forward(
+            params,
+            carry.tokens[:, None],
+            config,
+            positions=carry.cache.lengths[:, None],
+            cache=carry.cache,
+            decode=True,
+        )
+        step_logits = logits[:, 0, :]
+        rng, step_rng = jax.random.split(carry.rng)
+        sampled = _sample(step_logits, temperature, step_rng)
+        sampled = jnp.where(carry.done, pad_id, sampled)
+        logprob = jnp.take_along_axis(
+            jax.nn.log_softmax(step_logits, axis=-1), sampled[:, None], axis=1
+        )[:, 0]
+        done = carry.done | (sampled == eos_id)
+        return Carry(new_cache, sampled, done, rng), (sampled, jnp.where(carry.done, 0.0, logprob))
+
+    init_done = jnp.zeros((batch,), dtype=bool) | (first_tokens == eos_id)
+    carry = Carry(cache, first_tokens, init_done, rng)
+    if max_new_tokens > 1:
+        carry, (rest_tokens, rest_logprobs) = jax.lax.scan(
+            step, carry, None, length=max_new_tokens - 1
+        )
+        all_tokens = jnp.concatenate([first_tokens[:, None], rest_tokens.T], axis=1)
+        all_logprobs = jnp.concatenate([first_logprobs[:, None], rest_logprobs.T], axis=1)
+    else:
+        all_tokens = first_tokens[:, None]
+        all_logprobs = first_logprobs[:, None]
+
+    # length = tokens strictly before the first EOS (a sampled token that
+    # happens to equal pad_id is still a real token and counts)
+    seen_eos = jnp.cumsum(all_tokens == eos_id, axis=1) > 0
+    gen_lengths = jnp.sum(~seen_eos, axis=1)
+    return GenerationResult(tokens=all_tokens, lengths=gen_lengths, logprobs=all_logprobs)

@@ -1,0 +1,12 @@
+"""TPU compute ops: norms, rotary embeddings, attention (pallas + XLA).
+
+These are the hot ops of the native JAX inference/eval backend (SURVEY.md §7
+stage 5). Everything is pure-functional and jit/shard_map friendly: static
+shapes, no Python control flow on traced values.
+"""
+
+from prime_tpu.ops.norms import rms_norm
+from prime_tpu.ops.rope import apply_rope, rope_frequencies
+from prime_tpu.ops.attention import multi_head_attention
+
+__all__ = ["rms_norm", "apply_rope", "rope_frequencies", "multi_head_attention"]

@@ -1,0 +1,85 @@
+"""Multi-head attention with GQA: XLA reference path + pallas dispatch.
+
+Two execution regimes, one entry point:
+- **prefill** (S > 1): causal self-attention over the whole prompt — the
+  pallas flash kernel when running on TPU with aligned shapes, otherwise a
+  fused XLA einsum path (also the ground truth the kernel is tested against);
+- **decode** (S == 1): a single query attending to the KV cache — a pure
+  einsum over the cache (bandwidth-bound; XLA handles it optimally).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _pallas_eligible(q: jnp.ndarray, head_dim: int) -> bool:
+    if jax.default_backend() != "tpu":
+        return False
+    seq_len = q.shape[2]
+    from prime_tpu.ops.pallas_attention import BLOCK_Q
+
+    return seq_len % BLOCK_Q == 0 and head_dim % 128 == 0
+
+
+def xla_attention_causal(
+    q: jnp.ndarray,  # (B, H, S, D)
+    k: jnp.ndarray,  # (B, KH, S, D)
+    v: jnp.ndarray,
+    sm_scale: float,
+) -> jnp.ndarray:
+    """Reference causal attention (fp32 softmax), GQA via head repetition."""
+    num_heads, kv_heads = q.shape[1], k.shape[1]
+    if kv_heads != num_heads:
+        reps = num_heads // kv_heads
+        k = jnp.repeat(k, reps, axis=1)
+        v = jnp.repeat(v, reps, axis=1)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * sm_scale
+    seq = q.shape[2]
+    causal = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+    scores = jnp.where(causal[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
+
+
+def decode_attention(
+    q: jnp.ndarray,          # (B, H, 1, D)
+    k_cache: jnp.ndarray,    # (B, KH, C, D)
+    v_cache: jnp.ndarray,    # (B, KH, C, D)
+    cache_lengths: jnp.ndarray,  # (B,) number of valid cache entries
+    sm_scale: float,
+) -> jnp.ndarray:
+    """One decode step against the cache, masking invalid (future) slots."""
+    num_heads, kv_heads = q.shape[1], k_cache.shape[1]
+    if kv_heads != num_heads:
+        reps = num_heads // kv_heads
+        k_cache = jnp.repeat(k_cache, reps, axis=1)
+        v_cache = jnp.repeat(v_cache, reps, axis=1)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache, preferred_element_type=jnp.float32) * sm_scale
+    cache_size = k_cache.shape[2]
+    slot_ids = jnp.arange(cache_size)[None, None, None, :]
+    valid = slot_ids < cache_lengths[:, None, None, None]
+    scores = jnp.where(valid, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v_cache)
+
+
+def multi_head_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    sm_scale: float | None = None,
+    impl: str = "auto",  # auto | pallas | xla
+) -> jnp.ndarray:
+    """Causal self-attention (prefill path)."""
+    head_dim = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = head_dim**-0.5
+    if impl == "pallas" or (impl == "auto" and _pallas_eligible(q, head_dim)):
+        from prime_tpu.ops.pallas_attention import flash_attention_causal
+
+        return flash_attention_causal(q, k, v, sm_scale=sm_scale)
+    return xla_attention_causal(q, k, v, sm_scale)

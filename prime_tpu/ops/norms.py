@@ -1,0 +1,18 @@
+"""RMSNorm (the Llama-family norm).
+
+Computed in float32 regardless of input dtype (bf16 accumulation of squares
+loses precision at d_model >= 4096), cast back on output. XLA fuses this into
+neighboring ops; a pallas kernel buys nothing here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    variance = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    normed = x32 * jnp.reciprocal(jnp.sqrt(variance + eps))
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

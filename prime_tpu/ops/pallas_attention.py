@@ -1,0 +1,132 @@
+"""Flash attention (causal, GQA) as a pallas TPU kernel.
+
+Online-softmax blockwise attention: for each query block, stream key/value
+blocks through VMEM keeping running (max, sum, output) accumulators in fp32
+scratch — O(S) memory instead of the O(S^2) score matrix, and every matmul
+lands on the MXU at (BLOCK, head_dim)x(head_dim, BLOCK) granularity.
+
+Grid: (batch, q_heads, S // BLOCK_Q). GQA is handled in the BlockSpec index
+map: query head h reads kv head h // (H // KH), so grouped KV is never
+materialized per-query-head in HBM.
+
+The causal structure is exploited at the block level: KV blocks strictly above
+the diagonal are skipped (pl.when), halving prefill FLOPs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK_Q = 128
+BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref,      # (BLOCK_Q, D)
+    k_ref,      # (S, D)  one kv head, full length
+    v_ref,      # (S, D)
+    o_ref,      # (BLOCK_Q, D)
+    *,
+    sm_scale: float,
+    seq_len: int,
+    block_k: int,
+):
+    qb = pl.program_id(2)
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * sm_scale  # (BQ, D)
+
+    m = jnp.full((BLOCK_Q, 1), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((BLOCK_Q, 1), dtype=jnp.float32)
+    acc = jnp.zeros(q.shape, dtype=jnp.float32)
+
+    q_positions = qb * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, block_k), 0)
+
+    num_k_blocks = pl.cdiv(seq_len, block_k)
+
+    def body(kb, carry):
+        m_prev, l_prev, acc_prev = carry
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (BQ, BK)
+        kv_positions = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, block_k), 1)
+        scores = jnp.where(kv_positions <= q_positions, scores, NEG_INF)
+
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc_prev * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    # causal block skip: kv blocks entirely above the diagonal contribute nothing
+    last_block = jnp.minimum(qb + 1, num_k_blocks)  # blocks [0, last_block) are live
+    m, l, acc = jax.lax.fori_loop(0, last_block, body, (m, l, acc))
+
+    o_ref[0, 0, :, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def flash_attention_causal(
+    q: jnp.ndarray,  # (B, H, S, D)
+    k: jnp.ndarray,  # (B, KH, S, D)
+    v: jnp.ndarray,  # (B, KH, S, D)
+    sm_scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Causal flash attention. S must be a multiple of BLOCK_Q; D a multiple
+    of 128 (pad upstream). Returns (B, H, S, D) in q.dtype."""
+    batch, num_heads, seq_len, head_dim = q.shape
+    kv_heads = k.shape[1]
+    assert num_heads % kv_heads == 0, "query heads must be a multiple of kv heads"
+    group = num_heads // kv_heads
+    if sm_scale is None:
+        sm_scale = head_dim**-0.5
+
+    grid = (batch, num_heads, pl.cdiv(seq_len, BLOCK_Q))
+    block_k = min(BLOCK_K, seq_len)
+
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, seq_len=seq_len, block_k=block_k
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, BLOCK_Q, head_dim),
+                lambda b, h, qb: (b, h, qb, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, seq_len, head_dim),
+                lambda b, h, qb: (b, h // group, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, seq_len, head_dim),
+                lambda b, h, qb: (b, h // group, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, BLOCK_Q, head_dim),
+            lambda b, h, qb: (b, h, qb, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 2 * batch * num_heads * seq_len * seq_len * head_dim // 2,  # causal half
+            bytes_accessed=(q.size + k.size * group + v.size * group + q.size) * q.dtype.itemsize,
+            transcendentals=batch * num_heads * seq_len * seq_len // 2,
+        ),
+        interpret=interpret,
+    )(q, k, v)

@@ -1,0 +1,35 @@
+"""Rotary position embeddings (RoPE), Llama-3 style.
+
+Frequencies are precomputed once per (head_dim, theta) and applied with a
+position-indexed gather so the same code path serves prefill (positions
+0..S-1) and decode (a single running position per sequence).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_positions: int, theta: float = 500000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Return (cos, sin) tables of shape (max_positions, head_dim // 2), float32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    positions = jnp.arange(max_positions, dtype=jnp.float32)
+    angles = jnp.outer(positions, inv_freq)  # (P, D/2)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(
+    x: jnp.ndarray,            # (B, S, H, D) or (B, S, D_total) is NOT accepted — heads explicit
+    positions: jnp.ndarray,    # (B, S) absolute positions
+    cos: jnp.ndarray,          # (P, D/2)
+    sin: jnp.ndarray,          # (P, D/2)
+) -> jnp.ndarray:
+    """Rotate the head dimension of x by its absolute position."""
+    dtype = x.dtype
+    half = x.shape[-1] // 2
+    c = cos[positions][:, :, None, :]  # (B, S, 1, D/2)
+    s = sin[positions][:, :, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    rotated = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return rotated.astype(dtype)

@@ -1,0 +1,71 @@
+"""Device mesh construction for TPU slices.
+
+Axis conventions (used by sharding.py and the trainer):
+- ``dp``   — pure data parallel (gradients all-reduced)
+- ``fsdp`` — data parallel with parameter sharding (ZeRO-3 style; XLA turns
+  the annotations into reduce-scatter/all-gather over ICI)
+- ``tp``   — tensor parallel (megatron-style head/ff sharding)
+- ``sp``   — sequence parallel (ring attention, prime_tpu.parallel.ring_attention)
+
+``mesh_for_slice`` maps a provisioned TPU slice (SliceSpec) to a mesh whose
+axis order puts tp innermost so tensor-parallel collectives ride the
+fastest ICI dimension.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from prime_tpu.parallel.topology import SliceSpec, parse_slice
+
+
+def make_mesh(axes: dict[str, int] | None = None, devices=None):
+    """Build a jax.sharding.Mesh with named axes.
+
+    ``axes`` maps axis name -> size; sizes must multiply to the device count.
+    Default: all devices on a single ``dp`` axis.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if axes is None:
+        axes = {"dp": n}
+    total = math.prod(axes.values())
+    if total != n:
+        raise ValueError(
+            f"Mesh axes {axes} multiply to {total}, but {n} devices are available"
+        )
+    device_array = np.asarray(devices).reshape(*axes.values())
+    return Mesh(device_array, tuple(axes))
+
+
+def mesh_for_slice(
+    slice_name: str | SliceSpec,
+    tensor_parallel: int | None = None,
+    fsdp: int | None = None,
+    devices=None,
+):
+    """Derive a (dp, fsdp, tp) mesh for a TPU slice.
+
+    Default policy: tp = min(chips, 8 aligned to the slice's minor ICI dim),
+    fsdp = remaining chips, dp = 1. Multi-slice DCN data parallelism belongs on
+    an outer ``dp`` axis (see prime_tpu.parallel.distributed).
+    """
+    import jax
+
+    spec = parse_slice(slice_name) if isinstance(slice_name, str) else slice_name
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if tensor_parallel is None:
+        minor = min(int(d) for d in spec.topology.split("x") if int(d) > 1) if spec.chips > 1 else 1
+        tensor_parallel = min(8, minor if minor > 1 else 1, n)
+        while n % tensor_parallel:
+            tensor_parallel //= 2
+    if fsdp is None:
+        fsdp = n // tensor_parallel
+    dp = n // (fsdp * tensor_parallel)
+    return make_mesh({"dp": dp, "fsdp": fsdp, "tp": tensor_parallel}, devices)

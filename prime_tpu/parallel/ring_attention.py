@@ -1,0 +1,110 @@
+"""Ring attention: causal self-attention with the sequence sharded over ICI.
+
+Long-context first-class citizen: sequences larger than one chip's HBM are
+sharded along an ``sp`` mesh axis. Each device holds a local (B, H, S/P, D)
+block of q/k/v; KV blocks rotate around the ring via ``lax.ppermute`` while
+every device folds each visiting block into an online-softmax accumulator
+(the same math as the pallas flash kernel, lifted to the inter-chip level).
+P-1 rotations fully overlap compute with ICI transfers under XLA's async
+collective scheduling.
+
+Causality across the ring: device i owns global positions
+[i*S_local, (i+1)*S_local). A visiting KV block from source device j is
+- fully visible if j < i,
+- causally masked within the block if j == i,
+- fully masked if j > i (the where-mask zeroes it; its transfer cost is the
+  price of the symmetric schedule).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _ring_attention_local(
+    q: jnp.ndarray,  # (B, H, S_local, D) — this device's block
+    k: jnp.ndarray,  # (B, KH, S_local, D)
+    v: jnp.ndarray,
+    axis_name: str,
+    sm_scale: float,
+):
+    axis_size = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    batch, heads, s_local, head_dim = q.shape
+    kv_heads = k.shape[1]
+    if kv_heads != heads:
+        reps = heads // kv_heads
+        k = jnp.repeat(k, reps, axis=1)
+        v = jnp.repeat(v, reps, axis=1)
+
+    q32 = q.astype(jnp.float32) * sm_scale
+    q_pos = my_index * s_local + jnp.arange(s_local)  # global positions of my queries
+
+    m = jnp.full((batch, heads, s_local, 1), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((batch, heads, s_local, 1), dtype=jnp.float32)
+    acc = jnp.zeros((batch, heads, s_local, head_dim), dtype=jnp.float32)
+
+    def fold(carry, kv_block, source_index):
+        m_prev, l_prev, acc_prev = carry
+        k_blk, v_blk = kv_block
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+        kv_pos = source_index * s_local + jnp.arange(s_local)
+        visible = kv_pos[None, :] <= q_pos[:, None]  # (S_local, S_local) global causal mask
+        scores = jnp.where(visible[None, None], scores, NEG_INF)
+
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc_prev * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    # step 0: my own block; then rotate kv around the ring P-1 times
+    carry = fold((m, l, acc), (k, v), my_index)
+    perm = [(s, (s + 1) % axis_size) for s in range(axis_size)]
+
+    def ring_step(step, state):
+        carry, (k_cur, v_cur) = state
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        # after `step` rotations, I hold the block originally on device my_index - step
+        source = (my_index - step + axis_size) % axis_size
+        carry = fold(carry, (k_nxt, v_nxt), source)
+        return carry, (k_nxt, v_nxt)
+
+    (m, l, acc), _ = jax.lax.fori_loop(
+        1, axis_size, lambda s, st: ring_step(s, st), (carry, (k, v))
+    )
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(
+    q: jnp.ndarray,  # (B, H, S, D) with S sharded on `seq_axis`
+    k: jnp.ndarray,  # (B, KH, S, D)
+    v: jnp.ndarray,
+    mesh,
+    seq_axis: str = "sp",
+    sm_scale: float | None = None,
+) -> jnp.ndarray:
+    """Causal ring attention over a mesh sequence axis (full-array API)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    spec = P(None, None, seq_axis, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=seq_axis, sm_scale=sm_scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

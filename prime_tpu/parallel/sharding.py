@@ -1,0 +1,70 @@
+"""Sharding rules for the Llama param/activation pytrees.
+
+Megatron-style tensor parallelism + ZeRO-3 fsdp, expressed as PartitionSpecs
+over the (dp, fsdp, tp) mesh from prime_tpu.parallel.mesh. XLA inserts the
+collectives (all-gather for fsdp params, psum for tp partials) — nothing here
+issues communication explicitly.
+
+Layout choices (scaling-book recipe):
+- attention: wq/wk/wv shard the *head* output dim on tp, wo shards its input
+  dim on tp → one psum per attention block;
+- mlp: w_gate/w_up shard d_ff on tp, w_down shards d_ff on tp → one psum;
+- fsdp shards the other (d_model / vocab) dim of every large matrix;
+- norms are replicated (tiny);
+- batch is sharded over (dp, fsdp) jointly — fsdp is also a data axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from prime_tpu.models.config import ModelConfig
+
+
+def param_specs(config: ModelConfig) -> dict[str, Any]:
+    specs: dict[str, Any] = {
+        "embed": P("tp", "fsdp"),              # (V, D) vocab on tp, d_model on fsdp
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "tp"),
+            "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, "fsdp", "tp"),
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),
+        },
+        "final_norm": P(None),
+    }
+    if not config.tie_embeddings:
+        specs["lm_head"] = P("fsdp", "tp")
+    return specs
+
+
+def batch_spec() -> P:
+    return P(("dp", "fsdp"), None)
+
+
+def logits_spec() -> P:
+    return P(("dp", "fsdp"), None, "tp")
+
+
+def param_shardings(mesh, config: ModelConfig):
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(config),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params, mesh, config: ModelConfig):
+    """Place a param pytree onto the mesh (device_put with NamedShardings)."""
+    return jax.device_put(params, param_shardings(mesh, config))
+
+
+def shard_batch(batch, mesh):
+    return jax.device_put(batch, NamedSharding(mesh, batch_spec()))

@@ -1,0 +1,17 @@
+from prime_tpu.train.trainer import (
+    TrainState,
+    cross_entropy_loss,
+    default_optimizer,
+    init_train_state,
+    make_train_step,
+    shard_train_state,
+)
+
+__all__ = [
+    "TrainState",
+    "cross_entropy_loss",
+    "default_optimizer",
+    "init_train_state",
+    "make_train_step",
+    "shard_train_state",
+]

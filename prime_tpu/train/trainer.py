@@ -1,0 +1,109 @@
+"""Sharded training step for the Llama stack.
+
+The reference platform dispatches training server-side (SURVEY.md §2.10); the
+TPU-native framework carries its own compute path, so fine-tuning runs on the
+slices this CLI provisions. One jitted train step, sharded via NamedShardings
+over the (dp, fsdp, tp) mesh: XLA emits reduce-scatter/all-gather for fsdp and
+psums for tp over ICI.
+
+bf16 params/activations, fp32 optimizer state and loss; optional
+``jax.checkpoint`` rematerialization around the layer scan comes from the
+model's scan structure (XLA remats scan bodies well by default).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from prime_tpu.models.config import ModelConfig
+from prime_tpu.models.llama import forward
+from prime_tpu.parallel.sharding import param_shardings
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def cross_entropy_loss(
+    logits: jnp.ndarray,   # (B, S, V) fp32
+    targets: jnp.ndarray,  # (B, S) int32
+    mask: jnp.ndarray,     # (B, S) 1.0 for real tokens
+) -> jnp.ndarray:
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    token_ll = jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    return -jnp.sum(token_ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def default_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+def init_train_state(params, optimizer: optax.GradientTransformation) -> TrainState:
+    return TrainState(params=params, opt_state=optimizer.init(params), step=jnp.zeros((), jnp.int32))
+
+
+def make_train_step(
+    config: ModelConfig,
+    optimizer: optax.GradientTransformation,
+    attn_impl: str = "auto",
+):
+    """Build the jitted train step. Shardings propagate from the placed
+    inputs (shard_train_state / shard_batch) — the jit is mesh-agnostic."""
+
+    def loss_fn(params, tokens, targets, mask):
+        logits, _ = forward(params, tokens, config, cache=None, attn_impl=attn_impl)
+        return cross_entropy_loss(logits, targets, mask)
+
+    def train_step(state: TrainState, tokens, targets, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, targets, mask)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(new_params, new_opt_state, state.step + 1)
+        return new_state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+def shard_train_state(state: TrainState, mesh, config: ModelConfig) -> TrainState:
+    """Place a TrainState onto the mesh: params per the megatron/fsdp specs,
+    optimizer moments mirroring their param's sharding, scalars replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    p_shardings = param_shardings(mesh, config)
+    replicated = NamedSharding(mesh, P())
+    params = jax.device_put(state.params, p_shardings)
+
+    # Optimizer moments (adam mu/nu) are param-structured subtrees — place
+    # them with the params' shardings BY TREE POSITION. (Matching by shape is
+    # wrong: wq and wo have identical shapes whenever n_heads*head_dim ==
+    # d_model — every llama preset — but transposed PartitionSpecs.)
+    param_struct = jax.tree.structure(state.params)
+
+    def place_subtree(node):
+        if jax.tree.structure(node) == param_struct:
+            return jax.device_put(node, p_shardings)
+        return jax.tree.map(lambda leaf: jax.device_put(leaf, replicated), node)
+
+    opt_state = jax.tree.map(
+        place_subtree,
+        state.opt_state,
+        is_leaf=lambda n: jax.tree.structure(n) == param_struct,
+    )
+    step = jax.device_put(state.step, replicated)
+    return TrainState(params=params, opt_state=opt_state, step=step)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "attn_impl"))
+def eval_loss(params, tokens, targets, mask, config: ModelConfig, attn_impl: str = "auto"):
+    logits, _ = forward(params, tokens, config, cache=None, attn_impl=attn_impl)
+    return cross_entropy_loss(logits, targets, mask)

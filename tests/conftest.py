@@ -10,10 +10,18 @@
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The environment may preload jax and initialize a TPU backend at interpreter
+# start (sitecustomize); env vars alone are then too late. jax.config still
+# switches the active platform, and the CPU client initializes lazily with the
+# XLA_FLAGS above — giving the 8 virtual devices regardless of preload.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
