@@ -1,0 +1,169 @@
+"""`prime eval` — run local JAX evals, push results, browse the hub.
+
+Reference surface: prime_cli/commands/evals.py:1392 (run: local passthrough
+or --hosted), :1182 (push), list/get/samples. The local path here drives the
+native JAX runner instead of shelling out to the `verifiers` package — the
+runner keeps the same env-resolution → execute → results-dir → upload
+architecture (SURVEY.md §3.3), so hub pushes stay contract-compatible.
+"""
+
+from __future__ import annotations
+
+import click
+
+import prime_tpu.commands._deps as deps
+from prime_tpu.core.client import APIClient
+from prime_tpu.evals import EvalsClient
+from prime_tpu.utils.render import Renderer, output_options
+from prime_tpu.utils.short_id import shorten
+
+
+@click.group(name="eval")
+def eval_group() -> None:
+    """Run and manage model evaluations."""
+
+
+def build_evals_client() -> EvalsClient:
+    api = APIClient(config=deps.build_config(), transport=deps.transport_override)
+    return EvalsClient(api)
+
+
+@eval_group.command("run")
+@click.argument("env")
+@click.option("--model", "-m", required=True, help="Model preset or local HF checkpoint dir.")
+@click.option("--dataset", default=None, help="Local jsonl dataset (gsm8k format).")
+@click.option("--limit", "-n", type=int, default=64)
+@click.option("--batch-size", "-b", type=int, default=8)
+@click.option("--max-new-tokens", type=int, default=256)
+@click.option("--temperature", "-t", type=float, default=0.0)
+@click.option("--checkpoint", default=None, help="Local HF checkpoint dir for weights.")
+@click.option("--tokenizer", default=None, help="Tokenizer name/path (default: from checkpoint, else byte).")
+@click.option("--output-dir", default="outputs/evals")
+@click.option("--push/--no-push", "do_push", default=True, help="Push results to the Evals Hub.")
+@output_options
+def run_eval_cmd(
+    render: Renderer,
+    env: str,
+    model: str,
+    dataset: str | None,
+    limit: int,
+    batch_size: int,
+    max_new_tokens: int,
+    temperature: float,
+    checkpoint: str | None,
+    tokenizer: str | None,
+    output_dir: str,
+    do_push: bool,
+) -> None:
+    """Run ENV against a model on the local TPU and push the results."""
+    from prime_tpu.evals.runner import EvalRunSpec, push_eval_results, run_eval
+
+    spec = EvalRunSpec(
+        env=env,
+        model=model,
+        dataset_path=dataset,
+        limit=limit,
+        batch_size=batch_size,
+        max_new_tokens=max_new_tokens,
+        temperature=temperature,
+        checkpoint=checkpoint,
+        tokenizer=tokenizer,
+        output_dir=output_dir,
+    )
+
+    def progress(done: int, total: int) -> None:
+        render.message(f"  {done}/{total} samples")
+
+    render.message(f"Running {env} with {model} (limit {limit}, batch {batch_size})...")
+    try:
+        result = run_eval(spec, progress=progress)
+    except (ValueError, FileNotFoundError) as e:
+        raise click.ClickException(str(e)) from None
+    payload = {
+        "runDir": str(result.run_dir),
+        "metrics": result.metrics,
+    }
+    if do_push:
+        eval_id, metrics = push_eval_results(result.run_dir, build_evals_client())
+        payload["evalId"] = eval_id
+        render.message(f"Pushed to hub: {shorten(eval_id)}")
+    if render.is_json:
+        render.json(payload)
+    else:
+        render.message(
+            f"accuracy={result.metrics['accuracy']:.3f} "
+            f"samples/sec={result.metrics['samples_per_sec']:.2f} "
+            f"({int(result.metrics['num_samples'])} samples) -> {result.run_dir}"
+        )
+
+
+@eval_group.command("push")
+@click.option("--run-dir", default=None, help="Specific run dir (default: newest under outputs/evals).")
+@click.option("--env", default=None)
+@click.option("--model", default=None)
+@click.option("--output-dir", default="outputs/evals")
+@output_options
+def push_cmd(
+    render: Renderer, run_dir: str | None, env: str | None, model: str | None, output_dir: str
+) -> None:
+    """Push a finished eval run directory to the Evals Hub."""
+    from prime_tpu.evals.runner import find_latest_run, push_eval_results
+
+    try:
+        target = run_dir or find_latest_run(output_dir, env=env, model=model)
+    except FileNotFoundError as e:
+        raise click.ClickException(str(e)) from None
+    eval_id, metrics = push_eval_results(target, build_evals_client())
+    if render.is_json:
+        render.json({"evalId": eval_id, "metrics": metrics, "runDir": str(target)})
+    else:
+        render.message(f"Pushed {target} as {shorten(eval_id)}: {metrics}")
+
+
+@eval_group.command("list")
+@click.option("--env", default=None)
+@output_options
+def list_cmd(render: Renderer, env: str | None) -> None:
+    evaluations = build_evals_client().list_evaluations(env=env)
+    render.table(
+        ["ID", "ENV", "MODEL", "STATUS", "SAMPLES", "ACCURACY"],
+        [
+            [
+                shorten(e.eval_id),
+                shorten(e.env_id),
+                e.model,
+                e.status,
+                e.sample_count,
+                f"{e.metrics.get('accuracy', 0):.3f}" if e.metrics else "",
+            ]
+            for e in evaluations
+        ],
+        title="Evaluations",
+        json_rows=[e.model_dump(by_alias=True) for e in evaluations],
+    )
+
+
+@eval_group.command("get")
+@click.argument("eval_id")
+@output_options
+def get_cmd(render: Renderer, eval_id: str) -> None:
+    evaluation = build_evals_client().get_evaluation(eval_id)
+    render.detail(evaluation.model_dump(by_alias=True), title=f"Evaluation {shorten(eval_id)}")
+
+
+@eval_group.command("samples")
+@click.argument("eval_id")
+@click.option("--limit", type=int, default=20)
+@click.option("--offset", type=int, default=0)
+@output_options
+def samples_cmd(render: Renderer, eval_id: str, limit: int, offset: int) -> None:
+    samples = build_evals_client().get_samples(eval_id, limit=limit, offset=offset)
+    render.table(
+        ["ID", "CORRECT", "ANSWER", "COMPLETION"],
+        [
+            [s.sample_id, "Y" if s.correct else "n", s.answer or "", (s.completion or "")[:60]]
+            for s in samples
+        ],
+        title=f"Samples for {shorten(eval_id)}",
+        json_rows=[s.model_dump(by_alias=True) for s in samples],
+    )

@@ -1,0 +1,23 @@
+"""prime-tpu evals SDK + native JAX eval runner.
+
+SDK capability parity with prime-evals (SURVEY.md §2.4): environment
+resolution, evaluation lifecycle, adaptive batched sample upload. The runner
+(prime_tpu.evals.runner) replaces the reference's external `verifiers`
+subprocess with a native JAX backend: pjit-sharded generation on the TPU
+slice, scoring, results.jsonl/metadata.json output, hub push.
+"""
+
+from prime_tpu.evals.client import AsyncEvalsClient, EvalsClient
+from prime_tpu.evals.models import (
+    CreateEvaluationRequest,
+    Evaluation,
+    EvalSample,
+)
+
+__all__ = [
+    "EvalsClient",
+    "AsyncEvalsClient",
+    "Evaluation",
+    "EvalSample",
+    "CreateEvaluationRequest",
+]

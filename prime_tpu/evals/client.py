@@ -1,0 +1,281 @@
+"""Evals Hub clients (reference: prime_evals/evals.py:38-757).
+
+Capabilities:
+- environment resolution: explicit ``env_...`` id → direct lookup;
+  ``owner/slug`` → slug lookup; bare name → get-or-create;
+- evaluation lifecycle: create / get / list / finalize, sample paging;
+- **adaptive batched sample upload** (reference :219-295): samples are packed
+  into size-capped JSON batches (25 MiB), uploaded with bounded concurrency
+  (ThreadPoolExecutor sync / anyio task group async, 4 workers) and 429-aware
+  retry (5 attempts, exp backoff 1-16 s honoring Retry-After), reporting
+  progress via callback.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable
+
+from prime_tpu.core.client import APIClient, AsyncAPIClient
+from prime_tpu.core.exceptions import NotFoundError, RateLimitError
+from prime_tpu.evals.models import CreateEvaluationRequest, EvalEnvironment, Evaluation, EvalSample
+
+MAX_BATCH_BYTES = 25 * 1024 * 1024
+UPLOAD_WORKERS = 4
+RATE_LIMIT_ATTEMPTS = 5
+RATE_LIMIT_BACKOFF_S = (1, 2, 4, 8, 16)
+
+
+def build_batches(
+    samples: list[dict[str, Any]], max_bytes: int = MAX_BATCH_BYTES
+) -> list[list[dict[str, Any]]]:
+    """Pack samples into batches under the JSON size cap (reference :288).
+
+    An oversized single sample still ships alone (the backend rejects it with
+    a clear error rather than us silently dropping it).
+    """
+    import json
+
+    batches: list[list[dict[str, Any]]] = []
+    current: list[dict[str, Any]] = []
+    current_bytes = 2  # []
+    for sample in samples:
+        size = len(json.dumps(sample, default=str)) + 1
+        if current and current_bytes + size > max_bytes:
+            batches.append(current)
+            current = []
+            current_bytes = 2
+        current.append(sample)
+        current_bytes += size
+    if current:
+        batches.append(current)
+    return batches
+
+
+def _retry_delay(e: RateLimitError, attempt: int) -> float:
+    # Retry-After: 0 is a server-directed IMMEDIATE retry, not "absent"
+    return e.retry_after if e.retry_after is not None else RATE_LIMIT_BACKOFF_S[attempt]
+
+
+def _retry_429(fn: Callable[[], Any]) -> Any:
+    for attempt in range(RATE_LIMIT_ATTEMPTS):
+        try:
+            return fn()
+        except RateLimitError as e:
+            if attempt == RATE_LIMIT_ATTEMPTS - 1:
+                raise
+            time.sleep(_retry_delay(e, attempt))
+
+
+async def _retry_429_async(fn: Callable[[], Any]) -> Any:
+    import anyio
+
+    for attempt in range(RATE_LIMIT_ATTEMPTS):
+        try:
+            return await fn()
+        except RateLimitError as e:
+            if attempt == RATE_LIMIT_ATTEMPTS - 1:
+                raise
+            await anyio.sleep(_retry_delay(e, attempt))
+
+
+class EvalsClient:
+    def __init__(self, client: APIClient | None = None) -> None:
+        self.api = client or APIClient()
+
+    # -- environment resolution ---------------------------------------------
+
+    def resolve_environment(self, env: str) -> EvalEnvironment:
+        if env.startswith("env_"):
+            return EvalEnvironment.model_validate(self.api.get(f"/evals/environments/{env}"))
+        if "/" in env:
+            owner, slug = env.split("/", 1)
+            data = self.api.get("/evals/environments", params={"owner": owner, "slug": slug})
+            items = data.get("items", []) if isinstance(data, dict) else data
+            if not items:
+                raise NotFoundError(f"No eval environment {env!r}")
+            return EvalEnvironment.model_validate(items[0])
+        # bare name: get-or-create
+        data = self.api.get("/evals/environments", params={"name": env})
+        items = data.get("items", []) if isinstance(data, dict) else data
+        if items:
+            return EvalEnvironment.model_validate(items[0])
+        created = self.api.post("/evals/environments", json={"name": env}, idempotent_post=True)
+        return EvalEnvironment.model_validate(created)
+
+    # -- evaluation lifecycle -----------------------------------------------
+
+    def create_evaluation(self, request: CreateEvaluationRequest) -> Evaluation:
+        environment = self.resolve_environment(request.env)
+        data = self.api.post(
+            "/evals/evaluations",
+            json={
+                "envId": environment.env_id,
+                "model": request.model,
+                "metadata": request.metadata,
+            },
+            idempotent_post=True,
+        )
+        return Evaluation.model_validate(data)
+
+    def get_evaluation(self, eval_id: str) -> Evaluation:
+        return Evaluation.model_validate(self.api.get(f"/evals/evaluations/{eval_id}"))
+
+    def list_evaluations(self, env: str | None = None, limit: int = 50) -> list[Evaluation]:
+        params: dict[str, Any] = {"limit": limit}
+        if env:
+            params["envId"] = self.resolve_environment(env).env_id
+        data = self.api.get("/evals/evaluations", params=params)
+        items = data.get("items", []) if isinstance(data, dict) else data
+        return [Evaluation.model_validate(e) for e in items]
+
+    def get_samples(self, eval_id: str, limit: int = 100, offset: int = 0) -> list[EvalSample]:
+        data = self.api.get(
+            f"/evals/evaluations/{eval_id}/samples", params={"limit": limit, "offset": offset}
+        )
+        items = data.get("items", []) if isinstance(data, dict) else data
+        return [EvalSample.model_validate(s) for s in items]
+
+    def finalize_evaluation(self, eval_id: str, metrics: dict[str, float]) -> Evaluation:
+        data = self.api.post(
+            f"/evals/evaluations/{eval_id}/finalize", json={"metrics": metrics}, idempotent_post=True
+        )
+        return Evaluation.model_validate(data)
+
+    # -- batched sample upload ----------------------------------------------
+
+    def push_samples(
+        self,
+        eval_id: str,
+        samples: Iterable[EvalSample | dict[str, Any]],
+        progress: Callable[[int, int], None] | None = None,
+        workers: int = UPLOAD_WORKERS,
+        max_batch_bytes: int = MAX_BATCH_BYTES,
+    ) -> int:
+        rows = [
+            s.model_dump(by_alias=True, exclude_none=True) if isinstance(s, EvalSample) else s
+            for s in samples
+        ]
+        if not rows:
+            return 0
+        batches = build_batches(rows, max_bytes=max_batch_bytes)
+        total = len(batches)
+        uploaded = 0
+
+        def upload(batch: list[dict[str, Any]]) -> None:
+            _retry_429(
+                lambda: self.api.post(
+                    f"/evals/evaluations/{eval_id}/samples",
+                    json={"samples": batch},
+                    idempotent_post=True,
+                )
+            )
+
+        with ThreadPoolExecutor(max_workers=min(workers, total)) as pool:
+            for _ in pool.map(upload, batches):
+                uploaded += 1
+                if progress:
+                    progress(uploaded, total)
+        return len(rows)
+
+
+class AsyncEvalsClient:
+    """Async mirror (anyio task group + CapacityLimiter instead of threads)."""
+
+    def __init__(self, client: AsyncAPIClient | None = None) -> None:
+        self.api = client or AsyncAPIClient()
+
+    async def resolve_environment(self, env: str) -> EvalEnvironment:
+        if env.startswith("env_"):
+            return EvalEnvironment.model_validate(await self.api.get(f"/evals/environments/{env}"))
+        if "/" in env:
+            owner, slug = env.split("/", 1)
+            data = await self.api.get("/evals/environments", params={"owner": owner, "slug": slug})
+            items = data.get("items", []) if isinstance(data, dict) else data
+            if not items:
+                raise NotFoundError(f"No eval environment {env!r}")
+            return EvalEnvironment.model_validate(items[0])
+        data = await self.api.get("/evals/environments", params={"name": env})
+        items = data.get("items", []) if isinstance(data, dict) else data
+        if items:
+            return EvalEnvironment.model_validate(items[0])
+        created = await self.api.post("/evals/environments", json={"name": env}, idempotent_post=True)
+        return EvalEnvironment.model_validate(created)
+
+    async def create_evaluation(self, request: CreateEvaluationRequest) -> Evaluation:
+        environment = await self.resolve_environment(request.env)
+        data = await self.api.post(
+            "/evals/evaluations",
+            json={
+                "envId": environment.env_id,
+                "model": request.model,
+                "metadata": request.metadata,
+            },
+            idempotent_post=True,
+        )
+        return Evaluation.model_validate(data)
+
+    async def get_evaluation(self, eval_id: str) -> Evaluation:
+        return Evaluation.model_validate(await self.api.get(f"/evals/evaluations/{eval_id}"))
+
+    async def finalize_evaluation(self, eval_id: str, metrics: dict[str, float]) -> Evaluation:
+        data = await self.api.post(
+            f"/evals/evaluations/{eval_id}/finalize", json={"metrics": metrics}, idempotent_post=True
+        )
+        return Evaluation.model_validate(data)
+
+    async def list_evaluations(self, env: str | None = None, limit: int = 50) -> list[Evaluation]:
+        params: dict[str, Any] = {"limit": limit}
+        if env:
+            params["envId"] = (await self.resolve_environment(env)).env_id
+        data = await self.api.get("/evals/evaluations", params=params)
+        items = data.get("items", []) if isinstance(data, dict) else data
+        return [Evaluation.model_validate(e) for e in items]
+
+    async def get_samples(self, eval_id: str, limit: int = 100, offset: int = 0) -> list[EvalSample]:
+        data = await self.api.get(
+            f"/evals/evaluations/{eval_id}/samples", params={"limit": limit, "offset": offset}
+        )
+        items = data.get("items", []) if isinstance(data, dict) else data
+        return [EvalSample.model_validate(s) for s in items]
+
+    async def push_samples(
+        self,
+        eval_id: str,
+        samples: Iterable[EvalSample | dict[str, Any]],
+        progress: Callable[[int, int], None] | None = None,
+        workers: int = UPLOAD_WORKERS,
+        max_batch_bytes: int = MAX_BATCH_BYTES,
+    ) -> int:
+        import anyio
+
+        rows = [
+            s.model_dump(by_alias=True, exclude_none=True) if isinstance(s, EvalSample) else s
+            for s in samples
+        ]
+        if not rows:
+            return 0
+        batches = build_batches(rows, max_bytes=max_batch_bytes)
+        total = len(batches)
+        done = 0
+        limiter = anyio.CapacityLimiter(min(workers, total))
+
+        async def upload(batch: list[dict[str, Any]]) -> None:
+            nonlocal done
+            async with limiter:
+                await _retry_429_async(
+                    lambda: self.api.post(
+                        f"/evals/evaluations/{eval_id}/samples",
+                        json={"samples": batch},
+                        idempotent_post=True,
+                    )
+                )
+            done += 1
+            if progress:
+                progress(done, total)
+
+        async with anyio.create_task_group() as tg:
+            for batch in batches:
+                tg.start_soon(upload, batch)
+        return len(rows)

@@ -1,0 +1,92 @@
+"""Eval datasets: gsm8k loading + scoring, synthetic arithmetic for tests.
+
+gsm8k records are {"question": str, "answer": "...#### <number>"}; scoring is
+exact match on the final extracted number (the standard gsm8k protocol).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+_NUMBER_RE = re.compile(r"-?\$?[\d,]*\.?\d+")
+
+
+@dataclass
+class EvalExample:
+    question: str
+    answer: str        # gold final answer (normalized string)
+    prompt: str        # formatted prompt fed to the model
+
+
+GSM8K_TEMPLATE = (
+    "Question: {question}\n"
+    "Answer: Let's think step by step."
+)
+
+
+def normalize_number(text: str) -> str | None:
+    matches = _NUMBER_RE.findall(text.replace(",", ""))
+    if not matches:
+        return None
+    value = matches[-1].lstrip("$")
+    try:
+        f = float(value)
+        return str(int(f)) if f == int(f) else str(f)
+    except ValueError:
+        return None
+
+
+def extract_gold_answer(answer_field: str) -> str | None:
+    """gsm8k gold answers end with '#### <number>'."""
+    if "####" in answer_field:
+        return normalize_number(answer_field.split("####")[-1])
+    return normalize_number(answer_field)
+
+
+def score_completion(completion: str, gold: str) -> bool:
+    predicted = normalize_number(completion)
+    return predicted is not None and predicted == gold
+
+
+def load_gsm8k(path: str | Path, limit: int | None = None) -> list[EvalExample]:
+    """Load gsm8k-format jsonl from disk (zero-egress: data ships with envs)."""
+    examples = []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            gold = extract_gold_answer(row["answer"])
+            if gold is None:
+                continue
+            examples.append(
+                EvalExample(
+                    question=row["question"],
+                    answer=gold,
+                    prompt=GSM8K_TEMPLATE.format(question=row["question"]),
+                )
+            )
+            if limit and len(examples) >= limit:
+                break
+    return examples
+
+
+def synthetic_arithmetic(n: int, seed: int = 0) -> list[EvalExample]:
+    """Hermetic gsm8k-shaped problems for tests and dry runs."""
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        a, b = rng.randint(2, 99), rng.randint(2, 99)
+        question = f"Tom has {a} apples and buys {b} more. How many apples does he have?"
+        out.append(
+            EvalExample(
+                question=question,
+                answer=str(a + b),
+                prompt=GSM8K_TEMPLATE.format(question=question),
+            )
+        )
+    return out

@@ -1,0 +1,49 @@
+"""Evals Hub pydantic models (reference: prime_evals/models.py:8-135)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+class EvalEnvironment(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    env_id: str = Field(alias="envId")
+    name: str
+    owner: str | None = None
+    slug: str | None = None
+
+
+class Evaluation(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    eval_id: str = Field(alias="evalId")
+    env_id: str = Field(alias="envId")
+    model: str
+    status: str = "RUNNING"          # RUNNING|FINALIZED|FAILED
+    sample_count: int = Field(default=0, alias="sampleCount")
+    metrics: dict[str, float] = Field(default_factory=dict)
+    created_at: str | None = Field(default=None, alias="createdAt")
+    metadata: dict[str, Any] = Field(default_factory=dict)
+
+
+class EvalSample(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    sample_id: str | None = Field(default=None, alias="sampleId")
+    prompt: str = ""
+    completion: str = ""
+    answer: str | None = None
+    reward: float | None = None
+    correct: bool | None = None
+    info: dict[str, Any] = Field(default_factory=dict)
+
+
+class CreateEvaluationRequest(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    env: str                           # id, owner/slug, or bare name (get-or-create)
+    model: str
+    metadata: dict[str, Any] = Field(default_factory=dict)

@@ -1,0 +1,241 @@
+"""Native JAX eval runner: the verifiers role, TPU-first (SURVEY.md §7 st.5).
+
+Pipeline (north-star workload, BASELINE.md):
+  resolve dataset → batch prompts → pjit-sharded generate on the TPU slice
+  → score → write outputs/evals/{env}--{model}/<run>/ (metadata.json +
+  results.jsonl, the reference's results contract) → push to the Evals Hub
+  (prime_tpu.evals.client batched upload; reference utils/eval_push.py:54).
+
+The model provider is pluggable: ``JaxGenerator`` drives the native stack
+(HF checkpoint or random-init architecture); tests inject an oracle provider.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Protocol
+
+from prime_tpu.evals.datasets import EvalExample, load_gsm8k, score_completion, synthetic_arithmetic
+from prime_tpu.evals.models import CreateEvaluationRequest, EvalSample
+from prime_tpu.evals.tokenizer import Tokenizer, load_tokenizer
+
+
+class Generator(Protocol):
+    def generate(self, prompts: list[str], max_new_tokens: int, temperature: float) -> list[str]: ...
+
+
+@dataclass
+class EvalRunSpec:
+    env: str = "gsm8k"
+    model: str = "llama3-8b"
+    dataset_path: str | None = None      # None -> synthetic arithmetic
+    limit: int | None = 64
+    batch_size: int = 8
+    max_new_tokens: int = 256
+    temperature: float = 0.0
+    output_dir: str = "outputs/evals"
+    checkpoint: str | None = None        # local HF checkpoint dir
+    tokenizer: str | None = None         # tokenizer name/path; None -> byte fallback
+    metadata: dict = field(default_factory=dict)
+
+
+@dataclass
+class EvalRunResult:
+    run_dir: Path
+    metrics: dict[str, float]
+    samples: list[EvalSample]
+
+    @property
+    def accuracy(self) -> float:
+        return self.metrics.get("accuracy", 0.0)
+
+
+class JaxGenerator:
+    """Model provider backed by prime_tpu.models (the native TPU path)."""
+
+    def __init__(
+        self,
+        model: str,
+        checkpoint: str | None = None,
+        tokenizer: str | None = None,
+        dtype=None,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from prime_tpu.models import get_config
+        from prime_tpu.models.llama import init_params
+
+        dtype = dtype or jnp.bfloat16
+        if checkpoint is None and Path(model).is_dir():
+            checkpoint = model  # `-m ./my-checkpoint` means "load this"
+        if checkpoint is not None and not Path(checkpoint).exists():
+            raise ValueError(
+                f"Checkpoint path {checkpoint!r} does not exist — refusing to "
+                "fall back to random weights (results would be garbage)"
+            )
+        self.tokenizer: Tokenizer = load_tokenizer(tokenizer or checkpoint)
+        if checkpoint:
+            from prime_tpu.models.hf_loader import load_hf_checkpoint
+
+            self.params, self.config = load_hf_checkpoint(checkpoint, dtype=dtype)
+        else:
+            self.config = get_config(model)
+            self.params = init_params(jax.random.PRNGKey(0), self.config, dtype=dtype)
+        tok_vocab = getattr(self.tokenizer, "vocab_size", None)
+        if tok_vocab and tok_vocab > self.config.vocab_size:
+            raise ValueError(
+                f"Tokenizer vocab ({tok_vocab}) exceeds model vocab "
+                f"({self.config.vocab_size}) — ids would index out of bounds"
+            )
+        self._rng = jax.random.PRNGKey(0)
+
+    def generate(self, prompts: list[str], max_new_tokens: int, temperature: float) -> list[str]:
+        import jax
+        import jax.numpy as jnp
+
+        from prime_tpu.models.sampler import generate as sample_generate
+
+        if max_new_tokens >= self.config.max_seq_len:
+            raise ValueError(
+                f"max_new_tokens ({max_new_tokens}) must be < the model's "
+                f"max_seq_len ({self.config.max_seq_len})"
+            )
+        keep = self.config.max_seq_len - max_new_tokens
+        encoded = [self.tokenizer.encode(p)[-keep:] for p in prompts]
+        max_len = max(len(e) for e in encoded)
+        pad_id = self.tokenizer.pad_id
+        batch = jnp.asarray(
+            [e + [pad_id] * (max_len - len(e)) for e in encoded], dtype=jnp.int32
+        )
+        lengths = jnp.asarray([len(e) for e in encoded], dtype=jnp.int32)
+        self._rng, rng = jax.random.split(self._rng)
+        result = sample_generate(
+            self.params,
+            batch,
+            lengths,
+            self.config,
+            rng,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            eos_id=self.tokenizer.eos_id,
+            pad_id=pad_id,
+        )
+        tokens = result.tokens.tolist()
+        lens = result.lengths.tolist()
+        return [self.tokenizer.decode(t[:n]) for t, n in zip(tokens, lens)]
+
+
+def run_eval(
+    spec: EvalRunSpec,
+    generator: Generator | None = None,
+    progress: Callable[[int, int], None] | None = None,
+) -> EvalRunResult:
+    if spec.dataset_path:
+        examples = load_gsm8k(spec.dataset_path, limit=spec.limit)
+    else:
+        examples = synthetic_arithmetic(spec.limit or 64)
+    if not examples:
+        raise ValueError(f"No examples loaded from {spec.dataset_path!r}")
+    if generator is None:
+        generator = JaxGenerator(spec.model, checkpoint=spec.checkpoint, tokenizer=spec.tokenizer)
+
+    samples: list[EvalSample] = []
+    t0 = time.monotonic()
+    for start in range(0, len(examples), spec.batch_size):
+        chunk: list[EvalExample] = examples[start : start + spec.batch_size]
+        completions = generator.generate(
+            [e.prompt for e in chunk], spec.max_new_tokens, spec.temperature
+        )
+        for example, completion in zip(chunk, completions):
+            correct = score_completion(completion, example.answer)
+            samples.append(
+                EvalSample(
+                    sample_id=f"s_{len(samples)}",
+                    prompt=example.prompt,
+                    completion=completion,
+                    answer=example.answer,
+                    reward=1.0 if correct else 0.0,
+                    correct=correct,
+                )
+            )
+        if progress:
+            progress(len(samples), len(examples))
+    elapsed = time.monotonic() - t0
+
+    n = len(samples)
+    metrics = {
+        "accuracy": sum(1 for s in samples if s.correct) / n,
+        "samples_per_sec": n / elapsed if elapsed > 0 else 0.0,
+        "num_samples": float(n),
+        "wall_time_s": elapsed,
+    }
+
+    run_id = f"{time.strftime('%Y%m%d-%H%M%S')}-{uuid.uuid4().hex[:8]}"
+    run_dir = Path(spec.output_dir) / f"{spec.env}--{spec.model}" / run_id
+    run_dir.mkdir(parents=True, exist_ok=True)
+    (run_dir / "metadata.json").write_text(
+        json.dumps(
+            {
+                "env": spec.env,
+                "model": spec.model,
+                "metrics": metrics,
+                "spec": {
+                    "limit": spec.limit,
+                    "batch_size": spec.batch_size,
+                    "max_new_tokens": spec.max_new_tokens,
+                    "temperature": spec.temperature,
+                },
+                **spec.metadata,
+            },
+            indent=2,
+        )
+    )
+    with open(run_dir / "results.jsonl", "w") as f:
+        for sample in samples:
+            f.write(json.dumps(sample.model_dump(by_alias=True, exclude_none=True)) + "\n")
+    return EvalRunResult(run_dir=run_dir, metrics=metrics, samples=samples)
+
+
+def find_latest_run(output_dir: str | Path, env: str | None = None, model: str | None = None) -> Path:
+    """Newest outputs/evals/{env}--{model}/<run>/ dir (reference eval_push.py)."""
+    base = Path(output_dir)
+    candidates = []
+    for env_model_dir in base.iterdir() if base.exists() else []:
+        if not env_model_dir.is_dir() or "--" not in env_model_dir.name:
+            continue
+        dir_env, _, dir_model = env_model_dir.name.partition("--")
+        if env and dir_env != env:
+            continue
+        if model and dir_model != model:
+            continue
+        for run_dir in env_model_dir.iterdir():
+            if (run_dir / "metadata.json").exists():
+                candidates.append(run_dir)
+    if not candidates:
+        raise FileNotFoundError(f"No eval runs under {base}")
+    return max(candidates, key=lambda p: p.stat().st_mtime)
+
+
+def push_eval_results(run_dir: str | Path, client) -> "tuple[str, dict]":
+    """Upload a run dir to the Evals Hub: create → push samples → finalize."""
+    run_dir = Path(run_dir)
+    metadata = json.loads((run_dir / "metadata.json").read_text())
+    samples = []
+    with open(run_dir / "results.jsonl") as f:
+        for line in f:
+            if line.strip():
+                samples.append(json.loads(line))
+    evaluation = client.create_evaluation(
+        CreateEvaluationRequest(
+            env=metadata["env"], model=metadata["model"], metadata=metadata.get("spec", {})
+        )
+    )
+    client.push_samples(evaluation.eval_id, samples)
+    metrics = {k: v for k, v in metadata.get("metrics", {}).items() if isinstance(v, (int, float))}
+    client.finalize_evaluation(evaluation.eval_id, metrics)
+    return evaluation.eval_id, metrics

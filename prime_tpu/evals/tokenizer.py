@@ -1,0 +1,73 @@
+"""Tokenizer abstraction for the JAX eval runner.
+
+``load_tokenizer`` prefers a HuggingFace tokenizer (local path or cached
+name); the dependency-free ``ByteTokenizer`` fallback keeps tests and random-
+weight benches hermetic (ids = utf-8 bytes + offset, lossless roundtrip).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+
+class Tokenizer(Protocol):
+    eos_id: int
+    pad_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """Deterministic byte-level tokenizer. ids: 0=pad, 1=bos, 2=eos, byte+3."""
+
+    OFFSET = 3
+
+    def __init__(self) -> None:
+        self.pad_id = 0
+        self.bos_id = 1
+        self.eos_id = 2
+        self.vocab_size = 256 + self.OFFSET
+
+    def encode(self, text: str) -> list[int]:
+        return [self.bos_id] + [b + self.OFFSET for b in text.encode("utf-8")]
+
+    def decode(self, ids: list[int]) -> str:
+        # ids beyond the byte range (possible with models whose vocab is
+        # larger than 259, e.g. random-weight benches) decode to nothing
+        data = bytes(i - self.OFFSET for i in ids if self.OFFSET <= i < self.OFFSET + 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Thin wrapper over a transformers tokenizer."""
+
+    def __init__(self, name_or_path: str) -> None:
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(name_or_path)
+        self.eos_id = self._tok.eos_token_id if self._tok.eos_token_id is not None else -1
+        pad = self._tok.pad_token_id
+        self.pad_id = pad if pad is not None else (self.eos_id if self.eos_id >= 0 else 0)
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def load_tokenizer(name_or_path: str | None) -> Tokenizer:
+    """Load a tokenizer. An explicitly named tokenizer that fails to load is
+    an error (a silent byte fallback would score garbage as real results);
+    only ``None``/``"byte"`` select the hermetic byte tokenizer."""
+    if name_or_path in (None, "byte"):
+        return ByteTokenizer()
+    try:
+        return HFTokenizer(name_or_path)
+    except Exception as e:
+        raise ValueError(
+            f"Could not load tokenizer {name_or_path!r}: {e}. "
+            "Pass --tokenizer byte for the hermetic byte-level tokenizer."
+        ) from e

@@ -1,0 +1,113 @@
+"""HuggingFace Llama checkpoint → prime_tpu param pytree.
+
+Maps the HF ``LlamaForCausalLM`` state dict onto the stacked-layer layout of
+prime_tpu.models.llama (leading n_layers axis per leaf, weights transposed to
+(in, out) for right-multiplication). RoPE conventions match: both use the
+rotate-half formulation with inv_freq = theta^(-2i/d).
+
+Loads from a local directory containing ``*.safetensors`` (or a torch
+``pytorch_model.bin``); zero-egress environments ship checkpoints with pods.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from prime_tpu.models.config import ModelConfig
+
+
+def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
+    return ModelConfig(
+        name=name,
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads", hf_config.num_attention_heads),
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 8192),
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        rms_eps=getattr(hf_config, "rms_norm_eps", 1e-5),
+        tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+    )
+
+
+def _read_state_dict(checkpoint_dir: str | Path) -> dict[str, np.ndarray]:
+    checkpoint_dir = Path(checkpoint_dir)
+    tensors: dict[str, np.ndarray] = {}
+    safetensor_files = sorted(checkpoint_dir.glob("*.safetensors"))
+    if safetensor_files:
+        from safetensors.numpy import load_file
+
+        for file in safetensor_files:
+            tensors.update(load_file(str(file)))
+        return tensors
+    bins = sorted(checkpoint_dir.glob("pytorch_model*.bin"))
+    if bins:
+        import torch
+
+        for file in bins:
+            state = torch.load(str(file), map_location="cpu", weights_only=True)
+            tensors.update({k: v.float().numpy() for k, v in state.items()})
+        return tensors
+    raise FileNotFoundError(f"No *.safetensors or pytorch_model*.bin under {checkpoint_dir}")
+
+
+def params_from_state_dict(
+    state: dict[str, np.ndarray], config: ModelConfig, dtype=jnp.bfloat16
+) -> dict[str, Any]:
+    """Convert an HF LlamaForCausalLM state dict to the stacked param pytree."""
+
+    def get(name: str) -> np.ndarray:
+        for candidate in (name, f"model.{name}"):
+            if candidate in state:
+                return np.asarray(state[candidate])
+        raise KeyError(f"Missing weight {name!r} (have {len(state)} tensors)")
+
+    def stacked(template: str, transpose: bool) -> jnp.ndarray:
+        mats = []
+        for layer in range(config.n_layers):
+            w = get(template.format(layer))
+            mats.append(w.T if transpose else w)
+        return jnp.asarray(np.stack(mats), dtype=dtype)
+
+    params: dict[str, Any] = {
+        "embed": jnp.asarray(get("embed_tokens.weight"), dtype=dtype),
+        "layers": {
+            "attn_norm": stacked("layers.{}.input_layernorm.weight", transpose=False),
+            "wq": stacked("layers.{}.self_attn.q_proj.weight", transpose=True),
+            "wk": stacked("layers.{}.self_attn.k_proj.weight", transpose=True),
+            "wv": stacked("layers.{}.self_attn.v_proj.weight", transpose=True),
+            "wo": stacked("layers.{}.self_attn.o_proj.weight", transpose=True),
+            "mlp_norm": stacked("layers.{}.post_attention_layernorm.weight", transpose=False),
+            "w_gate": stacked("layers.{}.mlp.gate_proj.weight", transpose=True),
+            "w_up": stacked("layers.{}.mlp.up_proj.weight", transpose=True),
+            "w_down": stacked("layers.{}.mlp.down_proj.weight", transpose=True),
+        },
+        "final_norm": jnp.asarray(get("norm.weight"), dtype=dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = jnp.asarray(np.asarray(state["lm_head.weight"]).T, dtype=dtype)
+    return params
+
+
+def load_hf_checkpoint(
+    checkpoint_dir: str | Path, dtype=jnp.bfloat16
+) -> tuple[dict[str, Any], ModelConfig]:
+    """Load (params, config) from a local HF Llama checkpoint directory."""
+    import json
+
+    checkpoint_dir = Path(checkpoint_dir)
+    hf_cfg_raw = json.loads((checkpoint_dir / "config.json").read_text())
+
+    class _Cfg:
+        def __init__(self, d):
+            self.__dict__.update(d)
+
+    config = config_from_hf(_Cfg(hf_cfg_raw), name=checkpoint_dir.name)
+    state = _read_state_dict(checkpoint_dir)
+    return params_from_state_dict(state, config, dtype=dtype), config

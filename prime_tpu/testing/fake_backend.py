@@ -66,9 +66,11 @@ class FakeControlPlane:
         self._routes: list[tuple[str, re.Pattern[str], Callable[..., httpx.Response]]] = []
         self._register_routes()
         self._mounts: list[Callable[[httpx.Request], httpx.Response | None]] = []
+        from prime_tpu.testing.fake_evals_plane import FakeEvalsPlane
         from prime_tpu.testing.fake_sandbox_plane import FakeSandboxPlane
 
         self.sandbox_plane = FakeSandboxPlane(self)
+        self.evals_plane = FakeEvalsPlane(self)
 
     # -- catalog seeding -----------------------------------------------------
 

@@ -1,0 +1,122 @@
+"""Fake Evals Hub routes for the in-process control plane.
+
+Fault knob: ``rate_limit_next = N`` makes the next N sample-upload posts
+return 429 (with Retry-After: 0) — pins the 429-aware upload retry tier.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any
+
+import httpx
+
+from prime_tpu.testing.fake_backend import FakeControlPlane, _json_response
+
+
+class FakeEvalsPlane:
+    def __init__(self, fake: FakeControlPlane) -> None:
+        self.fake = fake
+        self.environments: dict[str, dict[str, Any]] = {}
+        self.evaluations: dict[str, dict[str, Any]] = {}
+        self.samples: dict[str, list[dict[str, Any]]] = {}
+        self.rate_limit_next = 0
+        self.upload_posts = 0
+        self._register()
+
+    def _register(self) -> None:
+        route = self.fake.route
+        plane = self
+
+        @route("GET", r"/evals/environments/(?P<env_id>env_[^/]+)")
+        def get_env(request: httpx.Request, env_id: str) -> httpx.Response:
+            env = plane.environments.get(env_id)
+            if not env:
+                return _json_response(404, {"detail": f"environment {env_id} not found"})
+            return _json_response(200, env)
+
+        @route("GET", r"/evals/environments")
+        def list_envs(request: httpx.Request) -> httpx.Response:
+            params = request.url.params
+            rows = list(plane.environments.values())
+            if params.get("name"):
+                rows = [r for r in rows if r["name"] == params["name"]]
+            if params.get("owner"):
+                rows = [r for r in rows if r.get("owner") == params["owner"]]
+            if params.get("slug"):
+                rows = [r for r in rows if r.get("slug") == params["slug"]]
+            return _json_response(200, {"items": rows, "total": len(rows)})
+
+        @route("POST", r"/evals/environments")
+        def create_env(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            env_id = f"env_{uuid.uuid4().hex[:8]}"
+            env = {
+                "envId": env_id,
+                "name": body["name"],
+                "owner": body.get("owner", "user_1"),
+                "slug": body.get("slug", body["name"]),
+            }
+            plane.environments[env_id] = env
+            return _json_response(200, env)
+
+        @route("POST", r"/evals/evaluations/(?P<eval_id>[^/]+)/samples")
+        def push_samples(request: httpx.Request, eval_id: str) -> httpx.Response:
+            plane.upload_posts += 1
+            if plane.rate_limit_next > 0:
+                plane.rate_limit_next -= 1
+                return _json_response(429, {"detail": "rate limited"}, {"Retry-After": "0"})
+            ev = plane.evaluations.get(eval_id)
+            if not ev:
+                return _json_response(404, {"detail": "evaluation not found"})
+            body = plane.fake._body(request)
+            plane.samples.setdefault(eval_id, []).extend(body.get("samples", []))
+            ev["sampleCount"] = len(plane.samples[eval_id])
+            return _json_response(200, {"accepted": len(body.get("samples", []))})
+
+        @route("POST", r"/evals/evaluations/(?P<eval_id>[^/]+)/finalize")
+        def finalize(request: httpx.Request, eval_id: str) -> httpx.Response:
+            ev = plane.evaluations.get(eval_id)
+            if not ev:
+                return _json_response(404, {"detail": "evaluation not found"})
+            ev["status"] = "FINALIZED"
+            ev["metrics"] = plane.fake._body(request).get("metrics", {})
+            return _json_response(200, ev)
+
+        @route("GET", r"/evals/evaluations/(?P<eval_id>[^/]+)/samples")
+        def get_samples(request: httpx.Request, eval_id: str) -> httpx.Response:
+            return plane.fake._paginate(request, plane.samples.get(eval_id, []))
+
+        @route("GET", r"/evals/evaluations/(?P<eval_id>[^/]+)")
+        def get_eval(request: httpx.Request, eval_id: str) -> httpx.Response:
+            ev = plane.evaluations.get(eval_id)
+            if not ev:
+                return _json_response(404, {"detail": "evaluation not found"})
+            return _json_response(200, ev)
+
+        @route("GET", r"/evals/evaluations")
+        def list_evals(request: httpx.Request) -> httpx.Response:
+            rows = list(plane.evaluations.values())
+            env_id = request.url.params.get("envId")
+            if env_id:
+                rows = [r for r in rows if r["envId"] == env_id]
+            return _json_response(200, {"items": rows, "total": len(rows)})
+
+        @route("POST", r"/evals/evaluations")
+        def create_eval(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            if body.get("envId") not in plane.environments:
+                return _json_response(404, {"detail": f"environment {body.get('envId')} not found"})
+            eval_id = f"eval_{uuid.uuid4().hex[:8]}"
+            ev = {
+                "evalId": eval_id,
+                "envId": body["envId"],
+                "model": body.get("model", ""),
+                "status": "RUNNING",
+                "sampleCount": 0,
+                "metrics": {},
+                "createdAt": "2026-07-28T00:00:00Z",
+                "metadata": body.get("metadata", {}),
+            }
+            plane.evaluations[eval_id] = ev
+            return _json_response(200, ev)

@@ -244,3 +244,29 @@ def test_connect_all_workers_propagates_failures(runner, fake, monkeypatch):
     fake.make_pod_active(pod_id)
     result = runner.invoke(cli, ["pods", "connect", pod_id, "--all-workers", "--command", "x"])
     assert result.exit_code == 1
+
+
+def test_eval_run_and_push_cli(runner, fake, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    result = runner.invoke(
+        cli,
+        [
+            "eval", "run", "arith", "-m", "tiny-test",
+            "-n", "4", "-b", "2", "--max-new-tokens", "8",
+            "--output", "json",
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    payload = json.loads(result.output)
+    assert payload["metrics"]["num_samples"] == 4.0
+    assert payload["evalId"].startswith("eval_")
+    assert fake.evals_plane.evaluations[payload["evalId"]]["status"] == "FINALIZED"
+
+    result = runner.invoke(cli, ["eval", "list", "--plain"])
+    assert result.exit_code == 0 and "arith" not in result.output  # env shown as id
+    result = runner.invoke(cli, ["eval", "samples", payload["evalId"], "--plain"])
+    assert result.exit_code == 0
+
+    # push again from the run dir on disk
+    result = runner.invoke(cli, ["eval", "push", "--output", "json"])
+    assert result.exit_code == 0, result.output
