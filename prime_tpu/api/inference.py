@@ -1,0 +1,80 @@
+"""Inference API client (reference: prime_cli/api/inference.py:31-165).
+
+OpenAI-compatible surface against ``config.inference_url``: list/retrieve
+models, chat completions with SSE streaming. Long read timeout (600 s) for
+generation; team rides the X-Prime-Team-ID header.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterator
+
+import httpx
+
+from prime_tpu.core.client import APIClient
+from prime_tpu.core.config import Config
+
+INFERENCE_TIMEOUT = httpx.Timeout(600.0, connect=10.0, write=60.0)
+
+
+class InferenceClient:
+    def __init__(
+        self,
+        config: Config | None = None,
+        transport: httpx.BaseTransport | None = None,
+    ) -> None:
+        config = config or Config()
+        # inference_url already includes its path prefix (e.g. /api/v1)
+        self.api = APIClient(
+            config=config,
+            base_url=config.inference_url,
+            api_prefix="",
+            timeout=INFERENCE_TIMEOUT,
+            transport=transport,
+        )
+
+    def list_models(self) -> list[dict[str, Any]]:
+        data = self.api.get("/models")
+        return data.get("data", []) if isinstance(data, dict) else data
+
+    def retrieve_model(self, model_id: str) -> dict[str, Any]:
+        return self.api.get(f"/models/{model_id}")
+
+    def chat_completion(
+        self,
+        model: str,
+        messages: list[dict[str, str]],
+        max_tokens: int | None = None,
+        temperature: float | None = None,
+        job_id: str | None = None,
+    ) -> dict[str, Any]:
+        payload: dict[str, Any] = {"model": model, "messages": messages}
+        if max_tokens is not None:
+            payload["max_tokens"] = max_tokens
+        if temperature is not None:
+            payload["temperature"] = temperature
+        headers = {"X-PI-Job-Id": job_id} if job_id else None
+        return self.api.post("/chat/completions", json=payload, headers=headers)
+
+    def chat_completion_stream(
+        self,
+        model: str,
+        messages: list[dict[str, str]],
+        max_tokens: int | None = None,
+        temperature: float | None = None,
+    ) -> Iterator[dict[str, Any]]:
+        """Yield SSE delta chunks (parsed JSON) until [DONE]."""
+        payload: dict[str, Any] = {"model": model, "messages": messages, "stream": True}
+        if max_tokens is not None:
+            payload["max_tokens"] = max_tokens
+        if temperature is not None:
+            payload["temperature"] = temperature
+        for line in self.api.stream_lines("POST", "/chat/completions", json=payload):
+            line = line.strip()
+            if not line.startswith("data:"):
+                continue
+            data = line[len("data:"):].strip()
+            if data == "[DONE]":
+                return
+            yield json.loads(data)

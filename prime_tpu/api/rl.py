@@ -1,0 +1,154 @@
+"""Hosted RL/LoRA training client (reference: prime_cli/api/rl.py:11-618).
+
+Surface: trainable models with tiered pricing, run CRUD/stop/restart,
+checkpoints, multi-component log retrieval (component / worker_index / env
+filters — the TPU equivalent of the reference's pod_index), metrics /
+rollouts / progress / distributions. TPU-native: runs land on TPU slices
+(``tpu_type`` + ``num_slices``) instead of GPU-type picks.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from prime_tpu.core.client import APIClient
+
+
+class RLModelPrice(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    tier: str = "standard"
+    train_per_hour: float = Field(default=0.0, alias="trainPerHour")
+    inference_per_mtok: float = Field(default=0.0, alias="inferencePerMtok")
+
+
+class RLModel(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    model_id: str = Field(alias="modelId")
+    name: str
+    params_b: float = Field(default=0.0, alias="paramsB")
+    prices: list[RLModelPrice] = Field(default_factory=list)
+    default_tpu: str | None = Field(default=None, alias="defaultTpu")
+
+    def resolve_price(self, tier: str = "standard") -> RLModelPrice | None:
+        for price in self.prices:
+            if price.tier == tier:
+                return price
+        return self.prices[0] if self.prices else None
+
+
+class RLRun(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    run_id: str = Field(alias="runId")
+    name: str
+    model: str
+    env: str | None = None
+    status: str = "PENDING"          # PENDING|RUNNING|COMPLETED|FAILED|STOPPED
+    run_type: str = Field(default="lora", alias="runType")  # lora | full_finetune
+    tpu_type: str | None = Field(default=None, alias="tpuType")
+    num_slices: int = Field(default=1, alias="numSlices")
+    created_at: str | None = Field(default=None, alias="createdAt")
+    failure_analysis: str | None = Field(default=None, alias="failureAnalysis")
+    progress: dict[str, Any] = Field(default_factory=dict)
+
+
+class RLCheckpoint(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    checkpoint_id: str = Field(alias="checkpointId")
+    run_id: str = Field(alias="runId")
+    step: int = 0
+    created_at: str | None = Field(default=None, alias="createdAt")
+
+
+class RLClient:
+    def __init__(self, client: APIClient) -> None:
+        self.client = client
+
+    # -- catalog -------------------------------------------------------------
+
+    def list_models(self) -> list[RLModel]:
+        data = self.client.get("/rft/models")
+        items = data.get("items", []) if isinstance(data, dict) else data
+        return [RLModel.model_validate(m) for m in items]
+
+    def list_tpus(self) -> list[dict[str, Any]]:
+        return self.client.get("/rft/tpus")
+
+    # -- run lifecycle -------------------------------------------------------
+
+    def create_run(self, payload: dict[str, Any]) -> RLRun:
+        return RLRun.model_validate(self.client.post("/rft/runs", json=payload, idempotent_post=True))
+
+    def list_runs(self, limit: int = 50) -> list[RLRun]:
+        data = self.client.get("/rft/runs", params={"limit": limit})
+        items = data.get("items", []) if isinstance(data, dict) else data
+        return [RLRun.model_validate(r) for r in items]
+
+    def get_run(self, run_id: str) -> RLRun:
+        return RLRun.model_validate(self.client.get(f"/rft/runs/{run_id}"))
+
+    def stop_run(self, run_id: str) -> RLRun:
+        return RLRun.model_validate(self.client.post(f"/rft/runs/{run_id}/stop", idempotent_post=True))
+
+    def restart_run(self, run_id: str) -> RLRun:
+        """Restart from the latest checkpoint (reference api/rl.py:365)."""
+        return RLRun.model_validate(self.client.post(f"/rft/runs/{run_id}/restart", idempotent_post=True))
+
+    def delete_run(self, run_id: str) -> None:
+        self.client.delete(f"/rft/runs/{run_id}")
+
+    # -- observability -------------------------------------------------------
+
+    def get_logs(
+        self,
+        run_id: str,
+        component: str | None = None,
+        worker_index: int | None = None,
+        env_name: str | None = None,
+        since: str | None = None,
+        search: str | None = None,
+        level: str | None = None,
+        limit: int = 200,
+    ) -> list[dict[str, Any]]:
+        params: dict[str, Any] = {"limit": limit}
+        for key, value in (
+            ("component", component),
+            ("worker_index", worker_index),
+            ("env_name", env_name),
+            ("since", since),
+            ("search", search),
+            ("level", level),
+        ):
+            if value is not None:
+                params[key] = value
+        data = self.client.get(f"/rft/runs/{run_id}/logs", params=params)
+        return data.get("items", []) if isinstance(data, dict) else data
+
+    def components(self, run_id: str) -> list[str]:
+        data = self.client.get(f"/rft/runs/{run_id}/components")
+        return data.get("items", []) if isinstance(data, dict) else data
+
+    def metrics(self, run_id: str) -> dict[str, Any]:
+        return self.client.get(f"/rft/runs/{run_id}/metrics")
+
+    def rollouts(self, run_id: str, limit: int = 20) -> list[dict[str, Any]]:
+        data = self.client.get(f"/rft/runs/{run_id}/rollouts", params={"limit": limit})
+        return data.get("items", []) if isinstance(data, dict) else data
+
+    def progress(self, run_id: str) -> dict[str, Any]:
+        return self.client.get(f"/rft/runs/{run_id}/progress")
+
+    def distributions(self, run_id: str) -> dict[str, Any]:
+        return self.client.get(f"/rft/runs/{run_id}/distributions")
+
+    # -- checkpoints ---------------------------------------------------------
+
+    def list_checkpoints(self, run_id: str) -> list[RLCheckpoint]:
+        data = self.client.get(f"/rft/runs/{run_id}/checkpoints")
+        items = data.get("items", []) if isinstance(data, dict) else data
+        return [RLCheckpoint.model_validate(c) for c in items]

@@ -1,0 +1,64 @@
+"""`prime deployments` — LoRA adapter deploy/unload (reference: commands/deployments.py,
+api/deployments.py:10-113: adapter list/deploy/unload, checkpoint→adapter)."""
+
+from __future__ import annotations
+
+import click
+
+from prime_tpu.commands._deps import build_client
+from prime_tpu.utils.render import Renderer, output_options
+from prime_tpu.utils.short_id import shorten
+
+
+@click.group(name="deployments")
+def deployments_group() -> None:
+    """Deploy trained adapters to the inference fleet."""
+
+
+@deployments_group.command("list")
+@output_options
+def list_cmd(render: Renderer) -> None:
+    data = build_client().get("/deployments/adapters")
+    items = data.get("items", []) if isinstance(data, dict) else data
+    render.table(
+        ["ADAPTER", "BASE MODEL", "STATUS", "CHECKPOINT"],
+        [
+            [a.get("adapterId", ""), a.get("baseModel", ""), a.get("status", ""), shorten(a.get("checkpointId", "") or "")]
+            for a in items
+        ],
+        title="Deployed adapters",
+        json_rows=items,
+    )
+
+
+@deployments_group.command("base-models")
+@output_options
+def base_models_cmd(render: Renderer) -> None:
+    """List base models adapters can be deployed onto."""
+    data = build_client().get("/deployments/base-models")
+    items = data.get("items", []) if isinstance(data, dict) else data
+    render.table(["MODEL"], [[m] for m in items], title="Deployable base models", json_rows=items)
+
+
+@deployments_group.command("deploy")
+@click.option("--checkpoint", required=True, help="Checkpoint ID to deploy as an adapter.")
+@click.option("--name", default=None)
+@output_options
+def deploy_cmd(render: Renderer, checkpoint: str, name: str | None) -> None:
+    result = build_client().post(
+        "/deployments/adapters",
+        json={"checkpointId": checkpoint, **({"name": name} if name else {})},
+        idempotent_post=True,
+    )
+    if render.is_json:
+        render.json(result)
+    else:
+        render.message(f"Adapter {result.get('adapterId')} deploying ({result.get('status')}).")
+
+
+@deployments_group.command("unload")
+@click.argument("adapter_id")
+@output_options
+def unload_cmd(render: Renderer, adapter_id: str) -> None:
+    build_client().delete(f"/deployments/adapters/{adapter_id}")
+    render.message(f"Adapter {adapter_id} unloaded.")

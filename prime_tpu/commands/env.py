@@ -1,0 +1,287 @@
+"""`prime env` — Environments Hub workflow.
+
+Reference surface (prime_cli/commands/env.py): init/build/push/pull/install/
+uninstall/list/status/info/versions/delete + per-env secrets + actions.
+TPU-native: installs check the env's declared TPU requirements against the
+local device when JAX sees an accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import click
+
+import prime_tpu.commands._deps as deps
+from prime_tpu.core.client import APIClient
+from prime_tpu.envhub import EnvHubClient
+from prime_tpu.envhub.packaging import (
+    build_archive,
+    build_wheel,
+    content_hash,
+    extract_archive,
+    read_env_metadata,
+    write_env_template,
+)
+from prime_tpu.utils.render import Renderer, output_options
+
+
+@click.group(name="env")
+def env_group() -> None:
+    """Package and distribute eval/RL environments."""
+
+
+def build_hub_client() -> EnvHubClient:
+    return EnvHubClient(APIClient(config=deps.build_config(), transport=deps.transport_override))
+
+
+def installs_dir() -> Path:
+    return deps.build_config().config_dir / "envs"
+
+
+def _installed_registry() -> dict:
+    path = installs_dir() / "installed.json"
+    if path.exists():
+        try:
+            return json.loads(path.read_text())
+        except json.JSONDecodeError:
+            return {}
+    return {}
+
+
+def _save_registry(registry: dict) -> None:
+    installs_dir().mkdir(parents=True, exist_ok=True)
+    (installs_dir() / "installed.json").write_text(json.dumps(registry, indent=2))
+
+
+@env_group.command("init")
+@click.argument("name")
+@click.option("--dir", "target", default=None, help="Target directory (default ./<name>).")
+def init_cmd(name: str, target: str | None) -> None:
+    """Scaffold a new environment (env.toml + pyproject + module)."""
+    env_dir = Path(target or name)
+    written = write_env_template(env_dir, name)
+    for path in written:
+        click.echo(f"  created {path}")
+    click.echo(f"Environment '{name}' initialized in {env_dir}/")
+
+
+@env_group.command("build")
+@click.option("--dir", "env_dir", default=".", type=click.Path(exists=True))
+@output_options
+def build_cmd(render: Renderer, env_dir: str) -> None:
+    """Build the env archive + wheel locally (no upload)."""
+    try:
+        metadata = read_env_metadata(env_dir)
+    except (FileNotFoundError, ValueError) as e:
+        raise click.ClickException(str(e)) from None
+    archive = build_archive(env_dir)
+    digest = content_hash(env_dir)
+    payload = {
+        "name": metadata["name"],
+        "version": metadata["version"],
+        "archiveBytes": len(archive),
+        "contentHash": digest,
+    }
+    try:
+        wheel = build_wheel(env_dir)
+        payload["wheel"] = str(wheel)
+    except RuntimeError as e:
+        render.message(f"(wheel build skipped: {e})", err=True)
+    if render.is_json:
+        render.json(payload)
+    else:
+        render.detail(payload, title=f"Built {metadata['name']}")
+
+
+@env_group.command("push")
+@click.option("--dir", "env_dir", default=".", type=click.Path(exists=True))
+@click.option("--visibility", type=click.Choice(["private", "public"]), default="private")
+@output_options
+def push_cmd(render: Renderer, env_dir: str, visibility: str) -> None:
+    """Archive, hash, and upload the environment to the hub."""
+    try:
+        result = build_hub_client().push(env_dir, visibility=visibility)
+    except (FileNotFoundError, ValueError) as e:
+        raise click.ClickException(str(e)) from None
+    if render.is_json:
+        render.json(result)
+    elif result.get("unchanged"):
+        render.message(f"{result['name']} unchanged (content hash matches hub) — nothing to push.")
+    else:
+        render.message(f"Pushed {result['name']}@{result['latestVersion']} ({visibility}).")
+
+
+@env_group.command("pull")
+@click.argument("name")
+@click.option("--version", default=None)
+@click.option("--dir", "target", default=None, help="Extract here (default ./<name>).")
+@output_options
+def pull_cmd(render: Renderer, name: str, version: str | None, target: str | None) -> None:
+    """Download an environment version and extract it locally."""
+    archive, info = build_hub_client().pull(name, version=version)
+    target_dir = Path(target or name)
+    if target_dir.exists() and any(target_dir.iterdir()):
+        raise click.ClickException(
+            f"{target_dir}/ exists and is not empty — refusing to overwrite local files"
+        )
+    extract_archive(archive, target_dir)
+    render.message(f"Pulled {name}@{info['version']} -> {target_dir}/")
+    if render.is_json:
+        render.json({"name": name, "version": info["version"], "dir": str(target_dir)})
+
+
+@env_group.command("install")
+@click.argument("name")
+@click.option("--version", default=None)
+@output_options
+def install_cmd(render: Renderer, name: str, version: str | None) -> None:
+    """Install an environment from the hub into the local env store."""
+    import shutil
+
+    archive, info = build_hub_client().pull(name, version=version)
+    target = installs_dir() / name
+    # clean install: stale files from a previous version must not survive
+    shutil.rmtree(target, ignore_errors=True)
+    extract_archive(archive, target)
+    # TPU requirement check (best-effort; informative, not fatal)
+    try:
+        metadata = read_env_metadata(target)
+        tpu_req = metadata.get("tpu", {})
+        if tpu_req.get("tpu_type"):
+            render.message(f"  env declares TPU requirement: {tpu_req}")
+    except (FileNotFoundError, ValueError):
+        pass
+    registry = _installed_registry()
+    registry[name] = {"version": info["version"], "path": str(target), "contentHash": info.get("contentHash")}
+    _save_registry(registry)
+    render.message(f"Installed {name}@{info['version']} -> {target}")
+    if render.is_json:
+        render.json(registry[name] | {"name": name})
+
+
+@env_group.command("uninstall")
+@click.argument("name")
+@output_options
+def uninstall_cmd(render: Renderer, name: str) -> None:
+    import shutil
+
+    registry = _installed_registry()
+    entry = registry.pop(name, None)
+    if entry is None:
+        raise click.ClickException(f"{name} is not installed")
+    shutil.rmtree(entry["path"], ignore_errors=True)
+    _save_registry(registry)
+    render.message(f"Uninstalled {name}.")
+
+
+@env_group.command("list")
+@click.option("--installed", is_flag=True, help="Show locally installed envs instead of the hub.")
+@output_options
+def list_cmd(render: Renderer, installed: bool) -> None:
+    if installed:
+        registry = _installed_registry()
+        render.table(
+            ["NAME", "VERSION", "PATH"],
+            [[name, e["version"], e["path"]] for name, e in sorted(registry.items())],
+            title="Installed environments",
+            json_rows=registry,
+        )
+        return
+    envs = build_hub_client().list()
+    render.table(
+        ["NAME", "LATEST", "VISIBILITY", "TAGS", "DESCRIPTION"],
+        [
+            [e["name"], e.get("latestVersion", ""), e.get("visibility", ""), ",".join(e.get("tags", [])), e.get("description", "")]
+            for e in envs
+        ],
+        title="Hub environments",
+        json_rows=envs,
+    )
+
+
+@env_group.command("info")
+@click.argument("name")
+@output_options
+def info_cmd(render: Renderer, name: str) -> None:
+    env = build_hub_client().get(name)
+    render.detail(env, title=f"Environment {name}")
+
+
+@env_group.command("status")
+@click.argument("name")
+@output_options
+def status_cmd(render: Renderer, name: str) -> None:
+    render.detail(build_hub_client().status(name), title=f"Status {name}")
+
+
+@env_group.command("versions")
+@click.argument("name")
+@output_options
+def versions_cmd(render: Renderer, name: str) -> None:
+    rows = build_hub_client().versions(name)
+    render.table(["VERSION"], [[v["version"]] for v in rows], title=f"{name} versions", json_rows=rows)
+
+
+@env_group.command("delete")
+@click.argument("name")
+@click.option("--version", default=None, help="Delete one version instead of the whole env.")
+@click.option("--yes", "-y", is_flag=True)
+@output_options
+def delete_cmd(render: Renderer, name: str, version: str | None, yes: bool) -> None:
+    label = f"{name}@{version}" if version else name
+    if not yes and not click.confirm(f"Delete {label} from the hub?"):
+        render.message("Aborted.")
+        return
+    client = build_hub_client()
+    if version:
+        client.delete_version(name, version)
+    else:
+        client.delete(name)
+    render.message(f"Deleted {label}.")
+
+
+@env_group.group("secrets")
+def secrets_subgroup() -> None:
+    """Per-environment secrets."""
+
+
+@secrets_subgroup.command("list")
+@click.argument("name")
+@output_options
+def env_secrets_list(render: Renderer, name: str) -> None:
+    keys = build_hub_client().list_secrets(name)
+    render.table(["KEY"], [[k] for k in keys], title=f"{name} secrets", json_rows=keys)
+
+
+@secrets_subgroup.command("set")
+@click.argument("name")
+@click.argument("key")
+@click.argument("value", required=False)
+def env_secrets_set(name: str, key: str, value: str | None) -> None:
+    if value is None:
+        value = click.prompt(f"Value for {key}", hide_input=True)
+    build_hub_client().set_secret(name, key, value)
+    click.echo(f"Secret {key} set on {name}.")
+
+
+@secrets_subgroup.command("delete")
+@click.argument("name")
+@click.argument("key")
+def env_secrets_delete(name: str, key: str) -> None:
+    build_hub_client().delete_secret(name, key)
+    click.echo(f"Secret {key} deleted from {name}.")
+
+
+@env_group.command("actions")
+@click.argument("name")
+@output_options
+def actions_cmd(render: Renderer, name: str) -> None:
+    rows = build_hub_client().actions(name)
+    render.table(
+        ["ACTION", "VERSION"],
+        [[a.get("action", ""), a.get("version", "")] for a in rows],
+        title=f"{name} actions",
+        json_rows=rows,
+    )

@@ -1,0 +1,99 @@
+"""`prime images` + `prime registry` — sandbox image builds and registry access
+(reference: commands/images.py:379-1604, registry.py)."""
+
+from __future__ import annotations
+
+import base64
+from pathlib import Path
+
+import click
+
+from prime_tpu.commands._deps import build_client
+from prime_tpu.utils.render import Renderer, output_options
+from prime_tpu.utils.short_id import shorten
+
+
+@click.group(name="images")
+def images_group() -> None:
+    """Build and publish sandbox images (JAX/libtpu base by default)."""
+
+
+@images_group.command("list")
+@output_options
+def list_cmd(render: Renderer) -> None:
+    data = build_client().get("/images")
+    items = data.get("items", []) if isinstance(data, dict) else data
+    render.table(
+        ["ID", "NAME", "STATUS", "VISIBILITY"],
+        [[shorten(i["imageId"]), i.get("name", ""), i.get("status", ""), i.get("visibility", "")] for i in items],
+        title="Images",
+        json_rows=items,
+    )
+
+
+@images_group.command("push")
+@click.option("--name", required=True)
+@click.option("--dockerfile", type=click.Path(exists=True), default="Dockerfile")
+@click.option("--visibility", type=click.Choice(["private", "public"]), default="private")
+@output_options
+def push_cmd(render: Renderer, name: str, dockerfile: str, visibility: str) -> None:
+    """Build an image from a Dockerfile (server-side build)."""
+    contents = Path(dockerfile).read_text()
+    result = build_client().post(
+        "/images/build",
+        json={
+            "name": name,
+            "dockerfileB64": base64.b64encode(contents.encode()).decode(),
+            "visibility": visibility,
+        },
+        idempotent_post=True,
+    )
+    if render.is_json:
+        render.json(result)
+    else:
+        render.message(f"Image {shorten(result['imageId'])} building (build {result.get('buildId')}).")
+
+
+@images_group.command("build-status")
+@click.argument("image_id")
+@output_options
+def build_status_cmd(render: Renderer, image_id: str) -> None:
+    render.detail(build_client().get(f"/images/{image_id}/build-status"), title=f"Image {shorten(image_id)}")
+
+
+@images_group.command("publish")
+@click.argument("image_id")
+@output_options
+def publish_cmd(render: Renderer, image_id: str) -> None:
+    result = build_client().post(f"/images/{image_id}/publish", idempotent_post=True)
+    render.message(f"Image {shorten(image_id)} is now {result.get('visibility')}.")
+
+
+@click.group(name="registry")
+def registry_group() -> None:
+    """Container registry credentials and access checks."""
+
+
+@registry_group.command("credentials")
+@output_options
+def credentials_cmd(render: Renderer) -> None:
+    data = build_client().get("/registry/credentials")
+    items = data.get("items", []) if isinstance(data, dict) else data
+    render.table(
+        ["REGISTRY", "USERNAME"],
+        [[c.get("registry", ""), c.get("username", "")] for c in items],
+        title="Registry credentials",
+        json_rows=items,
+    )
+
+
+@registry_group.command("check-access")
+@click.argument("image")
+@output_options
+def check_access_cmd(render: Renderer, image: str) -> None:
+    result = build_client().post("/registry/check-access", json={"image": image}, idempotent_post=True)
+    if render.is_json:
+        render.json(result)
+    else:
+        status = "accessible" if result.get("accessible") else "NOT accessible"
+        render.message(f"{image}: {status}")

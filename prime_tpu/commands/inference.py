@@ -1,0 +1,77 @@
+"""`prime inference` — models + chat from the CLI (reference: commands/inference.py)."""
+
+from __future__ import annotations
+
+import click
+
+import prime_tpu.commands._deps as deps
+from prime_tpu.api.inference import InferenceClient
+from prime_tpu.utils.render import Renderer, output_options
+
+
+@click.group(name="inference")
+def inference_group() -> None:
+    """Query the inference API."""
+
+
+def _client() -> InferenceClient:
+    return InferenceClient(config=deps.build_config(), transport=deps.transport_override)
+
+
+@inference_group.command("models")
+@output_options
+def models_cmd(render: Renderer) -> None:
+    models = _client().list_models()
+    render.table(
+        ["ID", "OWNED BY", "CONTEXT"],
+        [[m.get("id"), m.get("owned_by", ""), m.get("context_length", "")] for m in models],
+        title="Inference models",
+        json_rows=models,
+    )
+
+
+@inference_group.command("retrieve")
+@click.argument("model_id")
+@output_options
+def retrieve_cmd(render: Renderer, model_id: str) -> None:
+    render.detail(_client().retrieve_model(model_id), title=model_id)
+
+
+@inference_group.command("chat")
+@click.argument("model")
+@click.option("--message", "-m", "message", required=True, help="User message.")
+@click.option("--system", default=None)
+@click.option("--max-tokens", type=int, default=None)
+@click.option("--temperature", "-t", type=float, default=None)
+@click.option("--stream/--no-stream", default=True)
+@output_options
+def chat_cmd(
+    render: Renderer,
+    model: str,
+    message: str,
+    system: str | None,
+    max_tokens: int | None,
+    temperature: float | None,
+    stream: bool,
+) -> None:
+    """One-shot chat completion."""
+    messages = ([{"role": "system", "content": system}] if system else []) + [
+        {"role": "user", "content": message}
+    ]
+    client = _client()
+    if stream and not render.is_json:
+        for chunk in client.chat_completion_stream(
+            model, messages, max_tokens=max_tokens, temperature=temperature
+        ):
+            for choice in chunk.get("choices", []):
+                delta = choice.get("delta", {}).get("content")
+                if delta:
+                    click.echo(delta, nl=False)
+        click.echo()
+        return
+    response = client.chat_completion(model, messages, max_tokens=max_tokens, temperature=temperature)
+    if render.is_json:
+        render.json(response)
+    else:
+        for choice in response.get("choices", []):
+            click.echo(choice.get("message", {}).get("content", ""))

@@ -1,0 +1,87 @@
+"""`prime login` / `prime logout` — browser challenge auth.
+
+Reference flow (prime_cli/commands/login.py:88-246): generate an ephemeral
+RSA-2048 keypair → POST the public key to /auth_challenge/generate → user
+approves in the browser → poll /auth_challenge/status until approved → the
+response carries the API key OAEP-encrypted to our ephemeral key → decrypt,
+save, whoami, optional team pick.
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+import webbrowser
+
+import click
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+import prime_tpu.commands._deps as deps
+from prime_tpu.utils.render import Renderer, output_options
+
+POLL_INTERVAL_S = 2.0
+POLL_ATTEMPTS = 150  # five minutes
+
+# test injection point: replaces webbrowser.open
+browser_open = webbrowser.open
+
+
+@click.command("login")
+@click.option("--no-browser", is_flag=True, help="Print the approval URL instead of opening it.")
+@output_options
+def login(render: Renderer, no_browser: bool) -> None:
+    """Authenticate via the browser and store the API key."""
+    config = deps.build_config()
+    api = deps.build_client(config)
+
+    private_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    public_pem = private_key.public_key().public_bytes(
+        encoding=serialization.Encoding.PEM,
+        format=serialization.PublicFormat.SubjectPublicKeyInfo,
+    ).decode()
+
+    challenge = api.post("/auth_challenge/generate", json={"publicKey": public_pem})
+    url = challenge["verificationUrl"]
+    challenge_id = challenge["challengeId"]
+    if no_browser:
+        render.message(f"Open this URL to approve the login:\n  {url}")
+    else:
+        render.message(f"Opening {url} ...")
+        browser_open(url)
+
+    for _ in range(POLL_ATTEMPTS):
+        status = api.get(f"/auth_challenge/status/{challenge_id}")
+        if status.get("status") == "approved":
+            encrypted = base64.b64decode(status["encryptedApiKey"])
+            api_key = private_key.decrypt(
+                encrypted,
+                padding.OAEP(
+                    mgf=padding.MGF1(algorithm=hashes.SHA256()),
+                    algorithm=hashes.SHA256(),
+                    label=None,
+                ),
+            ).decode()
+            config.api_key = api_key
+            config.save()
+            whoami = deps.build_client(config).get("/user/whoami")
+            config.user_id = whoami.get("userId", "")
+            config.save()
+            render.message(f"Logged in as {whoami.get('email', whoami.get('userId', '?'))}.")
+            teams = deps.build_client(config).get("/teams")
+            if teams and not config.team_id:
+                render.message("Teams available — set one with: prime teams switch <team-id>")
+            return
+        if status.get("status") == "denied":
+            raise click.ClickException("Login request was denied.")
+        time.sleep(POLL_INTERVAL_S)
+    raise click.ClickException("Login timed out waiting for browser approval.")
+
+
+@click.command("logout")
+def logout() -> None:
+    """Clear the stored API key."""
+    config = deps.build_config()
+    config.api_key = ""
+    config.save()
+    click.echo("Logged out (API key cleared).")

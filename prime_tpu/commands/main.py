@@ -25,13 +25,14 @@ _LAZY_COMMANDS: dict[str, tuple[str, str]] = {
     "sandbox": ("prime_tpu.commands.sandbox", "sandbox_group"),
     "tunnel": ("prime_tpu.commands.tunnel", "tunnel_group"),
     "images": ("prime_tpu.commands.images", "images_group"),
+    "registry": ("prime_tpu.commands.images", "registry_group"),
     "inference": ("prime_tpu.commands.inference", "inference_group"),
     # Lab
     "env": ("prime_tpu.commands.env", "env_group"),
     "eval": ("prime_tpu.commands.evals", "eval_group"),
     "train": ("prime_tpu.commands.train", "train_group"),
     "rl": ("prime_tpu.commands.train", "train_group"),
-    "lab": ("prime_tpu.commands.lab", "lab_group"),
+    "lab": ("prime_tpu.commands.misc", "lab_group"),
     "deployments": ("prime_tpu.commands.deployments", "deployments_group"),
     # Account
     "login": ("prime_tpu.commands.login", "login"),
@@ -40,7 +41,10 @@ _LAZY_COMMANDS: dict[str, tuple[str, str]] = {
     "teams": ("prime_tpu.commands.account", "teams_group"),
     "config": ("prime_tpu.commands.config_cmd", "config_group"),
     "wallet": ("prime_tpu.commands.account", "wallet"),
+    "usage": ("prime_tpu.commands.misc", "usage"),
     "secrets": ("prime_tpu.commands.secrets", "secrets_group"),
+    "upgrade": ("prime_tpu.commands.misc", "upgrade"),
+    "feedback": ("prime_tpu.commands.misc", "feedback"),
 }
 
 

@@ -1,0 +1,147 @@
+"""`prime usage` / `prime upgrade` / `prime feedback` / `prime lab`.
+
+Reference: commands/usage.py (per-run usage incl. --watch), upgrade.py:15-60
+(install-method detection), feedback.py, lab.py (setup/doctor; the full
+Textual TUI is gated behind the optional dependency).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import click
+
+import prime_tpu.commands._deps as deps
+from prime_tpu.utils.render import Renderer, output_options
+
+
+@click.command("usage")
+@click.option("--watch", "-w", is_flag=True, help="Refresh every few seconds.")
+@click.option("--interval", type=float, default=5.0)
+@click.option("--iterations", type=int, default=None, hidden=True)  # test hook
+@output_options
+def usage(render: Renderer, watch: bool, interval: float, iterations: int | None) -> None:
+    """Show per-run token/cost usage."""
+    count = 0
+    while True:
+        data = deps.build_client().get("/billing/usage")
+        rows = data.get("items", []) if isinstance(data, dict) else data
+        render.table(
+            ["RUN", "TOKENS", "COST $"],
+            [[r.get("runId", ""), r.get("tokens", 0), f"{r.get('costUsd', 0):.2f}"] for r in rows],
+            title="Usage",
+            json_rows=rows,
+        )
+        count += 1
+        if not watch or (iterations is not None and count >= iterations):
+            return
+        time.sleep(interval)
+
+
+def detect_install_method() -> str:
+    """uv tool / pipx / pip / source checkout (reference upgrade.py:15-60)."""
+    exe = sys.prefix
+    if "uv/tools" in exe or "/uv/" in exe:
+        return "uv-tool"
+    if "pipx" in exe:
+        return "pipx"
+    import prime_tpu
+
+    if "site-packages" not in (prime_tpu.__file__ or ""):
+        return "source"
+    return "pip"
+
+
+@click.command("upgrade")
+@output_options
+def upgrade(render: Renderer) -> None:
+    """Show how to upgrade prime-tpu for this install method."""
+    method = detect_install_method()
+    commands = {
+        "uv-tool": "uv tool upgrade prime-tpu",
+        "pipx": "pipx upgrade prime-tpu",
+        "pip": f"{sys.executable} -m pip install --upgrade prime-tpu",
+        "source": "git pull (source checkout)",
+    }
+    if render.is_json:
+        render.json({"installMethod": method, "command": commands[method]})
+    else:
+        render.message(f"Install method: {method}")
+        render.message(f"Upgrade with: {commands[method]}")
+
+
+@click.command("feedback")
+@click.argument("message", required=False)
+@output_options
+def feedback(render: Renderer, message: str | None) -> None:
+    """Send feedback to the platform team."""
+    if not message:
+        message = click.prompt("Your feedback")
+    deps.build_client().post("/feedback", json={"message": message}, idempotent_post=True)
+    render.message("Thanks — feedback submitted.")
+
+
+@click.group(name="lab")
+def lab_group() -> None:
+    """Lab workspace: setup, doctor, and the TUI (requires `textual`)."""
+
+
+@lab_group.command("setup")
+@click.option("--dir", "workspace", default=".", type=click.Path())
+def lab_setup(workspace: str) -> None:
+    """Bootstrap a Lab workspace (config templates + gitignore hygiene)."""
+    from pathlib import Path
+
+    ws = Path(workspace)
+    ws.mkdir(parents=True, exist_ok=True)
+    lab_dir = ws / ".prime-lab"
+    lab_dir.mkdir(exist_ok=True)
+    config = lab_dir / "lab.toml"
+    if not config.exists():
+        config.write_text('[lab]\nversion = 1\nsections = ["evals", "training", "environments"]\n')
+        click.echo(f"  created {config}")
+    gitignore = ws / ".gitignore"
+    needed = ["outputs/", ".prime-lab/cache/", ".env"]
+    existing = gitignore.read_text().splitlines() if gitignore.exists() else []
+    additions = [line for line in needed if line not in existing]
+    if additions:
+        with open(gitignore, "a") as f:
+            for line in additions:
+                f.write(line + "\n")
+        click.echo(f"  updated {gitignore} (+{len(additions)} entries)")
+    click.echo("Lab workspace ready. Run `prime lab view` to open the TUI.")
+
+
+@lab_group.command("doctor")
+@output_options
+def lab_doctor(render: Renderer) -> None:
+    """Check the local environment for Lab prerequisites."""
+    import importlib.util
+    from pathlib import Path
+
+    checks = {
+        "config": deps.build_config().config_file.exists(),
+        "api_key": bool(deps.build_config().api_key),
+        "workspace": Path(".prime-lab/lab.toml").exists(),
+        "textual": importlib.util.find_spec("textual") is not None,
+        "jax": importlib.util.find_spec("jax") is not None,
+    }
+    render.table(
+        ["CHECK", "OK"],
+        [[name, "yes" if ok else "NO"] for name, ok in checks.items()],
+        title="Lab doctor",
+        json_rows=checks,
+    )
+
+
+@lab_group.command("view")
+def lab_view() -> None:
+    """Open the Lab TUI (requires the optional `textual` dependency)."""
+    import importlib.util
+
+    if importlib.util.find_spec("textual") is None:
+        raise click.ClickException(
+            "The Lab TUI needs the optional `textual` package: pip install prime-tpu[lab]"
+        )
+    raise click.ClickException("Lab TUI is not built yet in this release.")  # future round

@@ -1,0 +1,42 @@
+"""`prime secrets` — account-level secret CRUD (reference: commands/secrets.py)."""
+
+from __future__ import annotations
+
+import click
+
+from prime_tpu.commands._deps import build_client
+from prime_tpu.utils.render import Renderer, output_options
+
+
+@click.group(name="secrets")
+def secrets_group() -> None:
+    """Manage account-level secrets (injected into runs/sandboxes by name)."""
+
+
+@secrets_group.command("list")
+@output_options
+def list_cmd(render: Renderer) -> None:
+    data = build_client().get("/secrets")
+    keys = data.get("keys", []) if isinstance(data, dict) else data
+    render.table(["KEY"], [[k] for k in keys], title="Secrets", json_rows=keys)
+
+
+@secrets_group.command("set")
+@click.argument("key")
+@click.argument("value", required=False)
+def set_cmd(key: str, value: str | None) -> None:
+    if value is None:
+        value = click.prompt(f"Value for {key}", hide_input=True)
+    build_client().put(f"/secrets/{key}", json={"value": value})
+    click.echo(f"Secret {key} set.")
+
+
+@secrets_group.command("delete")
+@click.argument("key")
+@click.option("--yes", "-y", is_flag=True)
+def delete_cmd(key: str, yes: bool) -> None:
+    if not yes and not click.confirm(f"Delete secret {key}?"):
+        click.echo("Aborted.")
+        return
+    build_client().delete(f"/secrets/{key}")
+    click.echo(f"Secret {key} deleted.")

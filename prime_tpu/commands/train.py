@@ -1,0 +1,336 @@
+"""`prime train` / `prime rl` — TOML-driven hosted training.
+
+Reference surface: prime_cli/commands/rl.py (run dispatch :1246 with full-FT
+detection :882, models/gpus→tpus, configs schema dump, init template :229,
+list/get/stop/delete/restart, streaming logs :2298 with component filters,
+metrics/rollouts/progress/distributions, checkpoints). `prime train <file.toml>`
+is sugar for `prime train run <file.toml>` (reference DefaultGroup).
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+
+import click
+import pydantic
+
+import prime_tpu.commands._deps as deps
+from prime_tpu.api.rl import RLClient
+from prime_tpu.api.training import HostedTrainingClient, build_payload_from_toml
+from prime_tpu.core.client import APIClient
+from prime_tpu.train.config import RL_TOML_TEMPLATE, RLConfig, load_rl_config
+from prime_tpu.utils.render import Renderer, output_options
+from prime_tpu.utils.short_id import resolve, shorten
+
+LOG_POLL_INTERVAL_S = 3.0
+
+
+class TrainGroup(click.Group):
+    """`prime train foo.toml` → `prime train run foo.toml`."""
+
+    def resolve_command(self, ctx, args):
+        if args and args[0].endswith(".toml"):
+            return super().resolve_command(ctx, ["run", *args])
+        return super().resolve_command(ctx, args)
+
+
+@click.group(name="train", cls=TrainGroup)
+def train_group() -> None:
+    """Launch and monitor hosted training runs on TPU slices."""
+
+
+def _rl_client() -> RLClient:
+    return RLClient(APIClient(config=deps.build_config(), transport=deps.transport_override))
+
+
+def _resolve_run(client: RLClient, run_id: str) -> str:
+    try:
+        return resolve(run_id, [r.run_id for r in client.list_runs()])
+    except ValueError as e:
+        raise click.ClickException(str(e)) from None
+
+
+@train_group.command("run")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.option("--yes", "-y", is_flag=True, help="Skip the confirmation preview.")
+@click.option("--follow", "-f", is_flag=True, help="Stream logs after dispatch.")
+@output_options
+def run_cmd(render: Renderer, config_file: str, yes: bool, follow: bool) -> None:
+    """Dispatch a training run from a TOML config."""
+    try:
+        config, warnings = load_rl_config(config_file)
+    except pydantic.ValidationError as e:
+        msgs = "; ".join(
+            f"{'.'.join(str(p) for p in err['loc'])}: {err['msg']}" for err in e.errors()
+        )
+        raise click.ClickException(f"Invalid config: {msgs}") from None
+    except Exception as e:
+        raise click.ClickException(f"Could not parse {config_file}: {e}") from None
+    for warning in warnings:
+        render.message(f"warning: {warning}", err=True)
+
+    if config.is_full_finetune:
+        # full-FT: whole TOML is shipped opaque to the dedicated trainer
+        payload = build_payload_from_toml(config_file)
+        if not yes and not click.confirm(
+            f"Dispatch FULL-FINETUNE '{config.name}' ({config.model}) on "
+            f"{payload['tpuType']} x{payload['numSlices']}?",
+            default=True,
+        ):
+            render.message("Aborted.")
+            return
+        client = HostedTrainingClient(
+            APIClient(config=deps.build_config(), transport=deps.transport_override)
+        )
+        run = client.create_run(payload)
+        run_id = run.get("runId", "")
+    else:
+        if not yes and not click.confirm(
+            f"Dispatch LoRA run '{config.name}' ({config.model}, env {config.env.id}) on "
+            f"{config.infrastructure.tpu_type} x{config.infrastructure.num_slices}?",
+            default=True,
+        ):
+            render.message("Aborted.")
+            return
+        run_model = _rl_client().create_run(config.to_payload())
+        run_id = run_model.run_id
+    if render.is_json:
+        render.json({"runId": run_id, "type": config.type})
+    else:
+        render.message(f"Run {shorten(run_id)} dispatched. Logs: prime train logs {shorten(run_id)} -f")
+    if follow:
+        _stream_logs(render, run_id)
+
+
+@train_group.command("init")
+@click.argument("name")
+@click.option("--out", default=None, help="Output file (default <name>.toml)")
+def init_cmd(name: str, out: str | None) -> None:
+    """Write a starter training TOML."""
+    path = Path(out or f"{name}.toml")
+    if path.exists():
+        raise click.ClickException(f"{path} already exists")
+    path.write_text(RL_TOML_TEMPLATE.format(name=name))
+    click.echo(f"Wrote {path}. Edit it and dispatch with: prime train {path}")
+
+
+@train_group.command("configs")
+@output_options
+def configs_cmd(render: Renderer) -> None:
+    """Dump the training config schema (reference: prime train configs)."""
+    render.json(RLConfig.model_json_schema())
+
+
+@train_group.command("models")
+@output_options
+def models_cmd(render: Renderer) -> None:
+    """List trainable models with pricing."""
+    models = _rl_client().list_models()
+    render.table(
+        ["MODEL", "PARAMS(B)", "TRAIN $/HR", "DEFAULT TPU"],
+        [
+            [
+                m.name,
+                m.params_b,
+                f"{m.resolve_price().train_per_hour:.2f}" if m.resolve_price() else "",
+                m.default_tpu or "",
+            ]
+            for m in models
+        ],
+        title="Trainable models",
+        json_rows=[m.model_dump(by_alias=True) for m in models],
+    )
+
+
+@train_group.command("tpus")
+@output_options
+def tpus_cmd(render: Renderer) -> None:
+    """List TPU slice options for hosted training."""
+    rows = _rl_client().list_tpus()
+    render.table(
+        ["SLICE", "CHIPS", "HOSTS", "$/HR"],
+        [[r["sliceName"], r["chips"], r["hosts"], f"{r['priceHourly']:.2f}"] for r in rows],
+        title="Training TPUs",
+        json_rows=rows,
+    )
+
+
+@train_group.command("list")
+@output_options
+def list_cmd(render: Renderer) -> None:
+    runs = _rl_client().list_runs()
+    render.table(
+        ["ID", "NAME", "MODEL", "TYPE", "STATUS", "TPU", "SLICES"],
+        [
+            [shorten(r.run_id), r.name, r.model, r.run_type, r.status, r.tpu_type or "", r.num_slices]
+            for r in runs
+        ],
+        title="Training runs",
+        json_rows=[r.model_dump(by_alias=True) for r in runs],
+    )
+
+
+@train_group.command("get")
+@click.argument("run_id")
+@output_options
+def get_cmd(render: Renderer, run_id: str) -> None:
+    client = _rl_client()
+    run = client.get_run(_resolve_run(client, run_id))
+    render.detail(run.model_dump(by_alias=True), title=f"Run {shorten(run.run_id)}")
+
+
+@train_group.command("stop")
+@click.argument("run_id")
+@output_options
+def stop_cmd(render: Renderer, run_id: str) -> None:
+    client = _rl_client()
+    run = client.stop_run(_resolve_run(client, run_id))
+    render.message(f"Run {shorten(run.run_id)} is {run.status}.")
+
+
+@train_group.command("restart")
+@click.argument("run_id")
+@output_options
+def restart_cmd(render: Renderer, run_id: str) -> None:
+    """Restart a run from its latest checkpoint."""
+    client = _rl_client()
+    run = client.restart_run(_resolve_run(client, run_id))
+    render.message(f"Run {shorten(run.run_id)} restarted: {run.status}.")
+
+
+@train_group.command("delete")
+@click.argument("run_id")
+@click.option("--yes", "-y", is_flag=True)
+@output_options
+def delete_cmd(render: Renderer, run_id: str, yes: bool) -> None:
+    client = _rl_client()
+    full_id = _resolve_run(client, run_id)
+    if not yes and not click.confirm(f"Delete run {shorten(full_id)}?"):
+        render.message("Aborted.")
+        return
+    client.delete_run(full_id)
+    render.message(f"Run {shorten(full_id)} deleted.")
+
+
+def _stream_logs(
+    render: Renderer,
+    run_id: str,
+    component: str | None = None,
+    worker_index: int | None = None,
+    env_name: str | None = None,
+    max_polls: int | None = None,
+) -> None:
+    """Poll-stream logs with dedup until the run is terminal (reference :2298)."""
+    client = _rl_client()
+    seen: set[str] = set()
+    polls = 0
+    while True:
+        logs = client.get_logs(run_id, component=component, worker_index=worker_index, env_name=env_name)
+        for row in logs:
+            key = f"{row.get('ts', '')}|{row.get('component', '')}|{row.get('workerIndex', '')}|{row.get('message', '')}"
+            if key in seen:
+                continue
+            seen.add(key)
+            prefix = f"[{row.get('component', '?')}{':' + str(row['workerIndex']) if row.get('workerIndex') is not None else ''}]"
+            click.echo(f"{row.get('ts', '')} {prefix} {row.get('message', '')}")
+        run = client.get_run(run_id)
+        if run.status in ("COMPLETED", "FAILED", "STOPPED"):
+            render.message(f"Run {shorten(run_id)} finished: {run.status}")
+            if run.failure_analysis:
+                render.message(f"Failure analysis: {run.failure_analysis}", err=True)
+            return
+        polls += 1
+        if max_polls is not None and polls >= max_polls:
+            return
+        time.sleep(LOG_POLL_INTERVAL_S)
+
+
+@train_group.command("logs")
+@click.argument("run_id")
+@click.option("--follow", "-f", is_flag=True)
+@click.option("--component", default=None, help="trainer | inference | env")
+@click.option("--worker", "worker_index", type=int, default=None)
+@click.option("--env-name", default=None)
+@output_options
+def logs_cmd(
+    render: Renderer,
+    run_id: str,
+    follow: bool,
+    component: str | None,
+    worker_index: int | None,
+    env_name: str | None,
+) -> None:
+    client = _rl_client()
+    full_id = _resolve_run(client, run_id)
+    if follow:
+        _stream_logs(render, full_id, component=component, worker_index=worker_index, env_name=env_name)
+        return
+    logs = client.get_logs(full_id, component=component, worker_index=worker_index, env_name=env_name)
+    if render.is_json:
+        render.json(logs)
+    else:
+        for row in logs:
+            click.echo(f"{row.get('ts', '')} [{row.get('component', '?')}] {row.get('message', '')}")
+
+
+@train_group.command("components")
+@click.argument("run_id")
+@output_options
+def components_cmd(render: Renderer, run_id: str) -> None:
+    client = _rl_client()
+    rows = client.components(_resolve_run(client, run_id))
+    render.table(["COMPONENT"], [[c] for c in rows], title="Components", json_rows=rows)
+
+
+@train_group.command("metrics")
+@click.argument("run_id")
+@output_options
+def metrics_cmd(render: Renderer, run_id: str) -> None:
+    client = _rl_client()
+    render.detail(client.metrics(_resolve_run(client, run_id)), title="Metrics")
+
+
+@train_group.command("rollouts")
+@click.argument("run_id")
+@click.option("--limit", type=int, default=20)
+@output_options
+def rollouts_cmd(render: Renderer, run_id: str, limit: int) -> None:
+    client = _rl_client()
+    rows = client.rollouts(_resolve_run(client, run_id), limit=limit)
+    render.table(
+        ["STEP", "REWARD", "COMPLETION"],
+        [[r.get("step"), r.get("reward"), str(r.get("completion", ""))[:60]] for r in rows],
+        title="Rollouts",
+        json_rows=rows,
+    )
+
+
+@train_group.command("progress")
+@click.argument("run_id")
+@output_options
+def progress_cmd(render: Renderer, run_id: str) -> None:
+    client = _rl_client()
+    render.detail(client.progress(_resolve_run(client, run_id)), title="Progress")
+
+
+@train_group.command("distributions")
+@click.argument("run_id")
+@output_options
+def distributions_cmd(render: Renderer, run_id: str) -> None:
+    client = _rl_client()
+    render.detail(client.distributions(_resolve_run(client, run_id)), title="Distributions")
+
+
+@train_group.command("checkpoints")
+@click.argument("run_id")
+@output_options
+def checkpoints_cmd(render: Renderer, run_id: str) -> None:
+    client = _rl_client()
+    checkpoints = client.list_checkpoints(_resolve_run(client, run_id))
+    render.table(
+        ["ID", "STEP", "CREATED"],
+        [[shorten(c.checkpoint_id), c.step, c.created_at or ""] for c in checkpoints],
+        title="Checkpoints",
+        json_rows=[c.model_dump(by_alias=True) for c in checkpoints],
+    )

@@ -1,0 +1,83 @@
+"""`prime tunnel` — expose local ports (reference: commands/tunnel.py:48-561)."""
+
+from __future__ import annotations
+
+import signal
+
+import click
+
+import prime_tpu.commands._deps as deps
+from prime_tpu.tunnel import Tunnel, TunnelError
+from prime_tpu.tunnel.binary import FrpcUnavailable
+from prime_tpu.utils.render import Renderer, output_options
+
+
+@click.group(name="tunnel")
+def tunnel_group() -> None:
+    """Expose local ports via managed tunnels."""
+
+
+@tunnel_group.command("start")
+@click.argument("port", type=int)
+@click.option("--auth", default=None, help="user:password basic auth on the public URL.")
+@output_options
+def start_cmd(render: Renderer, port: int, auth: str | None) -> None:
+    """Start a tunnel to localhost:PORT (runs until Ctrl-C)."""
+    basic_auth = None
+    if auth:
+        if ":" not in auth:
+            raise click.ClickException("--auth must be user:password")
+        basic_auth = tuple(auth.split(":", 1))
+    tunnel = Tunnel(port, client=deps.build_client(), basic_auth=basic_auth)  # type: ignore[arg-type]
+    try:
+        url = tunnel.start()
+    except (TunnelError, FrpcUnavailable) as e:
+        raise click.ClickException(str(e)) from None
+    render.message(f"Tunnel up: {url} -> localhost:{port} (Ctrl-C to stop)")
+
+    stop = {"requested": False}
+
+    def handle_sigint(signum, frame):
+        stop["requested"] = True
+
+    signal.signal(signal.SIGINT, handle_sigint)
+    import time
+
+    while not stop["requested"]:
+        if tunnel.process and tunnel.process.poll() is not None:
+            render.error("frpc exited unexpectedly")
+            break
+        time.sleep(0.5)
+    tunnel.stop()
+    render.message("Tunnel stopped.")
+
+
+@tunnel_group.command("list")
+@output_options
+def list_cmd(render: Renderer) -> None:
+    data = deps.build_client().get("/tunnels")
+    items = data.get("items", []) if isinstance(data, dict) else data
+    render.table(
+        ["ID", "PORT", "URL", "STATUS"],
+        [[t["tunnelId"], t.get("localPort", ""), t.get("url", ""), t.get("status", "")] for t in items],
+        title="Tunnels",
+        json_rows=items,
+    )
+
+
+@tunnel_group.command("status")
+@click.argument("tunnel_id")
+@output_options
+def status_cmd(render: Renderer, tunnel_id: str) -> None:
+    render.detail(deps.build_client().get(f"/tunnels/{tunnel_id}"), title=f"Tunnel {tunnel_id}")
+
+
+@tunnel_group.command("stop")
+@click.argument("tunnel_ids", nargs=-1, required=True)
+@output_options
+def stop_cmd(render: Renderer, tunnel_ids: tuple[str, ...]) -> None:
+    """Delete tunnel registrations (bulk-capable)."""
+    client = deps.build_client()
+    for tunnel_id in tunnel_ids:
+        client.delete(f"/tunnels/{tunnel_id}")
+        render.message(f"Tunnel {tunnel_id} deleted.")

@@ -293,18 +293,24 @@ class APIClient:
         timeout: httpx.Timeout | float | None = None,
     ) -> Iterator[str]:
         """Stream response lines (SSE / JSONL endpoints). No retries."""
-        with self._client.stream(
-            method.upper(),
-            self._core.url(path),
-            json=json,
-            params=params,
-            headers=self._core.headers(headers),
-            timeout=timeout if timeout is not None else httpx.USE_CLIENT_DEFAULT,
-        ) as response:
-            if response.status_code >= 400:
-                response.read()
-                raise_for_status(response)
-            yield from response.iter_lines()
+        url = self._core.url(path)
+        try:
+            with self._client.stream(
+                method.upper(),
+                url,
+                json=json,
+                params=params,
+                headers=self._core.headers(headers),
+                timeout=timeout if timeout is not None else httpx.USE_CLIENT_DEFAULT,
+            ) as response:
+                if response.status_code >= 400:
+                    response.read()
+                    raise_for_status(response)
+                yield from response.iter_lines()
+        except httpx.TimeoutException as exc:
+            raise APITimeoutError(f"{method} {url} timed out: {exc}") from exc
+        except httpx.TransportError as exc:
+            raise APIConnectionError(f"Could not reach {url}: {exc}") from exc
 
 
 class AsyncAPIClient:
@@ -428,16 +434,22 @@ class AsyncAPIClient:
         headers: dict[str, str] | None = None,
         timeout: httpx.Timeout | float | None = None,
     ) -> AsyncIterator[str]:
-        async with self._client.stream(
-            method.upper(),
-            self._core.url(path),
-            json=json,
-            params=params,
-            headers=self._core.headers(headers),
-            timeout=timeout if timeout is not None else httpx.USE_CLIENT_DEFAULT,
-        ) as response:
-            if response.status_code >= 400:
-                await response.aread()
-                raise_for_status(response)
-            async for line in response.aiter_lines():
-                yield line
+        url = self._core.url(path)
+        try:
+            async with self._client.stream(
+                method.upper(),
+                url,
+                json=json,
+                params=params,
+                headers=self._core.headers(headers),
+                timeout=timeout if timeout is not None else httpx.USE_CLIENT_DEFAULT,
+            ) as response:
+                if response.status_code >= 400:
+                    await response.aread()
+                    raise_for_status(response)
+                async for line in response.aiter_lines():
+                    yield line
+        except httpx.TimeoutException as exc:
+            raise APITimeoutError(f"{method} {url} timed out: {exc}") from exc
+        except httpx.TransportError as exc:
+            raise APIConnectionError(f"Could not reach {url}: {exc}") from exc

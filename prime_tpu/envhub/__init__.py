@@ -1,0 +1,24 @@
+"""Environments Hub: package, version, and distribute eval/RL environments.
+
+Reference surface: prime_cli/commands/env.py (push = wheel build + archive +
+content hash + upload, env.py:1039-1660; install = pip from hub wheel with
+private pull-and-build fallback :3069). TPU-native delta: environment
+metadata declares TPU requirements (``tpu_type``, ``min_chips``) so installs
+can check the target slice.
+"""
+
+from prime_tpu.envhub.packaging import (
+    build_archive,
+    content_hash,
+    read_env_metadata,
+    write_env_template,
+)
+from prime_tpu.envhub.client import EnvHubClient
+
+__all__ = [
+    "EnvHubClient",
+    "build_archive",
+    "content_hash",
+    "read_env_metadata",
+    "write_env_template",
+]

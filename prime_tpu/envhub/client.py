@@ -1,0 +1,89 @@
+"""Environments Hub REST client.
+
+Wire surface: /envhub/environments (list/resolve/create), versions
+(push/pull/delete), per-env secrets, actions log. Push uploads the archive
+bytes with the content hash; pull downloads a version archive.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any
+
+from prime_tpu.core.client import APIClient
+from prime_tpu.core.exceptions import NotFoundError
+from prime_tpu.envhub.packaging import build_archive, content_hash, read_env_metadata
+
+
+class EnvHubClient:
+    def __init__(self, client: APIClient | None = None) -> None:
+        self.api = client or APIClient()
+
+    # -- listing/resolution ---------------------------------------------------
+
+    def list(self, owner: str | None = None) -> list[dict[str, Any]]:
+        params = {"owner": owner} if owner else {}
+        data = self.api.get("/envhub/environments", params=params)
+        return data.get("items", []) if isinstance(data, dict) else data
+
+    def get(self, name: str) -> dict[str, Any]:
+        return self.api.get(f"/envhub/environments/{name}")
+
+    def status(self, name: str) -> dict[str, Any]:
+        return self.api.get(f"/envhub/environments/{name}/status")
+
+    def versions(self, name: str) -> list[dict[str, Any]]:
+        data = self.api.get(f"/envhub/environments/{name}/versions")
+        return data.get("items", []) if isinstance(data, dict) else data
+
+    def delete(self, name: str) -> None:
+        self.api.delete(f"/envhub/environments/{name}")
+
+    def delete_version(self, name: str, version: str) -> None:
+        self.api.delete(f"/envhub/environments/{name}/versions/{version}")
+
+    # -- push / pull -----------------------------------------------------------
+
+    def push(self, env_dir: str, visibility: str = "private") -> dict[str, Any]:
+        """Archive + hash + resolve-or-create + upload (reference env.py:1039)."""
+        metadata = read_env_metadata(env_dir)
+        digest = content_hash(env_dir)
+        try:
+            existing = self.get(metadata["name"])
+            if existing.get("contentHash") == digest:
+                return {**existing, "unchanged": True}
+        except NotFoundError:
+            pass
+        archive = build_archive(env_dir)  # built only when actually uploading
+        payload = {
+            "name": metadata["name"],
+            "version": metadata["version"],
+            "description": metadata["description"],
+            "tags": metadata["tags"],
+            "tpu": metadata["tpu"],
+            "contentHash": digest,
+            "visibility": visibility,
+            "archiveB64": base64.b64encode(archive).decode(),
+        }
+        return self.api.post("/envhub/environments/push", json=payload, idempotent_post=True)
+
+    def pull(self, name: str, version: str | None = None) -> tuple[bytes, dict[str, Any]]:
+        params = {"version": version} if version else {}
+        data = self.api.get(f"/envhub/environments/{name}/pull", params=params)
+        return base64.b64decode(data["archiveB64"]), data
+
+    # -- secrets + actions -----------------------------------------------------
+
+    def list_secrets(self, name: str) -> list[str]:
+        data = self.api.get(f"/envhub/environments/{name}/secrets")
+        return data.get("keys", []) if isinstance(data, dict) else data
+
+    def set_secret(self, name: str, key: str, value: str) -> None:
+        self.api.put(f"/envhub/environments/{name}/secrets/{key}", json={"value": value})
+
+    def delete_secret(self, name: str, key: str) -> None:
+        self.api.delete(f"/envhub/environments/{name}/secrets/{key}")
+
+    def actions(self, name: str) -> list[dict[str, Any]]:
+        data = self.api.get(f"/envhub/environments/{name}/actions")
+        return data.get("items", []) if isinstance(data, dict) else data

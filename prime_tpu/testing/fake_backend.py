@@ -66,11 +66,18 @@ class FakeControlPlane:
         self._routes: list[tuple[str, re.Pattern[str], Callable[..., httpx.Response]]] = []
         self._register_routes()
         self._mounts: list[Callable[[httpx.Request], httpx.Response | None]] = []
+        from prime_tpu.testing.fake_envhub_plane import FakeEnvHubPlane
         from prime_tpu.testing.fake_evals_plane import FakeEvalsPlane
         from prime_tpu.testing.fake_sandbox_plane import FakeSandboxPlane
 
+        from prime_tpu.testing.fake_misc_plane import FakeMiscPlane
+        from prime_tpu.testing.fake_training_plane import FakeTrainingPlane
+
         self.sandbox_plane = FakeSandboxPlane(self)
         self.evals_plane = FakeEvalsPlane(self)
+        self.envhub_plane = FakeEnvHubPlane(self)
+        self.training_plane = FakeTrainingPlane(self)
+        self.misc_plane = FakeMiscPlane(self)
 
     # -- catalog seeding -----------------------------------------------------
 
@@ -135,10 +142,11 @@ class FakeControlPlane:
                 return resp
         if not path.startswith("/api/v1"):
             return _json_response(404, {"detail": f"no route {path}"})
-        auth = request.headers.get("Authorization", "")
-        if auth != f"Bearer {self.api_key}":
-            return _json_response(401, {"detail": "invalid or missing API key"})
         sub = path[len("/api/v1"):]
+        if not sub.startswith("/auth_challenge"):  # login flow happens pre-key
+            auth = request.headers.get("Authorization", "")
+            if auth != f"Bearer {self.api_key}":
+                return _json_response(401, {"detail": "invalid or missing API key"})
         for method, pattern, fn in self._routes:
             if method == request.method:
                 m = pattern.match(sub)

@@ -1,0 +1,121 @@
+"""Fake Environments Hub routes."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import httpx
+
+from prime_tpu.testing.fake_backend import FakeControlPlane, _json_response
+
+
+class FakeEnvHubPlane:
+    def __init__(self, fake: FakeControlPlane) -> None:
+        self.fake = fake
+        self.environments: dict[str, dict[str, Any]] = {}
+        self.archives: dict[tuple[str, str], str] = {}   # (name, version) -> archiveB64
+        self.version_hashes: dict[tuple[str, str], str] = {}
+        self.secrets: dict[str, dict[str, str]] = {}
+        self.actions: dict[str, list[dict[str, Any]]] = {}
+        self._register()
+
+    def _register(self) -> None:
+        route = self.fake.route
+        plane = self
+
+        @route("POST", r"/envhub/environments/push")
+        def push(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            name, version = body["name"], body["version"]
+            env = plane.environments.get(name, {"name": name, "versions": []})
+            stored_hash = plane.version_hashes.get((name, version))
+            if stored_hash is not None and stored_hash != body["contentHash"]:
+                return _json_response(
+                    409, {"detail": f"version {version} already exists with different content"}
+                )
+            env.update(
+                {
+                    "description": body.get("description", ""),
+                    "tags": body.get("tags", []),
+                    "tpu": body.get("tpu", {}),
+                    "contentHash": body["contentHash"],
+                    "visibility": body.get("visibility", "private"),
+                    "latestVersion": version,
+                    "owner": "user_1",
+                }
+            )
+            if version not in env["versions"]:
+                env["versions"].append(version)
+            plane.environments[name] = env
+            plane.archives[(name, version)] = body["archiveB64"]
+            plane.version_hashes[(name, version)] = body["contentHash"]
+            plane.actions.setdefault(name, []).append({"action": "push", "version": version})
+            return _json_response(200, env)
+
+        @route("GET", r"/envhub/environments/(?P<name>[^/]+)/pull")
+        def pull(request: httpx.Request, name: str) -> httpx.Response:
+            env = plane.environments.get(name)
+            if not env:
+                return _json_response(404, {"detail": f"environment {name} not found"})
+            version = request.url.params.get("version") or env["latestVersion"]
+            archive = plane.archives.get((name, version))
+            if archive is None:
+                return _json_response(404, {"detail": f"version {version} not found"})
+            return _json_response(
+                200,
+                {"name": name, "version": version, "contentHash": env["contentHash"], "archiveB64": archive},
+            )
+
+        @route("GET", r"/envhub/environments/(?P<name>[^/]+)/versions")
+        def versions(request: httpx.Request, name: str) -> httpx.Response:
+            env = plane.environments.get(name)
+            if not env:
+                return _json_response(404, {"detail": "not found"})
+            return _json_response(200, {"items": [{"version": v} for v in env["versions"]]})
+
+        @route("GET", r"/envhub/environments/(?P<name>[^/]+)/status")
+        def status(request: httpx.Request, name: str) -> httpx.Response:
+            env = plane.environments.get(name)
+            if not env:
+                return _json_response(404, {"detail": "not found"})
+            return _json_response(200, {"name": name, "status": "READY", "latestVersion": env["latestVersion"]})
+
+        @route("GET", r"/envhub/environments/(?P<name>[^/]+)/secrets")
+        def list_secrets(request: httpx.Request, name: str) -> httpx.Response:
+            return _json_response(200, {"keys": sorted(plane.secrets.get(name, {}))})
+
+        @route("PUT", r"/envhub/environments/(?P<name>[^/]+)/secrets/(?P<key>[^/]+)")
+        def set_secret(request: httpx.Request, name: str, key: str) -> httpx.Response:
+            plane.secrets.setdefault(name, {})[key] = plane.fake._body(request).get("value", "")
+            return _json_response(200, {"ok": True})
+
+        @route("DELETE", r"/envhub/environments/(?P<name>[^/]+)/secrets/(?P<key>[^/]+)")
+        def delete_secret(request: httpx.Request, name: str, key: str) -> httpx.Response:
+            plane.secrets.get(name, {}).pop(key, None)
+            return httpx.Response(204)
+
+        @route("GET", r"/envhub/environments/(?P<name>[^/]+)/actions")
+        def actions(request: httpx.Request, name: str) -> httpx.Response:
+            return _json_response(200, {"items": plane.actions.get(name, [])})
+
+        @route("GET", r"/envhub/environments/(?P<name>[^/]+)")
+        def get_env(request: httpx.Request, name: str) -> httpx.Response:
+            env = plane.environments.get(name)
+            if not env:
+                return _json_response(404, {"detail": f"environment {name} not found"})
+            return _json_response(200, env)
+
+        @route("DELETE", r"/envhub/environments/(?P<name>[^/]+)")
+        def delete_env(request: httpx.Request, name: str) -> httpx.Response:
+            if name not in plane.environments:
+                return _json_response(404, {"detail": "not found"})
+            del plane.environments[name]
+            return httpx.Response(204)
+
+        @route("GET", r"/envhub/environments")
+        def list_envs(request: httpx.Request) -> httpx.Response:
+            rows = list(plane.environments.values())
+            owner = request.url.params.get("owner")
+            if owner:
+                rows = [r for r in rows if r.get("owner") == owner]
+            return _json_response(200, {"items": rows, "total": len(rows)})

@@ -1,0 +1,256 @@
+"""Fake routes for the remaining surfaces: auth challenge (login), inference
+(OpenAI-compatible incl. SSE), secrets, deployments, billing/usage, images,
+registry, tunnels, feedback.
+"""
+
+from __future__ import annotations
+
+import base64
+import uuid
+from typing import Any
+
+import httpx
+
+from prime_tpu.testing.fake_backend import FakeControlPlane, _json_response
+
+
+class FakeMiscPlane:
+    def __init__(self, fake: FakeControlPlane) -> None:
+        self.fake = fake
+        self.challenges: dict[str, dict[str, Any]] = {}
+        self.auto_approve_logins = True
+        self.real_api_key = fake.api_key
+        self.account_secrets: dict[str, str] = {}
+        self.adapters: dict[str, dict[str, Any]] = {}
+        self.images: dict[str, dict[str, Any]] = {}
+        self.tunnels: dict[str, dict[str, Any]] = {}
+        self.feedback: list[dict[str, Any]] = []
+        self.usage_rows = [
+            {"runId": "run_demo1", "tokens": 120000, "costUsd": 1.2},
+            {"runId": "run_demo2", "tokens": 800000, "costUsd": 8.4},
+        ]
+        self.inference_models = [
+            {"id": "llama3-8b", "owned_by": "prime", "context_length": 8192},
+            {"id": "llama3-70b", "owned_by": "prime", "context_length": 8192},
+        ]
+        self._register()
+        fake.mount(self._handle_inference)
+
+    # -- inference host (config.inference_url points at inference.fake) ------
+
+    def _handle_inference(self, request: httpx.Request) -> httpx.Response | None:
+        # in-process: dedicated host; over a live socket: the /v1/ path prefix
+        # (control-plane routes all live under /api/v1/, so /v1/ is unambiguous)
+        if request.url.host != "inference.fake" and not request.url.path.startswith("/v1/"):
+            return None
+        auth = request.headers.get("Authorization", "")
+        if auth != f"Bearer {self.fake.api_key}":
+            return _json_response(401, {"detail": "bad key"})
+        path = request.url.path
+        if path == "/v1/models" and request.method == "GET":
+            return _json_response(200, {"data": self.inference_models})
+        if path.startswith("/v1/models/") and request.method == "GET":
+            model_id = path.rsplit("/", 1)[1]
+            for m in self.inference_models:
+                if m["id"] == model_id:
+                    return _json_response(200, m)
+            return _json_response(404, {"detail": "model not found"})
+        if path == "/v1/chat/completions" and request.method == "POST":
+            import json as jsonlib
+
+            body = jsonlib.loads(request.content.decode())
+            content = f"echo: {body['messages'][-1]['content']}"
+            if body.get("stream"):
+                chunks = []
+                for i, word in enumerate(content.split(" ")):
+                    delta = {"choices": [{"delta": {"content": (" " if i else "") + word}}]}
+                    chunks.append(f"data: {jsonlib.dumps(delta)}")
+                chunks.append("data: [DONE]")
+                return httpx.Response(
+                    200, text="\n\n".join(chunks), headers={"Content-Type": "text/event-stream"}
+                )
+            return _json_response(
+                200,
+                {
+                    "id": f"chatcmpl-{uuid.uuid4().hex[:8]}",
+                    "model": body["model"],
+                    "choices": [{"message": {"role": "assistant", "content": content}, "finish_reason": "stop"}],
+                    "usage": {"prompt_tokens": 5, "completion_tokens": 5},
+                },
+            )
+        return _json_response(404, {"detail": f"no inference route {path}"})
+
+    # -- control-plane routes -------------------------------------------------
+
+    def _register(self) -> None:
+        route = self.fake.route
+        plane = self
+
+        # auth challenge: exempt from bearer auth (login happens pre-key);
+        # FakeControlPlane.handle enforces auth AFTER mounts, so register these
+        # as a mount-style early check via routes + a bypass marker.
+        @route("POST", r"/auth_challenge/generate")
+        def generate_challenge(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            challenge_id = f"chal_{uuid.uuid4().hex[:8]}"
+            plane.challenges[challenge_id] = {
+                "publicKey": body["publicKey"],
+                "status": "approved" if plane.auto_approve_logins else "pending",
+            }
+            return _json_response(
+                200,
+                {
+                    "challengeId": challenge_id,
+                    "verificationUrl": f"https://app.fake/auth/{challenge_id}",
+                },
+            )
+
+        @route("GET", r"/auth_challenge/status/(?P<cid>[^/]+)")
+        def challenge_status(request: httpx.Request, cid: str) -> httpx.Response:
+            challenge = plane.challenges.get(cid)
+            if not challenge:
+                return _json_response(404, {"detail": "challenge not found"})
+            if challenge["status"] != "approved":
+                return _json_response(200, {"status": challenge["status"]})
+            from cryptography.hazmat.primitives import hashes, serialization
+            from cryptography.hazmat.primitives.asymmetric import padding
+
+            public_key = serialization.load_pem_public_key(challenge["publicKey"].encode())
+            encrypted = public_key.encrypt(
+                plane.real_api_key.encode(),
+                padding.OAEP(mgf=padding.MGF1(algorithm=hashes.SHA256()), algorithm=hashes.SHA256(), label=None),
+            )
+            return _json_response(
+                200,
+                {"status": "approved", "encryptedApiKey": base64.b64encode(encrypted).decode()},
+            )
+
+        @route("GET", r"/secrets")
+        def list_secrets(request: httpx.Request) -> httpx.Response:
+            return _json_response(200, {"keys": sorted(plane.account_secrets)})
+
+        @route("PUT", r"/secrets/(?P<key>[^/]+)")
+        def set_secret(request: httpx.Request, key: str) -> httpx.Response:
+            plane.account_secrets[key] = plane.fake._body(request).get("value", "")
+            return _json_response(200, {"ok": True})
+
+        @route("DELETE", r"/secrets/(?P<key>[^/]+)")
+        def delete_secret(request: httpx.Request, key: str) -> httpx.Response:
+            plane.account_secrets.pop(key, None)
+            return httpx.Response(204)
+
+        @route("GET", r"/deployments/adapters")
+        def list_adapters(request: httpx.Request) -> httpx.Response:
+            return _json_response(200, {"items": list(plane.adapters.values())})
+
+        @route("GET", r"/deployments/base-models")
+        def base_models(request: httpx.Request) -> httpx.Response:
+            return _json_response(200, {"items": ["llama3-8b", "llama3-70b"]})
+
+        @route("POST", r"/deployments/adapters")
+        def deploy_adapter(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            adapter_id = body.get("name") or f"adapter_{uuid.uuid4().hex[:6]}"
+            adapter = {
+                "adapterId": adapter_id,
+                "baseModel": "llama3-8b",
+                "status": "DEPLOYING",
+                "checkpointId": body.get("checkpointId"),
+            }
+            plane.adapters[adapter_id] = adapter
+            return _json_response(200, adapter)
+
+        @route("DELETE", r"/deployments/adapters/(?P<aid>[^/]+)")
+        def unload_adapter(request: httpx.Request, aid: str) -> httpx.Response:
+            if aid not in plane.adapters:
+                return _json_response(404, {"detail": "adapter not found"})
+            del plane.adapters[aid]
+            return httpx.Response(204)
+
+        @route("GET", r"/billing/usage")
+        def usage(request: httpx.Request) -> httpx.Response:
+            return _json_response(200, {"items": plane.usage_rows})
+
+        @route("GET", r"/images")
+        def list_images(request: httpx.Request) -> httpx.Response:
+            return _json_response(200, {"items": list(plane.images.values())})
+
+        @route("POST", r"/images/build")
+        def build_image(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            image_id = f"img_{uuid.uuid4().hex[:8]}"
+            image = {
+                "imageId": image_id,
+                "name": body.get("name", image_id),
+                "status": "BUILDING",
+                "visibility": body.get("visibility", "private"),
+                "buildId": f"build_{uuid.uuid4().hex[:6]}",
+            }
+            plane.images[image_id] = image
+            return _json_response(200, image)
+
+        @route("GET", r"/images/(?P<iid>[^/]+)/build-status")
+        def build_status(request: httpx.Request, iid: str) -> httpx.Response:
+            image = plane.images.get(iid)
+            if not image:
+                return _json_response(404, {"detail": "image not found"})
+            image["status"] = "READY"
+            return _json_response(200, image)
+
+        @route("POST", r"/images/(?P<iid>[^/]+)/publish")
+        def publish_image(request: httpx.Request, iid: str) -> httpx.Response:
+            image = plane.images.get(iid)
+            if not image:
+                return _json_response(404, {"detail": "image not found"})
+            image["visibility"] = "public"
+            return _json_response(200, image)
+
+        @route("GET", r"/registry/credentials")
+        def registry_creds(request: httpx.Request) -> httpx.Response:
+            return _json_response(200, {"items": [{"registry": "docker.io", "username": "prime"}]})
+
+        @route("POST", r"/registry/check-access")
+        def registry_check(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            image = body.get("image", "")
+            return _json_response(200, {"image": image, "accessible": not image.startswith("private/")})
+
+        @route("POST", r"/tunnels")
+        def create_tunnel(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            tunnel_id = f"tun_{uuid.uuid4().hex[:8]}"
+            tunnel = {
+                "tunnelId": tunnel_id,
+                "localPort": body.get("localPort"),
+                "hostname": f"{tunnel_id}.tunnels.fake",
+                "url": f"https://{tunnel_id}.tunnels.fake",
+                "frpToken": f"frp_{uuid.uuid4().hex[:12]}",
+                "serverHost": "tunnel-server.fake",
+                "serverPort": 7000,
+                "status": "REGISTERED",
+            }
+            plane.tunnels[tunnel_id] = tunnel
+            return _json_response(200, tunnel)
+
+        @route("GET", r"/tunnels/(?P<tid>[^/]+)")
+        def get_tunnel(request: httpx.Request, tid: str) -> httpx.Response:
+            tunnel = plane.tunnels.get(tid)
+            if not tunnel:
+                return _json_response(404, {"detail": "tunnel not found"})
+            return _json_response(200, tunnel)
+
+        @route("GET", r"/tunnels")
+        def list_tunnels(request: httpx.Request) -> httpx.Response:
+            return _json_response(200, {"items": list(plane.tunnels.values())})
+
+        @route("DELETE", r"/tunnels/(?P<tid>[^/]+)")
+        def delete_tunnel(request: httpx.Request, tid: str) -> httpx.Response:
+            if tid not in plane.tunnels:
+                return _json_response(404, {"detail": "tunnel not found"})
+            del plane.tunnels[tid]
+            return httpx.Response(204)
+
+        @route("POST", r"/feedback")
+        def feedback(request: httpx.Request) -> httpx.Response:
+            plane.feedback.append(plane.fake._body(request))
+            return _json_response(200, {"ok": True})

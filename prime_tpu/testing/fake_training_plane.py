@@ -1,0 +1,229 @@
+"""Fake hosted-training routes (/rft/* and /training/runs).
+
+Runs advance PENDING → RUNNING → COMPLETED across status polls and emit a
+few log lines per poll (per component/worker) so streaming/dedup logic is
+testable.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any
+
+import httpx
+
+from prime_tpu.parallel.topology import parse_slice
+from prime_tpu.testing.fake_backend import FakeControlPlane, _json_response
+
+_MODELS = [
+    {
+        "modelId": "m_llama3_8b",
+        "name": "llama3-8b",
+        "paramsB": 8.0,
+        "defaultTpu": "v5e-8",
+        "prices": [{"tier": "standard", "trainPerHour": 12.0, "inferencePerMtok": 0.3}],
+    },
+    {
+        "modelId": "m_llama3_70b",
+        "name": "llama3-70b",
+        "paramsB": 70.0,
+        "defaultTpu": "v5p-64",
+        "prices": [
+            {"tier": "standard", "trainPerHour": 96.0, "inferencePerMtok": 2.4},
+            {"tier": "priority", "trainPerHour": 144.0, "inferencePerMtok": 2.4},
+        ],
+    },
+]
+
+
+class FakeTrainingPlane:
+    def __init__(self, fake: FakeControlPlane, complete_after_polls: int = 3) -> None:
+        self.fake = fake
+        self.complete_after_polls = complete_after_polls
+        self.runs: dict[str, dict[str, Any]] = {}
+        self.payloads: dict[str, dict[str, Any]] = {}
+        self.checkpoints: dict[str, list[dict[str, Any]]] = {}
+        self._polls: dict[str, int] = {}
+        self._register()
+
+    def _advance(self, run_id: str) -> None:
+        run = self.runs[run_id]
+        if run["status"] in ("COMPLETED", "FAILED", "STOPPED"):
+            return
+        self._polls[run_id] = self._polls.get(run_id, 0) + 1
+        polls = self._polls[run_id]
+        if polls >= self.complete_after_polls:
+            run["status"] = "COMPLETED"
+            self.checkpoints.setdefault(run_id, []).append(
+                {"checkpointId": f"ckpt_{uuid.uuid4().hex[:8]}", "runId": run_id, "step": polls * 100}
+            )
+        elif polls >= 1:
+            run["status"] = "RUNNING"
+
+    def _register(self) -> None:
+        route = self.fake.route
+        plane = self
+
+        @route("GET", r"/rft/models")
+        def models(request: httpx.Request) -> httpx.Response:
+            return _json_response(200, {"items": _MODELS})
+
+        @route("GET", r"/rft/tpus")
+        def tpus(request: httpx.Request) -> httpx.Response:
+            rows = []
+            for name in ("v5e-8", "v5e-16", "v5e-64", "v5p-64", "v5p-128"):
+                spec = parse_slice(name)
+                rows.append(
+                    {
+                        "sliceName": spec.name,
+                        "chips": spec.chips,
+                        "hosts": spec.hosts,
+                        "priceHourly": round(spec.chips * (1.2 if spec.generation.value == "v5e" else 4.2), 2),
+                    }
+                )
+            return _json_response(200, rows)
+
+        @route("POST", r"/rft/runs/(?P<run_id>[^/]+)/stop")
+        def stop_run(request: httpx.Request, run_id: str) -> httpx.Response:
+            run = plane.runs.get(run_id)
+            if not run:
+                return _json_response(404, {"detail": "run not found"})
+            run["status"] = "STOPPED"
+            return _json_response(200, run)
+
+        @route("POST", r"/rft/runs/(?P<run_id>[^/]+)/restart")
+        def restart_run(request: httpx.Request, run_id: str) -> httpx.Response:
+            run = plane.runs.get(run_id)
+            if not run:
+                return _json_response(404, {"detail": "run not found"})
+            run["status"] = "PENDING"
+            plane._polls[run_id] = 0
+            return _json_response(200, run)
+
+        @route("GET", r"/rft/runs/(?P<run_id>[^/]+)/logs")
+        def logs(request: httpx.Request, run_id: str) -> httpx.Response:
+            run = plane.runs.get(run_id)
+            if not run:
+                return _json_response(404, {"detail": "run not found"})
+            polls = plane._polls.get(run_id, 0)
+            params = request.url.params
+            rows = []
+            for step in range(polls + 1):
+                for component in ("trainer", "inference"):
+                    for worker in range(2):
+                        rows.append(
+                            {
+                                "ts": f"2026-07-28T00:00:{step:02d}Z",
+                                "component": component,
+                                "workerIndex": worker,
+                                "level": "INFO",
+                                "message": f"{component} w{worker} step {step}",
+                            }
+                        )
+            if params.get("component"):
+                rows = [r for r in rows if r["component"] == params["component"]]
+            if params.get("worker_index") is not None and params.get("worker_index") != "":
+                rows = [r for r in rows if r["workerIndex"] == int(params["worker_index"])]
+            if params.get("search"):
+                rows = [r for r in rows if params["search"] in r["message"]]
+            return _json_response(200, {"items": rows})
+
+        @route("GET", r"/rft/runs/(?P<run_id>[^/]+)/components")
+        def components(request: httpx.Request, run_id: str) -> httpx.Response:
+            return _json_response(200, {"items": ["trainer", "inference", "env"]})
+
+        @route("GET", r"/rft/runs/(?P<run_id>[^/]+)/metrics")
+        def metrics(request: httpx.Request, run_id: str) -> httpx.Response:
+            polls = plane._polls.get(run_id, 0)
+            return _json_response(200, {"step": polls * 100, "loss": max(0.1, 2.0 - polls * 0.5), "reward": polls * 0.2})
+
+        @route("GET", r"/rft/runs/(?P<run_id>[^/]+)/rollouts")
+        def rollouts(request: httpx.Request, run_id: str) -> httpx.Response:
+            return _json_response(
+                200,
+                {"items": [{"step": i, "reward": 0.5, "completion": f"rollout {i}"} for i in range(3)]},
+            )
+
+        @route("GET", r"/rft/runs/(?P<run_id>[^/]+)/progress")
+        def progress(request: httpx.Request, run_id: str) -> httpx.Response:
+            polls = plane._polls.get(run_id, 0)
+            return _json_response(200, {"step": polls * 100, "totalSteps": 300, "pct": min(100, polls * 33)})
+
+        @route("GET", r"/rft/runs/(?P<run_id>[^/]+)/distributions")
+        def distributions(request: httpx.Request, run_id: str) -> httpx.Response:
+            return _json_response(200, {"reward": {"p50": 0.4, "p90": 0.8}})
+
+        @route("GET", r"/rft/runs/(?P<run_id>[^/]+)/checkpoints")
+        def checkpoints(request: httpx.Request, run_id: str) -> httpx.Response:
+            return _json_response(200, {"items": plane.checkpoints.get(run_id, [])})
+
+        @route("GET", r"/rft/runs/(?P<run_id>[^/]+)")
+        def get_run(request: httpx.Request, run_id: str) -> httpx.Response:
+            run = plane.runs.get(run_id)
+            if not run:
+                return _json_response(404, {"detail": "run not found"})
+            plane._advance(run_id)
+            return _json_response(200, run)
+
+        @route("GET", r"/rft/runs")
+        def list_runs(request: httpx.Request) -> httpx.Response:
+            return plane.fake._paginate(request, list(plane.runs.values()))
+
+        @route("POST", r"/rft/runs")
+        def create_run(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            if body.get("env", {}).get("id") in (None, ""):
+                return _json_response(
+                    422,
+                    {"detail": [{"loc": ["body", "env", "id"], "msg": "env id required", "type": "value_error"}]},
+                )
+            run_id = f"run_{uuid.uuid4().hex[:8]}"
+            run = {
+                "runId": run_id,
+                "name": body.get("name", run_id),
+                "model": body.get("model", ""),
+                "env": body.get("env", {}).get("id"),
+                "status": "PENDING",
+                "runType": body.get("runType", "lora"),
+                "tpuType": body.get("tpuType"),
+                "numSlices": body.get("numSlices", 1),
+                "createdAt": "2026-07-28T00:00:00Z",
+                "progress": {},
+            }
+            plane.runs[run_id] = run
+            plane.payloads[run_id] = body
+            return _json_response(200, run)
+
+        @route("DELETE", r"/rft/runs/(?P<run_id>[^/]+)")
+        def delete_run(request: httpx.Request, run_id: str) -> httpx.Response:
+            if run_id not in plane.runs:
+                return _json_response(404, {"detail": "run not found"})
+            del plane.runs[run_id]
+            return httpx.Response(204)
+
+        @route("POST", r"/training/runs")
+        def create_full_ft(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            run_id = f"run_{uuid.uuid4().hex[:8]}"
+            run = {
+                "runId": run_id,
+                "name": body.get("name", run_id),
+                "model": "full-ft",
+                "status": "PENDING",
+                "runType": "full_finetune",
+                "tpuType": body.get("tpuType"),
+                "numSlices": body.get("numSlices", 1),
+                "runToken": f"rtok_{uuid.uuid4().hex}",  # minted server-side
+                "createdAt": "2026-07-28T00:00:00Z",
+                "progress": {},
+            }
+            plane.runs[run_id] = run
+            plane.payloads[run_id] = body
+            return _json_response(200, run)
+
+        @route("GET", r"/training/runs/(?P<run_id>[^/]+)")
+        def get_full_ft(request: httpx.Request, run_id: str) -> httpx.Response:
+            run = plane.runs.get(run_id)
+            if not run:
+                return _json_response(404, {"detail": "run not found"})
+            return _json_response(200, run)

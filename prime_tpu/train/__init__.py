@@ -1,17 +1,25 @@
-from prime_tpu.train.trainer import (
-    TrainState,
-    cross_entropy_loss,
-    default_optimizer,
-    init_train_state,
-    make_train_step,
-    shard_train_state,
-)
+"""Training: TOML config schema (pure pydantic) + sharded JAX trainer.
 
-__all__ = [
+Lazy exports: ``prime_tpu.train.config`` is importable without pulling in
+jax/optax (the CLI loads it for --help), while the trainer symbols resolve on
+first access.
+"""
+
+_TRAINER_EXPORTS = {
     "TrainState",
     "cross_entropy_loss",
     "default_optimizer",
     "init_train_state",
     "make_train_step",
     "shard_train_state",
-]
+}
+
+__all__ = sorted(_TRAINER_EXPORTS)
+
+
+def __getattr__(name: str):
+    if name in _TRAINER_EXPORTS:
+        from prime_tpu.train import trainer
+
+        return getattr(trainer, name)
+    raise AttributeError(f"module 'prime_tpu.train' has no attribute {name!r}")

@@ -1,0 +1,10 @@
+"""prime-tpu tunnel SDK: expose local ports via managed frp tunnels.
+
+Reference: prime_tunnel (SURVEY.md §2.5) — register with the backend, write
+an frpc TOML config, spawn the frpc data plane, parse its log stream for
+connect/fail, poll the registration.
+"""
+
+from prime_tpu.tunnel.tunnel import Tunnel, TunnelError
+
+__all__ = ["Tunnel", "TunnelError"]

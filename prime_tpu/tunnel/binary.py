@@ -1,0 +1,100 @@
+"""frpc binary manager (reference: prime_tunnel/binary.py:15-155).
+
+Downloads the pinned frp release per-platform with SHA256 verification into a
+cache dir. Zero-egress environments point PRIME_FRPC_PATH at an existing
+binary instead — the download is attempted only when no override or cached
+copy exists.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import platform
+import tarfile
+import tempfile
+from pathlib import Path
+
+FRPC_VERSION = "0.66.0"
+# sha256 of the release tarballs (fatedier/frp v0.66.0)
+FRPC_CHECKSUMS = {
+    "linux_amd64": "d73b4d8dd3a5ce352354b6a9b47da3a5a6a268137ba0728ceba1864dcc4e4e4c",
+    "linux_arm64": "e9e73fcbf15c9fb9aa7e1e90826de5fddfbee125661c0dd0de7469aa5b38ab25",
+    "darwin_amd64": "3fa0e2e3834aa08eac1737dca9002bbd5a08e5bba5826e5e8bcb4b9013ef1a0e",
+    "darwin_arm64": "92dd6d23449e61e2e174168add13c0a1df894e5b5e0e1a0d8350c8169f5a989e",
+}
+RELEASE_URL = "https://github.com/fatedier/frp/releases/download/v{v}/frp_{v}_{plat}.tar.gz"
+
+
+class FrpcUnavailable(RuntimeError):
+    pass
+
+
+def _platform_key() -> str:
+    system = platform.system().lower()
+    machine = platform.machine().lower()
+    arch = {"x86_64": "amd64", "amd64": "amd64", "arm64": "arm64", "aarch64": "arm64"}.get(machine)
+    if system not in ("linux", "darwin") or arch is None:
+        raise FrpcUnavailable(f"No frpc build for {system}/{machine}")
+    return f"{system}_{arch}"
+
+
+def cache_dir() -> Path:
+    env_dir = os.environ.get("PRIME_CONFIG_DIR")
+    base = Path(env_dir) if env_dir else Path.home() / ".prime"
+    return base / "bin"
+
+
+def get_frpc_path(download: bool = True) -> Path:
+    """Resolve the frpc binary: override > cache > (optional) download."""
+    override = os.environ.get("PRIME_FRPC_PATH")
+    if override:
+        path = Path(override)
+        if not path.exists():
+            raise FrpcUnavailable(f"PRIME_FRPC_PATH={override} does not exist")
+        return path
+    cached = cache_dir() / f"frpc-{FRPC_VERSION}"
+    if cached.exists():
+        return cached
+    if not download:
+        raise FrpcUnavailable("frpc not cached and download disabled")
+    return _download_frpc(cached)
+
+
+def _download_frpc(target: Path) -> Path:
+    import httpx
+
+    plat = _platform_key()
+    expected = FRPC_CHECKSUMS.get(plat)
+    if expected is None:
+        raise FrpcUnavailable(f"No pinned checksum for platform {plat}")
+    url = RELEASE_URL.format(v=FRPC_VERSION, plat=plat)
+    try:
+        response = httpx.get(url, follow_redirects=True, timeout=120.0)
+        response.raise_for_status()
+    except httpx.HTTPError as e:
+        raise FrpcUnavailable(
+            f"Could not download frpc from {url}: {e}. "
+            "Set PRIME_FRPC_PATH to an existing frpc binary."
+        ) from e
+    data = response.content
+    digest = hashlib.sha256(data).hexdigest()
+    if digest != expected:
+        raise FrpcUnavailable(
+            f"frpc download checksum mismatch for {plat}: got {digest}, expected {expected}"
+        )
+    with tempfile.TemporaryDirectory() as tmp:
+        archive = Path(tmp) / "frp.tar.gz"
+        archive.write_bytes(data)
+        with tarfile.open(archive) as tar:
+            member = next((m for m in tar.getmembers() if m.name.endswith("/frpc")), None)
+            if member is None:
+                raise FrpcUnavailable(
+                    f"frp release archive has no frpc binary (layout changed?): {url}"
+                )
+            tar.extract(member, tmp, filter="data")
+            extracted = Path(tmp) / member.name
+            target.parent.mkdir(parents=True, exist_ok=True)
+            target.write_bytes(extracted.read_bytes())
+            target.chmod(0o755)
+    return target

@@ -1,0 +1,147 @@
+"""Tunnel lifecycle (reference: prime_tunnel/tunnel.py:59-498).
+
+start(): register with the backend → write frpc TOML → spawn frpc → a reader
+thread parses its log stream until success/error/timeout → poll registration.
+stop(): delete the registration, terminate the process, clean the config.
+"""
+
+from __future__ import annotations
+
+import re
+import subprocess
+import tempfile
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+from prime_tpu.core.client import APIClient
+from prime_tpu.tunnel.binary import get_frpc_path
+
+_LOG_SUCCESS_RE = re.compile(r"start proxy success|start tunnel success", re.IGNORECASE)
+_LOG_ERROR_RE = re.compile(r"(start error|login to server failed|proxy .* start error|connect to server error)(.*)", re.IGNORECASE)
+
+START_TIMEOUT_S = 30.0
+
+
+class TunnelError(RuntimeError):
+    pass
+
+
+class Tunnel:
+    """Expose a local port through a managed frp tunnel."""
+
+    def __init__(
+        self,
+        local_port: int,
+        client: APIClient | None = None,
+        basic_auth: tuple[str, str] | None = None,
+        frpc_path: str | Path | None = None,
+    ) -> None:
+        self.local_port = local_port
+        self.api = client or APIClient()
+        self.basic_auth = basic_auth
+        self._frpc_path = Path(frpc_path) if frpc_path else None
+        self.registration: dict[str, Any] | None = None
+        self.process: subprocess.Popen | None = None
+        self._config_path: Path | None = None
+        self._connected = threading.Event()
+        self._error: str | None = None
+
+    @property
+    def url(self) -> str | None:
+        return self.registration.get("url") if self.registration else None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, timeout_s: float = START_TIMEOUT_S) -> str:
+        """Register, launch frpc, wait for the proxy to come up. Returns URL."""
+        frpc = self._frpc_path or get_frpc_path()
+        self.registration = self.api.post(
+            "/tunnels", json={"localPort": self.local_port}, idempotent_post=True
+        )
+        self._config_path = self._write_config(self.registration)
+        self.process = subprocess.Popen(
+            [str(frpc), "-c", str(self._config_path)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        reader = threading.Thread(target=self._read_logs, daemon=True)
+        reader.start()
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._error:
+                self.stop()
+                raise TunnelError(f"frpc failed: {self._error}")
+            if self._connected.is_set():
+                return self.registration["url"]
+            if self.process.poll() is not None:
+                self.stop()
+                raise TunnelError(f"frpc exited with code {self.process.returncode}")
+            time.sleep(0.1)
+        self.stop()
+        raise TunnelError(f"Tunnel did not connect within {timeout_s}s")
+
+    def status(self) -> dict[str, Any]:
+        if not self.registration:
+            return {"status": "NOT_STARTED"}
+        remote = self.api.get(f"/tunnels/{self.registration['tunnelId']}")
+        remote["processAlive"] = self.process is not None and self.process.poll() is None
+        return remote
+
+    def stop(self) -> None:
+        if self.registration:
+            try:
+                self.api.delete(f"/tunnels/{self.registration['tunnelId']}")
+            except Exception:
+                pass
+        if self.process and self.process.poll() is None:
+            self.process.terminate()
+            try:
+                self.process.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.process.kill()
+        if self._config_path and self._config_path.exists():
+            self._config_path.unlink(missing_ok=True)
+
+    def __enter__(self) -> "Tunnel":
+        self.start()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # -- internals -----------------------------------------------------------
+
+    def _write_config(self, registration: dict[str, Any]) -> Path:
+        lines = [
+            f'serverAddr = "{registration["serverHost"]}"',
+            f"serverPort = {registration['serverPort']}",
+            f'auth.token = "{registration["frpToken"]}"',
+            "",
+            "[[proxies]]",
+            f'name = "{registration["tunnelId"]}"',
+            'type = "http"',
+            f"localPort = {self.local_port}",
+            f'customDomains = ["{registration["hostname"]}"]',
+        ]
+        if self.basic_auth:
+            user, password = self.basic_auth
+            lines += [f'httpUser = "{user}"', f'httpPassword = "{password}"']
+        fd, path = tempfile.mkstemp(prefix="frpc-", suffix=".toml")
+        Path(path).write_text("\n".join(lines) + "\n")
+        import os
+
+        os.close(fd)
+        return Path(path)
+
+    def _read_logs(self) -> None:
+        assert self.process is not None and self.process.stdout is not None
+        for line in self.process.stdout:
+            if _LOG_SUCCESS_RE.search(line):
+                self._connected.set()
+            match = _LOG_ERROR_RE.search(line)
+            if match:
+                self._error = line.strip()

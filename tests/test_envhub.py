@@ -1,0 +1,187 @@
+"""Environments Hub: packaging, hashing, push/pull/install round trips."""
+
+import json
+
+import pytest
+from click.testing import CliRunner
+
+import prime_tpu.commands._deps as deps
+from prime_tpu.commands.main import cli
+from prime_tpu.envhub.packaging import (
+    build_archive,
+    content_hash,
+    extract_archive,
+    iter_env_files,
+    read_env_metadata,
+    write_env_template,
+)
+from prime_tpu.testing import FakeControlPlane
+
+
+@pytest.fixture
+def fake(monkeypatch):
+    fake = FakeControlPlane()
+    monkeypatch.setattr(deps, "transport_override", fake.transport)
+    monkeypatch.setenv("PRIME_API_KEY", "test-key")
+    monkeypatch.setenv("PRIME_BASE_URL", "https://api.fake")
+    return fake
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+@pytest.fixture
+def env_dir(tmp_path):
+    d = tmp_path / "my-env"
+    write_env_template(d, "my-env")
+    (d / "data").mkdir()
+    (d / "data" / "eval.jsonl").write_text('{"question": "1+1?", "answer": "#### 2"}\n')
+    return d
+
+
+def test_template_and_metadata(env_dir):
+    metadata = read_env_metadata(env_dir)
+    assert metadata["name"] == "my-env"
+    assert metadata["tpu"]["tpu_type"] == "v5e"
+
+
+def test_gitignore_filtering(env_dir):
+    (env_dir / "__pycache__").mkdir()
+    (env_dir / "__pycache__" / "junk.pyc").write_text("x")
+    (env_dir / ".gitignore").write_text("scratch/\n*.log\n")
+    (env_dir / "scratch").mkdir()
+    (env_dir / "scratch" / "tmp.txt").write_text("x")
+    (env_dir / "debug.log").write_text("x")
+    files = [f.name for f in iter_env_files(env_dir)]
+    assert "junk.pyc" not in files and "tmp.txt" not in files and "debug.log" not in files
+    assert "env.toml" in files
+
+
+def test_content_hash_is_deterministic_and_drift_sensitive(env_dir):
+    h1 = content_hash(env_dir)
+    assert h1 == content_hash(env_dir)
+    (env_dir / "data" / "eval.jsonl").write_text('{"question": "2+2?", "answer": "#### 4"}\n')
+    assert content_hash(env_dir) != h1
+
+
+def test_archive_roundtrip_and_determinism(env_dir, tmp_path):
+    a1 = build_archive(env_dir)
+    a2 = build_archive(env_dir)
+    assert a1 == a2  # byte-identical (zeroed mtimes)
+    out = tmp_path / "extracted"
+    extract_archive(a1, out)
+    assert (out / "env.toml").read_text() == (env_dir / "env.toml").read_text()
+    assert (out / "data" / "eval.jsonl").exists()
+
+
+def test_push_pull_install_cli_roundtrip(runner, fake, env_dir, tmp_path, monkeypatch):
+    result = runner.invoke(cli, ["env", "push", "--dir", str(env_dir)])
+    assert result.exit_code == 0, result.output
+    assert "Pushed my-env@0.1.0" in result.output
+
+    # idempotent push: unchanged content is detected by hash
+    result = runner.invoke(cli, ["env", "push", "--dir", str(env_dir)])
+    assert "unchanged" in result.output
+
+    result = runner.invoke(cli, ["env", "list", "--output", "json"])
+    envs = json.loads(result.output)
+    assert envs[0]["name"] == "my-env"
+
+    pull_dir = tmp_path / "pulled"
+    result = runner.invoke(cli, ["env", "pull", "my-env", "--dir", str(pull_dir)])
+    assert result.exit_code == 0, result.output
+    assert (pull_dir / "data" / "eval.jsonl").exists()
+
+    result = runner.invoke(cli, ["env", "install", "my-env"])
+    assert result.exit_code == 0, result.output
+    result = runner.invoke(cli, ["env", "list", "--installed", "--plain"])
+    assert "my-env" in result.output
+
+    result = runner.invoke(cli, ["env", "uninstall", "my-env"])
+    assert result.exit_code == 0
+    result = runner.invoke(cli, ["env", "list", "--installed", "--plain"])
+    assert "my-env" not in result.output
+
+
+def test_env_secrets_and_versions_cli(runner, fake, env_dir):
+    runner.invoke(cli, ["env", "push", "--dir", str(env_dir)])
+    assert runner.invoke(cli, ["env", "secrets", "set", "my-env", "HF_TOKEN", "tok"]).exit_code == 0
+    result = runner.invoke(cli, ["env", "secrets", "list", "my-env", "--plain"])
+    assert "HF_TOKEN" in result.output
+    assert runner.invoke(cli, ["env", "secrets", "delete", "my-env", "HF_TOKEN"]).exit_code == 0
+
+    result = runner.invoke(cli, ["env", "versions", "my-env", "--plain"])
+    assert "0.1.0" in result.output
+    result = runner.invoke(cli, ["env", "actions", "my-env", "--plain"])
+    assert "push" in result.output
+
+
+def test_push_without_env_toml_fails_cleanly(runner, fake, tmp_path):
+    result = runner.invoke(cli, ["env", "push", "--dir", str(tmp_path)])
+    assert result.exit_code != 0
+    assert "env.toml" in result.output
+
+
+def test_env_init_cli(runner, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    result = runner.invoke(cli, ["env", "init", "fresh-env"])
+    assert result.exit_code == 0
+    assert (tmp_path / "fresh-env" / "env.toml").exists()
+    assert (tmp_path / "fresh-env" / "fresh_env.py").exists()
+
+
+def test_install_removes_stale_files(runner, fake, env_dir, tmp_path):
+    runner.invoke(cli, ["env", "push", "--dir", str(env_dir)])
+    runner.invoke(cli, ["env", "install", "my-env"])
+    from prime_tpu.commands.env import installs_dir
+
+    stale = installs_dir() / "my-env" / "old_task.py"
+    assert stale.parent.exists()
+    # simulate a v2 that no longer contains a file present in v1's install
+    (env_dir / "env.toml").write_text((env_dir / "env.toml").read_text().replace("0.1.0", "0.2.0"))
+    runner.invoke(cli, ["env", "push", "--dir", str(env_dir)])
+    stale.write_text("# leftover from v1")
+    result = runner.invoke(cli, ["env", "install", "my-env"])
+    assert result.exit_code == 0, result.output
+    assert not stale.exists()
+
+
+def test_pull_refuses_nonempty_dir(runner, fake, env_dir, tmp_path):
+    runner.invoke(cli, ["env", "push", "--dir", str(env_dir)])
+    target = tmp_path / "occupied"
+    target.mkdir()
+    (target / "keep.txt").write_text("mine")
+    result = runner.invoke(cli, ["env", "pull", "my-env", "--dir", str(target)])
+    assert result.exit_code != 0
+    assert "not empty" in result.output
+    assert (target / "keep.txt").read_text() == "mine"
+
+
+def test_repush_identical_old_version_is_not_conflict(fake):
+    """Per-version hashes: re-pushing identical v0.1.0 after v0.2.0 exists."""
+    plane = fake.envhub_plane
+    import base64, httpx
+
+    def push(version, digest):
+        return fake.handle(
+            httpx.Request(
+                "POST",
+                "https://api.fake/api/v1/envhub/environments/push",
+                headers={"Authorization": "Bearer test-key"},
+                content=__import__("json").dumps(
+                    {
+                        "name": "e",
+                        "version": version,
+                        "contentHash": digest,
+                        "archiveB64": base64.b64encode(b"x").decode(),
+                    }
+                ).encode(),
+            )
+        )
+
+    assert push("0.1.0", "hashA").status_code == 200
+    assert push("0.2.0", "hashB").status_code == 200
+    assert push("0.1.0", "hashA").status_code == 200  # identical re-push ok
+    assert push("0.1.0", "hashC").status_code == 409  # changed content conflicts

@@ -1,0 +1,233 @@
+"""Login, inference, secrets, deployments, usage, images/registry, tunnel,
+feedback, upgrade, lab — against the fake planes."""
+
+import json
+import stat
+
+import pytest
+from click.testing import CliRunner
+
+import prime_tpu.commands._deps as deps
+from prime_tpu.commands.main import cli
+from prime_tpu.testing import FakeControlPlane
+
+
+@pytest.fixture
+def fake(monkeypatch):
+    fake = FakeControlPlane()
+    monkeypatch.setattr(deps, "transport_override", fake.transport)
+    monkeypatch.setenv("PRIME_API_KEY", "test-key")
+    monkeypatch.setenv("PRIME_BASE_URL", "https://api.fake")
+    monkeypatch.setenv("PRIME_INFERENCE_URL", "https://inference.fake/v1")
+    return fake
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+# -- login -------------------------------------------------------------------
+
+
+def test_login_challenge_flow_decrypts_key(runner, fake, monkeypatch):
+    monkeypatch.delenv("PRIME_API_KEY")  # login must work without a key
+    monkeypatch.setattr("prime_tpu.commands.login.browser_open", lambda url: True)
+    monkeypatch.setattr("prime_tpu.commands.login.POLL_INTERVAL_S", 0)
+    result = runner.invoke(cli, ["login"])
+    assert result.exit_code == 0, result.output
+    assert "Logged in as dev@example.com" in result.output
+    # the OAEP-decrypted key now authenticates real calls
+    assert deps.build_config().api_key == "test-key"
+    result = runner.invoke(cli, ["whoami", "--output", "json"])
+    assert json.loads(result.output)["email"] == "dev@example.com"
+
+
+def test_login_no_browser_prints_url(runner, fake, monkeypatch):
+    monkeypatch.delenv("PRIME_API_KEY")
+    monkeypatch.setattr("prime_tpu.commands.login.POLL_INTERVAL_S", 0)
+    result = runner.invoke(cli, ["login", "--no-browser"])
+    assert "https://app.fake/auth/" in result.output
+
+
+def test_logout_clears_key(runner, fake, monkeypatch):
+    monkeypatch.delenv("PRIME_API_KEY")
+    cfg = deps.build_config()
+    cfg.api_key = "something"
+    cfg.save()
+    assert runner.invoke(cli, ["logout"]).exit_code == 0
+    assert deps.build_config().api_key == ""
+
+
+# -- inference ---------------------------------------------------------------
+
+
+def test_inference_models_and_chat(runner, fake):
+    result = runner.invoke(cli, ["inference", "models", "--plain"])
+    assert "llama3-8b" in result.output
+    result = runner.invoke(
+        cli, ["inference", "chat", "llama3-8b", "-m", "hello tpu", "--no-stream", "--output", "json"]
+    )
+    data = json.loads(result.output)
+    assert data["choices"][0]["message"]["content"] == "echo: hello tpu"
+
+
+def test_inference_chat_streaming(runner, fake):
+    result = runner.invoke(cli, ["inference", "chat", "llama3-8b", "-m", "stream me please"])
+    assert result.exit_code == 0, result.output
+    assert "echo: stream me please" in result.output
+
+
+# -- secrets / deployments / usage / feedback --------------------------------
+
+
+def test_secrets_crud(runner, fake):
+    assert runner.invoke(cli, ["secrets", "set", "WANDB_API_KEY", "w"]).exit_code == 0
+    result = runner.invoke(cli, ["secrets", "list", "--plain"])
+    assert "WANDB_API_KEY" in result.output
+    assert runner.invoke(cli, ["secrets", "delete", "WANDB_API_KEY", "--yes"]).exit_code == 0
+    result = runner.invoke(cli, ["secrets", "list", "--plain"])
+    assert "WANDB_API_KEY" not in result.output
+
+
+def test_deployments_flow(runner, fake):
+    result = runner.invoke(cli, ["deployments", "deploy", "--checkpoint", "ckpt_123", "--output", "json"])
+    adapter_id = json.loads(result.output)["adapterId"]
+    result = runner.invoke(cli, ["deployments", "list", "--plain"])
+    assert adapter_id in result.output
+    result = runner.invoke(cli, ["deployments", "base-models", "--plain"])
+    assert "llama3-8b" in result.output
+    assert runner.invoke(cli, ["deployments", "unload", adapter_id]).exit_code == 0
+
+
+def test_usage_and_watch(runner, fake):
+    result = runner.invoke(cli, ["usage", "--output", "json"])
+    rows = json.loads(result.output)
+    assert rows[0]["runId"] == "run_demo1"
+    result = runner.invoke(cli, ["usage", "--watch", "--interval", "0", "--iterations", "2", "--plain"])
+    assert result.output.count("run_demo1") == 2
+
+
+def test_feedback(runner, fake):
+    assert runner.invoke(cli, ["feedback", "love the TPUs"]).exit_code == 0
+    assert fake.misc_plane.feedback == [{"message": "love the TPUs"}]
+
+
+# -- images / registry -------------------------------------------------------
+
+
+def test_images_build_flow(runner, fake, tmp_path):
+    dockerfile = tmp_path / "Dockerfile"
+    dockerfile.write_text("FROM primetpu/jax-tpu:latest\n")
+    result = runner.invoke(
+        cli, ["images", "push", "--name", "my-image", "--dockerfile", str(dockerfile), "--output", "json"]
+    )
+    image_id = json.loads(result.output)["imageId"]
+    result = runner.invoke(cli, ["images", "build-status", image_id, "--output", "json"])
+    assert json.loads(result.output)["status"] == "READY"
+    assert runner.invoke(cli, ["images", "publish", image_id]).exit_code == 0
+    result = runner.invoke(cli, ["images", "list", "--plain"])
+    assert "my-image" in result.output and "public" in result.output
+
+
+def test_registry_commands(runner, fake):
+    result = runner.invoke(cli, ["registry", "credentials", "--plain"])
+    assert "docker.io" in result.output
+    result = runner.invoke(cli, ["registry", "check-access", "python:3.12", "--plain"])
+    assert "accessible" in result.output
+    result = runner.invoke(cli, ["registry", "check-access", "private/img", "--plain"])
+    assert "NOT accessible" in result.output
+
+
+# -- tunnel ------------------------------------------------------------------
+
+
+FAKE_FRPC = """\
+#!/usr/bin/env python3
+import sys, time
+print("frpc starting with config", sys.argv[-1], flush=True)
+print("[proxy] start proxy success", flush=True)
+time.sleep(60)
+"""
+
+
+@pytest.fixture
+def fake_frpc(tmp_path):
+    script = tmp_path / "frpc"
+    script.write_text(FAKE_FRPC)
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return script
+
+
+def test_tunnel_sdk_lifecycle(fake, fake_frpc, monkeypatch):
+    from prime_tpu.core.client import APIClient
+    from prime_tpu.core.config import Config
+    from prime_tpu.tunnel import Tunnel
+
+    cfg = Config()
+    cfg.api_key = "test-key"
+    api = APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    tunnel = Tunnel(8080, client=api, frpc_path=fake_frpc)
+    url = tunnel.start(timeout_s=15)
+    assert url.startswith("https://") and "tunnels.fake" in url
+    assert len(fake.misc_plane.tunnels) == 1
+    status = tunnel.status()
+    assert status["processAlive"] is True
+    tunnel.stop()
+    assert fake.misc_plane.tunnels == {}
+    assert tunnel.process.poll() is not None
+
+
+def test_tunnel_sdk_failure_log(fake, tmp_path):
+    from prime_tpu.core.client import APIClient
+    from prime_tpu.core.config import Config
+    from prime_tpu.tunnel import Tunnel, TunnelError
+
+    bad = tmp_path / "frpc"
+    bad.write_text("#!/usr/bin/env python3\nprint('login to server failed: auth', flush=True)\n")
+    bad.chmod(0o755)
+    cfg = Config()
+    cfg.api_key = "test-key"
+    api = APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    tunnel = Tunnel(8080, client=api, frpc_path=bad)
+    with pytest.raises(TunnelError, match="login to server failed"):
+        tunnel.start(timeout_s=10)
+
+
+def test_tunnel_cli_list_stop(runner, fake, fake_frpc):
+    # create a registration directly via the API (start would block on frpc)
+    client_result = runner.invoke(cli, ["tunnel", "list", "--output", "json"])
+    assert json.loads(client_result.output) == []
+    from prime_tpu.core.client import APIClient
+    from prime_tpu.core.config import Config
+
+    cfg = Config()
+    cfg.api_key = "test-key"
+    api = APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    created = api.post("/tunnels", json={"localPort": 9999})
+    result = runner.invoke(cli, ["tunnel", "list", "--plain"])
+    assert created["tunnelId"] in result.output
+    assert runner.invoke(cli, ["tunnel", "stop", created["tunnelId"]]).exit_code == 0
+
+
+# -- upgrade / lab -----------------------------------------------------------
+
+
+def test_upgrade_reports_method(runner, fake):
+    result = runner.invoke(cli, ["upgrade", "--output", "json"])
+    data = json.loads(result.output)
+    assert data["installMethod"] in ("pip", "pipx", "uv-tool", "source")
+    assert data["command"]
+
+
+def test_lab_setup_and_doctor(runner, fake, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    result = runner.invoke(cli, ["lab", "setup"])
+    assert result.exit_code == 0
+    assert (tmp_path / ".prime-lab" / "lab.toml").exists()
+    assert "outputs/" in (tmp_path / ".gitignore").read_text()
+    result = runner.invoke(cli, ["lab", "doctor", "--output", "json"])
+    checks = json.loads(result.output)
+    assert checks["workspace"] is True and checks["jax"] is True
+    result = runner.invoke(cli, ["lab", "view"])
+    assert result.exit_code != 0  # textual not installed -> clear error

@@ -1,0 +1,178 @@
+"""Hosted training: TOML schema, dispatch, monitoring."""
+
+import json
+
+import pytest
+from click.testing import CliRunner
+
+import prime_tpu.commands._deps as deps
+from prime_tpu.commands.main import cli
+from prime_tpu.testing import FakeControlPlane
+from prime_tpu.train.config import RL_TOML_TEMPLATE, load_rl_config, strip_deprecated
+
+
+@pytest.fixture
+def fake(monkeypatch):
+    fake = FakeControlPlane()
+    monkeypatch.setattr(deps, "transport_override", fake.transport)
+    monkeypatch.setenv("PRIME_API_KEY", "test-key")
+    monkeypatch.setenv("PRIME_BASE_URL", "https://api.fake")
+    return fake
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+@pytest.fixture
+def toml_file(tmp_path):
+    path = tmp_path / "job.toml"
+    path.write_text(RL_TOML_TEMPLATE.format(name="my-run"))
+    return path
+
+
+# -- schema ------------------------------------------------------------------
+
+
+def test_template_parses(toml_file):
+    config, warnings = load_rl_config(toml_file)
+    assert config.name == "my-run" and config.type == "lora"
+    assert config.infrastructure.tpu_type == "v5e-8"
+    assert warnings == []
+
+
+def test_unknown_key_is_an_error(tmp_path):
+    path = tmp_path / "bad.toml"
+    path.write_text('name = "x"\nmodel = "m"\nbogus_key = 1\n[env]\nid = "e"\n')
+    import pydantic
+
+    with pytest.raises(pydantic.ValidationError):
+        load_rl_config(path)
+
+
+def test_deprecated_gpu_keys_stripped_with_warning(tmp_path):
+    raw = {"name": "x", "gpu_type": "H100", "env": {"id": "e", "nccl_timeout": 30}}
+    cleaned, warnings = strip_deprecated(raw)
+    assert "gpu_type" not in cleaned
+    assert "nccl_timeout" not in cleaned["env"]
+    assert any("tpu_type" in w for w in warnings)
+    assert any("no TPU equivalent" in w for w in warnings)
+
+
+def test_full_finetune_detection(tmp_path):
+    path = tmp_path / "ft.toml"
+    path.write_text('name = "ft"\nmodel = "llama3-8b"\ntype = "full_finetune"\n[env]\nid = "e"\n')
+    config, _ = load_rl_config(path)
+    assert config.is_full_finetune
+
+
+# -- dispatch ----------------------------------------------------------------
+
+
+def test_train_run_lora_dispatch(runner, fake, toml_file):
+    result = runner.invoke(cli, ["train", "run", str(toml_file), "--yes", "--output", "json"])
+    assert result.exit_code == 0, result.output
+    run_id = json.loads(result.output)["runId"]
+    payload = fake.training_plane.payloads[run_id]
+    assert payload["tpuType"] == "v5e-8" and payload["adapter"]["r"] == 16
+
+
+def test_train_default_group_toml_shorthand(runner, fake, toml_file):
+    """`prime train foo.toml` ≡ `prime train run foo.toml`."""
+    result = runner.invoke(cli, ["train", str(toml_file), "--yes"])
+    assert result.exit_code == 0, result.output
+    assert "dispatched" in result.output
+
+
+def test_train_full_ft_ships_whole_toml(runner, fake, tmp_path):
+    path = tmp_path / "ft.toml"
+    path.write_text(
+        'name = "ft"\nmodel = "llama3-70b"\ntype = "full_finetune"\n'
+        '[env]\nid = "e"\n[infrastructure]\ntpu_type = "v5p-64"\nnum_slices = 2\n'
+    )
+    result = runner.invoke(cli, ["train", str(path), "--yes", "--output", "json"])
+    assert result.exit_code == 0, result.output
+    run_id = json.loads(result.output)["runId"]
+    payload = fake.training_plane.payloads[run_id]
+    assert "config" in payload and 'type = "full_finetune"' in payload["config"]
+    assert payload["tpuType"] == "v5p-64" and payload["numSlices"] == 2
+    assert fake.training_plane.runs[run_id]["runToken"].startswith("rtok_")
+
+
+def test_invalid_config_fails_cleanly(runner, fake, tmp_path):
+    path = tmp_path / "bad.toml"
+    path.write_text('name = "x"\nmodel = "m"\nwrong = 1\n[env]\nid = "e"\n')
+    result = runner.invoke(cli, ["train", str(path), "--yes"])
+    assert result.exit_code != 0
+    assert "Invalid config" in result.output and "wrong" in result.output
+
+
+# -- monitoring --------------------------------------------------------------
+
+
+def _dispatch(runner, toml_file) -> str:
+    result = runner.invoke(cli, ["train", "run", str(toml_file), "--yes", "--output", "json"])
+    return json.loads(result.output)["runId"]
+
+
+def test_lifecycle_and_monitoring(runner, fake, toml_file):
+    run_id = _dispatch(runner, toml_file)
+    result = runner.invoke(cli, ["train", "list", "--plain"])
+    assert "my-run" in result.output
+
+    # status advances per poll
+    runner.invoke(cli, ["train", "get", run_id])
+    result = runner.invoke(cli, ["train", "get", run_id, "--output", "json"])
+    assert json.loads(result.output)["status"] in ("RUNNING", "COMPLETED")
+
+    result = runner.invoke(cli, ["train", "logs", run_id, "--component", "trainer", "--worker", "0", "--plain"])
+    assert "trainer w0" in result.output and "inference" not in result.output
+
+    result = runner.invoke(cli, ["train", "metrics", run_id, "--output", "json"])
+    assert "loss" in json.loads(result.output)
+
+    result = runner.invoke(cli, ["train", "progress", run_id, "--output", "json"])
+    assert "pct" in json.loads(result.output)
+
+    result = runner.invoke(cli, ["train", "rollouts", run_id, "--plain"])
+    assert "rollout" in result.output
+
+    result = runner.invoke(cli, ["train", "components", run_id, "--plain"])
+    assert "trainer" in result.output
+
+    # drive to completion, then checkpoints exist
+    for _ in range(4):
+        runner.invoke(cli, ["train", "get", run_id])
+    result = runner.invoke(cli, ["train", "checkpoints", run_id, "--output", "json"])
+    assert json.loads(result.output)
+
+
+def test_stop_restart_delete(runner, fake, toml_file):
+    run_id = _dispatch(runner, toml_file)
+    result = runner.invoke(cli, ["train", "stop", run_id])
+    assert "STOPPED" in result.output
+    result = runner.invoke(cli, ["train", "restart", run_id])
+    assert "PENDING" in result.output
+    assert runner.invoke(cli, ["train", "delete", run_id, "--yes"]).exit_code == 0
+    result = runner.invoke(cli, ["train", "list", "--output", "json"])
+    assert json.loads(result.output) == []
+
+
+def test_models_tpus_configs(runner, fake):
+    result = runner.invoke(cli, ["train", "models", "--plain"])
+    assert "llama3-8b" in result.output and "llama3-70b" in result.output
+    result = runner.invoke(cli, ["train", "tpus", "--plain"])
+    assert "v5p-64" in result.output
+    result = runner.invoke(cli, ["train", "configs"])
+    schema = json.loads(result.output)
+    assert schema["properties"]["infrastructure"]
+
+
+def test_train_init_writes_template(runner, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    result = runner.invoke(cli, ["train", "init", "exp1"])
+    assert result.exit_code == 0
+    assert (tmp_path / "exp1.toml").exists()
+    config, _ = load_rl_config(tmp_path / "exp1.toml")
+    assert config.name == "exp1"
