@@ -28,6 +28,9 @@ def build_evals_client() -> EvalsClient:
     return EvalsClient(api)
 
 
+POLL_INTERVAL_S = 3.0
+
+
 @eval_group.command("run")
 @click.argument("env")
 @click.option("--model", "-m", required=True, help="Model preset or local HF checkpoint dir.")
@@ -40,6 +43,8 @@ def build_evals_client() -> EvalsClient:
 @click.option("--tokenizer", default=None, help="Tokenizer name/path (default: from checkpoint, else byte).")
 @click.option("--output-dir", default="outputs/evals")
 @click.option("--push/--no-push", "do_push", default=True, help="Push results to the Evals Hub.")
+@click.option("--hosted", is_flag=True, help="Run on the platform instead of locally.")
+@click.option("--tpu", "tpu_type", default="v5e-8", help="TPU slice for --hosted runs.")
 @output_options
 def run_eval_cmd(
     render: Renderer,
@@ -54,9 +59,31 @@ def run_eval_cmd(
     tokenizer: str | None,
     output_dir: str,
     do_push: bool,
+    hosted: bool,
+    tpu_type: str,
 ) -> None:
-    """Run ENV against a model on the local TPU and push the results."""
+    """Run ENV against a model (local TPU by default, --hosted for platform)."""
     from prime_tpu.evals.runner import EvalRunSpec, push_eval_results, run_eval
+
+    if hosted:
+        ignored = [
+            name
+            for name, value in (
+                ("--dataset", dataset),
+                ("--checkpoint", checkpoint),
+                ("--tokenizer", tokenizer),
+            )
+            if value is not None
+        ]
+        if not do_push:
+            ignored.append("--no-push")
+        if ignored:
+            render.message(
+                f"warning: {', '.join(ignored)} only apply to local runs and are ignored with --hosted",
+                err=True,
+            )
+        _run_hosted(render, env, model, limit, batch_size, max_new_tokens, temperature, tpu_type)
+        return
 
     spec = EvalRunSpec(
         env=env,
@@ -167,3 +194,60 @@ def samples_cmd(render: Renderer, eval_id: str, limit: int, offset: int) -> None
         title=f"Samples for {shorten(eval_id)}",
         json_rows=[s.model_dump(by_alias=True) for s in samples],
     )
+
+
+def _run_hosted(
+    render: Renderer,
+    env: str,
+    model: str,
+    limit: int,
+    batch_size: int,
+    max_new_tokens: int,
+    temperature: float,
+    tpu_type: str,
+) -> None:
+    """Submit a platform-side eval and poll status/logs until terminal
+    (reference commands/evals.py:565-716)."""
+    import time
+
+    from prime_tpu.utils.hosted_eval import EvalStatus, HostedEvalConfig
+
+    config = HostedEvalConfig(
+        env=env,
+        model=model,
+        limit=limit,
+        batch_size=batch_size,
+        max_new_tokens=max_new_tokens,
+        temperature=temperature,
+        tpu_type=tpu_type,
+    )
+    client = build_evals_client()
+    run = client.create_hosted(config.model_dump(by_alias=True, exclude_none=True))
+    hosted_id = run["hostedId"]
+    render.message(f"Hosted eval {shorten(hosted_id)} submitted on {tpu_type}.")
+    seen_lines = 0
+    while True:
+        run = client.get_hosted(hosted_id)
+        lines = client.hosted_logs(hosted_id)
+        for line in lines[seen_lines:]:
+            render.message(f"  {line}")
+        seen_lines = len(lines)
+        if run["status"] in EvalStatus.TERMINAL:
+            break
+        time.sleep(POLL_INTERVAL_S)
+    if render.is_json:
+        render.json(run)
+    else:
+        render.message(f"Hosted eval {shorten(hosted_id)}: {run['status']} {run.get('metrics', {})}")
+
+
+@eval_group.command("stop")
+@click.argument("hosted_id")
+@output_options
+def stop_hosted_cmd(render: Renderer, hosted_id: str) -> None:
+    """Cancel a hosted eval."""
+    run = build_evals_client().cancel_hosted(hosted_id)
+    if render.is_json:
+        render.json(run)
+    else:
+        render.message(f"Hosted eval {shorten(hosted_id)}: {run['status']}")

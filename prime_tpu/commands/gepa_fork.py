@@ -1,0 +1,45 @@
+"""`prime gepa` + `prime fork` (reference: commands/gepa.py, fork.py).
+
+``fork`` clones a hub environment under a new name (server-side copy).
+``gepa`` is a passthrough to the GEPA prompt-optimizer when that optional
+package is installed locally.
+"""
+
+from __future__ import annotations
+
+import click
+
+from prime_tpu.commands._deps import build_client
+from prime_tpu.utils.render import Renderer, output_options
+
+
+@click.command("fork")
+@click.argument("source_env")
+@click.argument("new_name")
+@output_options
+def fork(render: Renderer, source_env: str, new_name: str) -> None:
+    """Fork a hub environment under a new name."""
+    result = build_client().post(
+        f"/envhub/environments/{source_env}/fork",
+        json={"newName": new_name},
+        idempotent_post=True,
+    )
+    if render.is_json:
+        render.json(result)
+    else:
+        render.message(f"Forked {source_env} -> {result.get('name', new_name)}")
+
+
+@click.command("gepa", context_settings={"ignore_unknown_options": True})
+@click.argument("args", nargs=-1, type=click.UNPROCESSED)
+def gepa(args: tuple[str, ...]) -> None:
+    """Run the GEPA prompt optimizer (requires the optional `gepa` package)."""
+    import importlib.util
+    import subprocess
+    import sys
+
+    if importlib.util.find_spec("gepa") is None:
+        raise click.ClickException(
+            "GEPA is not installed: pip install gepa (then re-run `prime gepa ...`)"
+        )
+    raise SystemExit(subprocess.run([sys.executable, "-m", "gepa", *args]).returncode)

@@ -34,6 +34,8 @@ _LAZY_COMMANDS: dict[str, tuple[str, str]] = {
     "rl": ("prime_tpu.commands.train", "train_group"),
     "lab": ("prime_tpu.commands.misc", "lab_group"),
     "deployments": ("prime_tpu.commands.deployments", "deployments_group"),
+    "fork": ("prime_tpu.commands.gepa_fork", "fork"),
+    "gepa": ("prime_tpu.commands.gepa_fork", "gepa"),
     # Account
     "login": ("prime_tpu.commands.login", "login"),
     "logout": ("prime_tpu.commands.login", "logout"),
@@ -96,6 +98,16 @@ def cli(context: str | None) -> None:
     """
     if context:
         os.environ["PRIME_CONTEXT"] = context
+    if not os.environ.get("PRIME_DISABLE_VERSION_CHECK"):
+        from prime_tpu.utils.version_check import check_for_update
+
+        newer = check_for_update(prime_tpu.__version__)
+        if newer:
+            click.echo(
+                f"A newer prime-tpu is available ({newer} > {prime_tpu.__version__}); "
+                "run `prime upgrade` for instructions.",
+                err=True,
+            )
 
 
 def main() -> None:  # pragma: no cover - exercised via subprocess

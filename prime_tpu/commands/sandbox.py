@@ -7,6 +7,7 @@ expose/unexpose/list-ports, reset-cache).
 
 from __future__ import annotations
 
+import subprocess
 import sys
 
 import click
@@ -17,6 +18,10 @@ from prime_tpu.sandboxes import CreateSandboxRequest, EgressPolicy, SandboxClien
 from prime_tpu.sandboxes.auth import SandboxAuthCache
 from prime_tpu.utils.render import Renderer, output_options
 from prime_tpu.utils.short_id import resolve, shorten
+
+
+# Injection point for tests (no real ssh in CI).
+ssh_runner = subprocess.run
 
 
 @click.group(name="sandbox")
@@ -299,3 +304,35 @@ def reset_cache(render: Renderer) -> None:
     """Clear the on-disk gateway auth-token cache."""
     SandboxAuthCache().clear()
     render.message("Sandbox auth cache cleared.")
+
+
+@sandbox_group.command("ssh")
+@click.argument("sandbox_id")
+@output_options
+def ssh_cmd(render: Renderer, sandbox_id: str) -> None:
+    """SSH into a VM sandbox (mints short-lived credentials)."""
+    import os
+    import tempfile
+
+    client = build_sandbox_client()
+    session = client.create_ssh_session(_resolve_id(client, sandbox_id))
+    fd, key_path = tempfile.mkstemp(prefix="prime-sbx-key-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(session.private_key_pem)
+        os.chmod(key_path, 0o600)
+        args = [
+            "ssh",
+            "-i",
+            key_path,
+            "-o",
+            "StrictHostKeyChecking=no",
+            "-p",
+            str(session.port),
+            f"{session.username}@{session.host}",
+        ]
+        result = ssh_runner(args)
+        if getattr(result, "returncode", 0) != 0:
+            raise SystemExit(result.returncode)
+    finally:
+        os.unlink(key_path)

@@ -71,8 +71,13 @@ def run_cmd(render: Renderer, config_file: str, yes: bool, follow: bool) -> None
         render.message(f"warning: {warning}", err=True)
 
     if config.is_full_finetune:
-        # full-FT: whole TOML is shipped opaque to the dedicated trainer
-        payload = build_payload_from_toml(config_file)
+        # full-FT: whole TOML shipped opaque; only allowlisted env vars ride
+        # along (reference commands/rl.py:985 — WANDB_API_KEY/HF_TOKEN)
+        from prime_tpu.utils.env_vars import FULL_FT_ALLOWED_KEYS, collect_env_vars
+
+        payload = build_payload_from_toml(
+            config_file, env_vars=collect_env_vars(allowed=FULL_FT_ALLOWED_KEYS)
+        )
         if not yes and not click.confirm(
             f"Dispatch FULL-FINETUNE '{config.name}' ({config.model}) on "
             f"{payload['tpuType']} x{payload['numSlices']}?",

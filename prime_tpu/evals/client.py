@@ -143,6 +143,21 @@ class EvalsClient:
         )
         return Evaluation.model_validate(data)
 
+    # -- hosted evals ---------------------------------------------------------
+
+    def create_hosted(self, config: dict[str, Any]) -> dict[str, Any]:
+        return self.api.post("/evals/hosted", json=config, idempotent_post=True)
+
+    def get_hosted(self, hosted_id: str) -> dict[str, Any]:
+        return self.api.get(f"/evals/hosted/{hosted_id}")
+
+    def hosted_logs(self, hosted_id: str) -> list[str]:
+        data = self.api.get(f"/evals/hosted/{hosted_id}/logs")
+        return data.get("lines", []) if isinstance(data, dict) else data
+
+    def cancel_hosted(self, hosted_id: str) -> dict[str, Any]:
+        return self.api.post(f"/evals/hosted/{hosted_id}/cancel", idempotent_post=True)
+
     # -- batched sample upload ----------------------------------------------
 
     def push_samples(

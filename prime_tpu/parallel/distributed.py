@@ -1,0 +1,59 @@
+"""Multi-host / multi-slice distributed initialization.
+
+The TPU-native replacement for the reference's NCCL/MPI-shaped backend
+(SURVEY.md §2.10): on a multi-host slice every worker runs the same program;
+``jax.distributed.initialize`` wires them over DCN, after which the global
+device set spans all hosts and XLA collectives ride ICI within a slice and
+DCN across slices. `prime pods connect --all-workers` is the launch fan-out.
+"""
+
+from __future__ import annotations
+
+import os
+
+from prime_tpu.parallel.topology import SliceSpec, parse_slice
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize jax.distributed for a multi-host slice.
+
+    On Cloud TPU VMs all three arguments are discovered from the metadata
+    server automatically; explicit values are for DCN-pooled multi-slice jobs
+    (coordinator = worker 0 of slice 0) or for tests.
+    """
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def multislice_mesh_axes(slice_name: str | SliceSpec, num_slices: int) -> dict[str, int]:
+    """Axis sizes for a DCN-pooled multi-slice job: ``dp`` spans slices over
+    DCN (gradient all-reduce is DCN-tolerant), fsdp/tp stay inside each
+    slice's ICI (latency-sensitive collectives never cross DCN)."""
+    spec = parse_slice(slice_name) if isinstance(slice_name, str) else slice_name
+    tp = min(8, spec.chips)
+    while spec.chips % tp:
+        tp //= 2
+    return {"dp": num_slices, "fsdp": spec.chips // tp, "tp": tp}
+
+
+def worker_env(worker_index: int, coordinator_host: str, num_workers: int) -> dict[str, str]:
+    """Environment to export on each TPU VM worker before launching the job
+    (used by the pods SPMD fan-out)."""
+    return {
+        "PRIME_WORKER_INDEX": str(worker_index),
+        "PRIME_NUM_WORKERS": str(num_workers),
+        "PRIME_COORDINATOR": f"{coordinator_host}:8476",
+        **({"TPU_STDERR_LOG_LEVEL": "0"} if os.environ.get("PRIME_DEBUG") else {}),
+    }

@@ -545,6 +545,15 @@ class SandboxClient:
         response = self._gateway_request(sandbox_id, "GET", "files/list", params={"path": remote_path})
         return [FileEntry.model_validate(f) for f in response.json().get("files", [])]
 
+    # ---- ssh ---------------------------------------------------------------
+
+    def create_ssh_session(self, sandbox_id: str):
+        """Mint short-lived SSH credentials (VM sandboxes; containers 400)."""
+        from prime_tpu.sandboxes.models import SSHSession
+
+        data = self.api.post(f"/sandbox/{sandbox_id}/ssh", idempotent_post=True)
+        return SSHSession.model_validate(data)
+
     # ---- egress + ports ----------------------------------------------------
 
     def get_egress(self, sandbox_id: str) -> EgressPolicy:
@@ -971,6 +980,14 @@ class AsyncSandboxClient:
     async def list_files(self, sandbox_id: str, remote_path: str = "/") -> list[FileEntry]:
         response = await self._gateway_request(sandbox_id, "GET", "files/list", params={"path": remote_path})
         return [FileEntry.model_validate(f) for f in response.json().get("files", [])]
+
+    # ---- ssh ---------------------------------------------------------------
+
+    async def create_ssh_session(self, sandbox_id: str):
+        from prime_tpu.sandboxes.models import SSHSession
+
+        data = await self.api.post(f"/sandbox/{sandbox_id}/ssh", idempotent_post=True)
+        return SSHSession.model_validate(data)
 
     # ---- egress + ports ----------------------------------------------------
 

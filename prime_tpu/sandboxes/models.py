@@ -168,3 +168,15 @@ class FileEntry(BaseModel):
     path: str
     size: int = 0
     is_dir: bool = Field(default=False, alias="isDir")
+
+
+class SSHSession(BaseModel):
+    """Short-lived SSH access to a sandbox (reference models.py:601)."""
+
+    model_config = ConfigDict(populate_by_name=True)
+
+    host: str
+    port: int = 22
+    username: str = "root"
+    private_key_pem: str = Field(alias="privateKeyPem")
+    expires_at: float = Field(alias="expiresAt")

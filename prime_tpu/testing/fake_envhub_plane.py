@@ -66,6 +66,21 @@ class FakeEnvHubPlane:
                 {"name": name, "version": version, "contentHash": env["contentHash"], "archiveB64": archive},
             )
 
+        @route("POST", r"/envhub/environments/(?P<name>[^/]+)/fork")
+        def fork_env(request: httpx.Request, name: str) -> httpx.Response:
+            env = plane.environments.get(name)
+            if not env:
+                return _json_response(404, {"detail": f"environment {name} not found"})
+            new_name = plane.fake._body(request)["newName"]
+            if new_name in plane.environments:
+                return _json_response(409, {"detail": f"{new_name} already exists"})
+            forked = {**env, "name": new_name, "forkedFrom": name}
+            plane.environments[new_name] = forked
+            for version in env["versions"]:
+                plane.archives[(new_name, version)] = plane.archives[(name, version)]
+                plane.version_hashes[(new_name, version)] = plane.version_hashes.get((name, version), "")
+            return _json_response(200, forked)
+
         @route("GET", r"/envhub/environments/(?P<name>[^/]+)/versions")
         def versions(request: httpx.Request, name: str) -> httpx.Response:
             env = plane.environments.get(name)

@@ -22,6 +22,9 @@ class FakeEvalsPlane:
         self.samples: dict[str, list[dict[str, Any]]] = {}
         self.rate_limit_next = 0
         self.upload_posts = 0
+        self.hosted: dict[str, dict[str, Any]] = {}
+        self._hosted_polls: dict[str, int] = {}
+        self.hosted_complete_after = 2
         self._register()
 
     def _register(self) -> None:
@@ -59,6 +62,41 @@ class FakeEvalsPlane:
             }
             plane.environments[env_id] = env
             return _json_response(200, env)
+
+        @route("POST", r"/evals/hosted/(?P<hid>[^/]+)/cancel")
+        def cancel_hosted(request: httpx.Request, hid: str) -> httpx.Response:
+            run = plane.hosted.get(hid)
+            if not run:
+                return _json_response(404, {"detail": "not found"})
+            run["status"] = "CANCELLED"
+            return _json_response(200, run)
+
+        @route("GET", r"/evals/hosted/(?P<hid>[^/]+)/logs")
+        def hosted_logs(request: httpx.Request, hid: str) -> httpx.Response:
+            polls = plane._hosted_polls.get(hid, 0)
+            return _json_response(200, {"lines": [f"hosted eval step {i}" for i in range(polls + 1)]})
+
+        @route("GET", r"/evals/hosted/(?P<hid>[^/]+)")
+        def get_hosted(request: httpx.Request, hid: str) -> httpx.Response:
+            run = plane.hosted.get(hid)
+            if not run:
+                return _json_response(404, {"detail": "not found"})
+            if run["status"] not in ("COMPLETED", "FAILED", "CANCELLED"):
+                plane._hosted_polls[hid] = plane._hosted_polls.get(hid, 0) + 1
+                if plane._hosted_polls[hid] >= plane.hosted_complete_after:
+                    run["status"] = "COMPLETED"
+                    run["metrics"] = {"accuracy": 0.62, "samples_per_sec": 41.0}
+                else:
+                    run["status"] = "RUNNING"
+            return _json_response(200, run)
+
+        @route("POST", r"/evals/hosted")
+        def create_hosted(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            hid = f"heval_{uuid.uuid4().hex[:8]}"
+            run = {"hostedId": hid, "status": "PENDING", "metrics": {}, **body}
+            plane.hosted[hid] = run
+            return _json_response(200, run)
 
         @route("POST", r"/evals/evaluations/(?P<eval_id>[^/]+)/samples")
         def push_samples(request: httpx.Request, eval_id: str) -> httpx.Response:

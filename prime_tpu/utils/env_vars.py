@@ -1,0 +1,54 @@
+"""`.env` parsing with ``${VAR}`` expansion (reference: utils/env_vars.py:145).
+
+``collect_env_vars`` merges explicit KEY=VALUE pairs over a .env file over
+the process environment, restricted to an allowlist when given — full-FT
+dispatch only forwards WANDB_API_KEY/HF_TOKEN (reference commands/rl.py:985).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from pathlib import Path
+
+_VAR_RE = re.compile(r"\$\{([A-Za-z_][A-Za-z0-9_]*)\}")
+
+FULL_FT_ALLOWED_KEYS = {"WANDB_API_KEY", "HF_TOKEN"}
+
+
+def parse_dotenv(path: str | Path) -> dict[str, str]:
+    """Parse a .env file: KEY=VALUE lines, quotes stripped, ${VAR} expanded
+    against previously-defined keys then the process environment."""
+    result: dict[str, str] = {}
+    path = Path(path)
+    if not path.exists():
+        return result
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        key, _, value = line.partition("=")
+        key = key.strip()
+        value = value.strip()
+        if len(value) >= 2 and value[0] == value[-1] and value[0] in "\"'":
+            value = value[1:-1]
+        value = _VAR_RE.sub(lambda m: result.get(m.group(1), os.environ.get(m.group(1), "")), value)
+        result[key] = value
+    return result
+
+
+def collect_env_vars(
+    explicit: dict[str, str] | None = None,
+    dotenv_path: str | Path = ".env",
+    allowed: set[str] | None = None,
+) -> dict[str, str]:
+    """explicit > .env > os.environ, filtered to `allowed` when given."""
+    # os.environ is always the lowest layer; with no allowlist, seed from the
+    # keys the upper layers mention (a full environ dump would leak secrets)
+    dotenv = parse_dotenv(dotenv_path)
+    keys = allowed if allowed is not None else set(dotenv) | set(explicit or {})
+    merged = {key: os.environ[key] for key in keys if key in os.environ}
+    merged.update(dotenv)
+    if explicit:
+        merged.update(explicit)
+    return {k: v for k, v in merged.items() if k in keys}

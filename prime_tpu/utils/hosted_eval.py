@@ -1,0 +1,27 @@
+"""Hosted eval config + status (reference: utils/hosted_eval.py:12-121)."""
+
+from __future__ import annotations
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+class EvalStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    COMPLETED = "COMPLETED"
+    FAILED = "FAILED"
+    CANCELLED = "CANCELLED"
+
+    TERMINAL = {COMPLETED, FAILED, CANCELLED}
+
+
+class HostedEvalConfig(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    env: str
+    model: str
+    limit: int | None = None
+    batch_size: int = Field(default=8, alias="batchSize")
+    max_new_tokens: int = Field(default=256, alias="maxNewTokens")
+    temperature: float = 0.0
+    tpu_type: str = Field(default="v5e-8", alias="tpuType")

@@ -1,0 +1,47 @@
+"""PyPI version check with 24h cache (reference: utils/version_check.py:12-16).
+
+Runs before subcommands; network failures and zero-egress environments are
+silent (a version nag must never break the CLI).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+CACHE_TTL_S = 24 * 3600
+PYPI_URL = "https://pypi.org/pypi/prime-tpu/json"
+
+
+def _cache_path() -> Path:
+    env_dir = os.environ.get("PRIME_CONFIG_DIR")
+    base = Path(env_dir) if env_dir else Path.home() / ".prime"
+    return base / "version_check.json"
+
+
+def check_for_update(current_version: str, timeout_s: float = 2.0) -> str | None:
+    """Return the newer PyPI version string, or None. Never raises."""
+    cache = _cache_path()
+    try:
+        cached = json.loads(cache.read_text())
+        if time.time() - cached.get("checkedAt", 0) < CACHE_TTL_S:
+            latest = cached.get("latest")
+            return latest if latest and latest != current_version else None
+    except (OSError, json.JSONDecodeError):
+        pass
+    try:
+        import httpx
+
+        response = httpx.get(PYPI_URL, timeout=timeout_s)
+        response.raise_for_status()
+        latest = response.json()["info"]["version"]
+    except Exception:
+        return None
+    try:
+        cache.parent.mkdir(parents=True, exist_ok=True)
+        cache.write_text(json.dumps({"latest": latest, "checkedAt": time.time()}))
+    except OSError:
+        pass
+    return latest if latest != current_version else None

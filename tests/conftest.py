@@ -34,6 +34,7 @@ def anyio_backend():
 @pytest.fixture(autouse=True)
 def _isolate_config(tmp_path, monkeypatch):
     monkeypatch.setenv("PRIME_CONFIG_DIR", str(tmp_path / ".prime"))
+    monkeypatch.setenv("PRIME_DISABLE_VERSION_CHECK", "1")  # no network nag in tests
     monkeypatch.delenv("PRIME_API_KEY", raising=False)
     monkeypatch.delenv("PRIME_TEAM_ID", raising=False)
     monkeypatch.delenv("PRIME_BASE_URL", raising=False)
