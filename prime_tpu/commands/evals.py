@@ -78,7 +78,8 @@ def run_eval_cmd(
         if not do_push:
             ignored.append("--no-push")
         if ignored:
-            render.message(
+            # click.echo directly: must reach stderr even in --output json mode
+            click.echo(
                 f"warning: {', '.join(ignored)} only apply to local runs and are ignored with --hosted",
                 err=True,
             )
@@ -226,19 +227,29 @@ def _run_hosted(
     hosted_id = run["hostedId"]
     render.message(f"Hosted eval {shorten(hosted_id)} submitted on {tpu_type}.")
     seen_lines = 0
-    while True:
-        run = client.get_hosted(hosted_id)
-        lines = client.hosted_logs(hosted_id)
-        for line in lines[seen_lines:]:
-            render.message(f"  {line}")
-        seen_lines = len(lines)
-        if run["status"] in EvalStatus.TERMINAL:
-            break
-        time.sleep(POLL_INTERVAL_S)
+    try:
+        while True:
+            run = client.get_hosted(hosted_id)
+            lines = client.hosted_logs(hosted_id)
+            for line in lines[seen_lines:]:
+                render.message(f"  {line}")
+            seen_lines = len(lines)
+            if run["status"] in EvalStatus.TERMINAL:
+                break
+            time.sleep(POLL_INTERVAL_S)
+    except KeyboardInterrupt:
+        click.echo(
+            f"\nDetached — the hosted eval is still running. "
+            f"Cancel with: prime eval stop {hosted_id}",
+            err=True,
+        )
+        raise SystemExit(130) from None
     if render.is_json:
         render.json(run)
     else:
         render.message(f"Hosted eval {shorten(hosted_id)}: {run['status']} {run.get('metrics', {})}")
+    if run["status"] != EvalStatus.COMPLETED:
+        raise SystemExit(1)  # FAILED/CANCELLED must not look like success to scripts
 
 
 @eval_group.command("stop")

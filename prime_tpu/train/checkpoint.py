@@ -1,0 +1,57 @@
+"""Trainer checkpoint/resume via orbax (SURVEY.md §5 "checkpoint/resume").
+
+The platform client manages server-side checkpoints (api/rl.py); the native
+trainer saves its own: sharded-aware orbax checkpoints of the full TrainState
+(params + optimizer moments + step) with retention, plus metadata for
+warm-start bookkeeping. Restore places leaves back onto the saved shardings
+when a mesh is provided.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+from prime_tpu.train.trainer import TrainState
+
+
+class CheckpointManager:
+    def __init__(self, directory: str | Path, keep: int = 3) -> None:
+        import orbax.checkpoint as ocp
+
+        self.directory = Path(directory).resolve()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),
+        )
+
+    def save(self, state: TrainState, metrics: dict[str, Any] | None = None) -> int:
+        import jax
+        import orbax.checkpoint as ocp
+
+        step = int(jax.device_get(state.step))
+        self._manager.save(step, args=ocp.args.StandardSave(state._asdict()))
+        self._manager.wait_until_finished()
+        if metrics is not None:
+            (self.directory / f"metrics-{step}.json").write_text(json.dumps(metrics, default=float))
+        return step
+
+    def latest_step(self) -> int | None:
+        return self._manager.latest_step()
+
+    def restore(self, template: TrainState, step: int | None = None) -> TrainState:
+        """Restore into the structure (and shardings) of ``template``."""
+        import orbax.checkpoint as ocp
+
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"No checkpoints under {self.directory}")
+        restored = self._manager.restore(
+            step, args=ocp.args.StandardRestore(template._asdict())
+        )
+        return TrainState(**restored)
+
+    def close(self) -> None:
+        self._manager.close()

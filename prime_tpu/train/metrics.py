@@ -1,0 +1,38 @@
+"""Local training metrics log: append-only jsonl + simple aggregation.
+
+Client-side observability (SURVEY.md §5): hosted runs stream metrics from the
+backend; local runs write the same shape to ``metrics.jsonl`` so the same
+tooling (`prime train metrics`-style views, Lab charts later) reads both.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any
+
+
+class MetricsLogger:
+    def __init__(self, directory: str | Path) -> None:
+        self.path = Path(directory) / "metrics.jsonl"
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+
+    def log(self, step: int, **metrics: Any) -> None:
+        row = {"step": step, "ts": time.time()}
+        for key, value in metrics.items():
+            try:
+                row[key] = float(value)
+            except (TypeError, ValueError):
+                row[key] = value
+        with open(self.path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+    def read(self) -> list[dict[str, Any]]:
+        if not self.path.exists():
+            return []
+        return [json.loads(line) for line in self.path.read_text().splitlines() if line.strip()]
+
+    def last(self) -> dict[str, Any] | None:
+        rows = self.read()
+        return rows[-1] if rows else None

@@ -21,6 +21,23 @@ def _cache_path() -> Path:
     return base / "version_check.json"
 
 
+def _is_newer(candidate: str, current: str) -> bool:
+    try:
+        from packaging.version import Version
+
+        return Version(candidate) > Version(current)
+    except Exception:
+        return False  # unparseable versions never nag
+
+
+def _write_cache(cache: Path, latest: str | None) -> None:
+    try:
+        cache.parent.mkdir(parents=True, exist_ok=True)
+        cache.write_text(json.dumps({"latest": latest, "checkedAt": time.time()}))
+    except OSError:
+        pass
+
+
 def check_for_update(current_version: str, timeout_s: float = 2.0) -> str | None:
     """Return the newer PyPI version string, or None. Never raises."""
     cache = _cache_path()
@@ -28,7 +45,7 @@ def check_for_update(current_version: str, timeout_s: float = 2.0) -> str | None
         cached = json.loads(cache.read_text())
         if time.time() - cached.get("checkedAt", 0) < CACHE_TTL_S:
             latest = cached.get("latest")
-            return latest if latest and latest != current_version else None
+            return latest if latest and _is_newer(latest, current_version) else None
     except (OSError, json.JSONDecodeError):
         pass
     try:
@@ -38,10 +55,9 @@ def check_for_update(current_version: str, timeout_s: float = 2.0) -> str | None
         response.raise_for_status()
         latest = response.json()["info"]["version"]
     except Exception:
+        # cache the failure too: offline machines must not pay the
+        # timeout on every invocation (bounded to once per TTL)
+        _write_cache(cache, None)
         return None
-    try:
-        cache.parent.mkdir(parents=True, exist_ok=True)
-        cache.write_text(json.dumps({"latest": latest, "checkedAt": time.time()}))
-    except OSError:
-        pass
-    return latest if latest != current_version else None
+    _write_cache(cache, latest)
+    return latest if _is_newer(latest, current_version) else None

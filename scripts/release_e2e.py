@@ -1,0 +1,167 @@
+"""Release E2E harness (shape of reference scripts/release_e2e.py).
+
+Spins up the live-socket fake control plane, then exercises the release
+candidate's CLI end-to-end as real subprocesses: identity, availability,
+pods lifecycle, sandbox exec, env push/install, eval run+push, training
+dispatch, inference chat. Exits non-zero on the first failure.
+
+Run:  python scripts/release_e2e.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from prime_tpu.testing.live_server import LiveControlPlane  # noqa: E402
+
+PASS = 0
+FAIL: list[str] = []
+
+
+def run_cli(*args: str, env: dict[str, str], check: bool = True, input_text: str | None = None):
+    proc = subprocess.run(
+        [sys.executable, "-m", "prime_tpu.commands.main", *args],
+        capture_output=True,
+        text=True,
+        env=env,
+        input=input_text,
+        cwd=str(REPO),
+        timeout=300,
+    )
+    if check and proc.returncode != 0:
+        raise RuntimeError(f"prime {' '.join(args)} failed ({proc.returncode}):\n{proc.stderr[-1500:]}")
+    return proc
+
+
+def step(name: str):
+    def deco(fn):
+        def wrapper(env):
+            global PASS
+            try:
+                fn(env)
+                PASS += 1
+                print(f"  ok   {name}")
+            except Exception as e:
+                FAIL.append(name)
+                print(f"  FAIL {name}: {e}")
+
+        return wrapper
+
+    return deco
+
+
+@step("whoami")
+def check_whoami(env):
+    out = run_cli("whoami", "--output", "json", env=env).stdout
+    assert json.loads(out)["email"] == "dev@example.com"
+
+
+@step("availability list")
+def check_availability(env):
+    out = run_cli("availability", "list", "--tpu-type", "v5e", "--output", "json", env=env).stdout
+    rows = json.loads(out)
+    assert any(r["sliceName"] == "v5e-8" for r in rows)
+
+
+@step("pods create/status/terminate")
+def check_pods(env):
+    out = run_cli("pods", "create", "--slice", "v5e-16", "--yes", "--output", "json", env=env).stdout
+    pod_id = json.loads(out)["podId"]
+    run_cli("pods", "status", pod_id, env=env)
+    out = run_cli("pods", "status", pod_id, "--output", "json", env=env).stdout
+    assert json.loads(out)["status"] == "ACTIVE"
+    run_cli("pods", "terminate", pod_id, "--yes", env=env)
+
+
+@step("sandbox create/exec/delete")
+def check_sandbox(env):
+    out = run_cli("sandbox", "create", "--name", "e2e", "--output", "json", env=env).stdout
+    sid = json.loads(out)["sandboxId"]
+    out = run_cli("sandbox", "run", sid, "echo e2e-works", env=env).stdout
+    assert "e2e-works" in out
+    run_cli("sandbox", "delete", sid, "--yes", env=env)
+
+
+@step("env push/install")
+def check_env(env):
+    with tempfile.TemporaryDirectory() as tmp:
+        env_dir = Path(tmp) / "e2e-env"
+        run_cli("env", "init", "e2e-env", "--dir", str(env_dir), env=env)
+        run_cli("env", "push", "--dir", str(env_dir), env=env)
+        run_cli("env", "install", "e2e-env", env=env)
+
+
+@step("eval run (tiny model) + hub push")
+def check_eval(env):
+    with tempfile.TemporaryDirectory() as tmp:
+        out = run_cli(
+            "eval", "run", "e2e-arith", "-m", "tiny-test", "-n", "2", "-b", "2",
+            "--max-new-tokens", "4", "--output-dir", tmp, "--output", "json",
+            env=env,
+        ).stdout
+        payload = json.loads(out)
+        assert payload["metrics"]["num_samples"] == 2.0
+        assert payload["evalId"].startswith("eval_")
+
+
+@step("train dispatch + logs")
+def check_train(env):
+    with tempfile.TemporaryDirectory() as tmp:
+        toml = Path(tmp) / "e2e.toml"
+        run_cli("train", "init", "e2e-run", "--out", str(toml), env=env)
+        out = run_cli("train", "run", str(toml), "--yes", "--output", "json", env=env).stdout
+        run_id = json.loads(out)["runId"]
+        out = run_cli("train", "logs", run_id, "--plain", env=env).stdout
+        assert "trainer" in out
+
+
+@step("inference chat")
+def check_inference(env):
+    out = run_cli(
+        "inference", "chat", "llama3-8b", "-m", "ship it", "--no-stream", "--output", "json", env=env
+    ).stdout
+    assert json.loads(out)["choices"][0]["message"]["content"] == "echo: ship it"
+
+
+def main() -> int:
+    server = LiveControlPlane().start()
+    with tempfile.TemporaryDirectory() as config_dir:
+        env = {
+            **os.environ,
+            "PRIME_BASE_URL": server.url,
+            "PRIME_INFERENCE_URL": f"{server.url}/v1",
+            "PRIME_API_KEY": "test-key",
+            "PRIME_CONFIG_DIR": config_dir,
+            "PRIME_DISABLE_VERSION_CHECK": "1",
+            "PYTHONPATH": str(REPO),
+            # eval generation must not depend on TPU availability
+            "PALLAS_AXON_POOL_IPS": "",
+            "JAX_PLATFORMS": "cpu",
+        }
+        print(f"release E2E against {server.url}")
+        for check in (
+            check_whoami,
+            check_availability,
+            check_pods,
+            check_sandbox,
+            check_env,
+            check_eval,
+            check_train,
+            check_inference,
+        ):
+            check(env)
+    server.stop()
+    print(f"\n{PASS} passed, {len(FAIL)} failed" + (f": {FAIL}" if FAIL else ""))
+    return 1 if FAIL else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -306,7 +306,15 @@ def test_fork_env(runner, fake, tmp_path):
     assert data["forkedFrom"] == "orig"
 
 
-def test_gepa_requires_package(runner, fake):
+def test_gepa_requires_package(runner, fake, monkeypatch):
+    import importlib.util
+
+    real_find_spec = importlib.util.find_spec
+    monkeypatch.setattr(
+        importlib.util,
+        "find_spec",
+        lambda name, *a: None if name == "gepa" else real_find_spec(name, *a),
+    )
     result = runner.invoke(cli, ["gepa", "--help-me"])
     assert result.exit_code != 0
     assert "not installed" in result.output
@@ -348,3 +356,42 @@ def test_multislice_mesh_axes():
     axes = multislice_mesh_axes("v5e-16", num_slices=4)
     assert axes == {"dp": 4, "fsdp": 2, "tp": 8}
     assert axes["fsdp"] * axes["tp"] == 16
+
+
+def test_version_check_comparison_and_failure_cache(tmp_path, monkeypatch):
+    from prime_tpu.utils import version_check
+    import json as j, time
+
+    monkeypatch.setenv("PRIME_CONFIG_DIR", str(tmp_path))
+    # dev version newer than PyPI: no nag
+    (tmp_path / "version_check.json").write_text(
+        j.dumps({"latest": "0.1.0", "checkedAt": time.time()})
+    )
+    assert version_check.check_for_update("0.2.0.dev0") is None
+    # failed lookups are cached so offline machines pay the timeout once
+    (tmp_path / "version_check.json").unlink()
+    assert version_check.check_for_update("0.1.0", timeout_s=0.01) is None
+    cached = j.loads((tmp_path / "version_check.json").read_text())
+    assert cached["latest"] is None and cached["checkedAt"] > 0
+
+
+def test_hosted_eval_failure_exits_nonzero(runner, fake, monkeypatch):
+    import prime_tpu.commands.evals as ev_cmd
+
+    monkeypatch.setattr(ev_cmd, "POLL_INTERVAL_S", 0)
+    fake.evals_plane.hosted_complete_after = 10**9  # never completes on its own
+
+    orig_get = fake.evals_plane.hosted
+
+    def fail_soon():
+        for run in fake.evals_plane.hosted.values():
+            run["status"] = "FAILED"
+
+    import threading
+
+    timer = threading.Timer(0.2, fail_soon)
+    timer.start()
+    result = runner.invoke(cli, ["eval", "run", "e", "-m", "m", "--hosted"])
+    timer.cancel()
+    assert result.exit_code == 1
+    assert "FAILED" in result.output

@@ -176,3 +176,77 @@ def test_train_init_writes_template(runner, tmp_path, monkeypatch):
     assert (tmp_path / "exp1.toml").exists()
     config, _ = load_rl_config(tmp_path / "exp1.toml")
     assert config.name == "exp1"
+
+
+# -- native trainer checkpoint/metrics ---------------------------------------
+
+
+def test_checkpoint_save_restore_roundtrip(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.train import default_optimizer, init_train_state, make_train_step
+    from prime_tpu.train.checkpoint import CheckpointManager
+
+    cfg = get_config("tiny-test")
+    optimizer = default_optimizer(learning_rate=1e-2)
+    state = init_train_state(init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32), optimizer)
+    step = make_train_step(cfg, optimizer)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    mask = jnp.ones_like(tokens, dtype=jnp.float32)
+    state, _ = step(state, tokens, targets, mask)
+
+    manager = CheckpointManager(tmp_path / "ckpts", keep=2)
+    saved_step = manager.save(state, metrics={"loss": 1.0})
+    assert saved_step == 1
+    assert manager.latest_step() == 1
+
+    fresh = init_train_state(init_params(jax.random.PRNGKey(7), cfg, dtype=jnp.float32), optimizer)
+    restored = manager.restore(fresh)
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["embed"]), np.asarray(state.params["embed"])
+    )
+    assert int(restored.step) == 1
+    # resumed training continues from the restored state
+    resumed, metrics = step(restored, tokens, targets, mask)
+    assert int(resumed.step) == 2 and np.isfinite(float(metrics["loss"]))
+    manager.close()
+
+
+def test_checkpoint_retention(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.train import default_optimizer, init_train_state
+    from prime_tpu.train.checkpoint import CheckpointManager
+    from prime_tpu.train.trainer import TrainState
+
+    cfg = get_config("tiny-test")
+    optimizer = default_optimizer()
+    state = init_train_state(init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32), optimizer)
+    manager = CheckpointManager(tmp_path / "ckpts", keep=2)
+    for i in range(1, 5):
+        state = TrainState(state.params, state.opt_state, jnp.asarray(i))
+        manager.save(state)
+    assert manager.latest_step() == 4
+    steps = sorted(int(p.name) for p in (tmp_path / "ckpts").iterdir() if p.name.isdigit())
+    assert steps == [3, 4]  # retention pruned older checkpoints
+    manager.close()
+
+
+def test_metrics_logger(tmp_path):
+    from prime_tpu.train.metrics import MetricsLogger
+
+    logger = MetricsLogger(tmp_path)
+    logger.log(1, loss=2.5, grad_norm=1.1)
+    logger.log(2, loss=2.1, note="warmup done")
+    rows = logger.read()
+    assert [r["step"] for r in rows] == [1, 2]
+    assert rows[0]["loss"] == 2.5
+    assert logger.last()["note"] == "warmup done"
