@@ -8,7 +8,6 @@ local device when JAX sees an accelerator.
 
 from __future__ import annotations
 
-import json
 from pathlib import Path
 
 import click
@@ -36,23 +35,7 @@ def build_hub_client() -> EnvHubClient:
     return EnvHubClient(APIClient(config=deps.build_config(), transport=deps.transport_override))
 
 
-def installs_dir() -> Path:
-    return deps.build_config().config_dir / "envs"
-
-
-def _installed_registry() -> dict:
-    path = installs_dir() / "installed.json"
-    if path.exists():
-        try:
-            return json.loads(path.read_text())
-        except json.JSONDecodeError:
-            return {}
-    return {}
-
-
-def _save_registry(registry: dict) -> None:
-    installs_dir().mkdir(parents=True, exist_ok=True)
-    (installs_dir() / "installed.json").write_text(json.dumps(registry, indent=2))
+from prime_tpu.envhub.local import installs_dir, read_registry as _installed_registry, save_registry as _save_registry
 
 
 @env_group.command("init")

@@ -84,7 +84,7 @@ def feedback(render: Renderer, message: str | None) -> None:
 
 @click.group(name="lab")
 def lab_group() -> None:
-    """Lab workspace: setup, doctor, and the TUI (requires `textual`)."""
+    """Lab workspace: setup, doctor, sync, and the snapshot dashboard."""
 
 
 @lab_group.command("setup")
@@ -110,7 +110,7 @@ def lab_setup(workspace: str) -> None:
             for line in additions:
                 f.write(line + "\n")
         click.echo(f"  updated {gitignore} (+{len(additions)} entries)")
-    click.echo("Lab workspace ready. Run `prime lab view` to open the TUI.")
+    click.echo("Lab workspace ready. Run `prime lab view` for the dashboard.")
 
 
 @lab_group.command("doctor")
@@ -135,13 +135,95 @@ def lab_doctor(render: Renderer) -> None:
     )
 
 
-@lab_group.command("view")
-def lab_view() -> None:
-    """Open the Lab TUI (requires the optional `textual` dependency)."""
-    import importlib.util
+@lab_group.command("sync")
+@output_options
+def lab_sync(render: Renderer) -> None:
+    """Refresh the Lab cache from the platform."""
+    from prime_tpu.lab import LabDataSource
 
-    if importlib.util.find_spec("textual") is None:
-        raise click.ClickException(
-            "The Lab TUI needs the optional `textual` package: pip install prime-tpu[lab]"
+    snap = LabDataSource().refresh()
+    counts = {section: len(rows) for section, rows in snap.platform.items()}
+    for section, error in snap.errors.items():
+        click.echo(f"warning: {section} failed to sync: {error}", err=True)
+    if render.is_json:
+        render.json({"counts": counts, "errors": snap.errors})
+    else:
+        render.message("Synced: " + ", ".join(f"{k}={v}" for k, v in counts.items()))
+    if snap.errors and len(snap.errors) == len(counts):
+        raise SystemExit(1)  # every section failed — that's not a sync
+
+
+@lab_group.command("view")
+@click.option("--refresh/--cached", default=True, help="Hydrate from the platform first.")
+def lab_view(refresh: bool) -> None:
+    """Render the Lab dashboard (one-shot snapshot; full TUI needs `textual`)."""
+    from rich.console import Console
+    from rich.panel import Panel
+    from rich.table import Table
+
+    from prime_tpu.lab import LabDataSource
+
+    source = LabDataSource()
+    # hydrate only the sections the dashboard renders
+    snap = source.refresh(sections=("evals", "training", "pods")) if refresh else source.snapshot()
+    for section, error in snap.errors.items():
+        click.echo(f"warning: {section} failed to refresh: {error}", err=True)
+    console = Console()
+
+    def section_table(title, columns, rows, stale):
+        table = Table(title=title + (" (stale)" if stale else ""), expand=True)
+        for col in columns:
+            table.add_column(col)
+        for row in rows[:12]:
+            table.add_row(*(str(v) if v is not None else "" for v in row))
+        return table
+
+    console.print(
+        Panel(
+            f"local eval runs: {len(snap.local_eval_runs)}   "
+            f"installed envs: {len(snap.installed_envs)}",
+            title="prime lab",
         )
-    raise click.ClickException("Lab TUI is not built yet in this release.")  # future round
+    )
+    console.print(
+        section_table(
+            "Evaluations",
+            ["id", "model", "status", "accuracy"],
+            [
+                [e.get("evalId"), e.get("model"), e.get("status"), e.get("metrics", {}).get("accuracy")]
+                for e in snap.platform["evals"]
+            ],
+            not snap.freshness["evals"],
+        )
+    )
+    console.print(
+        section_table(
+            "Training runs",
+            ["id", "name", "status", "tpu"],
+            [
+                [r.get("runId"), r.get("name"), r.get("status"), r.get("tpuType")]
+                for r in snap.platform["training"]
+            ],
+            not snap.freshness["training"],
+        )
+    )
+    console.print(
+        section_table(
+            "Pods",
+            ["id", "slice", "status"],
+            [[p.get("podId"), p.get("sliceName"), p.get("status")] for p in snap.platform["pods"]],
+            not snap.freshness["pods"],
+        )
+    )
+    if snap.local_eval_runs:
+        console.print(
+            section_table(
+                "Local eval runs",
+                ["env", "model", "accuracy", "samples"],
+                [
+                    [r["env"], r["model"], r.get("accuracy"), r.get("samples")]
+                    for r in snap.local_eval_runs
+                ],
+                False,
+            )
+        )

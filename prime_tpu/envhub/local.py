@@ -1,0 +1,28 @@
+"""Local installed-environment registry (shared by env CLI and Lab)."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+
+def installs_dir() -> Path:
+    from prime_tpu.core.config import Config
+
+    return Config().config_dir / "envs"
+
+
+def read_registry() -> dict:
+    path = installs_dir() / "installed.json"
+    if path.exists():
+        try:
+            data = json.loads(path.read_text())
+            return data if isinstance(data, dict) else {}
+        except json.JSONDecodeError:
+            return {}
+    return {}
+
+
+def save_registry(registry: dict) -> None:
+    installs_dir().mkdir(parents=True, exist_ok=True)
+    (installs_dir() / "installed.json").write_text(json.dumps(registry, indent=2))

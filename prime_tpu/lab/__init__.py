@@ -1,0 +1,13 @@
+"""Lab workspace: local-first data layer for the Lab surfaces.
+
+Reference architecture (prime_lab_app, SURVEY.md §2.8) separates the Textual
+shell from the data machinery; this package carries the data machinery —
+disk caches (cache.py) and snapshot assembly (data.py: local workspace scan +
+cached platform rows + on-demand hydration). The interactive TUI shell is an
+optional future layer; `prime lab view` renders a one-shot snapshot today.
+"""
+
+from prime_tpu.lab.cache import LabCache
+from prime_tpu.lab.data import LabDataSource, LabSnapshot
+
+__all__ = ["LabCache", "LabDataSource", "LabSnapshot"]

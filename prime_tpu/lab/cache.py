@@ -1,0 +1,50 @@
+"""Disk row/detail caches for Lab sections (reference: prime_lab_app/cache.py).
+
+Section rows are cached as JSON under ``.prime-lab/cache/`` with a freshness
+timestamp: the TUI/data layer shows cached rows instantly and hydrates in the
+background; a TTL marks rows stale without deleting them (stale data beats a
+spinner).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any
+
+DEFAULT_TTL_S = 300.0
+
+
+class LabCache:
+    def __init__(self, workspace: str | Path = ".", ttl_s: float = DEFAULT_TTL_S) -> None:
+        self.directory = Path(workspace) / ".prime-lab" / "cache"
+        self.ttl_s = ttl_s
+
+    def _path(self, section: str) -> Path:
+        safe = section.replace("/", "_")
+        return self.directory / f"{safe}.json"
+
+    def put(self, section: str, rows: Any) -> None:
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._path(section).write_text(json.dumps({"savedAt": time.time(), "rows": rows}, default=str))
+
+    def get(self, section: str) -> tuple[Any | None, bool]:
+        """Return (rows, fresh). rows is None when never cached."""
+        path = self._path(section)
+        if not path.exists():
+            return None, False
+        try:
+            data = json.loads(path.read_text())
+        except json.JSONDecodeError:
+            return None, False
+        fresh = time.time() - data.get("savedAt", 0) < self.ttl_s
+        return data.get("rows"), fresh
+
+    def invalidate(self, section: str | None = None) -> None:
+        if section is not None:
+            self._path(section).unlink(missing_ok=True)
+            return
+        if self.directory.exists():
+            for path in self.directory.glob("*.json"):
+                path.unlink(missing_ok=True)

@@ -1,0 +1,139 @@
+"""Lab snapshot assembly (reference: prime_lab_app/data.py LabDataSource:54).
+
+Local-first: ``snapshot()`` returns instantly from the local workspace scan +
+disk cache; ``refresh()`` hydrates platform sections through the real clients
+and re-caches. Sections: evals (hub + local outputs/evals runs), training
+runs, environments (hub + installed), pods, sandboxes.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+from prime_tpu.lab.cache import LabCache
+
+PLATFORM_SECTIONS = ("evals", "training", "environments", "pods", "sandboxes")
+
+
+@dataclass
+class LabSnapshot:
+    local_eval_runs: list[dict[str, Any]] = field(default_factory=list)
+    installed_envs: dict[str, Any] = field(default_factory=dict)
+    platform: dict[str, Any] = field(default_factory=dict)      # section -> rows
+    freshness: dict[str, bool] = field(default_factory=dict)    # section -> fresh?
+    errors: dict[str, str] = field(default_factory=dict)        # section -> fetch error
+
+
+class LabDataSource:
+    def __init__(self, workspace: str | Path = ".", api_client=None, cache: LabCache | None = None) -> None:
+        self.workspace = Path(workspace)
+        self.cache = cache or LabCache(workspace)
+        self._api = api_client
+
+    # -- local scans (no network, always fresh) ------------------------------
+
+    def scan_local_eval_runs(self) -> list[dict[str, Any]]:
+        runs = []
+        base = self.workspace / "outputs" / "evals"
+        if not base.exists():
+            return runs
+        for env_model_dir in sorted(base.iterdir()):
+            if not env_model_dir.is_dir() or "--" not in env_model_dir.name:
+                continue
+            env, _, model = env_model_dir.name.partition("--")
+            for run_dir in sorted(env_model_dir.iterdir()):
+                metadata_path = run_dir / "metadata.json"
+                if not metadata_path.exists():
+                    continue
+                try:
+                    metadata = json.loads(metadata_path.read_text())
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(metadata, dict):
+                    continue
+                runs.append(
+                    {
+                        "env": env,
+                        "model": model,
+                        "runId": run_dir.name,
+                        "accuracy": metadata.get("metrics", {}).get("accuracy"),
+                        "samples": metadata.get("metrics", {}).get("num_samples"),
+                        "dir": str(run_dir),
+                    }
+                )
+        return runs
+
+    def scan_installed_envs(self) -> dict[str, Any]:
+        from prime_tpu.envhub.local import read_registry
+
+        return read_registry()
+
+    # -- snapshot ------------------------------------------------------------
+
+    def snapshot(self) -> LabSnapshot:
+        """Instant: local scans + whatever the cache holds (possibly stale)."""
+        snap = LabSnapshot(
+            local_eval_runs=self.scan_local_eval_runs(),
+            installed_envs=self.scan_installed_envs(),
+        )
+        for section in PLATFORM_SECTIONS:
+            rows, fresh = self.cache.get(section)
+            snap.platform[section] = rows or []
+            snap.freshness[section] = fresh
+        return snap
+
+    def refresh(self, sections: tuple[str, ...] = PLATFORM_SECTIONS) -> LabSnapshot:
+        """Hydrate platform sections through the real clients, then snapshot.
+
+        A dead section must not take down the others, but failures are
+        recorded in snapshot.errors so callers can tell "empty" from "broken".
+        """
+        if self._api is None:
+            import prime_tpu.commands._deps as deps
+
+            self._api = deps.build_client()
+        fetchers = {
+            "evals": self._fetch_evals,
+            "training": self._fetch_training,
+            "environments": self._fetch_environments,
+            "pods": self._fetch_pods,
+            "sandboxes": self._fetch_sandboxes,
+        }
+        errors: dict[str, str] = {}
+        for section in sections:
+            try:
+                self.cache.put(section, fetchers[section]())
+            except Exception as e:
+                errors[section] = str(e)
+        snap = self.snapshot()
+        snap.errors = errors
+        return snap
+
+    def _fetch_evals(self) -> list[dict[str, Any]]:
+        from prime_tpu.evals import EvalsClient
+
+        return [e.model_dump(by_alias=True) for e in EvalsClient(self._api).list_evaluations()]
+
+    def _fetch_training(self) -> list[dict[str, Any]]:
+        from prime_tpu.api.rl import RLClient
+
+        return [r.model_dump(by_alias=True) for r in RLClient(self._api).list_runs()]
+
+    def _fetch_environments(self) -> list[dict[str, Any]]:
+        from prime_tpu.envhub import EnvHubClient
+
+        return EnvHubClient(self._api).list()
+
+    def _fetch_pods(self) -> list[dict[str, Any]]:
+        from prime_tpu.api.pods import PodsClient
+
+        return [p.model_dump(by_alias=True) for p in PodsClient(self._api).list()]
+
+    def _fetch_sandboxes(self) -> list[dict[str, Any]]:
+        from prime_tpu.sandboxes.client import SandboxClient
+
+        client = SandboxClient(client=self._api)
+        return [s.model_dump(by_alias=True) for s in client.list(limit=50)]

@@ -1,0 +1,101 @@
+"""Lab data layer: caches, local scans, snapshot assembly, CLI view."""
+
+import json
+
+import pytest
+from click.testing import CliRunner
+
+import prime_tpu.commands._deps as deps
+from prime_tpu.commands.main import cli
+from prime_tpu.lab import LabCache, LabDataSource
+from prime_tpu.testing import FakeControlPlane
+
+
+@pytest.fixture
+def fake(monkeypatch):
+    fake = FakeControlPlane()
+    monkeypatch.setattr(deps, "transport_override", fake.transport)
+    monkeypatch.setenv("PRIME_API_KEY", "test-key")
+    monkeypatch.setenv("PRIME_BASE_URL", "https://api.fake")
+    return fake
+
+
+def test_cache_roundtrip_and_ttl(tmp_path):
+    cache = LabCache(tmp_path, ttl_s=1000)
+    assert cache.get("evals") == (None, False)
+    cache.put("evals", [{"a": 1}])
+    rows, fresh = cache.get("evals")
+    assert rows == [{"a": 1}] and fresh
+
+    stale_cache = LabCache(tmp_path, ttl_s=0)
+    rows, fresh = stale_cache.get("evals")
+    assert rows == [{"a": 1}] and not fresh  # stale rows still served
+
+    cache.invalidate()
+    assert cache.get("evals") == (None, False)
+
+
+def test_local_scan_picks_up_eval_runs(tmp_path):
+    run_dir = tmp_path / "outputs" / "evals" / "gsm8k--llama3-8b" / "run1"
+    run_dir.mkdir(parents=True)
+    (run_dir / "metadata.json").write_text(
+        json.dumps({"metrics": {"accuracy": 0.7, "num_samples": 64}})
+    )
+    source = LabDataSource(tmp_path)
+    snap = source.snapshot()
+    assert snap.local_eval_runs[0]["env"] == "gsm8k"
+    assert snap.local_eval_runs[0]["accuracy"] == 0.7
+    assert snap.platform["evals"] == [] and not snap.freshness["evals"]
+
+
+def test_refresh_hydrates_platform_sections(tmp_path, fake):
+    # seed platform state
+    from prime_tpu.core.client import APIClient
+    from prime_tpu.core.config import Config
+
+    cfg = Config()
+    cfg.api_key = "test-key"
+    api = APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    from prime_tpu.api.pods import CreatePodRequest, PodsClient
+
+    PodsClient(api).create(CreatePodRequest(name="lab-pod", slice_name="v5e-8"))
+
+    source = LabDataSource(tmp_path, api_client=api)
+    snap = source.refresh()
+    assert snap.freshness["pods"] is True
+    assert snap.platform["pods"][0]["name"] == "lab-pod"
+
+    # cached snapshot works without the client
+    cold = LabDataSource(tmp_path, api_client=None).snapshot()
+    assert cold.platform["pods"][0]["name"] == "lab-pod"
+
+
+def test_lab_view_cli(tmp_path, fake, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    runner = CliRunner()
+    result = runner.invoke(cli, ["lab", "sync", "--plain"])
+    assert result.exit_code == 0, result.output
+    assert "pods=" in result.output
+    result = runner.invoke(cli, ["lab", "view", "--cached"])
+    assert result.exit_code == 0, result.output
+    assert "prime lab" in result.output and "Training runs" in result.output
+
+
+def test_sync_surfaces_total_failure(tmp_path, fake, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("PRIME_API_KEY", "wrong-key")  # every fetch 401s
+    runner = CliRunner()
+    result = runner.invoke(cli, ["lab", "sync"])
+    assert result.exit_code == 1
+    assert "failed to sync" in result.output
+
+
+def test_scan_tolerates_non_dict_metadata(tmp_path):
+    run_dir = tmp_path / "outputs" / "evals" / "e--m" / "bad"
+    run_dir.mkdir(parents=True)
+    (run_dir / "metadata.json").write_text("[]")
+    good = tmp_path / "outputs" / "evals" / "e--m" / "good"
+    good.mkdir()
+    (good / "metadata.json").write_text(json.dumps({"metrics": {"accuracy": 1.0}}))
+    snap = LabDataSource(tmp_path).snapshot()
+    assert [r["runId"] for r in snap.local_eval_runs] == ["good"]
