@@ -1,0 +1,10 @@
+"""Environment entry point: load_environment() -> examples + scorer."""
+
+import json
+import pathlib
+
+
+def load_environment():
+    data = pathlib.Path(__file__).parent / "data" / "eval.jsonl"
+    examples = [json.loads(line) for line in data.read_text().splitlines() if line.strip()]
+    return {"name": "arith-rl", "examples": examples}

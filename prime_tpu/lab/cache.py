@@ -38,6 +38,8 @@ class LabCache:
             data = json.loads(path.read_text())
         except json.JSONDecodeError:
             return None, False
+        if not isinstance(data, dict):
+            return None, False  # foreign/corrupt cache file — treat as a miss
         fresh = time.time() - data.get("savedAt", 0) < self.ttl_s
         return data.get("rows"), fresh
 

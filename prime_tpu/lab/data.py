@@ -54,13 +54,15 @@ class LabDataSource:
                     continue
                 if not isinstance(metadata, dict):
                     continue
+                metrics = metadata.get("metrics")
+                metrics = metrics if isinstance(metrics, dict) else {}
                 runs.append(
                     {
                         "env": env,
                         "model": model,
                         "runId": run_dir.name,
-                        "accuracy": metadata.get("metrics", {}).get("accuracy"),
-                        "samples": metadata.get("metrics", {}).get("num_samples"),
+                        "accuracy": metrics.get("accuracy"),
+                        "samples": metrics.get("num_samples"),
                         "dir": str(run_dir),
                     }
                 )

@@ -99,3 +99,15 @@ def test_scan_tolerates_non_dict_metadata(tmp_path):
     (good / "metadata.json").write_text(json.dumps({"metrics": {"accuracy": 1.0}}))
     snap = LabDataSource(tmp_path).snapshot()
     assert [r["runId"] for r in snap.local_eval_runs] == ["good"]
+
+
+def test_null_metrics_and_foreign_cache_tolerated(tmp_path):
+    run_dir = tmp_path / "outputs" / "evals" / "e--m" / "nullm"
+    run_dir.mkdir(parents=True)
+    (run_dir / "metadata.json").write_text(json.dumps({"metrics": None}))
+    cache = LabCache(tmp_path)
+    cache.directory.mkdir(parents=True, exist_ok=True)
+    (cache.directory / "evals.json").write_text("[]")  # foreign cache shape
+    snap = LabDataSource(tmp_path, cache=cache).snapshot()
+    assert snap.local_eval_runs[0]["accuracy"] is None
+    assert snap.platform["evals"] == [] and not snap.freshness["evals"]
