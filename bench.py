@@ -32,7 +32,50 @@ NEW_TOKENS = 128
 MODEL = "llama3.2-1b"
 
 
+def _preflight(timeout_s: float = 180.0) -> None:
+    """Fail fast (clean JSON diagnostic) if the accelerator backend is hung —
+    the tunneled TPU occasionally stalls; a hang here would block the driver."""
+    import threading
+
+    done = threading.Event()
+    error: list[str] = []
+
+    def probe() -> None:
+        try:
+            x = jnp.ones((64, 64))
+            float(jnp.sum(x @ x))
+            done.set()
+        except Exception as e:  # pragma: no cover
+            error.append(str(e))
+            done.set()
+
+    thread = threading.Thread(target=probe, daemon=True)
+    thread.start()
+    if not done.wait(timeout_s) or error:
+        import os
+
+        reason = error[0] if error else f"backend unresponsive after {timeout_s:.0f}s"
+        print(
+            json.dumps(
+                {
+                    "metric": "decode_tokens_per_sec (bench aborted)",
+                    "value": 0.0,
+                    "unit": "tokens/s",
+                    "vs_baseline": 0.0,
+                    "error": reason,
+                    # NOTE: not jax.default_backend() — that query can hang on
+                    # the same stuck backend this preflight is detecting
+                    "backend": os.environ.get("JAX_PLATFORMS", "unknown"),
+                }
+            ),
+            flush=True,  # os._exit below skips the stdio flush
+        )
+        # os._exit: a hung PJRT client can block normal interpreter teardown
+        os._exit(1)
+
+
 def main() -> None:
+    _preflight()
     config = get_config(MODEL)
     rng = jax.random.PRNGKey(0)
     params = init_params(rng, config, dtype=jnp.bfloat16)

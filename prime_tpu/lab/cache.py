@@ -38,9 +38,10 @@ class LabCache:
             data = json.loads(path.read_text())
         except json.JSONDecodeError:
             return None, False
-        if not isinstance(data, dict):
+        saved_at = data.get("savedAt", 0) if isinstance(data, dict) else None
+        if not isinstance(saved_at, (int, float)):
             return None, False  # foreign/corrupt cache file — treat as a miss
-        fresh = time.time() - data.get("savedAt", 0) < self.ttl_s
+        fresh = time.time() - saved_at < self.ttl_s
         return data.get("rows"), fresh
 
     def invalidate(self, section: str | None = None) -> None:

@@ -111,3 +111,10 @@ def test_null_metrics_and_foreign_cache_tolerated(tmp_path):
     snap = LabDataSource(tmp_path, cache=cache).snapshot()
     assert snap.local_eval_runs[0]["accuracy"] is None
     assert snap.platform["evals"] == [] and not snap.freshness["evals"]
+
+
+def test_cache_tolerates_non_numeric_saved_at(tmp_path):
+    cache = LabCache(tmp_path)
+    cache.directory.mkdir(parents=True, exist_ok=True)
+    (cache.directory / "pods.json").write_text('{"savedAt": "yesterday", "rows": [1]}')
+    assert cache.get("pods") == (None, False)
