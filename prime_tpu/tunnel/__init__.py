@@ -5,6 +5,6 @@ an frpc TOML config, spawn the frpc data plane, parse its log stream for
 connect/fail, poll the registration.
 """
 
-from prime_tpu.tunnel.tunnel import Tunnel, TunnelError
+from prime_tpu.tunnel.tunnel import AsyncTunnel, Tunnel, TunnelError
 
-__all__ = ["Tunnel", "TunnelError"]
+__all__ = ["AsyncTunnel", "Tunnel", "TunnelError"]

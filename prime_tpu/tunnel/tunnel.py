@@ -61,12 +61,16 @@ class Tunnel:
             "/tunnels", json={"localPort": self.local_port}, idempotent_post=True
         )
         self._config_path = self._write_config(self.registration)
-        self.process = subprocess.Popen(
-            [str(frpc), "-c", str(self._config_path)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
+        try:
+            self.process = subprocess.Popen(
+                [str(frpc), "-c", str(self._config_path)],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        except OSError:
+            self.stop()  # don't leak the server-side registration or the token file
+            raise
         reader = threading.Thread(target=self._read_logs, daemon=True)
         reader.start()
 
@@ -145,3 +149,91 @@ class Tunnel:
             match = _LOG_ERROR_RE.search(line)
             if match:
                 self._error = line.strip()
+
+
+class AsyncTunnel(Tunnel):
+    """Async tunnel: same process machinery as :class:`Tunnel` (thread-based
+    frpc log reader), async control-plane calls, blocking waits pushed off the
+    event loop via anyio.to_thread."""
+
+    def __init__(
+        self,
+        local_port: int,
+        client=None,
+        basic_auth: tuple[str, str] | None = None,
+        frpc_path: str | Path | None = None,
+    ) -> None:
+        from prime_tpu.core.client import AsyncAPIClient
+
+        super().__init__(local_port, client=object(), basic_auth=basic_auth, frpc_path=frpc_path)
+        self.api = client or AsyncAPIClient()
+
+    async def start(self, timeout_s: float = START_TIMEOUT_S) -> str:  # type: ignore[override]
+        import anyio
+
+        frpc = self._frpc_path or get_frpc_path()
+        self.registration = await self.api.post(
+            "/tunnels", json={"localPort": self.local_port}, idempotent_post=True
+        )
+        self._config_path = self._write_config(self.registration)
+        try:
+            self.process = subprocess.Popen(
+                [str(frpc), "-c", str(self._config_path)],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        except OSError:
+            await self.stop()
+            raise
+        threading.Thread(target=self._read_logs, daemon=True).start()
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._error:
+                await self.stop()
+                raise TunnelError(f"frpc failed: {self._error}")
+            if self._connected.is_set():
+                return self.registration["url"]
+            if self.process.poll() is not None:
+                await self.stop()
+                raise TunnelError(f"frpc exited with code {self.process.returncode}")
+            await anyio.sleep(0.05)
+        await self.stop()
+        raise TunnelError(f"Tunnel did not connect within {timeout_s}s")
+
+    async def status(self) -> dict[str, Any]:  # type: ignore[override]
+        if not self.registration:
+            return {"status": "NOT_STARTED"}
+        remote = await self.api.get(f"/tunnels/{self.registration['tunnelId']}")
+        remote["processAlive"] = self.process is not None and self.process.poll() is None
+        return remote
+
+    async def stop(self) -> None:  # type: ignore[override]
+        import anyio
+
+        if self.registration:
+            try:
+                await self.api.delete(f"/tunnels/{self.registration['tunnelId']}")
+            except Exception:
+                pass
+        if self.process and self.process.poll() is None:
+            self.process.terminate()
+
+            def wait_reap() -> None:
+                try:
+                    self.process.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    self.process.kill()
+
+            # off the event loop: a hung frpc must not stall other tasks
+            await anyio.to_thread.run_sync(wait_reap)
+        if self._config_path and self._config_path.exists():
+            self._config_path.unlink(missing_ok=True)
+
+    async def __aenter__(self) -> "AsyncTunnel":  # type: ignore[override]
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:  # type: ignore[override]
+        await self.stop()

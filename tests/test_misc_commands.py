@@ -396,3 +396,54 @@ def test_hosted_eval_failure_exits_nonzero(runner, fake, monkeypatch):
     timer.cancel()
     assert result.exit_code == 1
     assert "FAILED" in result.output
+
+
+@pytest.mark.anyio
+async def test_async_tunnel_lifecycle(fake, fake_frpc):
+    from prime_tpu.core.client import AsyncAPIClient
+    from prime_tpu.core.config import Config
+    from prime_tpu.tunnel import AsyncTunnel
+
+    cfg = Config()
+    cfg.api_key = "test-key"
+    api = AsyncAPIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    tunnel = AsyncTunnel(8080, client=api, frpc_path=fake_frpc)
+    url = await tunnel.start(timeout_s=15)
+    assert "tunnels.fake" in url
+    status = await tunnel.status()
+    assert status["processAlive"] is True
+    await tunnel.stop()
+    assert fake.misc_plane.tunnels == {}
+    await api.close()
+
+
+@pytest.mark.anyio
+async def test_async_tunnel_failure(fake, tmp_path):
+    from prime_tpu.core.client import AsyncAPIClient
+    from prime_tpu.core.config import Config
+    from prime_tpu.tunnel import AsyncTunnel, TunnelError
+
+    bad = tmp_path / "frpc-bad"
+    bad.write_text("#!/usr/bin/env python3\nprint('connect to server error: refused', flush=True)\nimport time; time.sleep(5)\n")
+    bad.chmod(0o755)
+    cfg = Config()
+    cfg.api_key = "test-key"
+    api = AsyncAPIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    tunnel = AsyncTunnel(8080, client=api, frpc_path=bad)
+    with pytest.raises(TunnelError, match="connect to server error"):
+        await tunnel.start(timeout_s=10)
+    await api.close()
+
+
+def test_tunnel_spawn_failure_cleans_registration(fake, tmp_path):
+    from prime_tpu.core.client import APIClient
+    from prime_tpu.core.config import Config
+    from prime_tpu.tunnel import Tunnel
+
+    cfg = Config()
+    cfg.api_key = "test-key"
+    api = APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    tunnel = Tunnel(8080, client=api, frpc_path=tmp_path / "missing-frpc")
+    with pytest.raises(OSError):
+        tunnel.start(timeout_s=5)
+    assert fake.misc_plane.tunnels == {}  # registration rolled back
