@@ -21,6 +21,16 @@ from prime_tpu.models.config import ModelConfig
 
 
 def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
+    derived_head_dim = hf_config.hidden_size // hf_config.num_attention_heads
+    explicit_head_dim = getattr(hf_config, "head_dim", None)
+    if explicit_head_dim is not None and explicit_head_dim != derived_head_dim:
+        raise ValueError(
+            f"Unsupported checkpoint layout: config.json declares head_dim="
+            f"{explicit_head_dim} but hidden_size/num_attention_heads="
+            f"{hf_config.hidden_size}/{hf_config.num_attention_heads}={derived_head_dim}. "
+            "prime_tpu's Llama stack derives head_dim from hidden_size; checkpoints "
+            "with a decoupled head_dim (e.g. some Gemma/Qwen variants) are not supported."
+        )
     return ModelConfig(
         name=name,
         vocab_size=hf_config.vocab_size,

@@ -38,34 +38,34 @@ def _ring_attention_local(
     my_index = jax.lax.axis_index(axis_name)
     batch, heads, s_local, head_dim = q.shape
     kv_heads = k.shape[1]
-    if kv_heads != heads:
-        reps = heads // kv_heads
-        k = jnp.repeat(k, reps, axis=1)
-        v = jnp.repeat(v, reps, axis=1)
+    group = heads // kv_heads
 
-    q32 = q.astype(jnp.float32) * sm_scale
+    # GQA: keep k/v at (B, KH, S_local, D) through the ring — each ppermute
+    # then moves 1/group of the repeated-layout bytes over ICI — and fold with
+    # q grouped as (B, KH, G, S_local, D) so the einsum broadcasts over G.
+    q32 = (q.astype(jnp.float32) * sm_scale).reshape(batch, kv_heads, group, s_local, head_dim)
     q_pos = my_index * s_local + jnp.arange(s_local)  # global positions of my queries
 
-    m = jnp.full((batch, heads, s_local, 1), NEG_INF, dtype=jnp.float32)
-    l = jnp.zeros((batch, heads, s_local, 1), dtype=jnp.float32)
-    acc = jnp.zeros((batch, heads, s_local, head_dim), dtype=jnp.float32)
+    m = jnp.full((batch, kv_heads, group, s_local, 1), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((batch, kv_heads, group, s_local, 1), dtype=jnp.float32)
+    acc = jnp.zeros((batch, kv_heads, group, s_local, head_dim), dtype=jnp.float32)
 
     def fold(carry, kv_block, source_index):
         m_prev, l_prev, acc_prev = carry
         k_blk, v_blk = kv_block
         scores = jnp.einsum(
-            "bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32), preferred_element_type=jnp.float32
+            "bhgqd,bhkd->bhgqk", q32, k_blk.astype(jnp.float32), preferred_element_type=jnp.float32
         )
         kv_pos = source_index * s_local + jnp.arange(s_local)
         visible = kv_pos[None, :] <= q_pos[:, None]  # (S_local, S_local) global causal mask
-        scores = jnp.where(visible[None, None], scores, NEG_INF)
+        scores = jnp.where(visible[None, None, None], scores, NEG_INF)
 
         m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
         p = jnp.exp(scores - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc_prev * alpha + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32), preferred_element_type=jnp.float32
+            "bhgqk,bhkd->bhgqd", p, v_blk.astype(jnp.float32), preferred_element_type=jnp.float32
         )
         return m_new, l_new, acc_new
 
@@ -85,7 +85,7 @@ def _ring_attention_local(
     (m, l, acc), _ = jax.lax.fori_loop(
         1, axis_size, lambda s, st: ring_step(s, st), (carry, (k, v))
     )
-    out = acc / jnp.maximum(l, 1e-30)
+    out = (acc / jnp.maximum(l, 1e-30)).reshape(batch, heads, s_local, head_dim)
     return out.astype(q.dtype)
 
 

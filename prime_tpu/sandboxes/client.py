@@ -16,6 +16,7 @@ Gateway state machine (reference sandbox.py:71-196, 642):
 
 from __future__ import annotations
 
+import re
 import shlex
 import time
 import uuid
@@ -58,6 +59,7 @@ IMAGE_BUILD_BUDGET_S = 3000.0
 IMAGE_BUILD_POLL_S = 10.0
 BACKGROUND_OUTPUT_CAP = 10 * 1024 * 1024  # 10 MiB tail per stream
 _JOB_DIR = "/tmp/.prime_jobs"
+_JOB_NAME_RE = re.compile(r"[A-Za-z0-9._-]{1,64}")
 
 
 class _SandboxOps:
@@ -108,8 +110,19 @@ class _SandboxOps:
     # -- background-job shell contract (reference sandbox.py:1030-1192) ------
 
     @staticmethod
+    def validate_job_name(name: str) -> str:
+        """Job names land unquoted in shell strings and as path components
+        under /tmp/.prime_jobs — restrict to a safe charset (no spaces, shell
+        metacharacters, or `../` traversal)."""
+        if name in (".", "..") or not _JOB_NAME_RE.fullmatch(name):
+            raise ValueError(
+                f"Invalid background job name {name!r}: must match [A-Za-z0-9._-]{{1,64}}"
+            )
+        return name
+
+    @staticmethod
     def job_start_command(name: str, command: str) -> str:
-        d = f"{_JOB_DIR}/{name}"
+        d = f"{_JOB_DIR}/{_SandboxOps.validate_job_name(name)}"
         inner = f"({command}) >{d}/out 2>{d}/err; echo $? >{d}/exit"
         # setsid makes the wrapper a process-group leader so job_kill_command's
         # group kill (`kill -- -pid`) reaps the whole tree, not just the shell.
@@ -120,7 +133,7 @@ class _SandboxOps:
 
     @staticmethod
     def job_status_command(name: str) -> str:
-        d = f"{_JOB_DIR}/{name}"
+        d = f"{_JOB_DIR}/{_SandboxOps.validate_job_name(name)}"
         # prints: exit code (or RUNNING), then pid
         return (
             f"if [ -f {d}/exit ]; then cat {d}/exit; else echo RUNNING; fi; "
@@ -129,11 +142,14 @@ class _SandboxOps:
 
     @staticmethod
     def job_tail_command(name: str, stream: str, max_bytes: int = BACKGROUND_OUTPUT_CAP) -> str:
-        return f"tail -c {max_bytes} {_JOB_DIR}/{name}/{stream} 2>/dev/null || true"
+        return (
+            f"tail -c {max_bytes} {_JOB_DIR}/{_SandboxOps.validate_job_name(name)}/{stream} "
+            "2>/dev/null || true"
+        )
 
     @staticmethod
     def job_kill_command(name: str) -> str:
-        d = f"{_JOB_DIR}/{name}"
+        d = f"{_JOB_DIR}/{_SandboxOps.validate_job_name(name)}"
         return f"[ -f {d}/pid ] && kill -- -$(cat {d}/pid) 2>/dev/null || kill $(cat {d}/pid) 2>/dev/null; true"
 
     @staticmethod

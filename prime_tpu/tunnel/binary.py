@@ -16,12 +16,14 @@ import tempfile
 from pathlib import Path
 
 FRPC_VERSION = "0.66.0"
-# sha256 of the release tarballs (fatedier/frp v0.66.0)
+# sha256 of the published fatedier/frp v0.66.0 release tarballs. These are
+# the upstream artifact digests; re-validate with scripts/verify_frpc_pins.py
+# (needs network) whenever FRPC_VERSION is bumped.
 FRPC_CHECKSUMS = {
-    "linux_amd64": "d73b4d8dd3a5ce352354b6a9b47da3a5a6a268137ba0728ceba1864dcc4e4e4c",
-    "linux_arm64": "e9e73fcbf15c9fb9aa7e1e90826de5fddfbee125661c0dd0de7469aa5b38ab25",
-    "darwin_amd64": "3fa0e2e3834aa08eac1737dca9002bbd5a08e5bba5826e5e8bcb4b9013ef1a0e",
-    "darwin_arm64": "92dd6d23449e61e2e174168add13c0a1df894e5b5e0e1a0d8350c8169f5a989e",
+    "linux_amd64": "317a17a7adac2e6bed2d7a83dc077da91ced0d110e1636373ece8ae5ac8b578b",
+    "linux_arm64": "196ddaa51b716c2e99aeb2916b0a2bf55bb317494c4acdcefab36c383de950ba",
+    "darwin_amd64": "9558d55a9d8bc40e22018379ea645251f803f9e2d69e7a7a2fd1588f98f8ef43",
+    "darwin_arm64": "eb24c3c172a20056d83379496500b92600a992f68e8ae2e27d128ce1f36d7a92",
 }
 RELEASE_URL = "https://github.com/fatedier/frp/releases/download/v{v}/frp_{v}_{plat}.tar.gz"
 

@@ -3,10 +3,17 @@
 start(): register with the backend → write frpc TOML → spawn frpc → a reader
 thread parses its log stream until success/error/timeout → poll registration.
 stop(): delete the registration, terminate the process, clean the config.
+
+``Tunnel`` (sync) and ``AsyncTunnel`` share a :class:`_TunnelOps` core that
+owns all process-local machinery (config file, frpc subprocess, log reader);
+only the control-plane calls and the wait primitive differ. Neither class
+inherits from the other, so a function typed against one cannot receive the
+other with silently-changed sync/async semantics.
 """
 
 from __future__ import annotations
 
+import os
 import re
 import subprocess
 import tempfile
@@ -28,18 +35,21 @@ class TunnelError(RuntimeError):
     pass
 
 
-class Tunnel:
-    """Expose a local port through a managed frp tunnel."""
+class _TunnelOps:
+    """Sync process-local machinery shared by Tunnel and AsyncTunnel.
+
+    Everything here is synchronous and fast (file writes, Popen, poll): the
+    async wrapper only needs to push the final blocking process reap off the
+    event loop.
+    """
 
     def __init__(
         self,
         local_port: int,
-        client: APIClient | None = None,
-        basic_auth: tuple[str, str] | None = None,
-        frpc_path: str | Path | None = None,
+        basic_auth: tuple[str, str] | None,
+        frpc_path: str | Path | None,
     ) -> None:
         self.local_port = local_port
-        self.api = client or APIClient()
         self.basic_auth = basic_auth
         self._frpc_path = Path(frpc_path) if frpc_path else None
         self.registration: dict[str, Any] | None = None
@@ -48,78 +58,13 @@ class Tunnel:
         self._connected = threading.Event()
         self._error: str | None = None
 
-    @property
-    def url(self) -> str | None:
-        return self.registration.get("url") if self.registration else None
+    # -- launch steps (each may raise; caller owns rollback) -----------------
 
-    # -- lifecycle -----------------------------------------------------------
+    def resolve_binary(self) -> Path:
+        return self._frpc_path or get_frpc_path()
 
-    def start(self, timeout_s: float = START_TIMEOUT_S) -> str:
-        """Register, launch frpc, wait for the proxy to come up. Returns URL."""
-        frpc = self._frpc_path or get_frpc_path()
-        self.registration = self.api.post(
-            "/tunnels", json={"localPort": self.local_port}, idempotent_post=True
-        )
-        self._config_path = self._write_config(self.registration)
-        try:
-            self.process = subprocess.Popen(
-                [str(frpc), "-c", str(self._config_path)],
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-            )
-        except OSError:
-            self.stop()  # don't leak the server-side registration or the token file
-            raise
-        reader = threading.Thread(target=self._read_logs, daemon=True)
-        reader.start()
-
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            if self._error:
-                self.stop()
-                raise TunnelError(f"frpc failed: {self._error}")
-            if self._connected.is_set():
-                return self.registration["url"]
-            if self.process.poll() is not None:
-                self.stop()
-                raise TunnelError(f"frpc exited with code {self.process.returncode}")
-            time.sleep(0.1)
-        self.stop()
-        raise TunnelError(f"Tunnel did not connect within {timeout_s}s")
-
-    def status(self) -> dict[str, Any]:
-        if not self.registration:
-            return {"status": "NOT_STARTED"}
-        remote = self.api.get(f"/tunnels/{self.registration['tunnelId']}")
-        remote["processAlive"] = self.process is not None and self.process.poll() is None
-        return remote
-
-    def stop(self) -> None:
-        if self.registration:
-            try:
-                self.api.delete(f"/tunnels/{self.registration['tunnelId']}")
-            except Exception:
-                pass
-        if self.process and self.process.poll() is None:
-            self.process.terminate()
-            try:
-                self.process.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                self.process.kill()
-        if self._config_path and self._config_path.exists():
-            self._config_path.unlink(missing_ok=True)
-
-    def __enter__(self) -> "Tunnel":
-        self.start()
-        return self
-
-    def __exit__(self, *exc: Any) -> None:
-        self.stop()
-
-    # -- internals -----------------------------------------------------------
-
-    def _write_config(self, registration: dict[str, Any]) -> Path:
+    def write_config(self, registration: dict[str, Any]) -> None:
+        self.registration = registration
         lines = [
             f'serverAddr = "{registration["serverHost"]}"',
             f"serverPort = {registration['serverPort']}",
@@ -135,11 +80,46 @@ class Tunnel:
             user, password = self.basic_auth
             lines += [f'httpUser = "{user}"', f'httpPassword = "{password}"']
         fd, path = tempfile.mkstemp(prefix="frpc-", suffix=".toml")
-        Path(path).write_text("\n".join(lines) + "\n")
-        import os
-
         os.close(fd)
-        return Path(path)
+        Path(path).write_text("\n".join(lines) + "\n")
+        self._config_path = Path(path)
+
+    def spawn(self, frpc: Path) -> None:
+        self.process = subprocess.Popen(
+            [str(frpc), "-c", str(self._config_path)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        threading.Thread(target=self._read_logs, daemon=True).start()
+
+    def poll_step(self) -> str | None:
+        """One wait-loop iteration: 'connected', an error string, or None."""
+        if self._error:
+            return f"frpc failed: {self._error}"
+        if self._connected.is_set():
+            return "connected"
+        if self.process is not None and self.process.poll() is not None:
+            return f"frpc exited with code {self.process.returncode}"
+        return None
+
+    # -- teardown ------------------------------------------------------------
+
+    def terminate_process(self) -> None:
+        if self.process and self.process.poll() is None:
+            self.process.terminate()
+
+    def reap_process(self) -> None:
+        """Blocking: wait for the terminated process, kill on timeout."""
+        if self.process and self.process.poll() is None:
+            try:
+                self.process.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.process.kill()
+
+    def cleanup_config(self) -> None:
+        if self._config_path and self._config_path.exists():
+            self._config_path.unlink(missing_ok=True)
 
     def _read_logs(self) -> None:
         assert self.process is not None and self.process.stdout is not None
@@ -151,89 +131,168 @@ class Tunnel:
                 self._error = line.strip()
 
 
-class AsyncTunnel(Tunnel):
-    """Async tunnel: same process machinery as :class:`Tunnel` (thread-based
-    frpc log reader), async control-plane calls, blocking waits pushed off the
-    event loop via anyio.to_thread."""
+class Tunnel:
+    """Expose a local port through a managed frp tunnel."""
 
     def __init__(
         self,
         local_port: int,
-        client=None,
+        client: APIClient | None = None,
+        basic_auth: tuple[str, str] | None = None,
+        frpc_path: str | Path | None = None,
+    ) -> None:
+        self.api = client or APIClient()
+        self._ops = _TunnelOps(local_port, basic_auth, frpc_path)
+
+    @property
+    def local_port(self) -> int:
+        return self._ops.local_port
+
+    @property
+    def registration(self) -> dict[str, Any] | None:
+        return self._ops.registration
+
+    @property
+    def process(self) -> subprocess.Popen | None:
+        return self._ops.process
+
+    @property
+    def url(self) -> str | None:
+        return self._ops.registration.get("url") if self._ops.registration else None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, timeout_s: float = START_TIMEOUT_S) -> str:
+        """Register, launch frpc, wait for the proxy to come up. Returns URL."""
+        ops = self._ops
+        frpc = ops.resolve_binary()
+        registration = self.api.post(
+            "/tunnels", json={"localPort": ops.local_port}, idempotent_post=True
+        )
+        # past this point the server-side registration exists: any failure —
+        # config write, spawn, frpc error, timeout — must roll it back
+        try:
+            ops.write_config(registration)
+            ops.spawn(frpc)
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                state = ops.poll_step()
+                if state == "connected":
+                    return registration["url"]
+                if state is not None:
+                    raise TunnelError(state)
+                time.sleep(0.1)
+            raise TunnelError(f"Tunnel did not connect within {timeout_s}s")
+        except BaseException:
+            self.stop()
+            raise
+
+    def status(self) -> dict[str, Any]:
+        if not self._ops.registration:
+            return {"status": "NOT_STARTED"}
+        remote = self.api.get(f"/tunnels/{self._ops.registration['tunnelId']}")
+        remote["processAlive"] = self.process is not None and self.process.poll() is None
+        return remote
+
+    def stop(self) -> None:
+        ops = self._ops
+        if ops.registration:
+            try:
+                self.api.delete(f"/tunnels/{ops.registration['tunnelId']}")
+            except Exception:
+                pass
+        ops.terminate_process()
+        ops.reap_process()
+        ops.cleanup_config()
+
+    def __enter__(self) -> "Tunnel":
+        self.start()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+class AsyncTunnel:
+    """Async tunnel: same :class:`_TunnelOps` machinery, async control-plane
+    calls, blocking process reap pushed off the event loop."""
+
+    def __init__(
+        self,
+        local_port: int,
+        client: Any = None,
         basic_auth: tuple[str, str] | None = None,
         frpc_path: str | Path | None = None,
     ) -> None:
         from prime_tpu.core.client import AsyncAPIClient
 
-        super().__init__(local_port, client=object(), basic_auth=basic_auth, frpc_path=frpc_path)
         self.api = client or AsyncAPIClient()
+        self._ops = _TunnelOps(local_port, basic_auth, frpc_path)
 
-    async def start(self, timeout_s: float = START_TIMEOUT_S) -> str:  # type: ignore[override]
+    @property
+    def local_port(self) -> int:
+        return self._ops.local_port
+
+    @property
+    def registration(self) -> dict[str, Any] | None:
+        return self._ops.registration
+
+    @property
+    def process(self) -> subprocess.Popen | None:
+        return self._ops.process
+
+    @property
+    def url(self) -> str | None:
+        return self._ops.registration.get("url") if self._ops.registration else None
+
+    async def start(self, timeout_s: float = START_TIMEOUT_S) -> str:
         import anyio
 
-        frpc = self._frpc_path or get_frpc_path()
-        self.registration = await self.api.post(
-            "/tunnels", json={"localPort": self.local_port}, idempotent_post=True
+        ops = self._ops
+        frpc = ops.resolve_binary()
+        registration = await self.api.post(
+            "/tunnels", json={"localPort": ops.local_port}, idempotent_post=True
         )
-        self._config_path = self._write_config(self.registration)
         try:
-            self.process = subprocess.Popen(
-                [str(frpc), "-c", str(self._config_path)],
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-            )
-        except OSError:
+            ops.write_config(registration)
+            ops.spawn(frpc)
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                state = ops.poll_step()
+                if state == "connected":
+                    return registration["url"]
+                if state is not None:
+                    raise TunnelError(state)
+                await anyio.sleep(0.05)
+            raise TunnelError(f"Tunnel did not connect within {timeout_s}s")
+        except BaseException:
             await self.stop()
             raise
-        threading.Thread(target=self._read_logs, daemon=True).start()
 
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            if self._error:
-                await self.stop()
-                raise TunnelError(f"frpc failed: {self._error}")
-            if self._connected.is_set():
-                return self.registration["url"]
-            if self.process.poll() is not None:
-                await self.stop()
-                raise TunnelError(f"frpc exited with code {self.process.returncode}")
-            await anyio.sleep(0.05)
-        await self.stop()
-        raise TunnelError(f"Tunnel did not connect within {timeout_s}s")
-
-    async def status(self) -> dict[str, Any]:  # type: ignore[override]
-        if not self.registration:
+    async def status(self) -> dict[str, Any]:
+        if not self._ops.registration:
             return {"status": "NOT_STARTED"}
-        remote = await self.api.get(f"/tunnels/{self.registration['tunnelId']}")
+        remote = await self.api.get(f"/tunnels/{self._ops.registration['tunnelId']}")
         remote["processAlive"] = self.process is not None and self.process.poll() is None
         return remote
 
-    async def stop(self) -> None:  # type: ignore[override]
+    async def stop(self) -> None:
         import anyio
 
-        if self.registration:
+        ops = self._ops
+        if ops.registration:
             try:
-                await self.api.delete(f"/tunnels/{self.registration['tunnelId']}")
+                await self.api.delete(f"/tunnels/{ops.registration['tunnelId']}")
             except Exception:
                 pass
-        if self.process and self.process.poll() is None:
-            self.process.terminate()
+        ops.terminate_process()
+        # off the event loop: a hung frpc must not stall other tasks
+        await anyio.to_thread.run_sync(ops.reap_process)
+        ops.cleanup_config()
 
-            def wait_reap() -> None:
-                try:
-                    self.process.wait(timeout=5)
-                except subprocess.TimeoutExpired:
-                    self.process.kill()
-
-            # off the event loop: a hung frpc must not stall other tasks
-            await anyio.to_thread.run_sync(wait_reap)
-        if self._config_path and self._config_path.exists():
-            self._config_path.unlink(missing_ok=True)
-
-    async def __aenter__(self) -> "AsyncTunnel":  # type: ignore[override]
+    async def __aenter__(self) -> "AsyncTunnel":
         await self.start()
         return self
 
-    async def __aexit__(self, *exc: Any) -> None:  # type: ignore[override]
+    async def __aexit__(self, *exc: Any) -> None:
         await self.stop()

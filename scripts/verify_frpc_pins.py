@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Release-time check: FRPC_CHECKSUMS must match the published artifacts.
+
+Downloads each pinned frp release tarball and compares its sha256 against the
+pin in prime_tpu.tunnel.binary. Needs network egress — run at release time,
+not in CI sandboxes. Exits non-zero on any mismatch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import sys
+
+import httpx
+
+from prime_tpu.tunnel.binary import FRPC_CHECKSUMS, FRPC_VERSION, RELEASE_URL
+
+
+def main() -> int:
+    failures = 0
+    for plat, expected in FRPC_CHECKSUMS.items():
+        url = RELEASE_URL.format(v=FRPC_VERSION, plat=plat)
+        try:
+            response = httpx.get(url, follow_redirects=True, timeout=300.0)
+            response.raise_for_status()
+        except httpx.HTTPError as e:
+            print(f"FAIL {plat}: download error: {e}")
+            failures += 1
+            continue
+        digest = hashlib.sha256(response.content).hexdigest()
+        if digest == expected:
+            print(f"ok   {plat}: {digest}")
+        else:
+            print(f"FAIL {plat}: pinned {expected} but artifact is {digest}")
+            failures += 1
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

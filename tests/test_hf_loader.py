@@ -78,3 +78,33 @@ def test_decode_matches_transformers_generation(hf_model):
         temperature=0.0,
     )
     np.testing.assert_array_equal(np.asarray(result.tokens[0]), hf_out)
+
+
+def test_config_from_hf_rejects_decoupled_head_dim():
+    import pytest
+
+    from prime_tpu.models.hf_loader import config_from_hf
+
+    class Cfg:
+        vocab_size = 128
+        hidden_size = 64
+        num_hidden_layers = 2
+        num_attention_heads = 4
+        head_dim = 32  # != 64 // 4
+
+    with pytest.raises(ValueError, match="head_dim"):
+        config_from_hf(Cfg())
+
+
+def test_config_from_hf_accepts_matching_head_dim():
+    from prime_tpu.models.hf_loader import config_from_hf
+
+    class Cfg:
+        vocab_size = 128
+        hidden_size = 64
+        num_hidden_layers = 2
+        num_attention_heads = 4
+        head_dim = 16
+        intermediate_size = 256
+
+    assert config_from_hf(Cfg()).d_model == 64

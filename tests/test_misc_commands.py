@@ -447,3 +447,42 @@ def test_tunnel_spawn_failure_cleans_registration(fake, tmp_path):
     with pytest.raises(OSError):
         tunnel.start(timeout_s=5)
     assert fake.misc_plane.tunnels == {}  # registration rolled back
+
+
+def test_tunnel_config_failure_cleans_registration(fake, fake_frpc, monkeypatch):
+    """Any failure after POST /tunnels — not just spawn — rolls back (ADVICE r1)."""
+    from prime_tpu.core.client import APIClient
+    from prime_tpu.core.config import Config
+    from prime_tpu.tunnel import Tunnel
+    from prime_tpu.tunnel.tunnel import _TunnelOps
+
+    def boom(self, registration):
+        self.registration = registration
+        raise KeyError("hostname")
+
+    monkeypatch.setattr(_TunnelOps, "write_config", boom)
+    cfg = Config()
+    cfg.api_key = "test-key"
+    api = APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    tunnel = Tunnel(8080, client=api, frpc_path=fake_frpc)
+    with pytest.raises(KeyError):
+        tunnel.start(timeout_s=5)
+    assert fake.misc_plane.tunnels == {}
+
+
+def test_tunnel_timeout_cleans_registration(fake, tmp_path):
+    from prime_tpu.core.client import APIClient
+    from prime_tpu.core.config import Config
+    from prime_tpu.tunnel import Tunnel, TunnelError
+
+    silent = tmp_path / "frpc-silent"
+    silent.write_text("#!/usr/bin/env python3\nimport time; time.sleep(30)\n")
+    silent.chmod(0o755)
+    cfg = Config()
+    cfg.api_key = "test-key"
+    api = APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    tunnel = Tunnel(8080, client=api, frpc_path=silent)
+    with pytest.raises(TunnelError, match="did not connect"):
+        tunnel.start(timeout_s=0.5)
+    assert fake.misc_plane.tunnels == {}
+    assert tunnel.process.poll() is not None  # frpc reaped

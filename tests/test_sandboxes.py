@@ -409,3 +409,15 @@ async def test_async_wait_for_background_job(fake, tmp_path):
     job = await client.wait_for_background_job(sb.sandbox_id, "aw", timeout_s=10, poll_interval_s=0.1)
     assert job.exit_code == 0 and "finished" in job.stdout_tail
     await client.close()
+
+
+@pytest.mark.parametrize("bad", ["a b", "x;rm -rf /", "../escape", "", "a" * 65, "$(id)", ".", ".."])
+def test_background_job_name_validation_rejects(client, bad):
+    with pytest.raises(ValueError, match="Invalid background job name"):
+        client.start_background_job("sbx-any", bad, "true")
+
+
+def test_background_job_name_validation_accepts_safe_charset():
+    from prime_tpu.sandboxes.client import _SandboxOps
+
+    assert _SandboxOps.validate_job_name("train-run_1.log") == "train-run_1.log"
