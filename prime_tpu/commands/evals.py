@@ -9,6 +9,8 @@ architecture (SURVEY.md §3.3), so hub pushes stay contract-compatible.
 
 from __future__ import annotations
 
+from pathlib import Path
+
 import click
 
 import prime_tpu.commands._deps as deps
@@ -28,6 +30,12 @@ def build_evals_client() -> EvalsClient:
     return EvalsClient(api)
 
 
+def _is_default(ctx: click.Context, param: str) -> bool:
+    from click.core import ParameterSource
+
+    return ctx.get_parameter_source(param) == ParameterSource.DEFAULT
+
+
 POLL_INTERVAL_S = 3.0
 
 
@@ -45,6 +53,11 @@ POLL_INTERVAL_S = 3.0
 @click.option("--push/--no-push", "do_push", default=True, help="Push results to the Evals Hub.")
 @click.option("--hosted", is_flag=True, help="Run on the platform instead of locally.")
 @click.option("--tpu", "tpu_type", default="v5e-8", help="TPU slice for --hosted runs.")
+@click.option(
+    "--slice", "slice_name", default=None,
+    help="Shard the local model over this TPU slice's mesh (e.g. v5e-8).",
+)
+@click.option("--tp", "tensor_parallel", type=int, default=None, help="Tensor-parallel axis for --slice.")
 @output_options
 def run_eval_cmd(
     render: Renderer,
@@ -61,6 +74,8 @@ def run_eval_cmd(
     do_push: bool,
     hosted: bool,
     tpu_type: str,
+    slice_name: str | None,
+    tensor_parallel: int | None,
 ) -> None:
     """Run ENV against a model (local TPU by default, --hosted for platform)."""
     from prime_tpu.evals.runner import EvalRunSpec, push_eval_results, run_eval
@@ -86,8 +101,59 @@ def run_eval_cmd(
         _run_hosted(render, env, model, limit, batch_size, max_new_tokens, temperature, tpu_type)
         return
 
+    # environment execution protocol: resolve (local dir / installed / hub)
+    # → import load_environment() → its dataset+scorer drive the generator.
+    # Built-in labels and explicit --dataset runs skip resolution entirely:
+    # a hub env named "gsm8k" must not shadow the built-in, and a
+    # user-supplied dataset must not be silently replaced by env data.
+    from prime_tpu.commands.env import build_hub_client
+    from prime_tpu.envhub.execution import (
+        BUILTIN_ENVS,
+        EnvProtocolError,
+        EnvResolutionError,
+        load_environment,
+        resolve_environment,
+    )
+
+    env_examples = env_scorer = None
+    run_env_name = env
+    resolved = None
+    if env not in BUILTIN_ENVS and dataset is None:
+        try:
+            resolved = resolve_environment(env, hub_client=build_hub_client())
+        except EnvResolutionError as e:
+            if Path(env).suffix == "" and "/" in env:
+                # looked like a path/slug and nothing else will supply data
+                raise click.ClickException(str(e)) from None
+    if resolved is not None:
+        if resolved.drift:
+            click.echo(f"warning: {resolved.drift}", err=True)
+        try:
+            loaded = load_environment(resolved)
+        except EnvProtocolError as e:
+            raise click.ClickException(str(e)) from None
+        from prime_tpu.evals.datasets import EvalExample
+
+        env_examples = [
+            EvalExample(question=str(e["prompt"]), answer=str(e["answer"]), prompt=str(e["prompt"]))
+            for e in loaded.examples
+        ]
+        env_scorer = loaded.scorer
+        run_env_name = loaded.name
+        # env-declared eval defaults apply unless the flag was given explicitly
+        ctx = click.get_current_context()
+        if "max_new_tokens" in loaded.defaults and _is_default(ctx, "max_new_tokens"):
+            max_new_tokens = int(loaded.defaults["max_new_tokens"])
+        if "temperature" in loaded.defaults and _is_default(ctx, "temperature"):
+            temperature = float(loaded.defaults["temperature"])
+        render.message(
+            f"Resolved env {loaded.name} ({resolved.source}"
+            + (f"@{resolved.version}" if resolved.version else "")
+            + f", {len(env_examples)} examples)"
+        )
+
     spec = EvalRunSpec(
-        env=env,
+        env=run_env_name,
         model=model,
         dataset_path=dataset,
         limit=limit,
@@ -97,14 +163,16 @@ def run_eval_cmd(
         checkpoint=checkpoint,
         tokenizer=tokenizer,
         output_dir=output_dir,
+        slice_name=slice_name,
+        tensor_parallel=tensor_parallel,
     )
 
     def progress(done: int, total: int) -> None:
         render.message(f"  {done}/{total} samples")
 
-    render.message(f"Running {env} with {model} (limit {limit}, batch {batch_size})...")
+    render.message(f"Running {run_env_name} with {model} (limit {limit}, batch {batch_size})...")
     try:
-        result = run_eval(spec, progress=progress)
+        result = run_eval(spec, progress=progress, examples=env_examples, scorer=env_scorer)
     except (ValueError, FileNotFoundError) as e:
         raise click.ClickException(str(e)) from None
     payload = {

@@ -40,6 +40,8 @@ class EvalRunSpec:
     output_dir: str = "outputs/evals"
     checkpoint: str | None = None        # local HF checkpoint dir
     tokenizer: str | None = None         # tokenizer name/path; None -> byte fallback
+    slice_name: str | None = None        # TPU slice (e.g. v5e-8) -> sharded generate
+    tensor_parallel: int | None = None   # override tp axis (default: mesh_for_slice policy)
     metadata: dict = field(default_factory=dict)
 
 
@@ -55,7 +57,15 @@ class EvalRunResult:
 
 
 class JaxGenerator:
-    """Model provider backed by prime_tpu.models (the native TPU path)."""
+    """Model provider backed by prime_tpu.models (the native TPU path).
+
+    Sharded serving (the north-star workload, reference verifiers_bridge.py:944
+    played by a native pjit path): pass ``mesh`` (or ``slice_name`` to derive a
+    (dp, fsdp, tp) mesh via parallel.mesh.mesh_for_slice) and params are placed
+    with the megatron-TP + ZeRO-3 specs from parallel.sharding; prefill+decode
+    then run SPMD with the KV cache pinned batch-on-data-axes / heads-on-tp.
+    An 8B bf16 checkpoint (~16 GB) only fits a v5e-8 slice this way.
+    """
 
     def __init__(
         self,
@@ -63,6 +73,9 @@ class JaxGenerator:
         checkpoint: str | None = None,
         tokenizer: str | None = None,
         dtype=None,
+        mesh=None,
+        slice_name: str | None = None,
+        tensor_parallel: int | None = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -92,6 +105,24 @@ class JaxGenerator:
                 f"Tokenizer vocab ({tok_vocab}) exceeds model vocab "
                 f"({self.config.vocab_size}) — ids would index out of bounds"
             )
+
+        if mesh is None and slice_name is not None:
+            from prime_tpu.parallel.mesh import mesh_for_slice
+
+            mesh = mesh_for_slice(slice_name, tensor_parallel=tensor_parallel)
+        self.mesh = mesh
+        self._data_size = 1
+        if mesh is not None:
+            from prime_tpu.parallel.sharding import shard_params
+
+            tp = mesh.shape.get("tp", 1)
+            if self.config.n_kv_heads % tp or self.config.n_heads % tp:
+                raise ValueError(
+                    f"tp={tp} must divide n_heads={self.config.n_heads} and "
+                    f"n_kv_heads={self.config.n_kv_heads} ({self.config.name})"
+                )
+            self._data_size = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+            self.params = shard_params(self.params, mesh, self.config)
         self._rng = jax.random.PRNGKey(0)
 
     def generate(self, prompts: list[str], max_new_tokens: int, temperature: float) -> list[str]:
@@ -107,26 +138,49 @@ class JaxGenerator:
             )
         keep = self.config.max_seq_len - max_new_tokens
         encoded = [self.tokenizer.encode(p)[-keep:] for p in prompts]
-        max_len = max(len(e) for e in encoded)
+        n_real = len(encoded)
         pad_id = self.tokenizer.pad_id
+        # SPMD needs the batch divisible by the data axes; pad with dummy rows
+        pad_rows = (-n_real) % self._data_size
+        encoded += [[pad_id]] * pad_rows
+        max_len = max(len(e) for e in encoded)
         batch = jnp.asarray(
             [e + [pad_id] * (max_len - len(e)) for e in encoded], dtype=jnp.int32
         )
         lengths = jnp.asarray([len(e) for e in encoded], dtype=jnp.int32)
         self._rng, rng = jax.random.split(self._rng)
-        result = sample_generate(
-            self.params,
-            batch,
-            lengths,
-            self.config,
-            rng,
-            max_new_tokens=max_new_tokens,
-            temperature=temperature,
-            eos_id=self.tokenizer.eos_id,
-            pad_id=pad_id,
-        )
-        tokens = result.tokens.tolist()
-        lens = result.lengths.tolist()
+        kw: dict = {}
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from prime_tpu.parallel.sharding import batch_spec, cache_spec, lengths_spec
+
+            batch = jax.device_put(batch, NamedSharding(self.mesh, batch_spec()))
+            lengths = jax.device_put(lengths, NamedSharding(self.mesh, lengths_spec()))
+            kw["cache_spec"] = cache_spec()
+            if self.mesh.size > 1:
+                # pallas kernels are not SPMD-partitionable under jit; on a
+                # real multi-device mesh the XLA paths (which XLA shards) must
+                # run instead of the single-device pallas decode kernel
+                kw["attn_impl"] = "xla"
+        import contextlib
+
+        ctx = jax.set_mesh(self.mesh) if self.mesh is not None else contextlib.nullcontext()
+        with ctx:
+            result = sample_generate(
+                self.params,
+                batch,
+                lengths,
+                self.config,
+                rng,
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                eos_id=self.tokenizer.eos_id,
+                pad_id=pad_id,
+                **kw,
+            )
+        tokens = jax.device_get(result.tokens).tolist()[:n_real]
+        lens = jax.device_get(result.lengths).tolist()[:n_real]
         return [self.tokenizer.decode(t[:n]) for t, n in zip(tokens, lens)]
 
 
@@ -134,15 +188,28 @@ def run_eval(
     spec: EvalRunSpec,
     generator: Generator | None = None,
     progress: Callable[[int, int], None] | None = None,
+    examples: list[EvalExample] | None = None,
+    scorer: Callable[[str, str], float] | None = None,
 ) -> EvalRunResult:
-    if spec.dataset_path:
+    """Run an eval. ``examples``/``scorer`` come from an executed environment
+    (envhub.execution.load_environment); otherwise the dataset path / synthetic
+    fallback supplies examples and exact-match scoring applies."""
+    if examples is not None:
+        examples = examples[: spec.limit] if spec.limit else list(examples)
+    elif spec.dataset_path:
         examples = load_gsm8k(spec.dataset_path, limit=spec.limit)
     else:
         examples = synthetic_arithmetic(spec.limit or 64)
     if not examples:
         raise ValueError(f"No examples loaded from {spec.dataset_path!r}")
     if generator is None:
-        generator = JaxGenerator(spec.model, checkpoint=spec.checkpoint, tokenizer=spec.tokenizer)
+        generator = JaxGenerator(
+            spec.model,
+            checkpoint=spec.checkpoint,
+            tokenizer=spec.tokenizer,
+            slice_name=spec.slice_name,
+            tensor_parallel=spec.tensor_parallel,
+        )
 
     samples: list[EvalSample] = []
     t0 = time.monotonic()
@@ -152,14 +219,19 @@ def run_eval(
             [e.prompt for e in chunk], spec.max_new_tokens, spec.temperature
         )
         for example, completion in zip(chunk, completions):
-            correct = score_completion(completion, example.answer)
+            if scorer is not None:
+                reward = float(scorer(completion, example.answer))
+                correct = reward >= 0.5
+            else:
+                correct = score_completion(completion, example.answer)
+                reward = 1.0 if correct else 0.0
             samples.append(
                 EvalSample(
                     sample_id=f"s_{len(samples)}",
                     prompt=example.prompt,
                     completion=completion,
                     answer=example.answer,
-                    reward=1.0 if correct else 0.0,
+                    reward=reward,
                     correct=correct,
                 )
             )

@@ -30,7 +30,15 @@ Params = dict[str, Any]
 
 
 class KVCache(NamedTuple):
-    """Per-layer stacked KV cache: k/v are (L, B, KH, C, head_dim)."""
+    """Per-layer stacked KV cache: k/v are (L, B, KH, head_dim, C).
+
+    The cache is stored **feature-major** (head_dim in sublanes, cache slots in
+    lanes) so decode reads are lane-aligned for any head_dim: C is always a
+    multiple of 128, head_dim often is not (llama3.2 uses 64). With the
+    conventional (C, head_dim) layout the flash-decode kernel would pad 64
+    lanes to 128 and read twice the cache bytes — fatal for a path that is
+    pure HBM bandwidth.
+    """
 
     k: jnp.ndarray
     v: jnp.ndarray
@@ -38,11 +46,11 @@ class KVCache(NamedTuple):
 
     @property
     def capacity(self) -> int:
-        return self.k.shape[3]
+        return self.k.shape[4]
 
 
 def init_cache(config: ModelConfig, batch: int, capacity: int, dtype=jnp.bfloat16) -> KVCache:
-    shape = (config.n_layers, batch, config.n_kv_heads, capacity, config.head_dim)
+    shape = (config.n_layers, batch, config.n_kv_heads, config.head_dim, capacity)
     return KVCache(
         k=jnp.zeros(shape, dtype=dtype),
         v=jnp.zeros(shape, dtype=dtype),
@@ -109,22 +117,28 @@ def _attention_block(
     new_k_cache, new_v_cache = k_cache, v_cache
     if decode:
         assert k_cache is not None and cache_lengths is not None
-        # scatter this step's k/v into each sequence's next free slot
-        def put(cache, new):  # cache (B, KH, C, hd), new (B, KH, 1, hd)
-            def one(c, n, idx):
-                return jax.lax.dynamic_update_slice(c, n, (0, idx, 0))
+        # scatter this step's k/v column into each sequence's next free slot
+        def put(cache, new):  # cache (B, KH, hd, C), new (B, KH, 1, hd)
+            col = new.transpose(0, 1, 3, 2)  # (B, KH, hd, 1)
 
-            return jax.vmap(one)(cache, new, cache_lengths)
+            def one(c, n, idx):
+                return jax.lax.dynamic_update_slice(c, n, (0, 0, idx))
+
+            return jax.vmap(one)(cache, col, cache_lengths)
 
         new_k_cache = put(k_cache, k)
         new_v_cache = put(v_cache, v)
-        attn = decode_attention(q, new_k_cache, new_v_cache, cache_lengths + 1, hd**-0.5)
+        attn = decode_attention(
+            q, new_k_cache, new_v_cache, cache_lengths + 1, hd**-0.5, impl=attn_impl
+        )
     else:
         attn = multi_head_attention(q, k, v, impl=attn_impl)
         if k_cache is not None:
-            # prefill: stage the prompt's k/v at slots [0, S)
-            new_k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
-            new_v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
+            # prefill: stage the prompt's k/v feature-major at slots [0, S)
+            k_t = k.transpose(0, 1, 3, 2)  # (B, KH, hd, S)
+            v_t = v.transpose(0, 1, 3, 2)
+            new_k_cache = jax.lax.dynamic_update_slice(k_cache, k_t, (0, 0, 0, 0))
+            new_v_cache = jax.lax.dynamic_update_slice(v_cache, v_t, (0, 0, 0, 0))
 
     attn = attn.transpose(0, 2, 1, 3).reshape(batch, seq, h * hd)
     return x + attn @ lp["wo"], new_k_cache, new_v_cache

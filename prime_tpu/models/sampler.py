@@ -33,7 +33,9 @@ def _sample(logits: jnp.ndarray, temperature: float, rng: jax.Array) -> jnp.ndar
 
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "max_new_tokens", "temperature", "eos_id", "pad_id", "attn_impl"),
+    static_argnames=(
+        "config", "max_new_tokens", "temperature", "eos_id", "pad_id", "attn_impl", "cache_spec",
+    ),
 )
 def generate(
     params,
@@ -46,10 +48,18 @@ def generate(
     eos_id: int = -1,              # -1 disables EOS stopping
     pad_id: int = 0,
     attn_impl: str = "auto",
+    cache_spec=None,               # PartitionSpec for the (L,B,KH,hd,C) cache; needs jax.set_mesh
 ) -> GenerationResult:
     batch, prompt_len = prompt_tokens.shape
     capacity = prompt_len + max_new_tokens
     cache = init_cache(config, batch, capacity, dtype=params["embed"].dtype)
+    if cache_spec is not None:
+        # pin the cache layout before it enters the scan carry — XLA would
+        # otherwise be free to replicate the zeros init across the mesh
+        cache = cache._replace(
+            k=jax.lax.with_sharding_constraint(cache.k, cache_spec),
+            v=jax.lax.with_sharding_constraint(cache.v, cache_spec),
+        )
 
     # ---- prefill ----
     logits, cache = forward(

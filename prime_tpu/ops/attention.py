@@ -45,26 +45,56 @@ def xla_attention_causal(
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
 
 
+def _decode_pallas_eligible(k_cache: jnp.ndarray) -> bool:
+    if jax.default_backend() != "tpu":
+        return False
+    capacity = k_cache.shape[3]
+    from prime_tpu.ops.pallas_attention import BLOCK_C
+
+    # full (D, C) kv head blocks live in VMEM; cap C so two of them fit easily
+    return capacity % BLOCK_C == 0 and capacity * k_cache.shape[2] <= 2**22
+
+
 def decode_attention(
     q: jnp.ndarray,          # (B, H, 1, D)
-    k_cache: jnp.ndarray,    # (B, KH, C, D)
-    v_cache: jnp.ndarray,    # (B, KH, C, D)
+    k_cache: jnp.ndarray,    # (B, KH, D, C) feature-major (see models.llama.KVCache)
+    v_cache: jnp.ndarray,    # (B, KH, D, C)
     cache_lengths: jnp.ndarray,  # (B,) number of valid cache entries
     sm_scale: float,
+    impl: str = "auto",      # auto | pallas | xla
 ) -> jnp.ndarray:
-    """One decode step against the cache, masking invalid (future) slots."""
-    num_heads, kv_heads = q.shape[1], k_cache.shape[1]
-    if kv_heads != num_heads:
-        reps = num_heads // kv_heads
-        k_cache = jnp.repeat(k_cache, reps, axis=1)
-        v_cache = jnp.repeat(v_cache, reps, axis=1)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache, preferred_element_type=jnp.float32) * sm_scale
-    cache_size = k_cache.shape[2]
-    slot_ids = jnp.arange(cache_size)[None, None, None, :]
+    """One decode step against the cache, masking invalid (future) slots.
+
+    On TPU this dispatches to the pallas flash-decode kernel (early-exit at
+    each sequence's true length, one fused HBM pass). The XLA fallback is a
+    grouped einsum — GQA without jnp.repeat, so the cache is never
+    materialized per-query-head.
+
+    Callers running under a multi-device mesh must pass ``impl="xla"``:
+    a pallas_call is not SPMD-partitionable, so the kernel is only valid when
+    each device sees the whole (or an explicitly shard_mapped) cache. The
+    eval runner does this automatically (evals/runner.py JaxGenerator).
+    """
+    if impl == "pallas" or (impl == "auto" and _decode_pallas_eligible(k_cache)):
+        from prime_tpu.ops.pallas_attention import flash_decode
+
+        return flash_decode(q, k_cache, v_cache, cache_lengths, sm_scale=sm_scale)
+
+    batch, num_heads, _, head_dim = q.shape
+    kv_heads = k_cache.shape[1]
+    group = num_heads // kv_heads
+    qg = q.reshape(batch, kv_heads, group, head_dim)
+    scores = (
+        jnp.einsum("bkgd,bkdc->bkgc", qg, k_cache, preferred_element_type=jnp.float32)
+        * sm_scale
+    )
+    capacity = k_cache.shape[3]
+    slot_ids = jnp.arange(capacity)[None, None, None, :]
     valid = slot_ids < cache_lengths[:, None, None, None]
     scores = jnp.where(valid, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v_cache)
+    out = jnp.einsum("bkgc,bkdc->bkgd", probs.astype(q.dtype), v_cache)
+    return out.reshape(batch, num_heads, 1, head_dim)
 
 
 def multi_head_attention(

@@ -24,6 +24,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 BLOCK_Q = 128
 BLOCK_K = 128
+BLOCK_C = 128  # flash-decode cache-slot block (lane dimension of the kv cache)
 NEG_INF = -1e30
 
 
@@ -72,6 +73,98 @@ def _flash_kernel(
     m, l, acc = jax.lax.fori_loop(0, last_block, body, (m, l, acc))
 
     o_ref[0, 0, :, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _decode_kernel(
+    lengths_ref,  # (B,) scalar-prefetch, SMEM
+    q_ref,        # (1, 1, G, D)
+    k_ref,        # (1, 1, D, C) one kv head's cache, feature-major
+    v_ref,        # (1, 1, D, C)
+    o_ref,        # (1, 1, G, D)
+    *,
+    sm_scale: float,
+    block_c: int,
+):
+    b = pl.program_id(0)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (G, D)
+    group = q.shape[0]
+    length = lengths_ref[b]
+
+    m = jnp.full((group, 1), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((group, 1), dtype=jnp.float32)
+    acc = jnp.zeros(q.shape, dtype=jnp.float32)
+
+    def body(cb, carry):
+        m_prev, l_prev, acc_prev = carry
+        k = k_ref[0, 0, :, pl.ds(cb * block_c, block_c)].astype(jnp.float32)  # (D, BC)
+        v = v_ref[0, 0, :, pl.ds(cb * block_c, block_c)].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (G, BC)
+        slots = cb * block_c + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        scores = jnp.where(slots < length, scores, NEG_INF)
+
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc_prev * alpha + jax.lax.dot_general(
+            p, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (G, D)
+        return m_new, l_new, acc_new
+
+    # early exit: only stream cache blocks that hold valid entries for THIS
+    # sequence — mid-generation that is ~half the capacity, and the decode
+    # step is pure HBM bandwidth, so skipped blocks are direct speedup
+    num_blocks = pl.cdiv(length, block_c)
+    m, l, acc = jax.lax.fori_loop(0, num_blocks, body, (m, l, acc))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def flash_decode(
+    q: jnp.ndarray,              # (B, H, 1, D)
+    k_cache: jnp.ndarray,        # (B, KH, D, C) feature-major
+    v_cache: jnp.ndarray,        # (B, KH, D, C)
+    cache_lengths: jnp.ndarray,  # (B,) valid entries per sequence
+    sm_scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One fused decode step: for each (batch, kv-head) program, stream the
+    cache through VMEM with online softmax, stopping at the sequence's true
+    length (scalar-prefetched). C must be a multiple of BLOCK_C. The
+    feature-major cache keeps reads lane-aligned for any head_dim."""
+    batch, num_heads, _, head_dim = q.shape
+    kv_heads, capacity = k_cache.shape[1], k_cache.shape[3]
+    assert num_heads % kv_heads == 0
+    group = num_heads // kv_heads
+    if sm_scale is None:
+        sm_scale = head_dim**-0.5
+    block_c = min(BLOCK_C, capacity)
+
+    kernel = functools.partial(_decode_kernel, sm_scale=sm_scale, block_c=block_c)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(batch, kv_heads),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, head_dim), lambda b, h, lens: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, head_dim, capacity), lambda b, h, lens: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, head_dim, capacity), lambda b, h, lens: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, head_dim), lambda b, h, lens: (b, h, 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((batch, kv_heads, group, head_dim), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 2 * batch * num_heads * capacity * head_dim,
+            bytes_accessed=(k_cache.size + v_cache.size) * k_cache.dtype.itemsize,
+            transcendentals=batch * num_heads * capacity,
+        ),
+        interpret=interpret,
+    )(cache_lengths.astype(jnp.int32), q.reshape(batch, kv_heads, group, head_dim), k_cache, v_cache)
+    return out.reshape(batch, num_heads, 1, head_dim)
 
 
 @functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))

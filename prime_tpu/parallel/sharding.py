@@ -49,6 +49,20 @@ def batch_spec() -> P:
     return P(("dp", "fsdp"), None)
 
 
+def lengths_spec() -> P:
+    return P(("dp", "fsdp"))
+
+
+def cache_spec() -> P:
+    """KV cache (L, B, KH, hd, C): batch on the data axes, kv-heads on tp.
+
+    Pinning this matters for serving: without a constraint XLA may replicate
+    the zeros-initialised cache, which for an 8B model at long context is the
+    difference between fitting v5e HBM and OOM.
+    """
+    return P(None, ("dp", "fsdp"), "tp", None, None)
+
+
 def logits_spec() -> P:
     return P(("dp", "fsdp"), None, "tp")
 

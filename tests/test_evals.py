@@ -206,3 +206,20 @@ def test_max_new_tokens_bound(tmp_path):
     gen = JaxGenerator("tiny-test")
     with pytest.raises(ValueError, match="max_new_tokens"):
         gen.generate(["hi"], max_new_tokens=600, temperature=0.0)
+
+
+def test_run_eval_sharded_slice(tmp_path):
+    """North-star shape: eval run with --slice shards the generator over the
+    (virtual) v5e-8 mesh and still writes the results contract."""
+    spec = EvalRunSpec(
+        env="arith",
+        model="tiny-test",
+        limit=4,
+        batch_size=3,  # deliberately not divisible by the data axes
+        max_new_tokens=8,
+        output_dir=str(tmp_path),
+        slice_name="v5e-8",
+    )
+    result = run_eval(spec)
+    assert result.metrics["num_samples"] == 4
+    assert (result.run_dir / "results.jsonl").exists()
