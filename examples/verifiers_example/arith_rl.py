@@ -1,10 +1,44 @@
-"""Environment entry point: load_environment() -> examples + scorer."""
+"""Environment entry point: load_environment() -> examples + scorer.
+
+The examples live in gsm8k-format jsonl (question + "#### <answer>" rationale);
+load_environment() formats them into prompts and exposes a scorer that
+extracts the final number from a completion — the contract
+prime_tpu.envhub.execution drives the JAX generator with.
+"""
 
 import json
 import pathlib
+import re
+
+PROMPT_TEMPLATE = "Question: {question}\nAnswer: Let's think step by step."
+
+_FINAL_NUMBER = re.compile(r"####\s*([-+]?[\d,.]+)")
+_ANY_NUMBER = re.compile(r"([-+]?\d[\d,]*\.?\d*)")
+
+
+def _gold_answer(answer_text: str) -> str:
+    match = _FINAL_NUMBER.search(answer_text)
+    raw = match.group(1) if match else answer_text
+    return raw.replace(",", "").strip().rstrip(".")
+
+
+def score(completion: str, answer: str) -> float:
+    """1.0 if the last number in the completion equals the gold answer."""
+    numbers = _ANY_NUMBER.findall(completion.replace(",", ""))
+    return 1.0 if numbers and numbers[-1].rstrip(".") == answer else 0.0
 
 
 def load_environment():
     data = pathlib.Path(__file__).parent / "data" / "eval.jsonl"
-    examples = [json.loads(line) for line in data.read_text().splitlines() if line.strip()]
-    return {"name": "arith-rl", "examples": examples}
+    records = [json.loads(line) for line in data.read_text().splitlines() if line.strip()]
+    return {
+        "name": "arith-rl",
+        "examples": [
+            {
+                "prompt": PROMPT_TEMPLATE.format(question=r["question"]),
+                "answer": _gold_answer(r["answer"]),
+            }
+            for r in records
+        ],
+        "score": score,
+    }

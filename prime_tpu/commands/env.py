@@ -35,7 +35,7 @@ def build_hub_client() -> EnvHubClient:
     return EnvHubClient(APIClient(config=deps.build_config(), transport=deps.transport_override))
 
 
-from prime_tpu.envhub.local import installs_dir, read_registry as _installed_registry, save_registry as _save_registry
+from prime_tpu.envhub.local import read_registry as _installed_registry, save_registry as _save_registry
 
 
 @env_group.command("init")
@@ -120,14 +120,12 @@ def pull_cmd(render: Renderer, name: str, version: str | None, target: str | Non
 @click.option("--version", default=None)
 @output_options
 def install_cmd(render: Renderer, name: str, version: str | None) -> None:
-    """Install an environment from the hub into the local env store."""
-    import shutil
+    """Install an environment from the hub: pull, build the wheel, pip-install
+    it (pull-and-build, reference env.py:2431/:3069), register locally."""
+    from prime_tpu.envhub.execution import install_from_hub
 
-    archive, info = build_hub_client().pull(name, version=version)
-    target = installs_dir() / name
-    # clean install: stale files from a previous version must not survive
-    shutil.rmtree(target, ignore_errors=True)
-    extract_archive(archive, target)
+    entry = install_from_hub(build_hub_client(), name, version=version)
+    target = Path(entry["path"])
     # TPU requirement check (best-effort; informative, not fatal)
     try:
         metadata = read_env_metadata(target)
@@ -136,12 +134,74 @@ def install_cmd(render: Renderer, name: str, version: str | None) -> None:
             render.message(f"  env declares TPU requirement: {tpu_req}")
     except (FileNotFoundError, ValueError):
         pass
-    registry = _installed_registry()
-    registry[name] = {"version": info["version"], "path": str(target), "contentHash": info.get("contentHash")}
-    _save_registry(registry)
-    render.message(f"Installed {name}@{info['version']} -> {target}")
+    if entry.get("installNote"):
+        render.message(f"  note: {entry['installNote']}", err=True)
+    render.message(
+        f"Installed {name}@{entry['version']} -> {target}"
+        + (" (pip package installed)" if entry.get("pipInstalled") else "")
+    )
     if render.is_json:
-        render.json(registry[name] | {"name": name})
+        render.json(entry)
+
+
+@env_group.command("inspect")
+@click.argument("env_ref")
+@output_options
+def inspect_cmd(render: Renderer, env_ref: str) -> None:
+    """Inspect an env (local dir, installed name, or hub slug): metadata,
+    content hash, entry module, example count, drift vs the hub."""
+    from prime_tpu.envhub.execution import (
+        EnvProtocolError,
+        EnvResolutionError,
+        load_environment,
+        resolve_environment,
+    )
+    from prime_tpu.envhub.packaging import content_hash as compute_hash, iter_env_files
+
+    try:
+        resolved = resolve_environment(env_ref, hub_client=build_hub_client(), install_missing=False)
+    except EnvResolutionError as e:
+        # not local and not installed — fall back to hub-side metadata only
+        from prime_tpu.core.exceptions import APIError
+
+        try:
+            hub = build_hub_client().get(env_ref)
+        except APIError:
+            raise click.ClickException(str(e)) from None
+        render.detail(
+            {
+                "name": hub.get("name", env_ref),
+                "source": "hub (not installed)",
+                "latestVersion": hub.get("latestVersion"),
+                "visibility": hub.get("visibility"),
+                "contentHash": hub.get("contentHash"),
+                "tags": hub.get("tags", []),
+                "tpu": hub.get("tpu", {}),
+            },
+            title=f"Environment {env_ref}",
+        )
+        return
+    files = iter_env_files(resolved.env_dir)
+    payload: dict = {
+        "name": resolved.name,
+        "source": resolved.source,
+        "dir": str(resolved.env_dir),
+        "version": resolved.version,
+        "contentHash": compute_hash(resolved.env_dir),
+        "files": len(files),
+        "drift": resolved.drift,
+    }
+    if resolved.metadata:
+        payload["tpu"] = resolved.metadata.get("tpu", {})
+        payload["eval"] = resolved.metadata.get("eval", {})
+    try:
+        loaded = load_environment(resolved)
+        payload["examples"] = len(loaded.examples)
+        payload["hasScorer"] = loaded.scorer is not None
+        payload["loadEnvironment"] = "ok"
+    except EnvProtocolError as e:
+        payload["loadEnvironment"] = str(e)
+    render.detail(payload, title=f"Environment {resolved.name}")
 
 
 @env_group.command("uninstall")
@@ -257,14 +317,46 @@ def env_secrets_delete(name: str, key: str) -> None:
     click.echo(f"Secret {key} deleted from {name}.")
 
 
-@env_group.command("actions")
+@env_group.group("actions")
+def actions_subgroup() -> None:
+    """Hub-side actions on an environment (builds, pushes)."""
+
+
+@actions_subgroup.command("list")
 @click.argument("name")
 @output_options
-def actions_cmd(render: Renderer, name: str) -> None:
+def actions_list_cmd(render: Renderer, name: str) -> None:
     rows = build_hub_client().actions(name)
     render.table(
-        ["ACTION", "VERSION"],
-        [[a.get("action", ""), a.get("version", "")] for a in rows],
+        ["ID", "ACTION", "VERSION", "STATUS"],
+        [
+            [a.get("id", ""), a.get("action", ""), a.get("version", ""), a.get("status", "")]
+            for a in rows
+        ],
         title=f"{name} actions",
         json_rows=rows,
     )
+
+
+@actions_subgroup.command("logs")
+@click.argument("name")
+@click.argument("action_id")
+@output_options
+def actions_logs_cmd(render: Renderer, name: str, action_id: str) -> None:
+    logs = build_hub_client().action_logs(name, action_id)
+    if render.is_json:
+        render.json({"logs": logs})
+    else:
+        for line in logs:
+            render.message(line)
+
+
+@actions_subgroup.command("retry")
+@click.argument("name")
+@click.argument("action_id")
+@output_options
+def actions_retry_cmd(render: Renderer, name: str, action_id: str) -> None:
+    result = build_hub_client().retry_action(name, action_id)
+    render.message(f"Retried {action_id} -> {result.get('id')} ({result.get('status')}).")
+    if render.is_json:
+        render.json(result)

@@ -87,3 +87,12 @@ class EnvHubClient:
     def actions(self, name: str) -> list[dict[str, Any]]:
         data = self.api.get(f"/envhub/environments/{name}/actions")
         return data.get("items", []) if isinstance(data, dict) else data
+
+    def action_logs(self, name: str, action_id: str) -> list[str]:
+        data = self.api.get(f"/envhub/environments/{name}/actions/{action_id}/logs")
+        return data.get("logs", []) if isinstance(data, dict) else data
+
+    def retry_action(self, name: str, action_id: str) -> dict[str, Any]:
+        return self.api.post(
+            f"/envhub/environments/{name}/actions/{action_id}/retry", idempotent_post=True
+        )

@@ -1,0 +1,327 @@
+"""Environment execution protocol: resolve → prepare → import → run.
+
+The reference's eval architecture shells out to the `verifiers` framework
+(reference verifiers_bridge.py:724 `_prepare_single_environment`, :944
+`run_eval_passthrough`, verifiers_plugin.py:100): an env reference is resolved
+(local dir vs hub slug, with content-hash drift detection :365-409), installed
+if needed, then executed as a subprocess that drives an OpenAI endpoint.
+
+TPU-native redesign: environments are imported **in-process** and their
+dataset + scorer drive the native JAX generator directly — no subprocess, no
+HTTP round-trip per rollout; the generator batches prompts straight onto the
+chip. The env contract is the `load_environment()` entry point the packaging
+template scaffolds (envhub/packaging.py):
+
+    def load_environment() -> dict:
+        return {
+            "name": "my-env",
+            "examples": [{"prompt": ..., "answer": ...}, ...],
+            # optional:
+            "score": lambda completion, answer: float reward in [0, 1],
+            "max_new_tokens": 256,
+            "temperature": 0.0,
+        }
+
+(an object with .examples / .score attributes works too).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import sys
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Callable
+
+from prime_tpu.envhub.local import installs_dir, read_registry, save_registry
+from prime_tpu.envhub.packaging import content_hash, extract_archive, read_env_metadata
+
+
+# labels `prime eval run` treats as built-in datasets, never env refs — a hub
+# env with one of these names cannot shadow the built-in behavior
+BUILTIN_ENVS = frozenset({"gsm8k", "arith"})
+
+
+class EnvResolutionError(RuntimeError):
+    pass
+
+
+class EnvProtocolError(RuntimeError):
+    pass
+
+
+@dataclass
+class ResolvedEnv:
+    name: str
+    env_dir: Path
+    source: str                      # local | installed | hub
+    version: str | None = None
+    drift: str | None = None         # human-readable drift warning, if any
+    metadata: dict | None = None     # parsed env.toml
+
+
+@dataclass
+class LoadedEnvironment:
+    name: str
+    examples: list[dict]                       # [{"prompt":..., "answer":...}]
+    scorer: Callable[[str, str], float] | None
+    defaults: dict                             # eval defaults (max_new_tokens, ...)
+
+
+def install_from_hub(hub_client, name: str, version: str | None = None) -> dict:
+    """Pull an env from the hub into the local store and register it.
+
+    Mirrors the reference's install-from-hub with pull-and-build fallback
+    (reference env.py:2431, :3069): the wheel is built locally from the pulled
+    source and pip-installed so the env's module is importable package-style;
+    a failed wheel build degrades to path-import-only (the execution protocol
+    imports by path regardless).
+    """
+    import shutil
+
+    archive, info = hub_client.pull(name, version=version)
+    target = installs_dir() / name
+    # clean install: stale files from a previous version must not survive
+    shutil.rmtree(target, ignore_errors=True)
+    extract_archive(archive, target)
+    entry = {
+        "version": info["version"],
+        "path": str(target),
+        "contentHash": info.get("contentHash"),
+    }
+    wheel_error = _pip_install_env(target)
+    entry["pipInstalled"] = wheel_error is None
+    if wheel_error is not None:
+        entry["installNote"] = wheel_error
+    registry = read_registry()
+    registry[name] = entry
+    save_registry(registry)
+    return entry | {"name": name}
+
+
+def env_site_dir() -> Path:
+    """Site dir for pip-installed env packages (~/.prime/envs/_site).
+
+    A dedicated --target dir rather than the live site-packages: installs
+    stay inside the prime store (uninstall = rm), never mutate the user's
+    Python environment, and the execution protocol adds it to sys.path when
+    importing — same importability, no global side effects.
+    """
+    return installs_dir() / "_site"
+
+
+def _pip_install_env(env_dir: Path) -> str | None:
+    """Build the env's wheel and pip-install it into the env site dir.
+    Returns None on success, else a short reason (import-by-path still works
+    without it)."""
+    import subprocess
+
+    from prime_tpu.envhub.packaging import build_wheel
+
+    if not (env_dir / "pyproject.toml").exists():
+        return "no pyproject.toml — path-import only"
+    try:
+        wheel = build_wheel(env_dir)
+    except RuntimeError as e:
+        return f"wheel build failed: {e}"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pip", "install", "--no-deps", "--upgrade",
+            "--target", str(env_site_dir()), str(wheel),
+        ],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        return f"pip install failed: {proc.stderr.strip()[-300:]}"
+    return None
+
+
+def resolve_environment(
+    env_ref: str,
+    hub_client=None,
+    install_missing: bool = True,
+) -> ResolvedEnv:
+    """Resolve an env reference the way the reference CLI does: a local
+    directory beats an installed env beats a hub slug (installed on demand)."""
+    # 1. local directory containing env.toml
+    local = Path(env_ref)
+    if (local / "env.toml").exists():
+        metadata = read_env_metadata(local)
+        resolved = ResolvedEnv(
+            name=metadata["name"], env_dir=local.resolve(), source="local", metadata=metadata
+        )
+        if hub_client is not None:
+            resolved.drift = _local_drift(local, metadata["name"], hub_client)
+        return resolved
+
+    # 2. installed env store
+    registry = read_registry()
+    if env_ref in registry:
+        entry = registry[env_ref]
+        env_dir = Path(entry["path"])
+        if not env_dir.exists():
+            raise EnvResolutionError(
+                f"{env_ref} is registered but {env_dir} is missing — reinstall with "
+                f"`prime env install {env_ref}`"
+            )
+        drift = None
+        if hub_client is not None:
+            drift = _installed_drift(env_ref, entry, hub_client)
+        metadata = _try_metadata(env_dir)
+        return ResolvedEnv(
+            name=env_ref,
+            env_dir=env_dir,
+            source="installed",
+            version=entry.get("version"),
+            drift=drift,
+            metadata=metadata,
+        )
+
+    # 3. hub slug → install on demand
+    if hub_client is not None and install_missing:
+        from prime_tpu.core.exceptions import APIError
+
+        try:
+            entry = install_from_hub(hub_client, env_ref)
+        except APIError as e:
+            raise EnvResolutionError(
+                f"{env_ref!r} is not a local env dir, not installed, and the hub "
+                f"lookup failed: {e}"
+            ) from None
+        metadata = _try_metadata(Path(entry["path"]))
+        return ResolvedEnv(
+            name=env_ref,
+            env_dir=Path(entry["path"]),
+            source="hub",
+            version=entry.get("version"),
+            metadata=metadata,
+        )
+    raise EnvResolutionError(
+        f"{env_ref!r} is not a local env dir and is not installed "
+        "(no hub client available to install it)"
+    )
+
+
+def _try_metadata(env_dir: Path) -> dict | None:
+    try:
+        return read_env_metadata(env_dir)
+    except (FileNotFoundError, ValueError):
+        return None
+
+
+def _local_drift(env_dir: Path, name: str, hub_client) -> str | None:
+    """Local dir vs hub content hash (reference verifiers_bridge.py:365-409)."""
+    from prime_tpu.core.exceptions import APIError
+
+    try:
+        hub = hub_client.get(name)
+    except APIError:
+        return None
+    hub_hash = hub.get("contentHash")
+    if hub_hash and hub_hash != content_hash(env_dir):
+        return (
+            f"local {name}/ differs from the hub version "
+            f"({hub.get('latestVersion', '?')}) — running LOCAL code; "
+            f"`prime env push` to sync"
+        )
+    return None
+
+
+def _installed_drift(name: str, entry: dict, hub_client) -> str | None:
+    from prime_tpu.core.exceptions import APIError
+
+    try:
+        hub = hub_client.get(name)
+    except APIError:
+        return None
+    hub_hash = hub.get("contentHash")
+    if hub_hash and entry.get("contentHash") and hub_hash != entry["contentHash"]:
+        return (
+            f"installed {name}@{entry.get('version', '?')} is stale vs hub "
+            f"{hub.get('latestVersion', '?')} — `prime env install {name}` to update"
+        )
+    return None
+
+
+def _find_module_file(env_dir: Path, name: str) -> Path:
+    module = name.replace("-", "_")
+    candidates = [
+        env_dir / f"{module}.py",
+        env_dir / module / "__init__.py",
+        env_dir / "main.py",
+    ]
+    for candidate in candidates:
+        if candidate.exists():
+            return candidate
+    raise EnvProtocolError(
+        f"No entry module for env {name!r}: expected one of "
+        f"{[str(c.relative_to(env_dir)) for c in candidates]} under {env_dir}"
+    )
+
+
+def load_environment(resolved: ResolvedEnv) -> LoadedEnvironment:
+    """Import the env's module and call its ``load_environment()``."""
+    site = env_site_dir()
+    if site.exists() and str(site) not in sys.path:
+        sys.path.append(str(site))  # pip-installed env deps become importable
+    module_file = _find_module_file(resolved.env_dir, resolved.name)
+    module_name = f"prime_env_{resolved.name.replace('-', '_')}"
+    spec = importlib.util.spec_from_file_location(module_name, module_file)
+    if spec is None or spec.loader is None:
+        raise EnvProtocolError(f"Cannot import {module_file}")
+    module = importlib.util.module_from_spec(spec)
+    # registered so the env's own relative imports/dataclasses resolve
+    sys.modules[module_name] = module
+    try:
+        spec.loader.exec_module(module)
+    except Exception as e:
+        raise EnvProtocolError(f"Importing {module_file} failed: {e}") from e
+    loader = getattr(module, "load_environment", None)
+    if loader is None:
+        raise EnvProtocolError(
+            f"{module_file} defines no load_environment() — not a prime environment"
+        )
+    try:
+        env_obj = loader()
+    except Exception as e:
+        raise EnvProtocolError(f"{resolved.name}.load_environment() raised: {e}") from e
+    return _normalize(env_obj, resolved)
+
+
+def _normalize(env_obj: Any, resolved: ResolvedEnv) -> LoadedEnvironment:
+    def pick(key: str, default=None):
+        if isinstance(env_obj, dict):
+            return env_obj.get(key, default)
+        return getattr(env_obj, key, default)
+
+    examples = pick("examples")
+    if not examples:
+        raise EnvProtocolError(
+            f"{resolved.name}.load_environment() returned no examples "
+            "(need a non-empty 'examples' list of {prompt, answer} records)"
+        )
+    # gsm8k-style records are accepted: 'question' doubles as the prompt
+    examples = [
+        {**e, "prompt": e.get("prompt", e.get("question"))} for e in examples
+    ]
+    bad = next((e for e in examples if e.get("prompt") is None or "answer" not in e), None)
+    if bad is not None:
+        raise EnvProtocolError(
+            f"{resolved.name} example missing prompt/answer keys: {bad!r}"
+        )
+    scorer = pick("score")
+    if scorer is not None and not callable(scorer):
+        raise EnvProtocolError(f"{resolved.name} 'score' must be callable")
+    defaults = {}
+    eval_meta = (resolved.metadata or {}).get("eval", {})
+    for key in ("max_new_tokens", "temperature"):
+        value = pick(key, eval_meta.get(key))
+        if value is not None:
+            defaults[key] = value
+    return LoadedEnvironment(
+        name=pick("name", resolved.name),
+        examples=list(examples),
+        scorer=scorer,
+        defaults=defaults,
+    )

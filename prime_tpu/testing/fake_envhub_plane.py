@@ -49,7 +49,19 @@ class FakeEnvHubPlane:
             plane.environments[name] = env
             plane.archives[(name, version)] = body["archiveB64"]
             plane.version_hashes[(name, version)] = body["contentHash"]
-            plane.actions.setdefault(name, []).append({"action": "push", "version": version})
+            plane.actions.setdefault(name, []).append(
+                {
+                    "id": f"act_{sum(len(a) for a in plane.actions.values()) + 1}",
+                    "action": "push",
+                    "version": version,
+                    "status": "SUCCEEDED",
+                    "logs": [
+                        f"received {name}@{version} archive",
+                        f"content hash {body['contentHash'][:12]} recorded",
+                        "build finished",
+                    ],
+                }
+            )
             return _json_response(200, env)
 
         @route("GET", r"/envhub/environments/(?P<name>[^/]+)/pull")
@@ -108,6 +120,27 @@ class FakeEnvHubPlane:
         def delete_secret(request: httpx.Request, name: str, key: str) -> httpx.Response:
             plane.secrets.get(name, {}).pop(key, None)
             return httpx.Response(204)
+
+        @route("GET", r"/envhub/environments/(?P<name>[^/]+)/actions/(?P<action_id>[^/]+)/logs")
+        def action_logs(request: httpx.Request, name: str, action_id: str) -> httpx.Response:
+            for entry in plane.actions.get(name, []):
+                if entry.get("id") == action_id:
+                    return _json_response(200, {"logs": entry.get("logs", [])})
+            return _json_response(404, {"detail": f"action {action_id} not found"})
+
+        @route("POST", r"/envhub/environments/(?P<name>[^/]+)/actions/(?P<action_id>[^/]+)/retry")
+        def action_retry(request: httpx.Request, name: str, action_id: str) -> httpx.Response:
+            for entry in plane.actions.get(name, []):
+                if entry.get("id") == action_id:
+                    retried = {
+                        **entry,
+                        "id": f"act_{sum(len(a) for a in plane.actions.values()) + 1}",
+                        "status": "SUCCEEDED",
+                        "logs": [f"retry of {action_id}", "build finished"],
+                    }
+                    plane.actions[name].append(retried)
+                    return _json_response(200, retried)
+            return _json_response(404, {"detail": f"action {action_id} not found"})
 
         @route("GET", r"/envhub/environments/(?P<name>[^/]+)/actions")
         def actions(request: httpx.Request, name: str) -> httpx.Response:

@@ -114,7 +114,7 @@ def test_env_secrets_and_versions_cli(runner, fake, env_dir):
 
     result = runner.invoke(cli, ["env", "versions", "my-env", "--plain"])
     assert "0.1.0" in result.output
-    result = runner.invoke(cli, ["env", "actions", "my-env", "--plain"])
+    result = runner.invoke(cli, ["env", "actions", "list", "my-env", "--plain"])
     assert "push" in result.output
 
 
@@ -135,7 +135,7 @@ def test_env_init_cli(runner, tmp_path, monkeypatch):
 def test_install_removes_stale_files(runner, fake, env_dir, tmp_path):
     runner.invoke(cli, ["env", "push", "--dir", str(env_dir)])
     runner.invoke(cli, ["env", "install", "my-env"])
-    from prime_tpu.commands.env import installs_dir
+    from prime_tpu.envhub.local import installs_dir
 
     stale = installs_dir() / "my-env" / "old_task.py"
     assert stale.parent.exists()
@@ -185,3 +185,222 @@ def test_repush_identical_old_version_is_not_conflict(fake):
     assert push("0.2.0", "hashB").status_code == 200
     assert push("0.1.0", "hashA").status_code == 200  # identical re-push ok
     assert push("0.1.0", "hashC").status_code == 409  # changed content conflicts
+
+
+# -- environment execution protocol (reference verifiers_bridge.py:724-1088) --
+
+EXAMPLE_ENV = "examples/verifiers_example"
+
+
+def test_eval_run_executes_hub_env_end_to_end(runner, fake, tmp_path):
+    """North-star protocol: push the example env, then `prime eval run
+    arith-rl` resolves it from the hub, installs it, imports
+    load_environment(), and its dataset drives the (oracle-free) generator."""
+    import pathlib
+
+    push = runner.invoke(cli, ["env", "push", "--dir", EXAMPLE_ENV])
+    assert push.exit_code == 0, push.output
+    out_dir = tmp_path / "outs"
+    result = runner.invoke(
+        cli,
+        [
+            "eval", "run", "arith-rl", "-m", "tiny-test", "--no-push",
+            "-n", "4", "-b", "2", "--output-dir", str(out_dir), "--plain",
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    assert "Resolved env arith-rl (hub@0.1.0, 4 examples)" in result.output.replace("  ", " ") or "Resolved env arith-rl" in result.output
+    run_dirs = list(out_dir.glob("arith-rl--tiny-test/*/results.jsonl"))
+    assert len(run_dirs) == 1
+    lines = [json.loads(l) for l in run_dirs[0].read_text().splitlines() if l.strip()]
+    assert len(lines) == 4
+    # prompts came from the env's data/eval.jsonl, not the synthetic fallback
+    records = [
+        json.loads(l)
+        for l in pathlib.Path(EXAMPLE_ENV, "data", "eval.jsonl").read_text().splitlines()
+        if l.strip()
+    ]
+    assert any(r["question"] in lines[0]["prompt"] for r in records)
+    # second run resolves from the installed store without re-downloading
+    result2 = runner.invoke(
+        cli,
+        ["eval", "run", "arith-rl", "-m", "tiny-test", "--no-push", "-n", "2",
+         "--output-dir", str(out_dir), "--plain"],
+    )
+    assert result2.exit_code == 0, result2.output
+    assert "(installed" in result2.output
+
+
+def test_eval_run_env_defaults_apply(runner, fake, tmp_path):
+    """env.toml [eval] max_new_tokens=128 is used when the flag is defaulted."""
+    runner.invoke(cli, ["env", "push", "--dir", EXAMPLE_ENV])
+    out_dir = tmp_path / "outs"
+    result = runner.invoke(
+        cli,
+        ["eval", "run", "arith-rl", "-m", "tiny-test", "--no-push", "-n", "2",
+         "--output-dir", str(out_dir), "--output", "json"],
+    )
+    assert result.exit_code == 0, result.output
+    meta = json.loads(next(out_dir.glob("arith-rl--tiny-test/*/metadata.json")).read_text())
+    assert meta["spec"]["max_new_tokens"] == 128
+
+
+def test_eval_run_drift_warning_for_stale_install(runner, fake, tmp_path):
+    """Reinstall hint when the hub moves past the installed content hash."""
+    src = tmp_path / "src-env"
+    write_env_template(src, "drift-env")
+    (src / "drift_env.py").write_text(
+        "def load_environment():\n"
+        "    return {'name': 'drift-env', 'examples': [{'prompt': 'p', 'answer': 'a'}]}\n"
+    )
+    assert runner.invoke(cli, ["env", "push", "--dir", str(src)]).exit_code == 0
+    assert runner.invoke(cli, ["env", "install", "drift-env"]).exit_code == 0
+    # hub moves on: bump version + content
+    (src / "NEW.txt").write_text("new content")
+    toml = (src / "env.toml").read_text().replace('version = "0.1.0"', 'version = "0.2.0"')
+    (src / "env.toml").write_text(toml)
+    assert runner.invoke(cli, ["env", "push", "--dir", str(src)]).exit_code == 0
+
+    from prime_tpu.commands.env import build_hub_client
+    from prime_tpu.envhub.execution import resolve_environment
+
+    resolved = resolve_environment("drift-env", hub_client=build_hub_client())
+    assert resolved.source == "installed"
+    assert resolved.drift and "stale" in resolved.drift
+
+
+def test_eval_run_local_dir_drift_warning(runner, fake, tmp_path):
+    """A local env dir that diverged from its hub version warns (local wins)."""
+    src = tmp_path / "local-env"
+    write_env_template(src, "local-env")
+    (src / "local_env.py").write_text(
+        "def load_environment():\n"
+        "    return {'name': 'local-env', 'examples': [{'prompt': 'p', 'answer': 'a'}]}\n"
+    )
+    assert runner.invoke(cli, ["env", "push", "--dir", str(src)]).exit_code == 0
+    (src / "local_change.txt").write_text("diverged")
+
+    from prime_tpu.commands.env import build_hub_client
+    from prime_tpu.envhub.execution import resolve_environment
+
+    resolved = resolve_environment(str(src), hub_client=build_hub_client())
+    assert resolved.source == "local"
+    assert resolved.drift and "LOCAL" in resolved.drift
+
+
+def test_env_custom_scorer_drives_rewards(runner, fake, tmp_path):
+    """An env-provided score() sets sample rewards instead of exact match."""
+    src = tmp_path / "scored-env"
+    write_env_template(src, "scored-env")
+    (src / "scored_env.py").write_text(
+        "def load_environment():\n"
+        "    return {\n"
+        "        'name': 'scored-env',\n"
+        "        'examples': [{'prompt': 'say hi', 'answer': 'hi'}] * 2,\n"
+        "        'score': lambda completion, answer: 0.75,\n"
+        "    }\n"
+    )
+    from prime_tpu.envhub.execution import load_environment, resolve_environment
+    from prime_tpu.evals.datasets import EvalExample
+    from prime_tpu.evals.runner import EvalRunSpec, run_eval
+
+    resolved = resolve_environment(str(src))
+    loaded = load_environment(resolved)
+    examples = [
+        EvalExample(question=e["prompt"], answer=e["answer"], prompt=e["prompt"])
+        for e in loaded.examples
+    ]
+
+    class Oracle:
+        def generate(self, prompts, max_new_tokens, temperature):
+            return ["whatever"] * len(prompts)
+
+    result = run_eval(
+        EvalRunSpec(env="scored-env", model="oracle", limit=2, output_dir=str(tmp_path / "o")),
+        generator=Oracle(),
+        examples=examples,
+        scorer=loaded.scorer,
+    )
+    assert all(s.reward == 0.75 for s in result.samples)
+    assert all(s.correct for s in result.samples)  # 0.75 >= 0.5
+
+
+def test_env_inspect_cli(runner, fake):
+    runner.invoke(cli, ["env", "push", "--dir", EXAMPLE_ENV])
+    result = runner.invoke(cli, ["env", "inspect", EXAMPLE_ENV, "--output", "json"])
+    assert result.exit_code == 0, result.output
+    data = json.loads(result.output)
+    assert data["name"] == "arith-rl"
+    assert data["loadEnvironment"] == "ok"
+    assert data["examples"] == 16
+    assert data["source"] == "local"
+
+
+def test_env_actions_logs_and_retry_cli(runner, fake, env_dir):
+    runner.invoke(cli, ["env", "push", "--dir", str(env_dir)])
+    listed = runner.invoke(cli, ["env", "actions", "list", "my-env", "--output", "json"])
+    actions = json.loads(listed.output)
+    assert actions and actions[0]["action"] == "push"
+    action_id = actions[0]["id"]
+    logs = runner.invoke(cli, ["env", "actions", "logs", "my-env", action_id, "--plain"])
+    assert "build finished" in logs.output
+    retry = runner.invoke(cli, ["env", "actions", "retry", "my-env", action_id, "--plain"])
+    assert retry.exit_code == 0 and "Retried" in retry.output
+    relisted = json.loads(runner.invoke(cli, ["env", "actions", "list", "my-env", "--output", "json"]).output)
+    assert len(relisted) == 2
+
+
+def test_install_pip_installs_into_env_site(runner, fake, env_dir):
+    runner.invoke(cli, ["env", "push", "--dir", str(env_dir)])
+    result = runner.invoke(cli, ["env", "install", "my-env", "--output", "json"])
+    assert result.exit_code == 0, result.output
+    data = json.loads(result.output)
+    from prime_tpu.envhub.execution import env_site_dir
+
+    if data["pipInstalled"]:
+        assert (env_site_dir() / "my_env.py").exists() or list(env_site_dir().glob("my_env*"))
+    else:
+        assert "installNote" in data
+
+
+def test_builtin_labels_never_resolve_as_envs(runner, fake, tmp_path):
+    """A hub env named 'gsm8k' must not shadow the built-in dataset label."""
+    src = tmp_path / "impostor"
+    write_env_template(src, "gsm8k")
+    (src / "gsm8k.py").write_text(
+        "def load_environment():\n"
+        "    return {'name': 'gsm8k', 'examples': [{'prompt': 'x', 'answer': 'y'}]}\n"
+    )
+    runner.invoke(cli, ["env", "push", "--dir", str(src)])
+    out_dir = tmp_path / "outs"
+    result = runner.invoke(
+        cli,
+        ["eval", "run", "gsm8k", "-m", "tiny-test", "--no-push", "-n", "2",
+         "--output-dir", str(out_dir), "--plain"],
+    )
+    assert result.exit_code == 0, result.output
+    assert "Resolved env" not in result.output  # synthetic/builtin path ran
+
+
+def test_explicit_dataset_beats_env_resolution(runner, fake, tmp_path):
+    """--dataset wins: the env's bundled data must not silently replace it."""
+    runner.invoke(cli, ["env", "push", "--dir", EXAMPLE_ENV])
+    custom = tmp_path / "custom.jsonl"
+    custom.write_text('{"question": "7*3?", "answer": "#### 21"}\n' * 3)
+    out_dir = tmp_path / "outs"
+    result = runner.invoke(
+        cli,
+        ["eval", "run", "arith-rl", "-m", "tiny-test", "--no-push", "-n", "3",
+         "--dataset", str(custom), "--output-dir", str(out_dir), "--plain"],
+    )
+    assert result.exit_code == 0, result.output
+    assert "Resolved env" not in result.output
+    lines = next(out_dir.glob("arith-rl--tiny-test/*/results.jsonl")).read_text()
+    assert "7*3?" in lines
+
+
+def test_inspect_uninstalled_hub_env(runner, fake, env_dir):
+    runner.invoke(cli, ["env", "push", "--dir", str(env_dir)])
+    result = runner.invoke(cli, ["env", "inspect", "my-env", "--plain"])
+    assert result.exit_code == 0, result.output
+    assert "hub (not installed)" in result.output
