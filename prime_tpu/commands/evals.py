@@ -320,6 +320,142 @@ def _run_hosted(
         raise SystemExit(1)  # FAILED/CANCELLED must not look like success to scripts
 
 
+@eval_group.command("view")
+@click.argument("target", required=False)
+@click.option("--samples", "sample_count", type=int, default=10, help="Samples to show.")
+@click.option("--output-dir", default="outputs/evals")
+@output_options
+def view_cmd(render: Renderer, target: str | None, sample_count: int, output_dir: str) -> None:
+    """View one eval run: a local run dir (default: newest), a hub eval id
+    (eval_...), or a hosted run id (heval_...). Reference evals.py:1149."""
+    import json as _json
+
+    # exact id prefixes only — a path like evals/arith--m/run1 must not be
+    # mistaken for a hub id, and an id must not fall through to the dir path
+    if target and target.startswith("heval_") and not Path(target).exists():
+        client = build_evals_client()
+        run = client.get_hosted(target)
+        logs = client.hosted_logs(target)
+        if render.is_json:
+            render.json({**run, "logs": logs})
+            return
+        render.detail(
+            {k: v for k, v in run.items() if k != "logs"}, title=f"Hosted eval {shorten(target)}"
+        )
+        for line in logs[-sample_count:]:
+            render.message(f"  {line}")
+        return
+
+    if target and target.startswith("eval_") and not Path(target).exists():
+        client = build_evals_client()
+        evaluation = client.get_evaluation(target)
+        samples = client.get_samples(target, limit=sample_count)
+        if render.is_json:
+            render.json(
+                {
+                    "evaluation": evaluation.model_dump(by_alias=True),
+                    "samples": [s.model_dump(by_alias=True) for s in samples],
+                }
+            )
+            return
+        render.detail(evaluation.model_dump(by_alias=True), title=f"Evaluation {shorten(target)}")
+        _render_sample_table(render, [s.model_dump() for s in samples], sample_count)
+        return
+
+    from prime_tpu.evals.runner import find_latest_run
+
+    if target:
+        run_dir = Path(target)
+        if not run_dir.is_dir():
+            # never silently fall back to a different run than the one named
+            raise click.ClickException(
+                f"{target!r} is not a run directory, a hub eval id (eval_...), "
+                "or a hosted run id (heval_...)"
+            )
+    else:
+        try:
+            run_dir = find_latest_run(output_dir)
+        except FileNotFoundError as e:
+            raise click.ClickException(str(e)) from None
+    metadata_path = run_dir / "metadata.json"
+    if not metadata_path.exists():
+        raise click.ClickException(f"{run_dir} has no metadata.json — not an eval run dir")
+    metadata = _json.loads(metadata_path.read_text())
+    results_path = run_dir / "results.jsonl"
+    if not results_path.exists():
+        raise click.ClickException(f"{run_dir} has no results.jsonl (run interrupted?)")
+    rows = []
+    with open(results_path) as f:
+        for line in f:
+            if line.strip():
+                rows.append(_json.loads(line))
+    correct = sum(1 for r in rows if r.get("correct"))
+    if render.is_json:
+        render.json({"runDir": str(run_dir), "metadata": metadata, "samples": rows[:sample_count]})
+        return
+    render.detail(
+        {
+            "runDir": str(run_dir),
+            "env": metadata.get("env"),
+            "model": metadata.get("model"),
+            "samples": f"{correct}/{len(rows)} correct",
+            **{f"metric.{k}": v for k, v in metadata.get("metrics", {}).items()},
+        },
+        title=f"Eval run {run_dir.name}",
+    )
+    _render_sample_table(render, rows, sample_count)
+
+
+def _render_sample_table(render: Renderer, rows: list[dict], sample_count: int) -> None:
+    render.table(
+        ["ID", "OK", "ANSWER", "COMPLETION"],
+        [
+            [
+                r.get("sample_id", r.get("sampleId", "")),
+                "Y" if r.get("correct") else "n",
+                str(r.get("answer", ""))[:20],
+                str(r.get("completion", "")).replace("\n", " ")[:60],
+            ]
+            for r in rows[:sample_count]
+        ],
+        title="Samples",
+        json_rows=None,
+    )
+
+
+@eval_group.command("logs")
+@click.argument("hosted_id")
+@click.option("--follow", "-f", is_flag=True, help="Poll until the run is terminal.")
+@output_options
+def logs_cmd(render: Renderer, hosted_id: str, follow: bool) -> None:
+    """Stream a hosted eval's logs (reference evals.py:1357)."""
+    import time
+
+    from prime_tpu.utils.hosted_eval import EvalStatus
+
+    client = build_evals_client()
+    seen = 0
+    while True:
+        lines = client.hosted_logs(hosted_id)
+        if not render.is_json:
+            for line in lines[seen:]:
+                render.message(line)
+        seen = len(lines)
+        if not follow:
+            if render.is_json:
+                render.json({"logs": lines})
+            return
+        run = client.get_hosted(hosted_id)
+        if run["status"] in EvalStatus.TERMINAL:
+            # JSON follow mode: one final document with the full log + status
+            if render.is_json:
+                render.json({"logs": lines, "status": run["status"]})
+            else:
+                render.message(f"[{run['status']}]")
+            return
+        time.sleep(POLL_INTERVAL_S)
+
+
 @eval_group.command("stop")
 @click.argument("hosted_id")
 @output_options

@@ -486,3 +486,69 @@ def test_tunnel_timeout_cleans_registration(fake, tmp_path):
         tunnel.start(timeout_s=0.5)
     assert fake.misc_plane.tunnels == {}
     assert tunnel.process.poll() is not None  # frpc reaped
+
+
+# -- eval view / logs (reference evals.py:1149,1357) --------------------------
+
+
+def _make_local_run(tmp_path):
+    run_dir = tmp_path / "outs" / "arith--tiny-test" / "20260101-000000-abcd1234"
+    run_dir.mkdir(parents=True)
+    (run_dir / "metadata.json").write_text(json.dumps({
+        "env": "arith", "model": "tiny-test",
+        "metrics": {"accuracy": 0.5, "samples_per_sec": 2.0},
+    }))
+    (run_dir / "results.jsonl").write_text(
+        json.dumps({"sample_id": "s_0", "correct": True, "answer": "4", "completion": "4"}) + "\n"
+        + json.dumps({"sample_id": "s_1", "correct": False, "answer": "9", "completion": "7"}) + "\n"
+    )
+    return run_dir
+
+
+def test_eval_view_local_run(runner, fake, tmp_path):
+    run_dir = _make_local_run(tmp_path)
+    result = runner.invoke(
+        cli, ["eval", "view", "--output-dir", str(tmp_path / "outs"), "--plain"]
+    )
+    assert result.exit_code == 0, result.output
+    assert "1/2 correct" in result.output and "s_1" in result.output
+
+    result = runner.invoke(cli, ["eval", "view", str(run_dir), "--output", "json"])
+    data = json.loads(result.output)
+    assert data["metadata"]["metrics"]["accuracy"] == 0.5
+    assert len(data["samples"]) == 2
+
+
+def test_eval_view_hub_eval(runner, fake, tmp_path):
+    run_dir = _make_local_run(tmp_path)
+    pushed = runner.invoke(
+        cli, ["eval", "push", "--run-dir", str(run_dir), "--output", "json"]
+    )
+    eval_id = json.loads(pushed.output)["evalId"]
+    result = runner.invoke(cli, ["eval", "view", eval_id, "--plain"])
+    assert result.exit_code == 0, result.output
+    assert "s_0" in result.output
+
+    as_json = json.loads(runner.invoke(cli, ["eval", "view", eval_id, "--output", "json"]).output)
+    assert as_json["evaluation"]["status"] == "FINALIZED"
+
+
+def test_eval_view_and_logs_hosted(runner, fake, monkeypatch):
+    import prime_tpu.commands.evals as ev_cmd
+
+    monkeypatch.setattr(ev_cmd, "POLL_INTERVAL_S", 0)
+    runner.invoke(cli, ["eval", "run", "gsm8k", "-m", "llama3-8b", "--hosted", "--plain"])
+    import httpx
+
+    listing = fake.evals_plane.hosted
+    hid = next(iter(listing))
+    result = runner.invoke(cli, ["eval", "view", hid, "--plain"])
+    assert result.exit_code == 0, result.output
+    assert "COMPLETED" in result.output
+
+    logs = runner.invoke(cli, ["eval", "logs", hid, "--plain"])
+    assert logs.exit_code == 0
+    assert logs.output.strip()
+
+    follow = runner.invoke(cli, ["eval", "logs", hid, "-f", "--plain"])
+    assert "[COMPLETED]" in follow.output
