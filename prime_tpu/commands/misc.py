@@ -82,9 +82,26 @@ def feedback(render: Renderer, message: str | None) -> None:
     render.message("Thanks — feedback submitted.")
 
 
-@click.group(name="lab")
-def lab_group() -> None:
-    """Lab workspace: setup, doctor, sync, and the snapshot dashboard."""
+@click.group(name="lab", invoke_without_command=True)
+@click.pass_context
+def lab_group(ctx: click.Context) -> None:
+    """Lab workspace: bare `prime lab` opens the interactive shell;
+    subcommands cover setup, doctor, sync, and the one-shot dashboard."""
+    if ctx.invoked_subcommand is None:
+        ctx.invoke(lab_tui)
+
+
+@lab_group.command("tui")
+@click.option("--dir", "workspace", default=".", type=click.Path())
+def lab_tui(workspace: str = ".") -> None:
+    """Interactive three-pane Lab shell (nav / selector / inspector)."""
+    from prime_tpu.lab.tui import PrimeLabApp, run_interactive
+
+    app = PrimeLabApp(workspace=workspace, api_client=deps.build_client())
+    try:
+        run_interactive(app)
+    except RuntimeError as e:
+        raise click.ClickException(str(e)) from None
 
 
 @lab_group.command("setup")

@@ -1,0 +1,18 @@
+"""Interactive Lab TUI (reference: prime_lab_app/, 40 modules).
+
+The reference builds on the Textual framework; that is not a declarable
+dependency here, so the shell is a small self-contained TUI stack on rich:
+``driver`` owns the terminal (raw-mode keys + rich.Live), ``app`` is the
+three-pane shell (nav / selector / inspector, reference
+docs/lab-tui-design.md:38-44) over the local-first LabDataSource, and
+``launch`` runs config cards (reference launch_runner.py).
+
+Everything renders headlessly for tests: the app is a pure
+state-machine (on_key) + renderable (render), and the driver is the only
+tty-touching component.
+"""
+
+from prime_tpu.lab.tui.app import PrimeLabApp
+from prime_tpu.lab.tui.driver import render_text, run_interactive
+
+__all__ = ["PrimeLabApp", "render_text", "run_interactive"]

@@ -1,0 +1,293 @@
+"""The Lab shell: three panes (nav / selector / inspector) over LabDataSource.
+
+Reference: prime_lab_app/app.py:179 ``PrimeLabView`` and
+docs/lab-tui-design.md:38-44 (three-pane layout, section routing,
+local-first data with background hydration). This implementation is a pure
+state machine — ``on_key`` mutates state, ``render`` produces a rich
+renderable — so the whole shell is testable without a terminal.
+
+Key bindings: ↑/↓ or j/k move · tab/←/→ switch pane · 1-9 jump section ·
+enter select (launch section: arm, then launch) · r refresh section ·
+R refresh all · g/G top/bottom · q quit.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+from prime_tpu.lab.data import LabDataSource, LabSnapshot
+from prime_tpu.lab.tui.launch import LaunchError, launch_card, scan_cards
+
+# section key -> (title, [(column header, row dict key)...])
+SECTION_SPECS: dict[str, tuple[str, list[tuple[str, str]]]] = {
+    "local-runs": (
+        "Local eval runs",
+        [("ENV", "env"), ("MODEL", "model"), ("RUN", "runId"), ("ACC", "accuracy")],
+    ),
+    "evals": (
+        "Evals Hub",
+        [("ID", "evalId"), ("MODEL", "model"), ("STATUS", "status"), ("SAMPLES", "sampleCount")],
+    ),
+    "training": (
+        "Training runs",
+        [("ID", "runId"), ("NAME", "name"), ("STATUS", "status"), ("MODEL", "model")],
+    ),
+    "environments": (
+        "Environments",
+        [("NAME", "name"), ("LATEST", "latestVersion"), ("VISIBILITY", "visibility")],
+    ),
+    "pods": (
+        "Pods",
+        [("ID", "podId"), ("NAME", "name"), ("STATUS", "status"), ("TPU", "tpuType")],
+    ),
+    "sandboxes": (
+        "Sandboxes",
+        [("ID", "sandboxId"), ("STATUS", "status"), ("IMAGE", "dockerImage")],
+    ),
+    "launch": (
+        "Launch cards",
+        [("NAME", "name"), ("KIND", "kind"), ("FILE", "file")],
+    ),
+}
+SECTIONS = tuple(SECTION_SPECS)
+PLATFORM_KEYS = ("evals", "training", "environments", "pods", "sandboxes")
+
+
+class PrimeLabApp:
+    def __init__(
+        self,
+        data_source: LabDataSource | None = None,
+        workspace: str | Path = ".",
+        api_client=None,
+    ) -> None:
+        self.workspace = Path(workspace)
+        self.data = data_source or LabDataSource(workspace, api_client=api_client)
+        self._api = api_client
+        self.snapshot: LabSnapshot = self.data.snapshot()
+        self.section_idx = 0
+        self.cursors: dict[str, int] = {key: 0 for key in SECTIONS}
+        self.focus = "nav"  # nav | rows
+        self.status = "r: refresh section · R: refresh all · q: quit"
+        self.quit = False
+        self._armed_launch: Path | None = None
+        # launch cards are rescanned at most once per input event: render()
+        # reads rows() several times per frame and must not re-glob each time
+        self._launch_rows: list[dict[str, Any]] | None = None
+
+    # -- state accessors -----------------------------------------------------
+
+    @property
+    def section(self) -> str:
+        return SECTIONS[self.section_idx]
+
+    def rows(self, section: str | None = None) -> list[dict[str, Any]]:
+        section = section or self.section
+        if section == "local-runs":
+            return self.snapshot.local_eval_runs
+        if section == "launch":
+            if self._launch_rows is None:
+                self._launch_rows = [
+                    {"name": c.name, "kind": c.kind, "file": c.path.name, "path": str(c.path),
+                     "payload": c.payload}
+                    for c in scan_cards(self.workspace)
+                ]
+            return self._launch_rows
+        return self.snapshot.platform.get(section, [])
+
+    def selected_row(self) -> dict[str, Any] | None:
+        rows = self.rows()
+        if not rows:
+            return None
+        cursor = min(self.cursors[self.section], len(rows) - 1)
+        return rows[cursor]
+
+    # -- key handling ---------------------------------------------------------
+
+    def on_key(self, key: str) -> None:
+        self._launch_rows = None  # fresh scan per input event
+        if key in ("q", "escape"):
+            if self._armed_launch:
+                self._armed_launch = None
+                self.status = "launch disarmed"
+            else:
+                self.quit = True
+        elif key in ("tab", "right", "left"):
+            self.focus = "rows" if self.focus == "nav" else "nav"
+        elif key in ("down", "j"):
+            self._move(+1)
+        elif key in ("up", "k"):
+            self._move(-1)
+        elif key == "g":
+            self._jump(0)
+        elif key == "G":
+            self._jump(-1)
+        elif key.isdigit() and key != "0" and int(key) <= len(SECTIONS):
+            self.section_idx = int(key) - 1
+            self.focus = "rows"
+        elif key == "r":
+            self.refresh_current()
+        elif key == "R":
+            self.refresh_all()
+        elif key == "enter":
+            self._on_enter()
+
+    def tick(self) -> None:
+        """Idle callback from the driver: rescan local state only (cheap)."""
+        self._launch_rows = None
+        local = self.data.snapshot()
+        self.snapshot.local_eval_runs = local.local_eval_runs
+        self.snapshot.installed_envs = local.installed_envs
+
+    def _move(self, delta: int) -> None:
+        self._armed_launch = None
+        if self.focus == "nav":
+            self.section_idx = (self.section_idx + delta) % len(SECTIONS)
+        else:
+            rows = self.rows()
+            if rows:
+                cursor = self.cursors[self.section] + delta
+                self.cursors[self.section] = max(0, min(cursor, len(rows) - 1))
+
+    def _jump(self, where: int) -> None:
+        rows = self.rows()
+        if rows:
+            self.cursors[self.section] = 0 if where == 0 else len(rows) - 1
+
+    def _on_enter(self) -> None:
+        if self.focus == "nav":
+            self.focus = "rows"
+            return
+        if self.section != "launch":
+            return
+        row = self.selected_row()
+        if row is None:
+            return
+        card_path = Path(row["path"])
+        if self._armed_launch != card_path:
+            self._armed_launch = card_path
+            self.status = f"press enter again to launch {row['name']} ({row['kind']})"
+            return
+        self._armed_launch = None
+        self.status = self._do_launch(row)
+
+    def _do_launch(self, row: dict[str, Any]) -> str:
+        cards = {str(c.path): c for c in scan_cards(self.workspace)}
+        card = cards.get(row["path"])
+        if card is None:
+            return f"card {row['file']} disappeared"
+        api = self._api
+        if api is None:
+            import prime_tpu.commands._deps as deps
+
+            api = self._api = deps.build_client()
+        try:
+            result = launch_card(card, api)
+        except LaunchError as e:
+            return f"launch failed: {e}"
+        except Exception as e:
+            return f"launch failed: {e}"
+        return f"launched {result['kind']} {result['id']} ({result['status']})"
+
+    # -- refresh --------------------------------------------------------------
+
+    def refresh_current(self) -> None:
+        if self.section in PLATFORM_KEYS:
+            self.snapshot = self.data.refresh((self.section,))
+            self._report_refresh()
+        else:
+            self.tick()
+            self.status = f"rescanned {self.section}"
+
+    def refresh_all(self) -> None:
+        self.snapshot = self.data.refresh()
+        self._report_refresh()
+
+    def _report_refresh(self) -> None:
+        if self.snapshot.errors:
+            broken = ", ".join(f"{k}: {v}" for k, v in self.snapshot.errors.items())
+            self.status = f"refresh errors — {broken}"[:160]
+        else:
+            self.status = "refreshed"
+
+    # -- rendering ------------------------------------------------------------
+
+    def render(self):
+        from rich.console import Group
+        from rich.layout import Layout
+        from rich.panel import Panel
+        from rich.table import Table
+        from rich.text import Text
+
+        layout = Layout()
+        layout.split_column(
+            Layout(name="header", size=1),
+            Layout(name="body"),
+            Layout(name="footer", size=1),
+        )
+        layout["body"].split_row(
+            Layout(name="nav", size=24),
+            Layout(name="rows", ratio=2),
+            Layout(name="inspector", ratio=1),
+        )
+
+        layout["header"].update(
+            Text(f" PRIME LAB · {self.workspace.resolve().name}", style="bold")
+        )
+
+        nav = Table.grid(padding=(0, 1))
+        for index, key in enumerate(SECTIONS):
+            title = SECTION_SPECS[key][0]
+            count = len(self.rows(key))
+            marker = "▸" if index == self.section_idx else " "
+            style = "reverse" if index == self.section_idx and self.focus == "nav" else (
+                "bold" if index == self.section_idx else ""
+            )
+            stale = ""
+            if key in PLATFORM_KEYS and not self.snapshot.freshness.get(key, False):
+                stale = "*"
+            nav.add_row(Text(f"{marker}{index + 1} {title} ({count}){stale}", style=style))
+        layout["nav"].update(Panel(nav, title="sections", border_style="dim"))
+
+        title, columns = SECTION_SPECS[self.section]
+        table = Table(expand=True, pad_edge=False)
+        for header, _ in columns:
+            table.add_column(header, overflow="ellipsis", no_wrap=True)
+        rows = self.rows()
+        cursor = min(self.cursors[self.section], max(len(rows) - 1, 0))
+        for index, row in enumerate(rows):
+            style = "reverse" if index == cursor and self.focus == "rows" else ""
+            table.add_row(
+                *[_cell(row.get(key)) for _, key in columns],
+                style=style,
+            )
+        if not rows:
+            empty = Text("(empty)", style="dim")
+            layout["rows"].update(Panel(empty, title=title, border_style="dim"))
+        else:
+            layout["rows"].update(Panel(table, title=title, border_style="dim"))
+
+        detail = Table.grid(padding=(0, 1))
+        selected = self.selected_row()
+        if selected:
+            for key, value in selected.items():
+                if key == "payload":
+                    continue
+                detail.add_row(Text(str(key), style="dim"), _cell(value))
+        layout["inspector"].update(
+            Panel(detail if selected else Text("(nothing selected)", style="dim"),
+                  title="inspector", border_style="dim")
+        )
+
+        layout["footer"].update(Text(f" {self.status}", style="dim"))
+        return layout
+
+
+def _cell(value: Any):
+    from rich.text import Text
+
+    if value is None:
+        return Text("—", style="dim")
+    if isinstance(value, float):
+        return Text(f"{value:.3f}")
+    return Text(str(value))

@@ -1,0 +1,67 @@
+"""Terminal driver for the Lab TUI: raw-mode keys + rich.Live rendering.
+
+``run_interactive`` owns the tty; ``render_text`` renders any app frame to a
+plain string (tests and snapshots drive the app exclusively through it).
+"""
+
+from __future__ import annotations
+
+import select
+import sys
+from typing import Any, Protocol
+
+
+class TuiApp(Protocol):
+    quit: bool
+
+    def render(self) -> Any: ...
+    def on_key(self, key: str) -> None: ...
+    def tick(self) -> None: ...
+
+
+def render_text(app: TuiApp, width: int = 120, height: int = 40) -> str:
+    """Render one frame to plain text (headless — no tty required)."""
+    from rich.console import Console
+
+    console = Console(width=width, height=height, force_terminal=False)
+    with console.capture() as capture:
+        console.print(app.render())
+    return capture.get()
+
+
+def run_interactive(app: TuiApp, tick_interval_s: float = 2.0) -> None:
+    """Run the app against the real terminal until it quits."""
+    import termios
+    import tty
+
+    from rich.console import Console
+    from rich.live import Live
+
+    from prime_tpu.lab.tui.keys import decode_keys
+
+    if not sys.stdin.isatty():
+        raise RuntimeError("prime lab needs an interactive terminal (try `prime lab view`)")
+
+    stdin_fd = sys.stdin.fileno()
+    saved_attrs = termios.tcgetattr(stdin_fd)
+    console = Console()
+    try:
+        tty.setcbreak(stdin_fd)
+        with Live(app.render(), console=console, screen=True, auto_refresh=False) as live:
+            while not app.quit:
+                ready, _, _ = select.select([stdin_fd], [], [], tick_interval_s)
+                if ready:
+                    import os
+
+                    data = os.read(stdin_fd, 64)
+                    for key in decode_keys(data):
+                        if key == "ctrl+c":
+                            return
+                        app.on_key(key)
+                        if app.quit:
+                            break
+                else:
+                    app.tick()
+                live.update(app.render(), refresh=True)
+    finally:
+        termios.tcsetattr(stdin_fd, termios.TCSADRAIN, saved_attrs)

@@ -1,0 +1,76 @@
+"""Terminal key decoding: raw stdin bytes -> symbolic key names.
+
+Covers the keys the Lab shell binds (arrows, enter, tab, escape, printable
+ASCII). Unrecognized escape sequences decode to None and are ignored.
+"""
+
+from __future__ import annotations
+
+ESCAPE_SEQUENCES = {
+    b"[A": "up",
+    b"[B": "down",
+    b"[C": "right",
+    b"[D": "left",
+    b"[H": "home",
+    b"[F": "end",
+    b"[5~": "pageup",
+    b"[6~": "pagedown",
+    b"[3~": "delete",
+}
+
+
+def decode_key(data: bytes) -> str | None:
+    """Decode one key's worth of bytes (as read after a select() wakeup)."""
+    keys = decode_keys(data)
+    return keys[0] if keys else None
+
+
+def decode_keys(data: bytes) -> list[str]:
+    """Decode a buffer that may hold several coalesced keypresses (key
+    auto-repeat batches reads: b'jjj', b'\\x1b[A\\x1b[A')."""
+    keys: list[str] = []
+    index = 0
+    while index < len(data):
+        byte = data[index : index + 1]
+        if byte == b"\x1b":
+            # longest escape sequence first
+            matched = False
+            for length in (3, 2):
+                payload = data[index + 1 : index + 1 + length]
+                if payload in ESCAPE_SEQUENCES:
+                    keys.append(ESCAPE_SEQUENCES[payload])
+                    index += 1 + length
+                    matched = True
+                    break
+            if matched:
+                continue
+            if data[index + 1 : index + 2] == b"[":
+                # unrecognized CSI sequence: swallow through its terminator
+                # (an alphabetic final byte or '~') so its chars aren't typed
+                index += 2
+                while index < len(data):
+                    final = data[index : index + 1]
+                    index += 1
+                    if final.isalpha() or final == b"~":
+                        break
+            else:
+                keys.append("escape")
+                index += 1
+            continue
+        if byte in (b"\r", b"\n"):
+            keys.append("enter")
+        elif byte == b"\t":
+            keys.append("tab")
+        elif byte in (b"\x7f", b"\x08"):
+            keys.append("backspace")
+        elif byte == b"\x03":
+            keys.append("ctrl+c")
+        else:
+            try:
+                text = byte.decode()
+            except UnicodeDecodeError:
+                text = ""
+            if text.isprintable():
+                keys.append(text)
+        index += 1
+    return keys

@@ -1,0 +1,248 @@
+"""Lab TUI shell: key handling, three-pane rendering, refresh, launch cards."""
+
+import json
+
+import pytest
+
+from prime_tpu.core.client import APIClient
+from prime_tpu.core.config import Config
+from prime_tpu.lab.data import LabDataSource
+from prime_tpu.lab.tui import PrimeLabApp, render_text
+from prime_tpu.lab.tui.app import SECTIONS
+from prime_tpu.lab.tui.keys import decode_key
+from prime_tpu.testing import FakeControlPlane
+
+
+@pytest.fixture
+def fake():
+    return FakeControlPlane()
+
+
+@pytest.fixture
+def api(fake):
+    cfg = Config()
+    cfg.api_key = "test-key"
+    return APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+
+
+@pytest.fixture
+def app(fake, api, tmp_path):
+    source = LabDataSource(tmp_path, api_client=api)
+    return PrimeLabApp(data_source=source, workspace=tmp_path, api_client=api)
+
+
+def _local_run(tmp_path, env="gsm8k", model="m1", run="r1", accuracy=0.5):
+    run_dir = tmp_path / "outputs" / "evals" / f"{env}--{model}" / run
+    run_dir.mkdir(parents=True)
+    (run_dir / "metadata.json").write_text(
+        json.dumps({"metrics": {"accuracy": accuracy, "num_samples": 4}})
+    )
+
+
+# -- key decoding -------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "data,key",
+    [
+        (b"\r", "enter"),
+        (b"\t", "tab"),
+        (b"q", "q"),
+        (b"\x1b[A", "up"),
+        (b"\x1b[B", "down"),
+        (b"\x1b", "escape"),
+        (b"\x03", "ctrl+c"),
+        (b"\x1b[Z", None),  # unbound sequence ignored
+        (b"\x00", None),
+    ],
+)
+def test_decode_key(data, key):
+    assert decode_key(data) == key
+
+
+# -- navigation ---------------------------------------------------------------
+
+
+def test_nav_cycles_sections_and_digit_jump(app):
+    assert app.section == SECTIONS[0]
+    app.on_key("down")
+    assert app.section == SECTIONS[1]
+    app.on_key("up")
+    app.on_key("up")
+    assert app.section == SECTIONS[-1]  # wraps
+    app.on_key("3")
+    assert app.section == SECTIONS[2] and app.focus == "rows"
+
+
+def test_cursor_clamps_to_rows(app, tmp_path):
+    _local_run(tmp_path, run="r1")
+    _local_run(tmp_path, run="r2")
+    app.tick()
+    app.focus = "rows"
+    app.on_key("down")
+    app.on_key("down")
+    app.on_key("down")
+    assert app.cursors["local-runs"] == 1  # clamped to 2 rows
+    app.on_key("g")
+    assert app.cursors["local-runs"] == 0
+    app.on_key("G")
+    assert app.cursors["local-runs"] == 1
+
+
+def test_quit_key(app):
+    app.on_key("q")
+    assert app.quit
+
+
+# -- rendering ----------------------------------------------------------------
+
+
+def test_render_three_panes_headless(app, tmp_path):
+    _local_run(tmp_path, env="arith", model="tiny", run="r9", accuracy=1.0)
+    app.tick()
+    text = render_text(app)
+    assert "PRIME LAB" in text
+    assert "sections" in text and "inspector" in text
+    assert "Local eval runs" in text
+    assert "arith" in text and "r9" in text
+    # nav shows counts for every section
+    assert "Launch cards (0)" in text
+
+
+def test_render_empty_section(app):
+    app.on_key("6")  # sandboxes (no fetch yet)
+    text = render_text(app)
+    assert "(empty)" in text
+
+
+def test_inspector_shows_selected_row(app, tmp_path):
+    _local_run(tmp_path, env="arith", model="tiny", run="zzz", accuracy=0.25)
+    app.tick()
+    app.focus = "rows"
+    text = render_text(app)
+    assert "zzz" in text
+    assert "0.250" in text  # float formatting in inspector/table
+
+
+# -- refresh ------------------------------------------------------------------
+
+
+def test_refresh_all_hydrates_platform_sections(app, fake, api):
+    # create a sandbox through the SDK so the platform has a row
+    from prime_tpu.sandboxes import SandboxClient
+    from prime_tpu.sandboxes.models import CreateSandboxRequest
+
+    SandboxClient(client=api).create(CreateSandboxRequest())
+    app.on_key("R")
+    assert app.status == "refreshed"
+    rows = app.snapshot.platform["sandboxes"]
+    assert len(rows) == 1
+    app.on_key("6")
+    text = render_text(app)
+    assert rows[0]["sandboxId"][:12] in text
+
+
+def test_refresh_errors_reported_in_status(app, monkeypatch):
+    def boom():
+        raise RuntimeError("plane down")
+
+    monkeypatch.setattr(app.data, "_fetch_pods", boom)
+    app.on_key("5")  # pods
+    app.on_key("r")
+    assert "pods: plane down" in app.status
+
+
+# -- launch cards -------------------------------------------------------------
+
+
+def _write_card(tmp_path, name="card1", kind="eval"):
+    launch = tmp_path / ".prime-lab" / "launch"
+    launch.mkdir(parents=True, exist_ok=True)
+    (launch / f"{name}.toml").write_text(
+        f'[launch]\nkind = "{kind}"\nname = "{name}"\n\n'
+        f"[{kind}]\n"
+        + ('env = "arith"\nmodel = "tiny-test"\n' if kind == "eval" else 'model = "llama3-8b"\nenvId = "env_x"\n')
+    )
+
+
+def test_launch_section_lists_cards(app, tmp_path):
+    _write_card(tmp_path, "nightly", "eval")
+    app.on_key("7")  # launch section
+    text = render_text(app)
+    assert "nightly" in text and "eval" in text
+
+
+def test_launch_requires_arm_then_submits(app, tmp_path, fake):
+    _write_card(tmp_path, "nightly", "eval")
+    app.on_key("7")
+    app.focus = "rows"
+    app.on_key("enter")
+    assert "press enter again" in app.status
+    assert not fake.evals_plane.hosted
+    app.on_key("enter")
+    assert "launched eval heval_" in app.status
+    assert len(fake.evals_plane.hosted) == 1
+
+
+def test_launch_disarms_on_move_or_escape(app, tmp_path, fake):
+    _write_card(tmp_path, "a-card", "eval")
+    _write_card(tmp_path, "b-card", "eval")
+    app.on_key("7")
+    app.focus = "rows"
+    app.on_key("enter")
+    app.on_key("down")  # moving disarms
+    app.on_key("enter")
+    assert "press enter again" in app.status
+    app.on_key("escape")
+    assert "disarmed" in app.status
+    assert not fake.evals_plane.hosted
+
+
+def test_malformed_card_ignored(app, tmp_path):
+    launch = tmp_path / ".prime-lab" / "launch"
+    launch.mkdir(parents=True)
+    (launch / "broken.toml").write_text("not [ valid toml")
+    (launch / "wrongkind.toml").write_text('[launch]\nkind = "dance"\n')
+    app.on_key("7")
+    assert app.rows() == []
+
+
+# -- CLI entry ----------------------------------------------------------------
+
+
+def test_lab_tui_requires_tty(fake, monkeypatch):
+    from click.testing import CliRunner
+
+    import prime_tpu.commands._deps as deps
+    from prime_tpu.commands.main import cli
+
+    monkeypatch.setattr(deps, "transport_override", fake.transport)
+    monkeypatch.setenv("PRIME_API_KEY", "test-key")
+    monkeypatch.setenv("PRIME_BASE_URL", "https://api.fake")
+    result = CliRunner().invoke(cli, ["lab", "tui"])
+    assert result.exit_code != 0
+    assert "interactive terminal" in result.output
+
+
+def test_decode_keys_batched():
+    from prime_tpu.lab.tui.keys import decode_keys
+
+    assert decode_keys(b"jjj") == ["j", "j", "j"]
+    assert decode_keys(b"\x1b[A\x1b[A") == ["up", "up"]
+    assert decode_keys(b"j\x1b[Bq") == ["j", "down", "q"]
+    assert decode_keys(b"\x1b[Zjq") == ["j", "q"]  # unknown CSI swallowed
+    assert decode_keys(b"\x1bq") == ["escape", "q"]
+
+
+def test_view_explicit_bad_target_errors(fake, monkeypatch, tmp_path):
+    from click.testing import CliRunner
+
+    import prime_tpu.commands._deps as deps
+    from prime_tpu.commands.main import cli
+
+    monkeypatch.setattr(deps, "transport_override", fake.transport)
+    monkeypatch.setenv("PRIME_API_KEY", "test-key")
+    monkeypatch.setenv("PRIME_BASE_URL", "https://api.fake")
+    result = CliRunner().invoke(cli, ["eval", "view", str(tmp_path / "nope-typo")])
+    assert result.exit_code != 0
+    assert "not a run directory" in result.output
