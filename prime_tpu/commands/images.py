@@ -1,16 +1,35 @@
-"""`prime images` + `prime registry` — sandbox image builds and registry access
-(reference: commands/images.py:379-1604, registry.py)."""
+"""`prime images` + `prime registry` — sandbox image builds and registry access.
+
+Reference surface: commands/images.py:379-1604 (push/build-vm/list/publish/
+unpublish/visibility + artifact partition rendering), images_bulk.py
+(manifest-driven concurrent builds with retry), images_transfer_bulk.py,
+images_update_bulk.py, images_hf.py, registry.py. The HF flow is redesigned
+TPU-first: instead of dataset-driven bulk pushes, ``images hf-cache`` bakes
+HF checkpoint caches into an image partition so sandboxes cold-start with
+model weights local to the TPU VM.
+"""
 
 from __future__ import annotations
 
-import base64
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 
 import click
 
 from prime_tpu.commands._deps import build_client
+from prime_tpu.core.exceptions import APIError, RateLimitError
+from prime_tpu.sandboxes.images import ImageClient
 from prime_tpu.utils.render import Renderer, output_options
 from prime_tpu.utils.short_id import shorten
+
+BULK_WORKERS = 4
+BULK_RETRIES = 3
+
+
+def _image_client() -> ImageClient:
+    return ImageClient(build_client())
 
 
 @click.group(name="images")
@@ -21,13 +40,45 @@ def images_group() -> None:
 @images_group.command("list")
 @output_options
 def list_cmd(render: Renderer) -> None:
-    data = build_client().get("/images")
-    items = data.get("items", []) if isinstance(data, dict) else data
+    items = _image_client().list()
     render.table(
-        ["ID", "NAME", "STATUS", "VISIBILITY"],
-        [[shorten(i["imageId"]), i.get("name", ""), i.get("status", ""), i.get("visibility", "")] for i in items],
+        ["ID", "NAME", "KIND", "STATUS", "VISIBILITY", "SIZE_MB"],
+        [
+            [
+                shorten(i["imageId"]),
+                i.get("name", ""),
+                i.get("kind", "container"),
+                i.get("status", ""),
+                i.get("visibility", ""),
+                sum(a.get("sizeMb", 0) for a in i.get("artifacts", [])),
+            ]
+            for i in items
+        ],
         title="Images",
         json_rows=items,
+    )
+
+
+@images_group.command("get")
+@click.argument("image_id")
+@output_options
+def get_cmd(render: Renderer, image_id: str) -> None:
+    """Show one image including its artifact partitions."""
+    image = _image_client().get(image_id)
+    if render.is_json:
+        render.json(image)
+        return
+    render.detail(
+        {k: v for k, v in image.items() if k != "artifacts"}, title=f"Image {shorten(image_id)}"
+    )
+    render.table(
+        ["PARTITION", "TYPE", "SIZE_MB", "STATUS"],
+        [
+            [a.get("partition", ""), a.get("type", ""), a.get("sizeMb", 0), a.get("status", "")]
+            for a in image.get("artifacts", [])
+        ],
+        title="Artifacts",
+        json_rows=None,
     )
 
 
@@ -38,35 +89,213 @@ def list_cmd(render: Renderer) -> None:
 @output_options
 def push_cmd(render: Renderer, name: str, dockerfile: str, visibility: str) -> None:
     """Build an image from a Dockerfile (server-side build)."""
-    contents = Path(dockerfile).read_text()
-    result = build_client().post(
-        "/images/build",
-        json={
-            "name": name,
-            "dockerfileB64": base64.b64encode(contents.encode()).decode(),
-            "visibility": visibility,
-        },
-        idempotent_post=True,
-    )
+    result = _image_client().build(name, dockerfile=dockerfile, visibility=visibility)
     if render.is_json:
         render.json(result)
     else:
         render.message(f"Image {shorten(result['imageId'])} building (build {result.get('buildId')}).")
 
 
+@images_group.command("build-vm")
+@click.option("--name", required=True)
+@click.option("--base-image", required=True, help="Platform image to base the VM on.")
+@click.option("--boot-disk-gb", type=int, default=50)
+@click.option("--visibility", type=click.Choice(["private", "public"]), default="private")
+@output_options
+def build_vm_cmd(render: Renderer, name: str, base_image: str, boot_disk_gb: int, visibility: str) -> None:
+    """Build a VM image (for VM-kind sandboxes). Reference images.py:766."""
+    result = _image_client().build_vm(name, base_image, boot_disk_gb, visibility)
+    if render.is_json:
+        render.json(result)
+    else:
+        render.message(
+            f"VM image {shorten(result['imageId'])} building from {base_image} "
+            f"({boot_disk_gb} GB boot disk)."
+        )
+
+
+@images_group.command("hf-cache")
+@click.option("--name", required=True)
+@click.option("--model", "models", multiple=True, required=True,
+              help="HF model id to bake into the cache partition (repeatable).")
+@click.option("--visibility", type=click.Choice(["private", "public"]), default="private")
+@output_options
+def hf_cache_cmd(render: Renderer, name: str, models: tuple[str, ...], visibility: str) -> None:
+    """Build an image with HF checkpoint caches preloaded (TPU cold-start)."""
+    result = _image_client().build_hf_cache(name, list(models), visibility)
+    if render.is_json:
+        render.json(result)
+    else:
+        render.message(
+            f"HF-cache image {shorten(result['imageId'])} building with {len(models)} model(s)."
+        )
+
+
+@images_group.command("transfer")
+@click.argument("source")
+@click.option("--name", default=None, help="Target image name (default: derived from source).")
+@click.option("--visibility", type=click.Choice(["private", "public"]), default="private")
+@output_options
+def transfer_cmd(render: Renderer, source: str, name: str | None, visibility: str) -> None:
+    """Transfer an existing registry image into the platform."""
+    result = _image_client().transfer(source, name=name, visibility=visibility)
+    if render.is_json:
+        render.json(result)
+    else:
+        render.message(f"Transferring {source} as {result['name']} ({shorten(result['imageId'])}).")
+
+
 @images_group.command("build-status")
 @click.argument("image_id")
 @output_options
 def build_status_cmd(render: Renderer, image_id: str) -> None:
-    render.detail(build_client().get(f"/images/{image_id}/build-status"), title=f"Image {shorten(image_id)}")
+    render.detail(_image_client().build_status(image_id), title=f"Image {shorten(image_id)}")
 
 
 @images_group.command("publish")
 @click.argument("image_id")
 @output_options
 def publish_cmd(render: Renderer, image_id: str) -> None:
-    result = build_client().post(f"/images/{image_id}/publish", idempotent_post=True)
+    result = _image_client().publish(image_id)
     render.message(f"Image {shorten(image_id)} is now {result.get('visibility')}.")
+
+
+@images_group.command("unpublish")
+@click.argument("image_id")
+@output_options
+def unpublish_cmd(render: Renderer, image_id: str) -> None:
+    result = _image_client().unpublish(image_id)
+    render.message(f"Image {shorten(image_id)} is now {result.get('visibility')}.")
+
+
+@images_group.command("visibility")
+@click.argument("visibility", type=click.Choice(["public", "private"]))
+@click.argument("image_ids", nargs=-1, required=True)
+@output_options
+def visibility_cmd(render: Renderer, visibility: str, image_ids: tuple[str, ...]) -> None:
+    """Set visibility on many images at once."""
+    results = _image_client().set_visibility_bulk(list(image_ids), visibility)
+    _render_bulk_results(render, results, f"visibility -> {visibility}")
+
+
+# -- bulk operations (reference images_bulk / transfer_bulk / update_bulk) ----
+
+
+def _load_manifest(path: str) -> list[dict]:
+    try:
+        data = json.loads(Path(path).read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        raise click.ClickException(f"cannot read manifest {path}: {e}") from None
+    if not isinstance(data, list) or not all(isinstance(x, dict) for x in data):
+        raise click.ClickException(f"manifest {path} must be a JSON list of objects")
+    if not data:
+        raise click.ClickException(f"manifest {path} is empty")
+    return data
+
+
+def _entry_label(entry: dict) -> str | None:
+    return entry.get("name") or entry.get("source") or entry.get("imageId")
+
+
+def _bulk_sleep(seconds: float) -> None:  # seam: patched in tests
+    time.sleep(seconds)
+
+
+def _run_bulk(entries: list[dict], submit) -> list[dict]:
+    """Run one submit(entry) per manifest entry with bounded concurrency and
+    429-aware retries; failures become per-entry outcomes, never aborts."""
+
+    def one(entry: dict) -> dict:
+        label = _entry_label(entry)
+        for attempt in range(BULK_RETRIES + 1):
+            try:
+                result = submit(entry)
+                return {"entry": label, "ok": True, "imageId": result.get("imageId")}
+            except RateLimitError as e:
+                if attempt == BULK_RETRIES:
+                    return {"entry": label, "ok": False, "error": str(e)}
+                _bulk_sleep(min(e.retry_after or 2 ** attempt, 30))
+            except Exception as e:  # noqa: BLE001 — one bad entry must not abort the batch
+                return {"entry": label, "ok": False, "error": str(e)}
+        return {"entry": label, "ok": False, "error": "unreachable"}
+
+    with ThreadPoolExecutor(max_workers=BULK_WORKERS) as pool:
+        return list(pool.map(one, entries))
+
+
+def _render_bulk_results(render: Renderer, results: list[dict], title: str) -> None:
+    ok = sum(1 for r in results if r.get("ok"))
+    if render.is_json:
+        render.json({"results": results, "ok": ok, "failed": len(results) - ok})
+    else:
+        for r in results:
+            mark = "ok " if r.get("ok") else "ERR"
+            label = r.get("entry") or r.get("imageId") or ""
+            suffix = r.get("imageId") if r.get("ok") else r.get("error", "")
+            render.message(f"  {mark} {label} {suffix or ''}")
+        render.message(f"{title}: {ok}/{len(results)} succeeded")
+    if ok < len(results):
+        raise SystemExit(1)
+
+
+@images_group.command("bulk-push")
+@click.option("--manifest", required=True, type=click.Path(exists=True),
+              help='JSON list: [{"name", "dockerfile"|"dockerfileText", "visibility"?}]')
+@output_options
+def bulk_push_cmd(render: Renderer, manifest: str) -> None:
+    """Build many images concurrently from a manifest (reference images_bulk.py)."""
+    entries = _load_manifest(manifest)
+    base = Path(manifest).parent
+    client = _image_client()
+
+    def submit(entry: dict) -> dict:
+        if "name" not in entry:
+            raise click.ClickException(f"manifest entry missing 'name': {entry}")
+        text = entry.get("dockerfileText")
+        dockerfile = entry.get("dockerfile")
+        if text is None and dockerfile is not None:
+            dockerfile = str((base / dockerfile))
+        return client.build(
+            entry["name"], dockerfile=dockerfile, dockerfile_text=text,
+            visibility=entry.get("visibility", "private"),
+        )
+
+    _render_bulk_results(render, _run_bulk(entries, submit), "bulk push")
+
+
+@images_group.command("bulk-transfer")
+@click.option("--manifest", required=True, type=click.Path(exists=True),
+              help='JSON list: [{"source", "name"?, "visibility"?}]')
+@output_options
+def bulk_transfer_cmd(render: Renderer, manifest: str) -> None:
+    """Transfer many registry images (reference images_transfer_bulk.py)."""
+    entries = _load_manifest(manifest)
+    client = _image_client()
+
+    def submit(entry: dict) -> dict:
+        if "source" not in entry:
+            raise click.ClickException(f"manifest entry missing 'source': {entry}")
+        return client.transfer(
+            entry["source"], name=entry.get("name"), visibility=entry.get("visibility", "private")
+        )
+
+    _render_bulk_results(render, _run_bulk(entries, submit), "bulk transfer")
+
+
+@images_group.command("bulk-update")
+@click.option("--manifest", required=True, type=click.Path(exists=True),
+              help='JSON list: [{"imageId", "name"?, "visibility"?, "description"?}]')
+@output_options
+def bulk_update_cmd(render: Renderer, manifest: str) -> None:
+    """Update many logical images in one call (reference images_update_bulk.py)."""
+    entries = _load_manifest(manifest)
+    results = _image_client().update_bulk(entries)
+    normalized = [
+        {"entry": r.get("imageId"), "ok": r.get("ok", False), "imageId": r.get("imageId"),
+         "error": r.get("error")}
+        for r in results
+    ]
+    _render_bulk_results(render, normalized, "bulk update")
 
 
 @click.group(name="registry")

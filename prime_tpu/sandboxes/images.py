@@ -1,0 +1,167 @@
+"""Sandbox image client (reference: prime_sandboxes/images.py:16 ImageClient).
+
+Builds (Dockerfile + VM + HF-cache), registry transfers, build status,
+publish/unpublish, bulk visibility and bulk logical updates. Sync and async
+clients share the payload/parse core (house `_SandboxOps` pattern) instead of
+duplicating bodies.
+
+TPU-native notes: sandbox images default to a JAX/libtpu base, and the
+``hf-cache`` build kind bakes HF checkpoint caches into an image partition so
+a sandbox cold-starts with model weights local — the TPU-era replacement for
+the reference's HF dataset-driven bulk pushes.
+"""
+
+from __future__ import annotations
+
+import base64
+from pathlib import Path
+from typing import Any
+
+from prime_tpu.core.client import APIClient, AsyncAPIClient
+
+
+class _ImageOps:
+    @staticmethod
+    def build_payload(
+        name: str,
+        dockerfile: str | Path | None = None,
+        dockerfile_text: str | None = None,
+        visibility: str = "private",
+    ) -> dict[str, Any]:
+        if dockerfile_text is None:
+            if dockerfile is None:
+                raise ValueError("one of dockerfile / dockerfile_text is required")
+            dockerfile_text = Path(dockerfile).read_text()
+        return {
+            "name": name,
+            "dockerfileB64": base64.b64encode(dockerfile_text.encode()).decode(),
+            "visibility": visibility,
+        }
+
+    @staticmethod
+    def vm_payload(name: str, base_image: str, boot_disk_gb: int, visibility: str) -> dict[str, Any]:
+        return {
+            "name": name,
+            "baseImage": base_image,
+            "bootDiskGb": boot_disk_gb,
+            "visibility": visibility,
+        }
+
+    @staticmethod
+    def hf_cache_payload(name: str, models: list[str], visibility: str) -> dict[str, Any]:
+        if not models:
+            raise ValueError("at least one model is required for an hf-cache image")
+        return {"name": name, "models": list(models), "visibility": visibility}
+
+    @staticmethod
+    def transfer_payload(source: str, name: str | None, visibility: str) -> dict[str, Any]:
+        return {"source": source, "name": name or source.rsplit("/", 1)[-1].replace(":", "-"),
+                "visibility": visibility}
+
+    @staticmethod
+    def items(data: Any) -> list[dict[str, Any]]:
+        return data.get("items", []) if isinstance(data, dict) else data
+
+
+class ImageClient:
+    def __init__(self, client: APIClient | None = None) -> None:
+        self.api = client or APIClient()
+
+    def list(self) -> list[dict[str, Any]]:
+        return _ImageOps.items(self.api.get("/images"))
+
+    def get(self, image_id: str) -> dict[str, Any]:
+        return self.api.get(f"/images/{image_id}")
+
+    def build(self, name: str, dockerfile: str | Path | None = None,
+              dockerfile_text: str | None = None, visibility: str = "private") -> dict[str, Any]:
+        payload = _ImageOps.build_payload(name, dockerfile, dockerfile_text, visibility)
+        return self.api.post("/images/build", json=payload, idempotent_post=True)
+
+    def build_vm(self, name: str, base_image: str, boot_disk_gb: int = 50,
+                 visibility: str = "private") -> dict[str, Any]:
+        payload = _ImageOps.vm_payload(name, base_image, boot_disk_gb, visibility)
+        return self.api.post("/images/build-vm", json=payload, idempotent_post=True)
+
+    def build_hf_cache(self, name: str, models: list[str], visibility: str = "private") -> dict[str, Any]:
+        payload = _ImageOps.hf_cache_payload(name, models, visibility)
+        return self.api.post("/images/hf-cache", json=payload, idempotent_post=True)
+
+    def transfer(self, source: str, name: str | None = None, visibility: str = "private") -> dict[str, Any]:
+        payload = _ImageOps.transfer_payload(source, name, visibility)
+        return self.api.post("/images/transfer", json=payload, idempotent_post=True)
+
+    def build_status(self, image_id: str) -> dict[str, Any]:
+        return self.api.get(f"/images/{image_id}/build-status")
+
+    def publish(self, image_id: str) -> dict[str, Any]:
+        return self.api.post(f"/images/{image_id}/publish", idempotent_post=True)
+
+    def unpublish(self, image_id: str) -> dict[str, Any]:
+        return self.api.post(f"/images/{image_id}/unpublish", idempotent_post=True)
+
+    def set_visibility_bulk(self, image_ids: list[str], visibility: str) -> list[dict[str, Any]]:
+        data = self.api.post(
+            "/images/visibility-bulk",
+            json={"imageIds": image_ids, "visibility": visibility},
+            idempotent_post=True,
+        )
+        return data.get("results", [])
+
+    def update_bulk(self, updates: list[dict[str, Any]]) -> list[dict[str, Any]]:
+        data = self.api.post("/images/update-bulk", json={"updates": updates}, idempotent_post=True)
+        return data.get("results", [])
+
+
+class AsyncImageClient:
+    def __init__(self, client: AsyncAPIClient | None = None) -> None:
+        self.api = client or AsyncAPIClient()
+
+    async def list(self) -> list[dict[str, Any]]:
+        return _ImageOps.items(await self.api.get("/images"))
+
+    async def get(self, image_id: str) -> dict[str, Any]:
+        return await self.api.get(f"/images/{image_id}")
+
+    async def build(self, name: str, dockerfile: str | Path | None = None,
+                    dockerfile_text: str | None = None, visibility: str = "private") -> dict[str, Any]:
+        payload = _ImageOps.build_payload(name, dockerfile, dockerfile_text, visibility)
+        return await self.api.post("/images/build", json=payload, idempotent_post=True)
+
+    async def build_vm(self, name: str, base_image: str, boot_disk_gb: int = 50,
+                       visibility: str = "private") -> dict[str, Any]:
+        payload = _ImageOps.vm_payload(name, base_image, boot_disk_gb, visibility)
+        return await self.api.post("/images/build-vm", json=payload, idempotent_post=True)
+
+    async def build_hf_cache(self, name: str, models: list[str],
+                             visibility: str = "private") -> dict[str, Any]:
+        payload = _ImageOps.hf_cache_payload(name, models, visibility)
+        return await self.api.post("/images/hf-cache", json=payload, idempotent_post=True)
+
+    async def transfer(self, source: str, name: str | None = None,
+                       visibility: str = "private") -> dict[str, Any]:
+        payload = _ImageOps.transfer_payload(source, name, visibility)
+        return await self.api.post("/images/transfer", json=payload, idempotent_post=True)
+
+    async def build_status(self, image_id: str) -> dict[str, Any]:
+        return await self.api.get(f"/images/{image_id}/build-status")
+
+    async def publish(self, image_id: str) -> dict[str, Any]:
+        return await self.api.post(f"/images/{image_id}/publish", idempotent_post=True)
+
+    async def unpublish(self, image_id: str) -> dict[str, Any]:
+        return await self.api.post(f"/images/{image_id}/unpublish", idempotent_post=True)
+
+    async def set_visibility_bulk(self, image_ids: list[str], visibility: str) -> list[dict[str, Any]]:
+        data = await self.api.post(
+            "/images/visibility-bulk",
+            json={"imageIds": image_ids, "visibility": visibility},
+            idempotent_post=True,
+        )
+        return data.get("results", [])
+
+    async def update_bulk(self, updates: list[dict[str, Any]]) -> list[dict[str, Any]]:
+        data = await self.api.post(
+            "/images/update-bulk", json={"updates": updates}, idempotent_post=True
+        )
+        return data.get("results", [])

@@ -23,6 +23,7 @@ class FakeMiscPlane:
         self.account_secrets: dict[str, str] = {}
         self.adapters: dict[str, dict[str, Any]] = {}
         self.images: dict[str, dict[str, Any]] = {}
+        self.image_build_429s = 0  # fault injection: next N builds get 429
         self.tunnels: dict[str, dict[str, Any]] = {}
         self.feedback: list[dict[str, Any]] = []
         self.usage_rows = [
@@ -175,19 +176,96 @@ class FakeMiscPlane:
         def list_images(request: httpx.Request) -> httpx.Response:
             return _json_response(200, {"items": list(plane.images.values())})
 
-        @route("POST", r"/images/build")
-        def build_image(request: httpx.Request) -> httpx.Response:
-            body = plane.fake._body(request)
+        def _new_image(body: dict[str, Any], kind: str, extra: dict[str, Any] | None = None):
             image_id = f"img_{uuid.uuid4().hex[:8]}"
             image = {
                 "imageId": image_id,
                 "name": body.get("name", image_id),
+                "kind": kind,
                 "status": "BUILDING",
                 "visibility": body.get("visibility", "private"),
                 "buildId": f"build_{uuid.uuid4().hex[:6]}",
+                "artifacts": [
+                    {"partition": "rootfs", "type": "layer", "sizeMb": 812, "status": "READY"},
+                    {"partition": "cache", "type": "hf-cache", "sizeMb": 0, "status": "EMPTY"},
+                ],
+                **(extra or {}),
             }
             plane.images[image_id] = image
+            return image
+
+        @route("POST", r"/images/build")
+        def build_image(request: httpx.Request) -> httpx.Response:
+            if plane.image_build_429s > 0:
+                plane.image_build_429s -= 1
+                return _json_response(429, {"detail": "rate limited"})
+            body = plane.fake._body(request)
+            if body.get("name") in {i["name"] for i in plane.images.values()}:
+                return _json_response(409, {"detail": "image name already exists"})
+            return _json_response(200, _new_image(body, "container"))
+
+        @route("POST", r"/images/build-vm")
+        def build_vm_image(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            if not body.get("baseImage"):
+                return _json_response(422, {"detail": "baseImage is required"})
+            extra = {"baseImage": body["baseImage"], "bootDiskGb": body.get("bootDiskGb", 50)}
+            return _json_response(200, _new_image(body, "vm", extra))
+
+        @route("POST", r"/images/hf-cache")
+        def build_hf_cache_image(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            models = body.get("models", [])
+            if not models:
+                return _json_response(422, {"detail": "models list is required"})
+            image = _new_image(body, "hf-cache", {"models": models})
+            image["artifacts"][1] = {
+                "partition": "cache",
+                "type": "hf-cache",
+                "sizeMb": 1024 * len(models),
+                "status": "READY",
+            }
             return _json_response(200, image)
+
+        @route("POST", r"/images/transfer")
+        def transfer_image(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            if not body.get("source"):
+                return _json_response(422, {"detail": "source is required"})
+            return _json_response(
+                200, _new_image(body, "container", {"source": body["source"], "status": "TRANSFERRING"})
+            )
+
+        @route("POST", r"/images/update-bulk")
+        def update_images_bulk(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            results = []
+            for update in body.get("updates", []):
+                image = plane.images.get(update.get("imageId", ""))
+                if image is None:
+                    results.append({"imageId": update.get("imageId"), "ok": False, "error": "not found"})
+                    continue
+                for key in ("name", "visibility", "description"):
+                    if key in update:
+                        image[key] = update[key]
+                results.append({"imageId": image["imageId"], "ok": True})
+            return _json_response(200, {"results": results})
+
+        @route("POST", r"/images/visibility-bulk")
+        def visibility_bulk(request: httpx.Request) -> httpx.Response:
+            body = plane.fake._body(request)
+            visibility = body.get("visibility")
+            if visibility not in ("public", "private"):
+                return _json_response(422, {"detail": "visibility must be public|private"})
+            results = []
+            for iid in body.get("imageIds", []):
+                image = plane.images.get(iid)
+                if image is None:
+                    results.append({"imageId": iid, "ok": False, "error": "not found"})
+                else:
+                    image["visibility"] = visibility
+                    results.append({"imageId": iid, "ok": True})
+            return _json_response(200, {"results": results})
 
         @route("GET", r"/images/(?P<iid>[^/]+)/build-status")
         def build_status(request: httpx.Request, iid: str) -> httpx.Response:
@@ -203,6 +281,21 @@ class FakeMiscPlane:
             if not image:
                 return _json_response(404, {"detail": "image not found"})
             image["visibility"] = "public"
+            return _json_response(200, image)
+
+        @route("POST", r"/images/(?P<iid>[^/]+)/unpublish")
+        def unpublish_image(request: httpx.Request, iid: str) -> httpx.Response:
+            image = plane.images.get(iid)
+            if not image:
+                return _json_response(404, {"detail": "image not found"})
+            image["visibility"] = "private"
+            return _json_response(200, image)
+
+        @route("GET", r"/images/(?P<iid>[^/]+)")
+        def get_image(request: httpx.Request, iid: str) -> httpx.Response:
+            image = plane.images.get(iid)
+            if not image:
+                return _json_response(404, {"detail": "image not found"})
             return _json_response(200, image)
 
         @route("GET", r"/registry/credentials")

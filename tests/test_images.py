@@ -1,0 +1,232 @@
+"""Images suite: SDK client (sync+async), CLI commands, bulk operations."""
+
+import json
+
+import pytest
+from click.testing import CliRunner
+
+import prime_tpu.commands._deps as deps
+from prime_tpu.commands.main import cli
+from prime_tpu.core.client import APIClient, AsyncAPIClient
+from prime_tpu.core.config import Config
+from prime_tpu.sandboxes.images import AsyncImageClient, ImageClient
+from prime_tpu.testing import FakeControlPlane
+
+
+@pytest.fixture
+def fake(monkeypatch):
+    fake = FakeControlPlane()
+    monkeypatch.setattr(deps, "transport_override", fake.transport)
+    monkeypatch.setenv("PRIME_API_KEY", "test-key")
+    monkeypatch.setenv("PRIME_BASE_URL", "https://api.fake")
+    return fake
+
+
+@pytest.fixture
+def client(fake):
+    cfg = Config()
+    cfg.api_key = "test-key"
+    return ImageClient(APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport))
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+# -- SDK ----------------------------------------------------------------------
+
+
+def test_sdk_build_and_lifecycle(client):
+    image = client.build("jax-base", dockerfile_text="FROM python:3.12\n")
+    assert image["status"] == "BUILDING" and image["kind"] == "container"
+    assert client.build_status(image["imageId"])["status"] == "READY"
+    assert client.publish(image["imageId"])["visibility"] == "public"
+    assert client.unpublish(image["imageId"])["visibility"] == "private"
+    assert client.get(image["imageId"])["name"] == "jax-base"
+    assert len(client.list()) == 1
+
+
+def test_sdk_duplicate_name_conflict(client):
+    client.build("dup", dockerfile_text="FROM a\n")
+    from prime_tpu.core.exceptions import APIError
+
+    with pytest.raises(APIError):
+        client.build("dup", dockerfile_text="FROM b\n")
+
+
+def test_sdk_build_vm_requires_base(client):
+    from prime_tpu.core.exceptions import ValidationError
+
+    vm = client.build_vm("vm-img", base_image="tpu-ubuntu2204", boot_disk_gb=100)
+    assert vm["kind"] == "vm" and vm["bootDiskGb"] == 100
+    with pytest.raises(ValidationError):
+        client.api.post("/images/build-vm", json={"name": "x"}, idempotent_post=True)
+
+
+def test_sdk_hf_cache_image(client):
+    image = client.build_hf_cache("llama-cache", ["meta-llama/Llama-3.2-1B"])
+    assert image["kind"] == "hf-cache"
+    cache = next(a for a in image["artifacts"] if a["partition"] == "cache")
+    assert cache["status"] == "READY" and cache["sizeMb"] == 1024
+    with pytest.raises(ValueError, match="at least one model"):
+        client.build_hf_cache("empty", [])
+
+
+def test_sdk_transfer_derives_name(client):
+    image = client.transfer("docker.io/library/python:3.12-slim")
+    assert image["name"] == "python-3.12-slim"
+    assert image["status"] == "TRANSFERRING"
+
+
+def test_sdk_visibility_bulk_mixed(client):
+    a = client.build("a", dockerfile_text="FROM a\n")
+    results = client.set_visibility_bulk([a["imageId"], "img_missing"], "public")
+    by_id = {r["imageId"]: r for r in results}
+    assert by_id[a["imageId"]]["ok"] and not by_id["img_missing"]["ok"]
+    assert client.get(a["imageId"])["visibility"] == "public"
+
+
+def test_sdk_update_bulk(client):
+    a = client.build("old-name", dockerfile_text="FROM a\n")
+    results = client.update_bulk([{"imageId": a["imageId"], "name": "new-name"}])
+    assert results[0]["ok"]
+    assert client.get(a["imageId"])["name"] == "new-name"
+
+
+@pytest.mark.anyio
+async def test_sdk_async_mirror(fake):
+    cfg = Config()
+    cfg.api_key = "test-key"
+    api = AsyncAPIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    client = AsyncImageClient(api)
+    image = await client.build("async-img", dockerfile_text="FROM a\n")
+    assert (await client.build_status(image["imageId"]))["status"] == "READY"
+    assert (await client.set_visibility_bulk([image["imageId"]], "public"))[0]["ok"]
+    assert len(await client.list()) == 1
+    await api.close()
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_cli_get_renders_artifacts(runner, fake, client):
+    image = client.build("arty", dockerfile_text="FROM a\n")
+    result = runner.invoke(cli, ["images", "get", image["imageId"], "--plain"])
+    assert result.exit_code == 0, result.output
+    assert "rootfs" in result.output and "PARTITION" in result.output
+    as_json = json.loads(
+        runner.invoke(cli, ["images", "get", image["imageId"], "--output", "json"]).output
+    )
+    assert as_json["artifacts"][0]["partition"] == "rootfs"
+
+
+def test_cli_build_vm_and_unpublish(runner, fake):
+    result = runner.invoke(
+        cli,
+        ["images", "build-vm", "--name", "vm1", "--base-image", "tpu-vm-base", "--output", "json"],
+    )
+    assert result.exit_code == 0, result.output
+    image_id = json.loads(result.output)["imageId"]
+    runner.invoke(cli, ["images", "publish", image_id])
+    result = runner.invoke(cli, ["images", "unpublish", image_id, "--plain"])
+    assert "private" in result.output
+
+
+def test_cli_hf_cache(runner, fake):
+    result = runner.invoke(
+        cli,
+        ["images", "hf-cache", "--name", "caches", "--model",
+         "meta-llama/Llama-3.2-1B", "--model", "Qwen/Qwen2-0.5B", "--output", "json"],
+    )
+    assert result.exit_code == 0, result.output
+    data = json.loads(result.output)
+    assert data["kind"] == "hf-cache" and len(data["models"]) == 2
+
+
+def test_cli_visibility_bulk(runner, fake, client):
+    a = client.build("va", dockerfile_text="FROM a\n")
+    b = client.build("vb", dockerfile_text="FROM b\n")
+    result = runner.invoke(
+        cli, ["images", "visibility", "public", a["imageId"], b["imageId"], "--plain"]
+    )
+    assert result.exit_code == 0, result.output
+    assert "2/2 succeeded" in result.output
+
+
+def test_cli_bulk_push_manifest(runner, fake, tmp_path):
+    manifest = tmp_path / "m.json"
+    manifest.write_text(json.dumps([
+        {"name": "bulk-a", "dockerfileText": "FROM a\n"},
+        {"name": "bulk-b", "dockerfileText": "FROM b\n"},
+        {"name": "bulk-c", "dockerfileText": "FROM c\n"},
+    ]))
+    result = runner.invoke(cli, ["images", "bulk-push", "--manifest", str(manifest), "--plain"])
+    assert result.exit_code == 0, result.output
+    assert "3/3 succeeded" in result.output
+    assert len(fake.misc_plane.images) == 3
+
+
+def test_cli_bulk_push_retries_429(runner, fake, tmp_path, monkeypatch):
+    import prime_tpu.commands.images as images_cmd
+
+    monkeypatch.setattr(images_cmd, "_bulk_sleep", lambda s: None)
+    fake.misc_plane.image_build_429s = 1  # first build attempt rate-limited
+    manifest = tmp_path / "m.json"
+    manifest.write_text(json.dumps([{"name": "retry-a", "dockerfileText": "FROM a\n"}]))
+    result = runner.invoke(cli, ["images", "bulk-push", "--manifest", str(manifest), "--plain"])
+    assert result.exit_code == 0, result.output
+    assert "1/1 succeeded" in result.output
+
+
+def test_cli_bulk_push_partial_failure_exits_nonzero(runner, fake, tmp_path):
+    manifest = tmp_path / "m.json"
+    manifest.write_text(json.dumps([
+        {"name": "dup-x", "dockerfileText": "FROM a\n"},
+        {"name": "dup-x", "dockerfileText": "FROM b\n"},  # 409 duplicate
+    ]))
+    result = runner.invoke(cli, ["images", "bulk-push", "--manifest", str(manifest), "--plain"])
+    assert result.exit_code == 1
+    assert "1/2 succeeded" in result.output and "ERR" in result.output
+
+
+def test_cli_bulk_transfer_and_update(runner, fake, tmp_path, client):
+    transfers = tmp_path / "t.json"
+    transfers.write_text(json.dumps([
+        {"source": "docker.io/library/redis:7"},
+        {"source": "gcr.io/foo/bar:latest", "name": "bar"},
+    ]))
+    result = runner.invoke(cli, ["images", "bulk-transfer", "--manifest", str(transfers), "--plain"])
+    assert result.exit_code == 0, result.output
+    assert "2/2 succeeded" in result.output
+
+    ids = list(fake.misc_plane.images)
+    updates = tmp_path / "u.json"
+    updates.write_text(json.dumps([
+        {"imageId": ids[0], "visibility": "public"},
+        {"imageId": "img_nope", "name": "x"},
+    ]))
+    result = runner.invoke(cli, ["images", "bulk-update", "--manifest", str(updates), "--plain"])
+    assert result.exit_code == 1  # one entry failed
+    assert "1/2 succeeded" in result.output
+    assert fake.misc_plane.images[ids[0]]["visibility"] == "public"
+
+
+def test_cli_bulk_push_bad_manifest(runner, fake, tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    result = runner.invoke(cli, ["images", "bulk-push", "--manifest", str(bad)])
+    assert result.exit_code != 0
+    assert "JSON list" in result.output
+
+
+def test_cli_bulk_push_bad_entry_does_not_abort_batch(runner, fake, tmp_path):
+    manifest = tmp_path / "m.json"
+    manifest.write_text(json.dumps([
+        {"name": "no-dockerfile"},                      # client-side ValueError
+        {"name": "fine", "dockerfileText": "FROM a\n"},
+    ]))
+    result = runner.invoke(cli, ["images", "bulk-push", "--manifest", str(manifest), "--plain"])
+    assert result.exit_code == 1
+    assert "1/2 succeeded" in result.output
+    assert "no-dockerfile" in result.output  # failed entry still labeled
