@@ -106,28 +106,61 @@ def lab_tui(workspace: str = ".") -> None:
 
 @lab_group.command("setup")
 @click.option("--dir", "workspace", default=".", type=click.Path())
-def lab_setup(workspace: str) -> None:
-    """Bootstrap a Lab workspace (config templates + gitignore hygiene)."""
-    from pathlib import Path
+@click.option(
+    "--agent", "agents", multiple=True, default=("claude", "codex"),
+    help="Agent surface(s) to generate: claude, codex, cursor (repeatable).",
+)
+@click.option("--force-skills", is_flag=True, help="Overwrite bundled skill docs.")
+@output_options
+def lab_setup(render: Renderer, workspace: str, agents: tuple[str, ...], force_skills: bool) -> None:
+    """Bootstrap a Lab workspace: config, bundled skills, agent surfaces
+    (CLAUDE.md / AGENTS.md / cursor rules), gitignore hygiene."""
+    from prime_tpu.lab.setup import setup_workspace
 
-    ws = Path(workspace)
-    ws.mkdir(parents=True, exist_ok=True)
-    lab_dir = ws / ".prime-lab"
-    lab_dir.mkdir(exist_ok=True)
-    config = lab_dir / "lab.toml"
-    if not config.exists():
-        config.write_text('[lab]\nversion = 1\nsections = ["evals", "training", "environments"]\n')
-        click.echo(f"  created {config}")
-    gitignore = ws / ".gitignore"
-    needed = ["outputs/", ".prime-lab/cache/", ".env"]
-    existing = gitignore.read_text().splitlines() if gitignore.exists() else []
-    additions = [line for line in needed if line not in existing]
-    if additions:
-        with open(gitignore, "a") as f:
-            for line in additions:
-                f.write(line + "\n")
-        click.echo(f"  updated {gitignore} (+{len(additions)} entries)")
-    click.echo("Lab workspace ready. Run `prime lab view` for the dashboard.")
+    try:
+        report = setup_workspace(workspace, agents=tuple(agents), force_skills=force_skills)
+    except ValueError as e:
+        raise click.ClickException(str(e)) from None
+    if render.is_json:
+        render.json(report.as_dict())
+        return
+    for path in report.created:
+        render.message(f"  created {path}")
+    for path in report.updated:
+        render.message(f"  updated {path}")
+    render.message(
+        f"Lab workspace ready ({len(report.created)} created, {len(report.updated)} updated). "
+        "Run `prime lab` for the shell."
+    )
+
+
+@lab_group.command("hygiene")
+@click.option("--dir", "workspace", default=".", type=click.Path())
+@click.option("--fix", "do_fix", is_flag=True, help="Append gitignore entries for fixable findings.")
+@output_options
+def lab_hygiene(render: Renderer, workspace: str, do_fix: bool) -> None:
+    """Preflight the workspace for leaks: secrets, outputs, oversized files."""
+    from prime_tpu.lab.hygiene import apply_fixes, check_workspace
+
+    try:
+        findings = check_workspace(workspace)
+        fixed: list[str] = []
+        if do_fix:
+            fixed = apply_fixes(workspace, findings)
+            findings = check_workspace(workspace)  # re-check after fixes
+    except FileNotFoundError as e:
+        raise click.ClickException(str(e)) from None
+    if render.is_json:
+        render.json({"findings": [f.as_dict() for f in findings], "fixed": fixed})
+    else:
+        for entry in fixed:
+            render.message(f"  ignored {entry}")
+        if not findings:
+            render.message("hygiene: clean")
+        for f in findings:
+            render.message(f"  [{f.severity}] {f.code}: {f.message}")
+    if any(f.severity == "error" for f in findings):
+        raise SystemExit(1)
 
 
 @lab_group.command("doctor")

@@ -1,0 +1,143 @@
+"""Workspace hygiene preflights (reference: lab_hygiene.py, 321 LoC).
+
+Checks a Lab workspace for the accidents that leak data or bloat repos:
+secrets on disk that git would pick up, eval outputs / caches inside the
+repo, oversized files, and a missing workspace config. One filesystem walk
+plus one batched ``git check-ignore --stdin`` call, so the preflight stays
+fast on workspaces with thousands of output files. Findings carry a severity
+and, where safe, an auto-fix (a gitignore append); ``apply_fixes`` only ever
+adds ignore rules — it never deletes or rewrites user files.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import subprocess
+from dataclasses import dataclass
+from pathlib import Path
+
+SECRET_PATTERNS = ("*.pem", "*.key", "id_rsa", "id_ed25519", "credentials*.json", ".env")
+LARGE_FILE_MB = 50
+GENERATED_DIRS = (("outputs", "unignored-outputs"), (".prime-lab/cache", "unignored-cache"))
+
+
+@dataclass
+class Finding:
+    severity: str          # error | warn | info
+    code: str
+    message: str
+    fix_entry: str | None = None   # gitignore line that resolves it, if any
+
+    def as_dict(self) -> dict:
+        return {
+            "severity": self.severity,
+            "code": self.code,
+            "message": self.message,
+            "fix": self.fix_entry,
+        }
+
+
+def _in_git_repo(workspace: Path) -> bool:
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "--is-inside-work-tree"],
+            cwd=workspace,
+            capture_output=True,
+            timeout=10,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return proc.returncode == 0
+
+
+def _batch_ignored(workspace: Path, rels: list[str]) -> set[str]:
+    """One `git check-ignore --stdin` call: returns the subset git ignores."""
+    if not rels:
+        return set()
+    try:
+        proc = subprocess.run(
+            ["git", "check-ignore", "--stdin"],
+            cwd=workspace,
+            input="\n".join(rels),
+            text=True,
+            capture_output=True,
+            timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return set()
+    return set(proc.stdout.splitlines())
+
+
+def check_workspace(workspace: str | Path = ".") -> list[Finding]:
+    ws = Path(workspace)
+    if not ws.is_dir():
+        raise FileNotFoundError(f"workspace {ws} does not exist")
+    findings: list[Finding] = []
+
+    if not (ws / ".prime-lab" / "lab.toml").exists():
+        findings.append(
+            Finding("info", "no-lab-config", "no .prime-lab/lab.toml — run `prime lab setup`")
+        )
+
+    if not _in_git_repo(ws):
+        findings.append(
+            Finding("info", "no-git", "workspace is not a git repository; skipping git checks")
+        )
+        return findings
+
+    # single walk: classify secrets and oversized files, skip .git internals
+    secrets: list[str] = []
+    large: list[tuple[str, float]] = []
+    for path in sorted(ws.rglob("*")):
+        if ".git" in path.parts or not path.is_file():
+            continue
+        rel = path.relative_to(ws).as_posix()
+        if any(fnmatch.fnmatch(path.name, pattern) for pattern in SECRET_PATTERNS):
+            secrets.append(rel)
+        try:
+            size_mb = path.stat().st_size / (1024 * 1024)
+        except OSError:
+            continue
+        if size_mb >= LARGE_FILE_MB:
+            large.append((rel, size_mb))
+
+    dir_rels = [rel for rel, _ in GENERATED_DIRS if (ws / rel).exists()]
+    ignored = _batch_ignored(ws, secrets + [rel for rel, _ in large] + dir_rels)
+
+    for rel in secrets:
+        if rel not in ignored:
+            findings.append(
+                Finding(
+                    "error",
+                    "unignored-secret",
+                    f"{rel} looks like a secret and is not gitignored",
+                    fix_entry=rel if "/" not in rel else f"**/{Path(rel).name}",
+                )
+            )
+
+    for rel, code in GENERATED_DIRS:
+        if (ws / rel).exists() and rel not in ignored:
+            findings.append(
+                Finding("warn", code, f"{rel}/ exists and is not gitignored", fix_entry=rel + "/")
+            )
+
+    for rel, size_mb in large:
+        if rel not in ignored:
+            findings.append(
+                Finding(
+                    "warn",
+                    "large-file",
+                    f"{rel} is {size_mb:.0f} MB and not gitignored",
+                    fix_entry=rel,
+                )
+            )
+
+    return findings
+
+
+def apply_fixes(workspace: str | Path, findings: list[Finding]) -> list[str]:
+    """Append the fixable findings' ignore entries to .gitignore. Returns the
+    entries added. Additive only — never rewrites existing content."""
+    from prime_tpu.lab.setup import append_gitignore
+
+    return append_gitignore(workspace, [f.fix_entry for f in findings if f.fix_entry])

@@ -1,0 +1,180 @@
+"""Lab workspace bootstrap (reference: lab_setup.py:44-50, 1,878 LoC).
+
+The reference downloads skills + config templates from a GitHub repo at a
+pinned ref and writes agent-native surface files. This environment is
+zero-egress, so the equivalent content ships **bundled**: canonical skill
+documents and an agent guide live in this module, and setup materializes
+them into the workspace plus one surface file per agent flavor
+(CLAUDE.md / AGENTS.md / .cursor rules).
+
+Surface files are written idempotently between marker comments: user content
+outside the markers is never touched, and re-running setup refreshes only the
+generated block (the reference achieves the same with its pinned-ref
+re-sync).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+MARKER_BEGIN = "<!-- prime-lab:begin generated -->"
+MARKER_END = "<!-- prime-lab:end generated -->"
+
+LAB_TOML = """\
+[lab]
+version = 1
+sections = ["evals", "training", "environments", "pods", "sandboxes"]
+"""
+
+AGENT_GUIDE = """\
+## Prime Lab workspace
+
+This workspace is managed with the `prime` CLI (TPU compute platform).
+
+- Always pass `--plain` (or `--output json`) to `prime` commands: tables are
+  for humans, plain/json output is stable for tooling.
+- Evals: `prime eval run <env> -m <model> --plain` runs locally on the TPU;
+  add `--slice v5e-8` to shard a large model over the slice. Results land in
+  `outputs/evals/<env>--<model>/<run>/` (metadata.json + results.jsonl) and
+  push to the hub unless `--no-push`.
+- Environments: `prime env init <name>` scaffolds; `prime env push` uploads;
+  `prime env install <name>` makes a hub env runnable; an environment is a
+  module exposing `load_environment()` -> examples + scorer.
+- Training: `prime train <config.toml>` submits a hosted run; follow with
+  `prime train logs <id> -f`.
+- Compute: `prime pods create` provisions TPU slices, `prime sandbox create`
+  gives a JAX/libtpu sandbox, `prime tunnel start <port>` exposes local ports.
+- Never commit `outputs/`, `.prime-lab/cache/`, or `.env` — setup keeps them
+  gitignored; run `prime lab hygiene` before pushing.
+"""
+
+SKILLS: dict[str, str] = {
+    "running-evals.md": """\
+# Skill: running evals
+
+1. Resolve the environment: local dir with env.toml > installed > hub slug.
+2. `prime eval run <env> -m <model> -n <limit> --plain` (add `--no-push` for
+   scratch runs; `--slice v5e-8 --tp 4` for sharded models).
+3. Inspect with `prime eval view --plain` (newest run) and push later with
+   `prime eval push`.
+""",
+    "publishing-environments.md": """\
+# Skill: publishing environments
+
+1. `prime env init my-env && cd my-env` — edit `load_environment()` to return
+   {"examples": [{"prompt", "answer"}...], "score": fn}.
+2. `prime env inspect . --plain` must report loadEnvironment=ok.
+3. `prime env push --dir . --plain`; verify with `prime env actions list`.
+""",
+    "tpu-debugging.md": """\
+# Skill: TPU debugging
+
+- `prime pods status <id> --plain` and `prime pods connect <id>` for slices.
+- Sandboxes: `prime sandbox run <id> -- python -c "import jax; print(jax.devices())"`.
+- Multi-host slices expose one ssh target per worker; the same binary must
+  run on every worker (`prime pods connect --all-workers`).
+""",
+}
+
+# agent flavor -> surface path (relative to workspace)
+AGENT_SURFACES: dict[str, str] = {
+    "claude": "CLAUDE.md",
+    "codex": "AGENTS.md",
+    "cursor": ".cursor/rules/prime-lab.mdc",
+}
+
+GITIGNORE_ENTRIES = ["outputs/", ".prime-lab/cache/", ".env"]
+
+
+@dataclass
+class SetupReport:
+    created: list[str] = field(default_factory=list)
+    updated: list[str] = field(default_factory=list)
+    unchanged: list[str] = field(default_factory=list)
+
+    def as_dict(self) -> dict:
+        return {"created": self.created, "updated": self.updated, "unchanged": self.unchanged}
+
+
+def _write_generated_block(path: Path, body: str, report: SetupReport) -> None:
+    """Create or refresh the marked generated block, preserving user text."""
+    block = f"{MARKER_BEGIN}\n{body.rstrip()}\n{MARKER_END}\n"
+    if not path.exists():
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(block)
+        report.created.append(str(path))
+        return
+    text = path.read_text()
+    if MARKER_BEGIN in text and MARKER_END in text:
+        head, _, rest = text.partition(MARKER_BEGIN)
+        _, _, tail = rest.partition(MARKER_END)
+        new_text = head + block.rstrip("\n") + tail
+    else:
+        # surface exists but was never generated: append our block at the end
+        new_text = text.rstrip("\n") + "\n\n" + block
+    if new_text == text:
+        report.unchanged.append(str(path))
+    else:
+        path.write_text(new_text)
+        report.updated.append(str(path))
+
+
+def _write_once(path: Path, content: str, report: SetupReport, force: bool = False) -> None:
+    if path.exists() and not force:
+        report.unchanged.append(str(path))
+        return
+    path.parent.mkdir(parents=True, exist_ok=True)
+    existed = path.exists()
+    path.write_text(content)
+    (report.updated if existed else report.created).append(str(path))
+
+
+def setup_workspace(
+    workspace: str | Path = ".",
+    agents: tuple[str, ...] = ("claude", "codex"),
+    force_skills: bool = False,
+) -> SetupReport:
+    """Materialize the Lab workspace: config, launch dir, skills, agent
+    surfaces, gitignore hygiene. Idempotent; returns what changed."""
+    ws = Path(workspace)
+    ws.mkdir(parents=True, exist_ok=True)
+    report = SetupReport()
+
+    _write_once(ws / ".prime-lab" / "lab.toml", LAB_TOML, report)
+    launch = ws / ".prime-lab" / "launch"
+    if not launch.exists():
+        launch.mkdir(parents=True)
+        report.created.append(str(launch))
+
+    for name, content in SKILLS.items():
+        _write_once(ws / ".prime-lab" / "skills" / name, content, report, force=force_skills)
+
+    unknown = [a for a in agents if a not in AGENT_SURFACES]
+    if unknown:
+        raise ValueError(f"unknown agent flavor(s) {unknown}; choose from {sorted(AGENT_SURFACES)}")
+    for agent in agents:
+        _write_generated_block(ws / AGENT_SURFACES[agent], AGENT_GUIDE, report)
+
+    gitignore = ws / ".gitignore"
+    existed = gitignore.exists()
+    if append_gitignore(ws, GITIGNORE_ENTRIES):
+        (report.updated if existed else report.created).append(str(gitignore))
+
+    return report
+
+
+def append_gitignore(workspace: str | Path, entries: list[str]) -> list[str]:
+    """Append missing entries to the workspace .gitignore (additive only).
+    Shared by setup and hygiene --fix. Returns the entries actually added."""
+    gitignore = Path(workspace) / ".gitignore"
+    text = gitignore.read_text() if gitignore.exists() else ""
+    existing = text.splitlines()
+    additions = [e for e in dict.fromkeys(entries) if e and e not in existing]
+    if additions:
+        with open(gitignore, "a") as f:
+            if text and not text.endswith("\n"):
+                f.write("\n")  # don't glue onto an unterminated last line
+            for entry in additions:
+                f.write(entry + "\n")
+    return additions

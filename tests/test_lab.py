@@ -118,3 +118,154 @@ def test_cache_tolerates_non_numeric_saved_at(tmp_path):
     cache.directory.mkdir(parents=True, exist_ok=True)
     (cache.directory / "pods.json").write_text('{"savedAt": "yesterday", "rows": [1]}')
     assert cache.get("pods") == (None, False)
+
+
+# -- lab setup depth + hygiene (reference lab_setup.py / lab_hygiene.py) ------
+
+
+def test_setup_generates_agent_surfaces(tmp_path):
+    from prime_tpu.lab.setup import AGENT_GUIDE, setup_workspace
+
+    report = setup_workspace(tmp_path, agents=("claude", "codex", "cursor"))
+    assert (tmp_path / "CLAUDE.md").exists()
+    assert (tmp_path / "AGENTS.md").exists()
+    assert (tmp_path / ".cursor" / "rules" / "prime-lab.mdc").exists()
+    assert (tmp_path / ".prime-lab" / "skills" / "running-evals.md").exists()
+    assert "prime eval run" in (tmp_path / "CLAUDE.md").read_text()
+    assert str(tmp_path / "CLAUDE.md") in report.created
+
+
+def test_setup_preserves_user_content_outside_markers(tmp_path):
+    from prime_tpu.lab.setup import setup_workspace
+
+    (tmp_path / "CLAUDE.md").write_text("# My project notes\nkeep me\n")
+    setup_workspace(tmp_path, agents=("claude",))
+    text = (tmp_path / "CLAUDE.md").read_text()
+    assert "keep me" in text and "prime-lab:begin" in text
+
+    # editing inside the markers gets refreshed; outside survives re-setup
+    mangled = text.replace("prime eval run", "BROKEN")
+    (tmp_path / "CLAUDE.md").write_text(mangled + "\n# user appendix\n")
+    report = setup_workspace(tmp_path, agents=("claude",))
+    text = (tmp_path / "CLAUDE.md").read_text()
+    assert "prime eval run" in text and "BROKEN" not in text
+    assert "# user appendix" in text
+    assert str(tmp_path / "CLAUDE.md") in report.updated
+
+
+def test_setup_idempotent(tmp_path):
+    from prime_tpu.lab.setup import setup_workspace
+
+    setup_workspace(tmp_path)
+    report = setup_workspace(tmp_path)
+    assert report.created == [] and report.updated == []
+
+
+def test_setup_rejects_unknown_agent(tmp_path):
+    from prime_tpu.lab.setup import setup_workspace
+
+    with pytest.raises(ValueError, match="unknown agent"):
+        setup_workspace(tmp_path, agents=("emacs",))
+
+
+def _git(tmp_path, *args):
+    import subprocess
+
+    subprocess.run(["git", *args], cwd=tmp_path, capture_output=True, check=True)
+
+
+def test_hygiene_finds_and_fixes(tmp_path):
+    from prime_tpu.lab.hygiene import apply_fixes, check_workspace
+
+    _git(tmp_path, "init", "-q")
+    (tmp_path / "id_rsa").write_text("PRIVATE KEY")
+    (tmp_path / "outputs").mkdir()
+    (tmp_path / "outputs" / "x.jsonl").write_text("{}")
+
+    findings = check_workspace(tmp_path)
+    codes = {f.code for f in findings}
+    assert "unignored-secret" in codes and "unignored-outputs" in codes
+
+    added = apply_fixes(tmp_path, findings)
+    assert "outputs/" in added
+    after = {f.code for f in check_workspace(tmp_path)}
+    assert "unignored-secret" not in after and "unignored-outputs" not in after
+
+
+def test_hygiene_large_file(tmp_path):
+    from prime_tpu.lab.hygiene import check_workspace
+
+    _git(tmp_path, "init", "-q")
+    big = tmp_path / "model.bin"
+    big.write_bytes(b"\0" * (51 * 1024 * 1024))
+    findings = check_workspace(tmp_path)
+    assert any(f.code == "large-file" for f in findings)
+
+
+def test_hygiene_outside_git_repo(tmp_path):
+    from prime_tpu.lab.hygiene import check_workspace
+
+    findings = check_workspace(tmp_path)
+    codes = {f.code for f in findings}
+    assert "no-git" in codes  # informative, not an error
+
+
+def test_lab_setup_and_hygiene_cli(fake, tmp_path, monkeypatch):
+    runner = CliRunner()
+    result = runner.invoke(
+        cli, ["lab", "setup", "--dir", str(tmp_path), "--agent", "claude", "--output", "json"]
+    )
+    assert result.exit_code == 0, result.output
+    report = json.loads(result.output)
+    assert any("CLAUDE.md" in p for p in report["created"])
+
+    _git(tmp_path, "init", "-q")
+    (tmp_path / "secrets.pem").write_text("x")
+    result = runner.invoke(cli, ["lab", "hygiene", "--dir", str(tmp_path), "--plain"])
+    assert result.exit_code == 1  # unignored secret is an error
+    assert "unignored-secret" in result.output
+    result = runner.invoke(cli, ["lab", "hygiene", "--dir", str(tmp_path), "--fix", "--plain"])
+    assert result.exit_code == 0, result.output
+
+
+def test_hygiene_reports_every_secret_and_fix_converges(tmp_path):
+    from prime_tpu.lab.hygiene import apply_fixes, check_workspace
+
+    _git(tmp_path, "init", "-q")
+    (tmp_path / "a.pem").write_text("x")
+    (tmp_path / "b.pem").write_text("y")
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "credentials-prod.json").write_text("{}")
+
+    findings = check_workspace(tmp_path)
+    secret_msgs = [f.message for f in findings if f.code == "unignored-secret"]
+    assert len(secret_msgs) == 3  # ALL secrets reported, not one per pattern
+
+    apply_fixes(tmp_path, findings)
+    after = check_workspace(tmp_path)
+    assert not any(f.code == "unignored-secret" for f in after)  # one --fix converges
+
+
+def test_hygiene_ignores_git_internals(tmp_path):
+    from prime_tpu.lab.hygiene import check_workspace
+
+    _git(tmp_path, "init", "-q")
+    (tmp_path / ".git" / "credentials-cache.json").write_text("{}")
+    assert not any(f.code == "unignored-secret" for f in check_workspace(tmp_path))
+
+
+def test_hygiene_missing_workspace_errors(fake):
+    runner = CliRunner()
+    result = runner.invoke(cli, ["lab", "hygiene", "--dir", "/definitely/not/a/dir"])
+    assert result.exit_code != 0
+    assert "does not exist" in result.output
+
+
+def test_append_gitignore_handles_unterminated_file(tmp_path):
+    from prime_tpu.lab.setup import append_gitignore
+
+    (tmp_path / ".gitignore").write_text("existing-entry")  # no trailing newline
+    added = append_gitignore(tmp_path, ["outputs/"])
+    assert added == ["outputs/"]
+    lines = (tmp_path / ".gitignore").read_text().splitlines()
+    assert lines == ["existing-entry", "outputs/"]
