@@ -54,6 +54,7 @@ class Pod(BaseModel):
     region: str
     zone: str | None = None
     runtime_version: str | None = Field(default=None, alias="runtimeVersion")  # TPU VM image
+    disk_size_gib: int | None = Field(default=None, alias="diskSizeGib")
     price_hourly: float | None = Field(default=None, alias="priceHourly")
     spot: bool = False
     team_id: str | None = Field(default=None, alias="teamId")

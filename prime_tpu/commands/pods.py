@@ -18,8 +18,12 @@ from prime_tpu.api.availability import AvailabilityClient
 from prime_tpu.api.pods import CreatePodRequest, PodsClient
 from prime_tpu.commands._deps import build_client, build_config
 from prime_tpu.parallel.topology import list_slice_names, parse_slice
+from prime_tpu.utils import prompt
 from prime_tpu.utils.render import Renderer, output_options
 from prime_tpu.utils.short_id import resolve, shorten
+
+# TPU VM runtime images, newest first (the wizard's runtime step)
+DEFAULT_RUNTIMES = ("tpu-ubuntu2204-base", "v2-alpha-tpuv5-lite", "v2-alpha-tpuv5")
 
 # Injection point for tests (no real ssh in CI).
 ssh_runner = subprocess.run
@@ -150,24 +154,29 @@ def create(
     api = build_client()
     avail = AvailabilityClient(api)
 
+    wizard = slice_name is None
     if slice_name is None:
-        # Wizard: generation → slice size → offer by price.
-        types = avail.list_tpu_types()
-        click.echo("TPU generations:")
-        for i, t in enumerate(types, 1):
-            click.echo(
-                f"  {i}. {t['tpuType']}  ({t['minChips']}-{t['maxChips']} chips, "
+        # Wizard (reference pods.py:401-780 shape, TPU-flavored):
+        # generation → slice size → offer by price → runtime → disk.
+        gen_row = prompt.pick(
+            "TPU generations",
+            avail.list_tpu_types(),
+            describe=lambda t: (
+                f"{t['tpuType']}  ({t['minChips']}-{t['maxChips']} chips, "
                 f"from ${t['minPriceHourly']:.2f}/hr)"
-            )
-        idx = click.prompt("Select generation", type=click.IntRange(1, len(types)))
-        gen = types[idx - 1]["tpuType"]
-        sizes = list_slice_names(gen)
-        click.echo("Slice sizes:")
-        for i, s in enumerate(sizes, 1):
-            spec = parse_slice(s)
-            click.echo(f"  {i}. {s}  ({spec.chips} chips, {spec.hosts} host(s), ICI {spec.topology})")
-        idx = click.prompt("Select slice", type=click.IntRange(1, len(sizes)))
-        slice_name = sizes[idx - 1]
+            ),
+            assume_default=yes,
+            prompt="Select generation",
+        )
+        slice_name = prompt.pick(
+            "Slice sizes",
+            list_slice_names(gen_row["tpuType"]),
+            describe=lambda s: (
+                lambda sp: f"{s}  ({sp.chips} chips, {sp.hosts} host(s), ICI {sp.topology})"
+            )(parse_slice(s)),
+            assume_default=yes,
+            prompt="Select slice",
+        )
 
     try:
         spec = parse_slice(slice_name)
@@ -185,18 +194,29 @@ def create(
         if not offers:
             raise click.ClickException(f"No available offers for {spec.name}")
         offers.sort(key=lambda o: o.price_hourly)
-        if yes:
-            offer = offers[0]
-        else:
-            click.echo("Offers (price-sorted):")
-            for i, o in enumerate(offers, 1):
-                click.echo(
-                    f"  {i}. {o.provider}/{o.region}  ${o.price_hourly:.2f}/hr"
-                    f"{'  [spot]' if o.spot else ''}"
-                )
-            idx = click.prompt("Select offer", type=click.IntRange(1, len(offers)), default=1)
-            offer = offers[idx - 1]
+        offer = prompt.pick(
+            "Offers (price-sorted)",
+            offers,
+            describe=lambda o: (
+                f"{o.provider}/{o.region}  ${o.price_hourly:.2f}/hr"
+                f"{'  [spot]' if o.spot else ''}"
+            ),
+            assume_default=yes,
+            prompt="Select offer",
+        )
         provider, region = offer.provider, offer.region
+
+    # only the wizard asks follow-ups: a fully-specified `create --slice ...`
+    # must keep reading exactly one confirm from stdin, as before
+    if wizard and not yes:
+        if runtime_version is None:
+            runtime_version = prompt.pick(
+                "TPU runtime (VM image)",
+                list(DEFAULT_RUNTIMES),
+                prompt="Select runtime",
+            )
+        if disk_size_gib is None:
+            disk_size_gib = prompt.prompt_int("Boot disk GiB", default=100, minimum=20, maximum=3000)
 
     name = name or f"{spec.name}-{int(time.time()) % 100000}"
     summary = (

@@ -51,21 +51,33 @@ def _in_git_repo(workspace: Path) -> bool:
 
 
 def _batch_ignored(workspace: Path, rels: list[str]) -> set[str]:
-    """One `git check-ignore --stdin` call: returns the subset git ignores."""
+    """One `git check-ignore -z --stdin` call: returns the subset git ignores.
+    NUL separation on both sides — without -z git C-quotes non-ASCII paths on
+    stdout and they would never match the raw strings we compare against."""
     if not rels:
         return set()
     try:
         proc = subprocess.run(
-            ["git", "check-ignore", "--stdin"],
+            ["git", "check-ignore", "-z", "--stdin"],
             cwd=workspace,
-            input="\n".join(rels),
+            input="\0".join(rels) + "\0",
             text=True,
             capture_output=True,
             timeout=30,
         )
     except (OSError, subprocess.TimeoutExpired):
         return set()
-    return set(proc.stdout.splitlines())
+    return {p for p in proc.stdout.split("\0") if p}
+
+
+def _escape_gitignore(path: str) -> str:
+    """Escape glob metacharacters so a literal path works as an ignore rule."""
+    escaped = path.replace("\\", "\\\\")
+    for ch in ("[", "]", "*", "?"):
+        escaped = escaped.replace(ch, "\\" + ch)
+    if escaped.startswith(("#", "!")):
+        escaped = "\\" + escaped
+    return escaped
 
 
 def check_workspace(workspace: str | Path = ".") -> list[Finding]:
@@ -85,21 +97,24 @@ def check_workspace(workspace: str | Path = ".") -> list[Finding]:
         )
         return findings
 
-    # single walk: classify secrets and oversized files, skip .git internals
+    # single walk with .git pruned BEFORE descent (never enumerate objects/)
+    import os
+
     secrets: list[str] = []
     large: list[tuple[str, float]] = []
-    for path in sorted(ws.rglob("*")):
-        if ".git" in path.parts or not path.is_file():
-            continue
-        rel = path.relative_to(ws).as_posix()
-        if any(fnmatch.fnmatch(path.name, pattern) for pattern in SECRET_PATTERNS):
-            secrets.append(rel)
-        try:
-            size_mb = path.stat().st_size / (1024 * 1024)
-        except OSError:
-            continue
-        if size_mb >= LARGE_FILE_MB:
-            large.append((rel, size_mb))
+    for dirpath, dirnames, filenames in os.walk(ws):
+        dirnames[:] = sorted(d for d in dirnames if d != ".git")
+        for name in sorted(filenames):
+            path = Path(dirpath) / name
+            rel = path.relative_to(ws).as_posix()
+            if any(fnmatch.fnmatch(name, pattern) for pattern in SECRET_PATTERNS):
+                secrets.append(rel)
+            try:
+                size_mb = path.stat().st_size / (1024 * 1024)
+            except OSError:
+                continue
+            if size_mb >= LARGE_FILE_MB:
+                large.append((rel, size_mb))
 
     dir_rels = [rel for rel, _ in GENERATED_DIRS if (ws / rel).exists()]
     ignored = _batch_ignored(ws, secrets + [rel for rel, _ in large] + dir_rels)
@@ -111,7 +126,9 @@ def check_workspace(workspace: str | Path = ".") -> list[Finding]:
                     "error",
                     "unignored-secret",
                     f"{rel} looks like a secret and is not gitignored",
-                    fix_entry=rel if "/" not in rel else f"**/{Path(rel).name}",
+                    fix_entry=_escape_gitignore(rel)
+                    if "/" not in rel
+                    else f"**/{_escape_gitignore(Path(rel).name)}",
                 )
             )
 
@@ -128,7 +145,7 @@ def check_workspace(workspace: str | Path = ".") -> list[Finding]:
                     "warn",
                     "large-file",
                     f"{rel} is {size_mb:.0f} MB and not gitignored",
-                    fix_entry=rel,
+                    fix_entry=_escape_gitignore(rel),
                 )
             )
 

@@ -251,6 +251,7 @@ class FakeControlPlane:
                 "region": body.get("region") or "us-central2",
                 "zone": (body.get("region") or "us-central2") + "-b",
                 "runtimeVersion": body.get("runtimeVersion") or _DEFAULT_RUNTIME,
+                "diskSizeGib": body.get("diskSizeGib"),
                 "priceHourly": _CHIP_HOUR_PRICE[spec.generation.value] * spec.chips,
                 "spot": bool(body.get("spot", False)),
                 "teamId": body.get("teamId"),

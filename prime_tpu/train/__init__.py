@@ -12,9 +12,11 @@ _TRAINER_EXPORTS = {
     "init_train_state",
     "make_train_step",
     "shard_train_state",
+    "warmup_cosine",
 }
+_LOOP_EXPORTS = {"LoopReport", "train_loop"}
 
-__all__ = sorted(_TRAINER_EXPORTS)
+__all__ = sorted(_TRAINER_EXPORTS | _LOOP_EXPORTS)
 
 
 def __getattr__(name: str):
@@ -22,4 +24,8 @@ def __getattr__(name: str):
         from prime_tpu.train import trainer
 
         return getattr(trainer, name)
+    if name in _LOOP_EXPORTS:
+        from prime_tpu.train import loop
+
+        return getattr(loop, name)
     raise AttributeError(f"module 'prime_tpu.train' has no attribute {name!r}")

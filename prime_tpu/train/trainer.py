@@ -41,33 +41,112 @@ def cross_entropy_loss(
     return -jnp.sum(token_ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
-def default_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1) -> optax.GradientTransformation:
+def warmup_cosine(
+    peak_lr: float, total_steps: int, warmup_steps: int | None = None, final_lr_frac: float = 0.1
+):
+    """Linear warmup → cosine decay, the standard LLM schedule."""
+    if warmup_steps is None:
+        warmup_steps = max(1, total_steps // 100)
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=peak_lr,
+        warmup_steps=warmup_steps,
+        decay_steps=total_steps,
+        end_value=peak_lr * final_lr_frac,
+    )
+
+
+def default_optimizer(
+    learning_rate: float | optax.Schedule = 3e-4,
+    weight_decay: float = 0.1,
+    max_grad_norm: float = 1.0,
+) -> optax.GradientTransformation:
+    """AdamW + global-norm clipping. ``learning_rate`` may be a schedule
+    (see :func:`warmup_cosine`).
+
+    Mixed-precision policy lives in the train step, not here: the step feeds
+    the optimizer fp32 gradients and an fp32 view of the params, so BOTH Adam
+    moments stay fp32 even with bf16 params (optax has no nu_dtype knob, and
+    nu accumulates squared gradients — exactly what bf16's ~3 significant
+    digits destroy)."""
     return optax.chain(
-        optax.clip_by_global_norm(1.0),
+        optax.clip_by_global_norm(max_grad_norm),
         optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=weight_decay),
     )
 
 
+def _f32(tree):
+    return jax.tree.map(lambda x: x.astype(jnp.float32), tree)
+
+
 def init_train_state(params, optimizer: optax.GradientTransformation) -> TrainState:
-    return TrainState(params=params, opt_state=optimizer.init(params), step=jnp.zeros((), jnp.int32))
+    # fp32 skeleton: Adam's mu/nu are created in fp32 even for bf16 params
+    # (the step always hands the optimizer fp32 grads/param views)
+    skeleton = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    return TrainState(
+        params=params, opt_state=optimizer.init(skeleton), step=jnp.zeros((), jnp.int32)
+    )
 
 
 def make_train_step(
     config: ModelConfig,
     optimizer: optax.GradientTransformation,
     attn_impl: str = "auto",
+    accum_steps: int = 1,
 ):
     """Build the jitted train step. Shardings propagate from the placed
-    inputs (shard_train_state / shard_batch) — the jit is mesh-agnostic."""
+    inputs (shard_train_state / shard_batch) — the jit is mesh-agnostic.
+
+    ``accum_steps > 1`` scans microbatches (the leading batch dim must be a
+    multiple) accumulating fp32 gradients at constant memory before one
+    optimizer update. Microbatch gradients are combined weighted by their
+    real-token counts, so ragged masks give the SAME global token-mean
+    objective as the full-batch step — not a mean of per-microbatch means.
+    """
 
     def loss_fn(params, tokens, targets, mask):
         logits, _ = forward(params, tokens, config, cache=None, attn_impl=attn_impl)
         return cross_entropy_loss(logits, targets, mask)
 
+    def grads_of(params, tokens, targets, mask):
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, mask)
+            return loss, _f32(grads)
+        batch = tokens.shape[0]
+        if batch % accum_steps:
+            raise ValueError(f"batch {batch} not divisible by accum_steps {accum_steps}")
+        micro = batch // accum_steps
+
+        def shaped(x):
+            return x.reshape(accum_steps, micro, *x.shape[1:])
+
+        def micro_step(carry, xs):
+            loss_sum, token_sum, grad_sum = carry
+            _, _, m = xs
+            loss, grads = jax.value_and_grad(loss_fn)(params, *xs)
+            tokens_here = jnp.sum(m).astype(jnp.float32)
+            grad_sum = jax.tree.map(
+                lambda acc, g: acc + g.astype(jnp.float32) * tokens_here, grad_sum, grads
+            )
+            return (loss_sum + loss * tokens_here, token_sum + tokens_here, grad_sum), None
+
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (loss_sum, token_sum, grad_sum), _ = jax.lax.scan(
+            micro_step,
+            (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32), zeros),
+            (shaped(tokens), shaped(targets), shaped(mask)),
+        )
+        total = jnp.maximum(token_sum, 1.0)
+        return loss_sum / total, jax.tree.map(lambda g: g / total, grad_sum)
+
     def train_step(state: TrainState, tokens, targets, mask):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, targets, mask)
-        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        loss, grads = grads_of(state.params, tokens, targets, mask)
+        # fp32 update path: fp32 grads + fp32 param view -> fp32 moments and
+        # updates; the params round back to their storage dtype once
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, _f32(state.params))
+        new_params = jax.tree.map(
+            lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype), state.params, updates
+        )
         new_state = TrainState(new_params, new_opt_state, state.step + 1)
         return new_state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
 

@@ -1,0 +1,68 @@
+"""Interactive pickers (reference: utils/prompt.py, 202 LoC).
+
+One consistent selection UX for every wizard: numbered rows with aligned
+columns, a default choice, and `--yes` short-circuiting. Built on click's
+prompt machinery so CliRunner-driven tests can feed selections via stdin.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import click
+
+
+def pick(
+    title: str,
+    rows: Sequence[Any],
+    *,
+    describe: Callable[[Any], str] = str,
+    default: int | None = 1,
+    assume_default: bool = False,
+    prompt: str = "Select",
+) -> Any:
+    """Numbered picker: print rows, return the chosen one.
+
+    ``default`` is 1-based; ``assume_default=True`` (e.g. from --yes) skips
+    interaction entirely. Raises click.ClickException for an empty row list.
+    """
+    if not rows:
+        raise click.ClickException(f"{title}: nothing to select from")
+    if len(rows) == 1 or (assume_default and default is not None):
+        return rows[(default or 1) - 1]
+    click.echo(f"{title}:")
+    width = len(str(len(rows)))
+    for index, row in enumerate(rows, 1):
+        click.echo(f"  {index:>{width}}. {describe(row)}")
+    choice = click.prompt(prompt, type=click.IntRange(1, len(rows)), default=default)
+    return rows[choice - 1]
+
+
+def pick_value(
+    title: str,
+    value: Any | None,
+    choices: Sequence[Any],
+    *,
+    describe: Callable[[Any], str] = str,
+    default: int | None = 1,
+    assume_default: bool = False,
+) -> Any:
+    """Return ``value`` if already provided (flag given), else pick one."""
+    if value is not None:
+        return value
+    return pick(title, choices, describe=describe, default=default, assume_default=assume_default)
+
+
+def prompt_int(
+    label: str, default: int, *, minimum: int = 1, maximum: int | None = None,
+    assume_default: bool = False,
+) -> int:
+    if assume_default:
+        return default
+    return click.prompt(label, type=click.IntRange(minimum, maximum), default=default)
+
+
+def confirm(message: str, *, default: bool = True, assume_yes: bool = False) -> bool:
+    if assume_yes:
+        return True
+    return click.confirm(message, default=default)

@@ -86,11 +86,11 @@ def test_pods_create_noninteractive_and_lifecycle(runner, fake):
 
 
 def test_pods_create_wizard_interactive(runner, fake):
-    # generation 2 (v5e), slice 4 (v5e-8), offer 1, confirm
+    # generation 2 (v5e), slice 4 (v5e-8), offer 1, runtime 1, disk 100, confirm
     result = runner.invoke(
         cli,
         ["pods", "create"],
-        input="2\n4\n1\ny\n",
+        input="2\n4\n1\n1\n100\ny\n",
     )
     assert result.exit_code == 0, result.output
     assert "v5e-8" in result.output
@@ -270,3 +270,32 @@ def test_eval_run_and_push_cli(runner, fake, tmp_path, monkeypatch):
     # push again from the run dir on disk
     result = runner.invoke(cli, ["eval", "push", "--output", "json"])
     assert result.exit_code == 0, result.output
+
+
+def test_prompt_pickers():
+    """utils.prompt: single row short-circuits, assume_default skips I/O."""
+    import pytest as _pytest
+
+    from prime_tpu.utils.prompt import confirm, pick, pick_value, prompt_int
+
+    assert pick("t", ["only"]) == "only"
+    assert pick("t", ["a", "b", "c"], assume_default=True) == "a"
+    assert pick("t", ["a", "b"], default=2, assume_default=True) == "b"
+    assert pick_value("t", "given", ["a", "b"]) == "given"
+    assert pick_value("t", None, ["a", "b"], assume_default=True) == "a"
+    assert prompt_int("n", 7, assume_default=True) == 7
+    assert confirm("ok?", assume_yes=True) is True
+    with _pytest.raises(Exception, match="nothing to select"):
+        pick("t", [])
+
+
+def test_pods_create_wizard_runtime_and_disk_in_payload(runner, fake):
+    result = runner.invoke(
+        cli,
+        ["pods", "create"],
+        input="2\n4\n1\n2\n250\ny\n",  # runtime option 2, disk 250
+    )
+    assert result.exit_code == 0, result.output
+    pod = next(iter(fake.pods.values()))
+    assert pod["runtimeVersion"] == "v2-alpha-tpuv5-lite"
+    assert pod["diskSizeGib"] == 250

@@ -223,3 +223,22 @@ def test_run_eval_sharded_slice(tmp_path):
     result = run_eval(spec)
     assert result.metrics["num_samples"] == 4
     assert (result.run_dir / "results.jsonl").exists()
+
+
+def test_checkpoint_without_tokenizer_errors_not_byte_fallback(tmp_path):
+    """A real checkpoint whose tokenizer can't load must be an error — a
+    silent byte fallback would score garbage as results (VERDICT r1 weak #4)."""
+    import json as _json
+
+    from prime_tpu.evals.runner import JaxGenerator
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    (ckpt / "config.json").write_text(_json.dumps({
+        "vocab_size": 64, "hidden_size": 32, "num_hidden_layers": 1,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "intermediate_size": 64, "rms_norm_eps": 1e-5,
+    }))
+    # no tokenizer files and no weights: tokenizer failure must surface first
+    with pytest.raises(ValueError, match="Could not load tokenizer"):
+        JaxGenerator("some-model", checkpoint=str(ckpt))

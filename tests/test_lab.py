@@ -269,3 +269,16 @@ def test_append_gitignore_handles_unterminated_file(tmp_path):
     assert added == ["outputs/"]
     lines = (tmp_path / ".gitignore").read_text().splitlines()
     assert lines == ["existing-entry", "outputs/"]
+
+
+def test_hygiene_escapes_glob_metachars_and_converges(tmp_path):
+    from prime_tpu.lab.hygiene import apply_fixes, check_workspace
+
+    _git(tmp_path, "init", "-q")
+    weird = tmp_path / "data[v1].pem"
+    weird.write_text("secret")
+    findings = check_workspace(tmp_path)
+    assert any(f.code == "unignored-secret" for f in findings)
+    apply_fixes(tmp_path, findings)
+    after = check_workspace(tmp_path)
+    assert not any(f.code == "unignored-secret" for f in after)  # rule matched literally

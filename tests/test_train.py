@@ -250,3 +250,159 @@ def test_metrics_logger(tmp_path):
     assert [r["step"] for r in rows] == [1, 2]
     assert rows[0]["loss"] == 2.5
     assert logger.last()["note"] == "warmup done"
+
+
+# -- round-2 trainer depth: accumulation, schedule, loop ----------------------
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=N on batch B must match one step on the same batch (same
+    data, same update) up to fp32 accumulation noise."""
+    import jax
+    import jax.numpy as jnp
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.train import default_optimizer, init_train_state, make_train_step
+
+    config = get_config("tiny-test")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    optimizer = default_optimizer(learning_rate=1e-3)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, config.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    mask = jnp.ones_like(tokens, dtype=jnp.float32)
+
+    # the jitted step donates its state: each run needs its own buffers
+    params_b = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    state_a = init_train_state(params, optimizer)
+    state_b = init_train_state(params_b, optimizer)
+    step_full = make_train_step(config, optimizer)
+    step_accum = make_train_step(config, optimizer, accum_steps=2)
+
+    state_a, metrics_a = step_full(state_a, tokens, targets, mask)
+    state_b, metrics_b = step_accum(state_b, tokens, targets, mask)
+
+    import numpy as np
+
+    np.testing.assert_allclose(
+        float(metrics_a["loss"]), float(metrics_b["loss"]), rtol=1e-5, atol=1e-5
+    )
+    leaves_a = jax.tree.leaves(state_a.params)
+    leaves_b = jax.tree.leaves(state_b.params)
+    for a, b in zip(leaves_a, leaves_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+def test_grad_accumulation_rejects_indivisible_batch():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.train import default_optimizer, init_train_state, make_train_step
+
+    config = get_config("tiny-test")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    optimizer = default_optimizer()
+    state = init_train_state(params, optimizer)
+    step = make_train_step(config, optimizer, accum_steps=3)
+    tokens = jnp.zeros((4, 8), jnp.int32)
+    with pytest.raises(ValueError, match="not divisible"):
+        step(state, tokens, tokens, jnp.ones((4, 8), jnp.float32))
+
+
+def test_warmup_cosine_schedule_shape():
+    from prime_tpu.train import warmup_cosine
+
+    schedule = warmup_cosine(3e-4, total_steps=100, warmup_steps=10)
+    assert float(schedule(0)) == 0.0
+    assert abs(float(schedule(10)) - 3e-4) < 1e-9  # peak after warmup
+    assert float(schedule(100)) < 3e-4 * 0.11  # decayed to the floor
+
+
+def test_train_loop_times_and_logs(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.train import (
+        default_optimizer,
+        init_train_state,
+        make_train_step,
+        train_loop,
+    )
+    from prime_tpu.train.metrics import MetricsLogger
+
+    config = get_config("tiny-test")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    optimizer = default_optimizer(learning_rate=1e-3)
+    state = init_train_state(params, optimizer)
+    step = make_train_step(config, optimizer)
+
+    def batches(n=4):
+        for i in range(n):
+            tokens = jax.random.randint(jax.random.PRNGKey(i), (2, 16), 0, config.vocab_size)
+            yield tokens, jnp.roll(tokens, -1, axis=1), jnp.ones_like(tokens, jnp.float32)
+
+    metrics = MetricsLogger(tmp_path)
+    seen = []
+    state, report = train_loop(
+        state, step, batches(), metrics=metrics, on_step=lambda s, row: seen.append(s),
+        profile_dir=str(tmp_path / "trace"), profile_window=(1, 3),
+    )
+    assert report.steps == 4 and seen == [0, 1, 2, 3]
+    assert report.mean_step_time_s > 0 and report.tokens_per_sec > 0
+    rows = metrics.read()
+    assert len(rows) == 4 and rows[-1]["step_time_s"] > 0
+    assert (tmp_path / "trace").exists()  # profiler trace captured
+
+
+def test_accum_matches_full_batch_with_ragged_mask():
+    """Token-weighted accumulation: ragged masks must give the same global
+    objective as the full-batch step (mean-of-means would not)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.train import default_optimizer, init_train_state, make_train_step
+
+    config = get_config("tiny-test")
+    optimizer = default_optimizer(learning_rate=1e-3)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, config.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    mask = jnp.zeros((4, 16), jnp.float32)
+    mask = mask.at[0, :16].set(1.0).at[1, :2].set(1.0).at[2, :9].set(1.0).at[3, :1].set(1.0)
+
+    state_a = init_train_state(init_params(jax.random.PRNGKey(0), config, jnp.float32), optimizer)
+    state_b = init_train_state(init_params(jax.random.PRNGKey(0), config, jnp.float32), optimizer)
+    state_a, ma = make_train_step(config, optimizer)(state_a, tokens, targets, mask)
+    state_b, mb = make_train_step(config, optimizer, accum_steps=2)(state_b, tokens, targets, mask)
+    np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+def test_bf16_params_get_fp32_adam_moments():
+    import jax
+    import jax.numpy as jnp
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.train import default_optimizer, init_train_state, make_train_step
+
+    config = get_config("tiny-test")
+    optimizer = default_optimizer(learning_rate=1e-3)
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.bfloat16)
+    state = init_train_state(params, optimizer)
+    moment_dtypes = {leaf.dtype for leaf in jax.tree.leaves(state.opt_state)}
+    assert jnp.bfloat16 not in moment_dtypes  # both mu and nu fp32
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, config.vocab_size)
+    step = make_train_step(config, optimizer)
+    state, metrics = step(state, tokens, jnp.roll(tokens, -1, 1), jnp.ones_like(tokens, jnp.float32))
+    assert all(leaf.dtype == jnp.bfloat16 for leaf in jax.tree.leaves(state.params))
+    assert jnp.bfloat16 not in {leaf.dtype for leaf in jax.tree.leaves(state.opt_state)}
