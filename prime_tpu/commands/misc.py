@@ -134,6 +134,37 @@ def lab_setup(render: Renderer, workspace: str, agents: tuple[str, ...], force_s
     )
 
 
+@lab_group.command("mcp")
+@click.option("--dir", "workspace", default=".", type=click.Path(exists=True, file_okay=False))
+def lab_mcp(workspace: str) -> None:
+    """Run the stdio MCP server exposing Lab tools (for agent clients)."""
+    from prime_tpu.lab.mcp import serve
+
+    serve(workspace)
+
+
+@lab_group.command("agent")
+@click.argument("prompt_text", metavar="PROMPT")
+@click.option("--command", "agent_command", required=True,
+              help="Agent server command line (spawned as a subprocess).")
+@click.option("--dialect", type=click.Choice(["simple", "acp"]), default="acp")
+@click.option("--timeout", "timeout_s", type=float, default=120.0)
+def lab_agent(prompt_text: str, agent_command: str, dialect: str, timeout_s: float) -> None:
+    """One chat turn against a stdio agent (ACP or simple JSONL dialect)."""
+    import shlex
+
+    from prime_tpu.lab.agents import AgentError, AgentRuntime
+
+    runtime = AgentRuntime(shlex.split(agent_command), dialect=dialect)
+    try:
+        with runtime:
+            for event in runtime.prompt(prompt_text, timeout_s=timeout_s):
+                click.echo(event.text, nl=False)
+        click.echo()
+    except AgentError as e:
+        raise click.ClickException(str(e)) from None
+
+
 @lab_group.command("hygiene")
 @click.option("--dir", "workspace", default=".", type=click.Path())
 @click.option("--fix", "do_fix", is_flag=True, help="Append gitignore entries for fixable findings.")

@@ -1,0 +1,264 @@
+"""Agent runtime: subprocess chat over newline-delimited JSON-RPC stdio.
+
+Reference: prime_lab_app/agent_runtime.py:66 — an embedded chat runtime that
+owns one agent server process per workspace and speaks ACP / Codex
+app-server / Letta dialects over stdio. This implementation keeps the same
+architecture (spawn → initialize → prompt → streamed events → close) with a
+dialect table mapping the three wire shapes onto one driver:
+
+- ``acp``    — JSON-RPC 2.0: ``initialize`` → ``session/new`` →
+  ``session/prompt``; streamed ``session/update`` notifications carry chunks.
+- ``simple`` — bare JSONL turns: ``{"type": "prompt", ...}`` in,
+  ``{"type": "chunk"|"done", ...}`` out (what our test agents speak, and a
+  sane target for custom agents).
+
+The stdout reader runs on a thread pushing events into a queue; callers
+iterate :meth:`AgentRuntime.prompt` to stream a turn's chunks.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import subprocess
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Iterator
+
+
+class AgentError(RuntimeError):
+    pass
+
+
+@dataclass
+class AgentEvent:
+    kind: str          # chunk | done | error | log
+    text: str = ""
+    raw: dict | None = None
+
+
+class _Dialect:
+    """Wire-shape hooks; every method is pure message construction/parsing."""
+
+    name = "simple"
+
+    def initialize_msgs(self) -> list[dict]:
+        return []
+
+    def prompt_msg(self, text: str, msg_id: int) -> dict:
+        return {"type": "prompt", "id": msg_id, "text": text}
+
+    def parse(self, msg: dict) -> AgentEvent | None:
+        kind = msg.get("type")
+        if kind == "chunk":
+            return AgentEvent("chunk", text=str(msg.get("text", "")), raw=msg)
+        if kind == "done":
+            return AgentEvent("done", raw=msg)
+        if kind == "error":
+            return AgentEvent("error", text=str(msg.get("message", "")), raw=msg)
+        return AgentEvent("log", raw=msg)
+
+
+class _AcpDialect(_Dialect):
+    """ACP-flavored JSON-RPC 2.0 (initialize / session/new / session/prompt)."""
+
+    name = "acp"
+
+    def __init__(self) -> None:
+        self.session_id: str | None = None
+
+    def initialize_msgs(self) -> list[dict]:
+        return [
+            {"jsonrpc": "2.0", "id": 1, "method": "initialize",
+             "params": {"protocolVersion": 1, "clientInfo": {"name": "prime-lab"}}},
+            {"jsonrpc": "2.0", "id": 2, "method": "session/new", "params": {}},
+        ]
+
+    def prompt_msg(self, text: str, msg_id: int) -> dict:
+        return {
+            "jsonrpc": "2.0",
+            "id": msg_id,
+            "method": "session/prompt",
+            "params": {"sessionId": self.session_id, "prompt": [{"type": "text", "text": text}]},
+        }
+
+    def parse(self, msg: dict) -> AgentEvent | None:
+        if msg.get("method") == "session/update":
+            update = msg.get("params", {}).get("update", {})
+            if update.get("sessionUpdate") == "agent_message_chunk":
+                content = update.get("content", {})
+                return AgentEvent("chunk", text=str(content.get("text", "")), raw=msg)
+            return AgentEvent("log", raw=msg)
+        if "result" in msg:
+            result = msg.get("result") or {}
+            if isinstance(result, dict) and result.get("sessionId"):
+                self.session_id = result["sessionId"]
+                return AgentEvent("log", raw=msg)
+            if isinstance(result, dict) and result.get("stopReason") is not None:
+                return AgentEvent("done", raw=msg)
+            return AgentEvent("log", raw=msg)
+        if "error" in msg:
+            return AgentEvent("error", text=str(msg["error"].get("message", "")), raw=msg)
+        return AgentEvent("log", raw=msg)
+
+
+DIALECTS = {"simple": _Dialect, "acp": _AcpDialect}
+
+
+class AgentRuntime:
+    """Owns one agent subprocess and streams chat turns over its stdio."""
+
+    def __init__(
+        self,
+        command: list[str],
+        dialect: str = "simple",
+        cwd: str | None = None,
+        env: dict[str, str] | None = None,
+    ) -> None:
+        if dialect not in DIALECTS:
+            raise AgentError(f"unknown dialect {dialect!r}; choose from {sorted(DIALECTS)}")
+        self.command = command
+        self.dialect = DIALECTS[dialect]()
+        self._cwd = cwd
+        self._env = env
+        self.process: subprocess.Popen | None = None
+        self._events: queue.Queue[AgentEvent | None] = queue.Queue()
+        self._msg_id = 10
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, timeout_s: float = 15.0) -> None:
+        import os
+
+        env = dict(os.environ)
+        if self._env:
+            env.update(self._env)
+        try:
+            self.process = subprocess.Popen(
+                self.command,
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                text=True,
+                cwd=self._cwd,
+                env=env,
+            )
+        except OSError as e:
+            raise AgentError(f"could not spawn agent {self.command[0]!r}: {e}") from e
+        threading.Thread(target=self._read_stdout, daemon=True).start()
+        for msg in self.dialect.initialize_msgs():
+            self._send(msg)
+        # ACP: wait for the session id before accepting prompts
+        if isinstance(self.dialect, _AcpDialect):
+            deadline = time.monotonic() + timeout_s
+            while self.dialect.session_id is None:
+                if time.monotonic() > deadline:
+                    self.close()
+                    raise AgentError("agent did not establish a session in time")
+                if self.process.poll() is not None:
+                    rc = self.process.returncode
+                    self.close()  # release the pipes even though it exited
+                    raise AgentError(f"agent exited during handshake (rc={rc})")
+                time.sleep(0.02)
+
+    def prompt(self, text: str, timeout_s: float = 120.0) -> Iterator[AgentEvent]:
+        """Send one user turn; yield chunk events until the turn completes."""
+        if self.process is None or self.process.poll() is not None:
+            raise AgentError("agent is not running")
+        # drain leftovers from an abandoned/timed-out turn so this turn never
+        # consumes a stale chunk or terminates on a stale done
+        while True:
+            try:
+                if self._events.get_nowait() is None:
+                    raise AgentError("agent closed its output stream")
+            except queue.Empty:
+                break
+        self._msg_id += 1
+        self._send(self.dialect.prompt_msg(text, self._msg_id))
+        deadline = time.monotonic() + timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise AgentError(f"agent turn timed out after {timeout_s}s")
+            try:
+                event = self._events.get(timeout=min(remaining, 0.5))
+            except queue.Empty:
+                if self.process.poll() is not None:
+                    raise AgentError(
+                        f"agent exited mid-turn (rc={self.process.returncode})"
+                    ) from None
+                continue
+            if event is None:  # stdout closed
+                raise AgentError("agent closed its output stream mid-turn")
+            if event.kind == "error":
+                raise AgentError(event.text or "agent error")
+            if event.kind == "done":
+                return
+            if event.kind == "chunk":
+                yield event
+
+    def chat(self, text: str, timeout_s: float = 120.0) -> str:
+        """Convenience: one turn, concatenated."""
+        return "".join(e.text for e in self.prompt(text, timeout_s=timeout_s))
+
+    def close(self) -> None:
+        if self.process is None:
+            return
+        if self.process.stdin:
+            try:
+                self.process.stdin.close()
+            except OSError:
+                pass
+        if self.process.poll() is None:
+            self.process.terminate()
+            try:
+                self.process.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.process.kill()
+                self.process.wait(timeout=5)  # reap: no zombie after kill
+        else:
+            self.process.wait()  # already exited: reap it
+
+    def __enter__(self) -> "AgentRuntime":
+        self.start()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # -- internals -----------------------------------------------------------
+
+    def _send(self, msg: dict) -> None:
+        assert self.process is not None and self.process.stdin is not None
+        try:
+            self.process.stdin.write(json.dumps(msg) + "\n")
+            self.process.stdin.flush()
+        except (OSError, ValueError) as e:
+            raise AgentError(f"agent stdin write failed: {e}") from e
+
+    def _read_stdout(self) -> None:
+        assert self.process is not None and self.process.stdout is not None
+        try:
+            for line in self.process.stdout:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    self._events.put(AgentEvent("log", text=line))
+                    continue
+                if not isinstance(msg, dict):
+                    # scalars / JSON-RPC batches: log, never crash the reader
+                    self._events.put(AgentEvent("log", text=line))
+                    continue
+                try:
+                    event = self.dialect.parse(msg)
+                except Exception as e:  # noqa: BLE001 — a bad message must not kill the reader
+                    event = AgentEvent("error", text=f"unparseable agent message: {e}", raw=msg)
+                if event is not None:
+                    self._events.put(event)
+        finally:
+            # sentinel ALWAYS lands, or prompt() would block to full timeout
+            self._events.put(None)

@@ -1,0 +1,156 @@
+"""`prime lab mcp` — a minimal stdio MCP server forwarding Lab tools.
+
+Reference: prime_cli/lab_mcp.py:19-23 (stdio server bridging Lab widget
+tools). Speaks newline-delimited JSON-RPC 2.0: ``initialize``,
+``tools/list``, ``tools/call``. Tools are read-only views over the same data
+layer the shell uses, plus the hygiene preflight — an agent connected over
+MCP sees exactly what the TUI shows.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Callable, TextIO
+
+PROTOCOL_VERSION = "2024-11-05"
+SERVER_INFO = {"name": "prime-lab", "version": "1.0"}
+
+
+def _tool(name: str, description: str, properties: dict | None = None) -> dict:
+    return {
+        "name": name,
+        "description": description,
+        "inputSchema": {
+            "type": "object",
+            "properties": properties or {},
+        },
+    }
+
+
+def build_tools(workspace: str = ".") -> dict[str, tuple[dict, Callable[[dict], Any]]]:
+    """name -> (tool schema, handler(arguments) -> JSON-able result)."""
+    from prime_tpu.lab.data import LabDataSource
+
+    def snapshot(args: dict) -> Any:
+        source = LabDataSource(workspace)
+        snap = source.refresh() if args.get("refresh") else source.snapshot()
+        return {
+            "localEvalRuns": snap.local_eval_runs,
+            "installedEnvs": snap.installed_envs,
+            "platform": snap.platform,
+            "freshness": snap.freshness,
+            "errors": snap.errors,
+        }
+
+    def eval_runs(args: dict) -> Any:
+        return LabDataSource(workspace).scan_local_eval_runs()
+
+    def launch_cards(args: dict) -> Any:
+        from prime_tpu.lab.tui.launch import scan_cards
+
+        return [
+            {"name": c.name, "kind": c.kind, "file": c.path.name}
+            for c in scan_cards(workspace)
+        ]
+
+    def hygiene(args: dict) -> Any:
+        from prime_tpu.lab.hygiene import check_workspace
+
+        return [f.as_dict() for f in check_workspace(workspace)]
+
+    return {
+        "lab_snapshot": (
+            _tool(
+                "lab_snapshot",
+                "Full Lab snapshot: local eval runs, installed envs, platform sections.",
+                {"refresh": {"type": "boolean", "description": "Hydrate from the platform first."}},
+            ),
+            snapshot,
+        ),
+        "lab_eval_runs": (
+            _tool("lab_eval_runs", "Local eval run directories with metrics."),
+            eval_runs,
+        ),
+        "lab_launch_cards": (
+            _tool("lab_launch_cards", "Launch config cards under .prime-lab/launch/."),
+            launch_cards,
+        ),
+        "lab_hygiene": (
+            _tool("lab_hygiene", "Workspace hygiene findings (secrets, outputs, large files)."),
+            hygiene,
+        ),
+    }
+
+
+def handle_request(request: dict, tools: dict) -> dict | None:
+    """One JSON-RPC request -> response dict (None for notifications)."""
+    request_id = request.get("id")
+    method = request.get("method")
+
+    def ok(result: Any) -> dict:
+        return {"jsonrpc": "2.0", "id": request_id, "result": result}
+
+    def err(code: int, message: str) -> dict:
+        return {"jsonrpc": "2.0", "id": request_id, "error": {"code": code, "message": message}}
+
+    if method == "initialize":
+        return ok(
+            {
+                "protocolVersion": PROTOCOL_VERSION,
+                "serverInfo": SERVER_INFO,
+                "capabilities": {"tools": {}},
+            }
+        )
+    if method == "notifications/initialized":
+        return None
+    if method == "tools/list":
+        return ok({"tools": [schema for schema, _ in tools.values()]})
+    if method == "tools/call":
+        params = request.get("params")
+        if not isinstance(params, dict):
+            return err(-32602, "params must be an object")
+        name = params.get("name")
+        if name not in tools:
+            return err(-32602, f"unknown tool {name!r}")
+        _, handler = tools[name]
+        arguments = params.get("arguments")
+        try:
+            result = handler(arguments if isinstance(arguments, dict) else {})
+            text = json.dumps(result)  # serialization failures are tool errors too
+        except Exception as e:  # noqa: BLE001 — tool errors go back over the wire
+            return ok({"content": [{"type": "text", "text": f"error: {e}"}], "isError": True})
+        return ok({"content": [{"type": "text", "text": text}]})
+    if request_id is None:
+        return None  # unknown notification: ignore
+    return err(-32601, f"method {method!r} not found")
+
+
+def serve(workspace: str = ".", stdin: TextIO | None = None, stdout: TextIO | None = None) -> None:
+    """Blocking stdio loop: one JSON-RPC message per line."""
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    tools = build_tools(workspace)
+    for line in stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            request = json.loads(line)
+        except json.JSONDecodeError:
+            response: dict | None = {
+                "jsonrpc": "2.0", "id": None,
+                "error": {"code": -32700, "message": "parse error"},
+            }
+        else:
+            if isinstance(request, dict):
+                response = handle_request(request, tools)
+            else:
+                # scalars and JSON-RPC batch arrays: reject, don't crash
+                response = {
+                    "jsonrpc": "2.0", "id": None,
+                    "error": {"code": -32600, "message": "request must be an object"},
+                }
+        if response is not None:
+            stdout.write(json.dumps(response) + "\n")
+            stdout.flush()

@@ -1,0 +1,247 @@
+"""Agent runtime (subprocess stdio chat) + MCP server protocol tests."""
+
+import io
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from prime_tpu.lab.agents import AgentError, AgentRuntime
+from prime_tpu.lab.mcp import build_tools, handle_request
+
+# -- scripted fake agents ------------------------------------------------------
+
+SIMPLE_AGENT = textwrap.dedent(
+    """
+    import json, sys
+    for line in sys.stdin:
+        msg = json.loads(line)
+        if msg.get("type") == "prompt":
+            for word in msg["text"].split():
+                print(json.dumps({"type": "chunk", "text": word.upper() + " "}), flush=True)
+            print(json.dumps({"type": "done", "id": msg["id"]}), flush=True)
+    """
+)
+
+ACP_AGENT = textwrap.dedent(
+    """
+    import json, sys
+    def send(obj):
+        print(json.dumps(obj), flush=True)
+    for line in sys.stdin:
+        msg = json.loads(line)
+        method = msg.get("method")
+        if method == "initialize":
+            send({"jsonrpc": "2.0", "id": msg["id"], "result": {"protocolVersion": 1}})
+        elif method == "session/new":
+            send({"jsonrpc": "2.0", "id": msg["id"], "result": {"sessionId": "sess-1"}})
+        elif method == "session/prompt":
+            text = msg["params"]["prompt"][0]["text"]
+            assert msg["params"]["sessionId"] == "sess-1"
+            for chunk in (text[:3], text[3:]):
+                send({"jsonrpc": "2.0", "method": "session/update",
+                      "params": {"update": {"sessionUpdate": "agent_message_chunk",
+                                             "content": {"type": "text", "text": chunk}}}})
+            send({"jsonrpc": "2.0", "id": msg["id"], "result": {"stopReason": "end_turn"}})
+    """
+)
+
+CRASHING_AGENT = "import sys; sys.exit(3)"
+
+
+def _agent(script: str, dialect: str) -> AgentRuntime:
+    return AgentRuntime([sys.executable, "-u", "-c", script], dialect=dialect)
+
+
+def test_simple_dialect_chat():
+    with _agent(SIMPLE_AGENT, "simple") as agent:
+        assert agent.chat("hello tpu world", timeout_s=20) == "HELLO TPU WORLD "
+        # second turn on the same process
+        assert agent.chat("again", timeout_s=20) == "AGAIN "
+
+
+def test_acp_dialect_handshake_and_chat():
+    with _agent(ACP_AGENT, "acp") as agent:
+        assert agent.dialect.session_id == "sess-1"
+        assert agent.chat("ping-pong", timeout_s=20) == "ping-pong"
+
+
+def test_agent_crash_is_detected():
+    agent = _agent(CRASHING_AGENT, "simple")
+    agent.start()
+    with pytest.raises(AgentError, match="exited|closed"):
+        agent.chat("anything", timeout_s=10)
+    agent.close()
+
+
+def test_unknown_dialect_rejected():
+    with pytest.raises(AgentError, match="unknown dialect"):
+        AgentRuntime(["true"], dialect="letta-v9")
+
+
+def test_agent_turn_timeout():
+    hang = "import sys\nfor line in sys.stdin: pass"
+    agent = AgentRuntime([sys.executable, "-u", "-c", hang], dialect="simple")
+    agent.start()
+    with pytest.raises(AgentError, match="timed out"):
+        agent.chat("no reply", timeout_s=1.0)
+    agent.close()
+
+
+# -- MCP server ---------------------------------------------------------------
+
+
+def _rpc(method, params=None, request_id=1):
+    msg = {"jsonrpc": "2.0", "id": request_id, "method": method}
+    if params is not None:
+        msg["params"] = params
+    return msg
+
+
+def test_mcp_initialize_and_tools_list(tmp_path):
+    tools = build_tools(str(tmp_path))
+    response = handle_request(_rpc("initialize"), tools)
+    assert response["result"]["serverInfo"]["name"] == "prime-lab"
+    listing = handle_request(_rpc("tools/list"), tools)
+    names = {t["name"] for t in listing["result"]["tools"]}
+    assert {"lab_snapshot", "lab_eval_runs", "lab_launch_cards", "lab_hygiene"} <= names
+
+
+def test_mcp_tool_call_eval_runs(tmp_path):
+    run_dir = tmp_path / "outputs" / "evals" / "arith--m" / "r1"
+    run_dir.mkdir(parents=True)
+    (run_dir / "metadata.json").write_text(json.dumps({"metrics": {"accuracy": 1.0}}))
+    tools = build_tools(str(tmp_path))
+    response = handle_request(
+        _rpc("tools/call", {"name": "lab_eval_runs", "arguments": {}}), tools
+    )
+    rows = json.loads(response["result"]["content"][0]["text"])
+    assert rows[0]["env"] == "arith" and rows[0]["accuracy"] == 1.0
+
+
+def test_mcp_unknown_tool_and_method(tmp_path):
+    tools = build_tools(str(tmp_path))
+    bad_tool = handle_request(_rpc("tools/call", {"name": "nope"}), tools)
+    assert bad_tool["error"]["code"] == -32602
+    bad_method = handle_request(_rpc("frobnicate"), tools)
+    assert bad_method["error"]["code"] == -32601
+    assert handle_request({"jsonrpc": "2.0", "method": "notifications/initialized"}, tools) is None
+
+
+def test_mcp_tool_error_is_in_band(tmp_path, monkeypatch):
+    tools = build_tools(str(tmp_path / "missing-dir"))
+    response = handle_request(
+        _rpc("tools/call", {"name": "lab_hygiene", "arguments": {}}), tools
+    )
+    payload = response["result"]
+    assert payload.get("isError") is True
+    assert "error" in payload["content"][0]["text"]
+
+
+def test_mcp_stdio_end_to_end(tmp_path):
+    """Spawn the real `prime lab mcp` process and speak the protocol."""
+    messages = "\n".join(
+        json.dumps(m)
+        for m in [
+            _rpc("initialize", request_id=1),
+            {"jsonrpc": "2.0", "method": "notifications/initialized"},
+            _rpc("tools/list", request_id=2),
+            _rpc("tools/call", {"name": "lab_launch_cards", "arguments": {}}, request_id=3),
+        ]
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "prime_tpu.commands.main", "lab", "mcp", "--dir", str(tmp_path)],
+        input=messages + "\n",
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd="/root/repo",
+    )
+    responses = [json.loads(line) for line in proc.stdout.splitlines() if line.strip()]
+    assert len(responses) == 3  # notification produced no response
+    assert responses[0]["result"]["protocolVersion"]
+    assert json.loads(responses[2]["result"]["content"][0]["text"]) == []
+
+
+# -- CLI agent turn -----------------------------------------------------------
+
+
+def test_lab_agent_cli_one_turn(tmp_path):
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.main import cli
+
+    script = tmp_path / "agent.py"
+    script.write_text(SIMPLE_AGENT)
+    result = CliRunner().invoke(
+        cli,
+        ["lab", "agent", "hello world", "--dialect", "simple",
+         "--command", f"{sys.executable} -u {script}"],
+    )
+    assert result.exit_code == 0, result.output
+    assert "HELLO WORLD" in result.output
+
+
+def test_agent_nonobject_json_does_not_kill_reader():
+    weird = textwrap.dedent(
+        """
+        import json, sys
+        print("null", flush=True)
+        print("[1,2,3]", flush=True)
+        for line in sys.stdin:
+            msg = json.loads(line)
+            if msg.get("type") == "prompt":
+                print(json.dumps({"type": "chunk", "text": "ok"}), flush=True)
+                print(json.dumps({"type": "done"}), flush=True)
+        """
+    )
+    with AgentRuntime([sys.executable, "-u", "-c", weird], dialect="simple") as agent:
+        assert agent.chat("x", timeout_s=20) == "ok"
+
+
+def test_stale_turn_events_are_drained():
+    slow = textwrap.dedent(
+        """
+        import json, sys, time
+        for line in sys.stdin:
+            msg = json.loads(line)
+            if msg.get("type") != "prompt":
+                continue
+            text = msg["text"]
+            if text == "warmup":
+                print(json.dumps({"type": "chunk", "text": "ok"}), flush=True)
+            elif text == "turn1":
+                time.sleep(2)  # answer turn 1 late
+                print(json.dumps({"type": "chunk", "text": "STALE"}), flush=True)
+            else:
+                print(json.dumps({"type": "chunk", "text": "fresh"}), flush=True)
+            print(json.dumps({"type": "done"}), flush=True)
+        """
+    )
+    agent = AgentRuntime([sys.executable, "-u", "-c", slow], dialect="simple")
+    agent.start()
+    assert agent.chat("warmup", timeout_s=30) == "ok"  # agent fully up
+    with pytest.raises(AgentError, match="timed out"):
+        agent.chat("turn1", timeout_s=0.5)
+    import time as _time
+
+    _time.sleep(2.5)  # let the stale answer land in the queue
+    assert agent.chat("turn2", timeout_s=20) == "fresh"
+    agent.close()
+
+
+def test_mcp_rejects_nonobject_requests(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "prime_tpu.commands.main", "lab", "mcp", "--dir", str(tmp_path)],
+        input='[1,2]\n"str"\n' + json.dumps(_rpc("tools/list", request_id=9)) + "\n",
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd="/root/repo",
+    )
+    responses = [json.loads(line) for line in proc.stdout.splitlines() if line.strip()]
+    assert responses[0]["error"]["code"] == -32600
+    assert responses[1]["error"]["code"] == -32600
+    assert "tools" in responses[2]["result"]  # server survived bad input
