@@ -21,17 +21,28 @@ class ModelConfig:
     rope_theta: float = 500000.0
     rms_eps: float = 1e-5
     tie_embeddings: bool = False
+    # mixture-of-experts (0 experts = dense MLP; Mixtral-style top-k routing)
+    n_experts: int = 0
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
     @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+    @property
     def param_count(self) -> int:
         embed = self.vocab_size * self.d_model
         head = 0 if self.tie_embeddings else self.d_model * self.vocab_size
         attn = self.d_model * self.head_dim * (2 * self.n_heads + 2 * self.n_kv_heads)
-        mlp = 3 * self.d_model * self.d_ff
+        if self.is_moe:
+            mlp = self.n_experts * 3 * self.d_model * self.d_ff + self.d_model * self.n_experts
+        else:
+            mlp = 3 * self.d_model * self.d_ff
         norms = 2 * self.d_model
         per_layer = attn + mlp + norms
         return embed + head + self.n_layers * per_layer + self.d_model
@@ -79,6 +90,19 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         d_ff=8192,
         tie_embeddings=True,
     ),
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b",
+        vocab_size=32000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        max_seq_len=32768,
+        rope_theta=1000000.0,
+        n_experts=8,
+        experts_per_token=2,
+    ),
     # small configs for tests / benches that still exercise every code path
     "debug-128m": ModelConfig(
         name="debug-128m",
@@ -99,6 +123,19 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         n_kv_heads=2,
         d_ff=256,
         max_seq_len=512,
+    ),
+    "tiny-moe": ModelConfig(
+        name="tiny-moe",
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=256,
+        max_seq_len=512,
+        n_experts=4,
+        experts_per_token=2,
+        capacity_factor=2.0,
     ),
 }
 

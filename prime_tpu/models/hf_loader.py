@@ -43,6 +43,9 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
         rms_eps=getattr(hf_config, "rms_norm_eps", 1e-5),
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        # Mixtral-style sparse MoE
+        n_experts=getattr(hf_config, "num_local_experts", 0) or 0,
+        experts_per_token=getattr(hf_config, "num_experts_per_tok", 2) or 2,
     )
 
 
@@ -85,6 +88,40 @@ def params_from_state_dict(
             mats.append(w.T if transpose else w)
         return jnp.asarray(np.stack(mats), dtype=dtype)
 
+    if config.is_moe:
+        # Mixtral layout: block_sparse_moe.gate (router) + experts.M.{w1,w2,w3}
+        # w1 = gate_proj, w3 = up_proj (both (F, D)); w2 = down_proj ((D, F))
+        def stacked_experts(template: str) -> jnp.ndarray:
+            layers_out = []
+            for layer in range(config.n_layers):
+                experts = [
+                    get(template.format(layer, expert)).T
+                    for expert in range(config.n_experts)
+                ]
+                layers_out.append(np.stack(experts))
+            return jnp.asarray(np.stack(layers_out), dtype=dtype)  # (L, E, in, out)
+
+        mlp_weights = {
+            "router": jnp.asarray(
+                np.stack(
+                    [
+                        get(f"layers.{layer}.block_sparse_moe.gate.weight").T
+                        for layer in range(config.n_layers)
+                    ]
+                ),
+                dtype=jnp.float32,  # router decisions stay fp32
+            ),
+            "w_gate": stacked_experts("layers.{}.block_sparse_moe.experts.{}.w1.weight"),
+            "w_up": stacked_experts("layers.{}.block_sparse_moe.experts.{}.w3.weight"),
+            "w_down": stacked_experts("layers.{}.block_sparse_moe.experts.{}.w2.weight"),
+        }
+    else:
+        mlp_weights = {
+            "w_gate": stacked("layers.{}.mlp.gate_proj.weight", transpose=True),
+            "w_up": stacked("layers.{}.mlp.up_proj.weight", transpose=True),
+            "w_down": stacked("layers.{}.mlp.down_proj.weight", transpose=True),
+        }
+
     params: dict[str, Any] = {
         "embed": jnp.asarray(get("embed_tokens.weight"), dtype=dtype),
         "layers": {
@@ -94,9 +131,7 @@ def params_from_state_dict(
             "wv": stacked("layers.{}.self_attn.v_proj.weight", transpose=True),
             "wo": stacked("layers.{}.self_attn.o_proj.weight", transpose=True),
             "mlp_norm": stacked("layers.{}.post_attention_layernorm.weight", transpose=False),
-            "w_gate": stacked("layers.{}.mlp.gate_proj.weight", transpose=True),
-            "w_up": stacked("layers.{}.mlp.up_proj.weight", transpose=True),
-            "w_down": stacked("layers.{}.mlp.down_proj.weight", transpose=True),
+            **mlp_weights,
         },
         "final_norm": jnp.asarray(get("norm.weight"), dtype=dtype),
     }

@@ -67,6 +67,22 @@ def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Para
     def dense(key, shape, fan_in):
         return (jax.random.normal(key, shape, dtype=jnp.float32) * (fan_in**-0.5)).astype(dtype)
 
+    if config.is_moe:
+        experts = config.n_experts
+        mlp_weights = {
+            # router stays fp32: routing decisions are precision-sensitive
+            "router": dense(keys[9], (layers, d, experts), d).astype(jnp.float32),
+            "w_gate": dense(keys[5], (layers, experts, d, ff), d),
+            "w_up": dense(keys[6], (layers, experts, d, ff), d),
+            "w_down": dense(keys[7], (layers, experts, ff, d), ff),
+        }
+    else:
+        mlp_weights = {
+            "w_gate": dense(keys[5], (layers, d, ff), d),
+            "w_up": dense(keys[6], (layers, d, ff), d),
+            "w_down": dense(keys[7], (layers, ff, d), ff),
+        }
+
     params: Params = {
         "embed": dense(keys[0], (config.vocab_size, d), d),
         "layers": {
@@ -76,9 +92,7 @@ def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Para
             "wv": dense(keys[3], (layers, d, kh * hd), d),
             "wo": dense(keys[4], (layers, h * hd, d), h * hd),
             "mlp_norm": jnp.ones((layers, d), dtype=dtype),
-            "w_gate": dense(keys[5], (layers, d, ff), d),
-            "w_up": dense(keys[6], (layers, d, ff), d),
-            "w_down": dense(keys[7], (layers, ff, d), ff),
+            **mlp_weights,
         },
         "final_norm": jnp.ones((d,), dtype=dtype),
     }
@@ -144,11 +158,25 @@ def _attention_block(
     return x + attn @ lp["wo"], new_k_cache, new_v_cache
 
 
-def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> jnp.ndarray:
+def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense or sparse-MoE feed-forward. Returns (residual output, aux loss)."""
     normed = rms_norm(x, lp["mlp_norm"], config.rms_eps)
+    if config.is_moe:
+        from prime_tpu.ops.moe import moe_mlp
+
+        y, aux = moe_mlp(
+            normed,
+            lp["router"],
+            lp["w_gate"],
+            lp["w_up"],
+            lp["w_down"],
+            k=config.experts_per_token,
+            capacity_factor=config.capacity_factor,
+        )
+        return x + y, aux
     gate = jax.nn.silu(normed @ lp["w_gate"])
     up = normed @ lp["w_up"]
-    return x + (gate * up) @ lp["w_down"]
+    return x + (gate * up) @ lp["w_down"], jnp.zeros((), jnp.float32)
 
 
 def forward(
@@ -159,8 +187,10 @@ def forward(
     cache: KVCache | None = None,
     decode: bool = False,
     attn_impl: str = "auto",
-) -> tuple[jnp.ndarray, KVCache | None]:
-    """Run the transformer. Returns (logits (B, S, V) fp32, updated cache).
+    return_aux: bool = False,
+):
+    """Run the transformer. Returns (logits (B, S, V) fp32, updated cache),
+    plus the summed MoE load-balance aux loss when ``return_aux``.
 
     - training:     cache=None, decode=False
     - prefill:      cache=init_cache(...), decode=False
@@ -176,32 +206,40 @@ def forward(
 
     layer_params = params["layers"]
     cache_lengths = cache.lengths if cache is not None else None
+    aux0 = jnp.zeros((), jnp.float32)
 
-    def layer_fn(x, scanned):
+    def layer_fn(carry, scanned):
+        x, aux_sum = carry
         lp, k_c, v_c = scanned
         x, new_k, new_v = _attention_block(
             x, lp, positions, rope_tables, config,
             k_c, v_c, cache_lengths, decode, attn_impl,
         )
-        x = _mlp_block(x, lp, config)
-        return x, (new_k, new_v)
+        x, aux = _mlp_block(x, lp, config)
+        return (x, aux_sum + aux), (new_k, new_v)
 
     if cache is not None:
-        x, (new_k, new_v) = jax.lax.scan(layer_fn, x, (layer_params, cache.k, cache.v))
+        (x, aux_total), (new_k, new_v) = jax.lax.scan(
+            layer_fn, (x, aux0), (layer_params, cache.k, cache.v)
+        )
         new_lengths = cache.lengths + (1 if decode else seq)
         new_cache = KVCache(k=new_k, v=new_v, lengths=new_lengths)
     else:
 
-        def layer_fn_nocache(x, lp):
+        def layer_fn_nocache(carry, lp):
+            x, aux_sum = carry
             x, _, _ = _attention_block(
                 x, lp, positions, rope_tables, config, None, None, None, False, attn_impl
             )
-            return _mlp_block(x, lp, config), None
+            x, aux = _mlp_block(x, lp, config)
+            return (x, aux_sum + aux), None
 
-        x, _ = jax.lax.scan(layer_fn_nocache, x, layer_params)
+        (x, aux_total), _ = jax.lax.scan(layer_fn_nocache, (x, aux0), layer_params)
         new_cache = None
 
     x = rms_norm(x, params["final_norm"], config.rms_eps)
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
     logits = (x @ head).astype(jnp.float32)
+    if return_aux:
+        return logits, new_cache, aux_total
     return logits, new_cache

@@ -1,0 +1,107 @@
+"""Mixture-of-experts MLP: einsum dispatch, top-k routing, capacity drop.
+
+TPU-first MoE (GShard/Switch lineage): no scatters, no ragged shapes — tokens
+are dispatched to experts through dense one-hot einsums so the whole block is
+three MXU matmuls per expert plus two dispatch einsums, and GSPMD shards the
+expert dimension over an ``ep`` mesh axis (the dispatch einsum's token
+contraction becomes the all-to-all, inserted by XLA, riding ICI).
+
+Capacity: each expert processes at most C = ceil(k * T / E * capacity_factor)
+tokens; overflow tokens are dropped (their combine weight is zero, the
+residual stream carries them unchanged) — the standard TPU trade for static
+shapes. The router also returns the Switch load-balancing auxiliary loss.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(tokens: int, n_experts: int, k: int, capacity_factor: float) -> int:
+    capacity = int(tokens * k * capacity_factor / n_experts)
+    # round up to a multiple of 8 for clean sublane tiling; min 8
+    return max(8, ((capacity + 7) // 8) * 8)
+
+
+def top_k_routing(
+    router_logits: jnp.ndarray,  # (T, E) fp32
+    k: int,
+    capacity: int,
+):
+    """Returns (dispatch (T, E, C), combine (T, E, C), aux_loss scalar).
+
+    dispatch is a one-hot routing tensor; combine carries the (renormalized)
+    router probability of each token's chosen experts at its capacity slot.
+    """
+    tokens, n_experts = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)  # (T, E)
+
+    # iterative top-k (k is 1 or 2 in practice; unrolled, fully static)
+    expert_masks = []
+    gate_values = []
+    masked = probs
+    for _ in range(k):
+        choice = jnp.argmax(masked, axis=-1)                       # (T,)
+        one_hot = jax.nn.one_hot(choice, n_experts, dtype=probs.dtype)
+        expert_masks.append(one_hot)
+        gate_values.append(jnp.sum(probs * one_hot, axis=-1))      # (T,)
+        masked = masked * (1.0 - one_hot)
+
+    # renormalize the chosen gates so they sum to 1 per token (Mixtral style)
+    gate_stack = jnp.stack(gate_values, axis=-1)                   # (T, k)
+    gate_stack = gate_stack / jnp.maximum(jnp.sum(gate_stack, axis=-1, keepdims=True), 1e-9)
+
+    # capacity positions: for each expert, tokens are served in order; a
+    # token's slot is its cumulative index among tokens routed to that expert
+    dispatch = jnp.zeros((tokens, n_experts, capacity), dtype=probs.dtype)
+    combine = jnp.zeros((tokens, n_experts, capacity), dtype=probs.dtype)
+    for choice_index in range(k):
+        mask = expert_masks[choice_index]                          # (T, E)
+        # position within the expert, counting earlier-priority choices too
+        prior = sum(expert_masks[:choice_index]) if choice_index else 0.0
+        position = jnp.cumsum(mask, axis=0) - 1 + (
+            jnp.sum(prior, axis=0, keepdims=True) if choice_index else 0.0
+        )
+        in_capacity = (position < capacity) & (mask > 0)
+        slot = jax.nn.one_hot(position.astype(jnp.int32), capacity, dtype=probs.dtype)
+        routed = jnp.where(in_capacity[..., None], slot * mask[..., None], 0.0)
+        dispatch = dispatch + routed
+        combine = combine + routed * gate_stack[:, choice_index][:, None, None]
+
+    # Switch aux loss: E * Σ_e (token fraction to e) * (mean router prob of e)
+    token_fraction = jnp.mean(expert_masks[0], axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux_loss = n_experts * jnp.sum(token_fraction * mean_prob)
+    return dispatch, combine, aux_loss
+
+
+def moe_mlp(
+    x: jnp.ndarray,              # (B, S, D)
+    router_w: jnp.ndarray,       # (D, E)
+    w_gate: jnp.ndarray,         # (E, D, F)
+    w_up: jnp.ndarray,           # (E, D, F)
+    w_down: jnp.ndarray,         # (E, F, D)
+    k: int,
+    capacity_factor: float,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Sparse MoE feed-forward. Returns (output (B, S, D), aux_loss)."""
+    batch, seq, d_model = x.shape
+    tokens = batch * seq
+    n_experts = router_w.shape[-1]
+    x_flat = x.reshape(tokens, d_model)
+
+    router_logits = (x_flat.astype(jnp.float32) @ router_w.astype(jnp.float32))
+    capacity = expert_capacity(tokens, n_experts, k, capacity_factor)
+    dispatch, combine, aux_loss = top_k_routing(router_logits, k, capacity)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+
+    # dispatch: (T,E,C)·(T,D) -> (E,C,D); under an ep-sharded expert dim GSPMD
+    # turns the token contraction into the all-to-all over ICI
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x_flat)
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w_gate))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, w_up)
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, w_down)
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return y.reshape(batch, seq, d_model), aux_loss

@@ -47,13 +47,16 @@ def mesh_for_slice(
     slice_name: str | SliceSpec,
     tensor_parallel: int | None = None,
     fsdp: int | None = None,
+    expert_parallel: int | None = None,
     devices=None,
 ):
-    """Derive a (dp, fsdp, tp) mesh for a TPU slice.
+    """Derive a (dp, fsdp[, ep], tp) mesh for a TPU slice.
 
     Default policy: tp = min(chips, 8 aligned to the slice's minor ICI dim),
-    fsdp = remaining chips, dp = 1. Multi-slice DCN data parallelism belongs on
-    an outer ``dp`` axis (see prime_tpu.parallel.distributed).
+    fsdp = remaining chips, dp = 1. ``expert_parallel`` carves an ep axis out
+    of the fsdp factor for MoE models (tp stays innermost on the fastest ICI
+    dim). Multi-slice DCN data parallelism belongs on an outer ``dp`` axis
+    (see prime_tpu.parallel.distributed).
     """
     import jax
 
@@ -65,7 +68,26 @@ def mesh_for_slice(
         tensor_parallel = min(8, minor if minor > 1 else 1, n)
         while n % tensor_parallel:
             tensor_parallel //= 2
+    remaining = n // tensor_parallel
+    if expert_parallel:
+        if remaining % expert_parallel:
+            raise ValueError(
+                f"expert_parallel={expert_parallel} must divide the non-tp factor {remaining}"
+            )
+        if fsdp is None:
+            fsdp = remaining // expert_parallel
+        if remaining % (fsdp * expert_parallel):
+            raise ValueError(
+                f"fsdp={fsdp} * expert_parallel={expert_parallel} must divide "
+                f"the non-tp factor {remaining}"
+            )
+        dp = remaining // (fsdp * expert_parallel)
+        return make_mesh(
+            {"dp": dp, "fsdp": fsdp, "ep": expert_parallel, "tp": tensor_parallel}, devices
+        )
     if fsdp is None:
-        fsdp = n // tensor_parallel
+        fsdp = remaining
+    if remaining % fsdp:
+        raise ValueError(f"fsdp={fsdp} must divide the non-tp factor {remaining}")
     dp = n // (fsdp * tensor_parallel)
     return make_mesh({"dp": dp, "fsdp": fsdp, "tp": tensor_parallel}, devices)

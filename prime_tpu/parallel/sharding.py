@@ -25,6 +25,20 @@ from prime_tpu.models.config import ModelConfig
 
 
 def param_specs(config: ModelConfig) -> dict[str, Any]:
+    if config.is_moe:
+        # experts ride the ep axis; within an expert the same megatron layout
+        mlp_specs = {
+            "router": P(None, None, None),  # tiny + fp32: replicate
+            "w_gate": P(None, "ep", "fsdp", "tp"),
+            "w_up": P(None, "ep", "fsdp", "tp"),
+            "w_down": P(None, "ep", "tp", "fsdp"),
+        }
+    else:
+        mlp_specs = {
+            "w_gate": P(None, "fsdp", "tp"),
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),
+        }
     specs: dict[str, Any] = {
         "embed": P("tp", "fsdp"),              # (V, D) vocab on tp, d_model on fsdp
         "layers": {
@@ -34,9 +48,7 @@ def param_specs(config: ModelConfig) -> dict[str, Any]:
             "wv": P(None, "fsdp", "tp"),
             "wo": P(None, "tp", "fsdp"),
             "mlp_norm": P(None, None),
-            "w_gate": P(None, "fsdp", "tp"),
-            "w_up": P(None, "fsdp", "tp"),
-            "w_down": P(None, "tp", "fsdp"),
+            **mlp_specs,
         },
         "final_norm": P(None),
     }
@@ -67,9 +79,25 @@ def logits_spec() -> P:
     return P(("dp", "fsdp"), None, "tp")
 
 
+def prune_spec(spec: P, mesh) -> P:
+    """Drop axis names the mesh doesn't have (e.g. 'ep' on a (dp,fsdp,tp)
+    serving mesh): those dims fall back to replicated instead of erroring."""
+    axes = set(mesh.axis_names)
+
+    def keep(element):
+        if element is None:
+            return None
+        if isinstance(element, tuple):
+            kept = tuple(a for a in element if a in axes)
+            return kept if len(kept) > 1 else (kept[0] if kept else None)
+        return element if element in axes else None
+
+    return P(*(keep(element) for element in spec))
+
+
 def param_shardings(mesh, config: ModelConfig):
     return jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec),
+        lambda spec: NamedSharding(mesh, prune_spec(spec, mesh)),
         param_specs(config),
         is_leaf=lambda x: isinstance(x, P),
     )

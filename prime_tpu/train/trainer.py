@@ -93,6 +93,7 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     attn_impl: str = "auto",
     accum_steps: int = 1,
+    aux_weight: float = 0.01,   # MoE load-balance loss weight (Switch default)
 ):
     """Build the jitted train step. Shardings propagate from the placed
     inputs (shard_train_state / shard_batch) — the jit is mesh-agnostic.
@@ -105,6 +106,11 @@ def make_train_step(
     """
 
     def loss_fn(params, tokens, targets, mask):
+        if config.is_moe:
+            logits, _, aux = forward(
+                params, tokens, config, cache=None, attn_impl=attn_impl, return_aux=True
+            )
+            return cross_entropy_loss(logits, targets, mask) + aux_weight * aux
         logits, _ = forward(params, tokens, config, cache=None, attn_impl=attn_impl)
         return cross_entropy_loss(logits, targets, mask)
 
