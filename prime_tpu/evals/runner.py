@@ -114,20 +114,13 @@ class JaxGenerator:
             if self.config.capacity_factor < no_drop:
                 self.config = self.config.scaled(capacity_factor=no_drop)
         if mesh is None and slice_name is not None:
-            import math
-
             from prime_tpu.parallel.mesh import mesh_for_slice
 
-            expert_parallel = None
-            if self.config.is_moe:
-                # carve an ep axis out of the data factor: gcd of the non-tp
-                # device count and the expert count
-                probe = mesh_for_slice(slice_name, tensor_parallel=tensor_parallel)
-                free = probe.shape.get("dp", 1) * probe.shape.get("fsdp", 1)
-                ep = math.gcd(free, self.config.n_experts)
-                expert_parallel = ep if ep > 1 else None
             mesh = mesh_for_slice(
-                slice_name, tensor_parallel=tensor_parallel, expert_parallel=expert_parallel
+                slice_name,
+                tensor_parallel=tensor_parallel,
+                expert_parallel="auto" if self.config.is_moe else None,
+                n_experts=self.config.n_experts or None,
             )
         self.mesh = mesh
         self._data_size = 1

@@ -28,11 +28,14 @@ def top_k_routing(
     router_logits: jnp.ndarray,  # (T, E) fp32
     k: int,
     capacity: int,
+    valid: jnp.ndarray | None = None,  # (T,) 1.0 for real tokens
 ):
     """Returns (dispatch (T, E, C), combine (T, E, C), aux_loss scalar).
 
     dispatch is a one-hot routing tensor; combine carries the (renormalized)
     router probability of each token's chosen experts at its capacity slot.
+    ``valid`` masks padding tokens out of routing entirely — they take no
+    capacity slot and contribute nothing to the aux loss statistics.
     """
     tokens, n_experts = router_logits.shape
     probs = jax.nn.softmax(router_logits, axis=-1)  # (T, E)
@@ -44,6 +47,8 @@ def top_k_routing(
     for _ in range(k):
         choice = jnp.argmax(masked, axis=-1)                       # (T,)
         one_hot = jax.nn.one_hot(choice, n_experts, dtype=probs.dtype)
+        if valid is not None:
+            one_hot = one_hot * valid[:, None]
         expert_masks.append(one_hot)
         gate_values.append(jnp.sum(probs * one_hot, axis=-1))      # (T,)
         masked = masked * (1.0 - one_hot)
@@ -70,10 +75,18 @@ def top_k_routing(
         combine = combine + routed * gate_stack[:, choice_index][:, None, None]
 
     # Switch aux loss: E * Σ_e (token fraction to e) * (mean router prob of e)
-    token_fraction = jnp.mean(expert_masks[0], axis=0)
-    mean_prob = jnp.mean(probs, axis=0)
+    denom = jnp.sum(valid) if valid is not None else float(tokens)
+    denom = jnp.maximum(denom, 1.0)
+    token_fraction = jnp.sum(expert_masks[0], axis=0) / denom
+    if valid is not None:
+        mean_prob = jnp.sum(probs * valid[:, None], axis=0) / denom
+    else:
+        mean_prob = jnp.mean(probs, axis=0)
     aux_loss = n_experts * jnp.sum(token_fraction * mean_prob)
     return dispatch, combine, aux_loss
+
+
+MOE_GROUP_SIZE = 1024  # routing group: bounds dispatch memory to O(T * g)
 
 
 def moe_mlp(
@@ -84,24 +97,46 @@ def moe_mlp(
     w_down: jnp.ndarray,         # (E, F, D)
     k: int,
     capacity_factor: float,
+    group_size: int = MOE_GROUP_SIZE,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Sparse MoE feed-forward. Returns (output (B, S, D), aux_loss)."""
+    """Sparse MoE feed-forward. Returns (output (B, S, D), aux_loss).
+
+    Tokens are routed in fixed-size GROUPS (GShard style): capacity is
+    per-group, so the (g, E, C) dispatch tensors stay O(T·g·k·cf) total
+    instead of O(T²·k·cf) — without grouping a 32k-token Mixtral batch would
+    need ~11 GB of routing tensors per layer. Trailing padding inside the
+    last group is masked out of routing entirely (takes no capacity).
+    """
     batch, seq, d_model = x.shape
     tokens = batch * seq
     n_experts = router_w.shape[-1]
     x_flat = x.reshape(tokens, d_model)
 
-    router_logits = (x_flat.astype(jnp.float32) @ router_w.astype(jnp.float32))
-    capacity = expert_capacity(tokens, n_experts, k, capacity_factor)
-    dispatch, combine, aux_loss = top_k_routing(router_logits, k, capacity)
-    dispatch = dispatch.astype(x.dtype)
+    group = min(group_size, tokens)
+    n_groups = -(-tokens // group)
+    padded = n_groups * group
+    pad = padded - tokens
+    if pad:
+        x_flat = jnp.concatenate([x_flat, jnp.zeros((pad, d_model), x.dtype)])
+    valid = (jnp.arange(padded) < tokens).astype(jnp.float32).reshape(n_groups, group)
+
+    x_groups = x_flat.reshape(n_groups, group, d_model)
+    router_logits = jnp.einsum(
+        "gtd,de->gte", x_groups.astype(jnp.float32), router_w.astype(jnp.float32)
+    )
+    capacity = expert_capacity(group, n_experts, k, capacity_factor)
+    dispatch, combine, aux_loss = jax.vmap(
+        lambda logits, v: top_k_routing(logits, k, capacity, valid=v)
+    )(router_logits, valid)
+    dispatch = dispatch.astype(x.dtype)   # (g, group, E, C)
     combine = combine.astype(x.dtype)
 
-    # dispatch: (T,E,C)·(T,D) -> (E,C,D); under an ep-sharded expert dim GSPMD
-    # turns the token contraction into the all-to-all over ICI
-    expert_in = jnp.einsum("tec,td->ecd", dispatch, x_flat)
-    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w_gate))
-    up = jnp.einsum("ecd,edf->ecf", expert_in, w_up)
-    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, w_down)
-    y = jnp.einsum("tec,ecd->td", combine, expert_out)
-    return y.reshape(batch, seq, d_model), aux_loss
+    # dispatch: (g,t,E,C)·(g,t,D) -> (g,E,C,D); under an ep-sharded expert dim
+    # GSPMD turns the token contraction into the all-to-all over ICI
+    expert_in = jnp.einsum("gtec,gtd->gecd", dispatch, x_groups)
+    gate = jax.nn.silu(jnp.einsum("gecd,edf->gecf", expert_in, w_gate))
+    up = jnp.einsum("gecd,edf->gecf", expert_in, w_up)
+    expert_out = jnp.einsum("gecf,efd->gecd", gate * up, w_down)
+    y = jnp.einsum("gtec,gecd->gtd", combine, expert_out)
+    y = y.reshape(padded, d_model)[:tokens]
+    return y.reshape(batch, seq, d_model), jnp.mean(aux_loss)

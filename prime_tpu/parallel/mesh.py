@@ -47,7 +47,8 @@ def mesh_for_slice(
     slice_name: str | SliceSpec,
     tensor_parallel: int | None = None,
     fsdp: int | None = None,
-    expert_parallel: int | None = None,
+    expert_parallel: int | str | None = None,
+    n_experts: int | None = None,
     devices=None,
 ):
     """Derive a (dp, fsdp[, ep], tp) mesh for a TPU slice.
@@ -55,10 +56,12 @@ def mesh_for_slice(
     Default policy: tp = min(chips, 8 aligned to the slice's minor ICI dim),
     fsdp = remaining chips, dp = 1. ``expert_parallel`` carves an ep axis out
     of the fsdp factor for MoE models (tp stays innermost on the fastest ICI
-    dim). Multi-slice DCN data parallelism belongs on an outer ``dp`` axis
-    (see prime_tpu.parallel.distributed).
+    dim); pass ``"auto"`` with ``n_experts`` to take gcd(non-tp factor,
+    n_experts). Multi-slice DCN data parallelism belongs on an outer ``dp``
+    axis (see prime_tpu.parallel.distributed).
     """
     import jax
+    import math as _math
 
     spec = parse_slice(slice_name) if isinstance(slice_name, str) else slice_name
     devices = devices if devices is not None else jax.devices()
@@ -69,6 +72,11 @@ def mesh_for_slice(
         while n % tensor_parallel:
             tensor_parallel //= 2
     remaining = n // tensor_parallel
+    if expert_parallel == "auto":
+        if not n_experts:
+            raise ValueError("expert_parallel='auto' needs n_experts")
+        ep = _math.gcd(remaining, n_experts)
+        expert_parallel = ep if ep > 1 else None
     if expert_parallel:
         if remaining % expert_parallel:
             raise ValueError(

@@ -1,0 +1,170 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a ``pp`` mesh axis.
+
+TPU-first pipelining (scaling-book recipe): the stacked (L, ...) layer
+parameters are sharded on their leading axis over ``pp`` — each device holds
+a contiguous stage of L/P layers — and activations hop stage-to-stage with
+``lax.ppermute`` inside one ``shard_map``. The schedule is the classic GPipe
+fill/drain loop: with M microbatches and P stages, M + P - 1 ticks, bubble
+fraction (P-1)/(M+P-1). Everything is a single compiled program: the tick
+loop is a ``lax.fori_loop``, microbatch selection is a dynamic index, and
+stage activity is masking (idle stages compute on garbage that is never
+collected — the standard static-shape trade).
+
+Embedding/unembedding run replicated outside the pipelined region (cheap at
+the scales where pp matters less than the layer stack; a production variant
+folds them into the first/last stages). Gradients flow through ppermute and
+the final psum, so the same function backpropagates for training.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from prime_tpu.models.config import ModelConfig
+from prime_tpu.ops.norms import rms_norm
+from prime_tpu.ops.rope import rope_frequencies
+
+
+def pipeline_param_specs(config: ModelConfig) -> dict:
+    """Like sharding.param_specs but stages the layer stack over pp."""
+    if config.is_moe:
+        raise NotImplementedError("pipeline parallelism currently covers dense configs")
+    layer_spec = {
+        "attn_norm": P("pp", None),
+        "wq": P("pp", None, None),
+        "wk": P("pp", None, None),
+        "wv": P("pp", None, None),
+        "wo": P("pp", None, None),
+        "mlp_norm": P("pp", None),
+        "w_gate": P("pp", None, None),
+        "w_up": P("pp", None, None),
+        "w_down": P("pp", None, None),
+    }
+    specs = {
+        "embed": P(None, None),
+        "layers": layer_spec,
+        "final_norm": P(None),
+    }
+    if not config.tie_embeddings:
+        specs["lm_head"] = P(None, None)
+    return specs
+
+
+def _stage_forward(layers_local, x, positions, rope_tables, config: ModelConfig):
+    """Run this device's contiguous stage of layers (scan, no cache)."""
+    from prime_tpu.models.llama import _attention_block, _mlp_block
+
+    def layer_fn(x, lp):
+        x, _, _ = _attention_block(
+            x, lp, positions, rope_tables, config, None, None, None, False, "xla"
+        )
+        x, _ = _mlp_block(x, lp, config)
+        return x, None
+
+    x, _ = jax.lax.scan(layer_fn, x, layers_local)
+    return x
+
+
+def pipeline_forward(
+    params,
+    tokens: jnp.ndarray,       # (B, S) with B divisible by n_microbatches
+    config: ModelConfig,
+    mesh,
+    n_microbatches: int,
+) -> jnp.ndarray:
+    """Pipelined training forward. Returns logits (B, S, V) fp32."""
+    stages = mesh.shape["pp"]
+    if config.n_layers % stages:
+        raise ValueError(f"n_layers={config.n_layers} must divide into pp={stages} stages")
+    batch, seq = tokens.shape
+    if batch % n_microbatches:
+        raise ValueError(f"batch {batch} not divisible by {n_microbatches} microbatches")
+    micro = batch // n_microbatches
+
+    x = params["embed"][tokens]                       # (B, S, D) replicated
+    x_mb = x.reshape(n_microbatches, micro, seq, x.shape[-1])
+    positions = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None, :], (micro, seq))
+    rope_tables = rope_frequencies(config.head_dim, max(seq, config.max_seq_len), config.rope_theta)
+
+    layer_specs = pipeline_param_specs(config)["layers"]
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(layer_specs, P()),
+        out_specs=P(),
+    )
+    def run_pipeline(layers_local, x_mb):
+        stage_index = jax.lax.axis_index("pp")
+        perm = [(i, i + 1) for i in range(stages - 1)]  # forward shift, no wraparound
+
+        def tick(t, carry):
+            state, outs = carry
+            mb_in = jnp.clip(t, 0, n_microbatches - 1)
+            fresh = jax.lax.dynamic_index_in_dim(x_mb, mb_in, axis=0, keepdims=False)
+            x_in = jnp.where(stage_index == 0, fresh, state)
+            y = _stage_forward(layers_local, x_in, positions, rope_tables, config)
+            # the last stage finishes microbatch t-(P-1) at tick t
+            mb_out = t - (stages - 1)
+            collect = (stage_index == stages - 1) & (mb_out >= 0) & (mb_out < n_microbatches)
+            slot = jnp.clip(mb_out, 0, n_microbatches - 1)
+            updated = jax.lax.dynamic_update_index_in_dim(outs, y, slot, axis=0)
+            outs = jnp.where(collect, updated, outs)
+            if stages > 1:
+                state = jax.lax.ppermute(y, "pp", perm)
+            else:
+                state = y
+            return state, outs
+
+        # mark the zero carries as pp-varying so the loop carry types match
+        # the ppermute/masked outputs (jax's manual-axes varying tracking)
+        state0 = jax.lax.pcast(jnp.zeros_like(x_mb[0]), ("pp",), to="varying")
+        outs0 = jax.lax.pcast(jnp.zeros_like(x_mb), ("pp",), to="varying")
+        _, outs = jax.lax.fori_loop(0, n_microbatches + stages - 1, tick, (state0, outs0))
+        # only the last stage holds real outputs; psum broadcasts them to all
+        return jax.lax.psum(jnp.where(stage_index == stages - 1, outs, 0.0), "pp")
+
+    hidden = run_pipeline(params["layers"], x_mb)      # (M, mb, S, D)
+    hidden = hidden.reshape(batch, seq, -1)
+    hidden = rms_norm(hidden, params["final_norm"], config.rms_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    return (hidden @ head).astype(jnp.float32)
+
+
+def make_pipeline_train_step(
+    config: ModelConfig,
+    optimizer,
+    mesh,
+    n_microbatches: int,
+):
+    """Jitted pipelined train step (params staged over pp via
+    shard_pipeline_params). Same contract as trainer.make_train_step."""
+    from prime_tpu.train.trainer import TrainState, apply_gradients, cross_entropy_loss
+
+    def loss_fn(params, tokens, targets, mask):
+        logits = pipeline_forward(params, tokens, config, mesh, n_microbatches)
+        return cross_entropy_loss(logits, targets, mask)
+
+    def train_step(state: TrainState, tokens, targets, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, targets, mask)
+        new_state, grad_norm = apply_gradients(state, grads, optimizer)
+        return new_state, {"loss": loss, "grad_norm": grad_norm}
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+def shard_pipeline_params(params, mesh, config: ModelConfig):
+    """Place params for the pipeline: layer stack staged over pp, rest
+    replicated."""
+    from jax.sharding import NamedSharding
+
+    shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        pipeline_param_specs(config),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.device_put(params, shardings)

@@ -147,16 +147,25 @@ def make_train_step(
 
     def train_step(state: TrainState, tokens, targets, mask):
         loss, grads = grads_of(state.params, tokens, targets, mask)
-        # fp32 update path: fp32 grads + fp32 param view -> fp32 moments and
-        # updates; the params round back to their storage dtype once
-        updates, new_opt_state = optimizer.update(grads, state.opt_state, _f32(state.params))
-        new_params = jax.tree.map(
-            lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype), state.params, updates
-        )
-        new_state = TrainState(new_params, new_opt_state, state.step + 1)
-        return new_state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        new_state, grad_norm = apply_gradients(state, grads, optimizer)
+        return new_state, {"loss": loss, "grad_norm": grad_norm}
 
     return jax.jit(train_step, donate_argnums=(0,))
+
+
+def apply_gradients(state: TrainState, grads, optimizer) -> tuple[TrainState, jnp.ndarray]:
+    """The one fp32 update path (shared with the pipeline step): fp32 grads +
+    fp32 param view -> fp32 moments and updates; params round back to their
+    storage dtype once. Returns (new state, fp32 grad norm)."""
+    grads32 = _f32(grads)
+    updates, new_opt_state = optimizer.update(grads32, state.opt_state, _f32(state.params))
+    new_params = jax.tree.map(
+        lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype), state.params, updates
+    )
+    return (
+        TrainState(new_params, new_opt_state, state.step + 1),
+        optax.global_norm(grads32),
+    )
 
 
 def shard_train_state(state: TrainState, mesh, config: ModelConfig) -> TrainState:
