@@ -27,6 +27,7 @@ _LAZY_COMMANDS: dict[str, tuple[str, str]] = {
     "images": ("prime_tpu.commands.images", "images_group"),
     "registry": ("prime_tpu.commands.images", "registry_group"),
     "inference": ("prime_tpu.commands.inference", "inference_group"),
+    "serve": ("prime_tpu.commands.serve", "serve_cmd"),
     # Lab
     "env": ("prime_tpu.commands.env", "env_group"),
     "eval": ("prime_tpu.commands.evals", "eval_group"),

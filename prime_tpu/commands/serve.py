@@ -1,0 +1,55 @@
+"""`prime serve` — OpenAI-compatible inference on the local TPU slice.
+
+The TPU-native counterpart of the platform's hosted inference endpoint
+(reference api/inference.py consumes api.pinference.ai): serve a model —
+optionally pjit-sharded over the slice with --slice/--tp — and point any
+OpenAI client (including this CLI's own `prime inference chat`, via
+PRIME_INFERENCE_URL) at it.
+"""
+
+from __future__ import annotations
+
+import click
+
+
+@click.command(name="serve")
+@click.option("--model", "-m", required=True, help="Model preset or local HF checkpoint dir.")
+@click.option("--checkpoint", default=None, help="Local HF checkpoint dir for weights.")
+@click.option("--tokenizer", default=None)
+@click.option("--slice", "slice_name", default=None, help="Shard over this TPU slice's mesh.")
+@click.option("--tp", "tensor_parallel", type=int, default=None)
+@click.option("--host", default="127.0.0.1")
+@click.option("--port", type=int, default=8000)
+def serve_cmd(
+    model: str,
+    checkpoint: str | None,
+    tokenizer: str | None,
+    slice_name: str | None,
+    tensor_parallel: int | None,
+    host: str,
+    port: int,
+) -> None:
+    """Serve MODEL over an OpenAI-compatible HTTP API (blocks until Ctrl-C)."""
+    from prime_tpu.serve import serve_model
+
+    try:
+        server = serve_model(
+            model,
+            checkpoint=checkpoint,
+            tokenizer=tokenizer,
+            slice_name=slice_name,
+            tensor_parallel=tensor_parallel,
+            host=host,
+            port=port,
+        )
+    except (ValueError, OSError) as e:
+        raise click.ClickException(str(e)) from None
+    click.echo(f"Serving {model} at {server.url}/v1 (Ctrl-C to stop)")
+    click.echo(
+        f"  e.g. PRIME_INFERENCE_URL={server.url}/v1 prime inference chat {model} -m 'hi'"
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        click.echo("\nStopped.")
+        server.stop()

@@ -1,0 +1,12 @@
+"""Local OpenAI-compatible serving on the TPU slice.
+
+The reference points every eval/chat at a hosted inference endpoint
+(api.pinference.ai); this package closes the loop TPU-natively: `prime serve`
+exposes /v1/models and /v1/chat/completions on localhost backed by the same
+sharded JaxGenerator the eval runner uses — the framework's own
+InferenceClient (api/inference.py) talks to it unchanged.
+"""
+
+from prime_tpu.serve.server import InferenceServer, serve_model
+
+__all__ = ["InferenceServer", "serve_model"]

@@ -1,0 +1,223 @@
+"""OpenAI-compatible HTTP server over a JaxGenerator.
+
+Wire surface (subset the platform/inference clients use, reference
+api/inference.py): GET /v1/models, POST /v1/chat/completions with optional
+SSE streaming. Generation runs one request at a time behind a lock — the
+jitted sampler is a single compiled program and XLA serializes the chip
+anyway; continuous batching is a scheduler problem for a later round.
+Streaming replays the finished completion as SSE deltas (the sampler decodes
+a whole turn in one lax.scan; true token-level streaming would need a
+step-callback decode loop).
+
+Chat prompts use a minimal role-tagged template; pass a HF tokenizer with a
+chat template upstream for model-faithful formatting.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+CHAT_TEMPLATE = "{role}: {content}\n"
+
+
+def render_chat_prompt(messages: list[dict[str, str]]) -> str:
+    parts = [
+        CHAT_TEMPLATE.format(role=m.get("role", "user"), content=m.get("content", ""))
+        for m in messages
+    ]
+    return "".join(parts) + "assistant:"
+
+
+class InferenceServer:
+    """Own a generator + a ThreadingHTTPServer bound to host:port."""
+
+    def __init__(
+        self, model_id: str, generator=None, host: str = "127.0.0.1", port: int = 0
+    ) -> None:
+        """``generator=None`` binds the socket immediately and answers 503
+        until one is assigned — serve_model uses this so a port conflict fails
+        in milliseconds, not after minutes of checkpoint loading."""
+        self.model_id = model_id
+        self.generator = generator
+        self._lock = threading.Lock()  # one generation on the chip at a time
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args: object) -> None:  # quiet
+                pass
+
+            def _json(self, status: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:
+                if self.path in ("/v1/models", "/api/v1/models"):
+                    self._json(
+                        200,
+                        {"object": "list", "data": [{"id": outer.model_id, "object": "model"}]},
+                    )
+                elif self.path.rstrip("/").endswith(f"/models/{outer.model_id}"):
+                    self._json(200, {"id": outer.model_id, "object": "model"})
+                else:
+                    self._json(404, {"error": {"message": f"no route {self.path}"}})
+
+            def do_POST(self) -> None:
+                if self.path not in ("/v1/chat/completions", "/api/v1/chat/completions"):
+                    self._json(404, {"error": {"message": f"no route {self.path}"}})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    request = json.loads(self.rfile.read(length) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    self._json(400, {"error": {"message": "invalid JSON body"}})
+                    return
+                if not isinstance(request, dict):
+                    self._json(400, {"error": {"message": "request body must be an object"}})
+                    return
+                try:
+                    response = outer._chat(request)
+                except Exception as e:  # noqa: BLE001 — a bad request must get a response
+                    self._json(400, {"error": {"message": f"bad request: {e}"}})
+                    return
+                if isinstance(response, tuple):  # (status, error payload)
+                    self._json(*response)
+                    return
+                if request.get("stream"):
+                    self._stream(response)
+                else:
+                    self._json(200, response)
+
+            def _stream(self, completion: dict) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.end_headers()
+                text = completion["choices"][0]["message"]["content"]
+                base = {
+                    "id": completion["id"],
+                    "object": "chat.completion.chunk",
+                    "model": completion["model"],
+                }
+                step = 16
+                for start in range(0, max(len(text), 1), step):
+                    chunk = {
+                        **base,
+                        "choices": [
+                            {"index": 0, "delta": {"content": text[start : start + step]}}
+                        ],
+                    }
+                    self.wfile.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                done = {**base, "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}]}
+                self.wfile.write(f"data: {json.dumps(done)}\n\n".encode())
+                self.wfile.write(b"data: [DONE]\n\n")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    # -- request handling -----------------------------------------------------
+
+    def _chat(self, request: dict) -> dict | tuple[int, dict]:
+        if self.generator is None:
+            return 503, {"error": {"message": "model is still loading"}}
+        messages = request.get("messages")
+        if (
+            not isinstance(messages, list)
+            or not messages
+            or not all(isinstance(m, dict) for m in messages)
+        ):
+            return 400, {"error": {"message": "messages must be a non-empty list of objects"}}
+        model = request.get("model") or self.model_id
+        if model != self.model_id:
+            return 404, {"error": {"message": f"model {model!r} not served (have {self.model_id})"}}
+        try:
+            max_tokens = int(request.get("max_tokens") or 128)
+            temperature = float(request.get("temperature") or 0.0)
+        except (TypeError, ValueError):
+            return 400, {"error": {"message": "max_tokens/temperature must be numbers"}}
+        prompt = render_chat_prompt(messages)
+        try:
+            with self._lock:
+                completion = self.generator.generate(
+                    [prompt], max_new_tokens=max_tokens, temperature=temperature
+                )[0]
+        except Exception as e:  # noqa: BLE001 — surface as an API error, keep serving
+            return 500, {"error": {"message": f"generation failed: {e}"}}
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": self.model_id,
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": completion},
+                    "finish_reason": "stop",
+                }
+            ],
+            "usage": {
+                "prompt_tokens": len(prompt.split()),
+                "completion_tokens": len(completion.split()),
+                # openai-python's usage model requires total_tokens
+                "total_tokens": len(prompt.split()) + len(completion.split()),
+            },
+        }
+
+    # -- lifecycle -------------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "InferenceServer":
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self) -> "InferenceServer":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+def serve_model(
+    model: str,
+    checkpoint: str | None = None,
+    tokenizer: str | None = None,
+    slice_name: str | None = None,
+    tensor_parallel: int | None = None,
+    host: str = "127.0.0.1",
+    port: int = 8000,
+) -> InferenceServer:
+    """Bind the port, then build the (optionally sharded) generator."""
+    from prime_tpu.evals.runner import JaxGenerator
+
+    server = InferenceServer(model, host=host, port=port)  # fail fast on EADDRINUSE
+    server.generator = JaxGenerator(
+        model,
+        checkpoint=checkpoint,
+        tokenizer=tokenizer,
+        slice_name=slice_name,
+        tensor_parallel=tensor_parallel,
+    )
+    return server

@@ -1,0 +1,178 @@
+"""Local OpenAI-compatible serving: wire contract + InferenceClient interop."""
+
+import json
+
+import httpx
+import pytest
+
+from prime_tpu.serve import InferenceServer
+from prime_tpu.serve.server import render_chat_prompt
+
+
+class EchoGenerator:
+    """Deterministic fake: replies with the last user message, uppercased."""
+
+    def __init__(self, fail: bool = False):
+        self.fail = fail
+        self.calls: list[tuple] = []
+
+    def generate(self, prompts, max_new_tokens, temperature):
+        if self.fail:
+            raise RuntimeError("chip on fire")
+        self.calls.append((prompts, max_new_tokens, temperature))
+        return [p.splitlines()[-2].split(":", 1)[1].strip().upper() for p in prompts]
+
+
+@pytest.fixture
+def server():
+    with InferenceServer("tiny-test", EchoGenerator(), port=0) as srv:
+        yield srv
+
+
+def test_models_endpoints(server):
+    data = httpx.get(f"{server.url}/v1/models").json()
+    assert data["data"][0]["id"] == "tiny-test"
+    one = httpx.get(f"{server.url}/v1/models/tiny-test").json()
+    assert one["id"] == "tiny-test"
+    assert httpx.get(f"{server.url}/nope").status_code == 404
+
+
+def test_chat_completion(server):
+    response = httpx.post(
+        f"{server.url}/v1/chat/completions",
+        json={
+            "model": "tiny-test",
+            "messages": [{"role": "user", "content": "hello tpu"}],
+            "max_tokens": 32,
+            "temperature": 0.5,
+        },
+        timeout=30,
+    )
+    assert response.status_code == 200
+    body = response.json()
+    assert body["choices"][0]["message"]["content"] == "HELLO TPU"
+    assert body["object"] == "chat.completion"
+    assert body["usage"]["completion_tokens"] >= 1
+
+
+def test_chat_streaming(server):
+    with httpx.stream(
+        "POST",
+        f"{server.url}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": "stream me please"}], "stream": True},
+        timeout=30,
+    ) as response:
+        assert response.status_code == 200
+        chunks, done = [], False
+        for line in response.iter_lines():
+            if not line.startswith("data:"):
+                continue
+            data = line[5:].strip()
+            if data == "[DONE]":
+                done = True
+                break
+            chunks.append(json.loads(data))
+    text = "".join(c["choices"][0]["delta"].get("content", "") for c in chunks)
+    assert text == "STREAM ME PLEASE" and done
+
+
+def test_chat_errors(server):
+    bad = httpx.post(f"{server.url}/v1/chat/completions", content=b"not json")
+    assert bad.status_code == 400
+    empty = httpx.post(f"{server.url}/v1/chat/completions", json={"messages": []})
+    assert empty.status_code == 400
+    wrong_model = httpx.post(
+        f"{server.url}/v1/chat/completions",
+        json={"model": "other", "messages": [{"role": "user", "content": "x"}]},
+    )
+    assert wrong_model.status_code == 404
+
+
+def test_generation_failure_is_500_and_server_survives():
+    with InferenceServer("tiny-test", EchoGenerator(fail=True), port=0) as srv:
+        response = httpx.post(
+            f"{srv.url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "x"}]},
+        )
+        assert response.status_code == 500
+        assert "chip on fire" in response.json()["error"]["message"]
+        # still serving
+        assert httpx.get(f"{srv.url}/v1/models").status_code == 200
+
+
+def test_inference_client_interop(server, monkeypatch, tmp_path):
+    """The framework's own InferenceClient drives the local server unchanged."""
+    monkeypatch.setenv("PRIME_CONFIG_DIR", str(tmp_path))
+    monkeypatch.setenv("PRIME_API_KEY", "local")
+    monkeypatch.setenv("PRIME_INFERENCE_URL", f"{server.url}/v1")
+
+    from prime_tpu.api.inference import InferenceClient
+    from prime_tpu.core.config import Config
+
+    client = InferenceClient(config=Config())
+    assert client.list_models()[0]["id"] == "tiny-test"
+    reply = client.chat_completion(
+        "tiny-test", [{"role": "user", "content": "round trip"}], max_tokens=16
+    )
+    assert reply["choices"][0]["message"]["content"] == "ROUND TRIP"
+    chunks = list(
+        client.chat_completion_stream("tiny-test", [{"role": "user", "content": "sse too"}])
+    )
+    text = "".join(c["choices"][0]["delta"].get("content", "") for c in chunks)
+    assert text == "SSE TOO"
+
+
+def test_serve_real_tiny_model_end_to_end(tmp_path, monkeypatch):
+    """Full path: serve_model('tiny-test') -> HTTP chat -> decoded text."""
+    from prime_tpu.serve import serve_model
+
+    server = serve_model("tiny-test", port=0)
+    with server:
+        response = httpx.post(
+            f"{server.url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "2+2="}], "max_tokens": 4},
+            timeout=120,
+        )
+        assert response.status_code == 200
+        body = response.json()
+        assert isinstance(body["choices"][0]["message"]["content"], str)
+
+
+def test_render_chat_prompt():
+    prompt = render_chat_prompt(
+        [{"role": "system", "content": "be brief"}, {"role": "user", "content": "hi"}]
+    )
+    assert prompt == "system: be brief\nuser: hi\nassistant:"
+
+
+def test_malformed_requests_get_responses_not_resets(server):
+    list_body = httpx.post(f"{server.url}/v1/chat/completions", json=[1, 2])
+    assert list_body.status_code == 400
+    bad_temp = httpx.post(
+        f"{server.url}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": "x"}], "temperature": "hot"},
+    )
+    assert bad_temp.status_code == 400
+    bad_message = httpx.post(
+        f"{server.url}/v1/chat/completions", json={"messages": ["just a string"]}
+    )
+    assert bad_message.status_code == 400
+
+
+def test_usage_has_total_tokens(server):
+    body = httpx.post(
+        f"{server.url}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": "count me"}]},
+        timeout=30,
+    ).json()
+    usage = body["usage"]
+    assert usage["total_tokens"] == usage["prompt_tokens"] + usage["completion_tokens"]
+
+
+def test_unloaded_server_returns_503():
+    with InferenceServer("tiny-test", port=0) as srv:  # no generator yet
+        response = httpx.post(
+            f"{srv.url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "x"}]},
+        )
+        assert response.status_code == 503
