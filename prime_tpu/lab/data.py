@@ -21,6 +21,7 @@ PLATFORM_SECTIONS = ("evals", "training", "environments", "pods", "sandboxes")
 @dataclass
 class LabSnapshot:
     local_eval_runs: list[dict[str, Any]] = field(default_factory=list)
+    local_training_runs: list[dict[str, Any]] = field(default_factory=list)
     installed_envs: dict[str, Any] = field(default_factory=dict)
     platform: dict[str, Any] = field(default_factory=dict)      # section -> rows
     freshness: dict[str, bool] = field(default_factory=dict)    # section -> fresh?
@@ -32,6 +33,7 @@ class LabDataSource:
         self.workspace = Path(workspace)
         self.cache = cache or LabCache(workspace)
         self._api = api_client
+        self._metrics_cache: dict[str, tuple[tuple[int, int], list[dict[str, Any]]]] = {}
 
     # -- local scans (no network, always fresh) ------------------------------
 
@@ -73,12 +75,60 @@ class LabDataSource:
 
         return read_registry()
 
+    def scan_local_training_runs(self) -> list[dict[str, Any]]:
+        """Local training runs = dirs holding a metrics.jsonl (train_loop's
+        output): outputs/train/<run>/ plus the workspace root. Parsed rows are
+        cached on (mtime, size) — the TUI rescans every idle tick and a long
+        run's file must not be re-parsed each time."""
+        runs = []
+        candidates = [self.workspace]
+        train_base = self.workspace / "outputs" / "train"
+        if train_base.exists():
+            candidates += sorted(p for p in train_base.iterdir() if p.is_dir())
+        for run_dir in candidates:
+            path = run_dir / "metrics.jsonl"
+            if not path.exists():
+                continue
+            try:
+                stat = path.stat()
+                stamp = (stat.st_mtime_ns, stat.st_size)
+                cached = self._metrics_cache.get(str(path))
+                if cached and cached[0] == stamp:
+                    rows = cached[1]
+                else:
+                    rows = []
+                    for line in path.read_text().splitlines():
+                        if not line.strip():
+                            continue
+                        try:
+                            rows.append(json.loads(line))
+                        except json.JSONDecodeError:
+                            continue  # mid-append tail line: keep what parsed
+                    self._metrics_cache[str(path)] = (stamp, rows)
+            except OSError:
+                continue
+            if not rows:
+                continue
+            last = rows[-1]
+            runs.append(
+                {
+                    "run": run_dir.name if run_dir != self.workspace else "(workspace)",
+                    "steps": last.get("step", len(rows) - 1),
+                    "loss": last.get("loss"),
+                    "tokPerSec": last.get("tokens_per_sec"),
+                    "dir": str(run_dir),
+                    "metrics": rows,
+                }
+            )
+        return runs
+
     # -- snapshot ------------------------------------------------------------
 
     def snapshot(self) -> LabSnapshot:
         """Instant: local scans + whatever the cache holds (possibly stale)."""
         snap = LabSnapshot(
             local_eval_runs=self.scan_local_eval_runs(),
+            local_training_runs=self.scan_local_training_runs(),
             installed_envs=self.scan_installed_envs(),
         )
         for section in PLATFORM_SECTIONS:

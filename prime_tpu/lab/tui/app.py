@@ -25,6 +25,10 @@ SECTION_SPECS: dict[str, tuple[str, list[tuple[str, str]]]] = {
         "Local eval runs",
         [("ENV", "env"), ("MODEL", "model"), ("RUN", "runId"), ("ACC", "accuracy")],
     ),
+    "local-training": (
+        "Local training",
+        [("RUN", "run"), ("STEPS", "steps"), ("LOSS", "loss"), ("TOK/S", "tokPerSec")],
+    ),
     "evals": (
         "Evals Hub",
         [("ID", "evalId"), ("MODEL", "model"), ("STATUS", "status"), ("SAMPLES", "sampleCount")],
@@ -85,6 +89,8 @@ class PrimeLabApp:
         section = section or self.section
         if section == "local-runs":
             return self.snapshot.local_eval_runs
+        if section == "local-training":
+            return self.snapshot.local_training_runs
         if section == "launch":
             if self._launch_rows is None:
                 self._launch_rows = [
@@ -137,6 +143,7 @@ class PrimeLabApp:
         self._launch_rows = None
         local = self.data.snapshot()
         self.snapshot.local_eval_runs = local.local_eval_runs
+        self.snapshot.local_training_runs = local.local_training_runs
         self.snapshot.installed_envs = local.installed_envs
 
     def _move(self, delta: int) -> None:
@@ -271,13 +278,22 @@ class PrimeLabApp:
         selected = self.selected_row()
         if selected:
             for key, value in selected.items():
-                if key == "payload":
+                if key in ("payload", "metrics"):
                     continue
                 detail.add_row(Text(str(key), style="dim"), _cell(value))
-        layout["inspector"].update(
-            Panel(detail if selected else Text("(nothing selected)", style="dim"),
-                  title="inspector", border_style="dim")
-        )
+        body = detail if selected else Text("(nothing selected)", style="dim")
+        if selected and isinstance(selected.get("metrics"), list):
+            # training run: sparkline charts under the key/value detail;
+            # crop rather than wrap — a folded sparkline is unreadable
+            from prime_tpu.lab.tui.charts import training_chart_lines
+
+            chart = Text(
+                "\n".join(training_chart_lines(selected["metrics"], width=14)),
+                no_wrap=True,
+                overflow="crop",
+            )
+            body = Group(detail, Text(""), chart)
+        layout["inspector"].update(Panel(body, title="inspector", border_style="dim"))
 
         layout["footer"].update(Text(f" {self.status}", style="dim"))
         return layout

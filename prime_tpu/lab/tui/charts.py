@@ -1,0 +1,52 @@
+"""Terminal metric charts for the Lab shell (reference: training_charts.py).
+
+The reference renders textual-plot charts inside its Textual app; this stack
+draws unicode sparklines + axis labels with rich primitives so the same
+charts work in the shell's inspector pane and in one-shot CLI output.
+"""
+
+from __future__ import annotations
+
+BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list[float], width: int = 48) -> str:
+    """Downsample values to ``width`` buckets and render block characters."""
+    clean = [float(v) for v in values if v == v]  # drop NaN
+    if not clean:
+        return ""
+    if len(clean) > width:
+        # bucket means keep the shape without aliasing single spikes away
+        bucket = len(clean) / width
+        bucketed = []
+        for i in range(width):
+            start = int(i * bucket)
+            # the final bucket always reaches the newest sample exactly
+            end = len(clean) if i == width - 1 else max(int((i + 1) * bucket), start + 1)
+            chunk = clean[start:end]
+            bucketed.append(sum(chunk) / len(chunk))
+        clean = bucketed
+    lo, hi = min(clean), max(clean)
+    span = hi - lo
+    if span <= 0:
+        return BLOCKS[0] * len(clean)
+    return "".join(BLOCKS[int((v - lo) / span * (len(BLOCKS) - 1))] for v in clean)
+
+
+def metric_chart(rows: list[dict], key: str, width: int = 48) -> str | None:
+    """One labeled sparkline line for a metrics.jsonl-shaped row list."""
+    values = [row[key] for row in rows if isinstance(row.get(key), (int, float))]
+    if len(values) < 2:
+        return None
+    line = sparkline(values, width=width)
+    return f"{key:>14} {line}  {values[0]:.4g} → {values[-1]:.4g}"
+
+
+def training_chart_lines(rows: list[dict], width: int = 48) -> list[str]:
+    """Charts for the standard training metrics present in the rows."""
+    lines = []
+    for key in ("loss", "grad_norm", "tokens_per_sec", "step_time_s"):
+        line = metric_chart(rows, key, width=width)
+        if line:
+            lines.append(line)
+    return lines

@@ -138,10 +138,14 @@ class InferenceServer:
         if model != self.model_id:
             return 404, {"error": {"message": f"model {model!r} not served (have {self.model_id})"}}
         try:
-            max_tokens = int(request.get("max_tokens") or 128)
-            temperature = float(request.get("temperature") or 0.0)
+            raw_max = request.get("max_tokens")
+            max_tokens = 128 if raw_max is None else int(raw_max)
+            raw_temp = request.get("temperature")
+            temperature = 0.0 if raw_temp is None else float(raw_temp)
         except (TypeError, ValueError):
             return 400, {"error": {"message": "max_tokens/temperature must be numbers"}}
+        if max_tokens < 1:
+            return 400, {"error": {"message": "max_tokens must be >= 1"}}
         prompt = render_chat_prompt(messages)
         try:
             with self._lock:
@@ -182,15 +186,21 @@ class InferenceServer:
         return f"http://{host}:{port}"
 
     def start(self) -> "InferenceServer":
+        self._serving = True
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._thread.start()
         return self
 
     def serve_forever(self) -> None:
+        self._serving = True
         self._server.serve_forever()
 
     def stop(self) -> None:
-        self._server.shutdown()
+        # shutdown() handshakes with the serve_forever loop and DEADLOCKS if
+        # that loop never started (e.g. model load failed right after bind)
+        if getattr(self, "_serving", False):
+            self._server.shutdown()
+            self._serving = False
         self._server.server_close()
 
     def __enter__(self) -> "InferenceServer":
@@ -213,11 +223,15 @@ def serve_model(
     from prime_tpu.evals.runner import JaxGenerator
 
     server = InferenceServer(model, host=host, port=port)  # fail fast on EADDRINUSE
-    server.generator = JaxGenerator(
-        model,
-        checkpoint=checkpoint,
-        tokenizer=tokenizer,
-        slice_name=slice_name,
-        tensor_parallel=tensor_parallel,
-    )
+    try:
+        server.generator = JaxGenerator(
+            model,
+            checkpoint=checkpoint,
+            tokenizer=tokenizer,
+            slice_name=slice_name,
+            tensor_parallel=tensor_parallel,
+        )
+    except BaseException:
+        server.stop()  # don't leak the bound listener when the model fails to load
+        raise
     return server

@@ -110,7 +110,7 @@ def test_render_three_panes_headless(app, tmp_path):
 
 
 def test_render_empty_section(app):
-    app.on_key("6")  # sandboxes (no fetch yet)
+    app.on_key("7")  # sandboxes (no fetch yet)
     text = render_text(app)
     assert "(empty)" in text
 
@@ -137,7 +137,7 @@ def test_refresh_all_hydrates_platform_sections(app, fake, api):
     assert app.status == "refreshed"
     rows = app.snapshot.platform["sandboxes"]
     assert len(rows) == 1
-    app.on_key("6")
+    app.on_key("7")
     text = render_text(app)
     assert rows[0]["sandboxId"][:12] in text
 
@@ -147,7 +147,7 @@ def test_refresh_errors_reported_in_status(app, monkeypatch):
         raise RuntimeError("plane down")
 
     monkeypatch.setattr(app.data, "_fetch_pods", boom)
-    app.on_key("5")  # pods
+    app.on_key("6")  # pods
     app.on_key("r")
     assert "pods: plane down" in app.status
 
@@ -167,14 +167,14 @@ def _write_card(tmp_path, name="card1", kind="eval"):
 
 def test_launch_section_lists_cards(app, tmp_path):
     _write_card(tmp_path, "nightly", "eval")
-    app.on_key("7")  # launch section
+    app.on_key("8")  # launch section
     text = render_text(app)
     assert "nightly" in text and "eval" in text
 
 
 def test_launch_requires_arm_then_submits(app, tmp_path, fake):
     _write_card(tmp_path, "nightly", "eval")
-    app.on_key("7")
+    app.on_key("8")
     app.focus = "rows"
     app.on_key("enter")
     assert "press enter again" in app.status
@@ -187,7 +187,7 @@ def test_launch_requires_arm_then_submits(app, tmp_path, fake):
 def test_launch_disarms_on_move_or_escape(app, tmp_path, fake):
     _write_card(tmp_path, "a-card", "eval")
     _write_card(tmp_path, "b-card", "eval")
-    app.on_key("7")
+    app.on_key("8")
     app.focus = "rows"
     app.on_key("enter")
     app.on_key("down")  # moving disarms
@@ -203,7 +203,7 @@ def test_malformed_card_ignored(app, tmp_path):
     launch.mkdir(parents=True)
     (launch / "broken.toml").write_text("not [ valid toml")
     (launch / "wrongkind.toml").write_text('[launch]\nkind = "dance"\n')
-    app.on_key("7")
+    app.on_key("8")
     assert app.rows() == []
 
 
@@ -246,3 +246,89 @@ def test_view_explicit_bad_target_errors(fake, monkeypatch, tmp_path):
     result = CliRunner().invoke(cli, ["eval", "view", str(tmp_path / "nope-typo")])
     assert result.exit_code != 0
     assert "not a run directory" in result.output
+
+
+# -- training charts (reference training_charts.py role) ----------------------
+
+
+def _training_run(tmp_path, name="run1", steps=20):
+    import math
+
+    run = tmp_path / "outputs" / "train" / name
+    run.mkdir(parents=True)
+    with open(run / "metrics.jsonl", "w") as f:
+        for step in range(steps):
+            f.write(json.dumps({
+                "step": step,
+                "loss": 5.0 * math.exp(-step / 7) + 1.0,
+                "grad_norm": 2.0,
+                "tokens_per_sec": 1000.0 + step,
+                "step_time_s": 0.1,
+            }) + "\n")
+
+
+def test_sparkline_shapes():
+    from prime_tpu.lab.tui.charts import sparkline
+
+    assert sparkline([]) == ""
+    assert sparkline([1.0, 1.0, 1.0]) == "▁▁▁"
+    line = sparkline([0, 1, 2, 3, 4, 5, 6, 7])
+    assert line[0] == "▁" and line[-1] == "█" and len(line) == 8
+    assert len(sparkline(list(range(1000)), width=40)) == 40
+
+
+def test_local_training_section_and_chart(app, tmp_path):
+    _training_run(tmp_path, "sweep-a")
+    app.tick()
+    app.on_key("2")  # local-training section
+    rows = app.rows()
+    assert rows and rows[0]["run"] == "sweep-a" and rows[0]["steps"] == 19
+    text = render_text(app)
+    assert "Local training" in text and "sweep-a" in text
+    assert "loss" in text and "▁" in text  # sparkline rendered in inspector
+    assert "tokens_per_sec" in text
+
+
+def test_training_chart_lines_skip_missing_metrics():
+    from prime_tpu.lab.tui.charts import training_chart_lines
+
+    rows = [{"step": i, "loss": 3.0 - i * 0.1} for i in range(10)]
+    lines = training_chart_lines(rows)
+    assert len(lines) == 1 and lines[0].strip().startswith("loss")
+
+
+def test_metrics_scan_survives_partial_tail_line(app, tmp_path):
+    _training_run(tmp_path, "mid-write", steps=5)
+    path = tmp_path / "outputs" / "train" / "mid-write" / "metrics.jsonl"
+    with open(path, "a") as f:
+        f.write('{"step": 5, "loss": 1.')  # torn append
+    app.tick()
+    app.on_key("2")
+    rows = app.rows()
+    assert rows and rows[0]["steps"] == 4  # parsed rows kept, tail skipped
+
+
+def test_metrics_scan_caches_on_mtime(app, tmp_path, monkeypatch):
+    _training_run(tmp_path, "cached", steps=5)
+    app.tick()
+    calls = {"n": 0}
+    original = json.loads
+
+    def counting_loads(*a, **k):
+        calls["n"] += 1
+        return original(*a, **k)
+
+    monkeypatch.setattr(json, "loads", counting_loads)
+    import prime_tpu.lab.data as data_mod
+
+    monkeypatch.setattr(data_mod.json, "loads", counting_loads)
+    app.tick()  # unchanged file: no re-parse
+    assert calls["n"] == 0
+
+
+def test_sparkline_last_bucket_includes_newest_sample():
+    from prime_tpu.lab.tui.charts import sparkline
+
+    # huge final spike must show in the last cell even with inexact buckets
+    values = [0.0] * 999 + [100.0]
+    assert sparkline(values, width=48)[-1] != "▁"

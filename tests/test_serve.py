@@ -176,3 +176,26 @@ def test_unloaded_server_returns_503():
             json={"messages": [{"role": "user", "content": "x"}]},
         )
         assert response.status_code == 503
+
+
+def test_max_tokens_validation(server):
+    zero = httpx.post(
+        f"{server.url}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": "x"}], "max_tokens": 0},
+    )
+    assert zero.status_code == 400
+    negative = httpx.post(
+        f"{server.url}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": "x"}], "max_tokens": -5},
+    )
+    assert negative.status_code == 400
+
+
+def test_serve_model_closes_socket_on_load_failure():
+    from prime_tpu.serve import serve_model
+
+    with pytest.raises(ValueError):
+        serve_model("definitely-not-a-model", port=8991)
+    # the port must be reusable immediately in this same process
+    with InferenceServer("tiny-test", EchoGenerator(), port=8991) as srv:
+        assert httpx.get(f"{srv.url}/v1/models").status_code == 200
