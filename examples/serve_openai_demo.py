@@ -1,0 +1,40 @@
+"""Serve a model over the OpenAI-compatible API and chat with it.
+
+Starts a local server on a random port, round-trips one chat completion with
+the framework's own InferenceClient, and exits. With a real checkpoint pass
+--checkpoint / --slice to serve sharded weights on a TPU slice.
+"""
+
+import argparse
+
+from prime_tpu.serve import serve_model
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", "-m", default="tiny-test")
+    parser.add_argument("--checkpoint", default=None)
+    parser.add_argument("--slice", dest="slice_name", default=None)
+    args = parser.parse_args()
+
+    server = serve_model(
+        args.model, checkpoint=args.checkpoint, slice_name=args.slice_name, port=0
+    )
+    with server:
+        print(f"serving {args.model} at {server.url}/v1")
+        import httpx
+
+        reply = httpx.post(
+            f"{server.url}/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "Hello from the slice!"}],
+                "max_tokens": 16,
+            },
+            timeout=300,
+        ).json()
+        print("assistant:", reply["choices"][0]["message"]["content"])
+        print("usage:", reply["usage"])
+
+
+if __name__ == "__main__":
+    main()
