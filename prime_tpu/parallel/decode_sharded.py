@@ -1,0 +1,60 @@
+"""Multi-chip flash decode: the pallas kernel under shard_map.
+
+A ``pallas_call`` is opaque to the SPMD partitioner, so under a multi-device
+mesh the jitted serve path falls back to XLA decode (ops/attention.py). This
+module provides the building block that removes that limitation: the decode
+step wrapped in ``shard_map`` with the serving layout's specs — batch over
+the data axes, kv-heads over tp — so each device runs the flash-decode
+kernel on exactly its local cache shard and no communication is needed (the
+head-dim psum happens later in the attention output projection, as usual for
+megatron attention).
+
+Constraint: the batch shard and kv-head shard must be non-empty on every
+device (B divisible by dp*fsdp, KH divisible by tp) — the same divisibility
+the serving path already enforces.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def flash_decode_sharded(
+    q: jnp.ndarray,              # (B, H, 1, D)
+    k_cache: jnp.ndarray,        # (B, KH, D, C) feature-major
+    v_cache: jnp.ndarray,        # (B, KH, D, C)
+    cache_lengths: jnp.ndarray,  # (B,)
+    mesh,
+    sm_scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Per-shard pallas flash decode over a (dp, fsdp, tp[, ...]) mesh."""
+    from prime_tpu.ops.pallas_attention import flash_decode
+
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+
+    data = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    tp = "tp" if "tp" in mesh.axis_names else None
+    q_spec = P(data or None, tp, None, None)
+    kv_spec = P(data or None, tp, None, None)
+    lengths_spec = P(data or None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, lengths_spec),
+        out_specs=q_spec,
+        # pallas_call's out ShapeDtypeStruct carries no varying-axes metadata
+        check_vma=False,
+    )
+    def local_decode(q_local, k_local, v_local, lengths_local):
+        return flash_decode(
+            q_local, k_local, v_local, lengths_local, sm_scale=sm_scale, interpret=interpret
+        )
+
+    return local_decode(q, k_cache, v_cache, cache_lengths)

@@ -178,3 +178,21 @@ def test_flash_decode_matches_xla_decode():
     ref = decode_attention(q, k_cache, v_cache, lengths, d**-0.5, impl="xla")
     out = flash_decode(q, k_cache, v_cache, lengths, sm_scale=d**-0.5, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_flash_decode_sharded_matches_xla():
+    """The shard_map-wrapped pallas decode (interpret mode) over a
+    (dp,fsdp,tp) mesh == the XLA grouped decode on the full arrays."""
+    from prime_tpu.ops.attention import decode_attention
+    from prime_tpu.parallel.decode_sharded import flash_decode_sharded
+
+    mesh = make_mesh({"dp": 1, "fsdp": 4, "tp": 2})
+    b, h, kh, d, c = 4, 8, 2, 64, 256
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, 1, d), dtype=jnp.float32)
+    k_cache = jax.random.normal(jax.random.PRNGKey(1), (b, kh, d, c), dtype=jnp.float32)
+    v_cache = jax.random.normal(jax.random.PRNGKey(2), (b, kh, d, c), dtype=jnp.float32)
+    lengths = jnp.asarray([256, 1, 130, 77], dtype=jnp.int32)
+
+    ref = decode_attention(q, k_cache, v_cache, lengths, d**-0.5, impl="xla")
+    out = flash_decode_sharded(q, k_cache, v_cache, lengths, mesh, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
