@@ -34,15 +34,16 @@ def flash_decode_sharded(
 ) -> jnp.ndarray:
     """Per-shard pallas flash decode over a (dp, fsdp, tp[, ...]) mesh."""
     from prime_tpu.ops.pallas_attention import flash_decode
+    from prime_tpu.parallel import sharding
 
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
 
-    data = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
-    tp = "tp" if "tp" in mesh.axis_names else None
-    q_spec = P(data or None, tp, None, None)
-    kv_spec = P(data or None, tp, None, None)
-    lengths_spec = P(data or None)
+    # one source of truth for the serving layout: prune the canonical specs
+    # down to the axes this mesh actually has
+    q_spec = sharding.prune_spec(P(("dp", "fsdp"), "tp", None, None), mesh)
+    kv_spec = q_spec
+    lengths_spec = sharding.prune_spec(sharding.lengths_spec(), mesh)
 
     @functools.partial(
         jax.shard_map,
