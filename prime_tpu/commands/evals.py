@@ -423,6 +423,19 @@ def _render_sample_table(render: Renderer, rows: list[dict], sample_count: int) 
     )
 
 
+@eval_group.command("tui")
+@click.option("--dir", "workspace", default=".", type=click.Path())
+def eval_tui_cmd(workspace: str) -> None:
+    """Open the Lab shell focused on evals (reference evals.py:1166)."""
+    import prime_tpu.commands._deps as _deps
+    from prime_tpu.lab.tui import open_shell
+
+    try:
+        open_shell(workspace, api_client=_deps.build_client(), section="evals")
+    except RuntimeError as e:
+        raise click.ClickException(str(e)) from None
+
+
 @eval_group.command("logs")
 @click.argument("hosted_id")
 @click.option("--follow", "-f", is_flag=True, help="Poll until the run is terminal.")

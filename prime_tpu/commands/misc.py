@@ -95,11 +95,10 @@ def lab_group(ctx: click.Context) -> None:
 @click.option("--dir", "workspace", default=".", type=click.Path())
 def lab_tui(workspace: str = ".") -> None:
     """Interactive three-pane Lab shell (nav / selector / inspector)."""
-    from prime_tpu.lab.tui import PrimeLabApp, run_interactive
+    from prime_tpu.lab.tui import open_shell
 
-    app = PrimeLabApp(workspace=workspace, api_client=deps.build_client())
     try:
-        run_interactive(app)
+        open_shell(workspace, api_client=deps.build_client())
     except RuntimeError as e:
         raise click.ClickException(str(e)) from None
 

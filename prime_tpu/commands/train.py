@@ -108,6 +108,142 @@ def run_cmd(render: Renderer, config_file: str, yes: bool, follow: bool) -> None
         _stream_logs(render, run_id)
 
 
+@train_group.command("local")
+@click.option("--model", "-m", default="tiny-test", help="Model preset to train.")
+@click.option("--steps", type=int, default=20)
+@click.option("--batch-size", "-b", type=int, default=8)
+@click.option("--seq-len", type=int, default=128)
+@click.option("--lr", type=float, default=3e-4)
+@click.option("--accum", type=int, default=1, help="Gradient accumulation steps.")
+@click.option("--warmup", type=int, default=None, help="Warmup steps (default 1% of steps).")
+@click.option("--data", "data_path", default=None, type=click.Path(exists=True),
+              help="Text file (byte-tokenized LM data); default synthetic tokens.")
+@click.option("--slice", "slice_name", default=None, help="Shard over this TPU slice's mesh.")
+@click.option("--name", "run_name", default=None, help="Run name (default timestamped).")
+@click.option("--output-dir", default="outputs/train")
+@click.option("--checkpoint-every", type=int, default=0, help="orbax checkpoint cadence (0=off).")
+@click.option("--profile", is_flag=True, help="Capture a jax.profiler trace of steps 2-5.")
+@output_options
+def local_cmd(
+    render: Renderer,
+    model: str,
+    steps: int,
+    batch_size: int,
+    seq_len: int,
+    lr: float,
+    accum: int,
+    warmup: int | None,
+    data_path: str | None,
+    slice_name: str | None,
+    run_name: str | None,
+    output_dir: str,
+    checkpoint_every: int,
+    profile: bool,
+) -> None:
+    """Train MODEL locally on this slice (native JAX trainer, not hosted).
+
+    The hosted path (`prime train run`) dispatches to the platform; this runs
+    the framework's own sharded train step right here — metrics land in
+    outputs/train/<run>/metrics.jsonl and chart in `prime lab`.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.train import (
+        default_optimizer,
+        init_train_state,
+        make_train_step,
+        train_loop,
+        warmup_cosine,
+    )
+    from prime_tpu.train.data import synthetic_batches, text_batches
+    from prime_tpu.train.metrics import MetricsLogger
+
+    try:
+        config = get_config(model)
+    except ValueError as e:
+        raise click.ClickException(str(e)) from None
+    if accum < 1:
+        raise click.ClickException(f"--accum must be >= 1 (got {accum})")
+    if batch_size % accum:
+        raise click.ClickException(f"--batch-size {batch_size} must divide by --accum {accum}")
+
+    run_name = run_name or f"{model}-{time.strftime('%Y%m%d-%H%M%S')}"
+    run_dir = Path(output_dir) / run_name
+    if (run_dir / "metrics.jsonl").exists():
+        # appending would interleave two runs' rows under duplicate steps
+        raise click.ClickException(f"run {run_dir} already has metrics — pick a new --name")
+    run_dir.mkdir(parents=True, exist_ok=True)
+
+    schedule = warmup_cosine(lr, total_steps=steps, warmup_steps=warmup)
+    optimizer = default_optimizer(schedule)
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.bfloat16)
+    state = init_train_state(params, optimizer)
+
+    mesh = None
+    if slice_name is not None:
+        from prime_tpu.parallel.mesh import mesh_for_slice
+        from prime_tpu.train import shard_train_state
+
+        mesh = mesh_for_slice(
+            slice_name,
+            expert_parallel="auto" if config.is_moe else None,
+            n_experts=config.n_experts or None,
+        )
+        state = shard_train_state(state, mesh, config)
+        render.message(f"mesh: {dict(mesh.shape)}")
+
+    step_fn = make_train_step(config, optimizer, accum_steps=accum)
+
+    if data_path:
+        batches = text_batches(data_path, batch_size, seq_len, steps)
+    else:
+        batches = synthetic_batches(config.vocab_size, batch_size, seq_len, steps)
+    if mesh is not None:
+        from prime_tpu.parallel.sharding import shard_batch
+
+        batches = (tuple(shard_batch(x, mesh) for x in b) for b in batches)
+
+    checkpoints = None
+    if checkpoint_every:
+        from prime_tpu.train.checkpoint import CheckpointManager
+
+        checkpoints = CheckpointManager(run_dir / "checkpoints")
+
+    def on_step(step: int, row: dict) -> None:
+        if step % 5 == 0 or step == steps - 1:
+            render.message(
+                f"  step {step}: loss={row['loss']:.4f} "
+                f"{row['tokens_per_sec']:.0f} tok/s"
+            )
+
+    # a short run must still honor --profile: shrink the trace window to fit
+    profile_window = (2, 5) if steps >= 5 else (0, min(2, steps))
+    state, report = train_loop(
+        state,
+        step_fn,
+        batches,
+        metrics=MetricsLogger(run_dir),
+        checkpoints=checkpoints,
+        checkpoint_every=checkpoint_every,
+        profile_dir=str(run_dir / "trace") if profile else None,
+        profile_window=profile_window,
+        on_step=on_step,
+    )
+    if checkpoints is not None:
+        checkpoints.close()
+    payload = {"runDir": str(run_dir), **report.as_dict()}
+    if render.is_json:
+        render.json(payload)
+    else:
+        render.message(
+            f"done: {report.steps} steps, final loss {report.final_loss:.4f}, "
+            f"{report.tokens_per_sec:.0f} tok/s -> {run_dir}"
+        )
+
+
 @train_group.command("init")
 @click.argument("name")
 @click.option("--out", default=None, help="Output file (default <name>.toml)")

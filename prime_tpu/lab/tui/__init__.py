@@ -15,4 +15,19 @@ tty-touching component.
 from prime_tpu.lab.tui.app import PrimeLabApp
 from prime_tpu.lab.tui.driver import render_text, run_interactive
 
-__all__ = ["PrimeLabApp", "render_text", "run_interactive"]
+__all__ = ["PrimeLabApp", "open_shell", "render_text", "run_interactive"]
+
+
+def open_shell(workspace: str = ".", api_client=None, section: str | None = None) -> None:
+    """Launch the interactive shell, optionally focused on one section.
+
+    The single CLI entry point shared by `prime lab` and `prime eval tui` —
+    raises RuntimeError without a tty (callers map it to a CLI error).
+    """
+    from prime_tpu.lab.tui.app import SECTIONS
+
+    app = PrimeLabApp(workspace=workspace, api_client=api_client)
+    if section is not None:
+        app.section_idx = SECTIONS.index(section)
+        app.focus = "rows"
+    run_interactive(app)

@@ -1,0 +1,64 @@
+"""Token batch sources for local training.
+
+Two sources, both static-shape and stream-friendly:
+- ``text_batches``: a raw text file tokenized (byte tokenizer by default, any
+  prime_tpu tokenizer otherwise) into one continuous stream, cut into
+  (batch, seq+1) windows — next-token targets come from the shifted window.
+- ``synthetic_batches``: random tokens, for smoke tests and throughput
+  benches where data content is irrelevant.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterator
+
+import numpy as np
+
+
+def _windows(stream: np.ndarray, batch: int, seq: int, steps: int, seed: int) -> Iterator[tuple]:
+    import jax.numpy as jnp
+
+    window = seq + 1
+    usable = len(stream) - window + 1  # number of valid window start positions
+    if usable <= 0:
+        raise ValueError(
+            f"dataset has {len(stream)} tokens, need at least {window} for seq_len {seq}"
+        )
+    rng = np.random.default_rng(seed)
+    for _ in range(steps):
+        starts = rng.integers(0, usable, size=batch)  # exclusive high: last window included
+        chunk = np.stack([stream[s : s + window] for s in starts])
+        tokens = jnp.asarray(chunk[:, :-1], dtype=jnp.int32)
+        targets = jnp.asarray(chunk[:, 1:], dtype=jnp.int32)
+        yield tokens, targets, jnp.ones_like(tokens, jnp.float32)
+
+
+def text_batches(
+    path: str | Path,
+    batch: int,
+    seq: int,
+    steps: int,
+    tokenizer=None,
+    seed: int = 0,
+) -> Iterator[tuple]:
+    """Batches from a text file. Default tokenizer: hermetic byte-level."""
+    from prime_tpu.evals.tokenizer import load_tokenizer
+
+    tokenizer = tokenizer or load_tokenizer(None)
+    text = Path(path).read_text()
+    stream = np.asarray(tokenizer.encode(text), dtype=np.int32)
+    yield from _windows(stream, batch, seq, steps, seed)
+
+
+def synthetic_batches(
+    vocab_size: int, batch: int, seq: int, steps: int, seed: int = 0
+) -> Iterator[tuple]:
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(seed)
+    for step in range(steps):
+        key, sub = jax.random.split(key)
+        tokens = jax.random.randint(sub, (batch, seq), 0, vocab_size)
+        yield tokens, jnp.roll(tokens, -1, axis=1), jnp.ones_like(tokens, jnp.float32)

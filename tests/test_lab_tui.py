@@ -332,3 +332,17 @@ def test_sparkline_last_bucket_includes_newest_sample():
     # huge final spike must show in the last cell even with inexact buckets
     values = [0.0] * 999 + [100.0]
     assert sparkline(values, width=48)[-1] != "▁"
+
+
+def test_eval_tui_requires_tty(fake, monkeypatch):
+    from click.testing import CliRunner
+
+    import prime_tpu.commands._deps as deps
+    from prime_tpu.commands.main import cli
+
+    monkeypatch.setattr(deps, "transport_override", fake.transport)
+    monkeypatch.setenv("PRIME_API_KEY", "test-key")
+    monkeypatch.setenv("PRIME_BASE_URL", "https://api.fake")
+    result = CliRunner().invoke(cli, ["eval", "tui"])
+    assert result.exit_code != 0
+    assert "interactive terminal" in result.output

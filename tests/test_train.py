@@ -406,3 +406,98 @@ def test_bf16_params_get_fp32_adam_moments():
     state, metrics = step(state, tokens, jnp.roll(tokens, -1, 1), jnp.ones_like(tokens, jnp.float32))
     assert all(leaf.dtype == jnp.bfloat16 for leaf in jax.tree.leaves(state.params))
     assert jnp.bfloat16 not in {leaf.dtype for leaf in jax.tree.leaves(state.opt_state)}
+
+
+def test_train_local_cli(tmp_path):
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.main import cli
+
+    result = CliRunner().invoke(
+        cli,
+        ["train", "local", "-m", "tiny-test", "--steps", "6", "-b", "4",
+         "--seq-len", "32", "--accum", "2", "--lr", "1e-3",
+         "--name", "cli-run", "--output-dir", str(tmp_path), "--output", "json"],
+    )
+    assert result.exit_code == 0, result.output
+    import json as _json
+
+    payload = _json.loads(result.output)
+    assert payload["steps"] == 6 and payload["tokens_per_sec"] > 0
+    metrics = (tmp_path / "cli-run" / "metrics.jsonl").read_text().splitlines()
+    assert len(metrics) == 6
+
+
+def test_train_local_cli_sharded_with_text_data(tmp_path):
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.main import cli
+
+    data = tmp_path / "corpus.txt"
+    data.write_text("the quick brown fox jumps over the lazy dog. " * 200)
+    result = CliRunner().invoke(
+        cli,
+        ["train", "local", "-m", "tiny-test", "--steps", "4", "-b", "4",
+         "--seq-len", "32", "--slice", "v5e-8", "--data", str(data),
+         "--name", "sharded-run", "--output-dir", str(tmp_path), "--plain"],
+    )
+    assert result.exit_code == 0, result.output
+    assert "mesh" in result.output and "done:" in result.output
+
+
+def test_text_batches_shapes_and_determinism(tmp_path):
+    from prime_tpu.train.data import text_batches
+
+    data = tmp_path / "c.txt"
+    data.write_text("abcdefgh" * 100)
+    a = list(text_batches(data, batch=2, seq=16, steps=3, seed=7))
+    b = list(text_batches(data, batch=2, seq=16, steps=3, seed=7))
+    assert len(a) == 3
+    tokens, targets, mask = a[0]
+    assert tokens.shape == (2, 16) == targets.shape == mask.shape
+    import numpy as _np
+
+    _np.testing.assert_array_equal(_np.asarray(a[1][0]), _np.asarray(b[1][0]))
+    # next-token contract: targets are tokens shifted by one
+    _np.testing.assert_array_equal(_np.asarray(a[0][0][:, 1:]), _np.asarray(a[0][1][:, :-1]))
+
+
+def test_text_batches_rejects_tiny_corpus(tmp_path):
+    import pytest as _pytest
+
+    from prime_tpu.train.data import text_batches
+
+    data = tmp_path / "tiny.txt"
+    data.write_text("ab")
+    with _pytest.raises(ValueError, match="need at least"):
+        list(text_batches(data, batch=2, seq=128, steps=1))
+
+
+def test_train_local_rejects_bad_accum_and_reused_name(tmp_path):
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.main import cli
+
+    runner = CliRunner()
+    bad = runner.invoke(cli, ["train", "local", "--accum", "0", "--output-dir", str(tmp_path)])
+    assert bad.exit_code != 0 and "--accum" in bad.output
+
+    args = ["train", "local", "-m", "tiny-test", "--steps", "2", "-b", "2",
+            "--seq-len", "16", "--name", "dup", "--output-dir", str(tmp_path), "--plain"]
+    assert runner.invoke(cli, args).exit_code == 0
+    rerun = runner.invoke(cli, args)
+    assert rerun.exit_code != 0 and "already has metrics" in rerun.output
+
+
+def test_text_batches_exact_window_corpus(tmp_path):
+    """A corpus of exactly seq+1 tokens has one valid window and must work."""
+    from prime_tpu.evals.tokenizer import ByteTokenizer
+    from prime_tpu.train.data import text_batches
+
+    seq = 7
+    text = "x" * (seq + 1 - 1)  # byte tokenizer adds a BOS -> seq+1 tokens total
+    data = tmp_path / "exact.txt"
+    data.write_text(text)
+    assert len(ByteTokenizer().encode(text)) == seq + 1
+    batches = list(text_batches(data, batch=2, seq=seq, steps=2))
+    assert batches[0][0].shape == (2, seq)
