@@ -196,3 +196,32 @@ def test_flash_decode_sharded_matches_xla():
     ref = decode_attention(q, k_cache, v_cache, lengths, d**-0.5, impl="xla")
     out = flash_decode_sharded(q, k_cache, v_cache, lengths, mesh, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_sp_decode_attention_matches_xla():
+    """Sequence-sharded decode (cache slots over sp, two-phase softmax
+    combine) == the single-device XLA decode."""
+    from prime_tpu.ops.attention import decode_attention
+    from prime_tpu.parallel.long_context import sp_decode_attention
+
+    mesh = make_mesh({"sp": 8})
+    b, h, kh, d, c = 2, 8, 2, 64, 512
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, 1, d), dtype=jnp.float32)
+    k_cache = jax.random.normal(jax.random.PRNGKey(1), (b, kh, d, c), dtype=jnp.float32)
+    v_cache = jax.random.normal(jax.random.PRNGKey(2), (b, kh, d, c), dtype=jnp.float32)
+    lengths = jnp.asarray([512, 130], dtype=jnp.int32)  # one full, one short
+
+    ref = decode_attention(q, k_cache, v_cache, lengths, d**-0.5, impl="xla")
+    out = sp_decode_attention(q, k_cache, v_cache, lengths, mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_sp_decode_rejects_indivisible_capacity():
+    from prime_tpu.parallel.long_context import sp_decode_attention
+
+    mesh = make_mesh({"sp": 8})
+    with pytest.raises(ValueError, match="divide over sp"):
+        sp_decode_attention(
+            jnp.zeros((1, 4, 1, 32)), jnp.zeros((1, 2, 32, 100)),
+            jnp.zeros((1, 2, 32, 100)), jnp.zeros((1,), jnp.int32), mesh,
+        )
