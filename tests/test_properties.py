@@ -1,0 +1,121 @@
+"""Property-based tests (hypothesis) for the pure-math invariants.
+
+These pin the algebraic contracts that example-based tests sample only
+pointwise: slice topology arithmetic, deterministic packaging, key decoding,
+MoE routing conservation laws, sparkline bounds.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from prime_tpu.parallel.topology import list_slice_names, parse_slice
+
+settings.register_profile("ci", max_examples=25, deadline=None)
+settings.load_profile("ci")
+
+
+# -- slice topology -----------------------------------------------------------
+
+
+@given(st.sampled_from(["v4", "v5e", "v5p", "v6e"]))
+def test_every_listed_slice_parses_consistently(generation):
+    for name in list_slice_names(generation):
+        spec = parse_slice(name)
+        dims = [int(d) for d in spec.topology.split("x")]
+        assert np.prod(dims) == spec.chips
+        assert spec.chips % spec.hosts == 0
+        assert spec.hosts >= 1
+        assert parse_slice(spec.name).chips == spec.chips  # roundtrip
+
+
+# -- packaging determinism ----------------------------------------------------
+
+
+@given(
+    st.dictionaries(
+        st.text(alphabet="abcdefgh", min_size=1, max_size=8).map(lambda s: s + ".txt"),
+        st.binary(max_size=64),
+        min_size=1,
+        max_size=5,
+    )
+)
+def test_content_hash_is_order_independent_and_exclusion_stable(tmp_path_factory, files):
+    from prime_tpu.envhub.packaging import build_archive, content_hash
+
+    base = tmp_path_factory.mktemp("env")
+    for name, data in files.items():
+        (base / name).write_bytes(data)
+    digest_one = content_hash(base)
+    # excluded junk must not affect the hash or the archive
+    (base / "__pycache__").mkdir(exist_ok=True)
+    (base / "__pycache__" / "x.pyc").write_bytes(b"junk")
+    (base / "ignored.pyc").write_bytes(b"junk")
+    assert content_hash(base) == digest_one
+    assert build_archive(base) == build_archive(base)  # byte-identical archives
+
+
+# -- TUI key decoding ---------------------------------------------------------
+
+
+@given(st.lists(st.sampled_from(["j", "k", "q", "\r", "\t", "\x1b[A", "\x1b[B"]), max_size=12))
+def test_decode_keys_concatenation_is_associative(parts):
+    from prime_tpu.lab.tui.keys import decode_keys
+
+    joined = decode_keys("".join(parts).encode())
+    split = [key for part in parts for key in decode_keys(part.encode())]
+    assert joined == split
+
+
+# -- MoE routing conservation laws --------------------------------------------
+
+
+@given(st.integers(0, 2**31 - 1), st.sampled_from([1, 2]))
+def test_routing_conservation(seed, k):
+    from prime_tpu.ops.moe import top_k_routing
+
+    logits = jax.random.normal(jax.random.PRNGKey(seed), (24, 4), dtype=jnp.float32)
+    capacity = 8
+    dispatch, combine, aux = top_k_routing(logits, k=k, capacity=capacity)
+    d = np.asarray(dispatch)
+    c = np.asarray(combine)
+    # each token dispatched to at most k (expert, slot) pairs
+    assert (d.sum(axis=(1, 2)) <= k + 1e-6).all()
+    # each (expert, slot) pair serves at most one token
+    assert (d.sum(axis=0) <= 1 + 1e-6).all()
+    # combine weight only where dispatched, total mass <= 1 per token
+    assert (c[d == 0] == 0).all()
+    assert (c.sum(axis=(1, 2)) <= 1 + 1e-5).all()
+    assert np.isfinite(float(aux))
+
+
+# -- sparkline ----------------------------------------------------------------
+
+
+@given(
+    st.lists(st.floats(-1e6, 1e6, allow_nan=False), min_size=0, max_size=300),
+    st.integers(1, 64),
+)
+def test_sparkline_bounds(values, width):
+    from prime_tpu.lab.tui.charts import BLOCKS, sparkline
+
+    line = sparkline(values, width=width)
+    assert len(line) <= max(width, len(values) if len(values) <= width else width)
+    assert all(ch in BLOCKS for ch in line)
+
+
+# -- gitignore escaping -------------------------------------------------------
+
+
+@given(st.text(alphabet="ab*?[]!#x.", min_size=1, max_size=12))
+def test_escaped_gitignore_patterns_match_literally(name):
+    import fnmatch
+
+    from prime_tpu.lab.hygiene import _escape_gitignore
+
+    escaped = _escape_gitignore(name)
+    # the escaped pattern, with escapes stripped the way git reads them,
+    # must match exactly the literal name via fnmatch-style semantics
+    assert escaped.replace("\\\\", "\0").replace("\\", "").replace("\0", "\\") == name
