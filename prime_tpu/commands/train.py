@@ -122,6 +122,7 @@ def run_cmd(render: Renderer, config_file: str, yes: bool, follow: bool) -> None
 @click.option("--name", "run_name", default=None, help="Run name (default timestamped).")
 @click.option("--output-dir", default="outputs/train")
 @click.option("--checkpoint-every", type=int, default=0, help="orbax checkpoint cadence (0=off).")
+@click.option("--resume", is_flag=True, help="Resume --name from its latest checkpoint.")
 @click.option("--profile", is_flag=True, help="Capture a jax.profiler trace of steps 2-5.")
 @output_options
 def local_cmd(
@@ -138,6 +139,7 @@ def local_cmd(
     run_name: str | None,
     output_dir: str,
     checkpoint_every: int,
+    resume: bool,
     profile: bool,
 ) -> None:
     """Train MODEL locally on this slice (native JAX trainer, not hosted).
@@ -170,11 +172,17 @@ def local_cmd(
     if batch_size % accum:
         raise click.ClickException(f"--batch-size {batch_size} must divide by --accum {accum}")
 
+    if resume and not run_name:
+        raise click.ClickException("--resume needs --name (which run to continue)")
+    if resume and not checkpoint_every:
+        raise click.ClickException("--resume needs --checkpoint-every (to keep saving)")
     run_name = run_name or f"{model}-{time.strftime('%Y%m%d-%H%M%S')}"
     run_dir = Path(output_dir) / run_name
-    if (run_dir / "metrics.jsonl").exists():
+    if not resume and (run_dir / "metrics.jsonl").exists():
         # appending would interleave two runs' rows under duplicate steps
-        raise click.ClickException(f"run {run_dir} already has metrics — pick a new --name")
+        raise click.ClickException(
+            f"run {run_dir} already has metrics — pick a new --name or pass --resume"
+        )
     run_dir.mkdir(parents=True, exist_ok=True)
 
     schedule = warmup_cosine(lr, total_steps=steps, warmup_steps=warmup)
@@ -207,10 +215,19 @@ def local_cmd(
         batches = (tuple(shard_batch(x, mesh) for x in b) for b in batches)
 
     checkpoints = None
+    start_step = 0
     if checkpoint_every:
         from prime_tpu.train.checkpoint import CheckpointManager
 
         checkpoints = CheckpointManager(run_dir / "checkpoints")
+        if resume:
+            try:
+                state = checkpoints.restore(state)
+            except FileNotFoundError as e:
+                checkpoints.close()
+                raise click.ClickException(str(e)) from None
+            start_step = int(jax.device_get(state.step))
+            render.message(f"resumed {run_name} from step {start_step}")
 
     def on_step(step: int, row: dict) -> None:
         if step % 5 == 0 or step == steps - 1:
@@ -231,6 +248,7 @@ def local_cmd(
         profile_dir=str(run_dir / "trace") if profile else None,
         profile_window=profile_window,
         on_step=on_step,
+        start_step=start_step,
     )
     if checkpoints is not None:
         checkpoints.close()

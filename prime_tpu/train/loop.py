@@ -46,8 +46,10 @@ def train_loop(
     profile_window: tuple[int, int] = (2, 5),   # [start, stop) steps to trace
     log_every: int = 1,
     on_step: Callable[[int, dict], None] | None = None,
+    start_step: int = 0,
 ):
-    """Drive ``step_fn`` over ``batches``. Returns (state, LoopReport)."""
+    """Drive ``step_fn`` over ``batches``. Returns (state, LoopReport).
+    ``start_step`` offsets logged step numbers when resuming a run."""
     import jax
 
     report = LoopReport()
@@ -80,9 +82,9 @@ def train_loop(
                 "tokens_per_sec": tokens_this_step / dt if dt > 0 else 0.0,
             }
             if metrics is not None and step % max(log_every, 1) == 0:
-                metrics.log(step, **row)
+                metrics.log(start_step + step, **row)
             if on_step is not None:
-                on_step(step, row)
+                on_step(start_step + step, row)
             if checkpoints is not None and checkpoint_every and (step + 1) % checkpoint_every == 0:
                 checkpoints.save(state, metrics={"loss": loss})
     finally:

@@ -501,3 +501,40 @@ def test_text_batches_exact_window_corpus(tmp_path):
     assert len(ByteTokenizer().encode(text)) == seq + 1
     batches = list(text_batches(data, batch=2, seq=seq, steps=2))
     assert batches[0][0].shape == (2, seq)
+
+
+def test_train_local_resume_from_checkpoint(tmp_path):
+    import json as _json
+
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.main import cli
+
+    runner = CliRunner()
+    base = ["train", "local", "-m", "tiny-test", "-b", "2", "--seq-len", "16",
+            "--name", "resumable", "--output-dir", str(tmp_path),
+            "--checkpoint-every", "2", "--plain"]
+    first = runner.invoke(cli, base + ["--steps", "4"])
+    assert first.exit_code == 0, first.output
+
+    resumed = runner.invoke(cli, base + ["--steps", "3", "--resume"])
+    assert resumed.exit_code == 0, resumed.output
+    assert "resumed resumable from step 4" in resumed.output
+
+    rows = [_json.loads(l) for l in (tmp_path / "resumable" / "metrics.jsonl").read_text().splitlines()]
+    steps = [r["step"] for r in rows]
+    assert steps == [0, 1, 2, 3, 4, 5, 6]  # continuous numbering across resume
+
+
+def test_train_local_resume_requires_name_and_checkpoints(tmp_path):
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.main import cli
+
+    runner = CliRunner()
+    no_name = runner.invoke(cli, ["train", "local", "--resume", "--output-dir", str(tmp_path)])
+    assert no_name.exit_code != 0 and "--name" in no_name.output
+    no_ckpt = runner.invoke(
+        cli, ["train", "local", "--resume", "--name", "x", "--output-dir", str(tmp_path)]
+    )
+    assert no_ckpt.exit_code != 0 and "--checkpoint-every" in no_ckpt.output
