@@ -131,6 +131,78 @@ def check_inference(env):
     assert json.loads(out)["choices"][0]["message"]["content"] == "echo: ship it"
 
 
+@step("env execution protocol (hub resolve -> load_environment -> run)")
+def check_env_execution(env):
+    with tempfile.TemporaryDirectory() as tmp:
+        run_cli("env", "push", "--dir", str(REPO / "examples" / "verifiers_example"), env=env)
+        out = run_cli(
+            "eval", "run", "arith-rl", "-m", "tiny-test", "--no-push", "-n", "2", "-b", "2",
+            "--output-dir", tmp, "--plain", env=env,
+        ).stdout
+        assert "Resolved env arith-rl" in out
+        out = run_cli("env", "inspect", "arith-rl", "--output", "json", env=env).stdout
+        inspected = json.loads(out)
+        assert inspected["loadEnvironment"] == "ok" and inspected["hasScorer"]
+        actions = json.loads(run_cli("env", "actions", "list", "arith-rl", "--output", "json", env=env).stdout)
+        logs = run_cli("env", "actions", "logs", "arith-rl", actions[0]["id"], "--plain", env=env).stdout
+        assert "build finished" in logs
+
+
+@step("images suite (build-vm, hf-cache, bulk, visibility)")
+def check_images(env):
+    with tempfile.TemporaryDirectory() as tmp:
+        out = run_cli(
+            "images", "build-vm", "--name", "e2e-vm", "--base-image", "tpu-base",
+            "--output", "json", env=env,
+        ).stdout
+        image_id = json.loads(out)["imageId"]
+        run_cli("images", "hf-cache", "--name", "e2e-cache", "--model", "m/llama", env=env)
+        manifest = Path(tmp) / "m.json"
+        manifest.write_text(json.dumps([{"name": "e2e-bulk", "dockerfileText": "FROM a\n"}]))
+        out = run_cli("images", "bulk-push", "--manifest", str(manifest), "--plain", env=env).stdout
+        assert "1/1 succeeded" in out
+        run_cli("images", "visibility", "public", image_id, env=env)
+        detail = json.loads(run_cli("images", "get", image_id, "--output", "json", env=env).stdout)
+        assert detail["visibility"] == "public" and detail["artifacts"]
+
+
+@step("train local (native trainer) + lab charts data")
+def check_train_local(env):
+    with tempfile.TemporaryDirectory() as tmp:
+        out = run_cli(
+            "train", "local", "-m", "tiny-test", "--steps", "3", "-b", "2", "--seq-len", "16",
+            "--name", "e2e-local", "--output-dir", str(Path(tmp) / "outputs" / "train"),
+            "--output", "json", env=env,
+        ).stdout
+        payload = json.loads(out)
+        assert payload["steps"] == 3 and payload["tokens_per_sec"] > 0
+        metrics = (Path(tmp) / "outputs" / "train" / "e2e-local" / "metrics.jsonl").read_text()
+        assert len(metrics.splitlines()) == 3
+
+
+@step("serve round trip (OpenAI-compatible)")
+def check_serve(env):
+    code = (
+        "import os, httpx\n"
+        "from prime_tpu.serve import serve_model\n"
+        "server = serve_model('tiny-test', port=0)\n"
+        "with server:\n"
+        "    r = httpx.post(server.url + '/v1/chat/completions',\n"
+        "                   json={'messages': [{'role': 'user', 'content': 'hi'}], 'max_tokens': 2},\n"
+        "                   timeout=240)\n"
+        "    assert r.status_code == 200, r.text\n"
+        "    assert r.json()['usage']['total_tokens'] >= 1\n"
+        "print('serve-ok')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, env=env,
+        cwd=str(REPO), timeout=300,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(proc.stderr[-800:])
+    assert "serve-ok" in proc.stdout
+
+
 def main() -> int:
     server = LiveControlPlane().start()
     with tempfile.TemporaryDirectory() as config_dir:
@@ -156,6 +228,10 @@ def main() -> int:
             check_eval,
             check_train,
             check_inference,
+            check_env_execution,
+            check_images,
+            check_train_local,
+            check_serve,
         ):
             check(env)
     server.stop()
