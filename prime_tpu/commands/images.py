@@ -19,7 +19,7 @@ from pathlib import Path
 import click
 
 from prime_tpu.commands._deps import build_client
-from prime_tpu.core.exceptions import APIError, RateLimitError
+from prime_tpu.core.exceptions import RateLimitError
 from prime_tpu.sandboxes.images import ImageClient
 from prime_tpu.utils.render import Renderer, output_options
 from prime_tpu.utils.short_id import shorten
