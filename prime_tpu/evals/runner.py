@@ -137,7 +137,13 @@ class JaxGenerator:
             self.params = shard_params(self.params, mesh, self.config)
         self._rng = jax.random.PRNGKey(0)
 
-    def generate(self, prompts: list[str], max_new_tokens: int, temperature: float) -> list[str]:
+    def generate(
+        self,
+        prompts: list[str],
+        max_new_tokens: int,
+        temperature: float,
+        top_p: float = 1.0,
+    ) -> list[str]:
         import jax
         import jax.numpy as jnp
 
@@ -187,6 +193,7 @@ class JaxGenerator:
                 rng,
                 max_new_tokens=max_new_tokens,
                 temperature=temperature,
+                top_p=top_p,
                 eos_id=self.tokenizer.eos_id,
                 pad_id=pad_id,
                 **kw,

@@ -25,16 +25,35 @@ class GenerationResult(NamedTuple):
     logprobs: jnp.ndarray      # (B, max_new_tokens) logprob of each sampled token
 
 
-def _sample(logits: jnp.ndarray, temperature: float, rng: jax.Array) -> jnp.ndarray:
+def _sample(
+    logits: jnp.ndarray, temperature: float, rng: jax.Array, top_p: float = 1.0
+) -> jnp.ndarray:
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
-    return jax.random.categorical(rng, logits / temperature, axis=-1)
+    logits = logits / temperature
+    if top_p < 1.0:
+        # nucleus filtering, fully static: tokens outside the smallest set
+        # with cumulative probability >= top_p get -inf before sampling
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        cumulative = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+        # keep every token whose PRECEDING cumulative mass is < top_p (the
+        # first token crossing the threshold stays in the nucleus)
+        keep_sorted = jnp.concatenate(
+            [jnp.ones_like(cumulative[..., :1], dtype=bool), cumulative[..., :-1] < top_p],
+            axis=-1,
+        )
+        cutoff = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
+    return jax.random.categorical(rng, logits, axis=-1)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "config", "max_new_tokens", "temperature", "eos_id", "pad_id", "attn_impl", "cache_spec",
+        "config", "max_new_tokens", "temperature", "top_p", "eos_id", "pad_id",
+        "attn_impl", "cache_spec",
     ),
 )
 def generate(
@@ -45,6 +64,7 @@ def generate(
     rng: jax.Array,
     max_new_tokens: int = 128,
     temperature: float = 0.0,
+    top_p: float = 1.0,            # nucleus sampling (only with temperature > 0)
     eos_id: int = -1,              # -1 disables EOS stopping
     pad_id: int = 0,
     attn_impl: str = "auto",
@@ -71,7 +91,7 @@ def generate(
     last = jnp.take_along_axis(logits, (prompt_lengths - 1)[:, None, None], axis=1)[:, 0, :]
 
     rng, step_rng = jax.random.split(rng)
-    first_tokens = _sample(last, temperature, step_rng)
+    first_tokens = _sample(last, temperature, step_rng, top_p)
     first_logprobs = jnp.take_along_axis(
         jax.nn.log_softmax(last, axis=-1), first_tokens[:, None], axis=1
     )[:, 0]
@@ -94,7 +114,7 @@ def generate(
         )
         step_logits = logits[:, 0, :]
         rng, step_rng = jax.random.split(carry.rng)
-        sampled = _sample(step_logits, temperature, step_rng)
+        sampled = _sample(step_logits, temperature, step_rng, top_p)
         sampled = jnp.where(carry.done, pad_id, sampled)
         logprob = jnp.take_along_axis(
             jax.nn.log_softmax(step_logits, axis=-1), sampled[:, None], axis=1

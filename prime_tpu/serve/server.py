@@ -142,15 +142,20 @@ class InferenceServer:
             max_tokens = 128 if raw_max is None else int(raw_max)
             raw_temp = request.get("temperature")
             temperature = 0.0 if raw_temp is None else float(raw_temp)
+            raw_top_p = request.get("top_p")
+            top_p = 1.0 if raw_top_p is None else float(raw_top_p)
         except (TypeError, ValueError):
-            return 400, {"error": {"message": "max_tokens/temperature must be numbers"}}
+            return 400, {"error": {"message": "max_tokens/temperature/top_p must be numbers"}}
         if max_tokens < 1:
             return 400, {"error": {"message": "max_tokens must be >= 1"}}
+        if not 0.0 < top_p <= 1.0:
+            return 400, {"error": {"message": "top_p must be in (0, 1]"}}
         prompt = render_chat_prompt(messages)
+        kwargs = {"top_p": top_p} if top_p < 1.0 else {}
         try:
             with self._lock:
                 completion = self.generator.generate(
-                    [prompt], max_new_tokens=max_tokens, temperature=temperature
+                    [prompt], max_new_tokens=max_tokens, temperature=temperature, **kwargs
                 )[0]
         except Exception as e:  # noqa: BLE001 — surface as an API error, keep serving
             return 500, {"error": {"message": f"generation failed: {e}"}}

@@ -112,3 +112,45 @@ def test_generate_respects_prompt_lengths(params):
 def test_param_count_llama8b():
     assert get_config("llama3-8b").param_count == pytest.approx(8.03e9, rel=0.01)
     assert get_config("llama3.2-1b").param_count == pytest.approx(1.24e9, rel=0.02)
+
+
+def test_top_p_sampling_restricts_to_nucleus():
+    """With a peaked distribution and small top_p, sampling == argmax; with
+    top_p=1.0 the tail stays reachable."""
+    import jax
+
+    from prime_tpu.models.sampler import _sample
+
+    logits = jnp.log(jnp.asarray([[0.6, 0.25, 0.1, 0.05]]))
+    top = []
+    for seed in range(64):
+        token = int(_sample(logits, temperature=1.0, rng=jax.random.PRNGKey(seed), top_p=0.5)[0])
+        top.append(token)
+    assert set(top) == {0}  # 0.6 >= 0.5: nucleus is exactly the top token
+
+    mid = {
+        int(_sample(logits, temperature=1.0, rng=jax.random.PRNGKey(seed), top_p=0.9)[0])
+        for seed in range(128)
+    }
+    assert mid <= {0, 1, 2} and {0, 1} <= mid  # 0.6+0.25+0.1 >= 0.9, token 3 cut
+
+    full = {
+        int(_sample(logits, temperature=1.0, rng=jax.random.PRNGKey(seed), top_p=1.0)[0])
+        for seed in range(256)
+    }
+    assert 3 in full  # untruncated sampling still reaches the tail
+
+
+def test_generate_with_top_p_runs():
+    import jax
+
+    from prime_tpu.models.sampler import generate
+
+    params = init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 1, CFG.vocab_size)
+    lengths = jnp.asarray([6, 4], jnp.int32)
+    result = generate(
+        params, tokens, lengths, CFG, jax.random.PRNGKey(2),
+        max_new_tokens=4, temperature=0.8, top_p=0.9,
+    )
+    assert result.tokens.shape == (2, 4)

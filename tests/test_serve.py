@@ -16,10 +16,10 @@ class EchoGenerator:
         self.fail = fail
         self.calls: list[tuple] = []
 
-    def generate(self, prompts, max_new_tokens, temperature):
+    def generate(self, prompts, max_new_tokens, temperature, top_p=1.0):
         if self.fail:
             raise RuntimeError("chip on fire")
-        self.calls.append((prompts, max_new_tokens, temperature))
+        self.calls.append((prompts, max_new_tokens, temperature, top_p))
         return [p.splitlines()[-2].split(":", 1)[1].strip().upper() for p in prompts]
 
 
@@ -199,3 +199,19 @@ def test_serve_model_closes_socket_on_load_failure():
     # the port must be reusable immediately in this same process
     with InferenceServer("tiny-test", EchoGenerator(), port=8991) as srv:
         assert httpx.get(f"{srv.url}/v1/models").status_code == 200
+
+
+def test_top_p_validation_and_passthrough(server):
+    bad = httpx.post(
+        f"{server.url}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": "x"}], "top_p": 1.5},
+    )
+    assert bad.status_code == 400
+    ok = httpx.post(
+        f"{server.url}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": "hello there"}],
+              "temperature": 0.7, "top_p": 0.9},
+        timeout=30,
+    )
+    assert ok.status_code == 200
+    assert server.generator.calls[-1][3] == 0.9  # top_p reached the generator
