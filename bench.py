@@ -98,7 +98,19 @@ def main() -> None:
     prompts = jax.random.randint(jax.random.PRNGKey(1), (BATCH, PROMPT_LEN), 1, config.vocab_size)
     lengths = jnp.full((BATCH,), PROMPT_LEN, dtype=jnp.int32)
 
-    def run():
+    def time_fn(fn, iterations: int = 3) -> float:
+        """Best wall-clock seconds over `iterations` (after one warmup/compile
+        call). fn must end with a scalar host fetch: on tunneled backends
+        (axon) block_until_ready returns before the computation has run."""
+        fn()  # warmup + compile
+        best_s = float("inf")
+        for _ in range(iterations):
+            t0 = time.perf_counter()
+            fn()
+            best_s = min(best_s, time.perf_counter() - t0)
+        return best_s
+
+    def run_generate(**kw):
         result = generate(
             params,
             prompts,
@@ -107,19 +119,11 @@ def main() -> None:
             jax.random.PRNGKey(2),
             max_new_tokens=NEW_TOKENS,
             temperature=0.0,
+            **kw,
         )
-        # fetch a scalar to force execution: on tunneled backends (axon)
-        # block_until_ready returns before the computation has run
         float(jnp.sum(result.tokens))
-        return result
 
-    run()  # warmup + compile
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        run()
-        times.append(time.perf_counter() - t0)
-    best = min(times)
+    best = time_fn(run_generate)
     decode_tok_s = BATCH * NEW_TOKENS / best
     samples_per_sec = BATCH / best
 
@@ -154,13 +158,15 @@ def main() -> None:
             )
         float(jnp.sum(result.tokens))
 
-    run_sharded()  # warmup + compile
-    sharded_times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        run_sharded()
-        sharded_times.append(time.perf_counter() - t0)
-    sharded_tok_s = BATCH * NEW_TOKENS / min(sharded_times)
+    sharded_tok_s = BATCH * NEW_TOKENS / time_fn(run_sharded)
+
+    # int8 KV cache vs the SAME (XLA) decode path: the quantized cache has no
+    # pallas kernel yet, so compare against an XLA fp run — otherwise the
+    # kernel switch, not quantization, would dominate the delta
+    xla_fp_tok_s = BATCH * NEW_TOKENS / time_fn(lambda: run_generate(attn_impl="xla"))
+    q8_tok_s = BATCH * NEW_TOKENS / time_fn(
+        lambda: run_generate(attn_impl="xla", kv_quant=True)
+    )
 
     print(
         json.dumps(
@@ -172,6 +178,8 @@ def main() -> None:
                 "samples_per_sec": round(samples_per_sec, 2),
                 "gen_time_s": round(best, 3),
                 "sharded_1dev_tok_s": round(sharded_tok_s, 1),
+                "xla_fp_tok_s": round(xla_fp_tok_s, 1),
+                "int8_kv_xla_tok_s": round(q8_tok_s, 1),
                 "backend": jax.default_backend(),
                 "device": str(jax.devices()[0]),
             }

@@ -58,6 +58,7 @@ POLL_INTERVAL_S = 3.0
     help="Shard the local model over this TPU slice's mesh (e.g. v5e-8).",
 )
 @click.option("--tp", "tensor_parallel", type=int, default=None, help="Tensor-parallel axis for --slice.")
+@click.option("--kv-quant", is_flag=True, help="int8 KV cache (halved decode HBM traffic).")
 @output_options
 def run_eval_cmd(
     render: Renderer,
@@ -76,6 +77,7 @@ def run_eval_cmd(
     tpu_type: str,
     slice_name: str | None,
     tensor_parallel: int | None,
+    kv_quant: bool,
 ) -> None:
     """Run ENV against a model (local TPU by default, --hosted for platform)."""
     from prime_tpu.evals.runner import EvalRunSpec, push_eval_results, run_eval
@@ -90,6 +92,8 @@ def run_eval_cmd(
             )
             if value is not None
         ]
+        if kv_quant:
+            ignored.append("--kv-quant")
         if not do_push:
             ignored.append("--no-push")
         if ignored:
@@ -165,6 +169,7 @@ def run_eval_cmd(
         output_dir=output_dir,
         slice_name=slice_name,
         tensor_parallel=tensor_parallel,
+        kv_quant=kv_quant,
     )
 
     def progress(done: int, total: int) -> None:

@@ -18,6 +18,7 @@ import click
 @click.option("--tokenizer", default=None)
 @click.option("--slice", "slice_name", default=None, help="Shard over this TPU slice's mesh.")
 @click.option("--tp", "tensor_parallel", type=int, default=None)
+@click.option("--kv-quant", is_flag=True, help="int8 KV cache (halved decode HBM traffic).")
 @click.option("--host", default="127.0.0.1")
 @click.option("--port", type=int, default=8000)
 def serve_cmd(
@@ -26,6 +27,7 @@ def serve_cmd(
     tokenizer: str | None,
     slice_name: str | None,
     tensor_parallel: int | None,
+    kv_quant: bool,
     host: str,
     port: int,
 ) -> None:
@@ -39,6 +41,7 @@ def serve_cmd(
             tokenizer=tokenizer,
             slice_name=slice_name,
             tensor_parallel=tensor_parallel,
+            kv_quant=kv_quant,
             host=host,
             port=port,
         )

@@ -25,7 +25,9 @@ from prime_tpu.evals.tokenizer import Tokenizer, load_tokenizer
 
 
 class Generator(Protocol):
-    def generate(self, prompts: list[str], max_new_tokens: int, temperature: float) -> list[str]: ...
+    def generate(
+        self, prompts: list[str], max_new_tokens: int, temperature: float, top_p: float = 1.0
+    ) -> list[str]: ...
 
 
 @dataclass
@@ -42,6 +44,7 @@ class EvalRunSpec:
     tokenizer: str | None = None         # tokenizer name/path; None -> byte fallback
     slice_name: str | None = None        # TPU slice (e.g. v5e-8) -> sharded generate
     tensor_parallel: int | None = None   # override tp axis (default: mesh_for_slice policy)
+    kv_quant: bool = False               # int8 KV cache (halved decode HBM traffic)
     metadata: dict = field(default_factory=dict)
 
 
@@ -76,6 +79,7 @@ class JaxGenerator:
         mesh=None,
         slice_name: str | None = None,
         tensor_parallel: int | None = None,
+        kv_quant: bool = False,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -135,6 +139,7 @@ class JaxGenerator:
                 )
             self._data_size = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
             self.params = shard_params(self.params, mesh, self.config)
+        self.kv_quant = kv_quant
         self._rng = jax.random.PRNGKey(0)
 
     def generate(
@@ -194,8 +199,10 @@ class JaxGenerator:
                 max_new_tokens=max_new_tokens,
                 temperature=temperature,
                 top_p=top_p,
+                nucleus=top_p < 1.0,
                 eos_id=self.tokenizer.eos_id,
                 pad_id=pad_id,
+                kv_quant=self.kv_quant,
                 **kw,
             )
         tokens = jax.device_get(result.tokens).tolist()[:n_real]
@@ -228,6 +235,7 @@ def run_eval(
             tokenizer=spec.tokenizer,
             slice_name=spec.slice_name,
             tensor_parallel=spec.tensor_parallel,
+            kv_quant=spec.kv_quant,
         )
 
     samples: list[EvalSample] = []

@@ -38,24 +38,61 @@ class KVCache(NamedTuple):
     conventional (C, head_dim) layout the flash-decode kernel would pad 64
     lanes to 128 and read twice the cache bytes — fatal for a path that is
     pure HBM bandwidth.
+
+    Optional int8 quantization (``init_cache(quantized=True)``): k/v hold int8
+    with per-slot fp32 scales (L, B, KH, 1, C) — decode is pure HBM bandwidth,
+    so halving the cache bytes is up to ~2x decode throughput at long context.
+    The scales fold EXACTLY into the decode einsums (scores scale per key
+    slot, value scale folds into the softmax weights), so the only error is
+    the int8 rounding itself (~0.4% RMS per tensor).
     """
 
     k: jnp.ndarray
     v: jnp.ndarray
     lengths: jnp.ndarray  # (B,) valid entries per sequence
+    k_scale: jnp.ndarray | None = None  # (L, B, KH, 1, C) fp32 when quantized
+    v_scale: jnp.ndarray | None = None
 
     @property
     def capacity(self) -> int:
         return self.k.shape[4]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
-def init_cache(config: ModelConfig, batch: int, capacity: int, dtype=jnp.bfloat16) -> KVCache:
+
+def init_cache(
+    config: ModelConfig,
+    batch: int,
+    capacity: int,
+    dtype=jnp.bfloat16,
+    quantized: bool = False,
+) -> KVCache:
     shape = (config.n_layers, batch, config.n_kv_heads, config.head_dim, capacity)
+    scale_shape = (config.n_layers, batch, config.n_kv_heads, 1, capacity)
+    if quantized:
+        return KVCache(
+            k=jnp.zeros(shape, dtype=jnp.int8),
+            v=jnp.zeros(shape, dtype=jnp.int8),
+            lengths=jnp.zeros((batch,), dtype=jnp.int32),
+            k_scale=jnp.zeros(scale_shape, dtype=jnp.float32),
+            v_scale=jnp.zeros(scale_shape, dtype=jnp.float32),
+        )
     return KVCache(
         k=jnp.zeros(shape, dtype=dtype),
         v=jnp.zeros(shape, dtype=dtype),
         lengths=jnp.zeros((batch,), dtype=jnp.int32),
     )
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-slot symmetric int8: x is (..., head_dim, S). Returns (q, scale)
+    with scale shaped (..., 1, S)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-2, keepdims=True)
+    scale = absmax / 127.0
+    q = jnp.round(x.astype(jnp.float32) / jnp.maximum(scale, 1e-12)).astype(jnp.int8)
+    return q, scale
 
 
 def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Params:
@@ -107,11 +144,13 @@ def _attention_block(
     positions: jnp.ndarray,       # (B, S)
     rope_tables: tuple[jnp.ndarray, jnp.ndarray],
     config: ModelConfig,
-    k_cache: jnp.ndarray | None,  # (B, KH, C, hd) this layer
+    k_cache: jnp.ndarray | None,  # (B, KH, hd, C) this layer (int8 when quantized)
     v_cache: jnp.ndarray | None,
     cache_lengths: jnp.ndarray | None,
     decode: bool,
     attn_impl: str,
+    k_scale: jnp.ndarray | None = None,  # (B, KH, 1, C) when quantized
+    v_scale: jnp.ndarray | None = None,
 ):
     batch, seq, _ = x.shape
     h, kh, hd = config.n_heads, config.n_kv_heads, config.head_dim
@@ -128,22 +167,31 @@ def _attention_block(
     k = k.transpose(0, 2, 1, 3)
     v = v.transpose(0, 2, 1, 3)
 
+    quantized = k_scale is not None
     new_k_cache, new_v_cache = k_cache, v_cache
+    new_k_scale, new_v_scale = k_scale, v_scale
     if decode:
         assert k_cache is not None and cache_lengths is not None
         # scatter this step's k/v column into each sequence's next free slot
-        def put(cache, new):  # cache (B, KH, hd, C), new (B, KH, 1, hd)
-            col = new.transpose(0, 1, 3, 2)  # (B, KH, hd, 1)
-
+        def put(cache, col):  # cache (B, KH, *, C), col (B, KH, *, 1)
             def one(c, n, idx):
                 return jax.lax.dynamic_update_slice(c, n, (0, 0, idx))
 
             return jax.vmap(one)(cache, col, cache_lengths)
 
-        new_k_cache = put(k_cache, k)
-        new_v_cache = put(v_cache, v)
+        k_col = k.transpose(0, 1, 3, 2)  # (B, KH, hd, 1)
+        v_col = v.transpose(0, 1, 3, 2)
+        if quantized:
+            k_q, k_s = quantize_kv(k_col)
+            v_q, v_s = quantize_kv(v_col)
+            new_k_cache, new_k_scale = put(k_cache, k_q), put(k_scale, k_s)
+            new_v_cache, new_v_scale = put(v_cache, v_q), put(v_scale, v_s)
+        else:
+            new_k_cache = put(k_cache, k_col)
+            new_v_cache = put(v_cache, v_col)
         attn = decode_attention(
-            q, new_k_cache, new_v_cache, cache_lengths + 1, hd**-0.5, impl=attn_impl
+            q, new_k_cache, new_v_cache, cache_lengths + 1, hd**-0.5, impl=attn_impl,
+            k_scale=new_k_scale, v_scale=new_v_scale,
         )
     else:
         attn = multi_head_attention(q, k, v, impl=attn_impl)
@@ -151,11 +199,19 @@ def _attention_block(
             # prefill: stage the prompt's k/v feature-major at slots [0, S)
             k_t = k.transpose(0, 1, 3, 2)  # (B, KH, hd, S)
             v_t = v.transpose(0, 1, 3, 2)
-            new_k_cache = jax.lax.dynamic_update_slice(k_cache, k_t, (0, 0, 0, 0))
-            new_v_cache = jax.lax.dynamic_update_slice(v_cache, v_t, (0, 0, 0, 0))
+            if quantized:
+                k_q, k_s = quantize_kv(k_t)
+                v_q, v_s = quantize_kv(v_t)
+                new_k_cache = jax.lax.dynamic_update_slice(k_cache, k_q, (0, 0, 0, 0))
+                new_v_cache = jax.lax.dynamic_update_slice(v_cache, v_q, (0, 0, 0, 0))
+                new_k_scale = jax.lax.dynamic_update_slice(k_scale, k_s, (0, 0, 0, 0))
+                new_v_scale = jax.lax.dynamic_update_slice(v_scale, v_s, (0, 0, 0, 0))
+            else:
+                new_k_cache = jax.lax.dynamic_update_slice(k_cache, k_t, (0, 0, 0, 0))
+                new_v_cache = jax.lax.dynamic_update_slice(v_cache, v_t, (0, 0, 0, 0))
 
     attn = attn.transpose(0, 2, 1, 3).reshape(batch, seq, h * hd)
-    return x + attn @ lp["wo"], new_k_cache, new_v_cache
+    return x + attn @ lp["wo"], new_k_cache, new_v_cache, new_k_scale, new_v_scale
 
 
 def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -208,27 +264,44 @@ def forward(
     cache_lengths = cache.lengths if cache is not None else None
     aux0 = jnp.zeros((), jnp.float32)
 
+    quantized = cache is not None and cache.quantized
+
     def layer_fn(carry, scanned):
         x, aux_sum = carry
-        lp, k_c, v_c = scanned
-        x, new_k, new_v = _attention_block(
+        if quantized:
+            lp, k_c, v_c, k_s, v_s = scanned
+        else:
+            lp, k_c, v_c = scanned
+            k_s = v_s = None
+        x, new_k, new_v, new_ks, new_vs = _attention_block(
             x, lp, positions, rope_tables, config,
             k_c, v_c, cache_lengths, decode, attn_impl,
+            k_scale=k_s, v_scale=v_s,
         )
         x, aux = _mlp_block(x, lp, config)
-        return (x, aux_sum + aux), (new_k, new_v)
+        ys = (new_k, new_v, new_ks, new_vs) if quantized else (new_k, new_v)
+        return (x, aux_sum + aux), ys
 
     if cache is not None:
-        (x, aux_total), (new_k, new_v) = jax.lax.scan(
-            layer_fn, (x, aux0), (layer_params, cache.k, cache.v)
-        )
+        if quantized:
+            xs = (layer_params, cache.k, cache.v, cache.k_scale, cache.v_scale)
+            (x, aux_total), (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+                layer_fn, (x, aux0), xs
+            )
+        else:
+            (x, aux_total), (new_k, new_v) = jax.lax.scan(
+                layer_fn, (x, aux0), (layer_params, cache.k, cache.v)
+            )
+            new_ks = new_vs = None
         new_lengths = cache.lengths + (1 if decode else seq)
-        new_cache = KVCache(k=new_k, v=new_v, lengths=new_lengths)
+        new_cache = KVCache(
+            k=new_k, v=new_v, lengths=new_lengths, k_scale=new_ks, v_scale=new_vs
+        )
     else:
 
         def layer_fn_nocache(carry, lp):
             x, aux_sum = carry
-            x, _, _ = _attention_block(
+            x, _, _, _, _ = _attention_block(
                 x, lp, positions, rope_tables, config, None, None, None, False, attn_impl
             )
             x, aux = _mlp_block(x, lp, config)

@@ -26,14 +26,21 @@ class GenerationResult(NamedTuple):
 
 
 def _sample(
-    logits: jnp.ndarray, temperature: float, rng: jax.Array, top_p: float = 1.0
+    logits: jnp.ndarray,
+    temperature: float,
+    rng: jax.Array,
+    top_p=1.0,
+    nucleus: bool = False,
 ) -> jnp.ndarray:
+    """``nucleus`` is the static switch (compile-time); ``top_p`` itself is a
+    TRACED scalar so serving clients can vary it per request without
+    triggering a full recompile of the generation program."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
-    if top_p < 1.0:
-        # nucleus filtering, fully static: tokens outside the smallest set
-        # with cumulative probability >= top_p get -inf before sampling
+    if nucleus:
+        # nucleus filtering with static shapes: tokens outside the smallest
+        # set with cumulative probability >= top_p get -inf before sampling
         sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
         cumulative = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
         # keep every token whose PRECEDING cumulative mass is < top_p (the
@@ -52,8 +59,8 @@ def _sample(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "config", "max_new_tokens", "temperature", "top_p", "eos_id", "pad_id",
-        "attn_impl", "cache_spec",
+        "config", "max_new_tokens", "temperature", "nucleus", "eos_id", "pad_id",
+        "attn_impl", "cache_spec", "kv_quant",
     ),
 )
 def generate(
@@ -64,15 +71,19 @@ def generate(
     rng: jax.Array,
     max_new_tokens: int = 128,
     temperature: float = 0.0,
-    top_p: float = 1.0,            # nucleus sampling (only with temperature > 0)
+    top_p=1.0,                     # traced scalar; active only with nucleus=True
+    nucleus: bool = False,         # static switch for top-p filtering
     eos_id: int = -1,              # -1 disables EOS stopping
     pad_id: int = 0,
     attn_impl: str = "auto",
     cache_spec=None,               # PartitionSpec for the (L,B,KH,hd,C) cache; needs jax.set_mesh
+    kv_quant: bool = False,        # int8 KV cache (halved decode HBM traffic)
 ) -> GenerationResult:
     batch, prompt_len = prompt_tokens.shape
     capacity = prompt_len + max_new_tokens
-    cache = init_cache(config, batch, capacity, dtype=params["embed"].dtype)
+    cache = init_cache(
+        config, batch, capacity, dtype=params["embed"].dtype, quantized=kv_quant
+    )
     if cache_spec is not None:
         # pin the cache layout before it enters the scan carry — XLA would
         # otherwise be free to replicate the zeros init across the mesh
@@ -80,6 +91,11 @@ def generate(
             k=jax.lax.with_sharding_constraint(cache.k, cache_spec),
             v=jax.lax.with_sharding_constraint(cache.v, cache_spec),
         )
+        if cache.quantized:
+            cache = cache._replace(
+                k_scale=jax.lax.with_sharding_constraint(cache.k_scale, cache_spec),
+                v_scale=jax.lax.with_sharding_constraint(cache.v_scale, cache_spec),
+            )
 
     # ---- prefill ----
     logits, cache = forward(
@@ -91,7 +107,7 @@ def generate(
     last = jnp.take_along_axis(logits, (prompt_lengths - 1)[:, None, None], axis=1)[:, 0, :]
 
     rng, step_rng = jax.random.split(rng)
-    first_tokens = _sample(last, temperature, step_rng, top_p)
+    first_tokens = _sample(last, temperature, step_rng, top_p, nucleus)
     first_logprobs = jnp.take_along_axis(
         jax.nn.log_softmax(last, axis=-1), first_tokens[:, None], axis=1
     )[:, 0]
@@ -111,10 +127,11 @@ def generate(
             positions=carry.cache.lengths[:, None],
             cache=carry.cache,
             decode=True,
+            attn_impl=attn_impl,
         )
         step_logits = logits[:, 0, :]
         rng, step_rng = jax.random.split(carry.rng)
-        sampled = _sample(step_logits, temperature, step_rng, top_p)
+        sampled = _sample(step_logits, temperature, step_rng, top_p, nucleus)
         sampled = jnp.where(carry.done, pad_id, sampled)
         logprob = jnp.take_along_axis(
             jax.nn.log_softmax(step_logits, axis=-1), sampled[:, None], axis=1

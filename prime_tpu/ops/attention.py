@@ -62,6 +62,8 @@ def decode_attention(
     cache_lengths: jnp.ndarray,  # (B,) number of valid cache entries
     sm_scale: float,
     impl: str = "auto",      # auto | pallas | xla
+    k_scale: jnp.ndarray | None = None,  # (B, KH, 1, C) int8-cache dequant scales
+    v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """One decode step against the cache, masking invalid (future) slots.
 
@@ -75,7 +77,15 @@ def decode_attention(
     each device sees the whole (or an explicitly shard_mapped) cache. The
     eval runner does this automatically (evals/runner.py JaxGenerator).
     """
-    if impl == "pallas" or (impl == "auto" and _decode_pallas_eligible(k_cache)):
+    quantized = k_scale is not None
+    if quantized and impl == "pallas":
+        raise ValueError(
+            "flash_decode has no int8-cache variant yet: use impl='auto'/'xla' "
+            "with a quantized cache"
+        )
+    if not quantized and (
+        impl == "pallas" or (impl == "auto" and _decode_pallas_eligible(k_cache))
+    ):
         from prime_tpu.ops.pallas_attention import flash_decode
 
         return flash_decode(q, k_cache, v_cache, cache_lengths, sm_scale=sm_scale)
@@ -84,16 +94,32 @@ def decode_attention(
     kv_heads = k_cache.shape[1]
     group = num_heads // kv_heads
     qg = q.reshape(batch, kv_heads, group, head_dim)
-    scores = (
-        jnp.einsum("bkgd,bkdc->bkgc", qg, k_cache, preferred_element_type=jnp.float32)
-        * sm_scale
-    )
+    if quantized:
+        # int8 cache: the per-slot scales fold exactly into the einsums —
+        # scores pick up k's slot scale, v's slot scale folds into the probs,
+        # so the int8 values are read once and never materialized dequantized
+        scores = jnp.einsum(
+            "bkgd,bkdc->bkgc", qg.astype(jnp.float32), k_cache.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ) * (k_scale * sm_scale)
+    else:
+        scores = (
+            jnp.einsum("bkgd,bkdc->bkgc", qg, k_cache, preferred_element_type=jnp.float32)
+            * sm_scale
+        )
     capacity = k_cache.shape[3]
     slot_ids = jnp.arange(capacity)[None, None, None, :]
     valid = slot_ids < cache_lengths[:, None, None, None]
     scores = jnp.where(valid, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgc,bkdc->bkgd", probs.astype(q.dtype), v_cache)
+    if quantized:
+        weighted = (probs * v_scale).astype(jnp.float32)
+        out = jnp.einsum(
+            "bkgc,bkdc->bkgd", weighted, v_cache.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ).astype(q.dtype)
+    else:
+        out = jnp.einsum("bkgc,bkdc->bkgd", probs.astype(q.dtype), v_cache)
     return out.reshape(batch, num_heads, 1, head_dim)
 
 

@@ -59,7 +59,7 @@ def _stage_forward(layers_local, x, positions, rope_tables, config: ModelConfig)
     from prime_tpu.models.llama import _attention_block, _mlp_block
 
     def layer_fn(x, lp):
-        x, _, _ = _attention_block(
+        x, _, _, _, _ = _attention_block(
             x, lp, positions, rope_tables, config, None, None, None, False, "xla"
         )
         x, _ = _mlp_block(x, lp, config)

@@ -242,3 +242,17 @@ def test_checkpoint_without_tokenizer_errors_not_byte_fallback(tmp_path):
     # no tokenizer files and no weights: tokenizer failure must surface first
     with pytest.raises(ValueError, match="Could not load tokenizer"):
         JaxGenerator("some-model", checkpoint=str(ckpt))
+
+
+def test_run_eval_with_kv_quant(tmp_path):
+    spec = EvalRunSpec(
+        env="arith",
+        model="tiny-test",
+        limit=2,
+        batch_size=2,
+        max_new_tokens=6,
+        output_dir=str(tmp_path),
+        kv_quant=True,
+    )
+    result = run_eval(spec)
+    assert result.metrics["num_samples"] == 2

@@ -124,12 +124,12 @@ def test_top_p_sampling_restricts_to_nucleus():
     logits = jnp.log(jnp.asarray([[0.6, 0.25, 0.1, 0.05]]))
     top = []
     for seed in range(64):
-        token = int(_sample(logits, temperature=1.0, rng=jax.random.PRNGKey(seed), top_p=0.5)[0])
+        token = int(_sample(logits, temperature=1.0, rng=jax.random.PRNGKey(seed), top_p=0.5, nucleus=True)[0])
         top.append(token)
     assert set(top) == {0}  # 0.6 >= 0.5: nucleus is exactly the top token
 
     mid = {
-        int(_sample(logits, temperature=1.0, rng=jax.random.PRNGKey(seed), top_p=0.9)[0])
+        int(_sample(logits, temperature=1.0, rng=jax.random.PRNGKey(seed), top_p=0.9, nucleus=True)[0])
         for seed in range(128)
     }
     assert mid <= {0, 1, 2} and {0, 1} <= mid  # 0.6+0.25+0.1 >= 0.9, token 3 cut
@@ -151,6 +151,74 @@ def test_generate_with_top_p_runs():
     lengths = jnp.asarray([6, 4], jnp.int32)
     result = generate(
         params, tokens, lengths, CFG, jax.random.PRNGKey(2),
-        max_new_tokens=4, temperature=0.8, top_p=0.9,
+        max_new_tokens=4, temperature=0.8, top_p=0.9, nucleus=True,
     )
     assert result.tokens.shape == (2, 4)
+
+
+def test_int8_kv_cache_decode_matches_fp(params):
+    """Prefill + decode with the int8 cache stays close to the fp cache path
+    (only int8 rounding separates them), and the cache really is int8."""
+    import jax
+
+    from prime_tpu.models.llama import forward, init_cache
+
+    seq = 10
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, seq), 0, CFG.vocab_size)
+    full_logits, _ = forward(params, tokens, CFG)
+
+    prefix = 6
+    cache = init_cache(CFG, 2, seq + 4, dtype=jnp.float32, quantized=True)
+    assert cache.k.dtype == jnp.int8 and cache.quantized
+    logits, cache = forward(params, tokens[:, :prefix], CFG, cache=cache)
+    np.testing.assert_allclose(
+        np.asarray(full_logits[:, :prefix]), np.asarray(logits), rtol=2e-4, atol=2e-4
+    )  # prefill logits don't read the cache: exact
+    for i in range(prefix, seq):
+        step_logits, cache = forward(
+            params, tokens[:, i : i + 1], CFG,
+            positions=cache.lengths[:, None], cache=cache, decode=True,
+        )
+        # int8 rounding error only: tight but not exact
+        np.testing.assert_allclose(
+            np.asarray(full_logits[:, i]), np.asarray(step_logits[:, 0]), rtol=0.06, atol=0.06
+        )
+    assert cache.k.dtype == jnp.int8  # stays quantized through the scan
+
+
+def test_int8_kv_generate_greedy_matches_fp(params):
+    """Greedy generation with the int8 cache picks the same tokens as fp on a
+    tiny model (rounding noise must not flip confident argmaxes)."""
+    import jax
+
+    from prime_tpu.models.sampler import generate
+
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 8), 1, CFG.vocab_size)
+    lengths = jnp.asarray([8, 5], jnp.int32)
+    fp = generate(params, tokens, lengths, CFG, jax.random.PRNGKey(5), max_new_tokens=6)
+    q8 = generate(
+        params, tokens, lengths, CFG, jax.random.PRNGKey(5), max_new_tokens=6, kv_quant=True
+    )
+    match = (np.asarray(fp.tokens) == np.asarray(q8.tokens)).mean()
+    assert match >= 0.75, f"int8 cache flipped too many greedy tokens ({match:.0%} match)"
+
+
+def test_int8_cache_halves_bytes():
+    from prime_tpu.models.llama import init_cache
+
+    fp = init_cache(CFG, 2, 256, dtype=jnp.bfloat16)
+    q8 = init_cache(CFG, 2, 256, quantized=True)
+    fp_bytes = fp.k.nbytes + fp.v.nbytes
+    q8_bytes = q8.k.nbytes + q8.v.nbytes + q8.k_scale.nbytes + q8.v_scale.nbytes
+    assert q8_bytes < 0.6 * fp_bytes  # int8 + small fp32 scale rows
+
+
+def test_pallas_decode_refused_for_quantized_cache():
+    from prime_tpu.ops.attention import decode_attention
+
+    q = jnp.zeros((1, 4, 1, 32))
+    kq = jnp.zeros((1, 2, 32, 128), jnp.int8)
+    scale = jnp.ones((1, 2, 1, 128))
+    with pytest.raises(ValueError, match="int8-cache"):
+        decode_attention(q, kq, kq, jnp.ones((1,), jnp.int32), 1.0,
+                         impl="pallas", k_scale=scale, v_scale=scale)
