@@ -19,6 +19,7 @@ import click
 @click.option("--slice", "slice_name", default=None, help="Shard over this TPU slice's mesh.")
 @click.option("--tp", "tensor_parallel", type=int, default=None)
 @click.option("--kv-quant", is_flag=True, help="int8 KV cache (halved decode HBM traffic).")
+@click.option("--weight-quant", is_flag=True, help="int8 weights (W8A16; halved weight HBM traffic).")
 @click.option("--host", default="127.0.0.1")
 @click.option("--port", type=int, default=8000)
 def serve_cmd(
@@ -28,6 +29,7 @@ def serve_cmd(
     slice_name: str | None,
     tensor_parallel: int | None,
     kv_quant: bool,
+    weight_quant: bool,
     host: str,
     port: int,
 ) -> None:
@@ -42,6 +44,7 @@ def serve_cmd(
             slice_name=slice_name,
             tensor_parallel=tensor_parallel,
             kv_quant=kv_quant,
+            weight_quant=weight_quant,
             host=host,
             port=port,
         )

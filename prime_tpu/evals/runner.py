@@ -80,6 +80,7 @@ class JaxGenerator:
         slice_name: str | None = None,
         tensor_parallel: int | None = None,
         kv_quant: bool = False,
+        weight_quant: bool = False,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -127,6 +128,13 @@ class JaxGenerator:
                 n_experts=self.config.n_experts or None,
             )
         self.mesh = mesh
+        # pure-argument validation first: neither failure below should cost a
+        # multi-GB checkpoint placement before surfacing
+        if weight_quant and mesh is not None and mesh.size > 1:
+            raise ValueError(
+                "weight_quant currently serves single-device only (the "
+                "quantized (q, scale) leaves have no sharding specs yet)"
+            )
         self._data_size = 1
         if mesh is not None:
             from prime_tpu.parallel.sharding import shard_params
@@ -139,6 +147,10 @@ class JaxGenerator:
                 )
             self._data_size = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
             self.params = shard_params(self.params, mesh, self.config)
+        if weight_quant:
+            from prime_tpu.models.quantize import quantize_params_int8
+
+            self.params = quantize_params_int8(self.params)
         self.kv_quant = kv_quant
         self._rng = jax.random.PRNGKey(0)
 

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from prime_tpu.models.config import ModelConfig
+from prime_tpu.models.quantize import matmul as _mm
 from prime_tpu.ops.attention import decode_attention, multi_head_attention
 from prime_tpu.ops.norms import rms_norm
 from prime_tpu.ops.rope import apply_rope, rope_frequencies
@@ -157,9 +158,9 @@ def _attention_block(
     cos, sin = rope_tables
 
     normed = rms_norm(x, lp["attn_norm"], config.rms_eps)
-    q = (normed @ lp["wq"]).reshape(batch, seq, h, hd)
-    k = (normed @ lp["wk"]).reshape(batch, seq, kh, hd)
-    v = (normed @ lp["wv"]).reshape(batch, seq, kh, hd)
+    q = _mm(normed, lp["wq"]).reshape(batch, seq, h, hd)
+    k = _mm(normed, lp["wk"]).reshape(batch, seq, kh, hd)
+    v = _mm(normed, lp["wv"]).reshape(batch, seq, kh, hd)
     q = apply_rope(q, positions, cos, sin)
     k = apply_rope(k, positions, cos, sin)
 
@@ -211,7 +212,7 @@ def _attention_block(
                 new_v_cache = jax.lax.dynamic_update_slice(v_cache, v_t, (0, 0, 0, 0))
 
     attn = attn.transpose(0, 2, 1, 3).reshape(batch, seq, h * hd)
-    return x + attn @ lp["wo"], new_k_cache, new_v_cache, new_k_scale, new_v_scale
+    return x + _mm(attn, lp["wo"]), new_k_cache, new_v_cache, new_k_scale, new_v_scale
 
 
 def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -230,9 +231,9 @@ def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> tuple[jnp.nda
             capacity_factor=config.capacity_factor,
         )
         return x + y, aux
-    gate = jax.nn.silu(normed @ lp["w_gate"])
-    up = normed @ lp["w_up"]
-    return x + (gate * up) @ lp["w_down"], jnp.zeros((), jnp.float32)
+    gate = jax.nn.silu(_mm(normed, lp["w_gate"]))
+    up = _mm(normed, lp["w_up"])
+    return x + _mm(gate * up, lp["w_down"]), jnp.zeros((), jnp.float32)
 
 
 def forward(

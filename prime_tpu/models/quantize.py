@@ -1,0 +1,73 @@
+"""int8 weight quantization for serving (W8A16).
+
+At small decode batches the weight matrices — not the KV cache — dominate
+HBM traffic (every step reads every layer's weights once), so int8 weights
+are the other half of the decode-bandwidth story next to the int8 KV cache.
+
+Scheme: per-output-channel symmetric int8. A quantized matrix is the pytree
+tuple ``(q int8 (..., in, out), scale fp32 (..., 1, out))`` and the matmul
+dequantizes by scaling the OUTPUT columns — ``x @ (q * s) == (x @ q) * s``
+exactly, so XLA reads int8 from HBM and fuses the convert + scale into the
+matmul epilogue; the fp weights are never materialized.
+
+Norms, embeddings, the router, and the LM head stay in their original dtype
+(gathers and the final fp32 logits matmul have different numerics); the
+seven big per-layer matrices are what move the bytes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+QUANTIZED_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_weight(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-output-channel (last axis) symmetric int8."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
+    scale = absmax / 127.0
+    q = jnp.round(w.astype(jnp.float32) / jnp.maximum(scale, 1e-12)).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_params_int8(params: dict) -> dict:
+    """Return a params tree whose big layer matrices are (int8, scale) tuples.
+
+    MoE expert stacks quantize the same way (the per-output-channel axis is
+    still the last one). The rest of the tree is shared by reference.
+    """
+    layers = dict(params["layers"])
+    for key in QUANTIZED_LAYER_KEYS:
+        if key in layers:
+            layers[key] = quantize_weight(layers[key])
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
+    """``x @ w`` where w may be a quantized (q, scale) tuple."""
+    if isinstance(w, tuple):
+        q, scale = w
+        # int8 read from HBM; convert fuses into the matmul, scale into its
+        # epilogue (output columns), so this is exact w.r.t. x @ (q*scale)
+        y = x @ q.astype(x.dtype)
+        return y * scale.astype(y.dtype)[..., 0, :]
+    return x @ w
+
+
+def einsum(spec: str, activations: jnp.ndarray, w, out_scale_shape) -> jnp.ndarray:
+    """``jnp.einsum(spec, activations, w)`` where w may be a quantized
+    (q, scale) tuple. ``out_scale_shape`` reshapes the per-output-channel
+    scale for broadcast against the einsum result (the scheme's single owner
+    lives here — callers never unpack the tuple themselves)."""
+    if isinstance(w, tuple):
+        q, scale = w
+        y = jnp.einsum(spec, activations, q.astype(activations.dtype))
+        return y * scale[..., 0, :].astype(y.dtype).reshape(out_scale_shape)
+    return jnp.einsum(spec, activations, w)
+
+
+def is_quantized(params: dict) -> bool:
+    return isinstance(params.get("layers", {}).get("wq"), tuple)

@@ -131,12 +131,19 @@ def moe_mlp(
     dispatch = dispatch.astype(x.dtype)   # (g, group, E, C)
     combine = combine.astype(x.dtype)
 
+    from prime_tpu.models.quantize import einsum as q_einsum
+
+    def expert_einsum(spec: str, activations: jnp.ndarray, weight, out_dim: int) -> jnp.ndarray:
+        # int8 (q, scale) pairs dequant via the scheme's single owner
+        return q_einsum(spec, activations, weight, (1, n_experts, 1, out_dim))
+
     # dispatch: (g,t,E,C)·(g,t,D) -> (g,E,C,D); under an ep-sharded expert dim
     # GSPMD turns the token contraction into the all-to-all over ICI
     expert_in = jnp.einsum("gtec,gtd->gecd", dispatch, x_groups)
-    gate = jax.nn.silu(jnp.einsum("gecd,edf->gecf", expert_in, w_gate))
-    up = jnp.einsum("gecd,edf->gecf", expert_in, w_up)
-    expert_out = jnp.einsum("gecf,efd->gecd", gate * up, w_down)
+    ff = w_gate[0].shape[-1] if isinstance(w_gate, tuple) else w_gate.shape[-1]
+    gate = jax.nn.silu(expert_einsum("gecd,edf->gecf", expert_in, w_gate, ff))
+    up = expert_einsum("gecd,edf->gecf", expert_in, w_up, ff)
+    expert_out = expert_einsum("gecf,efd->gecd", gate * up, w_down, d_model)
     y = jnp.einsum("gtec,gecd->gtd", combine, expert_out)
     y = y.reshape(padded, d_model)[:tokens]
     return y.reshape(batch, seq, d_model), jnp.mean(aux_loss)

@@ -222,6 +222,7 @@ def serve_model(
     slice_name: str | None = None,
     tensor_parallel: int | None = None,
     kv_quant: bool = False,
+    weight_quant: bool = False,
     host: str = "127.0.0.1",
     port: int = 8000,
 ) -> InferenceServer:
@@ -237,6 +238,7 @@ def serve_model(
             slice_name=slice_name,
             tensor_parallel=tensor_parallel,
             kv_quant=kv_quant,
+            weight_quant=weight_quant,
         )
     except BaseException:
         server.stop()  # don't leak the bound listener when the model fails to load

@@ -222,3 +222,61 @@ def test_pallas_decode_refused_for_quantized_cache():
     with pytest.raises(ValueError, match="int8-cache"):
         decode_attention(q, kq, kq, jnp.ones((1,), jnp.int32), 1.0,
                          impl="pallas", k_scale=scale, v_scale=scale)
+
+
+def test_int8_weights_logits_close_and_bytes_halved(params):
+    from prime_tpu.models.quantize import is_quantized, quantize_params_int8
+
+    qparams = quantize_params_int8(params)
+    assert is_quantized(qparams)
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 10), 0, CFG.vocab_size)
+    fp_logits, _ = forward(params, tokens, CFG)
+    q_logits, _ = forward(qparams, tokens, CFG)
+    # int8 per-channel rounding: close in probability space
+    fp_probs = np.asarray(jax.nn.softmax(fp_logits, axis=-1))
+    q_probs = np.asarray(jax.nn.softmax(q_logits, axis=-1))
+    assert np.abs(fp_probs - q_probs).max() < 0.05
+    # argmax agreement stays high
+    agreement = (np.asarray(fp_logits).argmax(-1) == np.asarray(q_logits).argmax(-1)).mean()
+    assert agreement >= 0.9
+
+    big_fp = sum(w.nbytes for w in [params["layers"][k] for k in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")])
+    big_q8 = sum(
+        qparams["layers"][k][0].nbytes + qparams["layers"][k][1].nbytes
+        for k in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+    )
+    # fixture params are fp32: int8 + scales must be well under half
+    assert big_q8 < 0.3 * big_fp
+
+
+def test_int8_weights_generate_end_to_end(params):
+    from prime_tpu.models.quantize import quantize_params_int8
+    from prime_tpu.models.sampler import generate
+
+    qparams = quantize_params_int8(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(8), (2, 6), 1, CFG.vocab_size)
+    lengths = jnp.asarray([6, 4], jnp.int32)
+    result = generate(qparams, tokens, lengths, CFG, jax.random.PRNGKey(9), max_new_tokens=4)
+    assert result.tokens.shape == (2, 4)
+
+
+def test_int8_weights_moe_forward():
+    from prime_tpu.models import get_config
+    from prime_tpu.models.quantize import quantize_params_int8
+
+    moe_cfg = get_config("tiny-moe")
+    moe_params = init_params(jax.random.PRNGKey(0), moe_cfg, dtype=jnp.float32)
+    qparams = quantize_params_int8(moe_params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, moe_cfg.vocab_size)
+    fp_logits, _ = forward(moe_params, tokens, moe_cfg)
+    q_logits, _ = forward(qparams, tokens, moe_cfg)
+    fp_probs = np.asarray(jax.nn.softmax(fp_logits, axis=-1))
+    q_probs = np.asarray(jax.nn.softmax(q_logits, axis=-1))
+    assert np.abs(fp_probs - q_probs).max() < 0.08  # routing can amplify rounding
+
+
+def test_weight_quant_rejected_on_multi_device_mesh():
+    from prime_tpu.evals.runner import JaxGenerator
+
+    with pytest.raises(ValueError, match="single-device"):
+        JaxGenerator("tiny-test", slice_name="v5e-8", weight_quant=True)
