@@ -59,6 +59,7 @@ POLL_INTERVAL_S = 3.0
 )
 @click.option("--tp", "tensor_parallel", type=int, default=None, help="Tensor-parallel axis for --slice.")
 @click.option("--kv-quant", is_flag=True, help="int8 KV cache (halved decode HBM traffic).")
+@click.option("--weight-quant", is_flag=True, help="int8 weights (W8A16) for serving-side evals.")
 @output_options
 def run_eval_cmd(
     render: Renderer,
@@ -78,6 +79,7 @@ def run_eval_cmd(
     slice_name: str | None,
     tensor_parallel: int | None,
     kv_quant: bool,
+    weight_quant: bool,
 ) -> None:
     """Run ENV against a model (local TPU by default, --hosted for platform)."""
     from prime_tpu.evals.runner import EvalRunSpec, push_eval_results, run_eval
@@ -94,6 +96,8 @@ def run_eval_cmd(
         ]
         if kv_quant:
             ignored.append("--kv-quant")
+        if weight_quant:
+            ignored.append("--weight-quant")
         if not do_push:
             ignored.append("--no-push")
         if ignored:
@@ -170,6 +174,7 @@ def run_eval_cmd(
         slice_name=slice_name,
         tensor_parallel=tensor_parallel,
         kv_quant=kv_quant,
+        weight_quant=weight_quant,
     )
 
     def progress(done: int, total: int) -> None:

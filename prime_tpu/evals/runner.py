@@ -45,6 +45,7 @@ class EvalRunSpec:
     slice_name: str | None = None        # TPU slice (e.g. v5e-8) -> sharded generate
     tensor_parallel: int | None = None   # override tp axis (default: mesh_for_slice policy)
     kv_quant: bool = False               # int8 KV cache (halved decode HBM traffic)
+    weight_quant: bool = False           # int8 weights (W8A16)
     metadata: dict = field(default_factory=dict)
 
 
@@ -248,6 +249,7 @@ def run_eval(
             slice_name=spec.slice_name,
             tensor_parallel=spec.tensor_parallel,
             kv_quant=spec.kv_quant,
+            weight_quant=spec.weight_quant,
         )
 
     samples: list[EvalSample] = []

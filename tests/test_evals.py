@@ -256,3 +256,18 @@ def test_run_eval_with_kv_quant(tmp_path):
     )
     result = run_eval(spec)
     assert result.metrics["num_samples"] == 2
+
+
+def test_run_eval_with_weight_quant(tmp_path):
+    spec = EvalRunSpec(
+        env="arith",
+        model="tiny-test",
+        limit=2,
+        batch_size=2,
+        max_new_tokens=6,
+        output_dir=str(tmp_path),
+        weight_quant=True,
+        kv_quant=True,
+    )
+    result = run_eval(spec)
+    assert result.metrics["num_samples"] == 2
