@@ -433,6 +433,89 @@ def _render_sample_table(render: Renderer, rows: list[dict], sample_count: int) 
     )
 
 
+@eval_group.command("compare")
+@click.argument("run_a")
+@click.argument("run_b")
+@click.option("--samples", "show_samples", type=int, default=10, help="Flipped samples to show.")
+@output_options
+def compare_cmd(render: Renderer, run_a: str, run_b: str, show_samples: int) -> None:
+    """Compare two local eval run dirs: metric deltas and per-sample flips."""
+    import json as _json
+
+    def load_run(target: str):
+        run_dir = Path(target)
+        if not run_dir.is_dir() or not (run_dir / "metadata.json").exists():
+            raise click.ClickException(f"{target!r} is not an eval run directory")
+        metadata = _json.loads((run_dir / "metadata.json").read_text())
+        samples = {}
+        results = run_dir / "results.jsonl"
+        if results.exists():
+            for line in results.read_text().splitlines():
+                if line.strip():
+                    row = _json.loads(line)
+                    samples[row.get("prompt", row.get("sample_id"))] = row
+        return metadata, samples
+
+    meta_a, samples_a = load_run(run_a)
+    meta_b, samples_b = load_run(run_b)
+    metrics_a = meta_a.get("metrics", {})
+    metrics_b = meta_b.get("metrics", {})
+    def delta_of(a, b):
+        # a delta only makes sense when BOTH runs recorded the metric
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+            return b - a
+        return None
+
+    deltas = {
+        key: {
+            "a": metrics_a.get(key),
+            "b": metrics_b.get(key),
+            "delta": delta_of(metrics_a.get(key), metrics_b.get(key)),
+        }
+        for key in sorted(set(metrics_a) | set(metrics_b))
+        if isinstance(metrics_a.get(key), (int, float)) or isinstance(metrics_b.get(key), (int, float))
+    }
+
+    shared = set(samples_a) & set(samples_b)
+    regressions = [
+        key for key in shared
+        if samples_a[key].get("correct") and not samples_b[key].get("correct")
+    ]
+    improvements = [
+        key for key in shared
+        if not samples_a[key].get("correct") and samples_b[key].get("correct")
+    ]
+    payload = {
+        "runA": run_a,
+        "runB": run_b,
+        "metrics": deltas,
+        "sharedSamples": len(shared),
+        "regressions": len(regressions),
+        "improvements": len(improvements),
+    }
+    if render.is_json:
+        payload["regressedPrompts"] = regressions[:show_samples]
+        payload["improvedPrompts"] = improvements[:show_samples]
+        render.json(payload)
+        return
+    render.table(
+        ["METRIC", "A", "B", "DELTA"],
+        [
+            [key, f"{d['a']:.4g}" if d["a"] is not None else "—",
+             f"{d['b']:.4g}" if d["b"] is not None else "—",
+             f"{d['delta']:+.4g}" if d["delta"] is not None else "—"]
+            for key, d in deltas.items()
+        ],
+        title=f"{meta_a.get('env')}/{meta_a.get('model')} vs {meta_b.get('env')}/{meta_b.get('model')}",
+        json_rows=None,
+    )
+    render.message(
+        f"{len(shared)} shared samples: {len(improvements)} improved, {len(regressions)} regressed"
+    )
+    for key in regressions[:show_samples]:
+        render.message(f"  regressed: {str(key)[:90]}")
+
+
 @eval_group.command("tui")
 @click.option("--dir", "workspace", default=".", type=click.Path())
 def eval_tui_cmd(workspace: str) -> None:

@@ -552,3 +552,42 @@ def test_eval_view_and_logs_hosted(runner, fake, monkeypatch):
 
     follow = runner.invoke(cli, ["eval", "logs", hid, "-f", "--plain"])
     assert "[COMPLETED]" in follow.output
+
+
+def test_eval_compare(runner, fake, tmp_path):
+    import json as _json
+
+    def make_run(name, rows, accuracy):
+        run_dir = tmp_path / name
+        run_dir.mkdir()
+        (run_dir / "metadata.json").write_text(_json.dumps({
+            "env": "arith", "model": "m", "metrics": {"accuracy": accuracy},
+        }))
+        (run_dir / "results.jsonl").write_text(
+            "\n".join(_json.dumps(r) for r in rows)
+        )
+        return run_dir
+
+    a = make_run("a", [
+        {"prompt": "1+1", "correct": True},
+        {"prompt": "2+2", "correct": True},
+        {"prompt": "3+3", "correct": False},
+    ], 0.67)
+    b = make_run("b", [
+        {"prompt": "1+1", "correct": True},
+        {"prompt": "2+2", "correct": False},   # regression
+        {"prompt": "3+3", "correct": True},    # improvement
+    ], 0.67)
+
+    result = runner.invoke(cli, ["eval", "compare", str(a), str(b), "--output", "json"])
+    assert result.exit_code == 0, result.output
+    data = json.loads(result.output)
+    assert data["regressions"] == 1 and data["improvements"] == 1
+    assert data["regressedPrompts"] == ["2+2"]
+
+    plain = runner.invoke(cli, ["eval", "compare", str(a), str(b), "--plain"])
+    assert "1 improved, 1 regressed" in plain.output
+    assert "regressed: 2+2" in plain.output
+
+    bad = runner.invoke(cli, ["eval", "compare", str(tmp_path / "nope"), str(b)])
+    assert bad.exit_code != 0
