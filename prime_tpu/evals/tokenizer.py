@@ -57,6 +57,18 @@ class HFTokenizer:
     def decode(self, ids: list[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
 
+    def render_chat(self, messages: list[dict[str, str]]) -> str | None:
+        """Model-faithful chat formatting when the tokenizer ships a chat
+        template; None lets the caller fall back to a generic template."""
+        if not getattr(self._tok, "chat_template", None):
+            return None
+        try:
+            return self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True
+            )
+        except Exception:  # noqa: BLE001 — malformed templates fall back
+            return None
+
 
 def load_tokenizer(name_or_path: str | None) -> Tokenizer:
     """Load a tokenizer. An explicitly named tokenizer that fails to load is

@@ -150,7 +150,14 @@ class InferenceServer:
             return 400, {"error": {"message": "max_tokens must be >= 1"}}
         if not 0.0 < top_p <= 1.0:
             return 400, {"error": {"message": "top_p must be in (0, 1]"}}
-        prompt = render_chat_prompt(messages)
+        prompt = None
+        # model-faithful formatting first: a tokenizer chat template (e.g. a
+        # served HF checkpoint) beats the generic role-tagged fallback
+        tokenizer = getattr(self.generator, "tokenizer", None)
+        if tokenizer is not None and hasattr(tokenizer, "render_chat"):
+            prompt = tokenizer.render_chat(messages)
+        if prompt is None:
+            prompt = render_chat_prompt(messages)
         kwargs = {"top_p": top_p} if top_p < 1.0 else {}
         try:
             with self._lock:

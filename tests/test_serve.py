@@ -215,3 +215,45 @@ def test_top_p_validation_and_passthrough(server):
     )
     assert ok.status_code == 200
     assert server.generator.calls[-1][3] == 0.9  # top_p reached the generator
+
+
+def test_chat_template_preferred_over_generic():
+    """A generator exposing a tokenizer with render_chat gets model-faithful
+    formatting; returning None falls back to the generic template."""
+    prompts_seen = []
+
+    class TemplatedTokenizer:
+        def render_chat(self, messages):
+            return "<|chat|>" + messages[-1]["content"] + "<|assistant|>"
+
+    class Gen:
+        tokenizer = TemplatedTokenizer()
+
+        def generate(self, prompts, max_new_tokens, temperature, top_p=1.0):
+            prompts_seen.extend(prompts)
+            return ["ok"] * len(prompts)
+
+    with InferenceServer("tiny-test", Gen(), port=0) as srv:
+        r = httpx.post(
+            f"{srv.url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "hi"}]},
+            timeout=30,
+        )
+        assert r.status_code == 200
+    assert prompts_seen == ["<|chat|>hi<|assistant|>"]
+
+    class NoneTokenizer:
+        def render_chat(self, messages):
+            return None
+
+    class Gen2(Gen):
+        tokenizer = NoneTokenizer()
+
+    prompts_seen.clear()
+    with InferenceServer("tiny-test", Gen2(), port=0) as srv:
+        httpx.post(
+            f"{srv.url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "hi"}]},
+            timeout=30,
+        )
+    assert prompts_seen == ["user: hi\nassistant:"]
