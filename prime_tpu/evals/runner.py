@@ -26,7 +26,12 @@ from prime_tpu.evals.tokenizer import Tokenizer, load_tokenizer
 
 class Generator(Protocol):
     def generate(
-        self, prompts: list[str], max_new_tokens: int, temperature: float, top_p: float = 1.0
+        self,
+        prompts: list[str],
+        max_new_tokens: int,
+        temperature: float,
+        top_p: float = 1.0,
+        templated: bool = False,
     ) -> list[str]: ...
 
 
@@ -161,6 +166,7 @@ class JaxGenerator:
         max_new_tokens: int,
         temperature: float,
         top_p: float = 1.0,
+        templated: bool = False,  # prompts already carry BOS/chat headers
     ) -> list[str]:
         import jax
         import jax.numpy as jnp
@@ -173,7 +179,10 @@ class JaxGenerator:
                 f"max_seq_len ({self.config.max_seq_len})"
             )
         keep = self.config.max_seq_len - max_new_tokens
-        encoded = [self.tokenizer.encode(p)[-keep:] for p in prompts]
+        encoded = [
+            self.tokenizer.encode(p, add_special_tokens=not templated)[-keep:]
+            for p in prompts
+        ]
         n_real = len(encoded)
         pad_id = self.tokenizer.pad_id
         # SPMD needs the batch divisible by the data axes; pad with dummy rows

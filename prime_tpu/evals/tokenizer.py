@@ -14,7 +14,7 @@ class Tokenizer(Protocol):
     eos_id: int
     pad_id: int
 
-    def encode(self, text: str) -> list[int]: ...
+    def encode(self, text: str, add_special_tokens: bool = True) -> list[int]: ...
     def decode(self, ids: list[int]) -> str: ...
 
 
@@ -29,8 +29,9 @@ class ByteTokenizer:
         self.eos_id = 2
         self.vocab_size = 256 + self.OFFSET
 
-    def encode(self, text: str) -> list[int]:
-        return [self.bos_id] + [b + self.OFFSET for b in text.encode("utf-8")]
+    def encode(self, text: str, add_special_tokens: bool = True) -> list[int]:
+        bos = [self.bos_id] if add_special_tokens else []
+        return bos + [b + self.OFFSET for b in text.encode("utf-8")]
 
     def decode(self, ids: list[int]) -> str:
         # ids beyond the byte range (possible with models whose vocab is
@@ -51,8 +52,10 @@ class HFTokenizer:
         self.pad_id = pad if pad is not None else (self.eos_id if self.eos_id >= 0 else 0)
         self.vocab_size = len(self._tok)
 
-    def encode(self, text: str) -> list[int]:
-        return self._tok.encode(text)
+    def encode(self, text: str, add_special_tokens: bool = True) -> list[int]:
+        # templated prompts (render_chat) already carry BOS/headers — encoding
+        # them with specials would double the BOS and skew generation
+        return self._tok.encode(text, add_special_tokens=add_special_tokens)
 
     def decode(self, ids: list[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)

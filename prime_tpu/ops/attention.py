@@ -45,12 +45,36 @@ def xla_attention_causal(
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
 
 
+def _flash_decode_min_capacity() -> int:
+    import os
+    import warnings
+
+    raw = os.environ.get("PRIME_TPU_FLASH_DECODE_MIN_C", "2048")
+    try:
+        return int(raw)
+    except ValueError:
+        warnings.warn(
+            f"PRIME_TPU_FLASH_DECODE_MIN_C={raw!r} is not an integer; "
+            "using the default of 2048",
+            stacklevel=2,
+        )
+        return 2048
+
+
 def _decode_pallas_eligible(k_cache: jnp.ndarray) -> bool:
     if jax.default_backend() != "tpu":
         return False
     capacity = k_cache.shape[3]
     from prime_tpu.ops.pallas_attention import BLOCK_C
 
+    # Short caches: XLA wins. The decode step is weight-bandwidth-bound; at
+    # small capacity the KV read is a rounding error (67 MB vs 2.5 GB of
+    # weights for llama3.2-1b at C=256) and the kernel's launch/tiling
+    # overhead is a net loss — measured on v5e-1: XLA 1597 tok/s vs pallas
+    # 1438 at b8 p128+128. Flash-decode's per-sequence early exit only pays
+    # once the cache itself is a meaningful fraction of step bytes.
+    if capacity < _flash_decode_min_capacity():
+        return False
     # full (D, C) kv head blocks live in VMEM; cap C so two of them fit easily
     return capacity % BLOCK_C == 0 and capacity * k_cache.shape[2] <= 2**22
 
@@ -67,10 +91,13 @@ def decode_attention(
 ) -> jnp.ndarray:
     """One decode step against the cache, masking invalid (future) slots.
 
-    On TPU this dispatches to the pallas flash-decode kernel (early-exit at
-    each sequence's true length, one fused HBM pass). The XLA fallback is a
-    grouped einsum — GQA without jnp.repeat, so the cache is never
-    materialized per-query-head.
+    On TPU with a long cache (capacity >= PRIME_TPU_FLASH_DECODE_MIN_C,
+    default 2048) this dispatches to the pallas flash-decode kernel
+    (early-exit at each sequence's true length, one fused HBM pass). Short
+    caches use the XLA path even on TPU: decode is weight-bandwidth-bound
+    there and the kernel overhead is a measured net loss (see
+    _decode_pallas_eligible). The XLA path is a grouped einsum — GQA without
+    jnp.repeat, so the cache is never materialized per-query-head.
 
     Callers running under a multi-device mesh must pass ``impl="xla"``:
     a pallas_call is not SPMD-partitionable, so the kernel is only valid when

@@ -15,6 +15,8 @@ chat template upstream for model-faithful formatting.
 
 from __future__ import annotations
 
+import functools
+import inspect
 import json
 import threading
 import time
@@ -23,6 +25,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
 CHAT_TEMPLATE = "{role}: {content}\n"
+
+
+@functools.lru_cache(maxsize=64)
+def _accepts_kwarg(fn, name: str) -> bool:
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return True  # unintrospectable callables: assume the full protocol
+    return name in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    )
 
 
 def render_chat_prompt(messages: list[dict[str, str]]) -> str:
@@ -156,9 +169,15 @@ class InferenceServer:
         tokenizer = getattr(self.generator, "tokenizer", None)
         if tokenizer is not None and hasattr(tokenizer, "render_chat"):
             prompt = tokenizer.render_chat(messages)
-        if prompt is None:
-            prompt = render_chat_prompt(messages)
         kwargs = {"top_p": top_p} if top_p < 1.0 else {}
+        if prompt is not None:
+            # the template already renders BOS/headers — the generator must
+            # not add special tokens again (double BOS skews generation).
+            # Providers written before this kwarg existed keep working.
+            if _accepts_kwarg(self.generator.generate, "templated"):
+                kwargs["templated"] = True
+        else:
+            prompt = render_chat_prompt(messages)
         try:
             with self._lock:
                 completion = self.generator.generate(

@@ -226,11 +226,14 @@ def test_chat_template_preferred_over_generic():
         def render_chat(self, messages):
             return "<|chat|>" + messages[-1]["content"] + "<|assistant|>"
 
+    templated_flags = []
+
     class Gen:
         tokenizer = TemplatedTokenizer()
 
-        def generate(self, prompts, max_new_tokens, temperature, top_p=1.0):
+        def generate(self, prompts, max_new_tokens, temperature, top_p=1.0, templated=False):
             prompts_seen.extend(prompts)
+            templated_flags.append(templated)
             return ["ok"] * len(prompts)
 
     with InferenceServer("tiny-test", Gen(), port=0) as srv:
@@ -240,6 +243,28 @@ def test_chat_template_preferred_over_generic():
             timeout=30,
         )
         assert r.status_code == 200
+    assert prompts_seen == ["<|chat|>hi<|assistant|>"]
+    # templated prompts carry their own BOS/headers: the generator must be
+    # told not to add special tokens again (the double-BOS regression)
+    assert templated_flags == [True]
+
+    class OldSignatureGen:
+        """A provider written before the templated kwarg existed."""
+
+        tokenizer = TemplatedTokenizer()
+
+        def generate(self, prompts, max_new_tokens, temperature, top_p=1.0):
+            prompts_seen.extend(prompts)
+            return ["ok"] * len(prompts)
+
+    prompts_seen.clear()
+    with InferenceServer("tiny-test", OldSignatureGen(), port=0) as srv:
+        r = httpx.post(
+            f"{srv.url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "hi"}]},
+            timeout=30,
+        )
+        assert r.status_code == 200  # no TypeError 500: kwarg withheld
     assert prompts_seen == ["<|chat|>hi<|assistant|>"]
 
     class NoneTokenizer:
