@@ -22,6 +22,20 @@ import click
 @click.option("--weight-quant", is_flag=True, help="int8 weights (W8A16; halved weight HBM traffic).")
 @click.option("--host", default="127.0.0.1")
 @click.option("--port", type=int, default=8000)
+@click.option(
+    "--continuous", is_flag=True,
+    help="Continuous batching: concurrent requests share the chip via KV-cache "
+    "slots; streaming emits tokens as they decode.",
+)
+@click.option("--slots", type=int, default=8, help="Max concurrent requests (--continuous).")
+@click.option(
+    "--slot-capacity", type=int, default=2048,
+    help="Per-request KV capacity in tokens (--continuous).",
+)
+@click.option(
+    "--chunk", type=int, default=8,
+    help="Decode steps per dispatch — lower admits new requests sooner (--continuous).",
+)
 def serve_cmd(
     model: str,
     checkpoint: str | None,
@@ -32,6 +46,10 @@ def serve_cmd(
     weight_quant: bool,
     host: str,
     port: int,
+    continuous: bool,
+    slots: int,
+    slot_capacity: int,
+    chunk: int,
 ) -> None:
     """Serve MODEL over an OpenAI-compatible HTTP API (blocks until Ctrl-C)."""
     from prime_tpu.serve import serve_model
@@ -47,6 +65,10 @@ def serve_cmd(
             weight_quant=weight_quant,
             host=host,
             port=port,
+            continuous=continuous,
+            max_slots=slots,
+            slot_capacity=slot_capacity,
+            chunk=chunk,
         )
     except (ValueError, OSError) as e:
         raise click.ClickException(str(e)) from None

@@ -9,4 +9,21 @@ InferenceClient (api/inference.py) talks to it unchanged.
 
 from prime_tpu.serve.server import InferenceServer, serve_model
 
-__all__ = ["InferenceServer", "serve_model"]
+
+def __getattr__(name: str):
+    # engine classes import jax-adjacent modules; keep `import prime_tpu.serve`
+    # light for CLI startup (the lazy-import contract, SURVEY.md §1)
+    if name in ("ContinuousBatchingEngine", "EngineBackend", "EngineRequest"):
+        from prime_tpu.serve import engine
+
+        return getattr(engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "ContinuousBatchingEngine",
+    "EngineBackend",
+    "EngineRequest",
+    "InferenceServer",
+    "serve_model",
+]

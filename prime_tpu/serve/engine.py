@@ -1,0 +1,584 @@
+"""Continuous-batching inference engine: slot-based serving on static shapes.
+
+The reference platform serves models behind a hosted OpenAI-compatible API
+(reference: packages/prime/src/prime_cli/api/inference.py is the client side);
+this module is the TPU-native serving interior that plays the server role
+locally. Design follows the JetStream/vLLM-era insight adapted to XLA's
+compilation model:
+
+- **Slots, not requests.** The KV cache is one fixed (L, S, KH, hd, C) block
+  where S = max concurrent slots. A request is *admitted* into a free slot
+  (bucketed prefill writes its KV row), decoded as part of the batched decode
+  program, and *retired* on EOS/max_tokens — the slot is immediately reusable
+  while other slots keep decoding. No shape ever changes, so XLA compiles
+  exactly one decode program plus one prefill program per prompt bucket.
+- **Chunked decode.** Decode dispatches in chunks of T steps (one
+  ``lax.scan``), amortizing host dispatch over T tokens while keeping
+  admission latency bounded at T steps.
+- **Per-slot sampling state is traced.** temperature/top_p enter as (S,)
+  vectors, so requests with different sampling settings share one compiled
+  program — a per-request recompile would defeat continuous batching. The
+  nucleus (top-p) sort only runs when some active request asked for it
+  (``lax.cond`` on the traced predicate).
+
+Single-chip by default; pass ``mesh`` + ``cache_spec`` (from
+parallel.sharding) to run the same engine over a TPU slice — decode then
+takes the XLA attention path, which partitions under SPMD.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+MIN_BUCKET = 16
+NEG_INF = -1e30
+
+
+def bucket_for(length: int, capacity: int) -> int:
+    """Smallest power-of-two bucket (>= MIN_BUCKET, <= capacity) holding
+    ``length`` — bounds the number of compiled prefill programs."""
+    if length > capacity:
+        raise ValueError(f"prompt of {length} tokens does not fit capacity {capacity}")
+    b = MIN_BUCKET
+    while b < length:
+        b *= 2
+    return min(b, capacity)
+
+
+@dataclass
+class EngineRequest:
+    """One in-flight generation. ``events`` receives lists of token ids as
+    they decode, then ``None`` when the request is finished."""
+
+    id: int
+    prompt_ids: list[int]
+    max_new_tokens: int
+    temperature: float
+    top_p: float
+    events: queue.Queue = field(default_factory=queue.Queue)
+    emitted: int = 0
+    slot: int = -1
+    done: bool = False
+    cancelled: bool = False
+    error: str | None = None
+
+    def cancel(self) -> None:
+        """Abandon the request (e.g. the streaming client disconnected). The
+        engine retires the slot at the next chunk boundary instead of decoding
+        the rest of max_new_tokens for nobody."""
+        self.cancelled = True
+
+    def tokens(self, timeout: float | None = 120.0):
+        """Iterate over token-id batches until the request finishes."""
+        while True:
+            item = self.events.get(timeout=timeout)
+            if item is None:
+                if self.error:
+                    raise RuntimeError(self.error)
+                return
+            yield item
+
+    def all_tokens(self, timeout: float | None = 120.0) -> list[int]:
+        out: list[int] = []
+        for batch in self.tokens(timeout=timeout):
+            out.extend(batch)
+        return out
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching over prime_tpu.models.llama.
+
+    Thread model: callers ``submit()`` from any thread; one background engine
+    thread (``start()``) owns all device state and alternates admission
+    (prefill) with decode chunks. Tests drive it synchronously with ``tick()``.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        config: Any,
+        *,
+        eos_id: int = -1,
+        pad_id: int = 0,
+        max_slots: int = 8,
+        capacity: int = 2048,
+        chunk: int = 8,
+        mesh: Any = None,
+        cache_spec: Any = None,
+        attn_impl: str = "auto",
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from prime_tpu.models.llama import init_cache
+
+        self.params = params
+        self.config = config
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        self.max_slots = max_slots
+        self.capacity = capacity
+        self.chunk = chunk
+        self.mesh = mesh
+        self.cache_spec = cache_spec
+        # a pallas_call cannot partition under SPMD jit: any multi-device mesh
+        # must take the XLA decode path (same rule as evals.runner.JaxGenerator)
+        if mesh is not None and getattr(mesh, "size", 1) > 1 and attn_impl == "auto":
+            attn_impl = "xla"
+        self.attn_impl = attn_impl
+
+        self._dtype = jax.tree_util.tree_leaves(params)[0].dtype
+        self._requests: dict[int, EngineRequest] = {}  # slot -> request
+        self._active = np.zeros((max_slots,), dtype=bool)  # host-side admission map
+        self._rng = jax.random.PRNGKey(0)
+        self._init_device_state()
+        self._pending: queue.Queue[EngineRequest | None] = queue.Queue()
+        self._ids = itertools.count()
+        self._thread: threading.Thread | None = None
+        self._running = False
+        # one jitted program each: jit's own shape-keyed cache gives
+        # one-compile-per-prompt-bucket without a bucket-keyed dict here
+        self._prefill_fn: Any = None
+        self._decode_fn: Any = None
+
+    def _init_device_state(self) -> None:
+        """(Re)allocate the slot cache and per-slot vectors — used at
+        construction and to recover after a failed decode dispatch (donated
+        buffers are invalid once their call raises)."""
+        import jax
+        import jax.numpy as jnp
+
+        from prime_tpu.models.llama import init_cache
+
+        cache = init_cache(self.config, self.max_slots, self.capacity, dtype=self._dtype)
+        if self.cache_spec is not None and self.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            sharding = NamedSharding(self.mesh, self.cache_spec)
+            cache = cache._replace(
+                k=jax.device_put(cache.k, sharding), v=jax.device_put(cache.v, sharding)
+            )
+        self._k = cache.k
+        self._v = cache.v
+        self._lengths = jnp.zeros((self.max_slots,), dtype=jnp.int32)
+        self._last = jnp.zeros((self.max_slots,), dtype=jnp.int32)
+        self._temps = jnp.zeros((self.max_slots,), dtype=jnp.float32)
+        self._top_ps = jnp.ones((self.max_slots,), dtype=jnp.float32)
+
+    def _mesh_ctx(self):
+        """Mesh context for compiled calls — the engine thread does not
+        inherit a caller's jax.set_mesh, so every dispatch site enters it."""
+        import contextlib
+
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.set_mesh(self.mesh)
+
+    # ---- compiled programs ----
+
+    def _make_prefill(self):
+        import jax
+        import jax.numpy as jnp
+
+        from prime_tpu.models.llama import forward, init_cache
+
+        config, capacity, attn_impl = self.config, self.capacity, self.attn_impl
+        cache_spec = self.cache_spec
+
+        def prefill(
+            params, k, v, lengths, last, temps, top_ps,
+            tokens, length, slot, temp, top_p, rng,
+        ):
+            # run the prompt through a fresh single-row cache, then splice the
+            # row into the engine cache at ``slot`` — the engine cache is
+            # donated, so XLA updates it in place
+            row = init_cache(config, 1, capacity, dtype=k.dtype)
+            logits, row = forward(
+                params, tokens, config, cache=row, decode=False, attn_impl=attn_impl
+            )
+            new_k = jax.lax.dynamic_update_slice(k, row.k, (0, slot, 0, 0, 0))
+            new_v = jax.lax.dynamic_update_slice(v, row.v, (0, slot, 0, 0, 0))
+            if cache_spec is not None:
+                new_k = jax.lax.with_sharding_constraint(new_k, cache_spec)
+                new_v = jax.lax.with_sharding_constraint(new_v, cache_spec)
+            last_logits = jnp.take_along_axis(
+                logits, (length - 1)[None, None, None], axis=1
+            )[0, 0]
+            first = _sample_batch(last_logits[None, :], temp[None], top_p[None], rng)[0]
+            # the first sampled token's KV is not in the cache yet: the next
+            # decode step writes it at position ``length`` (put() scatters at
+            # cache_lengths), so the slot length stays the prompt length here
+            new_lengths = lengths.at[slot].set(length)
+            new_last = last.at[slot].set(first)
+            new_temps = temps.at[slot].set(temp)
+            new_top_ps = top_ps.at[slot].set(top_p)
+            return new_k, new_v, new_lengths, new_last, new_temps, new_top_ps, first
+
+        return jax.jit(prefill, donate_argnums=(1, 2, 3, 4, 5, 6))
+
+    def _make_decode(self):
+        import jax
+        import jax.numpy as jnp
+
+        from prime_tpu.models.llama import KVCache, forward
+
+        config, attn_impl, chunk = self.config, self.attn_impl, self.chunk
+        cache_spec = self.cache_spec
+
+        def decode(params, k, v, lengths, last, temps, top_ps, active, rng):
+            # neutralize retired slots' stale sampling params: a finished
+            # nucleus request must not keep the vocab-sort branch live for
+            # later greedy-only traffic (outputs of inactive slots are
+            # discarded host-side, so forcing them greedy is free)
+            temps = jnp.where(active, temps, 0.0)
+            top_ps = jnp.where(active, top_ps, 1.0)
+            cache = KVCache(k=k, v=v, lengths=lengths)
+
+            def step(carry, _):
+                cache, tok, rng = carry
+                logits, new_cache = forward(
+                    params,
+                    tok[:, None],
+                    config,
+                    positions=cache.lengths[:, None],
+                    cache=cache,
+                    decode=True,
+                    attn_impl=attn_impl,
+                )
+                if cache_spec is not None:
+                    new_cache = new_cache._replace(
+                        k=jax.lax.with_sharding_constraint(new_cache.k, cache_spec),
+                        v=jax.lax.with_sharding_constraint(new_cache.v, cache_spec),
+                    )
+                # inactive slots must not advance: their next admission
+                # prefills the slot from position 0 again
+                new_cache = new_cache._replace(
+                    lengths=jnp.where(active, new_cache.lengths, cache.lengths)
+                )
+                rng, step_rng = jax.random.split(rng)
+                sampled = _sample_batch(logits[:, 0, :], temps, top_ps, step_rng)
+                return (new_cache, sampled, rng), sampled
+
+            (cache, tok, rng), toks = jax.lax.scan(
+                step, (cache, last, rng), None, length=chunk
+            )
+            return cache.k, cache.v, cache.lengths, tok, toks.T  # toks (S, T)
+
+        return jax.jit(decode, donate_argnums=(1, 2, 3, 4))
+
+    # ---- public API ----
+
+    def submit(
+        self,
+        prompt_ids: list[int],
+        max_new_tokens: int = 128,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+    ) -> EngineRequest:
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        if len(prompt_ids) + max_new_tokens > self.capacity:
+            raise ValueError(
+                f"prompt ({len(prompt_ids)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds slot capacity ({self.capacity})"
+            )
+        req = EngineRequest(
+            id=next(self._ids),
+            prompt_ids=list(prompt_ids),
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            top_p=top_p,
+        )
+        self._pending.put(req)
+        return req
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._running = False
+        self._pending.put(None)  # wake the engine thread
+        if self._thread is not None:
+            self._thread.join(timeout=60)
+            self._thread = None
+        # fail everything still waiting so clients get a prompt error instead
+        # of hanging until their events.get timeout
+        self._fail_in_flight("engine shut down")
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                req.error = "engine shut down"
+                req.done = True
+                req.events.put(None)
+
+    def _fail_in_flight(self, message: str) -> None:
+        for slot, req in list(self._requests.items()):
+            req.error = message
+            req.done = True
+            req.events.put(None)
+            self._active[slot] = False
+            self._requests.pop(slot, None)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # ---- engine loop ----
+
+    def _run(self) -> None:
+        while self._running:
+            if not self.tick():
+                # idle: block until a request (or the shutdown sentinel) lands
+                try:
+                    item = self._pending.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                if item is None:
+                    continue
+                try:
+                    self._prefill(item, int(np.argmin(self._active)))
+                except Exception as e:  # noqa: BLE001 — keep the loop alive
+                    item.error = f"prefill failed: {e}"
+                    item.done = True
+                    item.events.put(None)
+
+    def tick(self) -> bool:
+        """One engine iteration: admit pending requests into free slots, then
+        decode one chunk. Returns False when there was nothing to do."""
+        admitted = self._admit()
+        self._retire_cancelled()
+        if not any(self._active):
+            return admitted
+        try:
+            self._decode_chunk()
+        except Exception as e:  # noqa: BLE001 — a dead engine hangs every client
+            # the decode jit donates the cache buffers, so a raised dispatch
+            # leaves them invalid: fail the in-flight requests promptly and
+            # reallocate device state so the engine keeps serving
+            self._fail_in_flight(f"decode failed: {e}")
+            self._init_device_state()
+        return True
+
+    def _retire_cancelled(self) -> None:
+        """Free slots whose client abandoned the request (disconnected
+        stream): decoding the rest of max_new_tokens for nobody would delay
+        admission of live requests."""
+        for slot, req in list(self._requests.items()):
+            if req.cancelled:
+                req.done = True
+                req.events.put(None)
+                self._active[slot] = False
+                self._requests.pop(slot, None)
+
+    def _admit(self) -> bool:
+        admitted = False
+        while True:
+            free = [s for s in range(self.max_slots) if not self._active[s]]
+            if not free:
+                return admitted
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                return admitted
+            if req is None:
+                continue
+            try:
+                self._prefill(req, free[0])
+                admitted = True
+            except Exception as e:  # noqa: BLE001 — bad request must not kill the loop
+                req.error = f"prefill failed: {e}"
+                req.done = True
+                req.events.put(None)
+
+    def _prefill(self, req: EngineRequest, slot: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        bucket = bucket_for(len(req.prompt_ids), self.capacity)
+        if self._prefill_fn is None:
+            self._prefill_fn = self._make_prefill()
+        padded = req.prompt_ids + [self.pad_id] * (bucket - len(req.prompt_ids))
+        tokens = jnp.asarray([padded], dtype=jnp.int32)
+        length = jnp.asarray(len(req.prompt_ids), dtype=jnp.int32)
+        self._rng, rng = jax.random.split(self._rng)
+        with self._mesh_ctx():
+            (
+                self._k, self._v, self._lengths, self._last,
+                self._temps, self._top_ps, first,
+            ) = self._prefill_fn(
+                self.params, self._k, self._v, self._lengths, self._last,
+                self._temps, self._top_ps, tokens, length,
+                jnp.asarray(slot, dtype=jnp.int32),
+                jnp.asarray(req.temperature, dtype=jnp.float32),
+                jnp.asarray(req.top_p, dtype=jnp.float32),
+                rng,
+            )
+        req.slot = slot
+        self._active[slot] = True
+        self._requests[slot] = req
+        self._emit(req, [int(first)])
+
+    def _decode_chunk(self) -> None:
+        import jax.numpy as jnp
+
+        import jax
+
+        if self._decode_fn is None:
+            self._decode_fn = self._make_decode()
+        self._rng, rng = jax.random.split(self._rng)
+        active = jnp.asarray(self._active)
+        with self._mesh_ctx():
+            self._k, self._v, self._lengths, self._last, toks = self._decode_fn(
+                self.params, self._k, self._v, self._lengths, self._last,
+                self._temps, self._top_ps, active, rng,
+            )
+        toks_host = np.asarray(toks)  # (S, T)
+        for slot in range(self.max_slots):
+            if self._active[slot]:
+                self._emit(self._requests[slot], toks_host[slot].tolist())
+
+    def _emit(self, req: EngineRequest, token_ids: list[int]) -> None:
+        """Feed decoded ids to the request, honoring EOS and max_new_tokens;
+        retire the slot when the request completes."""
+        out: list[int] = []
+        for t in token_ids:
+            if req.emitted >= req.max_new_tokens:
+                break
+            if t == self.eos_id:
+                req.done = True
+                break
+            out.append(t)
+            req.emitted += 1
+        if out:
+            req.events.put(out)
+        if req.done or req.emitted >= req.max_new_tokens:
+            req.done = True
+            if req.slot >= 0:
+                self._active[req.slot] = False
+                self._requests.pop(req.slot, None)
+            req.events.put(None)
+
+
+class EngineBackend:
+    """Joins a ContinuousBatchingEngine with a tokenizer — the backend
+    `prime serve --continuous` hands to InferenceServer. Exposes both the
+    blocking generate() protocol (non-streaming requests, eval runner
+    compatibility) and submit/stream for true per-token SSE."""
+
+    concurrent = True  # the server must NOT serialize requests behind a lock
+
+    def __init__(self, engine: ContinuousBatchingEngine, tokenizer: Any) -> None:
+        self.engine = engine
+        self.tokenizer = tokenizer
+
+    def submit_text(
+        self,
+        prompt: str,
+        max_new_tokens: int,
+        temperature: float,
+        top_p: float = 1.0,
+        templated: bool = False,
+    ) -> EngineRequest:
+        ids = self.tokenizer.encode(prompt, add_special_tokens=not templated)
+        # keep the tail if the prompt exceeds what the slot can hold
+        keep = self.engine.capacity - max_new_tokens
+        if keep <= 0:
+            raise ValueError(
+                f"max_new_tokens ({max_new_tokens}) leaves no room for a "
+                f"prompt in a slot of capacity {self.engine.capacity}"
+            )
+        return self.engine.submit(
+            ids[-keep:], max_new_tokens=max_new_tokens,
+            temperature=temperature, top_p=top_p,
+        )
+
+    def stream_text(self, req: EngineRequest, timeout: float | None = 120.0):
+        """Yield text deltas as the request decodes. Detokenization is
+        incremental: decode the accumulated ids each flush and emit the new
+        suffix, withholding trailing replacement chars (a partial multi-byte
+        sequence mid-token would otherwise flicker)."""
+        ids: list[int] = []
+        sent = ""
+        for batch in req.tokens(timeout=timeout):
+            ids.extend(batch)
+            full = self.tokenizer.decode(ids)
+            if full.startswith(sent):
+                delta = full[len(sent):]
+                if delta.endswith("�"):
+                    continue  # partial multi-byte sequence; wait for more ids
+                if delta:
+                    sent = full
+                    yield delta
+        full = self.tokenizer.decode(ids)
+        if full.startswith(sent) and len(full) > len(sent):
+            yield full[len(sent):]
+
+    def generate(
+        self,
+        prompts: list[str],
+        max_new_tokens: int,
+        temperature: float,
+        top_p: float = 1.0,
+        templated: bool = False,
+    ) -> list[str]:
+        reqs = [
+            self.submit_text(p, max_new_tokens, temperature, top_p, templated)
+            for p in prompts
+        ]
+        return [self.tokenizer.decode(r.all_tokens()) for r in reqs]
+
+    def shutdown(self) -> None:
+        self.engine.shutdown()
+
+
+def _sample_batch(logits, temps, top_ps, rng):
+    """Per-row sampling over (S, V) logits with traced (S,) temperature and
+    top_p. Greedy rows (temp == 0), plain-temperature rows, and nucleus rows
+    share one program; the vocab sort only executes when some row wants
+    nucleus (lax.cond picks the branch at runtime)."""
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+
+    def plain(scaled):
+        return scaled
+
+    def nucleus(scaled):
+        sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+        cumulative = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+        keep_sorted = jnp.concatenate(
+            [
+                jnp.ones_like(cumulative[..., :1], dtype=bool),
+                cumulative[..., :-1] < top_ps[:, None],
+            ],
+            axis=-1,
+        )
+        cutoff = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        return jnp.where(scaled >= cutoff, scaled, NEG_INF)
+
+    wants_nucleus = jnp.any((top_ps < 1.0) & (temps > 0.0))
+    filtered = jax.lax.cond(wants_nucleus, nucleus, plain, scaled)
+    sampled = jax.random.categorical(rng, filtered, axis=-1)
+    return jnp.where(temps == 0.0, greedy, sampled).astype(jnp.int32)

@@ -38,6 +38,19 @@ def _accepts_kwarg(fn, name: str) -> bool:
     )
 
 
+class _LiveStream:
+    """Marker wrapper: _chat returns this when a continuous-batching backend
+    is streaming deltas live (vs a finished completion to replay)."""
+
+    def __init__(self, deltas, request=None) -> None:
+        self.deltas = deltas
+        self.request = request
+
+    def cancel(self) -> None:
+        if self.request is not None and hasattr(self.request, "cancel"):
+            self.request.cancel()
+
+
 def render_chat_prompt(messages: list[dict[str, str]]) -> str:
     parts = [
         CHAT_TEMPLATE.format(role=m.get("role", "user"), content=m.get("content", ""))
@@ -96,23 +109,65 @@ class InferenceServer:
                 if not isinstance(request, dict):
                     self._json(400, {"error": {"message": "request body must be an object"}})
                     return
+                want_stream = bool(request.get("stream"))
                 try:
-                    response = outer._chat(request)
+                    response = outer._chat(request, stream=want_stream)
                 except Exception as e:  # noqa: BLE001 — a bad request must get a response
                     self._json(400, {"error": {"message": f"bad request: {e}"}})
                     return
                 if isinstance(response, tuple):  # (status, error payload)
                     self._json(*response)
                     return
-                if request.get("stream"):
-                    self._stream(response)
+                if isinstance(response, _LiveStream):
+                    self._stream_live(response)
+                elif want_stream:
+                    self._stream_replay(response)
                 else:
                     self._json(200, response)
 
-            def _stream(self, completion: dict) -> None:
+            def _sse_headers(self) -> None:
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.end_headers()
+
+            def _sse_chunk(self, base: dict, delta: dict, finish: str | None = None) -> None:
+                chunk = {
+                    **base,
+                    "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
+                }
+                self.wfile.write(f"data: {json.dumps(chunk)}\n\n".encode())
+
+            def _stream_live(self, live: "_LiveStream") -> None:
+                """True token-level streaming off a continuous-batching
+                backend: deltas are written as the engine decodes them."""
+                self._sse_headers()
+                base = {
+                    "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+                    "object": "chat.completion.chunk",
+                    "model": outer.model_id,
+                }
+                try:
+                    for delta in live.deltas:
+                        self._sse_chunk(base, {"content": delta})
+                    self._sse_chunk(base, {}, finish="stop")
+                    self.wfile.write(b"data: [DONE]\n\n")
+                except OSError:
+                    # client went away mid-stream: stop decoding for nobody;
+                    # writing a farewell chunk to the dead socket would raise
+                    live.cancel()
+                except Exception as e:  # noqa: BLE001 — generation failure
+                    live.cancel()
+                    try:
+                        self._sse_chunk(base, {"content": f"\n[error: {e}]"})
+                        self._sse_chunk(base, {}, finish="stop")
+                        self.wfile.write(b"data: [DONE]\n\n")
+                    except OSError:
+                        pass
+
+            def _stream_replay(self, completion: dict) -> None:
+                """SSE replay of an already-finished completion (one-shot
+                generator backends decode whole turns in one lax.scan)."""
+                self._sse_headers()
                 text = completion["choices"][0]["message"]["content"]
                 base = {
                     "id": completion["id"],
@@ -121,15 +176,8 @@ class InferenceServer:
                 }
                 step = 16
                 for start in range(0, max(len(text), 1), step):
-                    chunk = {
-                        **base,
-                        "choices": [
-                            {"index": 0, "delta": {"content": text[start : start + step]}}
-                        ],
-                    }
-                    self.wfile.write(f"data: {json.dumps(chunk)}\n\n".encode())
-                done = {**base, "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}]}
-                self.wfile.write(f"data: {json.dumps(done)}\n\n".encode())
+                    self._sse_chunk(base, {"content": text[start : start + step]})
+                self._sse_chunk(base, {}, finish="stop")
                 self.wfile.write(b"data: [DONE]\n\n")
 
         self._server = ThreadingHTTPServer((host, port), Handler)
@@ -137,7 +185,7 @@ class InferenceServer:
 
     # -- request handling -----------------------------------------------------
 
-    def _chat(self, request: dict) -> dict | tuple[int, dict]:
+    def _chat(self, request: dict, stream: bool = False):
         if self.generator is None:
             return 503, {"error": {"message": "model is still loading"}}
         messages = request.get("messages")
@@ -170,7 +218,8 @@ class InferenceServer:
         if tokenizer is not None and hasattr(tokenizer, "render_chat"):
             prompt = tokenizer.render_chat(messages)
         kwargs = {"top_p": top_p} if top_p < 1.0 else {}
-        if prompt is not None:
+        templated = prompt is not None
+        if templated:
             # the template already renders BOS/headers — the generator must
             # not add special tokens again (double BOS skews generation).
             # Providers written before this kwarg existed keep working.
@@ -178,11 +227,27 @@ class InferenceServer:
                 kwargs["templated"] = True
         else:
             prompt = render_chat_prompt(messages)
+        # continuous-batching backends stream live and batch across requests
+        # themselves — no lock, no whole-turn wait
+        if stream and hasattr(self.generator, "submit_text"):
+            try:
+                req = self.generator.submit_text(
+                    prompt, max_new_tokens=max_tokens, temperature=temperature,
+                    top_p=top_p, templated=templated,
+                )
+            except Exception as e:  # noqa: BLE001
+                return 500, {"error": {"message": f"generation failed: {e}"}}
+            return _LiveStream(self.generator.stream_text(req), request=req)
         try:
-            with self._lock:
+            if getattr(self.generator, "concurrent", False):
                 completion = self.generator.generate(
                     [prompt], max_new_tokens=max_tokens, temperature=temperature, **kwargs
                 )[0]
+            else:
+                with self._lock:
+                    completion = self.generator.generate(
+                        [prompt], max_new_tokens=max_tokens, temperature=temperature, **kwargs
+                    )[0]
         except Exception as e:  # noqa: BLE001 — surface as an API error, keep serving
             return 500, {"error": {"message": f"generation failed: {e}"}}
         return {
@@ -233,6 +298,8 @@ class InferenceServer:
             self._server.shutdown()
             self._serving = False
         self._server.server_close()
+        if hasattr(self.generator, "shutdown"):
+            self.generator.shutdown()  # stop a continuous-batching engine thread
 
     def __enter__(self) -> "InferenceServer":
         return self.start()
@@ -251,13 +318,27 @@ def serve_model(
     weight_quant: bool = False,
     host: str = "127.0.0.1",
     port: int = 8000,
+    continuous: bool = False,
+    max_slots: int = 8,
+    slot_capacity: int = 2048,
+    chunk: int = 8,
 ) -> InferenceServer:
-    """Bind the port, then build the (optionally sharded) generator."""
+    """Bind the port, then build the (optionally sharded) generator.
+
+    ``continuous=True`` serves through the slot-based continuous-batching
+    engine (serve/engine.py): concurrent requests share the chip via KV-cache
+    slots and streaming responses emit tokens as they decode, instead of one
+    whole-turn generation at a time behind a lock."""
     from prime_tpu.evals.runner import JaxGenerator
 
+    if continuous and kv_quant:
+        raise ValueError(
+            "--continuous does not support --kv-quant yet (the engine cache "
+            "is bf16; int8 KV serving uses the one-shot generator)"
+        )
     server = InferenceServer(model, host=host, port=port)  # fail fast on EADDRINUSE
     try:
-        server.generator = JaxGenerator(
+        generator = JaxGenerator(
             model,
             checkpoint=checkpoint,
             tokenizer=tokenizer,
@@ -266,6 +347,29 @@ def serve_model(
             kv_quant=kv_quant,
             weight_quant=weight_quant,
         )
+        if continuous:
+            from prime_tpu.serve.engine import ContinuousBatchingEngine, EngineBackend
+
+            cache_spec = None
+            if generator.mesh is not None:
+                from prime_tpu.parallel.sharding import cache_spec as _cache_spec
+
+                cache_spec = _cache_spec()
+            engine = ContinuousBatchingEngine(
+                generator.params,
+                generator.config,
+                eos_id=generator.tokenizer.eos_id,
+                pad_id=generator.tokenizer.pad_id,
+                max_slots=max_slots,
+                capacity=slot_capacity,
+                chunk=chunk,
+                mesh=generator.mesh,
+                cache_spec=cache_spec,
+            )
+            engine.start()
+            server.generator = EngineBackend(engine, generator.tokenizer)
+        else:
+            server.generator = generator
     except BaseException:
         server.stop()  # don't leak the bound listener when the model fails to load
         raise
