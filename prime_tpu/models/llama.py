@@ -23,7 +23,11 @@ import jax.numpy as jnp
 
 from prime_tpu.models.config import ModelConfig
 from prime_tpu.models.quantize import matmul as _mm
-from prime_tpu.ops.attention import decode_attention, multi_head_attention
+from prime_tpu.ops.attention import (
+    cache_prefill_attention,
+    decode_attention,
+    multi_head_attention,
+)
 from prime_tpu.ops.norms import rms_norm
 from prime_tpu.ops.rope import apply_rope, rope_frequencies
 
@@ -152,6 +156,7 @@ def _attention_block(
     attn_impl: str,
     k_scale: jnp.ndarray | None = None,  # (B, KH, 1, C) when quantized
     v_scale: jnp.ndarray | None = None,
+    prefill_offset: jnp.ndarray | None = None,  # () chunked prefill: write+attend at offset
 ):
     batch, seq, _ = x.shape
     h, kh, hd = config.n_heads, config.n_kv_heads, config.head_dim
@@ -194,6 +199,21 @@ def _attention_block(
             q, new_k_cache, new_v_cache, cache_lengths + 1, hd**-0.5, impl=attn_impl,
             k_scale=new_k_scale, v_scale=new_v_scale,
         )
+    elif prefill_offset is not None:
+        # chunked prefill: write this chunk's K/V into the cache at the
+        # offset, then attend over the cache (earlier chunks + reused prefix
+        # are visible; within-chunk attention stays causal via the mask)
+        assert k_cache is not None and not quantized, (
+            "chunked prefill requires a bf16 cache (int8 staging would "
+            "re-quantize per chunk)"
+        )
+        off = prefill_offset.astype(jnp.int32)
+        k_t = k.transpose(0, 1, 3, 2)  # (B, KH, hd, S)
+        v_t = v.transpose(0, 1, 3, 2)
+        zero = jnp.zeros((), dtype=jnp.int32)
+        new_k_cache = jax.lax.dynamic_update_slice(k_cache, k_t, (zero, zero, zero, off))
+        new_v_cache = jax.lax.dynamic_update_slice(v_cache, v_t, (zero, zero, zero, off))
+        attn = cache_prefill_attention(q, new_k_cache, new_v_cache, off, hd**-0.5)
     else:
         attn = multi_head_attention(q, k, v, impl=attn_impl)
         if k_cache is not None:
@@ -240,22 +260,30 @@ def forward(
     params: Params,
     tokens: jnp.ndarray,                 # (B, S) int32
     config: ModelConfig,
-    positions: jnp.ndarray | None = None,  # (B, S); default arange
+    positions: jnp.ndarray | None = None,  # (B, S); default arange (+ prefill_offset)
     cache: KVCache | None = None,
     decode: bool = False,
     attn_impl: str = "auto",
     return_aux: bool = False,
+    prefill_offset: jnp.ndarray | None = None,  # () traced; chunked prefill at offset
 ):
     """Run the transformer. Returns (logits (B, S, V) fp32, updated cache),
     plus the summed MoE load-balance aux loss when ``return_aux``.
 
-    - training:     cache=None, decode=False
-    - prefill:      cache=init_cache(...), decode=False
-    - decode step:  cache=<filled>, decode=True, S must be 1
+    - training:        cache=None, decode=False
+    - prefill:         cache=init_cache(...), decode=False
+    - chunked prefill: cache w/ lengths=offset, decode=False,
+                       prefill_offset=offset — writes this chunk's KV at
+                       [offset, offset+S) and attends over the cache, so a
+                       long prompt (or a suffix after a reused prefix) feeds
+                       in S-token chunks with O(S·C) attention memory
+    - decode step:     cache=<filled>, decode=True, S must be 1
     """
     batch, seq = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None, :], (batch, seq))
+        if prefill_offset is not None:
+            positions = positions + prefill_offset.astype(jnp.int32)
     max_pos = cache.capacity if cache is not None else max(seq, config.max_seq_len)
     rope_tables = rope_frequencies(config.head_dim, max_pos, config.rope_theta)
 
@@ -277,7 +305,7 @@ def forward(
         x, new_k, new_v, new_ks, new_vs = _attention_block(
             x, lp, positions, rope_tables, config,
             k_c, v_c, cache_lengths, decode, attn_impl,
-            k_scale=k_s, v_scale=v_s,
+            k_scale=k_s, v_scale=v_s, prefill_offset=prefill_offset,
         )
         x, aux = _mlp_block(x, lp, config)
         ys = (new_k, new_v, new_ks, new_vs) if quantized else (new_k, new_v)

@@ -25,6 +25,28 @@ class GenerationResult(NamedTuple):
     logprobs: jnp.ndarray      # (B, max_new_tokens) logprob of each sampled token
 
 
+def top_p_filter(logits: jnp.ndarray, top_p) -> jnp.ndarray:
+    """Nucleus filtering with static shapes: tokens outside the smallest set
+    with cumulative probability >= top_p get -inf. ``top_p`` is TRACED — a
+    scalar, or anything broadcastable against ``logits[..., :1]`` (the
+    serving engine passes a per-row vector) — so it varies per request
+    without recompiling. The single owner of this math; the continuous-
+    batching engine samples through it too."""
+    top_p = jnp.asarray(top_p)[..., None] if jnp.ndim(top_p) else top_p
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    cumulative = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+    # keep every token whose PRECEDING cumulative mass is < top_p (the
+    # first token crossing the threshold stays in the nucleus)
+    keep_sorted = jnp.concatenate(
+        [jnp.ones_like(cumulative[..., :1], dtype=bool), cumulative[..., :-1] < top_p],
+        axis=-1,
+    )
+    cutoff = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits >= cutoff, logits, -jnp.inf)
+
+
 def _sample(
     logits: jnp.ndarray,
     temperature: float,
@@ -39,20 +61,7 @@ def _sample(
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
     if nucleus:
-        # nucleus filtering with static shapes: tokens outside the smallest
-        # set with cumulative probability >= top_p get -inf before sampling
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-        cumulative = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
-        # keep every token whose PRECEDING cumulative mass is < top_p (the
-        # first token crossing the threshold stays in the nucleus)
-        keep_sorted = jnp.concatenate(
-            [jnp.ones_like(cumulative[..., :1], dtype=bool), cumulative[..., :-1] < top_p],
-            axis=-1,
-        )
-        cutoff = jnp.min(
-            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
-        )
-        logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
+        logits = top_p_filter(logits, top_p)
     return jax.random.categorical(rng, logits, axis=-1)
 
 

@@ -150,6 +150,42 @@ def decode_attention(
     return out.reshape(batch, num_heads, 1, head_dim)
 
 
+def cache_prefill_attention(
+    q: jnp.ndarray,          # (B, H, S, D) queries for a prompt CHUNK
+    k_cache: jnp.ndarray,    # (B, KH, D, C) feature-major, chunk already written
+    v_cache: jnp.ndarray,    # (B, KH, D, C)
+    offset: jnp.ndarray,     # () first cache slot of this chunk (traced)
+    sm_scale: float,
+) -> jnp.ndarray:
+    """Attention for chunked prefill: the chunk's K/V are first *written* into
+    the cache at ``offset``, then each chunk query attends over the whole
+    cache with the mask ``slot < offset + q_index + 1`` — causal within the
+    chunk, full visibility of everything before it (earlier chunks, a reused
+    prefix). One code path serves chunk 0 (offset 0 ≡ plain causal) and every
+    later chunk, so chunked prefill composes with prefix caching for free.
+
+    Grouped-einsum GQA like the decode path — the cache is never materialized
+    per-query-head. O(S·C) scores per chunk keeps peak memory bounded for
+    long prompts (vs O(S_total²) for one-shot prefill).
+    """
+    batch, num_heads, seq, head_dim = q.shape
+    kv_heads = k_cache.shape[1]
+    group = num_heads // kv_heads
+    qg = q.reshape(batch, kv_heads, group, seq, head_dim)
+    scores = (
+        jnp.einsum("bkgsd,bkdc->bkgsc", qg, k_cache, preferred_element_type=jnp.float32)
+        * sm_scale
+    )
+    capacity = k_cache.shape[3]
+    slot_ids = jnp.arange(capacity)[None, :]                  # (1, C)
+    q_limit = offset + jnp.arange(seq)[:, None] + 1           # (S, 1)
+    visible = slot_ids < q_limit                              # (S, C)
+    scores = jnp.where(visible[None, None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgsc,bkdc->bkgsd", probs.astype(q.dtype), v_cache)
+    return out.reshape(batch, num_heads, seq, head_dim)
+
+
 def multi_head_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,

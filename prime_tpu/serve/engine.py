@@ -51,6 +51,63 @@ def bucket_for(length: int, capacity: int) -> int:
     return min(b, capacity)
 
 
+def row_capacity_for(length: int, max_chunk: int, capacity: int) -> int:
+    """Staging-row capacity for a prompt of ``length``: a power-of-two bucket
+    up to ``max_chunk``, then multiples of ``max_chunk``. Every chunk_plan
+    size (power of two <= max_chunk, self-aligned) divides this, which is the
+    invariant that keeps chunk writes inside the row for ANY slot capacity —
+    including non-power-of-two ones, where bucket_for alone would produce a
+    row a mid-prompt chunk could overflow (dynamic_update_slice would then
+    clamp the write while the attention mask assumed the true offset: silent
+    KV corruption)."""
+    if length <= max_chunk:
+        row = MIN_BUCKET
+        while row < length:
+            row *= 2
+    else:
+        row = max_chunk * -(-length // max_chunk)
+    if row > capacity:
+        raise ValueError(
+            f"prompt of {length} tokens needs a {row}-slot staging row, which "
+            f"exceeds the slot capacity ({capacity}); raise --slot-capacity "
+            f"to a multiple of the prefill chunk ({max_chunk})"
+        )
+    return row
+
+
+def chunk_plan(start: int, length: int, max_chunk: int, row_capacity: int) -> list[tuple[int, int]]:
+    """Buddy-style decomposition of [start, length) into (offset, size) prefill
+    chunks: each chunk is a power of two, aligned to its own size, capped at
+    ``max_chunk``. With ``row_capacity`` from row_capacity_for, every chunk
+    size divides the row capacity, so offset+size never exceeds the row — a
+    dynamic_update_slice can therefore never clamp — and the size set is
+    O(log) distinct shapes, so chunked prefill compiles a bounded number of
+    programs. ``start`` must be a multiple of MIN_BUCKET (align a prefix
+    match down before calling). The final chunk may pad past ``length``; pad
+    slots are masked by the true length downstream."""
+    if start % MIN_BUCKET:
+        raise ValueError(f"start ({start}) must be a multiple of {MIN_BUCKET}")
+    plan = []
+    off = start
+    while off < length:
+        size = min(max_chunk, row_capacity) if off == 0 else min(off & -off, max_chunk)
+        plan.append((off, size))
+        if off + size > row_capacity:  # invariant guard; unreachable via submit()
+            raise AssertionError(
+                f"chunk [{off}, {off + size}) overflows row capacity {row_capacity}"
+            )
+        off += size
+    return plan
+
+
+def _common_prefix_len(a: list[int], b: list[int]) -> int:
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return i
+    return n
+
+
 @dataclass
 class EngineRequest:
     """One in-flight generation. ``events`` receives lists of token ids as
@@ -75,9 +132,19 @@ class EngineRequest:
         self.cancelled = True
 
     def tokens(self, timeout: float | None = 120.0):
-        """Iterate over token-id batches until the request finishes."""
+        """Iterate over token-id batches until the request finishes.
+        ``timeout`` bounds the wait for each event; on expiry the request is
+        cancelled (so the engine stops decoding for nobody) and a descriptive
+        TimeoutError raised instead of a bare queue.Empty."""
         while True:
-            item = self.events.get(timeout=timeout)
+            try:
+                item = self.events.get(timeout=timeout)
+            except queue.Empty:
+                self.cancel()
+                raise TimeoutError(
+                    f"no tokens within {timeout}s (queued behind busy slots "
+                    "or a slow first-compile); request cancelled"
+                ) from None
             if item is None:
                 if self.error:
                     raise RuntimeError(self.error)
@@ -109,6 +176,9 @@ class ContinuousBatchingEngine:
         max_slots: int = 8,
         capacity: int = 2048,
         chunk: int = 8,
+        prefill_chunk: int = 512,
+        prefix_cache_size: int = 4,
+        min_prefix: int = MIN_BUCKET,
         mesh: Any = None,
         cache_spec: Any = None,
         attn_impl: str = "auto",
@@ -143,9 +213,18 @@ class ContinuousBatchingEngine:
         self._thread: threading.Thread | None = None
         self._running = False
         # one jitted program each: jit's own shape-keyed cache gives
-        # one-compile-per-prompt-bucket without a bucket-keyed dict here
-        self._prefill_fn: Any = None
+        # one-compile-per-shape-bucket without bucket-keyed dicts here
+        self._chunk_fn: Any = None
+        self._finalize_fn: Any = None
         self._decode_fn: Any = None
+        # prompt-prefix KV reuse: newest-last list of (ids, row_k, row_v) —
+        # an admission whose prompt shares a prefix with a recent one copies
+        # that staged KV row and only prefills the suffix
+        self.prefill_chunk = max(MIN_BUCKET, prefill_chunk)
+        self.prefix_cache_size = prefix_cache_size
+        self.min_prefix = max(min_prefix, MIN_BUCKET)
+        self._prefix_cache: list[tuple[list[int], Any, Any]] = []
+        self.prefix_hits = 0  # observability: admissions seeded from the cache
 
     def _init_device_state(self) -> None:
         """(Re)allocate the slot cache and per-slot vectors — used at
@@ -184,33 +263,48 @@ class ContinuousBatchingEngine:
 
     # ---- compiled programs ----
 
-    def _make_prefill(self):
+    def _make_chunk_prefill(self):
         import jax
         import jax.numpy as jnp
 
-        from prime_tpu.models.llama import forward, init_cache
+        from prime_tpu.models.llama import KVCache, forward
 
-        config, capacity, attn_impl = self.config, self.capacity, self.attn_impl
+        config, attn_impl = self.config, self.attn_impl
+
+        def chunk_prefill(params, row_k, row_v, tokens, offset):
+            # write-at-offset + attend-over-row (models.llama chunked prefill):
+            # the staging row is donated, so chunks update it in place
+            row = KVCache(k=row_k, v=row_v, lengths=jnp.zeros((1,), jnp.int32))
+            logits, row = forward(
+                params, tokens, config, cache=row, decode=False,
+                attn_impl=attn_impl, prefill_offset=offset,
+            )
+            return row.k, row.v, logits
+
+        return jax.jit(chunk_prefill, donate_argnums=(1, 2))
+
+    def _make_finalize(self):
+        import jax
+        import jax.numpy as jnp
+
         cache_spec = self.cache_spec
 
-        def prefill(
-            params, k, v, lengths, last, temps, top_ps,
-            tokens, length, slot, temp, top_p, rng,
+        def finalize(
+            k, v, lengths, last, temps, top_ps,
+            row_k, row_v, chunk_logits, last_idx, length, slot, temp, top_p, rng,
         ):
-            # run the prompt through a fresh single-row cache, then splice the
-            # row into the engine cache at ``slot`` — the engine cache is
-            # donated, so XLA updates it in place
-            row = init_cache(config, 1, capacity, dtype=k.dtype)
-            logits, row = forward(
-                params, tokens, config, cache=row, decode=False, attn_impl=attn_impl
-            )
-            new_k = jax.lax.dynamic_update_slice(k, row.k, (0, slot, 0, 0, 0))
-            new_v = jax.lax.dynamic_update_slice(v, row.v, (0, slot, 0, 0, 0))
+            # splice the staged row into the engine cache at ``slot`` (the
+            # engine cache is donated; the row is NOT — it may live on in the
+            # prefix cache) and sample the first token from the prompt's last
+            # real position within the final chunk
+            zero = jnp.zeros((), jnp.int32)
+            new_k = jax.lax.dynamic_update_slice(k, row_k, (zero, slot, zero, zero, zero))
+            new_v = jax.lax.dynamic_update_slice(v, row_v, (zero, slot, zero, zero, zero))
             if cache_spec is not None:
                 new_k = jax.lax.with_sharding_constraint(new_k, cache_spec)
                 new_v = jax.lax.with_sharding_constraint(new_v, cache_spec)
-            last_logits = jnp.take_along_axis(
-                logits, (length - 1)[None, None, None], axis=1
+            last_logits = jax.lax.dynamic_slice(
+                chunk_logits, (zero, last_idx, zero), (1, 1, chunk_logits.shape[-1])
             )[0, 0]
             first = _sample_batch(last_logits[None, :], temp[None], top_p[None], rng)[0]
             # the first sampled token's KV is not in the cache yet: the next
@@ -222,7 +316,7 @@ class ContinuousBatchingEngine:
             new_top_ps = top_ps.at[slot].set(top_p)
             return new_k, new_v, new_lengths, new_last, new_temps, new_top_ps, first
 
-        return jax.jit(prefill, donate_argnums=(1, 2, 3, 4, 5, 6))
+        return jax.jit(finalize, donate_argnums=(0, 1, 2, 3, 4, 5))
 
     def _make_decode(self):
         import jax
@@ -290,6 +384,8 @@ class ContinuousBatchingEngine:
                 f"prompt ({len(prompt_ids)}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds slot capacity ({self.capacity})"
             )
+        # fail oversized staging rows here, not at admission inside the loop
+        row_capacity_for(len(prompt_ids), self.prefill_chunk, self.capacity)
         req = EngineRequest(
             id=next(self._ids),
             prompt_ids=list(prompt_ids),
@@ -353,6 +449,10 @@ class ContinuousBatchingEngine:
                     continue
                 if item is None:
                     continue
+                if item.cancelled:
+                    item.done = True
+                    item.events.put(None)
+                    continue
                 try:
                     self._prefill(item, int(np.argmin(self._active)))
                 except Exception as e:  # noqa: BLE001 — keep the loop alive
@@ -400,6 +500,11 @@ class ContinuousBatchingEngine:
                 return admitted
             if req is None:
                 continue
+            if req.cancelled:
+                # client went away while queued: don't pay the prefill
+                req.done = True
+                req.events.put(None)
+                continue
             try:
                 self._prefill(req, free[0])
                 admitted = True
@@ -412,29 +517,95 @@ class ContinuousBatchingEngine:
         import jax
         import jax.numpy as jnp
 
-        bucket = bucket_for(len(req.prompt_ids), self.capacity)
-        if self._prefill_fn is None:
-            self._prefill_fn = self._make_prefill()
-        padded = req.prompt_ids + [self.pad_id] * (bucket - len(req.prompt_ids))
-        tokens = jnp.asarray([padded], dtype=jnp.int32)
-        length = jnp.asarray(len(req.prompt_ids), dtype=jnp.int32)
+        if self._chunk_fn is None:
+            self._chunk_fn = self._make_chunk_prefill()
+        if self._finalize_fn is None:
+            self._finalize_fn = self._make_finalize()
+        ids = req.prompt_ids
+        row_cb = row_capacity_for(len(ids), self.prefill_chunk, self.capacity)
+        start, row_k, row_v = self._prefix_seed(ids, row_cb)
+        plan = chunk_plan(start, len(ids), self.prefill_chunk, row_cb)
+        logits = None
+        last_idx = 0
         self._rng, rng = jax.random.split(self._rng)
         with self._mesh_ctx():
+            for off, size in plan:
+                chunk_ids = ids[off : off + size]
+                chunk_ids += [self.pad_id] * (size - len(chunk_ids))
+                tokens = jnp.asarray([chunk_ids], dtype=jnp.int32)
+                row_k, row_v, logits = self._chunk_fn(
+                    self.params, row_k, row_v, tokens,
+                    jnp.asarray(off, dtype=jnp.int32),
+                )
+                last_idx = len(ids) - 1 - off  # prompt's last position, chunk-relative
             (
                 self._k, self._v, self._lengths, self._last,
                 self._temps, self._top_ps, first,
-            ) = self._prefill_fn(
-                self.params, self._k, self._v, self._lengths, self._last,
-                self._temps, self._top_ps, tokens, length,
+            ) = self._finalize_fn(
+                self._k, self._v, self._lengths, self._last,
+                self._temps, self._top_ps, row_k, row_v, logits,
+                jnp.asarray(last_idx, dtype=jnp.int32),
+                jnp.asarray(len(ids), dtype=jnp.int32),
                 jnp.asarray(slot, dtype=jnp.int32),
                 jnp.asarray(req.temperature, dtype=jnp.float32),
                 jnp.asarray(req.top_p, dtype=jnp.float32),
                 rng,
             )
+        self._store_prefix(ids, row_k, row_v)
         req.slot = slot
         self._active[slot] = True
         self._requests[slot] = req
         self._emit(req, [int(first)])
+
+    # ---- prompt-prefix KV reuse ----
+
+    def _prefix_seed(self, ids: list[int], row_cb: int):
+        """Longest-prefix match against recently staged rows: returns
+        (start, row_k, row_v) where [0, start) is already computed in the row.
+        start is aligned down to MIN_BUCKET (chunk_plan's invariant) and
+        capped at len(ids)-1 so at least one real token is always prefilled
+        (the finalize step needs the last prompt position's logits)."""
+        import jax.numpy as jnp
+
+        from prime_tpu.models.llama import init_cache
+
+        best_len, best = 0, None
+        for entry_ids, ek, ev in self._prefix_cache:
+            common = _common_prefix_len(ids, entry_ids)
+            if common > best_len:
+                best_len, best = common, (ek, ev)
+        best_len = min(best_len, len(ids) - 1)
+        best_len = (best_len // MIN_BUCKET) * MIN_BUCKET
+        if best is None or best_len < self.min_prefix:
+            row = init_cache(self.config, 1, row_cb, dtype=self._dtype)
+            return 0, row.k, row.v
+        self.prefix_hits += 1
+        self._prefix_cache = [e for e in self._prefix_cache if e[1] is not best[0]] + [
+            e for e in self._prefix_cache if e[1] is best[0]
+        ]  # LRU touch
+        return best_len, *self._resize_row(best[0], best[1], row_cb)
+
+    def _resize_row(self, row_k, row_v, target_cb: int):
+        """Fresh row buffers at ``target_cb`` seeded from a cached row (the
+        cached entry stays valid — chunk_prefill donates its row inputs)."""
+        import jax.numpy as jnp
+
+        src_cb = row_k.shape[-1]
+        if src_cb == target_cb:
+            return jnp.copy(row_k), jnp.copy(row_v)
+        if src_cb > target_cb:
+            return jnp.copy(row_k[..., :target_cb]), jnp.copy(row_v[..., :target_cb])
+        pad = [(0, 0)] * (row_k.ndim - 1) + [(0, target_cb - src_cb)]
+        return jnp.pad(row_k, pad), jnp.pad(row_v, pad)
+
+    def _store_prefix(self, ids: list[int], row_k, row_v) -> None:
+        if self.prefix_cache_size <= 0 or len(ids) < self.min_prefix:
+            return
+        # drop an entry for the identical prompt (the new row supersedes it)
+        self._prefix_cache = [e for e in self._prefix_cache if e[0] != ids]
+        self._prefix_cache.append((list(ids), row_k, row_v))
+        while len(self._prefix_cache) > self.prefix_cache_size:
+            self._prefix_cache.pop(0)
 
     def _decode_chunk(self) -> None:
         import jax.numpy as jnp
@@ -552,33 +723,19 @@ class EngineBackend:
 def _sample_batch(logits, temps, top_ps, rng):
     """Per-row sampling over (S, V) logits with traced (S,) temperature and
     top_p. Greedy rows (temp == 0), plain-temperature rows, and nucleus rows
-    share one program; the vocab sort only executes when some row wants
-    nucleus (lax.cond picks the branch at runtime)."""
+    share one program; the vocab sort (models.sampler.top_p_filter, the one
+    owner of the nucleus math) only executes when some row wants it
+    (lax.cond picks the branch at runtime)."""
     import jax
     import jax.numpy as jnp
 
+    from prime_tpu.models.sampler import top_p_filter
+
     greedy = jnp.argmax(logits, axis=-1)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-
-    def plain(scaled):
-        return scaled
-
-    def nucleus(scaled):
-        sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
-        cumulative = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
-        keep_sorted = jnp.concatenate(
-            [
-                jnp.ones_like(cumulative[..., :1], dtype=bool),
-                cumulative[..., :-1] < top_ps[:, None],
-            ],
-            axis=-1,
-        )
-        cutoff = jnp.min(
-            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
-        )
-        return jnp.where(scaled >= cutoff, scaled, NEG_INF)
-
     wants_nucleus = jnp.any((top_ps < 1.0) & (temps > 0.0))
-    filtered = jax.lax.cond(wants_nucleus, nucleus, plain, scaled)
+    filtered = jax.lax.cond(
+        wants_nucleus, lambda x: top_p_filter(x, top_ps), lambda x: x, scaled
+    )
     sampled = jax.random.categorical(rng, filtered, axis=-1)
     return jnp.where(temps == 0.0, greedy, sampled).astype(jnp.int32)

@@ -37,6 +37,7 @@ def make_engine(**kw) -> ContinuousBatchingEngine:
     kw.setdefault("max_slots", 4)
     kw.setdefault("capacity", 128)
     kw.setdefault("chunk", 4)
+    kw.setdefault("prefix_cache_size", 0)  # prefix tests opt in explicitly
     return ContinuousBatchingEngine(PARAMS, CONFIG, **kw)
 
 
@@ -141,6 +142,95 @@ def test_background_thread_lifecycle():
     with make_engine() as engine:
         req = engine.submit(prompt, max_new_tokens=6)
         assert req.all_tokens(timeout=60) == reference_tokens(prompt, 6)
+
+
+def test_row_capacity_for_non_pow2_slot_capacity():
+    """A non-power-of-two slot capacity must not let a chunk overflow the
+    staging row (dynamic_update_slice would clamp the write while the
+    attention mask assumed the true offset — silent KV corruption)."""
+    from prime_tpu.serve.engine import chunk_plan, row_capacity_for
+
+    row = row_capacity_for(2500, 512, 3000)
+    assert row == 2560  # multiple of the chunk, not bucket_for's min(pow2, cap)
+    for off, size in chunk_plan(0, 2500, 512, row):
+        assert off + size <= row
+    with pytest.raises(ValueError, match="staging row"):
+        row_capacity_for(2800, 512, 3000)  # needs 3072 > capacity: clear error
+
+
+def test_request_timeout_cancels():
+    engine = make_engine()
+    req = engine.submit([1, 2, 3], max_new_tokens=8)  # never ticked
+    with pytest.raises(TimeoutError, match="cancelled"):
+        req.all_tokens(timeout=0.05)
+    assert req.cancelled
+    engine.tick()  # the cancelled request must not be admitted
+    assert not any(engine._active)
+
+
+def test_chunk_plan_invariants():
+    from prime_tpu.serve.engine import MIN_BUCKET, chunk_plan
+
+    for start, length, pc, row_cb in [
+        (0, 100, 512, 128), (0, 600, 512, 1024), (16, 116, 512, 128),
+        (112, 128, 512, 128), (48, 1500, 256, 2048), (0, 16, 16, 16),
+    ]:
+        plan = chunk_plan(start, length, pc, row_cb)
+        covered = start
+        for off, size in plan:
+            assert off == covered, "chunks must be contiguous"
+            assert size & (size - 1) == 0 and size >= 1, "power-of-two sizes"
+            assert off % size == 0 or off == 0, "aligned to own size"
+            assert off + size <= row_cb, "never past the row (no DUS clamping)"
+            assert size <= pc
+            covered = off + size
+        assert covered >= length, "plan must cover the prompt"
+    with pytest.raises(ValueError):
+        chunk_plan(MIN_BUCKET - 1, 100, 512, 128)
+
+
+def test_long_prompt_chunked_admission_matches_reference():
+    """A prompt longer than prefill_chunk admits in chunks and still decodes
+    token-exactly like the one-shot sampler."""
+    prompt = [(i * 7) % 500 + 1 for i in range(70)]
+    engine = make_engine(capacity=128, prefill_chunk=32)
+    req = engine.submit(prompt, max_new_tokens=8)
+    drain(engine, req)
+    assert req.all_tokens(timeout=1) == reference_tokens(prompt, 8)
+
+
+def test_prefix_cache_hit_matches_cold_path():
+    """Two prompts sharing a long prefix: the second admission seeds from the
+    cached row (prefix_hits increments) and produces exactly the cold-path
+    tokens."""
+    shared = [(i * 11) % 500 + 1 for i in range(48)]
+    a = shared + [7, 8, 9]
+    b = shared + [100, 200]
+    engine = make_engine(capacity=128, prefill_chunk=32, min_prefix=16,
+                         prefix_cache_size=4)
+    ra = engine.submit(a, max_new_tokens=6)
+    drain(engine, ra)
+    assert engine.prefix_hits == 0
+    rb = engine.submit(b, max_new_tokens=6)
+    drain(engine, rb)
+    assert engine.prefix_hits == 1
+    assert ra.all_tokens(timeout=1) == reference_tokens(a, 6)
+    assert rb.all_tokens(timeout=1) == reference_tokens(b, 6)
+
+
+def test_prefix_cache_eviction_and_identical_prompt():
+    engine = make_engine(capacity=64, prefill_chunk=32, min_prefix=16,
+                         prefix_cache_size=2)
+    p1, p2, p3 = ([1] * 20, [2] * 20, [3] * 20)
+    for p in (p1, p2, p3):
+        r = engine.submit(list(p), max_new_tokens=2)
+        drain(engine, r)
+    assert len(engine._prefix_cache) == 2  # oldest evicted
+    # identical prompt re-admission: seeded from its own cached row
+    r = engine.submit(list(p3), max_new_tokens=4)
+    drain(engine, r)
+    assert engine.prefix_hits == 1
+    assert r.all_tokens(timeout=1) == reference_tokens(list(p3), 4)
 
 
 def test_cancel_retires_slot():

@@ -75,6 +75,46 @@ def test_decode_matches_full_forward(params):
         )
 
 
+def test_chunked_prefill_matches_one_shot(params):
+    """Feeding a prompt in chunks (write-at-offset + attend-over-cache) must
+    reproduce the one-shot prefill logits and leave an equivalent cache."""
+    seq, capacity = 24, 32
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (2, seq), 0, CFG.vocab_size)
+    ref_cache = init_cache(CFG, 2, capacity, dtype=jnp.float32)
+    ref_logits, ref_cache = forward(params, tokens, CFG, cache=ref_cache)
+
+    cache = init_cache(CFG, 2, capacity, dtype=jnp.float32)
+    offset = 0
+    chunk_logits = []
+    for size in (8, 16):  # uneven chunks on purpose
+        chunk = tokens[:, offset : offset + size]
+        logits, cache = forward(
+            params, chunk, CFG, cache=cache,
+            prefill_offset=jnp.asarray(offset, dtype=jnp.int32),
+        )
+        chunk_logits.append(logits)
+        offset += size
+    got = jnp.concatenate(chunk_logits, axis=1)
+    np.testing.assert_allclose(np.asarray(ref_logits), np.asarray(got), rtol=2e-4, atol=2e-4)
+    assert int(cache.lengths[0]) == seq
+    np.testing.assert_allclose(
+        np.asarray(ref_cache.k[:, :, :, :, :seq]), np.asarray(cache.k[:, :, :, :, :seq]),
+        rtol=2e-4, atol=2e-4,
+    )
+
+    # decode continues identically from the chunked cache
+    nxt = jnp.argmax(got[:, -1, :], axis=-1)[:, None]
+    step_ref, _ = forward(
+        params, nxt, CFG, positions=ref_cache.lengths[:, None], cache=ref_cache, decode=True
+    )
+    step_chunked, _ = forward(
+        params, nxt, CFG, positions=cache.lengths[:, None], cache=cache, decode=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(step_ref), np.asarray(step_chunked), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_gqa_heads_differ(params):
     """Sanity: GQA config uses fewer kv heads than q heads."""
     assert CFG.n_kv_heads < CFG.n_heads
