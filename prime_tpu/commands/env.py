@@ -35,6 +35,26 @@ def build_hub_client() -> EnvHubClient:
     return EnvHubClient(APIClient(config=deps.build_config(), transport=deps.transport_override))
 
 
+def load_resolved_environment(render: Renderer, resolved):
+    """Drift-warn, execute ``load_environment()``, and announce the result —
+    the shared tail of the environment execution protocol for every command
+    that runs an env (`prime eval run`, `prime train local-rl`)."""
+    from prime_tpu.envhub.execution import EnvProtocolError, load_environment
+
+    if resolved.drift:
+        click.echo(f"warning: {resolved.drift}", err=True)
+    try:
+        loaded = load_environment(resolved)
+    except EnvProtocolError as e:
+        raise click.ClickException(str(e)) from None
+    render.message(
+        f"Resolved env {loaded.name} ({resolved.source}"
+        + (f"@{resolved.version}" if resolved.version else "")
+        + f", {len(loaded.examples)} examples)"
+    )
+    return loaded
+
+
 from prime_tpu.envhub.local import read_registry as _installed_registry, save_registry as _save_registry
 
 

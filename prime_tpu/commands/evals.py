@@ -114,12 +114,10 @@ def run_eval_cmd(
     # Built-in labels and explicit --dataset runs skip resolution entirely:
     # a hub env named "gsm8k" must not shadow the built-in, and a
     # user-supplied dataset must not be silently replaced by env data.
-    from prime_tpu.commands.env import build_hub_client
+    from prime_tpu.commands.env import build_hub_client, load_resolved_environment
     from prime_tpu.envhub.execution import (
         BUILTIN_ENVS,
-        EnvProtocolError,
         EnvResolutionError,
-        load_environment,
         resolve_environment,
     )
 
@@ -134,12 +132,7 @@ def run_eval_cmd(
                 # looked like a path/slug and nothing else will supply data
                 raise click.ClickException(str(e)) from None
     if resolved is not None:
-        if resolved.drift:
-            click.echo(f"warning: {resolved.drift}", err=True)
-        try:
-            loaded = load_environment(resolved)
-        except EnvProtocolError as e:
-            raise click.ClickException(str(e)) from None
+        loaded = load_resolved_environment(render, resolved)
         from prime_tpu.evals.datasets import EvalExample
 
         env_examples = [
@@ -154,11 +147,6 @@ def run_eval_cmd(
             max_new_tokens = int(loaded.defaults["max_new_tokens"])
         if "temperature" in loaded.defaults and _is_default(ctx, "temperature"):
             temperature = float(loaded.defaults["temperature"])
-        render.message(
-            f"Resolved env {loaded.name} ({resolved.source}"
-            + (f"@{resolved.version}" if resolved.version else "")
-            + f", {len(env_examples)} examples)"
-        )
 
     spec = EvalRunSpec(
         env=run_env_name,

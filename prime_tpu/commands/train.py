@@ -262,6 +262,213 @@ def local_cmd(
         )
 
 
+@train_group.command("local-rl")
+@click.argument("env_ref")
+@click.option("--model", "-m", default="tiny-test", help="Model preset (or checkpoint dir).")
+@click.option("--checkpoint", default=None, type=click.Path(exists=True),
+              help="Local HF checkpoint dir to start from.")
+@click.option("--tokenizer", default=None, help="Tokenizer name/path (default: checkpoint's).")
+@click.option("--steps", type=int, default=50)
+@click.option("--group-size", "-g", type=int, default=8, help="Completions per prompt (GRPO G).")
+@click.option("--prompts-per-step", "-p", type=int, default=4)
+@click.option("--max-prompt-len", type=int, default=128)
+@click.option("--max-new-tokens", type=int, default=64)
+@click.option("--temperature", type=float, default=1.0)
+@click.option("--top-p", type=float, default=1.0)
+@click.option("--lr", type=float, default=1e-5)
+@click.option("--clip-eps", type=float, default=0.2)
+@click.option("--kl-coef", type=float, default=0.0,
+              help="KL penalty vs the frozen start policy (doubles param memory).")
+@click.option("--epochs-per-batch", type=int, default=1, help="Updates per rollout batch (GRPO mu).")
+@click.option("--slice", "slice_name", default=None, help="Shard over this TPU slice's mesh.")
+@click.option("--name", "run_name", default=None, help="Run name (default timestamped).")
+@click.option("--output-dir", default="outputs/rl")
+@click.option("--checkpoint-every", type=int, default=0, help="orbax checkpoint cadence (0=off).")
+@output_options
+def local_rl_cmd(
+    render: Renderer,
+    env_ref: str,
+    model: str,
+    checkpoint: str | None,
+    tokenizer: str | None,
+    steps: int,
+    group_size: int,
+    prompts_per_step: int,
+    max_prompt_len: int,
+    max_new_tokens: int,
+    temperature: float,
+    top_p: float,
+    lr: float,
+    clip_eps: float,
+    kl_coef: float,
+    epochs_per_batch: int,
+    slice_name: str | None,
+    run_name: str | None,
+    output_dir: str,
+    checkpoint_every: int,
+) -> None:
+    """GRPO fine-tune MODEL against ENV_REF locally on this slice.
+
+    The hosted path (`prime train run rl.toml`) dispatches RL to the platform;
+    this runs the framework's own GRPO loop natively: the env's dataset and
+    scorer (environment execution protocol, same contract `prime eval run`
+    uses) drive sharded rollouts and clipped-surrogate updates on the chips in
+    front of you. ENV_REF resolves like eval envs: local dir, installed env,
+    hub slug, or the built-in `arith`.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.train.grpo import GrpoConfig, run_grpo
+    from prime_tpu.train.metrics import MetricsLogger
+    from prime_tpu.train.trainer import default_optimizer
+
+    # -- environment: same execution protocol as `prime eval run` ------------
+    examples, scorer, env_name, env_defaults = _rl_environment(render, env_ref)
+
+    # env-declared eval defaults apply unless the flag was given explicitly
+    from click.core import ParameterSource
+
+    ctx = click.get_current_context()
+
+    def _is_default(param: str) -> bool:
+        return ctx.get_parameter_source(param) == ParameterSource.DEFAULT
+
+    if "max_new_tokens" in env_defaults and _is_default("max_new_tokens"):
+        max_new_tokens = int(env_defaults["max_new_tokens"])
+    if "temperature" in env_defaults and _is_default("temperature"):
+        env_temp = float(env_defaults["temperature"])
+        if env_temp > 0.0:
+            temperature = env_temp
+        else:
+            click.echo(
+                "warning: env declares temperature=0 (greedy eval) — GRPO rollouts "
+                f"need temperature > 0; keeping {temperature}",
+                err=True,
+            )
+
+    try:
+        cfg = GrpoConfig(
+            group_size=group_size,
+            prompts_per_step=prompts_per_step,
+            max_prompt_len=max_prompt_len,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            top_p=top_p,
+            clip_eps=clip_eps,
+            kl_coef=kl_coef,
+            epochs_per_batch=epochs_per_batch,
+            steps=steps,
+            learning_rate=lr,
+        )
+    except ValueError as e:
+        raise click.ClickException(str(e)) from None
+
+    # -- model + tokenizer ---------------------------------------------------
+    from prime_tpu.evals.tokenizer import load_tokenizer
+
+    if checkpoint is None and Path(model).is_dir():
+        checkpoint = model
+    try:
+        tok = load_tokenizer(tokenizer or checkpoint)
+    except ValueError as e:
+        raise click.ClickException(str(e)) from None
+    if checkpoint is not None:
+        from prime_tpu.models.hf_loader import load_hf_checkpoint
+
+        params, config = load_hf_checkpoint(checkpoint, dtype=jnp.bfloat16)
+    else:
+        try:
+            config = get_config(model)
+        except ValueError as e:
+            raise click.ClickException(str(e)) from None
+        params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.bfloat16)
+
+    mesh = None
+    if slice_name is not None:
+        from prime_tpu.parallel.mesh import mesh_for_slice
+
+        mesh = mesh_for_slice(slice_name)
+        render.message(f"mesh: {dict(mesh.shape)}")
+
+    run_name = run_name or f"{env_name}-{time.strftime('%Y%m%d-%H%M%S')}"
+    run_dir = Path(output_dir) / run_name
+    if (run_dir / "metrics.jsonl").exists():
+        raise click.ClickException(
+            f"run {run_dir} already has metrics — pick a new --name"
+        )
+    run_dir.mkdir(parents=True, exist_ok=True)
+
+    checkpoints = None
+    if checkpoint_every:
+        from prime_tpu.train.checkpoint import CheckpointManager
+
+        checkpoints = CheckpointManager(run_dir / "checkpoints")
+
+    def on_step(step: int, row: dict) -> None:
+        if step % 5 == 0 or step == steps - 1:
+            render.message(
+                f"  step {step}: reward={row['reward_mean']:.3f} "
+                f"loss={row['loss']:.4f} kl={row['kl']:.4f}"
+            )
+
+    render.message(
+        f"GRPO: {config.name} x {env_name} ({len(examples)} examples), "
+        f"{steps} steps, G={group_size} P={prompts_per_step}"
+    )
+    try:
+        state, report = run_grpo(
+            config,
+            params,
+            tok,
+            examples,
+            scorer,
+            cfg,
+            optimizer=default_optimizer(lr, weight_decay=0.0),
+            mesh=mesh,
+            metrics=MetricsLogger(run_dir),
+            checkpoints=checkpoints,
+            checkpoint_every=checkpoint_every,
+            on_step=on_step,
+        )
+    except ValueError as e:
+        raise click.ClickException(str(e)) from None
+    finally:
+        if checkpoints is not None:
+            checkpoints.close()
+    payload = {"runDir": str(run_dir), "env": env_name, **report.as_dict()}
+    if render.is_json:
+        render.json(payload)
+    else:
+        render.message(
+            f"done: {report.steps} steps, reward {report.first_reward:.3f} -> "
+            f"{report.last_reward:.3f}, final loss {report.final_loss:.4f} -> {run_dir}"
+        )
+
+
+def _rl_environment(render: Renderer, env_ref: str):
+    """Resolve ENV_REF to (examples, scorer, name, defaults) for GRPO."""
+    if env_ref == "arith":
+        from prime_tpu.evals.datasets import synthetic_arithmetic
+
+        examples = [
+            {"prompt": e.prompt, "answer": e.answer} for e in synthetic_arithmetic(256)
+        ]
+        return examples, None, "arith", {}
+
+    from prime_tpu.commands.env import build_hub_client, load_resolved_environment
+    from prime_tpu.envhub.execution import EnvResolutionError, resolve_environment
+
+    try:
+        resolved = resolve_environment(env_ref, hub_client=build_hub_client())
+    except EnvResolutionError as e:
+        raise click.ClickException(str(e)) from None
+    loaded = load_resolved_environment(render, resolved)
+    return loaded.examples, loaded.scorer, loaded.name, loaded.defaults
+
+
 @train_group.command("init")
 @click.argument("name")
 @click.option("--out", default=None, help="Output file (default <name>.toml)")

@@ -123,20 +123,32 @@ class _SandboxOps:
     @staticmethod
     def job_start_command(name: str, command: str) -> str:
         d = f"{_JOB_DIR}/{_SandboxOps.validate_job_name(name)}"
-        inner = f"({command}) >{d}/out 2>{d}/err; echo $? >{d}/exit"
-        # setsid makes the wrapper a process-group leader so job_kill_command's
-        # group kill (`kill -- -pid`) reaps the whole tree, not just the shell.
+        # The wrapper records its own $$: after setsid it is the session and
+        # process-group leader, so job_kill_command's `kill -- -pid` reaps the
+        # whole tree. ($! of the backgrounded list would be the transient
+        # subshell, whose pgid the setsid child has already left.)
+        inner = f"echo $$ >{d}/pid; ({command}) >{d}/out 2>{d}/err; echo $? >{d}/exit"
         return (
-            f"mkdir -p {d} && "
-            f"setsid nohup sh -c {shlex.quote(inner)} >/dev/null 2>&1 & echo $! >{d}/pid; cat {d}/pid"
+            f"mkdir -p {d} && rm -f {d}/pid {d}/exit && "
+            f"{{ setsid nohup sh -c {shlex.quote(inner)} >/dev/null 2>&1 & }} && "
+            # wait (bounded) for the detached wrapper to publish its pid so the
+            # caller gets it synchronously; the `|| sleep 1` keeps shells whose
+            # sleep rejects fractions (busybox) from spinning the loop dry
+            f"i=0; while [ ! -s {d}/pid ] && [ $i -lt 200 ]; "
+            f"do sleep 0.01 2>/dev/null || sleep 1; i=$((i+1)); done; "
+            f"cat {d}/pid 2>/dev/null"
         )
 
     @staticmethod
     def job_status_command(name: str) -> str:
         d = f"{_JOB_DIR}/{_SandboxOps.validate_job_name(name)}"
-        # prints: exit code (or RUNNING), then pid
+        # prints: exit code / RUNNING / NOTFOUND, then pid. The job dir is
+        # created synchronously by job_start_command, so "dir exists but no
+        # pid yet" means the detached wrapper is still starting — reported as
+        # RUNNING, not as a missing job.
         return (
-            f"if [ -f {d}/exit ]; then cat {d}/exit; else echo RUNNING; fi; "
+            f"if [ ! -d {d} ]; then echo NOTFOUND; "
+            f"elif [ -f {d}/exit ]; then cat {d}/exit; else echo RUNNING; fi; "
             f"cat {d}/pid 2>/dev/null || echo -1"
         )
 
@@ -154,14 +166,14 @@ class _SandboxOps:
 
     @staticmethod
     def parse_job_status(name: str, sandbox_id: str, status_out: str, out_tail: str, err_tail: str) -> BackgroundJob:
-        lines = status_out.strip().splitlines() or ["RUNNING", "-1"]
+        lines = status_out.strip().splitlines() or ["NOTFOUND"]
         first = lines[0].strip()
+        if first == "NOTFOUND":
+            # no job dir at all: start_background_job was never called
+            raise SandboxError(f"Background job {name!r} not found in sandbox {sandbox_id}", sandbox_id)
         pid_str = lines[1].strip() if len(lines) > 1 else "-1"
         pid = int(pid_str) if pid_str.isdigit() else None
         running = first == "RUNNING"
-        if running and pid is None:
-            # no exit file AND no pid file: the job was never started
-            raise SandboxError(f"Background job {name!r} not found in sandbox {sandbox_id}", sandbox_id)
         exit_code = None if running else int(first) if first.lstrip("-").isdigit() else 1
         return BackgroundJob(
             job_name=name,

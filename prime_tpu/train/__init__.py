@@ -15,8 +15,9 @@ _TRAINER_EXPORTS = {
     "warmup_cosine",
 }
 _LOOP_EXPORTS = {"LoopReport", "train_loop"}
+_GRPO_EXPORTS = {"GrpoConfig", "GrpoReport", "group_advantages", "run_grpo", "token_logprobs"}
 
-__all__ = sorted(_TRAINER_EXPORTS | _LOOP_EXPORTS)
+__all__ = sorted(_TRAINER_EXPORTS | _LOOP_EXPORTS | _GRPO_EXPORTS)
 
 
 def __getattr__(name: str):
@@ -28,4 +29,8 @@ def __getattr__(name: str):
         from prime_tpu.train import loop
 
         return getattr(loop, name)
+    if name in _GRPO_EXPORTS:
+        from prime_tpu.train import grpo
+
+        return getattr(grpo, name)
     raise AttributeError(f"module 'prime_tpu.train' has no attribute {name!r}")

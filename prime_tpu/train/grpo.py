@@ -1,0 +1,387 @@
+"""Native GRPO: group-relative policy optimization on the TPU slice.
+
+The reference's RL product runs server-side — the CLI only *configures* it
+(TOML pass-through, reference commands/rl.py:913 dispatch; SURVEY.md §2.10
+"training parallelism lives server-side in the separate prime-rl project").
+This framework carries its own compute path, so RL fine-tuning runs natively:
+rollouts come from the same jitted generator that serves evals
+(models/sampler.generate — which already returns per-token logprobs),
+rewards from the environment-execution protocol (envhub/execution.py), and
+updates ride the sharded trainer core (train/trainer.apply_gradients), so a
+mesh'd run gets megatron-TP + ZeRO-3 fsdp for free.
+
+TPU-first shape discipline: prompts are bucketed to a fixed ``max_prompt_len``
+and completions to ``max_new_tokens``, so every rollout step re-enters the
+same three compiled programs (generate, score-pass, update) — no shape churn,
+no recompiles. The update is token-level clipped-surrogate GRPO
+(group-standardized advantages; the token-level mean is the Dr.GRPO/DAPO
+variant — per-sequence length normalization biases against long correct
+answers) with an optional k3 KL penalty against the frozen starting policy
+(``kl_coef > 0`` keeps a reference param copy — doubles param memory).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from prime_tpu.models.config import ModelConfig
+from prime_tpu.models.llama import forward
+from prime_tpu.models.sampler import generate
+from prime_tpu.train.trainer import TrainState, apply_gradients, init_train_state
+
+
+@dataclass
+class GrpoConfig:
+    group_size: int = 8              # G completions per prompt
+    prompts_per_step: int = 4        # P prompts sampled per optimizer step
+    max_prompt_len: int = 128        # S: prompts truncated (keep tail) / padded
+    max_new_tokens: int = 64         # N: completion budget
+    temperature: float = 1.0         # rollout sampling temperature (> 0)
+    top_p: float = 1.0
+    clip_eps: float = 0.2            # PPO-style ratio clip
+    kl_coef: float = 0.0             # k3 KL vs frozen ref policy (0 = off)
+    epochs_per_batch: int = 1        # GRPO mu: updates per rollout batch
+    adv_eps: float = 1e-4            # std floor in group normalization
+    steps: int = 20
+    learning_rate: float = 1e-5
+
+    def __post_init__(self) -> None:
+        if self.temperature <= 0.0:
+            raise ValueError("GRPO rollouts need temperature > 0 (greedy groups are identical)")
+        if self.group_size < 2:
+            raise ValueError("group_size must be >= 2 — advantages are group-relative")
+
+
+def group_advantages(rewards: np.ndarray, eps: float = 1e-4) -> np.ndarray:
+    """(P, G) rewards → group-standardized advantages. A group with zero
+    spread (all-same rewards) gets zero advantage — no learning signal, which
+    is exactly GRPO's behavior (and why group_size > 1 matters)."""
+    mean = rewards.mean(axis=1, keepdims=True)
+    std = rewards.std(axis=1, keepdims=True)
+    return (rewards - mean) / (std + eps)
+
+
+def _token_logprobs_inline(params, tokens, config, attn_impl):
+    logits, _ = forward(params, tokens, config, cache=None, attn_impl=attn_impl)
+    logprobs = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logprobs, tokens[:, 1:, None], axis=-1)[..., 0]
+    return jnp.pad(picked, ((0, 0), (1, 0)))
+
+
+@functools.partial(jax.jit, static_argnames=("config", "attn_impl"))
+def token_logprobs(
+    params, tokens: jnp.ndarray, config: ModelConfig, attn_impl: str = "auto"
+) -> jnp.ndarray:
+    """Teacher-forced per-token logprobs: out[:, t] = log p(tokens[:, t] | <t).
+    Position 0 (no context) gets 0. Used for the behavior-policy snapshot and
+    the frozen-reference KL — both under the *untempered* policy."""
+    return _token_logprobs_inline(params, tokens, config, attn_impl)
+
+
+def make_grpo_step(
+    config: ModelConfig,
+    optimizer: optax.GradientTransformation,
+    clip_eps: float = 0.2,
+    kl_coef: float = 0.0,
+    attn_impl: str = "auto",
+    on_policy: bool = False,
+):
+    """Jitted GRPO update. Inputs: full packed sequences (B, T), a completion
+    mask (1.0 exactly on the tokens the policy sampled, EOS included), one
+    advantage per sequence, and the behavior/reference logprob snapshots.
+    Shardings propagate from the placed state/batch; the jit is mesh-agnostic
+    (same contract as trainer.make_train_step).
+
+    ``on_policy=True`` (valid when every rollout batch gets exactly one
+    update and there is no KL reference) skips the snapshot arguments:
+    old/ref default to stop_gradient of the current logprobs — the ratio is
+    identically 1, clipping is inert, and the caller saves one full
+    teacher-forced forward pass per step. Pass zeros for old_lp/ref_lp."""
+
+    def loss_fn(params, tokens, mask, advantages, old_lp, ref_lp):
+        lp = _token_logprobs_inline(params, tokens, config, attn_impl)
+        if on_policy:
+            old_lp = ref_lp = jax.lax.stop_gradient(lp)
+        ratio = jnp.exp(lp - old_lp)
+        clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps)
+        adv = advantages[:, None]
+        surrogate = jnp.minimum(ratio * adv, clipped * adv)
+        n_tokens = jnp.maximum(jnp.sum(mask), 1.0)
+        pg_loss = -jnp.sum(surrogate * mask) / n_tokens
+        # k3 estimator: unbiased, positive, low-variance (Schulman 2020)
+        kl = jnp.sum((jnp.exp(ref_lp - lp) - (ref_lp - lp) - 1.0) * mask) / n_tokens
+        clip_frac = jnp.sum((jnp.abs(ratio - 1.0) > clip_eps) * mask) / n_tokens
+        loss = pg_loss + kl_coef * kl
+        return loss, {"pg_loss": pg_loss, "kl": kl, "clip_frac": clip_frac,
+                      "ratio_mean": jnp.sum(ratio * mask) / n_tokens}
+
+    def grpo_step(state: TrainState, tokens, mask, advantages, old_lp, ref_lp):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, tokens, mask, advantages, old_lp, ref_lp
+        )
+        new_state, grad_norm = apply_gradients(state, grads, optimizer)
+        return new_state, {"loss": loss, "grad_norm": grad_norm, **aux}
+
+    return jax.jit(grpo_step, donate_argnums=(0,))
+
+
+def pack_rollouts(
+    prompt_ids: Sequence[Sequence[int]],   # B ragged prompts (already truncated to S)
+    gen_tokens: np.ndarray,                # (B, N) sampler output (pad after EOS)
+    gen_lengths: np.ndarray,               # (B,) pre-EOS lengths
+    pad_id: int,
+    total_len: int,                        # S + N, the static train width
+    eos_id: int = -1,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Repack prompt+completion CONTIGUOUSLY (B, S+N) + completion mask.
+
+    Generation ran with the prompt left-aligned and the completion appended at
+    position prompt_len via the KV cache — so the trained sequence must be
+    prompt tokens immediately followed by completion tokens (no pad gap in the
+    middle; a gap would teacher-force different positions than the policy saw).
+    The mask covers sampled tokens only, INCLUDING the EOS sample when it
+    fired (ending is a policy decision worth credit).
+    """
+    batch = len(prompt_ids)
+    n = gen_tokens.shape[1]
+    tokens = np.full((batch, total_len), pad_id, dtype=np.int32)
+    mask = np.zeros((batch, total_len), dtype=np.float32)
+    for i, prompt in enumerate(prompt_ids):
+        p = len(prompt)
+        gl = int(gen_lengths[i])
+        eff = min(gl + 1, n) if (eos_id >= 0 and gl < n) else gl
+        tokens[i, :p] = prompt
+        tokens[i, p : p + eff] = gen_tokens[i, :eff]
+        mask[i, p : p + eff] = 1.0
+    return tokens, mask
+
+
+@dataclass
+class GrpoReport:
+    steps: int = 0
+    mean_rewards: list[float] = field(default_factory=list)
+    final_loss: float = float("nan")
+    wall_time_s: float = 0.0
+
+    @property
+    def first_reward(self) -> float:
+        return self.mean_rewards[0] if self.mean_rewards else float("nan")
+
+    @property
+    def last_reward(self) -> float:
+        return self.mean_rewards[-1] if self.mean_rewards else float("nan")
+
+    def as_dict(self) -> dict[str, Any]:
+        return {
+            "steps": self.steps,
+            "first_reward": self.first_reward,
+            "last_reward": self.last_reward,
+            "final_loss": self.final_loss,
+            "wall_time_s": self.wall_time_s,
+        }
+
+
+def run_grpo(
+    config: ModelConfig,
+    params,
+    tokenizer,
+    examples: Sequence[dict],                      # [{"prompt":..., "answer":...}]
+    scorer: Callable[[str, str], float] | None,
+    cfg: GrpoConfig,
+    *,
+    optimizer: optax.GradientTransformation | None = None,
+    mesh=None,
+    rng: jax.Array | None = None,
+    metrics=None,                                  # train.metrics.MetricsLogger
+    checkpoints=None,                              # train.checkpoint.CheckpointManager
+    checkpoint_every: int = 0,
+    on_step: Callable[[int, dict], None] | None = None,
+    attn_impl: str = "auto",
+) -> tuple[TrainState, GrpoReport]:
+    """Drive the GRPO loop: sample P prompts → G rollouts each → score →
+    group advantages → mu clipped-surrogate updates. Returns the final
+    TrainState and a report with the reward trajectory.
+
+    ``scorer(completion, answer) -> float`` is the env contract
+    (envhub/execution.py LoadedEnvironment); None falls back to exact-match
+    via evals.datasets.score_completion.
+    """
+    import contextlib
+
+    from jax.sharding import NamedSharding
+
+    from prime_tpu.evals.datasets import score_completion
+    from prime_tpu.parallel.sharding import (
+        batch_spec,
+        cache_spec,
+        lengths_spec,
+        shard_batch,
+    )
+
+    if not examples:
+        raise ValueError("GRPO needs at least one {prompt, answer} example")
+    if optimizer is None:
+        optimizer = optax.chain(
+            optax.clip_by_global_norm(1.0), optax.adamw(cfg.learning_rate, b1=0.9, b2=0.95)
+        )
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+
+    state = init_train_state(params, optimizer)
+    ref_params = None
+    if cfg.kl_coef > 0.0:
+        # real copies, not aliases: the update step donates state.params, and
+        # donated buffers must not double as the frozen reference policy
+        ref_params = jax.tree.map(jnp.copy, params)
+    if mesh is not None:
+        from prime_tpu.train.trainer import shard_train_state as _sts
+
+        state = _sts(state, mesh, config)
+        if ref_params is not None:
+            from prime_tpu.parallel.sharding import shard_params
+
+            ref_params = shard_params(ref_params, mesh, config)
+
+    pad_id = tokenizer.pad_id
+    eos_id = getattr(tokenizer, "eos_id", -1)
+    batch = cfg.prompts_per_step * cfg.group_size
+    total_len = cfg.max_prompt_len + cfg.max_new_tokens
+    if mesh is not None:
+        data = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        if batch % data:
+            raise ValueError(
+                f"rollout batch {batch} (= prompts_per_step * group_size) must be "
+                f"divisible by the mesh data axes ({data})"
+            )
+
+    def place(x, spec):
+        if mesh is None:
+            return x
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    report = GrpoReport()
+    t0 = time.monotonic()
+    # prompt schedule derives from the caller's rng key — different keys give
+    # different schedules (a fixed host seed would repeat the same subset)
+    example_rng = np.random.default_rng(
+        np.asarray(jax.random.key_data(rng)).ravel().tolist()
+    )
+    mesh_ctx = (lambda: jax.set_mesh(mesh)) if mesh is not None else contextlib.nullcontext
+    gen_kw: dict = {}
+    score_impl = attn_impl
+    if mesh is not None:
+        gen_kw["cache_spec"] = cache_spec()
+        if mesh.size > 1:
+            # pallas is not SPMD-partitionable; both generate and the
+            # teacher-forced score/update passes must take the XLA paths
+            gen_kw["attn_impl"] = "xla"
+            score_impl = "xla"
+    # one update per batch and no KL reference → the ratio is identically 1:
+    # skip the behavior-policy snapshot pass entirely (stop_gradient inside)
+    on_policy = cfg.epochs_per_batch == 1 and cfg.kl_coef == 0.0
+    step_fn = make_grpo_step(
+        config, optimizer, cfg.clip_eps, cfg.kl_coef, score_impl, on_policy=on_policy
+    )
+
+    for step in range(cfg.steps):
+        picks = example_rng.choice(len(examples), size=cfg.prompts_per_step, replace=True)
+        chosen = [examples[int(i)] for i in picks]
+        prompt_ids = [
+            tokenizer.encode(e["prompt"])[-cfg.max_prompt_len :] for e in chosen
+        ]
+        # each prompt repeated G times, groups contiguous: row i*G+g
+        grouped_ids = [p for p in prompt_ids for _ in range(cfg.group_size)]
+        prompts = np.full((batch, cfg.max_prompt_len), pad_id, dtype=np.int32)
+        lengths = np.zeros((batch,), dtype=np.int32)
+        for i, ids in enumerate(grouped_ids):
+            prompts[i, : len(ids)] = ids
+            lengths[i] = len(ids)
+
+        rng, roll_rng = jax.random.split(rng)
+        with mesh_ctx():
+            result = generate(
+                state.params,
+                place(jnp.asarray(prompts), batch_spec()),
+                place(jnp.asarray(lengths), lengths_spec()),
+                config,
+                roll_rng,
+                max_new_tokens=cfg.max_new_tokens,
+                temperature=cfg.temperature,
+                top_p=cfg.top_p,
+                nucleus=cfg.top_p < 1.0,
+                eos_id=eos_id,
+                pad_id=pad_id,
+                **gen_kw,
+            )
+        gen_tokens = np.asarray(jax.device_get(result.tokens))
+        gen_lengths = np.asarray(jax.device_get(result.lengths))
+
+        completions = [
+            tokenizer.decode(gen_tokens[i, : gen_lengths[i]].tolist()) for i in range(batch)
+        ]
+        rewards = np.zeros((cfg.prompts_per_step, cfg.group_size), dtype=np.float32)
+        for i in range(batch):
+            answer = chosen[i // cfg.group_size].get("answer", "")
+            text = completions[i]
+            if scorer is not None:
+                rewards[i // cfg.group_size, i % cfg.group_size] = float(scorer(text, answer))
+            else:
+                rewards[i // cfg.group_size, i % cfg.group_size] = float(
+                    score_completion(text, str(answer))
+                )
+        advantages = group_advantages(rewards, cfg.adv_eps).reshape(batch)
+
+        tokens, mask = pack_rollouts(
+            grouped_ids, gen_tokens, gen_lengths, pad_id, total_len, eos_id=eos_id
+        )
+        tokens_j = jnp.asarray(tokens)
+        mask_j = jnp.asarray(mask)
+        adv_j = jnp.asarray(advantages)
+        if mesh is not None:
+            tokens_j, mask_j = shard_batch(tokens_j, mesh), shard_batch(mask_j, mesh)
+            adv_j = place(adv_j, lengths_spec())
+
+        with mesh_ctx():
+            if on_policy:
+                zeros = jnp.zeros_like(mask_j)
+                state, step_metrics = step_fn(state, tokens_j, mask_j, adv_j, zeros, zeros)
+            else:
+                old_lp = token_logprobs(state.params, tokens_j, config, attn_impl=score_impl)
+                ref_lp = (
+                    token_logprobs(ref_params, tokens_j, config, attn_impl=score_impl)
+                    if ref_params is not None
+                    else old_lp
+                )
+                for _ in range(cfg.epochs_per_batch):
+                    state, step_metrics = step_fn(state, tokens_j, mask_j, adv_j, old_lp, ref_lp)
+
+        mean_reward = float(rewards.mean())
+        loss = float(step_metrics["loss"])
+        report.steps = step + 1
+        report.mean_rewards.append(mean_reward)
+        report.final_loss = loss
+        row = {
+            "reward_mean": mean_reward,
+            "reward_std": float(rewards.std()),
+            "loss": loss,
+            "kl": float(step_metrics["kl"]),
+            "clip_frac": float(step_metrics["clip_frac"]),
+            "grad_norm": float(step_metrics["grad_norm"]),
+            "completion_len_mean": float(gen_lengths.mean()),
+        }
+        if metrics is not None:
+            metrics.log(step, **row)
+        if on_step is not None:
+            on_step(step, row)
+        if checkpoints is not None and checkpoint_every and (step + 1) % checkpoint_every == 0:
+            checkpoints.save(state, metrics={"reward_mean": mean_reward})
+
+    report.wall_time_s = time.monotonic() - t0
+    return state, report

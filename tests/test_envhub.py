@@ -404,3 +404,20 @@ def test_inspect_uninstalled_hub_env(runner, fake, env_dir):
     result = runner.invoke(cli, ["env", "inspect", "my-env", "--plain"])
     assert result.exit_code == 0, result.output
     assert "hub (not installed)" in result.output
+
+
+def test_train_local_rl_runs_env_protocol(runner, fake, tmp_path):
+    """`prime train local-rl <env>` drives GRPO with the environment execution
+    protocol: the hub env's dataset and scorer supply prompts and rewards."""
+    push = runner.invoke(cli, ["env", "push", "--dir", EXAMPLE_ENV])
+    assert push.exit_code == 0, push.output
+    result = runner.invoke(
+        cli,
+        ["train", "local-rl", "arith-rl", "-m", "tiny-test", "--steps", "2",
+         "-g", "2", "-p", "2", "--max-prompt-len", "24", "--max-new-tokens", "4",
+         "--name", "rl-env-run", "--output-dir", str(tmp_path / "rl"), "--plain"],
+    )
+    assert result.exit_code == 0, result.output
+    assert "Resolved env arith-rl" in result.output
+    metrics = (tmp_path / "rl" / "rl-env-run" / "metrics.jsonl").read_text().splitlines()
+    assert len(metrics) == 2

@@ -538,3 +538,47 @@ def test_train_local_resume_requires_name_and_checkpoints(tmp_path):
         cli, ["train", "local", "--resume", "--name", "x", "--output-dir", str(tmp_path)]
     )
     assert no_ckpt.exit_code != 0 and "--checkpoint-every" in no_ckpt.output
+
+
+def test_train_local_rl_cli_arith(tmp_path):
+    """`prime train local-rl arith`: native GRPO from the CLI — the built-in
+    arith env drives rollouts, metrics.jsonl gets one row per step."""
+    import json as _json
+
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.main import cli
+
+    result = CliRunner().invoke(
+        cli,
+        ["train", "local-rl", "arith", "-m", "tiny-test", "--steps", "3",
+         "-g", "2", "-p", "2", "--max-prompt-len", "16", "--max-new-tokens", "4",
+         "--lr", "1e-3", "--name", "rl-run", "--output-dir", str(tmp_path),
+         "--output", "json"],
+    )
+    assert result.exit_code == 0, result.output
+    payload = _json.loads(result.output)
+    assert payload["steps"] == 3 and payload["env"] == "arith"
+    rows = [
+        _json.loads(l)
+        for l in (tmp_path / "rl-run" / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert len(rows) == 3
+    assert all("reward_mean" in r and "kl" in r for r in rows)
+
+
+def test_train_local_rl_rejects_bad_flags(tmp_path):
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.main import cli
+
+    runner = CliRunner()
+    greedy = runner.invoke(
+        cli, ["train", "local-rl", "arith", "--temperature", "0",
+              "--output-dir", str(tmp_path)]
+    )
+    assert greedy.exit_code != 0 and "temperature" in greedy.output
+    solo = runner.invoke(
+        cli, ["train", "local-rl", "arith", "-g", "1", "--output-dir", str(tmp_path)]
+    )
+    assert solo.exit_code != 0 and "group_size" in solo.output
