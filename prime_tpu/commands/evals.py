@@ -30,12 +30,6 @@ def build_evals_client() -> EvalsClient:
     return EvalsClient(api)
 
 
-def _is_default(ctx: click.Context, param: str) -> bool:
-    from click.core import ParameterSource
-
-    return ctx.get_parameter_source(param) == ParameterSource.DEFAULT
-
-
 POLL_INTERVAL_S = 3.0
 
 
@@ -142,10 +136,11 @@ def run_eval_cmd(
         env_scorer = loaded.scorer
         run_env_name = loaded.name
         # env-declared eval defaults apply unless the flag was given explicitly
-        ctx = click.get_current_context()
-        if "max_new_tokens" in loaded.defaults and _is_default(ctx, "max_new_tokens"):
+        from prime_tpu.utils.render import flag_is_default
+
+        if "max_new_tokens" in loaded.defaults and flag_is_default("max_new_tokens"):
             max_new_tokens = int(loaded.defaults["max_new_tokens"])
-        if "temperature" in loaded.defaults and _is_default(ctx, "temperature"):
+        if "temperature" in loaded.defaults and flag_is_default("temperature"):
             temperature = float(loaded.defaults["temperature"])
 
     spec = EvalRunSpec(

@@ -329,16 +329,11 @@ def local_rl_cmd(
     examples, scorer, env_name, env_defaults = _rl_environment(render, env_ref)
 
     # env-declared eval defaults apply unless the flag was given explicitly
-    from click.core import ParameterSource
+    from prime_tpu.utils.render import flag_is_default
 
-    ctx = click.get_current_context()
-
-    def _is_default(param: str) -> bool:
-        return ctx.get_parameter_source(param) == ParameterSource.DEFAULT
-
-    if "max_new_tokens" in env_defaults and _is_default("max_new_tokens"):
+    if "max_new_tokens" in env_defaults and flag_is_default("max_new_tokens"):
         max_new_tokens = int(env_defaults["max_new_tokens"])
-    if "temperature" in env_defaults and _is_default("temperature"):
+    if "temperature" in env_defaults and flag_is_default("temperature"):
         env_temp = float(env_defaults["temperature"])
         if env_temp > 0.0:
             temperature = env_temp

@@ -21,6 +21,10 @@ class ModelConfig:
     rope_theta: float = 500000.0
     rms_eps: float = 1e-5
     tie_embeddings: bool = False
+    # decoupled per-head width (Qwen3/Gemma-style); None derives from d_model
+    head_dim_override: int | None = None
+    # q/k/v projection biases (Qwen2 family)
+    attn_bias: bool = False
     # mixture-of-experts (0 experts = dense MLP; Mixtral-style top-k routing)
     n_experts: int = 0
     experts_per_token: int = 2
@@ -28,7 +32,7 @@ class ModelConfig:
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     @property
     def is_moe(self) -> bool:
@@ -39,6 +43,8 @@ class ModelConfig:
         embed = self.vocab_size * self.d_model
         head = 0 if self.tie_embeddings else self.d_model * self.vocab_size
         attn = self.d_model * self.head_dim * (2 * self.n_heads + 2 * self.n_kv_heads)
+        if self.attn_bias:
+            attn += self.head_dim * (self.n_heads + 2 * self.n_kv_heads)
         if self.is_moe:
             mlp = self.n_experts * 3 * self.d_model * self.d_ff + self.d_model * self.n_experts
         else:
@@ -89,6 +95,61 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         n_kv_heads=8,
         d_ff=8192,
         tie_embeddings=True,
+    ),
+    # Qwen2.5 family: q/k/v biases, 1M rope theta, small sizes tie embeddings
+    "qwen2.5-0.5b": ModelConfig(
+        name="qwen2.5-0.5b",
+        vocab_size=151936,
+        d_model=896,
+        n_layers=24,
+        n_heads=14,
+        n_kv_heads=2,
+        d_ff=4864,
+        max_seq_len=32768,
+        rope_theta=1000000.0,
+        rms_eps=1e-6,
+        tie_embeddings=True,
+        attn_bias=True,
+    ),
+    "qwen2.5-1.5b": ModelConfig(
+        name="qwen2.5-1.5b",
+        vocab_size=151936,
+        d_model=1536,
+        n_layers=28,
+        n_heads=12,
+        n_kv_heads=2,
+        d_ff=8960,
+        max_seq_len=32768,
+        rope_theta=1000000.0,
+        rms_eps=1e-6,
+        tie_embeddings=True,
+        attn_bias=True,
+    ),
+    "qwen2.5-7b": ModelConfig(
+        name="qwen2.5-7b",
+        vocab_size=152064,
+        d_model=3584,
+        n_layers=28,
+        n_heads=28,
+        n_kv_heads=4,
+        d_ff=18944,
+        max_seq_len=32768,
+        rope_theta=1000000.0,
+        rms_eps=1e-6,
+        attn_bias=True,
+    ),
+    "qwen2.5-14b": ModelConfig(
+        name="qwen2.5-14b",
+        vocab_size=152064,
+        d_model=5120,
+        n_layers=48,
+        n_heads=40,
+        n_kv_heads=8,
+        d_ff=13824,
+        max_seq_len=32768,
+        rope_theta=1000000.0,
+        rms_eps=1e-6,
+        attn_bias=True,
     ),
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b",

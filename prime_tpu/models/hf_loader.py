@@ -1,9 +1,12 @@
-"""HuggingFace Llama checkpoint → prime_tpu param pytree.
+"""HuggingFace checkpoint → prime_tpu param pytree (Llama / Qwen2 / Mixtral).
 
-Maps the HF ``LlamaForCausalLM`` state dict onto the stacked-layer layout of
+Maps the HF ``LlamaForCausalLM``-shaped state dict (which Qwen2 and Mixtral
+share, modulo q/k/v biases and expert blocks) onto the stacked-layer layout of
 prime_tpu.models.llama (leading n_layers axis per leaf, weights transposed to
 (in, out) for right-multiplication). RoPE conventions match: both use the
-rotate-half formulation with inv_freq = theta^(-2i/d).
+rotate-half formulation with inv_freq = theta^(-2i/d). Decoupled head_dim
+(Qwen3/Gemma-style config.head_dim != hidden_size/num_heads) is carried via
+ModelConfig.head_dim_override.
 
 Loads from a local directory containing ``*.safetensors`` (or a torch
 ``pytorch_model.bin``); zero-egress environments ship checkpoints with pods.
@@ -23,15 +26,15 @@ from prime_tpu.models.config import ModelConfig
 def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
     derived_head_dim = hf_config.hidden_size // hf_config.num_attention_heads
     explicit_head_dim = getattr(hf_config, "head_dim", None)
-    if explicit_head_dim is not None and explicit_head_dim != derived_head_dim:
-        raise ValueError(
-            f"Unsupported checkpoint layout: config.json declares head_dim="
-            f"{explicit_head_dim} but hidden_size/num_attention_heads="
-            f"{hf_config.hidden_size}/{hf_config.num_attention_heads}={derived_head_dim}. "
-            "prime_tpu's Llama stack derives head_dim from hidden_size; checkpoints "
-            "with a decoupled head_dim (e.g. some Gemma/Qwen variants) are not supported."
-        )
+    model_type = getattr(hf_config, "model_type", "") or ""
+    # Qwen2 checkpoints carry q/k/v biases unconditionally; Llama-family
+    # configs declare them via attention_bias
+    attn_bias = bool(getattr(hf_config, "attention_bias", False)) or model_type == "qwen2"
     return ModelConfig(
+        head_dim_override=(
+            explicit_head_dim if explicit_head_dim not in (None, derived_head_dim) else None
+        ),
+        attn_bias=attn_bias,
         name=name,
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -122,6 +125,13 @@ def params_from_state_dict(
             "w_down": stacked("layers.{}.mlp.down_proj.weight", transpose=True),
         }
 
+    attn_biases = {}
+    if config.attn_bias:
+        attn_biases = {
+            "bq": stacked("layers.{}.self_attn.q_proj.bias", transpose=False),
+            "bk": stacked("layers.{}.self_attn.k_proj.bias", transpose=False),
+            "bv": stacked("layers.{}.self_attn.v_proj.bias", transpose=False),
+        }
     params: dict[str, Any] = {
         "embed": jnp.asarray(get("embed_tokens.weight"), dtype=dtype),
         "layers": {
@@ -131,6 +141,7 @@ def params_from_state_dict(
             "wv": stacked("layers.{}.self_attn.v_proj.weight", transpose=True),
             "wo": stacked("layers.{}.self_attn.o_proj.weight", transpose=True),
             "mlp_norm": stacked("layers.{}.post_attention_layernorm.weight", transpose=False),
+            **attn_biases,
             **mlp_weights,
         },
         "final_norm": jnp.asarray(get("norm.weight"), dtype=dtype),

@@ -125,6 +125,13 @@ def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Para
             "w_down": dense(keys[7], (layers, ff, d), ff),
         }
 
+    attn_biases = {}
+    if config.attn_bias:  # Qwen2-style q/k/v biases (no output bias)
+        attn_biases = {
+            "bq": jnp.zeros((layers, h * hd), dtype=dtype),
+            "bk": jnp.zeros((layers, kh * hd), dtype=dtype),
+            "bv": jnp.zeros((layers, kh * hd), dtype=dtype),
+        }
     params: Params = {
         "embed": dense(keys[0], (config.vocab_size, d), d),
         "layers": {
@@ -134,6 +141,7 @@ def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Para
             "wv": dense(keys[3], (layers, d, kh * hd), d),
             "wo": dense(keys[4], (layers, h * hd, d), h * hd),
             "mlp_norm": jnp.ones((layers, d), dtype=dtype),
+            **attn_biases,
             **mlp_weights,
         },
         "final_norm": jnp.ones((d,), dtype=dtype),
@@ -163,9 +171,12 @@ def _attention_block(
     cos, sin = rope_tables
 
     normed = rms_norm(x, lp["attn_norm"], config.rms_eps)
-    q = _mm(normed, lp["wq"]).reshape(batch, seq, h, hd)
-    k = _mm(normed, lp["wk"]).reshape(batch, seq, kh, hd)
-    v = _mm(normed, lp["wv"]).reshape(batch, seq, kh, hd)
+    q, k, v = _mm(normed, lp["wq"]), _mm(normed, lp["wk"]), _mm(normed, lp["wv"])
+    if "bq" in lp:  # Qwen2-style q/k/v biases
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(batch, seq, h, hd)
+    k = k.reshape(batch, seq, kh, hd)
+    v = v.reshape(batch, seq, kh, hd)
     q = apply_rope(q, positions, cos, sin)
     k = apply_rope(k, positions, cos, sin)
 

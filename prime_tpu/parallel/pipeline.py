@@ -44,6 +44,8 @@ def pipeline_param_specs(config: ModelConfig) -> dict:
         "w_up": P("pp", None, None),
         "w_down": P("pp", None, None),
     }
+    if config.attn_bias:
+        layer_spec |= {"bq": P("pp", None), "bk": P("pp", None), "bv": P("pp", None)}
     specs = {
         "embed": P(None, None),
         "layers": layer_spec,

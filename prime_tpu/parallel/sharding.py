@@ -39,6 +39,11 @@ def param_specs(config: ModelConfig) -> dict[str, Any]:
             "w_up": P(None, "fsdp", "tp"),
             "w_down": P(None, "tp", "fsdp"),
         }
+    attn_bias_specs: dict[str, Any] = {}
+    if config.attn_bias:
+        # bias vectors live on the projection output dim — same tp split as
+        # their matrices' output columns
+        attn_bias_specs = {"bq": P(None, "tp"), "bk": P(None, "tp"), "bv": P(None, "tp")}
     specs: dict[str, Any] = {
         "embed": P("tp", "fsdp"),              # (V, D) vocab on tp, d_model on fsdp
         "layers": {
@@ -48,6 +53,7 @@ def param_specs(config: ModelConfig) -> dict[str, Any]:
             "wv": P(None, "fsdp", "tp"),
             "wo": P(None, "tp", "fsdp"),
             "mlp_norm": P(None, None),
+            **attn_bias_specs,
             **mlp_specs,
         },
         "final_norm": P(None),

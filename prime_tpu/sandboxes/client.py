@@ -131,11 +131,12 @@ class _SandboxOps:
         return (
             f"mkdir -p {d} && rm -f {d}/pid {d}/exit && "
             f"{{ setsid nohup sh -c {shlex.quote(inner)} >/dev/null 2>&1 & }} && "
-            # wait (bounded) for the detached wrapper to publish its pid so the
-            # caller gets it synchronously; the `|| sleep 1` keeps shells whose
-            # sleep rejects fractions (busybox) from spinning the loop dry
+            # wait (bounded, ~2s) for the detached wrapper to publish its pid
+            # so the caller gets it synchronously; shells whose sleep rejects
+            # fractions (busybox) fall back to 1s ticks AND burn 100 loop
+            # counts per tick so the wall-clock bound stays ~2s either way
             f"i=0; while [ ! -s {d}/pid ] && [ $i -lt 200 ]; "
-            f"do sleep 0.01 2>/dev/null || sleep 1; i=$((i+1)); done; "
+            f"do sleep 0.01 2>/dev/null || {{ sleep 1; i=$((i+99)); }}; i=$((i+1)); done; "
             f"cat {d}/pid 2>/dev/null"
         )
 

@@ -274,7 +274,7 @@ def run_grpo(
         np.asarray(jax.random.key_data(rng)).ravel().tolist()
     )
     mesh_ctx = (lambda: jax.set_mesh(mesh)) if mesh is not None else contextlib.nullcontext
-    gen_kw: dict = {}
+    gen_kw: dict = {"attn_impl": attn_impl}
     score_impl = attn_impl
     if mesh is not None:
         gen_kw["cache_spec"] = cache_spec()

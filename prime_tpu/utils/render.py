@@ -104,3 +104,13 @@ def output_options(fn: Callable) -> Callable:
 
     functools.update_wrapper(wrapper, fn, assigned=("__name__", "__doc__"), updated=())
     return wrapper
+
+
+def flag_is_default(param: str) -> bool:
+    """True when ``param`` was not given explicitly on the command line —
+    used to let env-declared defaults beat CLI defaults but never beat the
+    user's own flags."""
+    from click.core import ParameterSource
+
+    ctx = click.get_current_context()
+    return ctx.get_parameter_source(param) == ParameterSource.DEFAULT

@@ -80,9 +80,7 @@ def test_decode_matches_transformers_generation(hf_model):
     np.testing.assert_array_equal(np.asarray(result.tokens[0]), hf_out)
 
 
-def test_config_from_hf_rejects_decoupled_head_dim():
-    import pytest
-
+def test_config_from_hf_decoupled_head_dim_carried():
     from prime_tpu.models.hf_loader import config_from_hf
 
     class Cfg:
@@ -90,13 +88,14 @@ def test_config_from_hf_rejects_decoupled_head_dim():
         hidden_size = 64
         num_hidden_layers = 2
         num_attention_heads = 4
-        head_dim = 32  # != 64 // 4
+        head_dim = 32  # != 64 // 4: decoupled (Qwen3/Gemma-style)
+        intermediate_size = 256
 
-    with pytest.raises(ValueError, match="head_dim"):
-        config_from_hf(Cfg())
+    config = config_from_hf(Cfg())
+    assert config.head_dim == 32 and config.head_dim_override == 32
 
 
-def test_config_from_hf_accepts_matching_head_dim():
+def test_config_from_hf_matching_head_dim_not_marked_override():
     from prime_tpu.models.hf_loader import config_from_hf
 
     class Cfg:
@@ -107,4 +106,101 @@ def test_config_from_hf_accepts_matching_head_dim():
         head_dim = 16
         intermediate_size = 256
 
-    assert config_from_hf(Cfg()).d_model == 64
+    config = config_from_hf(Cfg())
+    assert config.d_model == 64 and config.head_dim_override is None
+
+
+# -- Qwen2 family (q/k/v biases) ---------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def qwen_model():
+    cfg = transformers.Qwen2Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(1)
+    model = transformers.Qwen2ForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_qwen2_logits_match_transformers(qwen_model):
+    state = {k: v.float().numpy() for k, v in qwen_model.state_dict().items()}
+    config = config_from_hf(qwen_model.config, name="tiny-qwen")
+    assert config.attn_bias  # qwen2 always carries q/k/v biases
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+    assert "bq" in params["layers"]
+
+    tokens = np.array([[3, 17, 200, 45, 9, 88, 121, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = qwen_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    our_logits, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2_decode_matches_transformers_generation(qwen_model):
+    import jax
+
+    from prime_tpu.models.sampler import generate
+
+    state = {k: v.float().numpy() for k, v in qwen_model.state_dict().items()}
+    config = config_from_hf(qwen_model.config, name="tiny-qwen")
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+
+    prompt = np.array([[5, 42, 100, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_out = qwen_model.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=8,
+            do_sample=False,
+            eos_token_id=None,
+            pad_token_id=0,
+        ).numpy()[0, 4:]
+    result = generate(
+        params, jnp.asarray(prompt), jnp.array([4]), config,
+        jax.random.PRNGKey(0), max_new_tokens=8, temperature=0.0,
+    )
+    np.testing.assert_array_equal(np.asarray(result.tokens[0]), hf_out)
+
+
+# -- decoupled head_dim (Qwen3/Gemma-style layouts) --------------------------
+
+
+def test_decoupled_head_dim_logits_match_transformers():
+    cfg = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=32,  # decoupled: 4 heads x 32 != hidden 64
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(2)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    state = {k: v.float().numpy() for k, v in model.state_dict().items()}
+    config = config_from_hf(model.config, name="tiny-decoupled")
+    assert config.head_dim == 32
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+    assert params["layers"]["wq"].shape == (2, 64, 128)  # (L, D, H*hd)
+
+    tokens = np.array([[3, 17, 99, 45, 9, 88, 121, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    our_logits, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=2e-4, atol=2e-4)

@@ -225,3 +225,34 @@ def test_sp_decode_rejects_indivisible_capacity():
             jnp.zeros((1, 4, 1, 32)), jnp.zeros((1, 2, 32, 100)),
             jnp.zeros((1, 2, 32, 100)), jnp.zeros((1,), jnp.int32), mesh,
         )
+
+
+def test_sharded_generate_qwen_style_bias_and_decoupled_head_dim():
+    """attn_bias + head_dim_override must shard and decode like the plain
+    config: tp splits the bias vectors on the projection output dim."""
+    from jax.sharding import NamedSharding
+
+    from prime_tpu.models.sampler import generate as sample_generate
+    from prime_tpu.parallel.sharding import batch_spec, cache_spec, lengths_spec
+
+    cfg = CFG.scaled(name="tiny-qwen", attn_bias=True, head_dim_override=64)
+    mesh = make_mesh({"dp": 1, "fsdp": 4, "tp": 2})
+    params = init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    assert params["layers"]["bq"].shape == (cfg.n_layers, cfg.n_heads * 64)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (4, 10), 0, cfg.vocab_size)
+    lengths = jnp.asarray([10, 6, 8, 10], dtype=jnp.int32)
+
+    ref = sample_generate(
+        params, tokens, lengths, cfg, jax.random.PRNGKey(5),
+        max_new_tokens=6, temperature=0.0, eos_id=-1, pad_id=0,
+    )
+    sharded_params = shard_params(params, mesh, cfg)
+    tokens_s = jax.device_put(tokens, NamedSharding(mesh, batch_spec()))
+    lengths_s = jax.device_put(lengths, NamedSharding(mesh, lengths_spec()))
+    with jax.set_mesh(mesh):
+        out = sample_generate(
+            sharded_params, tokens_s, lengths_s, cfg, jax.random.PRNGKey(5),
+            max_new_tokens=6, temperature=0.0, eos_id=-1, pad_id=0,
+            cache_spec=cache_spec(),
+        )
+    np.testing.assert_array_equal(np.asarray(out.tokens), np.asarray(ref.tokens))
