@@ -25,6 +25,11 @@ class ModelConfig:
     head_dim_override: int | None = None
     # q/k/v projection biases (Qwen2 family)
     attn_bias: bool = False
+    # output-projection bias too (Llama-arch checkpoints with attention_bias;
+    # Qwen2 biases only q/k/v)
+    attn_out_bias: bool = False
+    # per-head RMSNorm on q/k before rope (Qwen3 family)
+    qk_norm: bool = False
     # mixture-of-experts (0 experts = dense MLP; Mixtral-style top-k routing)
     n_experts: int = 0
     experts_per_token: int = 2
@@ -45,6 +50,10 @@ class ModelConfig:
         attn = self.d_model * self.head_dim * (2 * self.n_heads + 2 * self.n_kv_heads)
         if self.attn_bias:
             attn += self.head_dim * (self.n_heads + 2 * self.n_kv_heads)
+        if self.attn_out_bias:
+            attn += self.d_model
+        if self.qk_norm:
+            attn += 2 * self.head_dim
         if self.is_moe:
             mlp = self.n_experts * 3 * self.d_model * self.d_ff + self.d_model * self.n_experts
         else:
@@ -150,6 +159,51 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         rope_theta=1000000.0,
         rms_eps=1e-6,
         attn_bias=True,
+    ),
+    # Qwen3 family: decoupled head_dim 128, per-head q/k RMSNorm, no biases
+    "qwen3-0.6b": ModelConfig(
+        name="qwen3-0.6b",
+        vocab_size=151936,
+        d_model=1024,
+        n_layers=28,
+        n_heads=16,
+        n_kv_heads=8,
+        d_ff=3072,
+        max_seq_len=40960,
+        rope_theta=1000000.0,
+        rms_eps=1e-6,
+        tie_embeddings=True,
+        head_dim_override=128,
+        qk_norm=True,
+    ),
+    "qwen3-4b": ModelConfig(
+        name="qwen3-4b",
+        vocab_size=151936,
+        d_model=2560,
+        n_layers=36,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=9728,
+        max_seq_len=40960,
+        rope_theta=1000000.0,
+        rms_eps=1e-6,
+        tie_embeddings=True,
+        head_dim_override=128,
+        qk_norm=True,
+    ),
+    "qwen3-8b": ModelConfig(
+        name="qwen3-8b",
+        vocab_size=151936,
+        d_model=4096,
+        n_layers=36,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=12288,
+        max_seq_len=40960,
+        rope_theta=1000000.0,
+        rms_eps=1e-6,
+        head_dim_override=128,
+        qk_norm=True,
     ),
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b",

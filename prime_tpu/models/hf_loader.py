@@ -35,6 +35,9 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
             explicit_head_dim if explicit_head_dim not in (None, derived_head_dim) else None
         ),
         attn_bias=attn_bias,
+        # Llama-arch attention_bias biases o_proj as well; Qwen2 does not
+        attn_out_bias=bool(getattr(hf_config, "attention_bias", False)),
+        qk_norm=model_type == "qwen3",
         name=name,
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -131,6 +134,15 @@ def params_from_state_dict(
             "bq": stacked("layers.{}.self_attn.q_proj.bias", transpose=False),
             "bk": stacked("layers.{}.self_attn.k_proj.bias", transpose=False),
             "bv": stacked("layers.{}.self_attn.v_proj.bias", transpose=False),
+        }
+    if config.attn_out_bias:
+        # Llama-arch attention_bias=True biases o_proj too — dropping it
+        # would silently offset every layer's attention output
+        attn_biases["bo"] = stacked("layers.{}.self_attn.o_proj.bias", transpose=False)
+    if config.qk_norm:
+        attn_biases |= {
+            "q_norm": stacked("layers.{}.self_attn.q_norm.weight", transpose=False),
+            "k_norm": stacked("layers.{}.self_attn.k_norm.weight", transpose=False),
         }
     params: dict[str, Any] = {
         "embed": jnp.asarray(get("embed_tokens.weight"), dtype=dtype),

@@ -132,6 +132,13 @@ def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Para
             "bk": jnp.zeros((layers, kh * hd), dtype=dtype),
             "bv": jnp.zeros((layers, kh * hd), dtype=dtype),
         }
+    if config.attn_out_bias:  # Llama-arch attention_bias biases o_proj too
+        attn_biases["bo"] = jnp.zeros((layers, d), dtype=dtype)
+    if config.qk_norm:  # Qwen3-style per-head q/k RMSNorm (weights shared across heads)
+        attn_biases |= {
+            "q_norm": jnp.ones((layers, hd), dtype=dtype),
+            "k_norm": jnp.ones((layers, hd), dtype=dtype),
+        }
     params: Params = {
         "embed": dense(keys[0], (config.vocab_size, d), d),
         "layers": {
@@ -177,6 +184,9 @@ def _attention_block(
     q = q.reshape(batch, seq, h, hd)
     k = k.reshape(batch, seq, kh, hd)
     v = v.reshape(batch, seq, kh, hd)
+    if "q_norm" in lp:  # Qwen3-style per-head RMSNorm before rope
+        q = rms_norm(q, lp["q_norm"], config.rms_eps)
+        k = rms_norm(k, lp["k_norm"], config.rms_eps)
     q = apply_rope(q, positions, cos, sin)
     k = apply_rope(k, positions, cos, sin)
 
@@ -243,7 +253,10 @@ def _attention_block(
                 new_v_cache = jax.lax.dynamic_update_slice(v_cache, v_t, (0, 0, 0, 0))
 
     attn = attn.transpose(0, 2, 1, 3).reshape(batch, seq, h * hd)
-    return x + _mm(attn, lp["wo"]), new_k_cache, new_v_cache, new_k_scale, new_v_scale
+    out = _mm(attn, lp["wo"])
+    if "bo" in lp:  # Llama-arch attention_bias checkpoints bias o_proj too
+        out = out + lp["bo"]
+    return x + out, new_k_cache, new_v_cache, new_k_scale, new_v_scale
 
 
 def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> tuple[jnp.ndarray, jnp.ndarray]:

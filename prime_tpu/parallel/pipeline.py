@@ -46,6 +46,10 @@ def pipeline_param_specs(config: ModelConfig) -> dict:
     }
     if config.attn_bias:
         layer_spec |= {"bq": P("pp", None), "bk": P("pp", None), "bv": P("pp", None)}
+    if config.attn_out_bias:
+        layer_spec |= {"bo": P("pp", None)}
+    if config.qk_norm:
+        layer_spec |= {"q_norm": P("pp", None), "k_norm": P("pp", None)}
     specs = {
         "embed": P(None, None),
         "layers": layer_spec,

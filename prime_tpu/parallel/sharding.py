@@ -44,6 +44,11 @@ def param_specs(config: ModelConfig) -> dict[str, Any]:
         # bias vectors live on the projection output dim — same tp split as
         # their matrices' output columns
         attn_bias_specs = {"bq": P(None, "tp"), "bk": P(None, "tp"), "bv": P(None, "tp")}
+    if config.attn_out_bias:
+        attn_bias_specs["bo"] = P(None, "fsdp")  # d_model dim, like wo's output
+    if config.qk_norm:
+        # (L, head_dim) weights shared across heads: replicate
+        attn_bias_specs |= {"q_norm": P(None, None), "k_norm": P(None, None)}
     specs: dict[str, Any] = {
         "embed": P("tp", "fsdp"),              # (V, D) vocab on tp, d_model on fsdp
         "layers": {

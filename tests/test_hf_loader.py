@@ -204,3 +204,105 @@ def test_decoupled_head_dim_logits_match_transformers():
         hf_logits = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
     our_logits, _ = forward(params, jnp.asarray(tokens), config)
     np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+# -- Qwen3 family (qk-norm + decoupled head_dim) -----------------------------
+
+
+@pytest.fixture(scope="module")
+def qwen3_model():
+    cfg = transformers.Qwen3Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=32,  # decoupled: 4 x 32 != 64
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(4)
+    model = transformers.Qwen3ForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_qwen3_logits_match_transformers(qwen3_model):
+    state = {k: v.float().numpy() for k, v in qwen3_model.state_dict().items()}
+    config = config_from_hf(qwen3_model.config, name="tiny-qwen3")
+    assert config.qk_norm and config.head_dim == 32 and not config.attn_bias
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+    assert params["layers"]["q_norm"].shape == (2, 32)
+
+    tokens = np.array([[3, 17, 200, 45, 9, 88, 121, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = qwen3_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    our_logits, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen3_decode_matches_transformers_generation(qwen3_model):
+    import jax
+
+    from prime_tpu.models.sampler import generate
+
+    state = {k: v.float().numpy() for k, v in qwen3_model.state_dict().items()}
+    config = config_from_hf(qwen3_model.config, name="tiny-qwen3")
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+
+    prompt = np.array([[5, 42, 100, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_out = qwen3_model.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=8,
+            do_sample=False,
+            eos_token_id=None,
+            pad_token_id=0,
+        ).numpy()[0, 4:]
+    result = generate(
+        params, jnp.asarray(prompt), jnp.array([4]), config,
+        jax.random.PRNGKey(0), max_new_tokens=8, temperature=0.0,
+    )
+    np.testing.assert_array_equal(np.asarray(result.tokens[0]), hf_out)
+
+
+def test_llama_attention_bias_includes_o_proj_bias():
+    """Llama-arch attention_bias=True biases o_proj as well as q/k/v —
+    logits must still match transformers exactly."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        attention_bias=True,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(5)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    # random biases: zero-init biases would mask a dropped-bias bug
+    with torch.no_grad():
+        for layer in model.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj, layer.self_attn.o_proj):
+                proj.bias.normal_(0.0, 0.5)
+    state = {k: v.float().numpy() for k, v in model.state_dict().items()}
+    config = config_from_hf(model.config, name="tiny-obias")
+    assert config.attn_bias and config.attn_out_bias
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+    assert "bo" in params["layers"]
+
+    tokens = np.array([[3, 17, 99, 45, 9, 88, 121, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    our_logits, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=2e-4, atol=2e-4)

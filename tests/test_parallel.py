@@ -235,10 +235,11 @@ def test_sharded_generate_qwen_style_bias_and_decoupled_head_dim():
     from prime_tpu.models.sampler import generate as sample_generate
     from prime_tpu.parallel.sharding import batch_spec, cache_spec, lengths_spec
 
-    cfg = CFG.scaled(name="tiny-qwen", attn_bias=True, head_dim_override=64)
+    cfg = CFG.scaled(name="tiny-qwen", attn_bias=True, head_dim_override=64, qk_norm=True)
     mesh = make_mesh({"dp": 1, "fsdp": 4, "tp": 2})
     params = init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
     assert params["layers"]["bq"].shape == (cfg.n_layers, cfg.n_heads * 64)
+    assert params["layers"]["q_norm"].shape == (cfg.n_layers, 64)
     tokens = jax.random.randint(jax.random.PRNGKey(4), (4, 10), 0, cfg.vocab_size)
     lengths = jnp.asarray([10, 6, 8, 10], dtype=jnp.int32)
 
