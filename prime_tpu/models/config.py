@@ -30,6 +30,15 @@ class ModelConfig:
     attn_out_bias: bool = False
     # per-head RMSNorm on q/k before rope (Qwen3 family)
     qk_norm: bool = False
+    # --- Gemma-family architecture knobs ---
+    act: str = "silu"                 # MLP activation: "silu" | "gelu_tanh"
+    norm_plus_one: bool = False       # RMSNorm scales by (1 + w)
+    post_norms: bool = False          # extra norms on block outputs (Gemma2/3)
+    scale_embed: bool = False         # hidden *= sqrt(d_model) after embedding
+    attn_softcap: float = 0.0         # tanh softcap on attention scores
+    final_softcap: float = 0.0        # tanh softcap on output logits
+    query_scale: float | None = None  # sm_scale = query_scale**-0.5 (else head_dim)
+    sliding_window: int = 0           # window for the sliding layers (even idx)
     # mixture-of-experts (0 experts = dense MLP; Mixtral-style top-k routing)
     n_experts: int = 0
     experts_per_token: int = 2
@@ -58,7 +67,7 @@ class ModelConfig:
             mlp = self.n_experts * 3 * self.d_model * self.d_ff + self.d_model * self.n_experts
         else:
             mlp = 3 * self.d_model * self.d_ff
-        norms = 2 * self.d_model
+        norms = (4 if self.post_norms else 2) * self.d_model
         per_layer = attn + mlp + norms
         return embed + head + self.n_layers * per_layer + self.d_model
 
@@ -204,6 +213,74 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         rms_eps=1e-6,
         head_dim_override=128,
         qk_norm=True,
+    ),
+    # Gemma 2 family: GeGLU, (1+w) norms, post-norms, scaled embeddings,
+    # softcapping, alternating 4k sliding-window / global layers
+    "gemma2-2b": ModelConfig(
+        name="gemma2-2b",
+        vocab_size=256000,
+        d_model=2304,
+        n_layers=26,
+        n_heads=8,
+        n_kv_heads=4,
+        d_ff=9216,
+        max_seq_len=8192,
+        rope_theta=10000.0,
+        rms_eps=1e-6,
+        tie_embeddings=True,
+        head_dim_override=256,
+        act="gelu_tanh",
+        norm_plus_one=True,
+        post_norms=True,
+        scale_embed=True,
+        attn_softcap=50.0,
+        final_softcap=30.0,
+        query_scale=256,
+        sliding_window=4096,
+    ),
+    "gemma2-9b": ModelConfig(
+        name="gemma2-9b",
+        vocab_size=256000,
+        d_model=3584,
+        n_layers=42,
+        n_heads=16,
+        n_kv_heads=8,
+        d_ff=14336,
+        max_seq_len=8192,
+        rope_theta=10000.0,
+        rms_eps=1e-6,
+        tie_embeddings=True,
+        head_dim_override=256,
+        act="gelu_tanh",
+        norm_plus_one=True,
+        post_norms=True,
+        scale_embed=True,
+        attn_softcap=50.0,
+        final_softcap=30.0,
+        query_scale=256,
+        sliding_window=4096,
+    ),
+    "gemma2-27b": ModelConfig(
+        name="gemma2-27b",
+        vocab_size=256000,
+        d_model=4608,
+        n_layers=46,
+        n_heads=32,
+        n_kv_heads=16,
+        d_ff=36864,
+        max_seq_len=8192,
+        rope_theta=10000.0,
+        rms_eps=1e-6,
+        tie_embeddings=True,
+        head_dim_override=128,
+        act="gelu_tanh",
+        norm_plus_one=True,
+        post_norms=True,
+        scale_embed=True,
+        attn_softcap=50.0,
+        final_softcap=30.0,
+        query_scale=144,
+        sliding_window=4096,
     ),
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b",

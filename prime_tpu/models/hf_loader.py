@@ -30,6 +30,7 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
     # Qwen2 checkpoints carry q/k/v biases unconditionally; Llama-family
     # configs declare them via attention_bias
     attn_bias = bool(getattr(hf_config, "attention_bias", False)) or model_type == "qwen2"
+    gemma = model_type == "gemma2"
     return ModelConfig(
         head_dim_override=(
             explicit_head_dim if explicit_head_dim not in (None, derived_head_dim) else None
@@ -38,6 +39,16 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         # Llama-arch attention_bias biases o_proj as well; Qwen2 does not
         attn_out_bias=bool(getattr(hf_config, "attention_bias", False)),
         qk_norm=model_type == "qwen3",
+        # Gemma2: GeGLU, (1+w) norms, post-norms, scaled embeddings,
+        # softcapped scores/logits, decoupled query scale, alternating windows
+        act="gelu_tanh" if gemma else "silu",
+        norm_plus_one=gemma,
+        post_norms=gemma,
+        scale_embed=gemma,
+        attn_softcap=float(getattr(hf_config, "attn_logit_softcapping", 0.0) or 0.0),
+        final_softcap=float(getattr(hf_config, "final_logit_softcapping", 0.0) or 0.0),
+        query_scale=getattr(hf_config, "query_pre_attn_scalar", None),
+        sliding_window=int(getattr(hf_config, "sliding_window", 0) or 0) if gemma else 0,
         name=name,
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -48,7 +59,9 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         max_seq_len=getattr(hf_config, "max_position_embeddings", 8192),
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
         rms_eps=getattr(hf_config, "rms_norm_eps", 1e-5),
-        tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        # Gemma's config default ties embeddings, so checkpoints omit the key
+        # from config.json; Llama-family defaults to untied
+        tie_embeddings=getattr(hf_config, "tie_word_embeddings", gemma),
         # Mixtral-style sparse MoE
         n_experts=getattr(hf_config, "num_local_experts", 0) or 0,
         experts_per_token=getattr(hf_config, "num_experts_per_tok", 2) or 2,
@@ -144,15 +157,32 @@ def params_from_state_dict(
             "q_norm": stacked("layers.{}.self_attn.q_norm.weight", transpose=False),
             "k_norm": stacked("layers.{}.self_attn.k_norm.weight", transpose=False),
         }
+    if config.post_norms:
+        # Gemma2 norm naming: post_attention_layernorm is a POST-norm on the
+        # attention output; the pre-MLP norm is pre_feedforward_layernorm
+        norm_keys = {
+            "attn_norm": stacked("layers.{}.input_layernorm.weight", transpose=False),
+            "attn_post_norm": stacked(
+                "layers.{}.post_attention_layernorm.weight", transpose=False
+            ),
+            "mlp_norm": stacked("layers.{}.pre_feedforward_layernorm.weight", transpose=False),
+            "mlp_post_norm": stacked(
+                "layers.{}.post_feedforward_layernorm.weight", transpose=False
+            ),
+        }
+    else:
+        norm_keys = {
+            "attn_norm": stacked("layers.{}.input_layernorm.weight", transpose=False),
+            "mlp_norm": stacked("layers.{}.post_attention_layernorm.weight", transpose=False),
+        }
     params: dict[str, Any] = {
         "embed": jnp.asarray(get("embed_tokens.weight"), dtype=dtype),
         "layers": {
-            "attn_norm": stacked("layers.{}.input_layernorm.weight", transpose=False),
             "wq": stacked("layers.{}.self_attn.q_proj.weight", transpose=True),
             "wk": stacked("layers.{}.self_attn.k_proj.weight", transpose=True),
             "wv": stacked("layers.{}.self_attn.v_proj.weight", transpose=True),
             "wo": stacked("layers.{}.self_attn.o_proj.weight", transpose=True),
-            "mlp_norm": stacked("layers.{}.post_attention_layernorm.weight", transpose=False),
+            **norm_keys,
             **attn_biases,
             **mlp_weights,
         },

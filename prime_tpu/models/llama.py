@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from prime_tpu.models.config import ModelConfig
 from prime_tpu.models.quantize import matmul as _mm
 from prime_tpu.ops.attention import (
+    _apply_softcap,
     cache_prefill_attention,
     decode_attention,
     multi_head_attention,
@@ -100,11 +101,17 @@ def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     return q, scale
 
 
+def _norm(x: jnp.ndarray, weight: jnp.ndarray, config: ModelConfig) -> jnp.ndarray:
+    return rms_norm(x, weight, config.rms_eps, plus_one=config.norm_plus_one)
+
+
 def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Params:
     """Random init (truncated-normal-ish scaled); checkpoint loaders overwrite."""
     keys = jax.random.split(rng, 10)
     d, hd = config.d_model, config.head_dim
     h, kh, ff, layers = config.n_heads, config.n_kv_heads, config.d_ff, config.n_layers
+    # Gemma-style (1+w) norms are zero-initialized (≡ unit scale)
+    norm_init = jnp.zeros if config.norm_plus_one else jnp.ones
 
     def dense(key, shape, fan_in):
         return (jax.random.normal(key, shape, dtype=jnp.float32) * (fan_in**-0.5)).astype(dtype)
@@ -136,22 +143,27 @@ def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Para
         attn_biases["bo"] = jnp.zeros((layers, d), dtype=dtype)
     if config.qk_norm:  # Qwen3-style per-head q/k RMSNorm (weights shared across heads)
         attn_biases |= {
-            "q_norm": jnp.ones((layers, hd), dtype=dtype),
-            "k_norm": jnp.ones((layers, hd), dtype=dtype),
+            "q_norm": norm_init((layers, hd), dtype=dtype),
+            "k_norm": norm_init((layers, hd), dtype=dtype),
+        }
+    if config.post_norms:  # Gemma2-style norms on the block outputs
+        attn_biases |= {
+            "attn_post_norm": norm_init((layers, d), dtype=dtype),
+            "mlp_post_norm": norm_init((layers, d), dtype=dtype),
         }
     params: Params = {
         "embed": dense(keys[0], (config.vocab_size, d), d),
         "layers": {
-            "attn_norm": jnp.ones((layers, d), dtype=dtype),
+            "attn_norm": norm_init((layers, d), dtype=dtype),
             "wq": dense(keys[1], (layers, d, h * hd), d),
             "wk": dense(keys[2], (layers, d, kh * hd), d),
             "wv": dense(keys[3], (layers, d, kh * hd), d),
             "wo": dense(keys[4], (layers, h * hd, d), h * hd),
-            "mlp_norm": jnp.ones((layers, d), dtype=dtype),
+            "mlp_norm": norm_init((layers, d), dtype=dtype),
             **attn_biases,
             **mlp_weights,
         },
-        "final_norm": jnp.ones((d,), dtype=dtype),
+        "final_norm": norm_init((d,), dtype=dtype),
     }
     if not config.tie_embeddings:
         params["lm_head"] = dense(keys[8], (d, config.vocab_size), d)
@@ -172,12 +184,17 @@ def _attention_block(
     k_scale: jnp.ndarray | None = None,  # (B, KH, 1, C) when quantized
     v_scale: jnp.ndarray | None = None,
     prefill_offset: jnp.ndarray | None = None,  # () chunked prefill: write+attend at offset
+    sliding: jnp.ndarray | None = None,  # () traced bool: this layer uses the window
 ):
     batch, seq, _ = x.shape
     h, kh, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    sm_scale = (config.query_scale or hd) ** -0.5
+    gemma_kw = dict(
+        softcap=config.attn_softcap, window=config.sliding_window, sliding=sliding
+    )
     cos, sin = rope_tables
 
-    normed = rms_norm(x, lp["attn_norm"], config.rms_eps)
+    normed = _norm(x, lp["attn_norm"], config)
     q, k, v = _mm(normed, lp["wq"]), _mm(normed, lp["wk"]), _mm(normed, lp["wv"])
     if "bq" in lp:  # Qwen2-style q/k/v biases
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
@@ -185,8 +202,8 @@ def _attention_block(
     k = k.reshape(batch, seq, kh, hd)
     v = v.reshape(batch, seq, kh, hd)
     if "q_norm" in lp:  # Qwen3-style per-head RMSNorm before rope
-        q = rms_norm(q, lp["q_norm"], config.rms_eps)
-        k = rms_norm(k, lp["k_norm"], config.rms_eps)
+        q = _norm(q, lp["q_norm"], config)
+        k = _norm(k, lp["k_norm"], config)
     q = apply_rope(q, positions, cos, sin)
     k = apply_rope(k, positions, cos, sin)
 
@@ -217,8 +234,8 @@ def _attention_block(
             new_k_cache = put(k_cache, k_col)
             new_v_cache = put(v_cache, v_col)
         attn = decode_attention(
-            q, new_k_cache, new_v_cache, cache_lengths + 1, hd**-0.5, impl=attn_impl,
-            k_scale=new_k_scale, v_scale=new_v_scale,
+            q, new_k_cache, new_v_cache, cache_lengths + 1, sm_scale, impl=attn_impl,
+            k_scale=new_k_scale, v_scale=new_v_scale, **gemma_kw,
         )
     elif prefill_offset is not None:
         # chunked prefill: write this chunk's K/V into the cache at the
@@ -234,9 +251,9 @@ def _attention_block(
         zero = jnp.zeros((), dtype=jnp.int32)
         new_k_cache = jax.lax.dynamic_update_slice(k_cache, k_t, (zero, zero, zero, off))
         new_v_cache = jax.lax.dynamic_update_slice(v_cache, v_t, (zero, zero, zero, off))
-        attn = cache_prefill_attention(q, new_k_cache, new_v_cache, off, hd**-0.5)
+        attn = cache_prefill_attention(q, new_k_cache, new_v_cache, off, sm_scale, **gemma_kw)
     else:
-        attn = multi_head_attention(q, k, v, impl=attn_impl)
+        attn = multi_head_attention(q, k, v, sm_scale, impl=attn_impl, **gemma_kw)
         if k_cache is not None:
             # prefill: stage the prompt's k/v feature-major at slots [0, S)
             k_t = k.transpose(0, 1, 3, 2)  # (B, KH, hd, S)
@@ -256,12 +273,14 @@ def _attention_block(
     out = _mm(attn, lp["wo"])
     if "bo" in lp:  # Llama-arch attention_bias checkpoints bias o_proj too
         out = out + lp["bo"]
+    if "attn_post_norm" in lp:  # Gemma2-style post-norm before the residual add
+        out = _norm(out, lp["attn_post_norm"], config)
     return x + out, new_k_cache, new_v_cache, new_k_scale, new_v_scale
 
 
 def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Dense or sparse-MoE feed-forward. Returns (residual output, aux loss)."""
-    normed = rms_norm(x, lp["mlp_norm"], config.rms_eps)
+    normed = _norm(x, lp["mlp_norm"], config)
     if config.is_moe:
         from prime_tpu.ops.moe import moe_mlp
 
@@ -274,10 +293,21 @@ def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> tuple[jnp.nda
             k=config.experts_per_token,
             capacity_factor=config.capacity_factor,
         )
+        if "mlp_post_norm" in lp:
+            y = _norm(y, lp["mlp_post_norm"], config)
         return x + y, aux
-    gate = jax.nn.silu(_mm(normed, lp["w_gate"]))
+    act = jax.nn.silu if config.act == "silu" else _gelu_tanh
+    gate = act(_mm(normed, lp["w_gate"]))
     up = _mm(normed, lp["w_up"])
-    return x + _mm(gate * up, lp["w_down"]), jnp.zeros((), jnp.float32)
+    y = _mm(gate * up, lp["w_down"])
+    if "mlp_post_norm" in lp:  # Gemma2-style post-norm before the residual add
+        y = _norm(y, lp["mlp_post_norm"], config)
+    return x + y, jnp.zeros((), jnp.float32)
+
+
+def _gelu_tanh(x: jnp.ndarray) -> jnp.ndarray:
+    """HF's gelu_pytorch_tanh (the Gemma MLP activation)."""
+    return jax.nn.gelu(x, approximate=True)
 
 
 def forward(
@@ -312,24 +342,34 @@ def forward(
     rope_tables = rope_frequencies(config.head_dim, max_pos, config.rope_theta)
 
     x = params["embed"][tokens]
+    if config.scale_embed:  # Gemma normalizes hidden states by sqrt(d_model)
+        x = x * jnp.asarray(config.d_model**0.5, dtype=x.dtype)
 
     layer_params = params["layers"]
     cache_lengths = cache.lengths if cache is not None else None
     aux0 = jnp.zeros((), jnp.float32)
+    # Gemma2 alternates sliding-window (even) and global (odd) layers; the
+    # per-layer flag rides the scan so one compiled body serves both kinds
+    sliding_flags = (
+        jnp.arange(config.n_layers) % 2 == 0
+        if config.sliding_window
+        else jnp.zeros((config.n_layers,), dtype=bool)
+    )
 
     quantized = cache is not None and cache.quantized
 
     def layer_fn(carry, scanned):
         x, aux_sum = carry
         if quantized:
-            lp, k_c, v_c, k_s, v_s = scanned
+            lp, sliding, k_c, v_c, k_s, v_s = scanned
         else:
-            lp, k_c, v_c = scanned
+            lp, sliding, k_c, v_c = scanned
             k_s = v_s = None
         x, new_k, new_v, new_ks, new_vs = _attention_block(
             x, lp, positions, rope_tables, config,
             k_c, v_c, cache_lengths, decode, attn_impl,
             k_scale=k_s, v_scale=v_s, prefill_offset=prefill_offset,
+            sliding=sliding,
         )
         x, aux = _mlp_block(x, lp, config)
         ys = (new_k, new_v, new_ks, new_vs) if quantized else (new_k, new_v)
@@ -337,13 +377,13 @@ def forward(
 
     if cache is not None:
         if quantized:
-            xs = (layer_params, cache.k, cache.v, cache.k_scale, cache.v_scale)
+            xs = (layer_params, sliding_flags, cache.k, cache.v, cache.k_scale, cache.v_scale)
             (x, aux_total), (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
                 layer_fn, (x, aux0), xs
             )
         else:
             (x, aux_total), (new_k, new_v) = jax.lax.scan(
-                layer_fn, (x, aux0), (layer_params, cache.k, cache.v)
+                layer_fn, (x, aux0), (layer_params, sliding_flags, cache.k, cache.v)
             )
             new_ks = new_vs = None
         new_lengths = cache.lengths + (1 if decode else seq)
@@ -352,20 +392,24 @@ def forward(
         )
     else:
 
-        def layer_fn_nocache(carry, lp):
+        def layer_fn_nocache(carry, scanned):
+            lp, sliding = scanned
             x, aux_sum = carry
             x, _, _, _, _ = _attention_block(
-                x, lp, positions, rope_tables, config, None, None, None, False, attn_impl
+                x, lp, positions, rope_tables, config, None, None, None, False, attn_impl,
+                sliding=sliding,
             )
             x, aux = _mlp_block(x, lp, config)
             return (x, aux_sum + aux), None
 
-        (x, aux_total), _ = jax.lax.scan(layer_fn_nocache, (x, aux0), layer_params)
+        (x, aux_total), _ = jax.lax.scan(
+            layer_fn_nocache, (x, aux0), (layer_params, sliding_flags)
+        )
         new_cache = None
 
-    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    x = _norm(x, params["final_norm"], config)
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
-    logits = (x @ head).astype(jnp.float32)
+    logits = _apply_softcap((x @ head).astype(jnp.float32), config.final_softcap)
     if return_aux:
         return logits, new_cache, aux_total
     return logits, new_cache

@@ -25,11 +25,33 @@ def _pallas_eligible(q: jnp.ndarray, head_dim: int) -> bool:
     return seq_len % BLOCK_Q == 0 and head_dim % 128 == 0
 
 
+def _apply_softcap(scores: jnp.ndarray, softcap: float) -> jnp.ndarray:
+    """Gemma2-style logit softcapping: softcap * tanh(scores / softcap).
+    Applied to the scaled scores BEFORE masking (masked -inf entries must not
+    pass through tanh or they'd become finite)."""
+    if softcap:
+        return jnp.tanh(scores / softcap) * softcap
+    return scores
+
+
+def _window_ok(delta: jnp.ndarray, window: int, sliding: jnp.ndarray | None) -> jnp.ndarray:
+    """True where the query-key distance fits the sliding window. ``sliding``
+    is a traced per-layer bool (Gemma2 alternates windowed/global layers);
+    None means the window applies unconditionally."""
+    ok = delta < window
+    if sliding is not None:
+        ok = ok | ~sliding
+    return ok
+
+
 def xla_attention_causal(
     q: jnp.ndarray,  # (B, H, S, D)
     k: jnp.ndarray,  # (B, KH, S, D)
     v: jnp.ndarray,
     sm_scale: float,
+    softcap: float = 0.0,
+    window: int = 0,
+    sliding: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Reference causal attention (fp32 softmax), GQA via head repetition."""
     num_heads, kv_heads = q.shape[1], k.shape[1]
@@ -38,9 +60,13 @@ def xla_attention_causal(
         k = jnp.repeat(k, reps, axis=1)
         v = jnp.repeat(v, reps, axis=1)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * sm_scale
+    scores = _apply_softcap(scores, softcap)
     seq = q.shape[2]
-    causal = jnp.tril(jnp.ones((seq, seq), dtype=bool))
-    scores = jnp.where(causal[None, None], scores, NEG_INF)
+    allowed = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+    if window:
+        pos = jnp.arange(seq)
+        allowed = allowed & _window_ok(pos[:, None] - pos[None, :], window, sliding)
+    scores = jnp.where(allowed[None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
 
@@ -88,6 +114,9 @@ def decode_attention(
     impl: str = "auto",      # auto | pallas | xla
     k_scale: jnp.ndarray | None = None,  # (B, KH, 1, C) int8-cache dequant scales
     v_scale: jnp.ndarray | None = None,
+    softcap: float = 0.0,                # Gemma2 score softcapping
+    window: int = 0,                     # sliding-window size (0 = global)
+    sliding: jnp.ndarray | None = None,  # traced per-layer bool for `window`
 ) -> jnp.ndarray:
     """One decode step against the cache, masking invalid (future) slots.
 
@@ -105,13 +134,16 @@ def decode_attention(
     eval runner does this automatically (evals/runner.py JaxGenerator).
     """
     quantized = k_scale is not None
-    if quantized and impl == "pallas":
+    gemma_masking = bool(softcap) or bool(window)
+    if impl == "pallas" and (quantized or gemma_masking):
         raise ValueError(
-            "flash_decode has no int8-cache variant yet: use impl='auto'/'xla' "
-            "with a quantized cache"
+            "flash_decode supports neither int8 caches nor softcap/sliding-"
+            "window yet: use impl='auto'/'xla' for those configs"
         )
-    if not quantized and (
-        impl == "pallas" or (impl == "auto" and _decode_pallas_eligible(k_cache))
+    if (
+        not quantized
+        and not gemma_masking
+        and (impl == "pallas" or (impl == "auto" and _decode_pallas_eligible(k_cache)))
     ):
         from prime_tpu.ops.pallas_attention import flash_decode
 
@@ -134,9 +166,15 @@ def decode_attention(
             jnp.einsum("bkgd,bkdc->bkgc", qg, k_cache, preferred_element_type=jnp.float32)
             * sm_scale
         )
+    scores = _apply_softcap(scores, softcap)
     capacity = k_cache.shape[3]
     slot_ids = jnp.arange(capacity)[None, None, None, :]
-    valid = slot_ids < cache_lengths[:, None, None, None]
+    lengths_b = cache_lengths[:, None, None, None]
+    valid = slot_ids < lengths_b
+    if window:
+        # the query sits at position lengths-1; distance to slot s is
+        # (lengths-1) - s
+        valid = valid & _window_ok(lengths_b - 1 - slot_ids, window, sliding)
     scores = jnp.where(valid, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     if quantized:
@@ -156,6 +194,9 @@ def cache_prefill_attention(
     v_cache: jnp.ndarray,    # (B, KH, D, C)
     offset: jnp.ndarray,     # () first cache slot of this chunk (traced)
     sm_scale: float,
+    softcap: float = 0.0,
+    window: int = 0,
+    sliding: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Attention for chunked prefill: the chunk's K/V are first *written* into
     the cache at ``offset``, then each chunk query attends over the whole
@@ -176,10 +217,13 @@ def cache_prefill_attention(
         jnp.einsum("bkgsd,bkdc->bkgsc", qg, k_cache, preferred_element_type=jnp.float32)
         * sm_scale
     )
+    scores = _apply_softcap(scores, softcap)
     capacity = k_cache.shape[3]
     slot_ids = jnp.arange(capacity)[None, :]                  # (1, C)
-    q_limit = offset + jnp.arange(seq)[:, None] + 1           # (S, 1)
-    visible = slot_ids < q_limit                              # (S, C)
+    q_pos = offset + jnp.arange(seq)[:, None]                 # (S, 1)
+    visible = slot_ids < q_pos + 1                            # (S, C)
+    if window:
+        visible = visible & _window_ok(q_pos - slot_ids, window, sliding)
     scores = jnp.where(visible[None, None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgsc,bkdc->bkgsd", probs.astype(q.dtype), v_cache)
@@ -192,13 +236,26 @@ def multi_head_attention(
     v: jnp.ndarray,
     sm_scale: float | None = None,
     impl: str = "auto",  # auto | pallas | xla
+    softcap: float = 0.0,
+    window: int = 0,
+    sliding: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """Causal self-attention (prefill path)."""
+    """Causal self-attention (prefill path). Softcap / sliding-window configs
+    (Gemma2) always take the XLA path — the flash kernel has no variant for
+    them yet."""
     head_dim = q.shape[-1]
     if sm_scale is None:
         sm_scale = head_dim**-0.5
-    if impl == "pallas" or (impl == "auto" and _pallas_eligible(q, head_dim)):
+    gemma_masking = bool(softcap) or bool(window)
+    if impl == "pallas" and gemma_masking:
+        raise ValueError(
+            "flash_attention has no softcap/sliding-window variant yet: "
+            "use impl='auto'/'xla' for those configs"
+        )
+    if not gemma_masking and (
+        impl == "pallas" or (impl == "auto" and _pallas_eligible(q, head_dim))
+    ):
         from prime_tpu.ops.pallas_attention import flash_attention_causal
 
         return flash_attention_causal(q, k, v, sm_scale=sm_scale)
-    return xla_attention_causal(q, k, v, sm_scale)
+    return xla_attention_causal(q, k, v, sm_scale, softcap, window, sliding)

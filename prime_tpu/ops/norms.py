@@ -10,9 +10,16 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+def rms_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5, plus_one: bool = False
+) -> jnp.ndarray:
+    """``plus_one`` scales by (1 + weight) — the Gemma convention, whose norm
+    weights are zero-initialized deltas around an implicit unit scale."""
     dtype = x.dtype
     x32 = x.astype(jnp.float32)
     variance = jnp.mean(x32 * x32, axis=-1, keepdims=True)
     normed = x32 * jnp.reciprocal(jnp.sqrt(variance + eps))
-    return (normed * weight.astype(jnp.float32)).astype(dtype)
+    w32 = weight.astype(jnp.float32)
+    if plus_one:
+        w32 = w32 + 1.0
+    return (normed * w32).astype(dtype)

@@ -33,6 +33,13 @@ def pipeline_param_specs(config: ModelConfig) -> dict:
     """Like sharding.param_specs but stages the layer stack over pp."""
     if config.is_moe:
         raise NotImplementedError("pipeline parallelism currently covers dense configs")
+    if config.sliding_window:
+        # per-layer sliding flags are indexed globally; the staged scan only
+        # sees local layer indices, so alternating-window configs need the
+        # stage offset threaded through before they can pipeline
+        raise NotImplementedError(
+            "pipeline parallelism does not cover sliding-window configs yet"
+        )
     layer_spec = {
         "attn_norm": P("pp", None),
         "wq": P("pp", None, None),
@@ -50,6 +57,8 @@ def pipeline_param_specs(config: ModelConfig) -> dict:
         layer_spec |= {"bo": P("pp", None)}
     if config.qk_norm:
         layer_spec |= {"q_norm": P("pp", None), "k_norm": P("pp", None)}
+    if config.post_norms:
+        layer_spec |= {"attn_post_norm": P("pp", None), "mlp_post_norm": P("pp", None)}
     specs = {
         "embed": P(None, None),
         "layers": layer_spec,
@@ -92,6 +101,8 @@ def pipeline_forward(
     micro = batch // n_microbatches
 
     x = params["embed"][tokens]                       # (B, S, D) replicated
+    if config.scale_embed:
+        x = x * jnp.asarray(config.d_model**0.5, dtype=x.dtype)
     x_mb = x.reshape(n_microbatches, micro, seq, x.shape[-1])
     positions = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None, :], (micro, seq))
     rope_tables = rope_frequencies(config.head_dim, max(seq, config.max_seq_len), config.rope_theta)
@@ -136,9 +147,13 @@ def pipeline_forward(
 
     hidden = run_pipeline(params["layers"], x_mb)      # (M, mb, S, D)
     hidden = hidden.reshape(batch, seq, -1)
-    hidden = rms_norm(hidden, params["final_norm"], config.rms_eps)
+    hidden = rms_norm(
+        hidden, params["final_norm"], config.rms_eps, plus_one=config.norm_plus_one
+    )
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
-    return (hidden @ head).astype(jnp.float32)
+    from prime_tpu.ops.attention import _apply_softcap
+
+    return _apply_softcap((hidden @ head).astype(jnp.float32), config.final_softcap)
 
 
 def make_pipeline_train_step(

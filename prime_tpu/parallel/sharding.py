@@ -49,6 +49,11 @@ def param_specs(config: ModelConfig) -> dict[str, Any]:
     if config.qk_norm:
         # (L, head_dim) weights shared across heads: replicate
         attn_bias_specs |= {"q_norm": P(None, None), "k_norm": P(None, None)}
+    if config.post_norms:
+        attn_bias_specs |= {
+            "attn_post_norm": P(None, None),
+            "mlp_post_norm": P(None, None),
+        }
     specs: dict[str, Any] = {
         "embed": P("tp", "fsdp"),              # (V, D) vocab on tp, d_model on fsdp
         "layers": {

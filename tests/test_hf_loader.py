@@ -306,3 +306,100 @@ def test_llama_attention_bias_includes_o_proj_bias():
         hf_logits = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
     our_logits, _ = forward(params, jnp.asarray(tokens), config)
     np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+# -- Gemma 2 family ----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gemma2_model():
+    cfg = transformers.Gemma2Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,   # two sliding + two global layers
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=32,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        query_pre_attn_scalar=24,    # decoupled from head_dim like gemma2-9b
+        attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0,
+        sliding_window=4,            # tiny: the window genuinely bites at seq 8
+        attn_implementation="eager",
+    )
+    torch.manual_seed(7)
+    model = transformers.Gemma2ForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_gemma2_logits_match_transformers(gemma2_model):
+    state = {k: v.float().numpy() for k, v in gemma2_model.state_dict().items()}
+    config = config_from_hf(gemma2_model.config, name="tiny-gemma2")
+    assert config.post_norms and config.norm_plus_one and config.scale_embed
+    assert config.attn_softcap == 50.0 and config.final_softcap == 30.0
+    assert config.sliding_window == 4 and config.query_scale == 24
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+    assert "attn_post_norm" in params["layers"]
+
+    # seq 8 > window 4: sliding layers and global layers genuinely differ
+    tokens = np.array([[3, 17, 200, 45, 9, 88, 121, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = gemma2_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    our_logits, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=5e-4, atol=5e-4)
+
+
+def test_gemma2_decode_matches_transformers_generation(gemma2_model):
+    """Greedy decode past the sliding window: cache masking must match HF."""
+    import jax
+
+    from prime_tpu.models.sampler import generate
+
+    state = {k: v.float().numpy() for k, v in gemma2_model.state_dict().items()}
+    config = config_from_hf(gemma2_model.config, name="tiny-gemma2")
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+
+    prompt = np.array([[5, 42, 100, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_out = gemma2_model.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=8,    # decode positions 4..11 cross window 4
+            do_sample=False,
+            eos_token_id=None,
+            pad_token_id=0,
+        ).numpy()[0, 4:]
+    result = generate(
+        params, jnp.asarray(prompt), jnp.array([4]), config,
+        jax.random.PRNGKey(0), max_new_tokens=8, temperature=0.0,
+    )
+    np.testing.assert_array_equal(np.asarray(result.tokens[0]), hf_out)
+
+
+def test_gemma2_checkpoint_dir_roundtrip(tmp_path):
+    """load_hf_checkpoint on a saved Gemma2 dir: config.json omits
+    tie_word_embeddings (True is Gemma's default) — the loader must not go
+    looking for an lm_head that tied checkpoints don't have."""
+    from prime_tpu.models.hf_loader import load_hf_checkpoint
+
+    cfg = transformers.Gemma2Config(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        num_key_value_heads=1,
+        head_dim=16,
+        query_pre_attn_scalar=16,
+        sliding_window=8,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(9)
+    transformers.Gemma2ForCausalLM(cfg).save_pretrained(tmp_path / "ckpt")
+    params, config = load_hf_checkpoint(tmp_path / "ckpt", dtype=jnp.float32)
+    assert config.tie_embeddings and "lm_head" not in params
+    assert config.post_norms and config.sliding_window == 8

@@ -257,3 +257,37 @@ def test_sharded_generate_qwen_style_bias_and_decoupled_head_dim():
             cache_spec=cache_spec(),
         )
     np.testing.assert_array_equal(np.asarray(out.tokens), np.asarray(ref.tokens))
+
+
+def test_sharded_generate_gemma_style_matches_single_device():
+    """Softcap + sliding-window + post-norms must survive sharding: the
+    Gemma2 masking paths are pure XLA and partition like the plain model."""
+    from jax.sharding import NamedSharding
+
+    from prime_tpu.models.sampler import generate as sample_generate
+    from prime_tpu.parallel.sharding import batch_spec, cache_spec, lengths_spec
+
+    cfg = CFG.scaled(
+        name="tiny-gemma", act="gelu_tanh", norm_plus_one=True, post_norms=True,
+        scale_embed=True, attn_softcap=50.0, final_softcap=30.0,
+        query_scale=24, sliding_window=4,
+    )
+    mesh = make_mesh({"dp": 1, "fsdp": 4, "tp": 2})
+    params = init_params(jax.random.PRNGKey(6), cfg, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (4, 10), 0, cfg.vocab_size)
+    lengths = jnp.asarray([10, 6, 8, 10], dtype=jnp.int32)
+
+    ref = sample_generate(
+        params, tokens, lengths, cfg, jax.random.PRNGKey(8),
+        max_new_tokens=6, temperature=0.0, eos_id=-1, pad_id=0,
+    )
+    sharded_params = shard_params(params, mesh, cfg)
+    tokens_s = jax.device_put(tokens, NamedSharding(mesh, batch_spec()))
+    lengths_s = jax.device_put(lengths, NamedSharding(mesh, lengths_spec()))
+    with jax.set_mesh(mesh):
+        out = sample_generate(
+            sharded_params, tokens_s, lengths_s, cfg, jax.random.PRNGKey(8),
+            max_new_tokens=6, temperature=0.0, eos_id=-1, pad_id=0,
+            cache_spec=cache_spec(),
+        )
+    np.testing.assert_array_equal(np.asarray(out.tokens), np.asarray(ref.tokens))

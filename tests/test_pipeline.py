@@ -100,3 +100,24 @@ def test_pipeline_validates_divisibility(params):
     bad_cfg = CFG.scaled(n_layers=3)
     with pytest.raises(ValueError, match="divide into"):
         pipeline_forward(params, jnp.zeros((4, 8), jnp.int32), bad_cfg, mesh, 2)
+
+
+def test_pipeline_forward_matches_dense_gemma_style():
+    """Gemma knobs (GeGLU, (1+w) norms, post-norms, scaled embed, softcaps)
+    must produce identical logits through the pipeline schedule. Sliding
+    window stays rejected (per-layer flags are globally indexed)."""
+    cfg = CFG.scaled(
+        name="tiny-gemma-pp", act="gelu_tanh", norm_plus_one=True, post_norms=True,
+        scale_embed=True, attn_softcap=50.0, final_softcap=30.0, query_scale=24,
+    )
+    gparams = init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (4, 16), 0, cfg.vocab_size)
+    ref, _ = forward(gparams, tokens, cfg, attn_impl="xla")
+    mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    staged = shard_pipeline_params(gparams, mesh, cfg)
+    out = pipeline_forward(staged, tokens, cfg, mesh, n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+    sliding_cfg = cfg.scaled(sliding_window=4)
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        shard_pipeline_params(gparams, make_mesh({"pp": 2}, devices=jax.devices()[:2]), sliding_cfg)
