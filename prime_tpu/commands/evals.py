@@ -54,6 +54,9 @@ POLL_INTERVAL_S = 3.0
 @click.option("--tp", "tensor_parallel", type=int, default=None, help="Tensor-parallel axis for --slice.")
 @click.option("--kv-quant", is_flag=True, help="int8 KV cache (halved decode HBM traffic).")
 @click.option("--weight-quant", is_flag=True, help="int8 weights (W8A16) for serving-side evals.")
+@click.option("--speculative", is_flag=True,
+              help="Prompt-lookup speculative decoding (greedy runs only; exact).")
+@click.option("--draft-len", type=int, default=4, help="Draft tokens per verify pass.")
 @output_options
 def run_eval_cmd(
     render: Renderer,
@@ -74,6 +77,8 @@ def run_eval_cmd(
     tensor_parallel: int | None,
     kv_quant: bool,
     weight_quant: bool,
+    speculative: bool,
+    draft_len: int,
 ) -> None:
     """Run ENV against a model (local TPU by default, --hosted for platform)."""
     from prime_tpu.evals.runner import EvalRunSpec, push_eval_results, run_eval
@@ -90,6 +95,8 @@ def run_eval_cmd(
         ]
         if kv_quant:
             ignored.append("--kv-quant")
+        if speculative:
+            ignored.append("--speculative")
         if weight_quant:
             ignored.append("--weight-quant")
         if not do_push:
@@ -143,6 +150,14 @@ def run_eval_cmd(
         if "temperature" in loaded.defaults and flag_is_default("temperature"):
             temperature = float(loaded.defaults["temperature"])
 
+    # after env defaults: an env-declared sampling temperature must not let
+    # --speculative silently fall back to plain decoding
+    if speculative and temperature != 0.0:
+        raise click.ClickException(
+            "--speculative is exact only for greedy decoding (temperature 0); "
+            f"this run resolved temperature={temperature}"
+        )
+
     spec = EvalRunSpec(
         env=run_env_name,
         model=model,
@@ -158,6 +173,8 @@ def run_eval_cmd(
         tensor_parallel=tensor_parallel,
         kv_quant=kv_quant,
         weight_quant=weight_quant,
+        speculative=speculative,
+        draft_len=draft_len,
     )
 
     def progress(done: int, total: int) -> None:

@@ -51,6 +51,8 @@ class EvalRunSpec:
     tensor_parallel: int | None = None   # override tp axis (default: mesh_for_slice policy)
     kv_quant: bool = False               # int8 KV cache (halved decode HBM traffic)
     weight_quant: bool = False           # int8 weights (W8A16)
+    speculative: bool = False            # prompt-lookup speculation (greedy only)
+    draft_len: int = 4                   # draft tokens per verify pass
     metadata: dict = field(default_factory=dict)
 
 
@@ -87,6 +89,8 @@ class JaxGenerator:
         tensor_parallel: int | None = None,
         kv_quant: bool = False,
         weight_quant: bool = False,
+        speculative: bool = False,
+        draft_len: int = 4,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -94,6 +98,12 @@ class JaxGenerator:
         from prime_tpu.models import get_config
         from prime_tpu.models.llama import init_params
 
+        # boolean-only validation before any checkpoint IO
+        if speculative and kv_quant:
+            raise ValueError(
+                "speculative decoding has no int8-cache verify path yet — "
+                "pick one of --speculative / --kv-quant"
+            )
         dtype = dtype or jnp.bfloat16
         if checkpoint is None and Path(model).is_dir():
             checkpoint = model  # `-m ./my-checkpoint` means "load this"
@@ -134,7 +144,7 @@ class JaxGenerator:
                 n_experts=self.config.n_experts or None,
             )
         self.mesh = mesh
-        # pure-argument validation first: neither failure below should cost a
+        # pure-argument validation first: no failure below should cost a
         # multi-GB checkpoint placement before surfacing
         if weight_quant and mesh is not None and mesh.size > 1:
             raise ValueError(
@@ -158,6 +168,8 @@ class JaxGenerator:
 
             self.params = quantize_params_int8(self.params)
         self.kv_quant = kv_quant
+        self.speculative = speculative
+        self.draft_len = draft_len
         self._rng = jax.random.PRNGKey(0)
 
     def generate(
@@ -212,21 +224,39 @@ class JaxGenerator:
 
         ctx = jax.set_mesh(self.mesh) if self.mesh is not None else contextlib.nullcontext()
         with ctx:
-            result = sample_generate(
-                self.params,
-                batch,
-                lengths,
-                self.config,
-                rng,
-                max_new_tokens=max_new_tokens,
-                temperature=temperature,
-                top_p=top_p,
-                nucleus=top_p < 1.0,
-                eos_id=self.tokenizer.eos_id,
-                pad_id=pad_id,
-                kv_quant=self.kv_quant,
-                **kw,
-            )
+            if self.speculative and temperature == 0.0:
+                from prime_tpu.models.speculative import spec_generate
+
+                result = spec_generate(
+                    self.params,
+                    batch,
+                    lengths,
+                    self.config,
+                    max_new_tokens=max_new_tokens,
+                    draft_len=self.draft_len,
+                    eos_id=self.tokenizer.eos_id,
+                    pad_id=pad_id,
+                    attn_impl=kw.get("attn_impl", "auto"),
+                    cache_spec=kw.get("cache_spec"),
+                )
+            else:
+                # speculation is exact only in argmax space — sampled
+                # generation falls back to the plain path
+                result = sample_generate(
+                    self.params,
+                    batch,
+                    lengths,
+                    self.config,
+                    rng,
+                    max_new_tokens=max_new_tokens,
+                    temperature=temperature,
+                    top_p=top_p,
+                    nucleus=top_p < 1.0,
+                    eos_id=self.tokenizer.eos_id,
+                    pad_id=pad_id,
+                    kv_quant=self.kv_quant,
+                    **kw,
+                )
         tokens = jax.device_get(result.tokens).tolist()[:n_real]
         lens = jax.device_get(result.lengths).tolist()[:n_real]
         return [self.tokenizer.decode(t[:n]) for t, n in zip(tokens, lens)]
@@ -259,6 +289,8 @@ def run_eval(
             tensor_parallel=spec.tensor_parallel,
             kv_quant=spec.kv_quant,
             weight_quant=spec.weight_quant,
+            speculative=spec.speculative,
+            draft_len=spec.draft_len,
         )
 
     samples: list[EvalSample] = []

@@ -248,9 +248,19 @@ def _attention_block(
         off = prefill_offset.astype(jnp.int32)
         k_t = k.transpose(0, 1, 3, 2)  # (B, KH, hd, S)
         v_t = v.transpose(0, 1, 3, 2)
-        zero = jnp.zeros((), dtype=jnp.int32)
-        new_k_cache = jax.lax.dynamic_update_slice(k_cache, k_t, (zero, zero, zero, off))
-        new_v_cache = jax.lax.dynamic_update_slice(v_cache, v_t, (zero, zero, zero, off))
+        if off.ndim == 0:  # one shared chunk offset
+            zero = jnp.zeros((), dtype=jnp.int32)
+            new_k_cache = jax.lax.dynamic_update_slice(k_cache, k_t, (zero, zero, zero, off))
+            new_v_cache = jax.lax.dynamic_update_slice(v_cache, v_t, (zero, zero, zero, off))
+        else:  # (B,): per-row window starts (speculative verify)
+            def put_rows(cache, block):
+                def one(c, n, idx):
+                    return jax.lax.dynamic_update_slice(c, n, (0, 0, idx))
+
+                return jax.vmap(one)(cache, block, off)
+
+            new_k_cache = put_rows(k_cache, k_t)
+            new_v_cache = put_rows(v_cache, v_t)
         attn = cache_prefill_attention(q, new_k_cache, new_v_cache, off, sm_scale, **gemma_kw)
     else:
         attn = multi_head_attention(q, k, v, sm_scale, impl=attn_impl, **gemma_kw)
@@ -337,7 +347,8 @@ def forward(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None, :], (batch, seq))
         if prefill_offset is not None:
-            positions = positions + prefill_offset.astype(jnp.int32)
+            off = prefill_offset.astype(jnp.int32)
+            positions = positions + (off[:, None] if off.ndim else off)
     max_pos = cache.capacity if cache is not None else max(seq, config.max_seq_len)
     rope_tables = rope_frequencies(config.head_dim, max_pos, config.rope_theta)
 

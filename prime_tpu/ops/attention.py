@@ -192,7 +192,7 @@ def cache_prefill_attention(
     q: jnp.ndarray,          # (B, H, S, D) queries for a prompt CHUNK
     k_cache: jnp.ndarray,    # (B, KH, D, C) feature-major, chunk already written
     v_cache: jnp.ndarray,    # (B, KH, D, C)
-    offset: jnp.ndarray,     # () first cache slot of this chunk (traced)
+    offset: jnp.ndarray,     # () or (B,) first cache slot of this chunk (traced)
     sm_scale: float,
     softcap: float = 0.0,
     window: int = 0,
@@ -219,12 +219,15 @@ def cache_prefill_attention(
     )
     scores = _apply_softcap(scores, softcap)
     capacity = k_cache.shape[3]
-    slot_ids = jnp.arange(capacity)[None, :]                  # (1, C)
-    q_pos = offset + jnp.arange(seq)[:, None]                 # (S, 1)
-    visible = slot_ids < q_pos + 1                            # (S, C)
+    # offset () = one shared chunk start; (B,) = per-sequence starts (the
+    # speculative verify window sits at each row's own cache length)
+    offset_b = jnp.reshape(offset.astype(jnp.int32), (-1,))
+    slot_ids = jnp.arange(capacity)[None, None, :]            # (1, 1, C)
+    q_pos = offset_b[:, None, None] + jnp.arange(seq)[None, :, None]  # (B|1, S, 1)
+    visible = slot_ids < q_pos + 1                            # (B|1, S, C)
     if window:
         visible = visible & _window_ok(q_pos - slot_ids, window, sliding)
-    scores = jnp.where(visible[None, None, None], scores, NEG_INF)
+    scores = jnp.where(visible[:, None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgsc,bkdc->bkgsd", probs.astype(q.dtype), v_cache)
     return out.reshape(batch, num_heads, seq, head_dim)
