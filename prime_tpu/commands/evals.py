@@ -56,7 +56,10 @@ POLL_INTERVAL_S = 3.0
 @click.option("--weight-quant", is_flag=True, help="int8 weights (W8A16) for serving-side evals.")
 @click.option("--speculative", is_flag=True,
               help="Prompt-lookup speculative decoding (greedy runs only; exact).")
-@click.option("--draft-len", type=int, default=4, help="Draft tokens per verify pass.")
+@click.option("--draft-len", type=click.IntRange(min=1), default=4,
+              help="Draft tokens per verify pass.")
+@click.option("--adapter", default=None, type=click.Path(exists=True),
+              help="LoRA adapter dir (from train local --lora) to merge into the model.")
 @output_options
 def run_eval_cmd(
     render: Renderer,
@@ -79,6 +82,7 @@ def run_eval_cmd(
     weight_quant: bool,
     speculative: bool,
     draft_len: int,
+    adapter: str | None,
 ) -> None:
     """Run ENV against a model (local TPU by default, --hosted for platform)."""
     from prime_tpu.evals.runner import EvalRunSpec, push_eval_results, run_eval
@@ -97,6 +101,8 @@ def run_eval_cmd(
             ignored.append("--kv-quant")
         if speculative:
             ignored.append("--speculative")
+        if adapter:
+            ignored.append("--adapter")
         if weight_quant:
             ignored.append("--weight-quant")
         if not do_push:
@@ -157,6 +163,11 @@ def run_eval_cmd(
             "--speculative is exact only for greedy decoding (temperature 0); "
             f"this run resolved temperature={temperature}"
         )
+    if speculative and kv_quant:
+        raise click.ClickException(
+            "speculative decoding has no int8-cache verify path yet — "
+            "pick one of --speculative / --kv-quant"
+        )
 
     spec = EvalRunSpec(
         env=run_env_name,
@@ -175,6 +186,7 @@ def run_eval_cmd(
         weight_quant=weight_quant,
         speculative=speculative,
         draft_len=draft_len,
+        adapter=adapter,
     )
 
     def progress(done: int, total: int) -> None:

@@ -124,6 +124,11 @@ def run_cmd(render: Renderer, config_file: str, yes: bool, follow: bool) -> None
 @click.option("--checkpoint-every", type=int, default=0, help="orbax checkpoint cadence (0=off).")
 @click.option("--resume", is_flag=True, help="Resume --name from its latest checkpoint.")
 @click.option("--profile", is_flag=True, help="Capture a jax.profiler trace of steps 2-5.")
+@click.option("--lora", is_flag=True,
+              help="Train LoRA adapters over frozen base weights (saves an adapter artifact).")
+@click.option("--lora-r", type=click.IntRange(min=1), default=16, help="LoRA rank.")
+@click.option("--lora-alpha", type=click.IntRange(min=1), default=32,
+              help="LoRA alpha (scale = alpha/r).")
 @output_options
 def local_cmd(
     render: Renderer,
@@ -141,6 +146,9 @@ def local_cmd(
     checkpoint_every: int,
     resume: bool,
     profile: bool,
+    lora: bool,
+    lora_r: int,
+    lora_alpha: int,
 ) -> None:
     """Train MODEL locally on this slice (native JAX trainer, not hosted).
 
@@ -185,25 +193,61 @@ def local_cmd(
         )
     run_dir.mkdir(parents=True, exist_ok=True)
 
+    if lora and accum > 1:
+        raise click.ClickException("--lora does not support --accum yet")
+    if lora and config.is_moe:
+        raise click.ClickException("--lora currently targets dense configs")
+
     schedule = warmup_cosine(lr, total_steps=steps, warmup_steps=warmup)
     optimizer = default_optimizer(schedule)
     params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.bfloat16)
-    state = init_train_state(params, optimizer)
 
     mesh = None
     if slice_name is not None:
         from prime_tpu.parallel.mesh import mesh_for_slice
-        from prime_tpu.train import shard_train_state
 
         mesh = mesh_for_slice(
             slice_name,
             expert_parallel="auto" if config.is_moe else None,
             n_experts=config.n_experts or None,
         )
-        state = shard_train_state(state, mesh, config)
         render.message(f"mesh: {dict(mesh.shape)}")
 
-    step_fn = make_train_step(config, optimizer, accum_steps=accum)
+    lora_cfg = None
+    if lora:
+        from prime_tpu.train.lora import (
+            LoraConfig,
+            init_lora_params,
+            init_lora_state,
+            make_lora_train_step,
+            shard_lora_state,
+        )
+
+        lora_cfg = LoraConfig(r=lora_r, alpha=lora_alpha)
+        adapters = init_lora_params(jax.random.PRNGKey(1), config, lora_cfg)
+        state = init_lora_state(adapters, optimizer)
+        if mesh is not None:
+            from prime_tpu.parallel.sharding import shard_params
+
+            params = shard_params(params, mesh, config)
+            state = shard_lora_state(state, mesh, config, lora_cfg)
+        lora_step = make_lora_train_step(config, lora_cfg, optimizer)
+
+        def step_fn(s, tokens, targets, mask):
+            return lora_step(s, params, tokens, targets, mask)
+
+        render.message(
+            f"LoRA r={lora_r} alpha={lora_alpha}: "
+            f"{sum(x.size for x in jax.tree.leaves(adapters)):,} trainable params "
+            f"(base {config.param_count:,} frozen)"
+        )
+    else:
+        state = init_train_state(params, optimizer)
+        if mesh is not None:
+            from prime_tpu.train import shard_train_state
+
+            state = shard_train_state(state, mesh, config)
+        step_fn = make_train_step(config, optimizer, accum_steps=accum)
 
     if data_path:
         batches = text_batches(data_path, batch_size, seq_len, steps)
@@ -253,6 +297,15 @@ def local_cmd(
     if checkpoints is not None:
         checkpoints.close()
     payload = {"runDir": str(run_dir), **report.as_dict()}
+    if lora_cfg is not None:
+        from prime_tpu.train.lora import save_adapters
+
+        adapter_dir = save_adapters(
+            run_dir / "adapters", jax.device_get(state.params), lora_cfg, config,
+            base_params=params,
+        )
+        payload["adapterDir"] = str(adapter_dir)
+        render.message(f"adapters -> {adapter_dir} (eval run --adapter {adapter_dir})")
     if render.is_json:
         render.json(payload)
     else:

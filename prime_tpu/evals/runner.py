@@ -53,6 +53,7 @@ class EvalRunSpec:
     weight_quant: bool = False           # int8 weights (W8A16)
     speculative: bool = False            # prompt-lookup speculation (greedy only)
     draft_len: int = 4                   # draft tokens per verify pass
+    adapter: str | None = None           # LoRA adapter artifact dir to merge
     metadata: dict = field(default_factory=dict)
 
 
@@ -91,6 +92,7 @@ class JaxGenerator:
         weight_quant: bool = False,
         speculative: bool = False,
         draft_len: int = 4,
+        adapter: str | None = None,   # LoRA adapter artifact dir to merge
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -126,6 +128,33 @@ class JaxGenerator:
                 f"Tokenizer vocab ({tok_vocab}) exceeds model vocab "
                 f"({self.config.vocab_size}) — ids would index out of bounds"
             )
+
+        if adapter is not None:
+            from prime_tpu.train.lora import (
+                base_fingerprint,
+                fingerprints_match,
+                load_adapters,
+                merge_lora,
+            )
+
+            adapters, lora_cfg, meta = load_adapters(adapter)
+            if meta["base_model"] != self.config.name:
+                raise ValueError(
+                    f"Adapter {adapter!r} was trained on {meta['base_model']!r} but "
+                    f"this model is {self.config.name!r} — merging would corrupt weights"
+                )
+            recorded = meta.get("base_fingerprint")
+            if recorded is not None and not fingerprints_match(
+                recorded, base_fingerprint(self.params)
+            ):
+                raise ValueError(
+                    f"Adapter {adapter!r} was trained over DIFFERENT base weights "
+                    f"than this model (same config name {self.config.name!r}, "
+                    "different weight fingerprint — e.g. adapters from a "
+                    "random-init training base merged into a real checkpoint). "
+                    "Re-train the adapters against this checkpoint."
+                )
+            self.params = merge_lora(self.params, adapters, lora_cfg)
 
         if self.config.is_moe:
             # inference must not drop tokens: capacity_factor = E/k guarantees
@@ -291,6 +320,7 @@ def run_eval(
             weight_quant=spec.weight_quant,
             speculative=spec.speculative,
             draft_len=spec.draft_len,
+            adapter=spec.adapter,
         )
 
     samples: list[EvalSample] = []

@@ -65,6 +65,59 @@ def _sample(
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def run_prefill(
+    params,
+    prompt_tokens: jnp.ndarray,    # (B, S) right-padded with pad_id
+    prompt_lengths: jnp.ndarray,   # (B,)
+    config: ModelConfig,
+    capacity: int,
+    attn_impl: str = "auto",
+    cache_spec=None,
+    kv_quant: bool = False,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Shared prefill: init the cache (optionally layout-pinned), run the
+    prompt, fix the per-sequence lengths, and return each row's next-token
+    logits. One owner for this block keeps ``generate`` and the speculative
+    decoder (models/speculative.py) byte-identical up to the first token."""
+    batch = prompt_tokens.shape[0]
+    cache = init_cache(
+        config, batch, capacity, dtype=params["embed"].dtype, quantized=kv_quant
+    )
+    if cache_spec is not None:
+        # pin the cache layout before it enters the scan carry — XLA would
+        # otherwise be free to replicate the zeros init across the mesh
+        cache = cache._replace(
+            k=jax.lax.with_sharding_constraint(cache.k, cache_spec),
+            v=jax.lax.with_sharding_constraint(cache.v, cache_spec),
+        )
+        if cache.quantized:
+            cache = cache._replace(
+                k_scale=jax.lax.with_sharding_constraint(cache.k_scale, cache_spec),
+                v_scale=jax.lax.with_sharding_constraint(cache.v_scale, cache_spec),
+            )
+    logits, cache = forward(
+        params, prompt_tokens, config, cache=cache, decode=False, attn_impl=attn_impl
+    )
+    # cache was filled for the padded length; true lengths are per-sequence
+    cache = cache._replace(lengths=prompt_lengths.astype(jnp.int32))
+    # next-token logits live at each sequence's last real position
+    last = jnp.take_along_axis(logits, (prompt_lengths - 1)[:, None, None], axis=1)[:, 0, :]
+    return last, cache
+
+
+def finalize_tokens(
+    generated: jnp.ndarray, eos_id: int, pad_id: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The output contract both decoders share: everything after the first
+    EOS becomes pad (the EOS itself stays in the buffer), and lengths count
+    the tokens strictly before it."""
+    max_new = generated.shape[1]
+    position = jnp.arange(max_new)[None, :]
+    first_eos = jnp.min(jnp.where(generated == eos_id, position, max_new), axis=1)
+    cleaned = jnp.where(position <= first_eos[:, None], generated, pad_id)
+    return cleaned, first_eos
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -89,31 +142,11 @@ def generate(
     kv_quant: bool = False,        # int8 KV cache (halved decode HBM traffic)
 ) -> GenerationResult:
     batch, prompt_len = prompt_tokens.shape
-    capacity = prompt_len + max_new_tokens
-    cache = init_cache(
-        config, batch, capacity, dtype=params["embed"].dtype, quantized=kv_quant
+    last, cache = run_prefill(
+        params, prompt_tokens, prompt_lengths, config,
+        capacity=prompt_len + max_new_tokens,
+        attn_impl=attn_impl, cache_spec=cache_spec, kv_quant=kv_quant,
     )
-    if cache_spec is not None:
-        # pin the cache layout before it enters the scan carry — XLA would
-        # otherwise be free to replicate the zeros init across the mesh
-        cache = cache._replace(
-            k=jax.lax.with_sharding_constraint(cache.k, cache_spec),
-            v=jax.lax.with_sharding_constraint(cache.v, cache_spec),
-        )
-        if cache.quantized:
-            cache = cache._replace(
-                k_scale=jax.lax.with_sharding_constraint(cache.k_scale, cache_spec),
-                v_scale=jax.lax.with_sharding_constraint(cache.v_scale, cache_spec),
-            )
-
-    # ---- prefill ----
-    logits, cache = forward(
-        params, prompt_tokens, config, cache=cache, decode=False, attn_impl=attn_impl
-    )
-    # cache was filled for the padded length; true lengths are per-sequence
-    cache = cache._replace(lengths=prompt_lengths.astype(jnp.int32))
-    # next-token logits live at each sequence's last real position
-    last = jnp.take_along_axis(logits, (prompt_lengths - 1)[:, None, None], axis=1)[:, 0, :]
 
     rng, step_rng = jax.random.split(rng)
     first_tokens = _sample(last, temperature, step_rng, top_p, nucleus)
@@ -162,6 +195,5 @@ def generate(
 
     # length = tokens strictly before the first EOS (a sampled token that
     # happens to equal pad_id is still a real token and counts)
-    seen_eos = jnp.cumsum(all_tokens == eos_id, axis=1) > 0
-    gen_lengths = jnp.sum(~seen_eos, axis=1)
-    return GenerationResult(tokens=all_tokens, lengths=gen_lengths, logprobs=all_logprobs)
+    cleaned, gen_lengths = finalize_tokens(all_tokens, eos_id, pad_id)
+    return GenerationResult(tokens=cleaned, lengths=gen_lengths, logprobs=all_logprobs)

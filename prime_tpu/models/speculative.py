@@ -42,8 +42,8 @@ import jax
 import jax.numpy as jnp
 
 from prime_tpu.models.config import ModelConfig
-from prime_tpu.models.llama import KVCache, forward, init_cache
-from prime_tpu.models.sampler import GenerationResult
+from prime_tpu.models.llama import KVCache, forward
+from prime_tpu.models.sampler import GenerationResult, finalize_tokens, run_prefill
 
 
 def propose_ngram_drafts(
@@ -111,23 +111,14 @@ def spec_generate(
     the verify pass works in argmax space)."""
     batch, prompt_len = prompt_tokens.shape
     # history is padded so a (draft_len+1) scatter window starting at any
-    # valid row length stays in-bounds (no silent dynamic_slice clamping)
+    # valid row length stays in-bounds (no silent dynamic_slice clamping);
+    # the cache matches because verify windows scribble up to draft_len+1
+    # slots past a row's valid length
     total = prompt_len + max_new_tokens + draft_len + 1
-    # verify windows may scribble up to draft_len+1 slots past a row's length
-    capacity = total
-    cache = init_cache(config, batch, capacity, dtype=params["embed"].dtype)
-    if cache_spec is not None:
-        cache = cache._replace(
-            k=jax.lax.with_sharding_constraint(cache.k, cache_spec),
-            v=jax.lax.with_sharding_constraint(cache.v, cache_spec),
-        )
-
-    # ---- prefill (identical to sampler.generate) ----
-    logits, cache = forward(
-        params, prompt_tokens, config, cache=cache, decode=False, attn_impl=attn_impl
+    last, cache = run_prefill(
+        params, prompt_tokens, prompt_lengths, config, capacity=total,
+        attn_impl=attn_impl, cache_spec=cache_spec,
     )
-    cache = cache._replace(lengths=prompt_lengths.astype(jnp.int32))
-    last = jnp.take_along_axis(logits, (prompt_lengths - 1)[:, None, None], axis=1)[:, 0, :]
     first = jnp.argmax(last, axis=-1).astype(jnp.int32)
     first_done = first == eos_id
 
@@ -215,12 +206,8 @@ def spec_generate(
         return jax.lax.dynamic_slice(row, (s,), (max_new_tokens,))
 
     generated = jax.vmap(row_gen)(final.history, prompt_lengths)
-    # identical post-processing to sampler.generate: pad after the first EOS,
-    # lengths exclude the EOS itself
-    position = jnp.arange(max_new_tokens)[None, :]
-    first_eos = jnp.min(jnp.where(generated == eos_id, position, max_new_tokens), axis=1)
-    cleaned = jnp.where(position <= first_eos[:, None], generated, pad_id)
-    gen_lengths = first_eos  # == max_new_tokens when no EOS fired
+    # the shared output contract: pad after the first EOS, lengths exclude it
+    cleaned, gen_lengths = finalize_tokens(generated, eos_id, pad_id)
     return GenerationResult(
         tokens=cleaned,
         lengths=gen_lengths,

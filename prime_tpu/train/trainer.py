@@ -168,12 +168,17 @@ def apply_gradients(state: TrainState, grads, optimizer) -> tuple[TrainState, jn
     )
 
 
-def shard_train_state(state: TrainState, mesh, config: ModelConfig) -> TrainState:
+def shard_train_state(
+    state: TrainState, mesh, config: ModelConfig, shardings=None
+) -> TrainState:
     """Place a TrainState onto the mesh: params per the megatron/fsdp specs,
-    optimizer moments mirroring their param's sharding, scalars replicated."""
+    optimizer moments mirroring their param's sharding, scalars replicated.
+
+    ``shardings`` overrides the params' NamedSharding tree — LoRA states pass
+    their adapter-factor layouts (train.lora) through the same placement."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    p_shardings = param_shardings(mesh, config)
+    p_shardings = shardings if shardings is not None else param_shardings(mesh, config)
     replicated = NamedSharding(mesh, P())
     params = jax.device_put(state.params, p_shardings)
 

@@ -1,0 +1,183 @@
+"""LoRA adapters: zero-effect init, frozen base, artifacts, sharding, CLI."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from prime_tpu.models import get_config
+from prime_tpu.models.llama import forward, init_params
+from prime_tpu.train.lora import (
+    LoraConfig,
+    init_lora_params,
+    init_lora_state,
+    load_adapters,
+    lora_param_specs,
+    make_lora_train_step,
+    merge_lora,
+    save_adapters,
+    shard_lora_state,
+)
+from prime_tpu.train.trainer import default_optimizer
+
+CFG = get_config("tiny-test")
+
+
+@pytest.fixture()
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="rank"):
+        LoraConfig(r=0)
+    with pytest.raises(ValueError, match="targets"):
+        LoraConfig(targets=("wq", "nope"))
+    assert LoraConfig(r=8, alpha=16).scale == 2.0
+
+
+def test_zero_init_merge_is_identity(params):
+    lora = LoraConfig(r=4)
+    adapters = init_lora_params(jax.random.PRNGKey(1), CFG, lora)
+    merged = merge_lora(params, adapters, lora)
+    tokens = jnp.asarray([[3, 7, 11, 2]], dtype=jnp.int32)
+    ref, _ = forward(params, tokens, CFG)
+    out, _ = forward(merged, tokens, CFG)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6, atol=1e-6)
+
+
+def test_lora_step_trains_adapters_and_freezes_base(params):
+    lora = LoraConfig(r=4, alpha=8)
+    optimizer = default_optimizer(1e-2, weight_decay=0.0)
+    adapters = init_lora_params(jax.random.PRNGKey(1), CFG, lora)
+    state = init_lora_state(adapters, optimizer)
+    step = make_lora_train_step(CFG, lora, optimizer)
+    base_before = jax.tree.map(jnp.copy, params)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, CFG.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    mask = jnp.ones_like(tokens, jnp.float32)
+    losses = []
+    for _ in range(6):
+        state, metrics = step(state, params, tokens, targets, mask)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], f"LoRA loss did not decrease: {losses}"
+    # base weights untouched (only adapters are in the optimizer state)
+    for a, b in zip(jax.tree.leaves(base_before), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # B factors moved off zero
+    assert float(jnp.abs(state.params["layers"]["wq"]["b"]).max()) > 0
+
+
+def test_adapter_artifact_roundtrip(tmp_path, params):
+    lora = LoraConfig(r=4, alpha=8, targets=("wq", "wo"))
+    adapters = init_lora_params(jax.random.PRNGKey(3), CFG, lora)
+    # randomize B so the roundtrip carries real content
+    adapters["layers"]["wq"]["b"] = jax.random.normal(
+        jax.random.PRNGKey(4), adapters["layers"]["wq"]["b"].shape
+    )
+    path = save_adapters(tmp_path / "art", adapters, lora, CFG, base_params=params)
+    loaded, lora2, meta = load_adapters(path)
+    assert meta["base_model"] == CFG.name and lora2 == lora
+    assert len(meta["base_fingerprint"]) == 2
+    for a, b in zip(jax.tree.leaves(adapters), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    meta = json.loads((path / "adapter_config.json").read_text())
+    assert meta["targets"] == ["wq", "wo"]
+
+
+def test_lora_specs_mirror_base_axes():
+    from jax.sharding import PartitionSpec as P
+
+    lora = LoraConfig(targets=("wq", "wo", "w_down"))
+    specs = lora_param_specs(CFG, lora)["layers"]
+    assert specs["wq"] == {"a": P(None, "fsdp", None), "b": P(None, None, "tp")}
+    assert specs["wo"] == {"a": P(None, "tp", None), "b": P(None, None, "fsdp")}
+    assert specs["w_down"] == {"a": P(None, "tp", None), "b": P(None, None, "fsdp")}
+
+
+def test_sharded_lora_step(params):
+    from prime_tpu.parallel.mesh import make_mesh
+    from prime_tpu.parallel.sharding import shard_batch, shard_params
+
+    lora = LoraConfig(r=4)
+    mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    optimizer = default_optimizer(1e-2, weight_decay=0.0)
+    base = shard_params(params, mesh, CFG)
+    adapters = init_lora_params(jax.random.PRNGKey(5), CFG, lora)
+    state = shard_lora_state(init_lora_state(adapters, optimizer), mesh, CFG, lora)
+    step = make_lora_train_step(CFG, lora, optimizer)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (8, 16), 0, CFG.vocab_size)
+    batch = tuple(
+        shard_batch(x, mesh)
+        for x in (tokens, jnp.roll(tokens, -1, 1), jnp.ones_like(tokens, jnp.float32))
+    )
+    state, metrics = step(state, base, *batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_train_local_lora_cli_and_eval_adapter(tmp_path):
+    """train local --lora writes an adapter artifact that eval run --adapter
+    merges (wrong-base adapters are refused)."""
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.main import cli
+
+    runner = CliRunner()
+    result = runner.invoke(
+        cli,
+        ["train", "local", "-m", "tiny-test", "--steps", "4", "-b", "4",
+         "--seq-len", "16", "--lora", "--lora-r", "4", "--lr", "1e-2",
+         "--name", "lora-run", "--output-dir", str(tmp_path), "--output", "json"],
+    )
+    assert result.exit_code == 0, result.output
+    payload = json.loads(result.output)
+    adapter_dir = payload["adapterDir"]
+    assert (tmp_path / "lora-run" / "adapters" / "adapters.npz").exists()
+
+    ev = runner.invoke(
+        cli,
+        ["eval", "run", "arith", "-m", "tiny-test", "--adapter", adapter_dir,
+         "--no-push", "-n", "2", "-b", "2", "--max-new-tokens", "4",
+         "--output-dir", str(tmp_path / "evals"), "--plain"],
+    )
+    assert ev.exit_code == 0, ev.output
+
+    wrong = runner.invoke(
+        cli,
+        ["eval", "run", "arith", "-m", "tiny-moe", "--adapter", adapter_dir,
+         "--no-push", "-n", "2", "--output-dir", str(tmp_path / "evals2"), "--plain"],
+    )
+    assert wrong.exit_code != 0 and "trained on" in wrong.output
+
+
+def test_adapter_fingerprint_rejects_different_base(tmp_path, params):
+    """Same config name, different base weights (the random-init-vs-checkpoint
+    trap): the merge must refuse based on the recorded fingerprint."""
+    import jax.numpy as jnp
+
+    from prime_tpu.evals.runner import JaxGenerator
+    from prime_tpu.train.lora import base_fingerprint, fingerprints_match
+
+    other = init_params(jax.random.PRNGKey(99), CFG, dtype=jnp.float32)
+    assert not fingerprints_match(base_fingerprint(params), base_fingerprint(other))
+
+    lora = LoraConfig(r=4)
+    adapters = init_lora_params(jax.random.PRNGKey(1), CFG, lora)
+    path = save_adapters(tmp_path / "art", adapters, lora, CFG, base_params=other)
+    # JaxGenerator("tiny-test") random-inits with PRNGKey(0) -> mismatch
+    with pytest.raises(ValueError, match="DIFFERENT base weights"):
+        JaxGenerator("tiny-test", adapter=str(path))
+
+
+def test_adapter_fingerprint_tolerates_dtype(params):
+    """bf16 and fp32 loads of the same weights must fingerprint-match."""
+    import jax.numpy as jnp
+
+    from prime_tpu.train.lora import base_fingerprint, fingerprints_match
+
+    bf16 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+    assert fingerprints_match(base_fingerprint(params), base_fingerprint(bf16))
