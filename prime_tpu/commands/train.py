@@ -297,15 +297,7 @@ def local_cmd(
     if checkpoints is not None:
         checkpoints.close()
     payload = {"runDir": str(run_dir), **report.as_dict()}
-    if lora_cfg is not None:
-        from prime_tpu.train.lora import save_adapters
-
-        adapter_dir = save_adapters(
-            run_dir / "adapters", jax.device_get(state.params), lora_cfg, config,
-            base_params=params,
-        )
-        payload["adapterDir"] = str(adapter_dir)
-        render.message(f"adapters -> {adapter_dir} (eval run --adapter {adapter_dir})")
+    _save_adapter_artifact(render, payload, run_dir, state, lora_cfg, config, params)
     if render.is_json:
         render.json(payload)
     else:
@@ -337,6 +329,11 @@ def local_cmd(
 @click.option("--name", "run_name", default=None, help="Run name (default timestamped).")
 @click.option("--output-dir", default="outputs/rl")
 @click.option("--checkpoint-every", type=int, default=0, help="orbax checkpoint cadence (0=off).")
+@click.option("--lora", is_flag=True,
+              help="Train LoRA adapters over the frozen base (the hosted default run type).")
+@click.option("--lora-r", type=click.IntRange(min=1), default=16, help="LoRA rank.")
+@click.option("--lora-alpha", type=click.IntRange(min=1), default=32,
+              help="LoRA alpha (scale = alpha/r).")
 @output_options
 def local_rl_cmd(
     render: Renderer,
@@ -359,6 +356,9 @@ def local_rl_cmd(
     run_name: str | None,
     output_dir: str,
     checkpoint_every: int,
+    lora: bool,
+    lora_r: int,
+    lora_alpha: int,
 ) -> None:
     """GRPO fine-tune MODEL against ENV_REF locally on this slice.
 
@@ -441,6 +441,15 @@ def local_rl_cmd(
         mesh = mesh_for_slice(slice_name)
         render.message(f"mesh: {dict(mesh.shape)}")
 
+    lora_cfg = None
+    if lora:
+        from prime_tpu.train.lora import LoraConfig
+
+        if config.is_moe:
+            raise click.ClickException("--lora currently targets dense configs")
+        lora_cfg = LoraConfig(r=lora_r, alpha=lora_alpha)
+        render.message(f"LoRA r={lora_r} alpha={lora_alpha} (base frozen)")
+
     run_name = run_name or f"{env_name}-{time.strftime('%Y%m%d-%H%M%S')}"
     run_dir = Path(output_dir) / run_name
     if (run_dir / "metrics.jsonl").exists():
@@ -480,6 +489,7 @@ def local_rl_cmd(
             checkpoints=checkpoints,
             checkpoint_every=checkpoint_every,
             on_step=on_step,
+            lora=lora_cfg,
         )
     except ValueError as e:
         raise click.ClickException(str(e)) from None
@@ -487,6 +497,7 @@ def local_rl_cmd(
         if checkpoints is not None:
             checkpoints.close()
     payload = {"runDir": str(run_dir), "env": env_name, **report.as_dict()}
+    _save_adapter_artifact(render, payload, run_dir, state, lora_cfg, config, params)
     if render.is_json:
         render.json(payload)
     else:
@@ -494,6 +505,25 @@ def local_rl_cmd(
             f"done: {report.steps} steps, reward {report.first_reward:.3f} -> "
             f"{report.last_reward:.3f}, final loss {report.final_loss:.4f} -> {run_dir}"
         )
+
+
+def _save_adapter_artifact(
+    render: Renderer, payload: dict, run_dir: Path, state, lora_cfg, config, base_params
+) -> None:
+    """Shared tail of every --lora run (SFT and GRPO): write the adapter
+    artifact next to the run and surface the eval-merge hint."""
+    if lora_cfg is None:
+        return
+    import jax
+
+    from prime_tpu.train.lora import save_adapters
+
+    adapter_dir = save_adapters(
+        run_dir / "adapters", jax.device_get(state.params), lora_cfg, config,
+        base_params=base_params,
+    )
+    payload["adapterDir"] = str(adapter_dir)
+    render.message(f"adapters -> {adapter_dir} (eval run --adapter {adapter_dir})")
 
 
 def _rl_environment(render: Renderer, env_ref: str):

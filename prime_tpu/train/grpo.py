@@ -93,6 +93,7 @@ def make_grpo_step(
     kl_coef: float = 0.0,
     attn_impl: str = "auto",
     on_policy: bool = False,
+    lora=None,  # train.lora.LoraConfig -> the state holds adapters, not params
 ):
     """Jitted GRPO update. Inputs: full packed sequences (B, T), a completion
     mask (1.0 exactly on the tokens the policy sampled, EOS included), one
@@ -104,10 +105,25 @@ def make_grpo_step(
     update and there is no KL reference) skips the snapshot arguments:
     old/ref default to stop_gradient of the current logprobs — the ratio is
     identically 1, clipping is inert, and the caller saves one full
-    teacher-forced forward pass per step. Pass zeros for old_lp/ref_lp."""
+    teacher-forced forward pass per step. Pass zeros for old_lp/ref_lp.
 
-    def loss_fn(params, tokens, mask, advantages, old_lp, ref_lp):
-        lp = _token_logprobs_inline(params, tokens, config, attn_impl)
+    The step signature is ``(state, base_params, tokens, mask, advantages,
+    old_lp, ref_lp)``. ``base_params`` is None for full-parameter GRPO; with
+    ``lora`` set it carries the frozen base (not donated) and the state holds
+    only the adapter factors — the hosted product's default run type, trained
+    on-slice."""
+
+    def policy_of(policy_params, base_params):
+        if lora is None:
+            return policy_params
+        from prime_tpu.train.lora import merge_lora
+
+        return merge_lora(base_params, policy_params, lora)
+
+    def loss_fn(policy_params, base_params, tokens, mask, advantages, old_lp, ref_lp):
+        lp = _token_logprobs_inline(
+            policy_of(policy_params, base_params), tokens, config, attn_impl
+        )
         if on_policy:
             old_lp = ref_lp = jax.lax.stop_gradient(lp)
         ratio = jnp.exp(lp - old_lp)
@@ -123,9 +139,9 @@ def make_grpo_step(
         return loss, {"pg_loss": pg_loss, "kl": kl, "clip_frac": clip_frac,
                       "ratio_mean": jnp.sum(ratio * mask) / n_tokens}
 
-    def grpo_step(state: TrainState, tokens, mask, advantages, old_lp, ref_lp):
+    def grpo_step(state: TrainState, base_params, tokens, mask, advantages, old_lp, ref_lp):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, tokens, mask, advantages, old_lp, ref_lp
+            state.params, base_params, tokens, mask, advantages, old_lp, ref_lp
         )
         new_state, grad_norm = apply_gradients(state, grads, optimizer)
         return new_state, {"loss": loss, "grad_norm": grad_norm, **aux}
@@ -205,6 +221,7 @@ def run_grpo(
     checkpoint_every: int = 0,
     on_step: Callable[[int, dict], None] | None = None,
     attn_impl: str = "auto",
+    lora=None,   # train.lora.LoraConfig: train adapters over the frozen base
 ) -> tuple[TrainState, GrpoReport]:
     """Drive the GRPO loop: sample P prompts → G rollouts each → score →
     group advantages → mu clipped-surrogate updates. Returns the final
@@ -234,20 +251,34 @@ def run_grpo(
         )
     rng = jax.random.PRNGKey(0) if rng is None else rng
 
-    state = init_train_state(params, optimizer)
+    base_params = None
     ref_params = None
-    if cfg.kl_coef > 0.0:
-        # real copies, not aliases: the update step donates state.params, and
-        # donated buffers must not double as the frozen reference policy
-        ref_params = jax.tree.map(jnp.copy, params)
-    if mesh is not None:
-        from prime_tpu.train.trainer import shard_train_state as _sts
+    if lora is not None:
+        from prime_tpu.train.lora import init_lora_params, shard_lora_state
 
-        state = _sts(state, mesh, config)
-        if ref_params is not None:
+        rng, lora_rng = jax.random.split(rng)
+        state = init_train_state(init_lora_params(lora_rng, config, lora), optimizer)
+        base_params = params  # frozen; doubles as the KL reference (the
+        # zero-effect adapter init makes base == start policy exactly)
+        if mesh is not None:
             from prime_tpu.parallel.sharding import shard_params
 
-            ref_params = shard_params(ref_params, mesh, config)
+            base_params = shard_params(base_params, mesh, config)
+            state = shard_lora_state(state, mesh, config, lora)
+    else:
+        state = init_train_state(params, optimizer)
+        if cfg.kl_coef > 0.0:
+            # real copies, not aliases: the update step donates state.params,
+            # and donated buffers must not double as the frozen reference
+            ref_params = jax.tree.map(jnp.copy, params)
+        if mesh is not None:
+            from prime_tpu.train.trainer import shard_train_state as _sts
+
+            state = _sts(state, mesh, config)
+            if ref_params is not None:
+                from prime_tpu.parallel.sharding import shard_params
+
+                ref_params = shard_params(ref_params, mesh, config)
 
     pad_id = tokenizer.pad_id
     eos_id = getattr(tokenizer, "eos_id", -1)
@@ -287,7 +318,8 @@ def run_grpo(
     # skip the behavior-policy snapshot pass entirely (stop_gradient inside)
     on_policy = cfg.epochs_per_batch == 1 and cfg.kl_coef == 0.0
     step_fn = make_grpo_step(
-        config, optimizer, cfg.clip_eps, cfg.kl_coef, score_impl, on_policy=on_policy
+        config, optimizer, cfg.clip_eps, cfg.kl_coef, score_impl,
+        on_policy=on_policy, lora=lora,
     )
 
     for step in range(cfg.steps):
@@ -305,9 +337,15 @@ def run_grpo(
             lengths[i] = len(ids)
 
         rng, roll_rng = jax.random.split(rng)
+        if lora is not None:
+            from prime_tpu.train.lora import merge_lora
+
+            policy_params = merge_lora(base_params, state.params, lora)
+        else:
+            policy_params = state.params
         with mesh_ctx():
             result = generate(
-                state.params,
+                policy_params,
                 place(jnp.asarray(prompts), batch_spec()),
                 place(jnp.asarray(lengths), lengths_spec()),
                 config,
@@ -350,17 +388,24 @@ def run_grpo(
 
         with mesh_ctx():
             if on_policy:
+                del policy_params  # the in-jit merge must be the only live copy
                 zeros = jnp.zeros_like(mask_j)
-                state, step_metrics = step_fn(state, tokens_j, mask_j, adv_j, zeros, zeros)
+                state, step_metrics = step_fn(
+                    state, base_params, tokens_j, mask_j, adv_j, zeros, zeros
+                )
             else:
-                old_lp = token_logprobs(state.params, tokens_j, config, attn_impl=score_impl)
+                old_lp = token_logprobs(policy_params, tokens_j, config, attn_impl=score_impl)
+                del policy_params  # see above
+                kl_reference = base_params if lora is not None else ref_params
                 ref_lp = (
-                    token_logprobs(ref_params, tokens_j, config, attn_impl=score_impl)
-                    if ref_params is not None
+                    token_logprobs(kl_reference, tokens_j, config, attn_impl=score_impl)
+                    if (kl_reference is not None and cfg.kl_coef > 0.0)
                     else old_lp
                 )
                 for _ in range(cfg.epochs_per_batch):
-                    state, step_metrics = step_fn(state, tokens_j, mask_j, adv_j, old_lp, ref_lp)
+                    state, step_metrics = step_fn(
+                        state, base_params, tokens_j, mask_j, adv_j, old_lp, ref_lp
+                    )
 
         mean_reward = float(rewards.mean())
         loss = float(step_metrics["loss"])

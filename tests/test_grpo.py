@@ -97,7 +97,7 @@ def test_update_raises_positive_advantage_logprob(tiny):
     adv = jnp.array([1.0, -1.0])
     old_lp = token_logprobs(state.params, tokens, config)
 
-    new_state, metrics = step(state, tokens, mask, adv, old_lp, old_lp)
+    new_state, metrics = step(state, None, tokens, mask, adv, old_lp, old_lp)
     new_lp = token_logprobs(new_state.params, tokens, config)
 
     pos_delta = float(jnp.sum((new_lp - old_lp)[0] * mask[0]))
@@ -120,11 +120,11 @@ def test_padding_tokens_do_not_contribute(tiny):
     old_lp = token_logprobs(state.params, tokens, config)
     fresh = jax.tree.map(jnp.copy, params)  # step donates its input state
 
-    _, m1 = step(state, tokens, mask, adv, old_lp, old_lp)
+    _, m1 = step(state, None, tokens, mask, adv, old_lp, old_lp)
     state2 = init_train_state(fresh, optimizer)
     tokens2 = tokens.at[0, 4].set(9)  # pad-region perturbation
     old_lp2 = jnp.where(mask > 0, old_lp, 0.0)
-    _, m2 = step(state2, tokens2, mask, adv, old_lp2, old_lp2)
+    _, m2 = step(state2, None, tokens2, mask, adv, old_lp2, old_lp2)
     assert float(m1["loss"]) == pytest.approx(float(m2["loss"]), abs=1e-5)
 
 
@@ -140,7 +140,7 @@ def test_ratio_clipping_engages(tiny):
     mask = jnp.array([[0, 1, 1, 1]], dtype=jnp.float32)
     adv = jnp.array([1.0])
     old_lp = token_logprobs(state.params, tokens, config) - 2.0  # ratio ~ e^2
-    _, metrics = step(state, tokens, mask, adv, old_lp, old_lp)
+    _, metrics = step(state, None, tokens, mask, adv, old_lp, old_lp)
     assert float(metrics["clip_frac"]) == pytest.approx(1.0)
     assert float(metrics["ratio_mean"]) > 1.2
 
@@ -155,12 +155,12 @@ def test_kl_zero_against_self_and_positive_after_drift(tiny):
     mask = jnp.array([[0, 1, 1, 1]], dtype=jnp.float32)
     adv = jnp.array([1.0])
     lp0 = token_logprobs(params, tokens, config)
-    new_state, metrics = step(state, tokens, mask, adv, lp0, lp0)
+    new_state, metrics = step(state, None, tokens, mask, adv, lp0, lp0)
     assert float(metrics["kl"]) == pytest.approx(0.0, abs=1e-6)
     # after the update the policy has moved off the (frozen) reference
     lp1 = token_logprobs(new_state.params, tokens, config)
     state2 = init_train_state(new_state.params, optimizer)
-    _, metrics2 = step(state2, tokens, mask, adv, lp1, lp0)
+    _, metrics2 = step(state2, None, tokens, mask, adv, lp1, lp0)
     assert float(metrics2["kl"]) > 0.0
 
 
@@ -253,3 +253,55 @@ def test_run_grpo_batch_divisibility_error():
             examples=[{"prompt": "a", "answer": "a"}],
             scorer=None, cfg=cfg, mesh=mesh,
         )
+
+
+# -- LoRA GRPO ---------------------------------------------------------------
+
+
+def test_run_grpo_lora_trains_adapters_only():
+    """GRPO with lora: the returned state holds adapter factors (base stays
+    frozen), rollouts/updates go through the merged policy, and the KL
+    reference is the base itself (zero KL at the zero-effect init)."""
+    from prime_tpu.train.lora import LoraConfig
+
+    config = get_config("tiny-test")
+    params = init_params(jax.random.PRNGKey(1), config, dtype=jnp.float32)
+    before = jax.tree.map(jnp.copy, params)
+    cfg = GrpoConfig(
+        group_size=4, prompts_per_step=2, max_prompt_len=8, max_new_tokens=4,
+        temperature=1.0, steps=2, kl_coef=0.05, learning_rate=1e-2,
+    )
+    state, report = run_grpo(
+        config, params, ByteTokenizer(),
+        examples=[{"prompt": "ab", "answer": "ab"}],
+        scorer=lambda c, a: float(len(c) > 0),
+        cfg=cfg,
+        rng=jax.random.PRNGKey(5),
+        lora=LoraConfig(r=4, alpha=8),
+    )
+    assert report.steps == 2 and np.isfinite(report.final_loss)
+    # state carries {layers: {wq: {a, b}, ...}} adapter factors
+    assert set(state.params["layers"]["wq"]) == {"a", "b"}
+    # base weights untouched
+    for x, y in zip(jax.tree.leaves(before), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_run_grpo_lora_sharded():
+    from prime_tpu.parallel.mesh import make_mesh
+    from prime_tpu.train.lora import LoraConfig
+
+    config = get_config("tiny-test")
+    params = init_params(jax.random.PRNGKey(2), config, dtype=jnp.float32)
+    mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2}, devices=jax.devices()[:8])
+    cfg = GrpoConfig(
+        group_size=4, prompts_per_step=2, max_prompt_len=8, max_new_tokens=4,
+        temperature=1.0, steps=1,
+    )
+    state, report = run_grpo(
+        config, params, ByteTokenizer(),
+        examples=[{"prompt": "xy", "answer": "xy"}],
+        scorer=None, cfg=cfg, mesh=mesh, rng=jax.random.PRNGKey(6),
+        lora=LoraConfig(r=4),
+    )
+    assert report.steps == 1 and np.isfinite(report.final_loss)

@@ -582,3 +582,30 @@ def test_train_local_rl_rejects_bad_flags(tmp_path):
         cli, ["train", "local-rl", "arith", "-g", "1", "--output-dir", str(tmp_path)]
     )
     assert solo.exit_code != 0 and "group_size" in solo.output
+
+
+def test_train_local_rl_lora_cli(tmp_path):
+    """`train local-rl --lora`: GRPO over frozen base, adapter artifact out."""
+    import json as _json
+
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.main import cli
+
+    result = CliRunner().invoke(
+        cli,
+        ["train", "local-rl", "arith", "-m", "tiny-test", "--steps", "2",
+         "-g", "2", "-p", "2", "--max-prompt-len", "16", "--max-new-tokens", "4",
+         "--lora", "--lora-r", "4", "--name", "rl-lora", "--output-dir",
+         str(tmp_path), "--output", "json"],
+    )
+    assert result.exit_code == 0, result.output
+    payload = _json.loads(result.output)
+    assert payload["steps"] == 2
+    adapter_dir = payload["adapterDir"]
+    assert (tmp_path / "rl-lora" / "adapters" / "adapters.npz").exists()
+    meta = _json.loads(
+        (tmp_path / "rl-lora" / "adapters" / "adapter_config.json").read_text()
+    )
+    assert meta["r"] == 4 and meta["base_model"] == "tiny-test"
+    assert adapter_dir.endswith("adapters")
