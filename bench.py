@@ -110,10 +110,10 @@ def main() -> None:
             best_s = min(best_s, time.perf_counter() - t0)
         return best_s
 
-    def run_generate(**kw):
+    def run_generate(prompt_tokens=None, **kw):
         result = generate(
             params,
-            prompts,
+            prompts if prompt_tokens is None else prompt_tokens,
             lengths,
             config,
             jax.random.PRNGKey(2),
@@ -202,6 +202,23 @@ def main() -> None:
 
     w8_q8_tok_s = BATCH * NEW_TOKENS / time_fn(run_w8_q8)
 
+    # prompt-lookup speculative decoding on periodic context (the favorable
+    # case: drafts accept). Secondary metric — the headline stays plain bf16.
+    from prime_tpu.models.speculative import spec_generate
+
+    periodic = jnp.tile(jnp.arange(1, 17, dtype=jnp.int32), (BATCH, PROMPT_LEN // 16))
+
+    def run_spec():
+        result = spec_generate(
+            params, periodic, lengths, config, max_new_tokens=NEW_TOKENS, draft_len=4
+        )
+        float(jnp.sum(result.tokens))
+
+    spec_tok_s = BATCH * NEW_TOKENS / time_fn(run_spec)
+    plain_periodic_tok_s = BATCH * NEW_TOKENS / time_fn(
+        lambda: run_generate(prompt_tokens=periodic)
+    )
+
     print(
         json.dumps(
             {
@@ -216,6 +233,8 @@ def main() -> None:
                 "int8_kv_xla_tok_s": round(q8_tok_s, 1),
                 "int8_weights_tok_s": round(w8_tok_s, 1),
                 "int8_weights_kv_tok_s": round(w8_q8_tok_s, 1),
+                "spec_periodic_tok_s": round(spec_tok_s, 1),
+                "plain_periodic_tok_s": round(plain_periodic_tok_s, 1),
                 "backend": jax.default_backend(),
                 "device": str(jax.devices()[0]),
             }

@@ -180,6 +180,43 @@ def check_train_local(env):
         assert len(metrics.splitlines()) == 3
 
 
+@step("GRPO local RL + LoRA adapter -> eval --adapter round trip")
+def check_local_rl_lora(env):
+    with tempfile.TemporaryDirectory() as tmp:
+        out = run_cli(
+            "train", "local-rl", "arith", "-m", "tiny-test", "--steps", "2",
+            "-g", "2", "-p", "2", "--max-prompt-len", "16", "--max-new-tokens", "4",
+            "--lora", "--lora-r", "4", "--name", "e2e-rl",
+            "--output-dir", str(Path(tmp) / "rl"), "--output", "json", env=env,
+        ).stdout
+        payload = json.loads(out)
+        assert payload["steps"] == 2 and "adapterDir" in payload
+        ev = run_cli(
+            "eval", "run", "arith", "-m", "tiny-test", "--adapter", payload["adapterDir"],
+            "--no-push", "-n", "2", "-b", "2", "--max-new-tokens", "4",
+            "--output-dir", str(Path(tmp) / "evals"), "--plain", env=env,
+        )
+        assert "accuracy=" in ev.stdout
+
+
+@step("speculative decoding through eval run")
+def check_speculative(env):
+    with tempfile.TemporaryDirectory() as tmp:
+        out = run_cli(
+            "eval", "run", "arith", "-m", "tiny-test", "--speculative", "--draft-len", "4",
+            "--no-push", "-n", "2", "-b", "2", "--max-new-tokens", "4",
+            "--output-dir", str(Path(tmp) / "evals"), "--plain", env=env,
+        )
+        assert "accuracy=" in out.stdout
+        # greedy-only guard: a sampling temperature must hard-error
+        bad = run_cli(
+            "eval", "run", "arith", "-m", "tiny-test", "--speculative", "-t", "0.5",
+            "--no-push", "-n", "1", "--output-dir", str(Path(tmp) / "e2"), "--plain",
+            env=env, check=False,
+        )
+        assert bad.returncode != 0 and "greedy" in (bad.stdout + bad.stderr)
+
+
 @step("serve round trip (OpenAI-compatible)")
 def check_serve(env):
     code = (
@@ -231,6 +268,8 @@ def main() -> int:
             check_env_execution,
             check_images,
             check_train_local,
+            check_local_rl_lora,
+            check_speculative,
             check_serve,
         ):
             check(env)
