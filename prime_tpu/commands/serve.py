@@ -20,6 +20,8 @@ import click
 @click.option("--tp", "tensor_parallel", type=int, default=None)
 @click.option("--kv-quant", is_flag=True, help="int8 KV cache (halved decode HBM traffic).")
 @click.option("--weight-quant", is_flag=True, help="int8 weights (W8A16; halved weight HBM traffic).")
+@click.option("--adapter", default=None, type=click.Path(exists=True),
+              help="LoRA adapter dir (from train local --lora) to merge into the model.")
 @click.option("--host", default="127.0.0.1")
 @click.option("--port", type=int, default=8000)
 @click.option(
@@ -44,6 +46,7 @@ def serve_cmd(
     tensor_parallel: int | None,
     kv_quant: bool,
     weight_quant: bool,
+    adapter: str | None,
     host: str,
     port: int,
     continuous: bool,
@@ -63,6 +66,7 @@ def serve_cmd(
             tensor_parallel=tensor_parallel,
             kv_quant=kv_quant,
             weight_quant=weight_quant,
+            adapter=adapter,
             host=host,
             port=port,
             continuous=continuous,

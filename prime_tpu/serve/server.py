@@ -316,6 +316,7 @@ def serve_model(
     tensor_parallel: int | None = None,
     kv_quant: bool = False,
     weight_quant: bool = False,
+    adapter: str | None = None,
     host: str = "127.0.0.1",
     port: int = 8000,
     continuous: bool = False,
@@ -346,6 +347,7 @@ def serve_model(
             tensor_parallel=tensor_parallel,
             kv_quant=kv_quant,
             weight_quant=weight_quant,
+            adapter=adapter,
         )
         if continuous:
             from prime_tpu.serve.engine import ContinuousBatchingEngine, EngineBackend

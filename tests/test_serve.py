@@ -282,3 +282,41 @@ def test_chat_template_preferred_over_generic():
             timeout=30,
         )
     assert prompts_seen == ["user: hi\nassistant:"]
+
+
+def test_serve_with_lora_adapter(tmp_path):
+    """serve_model --adapter really merges: a nonzero-B adapter must change
+    the greedy completion vs the unadapted base server."""
+    import httpx
+    import jax
+    import jax.numpy as jnp
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.serve import serve_model
+    from prime_tpu.train.lora import LoraConfig, init_lora_params, save_adapters
+
+    cfg = get_config("tiny-test")
+    lora = LoraConfig(r=4, alpha=64)
+    adapters = init_lora_params(jax.random.PRNGKey(1), cfg, lora)
+    # zero-effect init would make this test pass even with the plumbing cut
+    adapters["layers"]["wq"]["b"] = jax.random.normal(
+        jax.random.PRNGKey(2), adapters["layers"]["wq"]["b"].shape, jnp.float32
+    )
+    base = init_params(jax.random.PRNGKey(0), cfg)  # serve's own init seed/dtype
+    path = save_adapters(tmp_path / "art", adapters, lora, cfg, base_params=base)
+
+    body = {
+        "messages": [{"role": "user", "content": "hello there"}],
+        "max_tokens": 8,
+        "temperature": 0.0,
+    }
+
+    def completion(**kw):
+        server = serve_model("tiny-test", port=0, **kw)
+        with server:
+            r = httpx.post(server.url + "/v1/chat/completions", json=body, timeout=240)
+            assert r.status_code == 200, r.text
+            return r.json()["choices"][0]["message"]["content"]
+
+    assert completion(adapter=str(path)) != completion()
