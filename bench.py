@@ -1,27 +1,30 @@
-"""Benchmark: autoregressive generation throughput on the real TPU chip.
+"""Benchmark: TPU throughput on the north-star paths (BASELINE.md).
 
-Proxy for the north-star workload (gsm8k eval samples/sec, BASELINE.md): the
-eval runner's cost is dominated by batched prefill + greedy decode, which is
-exactly what this measures — llama3.2-1b architecture (random weights;
-throughput is weight-value independent), bf16, batch 8, 128-token prompts,
-128 new tokens.
+Sections (each prints a `# bench:` progress line; ONE final JSON line):
+  headline   decode tokens/sec — llama3.2-1b bf16, batch 8, 128+128 greedy
+  eval       eval samples/sec THROUGH EvalRunner (tokenize → batch → sharded
+             generate → score → results.jsonl) — the BASELINE.json metric
+  serve      continuous-batching engine tokens/sec under concurrent load
+  quant      int8 weights / int8 KV variants of the headline
+  longctx    flash-decode pallas kernel vs XLA at C=4096 (the regime the
+             kernel was built for; short-context already dispatches to XLA)
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+The headline JSON line is printed as soon as it is measured, then re-printed
+at the end enriched with every extra section — whichever line is last on
+stdout is complete, and an early kill still leaves a nonzero record (rounds
+1-2 recorded 0.0 because the preflight probe timeout was SHORTER than the
+tunnel's observed ~150 s success latency; see _preflight).
+
 The reference publishes no numbers (BASELINE.json "published": {}), so
-vs_baseline is the ratio against PREV_DECODE_TOK_S below — the first recorded
-round of this repo; update it when the bench materially improves.
+vs_baseline is the ratio against PREV_DECODE_TOK_S — this repo's round-1
+measured anchor.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-
-from prime_tpu.models import get_config
-from prime_tpu.models.llama import init_params
-from prime_tpu.models.sampler import generate
 
 # Round-1 anchor (v5e-1, this repo @ first bench). vs_baseline = value / this.
 PREV_DECODE_TOK_S = 1396.6
@@ -31,13 +34,67 @@ PROMPT_LEN = 128
 NEW_TOKENS = 128
 MODEL = "llama3.2-1b"
 
+# Observed on the axon tunnel (scripts/tpu_watch.sh, round 3): a trivial
+# matmul probe SUCCEEDS but takes ~150 s end-to-end (interpreter + PJRT
+# handshake + first compile over the relay). Rounds 1-2 probed with a 120 s
+# timeout and recorded the backend as "unresponsive" — the probe budget must
+# comfortably exceed the success latency, not undercut it.
+PROBE_TIMEOUT_S = 330.0
+PROBE_WAITS_S = (30.0, 60.0, 120.0, 240.0)  # between attempts; ~30 min worst case
+
+
+def _sweep_stray_holders() -> list[str]:
+    """Kill leftover TPU-touching helper processes from the round so the
+    bench (and the driver's end-of-round snapshot) owns the chip cleanly:
+    the reachability watcher (scripts/tpu_watch.sh) and any orphaned probe
+    interpreters. Never touches this process or its ancestors."""
+    me = os.getpid()
+    ancestors = set()
+    pid = me
+    for _ in range(10):
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                pid = int(f.read().split()[3])
+        except (OSError, ValueError, IndexError):
+            break
+        if pid <= 1:
+            break
+        ancestors.add(pid)
+    killed = []
+    try:
+        out = subprocess.run(
+            ["ps", "-eo", "pid,args"], capture_output=True, text=True, timeout=10
+        ).stdout
+    except Exception:
+        return killed
+    for line in out.splitlines()[1:]:
+        parts = line.strip().split(None, 1)
+        if len(parts) != 2:
+            continue
+        pid_s, cmd = parts
+        try:
+            pid = int(pid_s)
+        except ValueError:
+            continue
+        if pid == me or pid in ancestors:
+            continue
+        # exact helper signatures only: the watcher's shell process (bash
+        # running the script — NOT an editor/grep whose argv mentions it) and
+        # probe interpreters (python -c with the probe matmul literal)
+        is_watcher = "bash" in cmd and cmd.rstrip().endswith("tpu_watch.sh")
+        is_probe = "python" in cmd and "-c" in cmd and "jnp.ones((256" in cmd
+        if is_watcher or is_probe:
+            try:
+                os.kill(pid, 9)
+                killed.append(f"{pid}:{cmd[:60]}")
+            except OSError:
+                pass
+    return killed
+
 
 def _probe_once(timeout_s: float) -> str | None:
-    """One accelerator probe in a SUBPROCESS (fresh PJRT client — an in-process
-    retry would reuse the same stuck client). None on success, else a reason."""
-    import subprocess
-    import sys
-
+    """One accelerator probe in a SUBPROCESS (fresh PJRT client — an
+    in-process retry would reuse the same stuck client). None on success."""
     code = (
         "import jax, jax.numpy as jnp\n"
         "x = jnp.ones((256, 256))\n"
@@ -54,23 +111,65 @@ def _probe_once(timeout_s: float) -> str | None:
     return None
 
 
-def _preflight(attempts: int = 4, timeout_s: float = 120.0, wait_s: float = 60.0) -> None:
-    """The tunneled TPU occasionally stalls *transiently* — retry the probe a
-    few times (~10 min budget) before giving up with a clean JSON diagnostic.
-    Round 1 aborted on the first failed probe and recorded a 0.0 bench."""
+def _diagnose() -> dict:
+    """On preflight failure: enumerate candidate chip-holding processes and
+    environment state so the record says WHY, not just 'unresponsive'."""
+    # key NAMES only (plus the one known-safe platform selector): the failure
+    # JSON lands in git via BENCH_rNN.json, so tunnel endpoints/credentials
+    # that may ride AXON_* values must not be echoed
+    info: dict = {
+        "env_keys": sorted(k for k in os.environ if "AXON" in k or "JAX" in k),
+        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+    }
+    # pid/age/basename ONLY — full argv can carry tunnel endpoints or tokens
+    # (e.g. `python -m tunnel --token=...`) and this JSON is committed to git
+    try:
+        out = subprocess.run(
+            ["ps", "-eo", "pid,etime,comm"], capture_output=True, text=True, timeout=10
+        ).stdout
+        info["python_procs"] = [
+            " ".join(line.split()[:3])
+            for line in out.splitlines()[1:]
+            if "python" in line
+        ][:20]
+    except Exception as e:
+        info["python_procs"] = [f"ps failed: {e}"]
+    try:
+        out = subprocess.run(["ss", "-tln"], capture_output=True, text=True, timeout=10).stdout
+        info["listen_ports"] = sorted(
+            {
+                line.split()[3].rsplit(":", 1)[-1]
+                for line in out.splitlines()[1:]
+                if len(line.split()) > 3
+            }
+        )[:10]
+    except Exception:
+        pass
+    return info
+
+
+def _preflight() -> None:
+    swept = _sweep_stray_holders()
+    if swept:
+        print(f"# bench: swept {len(swept)} stray TPU helper(s): {swept}", flush=True)
     errors: list[str] = []
-    for attempt in range(attempts):
-        reason = _probe_once(timeout_s)
+    for attempt in range(len(PROBE_WAITS_S) + 1):
+        t0 = time.monotonic()
+        reason = _probe_once(PROBE_TIMEOUT_S)
         if reason is None:
-            if errors:
-                print(f"# preflight recovered after {len(errors)} failed probe(s)", flush=True)
+            print(
+                f"# bench: preflight ok in {time.monotonic() - t0:.0f}s"
+                + (f" after {len(errors)} failed probe(s)" if errors else ""),
+                flush=True,
+            )
             return
         errors.append(reason)
-        print(f"# preflight probe {attempt + 1}/{attempts} failed: {reason}", flush=True)
-        if attempt < attempts - 1:
-            time.sleep(wait_s)
-    import os
-
+        print(
+            f"# bench: preflight probe {attempt + 1}/{len(PROBE_WAITS_S) + 1} failed: {reason}",
+            flush=True,
+        )
+        if attempt < len(PROBE_WAITS_S):
+            time.sleep(PROBE_WAITS_S[attempt])
     print(
         json.dumps(
             {
@@ -78,7 +177,8 @@ def _preflight(attempts: int = 4, timeout_s: float = 120.0, wait_s: float = 60.0
                 "value": 0.0,
                 "unit": "tokens/s",
                 "vs_baseline": 0.0,
-                "error": f"{attempts} probes failed: {errors[-1]}",
+                "error": f"{len(errors)} probes failed: {errors[-1]}",
+                "diagnosis": _diagnose(),
                 # NOTE: not jax.default_backend() — that query can hang on
                 # the same stuck backend this preflight is detecting
                 "backend": os.environ.get("JAX_PLATFORMS", "unknown"),
@@ -92,6 +192,13 @@ def _preflight(attempts: int = 4, timeout_s: float = 120.0, wait_s: float = 60.0
 
 def main() -> None:
     _preflight()
+    import jax
+    import jax.numpy as jnp
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.models.sampler import generate
+
     config = get_config(MODEL)
     rng = jax.random.PRNGKey(0)
     params = init_params(rng, config, dtype=jnp.bfloat16)
@@ -110,136 +217,147 @@ def main() -> None:
             best_s = min(best_s, time.perf_counter() - t0)
         return best_s
 
-    def run_generate(prompt_tokens=None, **kw):
+    def run_generate(**kw):
         result = generate(
-            params,
-            prompts if prompt_tokens is None else prompt_tokens,
-            lengths,
-            config,
-            jax.random.PRNGKey(2),
-            max_new_tokens=NEW_TOKENS,
-            temperature=0.0,
-            **kw,
+            params, prompts, lengths, config, jax.random.PRNGKey(2),
+            max_new_tokens=NEW_TOKENS, temperature=0.0, **kw,
         )
         float(jnp.sum(result.tokens))
 
+    # ---- headline ------------------------------------------------------------
     best = time_fn(run_generate)
     decode_tok_s = BATCH * NEW_TOKENS / best
-    samples_per_sec = BATCH / best
+    record = {
+        "metric": f"decode_tokens_per_sec ({MODEL} bf16, b{BATCH}, p{PROMPT_LEN}+{NEW_TOKENS})",
+        "value": round(decode_tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(decode_tok_s / PREV_DECODE_TOK_S, 3),
+        "gen_time_s": round(best, 3),
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+    }
+    # early print: an external kill mid-extras still leaves a nonzero record
+    print(json.dumps(record), flush=True)
 
-    # sharded serve path on a 1-device mesh: same code the eval runner uses
-    # with --slice (VERDICT r1 asked for the sharded generate timed on-chip)
-    from jax.sharding import NamedSharding
+    # ---- eval: the north-star metric through the REAL runner ----------------
+    # EvalRunner end to end: tokenizer encode, batch assembly (+ SPMD padding),
+    # sharded generate on a 1-device mesh, scoring, results.jsonl writes —
+    # the BASELINE.json "verifiers eval samples/sec" definition, not a proxy.
+    try:
+        import tempfile
 
-    from prime_tpu.parallel.mesh import make_mesh
-    from prime_tpu.parallel.sharding import (
-        batch_spec,
-        cache_spec,
-        lengths_spec,
-        shard_params,
-    )
+        from prime_tpu.evals.runner import EvalRunSpec, JaxGenerator, run_eval
 
-    mesh = make_mesh({"dp": 1, "fsdp": 1, "tp": 1}, devices=jax.devices()[:1])
-    sharded = shard_params(params, mesh, config)
-    prompts_s = jax.device_put(prompts, NamedSharding(mesh, batch_spec()))
-    lengths_s = jax.device_put(lengths, NamedSharding(mesh, lengths_spec()))
+        eval_gen = JaxGenerator(MODEL, slice_name="v5e-1")
+        with tempfile.TemporaryDirectory() as td:
+            spec = EvalRunSpec(
+                env="synthetic-arith",
+                model=MODEL,
+                limit=32,
+                batch_size=8,
+                max_new_tokens=64,
+                output_dir=td,
+            )
+            run_eval(spec, generator=eval_gen)  # warmup: compile + first batch shapes
+            result = run_eval(spec, generator=eval_gen)
+        record["eval_samples_per_sec"] = round(result.metrics["samples_per_sec"], 2)
+        record["eval_wall_time_s"] = round(result.metrics["wall_time_s"], 2)
+        print(f"# bench: eval {record['eval_samples_per_sec']} samples/s", flush=True)
+        del eval_gen
+    except Exception as e:  # noqa: BLE001 — a failed extra must not zero the headline
+        record["eval_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: eval section failed: {e}", flush=True)
 
-    def run_sharded():
-        with jax.set_mesh(mesh):
+    # ---- serve: continuous-batching engine under concurrent load ------------
+    try:
+        from prime_tpu.serve.engine import ContinuousBatchingEngine
+
+        n_req, req_new = 16, 64
+        engine = ContinuousBatchingEngine(
+            params, config, pad_id=0, max_slots=8, capacity=1024, chunk=8
+        )
+        prompt_ids = [
+            [1] + [(7 * (i + j)) % 1000 + 3 for j in range(96)] for i in range(n_req)
+        ]
+        # warmup: compile prefill/decode/finalize for the buckets in play
+        warm = engine.submit(prompt_ids[0], max_new_tokens=req_new)
+        while not warm.done:
+            engine.tick()
+        t0 = time.perf_counter()
+        reqs = [engine.submit(ids, max_new_tokens=req_new) for ids in prompt_ids]
+        while not all(r.done for r in reqs):
+            engine.tick()
+        serve_s = time.perf_counter() - t0
+        total_tokens = sum(len(r.all_tokens(timeout=1)) for r in reqs)
+        record["serve_tok_s"] = round(total_tokens / serve_s, 1)
+        record["serve_requests"] = n_req
+        print(f"# bench: serve {record['serve_tok_s']} tok/s ({n_req} reqs)", flush=True)
+        del engine
+    except Exception as e:  # noqa: BLE001
+        record["serve_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: serve section failed: {e}", flush=True)
+
+    # ---- quant: int8 weights / int8 KV --------------------------------------
+    try:
+        from prime_tpu.models.quantize import quantize_params_int8
+
+        qparams = quantize_params_int8(params)
+
+        def run_q(kv_quant=False):
             result = generate(
-                sharded,
-                prompts_s,
-                lengths_s,
+                qparams, prompts, lengths, config, jax.random.PRNGKey(2),
+                max_new_tokens=NEW_TOKENS, temperature=0.0,
+                **({"attn_impl": "xla", "kv_quant": True} if kv_quant else {}),
+            )
+            float(jnp.sum(result.tokens))
+
+        record["int8_weights_tok_s"] = round(BATCH * NEW_TOKENS / time_fn(run_q), 1)
+        record["int8_weights_kv_tok_s"] = round(
+            BATCH * NEW_TOKENS / time_fn(lambda: run_q(kv_quant=True)), 1
+        )
+        print(f"# bench: int8 weights {record['int8_weights_tok_s']} tok/s", flush=True)
+    except Exception as e:  # noqa: BLE001
+        record["quant_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: quant section failed: {e}", flush=True)
+
+    # ---- longctx: flash-decode pallas kernel vs XLA at C=4096 ---------------
+    # The regime the kernel exists for (short context dispatches to XLA via
+    # PRIME_TPU_FLASH_DECODE_MIN_C). VERDICT r2 #5: prove it or retire it.
+    try:
+        lc_batch, lc_prompt, lc_new = 4, 3968, 64
+        lc_prompts = jax.random.randint(
+            jax.random.PRNGKey(3), (lc_batch, lc_prompt), 1, config.vocab_size
+        )
+
+        def run_lc(impl):
+            result = generate(
+                params,
+                lc_prompts,
+                jnp.full((lc_batch,), lc_prompt, dtype=jnp.int32),
                 config,
                 jax.random.PRNGKey(2),
-                max_new_tokens=NEW_TOKENS,
+                max_new_tokens=lc_new,
                 temperature=0.0,
-                cache_spec=cache_spec(),
+                attn_impl=impl,
             )
-        float(jnp.sum(result.tokens))
+            float(jnp.sum(result.tokens))
 
-    sharded_tok_s = BATCH * NEW_TOKENS / time_fn(run_sharded)
-
-    # int8 KV cache vs the SAME (XLA) decode path: the quantized cache has no
-    # pallas kernel yet, so compare against an XLA fp run — otherwise the
-    # kernel switch, not quantization, would dominate the delta
-    xla_fp_tok_s = BATCH * NEW_TOKENS / time_fn(lambda: run_generate(attn_impl="xla"))
-    q8_tok_s = BATCH * NEW_TOKENS / time_fn(
-        lambda: run_generate(attn_impl="xla", kv_quant=True)
-    )
-
-    # W8A16: int8 weights halve the dominant decode bytes at small batch
-    from prime_tpu.models.quantize import quantize_params_int8
-
-    qparams = quantize_params_int8(params)
-
-    def run_w8():
-        result = generate(
-            qparams,
-            prompts,
-            lengths,
-            config,
-            jax.random.PRNGKey(2),
-            max_new_tokens=NEW_TOKENS,
-            temperature=0.0,
+        xla_s = time_fn(lambda: run_lc("xla"), iterations=2)
+        pallas_s = time_fn(lambda: run_lc("pallas"), iterations=2)
+        record["longctx_xla_tok_s"] = round(lc_batch * lc_new / xla_s, 1)
+        record["longctx_pallas_tok_s"] = round(lc_batch * lc_new / pallas_s, 1)
+        record["longctx_pallas_speedup"] = round(xla_s / pallas_s, 3)
+        print(
+            f"# bench: longctx C=4096 pallas {record['longctx_pallas_tok_s']} vs "
+            f"xla {record['longctx_xla_tok_s']} tok/s",
+            flush=True,
         )
-        float(jnp.sum(result.tokens))
+    except Exception as e:  # noqa: BLE001
+        record["longctx_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: longctx section failed: {e}", flush=True)
 
-    w8_tok_s = BATCH * NEW_TOKENS / time_fn(run_w8)
-    def run_w8_q8():
-        result = generate(
-            qparams,
-            prompts,
-            lengths,
-            config,
-            jax.random.PRNGKey(2),
-            max_new_tokens=NEW_TOKENS,
-            temperature=0.0,
-            attn_impl="xla",
-            kv_quant=True,
-        )
-        float(jnp.sum(result.tokens))
-
-    w8_q8_tok_s = BATCH * NEW_TOKENS / time_fn(run_w8_q8)
-
-    # prompt-lookup speculative decoding on periodic context (the favorable
-    # case: drafts accept). Secondary metric — the headline stays plain bf16.
-    from prime_tpu.models.speculative import spec_generate
-
-    periodic = jnp.tile(jnp.arange(1, 17, dtype=jnp.int32), (BATCH, PROMPT_LEN // 16))
-
-    def run_spec():
-        result = spec_generate(
-            params, periodic, lengths, config, max_new_tokens=NEW_TOKENS, draft_len=4
-        )
-        float(jnp.sum(result.tokens))
-
-    spec_tok_s = BATCH * NEW_TOKENS / time_fn(run_spec)
-    plain_periodic_tok_s = BATCH * NEW_TOKENS / time_fn(
-        lambda: run_generate(prompt_tokens=periodic)
-    )
-
-    print(
-        json.dumps(
-            {
-                "metric": f"decode_tokens_per_sec ({MODEL} bf16, b{BATCH}, p{PROMPT_LEN}+{NEW_TOKENS})",
-                "value": round(decode_tok_s, 1),
-                "unit": "tokens/s",
-                "vs_baseline": round(decode_tok_s / PREV_DECODE_TOK_S, 3),
-                "samples_per_sec": round(samples_per_sec, 2),
-                "gen_time_s": round(best, 3),
-                "sharded_1dev_tok_s": round(sharded_tok_s, 1),
-                "xla_fp_tok_s": round(xla_fp_tok_s, 1),
-                "int8_kv_xla_tok_s": round(q8_tok_s, 1),
-                "int8_weights_tok_s": round(w8_tok_s, 1),
-                "int8_weights_kv_tok_s": round(w8_q8_tok_s, 1),
-                "spec_periodic_tok_s": round(spec_tok_s, 1),
-                "plain_periodic_tok_s": round(plain_periodic_tok_s, 1),
-                "backend": jax.default_backend(),
-                "device": str(jax.devices()[0]),
-            }
-        )
-    )
+    # final, enriched record — last JSON line on stdout wins
+    print(json.dumps(record), flush=True)
 
 
 if __name__ == "__main__":

@@ -490,6 +490,9 @@ def local_rl_cmd(
             checkpoint_every=checkpoint_every,
             on_step=on_step,
             lora=lora_cfg,
+            # the CLI never reuses `params` after this call — skip the safety
+            # copy and donate the tree (one full model of HBM on big models)
+            copy_params=False,
         )
     except ValueError as e:
         raise click.ClickException(str(e)) from None

@@ -38,7 +38,12 @@ class ModelConfig:
     attn_softcap: float = 0.0         # tanh softcap on attention scores
     final_softcap: float = 0.0        # tanh softcap on output logits
     query_scale: float | None = None  # sm_scale = query_scale**-0.5 (else head_dim)
-    sliding_window: int = 0           # window for the sliding layers (even idx)
+    sliding_window: int = 0           # window size for the sliding layers
+    # which layers slide when sliding_window > 0: "even" (Gemma2 alternation,
+    # even-index layers slide) | "uniform" (every layer slides, Mistral-style).
+    # Explicit so a config wanting a different pattern fails loudly instead of
+    # silently inheriting the Gemma2 alternation.
+    sliding_pattern: str = "even"
     # mixture-of-experts (0 experts = dense MLP; Mixtral-style top-k routing)
     n_experts: int = 0
     experts_per_token: int = 2

@@ -23,10 +23,27 @@ import numpy as np
 from prime_tpu.models.config import ModelConfig
 
 
+# model_type values whose math this loader reproduces exactly. Families that
+# SHARE Llama state-dict key names but need different math — gemma v1
+# ((1+w) norms + sqrt(d) embed scale + GeGLU), gemma3 (qk-norm + 5:1 sliding
+# pattern), phi3 (fused qkv), etc. — must fail loudly here rather than load
+# and silently produce garbage logits.
+SUPPORTED_MODEL_TYPES = frozenset({"llama", "mistral", "mixtral", "qwen2", "qwen3", "gemma2"})
+
+
 def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
     derived_head_dim = hf_config.hidden_size // hf_config.num_attention_heads
     explicit_head_dim = getattr(hf_config, "head_dim", None)
     model_type = getattr(hf_config, "model_type", "") or ""
+    # Empty model_type (hand-written configs, this repo's own tests) is
+    # treated as llama-like; anything else must be explicitly supported.
+    if model_type and model_type not in SUPPORTED_MODEL_TYPES:
+        raise ValueError(
+            f"Unsupported model_type {model_type!r}: this loader reproduces the math of "
+            f"{sorted(SUPPORTED_MODEL_TYPES)} only. Checkpoint families that share Llama "
+            "state-dict keys but diverge in math (gemma, gemma3, phi3, ...) would load "
+            "without error and produce wrong logits, so they are rejected."
+        )
     # Qwen2 checkpoints carry q/k/v biases unconditionally; Llama-family
     # configs declare them via attention_bias
     attn_bias = bool(getattr(hf_config, "attention_bias", False)) or model_type == "qwen2"
@@ -48,7 +65,15 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         attn_softcap=float(getattr(hf_config, "attn_logit_softcapping", 0.0) or 0.0),
         final_softcap=float(getattr(hf_config, "final_logit_softcapping", 0.0) or 0.0),
         query_scale=getattr(hf_config, "query_pre_attn_scalar", None),
-        sliding_window=int(getattr(hf_config, "sliding_window", 0) or 0) if gemma else 0,
+        # Gemma2 alternates sliding/global (even layers slide); Mistral v0.1
+        # slides every layer. Other families' window configs are rejected by
+        # the allowlist above rather than silently mapped to either pattern.
+        sliding_window=(
+            int(getattr(hf_config, "sliding_window", 0) or 0)
+            if model_type in ("gemma2", "mistral")
+            else 0
+        ),
+        sliding_pattern="even" if gemma else "uniform",
         name=name,
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,

@@ -359,13 +359,19 @@ def forward(
     layer_params = params["layers"]
     cache_lengths = cache.lengths if cache is not None else None
     aux0 = jnp.zeros((), jnp.float32)
-    # Gemma2 alternates sliding-window (even) and global (odd) layers; the
-    # per-layer flag rides the scan so one compiled body serves both kinds
-    sliding_flags = (
-        jnp.arange(config.n_layers) % 2 == 0
-        if config.sliding_window
-        else jnp.zeros((config.n_layers,), dtype=bool)
-    )
+    # Per-layer sliding flag rides the scan so one compiled body serves both
+    # kinds. The pattern is an explicit config field (ModelConfig.sliding_pattern)
+    # so non-Gemma2 window schemes can't silently inherit the even alternation.
+    if not config.sliding_window:
+        sliding_flags = jnp.zeros((config.n_layers,), dtype=bool)
+    elif config.sliding_pattern == "even":  # Gemma2: even layers slide
+        sliding_flags = jnp.arange(config.n_layers) % 2 == 0
+    elif config.sliding_pattern == "uniform":  # Mistral-style: all layers slide
+        sliding_flags = jnp.ones((config.n_layers,), dtype=bool)
+    else:
+        raise ValueError(
+            f"Unknown sliding_pattern {config.sliding_pattern!r} (want 'even' | 'uniform')"
+        )
 
     quantized = cache is not None and cache.quantized
 

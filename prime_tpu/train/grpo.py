@@ -222,6 +222,7 @@ def run_grpo(
     on_step: Callable[[int, dict], None] | None = None,
     attn_impl: str = "auto",
     lora=None,   # train.lora.LoraConfig: train adapters over the frozen base
+    copy_params: bool = True,
 ) -> tuple[TrainState, GrpoReport]:
     """Drive the GRPO loop: sample P prompts → G rollouts each → score →
     group advantages → mu clipped-surrogate updates. Returns the final
@@ -230,6 +231,10 @@ def run_grpo(
     ``scorer(completion, answer) -> float`` is the env contract
     (envhub/execution.py LoadedEnvironment); None falls back to exact-match
     via evals.datasets.score_completion.
+
+    ``copy_params=False`` (dense path) skips the safety copy of ``params``
+    and donates the caller's tree directly — saves one full model of HBM on
+    big models, but the passed tree is CONSUMED (unusable after the call).
     """
     import contextlib
 
@@ -266,10 +271,14 @@ def run_grpo(
             base_params = shard_params(base_params, mesh, config)
             state = shard_lora_state(state, mesh, config, lora)
     else:
-        state = init_train_state(params, optimizer)
+        # real copy, not an alias: the update step donates state.params, and a
+        # donated alias would leave the CALLER's params tree pointing at
+        # deleted buffers after the first step (crashing any later host-side
+        # reuse — saving, comparing, a second run_grpo call). copy_params=False
+        # skips the extra model of HBM and consumes the caller's tree instead.
+        start = jax.tree.map(jnp.copy, params) if copy_params else params
+        state = init_train_state(start, optimizer)
         if cfg.kl_coef > 0.0:
-            # real copies, not aliases: the update step donates state.params,
-            # and donated buffers must not double as the frozen reference
             ref_params = jax.tree.map(jnp.copy, params)
         if mesh is not None:
             from prime_tpu.train.trainer import shard_train_state as _sts

@@ -165,17 +165,38 @@ def init_lora_state(adapters: dict, optimizer: optax.GradientTransformation) -> 
 
 
 def base_fingerprint(params: dict) -> list[float]:
-    """A cheap content fingerprint of the base weights (embedding-slice
-    moments). Catches the silent-corruption case the base-model *name* can't:
-    adapters trained over the local trainer's random-init base merging into a
-    real checkpoint that happens to share the config name."""
-    head = params["embed"][:256].astype(jnp.float32)
-    return [float(jnp.mean(head)), float(jnp.std(head))]
+    """A cheap content fingerprint of the base weights. Catches the
+    silent-corruption case the base-model *name* can't: adapters trained over
+    the local trainer's random-init base merging into a real checkpoint that
+    happens to share the config name. Samples leaves ACROSS the tree (embed +
+    a fixed attention and MLP slice of layer 0) so drift outside the embedding
+    — e.g. an SFT variant with frozen embeddings — still trips the check."""
+    slices = [params["embed"][:256]]
+    layers = params.get("layers", {})
+    for key in ("wq", "w_down"):
+        if key in layers:
+            slices.append(layers[key][0, :64])
+    out: list[float] = []
+    for s in slices:
+        s = s.astype(jnp.float32)
+        out += [float(jnp.mean(s)), float(jnp.std(s))]
+    return out
 
 
 def fingerprints_match(a: list[float], b: list[float], rtol: float = 1e-2) -> bool:
     """Loose comparison: bf16-vs-fp32 loads of the same checkpoint must
-    match; a random init vs a trained checkpoint must not."""
+    match; a random init vs a trained checkpoint must not.
+
+    Legacy compat: artifacts saved before the multi-leaf scheme record only
+    the 2 embedding moments — those compare against the first 2 elements of
+    a current fingerprint (embed comes first) instead of being rejected with
+    a misleading 'different base weights' diagnosis. Any other length
+    mismatch is a mismatch — zip truncation must not weaken the check."""
+    if len(a) != len(b):
+        if 2 in (len(a), len(b)) and min(len(a), len(b)) == 2:
+            a, b = a[:2], b[:2]
+        else:
+            return False
     return all(abs(x - y) <= rtol * max(abs(x), abs(y), 1e-6) for x, y in zip(a, b))
 
 

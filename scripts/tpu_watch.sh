@@ -1,0 +1,23 @@
+#!/bin/bash
+# TPU reachability watcher: probe the axon backend every ~3 min, log results.
+# When the tunnel is up, /tmp/tpu_watch.log shows "UP" lines — bench then.
+# NOTE: rc must come from `timeout python`, NOT a pipeline tail (a piped rc
+# is the last command's — it reported false UPs for a hung backend).
+LOG=/tmp/tpu_watch.log
+echo "$(date -u +%H:%M:%S) watcher start" >> "$LOG"
+while true; do
+  t0=$(date +%s)
+  out=$(timeout 200 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256,256))
+print('PROBE_OK', float(jnp.sum(x@x)), jax.devices())
+" 2>&1)
+  rc=$?
+  t1=$(date +%s)
+  if [ $rc -eq 0 ] && echo "$out" | grep -q PROBE_OK; then
+    echo "$(date -u +%H:%M:%S) UP ($((t1-t0))s): $(echo "$out" | grep PROBE_OK)" >> "$LOG"
+  else
+    echo "$(date -u +%H:%M:%S) DOWN rc=$rc ($((t1-t0))s)" >> "$LOG"
+  fi
+  sleep 160
+done

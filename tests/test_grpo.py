@@ -305,3 +305,26 @@ def test_run_grpo_lora_sharded():
         lora=LoraConfig(r=4),
     )
     assert report.steps == 1 and np.isfinite(report.final_loss)
+
+
+def test_run_grpo_does_not_consume_caller_params():
+    """ADVICE r2: run_grpo donates its TrainState internally — the CALLER's
+    params tree must stay alive and usable after the run (saving, comparing,
+    a second run), not alias deleted donated buffers."""
+    config = get_config("tiny-test")
+    params = init_params(jax.random.PRNGKey(3), config, dtype=jnp.float32)
+    tok = ByteTokenizer()
+    cfg = GrpoConfig(
+        group_size=2, prompts_per_step=1, max_prompt_len=8, max_new_tokens=4,
+        temperature=1.0, steps=1,
+    )
+    run_grpo(
+        config, params, tok,
+        examples=[{"prompt": "1+1", "answer": "2"}],
+        scorer=None,
+        cfg=cfg,
+        rng=jax.random.PRNGKey(0),
+    )
+    # any host-side use of the original tree must still work
+    total = float(jnp.sum(params["embed"]))
+    assert np.isfinite(total)

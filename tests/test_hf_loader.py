@@ -403,3 +403,63 @@ def test_gemma2_checkpoint_dir_roundtrip(tmp_path):
     params, config = load_hf_checkpoint(tmp_path / "ckpt", dtype=jnp.float32)
     assert config.tie_embeddings and "lm_head" not in params
     assert config.post_norms and config.sliding_window == 8
+
+
+def test_config_from_hf_rejects_unsupported_model_type():
+    """ADVICE r2 (medium): families sharing Llama state-dict keys but needing
+    different math (gemma v1, gemma3, phi3) must fail loudly, not load and
+    silently produce garbage logits."""
+    import pytest
+
+    from prime_tpu.models.hf_loader import config_from_hf
+
+    class Cfg:
+        vocab_size = 128
+        hidden_size = 64
+        num_hidden_layers = 2
+        num_attention_heads = 4
+        intermediate_size = 256
+
+    for bad in ("gemma", "gemma3", "phi3", "falcon"):
+        Cfg.model_type = bad
+        with pytest.raises(ValueError, match="Unsupported model_type"):
+            config_from_hf(Cfg())
+    for ok in ("llama", "mistral", "qwen2", "qwen3", "gemma2", ""):
+        Cfg.model_type = ok
+        config_from_hf(Cfg())  # must not raise
+
+
+def test_config_from_hf_mistral_uniform_sliding():
+    """Mistral v0.1-style configs slide EVERY layer — they must not inherit
+    the Gemma2 even-layer alternation (ADVICE r2, llama.py:364)."""
+    from prime_tpu.models.hf_loader import config_from_hf
+
+    class Cfg:
+        model_type = "mistral"
+        vocab_size = 128
+        hidden_size = 64
+        num_hidden_layers = 2
+        num_attention_heads = 4
+        intermediate_size = 256
+        sliding_window = 4096
+
+    config = config_from_hf(Cfg())
+    assert config.sliding_window == 4096 and config.sliding_pattern == "uniform"
+
+
+def test_unknown_sliding_pattern_raises():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from prime_tpu.models.config import ModelConfig
+    from prime_tpu.models.llama import forward, init_params
+
+    config = ModelConfig(
+        name="t", vocab_size=64, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq_len=64, sliding_window=8, sliding_pattern="every-third",
+    )
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    tokens = jnp.ones((1, 4), dtype=jnp.int32)
+    with pytest.raises(ValueError, match="sliding_pattern"):
+        forward(params, tokens, config, cache=None)

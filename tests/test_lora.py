@@ -81,7 +81,7 @@ def test_adapter_artifact_roundtrip(tmp_path, params):
     path = save_adapters(tmp_path / "art", adapters, lora, CFG, base_params=params)
     loaded, lora2, meta = load_adapters(path)
     assert meta["base_model"] == CFG.name and lora2 == lora
-    assert len(meta["base_fingerprint"]) == 2
+    assert len(meta["base_fingerprint"]) == 6  # embed + wq + w_down moments
     for a, b in zip(jax.tree.leaves(adapters), jax.tree.leaves(loaded)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     meta = json.loads((path / "adapter_config.json").read_text())
@@ -181,3 +181,28 @@ def test_adapter_fingerprint_tolerates_dtype(params):
 
     bf16 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
     assert fingerprints_match(base_fingerprint(params), base_fingerprint(bf16))
+
+
+def test_fingerprint_covers_non_embedding_drift(params):
+    """ADVICE r2: two checkpoints differing ONLY outside the embedding (e.g.
+    an SFT variant with frozen embeddings) must fingerprint-mismatch."""
+    import jax.numpy as jnp
+
+    from prime_tpu.train.lora import base_fingerprint, fingerprints_match
+
+    drifted = jax.tree.map(jnp.copy, params)
+    drifted["layers"]["w_down"] = drifted["layers"]["w_down"] + 0.5
+    assert not fingerprints_match(base_fingerprint(params), base_fingerprint(drifted))
+
+
+def test_fingerprint_length_mismatch_fails():
+    """Unknown-scheme length mismatches must fail (zip truncation must not
+    silently weaken the check) — EXCEPT the legacy 2-moment scheme, which
+    compares against the embed moments (first 2 elements) of the current
+    fingerprint so pre-existing adapter artifacts stay loadable."""
+    from prime_tpu.train.lora import fingerprints_match
+
+    assert not fingerprints_match([1.0, 2.0, 3.0, 4.0], [1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
+    # legacy 2-element artifact vs current 6-element: embed moments decide
+    assert fingerprints_match([1.0, 2.0], [1.0, 2.0, 9.0, 9.0, 9.0, 9.0])
+    assert not fingerprints_match([5.0, 2.0], [1.0, 2.0, 9.0, 9.0, 9.0, 9.0])
