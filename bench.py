@@ -54,7 +54,10 @@ def _sweep_stray_holders() -> list[str]:
     for _ in range(10):
         try:
             with open(f"/proc/{pid}/stat") as f:
-                pid = int(f.read().split()[3])
+                # comm (field 2) may itself contain spaces/parens — ppid is
+                # the 2nd field AFTER the last ')', not split()[3]
+                after_comm = f.read().rsplit(")", 1)[1].split()
+                pid = int(after_comm[1])
         except (OSError, ValueError, IndexError):
             break
         if pid <= 1:

@@ -18,6 +18,27 @@ from prime_tpu.lab.cache import LabCache
 PLATFORM_SECTIONS = ("evals", "training", "environments", "pods", "sandboxes")
 
 
+def read_jsonl(path: Path) -> list[dict[str, Any]]:
+    """Tolerant JSONL read: skip blank and unparseable lines (a mid-append
+    tail line must not discard the parsed rows). Shared by the data source
+    and the detail screens."""
+    rows: list[dict[str, Any]] = []
+    try:
+        text = path.read_text()
+    except OSError:
+        return rows
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        try:
+            loaded = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(loaded, dict):
+            rows.append(loaded)
+    return rows
+
+
 @dataclass
 class LabSnapshot:
     local_eval_runs: list[dict[str, Any]] = field(default_factory=list)
@@ -96,14 +117,7 @@ class LabDataSource:
                 if cached and cached[0] == stamp:
                     rows = cached[1]
                 else:
-                    rows = []
-                    for line in path.read_text().splitlines():
-                        if not line.strip():
-                            continue
-                        try:
-                            rows.append(json.loads(line))
-                        except json.JSONDecodeError:
-                            continue  # mid-append tail line: keep what parsed
+                    rows = read_jsonl(path)
                     self._metrics_cache[str(path)] = (stamp, rows)
             except OSError:
                 continue

@@ -7,8 +7,10 @@ state machine — ``on_key`` mutates state, ``render`` produces a rich
 renderable — so the whole shell is testable without a terminal.
 
 Key bindings: ↑/↓ or j/k move · tab/←/→ switch pane · 1-9 jump section ·
-enter select (launch section: arm, then launch) · r refresh section ·
-R refresh all · g/G top/bottom · q quit.
+enter select (launch section: arm, then launch; data sections: drill into a
+detail screen — eval sample browser, training charts/config/logs, env
+versions/actions) · r refresh section · R refresh all · g/G top/bottom ·
+q quit (esc pops a detail screen first).
 """
 
 from __future__ import annotations
@@ -17,6 +19,15 @@ from pathlib import Path
 from typing import Any
 
 from prime_tpu.lab.data import LabDataSource, LabSnapshot
+from prime_tpu.lab.tui.detail import (
+    CLOSE,
+    DetailScreen,
+    load_env_detail,
+    load_hub_eval_detail,
+    load_local_eval_detail,
+    load_local_training_detail,
+    load_platform_training_detail,
+)
 from prime_tpu.lab.tui.launch import LaunchError, launch_card, scan_cards
 
 # section key -> (title, [(column header, row dict key)...])
@@ -74,6 +85,7 @@ class PrimeLabApp:
         self.focus = "nav"  # nav | rows
         self.status = "r: refresh section · R: refresh all · q: quit"
         self.quit = False
+        self.screens: list[DetailScreen] = []  # drill-down stack; top renders
         self._armed_launch: Path | None = None
         # launch cards are rescanned at most once per input event: render()
         # reads rows() several times per frame and must not re-glob each time
@@ -112,6 +124,20 @@ class PrimeLabApp:
 
     def on_key(self, key: str) -> None:
         self._launch_rows = None  # fresh scan per input event
+        if self.screens:
+            # the top detail screen owns the keyboard ('q' still quits from
+            # anywhere unless a search input is capturing text)
+            screen = self.screens[-1]
+            if key == "q" and getattr(screen, "search_input", None) is None:
+                self.quit = True
+                return
+            result = screen.on_key(key)
+            if result == CLOSE:
+                self.screens.pop()
+                self.status = "back"
+            elif result:
+                self.status = result
+            return
         if key in ("q", "escape"):
             if self._armed_launch:
                 self._armed_launch = None
@@ -166,6 +192,7 @@ class PrimeLabApp:
             self.focus = "rows"
             return
         if self.section != "launch":
+            self._open_detail()
             return
         row = self.selected_row()
         if row is None:
@@ -195,6 +222,46 @@ class PrimeLabApp:
         except Exception as e:
             return f"launch failed: {e}"
         return f"launched {result['kind']} {result['id']} ({result['status']})"
+
+    # -- detail screens --------------------------------------------------------
+
+    def _platform_api(self):
+        """Client for hub-backed detail screens; None means offline (detail
+        screens degrade to their local data rather than crashing)."""
+        if self._api is None:
+            try:
+                import prime_tpu.commands._deps as deps
+
+                self._api = deps.build_client()
+            except Exception:  # noqa: BLE001 - missing config/offline
+                return None
+        return self._api
+
+    def _open_detail(self) -> None:
+        row = self.selected_row()
+        if row is None:
+            return
+        section = self.section
+        try:
+            if section == "local-runs":
+                screen = load_local_eval_detail(row)
+            elif section == "evals":
+                screen = load_hub_eval_detail(row, self._platform_api())
+            elif section == "local-training":
+                screen = load_local_training_detail(row)
+            elif section == "training":
+                screen = load_platform_training_detail(row, self._platform_api())
+            elif section == "environments":
+                screen = load_env_detail(
+                    row, self._platform_api(), self.snapshot.installed_envs
+                )
+            else:
+                return
+        except Exception as e:  # noqa: BLE001 - detail must not kill the shell
+            self.status = f"detail failed: {e}"[:160]
+            return
+        self.screens.append(screen)
+        self.status = f"{screen.title} · esc: back"
 
     # -- refresh --------------------------------------------------------------
 
@@ -232,6 +299,18 @@ class PrimeLabApp:
             Layout(name="body"),
             Layout(name="footer", size=1),
         )
+        if self.screens:
+            # detail screen takes the whole body; header shows the crumb trail
+            screen = self.screens[-1]
+            crumbs = " › ".join(
+                [SECTION_SPECS[self.section][0]] + [s.title for s in self.screens]
+            )
+            layout["header"].update(Text(f" PRIME LAB · {crumbs}", style="bold"))
+            layout["body"].update(
+                Panel(screen.render(), title=screen.title, border_style="dim")
+            )
+            layout["footer"].update(Text(f" {self.status}", style="dim"))
+            return layout
         layout["body"].split_row(
             Layout(name="nav", size=24),
             Layout(name="rows", ratio=2),

@@ -1,0 +1,579 @@
+"""Drill-down detail screens for the Lab shell (VERDICT r2 #3).
+
+Reference roles: prime_lab_app/eval_screen.py:1 (per-sample rollout browser
+with search/filter), training_screen.py:100 (charts + config + log tabs),
+env inspection depth from commands/env.py. Same design rule as the shell:
+every screen is a pure state machine — ``on_key`` mutates state and returns a
+status string (or CLOSE), ``render`` produces a rich renderable — so all
+navigation is testable headlessly.
+
+Screens are pushed onto ``PrimeLabApp.screens`` by enter on a row; escape /
+backspace pops. Data comes from the run dir (local rows) or the platform
+clients (hub rows), fetched once at push time and on explicit refresh — a
+detail screen must never block the render loop on the network.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Callable
+
+CLOSE = "__close__"
+
+_PAGE = 16  # text-window lines per scroll page
+
+
+def _wrap(text: str, width: int = 76) -> list[str]:
+    lines: list[str] = []
+    for raw in str(text).splitlines() or [""]:
+        while len(raw) > width:
+            lines.append(raw[:width])
+            raw = raw[width:]
+        lines.append(raw)
+    return lines
+
+
+class DetailScreen:
+    """Base: key routing shared by every detail screen."""
+
+    title = "detail"
+
+    def on_key(self, key: str) -> str | None:
+        if key in ("escape", "backspace"):
+            return CLOSE
+        return None
+
+    def render(self):  # pragma: no cover - overridden
+        from rich.text import Text
+
+        return Text("")
+
+
+class EvalSampleBrowser(DetailScreen):
+    """Per-sample prompt/completion/answer/reward browser with filter and
+    search (reference eval_screen.py RolloutViewer:560 role).
+
+    ``samples``: [{"prompt", "completion", "answer", "reward", "correct"}].
+    Keys: n/→ next · p/← prev · g/G first/last · f cycle filter
+    (all → correct → incorrect) · / incremental search (enter jumps to the
+    next match, esc cancels) · j/k scroll long sample text · esc back.
+    """
+
+    FILTERS = ("all", "correct", "incorrect")
+
+    def __init__(self, title: str, samples: list[dict[str, Any]], source: str = "") -> None:
+        self.title = title
+        self.samples = samples
+        self.source = source
+        self.idx = 0
+        self.scroll = 0
+        self.filter_mode = "all"
+        self.search = ""
+        self.search_input: str | None = None  # non-None = capturing keys
+
+    # -- sample selection ------------------------------------------------------
+
+    def visible(self) -> list[int]:
+        """Indices of samples passing the filter."""
+        if self.filter_mode == "all":
+            return list(range(len(self.samples)))
+        want = self.filter_mode == "correct"
+        return [i for i, s in enumerate(self.samples) if bool(s.get("correct")) == want]
+
+    def current(self) -> dict[str, Any] | None:
+        vis = self.visible()
+        if not vis:
+            return None
+        if self.idx not in vis:
+            self.idx, self.scroll = vis[0], 0
+        return self.samples[self.idx]
+
+    def _step(self, delta: int) -> None:
+        vis = self.visible()
+        if not vis:
+            return
+        if self.idx not in vis:
+            # cursor was filtered out: re-snap to the first visible sample
+            # (scroll reset like every other navigation, not mid-text)
+            self.idx, self.scroll = vis[0], 0
+            return
+        pos = vis.index(self.idx)
+        self.idx = vis[max(0, min(pos + delta, len(vis) - 1))]
+        self.scroll = 0
+
+    def _search_jump(self) -> str:
+        if not self.search:
+            return "empty search"
+        needle = self.search.lower()
+        vis = self.visible()
+        if not vis:
+            return "no samples"
+        start = vis.index(self.idx) if self.idx in vis else 0
+        order = vis[start + 1 :] + vis[: start + 1]  # wrap, current last
+        for i in order:
+            s = self.samples[i]
+            hay = f"{s.get('prompt', '')} {s.get('completion', '')} {s.get('answer', '')}"
+            if needle in hay.lower():
+                self.idx = i
+                self.scroll = 0
+                return f"match at sample {i + 1}/{len(self.samples)}"
+        return f"no match for {self.search!r}"
+
+    def on_key(self, key: str) -> str | None:
+        if self.search_input is not None:
+            if key == "enter":
+                self.search = self.search_input
+                self.search_input = None
+                return self._search_jump()
+            if key == "escape":
+                self.search_input = None
+                return "search cancelled"
+            if key == "backspace":
+                self.search_input = self.search_input[:-1]
+            elif len(key) == 1 and key.isprintable():
+                self.search_input += key
+            return f"search: {self.search_input}"
+        if key in ("n", "right", "down"):
+            self._step(+1)
+        elif key in ("p", "left", "up"):
+            self._step(-1)
+        elif key == "g":
+            vis = self.visible()
+            if vis:
+                self.idx, self.scroll = vis[0], 0
+        elif key == "G":
+            vis = self.visible()
+            if vis:
+                self.idx, self.scroll = vis[-1], 0
+        elif key == "f":
+            pos = self.FILTERS.index(self.filter_mode)
+            self.filter_mode = self.FILTERS[(pos + 1) % len(self.FILTERS)]
+            return f"filter: {self.filter_mode} ({len(self.visible())} samples)"
+        elif key == "/":
+            self.search_input = ""
+            return "search: "
+        elif key == "j":
+            self.scroll += _PAGE // 2
+        elif key == "k":
+            self.scroll = max(0, self.scroll - _PAGE // 2)
+        else:
+            return super().on_key(key)
+        return None
+
+    def render(self):
+        from rich.console import Group
+        from rich.table import Table
+        from rich.text import Text
+
+        sample = self.current()
+        vis = self.visible()
+        if sample is None:
+            return Text(f"(no {self.filter_mode} samples)", style="dim")
+        pos = vis.index(self.idx) + 1
+
+        head = Table.grid(padding=(0, 1))
+        reward = sample.get("reward")
+        head.add_row(
+            Text(f"sample {pos}/{len(vis)}", style="bold"),
+            Text(f"filter={self.filter_mode}", style="dim"),
+            Text(
+                f"reward={reward:.3f}" if isinstance(reward, (int, float)) else "reward=—",
+                style="green" if sample.get("correct") else "red",
+            ),
+            Text(f"search={self.search!r}" if self.search else "", style="dim"),
+        )
+
+        body_lines: list[tuple[str, str]] = []  # (style, line)
+        for label, key in (("PROMPT", "prompt"), ("COMPLETION", "completion"), ("ANSWER", "answer")):
+            body_lines.append(("bold cyan", f"── {label} " + "─" * 40))
+            for line in _wrap(sample.get(key, "")):
+                body_lines.append(("", line))
+        window = body_lines[self.scroll : self.scroll + _PAGE]
+        if self.scroll and not window:
+            self.scroll = max(0, len(body_lines) - _PAGE)
+            window = body_lines[self.scroll :]
+        text = Text()
+        for style, line in window:
+            text.append(line + "\n", style=style or None)
+        if len(body_lines) > self.scroll + _PAGE:
+            text.append(f"… {len(body_lines) - self.scroll - _PAGE} more lines (j/k)", style="dim")
+        footer = Text(
+            "n/p sample · f filter · / search · j/k scroll · esc back",
+            style="dim",
+        )
+        if self.search_input is not None:
+            footer = Text(f"search: {self.search_input}▌", style="bold")
+        return Group(head, Text(""), text, Text(""), footer)
+
+
+class TrainingRunDetail(DetailScreen):
+    """Charts + config + log tail for one training run (reference
+    training_screen.py:100 role). Tabs: chart / config / logs.
+
+    Keys: tab or h/l cycle tabs · c cycle charted metric · j/k scroll logs ·
+    r reload from source · esc back.
+    """
+
+    TABS = ("chart", "config", "logs")
+
+    def __init__(
+        self,
+        title: str,
+        metrics: list[dict[str, Any]],
+        config: dict[str, Any] | None = None,
+        log_tail: Callable[[], list[str]] | None = None,
+        reload: Callable[[], list[dict[str, Any]]] | None = None,
+    ) -> None:
+        self.title = title
+        self.metrics = metrics
+        self.config = config or {}
+        self._log_tail = log_tail
+        self._reload = reload
+        self.tab = "chart"
+        self.metric_idx = 0
+        self.log_scroll = 0
+        self._logs: list[str] | None = None
+
+    def metric_keys(self) -> list[str]:
+        keys: list[str] = []
+        for row in self.metrics:
+            for key, value in row.items():
+                if key not in keys and isinstance(value, (int, float)) and key != "step":
+                    keys.append(key)
+        return keys
+
+    def logs(self) -> list[str]:
+        if self._logs is None:
+            self._logs = self._log_tail() if self._log_tail else []
+        return self._logs
+
+    def on_key(self, key: str) -> str | None:
+        if key in ("tab", "l"):
+            self.tab = self.TABS[(self.TABS.index(self.tab) + 1) % len(self.TABS)]
+            return f"tab: {self.tab}"
+        if key == "h":
+            self.tab = self.TABS[(self.TABS.index(self.tab) - 1) % len(self.TABS)]
+            return f"tab: {self.tab}"
+        if key == "c" and self.tab == "chart":
+            keys = self.metric_keys()
+            if keys:
+                self.metric_idx = (self.metric_idx + 1) % len(keys)
+                return f"metric: {keys[self.metric_idx]}"
+        if key == "j" and self.tab == "logs":
+            self.log_scroll += _PAGE // 2
+            return None
+        if key == "k" and self.tab == "logs":
+            self.log_scroll = max(0, self.log_scroll - _PAGE // 2)
+            return None
+        if key == "r":
+            if self._reload:
+                self.metrics = self._reload()
+            self._logs = None
+            return "reloaded"
+        return super().on_key(key)
+
+    def render(self):
+        from rich.console import Group
+        from rich.table import Table
+        from rich.text import Text
+
+        tabs = Text()
+        for name in self.TABS:
+            tabs.append(
+                f" {name} ", style="reverse" if name == self.tab else "dim"
+            )
+        parts: list[Any] = [tabs, Text("")]
+
+        if self.tab == "chart":
+            from prime_tpu.lab.tui.charts import metric_chart
+
+            keys = self.metric_keys()
+            if not keys:
+                parts.append(Text("(no numeric metrics)", style="dim"))
+            else:
+                self.metric_idx = min(self.metric_idx, len(keys) - 1)
+                focused = keys[self.metric_idx]
+                for key in [focused] + [k for k in keys if k != focused]:
+                    line = metric_chart(self.metrics, key, width=64)
+                    if line:
+                        style = "bold" if key == focused else None
+                        parts.append(Text(line, style=style, no_wrap=True, overflow="crop"))
+                last = self.metrics[-1] if self.metrics else {}
+                parts.append(Text(""))
+                parts.append(
+                    Text(
+                        " · ".join(
+                            f"{k}={last[k]:.4g}" for k in keys if isinstance(last.get(k), (int, float))
+                        ),
+                        style="dim",
+                    )
+                )
+        elif self.tab == "config":
+            if not self.config:
+                parts.append(Text("(no config recorded)", style="dim"))
+            else:
+                grid = Table.grid(padding=(0, 1))
+                for key, value in sorted(self.config.items()):
+                    rendered = (
+                        json.dumps(value) if isinstance(value, (dict, list)) else str(value)
+                    )
+                    grid.add_row(Text(str(key), style="dim"), Text(rendered[:80]))
+                parts.append(grid)
+        else:
+            lines = self.logs()
+            if not lines:
+                parts.append(Text("(no logs)", style="dim"))
+            else:
+                window = lines[self.log_scroll : self.log_scroll + _PAGE]
+                if self.log_scroll and not window:
+                    self.log_scroll = max(0, len(lines) - _PAGE)
+                    window = lines[self.log_scroll :]
+                text = Text()
+                for line in window:
+                    text.append(line[:100] + "\n")
+                if len(lines) > self.log_scroll + _PAGE:
+                    text.append(
+                        f"… {len(lines) - self.log_scroll - _PAGE} more (j/k)", style="dim"
+                    )
+                parts.append(text)
+
+        parts.append(Text(""))
+        parts.append(Text("tab/h/l tabs · c metric · j/k scroll · r reload · esc back", style="dim"))
+        return Group(*parts)
+
+
+class EnvDetail(DetailScreen):
+    """Versions + actions for one environment (reference env inspect /
+    versions / actions depth). Cursor moves over the action list; enter
+    fetches that action's logs inline.
+
+    Keys: j/k move · enter action logs · r refresh · esc back.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        versions: list[dict[str, Any]],
+        actions: list[dict[str, Any]],
+        fetch_logs: Callable[[str], list[str]] | None = None,
+        error: str = "",
+    ) -> None:
+        self.title = f"env: {name}"
+        self.name = name
+        self.versions = versions
+        self.actions = actions
+        self._fetch_logs = fetch_logs
+        self.error = error
+        self.cursor = 0
+        self.logs: list[str] | None = None
+        self.logs_for: str | None = None
+
+    def on_key(self, key: str) -> str | None:
+        if key in ("j", "down"):
+            self.cursor = min(self.cursor + 1, max(len(self.actions) - 1, 0))
+        elif key in ("k", "up"):
+            self.cursor = max(0, self.cursor - 1)
+        elif key == "enter" and self.actions:
+            action = self.actions[min(self.cursor, len(self.actions) - 1)]
+            action_id = str(action.get("id") or action.get("actionId") or "")
+            if not action_id:
+                return "action has no id"
+            if self._fetch_logs is None:
+                return "no log fetcher (offline)"
+            try:
+                self.logs = self._fetch_logs(action_id)
+                self.logs_for = action_id
+            except Exception as e:  # noqa: BLE001 - network surface
+                return f"logs failed: {e}"
+            return f"logs for {action_id}"
+        else:
+            return super().on_key(key)
+        return None
+
+    def render(self):
+        from rich.console import Group
+        from rich.table import Table
+        from rich.text import Text
+
+        parts: list[Any] = []
+        if self.error:
+            parts.append(Text(f"hub fetch failed: {self.error}", style="red"))
+            parts.append(Text(""))
+        versions = Table(title="versions", expand=True, pad_edge=False)
+        for header in ("VERSION", "CREATED", "STATUS"):
+            versions.add_column(header, overflow="ellipsis", no_wrap=True)
+        for v in self.versions[:8]:
+            versions.add_row(
+                str(v.get("version", "—")),
+                str(v.get("createdAt", v.get("created_at", "—"))),
+                str(v.get("status", "—")),
+            )
+        if not self.versions:
+            parts.append(Text("(no versions)", style="dim"))
+        else:
+            parts.append(versions)
+
+        actions = Table(title="actions", expand=True, pad_edge=False)
+        for header in ("ID", "KIND", "STATUS"):
+            actions.add_column(header, overflow="ellipsis", no_wrap=True)
+        for index, a in enumerate(self.actions[:12]):
+            style = "reverse" if index == min(self.cursor, len(self.actions) - 1) else ""
+            actions.add_row(
+                str(a.get("id", a.get("actionId", "—"))),
+                str(a.get("kind", a.get("type", "—"))),
+                str(a.get("status", "—")),
+                style=style,
+            )
+        if self.actions:
+            parts.append(actions)
+        else:
+            parts.append(Text("(no actions)", style="dim"))
+
+        if self.logs is not None:
+            parts.append(Text(f"── logs: {self.logs_for} " + "─" * 30, style="bold cyan"))
+            text = Text()
+            for line in self.logs[-_PAGE:]:
+                text.append(line[:100] + "\n")
+            parts.append(text if self.logs else Text("(empty)", style="dim"))
+
+        parts.append(Text("j/k move · enter action logs · esc back", style="dim"))
+        return Group(*parts)
+
+
+# -- constructors from app rows (data loading happens HERE, once) -------------
+
+
+def load_local_eval_detail(row: dict[str, Any]) -> EvalSampleBrowser:
+    """results.jsonl from a local run dir → sample browser."""
+    from prime_tpu.lab.data import read_jsonl
+
+    run_dir = Path(row.get("dir", ""))
+    samples = read_jsonl(run_dir / "results.jsonl")
+    return EvalSampleBrowser(
+        title=f"eval: {row.get('env', '?')}/{row.get('runId', '?')}",
+        samples=samples,
+        source=str(run_dir),
+    )
+
+
+def load_hub_eval_detail(row: dict[str, Any], api) -> EvalSampleBrowser:
+    """Evals Hub samples for one evaluation → sample browser."""
+    from prime_tpu.evals import EvalsClient
+
+    eval_id = str(row.get("evalId", row.get("id", "")))
+    samples: list[dict[str, Any]] = []
+    error = ""
+    try:
+        fetched = EvalsClient(api).get_samples(eval_id, limit=200)
+        samples = [s.model_dump(by_alias=True, exclude_none=True) for s in fetched]
+    except Exception as e:  # noqa: BLE001 - network surface
+        error = str(e)
+    browser = EvalSampleBrowser(title=f"eval: {eval_id}", samples=samples, source="hub")
+    if error:
+        browser.title += f" (fetch failed: {error[:60]})"
+    return browser
+
+
+def load_local_training_detail(row: dict[str, Any]) -> TrainingRunDetail:
+    """metrics.jsonl rows (+ config.json / train.log when present)."""
+    run_dir = Path(row.get("dir", ""))
+    config: dict[str, Any] = {}
+    for name in ("config.json", "run_config.json"):
+        path = run_dir / name
+        if path.exists():
+            try:
+                loaded = json.loads(path.read_text())
+                if isinstance(loaded, dict):
+                    config = loaded
+                    break
+            except json.JSONDecodeError:
+                pass
+
+    def log_tail() -> list[str]:
+        for name in ("train.log", "logs.txt"):
+            path = run_dir / name
+            if path.exists():
+                return path.read_text().splitlines()[-400:]
+        return []
+
+    def reload() -> list[dict[str, Any]]:
+        from prime_tpu.lab.data import read_jsonl
+
+        return read_jsonl(run_dir / "metrics.jsonl") or row.get("metrics", [])
+
+    return TrainingRunDetail(
+        title=f"training: {row.get('run', run_dir.name)}",
+        metrics=row.get("metrics", []),
+        config=config,
+        log_tail=log_tail,
+        reload=reload,
+    )
+
+
+def load_platform_training_detail(row: dict[str, Any], api) -> TrainingRunDetail:
+    """RL run detail via the platform clients: metrics history + logs."""
+    from prime_tpu.api.rl import RLClient
+
+    run_id = str(row.get("runId", row.get("id", "")))
+    client = RLClient(api)
+    metrics_rows: list[dict[str, Any]] = []
+    config: dict[str, Any] = dict(row)
+    try:
+        fetched = client.metrics(run_id)
+        # accept both {"history": [...]} and {metric: [values...]} shapes
+        if isinstance(fetched.get("history"), list):
+            metrics_rows = [r for r in fetched["history"] if isinstance(r, dict)]
+        else:
+            series = {
+                k: v for k, v in fetched.items() if isinstance(v, list) and v
+            }
+            length = max((len(v) for v in series.values()), default=0)
+            for i in range(length):
+                metrics_rows.append(
+                    {k: v[i] for k, v in series.items() if i < len(v) and isinstance(v[i], (int, float))}
+                )
+    except Exception as e:  # noqa: BLE001 - network surface
+        config["metricsError"] = str(e)
+
+    def log_tail() -> list[str]:
+        try:
+            items = client.get_logs(run_id, limit=200)
+            return [
+                str(item.get("message", item)) if isinstance(item, dict) else str(item)
+                for item in items
+            ]
+        except Exception as e:  # noqa: BLE001
+            return [f"(logs failed: {e})"]
+
+    return TrainingRunDetail(
+        title=f"training: {run_id}",
+        metrics=metrics_rows,
+        config=config,
+        log_tail=log_tail,
+    )
+
+
+def load_env_detail(row: dict[str, Any], api, installed: dict[str, Any]) -> EnvDetail:
+    """Hub versions/actions (when reachable) + local install state."""
+    name = str(row.get("name", ""))
+    versions: list[dict[str, Any]] = []
+    actions: list[dict[str, Any]] = []
+    fetch_logs = None
+    error = ""
+    if api is not None:
+        from prime_tpu.envhub import EnvHubClient
+
+        client = EnvHubClient(api)
+        try:
+            versions = client.versions(name)
+            actions = client.actions(name)
+            fetch_logs = lambda action_id: client.action_logs(name, action_id)  # noqa: E731
+        except Exception as e:  # noqa: BLE001 - network surface
+            error = str(e)
+    local = installed.get(name)
+    if isinstance(local, dict):
+        versions = [
+            {"version": local.get("version", "installed"), "status": "installed locally"}
+        ] + versions
+    return EnvDetail(name, versions, actions, fetch_logs=fetch_logs, error=error)

@@ -37,6 +37,7 @@ def _local_run(tmp_path, env="gsm8k", model="m1", run="r1", accuracy=0.5):
     (run_dir / "metadata.json").write_text(
         json.dumps({"metrics": {"accuracy": accuracy, "num_samples": 4}})
     )
+    return run_dir
 
 
 # -- key decoding -------------------------------------------------------------
@@ -346,3 +347,134 @@ def test_eval_tui_requires_tty(fake, monkeypatch):
     result = CliRunner().invoke(cli, ["eval", "tui"])
     assert result.exit_code != 0
     assert "interactive terminal" in result.output
+
+
+# -- detail screens (VERDICT r2 #3: section -> row -> detail -> back) ---------
+
+
+def _run_with_samples(tmp_path, n=4):
+    run_dir = _local_run(tmp_path)
+    with open(run_dir / "results.jsonl", "w") as f:
+        for i in range(n):
+            f.write(
+                json.dumps(
+                    {
+                        "prompt": f"what is {i}+{i}?",
+                        "completion": str(2 * i),
+                        "answer": str(2 * i) if i % 2 == 0 else "nope",
+                        "reward": 1.0 if i % 2 == 0 else 0.0,
+                        "correct": i % 2 == 0,
+                    }
+                )
+                + "\n"
+            )
+    return run_dir
+
+
+def test_eval_detail_drilldown_and_back(app, tmp_path):
+    _run_with_samples(tmp_path)
+    app.tick()
+    app.on_key("1")          # local-runs section, rows focus
+    app.on_key("enter")      # drill into sample browser
+    assert app.screens and "eval:" in app.screens[-1].title
+    text = render_text(app)
+    assert "sample 1/4" in text and "what is 0+0?" in text
+    app.on_key("n")          # next sample
+    assert "sample 2/4" in render_text(app)
+    app.on_key("escape")     # back to the shell
+    assert not app.screens
+    assert "Local eval runs" in render_text(app)
+
+
+def test_eval_detail_filter_and_search(app, tmp_path):
+    _run_with_samples(tmp_path)
+    app.tick()
+    app.on_key("1")
+    app.on_key("enter")
+    browser = app.screens[-1]
+    app.on_key("f")          # all -> correct
+    assert browser.filter_mode == "correct" and len(browser.visible()) == 2
+    app.on_key("f")          # correct -> incorrect
+    assert browser.filter_mode == "incorrect"
+    assert all(not browser.samples[i]["correct"] for i in browser.visible())
+    app.on_key("f")          # back to all
+    for ch in ("/", "3", "+", "3"):
+        app.on_key(ch)
+    app.on_key("enter")      # jump to the sample containing "3+3"
+    assert browser.samples[browser.idx]["prompt"] == "what is 3+3?"
+    # 'q' during search input types a literal q instead of quitting
+    app.on_key("/")
+    app.on_key("q")
+    assert not app.quit and browser.search_input == "q"
+    app.on_key("escape")     # cancel search input
+    assert browser.search_input is None and app.screens
+
+
+def test_training_detail_tabs_and_reload(app, tmp_path):
+    run_dir = tmp_path / "outputs" / "train" / "run1"
+    run_dir.mkdir(parents=True)
+    with open(run_dir / "metrics.jsonl", "w") as f:
+        for step in range(6):
+            f.write(json.dumps({"step": step, "loss": 3.0 - step * 0.3, "tokens_per_sec": 900.0 + step}) + "\n")
+    (run_dir / "config.json").write_text(json.dumps({"model": "tiny-test", "lr": 3e-4}))
+    (run_dir / "train.log").write_text("line-a\nline-b\n")
+    app.tick()
+    app.on_key("2")          # local-training
+    app.on_key("enter")
+    assert app.screens and "training:" in app.screens[-1].title
+    text = render_text(app)
+    assert "loss" in text   # chart tab renders metric sparkline
+    app.on_key("tab")        # -> config
+    text = render_text(app)
+    assert "tiny-test" in text and "lr" in text
+    app.on_key("tab")        # -> logs
+    text = render_text(app)
+    assert "line-a" in text and "line-b" in text
+    app.on_key("r")          # reload does not crash and keeps metrics
+    assert app.screens[-1].metrics
+    app.on_key("escape")
+    assert not app.screens
+
+
+def test_hub_eval_detail_fetches_samples(app, fake, api):
+    from prime_tpu.evals import EvalsClient
+    from prime_tpu.evals.models import CreateEvaluationRequest
+
+    client = EvalsClient(api)
+    ev = client.create_evaluation(CreateEvaluationRequest(env="gsm8k", model="m1"))
+    client.push_samples(
+        ev.eval_id,
+        [
+            {"sample_id": "s0", "prompt": "p0", "completion": "c0", "reward": 1.0, "correct": True},
+            {"sample_id": "s1", "prompt": "p1", "completion": "c1", "reward": 0.0, "correct": False},
+        ],
+    )
+    app.refresh_all()
+    app.on_key("3")          # evals hub section
+    app.on_key("enter")
+    assert app.screens
+    text = render_text(app)
+    assert "sample 1/2" in text and "p0" in text
+
+
+def test_env_detail_versions_and_actions(app, fake, api, tmp_path):
+    fake.envhub_plane.environments["arith"] = {
+        "name": "arith",
+        "versions": ["0.1.0", "0.2.0"],
+        "owner": "dev",
+        "visibility": "private",
+    }
+    fake.envhub_plane.actions["arith"] = [
+        {"id": "act_1", "kind": "build", "status": "completed", "logs": ["built ok"]}
+    ]
+    app.refresh_all()
+    app.on_key("5")          # environments
+    app.on_key("enter")
+    assert app.screens and app.screens[-1].title == "env: arith"
+    text = render_text(app)
+    assert "0.2.0" in text and "act_1" in text
+    app.on_key("enter")      # fetch logs for the selected action
+    text = render_text(app)
+    assert "built ok" in text
+    app.on_key("escape")
+    assert not app.screens
