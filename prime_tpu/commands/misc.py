@@ -146,10 +146,13 @@ def lab_mcp(workspace: str) -> None:
 @click.argument("prompt_text", metavar="PROMPT")
 @click.option("--command", "agent_command", required=True,
               help="Agent server command line (spawned as a subprocess).")
-@click.option("--dialect", type=click.Choice(["simple", "acp"]), default="acp")
+@click.option(
+    "--dialect", type=click.Choice(["simple", "acp", "codex", "letta"]), default="acp"
+)
 @click.option("--timeout", "timeout_s", type=float, default=120.0)
 def lab_agent(prompt_text: str, agent_command: str, dialect: str, timeout_s: float) -> None:
-    """One chat turn against a stdio agent (ACP or simple JSONL dialect)."""
+    """One chat turn against a stdio agent (ACP / Codex app-server / Letta /
+    simple JSONL dialect). Widget tool calls print as [widget:NAME] lines."""
     import shlex
 
     from prime_tpu.lab.agents import AgentError, AgentRuntime
@@ -158,7 +161,10 @@ def lab_agent(prompt_text: str, agent_command: str, dialect: str, timeout_s: flo
     try:
         with runtime:
             for event in runtime.prompt(prompt_text, timeout_s=timeout_s):
-                click.echo(event.text, nl=False)
+                if event.kind == "widget" and event.widget:
+                    click.echo(f"\n[widget:{event.widget['name']}] {event.widget['args']}")
+                else:
+                    click.echo(event.text, nl=False)
         click.echo()
     except AgentError as e:
         raise click.ClickException(str(e)) from None

@@ -4,16 +4,26 @@ Reference: prime_lab_app/agent_runtime.py:66 — an embedded chat runtime that
 owns one agent server process per workspace and speaks ACP / Codex
 app-server / Letta dialects over stdio. This implementation keeps the same
 architecture (spawn → initialize → prompt → streamed events → close) with a
-dialect table mapping the three wire shapes onto one driver:
+dialect table mapping the four wire shapes onto one driver:
 
 - ``acp``    — JSON-RPC 2.0: ``initialize`` → ``session/new`` →
   ``session/prompt``; streamed ``session/update`` notifications carry chunks.
+- ``codex``  — Codex app-server JSON-RPC (agent_runtime.py:629): ``initialize``
+  → ``thread/start`` → ``turn/start``; ``item/agentMessage/delta``
+  notifications stream text, ``turn/completed`` ends the turn. Lab widget
+  tools ride ``thread/start.dynamicTools``.
+- ``letta``  — Letta bidirectional JSONL (agent_runtime.py:543): typed
+  messages (``user`` / ``assistant`` / ``result`` / ``control_request``);
+  the client auto-approves ``can_use_tool`` control requests and registers
+  the widget tools via ``register_external_tools``.
 - ``simple`` — bare JSONL turns: ``{"type": "prompt", ...}`` in,
   ``{"type": "chunk"|"done", ...}`` out (what our test agents speak, and a
   sane target for custom agents).
 
 The stdout reader runs on a thread pushing events into a queue; callers
-iterate :meth:`AgentRuntime.prompt` to stream a turn's chunks.
+iterate :meth:`AgentRuntime.prompt` to stream a turn's chunks. Widget tool
+calls surface as ``widget`` events carrying the parsed call (name + args) —
+the TUI renders them natively (lab/widgets.py).
 """
 
 from __future__ import annotations
@@ -33,15 +43,23 @@ class AgentError(RuntimeError):
 
 @dataclass
 class AgentEvent:
-    kind: str          # chunk | done | error | log
+    kind: str          # chunk | done | error | log | widget
     text: str = ""
     raw: dict | None = None
+    widget: dict | None = None   # {"name": ..., "args": {...}} for kind=widget
 
 
 class _Dialect:
-    """Wire-shape hooks; every method is pure message construction/parsing."""
+    """Wire-shape hooks; every method is pure message construction/parsing
+    except ``auto_reply`` (protocol-mandated responses the reader thread
+    writes back, e.g. Letta tool-permission grants)."""
 
     name = "simple"
+    needs_handshake = False  # True: wait for session/thread id before prompts
+
+    def __init__(self, cwd: str | None = None) -> None:
+        self.cwd = cwd
+        self.session_id: str | None = None
 
     def initialize_msgs(self) -> list[dict]:
         return []
@@ -57,16 +75,25 @@ class _Dialect:
             return AgentEvent("done", raw=msg)
         if kind == "error":
             return AgentEvent("error", text=str(msg.get("message", "")), raw=msg)
+        if kind == "widget":
+            return AgentEvent(
+                "widget",
+                raw=msg,
+                widget={"name": str(msg.get("name", "")), "args": msg.get("args", {}) or {}},
+            )
         return AgentEvent("log", raw=msg)
+
+    def auto_reply(self, msg: dict) -> dict | None:
+        """A message the client must answer on the wire (reader thread sends
+        it before the event reaches the consumer)."""
+        return None
 
 
 class _AcpDialect(_Dialect):
     """ACP-flavored JSON-RPC 2.0 (initialize / session/new / session/prompt)."""
 
     name = "acp"
-
-    def __init__(self) -> None:
-        self.session_id: str | None = None
+    needs_handshake = True
 
     def initialize_msgs(self) -> list[dict]:
         return [
@@ -103,7 +130,168 @@ class _AcpDialect(_Dialect):
         return AgentEvent("log", raw=msg)
 
 
-DIALECTS = {"simple": _Dialect, "acp": _AcpDialect}
+class _CodexDialect(_Dialect):
+    """Codex app-server JSON-RPC (reference agent_runtime.py:629-668,863-1012):
+    ``initialize`` → ``thread/start`` (carrying the Lab widget tools as
+    ``dynamicTools``) → per-prompt ``turn/start``. Streaming notifications:
+    ``item/agentMessage/delta`` (text), ``item/tool/call`` (widget calls),
+    ``turn/completed`` (turn end, possibly with an error)."""
+
+    name = "codex"
+    needs_handshake = True
+
+    def initialize_msgs(self) -> list[dict]:
+        from prime_tpu.lab.widgets import widget_tool_specs
+
+        return [
+            {"jsonrpc": "2.0", "id": 1, "method": "initialize",
+             "params": {"clientInfo": {"name": "prime-lab"},
+                        "capabilities": {"experimentalApi": True}}},
+            {"jsonrpc": "2.0", "id": 2, "method": "thread/start",
+             "params": {"cwd": self.cwd, "dynamicTools": widget_tool_specs()}},
+        ]
+
+    def prompt_msg(self, text: str, msg_id: int) -> dict:
+        return {
+            "jsonrpc": "2.0",
+            "id": msg_id,
+            "method": "turn/start",
+            "params": {
+                "threadId": self.session_id,
+                "cwd": self.cwd,
+                "input": [{"type": "text", "text": text}],
+            },
+        }
+
+    def parse(self, msg: dict) -> AgentEvent | None:
+        method = msg.get("method")
+        params = msg.get("params", {}) if isinstance(msg.get("params"), dict) else {}
+        if method == "item/agentMessage/delta":
+            return AgentEvent("chunk", text=str(params.get("delta", "")), raw=msg)
+        if method == "item/tool/call":
+            return AgentEvent(
+                "widget",
+                raw=msg,
+                widget={
+                    "name": str(params.get("name", params.get("tool", ""))),
+                    "args": params.get("arguments", params.get("args", {})) or {},
+                },
+            )
+        if method == "turn/completed":
+            turn = params.get("turn", {})
+            error = turn.get("error") if isinstance(turn, dict) else None
+            if isinstance(error, dict):
+                return AgentEvent(
+                    "error", text=str(error.get("message", "codex turn failed")), raw=msg
+                )
+            return AgentEvent("done", raw=msg)
+        if "result" in msg:
+            result = msg.get("result") or {}
+            thread = result.get("thread") if isinstance(result, dict) else None
+            if isinstance(thread, dict) and thread.get("id"):
+                self.session_id = str(thread["id"])
+            return AgentEvent("log", raw=msg)
+        if "error" in msg:
+            return AgentEvent("error", text=str(msg["error"].get("message", "")), raw=msg)
+        return AgentEvent("log", raw=msg)
+
+    def auto_reply(self, msg: dict) -> dict | None:
+        # a tool call sent as a REQUEST (with an id) awaits a JSON-RPC result;
+        # without an ack the server blocks on the call and the turn never
+        # completes (same hazard the Letta path documents)
+        if msg.get("method") == "item/tool/call" and msg.get("id") is not None:
+            return {"jsonrpc": "2.0", "id": msg["id"], "result": {"status": "rendered"}}
+        return None
+
+
+class _LettaDialect(_Dialect):
+    """Letta bidirectional JSONL (reference agent_runtime.py:543-560,727-800):
+    typed messages, not JSON-RPC. The client registers the widget tools as
+    external tools at startup and auto-approves ``can_use_tool`` requests;
+    ``execute_external_tool`` requests surface as widget events (the TUI
+    renders them) while the wire reply acknowledges receipt."""
+
+    name = "letta"
+
+    def initialize_msgs(self) -> list[dict]:
+        from prime_tpu.lab.widgets import letta_external_tools
+
+        return [
+            {"type": "control_request", "request_id": "prime-lab-init",
+             "request": {"subtype": "initialize"}},
+            {"type": "control_request", "request_id": "prime-lab-tools",
+             "request": {"subtype": "register_external_tools",
+                         "tools": letta_external_tools()}},
+        ]
+
+    def prompt_msg(self, text: str, msg_id: int) -> dict:
+        return {"type": "user", "message": {"role": "user", "content": text}}
+
+    def parse(self, msg: dict) -> AgentEvent | None:
+        kind = msg.get("type")
+        if kind == "system":
+            session = msg.get("session_id") or msg.get("sessionId")
+            if session:
+                self.session_id = str(session)
+            return AgentEvent("log", raw=msg)
+        if kind == "assistant":
+            message = msg.get("message", {})
+            content = message.get("content") if isinstance(message, dict) else None
+            if isinstance(content, list):
+                text = "".join(
+                    str(part.get("text", ""))
+                    for part in content
+                    if isinstance(part, dict) and part.get("type") == "text"
+                )
+            else:
+                text = str(content or "")
+            return AgentEvent("chunk", text=text, raw=msg)
+        if kind == "result":
+            return AgentEvent("done", raw=msg)
+        if kind == "error":
+            return AgentEvent("error", text=str(msg.get("message", "")), raw=msg)
+        if kind == "control_request":
+            request = msg.get("request", {})
+            if isinstance(request, dict) and request.get("subtype") == "execute_external_tool":
+                return AgentEvent(
+                    "widget",
+                    raw=msg,
+                    widget={
+                        "name": str(request.get("tool_name", request.get("name", ""))),
+                        "args": request.get("arguments", request.get("args", {})) or {},
+                    },
+                )
+            return AgentEvent("log", raw=msg)
+        return AgentEvent("log", raw=msg)
+
+    def auto_reply(self, msg: dict) -> dict | None:
+        if msg.get("type") != "control_request":
+            return None
+        request = msg.get("request", {})
+        subtype = request.get("subtype") if isinstance(request, dict) else None
+        if subtype == "can_use_tool":
+            return {
+                "type": "control_response",
+                "request_id": str(msg.get("request_id", "")),
+                "response": {"subtype": "success", "response": {"behavior": "allow"}},
+            }
+        if subtype == "execute_external_tool":
+            # the widget event renders in the TUI; the wire gets an ack so the
+            # agent's tool call resolves instead of hanging
+            return {
+                "type": "control_response",
+                "request_id": str(msg.get("request_id", "")),
+                "response": {"subtype": "success", "response": {"status": "rendered"}},
+            }
+        return None
+
+
+DIALECTS = {
+    "simple": _Dialect,
+    "acp": _AcpDialect,
+    "codex": _CodexDialect,
+    "letta": _LettaDialect,
+}
 
 
 class AgentRuntime:
@@ -119,12 +307,15 @@ class AgentRuntime:
         if dialect not in DIALECTS:
             raise AgentError(f"unknown dialect {dialect!r}; choose from {sorted(DIALECTS)}")
         self.command = command
-        self.dialect = DIALECTS[dialect]()
+        self.dialect = DIALECTS[dialect](cwd=cwd)
         self._cwd = cwd
         self._env = env
         self.process: subprocess.Popen | None = None
         self._events: queue.Queue[AgentEvent | None] = queue.Queue()
         self._msg_id = 10
+        # the reader thread writes auto-replies on the same stdin the prompt
+        # thread writes turns on — unserialized writes can interleave frames
+        self._stdin_lock = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -149,8 +340,9 @@ class AgentRuntime:
         threading.Thread(target=self._read_stdout, daemon=True).start()
         for msg in self.dialect.initialize_msgs():
             self._send(msg)
-        # ACP: wait for the session id before accepting prompts
-        if isinstance(self.dialect, _AcpDialect):
+        # handshake dialects (acp: session id, codex: thread id) must not
+        # accept prompts until the id arrives
+        if self.dialect.needs_handshake:
             deadline = time.monotonic() + timeout_s
             while self.dialect.session_id is None:
                 if time.monotonic() > deadline:
@@ -163,7 +355,8 @@ class AgentRuntime:
                 time.sleep(0.02)
 
     def prompt(self, text: str, timeout_s: float = 120.0) -> Iterator[AgentEvent]:
-        """Send one user turn; yield chunk events until the turn completes."""
+        """Send one user turn; yield chunk + widget events until the turn
+        completes."""
         if self.process is None or self.process.poll() is not None:
             raise AgentError("agent is not running")
         # drain leftovers from an abandoned/timed-out turn so this turn never
@@ -195,7 +388,7 @@ class AgentRuntime:
                 raise AgentError(event.text or "agent error")
             if event.kind == "done":
                 return
-            if event.kind == "chunk":
+            if event.kind in ("chunk", "widget"):
                 yield event
 
     def chat(self, text: str, timeout_s: float = 120.0) -> str:
@@ -232,8 +425,9 @@ class AgentRuntime:
     def _send(self, msg: dict) -> None:
         assert self.process is not None and self.process.stdin is not None
         try:
-            self.process.stdin.write(json.dumps(msg) + "\n")
-            self.process.stdin.flush()
+            with self._stdin_lock:
+                self.process.stdin.write(json.dumps(msg) + "\n")
+                self.process.stdin.flush()
         except (OSError, ValueError) as e:
             raise AgentError(f"agent stdin write failed: {e}") from e
 
@@ -253,10 +447,17 @@ class AgentRuntime:
                     # scalars / JSON-RPC batches: log, never crash the reader
                     self._events.put(AgentEvent("log", text=line))
                     continue
+                reply = None
                 try:
+                    reply = self.dialect.auto_reply(msg)
                     event = self.dialect.parse(msg)
                 except Exception as e:  # noqa: BLE001 — a bad message must not kill the reader
                     event = AgentEvent("error", text=f"unparseable agent message: {e}", raw=msg)
+                if reply is not None:
+                    try:
+                        self._send(reply)
+                    except AgentError:
+                        pass  # process died; the sentinel below reports it
                 if event is not None:
                     self._events.put(event)
         finally:

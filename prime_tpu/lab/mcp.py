@@ -59,7 +59,89 @@ def build_tools(workspace: str = ".") -> dict[str, tuple[dict, Callable[[dict], 
 
         return [f.as_dict() for f in check_workspace(workspace)]
 
+    def training_runs(args: dict) -> Any:
+        rows = LabDataSource(workspace).scan_local_training_runs()
+        # metrics arrays can be thousands of rows; agents get the summary +
+        # the last row, and can chart via the lab_widget_show_chart tool
+        out = []
+        for row in rows:
+            metrics = row.get("metrics") or []
+            out.append(
+                {k: v for k, v in row.items() if k != "metrics"}
+                | {"lastMetrics": metrics[-1] if metrics else {}, "numRows": len(metrics)}
+            )
+        return out
+
+    def eval_samples(args: dict) -> Any:
+        from pathlib import Path
+
+        from prime_tpu.lab.data import read_jsonl
+
+        run_id = str(args.get("runId", ""))
+        limit = int(args.get("limit", 50) or 50)
+        rows = LabDataSource(workspace).scan_local_eval_runs()
+        if not run_id and rows:
+            # no runId means "the run of interest" = the NEWEST, not whichever
+            # sorts first alphabetically
+            rows = [max(rows, key=lambda r: Path(r["dir"]).stat().st_mtime)]
+        for row in rows:
+            if not run_id or row["runId"] == run_id:
+                return read_jsonl(Path(row["dir"]) / "results.jsonl")[:limit]
+        return {"error": f"no local run {run_id!r}"}
+
+    def widget_handler(name: str) -> Callable[[dict], Any]:
+        """Widget tool calls from MCP agents land in the workspace widget
+        journal (.prime-lab/widgets.jsonl); the shell's chat screen renders
+        the same contract natively when the agent speaks a chat dialect."""
+
+        def handle(args: dict) -> Any:
+            from prime_tpu.lab.widgets import validate_widget_call
+
+            problem = validate_widget_call(name, args)
+            if problem:
+                return {"status": "invalid", "error": problem}
+            from pathlib import Path
+
+            journal = Path(workspace) / ".prime-lab" / "widgets.jsonl"
+            journal.parent.mkdir(parents=True, exist_ok=True)
+            with open(journal, "a") as f:
+                f.write(json.dumps({"name": name, "args": args}) + "\n")
+            return {"status": "rendered", "widget": name}
+
+        return handle
+
+    from prime_tpu.lab.widgets import WIDGET_TOOLS
+
+    widget_entries = {
+        f"lab_widget_{tool.name}": (
+            {
+                "name": f"lab_widget_{tool.name}",
+                "description": tool.description,
+                "inputSchema": {
+                    "type": "object",
+                    "properties": tool.properties,
+                    "required": list(tool.required),
+                },
+            },
+            widget_handler(tool.name),
+        )
+        for tool in WIDGET_TOOLS
+    }
+
     return {
+        **widget_entries,
+        "lab_training_runs": (
+            _tool("lab_training_runs", "Local training runs: last metrics row + counts."),
+            training_runs,
+        ),
+        "lab_eval_samples": (
+            _tool(
+                "lab_eval_samples",
+                "Per-sample records (prompt/completion/reward) of a local eval run.",
+                {"runId": {"type": "string"}, "limit": {"type": "integer"}},
+            ),
+            eval_samples,
+        ),
         "lab_snapshot": (
             _tool(
                 "lab_snapshot",

@@ -64,6 +64,10 @@ SECTION_SPECS: dict[str, tuple[str, list[tuple[str, str]]]] = {
         "Launch cards",
         [("NAME", "name"), ("KIND", "kind"), ("FILE", "file")],
     ),
+    "agents": (
+        "Agent chat",
+        [("NAME", "name"), ("DIALECT", "dialect"), ("COMMAND", "command")],
+    ),
 }
 SECTIONS = tuple(SECTION_SPECS)
 PLATFORM_KEYS = ("evals", "training", "environments", "pods", "sandboxes")
@@ -111,6 +115,10 @@ class PrimeLabApp:
                     for c in scan_cards(self.workspace)
                 ]
             return self._launch_rows
+        if section == "agents":
+            from prime_tpu.lab.tui.chat import load_agents_config
+
+            return load_agents_config(self.workspace)
         return self.snapshot.platform.get(section, [])
 
     def selected_row(self) -> dict[str, Any] | None:
@@ -255,6 +263,10 @@ class PrimeLabApp:
                 screen = load_env_detail(
                     row, self._platform_api(), self.snapshot.installed_envs
                 )
+            elif section == "agents":
+                from prime_tpu.lab.tui.chat import open_agent_chat
+
+                screen = open_agent_chat(row, self.workspace)
             else:
                 return
         except Exception as e:  # noqa: BLE001 - detail must not kill the shell
@@ -306,9 +318,11 @@ class PrimeLabApp:
                 [SECTION_SPECS[self.section][0]] + [s.title for s in self.screens]
             )
             layout["header"].update(Text(f" PRIME LAB · {crumbs}", style="bold"))
-            layout["body"].update(
-                Panel(screen.render(), title=screen.title, border_style="dim")
-            )
+            try:
+                body = screen.render()
+            except Exception as e:  # noqa: BLE001 — a broken screen must not kill the shell
+                body = Text(f"render failed: {e}", style="red")
+            layout["body"].update(Panel(body, title=screen.title, border_style="dim"))
             layout["footer"].update(Text(f" {self.status}", style="dim"))
             return layout
         layout["body"].split_row(

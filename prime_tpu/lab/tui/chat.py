@@ -1,0 +1,203 @@
+"""In-shell agent chat screen (VERDICT r2 #4).
+
+Reference role: prime_lab_app agent chat + ``agent_widgets.py`` native widget
+rendering. The screen is a state machine like every other detail screen; the
+only thread is the turn worker (consuming ``AgentRuntime.prompt`` events into
+the transcript), so renders never block on the agent process.
+
+Transcript entries: {"role": "user"|"assistant"|"system", "text": str} or
+{"role": "widget", "name": str, "args": dict}. Widget calls render natively
+via lab/widgets.render_widget.
+
+Keys: printable chars type · enter send · backspace delete · esc clears the
+input (or closes the screen when empty and idle) · ctrl+u clear line.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterator
+
+from prime_tpu.lab.tui.detail import CLOSE, DetailScreen
+
+
+class AgentChatScreen(DetailScreen):
+    def __init__(
+        self,
+        name: str,
+        runtime_factory: Callable[[], Any],
+        transcript_limit: int = 200,
+    ) -> None:
+        self.title = f"agent: {name}"
+        self.name = name
+        self._factory = runtime_factory
+        self._runtime: Any = None
+        self.transcript: list[dict[str, Any]] = []
+        self.input_buffer = ""
+        self.busy = False
+        self.error = ""
+        self._worker: threading.Thread | None = None
+        self._limit = transcript_limit
+        # chat captures the keyboard (the shell's 'q'-quits guard keys off
+        # this attribute, same as the sample browser's search field)
+        self.search_input = ""
+
+    # -- turn lifecycle --------------------------------------------------------
+
+    def _ensure_runtime(self) -> Any:
+        if self._runtime is None:
+            self._runtime = self._factory()
+            if hasattr(self._runtime, "start"):
+                self._runtime.start()
+        return self._runtime
+
+    def send(self, text: str) -> None:
+        if self.busy or not text.strip():
+            return
+        self.transcript.append({"role": "user", "text": text})
+        self.busy = True
+        self.error = ""
+        self._worker = threading.Thread(target=self._run_turn, args=(text,), daemon=True)
+        self._worker.start()
+
+    def _run_turn(self, text: str) -> None:
+        try:
+            runtime = self._ensure_runtime()
+            streaming: dict[str, Any] | None = None
+            events: Iterator[Any] = runtime.prompt(text)
+            for event in events:
+                if event.kind == "chunk" and event.text:
+                    if streaming is None:
+                        streaming = {"role": "assistant", "text": ""}
+                        self.transcript.append(streaming)
+                    streaming["text"] += event.text
+                elif event.kind == "widget" and event.widget:
+                    streaming = None  # widget splits the assistant stream
+                    self.transcript.append(
+                        {
+                            "role": "widget",
+                            "name": event.widget.get("name", ""),
+                            "args": event.widget.get("args", {}),
+                        }
+                    )
+            if len(self.transcript) > self._limit:
+                del self.transcript[: len(self.transcript) - self._limit]
+        except Exception as e:  # noqa: BLE001 - agent failures surface in-chat
+            self.error = str(e)
+            self.transcript.append({"role": "system", "text": f"error: {e}"})
+        finally:
+            self.busy = False
+
+    def wait_idle(self, timeout_s: float = 10.0) -> bool:
+        """Join the turn worker (tests + clean shutdown)."""
+        worker = self._worker
+        if worker is None:
+            return True
+        worker.join(timeout=timeout_s)
+        return not worker.is_alive()
+
+    def close(self) -> None:
+        if self._runtime is not None and hasattr(self._runtime, "close"):
+            try:
+                self._runtime.close()
+            except Exception:  # noqa: BLE001 - teardown must not raise
+                pass
+            self._runtime = None
+
+    # -- keys ------------------------------------------------------------------
+
+    def on_key(self, key: str) -> str | None:
+        if key == "enter":
+            if self.busy:
+                # keep the typed text — a discarded message with no feedback
+                # is worse than waiting
+                return "turn still running — message kept in the input"
+            text, self.input_buffer = self.input_buffer, ""
+            self.send(text)
+            return None
+        if key == "backspace":
+            self.input_buffer = self.input_buffer[:-1]
+            return None
+        if key == "ctrl+u":
+            self.input_buffer = ""
+            return None
+        if key == "escape":
+            if self.input_buffer:
+                self.input_buffer = ""
+                return None
+            if self.busy:
+                return "turn still running (esc again after it finishes)"
+            self.close()
+            return CLOSE
+        if len(key) == 1 and key.isprintable():
+            self.input_buffer += key
+            return None
+        return None
+
+    # -- render ----------------------------------------------------------------
+
+    def render(self):
+        from rich.console import Group
+        from rich.text import Text
+
+        from prime_tpu.lab.widgets import render_widget
+
+        parts: list[Any] = []
+        for entry in self.transcript[-24:]:
+            role = entry.get("role")
+            if role == "widget":
+                parts.append(render_widget(str(entry.get("name", "")), entry.get("args", {})))
+                continue
+            style = {"user": "bold", "assistant": "", "system": "red"}.get(role or "", "dim")
+            prefix = {"user": "you", "assistant": self.name, "system": "sys"}.get(role or "", "?")
+            parts.append(Text(f"{prefix}: {entry.get('text', '')}", style=style or None))
+        if not self.transcript:
+            parts.append(Text("(no messages — type and press enter)", style="dim"))
+        parts.append(Text(""))
+        status = "…thinking" if self.busy else ""
+        parts.append(Text(f"> {self.input_buffer}▌ {status}", style="bold"))
+        parts.append(Text("enter send · esc clear/back", style="dim"))
+        return Group(*parts)
+
+
+def load_agents_config(workspace) -> list[dict[str, Any]]:
+    """Configured chat agents: ``.prime-lab/agents.json`` rows
+    [{"name", "command", "dialect"}]. Missing file -> empty list."""
+    import json
+    from pathlib import Path
+
+    path = Path(workspace) / ".prime-lab" / "agents.json"
+    if not path.exists():
+        return []
+    try:
+        loaded = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return []
+    rows = loaded.get("agents") if isinstance(loaded, dict) else loaded
+    if not isinstance(rows, list):
+        return []
+    return [
+        {
+            "name": str(row.get("name", f"agent-{i}")),
+            "dialect": str(row.get("dialect", "acp")),
+            "command": str(row.get("command", "")),
+        }
+        for i, row in enumerate(rows)
+        if isinstance(row, dict) and row.get("command")
+    ]
+
+
+def open_agent_chat(row: dict[str, Any], workspace) -> AgentChatScreen:
+    """Chat screen over a real AgentRuntime for one configured agent row."""
+    import shlex
+
+    from prime_tpu.lab.agents import AgentRuntime
+
+    def factory() -> AgentRuntime:
+        return AgentRuntime(
+            shlex.split(row["command"]),
+            dialect=row.get("dialect", "acp"),
+            cwd=str(workspace),
+        )
+
+    return AgentChatScreen(row["name"], factory)

@@ -49,7 +49,13 @@ def run_interactive(app: TuiApp, tick_interval_s: float = 2.0) -> None:
         tty.setcbreak(stdin_fd)
         with Live(app.render(), console=console, screen=True, auto_refresh=False) as live:
             while not app.quit:
-                ready, _, _ = select.select([stdin_fd], [], [], tick_interval_s)
+                # a busy screen (streaming agent turn) renders at 4 Hz so
+                # chunks appear as they arrive, not in tick-sized jumps
+                interval = tick_interval_s
+                top = getattr(app, "screens", None)
+                if top and getattr(top[-1], "busy", False):
+                    interval = 0.25
+                ready, _, _ = select.select([stdin_fd], [], [], interval)
                 if ready:
                     import os
 

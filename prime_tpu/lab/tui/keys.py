@@ -65,6 +65,8 @@ def decode_keys(data: bytes) -> list[str]:
             keys.append("backspace")
         elif byte == b"\x03":
             keys.append("ctrl+c")
+        elif byte == b"\x15":
+            keys.append("ctrl+u")
         else:
             try:
                 text = byte.decode()

@@ -1,0 +1,193 @@
+"""Lab widget tool contract: native TUI surfaces agents can drive.
+
+Reference role: prime_lab_app/agent_widgets.py:38 ``LAB_WIDGET_TOOLS`` +
+agent_widget_model.py — a fixed table of tools every chat dialect advertises
+(Codex ``dynamicTools``, Letta ``register_external_tools``, the MCP bridge's
+tool list); when the agent calls one, the TUI renders a native widget instead
+of text. This stack keeps the table small and declarative: each spec is pure
+data, ``render_widget`` maps a call onto rich renderables, and the chat
+screen owns any interactive follow-up (choice selection).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass(frozen=True)
+class WidgetToolSpec:
+    name: str
+    description: str
+    properties: dict[str, Any] = field(default_factory=dict)
+    required: tuple[str, ...] = ()
+
+
+WIDGET_TOOLS: tuple[WidgetToolSpec, ...] = (
+    WidgetToolSpec(
+        name="choose",
+        description="Present options as a native picker; the user selects one.",
+        properties={
+            "title": {"type": "string"},
+            "options": {"type": "array", "items": {"type": "string"}},
+        },
+        required=("options",),
+    ),
+    WidgetToolSpec(
+        name="show_table",
+        description="Render rows as a native table (columns inferred from keys).",
+        properties={
+            "title": {"type": "string"},
+            "rows": {"type": "array", "items": {"type": "object"}},
+        },
+        required=("rows",),
+    ),
+    WidgetToolSpec(
+        name="show_chart",
+        description="Render a numeric series as a native sparkline chart.",
+        properties={
+            "title": {"type": "string"},
+            "values": {"type": "array", "items": {"type": "number"}},
+        },
+        required=("values",),
+    ),
+    WidgetToolSpec(
+        name="launch_run",
+        description="Propose launching a training/eval run; the user confirms in the launch section.",
+        properties={
+            "kind": {"type": "string", "enum": ["eval", "training", "pod", "sandbox"]},
+            "config": {"type": "object"},
+        },
+        required=("kind",),
+    ),
+    WidgetToolSpec(
+        name="show_patch",
+        description="Render a unified diff with syntax-aware +/- coloring.",
+        properties={
+            "title": {"type": "string"},
+            "patch": {"type": "string"},
+        },
+        required=("patch",),
+    ),
+)
+
+_BY_NAME = {tool.name: tool for tool in WIDGET_TOOLS}
+
+
+def widget_tool_specs() -> list[dict[str, Any]]:
+    """Codex ``dynamicTools`` shape (JSON-schema parameters)."""
+    return [
+        {
+            "name": tool.name,
+            "description": tool.description,
+            "parameters": {
+                "type": "object",
+                "properties": tool.properties,
+                "required": list(tool.required),
+                "additionalProperties": False,
+            },
+        }
+        for tool in WIDGET_TOOLS
+    ]
+
+
+def letta_external_tools() -> list[dict[str, Any]]:
+    """Letta ``register_external_tools`` shape (label + parameters)."""
+    return [
+        {
+            "name": tool.name,
+            "label": f"Lab {tool.name.replace('_', ' ')}",
+            "description": tool.description,
+            "parameters": {
+                "type": "object",
+                "properties": tool.properties,
+                "required": list(tool.required),
+                "additionalProperties": False,
+            },
+        }
+        for tool in WIDGET_TOOLS
+    ]
+
+
+def validate_widget_call(name: str, args: dict[str, Any]) -> str | None:
+    """None when the call is well-formed, else a reason (the TUI shows it
+    instead of a broken widget — a malformed call must never crash a render).
+    Checks types, not just presence: agents do send ``{"options": 5}``."""
+    tool = _BY_NAME.get(name)
+    if tool is None:
+        return f"unknown widget tool {name!r}"
+    if not isinstance(args, dict):
+        return f"{name}: args must be an object"
+    missing = [key for key in tool.required if key not in args]
+    if missing:
+        return f"{name}: missing required {missing}"
+    for key, schema in tool.properties.items():
+        if key not in args:
+            continue
+        expected = schema.get("type")
+        value = args[key]
+        ok = {
+            "string": lambda v: isinstance(v, str),
+            "array": lambda v: isinstance(v, list),
+            "object": lambda v: isinstance(v, dict),
+            "number": lambda v: isinstance(v, (int, float)),
+        }.get(expected, lambda v: True)(value)
+        if not ok:
+            return f"{name}: {key} must be a JSON {expected}"
+    return None
+
+
+def render_widget(name: str, args: dict[str, Any]):
+    """One rich renderable per widget call (pure; no app state)."""
+    from rich.panel import Panel
+    from rich.table import Table
+    from rich.text import Text
+
+    problem = validate_widget_call(name, args)
+    if problem:
+        return Panel(Text(problem, style="red"), title="widget error", border_style="red")
+
+    title = str(args.get("title", "")) or name
+    if name == "choose":
+        body = Table.grid(padding=(0, 1))
+        for index, option in enumerate(args["options"], 1):
+            body.add_row(Text(f"{index}.", style="bold"), Text(str(option)))
+        return Panel(body, title=f"choose: {title}", border_style="cyan")
+    if name == "show_table":
+        rows = [r for r in args["rows"] if isinstance(r, dict)]
+        columns: list[str] = []
+        for row in rows:
+            for key in row:
+                if key not in columns:
+                    columns.append(key)
+        table = Table(expand=True, pad_edge=False)
+        for column in columns[:6]:
+            table.add_column(str(column), overflow="ellipsis", no_wrap=True)
+        for row in rows[:20]:
+            table.add_row(*[str(row.get(c, "—")) for c in columns[:6]])
+        return Panel(table, title=title, border_style="cyan")
+    if name == "show_chart":
+        from prime_tpu.lab.tui.charts import sparkline
+
+        values = [v for v in args["values"] if isinstance(v, (int, float))]
+        line = sparkline(values, width=48) if values else "(no numeric values)"
+        caption = f"{values[0]:.4g} → {values[-1]:.4g}" if values else ""
+        return Panel(
+            Text(f"{line}  {caption}", no_wrap=True, overflow="crop"),
+            title=title,
+            border_style="cyan",
+        )
+    if name == "launch_run":
+        body = Table.grid(padding=(0, 1))
+        body.add_row(Text("kind", style="dim"), Text(str(args.get("kind"))))
+        for key, value in (args.get("config") or {}).items():
+            body.add_row(Text(str(key), style="dim"), Text(str(value)[:60]))
+        return Panel(
+            body, title="launch proposal (confirm in the launch section)", border_style="yellow"
+        )
+    # show_patch
+    text = Text()
+    for line in str(args["patch"]).splitlines()[:40]:
+        style = "green" if line.startswith("+") else "red" if line.startswith("-") else None
+        text.append(line + "\n", style=style)
+    return Panel(text, title=title, border_style="cyan")

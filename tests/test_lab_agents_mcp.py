@@ -245,3 +245,255 @@ def test_mcp_rejects_nonobject_requests(tmp_path):
     assert responses[0]["error"]["code"] == -32600
     assert responses[1]["error"]["code"] == -32600
     assert "tools" in responses[2]["result"]  # server survived bad input
+
+
+# -- codex app-server dialect (VERDICT r2 #4) ---------------------------------
+
+CODEX_AGENT = textwrap.dedent(
+    """
+    import json, sys
+    def send(obj):
+        print(json.dumps(obj), flush=True)
+    for line in sys.stdin:
+        msg = json.loads(line)
+        method = msg.get("method")
+        if method == "initialize":
+            send({"jsonrpc": "2.0", "id": msg["id"], "result": {}})
+        elif method == "thread/start":
+            assert isinstance(msg["params"].get("dynamicTools"), list)
+            assert any(t["name"] == "choose" for t in msg["params"]["dynamicTools"])
+            send({"jsonrpc": "2.0", "id": msg["id"], "result": {"thread": {"id": "th-1"}}})
+        elif method == "turn/start":
+            assert msg["params"]["threadId"] == "th-1"
+            text = msg["params"]["input"][0]["text"]
+            for piece in (text[:2], text[2:]):
+                send({"jsonrpc": "2.0", "method": "item/agentMessage/delta",
+                      "params": {"delta": piece, "turnId": "t1"}})
+            send({"jsonrpc": "2.0", "method": "item/tool/call",
+                  "params": {"name": "show_chart", "arguments": {"values": [1, 2, 3]}}})
+            send({"jsonrpc": "2.0", "method": "turn/completed", "params": {"turn": {}}})
+    """
+)
+
+LETTA_AGENT = textwrap.dedent(
+    """
+    import json, sys
+    def send(obj):
+        print(json.dumps(obj), flush=True)
+    send({"type": "system", "session_id": "lt-1"})
+    for line in sys.stdin:
+        msg = json.loads(line)
+        if msg.get("type") == "control_request":
+            continue  # client-initiated init/register: no reply needed
+        if msg.get("type") == "control_response":
+            continue
+        if msg.get("type") == "user":
+            text = msg["message"]["content"]
+            # ask permission first; the client must auto-allow
+            send({"type": "control_request", "request_id": "r1",
+                  "request": {"subtype": "can_use_tool", "tool_name": "choose"}})
+            granted = json.loads(input())
+            assert granted["response"]["response"]["behavior"] == "allow"
+            send({"type": "control_request", "request_id": "r2",
+                  "request": {"subtype": "execute_external_tool",
+                               "tool_name": "choose",
+                               "arguments": {"options": ["a", "b"]}}})
+            ack = json.loads(input())
+            assert ack["response"]["response"]["status"] == "rendered"
+            send({"type": "assistant", "message": {"role": "assistant",
+                  "content": [{"type": "text", "text": text[::-1]}]}})
+            send({"type": "result"})
+    """
+)
+
+
+def test_codex_dialect_chat_and_widget():
+    with _agent(CODEX_AGENT, "codex") as agent:
+        events = list(agent.prompt("hello", timeout_s=20))
+    text = "".join(e.text for e in events if e.kind == "chunk")
+    widgets = [e.widget for e in events if e.kind == "widget"]
+    assert text == "hello"
+    assert widgets == [{"name": "show_chart", "args": {"values": [1, 2, 3]}}]
+    # handshake captured the thread id
+    assert agent.dialect.session_id == "th-1"
+
+
+def test_codex_turn_error_raises():
+    script = textwrap.dedent(
+        """
+        import json, sys
+        def send(obj):
+            print(json.dumps(obj), flush=True)
+        for line in sys.stdin:
+            msg = json.loads(line)
+            if msg.get("method") == "initialize":
+                send({"jsonrpc": "2.0", "id": msg["id"], "result": {}})
+            elif msg.get("method") == "thread/start":
+                send({"jsonrpc": "2.0", "id": msg["id"], "result": {"thread": {"id": "t"}}})
+            elif msg.get("method") == "turn/start":
+                send({"jsonrpc": "2.0", "method": "turn/completed",
+                      "params": {"turn": {"error": {"message": "model overloaded"}}}})
+        """
+    )
+    with _agent(script, "codex") as agent:
+        with pytest.raises(AgentError, match="model overloaded"):
+            list(agent.prompt("hi", timeout_s=20))
+
+
+def test_letta_dialect_auto_allows_tools_and_streams():
+    with _agent(LETTA_AGENT, "letta") as agent:
+        events = list(agent.prompt("abc", timeout_s=20))
+    text = "".join(e.text for e in events if e.kind == "chunk")
+    widgets = [e.widget for e in events if e.kind == "widget"]
+    assert text == "cba"
+    assert widgets == [{"name": "choose", "args": {"options": ["a", "b"]}}]
+    assert agent.dialect.session_id == "lt-1"
+
+
+# -- widget contract -----------------------------------------------------------
+
+
+def test_widget_specs_cover_both_wire_shapes():
+    from prime_tpu.lab.widgets import WIDGET_TOOLS, letta_external_tools, widget_tool_specs
+
+    names = {t.name for t in WIDGET_TOOLS}
+    assert {"choose", "show_table", "show_chart", "launch_run", "show_patch"} <= names
+    codex = widget_tool_specs()
+    letta = letta_external_tools()
+    assert {t["name"] for t in codex} == names == {t["name"] for t in letta}
+    assert all("parameters" in t for t in codex)
+    assert all(t["label"].startswith("Lab ") for t in letta)
+
+
+def test_widget_render_and_validation():
+    from rich.console import Console
+
+    from prime_tpu.lab.widgets import render_widget, validate_widget_call
+
+    assert validate_widget_call("choose", {}) is not None          # missing options
+    assert validate_widget_call("choose", {"options": ["x"]}) is None
+    assert validate_widget_call("nope", {}) is not None
+    console = Console(width=80, file=io.StringIO(), force_terminal=False)
+    console.print(render_widget("show_table", {"rows": [{"a": 1, "b": 2}]}))
+    console.print(render_widget("show_chart", {"values": [1.0, 5.0, 2.0]}))
+    console.print(render_widget("choose", {"options": ["first", "second"]}))
+    console.print(render_widget("bad_tool", {}))
+    out = console.file.getvalue()
+    assert "first" in out and "widget error" in out
+
+
+# -- in-shell chat screen ------------------------------------------------------
+
+
+class _ScriptedRuntime:
+    """Deterministic in-process stand-in for AgentRuntime."""
+
+    def __init__(self):
+        self.started = False
+        self.closed = False
+
+    def start(self):
+        self.started = True
+
+    def close(self):
+        self.closed = True
+
+    def prompt(self, text, timeout_s=120.0):
+        from prime_tpu.lab.agents import AgentEvent
+
+        yield AgentEvent("chunk", text=f"echo:{text}")
+        yield AgentEvent("widget", widget={"name": "choose", "args": {"options": ["x", "y"]}})
+
+
+def test_chat_screen_turn_and_widget_render():
+    from rich.console import Console
+
+    from prime_tpu.lab.tui.chat import AgentChatScreen
+
+    screen = AgentChatScreen("tester", _ScriptedRuntime)
+    for ch in "hi!":
+        screen.on_key(ch)
+    assert screen.input_buffer == "hi!"
+    screen.on_key("enter")
+    assert screen.wait_idle(5)
+    roles = [e["role"] for e in screen.transcript]
+    assert roles == ["user", "assistant", "widget"]
+    assert screen.transcript[1]["text"] == "echo:hi!"
+    console = Console(width=90, file=io.StringIO(), force_terminal=False)
+    console.print(screen.render())
+    out = console.file.getvalue()
+    assert "echo:hi!" in out and "choose" in out
+
+
+def test_chat_screen_esc_clears_then_closes():
+    from prime_tpu.lab.tui.chat import AgentChatScreen
+    from prime_tpu.lab.tui.detail import CLOSE
+
+    runtime = _ScriptedRuntime()
+    screen = AgentChatScreen("tester", lambda: runtime)
+    screen.on_key("x")
+    assert screen.on_key("escape") is None and screen.input_buffer == ""
+    screen.on_key("h")
+    screen.on_key("enter")
+    assert screen.wait_idle(5)
+    assert screen.on_key("escape") == CLOSE
+    assert runtime.closed
+
+
+def test_chat_section_lists_configured_agents(tmp_path):
+    from prime_tpu.lab.tui.chat import load_agents_config
+
+    cfg_dir = tmp_path / ".prime-lab"
+    cfg_dir.mkdir()
+    (cfg_dir / "agents.json").write_text(
+        json.dumps({"agents": [
+            {"name": "codex", "command": "codex app-server", "dialect": "codex"},
+            {"name": "broken"},  # no command: skipped
+        ]})
+    )
+    rows = load_agents_config(tmp_path)
+    assert rows == [{"name": "codex", "dialect": "codex", "command": "codex app-server"}]
+    assert load_agents_config(tmp_path / "nope") == []
+
+
+# -- MCP widget + detail tools -------------------------------------------------
+
+
+def test_mcp_widget_tools_journal(tmp_path):
+    tools = build_tools(str(tmp_path))
+    listed = handle_request({"jsonrpc": "2.0", "id": 1, "method": "tools/list"}, tools)
+    names = {t["name"] for t in listed["result"]["tools"]}
+    assert {"lab_widget_choose", "lab_widget_show_chart", "lab_training_runs",
+            "lab_eval_samples"} <= names
+    good = handle_request(
+        {"jsonrpc": "2.0", "id": 2, "method": "tools/call",
+         "params": {"name": "lab_widget_choose", "arguments": {"options": ["a"]}}},
+        tools,
+    )
+    payload = json.loads(good["result"]["content"][0]["text"])
+    assert payload["status"] == "rendered"
+    journal = (tmp_path / ".prime-lab" / "widgets.jsonl").read_text().strip()
+    assert json.loads(journal) == {"name": "choose", "args": {"options": ["a"]}}
+    bad = handle_request(
+        {"jsonrpc": "2.0", "id": 3, "method": "tools/call",
+         "params": {"name": "lab_widget_choose", "arguments": {}}},
+        tools,
+    )
+    assert json.loads(bad["result"]["content"][0]["text"])["status"] == "invalid"
+
+
+def test_mcp_eval_samples_tool(tmp_path):
+    run_dir = tmp_path / "outputs" / "evals" / "gsm8k--m1" / "r7"
+    run_dir.mkdir(parents=True)
+    (run_dir / "metadata.json").write_text(json.dumps({"metrics": {"accuracy": 1.0}}))
+    (run_dir / "results.jsonl").write_text(
+        json.dumps({"prompt": "p", "completion": "c", "reward": 1.0}) + "\n"
+    )
+    tools = build_tools(str(tmp_path))
+    response = handle_request(
+        {"jsonrpc": "2.0", "id": 4, "method": "tools/call",
+         "params": {"name": "lab_eval_samples", "arguments": {"runId": "r7"}}},
+        tools,
+    )
+    samples = json.loads(response["result"]["content"][0]["text"])
+    assert samples[0]["prompt"] == "p"
