@@ -107,13 +107,14 @@ def lab_tui(workspace: str = ".") -> None:
 @click.option("--dir", "workspace", default=".", type=click.Path())
 @click.option(
     "--agent", "agents", multiple=True, default=("claude", "codex"),
-    help="Agent surface(s) to generate: claude, codex, cursor (repeatable).",
+    help="Agent surface(s) to generate: claude, codex, cursor, gemini, windsurf (repeatable).",
 )
 @click.option("--force-skills", is_flag=True, help="Overwrite bundled skill docs.")
 @output_options
 def lab_setup(render: Renderer, workspace: str, agents: tuple[str, ...], force_skills: bool) -> None:
-    """Bootstrap a Lab workspace: config, bundled skills, agent surfaces
-    (CLAUDE.md / AGENTS.md / cursor rules), gitignore hygiene."""
+    """Bootstrap a Lab workspace: config, versioned skill bundle, agent
+    surface matrix (guide + MCP registration per flavor), chat-agent config,
+    gitignore hygiene, and a hygiene preflight."""
     from prime_tpu.lab.setup import setup_workspace
 
     try:
@@ -127,9 +128,14 @@ def lab_setup(render: Renderer, workspace: str, agents: tuple[str, ...], force_s
         render.message(f"  created {path}")
     for path in report.updated:
         render.message(f"  updated {path}")
+    for note in report.skipped:
+        render.message(f"  skipped {note}")
+    for finding in report.hygiene:
+        render.message(f"  [{finding['severity']}] {finding['code']}: {finding['message']}")
     render.message(
-        f"Lab workspace ready ({len(report.created)} created, {len(report.updated)} updated). "
-        "Run `prime lab` for the shell."
+        f"Lab workspace ready ({len(report.created)} created, {len(report.updated)} updated"
+        + (f", {len(report.skipped)} skipped" if report.skipped else "")
+        + "). Run `prime lab` for the shell."
     )
 
 

@@ -75,16 +75,78 @@ SKILLS: dict[str, str] = {
 - Multi-host slices expose one ssh target per worker; the same binary must
   run on every worker (`prime pods connect --all-workers`).
 """,
+    "training-locally.md": """\
+# Skill: local training
+
+1. SFT: `prime train local --model tiny-test --steps 100 --plain` (add
+   `--lora r=8` for adapters, `--resume` to continue from a checkpoint).
+2. GRPO: `prime train local-rl <env> --model <m> --steps 50 --plain`; the
+   env's `load_environment()` supplies prompts + the reward scorer.
+3. Metrics land in metrics.jsonl (charted by `prime lab`); checkpoints are
+   orbax dirs under the run dir. `--profile` captures a jax.profiler trace.
+""",
+    "serving-models.md": """\
+# Skill: serving models
+
+1. `prime serve <model-or-checkpoint> --plain` starts the OpenAI-compatible
+   endpoint; `--continuous` enables slot-based continuous batching with
+   chunked prefill + prefix KV reuse.
+2. Quantization: `--weight-quant` (int8 W8A16, fastest single-chip),
+   `--kv-quant` (int8 KV cache). Speculative: `--speculative` (greedy only).
+3. Sharded: `--slice v5e-8 [--tp N]` shards over the slice mesh; MoE models
+   carve an expert-parallel axis automatically.
+""",
+    "agent-widgets.md": """\
+# Skill: Lab widget tools
+
+Agents connected over MCP (`prime lab mcp`) or a chat dialect (codex /
+letta / acp) can call native Lab widgets instead of printing text walls:
+`choose` (picker), `show_table`, `show_chart` (sparkline), `launch_run`
+(proposal card), `show_patch` (diff). Calls are validated against the
+declared JSON schema; malformed calls render as widget errors, never crash.
+""",
+    "distributed-slices.md": """\
+# Skill: distributed TPU slices
+
+- Mesh policy: `--slice v5e-8` derives (dp, fsdp, tp); override with `--tp`.
+- Long context: ring-attention sequence parallelism shards 16-32k prompts
+  over the `sp` axis; chunked prefill keeps attention memory O(S*C).
+- Multi-host: `jax.distributed` over DCN initializes from the pod metadata;
+  collectives ride ICI within a slice.
+""",
 }
 
-# agent flavor -> surface path (relative to workspace)
-AGENT_SURFACES: dict[str, str] = {
-    "claude": "CLAUDE.md",
-    "codex": "AGENTS.md",
-    "cursor": ".cursor/rules/prime-lab.mdc",
+# Bump when SKILLS content changes: setup auto-refreshes bundled skills whose
+# on-disk content still matches the PREVIOUS bundle (i.e. not locally edited).
+SKILLS_VERSION = 2
+
+# agent flavor -> (guide surface path, MCP registration path or None).
+# The guide rides the marked generated block; the MCP file registers
+# `prime lab mcp` so the agent sees the Lab tools (reference lab_setup.py's
+# multi-agent surface matrix role).
+AGENT_SURFACES: dict[str, tuple[str, str | None]] = {
+    "claude": ("CLAUDE.md", ".mcp.json"),
+    "codex": ("AGENTS.md", None),
+    "cursor": (".cursor/rules/prime-lab.mdc", ".cursor/mcp.json"),
+    "gemini": ("GEMINI.md", None),
+    "windsurf": (".windsurf/rules/prime-lab.md", None),
+}
+
+MCP_SERVER_ENTRY = {
+    "command": "prime",
+    "args": ["lab", "mcp"],
 }
 
 GITIGNORE_ENTRIES = ["outputs/", ".prime-lab/cache/", ".env"]
+
+AGENTS_JSON_TEMPLATE = """\
+{
+  "_example": {"name": "my-agent", "dialect": "simple",
+               "command": "python -u my_agent.py",
+               "_dialects": "simple | acp | codex | letta"},
+  "agents": []
+}
+"""
 
 
 @dataclass
@@ -92,9 +154,17 @@ class SetupReport:
     created: list[str] = field(default_factory=list)
     updated: list[str] = field(default_factory=list)
     unchanged: list[str] = field(default_factory=list)
+    skipped: list[str] = field(default_factory=list)   # locally-modified skills
+    hygiene: list[dict] = field(default_factory=list)  # preflight findings
 
     def as_dict(self) -> dict:
-        return {"created": self.created, "updated": self.updated, "unchanged": self.unchanged}
+        return {
+            "created": self.created,
+            "updated": self.updated,
+            "unchanged": self.unchanged,
+            "skipped": self.skipped,
+            "hygiene": self.hygiene,
+        }
 
 
 def _write_generated_block(path: Path, body: str, report: SetupReport) -> None:
@@ -130,36 +200,143 @@ def _write_once(path: Path, content: str, report: SetupReport, force: bool = Fal
     (report.updated if existed else report.created).append(str(path))
 
 
+def _sync_skills(ws: Path, report: SetupReport, force: bool) -> None:
+    """Versioned skill-bundle sync (reference lab_setup.py's pinned-ref
+    re-sync role). A manifest records the bundle version + per-file content
+    hash at write time; on version bump, files still matching their RECORDED
+    hash (never locally edited) refresh automatically, edited files are kept
+    and reported as skipped. ``force`` overwrites everything."""
+    import hashlib
+    import json
+
+    skills_dir = ws / ".prime-lab" / "skills"
+    manifest_path = skills_dir / "MANIFEST.json"
+    manifest: dict = {}
+    if manifest_path.exists():
+        try:
+            loaded = json.loads(manifest_path.read_text())
+            if isinstance(loaded, dict):
+                manifest = loaded
+        except (OSError, json.JSONDecodeError):
+            manifest = {}
+    recorded_version = manifest.get("version", 0)
+    if isinstance(recorded_version, int) and recorded_version > SKILLS_VERSION and not force:
+        # downgrade guard: a NEWER bundle (written by a newer CLI, possibly
+        # committed by a teammate) must not be reverted by an older CLI — the
+        # whole sync is skipped, manifest untouched
+        report.skipped.append(
+            f"{skills_dir} (bundle v{recorded_version} is newer than this CLI's "
+            f"v{SKILLS_VERSION}; upgrade prime-tpu or pass --force-skills)"
+        )
+        return
+    recorded_hashes = manifest.get("files", {})
+    if not isinstance(recorded_hashes, dict):
+        recorded_hashes = {}
+    digest = lambda text: hashlib.sha256(text.encode()).hexdigest()  # noqa: E731
+
+    for name, content in SKILLS.items():
+        path = skills_dir / name
+        if not path.exists() or force:
+            _write_once(path, content, report, force=force)
+            continue
+        on_disk = path.read_text()
+        if on_disk == content:
+            report.unchanged.append(str(path))
+        elif recorded_hashes.get(name) == digest(on_disk):
+            # pristine copy of an older bundle: safe to refresh
+            path.write_text(content)
+            report.updated.append(str(path))
+        else:
+            report.skipped.append(f"{path} (locally modified; --force-skills to overwrite)")
+
+    new_manifest = {
+        "version": SKILLS_VERSION,
+        "files": {name: digest(content) for name, content in SKILLS.items()},
+    }
+    serialized = json.dumps(new_manifest, indent=2) + "\n"
+    if not manifest_path.exists() or manifest_path.read_text() != serialized:
+        manifest_path.parent.mkdir(parents=True, exist_ok=True)
+        existed = manifest_path.exists()
+        manifest_path.write_text(serialized)
+        (report.updated if existed else report.created).append(str(manifest_path))
+
+
+def _register_mcp(ws: Path, mcp_path: str, report: SetupReport) -> None:
+    """Merge the prime-lab MCP server into the agent's MCP config (additive:
+    other servers in the file are preserved)."""
+    import json
+
+    path = ws / mcp_path
+    config: dict = {}
+    if path.exists():
+        try:
+            loaded = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            report.skipped.append(f"{path} (unparseable; not touching it)")
+            return
+        if not isinstance(loaded, dict):
+            # valid JSON but not an object: overwriting would destroy it
+            report.skipped.append(f"{path} (not a JSON object; not touching it)")
+            return
+        config = loaded
+    servers = config.setdefault("mcpServers", {})
+    if not isinstance(servers, dict):
+        report.skipped.append(f"{path} (mcpServers is not an object; not touching it)")
+        return
+    if servers.get("prime-lab") == MCP_SERVER_ENTRY:
+        report.unchanged.append(str(path))
+        return
+    servers["prime-lab"] = MCP_SERVER_ENTRY
+    existed = path.exists()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(config, indent=2) + "\n")
+    (report.updated if existed else report.created).append(str(path))
+
+
 def setup_workspace(
     workspace: str | Path = ".",
     agents: tuple[str, ...] = ("claude", "codex"),
     force_skills: bool = False,
 ) -> SetupReport:
-    """Materialize the Lab workspace: config, launch dir, skills, agent
-    surfaces, gitignore hygiene. Idempotent; returns what changed."""
+    """Materialize the Lab workspace in one pass: config, launch dir,
+    versioned skill bundle, agent-surface matrix (guide block + MCP
+    registration per flavor), chat-agent config, gitignore hygiene, and a
+    hygiene preflight. Idempotent; returns what changed."""
     ws = Path(workspace)
     ws.mkdir(parents=True, exist_ok=True)
     report = SetupReport()
 
     _write_once(ws / ".prime-lab" / "lab.toml", LAB_TOML, report)
+    _write_once(ws / ".prime-lab" / "agents.json", AGENTS_JSON_TEMPLATE, report)
     launch = ws / ".prime-lab" / "launch"
     if not launch.exists():
         launch.mkdir(parents=True)
         report.created.append(str(launch))
 
-    for name, content in SKILLS.items():
-        _write_once(ws / ".prime-lab" / "skills" / name, content, report, force=force_skills)
+    _sync_skills(ws, report, force=force_skills)
 
     unknown = [a for a in agents if a not in AGENT_SURFACES]
     if unknown:
         raise ValueError(f"unknown agent flavor(s) {unknown}; choose from {sorted(AGENT_SURFACES)}")
     for agent in agents:
-        _write_generated_block(ws / AGENT_SURFACES[agent], AGENT_GUIDE, report)
+        surface, mcp_path = AGENT_SURFACES[agent]
+        _write_generated_block(ws / surface, AGENT_GUIDE, report)
+        if mcp_path:
+            _register_mcp(ws, mcp_path, report)
 
     gitignore = ws / ".gitignore"
     existed = gitignore.exists()
     if append_gitignore(ws, GITIGNORE_ENTRIES):
         (report.updated if existed else report.created).append(str(gitignore))
+
+    # hygiene preflight in the same pass: setup ends with a verdict on the
+    # workspace, not just files written
+    try:
+        from prime_tpu.lab.hygiene import check_workspace
+
+        report.hygiene = [f.as_dict() for f in check_workspace(ws)]
+    except Exception as e:  # noqa: BLE001 - hygiene must not fail setup
+        report.hygiene = [{"severity": "error", "code": "hygiene-crashed", "message": str(e)}]
 
     return report
 

@@ -282,3 +282,94 @@ def test_hygiene_escapes_glob_metachars_and_converges(tmp_path):
     apply_fixes(tmp_path, findings)
     after = check_workspace(tmp_path)
     assert not any(f.code == "unignored-secret" for f in after)  # rule matched literally
+
+
+# -- versioned skill bundle + surface matrix (VERDICT r2 #6) ------------------
+
+
+def test_skill_bundle_refreshes_pristine_keeps_edited(tmp_path):
+    """Bundle sync: a pristine skill from an older bundle refreshes on
+    version bump; a locally-edited one is kept and reported skipped."""
+    import json
+
+    from prime_tpu.lab import setup as setup_mod
+    from prime_tpu.lab.setup import setup_workspace
+
+    setup_workspace(tmp_path, agents=("claude",))
+    skills = tmp_path / ".prime-lab" / "skills"
+    manifest = json.loads((skills / "MANIFEST.json").read_text())
+    assert manifest["version"] == setup_mod.SKILLS_VERSION
+    assert set(manifest["files"]) == set(setup_mod.SKILLS)
+
+    # simulate an older pristine bundle for one skill and a local edit of another
+    (skills / "running-evals.md").write_text("old bundle content\n")
+    manifest["files"]["running-evals.md"] = __import__("hashlib").sha256(
+        b"old bundle content\n"
+    ).hexdigest()
+    (skills / "MANIFEST.json").write_text(json.dumps(manifest))
+    (skills / "tpu-debugging.md").write_text("MY local notes\n")
+
+    report = setup_workspace(tmp_path, agents=("claude",))
+    assert (skills / "running-evals.md").read_text() == setup_mod.SKILLS["running-evals.md"]
+    assert (skills / "tpu-debugging.md").read_text() == "MY local notes\n"
+    assert any("tpu-debugging.md" in s for s in report.skipped)
+    # force overwrites even local edits
+    setup_workspace(tmp_path, agents=("claude",), force_skills=True)
+    assert (skills / "tpu-debugging.md").read_text() == setup_mod.SKILLS["tpu-debugging.md"]
+
+
+def test_setup_registers_mcp_servers_additively(tmp_path):
+    import json
+
+    from prime_tpu.lab.setup import setup_workspace
+
+    (tmp_path / ".mcp.json").write_text(
+        json.dumps({"mcpServers": {"other": {"command": "x"}}})
+    )
+    setup_workspace(tmp_path, agents=("claude", "cursor"))
+    claude_cfg = json.loads((tmp_path / ".mcp.json").read_text())
+    assert claude_cfg["mcpServers"]["other"] == {"command": "x"}  # preserved
+    assert claude_cfg["mcpServers"]["prime-lab"]["args"] == ["lab", "mcp"]
+    cursor_cfg = json.loads((tmp_path / ".cursor" / "mcp.json").read_text())
+    assert "prime-lab" in cursor_cfg["mcpServers"]
+    # idempotent: second run reports unchanged, not updated
+    report = setup_workspace(tmp_path, agents=("claude",))
+    assert str(tmp_path / ".mcp.json") in report.unchanged
+
+
+def test_setup_surface_matrix_and_hygiene_report(tmp_path):
+    from prime_tpu.lab.setup import AGENT_GUIDE, setup_workspace
+
+    report = setup_workspace(tmp_path, agents=("gemini", "windsurf"))
+    assert AGENT_GUIDE.splitlines()[0] in (tmp_path / "GEMINI.md").read_text()
+    assert (tmp_path / ".windsurf" / "rules" / "prime-lab.md").exists()
+    assert isinstance(report.hygiene, list)  # preflight ran in the same pass
+    agents_json = (tmp_path / ".prime-lab" / "agents.json").read_text()
+    assert '"agents": []' in agents_json
+
+
+def test_skill_bundle_downgrade_guard_and_bad_mcp_configs(tmp_path):
+    import json
+
+    from prime_tpu.lab import setup as setup_mod
+    from prime_tpu.lab.setup import setup_workspace
+
+    setup_workspace(tmp_path, agents=("claude",))
+    skills = tmp_path / ".prime-lab" / "skills"
+    manifest = json.loads((skills / "MANIFEST.json").read_text())
+    manifest["version"] = setup_mod.SKILLS_VERSION + 5  # teammate's newer CLI
+    (skills / "MANIFEST.json").write_text(json.dumps(manifest))
+    (skills / "running-evals.md").write_text("newer bundle content\n")
+    report = setup_workspace(tmp_path, agents=("claude",))
+    assert (skills / "running-evals.md").read_text() == "newer bundle content\n"
+    assert any("newer than this CLI" in s for s in report.skipped)
+
+    # non-object configs are skipped, never overwritten or crashed on
+    (tmp_path / ".mcp.json").write_text("[1, 2]")
+    report = setup_workspace(tmp_path, agents=("claude",))
+    assert (tmp_path / ".mcp.json").read_text() == "[1, 2]"
+    assert any("not a JSON object" in s for s in report.skipped)
+    (tmp_path / ".mcp.json").write_text(json.dumps({"mcpServers": None}))
+    report = setup_workspace(tmp_path, agents=("claude",))
+    assert json.loads((tmp_path / ".mcp.json").read_text())["mcpServers"] is None
+    assert any("mcpServers is not an object" in s for s in report.skipped)
