@@ -1,0 +1,212 @@
+"""Lazy access + aggregate statistics for local eval run records.
+
+The eval runner writes one JSON object per line to ``results.jsonl``
+(`prime_tpu/evals/runner.py`); a long run can hold tens of thousands of
+samples, so the Lab shell must not slurp the whole file to show one of them.
+``IndexedJsonl`` keeps a byte-offset index and a bounded parsed-row cache:
+random access costs one seek + one json.loads, memory stays O(cache), and a
+row written while the shell is open is picked up by a later ``refresh()``.
+
+``run_overview`` computes the aggregate view (reward distribution, pass rate,
+per-metric summaries) in ONE streaming pass without retaining rows.
+
+Reference roles: prime_lab_app/eval_records.py:109 (LazyRunResults) and
+eval_records.py:55 (RunOverviewStats/MetricSummary) — redesigned around a
+bounded cache + streaming aggregation instead of an unbounded dict cache.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Iterator
+
+
+class IndexedJsonl:
+    """Offset-indexed random access over a .jsonl file.
+
+    ``get(i)`` seeks to the i-th line and parses it; parsed rows live in an
+    LRU cache capped at ``cache_rows``. ``len()`` forces a full offset scan
+    (cheap: readline only, no parsing). A malformed line yields ``{}`` so one
+    torn write cannot take down the browser.
+    """
+
+    def __init__(self, path: str | Path, cache_rows: int = 256) -> None:
+        self.path = Path(path)
+        self._offsets: list[int] = []
+        self._scanned = 0  # bytes consumed by the offset scan so far
+        self._eof = False
+        self._cache: OrderedDict[int, dict[str, Any]] = OrderedDict()
+        self._cache_rows = cache_rows
+
+    # -- offset index ----------------------------------------------------------
+
+    def _scan_to(self, index: int | None) -> None:
+        """Extend the offset index to cover ``index`` (None = to EOF)."""
+        if self._eof or (index is not None and index < len(self._offsets)):
+            return
+        try:
+            with self.path.open("rb") as fh:
+                fh.seek(self._scanned)
+                while index is None or len(self._offsets) <= index:
+                    pos = fh.tell()
+                    line = fh.readline()
+                    if not line:
+                        self._eof = True
+                        break
+                    if not line.endswith(b"\n"):
+                        # torn final line: a writer is mid-append. Do not
+                        # index it; a later refresh() re-reads from here.
+                        break
+                    self._offsets.append(pos)
+                    self._scanned = fh.tell()
+        except OSError:
+            self._eof = True
+
+    def refresh(self) -> None:
+        """Pick up rows appended since the last scan (live runs)."""
+        self._eof = False
+
+    def __len__(self) -> int:
+        self._scan_to(None)
+        return len(self._offsets)
+
+    def count_so_far(self) -> int:
+        """Rows indexed without forcing a full scan."""
+        return len(self._offsets)
+
+    # -- row access ------------------------------------------------------------
+
+    def get(self, index: int) -> dict[str, Any]:
+        if index in self._cache:
+            self._cache.move_to_end(index)
+            return self._cache[index]
+        self._scan_to(index)
+        if not 0 <= index < len(self._offsets):
+            return {}
+        try:
+            with self.path.open("rb") as fh:
+                fh.seek(self._offsets[index])
+                raw = fh.readline()
+        except OSError:
+            return {}
+        try:
+            row = json.loads(raw)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            row = {}
+        row = row if isinstance(row, dict) else {}
+        self._cache[index] = row
+        if len(self._cache) > self._cache_rows:
+            self._cache.popitem(last=False)
+        return row
+
+    def __getitem__(self, index: int) -> dict[str, Any]:
+        return self.get(index)
+
+    def __iter__(self) -> Iterator[dict[str, Any]]:
+        """Stream rows WITHOUT populating the cache (aggregation path).
+
+        Capped at the indexed row count so iteration and ``get``/``len`` always
+        agree: rows appended after the index froze (_eof) stay invisible to
+        BOTH until ``refresh()`` — no phantom rows in filtered views.
+        """
+        self._scan_to(None)
+        count = len(self._offsets)
+        try:
+            with self.path.open("rb") as fh:
+                for _ in range(count):
+                    raw = fh.readline()
+                    try:
+                        row = json.loads(raw)
+                    except (json.JSONDecodeError, UnicodeDecodeError):
+                        row = {}
+                    yield row if isinstance(row, dict) else {}
+        except OSError:
+            return
+
+    def column(self, key: str) -> list[Any]:
+        """One field across all rows, streamed (no row cache pollution)."""
+        return [row.get(key) for row in self]
+
+
+@dataclass(frozen=True)
+class MetricSummary:
+    name: str
+    count: int
+    mean: float
+    minimum: float
+    maximum: float
+
+
+@dataclass
+class RunOverview:
+    """Aggregates for one local eval run, computed in a single pass."""
+
+    n_samples: int = 0
+    rewards: list[float] = field(default_factory=list)
+    pass_rate: float | None = None
+    metrics: list[MetricSummary] = field(default_factory=list)
+
+    @property
+    def mean_reward(self) -> float | None:
+        return sum(self.rewards) / len(self.rewards) if self.rewards else None
+
+    def reward_histogram(self, bins: int = 10) -> list[int]:
+        """Counts per equal-width bin over [min, max] (empty → [])."""
+        if not self.rewards:
+            return []
+        lo, hi = min(self.rewards), max(self.rewards)
+        counts = [0] * bins
+        span = hi - lo
+        for value in self.rewards:
+            if span <= 0:
+                counts[0] += 1
+            else:
+                counts[min(int((value - lo) / span * bins), bins - 1)] += 1
+        return counts
+
+
+# fields that are per-sample bookkeeping, not scoreable metrics
+_NON_METRIC_KEYS = {"prompt", "completion", "answer", "sample_index", "tokens"}
+
+
+def run_overview(records: IndexedJsonl | str | Path) -> RunOverview:
+    """Stream ``results.jsonl`` once and aggregate.
+
+    ``reward`` feeds the distribution; ``correct`` feeds pass rate; every
+    OTHER numeric field becomes a MetricSummary (so custom env metrics —
+    format rewards, tool-call counts — show up without schema knowledge).
+    """
+    if not isinstance(records, IndexedJsonl):
+        records = IndexedJsonl(records)
+    overview = RunOverview()
+    n_correct = 0
+    n_flagged = 0
+    sums: dict[str, tuple[int, float, float, float]] = {}
+    for row in records:
+        overview.n_samples += 1
+        reward = row.get("reward")
+        if isinstance(reward, (int, float)) and math.isfinite(reward):
+            overview.rewards.append(float(reward))
+        if "correct" in row:
+            n_flagged += 1
+            n_correct += bool(row["correct"])
+        for key, value in row.items():
+            if key in _NON_METRIC_KEYS or key == "reward" or key == "correct":
+                continue
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                continue
+            if not math.isfinite(value):
+                continue
+            count, total, lo, hi = sums.get(key, (0, 0.0, float("inf"), float("-inf")))
+            sums[key] = (count + 1, total + value, min(lo, value), max(hi, value))
+    if n_flagged:
+        overview.pass_rate = n_correct / n_flagged
+    overview.metrics = [
+        MetricSummary(name=k, count=c, mean=t / c, minimum=lo, maximum=hi)
+        for k, (c, t, lo, hi) in sorted(sums.items())
+    ]
+    return overview

@@ -140,6 +140,13 @@ class PrimeLabApp:
                 self.quit = True
                 return
             result = screen.on_key(key)
+            child = getattr(screen, "child", None)
+            if child is not None:
+                # a screen may hand off a deeper screen (overview -> samples)
+                screen.child = None
+                self.screens.append(child)
+                self.status = f"{child.title} · esc: back"
+                return
             if result == CLOSE:
                 self.screens.pop()
                 self.status = "back"
@@ -169,6 +176,10 @@ class PrimeLabApp:
             self.refresh_current()
         elif key == "R":
             self.refresh_all()
+        elif key == "e" and self.section == "launch" and self.focus == "rows":
+            self._open_card_editor()
+        elif key == "n" and self.section == "launch":
+            self._open_card_editor(new=True)
         elif key == "enter":
             self._on_enter()
 
@@ -274,6 +285,24 @@ class PrimeLabApp:
             return
         self.screens.append(screen)
         self.status = f"{screen.title} · esc: back"
+
+    def _open_card_editor(self, new: bool = False) -> None:
+        from prime_tpu.lab.tui.editor import ConfigCardEditor, new_card
+
+        if new:
+            card = new_card(self.workspace)
+        else:
+            row = self.selected_row()
+            if row is None:
+                return
+            cards = {str(c.path): c for c in scan_cards(self.workspace)}
+            card = cards.get(row.get("path", ""))
+            if card is None:
+                self.status = "card disappeared"
+                return
+        self._armed_launch = None
+        self.screens.append(ConfigCardEditor(card, api_factory=self._platform_api))
+        self.status = f"editing {card.path.name} · s: save · esc: back"
 
     # -- refresh --------------------------------------------------------------
 

@@ -54,15 +54,18 @@ class EvalSampleBrowser(DetailScreen):
     """Per-sample prompt/completion/answer/reward browser with filter and
     search (reference eval_screen.py RolloutViewer:560 role).
 
-    ``samples``: [{"prompt", "completion", "answer", "reward", "correct"}].
+    ``samples``: [{"prompt", "completion", "answer", "reward", "correct"}] —
+    a list, or any lazy sequence with ``__len__``/``__getitem__``/``__iter__``
+    (``evalrecords.IndexedJsonl`` for big local runs).
     Keys: n/→ next · p/← prev · g/G first/last · f cycle filter
     (all → correct → incorrect) · / incremental search (enter jumps to the
-    next match, esc cancels) · j/k scroll long sample text · esc back.
+    next match, esc cancels) · j/k scroll long sample text · m toggle
+    markdown/LaTeX rendering · esc back.
     """
 
     FILTERS = ("all", "correct", "incorrect")
 
-    def __init__(self, title: str, samples: list[dict[str, Any]], source: str = "") -> None:
+    def __init__(self, title: str, samples, source: str = "") -> None:
         self.title = title
         self.samples = samples
         self.source = source
@@ -71,15 +74,22 @@ class EvalSampleBrowser(DetailScreen):
         self.filter_mode = "all"
         self.search = ""
         self.search_input: str | None = None  # non-None = capturing keys
+        self.rendered = False  # m: markdown/LaTeX translation of sample text
+        self._flags: list[bool] | None = None  # per-row `correct`, one pass
 
     # -- sample selection ------------------------------------------------------
 
     def visible(self) -> list[int]:
-        """Indices of samples passing the filter."""
+        """Indices of samples passing the filter. Correctness flags are
+        extracted in ONE streaming pass and cached — visible() runs on every
+        keypress and render, and must stay O(n-bools) even when ``samples``
+        is a lazily-parsed IndexedJsonl over a huge file."""
         if self.filter_mode == "all":
             return list(range(len(self.samples)))
+        if self._flags is None:
+            self._flags = [bool(s.get("correct")) for s in self.samples]
         want = self.filter_mode == "correct"
-        return [i for i, s in enumerate(self.samples) if bool(s.get("correct")) == want]
+        return [i for i, flag in enumerate(self._flags) if flag == want]
 
     def current(self) -> dict[str, Any] | None:
         vis = self.visible()
@@ -157,6 +167,10 @@ class EvalSampleBrowser(DetailScreen):
             self.scroll += _PAGE // 2
         elif key == "k":
             self.scroll = max(0, self.scroll - _PAGE // 2)
+        elif key == "m":
+            self.rendered = not self.rendered
+            self.scroll = 0
+            return f"markdown rendering {'on' if self.rendered else 'off'}"
         else:
             return super().on_key(key)
         return None
@@ -187,8 +201,16 @@ class EvalSampleBrowser(DetailScreen):
         body_lines: list[tuple[str, str]] = []  # (style, line)
         for label, key in (("PROMPT", "prompt"), ("COMPLETION", "completion"), ("ANSWER", "answer")):
             body_lines.append(("bold cyan", f"── {label} " + "─" * 40))
-            for line in _wrap(sample.get(key, "")):
-                body_lines.append(("", line))
+            content = str(sample.get(key, ""))
+            if self.rendered:
+                from prime_tpu.lab.tui.markdown import markdown_lines
+
+                for style, line in markdown_lines(content):
+                    for piece in _wrap(line):
+                        body_lines.append((style, piece))
+            else:
+                for line in _wrap(content):
+                    body_lines.append(("", line))
         window = body_lines[self.scroll : self.scroll + _PAGE]
         if self.scroll and not window:
             self.scroll = max(0, len(body_lines) - _PAGE)
@@ -199,12 +221,105 @@ class EvalSampleBrowser(DetailScreen):
         if len(body_lines) > self.scroll + _PAGE:
             text.append(f"… {len(body_lines) - self.scroll - _PAGE} more lines (j/k)", style="dim")
         footer = Text(
-            "n/p sample · f filter · / search · j/k scroll · esc back",
+            "n/p sample · f filter · / search · j/k scroll · m markdown · esc back",
             style="dim",
         )
         if self.search_input is not None:
             footer = Text(f"search: {self.search_input}▌", style="bold")
         return Group(head, Text(""), text, Text(""), footer)
+
+
+class EvalRunOverview(DetailScreen):
+    """Aggregate view of one eval run BEFORE per-sample drill-down
+    (reference eval_screen.py overview + eval_records.py:55 RunOverviewStats
+    role): pass rate, reward distribution, per-metric summaries — streamed
+    once from results.jsonl, no rows retained.
+
+    Keys: enter/s open the sample browser · r re-stream (live runs) ·
+    esc back.
+    """
+
+    def __init__(
+        self,
+        title: str,
+        records,
+        info: dict[str, Any] | None = None,
+        source: str = "",
+    ) -> None:
+        from prime_tpu.lab.evalrecords import run_overview
+
+        self.title = title
+        self.records = records
+        self.info = info or {}
+        self.source = source
+        self.overview = run_overview(records)
+        self.child: DetailScreen | None = None
+
+    def on_key(self, key: str) -> str | None:
+        if key in ("enter", "s"):
+            self.child = EvalSampleBrowser(
+                title=self.title, samples=self.records, source=self.source
+            )
+            return None
+        if key == "r":
+            from prime_tpu.lab.evalrecords import run_overview
+
+            refresh = getattr(self.records, "refresh", None)
+            if refresh is not None:
+                refresh()
+            self.overview = run_overview(self.records)
+            return f"reloaded: {self.overview.n_samples} samples"
+        return super().on_key(key)
+
+    def render(self):
+        from rich.console import Group
+        from rich.table import Table
+        from rich.text import Text
+
+        from prime_tpu.lab.tui.charts import BLOCKS
+
+        ov = self.overview
+        head = Table.grid(padding=(0, 2))
+        for key in ("env", "model", "runId"):
+            if self.info.get(key):
+                head.add_row(Text(key, style="dim"), Text(str(self.info[key])))
+        head.add_row(Text("samples", style="dim"), Text(str(ov.n_samples)))
+        if ov.pass_rate is not None:
+            head.add_row(
+                Text("pass rate", style="dim"),
+                Text(f"{ov.pass_rate:.1%}", style="green" if ov.pass_rate >= 0.5 else "red"),
+            )
+        if ov.mean_reward is not None:
+            head.add_row(Text("mean reward", style="dim"), Text(f"{ov.mean_reward:.4f}"))
+
+        parts: list[Any] = [head]
+        hist = ov.reward_histogram(bins=12)
+        if hist and ov.rewards:
+            peak = max(hist)
+            bars = "".join(
+                BLOCKS[int(c / peak * (len(BLOCKS) - 1))] if peak else BLOCKS[0] for c in hist
+            )
+            lo, hi = min(ov.rewards), max(ov.rewards)
+            parts.append(Text(""))
+            parts.append(
+                Text(f"reward dist  {lo:.2f} {bars} {hi:.2f}", style="cyan")
+            )
+        if ov.metrics:
+            grid = Table.grid(padding=(0, 2))
+            grid.add_row(*(Text(h, style="bold dim") for h in ("metric", "n", "mean", "min", "max")))
+            for m in ov.metrics:
+                grid.add_row(
+                    Text(m.name),
+                    Text(str(m.count), style="dim"),
+                    Text(f"{m.mean:.4g}"),
+                    Text(f"{m.minimum:.4g}", style="dim"),
+                    Text(f"{m.maximum:.4g}", style="dim"),
+                )
+            parts.append(Text(""))
+            parts.append(grid)
+        parts.append(Text(""))
+        parts.append(Text("enter samples · r reload · esc back", style="dim"))
+        return Group(*parts)
 
 
 class TrainingRunDetail(DetailScreen):
@@ -444,15 +559,17 @@ class EnvDetail(DetailScreen):
 # -- constructors from app rows (data loading happens HERE, once) -------------
 
 
-def load_local_eval_detail(row: dict[str, Any]) -> EvalSampleBrowser:
-    """results.jsonl from a local run dir → sample browser."""
-    from prime_tpu.lab.data import read_jsonl
+def load_local_eval_detail(row: dict[str, Any]) -> EvalRunOverview:
+    """results.jsonl from a local run dir → overview screen (enter drills
+    into the lazily-backed sample browser)."""
+    from prime_tpu.lab.evalrecords import IndexedJsonl
 
     run_dir = Path(row.get("dir", ""))
-    samples = read_jsonl(run_dir / "results.jsonl")
-    return EvalSampleBrowser(
+    records = IndexedJsonl(run_dir / "results.jsonl")
+    return EvalRunOverview(
         title=f"eval: {row.get('env', '?')}/{row.get('runId', '?')}",
-        samples=samples,
+        records=records,
+        info=row,
         source=str(run_dir),
     )
 

@@ -1,0 +1,200 @@
+"""Config-card editor screen (reference config_screen.py / config_factory.py /
+toml_format.py roles): edit a launch card's fields natively, save it back as
+TOML, and launch without leaving the shell.
+
+Pure state machine like every detail screen. Modes:
+- browse: j/k move over fields · enter edit the selected value · a add field
+  ("key=value") · d delete field · s save · L launch (saved card) · esc back
+- input: printable chars type · enter commit · esc cancel
+
+Values are typed on commit (int / float / bool / string via
+launch.parse_field_value) so a TOML round-trip preserves types.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from prime_tpu.lab.tui.detail import CLOSE, DetailScreen
+from prime_tpu.lab.tui.launch import (
+    LaunchCard,
+    LaunchError,
+    launch_card,
+    parse_field_value,
+    save_card,
+)
+
+
+class ConfigCardEditor(DetailScreen):
+    # pseudo-field key for the card's [launch].name — dotted so it can never
+    # collide with a payload key (add rejects dotted keys; scan_cards payloads
+    # are flat bare keys)
+    NAME_FIELD = "launch.name"
+
+    def __init__(self, card: LaunchCard, api_factory: Callable[[], Any] | None = None) -> None:
+        self.card = card
+        self.title = f"edit: {card.path.name}"
+        self._api_factory = api_factory
+        # ordered working copy; the name pseudo-field first, payload after
+        self.fields: list[tuple[str, Any]] = [(self.NAME_FIELD, card.name)] + list(
+            card.payload.items()
+        )
+        self.cursor = 0
+        # a card that has never been written (new_card template) starts dirty
+        # so the launch guard forces an explicit save first
+        self.dirty = not card.path.exists()
+        self.input: str | None = None   # non-None = capturing (also guards 'q')
+        self.input_mode = ""            # "edit" | "add"
+        self.message = ""
+
+    # the shell's 'q'-quits guard keys off this attribute name
+    @property
+    def search_input(self) -> str | None:
+        return self.input
+
+    # -- field ops -------------------------------------------------------------
+
+    def _commit_edit(self, text: str) -> str:
+        key, _ = self.fields[self.cursor]
+        value = parse_field_value(text) if key != self.NAME_FIELD else text.strip()
+        self.fields[self.cursor] = (key, value)
+        self.dirty = True
+        return f"{key} = {value!r}"
+
+    def _commit_add(self, text: str) -> str:
+        key, sep, raw = text.partition("=")
+        key = key.strip()
+        if not sep or not key:
+            return "add expects key=value"
+        if not key.replace("_", "").replace("-", "").isalnum():
+            # dotted/quoted keys would nest on TOML reparse and corrupt the
+            # flat-scalar payload contract — reject at entry
+            return f"key {key!r} must be bare (letters, digits, _ or -)"
+        if any(k == key for k, _ in self.fields):
+            return f"{key} already exists (edit it instead)"
+        self.fields.append((key, parse_field_value(raw)))
+        self.cursor = len(self.fields) - 1
+        self.dirty = True
+        return f"added {key}"
+
+    def _sync_card(self) -> None:
+        for key, value in self.fields:
+            if key == self.NAME_FIELD:
+                self.card.name = str(value)
+        self.card.payload = {k: v for k, v in self.fields if k != self.NAME_FIELD}
+
+    def save(self) -> str:
+        self._sync_card()
+        try:
+            save_card(self.card)
+        except (LaunchError, OSError) as e:
+            return f"save failed: {e}"
+        self.dirty = False
+        return f"saved {self.card.path.name}"
+
+    def launch(self) -> str:
+        if self.dirty:
+            return "unsaved changes — press s first"
+        api = self._api_factory() if self._api_factory is not None else None
+        if api is None:
+            return "no platform client (offline)"
+        self._sync_card()
+        try:
+            result = launch_card(self.card, api)
+        except LaunchError as e:
+            return f"launch failed: {e}"
+        except Exception as e:  # noqa: BLE001 - network surface
+            return f"launch failed: {e}"
+        return f"launched {result['kind']} {result['id']} ({result['status']})"
+
+    # -- keys ------------------------------------------------------------------
+
+    def on_key(self, key: str) -> str | None:
+        if self.input is not None:
+            if key == "enter":
+                text, self.input = self.input, None
+                self.message = (
+                    self._commit_edit(text) if self.input_mode == "edit" else self._commit_add(text)
+                )
+                return self.message
+            if key == "escape":
+                self.input = None
+                return "cancelled"
+            if key == "backspace":
+                self.input = self.input[:-1]
+            elif len(key) == 1 and key.isprintable():
+                self.input += key
+            return None
+        if key in ("j", "down"):
+            self.cursor = min(self.cursor + 1, len(self.fields) - 1)
+        elif key in ("k", "up"):
+            self.cursor = max(0, self.cursor - 1)
+        elif key == "enter":
+            _, value = self.fields[self.cursor]
+            self.input, self.input_mode = str(value), "edit"
+        elif key == "a":
+            self.input, self.input_mode = "", "add"
+            return "add field: key=value"
+        elif key == "d":
+            k, _ = self.fields[self.cursor]
+            if k == self.NAME_FIELD:
+                return "the name field cannot be deleted"
+            del self.fields[self.cursor]
+            self.cursor = min(self.cursor, len(self.fields) - 1)
+            self.dirty = True
+            return f"deleted {k}"
+        elif key == "s":
+            return self.save()
+        elif key == "L":
+            return self.launch()
+        else:
+            return super().on_key(key)
+        return None
+
+    # -- render ----------------------------------------------------------------
+
+    def render(self):
+        from rich.console import Group
+        from rich.table import Table
+        from rich.text import Text
+
+        head = Text(
+            f"[launch] kind={self.card.kind}" + ("  · unsaved changes" if self.dirty else ""),
+            style="yellow" if self.dirty else "dim",
+        )
+        grid = Table.grid(padding=(0, 2))
+        for index, (key, value) in enumerate(self.fields):
+            selected = index == self.cursor
+            if selected and self.input is not None:
+                shown = Text(f"{self.input}▌", style="bold reverse")
+            else:
+                shown = Text(str(value), style="reverse" if selected else "")
+            grid.add_row(Text(key, style="bold" if selected else "dim"), shown)
+        footer = Text(
+            "enter edit · a add · d delete · s save · L launch · esc back",
+            style="dim",
+        )
+        parts: list[Any] = [head, Text(""), grid, Text("")]
+        if self.message:
+            parts.append(Text(self.message, style="cyan"))
+        parts.append(footer)
+        return Group(*parts)
+
+
+def new_card(workspace, kind: str = "eval", name: str = "new-card") -> LaunchCard:
+    """Fresh card with a sensible template payload (config_factory.py role).
+    Not yet written to disk — the editor's save does that."""
+    from pathlib import Path
+
+    base = Path(workspace) / ".prime-lab" / "launch"
+    stem = name
+    counter = 1
+    while (base / f"{stem}.toml").exists():
+        counter += 1
+        stem = f"{name}-{counter}"
+    payload = (
+        {"env": "gsm8k", "model": "llama3-8b", "tpu_type": "v5e-8"}
+        if kind == "eval"
+        else {"model": "llama3-8b", "env": "arith-rl", "steps": 100}
+    )
+    return LaunchCard(path=base / f"{stem}.toml", kind=kind, name=stem, payload=payload)

@@ -69,6 +69,61 @@ def scan_cards(workspace: str | Path = ".") -> list[LaunchCard]:
     return cards
 
 
+def format_toml(card: LaunchCard) -> str:
+    """Serialize a card back to TOML (reference toml_format.py role). Flat
+    scalar payloads only — exactly what scan_cards accepts."""
+
+    def literal(value: Any) -> str:
+        if isinstance(value, bool):
+            return "true" if value else "false"
+        if isinstance(value, (int, float)):
+            return str(value)
+        text = str(value).replace("\\", "\\\\").replace('"', '\\"')
+        return f'"{text}"'
+
+    def bare(key: str) -> str:
+        # non-bare keys are quoted so they stay FLAT on reparse (an unquoted
+        # dotted key would nest and corrupt the scalar payload contract)
+        if key and key.replace("_", "").replace("-", "").isalnum():
+            return key
+        return literal(key)
+
+    lines = ["[launch]", f'kind = "{card.kind}"', f"name = {literal(card.name)}", ""]
+    lines.append(f"[{card.kind}]")
+    for key, value in card.payload.items():
+        lines.append(f"{bare(key)} = {literal(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def save_card(card: LaunchCard) -> None:
+    """Write the card to its path; a reparse failure means a bug in
+    format_toml, surfaced as LaunchError rather than a corrupt card."""
+    text = format_toml(card)
+    try:
+        reparsed = tomllib.loads(text)
+    except tomllib.TOMLDecodeError as e:  # pragma: no cover - formatter bug
+        raise LaunchError(f"card would not reparse: {e}") from e
+    if reparsed.get("launch", {}).get("kind") != card.kind:
+        raise LaunchError("card would lose its kind on reparse")  # pragma: no cover
+    if reparsed.get(card.kind) != card.payload:
+        raise LaunchError("card payload would not round-trip")
+    card.path.parent.mkdir(parents=True, exist_ok=True)
+    card.path.write_text(text)
+
+
+def parse_field_value(text: str) -> Any:
+    """Editor input -> typed TOML value (int / float / bool / string)."""
+    stripped = text.strip()
+    if stripped.lower() in ("true", "false"):
+        return stripped.lower() == "true"
+    for cast in (int, float):
+        try:
+            return cast(stripped)
+        except ValueError:
+            continue
+    return stripped
+
+
 def launch_card(card: LaunchCard, api_client) -> dict[str, Any]:
     """Submit a card through the platform clients. Returns {id, kind, status}."""
     if not card.payload:

@@ -1,0 +1,158 @@
+"""Markdown + LaTeX-lite rendering for eval transcripts in the Lab shell.
+
+Math-heavy envs (gsm8k, MATH) emit prompts/completions full of ``$\\frac{a}{b}$``
+and ``\\[ ... \\]`` spans; raw LaTeX in a terminal pane is unreadable. The
+reference renders these through markdown-it + a math plugin inside Textual
+(prime_lab_app/eval_markdown.py:89-151); this stack has no markdown-it, so it
+ships a small deterministic translator: LaTeX → plain unicode text, markdown
+block structure → (style, line) tuples the detail screens already render.
+
+Deliberately lossy-but-legible: unknown commands degrade to their argument
+text, never to a parse error.
+"""
+
+from __future__ import annotations
+
+import re
+
+# single-token LaTeX commands with a direct unicode spelling
+_SYMBOLS = {
+    "times": "×", "cdot": "·", "div": "÷", "pm": "±", "le": "≤", "leq": "≤",
+    "ge": "≥", "geq": "≥", "ne": "≠", "neq": "≠", "approx": "≈", "infty": "∞",
+    "sum": "Σ", "prod": "Π", "int": "∫", "pi": "π", "alpha": "α", "beta": "β",
+    "gamma": "γ", "delta": "δ", "epsilon": "ε", "theta": "θ", "lambda": "λ",
+    "mu": "μ", "sigma": "σ", "phi": "φ", "omega": "ω", "rightarrow": "→",
+    "to": "→", "leftarrow": "←", "Rightarrow": "⇒", "in": "∈", "subset": "⊂",
+    "cup": "∪", "cap": "∩", "forall": "∀", "exists": "∃", "sqrt": "√",
+    "angle": "∠", "degree": "°", "circ": "°", "percent": "%", "ldots": "…",
+    "dots": "…", "cdots": "⋯", "quad": " ", "qquad": "  ", ",": " ", ";": " ",
+    "!": "", "equiv": "≡", "propto": "∝", "partial": "∂", "nabla": "∇",
+}
+
+_SUPERSCRIPTS = str.maketrans("0123456789+-ni", "⁰¹²³⁴⁵⁶⁷⁸⁹⁺⁻ⁿⁱ")
+_SUBSCRIPTS = str.maketrans("0123456789+-", "₀₁₂₃₄₅₆₇₈₉₊₋")
+
+
+def _take_group(text: str, start: int) -> tuple[str, int]:
+    """Return (content, index_after) of the {...} group at ``start`` (which
+    must point at '{'), honoring nesting. No group → single char."""
+    if start >= len(text):
+        return "", start
+    if text[start] != "{":
+        return text[start], start + 1
+    depth = 0
+    for i in range(start, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start + 1 : i], i + 1
+    return text[start + 1 :], len(text)  # unbalanced: rest of string
+
+
+def latex_to_text(latex: str) -> str:
+    """Translate a LaTeX math fragment to plain unicode text."""
+    out: list[str] = []
+    i = 0
+    text = latex
+    while i < len(text):
+        ch = text[i]
+        if ch == "\\":
+            match = re.match(r"\\([a-zA-Z]+|.)", text[i:])
+            if not match:
+                i += 1
+                continue
+            command = match.group(1)
+            i += match.end()
+            if command == "frac":
+                num, i = _take_group(text, i)
+                den, i = _take_group(text, i)
+                out.append(f"({latex_to_text(num)})/({latex_to_text(den)})")
+            elif command == "sqrt":
+                arg, i = _take_group(text, i)
+                out.append(f"√({latex_to_text(arg)})")
+            elif command in ("text", "mathrm", "mathbf", "mathit", "textbf", "operatorname", "boxed"):
+                arg, i = _take_group(text, i)
+                rendered = latex_to_text(arg)
+                out.append(f"[{rendered}]" if command == "boxed" else rendered)
+            elif command in ("left", "right", "big", "Big"):
+                pass  # sizing only; the delimiter itself follows as a literal
+            elif command in _SYMBOLS:
+                out.append(_SYMBOLS[command])
+            else:
+                out.append(command)  # unknown command: degrade to its name
+        elif ch == "^":
+            arg, i = _take_group(text, i + 1)
+            plain = latex_to_text(arg)
+            if plain and all(c in "0123456789+-ni" for c in plain):
+                out.append(plain.translate(_SUPERSCRIPTS))
+            else:
+                out.append(f"^({plain})")
+        elif ch == "_":
+            arg, i = _take_group(text, i + 1)
+            plain = latex_to_text(arg)
+            if plain and all(c in "0123456789+-" for c in plain):
+                out.append(plain.translate(_SUBSCRIPTS))
+            else:
+                out.append(f"_({plain})")
+        elif ch in "{}":
+            i += 1
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+_MATH_SPANS = (
+    re.compile(r"\$\$(.+?)\$\$", re.DOTALL),
+    re.compile(r"\\\[(.+?)\\\]", re.DOTALL),
+    re.compile(r"\\\((.+?)\\\)"),
+    re.compile(r"\$([^$\n]+?)\$"),
+)
+
+
+def replace_math(text: str) -> str:
+    """Replace every $..$/$$..$$/\\(..\\)/\\[..\\] span with its translation."""
+    for pattern in _MATH_SPANS:
+        text = pattern.sub(lambda m: latex_to_text(m.group(1).strip()), text)
+    return text
+
+
+_INLINE_BOLD = re.compile(r"\*\*(.+?)\*\*")
+_INLINE_CODE = re.compile(r"`([^`]+)`")
+
+
+def markdown_lines(text: str, math: bool = True) -> list[tuple[str, str]]:
+    """Markdown → (style, line) tuples for the detail screens' text window.
+
+    Handles: #-headers, fenced code blocks, bullets, blockquotes, bold/code
+    marker stripping, math spans. Everything else passes through verbatim.
+    """
+    lines: list[tuple[str, str]] = []
+    in_fence = False
+    for raw in text.splitlines():
+        stripped = raw.strip()
+        if stripped.startswith("```"):
+            in_fence = not in_fence
+            tag = stripped[3:].strip()
+            lines.append(("dim", f"┌─ {tag or 'code'}" if in_fence else "└─"))
+            continue
+        if in_fence:
+            lines.append(("cyan", "│ " + raw))
+            continue
+        if math:
+            raw = replace_math(raw)
+        raw = _INLINE_BOLD.sub(lambda m: m.group(1), raw)
+        raw = _INLINE_CODE.sub(lambda m: m.group(1), raw)
+        header = re.match(r"^(#{1,6})\s+(.*)", raw)
+        if header:
+            lines.append(("bold magenta", header.group(2)))
+        elif raw.lstrip().startswith(("- ", "* ")):
+            indent = len(raw) - len(raw.lstrip())
+            lines.append(("", " " * indent + "• " + raw.lstrip()[2:]))
+        elif raw.lstrip().startswith("> "):
+            lines.append(("dim italic", raw.lstrip()[2:]))
+        else:
+            lines.append(("", raw))
+    return lines

@@ -1,0 +1,183 @@
+"""Lazy eval-record access (lab/evalrecords.py) and transcript markdown
+rendering (lab/tui/markdown.py)."""
+
+import json
+
+from prime_tpu.lab.evalrecords import IndexedJsonl, run_overview
+from prime_tpu.lab.tui.markdown import latex_to_text, markdown_lines, replace_math
+
+
+def _write_results(path, n=20):
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(
+                json.dumps(
+                    {
+                        "prompt": f"q{i}",
+                        "completion": f"a{i}",
+                        "reward": i / max(n - 1, 1),
+                        "correct": i % 2 == 0,
+                        "format_reward": 0.5,
+                        "turns": i % 3,
+                    }
+                )
+                + "\n"
+            )
+    return path
+
+
+# -- IndexedJsonl --------------------------------------------------------------
+
+
+def test_indexed_jsonl_random_access(tmp_path):
+    path = _write_results(tmp_path / "results.jsonl", n=50)
+    records = IndexedJsonl(path, cache_rows=4)
+    assert records[17]["prompt"] == "q17"
+    assert records[0]["prompt"] == "q0"
+    assert records[49]["completion"] == "a49"
+    assert len(records) == 50
+    # out of range is empty, not an exception
+    assert records[99] == {}
+    assert records[-1] == {}
+
+
+def test_indexed_jsonl_cache_is_bounded(tmp_path):
+    path = _write_results(tmp_path / "results.jsonl", n=30)
+    records = IndexedJsonl(path, cache_rows=8)
+    for i in range(30):
+        records.get(i)
+    assert len(records._cache) == 8
+    # evicted rows re-parse correctly
+    assert records[0]["prompt"] == "q0"
+
+
+def test_indexed_jsonl_malformed_line_is_empty_dict(tmp_path):
+    path = tmp_path / "results.jsonl"
+    path.write_text('{"ok": 1}\nNOT JSON\n{"ok": 3}\n')
+    records = IndexedJsonl(path)
+    assert len(records) == 3
+    assert records[1] == {}
+    assert records[2]["ok"] == 3
+
+
+def test_indexed_jsonl_torn_tail_and_refresh(tmp_path):
+    path = tmp_path / "results.jsonl"
+    path.write_text('{"i": 0}\n{"i": 1')  # torn mid-append
+    records = IndexedJsonl(path)
+    assert len(records) == 1
+    # writer finishes the line and appends another
+    with open(path, "a") as f:
+        f.write('}\n{"i": 2}\n')
+    records.refresh()
+    assert len(records) == 3
+    assert records[1]["i"] == 1 and records[2]["i"] == 2
+
+
+def test_indexed_jsonl_iter_agrees_with_len_after_append(tmp_path):
+    """Appended rows are invisible to BOTH iteration and get() until
+    refresh() — a filtered view must never see rows get() refuses to serve."""
+    path = _write_results(tmp_path / "results.jsonl", n=4)
+    records = IndexedJsonl(path)
+    assert len(records) == 4  # freezes the index at EOF
+    with open(path, "a") as f:
+        f.write(json.dumps({"prompt": "late", "correct": True}) + "\n")
+    assert len(list(records)) == 4
+    assert records[4] == {}
+    records.refresh()
+    assert len(records) == 5
+    assert len(list(records)) == 5 and records[4]["prompt"] == "late"
+
+
+def test_indexed_jsonl_missing_file(tmp_path):
+    records = IndexedJsonl(tmp_path / "absent.jsonl")
+    assert len(records) == 0
+    assert records[0] == {}
+    assert list(records) == []
+
+
+# -- run_overview --------------------------------------------------------------
+
+
+def test_run_overview_aggregates(tmp_path):
+    path = _write_results(tmp_path / "results.jsonl", n=20)
+    ov = run_overview(path)
+    assert ov.n_samples == 20
+    assert ov.pass_rate == 0.5
+    assert abs(ov.mean_reward - 0.5) < 1e-9
+    by_name = {m.name: m for m in ov.metrics}
+    # custom numeric fields become metrics; bookkeeping fields do not
+    assert by_name["format_reward"].mean == 0.5
+    assert by_name["turns"].maximum == 2
+    assert "prompt" not in by_name and "reward" not in by_name
+    hist = ov.reward_histogram(bins=10)
+    assert sum(hist) == 20 and len(hist) == 10
+
+
+def test_run_overview_empty(tmp_path):
+    path = tmp_path / "results.jsonl"
+    path.write_text("")
+    ov = run_overview(path)
+    assert ov.n_samples == 0
+    assert ov.pass_rate is None and ov.mean_reward is None
+    assert ov.reward_histogram() == []
+
+
+def test_run_overview_constant_rewards_single_bin(tmp_path):
+    path = tmp_path / "results.jsonl"
+    with open(path, "w") as f:
+        for _ in range(5):
+            f.write(json.dumps({"reward": 1.0}) + "\n")
+    ov = run_overview(path)
+    hist = ov.reward_histogram(bins=4)
+    assert hist == [5, 0, 0, 0]
+
+
+# -- latex / markdown ----------------------------------------------------------
+
+
+def test_latex_fraction_sqrt_and_symbols():
+    assert latex_to_text(r"\frac{1}{2}") == "(1)/(2)"
+    assert latex_to_text(r"\sqrt{x+1}") == "√(x+1)"
+    assert latex_to_text(r"a \times b \le c") == "a × b ≤ c"
+    assert latex_to_text(r"\frac{\sqrt{2}}{2}") == "(√(2))/(2)"
+
+
+def test_latex_super_subscripts():
+    assert latex_to_text("x^2") == "x²"
+    assert latex_to_text("x^{10}") == "x¹⁰"
+    assert latex_to_text("a_1") == "a₁"
+    # non-translatable exponent degrades to ^(...) form
+    assert latex_to_text("x^{y+z}") == "x^(y+z)"
+
+
+def test_latex_text_and_boxed_and_unknown():
+    assert latex_to_text(r"\text{speed} = 5") == "speed = 5"
+    assert latex_to_text(r"\boxed{42}") == "[42]"
+    # unknown command degrades to its name, never an error
+    assert latex_to_text(r"\weirdcmd{x}") == "weirdcmd{x}".replace("{", "").replace("}", "")
+
+
+def test_replace_math_spans():
+    out = replace_math(r"the answer is $\frac{3}{4}$ of the total")
+    assert out == "the answer is (3)/(4) of the total"
+    out = replace_math("total: \\[ x^2 + 1 \\]")
+    assert "x² + 1" in out
+    # dollars inside distinct lines don't pair across lines
+    assert replace_math("costs $5 now") == "costs $5 now"
+
+
+def test_markdown_lines_structure():
+    text = "# Title\n\nsome **bold** and `code`\n- item one\n```python\nx = 1\n```\n> quoted"
+    lines = markdown_lines(text)
+    styles = dict(lines)
+    assert ("bold magenta", "Title") in lines
+    assert ("", "some bold and code") in lines
+    assert ("", "• item one") in lines
+    assert ("cyan", "│ x = 1") in lines
+    assert ("dim italic", "quoted") in lines
+    assert styles  # noqa: the dict form just proves uniqueness isn't required
+
+
+def test_markdown_lines_math_inside_prose():
+    lines = markdown_lines(r"Compute $\frac{a}{b}$ here")
+    assert ("", "Compute (a)/(b) here") in lines

@@ -375,12 +375,17 @@ def test_eval_detail_drilldown_and_back(app, tmp_path):
     _run_with_samples(tmp_path)
     app.tick()
     app.on_key("1")          # local-runs section, rows focus
-    app.on_key("enter")      # drill into sample browser
+    app.on_key("enter")      # drill into the run overview
     assert app.screens and "eval:" in app.screens[-1].title
+    text = render_text(app)
+    assert "pass rate" in text and "50.0%" in text and "reward dist" in text
+    app.on_key("enter")      # overview -> sample browser
     text = render_text(app)
     assert "sample 1/4" in text and "what is 0+0?" in text
     app.on_key("n")          # next sample
     assert "sample 2/4" in render_text(app)
+    app.on_key("escape")     # back to the overview
+    assert app.screens and app.screens[-1].__class__.__name__ == "EvalRunOverview"
     app.on_key("escape")     # back to the shell
     assert not app.screens
     assert "Local eval runs" in render_text(app)
@@ -390,7 +395,8 @@ def test_eval_detail_filter_and_search(app, tmp_path):
     _run_with_samples(tmp_path)
     app.tick()
     app.on_key("1")
-    app.on_key("enter")
+    app.on_key("enter")      # overview
+    app.on_key("enter")      # sample browser
     browser = app.screens[-1]
     app.on_key("f")          # all -> correct
     assert browser.filter_mode == "correct" and len(browser.visible()) == 2
@@ -408,6 +414,49 @@ def test_eval_detail_filter_and_search(app, tmp_path):
     assert not app.quit and browser.search_input == "q"
     app.on_key("escape")     # cancel search input
     assert browser.search_input is None and app.screens
+
+
+def test_eval_overview_reload_sees_appended_rows(app, tmp_path):
+    run_dir = _run_with_samples(tmp_path)
+    app.tick()
+    app.on_key("1")
+    app.on_key("enter")      # overview
+    overview = app.screens[-1]
+    assert overview.overview.n_samples == 4
+    with open(run_dir / "results.jsonl", "a") as f:
+        f.write(json.dumps({"prompt": "late", "completion": "x", "reward": 1.0, "correct": True}) + "\n")
+    app.on_key("r")
+    assert overview.overview.n_samples == 5
+    assert "5 samples" in app.status
+
+
+def test_sample_browser_markdown_toggle(app, tmp_path):
+    run_dir = _local_run(tmp_path)
+    with open(run_dir / "results.jsonl", "w") as f:
+        f.write(
+            json.dumps(
+                {
+                    "prompt": r"compute $\frac{1}{2}$ of **eight**",
+                    "completion": "```python\nprint(4)\n```",
+                    "answer": "4",
+                    "reward": 1.0,
+                    "correct": True,
+                }
+            )
+            + "\n"
+        )
+    app.tick()
+    app.on_key("1")
+    app.on_key("enter")      # overview
+    app.on_key("enter")      # browser
+    text = render_text(app)
+    assert r"$\frac{1}{2}$" in text          # raw by default
+    app.on_key("m")
+    text = render_text(app)
+    assert "(1)/(2) of eight" in text         # math + bold rendered
+    assert "print(4)" in text
+    app.on_key("m")
+    assert r"$\frac{1}{2}$" in render_text(app)
 
 
 def test_training_detail_tabs_and_reload(app, tmp_path):
@@ -478,3 +527,125 @@ def test_env_detail_versions_and_actions(app, fake, api, tmp_path):
     assert "built ok" in text
     app.on_key("escape")
     assert not app.screens
+
+
+# -- config-card editor (reference config_screen.py role) ---------------------
+
+
+def test_card_editor_edit_save_roundtrip(app, tmp_path):
+    import tomllib
+
+    _write_card(tmp_path, "sweep", "eval")
+    app.on_key("8")              # launch section
+    app.on_key("e")              # open editor
+    assert app.screens and app.screens[-1].title == "edit: sweep.toml"
+    editor = app.screens[-1]
+    # move to the "model" field and retype its value
+    while editor.fields[editor.cursor][0] != "model":
+        app.on_key("j")
+    app.on_key("enter")          # edit mode, prefilled with current value
+    for _ in range("tiny-test".__len__()):
+        app.on_key("backspace")
+    for ch in "llama3-8b":
+        app.on_key(ch)
+    app.on_key("enter")          # commit
+    assert editor.dirty
+    app.on_key("s")              # save
+    assert not editor.dirty
+    data = tomllib.loads((tmp_path / ".prime-lab" / "launch" / "sweep.toml").read_text())
+    assert data["eval"]["model"] == "llama3-8b"
+    assert data["launch"]["kind"] == "eval"
+    app.on_key("escape")
+    assert not app.screens
+    # the shell's launch row reflects the rescan
+    assert "sweep" in render_text(app)
+
+
+def test_card_editor_add_delete_and_typing(app, tmp_path):
+    import tomllib
+
+    _write_card(tmp_path, "card2", "eval")
+    app.on_key("8")
+    app.on_key("e")
+    editor = app.screens[-1]
+    app.on_key("a")              # add field
+    for ch in "num_samples=64":
+        app.on_key(ch)
+    app.on_key("enter")
+    app.on_key("a")
+    for ch in "push=false":
+        app.on_key(ch)
+    app.on_key("enter")
+    app.on_key("s")
+    data = tomllib.loads(editor.card.path.read_text())
+    assert data["eval"]["num_samples"] == 64          # typed int, not "64"
+    assert data["eval"]["push"] is False              # typed bool
+    # delete it again (cursor sits on the later-added "push"; num_samples is above)
+    while editor.fields[editor.cursor][0] != "num_samples":
+        app.on_key("k")
+    app.on_key("d")
+    app.on_key("s")
+    data = tomllib.loads(editor.card.path.read_text())
+    assert "num_samples" not in data["eval"]
+
+
+def test_card_editor_new_card_and_launch(app, fake, tmp_path):
+    app.on_key("8")
+    app.on_key("n")              # new card template
+    editor = app.screens[-1]
+    assert editor.card.kind == "eval" and not editor.card.path.exists()
+    app.on_key("L")                          # launch before save
+    assert "unsaved" in app.status           # guard message surfaced via app
+    assert "unsaved" in (editor.launch())    # and via the direct call
+    editor.dirty = True
+    app.on_key("s")
+    assert editor.card.path.exists()
+    app.on_key("L")              # launch through the fake plane
+    assert "launched eval" in app.status or "launched eval" in editor.message
+
+
+def test_card_editor_payload_name_key_survives(app, tmp_path):
+    """A payload key literally named `name` must not collide with the
+    [launch].name pseudo-field: zero-edit save keeps both intact."""
+    import tomllib
+
+    base = tmp_path / ".prime-lab" / "launch"
+    base.mkdir(parents=True, exist_ok=True)
+    (base / "named.toml").write_text(
+        '[launch]\nkind = "eval"\nname = "outer"\n\n[eval]\nname = "inner"\nmodel = "m"\n'
+    )
+    app.on_key("8")
+    while app.selected_row() and app.selected_row()["name"] != "outer":
+        app.on_key("j")
+    app.on_key("e")
+    editor = app.screens[-1]
+    editor.dirty = True
+    app.on_key("s")
+    data = tomllib.loads((base / "named.toml").read_text())
+    assert data["launch"]["name"] == "outer"
+    assert data["eval"]["name"] == "inner"
+
+
+def test_card_editor_rejects_dotted_keys(app, tmp_path):
+    _write_card(tmp_path, "card4", "eval")
+    app.on_key("8")
+    app.on_key("e")
+    editor = app.screens[-1]
+    app.on_key("a")
+    for ch in "lr.schedule=cosine":
+        app.on_key(ch)
+    app.on_key("enter")
+    assert "must be bare" in editor.message
+    assert all(k != "lr.schedule" for k, _ in editor.fields)
+
+
+def test_card_editor_q_types_not_quits(app, tmp_path):
+    _write_card(tmp_path, "card3", "eval")
+    app.on_key("8")
+    app.on_key("e")
+    editor = app.screens[-1]
+    app.on_key("enter")          # edit mode
+    app.on_key("q")
+    assert not app.quit and editor.input.endswith("q")
+    app.on_key("escape")         # cancel edit
+    assert editor.input is None and app.screens
