@@ -1,0 +1,52 @@
+"""Tier-2 live-platform tests (SURVEY.md §4 tier 2; reference
+prime-sandboxes/tests/conftest.py:13-23 role).
+
+Everything under ``tests/live/`` talks to the REAL platform with real
+credentials — no fakes, no fixtures plane. The tier is opt-in and skipped by
+default so the hermetic tier-1 suite stays runnable offline:
+
+    PRIME_LIVE_TESTS=1 PRIME_API_KEY=... python -m pytest tests/live/ -q
+
+Write-path tests (anything that creates billable resources) additionally
+require ``PRIME_LIVE_WRITE=1`` so a credentialed read-only smoke run can
+never spin up pods or sandboxes by accident.
+
+Config isolation: the client reads ``PRIME_CONFIG_DIR`` pointed at a temp
+dir, so a developer's real ``~/.prime`` is never mutated by a test run.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+
+def _live_enabled() -> bool:
+    return os.environ.get("PRIME_LIVE_TESTS") == "1" and bool(
+        os.environ.get("PRIME_API_KEY")
+    )
+
+
+@pytest.fixture(autouse=True)
+def _require_live_opt_in():
+    if not _live_enabled():
+        pytest.skip("tier-2 live tests: set PRIME_LIVE_TESTS=1 and PRIME_API_KEY")
+
+
+@pytest.fixture()
+def live_client(tmp_path, monkeypatch):
+    """APIClient against the real platform, config isolated to a temp dir."""
+    monkeypatch.setenv("PRIME_CONFIG_DIR", str(tmp_path / "config"))
+    from prime_tpu.core.client import APIClient
+    from prime_tpu.core.config import Config
+
+    config = Config()  # PRIME_API_KEY env var wins over the (empty) temp file
+    return APIClient(config)
+
+
+@pytest.fixture()
+def unique_name():
+    import uuid
+
+    return f"tpu-live-{uuid.uuid4().hex[:8]}"

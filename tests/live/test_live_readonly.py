@@ -1,0 +1,41 @@
+"""Tier-2 read-only live smokes: list endpoints against the real platform.
+
+These assert SHAPE, not content — live inventory changes constantly, so a
+passing run means auth, transport, pagination, and response models agree
+with the deployed backend (the one thing tier 1's fakes cannot prove).
+"""
+
+from __future__ import annotations
+
+
+def test_availability_lists_tpu_offers(live_client):
+    from prime_tpu.api.availability import AvailabilityClient
+
+    offers = AvailabilityClient(live_client).list_tpus()
+    assert isinstance(offers, list)
+    for offer in offers[:5]:
+        assert offer.tpu_type
+        assert offer.chips >= 1
+
+
+def test_pods_list_paginates(live_client):
+    from prime_tpu.api.pods import PodsClient
+
+    pods = PodsClient(live_client).list(limit=5)
+    assert isinstance(pods, list)
+    for pod in pods:
+        assert pod.id
+
+
+def test_evals_list(live_client):
+    from prime_tpu.evals import EvalsClient
+
+    evaluations = EvalsClient(live_client).list_evaluations(limit=5)
+    assert isinstance(evaluations, list)
+
+
+def test_sandboxes_list(live_client):
+    from prime_tpu.sandboxes.client import SandboxClient
+
+    sandboxes = SandboxClient(live_client).list(limit=5)
+    assert isinstance(sandboxes, list)

@@ -1,0 +1,55 @@
+"""Tier-2 write-path live test: full sandbox lifecycle on the real platform.
+
+Creates a billable resource — gated behind PRIME_LIVE_WRITE=1 on top of the
+tier's own opt-in. Cleanup runs in ``finally`` so a mid-test failure cannot
+leak a running sandbox.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+# defined here rather than imported from conftest: conftest is not an
+# importable module unless the repo root happens to be on sys.path
+requires_write = pytest.mark.skipif(
+    os.environ.get("PRIME_LIVE_WRITE") != "1",
+    reason="write-path live test: set PRIME_LIVE_WRITE=1 to create real resources",
+)
+
+
+@requires_write
+def test_sandbox_create_exec_delete(live_client, unique_name):
+    from prime_tpu.sandboxes.client import SandboxClient
+    from prime_tpu.sandboxes.models import CreateSandboxRequest
+
+    client = SandboxClient(live_client)
+    sandbox = client.create(
+        CreateSandboxRequest(name=unique_name, timeout_minutes=10, labels={"tier": "live-test"})
+    )
+    try:
+        running = client.wait_for_creation(sandbox.id)
+        assert running.status.value.upper() == "RUNNING"
+        result = client.execute_command(sandbox.id, "echo live-ok && uname -s")
+        assert result.exit_code == 0
+        assert "live-ok" in result.stdout
+    finally:
+        client.delete(sandbox.id)
+
+
+@requires_write
+def test_sandbox_background_job(live_client, unique_name):
+    from prime_tpu.sandboxes.client import SandboxClient
+    from prime_tpu.sandboxes.models import CreateSandboxRequest
+
+    client = SandboxClient(live_client)
+    sandbox = client.create(CreateSandboxRequest(name=unique_name, timeout_minutes=10))
+    try:
+        client.wait_for_creation(sandbox.id)
+        client.start_background_job(sandbox.id, "smoke", "sleep 1 && echo done")
+        finished = client.wait_for_background_job(sandbox.id, "smoke", timeout_s=120)
+        assert not finished.running
+        assert "done" in (finished.stdout_tail or "")
+    finally:
+        client.delete(sandbox.id)
