@@ -8,9 +8,10 @@ renderable — so the whole shell is testable without a terminal.
 
 Key bindings: ↑/↓ or j/k move · tab/←/→ switch pane · 1-9 jump section ·
 enter select (launch section: arm, then launch; data sections: drill into a
-detail screen — eval sample browser, training charts/config/logs, env
-versions/actions) · r refresh section · R refresh all · g/G top/bottom ·
-q quit (esc pops a detail screen first).
+detail screen — eval overview → sample browser, training charts/config/logs,
+env versions/actions) · e edit / n new launch card · S workspace setup +
+doctor · r refresh section · R refresh all · g/G top/bottom · q quit (esc
+pops a detail screen first).
 """
 
 from __future__ import annotations
@@ -180,6 +181,12 @@ class PrimeLabApp:
             self._open_card_editor()
         elif key == "n" and self.section == "launch":
             self._open_card_editor(new=True)
+        elif key == "S":
+            from prime_tpu.lab.tui.setup_screen import WorkspaceSetupScreen
+
+            screen = WorkspaceSetupScreen(self.workspace)
+            self.screens.append(screen)
+            self.status = "lab setup · enter run · d doctor · esc back"
         elif key == "enter":
             self._on_enter()
 

@@ -1,8 +1,14 @@
 """Terminal metric charts for the Lab shell (reference: training_charts.py).
 
-The reference renders textual-plot charts inside its Textual app; this stack
-draws unicode sparklines + axis labels with rich primitives so the same
-charts work in the shell's inspector pane and in one-shot CLI output.
+The reference renders textual-plot canvas charts inside its Textual app
+(training_charts.py:35 LabPlotWidget, :440 _adaptive_ema); this stack draws
+pure-text unicode charts with rich primitives so the same charts work in the
+shell's inspector pane, the detail screens, and one-shot CLI output:
+
+- ``sparkline``: one-row block strip (section tables, secondary metrics)
+- ``block_chart``: multi-row column chart with y-axis labels (the focused
+  metric in the training detail screen)
+- ``ema`` / ``adaptive_retention``: smoothing overlay for noisy series
 """
 
 from __future__ import annotations
@@ -10,27 +16,152 @@ from __future__ import annotations
 BLOCKS = "▁▂▃▄▅▆▇█"
 
 
+def _bucket(values: list[float], width: int) -> list[float]:
+    """Downsample to ``width`` bucket means; keeps spikes from aliasing away
+    and always lands the final bucket on the newest sample."""
+    if len(values) <= width:
+        return values
+    size = len(values) / width
+    out = []
+    for i in range(width):
+        start = int(i * size)
+        end = len(values) if i == width - 1 else max(int((i + 1) * size), start + 1)
+        chunk = values[start:end]
+        out.append(sum(chunk) / len(chunk))
+    return out
+
+
 def sparkline(values: list[float], width: int = 48) -> str:
     """Downsample values to ``width`` buckets and render block characters."""
     clean = [float(v) for v in values if v == v]  # drop NaN
     if not clean:
         return ""
-    if len(clean) > width:
-        # bucket means keep the shape without aliasing single spikes away
-        bucket = len(clean) / width
-        bucketed = []
-        for i in range(width):
-            start = int(i * bucket)
-            # the final bucket always reaches the newest sample exactly
-            end = len(clean) if i == width - 1 else max(int((i + 1) * bucket), start + 1)
-            chunk = clean[start:end]
-            bucketed.append(sum(chunk) / len(chunk))
-        clean = bucketed
+    clean = _bucket(clean, width)
     lo, hi = min(clean), max(clean)
     span = hi - lo
     if span <= 0:
         return BLOCKS[0] * len(clean)
     return "".join(BLOCKS[int((v - lo) / span * (len(BLOCKS) - 1))] for v in clean)
+
+
+def ema(values: list[float], retention: float) -> list[float]:
+    """Exponential moving average; ``retention`` in [0, 1) is the weight kept
+    from the running average each step (0 = no smoothing)."""
+    if not values:
+        return []
+    out = [values[0]]
+    for value in values[1:]:
+        out.append(retention * out[-1] + (1.0 - retention) * value)
+    return out
+
+
+def adaptive_retention(n: int) -> float:
+    """Smoothing strength scaled to series length (reference
+    training_charts.py:440 role): short series stay nearly raw, long noisy
+    series get a half-life around n/16 points, capped at 0.98."""
+    if n <= 8:
+        return 0.0
+    return min(0.98, 1.0 - 16.0 / n)
+
+
+def block_chart(
+    values: list[float],
+    width: int = 60,
+    height: int = 8,
+) -> list[str]:
+    """Multi-row unicode column chart. Row 0 is the TOP. Each column is one
+    bucket; cells fill bottom-up with full blocks plus one partial block cap
+    (1/8-cell resolution → height*8 distinct levels)."""
+    clean = [float(v) for v in values if v == v]
+    if not clean or height < 1:
+        return []
+    clean = _bucket(clean, width)
+    lo, hi = min(clean), max(clean)
+    span = hi - lo
+    rows = [[" "] * len(clean) for _ in range(height)]
+    for col, value in enumerate(clean):
+        frac = 0.5 if span <= 0 else (value - lo) / span
+        eighths = max(1, round(frac * height * 8))  # every column visible
+        full, part = divmod(eighths, 8)
+        for r in range(full):
+            rows[height - 1 - r][col] = BLOCKS[7]
+        if part and full < height:
+            rows[height - 1 - full][col] = BLOCKS[part - 1]
+    return ["".join(row) for row in rows]
+
+
+def chart_panel(
+    rows: list[dict],
+    key: str,
+    width: int = 60,
+    height: int = 8,
+    smooth: bool = False,
+    window: int | None = None,
+) -> list[tuple[str, str]]:
+    """Full labeled chart for one metric as (style, line) tuples: title with
+    last/min/max, y-axis gutter labels, the block chart, and an x-axis step
+    range. ``window`` shows only the last N points; ``smooth`` overlays
+    adaptive EMA (the stats line always reports RAW values)."""
+    points = [
+        (row.get("step", i), float(row[key]))
+        for i, row in enumerate(rows)
+        if isinstance(row.get(key), (int, float)) and row[key] == row[key]
+    ]
+    if window:
+        points = points[-window:]
+    if len(points) < 2:
+        return []
+    steps = [p[0] for p in points]
+    raw = [p[1] for p in points]
+    values = ema(raw, adaptive_retention(len(raw))) if smooth else raw
+    lines: list[tuple[str, str]] = []
+    tag = " (ema)" if smooth and adaptive_retention(len(raw)) > 0 else ""
+    lines.append(
+        (
+            "bold",
+            f"{key}{tag}  last={raw[-1]:.4g}  min={min(raw):.4g}  max={max(raw):.4g}",
+        )
+    )
+    # bucket BEFORE computing axis labels so the gutter's hi/lo describe the
+    # columns actually drawn (bucket means), not pre-bucket outliers the
+    # chart cannot show; block_chart's own bucketing is then a no-op
+    values = _bucket(values, width)
+    chart_rows = block_chart(values, width=width, height=height)
+    lo, hi = min(values), max(values)
+    gutter = max(len(f"{hi:.3g}"), len(f"{lo:.3g}"))
+    for i, row in enumerate(chart_rows):
+        if i == 0:
+            label = f"{hi:.3g}".rjust(gutter)
+        elif i == len(chart_rows) - 1:
+            label = f"{lo:.3g}".rjust(gutter)
+        else:
+            label = " " * gutter
+        lines.append(("cyan", f"{label} {row}"))
+    lines.append(("dim", " " * gutter + f" step {steps[0]} → {steps[-1]} ({len(points)} pts)"))
+    return lines
+
+
+def discover_metrics(rows: list[dict]) -> list[str]:
+    """All numeric series keys, reward/loss-ish first (reference
+    training_charts.py:470 _metric_sort_key role), bookkeeping excluded."""
+    seen: list[str] = []
+    for row in rows:
+        for key, value in row.items():
+            if key in seen or key in ("step", "epoch", "time", "ts", "timestamp"):
+                continue
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                continue
+            seen.append(key)
+
+    def rank(key: str) -> tuple[int, str]:
+        lowered = key.lower()
+        if "reward" in lowered or lowered == "loss":
+            return (0, lowered)
+        if "loss" in lowered or "acc" in lowered:
+            return (1, lowered)
+        return (2, lowered)
+
+    return sorted(seen, key=rank)
 
 
 def metric_chart(rows: list[dict], key: str, width: int = 48) -> str | None:

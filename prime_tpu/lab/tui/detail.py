@@ -326,11 +326,13 @@ class TrainingRunDetail(DetailScreen):
     """Charts + config + log tail for one training run (reference
     training_screen.py:100 role). Tabs: chart / config / logs.
 
-    Keys: tab or h/l cycle tabs · c cycle charted metric · j/k scroll logs ·
+    Keys: tab or h/l cycle tabs · c cycle charted metric · s toggle EMA
+    smoothing · [ / ] zoom the step window out/in · j/k scroll logs ·
     r reload from source · esc back.
     """
 
     TABS = ("chart", "config", "logs")
+    WINDOWS = (None, 512, 128, 32)  # [ and ] walk this zoom ladder
 
     def __init__(
         self,
@@ -349,14 +351,13 @@ class TrainingRunDetail(DetailScreen):
         self.metric_idx = 0
         self.log_scroll = 0
         self._logs: list[str] | None = None
+        self.smooth = False
+        self.window_idx = 0  # index into WINDOWS
 
     def metric_keys(self) -> list[str]:
-        keys: list[str] = []
-        for row in self.metrics:
-            for key, value in row.items():
-                if key not in keys and isinstance(value, (int, float)) and key != "step":
-                    keys.append(key)
-        return keys
+        from prime_tpu.lab.tui.charts import discover_metrics
+
+        return discover_metrics(self.metrics)
 
     def logs(self) -> list[str]:
         if self._logs is None:
@@ -375,6 +376,14 @@ class TrainingRunDetail(DetailScreen):
             if keys:
                 self.metric_idx = (self.metric_idx + 1) % len(keys)
                 return f"metric: {keys[self.metric_idx]}"
+        if key == "s" and self.tab == "chart":
+            self.smooth = not self.smooth
+            return f"smoothing {'on' if self.smooth else 'off'}"
+        if key in ("[", "]") and self.tab == "chart":
+            delta = -1 if key == "[" else 1
+            self.window_idx = max(0, min(self.window_idx + delta, len(self.WINDOWS) - 1))
+            window = self.WINDOWS[self.window_idx]
+            return f"window: {'all' if window is None else f'last {window}'}"
         if key == "j" and self.tab == "logs":
             self.log_scroll += _PAGE // 2
             return None
@@ -401,7 +410,7 @@ class TrainingRunDetail(DetailScreen):
         parts: list[Any] = [tabs, Text("")]
 
         if self.tab == "chart":
-            from prime_tpu.lab.tui.charts import metric_chart
+            from prime_tpu.lab.tui.charts import chart_panel, metric_chart
 
             keys = self.metric_keys()
             if not keys:
@@ -409,11 +418,22 @@ class TrainingRunDetail(DetailScreen):
             else:
                 self.metric_idx = min(self.metric_idx, len(keys) - 1)
                 focused = keys[self.metric_idx]
-                for key in [focused] + [k for k in keys if k != focused]:
+                panel = chart_panel(
+                    self.metrics,
+                    focused,
+                    width=64,
+                    height=8,
+                    smooth=self.smooth,
+                    window=self.WINDOWS[self.window_idx],
+                )
+                for style, line in panel:
+                    parts.append(Text(line, style=style or None, no_wrap=True, overflow="crop"))
+                if panel:
+                    parts.append(Text(""))
+                for key in (k for k in keys if k != focused):
                     line = metric_chart(self.metrics, key, width=64)
                     if line:
-                        style = "bold" if key == focused else None
-                        parts.append(Text(line, style=style, no_wrap=True, overflow="crop"))
+                        parts.append(Text(line, no_wrap=True, overflow="crop"))
                 last = self.metrics[-1] if self.metrics else {}
                 parts.append(Text(""))
                 parts.append(
@@ -454,7 +474,12 @@ class TrainingRunDetail(DetailScreen):
                 parts.append(text)
 
         parts.append(Text(""))
-        parts.append(Text("tab/h/l tabs · c metric · j/k scroll · r reload · esc back", style="dim"))
+        parts.append(
+            Text(
+                "tab/h/l tabs · c metric · s smooth · [/] window · j/k scroll · r reload · esc back",
+                style="dim",
+            )
+        )
         return Group(*parts)
 
 

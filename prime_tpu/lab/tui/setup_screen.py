@@ -1,0 +1,174 @@
+"""Workspace setup + doctor screen for the Lab shell (reference
+prime_lab_app/setup_screens.py:38 SetupScreen, :197 AgentSyncScreen,
+:294 DoctorScreen — collapsed into one pure state machine since this stack's
+setup is synchronous file materialization, not a worker thread).
+
+Opened with ``S`` from the shell. Three actions over ``lab/setup.py`` and
+``lab/hygiene.py``:
+- enter  run setup for the checked agent surfaces (skill bundle, guide
+         blocks, MCP registration, gitignore) and show the change report
+- d      doctor: hygiene preflight only, findings colored by severity
+- x      apply the doctor's auto-fixes (gitignore entries)
+
+Keys: j/k move over surfaces · space check/uncheck · f toggle force-skills
+(overwrite locally-modified bundled skills) · esc back.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from prime_tpu.lab.tui.detail import DetailScreen
+
+
+class WorkspaceSetupScreen(DetailScreen):
+    def __init__(self, workspace) -> None:
+        from prime_tpu.lab.setup import AGENT_SURFACES
+
+        self.workspace = workspace
+        self.title = "lab setup"
+        self.surfaces = sorted(AGENT_SURFACES)
+        self.checked = {name: name in ("claude", "codex") for name in self.surfaces}
+        self.cursor = 0
+        self.force_skills = False
+        self.report: dict[str, Any] | None = None   # last setup report
+        self.findings: list[dict[str, Any]] | None = None  # last doctor run
+        self.message = ""
+
+    # -- actions ---------------------------------------------------------------
+
+    def run_setup(self) -> str:
+        from prime_tpu.lab.setup import setup_workspace
+
+        agents = tuple(name for name in self.surfaces if self.checked[name])
+        if not agents:
+            return "no surfaces checked (space toggles)"
+        try:
+            report = setup_workspace(
+                self.workspace, agents=agents, force_skills=self.force_skills
+            )
+        except Exception as e:  # noqa: BLE001 - setup must not kill the shell
+            return f"setup failed: {e}"
+        self.report = report.as_dict()
+        self.findings = self.report.get("hygiene") or []
+        changed = len(self.report["created"]) + len(self.report["updated"])
+        return f"setup ok: {changed} changed, {len(self.report['unchanged'])} unchanged"
+
+    def run_doctor(self) -> str:
+        from prime_tpu.lab.hygiene import check_workspace
+
+        try:
+            self.findings = [f.as_dict() for f in check_workspace(self.workspace)]
+        except Exception as e:  # noqa: BLE001
+            return f"doctor failed: {e}"
+        if not self.findings:
+            return "doctor: workspace clean"
+        worst = max(self.findings, key=_severity_rank)
+        return f"doctor: {len(self.findings)} finding(s), worst {worst['severity']}"
+
+    def apply_fixes(self) -> str:
+        from prime_tpu.lab.hygiene import apply_fixes, check_workspace
+
+        try:
+            findings = check_workspace(self.workspace)
+            applied = apply_fixes(self.workspace, findings)
+            self.findings = [f.as_dict() for f in check_workspace(self.workspace)]
+        except Exception as e:  # noqa: BLE001
+            return f"fixes failed: {e}"
+        return f"applied {len(applied)} fix(es)" if applied else "nothing auto-fixable"
+
+    # -- keys ------------------------------------------------------------------
+
+    def on_key(self, key: str) -> str | None:
+        if key in ("j", "down"):
+            self.cursor = min(self.cursor + 1, len(self.surfaces) - 1)
+        elif key in ("k", "up"):
+            self.cursor = max(0, self.cursor - 1)
+        elif key in (" ", "space"):
+            name = self.surfaces[self.cursor]
+            self.checked[name] = not self.checked[name]
+            return f"{name}: {'on' if self.checked[name] else 'off'}"
+        elif key == "f":
+            self.force_skills = not self.force_skills
+            return f"force-skills {'on' if self.force_skills else 'off'}"
+        elif key == "enter":
+            self.message = self.run_setup()
+            return self.message
+        elif key == "d":
+            self.message = self.run_doctor()
+            return self.message
+        elif key == "x":
+            self.message = self.apply_fixes()
+            return self.message
+        else:
+            return super().on_key(key)
+        return None
+
+    # -- render ----------------------------------------------------------------
+
+    def render(self):
+        from rich.console import Group
+        from rich.table import Table
+        from rich.text import Text
+
+        parts: list[Any] = []
+        grid = Table.grid(padding=(0, 1))
+        for index, name in enumerate(self.surfaces):
+            selected = index == self.cursor
+            box = "[x]" if self.checked[name] else "[ ]"
+            grid.add_row(
+                Text(box, style="green" if self.checked[name] else "dim"),
+                Text(name, style="reverse" if selected else ""),
+            )
+        parts.append(grid)
+        parts.append(
+            Text(
+                f"force-skills: {'on' if self.force_skills else 'off'}",
+                style="yellow" if self.force_skills else "dim",
+            )
+        )
+
+        if self.report is not None:
+            parts.append(Text(""))
+            summary = Table.grid(padding=(0, 2))
+            for bucket in ("created", "updated", "unchanged", "skipped"):
+                paths = self.report.get(bucket, [])
+                if paths:
+                    summary.add_row(
+                        Text(bucket, style="bold"),
+                        Text(", ".join(_short(p) for p in paths[:6]), style="dim"),
+                    )
+            parts.append(summary)
+
+        if self.findings is not None:
+            parts.append(Text(""))
+            if not self.findings:
+                parts.append(Text("hygiene: clean ✓", style="green"))
+            for finding in self.findings:
+                style = {"error": "red", "warn": "yellow"}.get(finding["severity"], "dim")
+                fix = " (x fixes)" if finding.get("fix") else ""
+                parts.append(
+                    Text(f"{finding['severity']:>5} {finding['code']}: {finding['message']}{fix}", style=style)
+                )
+
+        if self.message:
+            parts.append(Text(""))
+            parts.append(Text(self.message, style="cyan"))
+        parts.append(Text(""))
+        parts.append(
+            Text(
+                "space check · f force · enter setup · d doctor · x fix · esc back",
+                style="dim",
+            )
+        )
+        return Group(*parts)
+
+
+def _severity_rank(finding: dict[str, Any]) -> int:
+    return {"info": 0, "warn": 1, "error": 2}.get(finding.get("severity", "info"), 0)
+
+
+def _short(path: str) -> str:
+    from pathlib import Path
+
+    return Path(path).name or path

@@ -335,6 +335,95 @@ def test_sparkline_last_bucket_includes_newest_sample():
     assert sparkline(values, width=48)[-1] != "▁"
 
 
+def test_block_chart_levels_and_shape():
+    from prime_tpu.lab.tui.charts import block_chart
+
+    rows = block_chart([0.0, 0.5, 1.0], width=10, height=4)
+    assert len(rows) == 4 and all(len(r) == 3 for r in rows)
+    # max column fills the top row; min column only the bottom's smallest block
+    assert rows[0][2] == "█" and rows[0][0] == " "
+    assert rows[3][0] == "▁"
+    # constant series renders mid-height, not empty
+    flat = block_chart([2.0, 2.0, 2.0], width=10, height=4)
+    assert any(ch != " " for r in flat for ch in r)
+
+
+def test_ema_and_adaptive_retention():
+    from prime_tpu.lab.tui.charts import adaptive_retention, ema
+
+    assert ema([], 0.9) == []
+    assert ema([1.0, 1.0, 1.0], 0.9) == [1.0, 1.0, 1.0]
+    smoothed = ema([0.0, 10.0], 0.5)
+    assert smoothed == [0.0, 5.0]
+    assert adaptive_retention(4) == 0.0          # short series stay raw
+    assert 0.9 < adaptive_retention(1000) <= 0.98
+
+
+def test_chart_panel_labels_window_and_smooth():
+    from prime_tpu.lab.tui.charts import chart_panel
+
+    rows = [{"step": i, "loss": 10.0 - i * 0.01} for i in range(600)]
+    panel = chart_panel(rows, "loss", width=40, height=4)
+    assert panel[0][0] == "bold" and "last=4.01" in panel[0][1]
+    assert "step 0 → 599" in panel[-1][1]
+    # window keeps only the tail
+    windowed = chart_panel(rows, "loss", width=40, height=4, window=128)
+    assert "step 472 → 599 (128 pts)" in windowed[-1][1]
+    # smoothing tags the title but stats stay raw
+    smooth = chart_panel(rows, "loss", width=40, height=4, smooth=True)
+    assert "(ema)" in smooth[0][1] and "last=4.01" in smooth[0][1]
+    # missing metric or too-few points -> empty
+    assert chart_panel(rows, "absent") == []
+    assert chart_panel(rows[:1], "loss") == []
+
+
+def test_chart_panel_gutter_matches_bucketed_columns():
+    from prime_tpu.lab.tui.charts import chart_panel
+
+    # one 9.0 outlier in ~1.0 noise, 600 pts into 40 buckets: the outlier's
+    # bucket mean is ~1.5, so the axis label must NOT claim the chart shows 9
+    rows = [{"step": i, "reward": 9.0 if i == 300 else 1.0} for i in range(600)]
+    panel = chart_panel(rows, "reward", width=40, height=4)
+    top_label = panel[1][1].split()[0]
+    assert float(top_label) < 2.0
+    assert "max=9" in panel[0][1]  # the stats line still reports the raw max
+
+
+def test_discover_metrics_order_and_exclusions():
+    from prime_tpu.lab.tui.charts import discover_metrics
+
+    rows = [
+        {"step": 1, "ts": 123.0, "tokens_per_sec": 900.0, "loss": 2.0, "reward_mean": 0.5},
+        {"step": 2, "flag": True, "note": "text", "grad_norm": 1.0},
+    ]
+    keys = discover_metrics(rows)
+    assert keys[0] in ("loss", "reward_mean") and keys[1] in ("loss", "reward_mean")
+    assert "step" not in keys and "ts" not in keys
+    assert "flag" not in keys and "note" not in keys
+    assert "tokens_per_sec" in keys and "grad_norm" in keys
+
+
+def test_training_detail_block_chart_smooth_and_window(app, tmp_path):
+    run_dir = tmp_path / "outputs" / "train" / "runZ"
+    run_dir.mkdir(parents=True)
+    with open(run_dir / "metrics.jsonl", "w") as f:
+        for step in range(200):
+            f.write(json.dumps({"step": step, "loss": 5.0 - step * 0.01}) + "\n")
+    app.tick()
+    app.on_key("2")
+    app.on_key("enter")
+    detail = app.screens[-1]
+    text = render_text(app)
+    assert "last=3.01" in text and "step 0 → 199" in text
+    app.on_key("s")
+    assert detail.smooth and "(ema)" in render_text(app)
+    app.on_key("]")          # zoom in one step on the window ladder
+    assert "last 512" in app.status or "last 128" in app.status
+    app.on_key("[")
+    assert detail.window_idx == 0
+    app.on_key("escape")
+
+
 def test_eval_tui_requires_tty(fake, monkeypatch):
     from click.testing import CliRunner
 
@@ -637,6 +726,41 @@ def test_card_editor_rejects_dotted_keys(app, tmp_path):
     app.on_key("enter")
     assert "must be bare" in editor.message
     assert all(k != "lr.schedule" for k, _ in editor.fields)
+
+
+# -- workspace setup screen (reference setup_screens.py role) -----------------
+
+
+def test_setup_screen_runs_setup_and_doctor(app, tmp_path):
+    app.on_key("S")
+    screen = app.screens[-1]
+    assert screen.title == "lab setup"
+    # uncheck codex, keep claude
+    while screen.surfaces[screen.cursor] != "codex":
+        app.on_key("j")
+    app.on_key(" ")
+    assert not screen.checked["codex"] and screen.checked["claude"]
+    app.on_key("enter")          # run setup
+    assert "setup ok" in app.status
+    assert (tmp_path / ".prime-lab" / "lab.toml").exists()
+    assert (tmp_path / "CLAUDE.md").exists()
+    assert not (tmp_path / "AGENTS.md").exists()
+    text = render_text(app)
+    assert "created" in text
+    app.on_key("d")              # doctor pass
+    assert "doctor" in app.status
+    app.on_key("escape")
+    assert not app.screens
+
+
+def test_setup_screen_no_surfaces_checked(app):
+    app.on_key("S")
+    screen = app.screens[-1]
+    for name in screen.surfaces:
+        screen.checked[name] = False
+    app.on_key("enter")
+    assert "no surfaces checked" in app.status
+    assert screen.report is None
 
 
 def test_card_editor_q_types_not_quits(app, tmp_path):
