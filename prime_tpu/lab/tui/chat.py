@@ -9,8 +9,17 @@ Transcript entries: {"role": "user"|"assistant"|"system", "text": str} or
 {"role": "widget", "name": str, "args": dict}. Widget calls render natively
 via lab/widgets.render_widget.
 
-Keys: printable chars type · enter send · backspace delete · esc clears the
-input (or closes the screen when empty and idle) · ctrl+u clear line.
+Actionable widgets (reference agent_widget_model.py role): the newest
+un-answered ``choose`` or ``launch_run`` becomes *pending* — while the input
+line is empty, ↑/↓ move the option cursor and enter acts (choose: the
+selection is sent back to the agent as the next user message and stamped
+into the widget; launch_run: the proposal is written as a launch card for
+the launch section's arm/confirm flow — chat never launches directly).
+Typing anything instead answers in free text, which also clears the pending
+state on send.
+
+Keys: printable chars type · enter send/act · backspace delete · esc clears
+the input (or closes the screen when empty and idle) · ctrl+u clear line.
 """
 
 from __future__ import annotations
@@ -27,6 +36,7 @@ class AgentChatScreen(DetailScreen):
         name: str,
         runtime_factory: Callable[[], Any],
         transcript_limit: int = 200,
+        workspace: str | None = None,
     ) -> None:
         self.title = f"agent: {name}"
         self.name = name
@@ -38,6 +48,9 @@ class AgentChatScreen(DetailScreen):
         self.error = ""
         self._worker: threading.Thread | None = None
         self._limit = transcript_limit
+        self.workspace = workspace
+        self.pending: dict[str, Any] | None = None  # newest actionable widget
+        self.choice_cursor = 0
         # chat captures the keyboard (the shell's 'q'-quits guard keys off
         # this attribute, same as the sample browser's search field)
         self.search_input = ""
@@ -73,13 +86,15 @@ class AgentChatScreen(DetailScreen):
                     streaming["text"] += event.text
                 elif event.kind == "widget" and event.widget:
                     streaming = None  # widget splits the assistant stream
-                    self.transcript.append(
-                        {
-                            "role": "widget",
-                            "name": event.widget.get("name", ""),
-                            "args": event.widget.get("args", {}),
-                        }
-                    )
+                    entry = {
+                        "role": "widget",
+                        "name": event.widget.get("name", ""),
+                        "args": event.widget.get("args", {}),
+                    }
+                    self.transcript.append(entry)
+                    if entry["name"] in ("choose", "launch_run"):
+                        self.pending = entry
+                        self.choice_cursor = 0
             if len(self.transcript) > self._limit:
                 del self.transcript[: len(self.transcript) - self._limit]
         except Exception as e:  # noqa: BLE001 - agent failures surface in-chat
@@ -106,13 +121,83 @@ class AgentChatScreen(DetailScreen):
 
     # -- keys ------------------------------------------------------------------
 
+    # -- widget actions --------------------------------------------------------
+
+    def _choice_options(self) -> list[str]:
+        if self.pending is None or self.pending["name"] != "choose":
+            return []
+        options = self.pending.get("args", {}).get("options")
+        return [str(o) for o in options] if isinstance(options, list) else []
+
+    def _act_on_pending(self) -> str | None:
+        pending = self.pending
+        if pending is None:
+            return None
+        if pending["name"] == "choose":
+            options = self._choice_options()
+            if not options:
+                self.pending = None
+                return "choice widget has no options"
+            index = min(self.choice_cursor, len(options) - 1)
+            selected = options[index]
+            pending["args"]["selected"] = selected  # stamps the transcript render
+            self.pending = None
+            # a blank option label would be dropped by send(); answer by
+            # position so the agent always receives a reply
+            self.send(selected if selected.strip() else f"option {index + 1}")
+            return f"selected: {selected or f'option {index + 1}'}"
+        # launch_run: hand the proposal to the launch section's arm/confirm
+        # flow as a card on disk — chat never submits to the platform itself
+        args = pending.get("args", {})
+        if self.workspace is None:
+            return "no workspace for launch cards"
+        kind = str(args.get("kind", "eval"))
+        kind = {"training": "train"}.get(kind, kind)  # card kinds are train|eval
+        if kind not in ("train", "eval"):
+            return f"launch cards support eval/training, not {kind!r}"
+        config = args.get("config")
+        payload = (
+            {str(k): v for k, v in config.items() if isinstance(v, (str, int, float, bool))}
+            if isinstance(config, dict)
+            else {}
+        )
+        if not payload:
+            # never substitute template defaults for a config the agent did
+            # not propose — an armed card must contain only proposed values
+            return "proposal has no usable config — ask the agent to include one"
+        try:
+            from prime_tpu.lab.tui.editor import new_card
+            from prime_tpu.lab.tui.launch import save_card
+
+            card = new_card(self.workspace, kind=kind, name=f"{self.name}-proposal")
+            card.payload = payload
+            save_card(card)
+        except Exception as e:  # noqa: BLE001 - a bad proposal must not kill chat
+            return f"card write failed: {e}"
+        pending["args"]["saved_card"] = card.path.name
+        self.pending = None
+        return f"launch card written: {card.path.name} (arm it in the launch section)"
+
+    # -- keys ------------------------------------------------------------------
+
     def on_key(self, key: str) -> str | None:
+        if key in ("up", "down") and not self.input_buffer and self._choice_options():
+            delta = 1 if key == "down" else -1
+            count = len(self._choice_options())
+            self.choice_cursor = (self.choice_cursor + delta) % count
+            return None
         if key == "enter":
+            if not self.input_buffer.strip() and self.pending is not None and not self.busy:
+                # blank input (including stray whitespace) acts on the widget
+                self.input_buffer = ""
+                return self._act_on_pending()
             if self.busy:
                 # keep the typed text — a discarded message with no feedback
                 # is worse than waiting
                 return "turn still running — message kept in the input"
             text, self.input_buffer = self.input_buffer, ""
+            if text.strip():
+                self.pending = None  # a real free-text reply answers the widget
             self.send(text)
             return None
         if key == "backspace":
@@ -146,7 +231,10 @@ class AgentChatScreen(DetailScreen):
         for entry in self.transcript[-24:]:
             role = entry.get("role")
             if role == "widget":
-                parts.append(render_widget(str(entry.get("name", "")), entry.get("args", {})))
+                cursor = self.choice_cursor if entry is self.pending else None
+                parts.append(
+                    render_widget(str(entry.get("name", "")), entry.get("args", {}), cursor=cursor)
+                )
                 continue
             style = {"user": "bold", "assistant": "", "system": "red"}.get(role or "", "dim")
             prefix = {"user": "you", "assistant": self.name, "system": "sys"}.get(role or "", "?")
@@ -156,7 +244,15 @@ class AgentChatScreen(DetailScreen):
         parts.append(Text(""))
         status = "…thinking" if self.busy else ""
         parts.append(Text(f"> {self.input_buffer}▌ {status}", style="bold"))
-        parts.append(Text("enter send · esc clear/back", style="dim"))
+        if self.pending is not None and not self.input_buffer:
+            hint = (
+                "↑/↓ pick · enter select (or type a reply)"
+                if self.pending["name"] == "choose"
+                else "enter writes the launch card (or type a reply)"
+            )
+            parts.append(Text(hint, style="yellow"))
+        else:
+            parts.append(Text("enter send · esc clear/back", style="dim"))
         return Group(*parts)
 
 
@@ -200,4 +296,4 @@ def open_agent_chat(row: dict[str, Any], workspace) -> AgentChatScreen:
             cwd=str(workspace),
         )
 
-    return AgentChatScreen(row["name"], factory)
+    return AgentChatScreen(row["name"], factory, workspace=str(workspace))

@@ -53,12 +53,15 @@ WIDGET_TOOLS: tuple[WidgetToolSpec, ...] = (
     ),
     WidgetToolSpec(
         name="launch_run",
-        description="Propose launching a training/eval run; the user confirms in the launch section.",
+        description=(
+            "Propose launching a hosted eval or training run with an explicit "
+            "config; the user confirms in the launch section."
+        ),
         properties={
-            "kind": {"type": "string", "enum": ["eval", "training", "pod", "sandbox"]},
+            "kind": {"type": "string", "enum": ["eval", "training"]},
             "config": {"type": "object"},
         },
-        required=("kind",),
+        required=("kind", "config"),
     ),
     WidgetToolSpec(
         name="show_patch",
@@ -137,8 +140,10 @@ def validate_widget_call(name: str, args: dict[str, Any]) -> str | None:
     return None
 
 
-def render_widget(name: str, args: dict[str, Any]):
-    """One rich renderable per widget call (pure; no app state)."""
+def render_widget(name: str, args: dict[str, Any], cursor: int | None = None):
+    """One rich renderable per widget call (pure; no app state beyond the
+    optional ``cursor`` for a pending choice and the ``selected`` /
+    ``saved_card`` stamps the chat screen writes back into ``args``)."""
     from rich.panel import Panel
     from rich.table import Table
     from rich.text import Text
@@ -149,10 +154,23 @@ def render_widget(name: str, args: dict[str, Any]):
 
     title = str(args.get("title", "")) or name
     if name == "choose":
+        selected = args.get("selected")
         body = Table.grid(padding=(0, 1))
         for index, option in enumerate(args["options"], 1):
-            body.add_row(Text(f"{index}.", style="bold"), Text(str(option)))
-        return Panel(body, title=f"choose: {title}", border_style="cyan")
+            text = str(option)
+            if selected is not None:
+                marker = "✓" if text == selected else " "
+                style = "green" if text == selected else "dim"
+            elif cursor is not None:
+                marker = "▸" if index - 1 == cursor else " "
+                style = "reverse" if index - 1 == cursor else ""
+            else:
+                marker, style = "", ""
+            body.add_row(
+                Text(f"{marker}{index}.", style="bold"), Text(text, style=style or None)
+            )
+        border = "dim" if selected is not None else "cyan"
+        return Panel(body, title=f"choose: {title}", border_style=border)
     if name == "show_table":
         rows = [r for r in args["rows"] if isinstance(r, dict)]
         columns: list[str] = []
@@ -182,8 +200,14 @@ def render_widget(name: str, args: dict[str, Any]):
         body.add_row(Text("kind", style="dim"), Text(str(args.get("kind"))))
         for key, value in (args.get("config") or {}).items():
             body.add_row(Text(str(key), style="dim"), Text(str(value)[:60]))
+        saved = args.get("saved_card")
+        if saved:
+            body.add_row(Text("card", style="green"), Text(str(saved), style="green"))
         return Panel(
-            body, title="launch proposal (confirm in the launch section)", border_style="yellow"
+            body,
+            title="launch proposal"
+            + (" (card written)" if saved else " (confirm in the launch section)"),
+            border_style="dim" if saved else "yellow",
         )
     # show_patch
     text = Text()

@@ -440,6 +440,155 @@ def test_chat_screen_esc_clears_then_closes():
     assert runtime.closed
 
 
+class _WidgetScriptRuntime:
+    """Emits a launch proposal for 'launch', otherwise echo + a choose."""
+
+    def start(self):
+        pass
+
+    def close(self):
+        pass
+
+    def prompt(self, text, timeout_s=120.0):
+        from prime_tpu.lab.agents import AgentEvent
+
+        if text == "launch":
+            yield AgentEvent(
+                "widget",
+                widget={
+                    "name": "launch_run",
+                    "args": {
+                        "kind": "eval",
+                        "config": {"env": "gsm8k", "model": "m1", "nested": {"x": 1}},
+                    },
+                },
+            )
+        else:
+            yield AgentEvent("chunk", text=f"echo:{text}")
+            yield AgentEvent("widget", widget={"name": "choose", "args": {"options": ["x", "y"]}})
+
+
+def test_chat_choice_selection_roundtrip():
+    from rich.console import Console
+
+    from prime_tpu.lab.tui.chat import AgentChatScreen
+
+    screen = AgentChatScreen("tester", _WidgetScriptRuntime)
+    screen.on_key("h")
+    screen.on_key("enter")
+    assert screen.wait_idle(5)
+    assert screen.pending is not None and screen.pending["name"] == "choose"
+    # pending cursor renders as a marker
+    console = Console(width=90, file=io.StringIO(), force_terminal=False)
+    console.print(screen.render())
+    assert "▸" in console.file.getvalue()
+    screen.on_key("down")          # cursor -> y
+    screen.on_key("enter")         # select: answer goes back as a user turn
+    assert screen.wait_idle(5)
+    widget = next(e for e in screen.transcript if e["role"] == "widget")
+    assert widget["args"]["selected"] == "y"
+    texts = [e.get("text") for e in screen.transcript if e.get("role") == "user"]
+    assert "y" in texts
+    assert any(e.get("text") == "echo:y" for e in screen.transcript)
+
+
+def test_chat_launch_proposal_writes_card(tmp_path):
+    import tomllib
+
+    from prime_tpu.lab.tui.chat import AgentChatScreen
+
+    screen = AgentChatScreen("tester", _WidgetScriptRuntime, workspace=str(tmp_path))
+    for ch in "launch":
+        screen.on_key(ch)
+    screen.on_key("enter")
+    assert screen.wait_idle(5)
+    assert screen.pending is not None and screen.pending["name"] == "launch_run"
+    status = screen.on_key("enter")    # act on the proposal
+    assert "launch card written" in status
+    assert screen.pending is None
+    card_path = tmp_path / ".prime-lab" / "launch" / "tester-proposal.toml"
+    data = tomllib.loads(card_path.read_text())
+    assert data["launch"]["kind"] == "eval"
+    assert data["eval"] == {"env": "gsm8k", "model": "m1"}   # nested value filtered
+    widget = next(e for e in screen.transcript if e["role"] == "widget")
+    assert widget["args"]["saved_card"] == "tester-proposal.toml"
+
+
+def test_chat_launch_kind_normalized_and_bad_kind_rejected(tmp_path):
+    import tomllib
+
+    from prime_tpu.lab.tui.chat import AgentChatScreen
+
+    screen = AgentChatScreen("tester", _WidgetScriptRuntime, workspace=str(tmp_path))
+    # kind='training' (widget enum) must become a 'train' card scan_cards accepts
+    screen.transcript.append(
+        {"role": "widget", "name": "launch_run",
+         "args": {"kind": "training", "config": {"model": "m1", "steps": 5}}}
+    )
+    screen.pending = screen.transcript[-1]
+    status = screen.on_key("enter")
+    assert "launch card written" in status
+    card = tmp_path / ".prime-lab" / "launch" / "tester-proposal.toml"
+    data = tomllib.loads(card.read_text())
+    assert data["launch"]["kind"] == "train" and data["train"]["steps"] == 5
+    from prime_tpu.lab.tui.launch import scan_cards
+
+    assert any(c.kind == "train" for c in scan_cards(tmp_path))
+    # unsupported kind is refused, not silently lost
+    screen.transcript.append(
+        {"role": "widget", "name": "launch_run", "args": {"kind": "pod", "config": {"x": 1}}}
+    )
+    screen.pending = screen.transcript[-1]
+    assert "support eval/training" in screen.on_key("enter")
+
+
+def test_chat_launch_without_config_refused(tmp_path):
+    from prime_tpu.lab.tui.chat import AgentChatScreen
+
+    screen = AgentChatScreen("tester", _WidgetScriptRuntime, workspace=str(tmp_path))
+    screen.transcript.append(
+        {"role": "widget", "name": "launch_run", "args": {"kind": "eval"}}
+    )
+    screen.pending = screen.transcript[-1]
+    status = screen.on_key("enter")
+    assert "no usable config" in status
+    # no template-default card was fabricated
+    assert not (tmp_path / ".prime-lab" / "launch").exists()
+
+
+def test_chat_whitespace_enter_acts_and_blank_option_answers():
+    from prime_tpu.lab.tui.chat import AgentChatScreen
+
+    screen = AgentChatScreen("tester", _WidgetScriptRuntime)
+    screen.transcript.append(
+        {"role": "widget", "name": "choose", "args": {"options": ["", "retry"]}}
+    )
+    screen.pending = screen.transcript[-1]
+    screen.on_key(" ")             # stray whitespace then enter still selects
+    status = screen.on_key("enter")
+    assert "selected" in status
+    assert screen.wait_idle(5)
+    # the blank label was answered by position, not dropped by send()
+    user_turns = [e["text"] for e in screen.transcript if e.get("role") == "user"]
+    assert user_turns == ["option 1"]
+
+
+def test_chat_free_text_overrides_pending_choice():
+    from prime_tpu.lab.tui.chat import AgentChatScreen
+
+    screen = AgentChatScreen("tester", _WidgetScriptRuntime)
+    screen.on_key("h")
+    screen.on_key("enter")
+    assert screen.wait_idle(5)
+    first_widget = screen.pending
+    for ch in "neither":
+        screen.on_key(ch)
+    screen.on_key("enter")         # typed reply, not a selection
+    assert screen.wait_idle(5)
+    assert "selected" not in first_widget["args"]
+    assert any(e.get("text") == "neither" for e in screen.transcript)
+
+
 def test_chat_section_lists_configured_agents(tmp_path):
     from prime_tpu.lab.tui.chat import load_agents_config
 
