@@ -1,0 +1,178 @@
+"""Agent config editor: add/edit chat agents from inside the shell
+(reference prime_lab_app/agent_cards.py agent-config role — there a Textual
+card widget; here a field editor over ``.prime-lab/agents.json``, the file
+``load_agents_config`` reads and ``lab setup`` templates).
+
+Fields: name · dialect (enter cycles through the runtime's dialect table
+instead of free text — a typo'd dialect would only fail at spawn time) ·
+command (free text, shlex-split at spawn).
+
+Keys: j/k move · enter edit value (dialect: cycle) · s save · d delete this
+agent from the config · esc back.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+from prime_tpu.lab.tui.detail import CLOSE, DetailScreen
+
+
+def _dialects() -> tuple[str, ...]:
+    """The runtime's own dialect table — the cycle UI exists so a config can
+    only name a dialect the runtime will actually accept at spawn."""
+    from prime_tpu.lab.agents import DIALECTS
+
+    return tuple(sorted(DIALECTS))
+
+
+def _config_path(workspace) -> Path:
+    return Path(workspace) / ".prime-lab" / "agents.json"
+
+
+def load_raw_agents(workspace) -> list[dict[str, Any]]:
+    """The agents.json rows verbatim (unlike load_agents_config, which
+    normalizes + drops incomplete rows — the editor must see those too)."""
+    path = _config_path(workspace)
+    try:
+        loaded = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return []
+    rows = loaded.get("agents") if isinstance(loaded, dict) else loaded
+    return [dict(r) for r in rows if isinstance(r, dict)] if isinstance(rows, list) else []
+
+
+def save_agents(workspace, agents: list[dict[str, Any]]) -> None:
+    path = _config_path(workspace)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    existing: dict[str, Any] = {}
+    try:
+        loaded = json.loads(path.read_text())
+        if isinstance(loaded, dict):
+            existing = loaded  # keep unknown top-level keys (_example, notes)
+    except (OSError, json.JSONDecodeError):
+        pass
+    existing["agents"] = agents
+    path.write_text(json.dumps(existing, indent=2) + "\n")
+
+
+class AgentConfigEditor(DetailScreen):
+    FIELDS = ("name", "dialect", "command")
+
+    def __init__(self, workspace, agent_name: str | None = None) -> None:
+        self.workspace = workspace
+        self.agents = load_raw_agents(workspace)
+        self.index: int | None = None
+        if agent_name is not None:
+            for i, row in enumerate(self.agents):
+                if str(row.get("name")) == agent_name:
+                    self.index = i
+                    break
+        if self.index is None and agent_name and agent_name.startswith("agent-"):
+            # a nameless row is listed as its synthesized "agent-<i>" label
+            # (chat.load_agents_config) — resolve it back to the row rather
+            # than appending a duplicate
+            suffix = agent_name.rsplit("-", 1)[1]
+            if suffix.isdigit():
+                position = int(suffix)
+                if position < len(self.agents) and not self.agents[position].get("name"):
+                    self.index = position
+        if self.index is None:
+            self.agents.append({"name": agent_name or "new-agent", "dialect": "acp", "command": ""})
+            self.index = len(self.agents) - 1
+            self.dirty = True
+        else:
+            self.dirty = False
+        self.entry = self.agents[self.index]
+        self.title = f"agent: {self.entry.get('name', '?')}"
+        self.cursor = 0
+        self.input: str | None = None
+        self.message = ""
+
+    # the shell's 'q'-quits guard keys off this attribute name
+    @property
+    def search_input(self) -> str | None:
+        return self.input
+
+    def save(self) -> str:
+        if not str(self.entry.get("command", "")).strip():
+            return "command is required (the agent subprocess to spawn)"
+        try:
+            save_agents(self.workspace, self.agents)
+        except OSError as e:
+            return f"save failed: {e}"
+        self.dirty = False
+        self.title = f"agent: {self.entry.get('name', '?')}"
+        return f"saved {self.entry.get('name')}"
+
+    def on_key(self, key: str) -> str | None:
+        if self.input is not None:
+            if key == "enter":
+                field = self.FIELDS[self.cursor]
+                self.entry[field] = self.input.strip()
+                self.input = None
+                self.dirty = True
+                return f"{field} set"
+            if key == "escape":
+                self.input = None
+                return "cancelled"
+            if key == "backspace":
+                self.input = self.input[:-1]
+            elif len(key) == 1 and key.isprintable():
+                self.input += key
+            return None
+        if key in ("j", "down"):
+            self.cursor = min(self.cursor + 1, len(self.FIELDS) - 1)
+        elif key in ("k", "up"):
+            self.cursor = max(0, self.cursor - 1)
+        elif key == "enter":
+            field = self.FIELDS[self.cursor]
+            if field == "dialect":
+                dialects = _dialects()
+                current = str(self.entry.get("dialect", ""))
+                pos = dialects.index(current) if current in dialects else -1
+                self.entry["dialect"] = dialects[(pos + 1) % len(dialects)]
+                self.dirty = True
+                return f"dialect: {self.entry['dialect']}"
+            self.input = str(self.entry.get(field, ""))
+        elif key == "s":
+            self.message = self.save()
+            return self.message
+        elif key == "d":
+            name = self.agents[self.index].get("name", "?")
+            del self.agents[self.index]
+            try:
+                save_agents(self.workspace, self.agents)
+            except OSError as e:
+                return f"delete failed: {e}"
+            self.message = f"deleted {name}"
+            return CLOSE
+        else:
+            return super().on_key(key)
+        return None
+
+    def render(self):
+        from rich.console import Group
+        from rich.table import Table
+        from rich.text import Text
+
+        grid = Table.grid(padding=(0, 2))
+        for index, field in enumerate(self.FIELDS):
+            selected = index == self.cursor
+            if selected and self.input is not None:
+                value = Text(f"{self.input}▌", style="bold reverse")
+            else:
+                shown = str(self.entry.get(field, ""))
+                if field == "dialect":
+                    shown += "  (enter cycles)"
+                value = Text(shown, style="reverse" if selected else "")
+            grid.add_row(Text(field, style="bold" if selected else "dim"), value)
+        parts: list[Any] = [grid, Text("")]
+        if self.dirty:
+            parts.append(Text("unsaved changes", style="yellow"))
+        if self.message:
+            parts.append(Text(self.message, style="cyan"))
+        parts.append(Text("enter edit/cycle · s save · d delete · esc back", style="dim"))
+        return Group(*parts)

@@ -187,6 +187,23 @@ class PrimeLabApp:
             screen = WorkspaceSetupScreen(self.workspace)
             self.screens.append(screen)
             self.status = "lab setup · enter run · d doctor · esc back"
+        elif key == "t" and self.section == "local-runs":
+            from prime_tpu.lab.tui.evaltree import EvalTreeScreen
+
+            tree = EvalTreeScreen(self.snapshot.local_eval_runs)
+            self.screens.append(tree)
+            self.status = "eval tree · enter open · esc back"
+        elif key in ("e", "n") and self.section == "agents":
+            from prime_tpu.lab.tui.agent_editor import AgentConfigEditor
+
+            row = self.selected_row() if key == "e" else None
+            if key == "e" and row is None:
+                return
+            editor = AgentConfigEditor(
+                self.workspace, agent_name=row["name"] if row else None
+            )
+            self.screens.append(editor)
+            self.status = f"{editor.title} · s: save · esc: back"
         elif key == "enter":
             self._on_enter()
 

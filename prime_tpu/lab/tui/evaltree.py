@@ -1,0 +1,180 @@
+"""Grouped eval-run browser: env → model → run tree with aggregates
+(reference prime_lab_app/evaluation_browser.py:35 evaluation_index +
+eval_screen tree panel role).
+
+Opened with ``t`` from the local-runs section. The tree is a pure state
+machine over the flat run rows the data layer already scans: nodes carry an
+indent level and collapse state; group nodes aggregate run count and mean
+accuracy; enter on a run drills into the same EvalRunOverview screen the
+flat list uses (via the shell's child handoff).
+
+Keys: j/k move · enter/space collapse-toggle a group, enter opens a run ·
+g/G first/last · esc back.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from prime_tpu.lab.tui.detail import DetailScreen, load_local_eval_detail
+
+
+def build_tree(runs: list[dict[str, Any]]) -> list[dict[str, Any]]:
+    """Flat run rows → ordered node list. Node: {"level": 0|1|2, "label",
+    "key", "row"? (runs only), "count", "accuracy" (group mean over runs
+    that report one)}. Envs and models sort lexically; runs newest-first by
+    runId (run dirs are timestamped names in the results contract)."""
+    index: dict[str, dict[str, list[dict[str, Any]]]] = {}
+    for run in runs:
+        env = str(run.get("env", "?"))
+        model = str(run.get("model", "?"))
+        index.setdefault(env, {}).setdefault(model, []).append(run)
+
+    def mean_accuracy(items: list[dict[str, Any]]) -> float | None:
+        values = [r["accuracy"] for r in items if isinstance(r.get("accuracy"), (int, float))]
+        return sum(values) / len(values) if values else None
+
+    nodes: list[dict[str, Any]] = []
+    for env in sorted(index):
+        env_runs = [r for models in index[env].values() for r in models]
+        nodes.append(
+            {
+                "level": 0,
+                "key": env,
+                "label": env,
+                "count": len(env_runs),
+                "accuracy": mean_accuracy(env_runs),
+            }
+        )
+        for model in sorted(index[env]):
+            model_runs = index[env][model]
+            nodes.append(
+                {
+                    "level": 1,
+                    "key": f"{env}/{model}",
+                    "label": model,
+                    "count": len(model_runs),
+                    "accuracy": mean_accuracy(model_runs),
+                }
+            )
+            for run in sorted(model_runs, key=lambda r: str(r.get("runId", "")), reverse=True):
+                nodes.append(
+                    {
+                        "level": 2,
+                        "key": f"{env}/{model}/{run.get('runId', '?')}",
+                        "label": str(run.get("runId", "?")),
+                        "count": 1,
+                        "accuracy": run.get("accuracy"),
+                        "row": run,
+                    }
+                )
+    return nodes
+
+
+class EvalTreeScreen(DetailScreen):
+    def __init__(self, runs: list[dict[str, Any]]) -> None:
+        self.title = "eval runs by env/model"
+        self.nodes = build_tree(runs)
+        self.cursor = 0
+        self.collapsed: set[str] = set()
+        self.child: DetailScreen | None = None
+
+    # -- visibility ------------------------------------------------------------
+
+    def visible(self) -> list[int]:
+        """Indices of nodes whose ancestors are all expanded."""
+        out: list[int] = []
+        hidden_below: int | None = None  # level under which nodes are hidden
+        for index, node in enumerate(self.nodes):
+            level = node["level"]
+            if hidden_below is not None:
+                if level > hidden_below:
+                    continue
+                hidden_below = None
+            out.append(index)
+            if level < 2 and node["key"] in self.collapsed:
+                hidden_below = level
+        return out
+
+    def current(self) -> dict[str, Any] | None:
+        vis = self.visible()
+        if not vis:
+            return None
+        if self.cursor not in vis:
+            self.cursor = vis[0]
+        return self.nodes[self.cursor]
+
+    def _step(self, delta: int) -> None:
+        vis = self.visible()
+        if not vis:
+            return
+        if self.cursor not in vis:
+            self.cursor = vis[0]
+            return
+        pos = vis.index(self.cursor)
+        self.cursor = vis[max(0, min(pos + delta, len(vis) - 1))]
+
+    # -- keys ------------------------------------------------------------------
+
+    def on_key(self, key: str) -> str | None:
+        node = self.current()
+        if key in ("j", "down"):
+            self._step(+1)
+        elif key in ("k", "up"):
+            self._step(-1)
+        elif key == "g":
+            vis = self.visible()
+            if vis:
+                self.cursor = vis[0]
+        elif key == "G":
+            vis = self.visible()
+            if vis:
+                self.cursor = vis[-1]
+        elif key in ("enter", " ", "space"):
+            if node is None:
+                return None
+            if node["level"] < 2:
+                if node["key"] in self.collapsed:
+                    self.collapsed.discard(node["key"])
+                    return f"expanded {node['label']}"
+                self.collapsed.add(node["key"])
+                return f"collapsed {node['label']}"
+            if key == "enter":
+                try:
+                    self.child = load_local_eval_detail(node["row"])
+                except Exception as e:  # noqa: BLE001 - drill-down must not kill the tree
+                    return f"open failed: {e}"[:120]
+        else:
+            return super().on_key(key)
+        return None
+
+    # -- render ----------------------------------------------------------------
+
+    def render(self):
+        from rich.console import Group
+        from rich.text import Text
+
+        if not self.nodes:
+            return Text("(no local eval runs)", style="dim")
+        parts: list[Any] = []
+        for index in self.visible():
+            node = self.nodes[index]
+            selected = index == self.cursor
+            level = node["level"]
+            if level < 2:
+                marker = "▸" if node["key"] in self.collapsed else "▾"
+                label = f"{'  ' * level}{marker} {node['label']}"
+                extra = f"  {node['count']} run(s)"
+            else:
+                label = f"    {node['label']}"
+                extra = ""
+            accuracy = node.get("accuracy")
+            if isinstance(accuracy, (int, float)):
+                extra += f"  acc={accuracy:.1%}"
+            style = "reverse" if selected else ("bold" if level == 0 else "")
+            parts.append(
+                Text(label + extra, style=style or None, no_wrap=True, overflow="ellipsis")
+            )
+        parts.append(Text(""))
+        parts.append(Text("j/k move · enter open/toggle · space toggle · esc back", style="dim"))
+        return Group(*parts)

@@ -31,12 +31,22 @@ def app(fake, api, tmp_path):
     return PrimeLabApp(data_source=source, workspace=tmp_path, api_client=api)
 
 
-def _local_run(tmp_path, env="gsm8k", model="m1", run="r1", accuracy=0.5):
+def _local_run(tmp_path, env="gsm8k", model="m1", run="r1", accuracy=0.5, n_samples=None):
+    """One local eval run dir; n_samples also writes a results.jsonl."""
     run_dir = tmp_path / "outputs" / "evals" / f"{env}--{model}" / run
     run_dir.mkdir(parents=True)
     (run_dir / "metadata.json").write_text(
-        json.dumps({"metrics": {"accuracy": accuracy, "num_samples": 4}})
+        json.dumps({"metrics": {"accuracy": accuracy, "num_samples": n_samples or 4}})
     )
+    if n_samples:
+        with open(run_dir / "results.jsonl", "w") as f:
+            for i in range(n_samples):
+                f.write(
+                    json.dumps(
+                        {"prompt": f"p{i}", "completion": "c", "reward": float(accuracy), "correct": True}
+                    )
+                    + "\n"
+                )
     return run_dir
 
 
@@ -726,6 +736,116 @@ def test_card_editor_rejects_dotted_keys(app, tmp_path):
     app.on_key("enter")
     assert "must be bare" in editor.message
     assert all(k != "lr.schedule" for k, _ in editor.fields)
+
+
+# -- grouped eval tree (reference evaluation_browser.py role) -----------------
+
+
+def test_eval_tree_groups_and_aggregates(app, tmp_path):
+    _local_run(tmp_path, "gsm8k", "m1", "run-a", 0.5, n_samples=2)
+    _local_run(tmp_path, "gsm8k", "m1", "run-b", 1.0, n_samples=2)
+    _local_run(tmp_path, "gsm8k", "m2", "run-c", 0.25, n_samples=2)
+    _local_run(tmp_path, "math", "m1", "run-d", 0.75, n_samples=2)
+    app.tick()
+    app.on_key("1")
+    app.on_key("t")
+    tree = app.screens[-1]
+    assert tree.title.startswith("eval runs")
+    text = render_text(app)
+    # env aggregates over all its models: gsm8k mean = (0.5+1.0+0.25)/3
+    assert "gsm8k" in text and "3 run(s)" in text and "58.3%" in text
+    assert "math" in text and "75.0%" in text
+    # newest-first run ordering within a model
+    assert text.index("run-b") < text.index("run-a")
+    # collapse the gsm8k env: its models/runs disappear
+    app.on_key("g")
+    app.on_key(" ")
+    text = render_text(app)
+    assert "run-a" not in text and "m2" not in text and "math" in text
+    app.on_key("enter")      # expand again (enter toggles groups too)
+    assert "run-a" in render_text(app)
+
+
+def test_eval_tree_opens_run_overview(app, tmp_path):
+    _local_run(tmp_path, "gsm8k", "m1", "run-a", 1.0, n_samples=2)
+    app.tick()
+    app.on_key("1")
+    app.on_key("t")
+    tree = app.screens[-1]
+    # walk down to the run node and open it
+    while tree.current()["level"] != 2:
+        app.on_key("j")
+    app.on_key("enter")
+    assert app.screens[-1].__class__.__name__ == "EvalRunOverview"
+    assert "pass rate" in render_text(app)
+    app.on_key("escape")     # back to the tree
+    assert app.screens[-1] is tree
+    app.on_key("escape")
+    assert not app.screens
+
+
+# -- agent config editor (reference agent_cards.py role) ----------------------
+
+
+def test_agent_editor_create_and_edit(app, tmp_path):
+    from prime_tpu.lab.tui.app import SECTIONS
+
+    app.section_idx = SECTIONS.index("agents")
+    app.focus = "rows"
+    app.on_key("n")          # new agent
+    editor = app.screens[-1]
+    app.on_key("enter")      # edit name
+    for _ in range(len("new-agent")):
+        app.on_key("backspace")
+    for ch in "helper":
+        app.on_key(ch)
+    app.on_key("enter")
+    app.on_key("j")          # dialect row
+    app.on_key("enter")      # cycle to the next dialect in the runtime table
+    assert editor.entry["dialect"] == "codex"  # sorted table: acp -> codex
+    app.on_key("s")
+    assert "command is required" in app.status
+    app.on_key("j")          # command row
+    app.on_key("enter")
+    for ch in "python -u agent.py":
+        app.on_key(ch)
+    app.on_key("enter")
+    app.on_key("s")
+    assert "saved helper" in app.status
+    app.on_key("escape")
+    # the agents section now lists it (load_agents_config reads the file)
+    rows = app.rows("agents")
+    assert any(r["name"] == "helper" and r["dialect"] == "codex" for r in rows)
+    # re-open for edit and delete
+    app.on_key("e")
+    editor = app.screens[-1]
+    assert editor.entry["name"] == "helper"
+    app.on_key("d")
+    assert not app.screens   # delete closes the editor
+    assert all(r["name"] != "helper" for r in app.rows("agents"))
+
+
+def test_agent_editor_resolves_nameless_row(app, tmp_path):
+    """A row without a 'name' key is listed under its synthesized agent-<i>
+    label; editing it must resolve to the row, not append a duplicate."""
+    import json as _json
+
+    cfg = tmp_path / ".prime-lab"
+    cfg.mkdir(parents=True, exist_ok=True)
+    (cfg / "agents.json").write_text(
+        _json.dumps({"agents": [{"command": "python -u a.py", "dialect": "simple"}]})
+    )
+    from prime_tpu.lab.tui.app import SECTIONS
+
+    app.section_idx = SECTIONS.index("agents")
+    app.focus = "rows"
+    rows = app.rows("agents")
+    assert rows and rows[0]["name"] == "agent-0"
+    app.on_key("e")
+    editor = app.screens[-1]
+    assert editor.entry["command"] == "python -u a.py"
+    assert editor.entry["dialect"] == "simple"
+    assert len(editor.agents) == 1   # no duplicate appended
 
 
 # -- workspace setup screen (reference setup_screens.py role) -----------------
