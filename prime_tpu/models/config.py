@@ -40,10 +40,17 @@ class ModelConfig:
     query_scale: float | None = None  # sm_scale = query_scale**-0.5 (else head_dim)
     sliding_window: int = 0           # window size for the sliding layers
     # which layers slide when sliding_window > 0: "even" (Gemma2 alternation,
-    # even-index layers slide) | "uniform" (every layer slides, Mistral-style).
-    # Explicit so a config wanting a different pattern fails loudly instead of
-    # silently inheriting the Gemma2 alternation.
+    # even-index layers slide) | "uniform" (every layer slides, Mistral-style)
+    # | "N:1" (Gemma3-style period: N sliding layers then 1 global, e.g.
+    # "5:1"). Explicit so a config wanting a different pattern fails loudly
+    # instead of silently inheriting the Gemma2 alternation.
     sliding_pattern: str = "even"
+    # Gemma3: sliding (local) layers rope with their own base frequency;
+    # None = all layers share rope_theta
+    rope_local_theta: float | None = None
+    # linear RoPE position scaling on the global-layer table (Gemma3 4b+
+    # long-context stretch: factor 8)
+    rope_scale: float = 1.0
     # mixture-of-experts (0 experts = dense MLP; Mixtral-style top-k routing)
     n_experts: int = 0
     experts_per_token: int = 2
@@ -286,6 +293,83 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         final_softcap=30.0,
         query_scale=144,
         sliding_window=4096,
+    ),
+    # Gemma 3 family (text towers): Gemma2's GeGLU/(1+w)/post-norms/scaled
+    # embeddings, minus the softcaps, plus per-head qk-norm, a 5:1
+    # sliding/global schedule, and dual-frequency rope (global 1M — linearly
+    # scaled x8 on 4b+ — local 10k). max_seq_len capped at 32k here (the
+    # no-cache rope table is materialized at max_seq_len; serving longer
+    # contexts sizes tables from the KV capacity instead).
+    "gemma3-1b": ModelConfig(
+        name="gemma3-1b",
+        vocab_size=262144,
+        d_model=1152,
+        n_layers=26,
+        n_heads=4,
+        n_kv_heads=1,
+        d_ff=6912,
+        max_seq_len=32768,
+        rope_theta=1000000.0,
+        rope_local_theta=10000.0,
+        rms_eps=1e-6,
+        tie_embeddings=True,
+        head_dim_override=256,
+        qk_norm=True,
+        act="gelu_tanh",
+        norm_plus_one=True,
+        post_norms=True,
+        scale_embed=True,
+        query_scale=256,
+        sliding_window=512,
+        sliding_pattern="5:1",
+    ),
+    "gemma3-4b": ModelConfig(
+        name="gemma3-4b",
+        vocab_size=262208,
+        d_model=2560,
+        n_layers=34,
+        n_heads=8,
+        n_kv_heads=4,
+        d_ff=10240,
+        max_seq_len=32768,
+        rope_theta=1000000.0,
+        rope_local_theta=10000.0,
+        rope_scale=8.0,
+        rms_eps=1e-6,
+        tie_embeddings=True,
+        head_dim_override=256,
+        qk_norm=True,
+        act="gelu_tanh",
+        norm_plus_one=True,
+        post_norms=True,
+        scale_embed=True,
+        query_scale=256,
+        sliding_window=1024,
+        sliding_pattern="5:1",
+    ),
+    "gemma3-12b": ModelConfig(
+        name="gemma3-12b",
+        vocab_size=262208,
+        d_model=3840,
+        n_layers=48,
+        n_heads=16,
+        n_kv_heads=8,
+        d_ff=15360,
+        max_seq_len=32768,
+        rope_theta=1000000.0,
+        rope_local_theta=10000.0,
+        rope_scale=8.0,
+        rms_eps=1e-6,
+        tie_embeddings=True,
+        head_dim_override=256,
+        qk_norm=True,
+        act="gelu_tanh",
+        norm_plus_one=True,
+        post_norms=True,
+        scale_embed=True,
+        query_scale=256,
+        sliding_window=1024,
+        sliding_pattern="5:1",
     ),
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b",

@@ -25,29 +25,95 @@ from prime_tpu.models.config import ModelConfig
 
 # model_type values whose math this loader reproduces exactly. Families that
 # SHARE Llama state-dict key names but need different math — gemma v1
-# ((1+w) norms + sqrt(d) embed scale + GeGLU), gemma3 (qk-norm + 5:1 sliding
-# pattern), phi3 (fused qkv), etc. — must fail loudly here rather than load
-# and silently produce garbage logits.
-SUPPORTED_MODEL_TYPES = frozenset({"llama", "mistral", "mixtral", "qwen2", "qwen3", "gemma2"})
+# ((1+w) norms + sqrt(d) embed scale + GeGLU), phi3 (fused qkv), etc. — must
+# fail loudly here rather than load and silently produce garbage logits.
+SUPPORTED_MODEL_TYPES = frozenset(
+    {"llama", "mistral", "mixtral", "qwen2", "qwen3", "gemma2", "gemma3_text", "gemma3"}
+)
+
+
+def _gemma3_sliding_pattern(hf_config: Any) -> str:
+    """Gemma3's layer schedule as an "N:1" pattern string, validated against
+    the config's own declaration (layer_types list or sliding_window_pattern
+    int). A schedule this loader can't reproduce raises instead of silently
+    roping/masking the wrong layers."""
+    layer_types = getattr(hf_config, "layer_types", None)
+    if layer_types:
+        period = None
+        for i, kind in enumerate(layer_types):
+            if kind == "full_attention":
+                period = i + 1
+                break
+        if period is None:
+            return "uniform"  # every layer slides
+        expected = [
+            "full_attention" if (i + 1) % period == 0 else "sliding_attention"
+            for i in range(len(layer_types))
+        ]
+        if list(layer_types) != expected:
+            raise ValueError(
+                f"Gemma3 layer_types {layer_types!r} is not a periodic N:1 schedule; "
+                "this loader reproduces periodic schedules only"
+            )
+        return f"{period - 1}:1"
+    pattern = getattr(hf_config, "sliding_window_pattern", None) or 6
+    return f"{int(pattern) - 1}:1"
 
 
 def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
+    model_type = getattr(hf_config, "model_type", "") or ""
+    if model_type == "gemma3":
+        # multimodal wrapper config: the text tower is what this loader maps
+        # (vision weights are ignored by params_from_state_dict's key lookup)
+        inner = getattr(hf_config, "text_config", None)
+        if inner is None:
+            raise ValueError(
+                "gemma3 config has no text_config; pass the text tower's config"
+            )
+        if isinstance(inner, dict):
+            from types import SimpleNamespace
+
+            inner = SimpleNamespace(**inner)
+        if not getattr(inner, "model_type", ""):
+            inner.model_type = "gemma3_text"
+        return config_from_hf(inner, name=name)
     derived_head_dim = hf_config.hidden_size // hf_config.num_attention_heads
     explicit_head_dim = getattr(hf_config, "head_dim", None)
-    model_type = getattr(hf_config, "model_type", "") or ""
     # Empty model_type (hand-written configs, this repo's own tests) is
     # treated as llama-like; anything else must be explicitly supported.
     if model_type and model_type not in SUPPORTED_MODEL_TYPES:
         raise ValueError(
             f"Unsupported model_type {model_type!r}: this loader reproduces the math of "
             f"{sorted(SUPPORTED_MODEL_TYPES)} only. Checkpoint families that share Llama "
-            "state-dict keys but diverge in math (gemma, gemma3, phi3, ...) would load "
+            "state-dict keys but diverge in math (gemma, phi3, ...) would load "
             "without error and produce wrong logits, so they are rejected."
         )
     # Qwen2 checkpoints carry q/k/v biases unconditionally; Llama-family
     # configs declare them via attention_bias
     attn_bias = bool(getattr(hf_config, "attention_bias", False)) or model_type == "qwen2"
-    gemma = model_type == "gemma2"
+    gemma3 = model_type == "gemma3_text"
+    gemma = model_type == "gemma2" or gemma3
+    # Gemma3 4b+ stretch global-layer rope linearly (factor 8); local layers
+    # keep their own unscaled base frequency
+    rope_scaling = getattr(hf_config, "rope_scaling", None) or {}
+    if isinstance(rope_scaling, dict):
+        rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "linear"))
+        rope_factor = float(rope_scaling.get("factor", 1.0) or 1.0)
+    else:
+        rope_type, rope_factor = "linear", 1.0
+    if rope_type == "default":  # HF's explicit no-scaling marker
+        rope_factor = 1.0
+    elif rope_scaling and rope_type != "linear":
+        raise ValueError(
+            f"Unsupported rope_scaling type {rope_type!r} (linear only); "
+            "loading would silently distort long-range attention"
+        )
+    if gemma3:
+        sliding_pattern = _gemma3_sliding_pattern(hf_config)
+    elif gemma:
+        sliding_pattern = "even"
+    else:
+        sliding_pattern = "uniform"
     return ModelConfig(
         head_dim_override=(
             explicit_head_dim if explicit_head_dim not in (None, derived_head_dim) else None
@@ -55,9 +121,10 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         attn_bias=attn_bias,
         # Llama-arch attention_bias biases o_proj as well; Qwen2 does not
         attn_out_bias=bool(getattr(hf_config, "attention_bias", False)),
-        qk_norm=model_type == "qwen3",
-        # Gemma2: GeGLU, (1+w) norms, post-norms, scaled embeddings,
-        # softcapped scores/logits, decoupled query scale, alternating windows
+        qk_norm=model_type in ("qwen3", "gemma3_text"),
+        # Gemma2/3: GeGLU, (1+w) norms, post-norms, scaled embeddings; Gemma2
+        # adds softcapped scores/logits, Gemma3 drops the caps and adds
+        # qk-norm + dual-frequency rope
         act="gelu_tanh" if gemma else "silu",
         norm_plus_one=gemma,
         post_norms=gemma,
@@ -65,15 +132,22 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         attn_softcap=float(getattr(hf_config, "attn_logit_softcapping", 0.0) or 0.0),
         final_softcap=float(getattr(hf_config, "final_logit_softcapping", 0.0) or 0.0),
         query_scale=getattr(hf_config, "query_pre_attn_scalar", None),
-        # Gemma2 alternates sliding/global (even layers slide); Mistral v0.1
-        # slides every layer. Other families' window configs are rejected by
-        # the allowlist above rather than silently mapped to either pattern.
+        # Gemma2 alternates sliding/global (even layers slide); Gemma3 runs a
+        # periodic N:1 schedule; Mistral v0.1 slides every layer. Other
+        # families' window configs are rejected by the allowlist above rather
+        # than silently mapped to a pattern.
         sliding_window=(
             int(getattr(hf_config, "sliding_window", 0) or 0)
-            if model_type in ("gemma2", "mistral")
+            if model_type in ("gemma2", "gemma3_text", "mistral")
             else 0
         ),
-        sliding_pattern="even" if gemma else "uniform",
+        sliding_pattern=sliding_pattern,
+        rope_local_theta=(
+            float(getattr(hf_config, "rope_local_base_freq", 10000.0) or 10000.0)
+            if gemma3
+            else None
+        ),
+        rope_scale=rope_factor,
         name=name,
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -81,7 +155,11 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         n_heads=hf_config.num_attention_heads,
         n_kv_heads=getattr(hf_config, "num_key_value_heads", hf_config.num_attention_heads),
         d_ff=hf_config.intermediate_size,
-        max_seq_len=getattr(hf_config, "max_position_embeddings", 8192),
+        # capped: the no-cache forward materializes rope tables at max_seq_len
+        # (two pairs for dual-frequency models — ~256MB at gemma3's 131k);
+        # serving sizes tables from the KV capacity, and a longer training
+        # seq still sizes its own table via max(seq, max_seq_len)
+        max_seq_len=min(int(getattr(hf_config, "max_position_embeddings", 8192) or 8192), 32768),
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
         rms_eps=getattr(hf_config, "rms_norm_eps", 1e-5),
         # Gemma's config default ties embeddings, so checkpoints omit the key
@@ -120,7 +198,14 @@ def params_from_state_dict(
     """Convert an HF LlamaForCausalLM state dict to the stacked param pytree."""
 
     def get(name: str) -> np.ndarray:
-        for candidate in (name, f"model.{name}"):
+        # bare → LlamaForCausalLM → Gemma3 multimodal text-tower prefixes
+        candidates = (
+            name,
+            f"model.{name}",
+            f"model.language_model.{name}",
+            f"language_model.model.{name}",
+        )
+        for candidate in candidates:
             if candidate in state:
                 return np.asarray(state[candidate])
         raise KeyError(f"Missing weight {name!r} (have {len(state)} tensors)")

@@ -30,7 +30,7 @@ from prime_tpu.ops.attention import (
     multi_head_attention,
 )
 from prime_tpu.ops.norms import rms_norm
-from prime_tpu.ops.rope import apply_rope, rope_frequencies
+from prime_tpu.ops.rope import apply_rope_rows, rope_frequencies
 
 Params = dict[str, Any]
 
@@ -185,6 +185,7 @@ def _attention_block(
     v_scale: jnp.ndarray | None = None,
     prefill_offset: jnp.ndarray | None = None,  # () chunked prefill: write+attend at offset
     sliding: jnp.ndarray | None = None,  # () traced bool: this layer uses the window
+    rope_tables_local: tuple[jnp.ndarray, jnp.ndarray] | None = None,
 ):
     batch, seq, _ = x.shape
     h, kh, hd = config.n_heads, config.n_kv_heads, config.head_dim
@@ -193,6 +194,13 @@ def _attention_block(
         softcap=config.attn_softcap, window=config.sliding_window, sliding=sliding
     )
     cos, sin = rope_tables
+    # gather the seq-sized rows FIRST, then (Gemma3) select local vs global
+    # by the traced per-layer flag — selecting full (max_pos, D/2) tables in
+    # every scanned layer would waste HBM bandwidth in the decode hot loop
+    cos_rows, sin_rows = cos[positions], sin[positions]  # (B, S, D/2)
+    if rope_tables_local is not None and sliding is not None:
+        cos_rows = jnp.where(sliding, rope_tables_local[0][positions], cos_rows)
+        sin_rows = jnp.where(sliding, rope_tables_local[1][positions], sin_rows)
 
     normed = _norm(x, lp["attn_norm"], config)
     q, k, v = _mm(normed, lp["wq"]), _mm(normed, lp["wk"]), _mm(normed, lp["wv"])
@@ -201,11 +209,11 @@ def _attention_block(
     q = q.reshape(batch, seq, h, hd)
     k = k.reshape(batch, seq, kh, hd)
     v = v.reshape(batch, seq, kh, hd)
-    if "q_norm" in lp:  # Qwen3-style per-head RMSNorm before rope
+    if "q_norm" in lp:  # Qwen3/Gemma3-style per-head RMSNorm before rope
         q = _norm(q, lp["q_norm"], config)
         k = _norm(k, lp["k_norm"], config)
-    q = apply_rope(q, positions, cos, sin)
-    k = apply_rope(k, positions, cos, sin)
+    q = apply_rope_rows(q, cos_rows, sin_rows)
+    k = apply_rope_rows(k, cos_rows, sin_rows)
 
     q = q.transpose(0, 2, 1, 3)  # (B, H, S, hd)
     k = k.transpose(0, 2, 1, 3)
@@ -350,7 +358,15 @@ def forward(
             off = prefill_offset.astype(jnp.int32)
             positions = positions + (off[:, None] if off.ndim else off)
     max_pos = cache.capacity if cache is not None else max(seq, config.max_seq_len)
-    rope_tables = rope_frequencies(config.head_dim, max_pos, config.rope_theta)
+    rope_tables = rope_frequencies(
+        config.head_dim, max_pos, config.rope_theta, scale=config.rope_scale
+    )
+    # Gemma3: local (sliding) layers use an unscaled short-range frequency
+    rope_tables_local = (
+        rope_frequencies(config.head_dim, max_pos, config.rope_local_theta)
+        if config.rope_local_theta is not None
+        else None
+    )
 
     x = params["embed"][tokens]
     if config.scale_embed:  # Gemma normalizes hidden states by sqrt(d_model)
@@ -368,9 +384,13 @@ def forward(
         sliding_flags = jnp.arange(config.n_layers) % 2 == 0
     elif config.sliding_pattern == "uniform":  # Mistral-style: all layers slide
         sliding_flags = jnp.ones((config.n_layers,), dtype=bool)
+    elif config.sliding_pattern.endswith(":1"):  # Gemma3 "5:1": every (N+1)th is global
+        period = int(config.sliding_pattern[:-2]) + 1
+        sliding_flags = (jnp.arange(config.n_layers) + 1) % period != 0
     else:
         raise ValueError(
-            f"Unknown sliding_pattern {config.sliding_pattern!r} (want 'even' | 'uniform')"
+            f"Unknown sliding_pattern {config.sliding_pattern!r} "
+            "(want 'even' | 'uniform' | 'N:1')"
         )
 
     quantized = cache is not None and cache.quantized
@@ -386,7 +406,7 @@ def forward(
             x, lp, positions, rope_tables, config,
             k_c, v_c, cache_lengths, decode, attn_impl,
             k_scale=k_s, v_scale=v_s, prefill_offset=prefill_offset,
-            sliding=sliding,
+            sliding=sliding, rope_tables_local=rope_tables_local,
         )
         x, aux = _mlp_block(x, lp, config)
         ys = (new_k, new_v, new_ks, new_vs) if quantized else (new_k, new_v)
@@ -414,7 +434,7 @@ def forward(
             x, aux_sum = carry
             x, _, _, _, _ = _attention_block(
                 x, lp, positions, rope_tables, config, None, None, None, False, attn_impl,
-                sliding=sliding,
+                sliding=sliding, rope_tables_local=rope_tables_local,
             )
             x, aux = _mlp_block(x, lp, config)
             return (x, aux_sum + aux), None

@@ -10,9 +10,17 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def rope_frequencies(head_dim: int, max_positions: int, theta: float = 500000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Return (cos, sin) tables of shape (max_positions, head_dim // 2), float32."""
+def rope_frequencies(
+    head_dim: int, max_positions: int, theta: float = 500000.0, scale: float = 1.0
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Return (cos, sin) tables of shape (max_positions, head_dim // 2), float32.
+
+    ``scale`` > 1 applies linear position scaling (positions stretched by the
+    factor — HF ``rope_scaling {"rope_type": "linear"}``, e.g. Gemma3 4b+).
+    """
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scale != 1.0:
+        inv_freq = inv_freq / scale
     positions = jnp.arange(max_positions, dtype=jnp.float32)
     angles = jnp.outer(positions, inv_freq)  # (P, D/2)
     return jnp.cos(angles), jnp.sin(angles)
@@ -25,10 +33,22 @@ def apply_rope(
     sin: jnp.ndarray,          # (P, D/2)
 ) -> jnp.ndarray:
     """Rotate the head dimension of x by its absolute position."""
+    return apply_rope_rows(x, cos[positions], sin[positions])
+
+
+def apply_rope_rows(
+    x: jnp.ndarray,            # (B, S, H, D)
+    cos_rows: jnp.ndarray,     # (B, S, D/2) — already gathered per position
+    sin_rows: jnp.ndarray,
+) -> jnp.ndarray:
+    """Rotate with pre-gathered per-position rows. Callers that must select
+    between frequency tables (Gemma3 local vs global layers) gather the
+    seq-sized rows from each table FIRST and select those — a full-table
+    select before the gather would touch (max_pos, D/2) per layer per step."""
     dtype = x.dtype
     half = x.shape[-1] // 2
-    c = cos[positions][:, :, None, :]  # (B, S, 1, D/2)
-    s = sin[positions][:, :, None, :]
+    c = cos_rows[:, :, None, :]  # (B, S, 1, D/2)
+    s = sin_rows[:, :, None, :]
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     rotated = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)

@@ -105,7 +105,10 @@ def pipeline_forward(
         x = x * jnp.asarray(config.d_model**0.5, dtype=x.dtype)
     x_mb = x.reshape(n_microbatches, micro, seq, x.shape[-1])
     positions = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None, :], (micro, seq))
-    rope_tables = rope_frequencies(config.head_dim, max(seq, config.max_seq_len), config.rope_theta)
+    rope_tables = rope_frequencies(
+        config.head_dim, max(seq, config.max_seq_len), config.rope_theta,
+        scale=config.rope_scale,  # must match forward()'s rope math exactly
+    )
 
     layer_specs = pipeline_param_specs(config)["layers"]
 

@@ -405,6 +405,165 @@ def test_gemma2_checkpoint_dir_roundtrip(tmp_path):
     assert config.post_norms and config.sliding_window == 8
 
 
+# -- Gemma 3 family ----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gemma3_model():
+    cfg = transformers.Gemma3TextConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=7,   # 5:1 schedule: layers 5 and 11... here 5 is global
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=128,
+        rope_theta=1000000.0,
+        rope_local_base_freq=10000.0,
+        rope_scaling={"rope_type": "linear", "factor": 8.0},
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        query_pre_attn_scalar=24,
+        sliding_window=4,      # tiny: the window genuinely bites at seq 8
+        attn_implementation="eager",
+    )
+    torch.manual_seed(11)
+    model = transformers.Gemma3ForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_gemma3_config_mapping(gemma3_model):
+    config = config_from_hf(gemma3_model.config, name="tiny-gemma3")
+    assert config.qk_norm and config.norm_plus_one and config.post_norms
+    assert config.attn_softcap == 0.0 and config.final_softcap == 0.0
+    assert config.sliding_pattern == "5:1" and config.sliding_window == 4
+    assert config.rope_local_theta == 10000.0 and config.rope_scale == 8.0
+    assert config.query_scale == 24
+
+
+def test_gemma3_logits_match_transformers(gemma3_model):
+    """Exercises every Gemma3 delta at once: 5:1 sliding schedule, dual
+    rope frequencies (+ linear scaling on the global table), per-head
+    qk-norm with (1+w) weights, no softcaps."""
+    state = {k: v.float().numpy() for k, v in gemma3_model.state_dict().items()}
+    config = config_from_hf(gemma3_model.config, name="tiny-gemma3")
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+    assert "q_norm" in params["layers"] and "attn_post_norm" in params["layers"]
+
+    tokens = np.array([[3, 17, 200, 45, 9, 88, 121, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = gemma3_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    our_logits, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=5e-4, atol=5e-4)
+
+
+def test_gemma3_decode_matches_transformers_generation(gemma3_model):
+    import jax
+
+    from prime_tpu.models.sampler import generate
+
+    state = {k: v.float().numpy() for k, v in gemma3_model.state_dict().items()}
+    config = config_from_hf(gemma3_model.config, name="tiny-gemma3")
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+
+    prompt = np.array([[5, 42, 100, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_out = gemma3_model.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=8,    # decode positions 4..11 cross window 4
+            do_sample=False,
+            eos_token_id=None,
+            pad_token_id=0,
+        ).numpy()[0, 4:]
+    result = generate(
+        params, jnp.asarray(prompt), jnp.array([4]), config,
+        jax.random.PRNGKey(0), max_new_tokens=8, temperature=0.0,
+    )
+    np.testing.assert_array_equal(np.asarray(result.tokens[0]), hf_out)
+
+
+def test_gemma3_multimodal_config_unwraps_text_tower():
+    from prime_tpu.models.hf_loader import config_from_hf
+
+    class Wrapper:
+        model_type = "gemma3"
+        text_config = {
+            "model_type": "gemma3_text",
+            "vocab_size": 128,
+            "hidden_size": 64,
+            "num_hidden_layers": 6,
+            "num_attention_heads": 4,
+            "num_key_value_heads": 2,
+            "head_dim": 16,
+            "intermediate_size": 128,
+            "sliding_window": 512,
+            "rope_local_base_freq": 10000.0,
+        }
+
+    config = config_from_hf(Wrapper(), name="g3-mm")
+    assert config.sliding_pattern == "5:1" and config.qk_norm
+    assert config.rope_local_theta == 10000.0
+
+    class Bare:
+        model_type = "gemma3"
+
+    with pytest.raises(ValueError, match="text_config"):
+        config_from_hf(Bare())
+
+
+def test_gemma3_irregular_layer_types_rejected():
+    from prime_tpu.models.hf_loader import config_from_hf
+
+    class Cfg:
+        model_type = "gemma3_text"
+        vocab_size = 128
+        hidden_size = 64
+        num_hidden_layers = 4
+        num_attention_heads = 4
+        num_key_value_heads = 2
+        intermediate_size = 128
+        sliding_window = 256
+        layer_types = [
+            "full_attention",
+            "sliding_attention",
+            "sliding_attention",
+            "full_attention",
+        ]  # aperiodic: full first
+
+    with pytest.raises(ValueError, match="periodic"):
+        config_from_hf(Cfg())
+
+
+def test_rope_scaling_default_accepted_and_long_context_capped():
+    """HF's rope_scaling {"rope_type": "default"} means unscaled — it must
+    load; non-linear types must not. max_position_embeddings is capped at 32k
+    (the no-cache forward materializes rope tables at max_seq_len)."""
+    from prime_tpu.models.hf_loader import config_from_hf
+
+    class Cfg:
+        model_type = "llama"
+        vocab_size = 128
+        hidden_size = 64
+        num_hidden_layers = 2
+        num_attention_heads = 4
+        intermediate_size = 128
+        max_position_embeddings = 131072
+
+    Cfg.rope_scaling = {"rope_type": "default"}
+    config = config_from_hf(Cfg())
+    assert config.rope_scale == 1.0
+    assert config.max_seq_len == 32768
+
+    Cfg.rope_scaling = {"rope_type": "linear", "factor": 4.0}
+    assert config_from_hf(Cfg()).rope_scale == 4.0
+
+    Cfg.rope_scaling = {"rope_type": "yarn", "factor": 4.0}
+    with pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf(Cfg())
+
+
 def test_config_from_hf_rejects_unsupported_model_type():
     """ADVICE r2 (medium): families sharing Llama state-dict keys but needing
     different math (gemma v1, gemma3, phi3) must fail loudly, not load and
@@ -420,11 +579,11 @@ def test_config_from_hf_rejects_unsupported_model_type():
         num_attention_heads = 4
         intermediate_size = 256
 
-    for bad in ("gemma", "gemma3", "phi3", "falcon"):
+    for bad in ("gemma", "phi3", "falcon"):
         Cfg.model_type = bad
         with pytest.raises(ValueError, match="Unsupported model_type"):
             config_from_hf(Cfg())
-    for ok in ("llama", "mistral", "qwen2", "qwen3", "gemma2", ""):
+    for ok in ("llama", "mistral", "qwen2", "qwen3", "gemma2", "gemma3_text", ""):
         Cfg.model_type = ok
         config_from_hf(Cfg())  # must not raise
 
