@@ -156,6 +156,10 @@ class LabDataSource:
 
         A dead section must not take down the others, but failures are
         recorded in snapshot.errors so callers can tell "empty" from "broken".
+        Incoming rows are merged against the cached ones (progressive
+        loading, reference snapshots.py:8 role): a list endpoint returning a
+        lighter row shape must not wipe richer fields a previous fetch (or a
+        detail hydration) already cached for the same id.
         """
         if self._api is None:
             import prime_tpu.commands._deps as deps
@@ -170,8 +174,13 @@ class LabDataSource:
         }
         errors: dict[str, str] = {}
         for section in sections:
+            # the whole fetch→merge→cache pipeline stays inside the guard: a
+            # corrupt cache file or unwritable cache dir is a per-section
+            # failure too, not a reason to abort the other sections
             try:
-                self.cache.put(section, fetchers[section]())
+                incoming = fetchers[section]()
+                previous, _ = self.cache.get(section)
+                self.cache.put(section, merge_rows(previous or [], incoming))
             except Exception as e:
                 errors[section] = str(e)
         snap = self.snapshot()
@@ -203,3 +212,46 @@ class LabDataSource:
 
         client = SandboxClient(client=self._api)
         return [s.model_dump(by_alias=True) for s in client.list(limit=50)]
+
+
+_ROW_ID_KEYS = ("id", "evalId", "runId", "podId", "sandboxId", "name")
+
+
+def _row_id(row: dict[str, Any]) -> str | None:
+    for key in _ROW_ID_KEYS:
+        value = row.get(key)
+        if value:
+            return f"{key}={value}"
+    return None
+
+
+def merge_rows(
+    previous: list[dict[str, Any]], incoming: list[dict[str, Any]]
+) -> list[dict[str, Any]]:
+    """Progressive-loading merge (reference snapshots.py:8 merge_snapshot_rows
+    role). The incoming list is authoritative for ORDER and MEMBERSHIP (a row
+    the backend no longer returns is gone — deletions must propagate); for a
+    row present in both, incoming NON-None values win per field. An incoming
+    explicit None never clobbers a cached value: the fetchers dump pydantic
+    models without exclude_none, so a lighter list response emits its
+    unpopulated optional fields as None — exactly the fields a richer earlier
+    fetch may have filled. Rows without any recognizable id pass through."""
+    by_id: dict[str, dict[str, Any]] = {}
+    for row in previous:
+        if isinstance(row, dict):
+            row_id = _row_id(row)
+            if row_id is not None:
+                by_id[row_id] = row
+    merged: list[dict[str, Any]] = []
+    for row in incoming:
+        old = by_id.get(_row_id(row)) if isinstance(row, dict) else None
+        if old is None:
+            merged.append(row)
+            continue
+        combined = dict(old)
+        for key, value in row.items():
+            if value is None and combined.get(key) is not None:
+                continue
+            combined[key] = value
+        merged.append(combined)
+    return merged

@@ -70,6 +70,85 @@ def test_refresh_hydrates_platform_sections(tmp_path, fake):
     assert cold.platform["pods"][0]["name"] == "lab-pod"
 
 
+def test_merge_rows_preserves_richer_cached_fields():
+    """Progressive loading (reference snapshots.py role): a lighter incoming
+    row must not wipe fields a previous fetch cached for the same id; order,
+    membership, and conflicting values follow the incoming list."""
+    from prime_tpu.lab.data import merge_rows
+
+    previous = [
+        {"id": "a", "status": "RUNNING", "detail": {"logs": 12}},
+        {"id": "gone", "status": "DONE"},
+        {"noid": True, "x": 1},
+    ]
+    incoming = [
+        {"id": "b", "status": "PENDING"},
+        {"id": "a", "status": "STOPPED"},
+    ]
+    merged = merge_rows(previous, incoming)
+    assert [r.get("id") for r in merged] == ["b", "a"]       # incoming order, deletion propagated
+    assert merged[1]["status"] == "STOPPED"                   # incoming wins conflicts
+    assert merged[1]["detail"] == {"logs": 12}                # richer cached field preserved
+
+
+def test_merge_rows_incoming_none_never_clobbers():
+    """Fetchers dump pydantic models WITHOUT exclude_none: a lighter list
+    response carries unpopulated optionals as explicit None — those must not
+    wipe values a richer earlier fetch cached."""
+    from prime_tpu.lab.data import merge_rows
+
+    previous = [{"id": "a", "sshConnections": ["host1"], "note": None}]
+    incoming = [{"id": "a", "sshConnections": None, "note": "fresh", "status": None}]
+    merged = merge_rows(previous, incoming)
+    assert merged[0]["sshConnections"] == ["host1"]   # None did not clobber
+    assert merged[0]["note"] == "fresh"               # real value did win
+    assert merged[0]["status"] is None                # new None field passes through
+
+
+def test_refresh_survives_corrupt_cache_file(tmp_path, fake):
+    """A foreign/corrupt cache file is a per-section failure recorded in
+    snapshot.errors — it must not abort the other sections' refresh."""
+    import json as _json
+
+    from prime_tpu.core.client import APIClient
+    from prime_tpu.core.config import Config
+
+    cfg = Config()
+    cfg.api_key = "test-key"
+    api = APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    from prime_tpu.api.pods import CreatePodRequest, PodsClient
+
+    PodsClient(api).create(CreatePodRequest(name="ok-pod", slice_name="v5e-8"))
+    source = LabDataSource(tmp_path, api_client=api)
+    cache_dir = tmp_path / ".prime-lab" / "cache"
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    (cache_dir / "evals.json").write_text(_json.dumps({"rows": ["not", "dicts"], "ts": 1}))
+    snap = source.refresh()
+    assert snap.platform["pods"][0]["name"] == "ok-pod"   # healthy section unaffected
+
+
+def test_refresh_merges_into_cached_rows(tmp_path, fake):
+    from prime_tpu.core.client import APIClient
+    from prime_tpu.core.config import Config
+
+    cfg = Config()
+    cfg.api_key = "test-key"
+    api = APIClient(config=cfg, base_url="https://api.fake", transport=fake.transport)
+    from prime_tpu.api.pods import CreatePodRequest, PodsClient
+
+    pod = PodsClient(api).create(CreatePodRequest(name="merge-pod", slice_name="v5e-8"))
+    source = LabDataSource(tmp_path, api_client=api)
+    source.refresh()
+    # enrich the cached row as a detail hydration would
+    rows, _ = source.cache.get("pods")
+    rows[0]["detailNote"] = "hand-enriched"
+    source.cache.put("pods", rows)
+    snap = source.refresh()
+    enriched = next(r for r in snap.platform["pods"] if r.get("podId") == pod.pod_id)
+    assert enriched["detailNote"] == "hand-enriched"          # survived re-fetch
+    assert enriched["name"] == "merge-pod"
+
+
 def test_lab_view_cli(tmp_path, fake, monkeypatch):
     monkeypatch.chdir(tmp_path)
     runner = CliRunner()
