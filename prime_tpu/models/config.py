@@ -55,6 +55,9 @@ class ModelConfig:
     n_experts: int = 0
     experts_per_token: int = 2
     capacity_factor: float = 1.25
+    # renormalize the chosen top-k gates to sum 1 (Mixtral, Qwen3-MoE w/
+    # norm_topk_prob=True); False keeps raw softmax mass
+    norm_topk: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -293,6 +296,27 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         final_softcap=30.0,
         query_scale=144,
         sliding_window=4096,
+    ),
+    # Qwen3-MoE: qk-norm attention over 128 fine-grained experts, top-8,
+    # raw-softmax gates renormalized per norm_topk_prob (True on the released
+    # 30B-A3B), expert width 768 (moe_intermediate_size)
+    "qwen3-30b-a3b": ModelConfig(
+        name="qwen3-30b-a3b",
+        vocab_size=151936,
+        d_model=2048,
+        n_layers=48,
+        n_heads=32,
+        n_kv_heads=4,
+        d_ff=768,
+        max_seq_len=32768,
+        rope_theta=1000000.0,
+        rms_eps=1e-6,
+        head_dim_override=128,
+        qk_norm=True,
+        n_experts=128,
+        experts_per_token=8,
+        norm_topk=True,
+        capacity_factor=2.0,
     ),
     # Gemma 3 family (text towers): Gemma2's GeGLU/(1+w)/post-norms/scaled
     # embeddings, minus the softcaps, plus per-head qk-norm, a 5:1

@@ -28,7 +28,17 @@ from prime_tpu.models.config import ModelConfig
 # ((1+w) norms + sqrt(d) embed scale + GeGLU), phi3 (fused qkv), etc. — must
 # fail loudly here rather than load and silently produce garbage logits.
 SUPPORTED_MODEL_TYPES = frozenset(
-    {"llama", "mistral", "mixtral", "qwen2", "qwen3", "gemma2", "gemma3_text", "gemma3"}
+    {
+        "llama",
+        "mistral",
+        "mixtral",
+        "qwen2",
+        "qwen3",
+        "qwen3_moe",
+        "gemma2",
+        "gemma3_text",
+        "gemma3",
+    }
 )
 
 
@@ -91,6 +101,17 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
     # Qwen2 checkpoints carry q/k/v biases unconditionally; Llama-family
     # configs declare them via attention_bias
     attn_bias = bool(getattr(hf_config, "attention_bias", False)) or model_type == "qwen2"
+    if model_type == "qwen3_moe":
+        # the uniform layer scan needs every layer sparse; a mixed
+        # dense/sparse schedule would silently run dense layers through the
+        # router, so reject the configs that declare one
+        if getattr(hf_config, "mlp_only_layers", None):
+            raise ValueError(
+                "qwen3_moe with mlp_only_layers (mixed dense/sparse layers) "
+                "is not supported; every layer must be sparse"
+            )
+        if int(getattr(hf_config, "decoder_sparse_step", 1) or 1) != 1:
+            raise ValueError("qwen3_moe decoder_sparse_step != 1 is not supported")
     gemma3 = model_type == "gemma3_text"
     gemma = model_type == "gemma2" or gemma3
     # Gemma3 4b+ stretch global-layer rope linearly (factor 8); local layers
@@ -121,7 +142,7 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         attn_bias=attn_bias,
         # Llama-arch attention_bias biases o_proj as well; Qwen2 does not
         attn_out_bias=bool(getattr(hf_config, "attention_bias", False)),
-        qk_norm=model_type in ("qwen3", "gemma3_text"),
+        qk_norm=model_type in ("qwen3", "qwen3_moe", "gemma3_text"),
         # Gemma2/3: GeGLU, (1+w) norms, post-norms, scaled embeddings; Gemma2
         # adds softcapped scores/logits, Gemma3 drops the caps and adds
         # qk-norm + dual-frequency rope
@@ -154,7 +175,13 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         n_layers=hf_config.num_hidden_layers,
         n_heads=hf_config.num_attention_heads,
         n_kv_heads=getattr(hf_config, "num_key_value_heads", hf_config.num_attention_heads),
-        d_ff=hf_config.intermediate_size,
+        # sparse models size their experts by moe_intermediate_size (Qwen3-MoE
+        # 768 vs a dense intermediate the all-sparse stack never uses); only
+        # qwen3_moe among the supported types carries the key
+        d_ff=(
+            int(getattr(hf_config, "moe_intermediate_size", 0) or 0)
+            or hf_config.intermediate_size
+        ),
         # capped: the no-cache forward materializes rope tables at max_seq_len
         # (two pairs for dual-frequency models — ~256MB at gemma3's 131k);
         # serving sizes tables from the KV capacity, and a longer training
@@ -165,9 +192,22 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         # Gemma's config default ties embeddings, so checkpoints omit the key
         # from config.json; Llama-family defaults to untied
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", gemma),
-        # Mixtral-style sparse MoE
-        n_experts=getattr(hf_config, "num_local_experts", 0) or 0,
-        experts_per_token=getattr(hf_config, "num_experts_per_tok", 2) or 2,
+        # sparse MoE: Mixtral names the count num_local_experts, Qwen3-MoE
+        # num_experts; Qwen3-MoE checkpoints also choose whether top-k gates
+        # renormalize (norm_topk_prob)
+        n_experts=(
+            getattr(hf_config, "num_local_experts", 0)
+            or getattr(hf_config, "num_experts", 0)
+            or 0
+        ),
+        # fallbacks track each family's OWN transformers defaults: a pared
+        # config.json that omits a key must load with the math transformers
+        # would use, not this loader's preference
+        experts_per_token=(
+            getattr(hf_config, "num_experts_per_tok", None)
+            or (8 if model_type == "qwen3_moe" else 2)
+        ),
+        norm_topk=bool(getattr(hf_config, "norm_topk_prob", model_type != "qwen3_moe")),
     )
 
 
@@ -218,8 +258,28 @@ def params_from_state_dict(
         return jnp.asarray(np.stack(mats), dtype=dtype)
 
     if config.is_moe:
-        # Mixtral layout: block_sparse_moe.gate (router) + experts.M.{w1,w2,w3}
-        # w1 = gate_proj, w3 = up_proj (both (F, D)); w2 = down_proj ((D, F))
+        # two expert layouts share the same math:
+        # - Mixtral: block_sparse_moe.gate (router) + experts.M.{w1,w2,w3}
+        #   (w1 = gate_proj, w3 = up_proj, both (F, D); w2 = down_proj (D, F))
+        # - Qwen3-MoE: mlp.gate (router) + mlp.experts.M.{gate,up,down}_proj
+        def present(name: str) -> bool:
+            try:
+                get(name)
+            except KeyError:
+                return False
+            return True
+
+        if present("layers.0.mlp.experts.0.gate_proj.weight"):
+            router_t = "layers.{}.mlp.gate.weight"
+            gate_t = "layers.{}.mlp.experts.{}.gate_proj.weight"
+            up_t = "layers.{}.mlp.experts.{}.up_proj.weight"
+            down_t = "layers.{}.mlp.experts.{}.down_proj.weight"
+        else:
+            router_t = "layers.{}.block_sparse_moe.gate.weight"
+            gate_t = "layers.{}.block_sparse_moe.experts.{}.w1.weight"
+            up_t = "layers.{}.block_sparse_moe.experts.{}.w3.weight"
+            down_t = "layers.{}.block_sparse_moe.experts.{}.w2.weight"
+
         def stacked_experts(template: str) -> jnp.ndarray:
             layers_out = []
             for layer in range(config.n_layers):
@@ -233,16 +293,13 @@ def params_from_state_dict(
         mlp_weights = {
             "router": jnp.asarray(
                 np.stack(
-                    [
-                        get(f"layers.{layer}.block_sparse_moe.gate.weight").T
-                        for layer in range(config.n_layers)
-                    ]
+                    [get(router_t.format(layer)).T for layer in range(config.n_layers)]
                 ),
                 dtype=jnp.float32,  # router decisions stay fp32
             ),
-            "w_gate": stacked_experts("layers.{}.block_sparse_moe.experts.{}.w1.weight"),
-            "w_up": stacked_experts("layers.{}.block_sparse_moe.experts.{}.w3.weight"),
-            "w_down": stacked_experts("layers.{}.block_sparse_moe.experts.{}.w2.weight"),
+            "w_gate": stacked_experts(gate_t),
+            "w_up": stacked_experts(up_t),
+            "w_down": stacked_experts(down_t),
         }
     else:
         mlp_weights = {

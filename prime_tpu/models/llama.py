@@ -310,6 +310,7 @@ def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> tuple[jnp.nda
             lp["w_down"],
             k=config.experts_per_token,
             capacity_factor=config.capacity_factor,
+            norm_topk=config.norm_topk,
         )
         if "mlp_post_norm" in lp:
             y = _norm(y, lp["mlp_post_norm"], config)

@@ -29,13 +29,17 @@ def top_k_routing(
     k: int,
     capacity: int,
     valid: jnp.ndarray | None = None,  # (T,) 1.0 for real tokens
+    norm_topk: bool = True,
 ):
     """Returns (dispatch (T, E, C), combine (T, E, C), aux_loss scalar).
 
-    dispatch is a one-hot routing tensor; combine carries the (renormalized)
-    router probability of each token's chosen experts at its capacity slot.
-    ``valid`` masks padding tokens out of routing entirely — they take no
-    capacity slot and contribute nothing to the aux loss statistics.
+    dispatch is a one-hot routing tensor; combine carries the router
+    probability of each token's chosen experts at its capacity slot —
+    renormalized over the chosen k when ``norm_topk`` (Mixtral and
+    Qwen3-MoE's norm_topk_prob=True), raw softmax mass otherwise
+    (norm_topk_prob=False checkpoints). ``valid`` masks padding tokens out
+    of routing entirely — they take no capacity slot and contribute nothing
+    to the aux loss statistics.
     """
     tokens, n_experts = router_logits.shape
     probs = jax.nn.softmax(router_logits, axis=-1)  # (T, E)
@@ -53,9 +57,11 @@ def top_k_routing(
         gate_values.append(jnp.sum(probs * one_hot, axis=-1))      # (T,)
         masked = masked * (1.0 - one_hot)
 
-    # renormalize the chosen gates so they sum to 1 per token (Mixtral style)
     gate_stack = jnp.stack(gate_values, axis=-1)                   # (T, k)
-    gate_stack = gate_stack / jnp.maximum(jnp.sum(gate_stack, axis=-1, keepdims=True), 1e-9)
+    if norm_topk:  # chosen gates sum to 1 per token (Mixtral style)
+        gate_stack = gate_stack / jnp.maximum(
+            jnp.sum(gate_stack, axis=-1, keepdims=True), 1e-9
+        )
 
     # capacity positions: for each expert, tokens are served in order; a
     # token's slot is its cumulative index among tokens routed to that expert
@@ -98,6 +104,7 @@ def moe_mlp(
     k: int,
     capacity_factor: float,
     group_size: int = MOE_GROUP_SIZE,
+    norm_topk: bool = True,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Sparse MoE feed-forward. Returns (output (B, S, D), aux_loss).
 
@@ -126,7 +133,7 @@ def moe_mlp(
     )
     capacity = expert_capacity(group, n_experts, k, capacity_factor)
     dispatch, combine, aux_loss = jax.vmap(
-        lambda logits, v: top_k_routing(logits, k, capacity, valid=v)
+        lambda logits, v: top_k_routing(logits, k, capacity, valid=v, norm_topk=norm_topk)
     )(router_logits, valid)
     dispatch = dispatch.astype(x.dtype)   # (g, group, E, C)
     combine = combine.astype(x.dtype)

@@ -536,6 +536,130 @@ def test_gemma3_irregular_layer_types_rejected():
         config_from_hf(Cfg())
 
 
+# -- Qwen3-MoE family ---------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def qwen3moe_model():
+    cfg = transformers.Qwen3MoeConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,     # unused: every layer is sparse
+        moe_intermediate_size=48,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        num_experts=4,
+        num_experts_per_tok=2,
+        norm_topk_prob=True,       # the released 30B-A3B setting
+        max_position_embeddings=128,
+        rope_theta=1000000.0,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(13)
+    model = transformers.Qwen3MoeForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_qwen3moe_config_mapping(qwen3moe_model):
+    config = config_from_hf(qwen3moe_model.config, name="tiny-qwen3moe")
+    assert config.qk_norm and config.is_moe
+    assert config.n_experts == 4 and config.experts_per_token == 2
+    assert config.d_ff == 48       # moe_intermediate_size, not intermediate_size
+    assert config.norm_topk is True
+
+
+def test_qwen3moe_logits_match_transformers(qwen3moe_model):
+    """Qwen expert layout (mlp.gate + experts.M.{gate,up,down}_proj) through
+    the same grouped-dispatch MoE math as Mixtral, plus qk-norm attention."""
+    state = {k: v.float().numpy() for k, v in qwen3moe_model.state_dict().items()}
+    config = config_from_hf(qwen3moe_model.config, name="tiny-qwen3moe")
+    config = config.scaled(capacity_factor=8.0)  # no capacity drops vs HF's exact routing
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+    assert "router" in params["layers"] and "q_norm" in params["layers"]
+
+    tokens = np.array([[3, 17, 200, 45, 9, 88, 121, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = qwen3moe_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    our_logits, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=5e-4, atol=5e-4)
+
+
+def test_qwen3moe_norm_topk_false_changes_gates():
+    """norm_topk=False keeps raw softmax mass on the chosen experts —
+    the combine weights must NOT sum to 1 per token."""
+    import jax
+
+    from prime_tpu.ops.moe import expert_capacity, top_k_routing
+
+    logits = jax.random.normal(jax.random.PRNGKey(0), (16, 4), dtype=jnp.float32)
+    capacity = expert_capacity(16, 4, k=2, capacity_factor=8.0)
+    _, combine_norm, _ = top_k_routing(logits, k=2, capacity=capacity, norm_topk=True)
+    _, combine_raw, _ = top_k_routing(logits, k=2, capacity=capacity, norm_topk=False)
+    sums_norm = np.asarray(jnp.sum(combine_norm, axis=(1, 2)))
+    sums_raw = np.asarray(jnp.sum(combine_raw, axis=(1, 2)))
+    np.testing.assert_allclose(sums_norm, 1.0, atol=1e-5)
+    assert (sums_raw < 1.0 - 1e-4).all()   # softmax mass of k of 4 experts < 1
+
+
+def test_qwen3moe_mixed_dense_layers_rejected():
+    from prime_tpu.models.hf_loader import config_from_hf
+
+    class Cfg:
+        model_type = "qwen3_moe"
+        vocab_size = 128
+        hidden_size = 64
+        num_hidden_layers = 4
+        num_attention_heads = 4
+        num_key_value_heads = 2
+        intermediate_size = 128
+        moe_intermediate_size = 48
+        num_experts = 4
+        num_experts_per_tok = 2
+        mlp_only_layers = [0]
+
+    with pytest.raises(ValueError, match="mlp_only_layers"):
+        config_from_hf(Cfg())
+    Cfg.mlp_only_layers = []
+    Cfg.decoder_sparse_step = 2
+    with pytest.raises(ValueError, match="decoder_sparse_step"):
+        config_from_hf(Cfg())
+
+
+def test_qwen3moe_pared_config_tracks_hf_defaults():
+    """A config.json omitting norm_topk_prob / num_experts_per_tok must load
+    with transformers' qwen3_moe defaults (False / 8), not this loader's
+    Mixtral-shaped preferences."""
+    from prime_tpu.models.hf_loader import config_from_hf
+
+    class Cfg:
+        model_type = "qwen3_moe"
+        vocab_size = 128
+        hidden_size = 64
+        num_hidden_layers = 2
+        num_attention_heads = 4
+        num_key_value_heads = 2
+        intermediate_size = 128
+        moe_intermediate_size = 48
+        num_experts = 16
+
+    config = config_from_hf(Cfg())
+    assert config.norm_topk is False
+    assert config.experts_per_token == 8
+    # Mixtral keeps its own defaults (renormalized gates, top-2)
+    hf_mixtral = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
+        num_local_experts=8,
+    )
+    mixtral_cfg = config_from_hf(hf_mixtral)
+    assert mixtral_cfg.norm_topk is True and mixtral_cfg.experts_per_token == 2
+
+
 def test_rope_scaling_default_accepted_and_long_context_capped():
     """HF's rope_scaling {"rope_type": "default"} means unscaled — it must
     load; non-linear types must not. max_position_embeddings is capped at 32k
