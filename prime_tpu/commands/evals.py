@@ -55,7 +55,8 @@ POLL_INTERVAL_S = 3.0
 @click.option("--kv-quant", is_flag=True, help="int8 KV cache (halved decode HBM traffic).")
 @click.option("--weight-quant", is_flag=True, help="int8 weights (W8A16) for serving-side evals.")
 @click.option("--speculative", is_flag=True,
-              help="Prompt-lookup speculative decoding (greedy runs only; exact).")
+              help="Prompt-lookup speculative decoding (greedy: exact tokens; "
+                   "sampled: exact distribution via rejection sampling).")
 @click.option("--draft-len", type=click.IntRange(min=1), default=4,
               help="Draft tokens per verify pass.")
 @click.option("--adapter", default=None, type=click.Path(exists=True),
@@ -156,13 +157,6 @@ def run_eval_cmd(
         if "temperature" in loaded.defaults and flag_is_default("temperature"):
             temperature = float(loaded.defaults["temperature"])
 
-    # after env defaults: an env-declared sampling temperature must not let
-    # --speculative silently fall back to plain decoding
-    if speculative and temperature != 0.0:
-        raise click.ClickException(
-            "--speculative is exact only for greedy decoding (temperature 0); "
-            f"this run resolved temperature={temperature}"
-        )
     if speculative and kv_quant:
         raise click.ClickException(
             "speculative decoding has no int8-cache verify path yet — "

@@ -51,7 +51,7 @@ class EvalRunSpec:
     tensor_parallel: int | None = None   # override tp axis (default: mesh_for_slice policy)
     kv_quant: bool = False               # int8 KV cache (halved decode HBM traffic)
     weight_quant: bool = False           # int8 weights (W8A16)
-    speculative: bool = False            # prompt-lookup speculation (greedy only)
+    speculative: bool = False            # prompt-lookup speculation (any temperature)
     draft_len: int = 4                   # draft tokens per verify pass
     adapter: str | None = None           # LoRA adapter artifact dir to merge
     metadata: dict = field(default_factory=dict)
@@ -253,9 +253,11 @@ class JaxGenerator:
 
         ctx = jax.set_mesh(self.mesh) if self.mesh is not None else contextlib.nullcontext()
         with ctx:
-            if self.speculative and temperature == 0.0:
+            if self.speculative:
                 from prime_tpu.models.speculative import spec_generate
 
+                # sampled speculation is rejection sampling against the
+                # n-gram proposal — exact in DISTRIBUTION at any temperature
                 result = spec_generate(
                     self.params,
                     batch,
@@ -267,10 +269,12 @@ class JaxGenerator:
                     pad_id=pad_id,
                     attn_impl=kw.get("attn_impl", "auto"),
                     cache_spec=kw.get("cache_spec"),
+                    temperature=temperature,
+                    top_p=top_p,
+                    nucleus=top_p < 1.0,
+                    rng=rng,
                 )
             else:
-                # speculation is exact only in argmax space — sampled
-                # generation falls back to the plain path
                 result = sample_generate(
                     self.params,
                     batch,

@@ -92,7 +92,8 @@ SKILLS: dict[str, str] = {
    endpoint; `--continuous` enables slot-based continuous batching with
    chunked prefill + prefix KV reuse.
 2. Quantization: `--weight-quant` (int8 W8A16, fastest single-chip),
-   `--kv-quant` (int8 KV cache). Speculative: `--speculative` (greedy only).
+   `--kv-quant` (int8 KV cache). Speculative: `--speculative` (greedy: exact
+   tokens; sampled: exact distribution; not combinable with --kv-quant).
 3. Sharded: `--slice v5e-8 [--tp N]` shards over the slice mesh; MoE models
    carve an expert-parallel axis automatically.
 """,

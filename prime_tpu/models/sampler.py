@@ -47,6 +47,17 @@ def top_p_filter(logits: jnp.ndarray, top_p) -> jnp.ndarray:
     return jnp.where(logits >= cutoff, logits, -jnp.inf)
 
 
+def scaled_logits(logits: jnp.ndarray, temperature: float, top_p, nucleus: bool) -> jnp.ndarray:
+    """The sampling distribution's logits: temperature scaling then the
+    nucleus filter. ONE owner for this ordering — the speculative verifier
+    computes acceptance probabilities from the same function plain sampling
+    draws from, so the two can never drift."""
+    logits = logits / temperature
+    if nucleus:
+        logits = top_p_filter(logits, top_p)
+    return logits
+
+
 def _sample(
     logits: jnp.ndarray,
     temperature: float,
@@ -59,10 +70,7 @@ def _sample(
     triggering a full recompile of the generation program."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
-    logits = logits / temperature
-    if nucleus:
-        logits = top_p_filter(logits, top_p)
-    return jax.random.categorical(rng, logits, axis=-1)
+    return jax.random.categorical(rng, scaled_logits(logits, temperature, top_p, nucleus), axis=-1)
 
 
 def run_prefill(

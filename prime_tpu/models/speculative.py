@@ -1,4 +1,4 @@
-"""Prompt-lookup speculative decoding: draft-free multi-token greedy decode.
+"""Prompt-lookup speculative decoding: draft-free multi-token decode.
 
 The reference serves models through hosted inference (SURVEY.md §2.2
 ``/inference``) and never decodes locally; this framework's native serving
@@ -8,7 +8,10 @@ n-gram lookup in the sequence's own history (prompt + generation so far —
 "prompt-lookup decoding", the draft-model-free variant), then verify all D in
 ONE forward pass over the KV cache. Greedy verification is exact: emitted
 tokens are identical to plain ``generate`` token-for-token; matching drafts
-just arrive D-at-a-time for one weight read.
+just arrive D-at-a-time for one weight read. Sampled verification
+(temperature > 0) is rejection sampling against the point-mass n-gram
+proposal — exact in distribution (Leviathan et al. 2023 scheme specialized
+to a deterministic draft).
 
 TPU-first construction — the whole loop is one jitted ``lax.while_loop``:
 - static shapes throughout: the verify window is always (B, D+1); the
@@ -26,11 +29,13 @@ token per pass, like plain decode, plus the D-slot verify overhead. Measured
 on v5e-1, llama3.2-1b bf16, b8 p128+128 periodic context: 1503 -> 2379 tok/s
 (1.58x) at draft_len=4.
 
-Exactness caveat: "exact" means exact in argmax space — the (B, D+1) verify
-matmul and the (B, 1) decode matmul can round bf16 logits differently, so a
-near-tied argmax can flip vs plain decode (standard for batched-verify
-speculation; bit-identical in fp32, and immaterial for trained checkpoints
-where ties are rare).
+Exactness caveat: the (B, D+1) verify matmul and the (B, 1) decode matmul can
+round bf16 activations differently. Greedy: "exact" means exact in argmax
+space — a near-tied argmax can flip vs plain decode. Sampled: "exact in
+distribution" holds for the distribution induced by the verify pass's
+logits, which match plain decode's up to that same bf16 rounding (standard
+for batched-verify speculation; bit-identical in fp32, immaterial for
+trained checkpoints).
 """
 
 from __future__ import annotations
@@ -43,7 +48,13 @@ import jax.numpy as jnp
 
 from prime_tpu.models.config import ModelConfig
 from prime_tpu.models.llama import KVCache, forward
-from prime_tpu.models.sampler import GenerationResult, finalize_tokens, run_prefill
+from prime_tpu.models.sampler import (
+    GenerationResult,
+    _sample,
+    finalize_tokens,
+    run_prefill,
+    scaled_logits,
+)
 
 
 def propose_ngram_drafts(
@@ -85,13 +96,14 @@ class _SpecCarry(NamedTuple):
     cache_len: jnp.ndarray   # (B,) cache entries whose K/V are valid
     emitted: jnp.ndarray     # (B,) generated-token counts
     done: jnp.ndarray        # (B,)
+    rng: jnp.ndarray         # sampling key (unused in greedy mode)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "config", "max_new_tokens", "draft_len", "eos_id", "pad_id", "attn_impl",
-        "cache_spec",
+        "cache_spec", "temperature", "nucleus",
     ),
 )
 def spec_generate(
@@ -105,11 +117,27 @@ def spec_generate(
     pad_id: int = 0,
     attn_impl: str = "auto",
     cache_spec=None,
+    temperature: float = 0.0,
+    top_p=1.0,                     # traced; active only with nucleus=True
+    nucleus: bool = False,
+    rng: jnp.ndarray | None = None,
 ) -> GenerationResult:
-    """Greedy generation via prompt-lookup speculation. Emits exactly the
-    tokens plain greedy ``generate`` would (logprobs are returned as zeros —
-    the verify pass works in argmax space)."""
+    """Generation via prompt-lookup speculation.
+
+    temperature == 0 verifies in argmax space and emits exactly the tokens
+    plain greedy ``generate`` would. temperature > 0 uses deterministic-
+    proposal rejection sampling (Leviathan et al.): draft token x is accepted
+    with probability p(x) — its full model probability, since the n-gram
+    proposal is a point mass — and on rejection the correction is drawn from
+    the residual p with x zeroed. The OUTPUT DISTRIBUTION is exactly the
+    autoregressive sampling distribution at the same temperature/top_p; only
+    the number of forward passes changes. logprobs are returned as zeros.
+    """
     batch, prompt_len = prompt_tokens.shape
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampled speculative decoding needs an rng key")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)  # never consumed on the greedy path
     # history is padded so a (draft_len+1) scatter window starting at any
     # valid row length stays in-bounds (no silent dynamic_slice clamping);
     # the cache matches because verify windows scribble up to draft_len+1
@@ -119,7 +147,8 @@ def spec_generate(
         params, prompt_tokens, prompt_lengths, config, capacity=total,
         attn_impl=attn_impl, cache_spec=cache_spec,
     )
-    first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    rng, first_rng = jax.random.split(rng)
+    first = _sample(last, temperature, first_rng, top_p, nucleus).astype(jnp.int32)
     first_done = first == eos_id
 
     # the first token occupies a buffer slot even when it is EOS
@@ -135,6 +164,7 @@ def spec_generate(
         cache_len=prompt_lengths.astype(jnp.int32),
         emitted=jnp.ones((batch,), jnp.int32),
         done=first_done,
+        rng=rng,
     )
 
     def cond(c: _SpecCarry):
@@ -155,17 +185,59 @@ def spec_generate(
             attn_impl=attn_impl,
             prefill_offset=c.cache_len,
         )
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)          # (B, D+1)
+        next_rng = c.rng
+        if temperature == 0.0:
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)      # (B, D+1)
+            # leading run of drafts the model itself would have produced
+            agree = drafts == greedy[:, :-1]                            # (B, D)
+            n_acc = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1), axis=1)
+            tokens_round = greedy
+        else:
+            # rejection sampling against the point-mass n-gram proposal:
+            # accept draft x_i with prob p_i(x_i); the correction at the
+            # first rejection samples the residual (p with x_i zeroed), the
+            # bonus after a full run samples p_D directly
+            next_rng, accept_rng, fix_rng = jax.random.split(c.rng, 3)
+            # forward() emits fp32 logits; scaled_logits is the same function
+            # _sample draws from, so acceptance tests use exactly the
+            # distribution plain sampling would
+            probs = jax.nn.softmax(
+                scaled_logits(logits, temperature, top_p, nucleus), axis=-1
+            )                                                           # (B, D+1, V)
+            draft_p = jnp.squeeze(
+                jnp.take_along_axis(probs[:, :draft_len, :], drafts[:, :, None], axis=2),
+                axis=2,
+            )                                                           # (B, D)
+            uniform = jax.random.uniform(accept_rng, (batch, draft_len))
+            accept = uniform < draft_p
+            n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+            pos = n_acc                                                 # (B,) 0..D
+            p_pos = jax.vmap(lambda p, i: p[i])(probs, pos)             # (B, V)
+            rejected = pos < draft_len
+            draft_at = jax.vmap(lambda d, i: d[jnp.minimum(i, draft_len - 1)])(
+                drafts, pos
+            )
+            vocab_ids = jnp.arange(probs.shape[-1])[None, :]
+            residual = jnp.where(
+                rejected[:, None] & (vocab_ids == draft_at[:, None]), 0.0, p_pos
+            )
+            # categorical is scale-invariant — no renormalization needed
+            corrected = jax.random.categorical(
+                fix_rng, jnp.log(jnp.maximum(residual, 1e-30))
+            ).astype(jnp.int32)                                         # (B,)
+            padded_drafts = jnp.concatenate(
+                [drafts, jnp.zeros((batch, 1), jnp.int32)], axis=1
+            )                                                           # (B, D+1)
+            emit_pos = jnp.arange(draft_len + 1)[None, :]
+            tokens_round = jnp.where(
+                emit_pos == pos[:, None], corrected[:, None], padded_drafts
+            )
 
-        # leading run of drafts the model itself would have produced
-        agree = drafts == greedy[:, :-1]                                # (B, D)
-        n_acc = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1), axis=1)
-
-        # emitted this round: greedy[0..n_acc] — accepted drafts + the bonus/
-        # correction token. Truncate at the first EOS and at the budget.
+        # emitted this round: tokens_round[0..n_acc] — accepted drafts + the
+        # bonus/correction token. Truncate at the first EOS and at the budget.
         emit_ids = jnp.arange(draft_len + 1)[None, :]
         in_run = emit_ids <= n_acc[:, None]
-        is_eos = (greedy == eos_id) & in_run
+        is_eos = (tokens_round == eos_id) & in_run
         # index of the first EOS within the run (draft_len+1 if none)
         eos_first = jnp.min(
             jnp.where(is_eos, emit_ids, draft_len + 1), axis=1
@@ -176,7 +248,7 @@ def spec_generate(
         run_len = jnp.where(c.done, 0, run_len)
 
         keep = emit_ids < run_len[:, None]
-        tokens_out = jnp.where(keep, greedy, pad_id)
+        tokens_out = jnp.where(keep, tokens_round, pad_id)
 
         def scatter_row(row, start, vals, m):
             window_old = jax.lax.dynamic_slice(row, (start,), (draft_len + 1,))
@@ -197,6 +269,7 @@ def spec_generate(
             cache_len=new_cache_len,
             emitted=c.emitted + run_len,
             done=new_done,
+            rng=next_rng,
         )
 
     final = jax.lax.while_loop(cond, body, carry)

@@ -131,6 +131,75 @@ def test_spec_generate_sharded_matches_single_device(params):
     np.testing.assert_array_equal(np.asarray(out.tokens), np.asarray(ref.tokens))
 
 
+# -- sampled speculation: exact in DISTRIBUTION -------------------------------
+
+
+SAMP_CFG = CFG.scaled(name="tiny-samp", vocab_size=16, d_model=32, n_layers=1, n_heads=2, n_kv_heads=1, d_ff=64)
+
+
+@pytest.fixture(scope="module")
+def samp_params():
+    return init_params(jax.random.PRNGKey(3), SAMP_CFG, dtype=jnp.float32)
+
+
+def test_spec_sampled_matches_plain_distribution(samp_params):
+    """Rejection sampling against the n-gram proposal must reproduce the
+    autoregressive sampling distribution exactly — compare per-position
+    marginals over many seeds (TV distance below statistical noise)."""
+    n_runs = 2048
+    max_new = 3
+    prompt = jnp.array([[3, 7, 3, 7, 3]], dtype=jnp.int32)
+    lengths = jnp.array([5], dtype=jnp.int32)
+    keys = jax.random.split(jax.random.PRNGKey(42), n_runs)
+
+    def run_spec(key):
+        return spec_generate(
+            samp_params, prompt, lengths, SAMP_CFG, max_new_tokens=max_new,
+            draft_len=3, pad_id=0, attn_impl="xla", temperature=0.7, rng=key,
+        ).tokens[0]
+
+    def run_plain(key):
+        return generate(
+            samp_params, prompt, lengths, SAMP_CFG, key, max_new_tokens=max_new,
+            temperature=0.7, pad_id=0, attn_impl="xla",
+        ).tokens[0]
+
+    spec_tokens = np.asarray(jax.vmap(run_spec)(keys))      # (n, max_new)
+    plain_tokens = np.asarray(jax.vmap(run_plain)(keys))
+    for position in range(max_new):
+        spec_hist = np.bincount(spec_tokens[:, position], minlength=16) / n_runs
+        plain_hist = np.bincount(plain_tokens[:, position], minlength=16) / n_runs
+        tv = 0.5 * np.abs(spec_hist - plain_hist).sum()
+        assert tv < 0.09, f"position {position}: TV {tv:.3f}"
+
+
+def test_spec_sampled_top_p_collapses_to_greedy(samp_params):
+    """nucleus with a vanishing top_p keeps only the argmax token — sampled
+    speculation must then emit exactly the greedy sequence."""
+    prompt = jnp.array([[3, 7, 3, 7, 3, 9, 2, 11]], dtype=jnp.int32)
+    lengths = jnp.array([8], dtype=jnp.int32)
+    greedy = spec_generate(
+        samp_params, prompt, lengths, SAMP_CFG, max_new_tokens=8,
+        draft_len=3, pad_id=0, attn_impl="xla",
+    )
+    nucleus = spec_generate(
+        samp_params, prompt, lengths, SAMP_CFG, max_new_tokens=8,
+        draft_len=3, pad_id=0, attn_impl="xla",
+        temperature=1.0, top_p=1e-6, nucleus=True, rng=jax.random.PRNGKey(9),
+    )
+    np.testing.assert_array_equal(np.asarray(greedy.tokens), np.asarray(nucleus.tokens))
+    np.testing.assert_array_equal(np.asarray(greedy.lengths), np.asarray(nucleus.lengths))
+
+
+def test_spec_sampled_requires_rng(samp_params):
+    prompt = jnp.array([[1, 2, 3]], dtype=jnp.int32)
+    with pytest.raises(ValueError, match="rng"):
+        spec_generate(
+            samp_params, prompt, jnp.array([3], dtype=jnp.int32), SAMP_CFG,
+            max_new_tokens=2, temperature=0.5,
+        )
+
+
 def test_jax_generator_speculative_matches_plain():
     from prime_tpu.evals.runner import JaxGenerator
 
