@@ -157,12 +157,6 @@ def run_eval_cmd(
         if "temperature" in loaded.defaults and flag_is_default("temperature"):
             temperature = float(loaded.defaults["temperature"])
 
-    if speculative and kv_quant:
-        raise click.ClickException(
-            "speculative decoding has no int8-cache verify path yet — "
-            "pick one of --speculative / --kv-quant"
-        )
-
     spec = EvalRunSpec(
         env=run_env_name,
         model=model,

@@ -100,12 +100,6 @@ class JaxGenerator:
         from prime_tpu.models import get_config
         from prime_tpu.models.llama import init_params
 
-        # boolean-only validation before any checkpoint IO
-        if speculative and kv_quant:
-            raise ValueError(
-                "speculative decoding has no int8-cache verify path yet — "
-                "pick one of --speculative / --kv-quant"
-            )
         dtype = dtype or jnp.bfloat16
         if checkpoint is None and Path(model).is_dir():
             checkpoint = model  # `-m ./my-checkpoint` means "load this"
@@ -273,6 +267,7 @@ class JaxGenerator:
                     top_p=top_p,
                     nucleus=top_p < 1.0,
                     rng=rng,
+                    kv_quant=self.kv_quant,
                 )
             else:
                 result = sample_generate(

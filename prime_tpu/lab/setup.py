@@ -93,7 +93,7 @@ SKILLS: dict[str, str] = {
    chunked prefill + prefix KV reuse.
 2. Quantization: `--weight-quant` (int8 W8A16, fastest single-chip),
    `--kv-quant` (int8 KV cache). Speculative: `--speculative` (greedy: exact
-   tokens; sampled: exact distribution; not combinable with --kv-quant).
+   tokens; sampled: exact distribution; composes with --kv-quant).
 3. Sharded: `--slice v5e-8 [--tp N]` shards over the slice mesh; MoE models
    carve an expert-parallel axis automatically.
 """,
@@ -119,7 +119,7 @@ declared JSON schema; malformed calls render as widget errors, never crash.
 
 # Bump when SKILLS content changes: setup auto-refreshes bundled skills whose
 # on-disk content still matches the PREVIOUS bundle (i.e. not locally edited).
-SKILLS_VERSION = 2
+SKILLS_VERSION = 3  # bump on ANY bundled skill content change (sync is version-keyed)
 
 # agent flavor -> (guide surface path, MCP registration path or None).
 # The guide rides the marked generated block; the MCP file registers

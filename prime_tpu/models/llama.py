@@ -248,18 +248,28 @@ def _attention_block(
     elif prefill_offset is not None:
         # chunked prefill: write this chunk's K/V into the cache at the
         # offset, then attend over the cache (earlier chunks + reused prefix
-        # are visible; within-chunk attention stays causal via the mask)
-        assert k_cache is not None and not quantized, (
-            "chunked prefill requires a bf16 cache (int8 staging would "
-            "re-quantize per chunk)"
-        )
+        # are visible; within-chunk attention stays causal via the mask).
+        # int8 caches are exact here too: scales are PER-SLOT and chunks
+        # write disjoint slots, so each chunk quantizes its own columns once.
+        assert k_cache is not None
         off = prefill_offset.astype(jnp.int32)
         k_t = k.transpose(0, 1, 3, 2)  # (B, KH, hd, S)
         v_t = v.transpose(0, 1, 3, 2)
+        k_block_scale = v_block_scale = None
+        if quantized:
+            k_t, k_block_scale = quantize_kv(k_t)  # int8 + (B, KH, 1, S) scales
+            v_t, v_block_scale = quantize_kv(v_t)
         if off.ndim == 0:  # one shared chunk offset
             zero = jnp.zeros((), dtype=jnp.int32)
-            new_k_cache = jax.lax.dynamic_update_slice(k_cache, k_t, (zero, zero, zero, off))
-            new_v_cache = jax.lax.dynamic_update_slice(v_cache, v_t, (zero, zero, zero, off))
+
+            def put_shared(cache, block):
+                return jax.lax.dynamic_update_slice(cache, block, (zero, zero, zero, off))
+
+            new_k_cache = put_shared(k_cache, k_t)
+            new_v_cache = put_shared(v_cache, v_t)
+            if quantized:
+                new_k_scale = put_shared(k_scale, k_block_scale)
+                new_v_scale = put_shared(v_scale, v_block_scale)
         else:  # (B,): per-row window starts (speculative verify)
             def put_rows(cache, block):
                 def one(c, n, idx):
@@ -269,7 +279,15 @@ def _attention_block(
 
             new_k_cache = put_rows(k_cache, k_t)
             new_v_cache = put_rows(v_cache, v_t)
-        attn = cache_prefill_attention(q, new_k_cache, new_v_cache, off, sm_scale, **gemma_kw)
+            if quantized:
+                new_k_scale = put_rows(k_scale, k_block_scale)
+                new_v_scale = put_rows(v_scale, v_block_scale)
+        attn = cache_prefill_attention(
+            q, new_k_cache, new_v_cache, off, sm_scale,
+            k_scale=new_k_scale if quantized else None,
+            v_scale=new_v_scale if quantized else None,
+            **gemma_kw,
+        )
     else:
         attn = multi_head_attention(q, k, v, sm_scale, impl=attn_impl, **gemma_kw)
         if k_cache is not None:

@@ -103,7 +103,7 @@ class _SpecCarry(NamedTuple):
     jax.jit,
     static_argnames=(
         "config", "max_new_tokens", "draft_len", "eos_id", "pad_id", "attn_impl",
-        "cache_spec", "temperature", "nucleus",
+        "cache_spec", "temperature", "nucleus", "kv_quant",
     ),
 )
 def spec_generate(
@@ -121,6 +121,7 @@ def spec_generate(
     top_p=1.0,                     # traced; active only with nucleus=True
     nucleus: bool = False,
     rng: jnp.ndarray | None = None,
+    kv_quant: bool = False,        # int8 cache; verify windows quantize per-slot
 ) -> GenerationResult:
     """Generation via prompt-lookup speculation.
 
@@ -145,7 +146,7 @@ def spec_generate(
     total = prompt_len + max_new_tokens + draft_len + 1
     last, cache = run_prefill(
         params, prompt_tokens, prompt_lengths, config, capacity=total,
-        attn_impl=attn_impl, cache_spec=cache_spec,
+        attn_impl=attn_impl, cache_spec=cache_spec, kv_quant=kv_quant,
     )
     rng, first_rng = jax.random.split(rng)
     first = _sample(last, temperature, first_rng, top_p, nucleus).astype(jnp.int32)

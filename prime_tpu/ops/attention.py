@@ -197,6 +197,8 @@ def cache_prefill_attention(
     softcap: float = 0.0,
     window: int = 0,
     sliding: jnp.ndarray | None = None,
+    k_scale: jnp.ndarray | None = None,  # (B, KH, 1, C) int8-cache dequant scales
+    v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Attention for chunked prefill: the chunk's K/V are first *written* into
     the cache at ``offset``, then each chunk query attends over the whole
@@ -213,10 +215,21 @@ def cache_prefill_attention(
     kv_heads = k_cache.shape[1]
     group = num_heads // kv_heads
     qg = q.reshape(batch, kv_heads, group, seq, head_dim)
-    scores = (
-        jnp.einsum("bkgsd,bkdc->bkgsc", qg, k_cache, preferred_element_type=jnp.float32)
-        * sm_scale
-    )
+    if k_scale is not None:
+        # int8 cache: per-slot scales are constant over the contracted d axis,
+        # so dequant folds into the score epilogue exactly (decode path's
+        # scheme; k_scale (B, KH, 1, C) broadcasts over the g and s dims)
+        scores = jnp.einsum(
+            "bkgsd,bkdc->bkgsc",
+            qg.astype(jnp.float32),
+            k_cache.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ) * (k_scale[:, :, None, :, :] * sm_scale)
+    else:
+        scores = (
+            jnp.einsum("bkgsd,bkdc->bkgsc", qg, k_cache, preferred_element_type=jnp.float32)
+            * sm_scale
+        )
     scores = _apply_softcap(scores, softcap)
     capacity = k_cache.shape[3]
     # offset () = one shared chunk start; (B,) = per-sequence starts (the
@@ -229,7 +242,14 @@ def cache_prefill_attention(
         visible = visible & _window_ok(q_pos - slot_ids, window, sliding)
     scores = jnp.where(visible[:, None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgsc,bkdc->bkgsd", probs.astype(q.dtype), v_cache)
+    if v_scale is not None:
+        weighted = (probs * v_scale[:, :, None, :, :]).astype(jnp.float32)
+        out = jnp.einsum(
+            "bkgsc,bkdc->bkgsd", weighted, v_cache.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ).astype(q.dtype)
+    else:
+        out = jnp.einsum("bkgsc,bkdc->bkgsd", probs.astype(q.dtype), v_cache)
     return out.reshape(batch, num_heads, seq, head_dim)
 
 

@@ -75,6 +75,59 @@ def test_decode_matches_full_forward(params):
         )
 
 
+def test_chunked_prefill_quantized_matches_one_shot(params):
+    """int8 cache + shared-offset chunked prefill: per-slot scales make each
+    chunk's quantization independent, so the staged cache (values AND scales)
+    must equal the one-shot quantized prefill's exactly. Logits only match
+    approximately BY DESIGN: chunked prefill attends over the int8 cache
+    (like decode does) while one-shot prefill attends on raw activations."""
+    seq, capacity = 24, 32
+    tokens = jax.random.randint(jax.random.PRNGKey(17), (2, seq), 0, CFG.vocab_size)
+    ref_cache = init_cache(CFG, 2, capacity, dtype=jnp.float32, quantized=True)
+    ref_logits, ref_cache = forward(params, tokens, CFG, cache=ref_cache)
+
+    cache = init_cache(CFG, 2, capacity, dtype=jnp.float32, quantized=True)
+    offset = 0
+    chunk_logits = []
+    for size in (8, 16):
+        chunk = tokens[:, offset : offset + size]
+        logits, cache = forward(
+            params, chunk, CFG, cache=cache,
+            prefill_offset=jnp.asarray(offset, dtype=jnp.int32),
+        )
+        chunk_logits.append(logits)
+        offset += size
+    got = jnp.concatenate(chunk_logits, axis=1)
+    # int8 attention noise bound, and the same continuation choice
+    np.testing.assert_allclose(np.asarray(ref_logits), np.asarray(got), rtol=0.1, atol=0.1)
+    np.testing.assert_array_equal(
+        np.asarray(jnp.argmax(ref_logits[:, -1, :], axis=-1)),
+        np.asarray(jnp.argmax(got[:, -1, :], axis=-1)),
+    )
+    # layer 0 sees identical inputs either way -> bit-identical int8 payloads
+    # and per-slot scales (deeper layers legitimately drift: their inputs
+    # already differ by the int8 attention noise above)
+    np.testing.assert_array_equal(
+        np.asarray(ref_cache.k[0, :, :, :, :seq]), np.asarray(cache.k[0, :, :, :, :seq])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref_cache.k_scale[0, :, :, :, :seq]),
+        np.asarray(cache.k_scale[0, :, :, :, :seq]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref_cache.v_scale[0, :, :, :, :seq]),
+        np.asarray(cache.v_scale[0, :, :, :, :seq]),
+    )
+    # deeper layers: dequantized caches stay within the int8 noise bound
+    dequant = lambda c, s: np.asarray(c[:, :, :, :, :seq]).astype(np.float32) * np.asarray(  # noqa: E731
+        s[:, :, :, :, :seq]
+    )
+    np.testing.assert_allclose(
+        dequant(ref_cache.k, ref_cache.k_scale), dequant(cache.k, cache.k_scale),
+        rtol=0.2, atol=0.1,
+    )
+
+
 def test_chunked_prefill_matches_one_shot(params):
     """Feeding a prompt in chunks (write-at-offset + attend-over-cache) must
     reproduce the one-shot prefill logits and leave an equivalent cache."""

@@ -211,8 +211,31 @@ def test_jax_generator_speculative_matches_plain():
     assert a == b
 
 
-def test_jax_generator_speculative_rejects_kv_quant():
+def test_spec_kv_quant_matches_plain_kv_quant(params):
+    """int8-cache speculation: the verify window quantizes its slots with the
+    same per-slot scheme plain decode uses, so the stored cache is identical
+    — greedy spec+kvq must emit exactly what plain kvq decode does (fp32
+    weights: no matmul-rounding ties)."""
+    tokens = jnp.tile(jnp.arange(1, 9, dtype=jnp.int32), (2, 2))  # periodic (2, 16)
+    lengths = jnp.array([16, 12], dtype=jnp.int32)
+    ref = generate(
+        params, tokens, lengths, CFG, jax.random.PRNGKey(1),
+        max_new_tokens=12, temperature=0.0, pad_id=0, attn_impl="xla",
+        kv_quant=True,
+    )
+    out = spec_generate(
+        params, tokens, lengths, CFG,
+        max_new_tokens=12, draft_len=4, pad_id=0, attn_impl="xla",
+        kv_quant=True,
+    )
+    np.testing.assert_array_equal(np.asarray(ref.tokens), np.asarray(out.tokens))
+    np.testing.assert_array_equal(np.asarray(ref.lengths), np.asarray(out.lengths))
+
+
+def test_jax_generator_speculative_with_kv_quant():
+    """The former hard incompatibility is now a working combination."""
     from prime_tpu.evals.runner import JaxGenerator
 
-    with pytest.raises(ValueError, match="speculative"):
-        JaxGenerator("tiny-test", speculative=True, kv_quant=True)
+    gen = JaxGenerator("tiny-test", speculative=True, kv_quant=True)
+    out = gen.generate(["12+34=46 12+34="], max_new_tokens=8, temperature=0.0)
+    assert len(out) == 1 and isinstance(out[0], str)
