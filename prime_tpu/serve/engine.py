@@ -182,6 +182,7 @@ class ContinuousBatchingEngine:
         mesh: Any = None,
         cache_spec: Any = None,
         attn_impl: str = "auto",
+        kv_quant: bool = False,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -201,7 +202,10 @@ class ContinuousBatchingEngine:
         # must take the XLA decode path (same rule as evals.runner.JaxGenerator)
         if mesh is not None and getattr(mesh, "size", 1) > 1 and attn_impl == "auto":
             attn_impl = "xla"
+        # int8 caches need no impl override here: decode_attention's "auto"
+        # dispatch already routes quantized caches to the XLA path
         self.attn_impl = attn_impl
+        self.kv_quant = kv_quant
 
         self._dtype = jax.tree_util.tree_leaves(params)[0].dtype
         self._requests: dict[int, EngineRequest] = {}  # slot -> request
@@ -217,13 +221,13 @@ class ContinuousBatchingEngine:
         self._chunk_fn: Any = None
         self._finalize_fn: Any = None
         self._decode_fn: Any = None
-        # prompt-prefix KV reuse: newest-last list of (ids, row_k, row_v) —
+        # prompt-prefix KV reuse: newest-last list of (ids, row KVCache) —
         # an admission whose prompt shares a prefix with a recent one copies
         # that staged KV row and only prefills the suffix
         self.prefill_chunk = max(MIN_BUCKET, prefill_chunk)
         self.prefix_cache_size = prefix_cache_size
         self.min_prefix = max(min_prefix, MIN_BUCKET)
-        self._prefix_cache: list[tuple[list[int], Any, Any]] = []
+        self._prefix_cache: list[tuple[list[int], Any]] = []
         self.prefix_hits = 0  # observability: admissions seeded from the cache
 
     def _init_device_state(self) -> None:
@@ -235,7 +239,10 @@ class ContinuousBatchingEngine:
 
         from prime_tpu.models.llama import init_cache
 
-        cache = init_cache(self.config, self.max_slots, self.capacity, dtype=self._dtype)
+        cache = init_cache(
+            self.config, self.max_slots, self.capacity, dtype=self._dtype,
+            quantized=self.kv_quant,
+        )
         if self.cache_spec is not None and self.mesh is not None:
             from jax.sharding import NamedSharding
 
@@ -243,9 +250,13 @@ class ContinuousBatchingEngine:
             cache = cache._replace(
                 k=jax.device_put(cache.k, sharding), v=jax.device_put(cache.v, sharding)
             )
-        self._k = cache.k
-        self._v = cache.v
-        self._lengths = jnp.zeros((self.max_slots,), dtype=jnp.int32)
+            if cache.quantized:
+                cache = cache._replace(
+                    k_scale=jax.device_put(cache.k_scale, sharding),
+                    v_scale=jax.device_put(cache.v_scale, sharding),
+                )
+        # lengths ride inside the cache pytree (one donated unit per dispatch)
+        self._cache = cache
         self._last = jnp.zeros((self.max_slots,), dtype=jnp.int32)
         self._temps = jnp.zeros((self.max_slots,), dtype=jnp.float32)
         self._top_ps = jnp.ones((self.max_slots,), dtype=jnp.float32)
@@ -265,23 +276,22 @@ class ContinuousBatchingEngine:
 
     def _make_chunk_prefill(self):
         import jax
-        import jax.numpy as jnp
 
-        from prime_tpu.models.llama import KVCache, forward
+        from prime_tpu.models.llama import forward
 
         config, attn_impl = self.config, self.attn_impl
 
-        def chunk_prefill(params, row_k, row_v, tokens, offset):
+        def chunk_prefill(params, row, tokens, offset):
             # write-at-offset + attend-over-row (models.llama chunked prefill):
-            # the staging row is donated, so chunks update it in place
-            row = KVCache(k=row_k, v=row_v, lengths=jnp.zeros((1,), jnp.int32))
+            # the staging row pytree is donated, so chunks update it in place
+            # (scale leaves ride along on int8 caches)
             logits, row = forward(
                 params, tokens, config, cache=row, decode=False,
                 attn_impl=attn_impl, prefill_offset=offset,
             )
-            return row.k, row.v, logits
+            return row, logits
 
-        return jax.jit(chunk_prefill, donate_argnums=(1, 2))
+        return jax.jit(chunk_prefill, donate_argnums=(1,))
 
     def _make_finalize(self):
         import jax
@@ -290,19 +300,29 @@ class ContinuousBatchingEngine:
         cache_spec = self.cache_spec
 
         def finalize(
-            k, v, lengths, last, temps, top_ps,
-            row_k, row_v, chunk_logits, last_idx, length, slot, temp, top_p, rng,
+            cache, last, temps, top_ps,
+            row, chunk_logits, last_idx, length, slot, temp, top_p, rng,
         ):
             # splice the staged row into the engine cache at ``slot`` (the
             # engine cache is donated; the row is NOT — it may live on in the
             # prefix cache) and sample the first token from the prompt's last
             # real position within the final chunk
             zero = jnp.zeros((), jnp.int32)
-            new_k = jax.lax.dynamic_update_slice(k, row_k, (zero, slot, zero, zero, zero))
-            new_v = jax.lax.dynamic_update_slice(v, row_v, (zero, slot, zero, zero, zero))
-            if cache_spec is not None:
-                new_k = jax.lax.with_sharding_constraint(new_k, cache_spec)
-                new_v = jax.lax.with_sharding_constraint(new_v, cache_spec)
+
+            def splice(cache_leaf, row_leaf):
+                out = jax.lax.dynamic_update_slice(
+                    cache_leaf, row_leaf, (zero, slot, zero, zero, zero)
+                )
+                if cache_spec is not None:
+                    out = jax.lax.with_sharding_constraint(out, cache_spec)
+                return out
+
+            new_cache = cache._replace(k=splice(cache.k, row.k), v=splice(cache.v, row.v))
+            if cache.quantized:
+                new_cache = new_cache._replace(
+                    k_scale=splice(cache.k_scale, row.k_scale),
+                    v_scale=splice(cache.v_scale, row.v_scale),
+                )
             last_logits = jax.lax.dynamic_slice(
                 chunk_logits, (zero, last_idx, zero), (1, 1, chunk_logits.shape[-1])
             )[0, 0]
@@ -310,31 +330,30 @@ class ContinuousBatchingEngine:
             # the first sampled token's KV is not in the cache yet: the next
             # decode step writes it at position ``length`` (put() scatters at
             # cache_lengths), so the slot length stays the prompt length here
-            new_lengths = lengths.at[slot].set(length)
+            new_cache = new_cache._replace(lengths=cache.lengths.at[slot].set(length))
             new_last = last.at[slot].set(first)
             new_temps = temps.at[slot].set(temp)
             new_top_ps = top_ps.at[slot].set(top_p)
-            return new_k, new_v, new_lengths, new_last, new_temps, new_top_ps, first
+            return new_cache, new_last, new_temps, new_top_ps, first
 
-        return jax.jit(finalize, donate_argnums=(0, 1, 2, 3, 4, 5))
+        return jax.jit(finalize, donate_argnums=(0, 1, 2, 3))
 
     def _make_decode(self):
         import jax
         import jax.numpy as jnp
 
-        from prime_tpu.models.llama import KVCache, forward
+        from prime_tpu.models.llama import forward
 
         config, attn_impl, chunk = self.config, self.attn_impl, self.chunk
         cache_spec = self.cache_spec
 
-        def decode(params, k, v, lengths, last, temps, top_ps, active, rng):
+        def decode(params, cache, last, temps, top_ps, active, rng):
             # neutralize retired slots' stale sampling params: a finished
             # nucleus request must not keep the vocab-sort branch live for
             # later greedy-only traffic (outputs of inactive slots are
             # discarded host-side, so forcing them greedy is free)
             temps = jnp.where(active, temps, 0.0)
             top_ps = jnp.where(active, top_ps, 1.0)
-            cache = KVCache(k=k, v=v, lengths=lengths)
 
             def step(carry, _):
                 cache, tok, rng = carry
@@ -352,6 +371,15 @@ class ContinuousBatchingEngine:
                         k=jax.lax.with_sharding_constraint(new_cache.k, cache_spec),
                         v=jax.lax.with_sharding_constraint(new_cache.v, cache_spec),
                     )
+                    if new_cache.quantized:
+                        new_cache = new_cache._replace(
+                            k_scale=jax.lax.with_sharding_constraint(
+                                new_cache.k_scale, cache_spec
+                            ),
+                            v_scale=jax.lax.with_sharding_constraint(
+                                new_cache.v_scale, cache_spec
+                            ),
+                        )
                 # inactive slots must not advance: their next admission
                 # prefills the slot from position 0 again
                 new_cache = new_cache._replace(
@@ -364,9 +392,9 @@ class ContinuousBatchingEngine:
             (cache, tok, rng), toks = jax.lax.scan(
                 step, (cache, last, rng), None, length=chunk
             )
-            return cache.k, cache.v, cache.lengths, tok, toks.T  # toks (S, T)
+            return cache, tok, toks.T  # toks (S, T)
 
-        return jax.jit(decode, donate_argnums=(1, 2, 3, 4))
+        return jax.jit(decode, donate_argnums=(1, 2))
 
     # ---- public API ----
 
@@ -523,7 +551,7 @@ class ContinuousBatchingEngine:
             self._finalize_fn = self._make_finalize()
         ids = req.prompt_ids
         row_cb = row_capacity_for(len(ids), self.prefill_chunk, self.capacity)
-        start, row_k, row_v = self._prefix_seed(ids, row_cb)
+        start, row = self._prefix_seed(ids, row_cb)
         plan = chunk_plan(start, len(ids), self.prefill_chunk, row_cb)
         logits = None
         last_idx = 0
@@ -533,17 +561,14 @@ class ContinuousBatchingEngine:
                 chunk_ids = ids[off : off + size]
                 chunk_ids += [self.pad_id] * (size - len(chunk_ids))
                 tokens = jnp.asarray([chunk_ids], dtype=jnp.int32)
-                row_k, row_v, logits = self._chunk_fn(
-                    self.params, row_k, row_v, tokens,
-                    jnp.asarray(off, dtype=jnp.int32),
+                row, logits = self._chunk_fn(
+                    self.params, row, tokens, jnp.asarray(off, dtype=jnp.int32),
                 )
                 last_idx = len(ids) - 1 - off  # prompt's last position, chunk-relative
             (
-                self._k, self._v, self._lengths, self._last,
-                self._temps, self._top_ps, first,
+                self._cache, self._last, self._temps, self._top_ps, first,
             ) = self._finalize_fn(
-                self._k, self._v, self._lengths, self._last,
-                self._temps, self._top_ps, row_k, row_v, logits,
+                self._cache, self._last, self._temps, self._top_ps, row, logits,
                 jnp.asarray(last_idx, dtype=jnp.int32),
                 jnp.asarray(len(ids), dtype=jnp.int32),
                 jnp.asarray(slot, dtype=jnp.int32),
@@ -551,7 +576,7 @@ class ContinuousBatchingEngine:
                 jnp.asarray(req.top_p, dtype=jnp.float32),
                 rng,
             )
-        self._store_prefix(ids, row_k, row_v)
+        self._store_prefix(ids, row)
         req.slot = slot
         self._active[slot] = True
         self._requests[slot] = req
@@ -561,49 +586,57 @@ class ContinuousBatchingEngine:
 
     def _prefix_seed(self, ids: list[int], row_cb: int):
         """Longest-prefix match against recently staged rows: returns
-        (start, row_k, row_v) where [0, start) is already computed in the row.
+        (start, row) where [0, start) is already computed in the row pytree.
         start is aligned down to MIN_BUCKET (chunk_plan's invariant) and
         capped at len(ids)-1 so at least one real token is always prefilled
         (the finalize step needs the last prompt position's logits)."""
-        import jax.numpy as jnp
-
         from prime_tpu.models.llama import init_cache
 
         best_len, best = 0, None
-        for entry_ids, ek, ev in self._prefix_cache:
+        for entry_ids, entry_row in self._prefix_cache:
             common = _common_prefix_len(ids, entry_ids)
             if common > best_len:
-                best_len, best = common, (ek, ev)
+                best_len, best = common, entry_row
         best_len = min(best_len, len(ids) - 1)
         best_len = (best_len // MIN_BUCKET) * MIN_BUCKET
         if best is None or best_len < self.min_prefix:
-            row = init_cache(self.config, 1, row_cb, dtype=self._dtype)
-            return 0, row.k, row.v
+            return 0, init_cache(
+                self.config, 1, row_cb, dtype=self._dtype, quantized=self.kv_quant
+            )
         self.prefix_hits += 1
-        self._prefix_cache = [e for e in self._prefix_cache if e[1] is not best[0]] + [
-            e for e in self._prefix_cache if e[1] is best[0]
+        self._prefix_cache = [e for e in self._prefix_cache if e[1] is not best] + [
+            e for e in self._prefix_cache if e[1] is best
         ]  # LRU touch
-        return best_len, *self._resize_row(best[0], best[1], row_cb)
+        return best_len, self._resize_row(best, row_cb)
 
-    def _resize_row(self, row_k, row_v, target_cb: int):
-        """Fresh row buffers at ``target_cb`` seeded from a cached row (the
-        cached entry stays valid — chunk_prefill donates its row inputs)."""
+    def _resize_row(self, row, target_cb: int):
+        """Fresh row buffers at ``target_cb`` seeded from a cached row pytree
+        (the cached entry stays valid — chunk_prefill donates its row
+        inputs). Every capacity-axis leaf (k/v and int8 scales) resizes the
+        same way."""
+        import jax
         import jax.numpy as jnp
 
-        src_cb = row_k.shape[-1]
-        if src_cb == target_cb:
-            return jnp.copy(row_k), jnp.copy(row_v)
-        if src_cb > target_cb:
-            return jnp.copy(row_k[..., :target_cb]), jnp.copy(row_v[..., :target_cb])
-        pad = [(0, 0)] * (row_k.ndim - 1) + [(0, target_cb - src_cb)]
-        return jnp.pad(row_k, pad), jnp.pad(row_v, pad)
+        src_cb = row.capacity
 
-    def _store_prefix(self, ids: list[int], row_k, row_v) -> None:
+        def resize(leaf):
+            if leaf.ndim < 2 or leaf.shape[-1] != src_cb:
+                return jnp.copy(leaf)  # lengths: capacity-free
+            if src_cb == target_cb:
+                return jnp.copy(leaf)
+            if src_cb > target_cb:
+                return jnp.copy(leaf[..., :target_cb])
+            pad = [(0, 0)] * (leaf.ndim - 1) + [(0, target_cb - src_cb)]
+            return jnp.pad(leaf, pad)
+
+        return jax.tree_util.tree_map(resize, row)
+
+    def _store_prefix(self, ids: list[int], row) -> None:
         if self.prefix_cache_size <= 0 or len(ids) < self.min_prefix:
             return
         # drop an entry for the identical prompt (the new row supersedes it)
         self._prefix_cache = [e for e in self._prefix_cache if e[0] != ids]
-        self._prefix_cache.append((list(ids), row_k, row_v))
+        self._prefix_cache.append((list(ids), row))
         while len(self._prefix_cache) > self.prefix_cache_size:
             self._prefix_cache.pop(0)
 
@@ -617,8 +650,8 @@ class ContinuousBatchingEngine:
         self._rng, rng = jax.random.split(self._rng)
         active = jnp.asarray(self._active)
         with self._mesh_ctx():
-            self._k, self._v, self._lengths, self._last, toks = self._decode_fn(
-                self.params, self._k, self._v, self._lengths, self._last,
+            self._cache, self._last, toks = self._decode_fn(
+                self.params, self._cache, self._last,
                 self._temps, self._top_ps, active, rng,
             )
         toks_host = np.asarray(toks)  # (S, T)

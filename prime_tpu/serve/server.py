@@ -332,11 +332,6 @@ def serve_model(
     whole-turn generation at a time behind a lock."""
     from prime_tpu.evals.runner import JaxGenerator
 
-    if continuous and kv_quant:
-        raise ValueError(
-            "--continuous does not support --kv-quant yet (the engine cache "
-            "is bf16; int8 KV serving uses the one-shot generator)"
-        )
     server = InferenceServer(model, host=host, port=port)  # fail fast on EADDRINUSE
     try:
         generator = JaxGenerator(
@@ -367,6 +362,7 @@ def serve_model(
                 chunk=chunk,
                 mesh=generator.mesh,
                 cache_spec=cache_spec,
+                kv_quant=kv_quant,
             )
             engine.start()
             server.generator = EngineBackend(engine, generator.tokenizer)
