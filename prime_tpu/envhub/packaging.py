@@ -8,6 +8,7 @@ hub version, reference :365-409) + optional wheel build for pip installs.
 from __future__ import annotations
 
 import fnmatch
+import gzip
 import hashlib
 import io
 import subprocess
@@ -124,19 +125,25 @@ def content_hash(env_dir: str | Path) -> str:
 
 
 def build_archive(env_dir: str | Path) -> bytes:
-    """Deterministic tar.gz of the filtered env dir (mtime/uid zeroed)."""
+    """Deterministic tar.gz of the filtered env dir (mtime/uid zeroed).
+
+    The gzip layer is opened explicitly with ``mtime=0``: ``tarfile``'s
+    ``w:gz`` mode stamps the CURRENT time into the gzip header, so two
+    builds of identical content straddling a second boundary would differ
+    byte-for-byte (caught by the packaging-determinism property test)."""
     env_dir = Path(env_dir)
     buffer = io.BytesIO()
-    with tarfile.open(fileobj=buffer, mode="w:gz", compresslevel=6) as tar:
-        for path in iter_env_files(env_dir):
-            rel = path.relative_to(env_dir).as_posix()
-            info = tarfile.TarInfo(name=rel)
-            data = path.read_bytes()
-            info.size = len(data)
-            info.mtime = 0
-            info.uid = info.gid = 0
-            info.uname = info.gname = ""
-            tar.addfile(info, io.BytesIO(data))
+    with gzip.GzipFile(fileobj=buffer, mode="wb", compresslevel=6, mtime=0) as gz:
+        with tarfile.open(fileobj=gz, mode="w") as tar:
+            for path in iter_env_files(env_dir):
+                rel = path.relative_to(env_dir).as_posix()
+                info = tarfile.TarInfo(name=rel)
+                data = path.read_bytes()
+                info.size = len(data)
+                info.mtime = 0
+                info.uid = info.gid = 0
+                info.uname = info.gname = ""
+                tar.addfile(info, io.BytesIO(data))
     return buffer.getvalue()
 
 

@@ -57,6 +57,22 @@ def test_content_hash_is_order_independent_and_exclusion_stable(tmp_path_factory
     assert build_archive(base) == build_archive(base)  # byte-identical archives
 
 
+def test_build_archive_is_time_independent(tmp_path, monkeypatch):
+    """Regression: tarfile's w:gz stamps time.time() into the gzip header, so
+    builds straddling a second boundary differed byte-for-byte. The archive
+    must be identical no matter when it is built."""
+    import time
+
+    from prime_tpu.envhub.packaging import build_archive
+
+    (tmp_path / "a.txt").write_bytes(b"stable")
+    real_time = time.time
+    first = build_archive(tmp_path)
+    monkeypatch.setattr(time, "time", lambda: real_time() + 3600.0)
+    second = build_archive(tmp_path)
+    assert first == second
+
+
 # -- TUI key decoding ---------------------------------------------------------
 
 
