@@ -272,33 +272,48 @@ def main() -> None:
         print(f"# bench: eval section failed: {e}", flush=True)
 
     # ---- serve: continuous-batching engine under concurrent load ------------
-    try:
+    n_req, req_new = 16, 64
+    serve_prompts = [
+        [1] + [(7 * (i + j)) % 1000 + 3 for j in range(96)] for i in range(n_req)
+    ]
+
+    def run_serve(kv_quant: bool) -> float:
         from prime_tpu.serve.engine import ContinuousBatchingEngine
 
-        n_req, req_new = 16, 64
         engine = ContinuousBatchingEngine(
-            params, config, pad_id=0, max_slots=8, capacity=1024, chunk=8
+            params, config, pad_id=0, max_slots=8, capacity=1024, chunk=8,
+            kv_quant=kv_quant,
         )
-        prompt_ids = [
-            [1] + [(7 * (i + j)) % 1000 + 3 for j in range(96)] for i in range(n_req)
-        ]
-        # warmup: compile prefill/decode/finalize for the buckets in play
-        warm = engine.submit(prompt_ids[0], max_new_tokens=req_new)
-        while not warm.done:
-            engine.tick()
-        t0 = time.perf_counter()
-        reqs = [engine.submit(ids, max_new_tokens=req_new) for ids in prompt_ids]
-        while not all(r.done for r in reqs):
-            engine.tick()
-        serve_s = time.perf_counter() - t0
-        total_tokens = sum(len(r.all_tokens(timeout=1)) for r in reqs)
-        record["serve_tok_s"] = round(total_tokens / serve_s, 1)
+        try:
+            # warmup: compile prefill/decode/finalize for the buckets in play
+            warm = engine.submit(serve_prompts[0], max_new_tokens=req_new)
+            while not warm.done:
+                engine.tick()
+            t0 = time.perf_counter()
+            reqs = [engine.submit(ids, max_new_tokens=req_new) for ids in serve_prompts]
+            while not all(r.done for r in reqs):
+                engine.tick()
+            elapsed = time.perf_counter() - t0
+            total = sum(len(r.all_tokens(timeout=1)) for r in reqs)
+            return total / elapsed
+        finally:
+            del engine
+
+    # separate guards: an int8 failure must not mark the bf16 number failed
+    try:
+        record["serve_tok_s"] = round(run_serve(kv_quant=False), 1)
         record["serve_requests"] = n_req
         print(f"# bench: serve {record['serve_tok_s']} tok/s ({n_req} reqs)", flush=True)
-        del engine
     except Exception as e:  # noqa: BLE001
         record["serve_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: serve section failed: {e}", flush=True)
+    try:
+        # int8-cache engine: same load, half the KV HBM traffic per step
+        record["serve_int8_tok_s"] = round(run_serve(kv_quant=True), 1)
+        print(f"# bench: serve int8 {record['serve_int8_tok_s']} tok/s", flush=True)
+    except Exception as e:  # noqa: BLE001
+        record["serve_int8_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: serve int8 section failed: {e}", flush=True)
 
     # ---- quant: int8 weights / int8 KV --------------------------------------
     try:

@@ -34,6 +34,46 @@ def _wrap(text: str, width: int = 76) -> list[str]:
     return lines
 
 
+def _content_text(content: Any) -> str:
+    """Chat-message content → text. Handles the OpenAI part-list shape
+    ([{"type": "text", "text": ...}, ...]) alongside plain strings."""
+    if isinstance(content, list):
+        parts = []
+        for part in content:
+            if isinstance(part, dict):
+                parts.append(str(part.get("text", part.get("content", ""))))
+            else:
+                parts.append(str(part))
+        return "\n".join(p for p in parts if p)
+    return str(content)
+
+
+def sample_sections(sample: dict[str, Any]) -> list[tuple[str, str]]:
+    """(label, text) sections for one eval sample. Chat rollouts (a
+    ``messages`` list — multi-turn envs, hub samples) render one section per
+    role turn; flat rows render PROMPT/COMPLETION/ANSWER (reference
+    eval_render.py rollout-history role)."""
+    sections: list[tuple[str, str]] = []
+    messages = sample.get("messages")
+    if isinstance(messages, list) and messages:
+        for message in messages:
+            if isinstance(message, dict):
+                role = str(message.get("role", "?")).upper()
+                sections.append((role, _content_text(message.get("content", ""))))
+            else:
+                sections.append(("?", str(message)))
+        # completion/answer still shown unless the completion IS the last turn
+        completion = str(sample.get("completion", ""))
+        if completion and (not sections or completion != sections[-1][1]):
+            sections.append(("COMPLETION", completion))
+        if sample.get("answer") not in (None, ""):
+            sections.append(("ANSWER", str(sample["answer"])))
+        return sections
+    for label, key in (("PROMPT", "prompt"), ("COMPLETION", "completion"), ("ANSWER", "answer")):
+        sections.append((label, str(sample.get(key, ""))))
+    return sections
+
+
 class DetailScreen:
     """Base: key routing shared by every detail screen."""
 
@@ -123,7 +163,7 @@ class EvalSampleBrowser(DetailScreen):
         order = vis[start + 1 :] + vis[: start + 1]  # wrap, current last
         for i in order:
             s = self.samples[i]
-            hay = f"{s.get('prompt', '')} {s.get('completion', '')} {s.get('answer', '')}"
+            hay = " ".join(text for _, text in sample_sections(s))
             if needle in hay.lower():
                 self.idx = i
                 self.scroll = 0
@@ -199,9 +239,8 @@ class EvalSampleBrowser(DetailScreen):
         )
 
         body_lines: list[tuple[str, str]] = []  # (style, line)
-        for label, key in (("PROMPT", "prompt"), ("COMPLETION", "completion"), ("ANSWER", "answer")):
+        for label, content in sample_sections(sample):
             body_lines.append(("bold cyan", f"── {label} " + "─" * 40))
-            content = str(sample.get(key, ""))
             if self.rendered:
                 from prime_tpu.lab.tui.markdown import markdown_lines
 

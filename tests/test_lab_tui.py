@@ -558,6 +558,43 @@ def test_sample_browser_markdown_toggle(app, tmp_path):
     assert r"$\frac{1}{2}$" in render_text(app)
 
 
+def test_sample_browser_chat_messages_sections(app, tmp_path):
+    """Multi-turn rollouts (a `messages` list) render one section per role
+    turn, including part-list content; search spans the turns."""
+    run_dir = _local_run(tmp_path)
+    with open(run_dir / "results.jsonl", "w") as f:
+        f.write(
+            json.dumps(
+                {
+                    "messages": [
+                        {"role": "system", "content": "be terse"},
+                        {"role": "user", "content": [{"type": "text", "text": "what is 2+2?"}]},
+                        {"role": "assistant", "content": "4"},
+                    ],
+                    "answer": "4",
+                    "reward": 1.0,
+                    "correct": True,
+                }
+            )
+            + "\n"
+        )
+        f.write(json.dumps({"prompt": "plain row", "completion": "x", "reward": 0.0, "correct": False}) + "\n")
+    app.tick()
+    app.on_key("1")
+    app.on_key("enter")      # overview
+    app.on_key("enter")      # browser
+    text = render_text(app)
+    assert "SYSTEM" in text and "USER" in text and "ASSISTANT" in text
+    assert "what is 2+2?" in text            # part-list content flattened
+    assert "PROMPT" not in text              # chat rows don't show the flat labels
+    # search reaches message turns and jumps across row shapes
+    for ch in "/plain":
+        app.on_key(ch)
+    app.on_key("enter")
+    assert "match at sample 2/2" in app.status
+    assert "plain row" in render_text(app) and "PROMPT" in render_text(app)
+
+
 def test_training_detail_tabs_and_reload(app, tmp_path):
     run_dir = tmp_path / "outputs" / "train" / "run1"
     run_dir.mkdir(parents=True)
