@@ -51,6 +51,10 @@ class ModelConfig:
     # linear RoPE position scaling on the global-layer table (Gemma3 4b+
     # long-context stretch: factor 8)
     rope_scale: float = 1.0
+    # Llama 3.1+ frequency-dependent rope scaling: (factor, low_freq_factor,
+    # high_freq_factor, original_max_position). Mutually exclusive with
+    # rope_scale; tuple-typed so the config stays hashable for jit
+    rope_llama3: tuple[float, float, float, float] | None = None
     # mixture-of-experts (0 experts = dense MLP; Mixtral-style top-k routing)
     n_experts: int = 0
     experts_per_token: int = 2
@@ -109,6 +113,8 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         n_kv_heads=8,
         d_ff=28672,
     ),
+    # Llama 3.2: frequency-dependent llama3 rope scaling (factor 32 over the
+    # 8k pretraining window) — matches the released checkpoints' config.json
     "llama3.2-1b": ModelConfig(
         name="llama3.2-1b",
         vocab_size=128256,
@@ -118,6 +124,7 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         n_kv_heads=8,
         d_ff=8192,
         tie_embeddings=True,
+        rope_llama3=(32.0, 1.0, 4.0, 8192.0),
     ),
     "llama3.2-3b": ModelConfig(
         name="llama3.2-3b",
@@ -128,6 +135,7 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         n_kv_heads=8,
         d_ff=8192,
         tie_embeddings=True,
+        rope_llama3=(32.0, 1.0, 4.0, 8192.0),
     ),
     # Qwen2.5 family: q/k/v biases, 1M rope theta, small sizes tie embeddings
     "qwen2.5-0.5b": ModelConfig(

@@ -122,11 +122,22 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         rope_factor = float(rope_scaling.get("factor", 1.0) or 1.0)
     else:
         rope_type, rope_factor = "linear", 1.0
+    rope_llama3 = None
     if rope_type == "default":  # HF's explicit no-scaling marker
+        rope_factor = 1.0
+    elif rope_type == "llama3" and rope_scaling:
+        # Llama 3.1/3.2 frequency-dependent scaling — carried as its own
+        # tuple; the linear factor must not ALSO divide the frequencies
+        rope_llama3 = (
+            rope_factor,
+            float(rope_scaling.get("low_freq_factor", 1.0) or 1.0),
+            float(rope_scaling.get("high_freq_factor", 4.0) or 4.0),
+            float(rope_scaling.get("original_max_position_embeddings", 8192) or 8192),
+        )
         rope_factor = 1.0
     elif rope_scaling and rope_type != "linear":
         raise ValueError(
-            f"Unsupported rope_scaling type {rope_type!r} (linear only); "
+            f"Unsupported rope_scaling type {rope_type!r} (linear/llama3 only); "
             "loading would silently distort long-range attention"
         )
     if gemma3:
@@ -169,6 +180,7 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
             else None
         ),
         rope_scale=rope_factor,
+        rope_llama3=rope_llama3,
         name=name,
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,

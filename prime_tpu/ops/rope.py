@@ -10,16 +10,47 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def llama3_inv_freq(
+    inv_freq: jnp.ndarray,
+    factor: float,
+    low_freq_factor: float,
+    high_freq_factor: float,
+    original_max_position: float,
+) -> jnp.ndarray:
+    """Llama 3.1+ frequency-dependent rope scaling (HF ``rope_type: llama3``):
+    long-wavelength components are slowed by ``factor``, short wavelengths
+    stay unscaled, and the band in between interpolates smoothly."""
+    wavelen = 2.0 * jnp.pi / inv_freq
+    low_wavelen = original_max_position / low_freq_factor
+    high_wavelen = original_max_position / high_freq_factor
+    scaled = jnp.where(wavelen > low_wavelen, inv_freq / factor, inv_freq)
+    smooth = (original_max_position / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor
+    )
+    smoothed = (1.0 - smooth) / factor * inv_freq + smooth * inv_freq
+    medium = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+    return jnp.where(medium, smoothed, scaled)
+
+
 def rope_frequencies(
-    head_dim: int, max_positions: int, theta: float = 500000.0, scale: float = 1.0
+    head_dim: int,
+    max_positions: int,
+    theta: float = 500000.0,
+    scale: float = 1.0,
+    llama3: tuple[float, float, float, float] | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Return (cos, sin) tables of shape (max_positions, head_dim // 2), float32.
 
     ``scale`` > 1 applies linear position scaling (positions stretched by the
     factor — HF ``rope_scaling {"rope_type": "linear"}``, e.g. Gemma3 4b+).
+    ``llama3`` = (factor, low_freq_factor, high_freq_factor,
+    original_max_position) applies Llama 3.1+ frequency-dependent scaling
+    instead (mutually exclusive with ``scale``).
     """
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-    if scale != 1.0:
+    if llama3 is not None:
+        inv_freq = llama3_inv_freq(inv_freq, *llama3)
+    elif scale != 1.0:
         inv_freq = inv_freq / scale
     positions = jnp.arange(max_positions, dtype=jnp.float32)
     angles = jnp.outer(positions, inv_freq)  # (P, D/2)

@@ -107,7 +107,8 @@ def pipeline_forward(
     positions = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None, :], (micro, seq))
     rope_tables = rope_frequencies(
         config.head_dim, max(seq, config.max_seq_len), config.rope_theta,
-        scale=config.rope_scale,  # must match forward()'s rope math exactly
+        # must match forward()'s rope math exactly
+        scale=config.rope_scale, llama3=config.rope_llama3,
     )
 
     layer_specs = pipeline_param_specs(config)["layers"]

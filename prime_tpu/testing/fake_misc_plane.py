@@ -6,6 +6,7 @@ registry, tunnels, feedback.
 from __future__ import annotations
 
 import base64
+import threading
 import uuid
 from typing import Any
 
@@ -23,6 +24,11 @@ class FakeMiscPlane:
         self.account_secrets: dict[str, str] = {}
         self.adapters: dict[str, dict[str, Any]] = {}
         self.images: dict[str, dict[str, Any]] = {}
+        # name uniqueness must be atomic like a real backend's constraint:
+        # bulk-push hits this route from a thread pool, and an unlocked
+        # check-then-insert let two same-name builds both succeed (flaky
+        # test_cli_bulk_push_partial_failure under full-suite load)
+        self.images_lock = threading.Lock()
         self.image_build_429s = 0  # fault injection: next N builds get 429
         self.tunnels: dict[str, dict[str, Any]] = {}
         self.feedback: list[dict[str, Any]] = []
@@ -196,13 +202,14 @@ class FakeMiscPlane:
 
         @route("POST", r"/images/build")
         def build_image(request: httpx.Request) -> httpx.Response:
-            if plane.image_build_429s > 0:
-                plane.image_build_429s -= 1
-                return _json_response(429, {"detail": "rate limited"})
             body = plane.fake._body(request)
-            if body.get("name") in {i["name"] for i in plane.images.values()}:
-                return _json_response(409, {"detail": "image name already exists"})
-            return _json_response(200, _new_image(body, "container"))
+            with plane.images_lock:  # atomic fault injection AND uniqueness
+                if plane.image_build_429s > 0:
+                    plane.image_build_429s -= 1
+                    return _json_response(429, {"detail": "rate limited"})
+                if body.get("name") in {i["name"] for i in plane.images.values()}:
+                    return _json_response(409, {"detail": "image name already exists"})
+                return _json_response(200, _new_image(body, "container"))
 
         @route("POST", r"/images/build-vm")
         def build_vm_image(request: httpx.Request) -> httpx.Response:

@@ -660,6 +660,46 @@ def test_qwen3moe_pared_config_tracks_hf_defaults():
     assert mixtral_cfg.norm_topk is True and mixtral_cfg.experts_per_token == 2
 
 
+def test_llama3_rope_scaling_logits_match_transformers():
+    """Llama 3.1/3.2 checkpoints carry rope_scaling {"rope_type": "llama3"}
+    (frequency-dependent smoothing, NOT linear) — the loader must reproduce
+    transformers' scaled frequencies exactly, at positions long enough that
+    the low/medium/high frequency bands all genuinely differ."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rope_theta=10000.0,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+        attn_implementation="eager",
+    )
+    torch.manual_seed(19)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    config = config_from_hf(model.config, name="tiny-llama31")
+    assert config.rope_llama3 == (8.0, 1.0, 4.0, 64.0)
+    assert config.rope_scale == 1.0
+    state = {k: v.float().numpy() for k, v in model.state_dict().items()}
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+
+    # 100 tokens > original_max_position 64: the scaled bands are exercised
+    tokens = np.arange(3, 103, dtype=np.int32)[None, :] % 256
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    our_logits, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=5e-4, atol=5e-4)
+
+
 def test_rope_scaling_default_accepted_and_long_context_capped():
     """HF's rope_scaling {"rope_type": "default"} means unscaled — it must
     load; non-linear types must not. max_position_embeddings is capped at 32k
