@@ -305,6 +305,34 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         query_scale=144,
         sliding_window=4096,
     ),
+    # Phi-3 family: llama math behind fused qkv/gate_up projections (split at
+    # load); phi-4 shares the phi3 model_type with a 100k vocab
+    "phi3-mini": ModelConfig(
+        name="phi3-mini",
+        vocab_size=32064,
+        d_model=3072,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=32,
+        d_ff=8192,
+        max_seq_len=4096,
+        rope_theta=10000.0,
+        rms_eps=1e-5,
+        sliding_window=2047,        # every layer slides (released 4k config)
+        sliding_pattern="uniform",
+    ),
+    "phi4-14b": ModelConfig(
+        name="phi4-14b",
+        vocab_size=100352,
+        d_model=5120,
+        n_layers=40,
+        n_heads=40,
+        n_kv_heads=10,
+        d_ff=17920,
+        max_seq_len=16384,
+        rope_theta=250000.0,
+        rms_eps=1e-5,
+    ),
     # Qwen3-MoE: qk-norm attention over 128 fine-grained experts, top-8,
     # raw-softmax gates renormalized per norm_topk_prob (True on the released
     # 30B-A3B), expert width 768 (moe_intermediate_size)

@@ -25,7 +25,7 @@ from prime_tpu.models.config import ModelConfig
 
 # model_type values whose math this loader reproduces exactly. Families that
 # SHARE Llama state-dict key names but need different math — gemma v1
-# ((1+w) norms + sqrt(d) embed scale + GeGLU), phi3 (fused qkv), etc. — must
+# ((1+w) norms + sqrt(d) embed scale + GeGLU), deepseek (MLA), etc. — must
 # fail loudly here rather than load and silently produce garbage logits.
 SUPPORTED_MODEL_TYPES = frozenset(
     {
@@ -38,6 +38,7 @@ SUPPORTED_MODEL_TYPES = frozenset(
         "gemma2",
         "gemma3_text",
         "gemma3",
+        "phi3",
     }
 )
 
@@ -95,12 +96,19 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         raise ValueError(
             f"Unsupported model_type {model_type!r}: this loader reproduces the math of "
             f"{sorted(SUPPORTED_MODEL_TYPES)} only. Checkpoint families that share Llama "
-            "state-dict keys but diverge in math (gemma, phi3, ...) would load "
+            "state-dict keys but diverge in math (gemma, deepseek, ...) would load "
             "without error and produce wrong logits, so they are rejected."
         )
     # Qwen2 checkpoints carry q/k/v biases unconditionally; Llama-family
     # configs declare them via attention_bias
     attn_bias = bool(getattr(hf_config, "attention_bias", False)) or model_type == "qwen2"
+    if model_type == "phi3":
+        partial_rotary = float(getattr(hf_config, "partial_rotary_factor", 1.0) or 1.0)
+        if partial_rotary != 1.0:
+            raise ValueError(
+                f"phi3 partial_rotary_factor={partial_rotary} is not supported "
+                "(full rotary only); loading would silently rotate the wrong dims"
+            )
     if model_type == "qwen3_moe":
         # the uniform layer scan needs every layer sparse; a mixed
         # dense/sparse schedule would silently run dense layers through the
@@ -170,7 +178,7 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         # than silently mapped to a pattern.
         sliding_window=(
             int(getattr(hf_config, "sliding_window", 0) or 0)
-            if model_type in ("gemma2", "gemma3_text", "mistral")
+            if model_type in ("gemma2", "gemma3_text", "mistral", "phi3")
             else 0
         ),
         sliding_pattern=sliding_pattern,
@@ -269,18 +277,27 @@ def params_from_state_dict(
             mats.append(w.T if transpose else w)
         return jnp.asarray(np.stack(mats), dtype=dtype)
 
+    def present(name: str) -> bool:
+        try:
+            get(name)
+        except KeyError:
+            return False
+        return True
+
+    def stacked_rows(template: str, start: int, stop: int) -> jnp.ndarray:
+        """Row-slice of a fused projection, per layer, transposed to (in, out).
+        Phi3 fuses q/k/v into qkv_proj and gate/up into gate_up_proj — rows
+        are stacked in declaration order, so a static slice recovers each."""
+        mats = []
+        for layer in range(config.n_layers):
+            mats.append(get(template.format(layer))[start:stop].T)
+        return jnp.asarray(np.stack(mats), dtype=dtype)
+
     if config.is_moe:
         # two expert layouts share the same math:
         # - Mixtral: block_sparse_moe.gate (router) + experts.M.{w1,w2,w3}
         #   (w1 = gate_proj, w3 = up_proj, both (F, D); w2 = down_proj (D, F))
         # - Qwen3-MoE: mlp.gate (router) + mlp.experts.M.{gate,up,down}_proj
-        def present(name: str) -> bool:
-            try:
-                get(name)
-            except KeyError:
-                return False
-            return True
-
         if present("layers.0.mlp.experts.0.gate_proj.weight"):
             router_t = "layers.{}.mlp.gate.weight"
             gate_t = "layers.{}.mlp.experts.{}.gate_proj.weight"
@@ -312,6 +329,15 @@ def params_from_state_dict(
             "w_gate": stacked_experts(gate_t),
             "w_up": stacked_experts(up_t),
             "w_down": stacked_experts(down_t),
+        }
+    elif present("layers.0.mlp.gate_up_proj.weight"):
+        # Phi3 fused MLP: gate rows then up rows
+        mlp_weights = {
+            "w_gate": stacked_rows("layers.{}.mlp.gate_up_proj.weight", 0, config.d_ff),
+            "w_up": stacked_rows(
+                "layers.{}.mlp.gate_up_proj.weight", config.d_ff, 2 * config.d_ff
+            ),
+            "w_down": stacked("layers.{}.mlp.down_proj.weight", transpose=True),
         }
     else:
         mlp_weights = {
@@ -354,12 +380,29 @@ def params_from_state_dict(
             "attn_norm": stacked("layers.{}.input_layernorm.weight", transpose=False),
             "mlp_norm": stacked("layers.{}.post_attention_layernorm.weight", transpose=False),
         }
-    params: dict[str, Any] = {
-        "embed": jnp.asarray(get("embed_tokens.weight"), dtype=dtype),
-        "layers": {
+    if present("layers.0.self_attn.qkv_proj.weight"):
+        # Phi3 fused attention: q rows, then k rows, then v rows
+        q_rows = config.n_heads * config.head_dim
+        kv_rows = config.n_kv_heads * config.head_dim
+        attn_weights = {
+            "wq": stacked_rows("layers.{}.self_attn.qkv_proj.weight", 0, q_rows),
+            "wk": stacked_rows(
+                "layers.{}.self_attn.qkv_proj.weight", q_rows, q_rows + kv_rows
+            ),
+            "wv": stacked_rows(
+                "layers.{}.self_attn.qkv_proj.weight", q_rows + kv_rows, q_rows + 2 * kv_rows
+            ),
+        }
+    else:
+        attn_weights = {
             "wq": stacked("layers.{}.self_attn.q_proj.weight", transpose=True),
             "wk": stacked("layers.{}.self_attn.k_proj.weight", transpose=True),
             "wv": stacked("layers.{}.self_attn.v_proj.weight", transpose=True),
+        }
+    params: dict[str, Any] = {
+        "embed": jnp.asarray(get("embed_tokens.weight"), dtype=dtype),
+        "layers": {
+            **attn_weights,
             "wo": stacked("layers.{}.self_attn.o_proj.weight", transpose=True),
             **norm_keys,
             **attn_biases,

@@ -660,6 +660,85 @@ def test_qwen3moe_pared_config_tracks_hf_defaults():
     assert mixtral_cfg.norm_topk is True and mixtral_cfg.experts_per_token == 2
 
 
+# -- Phi-3 family --------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def phi3_model():
+    cfg = transformers.Phi3Config(
+        vocab_size=32064,          # Phi3Config pins padding_idx at 32000
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(23)
+    model = transformers.Phi3ForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_phi3_fused_projections_logits_match_transformers(phi3_model):
+    """Phi3 fuses q/k/v into qkv_proj and gate/up into gate_up_proj — the
+    loader's row-slice split must reproduce transformers logits exactly."""
+    state = {k: v.float().numpy() for k, v in phi3_model.state_dict().items()}
+    config = config_from_hf(phi3_model.config, name="tiny-phi3")
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+    assert params["layers"]["wq"].shape[-1] == config.n_heads * config.head_dim
+    assert params["layers"]["wk"].shape[-1] == config.n_kv_heads * config.head_dim
+
+    tokens = np.array([[3, 17, 200, 45, 9, 88, 121, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = phi3_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    our_logits, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=5e-4, atol=5e-4)
+
+
+def test_phi3_decode_matches_transformers_generation(phi3_model):
+    import jax
+
+    from prime_tpu.models.sampler import generate
+
+    state = {k: v.float().numpy() for k, v in phi3_model.state_dict().items()}
+    config = config_from_hf(phi3_model.config, name="tiny-phi3")
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+
+    prompt = np.array([[5, 42, 100, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_out = phi3_model.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=8, do_sample=False, eos_token_id=None, pad_token_id=0,
+        ).numpy()[0, 4:]
+    result = generate(
+        params, jnp.asarray(prompt), jnp.array([4]), config,
+        jax.random.PRNGKey(0), max_new_tokens=8, temperature=0.0,
+    )
+    np.testing.assert_array_equal(np.asarray(result.tokens[0]), hf_out)
+
+
+def test_phi3_partial_rotary_rejected():
+    from prime_tpu.models.hf_loader import config_from_hf
+
+    class Cfg:
+        model_type = "phi3"
+        vocab_size = 128
+        hidden_size = 64
+        num_hidden_layers = 2
+        num_attention_heads = 4
+        num_key_value_heads = 2
+        intermediate_size = 128
+        partial_rotary_factor = 0.75
+
+    with pytest.raises(ValueError, match="partial_rotary"):
+        config_from_hf(Cfg())
+
+
 def test_llama3_rope_scaling_logits_match_transformers():
     """Llama 3.1/3.2 checkpoints carry rope_scaling {"rope_type": "llama3"}
     (frequency-dependent smoothing, NOT linear) — the loader must reproduce
@@ -743,11 +822,11 @@ def test_config_from_hf_rejects_unsupported_model_type():
         num_attention_heads = 4
         intermediate_size = 256
 
-    for bad in ("gemma", "phi3", "falcon"):
+    for bad in ("gemma", "falcon", "deepseek_v3"):
         Cfg.model_type = bad
         with pytest.raises(ValueError, match="Unsupported model_type"):
             config_from_hf(Cfg())
-    for ok in ("llama", "mistral", "qwen2", "qwen3", "gemma2", "gemma3_text", ""):
+    for ok in ("llama", "mistral", "qwen2", "qwen3", "gemma2", "gemma3_text", "phi3", ""):
         Cfg.model_type = ok
         config_from_hf(Cfg())  # must not raise
 
