@@ -115,6 +115,9 @@ def run_cmd(render: Renderer, config_file: str, yes: bool, follow: bool) -> None
 @click.option("--seq-len", type=int, default=128)
 @click.option("--lr", type=float, default=3e-4)
 @click.option("--accum", type=int, default=1, help="Gradient accumulation steps.")
+@click.option("--remat", type=click.Choice(["none", "dots", "full"]), default="none",
+              help="Activation checkpointing around the layer scan: 'dots' keeps "
+                   "matmul outputs, 'full' recomputes everything in the backward pass.")
 @click.option("--warmup", type=int, default=None, help="Warmup steps (default 1% of steps).")
 @click.option("--data", "data_path", default=None, type=click.Path(exists=True),
               help="Text file (byte-tokenized LM data); default synthetic tokens.")
@@ -138,6 +141,7 @@ def local_cmd(
     seq_len: int,
     lr: float,
     accum: int,
+    remat: str,
     warmup: int | None,
     data_path: str | None,
     slice_name: str | None,
@@ -195,6 +199,8 @@ def local_cmd(
 
     if lora and accum > 1:
         raise click.ClickException("--lora does not support --accum yet")
+    if lora and remat != "none":
+        raise click.ClickException("--remat applies to full fine-tuning only (for now)")
     if lora and config.is_moe:
         raise click.ClickException("--lora currently targets dense configs")
 
@@ -247,7 +253,7 @@ def local_cmd(
             from prime_tpu.train import shard_train_state
 
             state = shard_train_state(state, mesh, config)
-        step_fn = make_train_step(config, optimizer, accum_steps=accum)
+        step_fn = make_train_step(config, optimizer, accum_steps=accum, remat=remat)
 
     if data_path:
         batches = text_batches(data_path, batch_size, seq_len, steps)

@@ -357,6 +357,7 @@ def forward(
     attn_impl: str = "auto",
     return_aux: bool = False,
     prefill_offset: jnp.ndarray | None = None,  # () traced; chunked prefill at offset
+    remat: str = "none",  # "none" | "full" | "dots" — training-path rematerialization
 ):
     """Run the transformer. Returns (logits (B, S, V) fp32, updated cache),
     plus the summed MoE load-balance aux loss when ``return_aux``.
@@ -458,6 +459,23 @@ def forward(
             )
             x, aux = _mlp_block(x, lp, config)
             return (x, aux_sum + aux), None
+
+        if remat not in ("none", "full", "dots"):
+            raise ValueError(f"Unknown remat {remat!r} (want 'none' | 'full' | 'dots')")
+        if remat != "none":
+            # WITHOUT this, reverse-mode AD through the scan saves every
+            # layer's residuals (activation memory = n_layers × per-layer);
+            # checkpointing recomputes them in the backward pass. "dots"
+            # keeps matmul outputs (cheap HBM, expensive to recompute on the
+            # MXU) and drops the elementwise rest — the usual TPU trade.
+            policy = (
+                None
+                if remat == "full"
+                else jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            )
+            layer_fn_nocache = jax.checkpoint(
+                layer_fn_nocache, policy=policy, prevent_cse=False
+            )
 
         (x, aux_total), _ = jax.lax.scan(
             layer_fn_nocache, (x, aux0), (layer_params, sliding_flags)

@@ -6,9 +6,10 @@ slices this CLI provisions. One jitted train step, sharded via NamedShardings
 over the (dp, fsdp, tp) mesh: XLA emits reduce-scatter/all-gather for fsdp and
 psums for tp over ICI.
 
-bf16 params/activations, fp32 optimizer state and loss; optional
-``jax.checkpoint`` rematerialization around the layer scan comes from the
-model's scan structure (XLA remats scan bodies well by default).
+bf16 params/activations, fp32 optimizer state and loss. ``remat`` wraps the
+model's scan body in ``jax.checkpoint`` — reverse-mode AD otherwise saves
+every layer's residuals, so long-sequence training is activation-bound
+without it ("dots" keeps matmul outputs, "full" recomputes everything).
 """
 
 from __future__ import annotations
@@ -94,6 +95,7 @@ def make_train_step(
     attn_impl: str = "auto",
     accum_steps: int = 1,
     aux_weight: float = 0.01,   # MoE load-balance loss weight (Switch default)
+    remat: str = "none",        # "none" | "full" | "dots" activation checkpointing
 ):
     """Build the jitted train step. Shardings propagate from the placed
     inputs (shard_train_state / shard_batch) — the jit is mesh-agnostic.
@@ -108,10 +110,13 @@ def make_train_step(
     def loss_fn(params, tokens, targets, mask):
         if config.is_moe:
             logits, _, aux = forward(
-                params, tokens, config, cache=None, attn_impl=attn_impl, return_aux=True
+                params, tokens, config, cache=None, attn_impl=attn_impl,
+                return_aux=True, remat=remat,
             )
             return cross_entropy_loss(logits, targets, mask) + aux_weight * aux
-        logits, _ = forward(params, tokens, config, cache=None, attn_impl=attn_impl)
+        logits, _ = forward(
+            params, tokens, config, cache=None, attn_impl=attn_impl, remat=remat
+        )
         return cross_entropy_loss(logits, targets, mask)
 
     def grads_of(params, tokens, targets, mask):
