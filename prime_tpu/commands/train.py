@@ -199,8 +199,6 @@ def local_cmd(
 
     if lora and accum > 1:
         raise click.ClickException("--lora does not support --accum yet")
-    if lora and remat != "none":
-        raise click.ClickException("--remat applies to full fine-tuning only (for now)")
     if lora and config.is_moe:
         raise click.ClickException("--lora currently targets dense configs")
 
@@ -237,7 +235,7 @@ def local_cmd(
 
             params = shard_params(params, mesh, config)
             state = shard_lora_state(state, mesh, config, lora_cfg)
-        lora_step = make_lora_train_step(config, lora_cfg, optimizer)
+        lora_step = make_lora_train_step(config, lora_cfg, optimizer, remat=remat)
 
         def step_fn(s, tokens, targets, mask):
             return lora_step(s, params, tokens, targets, mask)

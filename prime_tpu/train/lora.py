@@ -135,6 +135,7 @@ def make_lora_train_step(
     lora: LoraConfig,
     optimizer: optax.GradientTransformation,
     attn_impl: str = "auto",
+    remat: str = "none",  # activation checkpointing (same modes as make_train_step)
 ):
     """Jitted LoRA step: state holds ONLY the adapters; the frozen base
     params ride as a non-donated argument. fp32 adapter math throughout (the
@@ -142,7 +143,9 @@ def make_lora_train_step(
 
     def loss_fn(adapters, base_params, tokens, targets, mask):
         merged = merge_lora(base_params, adapters, lora)
-        logits, _ = forward(merged, tokens, config, cache=None, attn_impl=attn_impl)
+        logits, _ = forward(
+            merged, tokens, config, cache=None, attn_impl=attn_impl, remat=remat
+        )
         return cross_entropy_loss(logits, targets, mask)
 
     def step(state: TrainState, base_params, tokens, targets, mask):

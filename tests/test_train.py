@@ -525,16 +525,19 @@ def test_train_local_remat_cli(tmp_path):
         assert "loss" in result.output
 
 
-def test_train_local_lora_rejects_remat(tmp_path):
+def test_train_local_lora_with_remat(tmp_path):
+    """--remat composes with --lora: the adapter step checkpoints the merged
+    forward the same way the full-FT step does."""
     runner = CliRunner()
     with runner.isolated_filesystem(temp_dir=tmp_path):
         result = runner.invoke(
             cli,
-            ["train", "local", "-m", "tiny-test", "--steps", "1", "--lora",
-             "--remat", "full", "--plain"],
+            ["train", "local", "-m", "tiny-test", "--steps", "2", "-b", "2",
+             "--seq-len", "16", "--lora", "--remat", "full", "--plain",
+             "--name", "lora-remat"],
         )
-        assert result.exit_code != 0
-        assert "full fine-tuning only" in result.output
+        assert result.exit_code == 0, result.output
+        assert "loss" in result.output
 
 
 def test_text_batches_rejects_tiny_corpus(tmp_path):
