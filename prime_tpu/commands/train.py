@@ -338,6 +338,8 @@ def local_cmd(
 @click.option("--lora-r", type=click.IntRange(min=1), default=16, help="LoRA rank.")
 @click.option("--lora-alpha", type=click.IntRange(min=1), default=32,
               help="LoRA alpha (scale = alpha/r).")
+@click.option("--remat", type=click.Choice(["none", "dots", "full"]), default="none",
+              help="Activation checkpointing in the update forward.")
 @output_options
 def local_rl_cmd(
     render: Renderer,
@@ -363,6 +365,7 @@ def local_rl_cmd(
     lora: bool,
     lora_r: int,
     lora_alpha: int,
+    remat: str,
 ) -> None:
     """GRPO fine-tune MODEL against ENV_REF locally on this slice.
 
@@ -414,6 +417,7 @@ def local_rl_cmd(
             epochs_per_batch=epochs_per_batch,
             steps=steps,
             learning_rate=lr,
+            remat=remat,
         )
     except ValueError as e:
         raise click.ClickException(str(e)) from None

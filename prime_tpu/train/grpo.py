@@ -52,12 +52,15 @@ class GrpoConfig:
     adv_eps: float = 1e-4            # std floor in group normalization
     steps: int = 20
     learning_rate: float = 1e-5
+    remat: str = "none"              # activation checkpointing in the update forward
 
     def __post_init__(self) -> None:
         if self.temperature <= 0.0:
             raise ValueError("GRPO rollouts need temperature > 0 (greedy groups are identical)")
         if self.group_size < 2:
             raise ValueError("group_size must be >= 2 — advantages are group-relative")
+        if self.remat not in ("none", "full", "dots"):
+            raise ValueError(f"Unknown remat {self.remat!r} (want 'none' | 'full' | 'dots')")
 
 
 def group_advantages(rewards: np.ndarray, eps: float = 1e-4) -> np.ndarray:
@@ -69,8 +72,8 @@ def group_advantages(rewards: np.ndarray, eps: float = 1e-4) -> np.ndarray:
     return (rewards - mean) / (std + eps)
 
 
-def _token_logprobs_inline(params, tokens, config, attn_impl):
-    logits, _ = forward(params, tokens, config, cache=None, attn_impl=attn_impl)
+def _token_logprobs_inline(params, tokens, config, attn_impl, remat="none"):
+    logits, _ = forward(params, tokens, config, cache=None, attn_impl=attn_impl, remat=remat)
     logprobs = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     picked = jnp.take_along_axis(logprobs, tokens[:, 1:, None], axis=-1)[..., 0]
     return jnp.pad(picked, ((0, 0), (1, 0)))
@@ -94,6 +97,7 @@ def make_grpo_step(
     attn_impl: str = "auto",
     on_policy: bool = False,
     lora=None,  # train.lora.LoraConfig -> the state holds adapters, not params
+    remat: str = "none",  # activation checkpointing in the update forward
 ):
     """Jitted GRPO update. Inputs: full packed sequences (B, T), a completion
     mask (1.0 exactly on the tokens the policy sampled, EOS included), one
@@ -122,7 +126,7 @@ def make_grpo_step(
 
     def loss_fn(policy_params, base_params, tokens, mask, advantages, old_lp, ref_lp):
         lp = _token_logprobs_inline(
-            policy_of(policy_params, base_params), tokens, config, attn_impl
+            policy_of(policy_params, base_params), tokens, config, attn_impl, remat=remat
         )
         if on_policy:
             old_lp = ref_lp = jax.lax.stop_gradient(lp)
@@ -328,7 +332,7 @@ def run_grpo(
     on_policy = cfg.epochs_per_batch == 1 and cfg.kl_coef == 0.0
     step_fn = make_grpo_step(
         config, optimizer, cfg.clip_eps, cfg.kl_coef, score_impl,
-        on_policy=on_policy, lora=lora,
+        on_policy=on_policy, lora=lora, remat=cfg.remat,
     )
 
     for step in range(cfg.steps):

@@ -618,6 +618,23 @@ def test_train_local_resume_requires_name_and_checkpoints(tmp_path):
     assert no_ckpt.exit_code != 0 and "--checkpoint-every" in no_ckpt.output
 
 
+def test_train_local_rl_remat_cli(tmp_path):
+    """GRPO with --remat: the checkpointed update forward trains end to end."""
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.main import cli
+
+    result = CliRunner().invoke(
+        cli,
+        ["train", "local-rl", "arith", "-m", "tiny-test", "--steps", "2",
+         "-g", "2", "-p", "2", "--max-prompt-len", "16", "--max-new-tokens", "4",
+         "--remat", "dots", "--name", "rl-remat", "--output-dir", str(tmp_path),
+         "--plain"],
+    )
+    assert result.exit_code == 0, result.output
+    assert (tmp_path / "rl-remat" / "metrics.jsonl").exists()
+
+
 def test_train_local_rl_cli_arith(tmp_path):
     """`prime train local-rl arith`: native GRPO from the CLI — the built-in
     arith env drives rollouts, metrics.jsonl gets one row per step."""
