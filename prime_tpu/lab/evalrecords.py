@@ -170,6 +170,122 @@ class RunOverview:
 
 
 # fields that are per-sample bookkeeping, not scoreable metrics
+@dataclass(frozen=True)
+class SampleFlip:
+    """One sample whose correctness changed between two runs."""
+
+    key: str                  # prompt (or sample id) identifying the sample
+    direction: str            # "improvement" | "regression"
+    completion_a: str
+    completion_b: str
+    answer: str
+
+
+@dataclass
+class RunComparison:
+    """A vs B deltas for two local eval runs (reference eval compare role)."""
+
+    metrics: list[tuple[str, Any, Any, float | None]]  # (name, a, b, delta)
+    shared: int = 0
+    only_a: int = 0
+    only_b: int = 0
+    flips: list[SampleFlip] = field(default_factory=list)
+    duplicates: int = 0  # multi-rollout rows beyond each key's first
+
+    @property
+    def regressions(self) -> int:
+        return sum(1 for f in self.flips if f.direction == "regression")
+
+    @property
+    def improvements(self) -> int:
+        return sum(1 for f in self.flips if f.direction == "improvement")
+
+
+def _sample_key(row: dict[str, Any]) -> str | None:
+    # explicit None checks: sample_id 0 and an empty-string prompt are real keys
+    for field_name in ("prompt", "sample_id", "sampleId"):
+        value = row.get(field_name)
+        if value is not None:
+            return str(value)
+    return None
+
+
+def compare_runs(dir_a: str | Path, dir_b: str | Path) -> RunComparison:
+    """Compare two runs' metadata metrics and per-sample correctness,
+    matching samples by prompt (sample id fallback).
+
+    Streaming-first: the index pass keeps only key → (correct, row index)
+    per run (no completions in memory); the handful of flipped rows are
+    fetched afterwards through the lazy reader. Samples missing a
+    ``correct`` field in EITHER run are excluded from flip accounting (an
+    env that scores rewards only must not read as 100% regressions).
+    Duplicate keys (multi-rollout runs) keep the FIRST occurrence —
+    deterministic, and counted in ``duplicates`` so the screen can say so.
+    """
+    dir_a, dir_b = Path(dir_a), Path(dir_b)
+
+    def metadata_metrics(run_dir: Path) -> dict[str, Any]:
+        try:
+            loaded = json.loads((run_dir / "metadata.json").read_text())
+        except (OSError, json.JSONDecodeError):
+            return {}
+        metrics = loaded.get("metrics") if isinstance(loaded, dict) else None
+        return metrics if isinstance(metrics, dict) else {}
+
+    def index_run(records: IndexedJsonl) -> tuple[dict[str, tuple[bool | None, int]], int]:
+        out: dict[str, tuple[bool | None, int]] = {}
+        duplicates = 0
+        for position, row in enumerate(records):
+            key = _sample_key(row)
+            if key is None:
+                continue
+            if key in out:
+                duplicates += 1
+                continue  # first occurrence wins, deterministically
+            correct = bool(row["correct"]) if "correct" in row else None
+            out[key] = (correct, position)
+        return out, duplicates
+
+    metrics_a = metadata_metrics(dir_a)
+    metrics_b = metadata_metrics(dir_b)
+    metric_rows: list[tuple[str, Any, Any, float | None]] = []
+    for name in sorted(set(metrics_a) | set(metrics_b)):
+        a, b = metrics_a.get(name), metrics_b.get(name)
+        numeric = isinstance(a, (int, float)) and isinstance(b, (int, float))
+        if isinstance(a, (int, float)) or isinstance(b, (int, float)):
+            metric_rows.append((name, a, b, float(b - a) if numeric else None))
+
+    records_a = IndexedJsonl(dir_a / "results.jsonl")
+    records_b = IndexedJsonl(dir_b / "results.jsonl")
+    index_a, dup_a = index_run(records_a)
+    index_b, dup_b = index_run(records_b)
+    shared_keys = set(index_a) & set(index_b)
+    flips: list[SampleFlip] = []
+    for key in sorted(shared_keys):
+        ok_a, pos_a = index_a[key]
+        ok_b, pos_b = index_b[key]
+        if ok_a is None or ok_b is None or ok_a == ok_b:
+            continue
+        row_a, row_b = records_a.get(pos_a), records_b.get(pos_b)
+        flips.append(
+            SampleFlip(
+                key=key,
+                direction="improvement" if ok_b else "regression",
+                completion_a=str(row_a.get("completion", "")),
+                completion_b=str(row_b.get("completion", "")),
+                answer=str(row_a.get("answer", row_b.get("answer", ""))),
+            )
+        )
+    return RunComparison(
+        metrics=metric_rows,
+        shared=len(shared_keys),
+        only_a=len(set(index_a) - shared_keys),
+        only_b=len(set(index_b) - shared_keys),
+        flips=flips,
+        duplicates=dup_a + dup_b,
+    )
+
+
 _NON_METRIC_KEYS = {"prompt", "completion", "answer", "sample_index", "tokens"}
 
 

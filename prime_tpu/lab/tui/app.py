@@ -92,6 +92,7 @@ class PrimeLabApp:
         self.quit = False
         self.screens: list[DetailScreen] = []  # drill-down stack; top renders
         self._armed_launch: Path | None = None
+        self._compare_base: dict[str, Any] | None = None  # `x` comparison baseline
         # launch cards are rescanned at most once per input event: render()
         # reads rows() several times per frame and must not re-glob each time
         self._launch_rows: list[dict[str, Any]] | None = None
@@ -193,6 +194,13 @@ class PrimeLabApp:
             tree = EvalTreeScreen(self.snapshot.local_eval_runs)
             self.screens.append(tree)
             self.status = "eval tree · enter open · esc back"
+        elif key == "x" and self.section == "local-runs":
+            self._mark_or_compare()
+        elif key == "?":
+            from prime_tpu.lab.tui.help import HelpScreen
+
+            self.screens.append(HelpScreen())
+            self.status = "keys · esc back"
         elif key in ("e", "n") and self.section == "agents":
             from prime_tpu.lab.tui.agent_editor import AgentConfigEditor
 
@@ -309,6 +317,33 @@ class PrimeLabApp:
             return
         self.screens.append(screen)
         self.status = f"{screen.title} · esc: back"
+
+    def _mark_or_compare(self) -> None:
+        """First `x` marks the selected run as the comparison baseline;
+        a second `x` on a different run opens the A → B compare screen."""
+        row = self.selected_row()
+        if row is None:
+            return
+        base = self._compare_base
+        if base is None or base.get("dir") == row.get("dir"):
+            self._compare_base = row
+            self.status = f"baseline: {row.get('runId', '?')} — press x on another run"
+            return
+        from prime_tpu.lab.evalrecords import compare_runs
+        from prime_tpu.lab.tui.compare import RunCompareScreen
+
+        try:
+            comparison = compare_runs(base["dir"], row["dir"])
+        except Exception as e:  # noqa: BLE001 - compare must not kill the shell
+            self.status = f"compare failed: {e}"[:160]
+            return
+        self._compare_base = None
+        self.screens.append(
+            RunCompareScreen(
+                str(base.get("runId", "A")), str(row.get("runId", "B")), comparison
+            )
+        )
+        self.status = f"{self.screens[-1].title} · esc: back"
 
     def _open_card_editor(self, new: bool = False) -> None:
         from prime_tpu.lab.tui.editor import ConfigCardEditor, new_card

@@ -1,0 +1,148 @@
+"""Run-comparison screen: metric deltas + per-sample correctness flips
+(the `prime eval compare` CLI surface, in-shell — reference eval_screen
+comparison role).
+
+Opened from the local-runs section: `x` marks the selected run as the
+baseline (A), `x` on a second run opens this screen comparing A → B.
+
+Keys: j/k move over flips · f cycle filter (all → regressions →
+improvements) · enter expand/collapse the selected flip's completions ·
+esc back.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from prime_tpu.lab.tui.detail import DetailScreen, _wrap
+
+_FILTERS = ("all", "regressions", "improvements")
+
+
+class RunCompareScreen(DetailScreen):
+    def __init__(self, label_a: str, label_b: str, comparison) -> None:
+        self.title = f"compare: {label_a} → {label_b}"
+        self.label_a = label_a
+        self.label_b = label_b
+        self.comparison = comparison
+        self.cursor = 0
+        self.filter_mode = "all"
+        self.expanded = False
+
+    def visible(self) -> list[int]:
+        flips = self.comparison.flips
+        if self.filter_mode == "all":
+            return list(range(len(flips)))
+        want = "regression" if self.filter_mode == "regressions" else "improvement"
+        return [i for i, f in enumerate(flips) if f.direction == want]
+
+    def on_key(self, key: str) -> str | None:
+        vis = self.visible()
+        if key in ("j", "down"):
+            if vis:
+                pos = vis.index(self.cursor) if self.cursor in vis else -1
+                self.cursor = vis[min(pos + 1, len(vis) - 1)]
+                self.expanded = False
+        elif key in ("k", "up"):
+            if vis:
+                pos = vis.index(self.cursor) if self.cursor in vis else 1
+                self.cursor = vis[max(pos - 1, 0)]
+                self.expanded = False
+        elif key == "f":
+            position = _FILTERS.index(self.filter_mode)
+            self.filter_mode = _FILTERS[(position + 1) % len(_FILTERS)]
+            fresh = self.visible()
+            if fresh:
+                self.cursor = fresh[0]
+            self.expanded = False
+            return f"filter: {self.filter_mode} ({len(fresh)} flips)"
+        elif key == "enter":
+            self.expanded = not self.expanded
+        else:
+            return super().on_key(key)
+        return None
+
+    def render(self):
+        from rich.console import Group
+        from rich.table import Table
+        from rich.text import Text
+
+        comparison = self.comparison
+        parts: list[Any] = []
+        head = Table.grid(padding=(0, 2))
+        head.add_row(
+            Text("shared samples", style="dim"), Text(str(comparison.shared)),
+            Text("only A / only B", style="dim"),
+            Text(f"{comparison.only_a} / {comparison.only_b}"),
+        )
+        head.add_row(
+            Text("improvements", style="dim"),
+            Text(str(comparison.improvements), style="green"),
+            Text("regressions", style="dim"),
+            Text(str(comparison.regressions), style="red"),
+        )
+        parts.append(head)
+
+        if comparison.metrics:
+            grid = Table.grid(padding=(0, 2))
+            grid.add_row(*(Text(h, style="bold dim") for h in ("metric", "A", "B", "Δ")))
+            for name, a, b, delta in comparison.metrics:
+                style = "" if delta in (None, 0) else ("green" if delta > 0 else "red")
+                grid.add_row(
+                    Text(name),
+                    Text(f"{a:.4g}" if isinstance(a, (int, float)) else "—", style="dim"),
+                    Text(f"{b:.4g}" if isinstance(b, (int, float)) else "—", style="dim"),
+                    Text(f"{delta:+.4g}" if delta is not None else "—", style=style or None),
+                )
+            parts.append(Text(""))
+            parts.append(grid)
+
+        if comparison.duplicates:
+            parts.append(
+                Text(
+                    f"(multi-rollout runs: first rollout per prompt compared, "
+                    f"{comparison.duplicates} later rollout(s) ignored)",
+                    style="dim",
+                )
+            )
+        vis = self.visible()
+        parts.append(Text(""))
+        if not vis:
+            parts.append(Text(f"(no {self.filter_mode} flips)", style="dim"))
+        # window around the cursor so j/k can reach every flip
+        window = 14
+        start = 0
+        if self.cursor in vis:
+            position = vis.index(self.cursor)
+            start = max(0, min(position - window // 2, len(vis) - window))
+        if start:
+            parts.append(Text(f"… {start} earlier flips", style="dim"))
+        for index in vis[start : start + window]:
+            flip = comparison.flips[index]
+            selected = index == self.cursor
+            marker = "↑" if flip.direction == "improvement" else "↓"
+            color = "green" if flip.direction == "improvement" else "red"
+            parts.append(
+                Text(
+                    f"{marker} {flip.key[:70]}",
+                    style=f"reverse {color}" if selected else color,
+                    no_wrap=True,
+                    overflow="ellipsis",
+                )
+            )
+            if selected and self.expanded:
+                body = Text()
+                for label, text in (
+                    (f"A ({self.label_a})", flip.completion_a),
+                    (f"B ({self.label_b})", flip.completion_b),
+                    ("answer", flip.answer),
+                ):
+                    body.append(f"  {label}:\n", style="bold dim")
+                    for line in _wrap(text, width=70)[:6]:
+                        body.append(f"    {line}\n")
+                parts.append(body)
+        if len(vis) > start + window:
+            parts.append(Text(f"… {len(vis) - start - window} more flips", style="dim"))
+        parts.append(Text(""))
+        parts.append(Text("j/k move · f filter · enter expand · esc back", style="dim"))
+        return Group(*parts)

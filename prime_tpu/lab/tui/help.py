@@ -1,0 +1,87 @@
+"""Keybinding help overlay (`?` from the shell — reference app footer/help
+role). Static reference grouped by context; the table lives here so it can
+be asserted complete in tests when bindings change."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from prime_tpu.lab.tui.detail import DetailScreen
+
+KEYBINDINGS: tuple[tuple[str, tuple[tuple[str, str], ...]], ...] = (
+    (
+        "Shell",
+        (
+            ("↑/↓ j/k", "move (nav pane cycles sections, rows pane moves the cursor)"),
+            ("tab ←/→", "switch pane"),
+            ("1-9", "jump to section"),
+            ("enter", "drill into the selected row (launch: arm, enter again fires)"),
+            ("r / R", "refresh section / refresh everything"),
+            ("g / G", "first / last row"),
+            ("S", "workspace setup + hygiene doctor"),
+            ("?", "this help"),
+            ("q", "quit (esc pops a screen first)"),
+        ),
+    ),
+    (
+        "Local eval runs",
+        (
+            ("enter", "run overview → per-sample browser"),
+            ("t", "env → model → run tree with aggregates"),
+            ("x", "mark comparison baseline; x on a second run compares A → B"),
+        ),
+    ),
+    (
+        "Sample browser",
+        (
+            ("n/p", "next / previous sample"),
+            ("f", "filter all → correct → incorrect"),
+            ("/", "incremental search across turns"),
+            ("m", "markdown/LaTeX rendering"),
+            ("j/k", "scroll the transcript"),
+        ),
+    ),
+    (
+        "Training run",
+        (
+            ("tab h/l", "chart / config / logs tabs"),
+            ("c", "cycle charted metric"),
+            ("s", "EMA smoothing"),
+            ("[ / ]", "step-window zoom"),
+        ),
+    ),
+    (
+        "Launch cards",
+        (
+            ("e / n", "edit / new card (typed fields, TOML round-trip guard)"),
+            ("enter", "arm, enter again launches"),
+        ),
+    ),
+    (
+        "Agents",
+        (
+            ("enter", "chat (widgets: ↑/↓ + enter answer a pending choice/launch)"),
+            ("e / n", "edit / add an agent config"),
+        ),
+    ),
+)
+
+
+class HelpScreen(DetailScreen):
+    title = "keys"
+
+    def render(self):
+        from rich.console import Group
+        from rich.table import Table
+        from rich.text import Text
+
+        parts: list[Any] = []
+        for section, rows in KEYBINDINGS:
+            parts.append(Text(section, style="bold magenta"))
+            grid = Table.grid(padding=(0, 2))
+            for keys, description in rows:
+                grid.add_row(Text(keys, style="bold"), Text(description, style="dim"))
+            parts.append(grid)
+            parts.append(Text(""))
+        parts.append(Text("esc back", style="dim"))
+        return Group(*parts)

@@ -775,6 +775,115 @@ def test_card_editor_rejects_dotted_keys(app, tmp_path):
     assert all(k != "lr.schedule" for k, _ in editor.fields)
 
 
+# -- run comparison (eval compare, in-shell) ----------------------------------
+
+
+def _run_with_flips(tmp_path, run, rewards):
+    """Run dir whose sample i is correct iff rewards[i]; prompts shared."""
+    run_dir = tmp_path / "outputs" / "evals" / "gsm8k--m1" / run
+    run_dir.mkdir(parents=True)
+    accuracy = sum(rewards) / len(rewards)
+    (run_dir / "metadata.json").write_text(
+        json.dumps({"metrics": {"accuracy": accuracy, "num_samples": len(rewards)}})
+    )
+    with open(run_dir / "results.jsonl", "w") as f:
+        for i, ok in enumerate(rewards):
+            f.write(
+                json.dumps(
+                    {
+                        "prompt": f"q{i}",
+                        "completion": f"{run}-ans{i}",
+                        "answer": str(i),
+                        "reward": 1.0 if ok else 0.0,
+                        "correct": bool(ok),
+                    }
+                )
+                + "\n"
+            )
+    return run_dir
+
+
+def test_compare_runs_flips_and_metric_deltas(tmp_path):
+    from prime_tpu.lab.evalrecords import compare_runs
+
+    dir_a = _run_with_flips(tmp_path, "run-a", [1, 1, 0, 0])
+    dir_b = _run_with_flips(tmp_path, "run-b", [1, 0, 1, 0])
+    comparison = compare_runs(dir_a, dir_b)
+    assert comparison.shared == 4
+    assert comparison.regressions == 1 and comparison.improvements == 1
+    directions = {f.key: f.direction for f in comparison.flips}
+    assert directions == {"q1": "regression", "q2": "improvement"}
+    accuracy = next(m for m in comparison.metrics if m[0] == "accuracy")
+    assert accuracy[3] == pytest.approx(0.0)   # 0.5 -> 0.5
+
+
+def test_compare_runs_edge_cases(tmp_path):
+    """sample_id 0 keys, rows without 'correct', and duplicate keys."""
+    from prime_tpu.lab.evalrecords import compare_runs
+
+    def write(run, rows):
+        run_dir = tmp_path / "outputs" / "evals" / "e--m" / run
+        run_dir.mkdir(parents=True)
+        (run_dir / "metadata.json").write_text(json.dumps({"metrics": {}}))
+        with open(run_dir / "results.jsonl", "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        return run_dir
+
+    dir_a = write(
+        "a",
+        [
+            {"sample_id": 0, "completion": "x", "correct": True},   # falsy key kept
+            {"prompt": "dup", "completion": "first", "correct": True},
+            {"prompt": "dup", "completion": "second", "correct": False},  # ignored
+            {"prompt": "reward-only", "completion": "r", "reward": 0.5},  # no correct
+        ],
+    )
+    dir_b = write(
+        "b",
+        [
+            {"sample_id": 0, "completion": "y", "correct": False},
+            {"prompt": "dup", "completion": "other", "correct": True},
+            {"prompt": "reward-only", "completion": "r2", "correct": True},
+        ],
+    )
+    comparison = compare_runs(dir_a, dir_b)
+    assert comparison.shared == 3
+    assert comparison.duplicates == 1
+    # sample 0 regressed; dup compares first occurrences (no flip);
+    # reward-only is excluded from flip accounting, not counted as regression
+    assert [(f.key, f.direction) for f in comparison.flips] == [("0", "regression")]
+
+
+def test_compare_screen_via_x_marks(app, tmp_path):
+    _run_with_flips(tmp_path, "run-a", [1, 0])
+    _run_with_flips(tmp_path, "run-b", [0, 1])
+    app.tick()
+    app.on_key("1")
+    app.on_key("x")                  # mark baseline (first row)
+    assert "baseline" in app.status
+    app.on_key("j")
+    app.on_key("x")                  # compare with second row
+    assert app.screens and app.screens[-1].title.startswith("compare:")
+    text = render_text(app)
+    assert "improvements" in text and "regressions" in text
+    app.on_key("enter")              # expand the selected flip
+    text = render_text(app)
+    assert "ans0" in text            # both completions shown
+    app.on_key("f")                  # filter cycles
+    assert "filter:" in app.status
+    app.on_key("escape")
+    assert not app.screens
+
+
+def test_help_overlay(app):
+    app.on_key("?")
+    text = render_text(app)
+    assert "Sample browser" in text and "markdown" in text.lower()
+    app.on_key("escape")
+    assert not app.screens
+
+
 # -- grouped eval tree (reference evaluation_browser.py role) -----------------
 
 
