@@ -30,10 +30,16 @@ class ModelConfig:
     attn_out_bias: bool = False
     # per-head RMSNorm on q/k before rope (Qwen3 family)
     qk_norm: bool = False
+    # full-width RMSNorm on the flat q/k projections before the head reshape
+    # (OLMo-2: the rms statistic spans all heads jointly)
+    qk_norm_full: bool = False
     # --- Gemma-family architecture knobs ---
     act: str = "silu"                 # MLP activation: "silu" | "gelu_tanh"
     norm_plus_one: bool = False       # RMSNorm scales by (1 + w)
-    post_norms: bool = False          # extra norms on block outputs (Gemma2/3)
+    post_norms: bool = False          # norms on block outputs (Gemma2/3, OLMo-2)
+    # input norms before each sublayer (every family EXCEPT OLMo-2, which is
+    # post-norm only: sublayer output normed before the residual add)
+    pre_norms: bool = True
     scale_embed: bool = False         # hidden *= sqrt(d_model) after embedding
     attn_softcap: float = 0.0         # tanh softcap on attention scores
     final_softcap: float = 0.0        # tanh softcap on output logits
@@ -82,11 +88,13 @@ class ModelConfig:
             attn += self.d_model
         if self.qk_norm:
             attn += 2 * self.head_dim
+        if self.qk_norm_full:
+            attn += (self.n_heads + self.n_kv_heads) * self.head_dim
         if self.is_moe:
             mlp = self.n_experts * 3 * self.d_model * self.d_ff + self.d_model * self.n_experts
         else:
             mlp = 3 * self.d_model * self.d_ff
-        norms = (4 if self.post_norms else 2) * self.d_model
+        norms = ((2 if self.pre_norms else 0) + (2 if self.post_norms else 0)) * self.d_model
         per_layer = attn + mlp + norms
         return embed + head + self.n_layers * per_layer + self.d_model
 
@@ -304,6 +312,38 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         final_softcap=30.0,
         query_scale=144,
         sliding_window=4096,
+    ),
+    # OLMo-2 family: post-norm-only blocks (no input norms; sublayer outputs
+    # normed before the residual add) + full-width q/k RMSNorm
+    "olmo2-7b": ModelConfig(
+        name="olmo2-7b",
+        vocab_size=100352,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=32,
+        d_ff=11008,
+        max_seq_len=4096,
+        rope_theta=500000.0,
+        rms_eps=1e-6,
+        pre_norms=False,
+        post_norms=True,
+        qk_norm_full=True,
+    ),
+    "olmo2-13b": ModelConfig(
+        name="olmo2-13b",
+        vocab_size=100352,
+        d_model=5120,
+        n_layers=40,
+        n_heads=40,
+        n_kv_heads=40,
+        d_ff=13824,
+        max_seq_len=4096,
+        rope_theta=500000.0,
+        rms_eps=1e-6,
+        pre_norms=False,
+        post_norms=True,
+        qk_norm_full=True,
     ),
     # Phi-3 family: llama math behind fused qkv/gate_up projections (split at
     # load); phi-4 shares the phi3 model_type with a 100k vocab

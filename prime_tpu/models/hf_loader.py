@@ -39,6 +39,7 @@ SUPPORTED_MODEL_TYPES = frozenset(
         "gemma3_text",
         "gemma3",
         "phi3",
+        "olmo2",
     }
 )
 
@@ -162,12 +163,15 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         # Llama-arch attention_bias biases o_proj as well; Qwen2 does not
         attn_out_bias=bool(getattr(hf_config, "attention_bias", False)),
         qk_norm=model_type in ("qwen3", "qwen3_moe", "gemma3_text"),
+        # OLMo-2: post-norm-only blocks and full-width q/k norms
+        qk_norm_full=model_type == "olmo2",
+        pre_norms=model_type != "olmo2",
         # Gemma2/3: GeGLU, (1+w) norms, post-norms, scaled embeddings; Gemma2
         # adds softcapped scores/logits, Gemma3 drops the caps and adds
         # qk-norm + dual-frequency rope
         act="gelu_tanh" if gemma else "silu",
         norm_plus_one=gemma,
-        post_norms=gemma,
+        post_norms=gemma or model_type == "olmo2",
         scale_embed=gemma,
         attn_softcap=float(getattr(hf_config, "attn_logit_softcapping", 0.0) or 0.0),
         final_softcap=float(getattr(hf_config, "final_logit_softcapping", 0.0) or 0.0),
@@ -362,7 +366,23 @@ def params_from_state_dict(
             "q_norm": stacked("layers.{}.self_attn.q_norm.weight", transpose=False),
             "k_norm": stacked("layers.{}.self_attn.k_norm.weight", transpose=False),
         }
-    if config.post_norms:
+    if config.qk_norm_full:  # OLMo-2: same checkpoint names, full-width weights
+        attn_biases |= {
+            "q_norm_full": stacked("layers.{}.self_attn.q_norm.weight", transpose=False),
+            "k_norm_full": stacked("layers.{}.self_attn.k_norm.weight", transpose=False),
+        }
+    if not config.pre_norms:
+        # OLMo-2: post-norm only — the checkpoint has NO input norms, and its
+        # q_norm/k_norm are FULL-WIDTH (rms over all heads jointly)
+        norm_keys = {
+            "attn_post_norm": stacked(
+                "layers.{}.post_attention_layernorm.weight", transpose=False
+            ),
+            "mlp_post_norm": stacked(
+                "layers.{}.post_feedforward_layernorm.weight", transpose=False
+            ),
+        }
+    elif config.post_norms:
         # Gemma2 norm naming: post_attention_layernorm is a POST-norm on the
         # attention output; the pre-MLP norm is pre_feedforward_layernorm
         norm_keys = {

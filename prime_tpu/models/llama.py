@@ -146,20 +146,32 @@ def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Para
             "q_norm": norm_init((layers, hd), dtype=dtype),
             "k_norm": norm_init((layers, hd), dtype=dtype),
         }
-    if config.post_norms:  # Gemma2-style norms on the block outputs
+    if config.qk_norm_full:  # OLMo-2: rms statistic spans all heads jointly
+        attn_biases |= {
+            "q_norm_full": norm_init((layers, h * hd), dtype=dtype),
+            "k_norm_full": norm_init((layers, kh * hd), dtype=dtype),
+        }
+    if config.post_norms:  # Gemma2/OLMo-2 norms on the block outputs
         attn_biases |= {
             "attn_post_norm": norm_init((layers, d), dtype=dtype),
             "mlp_post_norm": norm_init((layers, d), dtype=dtype),
         }
+    pre_norms = (
+        {
+            "attn_norm": norm_init((layers, d), dtype=dtype),
+            "mlp_norm": norm_init((layers, d), dtype=dtype),
+        }
+        if config.pre_norms
+        else {}
+    )
     params: Params = {
         "embed": dense(keys[0], (config.vocab_size, d), d),
         "layers": {
-            "attn_norm": norm_init((layers, d), dtype=dtype),
             "wq": dense(keys[1], (layers, d, h * hd), d),
             "wk": dense(keys[2], (layers, d, kh * hd), d),
             "wv": dense(keys[3], (layers, d, kh * hd), d),
             "wo": dense(keys[4], (layers, h * hd, d), h * hd),
-            "mlp_norm": norm_init((layers, d), dtype=dtype),
+            **pre_norms,
             **attn_biases,
             **mlp_weights,
         },
@@ -202,10 +214,14 @@ def _attention_block(
         cos_rows = jnp.where(sliding, rope_tables_local[0][positions], cos_rows)
         sin_rows = jnp.where(sliding, rope_tables_local[1][positions], sin_rows)
 
-    normed = _norm(x, lp["attn_norm"], config)
+    # OLMo-2 is post-norm only: no input norm param, the raw residual feeds in
+    normed = _norm(x, lp["attn_norm"], config) if "attn_norm" in lp else x
     q, k, v = _mm(normed, lp["wq"]), _mm(normed, lp["wk"]), _mm(normed, lp["wv"])
     if "bq" in lp:  # Qwen2-style q/k/v biases
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    if "q_norm_full" in lp:  # OLMo-2: full-width RMSNorm before the head split
+        q = _norm(q, lp["q_norm_full"], config)
+        k = _norm(k, lp["k_norm_full"], config)
     q = q.reshape(batch, seq, h, hd)
     k = k.reshape(batch, seq, kh, hd)
     v = v.reshape(batch, seq, kh, hd)
@@ -316,7 +332,7 @@ def _attention_block(
 
 def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Dense or sparse-MoE feed-forward. Returns (residual output, aux loss)."""
-    normed = _norm(x, lp["mlp_norm"], config)
+    normed = _norm(x, lp["mlp_norm"], config) if "mlp_norm" in lp else x
     if config.is_moe:
         from prime_tpu.ops.moe import moe_mlp
 

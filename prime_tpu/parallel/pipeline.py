@@ -41,22 +41,24 @@ def pipeline_param_specs(config: ModelConfig) -> dict:
             "pipeline parallelism does not cover sliding-window configs yet"
         )
     layer_spec = {
-        "attn_norm": P("pp", None),
         "wq": P("pp", None, None),
         "wk": P("pp", None, None),
         "wv": P("pp", None, None),
         "wo": P("pp", None, None),
-        "mlp_norm": P("pp", None),
         "w_gate": P("pp", None, None),
         "w_up": P("pp", None, None),
         "w_down": P("pp", None, None),
     }
+    if config.pre_norms:
+        layer_spec |= {"attn_norm": P("pp", None), "mlp_norm": P("pp", None)}
     if config.attn_bias:
         layer_spec |= {"bq": P("pp", None), "bk": P("pp", None), "bv": P("pp", None)}
     if config.attn_out_bias:
         layer_spec |= {"bo": P("pp", None)}
     if config.qk_norm:
         layer_spec |= {"q_norm": P("pp", None), "k_norm": P("pp", None)}
+    if config.qk_norm_full:
+        layer_spec |= {"q_norm_full": P("pp", None), "k_norm_full": P("pp", None)}
     if config.post_norms:
         layer_spec |= {"attn_post_norm": P("pp", None), "mlp_post_norm": P("pp", None)}
     specs = {

@@ -49,20 +49,28 @@ def param_specs(config: ModelConfig) -> dict[str, Any]:
     if config.qk_norm:
         # (L, head_dim) weights shared across heads: replicate
         attn_bias_specs |= {"q_norm": P(None, None), "k_norm": P(None, None)}
+    if config.qk_norm_full:
+        # (L, H*hd) on the projection output dim — same tp split as the
+        # matrices' output columns so the norm weight lands with its slice
+        attn_bias_specs |= {"q_norm_full": P(None, "tp"), "k_norm_full": P(None, "tp")}
     if config.post_norms:
         attn_bias_specs |= {
             "attn_post_norm": P(None, None),
             "mlp_post_norm": P(None, None),
         }
+    pre_norm_specs = (
+        {"attn_norm": P(None, None), "mlp_norm": P(None, None)}
+        if config.pre_norms
+        else {}
+    )
     specs: dict[str, Any] = {
         "embed": P("tp", "fsdp"),              # (V, D) vocab on tp, d_model on fsdp
         "layers": {
-            "attn_norm": P(None, None),
             "wq": P(None, "fsdp", "tp"),
             "wk": P(None, "fsdp", "tp"),
             "wv": P(None, "fsdp", "tp"),
             "wo": P(None, "tp", "fsdp"),
-            "mlp_norm": P(None, None),
+            **pre_norm_specs,
             **attn_bias_specs,
             **mlp_specs,
         },

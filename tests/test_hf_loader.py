@@ -660,6 +660,71 @@ def test_qwen3moe_pared_config_tracks_hf_defaults():
     assert mixtral_cfg.norm_topk is True and mixtral_cfg.experts_per_token == 2
 
 
+# -- OLMo-2 family -------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def olmo2_model():
+    cfg = transformers.Olmo2Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rope_theta=500000.0,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(29)
+    model = transformers.Olmo2ForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_olmo2_logits_match_transformers(olmo2_model):
+    """OLMo-2's two deltas at once: post-norm-only blocks (no input norms —
+    the raw residual feeds the projections, outputs normed before the add)
+    and FULL-WIDTH q/k RMSNorm whose rms statistic spans all heads."""
+    state = {k: v.float().numpy() for k, v in olmo2_model.state_dict().items()}
+    config = config_from_hf(olmo2_model.config, name="tiny-olmo2")
+    assert not config.pre_norms and config.post_norms and config.qk_norm_full
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+    assert "attn_norm" not in params["layers"] and "mlp_norm" not in params["layers"]
+    assert params["layers"]["q_norm_full"].shape[-1] == config.n_heads * config.head_dim
+    assert params["layers"]["k_norm_full"].shape[-1] == config.n_kv_heads * config.head_dim
+
+    tokens = np.array([[3, 17, 200, 45, 9, 88, 121, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = olmo2_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    our_logits, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=5e-4, atol=5e-4)
+
+
+def test_olmo2_decode_matches_transformers_generation(olmo2_model):
+    import jax
+
+    from prime_tpu.models.sampler import generate
+
+    state = {k: v.float().numpy() for k, v in olmo2_model.state_dict().items()}
+    config = config_from_hf(olmo2_model.config, name="tiny-olmo2")
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+
+    prompt = np.array([[5, 42, 100, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_out = olmo2_model.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=8, do_sample=False, eos_token_id=None, pad_token_id=0,
+        ).numpy()[0, 4:]
+    result = generate(
+        params, jnp.asarray(prompt), jnp.array([4]), config,
+        jax.random.PRNGKey(0), max_new_tokens=8, temperature=0.0,
+    )
+    np.testing.assert_array_equal(np.asarray(result.tokens[0]), hf_out)
+
+
 # -- Phi-3 family --------------------------------------------------------------
 
 
