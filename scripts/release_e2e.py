@@ -218,19 +218,19 @@ def check_speculative(env):
         assert "accuracy=" in sampled.stdout
 
 
-@step("serve round trip (OpenAI-compatible)")
-def check_serve(env):
+def _serve_round_trip(env, serve_kwargs: str, sentinel: str) -> None:
+    """One OpenAI-compatible round trip against serve_model(<kwargs>)."""
     code = (
-        "import os, httpx\n"
+        "import httpx\n"
         "from prime_tpu.serve import serve_model\n"
-        "server = serve_model('tiny-test', port=0)\n"
+        f"server = serve_model('tiny-test', port=0{serve_kwargs})\n"
         "with server:\n"
         "    r = httpx.post(server.url + '/v1/chat/completions',\n"
-        "                   json={'messages': [{'role': 'user', 'content': 'hi'}], 'max_tokens': 2},\n"
+        "                   json={'messages': [{'role': 'user', 'content': 'hi'}], 'max_tokens': 3},\n"
         "                   timeout=240)\n"
         "    assert r.status_code == 200, r.text\n"
         "    assert r.json()['usage']['total_tokens'] >= 1\n"
-        "print('serve-ok')\n"
+        f"print('{sentinel}')\n"
     )
     proc = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True, env=env,
@@ -238,7 +238,21 @@ def check_serve(env):
     )
     if proc.returncode != 0:
         raise RuntimeError(proc.stderr[-800:])
-    assert "serve-ok" in proc.stdout
+    assert sentinel in proc.stdout
+
+
+@step("serve round trip (OpenAI-compatible)")
+def check_serve(env):
+    _serve_round_trip(env, "", "serve-ok")
+
+
+@step("continuous-batching serve with int8 KV cache")
+def check_serve_continuous_int8(env):
+    _serve_round_trip(
+        env,
+        ", continuous=True, kv_quant=True, max_slots=2, slot_capacity=256, chunk=4",
+        "serve-int8-ok",
+    )
 
 
 def main() -> int:
@@ -272,6 +286,7 @@ def main() -> int:
             check_local_rl_lora,
             check_speculative,
             check_serve,
+            check_serve_continuous_int8,
         ):
             check(env)
     server.stop()
