@@ -38,6 +38,14 @@ import click
     "--chunk", type=int, default=8,
     help="Decode steps per dispatch — lower admits new requests sooner (--continuous).",
 )
+@click.option(
+    "--speculative", is_flag=True,
+    help="Prompt-lookup speculative decoding (greedy: exact tokens; sampled: "
+         "exact distribution). With --continuous, per-slot drafts ride one "
+         "verify pass per tick.",
+)
+@click.option("--draft-len", type=click.IntRange(min=1), default=4,
+              help="Speculative draft tokens per step.")
 def serve_cmd(
     model: str,
     checkpoint: str | None,
@@ -53,6 +61,8 @@ def serve_cmd(
     slots: int,
     slot_capacity: int,
     chunk: int,
+    speculative: bool,
+    draft_len: int,
 ) -> None:
     """Serve MODEL over an OpenAI-compatible HTTP API (blocks until Ctrl-C)."""
     from prime_tpu.serve import serve_model
@@ -73,6 +83,8 @@ def serve_cmd(
             max_slots=slots,
             slot_capacity=slot_capacity,
             chunk=chunk,
+            speculative=speculative,
+            draft_len=draft_len,
         )
     except (ValueError, OSError) as e:
         raise click.ClickException(str(e)) from None

@@ -53,7 +53,6 @@ from prime_tpu.models.sampler import (
     _sample,
     finalize_tokens,
     run_prefill,
-    scaled_logits,
 )
 
 
@@ -87,6 +86,60 @@ def propose_ngram_drafts(
     drafts = jax.vmap(gather_row)(history, start)
     fallback = jnp.broadcast_to(t1, (batch, draft_len))
     return jnp.where((best >= 0)[:, None], drafts, fallback)
+
+
+def verify_window_tokens(
+    logits: jnp.ndarray,   # (B, D+1, V) fp32 — the verify pass's outputs
+    drafts: jnp.ndarray,   # (B, D) proposed tokens
+    temps: jnp.ndarray,    # (B,) traced; 0 = greedy argmax acceptance
+    top_ps: jnp.ndarray,   # (B,) traced; active only where temps > 0
+    accept_rng: jnp.ndarray,
+    fix_rng: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The ONE owner of speculative accept/correct math, per-row.
+
+    Greedy rows (temp 0) accept drafts matching argmax and take the argmax
+    bonus/correction; sampled rows rejection-sample against the point-mass
+    n-gram proposal (accept draft x with prob p(x); on rejection draw from
+    the residual with x zeroed) — exact in distribution. Temperature scaling
+    then the nucleus filter, matching sampler.scaled_logits' ordering.
+    Returns (tokens_round (B, D+1), n_acc (B,)): positions <= n_acc of
+    tokens_round are this round's emissions (accepted drafts + the
+    bonus/correction at position n_acc).
+    """
+    from prime_tpu.models.sampler import top_p_filter
+
+    batch, window, _ = logits.shape
+    draft_len = window - 1
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # (B, D+1)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None, None]
+    wants_nucleus = jnp.any((top_ps < 1.0) & (temps > 0.0))
+    filtered = jax.lax.cond(
+        wants_nucleus, lambda x: top_p_filter(x, top_ps[:, None]), lambda x: x, scaled
+    )
+    probs = jax.nn.softmax(filtered, axis=-1)
+    draft_p = jnp.squeeze(
+        jnp.take_along_axis(probs[:, :draft_len, :], drafts[:, :, None], axis=2), axis=2
+    )                                                                # (B, D)
+    uniform = jax.random.uniform(accept_rng, (batch, draft_len))
+    greedy_row = (temps == 0.0)[:, None]
+    accept = jnp.where(greedy_row, drafts == greedy_tok[:, :draft_len], uniform < draft_p)
+    n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+    pos = n_acc                                                      # (B,) 0..D
+    p_pos = jax.vmap(lambda p, i: p[i])(probs, pos)                  # (B, V)
+    rejected = pos < draft_len
+    draft_at = jax.vmap(lambda d, i: d[jnp.minimum(i, draft_len - 1)])(drafts, pos)
+    vocab_ids = jnp.arange(probs.shape[-1])[None, :]
+    residual = jnp.where(rejected[:, None] & (vocab_ids == draft_at[:, None]), 0.0, p_pos)
+    corrected_sampled = jax.random.categorical(
+        fix_rng, jnp.log(jnp.maximum(residual, 1e-30))
+    ).astype(jnp.int32)
+    corrected_greedy = jax.vmap(lambda g, i: g[i])(greedy_tok, pos)
+    corrected = jnp.where(temps == 0.0, corrected_greedy, corrected_sampled)
+    padded = jnp.concatenate([drafts, jnp.zeros((batch, 1), jnp.int32)], axis=1)
+    emit_ids = jnp.arange(draft_len + 1)[None, :]
+    tokens_round = jnp.where(emit_ids == pos[:, None], corrected[:, None], padded)
+    return tokens_round, n_acc
 
 
 class _SpecCarry(NamedTuple):
@@ -194,44 +247,18 @@ def spec_generate(
             n_acc = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1), axis=1)
             tokens_round = greedy
         else:
-            # rejection sampling against the point-mass n-gram proposal:
-            # accept draft x_i with prob p_i(x_i); the correction at the
-            # first rejection samples the residual (p with x_i zeroed), the
-            # bonus after a full run samples p_D directly
+            # per-row shared verify math (verify_window_tokens is the one
+            # owner of the accept/residual/bonus scheme, shared with the
+            # continuous engine's per-slot mixed-temperature path)
             next_rng, accept_rng, fix_rng = jax.random.split(c.rng, 3)
-            # forward() emits fp32 logits; scaled_logits is the same function
-            # _sample draws from, so acceptance tests use exactly the
-            # distribution plain sampling would
-            probs = jax.nn.softmax(
-                scaled_logits(logits, temperature, top_p, nucleus), axis=-1
-            )                                                           # (B, D+1, V)
-            draft_p = jnp.squeeze(
-                jnp.take_along_axis(probs[:, :draft_len, :], drafts[:, :, None], axis=2),
-                axis=2,
-            )                                                           # (B, D)
-            uniform = jax.random.uniform(accept_rng, (batch, draft_len))
-            accept = uniform < draft_p
-            n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
-            pos = n_acc                                                 # (B,) 0..D
-            p_pos = jax.vmap(lambda p, i: p[i])(probs, pos)             # (B, V)
-            rejected = pos < draft_len
-            draft_at = jax.vmap(lambda d, i: d[jnp.minimum(i, draft_len - 1)])(
-                drafts, pos
+            temps_vec = jnp.full((batch,), temperature, jnp.float32)
+            top_vec = (
+                jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (batch,))
+                if nucleus
+                else jnp.ones((batch,), jnp.float32)
             )
-            vocab_ids = jnp.arange(probs.shape[-1])[None, :]
-            residual = jnp.where(
-                rejected[:, None] & (vocab_ids == draft_at[:, None]), 0.0, p_pos
-            )
-            # categorical is scale-invariant — no renormalization needed
-            corrected = jax.random.categorical(
-                fix_rng, jnp.log(jnp.maximum(residual, 1e-30))
-            ).astype(jnp.int32)                                         # (B,)
-            padded_drafts = jnp.concatenate(
-                [drafts, jnp.zeros((batch, 1), jnp.int32)], axis=1
-            )                                                           # (B, D+1)
-            emit_pos = jnp.arange(draft_len + 1)[None, :]
-            tokens_round = jnp.where(
-                emit_pos == pos[:, None], corrected[:, None], padded_drafts
+            tokens_round, n_acc = verify_window_tokens(
+                logits, drafts, temps_vec, top_vec, accept_rng, fix_rng
             )
 
         # emitted this round: tokens_round[0..n_acc] — accepted drafts + the

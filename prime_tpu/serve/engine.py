@@ -183,6 +183,8 @@ class ContinuousBatchingEngine:
         cache_spec: Any = None,
         attn_impl: str = "auto",
         kv_quant: bool = False,
+        speculative: bool = False,
+        draft_len: int = 4,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -206,6 +208,12 @@ class ContinuousBatchingEngine:
         # dispatch already routes quantized caches to the XLA path
         self.attn_impl = attn_impl
         self.kv_quant = kv_quant
+        # prompt-lookup speculation: each tick proposes draft_len n-gram
+        # drafts per slot (host-side, from the slot's own history) and one
+        # (B, D+1) verify forward replaces draft_len+1 single-token steps
+        self.speculative = speculative
+        self.draft_len = draft_len
+        self._histories: dict[int, list[int]] = {}  # slot -> prompt + decoded
 
         self._dtype = jax.tree_util.tree_leaves(params)[0].dtype
         self._requests: dict[int, EngineRequest] = {}  # slot -> request
@@ -221,6 +229,7 @@ class ContinuousBatchingEngine:
         self._chunk_fn: Any = None
         self._finalize_fn: Any = None
         self._decode_fn: Any = None
+        self._spec_fn: Any = None
         # prompt-prefix KV reuse: newest-last list of (ids, row KVCache) —
         # an admission whose prompt shares a prefix with a recent one copies
         # that staged KV row and only prefills the suffix
@@ -396,6 +405,104 @@ class ContinuousBatchingEngine:
 
         return jax.jit(decode, donate_argnums=(1, 2))
 
+    def _make_spec_decode(self):
+        import jax
+        import jax.numpy as jnp
+
+        from prime_tpu.models.llama import forward
+        from prime_tpu.models.speculative import verify_window_tokens
+
+        config, attn_impl = self.config, self.attn_impl
+        cache_spec = self.cache_spec
+
+        def spec_decode(params, cache, last, temps, top_ps, active, drafts, rng):
+            """One verify pass over (B, D+1) windows at each slot's cache
+            length. Accept/correct math is verify_window_tokens — the one
+            owner shared with models/speculative.spec_generate — with
+            per-slot traced temps mixing greedy and sampled slots in one
+            program."""
+            temps = jnp.where(active, temps, 0.0)
+            top_ps = jnp.where(active, top_ps, 1.0)
+            offsets = cache.lengths
+            window = jnp.concatenate([last[:, None], drafts], axis=1)  # (B, D+1)
+            logits, new_cache = forward(
+                params, window, config, cache=cache, decode=False,
+                attn_impl=attn_impl, prefill_offset=offsets,
+            )
+            if cache_spec is not None:
+                constrained = {
+                    "k": jax.lax.with_sharding_constraint(new_cache.k, cache_spec),
+                    "v": jax.lax.with_sharding_constraint(new_cache.v, cache_spec),
+                }
+                if new_cache.quantized:
+                    constrained["k_scale"] = jax.lax.with_sharding_constraint(
+                        new_cache.k_scale, cache_spec
+                    )
+                    constrained["v_scale"] = jax.lax.with_sharding_constraint(
+                        new_cache.v_scale, cache_spec
+                    )
+                new_cache = new_cache._replace(**constrained)
+
+            rng, accept_rng, fix_rng = jax.random.split(rng, 3)
+            tokens_round, n_acc = verify_window_tokens(
+                logits, drafts, temps, top_ps, accept_rng, fix_rng
+            )
+            run_len = jnp.where(active, n_acc + 1, 0)
+            # forward advanced lengths by the full window; only run_len stay
+            new_cache = new_cache._replace(lengths=offsets + run_len)
+            last_out = jax.vmap(lambda t, i: t[jnp.maximum(i - 1, 0)])(
+                tokens_round, run_len
+            )
+            last_out = jnp.where(active, last_out, last)
+            return new_cache, last_out, tokens_round, run_len
+
+        return jax.jit(spec_decode, donate_argnums=(1, 2))
+
+    def _propose_drafts(self, slot: int) -> list[int]:
+        """Host-side prompt-lookup: copy the tokens after the most recent
+        earlier occurrence of the slot's trailing bigram (n-gram drafting,
+        same scheme as models/speculative.propose_ngram_drafts)."""
+        history = self._histories.get(slot, [])
+        draft_len = self.draft_len
+        if len(history) < 2:
+            return (history[-1:] or [self.pad_id]) * draft_len
+        t0, t1 = history[-2], history[-1]
+        for position in range(len(history) - 3, -1, -1):
+            if history[position] == t0 and history[position + 1] == t1:
+                window = history[position + 2 : position + 2 + draft_len]
+                return window + [t1] * (draft_len - len(window))
+        return [t1] * draft_len
+
+    def _spec_chunk(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if self._spec_fn is None:
+            self._spec_fn = self._make_spec_decode()
+        self._rng, rng = jax.random.split(self._rng)
+        active = jnp.asarray(self._active)
+        # propose only for live slots — the bigram scan is host-side Python
+        # and inactive rows' drafts are ignored anyway (run_len forced to 0)
+        drafts = jnp.asarray(
+            [
+                self._propose_drafts(slot) if self._active[slot] else [self.pad_id] * self.draft_len
+                for slot in range(self.max_slots)
+            ],
+            dtype=jnp.int32,
+        )
+        with self._mesh_ctx():
+            self._cache, self._last, toks, run_len = self._spec_fn(
+                self.params, self._cache, self._last,
+                self._temps, self._top_ps, active, drafts, rng,
+            )
+        toks_host = np.asarray(toks)
+        runs = np.asarray(run_len)
+        for slot in range(self.max_slots):
+            if self._active[slot]:
+                out = toks_host[slot][: int(runs[slot])].tolist()
+                self._histories[slot].extend(out)
+                self._emit(self._requests[slot], out)
+
     # ---- public API ----
 
     def submit(
@@ -407,10 +514,14 @@ class ContinuousBatchingEngine:
     ) -> EngineRequest:
         if not prompt_ids:
             raise ValueError("empty prompt")
-        if len(prompt_ids) + max_new_tokens > self.capacity:
+        # speculation scribbles up to draft_len+1 verify slots past a row's
+        # valid length — the slot must hold them even when every draft lands
+        overhead = self.draft_len + 1 if self.speculative else 0
+        if len(prompt_ids) + max_new_tokens + overhead > self.capacity:
             raise ValueError(
-                f"prompt ({len(prompt_ids)}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds slot capacity ({self.capacity})"
+                f"prompt ({len(prompt_ids)}) + max_new_tokens ({max_new_tokens})"
+                + (f" + verify window ({overhead})" if overhead else "")
+                + f" exceeds slot capacity ({self.capacity})"
             )
         # fail oversized staging rows here, not at admission inside the loop
         row_capacity_for(len(prompt_ids), self.prefill_chunk, self.capacity)
@@ -496,7 +607,10 @@ class ContinuousBatchingEngine:
         if not any(self._active):
             return admitted
         try:
-            self._decode_chunk()
+            if self.speculative:
+                self._spec_chunk()
+            else:
+                self._decode_chunk()
         except Exception as e:  # noqa: BLE001 — a dead engine hangs every client
             # the decode jit donates the cache buffers, so a raised dispatch
             # leaves them invalid: fail the in-flight requests promptly and
@@ -580,6 +694,7 @@ class ContinuousBatchingEngine:
         req.slot = slot
         self._active[slot] = True
         self._requests[slot] = req
+        self._histories[slot] = list(ids) + [int(first)]
         self._emit(req, [int(first)])
 
     # ---- prompt-prefix KV reuse ----
@@ -678,6 +793,7 @@ class ContinuousBatchingEngine:
             if req.slot >= 0:
                 self._active[req.slot] = False
                 self._requests.pop(req.slot, None)
+                self._histories.pop(req.slot, None)
             req.events.put(None)
 
 
@@ -703,7 +819,9 @@ class EngineBackend:
     ) -> EngineRequest:
         ids = self.tokenizer.encode(prompt, add_special_tokens=not templated)
         # keep the tail if the prompt exceeds what the slot can hold
-        keep = self.engine.capacity - max_new_tokens
+        # (speculation reserves draft_len+1 extra verify slots per row)
+        overhead = self.engine.draft_len + 1 if self.engine.speculative else 0
+        keep = self.engine.capacity - max_new_tokens - overhead
         if keep <= 0:
             raise ValueError(
                 f"max_new_tokens ({max_new_tokens}) leaves no room for a "

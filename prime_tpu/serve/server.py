@@ -323,6 +323,8 @@ def serve_model(
     max_slots: int = 8,
     slot_capacity: int = 2048,
     chunk: int = 8,
+    speculative: bool = False,
+    draft_len: int = 4,
 ) -> InferenceServer:
     """Bind the port, then build the (optionally sharded) generator.
 
@@ -343,6 +345,10 @@ def serve_model(
             kv_quant=kv_quant,
             weight_quant=weight_quant,
             adapter=adapter,
+            # the engine drafts per-slot itself; the one-shot generator path
+            # uses spec_generate directly
+            speculative=speculative and not continuous,
+            draft_len=draft_len,
         )
         if continuous:
             from prime_tpu.serve.engine import ContinuousBatchingEngine, EngineBackend
@@ -363,6 +369,8 @@ def serve_model(
                 mesh=generator.mesh,
                 cache_spec=cache_spec,
                 kv_quant=kv_quant,
+                speculative=speculative,
+                draft_len=draft_len,
             )
             engine.start()
             server.generator = EngineBackend(engine, generator.tokenizer)

@@ -233,6 +233,71 @@ def test_prefix_cache_eviction_and_identical_prompt():
     assert r.all_tokens(timeout=1) == reference_tokens(list(p3), 4)
 
 
+# -- speculative continuous decoding ------------------------------------------
+
+
+def test_spec_engine_greedy_matches_plain():
+    """The load-bearing invariant, speculative edition: whatever the drafts
+    do, a greedy request emits exactly the plain engine's tokens."""
+    prompts = [
+        list(range(1, 9)) * 2,           # periodic: drafts land
+        [7, 100, 23, 451, 88, 3],        # aperiodic: drafts mostly miss
+    ]
+    refs = [reference_tokens(p, 12) for p in prompts]
+    engine = make_engine(speculative=True, draft_len=4)
+    reqs = [engine.submit(p, max_new_tokens=12) for p in prompts]
+    for req in reqs:
+        drain(engine, req)
+    for req, ref in zip(reqs, refs):
+        assert req.all_tokens(timeout=1) == ref
+
+
+def test_spec_engine_eos_and_budget():
+    prompt = [5, 9, 301, 42, 77]
+    ref = reference_tokens(prompt, 12)
+    eos = ref[3]
+    engine = make_engine(speculative=True, eos_id=eos)
+    req = engine.submit(prompt, max_new_tokens=12)
+    drain(engine, req)
+    assert req.all_tokens(timeout=1) == ref[:3]
+    # budget: exactly max_new_tokens even when a verify run overshoots
+    engine2 = make_engine(speculative=True)
+    req2 = engine2.submit(list(range(1, 9)) * 2, max_new_tokens=5)
+    drain(engine2, req2)
+    assert len(req2.all_tokens(timeout=1)) == 5
+
+
+def test_spec_engine_mixed_sampling_slots():
+    """A sampled request and a greedy request decode concurrently through
+    the one verify program; the greedy one still matches the reference."""
+    greedy_prompt = list(range(1, 9)) * 2
+    ref = reference_tokens(greedy_prompt, 10)
+    engine = make_engine(speculative=True)
+    sampled = engine.submit([3, 1, 4, 1, 5, 9], max_new_tokens=10, temperature=0.8, top_p=0.9)
+    greedy = engine.submit(greedy_prompt, max_new_tokens=10)
+    drain(engine, sampled)
+    drain(engine, greedy)
+    assert greedy.all_tokens(timeout=1) == ref
+    assert len(sampled.all_tokens(timeout=1)) == 10
+
+
+def test_spec_engine_with_kv_quant():
+    prompt = list(range(1, 9)) * 2
+    plain = make_engine(kv_quant=True)
+    ref_req = plain.submit(prompt, max_new_tokens=10)
+    drain(plain, ref_req)
+    spec = make_engine(kv_quant=True, speculative=True)
+    req = spec.submit(prompt, max_new_tokens=10)
+    drain(spec, req)
+    assert req.all_tokens(timeout=1) == ref_req.all_tokens(timeout=1)
+
+
+def test_spec_engine_capacity_includes_verify_window():
+    engine = make_engine(speculative=True, draft_len=4, capacity=32)
+    with pytest.raises(ValueError, match="verify window"):
+        engine.submit(list(range(1, 17)), max_new_tokens=12)  # 16+12+5 > 32
+
+
 def test_kv_quant_engine_end_to_end():
     """int8-cache engine: requests complete, decode matches the one-shot
     sampler's kv-quant decode closely (prefill differs only by the chunked
