@@ -277,20 +277,23 @@ def main() -> None:
         [1] + [(7 * (i + j)) % 1000 + 3 for j in range(96)] for i in range(n_req)
     ]
 
-    def run_serve(kv_quant: bool) -> float:
+    def run_serve(
+        kv_quant: bool = False, speculative: bool = False, prompts=None
+    ) -> float:
         from prime_tpu.serve.engine import ContinuousBatchingEngine
 
+        prompts = prompts or serve_prompts
         engine = ContinuousBatchingEngine(
             params, config, pad_id=0, max_slots=8, capacity=1024, chunk=8,
-            kv_quant=kv_quant,
+            kv_quant=kv_quant, speculative=speculative,
         )
         try:
             # warmup: compile prefill/decode/finalize for the buckets in play
-            warm = engine.submit(serve_prompts[0], max_new_tokens=req_new)
+            warm = engine.submit(prompts[0], max_new_tokens=req_new)
             while not warm.done:
                 engine.tick()
             t0 = time.perf_counter()
-            reqs = [engine.submit(ids, max_new_tokens=req_new) for ids in serve_prompts]
+            reqs = [engine.submit(ids, max_new_tokens=req_new) for ids in prompts]
             while not all(r.done for r in reqs):
                 engine.tick()
             elapsed = time.perf_counter() - t0
@@ -314,6 +317,21 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         record["serve_int8_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: serve int8 section failed: {e}", flush=True)
+    try:
+        # speculative engine on genuinely PERIODIC prompts (the favorable
+        # regime: continuations repeat the cycle, so n-gram drafts land and
+        # each verify pass emits several tokens) — the default serve_prompts
+        # are an arithmetic progression with no repeated bigrams
+        periodic = [
+            [1] + list(range(3 + i, 11 + i)) * 12 for i in range(n_req)
+        ]
+        record["serve_spec_tok_s"] = round(
+            run_serve(speculative=True, prompts=periodic), 1
+        )
+        print(f"# bench: serve speculative {record['serve_spec_tok_s']} tok/s", flush=True)
+    except Exception as e:  # noqa: BLE001
+        record["serve_spec_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: serve speculative section failed: {e}", flush=True)
 
     # ---- quant: int8 weights / int8 KV --------------------------------------
     try:
