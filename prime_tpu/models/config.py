@@ -61,6 +61,10 @@ class ModelConfig:
     # high_freq_factor, original_max_position). Mutually exclusive with
     # rope_scale; tuple-typed so the config stays hashable for jit
     rope_llama3: tuple[float, float, float, float] | None = None
+    # YaRN NTK-by-parts scaling: (factor, beta_fast, beta_slow,
+    # original_max_position, attention_factor) — attention_factor resolved at
+    # load (incl. mscale variants) so model code just scales the tables
+    rope_yarn: tuple[float, float, float, float, float] | None = None
     # mixture-of-experts (0 experts = dense MLP; Mixtral-style top-k routing)
     n_experts: int = 0
     experts_per_token: int = 2

@@ -132,6 +132,7 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
     else:
         rope_type, rope_factor = "linear", 1.0
     rope_llama3 = None
+    rope_yarn = None
     if rope_type == "default":  # HF's explicit no-scaling marker
         rope_factor = 1.0
     elif rope_type == "llama3" and rope_scaling:
@@ -144,9 +145,45 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
             float(rope_scaling.get("original_max_position_embeddings", 8192) or 8192),
         )
         rope_factor = 1.0
+    elif rope_type == "yarn" and rope_scaling:
+        import math
+
+        # HF treats ANY falsy truncate (false, null, 0) as non-truncating;
+        # mirror that truthiness or a "truncate": null config would load
+        # with silently divergent correction bounds
+        if not rope_scaling.get("truncate", True):
+            raise ValueError(
+                "yarn rope_scaling with a falsy truncate is not supported "
+                "(the correction range would differ from the tables built here)"
+            )
+
+        def mscale_of(scale: float, m: float = 1.0) -> float:
+            return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+        attention_factor = rope_scaling.get("attention_factor")
+        mscale = rope_scaling.get("mscale")
+        mscale_all_dim = rope_scaling.get("mscale_all_dim")
+        if attention_factor is None:
+            if mscale and mscale_all_dim:
+                attention_factor = mscale_of(rope_factor, mscale) / mscale_of(
+                    rope_factor, mscale_all_dim
+                )
+            else:
+                attention_factor = mscale_of(rope_factor)
+        rope_yarn = (
+            rope_factor,
+            float(rope_scaling.get("beta_fast") or 32.0),
+            float(rope_scaling.get("beta_slow") or 1.0),
+            float(
+                rope_scaling.get("original_max_position_embeddings")
+                or getattr(hf_config, "max_position_embeddings", 8192)
+            ),
+            float(attention_factor),
+        )
+        rope_factor = 1.0
     elif rope_scaling and rope_type != "linear":
         raise ValueError(
-            f"Unsupported rope_scaling type {rope_type!r} (linear/llama3 only); "
+            f"Unsupported rope_scaling type {rope_type!r} (linear/llama3/yarn only); "
             "loading would silently distort long-range attention"
         )
     if gemma3:
@@ -193,6 +230,7 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         ),
         rope_scale=rope_factor,
         rope_llama3=rope_llama3,
+        rope_yarn=rope_yarn,
         name=name,
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,

@@ -396,7 +396,7 @@ def forward(
     max_pos = cache.capacity if cache is not None else max(seq, config.max_seq_len)
     rope_tables = rope_frequencies(
         config.head_dim, max_pos, config.rope_theta,
-        scale=config.rope_scale, llama3=config.rope_llama3,
+        scale=config.rope_scale, llama3=config.rope_llama3, yarn=config.rope_yarn,
     )
     # Gemma3: local (sliding) layers use an unscaled short-range frequency
     rope_tables_local = (

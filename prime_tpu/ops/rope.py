@@ -32,29 +32,79 @@ def llama3_inv_freq(
     return jnp.where(medium, smoothed, scaled)
 
 
+def yarn_inv_freq(
+    inv_freq: jnp.ndarray,
+    head_dim: int,
+    theta: float,
+    factor: float,
+    beta_fast: float,
+    beta_slow: float,
+    original_max_position: float,
+) -> jnp.ndarray:
+    """YaRN NTK-by-parts frequencies (https://huggingface.co/papers/2309.00071,
+    HF ``rope_type: yarn``): fast-rotating dims keep their pretrained
+    frequencies (extrapolation), slow dims interpolate by ``factor``, and a
+    linear ramp between the beta_fast/beta_slow correction dims blends them.
+    The companion attention temperature is applied to the cos/sin tables by
+    the caller (scaling both scales q·k by its square)."""
+    import math
+
+    half = head_dim // 2
+    inv_extrapolation = inv_freq
+    inv_interpolation = inv_freq / factor
+
+    def correction_dim(num_rotations: float) -> float:
+        return (
+            head_dim
+            * math.log(original_max_position / (num_rotations * 2 * math.pi))
+        ) / (2 * math.log(theta))
+
+    low = max(math.floor(correction_dim(beta_fast)), 0)
+    high = min(math.ceil(correction_dim(beta_slow)), head_dim - 1)
+    if low == high:
+        high += 0.001  # prevent singularity (HF's guard)
+    ramp = jnp.clip(
+        (jnp.arange(half, dtype=jnp.float32) - low) / (high - low), 0.0, 1.0
+    )
+    extrapolation_factor = 1.0 - ramp
+    return (
+        inv_interpolation * (1.0 - extrapolation_factor)
+        + inv_extrapolation * extrapolation_factor
+    )
+
+
 def rope_frequencies(
     head_dim: int,
     max_positions: int,
     theta: float = 500000.0,
     scale: float = 1.0,
     llama3: tuple[float, float, float, float] | None = None,
+    yarn: tuple[float, float, float, float, float] | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Return (cos, sin) tables of shape (max_positions, head_dim // 2), float32.
 
     ``scale`` > 1 applies linear position scaling (positions stretched by the
     factor — HF ``rope_scaling {"rope_type": "linear"}``, e.g. Gemma3 4b+).
     ``llama3`` = (factor, low_freq_factor, high_freq_factor,
-    original_max_position) applies Llama 3.1+ frequency-dependent scaling
-    instead (mutually exclusive with ``scale``).
+    original_max_position) applies Llama 3.1+ frequency-dependent scaling.
+    ``yarn`` = (factor, beta_fast, beta_slow, original_max_position,
+    attention_factor) applies YaRN NTK-by-parts scaling with its attention
+    temperature folded into the tables. The three are mutually exclusive.
     """
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    attention_factor = 1.0
     if llama3 is not None:
         inv_freq = llama3_inv_freq(inv_freq, *llama3)
+    elif yarn is not None:
+        factor, beta_fast, beta_slow, original_max, attention_factor = yarn
+        inv_freq = yarn_inv_freq(
+            inv_freq, head_dim, theta, factor, beta_fast, beta_slow, original_max
+        )
     elif scale != 1.0:
         inv_freq = inv_freq / scale
     positions = jnp.arange(max_positions, dtype=jnp.float32)
     angles = jnp.outer(positions, inv_freq)  # (P, D/2)
-    return jnp.cos(angles), jnp.sin(angles)
+    return jnp.cos(angles) * attention_factor, jnp.sin(angles) * attention_factor
 
 
 def apply_rope(

@@ -110,7 +110,7 @@ def pipeline_forward(
     rope_tables = rope_frequencies(
         config.head_dim, max(seq, config.max_seq_len), config.rope_theta,
         # must match forward()'s rope math exactly
-        scale=config.rope_scale, llama3=config.rope_llama3,
+        scale=config.rope_scale, llama3=config.rope_llama3, yarn=config.rope_yarn,
     )
 
     layer_specs = pipeline_param_specs(config)["layers"]

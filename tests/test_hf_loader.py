@@ -844,6 +844,61 @@ def test_llama3_rope_scaling_logits_match_transformers():
     np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=5e-4, atol=5e-4)
 
 
+def test_yarn_rope_scaling_logits_match_transformers():
+    """YaRN (NTK-by-parts + attention temperature) checkpoints — Qwen
+    long-context releases, GPT-OSS-style configs — must reproduce
+    transformers' frequencies AND the cos/sin attention factor exactly,
+    past the original pretraining window."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rope_theta=10000.0,
+        rope_scaling={
+            "rope_type": "yarn",
+            "factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+        attn_implementation="eager",
+    )
+    torch.manual_seed(31)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    config = config_from_hf(model.config, name="tiny-yarn")
+    factor, beta_fast, beta_slow, orig, att = config.rope_yarn
+    assert (factor, beta_fast, beta_slow, orig) == (4.0, 32.0, 1.0, 64.0)
+    assert att == pytest.approx(0.1 * np.log(4.0) + 1.0)
+    state = {k: v.float().numpy() for k, v in model.state_dict().items()}
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+
+    # 100 tokens > original window 64: interpolated dims genuinely bite
+    tokens = np.arange(5, 105, dtype=np.int32)[None, :] % 256
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    our_logits, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=5e-4, atol=5e-4)
+
+
+def test_yarn_truncate_false_rejected():
+    from prime_tpu.models.hf_loader import config_from_hf
+
+    class Cfg:
+        model_type = "llama"
+        vocab_size = 128
+        hidden_size = 64
+        num_hidden_layers = 2
+        num_attention_heads = 4
+        intermediate_size = 128
+        rope_scaling = {"rope_type": "yarn", "factor": 4.0, "truncate": False}
+
+    with pytest.raises(ValueError, match="truncate"):
+        config_from_hf(Cfg())
+
+
 def test_rope_scaling_default_accepted_and_long_context_capped():
     """HF's rope_scaling {"rope_type": "default"} means unscaled — it must
     load; non-linear types must not. max_position_embeddings is capped at 32k
@@ -868,6 +923,9 @@ def test_rope_scaling_default_accepted_and_long_context_capped():
     assert config_from_hf(Cfg()).rope_scale == 4.0
 
     Cfg.rope_scaling = {"rope_type": "yarn", "factor": 4.0}
+    assert config_from_hf(Cfg()).rope_yarn is not None  # yarn now supported
+
+    Cfg.rope_scaling = {"rope_type": "longrope", "factor": 4.0}
     with pytest.raises(ValueError, match="rope_scaling"):
         config_from_hf(Cfg())
 
