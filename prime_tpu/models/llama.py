@@ -182,6 +182,26 @@ def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Para
     return params
 
 
+def sliding_layer_flags(config: ModelConfig) -> jnp.ndarray:
+    """(n_layers,) bool: which layers use the sliding window. The pattern is
+    an explicit config field so non-Gemma2 window schemes can't silently
+    inherit the even alternation. Shared by forward() and the pipeline (where
+    the flags shard over pp alongside the layer stack)."""
+    if not config.sliding_window:
+        return jnp.zeros((config.n_layers,), dtype=bool)
+    if config.sliding_pattern == "even":  # Gemma2: even layers slide
+        return jnp.arange(config.n_layers) % 2 == 0
+    if config.sliding_pattern == "uniform":  # Mistral-style: all layers slide
+        return jnp.ones((config.n_layers,), dtype=bool)
+    if config.sliding_pattern.endswith(":1"):  # Gemma3 "5:1": every (N+1)th is global
+        period = int(config.sliding_pattern[:-2]) + 1
+        return (jnp.arange(config.n_layers) + 1) % period != 0
+    raise ValueError(
+        f"Unknown sliding_pattern {config.sliding_pattern!r} "
+        "(want 'even' | 'uniform' | 'N:1')"
+    )
+
+
 def _attention_block(
     x: jnp.ndarray,               # (B, S, D)
     lp: Params,                   # one layer's params
@@ -415,20 +435,7 @@ def forward(
     # Per-layer sliding flag rides the scan so one compiled body serves both
     # kinds. The pattern is an explicit config field (ModelConfig.sliding_pattern)
     # so non-Gemma2 window schemes can't silently inherit the even alternation.
-    if not config.sliding_window:
-        sliding_flags = jnp.zeros((config.n_layers,), dtype=bool)
-    elif config.sliding_pattern == "even":  # Gemma2: even layers slide
-        sliding_flags = jnp.arange(config.n_layers) % 2 == 0
-    elif config.sliding_pattern == "uniform":  # Mistral-style: all layers slide
-        sliding_flags = jnp.ones((config.n_layers,), dtype=bool)
-    elif config.sliding_pattern.endswith(":1"):  # Gemma3 "5:1": every (N+1)th is global
-        period = int(config.sliding_pattern[:-2]) + 1
-        sliding_flags = (jnp.arange(config.n_layers) + 1) % period != 0
-    else:
-        raise ValueError(
-            f"Unknown sliding_pattern {config.sliding_pattern!r} "
-            "(want 'even' | 'uniform' | 'N:1')"
-        )
+    sliding_flags = sliding_layer_flags(config)
 
     quantized = cache is not None and cache.quantized
 

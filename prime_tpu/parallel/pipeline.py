@@ -33,13 +33,6 @@ def pipeline_param_specs(config: ModelConfig) -> dict:
     """Like sharding.param_specs but stages the layer stack over pp."""
     if config.is_moe:
         raise NotImplementedError("pipeline parallelism currently covers dense configs")
-    if config.sliding_window:
-        # per-layer sliding flags are indexed globally; the staged scan only
-        # sees local layer indices, so alternating-window configs need the
-        # stage offset threaded through before they can pipeline
-        raise NotImplementedError(
-            "pipeline parallelism does not cover sliding-window configs yet"
-        )
     layer_spec = {
         "wq": P("pp", None, None),
         "wk": P("pp", None, None),
@@ -71,18 +64,26 @@ def pipeline_param_specs(config: ModelConfig) -> dict:
     return specs
 
 
-def _stage_forward(layers_local, x, positions, rope_tables, config: ModelConfig):
-    """Run this device's contiguous stage of layers (scan, no cache)."""
+def _stage_forward(
+    layers_local, sliding_local, x, positions, rope_tables, rope_tables_local,
+    config: ModelConfig,
+):
+    """Run this device's contiguous stage of layers (scan, no cache). The
+    per-layer sliding flags ride the scan exactly like in forward() — they
+    were computed GLOBALLY and sharded over pp with the layer stack, so an
+    alternating-window schedule stays aligned across stages."""
     from prime_tpu.models.llama import _attention_block, _mlp_block
 
-    def layer_fn(x, lp):
+    def layer_fn(x, scanned):
+        lp, sliding = scanned
         x, _, _, _, _ = _attention_block(
-            x, lp, positions, rope_tables, config, None, None, None, False, "xla"
+            x, lp, positions, rope_tables, config, None, None, None, False, "xla",
+            sliding=sliding, rope_tables_local=rope_tables_local,
         )
         x, _ = _mlp_block(x, lp, config)
         return x, None
 
-    x, _ = jax.lax.scan(layer_fn, x, layers_local)
+    x, _ = jax.lax.scan(layer_fn, x, (layers_local, sliding_local))
     return x
 
 
@@ -112,16 +113,24 @@ def pipeline_forward(
         # must match forward()'s rope math exactly
         scale=config.rope_scale, llama3=config.rope_llama3, yarn=config.rope_yarn,
     )
+    rope_tables_local = (
+        rope_frequencies(config.head_dim, max(seq, config.max_seq_len), config.rope_local_theta)
+        if config.rope_local_theta is not None
+        else None
+    )
+    from prime_tpu.models.llama import sliding_layer_flags
+
+    sliding_flags = sliding_layer_flags(config)  # (L,), stages over pp below
 
     layer_specs = pipeline_param_specs(config)["layers"]
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(layer_specs, P()),
+        in_specs=(layer_specs, P("pp"), P()),
         out_specs=P(),
     )
-    def run_pipeline(layers_local, x_mb):
+    def run_pipeline(layers_local, sliding_local, x_mb):
         stage_index = jax.lax.axis_index("pp")
         perm = [(i, i + 1) for i in range(stages - 1)]  # forward shift, no wraparound
 
@@ -130,7 +139,10 @@ def pipeline_forward(
             mb_in = jnp.clip(t, 0, n_microbatches - 1)
             fresh = jax.lax.dynamic_index_in_dim(x_mb, mb_in, axis=0, keepdims=False)
             x_in = jnp.where(stage_index == 0, fresh, state)
-            y = _stage_forward(layers_local, x_in, positions, rope_tables, config)
+            y = _stage_forward(
+                layers_local, sliding_local, x_in, positions, rope_tables,
+                rope_tables_local, config,
+            )
             # the last stage finishes microbatch t-(P-1) at tick t
             mb_out = t - (stages - 1)
             collect = (stage_index == stages - 1) & (mb_out >= 0) & (mb_out < n_microbatches)
@@ -151,7 +163,7 @@ def pipeline_forward(
         # only the last stage holds real outputs; psum broadcasts them to all
         return jax.lax.psum(jnp.where(stage_index == stages - 1, outs, 0.0), "pp")
 
-    hidden = run_pipeline(params["layers"], x_mb)      # (M, mb, S, D)
+    hidden = run_pipeline(params["layers"], sliding_flags, x_mb)  # (M, mb, S, D)
     hidden = hidden.reshape(batch, seq, -1)
     hidden = rms_norm(
         hidden, params["final_norm"], config.rms_eps, plus_one=config.norm_plus_one

@@ -103,12 +103,14 @@ def test_pipeline_validates_divisibility(params):
 
 
 def test_pipeline_forward_matches_dense_gemma_style():
-    """Gemma knobs (GeGLU, (1+w) norms, post-norms, scaled embed, softcaps)
-    must produce identical logits through the pipeline schedule. Sliding
-    window stays rejected (per-layer flags are globally indexed)."""
+    """Gemma knobs (GeGLU, (1+w) norms, post-norms, scaled embed, softcaps,
+    and the even sliding/global alternation whose per-layer flags must stay
+    GLOBALLY indexed across stage boundaries) must produce identical logits
+    through the pipeline schedule."""
     cfg = CFG.scaled(
         name="tiny-gemma-pp", act="gelu_tanh", norm_plus_one=True, post_norms=True,
         scale_embed=True, attn_softcap=50.0, final_softcap=30.0, query_scale=24,
+        sliding_window=4,  # seq 16 > window 4: sliding layers genuinely differ
     )
     gparams = init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
     tokens = jax.random.randint(jax.random.PRNGKey(4), (4, 16), 0, cfg.vocab_size)
@@ -118,6 +120,22 @@ def test_pipeline_forward_matches_dense_gemma_style():
     out = pipeline_forward(staged, tokens, cfg, mesh, n_microbatches=2)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
-    sliding_cfg = cfg.scaled(sliding_window=4)
-    with pytest.raises(NotImplementedError, match="sliding-window"):
-        shard_pipeline_params(gparams, make_mesh({"pp": 2}, devices=jax.devices()[:2]), sliding_cfg)
+
+def test_pipeline_forward_matches_dense_gemma3_style():
+    """Gemma3's 5:1 schedule + dual-frequency rope (local theta selected by
+    the traced flag) through the pipeline: with 4 layers and pattern '3:1',
+    the global layer sits at index 3 — in the SECOND stage, so a local
+    (stage-relative) flag indexing would compute it wrong."""
+    cfg = CFG.scaled(
+        name="tiny-g3-pp", act="gelu_tanh", norm_plus_one=True, post_norms=True,
+        scale_embed=True, qk_norm=True, query_scale=24,
+        sliding_window=4, sliding_pattern="3:1",
+        rope_theta=1000000.0, rope_local_theta=10000.0, rope_scale=8.0,
+    )
+    gparams = init_params(jax.random.PRNGKey(5), cfg, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (4, 16), 0, cfg.vocab_size)
+    ref, _ = forward(gparams, tokens, cfg, attn_impl="xla")
+    mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    staged = shard_pipeline_params(gparams, mesh, cfg)
+    out = pipeline_forward(staged, tokens, cfg, mesh, n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
