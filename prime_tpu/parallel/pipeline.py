@@ -32,15 +32,24 @@ from prime_tpu.ops.rope import rope_frequencies
 def pipeline_param_specs(config: ModelConfig) -> dict:
     """Like sharding.param_specs but stages the layer stack over pp."""
     if config.is_moe:
-        raise NotImplementedError("pipeline parallelism currently covers dense configs")
+        mlp_spec = {
+            "router": P("pp", None, None),
+            "w_gate": P("pp", None, None, None),
+            "w_up": P("pp", None, None, None),
+            "w_down": P("pp", None, None, None),
+        }
+    else:
+        mlp_spec = {
+            "w_gate": P("pp", None, None),
+            "w_up": P("pp", None, None),
+            "w_down": P("pp", None, None),
+        }
     layer_spec = {
         "wq": P("pp", None, None),
         "wk": P("pp", None, None),
         "wv": P("pp", None, None),
         "wo": P("pp", None, None),
-        "w_gate": P("pp", None, None),
-        "w_up": P("pp", None, None),
-        "w_down": P("pp", None, None),
+        **mlp_spec,
     }
     if config.pre_norms:
         layer_spec |= {"attn_norm": P("pp", None), "mlp_norm": P("pp", None)}
@@ -74,17 +83,23 @@ def _stage_forward(
     alternating-window schedule stays aligned across stages."""
     from prime_tpu.models.llama import _attention_block, _mlp_block
 
-    def layer_fn(x, scanned):
+    def layer_fn(carry, scanned):
+        x, aux_sum = carry
         lp, sliding = scanned
         x, _, _, _, _ = _attention_block(
             x, lp, positions, rope_tables, config, None, None, None, False, "xla",
             sliding=sliding, rope_tables_local=rope_tables_local,
         )
-        x, _ = _mlp_block(x, lp, config)
-        return x, None
+        x, aux = _mlp_block(x, lp, config)
+        return (x, aux_sum + aux), None
 
-    x, _ = jax.lax.scan(layer_fn, x, (layers_local, sliding_local))
-    return x
+    # runs inside run_pipeline's shard_map: the zero init must carry the same
+    # pp-varying marker the scanned layer params give the aux output
+    aux_zero = jax.lax.pcast(jnp.zeros((), jnp.float32), ("pp",), to="varying")
+    (x, aux_total), _ = jax.lax.scan(
+        layer_fn, (x, aux_zero), (layers_local, sliding_local)
+    )
+    return x, aux_total
 
 
 def pipeline_forward(
@@ -93,8 +108,10 @@ def pipeline_forward(
     config: ModelConfig,
     mesh,
     n_microbatches: int,
+    return_aux: bool = False,
 ) -> jnp.ndarray:
-    """Pipelined training forward. Returns logits (B, S, V) fp32."""
+    """Pipelined training forward. Returns logits (B, S, V) fp32 (plus the
+    microbatch-averaged MoE load-balance aux when ``return_aux``)."""
     stages = mesh.shape["pp"]
     if config.n_layers % stages:
         raise ValueError(f"n_layers={config.n_layers} must divide into pp={stages} stages")
@@ -128,21 +145,27 @@ def pipeline_forward(
         jax.shard_map,
         mesh=mesh,
         in_specs=(layer_specs, P("pp"), P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
     )
     def run_pipeline(layers_local, sliding_local, x_mb):
         stage_index = jax.lax.axis_index("pp")
         perm = [(i, i + 1) for i in range(stages - 1)]  # forward shift, no wraparound
 
         def tick(t, carry):
-            state, outs = carry
+            state, outs, aux_acc = carry
             mb_in = jnp.clip(t, 0, n_microbatches - 1)
             fresh = jax.lax.dynamic_index_in_dim(x_mb, mb_in, axis=0, keepdims=False)
             x_in = jnp.where(stage_index == 0, fresh, state)
-            y = _stage_forward(
+            y, aux = _stage_forward(
                 layers_local, sliding_local, x_in, positions, rope_tables,
                 rope_tables_local, config,
             )
+            # this stage processes microbatch t - stage_index at tick t; aux
+            # from bubble ticks (garbage inputs outside that range) must not
+            # pollute the MoE load-balance signal
+            mb_here = t - stage_index
+            real = (mb_here >= 0) & (mb_here < n_microbatches)
+            aux_acc = aux_acc + jnp.where(real, aux, 0.0)
             # the last stage finishes microbatch t-(P-1) at tick t
             mb_out = t - (stages - 1)
             collect = (stage_index == stages - 1) & (mb_out >= 0) & (mb_out < n_microbatches)
@@ -153,17 +176,24 @@ def pipeline_forward(
                 state = jax.lax.ppermute(y, "pp", perm)
             else:
                 state = y
-            return state, outs
+            return state, outs, aux_acc
 
         # mark the zero carries as pp-varying so the loop carry types match
         # the ppermute/masked outputs (jax's manual-axes varying tracking)
         state0 = jax.lax.pcast(jnp.zeros_like(x_mb[0]), ("pp",), to="varying")
         outs0 = jax.lax.pcast(jnp.zeros_like(x_mb), ("pp",), to="varying")
-        _, outs = jax.lax.fori_loop(0, n_microbatches + stages - 1, tick, (state0, outs0))
+        aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ("pp",), to="varying")
+        _, outs, aux_acc = jax.lax.fori_loop(
+            0, n_microbatches + stages - 1, tick, (state0, outs0, aux0)
+        )
         # only the last stage holds real outputs; psum broadcasts them to all
-        return jax.lax.psum(jnp.where(stage_index == stages - 1, outs, 0.0), "pp")
+        # (aux sums every stage's layers — the same sum-over-layers forward()
+        # returns — averaged over microbatches)
+        logits_all = jax.lax.psum(jnp.where(stage_index == stages - 1, outs, 0.0), "pp")
+        aux_all = jax.lax.psum(aux_acc, "pp") / n_microbatches
+        return logits_all, aux_all
 
-    hidden = run_pipeline(params["layers"], sliding_flags, x_mb)  # (M, mb, S, D)
+    hidden, aux_total = run_pipeline(params["layers"], sliding_flags, x_mb)  # (M, mb, S, D)
     hidden = hidden.reshape(batch, seq, -1)
     hidden = rms_norm(
         hidden, params["final_norm"], config.rms_eps, plus_one=config.norm_plus_one
@@ -171,7 +201,8 @@ def pipeline_forward(
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
     from prime_tpu.ops.attention import _apply_softcap
 
-    return _apply_softcap((hidden @ head).astype(jnp.float32), config.final_softcap)
+    logits = _apply_softcap((hidden @ head).astype(jnp.float32), config.final_softcap)
+    return (logits, aux_total) if return_aux else logits
 
 
 def make_pipeline_train_step(
@@ -179,12 +210,18 @@ def make_pipeline_train_step(
     optimizer,
     mesh,
     n_microbatches: int,
+    aux_weight: float = 0.01,   # MoE load-balance loss weight (Switch default)
 ):
     """Jitted pipelined train step (params staged over pp via
     shard_pipeline_params). Same contract as trainer.make_train_step."""
     from prime_tpu.train.trainer import TrainState, apply_gradients, cross_entropy_loss
 
     def loss_fn(params, tokens, targets, mask):
+        if config.is_moe:
+            logits, aux = pipeline_forward(
+                params, tokens, config, mesh, n_microbatches, return_aux=True
+            )
+            return cross_entropy_loss(logits, targets, mask) + aux_weight * aux
         logits = pipeline_forward(params, tokens, config, mesh, n_microbatches)
         return cross_entropy_loss(logits, targets, mask)
 
