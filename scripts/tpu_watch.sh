@@ -1,23 +1,102 @@
 #!/bin/bash
 # TPU reachability watcher: probe the axon backend every ~3 min, log results.
-# When the tunnel is up, /tmp/tpu_watch.log shows "UP" lines — bench then.
+# When the tunnel is up, /tmp/tpu_watch.log shows "UP" lines.
+#
+# Opportunistic bench (round 4): on the FIRST successful probe, run
+# `python bench.py` immediately and commit the captured record as
+# BENCH_opportunistic_r04.json plus a BASELINE.md row — the tunnel was down
+# for the entire round-3 builder window, so a single UP window anywhere in
+# the round must yield a durable number even if the end-of-round window is
+# down again. Only a NONZERO headline is committed; a 0.0 abort (tunnel
+# flapped between probe and bench) leaves no marker so a later UP window
+# retries. After a successful capture the watcher keeps logging.
+#
+# Env overrides (for end-to-end testing of this script):
+#   TPU_WATCH_REPO   repo to commit into        (default /root/repo)
+#   TPU_WATCH_LOG    log path                   (default /tmp/tpu_watch.log)
+#   TPU_WATCH_PROBE  probe command              (default: inline jax matmul)
+#   TPU_WATCH_SLEEP  seconds between probes     (default 160)
+#
 # NOTE: rc must come from `timeout python`, NOT a pipeline tail (a piped rc
 # is the last command's — it reported false UPs for a hung backend).
-LOG=/tmp/tpu_watch.log
-echo "$(date -u +%H:%M:%S) watcher start" >> "$LOG"
-while true; do
-  t0=$(date +%s)
-  out=$(timeout 200 python -c "
+LOG=${TPU_WATCH_LOG:-/tmp/tpu_watch.log}
+REPO=${TPU_WATCH_REPO:-/root/repo}
+SLEEP=${TPU_WATCH_SLEEP:-160}
+OPP="$REPO/BENCH_opportunistic_r04.json"
+# startup reconciliation: a crash between writing the marker and the commit
+# landing leaves an uncommitted marker that would block every future
+# capture — if the marker isn't in the git index, drop it and re-capture
+if [ -e "$OPP" ] && ! git -C "$REPO" ls-files --error-unmatch \
+    BENCH_opportunistic_r04.json >/dev/null 2>&1; then
+  rm -f "$OPP"
+fi
+probe() {
+  if [ -n "$TPU_WATCH_PROBE" ]; then
+    timeout 200 bash -c "$TPU_WATCH_PROBE" 2>&1
+  else
+    timeout 200 python -c "
 import jax, jax.numpy as jnp
 x = jnp.ones((256,256))
 print('PROBE_OK', float(jnp.sum(x@x)), jax.devices())
-" 2>&1)
+" 2>&1
+  fi
+}
+echo "$(date -u +%H:%M:%S) watcher start" >> "$LOG"
+while true; do
+  t0=$(date +%s)
+  out=$(probe)
   rc=$?
   t1=$(date +%s)
   if [ $rc -eq 0 ] && echo "$out" | grep -q PROBE_OK; then
     echo "$(date -u +%H:%M:%S) UP ($((t1-t0))s): $(echo "$out" | grep PROBE_OK)" >> "$LOG"
+    if [ ! -e "$OPP" ] && ! pgrep -f 'bench\.py$' >/dev/null; then
+      # (pgrep guard: if the DRIVER's bench is already running, starting ours
+      # would sweep-kill it mid-measurement — defer to the next UP probe. The
+      # pattern matches any cmdline ENDING in bench.py, the same breadth as
+      # bench.py's own sweep signature, so `python3 bench.py` or an absolute
+      # path also defers)
+      echo "$(date -u +%H:%M:%S) OPPORTUNISTIC BENCH starting" >> "$LOG"
+      # bench.py's stray-holder sweep protects its ancestors (this shell),
+      # so running it from here is safe; 45 min cap covers all sections.
+      (cd "$REPO" && timeout 2700 python bench.py \
+        > /tmp/bench_opp.out 2> /tmp/bench_opp.err)
+      brc=$?
+      # last JSON line wins (same contract as the driver); validate in a
+      # TEMP file first — the marker only appears once a real number exists,
+      # so a kill mid-capture can't strand a marker that blocks retries
+      TMP=/tmp/bench_opp_record.json
+      grep '^{' /tmp/bench_opp.out | tail -1 > "$TMP"
+      val=$(python -c "import json;print(json.load(open('$TMP'))['value'])" 2>/dev/null)
+      # commit only a real measurement: a 0.0 abort means the tunnel flapped
+      # between the probe and the bench — retry on the next UP window
+      if [ -n "$val" ] && python -c "exit(0 if float('$val') > 0 else 1)" 2>/dev/null; then
+        cp "$TMP" "$OPP"
+        {
+          echo ""
+          echo "### Opportunistic capture $(date -u +%Y-%m-%dT%H:%M:%SZ) (round 4 watcher)"
+          echo ""
+          echo "Tunnel-UP window caught by scripts/tpu_watch.sh; full record in"
+          echo "\`BENCH_opportunistic_r04.json\` (headline decode: ${val} tok/s)."
+        } >> "$REPO/BASELINE.md"
+        # pathspec after `--` restricts the commit to these two files even
+        # if the operator has unrelated changes staged in the index
+        if (cd "$REPO" && git add BENCH_opportunistic_r04.json BASELINE.md \
+          && git commit -q -m "Capture opportunistic TPU bench during UP window (headline ${val} tok/s)" \
+               -- BENCH_opportunistic_r04.json BASELINE.md); then
+          echo "$(date -u +%H:%M:%S) OPPORTUNISTIC BENCH done rc=$brc value=$val (committed)" >> "$LOG"
+        else
+          # commit failed (index.lock, hook, ...): drop the marker so the
+          # next UP window re-captures; the duplicate BASELINE.md row a
+          # retry appends is timestamped and harmless
+          rm -f "$OPP"
+          echo "$(date -u +%H:%M:%S) OPPORTUNISTIC BENCH value=$val but git commit FAILED (will retry)" >> "$LOG"
+        fi
+      else
+        echo "$(date -u +%H:%M:%S) OPPORTUNISTIC BENCH no usable number rc=$brc value='$val' (will retry; see /tmp/bench_opp.err)" >> "$LOG"
+      fi
+    fi
   else
     echo "$(date -u +%H:%M:%S) DOWN rc=$rc ($((t1-t0))s)" >> "$LOG"
   fi
-  sleep 160
+  sleep "$SLEEP"
 done
