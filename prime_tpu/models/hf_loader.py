@@ -192,6 +192,13 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         sliding_pattern = "even"
     else:
         sliding_pattern = "uniform"
+    # sparse MoE: Mixtral names the count num_local_experts, Qwen3-MoE
+    # num_experts; nonzero is THE MoE signal (capacity_factor keys off it too)
+    n_experts = (
+        getattr(hf_config, "num_local_experts", 0)
+        or getattr(hf_config, "num_experts", 0)
+        or 0
+    )
     return ModelConfig(
         head_dim_override=(
             explicit_head_dim if explicit_head_dim not in (None, derived_head_dim) else None
@@ -254,14 +261,9 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         # Gemma's config default ties embeddings, so checkpoints omit the key
         # from config.json; Llama-family defaults to untied
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", gemma),
-        # sparse MoE: Mixtral names the count num_local_experts, Qwen3-MoE
-        # num_experts; Qwen3-MoE checkpoints also choose whether top-k gates
-        # renormalize (norm_topk_prob)
-        n_experts=(
-            getattr(hf_config, "num_local_experts", 0)
-            or getattr(hf_config, "num_experts", 0)
-            or 0
-        ),
+        # (Qwen3-MoE checkpoints also choose whether top-k gates
+        # renormalize, norm_topk_prob below)
+        n_experts=n_experts,
         # fallbacks track each family's OWN transformers defaults: a pared
         # config.json that omits a key must load with the math transformers
         # would use, not this loader's preference
@@ -270,6 +272,13 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
             or (8 if model_type == "qwen3_moe" else 2)
         ),
         norm_topk=bool(getattr(hf_config, "norm_topk_prob", model_type != "qwen3_moe")),
+        # HF routing is dropless; this stack's capacity routing drops tokens
+        # above capacity_factor. Any HF MoE checkpoint (keyed off n_experts,
+        # not a second model-type list a future MoE family could miss) gets
+        # the same 2.0 headroom the hand-written presets use, or routing
+        # imbalance silently zeroes dropped tokens' expert output (advisor
+        # r3). Dense models keep the ModelConfig default by omission.
+        **({"capacity_factor": 2.0} if n_experts else {}),
     )
 
 

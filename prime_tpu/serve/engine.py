@@ -214,6 +214,13 @@ class ContinuousBatchingEngine:
         self.speculative = speculative
         self.draft_len = draft_len
         self._histories: dict[int, list[int]] = {}  # slot -> prompt + decoded
+        # slot -> {(t0, t1) -> latest position p with history[p:p+2] == (t0,
+        # t1) and p <= len-3}: the prompt-lookup index, built once at admit
+        # and extended O(1) per emitted token — the previous per-tick
+        # backward scan was O(slots x full history) of host Python per
+        # verify dispatch and eroded the speculative speedup on long
+        # histories (advisor r3)
+        self._bigram_index: dict[int, dict[tuple[int, int], int]] = {}
 
         self._dtype = jax.tree_util.tree_leaves(params)[0].dtype
         self._requests: dict[int, EngineRequest] = {}  # slot -> request
@@ -458,19 +465,31 @@ class ContinuousBatchingEngine:
 
         return jax.jit(spec_decode, donate_argnums=(1, 2))
 
+    def _index_bigrams(self, slot: int, old_len: int) -> None:
+        """Extend the slot's bigram index over tokens appended since
+        `old_len`. Indexable positions are 0..len-3 (the trailing bigram
+        itself is the lookup KEY, never a hit); later occurrences overwrite
+        earlier ones so lookups return the most recent match, identical to
+        the backward scan this replaces."""
+        history = self._histories[slot]
+        index = self._bigram_index.setdefault(slot, {})
+        for p in range(max(0, old_len - 2), len(history) - 2):
+            index[(history[p], history[p + 1])] = p
+
     def _propose_drafts(self, slot: int) -> list[int]:
         """Host-side prompt-lookup: copy the tokens after the most recent
         earlier occurrence of the slot's trailing bigram (n-gram drafting,
-        same scheme as models/speculative.propose_ngram_drafts)."""
+        same scheme as models/speculative.propose_ngram_drafts), via the
+        incrementally maintained O(1) bigram index."""
         history = self._histories.get(slot, [])
         draft_len = self.draft_len
         if len(history) < 2:
             return (history[-1:] or [self.pad_id]) * draft_len
         t0, t1 = history[-2], history[-1]
-        for position in range(len(history) - 3, -1, -1):
-            if history[position] == t0 and history[position + 1] == t1:
-                window = history[position + 2 : position + 2 + draft_len]
-                return window + [t1] * (draft_len - len(window))
+        position = self._bigram_index.get(slot, {}).get((t0, t1))
+        if position is not None:
+            window = history[position + 2 : position + 2 + draft_len]
+            return window + [t1] * (draft_len - len(window))
         return [t1] * draft_len
 
     def _spec_chunk(self) -> None:
@@ -500,7 +519,9 @@ class ContinuousBatchingEngine:
         for slot in range(self.max_slots):
             if self._active[slot]:
                 out = toks_host[slot][: int(runs[slot])].tolist()
+                old_len = len(self._histories[slot])
                 self._histories[slot].extend(out)
+                self._index_bigrams(slot, old_len)
                 self._emit(self._requests[slot], out)
 
     # ---- public API ----
@@ -695,6 +716,9 @@ class ContinuousBatchingEngine:
         self._active[slot] = True
         self._requests[slot] = req
         self._histories[slot] = list(ids) + [int(first)]
+        if self.speculative:
+            self._bigram_index[slot] = {}
+            self._index_bigrams(slot, 0)
         self._emit(req, [int(first)])
 
     # ---- prompt-prefix KV reuse ----
@@ -794,6 +818,7 @@ class ContinuousBatchingEngine:
                 self._active[req.slot] = False
                 self._requests.pop(req.slot, None)
                 self._histories.pop(req.slot, None)
+                self._bigram_index.pop(req.slot, None)
             req.events.put(None)
 
 

@@ -469,3 +469,41 @@ def test_engine_under_mesh():
     req = engine.submit(prompt, max_new_tokens=6)
     drain(engine, req)
     assert req.all_tokens(timeout=1) == reference_tokens(prompt, 6)
+
+
+def test_bigram_index_matches_backward_scan():
+    """The incremental prompt-lookup index must propose exactly what the
+    O(history) backward scan it replaced proposed, across random histories
+    and incremental extends (advisor r3: the per-tick scan was host-side
+    Python over the full history for every slot)."""
+    import random
+
+    def scan_reference(history, draft_len, pad_id):
+        if len(history) < 2:
+            return (history[-1:] or [pad_id]) * draft_len
+        t0, t1 = history[-2], history[-1]
+        for position in range(len(history) - 3, -1, -1):
+            if history[position] == t0 and history[position + 1] == t1:
+                window = history[position + 2 : position + 2 + draft_len]
+                return window + [t1] * (draft_len - len(window))
+        return [t1] * draft_len
+
+    engine = make_engine(speculative=True, draft_len=4)
+    rng = random.Random(7)
+    for trial in range(40):
+        # small alphabet → plenty of repeated bigrams
+        history = [rng.randrange(1, 6) for _ in range(rng.randrange(1, 30))]
+        engine._histories[0] = list(history)
+        engine._bigram_index[0] = {}
+        engine._index_bigrams(0, 0)
+        assert engine._propose_drafts(0) == scan_reference(history, 4, engine.pad_id)
+        # grow incrementally, as _spec_chunk does after each verify round
+        for _ in range(6):
+            old_len = len(engine._histories[0])
+            engine._histories[0].extend(
+                rng.randrange(1, 6) for _ in range(rng.randrange(1, 4))
+            )
+            engine._index_bigrams(0, old_len)
+            assert engine._propose_drafts(0) == scan_reference(
+                engine._histories[0], 4, engine.pad_id
+            )

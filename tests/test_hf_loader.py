@@ -988,3 +988,35 @@ def test_unknown_sliding_pattern_raises():
     tokens = jnp.ones((1, 4), dtype=jnp.int32)
     with pytest.raises(ValueError, match="sliding_pattern"):
         forward(params, tokens, config, cache=None)
+
+
+def test_moe_configs_get_dropless_headroom_capacity():
+    """HF MoE checkpoints route dropless; the capacity-routing stack needs
+    capacity_factor headroom (2.0, matching the hand-written qwen3-30b-a3b
+    preset) or imbalance silently zeroes dropped tokens' expert output.
+    Dense models keep the ModelConfig default."""
+    from prime_tpu.models.hf_loader import config_from_hf
+
+    class Cfg:
+        model_type = "qwen3_moe"
+        vocab_size = 128
+        hidden_size = 64
+        num_hidden_layers = 2
+        num_attention_heads = 4
+        num_key_value_heads = 2
+        intermediate_size = 128
+        moe_intermediate_size = 48
+        num_experts = 16
+
+    assert config_from_hf(Cfg()).capacity_factor == 2.0
+    hf_mixtral = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
+        num_local_experts=8,
+    )
+    assert config_from_hf(hf_mixtral).capacity_factor == 2.0
+    hf_dense = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
+    )
+    assert config_from_hf(hf_dense).capacity_factor == 1.25
