@@ -18,12 +18,16 @@ def initialize_multihost(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    initialization_timeout: float | None = None,
 ) -> None:
     """Initialize jax.distributed for a multi-host slice.
 
     On Cloud TPU VMs all three arguments are discovered from the metadata
     server automatically; explicit values are for DCN-pooled multi-slice jobs
     (coordinator = worker 0 of slice 0) or for tests.
+    ``initialization_timeout`` bounds the coordinator handshake so a worker
+    whose peers never arrive FAILS instead of hanging (failure detection at
+    launch; exercised by tests/test_multihost.py).
     """
     import jax
 
@@ -34,6 +38,8 @@ def initialize_multihost(
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
+    if initialization_timeout is not None:
+        kwargs["initialization_timeout"] = initialization_timeout
     jax.distributed.initialize(**kwargs)
 
 
