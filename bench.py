@@ -422,6 +422,43 @@ def main() -> None:
         record["longctx_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: longctx section failed: {e}", flush=True)
 
+    # ---- spdecode: sequence-parallel decode step ----------------------------
+    # The long-context decode path a v5e-8+ slice runs (cache slots sharded
+    # over sp, two-phase softmax combine — parallel/long_context.py), timed
+    # through the IDENTICAL shard_map code on the bench chip's sp=1 mesh.
+    # What a single chip can measure is the sp machinery's overhead vs the
+    # plain decode step (expect ~1.0x); cross-chip scaling needs a slice the
+    # driver doesn't have. Parity at sp=8 is locked by
+    # tests/test_parallel.py::test_sp_decode_parity_long_cache.
+    try:
+        from prime_tpu.ops.attention import decode_attention
+        from prime_tpu.parallel.long_context import sp_decode_attention
+        from prime_tpu.parallel.mesh import make_mesh
+
+        sp_b, sp_h, sp_kh, sp_d, sp_c = 8, 32, 8, 64, 4096
+        sp_q = jax.random.normal(jax.random.PRNGKey(4), (sp_b, sp_h, 1, sp_d), dtype=jnp.bfloat16)
+        sp_k = jax.random.normal(jax.random.PRNGKey(5), (sp_b, sp_kh, sp_d, sp_c), dtype=jnp.bfloat16)
+        sp_v = jax.random.normal(jax.random.PRNGKey(6), (sp_b, sp_kh, sp_d, sp_c), dtype=jnp.bfloat16)
+        sp_lens = jnp.full((sp_b,), sp_c, dtype=jnp.int32)
+        mesh1 = make_mesh({"sp": 1})
+        plain_fn = jax.jit(
+            lambda: decode_attention(sp_q, sp_k, sp_v, sp_lens, sp_d**-0.5, impl="xla")
+        )
+        sp_fn = jax.jit(lambda: sp_decode_attention(sp_q, sp_k, sp_v, sp_lens, mesh1))
+        plain_s = time_fn(lambda: float(jnp.sum(plain_fn())), iterations=5)
+        sp_s = time_fn(lambda: float(jnp.sum(sp_fn())), iterations=5)
+        record["spdecode_plain_us"] = round(plain_s * 1e6, 1)
+        record["spdecode_sp_us"] = round(sp_s * 1e6, 1)
+        record["spdecode_overhead"] = round(sp_s / plain_s, 3)
+        print(
+            f"# bench: spdecode C={sp_c} sp-path {record['spdecode_sp_us']}us vs "
+            f"plain {record['spdecode_plain_us']}us",
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001
+        record["spdecode_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: spdecode section failed: {e}", flush=True)
+
     # final, enriched record — last JSON line on stdout wins
     print(json.dumps(record), flush=True)
 
