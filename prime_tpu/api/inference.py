@@ -23,14 +23,18 @@ class InferenceClient:
         self,
         config: Config | None = None,
         transport: httpx.BaseTransport | None = None,
+        base_url: str | None = None,
+        timeout: httpx.Timeout | None = None,
     ) -> None:
         config = config or Config()
-        # inference_url already includes its path prefix (e.g. /api/v1)
+        # inference_url already includes its path prefix (e.g. /api/v1);
+        # base_url overrides it for endpoint-alias targets, timeout for
+        # fast-fail preflight probes
         self.api = APIClient(
             config=config,
-            base_url=config.inference_url,
+            base_url=base_url or config.inference_url,
             api_prefix="",
-            timeout=INFERENCE_TIMEOUT,
+            timeout=timeout or INFERENCE_TIMEOUT,
             transport=transport,
         )
 

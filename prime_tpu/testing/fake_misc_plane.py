@@ -40,6 +40,9 @@ class FakeMiscPlane:
             {"id": "llama3-8b", "owned_by": "prime", "context_length": 8192},
             {"id": "llama3-70b", "owned_by": "prime", "context_length": 8192},
         ]
+        # fault injection: chat completions 402 (insufficient balance) —
+        # the eval-preflight billing fail-fast is tested against it
+        self.payment_required = False
         self._register()
         fake.mount(self._handle_inference)
 
@@ -65,6 +68,8 @@ class FakeMiscPlane:
         if path == "/v1/chat/completions" and request.method == "POST":
             import json as jsonlib
 
+            if self.payment_required:
+                return _json_response(402, {"detail": "insufficient balance — top up your wallet"})
             body = jsonlib.loads(request.content.decode())
             content = f"echo: {body['messages'][-1]['content']}"
             if body.get("stream"):

@@ -392,7 +392,9 @@ def test_hosted_eval_failure_exits_nonzero(runner, fake, monkeypatch):
 
     timer = threading.Timer(0.2, fail_soon)
     timer.start()
-    result = runner.invoke(cli, ["eval", "run", "e", "-m", "m", "--hosted"])
+    # llama3-8b: a model the preflight validates (an unknown id now fails
+    # fast BEFORE submission — tests/test_eval_endpoints.py covers that)
+    result = runner.invoke(cli, ["eval", "run", "e", "-m", "llama3-8b", "--hosted"])
     timer.cancel()
     assert result.exit_code == 1
     assert "FAILED" in result.output

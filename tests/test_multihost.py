@@ -62,14 +62,21 @@ def test_two_process_distributed_smoke():
     procs = _spawn_workers(2)
     records = []
     failures = []
-    for i, proc in enumerate(procs):
-        out, err = proc.communicate(timeout=300)
-        if proc.returncode != 0:
-            failures.append(f"proc {i} rc={proc.returncode}:\n{err[-1500:]}")
-            continue
-        ok_lines = [l for l in out.splitlines() if l.startswith("MULTIHOST_SMOKE_OK ")]
-        assert ok_lines, f"proc {i} printed no OK line:\n{out[-500:]}"
-        records.append(json.loads(ok_lines[-1].split(" ", 1)[1]))
+    try:
+        for i, proc in enumerate(procs):
+            out, err = proc.communicate(timeout=300)
+            if proc.returncode != 0:
+                failures.append(f"proc {i} rc={proc.returncode}:\n{err[-1500:]}")
+                continue
+            ok_lines = [l for l in out.splitlines() if l.startswith("MULTIHOST_SMOKE_OK ")]
+            assert ok_lines, f"proc {i} printed no OK line:\n{out[-500:]}"
+            records.append(json.loads(ok_lines[-1].split(" ", 1)[1]))
+    finally:
+        # a failed/timed-out worker must not orphan its sibling (it would
+        # spin against the dead coordinator until jax's init timeout)
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
     assert not failures, "\n".join(failures)
     assert [r["process_id"] for r in records] == [0, 1]
     for record in records:
