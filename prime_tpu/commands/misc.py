@@ -205,6 +205,24 @@ def lab_hygiene(render: Renderer, workspace: str, do_fix: bool) -> None:
         raise SystemExit(1)
 
 
+@lab_group.command("register-github")
+@click.option("--dir", "workspace", default=".", type=click.Path())
+@output_options
+def lab_register_github(render: Renderer, workspace: str) -> None:
+    """Write a GitHub Actions workflow that runs the Lab hygiene preflight
+    on every push/PR (reference commands/lab.py:106-113)."""
+    from prime_tpu.lab.hygiene import write_github_workflow
+
+    try:
+        path = write_github_workflow(workspace)
+    except OSError as e:
+        raise click.ClickException(str(e)) from None
+    if render.is_json:
+        render.json({"path": str(path)})
+    else:
+        render.message(f"Wrote {path}")
+
+
 @lab_group.command("doctor")
 @output_options
 def lab_doctor(render: Renderer) -> None:

@@ -158,3 +158,40 @@ def apply_fixes(workspace: str | Path, findings: list[Finding]) -> list[str]:
     from prime_tpu.lab.setup import append_gitignore
 
     return append_gitignore(workspace, [f.fix_entry for f in findings if f.fix_entry])
+
+
+# `prime lab register-github` (reference commands/lab.py:106-113) drops a CI
+# workflow that runs the hygiene preflight on every push/PR, so a workspace
+# that leaks secrets or tracks generated outputs fails CI, not just the
+# local doctor. The workflow installs this package and runs the same
+# `prime lab hygiene` the shell's setup screen uses.
+GITHUB_WORKFLOW_RELPATH = Path(".github") / "workflows" / "prime-lab-hygiene.yml"
+GITHUB_WORKFLOW_YAML = """\
+name: prime-lab-hygiene
+
+on:
+  pull_request:
+  push:
+    branches: [main]
+
+jobs:
+  hygiene:
+    runs-on: ubuntu-latest
+    steps:
+      - uses: actions/checkout@v4
+      - uses: actions/setup-python@v5
+        with:
+          python-version: "3.12"
+      - name: Install prime
+        run: pip install prime-tpu
+      - name: Lab workspace hygiene
+        run: prime lab hygiene --plain
+"""
+
+
+def write_github_workflow(workspace: str | Path = ".") -> Path:
+    """Write the hygiene CI workflow into the workspace; returns its path."""
+    path = Path(workspace).expanduser().resolve() / GITHUB_WORKFLOW_RELPATH
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(GITHUB_WORKFLOW_YAML, encoding="utf-8")
+    return path

@@ -452,3 +452,27 @@ def test_skill_bundle_downgrade_guard_and_bad_mcp_configs(tmp_path):
     report = setup_workspace(tmp_path, agents=("claude",))
     assert json.loads((tmp_path / ".mcp.json").read_text())["mcpServers"] is None
     assert any("mcpServers is not an object" in s for s in report.skipped)
+
+
+def test_lab_register_github(tmp_path):
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.main import cli
+
+    runner = CliRunner()
+    result = runner.invoke(cli, ["lab", "register-github", "--dir", str(tmp_path)])
+    assert result.exit_code == 0, result.output
+    workflow = tmp_path / ".github" / "workflows" / "prime-lab-hygiene.yml"
+    assert workflow.exists()
+    text = workflow.read_text()
+    assert "prime lab hygiene" in text and "pull_request" in text
+    # idempotent: a rewrite leaves identical content
+    assert runner.invoke(cli, ["lab", "register-github", "--dir", str(tmp_path)]).exit_code == 0
+    assert workflow.read_text() == text
+    # json mode reports the path
+    import json as _json
+
+    result = runner.invoke(
+        cli, ["lab", "register-github", "--dir", str(tmp_path), "--output", "json"]
+    )
+    assert _json.loads(result.output)["path"] == str(workflow)
