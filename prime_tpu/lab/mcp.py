@@ -95,18 +95,25 @@ def build_tools(workspace: str = ".") -> dict[str, tuple[dict, Callable[[dict], 
         the same contract natively when the agent speaks a chat dialect."""
 
         def handle(args: dict) -> Any:
-            from prime_tpu.lab.widgets import validate_widget_call
+            # the typed model repairs what it can (the journal gets the
+            # NORMALIZED payload) and reports why when it can't — the agent
+            # sees which repairs were applied and can correct next call
+            from prime_tpu.lab.widget_model import WidgetValidationError, normalize_widget_call
 
-            problem = validate_widget_call(name, args)
-            if problem:
-                return {"status": "invalid", "error": problem}
+            try:
+                normalized = normalize_widget_call(name, args)
+            except WidgetValidationError as e:
+                return {"status": "invalid", "error": str(e)}
             from pathlib import Path
 
             journal = Path(workspace) / ".prime-lab" / "widgets.jsonl"
             journal.parent.mkdir(parents=True, exist_ok=True)
             with open(journal, "a") as f:
-                f.write(json.dumps({"name": name, "args": args}) + "\n")
-            return {"status": "rendered", "widget": name}
+                f.write(json.dumps({"name": name, "args": normalized.args}) + "\n")
+            result: dict[str, Any] = {"status": "rendered", "widget": name}
+            if normalized.repairs:
+                result["repairs"] = list(normalized.repairs)
+            return result
 
         return handle
 

@@ -41,6 +41,13 @@ def sparkline(values: list[float], width: int = 48) -> str:
     span = hi - lo
     if span <= 0:
         return BLOCKS[0] * len(clean)
+    if span == float("inf"):
+        # finite endpoints can still have an overflowing range (±1e308);
+        # rescale into a finite span instead of dividing by inf -> NaN
+        scale = max(abs(lo), abs(hi)) / 2.0
+        clean = [v / scale for v in clean]
+        lo, hi = min(clean), max(clean)
+        span = hi - lo
     return "".join(BLOCKS[int((v - lo) / span * (len(BLOCKS) - 1))] for v in clean)
 
 

@@ -147,24 +147,26 @@ class AgentChatScreen(DetailScreen):
             self.send(selected if selected.strip() else f"option {index + 1}")
             return f"selected: {selected or f'option {index + 1}'}"
         # launch_run: hand the proposal to the launch section's arm/confirm
-        # flow as a card on disk — chat never submits to the platform itself
+        # flow as a card on disk — chat never submits to the platform itself.
+        # The typed widget model repairs/rejects the payload (numerics become
+        # numeric on the card, junk fields are dropped with a record) so the
+        # TOML the user arms has real types, not agent leftovers.
         args = pending.get("args", {})
         if self.workspace is None:
             return "no workspace for launch cards"
-        kind = str(args.get("kind", "eval"))
-        kind = {"training": "train"}.get(kind, kind)  # card kinds are train|eval
-        if kind not in ("train", "eval"):
-            return f"launch cards support eval/training, not {kind!r}"
-        config = args.get("config")
-        payload = (
-            {str(k): v for k, v in config.items() if isinstance(v, (str, int, float, bool))}
-            if isinstance(config, dict)
-            else {}
+        from prime_tpu.lab.widget_model import (
+            WidgetValidationError,
+            launch_card_payload,
+            normalize_widget_call,
         )
-        if not payload:
+
+        try:
+            normalized = normalize_widget_call("launch_run", args)
+            kind, payload = launch_card_payload(normalized)
+        except WidgetValidationError as e:
             # never substitute template defaults for a config the agent did
             # not propose — an armed card must contain only proposed values
-            return "proposal has no usable config — ask the agent to include one"
+            return f"unusable proposal: {e}"
         try:
             from prime_tpu.lab.tui.editor import new_card
             from prime_tpu.lab.tui.launch import save_card

@@ -1,0 +1,251 @@
+"""Typed widget-payload model: normalize/repair/reject agent widget calls.
+
+Reference role: prime_lab_app/agent_widget_model.py:1-1168 + agent_cards.py
+:1-536 — the layer between raw agent tool-call JSON and the TUI. Agents emit
+malformed payloads constantly (numbers as strings, null holes, scalar where
+an array belongs, 10k-row tables); the previous shallow check
+(widgets.validate_widget_call) only gated types, so anything past it was
+rendered best-effort. This module gives every widget a typed contract:
+
+- **repair** what is safely repairable — coerce numeric strings, stringify
+  scalar options, drop null/empty/non-finite entries, dedupe, cap sizes —
+  and RECORD each repair so the TUI can show "repaired: ..." instead of
+  silently rendering something the agent didn't say;
+- **reject** what isn't — unknown tool, missing required keys, payloads
+  empty after repair — with a reason string the chat renders as an error
+  widget (never a crash, never a silent misrender);
+- **round-trip state**: the stamps the chat screen writes back into a
+  rendered widget's args (``selected``, ``saved_card``) survive
+  re-normalization, so re-rendering a transcript keeps interaction state;
+- **card lifecycle**: a normalized ``launch_run`` payload converts to a
+  typed launch-card payload (kind mapped onto the card taxonomy, numerics
+  actually numeric) so the card on disk — and the TOML the user edits —
+  has real types, not stringly-typed leftovers.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any
+
+MAX_OPTIONS = 24
+MAX_ROWS = 100
+MAX_POINTS = 512
+MAX_PATCH_LINES = 400
+# launch-config fields that must be numeric on the card; agents routinely
+# send them as strings ("limit": "64")
+INT_CONFIG_FIELDS = ("limit", "batch_size", "max_new_tokens", "epochs", "draft_len", "seed")
+FLOAT_CONFIG_FIELDS = ("temperature", "learning_rate", "top_p", "beta", "clip_eps")
+# stamps the chat screen writes back into rendered args; normalization must
+# carry them through unchanged (widget state round-trip)
+STATE_KEYS = ("selected", "saved_card")
+
+
+class WidgetValidationError(Exception):
+    """The payload is unusable even after repair; the message says why."""
+
+
+@dataclass
+class NormalizedWidget:
+    name: str
+    args: dict[str, Any]
+    repairs: tuple[str, ...] = ()
+
+    def with_state_from(self, raw_args: dict[str, Any]) -> "NormalizedWidget":
+        for key in STATE_KEYS:
+            if isinstance(raw_args, dict) and key in raw_args:
+                self.args[key] = raw_args[key]
+        return self
+
+
+def _coerce_number(value: Any) -> float | int | None:
+    """A number, a numeric string, or None; NaN/inf count as unusable."""
+    if isinstance(value, bool):
+        return None
+    if isinstance(value, (int, float)):
+        return value if math.isfinite(value) else None
+    if isinstance(value, str):
+        text = value.strip()
+        try:
+            number = int(text)
+        except ValueError:
+            try:
+                number = float(text)
+            except ValueError:
+                return None
+        return number if math.isfinite(number) else None
+    return None
+
+
+def _title(args: dict[str, Any], repairs: list[str]) -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    if "title" in args and args["title"] is not None:
+        if isinstance(args["title"], str):
+            out["title"] = args["title"]
+        else:
+            out["title"] = str(args["title"])
+            repairs.append("title coerced to string")
+    return out
+
+
+def _normalize_choose(args: dict[str, Any], repairs: list[str]) -> dict[str, Any]:
+    raw = args.get("options")
+    if not isinstance(raw, list):
+        raise WidgetValidationError("choose: options must be an array of strings")
+    options: list[str] = []
+    for item in raw:
+        if item is None:
+            repairs.append("dropped null option")
+            continue
+        text = item if isinstance(item, str) else str(item)
+        if not isinstance(item, str):
+            repairs.append(f"option {text[:20]!r} coerced to string")
+        text = text.strip()
+        if not text:
+            repairs.append("dropped empty option")
+            continue
+        if text in options:
+            repairs.append(f"dropped duplicate option {text[:20]!r}")
+            continue
+        options.append(text)
+    if not options:
+        raise WidgetValidationError("choose: no usable options after repair")
+    if len(options) > MAX_OPTIONS:
+        repairs.append(f"options capped at {MAX_OPTIONS} (got {len(options)})")
+        options = options[:MAX_OPTIONS]
+    return {**_title(args, repairs), "options": options}
+
+
+def _normalize_table(args: dict[str, Any], repairs: list[str]) -> dict[str, Any]:
+    raw = args.get("rows")
+    if not isinstance(raw, list):
+        raise WidgetValidationError("show_table: rows must be an array of objects")
+    rows = []
+    for row in raw:
+        if isinstance(row, dict):
+            rows.append({str(k): v for k, v in row.items()})
+        else:
+            repairs.append(f"dropped non-object row {str(row)[:20]!r}")
+    if not rows:
+        raise WidgetValidationError("show_table: no object rows after repair")
+    if len(rows) > MAX_ROWS:
+        repairs.append(f"rows capped at {MAX_ROWS} (got {len(rows)})")
+        rows = rows[:MAX_ROWS]
+    return {**_title(args, repairs), "rows": rows}
+
+
+def _normalize_chart(args: dict[str, Any], repairs: list[str]) -> dict[str, Any]:
+    raw = args.get("values")
+    if not isinstance(raw, list):
+        raise WidgetValidationError("show_chart: values must be an array of numbers")
+    values: list[float | int] = []
+    for item in raw:
+        number = _coerce_number(item)
+        if number is None:
+            repairs.append(f"dropped non-numeric value {str(item)[:20]!r}")
+            continue
+        if not isinstance(item, (int, float)) or isinstance(item, bool):
+            repairs.append(f"value {number} coerced from {type(item).__name__}")
+        values.append(number)
+    if not values:
+        raise WidgetValidationError("show_chart: no numeric values after repair")
+    if len(values) > MAX_POINTS:
+        repairs.append(f"values downsampled to {MAX_POINTS} points (got {len(values)})")
+        step = len(values) / MAX_POINTS
+        values = [values[int(i * step)] for i in range(MAX_POINTS)]
+    return {**_title(args, repairs), "values": values}
+
+
+def _normalize_launch(args: dict[str, Any], repairs: list[str]) -> dict[str, Any]:
+    kind = args.get("kind")
+    if not isinstance(kind, str) or kind not in ("eval", "training"):
+        raise WidgetValidationError(
+            f"launch_run: kind must be 'eval' or 'training', got {str(kind)[:20]!r}"
+        )
+    raw = args.get("config")
+    if not isinstance(raw, dict):
+        raise WidgetValidationError("launch_run: config must be an object")
+    config: dict[str, Any] = {}
+    for key, value in raw.items():
+        key = str(key)
+        if value is None:
+            repairs.append(f"dropped null config field {key!r}")
+            continue
+        if key in INT_CONFIG_FIELDS:
+            number = _coerce_number(value)
+            if number is None:
+                repairs.append(f"dropped non-numeric {key!r}={str(value)[:20]!r}")
+                continue
+            if not isinstance(value, int) or isinstance(value, bool):
+                repairs.append(f"{key} coerced to int")
+            config[key] = int(number)
+        elif key in FLOAT_CONFIG_FIELDS:
+            number = _coerce_number(value)
+            if number is None:
+                repairs.append(f"dropped non-numeric {key!r}={str(value)[:20]!r}")
+                continue
+            if isinstance(value, str):
+                repairs.append(f"{key} coerced to float")
+            config[key] = float(number)
+        elif isinstance(value, (str, int, float, bool)):
+            config[key] = value
+        else:
+            repairs.append(f"dropped non-scalar config field {key!r}")
+    if not config:
+        raise WidgetValidationError("launch_run: no usable config fields after repair")
+    return {"kind": kind, "config": config}
+
+
+def _normalize_patch(args: dict[str, Any], repairs: list[str]) -> dict[str, Any]:
+    raw = args.get("patch")
+    if raw is None:
+        raise WidgetValidationError("show_patch: patch is required")
+    text = raw if isinstance(raw, str) else str(raw)
+    if not isinstance(raw, str):
+        repairs.append("patch coerced to string")
+    if not text.strip():
+        raise WidgetValidationError("show_patch: patch is empty")
+    lines = text.splitlines()
+    if len(lines) > MAX_PATCH_LINES:
+        repairs.append(f"patch truncated to {MAX_PATCH_LINES} lines (got {len(lines)})")
+        text = "\n".join(lines[:MAX_PATCH_LINES])
+    return {**_title(args, repairs), "patch": text}
+
+
+_NORMALIZERS = {
+    "choose": _normalize_choose,
+    "show_table": _normalize_table,
+    "show_chart": _normalize_chart,
+    "launch_run": _normalize_launch,
+    "show_patch": _normalize_patch,
+}
+
+
+def normalize_widget_call(name: str, args: Any) -> NormalizedWidget:
+    """Typed repair-or-reject for one widget call.
+
+    Returns the normalized payload with a record of every repair applied, or
+    raises :class:`WidgetValidationError` with a reason the TUI can render.
+    Interaction stamps (``selected``/``saved_card``) round-trip untouched.
+    """
+    normalizer = _NORMALIZERS.get(name)
+    if normalizer is None:
+        raise WidgetValidationError(f"unknown widget tool {name!r}")
+    if not isinstance(args, dict):
+        raise WidgetValidationError(f"{name}: args must be an object")
+    repairs: list[str] = []
+    normalized = normalizer(args, repairs)
+    return NormalizedWidget(name=name, args=normalized, repairs=tuple(repairs)).with_state_from(
+        args
+    )
+
+
+def launch_card_payload(normalized: NormalizedWidget) -> tuple[str, dict[str, Any]]:
+    """Card-lifecycle step: map a normalized launch_run onto the launch-card
+    taxonomy (train|eval) with typed values, ready for editor.new_card /
+    launch.save_card."""
+    if normalized.name != "launch_run":
+        raise WidgetValidationError(f"not a launch proposal: {normalized.name!r}")
+    kind = {"training": "train"}.get(normalized.args["kind"], normalized.args["kind"])
+    return kind, dict(normalized.args["config"])

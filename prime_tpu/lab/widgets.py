@@ -143,14 +143,32 @@ def validate_widget_call(name: str, args: dict[str, Any]) -> str | None:
 def render_widget(name: str, args: dict[str, Any], cursor: int | None = None):
     """One rich renderable per widget call (pure; no app state beyond the
     optional ``cursor`` for a pending choice and the ``selected`` /
-    ``saved_card`` stamps the chat screen writes back into ``args``)."""
+    ``saved_card`` stamps the chat screen writes back into ``args``).
+
+    Payloads go through the typed widget model first
+    (widget_model.normalize_widget_call): repairable damage is fixed and
+    surfaced in the panel subtitle, unusable payloads render as an explicit
+    error panel — never a crash, never a silent misrender."""
     from rich.panel import Panel
     from rich.table import Table
     from rich.text import Text
 
-    problem = validate_widget_call(name, args)
-    if problem:
-        return Panel(Text(problem, style="red"), title="widget error", border_style="red")
+    from prime_tpu.lab.widget_model import WidgetValidationError, normalize_widget_call
+
+    try:
+        normalized = normalize_widget_call(name, args)
+    except WidgetValidationError as e:
+        return Panel(Text(str(e), style="red"), title="widget error", border_style="red")
+    args = normalized.args
+    subtitle = (
+        f"repaired: {'; '.join(normalized.repairs[:3])}"
+        + ("; …" if len(normalized.repairs) > 3 else "")
+        if normalized.repairs
+        else None
+    )
+
+    def panel(*a, **kw):
+        return Panel(*a, subtitle=subtitle, subtitle_align="left", **kw)
 
     title = str(args.get("title", "")) or name
     if name == "choose":
@@ -170,7 +188,7 @@ def render_widget(name: str, args: dict[str, Any], cursor: int | None = None):
                 Text(f"{marker}{index}.", style="bold"), Text(text, style=style or None)
             )
         border = "dim" if selected is not None else "cyan"
-        return Panel(body, title=f"choose: {title}", border_style=border)
+        return panel(body, title=f"choose: {title}", border_style=border)
     if name == "show_table":
         rows = [r for r in args["rows"] if isinstance(r, dict)]
         columns: list[str] = []
@@ -183,14 +201,14 @@ def render_widget(name: str, args: dict[str, Any], cursor: int | None = None):
             table.add_column(str(column), overflow="ellipsis", no_wrap=True)
         for row in rows[:20]:
             table.add_row(*[str(row.get(c, "—")) for c in columns[:6]])
-        return Panel(table, title=title, border_style="cyan")
+        return panel(table, title=title, border_style="cyan")
     if name == "show_chart":
         from prime_tpu.lab.tui.charts import sparkline
 
         values = [v for v in args["values"] if isinstance(v, (int, float))]
         line = sparkline(values, width=48) if values else "(no numeric values)"
         caption = f"{values[0]:.4g} → {values[-1]:.4g}" if values else ""
-        return Panel(
+        return panel(
             Text(f"{line}  {caption}", no_wrap=True, overflow="crop"),
             title=title,
             border_style="cyan",
@@ -203,7 +221,7 @@ def render_widget(name: str, args: dict[str, Any], cursor: int | None = None):
         saved = args.get("saved_card")
         if saved:
             body.add_row(Text("card", style="green"), Text(str(saved), style="green"))
-        return Panel(
+        return panel(
             body,
             title="launch proposal"
             + (" (card written)" if saved else " (confirm in the launch section)"),
@@ -214,4 +232,4 @@ def render_widget(name: str, args: dict[str, Any], cursor: int | None = None):
     for line in str(args["patch"]).splitlines()[:40]:
         style = "green" if line.startswith("+") else "red" if line.startswith("-") else None
         text.append(line + "\n", style=style)
-    return Panel(text, title=title, border_style="cyan")
+    return panel(text, title=title, border_style="cyan")

@@ -539,7 +539,7 @@ def test_chat_launch_kind_normalized_and_bad_kind_rejected(tmp_path):
         {"role": "widget", "name": "launch_run", "args": {"kind": "pod", "config": {"x": 1}}}
     )
     screen.pending = screen.transcript[-1]
-    assert "support eval/training" in screen.on_key("enter")
+    assert "eval' or 'training" in screen.on_key("enter")
 
 
 def test_chat_launch_without_config_refused(tmp_path):
@@ -551,7 +551,7 @@ def test_chat_launch_without_config_refused(tmp_path):
     )
     screen.pending = screen.transcript[-1]
     status = screen.on_key("enter")
-    assert "no usable config" in status
+    assert "unusable proposal" in status
     # no template-default card was fabricated
     assert not (tmp_path / ".prime-lab" / "launch").exists()
 
