@@ -65,6 +65,20 @@ class ModelConfig:
     # original_max_position, attention_factor) — attention_factor resolved at
     # load (incl. mscale variants) so model code just scales the tables
     rope_yarn: tuple[float, float, float, float, float] | None = None
+    # GPT-OSS ships yarn with truncate=false: correction bounds stay
+    # fractional instead of floor/ceil, shifting the interpolation ramp
+    rope_yarn_truncate: bool = True
+    # Phi-3.5 LongRoPE: (short_factors, long_factors, original_max_position,
+    # attention_factor) — per-dim learned frequency rescales; the long set
+    # applies when the table covers more than the pretrained range
+    rope_longrope: tuple[tuple[float, ...], tuple[float, ...], float, float] | None = None
+    # Phi-2-style partial rotary: only the first head_dim*partial_rotary
+    # features of each head rotate, the tail passes through position-free
+    partial_rotary: float = 1.0
+    # GPT-OSS attention sinks: one learned logit per head joins every
+    # softmax normalization (no value contribution) — a drain for attention
+    # mass that otherwise piles onto early tokens
+    attn_sinks: bool = False
     # mixture-of-experts (0 experts = dense MLP; Mixtral-style top-k routing)
     n_experts: int = 0
     experts_per_token: int = 2
@@ -72,6 +86,12 @@ class ModelConfig:
     # renormalize the chosen top-k gates to sum 1 (Mixtral, Qwen3-MoE w/
     # norm_topk_prob=True); False keeps raw softmax mass
     norm_topk: bool = True
+    # GPT-OSS: biases on the router and every expert projection
+    moe_bias: bool = False
+    # GPT-OSS clamped GLU: ff = (up+1) * gate * sigmoid(1.702*gate) with
+    # gate clamped above and up clamped both ways at this limit (0 = plain
+    # silu gating)
+    moe_glu_clamp: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -94,8 +114,12 @@ class ModelConfig:
             attn += 2 * self.head_dim
         if self.qk_norm_full:
             attn += (self.n_heads + self.n_kv_heads) * self.head_dim
+        if self.attn_sinks:
+            attn += self.n_heads
         if self.is_moe:
             mlp = self.n_experts * 3 * self.d_model * self.d_ff + self.d_model * self.n_experts
+            if self.moe_bias:
+                mlp += self.n_experts * (2 * self.d_ff + self.d_model) + self.n_experts
         else:
             mlp = 3 * self.d_model * self.d_ff
         norms = ((2 if self.pre_norms else 0) + (2 if self.post_norms else 0)) * self.d_model
@@ -499,6 +523,63 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         d_ff=2048,
         max_seq_len=2048,
     ),
+    # GPT-OSS (openai 2025): all-MoE with router+expert biases and clamped
+    # GLU, per-head attention sinks, even-alternating sliding window 128,
+    # q/k/v/o biases, non-truncated YaRN x32 over a 4k pretrain range.
+    # attention_factor = mscale_of(32) = 0.1*ln(32)+1 ≈ 1.3466 (resolved here
+    # like every other preset so model code only scales tables).
+    "gpt-oss-20b": ModelConfig(
+        name="gpt-oss-20b",
+        vocab_size=201088,
+        d_model=2880,
+        n_layers=24,
+        n_heads=64,
+        n_kv_heads=8,
+        d_ff=2880,
+        max_seq_len=32768,
+        rope_theta=150000.0,
+        rms_eps=1e-5,
+        head_dim_override=64,
+        attn_bias=True,
+        attn_out_bias=True,
+        attn_sinks=True,
+        sliding_window=128,
+        sliding_pattern="even",
+        rope_yarn=(32.0, 32.0, 1.0, 4096.0, 1.3465735902799727),
+        rope_yarn_truncate=False,
+        n_experts=32,
+        experts_per_token=4,
+        norm_topk=True,
+        capacity_factor=2.0,
+        moe_bias=True,
+        moe_glu_clamp=7.0,
+    ),
+    "gpt-oss-120b": ModelConfig(
+        name="gpt-oss-120b",
+        vocab_size=201088,
+        d_model=2880,
+        n_layers=36,
+        n_heads=64,
+        n_kv_heads=8,
+        d_ff=2880,
+        max_seq_len=32768,
+        rope_theta=150000.0,
+        rms_eps=1e-5,
+        head_dim_override=64,
+        attn_bias=True,
+        attn_out_bias=True,
+        attn_sinks=True,
+        sliding_window=128,
+        sliding_pattern="even",
+        rope_yarn=(32.0, 32.0, 1.0, 4096.0, 1.3465735902799727),
+        rope_yarn_truncate=False,
+        n_experts=128,
+        experts_per_token=4,
+        norm_topk=True,
+        capacity_factor=2.0,
+        moe_bias=True,
+        moe_glu_clamp=7.0,
+    ),
     "tiny-test": ModelConfig(
         name="tiny-test",
         vocab_size=512,
@@ -521,6 +602,33 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         n_experts=4,
         experts_per_token=2,
         capacity_factor=2.0,
+    ),
+    # GPT-OSS architecture at test scale: sinks + biased clamped-GLU MoE +
+    # alternating window + non-truncated yarn, all exercised on CPU
+    "tiny-gptoss": ModelConfig(
+        name="tiny-gptoss",
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=96,
+        max_seq_len=512,
+        rope_theta=150000.0,
+        head_dim_override=32,
+        attn_bias=True,
+        attn_out_bias=True,
+        attn_sinks=True,
+        sliding_window=8,
+        sliding_pattern="even",
+        rope_yarn=(32.0, 32.0, 1.0, 64.0, 1.3465735902799727),
+        rope_yarn_truncate=False,
+        n_experts=4,
+        experts_per_token=2,
+        norm_topk=True,
+        capacity_factor=2.0,
+        moe_bias=True,
+        moe_glu_clamp=7.0,
     ),
 }
 

@@ -40,6 +40,7 @@ SUPPORTED_MODEL_TYPES = frozenset(
         "gemma3",
         "phi3",
         "olmo2",
+        "gpt_oss",
     }
 )
 
@@ -103,13 +104,9 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
     # Qwen2 checkpoints carry q/k/v biases unconditionally; Llama-family
     # configs declare them via attention_bias
     attn_bias = bool(getattr(hf_config, "attention_bias", False)) or model_type == "qwen2"
-    if model_type == "phi3":
-        partial_rotary = float(getattr(hf_config, "partial_rotary_factor", 1.0) or 1.0)
-        if partial_rotary != 1.0:
-            raise ValueError(
-                f"phi3 partial_rotary_factor={partial_rotary} is not supported "
-                "(full rotary only); loading would silently rotate the wrong dims"
-            )
+    # Phi-2/Phi-3 partial rotary: only the first head_dim*factor features
+    # rotate (ops/rope.apply_rope_rows passes the tail through)
+    partial_rotary = float(getattr(hf_config, "partial_rotary_factor", 1.0) or 1.0)
     if model_type == "qwen3_moe":
         # the uniform layer scan needs every layer sparse; a mixed
         # dense/sparse schedule would silently run dense layers through the
@@ -133,6 +130,8 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         rope_type, rope_factor = "linear", 1.0
     rope_llama3 = None
     rope_yarn = None
+    rope_longrope = None
+    yarn_truncate = True
     if rope_type == "default":  # HF's explicit no-scaling marker
         rope_factor = 1.0
     elif rope_type == "llama3" and rope_scaling:
@@ -150,12 +149,8 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
 
         # HF treats ANY falsy truncate (false, null, 0) as non-truncating;
         # mirror that truthiness or a "truncate": null config would load
-        # with silently divergent correction bounds
-        if not rope_scaling.get("truncate", True):
-            raise ValueError(
-                "yarn rope_scaling with a falsy truncate is not supported "
-                "(the correction range would differ from the tables built here)"
-            )
+        # with silently divergent correction bounds (GPT-OSS ships false)
+        yarn_truncate = bool(rope_scaling.get("truncate", True))
 
         def mscale_of(scale: float, m: float = 1.0) -> float:
             return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
@@ -181,14 +176,68 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
             float(attention_factor),
         )
         rope_factor = 1.0
+    elif rope_type == "longrope" and rope_scaling:
+        import math
+
+        # Phi-3.5 LongRoPE: per-dim learned frequency rescales. Phi3-family
+        # configs derive the attention temperature from the ratio of the
+        # (extended) max positions to the pretrained range, NOT from a
+        # "factor" key (HF modeling_rope_utils._compute_longrope_parameters)
+        short = rope_scaling.get("short_factor")
+        long = rope_scaling.get("long_factor")
+        if not short or not long:
+            raise ValueError("longrope rope_scaling needs short_factor and long_factor lists")
+        # HF semantics exactly (_compute_longrope_parameters): ONLY a
+        # top-level original_max_position_embeddings counts (Phi3 carries
+        # it there; a rope_scaling-nested copy is IGNORED by HF), and it
+        # derives the temperature from max/original; without it the
+        # pretrained range is max_position_embeddings itself and the
+        # temperature comes from the rope_scaling "factor" key
+        original_max = float(getattr(hf_config, "original_max_position_embeddings", 0) or 0)
+        if original_max:
+            lr_factor = float(hf_config.max_position_embeddings) / original_max
+        else:
+            original_max = float(hf_config.max_position_embeddings)
+            lr_factor = float(rope_scaling.get("factor") or 1.0)
+        attention_factor = rope_scaling.get("attention_factor")
+        if attention_factor is None:
+            attention_factor = (
+                1.0
+                if lr_factor <= 1.0
+                else math.sqrt(1.0 + math.log(lr_factor) / math.log(original_max))
+            )
+        rope_longrope = (
+            tuple(float(f) for f in short),
+            tuple(float(f) for f in long),
+            original_max,
+            float(attention_factor),
+        )
+        rope_factor = 1.0
     elif rope_scaling and rope_type != "linear":
         raise ValueError(
-            f"Unsupported rope_scaling type {rope_type!r} (linear/llama3/yarn only); "
+            f"Unsupported rope_scaling type {rope_type!r} "
+            "(linear/llama3/yarn/longrope only); "
             "loading would silently distort long-range attention"
         )
     if gemma3:
         sliding_pattern = _gemma3_sliding_pattern(hf_config)
     elif gemma:
+        sliding_pattern = "even"
+    elif model_type == "gpt_oss":
+        # GPT-OSS alternates sliding/full starting with sliding (layer_types
+        # in the config); validate rather than assume — a checkpoint with a
+        # different schedule must not silently window the wrong layers
+        layer_types = getattr(hf_config, "layer_types", None)
+        if layer_types:
+            expected = [
+                "sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(len(layer_types))
+            ]
+            if list(layer_types) != expected:
+                raise ValueError(
+                    f"gpt_oss layer_types {layer_types!r} is not the even-alternating "
+                    "schedule this loader reproduces"
+                )
         sliding_pattern = "even"
     else:
         sliding_pattern = "uniform"
@@ -226,10 +275,17 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         # than silently mapped to a pattern.
         sliding_window=(
             int(getattr(hf_config, "sliding_window", 0) or 0)
-            if model_type in ("gemma2", "gemma3_text", "mistral", "phi3")
+            if model_type in ("gemma2", "gemma3_text", "mistral", "phi3", "gpt_oss")
             else 0
         ),
         sliding_pattern=sliding_pattern,
+        # GPT-OSS: per-head sink logits, biased router/experts, clamped GLU
+        attn_sinks=model_type == "gpt_oss",
+        moe_bias=model_type == "gpt_oss",
+        moe_glu_clamp=7.0 if model_type == "gpt_oss" else 0.0,
+        rope_yarn_truncate=yarn_truncate,
+        rope_longrope=rope_longrope,
+        partial_rotary=partial_rotary,
         rope_local_theta=(
             float(getattr(hf_config, "rope_local_base_freq", 10000.0) or 10000.0)
             if gemma3
@@ -344,7 +400,50 @@ def params_from_state_dict(
             mats.append(get(template.format(layer))[start:stop].T)
         return jnp.asarray(np.stack(mats), dtype=dtype)
 
-    if config.is_moe:
+    if config.is_moe and present("layers.0.mlp.experts.gate_up_proj"):
+        # GPT-OSS fused expert tensors: gate_up_proj (E, D, 2F) with gate on
+        # even output columns and up on odd ([..., ::2] / [..., 1::2] in the
+        # HF forward), stored activation-major so NO transpose; down_proj
+        # (E, F, D) likewise. Router is a Linear (E, D) -> transposed, with
+        # bias; every projection carries a bias.
+        def stacked_fused(suffix: str, pick) -> jnp.ndarray:
+            return jnp.asarray(
+                np.stack(
+                    [
+                        pick(get(f"layers.{layer}.mlp.experts.{suffix}"))
+                        for layer in range(config.n_layers)
+                    ]
+                ),
+                dtype=dtype,
+            )
+
+        mlp_weights = {
+            "router": jnp.asarray(
+                np.stack(
+                    [
+                        get(f"layers.{layer}.mlp.router.weight").T
+                        for layer in range(config.n_layers)
+                    ]
+                ),
+                dtype=jnp.float32,
+            ),
+            "router_bias": jnp.asarray(
+                np.stack(
+                    [
+                        get(f"layers.{layer}.mlp.router.bias")
+                        for layer in range(config.n_layers)
+                    ]
+                ),
+                dtype=jnp.float32,
+            ),
+            "w_gate": stacked_fused("gate_up_proj", lambda w: w[..., ::2]),
+            "w_up": stacked_fused("gate_up_proj", lambda w: w[..., 1::2]),
+            "b_gate": stacked_fused("gate_up_proj_bias", lambda b: b[..., ::2]),
+            "b_up": stacked_fused("gate_up_proj_bias", lambda b: b[..., 1::2]),
+            "w_down": stacked_fused("down_proj", lambda w: w),
+            "b_down": stacked_fused("down_proj_bias", lambda b: b),
+        }
+    elif config.is_moe:
         # two expert layouts share the same math:
         # - Mixtral: block_sparse_moe.gate (router) + experts.M.{w1,w2,w3}
         #   (w1 = gate_proj, w3 = up_proj, both (F, D); w2 = down_proj (D, F))
@@ -418,6 +517,13 @@ def params_from_state_dict(
             "q_norm_full": stacked("layers.{}.self_attn.q_norm.weight", transpose=False),
             "k_norm_full": stacked("layers.{}.self_attn.k_norm.weight", transpose=False),
         }
+    if config.attn_sinks:  # GPT-OSS per-head sink logits (fp32 in the softmax)
+        attn_biases["sinks"] = jnp.asarray(
+            np.stack(
+                [get(f"layers.{layer}.self_attn.sinks") for layer in range(config.n_layers)]
+            ),
+            dtype=jnp.float32,
+        )
     if not config.pre_norms:
         # OLMo-2: post-norm only — the checkpoint has NO input norms, and its
         # q_norm/k_norm are FULL-WIDTH (rms over all heads jointly)

@@ -125,6 +125,13 @@ def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Para
             "w_up": dense(keys[6], (layers, experts, d, ff), d),
             "w_down": dense(keys[7], (layers, experts, ff, d), ff),
         }
+        if config.moe_bias:  # GPT-OSS: router + every expert projection
+            mlp_weights |= {
+                "router_bias": jnp.zeros((layers, experts), dtype=jnp.float32),
+                "b_gate": jnp.zeros((layers, experts, ff), dtype=dtype),
+                "b_up": jnp.zeros((layers, experts, ff), dtype=dtype),
+                "b_down": jnp.zeros((layers, experts, d), dtype=dtype),
+            }
     else:
         mlp_weights = {
             "w_gate": dense(keys[5], (layers, d, ff), d),
@@ -151,6 +158,9 @@ def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Para
             "q_norm_full": norm_init((layers, h * hd), dtype=dtype),
             "k_norm_full": norm_init((layers, kh * hd), dtype=dtype),
         }
+    if config.attn_sinks:  # GPT-OSS: per-head sink logits (fp32 — they live
+        # inside the softmax normalization)
+        attn_biases["sinks"] = jnp.zeros((layers, h), dtype=jnp.float32)
     if config.post_norms:  # Gemma2/OLMo-2 norms on the block outputs
         attn_biases |= {
             "attn_post_norm": norm_init((layers, d), dtype=dtype),
@@ -223,7 +233,8 @@ def _attention_block(
     h, kh, hd = config.n_heads, config.n_kv_heads, config.head_dim
     sm_scale = (config.query_scale or hd) ** -0.5
     gemma_kw = dict(
-        softcap=config.attn_softcap, window=config.sliding_window, sliding=sliding
+        softcap=config.attn_softcap, window=config.sliding_window, sliding=sliding,
+        sinks=lp.get("sinks"),
     )
     cos, sin = rope_tables
     # gather the seq-sized rows FIRST, then (Gemma3) select local vs global
@@ -365,6 +376,11 @@ def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> tuple[jnp.nda
             k=config.experts_per_token,
             capacity_factor=config.capacity_factor,
             norm_topk=config.norm_topk,
+            router_b=lp.get("router_bias"),
+            b_gate=lp.get("b_gate"),
+            b_up=lp.get("b_up"),
+            b_down=lp.get("b_down"),
+            glu_clamp=config.moe_glu_clamp,
         )
         if "mlp_post_norm" in lp:
             y = _norm(y, lp["mlp_post_norm"], config)
@@ -394,6 +410,7 @@ def forward(
     return_aux: bool = False,
     prefill_offset: jnp.ndarray | None = None,  # () traced; chunked prefill at offset
     remat: str = "none",  # "none" | "full" | "dots" — training-path rematerialization
+    longrope_select: int | None = None,  # static run-length bound for LongRoPE
 ):
     """Run the transformer. Returns (logits (B, S, V) fp32, updated cache),
     plus the summed MoE load-balance aux loss when ``return_aux``.
@@ -417,6 +434,22 @@ def forward(
     rope_tables = rope_frequencies(
         config.head_dim, max_pos, config.rope_theta,
         scale=config.rope_scale, llama3=config.rope_llama3, yarn=config.rope_yarn,
+        yarn_truncate=config.rope_yarn_truncate, longrope=config.rope_longrope,
+        # LongRoPE short/long selection follows the run's actual position
+        # bound (static at trace time): callers that know their true bound
+        # (sampler: prompt+max_new) pass it; otherwise cache runs can reach
+        # capacity and no-cache runs only touch seq positions. One run keeps
+        # ONE factor set — HF's mid-generation dynamic switch re-ropes new
+        # queries against keys cached under the other set, which this stack
+        # deliberately avoids. Serving guidance: size a continuous engine's
+        # capacity <= the pretrained range when short-context behavior must
+        # match HF's short factors.
+        longrope_select=(
+            longrope_select
+            if longrope_select is not None
+            else (cache.capacity if cache is not None else seq)
+        ),
+        partial=config.partial_rotary,
     )
     # Gemma3: local (sliding) layers use an unscaled short-range frequency
     rope_tables_local = (

@@ -44,6 +44,18 @@ def _window_ok(delta: jnp.ndarray, window: int, sliding: jnp.ndarray | None) -> 
     return ok
 
 
+def _sink_softmax(scores: jnp.ndarray, sinks: jnp.ndarray) -> jnp.ndarray:
+    """Softmax over [scores, sink] dropping the sink column (GPT-OSS
+    attention sinks): each head owns a learned logit that joins the
+    normalization but contributes no value, damping attention mass on early
+    tokens. ``sinks`` must broadcast against scores' leading dims with a
+    trailing singleton key axis."""
+    m = jnp.maximum(jnp.max(scores, axis=-1, keepdims=True), sinks)
+    p = jnp.exp(scores - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True) + jnp.exp(sinks - m)
+    return p / denom
+
+
 def xla_attention_causal(
     q: jnp.ndarray,  # (B, H, S, D)
     k: jnp.ndarray,  # (B, KH, S, D)
@@ -52,6 +64,7 @@ def xla_attention_causal(
     softcap: float = 0.0,
     window: int = 0,
     sliding: jnp.ndarray | None = None,
+    sinks: jnp.ndarray | None = None,  # (H,) per-head sink logits (GPT-OSS)
 ) -> jnp.ndarray:
     """Reference causal attention (fp32 softmax), GQA via head repetition."""
     num_heads, kv_heads = q.shape[1], k.shape[1]
@@ -67,7 +80,12 @@ def xla_attention_causal(
         pos = jnp.arange(seq)
         allowed = allowed & _window_ok(pos[:, None] - pos[None, :], window, sliding)
     scores = jnp.where(allowed[None, None], scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1)
+    if sinks is not None:
+        probs = _sink_softmax(
+            scores, sinks.astype(jnp.float32).reshape(1, num_heads, 1, 1)
+        )
+    else:
+        probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
 
 
@@ -117,6 +135,7 @@ def decode_attention(
     softcap: float = 0.0,                # Gemma2 score softcapping
     window: int = 0,                     # sliding-window size (0 = global)
     sliding: jnp.ndarray | None = None,  # traced per-layer bool for `window`
+    sinks: jnp.ndarray | None = None,    # (H,) per-head sink logits (GPT-OSS)
 ) -> jnp.ndarray:
     """One decode step against the cache, masking invalid (future) slots.
 
@@ -134,11 +153,11 @@ def decode_attention(
     eval runner does this automatically (evals/runner.py JaxGenerator).
     """
     quantized = k_scale is not None
-    gemma_masking = bool(softcap) or bool(window)
+    gemma_masking = bool(softcap) or bool(window) or sinks is not None
     if impl == "pallas" and (quantized or gemma_masking):
         raise ValueError(
             "flash_decode supports neither int8 caches nor softcap/sliding-"
-            "window yet: use impl='auto'/'xla' for those configs"
+            "window/attention-sinks yet: use impl='auto'/'xla' for those configs"
         )
     if (
         not quantized
@@ -176,7 +195,12 @@ def decode_attention(
         # (lengths-1) - s
         valid = valid & _window_ok(lengths_b - 1 - slot_ids, window, sliding)
     scores = jnp.where(valid, scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1)
+    if sinks is not None:
+        probs = _sink_softmax(
+            scores, sinks.astype(jnp.float32).reshape(1, kv_heads, group, 1)
+        )
+    else:
+        probs = jax.nn.softmax(scores, axis=-1)
     if quantized:
         weighted = (probs * v_scale).astype(jnp.float32)
         out = jnp.einsum(
@@ -199,6 +223,7 @@ def cache_prefill_attention(
     sliding: jnp.ndarray | None = None,
     k_scale: jnp.ndarray | None = None,  # (B, KH, 1, C) int8-cache dequant scales
     v_scale: jnp.ndarray | None = None,
+    sinks: jnp.ndarray | None = None,    # (H,) per-head sink logits (GPT-OSS)
 ) -> jnp.ndarray:
     """Attention for chunked prefill: the chunk's K/V are first *written* into
     the cache at ``offset``, then each chunk query attends over the whole
@@ -241,7 +266,12 @@ def cache_prefill_attention(
     if window:
         visible = visible & _window_ok(q_pos - slot_ids, window, sliding)
     scores = jnp.where(visible[:, None, None], scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1)
+    if sinks is not None:
+        probs = _sink_softmax(
+            scores, sinks.astype(jnp.float32).reshape(1, kv_heads, group, 1, 1)
+        )
+    else:
+        probs = jax.nn.softmax(scores, axis=-1)
     if v_scale is not None:
         weighted = (probs * v_scale[:, :, None, :, :]).astype(jnp.float32)
         out = jnp.einsum(
@@ -262,18 +292,19 @@ def multi_head_attention(
     softcap: float = 0.0,
     window: int = 0,
     sliding: jnp.ndarray | None = None,
+    sinks: jnp.ndarray | None = None,  # (H,) per-head sink logits (GPT-OSS)
 ) -> jnp.ndarray:
-    """Causal self-attention (prefill path). Softcap / sliding-window configs
-    (Gemma2) always take the XLA path — the flash kernel has no variant for
-    them yet."""
+    """Causal self-attention (prefill path). Softcap / sliding-window /
+    attention-sink configs always take the XLA path — the flash kernel has
+    no variant for them yet."""
     head_dim = q.shape[-1]
     if sm_scale is None:
         sm_scale = head_dim**-0.5
-    gemma_masking = bool(softcap) or bool(window)
+    gemma_masking = bool(softcap) or bool(window) or sinks is not None
     if impl == "pallas" and gemma_masking:
         raise ValueError(
-            "flash_attention has no softcap/sliding-window variant yet: "
-            "use impl='auto'/'xla' for those configs"
+            "flash_attention has no softcap/sliding-window/attention-sinks "
+            "variant yet: use impl='auto'/'xla' for those configs"
         )
     if not gemma_masking and (
         impl == "pallas" or (impl == "auto" and _pallas_eligible(q, head_dim))
@@ -281,4 +312,4 @@ def multi_head_attention(
         from prime_tpu.ops.pallas_attention import flash_attention_causal
 
         return flash_attention_causal(q, k, v, sm_scale=sm_scale)
-    return xla_attention_causal(q, k, v, sm_scale, softcap, window, sliding)
+    return xla_attention_causal(q, k, v, sm_scale, softcap, window, sliding, sinks=sinks)

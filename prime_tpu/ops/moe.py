@@ -105,6 +105,12 @@ def moe_mlp(
     capacity_factor: float,
     group_size: int = MOE_GROUP_SIZE,
     norm_topk: bool = True,
+    router_b: jnp.ndarray | None = None,  # (E,) router bias (GPT-OSS)
+    b_gate: jnp.ndarray | None = None,    # (E, F) expert projection biases
+    b_up: jnp.ndarray | None = None,      # (E, F)
+    b_down: jnp.ndarray | None = None,    # (E, D)
+    glu_clamp: float = 0.0,               # GPT-OSS clamped GLU (limit 7.0)
+    glu_alpha: float = 1.702,             # sigmoid temperature for the clamped GLU
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Sparse MoE feed-forward. Returns (output (B, S, D), aux_loss).
 
@@ -131,6 +137,8 @@ def moe_mlp(
     router_logits = jnp.einsum(
         "gtd,de->gte", x_groups.astype(jnp.float32), router_w.astype(jnp.float32)
     )
+    if router_b is not None:
+        router_logits = router_logits + router_b.astype(jnp.float32)
     capacity = expert_capacity(group, n_experts, k, capacity_factor)
     dispatch, combine, aux_loss = jax.vmap(
         lambda logits, v: top_k_routing(logits, k, capacity, valid=v, norm_topk=norm_topk)
@@ -148,9 +156,26 @@ def moe_mlp(
     # GSPMD turns the token contraction into the all-to-all over ICI
     expert_in = jnp.einsum("gtec,gtd->gecd", dispatch, x_groups)
     ff = w_gate[0].shape[-1] if isinstance(w_gate, tuple) else w_gate.shape[-1]
-    gate = jax.nn.silu(expert_einsum("gecd,edf->gecf", expert_in, w_gate, ff))
+    gate = expert_einsum("gecd,edf->gecf", expert_in, w_gate, ff)
     up = expert_einsum("gecd,edf->gecf", expert_in, w_up, ff)
-    expert_out = expert_einsum("gecf,efd->gecd", gate * up, w_down, d_model)
+    if b_gate is not None:  # biases broadcast over the capacity slot axis;
+        gate = gate + b_gate[None, :, None, :].astype(gate.dtype)
+    if b_up is not None:
+        up = up + b_up[None, :, None, :].astype(up.dtype)
+    if glu_clamp:
+        # GPT-OSS clamped GLU: gate capped above, up capped both ways, a
+        # temperature inside the sigmoid, and a +1 on the linear branch —
+        # ff = (up + 1) * gate * sigmoid(alpha * gate). Phantom capacity
+        # slots produce nonzero activations here (bias + the +1), but their
+        # combine weights are zero so nothing reaches the output.
+        gate = jnp.clip(gate, max=glu_clamp)
+        up = jnp.clip(up, min=-glu_clamp, max=glu_clamp)
+        hidden = (up + 1.0) * (gate * jax.nn.sigmoid(glu_alpha * gate))
+    else:
+        hidden = jax.nn.silu(gate) * up
+    expert_out = expert_einsum("gecf,efd->gecd", hidden, w_down, d_model)
+    if b_down is not None:
+        expert_out = expert_out + b_down[None, :, None, :].astype(expert_out.dtype)
     y = jnp.einsum("gtec,gecd->gtd", combine, expert_out)
     y = y.reshape(padded, d_model)[:tokens]
     return y.reshape(batch, seq, d_model), jnp.mean(aux_loss)

@@ -40,13 +40,16 @@ def yarn_inv_freq(
     beta_fast: float,
     beta_slow: float,
     original_max_position: float,
+    truncate: bool = True,
 ) -> jnp.ndarray:
     """YaRN NTK-by-parts frequencies (https://huggingface.co/papers/2309.00071,
     HF ``rope_type: yarn``): fast-rotating dims keep their pretrained
     frequencies (extrapolation), slow dims interpolate by ``factor``, and a
     linear ramp between the beta_fast/beta_slow correction dims blends them.
-    The companion attention temperature is applied to the cos/sin tables by
-    the caller (scaling both scales q·k by its square)."""
+    ``truncate=False`` (GPT-OSS) keeps the fractional correction bounds
+    instead of flooring/ceiling them, shifting the ramp sub-dim. The
+    companion attention temperature is applied to the cos/sin tables by the
+    caller (scaling both scales q·k by its square)."""
     import math
 
     half = head_dim // 2
@@ -59,8 +62,12 @@ def yarn_inv_freq(
             * math.log(original_max_position / (num_rotations * 2 * math.pi))
         ) / (2 * math.log(theta))
 
-    low = max(math.floor(correction_dim(beta_fast)), 0)
-    high = min(math.ceil(correction_dim(beta_slow)), head_dim - 1)
+    low = correction_dim(beta_fast)
+    high = correction_dim(beta_slow)
+    if truncate:
+        low, high = math.floor(low), math.ceil(high)
+    low = max(low, 0)
+    high = min(high, head_dim - 1)
     if low == high:
         high += 0.001  # prevent singularity (HF's guard)
     ramp = jnp.clip(
@@ -73,6 +80,20 @@ def yarn_inv_freq(
     )
 
 
+def longrope_inv_freq(
+    head_dim: int,
+    theta: float,
+    ext_factors: tuple[float, ...],
+) -> jnp.ndarray:
+    """Phi-3.5 LongRoPE (HF ``rope_type: longrope``): each frequency dim gets
+    its own learned rescale factor — ``inv_freq_i = 1 / (ext_i * theta^(2i/d))``.
+    The caller picks the short vs long factor set (by target positions vs the
+    pretrained max) and applies the attention temperature to the tables."""
+    ext = jnp.asarray(ext_factors, dtype=jnp.float32)
+    base = theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    return 1.0 / (ext * base)
+
+
 def rope_frequencies(
     head_dim: int,
     max_positions: int,
@@ -80,8 +101,15 @@ def rope_frequencies(
     scale: float = 1.0,
     llama3: tuple[float, float, float, float] | None = None,
     yarn: tuple[float, float, float, float, float] | None = None,
+    yarn_truncate: bool = True,
+    longrope: tuple[tuple[float, ...], tuple[float, ...], float, float] | None = None,
+    longrope_select: int | None = None,
+    partial: float = 1.0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Return (cos, sin) tables of shape (max_positions, head_dim // 2), float32.
+    """Return (cos, sin) tables of shape (max_positions, rot_dim // 2), float32,
+    where ``rot_dim = int(head_dim * partial)`` (partial rotary, Phi-2 style:
+    only the first rot_dim features of each head rotate; apply_rope_rows
+    passes the rest through untouched).
 
     ``scale`` > 1 applies linear position scaling (positions stretched by the
     factor — HF ``rope_scaling {"rope_type": "linear"}``, e.g. Gemma3 4b+).
@@ -89,21 +117,35 @@ def rope_frequencies(
     original_max_position) applies Llama 3.1+ frequency-dependent scaling.
     ``yarn`` = (factor, beta_fast, beta_slow, original_max_position,
     attention_factor) applies YaRN NTK-by-parts scaling with its attention
-    temperature folded into the tables. The three are mutually exclusive.
+    temperature folded into the tables; ``yarn_truncate=False`` keeps the
+    fractional correction bounds (GPT-OSS). ``longrope`` = (short_factors,
+    long_factors, original_max_position, attention_factor) applies Phi-3.5
+    per-dim rescaling; the long set applies when ``longrope_select`` (the
+    run's actual position bound — HF selects by RUNTIME seq_len, so a prompt
+    inside the pretrained range gets the short factors even though the table
+    is sized to max_seq_len; defaults to the table size) exceeds the
+    pretrained range. The scaling families are mutually exclusive.
     """
-    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    rot_dim = int(head_dim * partial)
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, rot_dim, 2, dtype=jnp.float32) / rot_dim))
     attention_factor = 1.0
     if llama3 is not None:
         inv_freq = llama3_inv_freq(inv_freq, *llama3)
     elif yarn is not None:
         factor, beta_fast, beta_slow, original_max, attention_factor = yarn
         inv_freq = yarn_inv_freq(
-            inv_freq, head_dim, theta, factor, beta_fast, beta_slow, original_max
+            inv_freq, rot_dim, theta, factor, beta_fast, beta_slow, original_max,
+            truncate=yarn_truncate,
         )
+    elif longrope is not None:
+        short_factors, long_factors, original_max, attention_factor = longrope
+        select = longrope_select if longrope_select is not None else max_positions
+        ext = long_factors if select > original_max else short_factors
+        inv_freq = longrope_inv_freq(rot_dim, theta, ext)
     elif scale != 1.0:
         inv_freq = inv_freq / scale
     positions = jnp.arange(max_positions, dtype=jnp.float32)
-    angles = jnp.outer(positions, inv_freq)  # (P, D/2)
+    angles = jnp.outer(positions, inv_freq)  # (P, rot_dim/2)
     return jnp.cos(angles) * attention_factor, jnp.sin(angles) * attention_factor
 
 
@@ -125,12 +167,18 @@ def apply_rope_rows(
     """Rotate with pre-gathered per-position rows. Callers that must select
     between frequency tables (Gemma3 local vs global layers) gather the
     seq-sized rows from each table FIRST and select those — a full-table
-    select before the gather would touch (max_pos, D/2) per layer per step."""
+    select before the gather would touch (max_pos, D/2) per layer per step.
+
+    Partial rotary (Phi-2/Phi-3 ``partial_rotary_factor``): when the tables
+    cover fewer than head_dim//2 frequencies, only the first 2*half features
+    rotate and the tail passes through unchanged."""
     dtype = x.dtype
-    half = x.shape[-1] // 2
-    c = cos_rows[:, :, None, :]  # (B, S, 1, D/2)
+    half = cos_rows.shape[-1]
+    c = cos_rows[:, :, None, :]  # (B, S, 1, half)
     s = sin_rows[:, :, None, :]
     x1 = x[..., :half].astype(jnp.float32)
-    x2 = x[..., half:].astype(jnp.float32)
-    rotated = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
-    return rotated.astype(dtype)
+    x2 = x[..., half : 2 * half].astype(jnp.float32)
+    rotated = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(dtype)
+    if 2 * half == x.shape[-1]:
+        return rotated
+    return jnp.concatenate([rotated, x[..., 2 * half :]], axis=-1)

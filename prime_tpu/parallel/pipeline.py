@@ -38,6 +38,13 @@ def pipeline_param_specs(config: ModelConfig) -> dict:
             "w_up": P("pp", None, None, None),
             "w_down": P("pp", None, None, None),
         }
+        if config.moe_bias:  # GPT-OSS biases stage with their projections
+            mlp_spec |= {
+                "router_bias": P("pp", None),
+                "b_gate": P("pp", None, None),
+                "b_up": P("pp", None, None),
+                "b_down": P("pp", None, None),
+            }
     else:
         mlp_spec = {
             "w_gate": P("pp", None, None),
@@ -59,6 +66,8 @@ def pipeline_param_specs(config: ModelConfig) -> dict:
         layer_spec |= {"bo": P("pp", None)}
     if config.qk_norm:
         layer_spec |= {"q_norm": P("pp", None), "k_norm": P("pp", None)}
+    if config.attn_sinks:
+        layer_spec |= {"sinks": P("pp", None)}
     if config.qk_norm_full:
         layer_spec |= {"q_norm_full": P("pp", None), "k_norm_full": P("pp", None)}
     if config.post_norms:
@@ -127,8 +136,12 @@ def pipeline_forward(
     positions = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None, :], (micro, seq))
     rope_tables = rope_frequencies(
         config.head_dim, max(seq, config.max_seq_len), config.rope_theta,
-        # must match forward()'s rope math exactly
+        # must match forward()'s rope math exactly (incl. the round-4
+        # families: non-truncated yarn, LongRoPE, partial rotary; the
+        # no-cache path selects LongRoPE factors by seq)
         scale=config.rope_scale, llama3=config.rope_llama3, yarn=config.rope_yarn,
+        yarn_truncate=config.rope_yarn_truncate, longrope=config.rope_longrope,
+        longrope_select=seq, partial=config.partial_rotary,
     )
     rope_tables_local = (
         rope_frequencies(config.head_dim, max(seq, config.max_seq_len), config.rope_local_theta)

@@ -33,6 +33,13 @@ def param_specs(config: ModelConfig) -> dict[str, Any]:
             "w_up": P(None, "ep", "fsdp", "tp"),
             "w_down": P(None, "ep", "tp", "fsdp"),
         }
+        if config.moe_bias:  # GPT-OSS: biases land with their projections
+            mlp_specs |= {
+                "router_bias": P(None, None),
+                "b_gate": P(None, "ep", "tp"),
+                "b_up": P(None, "ep", "tp"),
+                "b_down": P(None, "ep", "fsdp"),
+            }
     else:
         mlp_specs = {
             "w_gate": P(None, "fsdp", "tp"),
@@ -49,6 +56,10 @@ def param_specs(config: ModelConfig) -> dict[str, Any]:
     if config.qk_norm:
         # (L, head_dim) weights shared across heads: replicate
         attn_bias_specs |= {"q_norm": P(None, None), "k_norm": P(None, None)}
+    if config.attn_sinks:
+        # (L, H) per-head logits: the head axis rides tp like the q heads
+        # they normalize (each device needs only its own heads' sinks)
+        attn_bias_specs["sinks"] = P(None, "tp")
     if config.qk_norm_full:
         # (L, H*hd) on the projection output dim — same tp split as the
         # matrices' output columns so the norm weight lands with its slice

@@ -507,3 +507,27 @@ def test_bigram_index_matches_backward_scan():
             assert engine._propose_drafts(0) == scan_reference(
                 engine._histories[0], 4, engine.pad_id
             )
+
+
+def test_engine_gptoss_matches_sampler():
+    """GPT-OSS architecture through the continuous engine: attention sinks
+    and the biased clamped-GLU MoE must produce the sampler's exact greedy
+    tokens through chunked prefill + slot decode."""
+    config = get_config("tiny-gptoss")
+    params = init_params(jax.random.PRNGKey(3), config, dtype=jnp.float32)
+    prompts = [[5, 42, 100, 7, 61, 9], [17, 3, 88]]
+    refs = []
+    for p in prompts:
+        result = generate(
+            params, jnp.asarray([p], dtype=jnp.int32),
+            jnp.asarray([len(p)], dtype=jnp.int32), config,
+            jax.random.PRNGKey(7), max_new_tokens=10, temperature=0.0,
+        )
+        refs.append(result.tokens[0].tolist())
+    engine = ContinuousBatchingEngine(
+        params, config, pad_id=0, max_slots=2, capacity=128, chunk=4,
+    )
+    reqs = [engine.submit(p, max_new_tokens=10) for p in prompts]
+    drain(engine, *reqs)
+    for req, ref in zip(reqs, refs):
+        assert req.all_tokens(timeout=1) == ref

@@ -328,3 +328,39 @@ def test_run_grpo_does_not_consume_caller_params():
     # any host-side use of the original tree must still work
     total = float(jnp.sum(params["embed"]))
     assert np.isfinite(total)
+
+
+def test_run_grpo_lora_with_remat_matches_no_remat():
+    """The GRPO-LoRA fused path under activation checkpointing: remat must
+    change memory, not math — adapters after a rematerialized run equal the
+    plain run's bit-for-bit aside from fp reassociation."""
+    from prime_tpu.train.lora import LoraConfig
+
+    config = get_config("tiny-test")
+    params = init_params(jax.random.PRNGKey(1), config, dtype=jnp.float32)
+
+    def run(remat):
+        cfg = GrpoConfig(
+            group_size=4, prompts_per_step=2, max_prompt_len=8, max_new_tokens=4,
+            temperature=1.0, steps=2, kl_coef=0.05, learning_rate=1e-2,
+            remat=remat,
+        )
+        state, report = run_grpo(
+            config, params, ByteTokenizer(),
+            examples=[{"prompt": "ab", "answer": "ab"}],
+            scorer=lambda c, a: float(len(c) > 0),
+            cfg=cfg,
+            rng=jax.random.PRNGKey(5),
+            lora=LoraConfig(r=4, alpha=8),
+        )
+        assert np.isfinite(report.final_loss)
+        return state
+
+    plain = run("none")
+    dots = run("dots")
+    full = run("full")
+    # recompute reassociates fp ops; tolerance covers that, not a math change
+    for a, b in zip(jax.tree.leaves(plain.params), jax.tree.leaves(dots.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(plain.params), jax.tree.leaves(full.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)

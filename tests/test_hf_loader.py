@@ -787,7 +787,10 @@ def test_phi3_decode_matches_transformers_generation(phi3_model):
     np.testing.assert_array_equal(np.asarray(result.tokens[0]), hf_out)
 
 
-def test_phi3_partial_rotary_rejected():
+def test_phi3_partial_rotary_carried():
+    """partial_rotary_factor is supported (round 4): the config carries it
+    and only head_dim*factor features rotate (parity pinned by
+    test_phi3_longrope_and_partial_rotary_match_transformers)."""
     from prime_tpu.models.hf_loader import config_from_hf
 
     class Cfg:
@@ -800,8 +803,7 @@ def test_phi3_partial_rotary_rejected():
         intermediate_size = 128
         partial_rotary_factor = 0.75
 
-    with pytest.raises(ValueError, match="partial_rotary"):
-        config_from_hf(Cfg())
+    assert config_from_hf(Cfg()).partial_rotary == 0.75
 
 
 def test_llama3_rope_scaling_logits_match_transformers():
@@ -883,7 +885,9 @@ def test_yarn_rope_scaling_logits_match_transformers():
     np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=5e-4, atol=5e-4)
 
 
-def test_yarn_truncate_false_rejected():
+def test_yarn_truncate_false_carried():
+    """Non-truncated yarn is supported (round 4, GPT-OSS ships it): the
+    fractional correction bounds ride the config instead of being rejected."""
     from prime_tpu.models.hf_loader import config_from_hf
 
     class Cfg:
@@ -895,8 +899,11 @@ def test_yarn_truncate_false_rejected():
         intermediate_size = 128
         rope_scaling = {"rope_type": "yarn", "factor": 4.0, "truncate": False}
 
-    with pytest.raises(ValueError, match="truncate"):
-        config_from_hf(Cfg())
+    config = config_from_hf(Cfg())
+    assert config.rope_yarn is not None and config.rope_yarn_truncate is False
+    # truncate defaults True when absent
+    Cfg.rope_scaling = {"rope_type": "yarn", "factor": 4.0}
+    assert config_from_hf(Cfg()).rope_yarn_truncate is True
 
 
 def test_rope_scaling_default_accepted_and_long_context_capped():
@@ -1020,3 +1027,189 @@ def test_moe_configs_get_dropless_headroom_capacity():
         num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
     )
     assert config_from_hf(hf_dense).capacity_factor == 1.25
+
+
+# -- GPT-OSS family ------------------------------------------------------------
+# attention sinks + biased clamped-GLU MoE + even-alternating sliding window +
+# non-truncated yarn (reference for WHAT to support: the HF gpt_oss family;
+# math mirrored from transformers modeling_gpt_oss eager paths)
+
+
+@pytest.fixture(scope="module")
+def gptoss_model():
+    cfg = transformers.GptOssConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=48,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        sliding_window=8,
+        max_position_embeddings=128,
+        rope_theta=150000.0,
+        rope_scaling={
+            "rope_type": "yarn",
+            "factor": 32.0,
+            "beta_fast": 32.0,
+            "beta_slow": 1.0,
+            "truncate": False,
+            "original_max_position_embeddings": 64,
+        },
+        layer_types=["sliding_attention", "full_attention"],
+        attention_bias=True,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.GptOssForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_gptoss_config_mapping(gptoss_model):
+    config = config_from_hf(gptoss_model.config, name="tiny-gptoss-hf")
+    assert config.attn_sinks and config.moe_bias and config.moe_glu_clamp == 7.0
+    assert config.sliding_window == 8 and config.sliding_pattern == "even"
+    assert config.rope_yarn is not None and config.rope_yarn_truncate is False
+    assert config.n_experts == 4 and config.experts_per_token == 2
+    assert config.attn_bias and config.attn_out_bias
+    assert config.head_dim == 16
+
+
+def test_gptoss_logits_match_transformers(gptoss_model):
+    state = {k: v.float().numpy() for k, v in gptoss_model.state_dict().items()}
+    config = config_from_hf(gptoss_model.config, name="tiny-gptoss-hf")
+    # HF routes dropless on CPU; crank capacity so no token can drop and the
+    # comparison isolates the sink/clamped-GLU/bias math itself
+    config = config.scaled(capacity_factor=float(config.n_experts))
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+
+    tokens = np.array([[3, 17, 200, 45, 9, 88, 121, 7, 54, 33, 2, 99]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = gptoss_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    our_logits, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=5e-4, atol=5e-4)
+
+
+def test_gptoss_greedy_decode_matches_transformers(gptoss_model):
+    from prime_tpu.models.sampler import generate
+
+    state = {k: v.float().numpy() for k, v in gptoss_model.state_dict().items()}
+    config = config_from_hf(gptoss_model.config, name="tiny-gptoss-hf")
+    config = config.scaled(capacity_factor=float(config.n_experts))
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+
+    prompt = np.array([[5, 42, 100, 7, 61]], dtype=np.int32)
+    with torch.no_grad():
+        hf_out = gptoss_model.generate(
+            torch.tensor(prompt, dtype=torch.long), max_new_tokens=8, do_sample=False
+        ).numpy()[:, prompt.shape[1]:]
+    import jax
+
+    result = generate(
+        params, jnp.asarray(prompt), jnp.asarray([prompt.shape[1]], dtype=jnp.int32),
+        config, jax.random.PRNGKey(0), max_new_tokens=8, temperature=0.0,
+    )
+    assert np.asarray(result.tokens)[0].tolist() == hf_out[0].tolist()
+
+
+def test_gptoss_rejects_non_alternating_layer_types(gptoss_model):
+    import copy
+
+    cfg = copy.deepcopy(gptoss_model.config)
+    cfg.layer_types = ["full_attention", "sliding_attention"]
+    with pytest.raises(ValueError, match="even-alternating"):
+        config_from_hf(cfg)
+
+
+# -- Phi-3.5: longrope + partial rotary ---------------------------------------
+
+
+def test_phi3_longrope_and_partial_rotary_match_transformers():
+    """Phi3 with longrope scaling AND a partial rotary factor: logits parity
+    proves the per-dim frequency rescale, the attention temperature, and the
+    rotate-first-dims-only application all match HF."""
+    cfg = transformers.Phi3Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        partial_rotary_factor=0.5,
+        max_position_embeddings=256,
+        original_max_position_embeddings=64,
+        rope_theta=10000.0,
+        rope_scaling={
+            "type": "longrope",
+            "short_factor": [1.0 + 0.1 * i for i in range(4)],
+            "long_factor": [2.0 + 0.5 * i for i in range(4)],
+        },
+        sliding_window=None,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+        pad_token_id=0,  # default 32000 would index past the tiny vocab
+        bos_token_id=1,
+        eos_token_id=2,
+    )
+    torch.manual_seed(1)
+    model = transformers.Phi3ForCausalLM(cfg)
+    model.eval()
+    state = {k: v.float().numpy() for k, v in model.state_dict().items()}
+    config = config_from_hf(cfg, name="tiny-phi35")
+    assert config.partial_rotary == 0.5
+    assert config.rope_longrope is not None
+    params = params_from_state_dict(state, config, dtype=jnp.float32)
+
+    tokens = np.array([[3, 17, 200, 45, 9, 88, 121, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    our_logits, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(our_logits), hf_logits, rtol=5e-4, atol=5e-4)
+
+
+def test_longrope_factor_semantics_match_hf():
+    """HF's _compute_longrope_parameters reads original_max_position_embeddings
+    ONLY from the config top level (a rope_scaling-nested copy is ignored) and
+    falls back to the rope_scaling 'factor' key for the attention temperature.
+    The loader must mirror that exactly or logits silently diverge."""
+    import math
+
+    from prime_tpu.models.hf_loader import config_from_hf
+
+    class Cfg:
+        model_type = "llama"
+        vocab_size = 128
+        hidden_size = 64
+        num_hidden_layers = 2
+        num_attention_heads = 4
+        intermediate_size = 128
+        max_position_embeddings = 4096
+        rope_scaling = {
+            "rope_type": "longrope",
+            "short_factor": [1.0] * 8,
+            "long_factor": [2.0] * 8,
+            "factor": 4.0,
+            # HF IGNORES this nested key — so must we
+            "original_max_position_embeddings": 64,
+        }
+
+    config = config_from_hf(Cfg())
+    short, long, original_max, attention_factor = config.rope_longrope
+    assert original_max == 4096.0  # NOT the nested 64
+    assert attention_factor == pytest.approx(
+        math.sqrt(1.0 + math.log(4.0) / math.log(4096.0))
+    )
+
+    # with a top-level original_max, the temperature derives from the ratio
+    # and the factor key is ignored (Phi3 behavior)
+    Cfg2 = type("Cfg2", (), dict(vars(Cfg)))
+    Cfg2.original_max_position_embeddings = 1024
+    _, _, original_max2, attention_factor2 = config_from_hf(Cfg2()).rope_longrope
+    assert original_max2 == 1024.0
+    assert attention_factor2 == pytest.approx(
+        math.sqrt(1.0 + math.log(4096.0 / 1024.0) / math.log(1024.0))
+    )

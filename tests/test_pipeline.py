@@ -164,3 +164,18 @@ def test_pipeline_forward_matches_dense_gemma3_style():
     staged = shard_pipeline_params(gparams, mesh, cfg)
     out = pipeline_forward(staged, tokens, cfg, mesh, n_microbatches=2)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_pipeline_gptoss_matches_dense():
+    """GPT-OSS config (sinks + biased clamped-GLU MoE + even-alternating
+    sliding window + non-truncated yarn) under pipeline parallelism: the
+    sinks/bias leaves shard over pp with the layer stack and the staged
+    logits match the plain scan."""
+    cfg = get_config("tiny-gptoss").scaled(n_layers=4, capacity_factor=8.0)
+    mparams = init_params(jax.random.PRNGKey(11), cfg, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(12), (4, 16), 1, cfg.vocab_size)
+    ref, _ = forward(mparams, tokens, cfg, attn_impl="xla")
+    mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    staged = shard_pipeline_params(mparams, mesh, cfg)
+    out = pipeline_forward(staged, tokens, cfg, mesh, n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
