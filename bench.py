@@ -422,6 +422,49 @@ def main() -> None:
         record["longctx_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: longctx section failed: {e}", flush=True)
 
+    # ---- winctx: sliding-window flash decode at long context ----------------
+    # The round-4 kernel variant: a sliding layer's decode step front-skips
+    # cache blocks before the window, so it streams ~window slots instead of
+    # the whole cache (Gemma2/3, Mistral, GPT-OSS layers). Microbench of the
+    # decode step itself at C=4096 / window=1024: pallas-with-skip vs the
+    # XLA path that reads everything and masks.
+    try:
+        from prime_tpu.ops.attention import decode_attention
+
+        wb, wh, wkh, wd, wc, wwin = 8, 32, 8, 64, 4096, 1024
+        wq = jax.random.normal(jax.random.PRNGKey(7), (wb, wh, 1, wd), dtype=jnp.bfloat16)
+        wk = jax.random.normal(jax.random.PRNGKey(8), (wb, wkh, wd, wc), dtype=jnp.bfloat16)
+        wv = jax.random.normal(jax.random.PRNGKey(9), (wb, wkh, wd, wc), dtype=jnp.bfloat16)
+        wlens = jnp.full((wb,), wc, dtype=jnp.int32)
+
+        # both sides jitted: an eager XLA baseline would pay per-op dispatch
+        # at this microsecond scale and flatter the kernel (spdecode's scheme)
+        win_xla_fn = jax.jit(
+            lambda: decode_attention(
+                wq, wk, wv, wlens, wd**-0.5, impl="xla", window=wwin,
+                sliding=jnp.asarray(True),
+            )
+        )
+        win_pallas_fn = jax.jit(
+            lambda: decode_attention(
+                wq, wk, wv, wlens, wd**-0.5, impl="pallas", window=wwin,
+                sliding=jnp.asarray(True),
+            )
+        )
+        win_xla_s = time_fn(lambda: float(jnp.sum(win_xla_fn())), iterations=5)
+        win_pallas_s = time_fn(lambda: float(jnp.sum(win_pallas_fn())), iterations=5)
+        record["winctx_xla_us"] = round(win_xla_s * 1e6, 1)
+        record["winctx_pallas_us"] = round(win_pallas_s * 1e6, 1)
+        record["winctx_pallas_speedup"] = round(win_xla_s / win_pallas_s, 3)
+        print(
+            f"# bench: winctx C={wc} win={wwin} pallas {record['winctx_pallas_us']}us "
+            f"vs xla {record['winctx_xla_us']}us",
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001
+        record["winctx_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: winctx section failed: {e}", flush=True)
+
     # ---- spdecode: sequence-parallel decode step ----------------------------
     # The long-context decode path a v5e-8+ slice runs (cache slots sharded
     # over sp, two-phase softmax combine — parallel/long_context.py), timed

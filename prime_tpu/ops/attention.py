@@ -153,20 +153,23 @@ def decode_attention(
     eval runner does this automatically (evals/runner.py JaxGenerator).
     """
     quantized = k_scale is not None
-    gemma_masking = bool(softcap) or bool(window) or sinks is not None
-    if impl == "pallas" and (quantized or gemma_masking):
+    if impl == "pallas" and quantized:
         raise ValueError(
-            "flash_decode supports neither int8 caches nor softcap/sliding-"
-            "window/attention-sinks yet: use impl='auto'/'xla' for those configs"
+            "flash_decode has no int8-cache variant yet: use impl='auto'/'xla' "
+            "for quantized caches"
         )
-    if (
-        not quantized
-        and not gemma_masking
-        and (impl == "pallas" or (impl == "auto" and _decode_pallas_eligible(k_cache)))
+    if not quantized and (
+        impl == "pallas" or (impl == "auto" and _decode_pallas_eligible(k_cache))
     ):
         from prime_tpu.ops.pallas_attention import flash_decode
 
-        return flash_decode(q, k_cache, v_cache, cache_lengths, sm_scale=sm_scale)
+        # softcap/sliding-window/sinks ride the kernel (Gemma2/3, Mistral,
+        # Phi-3, GPT-OSS): the window even front-skips cache blocks, so a
+        # sliding layer streams ~window slots instead of the whole cache
+        return flash_decode(
+            q, k_cache, v_cache, cache_lengths, sm_scale=sm_scale,
+            softcap=softcap, window=window, sliding=sliding, sinks=sinks,
+        )
 
     batch, num_heads, _, head_dim = q.shape
     kv_heads = k_cache.shape[1]

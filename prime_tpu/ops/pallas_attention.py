@@ -77,18 +77,26 @@ def _flash_kernel(
 
 def _decode_kernel(
     lengths_ref,  # (B,) scalar-prefetch, SMEM
+    window_ref,   # (1,) scalar-prefetch: effective window (0 = global layer)
     q_ref,        # (1, 1, G, D)
     k_ref,        # (1, 1, D, C) one kv head's cache, feature-major
     v_ref,        # (1, 1, D, C)
+    sinks_ref,    # (1, G) this kv head's group of sink logits
     o_ref,        # (1, 1, G, D)
     *,
     sm_scale: float,
     block_c: int,
+    softcap: float,
+    use_sinks: bool,
 ):
     b = pl.program_id(0)
     q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (G, D)
     group = q.shape[0]
     length = lengths_ref[b]
+    window = window_ref[0]
+    # the query sits at position length-1; a sliding layer sees slots
+    # [length-window, length), a global layer (window 0) sees [0, length)
+    first_slot = jnp.where(window > 0, jnp.maximum(length - window, 0), 0)
 
     m = jnp.full((group, 1), NEG_INF, dtype=jnp.float32)
     l = jnp.zeros((group, 1), dtype=jnp.float32)
@@ -101,8 +109,10 @@ def _decode_kernel(
         scores = jax.lax.dot_general(
             q, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # (G, BC)
+        if softcap:
+            scores = jnp.tanh(scores / softcap) * softcap
         slots = cb * block_c + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        scores = jnp.where(slots < length, scores, NEG_INF)
+        scores = jnp.where((slots < length) & (slots >= first_slot), scores, NEG_INF)
 
         m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
         p = jnp.exp(scores - m_new)
@@ -113,27 +123,52 @@ def _decode_kernel(
         )  # (G, D)
         return m_new, l_new, acc_new
 
-    # early exit: only stream cache blocks that hold valid entries for THIS
-    # sequence — mid-generation that is ~half the capacity, and the decode
-    # step is pure HBM bandwidth, so skipped blocks are direct speedup
+    # early exit BOTH ways: only stream cache blocks that hold live entries
+    # for THIS sequence — from the back that is the valid length
+    # (mid-generation ~half the capacity), and on a sliding layer the front
+    # skip leaves only ~window/block_c blocks; the decode step is pure HBM
+    # bandwidth, so every skipped block is direct speedup
+    start_block = first_slot // block_c
     num_blocks = pl.cdiv(length, block_c)
-    m, l, acc = jax.lax.fori_loop(0, num_blocks, body, (m, l, acc))
-    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    m, l, acc = jax.lax.fori_loop(start_block, num_blocks, body, (m, l, acc))
+    if use_sinks:
+        # GPT-OSS attention sinks: the per-head logit joins the softmax
+        # normalization (no value contribution) — rescale the accumulators
+        # to the combined max, then add exp(sink) to the denominator
+        sink = sinks_ref[0].astype(jnp.float32).reshape(group, 1)
+        m_final = jnp.maximum(m, sink)
+        scale = jnp.exp(m - m_final)
+        denom = l * scale + jnp.exp(sink - m_final)
+        o_ref[0, 0] = (acc * scale / jnp.maximum(denom, 1e-30)).astype(o_ref.dtype)
+    else:
+        o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "softcap", "window", "interpret")
+)
 def flash_decode(
     q: jnp.ndarray,              # (B, H, 1, D)
     k_cache: jnp.ndarray,        # (B, KH, D, C) feature-major
     v_cache: jnp.ndarray,        # (B, KH, D, C)
     cache_lengths: jnp.ndarray,  # (B,) valid entries per sequence
     sm_scale: float | None = None,
+    softcap: float = 0.0,                # Gemma2 score softcapping
+    window: int = 0,                     # sliding-window size (0 = global)
+    sliding: jnp.ndarray | None = None,  # traced per-layer bool for `window`
+    sinks: jnp.ndarray | None = None,    # (H,) per-head sink logits (GPT-OSS)
     interpret: bool = False,
 ) -> jnp.ndarray:
     """One fused decode step: for each (batch, kv-head) program, stream the
     cache through VMEM with online softmax, stopping at the sequence's true
     length (scalar-prefetched). C must be a multiple of BLOCK_C. The
-    feature-major cache keeps reads lane-aligned for any head_dim."""
+    feature-major cache keeps reads lane-aligned for any head_dim.
+
+    Gemma/GPT-OSS variants ride the same kernel: ``softcap`` tanh-caps the
+    scores, ``window`` (+ the traced per-layer ``sliding`` flag the model
+    scan carries) masks AND front-skips cache blocks — a sliding layer
+    streams only ~window slots instead of the whole cache — and ``sinks``
+    adds each head's learned logit to the softmax denominator."""
     batch, num_heads, _, head_dim = q.shape
     kv_heads, capacity = k_cache.shape[1], k_cache.shape[3]
     assert num_heads % kv_heads == 0
@@ -142,16 +177,34 @@ def flash_decode(
         sm_scale = head_dim**-0.5
     block_c = min(BLOCK_C, capacity)
 
-    kernel = functools.partial(_decode_kernel, sm_scale=sm_scale, block_c=block_c)
+    # effective window as a prefetched scalar: the layer scan traces
+    # `sliding`, so the window can't be folded statically — 0 means global
+    if window:
+        on = sliding if sliding is not None else jnp.asarray(True)
+        window_arr = jnp.where(on, jnp.int32(window), jnp.int32(0)).reshape(1)
+    else:
+        window_arr = jnp.zeros((1,), jnp.int32)
+    use_sinks = sinks is not None
+    sinks_arr = (
+        sinks.astype(jnp.float32).reshape(kv_heads, group)
+        if use_sinks
+        else jnp.zeros((kv_heads, group), jnp.float32)
+    )
+
+    kernel = functools.partial(
+        _decode_kernel, sm_scale=sm_scale, block_c=block_c, softcap=softcap,
+        use_sinks=use_sinks,
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(batch, kv_heads),
         in_specs=[
-            pl.BlockSpec((1, 1, group, head_dim), lambda b, h, lens: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, head_dim, capacity), lambda b, h, lens: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, head_dim, capacity), lambda b, h, lens: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, group, head_dim), lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, head_dim, capacity), lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, head_dim, capacity), lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec((1, group), lambda b, h, *_: (h, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, group, head_dim), lambda b, h, lens: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, group, head_dim), lambda b, h, *_: (b, h, 0, 0)),
     )
     out = pl.pallas_call(
         kernel,
@@ -163,7 +216,10 @@ def flash_decode(
             transcendentals=batch * num_heads * capacity,
         ),
         interpret=interpret,
-    )(cache_lengths.astype(jnp.int32), q.reshape(batch, kv_heads, group, head_dim), k_cache, v_cache)
+    )(
+        cache_lengths.astype(jnp.int32), window_arr,
+        q.reshape(batch, kv_heads, group, head_dim), k_cache, v_cache, sinks_arr,
+    )
     return out.reshape(batch, num_heads, 1, head_dim)
 
 

@@ -30,9 +30,17 @@ def flash_decode_sharded(
     cache_lengths: jnp.ndarray,  # (B,)
     mesh,
     sm_scale: float | None = None,
+    softcap: float = 0.0,
+    window: int = 0,
+    sliding: jnp.ndarray | None = None,
+    sinks: jnp.ndarray | None = None,  # (H,) per-head sink logits (GPT-OSS)
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Per-shard pallas flash decode over a (dp, fsdp, tp[, ...]) mesh."""
+    """Per-shard pallas flash decode over a (dp, fsdp, tp[, ...]) mesh.
+
+    The Gemma/GPT-OSS variants shard cleanly: softcap and the window are
+    per-score/per-slot (no cross-shard state), and sinks split over tp with
+    the heads they normalize."""
     from prime_tpu.ops.pallas_attention import flash_decode
     from prime_tpu.parallel import sharding
 
@@ -44,18 +52,28 @@ def flash_decode_sharded(
     q_spec = sharding.prune_spec(P(("dp", "fsdp"), "tp", None, None), mesh)
     kv_spec = q_spec
     lengths_spec = sharding.prune_spec(sharding.lengths_spec(), mesh)
+    sinks_spec = sharding.prune_spec(P("tp"), mesh)
+    if sinks is None:
+        # dummy replicated zeros keep ONE shard_map signature; use_sinks
+        # stays False inside flash_decode via the has_sinks closure below
+        sinks_in = jnp.zeros((q.shape[1],), jnp.float32)
+    else:
+        sinks_in = sinks.astype(jnp.float32)
+    has_sinks = sinks is not None
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(q_spec, kv_spec, kv_spec, lengths_spec),
+        in_specs=(q_spec, kv_spec, kv_spec, lengths_spec, sinks_spec),
         out_specs=q_spec,
         # pallas_call's out ShapeDtypeStruct carries no varying-axes metadata
         check_vma=False,
     )
-    def local_decode(q_local, k_local, v_local, lengths_local):
+    def local_decode(q_local, k_local, v_local, lengths_local, sinks_local):
         return flash_decode(
-            q_local, k_local, v_local, lengths_local, sm_scale=sm_scale, interpret=interpret
+            q_local, k_local, v_local, lengths_local, sm_scale=sm_scale,
+            softcap=softcap, window=window, sliding=sliding,
+            sinks=sinks_local if has_sinks else None, interpret=interpret,
         )
 
-    return local_decode(q, k_cache, v_cache, cache_lengths)
+    return local_decode(q, k_cache, v_cache, cache_lengths, sinks_in)

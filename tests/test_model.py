@@ -312,7 +312,7 @@ def test_pallas_decode_refused_for_quantized_cache():
     q = jnp.zeros((1, 4, 1, 32))
     kq = jnp.zeros((1, 2, 32, 128), jnp.int8)
     scale = jnp.ones((1, 2, 1, 128))
-    with pytest.raises(ValueError, match="int8 caches"):
+    with pytest.raises(ValueError, match="int8-cache"):
         decode_attention(q, kq, kq, jnp.ones((1,), jnp.int32), 1.0,
                          impl="pallas", k_scale=scale, v_scale=scale)
 

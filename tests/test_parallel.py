@@ -353,3 +353,70 @@ def test_sp_decode_parity_long_cache():
     ref = decode_attention(q, k_cache, v_cache, lengths, d**-0.5, impl="xla")
     out = sp_decode_attention(q, k_cache, v_cache, lengths, mesh)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_flash_decode_gemma_gptoss_variants_match_xla():
+    """The round-4 kernel variants (softcap, sliding window with front-block
+    skip, attention sinks — alone and combined) vs the XLA decode path, over
+    ragged lengths that straddle block boundaries."""
+    from prime_tpu.ops.attention import decode_attention
+    from prime_tpu.ops.pallas_attention import flash_decode
+
+    b, h, kh, d, c = 4, 8, 2, 64, 512
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, 1, d), dtype=jnp.float32)
+    k_cache = jax.random.normal(jax.random.PRNGKey(1), (b, kh, d, c), dtype=jnp.float32)
+    v_cache = jax.random.normal(jax.random.PRNGKey(2), (b, kh, d, c), dtype=jnp.float32)
+    lengths = jnp.asarray([512, 1, 130, 300], dtype=jnp.int32)
+    sinks = jax.random.normal(jax.random.PRNGKey(3), (h,), dtype=jnp.float32)
+
+    cases = [
+        dict(softcap=30.0),
+        dict(window=64),                               # window < every block span
+        dict(window=64, sliding=jnp.asarray(True)),
+        dict(window=64, sliding=jnp.asarray(False)),   # traced OFF -> global
+        dict(window=200),                              # window crosses block boundaries
+        dict(sinks=sinks),
+        dict(softcap=30.0, window=64, sliding=jnp.asarray(True)),
+        dict(window=128, sinks=sinks),
+    ]
+    for kw in cases:
+        ref = decode_attention(q, k_cache, v_cache, lengths, d**-0.5, impl="xla", **kw)
+        out = flash_decode(
+            q, k_cache, v_cache, lengths, sm_scale=d**-0.5, interpret=True, **kw
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3,
+            err_msg=f"variant {sorted(kw)}",
+        )
+
+
+def test_flash_decode_sharded_gptoss_variants():
+    """The shard_map wrapper carries the variant args: sinks split over tp
+    with their heads, window/softcap are elementwise-safe."""
+    from prime_tpu.ops.attention import decode_attention
+    from prime_tpu.parallel.decode_sharded import flash_decode_sharded
+
+    mesh = make_mesh({"dp": 1, "fsdp": 4, "tp": 2})
+    b, h, kh, d, c = 4, 8, 2, 64, 256
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, 1, d), dtype=jnp.float32)
+    k_cache = jax.random.normal(jax.random.PRNGKey(1), (b, kh, d, c), dtype=jnp.float32)
+    v_cache = jax.random.normal(jax.random.PRNGKey(2), (b, kh, d, c), dtype=jnp.float32)
+    lengths = jnp.asarray([256, 1, 130, 77], dtype=jnp.int32)
+    sinks = jax.random.normal(jax.random.PRNGKey(3), (h,), dtype=jnp.float32)
+
+    cases = (
+        dict(sinks=sinks),
+        dict(window=64, softcap=20.0),
+        # traced sliding flag: crosses the shard_map boundary via closure
+        # capture (the production layer scan passes exactly this)
+        dict(window=64, sliding=jnp.asarray(True)),
+    )
+    for kw in cases:
+        ref = decode_attention(q, k_cache, v_cache, lengths, d**-0.5, impl="xla", **kw)
+        out = flash_decode_sharded(
+            q, k_cache, v_cache, lengths, mesh, interpret=True, **kw
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3,
+            err_msg=f"variant {sorted(kw)}",
+        )
