@@ -75,20 +75,14 @@ def _flash_kernel(
     o_ref[0, 0, :, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-def _decode_kernel(
-    lengths_ref,  # (B,) scalar-prefetch, SMEM
-    window_ref,   # (1,) scalar-prefetch: effective window (0 = global layer)
-    q_ref,        # (1, 1, G, D)
-    k_ref,        # (1, 1, D, C) one kv head's cache, feature-major
-    v_ref,        # (1, 1, D, C)
-    sinks_ref,    # (1, G) this kv head's group of sink logits
-    o_ref,        # (1, 1, G, D)
-    *,
-    sm_scale: float,
-    block_c: int,
-    softcap: float,
-    use_sinks: bool,
+def _decode_body(
+    lengths_ref, window_ref, q_ref, sinks_ref, o_ref, load_block,
+    *, sm_scale, block_c, softcap, use_sinks,
 ):
+    """Shared online-softmax decode loop. ``load_block(cb)`` returns this
+    cache block's (k (D, BC) fp32-effective, v (D, BC), k_colscale, v_colscale)
+    — the per-slot int8 dequant scales fold into the score/value epilogues
+    exactly (column scales are constant over the contracted D axis)."""
     b = pl.program_id(0)
     q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (G, D)
     group = q.shape[0]
@@ -104,11 +98,12 @@ def _decode_kernel(
 
     def body(cb, carry):
         m_prev, l_prev, acc_prev = carry
-        k = k_ref[0, 0, :, pl.ds(cb * block_c, block_c)].astype(jnp.float32)  # (D, BC)
-        v = v_ref[0, 0, :, pl.ds(cb * block_c, block_c)].astype(jnp.float32)
+        k, v, k_colscale, v_colscale = load_block(cb)
         scores = jax.lax.dot_general(
             q, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # (G, BC)
+        if k_colscale is not None:
+            scores = scores * k_colscale  # (1, BC) broadcasts over G
         if softcap:
             scores = jnp.tanh(scores / softcap) * softcap
         slots = cb * block_c + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
@@ -118,8 +113,9 @@ def _decode_kernel(
         p = jnp.exp(scores - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        weighted = p if v_colscale is None else p * v_colscale
         acc_new = acc_prev * alpha + jax.lax.dot_general(
-            p, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            weighted, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (G, D)
         return m_new, l_new, acc_new
 
@@ -144,6 +140,64 @@ def _decode_kernel(
         o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
+def _decode_kernel(
+    lengths_ref,  # (B,) scalar-prefetch, SMEM
+    window_ref,   # (1,) scalar-prefetch: effective window (0 = global layer)
+    q_ref,        # (1, 1, G, D)
+    k_ref,        # (1, 1, D, C) one kv head's cache, feature-major
+    v_ref,        # (1, 1, D, C)
+    sinks_ref,    # (1, G) this kv head's group of sink logits
+    o_ref,        # (1, 1, G, D)
+    *,
+    sm_scale: float,
+    block_c: int,
+    softcap: float,
+    use_sinks: bool,
+):
+    def load_block(cb):
+        k = k_ref[0, 0, :, pl.ds(cb * block_c, block_c)].astype(jnp.float32)
+        v = v_ref[0, 0, :, pl.ds(cb * block_c, block_c)].astype(jnp.float32)
+        return k, v, None, None
+
+    _decode_body(
+        lengths_ref, window_ref, q_ref, sinks_ref, o_ref, load_block,
+        sm_scale=sm_scale, block_c=block_c, softcap=softcap, use_sinks=use_sinks,
+    )
+
+
+def _decode_kernel_int8(
+    lengths_ref,   # (B,) scalar-prefetch, SMEM
+    window_ref,    # (1,) scalar-prefetch
+    q_ref,         # (1, 1, G, D)
+    k_ref,         # (1, 1, D, C) int8
+    v_ref,         # (1, 1, D, C) int8
+    k_scale_ref,   # (1, 1, 1, C) per-slot dequant scales
+    v_scale_ref,   # (1, 1, 1, C)
+    sinks_ref,     # (1, G)
+    o_ref,         # (1, 1, G, D)
+    *,
+    sm_scale: float,
+    block_c: int,
+    softcap: float,
+    use_sinks: bool,
+):
+    def load_block(cb):
+        sl = pl.ds(cb * block_c, block_c)
+        # int8 streams from HBM (half the bytes) and widens to fp32 in
+        # VMEM; the per-slot scales are column-constant so they fold into
+        # the epilogues and a dequantized cache is never written back
+        k = k_ref[0, 0, :, sl].astype(jnp.float32)
+        v = v_ref[0, 0, :, sl].astype(jnp.float32)
+        k_colscale = k_scale_ref[0, 0, :, sl].astype(jnp.float32)  # (1, BC)
+        v_colscale = v_scale_ref[0, 0, :, sl].astype(jnp.float32)
+        return k, v, k_colscale, v_colscale
+
+    _decode_body(
+        lengths_ref, window_ref, q_ref, sinks_ref, o_ref, load_block,
+        sm_scale=sm_scale, block_c=block_c, softcap=softcap, use_sinks=use_sinks,
+    )
+
+
 @functools.partial(
     jax.jit, static_argnames=("sm_scale", "softcap", "window", "interpret")
 )
@@ -157,6 +211,8 @@ def flash_decode(
     window: int = 0,                     # sliding-window size (0 = global)
     sliding: jnp.ndarray | None = None,  # traced per-layer bool for `window`
     sinks: jnp.ndarray | None = None,    # (H,) per-head sink logits (GPT-OSS)
+    k_scale: jnp.ndarray | None = None,  # (B, KH, 1, C) int8-cache dequant scales
+    v_scale: jnp.ndarray | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """One fused decode step: for each (batch, kv-head) program, stream the
@@ -168,7 +224,10 @@ def flash_decode(
     scores, ``window`` (+ the traced per-layer ``sliding`` flag the model
     scan carries) masks AND front-skips cache blocks — a sliding layer
     streams only ~window slots instead of the whole cache — and ``sinks``
-    adds each head's learned logit to the softmax denominator."""
+    adds each head's learned logit to the softmax denominator. With
+    ``k_scale``/``v_scale`` the cache is int8: half the bytes stream from
+    HBM (widened to fp32 in VMEM) and the per-slot scales fold into the
+    score/value epilogues, so no dequantized cache is ever materialized."""
     batch, num_heads, _, head_dim = q.shape
     kv_heads, capacity = k_cache.shape[1], k_cache.shape[3]
     assert num_heads % kv_heads == 0
@@ -176,6 +235,8 @@ def flash_decode(
     if sm_scale is None:
         sm_scale = head_dim**-0.5
     block_c = min(BLOCK_C, capacity)
+    quantized = k_scale is not None
+    assert quantized == (v_scale is not None), "k_scale and v_scale go together"
 
     # effective window as a prefetched scalar: the layer scan traces
     # `sliding`, so the window can't be folded statically — 0 means global
@@ -191,19 +252,29 @@ def flash_decode(
         else jnp.zeros((kv_heads, group), jnp.float32)
     )
 
-    kernel = functools.partial(
-        _decode_kernel, sm_scale=sm_scale, block_c=block_c, softcap=softcap,
-        use_sinks=use_sinks,
-    )
+    qkv_specs = [
+        pl.BlockSpec((1, 1, group, head_dim), lambda b, h, *_: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, head_dim, capacity), lambda b, h, *_: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, head_dim, capacity), lambda b, h, *_: (b, h, 0, 0)),
+    ]
+    scale_specs = [
+        pl.BlockSpec((1, 1, 1, capacity), lambda b, h, *_: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, 1, capacity), lambda b, h, *_: (b, h, 0, 0)),
+    ]
+    sinks_spec = pl.BlockSpec((1, group), lambda b, h, *_: (h, 0))
+    common = dict(sm_scale=sm_scale, block_c=block_c, softcap=softcap, use_sinks=use_sinks)
+    if quantized:
+        kernel = functools.partial(_decode_kernel_int8, **common)
+        in_specs = qkv_specs + scale_specs + [sinks_spec]
+        operands = (k_cache, v_cache, k_scale, v_scale, sinks_arr)
+    else:
+        kernel = functools.partial(_decode_kernel, **common)
+        in_specs = qkv_specs + [sinks_spec]
+        operands = (k_cache, v_cache, sinks_arr)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(batch, kv_heads),
-        in_specs=[
-            pl.BlockSpec((1, 1, group, head_dim), lambda b, h, *_: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, head_dim, capacity), lambda b, h, *_: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, head_dim, capacity), lambda b, h, *_: (b, h, 0, 0)),
-            pl.BlockSpec((1, group), lambda b, h, *_: (h, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, group, head_dim), lambda b, h, *_: (b, h, 0, 0)),
     )
     out = pl.pallas_call(
@@ -218,7 +289,7 @@ def flash_decode(
         interpret=interpret,
     )(
         cache_lengths.astype(jnp.int32), window_arr,
-        q.reshape(batch, kv_heads, group, head_dim), k_cache, v_cache, sinks_arr,
+        q.reshape(batch, kv_heads, group, head_dim), *operands,
     )
     return out.reshape(batch, num_heads, 1, head_dim)
 

@@ -204,8 +204,10 @@ class ContinuousBatchingEngine:
         # must take the XLA decode path (same rule as evals.runner.JaxGenerator)
         if mesh is not None and getattr(mesh, "size", 1) > 1 and attn_impl == "auto":
             attn_impl = "xla"
-        # int8 caches need no impl override here: decode_attention's "auto"
-        # dispatch already routes quantized caches to the XLA path
+        # int8 caches ride the flash kernel on single-device engines (auto
+        # dispatch, round 4); the mesh>1 override above is what keeps
+        # multi-device engines on the SPMD-safe XLA path, independent of
+        # quantization
         self.attn_impl = attn_impl
         self.kv_quant = kv_quant
         # prompt-lookup speculation: each tick proposes draft_len n-gram
