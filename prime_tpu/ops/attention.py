@@ -294,21 +294,16 @@ def multi_head_attention(
     sinks: jnp.ndarray | None = None,  # (H,) per-head sink logits (GPT-OSS)
 ) -> jnp.ndarray:
     """Causal self-attention (prefill path). Softcap / sliding-window /
-    attention-sink configs always take the XLA path — the flash kernel has
-    no variant for them yet."""
+    attention-sink configs ride the flash kernel too (a sliding layer's
+    prefill skips KV blocks outside each query block's band)."""
     head_dim = q.shape[-1]
     if sm_scale is None:
         sm_scale = head_dim**-0.5
-    gemma_masking = bool(softcap) or bool(window) or sinks is not None
-    if impl == "pallas" and gemma_masking:
-        raise ValueError(
-            "flash_attention has no softcap/sliding-window/attention-sinks "
-            "variant yet: use impl='auto'/'xla' for those configs"
-        )
-    if not gemma_masking and (
-        impl == "pallas" or (impl == "auto" and _pallas_eligible(q, head_dim))
-    ):
+    if impl == "pallas" or (impl == "auto" and _pallas_eligible(q, head_dim)):
         from prime_tpu.ops.pallas_attention import flash_attention_causal
 
-        return flash_attention_causal(q, k, v, sm_scale=sm_scale)
+        return flash_attention_causal(
+            q, k, v, sm_scale=sm_scale, softcap=softcap, window=window,
+            sliding=sliding, sinks=sinks,
+        )
     return xla_attention_causal(q, k, v, sm_scale, softcap, window, sliding, sinks=sinks)

@@ -28,18 +28,57 @@ BLOCK_C = 128  # flash-decode cache-slot block (lane dimension of the kv cache)
 NEG_INF = -1e30
 
 
+def _window_scalar(window: int, sliding) -> jnp.ndarray:
+    """Effective window as a (1,) prefetch scalar: the layer scan traces
+    ``sliding``, so the window can't be folded statically — 0 means global.
+    ONE implementation for the prefill and decode kernels, so their window
+    semantics cannot drift."""
+    if window:
+        on = sliding if sliding is not None else jnp.asarray(True)
+        return jnp.where(on, jnp.int32(window), jnp.int32(0)).reshape(1)
+    return jnp.zeros((1,), jnp.int32)
+
+
+def _sinks_operand(sinks, rows: int, cols: int) -> tuple[bool, jnp.ndarray]:
+    """(use_sinks, operand): a real zeros operand keeps one kernel signature
+    when sinks are off (a zero sink would CHANGE the math — exp(0) joins the
+    denominator — so use_sinks gates the epilogue statically)."""
+    if sinks is None:
+        return False, jnp.zeros((rows, cols), jnp.float32)
+    return True, sinks.astype(jnp.float32).reshape(rows, cols)
+
+
+def _finalize_attention(acc, m, l, sink):
+    """Shared epilogue: plain normalization, or — with a sink logit — the
+    GPT-OSS denominator (the per-head logit joins the softmax normalization
+    with no value contribution): rescale the accumulators to the combined
+    max, add exp(sink)."""
+    if sink is None:
+        return acc / jnp.maximum(l, 1e-30)
+    m_final = jnp.maximum(m, sink)
+    scale = jnp.exp(m - m_final)
+    denom = l * scale + jnp.exp(sink - m_final)
+    return acc * scale / jnp.maximum(denom, 1e-30)
+
+
+
 def _flash_kernel(
-    q_ref,      # (BLOCK_Q, D)
-    k_ref,      # (S, D)  one kv head, full length
-    v_ref,      # (S, D)
-    o_ref,      # (BLOCK_Q, D)
+    window_ref,  # (1,) scalar-prefetch: effective window (0 = global layer)
+    q_ref,       # (BLOCK_Q, D)
+    k_ref,       # (S, D)  one kv head, full length
+    v_ref,       # (S, D)
+    sinks_ref,   # (1, 1) this q head's sink logit
+    o_ref,       # (BLOCK_Q, D)
     *,
     sm_scale: float,
     seq_len: int,
     block_k: int,
+    softcap: float,
+    use_sinks: bool,
 ):
     qb = pl.program_id(2)
     q = q_ref[0, 0, :, :].astype(jnp.float32) * sm_scale  # (BQ, D)
+    window = window_ref[0]
 
     m = jnp.full((BLOCK_Q, 1), NEG_INF, dtype=jnp.float32)
     l = jnp.zeros((BLOCK_Q, 1), dtype=jnp.float32)
@@ -56,8 +95,14 @@ def _flash_kernel(
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (BQ, BK)
+        if softcap:
+            scores = jnp.tanh(scores / softcap) * softcap
         kv_positions = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, block_k), 1)
-        scores = jnp.where(kv_positions <= q_positions, scores, NEG_INF)
+        allowed = kv_positions <= q_positions
+        # sliding layer: key must also be within `window` of the query
+        # (delta < window, matching ops.attention._window_ok)
+        allowed &= (window == 0) | (q_positions - kv_positions < window)
+        scores = jnp.where(allowed, scores, NEG_INF)
 
         m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
         p = jnp.exp(scores - m_new)
@@ -68,11 +113,19 @@ def _flash_kernel(
         )
         return m_new, l_new, acc_new
 
-    # causal block skip: kv blocks entirely above the diagonal contribute nothing
+    # block skip BOTH ways: kv blocks strictly above the diagonal contribute
+    # nothing (causal), and on a sliding layer blocks entirely before the
+    # query block's window band contribute nothing either — a long prompt's
+    # sliding layer does O(S·window) work instead of O(S²/2)
     last_block = jnp.minimum(qb + 1, num_k_blocks)  # blocks [0, last_block) are live
-    m, l, acc = jax.lax.fori_loop(0, last_block, body, (m, l, acc))
+    earliest_q = qb * BLOCK_Q
+    band_start = jnp.where(
+        window > 0, jnp.maximum(earliest_q - window + 1, 0) // block_k, 0
+    )
+    m, l, acc = jax.lax.fori_loop(band_start, last_block, body, (m, l, acc))
 
-    o_ref[0, 0, :, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    sink = sinks_ref[0, 0].astype(jnp.float32) if use_sinks else None
+    o_ref[0, 0, :, :] = _finalize_attention(acc, m, l, sink).astype(o_ref.dtype)
 
 
 def _decode_body(
@@ -127,17 +180,8 @@ def _decode_body(
     start_block = first_slot // block_c
     num_blocks = pl.cdiv(length, block_c)
     m, l, acc = jax.lax.fori_loop(start_block, num_blocks, body, (m, l, acc))
-    if use_sinks:
-        # GPT-OSS attention sinks: the per-head logit joins the softmax
-        # normalization (no value contribution) — rescale the accumulators
-        # to the combined max, then add exp(sink) to the denominator
-        sink = sinks_ref[0].astype(jnp.float32).reshape(group, 1)
-        m_final = jnp.maximum(m, sink)
-        scale = jnp.exp(m - m_final)
-        denom = l * scale + jnp.exp(sink - m_final)
-        o_ref[0, 0] = (acc * scale / jnp.maximum(denom, 1e-30)).astype(o_ref.dtype)
-    else:
-        o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    sink = sinks_ref[0].astype(jnp.float32).reshape(group, 1) if use_sinks else None
+    o_ref[0, 0] = _finalize_attention(acc, m, l, sink).astype(o_ref.dtype)
 
 
 def _decode_kernel(
@@ -238,19 +282,8 @@ def flash_decode(
     quantized = k_scale is not None
     assert quantized == (v_scale is not None), "k_scale and v_scale go together"
 
-    # effective window as a prefetched scalar: the layer scan traces
-    # `sliding`, so the window can't be folded statically — 0 means global
-    if window:
-        on = sliding if sliding is not None else jnp.asarray(True)
-        window_arr = jnp.where(on, jnp.int32(window), jnp.int32(0)).reshape(1)
-    else:
-        window_arr = jnp.zeros((1,), jnp.int32)
-    use_sinks = sinks is not None
-    sinks_arr = (
-        sinks.astype(jnp.float32).reshape(kv_heads, group)
-        if use_sinks
-        else jnp.zeros((kv_heads, group), jnp.float32)
-    )
+    window_arr = _window_scalar(window, sliding)
+    use_sinks, sinks_arr = _sinks_operand(sinks, kv_heads, group)
 
     qkv_specs = [
         pl.BlockSpec((1, 1, group, head_dim), lambda b, h, *_: (b, h, 0, 0)),
@@ -294,16 +327,26 @@ def flash_decode(
     return out.reshape(batch, num_heads, 1, head_dim)
 
 
-@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "softcap", "window", "interpret")
+)
 def flash_attention_causal(
     q: jnp.ndarray,  # (B, H, S, D)
     k: jnp.ndarray,  # (B, KH, S, D)
     v: jnp.ndarray,  # (B, KH, S, D)
     sm_scale: float | None = None,
+    softcap: float = 0.0,                # Gemma2 score softcapping
+    window: int = 0,                     # sliding-window size (0 = global)
+    sliding: jnp.ndarray | None = None,  # traced per-layer bool for `window`
+    sinks: jnp.ndarray | None = None,    # (H,) per-head sink logits (GPT-OSS)
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Causal flash attention. S must be a multiple of BLOCK_Q; D a multiple
-    of 128 (pad upstream). Returns (B, H, S, D) in q.dtype."""
+    of 128 (pad upstream). Returns (B, H, S, D) in q.dtype.
+
+    Same Gemma/GPT-OSS variants as flash_decode: softcap, sliding window
+    (the kernel skips KV blocks entirely before each query block's band —
+    a sliding layer's prefill is O(S·window), not O(S²/2)), and sinks."""
     batch, num_heads, seq_len, head_dim = q.shape
     kv_heads = k.shape[1]
     assert num_heads % kv_heads == 0, "query heads must be a multiple of kv heads"
@@ -314,39 +357,32 @@ def flash_attention_causal(
     grid = (batch, num_heads, pl.cdiv(seq_len, BLOCK_Q))
     block_k = min(BLOCK_K, seq_len)
 
+    window_arr = _window_scalar(window, sliding)
+    use_sinks, sinks_arr = _sinks_operand(sinks, num_heads, 1)
+
     kernel = functools.partial(
-        _flash_kernel, sm_scale=sm_scale, seq_len=seq_len, block_k=block_k
+        _flash_kernel, sm_scale=sm_scale, seq_len=seq_len, block_k=block_k,
+        softcap=softcap, use_sinks=use_sinks,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, BLOCK_Q, head_dim), lambda b, h, qb, *_: (b, h, qb, 0)),
+            pl.BlockSpec((1, 1, seq_len, head_dim), lambda b, h, qb, *_: (b, h // group, 0, 0)),
+            pl.BlockSpec((1, 1, seq_len, head_dim), lambda b, h, qb, *_: (b, h // group, 0, 0)),
+            pl.BlockSpec((1, 1), lambda b, h, qb, *_: (h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, BLOCK_Q, head_dim), lambda b, h, qb, *_: (b, h, qb, 0)),
     )
     return pl.pallas_call(
         kernel,
+        grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, BLOCK_Q, head_dim),
-                lambda b, h, qb: (b, h, qb, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, 1, seq_len, head_dim),
-                lambda b, h, qb: (b, h // group, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, 1, seq_len, head_dim),
-                lambda b, h, qb: (b, h // group, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, BLOCK_Q, head_dim),
-            lambda b, h, qb: (b, h, qb, 0),
-            memory_space=pltpu.VMEM,
-        ),
         cost_estimate=pl.CostEstimate(
             flops=2 * 2 * batch * num_heads * seq_len * seq_len * head_dim // 2,  # causal half
             bytes_accessed=(q.size + k.size * group + v.size * group + q.size) * q.dtype.itemsize,
             transcendentals=batch * num_heads * seq_len * seq_len // 2,
         ),
         interpret=interpret,
-    )(q, k, v)
+    )(window_arr, q, k, v, sinks_arr)

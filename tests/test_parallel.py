@@ -420,3 +420,33 @@ def test_flash_decode_sharded_gptoss_variants():
             np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3,
             err_msg=f"variant {sorted(kw)}",
         )
+
+
+def test_flash_prefill_gemma_gptoss_variants_match_xla():
+    """Prefill flash kernel round-4 variants (softcap, sliding window with
+    band block-skip, sinks — alone and combined) vs the XLA reference, at a
+    seq spanning multiple query AND key blocks."""
+    from prime_tpu.ops.pallas_attention import flash_attention_causal
+
+    b, h, kh, s, d = 2, 4, 2, 384, 128
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, s, d), dtype=jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, kh, s, d), dtype=jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, kh, s, d), dtype=jnp.float32)
+    sinks = jax.random.normal(jax.random.PRNGKey(3), (h,), dtype=jnp.float32)
+
+    cases = [
+        dict(softcap=30.0),
+        dict(window=64),                              # band inside one block
+        dict(window=200),                             # band crosses blocks
+        dict(window=64, sliding=jnp.asarray(True)),
+        dict(window=64, sliding=jnp.asarray(False)),  # traced OFF -> global
+        dict(sinks=sinks),
+        dict(softcap=30.0, window=200, sinks=sinks),
+    ]
+    for kw in cases:
+        ref = xla_attention_causal(q, k, v, d**-0.5, **kw)
+        out = flash_attention_causal(q, k, v, sm_scale=d**-0.5, interpret=True, **kw)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3,
+            err_msg=f"variant {sorted(kw)}",
+        )
