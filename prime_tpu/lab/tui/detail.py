@@ -36,30 +36,80 @@ def _wrap(text: str, width: int = 76) -> list[str]:
 
 def _content_text(content: Any) -> str:
     """Chat-message content → text. Handles the OpenAI part-list shape
-    ([{"type": "text", "text": ...}, ...]) alongside plain strings."""
+    ([{"type": "text", "text": ...}, ...]) alongside plain strings, and
+    surfaces reasoning-part content (thinking models) inline."""
     if isinstance(content, list):
         parts = []
         for part in content:
             if isinstance(part, dict):
-                parts.append(str(part.get("text", part.get("content", ""))))
+                kind = str(part.get("type", ""))
+                text = str(part.get("text", part.get("content", "")))
+                if not text and kind in ("reasoning", "thinking"):
+                    text = str(part.get(kind, ""))
+                if text and kind in ("reasoning", "thinking"):
+                    text = f"[reasoning] {text}"
+                parts.append(text)
             else:
                 parts.append(str(part))
         return "\n".join(p for p in parts if p)
     return str(content)
 
 
+def _tool_call_lines(tool_calls: Any) -> list[str]:
+    """One line per tool call: name(args) [-> id]. Tolerates both the OpenAI
+    function-call shape ({"function": {"name", "arguments"}}) and flat
+    {"name", "arguments"} records (reference eval_render.tool_call_parts)."""
+    lines: list[str] = []
+    if not isinstance(tool_calls, list):
+        return lines
+    for call in tool_calls:
+        if not isinstance(call, dict):
+            lines.append(str(call))
+            continue
+        fn = call.get("function") if isinstance(call.get("function"), dict) else call
+        name = str(fn.get("name", "?"))
+        args = fn.get("arguments", "")
+        if isinstance(args, dict):
+            import json as _json
+
+            args = _json.dumps(args, sort_keys=True)
+        args = str(args)
+        if len(args) > 200:
+            args = args[:200] + "…"
+        call_id = call.get("id") or call.get("tool_call_id")
+        lines.append(f"{name}({args})" + (f" -> {call_id}" if call_id else ""))
+    return lines
+
+
 def sample_sections(sample: dict[str, Any]) -> list[tuple[str, str]]:
     """(label, text) sections for one eval sample. Chat rollouts (a
     ``messages`` list — multi-turn envs, hub samples) render one section per
-    role turn; flat rows render PROMPT/COMPLETION/ANSWER (reference
-    eval_render.py rollout-history role)."""
+    role turn, including tool calls, tool results, and reasoning content;
+    flat rows render PROMPT/COMPLETION/ANSWER. Token usage and env state
+    get their own sections when the record carries them (reference
+    eval_render.py rollout-history / build_usage_text / build_state_text
+    roles)."""
     sections: list[tuple[str, str]] = []
     messages = sample.get("messages")
     if isinstance(messages, list) and messages:
         for message in messages:
             if isinstance(message, dict):
                 role = str(message.get("role", "?")).upper()
-                sections.append((role, _content_text(message.get("content", ""))))
+                body = _content_text(message.get("content", ""))
+                reasoning = message.get("reasoning") or message.get("reasoning_content")
+                if reasoning:
+                    prefix = f"[reasoning] {reasoning}"
+                    body = f"{prefix}\n{body}" if body else prefix
+                # assistant tool calls render as call lines; tool replies
+                # label with the tool's id so the pairing reads top-down
+                calls = _tool_call_lines(message.get("tool_calls"))
+                if calls:
+                    body = "\n".join(
+                        ([body] if body else []) + [f"⚒ {line}" for line in calls]
+                    )
+                if role == "TOOL" and message.get("tool_call_id"):
+                    role = f"TOOL {message['tool_call_id']}"
+                sections.append((role, body))
             else:
                 sections.append(("?", str(message)))
         # completion/answer still shown unless the completion IS the last turn
@@ -68,9 +118,21 @@ def sample_sections(sample: dict[str, Any]) -> list[tuple[str, str]]:
             sections.append(("COMPLETION", completion))
         if sample.get("answer") not in (None, ""):
             sections.append(("ANSWER", str(sample["answer"])))
-        return sections
-    for label, key in (("PROMPT", "prompt"), ("COMPLETION", "completion"), ("ANSWER", "answer")):
-        sections.append((label, str(sample.get(key, ""))))
+    else:
+        for label, key in (
+            ("PROMPT", "prompt"), ("COMPLETION", "completion"), ("ANSWER", "answer")
+        ):
+            sections.append((label, str(sample.get(key, ""))))
+    usage = sample.get("usage")
+    if isinstance(usage, dict) and usage:
+        sections.append(
+            ("USAGE", "  ".join(f"{k}={usage[k]}" for k in sorted(usage)))
+        )
+    state = sample.get("state")
+    if isinstance(state, dict) and state:
+        import json as _json
+
+        sections.append(("STATE", _json.dumps(state, sort_keys=True)[:500]))
     return sections
 
 

@@ -1039,3 +1039,52 @@ def test_card_editor_q_types_not_quits(app, tmp_path):
     assert not app.quit and editor.input.endswith("q")
     app.on_key("escape")         # cancel edit
     assert editor.input is None and app.screens
+
+
+def test_sample_browser_tool_calls_reasoning_usage_state(app, tmp_path):
+    """Round-4 render breadth: tool-call turns, tool replies paired by id,
+    reasoning content, token usage, and env state all render (reference
+    eval_render.py tool_call_parts / stringify_message_reasoning /
+    build_usage_text / build_state_text roles)."""
+    run_dir = _local_run(tmp_path)
+    with open(run_dir / "results.jsonl", "w") as f:
+        f.write(
+            json.dumps(
+                {
+                    "messages": [
+                        {"role": "user", "content": "weather in SF?"},
+                        {
+                            "role": "assistant",
+                            "content": "",
+                            "reasoning": "user wants current weather",
+                            "tool_calls": [
+                                {
+                                    "id": "call_1",
+                                    "function": {
+                                        "name": "get_weather",
+                                        "arguments": {"city": "SF"},
+                                    },
+                                }
+                            ],
+                        },
+                        {"role": "tool", "tool_call_id": "call_1", "content": "64F sunny"},
+                        {"role": "assistant", "content": "64F and sunny."},
+                    ],
+                    "usage": {"prompt_tokens": 21, "completion_tokens": 9},
+                    "state": {"turns": 2},
+                    "reward": 1.0,
+                    "correct": True,
+                }
+            )
+            + "\n"
+        )
+    app.tick()
+    app.on_key("1")
+    app.on_key("enter")
+    app.on_key("enter")
+    text = render_text(app)
+    assert 'get_weather({"city": "SF"}) -> call_1' in text
+    assert "TOOL call_1" in text and "64F sunny" in text
+    assert "[reasoning] user wants current weather" in text
+    assert "USAGE" in text and "completion_tokens=9" in text
+    assert "STATE" in text and '"turns": 2' in text
