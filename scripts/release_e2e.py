@@ -112,6 +112,42 @@ def check_eval(env):
         assert payload["evalId"].startswith("eval_")
 
 
+@step("eval endpoint alias + preflights")
+def check_eval_endpoints(env):
+    """Round-4 surface: an alias with a base_url runs inference-backed (the
+    fake endpoint echoes prompts), an unknown hosted model 402/404s BEFORE
+    submission, and local-only flags hard-fail with --hosted."""
+    with tempfile.TemporaryDirectory() as tmp:
+        table = Path(tmp) / "endpoints.toml"
+        table.write_text(
+            f'[smoke]\nmodel = "llama3-8b"\nbase_url = "{env["PRIME_INFERENCE_URL"]}"\n'
+        )
+        out = run_cli(
+            "eval", "run", "e2e-arith", "-m", "smoke", "-n", "2", "-b", "2",
+            "--no-push", "--endpoints-path", str(table),
+            "--output-dir", tmp, "--output", "json",
+            env=env,
+        ).stdout
+        payload = json.loads(out[out.index("{"):])
+        assert payload["metrics"]["num_samples"] == 2.0
+        rows = [
+            json.loads(line)
+            for line in open(Path(payload["runDir"]) / "results.jsonl")
+            if line.strip()
+        ]
+        assert all(r["completion"].startswith("echo: ") for r in rows)
+        proc = run_cli(
+            "eval", "run", "e2e-arith", "-m", "not-a-model", "--hosted",
+            env=env, check=False,
+        )
+        assert proc.returncode != 0 and "Invalid model" in proc.stderr
+        proc = run_cli(
+            "eval", "run", "e2e-arith", "-m", "llama3-8b", "--hosted", "--kv-quant",
+            env=env, check=False,
+        )
+        assert proc.returncode != 0 and "--kv-quant" in proc.stderr
+
+
 @step("train dispatch + logs")
 def check_train(env):
     with tempfile.TemporaryDirectory() as tmp:
@@ -278,6 +314,7 @@ def main() -> int:
             check_sandbox,
             check_env,
             check_eval,
+            check_eval_endpoints,
             check_train,
             check_inference,
             check_env_execution,
