@@ -431,3 +431,30 @@ def test_decode_attention_routes_quantized_cache_to_flash(monkeypatch):
         q, kq, vq, lengths, d**-0.5, impl="xla", k_scale=k_scale, v_scale=v_scale,
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_int8_weights_gptoss_tree_quantizes_cleanly():
+    """The GPT-OSS param tree (sinks, router/expert biases, fused-expert
+    layout) must survive W8A16 quantization: biases and sinks stay exact,
+    expert matrices quantize, and greedy decode still tracks fp32 at tiny
+    scale."""
+    from prime_tpu.models.quantize import is_quantized, quantize_params_int8
+
+    cfg = get_config("tiny-gptoss").scaled(capacity_factor=8.0)
+    gp = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    qp = quantize_params_int8(gp)
+    assert is_quantized(qp)
+    # sinks and biases are not matmul weights — they must pass through exact
+    np.testing.assert_array_equal(
+        np.asarray(gp["layers"]["sinks"]), np.asarray(qp["layers"]["sinks"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(gp["layers"]["router_bias"]), np.asarray(qp["layers"]["router_bias"])
+    )
+    prompts = jnp.asarray([[5, 42, 100, 7, 61]])
+    lengths = jnp.asarray([5], jnp.int32)
+    ref = generate(gp, prompts, lengths, cfg, jax.random.PRNGKey(0),
+                   max_new_tokens=6, temperature=0.0)
+    out = generate(qp, prompts, lengths, cfg, jax.random.PRNGKey(0),
+                   max_new_tokens=6, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(ref.tokens), np.asarray(out.tokens))
