@@ -531,3 +531,23 @@ def test_engine_gptoss_matches_sampler():
     drain(engine, *reqs)
     for req, ref in zip(reqs, refs):
         assert req.all_tokens(timeout=1) == ref
+
+
+def test_spec_engine_gptoss_matches_plain():
+    """Speculative decoding on the GPT-OSS architecture: the verify window
+    runs attention sinks through the chunked-prefill path — greedy tokens
+    must equal the plain engine's regardless of what the drafts do."""
+    config = get_config("tiny-gptoss")
+    params = init_params(jax.random.PRNGKey(3), config, dtype=jnp.float32)
+    prompts = [list(range(1, 9)) * 3, [7, 100, 23, 451, 88, 3]]
+
+    def run(speculative):
+        engine = ContinuousBatchingEngine(
+            params, config, pad_id=0, max_slots=2, capacity=128, chunk=4,
+            speculative=speculative, draft_len=4,
+        )
+        reqs = [engine.submit(p, max_new_tokens=10) for p in prompts]
+        drain(engine, *reqs)
+        return [r.all_tokens(timeout=1) for r in reqs]
+
+    assert run(False) == run(True)
