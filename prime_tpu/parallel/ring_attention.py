@@ -33,6 +33,8 @@ def _ring_attention_local(
     v: jnp.ndarray,
     axis_name: str,
     sm_scale: float,
+    window: int = 0,
+    hops: int | None = None,  # ring rotations (host-static; None = P-1)
 ):
     axis_size = jax.lax.psum(1, axis_name)
     my_index = jax.lax.axis_index(axis_name)
@@ -58,6 +60,10 @@ def _ring_attention_local(
         )
         kv_pos = source_index * s_local + jnp.arange(s_local)
         visible = kv_pos[None, :] <= q_pos[:, None]  # (S_local, S_local) global causal mask
+        if window:
+            # sliding layer: the key must also be within `window` of the
+            # query (delta < window, ops.attention._window_ok semantics)
+            visible &= q_pos[:, None] - kv_pos[None, :] < window
         scores = jnp.where(visible[None, None, None], scores, NEG_INF)
 
         m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
@@ -69,7 +75,9 @@ def _ring_attention_local(
         )
         return m_new, l_new, acc_new
 
-    # step 0: my own block; then rotate kv around the ring P-1 times
+    # step 0: my own block; then rotate kv around the ring `hops` times
+    # (host-static — the loop lowers to a fixed-length scan, not a dynamic
+    # while; ring_hops computes the sliding-layer cap)
     carry = fold((m, l, acc), (k, v), my_index)
     perm = [(s, (s + 1) % axis_size) for s in range(axis_size)]
 
@@ -82,8 +90,9 @@ def _ring_attention_local(
         carry = fold(carry, (k_nxt, v_nxt), source)
         return carry, (k_nxt, v_nxt)
 
+    last = (1 + hops) if hops is not None else axis_size
     (m, l, acc), _ = jax.lax.fori_loop(
-        1, axis_size, lambda s, st: ring_step(s, st), (carry, (k, v))
+        1, last, lambda s, st: ring_step(s, st), (carry, (k, v))
     )
     out = (acc / jnp.maximum(l, 1e-30)).reshape(batch, heads, s_local, head_dim)
     return out.astype(q.dtype)
@@ -96,15 +105,38 @@ def ring_self_attention(
     mesh,
     seq_axis: str = "sp",
     sm_scale: float | None = None,
+    window: int = 0,
 ) -> jnp.ndarray:
-    """Causal ring attention over a mesh sequence axis (full-array API)."""
+    """Causal ring attention over a mesh sequence axis (full-array API).
+
+    ``window`` > 0 makes it a sliding layer: the causal mask adds the
+    window band AND the ring stops after ``ring_hops(...)`` rotations —
+    the KV blocks beyond the band are never transferred, so a
+    Gemma/Mistral-style windowed layer costs O(window) ICI traffic per
+    device instead of a full rotation."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    shards = mesh.shape[seq_axis]
+    hops = ring_hops(window, q.shape[2] // shards, shards)
     spec = P(None, None, seq_axis, None)
     fn = jax.shard_map(
-        functools.partial(_ring_attention_local, axis_name=seq_axis, sm_scale=sm_scale),
+        functools.partial(
+            _ring_attention_local, axis_name=seq_axis, sm_scale=sm_scale,
+            window=window, hops=hops,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
     )
     return fn(q, k, v)
+
+
+def ring_hops(window: int, s_local: int, axis_size: int) -> int:
+    """Ring rotations a layer needs. Global layers make the full P-1; a
+    sliding layer's earliest query (global i*S_local) sees back to
+    q - window + 1, exactly ceil((window-1)/S_local) hops upstream — every
+    earlier block is fully masked and never transferred (a window within
+    one shard span costs exactly one hop)."""
+    if not window:
+        return axis_size - 1
+    return min(axis_size - 1, -(-(window - 1) // s_local))

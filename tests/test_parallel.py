@@ -481,3 +481,40 @@ def test_sharded_train_step_gptoss_updates_sinks_and_biases():
     for name, old in before.items():
         new = np.asarray(state.params["layers"][name])
         assert not np.allclose(old, new), f"{name} never updated"
+
+
+@pytest.mark.slow
+def test_ring_attention_sliding_window_matches_dense():
+    """Windowed ring attention (round 4): the mask adds the window band and
+    the ring stops after ceil((window-1)/S_local) hops — parity vs dense
+    windowed attention at window sizes inside one shard, straddling two,
+    and spanning several (seq 2048 over sp=8, 256 tokens/device)."""
+    mesh = make_mesh({"sp": 8})
+    b, h, kh, s, d = 1, 4, 2, 2048, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, s, d), dtype=jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, kh, s, d), dtype=jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, kh, s, d), dtype=jnp.float32)
+    for window in (128, 300, 900):
+        ref = xla_attention_causal(q, k, v, d**-0.5, window=window)
+        out = ring_self_attention(q, k, v, mesh, window=window)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3,
+            err_msg=f"window {window}",
+        )
+
+
+def test_ring_hops_formula():
+    """The hop cap itself (parity can't see it: extra hops fold to zero).
+    s_local=256, sp=8: window within one shard span = 1 hop, straddling =
+    2, spanning several = ceil((w-1)/256), global/full = 7."""
+    from prime_tpu.parallel.ring_attention import ring_hops
+
+    assert ring_hops(0, 256, 8) == 7       # global layer: full rotation
+    assert ring_hops(1, 256, 8) == 0       # self-attention only
+    assert ring_hops(128, 256, 8) == 1
+    assert ring_hops(256, 256, 8) == 1     # w-1 = 255 still within one span
+    assert ring_hops(257, 256, 8) == 1
+    assert ring_hops(258, 256, 8) == 2     # first query needs 257 back
+    assert ring_hops(300, 256, 8) == 2
+    assert ring_hops(900, 256, 8) == 4
+    assert ring_hops(10**6, 256, 8) == 7   # capped at P-1
