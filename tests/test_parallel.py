@@ -518,3 +518,33 @@ def test_ring_hops_formula():
     assert ring_hops(300, 256, 8) == 2
     assert ring_hops(900, 256, 8) == 4
     assert ring_hops(10**6, 256, 8) == 7   # capped at P-1
+
+
+def test_sp_decode_int8_cache_matches_xla():
+    """Sequence-sharded decode over an int8 cache: the per-slot scales live
+    with their slots on each sp shard and fold into the local einsums —
+    parity vs the single-device XLA quantized decode."""
+    from prime_tpu.models.llama import quantize_kv
+    from prime_tpu.ops.attention import decode_attention
+    from prime_tpu.parallel.long_context import sp_decode_attention
+
+    mesh = make_mesh({"sp": 8})
+    b, h, kh, d, c = 2, 8, 2, 64, 512
+    k_raw = jax.random.normal(jax.random.PRNGKey(1), (b, kh, d, c), dtype=jnp.float32)
+    v_raw = jax.random.normal(jax.random.PRNGKey(2), (b, kh, d, c), dtype=jnp.float32)
+    kq, k_scale = quantize_kv(k_raw)
+    vq, v_scale = quantize_kv(v_raw)
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, 1, d), dtype=jnp.float32)
+    lengths = jnp.asarray([512, 130], dtype=jnp.int32)
+
+    ref = decode_attention(
+        q, kq, vq, lengths, d**-0.5, impl="xla", k_scale=k_scale, v_scale=v_scale
+    )
+    out = sp_decode_attention(
+        q, kq, vq, lengths, mesh, k_scale=k_scale, v_scale=v_scale
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+    # and the fp path still matches (the dummy-scales signature must be inert)
+    ref_fp = decode_attention(q, k_raw, v_raw, lengths, d**-0.5, impl="xla")
+    out_fp = sp_decode_attention(q, k_raw, v_raw, lengths, mesh)
+    np.testing.assert_allclose(np.asarray(out_fp), np.asarray(ref_fp), rtol=2e-3, atol=2e-3)
