@@ -228,6 +228,7 @@ def _attention_block(
     prefill_offset: jnp.ndarray | None = None,  # () chunked prefill: write+attend at offset
     sliding: jnp.ndarray | None = None,  # () traced bool: this layer uses the window
     rope_tables_local: tuple[jnp.ndarray, jnp.ndarray] | None = None,
+    ring_mesh=None,  # mesh for attn_impl="ring" (context-parallel training)
 ):
     batch, seq, _ = x.shape
     h, kh, hd = config.n_heads, config.n_kv_heads, config.head_dim
@@ -335,6 +336,23 @@ def _attention_block(
             v_scale=new_v_scale if quantized else None,
             **gemma_kw,
         )
+    elif attn_impl == "ring":
+        # context-parallel training: the sequence axis is sharded over the
+        # mesh's `sp` axis and KV blocks rotate via ring attention
+        # (parallel/ring_attention.py); no-cache path only. The uniform
+        # window/softcap/sink knobs ride the ring fold; PER-LAYER sliding
+        # schedules can't (the hop cap must be static and uniform across
+        # the scanned layers), which forward() rejects up front.
+        from prime_tpu.parallel.ring_attention import ring_self_attention
+        from prime_tpu.parallel.sharding import ring_qkv_axes
+
+        batch_axis, head_axis = ring_qkv_axes(ring_mesh, kh)
+        attn = ring_self_attention(
+            q, k, v, ring_mesh, seq_axis="sp", sm_scale=sm_scale,
+            window=config.sliding_window, softcap=config.attn_softcap,
+            sinks=lp.get("sinks"),
+            batch_axis=batch_axis, head_axis=head_axis,
+        )
     else:
         attn = multi_head_attention(q, k, v, sm_scale, impl=attn_impl, **gemma_kw)
         if k_cache is not None:
@@ -411,6 +429,7 @@ def forward(
     prefill_offset: jnp.ndarray | None = None,  # () traced; chunked prefill at offset
     remat: str = "none",  # "none" | "full" | "dots" — training-path rematerialization
     longrope_select: int | None = None,  # static run-length bound for LongRoPE
+    ring_mesh=None,  # attn_impl="ring": mesh whose `sp` axis shards the sequence
 ):
     """Run the transformer. Returns (logits (B, S, V) fp32, updated cache),
     plus the summed MoE load-balance aux loss when ``return_aux``.
@@ -425,6 +444,21 @@ def forward(
     - decode step:     cache=<filled>, decode=True, S must be 1
     """
     batch, seq = tokens.shape
+    if attn_impl == "ring":
+        # context parallelism is a TRAINING-path mode: the KV cache's slot
+        # axis is not ring-sharded (long-context decode is long_context.py's
+        # sp path), and per-layer sliding schedules would need a per-layer
+        # static hop cap the uniform scan can't express
+        if cache is not None:
+            raise ValueError("attn_impl='ring' serves the no-cache (training) path only")
+        if ring_mesh is None or "sp" not in ring_mesh.shape:
+            raise ValueError("attn_impl='ring' needs ring_mesh with an 'sp' axis")
+        if config.sliding_window and config.sliding_pattern != "uniform":
+            raise ValueError(
+                "attn_impl='ring' supports uniform window schedules only "
+                f"(got pattern {config.sliding_pattern!r}); per-layer "
+                "schedules need a per-layer static hop cap"
+            )
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None, :], (batch, seq))
         if prefill_offset is not None:
@@ -512,6 +546,7 @@ def forward(
             x, _, _, _, _ = _attention_block(
                 x, lp, positions, rope_tables, config, None, None, None, False, attn_impl,
                 sliding=sliding, rope_tables_local=rope_tables_local,
+                ring_mesh=ring_mesh,
             )
             x, aux = _mlp_block(x, lp, config)
             return (x, aux_sum + aux), None

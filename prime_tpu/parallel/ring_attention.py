@@ -31,10 +31,13 @@ def _ring_attention_local(
     q: jnp.ndarray,  # (B, H, S_local, D) — this device's block
     k: jnp.ndarray,  # (B, KH, S_local, D)
     v: jnp.ndarray,
+    sinks: jnp.ndarray,  # (H,) per-head sink logits (zeros when unused)
     axis_name: str,
     sm_scale: float,
     window: int = 0,
     hops: int | None = None,  # ring rotations (host-static; None = P-1)
+    softcap: float = 0.0,
+    use_sinks: bool = False,
 ):
     axis_size = jax.lax.psum(1, axis_name)
     my_index = jax.lax.axis_index(axis_name)
@@ -58,6 +61,10 @@ def _ring_attention_local(
         scores = jnp.einsum(
             "bhgqd,bhkd->bhgqk", q32, k_blk.astype(jnp.float32), preferred_element_type=jnp.float32
         )
+        # the canonical softcap (cap-before-mask invariant lives there)
+        from prime_tpu.ops.attention import _apply_softcap
+
+        scores = _apply_softcap(scores, softcap)
         kv_pos = source_index * s_local + jnp.arange(s_local)
         visible = kv_pos[None, :] <= q_pos[:, None]  # (S_local, S_local) global causal mask
         if window:
@@ -94,8 +101,18 @@ def _ring_attention_local(
     (m, l, acc), _ = jax.lax.fori_loop(
         1, last, lambda s, st: ring_step(s, st), (carry, (k, v))
     )
-    out = (acc / jnp.maximum(l, 1e-30)).reshape(batch, heads, s_local, head_dim)
-    return out.astype(q.dtype)
+    if use_sinks:
+        # GPT-OSS attention sinks: one denominator adjustment after all
+        # folds (the sink joins every query's normalization, no value) —
+        # same algebra as ops.pallas_attention._finalize_attention
+        sink = sinks.astype(jnp.float32).reshape(1, kv_heads, group, 1, 1)
+        m_final = jnp.maximum(m, sink)
+        rescale = jnp.exp(m - m_final)
+        denom = l * rescale + jnp.exp(sink - m_final)
+        out = (acc * rescale / jnp.maximum(denom, 1e-30))
+    else:
+        out = acc / jnp.maximum(l, 1e-30)
+    return out.reshape(batch, heads, s_local, head_dim).astype(q.dtype)
 
 
 def ring_self_attention(
@@ -106,6 +123,10 @@ def ring_self_attention(
     seq_axis: str = "sp",
     sm_scale: float | None = None,
     window: int = 0,
+    softcap: float = 0.0,
+    sinks: jnp.ndarray | None = None,  # (H,) per-head sink logits
+    batch_axis=None,  # mesh axis (or tuple) sharding the batch dim
+    head_axis=None,   # mesh axis sharding the head dims (megatron tp)
 ) -> jnp.ndarray:
     """Causal ring attention over a mesh sequence axis (full-array API).
 
@@ -113,22 +134,30 @@ def ring_self_attention(
     window band AND the ring stops after ``ring_hops(...)`` rotations —
     the KV blocks beyond the band are never transferred, so a
     Gemma/Mistral-style windowed layer costs O(window) ICI traffic per
-    device instead of a full rotation."""
+    device instead of a full rotation. ``softcap``/``sinks`` carry the
+    Gemma2/GPT-OSS score math. ``batch_axis``/``head_axis`` let the batch
+    ride data axes and the heads ride tp (per-head math shards cleanly),
+    so context parallelism composes with dp/fsdp/tp instead of silently
+    replicating over them."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     shards = mesh.shape[seq_axis]
     hops = ring_hops(window, q.shape[2] // shards, shards)
-    spec = P(None, None, seq_axis, None)
+    use_sinks = sinks is not None
+    sinks_in = (
+        sinks.astype(jnp.float32) if use_sinks else jnp.zeros((q.shape[1],), jnp.float32)
+    )
+    spec = P(batch_axis, head_axis, seq_axis, None)
     fn = jax.shard_map(
         functools.partial(
             _ring_attention_local, axis_name=seq_axis, sm_scale=sm_scale,
-            window=window, hops=hops,
+            window=window, hops=hops, softcap=softcap, use_sinks=use_sinks,
         ),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, P(head_axis)),
         out_specs=spec,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, sinks_in)
 
 
 def ring_hops(window: int, s_local: int, axis_size: int) -> int:

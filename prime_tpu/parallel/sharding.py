@@ -96,6 +96,28 @@ def batch_spec() -> P:
     return P(("dp", "fsdp"), None)
 
 
+def cp_batch_spec() -> P:
+    """Context-parallel training batches: sequence sharded over sp (ring
+    attention rotates the KV blocks; everything elementwise stays local)."""
+    return P(("dp", "fsdp"), "sp")
+
+
+def ring_qkv_axes(mesh, kv_heads: int):
+    """(batch_axis, head_axis) for ring attention on ``mesh`` — the ONE
+    owner of the axis-name policy (model code must not re-hardcode it).
+    Batch rides the data axes; heads ride tp when present (per-head math
+    shards cleanly under megatron layout). A tp axis that can't divide the
+    kv heads is an error rather than silent replication of every head's
+    attention on every tp device."""
+    batch = tuple(a for a in ("dp", "fsdp") if a in mesh.shape) or None
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and kv_heads % tp:
+        raise ValueError(
+            f"ring attention: tp={tp} must divide n_kv_heads={kv_heads}"
+        )
+    return batch, ("tp" if tp > 1 else None)
+
+
 def lengths_spec() -> P:
     return P(("dp", "fsdp"))
 

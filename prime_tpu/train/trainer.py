@@ -96,9 +96,14 @@ def make_train_step(
     accum_steps: int = 1,
     aux_weight: float = 0.01,   # MoE load-balance loss weight (Switch default)
     remat: str = "none",        # "none" | "full" | "dots" activation checkpointing
+    ring_mesh=None,             # attn_impl="ring": context-parallel training
 ):
     """Build the jitted train step. Shardings propagate from the placed
     inputs (shard_train_state / shard_batch) — the jit is mesh-agnostic.
+    (``attn_impl="ring"`` + ``ring_mesh`` is the exception: context-parallel
+    training shards the SEQUENCE over the mesh's sp axis and attention
+    rotates KV blocks around the ring — sequences longer than one chip's
+    activation memory train without rematerializing the whole batch.)
 
     ``accum_steps > 1`` scans microbatches (the leading batch dim must be a
     multiple) accumulating fp32 gradients at constant memory before one
@@ -111,11 +116,12 @@ def make_train_step(
         if config.is_moe:
             logits, _, aux = forward(
                 params, tokens, config, cache=None, attn_impl=attn_impl,
-                return_aux=True, remat=remat,
+                return_aux=True, remat=remat, ring_mesh=ring_mesh,
             )
             return cross_entropy_loss(logits, targets, mask) + aux_weight * aux
         logits, _ = forward(
-            params, tokens, config, cache=None, attn_impl=attn_impl, remat=remat
+            params, tokens, config, cache=None, attn_impl=attn_impl, remat=remat,
+            ring_mesh=ring_mesh,
         )
         return cross_entropy_loss(logits, targets, mask)
 
