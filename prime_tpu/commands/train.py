@@ -122,6 +122,10 @@ def run_cmd(render: Renderer, config_file: str, yes: bool, follow: bool) -> None
 @click.option("--data", "data_path", default=None, type=click.Path(exists=True),
               help="Text file (byte-tokenized LM data); default synthetic tokens.")
 @click.option("--slice", "slice_name", default=None, help="Shard over this TPU slice's mesh.")
+@click.option("--sp", "sp_degree", type=click.IntRange(min=2), default=None,
+              help="Context-parallel degree: shard the SEQUENCE over an sp axis "
+                   "with ring attention (long sequences train without fitting on "
+                   "one chip). Needs --slice; the non-sp chips become fsdp.")
 @click.option("--name", "run_name", default=None, help="Run name (default timestamped).")
 @click.option("--output-dir", default="outputs/train")
 @click.option("--checkpoint-every", type=int, default=0, help="orbax checkpoint cadence (0=off).")
@@ -145,6 +149,7 @@ def local_cmd(
     warmup: int | None,
     data_path: str | None,
     slice_name: str | None,
+    sp_degree: int | None,
     run_name: str | None,
     output_dir: str,
     checkpoint_every: int,
@@ -207,7 +212,34 @@ def local_cmd(
     params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.bfloat16)
 
     mesh = None
-    if slice_name is not None:
+    if sp_degree is not None:
+        # context parallelism: sequence over sp, remaining chips on fsdp
+        # (ring attention composes with tp via sharding.ring_qkv_axes, but
+        # train local keeps the mesh policy simple: fsdp x sp)
+        if slice_name is None:
+            raise click.ClickException("--sp needs --slice (which chips form the mesh)")
+        if lora:
+            raise click.ClickException("--sp does not support --lora yet")
+        if seq_len % sp_degree:
+            raise click.ClickException(
+                f"--seq-len {seq_len} must divide by --sp {sp_degree}"
+            )
+        if config.sliding_window and config.sliding_pattern != "uniform":
+            raise click.ClickException(
+                f"--sp supports uniform window schedules only "
+                f"(model {model!r} uses {config.sliding_pattern!r})"
+            )
+        from prime_tpu.parallel.mesh import make_mesh
+        from prime_tpu.parallel.topology import parse_slice
+
+        chips = parse_slice(slice_name).chips
+        if chips % sp_degree:
+            raise click.ClickException(
+                f"--sp {sp_degree} must divide the slice's {chips} chips"
+            )
+        mesh = make_mesh({"dp": 1, "fsdp": chips // sp_degree, "sp": sp_degree})
+        render.message(f"mesh: {dict(mesh.shape)} (context-parallel)")
+    elif slice_name is not None:
         from prime_tpu.parallel.mesh import mesh_for_slice
 
         mesh = mesh_for_slice(
@@ -251,16 +283,23 @@ def local_cmd(
             from prime_tpu.train import shard_train_state
 
             state = shard_train_state(state, mesh, config)
-        step_fn = make_train_step(config, optimizer, accum_steps=accum, remat=remat)
+        step_fn = make_train_step(
+            config, optimizer, accum_steps=accum, remat=remat,
+            attn_impl="ring" if sp_degree else "auto",
+            ring_mesh=mesh if sp_degree else None,
+        )
 
     if data_path:
         batches = text_batches(data_path, batch_size, seq_len, steps)
     else:
         batches = synthetic_batches(config.vocab_size, batch_size, seq_len, steps)
     if mesh is not None:
-        from prime_tpu.parallel.sharding import shard_batch
+        from prime_tpu.parallel.sharding import cp_batch_spec, shard_batch
 
-        batches = (tuple(shard_batch(x, mesh) for x in b) for b in batches)
+        batch_sp = cp_batch_spec() if sp_degree else None
+        batches = (
+            tuple(shard_batch(x, mesh, spec=batch_sp) for x in b) for b in batches
+        )
 
     checkpoints = None
     start_step = 0

@@ -165,5 +165,8 @@ def shard_params(params, mesh, config: ModelConfig):
     return jax.device_put(params, param_shardings(mesh, config))
 
 
-def shard_batch(batch, mesh):
-    return jax.device_put(batch, NamedSharding(mesh, batch_spec()))
+def shard_batch(batch, mesh, spec: P | None = None):
+    """Place a (B, S) batch: data axes on B by default; pass
+    ``cp_batch_spec()`` to also shard S over sp (context parallelism).
+    Unknown axes prune to replicated so one spec serves any mesh."""
+    return jax.device_put(batch, NamedSharding(mesh, prune_spec(spec or batch_spec(), mesh)))

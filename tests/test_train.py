@@ -704,3 +704,39 @@ def test_train_local_rl_lora_cli(tmp_path):
     )
     assert meta["r"] == 4 and meta["base_model"] == "tiny-test"
     assert adapter_dir.endswith("adapters")
+
+
+def test_train_local_cli_context_parallel(tmp_path):
+    """--sp shards the sequence over the ring (context parallelism) through
+    the real CLI: mesh reported, loss finite, metrics written."""
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.main import cli
+
+    result = CliRunner().invoke(
+        cli,
+        ["train", "local", "-m", "tiny-test", "--steps", "3", "-b", "2",
+         "--seq-len", "64", "--slice", "v5e-8", "--sp", "8",
+         "--name", "cp-run", "--output-dir", str(tmp_path), "--plain"],
+    )
+    assert result.exit_code == 0, result.output
+    assert "'sp': 8" in result.output and "context-parallel" in result.output
+    assert "done:" in result.output
+    assert (tmp_path / "cp-run" / "metrics.jsonl").exists()
+    # guardrails: --sp without --slice, indivisible seq, per-layer schedules
+    bad = CliRunner().invoke(
+        cli, ["train", "local", "-m", "tiny-test", "--sp", "8", "--steps", "2"]
+    )
+    assert bad.exit_code != 0 and "--slice" in bad.output
+    bad = CliRunner().invoke(
+        cli,
+        ["train", "local", "-m", "tiny-test", "--sp", "8", "--slice", "v5e-8",
+         "--seq-len", "30", "--steps", "2"],
+    )
+    assert bad.exit_code != 0 and "divide" in bad.output
+    bad = CliRunner().invoke(
+        cli,
+        ["train", "local", "-m", "tiny-gptoss", "--sp", "8", "--slice", "v5e-8",
+         "--seq-len", "64", "--steps", "2"],
+    )
+    assert bad.exit_code != 0 and "uniform" in bad.output
