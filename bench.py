@@ -163,6 +163,11 @@ def _diagnose() -> dict:
 
 
 def _preflight() -> None:
+    # PRIME_BENCH_NO_SWEEP: the watcher's opportunistic bench sets this —
+    # its probe just confirmed the tunnel is UP, so there are no stray
+    # holders to clear, and sweeping would race the DRIVER's authoritative
+    # bench (whichever swept last would SIGKILL the other mid-run)
+    no_sweep = bool(os.environ.get("PRIME_BENCH_NO_SWEEP"))
     # Provisional abort record FIRST, before anything that can hang or be
     # killed: the driver takes the LAST JSON line on stdout, so a later
     # success (or the structured abort below) overwrites this — but an
@@ -182,7 +187,7 @@ def _preflight() -> None:
         ),
         flush=True,
     )
-    swept = _sweep_stray_holders()
+    swept = [] if no_sweep else _sweep_stray_holders()
     if swept:
         print(f"# bench: swept {len(swept)} stray TPU helper(s): {swept}", flush=True)
     errors: list[str] = []

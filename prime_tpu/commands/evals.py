@@ -133,6 +133,7 @@ def run_eval_cmd(
     except EvalPreflightError as e:
         raise click.ClickException(str(e)) from None
     api_base = None
+    alias_name = model  # what the user typed — error messages must use it
     if resolution is not None:
         render.message(f"Endpoint alias {model!r} -> {resolution.model}")
         model = resolution.model
@@ -144,7 +145,7 @@ def run_eval_cmd(
             # the platform TPU fleet — honoring the model id but not the
             # endpoint would silently evaluate a different deployment
             raise click.ClickException(
-                f"alias {resolution.model!r} carries a base_url, which "
+                f"alias {alias_name!r} carries a base_url, which "
                 "conflicts with --hosted (hosted evals run on the platform, "
                 "not against an endpoint) — drop --hosted or use a "
                 "rename-only alias"
@@ -261,8 +262,10 @@ def run_eval_cmd(
         from prime_tpu.evals.endpoints import ApiGenerator
 
         # preflight only our own platform: foreign endpoints may not accept
-        # the configured credentials for /models (reference skips there too)
-        if api_base == deps.build_config().inference_url:
+        # the configured credentials for /models (reference skips there too).
+        # Both sides normalized — a trailing-slash mismatch must not
+        # silently skip the documented fail-fast
+        if api_base.rstrip("/") == deps.build_config().inference_url.rstrip("/"):
             try:
                 validate_model(model, base_url=api_base, warn=warn)
                 preflight_billing(model, base_url=api_base, warn=warn)

@@ -33,7 +33,11 @@ def _bucket(values: list[float], width: int) -> list[float]:
 
 def sparkline(values: list[float], width: int = 48) -> str:
     """Downsample values to ``width`` buckets and render block characters."""
-    clean = [float(v) for v in values if v == v]  # drop NaN
+    import math as _math
+
+    # drop NaN AND inf: a diverged-loss inf would poison the bucket means
+    # and the span normalization (inf/inf -> NaN) however it's rescued
+    clean = [float(v) for v in values if _math.isfinite(v)]
     if not clean:
         return ""
     clean = _bucket(clean, width)

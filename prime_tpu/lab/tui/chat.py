@@ -124,10 +124,18 @@ class AgentChatScreen(DetailScreen):
     # -- widget actions --------------------------------------------------------
 
     def _choice_options(self) -> list[str]:
+        """The NORMALIZED options — the exact list render_widget displays.
+        Selecting from the raw list would let the cursor act on an option
+        the panel never showed (dropped nulls/dupes shift the indices)."""
         if self.pending is None or self.pending["name"] != "choose":
             return []
-        options = self.pending.get("args", {}).get("options")
-        return [str(o) for o in options] if isinstance(options, list) else []
+        from prime_tpu.lab.widget_model import WidgetValidationError, normalize_widget_call
+
+        try:
+            normalized = normalize_widget_call("choose", self.pending.get("args", {}))
+        except WidgetValidationError:
+            return []
+        return list(normalized.args["options"])
 
     def _act_on_pending(self) -> str | None:
         pending = self.pending

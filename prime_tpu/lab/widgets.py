@@ -113,30 +113,18 @@ def letta_external_tools() -> list[dict[str, Any]]:
 
 
 def validate_widget_call(name: str, args: dict[str, Any]) -> str | None:
-    """None when the call is well-formed, else a reason (the TUI shows it
-    instead of a broken widget — a malformed call must never crash a render).
-    Checks types, not just presence: agents do send ``{"options": 5}``."""
-    tool = _BY_NAME.get(name)
-    if tool is None:
-        return f"unknown widget tool {name!r}"
-    if not isinstance(args, dict):
-        return f"{name}: args must be an object"
-    missing = [key for key in tool.required if key not in args]
-    if missing:
-        return f"{name}: missing required {missing}"
-    for key, schema in tool.properties.items():
-        if key not in args:
-            continue
-        expected = schema.get("type")
-        value = args[key]
-        ok = {
-            "string": lambda v: isinstance(v, str),
-            "array": lambda v: isinstance(v, list),
-            "object": lambda v: isinstance(v, dict),
-            "number": lambda v: isinstance(v, (int, float)),
-        }.get(expected, lambda v: True)(value)
-        if not ok:
-            return f"{name}: {key} must be a JSON {expected}"
+    """None when the call is usable (possibly after repair), else a reason.
+
+    Thin shim over the typed widget model — ONE validation contract
+    (widget_model.normalize_widget_call) decides; a second shallower
+    checker here would invite callers onto the weaker path the round-4
+    model replaced."""
+    from prime_tpu.lab.widget_model import WidgetValidationError, normalize_widget_call
+
+    try:
+        normalize_widget_call(name, args)
+    except WidgetValidationError as e:
+        return str(e)
     return None
 
 

@@ -56,9 +56,12 @@ while true; do
       # bench.py's own sweep signature, so `python3 bench.py` or an absolute
       # path also defers)
       echo "$(date -u +%H:%M:%S) OPPORTUNISTIC BENCH starting" >> "$LOG"
-      # bench.py's stray-holder sweep protects its ancestors (this shell),
-      # so running it from here is safe; 45 min cap covers all sections.
-      (cd "$REPO" && timeout 2700 python bench.py \
+      # PRIME_BENCH_NO_SWEEP: the probe just proved the tunnel UP, and a
+      # sweep from here could SIGKILL a concurrently-starting DRIVER bench
+      # (the authoritative record); the driver's own sweep may kill THIS
+      # bench instead, which is fine — no JSON lands, so a later UP window
+      # retries. 45 min cap covers all sections.
+      (cd "$REPO" && PRIME_BENCH_NO_SWEEP=1 timeout 2700 python bench.py \
         > /tmp/bench_opp.out 2> /tmp/bench_opp.err)
       brc=$?
       # last JSON line wins (same contract as the driver); validate in a

@@ -556,7 +556,11 @@ def test_chat_launch_without_config_refused(tmp_path):
     assert not (tmp_path / ".prime-lab" / "launch").exists()
 
 
-def test_chat_whitespace_enter_acts_and_blank_option_answers():
+def test_chat_whitespace_enter_acts_and_selection_matches_render():
+    """Selection acts on the NORMALIZED options — the exact list the panel
+    renders (a blank option is dropped by the widget model, so the cursor
+    lands on the only real option and the agent receives its label, not a
+    positional answer for an entry the UI never showed)."""
     from prime_tpu.lab.tui.chat import AgentChatScreen
 
     screen = AgentChatScreen("tester", _WidgetScriptRuntime)
@@ -568,9 +572,14 @@ def test_chat_whitespace_enter_acts_and_blank_option_answers():
     status = screen.on_key("enter")
     assert "selected" in status
     assert screen.wait_idle(5)
-    # the blank label was answered by position, not dropped by send()
     user_turns = [e["text"] for e in screen.transcript if e.get("role") == "user"]
-    assert user_turns == ["option 1"]
+    assert user_turns == ["retry"]
+    # all options unusable -> the widget refuses rather than misrendering
+    screen.transcript.append(
+        {"role": "widget", "name": "choose", "args": {"options": ["", "  "]}}
+    )
+    screen.pending = screen.transcript[-1]
+    assert "no options" in screen.on_key("enter")
 
 
 def test_chat_free_text_overrides_pending_choice():
