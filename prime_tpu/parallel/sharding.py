@@ -132,6 +132,16 @@ def cache_spec() -> P:
     return P(None, ("dp", "fsdp"), "tp", None, None)
 
 
+def sp_cache_spec() -> P:
+    """KV cache (L, B, KH, hd, C) with the SLOT axis sharded over sp: a
+    long-context cache larger than one chip's HBM spreads across the
+    slice. Pass as ``generate(..., cache_spec=sp_cache_spec())`` under a
+    mesh with an sp axis — GSPMD inserts the slot-axis collectives for
+    the decode reads/writes (the hand-optimized per-step combine is
+    long_context.sp_decode_attention)."""
+    return P(None, ("dp", "fsdp"), "tp", None, "sp")
+
+
 def logits_spec() -> P:
     return P(("dp", "fsdp"), None, "tp")
 
