@@ -75,6 +75,9 @@ def _hosted_logs_tolerant(client, hosted_id: str, state: dict) -> list[str]:
     help="Shard the local model over this TPU slice's mesh (e.g. v5e-8).",
 )
 @click.option("--tp", "tensor_parallel", type=int, default=None, help="Tensor-parallel axis for --slice.")
+@click.option("--sp", "sequence_parallel", type=click.IntRange(min=2), default=None,
+              help="Sequence-parallel axis for --slice: shard the KV cache's slot "
+                   "dimension so a long-context cache spreads across the slice.")
 @click.option("--kv-quant", is_flag=True, help="int8 KV cache (halved decode HBM traffic).")
 @click.option("--weight-quant", is_flag=True, help="int8 weights (W8A16) for serving-side evals.")
 @click.option("--speculative", is_flag=True,
@@ -106,6 +109,7 @@ def run_eval_cmd(
     tpu_type: str,
     slice_name: str | None,
     tensor_parallel: int | None,
+    sequence_parallel: int | None,
     kv_quant: bool,
     weight_quant: bool,
     speculative: bool,
@@ -241,6 +245,7 @@ def run_eval_cmd(
                 ("--tokenizer", tokenizer),
                 ("--slice", slice_name),
                 ("--tp", tensor_parallel),
+                ("--sp", sequence_parallel),
                 ("--adapter", adapter),
             )
             if value is not None
@@ -286,6 +291,7 @@ def run_eval_cmd(
         output_dir=output_dir,
         slice_name=slice_name,
         tensor_parallel=tensor_parallel,
+        sequence_parallel=sequence_parallel,
         kv_quant=kv_quant,
         weight_quant=weight_quant,
         speculative=speculative,

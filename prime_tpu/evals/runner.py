@@ -49,6 +49,7 @@ class EvalRunSpec:
     tokenizer: str | None = None         # tokenizer name/path; None -> byte fallback
     slice_name: str | None = None        # TPU slice (e.g. v5e-8) -> sharded generate
     tensor_parallel: int | None = None   # override tp axis (default: mesh_for_slice policy)
+    sequence_parallel: int | None = None  # sp axis: slot-sharded long-context KV cache
     kv_quant: bool = False               # int8 KV cache (halved decode HBM traffic)
     weight_quant: bool = False           # int8 weights (W8A16)
     speculative: bool = False            # prompt-lookup speculation (any temperature)
@@ -88,6 +89,7 @@ class JaxGenerator:
         mesh=None,
         slice_name: str | None = None,
         tensor_parallel: int | None = None,
+        sequence_parallel: int | None = None,  # sp axis: slot-sharded KV cache
         kv_quant: bool = False,
         weight_quant: bool = False,
         speculative: bool = False,
@@ -163,8 +165,11 @@ class JaxGenerator:
             mesh = mesh_for_slice(
                 slice_name,
                 tensor_parallel=tensor_parallel,
-                expert_parallel="auto" if self.config.is_moe else None,
+                expert_parallel=(
+                    "auto" if self.config.is_moe and not sequence_parallel else None
+                ),
                 n_experts=self.config.n_experts or None,
+                sequence_parallel=sequence_parallel,
             )
         self.mesh = mesh
         # pure-argument validation first: no failure below should cost a
@@ -233,11 +238,26 @@ class JaxGenerator:
         if self.mesh is not None:
             from jax.sharding import NamedSharding
 
-            from prime_tpu.parallel.sharding import batch_spec, cache_spec, lengths_spec
+            from prime_tpu.parallel.sharding import (
+                batch_spec,
+                cache_spec,
+                lengths_spec,
+                prune_spec,
+                sp_cache_spec,
+            )
 
-            batch = jax.device_put(batch, NamedSharding(self.mesh, batch_spec()))
-            lengths = jax.device_put(lengths, NamedSharding(self.mesh, lengths_spec()))
-            kw["cache_spec"] = cache_spec()
+            batch = jax.device_put(
+                batch, NamedSharding(self.mesh, prune_spec(batch_spec(), self.mesh))
+            )
+            lengths = jax.device_put(
+                lengths, NamedSharding(self.mesh, prune_spec(lengths_spec(), self.mesh))
+            )
+            # an sp axis shards the KV cache's SLOT dimension: a long-context
+            # cache larger than one chip's HBM spreads across the slice
+            has_sp = self.mesh.shape.get("sp", 1) > 1
+            kw["cache_spec"] = prune_spec(
+                sp_cache_spec() if has_sp else cache_spec(), self.mesh
+            )
             if self.mesh.size > 1:
                 # pallas kernels are not SPMD-partitionable under jit; on a
                 # real multi-device mesh the XLA paths (which XLA shards) must
@@ -315,6 +335,7 @@ def run_eval(
             tokenizer=spec.tokenizer,
             slice_name=spec.slice_name,
             tensor_parallel=spec.tensor_parallel,
+            sequence_parallel=spec.sequence_parallel,
             kv_quant=spec.kv_quant,
             weight_quant=spec.weight_quant,
             speculative=spec.speculative,

@@ -49,16 +49,19 @@ def mesh_for_slice(
     fsdp: int | None = None,
     expert_parallel: int | str | None = None,
     n_experts: int | None = None,
+    sequence_parallel: int | None = None,
     devices=None,
 ):
-    """Derive a (dp, fsdp[, ep], tp) mesh for a TPU slice.
+    """Derive a (dp, fsdp[, sp][, ep], tp) mesh for a TPU slice.
 
     Default policy: tp = min(chips, 8 aligned to the slice's minor ICI dim),
     fsdp = remaining chips, dp = 1. ``expert_parallel`` carves an ep axis out
     of the fsdp factor for MoE models (tp stays innermost on the fastest ICI
     dim); pass ``"auto"`` with ``n_experts`` to take gcd(non-tp factor,
-    n_experts). Multi-slice DCN data parallelism belongs on an outer ``dp``
-    axis (see prime_tpu.parallel.distributed).
+    n_experts). ``sequence_parallel`` carves an sp axis for long-context
+    work (ring-attention training, slot-sharded KV caches). Multi-slice DCN
+    data parallelism belongs on an outer ``dp`` axis (see
+    prime_tpu.parallel.distributed).
     """
     import jax
     import math as _math
@@ -72,6 +75,25 @@ def mesh_for_slice(
         while n % tensor_parallel:
             tensor_parallel //= 2
     remaining = n // tensor_parallel
+    sp = None
+    if sequence_parallel and sequence_parallel > 1:
+        if expert_parallel:
+            raise ValueError("sequence_parallel and expert_parallel are mutually exclusive")
+        if remaining % sequence_parallel:
+            raise ValueError(
+                f"sequence_parallel={sequence_parallel} must divide the "
+                f"non-tp factor {remaining}"
+            )
+        sp = sequence_parallel
+        remaining //= sp
+        if fsdp is None:
+            fsdp = remaining
+        if remaining % fsdp:
+            raise ValueError(f"fsdp={fsdp} must divide the non-tp/sp factor {remaining}")
+        return make_mesh(
+            {"dp": remaining // fsdp, "fsdp": fsdp, "sp": sp, "tp": tensor_parallel},
+            devices,
+        )
     if expert_parallel == "auto":
         if not n_experts:
             raise ValueError("expert_parallel='auto' needs n_experts")

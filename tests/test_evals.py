@@ -271,3 +271,19 @@ def test_run_eval_with_weight_quant(tmp_path):
     )
     result = run_eval(spec)
     assert result.metrics["num_samples"] == 2
+
+
+def test_run_eval_sequence_parallel_slot_sharded_cache(tmp_path):
+    """eval run --slice --sp: the KV cache's slot axis shards over sp and
+    the whole eval pipeline still produces results (long-context serving
+    building block through the real runner)."""
+    from prime_tpu.evals.runner import EvalRunSpec, run_eval
+
+    spec = EvalRunSpec(
+        env="synthetic-arith", model="tiny-test", limit=4, batch_size=4,
+        max_new_tokens=8, output_dir=str(tmp_path),
+        slice_name="v5e-8", tensor_parallel=1, sequence_parallel=4,
+    )
+    result = run_eval(spec)
+    assert result.metrics["num_samples"] == 4
+    assert (result.run_dir / "results.jsonl").exists()
