@@ -18,6 +18,9 @@ import click
 @click.option("--tokenizer", default=None)
 @click.option("--slice", "slice_name", default=None, help="Shard over this TPU slice's mesh.")
 @click.option("--tp", "tensor_parallel", type=int, default=None)
+@click.option("--sp", "sequence_parallel", type=click.IntRange(min=2), default=None,
+              help="Sequence-parallel axis for --slice: shard the KV cache's "
+                   "slot dimension across the slice (long-context serving).")
 @click.option("--kv-quant", is_flag=True, help="int8 KV cache (halved decode HBM traffic).")
 @click.option("--weight-quant", is_flag=True, help="int8 weights (W8A16; halved weight HBM traffic).")
 @click.option("--adapter", default=None, type=click.Path(exists=True),
@@ -52,6 +55,7 @@ def serve_cmd(
     tokenizer: str | None,
     slice_name: str | None,
     tensor_parallel: int | None,
+    sequence_parallel: int | None,
     kv_quant: bool,
     weight_quant: bool,
     adapter: str | None,
@@ -74,6 +78,7 @@ def serve_cmd(
             tokenizer=tokenizer,
             slice_name=slice_name,
             tensor_parallel=tensor_parallel,
+            sequence_parallel=sequence_parallel,
             kv_quant=kv_quant,
             weight_quant=weight_quant,
             adapter=adapter,
