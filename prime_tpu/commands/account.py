@@ -49,6 +49,57 @@ def teams_switch(team_id: str | None) -> None:
     click.echo(f"Active team: {team_id or '(personal)'}")
 
 
+@click.command("switch")
+@click.argument("target", required=False)
+def switch_cmd(target: str | None) -> None:
+    """Switch between your personal account and team contexts.
+
+    TARGET is a team slug, a team id, or 'personal'; omit it to pick
+    interactively (reference commands/switch.py)."""
+    cfg = build_config()
+    if target and target.strip().lower() == "personal":
+        cfg.team_id = ""
+        cfg.save()
+        click.echo("Switched to personal account.")
+        return
+    teams = build_client().get("/teams")
+    if target:
+        wanted = target.strip().lower()
+        match = next(
+            (
+                t
+                for t in teams
+                if str(t.get("slug", "")).strip().lower() == wanted
+                or str(t.get("teamId", "")).strip().lower() == wanted
+            ),
+            None,
+        )
+        if match is None:
+            slugs = sorted(str(t.get("slug") or t["teamId"]) for t in teams)
+            raise click.ClickException(
+                f"No team matches {target!r}. Available: {', '.join(slugs)} "
+                "(or 'personal')"
+            )
+    else:
+        if not teams:
+            raise click.ClickException("No teams available — you are on your personal account")
+        for index, team in enumerate(teams, 1):
+            marker = "*" if team["teamId"] == cfg.team_id else " "
+            click.echo(f" {marker} {index}. {team['name']} ({team.get('slug', team['teamId'])})")
+        choice = click.prompt(
+            "Team number (0 for personal)", type=click.IntRange(0, len(teams))
+        )
+        if choice == 0:
+            cfg.team_id = ""
+            cfg.save()
+            click.echo("Switched to personal account.")
+            return
+        match = teams[choice - 1]
+    cfg.team_id = match["teamId"]
+    cfg.save()
+    click.echo(f"Switched to team '{match['name']}'.")
+
+
 @click.command("wallet")
 @output_options
 def wallet(render: Renderer) -> None:

@@ -42,6 +42,7 @@ _LAZY_COMMANDS: dict[str, tuple[str, str]] = {
     "logout": ("prime_tpu.commands.login", "logout"),
     "whoami": ("prime_tpu.commands.account", "whoami"),
     "teams": ("prime_tpu.commands.account", "teams_group"),
+    "switch": ("prime_tpu.commands.account", "switch_cmd"),
     "config": ("prime_tpu.commands.config_cmd", "config_group"),
     "wallet": ("prime_tpu.commands.account", "wallet"),
     "usage": ("prime_tpu.commands.misc", "usage"),

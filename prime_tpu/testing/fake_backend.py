@@ -61,7 +61,7 @@ class FakeControlPlane:
         self.offers = self._seed_offers()
         self.wallet = {"balanceUsd": 100.0, "currency": "USD"}
         self.user = {"userId": "user_1", "email": "dev@example.com", "name": "Dev"}
-        self.teams = [{"teamId": "team_1", "name": "research"}]
+        self.teams = [{"teamId": "team_1", "name": "research", "slug": "research"}]
         self.secrets: dict[str, str] = {}
         self._routes: list[tuple[str, re.Pattern[str], Callable[..., httpx.Response]]] = []
         self._register_routes()

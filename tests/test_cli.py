@@ -299,3 +299,20 @@ def test_pods_create_wizard_runtime_and_disk_in_payload(runner, fake):
     pod = next(iter(fake.pods.values()))
     assert pod["runtimeVersion"] == "v2-alpha-tpuv5-lite"
     assert pod["diskSizeGib"] == 250
+
+
+def test_switch_by_slug_id_personal_and_miss(runner, fake):
+    """Top-level `prime switch` (reference commands/switch.py): resolves a
+    team by slug or id, 'personal' clears the team, unknown targets list
+    what's available, and no argument prompts interactively."""
+    assert runner.invoke(cli, ["switch", "research"]).exit_code == 0
+    result = runner.invoke(cli, ["whoami", "--output", "json"])
+    assert json.loads(result.output)["teamId"] == "team_1" or result.exit_code == 0
+    assert "Switched to team 'research'" in runner.invoke(cli, ["switch", "team_1"]).output
+    assert "personal" in runner.invoke(cli, ["switch", "personal"]).output
+    missed = runner.invoke(cli, ["switch", "nope"])
+    assert missed.exit_code != 0 and "research" in missed.output
+    picked = runner.invoke(cli, ["switch"], input="1\n")
+    assert picked.exit_code == 0 and "Switched to team 'research'" in picked.output
+    picked = runner.invoke(cli, ["switch"], input="0\n")
+    assert picked.exit_code == 0 and "personal" in picked.output
