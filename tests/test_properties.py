@@ -135,3 +135,53 @@ def test_escaped_gitignore_patterns_match_literally(name):
     # the escaped pattern, with escapes stripped the way git reads them,
     # must match exactly the literal name via fnmatch-style semantics
     assert escaped.replace("\\\\", "\0").replace("\\", "").replace("\0", "\\") == name
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    seed=st.integers(0, 2**31 - 1),
+    heads=st.sampled_from([1, 2, 4]),
+    keys=st.integers(1, 9),
+)
+def test_sink_softmax_equals_concat_softmax(seed, heads, keys):
+    """_sink_softmax(scores, sink) must equal softmax over [scores, sink]
+    with the sink column dropped (the HF GPT-OSS formulation) for any
+    scores, including extreme magnitudes."""
+    from prime_tpu.ops.attention import _sink_softmax
+
+    rng = np.random.default_rng(seed)
+    scores = jnp.asarray(
+        rng.normal(scale=rng.choice([1.0, 30.0, 300.0]), size=(1, heads, 2, keys)),
+        dtype=jnp.float32,
+    )
+    sinks = jnp.asarray(rng.normal(size=(heads,)), dtype=jnp.float32)
+    got = _sink_softmax(scores, sinks.reshape(1, heads, 1, 1))
+    padded = jnp.concatenate(
+        [scores, jnp.broadcast_to(sinks.reshape(1, heads, 1, 1), (1, heads, 2, 1))],
+        axis=-1,
+    )
+    want = jax.nn.softmax(padded, axis=-1)[..., :-1]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    window=st.integers(1, 10_000),
+    s_local=st.sampled_from([1, 8, 64, 256, 1024]),
+    axis_size=st.sampled_from([2, 4, 8, 16]),
+)
+def test_ring_hops_is_sufficient_and_tight(window, s_local, axis_size):
+    """The hop cap must be SUFFICIENT (every position a query can see lies
+    within `hops` shards upstream) and TIGHT (one fewer hop would miss a
+    visible position, unless capped at the full rotation)."""
+    from prime_tpu.parallel.ring_attention import ring_hops
+
+    hops = ring_hops(window, s_local, axis_size)
+    assert 0 <= hops <= axis_size - 1
+    # sufficiency: the earliest query on any shard (local offset 0) sees
+    # back window-1 positions; those must fit within hops upstream shards
+    if hops < axis_size - 1:
+        assert window - 1 <= hops * s_local
+        # tightness: hops-1 shards would NOT cover the band
+        if hops > 0:
+            assert window - 1 > (hops - 1) * s_local
