@@ -57,10 +57,14 @@ def switch_cmd(target: str | None) -> None:
     TARGET is a team slug, a team id, or 'personal'; omit it to pick
     interactively (reference commands/switch.py)."""
     cfg = build_config()
-    if target and target.strip().lower() == "personal":
+
+    def go_personal() -> None:
         cfg.team_id = ""
         cfg.save()
         click.echo("Switched to personal account.")
+
+    if target and target.strip().lower() == "personal":
+        go_personal()
         return
     teams = build_client().get("/teams")
     if target:
@@ -90,9 +94,7 @@ def switch_cmd(target: str | None) -> None:
             "Team number (0 for personal)", type=click.IntRange(0, len(teams))
         )
         if choice == 0:
-            cfg.team_id = ""
-            cfg.save()
-            click.echo("Switched to personal account.")
+            go_personal()
             return
         match = teams[choice - 1]
     cfg.team_id = match["teamId"]

@@ -164,6 +164,9 @@ def run_eval_cmd(
                 ("--checkpoint", checkpoint),
                 ("--tokenizer", tokenizer),
                 ("--adapter", adapter),
+                ("--slice", slice_name),
+                ("--tp", tensor_parallel),
+                ("--sp", sequence_parallel),
             )
             if value is not None
         ]

@@ -159,6 +159,14 @@ class JaxGenerator:
             no_drop = self.config.n_experts / self.config.experts_per_token
             if self.config.capacity_factor < no_drop:
                 self.config = self.config.scaled(capacity_factor=no_drop)
+        if sequence_parallel and (mesh is not None or slice_name is None):
+            # silently dropping the flag would leave the user believing a
+            # long-context cache is spread across the slice when it isn't
+            raise ValueError(
+                "sequence_parallel needs slice_name (the sp axis is carved "
+                "from the slice's mesh); with an explicit mesh, build the sp "
+                "axis into it instead"
+            )
         if mesh is None and slice_name is not None:
             from prime_tpu.parallel.mesh import mesh_for_slice
 

@@ -306,8 +306,9 @@ def test_switch_by_slug_id_personal_and_miss(runner, fake):
     team by slug or id, 'personal' clears the team, unknown targets list
     what's available, and no argument prompts interactively."""
     assert runner.invoke(cli, ["switch", "research"]).exit_code == 0
-    result = runner.invoke(cli, ["whoami", "--output", "json"])
-    assert json.loads(result.output)["teamId"] == "team_1" or result.exit_code == 0
+    # the switch must actually persist: teams list marks team_1 active
+    listed = runner.invoke(cli, ["teams", "list", "--plain"]).output
+    assert "*" in listed
     assert "Switched to team 'research'" in runner.invoke(cli, ["switch", "team_1"]).output
     assert "personal" in runner.invoke(cli, ["switch", "personal"]).output
     missed = runner.invoke(cli, ["switch", "nope"])

@@ -287,3 +287,14 @@ def test_run_eval_sequence_parallel_slot_sharded_cache(tmp_path):
     result = run_eval(spec)
     assert result.metrics["num_samples"] == 4
     assert (result.run_dir / "results.jsonl").exists()
+
+
+def test_sequence_parallel_without_slice_rejected():
+    """--sp must not be silently dropped: without a slice (or with an
+    explicit mesh) the generator refuses instead of serving unsharded."""
+    import pytest as _pytest
+
+    from prime_tpu.evals.runner import JaxGenerator
+
+    with _pytest.raises(ValueError, match="sequence_parallel needs slice_name"):
+        JaxGenerator("tiny-test", sequence_parallel=4)
