@@ -305,6 +305,7 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — a failed extra must not zero the headline
         record["eval_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: eval section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
     # ---- serve: continuous-batching engine under concurrent load ------------
     n_req, req_new = 16, 64
@@ -345,6 +346,7 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         record["serve_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: serve section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
     try:
         # int8-cache engine: same load, half the KV HBM traffic per step
         record["serve_int8_tok_s"] = round(run_serve(kv_quant=True), 1)
@@ -352,6 +354,7 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         record["serve_int8_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: serve int8 section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
     try:
         # speculative engine on genuinely PERIODIC prompts (the favorable
         # regime: continuations repeat the cycle, so n-gram drafts land and
@@ -367,6 +370,7 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         record["serve_spec_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: serve speculative section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
     # ---- quant: int8 weights / int8 KV --------------------------------------
     try:
@@ -392,6 +396,7 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         record["quant_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: quant section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
     # ---- longctx: flash-decode pallas kernel vs XLA at C=4096 ---------------
     # The regime the kernel exists for (short context dispatches to XLA via
@@ -454,6 +459,7 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         record["longctx_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: longctx section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
     # ---- winctx: sliding-window flash decode at long context ----------------
     # The round-4 kernel variant: a sliding layer's decode step front-skips
@@ -497,6 +503,7 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         record["winctx_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: winctx section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
     # ---- spdecode: sequence-parallel decode step ----------------------------
     # The long-context decode path a v5e-8+ slice runs (cache slots sharded
@@ -534,6 +541,7 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         record["spdecode_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: spdecode section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
     # final, enriched record — last JSON line on stdout wins
     print(json.dumps(record), flush=True)
