@@ -59,6 +59,52 @@ def set_inference_url(value: str) -> None:
     _set("inference_url", value)
 
 
+@config_group.command("set-frontend-url")
+@click.argument("value")
+def set_frontend_url(value: str) -> None:
+    _set("frontend_url", value)
+
+
+@config_group.command("remove-team-id")
+def remove_team_id() -> None:
+    """Clear the active team (back to personal scope)."""
+    _set("team_id", "")
+
+
+@config_group.command("set-share-resources-with-team")
+@click.argument("enabled", type=click.Choice(["true", "false"]))
+def set_share_resources_with_team(enabled: str) -> None:
+    """Auto-share newly created resources with all team members."""
+    cfg = build_config()
+    cfg.share_resources_with_team = enabled == "true"
+    cfg.save()
+    click.echo(f"Share resources with team set to: {enabled}")
+
+
+@config_group.command("reset")
+@click.option("--yes", "-y", is_flag=True, help="Skip the confirmation prompt.")
+def reset_cmd(yes: bool) -> None:
+    """Reset configuration to defaults (reference commands/config.py reset)."""
+    if not yes and not click.confirm("Reset all settings to defaults?"):
+        click.echo("Aborted.")
+        return
+    from prime_tpu.core.config import (
+        DEFAULT_BASE_URL,
+        DEFAULT_FRONTEND_URL,
+        DEFAULT_INFERENCE_URL,
+    )
+
+    cfg = build_config()
+    cfg.api_key = ""
+    cfg.team_id = ""
+    cfg.base_url = DEFAULT_BASE_URL
+    cfg.frontend_url = DEFAULT_FRONTEND_URL
+    cfg.inference_url = DEFAULT_INFERENCE_URL
+    cfg.share_resources_with_team = False
+    cfg.save()
+    click.echo("Configuration reset to defaults.")
+
+
 @config_group.command("set-ssh-key-path")
 @click.argument("value", type=click.Path())
 def set_ssh_key_path(value: str) -> None:

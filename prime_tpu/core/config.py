@@ -38,6 +38,8 @@ class ConfigModel(BaseModel):
     frontend_url: str = DEFAULT_FRONTEND_URL
     inference_url: str = DEFAULT_INFERENCE_URL
     ssh_key_path: str = Field(default_factory=lambda: str(Path.home() / ".ssh" / "id_rsa"))
+    # auto-share newly created resources with the active team
+    share_resources_with_team: bool = False
     # TPU-native defaults: which accelerator generation the create-wizard proposes.
     default_tpu_type: str = "v5e"
 
@@ -161,6 +163,18 @@ class Config:
     @property
     def frontend_url(self) -> str:
         return self._get("frontend_url").rstrip("/")
+
+    @frontend_url.setter
+    def frontend_url(self, value: str) -> None:
+        self._model.frontend_url = value
+
+    @property
+    def share_resources_with_team(self) -> bool:
+        return bool(self._model.share_resources_with_team)
+
+    @share_resources_with_team.setter
+    def share_resources_with_team(self, value: bool) -> None:
+        self._model.share_resources_with_team = bool(value)
 
     @property
     def inference_url(self) -> str:

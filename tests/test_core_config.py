@@ -94,3 +94,37 @@ def test_corrupt_config_file_falls_back_to_defaults(tmp_path):
     d.mkdir()
     (d / "config.json").write_text("{not json")
     assert Config(d).api_key == ""
+
+
+def test_config_cli_frontend_share_remove_reset(tmp_path, monkeypatch):
+    """Round-4 parity: set-frontend-url / remove-team-id /
+    set-share-resources-with-team / reset (reference commands/config.py)."""
+    import json as _json
+
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.main import cli
+
+    monkeypatch.setenv("PRIME_CONFIG_DIR", str(tmp_path))
+    monkeypatch.delenv("PRIME_API_KEY", raising=False)
+    monkeypatch.delenv("PRIME_BASE_URL", raising=False)
+    runner = CliRunner()
+    assert runner.invoke(cli, ["config", "set-frontend-url", "https://f.example"]).exit_code == 0
+    assert runner.invoke(cli, ["config", "set-team-id", "team_9"]).exit_code == 0
+    assert runner.invoke(
+        cli, ["config", "set-share-resources-with-team", "true"]
+    ).exit_code == 0
+    saved = _json.loads((tmp_path / "config.json").read_text())
+    assert saved["frontend_url"] == "https://f.example"
+    assert saved["share_resources_with_team"] is True
+    assert runner.invoke(cli, ["config", "remove-team-id"]).exit_code == 0
+    assert _json.loads((tmp_path / "config.json").read_text())["team_id"] == ""
+    # invalid share value is rejected by the choice type
+    assert runner.invoke(
+        cli, ["config", "set-share-resources-with-team", "maybe"]
+    ).exit_code != 0
+    # reset restores defaults (confirmation skipped with -y)
+    assert runner.invoke(cli, ["config", "reset", "-y"]).exit_code == 0
+    saved = _json.loads((tmp_path / "config.json").read_text())
+    assert saved["frontend_url"] != "https://f.example"
+    assert saved["share_resources_with_team"] is False
