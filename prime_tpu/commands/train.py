@@ -108,6 +108,29 @@ def run_cmd(render: Renderer, config_file: str, yes: bool, follow: bool) -> None
         _stream_logs(render, run_id)
 
 
+@train_group.command("request")
+@click.option("--models", "-m", "models_text", default=None,
+              help="Model(s) to request (comma-separated); prompts when omitted.")
+@click.option("--context", "context_text", default=None,
+              help="Use case / why this model matters.")
+def request_models_cmd(models_text: str | None, context_text: str | None) -> None:
+    """Request models for Hosted Training (lands as product feedback;
+    reference rl.py:1803)."""
+    if models_text is None:
+        models_text = click.prompt("Model(s) (provider/model names, comma-separated ok)")
+    if not models_text.strip():
+        raise click.ClickException("At least one model is required")
+    if context_text is None:
+        context_text = click.prompt(
+            "Use case or context (enter to skip)", default="", show_default=False
+        )
+    message = f"Hosted Training model request: {models_text.strip()}"
+    if context_text.strip():
+        message += f"\nContext: {context_text.strip()}"
+    deps.build_client().post("/feedback", json={"message": message}, idempotent_post=True)
+    click.echo("Request submitted. Thanks!")
+
+
 @train_group.command("local")
 @click.option("--model", "-m", default="tiny-test", help="Model preset to train.")
 @click.option("--steps", type=int, default=20)

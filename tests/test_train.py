@@ -740,3 +740,19 @@ def test_train_local_cli_context_parallel(tmp_path):
          "--seq-len", "64", "--steps", "2"],
     )
     assert bad.exit_code != 0 and "uniform" in bad.output
+
+
+def test_train_request_models(runner, fake):
+    """`prime train request` submits a model request as product feedback
+    (reference rl.py:1803)."""
+    from prime_tpu.commands.main import cli
+
+    result = runner.invoke(
+        cli, ["train", "request", "-m", "meta/llama-4-behemoth", "--context", "RL"]
+    )
+    assert result.exit_code == 0, result.output
+    assert "Thanks" in result.output
+    assert any("llama-4-behemoth" in m["message"] for m in fake.misc_plane.feedback)
+    # a blank models answer is rejected
+    result = runner.invoke(cli, ["train", "request", "-m", "  "])
+    assert result.exit_code != 0 and "required" in result.output
