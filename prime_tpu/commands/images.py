@@ -168,6 +168,51 @@ def unpublish_cmd(render: Renderer, image_id: str) -> None:
     render.message(f"Image {shorten(image_id)} is now {result.get('visibility')}.")
 
 
+@images_group.command("update")
+@click.argument("image_id")
+@click.option("--name", default=None, help="New image name.")
+@click.option("--visibility", type=click.Choice(["public", "private"]), default=None)
+@click.option("--description", default=None)
+@output_options
+def update_cmd(
+    render: Renderer,
+    image_id: str,
+    name: str | None,
+    visibility: str | None,
+    description: str | None,
+) -> None:
+    """Update one image's metadata (reference images.py update)."""
+    fields = {
+        key: value
+        for key, value in (
+            ("name", name), ("visibility", visibility), ("description", description)
+        )
+        if value is not None
+    }
+    if not fields:
+        raise click.ClickException("nothing to update — pass --name/--visibility/--description")
+    from prime_tpu.core.exceptions import APIError
+
+    try:
+        _image_client().update(image_id, **fields)
+    except APIError as e:
+        raise click.ClickException(str(e)) from None
+    render.message(f"Image {shorten(image_id)} updated ({', '.join(sorted(fields))}).")
+
+
+@images_group.command("delete")
+@click.argument("image_id")
+@click.option("--yes", "-y", is_flag=True, help="Skip the confirmation prompt.")
+@output_options
+def delete_cmd(render: Renderer, image_id: str, yes: bool) -> None:
+    """Delete an image from the registry (reference images.py delete)."""
+    if not yes and not click.confirm(f"Delete image {shorten(image_id)}?"):
+        render.message("Aborted.")
+        return
+    _image_client().delete(image_id)
+    render.message(f"Image {shorten(image_id)} deleted.")
+
+
 @images_group.command("visibility")
 @click.argument("visibility", type=click.Choice(["public", "private"]))
 @click.argument("image_ids", nargs=-1, required=True)

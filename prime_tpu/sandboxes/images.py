@@ -112,6 +112,20 @@ class ImageClient:
         data = self.api.post("/images/update-bulk", json={"updates": updates}, idempotent_post=True)
         return data.get("results", [])
 
+    def update(self, image_id: str, **fields: Any) -> dict[str, Any]:
+        """Single-image update (name/visibility/description): the bulk
+        endpoint with one entry, so single and bulk share one contract."""
+        results = self.update_bulk([{"imageId": image_id, **fields}])
+        result = results[0] if results else {"imageId": image_id, "ok": False, "error": "no result"}
+        if not result.get("ok"):
+            from prime_tpu.core.exceptions import APIError
+
+            raise APIError(f"update {image_id} failed: {result.get('error', 'unknown')}")
+        return result
+
+    def delete(self, image_id: str) -> dict[str, Any]:
+        return self.api.delete(f"/images/{image_id}") or {"imageId": image_id, "deleted": True}
+
 
 class AsyncImageClient:
     def __init__(self, client: AsyncAPIClient | None = None) -> None:

@@ -263,6 +263,13 @@ class FakeMiscPlane:
                 results.append({"imageId": image["imageId"], "ok": True})
             return _json_response(200, {"results": results})
 
+        @route("DELETE", r"/images/(?P<iid>[^/]+)")
+        def delete_image(request: httpx.Request, iid: str) -> httpx.Response:
+            if iid not in plane.images:
+                return _json_response(404, {"detail": f"image {iid} not found"})
+            del plane.images[iid]
+            return _json_response(200, {"imageId": iid, "deleted": True})
+
         @route("POST", r"/images/visibility-bulk")
         def visibility_bulk(request: httpx.Request) -> httpx.Response:
             body = plane.fake._body(request)

@@ -230,3 +230,28 @@ def test_cli_bulk_push_bad_entry_does_not_abort_batch(runner, fake, tmp_path):
     assert result.exit_code == 1
     assert "1/2 succeeded" in result.output
     assert "no-dockerfile" in result.output  # failed entry still labeled
+
+
+def test_images_update_and_delete(runner, fake, client):
+    """Single-image update (shares the bulk contract) and delete with
+    confirmation (reference images.py update/delete)."""
+    from prime_tpu.commands.main import cli
+
+    image_id = client.build("upd-img", dockerfile_text="FROM x\n")["imageId"]
+    result = runner.invoke(
+        cli, ["images", "update", image_id, "--name", "renamed", "--visibility", "public"]
+    )
+    assert result.exit_code == 0, result.output
+    assert fake.misc_plane.images[image_id]["name"] == "renamed"
+    assert fake.misc_plane.images[image_id]["visibility"] == "public"
+    # nothing-to-update and unknown image both error loudly
+    assert runner.invoke(cli, ["images", "update", image_id]).exit_code != 0
+    assert runner.invoke(
+        cli, ["images", "update", "img_nope", "--name", "x"]
+    ).exit_code != 0
+    # delete: refused without confirmation, removed with -y
+    refused = runner.invoke(cli, ["images", "delete", image_id], input="n\n")
+    assert refused.exit_code == 0 and image_id in fake.misc_plane.images
+    assert runner.invoke(cli, ["images", "delete", image_id, "-y"]).exit_code == 0
+    assert image_id not in fake.misc_plane.images
+    assert runner.invoke(cli, ["images", "delete", image_id, "-y"]).exit_code != 0
