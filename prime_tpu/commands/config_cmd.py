@@ -88,19 +88,10 @@ def reset_cmd(yes: bool) -> None:
     if not yes and not click.confirm("Reset all settings to defaults?"):
         click.echo("Aborted.")
         return
-    from prime_tpu.core.config import (
-        DEFAULT_BASE_URL,
-        DEFAULT_FRONTEND_URL,
-        DEFAULT_INFERENCE_URL,
-    )
-
     cfg = build_config()
-    cfg.api_key = ""
-    cfg.team_id = ""
-    cfg.base_url = DEFAULT_BASE_URL
-    cfg.frontend_url = DEFAULT_FRONTEND_URL
-    cfg.inference_url = DEFAULT_INFERENCE_URL
-    cfg.share_resources_with_team = False
+    # a fresh ConfigModel: EVERY field resets (user_id, ssh_key_path, and
+    # any field added later included), no hand-maintained list to drift
+    cfg.reset()
     cfg.save()
     click.echo("Configuration reset to defaults.")
 

@@ -191,12 +191,8 @@ def update_cmd(
     }
     if not fields:
         raise click.ClickException("nothing to update — pass --name/--visibility/--description")
-    from prime_tpu.core.exceptions import APIError
-
-    try:
-        _image_client().update(image_id, **fields)
-    except APIError as e:
-        raise click.ClickException(str(e)) from None
+    # APIError -> ClickException happens in LazyGroup.invoke (main.py)
+    _image_client().update(image_id, **fields)
     render.message(f"Image {shorten(image_id)} updated ({', '.join(sorted(fields))}).")
 
 

@@ -116,13 +116,18 @@ def run_cmd(render: Renderer, config_file: str, yes: bool, follow: bool) -> None
 def request_models_cmd(models_text: str | None, context_text: str | None) -> None:
     """Request models for Hosted Training (lands as product feedback;
     reference rl.py:1803)."""
-    if models_text is None:
+    prompted = models_text is None
+    if prompted:
         models_text = click.prompt("Model(s) (provider/model names, comma-separated ok)")
     if not models_text.strip():
         raise click.ClickException("At least one model is required")
     if context_text is None:
-        context_text = click.prompt(
-            "Use case or context (enter to skip)", default="", show_default=False
+        # only prompt in the interactive flow — `-m` from a script must not
+        # hang on a stdin read for an OPTIONAL field
+        context_text = (
+            click.prompt("Use case or context (enter to skip)", default="", show_default=False)
+            if prompted
+            else ""
         )
     message = f"Hosted Training model request: {models_text.strip()}"
     if context_text.strip():

@@ -99,6 +99,12 @@ class Config:
                 return ConfigModel()
         return ConfigModel()
 
+    def reset(self) -> None:
+        """Replace the in-memory model with a fresh default ConfigModel —
+        every field (including ones added later) resets, with no
+        hand-maintained enumeration to drift."""
+        self._model = ConfigModel()
+
     def save(self) -> None:
         self.config_dir.mkdir(parents=True, exist_ok=True)
         self._atomic_write(self.config_file, self._model.model_dump())

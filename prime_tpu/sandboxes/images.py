@@ -22,6 +22,17 @@ from prime_tpu.core.client import APIClient, AsyncAPIClient
 
 class _ImageOps:
     @staticmethod
+    def single_update_result(image_id: str, results: list[dict[str, Any]]) -> dict[str, Any]:
+        """Shared single-image update contract for the sync/async clients:
+        the bulk endpoint's one-entry result, raised as APIError on failure."""
+        result = results[0] if results else {"imageId": image_id, "ok": False, "error": "no result"}
+        if not result.get("ok"):
+            from prime_tpu.core.exceptions import APIError
+
+            raise APIError(f"update {image_id} failed: {result.get('error', 'unknown')}")
+        return result
+
+    @staticmethod
     def build_payload(
         name: str,
         dockerfile: str | Path | None = None,
@@ -116,12 +127,7 @@ class ImageClient:
         """Single-image update (name/visibility/description): the bulk
         endpoint with one entry, so single and bulk share one contract."""
         results = self.update_bulk([{"imageId": image_id, **fields}])
-        result = results[0] if results else {"imageId": image_id, "ok": False, "error": "no result"}
-        if not result.get("ok"):
-            from prime_tpu.core.exceptions import APIError
-
-            raise APIError(f"update {image_id} failed: {result.get('error', 'unknown')}")
-        return result
+        return _ImageOps.single_update_result(image_id, results)
 
     def delete(self, image_id: str) -> dict[str, Any]:
         return self.api.delete(f"/images/{image_id}") or {"imageId": image_id, "deleted": True}
