@@ -458,3 +458,32 @@ def test_int8_weights_gptoss_tree_quantizes_cleanly():
     out = generate(qp, prompts, lengths, cfg, jax.random.PRNGKey(0),
                    max_new_tokens=6, temperature=0.0)
     np.testing.assert_array_equal(np.asarray(ref.tokens), np.asarray(out.tokens))
+
+
+def test_longrope_long_factor_branch_decodes():
+    """A LongRoPE config whose cache capacity crosses the pretrained range
+    selects the LONG factor set (static per run) and still decodes
+    deterministically — the branch no short-context parity test reaches."""
+    from prime_tpu.ops.rope import rope_frequencies
+
+    cfg = CFG.scaled(
+        rope_longrope=((1.0,) * (CFG.head_dim // 2), (4.0,) * (CFG.head_dim // 2), 32.0, 1.2),
+        max_seq_len=128,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    prompts = jax.random.randint(jax.random.PRNGKey(1), (2, 40), 1, cfg.vocab_size)
+    lengths = jnp.asarray([40, 33], jnp.int32)
+    # capacity 40+8 = 48 > original_max 32 -> long factors
+    out1 = generate(params, prompts, lengths, cfg, jax.random.PRNGKey(2),
+                    max_new_tokens=8, temperature=0.0)
+    out2 = generate(params, prompts, lengths, cfg, jax.random.PRNGKey(2),
+                    max_new_tokens=8, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(out1.tokens), np.asarray(out2.tokens))
+    # and the table builder demonstrably switches sets at the boundary
+    cos_short, _ = rope_frequencies(
+        CFG.head_dim, 16, 10000.0, longrope=cfg.rope_longrope, longrope_select=16
+    )
+    cos_long, _ = rope_frequencies(
+        CFG.head_dim, 16, 10000.0, longrope=cfg.rope_longrope, longrope_select=64
+    )
+    assert not np.allclose(np.asarray(cos_short), np.asarray(cos_long))
