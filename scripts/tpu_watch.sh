@@ -2,10 +2,10 @@
 # TPU reachability watcher: probe the axon backend every ~3 min, log results.
 # When the tunnel is up, /tmp/tpu_watch.log shows "UP" lines.
 #
-# Opportunistic bench (round 4): on the FIRST successful probe, run
+# Opportunistic bench (round 5): on the FIRST successful probe, run
 # `python bench.py` immediately and commit the captured record as
-# BENCH_opportunistic_r04.json plus a BASELINE.md row — the tunnel was down
-# for the entire round-3 builder window, so a single UP window anywhere in
+# BENCH_opportunistic_r05.json plus a BASELINE.md row — the tunnel was down
+# for the entire previous builder windows, so a single UP window anywhere in
 # the round must yield a durable number even if the end-of-round window is
 # down again. Only a NONZERO headline is committed; a 0.0 abort (tunnel
 # flapped between probe and bench) leaves no marker so a later UP window
@@ -22,12 +22,12 @@
 LOG=${TPU_WATCH_LOG:-/tmp/tpu_watch.log}
 REPO=${TPU_WATCH_REPO:-/root/repo}
 SLEEP=${TPU_WATCH_SLEEP:-160}
-OPP="$REPO/BENCH_opportunistic_r04.json"
+OPP="$REPO/BENCH_opportunistic_r05.json"
 # startup reconciliation: a crash between writing the marker and the commit
 # landing leaves an uncommitted marker that would block every future
 # capture — if the marker isn't in the git index, drop it and re-capture
 if [ -e "$OPP" ] && ! git -C "$REPO" ls-files --error-unmatch \
-    BENCH_opportunistic_r04.json >/dev/null 2>&1; then
+    BENCH_opportunistic_r05.json >/dev/null 2>&1; then
   rm -f "$OPP"
 fi
 probe() {
@@ -76,16 +76,16 @@ while true; do
         cp "$TMP" "$OPP"
         {
           echo ""
-          echo "### Opportunistic capture $(date -u +%Y-%m-%dT%H:%M:%SZ) (round 4 watcher)"
+          echo "### Opportunistic capture $(date -u +%Y-%m-%dT%H:%M:%SZ) (round 5 watcher)"
           echo ""
           echo "Tunnel-UP window caught by scripts/tpu_watch.sh; full record in"
-          echo "\`BENCH_opportunistic_r04.json\` (headline decode: ${val} tok/s)."
+          echo "\`BENCH_opportunistic_r05.json\` (headline decode: ${val} tok/s)."
         } >> "$REPO/BASELINE.md"
         # pathspec after `--` restricts the commit to these two files even
         # if the operator has unrelated changes staged in the index
-        if (cd "$REPO" && git add BENCH_opportunistic_r04.json BASELINE.md \
+        if (cd "$REPO" && git add BENCH_opportunistic_r05.json BASELINE.md \
           && git commit -q -m "Capture opportunistic TPU bench during UP window (headline ${val} tok/s)" \
-               -- BENCH_opportunistic_r04.json BASELINE.md); then
+               -- BENCH_opportunistic_r05.json BASELINE.md); then
           echo "$(date -u +%H:%M:%S) OPPORTUNISTIC BENCH done rc=$brc value=$val (committed)" >> "$LOG"
         else
           # commit failed (index.lock, hook, ...): drop the marker so the
