@@ -89,6 +89,17 @@ def xla_attention_causal(
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
 
 
+def _pallas_interpret() -> bool:
+    """PRIME_TPU_PALLAS_INTERPRET=1 runs the kernels in interpret mode, so
+    the pallas dispatch paths (incl. window/softcap/sink/int8 variants) can
+    be validated off-TPU — bench.py's smoke mode sets it on CPU."""
+    import os
+
+    return os.environ.get("PRIME_TPU_PALLAS_INTERPRET", "").lower() not in (
+        "", "0", "false", "no",
+    )
+
+
 def _flash_decode_min_capacity() -> int:
     import os
     import warnings
@@ -164,7 +175,7 @@ def decode_attention(
         return flash_decode(
             q, k_cache, v_cache, cache_lengths, sm_scale=sm_scale,
             softcap=softcap, window=window, sliding=sliding, sinks=sinks,
-            k_scale=k_scale, v_scale=v_scale,
+            k_scale=k_scale, v_scale=v_scale, interpret=_pallas_interpret(),
         )
 
     batch, num_heads, _, head_dim = q.shape
@@ -304,6 +315,6 @@ def multi_head_attention(
 
         return flash_attention_causal(
             q, k, v, sm_scale=sm_scale, softcap=softcap, window=window,
-            sliding=sliding, sinks=sinks,
+            sliding=sliding, sinks=sinks, interpret=_pallas_interpret(),
         )
     return xla_attention_causal(q, k, v, sm_scale, softcap, window, sliding, sinks=sinks)
