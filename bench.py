@@ -53,6 +53,19 @@ if SMOKE:
     # run the pallas sections (longctx/winctx variants) in interpret mode so
     # an off-TPU smoke exercises the kernel dispatch paths end to end
     os.environ.setdefault("PRIME_TPU_PALLAS_INTERPRET", "1")
+    # smoke validates bench.py's code paths, not the tunnel: force the CPU
+    # backend and neutralize the axon plugin. Setting the env vars in-process
+    # is too late (the axon site hook reads them at interpreter start, and a
+    # down tunnel then blocks backend init forever — exactly when smoke gets
+    # used), so re-exec once with a scrubbed environment.
+    if os.environ.get("PRIME_BENCH_SMOKE_REEXEC") != "1":
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            PALLAS_AXON_POOL_IPS="",
+            PRIME_BENCH_SMOKE_REEXEC="1",
+        )
+        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 BATCH = 2 if SMOKE else 8
 PROMPT_LEN = 16 if SMOKE else 128
 NEW_TOKENS = 8 if SMOKE else 128
@@ -205,9 +218,10 @@ def _diagnose() -> dict:
                 return "-c"
             if arg == "-m":
                 return f"-m {argv[i + 1]}" if i + 1 < len(argv) else "-m"
-            # ONLY a .py path is safe to echo: a bare non-dash argument may be
+            # ONLY a non-dash .py path is safe to echo: a bare argument may be
             # the space-separated VALUE of a preceding flag (`--token SECRET`)
-            if arg.endswith(".py"):
+            # and a dash-prefixed one is a flag (possibly `--config=creds.py`)
+            if arg.endswith(".py") and not arg.startswith("-"):
                 return os.path.basename(arg)
         return "?"
 

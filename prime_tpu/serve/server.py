@@ -314,6 +314,7 @@ def serve_model(
     tokenizer: str | None = None,
     slice_name: str | None = None,
     tensor_parallel: int | None = None,
+    sequence_parallel: int | None = None,
     kv_quant: bool = False,
     weight_quant: bool = False,
     adapter: str | None = None,
@@ -342,6 +343,7 @@ def serve_model(
             tokenizer=tokenizer,
             slice_name=slice_name,
             tensor_parallel=tensor_parallel,
+            sequence_parallel=sequence_parallel,
             kv_quant=kv_quant,
             weight_quant=weight_quant,
             adapter=adapter,
@@ -355,9 +357,19 @@ def serve_model(
 
             cache_spec = None
             if generator.mesh is not None:
-                from prime_tpu.parallel.sharding import cache_spec as _cache_spec
+                from prime_tpu.parallel.sharding import (
+                    cache_spec as _cache_spec,
+                    prune_spec,
+                    sp_cache_spec,
+                )
 
-                cache_spec = _cache_spec()
+                # an sp axis shards each slot's KV cache over the slice's
+                # slot dimension — long-context serving where one request's
+                # cache exceeds a single chip's HBM (mirrors evals/runner.py)
+                has_sp = generator.mesh.shape.get("sp", 1) > 1
+                cache_spec = prune_spec(
+                    sp_cache_spec() if has_sp else _cache_spec(), generator.mesh
+                )
             engine = ContinuousBatchingEngine(
                 generator.params,
                 generator.config,

@@ -471,6 +471,52 @@ def test_engine_under_mesh():
     assert req.all_tokens(timeout=1) == reference_tokens(prompt, 6)
 
 
+def test_engine_under_sp_mesh():
+    """Slot-sharded long-context serving (VERDICT r4 #7): the engine's KV
+    cache slot axis shards over an sp axis (sp_cache_spec) and concurrent
+    requests still decode exactly the one-shot sampler's tokens."""
+    from prime_tpu.parallel.mesh import make_mesh
+    from prime_tpu.parallel.sharding import prune_spec, shard_params, sp_cache_spec
+
+    mesh = make_mesh({"dp": 1, "fsdp": 1, "tp": 2, "sp": 2}, devices=jax.devices()[:4])
+    sharded = shard_params(PARAMS, mesh, CONFIG)
+    engine = ContinuousBatchingEngine(
+        sharded, CONFIG, max_slots=2, capacity=64, chunk=4,
+        mesh=mesh, cache_spec=prune_spec(sp_cache_spec(), mesh),
+    )
+    prompts = [[9, 8, 7, 6], [5, 4, 3]]
+    reqs = [engine.submit(p, max_new_tokens=6) for p in prompts]
+    while not all(r.done for r in reqs):
+        engine.tick()
+    for p, r in zip(prompts, reqs):
+        assert r.all_tokens(timeout=1) == reference_tokens(p, 6)
+
+
+def test_serve_model_accepts_sequence_parallel():
+    """`prime serve --sp N` reaches the engine: serve_model must accept
+    sequence_parallel and build the sp-meshed continuous engine with a
+    slot-sharded cache spec (this kwarg was dropped in round 4 — the CLI
+    raised TypeError before any model loaded)."""
+    from prime_tpu.serve import serve_model
+
+    server = serve_model(
+        "tiny-test", port=0, slice_name="v5e-8", sequence_parallel=2,
+        continuous=True, max_slots=2, slot_capacity=64, chunk=4,
+    )
+    with server:
+        engine = server.generator.engine
+        assert engine.mesh is not None and engine.mesh.shape.get("sp") == 2
+        assert engine.cache_spec[-1] == "sp"  # slot axis sharded
+        import httpx
+
+        response = httpx.post(
+            f"{server.url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "2+2="}], "max_tokens": 4},
+            timeout=120,
+        )
+        assert response.status_code == 200
+
+
 def test_bigram_index_matches_backward_scan():
     """The incremental prompt-lookup index must propose exactly what the
     O(history) backward scan it replaced proposed, across random histories

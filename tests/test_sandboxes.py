@@ -376,13 +376,28 @@ def test_kill_background_job_reaps_process_tree(client, fake):
     client.start_background_job(sid, "lived", "sleep 30; echo never")
     import time as _time
 
-    _time.sleep(0.2)
+    # poll-until-deadline instead of fixed sleeps: under a loaded machine the
+    # job spawn / group kill can take well over the former 0.2 s (flaked in
+    # the round-4 full-suite run while passing in isolation)
+    deadline = _time.monotonic() + 10.0
+    while _time.monotonic() < deadline:
+        probe = client.execute_command(sid, "pgrep -f 'sleep [3]0' || echo absent")
+        if "absent" not in probe.stdout:
+            break  # the sleep is alive: the job tree has spawned
+        _time.sleep(0.05)
+    else:
+        pytest.fail("background job never spawned its process tree")
     client.kill_background_job(sid, "lived")
-    _time.sleep(0.2)
-    # the group kill must have reaped the sleep: pgrep finds nothing
+    # the group kill must reap the sleep: pgrep finds nothing
     # ([3]0 so the probe's own cmdline doesn't match itself)
-    result = client.execute_command(sid, "pgrep -f 'sleep [3]0' || echo gone")
-    assert "gone" in result.stdout
+    deadline = _time.monotonic() + 10.0
+    while _time.monotonic() < deadline:
+        result = client.execute_command(sid, "pgrep -f 'sleep [3]0' || echo gone")
+        if "gone" in result.stdout:
+            break
+        _time.sleep(0.05)
+    else:
+        pytest.fail("killed background job's process tree still alive after 10s")
 
 
 def test_get_unknown_background_job_raises(client, fake):
