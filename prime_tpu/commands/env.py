@@ -101,13 +101,73 @@ def build_cmd(render: Renderer, env_dir: str) -> None:
 @env_group.command("push")
 @click.option("--dir", "env_dir", default=".", type=click.Path(exists=True))
 @click.option("--visibility", type=click.Choice(["private", "public"]), default="private")
+@click.option(
+    "--auto-bump", is_flag=True,
+    help="Bump the patch version before pushing (1.2.3 -> 1.2.4).",
+)
+@click.option(
+    "--rc", is_flag=True,
+    help="Bump or create an .rc pre-release before pushing (rc0 -> rc1).",
+)
+@click.option(
+    "--post", is_flag=True,
+    help="Bump or create a .post release before pushing (post0 -> post1).",
+)
 @output_options
-def push_cmd(render: Renderer, env_dir: str, visibility: str) -> None:
-    """Archive, hash, and upload the environment to the hub."""
+def push_cmd(
+    render: Renderer, env_dir: str, visibility: str,
+    auto_bump: bool, rc: bool, post: bool,
+) -> None:
+    """Archive, hash, and upload the environment to the hub.
+
+    --auto-bump/--rc/--post rewrite the env.toml + pyproject versions in
+    place first (reference env.py:1073-1140); the checkout's upstream link
+    (.prime/env-metadata.json) is shown before and updated after the push.
+    """
+    from prime_tpu.envhub.provenance import (
+        bumped_version,
+        read_provenance,
+        upstream_display,
+        write_provenance,
+    )
+
+    from prime_tpu.core.exceptions import APIError
+
+    if sum((auto_bump, rc, post)) > 1:
+        raise click.UsageError("--auto-bump, --rc, and --post are mutually exclusive")
+    bump_mode = "patch" if auto_bump else "rc" if rc else "post" if post else None
+    upstream = upstream_display(read_provenance(env_dir))
+    if upstream:
+        render.message(f"Using upstream environment {upstream}", err=True)
+    # snapshot the version carriers so a failed push doesn't burn the bumped
+    # number (re-running would skip it, leaving local one ahead of the hub)
+    bump_snapshots: list[tuple[Path, str]] = []
+    if bump_mode:
+        for carrier in ("env.toml", "pyproject.toml"):
+            path = Path(env_dir) / carrier
+            if path.exists():
+                bump_snapshots.append((path, path.read_text()))
+        try:
+            old, new = bumped_version(env_dir, bump_mode)
+        except ValueError as e:
+            raise click.ClickException(str(e)) from None
+        render.message(f"Auto-bumping version: {old} -> {new}")
     try:
         result = build_hub_client().push(env_dir, visibility=visibility)
-    except (FileNotFoundError, ValueError) as e:
+    except (FileNotFoundError, ValueError, APIError) as e:
+        for path, content in bump_snapshots:
+            path.write_text(content)
+        if bump_snapshots:
+            render.message("Push failed — version bump rolled back.", err=True)
         raise click.ClickException(str(e)) from None
+    if not result.get("unchanged"):
+        write_provenance(
+            env_dir,
+            name=result.get("name"),
+            owner=result.get("owner"),
+            version=result.get("latestVersion"),
+            source="push",
+        )
     if render.is_json:
         render.json(result)
     elif result.get("unchanged"):
@@ -130,6 +190,16 @@ def pull_cmd(render: Renderer, name: str, version: str | None, target: str | Non
             f"{target_dir}/ exists and is not empty — refusing to overwrite local files"
         )
     extract_archive(archive, target_dir)
+    from prime_tpu.envhub.provenance import write_provenance
+
+    # link the checkout to its upstream so later pushes/evals name it
+    write_provenance(
+        target_dir,
+        name=name,
+        owner=info.get("owner"),
+        version=info.get("version"),
+        source="pull",
+    )
     render.message(f"Pulled {name}@{info['version']} -> {target_dir}/")
     if render.is_json:
         render.json({"name": name, "version": info["version"], "dir": str(target_dir)})
@@ -214,6 +284,13 @@ def inspect_cmd(render: Renderer, env_ref: str) -> None:
     if resolved.metadata:
         payload["tpu"] = resolved.metadata.get("tpu", {})
         payload["eval"] = resolved.metadata.get("eval", {})
+    from prime_tpu.envhub.provenance import read_provenance, upstream_display
+
+    provenance = read_provenance(resolved.env_dir)
+    if provenance:
+        payload["upstream"] = upstream_display(provenance)
+        payload["upstreamVersion"] = provenance.get("version")
+        payload["upstreamSource"] = provenance.get("source")
     try:
         loaded = load_environment(resolved)
         payload["examples"] = len(loaded.examples)

@@ -30,10 +30,27 @@ def fork(render: Renderer, source_env: str, new_name: str) -> None:
         render.message(f"Forked {source_env} -> {result.get('name', new_name)}")
 
 
-@click.command("gepa", context_settings={"ignore_unknown_options": True})
-@click.argument("args", nargs=-1, type=click.UNPROCESSED)
-def gepa(args: tuple[str, ...]) -> None:
-    """Run the GEPA prompt optimizer (requires the optional `gepa` package)."""
+class _DefaultRunGroup(click.Group):
+    """`prime gepa wordle --max-calls 100` == `prime gepa run wordle ...`
+    (reference commands/gepa.py DefaultCommandGroup)."""
+
+    def resolve_command(self, ctx, args):
+        if args and args[0] not in self.commands and args[0] not in ("--help", "-h"):
+            args = ["run", *args]
+        return super().resolve_command(ctx, args)
+
+    def format_usage(self, ctx, formatter):
+        formatter.write_usage(ctx.command_path, "run ENV_OR_CONFIG [ARGS]...")
+
+
+@click.group("gepa", cls=_DefaultRunGroup, invoke_without_command=False)
+def gepa() -> None:
+    """Run GEPA prompt optimization (endpoint + key injected from config)."""
+
+
+def _exec_gepa(run_target: str, args: list[str], env: dict[str, str]) -> None:
+    """Exec the optional optimizer package — the ONLY step that needs it
+    installed; everything before (injection, env resolution) runs without."""
     import importlib.util
     import subprocess
     import sys
@@ -42,4 +59,73 @@ def gepa(args: tuple[str, ...]) -> None:
         raise click.ClickException(
             "GEPA is not installed: pip install gepa (then re-run `prime gepa ...`)"
         )
-    raise SystemExit(subprocess.run([sys.executable, "-m", "gepa", *args]).returncode)
+    raise SystemExit(
+        subprocess.run(
+            [sys.executable, "-m", "gepa", run_target, *args], env=env
+        ).returncode
+    )
+
+
+@gepa.command(
+    "run",
+    context_settings={"ignore_unknown_options": True, "help_option_names": []},
+)
+@click.argument("environment_or_config", required=False)
+@click.argument("args", nargs=-1, type=click.UNPROCESSED)
+def gepa_run(environment_or_config: str | None, args: tuple[str, ...]) -> None:
+    """Run optimization with local-first environment resolution.
+
+    Injects the configured inference endpoint (-b) and API key
+    (-k PRIME_API_KEY) unless overridden; resolves ENV_OR_CONFIG the same
+    way `prime eval run` does (reference verifiers_bridge.py:1064).
+    """
+    from prime_tpu.evals.gepa_bridge import (
+        GepaBridgeError,
+        gepa_help_text,
+        is_help_request,
+        prepare_gepa_run,
+    )
+
+    passthrough = list(args)
+    if is_help_request(environment_or_config or "", passthrough):
+        click.echo(gepa_help_text())
+        return
+    if environment_or_config is None:
+        raise click.UsageError(
+            "Missing argument 'ENV_OR_CONFIG'. "
+            "Example: prime gepa run wordle --max-calls 100"
+        )
+    if environment_or_config.startswith("-"):
+        raise click.UsageError(
+            "Environment/config must be the first argument. "
+            "Example: prime gepa run wordle --max-calls 100"
+        )
+
+    from prime_tpu.commands._deps import build_config
+    from prime_tpu.envhub.execution import EnvResolutionError
+
+    try:
+        invocation = prepare_gepa_run(
+            environment_or_config, passthrough, build_config(),
+            hub_client=_hub_client_or_none(),
+        )
+    except (GepaBridgeError, EnvResolutionError) as e:
+        raise click.ClickException(str(e)) from None
+    if invocation.resolved_env_name:
+        click.echo(
+            f"Environment: {invocation.resolved_env_name} "
+            f"({invocation.resolved_source})",
+            err=True,
+        )
+    _exec_gepa(invocation.run_target, invocation.args, invocation.env)
+
+
+def _hub_client_or_none():
+    """A hub client for on-demand env installs; None when the control plane
+    is unreachable/unconfigured (local env dirs still resolve)."""
+    try:
+        from prime_tpu.commands.env import build_hub_client
+
+        return build_hub_client()
+    except Exception:  # noqa: BLE001 — resolution degrades to local-only
+        return None

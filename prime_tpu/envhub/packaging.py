@@ -18,6 +18,7 @@ import tomllib
 from pathlib import Path
 
 DEFAULT_EXCLUDES = [
+    ".prime",  # local hub-link state (provenance.py) — never ships or hashes
     ".git",
     "__pycache__",
     "*.pyc",

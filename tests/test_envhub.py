@@ -105,6 +105,118 @@ def test_push_pull_install_cli_roundtrip(runner, fake, env_dir, tmp_path, monkey
     assert "my-env" not in result.output
 
 
+def test_version_bumpers():
+    """Pure bump semantics (reference env.py:2010-2076)."""
+    from prime_tpu.envhub.provenance import bump_patch, bump_post, bump_rc
+
+    assert bump_patch("1.2.3") == "1.2.4"
+    assert bump_patch("1.2.3rc1") == "1.2.4"  # pre-release suffix dropped
+    assert bump_patch("1.2") == "1.2.1"
+    assert bump_patch("7") == "7.0.1"
+    assert bump_rc("1.2.3") == "1.2.3.rc0"
+    assert bump_rc("1.2.3.rc0") == "1.2.3.rc1"
+    assert bump_rc("1.2.3rc2") == "1.2.3.rc3"
+    assert bump_post("1.2.3") == "1.2.3.post0"
+    assert bump_post("1.2.3.post0") == "1.2.3.post1"
+    assert bump_post("1.2.3+local") == "1.2.3.post0"
+
+
+def test_push_auto_bump_roundtrips_versions(runner, fake, env_dir):
+    """--auto-bump rewrites env.toml AND pyproject in place, and the hub
+    records the bumped version; --rc/--post stack on top."""
+    from prime_tpu.envhub.provenance import (
+        read_env_toml_version,
+        read_pyproject_version,
+    )
+
+    result = runner.invoke(cli, ["env", "push", "--dir", str(env_dir), "--auto-bump"])
+    assert result.exit_code == 0, result.output
+    assert "Auto-bumping version: 0.1.0 -> 0.1.1" in result.output
+    assert "Pushed my-env@0.1.1" in result.output
+    assert read_env_toml_version(env_dir) == "0.1.1"
+    assert read_pyproject_version(env_dir) == "0.1.1"
+
+    result = runner.invoke(cli, ["env", "push", "--dir", str(env_dir), "--rc"])
+    assert result.exit_code == 0, result.output
+    assert read_env_toml_version(env_dir) == "0.1.1.rc0"
+    result = runner.invoke(cli, ["env", "versions", "my-env", "--output", "json"])
+    versions = [v["version"] for v in json.loads(result.output)]
+    assert "0.1.1" in versions and "0.1.1.rc0" in versions
+
+    # mutually exclusive
+    result = runner.invoke(
+        cli, ["env", "push", "--dir", str(env_dir), "--auto-bump", "--post"]
+    )
+    assert result.exit_code == 2
+    assert "mutually exclusive" in result.output
+
+
+def test_bump_rewrites_only_the_right_table(env_dir):
+    """A version key in an earlier unrelated table must never be touched."""
+    from prime_tpu.envhub.provenance import bumped_version
+
+    pyproject = env_dir / "pyproject.toml"
+    pyproject.write_text(
+        '[tool.something]\nversion = "9.9.9"\n\n' + pyproject.read_text()
+    )
+    old, new = bumped_version(env_dir, "patch")
+    assert (old, new) == ("0.1.0", "0.1.1")
+    content = pyproject.read_text()
+    assert 'version = "9.9.9"' in content  # [tool.*] untouched
+    assert content.count('version = "0.1.1"') == 1  # [project] bumped
+
+
+def test_push_failure_rolls_back_bump(runner, fake, env_dir, monkeypatch):
+    """A failed upload must not burn the bumped version number."""
+    from prime_tpu.core.exceptions import APIError
+    from prime_tpu.envhub.provenance import read_env_toml_version
+
+    class FailingHub:
+        def push(self, *a, **k):
+            raise APIError("hub unreachable")
+
+    monkeypatch.setattr(
+        "prime_tpu.commands.env.build_hub_client", lambda: FailingHub()
+    )
+    result = runner.invoke(cli, ["env", "push", "--dir", str(env_dir), "--auto-bump"])
+    assert result.exit_code != 0
+    assert "rolled back" in result.output
+    assert read_env_toml_version(env_dir) == "0.1.0"
+
+
+def test_provenance_roundtrip_and_hash_exclusion(runner, fake, env_dir, tmp_path):
+    """pull links the checkout to its upstream; push displays the link and
+    refreshes it; inspect surfaces it in both output modes; the .prime/
+    record never enters the content hash (else every pull would 'drift')."""
+    from prime_tpu.envhub.provenance import read_provenance
+
+    runner.invoke(cli, ["env", "push", "--dir", str(env_dir)])
+    pull_dir = tmp_path / "checkout"
+    result = runner.invoke(cli, ["env", "pull", "my-env", "--dir", str(pull_dir)])
+    assert result.exit_code == 0, result.output
+
+    record = read_provenance(pull_dir)
+    assert record["name"] == "my-env" and record["source"] == "pull"
+    # provenance is local state: hash matches the hub despite the new file
+    assert content_hash(pull_dir) == content_hash(env_dir)
+
+    # push from the linked checkout announces its upstream (bumped: the hub
+    # rightly refuses same-version pushes with different content)
+    (pull_dir / "data" / "eval.jsonl").write_text('{"question": "2+2?", "answer": "#### 4"}\n')
+    result = runner.invoke(cli, ["env", "push", "--dir", str(pull_dir), "--auto-bump"])
+    assert result.exit_code == 0, result.output
+    assert "Using upstream environment my-env" in result.output
+    assert read_provenance(pull_dir)["source"] == "push"
+
+    # inspect renders the link in both modes
+    result = runner.invoke(cli, ["env", "inspect", str(pull_dir), "--output", "json"])
+    data = json.loads(result.output)
+    assert data["upstream"].endswith("my-env")  # owner/name once the hub names an owner
+    assert data["upstreamSource"] == "push"
+    result = runner.invoke(cli, ["env", "inspect", str(pull_dir), "--plain"])
+    assert "my-env" in result.output and "upstream" in result.output.lower()
+
+
 def test_env_secrets_and_versions_cli(runner, fake, env_dir):
     runner.invoke(cli, ["env", "push", "--dir", str(env_dir)])
     assert runner.invoke(cli, ["env", "secrets", "set", "my-env", "HF_TOKEN", "tok"]).exit_code == 0
