@@ -103,14 +103,19 @@ def gepa_run(environment_or_config: str | None, args: tuple[str, ...]) -> None:
 
     from prime_tpu.commands._deps import build_config
     from prime_tpu.envhub.execution import EnvResolutionError
+    from prime_tpu.evals.endpoints import EvalPreflightError
 
     try:
         invocation = prepare_gepa_run(
             environment_or_config, passthrough, build_config(),
             hub_client=_hub_client_or_none(),
         )
-    except (GepaBridgeError, EnvResolutionError) as e:
+    except (GepaBridgeError, EnvResolutionError, EvalPreflightError, ValueError) as e:
+        # ValueError: a local env dir with a malformed env.toml
+        # (envhub.packaging.read_env_metadata) must fail as a CLI error too
         raise click.ClickException(str(e)) from None
+    for warning in invocation.warnings:
+        click.echo(f"Warning: {warning}", err=True)
     if invocation.resolved_env_name:
         click.echo(
             f"Environment: {invocation.resolved_env_name} "

@@ -58,6 +58,7 @@ class GepaInvocation:
     base_url: str | None = None
     resolved_env_name: str | None = None
     resolved_source: str | None = None
+    warnings: tuple[str, ...] = ()
 
 
 def parse_value_option(args: list[str], long_flag: str, short_flag: str | None) -> str | None:
@@ -183,11 +184,22 @@ def prepare_gepa_run(
 
     run_target = environment_or_config
     resolved_name = resolved_source = None
+    warnings: list[str] = []
     if is_config_target(environment_or_config):
-        config_env = _collect_config_env(Path(environment_or_config), env_dir_path)
+        config_path = Path(environment_or_config)
+        if not config_path.is_file():
+            raise GepaBridgeError(f"GEPA config {config_path} does not exist")
+        config_env = _collect_config_env(config_path, env_dir_path)
         if config_env is not None:
             resolved = _resolve_env(config_env[0], config_env[1], hub_client)
             resolved_name, resolved_source = resolved.name, resolved.source
+        else:
+            # reference behavior: warn and skip the pre-install, never
+            # silently — the optimizer still gets the config verbatim
+            warnings.append(
+                f"could not read [env] env_id from {config_path}; "
+                "skipping environment pre-install"
+            )
     else:
         resolved = _resolve_env(environment_or_config, env_dir_path, hub_client)
         run_target = resolved.name
@@ -201,6 +213,7 @@ def prepare_gepa_run(
         base_url=base_url,
         resolved_env_name=resolved_name,
         resolved_source=resolved_source,
+        warnings=tuple(warnings),
     )
 
 

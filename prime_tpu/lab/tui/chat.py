@@ -92,7 +92,7 @@ class AgentChatScreen(DetailScreen):
                         "args": event.widget.get("args", {}),
                     }
                     self.transcript.append(entry)
-                    if entry["name"] in ("choose", "launch_run"):
+                    if entry["name"] in ("choose", "launch_run", "configure_run"):
                         self.pending = entry
                         self.choice_cursor = 0
             if len(self.transcript) > self._limit:
@@ -154,6 +154,8 @@ class AgentChatScreen(DetailScreen):
             # position so the agent always receives a reply
             self.send(selected if selected.strip() else f"option {index + 1}")
             return f"selected: {selected or f'option {index + 1}'}"
+        if pending["name"] == "configure_run":
+            return self._act_on_form(pending)
         # launch_run: hand the proposal to the launch section's arm/confirm
         # flow as a card on disk — chat never submits to the platform itself.
         # The typed widget model repairs/rejects the payload (numerics become
@@ -175,11 +177,18 @@ class AgentChatScreen(DetailScreen):
             # never substitute template defaults for a config the agent did
             # not propose — an armed card must contain only proposed values
             return f"unusable proposal: {e}"
+        return self._write_launch_card(pending, kind, payload, "proposal")
+
+    def _write_launch_card(
+        self, pending: dict[str, Any], kind: str, payload: dict[str, Any], suffix: str
+    ) -> str:
+        """Shared card-write tail for launch_run proposals and configure_run
+        forms: write the card, stamp the widget, clear the pending state."""
         try:
             from prime_tpu.lab.tui.editor import new_card
             from prime_tpu.lab.tui.launch import save_card
 
-            card = new_card(self.workspace, kind=kind, name=f"{self.name}-proposal")
+            card = new_card(self.workspace, kind=kind, name=f"{self.name}-{suffix}")
             card.payload = payload
             save_card(card)
         except Exception as e:  # noqa: BLE001 - a bad proposal must not kill chat
@@ -187,6 +196,68 @@ class AgentChatScreen(DetailScreen):
         pending["args"]["saved_card"] = card.path.name
         self.pending = None
         return f"launch card written: {card.path.name} (arm it in the launch section)"
+
+    def _form_edit(self, text: str) -> str | None:
+        """``name=value`` against a pending configure_run edits that field in
+        place (stamped into args['values'] so the transcript re-render shows
+        the edit); returns a status line, or None when the text is not a form
+        edit and should go to the agent as a normal message."""
+        pending = self.pending
+        if pending is None or pending["name"] != "configure_run" or "=" not in text:
+            return None
+        from prime_tpu.lab.widget_model import (
+            WidgetValidationError,
+            build_form_model,
+            normalize_widget_call,
+        )
+
+        name, _, value = text.partition("=")
+        name, value = name.strip(), value.strip()
+        try:
+            normalized = normalize_widget_call("configure_run", pending.get("args", {}))
+            form = build_form_model(normalized, self.workspace)
+        except WidgetValidationError:
+            return None
+        field_names = {spec.name for spec in form.fields if not spec.disabled}
+        if name not in field_names:
+            return None  # not a field: treat as a chat message
+        values = pending["args"].setdefault("form_values", {})
+        values[name] = value
+        pending["args"].pop("form_errors", None)  # edits invalidate stale errors
+        return f"{name} = {value or '(cleared)'}"
+
+    def _act_on_form(self, pending: dict[str, Any]) -> str | None:
+        """Enter on a pending form: typed parse -> launch card (eval/train)
+        or CLI command (gepa); parse failures stay on the form as errors."""
+        from prime_tpu.lab.widget_model import (
+            WidgetValidationError,
+            build_form_model,
+            form_command_text,
+            form_launch_payload,
+            normalize_widget_call,
+        )
+
+        args = pending.get("args", {})
+        try:
+            normalized = normalize_widget_call("configure_run", args)
+            form = build_form_model(normalized, self.workspace)
+        except WidgetValidationError as e:
+            self.pending = None
+            return f"unusable form: {e}"
+        if form.kind == "gepa":
+            command = form_command_text(form)
+            pending["args"]["saved_card"] = command
+            self.pending = None
+            self.send(f"run it with: {command}")
+            return command
+        try:
+            kind, payload = form_launch_payload(form)
+        except WidgetValidationError as e:
+            args["form_errors"] = [part.strip() for part in str(e).split(";")]
+            return f"fix the form: {e}"
+        if self.workspace is None:
+            return "no workspace for launch cards"
+        return self._write_launch_card(pending, kind, payload, "form")
 
     # -- keys ------------------------------------------------------------------
 
@@ -206,7 +277,15 @@ class AgentChatScreen(DetailScreen):
                 # is worse than waiting
                 return "turn still running — message kept in the input"
             text, self.input_buffer = self.input_buffer, ""
-            if text.strip():
+            stripped = text.strip()
+            if stripped and self.pending is not None and self.pending["name"] == "configure_run":
+                if stripped == "stop":  # the form's discard action
+                    self.pending = None
+                    return "form dismissed"
+                edited = self._form_edit(stripped)
+                if edited is not None:
+                    return edited
+            if stripped:
                 self.pending = None  # a real free-text reply answers the widget
             self.send(text)
             return None
@@ -243,7 +322,10 @@ class AgentChatScreen(DetailScreen):
             if role == "widget":
                 cursor = self.choice_cursor if entry is self.pending else None
                 parts.append(
-                    render_widget(str(entry.get("name", "")), entry.get("args", {}), cursor=cursor)
+                    render_widget(
+                        str(entry.get("name", "")), entry.get("args", {}),
+                        cursor=cursor, workspace=self.workspace,
+                    )
                 )
                 continue
             style = {"user": "bold", "assistant": "", "system": "red"}.get(role or "", "dim")

@@ -34,10 +34,35 @@ def _wrap(text: str, width: int = 76) -> list[str]:
     return lines
 
 
+def _media_placeholder(kind: str, part: dict[str, Any]) -> str | None:
+    """Non-text content parts render as explicit placeholders instead of
+    vanishing (reference eval_render.py media handling): a multimodal turn
+    must say what it carried even though a terminal can't show it."""
+    if kind in ("image_url", "input_image", "image"):
+        url = part.get("image_url")
+        if isinstance(url, dict):
+            url = url.get("url", "")
+        url = str(url or part.get("url") or "")
+        if url.startswith("data:"):
+            return f"[image: inline data, {len(url)} bytes]"
+        return f"[image: {url[:60]}]" if url else "[image]"
+    if kind in ("input_audio", "audio"):
+        audio = part.get("input_audio")
+        fmt = audio.get("format", "") if isinstance(audio, dict) else ""
+        return f"[audio: {fmt}]" if fmt else "[audio]"
+    if kind in ("file", "input_file", "attachment"):
+        name = str(
+            part.get("filename") or part.get("file_name") or part.get("name") or ""
+        )
+        return f"[file: {name[:60]}]" if name else "[file]"
+    return None
+
+
 def _content_text(content: Any) -> str:
     """Chat-message content → text. Handles the OpenAI part-list shape
-    ([{"type": "text", "text": ...}, ...]) alongside plain strings, and
-    surfaces reasoning-part content (thinking models) inline."""
+    ([{"type": "text", "text": ...}, ...]) alongside plain strings, surfaces
+    reasoning-part content (thinking models) inline, and renders image/
+    audio/file parts as placeholders rather than dropping them."""
     if isinstance(content, list):
         parts = []
         for part in content:
@@ -48,6 +73,11 @@ def _content_text(content: Any) -> str:
                     text = str(part.get(kind, ""))
                 if text and kind in ("reasoning", "thinking"):
                     text = f"[reasoning] {text}"
+                if not text:
+                    placeholder = _media_placeholder(kind, part)
+                    if placeholder is None and kind:
+                        placeholder = f"[{kind}]"  # unknown parts never vanish
+                    text = placeholder or ""
                 parts.append(text)
             else:
                 parts.append(str(part))
@@ -92,6 +122,17 @@ def sample_sections(sample: dict[str, Any]) -> list[tuple[str, str]]:
     sections: list[tuple[str, str]] = []
     messages = sample.get("messages")
     if isinstance(messages, list) and messages:
+        # call-id -> tool name across ALL turns, so a tool reply three turns
+        # after its call still names the tool it answers (multi-turn chains)
+        call_names: dict[str, str] = {}
+        for message in messages:
+            if isinstance(message, dict) and isinstance(message.get("tool_calls"), list):
+                for call in message["tool_calls"]:
+                    if isinstance(call, dict):
+                        fn = call.get("function") if isinstance(call.get("function"), dict) else call
+                        call_id = str(call.get("id") or call.get("tool_call_id") or "")
+                        if call_id:
+                            call_names[call_id] = str(fn.get("name", "?"))
         for message in messages:
             if isinstance(message, dict):
                 role = str(message.get("role", "?")).upper()
@@ -100,15 +141,26 @@ def sample_sections(sample: dict[str, Any]) -> list[tuple[str, str]]:
                 if reasoning:
                     prefix = f"[reasoning] {reasoning}"
                     body = f"{prefix}\n{body}" if body else prefix
+                refusal = message.get("refusal")
+                if refusal:
+                    line = f"[refusal] {refusal}"
+                    body = f"{line}\n{body}" if body else line
                 # assistant tool calls render as call lines; tool replies
-                # label with the tool's id so the pairing reads top-down
+                # label with the calling tool's NAME (id as fallback) so a
+                # multi-turn chain reads call -> result top-down
                 calls = _tool_call_lines(message.get("tool_calls"))
                 if calls:
                     body = "\n".join(
                         ([body] if body else []) + [f"⚒ {line}" for line in calls]
                     )
                 if role == "TOOL" and message.get("tool_call_id"):
-                    role = f"TOOL {message['tool_call_id']}"
+                    call_id = str(message["tool_call_id"])
+                    name = call_names.get(call_id)
+                    role = f"TOOL {name} ({call_id})" if name else f"TOOL {call_id} (unmatched)"
+                if message.get("error"):
+                    line = f"[error] {message['error']}"
+                    body = f"{body}\n{line}" if body else line
+                    role = f"{role} ⚠"
                 sections.append((role, body))
             else:
                 sections.append(("?", str(message)))
@@ -123,6 +175,11 @@ def sample_sections(sample: dict[str, Any]) -> list[tuple[str, str]]:
             ("PROMPT", "prompt"), ("COMPLETION", "completion"), ("ANSWER", "answer")
         ):
             sections.append((label, str(sample.get(key, ""))))
+    # a failed rollout's record carries the harness error — render it as its
+    # own red section, never buried in state
+    error = sample.get("error") or sample.get("exception")
+    if error:
+        sections.append(("ERROR", str(error)))
     usage = sample.get("usage")
     if isinstance(usage, dict) and usage:
         sections.append(
@@ -302,7 +359,10 @@ class EvalSampleBrowser(DetailScreen):
 
         body_lines: list[tuple[str, str]] = []  # (style, line)
         for label, content in sample_sections(sample):
-            body_lines.append(("bold cyan", f"── {label} " + "─" * 40))
+            header_style = (
+                "bold red" if label.startswith("ERROR") or label.endswith("⚠") else "bold cyan"
+            )
+            body_lines.append((header_style, f"── {label} " + "─" * 40))
             if self.rendered:
                 from prime_tpu.lab.tui.markdown import markdown_lines
 

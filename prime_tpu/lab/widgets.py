@@ -72,6 +72,21 @@ WIDGET_TOOLS: tuple[WidgetToolSpec, ...] = (
         },
         required=("patch",),
     ),
+    WidgetToolSpec(
+        name="configure_run",
+        description=(
+            "Open an editable run-configuration form (eval, rl, or gepa) "
+            "seeded with your proposed values; the user edits fields with "
+            "name=value and launches or stops it."
+        ),
+        properties={
+            "title": {"type": "string"},
+            "kind": {"type": "string", "enum": ["eval", "rl", "gepa"]},
+            "env": {"type": "string"},
+            "config": {"type": "object"},
+        },
+        required=("kind",),
+    ),
 )
 
 _BY_NAME = {tool.name: tool for tool in WIDGET_TOOLS}
@@ -128,7 +143,9 @@ def validate_widget_call(name: str, args: dict[str, Any]) -> str | None:
     return None
 
 
-def render_widget(name: str, args: dict[str, Any], cursor: int | None = None):
+def render_widget(
+    name: str, args: dict[str, Any], cursor: int | None = None, workspace: Any = None
+):
     """One rich renderable per widget call (pure; no app state beyond the
     optional ``cursor`` for a pending choice and the ``selected`` /
     ``saved_card`` stamps the chat screen writes back into ``args``).
@@ -213,6 +230,33 @@ def render_widget(name: str, args: dict[str, Any], cursor: int | None = None):
             body,
             title="launch proposal"
             + (" (card written)" if saved else " (confirm in the launch section)"),
+            border_style="dim" if saved else "yellow",
+        )
+    if name == "configure_run":
+        from prime_tpu.lab.widget_model import build_form_model
+
+        form = build_form_model(normalized, workspace)
+        body = Table.grid(padding=(0, 1))
+        for spec in form.fields:
+            marker = "▾" if spec.widget == "select" else " "
+            style = "dim" if spec.disabled else None
+            body.add_row(
+                Text(spec.label, style="dim"),
+                Text(f"{spec.value or '—'} {marker}".rstrip(), style=style),
+            )
+        for error in args.get("form_errors") or ():
+            body.add_row(Text("!", style="red"), Text(str(error), style="red"))
+        saved = args.get("saved_card")
+        if saved:
+            body.add_row(Text("card", style="green"), Text(str(saved), style="green"))
+        hint = (
+            "card written"
+            if saved
+            else "edit: name=value · enter: launch · stop: discard"
+        )
+        return panel(
+            body,
+            title=f"{form.title} ({hint})",
             border_style="dim" if saved else "yellow",
         )
     # show_patch

@@ -655,3 +655,84 @@ def test_mcp_eval_samples_tool(tmp_path):
     )
     samples = json.loads(response["result"]["content"][0]["text"])
     assert samples[0]["prompt"] == "p"
+
+
+def test_chat_form_edit_launch_roundtrip(tmp_path):
+    """configure_run form: field edits stamp form_values, typed errors stay
+    on the form, a valid enter writes the launch card (VERDICT r4 #3)."""
+    import tomllib
+
+    from prime_tpu.lab.tui.chat import AgentChatScreen
+
+    screen = AgentChatScreen("tester", lambda: None, workspace=str(tmp_path))
+    screen.transcript.append(
+        {"role": "widget", "name": "configure_run",
+         "args": {"kind": "eval", "env": "gsm8k", "config": {"model": "tiny-test"}}}
+    )
+    screen.pending = screen.transcript[-1]
+
+    # a field edit is intercepted (not sent to the agent) and stamped
+    for ch in "limit=abc":
+        screen.on_key(ch)
+    status = screen.on_key("enter")
+    assert status == "limit = abc"
+    assert screen.pending["args"]["form_values"] == {"limit": "abc"}
+    assert not any(e.get("role") == "user" for e in screen.transcript)
+
+    # enter with a bad integer keeps the form pending, errors stamped
+    status = screen.on_key("enter")
+    assert "fix the form" in status
+    assert screen.pending is not None
+    assert screen.pending["args"]["form_errors"]
+
+    # repair the field, launch: card written with typed values
+    for ch in "limit=20":
+        screen.on_key(ch)
+    screen.on_key("enter")
+    assert screen.pending["args"].get("form_errors") is None
+    status = screen.on_key("enter")
+    assert "launch card written" in status, status
+    assert screen.pending is None
+    card = tmp_path / ".prime-lab" / "launch" / "tester-form.toml"
+    data = tomllib.loads(card.read_text())
+    assert data["launch"]["kind"] == "eval"
+    assert data["eval"]["limit"] == 20 and isinstance(data["eval"]["limit"], int)
+    assert data["eval"]["env"] == "gsm8k"
+    widget = next(e for e in screen.transcript if e["role"] == "widget")
+    assert widget["args"]["saved_card"] == "tester-form.toml"
+
+
+def test_chat_form_stop_dismisses(tmp_path):
+    from prime_tpu.lab.tui.chat import AgentChatScreen
+    from prime_tpu.lab.tui.launch import scan_cards
+
+    screen = AgentChatScreen("tester", lambda: None, workspace=str(tmp_path))
+    screen.transcript.append(
+        {"role": "widget", "name": "configure_run", "args": {"kind": "rl"}}
+    )
+    screen.pending = screen.transcript[-1]
+    for ch in "stop":
+        screen.on_key(ch)
+    assert screen.on_key("enter") == "form dismissed"
+    assert screen.pending is None and scan_cards(tmp_path) == []
+
+
+def test_chat_form_renders_with_workspace_options(tmp_path):
+    import io
+
+    from rich.console import Console
+
+    from prime_tpu.envhub.packaging import write_env_template
+    from prime_tpu.lab.tui.chat import AgentChatScreen
+
+    write_env_template(tmp_path / "environments" / "wordle", "wordle")
+    screen = AgentChatScreen("tester", lambda: None, workspace=str(tmp_path))
+    screen.transcript.append(
+        {"role": "widget", "name": "configure_run", "args": {"kind": "eval"}}
+    )
+    screen.pending = screen.transcript[-1]
+    console = Console(width=100, file=io.StringIO(), force_terminal=False)
+    console.print(screen.render())
+    out = console.file.getvalue()
+    assert "Evaluate wordle" in out       # env select seeded from the workspace
+    assert "name=value" in out            # edit hint

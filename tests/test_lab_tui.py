@@ -1084,7 +1084,72 @@ def test_sample_browser_tool_calls_reasoning_usage_state(app, tmp_path):
     app.on_key("enter")
     text = render_text(app)
     assert 'get_weather({"city": "SF"}) -> call_1' in text
-    assert "TOOL call_1" in text and "64F sunny" in text
+    assert "TOOL get_weather (call_1)" in text and "64F sunny" in text
     assert "[reasoning] user wants current weather" in text
     assert "USAGE" in text and "completion_tokens=9" in text
     assert "STATE" in text and '"turns": 2' in text
+
+
+def test_sample_browser_tool_chains_media_and_error_turns(app, tmp_path):
+    """Round-5 render breadth (VERDICT r4 #3): multi-turn tool chains pair
+    each reply with its calling tool by name across turns, image/file parts
+    render as placeholders instead of vanishing, refusal/error turns and the
+    sample-level harness error render explicitly."""
+    run_dir = _local_run(tmp_path)
+    with open(run_dir / "results.jsonl", "w") as f:
+        f.write(
+            json.dumps(
+                {
+                    "messages": [
+                        {
+                            "role": "user",
+                            "content": [
+                                {"type": "text", "text": "what is in this picture?"},
+                                {"type": "image_url", "image_url": {"url": "https://x/cat.png"}},
+                                {"type": "input_file", "filename": "notes.pdf"},
+                            ],
+                        },
+                        {
+                            "role": "assistant",
+                            "content": "",
+                            "tool_calls": [
+                                {"id": "c1", "function": {"name": "look", "arguments": {}}},
+                                {"id": "c2", "function": {"name": "fetch", "arguments": {}}},
+                            ],
+                        },
+                        {"role": "tool", "tool_call_id": "c2", "content": "fetched"},
+                        {"role": "tool", "tool_call_id": "c1", "content": "a cat"},
+                        {"role": "tool", "tool_call_id": "c9", "content": "orphan reply"},
+                        {
+                            "role": "assistant",
+                            "content": "",
+                            "refusal": "I can't help with that.",
+                            "error": "rate limited",
+                        },
+                    ],
+                    "error": "rollout aborted after turn 6",
+                    "reward": 0.0,
+                    "correct": False,
+                }
+            )
+            + "\n"
+        )
+    app.tick()
+    app.on_key("1")
+    app.on_key("enter")
+    app.on_key("enter")
+    text = render_text(app)
+    # out-of-order replies still name their tools; orphans say so
+    assert "TOOL fetch (c2)" in text and "TOOL look (c1)" in text
+    assert "TOOL c9 (unmatched)" in text
+    # media placeholders
+    assert "[image: https://x/cat.png]" in text
+    assert "[file: notes.pdf]" in text
+    # refusal + per-turn error + sample-level error (scroll to the tail —
+    # the ERROR/USAGE sections sit below the first page)
+    assert "[refusal] I can't help with that." in text
+    assert "[error] rate limited" in text
+    for _ in range(30):
+        app.on_key("j")
+    tail = render_text(app)
+    assert "ERROR" in tail and "rollout aborted after turn 6" in tail

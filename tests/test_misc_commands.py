@@ -729,3 +729,26 @@ def test_eval_compare(runner, fake, tmp_path):
 
     bad = runner.invoke(cli, ["eval", "compare", str(tmp_path / "nope"), str(b)])
     assert bad.exit_code != 0
+
+
+def test_gepa_config_target_errors_and_warnings(runner, fake, tmp_path, monkeypatch, gepa_exec):
+    monkeypatch.chdir(tmp_path)
+    # missing config file is a hard CLI error, not a silent passthrough
+    result = runner.invoke(cli, ["gepa", "run", "nope.toml"])
+    assert result.exit_code != 0
+    assert "does not exist" in result.output
+    # unparseable [env] warns and skips the pre-install, still execs
+    config = tmp_path / "broken.toml"
+    config.write_text("not [ valid toml")
+    result = runner.invoke(cli, ["gepa", "run", str(config)])
+    assert result.exit_code == 0, result.output
+    assert "skipping environment pre-install" in result.output
+    assert gepa_exec[-1][0] == str(config)
+    # malformed workspace endpoints.toml fails as a CLI error, not a traceback
+    (tmp_path / "configs").mkdir()
+    (tmp_path / "configs" / "endpoints.toml").write_text("also not [ toml")
+    _local_env(tmp_path)
+    result = runner.invoke(cli, ["gepa", "run", "wordle"])
+    assert result.exit_code != 0
+    assert "Malformed endpoints file" in result.output
+    assert not isinstance(result.exception, Exception) or result.exception.__class__ is SystemExit

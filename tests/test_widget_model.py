@@ -251,3 +251,169 @@ def test_chat_unusable_proposal_writes_nothing(tmp_path):
     message = screen._act_on_pending()
     assert "unusable proposal" in message
     assert scan_cards(tmp_path) == []
+
+
+# -- configure_run form model (VERDICT r4 #3: reference field-spec layer) -----
+
+
+def _form(args, workspace=None):
+    from prime_tpu.lab.widget_model import build_form_model, normalize_widget_call
+
+    return build_form_model(normalize_widget_call("configure_run", args), workspace)
+
+
+def test_form_normalization_maps_kind_and_coerces_config():
+    from prime_tpu.lab.widget_model import normalize_widget_call
+
+    normalized = normalize_widget_call(
+        "configure_run",
+        {"kind": "training", "env": 7, "config": {"limit": "20", "junk": None}},
+    )
+    assert normalized.args["kind"] == "rl"
+    assert normalized.args["env"] == "7"
+    assert normalized.args["config"]["limit"] == 20
+    assert "junk" not in normalized.args["config"]
+    assert any("mapped" in r for r in normalized.repairs)
+    with pytest.raises(WidgetValidationError, match="kind"):
+        normalize_widget_call("configure_run", {"kind": "pods"})
+
+
+def test_form_defaults_and_layering():
+    form = _form({"kind": "eval", "env": "gsm8k"})
+    by_name = {f.name: f for f in form.fields}
+    assert by_name["limit"].value == "50"            # seeded default
+    assert by_name["rollouts_per_example"].value == "3"
+    assert by_name["max_concurrent"].value == "auto"
+    assert by_name["env"].value == "gsm8k"
+    assert form.title == "Evaluate gsm8k"
+    assert [a.name for a in form.actions] == ["launch", "stop"]
+
+    # agent config beats defaults; user edits beat agent config
+    form = _form(
+        {"kind": "eval", "config": {"limit": 10}, "form_values": {"limit": "99"}}
+    )
+    assert {f.name: f for f in form.fields}["limit"].value == "99"
+
+
+def test_form_rl_schedule_and_disabled_field():
+    form = _form({"kind": "rl", "env": "arith-rl"})
+    names = [f.name for f in form.fields]
+    assert "max_steps" in names and "batch_size" in names
+    assert "seq_len" not in names  # disabled + no value -> omitted
+    form = _form({"kind": "rl", "config": {"seq_len": 2048}})
+    seq = {f.name: f for f in form.fields}["seq_len"]
+    assert seq.disabled and seq.value == "2048"
+    assert form.title.startswith("Train")
+
+
+def test_form_model_select_options(tmp_path):
+    (tmp_path / "configs").mkdir()
+    (tmp_path / "configs" / "endpoints.toml").write_text(
+        '[fast]\nmodel = "llama3.2-1b"\nbase_url = "https://x/v1"\n'
+    )
+    form = _form({"kind": "eval"}, workspace=tmp_path)
+    model = {f.name: f for f in form.fields}["model"]
+    assert model.widget == "select"
+    values = [v for _, v in model.options]
+    assert "llama3.2-1b" in values          # preset registry
+    assert "fast" in values                 # endpoint alias
+    assert model.value == values[0]         # seeded with the first option
+
+    # rl forms restrict to trainable presets (no serving aliases)
+    rl_model = {f.name: f for f in _form({"kind": "rl"}, workspace=tmp_path).fields}["model"]
+    assert "fast" not in [v for _, v in rl_model.options]
+
+    # an unknown agent-proposed model is kept, prepended to the options
+    form = _form({"kind": "eval", "config": {"model": "my-finetune"}}, workspace=tmp_path)
+    model = {f.name: f for f in form.fields}["model"]
+    assert model.value == "my-finetune" and model.options[0] == ("my-finetune", "my-finetune")
+
+
+def test_form_environment_select_from_workspace(tmp_path):
+    from prime_tpu.envhub.packaging import write_env_template
+
+    write_env_template(tmp_path / "environments" / "wordle", "wordle")
+    write_env_template(tmp_path / "environments" / "maze", "maze")
+    form = _form({"kind": "eval"}, workspace=tmp_path)
+    env = {f.name: f for f in form.fields}["env"]
+    assert env.widget == "select"
+    assert [v for _, v in env.options] == ["maze", "wordle"]
+    assert env.value == "maze"
+
+
+def test_form_parse_and_launch_payload():
+    from prime_tpu.lab.widget_model import form_launch_payload, parse_form_values
+
+    form = _form({"kind": "eval", "env": "gsm8k", "form_values": {"limit": "abc"}})
+    _config, errors = parse_form_values(form)
+    assert errors and "Examples" in errors[0]
+    with pytest.raises(WidgetValidationError, match="Examples"):
+        form_launch_payload(form)
+
+    form = _form({"kind": "rl", "env": "arith-rl", "config": {"model": "tiny-test"}})
+    kind, payload = form_launch_payload(form)
+    assert kind == "train"                       # rl maps onto the card taxonomy
+    assert payload["max_steps"] == 100 and isinstance(payload["max_steps"], int)
+    assert payload["env"] == "arith-rl"
+
+    with pytest.raises(WidgetValidationError, match="Environment"):
+        form_launch_payload(_form({"kind": "eval"}))
+    with pytest.raises(WidgetValidationError, match="command line"):
+        form_launch_payload(_form({"kind": "gepa", "env": "wordle"}))
+
+
+def test_form_command_text():
+    from prime_tpu.lab.widget_model import form_command_text
+
+    assert (
+        form_command_text(_form({"kind": "eval", "env": "gsm8k", "config": {"model": "m1"}}))
+        == "prime eval run gsm8k -m m1 -n 50 --max-new-tokens 1024"
+    )
+    assert form_command_text(
+        _form({"kind": "gepa", "env": "wordle", "config": {"model": "m1"}})
+    ) == "prime gepa run wordle -m m1"
+    assert "train request" in form_command_text(_form({"kind": "rl", "env": "e"}))
+
+
+def test_form_state_round_trips():
+    from prime_tpu.lab.widget_model import normalize_widget_call
+
+    args = {
+        "kind": "eval",
+        "env": "gsm8k",
+        "form_values": {"limit": "7"},
+        "form_errors": ["Examples: 'x' is not an integer"],
+        "saved_card": "chat-form.toml",
+    }
+    normalized = normalize_widget_call("configure_run", args)
+    assert normalized.args["form_values"] == {"limit": "7"}
+    assert normalized.args["form_errors"] == ["Examples: 'x' is not an integer"]
+    assert normalized.args["saved_card"] == "chat-form.toml"
+    # idempotent: re-normalizing the normalized args changes nothing
+    again = normalize_widget_call("configure_run", normalized.args)
+    assert again.args == normalized.args
+
+
+def test_form_renders_headlessly(tmp_path):
+    import io
+
+    from rich.console import Console
+
+    from prime_tpu.lab.widgets import render_widget
+
+    console = Console(width=90, file=io.StringIO(), force_terminal=False)
+    console.print(
+        render_widget(
+            "configure_run",
+            {"kind": "eval", "env": "gsm8k", "form_errors": ["Examples: bad"]},
+            workspace=tmp_path,
+        )
+    )
+    out = console.file.getvalue()
+    assert "Evaluate gsm8k" in out
+    assert "Examples" in out and "50" in out
+    assert "bad" in out
+    # error payload renders as an explicit error panel, never a crash
+    console = Console(width=90, file=io.StringIO(), force_terminal=False)
+    console.print(render_widget("configure_run", {"kind": "nope"}))
+    assert "widget error" in console.file.getvalue()
