@@ -141,10 +141,15 @@ def _sweep_stray_holders() -> list[str]:
 
 def _tree_bytes(params) -> int:
     """Total bytes of a parameter pytree as stored on device (bf16 weights
-    count 2 bytes, int8 quantized weights 1 byte + their fp scales)."""
+    count 2 bytes, int8 1 byte + fp scales, int4 packed two-per-byte)."""
     import jax
 
-    return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params))
+    def leaf_bytes(x) -> float:
+        if "int4" in str(x.dtype):
+            return x.size * 0.5
+        return x.size * x.dtype.itemsize
+
+    return int(sum(leaf_bytes(x) for x in jax.tree_util.tree_leaves(params)))
 
 
 def _kv_bytes_per_slot(config, kv_bytes: float) -> float:
@@ -576,6 +581,49 @@ def main() -> None:
             f"({record['int8_hbm_pct_peak']}% HBM peak)",
             flush=True,
         )
+        # int4 weights (W4A16 group-wise): half the int8 weight bytes again —
+        # at decode the weights dominate HBM traffic, so this is the deepest
+        # single-chip bandwidth lever in the stack. Nested guard: an int4
+        # failure must not erase the int8 numbers already recorded above.
+        try:
+            from prime_tpu.models.quantize import quantize_params_int4
+
+            q4params = quantize_params_int4(params)
+
+            def run_q4(kv_quant=False):
+                result = generate(
+                    q4params, prompts, lengths, config, jax.random.PRNGKey(2),
+                    max_new_tokens=NEW_TOKENS, temperature=0.0,
+                    **({"kv_quant": True} if kv_quant else {}),
+                )
+                float(jnp.sum(result.tokens))
+
+            q4_s = time_fn(run_q4)
+            q4kv_s = time_fn(lambda: run_q4(kv_quant=True))
+            record["int4_weights_tok_s"] = round(BATCH * NEW_TOKENS / q4_s, 1)
+            record["int4_weights_kv_tok_s"] = round(BATCH * NEW_TOKENS / q4kv_s, 1)
+            q4param_bytes = _tree_bytes(q4params)
+            record["int4_param_gb"] = round(q4param_bytes / 1e9, 3)
+            record.update(
+                _decode_roofline(
+                    q4param_bytes, config, BATCH, ctx_avg, NEW_TOKENS, q4_s,
+                    prefix="int4_",
+                )
+            )
+            record.update(
+                _decode_roofline(
+                    q4param_bytes, config, BATCH, ctx_avg, NEW_TOKENS, q4kv_s,
+                    kv_bytes=1 + 4 / config.head_dim, prefix="int4_kv_",
+                )
+            )
+            print(
+                f"# bench: int4 weights {record['int4_weights_tok_s']} tok/s "
+                f"({record['int4_hbm_pct_peak']}% HBM peak)",
+                flush=True,
+            )
+        except Exception as e:  # noqa: BLE001
+            record["int4_error"] = f"{type(e).__name__}: {e}"[:200]
+            print(f"# bench: int4 subsection failed: {e}", flush=True)
     except Exception as e:  # noqa: BLE001
         record["quant_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: quant section failed: {e}", flush=True)

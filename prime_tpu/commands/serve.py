@@ -22,7 +22,12 @@ import click
               help="Sequence-parallel axis for --slice: shard the KV cache's "
                    "slot dimension across the slice (long-context serving).")
 @click.option("--kv-quant", is_flag=True, help="int8 KV cache (halved decode HBM traffic).")
-@click.option("--weight-quant", is_flag=True, help="int8 weights (W8A16; halved weight HBM traffic).")
+@click.option("--weight-quant", is_flag=True, help="Quantized weights (halved+ weight HBM traffic).")
+@click.option(
+    "--weight-bits", type=click.Choice(["8", "4"]), default="8",
+    help="Weight quantization width for --weight-quant: 8 = W8A16 "
+         "per-channel, 4 = W4A16 group-wise (another 2x fewer weight bytes).",
+)
 @click.option("--adapter", default=None, type=click.Path(exists=True),
               help="LoRA adapter dir (from train local --lora) to merge into the model.")
 @click.option("--host", default="127.0.0.1")
@@ -58,6 +63,7 @@ def serve_cmd(
     sequence_parallel: int | None,
     kv_quant: bool,
     weight_quant: bool,
+    weight_bits: str,
     adapter: str | None,
     host: str,
     port: int,
@@ -71,6 +77,11 @@ def serve_cmd(
     """Serve MODEL over an OpenAI-compatible HTTP API (blocks until Ctrl-C)."""
     from prime_tpu.serve import serve_model
 
+    if weight_bits == "4" and not weight_quant:
+        # silently serving bf16 at 4x the expected HBM footprint would be a
+        # nasty surprise; make the dependency explicit
+        raise click.UsageError("--weight-bits 4 requires --weight-quant")
+
     try:
         server = serve_model(
             model,
@@ -80,7 +91,7 @@ def serve_cmd(
             tensor_parallel=tensor_parallel,
             sequence_parallel=sequence_parallel,
             kv_quant=kv_quant,
-            weight_quant=weight_quant,
+            weight_quant=("int4" if weight_bits == "4" else True) if weight_quant else False,
             adapter=adapter,
             host=host,
             port=port,

@@ -51,7 +51,7 @@ class EvalRunSpec:
     tensor_parallel: int | None = None   # override tp axis (default: mesh_for_slice policy)
     sequence_parallel: int | None = None  # sp axis: slot-sharded long-context KV cache
     kv_quant: bool = False               # int8 KV cache (halved decode HBM traffic)
-    weight_quant: bool = False           # int8 weights (W8A16)
+    weight_quant: bool | str = False     # True/'int8' W8A16; 'int4' W4A16
     speculative: bool = False            # prompt-lookup speculation (any temperature)
     draft_len: int = 4                   # draft tokens per verify pass
     adapter: str | None = None           # LoRA adapter artifact dir to merge
@@ -91,7 +91,7 @@ class JaxGenerator:
         tensor_parallel: int | None = None,
         sequence_parallel: int | None = None,  # sp axis: slot-sharded KV cache
         kv_quant: bool = False,
-        weight_quant: bool = False,
+        weight_quant: bool | str = False,  # True/'int8' -> W8A16; 'int4' -> W4A16
         speculative: bool = False,
         draft_len: int = 4,
         adapter: str | None = None,   # LoRA adapter artifact dir to merge
@@ -200,9 +200,20 @@ class JaxGenerator:
             self._data_size = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
             self.params = shard_params(self.params, mesh, self.config)
         if weight_quant:
-            from prime_tpu.models.quantize import quantize_params_int8
+            # True / "int8" -> W8A16; "int4" -> W4A16 group-wise (half the
+            # weight HBM bytes again; MoE expert stacks get int8 first since
+            # int4 serves the dense matmul path only)
+            from prime_tpu.models.quantize import (
+                quantize_params_int4,
+                quantize_params_int8,
+            )
 
-            self.params = quantize_params_int8(self.params)
+            if weight_quant == "int4":
+                # int4 claims the dense 3D stacks; int8 then covers whatever
+                # remains unquantized (MoE expert stacks)
+                self.params = quantize_params_int8(quantize_params_int4(self.params))
+            else:
+                self.params = quantize_params_int8(self.params)
         self.kv_quant = kv_quant
         self.speculative = speculative
         self.draft_len = draft_len

@@ -245,8 +245,11 @@ class AgentChatScreen(DetailScreen):
             self.pending = None
             return f"unusable form: {e}"
         if form.kind == "gepa":
+            # no launch card exists for gepa — stamp the CLI command (its own
+            # key: a saved_card stamp would render "card written" for a card
+            # that was never on disk)
             command = form_command_text(form)
-            pending["args"]["saved_card"] = command
+            pending["args"]["command"] = command
             self.pending = None
             self.send(f"run it with: {command}")
             return command

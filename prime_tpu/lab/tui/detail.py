@@ -73,11 +73,10 @@ def _content_text(content: Any) -> str:
                     text = str(part.get(kind, ""))
                 if text and kind in ("reasoning", "thinking"):
                     text = f"[reasoning] {text}"
-                if not text:
+                if not text and kind not in ("", "text", "reasoning", "thinking"):
+                    # kinds handled above stay empty when their text is empty
                     placeholder = _media_placeholder(kind, part)
-                    if placeholder is None and kind:
-                        placeholder = f"[{kind}]"  # unknown parts never vanish
-                    text = placeholder or ""
+                    text = placeholder or f"[{kind}]"  # unknown parts never vanish
                 parts.append(text)
             else:
                 parts.append(str(part))

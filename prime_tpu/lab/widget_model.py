@@ -41,7 +41,7 @@ FLOAT_CONFIG_FIELDS = ("temperature", "learning_rate", "top_p", "beta", "clip_ep
 # carry them through unchanged (widget state round-trip). ``form_values``
 # holds the user's form edits, ``form_errors`` the last typed-parse failures
 # (prefixed: a bare "values" stamp would collide with show_chart's payload).
-STATE_KEYS = ("selected", "saved_card", "form_values", "form_errors")
+STATE_KEYS = ("selected", "saved_card", "command", "form_values", "form_errors")
 
 FORM_KINDS = ("eval", "rl", "gepa")
 FORM_INT_FIELDS = ("rollouts_per_example", "max_steps", "seq_len")
@@ -322,12 +322,18 @@ class ActionSpec:
 
 @dataclass(frozen=True)
 class FormModel:
-    """Logical run-configuration form, independent of the rendering skin."""
+    """Logical run-configuration form, independent of the rendering skin.
+
+    ``extras`` are agent-proposed config fields outside the editable
+    schedule (e.g. temperature, seed): not editable, but visible in the
+    render and carried onto the launched card — a proposal must not behave
+    differently between launch_run and configure_run."""
 
     kind: str
     title: str
     fields: tuple[FieldSpec, ...]
     actions: tuple[ActionSpec, ...]
+    extras: tuple[tuple[str, Any], ...] = ()
 
 
 # (name, label, input_type, default, disabled) per form kind — defaults
@@ -494,7 +500,14 @@ def build_form_model(normalized: NormalizedWidget, workspace: Any = None) -> For
     env_label = (env_value or "run").rsplit("/", 1)[-1]
     title = normalized.args.get("title") or f"{_FORM_TITLES[kind]} {env_label}"
     actions = (ActionSpec("launch", "Launch", "primary"), ActionSpec("stop", "Stop"))
-    return FormModel(kind=kind, title=title, fields=tuple(fields), actions=actions)
+    schedule_names = {name for name, *_ in _FORM_SCHEDULES[kind]}
+    config = normalized.args.get("config") or {}
+    extras = tuple(
+        (key, value) for key, value in config.items() if key not in schedule_names
+    )
+    return FormModel(
+        kind=kind, title=title, fields=tuple(fields), actions=actions, extras=extras
+    )
 
 
 def parse_form_values(form: FormModel) -> tuple[dict[str, Any], list[str]]:
@@ -528,7 +541,9 @@ def form_launch_payload(form: FormModel) -> tuple[str, dict[str, Any]]:
     kind = {"rl": "train"}.get(form.kind, form.kind)
     if kind == "gepa":
         raise WidgetValidationError("gepa forms launch via the command line")
-    return kind, config
+    # field values win over extras on key collision (can't happen today —
+    # extras are by construction outside the schedule — but cheap insurance)
+    return kind, {**dict(form.extras), **config}
 
 
 def form_command_text(form: FormModel) -> str:

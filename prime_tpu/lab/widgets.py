@@ -244,14 +244,23 @@ def render_widget(
                 Text(spec.label, style="dim"),
                 Text(f"{spec.value or '—'} {marker}".rstrip(), style=style),
             )
+        for key, value in form.extras:
+            # agent-proposed fields outside the editable schedule: shown, and
+            # carried onto the launched card
+            body.add_row(Text(str(key), style="dim"), Text(str(value)[:60], style="dim"))
         for error in args.get("form_errors") or ():
             body.add_row(Text("!", style="red"), Text(str(error), style="red"))
         saved = args.get("saved_card")
+        command = args.get("command")
         if saved:
             body.add_row(Text("card", style="green"), Text(str(saved), style="green"))
+        if command:
+            body.add_row(Text("command", style="green"), Text(str(command), style="green"))
         hint = (
             "card written"
             if saved
+            else "command sent"
+            if command
             else "edit: name=value · enter: launch · stop: discard"
         )
         return panel(

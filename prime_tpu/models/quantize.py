@@ -1,14 +1,22 @@
-"""int8 weight quantization for serving (W8A16).
+"""int8 / int4 weight quantization for serving (W8A16 / W4A16).
 
 At small decode batches the weight matrices — not the KV cache — dominate
-HBM traffic (every step reads every layer's weights once), so int8 weights
-are the other half of the decode-bandwidth story next to the int8 KV cache.
+HBM traffic (every step reads every layer's weights once), so quantized
+weights are the other half of the decode-bandwidth story next to the int8
+KV cache.
 
-Scheme: per-output-channel symmetric int8. A quantized matrix is the pytree
+int8 scheme: per-output-channel symmetric. A quantized matrix is the pytree
 tuple ``(q int8 (..., in, out), scale fp32 (..., 1, out))`` and the matmul
 dequantizes by scaling the OUTPUT columns — ``x @ (q * s) == (x @ q) * s``
 exactly, so XLA reads int8 from HBM and fuses the convert + scale into the
 matmul epilogue; the fp weights are never materialized.
+
+int4 scheme: group-wise symmetric along the REDUCTION axis (AWQ/GPTQ-style,
+group=128 input channels), because 4 bits with one scale per whole column
+loses too much signal. The tuple is ``(q int4 (..., in, out), scale fp32
+(..., groups, 1, out))`` and the matmul splits the reduction into per-group
+partials — ``sum_g (x_g @ q_g) * s_g`` — so XLA streams packed int4 from
+HBM (half the int8 bytes) and the MXU still sees batched bf16 matmuls.
 
 Norms, embeddings, the router, and the LM head stay in their original dtype
 (gathers and the final fp32 logits matmul have different numerics); the
@@ -39,17 +47,67 @@ def quantize_params_int8(params: dict) -> dict:
     """
     layers = dict(params["layers"])
     for key in QUANTIZED_LAYER_KEYS:
-        if key in layers:
+        if key in layers and not isinstance(layers[key], tuple):
             layers[key] = quantize_weight(layers[key])
     out = dict(params)
     out["layers"] = layers
     return out
 
 
+INT4_GROUP = 128
+
+
+def quantize_weight_int4(
+    w: jnp.ndarray, group: int = INT4_GROUP
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Group-wise (reduction axis) symmetric int4: one fp32 scale per
+    ``group`` input channels per output channel. Falls back to a single
+    group when the reduction dim doesn't divide."""
+    *lead, d_in, d_out = w.shape
+    g = group if d_in % group == 0 else d_in
+    groups = d_in // g
+    wg = w.astype(jnp.float32).reshape(*lead, groups, g, d_out)
+    absmax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)  # (..., groups, 1, out)
+    scale = absmax / 7.0
+    q = jnp.clip(jnp.round(wg / jnp.maximum(scale, 1e-12)), -8, 7).astype(jnp.int4)
+    return q.reshape(*lead, d_in, d_out), scale
+
+
+def quantize_params_int4(params: dict, group: int = INT4_GROUP) -> dict:
+    """Params tree with the big DENSE layer matrices as (int4, scale) tuples.
+
+    MoE expert stacks are left untouched (the grouped-reduction einsum isn't
+    wired through the expert dispatch path) — quantize those with
+    :func:`quantize_params_int8` first if needed; int8 tuples and int4
+    tuples coexist in one tree, ``matmul`` dispatches on dtype."""
+    layers = dict(params["layers"])
+    for key in QUANTIZED_LAYER_KEYS:
+        w = layers.get(key)
+        if w is not None and not isinstance(w, tuple) and w.ndim == 3:
+            layers[key] = quantize_weight_int4(w, group=group)
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def _matmul_int4(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Per-group partial matmuls, scaled then summed over groups: exact
+    w.r.t. ``x @ dequant(q, scale)`` up to fp accumulation order."""
+    d_in, d_out = q.shape[-2:]
+    groups = scale.shape[-3]
+    g = d_in // groups
+    xg = x.reshape(*x.shape[:-1], groups, g)
+    qg = q.reshape(*q.shape[:-2], groups, g, d_out)
+    y = jnp.einsum("...gi,gio->...go", xg, qg.astype(x.dtype))
+    return jnp.sum(y * scale[..., 0, :].astype(y.dtype), axis=-2)
+
+
 def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
-    """``x @ w`` where w may be a quantized (q, scale) tuple."""
+    """``x @ w`` where w may be an int8 or int4 quantized (q, scale) tuple."""
     if isinstance(w, tuple):
         q, scale = w
+        if q.dtype == jnp.int4:
+            return _matmul_int4(x, q, scale)
         # int8 read from HBM; convert fuses into the matmul, scale into its
         # epilogue (output columns), so this is exact w.r.t. x @ (q*scale)
         y = x @ q.astype(x.dtype)

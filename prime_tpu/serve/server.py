@@ -316,7 +316,7 @@ def serve_model(
     tensor_parallel: int | None = None,
     sequence_parallel: int | None = None,
     kv_quant: bool = False,
-    weight_quant: bool = False,
+    weight_quant: bool | str = False,  # True/'int8' -> W8A16; 'int4' -> W4A16
     adapter: str | None = None,
     host: str = "127.0.0.1",
     port: int = 8000,

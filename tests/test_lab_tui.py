@@ -1153,3 +1153,13 @@ def test_sample_browser_tool_chains_media_and_error_turns(app, tmp_path):
         app.on_key("j")
     tail = render_text(app)
     assert "ERROR" in tail and "rollout aborted after turn 6" in tail
+
+
+def test_empty_text_parts_render_nothing(app, tmp_path):
+    """An empty 'text' part (streamed turns that only carry tool_calls) must
+    not leave a '[text]' placeholder behind."""
+    from prime_tpu.lab.tui.detail import _content_text
+
+    assert _content_text([{"type": "text", "text": ""}]) == ""
+    assert _content_text([{"type": "reasoning"}]) == ""
+    assert _content_text([{"type": "mystery_kind"}]) == "[mystery_kind]"

@@ -390,6 +390,59 @@ def test_int8_weights_moe_forward():
     assert np.abs(fp_probs - q_probs).max() < 0.08  # routing can amplify rounding
 
 
+def test_int4_weights_matmul_exact_and_bytes_quartered(params):
+    """W4A16 group-wise: the grouped matmul must equal x @ dequant(q, s)
+    (same math, different order), logits stay usable, bytes ~quarter fp32."""
+    from prime_tpu.models.quantize import (
+        matmul,
+        quantize_params_int4,
+        quantize_weight_int4,
+    )
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (256, 96)) * 0.02
+    q, s = quantize_weight_int4(w)
+    assert str(q.dtype) == "int4" and s.shape == (2, 1, 96)  # groups of 128
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 256))
+    dequant = (q.astype(jnp.float32).reshape(2, 128, 96) * s).reshape(256, 96)
+    assert np.abs(np.asarray(matmul(x, (q, s)) - x @ dequant)).max() < 1e-4
+    # 4-bit quantization noise is bounded for well-scaled weights
+    rel = float(jnp.linalg.norm(matmul(x, (q, s)) - x @ w) / jnp.linalg.norm(x @ w))
+    assert rel < 0.25
+
+    q4params = quantize_params_int4(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 10), 0, CFG.vocab_size)
+    logits, _ = forward(q4params, tokens, CFG)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_int4_weights_generate_and_compose_with_int8(params):
+    """int4 dense + int8 leftovers compose in one tree; generate runs; a
+    second int8 pass never re-quantizes an existing tuple."""
+    from prime_tpu.models.quantize import quantize_params_int4, quantize_params_int8
+    from prime_tpu.models.sampler import generate
+
+    q4 = quantize_params_int8(quantize_params_int4(params))
+    assert str(q4["layers"]["wq"][0].dtype) == "int4"  # int8 pass left it alone
+    tokens = jax.random.randint(jax.random.PRNGKey(8), (2, 6), 1, CFG.vocab_size)
+    lengths = jnp.asarray([6, 4], jnp.int32)
+    result = generate(q4, tokens, lengths, CFG, jax.random.PRNGKey(9), max_new_tokens=4)
+    assert result.tokens.shape == (2, 4)
+    # kv_quant composes too (int4 weights + int8 cache)
+    result = generate(
+        q4, tokens, lengths, CFG, jax.random.PRNGKey(9), max_new_tokens=4, kv_quant=True
+    )
+    assert result.tokens.shape == (2, 4)
+
+
+def test_int4_generator_weight_bits(tmp_path):
+    from prime_tpu.evals.runner import JaxGenerator
+
+    gen = JaxGenerator("tiny-test", weight_quant="int4")
+    assert str(gen.params["layers"]["wq"][0].dtype) == "int4"
+    [out] = gen.generate(["2+2="], max_new_tokens=4, temperature=0.0)
+    assert isinstance(out, str)
+
+
 def test_weight_quant_rejected_on_multi_device_mesh():
     from prime_tpu.evals.runner import JaxGenerator
 

@@ -417,3 +417,50 @@ def test_form_renders_headlessly(tmp_path):
     console = Console(width=90, file=io.StringIO(), force_terminal=False)
     console.print(render_widget("configure_run", {"kind": "nope"}))
     assert "widget error" in console.file.getvalue()
+
+
+def test_form_extras_visible_and_carried_to_card():
+    """Agent config outside the schedule (temperature, seed) must ride onto
+    the launched card — a proposal can't behave differently between
+    launch_run and configure_run — and render in the form."""
+    import io
+
+    from rich.console import Console
+
+    from prime_tpu.lab.widget_model import form_launch_payload
+    from prime_tpu.lab.widgets import render_widget
+
+    args = {"kind": "eval", "env": "gsm8k", "config": {"temperature": 0.0, "seed": 42}}
+    form = _form(args)
+    assert dict(form.extras) == {"temperature": 0.0, "seed": 42}
+    _kind, payload = form_launch_payload(form)
+    assert payload["temperature"] == 0.0 and payload["seed"] == 42
+    console = Console(width=90, file=io.StringIO(), force_terminal=False)
+    console.print(render_widget("configure_run", args))
+    out = console.file.getvalue()
+    assert "temperature" in out and "seed" in out
+
+
+def test_gepa_form_stamps_command_not_card(tmp_path):
+    import io
+
+    from rich.console import Console
+
+    from prime_tpu.lab.tui.chat import AgentChatScreen
+    from prime_tpu.lab.tui.launch import scan_cards
+    from prime_tpu.lab.widgets import render_widget
+
+    screen = AgentChatScreen("tester", lambda: None, workspace=str(tmp_path))
+    entry = {"role": "widget", "name": "configure_run",
+             "args": {"kind": "gepa", "env": "wordle", "config": {"model": "m1"}}}
+    screen.transcript.append(entry)
+    screen.pending = entry
+    status = screen.on_key("enter")
+    assert status == "prime gepa run wordle -m m1"
+    assert "saved_card" not in entry["args"]
+    assert entry["args"]["command"] == status
+    assert scan_cards(tmp_path) == []  # truly no card on disk
+    console = Console(width=100, file=io.StringIO(), force_terminal=False)
+    console.print(render_widget("configure_run", entry["args"]))
+    out = console.file.getvalue()
+    assert "command sent" in out and "card written" not in out
