@@ -259,10 +259,9 @@ class JaxGenerator:
 
             from prime_tpu.parallel.sharding import (
                 batch_spec,
-                cache_spec,
+                cache_spec_for,
                 lengths_spec,
                 prune_spec,
-                sp_cache_spec,
             )
 
             batch = jax.device_put(
@@ -272,10 +271,11 @@ class JaxGenerator:
                 lengths, NamedSharding(self.mesh, prune_spec(lengths_spec(), self.mesh))
             )
             # an sp axis shards the KV cache's SLOT dimension: a long-context
-            # cache larger than one chip's HBM spreads across the slice
+            # cache larger than one chip's HBM spreads across the slice.
+            # cache_spec_for keeps MLA's single-latent head axis replicated.
             has_sp = self.mesh.shape.get("sp", 1) > 1
             kw["cache_spec"] = prune_spec(
-                sp_cache_spec() if has_sp else cache_spec(), self.mesh
+                cache_spec_for(self.config, sp=has_sp), self.mesh
             )
             if self.mesh.size > 1:
                 # pallas kernels are not SPMD-partitionable under jit; on a

@@ -93,9 +93,31 @@ class ModelConfig:
     # silu gating)
     moe_glu_clamp: float = 0.0
 
+    # --- DeepSeek-style multi-head latent attention (MLA) ---
+    # kv_lora_rank set => MLA: K/V live as ONE shared per-token latent
+    # [c_kv (kv_lora_rank); k_pe (qk_rope_head_dim)] instead of per-head
+    # K/V — the decode cache shrinks ~(2*H*hd)/(rank+rope)x. q_lora_rank
+    # adds the low-rank query path (DeepSeek-V2/V3; the Lite models use a
+    # direct query projection).
+    kv_lora_rank: int | None = None
+    q_lora_rank: int | None = None
+    qk_rope_head_dim: int = 64    # roped sub-head, shared across heads (MQA-style)
+    qk_nope_head_dim: int = 128   # position-free sub-head, absorbed into the latent
+    v_head_dim: int = 128         # per-head value width out of the latent
+
     @property
     def head_dim(self) -> int:
         return self.head_dim_override or self.d_model // self.n_heads
+
+    @property
+    def mla(self) -> bool:
+        return self.kv_lora_rank is not None
+
+    @property
+    def mla_cache_dim(self) -> int:
+        """Per-token latent the cache stores: [c_kv; roped k_pe]."""
+        assert self.kv_lora_rank is not None
+        return self.kv_lora_rank + self.qk_rope_head_dim
 
     @property
     def is_moe(self) -> bool:
@@ -105,7 +127,23 @@ class ModelConfig:
     def param_count(self) -> int:
         embed = self.vocab_size * self.d_model
         head = 0 if self.tie_embeddings else self.d_model * self.vocab_size
-        attn = self.d_model * self.head_dim * (2 * self.n_heads + 2 * self.n_kv_heads)
+        if self.mla:
+            nope, rope = self.qk_nope_head_dim, self.qk_rope_head_dim
+            rank, vd, h = self.kv_lora_rank, self.v_head_dim, self.n_heads
+            if self.q_lora_rank is not None:
+                attn = self.d_model * self.q_lora_rank + self.q_lora_rank * (
+                    1 + h * (nope + rope)
+                )
+            else:
+                attn = self.d_model * h * (nope + rope)
+            attn += (
+                self.d_model * (rank + rope)  # wkv_a
+                + rank                        # kv_a_norm
+                + rank * h * (nope + vd)      # wkv_b
+                + h * vd * self.d_model       # wo
+            )
+        else:
+            attn = self.d_model * self.head_dim * (2 * self.n_heads + 2 * self.n_kv_heads)
         if self.attn_bias:
             attn += self.head_dim * (self.n_heads + 2 * self.n_kv_heads)
         if self.attn_out_bias:
@@ -589,6 +627,38 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         n_kv_heads=2,
         d_ff=256,
         max_seq_len=512,
+    ),
+    # DeepSeek-V2-Lite-shaped MLA at test scale: direct query projection
+    # (q_lora_rank=None), shared-latent KV cache, absorbed decode
+    "tiny-mla": ModelConfig(
+        name="tiny-mla",
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=4,  # MLA has no GQA grouping; kept == n_heads for clarity
+        d_ff=256,
+        max_seq_len=512,
+        kv_lora_rank=32,
+        qk_rope_head_dim=16,
+        qk_nope_head_dim=32,
+        v_head_dim=32,
+    ),
+    # DeepSeek-V2/V3-style low-rank query path at test scale
+    "tiny-mla-qlora": ModelConfig(
+        name="tiny-mla-qlora",
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=4,
+        d_ff=256,
+        max_seq_len=512,
+        kv_lora_rank=32,
+        q_lora_rank=48,
+        qk_rope_head_dim=16,
+        qk_nope_head_dim=32,
+        v_head_dim=32,
     ),
     "tiny-moe": ModelConfig(
         name="tiny-moe",

@@ -75,6 +75,18 @@ def init_cache(
     dtype=jnp.bfloat16,
     quantized: bool = False,
 ) -> KVCache:
+    if config.mla:
+        # MLA: ONE shared latent column [c_kv; roped k_pe] per token rides
+        # the k array (KH=1, width rank+rope); v is a 1-wide dummy the scan
+        # carries untouched (models/mla.py) — the latent is already ~10x
+        # smaller than per-head K/V, so int8 cache quant is not wired here
+        if quantized:
+            raise ValueError("MLA caches are latent-compressed; kv_quant is unsupported")
+        return KVCache(
+            k=jnp.zeros((config.n_layers, batch, 1, config.mla_cache_dim, capacity), dtype=dtype),
+            v=jnp.zeros((config.n_layers, batch, 1, 1, capacity), dtype=dtype),
+            lengths=jnp.zeros((batch,), dtype=jnp.int32),
+        )
     shape = (config.n_layers, batch, config.n_kv_heads, config.head_dim, capacity)
     scale_shape = (config.n_layers, batch, config.n_kv_heads, 1, capacity)
     if quantized:
@@ -174,13 +186,21 @@ def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Para
         if config.pre_norms
         else {}
     )
-    params: Params = {
-        "embed": dense(keys[0], (config.vocab_size, d), d),
-        "layers": {
+    if config.mla:
+        from prime_tpu.models.mla import init_mla_attn_params
+
+        attn_weights = init_mla_attn_params(keys, config, dtype, dense)
+    else:
+        attn_weights = {
             "wq": dense(keys[1], (layers, d, h * hd), d),
             "wk": dense(keys[2], (layers, d, kh * hd), d),
             "wv": dense(keys[3], (layers, d, kh * hd), d),
             "wo": dense(keys[4], (layers, h * hd, d), h * hd),
+        }
+    params: Params = {
+        "embed": dense(keys[0], (config.vocab_size, d), d),
+        "layers": {
+            **attn_weights,
             **pre_norms,
             **attn_biases,
             **mlp_weights,
@@ -444,11 +464,22 @@ def forward(
     - decode step:     cache=<filled>, decode=True, S must be 1
     """
     batch, seq = tokens.shape
+    if config.mla:
+        from prime_tpu.models.mla import validate_mla_config
+
+        # loud rejection of per-head attention features the absorbed latent
+        # form can't express (window/softcap/sinks/qk_norm/bias/...)
+        validate_mla_config(config)
     if attn_impl == "ring":
         # context parallelism is a TRAINING-path mode: the KV cache's slot
         # axis is not ring-sharded (long-context decode is long_context.py's
         # sp path), and per-layer sliding schedules would need a per-layer
         # static hop cap the uniform scan can't express
+        if config.mla:
+            raise ValueError(
+                "attn_impl='ring' does not serve MLA configs yet (the ring "
+                "fold rotates per-head K/V, not the shared latent)"
+            )
         if cache is not None:
             raise ValueError("attn_impl='ring' serves the no-cache (training) path only")
         if ring_mesh is None or "sp" not in ring_mesh.shape:
@@ -466,7 +497,10 @@ def forward(
             positions = positions + (off[:, None] if off.ndim else off)
     max_pos = cache.capacity if cache is not None else max(seq, config.max_seq_len)
     rope_tables = rope_frequencies(
-        config.head_dim, max_pos, config.rope_theta,
+        # MLA ropes only the shared qk_rope sub-head; the nope part and the
+        # latent are position-free
+        config.qk_rope_head_dim if config.mla else config.head_dim,
+        max_pos, config.rope_theta,
         scale=config.rope_scale, llama3=config.rope_llama3, yarn=config.rope_yarn,
         yarn_truncate=config.rope_yarn_truncate, longrope=config.rope_longrope,
         # LongRoPE short/long selection follows the run's actual position
@@ -513,12 +547,21 @@ def forward(
         else:
             lp, sliding, k_c, v_c = scanned
             k_s = v_s = None
-        x, new_k, new_v, new_ks, new_vs = _attention_block(
-            x, lp, positions, rope_tables, config,
-            k_c, v_c, cache_lengths, decode, attn_impl,
-            k_scale=k_s, v_scale=v_s, prefill_offset=prefill_offset,
-            sliding=sliding, rope_tables_local=rope_tables_local,
-        )
+        if config.mla:
+            from prime_tpu.models.mla import mla_attention_block
+
+            x, new_k, new_v, new_ks, new_vs = mla_attention_block(
+                x, lp, positions, rope_tables, config,
+                k_c, v_c, cache_lengths, decode, attn_impl,
+                prefill_offset=prefill_offset,
+            )
+        else:
+            x, new_k, new_v, new_ks, new_vs = _attention_block(
+                x, lp, positions, rope_tables, config,
+                k_c, v_c, cache_lengths, decode, attn_impl,
+                k_scale=k_s, v_scale=v_s, prefill_offset=prefill_offset,
+                sliding=sliding, rope_tables_local=rope_tables_local,
+            )
         x, aux = _mlp_block(x, lp, config)
         ys = (new_k, new_v, new_ks, new_vs) if quantized else (new_k, new_v)
         return (x, aux_sum + aux), ys
@@ -543,11 +586,19 @@ def forward(
         def layer_fn_nocache(carry, scanned):
             lp, sliding = scanned
             x, aux_sum = carry
-            x, _, _, _, _ = _attention_block(
-                x, lp, positions, rope_tables, config, None, None, None, False, attn_impl,
-                sliding=sliding, rope_tables_local=rope_tables_local,
-                ring_mesh=ring_mesh,
-            )
+            if config.mla:
+                from prime_tpu.models.mla import mla_attention_block
+
+                x, _, _, _, _ = mla_attention_block(
+                    x, lp, positions, rope_tables, config,
+                    None, None, None, False, attn_impl,
+                )
+            else:
+                x, _, _, _, _ = _attention_block(
+                    x, lp, positions, rope_tables, config, None, None, None, False, attn_impl,
+                    sliding=sliding, rope_tables_local=rope_tables_local,
+                    ring_mesh=ring_mesh,
+                )
             x, aux = _mlp_block(x, lp, config)
             return (x, aux_sum + aux), None
 

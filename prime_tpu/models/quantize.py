@@ -28,7 +28,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-QUANTIZED_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+QUANTIZED_LAYER_KEYS = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+    # MLA projections (models/mla.py): wkv_a/wq_a/wq_b flow through matmul();
+    # wkv_b's absorb/value einsums fold the per-output-channel int8 scales
+    # themselves (_split_wkv_b)
+    "wkv_a", "wq_a", "wq_b", "wkv_b",
+)
 
 
 def quantize_weight(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -82,6 +88,11 @@ def quantize_params_int4(params: dict, group: int = INT4_GROUP) -> dict:
     tuples coexist in one tree, ``matmul`` dispatches on dtype."""
     layers = dict(params["layers"])
     for key in QUANTIZED_LAYER_KEYS:
+        if key == "wkv_b":
+            # the MLA absorb einsum CONTRACTS wkv_b's reduction axis, where
+            # int4's group scales live — only int8's output-channel scheme
+            # folds there; a later int8 pass picks this key up
+            continue
         w = layers.get(key)
         if w is not None and not isinstance(w, tuple) and w.ndim == 3:
             layers[key] = quantize_weight_int4(w, group=group)
@@ -128,4 +139,5 @@ def einsum(spec: str, activations: jnp.ndarray, w, out_scale_shape) -> jnp.ndarr
 
 
 def is_quantized(params: dict) -> bool:
-    return isinstance(params.get("layers", {}).get("wq"), tuple)
+    layers = params.get("layers", {})
+    return any(isinstance(layers.get(k), tuple) for k in QUANTIZED_LAYER_KEYS)

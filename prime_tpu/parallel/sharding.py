@@ -74,13 +74,35 @@ def param_specs(config: ModelConfig) -> dict[str, Any]:
         if config.pre_norms
         else {}
     )
-    specs: dict[str, Any] = {
-        "embed": P("tp", "fsdp"),              # (V, D) vocab on tp, d_model on fsdp
-        "layers": {
+    if config.mla:
+        # MLA: query heads and wkv_b's per-head output columns ride tp
+        # (h-major flat layout splits whole heads when h % tp == 0); the
+        # shared latent projections are head-free and ride fsdp only
+        attn_specs: dict[str, Any] = {
+            "wkv_a": P(None, "fsdp", None),
+            "kv_a_norm": P(None, None),
+            "wkv_b": P(None, None, "tp"),
+            "wo": P(None, "tp", "fsdp"),
+        }
+        if config.q_lora_rank is not None:
+            attn_specs |= {
+                "wq_a": P(None, "fsdp", None),
+                "q_a_norm": P(None, None),
+                "wq_b": P(None, None, "tp"),
+            }
+        else:
+            attn_specs["wq"] = P(None, "fsdp", "tp")
+    else:
+        attn_specs = {
             "wq": P(None, "fsdp", "tp"),
             "wk": P(None, "fsdp", "tp"),
             "wv": P(None, "fsdp", "tp"),
             "wo": P(None, "tp", "fsdp"),
+        }
+    specs: dict[str, Any] = {
+        "embed": P("tp", "fsdp"),              # (V, D) vocab on tp, d_model on fsdp
+        "layers": {
+            **attn_specs,
             **pre_norm_specs,
             **attn_bias_specs,
             **mlp_specs,
@@ -130,6 +152,17 @@ def cache_spec() -> P:
     difference between fitting v5e HBM and OOM.
     """
     return P(None, ("dp", "fsdp"), "tp", None, None)
+
+
+def cache_spec_for(config, sp: bool = False) -> P:
+    """The cache spec a model's KV cache layout admits: MLA caches have ONE
+    kv 'head' (the shared latent), so the head axis must stay replicated —
+    putting tp there would demand 1 % tp == 0. Non-MLA picks the standard
+    (sp_)cache_spec. Callers still prune against their mesh."""
+    base = sp_cache_spec() if sp else cache_spec()
+    if getattr(config, "mla", False):
+        return P(base[0], base[1], None, *base[3:])
+    return base
 
 
 def sp_cache_spec() -> P:

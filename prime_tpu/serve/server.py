@@ -357,18 +357,15 @@ def serve_model(
 
             cache_spec = None
             if generator.mesh is not None:
-                from prime_tpu.parallel.sharding import (
-                    cache_spec as _cache_spec,
-                    prune_spec,
-                    sp_cache_spec,
-                )
+                from prime_tpu.parallel.sharding import cache_spec_for, prune_spec
 
                 # an sp axis shards each slot's KV cache over the slice's
                 # slot dimension — long-context serving where one request's
-                # cache exceeds a single chip's HBM (mirrors evals/runner.py)
+                # cache exceeds a single chip's HBM (mirrors evals/runner.py);
+                # MLA caches keep their single-latent head axis replicated
                 has_sp = generator.mesh.shape.get("sp", 1) > 1
                 cache_spec = prune_spec(
-                    sp_cache_spec() if has_sp else _cache_spec(), generator.mesh
+                    cache_spec_for(generator.config, sp=has_sp), generator.mesh
                 )
             engine = ContinuousBatchingEngine(
                 generator.params,

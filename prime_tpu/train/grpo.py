@@ -247,7 +247,7 @@ def run_grpo(
     from prime_tpu.evals.datasets import score_completion
     from prime_tpu.parallel.sharding import (
         batch_spec,
-        cache_spec,
+        cache_spec_for,
         lengths_spec,
         shard_batch,
     )
@@ -321,7 +321,7 @@ def run_grpo(
     gen_kw: dict = {"attn_impl": attn_impl}
     score_impl = attn_impl
     if mesh is not None:
-        gen_kw["cache_spec"] = cache_spec()
+        gen_kw["cache_spec"] = cache_spec_for(config)  # MLA latent head stays replicated
         if mesh.size > 1:
             # pallas is not SPMD-partitionable; both generate and the
             # teacher-forced score/update passes must take the XLA paths

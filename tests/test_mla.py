@@ -1,0 +1,253 @@
+"""Multi-head latent attention (DeepSeek-style MLA, models/mla.py).
+
+The load-bearing contracts:
+- the ABSORBED formulation (what serves) equals the textbook per-head
+  reconstruction (the oracle) to fp32 noise;
+- prefill+decode through the latent cache equals the dense no-cache forward
+  at the same positions;
+- the engine decodes exactly the one-shot sampler's tokens (slot splicing,
+  chunked prefill, and continuous decode all ride the latent cache);
+- the cache really is latent-compressed, and kv_quant is rejected loudly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from prime_tpu.models import get_config
+from prime_tpu.models.llama import forward, init_cache, init_params
+from prime_tpu.models.sampler import generate
+
+CFG = get_config("tiny-mla")
+PARAMS = init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    return jax.random.randint(jax.random.PRNGKey(1), (2, 12), 1, CFG.vocab_size)
+
+
+@pytest.mark.parametrize("preset", ["tiny-mla", "tiny-mla-qlora"])
+def test_absorbed_equals_naive_oracle(preset):
+    """q_nope @ W_kc . c_kv == q_nope . (W_kc @ c_kv): the absorption is a
+    reassociation, so the two formulations agree to fp32 noise."""
+    from prime_tpu.models.mla import mla_attention_block, naive_mla_attention
+    from prime_tpu.ops.rope import rope_frequencies
+
+    config = get_config(preset)
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    lp = {k: v[0] for k, v in params["layers"].items()}
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 12, config.d_model)) * 0.1
+    positions = jnp.broadcast_to(jnp.arange(12)[None], (2, 12))
+    tables = rope_frequencies(config.qk_rope_head_dim, 64, config.rope_theta)
+    absorbed, *_ = mla_attention_block(
+        x, lp, positions, tables, config, None, None, None, False, "xla"
+    )
+    naive = naive_mla_attention(x, lp, positions, tables, config)
+    assert float(jnp.max(jnp.abs(absorbed - naive))) < 1e-5
+
+
+def test_prefill_decode_matches_dense(tokens):
+    dense_logits, _ = forward(PARAMS, tokens, CFG)
+    cache = init_cache(CFG, 2, 16, dtype=jnp.float32)
+    _, cache = forward(PARAMS, tokens[:, :11], CFG, cache=cache)
+    step_logits, cache = forward(
+        PARAMS, tokens[:, 11:12], CFG, cache=cache, decode=True,
+        positions=jnp.full((2, 1), 11, jnp.int32),
+    )
+    assert float(jnp.max(jnp.abs(step_logits[:, 0] - dense_logits[:, 11]))) < 1e-4
+    assert cache.lengths.tolist() == [12, 12]
+
+
+def test_chunked_prefill_matches_one_shot(tokens):
+    """Chunked prefill writes latent columns at the offset and attends over
+    the cache — logits for the final chunk must match one-shot prefill."""
+    cache_ref = init_cache(CFG, 2, 16, dtype=jnp.float32)
+    ref_logits, cache_ref = forward(PARAMS, tokens, CFG, cache=cache_ref)
+
+    cache = init_cache(CFG, 2, 16, dtype=jnp.float32)
+    _, cache = forward(PARAMS, tokens[:, :8], CFG, cache=cache)
+    chunk_logits, cache = forward(
+        PARAMS, tokens[:, 8:], CFG, cache=cache,
+        prefill_offset=jnp.asarray(8, jnp.int32),
+    )
+    assert float(jnp.max(jnp.abs(chunk_logits - ref_logits[:, 8:]))) < 1e-4
+    assert float(jnp.max(jnp.abs(cache.k - cache_ref.k))) < 1e-5
+
+
+def test_generate_greedy_deterministic(tokens):
+    lengths = jnp.full((2,), 12, jnp.int32)
+    a = generate(PARAMS, tokens, lengths, CFG, jax.random.PRNGKey(3), max_new_tokens=6, temperature=0.0)
+    b = generate(PARAMS, tokens, lengths, CFG, jax.random.PRNGKey(9), max_new_tokens=6, temperature=0.0)
+    assert a.tokens.tolist() == b.tokens.tolist()  # greedy ignores the rng
+
+
+def test_engine_matches_one_shot_sampler():
+    from prime_tpu.serve.engine import ContinuousBatchingEngine
+
+    prompt = [9, 8, 7, 6, 5]
+    ref = generate(
+        PARAMS, jnp.asarray([prompt], jnp.int32), jnp.asarray([5], jnp.int32),
+        CFG, jax.random.PRNGKey(7), max_new_tokens=6, temperature=0.0,
+    ).tokens[0].tolist()
+    engine = ContinuousBatchingEngine(PARAMS, CFG, max_slots=2, capacity=64, chunk=4)
+    reqs = [engine.submit(prompt, max_new_tokens=6), engine.submit([3, 2], max_new_tokens=6)]
+    while not all(r.done for r in reqs):
+        engine.tick()
+    assert reqs[0].all_tokens(timeout=1) == ref
+
+
+def test_sharded_generate_tp_fsdp(tokens):
+    """MLA under the serving mesh: query heads on tp, latent cache head axis
+    replicated (cache_spec_for); decoded tokens match the single-device run."""
+    from jax.sharding import NamedSharding
+
+    from prime_tpu.parallel.mesh import make_mesh
+    from prime_tpu.parallel.sharding import (
+        batch_spec,
+        cache_spec_for,
+        lengths_spec,
+        prune_spec,
+        shard_params,
+    )
+
+    lengths = jnp.full((2,), 12, jnp.int32)
+    ref = generate(
+        PARAMS, tokens, lengths, CFG, jax.random.PRNGKey(5), max_new_tokens=4,
+        temperature=0.0,
+    ).tokens.tolist()
+    mesh = make_mesh({"dp": 1, "fsdp": 2, "tp": 2}, devices=jax.devices()[:4])
+    sharded = shard_params(PARAMS, mesh, CFG)
+    with jax.set_mesh(mesh):
+        out = generate(
+            sharded,
+            jax.device_put(tokens, NamedSharding(mesh, batch_spec())),
+            jax.device_put(lengths, NamedSharding(mesh, lengths_spec())),
+            CFG, jax.random.PRNGKey(5), max_new_tokens=4, temperature=0.0,
+            attn_impl="xla", cache_spec=prune_spec(cache_spec_for(CFG), mesh),
+        )
+    assert out.tokens.tolist() == ref
+
+
+def test_train_step_finite_grads():
+    from prime_tpu.parallel.mesh import make_mesh
+    from prime_tpu.parallel.sharding import shard_batch
+    from prime_tpu.train import (
+        default_optimizer,
+        init_train_state,
+        make_train_step,
+        shard_train_state,
+    )
+
+    mesh = make_mesh({"dp": 1, "fsdp": 2, "tp": 2}, devices=jax.devices()[:4])
+    opt = default_optimizer()
+    state = shard_train_state(
+        init_train_state(init_params(jax.random.PRNGKey(3), CFG, jnp.float32), opt),
+        mesh, CFG,
+    )
+    step = make_train_step(CFG, opt)
+    t = jax.random.randint(jax.random.PRNGKey(4), (4, 16), 0, CFG.vocab_size)
+    batch = tuple(
+        shard_batch(x, mesh) for x in (t, jnp.roll(t, -1, 1), jnp.ones_like(t, jnp.float32))
+    )
+    _state, metrics = step(state, *batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(float(metrics["grad_norm"]))
+
+
+def test_cache_is_latent_compressed_and_kv_quant_rejected():
+    cache = init_cache(CFG, 2, 64, dtype=jnp.float32)
+    # joint latent column: rank + rope wide, ONE head; dummy v is 1-wide
+    assert cache.k.shape == (CFG.n_layers, 2, 1, CFG.mla_cache_dim, 64)
+    assert cache.v.shape == (CFG.n_layers, 2, 1, 1, 64)
+    mha_bytes = CFG.n_layers * 2 * 2 * CFG.n_heads * (
+        CFG.qk_nope_head_dim + CFG.qk_rope_head_dim
+    ) * 64 * 4
+    assert cache.k.nbytes + cache.v.nbytes < 0.2 * mha_bytes
+    with pytest.raises(ValueError, match="kv_quant"):
+        init_cache(CFG, 2, 64, quantized=True)
+    with pytest.raises(ValueError, match="kv_quant"):
+        generate(
+            PARAMS, jnp.asarray([[1, 2]], jnp.int32), jnp.asarray([2], jnp.int32),
+            CFG, jax.random.PRNGKey(0), max_new_tokens=2, kv_quant=True,
+        )
+
+
+def test_ring_rejected_for_mla(tokens):
+    from prime_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"sp": 2}, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="ring"):
+        forward(PARAMS, tokens, CFG, attn_impl="ring", ring_mesh=mesh)
+
+
+def test_param_count_matches_tree():
+    leaves = jax.tree_util.tree_leaves(PARAMS)
+    assert sum(x.size for x in leaves) == CFG.param_count
+    qcfg = get_config("tiny-mla-qlora")
+    qparams = init_params(jax.random.PRNGKey(0), qcfg, dtype=jnp.float32)
+    assert sum(x.size for x in jax.tree_util.tree_leaves(qparams)) == qcfg.param_count
+
+
+@pytest.mark.parametrize("preset", ["tiny-mla", "tiny-mla-qlora"])
+def test_int8_weights_mla(preset):
+    """int8 quantization covers every MLA projection (wkv_b's scales fold
+    into the absorb/value einsums exactly) and generate still runs."""
+    from prime_tpu.models.quantize import is_quantized, quantize_params_int8
+
+    config = get_config(preset)
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    qparams = quantize_params_int8(params)
+    assert is_quantized(qparams)
+    assert isinstance(qparams["layers"]["wkv_b"], tuple)
+    assert isinstance(qparams["layers"]["wkv_a"], tuple)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 10), 0, config.vocab_size)
+    fp_logits, _ = forward(params, tokens, config)
+    q_logits, _ = forward(qparams, tokens, config)
+    fp_probs = np.asarray(jax.nn.softmax(fp_logits, axis=-1))
+    q_probs = np.asarray(jax.nn.softmax(q_logits, axis=-1))
+    assert np.abs(fp_probs - q_probs).max() < 0.06
+
+    # scale folding is EXACT vs explicitly dequantized weights
+    dequant = dict(params)
+    layers = dict(qparams["layers"])
+    for key, value in layers.items():
+        if isinstance(value, tuple):
+            layers[key] = (value[0].astype(jnp.float32) * value[1]).astype(jnp.float32)
+    dequant["layers"] = layers
+    d_logits, _ = forward(dequant, tokens, config)
+    assert np.abs(np.asarray(q_logits) - np.asarray(d_logits)).max() < 1e-3
+
+    out = generate(
+        qparams, tokens, jnp.full((2,), 10, jnp.int32), config,
+        jax.random.PRNGKey(8), max_new_tokens=4, temperature=0.0,
+    )
+    assert out.tokens.shape == (2, 4)
+
+
+def test_int4_weights_mla_skips_wkv_b():
+    """int4's reduction-axis group scales can't fold through the absorb
+    einsum; wkv_b stays for the int8 pass, everything else goes int4."""
+    from prime_tpu.models.quantize import quantize_params_int4, quantize_params_int8
+
+    params = init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+    q4 = quantize_params_int8(quantize_params_int4(params))
+    assert str(q4["layers"]["wq"][0].dtype) == "int4"
+    assert str(q4["layers"]["wkv_b"][0].dtype) == "int8"
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 8), 0, CFG.vocab_size)
+    logits, _ = forward(q4, tokens, CFG)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_unsupported_attention_features_rejected():
+    """Per-head attention features have no latent-form equivalent: loud
+    error, not silently different numerics."""
+    bad = CFG.scaled(sliding_window=64, sliding_pattern="uniform")
+    params = init_params(jax.random.PRNGKey(0), bad, dtype=jnp.float32)
+    tokens = jnp.asarray([[1, 2, 3]], jnp.int32)
+    with pytest.raises(ValueError, match="sliding_window"):
+        forward(params, tokens, bad)
+    with pytest.raises(ValueError, match="attn_softcap"):
+        forward(params, tokens, CFG.scaled(attn_softcap=50.0))
