@@ -92,6 +92,18 @@ class ModelConfig:
     # gate clamped above and up clamped both ways at this limit (0 = plain
     # silu gating)
     moe_glu_clamp: float = 0.0
+    # --- DeepSeekMoE knobs ---
+    # always-on shared expert(s): a dense silu MLP of width
+    # n_shared_experts * d_ff added to every token's routed output
+    n_shared_experts: int = 0
+    # routing score function: "softmax" (Mixtral/Qwen) | "sigmoid"
+    # (DeepSeek-V3 independent per-expert scores)
+    moe_score_func: str = "softmax"
+    # learned selection-only bias (V3 aux-loss-free balancing: shifts WHICH
+    # experts are picked, never the gate values)
+    moe_score_bias: bool = False
+    # multiplier on the final routed combine weights (routed_scaling_factor)
+    routed_scaling_factor: float = 1.0
 
     # --- DeepSeek-style multi-head latent attention (MLA) ---
     # kv_lora_rank set => MLA: K/V live as ONE shared per-token latent
@@ -158,6 +170,10 @@ class ModelConfig:
             mlp = self.n_experts * 3 * self.d_model * self.d_ff + self.d_model * self.n_experts
             if self.moe_bias:
                 mlp += self.n_experts * (2 * self.d_ff + self.d_model) + self.n_experts
+            if self.moe_score_bias:
+                mlp += self.n_experts
+            if self.n_shared_experts:
+                mlp += 3 * self.d_model * self.n_shared_experts * self.d_ff
         else:
             mlp = 3 * self.d_model * self.d_ff
         norms = ((2 if self.pre_norms else 0) + (2 if self.post_norms else 0)) * self.d_model
@@ -672,6 +688,32 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         n_experts=4,
         experts_per_token=2,
         capacity_factor=2.0,
+    ),
+    # DeepSeek-V3 architecture at test scale: MLA + sigmoid-scored routing
+    # with a selection-only balance bias, routed scaling, and an always-on
+    # shared expert (first_k_dense_replace is the one V3 structural feature
+    # not modeled — the uniform layer scan has no mixed dense/MoE layers)
+    "tiny-deepseek": ModelConfig(
+        name="tiny-deepseek",
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=4,
+        d_ff=64,              # per-expert width (fine-grained experts)
+        max_seq_len=512,
+        kv_lora_rank=32,
+        q_lora_rank=48,
+        qk_rope_head_dim=16,
+        qk_nope_head_dim=32,
+        v_head_dim=32,
+        n_experts=8,
+        experts_per_token=2,
+        capacity_factor=2.0,
+        n_shared_experts=2,
+        moe_score_func="sigmoid",
+        moe_score_bias=True,
+        routed_scaling_factor=2.5,
     ),
     # GPT-OSS architecture at test scale: sinks + biased clamped-GLU MoE +
     # alternating window + non-truncated yarn, all exercised on CPU

@@ -25,8 +25,8 @@ from prime_tpu.models.config import ModelConfig
 
 # model_type values whose math this loader reproduces exactly. Families that
 # SHARE Llama state-dict key names but need different math — gemma v1
-# ((1+w) norms + sqrt(d) embed scale + GeGLU), deepseek (MLA), etc. — must
-# fail loudly here rather than load and silently produce garbage logits.
+# ((1+w) norms + sqrt(d) embed scale + GeGLU), etc. — must fail loudly here
+# rather than load and silently produce garbage logits.
 SUPPORTED_MODEL_TYPES = frozenset(
     {
         "llama",
@@ -41,6 +41,7 @@ SUPPORTED_MODEL_TYPES = frozenset(
         "phi3",
         "olmo2",
         "gpt_oss",
+        "deepseek_v3",
     }
 )
 
@@ -73,8 +74,63 @@ def _gemma3_sliding_pattern(hf_config: Any) -> str:
     return f"{int(pattern) - 1}:1"
 
 
+def _deepseek_config_from_hf(hf_config: Any, name: str) -> ModelConfig:
+    """DeepSeek-V3: MLA + sigmoid-scored MoE with selection bias + shared
+    experts. Structural features this stack doesn't model are rejected
+    loudly: a dense-layer prefix (first_k_dense_replace > 0 — the uniform
+    layer scan has no mixed dense/MoE layers) and node-limited group routing
+    (n_group > 1)."""
+    first_dense = int(getattr(hf_config, "first_k_dense_replace", 0) or 0)
+    if first_dense:
+        raise ValueError(
+            f"deepseek_v3 first_k_dense_replace={first_dense} is not modeled "
+            "(this stack's layer scan is uniform — no dense-prefix layers)"
+        )
+    n_group = int(getattr(hf_config, "n_group", 1) or 1)
+    if n_group > 1:
+        raise ValueError(
+            f"deepseek_v3 n_group={n_group} (node-limited group routing) is "
+            "not modeled; only n_group=1 checkpoints load"
+        )
+    if getattr(hf_config, "rope_scaling", None):
+        raise ValueError("deepseek_v3 rope_scaling is not wired for MLA yet")
+    scoring = getattr(hf_config, "scoring_func", "sigmoid") or "sigmoid"
+    return ModelConfig(
+        name=name,
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_attention_heads,  # MLA has no GQA grouping
+        d_ff=int(getattr(hf_config, "moe_intermediate_size", 0) or hf_config.intermediate_size),
+        max_seq_len=min(int(getattr(hf_config, "max_position_embeddings", 8192) or 8192), 32768),
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        rms_eps=getattr(hf_config, "rms_norm_eps", 1e-6),
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        kv_lora_rank=int(hf_config.kv_lora_rank),
+        q_lora_rank=(
+            int(hf_config.q_lora_rank) if getattr(hf_config, "q_lora_rank", None) else None
+        ),
+        qk_rope_head_dim=int(hf_config.qk_rope_head_dim),
+        qk_nope_head_dim=int(hf_config.qk_nope_head_dim),
+        v_head_dim=int(hf_config.v_head_dim),
+        n_experts=int(getattr(hf_config, "n_routed_experts", 0) or 0),
+        experts_per_token=int(getattr(hf_config, "num_experts_per_tok", 8) or 8),
+        n_shared_experts=int(getattr(hf_config, "n_shared_experts", 0) or 0),
+        moe_score_func=scoring,
+        moe_score_bias=True,  # the e_score_correction_bias buffer always ships
+        routed_scaling_factor=float(getattr(hf_config, "routed_scaling_factor", 1.0) or 1.0),
+        norm_topk=bool(getattr(hf_config, "norm_topk_prob", True)),
+        # HF routing is dropless; give capacity routing the same headroom
+        # every other HF MoE gets (advisor r3)
+        **({"capacity_factor": 2.0} if getattr(hf_config, "n_routed_experts", 0) else {}),
+    )
+
+
 def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
     model_type = getattr(hf_config, "model_type", "") or ""
+    if model_type == "deepseek_v3":
+        return _deepseek_config_from_hf(hf_config, name)
     if model_type == "gemma3":
         # multimodal wrapper config: the text tower is what this loader maps
         # (vision weights are ignored by params_from_state_dict's key lookup)
@@ -360,9 +416,15 @@ def _read_state_dict(checkpoint_dir: str | Path) -> dict[str, np.ndarray]:
 
 
 def params_from_state_dict(
-    state: dict[str, np.ndarray], config: ModelConfig, dtype=jnp.bfloat16
+    state: dict[str, np.ndarray], config: ModelConfig, dtype=jnp.bfloat16,
+    rope_interleave: bool = False,
 ) -> dict[str, Any]:
-    """Convert an HF LlamaForCausalLM state dict to the stacked param pytree."""
+    """Convert an HF LlamaForCausalLM state dict to the stacked param pytree.
+
+    ``rope_interleave`` (DeepSeek checkpoints): the rope sub-head's features
+    are stored pair-interleaved; the loader de-interleaves the PRODUCING
+    weight columns once so the runtime uses the standard rotate-half rope
+    with no per-step permute."""
 
     def get(name: str) -> np.ndarray:
         # bare → LlamaForCausalLM → Gemma3 multimodal text-tower prefixes
@@ -480,6 +542,30 @@ def params_from_state_dict(
             "w_up": stacked_experts(up_t),
             "w_down": stacked_experts(down_t),
         }
+        if config.moe_score_bias:
+            # DeepSeek-V3 aux-free balance bias (a buffer on the gate)
+            mlp_weights["score_bias"] = jnp.asarray(
+                np.stack(
+                    [
+                        get(f"layers.{layer}.mlp.gate.e_score_correction_bias")
+                        for layer in range(config.n_layers)
+                    ]
+                ),
+                dtype=jnp.float32,
+            )
+        if config.n_shared_experts:
+            # DeepSeekMoE always-on shared expert (one fused dense MLP)
+            mlp_weights |= {
+                "w_shared_gate": stacked(
+                    "layers.{}.mlp.shared_experts.gate_proj.weight", transpose=True
+                ),
+                "w_shared_up": stacked(
+                    "layers.{}.mlp.shared_experts.up_proj.weight", transpose=True
+                ),
+                "w_shared_down": stacked(
+                    "layers.{}.mlp.shared_experts.down_proj.weight", transpose=True
+                ),
+            }
     elif present("layers.0.mlp.gate_up_proj.weight"):
         # Phi3 fused MLP: gate rows then up rows
         mlp_weights = {
@@ -553,7 +639,53 @@ def params_from_state_dict(
             "attn_norm": stacked("layers.{}.input_layernorm.weight", transpose=False),
             "mlp_norm": stacked("layers.{}.post_attention_layernorm.weight", transpose=False),
         }
-    if present("layers.0.self_attn.qkv_proj.weight"):
+    if config.mla:
+        # DeepSeek MLA: q (direct or low-rank) + kv_a (latent+rope, MQA) +
+        # kv_b (per-head nope/value halves). HF's rope_interleave stores the
+        # rope features pair-interleaved ([x0,y0,x1,y1,...]); de-interleave
+        # the producing columns so standard rotate-half rope applies.
+        nope, rope = config.qk_nope_head_dim, config.qk_rope_head_dim
+        perm = np.concatenate([np.arange(0, rope, 2), np.arange(1, rope, 2)])
+
+        def deinterleave_q(w: np.ndarray) -> np.ndarray:
+            # w (in, H*(nope+rope)): permute each head's rope columns
+            if not rope_interleave:
+                return w
+            w = w.copy()
+            for head in range(config.n_heads):
+                base = head * (nope + rope) + nope
+                w[:, base : base + rope] = w[:, base + perm]
+            return w
+
+        def deinterleave_kpe(w: np.ndarray) -> np.ndarray:
+            # w (in, rank+rope): permute the trailing shared-rope columns
+            if not rope_interleave:
+                return w
+            w = w.copy()
+            base = config.kv_lora_rank
+            w[:, base : base + rope] = w[:, base + perm]
+            return w
+
+        def stacked_via(template: str, fix) -> jnp.ndarray:
+            return jnp.asarray(
+                np.stack([fix(get(template.format(i)).T) for i in range(config.n_layers)]),
+                dtype=dtype,
+            )
+
+        attn_weights = {
+            "wkv_a": stacked_via("layers.{}.self_attn.kv_a_proj_with_mqa.weight", deinterleave_kpe),
+            "kv_a_norm": stacked("layers.{}.self_attn.kv_a_layernorm.weight", transpose=False),
+            "wkv_b": stacked("layers.{}.self_attn.kv_b_proj.weight", transpose=True),
+        }
+        if config.q_lora_rank is not None:
+            attn_weights |= {
+                "wq_a": stacked("layers.{}.self_attn.q_a_proj.weight", transpose=True),
+                "q_a_norm": stacked("layers.{}.self_attn.q_a_layernorm.weight", transpose=False),
+                "wq_b": stacked_via("layers.{}.self_attn.q_b_proj.weight", deinterleave_q),
+            }
+        else:
+            attn_weights["wq"] = stacked_via("layers.{}.self_attn.q_proj.weight", deinterleave_q)
+    elif present("layers.0.self_attn.qkv_proj.weight"):
         # Phi3 fused attention: q rows, then k rows, then v rows
         q_rows = config.n_heads * config.head_dim
         kv_rows = config.n_kv_heads * config.head_dim
@@ -603,4 +735,17 @@ def load_hf_checkpoint(
 
     config = config_from_hf(_Cfg(hf_cfg_raw), name=checkpoint_dir.name)
     state = _read_state_dict(checkpoint_dir)
-    return params_from_state_dict(state, config, dtype=dtype), config
+    return (
+        params_from_state_dict(
+            state, config, dtype=dtype,
+            # transformers' DeepseekV3Config DEFAULTS rope_interleave to
+            # True — a config.json that omits the key still means
+            # interleaved weights, so the fallback must track that default
+            rope_interleave=bool(
+                hf_cfg_raw.get(
+                    "rope_interleave", hf_cfg_raw.get("model_type") == "deepseek_v3"
+                )
+            ),
+        ),
+        config,
+    )

@@ -119,7 +119,7 @@ def _norm(x: jnp.ndarray, weight: jnp.ndarray, config: ModelConfig) -> jnp.ndarr
 
 def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Params:
     """Random init (truncated-normal-ish scaled); checkpoint loaders overwrite."""
-    keys = jax.random.split(rng, 10)
+    keys = jax.random.split(rng, 14)
     d, hd = config.d_model, config.head_dim
     h, kh, ff, layers = config.n_heads, config.n_kv_heads, config.d_ff, config.n_layers
     # Gemma-style (1+w) norms are zero-initialized (≡ unit scale)
@@ -143,6 +143,16 @@ def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Para
                 "b_gate": jnp.zeros((layers, experts, ff), dtype=dtype),
                 "b_up": jnp.zeros((layers, experts, ff), dtype=dtype),
                 "b_down": jnp.zeros((layers, experts, d), dtype=dtype),
+            }
+        if config.moe_score_bias:  # DeepSeek-V3 aux-free balance bias (fp32,
+            # selection-only — updated out-of-band, not by the loss)
+            mlp_weights["score_bias"] = jnp.zeros((layers, experts), dtype=jnp.float32)
+        if config.n_shared_experts:  # DeepSeekMoE always-on shared expert(s)
+            sf = config.n_shared_experts * ff
+            mlp_weights |= {
+                "w_shared_gate": dense(keys[10], (layers, d, sf), d),
+                "w_shared_up": dense(keys[11], (layers, d, sf), d),
+                "w_shared_down": dense(keys[12], (layers, sf, d), sf),
             }
     else:
         mlp_weights = {
@@ -419,7 +429,15 @@ def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> tuple[jnp.nda
             b_up=lp.get("b_up"),
             b_down=lp.get("b_down"),
             glu_clamp=config.moe_glu_clamp,
+            score_func=config.moe_score_func,
+            select_bias=lp.get("score_bias"),
+            routed_scale=config.routed_scaling_factor,
         )
+        if "w_shared_gate" in lp:
+            # DeepSeekMoE shared expert(s): a dense always-on silu MLP added
+            # to the routed output (every token, no capacity, no routing)
+            shared_gate = jax.nn.silu(_mm(normed, lp["w_shared_gate"]))
+            y = y + _mm(shared_gate * _mm(normed, lp["w_shared_up"]), lp["w_shared_down"])
         if "mlp_post_norm" in lp:
             y = _norm(y, lp["mlp_post_norm"], config)
         return x + y, aux

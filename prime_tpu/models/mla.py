@@ -61,7 +61,10 @@ def init_mla_attn_params(keys, config: ModelConfig, dtype, dense) -> dict:
         weights |= {
             "wq_a": dense(keys[1], (layers, d, qr), d),
             "q_a_norm": jnp.ones((layers, qr), dtype=dtype),
-            "wq_b": dense(keys[5], (layers, qr, h * (nope + rope)), qr),
+            # keys[13]: every lower index belongs to a llama.init_params
+            # weight (5/6/7 are the MLP stack) — sharing one would correlate
+            # the two matrices at from-scratch init
+            "wq_b": dense(keys[13], (layers, qr, h * (nope + rope)), qr),
         }
     else:
         weights["wq"] = dense(keys[1], (layers, d, h * (nope + rope)), d)

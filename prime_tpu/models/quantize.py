@@ -34,6 +34,8 @@ QUANTIZED_LAYER_KEYS = (
     # wkv_b's absorb/value einsums fold the per-output-channel int8 scales
     # themselves (_split_wkv_b)
     "wkv_a", "wq_a", "wq_b", "wkv_b",
+    # DeepSeekMoE always-on shared expert (dense path through matmul())
+    "w_shared_gate", "w_shared_up", "w_shared_down",
 )
 
 

@@ -30,6 +30,9 @@ def top_k_routing(
     capacity: int,
     valid: jnp.ndarray | None = None,  # (T,) 1.0 for real tokens
     norm_topk: bool = True,
+    score_func: str = "softmax",       # "softmax" | "sigmoid" (DeepSeek-V3)
+    select_bias: jnp.ndarray | None = None,  # (E,) selection-only bias
+    routed_scale: float = 1.0,         # DeepSeek routed_scaling_factor
 ):
     """Returns (dispatch (T, E, C), combine (T, E, C), aux_loss scalar).
 
@@ -40,14 +43,23 @@ def top_k_routing(
     (norm_topk_prob=False checkpoints). ``valid`` masks padding tokens out
     of routing entirely — they take no capacity slot and contribute nothing
     to the aux loss statistics.
+
+    DeepSeek-V3 routing: ``score_func='sigmoid'`` scores each expert
+    independently; ``select_bias`` (the aux-loss-free balancing bias) shifts
+    WHICH experts are chosen but never the gate values; ``routed_scale``
+    multiplies the final combine weights.
     """
     tokens, n_experts = router_logits.shape
-    probs = jax.nn.softmax(router_logits, axis=-1)  # (T, E)
+    if score_func == "sigmoid":
+        probs = jax.nn.sigmoid(router_logits)
+    else:
+        probs = jax.nn.softmax(router_logits, axis=-1)  # (T, E)
+    selection = probs if select_bias is None else probs + select_bias.astype(probs.dtype)
 
     # iterative top-k (k is 1 or 2 in practice; unrolled, fully static)
     expert_masks = []
     gate_values = []
-    masked = probs
+    masked = selection
     for _ in range(k):
         choice = jnp.argmax(masked, axis=-1)                       # (T,)
         one_hot = jax.nn.one_hot(choice, n_experts, dtype=probs.dtype)
@@ -55,13 +67,18 @@ def top_k_routing(
             one_hot = one_hot * valid[:, None]
         expert_masks.append(one_hot)
         gate_values.append(jnp.sum(probs * one_hot, axis=-1))      # (T,)
-        masked = masked * (1.0 - one_hot)
+        # exclude by -inf, NOT by zeroing: a selection bias can drive every
+        # non-chosen score negative, where a zeroed winner would stay the
+        # argmax and be picked twice
+        masked = jnp.where(one_hot > 0, -jnp.inf, masked)
 
     gate_stack = jnp.stack(gate_values, axis=-1)                   # (T, k)
-    if norm_topk:  # chosen gates sum to 1 per token (Mixtral style)
+    if norm_topk:  # chosen gates sum to 1 per token (Mixtral / DeepSeek-V3)
         gate_stack = gate_stack / jnp.maximum(
             jnp.sum(gate_stack, axis=-1, keepdims=True), 1e-9
         )
+    if routed_scale != 1.0:
+        gate_stack = gate_stack * routed_scale
 
     # capacity positions: for each expert, tokens are served in order; a
     # token's slot is its cumulative index among tokens routed to that expert
@@ -81,13 +98,20 @@ def top_k_routing(
         combine = combine + routed * gate_stack[:, choice_index][:, None, None]
 
     # Switch aux loss: E * Σ_e (token fraction to e) * (mean router prob of e)
+    # — sigmoid scores don't sum to 1 per token, so normalize them for the
+    # balance statistic (DeepSeek's seq-aux formulation does the same)
     denom = jnp.sum(valid) if valid is not None else float(tokens)
     denom = jnp.maximum(denom, 1.0)
     token_fraction = jnp.sum(expert_masks[0], axis=0) / denom
+    stat_probs = (
+        probs / jnp.maximum(jnp.sum(probs, axis=-1, keepdims=True), 1e-9)
+        if score_func == "sigmoid"
+        else probs
+    )
     if valid is not None:
-        mean_prob = jnp.sum(probs * valid[:, None], axis=0) / denom
+        mean_prob = jnp.sum(stat_probs * valid[:, None], axis=0) / denom
     else:
-        mean_prob = jnp.mean(probs, axis=0)
+        mean_prob = jnp.mean(stat_probs, axis=0)
     aux_loss = n_experts * jnp.sum(token_fraction * mean_prob)
     return dispatch, combine, aux_loss
 
@@ -111,6 +135,9 @@ def moe_mlp(
     b_down: jnp.ndarray | None = None,    # (E, D)
     glu_clamp: float = 0.0,               # GPT-OSS clamped GLU (limit 7.0)
     glu_alpha: float = 1.702,             # sigmoid temperature for the clamped GLU
+    score_func: str = "softmax",          # DeepSeek-V3: "sigmoid"
+    select_bias: jnp.ndarray | None = None,  # (E,) selection-only balance bias
+    routed_scale: float = 1.0,            # DeepSeek routed_scaling_factor
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Sparse MoE feed-forward. Returns (output (B, S, D), aux_loss).
 
@@ -141,7 +168,11 @@ def moe_mlp(
         router_logits = router_logits + router_b.astype(jnp.float32)
     capacity = expert_capacity(group, n_experts, k, capacity_factor)
     dispatch, combine, aux_loss = jax.vmap(
-        lambda logits, v: top_k_routing(logits, k, capacity, valid=v, norm_topk=norm_topk)
+        lambda logits, v: top_k_routing(
+            logits, k, capacity, valid=v, norm_topk=norm_topk,
+            score_func=score_func, select_bias=select_bias,
+            routed_scale=routed_scale,
+        )
     )(router_logits, valid)
     dispatch = dispatch.astype(x.dtype)   # (g, group, E, C)
     combine = combine.astype(x.dtype)

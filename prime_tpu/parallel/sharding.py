@@ -40,6 +40,15 @@ def param_specs(config: ModelConfig) -> dict[str, Any]:
                 "b_up": P(None, "ep", "tp"),
                 "b_down": P(None, "ep", "fsdp"),
             }
+        if config.moe_score_bias:
+            mlp_specs["score_bias"] = P(None, None)  # tiny fp32: replicate
+        if config.n_shared_experts:
+            # the shared expert is a dense MLP: megatron layout, no ep axis
+            mlp_specs |= {
+                "w_shared_gate": P(None, "fsdp", "tp"),
+                "w_shared_up": P(None, "fsdp", "tp"),
+                "w_shared_down": P(None, "tp", "fsdp"),
+            }
     else:
         mlp_specs = {
             "w_gate": P(None, "fsdp", "tp"),

@@ -952,7 +952,7 @@ def test_config_from_hf_rejects_unsupported_model_type():
         num_attention_heads = 4
         intermediate_size = 256
 
-    for bad in ("gemma", "falcon", "deepseek_v3"):
+    for bad in ("gemma", "falcon", "deepseek_v2"):  # v3 loads now (test_mla)
         Cfg.model_type = bad
         with pytest.raises(ValueError, match="Unsupported model_type"):
             config_from_hf(Cfg())

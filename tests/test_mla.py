@@ -251,3 +251,126 @@ def test_unsupported_attention_features_rejected():
         forward(params, tokens, bad)
     with pytest.raises(ValueError, match="attn_softcap"):
         forward(params, tokens, CFG.scaled(attn_softcap=50.0))
+
+
+# -- HF DeepSeek-V3 parity ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def deepseek_model():
+    import torch
+    import transformers
+
+    cfg = transformers.DeepseekV3Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        moe_intermediate_size=48,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=4,
+        kv_lora_rank=32,
+        q_lora_rank=48,
+        qk_rope_head_dim=16,
+        qk_nope_head_dim=32,
+        v_head_dim=32,
+        n_routed_experts=8,
+        num_experts_per_tok=2,
+        n_shared_experts=1,
+        n_group=1,
+        topk_group=1,
+        first_k_dense_replace=0,
+        routed_scaling_factor=2.5,
+        norm_topk_prob=True,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        rope_scaling=None,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(31)
+    model = transformers.DeepseekV3ForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_deepseek_v3_logits_match_transformers(deepseek_model):
+    """The full V3 stack at once — MLA (low-rank q, interleaved rope
+    de-interleaved at load), sigmoid routing with the e_score bias, routed
+    scaling, shared expert — pinned against transformers' reference."""
+    import torch
+
+    from prime_tpu.models.hf_loader import config_from_hf, params_from_state_dict
+
+    state = {k: v.float().numpy() for k, v in deepseek_model.state_dict().items()}
+    config = config_from_hf(deepseek_model.config, name="tiny-ds-hf")
+    assert config.mla and config.moe_score_func == "sigmoid"
+    assert config.n_shared_experts == 1 and config.routed_scaling_factor == 2.5
+    params = params_from_state_dict(
+        state, config, dtype=jnp.float32,
+        rope_interleave=bool(getattr(deepseek_model.config, "rope_interleave", False)),
+    )
+    tokens = np.array([[3, 17, 200, 45, 9, 88, 121, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = deepseek_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    ours, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=5e-4, atol=5e-4)
+
+
+def test_deepseek_v3_greedy_matches_transformers(deepseek_model):
+    import torch
+
+    from prime_tpu.models.hf_loader import config_from_hf, params_from_state_dict
+
+    state = {k: v.float().numpy() for k, v in deepseek_model.state_dict().items()}
+    config = config_from_hf(deepseek_model.config, name="tiny-ds-hf")
+    params = params_from_state_dict(
+        state, config, dtype=jnp.float32,
+        rope_interleave=bool(getattr(deepseek_model.config, "rope_interleave", False)),
+    )
+    prompt = np.array([[5, 42, 100, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_out = deepseek_model.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=8, do_sample=False, eos_token_id=None, pad_token_id=0,
+        ).numpy()[0, 4:]
+    ours = generate(
+        params, jnp.asarray(prompt), jnp.asarray([4], jnp.int32), config,
+        jax.random.PRNGKey(0), max_new_tokens=8, temperature=0.0,
+    ).tokens[0]
+    assert np.asarray(ours).tolist() == hf_out.tolist()
+
+
+def test_deepseek_v3_unmodeled_features_rejected():
+    from prime_tpu.models.hf_loader import config_from_hf
+
+    class Cfg:
+        model_type = "deepseek_v3"
+        vocab_size = 256
+        hidden_size = 64
+        intermediate_size = 128
+        num_hidden_layers = 2
+        num_attention_heads = 4
+        kv_lora_rank = 32
+        q_lora_rank = None
+        qk_rope_head_dim = 16
+        qk_nope_head_dim = 32
+        v_head_dim = 32
+        n_routed_experts = 8
+        first_k_dense_replace = 0
+        n_group = 1
+        rope_scaling = None
+
+    ok = config_from_hf(Cfg())
+    assert ok.mla and ok.q_lora_rank is None
+
+    dense_prefix = Cfg()
+    dense_prefix.first_k_dense_replace = 3
+    with pytest.raises(ValueError, match="first_k_dense_replace"):
+        config_from_hf(dense_prefix)
+
+    grouped = Cfg()
+    grouped.n_group = 4
+    with pytest.raises(ValueError, match="n_group"):
+        config_from_hf(grouped)
