@@ -729,6 +729,56 @@ def main() -> None:
         print(f"# bench: longctx section failed: {e}", flush=True)
     print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
+    # ---- mlactx: MLA latent-cache decode at long context --------------------
+    # The round-5 architecture lever: a 1.16B MLA model (DeepSeek-V2 dims,
+    # rank-512 latent) decodes at the same long context as the llama longctx
+    # section. Per token per layer the latent streams 577*2 ≈ 1.1 KiB vs the
+    # 8 KiB its own 16x(128+64) heads would need as full K/V (7x) and the
+    # 2 KiB of llama3.2-1b's already-GQA-compressed 8x64 cache (1.7x) —
+    # MLA reaches GQA-class cache size WITHOUT sharing heads, at 16 full-
+    # width query heads.
+    try:
+        mla_cfg = get_config("tiny-mla" if SMOKE else "mla-1b")
+        mla_params = init_params(jax.random.PRNGKey(30), mla_cfg, dtype=jnp.bfloat16)
+        mb, mp, mn = (2, 120, 8) if SMOKE else (4, 4032, 64)
+        mla_prompts = jax.random.randint(
+            jax.random.PRNGKey(31), (mb, mp), 1, mla_cfg.vocab_size
+        )
+
+        def run_mla():
+            result = generate(
+                mla_params, mla_prompts,
+                jnp.full((mb,), mp, dtype=jnp.int32), mla_cfg,
+                jax.random.PRNGKey(32), max_new_tokens=mn, temperature=0.0,
+            )
+            float(jnp.sum(result.tokens))
+
+        mla_s = time_fn(run_mla, iterations=2)
+        record["mlactx_tok_s"] = round(mb * mn / mla_s, 1)
+        mla_param_bytes = _tree_bytes(mla_params)
+        record["mlactx_param_gb"] = round(mla_param_bytes / 1e9, 3)
+        record["mlactx_cache_gb_per_4k_seq"] = round(
+            mla_cfg.n_layers * (mla_cfg.mla_cache_dim + 1) * 2 * 4096 / 1e9, 4
+        )
+        # roofline over the full gen time (long prefill included → lower
+        # bound); the latent cache streams twice per step (K and V reads
+        # share the array) plus the 1-wide dummy
+        mla_slot = mla_cfg.n_layers * (2 * mla_cfg.mla_cache_dim + 1) * 2
+        per_step = mla_param_bytes + mb * mla_slot * (mp + mn / 2)
+        mla_gbs = per_step * mn / mla_s / 1e9
+        record["mlactx_hbm_gbs"] = round(mla_gbs, 1)
+        record["mlactx_hbm_pct_peak"] = round(100.0 * mla_gbs / V5E_HBM_GBS, 1)
+        print(
+            f"# bench: mlactx C={mp + mn} {record['mlactx_tok_s']} tok/s "
+            f"(latent cache, ~{record['mlactx_hbm_pct_peak']}% HBM peak)",
+            flush=True,
+        )
+        del mla_params
+    except Exception as e:  # noqa: BLE001
+        record["mlactx_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: mlactx section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
+
     # ---- winctx: sliding-window flash decode at long context ----------------
     # The round-4 kernel variant: a sliding layer's decode step front-skips
     # cache blocks before the window, so it streams ~window slots instead of

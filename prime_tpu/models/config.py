@@ -644,6 +644,24 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         d_ff=256,
         max_seq_len=512,
     ),
+    # ~1B dense model with DeepSeek-V2-dimension MLA (rank 512 latent, 64
+    # rope, 128 nope/value heads): the bench model for the latent-cache
+    # long-context story — its decode cache is ~9x smaller than a
+    # GQA model's at the same context
+    "mla-1b": ModelConfig(
+        name="mla-1b",
+        vocab_size=32000,
+        d_model=2048,
+        n_layers=16,
+        n_heads=16,
+        n_kv_heads=16,
+        d_ff=8192,
+        max_seq_len=8192,
+        kv_lora_rank=512,
+        qk_rope_head_dim=64,
+        qk_nope_head_dim=128,
+        v_head_dim=128,
+    ),
     # DeepSeek-V2-Lite-shaped MLA at test scale: direct query projection
     # (q_lora_rank=None), shared-latent KV cache, absorbed decode
     "tiny-mla": ModelConfig(

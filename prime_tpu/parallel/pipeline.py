@@ -45,17 +45,44 @@ def pipeline_param_specs(config: ModelConfig) -> dict:
                 "b_up": P("pp", None, None),
                 "b_down": P("pp", None, None),
             }
+        if config.moe_score_bias:  # DeepSeek-V3 balance bias
+            mlp_spec |= {"score_bias": P("pp", None)}
+        if config.n_shared_experts:  # DeepSeekMoE shared expert (dense MLP)
+            mlp_spec |= {
+                "w_shared_gate": P("pp", None, None),
+                "w_shared_up": P("pp", None, None),
+                "w_shared_down": P("pp", None, None),
+            }
     else:
         mlp_spec = {
             "w_gate": P("pp", None, None),
             "w_up": P("pp", None, None),
             "w_down": P("pp", None, None),
         }
+    if config.mla:
+        attn_spec = {
+            "wkv_a": P("pp", None, None),
+            "kv_a_norm": P("pp", None),
+            "wkv_b": P("pp", None, None),
+            "wo": P("pp", None, None),
+        }
+        if config.q_lora_rank is not None:
+            attn_spec |= {
+                "wq_a": P("pp", None, None),
+                "q_a_norm": P("pp", None),
+                "wq_b": P("pp", None, None),
+            }
+        else:
+            attn_spec["wq"] = P("pp", None, None)
+    else:
+        attn_spec = {
+            "wq": P("pp", None, None),
+            "wk": P("pp", None, None),
+            "wv": P("pp", None, None),
+            "wo": P("pp", None, None),
+        }
     layer_spec = {
-        "wq": P("pp", None, None),
-        "wk": P("pp", None, None),
-        "wv": P("pp", None, None),
-        "wo": P("pp", None, None),
+        **attn_spec,
         **mlp_spec,
     }
     if config.pre_norms:
@@ -95,10 +122,17 @@ def _stage_forward(
     def layer_fn(carry, scanned):
         x, aux_sum = carry
         lp, sliding = scanned
-        x, _, _, _, _ = _attention_block(
-            x, lp, positions, rope_tables, config, None, None, None, False, "xla",
-            sliding=sliding, rope_tables_local=rope_tables_local,
-        )
+        if config.mla:
+            from prime_tpu.models.mla import mla_attention_block
+
+            x, _, _, _, _ = mla_attention_block(
+                x, lp, positions, rope_tables, config, None, None, None, False, "xla"
+            )
+        else:
+            x, _, _, _, _ = _attention_block(
+                x, lp, positions, rope_tables, config, None, None, None, False, "xla",
+                sliding=sliding, rope_tables_local=rope_tables_local,
+            )
         x, aux = _mlp_block(x, lp, config)
         return (x, aux_sum + aux), None
 
@@ -135,7 +169,9 @@ def pipeline_forward(
     x_mb = x.reshape(n_microbatches, micro, seq, x.shape[-1])
     positions = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None, :], (micro, seq))
     rope_tables = rope_frequencies(
-        config.head_dim, max(seq, config.max_seq_len), config.rope_theta,
+        # MLA ropes only the shared qk_rope sub-head (mirrors llama.forward)
+        config.qk_rope_head_dim if config.mla else config.head_dim,
+        max(seq, config.max_seq_len), config.rope_theta,
         # must match forward()'s rope math exactly (incl. the round-4
         # families: non-truncated yarn, LongRoPE, partial rotary; the
         # no-cache path selects LongRoPE factors by seq)

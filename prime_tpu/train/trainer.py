@@ -70,9 +70,24 @@ def default_optimizer(
     moments stay fp32 even with bf16 params (optax has no nu_dtype knob, and
     nu accumulates squared gradients — exactly what bf16's ~3 significant
     digits destroy)."""
+    def decay_mask(tree):
+        # DeepSeek-V3's score_bias is a SELECTION-ONLY buffer: it has zero
+        # gradient (it only feeds argmax), so with unmasked AdamW each step
+        # would be pure decay, exponentially erasing a loaded checkpoint's
+        # routing balance. Everything else keeps the standard decay.
+        def keep(path, _x):
+            return not any(
+                getattr(key, "key", None) == "score_bias" for key in path
+            )
+
+        return jax.tree_util.tree_map_with_path(keep, tree)
+
     return optax.chain(
         optax.clip_by_global_norm(max_grad_norm),
-        optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=weight_decay),
+        optax.adamw(
+            learning_rate, b1=0.9, b2=0.95, weight_decay=weight_decay,
+            mask=decay_mask,
+        ),
     )
 
 

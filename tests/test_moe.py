@@ -420,3 +420,55 @@ def test_negative_selection_bias_never_double_picks():
     per_expert = np.asarray(dispatch).sum(-1)[0]  # how often each expert chosen
     assert per_expert.max() <= 1.0, per_expert    # no expert picked twice
     assert per_expert.sum() == 2.0                # two DISTINCT experts
+
+
+def test_score_bias_survives_training_steps():
+    """The selection-only bias has zero gradient; unmasked AdamW decay would
+    erase it. The optimizer's decay mask must leave it untouched."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.train import default_optimizer, init_train_state, make_train_step
+
+    cfg = get_config("tiny-deepseek")
+    params = init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    params["layers"]["score_bias"] = params["layers"]["score_bias"] + 0.25
+    opt = default_optimizer()
+    state = init_train_state(params, opt)
+    step = make_train_step(cfg, opt)
+    t = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    for _ in range(3):
+        state, _metrics = step(state, t, jnp.roll(t, -1, 1), jnp.ones_like(t, jnp.float32))
+    np.testing.assert_allclose(
+        np.asarray(state.params["layers"]["score_bias"]), 0.25, rtol=1e-6
+    )
+
+
+def test_tiny_deepseek_pipeline_train_step():
+    """MLA + DeepSeekMoE staged over pp: specs cover the new keys and the
+    stage forward routes through the MLA block."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.parallel.mesh import make_mesh
+    from prime_tpu.parallel.pipeline import (
+        make_pipeline_train_step,
+        shard_pipeline_params,
+    )
+    from prime_tpu.train import default_optimizer, init_train_state
+
+    cfg = get_config("tiny-deepseek")  # 2 layers -> 2 stages
+    mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    opt = default_optimizer()
+    params = shard_pipeline_params(init_params(jax.random.PRNGKey(0), cfg, jnp.float32), mesh, cfg)
+    state = init_train_state(params, opt)
+    step = make_pipeline_train_step(cfg, opt, mesh, n_microbatches=2)
+    t = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size)
+    _state, metrics = step(state, t, jnp.roll(t, -1, 1), jnp.ones_like(t, jnp.float32))
+    assert np.isfinite(float(metrics["loss"]))
