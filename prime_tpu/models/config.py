@@ -104,6 +104,11 @@ class ModelConfig:
     moe_score_bias: bool = False
     # multiplier on the final routed combine weights (routed_scaling_factor)
     routed_scaling_factor: float = 1.0
+    # DeepSeek first_k_dense_replace: the first k layers run a DENSE MLP of
+    # width dense_ff (HF intermediate_size) instead of the MoE — the forward
+    # scans the dense-prefix stack and the MoE stack separately
+    first_k_dense: int = 0
+    dense_ff: int | None = None  # dense-prefix MLP width (defaults to d_ff)
 
     # --- DeepSeek-style multi-head latent attention (MLA) ---
     # kv_lora_rank set => MLA: K/V live as ONE shared per-token latent
@@ -177,8 +182,17 @@ class ModelConfig:
         else:
             mlp = 3 * self.d_model * self.d_ff
         norms = ((2 if self.pre_norms else 0) + (2 if self.post_norms else 0)) * self.d_model
-        per_layer = attn + mlp + norms
-        return embed + head + self.n_layers * per_layer + self.d_model
+        if self.first_k_dense:
+            dense_mlp = 3 * self.d_model * (self.dense_ff or self.d_ff)
+            mlp_total = (
+                (self.n_layers - self.first_k_dense) * mlp
+                + self.first_k_dense * dense_mlp
+            )
+        else:
+            mlp_total = self.n_layers * mlp
+        return (
+            embed + head + self.n_layers * (attn + norms) + mlp_total + self.d_model
+        )
 
     def scaled(self, **overrides) -> "ModelConfig":
         return replace(self, **overrides)

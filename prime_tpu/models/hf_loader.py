@@ -76,16 +76,10 @@ def _gemma3_sliding_pattern(hf_config: Any) -> str:
 
 def _deepseek_config_from_hf(hf_config: Any, name: str) -> ModelConfig:
     """DeepSeek-V3: MLA + sigmoid-scored MoE with selection bias + shared
-    experts. Structural features this stack doesn't model are rejected
-    loudly: a dense-layer prefix (first_k_dense_replace > 0 — the uniform
-    layer scan has no mixed dense/MoE layers) and node-limited group routing
-    (n_group > 1)."""
+    experts + dense-prefix layers (first_k_dense_replace — the two-scan
+    forward runs them as a separate stack). Node-limited group routing
+    (n_group > 1) and rope_scaling stay rejected as unmodeled."""
     first_dense = int(getattr(hf_config, "first_k_dense_replace", 0) or 0)
-    if first_dense:
-        raise ValueError(
-            f"deepseek_v3 first_k_dense_replace={first_dense} is not modeled "
-            "(this stack's layer scan is uniform — no dense-prefix layers)"
-        )
     n_group = int(getattr(hf_config, "n_group", 1) or 1)
     if n_group > 1:
         raise ValueError(
@@ -115,6 +109,10 @@ def _deepseek_config_from_hf(hf_config: Any, name: str) -> ModelConfig:
         qk_nope_head_dim=int(hf_config.qk_nope_head_dim),
         v_head_dim=int(hf_config.v_head_dim),
         n_experts=int(getattr(hf_config, "n_routed_experts", 0) or 0),
+        # first_k_dense_replace: the prefix layers run a dense MLP of the
+        # full intermediate width (the two-scan forward handles the split)
+        first_k_dense=first_dense,
+        dense_ff=int(hf_config.intermediate_size) if first_dense else None,
         experts_per_token=int(getattr(hf_config, "num_experts_per_tok", 8) or 8),
         n_shared_experts=int(getattr(hf_config, "n_shared_experts", 0) or 0),
         moe_score_func=scoring,
@@ -510,7 +508,9 @@ def params_from_state_dict(
         # - Mixtral: block_sparse_moe.gate (router) + experts.M.{w1,w2,w3}
         #   (w1 = gate_proj, w3 = up_proj, both (F, D); w2 = down_proj (D, F))
         # - Qwen3-MoE: mlp.gate (router) + mlp.experts.M.{gate,up,down}_proj
-        if present("layers.0.mlp.experts.0.gate_proj.weight"):
+        moe_layers = range(config.first_k_dense, config.n_layers)
+        first_moe = config.first_k_dense  # prefix layers are dense (DeepSeek)
+        if present(f"layers.{first_moe}.mlp.experts.0.gate_proj.weight"):
             router_t = "layers.{}.mlp.gate.weight"
             gate_t = "layers.{}.mlp.experts.{}.gate_proj.weight"
             up_t = "layers.{}.mlp.experts.{}.up_proj.weight"
@@ -523,7 +523,7 @@ def params_from_state_dict(
 
         def stacked_experts(template: str) -> jnp.ndarray:
             layers_out = []
-            for layer in range(config.n_layers):
+            for layer in moe_layers:
                 experts = [
                     get(template.format(layer, expert)).T
                     for expert in range(config.n_experts)
@@ -534,7 +534,7 @@ def params_from_state_dict(
         mlp_weights = {
             "router": jnp.asarray(
                 np.stack(
-                    [get(router_t.format(layer)).T for layer in range(config.n_layers)]
+                    [get(router_t.format(layer)).T for layer in moe_layers]
                 ),
                 dtype=jnp.float32,  # router decisions stay fp32
             ),
@@ -548,23 +548,24 @@ def params_from_state_dict(
                 np.stack(
                     [
                         get(f"layers.{layer}.mlp.gate.e_score_correction_bias")
-                        for layer in range(config.n_layers)
+                        for layer in moe_layers
                     ]
                 ),
                 dtype=jnp.float32,
             )
         if config.n_shared_experts:
-            # DeepSeekMoE always-on shared expert (one fused dense MLP)
+            # DeepSeekMoE always-on shared expert (one fused dense MLP;
+            # only the MoE layers carry it)
+            def stacked_shared(template: str) -> jnp.ndarray:
+                return jnp.asarray(
+                    np.stack([get(template.format(i)).T for i in moe_layers]),
+                    dtype=dtype,
+                )
+
             mlp_weights |= {
-                "w_shared_gate": stacked(
-                    "layers.{}.mlp.shared_experts.gate_proj.weight", transpose=True
-                ),
-                "w_shared_up": stacked(
-                    "layers.{}.mlp.shared_experts.up_proj.weight", transpose=True
-                ),
-                "w_shared_down": stacked(
-                    "layers.{}.mlp.shared_experts.down_proj.weight", transpose=True
-                ),
+                "w_shared_gate": stacked_shared("layers.{}.mlp.shared_experts.gate_proj.weight"),
+                "w_shared_up": stacked_shared("layers.{}.mlp.shared_experts.up_proj.weight"),
+                "w_shared_down": stacked_shared("layers.{}.mlp.shared_experts.down_proj.weight"),
             }
     elif present("layers.0.mlp.gate_up_proj.weight"):
         # Phi3 fused MLP: gate rows then up rows
@@ -704,17 +705,38 @@ def params_from_state_dict(
             "wk": stacked("layers.{}.self_attn.k_proj.weight", transpose=True),
             "wv": stacked("layers.{}.self_attn.v_proj.weight", transpose=True),
         }
+    shared_keys = {
+        **attn_weights,
+        "wo": stacked("layers.{}.self_attn.o_proj.weight", transpose=True),
+        **norm_keys,
+        **attn_biases,
+    }
     params: dict[str, Any] = {
         "embed": jnp.asarray(get("embed_tokens.weight"), dtype=dtype),
-        "layers": {
-            **attn_weights,
-            "wo": stacked("layers.{}.self_attn.o_proj.weight", transpose=True),
-            **norm_keys,
-            **attn_biases,
-            **mlp_weights,
-        },
+        "layers": {**shared_keys, **mlp_weights},
         "final_norm": jnp.asarray(get("norm.weight"), dtype=dtype),
     }
+    if config.first_k_dense:
+        # DeepSeek dense prefix: attention/norm stacks cover ALL layers —
+        # split them; the MoE stacks above were already built over the MoE
+        # tail only, and the prefix layers carry a plain gate/up/down MLP
+        kd = config.first_k_dense
+        params["layers"] = {
+            **{key: value[kd:] for key, value in shared_keys.items()},
+            **mlp_weights,
+        }
+
+        def stacked_prefix(template: str) -> jnp.ndarray:
+            return jnp.asarray(
+                np.stack([get(template.format(i)).T for i in range(kd)]), dtype=dtype
+            )
+
+        params["dense_layers"] = {
+            **{key: value[:kd] for key, value in shared_keys.items()},
+            "w_gate": stacked_prefix("layers.{}.mlp.gate_proj.weight"),
+            "w_up": stacked_prefix("layers.{}.mlp.up_proj.weight"),
+            "w_down": stacked_prefix("layers.{}.mlp.down_proj.weight"),
+        }
     if not config.tie_embeddings:
         params["lm_head"] = jnp.asarray(np.asarray(state["lm_head.weight"]).T, dtype=dtype)
     return params

@@ -119,9 +119,13 @@ def _norm(x: jnp.ndarray, weight: jnp.ndarray, config: ModelConfig) -> jnp.ndarr
 
 def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Params:
     """Random init (truncated-normal-ish scaled); checkpoint loaders overwrite."""
-    keys = jax.random.split(rng, 14)
+    keys = jax.random.split(rng, 17)
     d, hd = config.d_model, config.head_dim
     h, kh, ff, layers = config.n_heads, config.n_kv_heads, config.d_ff, config.n_layers
+    # DeepSeek dense prefix: the MLP stacks cover only the tail layers — at
+    # 256-expert scale, building full-length expert stacks just to slice
+    # them would be a multi-GB transient allocation
+    mlp_layers = layers - config.first_k_dense
     # Gemma-style (1+w) norms are zero-initialized (≡ unit scale)
     norm_init = jnp.zeros if config.norm_plus_one else jnp.ones
 
@@ -132,33 +136,33 @@ def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Para
         experts = config.n_experts
         mlp_weights = {
             # router stays fp32: routing decisions are precision-sensitive
-            "router": dense(keys[9], (layers, d, experts), d).astype(jnp.float32),
-            "w_gate": dense(keys[5], (layers, experts, d, ff), d),
-            "w_up": dense(keys[6], (layers, experts, d, ff), d),
-            "w_down": dense(keys[7], (layers, experts, ff, d), ff),
+            "router": dense(keys[9], (mlp_layers, d, experts), d).astype(jnp.float32),
+            "w_gate": dense(keys[5], (mlp_layers, experts, d, ff), d),
+            "w_up": dense(keys[6], (mlp_layers, experts, d, ff), d),
+            "w_down": dense(keys[7], (mlp_layers, experts, ff, d), ff),
         }
         if config.moe_bias:  # GPT-OSS: router + every expert projection
             mlp_weights |= {
-                "router_bias": jnp.zeros((layers, experts), dtype=jnp.float32),
-                "b_gate": jnp.zeros((layers, experts, ff), dtype=dtype),
-                "b_up": jnp.zeros((layers, experts, ff), dtype=dtype),
-                "b_down": jnp.zeros((layers, experts, d), dtype=dtype),
+                "router_bias": jnp.zeros((mlp_layers, experts), dtype=jnp.float32),
+                "b_gate": jnp.zeros((mlp_layers, experts, ff), dtype=dtype),
+                "b_up": jnp.zeros((mlp_layers, experts, ff), dtype=dtype),
+                "b_down": jnp.zeros((mlp_layers, experts, d), dtype=dtype),
             }
         if config.moe_score_bias:  # DeepSeek-V3 aux-free balance bias (fp32,
             # selection-only — updated out-of-band, not by the loss)
-            mlp_weights["score_bias"] = jnp.zeros((layers, experts), dtype=jnp.float32)
+            mlp_weights["score_bias"] = jnp.zeros((mlp_layers, experts), dtype=jnp.float32)
         if config.n_shared_experts:  # DeepSeekMoE always-on shared expert(s)
             sf = config.n_shared_experts * ff
             mlp_weights |= {
-                "w_shared_gate": dense(keys[10], (layers, d, sf), d),
-                "w_shared_up": dense(keys[11], (layers, d, sf), d),
-                "w_shared_down": dense(keys[12], (layers, sf, d), sf),
+                "w_shared_gate": dense(keys[10], (mlp_layers, d, sf), d),
+                "w_shared_up": dense(keys[11], (mlp_layers, d, sf), d),
+                "w_shared_down": dense(keys[12], (mlp_layers, sf, d), sf),
             }
     else:
         mlp_weights = {
-            "w_gate": dense(keys[5], (layers, d, ff), d),
-            "w_up": dense(keys[6], (layers, d, ff), d),
-            "w_down": dense(keys[7], (layers, ff, d), ff),
+            "w_gate": dense(keys[5], (mlp_layers, d, ff), d),
+            "w_up": dense(keys[6], (mlp_layers, d, ff), d),
+            "w_down": dense(keys[7], (mlp_layers, ff, d), ff),
         }
 
     attn_biases = {}
@@ -207,16 +211,32 @@ def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Para
             "wv": dense(keys[3], (layers, d, kh * hd), d),
             "wo": dense(keys[4], (layers, h * hd, d), h * hd),
         }
+    shared_keys = {**attn_weights, **pre_norms, **attn_biases}
     params: Params = {
         "embed": dense(keys[0], (config.vocab_size, d), d),
         "layers": {
-            **attn_weights,
-            **pre_norms,
-            **attn_biases,
+            **shared_keys,
             **mlp_weights,
         },
         "final_norm": norm_init((d,), dtype=dtype),
     }
+    if config.first_k_dense:
+        # DeepSeek dense-prefix: the first k layers swap the MoE for a dense
+        # MLP of width dense_ff. Attention/norm/bias stacks were built over
+        # ALL layers — split them; the MLP stacks were already built
+        # tail-sized (mlp_layers).
+        kd = config.first_k_dense
+        dff = config.dense_ff or ff
+        params["layers"] = {
+            key: (value[kd:] if key in shared_keys else value)
+            for key, value in params["layers"].items()
+        }
+        params["dense_layers"] = {
+            **{key: value[:kd] for key, value in shared_keys.items()},
+            "w_gate": dense(keys[14], (kd, d, dff), d),
+            "w_up": dense(keys[15], (kd, d, dff), d),
+            "w_down": dense(keys[16], (kd, dff, d), dff),
+        }
     if not config.tie_embeddings:
         params["lm_head"] = dense(keys[8], (d, config.vocab_size), d)
     return params
@@ -412,7 +432,9 @@ def _attention_block(
 def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Dense or sparse-MoE feed-forward. Returns (residual output, aux loss)."""
     normed = _norm(x, lp["mlp_norm"], config) if "mlp_norm" in lp else x
-    if config.is_moe:
+    # key-presence decides, not config.is_moe alone: a DeepSeek dense-prefix
+    # layer (first_k_dense) carries a plain MLP inside an MoE model
+    if config.is_moe and "router" in lp:
         from prime_tpu.ops.moe import moe_mlp
 
         y, aux = moe_mlp(
@@ -584,20 +606,48 @@ def forward(
         ys = (new_k, new_v, new_ks, new_vs) if quantized else (new_k, new_v)
         return (x, aux_sum + aux), ys
 
+    # DeepSeek first_k_dense: the dense-prefix stack scans first, then the
+    # MoE stack — same layer_fn (the MLP branch keys off each stack's own
+    # params), cache arrays split at the static boundary and re-joined
+    kd = config.first_k_dense
+    stacks = (
+        [(params["dense_layers"], slice(0, kd)), (layer_params, slice(kd, None))]
+        if kd
+        else [(layer_params, slice(0, None))]
+    )
+
     if cache is not None:
+        new_ks = new_vs = None
+        k_parts, v_parts, ks_parts, vs_parts = [], [], [], []
+        aux_total = aux0
+        for stack, rows in stacks:
+            if quantized:
+                xs = (
+                    stack, sliding_flags[rows], cache.k[rows], cache.v[rows],
+                    cache.k_scale[rows], cache.v_scale[rows],
+                )
+                (x, aux_total), (part_k, part_v, part_ks, part_vs) = jax.lax.scan(
+                    layer_fn, (x, aux_total), xs
+                )
+                ks_parts.append(part_ks)
+                vs_parts.append(part_vs)
+            else:
+                (x, aux_total), (part_k, part_v) = jax.lax.scan(
+                    layer_fn, (x, aux_total),
+                    (stack, sliding_flags[rows], cache.k[rows], cache.v[rows]),
+                )
+            k_parts.append(part_k)
+            v_parts.append(part_v)
+
+        def join(parts):
+            return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+
         if quantized:
-            xs = (layer_params, sliding_flags, cache.k, cache.v, cache.k_scale, cache.v_scale)
-            (x, aux_total), (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
-                layer_fn, (x, aux0), xs
-            )
-        else:
-            (x, aux_total), (new_k, new_v) = jax.lax.scan(
-                layer_fn, (x, aux0), (layer_params, sliding_flags, cache.k, cache.v)
-            )
-            new_ks = new_vs = None
+            new_ks, new_vs = join(ks_parts), join(vs_parts)
         new_lengths = cache.lengths + (1 if decode else seq)
         new_cache = KVCache(
-            k=new_k, v=new_v, lengths=new_lengths, k_scale=new_ks, v_scale=new_vs
+            k=join(k_parts), v=join(v_parts), lengths=new_lengths,
+            k_scale=new_ks, v_scale=new_vs,
         )
     else:
 
@@ -637,9 +687,11 @@ def forward(
                 layer_fn_nocache, policy=policy, prevent_cse=False
             )
 
-        (x, aux_total), _ = jax.lax.scan(
-            layer_fn_nocache, (x, aux0), (layer_params, sliding_flags)
-        )
+        aux_total = aux0
+        for stack, rows in stacks:
+            (x, aux_total), _ = jax.lax.scan(
+                layer_fn_nocache, (x, aux_total), (stack, sliding_flags[rows])
+            )
         new_cache = None
 
     x = _norm(x, params["final_norm"], config)

@@ -53,12 +53,15 @@ def quantize_params_int8(params: dict) -> dict:
     MoE expert stacks quantize the same way (the per-output-channel axis is
     still the last one). The rest of the tree is shared by reference.
     """
-    layers = dict(params["layers"])
-    for key in QUANTIZED_LAYER_KEYS:
-        if key in layers and not isinstance(layers[key], tuple):
-            layers[key] = quantize_weight(layers[key])
     out = dict(params)
-    out["layers"] = layers
+    for subtree in ("layers", "dense_layers"):  # dense_layers: DeepSeek prefix
+        if subtree not in params:
+            continue
+        layers = dict(params[subtree])
+        for key in QUANTIZED_LAYER_KEYS:
+            if key in layers and not isinstance(layers[key], tuple):
+                layers[key] = quantize_weight(layers[key])
+        out[subtree] = layers
     return out
 
 
@@ -88,18 +91,21 @@ def quantize_params_int4(params: dict, group: int = INT4_GROUP) -> dict:
     wired through the expert dispatch path) — quantize those with
     :func:`quantize_params_int8` first if needed; int8 tuples and int4
     tuples coexist in one tree, ``matmul`` dispatches on dtype."""
-    layers = dict(params["layers"])
-    for key in QUANTIZED_LAYER_KEYS:
-        if key == "wkv_b":
-            # the MLA absorb einsum CONTRACTS wkv_b's reduction axis, where
-            # int4's group scales live — only int8's output-channel scheme
-            # folds there; a later int8 pass picks this key up
-            continue
-        w = layers.get(key)
-        if w is not None and not isinstance(w, tuple) and w.ndim == 3:
-            layers[key] = quantize_weight_int4(w, group=group)
     out = dict(params)
-    out["layers"] = layers
+    for subtree in ("layers", "dense_layers"):  # dense_layers: DeepSeek prefix
+        if subtree not in params:
+            continue
+        layers = dict(params[subtree])
+        for key in QUANTIZED_LAYER_KEYS:
+            if key == "wkv_b":
+                # the MLA absorb einsum CONTRACTS wkv_b's reduction axis,
+                # where int4's group scales live — only int8's output-channel
+                # scheme folds there; a later int8 pass picks this key up
+                continue
+            w = layers.get(key)
+            if w is not None and not isinstance(w, tuple) and w.ndim == 3:
+                layers[key] = quantize_weight_int4(w, group=group)
+        out[subtree] = layers
     return out
 
 

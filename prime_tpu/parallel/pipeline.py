@@ -31,6 +31,12 @@ from prime_tpu.ops.rope import rope_frequencies
 
 def pipeline_param_specs(config: ModelConfig) -> dict:
     """Like sharding.param_specs but stages the layer stack over pp."""
+    if config.first_k_dense:
+        raise ValueError(
+            "pipeline parallelism does not stage DeepSeek dense-prefix "
+            "models (first_k_dense > 0): the two stacks would need separate "
+            "pp layouts"
+        )
     if config.is_moe:
         mlp_spec = {
             "router": P("pp", None, None),
@@ -263,6 +269,13 @@ def make_pipeline_train_step(
 ):
     """Jitted pipelined train step (params staged over pp via
     shard_pipeline_params). Same contract as trainer.make_train_step."""
+    if config.mla:
+        from prime_tpu.models.mla import validate_mla_config
+
+        # the stage forward calls the MLA block directly — the same loud
+        # rejection forward() applies must fire here, or pipeline training
+        # would silently run different attention math than serving
+        validate_mla_config(config)
     from prime_tpu.train.trainer import TrainState, apply_gradients, cross_entropy_loss
 
     def loss_fn(params, tokens, targets, mask):

@@ -118,6 +118,16 @@ def param_specs(config: ModelConfig) -> dict[str, Any]:
         },
         "final_norm": P(None),
     }
+    if config.first_k_dense:
+        # DeepSeek dense-prefix stack: same attention/norm layout, dense MLP
+        specs["dense_layers"] = {
+            **attn_specs,
+            **pre_norm_specs,
+            **attn_bias_specs,
+            "w_gate": P(None, "fsdp", "tp"),
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),
+        }
     if not config.tie_embeddings:
         specs["lm_head"] = P("fsdp", "tp")
     return specs

@@ -365,12 +365,107 @@ def test_deepseek_v3_unmodeled_features_rejected():
     ok = config_from_hf(Cfg())
     assert ok.mla and ok.q_lora_rank is None
 
-    dense_prefix = Cfg()
-    dense_prefix.first_k_dense_replace = 3
-    with pytest.raises(ValueError, match="first_k_dense_replace"):
-        config_from_hf(dense_prefix)
+    prefixed = Cfg()
+    prefixed.first_k_dense_replace = 2
+    assert config_from_hf(prefixed).first_k_dense == 2  # modeled since round 5
 
     grouped = Cfg()
     grouped.n_group = 4
     with pytest.raises(ValueError, match="n_group"):
         config_from_hf(grouped)
+
+
+@pytest.fixture(scope="module")
+def deepseek_prefix_model():
+    """first_k_dense_replace=1: layer 0 is a dense MLP, layers 1-2 are MoE
+    (the real V2-Lite/V3 structure the two-scan forward exists for)."""
+    import torch
+    import transformers
+
+    cfg = transformers.DeepseekV3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=48, num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=4, kv_lora_rank=32, q_lora_rank=48,
+        qk_rope_head_dim=16, qk_nope_head_dim=32, v_head_dim=32,
+        n_routed_experts=8, num_experts_per_tok=2, n_shared_experts=1,
+        n_group=1, topk_group=1, first_k_dense_replace=1,
+        routed_scaling_factor=2.5, norm_topk_prob=True,
+        max_position_embeddings=128, rope_theta=10000.0, rope_scaling=None,
+        rms_norm_eps=1e-6, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(33)
+    model = transformers.DeepseekV3ForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _load_prefix(model, dtype=jnp.float32):
+    from prime_tpu.models.hf_loader import config_from_hf, params_from_state_dict
+
+    state = {k: v.float().numpy() for k, v in model.state_dict().items()}
+    config = config_from_hf(model.config, name="ds-prefix")
+    params = params_from_state_dict(state, config, dtype=dtype, rope_interleave=True)
+    return params, config
+
+
+def test_deepseek_dense_prefix_logits_match_transformers(deepseek_prefix_model):
+    import torch
+
+    params, config = _load_prefix(deepseek_prefix_model)
+    assert config.first_k_dense == 1 and config.dense_ff == 128
+    assert "dense_layers" in params and "router" not in params["dense_layers"]
+    assert params["layers"]["router"].shape[0] == 2  # MoE tail only
+    tokens = np.array([[3, 17, 200, 45, 9, 88, 121, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf = deepseek_prefix_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    ours, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(ours), hf, rtol=5e-4, atol=5e-4)
+
+
+def test_deepseek_dense_prefix_greedy_and_engine(deepseek_prefix_model):
+    """Greedy decode matches transformers through the two-scan cache, and
+    the continuous engine serves the model (cache split/join per tick)."""
+    import torch
+
+    from prime_tpu.serve.engine import ContinuousBatchingEngine
+
+    params, config = _load_prefix(deepseek_prefix_model)
+    prompt = np.array([[5, 42, 100, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_out = deepseek_prefix_model.generate(
+            torch.tensor(prompt, dtype=torch.long), max_new_tokens=8,
+            do_sample=False, eos_token_id=None, pad_token_id=0,
+        ).numpy()[0, 4:]
+    ours = generate(
+        params, jnp.asarray(prompt), jnp.asarray([4], jnp.int32), config,
+        jax.random.PRNGKey(0), max_new_tokens=8, temperature=0.0,
+    ).tokens[0]
+    assert np.asarray(ours).tolist() == hf_out.tolist()
+
+    engine = ContinuousBatchingEngine(params, config, max_slots=2, capacity=64, chunk=4)
+    request = engine.submit([5, 42, 100, 7], max_new_tokens=8)
+    while not request.done:
+        engine.tick()
+    assert request.all_tokens(timeout=1) == hf_out.tolist()
+
+
+def test_deepseek_dense_prefix_trains_and_quantizes(deepseek_prefix_model):
+    from prime_tpu.models.quantize import quantize_params_int8
+    from prime_tpu.train import default_optimizer, init_train_state, make_train_step
+
+    params, config = _load_prefix(deepseek_prefix_model)
+    # quantized forward FIRST: the jitted train step donates its buffers,
+    # deleting every array the q8 tree shares by reference (embed, norms)
+    q8 = quantize_params_int8(params)
+    assert isinstance(q8["dense_layers"]["w_gate"], tuple)  # prefix quantized too
+    tokens = jnp.asarray([[3, 17, 200, 45]], jnp.int32)
+    logits, _ = forward(q8, tokens, config)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+    opt = default_optimizer()
+    state = init_train_state(params, opt)
+    step = make_train_step(config, opt)
+    t = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, config.vocab_size)
+    _state, metrics = step(state, t, jnp.roll(t, -1, 1), jnp.ones_like(t, jnp.float32))
+    assert np.isfinite(float(metrics["loss"]))
