@@ -104,6 +104,10 @@ class ModelConfig:
     moe_score_bias: bool = False
     # multiplier on the final routed combine weights (routed_scaling_factor)
     routed_scaling_factor: float = 1.0
+    # V3 node-limited routing: experts partition into moe_n_groups groups,
+    # only the moe_topk_groups best (by top-2 score sum) stay selectable
+    moe_n_groups: int = 1
+    moe_topk_groups: int = 1
     # DeepSeek first_k_dense_replace: the first k layers run a DENSE MLP of
     # width dense_ff (HF intermediate_size) instead of the MoE — the forward
     # scans the dense-prefix stack and the MoE stack separately
@@ -723,8 +727,9 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
     ),
     # DeepSeek-V3 architecture at test scale: MLA + sigmoid-scored routing
     # with a selection-only balance bias, routed scaling, and an always-on
-    # shared expert (first_k_dense_replace is the one V3 structural feature
-    # not modeled — the uniform layer scan has no mixed dense/MoE layers)
+    # shared expert. Dense-prefix layers (first_k_dense_replace) and group
+    # routing (n_group) are modeled too — covered by the HF-parity fixtures
+    # in tests/test_mla.py rather than this preset
     "tiny-deepseek": ModelConfig(
         name="tiny-deepseek",
         vocab_size=512,

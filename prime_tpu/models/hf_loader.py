@@ -76,16 +76,11 @@ def _gemma3_sliding_pattern(hf_config: Any) -> str:
 
 def _deepseek_config_from_hf(hf_config: Any, name: str) -> ModelConfig:
     """DeepSeek-V3: MLA + sigmoid-scored MoE with selection bias + shared
-    experts + dense-prefix layers (first_k_dense_replace — the two-scan
-    forward runs them as a separate stack). Node-limited group routing
-    (n_group > 1) and rope_scaling stay rejected as unmodeled."""
+    experts + dense-prefix layers (first_k_dense_replace, two-scan forward)
+    + node-limited group routing (n_group/topk_group). rope_scaling stays
+    rejected (DeepSeek-yarn applies mscale to the softmax scale, which the
+    MLA path does not model yet)."""
     first_dense = int(getattr(hf_config, "first_k_dense_replace", 0) or 0)
-    n_group = int(getattr(hf_config, "n_group", 1) or 1)
-    if n_group > 1:
-        raise ValueError(
-            f"deepseek_v3 n_group={n_group} (node-limited group routing) is "
-            "not modeled; only n_group=1 checkpoints load"
-        )
     if getattr(hf_config, "rope_scaling", None):
         raise ValueError("deepseek_v3 rope_scaling is not wired for MLA yet")
     scoring = getattr(hf_config, "scoring_func", "sigmoid") or "sigmoid"
@@ -118,6 +113,8 @@ def _deepseek_config_from_hf(hf_config: Any, name: str) -> ModelConfig:
         moe_score_func=scoring,
         moe_score_bias=True,  # the e_score_correction_bias buffer always ships
         routed_scaling_factor=float(getattr(hf_config, "routed_scaling_factor", 1.0) or 1.0),
+        moe_n_groups=int(getattr(hf_config, "n_group", 1) or 1),
+        moe_topk_groups=int(getattr(hf_config, "topk_group", 1) or 1),
         norm_topk=bool(getattr(hf_config, "norm_topk_prob", True)),
         # HF routing is dropless; give capacity routing the same headroom
         # every other HF MoE gets (advisor r3)
@@ -718,8 +715,11 @@ def params_from_state_dict(
     }
     if config.first_k_dense:
         # DeepSeek dense prefix: attention/norm stacks cover ALL layers —
-        # split them; the MoE stacks above were already built over the MoE
-        # tail only, and the prefix layers carry a plain gate/up/down MLP
+        # split them (transiently ~2x those stacks on device; attention is
+        # a small fraction of a prefix model next to its expert weights, so
+        # the peak is dominated by the experts either way); the MoE stacks
+        # above were already built over the MoE tail only, and the prefix
+        # layers carry a plain gate/up/down MLP
         kd = config.first_k_dense
         params["layers"] = {
             **{key: value[kd:] for key, value in shared_keys.items()},

@@ -454,6 +454,8 @@ def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> tuple[jnp.nda
             score_func=config.moe_score_func,
             select_bias=lp.get("score_bias"),
             routed_scale=config.routed_scaling_factor,
+            route_groups=config.moe_n_groups,
+            route_topk_groups=config.moe_topk_groups,
         )
         if "w_shared_gate" in lp:
             # DeepSeekMoE shared expert(s): a dense always-on silu MLP added
@@ -608,7 +610,12 @@ def forward(
 
     # DeepSeek first_k_dense: the dense-prefix stack scans first, then the
     # MoE stack — same layer_fn (the MLP branch keys off each stack's own
-    # params), cache arrays split at the static boundary and re-joined
+    # params), cache arrays split at the static boundary and re-joined.
+    # The join concatenates the full cache each step — the price of keeping
+    # ONE uniform KVCache contract for every consumer (engine slots,
+    # sp_cache_spec, checkpoints); acceptable while prefix models serve
+    # single-host (kd<=3), revisit with a pre-split cache if it shows up
+    # on a profile
     kd = config.first_k_dense
     stacks = (
         [(params["dense_layers"], slice(0, kd)), (layer_params, slice(kd, None))]

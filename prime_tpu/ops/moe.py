@@ -33,6 +33,8 @@ def top_k_routing(
     score_func: str = "softmax",       # "softmax" | "sigmoid" (DeepSeek-V3)
     select_bias: jnp.ndarray | None = None,  # (E,) selection-only bias
     routed_scale: float = 1.0,         # DeepSeek routed_scaling_factor
+    n_groups: int = 1,                 # V3 node-limited routing: expert groups
+    topk_groups: int = 1,              # ...of which this many stay selectable
 ):
     """Returns (dispatch (T, E, C), combine (T, E, C), aux_loss scalar).
 
@@ -55,6 +57,20 @@ def top_k_routing(
     else:
         probs = jax.nn.softmax(router_logits, axis=-1)  # (T, E)
     selection = probs if select_bias is None else probs + select_bias.astype(probs.dtype)
+
+    if n_groups > 1:
+        # DeepSeek-V3 node-limited routing: rank expert GROUPS by the sum of
+        # each group's top-2 biased scores, keep topk_groups, and zero the
+        # rest out of selection — HF masks to 0.0 (not -inf), reproduced
+        # exactly so group-edge tie behavior matches torch.topk
+        group_sel = selection.reshape(tokens, n_groups, n_experts // n_groups)
+        group_scores = jnp.sum(jax.lax.top_k(group_sel, 2)[0], axis=-1)  # (T, G)
+        kept = jax.lax.top_k(group_scores, topk_groups)[1]               # (T, kept)
+        group_mask = jnp.sum(
+            jax.nn.one_hot(kept, n_groups, dtype=selection.dtype), axis=1
+        )  # (T, G)
+        expanded = jnp.repeat(group_mask, n_experts // n_groups, axis=-1)
+        selection = jnp.where(expanded > 0, selection, 0.0)
 
     # iterative top-k (k is 1 or 2 in practice; unrolled, fully static)
     expert_masks = []
@@ -138,6 +154,8 @@ def moe_mlp(
     score_func: str = "softmax",          # DeepSeek-V3: "sigmoid"
     select_bias: jnp.ndarray | None = None,  # (E,) selection-only balance bias
     routed_scale: float = 1.0,            # DeepSeek routed_scaling_factor
+    route_groups: int = 1,                # V3 node-limited group routing
+    route_topk_groups: int = 1,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Sparse MoE feed-forward. Returns (output (B, S, D), aux_loss).
 
@@ -171,7 +189,8 @@ def moe_mlp(
         lambda logits, v: top_k_routing(
             logits, k, capacity, valid=v, norm_topk=norm_topk,
             score_func=score_func, select_bias=select_bias,
-            routed_scale=routed_scale,
+            routed_scale=routed_scale, n_groups=route_groups,
+            topk_groups=route_topk_groups,
         )
     )(router_logits, valid)
     dispatch = dispatch.astype(x.dtype)   # (g, group, E, C)
