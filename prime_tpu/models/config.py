@@ -125,6 +125,10 @@ class ModelConfig:
     qk_rope_head_dim: int = 64    # roped sub-head, shared across heads (MQA-style)
     qk_nope_head_dim: int = 128   # position-free sub-head, absorbed into the latent
     v_head_dim: int = 128         # per-head value width out of the latent
+    # DeepSeek-yarn long-context: multiplier on the MLA softmax scale
+    # (yarn_get_mscale(factor, mscale_all_dim)^2 — HF applies it to
+    # attention scaling, NOT the rope tables)
+    attn_scale_mult: float = 1.0
 
     @property
     def head_dim(self) -> int:

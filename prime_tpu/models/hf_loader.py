@@ -74,15 +74,66 @@ def _gemma3_sliding_pattern(hf_config: Any) -> str:
     return f"{int(pattern) - 1}:1"
 
 
+def _parse_yarn(rope_scaling: dict, factor: float, default_max: float) -> tuple:
+    """HF _compute_yarn_parameters semantics, shared by the generic and
+    DeepSeek branches: (factor, beta_fast, beta_slow, original_max,
+    attention_factor). The attention factor resolves from mscale /
+    mscale_all_dim exactly as transformers does."""
+    import math
+
+    def mscale_of(scale: float, m: float = 1.0) -> float:
+        return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+    attention_factor = rope_scaling.get("attention_factor")
+    mscale = rope_scaling.get("mscale")
+    mscale_all_dim = rope_scaling.get("mscale_all_dim")
+    if attention_factor is None:
+        if mscale and mscale_all_dim:
+            attention_factor = mscale_of(factor, mscale) / mscale_of(factor, mscale_all_dim)
+        else:
+            attention_factor = mscale_of(factor)
+    return (
+        factor,
+        float(rope_scaling.get("beta_fast") or 32.0),
+        float(rope_scaling.get("beta_slow") or 1.0),
+        float(rope_scaling.get("original_max_position_embeddings") or default_max),
+        float(attention_factor),
+    )
+
+
 def _deepseek_config_from_hf(hf_config: Any, name: str) -> ModelConfig:
     """DeepSeek-V3: MLA + sigmoid-scored MoE with selection bias + shared
     experts + dense-prefix layers (first_k_dense_replace, two-scan forward)
-    + node-limited group routing (n_group/topk_group). rope_scaling stays
-    rejected (DeepSeek-yarn applies mscale to the softmax scale, which the
-    MLA path does not model yet)."""
+    + node-limited group routing (n_group/topk_group) + DeepSeek-yarn
+    long-context (NTK-by-parts tables on the rope sub-head, mscale_all_dim^2
+    on the softmax scale). Non-yarn rope_scaling types are rejected."""
     first_dense = int(getattr(hf_config, "first_k_dense_replace", 0) or 0)
-    if getattr(hf_config, "rope_scaling", None):
-        raise ValueError("deepseek_v3 rope_scaling is not wired for MLA yet")
+    # DeepSeek-yarn: NTK-by-parts frequencies over the qk_rope sub-head with
+    # the attention factor on cos/sin, PLUS mscale_all_dim^2 on the softmax
+    # scale itself (HF DeepseekV3Attention) — the table machinery is shared
+    # with the other yarn families, the scale multiplier is MLA-specific
+    rope_yarn = None
+    yarn_truncate = True
+    attn_scale_mult = 1.0
+    rope_scaling = getattr(hf_config, "rope_scaling", None)
+    if rope_scaling:
+        import math
+
+        if not isinstance(rope_scaling, dict):
+            raise ValueError(f"deepseek_v3 rope_scaling must be a dict, got {rope_scaling!r}")
+        rope_type = rope_scaling.get("rope_type", rope_scaling.get("type"))
+        if rope_type != "yarn":
+            raise ValueError(
+                f"deepseek_v3 rope_scaling type {rope_type!r} is not modeled "
+                "(yarn is the family's published long-context scheme)"
+            )
+        factor = float(rope_scaling["factor"])
+        rope_yarn = _parse_yarn(rope_scaling, factor, hf_config.max_position_embeddings)
+        yarn_truncate = bool(rope_scaling.get("truncate", True))
+        mscale_all_dim = rope_scaling.get("mscale_all_dim")
+        if mscale_all_dim:
+            # HF DeepseekV3Attention: mscale^2 rides the softmax scale itself
+            attn_scale_mult = (0.1 * mscale_all_dim * math.log(factor) + 1.0) ** 2 if factor > 1 else 1.0
     scoring = getattr(hf_config, "scoring_func", "sigmoid") or "sigmoid"
     return ModelConfig(
         name=name,
@@ -103,6 +154,9 @@ def _deepseek_config_from_hf(hf_config: Any, name: str) -> ModelConfig:
         qk_rope_head_dim=int(hf_config.qk_rope_head_dim),
         qk_nope_head_dim=int(hf_config.qk_nope_head_dim),
         v_head_dim=int(hf_config.v_head_dim),
+        rope_yarn=rope_yarn,
+        rope_yarn_truncate=yarn_truncate,
+        attn_scale_mult=attn_scale_mult,
         n_experts=int(getattr(hf_config, "n_routed_experts", 0) or 0),
         # first_k_dense_replace: the prefix layers run a dense MLP of the
         # full intermediate width (the two-scan forward handles the split)
@@ -203,28 +257,8 @@ def config_from_hf(hf_config: Any, name: str = "hf-model") -> ModelConfig:
         # with silently divergent correction bounds (GPT-OSS ships false)
         yarn_truncate = bool(rope_scaling.get("truncate", True))
 
-        def mscale_of(scale: float, m: float = 1.0) -> float:
-            return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
-
-        attention_factor = rope_scaling.get("attention_factor")
-        mscale = rope_scaling.get("mscale")
-        mscale_all_dim = rope_scaling.get("mscale_all_dim")
-        if attention_factor is None:
-            if mscale and mscale_all_dim:
-                attention_factor = mscale_of(rope_factor, mscale) / mscale_of(
-                    rope_factor, mscale_all_dim
-                )
-            else:
-                attention_factor = mscale_of(rope_factor)
-        rope_yarn = (
-            rope_factor,
-            float(rope_scaling.get("beta_fast") or 32.0),
-            float(rope_scaling.get("beta_slow") or 1.0),
-            float(
-                rope_scaling.get("original_max_position_embeddings")
-                or getattr(hf_config, "max_position_embeddings", 8192)
-            ),
-            float(attention_factor),
+        rope_yarn = _parse_yarn(
+            rope_scaling, rope_factor, getattr(hf_config, "max_position_embeddings", 8192)
         )
         rope_factor = 1.0
     elif rope_type == "longrope" and rope_scaling:

@@ -187,7 +187,11 @@ def mla_attention_block(
     """
     batch, seq, _ = x.shape
     rank = config.kv_lora_rank
-    sm_scale = (config.qk_nope_head_dim + config.qk_rope_head_dim) ** -0.5
+    # attn_scale_mult: DeepSeek-yarn mscale^2 rides the softmax scale
+    sm_scale = (
+        (config.qk_nope_head_dim + config.qk_rope_head_dim) ** -0.5
+        * config.attn_scale_mult
+    )
     cos, sin = rope_tables
     cos_rows, sin_rows = cos[positions], sin[positions]
 
@@ -271,7 +275,7 @@ def naive_mla_attention(x, lp, positions, rope_tables, config: ModelConfig):
 
     k = jnp.concatenate([k_nope, jnp.broadcast_to(k_pe, (batch, seq, h, rope))], -1)
     qf = jnp.concatenate([q_nope, q_pe], -1)
-    sm_scale = (nope + rope) ** -0.5
+    sm_scale = (nope + rope) ** -0.5 * config.attn_scale_mult
     ctx = multi_head_attention(
         qf.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
         sm_scale, impl="xla",

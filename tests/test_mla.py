@@ -376,9 +376,9 @@ def test_deepseek_v3_unmodeled_features_rejected():
     assert cfg.moe_n_groups == 4 and cfg.moe_topk_groups == 2  # modeled since r5
 
     scaled = Cfg()
-    scaled.rope_scaling = {"type": "yarn", "factor": 4}
+    scaled.rope_scaling = {"type": "linear", "factor": 4}
     with pytest.raises(ValueError, match="rope_scaling"):
-        config_from_hf(scaled)
+        config_from_hf(scaled)  # only yarn is the published DeepSeek scheme
 
 
 @pytest.fixture(scope="module")
@@ -505,6 +505,62 @@ def test_deepseek_v3_group_routing_matches_transformers():
     assert config.moe_n_groups == 2 and config.moe_topk_groups == 1
     params = params_from_state_dict(state, config, dtype=jnp.float32, rope_interleave=True)
     tokens = np.array([[3, 17, 200, 45, 9, 88, 121, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    ours, _ = forward(params, jnp.asarray(tokens), config)
+    np.testing.assert_allclose(np.asarray(ours), hf, rtol=5e-4, atol=5e-4)
+    prompt = np.array([[5, 42, 100, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_out = model.generate(
+            torch.tensor(prompt, dtype=torch.long), max_new_tokens=6,
+            do_sample=False, eos_token_id=None, pad_token_id=0,
+        ).numpy()[0, 4:]
+    ours_gen = generate(
+        params, jnp.asarray(prompt), jnp.asarray([4], jnp.int32), config,
+        jax.random.PRNGKey(0), max_new_tokens=6, temperature=0.0,
+    ).tokens[0]
+    assert np.asarray(ours_gen).tolist() == hf_out.tolist()
+
+
+def test_deepseek_v3_yarn_matches_transformers():
+    """DeepSeek-yarn long-context: NTK-by-parts tables over the rope
+    sub-head plus mscale_all_dim^2 on the softmax scale, pinned against
+    transformers logits + greedy (the real V2/V3 checkpoints' scheme)."""
+    import torch
+    import transformers
+
+    from prime_tpu.models.hf_loader import config_from_hf, params_from_state_dict
+
+    cfg = transformers.DeepseekV3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=48, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, kv_lora_rank=32, q_lora_rank=48,
+        qk_rope_head_dim=16, qk_nope_head_dim=32, v_head_dim=32,
+        n_routed_experts=8, num_experts_per_tok=2, n_shared_experts=1,
+        n_group=1, topk_group=1, first_k_dense_replace=0,
+        routed_scaling_factor=2.5, norm_topk_prob=True,
+        max_position_embeddings=128, rope_theta=10000.0,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 4.0, "beta_fast": 32,
+            "beta_slow": 1, "mscale": 1.0, "mscale_all_dim": 1.0,
+            "original_max_position_embeddings": 32,
+        },
+        rms_norm_eps=1e-6, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(37)
+    model = transformers.DeepseekV3ForCausalLM(cfg)
+    model.eval()
+    state = {k: v.float().numpy() for k, v in model.state_dict().items()}
+    config = config_from_hf(model.config, name="ds-yarn")
+    assert config.rope_yarn is not None
+    assert config.attn_scale_mult > 1.0  # mscale_all_dim=1, factor=4 -> >1
+    # no-drop capacity (E/k), as the serving path sets it: at 48 tokens the
+    # default 2.0 headroom drops tokens that HF's dropless routing serves,
+    # which would mask whether the YARN math matches
+    config = config.scaled(capacity_factor=config.n_experts / config.experts_per_token)
+    params = params_from_state_dict(state, config, dtype=jnp.float32, rope_interleave=True)
+    tokens = np.array([[3, 17, 200, 45, 9, 88, 121, 7] * 6], dtype=np.int32)  # past orig range
     with torch.no_grad():
         hf = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
     ours, _ = forward(params, jnp.asarray(tokens), config)
