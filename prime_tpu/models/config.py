@@ -666,6 +666,43 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         d_ff=256,
         max_seq_len=512,
     ),
+    # DeepSeek-V2-Lite at published scale (15.7B total / ~2.4B active):
+    # MLA with direct query projection, 64 fine-grained experts (top-6) + 2
+    # shared, one dense-prefix layer — the real checkpoint's architecture
+    # (HF deepseek-ai/DeepSeek-V2-Lite config.json values)
+    "deepseek-v2-lite": ModelConfig(
+        name="deepseek-v2-lite",
+        vocab_size=102400,
+        d_model=2048,
+        n_layers=27,
+        n_heads=16,
+        n_kv_heads=16,
+        d_ff=1408,            # moe_intermediate_size (per-expert width)
+        max_seq_len=32768,
+        rope_theta=10000.0,
+        rms_eps=1e-6,
+        kv_lora_rank=512,
+        qk_rope_head_dim=64,
+        qk_nope_head_dim=128,
+        v_head_dim=128,
+        n_experts=64,
+        experts_per_token=6,
+        capacity_factor=2.0,
+        n_shared_experts=2,
+        moe_score_func="softmax",  # V2 gates with softmax (V3 moved to sigmoid)
+        routed_scaling_factor=1.0,
+        norm_topk=False,           # V2-Lite ships norm_topk_prob=false
+        first_k_dense=1,
+        dense_ff=10944,            # the prefix layer's dense intermediate
+        # the checkpoint's yarn long-context: factor 40 over a 4096 window;
+        # mscale == mscale_all_dim == 0.707 so the table attention factor
+        # cancels to 1.0 and the whole mscale rides the softmax scale:
+        # (0.1*0.707*ln(40)+1)^2. max_seq_len capped at 32k (the no-cache
+        # forward materializes rope tables at this length; serving sizes
+        # tables from KV capacity, so longer contexts still work).
+        rope_yarn=(40.0, 32.0, 1.0, 4096.0, 1.0),
+        attn_scale_mult=1.5896261651208736,
+    ),
     # ~1B dense model with DeepSeek-V2-dimension MLA (rank 512 latent, 64
     # rope, 128 nope/value heads): the bench model for the latent-cache
     # long-context story — its decode cache is ~9x smaller than a

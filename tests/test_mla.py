@@ -576,3 +576,33 @@ def test_deepseek_v3_yarn_matches_transformers():
         jax.random.PRNGKey(0), max_new_tokens=6, temperature=0.0,
     ).tokens[0]
     assert np.asarray(ours_gen).tolist() == hf_out.tolist()
+
+
+def test_deepseek_v2_lite_preset_shapes_without_materializing():
+    """The published V2-Lite architecture (15.7B, 64 experts + 2 shared, one
+    dense-prefix layer) structurally checks out via eval_shape — no 15.7B
+    materialization, just the traced param tree and the cache footprint."""
+    config = get_config("deepseek-v2-lite")
+    assert config.mla and config.first_k_dense == 1 and config.n_experts == 64
+    assert config.param_count == pytest.approx(15.7e9, rel=0.02)
+
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), config, dtype=jnp.bfloat16)
+    )
+    total = sum(
+        int(np.prod(leaf.shape)) for leaf in jax.tree_util.tree_leaves(shapes)
+    )
+    assert total == config.param_count
+    assert shapes["dense_layers"]["w_gate"].shape == (1, 2048, 10944)
+    assert shapes["layers"]["w_gate"].shape == (26, 64, 2048, 1408)
+    assert shapes["layers"]["w_shared_gate"].shape == (26, 2048, 2 * 1408)
+    assert shapes["layers"]["wkv_b"].shape == (26, 512, 16 * (128 + 128))
+
+    # latent cache: 576 * 2 bytes/token/layer -> a 32k-token sequence fits
+    # in ~1 GiB of cache vs ~10.7 GiB for per-head K (nope+rope) + V (v_dim)
+    cache_shapes = jax.eval_shape(
+        lambda: init_cache(config, 1, 32768, dtype=jnp.bfloat16)
+    )
+    latent_bytes = int(np.prod(cache_shapes.k.shape)) * 2
+    full_kv_bytes = 27 * 16 * ((128 + 64) + 128) * 32768 * 2
+    assert latent_bytes < 0.12 * full_kv_bytes
