@@ -385,10 +385,16 @@ def main() -> None:
         from prime_tpu.models.llama import forward, init_cache
 
         prefill_cache = init_cache(config, BATCH, PROMPT_LEN + NEW_TOKENS)
+        # params/cache as ARGUMENTS, not closure captures: a captured tree is
+        # serialized into the program as 2.47 GB of constants, which a
+        # tunneled backend re-ships on compile (observed stalling the r5
+        # opportunistic capture for minutes)
         prefill_fn = jax.jit(
-            lambda: forward(params, prompts, config, cache=prefill_cache)[0]
+            lambda p, c: forward(p, prompts, config, cache=c)[0]
         )
-        prefill_s = time_fn(lambda: float(jnp.sum(prefill_fn())), iterations=3)
+        prefill_s = time_fn(
+            lambda: float(jnp.sum(prefill_fn(params, prefill_cache))), iterations=3
+        )
         n_params = param_bytes / 2  # bf16 storage
         prefill_flops = (
             2.0 * n_params * BATCH * PROMPT_LEN
@@ -700,10 +706,13 @@ def main() -> None:
             from prime_tpu.models.llama import forward as _fwd, init_cache as _ic
 
             lc_cache = _ic(config, lc_batch, lc_prompt + lc_new)
+            # args not closures — see the headline prefill_fn note
             lc_pre_fn = jax.jit(
-                lambda: _fwd(params, lc_prompts, config, cache=lc_cache)[0]
+                lambda p, c: _fwd(p, lc_prompts, config, cache=c)[0]
             )
-            lc_pre_s = time_fn(lambda: float(jnp.sum(lc_pre_fn())), iterations=2)
+            lc_pre_s = time_fn(
+                lambda: float(jnp.sum(lc_pre_fn(params, lc_cache))), iterations=2
+            )
             record["longctx_prefill_ms"] = round(lc_pre_s * 1e3, 1)
             # same noise guard as the headline: both operands are large and noisy
             if pallas_s - lc_pre_s > 0.2 * pallas_s:
