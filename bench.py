@@ -141,15 +141,13 @@ def _sweep_stray_holders() -> list[str]:
 
 def _tree_bytes(params) -> int:
     """Total bytes of a parameter pytree as stored on device (bf16 weights
-    count 2 bytes, int8 1 byte + fp scales, int4 packed two-per-byte)."""
+    2 bytes, int8 1 byte + fp scales; int4 weights are nibble-packed into
+    uint8 carriers with half the elements, so itemsize covers them too)."""
     import jax
 
-    def leaf_bytes(x) -> float:
-        if "int4" in str(x.dtype):
-            return x.size * 0.5
-        return x.size * x.dtype.itemsize
-
-    return int(sum(leaf_bytes(x) for x in jax.tree_util.tree_leaves(params)))
+    return int(
+        sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params))
+    )
 
 
 def _kv_bytes_per_slot(config, kv_bytes: float) -> float:

@@ -13,10 +13,16 @@ matmul epilogue; the fp weights are never materialized.
 
 int4 scheme: group-wise symmetric along the REDUCTION axis (AWQ/GPTQ-style,
 group=128 input channels), because 4 bits with one scale per whole column
-loses too much signal. The tuple is ``(q int4 (..., in, out), scale fp32
-(..., groups, 1, out))`` and the matmul splits the reduction into per-group
-partials — ``sum_g (x_g @ q_g) * s_g`` — so XLA streams packed int4 from
-HBM (half the int8 bytes) and the MXU still sees batched bf16 matmuls.
+loses too much signal. Storage is NIBBLE-PACKED uint8 — two 4-bit values
+per byte, low nibble = first half of the group, high nibble = second half —
+NOT the jnp.int4 dtype: int4 arrays cannot cross a jit boundary on every
+backend (the tunneled axon plugin's shard_arg recurses on them), and a
+packed uint8 carrier moves the same 4 bits/weight while staying a
+first-class dtype everywhere. The tuple is ``(packed uint8 (..., in/2,
+out), scale fp32 (..., groups, 1, out))``; the matmul sign-extends the
+nibbles in-graph and splits the reduction into per-group partials —
+``sum_g (x_lo @ lo_g + x_hi @ hi_g) * s_g`` — so HBM streams half the
+int8 bytes and the MXU still sees batched bf16 matmuls.
 
 Norms, embeddings, the router, and the LM head stay in their original dtype
 (gathers and the final fp32 logits matmul have different numerics); the
@@ -71,17 +77,25 @@ INT4_GROUP = 128
 def quantize_weight_int4(
     w: jnp.ndarray, group: int = INT4_GROUP
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Group-wise (reduction axis) symmetric int4: one fp32 scale per
-    ``group`` input channels per output channel. Falls back to a single
-    group when the reduction dim doesn't divide."""
+    """Group-wise (reduction axis) symmetric int4, nibble-packed into uint8:
+    one fp32 scale per ``group`` input channels per output channel. Falls
+    back to a single group when the reduction dim doesn't divide; an odd
+    reduction dim (can't pack pairs) keeps an unpacked int8 carrier, which
+    ``_matmul_int4`` detects by shape."""
     *lead, d_in, d_out = w.shape
     g = group if d_in % group == 0 else d_in
     groups = d_in // g
     wg = w.astype(jnp.float32).reshape(*lead, groups, g, d_out)
     absmax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)  # (..., groups, 1, out)
     scale = absmax / 7.0
-    q = jnp.clip(jnp.round(wg / jnp.maximum(scale, 1e-12)), -8, 7).astype(jnp.int4)
-    return q.reshape(*lead, d_in, d_out), scale
+    q = jnp.clip(jnp.round(wg / jnp.maximum(scale, 1e-12)), -8, 7).astype(jnp.int8)
+    if g % 2:  # odd group: no pair packing; int8 carrier, same scale layout
+        return q.reshape(*lead, d_in, d_out), scale
+    # low nibble = first half-group, high nibble = second half-group
+    lo = q[..., : g // 2, :].astype(jnp.uint8) & 0xF
+    hi = q[..., g // 2 :, :].astype(jnp.uint8) & 0xF
+    packed = lo | (hi << 4)
+    return packed.reshape(*lead, d_in // 2, d_out), scale
 
 
 def quantize_params_int4(params: dict, group: int = INT4_GROUP) -> dict:
@@ -109,23 +123,42 @@ def quantize_params_int4(params: dict, group: int = INT4_GROUP) -> dict:
     return out
 
 
+def _unpack_nibbles(packed: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Sign-extend the two 4-bit values in each uint8 to int8 in [-8, 7]."""
+    lo = ((packed & 0xF).astype(jnp.int8) ^ 8) - 8
+    hi = ((packed >> 4).astype(jnp.int8) ^ 8) - 8
+    return lo, hi
+
+
 def _matmul_int4(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
     """Per-group partial matmuls, scaled then summed over groups: exact
-    w.r.t. ``x @ dequant(q, scale)`` up to fp accumulation order."""
-    d_in, d_out = q.shape[-2:]
+    w.r.t. ``x @ dequant(q, scale)`` up to fp accumulation order. ``q`` is
+    nibble-packed uint8 (rows = d_in/2) or, for an odd reduction dim, an
+    unpacked int8 carrier (rows = d_in)."""
+    d_in = x.shape[-1]
+    d_out = q.shape[-1]
     groups = scale.shape[-3]
     g = d_in // groups
     xg = x.reshape(*x.shape[:-1], groups, g)
-    qg = q.reshape(*q.shape[:-2], groups, g, d_out)
-    y = jnp.einsum("...gi,gio->...go", xg, qg.astype(x.dtype))
-    return jnp.sum(y * scale[..., 0, :].astype(y.dtype), axis=-2)
+    s = scale[..., 0, :]  # (..., groups, out)
+    if q.shape[-2] == d_in:  # odd-group int8 carrier
+        qg = q.reshape(*q.shape[:-2], groups, g, d_out)
+        y = jnp.einsum("...gi,gio->...go", xg, qg.astype(x.dtype))
+        return jnp.sum(y * s.astype(y.dtype), axis=-2)
+    pg = q.reshape(*q.shape[:-2], groups, g // 2, d_out)
+    lo, hi = _unpack_nibbles(pg)
+    y = jnp.einsum("...gi,gio->...go", xg[..., : g // 2], lo.astype(x.dtype))
+    y = y + jnp.einsum("...gi,gio->...go", xg[..., g // 2 :], hi.astype(x.dtype))
+    return jnp.sum(y * s.astype(y.dtype), axis=-2)
 
 
 def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     """``x @ w`` where w may be an int8 or int4 quantized (q, scale) tuple."""
     if isinstance(w, tuple):
         q, scale = w
-        if q.dtype == jnp.int4:
+        # grouped (int4) scheme carries a per-group scale axis the
+        # per-output-channel int8 scheme doesn't have
+        if scale.ndim == q.ndim + 1:
             return _matmul_int4(x, q, scale)
         # int8 read from HBM; convert fuses into the matmul, scale into its
         # epilogue (output columns), so this is exact w.r.t. x @ (q*scale)

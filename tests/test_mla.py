@@ -234,7 +234,7 @@ def test_int4_weights_mla_skips_wkv_b():
 
     params = init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
     q4 = quantize_params_int8(quantize_params_int4(params))
-    assert str(q4["layers"]["wq"][0].dtype) == "int4"
+    assert str(q4["layers"]["wq"][0].dtype) == "uint8"
     assert str(q4["layers"]["wkv_b"][0].dtype) == "int8"
     tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 8), 0, CFG.vocab_size)
     logits, _ = forward(q4, tokens, CFG)

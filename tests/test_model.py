@@ -401,9 +401,15 @@ def test_int4_weights_matmul_exact_and_bytes_quartered(params):
 
     w = jax.random.normal(jax.random.PRNGKey(0), (256, 96)) * 0.02
     q, s = quantize_weight_int4(w)
-    assert str(q.dtype) == "int4" and s.shape == (2, 1, 96)  # groups of 128
+    # nibble-packed uint8 carrier: half the rows, two weights per byte
+    assert str(q.dtype) == "uint8" and q.shape == (128, 96)
+    assert s.shape == (2, 1, 96)  # groups of 128
     x = jax.random.normal(jax.random.PRNGKey(1), (4, 256))
-    dequant = (q.astype(jnp.float32).reshape(2, 128, 96) * s).reshape(256, 96)
+    from prime_tpu.models.quantize import _unpack_nibbles
+
+    lo, hi = _unpack_nibbles(q.reshape(2, 64, 96))
+    unpacked = jnp.concatenate([lo, hi], axis=-2)  # (2, 128, 96) int8
+    dequant = (unpacked.astype(jnp.float32) * s).reshape(256, 96)
     assert np.abs(np.asarray(matmul(x, (q, s)) - x @ dequant)).max() < 1e-4
     # 4-bit quantization noise is bounded for well-scaled weights
     rel = float(jnp.linalg.norm(matmul(x, (q, s)) - x @ w) / jnp.linalg.norm(x @ w))
@@ -422,7 +428,7 @@ def test_int4_weights_generate_and_compose_with_int8(params):
     from prime_tpu.models.sampler import generate
 
     q4 = quantize_params_int8(quantize_params_int4(params))
-    assert str(q4["layers"]["wq"][0].dtype) == "int4"  # int8 pass left it alone
+    assert str(q4["layers"]["wq"][0].dtype) == "uint8"  # int8 pass left it alone
     tokens = jax.random.randint(jax.random.PRNGKey(8), (2, 6), 1, CFG.vocab_size)
     lengths = jnp.asarray([6, 4], jnp.int32)
     result = generate(q4, tokens, lengths, CFG, jax.random.PRNGKey(9), max_new_tokens=4)
@@ -438,7 +444,7 @@ def test_int4_generator_weight_bits(tmp_path):
     from prime_tpu.evals.runner import JaxGenerator
 
     gen = JaxGenerator("tiny-test", weight_quant="int4")
-    assert str(gen.params["layers"]["wq"][0].dtype) == "int4"
+    assert str(gen.params["layers"]["wq"][0].dtype) == "uint8"
     [out] = gen.generate(["2+2="], max_new_tokens=4, temperature=0.0)
     assert isinstance(out, str)
 
