@@ -67,7 +67,7 @@ def _flash_kernel(
     q_ref,       # (BLOCK_Q, D)
     k_ref,       # (S, D)  one kv head, full length
     v_ref,       # (S, D)
-    sinks_ref,   # (1, 1) this q head's sink logit
+    sinks_ref,   # (H, 1) all sink logits; row picked by program id
     o_ref,       # (BLOCK_Q, D)
     *,
     sm_scale: float,
@@ -124,7 +124,8 @@ def _flash_kernel(
     )
     m, l, acc = jax.lax.fori_loop(band_start, last_block, body, (m, l, acc))
 
-    sink = sinks_ref[0, 0].astype(jnp.float32) if use_sinks else None
+    # full-array sinks block (see flash_decode): slice this head's row here
+    sink = sinks_ref[pl.program_id(1), 0].astype(jnp.float32) if use_sinks else None
     o_ref[0, 0, :, :] = _finalize_attention(acc, m, l, sink).astype(o_ref.dtype)
 
 
@@ -180,7 +181,13 @@ def _decode_body(
     start_block = first_slot // block_c
     num_blocks = pl.cdiv(length, block_c)
     m, l, acc = jax.lax.fori_loop(start_block, num_blocks, body, (m, l, acc))
-    sink = sinks_ref[0].astype(jnp.float32).reshape(group, 1) if use_sinks else None
+    # the sinks block is the FULL (KH, G) array (a (1, G) slice would break
+    # the TPU lowering's sublane-divisibility rule); pick this program's row
+    sink = (
+        sinks_ref[pl.program_id(1)].astype(jnp.float32).reshape(group, 1)
+        if use_sinks
+        else None
+    )
     o_ref[0, 0] = _finalize_attention(acc, m, l, sink).astype(o_ref.dtype)
 
 
@@ -190,7 +197,7 @@ def _decode_kernel(
     q_ref,        # (1, 1, G, D)
     k_ref,        # (1, 1, D, C) one kv head's cache, feature-major
     v_ref,        # (1, 1, D, C)
-    sinks_ref,    # (1, G) this kv head's group of sink logits
+    sinks_ref,    # (KH, G) all sink logits; rows picked by program id
     o_ref,        # (1, 1, G, D)
     *,
     sm_scale: float,
@@ -217,7 +224,7 @@ def _decode_kernel_int8(
     v_ref,         # (1, 1, D, C) int8
     k_scale_ref,   # (1, 1, 1, C) per-slot dequant scales
     v_scale_ref,   # (1, 1, 1, C)
-    sinks_ref,     # (1, G)
+    sinks_ref,     # (KH, G) all sink logits; rows picked by program id
     o_ref,         # (1, 1, G, D)
     *,
     sm_scale: float,
@@ -294,7 +301,7 @@ def flash_decode(
         pl.BlockSpec((1, 1, 1, capacity), lambda b, h, *_: (b, h, 0, 0)),
         pl.BlockSpec((1, 1, 1, capacity), lambda b, h, *_: (b, h, 0, 0)),
     ]
-    sinks_spec = pl.BlockSpec((1, group), lambda b, h, *_: (h, 0))
+    sinks_spec = pl.BlockSpec((kv_heads, group), lambda b, h, *_: (0, 0))
     common = dict(sm_scale=sm_scale, block_c=block_c, softcap=softcap, use_sinks=use_sinks)
     if quantized:
         kernel = functools.partial(_decode_kernel_int8, **common)
@@ -371,7 +378,7 @@ def flash_attention_causal(
             pl.BlockSpec((1, 1, BLOCK_Q, head_dim), lambda b, h, qb, *_: (b, h, qb, 0)),
             pl.BlockSpec((1, 1, seq_len, head_dim), lambda b, h, qb, *_: (b, h // group, 0, 0)),
             pl.BlockSpec((1, 1, seq_len, head_dim), lambda b, h, qb, *_: (b, h // group, 0, 0)),
-            pl.BlockSpec((1, 1), lambda b, h, qb, *_: (h, 0)),
+            pl.BlockSpec((num_heads, 1), lambda b, h, qb, *_: (0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, BLOCK_Q, head_dim), lambda b, h, qb, *_: (b, h, qb, 0)),
     )
