@@ -24,6 +24,7 @@ vs_baseline is the ratio against PREV_DECODE_TOK_S — this repo's round-1
 measured anchor.
 """
 
+import functools
 import json
 import os
 import subprocess
@@ -58,7 +59,10 @@ if SMOKE:
     # is too late (the axon site hook reads them at interpreter start, and a
     # down tunnel then blocks backend init forever — exactly when smoke gets
     # used), so re-exec once with a scrubbed environment.
-    if os.environ.get("PRIME_BENCH_SMOKE_REEXEC") != "1":
+    # the re-exec is for `python bench.py` runs ONLY: an importer (e.g.
+    # scripts/serve_profile.py borrowing the serve scenario) must never have
+    # its process silently replaced by a smoke bench
+    if __name__ == "__main__" and os.environ.get("PRIME_BENCH_SMOKE_REEXEC") != "1":
         env = dict(
             os.environ,
             JAX_PLATFORMS="cpu",
@@ -70,6 +74,24 @@ BATCH = 2 if SMOKE else 8
 PROMPT_LEN = 16 if SMOKE else 128
 NEW_TOKENS = 8 if SMOKE else 128
 MODEL = "tiny-test" if SMOKE else "llama3.2-1b"
+
+# serve-section scenario, module-level so scripts/serve_profile.py profiles
+# EXACTLY the workload the bench measures (tuning one tunes both)
+SERVE_N_REQ, SERVE_NEW = (4, 8) if SMOKE else (16, 64)
+SERVE_PROMPT_LEN = 24 if SMOKE else 96
+SERVE_SLOTS = 8
+SERVE_CHUNK = 8
+SERVE_CAPACITY = 1024
+
+
+def serve_prompts_for(config) -> list[list[int]]:
+    """The serve scenario's deterministic prompt set (no shared prefixes
+    between requests, so admissions exercise cold prefill)."""
+    return [
+        [1]
+        + [(7 * (i + j)) % (config.vocab_size - 3) + 3 for j in range(SERVE_PROMPT_LEN)]
+        for i in range(SERVE_N_REQ)
+    ]
 
 # Observed on the axon tunnel (scripts/tpu_watch.sh, round 3): a trivial
 # matmul probe SUCCEEDS but takes ~150 s end-to-end (interpreter + PJRT
@@ -351,6 +373,45 @@ def main() -> None:
             best_s = min(best_s, time.perf_counter() - t0)
         return best_s
 
+    def time_op(op, q0, operands, iters=None) -> float:
+        """Per-invocation seconds for a decode-shaped op, with dispatch and
+        tunnel-transfer overhead cancelled out: jit a fori_loop that feeds the
+        op's output back into the query (the data dependency serializes the
+        chain), time a short and a long chain, and attribute the difference
+        to the extra iterations. Operands ride as jit ARGUMENTS — a closure
+        constant is re-shipped by a tunneled backend on every call, which made
+        the first cut of these microbenches report tunnel RTT (~32 ms/op,
+        0.5 GB/s "achieved") instead of kernel time. ``op(q, *operands)``
+        must return an array of q's shape."""
+        if iters is None:
+            # smoke runs pallas in interpret mode where every chained
+            # iteration costs milliseconds — keep the chains token-length
+            iters = (2, 12) if SMOKE else (10, 510)
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def chain(q, ops, n):
+            def body(_, q_cur):
+                # the tiny scaled add keeps values bounded across 500 hops
+                # while making every iteration depend on the previous one
+                return q_cur + op(q_cur, *ops) * 1e-6
+            return jax.lax.fori_loop(0, n, body, q)
+
+        short, long_ = iters
+        t_short = time_fn(
+            lambda: float(jnp.sum(chain(q0, operands, short))), iterations=3
+        )
+        t_long = time_fn(
+            lambda: float(jnp.sum(chain(q0, operands, long_))), iterations=3
+        )
+        if t_long <= t_short:
+            # timing noise inverted the chains: a silently-floored difference
+            # would record a ~10^6x phantom speedup as if it were real
+            raise RuntimeError(
+                f"timing inversion (t_long {t_long:.4f}s <= t_short "
+                f"{t_short:.4f}s): backend too noisy for this microbench"
+            )
+        return (t_long - t_short) / (long_ - short)
+
     def run_generate(**kw):
         result = generate(
             params, prompts, lengths, config, jax.random.PRNGKey(2),
@@ -461,13 +522,10 @@ def main() -> None:
     print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
     # ---- serve: continuous-batching engine under concurrent load ------------
-    n_req, req_new = (4, 8) if SMOKE else (16, 64)
-    serve_prompt_len = 24 if SMOKE else 96
-    serve_slots = 8
-    serve_prompts = [
-        [1] + [(7 * (i + j)) % (config.vocab_size - 3) + 3 for j in range(serve_prompt_len)]
-        for i in range(n_req)
-    ]
+    n_req, req_new = SERVE_N_REQ, SERVE_NEW
+    serve_prompt_len = SERVE_PROMPT_LEN
+    serve_slots = SERVE_SLOTS
+    serve_prompts = serve_prompts_for(config)
 
     def run_serve(
         kv_quant: bool = False, speculative: bool = False, prompts=None
@@ -476,14 +534,20 @@ def main() -> None:
 
         prompts = prompts or serve_prompts
         engine = ContinuousBatchingEngine(
-            params, config, pad_id=0, max_slots=serve_slots, capacity=1024, chunk=8,
+            params, config, pad_id=0, max_slots=serve_slots,
+            capacity=SERVE_CAPACITY, chunk=SERVE_CHUNK,
             kv_quant=kv_quant, speculative=speculative,
         )
         try:
-            # warmup: compile prefill/decode/finalize for the buckets in play
-            warm = engine.submit(prompts[0], max_new_tokens=req_new)
-            while not warm.done:
-                engine.tick()
+            # warmup: compile prefill/decode/finalize for the buckets in play.
+            # TWO passes over the same prompt: the second admission hits the
+            # prompt-prefix KV cache and prefills only the suffix — a
+            # DIFFERENT chunk shape whose first compile would otherwise land
+            # mid-measurement (remote TPU compiles cost seconds each)
+            for _ in range(2):
+                warm = engine.submit(prompts[0], max_new_tokens=req_new)
+                while not warm.done:
+                    engine.tick()
             t0 = time.perf_counter()
             reqs = [engine.submit(ids, max_new_tokens=req_new) for ids in prompts]
             while not all(r.done for r in reqs):
@@ -803,22 +867,19 @@ def main() -> None:
         wv = jax.random.normal(jax.random.PRNGKey(9), (wb, wkh, wd, wc), dtype=jnp.bfloat16)
         wlens = jnp.full((wb,), wc, dtype=jnp.int32)
 
-        # both sides jitted: an eager XLA baseline would pay per-op dispatch
-        # at this microsecond scale and flatter the kernel (spdecode's scheme)
-        win_xla_fn = jax.jit(
-            lambda: decode_attention(
-                wq, wk, wv, wlens, wd**-0.5, impl="xla", window=wwin,
+        # chained in-jit timing (time_op): a single dispatch per wall-clock
+        # sample is pure tunnel RTT at this microsecond scale — both sides
+        # run a serialized chain of ops and the overhead cancels in the
+        # long-minus-short difference
+        def win_op(impl):
+            return lambda qc, k, v, lens: decode_attention(
+                qc, k, v, lens, wd**-0.5, impl=impl, window=wwin,
                 sliding=jnp.asarray(True),
             )
-        )
-        win_pallas_fn = jax.jit(
-            lambda: decode_attention(
-                wq, wk, wv, wlens, wd**-0.5, impl="pallas", window=wwin,
-                sliding=jnp.asarray(True),
-            )
-        )
-        win_xla_s = time_fn(lambda: float(jnp.sum(win_xla_fn())), iterations=5)
-        win_pallas_s = time_fn(lambda: float(jnp.sum(win_pallas_fn())), iterations=5)
+
+        win_ops = (wk, wv, wlens)
+        win_xla_s = time_op(win_op("xla"), wq, win_ops)
+        win_pallas_s = time_op(win_op("pallas"), wq, win_ops)
         record["winctx_xla_us"] = round(win_xla_s * 1e6, 1)
         record["winctx_pallas_us"] = round(win_pallas_s * 1e6, 1)
         record["winctx_pallas_speedup"] = round(win_xla_s / win_pallas_s, 3)
@@ -859,12 +920,19 @@ def main() -> None:
         sp_v = jax.random.normal(jax.random.PRNGKey(6), (sp_b, sp_kh, sp_d, sp_c), dtype=jnp.bfloat16)
         sp_lens = jnp.full((sp_b,), sp_c, dtype=jnp.int32)
         mesh1 = make_mesh({"sp": 1})
-        plain_fn = jax.jit(
-            lambda: decode_attention(sp_q, sp_k, sp_v, sp_lens, sp_d**-0.5, impl="xla")
+        # chained in-jit timing (time_op) for the same reason as winctx: one
+        # dispatch per sample measures tunnel RTT, not the op
+        sp_operands = (sp_k, sp_v, sp_lens)
+        plain_s = time_op(
+            lambda qc, k, v, lens: decode_attention(
+                qc, k, v, lens, sp_d**-0.5, impl="xla"
+            ),
+            sp_q, sp_operands,
         )
-        sp_fn = jax.jit(lambda: sp_decode_attention(sp_q, sp_k, sp_v, sp_lens, mesh1))
-        plain_s = time_fn(lambda: float(jnp.sum(plain_fn())), iterations=5)
-        sp_s = time_fn(lambda: float(jnp.sum(sp_fn())), iterations=5)
+        sp_s = time_op(
+            lambda qc, k, v, lens: sp_decode_attention(qc, k, v, lens, mesh1),
+            sp_q, sp_operands,
+        )
         record["spdecode_plain_us"] = round(plain_s * 1e6, 1)
         record["spdecode_sp_us"] = round(sp_s * 1e6, 1)
         record["spdecode_overhead"] = round(sp_s / plain_s, 3)

@@ -492,9 +492,15 @@ def forward(
     remat: str = "none",  # "none" | "full" | "dots" — training-path rematerialization
     longrope_select: int | None = None,  # static run-length bound for LongRoPE
     ring_mesh=None,  # attn_impl="ring": mesh whose `sp` axis shards the sequence
+    last_positions: jnp.ndarray | None = None,  # (B,) → logits only at these rows
 ):
     """Run the transformer. Returns (logits (B, S, V) fp32, updated cache),
     plus the summed MoE load-balance aux loss when ``return_aux``.
+    With ``last_positions`` the head matmul runs on ONE gathered position per
+    row and logits are (B, 1, V): a prefill that only needs each sequence's
+    next-token logits skips S× the unembedding FLOPs and never materializes
+    the (B, S, V) fp32 buffer (~8 GB at B=4, S=4k, llama vocab — observed
+    crashing the remote TPU compile helper before this path existed).
 
     - training:        cache=None, decode=False
     - prefill:         cache=init_cache(...), decode=False
@@ -702,6 +708,11 @@ def forward(
         new_cache = None
 
     x = _norm(x, params["final_norm"], config)
+    if last_positions is not None:
+        # gather BEFORE the head matmul (see docstring)
+        x = jnp.take_along_axis(
+            x, last_positions.astype(jnp.int32)[:, None, None], axis=1
+        )
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
     logits = _apply_softcap((x @ head).astype(jnp.float32), config.final_softcap)
     if return_aux:

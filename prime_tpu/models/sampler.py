@@ -103,14 +103,16 @@ def run_prefill(
                 k_scale=jax.lax.with_sharding_constraint(cache.k_scale, cache_spec),
                 v_scale=jax.lax.with_sharding_constraint(cache.v_scale, cache_spec),
             )
+    # next-token logits live at each sequence's last real position — gather
+    # it inside forward, before the unembedding (skips S× the head FLOPs and
+    # the (B, S, V) fp32 logits buffer, which at long context dwarfs HBM)
     logits, cache = forward(
-        params, prompt_tokens, config, cache=cache, decode=False, attn_impl=attn_impl
+        params, prompt_tokens, config, cache=cache, decode=False,
+        attn_impl=attn_impl, last_positions=prompt_lengths - 1,
     )
     # cache was filled for the padded length; true lengths are per-sequence
     cache = cache._replace(lengths=prompt_lengths.astype(jnp.int32))
-    # next-token logits live at each sequence's last real position
-    last = jnp.take_along_axis(logits, (prompt_lengths - 1)[:, None, None], axis=1)[:, 0, :]
-    return last, cache
+    return logits[:, 0, :], cache
 
 
 def finalize_tokens(

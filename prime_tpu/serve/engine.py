@@ -299,15 +299,20 @@ class ContinuousBatchingEngine:
 
         config, attn_impl = self.config, self.attn_impl
 
-        def chunk_prefill(params, row, tokens, offset):
+        def chunk_prefill(params, row, tokens, offset, last_in_chunk):
             # write-at-offset + attend-over-row (models.llama chunked prefill):
             # the staging row pytree is donated, so chunks update it in place
-            # (scale leaves ride along on int8 caches)
+            # (scale leaves ride along on int8 caches). Only ONE position's
+            # logits ever get used (the prompt's last, in the final chunk), so
+            # gather it before the unembedding: a (1, chunk, V) fp32 logits
+            # buffer plus chunk x the head FLOPs per chunk would be pure waste
+            # on the admission hot path (non-final chunks' logits are unused).
             logits, row = forward(
                 params, tokens, config, cache=row, decode=False,
                 attn_impl=attn_impl, prefill_offset=offset,
+                last_positions=last_in_chunk,
             )
-            return row, logits
+            return row, logits  # logits (1, 1, V): the gathered position only
 
         return jax.jit(chunk_prefill, donate_argnums=(1,))
 
@@ -319,12 +324,13 @@ class ContinuousBatchingEngine:
 
         def finalize(
             cache, last, temps, top_ps,
-            row, chunk_logits, last_idx, length, slot, temp, top_p, rng,
+            row, chunk_logits, length, slot, temp, top_p, rng,
         ):
             # splice the staged row into the engine cache at ``slot`` (the
             # engine cache is donated; the row is NOT — it may live on in the
-            # prefix cache) and sample the first token from the prompt's last
-            # real position within the final chunk
+            # prefix cache) and sample the first token from the prompt's
+            # last-position logits (chunk_fn already gathered that row:
+            # chunk_logits is (1, 1, V))
             zero = jnp.zeros((), jnp.int32)
 
             def splice(cache_leaf, row_leaf):
@@ -341,10 +347,9 @@ class ContinuousBatchingEngine:
                     k_scale=splice(cache.k_scale, row.k_scale),
                     v_scale=splice(cache.v_scale, row.v_scale),
                 )
-            last_logits = jax.lax.dynamic_slice(
-                chunk_logits, (zero, last_idx, zero), (1, 1, chunk_logits.shape[-1])
-            )[0, 0]
-            first = _sample_batch(last_logits[None, :], temp[None], top_p[None], rng)[0]
+            first = _sample_batch(
+                chunk_logits[0], temp[None], top_p[None], rng
+            )[0]
             # the first sampled token's KV is not in the cache yet: the next
             # decode step writes it at position ``length`` (put() scatters at
             # cache_lengths), so the slot length stays the prompt length here
@@ -691,22 +696,25 @@ class ContinuousBatchingEngine:
         start, row = self._prefix_seed(ids, row_cb)
         plan = chunk_plan(start, len(ids), self.prefill_chunk, row_cb)
         logits = None
-        last_idx = 0
         self._rng, rng = jax.random.split(self._rng)
         with self._mesh_ctx():
             for off, size in plan:
                 chunk_ids = ids[off : off + size]
                 chunk_ids += [self.pad_id] * (size - len(chunk_ids))
                 tokens = jnp.asarray([chunk_ids], dtype=jnp.int32)
+                # chunk-relative last prompt position, clamped into this
+                # chunk: the gathered row only matters for the final chunk
+                # (finalize consumes that one), clamping keeps earlier
+                # chunks' gathers in bounds
+                rel = min(max(len(ids) - 1 - off, 0), size - 1)
                 row, logits = self._chunk_fn(
                     self.params, row, tokens, jnp.asarray(off, dtype=jnp.int32),
+                    jnp.asarray([rel], dtype=jnp.int32),
                 )
-                last_idx = len(ids) - 1 - off  # prompt's last position, chunk-relative
             (
                 self._cache, self._last, self._temps, self._top_ps, first,
             ) = self._finalize_fn(
                 self._cache, self._last, self._temps, self._top_ps, row, logits,
-                jnp.asarray(last_idx, dtype=jnp.int32),
                 jnp.asarray(len(ids), dtype=jnp.int32),
                 jnp.asarray(slot, dtype=jnp.int32),
                 jnp.asarray(req.temperature, dtype=jnp.float32),

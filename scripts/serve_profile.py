@@ -1,0 +1,107 @@
+"""Phase-level profile of the continuous-batching engine's bench scenario.
+
+Answers ONE question: where does the serve bench's wall-clock go on the real
+chip — admissions (prefill dispatches), decode chunks, or mid-run XLA
+compiles? The serve roofline in bench.py says ~2% of HBM peak, which means
+the engine is host/dispatch-bound there, not bandwidth-bound; this script
+attributes the time so the fix targets the right layer.
+
+Usage: python scripts/serve_profile.py  (single real chip; ~2 min)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from prime_tpu.models import get_config
+from prime_tpu.models.llama import init_params
+from prime_tpu.serve.engine import ContinuousBatchingEngine
+
+TIMES: dict[str, float] = defaultdict(float)
+COUNTS: dict[str, int] = defaultdict(int)
+
+
+def _wrap(obj, name: str) -> None:
+    """Time a method into TIMES[name], EXCLUDING any XLA-compile seconds that
+    fire inside it (they land in TIMES['xla_compile'] via the compiler spy) —
+    the report's buckets must be disjoint or mid-run compiles get attributed
+    to the phase they happened to fire in."""
+    fn = getattr(obj, name)
+
+    def timed(*a, **k):
+        compile_before = TIMES["xla_compile"]
+        t0 = time.perf_counter()
+        out = fn(*a, **k)
+        elapsed = time.perf_counter() - t0
+        TIMES[name] += elapsed - (TIMES["xla_compile"] - compile_before)
+        COUNTS[name] += 1
+        return out
+
+    setattr(obj, name, timed)
+
+
+def main() -> None:
+    # the scenario comes from bench.py so this profiles EXACTLY the workload
+    # the bench's serve section measures
+    import bench
+
+    config = get_config("llama3.2-1b")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.bfloat16)
+    req_new = bench.SERVE_NEW
+    prompts = bench.serve_prompts_for(config)
+    engine = ContinuousBatchingEngine(
+        params, config, pad_id=0, max_slots=bench.SERVE_SLOTS,
+        capacity=bench.SERVE_CAPACITY, chunk=bench.SERVE_CHUNK,
+    )
+    # count XLA compiles (remote compiles over the tunnel cost seconds each)
+    import jax._src.compiler as _c
+
+    cname = (
+        "backend_compile_and_load"
+        if hasattr(_c, "backend_compile_and_load")
+        else "backend_compile"
+    )
+    real_compile = getattr(_c, cname)
+
+    def spy(*a, **k):
+        t0 = time.perf_counter()
+        out = real_compile(*a, **k)
+        TIMES["xla_compile"] += time.perf_counter() - t0
+        COUNTS["xla_compile"] += 1
+        return out
+
+    setattr(_c, cname, spy)
+
+    _wrap(engine, "_prefill")
+    _wrap(engine, "_decode_chunk")
+    for phase in ("warm1", "warm2", "measured"):
+        TIMES.clear()
+        COUNTS.clear()
+        t0 = time.perf_counter()
+        if phase.startswith("warm"):
+            reqs = [engine.submit(prompts[0], max_new_tokens=req_new)]
+        else:
+            reqs = [engine.submit(ids, max_new_tokens=req_new) for ids in prompts]
+        while not all(r.done for r in reqs):
+            engine.tick()
+        elapsed = time.perf_counter() - t0
+        total = sum(len(r.all_tokens(timeout=1)) for r in reqs)
+        print(f"--- {phase}: {total} tokens in {elapsed:.2f}s = {total/elapsed:.1f} tok/s")
+        for k in sorted(TIMES):
+            print(f"    {k}: {TIMES[k]:.2f}s over {COUNTS[k]} calls")
+        other = elapsed - sum(
+            TIMES[k] for k in ("_prefill", "_decode_chunk", "xla_compile")
+        )
+        print(f"    other (host glue): {other:.2f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,23 @@ def test_forward_shapes_and_determinism(params):
     np.testing.assert_array_equal(np.asarray(logits), np.asarray(logits2))
 
 
+def test_last_positions_matches_full_logits(params):
+    """forward(last_positions=...) == gathering those rows from full logits.
+
+    The gathered-before-unembedding prefill path (models/llama.py forward)
+    must be numerically identical to slicing the full (B, S, V) logits —
+    it exists so long-context prefill never materializes that buffer."""
+    tokens = jax.random.randint(jax.random.PRNGKey(11), (3, 16), 0, CFG.vocab_size)
+    lengths = jnp.asarray([16, 9, 4], dtype=jnp.int32)
+    full_logits, _ = forward(params, tokens, CFG)
+    last_logits, _ = forward(params, tokens, CFG, last_positions=lengths - 1)
+    assert last_logits.shape == (3, 1, CFG.vocab_size)
+    expect = np.take_along_axis(
+        np.asarray(full_logits), np.asarray(lengths - 1)[:, None, None], axis=1
+    )
+    np.testing.assert_allclose(np.asarray(last_logits), expect, rtol=1e-5, atol=1e-5)
+
+
 def test_causality(params):
     """Changing a future token must not change past logits."""
     tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 12), 0, CFG.vocab_size)
