@@ -130,15 +130,58 @@ def _unpack_nibbles(packed: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     return lo, hi
 
 
+def _int4_pallas_eligible(x: jnp.ndarray, q: jnp.ndarray, interpret: bool) -> bool:
+    """Gate the fused pallas int4 kernel to the regime it exists for: the
+    decode/gemv path on TPU (few activation rows, per-layer 2-D packed
+    weights, lane-aligned output). Prefill and training keep the XLA path —
+    they are MXU-bound, not weight-bandwidth-bound — as do stacked
+    (pre-scan-slice) weights and CPU runs (unless interpret mode is forced
+    for tests)."""
+    import numpy as np
+
+    if q.ndim != 2 or q.dtype != jnp.uint8:
+        return False
+    if q.shape[-1] % 128:
+        return False
+    rows = int(np.prod(x.shape[:-1]))
+    if rows > 32:
+        return False
+    return interpret or jax.default_backend() == "tpu"
+
+
 def _matmul_int4(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
     """Per-group partial matmuls, scaled then summed over groups: exact
     w.r.t. ``x @ dequant(q, scale)`` up to fp accumulation order. ``q`` is
     nibble-packed uint8 (rows = d_in/2) or, for an odd reduction dim, an
-    unpacked int8 carrier (rows = d_in)."""
+    unpacked int8 carrier (rows = d_in).
+
+    On TPU in the decode regime the packed case dispatches to the fused
+    pallas kernel (ops/pallas_quant.py): XLA materializes the unpack chain's
+    intermediates to HBM, forfeiting the nibble packing's bandwidth halving;
+    the kernel unpacks in VMEM so HBM streams exactly the packed bytes."""
     d_in = x.shape[-1]
     d_out = q.shape[-1]
     groups = scale.shape[-3]
     g = d_in // groups
+    # the kernel's in-loop activation slice is on the LANE dim: group
+    # boundaries must be 128-aligned (always true for INT4_GROUP=128; a
+    # single whole-dim group is the full lane dim, also fine)
+    lane_aligned = g % 128 == 0 or groups == 1
+    from prime_tpu.ops.attention import _pallas_interpret
+
+    interpret = _pallas_interpret()
+    if (
+        q.shape[-2] * 2 == d_in
+        and lane_aligned
+        and _int4_pallas_eligible(x, q, interpret)
+    ):
+        from prime_tpu.ops.pallas_quant import int4_matmul
+
+        y = int4_matmul(
+            x.reshape(-1, d_in), q, scale[..., 0, :].astype(jnp.float32),
+            interpret=interpret,
+        )
+        return y.reshape(*x.shape[:-1], d_out)
     xg = x.reshape(*x.shape[:-1], groups, g)
     s = scale[..., 0, :]  # (..., groups, out)
     if q.shape[-2] == d_in:  # odd-group int8 carrier

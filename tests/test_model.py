@@ -457,6 +457,53 @@ def test_int4_weights_generate_and_compose_with_int8(params):
     assert result.tokens.shape == (2, 4)
 
 
+def test_int4_pallas_kernel_matches_xla_path(params, monkeypatch):
+    """The fused pallas int4 matmul (ops/pallas_quant.py) must match the XLA
+    grouped-partial path bit-for-bit up to fp accumulation order, across the
+    gemv shapes the decode regime dispatches (tall, wide, single-row), and
+    the dispatch itself must hold end-to-end through generate() when
+    interpret mode marks the kernel eligible off-TPU."""
+    from prime_tpu.models.quantize import _matmul_int4, quantize_weight_int4
+    from prime_tpu.models.sampler import generate
+    from prime_tpu.ops.pallas_quant import int4_matmul
+
+    # the references below must come from the XLA path: if interpret mode
+    # leaked in from the environment the kernel would be compared to itself
+    monkeypatch.delenv("PRIME_TPU_PALLAS_INTERPRET", raising=False)
+    # 896 regression: a multiple of 128 but not of the 512 preferred block —
+    # the kernel must pick a dividing block, not floor-drop tail columns
+    for i, (din, dout, rows) in enumerate(
+        [(256, 128, 8), (512, 256, 1), (256, 384, 3), (256, 896, 4)]
+    ):
+        w = jax.random.normal(jax.random.PRNGKey(i), (din, dout)) * 0.02
+        q, s = quantize_weight_int4(w)
+        x = jax.random.normal(jax.random.PRNGKey(10 + i), (rows, din))
+        ref = _matmul_int4(x, q, s)  # XLA path (kernel ineligible off-TPU)
+        out = int4_matmul(x, q, s[..., 0, :].astype(jnp.float32), interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+    # end-to-end: interpret mode flips eligibility on (checked at trace
+    # time), so the second generate uses a DIFFERENT max_new_tokens to force
+    # a retrace — greedy tokens over the common prefix must agree exactly
+    from prime_tpu.models.quantize import quantize_params_int4
+
+    q4 = quantize_params_int4(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(20), (2, 6), 1, CFG.vocab_size)
+    lengths = jnp.asarray([6, 5], jnp.int32)
+    plain = generate(
+        q4, tokens, lengths, CFG, jax.random.PRNGKey(0),
+        max_new_tokens=4, temperature=0.0,
+    )
+    monkeypatch.setenv("PRIME_TPU_PALLAS_INTERPRET", "1")
+    kernel = generate(
+        q4, tokens, lengths, CFG, jax.random.PRNGKey(0),
+        max_new_tokens=5, temperature=0.0,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(plain.tokens), np.asarray(kernel.tokens[:, :4])
+    )
+
+
 def test_int4_generator_weight_bits(tmp_path):
     from prime_tpu.evals.runner import JaxGenerator
 
