@@ -129,124 +129,112 @@ def _flash_kernel(
     o_ref[0, 0, :, :] = _finalize_attention(acc, m, l, sink).astype(o_ref.dtype)
 
 
-def _decode_body(
-    lengths_ref, window_ref, q_ref, sinks_ref, o_ref, load_block,
-    *, sm_scale, block_c, softcap, use_sinks,
-):
-    """Shared online-softmax decode loop. ``load_block(cb)`` returns this
-    cache block's (k (D, BC) fp32-effective, v (D, BC), k_colscale, v_colscale)
-    — the per-slot int8 dequant scales fold into the score/value epilogues
-    exactly (column scales are constant over the contracted D axis)."""
-    b = pl.program_id(0)
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (G, D)
-    group = q.shape[0]
+def _decode_live_block(b, cb, lengths_ref, window_ref, block_c: int):
+    """The cache block this grid step should have resident: the block
+    coordinate clipped into the sequence's live [first, last] range. Out-of-
+    range steps REVISIT an edge block — Mosaic elides the operand copy when
+    the index map returns the same block as the previous iteration, so the
+    clip turns the mask-level early exit into an actual HBM-bytes saving
+    (the previous design DMA'd the full capacity into VMEM per program and
+    the fori_loop skip saved only compute, which is why XLA's read-it-all
+    path kept winning the microbenches)."""
     length = lengths_ref[b]
     window = window_ref[0]
-    # the query sits at position length-1; a sliding layer sees slots
-    # [length-window, length), a global layer (window 0) sees [0, length)
+    num = jnp.maximum(pl.cdiv(length, block_c), 1)
     first_slot = jnp.where(window > 0, jnp.maximum(length - window, 0), 0)
-
-    m = jnp.full((group, 1), NEG_INF, dtype=jnp.float32)
-    l = jnp.zeros((group, 1), dtype=jnp.float32)
-    acc = jnp.zeros(q.shape, dtype=jnp.float32)
-
-    def body(cb, carry):
-        m_prev, l_prev, acc_prev = carry
-        k, v, k_colscale, v_colscale = load_block(cb)
-        scores = jax.lax.dot_general(
-            q, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (G, BC)
-        if k_colscale is not None:
-            scores = scores * k_colscale  # (1, BC) broadcasts over G
-        if softcap:
-            scores = jnp.tanh(scores / softcap) * softcap
-        slots = cb * block_c + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        scores = jnp.where((slots < length) & (slots >= first_slot), scores, NEG_INF)
-
-        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
-        p = jnp.exp(scores - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        weighted = p if v_colscale is None else p * v_colscale
-        acc_new = acc_prev * alpha + jax.lax.dot_general(
-            weighted, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (G, D)
-        return m_new, l_new, acc_new
-
-    # early exit BOTH ways: only stream cache blocks that hold live entries
-    # for THIS sequence — from the back that is the valid length
-    # (mid-generation ~half the capacity), and on a sliding layer the front
-    # skip leaves only ~window/block_c blocks; the decode step is pure HBM
-    # bandwidth, so every skipped block is direct speedup
-    start_block = first_slot // block_c
-    num_blocks = pl.cdiv(length, block_c)
-    m, l, acc = jax.lax.fori_loop(start_block, num_blocks, body, (m, l, acc))
-    # the sinks block is the FULL (KH, G) array (a (1, G) slice would break
-    # the TPU lowering's sublane-divisibility rule); pick this program's row
-    sink = (
-        sinks_ref[pl.program_id(1)].astype(jnp.float32).reshape(group, 1)
-        if use_sinks
-        else None
-    )
-    o_ref[0, 0] = _finalize_attention(acc, m, l, sink).astype(o_ref.dtype)
+    first = first_slot // block_c
+    return jnp.clip(cb, first, jnp.maximum(num - 1, first))
 
 
 def _decode_kernel(
     lengths_ref,  # (B,) scalar-prefetch, SMEM
     window_ref,   # (1,) scalar-prefetch: effective window (0 = global layer)
     q_ref,        # (1, 1, G, D)
-    k_ref,        # (1, 1, D, C) one kv head's cache, feature-major
-    v_ref,        # (1, 1, D, C)
-    sinks_ref,    # (KH, G) all sink logits; rows picked by program id
-    o_ref,        # (1, 1, G, D)
-    *,
+    k_ref,        # (1, 1, D, BLOCK_C) the live cache block for this step
+    v_ref,        # (1, 1, D, BLOCK_C)
+    *rest,        # int8 path: k_scale_ref, v_scale_ref (1, 1, 1, BLOCK_C);
+                  # then sinks_ref (KH, G), o_ref (1, 1, G, D),
+                  # scratch: m (G, 128), l (G, 128), acc (G, D) — all fp32,
+                  # carried across the cache-block grid dimension
     sm_scale: float,
     block_c: int,
     softcap: float,
     use_sinks: bool,
+    quantized: bool,
 ):
-    def load_block(cb):
-        k = k_ref[0, 0, :, pl.ds(cb * block_c, block_c)].astype(jnp.float32)
-        v = v_ref[0, 0, :, pl.ds(cb * block_c, block_c)].astype(jnp.float32)
-        return k, v, None, None
+    if quantized:
+        k_scale_ref, v_scale_ref, sinks_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        sinks_ref, o_ref, m_scr, l_scr, acc_scr = rest
+        k_scale_ref = v_scale_ref = None
 
-    _decode_body(
-        lengths_ref, window_ref, q_ref, sinks_ref, o_ref, load_block,
-        sm_scale=sm_scale, block_c=block_c, softcap=softcap, use_sinks=use_sinks,
-    )
+    # program ids hoisted out of the pl.when closures: inside them the HLO
+    # interpreter (CPU tests) has no lowering for the primitive
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    cb = pl.program_id(2)
+    last_cb = pl.num_programs(2) - 1
+    length = lengths_ref[b]
+    window = window_ref[0]
+    # the query sits at position length-1; a sliding layer sees slots
+    # [length-window, length), a global layer (window 0) sees [0, length)
+    first_slot = jnp.where(window > 0, jnp.maximum(length - window, 0), 0)
+    first = first_slot // block_c
+    num = pl.cdiv(length, block_c)
 
+    @pl.when(cb == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, dtype=jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, dtype=jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, dtype=jnp.float32)
 
-def _decode_kernel_int8(
-    lengths_ref,   # (B,) scalar-prefetch, SMEM
-    window_ref,    # (1,) scalar-prefetch
-    q_ref,         # (1, 1, G, D)
-    k_ref,         # (1, 1, D, C) int8
-    v_ref,         # (1, 1, D, C) int8
-    k_scale_ref,   # (1, 1, 1, C) per-slot dequant scales
-    v_scale_ref,   # (1, 1, 1, C)
-    sinks_ref,     # (KH, G) all sink logits; rows picked by program id
-    o_ref,         # (1, 1, G, D)
-    *,
-    sm_scale: float,
-    block_c: int,
-    softcap: float,
-    use_sinks: bool,
-):
-    def load_block(cb):
-        sl = pl.ds(cb * block_c, block_c)
-        # int8 streams from HBM (half the bytes) and widens to fp32 in
-        # VMEM; the per-slot scales are column-constant so they fold into
-        # the epilogues and a dequantized cache is never written back
-        k = k_ref[0, 0, :, sl].astype(jnp.float32)
-        v = v_ref[0, 0, :, sl].astype(jnp.float32)
-        k_colscale = k_scale_ref[0, 0, :, sl].astype(jnp.float32)  # (1, BC)
-        v_colscale = v_scale_ref[0, 0, :, sl].astype(jnp.float32)
-        return k, v, k_colscale, v_colscale
+    @pl.when((cb >= first) & (cb < num))
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (G, D)
+        k = k_ref[0, 0].astype(jnp.float32)             # (D, BC)
+        v = v_ref[0, 0].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (G, BC)
+        if quantized:
+            # int8 streams from HBM (half the bytes) and widens to fp32 in
+            # VMEM; the per-slot scales are column-constant so they fold
+            # into the epilogues, no dequantized cache is materialized
+            scores = scores * k_scale_ref[0, 0].astype(jnp.float32)  # (1, BC)
+        if softcap:
+            scores = jnp.tanh(scores / softcap) * softcap
+        slots = cb * block_c + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        scores = jnp.where((slots < length) & (slots >= first_slot), scores, NEG_INF)
 
-    _decode_body(
-        lengths_ref, window_ref, q_ref, sinks_ref, o_ref, load_block,
-        sm_scale=sm_scale, block_c=block_c, softcap=softcap, use_sinks=use_sinks,
-    )
+        m_prev = m_scr[:, :1]  # (G, 1)
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        weighted = (
+            p if not quantized else p * v_scale_ref[0, 0].astype(jnp.float32)
+        )
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            weighted, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (G, D)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(cb == last_cb)
+    def _finalize():
+        group = q_ref.shape[2]
+        # the sinks block is the FULL (KH, G) array (a (1, G) slice would
+        # break the TPU lowering's sublane-divisibility rule); pick this
+        # program's row
+        sink = (
+            sinks_ref[h].astype(jnp.float32).reshape(group, 1)
+            if use_sinks
+            else None
+        )
+        o_ref[0, 0] = _finalize_attention(
+            acc_scr[...], m_scr[:, :1], l_scr[:, :1], sink
+        ).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -285,42 +273,66 @@ def flash_decode(
     group = num_heads // kv_heads
     if sm_scale is None:
         sm_scale = head_dim**-0.5
-    block_c = min(BLOCK_C, capacity)
+    # biggest supported block that divides the capacity: fewer, larger DMAs
+    block_c = next(
+        (b for b in (512, 256, BLOCK_C) if capacity % b == 0 and b <= capacity),
+        capacity,
+    )
     quantized = k_scale is not None
     assert quantized == (v_scale is not None), "k_scale and v_scale go together"
 
     window_arr = _window_scalar(window, sliding)
     use_sinks, sinks_arr = _sinks_operand(sinks, kv_heads, group)
 
+    def kv_map(b, h, cb, lens, win):
+        # shared by k/v AND the int8 scale blocks: the scale block must
+        # always ride the same live-block index as its cache block
+        return (b, h, 0, _decode_live_block(b, cb, lens, win, block_c))
+
     qkv_specs = [
-        pl.BlockSpec((1, 1, group, head_dim), lambda b, h, *_: (b, h, 0, 0)),
-        pl.BlockSpec((1, 1, head_dim, capacity), lambda b, h, *_: (b, h, 0, 0)),
-        pl.BlockSpec((1, 1, head_dim, capacity), lambda b, h, *_: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, group, head_dim), lambda b, h, cb, *_: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, head_dim, block_c), kv_map),
+        pl.BlockSpec((1, 1, head_dim, block_c), kv_map),
     ]
     scale_specs = [
-        pl.BlockSpec((1, 1, 1, capacity), lambda b, h, *_: (b, h, 0, 0)),
-        pl.BlockSpec((1, 1, 1, capacity), lambda b, h, *_: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, 1, block_c), kv_map),
+        pl.BlockSpec((1, 1, 1, block_c), kv_map),
     ]
-    sinks_spec = pl.BlockSpec((kv_heads, group), lambda b, h, *_: (0, 0))
-    common = dict(sm_scale=sm_scale, block_c=block_c, softcap=softcap, use_sinks=use_sinks)
+    sinks_spec = pl.BlockSpec((kv_heads, group), lambda b, h, cb, *_: (0, 0))
+    kernel = functools.partial(
+        _decode_kernel, sm_scale=sm_scale, block_c=block_c, softcap=softcap,
+        use_sinks=use_sinks, quantized=quantized,
+    )
     if quantized:
-        kernel = functools.partial(_decode_kernel_int8, **common)
         in_specs = qkv_specs + scale_specs + [sinks_spec]
         operands = (k_cache, v_cache, k_scale, v_scale, sinks_arr)
     else:
-        kernel = functools.partial(_decode_kernel, **common)
         in_specs = qkv_specs + [sinks_spec]
         operands = (k_cache, v_cache, sinks_arr)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(batch, kv_heads),
+        # the cache-block axis is a GRID dimension: blocks outside a
+        # sequence's live range revisit a resident block (index-map clip)
+        # and their copies are elided, so HBM traffic tracks true lengths,
+        # not capacity
+        grid=(batch, kv_heads, capacity // block_c),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, group, head_dim), lambda b, h, *_: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec(
+            (1, 1, group, head_dim), lambda b, h, cb, *_: (b, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group, 128), jnp.float32),     # running max
+            pltpu.VMEM((group, 128), jnp.float32),     # running denominator
+            pltpu.VMEM((group, head_dim), jnp.float32),  # output accumulator
+        ],
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((batch, kv_heads, group, head_dim), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         cost_estimate=pl.CostEstimate(
             flops=2 * 2 * batch * num_heads * capacity * head_dim,
             bytes_accessed=(k_cache.size + v_cache.size) * k_cache.dtype.itemsize,

@@ -390,6 +390,57 @@ def test_flash_decode_gemma_gptoss_variants_match_xla():
         )
 
 
+def test_flash_decode_multi_block_grid_parity():
+    """The cache-block GRID path for real: capacity 1536 forces block_c=512
+    and a 3-step block axis, so the scratch carry (init/accumulate/finalize
+    across grid steps), the index-map live-block clip, and the window front
+    skip across block boundaries all execute — the other decode tests'
+    capacities collapse to a single block, which would hide a regression in
+    exactly the machinery the grid rewrite introduced."""
+    from prime_tpu.ops.attention import decode_attention
+    from prime_tpu.ops.pallas_attention import flash_decode
+
+    b, h, kh, d, c = 4, 8, 2, 64, 1536
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, 1, d), dtype=jnp.float32)
+    k_cache = jax.random.normal(jax.random.PRNGKey(1), (b, kh, d, c), dtype=jnp.float32)
+    v_cache = jax.random.normal(jax.random.PRNGKey(2), (b, kh, d, c), dtype=jnp.float32)
+    # lengths hit: full capacity, inside block 0, just over a block edge,
+    # and mid block 2
+    lengths = jnp.asarray([1536, 100, 513, 1100], dtype=jnp.int32)
+    sinks = jax.random.normal(jax.random.PRNGKey(3), (h,), dtype=jnp.float32)
+
+    cases = [
+        dict(),
+        dict(window=600, sliding=jnp.asarray(True)),   # band crosses blocks
+        dict(window=600, sliding=jnp.asarray(False)),  # traced OFF -> global
+        dict(softcap=30.0, sinks=sinks),
+        dict(window=512, sliding=jnp.asarray(True), sinks=sinks),
+    ]
+    for kw in cases:
+        ref = decode_attention(q, k_cache, v_cache, lengths, d**-0.5, impl="xla", **kw)
+        out = flash_decode(
+            q, k_cache, v_cache, lengths, sm_scale=d**-0.5, interpret=True, **kw
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3,
+            err_msg=f"variant {sorted(kw)}",
+        )
+
+    # int8 cache variant through the same multi-block grid
+    k_q = jnp.clip(jnp.round(k_cache / 0.05), -127, 127).astype(jnp.int8)
+    v_q = jnp.clip(jnp.round(v_cache / 0.05), -127, 127).astype(jnp.int8)
+    scales = jnp.full((b, kh, 1, c), 0.05, dtype=jnp.float32)
+    ref = decode_attention(
+        q, k_q, v_q, lengths, d**-0.5, impl="xla", k_scale=scales, v_scale=scales,
+        window=600, sliding=jnp.asarray(True),
+    )
+    out = flash_decode(
+        q, k_q, v_q, lengths, sm_scale=d**-0.5, interpret=True,
+        k_scale=scales, v_scale=scales, window=600, sliding=jnp.asarray(True),
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
 def test_flash_decode_sharded_gptoss_variants():
     """The shard_map wrapper carries the variant args: sinks split over tp
     with their heads, window/softcap are elementwise-safe."""
