@@ -5,12 +5,20 @@ blocks through VMEM keeping running (max, sum, output) accumulators in fp32
 scratch — O(S) memory instead of the O(S^2) score matrix, and every matmul
 lands on the MXU at (BLOCK, head_dim)x(head_dim, BLOCK) granularity.
 
-Grid: (batch, q_heads, S // BLOCK_Q). GQA is handled in the BlockSpec index
-map: query head h reads kv head h // (H // KH), so grouped KV is never
-materialized per-query-head in HBM.
+The KV/cache-block axis is a GRID dimension in both kernels — prefill:
+(batch, q_heads, S // BLOCK_Q, S // BLOCK_K); decode: (batch, kv_heads,
+C // block_c) — with the online-softmax state carried across the innermost
+axis ("arbitrary" semantics). The index maps clip each step's block
+coordinate into the live range (causal diagonal / sliding-window band /
+scalar-prefetched cache lengths); out-of-range steps revisit an already-
+resident block, and Mosaic elides the copy when the index map repeats
+itself — so dead blocks are never READ from HBM, not merely skipped in
+compute. That distinction is load-bearing: these ops are HBM-bandwidth-
+bound, and an earlier design that DMA'd the full operand per program and
+skipped only compute lost to XLA's read-it-all path.
 
-The causal structure is exploited at the block level: KV blocks strictly above
-the diagonal are skipped (pl.when), halving prefill FLOPs.
+GQA is handled in the BlockSpec index maps: query head h reads kv head
+h // (H // KH), so grouped KV is never materialized per-query-head in HBM.
 """
 
 from __future__ import annotations
@@ -62,71 +70,93 @@ def _finalize_attention(acc, m, l, sink):
 
 
 
+def _prefill_band(qb, window_ref, block_k: int):
+    """This query block's live kv-block range [band_start, causal_last]:
+    causal cuts blocks strictly above the diagonal, a sliding window cuts
+    blocks entirely before the band. Shared by the kernel's compute gate and
+    the k/v index maps — the index-map clip makes out-of-range grid steps
+    revisit a resident block so their copies are elided (see
+    _decode_live_block for the mechanism)."""
+    window = window_ref[0]
+    causal_last = (qb * BLOCK_Q + BLOCK_Q - 1) // block_k
+    band_start = jnp.where(
+        window > 0, jnp.maximum(qb * BLOCK_Q - window + 1, 0) // block_k, 0
+    )
+    return band_start, causal_last
+
+
 def _flash_kernel(
     window_ref,  # (1,) scalar-prefetch: effective window (0 = global layer)
-    q_ref,       # (BLOCK_Q, D)
-    k_ref,       # (S, D)  one kv head, full length
-    v_ref,       # (S, D)
+    q_ref,       # (1, 1, BLOCK_Q, D)
+    k_ref,       # (1, 1, BLOCK_K, D) this step's live kv block
+    v_ref,       # (1, 1, BLOCK_K, D)
     sinks_ref,   # (H, 1) all sink logits; row picked by program id
-    o_ref,       # (BLOCK_Q, D)
+    o_ref,       # (1, 1, BLOCK_Q, D)
+    m_scr,       # (BLOCK_Q, 128) f32: running max, carried across kv steps
+    l_scr,       # (BLOCK_Q, 128) f32: running denominator
+    acc_scr,     # (BLOCK_Q, D) f32: output accumulator
     *,
     sm_scale: float,
-    seq_len: int,
     block_k: int,
     softcap: float,
     use_sinks: bool,
 ):
+    # program ids hoisted out of the pl.when closures (the HLO interpreter
+    # has no lowering for the primitive inside them)
+    h = pl.program_id(1)
     qb = pl.program_id(2)
-    q = q_ref[0, 0, :, :].astype(jnp.float32) * sm_scale  # (BQ, D)
+    kb = pl.program_id(3)
+    last_kb = pl.num_programs(3) - 1
     window = window_ref[0]
+    band_start, causal_last = _prefill_band(qb, window_ref, block_k)
 
-    m = jnp.full((BLOCK_Q, 1), NEG_INF, dtype=jnp.float32)
-    l = jnp.zeros((BLOCK_Q, 1), dtype=jnp.float32)
-    acc = jnp.zeros(q.shape, dtype=jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, dtype=jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, dtype=jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, dtype=jnp.float32)
 
-    q_positions = qb * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, block_k), 0)
-
-    num_k_blocks = pl.cdiv(seq_len, block_k)
-
-    def body(kb, carry):
-        m_prev, l_prev, acc_prev = carry
-        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    @pl.when((kb >= band_start) & (kb <= causal_last))
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (BQ, D)
+        k = k_ref[0, 0].astype(jnp.float32)             # (BK, D)
+        v = v_ref[0, 0].astype(jnp.float32)
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (BQ, BK)
         if softcap:
             scores = jnp.tanh(scores / softcap) * softcap
-        kv_positions = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, block_k), 1)
+        q_positions = qb * BLOCK_Q + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0
+        )
+        kv_positions = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1
+        )
         allowed = kv_positions <= q_positions
         # sliding layer: key must also be within `window` of the query
         # (delta < window, matching ops.attention._window_ok)
         allowed &= (window == 0) | (q_positions - kv_positions < window)
         scores = jnp.where(allowed, scores, NEG_INF)
 
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
         p = jnp.exp(scores - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc_prev * alpha + jax.lax.dot_general(
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    # block skip BOTH ways: kv blocks strictly above the diagonal contribute
-    # nothing (causal), and on a sliding layer blocks entirely before the
-    # query block's window band contribute nothing either — a long prompt's
-    # sliding layer does O(S·window) work instead of O(S²/2)
-    last_block = jnp.minimum(qb + 1, num_k_blocks)  # blocks [0, last_block) are live
-    earliest_q = qb * BLOCK_Q
-    band_start = jnp.where(
-        window > 0, jnp.maximum(earliest_q - window + 1, 0) // block_k, 0
-    )
-    m, l, acc = jax.lax.fori_loop(band_start, last_block, body, (m, l, acc))
-
-    # full-array sinks block (see flash_decode): slice this head's row here
-    sink = sinks_ref[pl.program_id(1), 0].astype(jnp.float32) if use_sinks else None
-    o_ref[0, 0, :, :] = _finalize_attention(acc, m, l, sink).astype(o_ref.dtype)
+    @pl.when(kb == last_kb)
+    def _finalize():
+        # full-array sinks block (see flash_decode): slice this head's row
+        sink = sinks_ref[h, 0].astype(jnp.float32) if use_sinks else None
+        o_ref[0, 0] = _finalize_attention(
+            acc_scr[...], m_scr[:, :1], l_scr[:, :1], sink
+        ).astype(o_ref.dtype)
 
 
 def _decode_live_block(b, cb, lengths_ref, window_ref, block_c: int):
@@ -373,31 +403,52 @@ def flash_attention_causal(
     if sm_scale is None:
         sm_scale = head_dim**-0.5
 
-    grid = (batch, num_heads, pl.cdiv(seq_len, BLOCK_Q))
     block_k = min(BLOCK_K, seq_len)
+    # the kv-block axis is a GRID dimension (see flash_decode): the index
+    # map clips each step into the query block's live [band_start,
+    # causal_last] range, so blocks above the diagonal — and, on a sliding
+    # layer, before the band — are never read from HBM, not just skipped in
+    # compute. Causal prefill reads ~half the k/v bytes; a sliding layer
+    # reads O(S*window).
+    grid = (batch, num_heads, pl.cdiv(seq_len, BLOCK_Q), pl.cdiv(seq_len, block_k))
 
     window_arr = _window_scalar(window, sliding)
     use_sinks, sinks_arr = _sinks_operand(sinks, num_heads, 1)
 
+    def kv_map(b, h, qb, kb, win):
+        band_start, causal_last = _prefill_band(qb, win, block_k)
+        last = jnp.minimum(causal_last, pl.cdiv(seq_len, block_k) - 1)
+        return (b, h // group, jnp.clip(kb, band_start, last), 0)
+
     kernel = functools.partial(
-        _flash_kernel, sm_scale=sm_scale, seq_len=seq_len, block_k=block_k,
+        _flash_kernel, sm_scale=sm_scale, block_k=block_k,
         softcap=softcap, use_sinks=use_sinks,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, BLOCK_Q, head_dim), lambda b, h, qb, *_: (b, h, qb, 0)),
-            pl.BlockSpec((1, 1, seq_len, head_dim), lambda b, h, qb, *_: (b, h // group, 0, 0)),
-            pl.BlockSpec((1, 1, seq_len, head_dim), lambda b, h, qb, *_: (b, h // group, 0, 0)),
-            pl.BlockSpec((num_heads, 1), lambda b, h, qb, *_: (0, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q, head_dim), lambda b, h, qb, kb, *_: (b, h, qb, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim), kv_map),
+            pl.BlockSpec((1, 1, block_k, head_dim), kv_map),
+            pl.BlockSpec((num_heads, 1), lambda b, h, qb, kb, *_: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, BLOCK_Q, head_dim), lambda b, h, qb, *_: (b, h, qb, 0)),
+        out_specs=pl.BlockSpec(
+            (1, 1, BLOCK_Q, head_dim), lambda b, h, qb, kb, *_: (b, h, qb, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),      # running max
+            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),      # running denominator
+            pltpu.VMEM((BLOCK_Q, head_dim), jnp.float32),  # output accumulator
+        ],
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
         cost_estimate=pl.CostEstimate(
             flops=2 * 2 * batch * num_heads * seq_len * seq_len * head_dim // 2,  # causal half
             bytes_accessed=(q.size + k.size * group + v.size * group + q.size) * q.dtype.itemsize,
