@@ -451,7 +451,12 @@ def flash_attention_causal(
         ),
         cost_estimate=pl.CostEstimate(
             flops=2 * 2 * batch * num_heads * seq_len * seq_len * head_dim // 2,  # causal half
-            bytes_accessed=(q.size + k.size * group + v.size * group + q.size) * q.dtype.itemsize,
+            # causal halves the k/v bytes actually read too (the index-map
+            # clip elides above-diagonal block copies) — keep flops and
+            # bytes on the same convention
+            bytes_accessed=(
+                q.size + (k.size * group + v.size * group) // 2 + q.size
+            ) * q.dtype.itemsize,
             transcendentals=batch * num_heads * seq_len * seq_len // 2,
         ),
         interpret=interpret,
