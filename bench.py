@@ -882,6 +882,64 @@ def main() -> None:
         print(f"# bench: mlactx section failed: {e}", flush=True)
     print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
+    # ---- trainstep: full training-step throughput + MFU ---------------------
+    # The other half of the framework: one adamw step (fwd + bwd + fp32
+    # update) on a 0.5B dense model, remat="dots". MFU uses the standard
+    # model-FLOP count (6*N per token + causal attention fwd*3), NOT the
+    # rematerialized hardware FLOPs.
+    try:
+        from prime_tpu.train.trainer import (
+            default_optimizer,
+            init_train_state,
+            make_train_step,
+        )
+
+        tr_model = "tiny-test" if SMOKE else "qwen2.5-0.5b"
+        tr_cfg = get_config(tr_model)
+        tr_b, tr_s = (2, 64) if SMOKE else (4, 1024)
+        tr_params = init_params(jax.random.PRNGKey(40), tr_cfg, dtype=jnp.bfloat16)
+        tr_opt = default_optimizer()
+        holder = {"state": init_train_state(tr_params, tr_opt)}
+        step_fn = make_train_step(tr_cfg, tr_opt, remat="dots")
+        tr_tokens = jax.random.randint(
+            jax.random.PRNGKey(41), (tr_b, tr_s + 1), 1, tr_cfg.vocab_size
+        )
+        tr_mask = jnp.ones((tr_b, tr_s), dtype=jnp.float32)
+
+        def run_train_step():
+            state, metrics = step_fn(
+                holder["state"], tr_tokens[:, :-1], tr_tokens[:, 1:], tr_mask
+            )
+            holder["state"] = state
+            float(metrics["loss"])  # host sync
+
+        tr_step_s = time_fn(run_train_step, iterations=3)
+        tr_param_count = _tree_bytes(tr_params) / 2  # bf16 storage
+        tr_tokens_per_step = tr_b * tr_s
+        tr_flops = (
+            6.0 * tr_param_count * tr_tokens_per_step
+            # causal fwd attention is 2*L*H*S^2*hd (same model as the
+            # headline prefill MFU); fwd + 2x bwd = 3x that
+            + 6.0 * tr_cfg.n_layers * tr_cfg.n_heads
+            * tr_b * tr_s**2 * tr_cfg.head_dim
+        )
+        record["trainstep_tok_s"] = round(tr_tokens_per_step / tr_step_s, 1)
+        record["trainstep_step_ms"] = round(tr_step_s * 1e3, 1)
+        record["trainstep_mfu_pct"] = round(
+            100.0 * tr_flops / tr_step_s / V5E_BF16_FLOPS, 1
+        )
+        record["trainstep_model"] = tr_model
+        print(
+            f"# bench: trainstep {record['trainstep_tok_s']} tok/s "
+            f"({record['trainstep_mfu_pct']}% MFU, b{tr_b} s{tr_s})",
+            flush=True,
+        )
+        del tr_params, holder
+    except Exception as e:  # noqa: BLE001
+        record["trainstep_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: trainstep section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
+
     # ---- winctx: sliding-window flash decode at long context ----------------
     # The round-4 kernel variant: a sliding layer's decode step front-skips
     # cache blocks before the window, so it streams ~window slots instead of
