@@ -100,6 +100,21 @@ def chunk_plan(start: int, length: int, max_chunk: int, row_capacity: int) -> li
     return plan
 
 
+def _power_batches(n: int) -> list[int]:
+    """Greedy power-of-two decomposition, largest first: 7 -> [4, 2, 1]."""
+    out = []
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    while n:
+        if p <= n:
+            out.append(p)
+            n -= p
+        else:
+            p //= 2
+    return out
+
+
 def _common_prefix_len(a: list[int], b: list[int]) -> int:
     n = min(len(a), len(b))
     for i in range(n):
@@ -236,7 +251,7 @@ class ContinuousBatchingEngine:
         # one jitted program each: jit's own shape-keyed cache gives
         # one-compile-per-shape-bucket without bucket-keyed dicts here
         self._chunk_fn: Any = None
-        self._finalize_fn: Any = None
+        self._finalize_batch_fn: Any = None
         self._decode_fn: Any = None
         self._spec_fn: Any = None
         # prompt-prefix KV reuse: newest-last list of (ids, row KVCache) —
@@ -315,51 +330,6 @@ class ContinuousBatchingEngine:
             return row, logits  # logits (1, 1, V): the gathered position only
 
         return jax.jit(chunk_prefill, donate_argnums=(1,))
-
-    def _make_finalize(self):
-        import jax
-        import jax.numpy as jnp
-
-        cache_spec = self.cache_spec
-
-        def finalize(
-            cache, last, temps, top_ps,
-            row, chunk_logits, length, slot, temp, top_p, rng,
-        ):
-            # splice the staged row into the engine cache at ``slot`` (the
-            # engine cache is donated; the row is NOT — it may live on in the
-            # prefix cache) and sample the first token from the prompt's
-            # last-position logits (chunk_fn already gathered that row:
-            # chunk_logits is (1, 1, V))
-            zero = jnp.zeros((), jnp.int32)
-
-            def splice(cache_leaf, row_leaf):
-                out = jax.lax.dynamic_update_slice(
-                    cache_leaf, row_leaf, (zero, slot, zero, zero, zero)
-                )
-                if cache_spec is not None:
-                    out = jax.lax.with_sharding_constraint(out, cache_spec)
-                return out
-
-            new_cache = cache._replace(k=splice(cache.k, row.k), v=splice(cache.v, row.v))
-            if cache.quantized:
-                new_cache = new_cache._replace(
-                    k_scale=splice(cache.k_scale, row.k_scale),
-                    v_scale=splice(cache.v_scale, row.v_scale),
-                )
-            first = _sample_batch(
-                chunk_logits[0], temp[None], top_p[None], rng
-            )[0]
-            # the first sampled token's KV is not in the cache yet: the next
-            # decode step writes it at position ``length`` (put() scatters at
-            # cache_lengths), so the slot length stays the prompt length here
-            new_cache = new_cache._replace(lengths=cache.lengths.at[slot].set(length))
-            new_last = last.at[slot].set(first)
-            new_temps = temps.at[slot].set(temp)
-            new_top_ps = top_ps.at[slot].set(top_p)
-            return new_cache, new_last, new_temps, new_top_ps, first
-
-        return jax.jit(finalize, donate_argnums=(0, 1, 2, 3))
 
     def _make_decode(self):
         import jax
@@ -664,24 +634,75 @@ class ContinuousBatchingEngine:
             free = [s for s in range(self.max_slots) if not self._active[s]]
             if not free:
                 return admitted
-            try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
+            # drain up to the free-slot count so a burst can be admitted as
+            # ONE batched prefill: per-request b=1 prefills underuse the MXU
+            # (the weights stream once per request instead of once per wave)
+            # and pay two dispatches each
+            burst: list[EngineRequest] = []
+            while len(burst) < len(free):
+                try:
+                    req = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+                if req is None:
+                    continue
+                if req.cancelled:
+                    # client went away while queued: don't pay the prefill
+                    req.done = True
+                    req.events.put(None)
+                    continue
+                burst.append(req)
+            if not burst:
                 return admitted
-            if req is None:
-                continue
-            if req.cancelled:
-                # client went away while queued: don't pay the prefill
-                req.done = True
-                req.events.put(None)
-                continue
-            try:
-                self._prefill(req, free[0])
-                admitted = True
-            except Exception as e:  # noqa: BLE001 — bad request must not kill the loop
-                req.error = f"prefill failed: {e}"
-                req.done = True
-                req.events.put(None)
+            # cold requests sharing a (row capacity, chunk plan) batch
+            # together; prefix-cache hits keep the per-request path (their
+            # plans start mid-prompt and their seeded rows differ)
+            groups: dict[tuple, list[EngineRequest]] = {}
+            singles: list[EngineRequest] = []
+            for req in burst:
+                ids = req.prompt_ids
+                try:
+                    row_cb = row_capacity_for(
+                        len(ids), self.prefill_chunk, self.capacity
+                    )
+                except ValueError as e:
+                    req.error = f"prefill failed: {e}"
+                    req.done = True
+                    req.events.put(None)
+                    continue
+                if self._prefix_match_len(ids) > 0:
+                    singles.append(req)
+                else:
+                    plan = tuple(chunk_plan(0, len(ids), self.prefill_chunk, row_cb))
+                    groups.setdefault((row_cb, plan), []).append(req)
+            for req in singles:
+                try:
+                    self._prefill(req, free.pop(0))
+                    admitted = True
+                except Exception as e:  # noqa: BLE001 — keep the loop alive
+                    req.error = f"prefill failed: {e}"
+                    req.done = True
+                    req.events.put(None)
+            for (row_cb, plan), reqs in groups.items():
+                # power-of-two sub-batches (largest first): the compile set
+                # per plan stays O(log slots) instead of one program per
+                # arbitrary wave size — a size-7 wave runs as 4+2+1, all
+                # shapes a warmup can enumerate
+                remaining = reqs
+                for size in _power_batches(len(reqs)):
+                    sub, remaining = remaining[:size], remaining[size:]
+                    try:
+                        if size == 1:
+                            self._prefill(sub[0], free.pop(0))
+                        else:
+                            slots = [free.pop(0) for _ in sub]
+                            self._prefill_batch(sub, slots, row_cb, list(plan))
+                        admitted = True
+                    except Exception as e:  # noqa: BLE001 — keep the loop alive
+                        for req in sub:
+                            req.error = f"prefill failed: {e}"
+                            req.done = True
+                            req.events.put(None)
 
     def _prefill(self, req: EngineRequest, slot: int) -> None:
         import jax
@@ -689,8 +710,8 @@ class ContinuousBatchingEngine:
 
         if self._chunk_fn is None:
             self._chunk_fn = self._make_chunk_prefill()
-        if self._finalize_fn is None:
-            self._finalize_fn = self._make_finalize()
+        if self._finalize_batch_fn is None:
+            self._finalize_batch_fn = self._make_finalize_batch()
         ids = req.prompt_ids
         row_cb = row_capacity_for(len(ids), self.prefill_chunk, self.capacity)
         start, row = self._prefix_seed(ids, row_cb)
@@ -711,27 +732,170 @@ class ContinuousBatchingEngine:
                     self.params, row, tokens, jnp.asarray(off, dtype=jnp.int32),
                     jnp.asarray([rel], dtype=jnp.int32),
                 )
+            # the batch finalize IS the single finalize at n=1 — one owner
+            # of the splice/sample/bookkeeping semantics
             (
-                self._cache, self._last, self._temps, self._top_ps, first,
-            ) = self._finalize_fn(
+                self._cache, self._last, self._temps, self._top_ps, firsts,
+            ) = self._finalize_batch_fn(
                 self._cache, self._last, self._temps, self._top_ps, row, logits,
-                jnp.asarray(len(ids), dtype=jnp.int32),
-                jnp.asarray(slot, dtype=jnp.int32),
-                jnp.asarray(req.temperature, dtype=jnp.float32),
-                jnp.asarray(req.top_p, dtype=jnp.float32),
+                jnp.asarray([len(ids)], dtype=jnp.int32),
+                jnp.asarray([slot], dtype=jnp.int32),
+                jnp.asarray([req.temperature], dtype=jnp.float32),
+                jnp.asarray([req.top_p], dtype=jnp.float32),
                 rng,
             )
+        first = int(firsts[0])
         self._store_prefix(ids, row)
         req.slot = slot
         self._active[slot] = True
         self._requests[slot] = req
-        self._histories[slot] = list(ids) + [int(first)]
+        self._histories[slot] = list(ids) + [first]
         if self.speculative:
             self._bigram_index[slot] = {}
             self._index_bigrams(slot, 0)
-        self._emit(req, [int(first)])
+        self._emit(req, [first])
+
+    def _prefill_batch(
+        self,
+        reqs: list[EngineRequest],
+        slots: list[int],
+        row_cb: int,
+        plan: list[tuple[int, int]],
+    ) -> None:
+        """Admit a whole burst of cold same-plan requests in one batched
+        prefill: the chunk forwards run at batch N (weights stream once per
+        wave, not once per request) and ONE finalize dispatch splices every
+        staged row and samples every first token. The prefix cache is seeded
+        from the FIRST member's row only (slicing every member would cost a
+        dispatch per leaf per request) — enough that a recurring
+        shared-prefix burst prefix-hits from its second wave on."""
+        import jax
+        import jax.numpy as jnp
+
+        from prime_tpu.models.llama import init_cache
+
+        if self._chunk_fn is None:
+            self._chunk_fn = self._make_chunk_prefill()
+        if self._finalize_batch_fn is None:
+            self._finalize_batch_fn = self._make_finalize_batch()
+        n = len(reqs)
+        self._rng, rng = jax.random.split(self._rng)
+        row = init_cache(self.config, n, row_cb, dtype=self._dtype, quantized=self.kv_quant)
+        logits = None
+        with self._mesh_ctx():
+            for off, size in plan:
+                chunk_rows = []
+                rels = []
+                for req in reqs:
+                    ids = req.prompt_ids
+                    chunk_ids = ids[off : off + size]
+                    chunk_ids = list(chunk_ids) + [self.pad_id] * (size - len(chunk_ids))
+                    chunk_rows.append(chunk_ids)
+                    rels.append(min(max(len(ids) - 1 - off, 0), size - 1))
+                tokens = jnp.asarray(chunk_rows, dtype=jnp.int32)
+                row, logits = self._chunk_fn(
+                    self.params, row, tokens, jnp.asarray(off, dtype=jnp.int32),
+                    jnp.asarray(rels, dtype=jnp.int32),
+                )
+            (
+                self._cache, self._last, self._temps, self._top_ps, firsts,
+            ) = self._finalize_batch_fn(
+                self._cache, self._last, self._temps, self._top_ps, row, logits,
+                jnp.asarray([len(r.prompt_ids) for r in reqs], dtype=jnp.int32),
+                jnp.asarray(slots, dtype=jnp.int32),
+                jnp.asarray([r.temperature for r in reqs], dtype=jnp.float32),
+                jnp.asarray([r.top_p for r in reqs], dtype=jnp.float32),
+                rng,
+            )
+        # lazy per-leaf slices of member 0: a handful of tiny ops per WAVE
+        row0 = jax.tree_util.tree_map(
+            lambda x: x[:, :1] if x.ndim >= 2 else x[:1], row
+        )
+        self._store_prefix(reqs[0].prompt_ids, row0)
+        firsts_host = [int(t) for t in firsts]
+        for req, slot, first in zip(reqs, slots, firsts_host):
+            req.slot = slot
+            self._active[slot] = True
+            self._requests[slot] = req
+            self._histories[slot] = list(req.prompt_ids) + [first]
+            if self.speculative:
+                self._bigram_index[slot] = {}
+                self._index_bigrams(slot, 0)
+            self._emit(req, [first])
+
+    def _make_finalize_batch(self):
+        import jax
+        import jax.numpy as jnp
+
+        cache_spec = self.cache_spec
+
+        def finalize_batch(
+            cache, last, temps, top_ps, rows, logits, lengths, slots, temps_new,
+            top_ps_new, rng,
+        ):
+            # splice every staged row (batch axis N on the rows' slot dim)
+            # into the engine cache and sample all first tokens — one
+            # dispatch for the whole admission wave
+            n = lengths.shape[0]
+            zero = jnp.zeros((), jnp.int32)
+
+            def splice_all(cache_leaf, rows_leaf):
+                def body(i, acc):
+                    row_i = jax.lax.dynamic_slice_in_dim(rows_leaf, i, 1, axis=1)
+                    return jax.lax.dynamic_update_slice(
+                        acc, row_i, (zero, slots[i], zero, zero, zero)
+                    )
+
+                out = jax.lax.fori_loop(0, n, body, cache_leaf)
+                if cache_spec is not None:
+                    out = jax.lax.with_sharding_constraint(out, cache_spec)
+                return out
+
+            new_cache = cache._replace(
+                k=splice_all(cache.k, rows.k), v=splice_all(cache.v, rows.v)
+            )
+            if cache.quantized:
+                new_cache = new_cache._replace(
+                    k_scale=splice_all(cache.k_scale, rows.k_scale),
+                    v_scale=splice_all(cache.v_scale, rows.v_scale),
+                )
+            firsts = _sample_batch(logits[:, 0, :], temps_new, top_ps_new, rng)
+            # the first sampled tokens' KV is not in the cache yet: the next
+            # decode step writes each at position ``length`` (put() scatters
+            # at cache_lengths), so slot lengths stay the prompt lengths here
+            new_cache = new_cache._replace(
+                lengths=cache.lengths.at[slots].set(lengths)
+            )
+            return (
+                new_cache,
+                last.at[slots].set(firsts),
+                temps.at[slots].set(temps_new),
+                top_ps.at[slots].set(top_ps_new),
+                firsts,
+            )
+
+        return jax.jit(finalize_batch, donate_argnums=(0, 1, 2, 3))
 
     # ---- prompt-prefix KV reuse ----
+
+    def _prefix_match(self, ids: list[int]):
+        """ONE owner of the prefix-hit math (clamp to len-1, MIN_BUCKET
+        alignment, min_prefix threshold): returns (usable_len, cached_row) —
+        (0, None) when nothing usable. _admit routes on the length (no
+        allocation); _prefix_seed consumes the row."""
+        best_len, best = 0, None
+        for entry_ids, entry_row in self._prefix_cache:
+            common = _common_prefix_len(ids, entry_ids)
+            if common > best_len:
+                best_len, best = common, entry_row
+        best_len = min(best_len, len(ids) - 1)
+        best_len = (best_len // MIN_BUCKET) * MIN_BUCKET
+        if best is None or best_len < self.min_prefix:
+            return 0, None
+        return best_len, best
+
+    def _prefix_match_len(self, ids: list[int]) -> int:
+        return self._prefix_match(ids)[0]
 
     def _prefix_seed(self, ids: list[int], row_cb: int):
         """Longest-prefix match against recently staged rows: returns
@@ -741,14 +905,8 @@ class ContinuousBatchingEngine:
         (the finalize step needs the last prompt position's logits)."""
         from prime_tpu.models.llama import init_cache
 
-        best_len, best = 0, None
-        for entry_ids, entry_row in self._prefix_cache:
-            common = _common_prefix_len(ids, entry_ids)
-            if common > best_len:
-                best_len, best = common, entry_row
-        best_len = min(best_len, len(ids) - 1)
-        best_len = (best_len // MIN_BUCKET) * MIN_BUCKET
-        if best is None or best_len < self.min_prefix:
+        best_len, best = self._prefix_match(ids)
+        if best is None:
             return 0, init_cache(
                 self.config, 1, row_cb, dtype=self._dtype, quantized=self.kv_quant
             )

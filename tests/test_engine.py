@@ -76,6 +76,52 @@ def test_concurrent_requests_each_match_reference():
         assert r.all_tokens(timeout=1) == reference_tokens(p, 10)
 
 
+def test_batched_admission_mixed_plans_match_reference():
+    """A burst whose prompts span different row buckets: the same-plan
+    groups batch, the odd one goes alone, and every request still emits the
+    one-shot sampler's exact greedy tokens."""
+    short = [[3, 1, 4], [2, 7, 18, 9], [11, 12]]            # one bucket
+    long = [list(range(2, 40))]                              # bigger bucket
+    engine = make_engine()
+    reqs = [engine.submit(p, max_new_tokens=8) for p in short + long]
+    drain(engine, *reqs)
+    for p, r in zip(short + long, reqs):
+        assert r.all_tokens(timeout=1) == reference_tokens(p, 8)
+
+
+def test_batched_admission_with_prefix_hit_in_burst():
+    """A burst containing a prompt that prefix-hits the cache routes that
+    request through the seeded single path while the rest batch; tokens
+    still match the reference for all of them."""
+    base = list(range(5, 37))  # 32 tokens: above min_prefix, bucket-aligned
+    engine = make_engine(prefix_cache_size=2)
+    warm = engine.submit(base + [7], max_new_tokens=4)
+    drain(engine, warm)
+    # burst: one prefix-hitting prompt + two cold ones
+    prompts = [base + [9, 3], [41, 42, 43], [91, 92, 93, 94]]
+    reqs = [engine.submit(p, max_new_tokens=8) for p in prompts]
+    drain(engine, *reqs)
+    assert engine.prefix_hits >= 1
+    for p, r in zip(prompts, reqs):
+        assert r.all_tokens(timeout=1) == reference_tokens(p, 8)
+
+
+def test_batched_admission_seeds_prefix_cache():
+    """A batched wave stores its first member's staged row, so a recurring
+    shared-prefix burst prefix-hits from the second wave on (and the hit
+    path still emits reference tokens)."""
+    base = list(range(5, 37))  # 32 tokens, bucket-aligned, above min_prefix
+    engine = make_engine(prefix_cache_size=2)
+    wave1 = [engine.submit(base + [t], max_new_tokens=4) for t in (101, 102)]
+    drain(engine, *wave1)
+    assert engine.prefix_hits == 0
+    wave2 = [engine.submit(base + [t], max_new_tokens=4) for t in (103, 104)]
+    drain(engine, *wave2)
+    assert engine.prefix_hits >= 1
+    for t, r in zip((103, 104), wave2):
+        assert r.all_tokens(timeout=1) == reference_tokens(base + [t], 4)
+
+
 def test_mid_flight_admission():
     """A request admitted while another is mid-decode: both match reference."""
     engine = make_engine()

@@ -389,15 +389,19 @@ def test_kill_background_job_reaps_process_tree(client, fake):
         pytest.fail("background job never spawned its process tree")
     client.kill_background_job(sid, "lived")
     # the group kill must reap the sleep: pgrep finds nothing
-    # ([3]0 so the probe's own cmdline doesn't match itself)
-    deadline = _time.monotonic() + 10.0
+    # ([3]0 so the probe's own cmdline doesn't match itself). The kill is
+    # idempotent, so RE-ISSUE it each poll: under heavy machine load (three
+    # concurrent suites) a single kill+10s wait still flaked — each probe's
+    # round trip through the fake plane can take seconds by itself.
+    deadline = _time.monotonic() + 30.0
     while _time.monotonic() < deadline:
         result = client.execute_command(sid, "pgrep -f 'sleep [3]0' || echo gone")
         if "gone" in result.stdout:
             break
+        client.kill_background_job(sid, "lived")
         _time.sleep(0.05)
     else:
-        pytest.fail("killed background job's process tree still alive after 10s")
+        pytest.fail("killed background job's process tree still alive after 30s")
 
 
 def test_get_unknown_background_job_raises(client, fake):
