@@ -232,8 +232,10 @@ def local_cmd(
 
     if lora and accum > 1:
         raise click.ClickException("--lora does not support --accum yet")
-    if lora and config.is_moe:
-        raise click.ClickException("--lora currently targets dense configs")
+    if lora and getattr(config, "mla", False):
+        raise click.ClickException(
+            "--lora does not support MLA configs (no wq/wk/wv projections)"
+        )
 
     schedule = warmup_cosine(lr, total_steps=steps, warmup_steps=warmup)
     optimizer = default_optimizer(schedule)
@@ -520,8 +522,10 @@ def local_rl_cmd(
     if lora:
         from prime_tpu.train.lora import LoraConfig
 
-        if config.is_moe:
-            raise click.ClickException("--lora currently targets dense configs")
+        if getattr(config, "mla", False):
+            raise click.ClickException(
+                "--lora does not support MLA configs (no wq/wk/wv projections)"
+            )
         lora_cfg = LoraConfig(r=lora_r, alpha=lora_alpha)
         render.message(f"LoRA r={lora_r} alpha={lora_alpha} (base frozen)")
 

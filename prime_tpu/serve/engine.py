@@ -692,11 +692,11 @@ class ContinuousBatchingEngine:
                 for size in _power_batches(len(reqs)):
                     sub, remaining = remaining[:size], remaining[size:]
                     try:
-                        if size == 1:
-                            self._prefill(sub[0], free.pop(0))
-                        else:
-                            slots = [free.pop(0) for _ in sub]
-                            self._prefill_batch(sub, slots, row_cb, list(plan))
+                        # size 1 rides the same path (batch-1 shapes are
+                        # identical to the old single-request prefill, and
+                        # the plan is already computed)
+                        slots = [free.pop(0) for _ in sub]
+                        self._prefill_batch(sub, slots, row_cb, list(plan))
                         admitted = True
                     except Exception as e:  # noqa: BLE001 — keep the loop alive
                         for req in sub:
@@ -812,7 +812,7 @@ class ContinuousBatchingEngine:
             lambda x: x[:, :1] if x.ndim >= 2 else x[:1], row
         )
         self._store_prefix(reqs[0].prompt_ids, row0)
-        firsts_host = [int(t) for t in firsts]
+        firsts_host = [int(t) for t in np.asarray(firsts)]
         for req, slot, first in zip(reqs, slots, firsts_host):
             req.slot = slot
             self._active[slot] = True

@@ -68,9 +68,26 @@ def init_lora_params(
     rng: jax.Array, config: ModelConfig, lora: LoraConfig, dtype=jnp.float32
 ) -> dict[str, Any]:
     """A zero-effect init: A ~ normal(0, 1/r), B = 0 — merged weights equal
-    the base exactly until the first update (the standard LoRA init)."""
+    the base exactly until the first update (the standard LoRA init).
+
+    MoE configs adapt their ATTENTION projections (identical layout to
+    dense models); the expert MLP stacks carry an extra expert axis the
+    (L, d_in, r) factors cannot address, so MLP targets reject loudly.
+    MLA configs have no wq/wk/wv at all (low-rank q/kv projections) and
+    reject as a whole."""
+    if getattr(config, "mla", False):
+        raise NotImplementedError(
+            "LoRA targets (wq/wk/wv/wo) do not exist in MLA configs "
+            "(attention runs through low-rank wq_a/wq_b/wkv_a/wkv_b)"
+        )
     if config.is_moe:
-        raise NotImplementedError("LoRA currently targets dense configs")
+        mlp_targets = set(lora.targets) & {"w_gate", "w_up", "w_down"}
+        if mlp_targets:
+            raise NotImplementedError(
+                f"LoRA on MoE expert MLPs is not supported (targets "
+                f"{sorted(mlp_targets)} have a stacked expert axis); "
+                "target the attention projections instead"
+            )
     layers = config.n_layers
     adapters: dict[str, Any] = {}
     keys = jax.random.split(rng, len(lora.targets))
@@ -136,6 +153,7 @@ def make_lora_train_step(
     optimizer: optax.GradientTransformation,
     attn_impl: str = "auto",
     remat: str = "none",  # activation checkpointing (same modes as make_train_step)
+    aux_weight: float = 0.01,  # MoE load-balance weight (same as make_train_step)
 ):
     """Jitted LoRA step: state holds ONLY the adapters; the frozen base
     params ride as a non-donated argument. fp32 adapter math throughout (the
@@ -143,6 +161,15 @@ def make_lora_train_step(
 
     def loss_fn(adapters, base_params, tokens, targets, mask):
         merged = merge_lora(base_params, adapters, lora)
+        if config.is_moe:
+            # attention adapters steer the hidden states the router reads,
+            # so the balance loss stays in the objective exactly as in the
+            # full trainer
+            logits, _, aux = forward(
+                merged, tokens, config, cache=None, attn_impl=attn_impl,
+                remat=remat, return_aux=True,
+            )
+            return cross_entropy_loss(logits, targets, mask) + aux_weight * aux
         logits, _ = forward(
             merged, tokens, config, cache=None, attn_impl=attn_impl, remat=remat
         )
