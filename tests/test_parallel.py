@@ -426,6 +426,20 @@ def test_flash_decode_multi_block_grid_parity():
             err_msg=f"variant {sorted(kw)}",
         )
 
+    # Gemma-class head_dim (256, > one 128 lane) + softcap through the
+    # multi-block grid: the scratch accumulator and q/k/v blocks carry a
+    # two-lane-tile head axis
+    d_big = 256
+    qb_ = jax.random.normal(jax.random.PRNGKey(11), (2, 4, 1, d_big), dtype=jnp.float32)
+    kb_ = jax.random.normal(jax.random.PRNGKey(12), (2, 2, d_big, c), dtype=jnp.float32)
+    vb_ = jax.random.normal(jax.random.PRNGKey(13), (2, 2, d_big, c), dtype=jnp.float32)
+    lens_b = jnp.asarray([1536, 700], dtype=jnp.int32)
+    ref = decode_attention(qb_, kb_, vb_, lens_b, d_big**-0.5, impl="xla", softcap=50.0)
+    out = flash_decode(
+        qb_, kb_, vb_, lens_b, sm_scale=d_big**-0.5, interpret=True, softcap=50.0
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
     # int8 cache variant through the same multi-block grid
     k_q = jnp.clip(jnp.round(k_cache / 0.05), -127, 127).astype(jnp.int8)
     v_q = jnp.clip(jnp.round(v_cache / 0.05), -127, 127).astype(jnp.int8)
