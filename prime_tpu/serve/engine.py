@@ -31,6 +31,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -261,7 +262,16 @@ class ContinuousBatchingEngine:
         self.prefix_cache_size = prefix_cache_size
         self.min_prefix = max(min_prefix, MIN_BUCKET)
         self._prefix_cache: list[tuple[list[int], Any]] = []
-        self.prefix_hits = 0  # observability: admissions seeded from the cache
+        # observability counters (surfaced by stats() and the server's
+        # /metrics route)
+        self.prefix_hits = 0        # admissions seeded from the prefix cache
+        self.requests_admitted = 0
+        self.requests_completed = 0
+        self.tokens_emitted = 0
+        self.batched_waves = 0      # multi-request admission prefills
+        self.requests_cancelled = 0  # admitted, then client went away
+        self.requests_failed = 0     # admitted, then the decode dispatch died
+        self._t0 = time.monotonic()
 
     def _init_device_state(self) -> None:
         """(Re)allocate the slot cache and per-slot vectors — used at
@@ -563,6 +573,7 @@ class ContinuousBatchingEngine:
         for slot, req in list(self._requests.items()):
             req.error = message
             req.done = True
+            self.requests_failed += 1
             req.events.put(None)
             self._active[slot] = False
             self._requests.pop(slot, None)
@@ -624,6 +635,7 @@ class ContinuousBatchingEngine:
         for slot, req in list(self._requests.items()):
             if req.cancelled:
                 req.done = True
+                self.requests_cancelled += 1
                 req.events.put(None)
                 self._active[slot] = False
                 self._requests.pop(slot, None)
@@ -746,6 +758,7 @@ class ContinuousBatchingEngine:
             )
         first = int(firsts[0])
         self._store_prefix(ids, row)
+        self.requests_admitted += 1
         req.slot = slot
         self._active[slot] = True
         self._requests[slot] = req
@@ -812,6 +825,9 @@ class ContinuousBatchingEngine:
             lambda x: x[:, :1] if x.ndim >= 2 else x[:1], row
         )
         self._store_prefix(reqs[0].prompt_ids, row0)
+        self.requests_admitted += len(reqs)
+        if n > 1:
+            self.batched_waves += 1
         firsts_host = [int(t) for t in np.asarray(firsts)]
         for req, slot, first in zip(reqs, slots, firsts_host):
             req.slot = slot
@@ -980,14 +996,32 @@ class ContinuousBatchingEngine:
             req.emitted += 1
         if out:
             req.events.put(out)
+            self.tokens_emitted += len(out)
         if req.done or req.emitted >= req.max_new_tokens:
             req.done = True
+            self.requests_completed += 1
             if req.slot >= 0:
                 self._active[req.slot] = False
                 self._requests.pop(req.slot, None)
                 self._histories.pop(req.slot, None)
                 self._bigram_index.pop(req.slot, None)
             req.events.put(None)
+
+    def stats(self) -> dict:
+        """Host-side observability counters (engine-thread owned; reads from
+        other threads see a near-consistent snapshot, fine for metrics)."""
+        return {
+            "requests_admitted": self.requests_admitted,
+            "requests_completed": self.requests_completed,
+            "requests_cancelled": self.requests_cancelled,
+            "requests_failed": self.requests_failed,
+            "tokens_emitted": self.tokens_emitted,
+            "prefix_hits": self.prefix_hits,
+            "batched_admission_waves": self.batched_waves,
+            "active_slots": int(self._active.sum()),
+            "queue_depth": self._pending.qsize(),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+        }
 
 
 class EngineBackend:
@@ -1001,6 +1035,10 @@ class EngineBackend:
     def __init__(self, engine: ContinuousBatchingEngine, tokenizer: Any) -> None:
         self.engine = engine
         self.tokenizer = tokenizer
+
+    def stats(self) -> dict:
+        """Forward the engine's observability counters (server /metrics)."""
+        return self.engine.stats()
 
     def submit_text(
         self,

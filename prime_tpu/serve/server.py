@@ -91,6 +91,8 @@ class InferenceServer:
                         200,
                         {"object": "list", "data": [{"id": outer.model_id, "object": "model"}]},
                     )
+                elif self.path in ("/metrics", "/v1/metrics"):
+                    self._json(200, outer.metrics())
                 elif self.path.rstrip("/").endswith(f"/models/{outer.model_id}"):
                     self._json(200, {"id": outer.model_id, "object": "model"})
                 else:
@@ -182,6 +184,25 @@ class InferenceServer:
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None
+
+    # -- observability --------------------------------------------------------
+
+    def metrics(self) -> dict:
+        """GET /metrics: server identity + the backing engine's counters
+        (admissions, completions, tokens, prefix hits, batched waves, active
+        slots, queue depth) when the generator exposes ``stats()`` — the
+        continuous-batching EngineBackend forwards its engine's."""
+        payload: dict = {
+            "model": self.model_id,
+            "loaded": self.generator is not None,
+        }
+        stats_fn = getattr(self.generator, "stats", None)
+        if callable(stats_fn):
+            try:
+                payload["engine"] = stats_fn()
+            except Exception as e:  # noqa: BLE001 — metrics must never 500
+                payload["engine_error"] = str(e)[:200]
+        return payload
 
     # -- request handling -----------------------------------------------------
 

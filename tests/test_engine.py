@@ -106,6 +106,23 @@ def test_batched_admission_with_prefix_hit_in_burst():
         assert r.all_tokens(timeout=1) == reference_tokens(p, 8)
 
 
+def test_engine_stats_counters():
+    """stats() tracks admissions (batched + single), completions, tokens,
+    and the batched-wave count."""
+    engine = make_engine()
+    prompts = [[3, 1, 4], [2, 7, 18], [9, 9, 9], [5, 6]]
+    reqs = [engine.submit(p, max_new_tokens=6) for p in prompts]
+    drain(engine, *reqs)
+    s = engine.stats()
+    assert s["requests_admitted"] == 4
+    assert s["requests_completed"] == 4
+    assert s["tokens_emitted"] == sum(len(r.all_tokens(timeout=1)) for r in reqs)
+    assert s["batched_admission_waves"] >= 1  # the 4-wide cold wave
+    assert s["active_slots"] == 0
+    assert s["queue_depth"] == 0
+    assert s["uptime_s"] >= 0
+
+
 def test_batched_admission_seeds_prefix_cache():
     """A batched wave stores its first member's staged row, so a recurring
     shared-prefix burst prefix-hits from the second wave on (and the hit

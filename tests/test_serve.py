@@ -29,6 +29,23 @@ def server():
         yield srv
 
 
+def test_metrics_endpoint(server):
+    data = httpx.get(f"{server.url}/metrics").json()
+    assert data["model"] == "tiny-test"
+    assert data["loaded"] is True
+    assert "engine" not in data  # EchoGenerator has no stats()
+
+
+def test_metrics_forwards_engine_stats():
+    class StatsGenerator(EchoGenerator):
+        def stats(self):
+            return {"tokens_emitted": 42, "requests_completed": 3}
+
+    with InferenceServer("tiny-test", StatsGenerator(), port=0) as srv:
+        data = httpx.get(f"{srv.url}/metrics").json()
+    assert data["engine"] == {"tokens_emitted": 42, "requests_completed": 3}
+
+
 def test_models_endpoints(server):
     data = httpx.get(f"{server.url}/v1/models").json()
     assert data["data"][0]["id"] == "tiny-test"
