@@ -108,6 +108,7 @@ def serve_cmd(
     click.echo(
         f"  e.g. PRIME_INFERENCE_URL={server.url}/v1 prime inference chat {model} -m 'hi'"
     )
+    click.echo(f"  metrics: {server.url}/metrics")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
