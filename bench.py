@@ -567,12 +567,18 @@ def main() -> None:
                     engine.tick()
                 size //= 2
                 lead += 1
+            waves_before = engine.batched_waves
             t0 = time.perf_counter()
             reqs = [engine.submit(ids, max_new_tokens=req_new) for ids in prompts]
             while not all(r.done for r in reqs):
                 engine.tick()
             elapsed = time.perf_counter() - t0
             total = sum(len(r.all_tokens(timeout=1)) for r in reqs)
+            # evidence the batched-admission path carried the measurement
+            record.setdefault(
+                "serve_batched_waves", engine.batched_waves - waves_before
+            )
+            record.setdefault("serve_prefix_hits", engine.prefix_hits)
             return total / elapsed
         finally:
             del engine
