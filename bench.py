@@ -528,7 +528,8 @@ def main() -> None:
     serve_prompts = serve_prompts_for(config)
 
     def run_serve(
-        kv_quant: bool = False, speculative: bool = False, prompts=None
+        kv_quant: bool = False, speculative: bool = False, prompts=None,
+        record_counters: bool = False,
     ) -> float:
         from prime_tpu.serve.engine import ContinuousBatchingEngine
 
@@ -568,24 +569,28 @@ def main() -> None:
                 size //= 2
                 lead += 1
             waves_before = engine.batched_waves
+            hits_before = engine.prefix_hits
             t0 = time.perf_counter()
             reqs = [engine.submit(ids, max_new_tokens=req_new) for ids in prompts]
             while not all(r.done for r in reqs):
                 engine.tick()
             elapsed = time.perf_counter() - t0
             total = sum(len(r.all_tokens(timeout=1)) for r in reqs)
-            # evidence the batched-admission path carried the measurement
-            record.setdefault(
-                "serve_batched_waves", engine.batched_waves - waves_before
-            )
-            record.setdefault("serve_prefix_hits", engine.prefix_hits)
+            if record_counters:
+                # evidence the batched-admission path carried the MEASURED
+                # window (deltas, not engine-lifetime totals — warmup hits
+                # prompts[0]'s prefix by construction), and only from the
+                # headline bf16 run so a failed run can't be papered over
+                # by a later variant's counters
+                record["serve_batched_waves"] = engine.batched_waves - waves_before
+                record["serve_prefix_hits"] = engine.prefix_hits - hits_before
             return total / elapsed
         finally:
             del engine
 
     # separate guards: an int8 failure must not mark the bf16 number failed
     try:
-        record["serve_tok_s"] = round(run_serve(kv_quant=False), 1)
+        record["serve_tok_s"] = round(run_serve(kv_quant=False, record_counters=True), 1)
         record["serve_requests"] = n_req
         # roofline approximation: with the queue longer than the slot count
         # the slots stay full, so each decode step streams the weights once
