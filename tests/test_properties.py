@@ -185,3 +185,39 @@ def test_ring_hops_is_sufficient_and_tight(window, s_local, axis_size):
         # tightness: hops-1 shards would NOT cover the band
         if hops > 0:
             assert window - 1 > (hops - 1) * s_local
+
+
+@given(st.integers(min_value=1, max_value=256))
+def test_power_batches_decomposition(n):
+    """_power_batches covers n exactly with descending powers of two — the
+    invariant that bounds the engine's batched-admission compile set."""
+    from prime_tpu.serve.engine import _power_batches
+
+    parts = _power_batches(n)
+    assert sum(parts) == n
+    assert all(p & (p - 1) == 0 for p in parts)  # powers of two
+    assert parts == sorted(parts, reverse=True)
+    assert len(set(parts)) == len(parts)  # binary decomposition: no repeats
+
+
+@given(
+    st.integers(min_value=1, max_value=600),
+    st.integers(min_value=1, max_value=600),
+)
+@settings(deadline=None)
+def test_cold_chunk_plans_equal_iff_groupable(len_a, len_b):
+    """Two cold prompts batch together exactly when their (row capacity,
+    plan) keys match — and matching plans guarantee both prompts' last
+    token lands inside the final chunk (what the batched rels gather
+    assumes)."""
+    from prime_tpu.serve.engine import chunk_plan, row_capacity_for
+
+    capacity, max_chunk = 1024, 128
+    rows = [row_capacity_for(n, max_chunk, capacity) for n in (len_a, len_b)]
+    plans = [
+        chunk_plan(0, n, max_chunk, r) for n, r in zip((len_a, len_b), rows)
+    ]
+    if (rows[0], plans[0]) == (rows[1], plans[1]):
+        off, size = plans[0][-1]
+        for n in (len_a, len_b):
+            assert off < n <= off + size
