@@ -529,7 +529,7 @@ def main() -> None:
 
     def run_serve(
         kv_quant: bool = False, speculative: bool = False, prompts=None,
-        record_counters: bool = False,
+        record_counters: bool = False, obs_key: str | None = None,
     ) -> float:
         from prime_tpu.serve.engine import ContinuousBatchingEngine
 
@@ -584,13 +584,22 @@ def main() -> None:
                 # by a later variant's counters
                 record["serve_batched_waves"] = engine.batched_waves - waves_before
                 record["serve_prefix_hits"] = engine.prefix_hits - hits_before
+            if obs_key:
+                # full metrics-registry snapshot (TTFT / queue-wait /
+                # prefill / decode-step histograms over the warmup+measured
+                # window) so BENCH_*.json carries distributions, not just
+                # the headline mean
+                engine.stats()  # refresh point-in-time gauges
+                record[obs_key] = engine.registry.snapshot()
             return total / elapsed
         finally:
             del engine
 
     # separate guards: an int8 failure must not mark the bf16 number failed
     try:
-        record["serve_tok_s"] = round(run_serve(kv_quant=False, record_counters=True), 1)
+        record["serve_tok_s"] = round(
+            run_serve(kv_quant=False, record_counters=True, obs_key="serve_obs"), 1
+        )
         record["serve_requests"] = n_req
         # roofline approximation: with the queue longer than the slot count
         # the slots stay full, so each decode step streams the weights once
@@ -614,7 +623,7 @@ def main() -> None:
     print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
     try:
         # int8-cache engine: same load, half the KV HBM traffic per step
-        record["serve_int8_tok_s"] = round(run_serve(kv_quant=True), 1)
+        record["serve_int8_tok_s"] = round(run_serve(kv_quant=True, obs_key="serve_int8_obs"), 1)
         print(f"# bench: serve int8 {record['serve_int8_tok_s']} tok/s", flush=True)
     except Exception as e:  # noqa: BLE001
         record["serve_int8_error"] = f"{type(e).__name__}: {e}"[:200]
@@ -629,7 +638,7 @@ def main() -> None:
             [1] + list(range(3 + i, 11 + i)) * 12 for i in range(n_req)
         ]
         record["serve_spec_tok_s"] = round(
-            run_serve(speculative=True, prompts=periodic), 1
+            run_serve(speculative=True, prompts=periodic, obs_key="serve_spec_obs"), 1
         )
         print(f"# bench: serve speculative {record['serve_spec_tok_s']} tok/s", flush=True)
     except Exception as e:  # noqa: BLE001

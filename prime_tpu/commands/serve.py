@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import click
 
+from prime_tpu.utils.render import Renderer, output_options
 
-@click.command(name="serve")
-@click.option("--model", "-m", required=True, help="Model preset or local HF checkpoint dir.")
+
+@click.group(name="serve", invoke_without_command=True)
+@click.option("--model", "-m", default=None, help="Model preset or local HF checkpoint dir.")
 @click.option("--checkpoint", default=None, help="Local HF checkpoint dir for weights.")
 @click.option("--tokenizer", default=None)
 @click.option("--slice", "slice_name", default=None, help="Shard over this TPU slice's mesh.")
@@ -54,8 +56,10 @@ import click
 )
 @click.option("--draft-len", type=click.IntRange(min=1), default=4,
               help="Speculative draft tokens per step.")
+@click.pass_context
 def serve_cmd(
-    model: str,
+    ctx: click.Context,
+    model: str | None,
     checkpoint: str | None,
     tokenizer: str | None,
     slice_name: str | None,
@@ -75,6 +79,10 @@ def serve_cmd(
     draft_len: int,
 ) -> None:
     """Serve MODEL over an OpenAI-compatible HTTP API (blocks until Ctrl-C)."""
+    if ctx.invoked_subcommand is not None:
+        return  # `prime serve metrics` — the subcommand runs instead
+    if model is None:
+        raise click.UsageError("Missing option '--model' / '-m'.")
     from prime_tpu.serve import serve_model
 
     if weight_bits == "4" and not weight_quant:
@@ -108,9 +116,94 @@ def serve_cmd(
     click.echo(
         f"  e.g. PRIME_INFERENCE_URL={server.url}/v1 prime inference chat {model} -m 'hi'"
     )
-    click.echo(f"  metrics: {server.url}/metrics")
+    click.echo(f"  metrics: {server.url}/metrics  (prometheus: {server.url}/metrics?format=prometheus)")
+    click.echo(f"  health:  {server.url}/healthz")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         click.echo("\nStopped.")
         server.stop()
+
+
+@serve_cmd.command(name="metrics")
+@click.option(
+    "--url", default="http://127.0.0.1:8000", show_default=True,
+    help="Base URL of a running `prime serve` instance.",
+)
+@click.option(
+    "--prometheus", is_flag=True,
+    help="Dump the raw Prometheus text exposition instead of a table.",
+)
+@output_options
+def serve_metrics_cmd(render: "Renderer", url: str, prometheus: bool) -> None:
+    """Scrape a running server's metrics registry: counters, gauges, and
+    latency histograms (TTFT, queue wait, prefill/decode) with estimated
+    p50/p95. See docs/architecture.md "Observability"."""
+    import httpx
+
+    from prime_tpu.obs.metrics import quantile_from_snapshot
+
+    if prometheus and render.is_json:
+        # the exposition IS a text format; silently emitting it where a
+        # script asked for JSON would break a downstream `| jq`
+        raise click.UsageError(
+            "--prometheus emits text exposition format; drop it or use "
+            "--output json without it for the registry JSON"
+        )
+    base = url.rstrip("/")
+    try:
+        if prometheus:
+            response = httpx.get(
+                f"{base}/metrics", params={"format": "prometheus"}, timeout=10
+            )
+            response.raise_for_status()
+            click.echo(response.text, nl=False)
+            return
+        response = httpx.get(
+            f"{base}/metrics", params={"format": "registry"}, timeout=10
+        )
+        response.raise_for_status()
+        payload = response.json()
+    except (httpx.HTTPError, ValueError) as e:
+        raise click.ClickException(f"could not scrape {base}/metrics: {e}") from None
+    if not isinstance(payload, dict) or not all(
+        isinstance(registry, dict)
+        and all(isinstance(family, dict) and "series" in family for family in registry.values())
+        for registry in payload.values()
+    ):
+        # e.g. a pre-telemetry server that answered the bare /metrics JSON
+        raise click.ClickException(
+            f"{base}/metrics?format=registry did not return registry snapshots "
+            "(is the server running this repo's serve build?)"
+        )
+
+    value_rows: list[list] = []
+    hist_rows: list[list] = []
+    for section, registry in payload.items():
+        for name, family in registry.items():
+            for series in family["series"]:
+                labels = ",".join(f"{k}={v}" for k, v in series["labels"].items())
+                if family["type"] == "histogram":
+                    count = series["count"]
+                    mean = series["sum"] / count if count else 0.0
+                    p50 = quantile_from_snapshot(series["buckets"], series["counts"], 0.5)
+                    p95 = quantile_from_snapshot(series["buckets"], series["counts"], 0.95)
+                    hist_rows.append(
+                        [section, name, labels, count,
+                         round(mean, 6), round(p50, 6), round(p95, 6)]
+                    )
+                else:
+                    value_rows.append(
+                        [section, name, labels, family["type"], series["value"]]
+                    )
+    if render.is_json:
+        render.json(payload)
+        return
+    render.table(
+        ["section", "metric", "labels", "type", "value"], value_rows,
+        title="Counters & gauges",
+    )
+    render.table(
+        ["section", "metric", "labels", "count", "mean", "p50", "p95"], hist_rows,
+        title="Histograms (seconds unless named otherwise)",
+    )

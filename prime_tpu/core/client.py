@@ -17,6 +17,10 @@ sandboxes SDK client's idempotency-aware retry tiers
 Both clients share one request-building/response-mapping core so the async
 surface cannot drift from the sync one (the reference duplicates ~1,100 lines
 between its mirrors; see SURVEY.md §7 "hard parts").
+
+Every request records latency/status/retry-count into the process-wide
+metrics registry (prime_tpu.obs; docs/architecture.md "Observability") —
+the sync and async mirrors share the recording helper too.
 """
 
 from __future__ import annotations
@@ -31,6 +35,7 @@ import httpx
 
 import prime_tpu
 from prime_tpu.core.config import Config
+from prime_tpu.obs.metrics import REGISTRY
 from prime_tpu.core.exceptions import (
     APIConnectionError,
     APIError,
@@ -49,6 +54,33 @@ MAX_ATTEMPTS = 4
 BACKOFF_BASE = 0.5
 BACKOFF_MAX = 30.0
 IDEMPOTENT_METHODS = frozenset({"GET", "HEAD", "PUT", "DELETE"})
+
+
+# HTTP transport metrics (process-wide default registry, shared by the sync
+# and async clients so the mirrors cannot drift): outcome label is the HTTP
+# status code, or "connection_error"/"timeout" when no response arrived.
+_HTTP_REQUESTS = REGISTRY.counter(
+    "client_http_requests_total", "Backend API requests by final outcome",
+    labelnames=("method", "status"),
+)
+_HTTP_LATENCY = REGISTRY.histogram(
+    "client_http_request_seconds",
+    "Backend API request wall time (all attempts + backoff)",
+    labelnames=("method",),
+)
+_HTTP_RETRIES = REGISTRY.counter(
+    "client_http_retries_total", "Retry attempts beyond each request's first",
+    labelnames=("method",),
+)
+
+
+def _observe_request(method: str, status: str, t0: float, attempt: int) -> None:
+    """Record one logical request's outcome: final status, total wall time,
+    and how many extra attempts the retry tiers spent on it."""
+    _HTTP_REQUESTS.inc(method=method, status=status)
+    _HTTP_LATENCY.observe(time.monotonic() - t0, method=method)
+    if attempt:
+        _HTTP_RETRIES.inc(attempt, method=method)
 
 
 def user_agent() -> str:
@@ -228,6 +260,7 @@ class APIClient:
         hdrs = self._core.headers(headers)
         replayable = files is None
         last_exc: Exception | None = None
+        t0 = time.monotonic()
         for attempt in range(self.max_attempts):
             try:
                 response = self._client.request(
@@ -246,6 +279,7 @@ class APIClient:
                     not _should_retry_exception(exc, method, idempotent_post, replayable)
                     or attempt == self.max_attempts - 1
                 ):
+                    _observe_request(method, "timeout", t0, attempt)
                     raise APITimeoutError(f"{method} {url} timed out: {exc}") from exc
                 time.sleep(_backoff(attempt))
                 continue
@@ -255,6 +289,7 @@ class APIClient:
                     not _should_retry_exception(exc, method, idempotent_post, replayable)
                     or attempt == self.max_attempts - 1
                 ):
+                    _observe_request(method, "connection_error", t0, attempt)
                     raise APIConnectionError(f"Could not reach {url}: {exc}") from exc
                 time.sleep(_backoff(attempt))
                 continue
@@ -264,6 +299,7 @@ class APIClient:
             ):
                 time.sleep(_backoff(attempt))
                 continue
+            _observe_request(method, str(response.status_code), t0, attempt)
             return self._core.parse(response)
         raise APIConnectionError(f"Could not reach {url}: {last_exc}")  # pragma: no cover
 
@@ -292,24 +328,35 @@ class APIClient:
         headers: dict[str, str] | None = None,
         timeout: httpx.Timeout | float | None = None,
     ) -> Iterator[str]:
-        """Stream response lines (SSE / JSONL endpoints). No retries."""
+        """Stream response lines (SSE / JSONL endpoints). No retries. The
+        latency metric covers time-to-headers, not the stream's lifetime —
+        a long-lived SSE tail would drown the histogram otherwise."""
+        method = method.upper()
         url = self._core.url(path)
+        t0 = time.monotonic()
+        observed = False
         try:
             with self._client.stream(
-                method.upper(),
+                method,
                 url,
                 json=json,
                 params=params,
                 headers=self._core.headers(headers),
                 timeout=timeout if timeout is not None else httpx.USE_CLIENT_DEFAULT,
             ) as response:
+                _observe_request(method, str(response.status_code), t0, 0)
+                observed = True
                 if response.status_code >= 400:
                     response.read()
                     raise_for_status(response)
                 yield from response.iter_lines()
         except httpx.TimeoutException as exc:
+            if not observed:
+                _observe_request(method, "timeout", t0, 0)
             raise APITimeoutError(f"{method} {url} timed out: {exc}") from exc
         except httpx.TransportError as exc:
+            if not observed:
+                _observe_request(method, "connection_error", t0, 0)
             raise APIConnectionError(f"Could not reach {url}: {exc}") from exc
 
 
@@ -370,6 +417,7 @@ class AsyncAPIClient:
         hdrs = self._core.headers(headers)
         replayable = files is None
         last_exc: Exception | None = None
+        t0 = time.monotonic()
         for attempt in range(self.max_attempts):
             try:
                 response = await self._client.request(
@@ -388,6 +436,7 @@ class AsyncAPIClient:
                     not _should_retry_exception(exc, method, idempotent_post, replayable)
                     or attempt == self.max_attempts - 1
                 ):
+                    _observe_request(method, "timeout", t0, attempt)
                     raise APITimeoutError(f"{method} {url} timed out: {exc}") from exc
                 await anyio.sleep(_backoff(attempt))
                 continue
@@ -397,6 +446,7 @@ class AsyncAPIClient:
                     not _should_retry_exception(exc, method, idempotent_post, replayable)
                     or attempt == self.max_attempts - 1
                 ):
+                    _observe_request(method, "connection_error", t0, attempt)
                     raise APIConnectionError(f"Could not reach {url}: {exc}") from exc
                 await anyio.sleep(_backoff(attempt))
                 continue
@@ -406,6 +456,7 @@ class AsyncAPIClient:
             ):
                 await anyio.sleep(_backoff(attempt))
                 continue
+            _observe_request(method, str(response.status_code), t0, attempt)
             return self._core.parse(response)
         raise APIConnectionError(f"Could not reach {url}: {last_exc}")  # pragma: no cover
 
@@ -434,22 +485,31 @@ class AsyncAPIClient:
         headers: dict[str, str] | None = None,
         timeout: httpx.Timeout | float | None = None,
     ) -> AsyncIterator[str]:
+        method = method.upper()
         url = self._core.url(path)
+        t0 = time.monotonic()
+        observed = False
         try:
             async with self._client.stream(
-                method.upper(),
+                method,
                 url,
                 json=json,
                 params=params,
                 headers=self._core.headers(headers),
                 timeout=timeout if timeout is not None else httpx.USE_CLIENT_DEFAULT,
             ) as response:
+                _observe_request(method, str(response.status_code), t0, 0)
+                observed = True
                 if response.status_code >= 400:
                     await response.aread()
                     raise_for_status(response)
                 async for line in response.aiter_lines():
                     yield line
         except httpx.TimeoutException as exc:
+            if not observed:
+                _observe_request(method, "timeout", t0, 0)
             raise APITimeoutError(f"{method} {url} timed out: {exc}") from exc
         except httpx.TransportError as exc:
+            if not observed:
+                _observe_request(method, "connection_error", t0, 0)
             raise APIConnectionError(f"Could not reach {url}: {exc}") from exc

@@ -22,6 +22,8 @@ from typing import Callable, Protocol
 from prime_tpu.evals.datasets import EvalExample, load_gsm8k, score_completion, synthetic_arithmetic
 from prime_tpu.evals.models import CreateEvaluationRequest, EvalSample
 from prime_tpu.evals.tokenizer import Tokenizer, load_tokenizer
+from prime_tpu.obs.metrics import Registry
+from prime_tpu.obs.trace import TRACER
 
 
 class Generator(Protocol):
@@ -362,13 +364,35 @@ def run_eval(
             adapter=spec.adapter,
         )
 
+    # per-run registry: batch/sample latency histograms land in the run's
+    # metadata.json under "obs" (runs stay isolated from each other); the
+    # summary metrics below are derived from the same observations
+    registry = Registry()
+    batch_hist = registry.histogram(
+        "eval_batch_seconds", "Wall time per generate() batch"
+    )
+    sample_hist = registry.histogram(
+        "eval_sample_seconds", "Amortized wall time per sample (batch/size)"
+    )
+    samples_counter = registry.counter("eval_samples_total", "Samples scored")
+    sample_latencies: list[float] = []
+
     samples: list[EvalSample] = []
     t0 = time.monotonic()
     for start in range(0, len(examples), spec.batch_size):
         chunk: list[EvalExample] = examples[start : start + spec.batch_size]
-        completions = generator.generate(
-            [e.prompt for e in chunk], spec.max_new_tokens, spec.temperature
-        )
+        batch_t0 = time.monotonic()
+        with TRACER.span("eval.batch", start=start, size=len(chunk)):
+            completions = generator.generate(
+                [e.prompt for e in chunk], spec.max_new_tokens, spec.temperature
+            )
+        batch_elapsed = time.monotonic() - batch_t0
+        batch_hist.observe(batch_elapsed)
+        per_sample = batch_elapsed / len(chunk)
+        for _ in chunk:
+            sample_hist.observe(per_sample)
+            sample_latencies.append(per_sample)
+        samples_counter.inc(len(chunk))
         for example, completion in zip(chunk, completions):
             if scorer is not None:
                 reward = float(scorer(completion, example.answer))
@@ -391,11 +415,18 @@ def run_eval(
     elapsed = time.monotonic() - t0
 
     n = len(samples)
+    ordered = sorted(sample_latencies)
     metrics = {
         "accuracy": sum(1 for s in samples if s.correct) / n,
         "samples_per_sec": n / elapsed if elapsed > 0 else 0.0,
         "num_samples": float(n),
         "wall_time_s": elapsed,
+        # per-sample latency distribution (amortized over each batch) — a
+        # single elapsed scalar hides stragglers and warmup/compile skew
+        "sample_latency_mean_s": sum(ordered) / len(ordered) if ordered else 0.0,
+        "sample_latency_p50_s": ordered[len(ordered) // 2] if ordered else 0.0,
+        "sample_latency_p95_s": ordered[int(len(ordered) * 0.95)] if ordered else 0.0,
+        "sample_latency_max_s": ordered[-1] if ordered else 0.0,
     }
 
     run_id = f"{time.strftime('%Y%m%d-%H%M%S')}-{uuid.uuid4().hex[:8]}"
@@ -413,6 +444,9 @@ def run_eval(
                     "max_new_tokens": spec.max_new_tokens,
                     "temperature": spec.temperature,
                 },
+                # full histogram data (bucket counts) for offline analysis —
+                # the scalar metrics above are a lossy summary of these
+                "obs": registry.snapshot(),
                 **spec.metadata,
             },
             indent=2,

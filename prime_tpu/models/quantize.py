@@ -130,13 +130,46 @@ def _unpack_nibbles(packed: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     return lo, hi
 
 
+def _mesh_context_active() -> bool:
+    """True inside any mesh context (``with mesh:`` or ``jax.set_mesh``) with
+    more than one device — the SPMD regime where a bare ``pl.pallas_call``
+    cannot partition under jit (same rule the attention ops document: mesh
+    callers must take the XLA path). Checks both the legacy physical-mesh
+    thread resource and the newer abstract-mesh context, tolerating either
+    being absent across jax versions."""
+    try:
+        from jax._src import mesh as mesh_lib
+    except Exception:  # pragma: no cover — internal layout moved
+        return False
+    physical = getattr(
+        getattr(getattr(mesh_lib, "thread_resources", None), "env", None),
+        "physical_mesh", None,
+    )
+    if physical is not None and not physical.empty and physical.size > 1:
+        return True
+    get_abstract = getattr(mesh_lib, "get_abstract_mesh", None)
+    if get_abstract is not None:
+        abstract = get_abstract()
+        if (
+            abstract is not None
+            and not getattr(abstract, "empty", True)
+            and getattr(abstract, "size", 1) > 1
+        ):
+            return True
+    return False
+
+
 def _int4_pallas_eligible(x: jnp.ndarray, q: jnp.ndarray, interpret: bool) -> bool:
     """Gate the fused pallas int4 kernel to the regime it exists for: the
-    decode/gemv path on TPU (few activation rows, per-layer 2-D packed
-    weights, lane-aligned output). Prefill and training keep the XLA path —
-    they are MXU-bound, not weight-bandwidth-bound — as do stacked
-    (pre-scan-slice) weights and CPU runs (unless interpret mode is forced
-    for tests)."""
+    SINGLE-DEVICE decode/gemv path on TPU (few activation rows, per-layer
+    2-D packed weights, lane-aligned output). Prefill and training keep the
+    XLA path — they are MXU-bound, not weight-bandwidth-bound — as do
+    stacked (pre-scan-slice) weights and CPU runs (unless interpret mode is
+    forced for tests). Under an active multi-device mesh context the XLA
+    unpack chain runs instead: a bare pallas_call cannot partition under
+    SPMD jit (ADVICE r5). A multi-chip host WITHOUT a mesh stays eligible —
+    unsharded jit commits to one device, where the kernel is exactly the
+    weight-bandwidth win it was built for."""
     import numpy as np
 
     if q.ndim != 2 or q.dtype != jnp.uint8:
@@ -145,6 +178,8 @@ def _int4_pallas_eligible(x: jnp.ndarray, q: jnp.ndarray, interpret: bool) -> bo
         return False
     rows = int(np.prod(x.shape[:-1]))
     if rows > 32:
+        return False
+    if _mesh_context_active():
         return False
     return interpret or jax.default_backend() == "tpu"
 

@@ -1,0 +1,43 @@
+"""Unified observability layer: metrics registry + request tracing.
+
+Dependency-free on purpose — ``obs`` sits below every other prime_tpu layer
+(core.client, serve, evals all record into it) so it must import nothing from
+them and nothing heavyweight (no jax, no httpx). Two halves:
+
+- :mod:`prime_tpu.obs.metrics` — ``Counter`` / ``Gauge`` / ``Histogram``
+  families in a ``Registry`` with one lock per registry, so a snapshot (or a
+  Prometheus scrape) sees a mutually consistent view of every series.
+- :mod:`prime_tpu.obs.trace` — a lightweight span tracer
+  (``span(name, **attrs)`` context manager) with monotonic-clock timing,
+  thread-local parent/child nesting and JSONL export for offline analysis.
+
+See docs/architecture.md "Observability" for the exposition endpoints
+(`GET /metrics?format=prometheus`, `/healthz`) and the trace JSONL schema.
+"""
+
+from prime_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    DEFAULT_SIZE_BUCKETS,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    quantile_from_snapshot,
+)
+from prime_tpu.obs.trace import TRACER, Span, Tracer, span
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "REGISTRY",
+    "DEFAULT_LATENCY_BUCKETS",
+    "DEFAULT_SIZE_BUCKETS",
+    "quantile_from_snapshot",
+    "Span",
+    "Tracer",
+    "TRACER",
+    "span",
+]

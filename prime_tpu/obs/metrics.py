@@ -1,0 +1,349 @@
+"""Thread-safe metrics registry with Prometheus text-format exposition.
+
+The shape follows prometheus_client's data model (families → labeled series)
+without the dependency: a ``Registry`` owns metric families; every family
+created by one registry shares that registry's single lock, so
+``snapshot()`` / ``render_prometheus()`` observe a mutually consistent view
+across ALL series — the cross-field inconsistency the bare engine counters
+had (ADVICE r5, serve/engine.py) cannot recur through this layer.
+
+Histograms are fixed-bucket (cumulative ``le`` semantics, like Prometheus):
+``observe`` is O(#buckets) with no allocation, and quantiles are estimated
+host-side by linear interpolation within the bucket that crosses the rank —
+good enough for TTFT/TPOT p50/p95 dashboards, exact at bucket boundaries.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Any, Iterable, Mapping
+
+# Prometheus-style latency buckets, widened past 10s because a first-compile
+# TTFT on a cold engine is legitimately minutes, not milliseconds.
+DEFAULT_LATENCY_BUCKETS: tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+# power-of-two size buckets (admission batch sizes, token counts, ...)
+DEFAULT_SIZE_BUCKETS: tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _escape_label_value(value: str) -> str:
+    return value.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _format_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v)
+
+
+def _label_str(names: tuple[str, ...], values: tuple[str, ...], extra: str = "") -> str:
+    parts = [f'{n}="{_escape_label_value(v)}"' for n, v in zip(names, values)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    """One metric family: a name, help text, label names, and a series per
+    distinct label-value tuple. Lock is the owning registry's."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: tuple[str, ...], lock: threading.RLock) -> None:
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for label in labelnames:
+            if not _LABEL_RE.match(label):
+                raise ValueError(f"invalid label name {label!r}")
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = lock
+        self._series: dict[tuple[str, ...], Any] = {}
+
+    def _key(self, labels: Mapping[str, Any]) -> tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name} expects labels {self.labelnames}, got {tuple(labels)}"
+            )
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def _new_series(self) -> Any:
+        raise NotImplementedError
+
+    def _get(self, labels: Mapping[str, Any]) -> Any:
+        key = self._key(labels)
+        series = self._series.get(key)
+        if series is None:
+            series = self._series[key] = self._new_series()
+        return series
+
+
+class Counter(_Metric):
+    """Monotonically increasing counter."""
+
+    kind = "counter"
+
+    def _new_series(self) -> list[float]:
+        return [0.0]
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._get(labels)[0] += amount
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            series = self._series.get(self._key(labels))
+            return series[0] if series else 0.0
+
+
+class Gauge(_Metric):
+    """Value that can go up and down."""
+
+    kind = "gauge"
+
+    def _new_series(self) -> list[float]:
+        return [0.0]
+
+    def set(self, value: float, **labels: Any) -> None:
+        with self._lock:
+            self._get(labels)[0] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        with self._lock:
+            self._get(labels)[0] += amount
+
+    def dec(self, amount: float = 1.0, **labels: Any) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            series = self._series.get(self._key(labels))
+            return series[0] if series else 0.0
+
+
+class _HistSeries:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int) -> None:
+        self.counts = [0] * (n_buckets + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram with Prometheus cumulative ``le`` semantics."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: tuple[str, ...],
+        lock: threading.RLock,
+        buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS,
+    ) -> None:
+        super().__init__(name, help, labelnames, lock)
+        self.buckets = tuple(float(b) for b in buckets)
+        if not self.buckets or any(
+            a >= b for a, b in zip(self.buckets, self.buckets[1:])
+        ):
+            raise ValueError("buckets must be non-empty and strictly increasing")
+
+    def _new_series(self) -> _HistSeries:
+        return _HistSeries(len(self.buckets))
+
+    def observe(self, value: float, **labels: Any) -> None:
+        with self._lock:
+            series = self._get(labels)
+            # first bucket whose upper bound holds the value (le: v <= bound);
+            # past the last bound it lands in +Inf only
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    series.counts[i] += 1
+                    break
+            else:
+                series.counts[-1] += 1
+            series.sum += value
+            series.count += 1
+
+    def quantile(self, q: float, **labels: Any) -> float:
+        """Estimated q-quantile (0 <= q <= 1) by linear interpolation inside
+        the bucket that crosses rank q*count. Values beyond the last finite
+        bound clamp to it (the +Inf bucket has no width to interpolate)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("q must be in [0, 1]")
+        with self._lock:
+            series = self._series.get(self._key(labels))
+            if series is None or series.count == 0:
+                return float("nan")
+            return quantile_from_snapshot(list(self.buckets), series.counts, q)
+
+    def series_snapshot(self, **labels: Any) -> dict | None:
+        with self._lock:
+            series = self._series.get(self._key(labels))
+            if series is None:
+                return None
+            return {
+                "buckets": list(self.buckets),
+                "counts": list(series.counts),
+                "sum": series.sum,
+                "count": series.count,
+            }
+
+
+class Registry:
+    """A set of metric families sharing ONE lock: any read path
+    (``snapshot``, ``render_prometheus``, bulk ``values``) sees every series
+    at a single consistent point, and every write is a short critical
+    section (CPython-cheap; nothing here runs on a jit hot path — metrics
+    record around device dispatches, not inside them)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _register(self, cls, name: str, help: str, labelnames, **kw) -> Any:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls) or existing.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered as {existing.kind} "
+                        f"with labels {existing.labelnames}"
+                    )
+                return existing
+            metric = cls(name, help, tuple(labelnames), self._lock, **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "", labelnames: Iterable[str] = ()) -> Counter:
+        return self._register(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames: Iterable[str] = ()) -> Gauge:
+        return self._register(Gauge, name, help, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labelnames: Iterable[str] = (),
+        buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS,
+    ) -> Histogram:
+        return self._register(Histogram, name, help, labelnames, buckets=buckets)
+
+    def get(self, name: str) -> _Metric | None:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def values(self) -> dict[str, float]:
+        """Unlabeled counter/gauge values in one consistent read — the
+        engine's ``stats()`` composes its legacy JSON from this."""
+        with self._lock:
+            out = {}
+            for name, metric in self._metrics.items():
+                if isinstance(metric, (Counter, Gauge)) and not metric.labelnames:
+                    series = metric._series.get(())
+                    out[name] = series[0] if series else 0.0
+            return out
+
+    def snapshot(self) -> dict[str, dict]:
+        """JSON-able dump of every family and series, taken under the one
+        lock (mutually consistent across metrics)."""
+        with self._lock:
+            out: dict[str, dict] = {}
+            for name, metric in self._metrics.items():
+                series_list = []
+                for key, series in metric._series.items():
+                    labels = dict(zip(metric.labelnames, key))
+                    if isinstance(metric, Histogram):
+                        series_list.append(
+                            {
+                                "labels": labels,
+                                "buckets": list(metric.buckets),
+                                "counts": list(series.counts),
+                                "sum": series.sum,
+                                "count": series.count,
+                            }
+                        )
+                    else:
+                        series_list.append({"labels": labels, "value": series[0]})
+                out[name] = {
+                    "type": metric.kind,
+                    "help": metric.help,
+                    "series": series_list,
+                }
+            return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4 of every family."""
+        with self._lock:
+            lines: list[str] = []
+            for name, metric in self._metrics.items():
+                if metric.help:
+                    lines.append(f"# HELP {name} {_escape_help(metric.help)}")
+                lines.append(f"# TYPE {name} {metric.kind}")
+                for key, series in metric._series.items():
+                    if isinstance(metric, Histogram):
+                        cumulative = 0
+                        for bound, count in zip(
+                            metric.buckets + (math.inf,), series.counts
+                        ):
+                            cumulative += count
+                            le = f'le="{_format_value(float(bound))}"'
+                            lines.append(
+                                f"{name}_bucket"
+                                f"{_label_str(metric.labelnames, key, le)} {cumulative}"
+                            )
+                        labels = _label_str(metric.labelnames, key)
+                        lines.append(f"{name}_sum{labels} {_format_value(series.sum)}")
+                        lines.append(f"{name}_count{labels} {series.count}")
+                    else:
+                        labels = _label_str(metric.labelnames, key)
+                        lines.append(f"{name}{labels} {_format_value(series[0])}")
+            return "\n".join(lines) + "\n" if lines else ""
+
+
+def quantile_from_snapshot(buckets: list[float], counts: list[int], q: float) -> float:
+    """Histogram quantile estimate from snapshot data (same interpolation as
+    :meth:`Histogram.quantile`) — for consumers holding a serialized
+    snapshot, e.g. `prime serve metrics` rendering a scraped registry."""
+    total = sum(counts)
+    if total == 0:
+        return float("nan")
+    rank = q * total
+    cumulative = 0
+    lower = 0.0
+    for i, bound in enumerate(buckets):
+        in_bucket = counts[i]
+        if cumulative + in_bucket >= rank and in_bucket > 0:
+            frac = (rank - cumulative) / in_bucket
+            return lower + (bound - lower) * min(max(frac, 0.0), 1.0)
+        cumulative += in_bucket
+        lower = bound
+    return buckets[-1]
+
+
+# Process-wide default registry: core.client's HTTP metrics and anything else
+# without a natural owner records here. Servers and engines own their OWN
+# registries (per-instance isolation keeps tests and multi-engine processes
+# from cross-contaminating) and expose them through `GET /metrics`.
+REGISTRY = Registry()

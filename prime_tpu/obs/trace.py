@@ -1,0 +1,210 @@
+"""Lightweight span tracer: nested timing attribution with JSONL export.
+
+``tracer.span(name, **attrs)`` is a context manager timing a region on the
+monotonic clock. Spans nest per-thread (a thread-local stack assigns
+parent/child ids), so a serve request's TTFT decomposes into queue-wait →
+prefill → decode chunks without any global coordination. Finished spans are
+kept in a bounded in-memory ring and, when a sink path is set, appended as
+one JSON object per line — the offline-analysis format (each line:
+``{"name", "trace_id", "span_id", "parent_id", "start_unix_s", "start_s",
+"duration_s", "attrs"}``; ``start_s`` is monotonic, so within one process
+spans order and subtract exactly; ``start_unix_s`` anchors them to wall
+time for cross-process correlation).
+
+The module-level ``TRACER`` is disabled unless ``PRIME_TRACE`` names a JSONL
+path in the environment — a disabled tracer's ``span()`` returns a no-op
+context, keeping the hot paths free of tracing cost by default.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, TextIO
+
+
+class Span:
+    __slots__ = (
+        "name", "attrs", "trace_id", "span_id", "parent_id",
+        "start_unix_s", "start_s", "duration_s",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        attrs: dict[str, Any],
+        trace_id: str,
+        span_id: str,
+        parent_id: str | None,
+    ) -> None:
+        self.name = name
+        self.attrs = attrs
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_unix_s = time.time()
+        self.start_s = time.monotonic()
+        self.duration_s: float | None = None
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_unix_s": self.start_unix_s,
+            "start_s": self.start_s,
+            "duration_s": self.duration_s,
+            "attrs": self.attrs,
+        }
+
+
+class _NullSpan:
+    """Shared no-op stand-in when tracing is disabled."""
+
+    __slots__ = ()
+
+    def set_attr(self, key: str, value: Any) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _SpanContext:
+    __slots__ = ("_tracer", "_span")
+
+    def __init__(self, tracer: "Tracer", span: Span) -> None:
+        self._tracer = tracer
+        self._span = span
+
+    def __enter__(self) -> Span:
+        self._tracer._push(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self._span.attrs.setdefault("error", f"{type(exc).__name__}: {exc}")
+        self._tracer._pop(self._span)
+
+
+class Tracer:
+    """Span factory + finished-span buffer. Thread-safe; one instance can be
+    shared across the engine thread and HTTP handler threads (each thread
+    nests its own stack)."""
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        sink_path: str | os.PathLike | None = None,
+        max_spans: int = 4096,
+    ) -> None:
+        self.enabled = enabled
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._finished: deque[Span] = deque(maxlen=max_spans)
+        self._ids = itertools.count(1)
+        self._sink_path = os.fspath(sink_path) if sink_path is not None else None
+        self._sink: TextIO | None = None
+
+    # -- span lifecycle -------------------------------------------------------
+
+    def span(self, name: str, *, parent: Span | None = None, **attrs: Any):
+        """Context manager timing ``name``; yields the live Span (mutable via
+        ``set_attr``). ``parent`` overrides the thread-local nesting — pass a
+        request's root span to parent work done on another thread."""
+        if not self.enabled:
+            return _NULL_SPAN
+        stack = self._stack()
+        if parent is None and stack:
+            parent = stack[-1]
+        span_id = f"s{next(self._ids):x}"
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            trace_id, parent_id = f"t{next(self._ids):x}", None
+        return _SpanContext(self, Span(name, dict(attrs), trace_id, span_id, parent_id))
+
+    def _stack(self) -> list[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _push(self, span: Span) -> None:
+        self._stack().append(span)
+
+    def _pop(self, span: Span) -> None:
+        span.duration_s = time.monotonic() - span.start_s
+        stack = self._stack()
+        if stack and stack[-1] is span:
+            stack.pop()
+        with self._lock:
+            self._finished.append(span)
+            if self._sink_path is not None:
+                # a broken sink (bad PRIME_TRACE path, disk full) must never
+                # fail the traced code path — telemetry misconfiguration
+                # cannot be allowed to take down serving. Disable the sink on
+                # the first error; the in-memory ring keeps working.
+                try:
+                    if self._sink is None:
+                        self._sink = open(self._sink_path, "a", buffering=1)
+                    self._sink.write(json.dumps(span.to_dict(), default=str) + "\n")
+                except OSError as e:
+                    sys.stderr.write(
+                        f"prime_tpu.obs.trace: disabling span sink "
+                        f"{self._sink_path!r}: {e}\n"
+                    )
+                    self._sink_path = None
+                    self._sink = None
+
+    # -- export ---------------------------------------------------------------
+
+    def drain(self) -> list[dict[str, Any]]:
+        """Return and clear the finished-span buffer (newest last)."""
+        with self._lock:
+            spans = [s.to_dict() for s in self._finished]
+            self._finished.clear()
+        return spans
+
+    def export_jsonl(self, path: str | os.PathLike) -> int:
+        """Append the finished-span buffer to ``path`` as JSONL; returns the
+        number of spans written (buffer is drained)."""
+        spans = self.drain()
+        with open(path, "a") as f:
+            for span in spans:
+                f.write(json.dumps(span, default=str) + "\n")
+        return len(spans)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None
+
+
+# Global tracer: off unless PRIME_TRACE points at a JSONL sink, so untraced
+# runs pay one attribute check per span site.
+TRACER = Tracer(
+    enabled=bool(os.environ.get("PRIME_TRACE")),
+    sink_path=os.environ.get("PRIME_TRACE") or None,
+)
+
+
+def span(name: str, **attrs: Any):
+    """``prime_tpu.obs.span(...)``: a span on the global tracer."""
+    return TRACER.span(name, **attrs)

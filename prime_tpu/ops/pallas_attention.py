@@ -364,8 +364,16 @@ def flash_decode(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
+            # flops/transcendentals stay full-capacity: the grid executes
+            # every cache-block step (a dead block revisits a resident block
+            # and its masked compute still runs). bytes_accessed uses the
+            # elided-read convention, same as flash_attention_causal: the
+            # live-block index-map clip copies only ~length/capacity of the
+            # cache from HBM, and lengths are traced (unknown at estimate
+            # time), so charge the mid-generation expectation of capacity/2
+            # (docs/kernels.md "Cost estimates").
             flops=2 * 2 * batch * num_heads * capacity * head_dim,
-            bytes_accessed=(k_cache.size + v_cache.size) * k_cache.dtype.itemsize,
+            bytes_accessed=(k_cache.size + v_cache.size) * k_cache.dtype.itemsize // 2,
             transcendentals=batch * num_heads * capacity,
         ),
         interpret=interpret,

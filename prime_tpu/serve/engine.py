@@ -24,6 +24,11 @@ compilation model:
 Single-chip by default; pass ``mesh`` + ``cache_spec`` (from
 parallel.sharding) to run the same engine over a TPU slice — decode then
 takes the XLA attention path, which partitions under SPMD.
+
+Observability: each engine owns a prime_tpu.obs metrics Registry (queue-wait
+/ TTFT / per-token latency histograms next to the legacy counters) exposed
+through the server's ``GET /metrics?format=prometheus``; see
+docs/architecture.md "Observability".
 """
 
 from __future__ import annotations
@@ -36,6 +41,9 @@ from dataclasses import dataclass, field
 from typing import Any
 
 import numpy as np
+
+from prime_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS, Registry
+from prime_tpu.obs.trace import TRACER
 
 MIN_BUCKET = 16
 NEG_INF = -1e30
@@ -140,6 +148,11 @@ class EngineRequest:
     done: bool = False
     cancelled: bool = False
     error: str | None = None
+    # monotonic-clock request timeline (obs histograms: queue wait = admitted
+    # - submitted, TTFT = first token - submitted, TPOT over the decode tail)
+    submitted_at: float = 0.0
+    admitted_at: float = 0.0
+    first_token_at: float = 0.0
 
     def cancel(self) -> None:
         """Abandon the request (e.g. the streaming client disconnected). The
@@ -201,6 +214,7 @@ class ContinuousBatchingEngine:
         kv_quant: bool = False,
         speculative: bool = False,
         draft_len: int = 4,
+        registry: Registry | None = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -262,16 +276,87 @@ class ContinuousBatchingEngine:
         self.prefix_cache_size = prefix_cache_size
         self.min_prefix = max(min_prefix, MIN_BUCKET)
         self._prefix_cache: list[tuple[list[int], Any]] = []
-        # observability counters (surfaced by stats() and the server's
-        # /metrics route)
-        self.prefix_hits = 0        # admissions seeded from the prefix cache
-        self.requests_admitted = 0
-        self.requests_completed = 0
-        self.tokens_emitted = 0
-        self.batched_waves = 0      # multi-request admission prefills
-        self.requests_cancelled = 0  # admitted, then client went away
-        self.requests_failed = 0     # admitted, then the decode dispatch died
+        # observability: registry-backed counters + latency histograms
+        # (surfaced by stats(), the server's /metrics JSON, and the
+        # Prometheus exposition at /metrics?format=prometheus). One Registry
+        # per engine — its single lock makes every stats() read mutually
+        # consistent across counters (closes the ADVICE r5 note about
+        # cross-field inconsistency of the old bare ints).
+        self.registry = registry if registry is not None else Registry()
+        r = self.registry
+        self._m_admitted = r.counter(
+            "serve_requests_admitted_total", "Requests admitted into a KV slot"
+        )
+        self._m_completed = r.counter(
+            "serve_requests_completed_total", "Requests finished (EOS or max_tokens)"
+        )
+        self._m_cancelled = r.counter(
+            "serve_requests_cancelled_total", "Requests abandoned by their client"
+        )
+        self._m_failed = r.counter(
+            "serve_requests_failed_total", "Requests failed by a dead dispatch"
+        )
+        self._m_tokens = r.counter(
+            "serve_tokens_emitted_total", "Decoded tokens delivered to clients"
+        )
+        self._m_prefix_hits = r.counter(
+            "serve_prefix_hits_total", "Admissions seeded from the prefix-KV cache"
+        )
+        self._m_batched_waves = r.counter(
+            "serve_batched_admission_waves_total", "Multi-request admission prefills"
+        )
+        self._m_active_slots = r.gauge("serve_active_slots", "Slots decoding right now")
+        self._m_queue_depth = r.gauge("serve_queue_depth", "Requests waiting for a slot")
+        self._m_queue_wait = r.histogram(
+            "serve_queue_wait_seconds", "Submit to admission-start wait per request"
+        )
+        self._m_ttft = r.histogram(
+            "serve_ttft_seconds", "Submit to first emitted token per request"
+        )
+        self._m_tpot = r.histogram(
+            "serve_tpot_seconds", "Mean per-token decode latency per completed request"
+        )
+        self._m_prefill_s = r.histogram(
+            "serve_prefill_seconds", "Prefill wall time per admission dispatch"
+        )
+        self._m_decode_step_s = r.histogram(
+            "serve_decode_step_seconds", "Decode wall time per generated step"
+        )
+        self._m_admit_batch = r.histogram(
+            "serve_admission_batch_size", "Requests admitted per prefill wave",
+            buckets=DEFAULT_SIZE_BUCKETS,
+        )
         self._t0 = time.monotonic()
+
+    # legacy counter attributes (bench.py and older callers read these as
+    # plain ints) — now views over the registry-backed counters
+    @property
+    def prefix_hits(self) -> int:
+        return int(self._m_prefix_hits.value())
+
+    @property
+    def requests_admitted(self) -> int:
+        return int(self._m_admitted.value())
+
+    @property
+    def requests_completed(self) -> int:
+        return int(self._m_completed.value())
+
+    @property
+    def requests_cancelled(self) -> int:
+        return int(self._m_cancelled.value())
+
+    @property
+    def requests_failed(self) -> int:
+        return int(self._m_failed.value())
+
+    @property
+    def tokens_emitted(self) -> int:
+        return int(self._m_tokens.value())
+
+    @property
+    def batched_waves(self) -> int:
+        return int(self._m_batched_waves.value())
 
     def _init_device_state(self) -> None:
         """(Re)allocate the slot cache and per-slot vectors — used at
@@ -496,13 +581,17 @@ class ContinuousBatchingEngine:
             ],
             dtype=jnp.int32,
         )
-        with self._mesh_ctx():
+        t_start = time.monotonic()
+        with TRACER.span("serve.spec_verify", draft_len=self.draft_len), self._mesh_ctx():
             self._cache, self._last, toks, run_len = self._spec_fn(
                 self.params, self._cache, self._last,
                 self._temps, self._top_ps, active, drafts, rng,
             )
-        toks_host = np.asarray(toks)
-        runs = np.asarray(run_len)
+            toks_host = np.asarray(toks)
+            runs = np.asarray(run_len)
+        # one verify pass advances each slot by >=1 token: charge it as one
+        # decode step (per-token attribution rides the request TPOT histogram)
+        self._m_decode_step_s.observe(time.monotonic() - t_start)
         for slot in range(self.max_slots):
             if self._active[slot]:
                 out = toks_host[slot][: int(runs[slot])].tolist()
@@ -539,6 +628,7 @@ class ContinuousBatchingEngine:
             max_new_tokens=max_new_tokens,
             temperature=temperature,
             top_p=top_p,
+            submitted_at=time.monotonic(),
         )
         self._pending.put(req)
         return req
@@ -573,7 +663,7 @@ class ContinuousBatchingEngine:
         for slot, req in list(self._requests.items()):
             req.error = message
             req.done = True
-            self.requests_failed += 1
+            self._m_failed.inc()
             req.events.put(None)
             self._active[slot] = False
             self._requests.pop(slot, None)
@@ -635,7 +725,7 @@ class ContinuousBatchingEngine:
         for slot, req in list(self._requests.items()):
             if req.cancelled:
                 req.done = True
-                self.requests_cancelled += 1
+                self._m_cancelled.inc()
                 req.events.put(None)
                 self._active[slot] = False
                 self._requests.pop(slot, None)
@@ -725,12 +815,16 @@ class ContinuousBatchingEngine:
         if self._finalize_batch_fn is None:
             self._finalize_batch_fn = self._make_finalize_batch()
         ids = req.prompt_ids
+        t_start = time.monotonic()
+        if req.submitted_at:
+            self._m_queue_wait.observe(t_start - req.submitted_at)
+        req.admitted_at = t_start
         row_cb = row_capacity_for(len(ids), self.prefill_chunk, self.capacity)
         start, row = self._prefix_seed(ids, row_cb)
         plan = chunk_plan(start, len(ids), self.prefill_chunk, row_cb)
         logits = None
         self._rng, rng = jax.random.split(self._rng)
-        with self._mesh_ctx():
+        with TRACER.span("serve.prefill", slot=slot, prompt_len=len(ids)), self._mesh_ctx():
             for off, size in plan:
                 chunk_ids = ids[off : off + size]
                 chunk_ids += [self.pad_id] * (size - len(chunk_ids))
@@ -756,9 +850,11 @@ class ContinuousBatchingEngine:
                 jnp.asarray([req.top_p], dtype=jnp.float32),
                 rng,
             )
-        first = int(firsts[0])
+        first = int(firsts[0])  # host sync: the prefill really finished here
+        self._m_prefill_s.observe(time.monotonic() - t_start)
+        self._m_admit_batch.observe(1)
         self._store_prefix(ids, row)
-        self.requests_admitted += 1
+        self._m_admitted.inc()
         req.slot = slot
         self._active[slot] = True
         self._requests[slot] = req
@@ -792,10 +888,15 @@ class ContinuousBatchingEngine:
         if self._finalize_batch_fn is None:
             self._finalize_batch_fn = self._make_finalize_batch()
         n = len(reqs)
+        t_start = time.monotonic()
+        for req in reqs:
+            if req.submitted_at:
+                self._m_queue_wait.observe(t_start - req.submitted_at)
+            req.admitted_at = t_start
         self._rng, rng = jax.random.split(self._rng)
         row = init_cache(self.config, n, row_cb, dtype=self._dtype, quantized=self.kv_quant)
         logits = None
-        with self._mesh_ctx():
+        with TRACER.span("serve.prefill_batch", batch=n, row_capacity=row_cb), self._mesh_ctx():
             for off, size in plan:
                 chunk_rows = []
                 rels = []
@@ -825,10 +926,12 @@ class ContinuousBatchingEngine:
             lambda x: x[:, :1] if x.ndim >= 2 else x[:1], row
         )
         self._store_prefix(reqs[0].prompt_ids, row0)
-        self.requests_admitted += len(reqs)
+        firsts_host = [int(t) for t in np.asarray(firsts)]  # host sync
+        self._m_prefill_s.observe(time.monotonic() - t_start)
+        self._m_admit_batch.observe(n)
+        self._m_admitted.inc(len(reqs))
         if n > 1:
-            self.batched_waves += 1
-        firsts_host = [int(t) for t in np.asarray(firsts)]
+            self._m_batched_waves.inc()
         for req, slot, first in zip(reqs, slots, firsts_host):
             req.slot = slot
             self._active[slot] = True
@@ -926,7 +1029,7 @@ class ContinuousBatchingEngine:
             return 0, init_cache(
                 self.config, 1, row_cb, dtype=self._dtype, quantized=self.kv_quant
             )
-        self.prefix_hits += 1
+        self._m_prefix_hits.inc()
         self._prefix_cache = [e for e in self._prefix_cache if e[1] is not best] + [
             e for e in self._prefix_cache if e[1] is best
         ]  # LRU touch
@@ -972,12 +1075,14 @@ class ContinuousBatchingEngine:
             self._decode_fn = self._make_decode()
         self._rng, rng = jax.random.split(self._rng)
         active = jnp.asarray(self._active)
-        with self._mesh_ctx():
+        t_start = time.monotonic()
+        with TRACER.span("serve.decode_chunk", steps=self.chunk), self._mesh_ctx():
             self._cache, self._last, toks = self._decode_fn(
                 self.params, self._cache, self._last,
                 self._temps, self._top_ps, active, rng,
             )
-        toks_host = np.asarray(toks)  # (S, T)
+            toks_host = np.asarray(toks)  # (S, T) — host sync inside the span
+        self._m_decode_step_s.observe((time.monotonic() - t_start) / self.chunk)
         for slot in range(self.max_slots):
             if self._active[slot]:
                 self._emit(self._requests[slot], toks_host[slot].tolist())
@@ -996,10 +1101,18 @@ class ContinuousBatchingEngine:
             req.emitted += 1
         if out:
             req.events.put(out)
-            self.tokens_emitted += len(out)
+            self._m_tokens.inc(len(out))
+            if not req.first_token_at:
+                req.first_token_at = time.monotonic()
+                if req.submitted_at:
+                    self._m_ttft.observe(req.first_token_at - req.submitted_at)
         if req.done or req.emitted >= req.max_new_tokens:
             req.done = True
-            self.requests_completed += 1
+            self._m_completed.inc()
+            if req.first_token_at and req.emitted > 1:
+                self._m_tpot.observe(
+                    (time.monotonic() - req.first_token_at) / (req.emitted - 1)
+                )
             if req.slot >= 0:
                 self._active[req.slot] = False
                 self._requests.pop(req.slot, None)
@@ -1008,18 +1121,25 @@ class ContinuousBatchingEngine:
             req.events.put(None)
 
     def stats(self) -> dict:
-        """Host-side observability counters (engine-thread owned; reads from
-        other threads see a near-consistent snapshot, fine for metrics)."""
+        """Legacy JSON counters for the server's /metrics route — same keys
+        and shape as the pre-registry bare ints. All counter fields come from
+        ONE locked registry read, so a single response is mutually consistent
+        across counters; active_slots/queue_depth are point-in-time gauges
+        refreshed here (so a Prometheus scrape through the same registry sees
+        them fresh too)."""
+        self._m_active_slots.set(int(self._active.sum()))
+        self._m_queue_depth.set(self._pending.qsize())
+        values = self.registry.values()
         return {
-            "requests_admitted": self.requests_admitted,
-            "requests_completed": self.requests_completed,
-            "requests_cancelled": self.requests_cancelled,
-            "requests_failed": self.requests_failed,
-            "tokens_emitted": self.tokens_emitted,
-            "prefix_hits": self.prefix_hits,
-            "batched_admission_waves": self.batched_waves,
-            "active_slots": int(self._active.sum()),
-            "queue_depth": self._pending.qsize(),
+            "requests_admitted": int(values["serve_requests_admitted_total"]),
+            "requests_completed": int(values["serve_requests_completed_total"]),
+            "requests_cancelled": int(values["serve_requests_cancelled_total"]),
+            "requests_failed": int(values["serve_requests_failed_total"]),
+            "tokens_emitted": int(values["serve_tokens_emitted_total"]),
+            "prefix_hits": int(values["serve_prefix_hits_total"]),
+            "batched_admission_waves": int(values["serve_batched_admission_waves_total"]),
+            "active_slots": int(values["serve_active_slots"]),
+            "queue_depth": int(values["serve_queue_depth"]),
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
 
@@ -1039,6 +1159,12 @@ class EngineBackend:
     def stats(self) -> dict:
         """Forward the engine's observability counters (server /metrics)."""
         return self.engine.stats()
+
+    @property
+    def registry(self):
+        """The engine's metrics Registry — InferenceServer renders it into
+        the Prometheus exposition at /metrics?format=prometheus."""
+        return self.engine.registry
 
     def submit_text(
         self,

@@ -11,6 +11,12 @@ step-callback decode loop).
 
 Chat prompts use a minimal role-tagged template; pass a HF tokenizer with a
 chat template upstream for model-faithful formatting.
+
+Observability (docs/architecture.md "Observability"): ``GET /metrics``
+returns the legacy JSON counters; ``GET /metrics?format=prometheus`` renders
+the server's HTTP metrics plus the backing engine's registry (queue-wait,
+TTFT, prefill/decode histograms) in Prometheus text format; ``GET /healthz``
+is the liveness probe. `prime serve metrics` renders either from the CLI.
 """
 
 from __future__ import annotations
@@ -23,6 +29,10 @@ import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
+from urllib.parse import parse_qs, urlsplit
+
+from prime_tpu.obs.metrics import Registry
+from prime_tpu.obs.trace import TRACER
 
 CHAT_TEMPLATE = "{role}: {content}\n"
 
@@ -51,6 +61,21 @@ class _LiveStream:
             self.request.cancel()
 
 
+def _route_label(path: str) -> str:
+    """Collapse a request path to a bounded route label (metric cardinality
+    must not scale with whatever paths clients probe)."""
+    p = urlsplit(path).path.rstrip("/") or "/"
+    if p.endswith("/chat/completions"):
+        return "/v1/chat/completions"
+    if p.endswith("/models") or "/models/" in p:
+        return "/v1/models"
+    if p.endswith("/metrics"):
+        return "/metrics"
+    if p == "/healthz":
+        return "/healthz"
+    return "other"
+
+
 def render_chat_prompt(messages: list[dict[str, str]]) -> str:
     parts = [
         CHAT_TEMPLATE.format(role=m.get("role", "user"), content=m.get("content", ""))
@@ -71,6 +96,18 @@ class InferenceServer:
         self.model_id = model_id
         self.generator = generator
         self._lock = threading.Lock()  # one generation on the chip at a time
+        # server-side HTTP metrics live in the server's own registry; the
+        # backing engine's registry (generator.registry, when present) is
+        # rendered alongside it by the Prometheus exposition
+        self.registry = Registry()
+        self._m_http_requests = self.registry.counter(
+            "http_requests_total", "HTTP requests served",
+            labelnames=("route", "method", "status"),
+        )
+        self._m_http_latency = self.registry.histogram(
+            "http_request_seconds", "HTTP request wall time", labelnames=("route",)
+        )
+        self._t0 = time.monotonic()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -78,6 +115,7 @@ class InferenceServer:
                 pass
 
             def _json(self, status: int, payload: dict) -> None:
+                self._status_sent = status
                 body = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
@@ -85,20 +123,65 @@ class InferenceServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _text(self, status: int, body: str, content_type: str) -> None:
+                self._status_sent = status
+                raw = body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def _observe(self, t0: float) -> None:
+                route = _route_label(self.path)
+                status = getattr(self, "_status_sent", 0)
+                outer._m_http_requests.inc(
+                    route=route, method=self.command, status=str(status)
+                )
+                outer._m_http_latency.observe(time.monotonic() - t0, route=route)
+
             def do_GET(self) -> None:
-                if self.path in ("/v1/models", "/api/v1/models"):
+                t0 = time.monotonic()
+                try:
+                    self._get()
+                finally:
+                    self._observe(t0)
+
+            def _get(self) -> None:
+                parts = urlsplit(self.path)
+                path = parts.path
+                if path in ("/v1/models", "/api/v1/models"):
                     self._json(
                         200,
                         {"object": "list", "data": [{"id": outer.model_id, "object": "model"}]},
                     )
-                elif self.path in ("/metrics", "/v1/metrics"):
-                    self._json(200, outer.metrics())
-                elif self.path.rstrip("/").endswith(f"/models/{outer.model_id}"):
+                elif path in ("/metrics", "/v1/metrics"):
+                    fmt = parse_qs(parts.query).get("format", [""])[0]
+                    if fmt == "prometheus":
+                        self._text(
+                            200,
+                            outer.metrics_prometheus(),
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                    elif fmt == "registry":
+                        self._json(200, outer.metrics_registry())
+                    else:
+                        self._json(200, outer.metrics())
+                elif path == "/healthz":
+                    self._json(200, outer.healthz())
+                elif path.rstrip("/").endswith(f"/models/{outer.model_id}"):
                     self._json(200, {"id": outer.model_id, "object": "model"})
                 else:
                     self._json(404, {"error": {"message": f"no route {self.path}"}})
 
             def do_POST(self) -> None:
+                t0 = time.monotonic()
+                try:
+                    self._post()
+                finally:
+                    self._observe(t0)
+
+            def _post(self) -> None:
                 if self.path not in ("/v1/chat/completions", "/api/v1/chat/completions"):
                     self._json(404, {"error": {"message": f"no route {self.path}"}})
                     return
@@ -128,6 +211,7 @@ class InferenceServer:
                     self._json(200, response)
 
             def _sse_headers(self) -> None:
+                self._status_sent = 200
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.end_headers()
@@ -204,6 +288,52 @@ class InferenceServer:
                 payload["engine_error"] = str(e)[:200]
         return payload
 
+    def metrics_prometheus(self) -> str:
+        """GET /metrics?format=prometheus: text exposition of the server's
+        HTTP metrics plus the backing engine's registry (queue-wait, TTFT,
+        prefill/decode histograms — see docs/architecture.md
+        "Observability"). Calling the generator's stats() first refreshes
+        its point-in-time gauges so a scrape never reports stale slot/queue
+        depths."""
+        stats_fn = getattr(self.generator, "stats", None)
+        if callable(stats_fn):
+            try:
+                stats_fn()
+            except Exception:  # noqa: BLE001 — metrics must never 500
+                pass
+        text = self.registry.render_prometheus()
+        engine_registry = getattr(self.generator, "registry", None)
+        if isinstance(engine_registry, Registry) and engine_registry is not self.registry:
+            text += engine_registry.render_prometheus()
+        return text
+
+    def metrics_registry(self) -> dict:
+        """GET /metrics?format=registry: full JSON snapshots (histogram
+        bucket data included) of the server and engine registries — the
+        machine-readable twin of the Prometheus exposition, consumed by
+        `prime serve metrics`."""
+        stats_fn = getattr(self.generator, "stats", None)
+        if callable(stats_fn):
+            try:
+                stats_fn()  # refresh point-in-time gauges
+            except Exception:  # noqa: BLE001 — metrics must never 500
+                pass
+        payload = {"server": self.registry.snapshot()}
+        engine_registry = getattr(self.generator, "registry", None)
+        if isinstance(engine_registry, Registry) and engine_registry is not self.registry:
+            payload["engine"] = engine_registry.snapshot()
+        return payload
+
+    def healthz(self) -> dict:
+        """GET /healthz: liveness for load balancers / scrapers. Always 200
+        while the listener is up; ``loaded`` distinguishes the still-loading
+        window (serve_model binds the socket before the checkpoint loads)."""
+        return {
+            "status": "ok",
+            "loaded": self.generator is not None,
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+        }
+
     # -- request handling -----------------------------------------------------
 
     def _chat(self, request: dict, stream: bool = False):
@@ -260,15 +390,16 @@ class InferenceServer:
                 return 500, {"error": {"message": f"generation failed: {e}"}}
             return _LiveStream(self.generator.stream_text(req), request=req)
         try:
-            if getattr(self.generator, "concurrent", False):
-                completion = self.generator.generate(
-                    [prompt], max_new_tokens=max_tokens, temperature=temperature, **kwargs
-                )[0]
-            else:
-                with self._lock:
+            with TRACER.span("serve.chat", model=self.model_id, max_tokens=max_tokens):
+                if getattr(self.generator, "concurrent", False):
                     completion = self.generator.generate(
                         [prompt], max_new_tokens=max_tokens, temperature=temperature, **kwargs
                     )[0]
+                else:
+                    with self._lock:
+                        completion = self.generator.generate(
+                            [prompt], max_new_tokens=max_tokens, temperature=temperature, **kwargs
+                        )[0]
         except Exception as e:  # noqa: BLE001 — surface as an API error, keep serving
             return 500, {"error": {"message": f"generation failed: {e}"}}
         return {

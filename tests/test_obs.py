@@ -1,0 +1,476 @@
+"""Observability layer: registry semantics, Prometheus exposition, span
+tracing, and the serve wiring (JSON /metrics backward compatibility +
+histograms populating through a real streamed completion).
+
+The fast server-scrape tests double as the tier-1 smoke for exposition
+regressions: they import prime_tpu.obs, stand up a live in-process
+InferenceServer, and parse the actual Prometheus text a scraper would see.
+"""
+
+import json
+import math
+
+import httpx
+import pytest
+
+from prime_tpu.obs import (
+    Registry,
+    Tracer,
+    quantile_from_snapshot,
+)
+
+# ---- histogram semantics ----------------------------------------------------
+
+
+def test_histogram_bucket_boundaries():
+    """``le`` semantics: a value ON a bound lands in that bucket; past the
+    last bound only +Inf counts it."""
+    r = Registry()
+    h = r.histogram("h_seconds", "x", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.100001, 1.0, 9.9, 10.0, 11.0):
+        h.observe(v)
+    snap = h.series_snapshot()
+    assert snap["counts"] == [2, 2, 2, 1]  # per-bucket (non-cumulative) + Inf
+    assert snap["count"] == 7
+    assert snap["sum"] == pytest.approx(sum((0.05, 0.1, 0.100001, 1.0, 9.9, 10.0, 11.0)))
+
+
+def test_histogram_quantiles():
+    r = Registry()
+    h = r.histogram("h", "x", buckets=(1.0, 2.0, 4.0))
+    for _ in range(50):
+        h.observe(0.5)
+    for _ in range(50):
+        h.observe(3.0)
+    # 50 obs in (0,1], 50 in (2,4]: the median sits exactly at bucket 1's
+    # upper bound, p99 interpolates inside the (2,4] bucket
+    assert h.quantile(0.5) == pytest.approx(1.0)
+    assert 2.0 <= h.quantile(0.99) <= 4.0
+    assert math.isnan(r.histogram("empty", "x", buckets=(1.0,)).quantile(0.5))
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+    # the snapshot-based estimator is the same math
+    snap = h.series_snapshot()
+    assert quantile_from_snapshot(snap["buckets"], snap["counts"], 0.5) == pytest.approx(
+        h.quantile(0.5)
+    )
+
+
+def test_histogram_bucket_validation():
+    r = Registry()
+    with pytest.raises(ValueError):
+        r.histogram("bad", "x", buckets=())
+    with pytest.raises(ValueError):
+        r.histogram("bad2", "x", buckets=(2.0, 1.0))
+
+
+# ---- registry semantics -----------------------------------------------------
+
+
+def test_counter_and_gauge():
+    r = Registry()
+    c = r.counter("c_total", "x")
+    c.inc()
+    c.inc(2)
+    assert c.value() == 3
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = r.gauge("g", "x")
+    g.set(5)
+    g.dec(2)
+    assert g.value() == 3
+
+
+def test_registry_get_or_create_and_conflicts():
+    r = Registry()
+    assert r.counter("c_total") is r.counter("c_total")
+    with pytest.raises(ValueError):
+        r.gauge("c_total")  # same name, different kind
+    with pytest.raises(ValueError):
+        r.counter("c_total", labelnames=("x",))  # same kind, different labels
+    with pytest.raises(ValueError):
+        r.counter("bad name")
+    with pytest.raises(ValueError):
+        r.counter("ok_total", labelnames=("bad-label",))
+
+
+def test_labeled_series_and_values():
+    r = Registry()
+    c = r.counter("req_total", "x", labelnames=("method",))
+    c.inc(method="GET")
+    c.inc(3, method="POST")
+    assert c.value(method="POST") == 3
+    assert c.value(method="DELETE") == 0  # never observed
+    with pytest.raises(ValueError):
+        c.inc(verb="GET")  # wrong label name
+    # values() is the unlabeled-only consistent read (engine stats source)
+    plain = r.counter("plain_total")
+    plain.inc(7)
+    assert r.values() == {"plain_total": 7.0}
+
+
+# ---- Prometheus exposition --------------------------------------------------
+
+
+def test_prometheus_rendering_and_escaping():
+    r = Registry()
+    c = r.counter("reqs_total", 'help with \\ and\nnewline', labelnames=("path",))
+    c.inc(2, path='a"b\\c\nd')
+    text = r.render_prometheus()
+    assert '# HELP reqs_total help with \\\\ and\\nnewline' in text
+    assert "# TYPE reqs_total counter" in text
+    assert 'reqs_total{path="a\\"b\\\\c\\nd"} 2' in text
+
+
+def test_prometheus_histogram_rendering():
+    r = Registry()
+    h = r.histogram("lat_seconds", "latency", buckets=(0.5, 1.0))
+    h.observe(0.2)
+    h.observe(0.7)
+    h.observe(5.0)
+    lines = r.render_prometheus().splitlines()
+    assert 'lat_seconds_bucket{le="0.5"} 1' in lines
+    assert 'lat_seconds_bucket{le="1"} 2' in lines  # cumulative
+    assert 'lat_seconds_bucket{le="+Inf"} 3' in lines
+    assert "lat_seconds_sum 5.9" in lines
+    assert "lat_seconds_count 3" in lines
+
+
+def test_snapshot_roundtrips_through_json():
+    r = Registry()
+    r.counter("c_total").inc()
+    r.histogram("h_seconds", buckets=(1.0,)).observe(2.0)
+    snap = json.loads(json.dumps(r.snapshot()))
+    assert snap["c_total"]["type"] == "counter"
+    assert snap["h_seconds"]["series"][0]["counts"] == [0, 1]
+
+
+# ---- tracing ----------------------------------------------------------------
+
+
+def test_span_nesting_and_jsonl_roundtrip(tmp_path):
+    tracer = Tracer()
+    with tracer.span("outer", kind="request") as outer:
+        with tracer.span("inner") as inner:
+            inner.set_attr("tokens", 3)
+        assert inner.parent_id == outer.span_id
+        assert inner.trace_id == outer.trace_id
+    path = tmp_path / "trace.jsonl"
+    assert tracer.export_jsonl(path) == 2
+    rows = [json.loads(line) for line in path.read_text().splitlines()]
+    by_name = {row["name"]: row for row in rows}
+    assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+    assert by_name["outer"]["parent_id"] is None
+    assert by_name["inner"]["attrs"] == {"tokens": 3}
+    assert all(row["duration_s"] >= 0 for row in rows)
+    # inner is fully contained in outer on the monotonic clock
+    assert by_name["inner"]["start_s"] >= by_name["outer"]["start_s"]
+    assert tracer.drain() == []  # export drained the buffer
+
+
+def test_span_records_exceptions_and_sink(tmp_path):
+    sink = tmp_path / "sink.jsonl"
+    tracer = Tracer(sink_path=sink)
+    with pytest.raises(RuntimeError):
+        with tracer.span("boom"):
+            raise RuntimeError("kaput")
+    row = json.loads(sink.read_text().splitlines()[0])
+    assert "kaput" in row["attrs"]["error"]
+    tracer.close()
+
+
+def test_disabled_tracer_is_noop():
+    tracer = Tracer(enabled=False)
+    with tracer.span("x", a=1) as s:
+        s.set_attr("b", 2)  # must not raise
+    assert tracer.drain() == []
+
+
+# ---- serve wiring -----------------------------------------------------------
+
+
+class EchoGenerator:
+    def generate(self, prompts, max_new_tokens, temperature, top_p=1.0):
+        return [p.splitlines()[-2].split(":", 1)[1].strip().upper() for p in prompts]
+
+
+@pytest.fixture
+def server():
+    from prime_tpu.serve import InferenceServer
+
+    with InferenceServer("tiny-test", EchoGenerator(), port=0) as srv:
+        yield srv
+
+
+def test_metrics_json_shape_unchanged(server):
+    """The default JSON /metrics response keeps the pre-obs shape for
+    existing keys (wire compatibility for whatever already scrapes it)."""
+    data = httpx.get(f"{server.url}/metrics").json()
+    assert data["model"] == "tiny-test"
+    assert data["loaded"] is True
+    assert "engine" not in data  # EchoGenerator has no stats()
+
+
+def test_healthz(server):
+    data = httpx.get(f"{server.url}/healthz").json()
+    assert data["status"] == "ok"
+    assert data["loaded"] is True
+    assert data["uptime_s"] >= 0
+
+    from prime_tpu.serve import InferenceServer
+
+    with InferenceServer("tiny-test", port=0) as unloaded:
+        data = httpx.get(f"{unloaded.url}/healthz").json()
+        assert data["status"] == "ok" and data["loaded"] is False
+
+
+def test_prometheus_scrape_live_server(server):
+    """Fast exposition smoke: a live in-process server must serve parseable
+    Prometheus text with the http metrics populated."""
+    httpx.post(
+        f"{server.url}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": "hi"}]},
+        timeout=30,
+    )
+    response = httpx.get(f"{server.url}/metrics", params={"format": "prometheus"})
+    assert response.status_code == 200
+    assert response.headers["content-type"].startswith("text/plain")
+    text = response.text
+    assert "# TYPE http_requests_total counter" in text
+    assert 'http_requests_total{route="/v1/chat/completions",method="POST",status="200"} 1' in text
+    assert "# TYPE http_request_seconds histogram" in text
+    # every non-comment line is `name{labels} value`
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        name_part, _, value = line.rpartition(" ")
+        assert name_part and float(value) >= 0
+
+
+def test_registry_json_view(server):
+    payload = httpx.get(f"{server.url}/metrics", params={"format": "registry"}).json()
+    assert "server" in payload
+    assert payload["server"]["http_requests_total"]["type"] == "counter"
+
+
+def test_engine_histograms_populate_through_streamed_completion():
+    """Acceptance: one streamed chat completion through InferenceServer over
+    the continuous-batching engine leaves serve_ttft_seconds and
+    serve_queue_wait_seconds with non-zero counts in the Prometheus text,
+    while the JSON /metrics engine keys stay the legacy shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from prime_tpu.evals.tokenizer import ByteTokenizer
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.serve import InferenceServer
+    from prime_tpu.serve.engine import ContinuousBatchingEngine, EngineBackend
+
+    config = get_config("tiny-test")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    engine = ContinuousBatchingEngine(
+        params, config, max_slots=2, capacity=128, chunk=4, prefix_cache_size=0
+    )
+    with engine:
+        backend = EngineBackend(engine, ByteTokenizer())
+        with InferenceServer("tiny-test", backend, port=0) as srv:
+            with httpx.stream(
+                "POST",
+                f"{srv.url}/v1/chat/completions",
+                json={
+                    "messages": [{"role": "user", "content": "ab"}],
+                    "max_tokens": 6,
+                    "stream": True,
+                },
+                timeout=120,
+            ) as response:
+                assert response.status_code == 200
+                body = "".join(response.iter_lines())
+                assert "[DONE]" in body
+
+            # legacy JSON: same keys as the pre-registry counters
+            engine_stats = httpx.get(f"{srv.url}/metrics").json()["engine"]
+            assert set(engine_stats) == {
+                "requests_admitted", "requests_completed", "requests_cancelled",
+                "requests_failed", "tokens_emitted", "prefix_hits",
+                "batched_admission_waves", "active_slots", "queue_depth",
+                "uptime_s",
+            }
+            assert engine_stats["requests_admitted"] == 1
+            assert engine_stats["requests_completed"] == 1
+
+            text = httpx.get(
+                f"{srv.url}/metrics", params={"format": "prometheus"}
+            ).text
+    assert "serve_ttft_seconds_count 1" in text
+    assert "serve_queue_wait_seconds_count 1" in text
+    assert "serve_prefill_seconds_count 1" in text
+    assert "serve_tokens_emitted_total 6" in text
+    # decode ran at least one chunk past the prefill's first token
+    assert "serve_decode_step_seconds_count 0" not in text
+    # TTFT must be a real measurement, not a zero-fill
+    for line in text.splitlines():
+        if line.startswith("serve_ttft_seconds_sum"):
+            assert float(line.split()[-1]) > 0
+
+
+def test_engine_tpot_and_batch_size_histograms():
+    """Direct engine drive: TPOT records per completed multi-token request,
+    admission batch size records the wave width."""
+    import jax
+    import jax.numpy as jnp
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.serve.engine import ContinuousBatchingEngine
+
+    config = get_config("tiny-test")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    engine = ContinuousBatchingEngine(
+        params, config, max_slots=4, capacity=128, chunk=4, prefix_cache_size=0
+    )
+    reqs = [engine.submit([3, 1, 4, 1], max_new_tokens=5) for _ in range(2)]
+    for _ in range(50):
+        engine.tick()
+        if all(r.done for r in reqs):
+            break
+    assert all(r.done for r in reqs)
+    tpot = engine.registry.get("serve_tpot_seconds").series_snapshot()
+    assert tpot["count"] == 2
+    batch = engine.registry.get("serve_admission_batch_size").series_snapshot()
+    assert batch["count"] >= 1 and batch["sum"] == 2  # one 2-wide wave
+
+
+def test_client_http_metrics():
+    """Every APIClient request records latency/status/retries into the
+    process-wide registry, sync and async alike."""
+    from prime_tpu.core.client import (
+        _HTTP_LATENCY,
+        _HTTP_REQUESTS,
+        _HTTP_RETRIES,
+        APIClient,
+    )
+    from prime_tpu.core.config import Config
+
+    before_ok = _HTTP_REQUESTS.value(method="GET", status="200")
+    before_404 = _HTTP_REQUESTS.value(method="GET", status="404")
+    before_retry = _HTTP_RETRIES.value(method="GET")
+    lat_before = _HTTP_LATENCY.series_snapshot(method="GET")
+    lat_before_count = lat_before["count"] if lat_before else 0
+
+    calls = {"n": 0}
+
+    def handler(request):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return httpx.Response(503, json={})  # retried (idempotent GET)
+        if calls["n"] == 2:
+            return httpx.Response(200, json={"ok": True})
+        return httpx.Response(404, json={"detail": "nope"})
+
+    cfg = Config()
+    cfg.api_key = "k"
+    client = APIClient(
+        config=cfg, base_url="https://api.test",
+        transport=httpx.MockTransport(handler),
+    )
+    import prime_tpu.core.client as client_mod
+
+    # no real sleeps in tests: the 503→200 retry backoff would add seconds
+    orig = client_mod._backoff
+    client_mod._backoff = lambda attempt: 0.0
+    try:
+        assert client.get("/thing") == {"ok": True}
+        with pytest.raises(Exception):
+            client.get("/missing")
+    finally:
+        client_mod._backoff = orig
+    assert _HTTP_REQUESTS.value(method="GET", status="200") == before_ok + 1
+    assert _HTTP_REQUESTS.value(method="GET", status="404") == before_404 + 1
+    assert _HTTP_RETRIES.value(method="GET") == before_retry + 1  # the 503 retry
+    assert _HTTP_LATENCY.series_snapshot(method="GET")["count"] == lat_before_count + 2
+
+
+def test_eval_runner_latency_metrics(tmp_path):
+    from prime_tpu.evals.runner import EvalRunSpec, run_eval
+
+    class Oracle:
+        def generate(self, prompts, max_new_tokens, temperature, top_p=1.0):
+            return ["42"] * len(prompts)
+
+    spec = EvalRunSpec(limit=6, batch_size=2, output_dir=str(tmp_path))
+    result = run_eval(spec, generator=Oracle())
+    for key in (
+        "sample_latency_mean_s", "sample_latency_p50_s",
+        "sample_latency_p95_s", "sample_latency_max_s",
+    ):
+        assert key in result.metrics
+        assert result.metrics[key] >= 0
+    meta = json.loads((result.run_dir / "metadata.json").read_text())
+    obs = meta["obs"]
+    assert obs["eval_samples_total"]["series"][0]["value"] == 6
+    assert obs["eval_batch_seconds"]["series"][0]["count"] == 3
+
+
+def test_serve_metrics_cli(server):
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.serve import serve_cmd
+
+    httpx.get(f"{server.url}/v1/models")  # populate an http counter
+    runner = CliRunner()
+    out = runner.invoke(
+        serve_cmd, ["metrics", "--url", server.url, "--plain"]
+    )
+    assert out.exit_code == 0, out.output
+    assert "http_requests_total" in out.output
+    as_json = runner.invoke(
+        serve_cmd, ["metrics", "--url", server.url, "--output", "json"]
+    )
+    assert as_json.exit_code == 0
+    assert json.loads(as_json.output)["server"]["http_requests_total"]["type"] == "counter"
+    prom = runner.invoke(serve_cmd, ["metrics", "--url", server.url, "--prometheus"])
+    assert prom.exit_code == 0
+    assert "# TYPE http_requests_total counter" in prom.output
+    dead = runner.invoke(serve_cmd, ["metrics", "--url", "http://127.0.0.1:9"])
+    assert dead.exit_code != 0
+    assert "could not scrape" in dead.output
+
+
+def test_serve_cli_still_requires_model():
+    """The group conversion must not silently accept a bare `prime serve`."""
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.serve import serve_cmd
+
+    result = CliRunner().invoke(serve_cmd, [])
+    assert result.exit_code != 0
+    assert "--model" in result.output
+
+
+def test_int4_pallas_gate_under_mesh():
+    """ADVICE r5: the fused int4 kernel must be ineligible under a
+    multi-device mesh context, and the XLA fallback must match the
+    ungated reference numerics."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from prime_tpu.models import quantize as qz
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (256, 128), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 256), jnp.float32)
+    qw = qz.quantize_weight_int4(w)
+    assert qw[0].ndim == 2 and qw[0].dtype == jnp.uint8
+    # outside any mesh: interpret mode keeps the kernel eligible (CPU tests)
+    assert qz._int4_pallas_eligible(x, qw[0], True)
+    ref = qz.matmul(x, qw)
+
+    mesh = jax.make_mesh((2,), ("tp",), devices=jax.devices()[:2])
+    with mesh:
+        assert qz._mesh_context_active()
+        assert not qz._int4_pallas_eligible(x, qw[0], True)
+        out = qz.matmul(x, qw)
+    assert not qz._mesh_context_active()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
