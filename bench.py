@@ -568,13 +568,18 @@ def main() -> None:
                     engine.tick()
                 size //= 2
                 lead += 1
+            # drain warmup's lookahead chunk: its retirement waste and its
+            # warmup-boundary window must not leak into the measured deltas
+            engine.tick()
             waves_before = engine.batched_waves
             hits_before = engine.prefix_hits
+            stats_before = engine.stats()
             t0 = time.perf_counter()
             reqs = [engine.submit(ids, max_new_tokens=req_new) for ids in prompts]
             while not all(r.done for r in reqs):
                 engine.tick()
             elapsed = time.perf_counter() - t0
+            engine.tick()  # drain the lookahead chunk so waste/inflight settle
             total = sum(len(r.all_tokens(timeout=1)) for r in reqs)
             if record_counters:
                 # evidence the batched-admission path carried the MEASURED
@@ -584,6 +589,23 @@ def main() -> None:
                 # by a later variant's counters
                 record["serve_batched_waves"] = engine.batched_waves - waves_before
                 record["serve_prefix_hits"] = engine.prefix_hits - hits_before
+                # pipeline evidence from the same run: how much of the decode
+                # window the host overlapped, what it blocked for, and the
+                # decode the one-chunk retirement lag threw away — deltas
+                # over the measured window, like the wave/hit counters above
+                # (warmup's retirement waste and cold-compile windows must
+                # not pollute the measured numbers)
+                stats = engine.stats()
+                stall = stats["host_stall_s"] - stats_before["host_stall_s"]
+                window = stats["chunk_window_s"] - stats_before["chunk_window_s"]
+                record["serve_overlap"] = stats["overlap"]
+                record["serve_overlap_ratio"] = (
+                    round(max(0.0, min(1.0, 1.0 - stall / window)), 4) if window > 0 else 0.0
+                )
+                record["serve_host_stall_s"] = round(stall, 6)
+                record["serve_wasted_decode_tokens"] = (
+                    stats["wasted_decode_tokens"] - stats_before["wasted_decode_tokens"]
+                )
             if obs_key:
                 # full metrics-registry snapshot (TTFT / queue-wait /
                 # prefill / decode-step histograms over the warmup+measured

@@ -56,6 +56,18 @@ from prime_tpu.utils.render import Renderer, output_options
 )
 @click.option("--draft-len", type=click.IntRange(min=1), default=4,
               help="Speculative draft tokens per step.")
+@click.option(
+    "--overlap/--no-overlap", "overlap", default=None,
+    help="Overlapped decode pipeline (--continuous): dispatch chunk N+1 "
+         "before syncing chunk N so host bookkeeping hides inside device "
+         "compute. Default: on (PRIME_SERVE_OVERLAP).",
+)
+@click.option(
+    "--warmup/--no-warmup", "warmup", default=None,
+    help="Compile the engine's full program set at startup so no cold XLA "
+         "compile lands mid-request (--continuous). Default: off "
+         "(PRIME_SERVE_WARMUP).",
+)
 @click.pass_context
 def serve_cmd(
     ctx: click.Context,
@@ -77,6 +89,8 @@ def serve_cmd(
     chunk: int,
     speculative: bool,
     draft_len: int,
+    overlap: bool | None,
+    warmup: bool | None,
 ) -> None:
     """Serve MODEL over an OpenAI-compatible HTTP API (blocks until Ctrl-C)."""
     if ctx.invoked_subcommand is not None:
@@ -109,6 +123,8 @@ def serve_cmd(
             chunk=chunk,
             speculative=speculative,
             draft_len=draft_len,
+            overlap=overlap,
+            warmup=warmup,
         )
     except (ValueError, OSError) as e:
         raise click.ClickException(str(e)) from None

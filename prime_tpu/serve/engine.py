@@ -20,6 +20,18 @@ compilation model:
   program — a per-request recompile would defeat continuous batching. The
   nucleus (top-p) sort only runs when some active request asked for it
   (``lax.cond`` on the traced predicate).
+- **One-chunk-deep decode pipeline.** JAX dispatch is asynchronous: a decode
+  chunk's tokens stay on the device until the host asks for them. ``tick()``
+  exploits that by dispatching chunk N+1 (using the last-known active mask)
+  *before* fetching chunk N's tokens, so emit, EOS/budget retirement,
+  cancellation sweeps, prefix indexing, and admission planning all execute
+  inside the device-compute window instead of serializing with it.
+  Retirement takes effect at the next chunk boundary — a slot that finished
+  in chunk N still decodes through chunk N+1 (bounded waste, counted by
+  ``serve_wasted_decode_tokens_total``). ``PRIME_SERVE_OVERLAP=0`` restores
+  the strictly synchronous loop; speculative mode always runs synchronously
+  (drafts for chunk N+1 need chunk N's tokens on the host). See
+  docs/architecture.md "Engine pipeline".
 
 Single-chip by default; pass ``mesh`` + ``cache_spec`` (from
 parallel.sharding) to run the same engine over a TPU slice — decode then
@@ -34,9 +46,12 @@ docs/architecture.md "Observability".
 from __future__ import annotations
 
 import itertools
+import os
 import queue
+import sys
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -132,6 +147,32 @@ def _common_prefix_len(a: list[int], b: list[int]) -> int:
     return n
 
 
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("", "0", "false", "off", "no")
+
+
+@dataclass
+class _InflightChunk:
+    """A dispatched-but-unfetched decode chunk. ``mask`` and ``requests``
+    are snapshots from dispatch time: between dispatch and sync, slots may
+    retire and even be re-admitted to NEW requests, and chunk tokens must
+    only ever reach the request that was decoding when the chunk launched."""
+
+    seq: int
+    toks: Any  # (S, T) device array — a future until synced
+    mask: np.ndarray
+    requests: dict[int, EngineRequest]
+    dispatched_at: float
+    # False once an admission prefill ran inside this chunk's window: its
+    # dispatch-to-sync wall time then includes host prefill blocking and must
+    # not feed the per-step decode histogram (it still counts toward the
+    # window/stall overlap counters, which measure the loop, not the device)
+    clean: bool = True
+
+
 @dataclass
 class EngineRequest:
     """One in-flight generation. ``events`` receives lists of token ids as
@@ -214,6 +255,8 @@ class ContinuousBatchingEngine:
         kv_quant: bool = False,
         speculative: bool = False,
         draft_len: int = 4,
+        overlap: bool | None = None,
+        warmup: bool | None = None,
         registry: Registry | None = None,
     ) -> None:
         import jax
@@ -245,6 +288,24 @@ class ContinuousBatchingEngine:
         # (B, D+1) verify forward replaces draft_len+1 single-token steps
         self.speculative = speculative
         self.draft_len = draft_len
+        # overlapped decode pipeline (module docstring): on by default,
+        # PRIME_SERVE_OVERLAP=0 restores the synchronous loop. Speculative
+        # mode is ALWAYS synchronous — proposing chunk N+1's n-gram drafts
+        # needs chunk N's accepted tokens on the host, a data dependency the
+        # pipeline cannot hide (pinned by test_spec_chunk_runs_synchronously).
+        if overlap is None:
+            overlap = _env_flag("PRIME_SERVE_OVERLAP", True)
+        self.overlap = bool(overlap) and not speculative
+        # AOT-style warmup (see warmup()): opt-in via PRIME_SERVE_WARMUP
+        # because compiling the full program set up front trades startup
+        # seconds for the guarantee that no cold compile lands mid-pipeline
+        if warmup is None:
+            warmup = _env_flag("PRIME_SERVE_WARMUP", False)
+        self.warmup_enabled = bool(warmup)
+        # dispatched-but-unfetched decode chunks, oldest first (depth <= 1
+        # outside tick(); owned by the engine thread)
+        self._inflight: list[_InflightChunk] = []
+        self._chunk_seq = itertools.count()
         self._histories: dict[int, list[int]] = {}  # slot -> prompt + decoded
         # slot -> {(t0, t1) -> latest position p with history[p:p+2] == (t0,
         # t1) and p <= len-3}: the prompt-lookup index, built once at admit
@@ -260,6 +321,9 @@ class ContinuousBatchingEngine:
         self._rng = jax.random.PRNGKey(0)
         self._init_device_state()
         self._pending: queue.Queue[EngineRequest | None] = queue.Queue()
+        # requests the idle loop popped and handed back for batched
+        # admission: consumed by _admit before _pending (engine thread only)
+        self._requeued: deque[EngineRequest] = deque()
         self._ids = itertools.count()
         self._thread: threading.Thread | None = None
         self._running = False
@@ -320,11 +384,42 @@ class ContinuousBatchingEngine:
             "serve_prefill_seconds", "Prefill wall time per admission dispatch"
         )
         self._m_decode_step_s = r.histogram(
-            "serve_decode_step_seconds", "Decode wall time per generated step"
+            "serve_decode_step_seconds",
+            "Decode wall time per generated step (overlap mode: the full "
+            "dispatch-to-sync loop window of admission-free chunks, an upper "
+            "bound on device step time; sync mode: the blocking decode call)",
         )
         self._m_admit_batch = r.histogram(
             "serve_admission_batch_size", "Requests admitted per prefill wave",
             buckets=DEFAULT_SIZE_BUCKETS,
+        )
+        # pipeline instrumentation (overlap mode): how long the host actually
+        # blocked waiting for a chunk vs the chunk's dispatch-to-sync window,
+        # and the decode the one-chunk retirement lag threw away
+        self._m_host_stall_s = r.counter(
+            "serve_host_stall_seconds_total",
+            "Seconds the host blocked waiting for a dispatched decode chunk",
+        )
+        self._m_chunk_window_s = r.counter(
+            "serve_chunk_window_seconds_total",
+            "Seconds between decode-chunk dispatch and its host sync",
+        )
+        self._m_wasted_tokens = r.counter(
+            "serve_wasted_decode_tokens_total",
+            "Tokens decoded for slots already retired at dispatch (one-chunk lag)",
+        )
+        self._m_inflight_depth = r.gauge(
+            "serve_inflight_depth", "Dispatched-but-unfetched decode chunks"
+        )
+        self._m_overlap_ratio = r.gauge(
+            "serve_overlap_ratio",
+            "1 - host-stall/chunk-window: fraction of the decode window the host overlapped",
+        )
+        self._m_warmup_programs = r.gauge(
+            "serve_warmup_programs", "Programs executed by the AOT warmup pass"
+        )
+        self._m_warmup_s = r.gauge(
+            "serve_warmup_seconds", "Wall seconds the AOT warmup pass took"
         )
         self._t0 = time.monotonic()
 
@@ -600,6 +695,147 @@ class ContinuousBatchingEngine:
                 self._index_bigrams(slot, old_len)
                 self._emit(self._requests[slot], out)
 
+    # ---- AOT warmup ----
+
+    def _warmup_row_capacities(self) -> list[int]:
+        """Every staging-row capacity row_capacity_for can produce for this
+        engine: powers of two up to the prefill chunk, then prefill-chunk
+        multiples up to the slot capacity — the bounded row set that keys the
+        chunk-prefill and finalize program shapes."""
+        rows: set[int] = set()
+        r = MIN_BUCKET
+        while r < self.prefill_chunk and r <= self.capacity:
+            rows.add(r)
+            r *= 2
+        if r <= self.capacity:
+            rows.add(r)  # smallest power of two >= prefill_chunk
+        m = self.prefill_chunk * 2
+        while m <= self.capacity:
+            rows.add(m)
+            m += self.prefill_chunk
+        return sorted(rows)
+
+    def warmup(self) -> int:
+        """Execute the engine's bounded program set once so no cold XLA
+        compile ever lands mid-pipeline: the decode chunk (and spec-verify
+        when speculative), plus every chunk-prefill and finalize shape —
+        (row capacity x power-of-two sub-batch) for the cold admission plans,
+        and the n=1 prefix-suffix chunk sizes. Runs on the engine's own
+        device state BEFORE any admission: decode executes with an
+        all-inactive mask (slot lengths are restored, so the scribbled KV is
+        invisible), and finalize splices zero-length rows, so post-warmup
+        state is indistinguishable from cold state. Returns the number of
+        programs executed; gated by ``PRIME_SERVE_WARMUP`` in ``start()``.
+        A raised dispatch reallocates device state before propagating — the
+        warmup calls donate the cache/last/temps buffers, and leaving them
+        consumed would fail every later admission on a deleted array."""
+        # the zero-length finalize splices and donated-state chaining are
+        # only safe against an idle engine, and only on the thread that owns
+        # the device state once the loop is running
+        if self._requests or any(self._active) or self._inflight:
+            raise RuntimeError(
+                "warmup() requires an idle engine (admitted or in-flight "
+                "requests would be corrupted by the warmup splices)"
+            )
+        if self._thread is not None and self._thread is not threading.current_thread():
+            raise RuntimeError(
+                "warmup() must run on the engine thread once start()ed "
+                "(set warmup=True / PRIME_SERVE_WARMUP=1 instead)"
+            )
+        try:
+            return self._warmup()
+        except Exception:
+            self._init_device_state()
+            raise
+
+    def _warmup(self) -> int:
+        import jax
+        import jax.numpy as jnp
+
+        from prime_tpu.models.llama import init_cache
+
+        if self._chunk_fn is None:
+            self._chunk_fn = self._make_chunk_prefill()
+        if self._finalize_batch_fn is None:
+            self._finalize_batch_fn = self._make_finalize_batch()
+        if self._decode_fn is None:
+            self._decode_fn = self._make_decode()
+        if self.speculative and self._spec_fn is None:
+            self._spec_fn = self._make_spec_decode()
+        dispatches = 0
+        t0 = time.monotonic()
+        # throwaway rng stream: warmup outputs are discarded, and the
+        # engine's own stream must stay untouched so a warmed engine's
+        # sampled requests are bit-identical to a cold one's
+        warm_rng = jax.random.PRNGKey(0)
+        with TRACER.span("serve.warmup"), self._mesh_ctx():
+            inactive = jnp.zeros((self.max_slots,), dtype=bool)
+            warm_rng, rng = jax.random.split(warm_rng)
+            self._cache, self._last, toks = self._decode_fn(
+                self.params, self._cache, self._last,
+                self._temps, self._top_ps, inactive, rng,
+            )
+            jax.block_until_ready(toks)
+            dispatches += 1
+            if self.speculative:
+                drafts = jnp.full(
+                    (self.max_slots, self.draft_len), self.pad_id, dtype=jnp.int32
+                )
+                warm_rng, rng = jax.random.split(warm_rng)
+                self._cache, self._last, toks, _ = self._spec_fn(
+                    self.params, self._cache, self._last,
+                    self._temps, self._top_ps, inactive, drafts, rng,
+                )
+                jax.block_until_ready(toks)
+                dispatches += 1
+            batch_sizes = [1]
+            while batch_sizes[-1] * 2 <= self.max_slots:
+                batch_sizes.append(batch_sizes[-1] * 2)
+            for row_cb in self._warmup_row_capacities():
+                cold_sizes = {s for _, s in chunk_plan(0, row_cb, self.prefill_chunk, row_cb)}
+                # prefix-hit suffixes admit singly with mid-prompt plans:
+                # every power-of-two chunk size up to min(prefill_chunk, row)
+                # is reachable at batch 1
+                prefix_sizes = set(cold_sizes)
+                s = MIN_BUCKET
+                while s <= min(self.prefill_chunk, row_cb):
+                    prefix_sizes.add(s)
+                    s *= 2
+                for n in batch_sizes:
+                    sizes = sorted(prefix_sizes if n == 1 else cold_sizes)
+                    row = init_cache(
+                        self.config, n, row_cb, dtype=self._dtype,
+                        quantized=self.kv_quant,
+                    )
+                    logits = None
+                    for size in sizes:
+                        # offset is traced (not a program key): 0 warms the
+                        # same program every real plan offset hits
+                        tokens = jnp.full((n, size), self.pad_id, dtype=jnp.int32)
+                        row, logits = self._chunk_fn(
+                            self.params, row, tokens,
+                            jnp.asarray(0, dtype=jnp.int32),
+                            jnp.zeros((n,), dtype=jnp.int32),
+                        )
+                        dispatches += 1
+                    warm_rng, rng = jax.random.split(warm_rng)
+                    (
+                        self._cache, self._last, self._temps, self._top_ps, firsts,
+                    ) = self._finalize_batch_fn(
+                        self._cache, self._last, self._temps, self._top_ps,
+                        row, logits,
+                        jnp.zeros((n,), dtype=jnp.int32),
+                        jnp.arange(n, dtype=jnp.int32),
+                        jnp.zeros((n,), dtype=jnp.float32),
+                        jnp.ones((n,), dtype=jnp.float32),
+                        rng,
+                    )
+                    jax.block_until_ready(firsts)
+                    dispatches += 1
+        self._m_warmup_programs.set(dispatches)
+        self._m_warmup_s.set(time.monotonic() - t0)
+        return dispatches
+
     # ---- public API ----
 
     def submit(
@@ -651,7 +887,7 @@ class ContinuousBatchingEngine:
         self._fail_in_flight("engine shut down")
         while True:
             try:
-                req = self._pending.get_nowait()
+                req = self._pop_pending()
             except queue.Empty:
                 break
             if req is not None:
@@ -660,6 +896,10 @@ class ContinuousBatchingEngine:
                 req.events.put(None)
 
     def _fail_in_flight(self, message: str) -> None:
+        # drop any dispatched-but-unfetched lookahead chunks: their donated
+        # input buffers are gone and their outputs must never be emitted
+        self._inflight.clear()
+        self._m_inflight_depth.set(0)
         for slot, req in list(self._requests.items()):
             req.error = message
             req.done = True
@@ -678,6 +918,13 @@ class ContinuousBatchingEngine:
     # ---- engine loop ----
 
     def _run(self) -> None:
+        if self.warmup_enabled:
+            # compile on the engine thread (it owns device state) before the
+            # first request can land mid-pipeline on a cold program
+            try:
+                self.warmup()  # reallocates its donated state on failure
+            except Exception as e:  # noqa: BLE001 — serve anyway; compiles land lazily
+                sys.stderr.write(f"prime_tpu.serve.engine: warmup failed: {e}\n")
         while self._running:
             if not self.tick():
                 # idle: block until a request (or the shutdown sentinel) lands
@@ -687,20 +934,71 @@ class ContinuousBatchingEngine:
                     continue
                 if item is None:
                     continue
-                if item.cancelled:
-                    item.done = True
-                    item.events.put(None)
-                    continue
-                try:
-                    self._prefill(item, int(np.argmin(self._active)))
-                except Exception as e:  # noqa: BLE001 — keep the loop alive
-                    item.error = f"prefill failed: {e}"
-                    item.done = True
-                    item.events.put(None)
+                # requeue at the FRONT (arrival order preserved) and run a
+                # full tick: a burst landing while the engine idles must take
+                # the batched _admit() path — the old argmin single prefill
+                # here paid one dispatch pair per request even when the whole
+                # burst was already queued behind this item
+                self._requeue(item)
+                self.tick()
+
+    def _requeue(self, req: EngineRequest) -> None:
+        """Hand a popped request back to admission ahead of the pending
+        queue (_pop_pending consumes _requeued first, preserving arrival
+        order without reaching into queue.Queue internals)."""
+        self._requeued.append(req)
+
+    def _pop_pending(self) -> EngineRequest | None:
+        """The ONE owner of admission-drain order: requeued head first, then
+        the pending queue. Raises queue.Empty when both are drained; may
+        return the None shutdown sentinel (callers skip it)."""
+        if self._requeued:
+            return self._requeued.popleft()
+        return self._pending.get_nowait()
 
     def tick(self) -> bool:
-        """One engine iteration: admit pending requests into free slots, then
-        decode one chunk. Returns False when there was nothing to do."""
+        """One engine iteration. Returns False when there was nothing to do.
+
+        Overlap mode (default): dispatch the next decode chunk on the
+        last-known active mask FIRST, then do all host work — fetching the
+        previous chunk's tokens, emit/retire, cancellation sweep, admission —
+        inside the new chunk's device-compute window. Synchronous mode
+        (``PRIME_SERVE_OVERLAP=0`` or speculative): admit, then decode one
+        chunk and block for its tokens.
+        """
+        if not self.overlap:
+            return self._tick_sync()
+        did = False
+        try:
+            if any(self._active):
+                self._dispatch_decode()
+                did = True
+            # one-deep pipeline: with a fresh chunk dispatched, sync the
+            # previous one now (its host work overlaps the new chunk's device
+            # window); with nothing dispatched, drain what is still in flight
+            while len(self._inflight) > (1 if did else 0):
+                self._sync_decode()
+                did = True
+        except Exception as e:  # noqa: BLE001 — a dead engine hangs every client
+            # the decode jit donates the cache buffers, so a raised dispatch
+            # or sync leaves them (and any in-flight lookahead chunk) invalid:
+            # drop the pipeline, fail the in-flight requests promptly, and
+            # reallocate device state so the engine keeps serving. Recovery is
+            # always synchronous — _init_device_state must not race an
+            # in-flight donated dispatch.
+            self._fail_in_flight(f"decode failed: {e}")
+            self._init_device_state()
+            return True
+        self._retire_cancelled()
+        admitted = self._admit()
+        if admitted:
+            for chunk in self._inflight:
+                chunk.clean = False
+        return admitted or did
+
+    def _tick_sync(self) -> bool:
+        """The strictly serial loop: admit, then decode one chunk and block
+        for its tokens before any emit/admission work."""
         admitted = self._admit()
         self._retire_cancelled()
         if not any(self._active):
@@ -717,6 +1015,63 @@ class ContinuousBatchingEngine:
             self._fail_in_flight(f"decode failed: {e}")
             self._init_device_state()
         return True
+
+    def _dispatch_decode(self) -> None:
+        """Launch one decode chunk and return WITHOUT waiting for it: the
+        tokens stay on the device inside an _InflightChunk until
+        _sync_decode fetches them. JAX's async dispatch makes this the whole
+        pipeline — the host returns as soon as the computation is enqueued."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._decode_fn is None:
+            self._decode_fn = self._make_decode()
+        self._rng, rng = jax.random.split(self._rng)
+        mask = self._active.copy()
+        seq = next(self._chunk_seq)
+        with TRACER.span("serve.dispatch", seq=seq, steps=self.chunk), self._mesh_ctx():
+            self._cache, self._last, toks = self._decode_fn(
+                self.params, self._cache, self._last,
+                self._temps, self._top_ps, jnp.asarray(mask), rng,
+            )
+        self._inflight.append(
+            _InflightChunk(
+                seq=seq, toks=toks, mask=mask,
+                requests=dict(self._requests),
+                dispatched_at=time.monotonic(),
+            )
+        )
+        self._m_inflight_depth.set(len(self._inflight))
+
+    def _sync_decode(self) -> None:
+        """Fetch the oldest in-flight chunk's tokens and emit them. Tokens
+        route via the dispatch-time request snapshot: a slot retired (and
+        possibly re-admitted) after dispatch gets its whole chunk counted as
+        wasted decode instead of leaking old tokens into the new request."""
+        chunk = self._inflight.pop(0)
+        t_sync = time.monotonic()
+        with TRACER.span("serve.sync", seq=chunk.seq):
+            toks_host = np.asarray(chunk.toks)  # blocks until the chunk lands
+        t_done = time.monotonic()
+        self._m_host_stall_s.inc(t_done - t_sync)
+        self._m_chunk_window_s.inc(t_done - chunk.dispatched_at)
+        if chunk.clean:
+            # steady-state decode only: windows that contained an admission
+            # prefill are dominated by host work already recorded in
+            # serve_prefill_seconds and would corrupt the per-step histogram
+            self._m_decode_step_s.observe((t_done - chunk.dispatched_at) / self.chunk)
+        self._m_inflight_depth.set(len(self._inflight))
+        for slot in range(self.max_slots):
+            if not chunk.mask[slot]:
+                continue
+            req = chunk.requests.get(slot)
+            if req is None or req.done or req.cancelled:
+                # dispatched on a stale mask: the slot retired between
+                # dispatch and sync — the bounded cost of one-chunk-lag
+                # retirement is this whole chunk row
+                self._m_wasted_tokens.inc(self.chunk)
+                continue
+            self._emit(req, toks_host[slot].tolist())
 
     def _retire_cancelled(self) -> None:
         """Free slots whose client abandoned the request (disconnected
@@ -743,7 +1098,7 @@ class ContinuousBatchingEngine:
             burst: list[EngineRequest] = []
             while len(burst) < len(free):
                 try:
-                    req = self._pending.get_nowait()
+                    req = self._pop_pending()
                 except queue.Empty:
                     break
                 if req is None:
@@ -1128,8 +1483,15 @@ class ContinuousBatchingEngine:
         refreshed here (so a Prometheus scrape through the same registry sees
         them fresh too)."""
         self._m_active_slots.set(int(self._active.sum()))
-        self._m_queue_depth.set(self._pending.qsize())
+        self._m_queue_depth.set(self._pending.qsize() + len(self._requeued))
         values = self.registry.values()
+        stall = float(values["serve_host_stall_seconds_total"])
+        window = float(values["serve_chunk_window_seconds_total"])
+        # fraction of the dispatch-to-sync window the host did NOT block for:
+        # 0 in synchronous mode (stall == window), ->1 when emit/admission
+        # fully hide inside device compute
+        ratio = max(0.0, min(1.0, 1.0 - stall / window)) if window > 0 else 0.0
+        self._m_overlap_ratio.set(ratio)
         return {
             "requests_admitted": int(values["serve_requests_admitted_total"]),
             "requests_completed": int(values["serve_requests_completed_total"]),
@@ -1140,6 +1502,13 @@ class ContinuousBatchingEngine:
             "batched_admission_waves": int(values["serve_batched_admission_waves_total"]),
             "active_slots": int(values["serve_active_slots"]),
             "queue_depth": int(values["serve_queue_depth"]),
+            "overlap": bool(self.overlap),
+            "inflight_depth": int(values["serve_inflight_depth"]),
+            "host_stall_s": round(stall, 6),
+            "chunk_window_s": round(window, 6),
+            "overlap_ratio": round(ratio, 4),
+            "wasted_decode_tokens": int(values["serve_wasted_decode_tokens_total"]),
+            "warmup_programs": int(values["serve_warmup_programs"]),
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
 

@@ -478,13 +478,18 @@ def serve_model(
     chunk: int = 8,
     speculative: bool = False,
     draft_len: int = 4,
+    overlap: bool | None = None,
+    warmup: bool | None = None,
 ) -> InferenceServer:
     """Bind the port, then build the (optionally sharded) generator.
 
     ``continuous=True`` serves through the slot-based continuous-batching
     engine (serve/engine.py): concurrent requests share the chip via KV-cache
     slots and streaming responses emit tokens as they decode, instead of one
-    whole-turn generation at a time behind a lock."""
+    whole-turn generation at a time behind a lock. ``overlap``/``warmup``
+    (None = the PRIME_SERVE_OVERLAP / PRIME_SERVE_WARMUP env defaults)
+    control the engine's one-chunk-deep decode pipeline and its AOT warmup
+    pass — docs/architecture.md "Engine pipeline"."""
     from prime_tpu.evals.runner import JaxGenerator
 
     server = InferenceServer(model, host=host, port=port)  # fail fast on EADDRINUSE
@@ -532,6 +537,8 @@ def serve_model(
                 kv_quant=kv_quant,
                 speculative=speculative,
                 draft_len=draft_len,
+                overlap=overlap,
+                warmup=warmup,
             )
             engine.start()
             server.generator = EngineBackend(engine, generator.tokenizer)

@@ -1,29 +1,35 @@
 """Phase-level profile of the continuous-batching engine's bench scenario.
 
 Answers ONE question: where does the serve bench's wall-clock go on the real
-chip — admissions (prefill dispatches), decode chunks, or mid-run XLA
+chip — admissions (prefill dispatches), decode dispatch/sync, or mid-run XLA
 compiles? The serve roofline in bench.py says ~2% of HBM peak, which means
 the engine is host/dispatch-bound there, not bandwidth-bound; this script
 attributes the time so the fix targets the right layer.
 
-Usage: python scripts/serve_profile.py  (single real chip; ~2 min)
+Two modes:
+
+- ``python scripts/serve_profile.py``  (single real chip; ~2 min) — run the
+  bench serve scenario with per-phase timers. Set ``PRIME_TRACE=trace.jsonl``
+  first and the run also leaves a span log the second mode can analyze.
+- ``python scripts/serve_profile.py --trace trace.jsonl`` — read a
+  PRIME_TRACE JSONL (from any serve run) and print the per-chunk
+  dispatch-vs-sync overlap report: for every decode chunk, how long the
+  host spent enqueuing it (``serve.dispatch``), how long it later blocked
+  fetching the tokens (``serve.sync``), and the host-stall fraction of the
+  dispatch→sync window. A well-overlapped engine shows stall fractions near
+  zero; ~1.0 means the loop is effectively synchronous.
 """
 
 from __future__ import annotations
 
+import argparse
+import json
 import os
 import sys
 import time
 from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import jax
-import jax.numpy as jnp
-
-from prime_tpu.models import get_config
-from prime_tpu.models.llama import init_params
-from prime_tpu.serve.engine import ContinuousBatchingEngine
 
 TIMES: dict[str, float] = defaultdict(float)
 COUNTS: dict[str, int] = defaultdict(int)
@@ -48,7 +54,83 @@ def _wrap(obj, name: str) -> None:
     setattr(obj, name, timed)
 
 
+def overlap_report(path: str) -> None:
+    """Pair serve.dispatch / serve.sync spans by chunk seq and print the
+    per-chunk host-stall breakdown plus aggregates. One PRIME_TRACE file can
+    hold several engines' spans back-to-back (bench.py builds a fresh engine
+    per serve section, each restarting seq at 0): a dispatch whose seq was
+    already seen starts a new run, so runs are reported separately instead
+    of silently overwriting each other. Concurrent engines interleaving one
+    sink are not disambiguated."""
+    runs: list[tuple[dict[int, dict], dict[int, dict]]] = [({}, {})]
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            span = json.loads(line)
+            seq = span.get("attrs", {}).get("seq")
+            if seq is None:
+                continue
+            dispatch, sync = runs[-1]
+            if span["name"] == "serve.dispatch":
+                if seq in dispatch:  # seq restarted: a new engine's spans begin
+                    dispatch, sync = {}, {}
+                    runs.append((dispatch, sync))
+                dispatch[seq] = span
+            elif span["name"] == "serve.sync":
+                sync[seq] = span
+    runs = [(d, s) for d, s in runs if set(d) & set(s)]
+    if not runs:
+        print(f"no paired serve.dispatch/serve.sync spans in {path}")
+        print("(synchronous loop? PRIME_SERVE_OVERLAP=0 emits serve.decode_chunk only)")
+        return
+    tot_stall = tot_window = 0.0
+    for i, (dispatch, sync) in enumerate(runs):
+        seqs = sorted(set(dispatch) & set(sync))
+        label = f" (engine run {i + 1}/{len(runs)})" if len(runs) > 1 else ""
+        print(f"--- overlap report: {len(seqs)} chunks from {path}{label}")
+        print(
+            f"{'chunk':>6} {'dispatch_ms':>12} {'stall_ms':>10} "
+            f"{'window_ms':>10} {'stall_frac':>10}"
+        )
+        for seq in seqs:
+            d, s = dispatch[seq], sync[seq]
+            # window: dispatch start -> sync end, on the shared monotonic clock
+            window = (s["start_s"] + s["duration_s"]) - d["start_s"]
+            stall = s["duration_s"]
+            tot_stall += stall
+            tot_window += window
+            print(
+                f"{seq:>6} {d['duration_s'] * 1e3:>12.2f} {stall * 1e3:>10.2f} "
+                f"{window * 1e3:>10.2f} {stall / window if window > 0 else 0.0:>10.3f}"
+            )
+    frac = tot_stall / tot_window if tot_window > 0 else 0.0
+    print(
+        f"--- total: stall {tot_stall:.3f}s of {tot_window:.3f}s window "
+        f"({frac:.1%} stalled, {1 - frac:.1%} overlapped)"
+    )
+
+
 def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--trace", metavar="JSONL", default=None,
+        help="Print the dispatch-vs-sync overlap report from a PRIME_TRACE "
+             "JSONL instead of running the profile.",
+    )
+    args = parser.parse_args()
+    if args.trace:
+        overlap_report(args.trace)
+        return
+
+    import jax
+    import jax.numpy as jnp
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.serve.engine import ContinuousBatchingEngine
+
     # the scenario comes from bench.py so this profiles EXACTLY the workload
     # the bench's serve section measures
     import bench
@@ -81,7 +163,17 @@ def main() -> None:
     setattr(_c, cname, spy)
 
     _wrap(engine, "_prefill")
-    _wrap(engine, "_decode_chunk")
+    _wrap(engine, "_prefill_batch")
+    if engine.overlap:
+        # the pipelined loop: dispatch is host enqueue time, sync is the
+        # blocked fetch — their gap is exactly what overlap bought
+        _wrap(engine, "_dispatch_decode")
+        _wrap(engine, "_sync_decode")
+    else:
+        _wrap(engine, "_decode_chunk")
+    decode_keys = (
+        ("_dispatch_decode", "_sync_decode") if engine.overlap else ("_decode_chunk",)
+    )
     for phase in ("warm1", "warm2", "measured"):
         TIMES.clear()
         COUNTS.clear()
@@ -93,14 +185,29 @@ def main() -> None:
         while not all(r.done for r in reqs):
             engine.tick()
         elapsed = time.perf_counter() - t0
+        # snapshot the phase timers BEFORE draining the lookahead chunk: the
+        # drain's sync time is outside `elapsed` and must not skew the
+        # attribution (nor leak into the next phase's timed window)
+        times, counts = dict(TIMES), dict(COUNTS)
+        engine.tick()
         total = sum(len(r.all_tokens(timeout=1)) for r in reqs)
         print(f"--- {phase}: {total} tokens in {elapsed:.2f}s = {total/elapsed:.1f} tok/s")
-        for k in sorted(TIMES):
-            print(f"    {k}: {TIMES[k]:.2f}s over {COUNTS[k]} calls")
+        for k in sorted(times):
+            print(f"    {k}: {times[k]:.2f}s over {counts[k]} calls")
         other = elapsed - sum(
-            TIMES[k] for k in ("_prefill", "_decode_chunk", "xla_compile")
+            times.get(k, 0.0)
+            for k in ("_prefill", "_prefill_batch", "xla_compile", *decode_keys)
         )
         print(f"    other (host glue): {other:.2f}s")
+    stats = engine.stats()
+    print(
+        f"--- engine: overlap_ratio {stats['overlap_ratio']}, host stall "
+        f"{stats['host_stall_s']}s of {stats['chunk_window_s']}s window, "
+        f"wasted decode tokens {stats['wasted_decode_tokens']}"
+    )
+    if os.environ.get("PRIME_TRACE"):
+        print(f"--- spans at {os.environ['PRIME_TRACE']}: rerun with "
+              f"--trace {os.environ['PRIME_TRACE']} for the per-chunk report")
 
 
 if __name__ == "__main__":

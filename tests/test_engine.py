@@ -18,8 +18,19 @@ from prime_tpu.models.llama import init_params
 from prime_tpu.models.sampler import generate
 from prime_tpu.serve.engine import ContinuousBatchingEngine, EngineRequest, bucket_for
 
+from _markers import requires_set_mesh
+
 CONFIG = get_config("tiny-test")
 PARAMS = init_params(jax.random.PRNGKey(0), CONFIG, dtype=jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _default_pipeline_env(monkeypatch):
+    """Pin the engine's env-driven defaults: an ambient PRIME_SERVE_OVERLAP=0
+    (someone debugging with the escape hatch) or PRIME_SERVE_WARMUP=1 must
+    not silently flip every engine test onto the other code path."""
+    monkeypatch.delenv("PRIME_SERVE_OVERLAP", raising=False)
+    monkeypatch.delenv("PRIME_SERVE_WARMUP", raising=False)
 
 
 def reference_tokens(prompt_ids: list[int], n: int) -> list[int]:
@@ -416,8 +427,9 @@ def test_cancel_retires_slot():
 
 def test_decode_failure_fails_requests_and_recovers():
     """A raised decode dispatch must not kill the engine: in-flight requests
-    error out promptly and the next request is served fresh."""
-    engine = make_engine()
+    error out promptly and the next request is served fresh. (Synchronous
+    loop — the overlapped error path is test_overlap_decode_failure_*.)"""
+    engine = make_engine(overlap=False)
     req = engine.submit([1, 2, 3], max_new_tokens=8)
     engine._admit()
     boom = [True]
@@ -454,6 +466,257 @@ def test_shutdown_fails_waiting_requests_promptly():
     engine2.shutdown()
     with pytest.raises(RuntimeError, match="shut down"):
         in_flight.all_tokens(timeout=5)
+
+
+# -- overlapped decode pipeline -----------------------------------------------
+
+
+def test_overlap_default_env_and_spec_gating(monkeypatch):
+    """Overlap is on by default, PRIME_SERVE_OVERLAP=0 switches it off, and
+    speculative mode forces the synchronous loop regardless (chunk N+1's
+    drafts need chunk N's tokens on the host — a data dependency the
+    pipeline cannot hide)."""
+    assert make_engine().overlap
+    monkeypatch.setenv("PRIME_SERVE_OVERLAP", "0")
+    assert not make_engine().overlap
+    monkeypatch.setenv("PRIME_SERVE_OVERLAP", "1")
+    assert not make_engine(speculative=True).overlap
+    assert not make_engine(speculative=True, overlap=True).overlap
+    monkeypatch.delenv("PRIME_SERVE_OVERLAP")
+    assert not make_engine(overlap=False).overlap
+
+
+def test_overlap_dispatches_next_chunk_before_syncing_previous(monkeypatch):
+    """The load-bearing pipeline property, asserted via tracer-span order:
+    chunk N+1's serve.dispatch span finishes BEFORE chunk N's serve.sync
+    span — i.e. the host enqueued the next chunk before it blocked for the
+    previous one's tokens."""
+    from prime_tpu.obs.trace import Tracer
+    from prime_tpu.serve import engine as engine_mod
+
+    tracer = Tracer(enabled=True)
+    monkeypatch.setattr(engine_mod, "TRACER", tracer)
+    engine = make_engine()
+    req = engine.submit([5, 9, 301, 42, 77], max_new_tokens=16)
+    drain(engine, req)
+    engine.tick()  # drain the lookahead chunk
+    order = [
+        (s["name"], s["attrs"]["seq"])
+        for s in tracer.drain()
+        if s["name"] in ("serve.dispatch", "serve.sync")
+    ]
+    assert ("serve.dispatch", 1) in order and ("serve.sync", 0) in order
+    # every sync of chunk N comes after the dispatch of chunk N+1 (when one
+    # exists: the final drained chunk has no successor)
+    for name, seq in order:
+        if name == "serve.sync" and ("serve.dispatch", seq + 1) in order:
+            assert order.index(("serve.dispatch", seq + 1)) < order.index(
+                ("serve.sync", seq)
+            ), f"chunk {seq + 1} was not dispatched before chunk {seq}'s sync"
+    assert req.all_tokens(timeout=1) == reference_tokens([5, 9, 301, 42, 77], 16)
+
+
+def test_overlap_greedy_streams_identical_to_sync():
+    """Bit-identical token streams: the overlapped pipeline reorders host
+    work, never device math — greedy decode must emit exactly what the
+    synchronous loop emits, request by request."""
+    prompts = [[3, 1, 4, 1, 5], [2, 7, 18], [161, 80, 33, 98, 226, 50], [101]]
+
+    def run(overlap):
+        engine = make_engine(overlap=overlap)
+        reqs = [engine.submit(list(p), max_new_tokens=11) for p in prompts]
+        drain(engine, *reqs)
+        engine.tick()  # overlapped mode: drain the lookahead chunk
+        return [r.all_tokens(timeout=1) for r in reqs]
+
+    assert run(True) == run(False)
+
+
+def test_overlap_eos_lag_counts_wasted_decode():
+    """A request retiring on EOS mid-pipeline emits nothing past EOS, and
+    the lookahead chunk decoded for its slot is counted as wasted decode
+    (bounded at one chunk per retirement)."""
+    prompt = [5, 9, 301, 42, 77]
+    ref = reference_tokens(prompt, 12)
+    eos = ref[3]
+    engine = make_engine(eos_id=eos)
+    assert engine.overlap
+    req = engine.submit(prompt, max_new_tokens=12)
+    drain(engine, req)
+    for _ in range(3):
+        engine.tick()  # drain the pipeline
+    assert req.all_tokens(timeout=1) == ref[:3]  # nothing past EOS
+    stats = engine.stats()
+    assert stats["wasted_decode_tokens"] >= engine.chunk
+    assert stats["inflight_depth"] == 0
+    assert stats["host_stall_s"] <= stats["chunk_window_s"]
+
+
+def test_overlap_cancel_retires_with_one_chunk_lag():
+    """Cancellation under the pipeline: the slot frees at the next chunk
+    boundary, its lookahead tokens are dropped (not leaked to the slot's
+    next tenant), and the replacement request decodes reference-exactly."""
+    engine = make_engine(max_slots=1)
+    victim = engine.submit([1, 2, 3], max_new_tokens=50)
+    engine.tick()  # admit
+    engine.tick()  # dispatch first chunk
+    assert engine._active[0] and engine._inflight
+    victim.cancel()
+    replacement = engine.submit([4, 5, 6], max_new_tokens=4)
+    drain(engine, replacement)
+    engine.tick()
+    assert victim.done
+    assert replacement.all_tokens(timeout=1) == reference_tokens([4, 5, 6], 4)
+    assert engine.stats()["wasted_decode_tokens"] >= engine.chunk
+
+
+def test_overlap_decode_failure_with_inflight_chunk_recovers():
+    """A raised dispatch while a lookahead chunk is in flight: the pipeline
+    is dropped, in-flight requests fail promptly (donated buffers are gone),
+    device state reallocates, and the next request is served fresh."""
+    engine = make_engine()
+    req = engine.submit([1, 2, 3], max_new_tokens=32)
+    engine.tick()  # admit
+    engine.tick()  # dispatch chunk 0
+    assert engine._inflight
+    real_fn = engine._decode_fn
+
+    def exploding(*args, **kwargs):
+        raise RuntimeError("chip on fire")
+
+    engine._decode_fn = exploding
+    engine.tick()  # dispatch raises with a chunk still in flight
+    engine._decode_fn = real_fn
+    assert not engine._inflight
+    with pytest.raises(RuntimeError, match="chip on fire"):
+        req.all_tokens(timeout=1)
+    fresh = engine.submit([7, 8, 9], max_new_tokens=4)
+    drain(engine, fresh)
+    assert fresh.all_tokens(timeout=1) == reference_tokens([7, 8, 9], 4)
+
+
+def test_spec_chunk_runs_synchronously():
+    """Pin the shipped speculative behavior: spec mode always runs the
+    serial loop (overlap gated off at construction) and never leaves a
+    chunk in flight."""
+    engine = make_engine(speculative=True, draft_len=4)
+    assert not engine.overlap
+    req = engine.submit(list(range(1, 9)) * 2, max_new_tokens=12)
+    drain(engine, req)
+    assert not engine._inflight
+    assert req.all_tokens(timeout=1) == reference_tokens(list(range(1, 9)) * 2, 12)
+
+
+def test_idle_burst_requeues_into_one_batched_wave():
+    """The idle-path admission fix: a request popped by the idle loop is
+    requeued at the FRONT (arrival order kept) and admitted through the
+    batched _admit() path together with the rest of the burst — not
+    prefilled singly via the old argmin path."""
+    engine = make_engine()
+    prompts = [[3, 1, 4], [2, 7, 18], [9, 9, 9], [5, 6]]
+    reqs = [engine.submit(list(p), max_new_tokens=6) for p in prompts]
+    # what _run's idle path does: pop one, requeue, tick
+    first = engine._pending.get(timeout=1)
+    assert first is reqs[0]
+    engine._requeue(first)
+    engine.tick()
+    assert engine.batched_waves == 1  # ONE 4-wide wave, order preserved
+    assert [engine._requests[s].id for s in sorted(engine._requests)] == [
+        r.id for r in reqs
+    ]
+    drain(engine, *reqs)
+    for p, r in zip(prompts, reqs):
+        assert r.all_tokens(timeout=1) == reference_tokens(p, 6)
+
+
+# -- AOT warmup ----------------------------------------------------------------
+
+
+def test_warmup_compiles_programs_and_preserves_cold_state(monkeypatch):
+    """warmup() executes the bounded program set (decode + every
+    chunk-prefill/finalize shape) against the engine's own device state and
+    leaves it indistinguishable from cold: the first real request still
+    decodes reference-exactly. PRIME_SERVE_WARMUP gates the start() hook."""
+    engine = make_engine(max_slots=2, capacity=32, prefill_chunk=16, warmup=True)
+    assert engine.warmup_enabled
+    rng_before = engine._rng
+    programs = engine.warmup()
+    # decode + per-(row, batch) chunk/finalize: rows {16, 32} x batches {1, 2}
+    assert programs >= 1 + 2 * 2 * 2
+    # cold-state indistinguishability includes the sampling stream: a warmed
+    # engine's sampled requests must be bit-identical to a cold engine's
+    assert (engine._rng == rng_before).all()
+    stats = engine.stats()
+    assert stats["warmup_programs"] == programs
+    req = engine.submit([5, 9, 3], max_new_tokens=6)
+    drain(engine, req)
+    assert req.all_tokens(timeout=1) == reference_tokens([5, 9, 3], 6)
+    # warmup against a live engine would splice zero-length garbage over
+    # occupied slots: guarded
+    busy = engine.submit([7, 8], max_new_tokens=20)
+    engine.tick()
+    assert any(engine._active)
+    with pytest.raises(RuntimeError, match="idle engine"):
+        engine.warmup()
+    busy.cancel()
+
+    monkeypatch.setenv("PRIME_SERVE_WARMUP", "1")
+    assert make_engine().warmup_enabled
+    monkeypatch.setenv("PRIME_SERVE_WARMUP", "0")
+    assert not make_engine().warmup_enabled
+
+
+def test_warmup_failure_reallocates_state_and_serves():
+    """A warmup dispatch that raises AFTER consuming its donated inputs must
+    not brick the engine: _run reallocates device state and the first real
+    request still decodes reference-exactly."""
+    engine = make_engine(warmup=True)
+    real_make = engine._make_decode
+    boomed = []
+
+    def flaky_make():
+        fn = real_make()
+
+        def wrapper(*args, **kwargs):
+            out = fn(*args, **kwargs)  # donation already happened
+            if not boomed:
+                boomed.append(1)
+                raise RuntimeError("warmup boom")
+            return out
+
+        return wrapper
+
+    engine._make_decode = flaky_make
+    with engine:
+        req = engine.submit([1, 2, 3], max_new_tokens=4)
+        assert req.all_tokens(timeout=120) == reference_tokens([1, 2, 3], 4)
+    assert boomed
+
+
+def test_warmup_speculative_covers_verify_program():
+    engine = make_engine(
+        max_slots=2, capacity=64, prefill_chunk=16, speculative=True, draft_len=4
+    )
+    programs = engine.warmup()
+    assert programs >= 2  # decode + spec-verify at minimum
+    prompt = list(range(1, 9)) * 2
+    req = engine.submit(prompt, max_new_tokens=10)
+    drain(engine, req)
+    assert req.all_tokens(timeout=1) == reference_tokens(prompt, 10)
+
+
+def test_stats_reports_pipeline_fields():
+    engine = make_engine()
+    req = engine.submit([1, 2, 3], max_new_tokens=6)
+    drain(engine, req)
+    engine.tick()
+    s = engine.stats()
+    assert s["overlap"] is True
+    assert s["inflight_depth"] == 0
+    assert s["chunk_window_s"] > 0
+    assert 0.0 <= s["overlap_ratio"] <= 1.0
+    assert s["host_stall_s"] >= 0
+    assert s["wasted_decode_tokens"] >= 0 and s["warmup_programs"] == 0
 
 
 def test_engine_backend_server_integration():
@@ -515,6 +778,7 @@ def test_engine_backend_generate_blocking():
     assert text == tok.decode(ref)
 
 
+@requires_set_mesh
 def test_engine_under_mesh():
     """The engine runs sharded over a device mesh (tp over kv heads)."""
     from prime_tpu.parallel.mesh import make_mesh
@@ -534,6 +798,7 @@ def test_engine_under_mesh():
     assert req.all_tokens(timeout=1) == reference_tokens(prompt, 6)
 
 
+@requires_set_mesh
 def test_engine_under_sp_mesh():
     """Slot-sharded long-context serving (VERDICT r4 #7): the engine's KV
     cache slot axis shards over an sp axis (sp_cache_spec) and concurrent
@@ -555,6 +820,7 @@ def test_engine_under_sp_mesh():
         assert r.all_tokens(timeout=1) == reference_tokens(p, 6)
 
 
+@requires_set_mesh
 def test_serve_model_accepts_sequence_parallel():
     """`prime serve --sp N` reaches the engine: serve_model must accept
     sequence_parallel and build the sp-meshed continuous engine with a

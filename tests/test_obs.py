@@ -289,12 +289,15 @@ def test_engine_histograms_populate_through_streamed_completion():
                 body = "".join(response.iter_lines())
                 assert "[DONE]" in body
 
-            # legacy JSON: same keys as the pre-registry counters
+            # legacy JSON: the pre-registry counter keys, plus the decode
+            # pipeline fields (PR 2: overlapped dispatch) — additive only
             engine_stats = httpx.get(f"{srv.url}/metrics").json()["engine"]
             assert set(engine_stats) == {
                 "requests_admitted", "requests_completed", "requests_cancelled",
                 "requests_failed", "tokens_emitted", "prefix_hits",
                 "batched_admission_waves", "active_slots", "queue_depth",
+                "overlap", "inflight_depth", "host_stall_s", "chunk_window_s",
+                "overlap_ratio", "wasted_decode_tokens", "warmup_programs",
                 "uptime_s",
             }
             assert engine_stats["requests_admitted"] == 1
@@ -474,3 +477,61 @@ def test_int4_pallas_gate_under_mesh():
         out = qz.matmul(x, qw)
     assert not qz._mesh_context_active()
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_serve_profile_overlap_report(tmp_path):
+    """scripts/serve_profile.py --trace: pairs serve.dispatch/serve.sync
+    spans by chunk seq from a PRIME_TRACE JSONL and reports the per-chunk
+    host-stall fraction (the offline twin of serve_overlap_ratio)."""
+    import json
+    import pathlib
+    import subprocess
+    import sys
+
+    spans = [
+        # chunk 0: dispatched at t=0.00 (1ms enqueue), synced over [0.10, 0.101]
+        {"name": "serve.dispatch", "start_s": 0.0, "duration_s": 0.001,
+         "attrs": {"seq": 0, "steps": 8}},
+        {"name": "serve.sync", "start_s": 0.10, "duration_s": 0.001,
+         "attrs": {"seq": 0}},
+        # chunk 1: fully stalled (sync spans the whole window)
+        {"name": "serve.dispatch", "start_s": 0.2, "duration_s": 0.001,
+         "attrs": {"seq": 1, "steps": 8}},
+        {"name": "serve.sync", "start_s": 0.201, "duration_s": 0.099,
+         "attrs": {"seq": 1}},
+        # unrelated span: must be ignored
+        {"name": "serve.prefill", "start_s": 0.0, "duration_s": 0.5, "attrs": {}},
+        # a second engine's spans (seq restarts at 0): a new run, not an
+        # overwrite of the first engine's chunk 0
+        {"name": "serve.dispatch", "start_s": 1.0, "duration_s": 0.001,
+         "attrs": {"seq": 0, "steps": 8}},
+        {"name": "serve.sync", "start_s": 1.05, "duration_s": 0.002,
+         "attrs": {"seq": 0}},
+    ]
+    trace = tmp_path / "trace.jsonl"
+    trace.write_text("".join(json.dumps(s) + "\n" for s in spans))
+    script = str(
+        pathlib.Path(__file__).resolve().parents[1] / "scripts" / "serve_profile.py"
+    )
+    out = subprocess.run(
+        [sys.executable, script, "--trace", str(trace)],
+        capture_output=True, text=True, timeout=60, check=True,
+    ).stdout
+    assert "overlap report: 2 chunks" in out and "engine run 1/2" in out
+    assert "overlap report: 1 chunks" in out and "engine run 2/2" in out
+    assert "stall_frac" in out
+    lines = [l for l in out.splitlines() if l.strip().startswith(("0 ", "1 "))]
+    assert len(lines) == 3  # chunks 0+1 of run 1, chunk 0 of run 2
+    # run 1: chunk 0 barely stalled, chunk 1 fully stalled
+    assert float(lines[0].split()[-1]) < 0.05
+    assert float(lines[1].split()[-1]) > 0.9
+    assert "overlapped)" in out
+
+    # an empty / span-free file degrades with a pointer, not a crash
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    out2 = subprocess.run(
+        [sys.executable, script, "--trace", str(empty)],
+        capture_output=True, text=True, timeout=60, check=True,
+    ).stdout
+    assert "no paired" in out2

@@ -19,6 +19,8 @@ from prime_tpu.train import (
     shard_train_state,
 )
 
+from _markers import requires_set_mesh
+
 CFG = get_config("tiny-test")
 
 
@@ -115,6 +117,7 @@ def test_opt_state_sharding_matches_params_by_position():
     assert mu["layers"]["wq"].sharding.spec == jax.sharding.PartitionSpec(None, "fsdp", "tp")
 
 
+@requires_set_mesh
 def test_sharded_generate_matches_single_device():
     """The eval/serve path: JaxGenerator over a mesh must produce the same
     tokens as the unsharded sampler (fp32 weights for determinism)."""
@@ -227,6 +230,7 @@ def test_sp_decode_rejects_indivisible_capacity():
         )
 
 
+@requires_set_mesh
 def test_sharded_generate_qwen_style_bias_and_decoupled_head_dim():
     """attn_bias + head_dim_override must shard and decode like the plain
     config: tp splits the bias vectors on the projection output dim."""
@@ -259,6 +263,7 @@ def test_sharded_generate_qwen_style_bias_and_decoupled_head_dim():
     np.testing.assert_array_equal(np.asarray(out.tokens), np.asarray(ref.tokens))
 
 
+@requires_set_mesh
 def test_sharded_generate_gemma_style_matches_single_device():
     """Softcap + sliding-window + post-norms must survive sharding: the
     Gemma2 masking paths are pure XLA and partition like the plain model."""
@@ -615,6 +620,7 @@ def test_sp_decode_int8_cache_matches_xla():
     np.testing.assert_allclose(np.asarray(out_fp), np.asarray(ref_fp), rtol=2e-3, atol=2e-3)
 
 
+@requires_set_mesh
 def test_generate_with_sp_sharded_cache_matches_plain():
     """Long-context serving building block: generate with the KV cache's
     SLOT axis sharded over sp (a cache bigger than one chip's HBM spreads
