@@ -14,8 +14,6 @@ import time
 import webbrowser
 
 import click
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import padding, rsa
 
 import prime_tpu.commands._deps as deps
 from prime_tpu.utils.render import Renderer, output_options
@@ -32,6 +30,12 @@ browser_open = webbrowser.open
 @output_options
 def login(render: Renderer, no_browser: bool) -> None:
     """Authenticate via the browser and store the API key."""
+    # lazy: cryptography is only needed by the actual login handshake —
+    # importing it at module scope broke `prime --help` (which loads every
+    # command group) on containers without the wheel
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
     config = deps.build_config()
     api = deps.build_client(config)
 

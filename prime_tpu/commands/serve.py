@@ -68,6 +68,12 @@ from prime_tpu.utils.render import Renderer, output_options
          "compile lands mid-request (--continuous). Default: off "
          "(PRIME_SERVE_WARMUP).",
 )
+@click.option(
+    "--prefix-cache-mb", type=float, default=None,
+    help="Byte budget (MiB) of the radix prefix-KV cache: shared prompt "
+         "blocks are cached once and reused across admissions; 0 disables "
+         "(--continuous). Default: 256 (PRIME_SERVE_PREFIX_CACHE_MB).",
+)
 @click.pass_context
 def serve_cmd(
     ctx: click.Context,
@@ -91,6 +97,7 @@ def serve_cmd(
     draft_len: int,
     overlap: bool | None,
     warmup: bool | None,
+    prefix_cache_mb: float | None,
 ) -> None:
     """Serve MODEL over an OpenAI-compatible HTTP API (blocks until Ctrl-C)."""
     if ctx.invoked_subcommand is not None:
@@ -125,6 +132,7 @@ def serve_cmd(
             draft_len=draft_len,
             overlap=overlap,
             warmup=warmup,
+            prefix_cache_mb=prefix_cache_mb,
         )
     except (ValueError, OSError) as e:
         raise click.ClickException(str(e)) from None

@@ -28,6 +28,11 @@ DEFAULT_LATENCY_BUCKETS: tuple[float, ...] = (
 )
 # power-of-two size buckets (admission batch sizes, token counts, ...)
 DEFAULT_SIZE_BUCKETS: tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+# token-length buckets for prompt/prefix histograms: MIN_BUCKET-aligned
+# block counts up to long-context slot capacities (serve_prefix_hit_tokens)
+DEFAULT_TOKEN_BUCKETS: tuple[float, ...] = (
+    16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
+)
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from prime_tpu.parallel.compat import shard_map
+
 
 def flash_decode_sharded(
     q: jnp.ndarray,              # (B, H, 1, D)
@@ -62,7 +64,7 @@ def flash_decode_sharded(
     has_sinks = sinks is not None
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec, lengths_spec, sinks_spec),
         out_specs=q_spec,

@@ -34,6 +34,8 @@ import json
 import os
 import sys
 
+from prime_tpu.parallel.compat import shard_map
+
 
 def run_smoke(
     coordinator_address: str | None = None,
@@ -71,7 +73,7 @@ def run_smoke(
         jnp.ones((n_global,)), NamedSharding(mesh, P("dp"))
     )
     total = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda x: jax.lax.psum(jnp.sum(x), "dp"),
             mesh=mesh, in_specs=P("dp"), out_specs=P(),
         )
@@ -87,7 +89,7 @@ def run_smoke(
     )
     stamped = jax.device_put(jnp.asarray(stamps), NamedSharding(mesh, P("dp")))
     gathered = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda x: jax.lax.all_gather(x, "dp", tiled=True),
             mesh=mesh, in_specs=P("dp"), out_specs=P(),
             # the gathered result IS replicated, but the varying-axes checker

@@ -37,10 +37,20 @@ Single-chip by default; pass ``mesh`` + ``cache_spec`` (from
 parallel.sharding) to run the same engine over a TPU slice — decode then
 takes the XLA attention path, which partitions under SPMD.
 
+- **Block-granular prefix reuse.** Prompt prefixes are cached in a radix
+  tree of MIN_BUCKET-aligned KV segments (serve/prefix_cache.py) under a
+  byte budget (``--prefix-cache-mb`` / ``PRIME_SERVE_PREFIX_CACHE_MB``):
+  common blocks are stored once and shared by reference, matching is
+  partial (two prompts sharing only a system preamble both hit), and a hit
+  seeds its staging row with ONE jitted ``assemble_row`` dispatch instead
+  of a per-leaf copy/pad chain. See docs/architecture.md "Prefix cache".
+
 Observability: each engine owns a prime_tpu.obs metrics Registry (queue-wait
 / TTFT / per-token latency histograms next to the legacy counters) exposed
 through the server's ``GET /metrics?format=prometheus``; see
-docs/architecture.md "Observability".
+docs/architecture.md "Observability". ``stats()`` returns the engine loop's
+cross-field-consistent snapshot (refreshed at every tick under a small
+lock), so an HTTP scrape never reads live counters mid-tick.
 """
 
 from __future__ import annotations
@@ -57,11 +67,18 @@ from typing import Any
 
 import numpy as np
 
-from prime_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS, Registry
+from prime_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS, DEFAULT_TOKEN_BUCKETS, Registry
 from prime_tpu.obs.trace import TRACER
+from prime_tpu.serve.prefix_cache import BlockPrefixCache
 
 MIN_BUCKET = 16
 NEG_INF = -1e30
+# default byte budget for the radix prefix-KV cache: roughly what the old
+# 4-entry whole-row list held for a 1B model at 2048-slot rows
+DEFAULT_PREFIX_CACHE_MB = 256.0
+# KVCache fields with a capacity axis (the segment/assemble unit); lengths is
+# capacity-free and rebuilt by init_cache at assemble time
+_CAPACITY_FIELDS = ("k", "v", "k_scale", "v_scale")
 
 
 def bucket_for(length: int, capacity: int) -> int:
@@ -137,14 +154,6 @@ def _power_batches(n: int) -> list[int]:
         else:
             p //= 2
     return out
-
-
-def _common_prefix_len(a: list[int], b: list[int]) -> int:
-    n = min(len(a), len(b))
-    for i in range(n):
-        if a[i] != b[i]:
-            return i
-    return n
 
 
 def _env_flag(name: str, default: bool) -> bool:
@@ -247,7 +256,7 @@ class ContinuousBatchingEngine:
         capacity: int = 2048,
         chunk: int = 8,
         prefill_chunk: int = 512,
-        prefix_cache_size: int = 4,
+        prefix_cache_mb: float | None = None,
         min_prefix: int = MIN_BUCKET,
         mesh: Any = None,
         cache_spec: Any = None,
@@ -333,13 +342,23 @@ class ContinuousBatchingEngine:
         self._finalize_batch_fn: Any = None
         self._decode_fn: Any = None
         self._spec_fn: Any = None
-        # prompt-prefix KV reuse: newest-last list of (ids, row KVCache) —
-        # an admission whose prompt shares a prefix with a recent one copies
-        # that staged KV row and only prefills the suffix
+        self._assemble_fn: Any = None
+        # prompt-prefix KV reuse: a radix tree of MIN_BUCKET-aligned KV
+        # segments under a byte budget (serve/prefix_cache.py) — an admission
+        # whose prompt shares cached blocks assembles them into its staging
+        # row with one jitted dispatch and only prefills the suffix.
+        # prefix_cache_mb=0 disables; None reads PRIME_SERVE_PREFIX_CACHE_MB.
         self.prefill_chunk = max(MIN_BUCKET, prefill_chunk)
-        self.prefix_cache_size = prefix_cache_size
         self.min_prefix = max(min_prefix, MIN_BUCKET)
-        self._prefix_cache: list[tuple[list[int], Any]] = []
+        if prefix_cache_mb is None:
+            raw = os.environ.get("PRIME_SERVE_PREFIX_CACHE_MB", "").strip()
+            prefix_cache_mb = float(raw) if raw else DEFAULT_PREFIX_CACHE_MB
+        self.prefix_cache_mb = float(prefix_cache_mb)
+        self.prefix_cache: BlockPrefixCache | None = (
+            BlockPrefixCache(int(self.prefix_cache_mb * 2**20), block=MIN_BUCKET)
+            if self.prefix_cache_mb > 0
+            else None
+        )
         # observability: registry-backed counters + latency histograms
         # (surfaced by stats(), the server's /metrics JSON, and the
         # Prometheus exposition at /metrics?format=prometheus). One Registry
@@ -365,6 +384,23 @@ class ContinuousBatchingEngine:
         )
         self._m_prefix_hits = r.counter(
             "serve_prefix_hits_total", "Admissions seeded from the prefix-KV cache"
+        )
+        self._m_prefix_hit_tokens = r.histogram(
+            "serve_prefix_hit_tokens", "Cached tokens reused per prefix hit",
+            buckets=DEFAULT_TOKEN_BUCKETS,
+        )
+        self._m_prefix_bytes = r.gauge(
+            "serve_prefix_cache_bytes", "Device bytes held by cached KV segments"
+        )
+        self._m_prefix_nodes = r.gauge(
+            "serve_prefix_cache_nodes", "Segment nodes in the prefix radix tree"
+        )
+        self._m_prefix_evictions = r.counter(
+            "serve_prefix_evictions_total", "Segment nodes evicted by the byte-budget LRU"
+        )
+        self._m_prefix_assembles = r.counter(
+            "serve_prefix_assembles_total",
+            "assemble_row dispatches (one per prefix-seeded admission)",
         )
         self._m_batched_waves = r.counter(
             "serve_batched_admission_waves_total", "Multi-request admission prefills"
@@ -422,6 +458,12 @@ class ContinuousBatchingEngine:
             "serve_warmup_seconds", "Wall seconds the AOT warmup pass took"
         )
         self._t0 = time.monotonic()
+        # stats() snapshot, ticked by the engine loop (ADVICE engine.py:1008):
+        # HTTP handler threads read the last end-of-tick snapshot under this
+        # lock instead of live counters and queue sizes mid-tick, so one
+        # /metrics response is cross-field consistent with the loop state
+        self._stats_lock = threading.Lock()
+        self._stats_snapshot: dict | None = None
 
     # legacy counter attributes (bench.py and older callers read these as
     # plain ints) — now views over the registry-backed counters
@@ -720,7 +762,8 @@ class ContinuousBatchingEngine:
         compile ever lands mid-pipeline: the decode chunk (and spec-verify
         when speculative), plus every chunk-prefill and finalize shape —
         (row capacity x power-of-two sub-batch) for the cold admission plans,
-        and the n=1 prefix-suffix chunk sizes. Runs on the engine's own
+        the n=1 prefix-suffix chunk sizes, and the single-segment
+        assemble_row shapes at every power-of-two matched length. Runs on the engine's own
         device state BEFORE any admission: decode executes with an
         all-inactive mask (slot lengths are restored, so the scribbled KV is
         invisible), and finalize splices zero-length rows, so post-warmup
@@ -762,6 +805,8 @@ class ContinuousBatchingEngine:
             self._decode_fn = self._make_decode()
         if self.speculative and self._spec_fn is None:
             self._spec_fn = self._make_spec_decode()
+        if self.prefix_cache is not None and self._assemble_fn is None:
+            self._assemble_fn = self._make_assemble_row()
         dispatches = 0
         t0 = time.monotonic()
         # throwaway rng stream: warmup outputs are discarded, and the
@@ -832,6 +877,29 @@ class ContinuousBatchingEngine:
                     )
                     jax.block_until_ready(firsts)
                     dispatches += 1
+                if self.prefix_cache is not None:
+                    # assemble_row coverage: the common single-segment hit
+                    # (one donor path, no branch point) at every power-of-two
+                    # matched length this row can hold. Multi-segment and
+                    # odd-length assembles are tiny data-movement programs
+                    # that compile lazily on first branchy hit.
+                    seg_len = MIN_BUCKET
+                    while seg_len < row_cb:
+                        donor = init_cache(
+                            self.config, 1, seg_len, dtype=self._dtype,
+                            quantized=self.kv_quant,
+                        )
+                        segment = {
+                            f: getattr(donor, f)
+                            for f in _CAPACITY_FIELDS
+                            if getattr(donor, f) is not None
+                        }
+                        assembled = self._assemble_fn(
+                            (segment,), (seg_len,), row_cb
+                        )
+                        jax.block_until_ready(assembled.k)
+                        dispatches += 1
+                        seg_len *= 2
         self._m_warmup_programs.set(dispatches)
         self._m_warmup_s.set(time.monotonic() - t0)
         return dispatches
@@ -872,6 +940,9 @@ class ContinuousBatchingEngine:
     def start(self) -> None:
         if self._thread is not None:
             return
+        # seed the snapshot before the loop owns it: a scrape landing between
+        # start() and the first tick must not observe None
+        self._refresh_stats()
         self._running = True
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -965,7 +1036,16 @@ class ContinuousBatchingEngine:
         inside the new chunk's device-compute window. Synchronous mode
         (``PRIME_SERVE_OVERLAP=0`` or speculative): admit, then decode one
         chunk and block for its tokens.
+
+        Every tick ends by publishing the stats() snapshot — the engine loop
+        is the one writer, so HTTP readers always see a loop-consistent view.
         """
+        try:
+            return self._tick_inner()
+        finally:
+            self._refresh_stats()
+
+    def _tick_inner(self) -> bool:
         if not self.overlap:
             return self._tick_sync()
         did = False
@@ -1350,76 +1430,134 @@ class ContinuousBatchingEngine:
 
         return jax.jit(finalize_batch, donate_argnums=(0, 1, 2, 3))
 
-    # ---- prompt-prefix KV reuse ----
+    # ---- prompt-prefix KV reuse (block radix tree, serve/prefix_cache.py) ----
 
     def _prefix_match(self, ids: list[int]):
-        """ONE owner of the prefix-hit math (clamp to len-1, MIN_BUCKET
-        alignment, min_prefix threshold): returns (usable_len, cached_row) —
-        (0, None) when nothing usable. _admit routes on the length (no
-        allocation); _prefix_seed consumes the row."""
-        best_len, best = 0, None
-        for entry_ids, entry_row in self._prefix_cache:
-            common = _common_prefix_len(ids, entry_ids)
-            if common > best_len:
-                best_len, best = common, entry_row
-        best_len = min(best_len, len(ids) - 1)
-        best_len = (best_len // MIN_BUCKET) * MIN_BUCKET
-        if best is None or best_len < self.min_prefix:
-            return 0, None
-        return best_len, best
+        """ONE owner of the prefix-hit math (clamp to len-1 so at least one
+        real token is always prefilled — the finalize step needs the last
+        prompt position's logits — block alignment via the cache's walk,
+        min_prefix threshold): returns a PINNED PrefixMatch or None. The
+        caller must release() it after consuming the segments."""
+        if self.prefix_cache is None:
+            return None
+        match = self.prefix_cache.match(ids, limit=len(ids) - 1)
+        if match is None:
+            return None
+        if match.length < self.min_prefix:
+            self.prefix_cache.release(match)
+            return None
+        return match
 
     def _prefix_match_len(self, ids: list[int]) -> int:
-        return self._prefix_match(ids)[0]
+        """Routing peek for _admit: usable cached-prefix length without
+        pinning or LRU touches (the seeded path re-matches and pins)."""
+        if self.prefix_cache is None:
+            return 0
+        length = self.prefix_cache.match_len(ids, limit=len(ids) - 1)
+        return length if length >= self.min_prefix else 0
 
-    def _prefix_seed(self, ids: list[int], row_cb: int):
-        """Longest-prefix match against recently staged rows: returns
-        (start, row) where [0, start) is already computed in the row pytree.
-        start is aligned down to MIN_BUCKET (chunk_plan's invariant) and
-        capped at len(ids)-1 so at least one real token is always prefilled
-        (the finalize step needs the last prompt position's logits)."""
+    def _make_assemble_row(self):
+        """One jitted program per (segment-shape tuple, takes, target
+        capacity): dynamic-update-slice concatenation of matched segments
+        into a FRESH staging row (jit outputs are new buffers, so the row is
+        donation-safe for chunk_prefill and never aliases cached segments).
+        Partial takes slice inside the program — no host-side per-leaf ops."""
+        import jax
+
         from prime_tpu.models.llama import init_cache
 
-        best_len, best = self._prefix_match(ids)
-        if best is None:
+        config, dtype, quantized = self.config, self._dtype, self.kv_quant
+
+        def assemble(segments, takes, target_cb):
+            row = init_cache(config, 1, target_cb, dtype=dtype, quantized=quantized)
+            out = {
+                f: getattr(row, f)
+                for f in _CAPACITY_FIELDS
+                if getattr(row, f) is not None
+            }
+            off = 0
+            for seg, take in zip(segments, takes):
+                for name, leaf in seg.items():
+                    piece = leaf[..., :take]
+                    start = (0,) * (leaf.ndim - 1) + (off,)
+                    out[name] = jax.lax.dynamic_update_slice(out[name], piece, start)
+                off += take
+            # lengths stay init_cache's zeros: chunked prefill masks via
+            # prefill_offset, and finalize sets slot lengths explicitly
+            return row._replace(**out)
+
+        return jax.jit(assemble, static_argnums=(1, 2))
+
+    def _prefix_seed(self, ids: list[int], row_cb: int):
+        """Seed an admission's staging row: on a hit, ONE assemble_row
+        dispatch splices every matched segment into a fresh row at ``row_cb``
+        capacity and returns (start, row) with [0, start) already computed;
+        on a miss, a fresh empty row. start is block-aligned (chunk_plan's
+        invariant). The matched path is pinned until the dispatch is
+        enqueued, so a concurrent store's eviction can never free a segment
+        mid-assembly."""
+        from prime_tpu.models.llama import init_cache
+
+        match = self._prefix_match(ids)
+        if match is None:
             return 0, init_cache(
                 self.config, 1, row_cb, dtype=self._dtype, quantized=self.kv_quant
             )
+        if self._assemble_fn is None:
+            self._assemble_fn = self._make_assemble_row()
+        try:
+            with TRACER.span(
+                "serve.assemble", hit_tokens=match.length,
+                segments=len(match.entries), row_capacity=row_cb,
+            ):
+                row = self._assemble_fn(match.segments(), match.takes(), row_cb)
+        finally:
+            self.prefix_cache.release(match)
         self._m_prefix_hits.inc()
-        self._prefix_cache = [e for e in self._prefix_cache if e[1] is not best] + [
-            e for e in self._prefix_cache if e[1] is best
-        ]  # LRU touch
-        return best_len, self._resize_row(best, row_cb)
+        self._m_prefix_assembles.inc()
+        self._m_prefix_hit_tokens.observe(match.length)
+        return match.length, row
 
-    def _resize_row(self, row, target_cb: int):
-        """Fresh row buffers at ``target_cb`` seeded from a cached row pytree
-        (the cached entry stays valid — chunk_prefill donates its row
-        inputs). Every capacity-axis leaf (k/v and int8 scales) resizes the
-        same way."""
-        import jax
-        import jax.numpy as jnp
-
+    def _row_slicer(self, row):
+        """Segment extractor for _store_prefix: slots [start, stop) of every
+        capacity-axis leaf of a finalized batch-1 staging row, as a plain
+        dict (lengths is capacity-free and dropped — assemble rebuilds it).
+        Each call is one lazy jnp slice per leaf, and the cache only invokes
+        it for the genuinely new tail of the trie path."""
         src_cb = row.capacity
 
-        def resize(leaf):
-            if leaf.ndim < 2 or leaf.shape[-1] != src_cb:
-                return jnp.copy(leaf)  # lengths: capacity-free
-            if src_cb == target_cb:
-                return jnp.copy(leaf)
-            if src_cb > target_cb:
-                return jnp.copy(leaf[..., :target_cb])
-            pad = [(0, 0)] * (leaf.ndim - 1) + [(0, target_cb - src_cb)]
-            return jnp.pad(leaf, pad)
+        def slicer(start: int, stop: int):
+            out = {}
+            for name in _CAPACITY_FIELDS:
+                leaf = getattr(row, name)
+                if leaf is None:
+                    continue
+                assert leaf.shape[-1] == src_cb, f"{name} is not capacity-major"
+                out[name] = leaf[..., start:stop]
+            return out
 
-        return jax.tree_util.tree_map(resize, row)
+        return slicer
 
     def _store_prefix(self, ids: list[int], row) -> None:
-        if self.prefix_cache_size <= 0 or len(ids) < self.min_prefix:
+        """Split the finalized staging row into block segments and insert
+        them along the radix path: blocks already cached are deduplicated
+        (shared bytes stored once), only the divergent tail allocates, and
+        the byte-budget LRU evicts cold leaves afterwards. Only full blocks
+        of REAL tokens are stored — the padded row tail never enters the
+        cache."""
+        cache = self.prefix_cache
+        if cache is None:
             return
-        # drop an entry for the identical prompt (the new row supersedes it)
-        self._prefix_cache = [e for e in self._prefix_cache if e[0] != ids]
-        self._prefix_cache.append((list(ids), row))
-        while len(self._prefix_cache) > self.prefix_cache_size:
-            self._prefix_cache.pop(0)
+        aligned = (len(ids) // MIN_BUCKET) * MIN_BUCKET
+        if aligned < self.min_prefix:
+            return
+        evictions_before = cache.evictions
+        cache.insert(list(ids[:aligned]), self._row_slicer(row))
+        evicted = cache.evictions - evictions_before
+        if evicted:
+            self._m_prefix_evictions.inc(evicted)
+        self._m_prefix_bytes.set(cache.bytes)
+        self._m_prefix_nodes.set(cache.nodes)
 
     def _decode_chunk(self) -> None:
         import jax.numpy as jnp
@@ -1477,13 +1615,30 @@ class ContinuousBatchingEngine:
 
     def stats(self) -> dict:
         """Legacy JSON counters for the server's /metrics route — same keys
-        and shape as the pre-registry bare ints. All counter fields come from
-        ONE locked registry read, so a single response is mutually consistent
-        across counters; active_slots/queue_depth are point-in-time gauges
-        refreshed here (so a Prometheus scrape through the same registry sees
-        them fresh too)."""
+        and shape as the pre-registry bare ints, plus the pipeline and
+        prefix-cache fields (additive). While the engine loop is running,
+        this returns the loop's end-of-tick snapshot (taken under a small
+        lock), NOT a live read: every field in one response reflects the
+        same loop state, closing the ADVICE engine.py:1008 note about
+        queue/slot reads racing mid-tick. Callers driving the engine
+        synchronously (tests, bench) get a fresh computation — they own the
+        state, so there is nothing to race."""
+        if self._thread is None or self._thread is threading.current_thread():
+            return self._refresh_stats()
+        with self._stats_lock:
+            snapshot = self._stats_snapshot
+        if snapshot is None:  # loop started but no tick completed yet
+            return self._refresh_stats()
+        return dict(snapshot)
+
+    def _refresh_stats(self) -> dict:
+        """Compute the full stats dict from live state and publish it as the
+        snapshot stats() serves to other threads. Called at the end of every
+        tick() by the engine loop (and directly by synchronous owners)."""
         self._m_active_slots.set(int(self._active.sum()))
         self._m_queue_depth.set(self._pending.qsize() + len(self._requeued))
+        if self.prefix_cache is not None:
+            self._m_prefix_bytes.set(self.prefix_cache.bytes)
         values = self.registry.values()
         stall = float(values["serve_host_stall_seconds_total"])
         window = float(values["serve_chunk_window_seconds_total"])
@@ -1492,7 +1647,7 @@ class ContinuousBatchingEngine:
         # fully hide inside device compute
         ratio = max(0.0, min(1.0, 1.0 - stall / window)) if window > 0 else 0.0
         self._m_overlap_ratio.set(ratio)
-        return {
+        snapshot = {
             "requests_admitted": int(values["serve_requests_admitted_total"]),
             "requests_completed": int(values["serve_requests_completed_total"]),
             "requests_cancelled": int(values["serve_requests_cancelled_total"]),
@@ -1509,8 +1664,15 @@ class ContinuousBatchingEngine:
             "overlap_ratio": round(ratio, 4),
             "wasted_decode_tokens": int(values["serve_wasted_decode_tokens_total"]),
             "warmup_programs": int(values["serve_warmup_programs"]),
+            "prefix_cache_bytes": int(values["serve_prefix_cache_bytes"]),
+            "prefix_cache_nodes": int(values["serve_prefix_cache_nodes"]),
+            "prefix_evictions": int(values["serve_prefix_evictions_total"]),
+            "prefix_assembles": int(values["serve_prefix_assembles_total"]),
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
+        with self._stats_lock:
+            self._stats_snapshot = snapshot
+        return dict(snapshot)
 
 
 class EngineBackend:

@@ -1,0 +1,300 @@
+"""Block-granular radix-tree prefix KV cache for the serving engine.
+
+The engine's prompt-prefix reuse layer (serve/engine.py `_prefix_seed` /
+`_store_prefix`) used to be a flat newest-last list of at most N whole-prompt
+staging rows: matching compared the full prompt against each stored prompt,
+every hit paid a per-leaf copy/pad dispatch chain, two prompts sharing a
+system preamble stored that preamble's KV twice, and nothing bounded the
+cached bytes. This module replaces the storage side with a radix tree over
+``block``-aligned token runs:
+
+- **Nodes own segments.** Each edge of the (path-compressed) trie is a run of
+  tokens whose length is a multiple of ``block``; the node owning the edge
+  holds the KV *segment* for exactly those cache slots — a dict of
+  capacity-axis slices of the staging-row pytree (k/v plus int8 scales when
+  quantized). A prompt's prefix KV is the concatenation of the segments along
+  its trie path, which is what the engine's single jitted ``assemble_row``
+  dispatch rebuilds into a fresh donation-safe row.
+- **Shared blocks are stored once.** Inserting a prompt walks the existing
+  path first; only the divergent tail allocates a new node (one slice per
+  leaf). A mid-edge divergence splits the edge at the block boundary — both
+  halves keep their slot counts, so total bytes are conserved — and the new
+  tail hangs off the split point. Two prompts sharing only a system preamble
+  therefore share the preamble's segment.
+- **Matching is leaf-level and partial.** ``match`` walks full blocks and may
+  stop mid-edge: a cached 96-token prompt serves a 48-token prefix hit by
+  taking the first 48 slots of its segment (sliced inside the assemble
+  program, not on the host). Correctness leans on the radix invariant: a
+  segment is only reachable along the exact token path from the root, so the
+  KV it holds was computed under precisely the context the new prompt shares.
+- **Byte-budget LRU.** The cache tracks the device bytes of every segment and
+  evicts least-recently-used *leaf* nodes (interior nodes are load-bearing
+  for their descendants' paths) until under ``budget_bytes``. ``match`` pins
+  its path (refcount) so a hit mid-assembly can never have a segment evicted
+  out from under it; callers release the pin once the assemble dispatch is
+  enqueued.
+
+The tree is engine-thread-owned (like all engine device state): pin/release
+make the eviction invariant explicit, not the structure thread-safe. The
+module is deliberately jax-light — segments are opaque pytrees; only byte
+accounting walks their leaves — so it unit-tests with plain numpy arrays.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+__all__ = ["BlockPrefixCache", "PrefixMatch", "segment_nbytes"]
+
+
+def segment_nbytes(segment: Any) -> int:
+    """Device bytes of a segment pytree (sum over leaves of size*itemsize —
+    the same accounting for bf16/fp32 KV, int8 KV, and fp32 scales)."""
+    import jax
+
+    return int(
+        sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(segment))
+    )
+
+
+def _common_len(a, b) -> int:
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return i
+    return n
+
+
+class _Node:
+    """One radix-tree edge+node: ``tokens`` is the edge label (length a
+    multiple of the cache block), ``segment`` the KV slices for those slots.
+    Children are keyed by the first block of their edge — siblings can never
+    share a first block (they would have been one edge split later)."""
+
+    __slots__ = ("tokens", "segment", "children", "parent", "refs", "last_used", "nbytes")
+
+    def __init__(self, tokens: tuple[int, ...], segment: Any, parent: "_Node | None") -> None:
+        self.tokens = tokens
+        self.segment = segment
+        self.children: dict[tuple[int, ...], _Node] = {}
+        self.parent = parent
+        self.refs = 0
+        self.last_used = 0
+        self.nbytes = segment_nbytes(segment) if segment is not None else 0
+
+
+@dataclass
+class PrefixMatch:
+    """A pinned walk result: ``entries`` are (node, take) pairs root-to-deep;
+    ``take`` is how many of the node's slots the match uses (a multiple of
+    the block; full except possibly the last entry). ``length`` is their sum.
+    Callers MUST ``release()`` the match once its segments have been read."""
+
+    length: int
+    entries: list[tuple[_Node, int]] = field(default_factory=list)
+
+    def segments(self) -> tuple[Any, ...]:
+        return tuple(node.segment for node, _ in self.entries)
+
+    def takes(self) -> tuple[int, ...]:
+        return tuple(take for _, take in self.entries)
+
+
+class BlockPrefixCache:
+    """Radix tree of block-aligned KV segments under a byte budget.
+
+    ``block`` must match the engine's MIN_BUCKET (chunk_plan's alignment
+    contract: a prefix hit becomes the ``start`` of a chunk plan, which must
+    be block-aligned). ``budget_bytes <= 0`` means unbounded (the engine
+    disables the cache entirely rather than passing 0 here).
+    """
+
+    def __init__(self, budget_bytes: int, block: int = 16) -> None:
+        if block <= 0:
+            raise ValueError(f"block must be positive, got {block}")
+        self.block = block
+        self.budget_bytes = int(budget_bytes)
+        self._root = _Node((), None, None)
+        self._clock = itertools.count(1)
+        self.bytes = 0
+        self.nodes = 0  # segment-owning nodes (root excluded), O(1) gauge read
+        self.evictions = 0  # nodes evicted (monotonic)
+        self.evicted_bytes = 0
+        self.dedup_tokens = 0  # insert tokens already present (stored once)
+        self.stored_tokens = 0  # insert tokens that allocated new segments
+
+    # ---- lookup ----
+
+    def _walk(self, ids, limit: int) -> list[tuple[_Node, int]]:
+        """Longest block-aligned cached prefix of ``ids[:limit]`` as
+        (node, take) entries. Pure read — no pins, no LRU touches."""
+        block = self.block
+        cap = (min(limit, len(ids)) // block) * block
+        entries: list[tuple[_Node, int]] = []
+        node, pos = self._root, 0
+        while pos + block <= cap:
+            child = node.children.get(tuple(ids[pos : pos + block]))
+            if child is None:
+                break
+            edge = child.tokens
+            n = min(len(edge), cap - pos)
+            m = (_common_len(edge[:n], tuple(ids[pos : pos + n])) // block) * block
+            if m == 0:
+                break
+            entries.append((child, m))
+            pos += m
+            if m < len(edge):
+                break  # diverged (or hit the cap) mid-edge: partial take
+            node = child
+        return entries
+
+    def match_len(self, ids, limit: int | None = None) -> int:
+        """Longest usable cached prefix length (block-aligned), without
+        pinning — the engine's admission router calls this to decide which
+        requests take the seeded path."""
+        limit = len(ids) - 1 if limit is None else limit
+        return sum(take for _, take in self._walk(ids, limit))
+
+    def match(self, ids, limit: int | None = None) -> PrefixMatch | None:
+        """Longest cached prefix of ``ids`` capped at ``limit`` tokens
+        (default len-1: the engine must always prefill at least one real
+        token for the finalize logits). Pins every node on the path and
+        refreshes its LRU stamp; returns None on no usable blocks."""
+        limit = len(ids) - 1 if limit is None else limit
+        entries = self._walk(ids, limit)
+        if not entries:
+            return None
+        stamp = next(self._clock)
+        for node, _ in entries:
+            node.refs += 1
+            node.last_used = stamp
+        return PrefixMatch(length=sum(t for _, t in entries), entries=entries)
+
+    def release(self, match: PrefixMatch) -> None:
+        for node, _ in match.entries:
+            node.refs -= 1
+
+    # ---- insert ----
+
+    def insert(self, ids, slicer: Callable[[int, int], Any]) -> int:
+        """Store the KV for ``ids`` (length MUST be a multiple of the block —
+        the engine aligns down so no padded/garbage slot is ever cached)
+        along the trie path. ``slicer(start, stop)`` returns the segment
+        pytree for slots [start, stop) of the finalized staging row; it is
+        only called for the genuinely new tail, so shared blocks cost
+        nothing. Returns the bytes added."""
+        block = self.block
+        total = len(ids)
+        if total == 0:
+            return 0
+        if total % block:
+            raise ValueError(f"insert length {total} not aligned to block {block}")
+        ids = tuple(ids)
+        stamp = next(self._clock)
+        node, pos = self._root, 0
+        added = 0
+        while pos < total:
+            child = node.children.get(ids[pos : pos + block])
+            if child is None:
+                seg = slicer(pos, total)
+                new = _Node(ids[pos:total], seg, node)
+                new.last_used = stamp
+                node.children[ids[pos : pos + block]] = new
+                self.bytes += new.nbytes
+                self.nodes += 1
+                added += new.nbytes
+                self.stored_tokens += total - pos
+                break
+            edge = child.tokens
+            n = min(len(edge), total - pos)
+            m = (_common_len(edge[:n], ids[pos : pos + n]) // block) * block
+            # the first block matched via the child key and total-pos >= block,
+            # so the aligned common run is at least one block
+            assert m >= block, "child key matched but edge diverges inside block 0"
+            if m < len(edge):
+                self._split(child, m)
+            self.dedup_tokens += m
+            child.last_used = stamp
+            pos += m
+            node = child
+        self.evict_to_budget()
+        return added
+
+    def _split(self, node: _Node, m: int) -> None:
+        """Split ``node``'s edge at slot ``m`` (block-aligned): the node
+        keeps the first m tokens/slots (its parent key stays valid — the
+        first block is unchanged); a new lower node takes the rest plus the
+        original children. Byte accounting is conserved: slot counts are
+        linear, so upper+lower bytes == the original."""
+        # a pinned node's segment must stay intact until release() — the pin
+        # contract assemble relies on. The engine releases every pin before
+        # its store-path insert (same thread), so this is unreachable there;
+        # fail loudly rather than silently truncating a pinned segment.
+        assert node.refs == 0, "cannot split a node on a pinned match path"
+        lower = _Node(node.tokens[m:], self._cut(node.segment, m, len(node.tokens)), node)
+        lower.children = node.children
+        for c in lower.children.values():
+            c.parent = lower
+        lower.last_used = node.last_used
+        upper_seg = self._cut(node.segment, 0, m)
+        self.bytes += lower.nbytes + segment_nbytes(upper_seg) - node.nbytes
+        self.nodes += 1
+        node.segment = upper_seg
+        node.nbytes = segment_nbytes(upper_seg)
+        node.tokens = node.tokens[:m]
+        node.children = {lower.tokens[: self.block]: lower}
+
+    @staticmethod
+    def _cut(segment: Any, start: int, stop: int) -> Any:
+        """Re-slice an existing segment along the capacity axis (always the
+        last axis of every segment leaf, by construction of the engine's
+        slicer)."""
+        import jax
+
+        return jax.tree_util.tree_map(lambda x: x[..., start:stop], segment)
+
+    # ---- eviction ----
+
+    def evict_to_budget(self) -> int:
+        """Drop least-recently-used unpinned leaves until within budget: ONE
+        tree walk collects the current leaves into a min-heap by LRU stamp,
+        and a parent bared by its last child's eviction joins the heap (the
+        cascade stays local via parent pointers — no per-victim re-walk on
+        the engine thread). Pinned leaves are skipped; when only pinned or
+        interior nodes remain the cache may stay over budget, which is safe.
+        Returns the number of nodes evicted."""
+        if self.budget_bytes <= 0 or self.bytes <= self.budget_bytes:
+            return 0
+        heap: list[tuple[int, int, _Node]] = []
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            for child in node.children.values():
+                if child.children:
+                    stack.append(child)
+                else:
+                    heapq.heappush(heap, (child.last_used, id(child), child))
+        evicted = 0
+        while self.bytes > self.budget_bytes and heap:
+            _, _, victim = heapq.heappop(heap)
+            if victim.refs > 0 or victim.children:
+                continue  # pinned, or became interior since collection
+            parent = victim.parent
+            assert parent is not None
+            del parent.children[victim.tokens[: self.block]]
+            self.bytes -= victim.nbytes
+            self.nodes -= 1
+            self.evicted_bytes += victim.nbytes
+            self.evictions += 1
+            evicted += 1
+            if parent is not self._root and not parent.children:
+                heapq.heappush(heap, (parent.last_used, id(parent), parent))
+        return evicted
+
+    def clear(self) -> None:
+        self._root = _Node((), None, None)
+        self.bytes = 0
+        self.nodes = 0

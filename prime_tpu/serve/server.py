@@ -480,6 +480,7 @@ def serve_model(
     draft_len: int = 4,
     overlap: bool | None = None,
     warmup: bool | None = None,
+    prefix_cache_mb: float | None = None,
 ) -> InferenceServer:
     """Bind the port, then build the (optionally sharded) generator.
 
@@ -489,7 +490,10 @@ def serve_model(
     whole-turn generation at a time behind a lock. ``overlap``/``warmup``
     (None = the PRIME_SERVE_OVERLAP / PRIME_SERVE_WARMUP env defaults)
     control the engine's one-chunk-deep decode pipeline and its AOT warmup
-    pass — docs/architecture.md "Engine pipeline"."""
+    pass — docs/architecture.md "Engine pipeline". ``prefix_cache_mb``
+    (None = the PRIME_SERVE_PREFIX_CACHE_MB env default, 0 = off) is the
+    byte budget of the radix prefix-KV cache — docs/architecture.md
+    "Prefix cache"."""
     from prime_tpu.evals.runner import JaxGenerator
 
     server = InferenceServer(model, host=host, port=port)  # fail fast on EADDRINUSE
@@ -539,6 +543,7 @@ def serve_model(
                 draft_len=draft_len,
                 overlap=overlap,
                 warmup=warmup,
+                prefix_cache_mb=prefix_cache_mb,
             )
             engine.start()
             server.generator = EngineBackend(engine, generator.tokenizer)

@@ -1,0 +1,43 @@
+"""Stdlib compatibility shims.
+
+The repo targets the jax_graft toolchain (Python 3.11+), but thin test
+containers may run 3.10, where ``tomllib`` does not exist. Importing TOML
+parsing through this module keeps every importer importable everywhere:
+
+- Python >= 3.11: the stdlib ``tomllib``;
+- 3.10 with the ``tomli`` backport installed: ``tomli`` (identical API);
+- neither: a placeholder that defers the ``ModuleNotFoundError`` to the
+  first actual parse, so importing a module that MIGHT parse TOML never
+  breaks test collection — only code paths that really parse raise, with an
+  actionable message. Tests gate on ``TOMLLIB_AVAILABLE`` (or
+  tests/_markers ``get_tomllib()`` / ``requires_tomllib``) and skip visibly.
+"""
+
+from __future__ import annotations
+
+_have_parser = True
+try:
+    import tomllib  # type: ignore[import-not-found]  # Python >= 3.11
+except ModuleNotFoundError:  # pragma: no cover — depends on the interpreter
+    try:
+        import tomli as tomllib  # type: ignore[import-not-found, no-redef]
+    except ModuleNotFoundError:
+
+        class _MissingTomllib:
+            """Defer-to-first-use stand-in for the tomllib module."""
+
+            class TOMLDecodeError(Exception):
+                """Matches the real API for ``except`` clauses; never raised
+                here — there is no parser to raise it."""
+
+            def __getattr__(self, name: str):
+                raise ModuleNotFoundError(
+                    "TOML parsing needs Python >= 3.11 (stdlib tomllib) or "
+                    "the tomli backport; neither is available in this "
+                    "environment"
+                )
+
+        tomllib = _MissingTomllib()  # type: ignore[assignment]
+        _have_parser = False
+
+TOMLLIB_AVAILABLE = _have_parser

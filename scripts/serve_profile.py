@@ -164,6 +164,10 @@ def main() -> None:
 
     _wrap(engine, "_prefill")
     _wrap(engine, "_prefill_batch")
+    # prefix-cache attribution: seed covers the radix match + the single
+    # assemble_row dispatch on hits, store the block split/insert path
+    _wrap(engine, "_prefix_seed")
+    _wrap(engine, "_store_prefix")
     if engine.overlap:
         # the pipelined loop: dispatch is host enqueue time, sync is the
         # blocked fetch — their gap is exactly what overlap bought
@@ -204,6 +208,12 @@ def main() -> None:
         f"--- engine: overlap_ratio {stats['overlap_ratio']}, host stall "
         f"{stats['host_stall_s']}s of {stats['chunk_window_s']}s window, "
         f"wasted decode tokens {stats['wasted_decode_tokens']}"
+    )
+    print(
+        f"--- prefix cache: {stats['prefix_cache_bytes'] / 1e6:.1f} MB in "
+        f"{stats['prefix_cache_nodes']} nodes, {engine.prefix_hits} hits / "
+        f"{stats['prefix_assembles']} assembles, "
+        f"{stats['prefix_evictions']} evictions"
     )
     if os.environ.get("PRIME_TRACE"):
         print(f"--- spans at {os.environ['PRIME_TRACE']}: rerun with "

@@ -8,6 +8,7 @@ order) is scaffolding on top of that invariant.
 """
 
 import json
+import time
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +32,7 @@ def _default_pipeline_env(monkeypatch):
     not silently flip every engine test onto the other code path."""
     monkeypatch.delenv("PRIME_SERVE_OVERLAP", raising=False)
     monkeypatch.delenv("PRIME_SERVE_WARMUP", raising=False)
+    monkeypatch.delenv("PRIME_SERVE_PREFIX_CACHE_MB", raising=False)
 
 
 def reference_tokens(prompt_ids: list[int], n: int) -> list[int]:
@@ -48,7 +50,7 @@ def make_engine(**kw) -> ContinuousBatchingEngine:
     kw.setdefault("max_slots", 4)
     kw.setdefault("capacity", 128)
     kw.setdefault("chunk", 4)
-    kw.setdefault("prefix_cache_size", 0)  # prefix tests opt in explicitly
+    kw.setdefault("prefix_cache_mb", 0)  # prefix tests opt in explicitly
     return ContinuousBatchingEngine(PARAMS, CONFIG, **kw)
 
 
@@ -105,7 +107,7 @@ def test_batched_admission_with_prefix_hit_in_burst():
     request through the seeded single path while the rest batch; tokens
     still match the reference for all of them."""
     base = list(range(5, 37))  # 32 tokens: above min_prefix, bucket-aligned
-    engine = make_engine(prefix_cache_size=2)
+    engine = make_engine(prefix_cache_mb=64)
     warm = engine.submit(base + [7], max_new_tokens=4)
     drain(engine, warm)
     # burst: one prefix-hitting prompt + two cold ones
@@ -139,7 +141,7 @@ def test_batched_admission_seeds_prefix_cache():
     shared-prefix burst prefix-hits from the second wave on (and the hit
     path still emits reference tokens)."""
     base = list(range(5, 37))  # 32 tokens, bucket-aligned, above min_prefix
-    engine = make_engine(prefix_cache_size=2)
+    engine = make_engine(prefix_cache_mb=64)
     wave1 = [engine.submit(base + [t], max_new_tokens=4) for t in (101, 102)]
     drain(engine, *wave1)
     assert engine.prefix_hits == 0
@@ -281,7 +283,7 @@ def test_prefix_cache_hit_matches_cold_path():
     a = shared + [7, 8, 9]
     b = shared + [100, 200]
     engine = make_engine(capacity=128, prefill_chunk=32, min_prefix=16,
-                         prefix_cache_size=4)
+                         prefix_cache_mb=64)
     ra = engine.submit(a, max_new_tokens=6)
     drain(engine, ra)
     assert engine.prefix_hits == 0
@@ -292,19 +294,140 @@ def test_prefix_cache_hit_matches_cold_path():
     assert rb.all_tokens(timeout=1) == reference_tokens(b, 6)
 
 
-def test_prefix_cache_eviction_and_identical_prompt():
+def test_prefix_cache_byte_budget_evicts_lru_and_identical_prompt():
+    """Byte-budget LRU: with room for ~2 stored prefixes, storing a third
+    evicts the LEAST RECENTLY USED one (p1 was touched by a hit, so p2
+    goes); an identical-prompt re-admission still seeds from its own
+    blocks."""
     engine = make_engine(capacity=64, prefill_chunk=32, min_prefix=16,
-                         prefix_cache_size=2)
-    p1, p2, p3 = ([1] * 20, [2] * 20, [3] * 20)
-    for p in (p1, p2, p3):
+                         prefix_cache_mb=64)
+    cache = engine.prefix_cache
+    p1, p2 = [1] * 20, [2] * 20
+    for p in (p1, p2):
         r = engine.submit(list(p), max_new_tokens=2)
         drain(engine, r)
-    assert len(engine._prefix_cache) == 2  # oldest evicted
-    # identical prompt re-admission: seeded from its own cached row
-    r = engine.submit(list(p3), max_new_tokens=4)
+    per_entry = cache.bytes // 2
+    assert per_entry > 0 and cache.nodes == 2
+    # touch p1 (a hit refreshes its LRU stamp), then shrink the budget so a
+    # third entry forces exactly one eviction
+    r = engine.submit(list(p1), max_new_tokens=2)
     drain(engine, r)
     assert engine.prefix_hits == 1
-    assert r.all_tokens(timeout=1) == reference_tokens(list(p3), 4)
+    cache.budget_bytes = int(per_entry * 2.5)
+    r = engine.submit([3] * 20, max_new_tokens=2)
+    drain(engine, r)
+    assert cache.evictions == 1 and cache.bytes <= cache.budget_bytes
+    assert engine._prefix_match_len([1] * 20) == 16  # p1 survived (recently used)
+    assert engine._prefix_match_len([2] * 20) == 0   # p2 was the LRU victim
+    assert engine.stats()["prefix_evictions"] == 1
+    # identical prompt re-admission: seeded from its own cached blocks
+    r = engine.submit([3] * 20, max_new_tokens=4)
+    drain(engine, r)
+    assert engine.prefix_hits == 2
+    assert r.all_tokens(timeout=1) == reference_tokens([3] * 20, 4)
+
+
+def test_prefix_cache_partial_hit_and_block_dedup():
+    """The radix upgrade over the flat list: two prompts sharing only a
+    32-token preamble store that preamble ONCE (bytes grow by the divergent
+    tail only), and a third prompt sharing nothing but the preamble still
+    hits at preamble length."""
+    pre = [(i * 13) % 400 + 1 for i in range(32)]
+    a = pre + [7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18, 19, 20, 21, 22]
+    b = pre + [107, 108, 109, 110, 111, 112, 113, 114, 115, 116, 117, 118,
+               119, 120, 121, 122]
+    engine = make_engine(capacity=128, prefill_chunk=32, min_prefix=16,
+                         prefix_cache_mb=64)
+    cache = engine.prefix_cache
+    ra = engine.submit(list(a), max_new_tokens=4)
+    drain(engine, ra)
+    bytes_a = cache.bytes  # 48 stored slots
+    rb = engine.submit(list(b), max_new_tokens=4)
+    drain(engine, rb)
+    # b hit the shared 32 tokens and stored only its 16-token tail: bytes are
+    # 48 + 16 slots, NOT the 96 two full-row duplicates would cost
+    assert engine.prefix_hits == 1
+    assert cache.dedup_tokens >= 32
+    assert cache.bytes == bytes_a * 64 // 48
+    c = pre + [999, 998]
+    assert engine._prefix_match_len(c) == 32  # preamble-only partial hit
+    rc = engine.submit(list(c), max_new_tokens=4)
+    drain(engine, rc)
+    assert engine.prefix_hits == 2
+    hit_hist = engine.registry.get("serve_prefix_hit_tokens").series_snapshot()
+    assert hit_hist["count"] == 2 and hit_hist["sum"] == 64.0  # 32 + 32
+    for p, r in ((a, ra), (b, rb), (c, rc)):
+        assert r.all_tokens(timeout=1) == reference_tokens(list(p), 4)
+
+
+def test_prefix_cache_refcount_blocks_eviction():
+    """A pinned match (segments mid-assembly) survives a byte-budget sweep;
+    releasing the pin makes the path evictable again."""
+    engine = make_engine(capacity=64, prefill_chunk=32, min_prefix=16,
+                         prefix_cache_mb=64)
+    cache = engine.prefix_cache
+    prompt = list(range(40, 60))
+    r = engine.submit(list(prompt), max_new_tokens=2)
+    drain(engine, r)
+    match = cache.match(prompt, limit=16)
+    assert match is not None and match.length == 16
+    cache.budget_bytes = 1  # everything must go — except the pinned path
+    assert cache.evict_to_budget() == 0
+    assert cache.bytes > 0 and cache.nodes == 1
+    cache.release(match)
+    assert cache.evict_to_budget() == 1
+    assert cache.bytes == 0 and cache.nodes == 0
+
+
+@pytest.mark.parametrize("overlap", [True, False], ids=["overlap", "sync"])
+def test_prefix_cache_bit_identity_on_off(overlap):
+    """Greedy outputs are bit-identical with the prefix cache enabled and
+    disabled, across the overlap and synchronous loops — the radix
+    cache/assemble path must be invisible in the emitted tokens. (CI runs
+    this matrix as the serve-engine smoke step.)"""
+    pre = [(i * 19) % 300 + 2 for i in range(32)]
+    prompts = [
+        pre + [7, 8, 9],
+        pre + [100, 200],          # shares the full preamble with the first
+        pre[:16] + [5, 5, 5, 5],   # shares only the first block
+        [9, 8, 7],                 # no shared prefix at all
+        pre + [7, 8, 9],           # identical replay: full-length hit
+    ]
+    outs = {}
+    for mb in (64, 0):
+        engine = make_engine(capacity=128, prefill_chunk=32, min_prefix=16,
+                             prefix_cache_mb=mb, overlap=overlap)
+        assert engine.overlap is overlap
+        outs[mb] = []
+        for p in prompts:
+            req = engine.submit(list(p), max_new_tokens=8)
+            drain(engine, req)
+            outs[mb].append(req.all_tokens(timeout=1))
+        if mb:
+            assert engine.prefix_hits >= 3  # 2nd, 3rd, and replay prompts hit
+    assert outs[64] == outs[0]
+
+
+def test_stats_snapshot_is_loop_ticked():
+    """With the engine loop running, stats() serves the end-of-tick snapshot
+    (one writer: the engine thread) instead of reading live state; a
+    synchronous owner still gets a fresh computation."""
+    engine = make_engine()
+    fresh = engine.stats()  # no thread: computed live
+    assert fresh["requests_admitted"] == 0
+    with engine:
+        req = engine.submit([1, 2, 3], max_new_tokens=4)
+        req.all_tokens(timeout=120)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            snap = engine.stats()
+            if snap["requests_completed"] == 1 and snap["active_slots"] == 0:
+                break
+            time.sleep(0.01)
+        assert snap["requests_completed"] == 1
+        assert snap["requests_admitted"] == 1
+        # the reader got the published snapshot, not a mid-tick recomputation
+        assert engine._stats_snapshot is not None
 
 
 # -- speculative continuous decoding ------------------------------------------
@@ -397,7 +520,7 @@ def test_kv_quant_engine_end_to_end():
 def test_kv_quant_prefix_cache_roundtrip():
     """Quantized staging rows (values + scales) survive the prefix cache:
     a warm admission reuses the int8 row and still completes correctly."""
-    engine = make_engine(kv_quant=True, prefix_cache_size=4, min_prefix=8)
+    engine = make_engine(kv_quant=True, prefix_cache_mb=64, min_prefix=8)
     shared = list(range(1, 17))  # 16-token shared prefix
     first = engine.submit(shared + [21, 22], max_new_tokens=4)
     while not first.done:

@@ -11,6 +11,8 @@ import pytest
 from prime_tpu.lab.agents import AgentError, AgentRuntime
 from prime_tpu.lab.mcp import build_tools, handle_request
 
+from _markers import get_tomllib
+
 # -- scripted fake agents ------------------------------------------------------
 
 SIMPLE_AGENT = textwrap.dedent(
@@ -493,7 +495,7 @@ def test_chat_choice_selection_roundtrip():
 
 
 def test_chat_launch_proposal_writes_card(tmp_path):
-    import tomllib
+    tomllib = get_tomllib()
 
     from prime_tpu.lab.tui.chat import AgentChatScreen
 
@@ -515,7 +517,7 @@ def test_chat_launch_proposal_writes_card(tmp_path):
 
 
 def test_chat_launch_kind_normalized_and_bad_kind_rejected(tmp_path):
-    import tomllib
+    tomllib = get_tomllib()
 
     from prime_tpu.lab.tui.chat import AgentChatScreen
 
@@ -660,7 +662,7 @@ def test_mcp_eval_samples_tool(tmp_path):
 def test_chat_form_edit_launch_roundtrip(tmp_path):
     """configure_run form: field edits stamp form_values, typed errors stay
     on the form, a valid enter writes the launch card (VERDICT r4 #3)."""
-    import tomllib
+    tomllib = get_tomllib()
 
     from prime_tpu.lab.tui.chat import AgentChatScreen
 

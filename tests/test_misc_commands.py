@@ -12,6 +12,8 @@ import prime_tpu.commands._deps as deps
 from prime_tpu.commands.main import cli
 from prime_tpu.testing import FakeControlPlane
 
+from _markers import requires_cryptography
+
 
 @pytest.fixture
 def fake(monkeypatch):
@@ -31,6 +33,7 @@ def runner():
 # -- login -------------------------------------------------------------------
 
 
+@requires_cryptography
 def test_login_challenge_flow_decrypts_key(runner, fake, monkeypatch):
     monkeypatch.delenv("PRIME_API_KEY")  # login must work without a key
     monkeypatch.setattr("prime_tpu.commands.login.browser_open", lambda url: True)
@@ -44,6 +47,7 @@ def test_login_challenge_flow_decrypts_key(runner, fake, monkeypatch):
     assert json.loads(result.output)["email"] == "dev@example.com"
 
 
+@requires_cryptography
 def test_login_no_browser_prints_url(runner, fake, monkeypatch):
     monkeypatch.delenv("PRIME_API_KEY")
     monkeypatch.setattr("prime_tpu.commands.login.POLL_INTERVAL_S", 0)

@@ -18,6 +18,8 @@ import sys
 
 import pytest
 
+from _markers import requires_vma
+
 
 def _free_port() -> int:
     s = socket.socket()
@@ -54,6 +56,7 @@ def _spawn_workers(num_processes: int, devices_per_process: int = 4):
 
 
 @pytest.mark.slow
+@requires_vma
 def test_two_process_distributed_smoke():
     """initialize_multihost + psum + all_gather + sharded matmul across two
     REAL processes: every check requires data to cross the process boundary."""

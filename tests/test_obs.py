@@ -270,7 +270,7 @@ def test_engine_histograms_populate_through_streamed_completion():
     config = get_config("tiny-test")
     params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
     engine = ContinuousBatchingEngine(
-        params, config, max_slots=2, capacity=128, chunk=4, prefix_cache_size=0
+        params, config, max_slots=2, capacity=128, chunk=4, prefix_cache_mb=0
     )
     with engine:
         backend = EngineBackend(engine, ByteTokenizer())
@@ -290,7 +290,8 @@ def test_engine_histograms_populate_through_streamed_completion():
                 assert "[DONE]" in body
 
             # legacy JSON: the pre-registry counter keys, plus the decode
-            # pipeline fields (PR 2: overlapped dispatch) — additive only
+            # pipeline fields (PR 2) and the radix prefix-cache fields
+            # (PR 3) — additive only
             engine_stats = httpx.get(f"{srv.url}/metrics").json()["engine"]
             assert set(engine_stats) == {
                 "requests_admitted", "requests_completed", "requests_cancelled",
@@ -298,7 +299,8 @@ def test_engine_histograms_populate_through_streamed_completion():
                 "batched_admission_waves", "active_slots", "queue_depth",
                 "overlap", "inflight_depth", "host_stall_s", "chunk_window_s",
                 "overlap_ratio", "wasted_decode_tokens", "warmup_programs",
-                "uptime_s",
+                "prefix_cache_bytes", "prefix_cache_nodes", "prefix_evictions",
+                "prefix_assembles", "uptime_s",
             }
             assert engine_stats["requests_admitted"] == 1
             assert engine_stats["requests_completed"] == 1
@@ -331,7 +333,7 @@ def test_engine_tpot_and_batch_size_histograms():
     config = get_config("tiny-test")
     params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
     engine = ContinuousBatchingEngine(
-        params, config, max_slots=4, capacity=128, chunk=4, prefix_cache_size=0
+        params, config, max_slots=4, capacity=128, chunk=4, prefix_cache_mb=0
     )
     reqs = [engine.submit([3, 1, 4, 1], max_new_tokens=5) for _ in range(2)]
     for _ in range(50):

@@ -19,7 +19,7 @@ from prime_tpu.train import (
     shard_train_state,
 )
 
-from _markers import requires_set_mesh
+from _markers import requires_set_mesh, requires_vma
 
 CFG = get_config("tiny-test")
 
@@ -66,6 +66,7 @@ def test_ring_attention_matches_dense():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
 
+@requires_vma
 def test_sharded_forward_matches_single_device():
     mesh = make_mesh({"dp": 1, "fsdp": 2, "tp": 4})
     params = init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
@@ -149,6 +150,7 @@ def test_sharded_generate_matches_single_device():
     np.testing.assert_array_equal(np.asarray(out.lengths), np.asarray(ref.lengths))
 
 
+@requires_set_mesh
 def test_jax_generator_mesh_pads_ragged_batch():
     from prime_tpu.evals.runner import JaxGenerator
 

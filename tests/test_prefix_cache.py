@@ -1,0 +1,137 @@
+"""Unit tests for the block-granular radix prefix cache (serve/prefix_cache).
+
+These drive the trie with plain numpy segments — no jax dispatch, no model —
+so the structural invariants (path compression, block alignment, split byte
+conservation, dedup, LRU eviction, refcount pins) are pinned independently
+of the engine. Engine-level integration (assemble, bit-identity, metrics)
+lives in tests/test_engine.py.
+"""
+
+import numpy as np
+import pytest
+
+from prime_tpu.serve.prefix_cache import BlockPrefixCache, segment_nbytes
+
+BLOCK = 16
+# one fake capacity-axis leaf: 4 bytes per slot keeps the byte math legible
+SLOT_BYTES = 4
+
+
+def seg_for(row: np.ndarray, start: int, stop: int) -> dict:
+    return {"k": row[..., start:stop]}
+
+
+def make_row(tokens: list[int]) -> np.ndarray:
+    # a 1 x len row whose values encode the token ids, so segment contents
+    # can be checked after splits/partial takes
+    return np.asarray([tokens], dtype=np.float32)
+
+
+def insert(cache: BlockPrefixCache, tokens: list[int]) -> int:
+    row = make_row(tokens)
+    return cache.insert(tokens, lambda a, b: seg_for(row, a, b))
+
+
+def test_insert_match_roundtrip_and_alignment():
+    cache = BlockPrefixCache(budget_bytes=1 << 20, block=BLOCK)
+    tokens = list(range(100, 148))  # 48 tokens = 3 blocks
+    insert(cache, tokens)
+    assert cache.nodes == 1 and cache.bytes == 48 * SLOT_BYTES
+    # full-path match, capped at len-1 by the caller's limit
+    m = cache.match(tokens + [7], limit=48)
+    assert m is not None and m.length == 48
+    cache.release(m)
+    # mid-edge partial: a 40-token limit aligns down to 32
+    m = cache.match(tokens, limit=40)
+    assert m is not None and m.length == 32
+    assert [t for t in m.takes()] == [32]
+    np.testing.assert_array_equal(
+        m.segments()[0]["k"][..., :32], make_row(tokens)[..., :32]
+    )
+    cache.release(m)
+    # diverging after one block matches exactly that block
+    assert cache.match_len(tokens[:16] + [1] * 32, limit=48) == 16
+    # nothing under one block
+    assert cache.match(tokens, limit=BLOCK - 1) is None
+    with pytest.raises(ValueError, match="not aligned"):
+        insert(cache, tokens[:20])
+
+
+def test_shared_prefix_dedup_and_split_conserves_bytes():
+    cache = BlockPrefixCache(budget_bytes=1 << 20, block=BLOCK)
+    pre = list(range(32))
+    a = pre + [500 + i for i in range(16)]
+    b = pre + [900 + i for i in range(16)]
+    insert(cache, a)
+    assert cache.bytes == 48 * SLOT_BYTES and cache.nodes == 1
+    insert(cache, b)
+    # the 32-token preamble is stored once: a's edge split into 32 + 16 and
+    # b added only its 16-token tail
+    assert cache.bytes == 64 * SLOT_BYTES
+    assert cache.nodes == 3
+    assert cache.dedup_tokens == 32
+    # both full paths still match, with the right segment contents
+    for tokens in (a, b):
+        m = cache.match(tokens, limit=48)
+        assert m is not None and m.length == 48
+        got = np.concatenate(
+            [seg["k"][..., :take] for seg, take in zip(m.segments(), m.takes())],
+            axis=-1,
+        )
+        np.testing.assert_array_equal(got, make_row(tokens))
+        cache.release(m)
+    # re-inserting an already-covered prompt adds nothing
+    before = cache.bytes
+    assert insert(cache, a) == 0
+    assert cache.bytes == before
+
+
+def test_byte_budget_evicts_lru_leaves_first():
+    cache = BlockPrefixCache(budget_bytes=3 * 16 * SLOT_BYTES, block=BLOCK)
+    p1, p2, p3 = [[k] * 16 for k in (1, 2, 3)]
+    insert(cache, p1)
+    insert(cache, p2)
+    cache.release(cache.match(p1 + [9], limit=16))  # touch p1: p2 is now LRU
+    insert(cache, p3)  # fits: 3 entries == budget
+    assert cache.evictions == 0
+    insert(cache, [4] * 16)  # over budget: evict exactly the LRU leaf (p2)
+    assert cache.evictions == 1
+    assert cache.match_len(p2, limit=16) == 0
+    for p in (p1, p3, [4] * 16):
+        assert cache.match_len(p, limit=16) == 16
+    assert cache.bytes <= cache.budget_bytes
+
+
+def test_eviction_cascades_to_emptied_interior_nodes():
+    cache = BlockPrefixCache(budget_bytes=1 << 20, block=BLOCK)
+    pre = list(range(32))
+    insert(cache, pre + [500 + i for i in range(16)])
+    insert(cache, pre + [900 + i for i in range(16)])
+    assert cache.nodes == 3
+    cache.budget_bytes = 1
+    assert cache.evict_to_budget() == 3  # two tails, then the bared preamble
+    assert cache.bytes == 0 and cache.nodes == 0
+
+
+def test_refcount_protects_pinned_path_from_eviction():
+    cache = BlockPrefixCache(budget_bytes=1 << 20, block=BLOCK)
+    pre = list(range(32))
+    insert(cache, pre + [500 + i for i in range(16)])
+    insert(cache, pre + [900 + i for i in range(16)])
+    pinned = cache.match(pre + [500 + i for i in range(16)], limit=48)
+    assert pinned is not None and len(pinned.entries) == 2
+    cache.budget_bytes = 1
+    # the unpinned sibling tail goes; the pinned preamble+tail survive
+    assert cache.evict_to_budget() == 1
+    assert cache.bytes == 48 * SLOT_BYTES
+    cache.release(pinned)
+    assert cache.evict_to_budget() == 2
+    assert cache.bytes == 0
+
+
+def test_segment_nbytes_counts_every_leaf():
+    seg = {
+        "k": np.zeros((2, 3, 16), dtype=np.float32),
+        "k_scale": np.zeros((2, 1, 16), dtype=np.int8),
+    }
+    assert segment_nbytes(seg) == 2 * 3 * 16 * 4 + 2 * 1 * 16

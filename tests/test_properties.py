@@ -8,6 +8,9 @@ MoE routing conservation laws, sparkline bounds.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis", reason="hypothesis not installed (env gap)")
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
