@@ -800,6 +800,123 @@ def main() -> None:
         print(f"# bench: serve shared-prefix section failed: {e}", flush=True)
     print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
+    # ---- serve fleet: 2-replica router, shared-prefix burst -----------------
+    # the multi-replica control plane (docs/architecture.md "Serve fleet"):
+    # two in-process engines behind a FleetRouter, driven over real HTTP with
+    # the same shared-preamble burst as the prefix section. Reports aggregate
+    # tok/s and the affinity hit ratio — the fraction of keyed requests the
+    # consistent-hash scheduler landed on their prefix-cache-warm replica.
+    try:
+        import concurrent.futures
+
+        import httpx
+
+        from prime_tpu.serve.engine import ContinuousBatchingEngine, EngineBackend
+        from prime_tpu.serve.fleet import serve_fleet
+        from prime_tpu.serve.server import InferenceServer
+
+        class _NumTokenizer:
+            """Whitespace-number tokenizer: HTTP text round-trips to the same
+            int ids bench feeds engines directly (non-numeric template words
+            hash to stable small ids)."""
+
+            def encode(self, text, add_special_tokens=True):
+                return [
+                    int(tok) if tok.isdigit() else (sum(tok.encode()) % 97) + 3
+                    for tok in text.split()
+                ]
+
+            def decode(self, ids):
+                return " ".join(str(i) for i in ids)
+
+        fleet_slots = max(2, serve_slots // 2)
+        # construct INSIDE the guarded block: a failed second server or
+        # router must not leak running engine threads (and their KV device
+        # allocations) into the later bench sections
+        engines: list = []
+        servers: list = []
+        router = None
+        try:
+            for _ in range(2):
+                engine = ContinuousBatchingEngine(
+                    params, config, pad_id=0, max_slots=fleet_slots,
+                    capacity=SERVE_CAPACITY, chunk=SERVE_CHUNK, prefix_cache_mb=256,
+                )
+                engine.start()
+                engines.append(engine)
+                servers.append(
+                    InferenceServer(
+                        "bench-fleet", EngineBackend(engine, _NumTokenizer()), port=0
+                    ).start()
+                )
+            router = serve_fleet(
+                [srv.url for srv in servers], poll_interval=0.2, model_id="bench-fleet",
+            )
+            pre_len = 16 if SMOKE else 64
+            preamble = " ".join(
+                str((5 * j) % (config.vocab_size - 3) + 3) for j in range(pre_len)
+            )
+            fleet_msgs = [
+                [{"role": "user", "content": preamble + " " + " ".join(
+                    str((13 * (i * 7 + j)) % (config.vocab_size - 3) + 3)
+                    for j in range(serve_prompt_len - pre_len)
+                )}]
+                for i in range(n_req)
+            ]
+
+            def fleet_post(messages, timeout=240.0):
+                response = httpx.post(
+                    f"{router.url}/v1/chat/completions",
+                    json={"messages": messages, "max_tokens": req_new, "temperature": 0.0},
+                    timeout=timeout,
+                )
+                response.raise_for_status()
+                return response.json()
+
+            # warm each replica directly (compile prefill/decode/assemble off
+            # the measured clock), then let the router's poller observe them
+            for srv in servers:
+                for _ in range(2):
+                    httpx.post(
+                        f"{srv.url}/v1/chat/completions",
+                        json={"messages": fleet_msgs[0], "max_tokens": req_new,
+                              "temperature": 0.0},
+                        timeout=240.0,
+                    ).raise_for_status()
+            time.sleep(0.5)
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+                bodies = list(pool.map(fleet_post, fleet_msgs))
+            elapsed = time.perf_counter() - t0
+            total = sum(b["usage"]["completion_tokens"] for b in bodies)
+            stats = router.stats()
+            record["serve_fleet_tok_s"] = round(total / elapsed, 1)
+            record["serve_fleet_affinity_ratio"] = stats["affinity_hit_ratio"]
+            record["serve_fleet_reroutes"] = stats["reroutes"]
+            record["serve_fleet_requests_by_replica"] = {
+                rid: sum(outcomes.values())
+                for rid, outcomes in stats["requests_by_replica"].items()
+            }
+            record["serve_fleet_obs"] = router.registry.snapshot()
+            print(
+                f"# bench: serve fleet (2 replicas) {record['serve_fleet_tok_s']} "
+                f"tok/s aggregate, affinity hit ratio "
+                f"{record['serve_fleet_affinity_ratio']}, per-replica "
+                f"{record['serve_fleet_requests_by_replica']}",
+                flush=True,
+            )
+        finally:
+            if router is not None:
+                router.stop()
+            for srv in servers:
+                srv.stop()  # also shuts down the backing engine
+            for engine in engines[len(servers):]:
+                engine.shutdown()  # engine started but its server never did
+    except Exception as e:  # noqa: BLE001
+        record["serve_fleet_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: serve fleet section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
+
     # ---- quant: int8 weights / int8 KV --------------------------------------
     try:
         from prime_tpu.models.quantize import quantize_params_int8

@@ -3,19 +3,35 @@
 OpenAI-compatible surface against ``config.inference_url``: list/retrieve
 models, chat completions with SSE streaming. Long read timeout (600 s) for
 generation; team rides the X-Prime-Team-ID header.
+
+Backpressure-aware: a serving stack with admission control (the engine's
+bounded queue, the fleet router's admission gate — docs/architecture.md
+"Serve fleet") answers 429 with a Retry-After when saturated. Chat calls
+honor that header with bounded retries (``max_429_retries``, sleep capped at
+``RETRY_AFTER_CAP``), reusing the RateLimitError plumbing in core/client.py
+— SDK callers ride out transient saturation instead of surfacing it.
+Streaming retries only before the first delta; a stream that already yielded
+tokens is never silently replayed.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
+import time
 from typing import Any, Iterator
 
 import httpx
 
 from prime_tpu.core.client import APIClient
 from prime_tpu.core.config import Config
+from prime_tpu.core.exceptions import RateLimitError
 
 INFERENCE_TIMEOUT = httpx.Timeout(600.0, connect=10.0, write=60.0)
+# Retry-After values above this are "come back much later", not "ride it
+# out": sleeping minutes inside a library call would look like a hang
+RETRY_AFTER_CAP = 30.0
+DEFAULT_429_RETRIES = 3
 
 
 class InferenceClient:
@@ -25,6 +41,7 @@ class InferenceClient:
         transport: httpx.BaseTransport | None = None,
         base_url: str | None = None,
         timeout: httpx.Timeout | None = None,
+        max_429_retries: int = DEFAULT_429_RETRIES,
     ) -> None:
         config = config or Config()
         # inference_url already includes its path prefix (e.g. /api/v1);
@@ -37,6 +54,18 @@ class InferenceClient:
             timeout=timeout or INFERENCE_TIMEOUT,
             transport=transport,
         )
+        self.max_429_retries = max(0, max_429_retries)
+
+    def _backoff_429(self, exc: RateLimitError, attempt: int) -> None:
+        """Sleep out a 429: the server's Retry-After when it sent one
+        (capped), else a small attempt-scaled fallback."""
+        if exc.retry_after is not None:
+            # clamp both ends: a hostile/buggy negative Retry-After must not
+            # turn into a time.sleep ValueError
+            delay = max(0.0, min(float(exc.retry_after), RETRY_AFTER_CAP))
+        else:
+            delay = min(0.5 * (2**attempt), RETRY_AFTER_CAP)
+        time.sleep(delay)
 
     def list_models(self) -> list[dict[str, Any]]:
         data = self.api.get("/models")
@@ -59,7 +88,13 @@ class InferenceClient:
         if temperature is not None:
             payload["temperature"] = temperature
         headers = {"X-PI-Job-Id": job_id} if job_id else None
-        return self.api.post("/chat/completions", json=payload, headers=headers)
+        for attempt in range(self.max_429_retries + 1):
+            try:
+                return self.api.post("/chat/completions", json=payload, headers=headers)
+            except RateLimitError as e:
+                if attempt == self.max_429_retries:
+                    raise
+                self._backoff_429(e, attempt)
 
     def chat_completion_stream(
         self,
@@ -68,13 +103,29 @@ class InferenceClient:
         max_tokens: int | None = None,
         temperature: float | None = None,
     ) -> Iterator[dict[str, Any]]:
-        """Yield SSE delta chunks (parsed JSON) until [DONE]."""
+        """Yield SSE delta chunks (parsed JSON) until [DONE]. A 429 raised
+        while opening the stream (before any delta) retries after its
+        Retry-After, like the non-streaming path; once bytes flow, failures
+        surface — replaying a half-delivered stream would duplicate output."""
         payload: dict[str, Any] = {"model": model, "messages": messages, "stream": True}
         if max_tokens is not None:
             payload["max_tokens"] = max_tokens
         if temperature is not None:
             payload["temperature"] = temperature
-        for line in self.api.stream_lines("POST", "/chat/completions", json=payload):
+        for attempt in range(self.max_429_retries + 1):
+            lines = self.api.stream_lines("POST", "/chat/completions", json=payload)
+            try:
+                # stream_lines raises the mapped status error on first pull
+                first = next(lines, None)
+            except RateLimitError as e:
+                if attempt == self.max_429_retries:
+                    raise
+                self._backoff_429(e, attempt)
+                continue
+            break
+        if first is None:
+            return
+        for line in itertools.chain([first], lines):
             line = line.strip()
             if not line.startswith("data:"):
                 continue

@@ -74,6 +74,28 @@ from prime_tpu.utils.render import Renderer, output_options
          "blocks are cached once and reused across admissions; 0 disables "
          "(--continuous). Default: 256 (PRIME_SERVE_PREFIX_CACHE_MB).",
 )
+@click.option(
+    "--max-queue", type=int, default=None,
+    help="Bound the engine's pending queue (--continuous): submissions past "
+         "it get 429 + Retry-After instead of queueing unboundedly. "
+         "0 = unbounded. Default: 0 (PRIME_SERVE_MAX_QUEUE).",
+)
+@click.option(
+    "--replica-of", default=None, metavar="ROUTER_URL",
+    help="Register this server with a running `prime serve fleet` router "
+         "(POST ROUTER_URL/admin/join) once the model is loaded.",
+)
+@click.option(
+    "--advertise-url", default=None, metavar="URL",
+    help="URL the fleet router should reach this replica at (--replica-of). "
+         "Required when binding 0.0.0.0: the bind address is not reachable "
+         "from another host, so it cannot be advertised.",
+)
+@click.option(
+    "--fleet-token", default=None, envvar="PRIME_FLEET_ADMIN_TOKEN",
+    help="Bearer token for the router's admin surface (--replica-of against "
+         "a router started with --admin-token).",
+)
 @click.pass_context
 def serve_cmd(
     ctx: click.Context,
@@ -98,6 +120,10 @@ def serve_cmd(
     overlap: bool | None,
     warmup: bool | None,
     prefix_cache_mb: float | None,
+    max_queue: int | None,
+    replica_of: str | None,
+    advertise_url: str | None,
+    fleet_token: str | None,
 ) -> None:
     """Serve MODEL over an OpenAI-compatible HTTP API (blocks until Ctrl-C)."""
     if ctx.invoked_subcommand is not None:
@@ -110,6 +136,14 @@ def serve_cmd(
         # silently serving bf16 at 4x the expected HBM footprint would be a
         # nasty surprise; make the dependency explicit
         raise click.UsageError("--weight-bits 4 requires --weight-quant")
+    if replica_of and advertise_url is None and host in ("0.0.0.0", "::"):
+        # pure CLI-argument error: fail BEFORE minutes of checkpoint loading.
+        # The bind-any address is meaningless to a remote router — it would
+        # route traffic to itself (or nowhere).
+        raise click.UsageError(
+            "--replica-of with --host 0.0.0.0 requires --advertise-url "
+            "(the URL the router can reach this replica at)"
+        )
 
     try:
         server = serve_model(
@@ -133,9 +167,28 @@ def serve_cmd(
             overlap=overlap,
             warmup=warmup,
             prefix_cache_mb=prefix_cache_mb,
+            max_queue=max_queue,
         )
     except (ValueError, OSError) as e:
         raise click.ClickException(str(e)) from None
+    if replica_of:
+        import httpx
+
+        try:
+            response = httpx.post(
+                f"{replica_of.rstrip('/')}/admin/join",
+                json={"url": advertise_url or server.url},
+                headers=(
+                    {"Authorization": f"Bearer {fleet_token}"} if fleet_token else None
+                ),
+                timeout=5,
+            )
+            response.raise_for_status()
+            click.echo(f"Joined fleet at {replica_of} as {response.json().get('joined')}")
+        except (httpx.HTTPError, ValueError) as e:
+            # serve anyway: the operator can join manually once the router
+            # is up (POST /admin/join {"url": ...})
+            click.echo(f"warning: could not join fleet at {replica_of}: {e}", err=True)
     click.echo(f"Serving {model} at {server.url}/v1 (Ctrl-C to stop)")
     click.echo(
         f"  e.g. PRIME_INFERENCE_URL={server.url}/v1 prime inference chat {model} -m 'hi'"
@@ -147,6 +200,95 @@ def serve_cmd(
     except KeyboardInterrupt:
         click.echo("\nStopped.")
         server.stop()
+
+
+@serve_cmd.command(name="fleet")
+@click.option(
+    "--replica", "replicas", multiple=True, metavar="URL",
+    help="Upstream replica base URL (repeatable). Replicas can also join "
+         "later via `prime serve --replica-of` or POST /admin/join.",
+)
+@click.option("--host", default="127.0.0.1", show_default=True)
+@click.option("--port", type=int, default=8080, show_default=True)
+@click.option("--model", "model_id", default=None,
+              help="Model id for /v1/models when no replica is reachable.")
+@click.option(
+    "--max-inflight", type=click.IntRange(min=1), default=64, show_default=True,
+    help="Admission control: chat requests proxied concurrently before the "
+         "router answers 429 + Retry-After.",
+)
+@click.option(
+    "--queue-wait", "queue_wait_s", type=float, default=0.25, show_default=True,
+    help="Seconds a request may wait for an in-flight permit before 429.",
+)
+@click.option(
+    "--affinity-blocks", type=click.IntRange(min=1), default=2, show_default=True,
+    help="Leading MIN_BUCKET-aligned prompt blocks hashed for prefix "
+         "affinity (same block size as the engines' prefix-KV cache).",
+)
+@click.option(
+    "--health-interval", "poll_interval", type=float, default=1.0, show_default=True,
+    help="Seconds between /healthz polls of each replica.",
+)
+@click.option(
+    "--fail-threshold", type=click.IntRange(min=1), default=3, show_default=True,
+    help="Consecutive connect failures before a replica's breaker opens.",
+)
+@click.option(
+    "--cooldown", type=float, default=5.0, show_default=True,
+    help="Seconds an open breaker waits before a half-open probe.",
+)
+@click.option(
+    "--admin-token", default=None, envvar="PRIME_FLEET_ADMIN_TOKEN",
+    help="Require `Authorization: Bearer <token>` on the mutating admin "
+         "surface (/admin/join, /admin/drain). Unset = open (loopback only!).",
+)
+def serve_fleet_cmd(
+    replicas: tuple[str, ...],
+    host: str,
+    port: int,
+    model_id: str | None,
+    max_inflight: int,
+    queue_wait_s: float,
+    affinity_blocks: int,
+    poll_interval: float,
+    fail_threshold: int,
+    cooldown: float,
+    admin_token: str | None,
+) -> None:
+    """Route an OpenAI-compatible endpoint across N engine replicas:
+    prefix-affinity scheduling (shared-prefix traffic lands on the replica
+    whose KV cache is warm), health-gated failover with circuit breaking,
+    and fleet-level admission control. See docs/architecture.md
+    "Serve fleet"."""
+    from prime_tpu.serve.fleet import FleetRouter
+
+    try:
+        router = FleetRouter(
+            replicas,
+            host=host,
+            port=port,
+            model_id=model_id,
+            max_inflight=max_inflight,
+            queue_wait_s=queue_wait_s,
+            affinity_blocks=affinity_blocks,
+            poll_interval=poll_interval,
+            fail_threshold=fail_threshold,
+            cooldown=cooldown,
+            admin_token=admin_token,
+        )
+    except OSError as e:
+        raise click.ClickException(str(e)) from None
+    click.echo(f"Fleet router at {router.url}/v1 over {len(replicas)} replica(s)")
+    click.echo(f"  join:    POST {router.url}/admin/join  {{\"url\": ...}}")
+    click.echo(f"  drain:   POST {router.url}/admin/drain?replica=<id>")
+    click.echo(f"  fleet:   {router.url}/admin/fleet")
+    click.echo(f"  metrics: {router.url}/metrics  (prometheus: {router.url}/metrics?format=prometheus)")
+    try:
+        router.serve_forever()
+    except KeyboardInterrupt:
+        click.echo("\nStopped.")
+        router.stop()
 
 
 @serve_cmd.command(name="metrics")

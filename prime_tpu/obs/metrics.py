@@ -202,6 +202,17 @@ class Histogram(_Metric):
                 return float("nan")
             return quantile_from_snapshot(list(self.buckets), series.counts, q)
 
+    def mean(self, default: float = 0.0, **labels: Any) -> float:
+        """Mean of every observed value (sum/count), or ``default`` when the
+        series is empty/absent — the engine and the fleet router both derive
+        Retry-After estimates from queue-wait means, so the arithmetic lives
+        here once instead of on both callers."""
+        with self._lock:
+            series = self._series.get(self._key(labels))
+            if series is None or series.count == 0:
+                return default
+            return series.sum / series.count
+
     def series_snapshot(self, **labels: Any) -> dict | None:
         with self._lock:
             series = self._series.get(self._key(labels))

@@ -7,6 +7,7 @@ sharded JaxGenerator the eval runner uses — the framework's own
 InferenceClient (api/inference.py) talks to it unchanged.
 """
 
+from prime_tpu.serve.errors import DrainingError, QueueFullError
 from prime_tpu.serve.server import InferenceServer, serve_model
 
 
@@ -17,13 +18,23 @@ def __getattr__(name: str):
         from prime_tpu.serve import engine
 
         return getattr(engine, name)
+    if name in ("FleetRouter", "FleetMembership", "Replica", "serve_fleet"):
+        from prime_tpu.serve import fleet
+
+        return getattr(fleet, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 __all__ = [
     "ContinuousBatchingEngine",
+    "DrainingError",
     "EngineBackend",
     "EngineRequest",
+    "FleetMembership",
+    "FleetRouter",
     "InferenceServer",
+    "QueueFullError",
+    "Replica",
+    "serve_fleet",
     "serve_model",
 ]

@@ -69,6 +69,7 @@ import numpy as np
 
 from prime_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS, DEFAULT_TOKEN_BUCKETS, Registry
 from prime_tpu.obs.trace import TRACER
+from prime_tpu.serve.errors import DrainingError, QueueFullError
 from prime_tpu.serve.prefix_cache import BlockPrefixCache
 
 MIN_BUCKET = 16
@@ -266,6 +267,7 @@ class ContinuousBatchingEngine:
         draft_len: int = 4,
         overlap: bool | None = None,
         warmup: bool | None = None,
+        max_queue: int | None = None,
         registry: Registry | None = None,
     ) -> None:
         import jax
@@ -329,6 +331,27 @@ class ContinuousBatchingEngine:
         self._active = np.zeros((max_slots,), dtype=bool)  # host-side admission map
         self._rng = jax.random.PRNGKey(0)
         self._init_device_state()
+        # submit()/shutdown() set this to wake an idle engine loop; the loop
+        # never pops the queue outside tick()'s _admit (a popped-but-unadmitted
+        # request held on the loop's stack would be invisible to `drained`)
+        self._wake = threading.Event()
+        # True while tick() runs: _admit holds popped-but-unregistered
+        # requests in locals mid-tick, so drain-completion checks must not
+        # trust the (momentarily empty) queue/slot structures until the tick
+        # finishes (GIL ordering makes the flag visible before the pop is)
+        self._tick_busy = False
+        # admission control: a bounded pending queue. submit() past the bound
+        # raises QueueFullError (the server maps it to 429 + Retry-After)
+        # instead of queueing unboundedly — under sustained overload an
+        # unbounded queue converts every request into a timeout, the worst of
+        # both worlds. 0 = unbounded (the historical behavior).
+        if max_queue is None:
+            raw_mq = os.environ.get("PRIME_SERVE_MAX_QUEUE", "").strip()
+            max_queue = int(raw_mq) if raw_mq else 0
+        self.max_queue = max(0, int(max_queue))
+        # drain: set by drain(); submit() refuses new work (DrainingError)
+        # while the loop keeps ticking until in-flight requests finish
+        self._draining = False
         self._pending: queue.Queue[EngineRequest | None] = queue.Queue()
         # requests the idle loop popped and handed back for batched
         # admission: consumed by _admit before _pending (engine thread only)
@@ -915,6 +938,15 @@ class ContinuousBatchingEngine:
     ) -> EngineRequest:
         if not prompt_ids:
             raise ValueError("empty prompt")
+        if self._draining:
+            raise DrainingError("engine is draining; not accepting new requests")
+        if self.max_queue:
+            depth = self._pending.qsize() + len(self._requeued)
+            if depth >= self.max_queue:
+                raise QueueFullError(
+                    f"pending queue is full ({depth}/{self.max_queue})",
+                    retry_after=self.retry_after_estimate(depth),
+                )
         # speculation scribbles up to draft_len+1 verify slots past a row's
         # valid length — the slot must hold them even when every draft lands
         overhead = self.draft_len + 1 if self.speculative else 0
@@ -935,7 +967,63 @@ class ContinuousBatchingEngine:
             submitted_at=time.monotonic(),
         )
         self._pending.put(req)
+        self._wake.set()
         return req
+
+    def retry_after_estimate(self, depth: int | None = None) -> float:
+        """Seconds until a retried submit is likely to be admitted: the mean
+        observed queue wait scaled by how many slot-widths of work are queued
+        ahead. Clamped to [0.1, 60] so a cold histogram still produces a
+        usable Retry-After and a pathological backlog cannot tell clients to
+        go away for an hour."""
+        if depth is None:
+            depth = self._pending.qsize() + len(self._requeued)
+        per_wave = self._m_queue_wait.mean(default=1.0)
+        waves = (depth + 1) / max(1, self.max_slots)
+        return max(0.1, min(60.0, per_wave * waves))
+
+    def drain(self) -> None:
+        """Stop taking new work (submit() raises DrainingError) while the
+        engine loop finishes every queued and in-flight request. Idempotent;
+        ``drained`` flips True once nothing is pending, admitted, or in the
+        decode pipeline. The caller (server /admin/drain, fleet router) polls
+        ``drained`` — the loop itself needs no extra wake-up because it is
+        already ticking while work remains."""
+        self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        """True when a drain has fully quiesced the engine: no queued,
+        requeued, admitted, or dispatched-but-unfetched work remains — and
+        no tick is mid-flight (a running _admit holds popped requests in
+        locals where none of those structures can see them). Safe to read
+        from any thread; a drain-gated kill must never observe True while a
+        request the engine accepted is still unfinished. Read order matters:
+        queue state before slot state, _tick_busy first AND last — a tick
+        that pops the final request between our reads either shows up as
+        busy, or has already registered the request in _requests (checked
+        later), so every interleaving reports False until truly quiet."""
+        if not self._draining or self._tick_busy:
+            return False
+        if not self._pending.empty() or self._requeued:
+            return False
+        if self._requests or self._inflight:
+            return False
+        return not self._tick_busy
+
+    def join_drain(self, timeout: float | None = 30.0) -> bool:
+        """Block until ``drained`` (polling — the engine thread owns all the
+        state being watched). Returns False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self.drained:
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.01)
+        return True
 
     def start(self) -> None:
         if self._thread is not None:
@@ -949,7 +1037,8 @@ class ContinuousBatchingEngine:
 
     def shutdown(self) -> None:
         self._running = False
-        self._pending.put(None)  # wake the engine thread
+        self._pending.put(None)  # sentinel: _pop_pending skips it
+        self._wake.set()  # wake the engine thread
         if self._thread is not None:
             self._thread.join(timeout=60)
             self._thread = None
@@ -998,20 +1087,16 @@ class ContinuousBatchingEngine:
                 sys.stderr.write(f"prime_tpu.serve.engine: warmup failed: {e}\n")
         while self._running:
             if not self.tick():
-                # idle: block until a request (or the shutdown sentinel) lands
-                try:
-                    item = self._pending.get(timeout=0.2)
-                except queue.Empty:
-                    continue
-                if item is None:
-                    continue
-                # requeue at the FRONT (arrival order preserved) and run a
-                # full tick: a burst landing while the engine idles must take
-                # the batched _admit() path — the old argmin single prefill
-                # here paid one dispatch pair per request even when the whole
-                # burst was already queued behind this item
-                self._requeue(item)
-                self.tick()
+                # idle: wait for a submit/shutdown wake rather than popping
+                # the queue here — a request popped into this frame's locals
+                # would be invisible to `drained` (and to queue-depth reads)
+                # for the instant before it was requeued, which let a
+                # drain-gated kill land on a replica that still held work.
+                # The wake costs nothing batched: the next tick's _admit
+                # drains the whole queued burst into one prefill wave, same
+                # as the old requeue-at-front path.
+                if self._wake.wait(timeout=0.2):
+                    self._wake.clear()
 
     def _requeue(self, req: EngineRequest) -> None:
         """Hand a popped request back to admission ahead of the pending
@@ -1040,9 +1125,11 @@ class ContinuousBatchingEngine:
         Every tick ends by publishing the stats() snapshot — the engine loop
         is the one writer, so HTTP readers always see a loop-consistent view.
         """
+        self._tick_busy = True
         try:
             return self._tick_inner()
         finally:
+            self._tick_busy = False
             self._refresh_stats()
 
     def _tick_inner(self) -> bool:
@@ -1657,6 +1744,9 @@ class ContinuousBatchingEngine:
             "batched_admission_waves": int(values["serve_batched_admission_waves_total"]),
             "active_slots": int(values["serve_active_slots"]),
             "queue_depth": int(values["serve_queue_depth"]),
+            "max_slots": int(self.max_slots),
+            "max_queue": int(self.max_queue),
+            "state": "draining" if self._draining else "running",
             "overlap": bool(self.overlap),
             "inflight_depth": int(values["serve_inflight_depth"]),
             "host_stall_s": round(stall, 6),
@@ -1754,6 +1844,15 @@ class EngineBackend:
             for p in prompts
         ]
         return [self.tokenizer.decode(r.all_tokens()) for r in reqs]
+
+    def drain(self) -> None:
+        """Forward the server's drain hook: stop admitting, finish in-flight
+        (docs/architecture.md "Serve fleet", drain protocol)."""
+        self.engine.drain()
+
+    @property
+    def drained(self) -> bool:
+        return self.engine.drained
 
     def shutdown(self) -> None:
         self.engine.shutdown()

@@ -1,0 +1,50 @@
+"""Typed serving errors shared by the engine, the HTTP server, and the fleet
+router.
+
+Deliberately dependency-free (no jax, no numpy, no httpx): server.py must be
+able to map these to HTTP statuses without importing the engine module, and
+the fleet router must be able to raise/catch them without a backing engine in
+the process at all.
+"""
+
+from __future__ import annotations
+
+import math
+
+__all__ = ["DrainingError", "QueueFullError", "backpressure_response"]
+
+
+def backpressure_response(
+    message: str, retry_after: float
+) -> tuple[int, dict, dict]:
+    """The ONE owner of the 429 wire contract, shared by the single-replica
+    server and the fleet router: integer delta-seconds in the Retry-After
+    header (RFC 9110 — standard clients parse it with int()), the precise
+    float in the JSON body for this repo's own tooling."""
+    return (
+        429,
+        {"error": {
+            "message": message,
+            "type": "overloaded",
+            "retry_after": round(retry_after, 3),
+        }},
+        {"Retry-After": str(math.ceil(retry_after))},
+    )
+
+
+class QueueFullError(RuntimeError):
+    """The engine's (or router's) bounded pending queue is at capacity.
+
+    ``retry_after`` is the producer's estimate of when a retry is likely to
+    be admitted, in seconds — the HTTP layers map this error to a 429
+    response with a ``Retry-After`` header carrying that value.
+    """
+
+    def __init__(self, message: str, retry_after: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after = float(retry_after)
+
+
+class DrainingError(RuntimeError):
+    """The engine is draining: in-flight requests finish, new submissions are
+    refused. The HTTP layer maps this to 503 so routers stop sending work."""

@@ -1,0 +1,36 @@
+"""Serve fleet: a multi-replica control plane over single-slice engines.
+
+Podracer-style composition (PAPERS.md): each TPU slice runs the unchanged
+single-process serve stack (engine + InferenceServer), and this package adds
+the thin layer that makes N of them one endpoint — prefix-affinity routing
+(balancer.py), health-gated membership with circuit breaking and graceful
+drain (membership.py), and the OpenAI-compatible proxy with fleet-level
+admission control (router.py). See docs/architecture.md "Serve fleet".
+"""
+
+from prime_tpu.serve.fleet.balancer import (
+    HashRing,
+    PrefixAffinityBalancer,
+    affinity_key,
+)
+from prime_tpu.serve.fleet.membership import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    FleetMembership,
+    Replica,
+)
+from prime_tpu.serve.fleet.router import FleetRouter, serve_fleet
+
+__all__ = [
+    "BREAKER_CLOSED",
+    "BREAKER_HALF_OPEN",
+    "BREAKER_OPEN",
+    "FleetMembership",
+    "FleetRouter",
+    "HashRing",
+    "PrefixAffinityBalancer",
+    "Replica",
+    "affinity_key",
+    "serve_fleet",
+]

@@ -1,0 +1,199 @@
+"""Prefix-affinity scheduling: consistent hashing over prompt-prefix blocks.
+
+The router's placement problem: the engines behind it each hold a radix
+prefix-KV cache (serve/prefix_cache.py) keyed on MIN_BUCKET-aligned token
+blocks, so a request whose prompt shares a cached prefix decodes markedly
+faster *on the replica that already holds those blocks* and gains nothing
+anywhere else. The balancer therefore routes on the same key material the
+cache indexes by:
+
+- ``affinity_key`` takes the leading ``blocks`` MIN_BUCKET-sized blocks of
+  the prompt — token ids when the router has them, a character-length proxy
+  (``CHARS_PER_TOKEN`` chars per nominal token) when it only has text, which
+  is deterministic and prefix-stable even though it is not the replica's
+  exact tokenization. Two prompts sharing a system preamble map to the same
+  key; prompts shorter than one block have no usable key (their prefill is
+  too cheap to chase).
+- ``HashRing`` is classic consistent hashing (``vnodes`` virtual points per
+  replica, SHA-1 positions): adding or draining one replica remaps only the
+  hash arcs it owned, so a membership change does not reshuffle every
+  prefix's home and invalidate every replica's warm cache at once.
+- ``PrefixAffinityBalancer.pick`` walks the ring from the key's position and
+  takes the first *routable* replica as the affinity target. A saturated
+  target (its /healthz-reported queue is backing up) falls back to the
+  least-loaded routable replica — queue depth + active slots, the same
+  fields the membership poller snapshots — because a cache hit is not worth
+  queueing behind a full box when an idle one can cold-prefill immediately.
+
+Dependency-light on purpose: hashlib + the membership module. MIN_BUCKET is
+redeclared from serve/engine.py (imported lazily there to keep this module
+jax-free) and pinned by a test so the two cannot drift.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Iterable, Sequence
+
+from prime_tpu.serve.fleet.membership import BREAKER_CLOSED, Replica
+
+# MUST equal serve.engine.MIN_BUCKET (tests/test_fleet.py pins this): the
+# affinity key is aligned to the prefix cache's block size so every prompt
+# that could share cached KV blocks shares a routing key.
+MIN_BUCKET = 16
+# crude text->token length proxy for routers fronting upstreams whose
+# tokenizer they don't have; only the block *alignment* depends on it, and
+# alignment only affects which over-short prompts get no key
+CHARS_PER_TOKEN = 4
+
+
+def affinity_key(
+    prompt: "Sequence[int] | str",
+    block: int = MIN_BUCKET,
+    blocks: int = 2,
+) -> tuple | None:
+    """The routing key: the leading ``blocks`` blocks of the prompt, block-
+    aligned exactly like the prefix cache's radix-tree edges. Token-id
+    sequences use ``block`` tokens per block; text uses ``block *
+    CHARS_PER_TOKEN`` characters. Returns None when the prompt is shorter
+    than one block (no cacheable prefix worth routing on)."""
+    if isinstance(prompt, str):
+        unit = block * CHARS_PER_TOKEN
+        usable = (len(prompt) // unit) * unit
+        if usable == 0:
+            return None
+        head = prompt[: min(usable, blocks * unit)]
+        return ("text", head)
+    usable = (len(prompt) // block) * block
+    if usable == 0:
+        return None
+    return ("ids", tuple(prompt[: min(usable, blocks * block)]))
+
+
+def _hash64(data: str) -> int:
+    """Stable 64-bit position (SHA-1 prefix): deterministic across processes
+    and Python versions, unlike builtin hash() under PYTHONHASHSEED."""
+    return int.from_bytes(hashlib.sha1(data.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes, rebuilt (and memoized) per
+    member set — fleets are a handful of replicas, so a rebuild is a few
+    hundred hashes and only happens when membership actually changes."""
+
+    def __init__(self, vnodes: int = 64) -> None:
+        self.vnodes = vnodes
+        self._members: tuple[str, ...] = ()
+        self._points: list[int] = []
+        self._owners: list[str] = []
+
+    def build(self, members: Iterable[str]) -> None:
+        members = tuple(sorted(members))
+        if members == self._members:
+            return
+        pairs = sorted(
+            (_hash64(f"{member}#{v}"), member)
+            for member in members
+            for v in range(self.vnodes)
+        )
+        self._members = members
+        self._points = [p for p, _ in pairs]
+        self._owners = [m for _, m in pairs]
+
+    def candidates(self, key: tuple) -> list[str]:
+        """Every member, ordered by ring position clockwise from the key's
+        hash: element 0 is the affinity target; the rest are the fallback
+        order a failed/saturated target hands its arc to."""
+        if not self._points:
+            return []
+        start = bisect.bisect_left(self._points, _hash64(repr(key)))
+        seen: list[str] = []
+        for i in range(len(self._owners)):
+            owner = self._owners[(start + i) % len(self._owners)]
+            if owner not in seen:
+                seen.append(owner)
+                if len(seen) == len(self._members):
+                    break
+        return seen
+
+
+class Pick:
+    """One routing decision. ``affinity`` — the request had a usable prefix
+    key; ``hit`` — it landed on its ring target (the replica most likely to
+    hold its prefix KV); ``rerouted`` — it had a target but was diverted
+    (saturation or exclusion)."""
+
+    __slots__ = ("replica", "affinity", "hit", "rerouted")
+
+    def __init__(self, replica: Replica, affinity: bool, hit: bool, rerouted: bool) -> None:
+        self.replica = replica
+        self.affinity = affinity
+        self.hit = hit
+        self.rerouted = rerouted
+
+
+def _load(replica: Replica) -> tuple:
+    # least-loaded = fewest queued + running requests; ties broken by id so
+    # the choice is deterministic under equal load
+    return (replica.queue_depth + replica.active_slots, replica.id)
+
+
+class PrefixAffinityBalancer:
+    """Pure placement policy over a FleetMembership: no sockets, no threads —
+    the router calls ``pick`` per request; tests drive it directly."""
+
+    def __init__(
+        self,
+        membership,
+        *,
+        block: int = MIN_BUCKET,
+        blocks: int = 2,
+        vnodes: int = 64,
+        saturation_depth: int = 0,
+    ) -> None:
+        self.membership = membership
+        self.block = block
+        self.blocks = blocks
+        # a replica is "saturated" once its reported queue depth exceeds
+        # this: work sent there waits behind a backlog instead of starting,
+        # so the affinity win no longer pays for the wait
+        self.saturation_depth = saturation_depth
+        self._ring = HashRing(vnodes=vnodes)
+
+    def pick(
+        self,
+        prompt: "Sequence[int] | str | None",
+        exclude: "set[str] | None" = None,
+    ) -> Pick | None:
+        """Choose a replica for one request. ``exclude`` holds replica ids
+        this request already failed against (connect error / upstream 429) —
+        the retry must go elsewhere. Returns None when no routable replica
+        remains (the router then answers 503/429)."""
+        exclude = exclude or set()
+        routable = [
+            r for r in self.membership.routable_replicas() if r.id not in exclude
+        ]
+        if not routable:
+            return None
+        # prefer replicas with a closed breaker: a half-open one is a probe
+        # target of last resort, not a general member of the rotation
+        closed = [r for r in routable if r.breaker == BREAKER_CLOSED]
+        pool = closed or routable
+        by_id = {r.id: r for r in pool}
+        key = (
+            affinity_key(prompt, block=self.block, blocks=self.blocks)
+            if prompt is not None
+            else None
+        )
+        if key is None:
+            return Pick(min(pool, key=_load), affinity=False, hit=False, rerouted=False)
+        self._ring.build(by_id.keys())
+        order = self._ring.candidates(key)
+        target = by_id[order[0]]
+        if target.queue_depth <= self.saturation_depth:
+            return Pick(target, affinity=True, hit=True, rerouted=False)
+        least = min(pool, key=_load)
+        return Pick(
+            least, affinity=True, hit=least.id == target.id, rerouted=least.id != target.id
+        )

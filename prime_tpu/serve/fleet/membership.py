@@ -1,0 +1,345 @@
+"""Fleet membership: replica registry, health polling, circuit breaking.
+
+One ``FleetMembership`` owns the set of upstream replicas behind a
+FleetRouter and the truth about which of them may receive traffic:
+
+- **Health polling.** A background thread GETs each replica's ``/healthz``
+  every ``poll_interval`` seconds and snapshots the reply onto the Replica —
+  lifecycle ``state`` (loading/ready/draining, serve/server.py), live
+  ``queue_depth`` / ``active_slots`` / ``max_slots`` (the balancer's
+  least-loaded signal). An HTTP answer of any status counts as *alive*: 503
+  means "don't send work", not "the process is gone".
+- **Circuit breaking.** Connect-level failures (refused, timeout, reset
+  before headers) — from the poller or reported by the router's own request
+  path via ``note_failure`` — increment a consecutive-failure count; at
+  ``fail_threshold`` the breaker opens and the replica drops out of routing
+  for ``cooldown`` seconds. After the cooldown it is *half-open*: the next
+  health probe (or a last-resort routed request) is the trial; success slams
+  the breaker closed, failure re-opens it for another cooldown. This is the
+  standard three-state breaker — the half-open single-trial step is what
+  stops a still-dead replica from eating a burst of real traffic every
+  cooldown expiry.
+- **Drain.** ``drain(replica_id)`` marks the replica draining locally —
+  routing excludes it immediately, so the consistent-hash ring rebalances
+  its arcs — and (best-effort) POSTs the replica's ``/admin/drain`` so it
+  finishes in-flight work and refuses new submissions itself. In-flight
+  streams are untouched: drain is about *new* work.
+
+All replica state mutates under one lock; reads used during routing
+(``routable_replicas``) take the same lock and return the Replica objects
+themselves — their scalar fields are written atomically enough for the
+balancer's heuristics, which tolerate a poll interval of staleness anyway.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Iterable
+from urllib.parse import urlsplit
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half-open"
+
+# numeric encoding for the fleet_breaker_state gauge (docs "Serve fleet")
+BREAKER_GAUGE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1, BREAKER_OPEN: 2}
+
+
+def replica_id_for(url: str) -> str:
+    """host:port of the upstream — stable across restarts of the same
+    address, which is exactly what the consistent-hash ring wants (a bounced
+    replica keeps its arcs, so its rewarmed cache reclaims its prefixes)."""
+    parts = urlsplit(url if "//" in url else f"http://{url}")
+    return parts.netloc or url
+
+
+class Replica:
+    """One upstream engine server, as the router sees it."""
+
+    def __init__(self, url: str, replica_id: str | None = None) -> None:
+        self.url = url.rstrip("/")
+        self.id = replica_id or replica_id_for(url)
+        # lifecycle as last reported by /healthz (or "unknown" before the
+        # first poll — treated as routable so a cold fleet can serve
+        # immediately; the first real request doubles as the probe)
+        self.state = "unknown"
+        self.queue_depth = 0
+        self.active_slots = 0
+        self.max_slots = 0
+        self.drained = False
+        # router-side drain is STICKY: once drain() marks the replica, a
+        # health poll must not flip it back to ready (the remote
+        # /admin/drain POST is best-effort and may never have landed);
+        # un-drain = remove + re-join (or restart the replica)
+        self.local_drain = False
+        self.last_poll_at = 0.0
+        # breaker
+        self.breaker = BREAKER_CLOSED
+        self.consecutive_failures = 0
+        self.open_until = 0.0
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "url": self.url,
+            "state": self.state,
+            "breaker": self.breaker,
+            "queue_depth": self.queue_depth,
+            "active_slots": self.active_slots,
+            "max_slots": self.max_slots,
+            "consecutive_failures": self.consecutive_failures,
+            "last_poll_age_s": (
+                round(time.monotonic() - self.last_poll_at, 3) if self.last_poll_at else None
+            ),
+        }
+
+
+class FleetMembership:
+    """Replica set + poller + breaker state machine (module docstring)."""
+
+    def __init__(
+        self,
+        urls: Iterable[str] = (),
+        *,
+        poll_interval: float = 1.0,
+        fail_threshold: int = 3,
+        cooldown: float = 5.0,
+        probe_timeout: float = 2.0,
+        admin_token: str | None = None,
+        on_change: Callable[[], None] | None = None,
+    ) -> None:
+        self._lock = threading.RLock()
+        self.replicas: dict[str, Replica] = {}
+        self.poll_interval = poll_interval
+        self.fail_threshold = max(1, fail_threshold)
+        self.cooldown = cooldown
+        self.probe_timeout = probe_timeout
+        # sent as a Bearer on remote /admin/drain POSTs — replicas started
+        # with PRIME_FLEET_ADMIN_TOKEN gate their drain endpoint on it
+        self.admin_token = admin_token
+        # router hook: bump gauges (breaker state, per-replica health) on any
+        # transition without membership importing the metrics wiring
+        self._on_change = on_change
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._client = None  # lazy httpx.Client (poller + drain POSTs only)
+        self._poll_pool = None  # lazy ThreadPoolExecutor for concurrent probes
+        for url in urls:
+            self.add(url)
+
+    # ---- membership -----------------------------------------------------
+
+    def add(self, url: str) -> Replica:
+        replica = Replica(url)
+        with self._lock:
+            existing = self.replicas.get(replica.id)
+            if existing is not None:
+                return existing
+            self.replicas[replica.id] = replica
+        self._changed()
+        return replica
+
+    def remove(self, replica_id: str) -> bool:
+        with self._lock:
+            gone = self.replicas.pop(replica_id, None) is not None
+        if gone:
+            self._changed()
+        return gone
+
+    def get(self, replica_id: str) -> Replica | None:
+        with self._lock:
+            return self.replicas.get(replica_id)
+
+    def routable_replicas(self) -> list[Replica]:
+        """Replicas that may receive NEW work right now: not draining, not
+        loading, breaker not open (an expired open transitions to half-open
+        here — time-based transitions happen at read, so routing never waits
+        on the poller to notice the cooldown lapsed)."""
+        now = time.monotonic()
+        out: list[Replica] = []
+        transitioned = False
+        with self._lock:
+            for replica in self.replicas.values():
+                if replica.state in ("draining", "loading", "down"):
+                    continue
+                if replica.breaker == BREAKER_OPEN:
+                    if now < replica.open_until:
+                        continue
+                    replica.breaker = BREAKER_HALF_OPEN
+                    transitioned = True
+                out.append(replica)
+        if transitioned:
+            self._changed()  # keep the breaker-state gauges honest
+        return out
+
+    # ---- breaker --------------------------------------------------------
+
+    def note_failure(self, replica_id: str) -> None:
+        """A connect-level failure (no HTTP response) observed against the
+        replica — by the poller or by the router's request path."""
+        with self._lock:
+            replica = self.replicas.get(replica_id)
+            if replica is None:
+                return
+            replica.consecutive_failures += 1
+            if replica.breaker == BREAKER_HALF_OPEN or (
+                replica.consecutive_failures >= self.fail_threshold
+            ):
+                # trial failed, or the threshold tripped: (re-)open
+                replica.breaker = BREAKER_OPEN
+                replica.open_until = time.monotonic() + self.cooldown
+        self._changed()
+
+    def note_success(self, replica_id: str) -> None:
+        """The replica answered an HTTP request (any status): the process is
+        alive, so the breaker closes and the failure streak resets."""
+        with self._lock:
+            replica = self.replicas.get(replica_id)
+            if replica is None:
+                return
+            if replica.consecutive_failures == 0 and replica.breaker == BREAKER_CLOSED:
+                return
+            replica.consecutive_failures = 0
+            replica.breaker = BREAKER_CLOSED
+            replica.open_until = 0.0
+        self._changed()
+
+    # ---- drain ----------------------------------------------------------
+
+    def drain(self, replica_id: str, remote: bool = True) -> bool:
+        """Mark a replica draining (routing excludes it at the next pick and
+        the ring rebalances its arcs). With ``remote``, also POST its
+        ``/admin/drain`` so the replica itself stops admitting and finishes
+        in-flight work; best-effort — an unreachable replica still drains
+        from the router's point of view."""
+        with self._lock:
+            replica = self.replicas.get(replica_id)
+            if replica is None:
+                return False
+            replica.state = "draining"
+            replica.local_drain = True
+        self._changed()
+        if remote:
+            headers = (
+                {"Authorization": f"Bearer {self.admin_token}"}
+                if self.admin_token
+                else None
+            )
+            try:
+                self._http().post(f"{replica.url}/admin/drain", headers=headers)
+            except Exception:  # noqa: BLE001 — local drain already effective
+                pass
+        return True
+
+    # ---- polling --------------------------------------------------------
+
+    def _http(self):
+        import httpx
+
+        # shared by the poller thread and router handler threads (drain):
+        # create-once under the membership lock, like the router's client
+        with self._lock:
+            if self._client is None:
+                self._client = httpx.Client(
+                    timeout=httpx.Timeout(self.probe_timeout, connect=self.probe_timeout)
+                )
+            return self._client
+
+    def poll_once(self, replica: Replica) -> None:
+        """One health probe: snapshot /healthz onto the replica, feed the
+        breaker. In the half-open state this IS the trial request."""
+        import httpx
+
+        try:
+            response = self._http().get(f"{replica.url}/healthz")
+        except httpx.HTTPError:
+            self.note_failure(replica.id)
+            return
+        body: dict[str, Any] = {}
+        try:
+            parsed = response.json()
+            if isinstance(parsed, dict):
+                body = parsed
+        except ValueError:
+            pass
+        with self._lock:
+            replica.last_poll_at = time.monotonic()
+            if replica.local_drain:
+                # sticky: even if the upstream still says "ready" (the
+                # best-effort remote drain POST may have been lost), the
+                # router keeps it out of rotation
+                replica.state = "draining"
+            else:
+                replica.state = str(
+                    body.get("state", "ready" if response.status_code == 200 else "down")
+                )
+            replica.queue_depth = int(body.get("queue_depth", 0) or 0)
+            replica.active_slots = int(body.get("active_slots", 0) or 0)
+            replica.max_slots = int(body.get("max_slots", 0) or 0)
+            replica.drained = bool(body.get("drained", False))
+        self.note_success(replica.id)
+
+    def poll_all(self) -> None:
+        """Probe every replica concurrently: a blackholed host (no RST, just
+        silence until probe_timeout) must cost the cycle one timeout, not
+        stall every other replica's breaker/load update behind it. Probes run
+        on a small persistent pool — one thread per replica per cycle would
+        churn ~poll-rate × fleet-size thread creations forever."""
+        import concurrent.futures
+
+        with self._lock:
+            replicas = list(self.replicas.values())
+        if len(replicas) <= 1:
+            for replica in replicas:
+                self.poll_once(replica)
+            return
+        with self._lock:
+            if self._poll_pool is None:
+                self._poll_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="fleet-poll"
+                )
+            pool = self._poll_pool
+        futures = [pool.submit(self.poll_once, replica) for replica in replicas]
+        # probe_timeout bounds each poll; the margin covers scheduling
+        concurrent.futures.wait(futures, timeout=self.probe_timeout + 1.0)
+
+    def start(self) -> "FleetMembership":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self.poll_all()  # synchronous first pass: route on real state at t=0
+        self._thread = threading.Thread(target=self._poll_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._lock:
+            client, self._client = self._client, None
+            pool, self._poll_pool = self._poll_pool, None
+        if client is not None:
+            client.close()
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.poll_all()
+            except Exception:  # noqa: BLE001 — the poller must never die
+                pass
+
+    def _changed(self) -> None:
+        if self._on_change is not None:
+            try:
+                self._on_change()
+            except Exception:  # noqa: BLE001 — metrics hook must not break routing
+                pass
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {rid: r.snapshot() for rid, r in self.replicas.items()}

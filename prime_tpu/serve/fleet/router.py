@@ -1,0 +1,635 @@
+"""OpenAI-compatible fleet router: N engine replicas behind one endpoint.
+
+The serve stack's control plane (docs/architecture.md "Serve fleet"):
+``FleetRouter`` binds one HTTP listener and forwards ``/v1/chat/completions``
+to one of N upstream ``InferenceServer`` replicas — in-process servers in
+tests and bench, arbitrary HTTP upstreams in production. Per request:
+
+1. **Admission.** A bounded in-flight gate (``max_inflight`` permits,
+   acquired with at most ``queue_wait_s`` of waiting). A saturated fleet
+   answers 429 with a computed ``Retry-After`` instead of queueing
+   unboundedly — same contract as the engine's own bounded pending queue,
+   one level up.
+2. **Placement.** The prefix-affinity balancer (balancer.py) consistent-
+   hashes the prompt's leading MIN_BUCKET-aligned blocks so shared-prefix
+   traffic lands on the replica whose radix prefix-KV cache already holds
+   those blocks, falling back to least-loaded when the target is saturated.
+3. **Forwarding.** The original request body is proxied verbatim. Connect-
+   level failures retry on a different replica (safe: no tokens were
+   streamed yet) and feed the membership circuit breaker; an upstream 429
+   retries on a less-loaded replica; an upstream 503 (loading/draining)
+   excludes the replica and retries. Mid-stream failures are NOT retried —
+   tokens already reached the client.
+
+Observability: the router owns a metrics Registry (per-replica
+request/outcome counters, affinity hit counters + ratio gauge, reroute
+counters by reason, breaker-state gauges, queue-wait histogram) rendered at
+``GET /metrics?format=prometheus|registry`` exactly like the single-replica
+server. ``/admin/fleet`` dumps membership; ``POST /admin/drain`` starts a
+graceful drain; ``POST /admin/join`` registers a new replica (what
+``prime serve --replica-of`` calls after binding).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Iterable
+from urllib.parse import parse_qs, urlsplit
+
+from prime_tpu.obs.metrics import Registry
+from prime_tpu.obs.trace import TRACER
+from prime_tpu.serve.errors import backpressure_response
+from prime_tpu.serve.fleet.balancer import PrefixAffinityBalancer
+from prime_tpu.serve.fleet.membership import BREAKER_GAUGE, FleetMembership
+from prime_tpu.serve.server import render_chat_prompt
+
+CHAT_PATHS = ("/v1/chat/completions", "/api/v1/chat/completions")
+
+# never forwarded upstream: hop-by-hop headers (RFC 9110 §7.6.1) plus the
+# ones httpx must own for the new connection (host/length/encoding)
+_HOP_HEADERS = frozenset(
+    (
+        "host", "content-length", "connection", "keep-alive",
+        "transfer-encoding", "upgrade", "te", "trailer",
+        "proxy-authorization", "proxy-authenticate", "accept-encoding",
+        "expect",
+    )
+)
+
+
+def _forward_headers(headers) -> dict[str, str]:
+    """Client request headers to pass through to the replica: attribution
+    and auth (X-PI-Job-Id, X-Prime-Team-ID, Authorization, ...) must survive
+    the hop — a production upstream behind the router authorizes on them."""
+    out = {
+        name: value
+        for name, value in headers.items()
+        if name.lower() not in _HOP_HEADERS
+    }
+    out.setdefault("Content-Type", "application/json")
+    return out
+
+
+class _AdmissionGate:
+    """Counting gate with a bounded wait: at most ``max_inflight`` chat
+    requests proxy concurrently; an acquire waits up to ``timeout`` seconds
+    behind them, then the caller 429s. Tracks how many threads are waiting —
+    the Retry-After estimate scales with it."""
+
+    def __init__(self, max_inflight: int) -> None:
+        self.max_inflight = max(1, max_inflight)
+        self._cond = threading.Condition()
+        self._inflight = 0
+        self.waiting = 0
+
+    def acquire(self, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            self.waiting += 1
+            try:
+                while self._inflight >= self.max_inflight:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cond.wait(timeout=remaining):
+                        if self._inflight >= self.max_inflight:
+                            return False
+                self._inflight += 1
+                return True
+            finally:
+                self.waiting -= 1
+
+    def release(self) -> None:
+        with self._cond:
+            self._inflight = max(0, self._inflight - 1)
+            self._cond.notify()
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+
+class FleetRouter:
+    """One router process fronting a replica set (module docstring)."""
+
+    def __init__(
+        self,
+        replicas: Iterable[str] = (),
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        model_id: str | None = None,
+        max_inflight: int = 64,
+        queue_wait_s: float = 0.25,
+        affinity_blocks: int = 2,
+        vnodes: int = 64,
+        saturation_depth: int = 0,
+        poll_interval: float = 1.0,
+        fail_threshold: int = 3,
+        cooldown: float = 5.0,
+        probe_timeout: float = 2.0,
+        read_timeout: float = 600.0,
+        admin_token: str | None = None,
+        membership: FleetMembership | None = None,
+    ) -> None:
+        self.model_id = model_id
+        # gate for the mutating admin surface (/admin/join registers an
+        # upstream that will then receive forwarded Authorization headers
+        # and prompt bodies; /admin/drain evicts replicas): when set, those
+        # POSTs require `Authorization: Bearer <token>`. None (the default)
+        # leaves them open — fine on loopback, NOT on a shared network.
+        self.admin_token = admin_token
+        self.membership = membership or FleetMembership(
+            replicas,
+            poll_interval=poll_interval,
+            fail_threshold=fail_threshold,
+            cooldown=cooldown,
+            probe_timeout=probe_timeout,
+            admin_token=admin_token,
+        )
+        self.membership._on_change = self._sync_gauges
+        self.balancer = PrefixAffinityBalancer(
+            self.membership,
+            blocks=affinity_blocks,
+            vnodes=vnodes,
+            saturation_depth=saturation_depth,
+        )
+        self._gate = _AdmissionGate(max_inflight)
+        self.queue_wait_s = queue_wait_s
+        self._read_timeout = read_timeout
+        self._client = None
+        self._client_lock = threading.Lock()
+
+        self.registry = Registry()
+        r = self.registry
+        self._m_requests = r.counter(
+            "fleet_requests_total",
+            "Chat requests forwarded, by replica and outcome",
+            labelnames=("replica", "outcome"),
+        )
+        self._m_affinity_requests = r.counter(
+            "fleet_affinity_requests_total",
+            "Chat requests that carried a usable prefix-affinity key",
+        )
+        self._m_affinity_hits = r.counter(
+            "fleet_affinity_hits_total",
+            "Affinity-keyed requests routed to their consistent-hash target",
+        )
+        self._m_affinity_ratio = r.gauge(
+            "fleet_affinity_hit_ratio",
+            "fleet_affinity_hits_total / fleet_affinity_requests_total",
+        )
+        self._m_reroutes = r.counter(
+            "fleet_reroutes_total",
+            "Requests diverted from their first-choice replica, by reason",
+            labelnames=("reason",),
+        )
+        self._m_breaker = r.gauge(
+            "fleet_breaker_state",
+            "Circuit state per replica: 0=closed 1=half-open 2=open",
+            labelnames=("replica",),
+        )
+        self._m_queue_wait = r.histogram(
+            "fleet_queue_wait_seconds", "Router admission-gate wait per chat request"
+        )
+        self._m_rejected = r.counter(
+            "fleet_admission_rejected_total",
+            "Chat requests answered 429 by the router's own admission gate",
+        )
+        self._m_inflight = r.gauge(
+            "fleet_inflight_requests", "Chat requests currently proxied upstream"
+        )
+        self._t0 = time.monotonic()
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args: object) -> None:  # quiet
+                pass
+
+            def _json(self, status: int, payload: dict, headers: dict | None = None) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, str(value))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _text(self, status: int, body: str, content_type: str) -> None:
+                raw = body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def do_GET(self) -> None:
+                parts = urlsplit(self.path)
+                path = parts.path
+                if path == "/healthz":
+                    payload = outer.healthz()
+                    self._json(200 if payload["state"] == "ready" else 503, payload)
+                elif path == "/livez":
+                    # liveness: the router process is up even when zero
+                    # replicas are routable (readiness is /healthz's job)
+                    self._json(200, {"status": "ok"})
+                elif path in ("/metrics", "/v1/metrics"):
+                    fmt = parse_qs(parts.query).get("format", [""])[0]
+                    if fmt == "prometheus":
+                        self._text(
+                            200,
+                            outer.registry.render_prometheus(),
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                    elif fmt == "registry":
+                        self._json(200, {"router": outer.registry.snapshot()})
+                    else:
+                        self._json(200, outer.stats())
+                elif path == "/admin/fleet":
+                    self._json(200, {"replicas": outer.membership.snapshot()})
+                elif path.endswith("/models") or "/models/" in path:
+                    status, payload = outer._proxy_models(path)
+                    self._json(status, payload)
+                else:
+                    self._json(404, {"error": {"message": f"no route {self.path}"}})
+
+            def do_POST(self) -> None:
+                parts = urlsplit(self.path)
+                path = parts.path
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(length) if length else b"{}"
+                except ValueError:
+                    self._json(400, {"error": {"message": "bad Content-Length"}})
+                    return
+                if path.startswith("/admin/"):
+                    if not outer._admin_authorized(self.headers):
+                        self._json(403, {"error": {"message": "admin token required"}})
+                        return
+                if path == "/admin/drain":
+                    target = parse_qs(parts.query).get("replica", [None])[0]
+                    if target is None:
+                        target = outer._json_field(raw, "replica")
+                    if not target or not isinstance(target, str):
+                        self._json(400, {"error": {"message": "replica id required"}})
+                        return
+                    if outer.membership.drain(target):
+                        self._json(200, {"draining": target})
+                    else:
+                        self._json(404, {"error": {"message": f"no replica {target!r}"}})
+                    return
+                if path == "/admin/join":
+                    url = outer._json_field(raw, "url")
+                    if not url or not isinstance(url, str) or not url.startswith(
+                        ("http://", "https://")
+                    ):
+                        self._json(
+                            400, {"error": {"message": "url must be an http(s) URL"}}
+                        )
+                        return
+                    replica = outer.membership.add(url)
+                    outer.membership.poll_once(replica)
+                    self._json(200, {"joined": replica.id})
+                    return
+                if path not in CHAT_PATHS:
+                    self._json(404, {"error": {"message": f"no route {self.path}"}})
+                    return
+                outer._chat(self, raw, _forward_headers(self.headers))
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    # ---- helpers ---------------------------------------------------------
+
+    def _admin_authorized(self, headers) -> bool:
+        if self.admin_token is None:
+            return True
+        return headers.get("Authorization", "") == f"Bearer {self.admin_token}"
+
+    @staticmethod
+    def _json_field(raw: bytes, field: str) -> str | None:
+        try:
+            body = json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            return None
+        return body.get(field) if isinstance(body, dict) else None
+
+    def _http(self):
+        import httpx
+
+        with self._client_lock:
+            if self._client is None:
+                self._client = httpx.Client(
+                    timeout=httpx.Timeout(
+                        self._read_timeout, connect=self.membership.probe_timeout
+                    )
+                )
+            return self._client
+
+    def _sync_gauges(self) -> None:
+        with self.membership._lock:
+            states = {r.id: r.breaker for r in self.membership.replicas.values()}
+        for rid, breaker in states.items():
+            self._m_breaker.set(BREAKER_GAUGE[breaker], replica=rid)
+
+    def _retry_after(self) -> float:
+        """Seconds a 429'd client should wait: the mean admission wait scaled
+        by the queue ahead of it, clamped like the engine's estimate."""
+        mean_wait = self._m_queue_wait.mean(default=max(self.queue_wait_s, 0.1))
+        return max(0.5, min(60.0, mean_wait * (self._gate.waiting + 1)))
+
+    # ---- proxying --------------------------------------------------------
+
+    def _proxy_models(self, path: str) -> tuple[int, dict]:
+        import httpx
+
+        for replica in self.membership.routable_replicas():
+            try:
+                response = self._http().get(f"{replica.url}{path}")
+            except httpx.HTTPError:
+                self.membership.note_failure(replica.id)
+                continue
+            self.membership.note_success(replica.id)
+            try:
+                return response.status_code, response.json()
+            except ValueError:
+                continue
+        if self.model_id:
+            return 200, {"object": "list", "data": [{"id": self.model_id, "object": "model"}]}
+        return 503, {"error": {"message": "no routable replica"}}
+
+    def _chat(self, handler, raw: bytes, headers: dict[str, str]) -> None:
+        try:
+            request = json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            handler._json(400, {"error": {"message": "invalid JSON body"}})
+            return
+        if not isinstance(request, dict):
+            handler._json(400, {"error": {"message": "request body must be an object"}})
+            return
+        messages = request.get("messages")
+        prompt = (
+            render_chat_prompt(messages)
+            if isinstance(messages, list) and all(isinstance(m, dict) for m in messages)
+            else None
+        )
+        t_wait = time.monotonic()
+        admitted = self._gate.acquire(timeout=self.queue_wait_s)
+        self._m_queue_wait.observe(time.monotonic() - t_wait)
+        if not admitted:
+            self._m_rejected.inc()
+            handler._json(
+                *backpressure_response(
+                    "fleet saturated: router admission queue is full",
+                    self._retry_after(),
+                )
+            )
+            return
+        self._m_inflight.set(self._gate.inflight)
+        try:
+            with TRACER.span("fleet.route"):
+                self._route_chat(handler, raw, prompt, headers)
+        finally:
+            self._gate.release()
+            self._m_inflight.set(self._gate.inflight)
+
+    def _route_chat(
+        self, handler, raw: bytes, prompt: str | None, headers: dict[str, str]
+    ) -> None:
+        """Pick → forward → (maybe) retry elsewhere. Retries only ever happen
+        before a single response byte reached the client, so the request is
+        replayable by construction."""
+        import httpx
+
+        excluded: set[str] = set()
+        upstream_429: tuple[int, dict, dict] | None = None
+        first_attempt = True
+        # one attempt per distinct replica, +1 for a half-open straggler that
+        # routable_replicas only exposes after a cooldown lapses mid-loop
+        for _ in range(len(self.membership.replicas) + 1):
+            pick = self.balancer.pick(prompt, excluded)
+            if pick is None:
+                break
+            replica = pick.replica
+            if first_attempt:
+                # affinity accounting covers the *placement* decision, once
+                # per request — retries are failover, not placement
+                first_attempt = False
+                if pick.affinity:
+                    self._m_affinity_requests.inc()
+                    if pick.hit:
+                        self._m_affinity_hits.inc()
+                    total = self._m_affinity_requests.value()
+                    self._m_affinity_ratio.set(
+                        self._m_affinity_hits.value() / total if total else 0.0
+                    )
+                if pick.rerouted:
+                    self._m_reroutes.inc(reason="saturated")
+            url = f"{replica.url}/v1/chat/completions"
+            try:
+                with self._http().stream("POST", url, content=raw, headers=headers) as response:
+                    if response.status_code == 429:
+                        response.read()
+                        self.membership.note_success(replica.id)
+                        self._m_requests.inc(replica=replica.id, outcome="upstream_429")
+                        self._m_reroutes.inc(reason="upstream_429")
+                        upstream_429 = self._forwardable(response)
+                        excluded.add(replica.id)
+                        continue
+                    if response.status_code == 503:
+                        # loading or draining: the poller will learn the
+                        # state soon; this request goes elsewhere now
+                        response.read()
+                        self.membership.note_success(replica.id)
+                        self._m_requests.inc(replica=replica.id, outcome="upstream_503")
+                        self._m_reroutes.inc(reason="upstream_503")
+                        excluded.add(replica.id)
+                        continue
+                    self.membership.note_success(replica.id)
+                    self._forward_response(handler, replica, response)
+                    return
+            except (httpx.ConnectError, httpx.ConnectTimeout, httpx.RemoteProtocolError):
+                # connect refused/timed out, or the replica dropped the
+                # connection before a response (a dying server closing its
+                # pooled keep-alives looks like this): either way not one
+                # response byte reached the client, so the request is safely
+                # replayable elsewhere — and the breaker learns about the
+                # dead replica. Mid-SSE failures never take this path (they
+                # are contained in _forward_response after bytes flowed).
+                self.membership.note_failure(replica.id)
+                self._m_requests.inc(replica=replica.id, outcome="connect_error")
+                self._m_reroutes.inc(reason="connect_error")
+                excluded.add(replica.id)
+                continue
+            except httpx.HTTPError as e:
+                # transport died mid-request (headers or body partially
+                # exchanged): NOT replayable — surface a 502
+                self._m_requests.inc(replica=replica.id, outcome="transport_error")
+                handler._json(
+                    502, {"error": {"message": f"upstream {replica.id} failed: {e}"}}
+                )
+                return
+        if upstream_429 is not None:
+            # every replica is shedding load: propagate the 429 (+Retry-After)
+            status, payload, headers = upstream_429
+            handler._json(status, payload, headers)
+            return
+        handler._json(503, {"error": {"message": "no routable replica in the fleet"}})
+
+    @staticmethod
+    def _forwardable(response) -> tuple[int, dict, dict]:
+        """(status, json payload, passthrough headers) of a buffered upstream
+        error response — kept so an all-replicas-429 run can propagate the
+        last replica's Retry-After."""
+        try:
+            payload = response.json()
+        except ValueError:
+            payload = {"error": {"message": response.text[:500]}}
+        headers = {}
+        if response.headers.get("Retry-After"):
+            headers["Retry-After"] = response.headers["Retry-After"]
+        return response.status_code, payload, headers
+
+    def _forward_response(self, handler, replica, response) -> None:
+        """Stream the upstream response through to the client verbatim.
+        Chunked passthrough (no buffering) so SSE token deltas reach the
+        client as the replica emits them; a client disconnect closes the
+        upstream stream, which cancels the replica-side generation."""
+        import httpx
+
+        content_type = response.headers.get("Content-Type", "application/json")
+        streaming = "text/event-stream" in content_type
+        try:
+            if streaming:
+                handler.send_response(response.status_code)
+                handler.send_header("Content-Type", content_type)
+                # HTTP/1.1 keep-alive passthrough without a known length
+                handler.send_header("Transfer-Encoding", "chunked")
+                handler.end_headers()
+                try:
+                    # iter_bytes (not iter_raw): httpx undoes any upstream
+                    # Content-Encoding, matching the headers we forward
+                    for chunk in response.iter_bytes():
+                        if chunk:
+                            handler.wfile.write(b"%x\r\n" % len(chunk) + chunk + b"\r\n")
+                except httpx.HTTPError:
+                    # upstream died mid-stream: tokens already reached the
+                    # client, so no retry — drop the connection unterminated
+                    # (a missing final chunk is the truncation signal)
+                    self._m_requests.inc(replica=replica.id, outcome="stream_error")
+                    handler.close_connection = True
+                    return
+                handler.wfile.write(b"0\r\n\r\n")
+            else:
+                body = response.read()
+                handler.send_response(response.status_code)
+                handler.send_header("Content-Type", content_type)
+                handler.send_header("Content-Length", str(len(body)))
+                if response.headers.get("Retry-After"):
+                    handler.send_header("Retry-After", response.headers["Retry-After"])
+                handler.end_headers()
+                handler.wfile.write(body)
+        except OSError:
+            # downstream client went away; closing the upstream response (the
+            # `with` in _route_chat) aborts the replica-side stream
+            self._m_requests.inc(replica=replica.id, outcome="client_disconnect")
+            return
+        self._m_requests.inc(
+            replica=replica.id,
+            outcome="ok" if response.status_code < 400 else f"http_{response.status_code}",
+        )
+
+    # ---- observability ---------------------------------------------------
+
+    def healthz(self) -> dict:
+        routable = self.membership.routable_replicas()
+        with self.membership._lock:
+            total = len(self.membership.replicas)
+        return {
+            "status": "ok",
+            "state": "ready" if routable else "unavailable",
+            "replicas": total,
+            "routable": len(routable),
+            "inflight": self._gate.inflight,
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+        }
+
+    def stats(self) -> dict:
+        """Router counters in one JSON blob (the default /metrics payload and
+        what bench/tests read): totals, affinity ratio, reroutes, per-replica
+        outcome counts, and the live membership snapshot."""
+        values = self.registry.values()
+        snapshot = self.registry.snapshot()
+        per_replica: dict[str, dict[str, int]] = {}
+        for series in snapshot["fleet_requests_total"]["series"]:
+            labels = series["labels"]
+            per_replica.setdefault(labels["replica"], {})[labels["outcome"]] = int(
+                series["value"]
+            )
+        reroutes = {
+            series["labels"]["reason"]: int(series["value"])
+            for series in snapshot["fleet_reroutes_total"]["series"]
+        }
+        return {
+            "affinity_requests": int(values["fleet_affinity_requests_total"]),
+            "affinity_hits": int(values["fleet_affinity_hits_total"]),
+            "affinity_hit_ratio": round(values["fleet_affinity_hit_ratio"], 4),
+            "admission_rejected": int(values["fleet_admission_rejected_total"]),
+            "inflight": self._gate.inflight,
+            "requests_by_replica": per_replica,
+            "reroutes": reroutes,
+            "replicas": self.membership.snapshot(),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+        }
+
+    # ---- lifecycle -------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FleetRouter":
+        self.membership.start()
+        self._sync_gauges()
+        self._serving = True
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.membership.start()
+        self._serving = True
+        self._server.serve_forever()
+
+    def stop(self) -> None:
+        if getattr(self, "_serving", False):
+            self._server.shutdown()
+            self._serving = False
+        self._server.server_close()
+        self.membership.stop()
+        with self._client_lock:
+            if self._client is not None:
+                self._client.close()
+                self._client = None
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+def serve_fleet(replicas: Iterable[str], **kwargs: Any) -> FleetRouter:
+    """Build and start a FleetRouter over ``replicas`` (upstream base URLs).
+    The `prime serve fleet` CLI and tests both enter through here."""
+    return FleetRouter(replicas, **kwargs).start()

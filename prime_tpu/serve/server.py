@@ -24,6 +24,7 @@ from __future__ import annotations
 import functools
 import inspect
 import json
+import os
 import threading
 import time
 import uuid
@@ -33,6 +34,7 @@ from urllib.parse import parse_qs, urlsplit
 
 from prime_tpu.obs.metrics import Registry
 from prime_tpu.obs.trace import TRACER
+from prime_tpu.serve.errors import DrainingError, QueueFullError, backpressure_response
 
 CHAT_TEMPLATE = "{role}: {content}\n"
 
@@ -71,8 +73,10 @@ def _route_label(path: str) -> str:
         return "/v1/models"
     if p.endswith("/metrics"):
         return "/metrics"
-    if p == "/healthz":
+    if p in ("/healthz", "/livez"):
         return "/healthz"
+    if p.startswith("/admin/"):
+        return "/admin"
     return "other"
 
 
@@ -88,13 +92,30 @@ class InferenceServer:
     """Own a generator + a ThreadingHTTPServer bound to host:port."""
 
     def __init__(
-        self, model_id: str, generator=None, host: str = "127.0.0.1", port: int = 0
+        self,
+        model_id: str,
+        generator=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        admin_token: str | None = None,
     ) -> None:
         """``generator=None`` binds the socket immediately and answers 503
         until one is assigned — serve_model uses this so a port conflict fails
-        in milliseconds, not after minutes of checkpoint loading."""
+        in milliseconds, not after minutes of checkpoint loading.
+        ``admin_token`` (None = PRIME_FLEET_ADMIN_TOKEN env, "" = open) gates
+        POST /admin/drain with `Authorization: Bearer <token>` — drain is
+        irreversible, so beyond loopback it must not be one anonymous packet."""
         self.model_id = model_id
+        self._draining = False  # set by drain(): finish in-flight, refuse new
         self.generator = generator
+        if admin_token is None:
+            admin_token = os.environ.get("PRIME_FLEET_ADMIN_TOKEN", "")
+        self.admin_token = admin_token or None
+        # chat requests currently generating/streaming in THIS server: the
+        # drain-complete signal for backends without their own `drained`
+        # (the one-shot generator path has no engine to ask)
+        self._inflight_chats = 0
+        self._inflight_lock = threading.Lock()
         self._lock = threading.Lock()  # one generation on the chip at a time
         # server-side HTTP metrics live in the server's own registry; the
         # backing engine's registry (generator.registry, when present) is
@@ -114,12 +135,16 @@ class InferenceServer:
             def log_message(self, *args: object) -> None:  # quiet
                 pass
 
-            def _json(self, status: int, payload: dict) -> None:
+            def _json(
+                self, status: int, payload: dict, headers: dict | None = None
+            ) -> None:
                 self._status_sent = status
                 body = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, str(value))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -168,7 +193,15 @@ class InferenceServer:
                     else:
                         self._json(200, outer.metrics())
                 elif path == "/healthz":
-                    self._json(200, outer.healthz())
+                    payload = outer.healthz()
+                    # routers and k8s readiness probes gate traffic on the
+                    # status code: 200 only when ready to take new work
+                    self._json(200 if payload["state"] == "ready" else 503, payload)
+                elif path == "/livez":
+                    # liveness (the old /healthz contract): always 200 while
+                    # the listener is up — loading and draining are healthy
+                    # states for a process, just not routable ones
+                    self._json(200, {"status": "ok"})
                 elif path.rstrip("/").endswith(f"/models/{outer.model_id}"):
                     self._json(200, {"id": outer.model_id, "object": "model"})
                 else:
@@ -182,6 +215,18 @@ class InferenceServer:
                     self._observe(t0)
 
             def _post(self) -> None:
+                if urlsplit(self.path).path == "/admin/drain":
+                    # graceful-drain hook (k8s preStop / fleet router): stop
+                    # taking new work, finish in-flight, report progress
+                    if outer.admin_token is not None and (
+                        self.headers.get("Authorization", "")
+                        != f"Bearer {outer.admin_token}"
+                    ):
+                        self._json(403, {"error": {"message": "admin token required"}})
+                        return
+                    outer.drain()
+                    self._json(200, outer.healthz())
+                    return
                 if self.path not in ("/v1/chat/completions", "/api/v1/chat/completions"):
                     self._json(404, {"error": {"message": f"no route {self.path}"}})
                     return
@@ -195,20 +240,28 @@ class InferenceServer:
                     self._json(400, {"error": {"message": "request body must be an object"}})
                     return
                 want_stream = bool(request.get("stream"))
+                # count the WHOLE chat lifetime (generation + streaming) so a
+                # drain only reports complete once live responses finished
+                with outer._inflight_lock:
+                    outer._inflight_chats += 1
                 try:
-                    response = outer._chat(request, stream=want_stream)
-                except Exception as e:  # noqa: BLE001 — a bad request must get a response
-                    self._json(400, {"error": {"message": f"bad request: {e}"}})
-                    return
-                if isinstance(response, tuple):  # (status, error payload)
-                    self._json(*response)
-                    return
-                if isinstance(response, _LiveStream):
-                    self._stream_live(response)
-                elif want_stream:
-                    self._stream_replay(response)
-                else:
-                    self._json(200, response)
+                    try:
+                        response = outer._chat(request, stream=want_stream)
+                    except Exception as e:  # noqa: BLE001 — a bad request must get a response
+                        self._json(400, {"error": {"message": f"bad request: {e}"}})
+                        return
+                    if isinstance(response, tuple):  # (status, error payload)
+                        self._json(*response)
+                        return
+                    if isinstance(response, _LiveStream):
+                        self._stream_live(response)
+                    elif want_stream:
+                        self._stream_replay(response)
+                    else:
+                        self._json(200, response)
+                finally:
+                    with outer._inflight_lock:
+                        outer._inflight_chats -= 1
 
             def _sse_headers(self) -> None:
                 self._status_sent = 200
@@ -269,6 +322,22 @@ class InferenceServer:
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None
 
+    @property
+    def generator(self):
+        return self._generator
+
+    @generator.setter
+    def generator(self, generator) -> None:
+        """serve_model assigns the generator AFTER the socket is bound (and
+        minutes of checkpoint loading). If a drain arrived in that window,
+        forward it now — otherwise the engine never learns it should refuse
+        work and healthz `drained` could never flip true."""
+        self._generator = generator
+        if self._draining and generator is not None:
+            drain_fn = getattr(generator, "drain", None)
+            if callable(drain_fn):
+                drain_fn()
+
     # -- observability --------------------------------------------------------
 
     def metrics(self) -> dict:
@@ -325,20 +394,79 @@ class InferenceServer:
         return payload
 
     def healthz(self) -> dict:
-        """GET /healthz: liveness for load balancers / scrapers. Always 200
-        while the listener is up; ``loaded`` distinguishes the still-loading
-        window (serve_model binds the socket before the checkpoint loads)."""
-        return {
+        """GET /healthz: readiness for routers / k8s probes. ``state`` is the
+        replica lifecycle — ``loading`` (socket bound, checkpoint still
+        loading), ``ready``, or ``draining`` (finishing in-flight, refusing
+        new work) — and the HTTP handler returns 503 for anything but
+        ``ready`` so traffic gates on the status code alone. ``queue_depth``
+        / ``active_slots`` / ``max_slots`` come from the backing engine's
+        stats() snapshot when present; the fleet balancer's least-loaded
+        fallback reads them from here."""
+        if self.generator is None:
+            state = "loading"
+        elif self._draining:
+            state = "draining"
+        else:
+            state = "ready"
+        payload = {
             "status": "ok",
+            "state": state,
             "loaded": self.generator is not None,
+            "queue_depth": 0,
+            "active_slots": 0,
+            "max_slots": 0,
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
+        stats_fn = getattr(self.generator, "stats", None)
+        if callable(stats_fn):
+            try:
+                stats = stats_fn()
+                for key in ("queue_depth", "active_slots", "max_slots"):
+                    payload[key] = int(stats.get(key, 0))
+            except Exception as e:  # noqa: BLE001 — health must never 500
+                payload["stats_error"] = str(e)[:200]
+        if self._draining:
+            # a drain is complete when nothing is queued or decoding — the
+            # fleet router (and a preStop hook's poll loop) watch this flag.
+            # Backends without a `drained` property (one-shot generators)
+            # fall back to the server's own in-flight chat count. The count
+            # is ALSO required alongside an engine's drained flag: the
+            # engine retires a request once every token is queued, but the
+            # HTTP thread may still be flushing those tokens to a slow SSE
+            # client — killing then would truncate the stream drain promised
+            # to finish.
+            drained = getattr(self.generator, "drained", None)
+            if drained is None:
+                drained = (
+                    payload["queue_depth"] == 0 and payload["active_slots"] == 0
+                )
+            payload["drained"] = bool(drained) and self._inflight_chats == 0
+        return payload
+
+    def drain(self) -> None:
+        """Stop accepting new chat requests (503) while in-flight ones —
+        including live SSE streams — run to completion. Forwards to the
+        generator's drain hook when it has one (the continuous-batching
+        engine stops admitting and finishes its slots). Idempotent."""
+        self._draining = True
+        drain_fn = getattr(self.generator, "drain", None)
+        if callable(drain_fn):
+            drain_fn()
 
     # -- request handling -----------------------------------------------------
+
+    @staticmethod
+    def _backpressure(e: QueueFullError):
+        """429 + Retry-After: the engine's bounded queue refused the request.
+        Clients (api/inference.py) honor the header with bounded retries; the
+        fleet router treats it as a signal to try a less-loaded replica."""
+        return backpressure_response(f"server overloaded: {e}", e.retry_after)
 
     def _chat(self, request: dict, stream: bool = False):
         if self.generator is None:
             return 503, {"error": {"message": "model is still loading"}}
+        if self._draining:
+            return 503, {"error": {"message": "server is draining", "type": "draining"}}
         messages = request.get("messages")
         if (
             not isinstance(messages, list)
@@ -386,6 +514,10 @@ class InferenceServer:
                     prompt, max_new_tokens=max_tokens, temperature=temperature,
                     top_p=top_p, templated=templated,
                 )
+            except QueueFullError as e:
+                return self._backpressure(e)
+            except DrainingError:
+                return 503, {"error": {"message": "server is draining", "type": "draining"}}
             except Exception as e:  # noqa: BLE001
                 return 500, {"error": {"message": f"generation failed: {e}"}}
             return _LiveStream(self.generator.stream_text(req), request=req)
@@ -400,6 +532,10 @@ class InferenceServer:
                         completion = self.generator.generate(
                             [prompt], max_new_tokens=max_tokens, temperature=temperature, **kwargs
                         )[0]
+        except QueueFullError as e:
+            return self._backpressure(e)
+        except DrainingError:
+            return 503, {"error": {"message": "server is draining", "type": "draining"}}
         except Exception as e:  # noqa: BLE001 — surface as an API error, keep serving
             return 500, {"error": {"message": f"generation failed: {e}"}}
         return {
@@ -481,6 +617,8 @@ def serve_model(
     overlap: bool | None = None,
     warmup: bool | None = None,
     prefix_cache_mb: float | None = None,
+    max_queue: int | None = None,
+    admin_token: str | None = None,
 ) -> InferenceServer:
     """Bind the port, then build the (optionally sharded) generator.
 
@@ -493,10 +631,14 @@ def serve_model(
     pass — docs/architecture.md "Engine pipeline". ``prefix_cache_mb``
     (None = the PRIME_SERVE_PREFIX_CACHE_MB env default, 0 = off) is the
     byte budget of the radix prefix-KV cache — docs/architecture.md
-    "Prefix cache"."""
+    "Prefix cache". ``max_queue`` (None = the PRIME_SERVE_MAX_QUEUE env
+    default, 0 = unbounded) bounds the engine's pending queue: submissions
+    past it get 429 + Retry-After instead of queueing unboundedly — the
+    admission-control half of docs/architecture.md "Serve fleet"."""
     from prime_tpu.evals.runner import JaxGenerator
 
-    server = InferenceServer(model, host=host, port=port)  # fail fast on EADDRINUSE
+    # fail fast on EADDRINUSE; admin_token=None reads PRIME_FLEET_ADMIN_TOKEN
+    server = InferenceServer(model, host=host, port=port, admin_token=admin_token)
     try:
         generator = JaxGenerator(
             model,
@@ -544,6 +686,7 @@ def serve_model(
                 overlap=overlap,
                 warmup=warmup,
                 prefix_cache_mb=prefix_cache_mb,
+                max_queue=max_queue,
             )
             engine.start()
             server.generator = EngineBackend(engine, generator.tokenizer)

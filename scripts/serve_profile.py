@@ -18,6 +18,11 @@ Two modes:
   fetching the tokens (``serve.sync``), and the host-stall fraction of the
   dispatch→sync window. A well-overlapped engine shows stall fractions near
   zero; ~1.0 means the loop is effectively synchronous.
+- ``python scripts/serve_profile.py --fleet http://router:8080`` — scrape a
+  running `prime serve fleet` router and print the routing report: request
+  distribution and outcomes per replica, affinity hit ratio (the fraction of
+  keyed requests the consistent-hash scheduler landed on their prefix-warm
+  replica), reroute reasons, breaker states, and admission-gate queue waits.
 """
 
 from __future__ import annotations
@@ -112,6 +117,47 @@ def overlap_report(path: str) -> None:
     )
 
 
+def fleet_report(url: str) -> None:
+    """Scrape a FleetRouter's /metrics and /admin/fleet and print where the
+    traffic went and why — the first question when fleet throughput
+    disappoints is 'did affinity routing actually concentrate the shared
+    prefixes, or did saturation/reroutes scatter them?'."""
+    import httpx
+
+    base = url.rstrip("/")
+    stats = httpx.get(f"{base}/metrics", timeout=10).json()
+    print(f"--- fleet routing report: {base}")
+    print(
+        f"affinity: {stats['affinity_hits']}/{stats['affinity_requests']} keyed "
+        f"requests hit their hash target (ratio {stats['affinity_hit_ratio']})"
+    )
+    rejected = stats.get("admission_rejected", 0)
+    if rejected:
+        print(f"admission gate rejected {rejected} requests (fleet saturated)")
+    if stats.get("reroutes"):
+        reasons = ", ".join(f"{k}={v}" for k, v in sorted(stats["reroutes"].items()))
+        print(f"reroutes: {reasons}")
+    print(f"{'replica':>24} {'state':>9} {'breaker':>9} {'queue':>6} {'slots':>8} requests")
+    for rid, replica in sorted(stats.get("replicas", {}).items()):
+        outcomes = stats.get("requests_by_replica", {}).get(rid, {})
+        shown = ", ".join(f"{k}={v}" for k, v in sorted(outcomes.items())) or "-"
+        slots = f"{replica['active_slots']}/{replica['max_slots'] or '?'}"
+        print(
+            f"{rid:>24} {replica['state']:>9} {replica['breaker']:>9} "
+            f"{replica['queue_depth']:>6} {slots:>8} {shown}"
+        )
+    registry = httpx.get(f"{base}/metrics", params={"format": "registry"}, timeout=10).json()
+    wait = next(
+        (s for s in registry["router"]["fleet_queue_wait_seconds"]["series"] if s["count"]),
+        None,
+    )
+    if wait:
+        print(
+            f"admission queue wait: {wait['count']} requests, "
+            f"mean {wait['sum'] / wait['count'] * 1e3:.2f} ms"
+        )
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -119,9 +165,17 @@ def main() -> None:
         help="Print the dispatch-vs-sync overlap report from a PRIME_TRACE "
              "JSONL instead of running the profile.",
     )
+    parser.add_argument(
+        "--fleet", metavar="ROUTER_URL", default=None,
+        help="Print the routing report scraped from a running "
+             "`prime serve fleet` router instead of running the profile.",
+    )
     args = parser.parse_args()
     if args.trace:
         overlap_report(args.trace)
+        return
+    if args.fleet:
+        fleet_report(args.fleet)
         return
 
     import jax

@@ -1075,3 +1075,52 @@ def test_engine_gemma_style_window_softcap_matches_sampler():
     drain(engine, *reqs)
     for req, ref in zip(reqs, refs):
         assert req.all_tokens(timeout=1) == ref
+
+
+def test_submit_bounded_queue_raises_queue_full():
+    """max_queue bounds the pending queue: submissions past it get the typed
+    QueueFullError (the 429 the server maps it to carries retry_after)."""
+    from prime_tpu.serve.errors import QueueFullError
+
+    engine = make_engine(max_queue=2)
+    # not started: nothing consumes the queue, so the bound is exact
+    engine.submit([1, 2, 3], max_new_tokens=4)
+    engine.submit([1, 2, 4], max_new_tokens=4)
+    with pytest.raises(QueueFullError) as excinfo:
+        engine.submit([1, 2, 5], max_new_tokens=4)
+    assert excinfo.value.retry_after > 0
+    assert engine.stats()["max_queue"] == 2
+    # working the queue down reopens admission
+    for _ in range(40):
+        engine.tick()
+        stats = engine.stats()
+        if stats["queue_depth"] == 0 and stats["active_slots"] == 0:
+            break
+    engine.submit([1, 2, 6], max_new_tokens=4)
+
+
+def test_drain_finishes_inflight_then_refuses_new_work():
+    """drain(): in-flight requests decode to completion, new submits raise
+    DrainingError, and `drained` flips once the engine is quiescent."""
+    from prime_tpu.serve.errors import DrainingError
+
+    engine = make_engine()
+    req = engine.submit([1, 5, 9, 2], max_new_tokens=6)
+    engine.tick()  # admit
+    engine.drain()
+    assert engine.stats()["state"] == "draining"
+    with pytest.raises(DrainingError):
+        engine.submit([1, 2, 3], max_new_tokens=4)
+    drain(engine, req)  # the in-flight request still completes
+    assert req.done and req.error is None
+    assert len(req.all_tokens(timeout=1)) == 6
+    engine.tick()  # retire the lookahead chunk
+    assert engine.drained
+
+
+def test_max_queue_env_default(monkeypatch):
+    monkeypatch.setenv("PRIME_SERVE_MAX_QUEUE", "7")
+    engine = make_engine()
+    assert engine.max_queue == 7
+    monkeypatch.delenv("PRIME_SERVE_MAX_QUEUE")
+    assert make_engine().max_queue == 0  # unbounded by default

@@ -1,0 +1,568 @@
+"""Serve fleet: prefix-affinity routing, failover, drain, admission control.
+
+All fake upstreams (real InferenceServer processes over scripted backends, no
+TPU): the load-bearing properties are (1) shared-prefix traffic concentrates
+on one replica deterministically, (2) a replica dying mid-burst loses zero
+un-streamed requests, (3) drain finishes in-flight streams while new work
+reroutes, (4) saturation surfaces as 429 + Retry-After end-to-end instead of
+unbounded queueing.
+"""
+
+import threading
+import time
+from contextlib import contextmanager
+
+import httpx
+import pytest
+
+from prime_tpu.serve import InferenceServer
+from prime_tpu.serve.errors import QueueFullError
+from prime_tpu.serve.fleet import (
+    BREAKER_CLOSED,
+    BREAKER_OPEN,
+    FleetMembership,
+    HashRing,
+    PrefixAffinityBalancer,
+    affinity_key,
+    serve_fleet,
+)
+from prime_tpu.serve.fleet import balancer as balancer_mod
+
+# long enough for a text affinity key (>= MIN_BUCKET * CHARS_PER_TOKEN chars)
+PREAMBLE = "You are a terse and helpful assistant for the fleet routing test. " * 3
+
+
+class FleetBackend:
+    """Scripted replica backend: replies with its own name so tests can see
+    exactly where the router sent each request."""
+
+    concurrent = True
+
+    def __init__(self, name: str, delay: float = 0.0):
+        self.name = name
+        self.delay = delay
+        self.calls: list[str] = []
+        self.queue_depth = 0
+        self.active_slots = 0
+        self.max_slots = 8
+        self.submit_error: Exception | None = None
+
+    def stats(self):
+        return {
+            "queue_depth": self.queue_depth,
+            "active_slots": self.active_slots,
+            "max_slots": self.max_slots,
+        }
+
+    def generate(self, prompts, max_new_tokens, temperature, top_p=1.0, templated=False):
+        if self.submit_error is not None:
+            raise self.submit_error
+        self.calls.append(prompts[0])
+        if self.delay:
+            time.sleep(self.delay)
+        return [self.name] * len(prompts)
+
+
+@contextmanager
+def make_fleet(backends, **router_kw):
+    router_kw.setdefault("poll_interval", 0.05)
+    router_kw.setdefault("model_id", "tiny-test")
+    servers = [InferenceServer("tiny-test", b, port=0).start() for b in backends]
+    router = serve_fleet([srv.url for srv in servers], **router_kw)
+    try:
+        yield router, servers
+    finally:
+        router.stop()
+        for srv in servers:
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001 — a test may have stopped one already
+                pass
+
+
+def chat(url: str, content: str, timeout: float = 30.0) -> httpx.Response:
+    return httpx.post(
+        f"{url}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": content}]},
+        timeout=timeout,
+    )
+
+
+# ---- balancer units ---------------------------------------------------------
+
+
+def test_affinity_block_matches_engine_min_bucket():
+    """The routing key's block size must equal the engine prefix cache's
+    MIN_BUCKET — same alignment, or prompts that share cached KV blocks
+    would not share a routing key."""
+    from prime_tpu.serve.engine import MIN_BUCKET
+
+    assert balancer_mod.MIN_BUCKET == MIN_BUCKET
+
+
+def test_affinity_key_alignment_and_sharing():
+    # token ids: block-aligned, capped at `blocks` blocks
+    assert affinity_key(list(range(15))) is None  # under one block
+    a = affinity_key(list(range(64)))
+    b = affinity_key(list(range(32)) + [99] * 32)
+    assert a == b  # same leading 2 blocks -> same key
+    assert affinity_key(list(range(32))) == a  # exactly the cap
+    # text: shares the leading blocks -> shares the key; short text -> None
+    assert affinity_key("x") is None
+    assert affinity_key(PREAMBLE + "tail one") == affinity_key(PREAMBLE + "other tail")
+    # deterministic across calls (sha1, not PYTHONHASHSEED-dependent)
+    assert affinity_key(PREAMBLE + "q") == affinity_key(PREAMBLE + "q")
+
+
+def test_hash_ring_minimal_remap():
+    """Consistent hashing: removing one member only remaps the keys that
+    member owned — everyone else's affinity target survives the change."""
+    ring = HashRing(vnodes=64)
+    ring.build(["a:1", "b:2", "c:3"])
+    keys = [("ids", (i,) * 32) for i in range(200)]
+    owners = {k: ring.candidates(k)[0] for k in keys}
+    ring2 = HashRing(vnodes=64)
+    ring2.build(["a:1", "c:3"])
+    for key, owner in owners.items():
+        if owner != "b:2":
+            assert ring2.candidates(key)[0] == owner
+
+
+def test_balancer_least_loaded_fallback_on_saturation():
+    m = FleetMembership(["http://127.0.0.1:1", "http://127.0.0.1:2"])
+    b = PrefixAffinityBalancer(m)
+    target = b.pick(PREAMBLE).replica
+    other = next(r for r in m.replicas.values() if r.id != target.id)
+    # saturate the affinity target: queued work means new requests wait
+    target.queue_depth = 3
+    pick = b.pick(PREAMBLE)
+    assert pick.replica.id == other.id
+    assert pick.rerouted and pick.affinity and not pick.hit
+    # unsaturated again: back to the hash target (cache affinity restored)
+    target.queue_depth = 0
+    assert b.pick(PREAMBLE).replica.id == target.id
+
+
+def test_balancer_excludes_failed_replica():
+    m = FleetMembership(["http://127.0.0.1:1", "http://127.0.0.1:2"])
+    b = PrefixAffinityBalancer(m)
+    first = b.pick(PREAMBLE).replica
+    retry = b.pick(PREAMBLE, exclude={first.id})
+    assert retry is not None and retry.replica.id != first.id
+    assert b.pick(PREAMBLE, exclude={r.id for r in m.replicas.values()}) is None
+
+
+def test_router_side_drain_is_sticky_across_polls():
+    """A drained replica must stay out of rotation even when the remote
+    /admin/drain POST never landed and its /healthz keeps answering ready."""
+    backend = FleetBackend("replica-a")
+    server = InferenceServer("tiny-test", backend, port=0).start()
+    try:
+        m = FleetMembership([server.url])
+        rid = next(iter(m.replicas))
+        m.drain(rid, remote=False)  # the replica itself was never told
+        m.poll_once(m.replicas[rid])  # upstream still reports ready
+        assert m.replicas[rid].state == "draining"
+        assert rid not in {r.id for r in m.routable_replicas()}
+    finally:
+        server.stop()
+
+
+def test_breaker_opens_after_threshold_and_half_opens_after_cooldown():
+    m = FleetMembership(
+        ["http://127.0.0.1:9", "http://127.0.0.1:10"],
+        fail_threshold=3, cooldown=0.1,
+    )
+    dead = next(iter(m.replicas.values()))
+    for _ in range(2):
+        m.note_failure(dead.id)
+    assert dead.breaker == BREAKER_CLOSED  # under threshold
+    m.note_failure(dead.id)
+    assert dead.breaker == BREAKER_OPEN
+    assert dead.id not in {r.id for r in m.routable_replicas()}
+    time.sleep(0.15)
+    # cooldown lapsed: half-open, routable as a trial
+    assert dead.id in {r.id for r in m.routable_replicas()}
+    # trial failure re-opens immediately (no need for a full new streak)
+    m.note_failure(dead.id)
+    assert dead.breaker == BREAKER_OPEN
+    time.sleep(0.15)
+    m.routable_replicas()  # half-open again
+    m.note_success(dead.id)
+    assert dead.breaker == BREAKER_CLOSED and dead.consecutive_failures == 0
+
+
+# ---- routing over live fake replicas ---------------------------------------
+
+
+def test_affinity_routing_concentrates_shared_prefix():
+    """The acceptance bar: a shared-prefix burst routes >= 90% of requests to
+    ONE replica, and the router's metrics expose the hit ratio."""
+    a, b = FleetBackend("replica-a"), FleetBackend("replica-b")
+    with make_fleet([a, b]) as (router, _servers):
+        replies = [
+            chat(router.url, f"{PREAMBLE} question {i}").json()["choices"][0]["message"]["content"]
+            for i in range(20)
+        ]
+        top = max(replies.count("replica-a"), replies.count("replica-b"))
+        assert top >= 18  # >= 90% on one replica (sha1 target: actually all 20)
+        stats = router.stats()
+        assert stats["affinity_requests"] == 20
+        assert stats["affinity_hit_ratio"] >= 0.9
+        # the ratio is also a scrape-able gauge
+        text = httpx.get(f"{router.url}/metrics", params={"format": "prometheus"}).text
+        assert "fleet_affinity_hit_ratio" in text
+
+
+def test_distinct_prefixes_spread_across_replicas():
+    a, b = FleetBackend("replica-a"), FleetBackend("replica-b")
+    with make_fleet([a, b]) as (router, _servers):
+        for i in range(16):
+            prefix = f"System prompt variant {i}: " + f"filler-{i} " * 12
+            assert chat(router.url, prefix + "q").status_code == 200
+        assert a.calls and b.calls  # consistent hashing spread the keys
+
+
+def test_failover_mid_burst_loses_no_requests():
+    """Kill the replica carrying the affinity traffic mid-burst: every
+    un-streamed request must reroute to the survivor and succeed."""
+    a, b = FleetBackend("replica-a", delay=0.02), FleetBackend("replica-b", delay=0.02)
+    with make_fleet([a, b], fail_threshold=2, cooldown=5.0) as (router, servers):
+        # find the affinity target with one probe request
+        probe = chat(router.url, f"{PREAMBLE} probe").json()
+        victim_name = probe["choices"][0]["message"]["content"]
+        victim_srv = servers[0] if victim_name == "replica-a" else servers[1]
+
+        results: list[str] = []
+        errors: list[Exception] = []
+        lock = threading.Lock()
+
+        def worker(i: int) -> None:
+            try:
+                response = chat(router.url, f"{PREAMBLE} burst {i}", timeout=30)
+                assert response.status_code == 200, response.text
+                name = response.json()["choices"][0]["message"]["content"]
+                with lock:
+                    results.append(name)
+            except Exception as e:  # noqa: BLE001 — collected for the assert
+                with lock:
+                    errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(24)]
+        for i, t in enumerate(threads):
+            t.start()
+            if i == 7:
+                victim_srv.stop()  # mid-burst: later connects get refused
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert len(results) == 24  # zero lost requests
+        survivor = "replica-b" if victim_name == "replica-a" else "replica-a"
+        assert survivor in results  # the survivor picked up rerouted work
+        stats = router.stats()
+        # the dead replica was detected either by a live request taking the
+        # connect-error reroute path or by the 0.05s health poller tripping
+        # the breaker first — which one wins is a race, both are correct, and
+        # either way the breaker has accumulated the failure streak by now
+        assert stats["replicas"][_rid(victim_srv)]["breaker"] == BREAKER_OPEN
+
+
+def _rid(server: InferenceServer) -> str:
+    from prime_tpu.serve.fleet.membership import replica_id_for
+
+    return replica_id_for(server.url)
+
+
+# ---- drain ------------------------------------------------------------------
+
+
+class StreamingBackend(FleetBackend):
+    """Backend with true live streaming: deltas trickle out so a drain can
+    land mid-stream."""
+
+    def __init__(self, name: str, n_deltas: int = 6, delta_s: float = 0.05):
+        super().__init__(name)
+        self.n_deltas = n_deltas
+        self.delta_s = delta_s
+        self.first_delta = threading.Event()
+
+    def submit_text(self, prompt, max_new_tokens, temperature, top_p=1.0, templated=False):
+        if self.submit_error is not None:
+            raise self.submit_error
+        self.calls.append(prompt)
+        return object()
+
+    def stream_text(self, req, timeout=None):
+        for i in range(self.n_deltas):
+            self.first_delta.set()
+            time.sleep(self.delta_s)
+            yield f"{self.name}:{i} "
+
+
+def test_drain_completes_inflight_stream_and_reroutes_new_work():
+    a = StreamingBackend("replica-a")
+    b = StreamingBackend("replica-b")
+    with make_fleet([a, b]) as (router, servers):
+        probe = chat(router.url, f"{PREAMBLE} probe").json()
+        victim_name = probe["choices"][0]["message"]["content"].split(":")[0]
+        victim_idx = 0 if victim_name == "replica-a" else 1
+        victim_srv = servers[victim_idx]
+        victim_backend = (a, b)[victim_idx]
+        victim_backend.first_delta.clear()
+
+        deltas: list[str] = []
+        done = threading.Event()
+
+        def consume() -> None:
+            with httpx.stream(
+                "POST",
+                f"{router.url}/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": f"{PREAMBLE} stream"}],
+                      "stream": True},
+                timeout=30,
+            ) as response:
+                assert response.status_code == 200
+                for line in response.iter_lines():
+                    if line.startswith("data:") and "[DONE]" not in line:
+                        deltas.append(line)
+            done.set()
+
+        t = threading.Thread(target=consume)
+        t.start()
+        assert victim_backend.first_delta.wait(timeout=10)  # stream is live
+        # drain the replica mid-stream through the router's admin surface
+        response = httpx.post(
+            f"{router.url}/admin/drain", params={"replica": _rid(victim_srv)}, timeout=5
+        )
+        assert response.status_code == 200
+        # the in-flight stream must run to completion (drain != kill)
+        assert done.wait(timeout=30)
+        t.join(timeout=5)
+        payloads = [d for d in deltas if victim_name in d]
+        assert len(payloads) >= victim_backend.n_deltas  # every delta arrived
+        # the drained replica reports 503/draining on its own healthz...
+        health = httpx.get(f"{victim_srv.url}/healthz", timeout=5)
+        assert health.status_code == 503
+        assert health.json()["state"] == "draining"
+        # ...refuses new work directly...
+        assert chat(victim_srv.url, "direct").status_code == 503
+        # ...and the router sends every new request to the survivor
+        survivor = "replica-b" if victim_name == "replica-a" else "replica-a"
+        for i in range(4):
+            body = chat(router.url, f"{PREAMBLE} after-drain {i}").json()
+            assert body["choices"][0]["message"]["content"].startswith(survivor)
+
+
+# ---- admission control / 429 ------------------------------------------------
+
+
+def test_router_admission_gate_429_with_retry_after():
+    slow = FleetBackend("replica-a", delay=0.6)
+    with make_fleet([slow], max_inflight=1, queue_wait_s=0.05) as (router, _servers):
+        codes: list[int] = []
+        headers: list[str | None] = []
+        lock = threading.Lock()
+
+        def worker() -> None:
+            response = chat(router.url, f"{PREAMBLE} x", timeout=30)
+            with lock:
+                codes.append(response.status_code)
+                headers.append(response.headers.get("Retry-After"))
+
+        threads = [threading.Thread(target=worker) for _ in range(3)]
+        for t in threads:
+            t.start()
+            time.sleep(0.1)  # guarantee overlap with the 0.6 s in-flight call
+        for t in threads:
+            t.join(timeout=30)
+        assert codes.count(200) >= 1
+        assert codes.count(429) >= 1
+        rejected = [h for c, h in zip(codes, headers) if c == 429]
+        assert all(h is not None and float(h) > 0 for h in rejected)
+        assert router.stats()["admission_rejected"] >= 1
+
+
+def test_upstream_429_fails_over_then_propagates():
+    a, b = FleetBackend("replica-a"), FleetBackend("replica-b")
+    a.submit_error = QueueFullError("full", retry_after=0.2)
+    with make_fleet([a, b]) as (router, _servers):
+        # one replica shedding load: the request lands on the other
+        response = chat(router.url, f"{PREAMBLE} one-full")
+        assert response.status_code == 200
+        assert response.json()["choices"][0]["message"]["content"] == "replica-b"
+        # the whole fleet shedding load: 429 + Retry-After reaches the client
+        b.submit_error = QueueFullError("full", retry_after=0.2)
+        response = chat(router.url, f"{PREAMBLE} all-full")
+        assert response.status_code == 429
+        # integer delta-seconds passthrough from the last replica's 429
+        assert response.headers["Retry-After"] == "1"
+        assert response.json()["error"]["retry_after"] == pytest.approx(0.2)
+        assert router.stats()["reroutes"].get("upstream_429", 0) >= 1
+
+
+def test_client_survives_router_backpressure(monkeypatch, tmp_path):
+    """End-to-end satellite: engine-style 429s propagate through the router
+    and the SDK's InferenceClient rides them out via Retry-After."""
+    monkeypatch.setenv("PRIME_CONFIG_DIR", str(tmp_path))
+    monkeypatch.setenv("PRIME_API_KEY", "local")
+
+    from prime_tpu.api.inference import InferenceClient
+    from prime_tpu.core.config import Config
+
+    flaky = FleetBackend("replica-a")
+    attempts = {"n": 0}
+
+    real_generate = flaky.generate
+
+    def generate(prompts, max_new_tokens, temperature, top_p=1.0, templated=False):
+        attempts["n"] += 1
+        if attempts["n"] <= 2:
+            raise QueueFullError("warming up", retry_after=0.05)
+        return real_generate(prompts, max_new_tokens, temperature, top_p, templated)
+
+    flaky.generate = generate
+    with make_fleet([flaky]) as (router, _servers):
+        client = InferenceClient(
+            config=Config(), base_url=f"{router.url}/v1", max_429_retries=3
+        )
+        reply = client.chat_completion(
+            "tiny-test", [{"role": "user", "content": f"{PREAMBLE} retry me"}]
+        )
+        assert reply["choices"][0]["message"]["content"] == "replica-a"
+        assert attempts["n"] == 3  # two 429s ridden out, third attempt served
+
+
+def test_client_gives_up_after_bounded_retries(monkeypatch, tmp_path):
+    monkeypatch.setenv("PRIME_CONFIG_DIR", str(tmp_path))
+    monkeypatch.setenv("PRIME_API_KEY", "local")
+
+    from prime_tpu.api.inference import InferenceClient
+    from prime_tpu.core.config import Config
+    from prime_tpu.core.exceptions import RateLimitError
+
+    full = FleetBackend("replica-a")
+    full.submit_error = QueueFullError("permanently full", retry_after=0.02)
+    with make_fleet([full]) as (router, _servers):
+        client = InferenceClient(
+            config=Config(), base_url=f"{router.url}/v1", max_429_retries=1
+        )
+        with pytest.raises(RateLimitError) as excinfo:
+            client.chat_completion("tiny-test", [{"role": "user", "content": "x"}])
+        assert excinfo.value.retry_after is not None
+
+
+# ---- router surface ---------------------------------------------------------
+
+
+def test_router_healthz_metrics_and_admin_surfaces():
+    a, b = FleetBackend("replica-a"), FleetBackend("replica-b")
+    with make_fleet([a, b]) as (router, servers):
+        health = httpx.get(f"{router.url}/healthz", timeout=5)
+        assert health.status_code == 200
+        assert health.json()["routable"] == 2
+        fleet = httpx.get(f"{router.url}/admin/fleet", timeout=5).json()
+        assert set(fleet["replicas"]) == {_rid(servers[0]), _rid(servers[1])}
+        models = httpx.get(f"{router.url}/v1/models", timeout=5).json()
+        assert models["data"][0]["id"] == "tiny-test"
+        registry = httpx.get(
+            f"{router.url}/metrics", params={"format": "registry"}, timeout=5
+        ).json()
+        assert "fleet_requests_total" in registry["router"]
+        assert httpx.get(f"{router.url}/nope", timeout=5).status_code == 404
+
+
+def test_router_join_registers_new_replica():
+    a = FleetBackend("replica-a")
+    with make_fleet([a]) as (router, _servers):
+        late = InferenceServer("tiny-test", FleetBackend("replica-late"), port=0).start()
+        try:
+            response = httpx.post(
+                f"{router.url}/admin/join", json={"url": late.url}, timeout=5
+            )
+            assert response.status_code == 200
+            assert response.json()["joined"] == _rid(late)
+            assert _rid(late) in router.stats()["replicas"]
+        finally:
+            late.stop()
+
+
+def test_router_forwards_attribution_headers():
+    """X-PI-Job-Id / Authorization etc. must survive the proxy hop — a
+    production upstream authorizes and attributes on them."""
+    import json as _json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    seen: dict[str, str] = {}
+
+    class Upstream(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, payload: dict) -> None:
+            body = _json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            self._send({"state": "ready", "queue_depth": 0, "active_slots": 0})
+
+        def do_POST(self):
+            seen.update({k: v for k, v in self.headers.items()})
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self._send({"choices": [{"message": {"content": "ok"}}]})
+
+    upstream = ThreadingHTTPServer(("127.0.0.1", 0), Upstream)
+    threading.Thread(target=upstream.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{upstream.server_address[1]}"
+    router = serve_fleet([url], poll_interval=0.05, model_id="tiny-test")
+    try:
+        response = httpx.post(
+            f"{router.url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "x"}]},
+            headers={"X-PI-Job-Id": "job-7", "Authorization": "Bearer tok"},
+            timeout=10,
+        )
+        assert response.status_code == 200
+        assert seen.get("X-PI-Job-Id") == "job-7"
+        assert seen.get("Authorization") == "Bearer tok"
+        # hop-by-hop/host were rewritten for the upstream connection
+        assert seen.get("Host", "").endswith(str(upstream.server_address[1]))
+    finally:
+        router.stop()
+        upstream.shutdown()
+        upstream.server_close()
+
+
+def test_admin_surface_token_gate_and_join_validation():
+    a = FleetBackend("replica-a")
+    with make_fleet([a], admin_token="sekrit") as (router, servers):
+        assert chat(router.url, "x").status_code == 200  # data plane open
+        rid = _rid(servers[0])
+        denied = httpx.post(
+            f"{router.url}/admin/drain", params={"replica": rid}, timeout=5
+        )
+        assert denied.status_code == 403
+        auth = {"Authorization": "Bearer sekrit"}
+        # malformed join payloads answer 400, not a dropped connection
+        bad = httpx.post(
+            f"{router.url}/admin/join", json={"url": 123}, headers=auth, timeout=5
+        )
+        assert bad.status_code == 400
+        ok = httpx.post(
+            f"{router.url}/admin/drain", params={"replica": rid}, headers=auth, timeout=5
+        )
+        assert ok.status_code == 200
+
+
+def test_router_healthz_unavailable_when_all_replicas_down():
+    a = FleetBackend("replica-a")
+    with make_fleet([a], fail_threshold=1, cooldown=30.0) as (router, servers):
+        servers[0].stop()
+        # one failed request trips the breaker (threshold 1)
+        assert chat(router.url, "x").status_code == 503
+        health = httpx.get(f"{router.url}/healthz", timeout=5)
+        assert health.status_code == 503
+        assert health.json()["state"] == "unavailable"

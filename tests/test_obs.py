@@ -290,13 +290,14 @@ def test_engine_histograms_populate_through_streamed_completion():
                 assert "[DONE]" in body
 
             # legacy JSON: the pre-registry counter keys, plus the decode
-            # pipeline fields (PR 2) and the radix prefix-cache fields
-            # (PR 3) — additive only
+            # pipeline fields (PR 2), the radix prefix-cache fields (PR 3),
+            # and the fleet admission/drain fields (PR 4) — additive only
             engine_stats = httpx.get(f"{srv.url}/metrics").json()["engine"]
             assert set(engine_stats) == {
                 "requests_admitted", "requests_completed", "requests_cancelled",
                 "requests_failed", "tokens_emitted", "prefix_hits",
                 "batched_admission_waves", "active_slots", "queue_depth",
+                "max_slots", "max_queue", "state",
                 "overlap", "inflight_depth", "host_stall_s", "chunk_window_s",
                 "overlap_ratio", "wasted_decode_tokens", "warmup_programs",
                 "prefix_cache_bytes", "prefix_cache_nodes", "prefix_evictions",

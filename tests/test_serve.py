@@ -301,6 +301,108 @@ def test_chat_template_preferred_over_generic():
     assert prompts_seen == ["user: hi\nassistant:"]
 
 
+def test_healthz_states_gate_traffic():
+    """/healthz answers 503 while loading or draining and 200 when ready,
+    with the queue/slot fields routers balance on (fleet satellite)."""
+    with InferenceServer("tiny-test", port=0) as loading:  # no generator yet
+        response = httpx.get(f"{loading.url}/healthz")
+        assert response.status_code == 503
+        assert response.json()["state"] == "loading"
+        # liveness stays 200 through unready states (k8s livenessProbe moved
+        # to /livez when /healthz became a readiness gate)
+        assert httpx.get(f"{loading.url}/livez").status_code == 200
+
+    class StatsGenerator(EchoGenerator):
+        def stats(self):
+            return {"queue_depth": 3, "active_slots": 2, "max_slots": 8}
+
+    with InferenceServer("tiny-test", StatsGenerator(), port=0) as srv:
+        response = httpx.get(f"{srv.url}/healthz")
+        assert response.status_code == 200
+        body = response.json()
+        assert body["state"] == "ready"
+        assert (body["queue_depth"], body["active_slots"], body["max_slots"]) == (3, 2, 8)
+
+        # POST /admin/drain flips the state; in-flight finish, new work 503s
+        drained = httpx.post(f"{srv.url}/admin/drain")
+        assert drained.status_code == 200
+        assert drained.json()["state"] == "draining"
+        assert httpx.get(f"{srv.url}/healthz").status_code == 503
+        assert httpx.get(f"{srv.url}/livez").status_code == 200
+        # this backend reports queued work (queue_depth 3): not drained yet
+        assert httpx.get(f"{srv.url}/healthz").json()["drained"] is False
+
+    with InferenceServer("tiny-test", EchoGenerator(), port=0) as idle:
+        httpx.post(f"{idle.url}/admin/drain")
+        # no stats, no in-flight chats: the server's own counter says done
+        assert httpx.get(f"{idle.url}/healthz").json()["drained"] is True
+        refused = httpx.post(
+            f"{idle.url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "x"}]},
+        )
+        assert refused.status_code == 503
+        assert refused.json()["error"]["type"] == "draining"
+
+
+def test_admin_drain_token_gate():
+    """Drain is irreversible: with an admin token configured, an anonymous
+    POST /admin/drain must be refused."""
+    with InferenceServer("tiny-test", EchoGenerator(), port=0, admin_token="t0k") as srv:
+        assert httpx.post(f"{srv.url}/admin/drain").status_code == 403
+        assert httpx.get(f"{srv.url}/healthz").status_code == 200  # NOT drained
+        ok = httpx.post(
+            f"{srv.url}/admin/drain", headers={"Authorization": "Bearer t0k"}
+        )
+        assert ok.status_code == 200
+        assert httpx.get(f"{srv.url}/healthz").status_code == 503
+
+
+def test_drain_during_loading_reaches_late_generator():
+    """A drain landing in the checkpoint-loading window must forward to the
+    generator assigned afterwards, or `drained` could never flip true."""
+    drain_calls = []
+
+    class DrainableGen(EchoGenerator):
+        drained = True
+
+        def drain(self):
+            drain_calls.append(True)
+
+    srv = InferenceServer("tiny-test", port=0).start()  # still "loading"
+    try:
+        assert httpx.post(f"{srv.url}/admin/drain").status_code == 200
+        srv.generator = DrainableGen()  # serve_model's late assignment
+        assert drain_calls  # the pending drain was forwarded
+        body = httpx.get(f"{srv.url}/healthz").json()
+        assert body["state"] == "draining" and body["drained"] is True
+    finally:
+        srv.stop()
+
+
+def test_queue_full_maps_to_429_with_retry_after():
+    """A backend raising the typed QueueFullError surfaces as 429 with a
+    Retry-After header (the admission-control contract clients and the
+    fleet router both build on)."""
+    from prime_tpu.serve.errors import QueueFullError
+
+    class FullGenerator(EchoGenerator):
+        def generate(self, prompts, max_new_tokens, temperature, top_p=1.0):
+            raise QueueFullError("pending queue is full (4/4)", retry_after=1.5)
+
+    with InferenceServer("tiny-test", FullGenerator(), port=0) as srv:
+        response = httpx.post(
+            f"{srv.url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "x"}]},
+        )
+        assert response.status_code == 429
+        # header is RFC 9110 integer delta-seconds (ceil); body keeps the float
+        assert response.headers["Retry-After"] == "2"
+        body = response.json()["error"]
+        assert body["type"] == "overloaded" and body["retry_after"] == 1.5
+        # still serving
+        assert httpx.get(f"{srv.url}/v1/models").status_code == 200
+
+
 def test_serve_with_lora_adapter(tmp_path):
     """serve_model --adapter really merges: a nonzero-B adapter must change
     the greedy completion vs the unadapted base server."""
