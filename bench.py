@@ -199,22 +199,53 @@ def _decode_roofline(
     }
 
 
-def _probe_once(timeout_s: float) -> str | None:
+# The probe registers faulthandler on SIGUSR1 so a timed-out probe can be
+# asked WHERE it is stuck (inside PJRT client init? the tunnel handshake?
+# the compile RPC?) before being killed — BENCH_r05's two 210s timeouts
+# produced nothing but "backend unresponsive", which is undiagnosable.
+# NOTE: the `jnp.ones((256` literal is _sweep_stray_holders' probe signature.
+PROBE_CODE = (
+    "import faulthandler, signal, sys\n"
+    "faulthandler.register(signal.SIGUSR1, file=sys.stderr)\n"
+    "import jax, jax.numpy as jnp\n"
+    "x = jnp.ones((256, 256))\n"
+    "print(float(jnp.sum(x @ x)))\n"
+)
+
+
+def _probe_once(timeout_s: float, code: str = PROBE_CODE) -> dict | None:
     """One accelerator probe in a SUBPROCESS (fresh PJRT client — an
-    in-process retry would reuse the same stuck client). None on success."""
-    code = (
-        "import jax, jax.numpy as jnp\n"
-        "x = jnp.ones((256, 256))\n"
-        "print(float(jnp.sum(x @ x)))\n"
+    in-process retry would reuse the same stuck client). None on success;
+    on failure a dict with ``error`` and — for hangs — ``child_stacks``,
+    the faulthandler dump of every thread in the stuck child."""
+    import signal
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True, timeout=timeout_s
-        )
+        _, err = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return f"backend unresponsive after {timeout_s:.0f}s"
+        stacks = ""
+        try:
+            # ask the child to dump its thread stacks, give the write a
+            # moment to land, THEN kill — the dump is the whole point
+            proc.send_signal(signal.SIGUSR1)
+            time.sleep(2.0)
+        except OSError:
+            pass
+        proc.kill()
+        try:
+            _, stacks = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:  # pragma: no cover — kill -9'd
+            stacks = ""
+        return {
+            "error": f"backend unresponsive after {timeout_s:.0f}s",
+            "child_stacks": (stacks or "").strip()[-3000:] or None,
+        }
     if proc.returncode != 0:
-        return f"probe rc={proc.returncode}: {proc.stderr.strip()[-300:]}"
+        return {"error": f"probe rc={proc.returncode}: {err.strip()[-300:]}"}
     return None
 
 
@@ -274,6 +305,35 @@ def _diagnose() -> dict:
         )[:10]
     except Exception:
         pass
+    # newest flight-recorder summaries from any local serve/router process
+    # (GET /debug/requests, docs/observability.md): when a serve replica is
+    # what's holding the chip, its per-request timelines say what it was
+    # doing — queued? mid-prefill? wedged mid-chunk? — when the backend
+    # stopped answering. Loopback with a 1s budget per port; never fatal.
+    flights: dict = {}
+    try:
+        import httpx
+
+        for port in (info.get("listen_ports") or [])[:8]:
+            try:
+                response = httpx.get(
+                    f"http://127.0.0.1:{port}/debug/requests", timeout=1.0
+                )
+                if response.status_code != 200:
+                    continue
+                data = response.json()
+                data = data.get("router", data)  # router wraps its summaries
+                if isinstance(data, dict) and ("recent" in data or "inflight" in data):
+                    flights[str(port)] = {
+                        "inflight": data.get("inflight", [])[:5],
+                        "recent": data.get("recent", [])[:5],
+                    }
+            except Exception:  # noqa: BLE001 — diagnosis must never throw
+                continue
+    except Exception:  # noqa: BLE001 — httpx may be absent in minimal envs
+        pass
+    if flights:
+        info["flight_recorders"] = flights
     return info
 
 
@@ -336,15 +396,19 @@ def _preflight() -> dict:
     report: dict = {"ok": False, "probes": []}
     for attempt, timeout_s in enumerate(PROBE_TIMEOUTS_S):
         t0 = time.monotonic()
-        reason = _probe_once(timeout_s)
-        report["probes"].append(
-            {
-                "attempt": attempt + 1,
-                "timeout_s": timeout_s,
-                "elapsed_s": round(time.monotonic() - t0, 1),
-                "error": reason,
-            }
-        )
+        result = _probe_once(timeout_s)
+        reason = None if result is None else result["error"]
+        entry = {
+            "attempt": attempt + 1,
+            "timeout_s": timeout_s,
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "error": reason,
+        }
+        if result is not None and result.get("child_stacks"):
+            # the stuck child's own thread stacks (faulthandler): the
+            # difference between "tunnel down" and "compile RPC wedged"
+            entry["child_stacks"] = result["child_stacks"]
+        report["probes"].append(entry)
         if reason is None:
             report["ok"] = True
             failed = attempt

@@ -26,6 +26,7 @@ import httpx
 from prime_tpu.core.client import APIClient
 from prime_tpu.core.config import Config
 from prime_tpu.core.exceptions import RateLimitError
+from prime_tpu.obs.trace import TRACEPARENT_HEADER, TRACER, new_traceparent
 
 INFERENCE_TIMEOUT = httpx.Timeout(600.0, connect=10.0, write=60.0)
 # Retry-After values above this are "come back much later", not "ride it
@@ -87,14 +88,24 @@ class InferenceClient:
             payload["max_tokens"] = max_tokens
         if temperature is not None:
             payload["temperature"] = temperature
-        headers = {"X-PI-Job-Id": job_id} if job_id else None
-        for attempt in range(self.max_429_retries + 1):
-            try:
-                return self.api.post("/chat/completions", json=payload, headers=headers)
-            except RateLimitError as e:
-                if attempt == self.max_429_retries:
-                    raise
-                self._backoff_429(e, attempt)
+        headers = {"X-PI-Job-Id": job_id} if job_id else {}
+        # ONE trace for the whole logical call: 429 retries are attempts
+        # inside the same request story, so they must share the trace id the
+        # server-side spans join (a fresh traceparent per attempt would
+        # shatter the waterfall). The span is the outermost client hop.
+        with TRACER.span("client.chat", model=model) as span:
+            traceparent = span.traceparent()
+            if traceparent:
+                headers[TRACEPARENT_HEADER] = traceparent
+            for attempt in range(self.max_429_retries + 1):
+                try:
+                    return self.api.post(
+                        "/chat/completions", json=payload, headers=headers or None
+                    )
+                except RateLimitError as e:
+                    if attempt == self.max_429_retries:
+                        raise
+                    self._backoff_429(e, attempt)
 
     def chat_completion_stream(
         self,
@@ -112,8 +123,15 @@ class InferenceClient:
             payload["max_tokens"] = max_tokens
         if temperature is not None:
             payload["temperature"] = temperature
+        # streams share one trace across open-stream retries too; no client
+        # span wraps the body (it would stay open for the stream's lifetime)
+        headers = (
+            {TRACEPARENT_HEADER: new_traceparent()} if TRACER.enabled else None
+        )
         for attempt in range(self.max_429_retries + 1):
-            lines = self.api.stream_lines("POST", "/chat/completions", json=payload)
+            lines = self.api.stream_lines(
+                "POST", "/chat/completions", json=payload, headers=headers
+            )
             try:
                 # stream_lines raises the mapped status error on first pull
                 first = next(lines, None)

@@ -294,17 +294,40 @@ def serve_fleet_cmd(
 @serve_cmd.command(name="metrics")
 @click.option(
     "--url", default="http://127.0.0.1:8000", show_default=True,
-    help="Base URL of a running `prime serve` instance.",
+    help="Base URL of a running `prime serve` instance OR a "
+         "`prime serve fleet` router (router-specific series render too).",
 )
 @click.option(
     "--prometheus", is_flag=True,
     help="Dump the raw Prometheus text exposition instead of a table.",
 )
+@click.option(
+    "--debug-url", default=None, metavar="URL",
+    help="Print the flight-recorder view (GET /debug/requests) of a server "
+         "or router instead of scraping metrics. See docs/observability.md.",
+)
+@click.option(
+    "--request", "request_id", default=None, metavar="ID",
+    help="With --debug-url: print one request's full timeline "
+         "(engine request id or W3C trace id).",
+)
+@click.option(
+    "--admin-token", default=None, envvar="PRIME_FLEET_ADMIN_TOKEN",
+    help="Bearer token for /debug/requests when the target gates it.",
+)
 @output_options
-def serve_metrics_cmd(render: "Renderer", url: str, prometheus: bool) -> None:
+def serve_metrics_cmd(
+    render: "Renderer",
+    url: str,
+    prometheus: bool,
+    debug_url: str | None,
+    request_id: str | None,
+    admin_token: str | None,
+) -> None:
     """Scrape a running server's metrics registry: counters, gauges, and
     latency histograms (TTFT, queue wait, prefill/decode) with estimated
-    p50/p95. See docs/architecture.md "Observability"."""
+    p50/p95 — or, with --debug-url, the flight-recorder request timelines.
+    See docs/architecture.md "Observability" and docs/observability.md."""
     import httpx
 
     from prime_tpu.obs.metrics import quantile_from_snapshot
@@ -316,6 +339,11 @@ def serve_metrics_cmd(render: "Renderer", url: str, prometheus: bool) -> None:
             "--prometheus emits text exposition format; drop it or use "
             "--output json without it for the registry JSON"
         )
+    if request_id and not debug_url:
+        raise click.UsageError("--request requires --debug-url")
+    if debug_url:
+        _render_flight_view(render, debug_url, request_id, admin_token)
+        return
     base = url.rstrip("/")
     try:
         if prometheus:
@@ -372,4 +400,113 @@ def serve_metrics_cmd(render: "Renderer", url: str, prometheus: bool) -> None:
     render.table(
         ["section", "metric", "labels", "count", "mean", "p50", "p95"], hist_rows,
         title="Histograms (seconds unless named otherwise)",
+    )
+    if "router" in payload:
+        # fleet-router scrape: condense the router-specific families
+        # (fleet_requests_total by replica/outcome, breaker-state gauges,
+        # the affinity ratio) into one per-replica table — the series render
+        # in the generic tables above too, but the routing question is
+        # always "who got the traffic and who is broken"
+        router = payload["router"]
+
+        def series_of(name: str) -> list[dict]:
+            return router.get(name, {}).get("series", [])
+
+        per_replica: dict[str, dict[str, int]] = {}
+        for series in series_of("fleet_requests_total"):
+            labels = series["labels"]
+            per_replica.setdefault(labels.get("replica", "?"), {})[
+                labels.get("outcome", "?")
+            ] = int(series["value"])
+        breakers = {
+            series["labels"].get("replica", "?"): {0: "closed", 1: "half-open", 2: "open"}.get(
+                int(series["value"]), str(series["value"])
+            )
+            for series in series_of("fleet_breaker_state")
+        }
+        fleet_rows = [
+            [
+                rid,
+                breakers.get(rid, "?"),
+                sum(outcomes.values()),
+                ", ".join(f"{k}={v}" for k, v in sorted(outcomes.items())) or "-",
+            ]
+            for rid, outcomes in sorted(per_replica.items())
+        ]
+        ratio = next(
+            (s["value"] for s in series_of("fleet_affinity_hit_ratio")), None
+        )
+        render.table(
+            ["replica", "breaker", "requests", "outcomes"], fleet_rows,
+            title="Fleet routing"
+            + (f" (affinity hit ratio {ratio})" if ratio is not None else ""),
+        )
+
+
+def _render_flight_view(
+    render: "Renderer", debug_url: str, request_id: str | None, admin_token: str | None
+) -> None:
+    """`prime serve metrics --debug-url`: the flight-recorder view of a
+    server or router — recent + in-flight request summaries, or one full
+    timeline with --request."""
+    import httpx
+
+    base = debug_url.rstrip("/")
+    path = f"/debug/requests/{request_id}" if request_id else "/debug/requests"
+    headers = {"Authorization": f"Bearer {admin_token}"} if admin_token else None
+    try:
+        response = httpx.get(f"{base}{path}", headers=headers, timeout=10)
+        if response.status_code == 403:
+            raise click.ClickException(
+                f"{base}{path} requires an admin token (--admin-token / "
+                "PRIME_FLEET_ADMIN_TOKEN)"
+            )
+        if response.status_code == 404:
+            raise click.ClickException(f"no request {request_id!r} at {base}")
+        response.raise_for_status()
+        payload = response.json()
+    except (httpx.HTTPError, ValueError) as e:
+        raise click.ClickException(f"could not read {base}{path}: {e}") from None
+    if render.is_json:
+        render.json(payload)
+        return
+    if request_id:
+        # one timeline (server shape) or {"router": ..., "replica": ...}
+        sections = (
+            payload.items() if "router" in payload else [("request", payload)]
+        )
+        for section, timeline in sections:
+            if not isinstance(timeline, dict):
+                continue
+            header = ", ".join(
+                f"{k}={v}" for k, v in timeline.items() if k != "events"
+            )
+            click.echo(f"--- {section}: {header}")
+            for event in timeline.get("events", []):
+                detail = ", ".join(
+                    f"{k}={v}" for k, v in event.items() if k not in ("t_s", "event")
+                )
+                click.echo(
+                    f"{event['t_s'] * 1e3:>10.2f} ms  {event['event']}"
+                    + (f" ({detail})" if detail else "")
+                )
+        return
+    summaries = payload.get("router", payload)
+    rows = [
+        [
+            entry.get("id", "?")[:16],
+            entry.get("state", "?"),
+            entry.get("outcome") or "-",
+            round(entry.get("duration_s", 0.0), 3),
+            entry.get("events", 0),
+            entry.get("last_event") or "-",
+            entry.get("replica") or "-",
+        ]
+        for bucket in ("inflight", "recent")
+        for entry in summaries.get(bucket, [])
+    ]
+    render.table(
+        ["request", "state", "outcome", "duration_s", "events", "last_event", "replica"],
+        rows,
+        title=f"Flight recorder @ {base} (in-flight first, then recent)",
     )

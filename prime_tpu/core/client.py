@@ -36,6 +36,7 @@ import httpx
 import prime_tpu
 from prime_tpu.core.config import Config
 from prime_tpu.obs.metrics import REGISTRY
+from prime_tpu.obs.trace import TRACEPARENT_HEADER, TRACER, new_traceparent
 from prime_tpu.core.exceptions import (
     APIConnectionError,
     APIError,
@@ -192,6 +193,14 @@ class _RequestCore:
             headers["X-Prime-Team-ID"] = self.team_id
         if extra:
             headers.update(extra)
+        if TRACER.enabled and not any(
+            k.lower() == TRACEPARENT_HEADER for k in headers
+        ):
+            # outermost-hop trace context (docs/observability.md): the SDK is
+            # where a request's distributed trace begins, unless a caller
+            # (e.g. api/inference.py, which spans the whole retry loop)
+            # already injected one
+            headers[TRACEPARENT_HEADER] = new_traceparent()
         return headers
 
     @staticmethod

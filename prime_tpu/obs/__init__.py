@@ -15,6 +15,7 @@ See docs/architecture.md "Observability" for the exposition endpoints
 (`GET /metrics?format=prometheus`, `/healthz`) and the trace JSONL schema.
 """
 
+from prime_tpu.obs.flight import FlightRecorder
 from prime_tpu.obs.metrics import (
     DEFAULT_LATENCY_BUCKETS,
     DEFAULT_SIZE_BUCKETS,
@@ -23,9 +24,19 @@ from prime_tpu.obs.metrics import (
     Gauge,
     Histogram,
     Registry,
+    lint_prometheus_text,
     quantile_from_snapshot,
 )
-from prime_tpu.obs.trace import TRACER, Span, Tracer, span
+from prime_tpu.obs.trace import (
+    TRACEPARENT_HEADER,
+    TRACER,
+    Span,
+    TraceContext,
+    Tracer,
+    new_traceparent,
+    parse_traceparent,
+    span,
+)
 
 __all__ = [
     "Counter",
@@ -35,9 +46,15 @@ __all__ = [
     "REGISTRY",
     "DEFAULT_LATENCY_BUCKETS",
     "DEFAULT_SIZE_BUCKETS",
+    "lint_prometheus_text",
     "quantile_from_snapshot",
+    "FlightRecorder",
     "Span",
+    "TraceContext",
     "Tracer",
     "TRACER",
+    "TRACEPARENT_HEADER",
+    "new_traceparent",
+    "parse_traceparent",
     "span",
 ]

@@ -1,0 +1,223 @@
+"""Always-on request flight recorder: bounded per-request timelines.
+
+Tracing answers "why was request X slow" only when ``PRIME_TRACE`` was set
+before the incident; the flight recorder answers it after the fact, always.
+Each request owns a small timeline — admission, prefill, chunk boundaries,
+retirement, errors — appended O(1) into fixed-size rings, so the recorder
+costs a dict lookup and a deque append per *event* (events are per chunk,
+never per token) and its memory is strictly bounded regardless of traffic:
+
+- at most ``max_inflight`` live timelines (beginning one past the bound
+  evicts the oldest live timeline into the completed ring as ``evicted``);
+- at most ``capacity`` completed timelines (oldest dropped);
+- at most ``max_events`` events per timeline (oldest dropped, counted in
+  ``events_dropped`` so a truncated view says so).
+
+Surfaced as ``GET /debug/requests`` (recent + in-flight summaries) and
+``GET /debug/requests/{id}`` on the serve server and the fleet router —
+docs/observability.md "Flight recorder". Timelines are keyed by the engine
+request id AND the request's W3C trace id, so the router can ask a replica
+about a request it proxied using the shared trace id alone.
+
+``slow_ms`` (the ``PRIME_SERVE_SLOW_MS`` knob) is the capture threshold: a
+request retiring slower than it has its whole timeline persisted to the
+trace sink as one ``flight.slow_request`` span (attrs carry the events), so
+slow-request forensics survive process death even when nobody was watching
+the debug endpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from itertools import islice
+from typing import Any
+
+from prime_tpu.obs.trace import TRACER, TraceContext
+
+DEFAULT_CAPACITY = 256
+DEFAULT_MAX_EVENTS = 64
+DEFAULT_MAX_INFLIGHT = 1024
+
+
+def slow_ms_from_env() -> float:
+    """The ``PRIME_SERVE_SLOW_MS`` capture threshold; 0 = off."""
+    raw = os.environ.get("PRIME_SERVE_SLOW_MS", "").strip()
+    try:
+        return max(0.0, float(raw)) if raw else 0.0
+    except ValueError:
+        return 0.0
+
+
+class _Timeline:
+    __slots__ = (
+        "id", "trace_id", "meta", "start_unix_s", "_t0", "events",
+        "events_dropped", "outcome", "duration_s",
+    )
+
+    def __init__(
+        self, key: str, trace_id: str | None, meta: dict[str, Any], max_events: int
+    ) -> None:
+        self.id = key
+        self.trace_id = trace_id
+        self.meta = meta
+        self.start_unix_s = time.time()
+        self._t0 = time.monotonic()
+        self.events: deque[tuple[float, str, dict | None]] = deque(maxlen=max_events)
+        self.events_dropped = 0
+        self.outcome: str | None = None  # None while in flight
+        self.duration_s: float | None = None
+
+    def add(self, name: str, fields: dict | None) -> None:
+        if len(self.events) == self.events.maxlen:
+            self.events_dropped += 1
+        self.events.append((time.monotonic() - self._t0, name, fields))
+
+    def summary(self) -> dict[str, Any]:
+        last = self.events[-1] if self.events else None
+        return {
+            "id": self.id,
+            "trace_id": self.trace_id,
+            "state": "done" if self.outcome is not None else "inflight",
+            "outcome": self.outcome,
+            "start_unix_s": round(self.start_unix_s, 6),
+            "duration_s": round(
+                self.duration_s
+                if self.duration_s is not None
+                else time.monotonic() - self._t0,
+                6,
+            ),
+            "events": len(self.events) + self.events_dropped,
+            "last_event": last[1] if last else None,
+            **self.meta,
+        }
+
+    def to_dict(self) -> dict[str, Any]:
+        out = self.summary()
+        out["events_dropped"] = self.events_dropped
+        out["events"] = [
+            {"t_s": round(t, 6), "event": name, **(fields or {})}
+            for t, name, fields in self.events
+        ]
+        return out
+
+
+class FlightRecorder:
+    """Bounded ring of per-request timelines (module docstring). All methods
+    are thread-safe and O(1); unknown keys are ignored (a request bounced
+    before ``begin`` — or already retired — must not raise on a late event)."""
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        max_events: int = DEFAULT_MAX_EVENTS,
+        max_inflight: int = DEFAULT_MAX_INFLIGHT,
+        slow_ms: float | None = None,
+    ) -> None:
+        self.capacity = max(1, capacity)
+        self.max_events = max(1, max_events)
+        self.max_inflight = max(1, max_inflight)
+        self.slow_ms = slow_ms_from_env() if slow_ms is None else max(0.0, slow_ms)
+        self._lock = threading.Lock()
+        # insertion-ordered (py3.7 dicts): the first key is the oldest live
+        # timeline, which is what the inflight bound evicts
+        self._inflight: dict[str, _Timeline] = {}
+        self._recent: deque[_Timeline] = deque(maxlen=self.capacity)
+
+    def begin(self, key: str, *, trace_id: str | None = None, **meta: Any) -> None:
+        key = str(key)
+        with self._lock:
+            if key in self._inflight:
+                return  # double-begin: keep the original timeline
+            while len(self._inflight) >= self.max_inflight:
+                _, oldest = next(iter(self._inflight.items()))
+                self._finish(oldest, "evicted")
+            self._inflight[key] = _Timeline(key, trace_id, dict(meta), self.max_events)
+
+    def event(self, key: str, name: str, **fields: Any) -> None:
+        with self._lock:
+            timeline = self._inflight.get(str(key))
+            if timeline is not None:
+                timeline.add(name, fields or None)
+
+    def annotate(self, key: str, **meta: Any) -> None:
+        """Merge metadata into a live timeline (e.g. the replica that ended
+        up serving a routed request — known only mid-flight)."""
+        with self._lock:
+            timeline = self._inflight.get(str(key))
+            if timeline is not None:
+                timeline.meta.update(meta)
+
+    def end(self, key: str, outcome: str, **fields: Any) -> None:
+        with self._lock:
+            timeline = self._inflight.get(str(key))
+            if timeline is None:
+                return  # already ended (idempotent) or never began
+            if fields:
+                timeline.add(outcome, fields)
+            self._finish(timeline, outcome)
+            slow = (
+                self.slow_ms > 0 and timeline.duration_s * 1000.0 >= self.slow_ms
+            )
+        if slow:
+            self._persist_slow(timeline)
+
+    def _finish(self, timeline: _Timeline, outcome: str) -> None:
+        """Move a live timeline to the completed ring (lock held)."""
+        timeline.outcome = outcome
+        timeline.duration_s = time.monotonic() - timeline._t0
+        self._inflight.pop(timeline.id, None)
+        self._recent.append(timeline)
+
+    def _persist_slow(self, timeline: _Timeline) -> None:
+        """Slow-request capture: the whole timeline as ONE synthetic span on
+        the trace sink (no sink configured = no-op). Outside the lock — the
+        sink write may hit a slow disk."""
+        context = (
+            TraceContext(timeline.trace_id, "0" * 16) if timeline.trace_id else None
+        )
+        TRACER.emit(
+            "flight.slow_request",
+            timeline.duration_s,
+            context=context,
+            request=timeline.id,
+            outcome=timeline.outcome,
+            timeline=timeline.to_dict()["events"],
+            **timeline.meta,
+        )
+
+    # -- read side (the /debug/requests endpoints) ----------------------------
+
+    def summaries(self, limit: int = 50) -> dict[str, list[dict]]:
+        """In-flight + recently completed request summaries, newest first.
+        Builds at most ``limit`` summaries per ring while holding the lock —
+        a /debug/requests poll must not stall the engine loop's appends
+        behind thousands of dict constructions."""
+        with self._lock:
+            inflight = [
+                t.summary()
+                for t in islice(reversed(list(self._inflight.values())), limit)
+            ]
+            recent = [t.summary() for t in islice(reversed(self._recent), limit)]
+        return {"inflight": inflight, "recent": recent}
+
+    def get(self, key: str) -> dict[str, Any] | None:
+        """Full timeline by request id OR trace id (newest match wins), so a
+        router holding only the shared trace id can resolve a replica-side
+        request it never knew the engine id of."""
+        key = str(key)
+        with self._lock:
+            timeline = self._inflight.get(key)
+            if timeline is None:
+                for t in reversed(list(self._inflight.values())):
+                    if t.trace_id == key:
+                        timeline = t
+                        break
+            if timeline is None:
+                for t in reversed(self._recent):
+                    if t.id == key or t.trace_id == key:
+                        timeline = t
+                        break
+            return timeline.to_dict() if timeline is not None else None

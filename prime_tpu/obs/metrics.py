@@ -51,6 +51,10 @@ def _format_value(v: float) -> str:
         return "+Inf"
     if v == -math.inf:
         return "-Inf"
+    if isinstance(v, float) and math.isnan(v):
+        # text-format spec spelling — repr() would emit "nan", which the
+        # reference Prometheus parser rejects
+        return "NaN"
     if isinstance(v, float) and v.is_integer():
         return str(int(v))
     return repr(v)
@@ -310,14 +314,23 @@ class Registry:
             return out
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4 of every family."""
+        """Prometheus text exposition format 0.0.4 of every family. Always
+        well-formed: a label-less family that exists but was never observed
+        still emits its (zero) series — a registered histogram must expose
+        zero-count buckets, not a bare HELP/TYPE header some scrapers choke
+        on — and non-finite values render in spec spelling (+Inf/-Inf/NaN).
+        Labeled families with no series have nothing emittable (the label
+        values are unknown) and legally render headers only."""
         with self._lock:
             lines: list[str] = []
             for name, metric in self._metrics.items():
                 if metric.help:
                     lines.append(f"# HELP {name} {_escape_help(metric.help)}")
                 lines.append(f"# TYPE {name} {metric.kind}")
-                for key, series in metric._series.items():
+                series_items = list(metric._series.items())
+                if not series_items and not metric.labelnames:
+                    series_items = [((), metric._new_series())]
+                for key, series in series_items:
                     if isinstance(metric, Histogram):
                         cumulative = 0
                         for bound, count in zip(
@@ -356,6 +369,116 @@ def quantile_from_snapshot(buckets: list[float], counts: list[int], q: float) ->
         cumulative += in_bucket
         lower = bound
     return buckets[-1]
+
+
+# quoted label values may legally contain '}' and ','; only '"', '\' and
+# newline are escaped — so the labels block and the pair splitter must be
+# quote-aware, not delimiter-naive
+_QUOTED = r'"(?:[^"\\]|\\.)*"'
+_LABEL_PAIR = rf"[a-zA-Z_][a-zA-Z0-9_]*={_QUOTED}"
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    rf"(?P<labels>\{{(?:[^\"}}]|{_QUOTED})*\}})?"
+    r" (?P<value>\S+)(?: (?P<ts>-?[0-9]+))?$"
+)
+# the exposition format permits a trailing comma before '}'
+_LABELS_RE = re.compile(rf"^{_LABEL_PAIR}(?:,{_LABEL_PAIR})*,?$")
+_LABEL_FIND_RE = re.compile(rf"([a-zA-Z_][a-zA-Z0-9_]*)=({_QUOTED})")
+
+
+def lint_prometheus_text(text: str) -> list[str]:
+    """Pure-python lint of Prometheus text exposition format 0.0.4. Returns
+    a list of problems (empty = well-formed). Checked: sample-line syntax
+    and label syntax, values parse (incl. +Inf/-Inf/NaN spellings — 'nan'
+    is a violation), no duplicate series, TYPE declared at most once per
+    family, and histogram invariants per series (cumulative non-decreasing
+    buckets, a +Inf bucket, _count equal to the +Inf bucket). The tests and
+    the CI serve-smoke job run every /metrics endpoint through this."""
+    problems: list[str] = []
+    typed: dict[str, str] = {}
+    seen_samples: set[str] = set()
+    # histogram accounting: series key -> list of (le, cumulative count)
+    buckets: dict[str, list[tuple[float, float]]] = {}
+    counts: dict[str, float] = {}
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 2 and parts[1] == "TYPE":
+                if len(parts) < 4 or parts[3] not in (
+                    "counter", "gauge", "histogram", "summary", "untyped"
+                ):
+                    problems.append(f"line {lineno}: malformed TYPE comment: {line!r}")
+                elif parts[2] in typed:
+                    problems.append(f"line {lineno}: duplicate TYPE for {parts[2]}")
+                else:
+                    typed[parts[2]] = parts[3]
+            elif len(parts) >= 2 and parts[1] not in ("HELP", "TYPE"):
+                problems.append(f"line {lineno}: unknown comment keyword: {line!r}")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            problems.append(f"line {lineno}: unparseable sample: {line!r}")
+            continue
+        name, labels, value = m.group("name"), m.group("labels"), m.group("value")
+        if labels:
+            inner = labels[1:-1]
+            if inner and not _LABELS_RE.match(inner):
+                problems.append(f"line {lineno}: malformed labels {inner!r}")
+        sample_key = f"{name}{labels or ''}"
+        if sample_key in seen_samples:
+            problems.append(f"line {lineno}: duplicate series {sample_key}")
+        seen_samples.add(sample_key)
+        if value in ("+Inf", "-Inf", "NaN"):
+            parsed = {"+Inf": math.inf, "-Inf": -math.inf, "NaN": math.nan}[value]
+        else:
+            try:
+                parsed = float(value)
+            except ValueError:
+                problems.append(f"line {lineno}: unparseable value {value!r}")
+                continue
+            if value.lower() in ("nan", "inf", "-inf", "+inf") and value not in (
+                "+Inf", "-Inf", "NaN"
+            ):
+                problems.append(
+                    f"line {lineno}: non-finite value {value!r} not in spec "
+                    "spelling (+Inf/-Inf/NaN)"
+                )
+        # histogram bookkeeping: strip the le label to key the series
+        for suffix, store in (("_bucket", buckets), ("_count", counts)):
+            if not name.endswith(suffix):
+                continue
+            base = name[: -len(suffix)]
+            if typed.get(base) != "histogram":
+                continue
+            pairs = _LABEL_FIND_RE.findall(labels or "")
+            le = next((v[1:-1] for k, v in pairs if k == "le"), None)
+            rest = ",".join(sorted(f"{k}={v}" for k, v in pairs if k != "le"))
+            series_key = f"{base}{{{rest}}}"
+            if store is buckets:
+                if le is None:
+                    problems.append(f"line {lineno}: histogram bucket without le label")
+                else:
+                    bound = math.inf if le == "+Inf" else float(le)
+                    buckets.setdefault(series_key, []).append((bound, parsed))
+            else:
+                counts[series_key] = parsed
+    for series_key, entries in buckets.items():
+        bounds = [b for b, _ in entries]
+        if bounds != sorted(bounds):
+            problems.append(f"{series_key}: bucket bounds not increasing")
+        cumulative = [c for _, c in entries]
+        if any(a > b for a, b in zip(cumulative, cumulative[1:])):
+            problems.append(f"{series_key}: bucket counts not cumulative")
+        if not entries or entries[-1][0] != math.inf:
+            problems.append(f"{series_key}: missing +Inf bucket")
+        elif series_key in counts and counts[series_key] != entries[-1][1]:
+            problems.append(
+                f"{series_key}: _count {counts[series_key]} != +Inf bucket "
+                f"{entries[-1][1]}"
+            )
+    return problems
 
 
 # Process-wide default registry: core.client's HTTP metrics and anything else

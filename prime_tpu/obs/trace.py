@@ -11,6 +11,16 @@ one JSON object per line — the offline-analysis format (each line:
 spans order and subtract exactly; ``start_unix_s`` anchors them to wall
 time for cross-process correlation).
 
+**Cross-process propagation** (docs/observability.md): trace and span ids
+are W3C trace-context hex (32-char trace id, 16-char span id), carried
+between processes in the standard ``traceparent`` HTTP header
+(``00-<trace_id>-<parent_span_id>-<flags>``). :func:`parse_traceparent` /
+:meth:`TraceContext.to_header` are the one inject/extract owner; a span
+opened with ``span(name, context=ctx)`` joins the inbound trace instead of
+starting a fresh one, so one trace id follows a request from the SDK call
+through the fleet router down to engine dispatch. The JSONL schema is
+unchanged — the ids inside it simply agree across processes now.
+
 The module-level ``TRACER`` is disabled unless ``PRIME_TRACE`` names a JSONL
 path in the environment — a disabled tracer's ``span()`` returns a no-op
 context, keeping the hot paths free of tracing cost by default.
@@ -18,14 +28,80 @@ context, keeping the hot paths free of tracing cost by default.
 
 from __future__ import annotations
 
-import itertools
 import json
 import os
+import secrets
+import re
 import sys
 import threading
 import time
 from collections import deque
 from typing import Any, TextIO
+
+TRACEPARENT_HEADER = "traceparent"
+
+# version "00" is exactly 4 dash-separated fields; future versions may append
+# more, which per the spec must be tolerated (parse the known prefix)
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})(-.*)?$"
+)
+
+
+class TraceContext:
+    """A W3C trace-context pair: the trace id plus the span id of the parent
+    hop. Immutable value object — ``span(..., context=ctx)`` opens a child
+    of it, ``to_header()`` serializes it for the wire."""
+
+    __slots__ = ("trace_id", "span_id", "flags")
+
+    def __init__(self, trace_id: str, span_id: str, flags: int = 1) -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.flags = flags
+
+    @classmethod
+    def generate(cls) -> "TraceContext":
+        return cls(secrets.token_hex(16), secrets.token_hex(8))
+
+    def to_header(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{self.flags:02x}"
+
+    def __repr__(self) -> str:  # debugging/test output
+        return f"TraceContext({self.to_header()})"
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, TraceContext)
+            and self.trace_id == other.trace_id
+            and self.span_id == other.span_id
+        )
+
+
+def parse_traceparent(header: str | None) -> TraceContext | None:
+    """Extract a TraceContext from a ``traceparent`` header value, or None
+    when absent/malformed. Malformed means: wrong field shapes, the invalid
+    version ``ff``, an all-zero trace or span id, or (for version 00) extra
+    trailing fields. A restart of the trace is the correct degradation for
+    every one of these — never raise on hostile header input."""
+    if not header or not isinstance(header, str):
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, span_id, flags, extra = m.groups()
+    if version == "ff":
+        return None
+    if version == "00" and extra:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return TraceContext(trace_id, span_id, int(flags, 16))
+
+
+def new_traceparent() -> str:
+    """A fresh root ``traceparent`` value — what the outermost hop (the SDK
+    client) injects when no trace is in progress."""
+    return TraceContext.generate().to_header()
 
 
 class Span:
@@ -54,6 +130,16 @@ class Span:
     def set_attr(self, key: str, value: Any) -> None:
         self.attrs[key] = value
 
+    def context(self) -> TraceContext:
+        """This span as a propagation context: children opened under it —
+        including in another process — parent to this span's id."""
+        return TraceContext(self.trace_id, self.span_id)
+
+    def traceparent(self) -> str:
+        """``traceparent`` header value for outbound requests made while
+        this span is open (the remote side's spans become its children)."""
+        return self.context().to_header()
+
     def to_dict(self) -> dict[str, Any]:
         return {
             "name": self.name,
@@ -74,6 +160,14 @@ class _NullSpan:
 
     def set_attr(self, key: str, value: Any) -> None:
         pass
+
+    def context(self) -> None:
+        return None
+
+    def traceparent(self) -> None:
+        # callers inject the header only when a real span produced one, so
+        # an untraced process transparently passes inbound context through
+        return None
 
     def __enter__(self) -> "_NullSpan":
         return self
@@ -117,27 +211,90 @@ class Tracer:
         self._local = threading.local()
         self._lock = threading.Lock()
         self._finished: deque[Span] = deque(maxlen=max_spans)
-        self._ids = itertools.count(1)
         self._sink_path = os.fspath(sink_path) if sink_path is not None else None
         self._sink: TextIO | None = None
 
     # -- span lifecycle -------------------------------------------------------
 
-    def span(self, name: str, *, parent: Span | None = None, **attrs: Any):
+    def span(
+        self,
+        name: str,
+        *,
+        parent: Span | None = None,
+        context: TraceContext | None = None,
+        **attrs: Any,
+    ):
         """Context manager timing ``name``; yields the live Span (mutable via
         ``set_attr``). ``parent`` overrides the thread-local nesting — pass a
-        request's root span to parent work done on another thread."""
+        request's root span to parent work done on another thread.
+        ``context`` (a :class:`TraceContext`, e.g. from an inbound
+        ``traceparent`` header) joins an existing — possibly remote — trace:
+        the span adopts its trace id and parents to its span id. Precedence:
+        explicit ``parent`` > explicit ``context`` > thread-local stack."""
         if not self.enabled:
             return _NULL_SPAN
-        stack = self._stack()
-        if parent is None and stack:
-            parent = stack[-1]
-        span_id = f"s{next(self._ids):x}"
+        if parent is None and context is None:
+            stack = self._stack()
+            if stack:
+                parent = stack[-1]
+        span_id = secrets.token_hex(8)
         if parent is not None:
             trace_id, parent_id = parent.trace_id, parent.span_id
+        elif context is not None:
+            trace_id, parent_id = context.trace_id, context.span_id
         else:
-            trace_id, parent_id = f"t{next(self._ids):x}", None
+            trace_id, parent_id = secrets.token_hex(16), None
         return _SpanContext(self, Span(name, dict(attrs), trace_id, span_id, parent_id))
+
+    def emit(
+        self,
+        name: str,
+        duration_s: float,
+        *,
+        context: TraceContext | None = None,
+        ago_s: float | None = None,
+        **attrs: Any,
+    ) -> None:
+        """Record an already-finished span: a region measured elsewhere
+        (queue wait observed at admission, a flight-recorder timeline
+        persisted after the fact). The span ends ``ago_s`` seconds in the
+        past (default 0: it ends now) and lasted ``duration_s``."""
+        if not self.enabled:
+            return
+        end_ago = ago_s if ago_s is not None else 0.0
+        span_id = secrets.token_hex(8)
+        if context is not None:
+            trace_id, parent_id = context.trace_id, context.span_id
+        else:
+            trace_id, parent_id = secrets.token_hex(16), None
+        span = Span(name, dict(attrs), trace_id, span_id, parent_id)
+        span.start_unix_s = time.time() - end_ago - duration_s
+        span.start_s = time.monotonic() - end_ago - duration_s
+        span.duration_s = duration_s
+        with self._lock:
+            self._finished.append(span)
+            self._write_sink(span)
+
+    def reconfigure(
+        self,
+        enabled: bool | None = None,
+        sink_path: str | os.PathLike | None | object = "__keep__",
+    ) -> dict[str, Any]:
+        """Flip tracing on/off or repoint the sink at runtime (tests, the CI
+        serve-smoke harness). Returns the previous settings so callers can
+        restore them: ``TRACER.reconfigure(**prev)``."""
+        with self._lock:
+            prev = {"enabled": self.enabled, "sink_path": self._sink_path}
+            if enabled is not None:
+                self.enabled = enabled
+            if sink_path != "__keep__":
+                if self._sink is not None:
+                    self._sink.close()
+                    self._sink = None
+                self._sink_path = (
+                    os.fspath(sink_path) if sink_path is not None else None
+                )
+        return prev
 
     def _stack(self) -> list[Span]:
         stack = getattr(self._local, "stack", None)
@@ -155,22 +312,27 @@ class Tracer:
             stack.pop()
         with self._lock:
             self._finished.append(span)
-            if self._sink_path is not None:
-                # a broken sink (bad PRIME_TRACE path, disk full) must never
-                # fail the traced code path — telemetry misconfiguration
-                # cannot be allowed to take down serving. Disable the sink on
-                # the first error; the in-memory ring keeps working.
-                try:
-                    if self._sink is None:
-                        self._sink = open(self._sink_path, "a", buffering=1)
-                    self._sink.write(json.dumps(span.to_dict(), default=str) + "\n")
-                except OSError as e:
-                    sys.stderr.write(
-                        f"prime_tpu.obs.trace: disabling span sink "
-                        f"{self._sink_path!r}: {e}\n"
-                    )
-                    self._sink_path = None
-                    self._sink = None
+            self._write_sink(span)
+
+    def _write_sink(self, span: Span) -> None:
+        """Append a finished span to the JSONL sink (caller holds the lock).
+        A broken sink (bad PRIME_TRACE path, disk full) must never fail the
+        traced code path — telemetry misconfiguration cannot be allowed to
+        take down serving. Disable the sink on the first error; the
+        in-memory ring keeps working."""
+        if self._sink_path is None:
+            return
+        try:
+            if self._sink is None:
+                self._sink = open(self._sink_path, "a", buffering=1)
+            self._sink.write(json.dumps(span.to_dict(), default=str) + "\n")
+        except OSError as e:
+            sys.stderr.write(
+                f"prime_tpu.obs.trace: disabling span sink "
+                f"{self._sink_path!r}: {e}\n"
+            )
+            self._sink_path = None
+            self._sink = None
 
     # -- export ---------------------------------------------------------------
 

@@ -67,8 +67,9 @@ from typing import Any
 
 import numpy as np
 
+from prime_tpu.obs.flight import FlightRecorder
 from prime_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS, DEFAULT_TOKEN_BUCKETS, Registry
-from prime_tpu.obs.trace import TRACER
+from prime_tpu.obs.trace import TRACER, TraceContext
 from prime_tpu.serve.errors import DrainingError, QueueFullError
 from prime_tpu.serve.prefix_cache import BlockPrefixCache
 
@@ -204,6 +205,9 @@ class EngineRequest:
     submitted_at: float = 0.0
     admitted_at: float = 0.0
     first_token_at: float = 0.0
+    # W3C trace context from the inbound hop (server → submit): engine spans
+    # for this request join the caller's distributed trace through it
+    trace: TraceContext | None = None
 
     def cancel(self) -> None:
         """Abandon the request (e.g. the streaming client disconnected). The
@@ -480,6 +484,10 @@ class ContinuousBatchingEngine:
         self._m_warmup_s = r.gauge(
             "serve_warmup_seconds", "Wall seconds the AOT warmup pass took"
         )
+        # always-on flight recorder (obs/flight.py): bounded per-request
+        # timelines readable at GET /debug/requests even with tracing off;
+        # PRIME_SERVE_SLOW_MS auto-persists slow timelines to the trace sink
+        self.flight = FlightRecorder()
         self._t0 = time.monotonic()
         # stats() snapshot, ticked by the engine loop (ADVICE engine.py:1008):
         # HTTP handler threads read the last end-of-tick snapshot under this
@@ -758,7 +766,9 @@ class ContinuousBatchingEngine:
                 old_len = len(self._histories[slot])
                 self._histories[slot].extend(out)
                 self._index_bigrams(slot, old_len)
-                self._emit(self._requests[slot], out)
+                req = self._requests[slot]
+                self.flight.event(req.id, "chunk", accepted=len(out))
+                self._emit(req, out)
 
     # ---- AOT warmup ----
 
@@ -935,6 +945,7 @@ class ContinuousBatchingEngine:
         max_new_tokens: int = 128,
         temperature: float = 0.0,
         top_p: float = 1.0,
+        trace: TraceContext | None = None,
     ) -> EngineRequest:
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -965,6 +976,13 @@ class ContinuousBatchingEngine:
             temperature=temperature,
             top_p=top_p,
             submitted_at=time.monotonic(),
+            trace=trace,
+        )
+        self.flight.begin(
+            req.id,
+            trace_id=trace.trace_id if trace is not None else None,
+            prompt_tokens=len(prompt_ids),
+            max_new_tokens=max_new_tokens,
         )
         self._pending.put(req)
         self._wake.set()
@@ -1053,7 +1071,24 @@ class ContinuousBatchingEngine:
             if req is not None:
                 req.error = "engine shut down"
                 req.done = True
+                self._retire_flight(req, "failed", error="engine shut down")
                 req.events.put(None)
+
+    def _retire_flight(self, req: EngineRequest, outcome: str, **fields: Any) -> None:
+        """Close a request's flight-recorder timeline and emit its summary
+        span (``serve.request``, submit → retirement) under the request's
+        distributed trace — the one engine span a cross-process waterfall is
+        guaranteed to have per request. Idempotent via FlightRecorder.end."""
+        self.flight.end(req.id, outcome, tokens=req.emitted, **fields)
+        if req.submitted_at:
+            TRACER.emit(
+                "serve.request",
+                time.monotonic() - req.submitted_at,
+                context=req.trace,
+                request=req.id,
+                outcome=outcome,
+                tokens=req.emitted,
+            )
 
     def _fail_in_flight(self, message: str) -> None:
         # drop any dispatched-but-unfetched lookahead chunks: their donated
@@ -1064,6 +1099,7 @@ class ContinuousBatchingEngine:
             req.error = message
             req.done = True
             self._m_failed.inc()
+            self._retire_flight(req, "failed", error=message[:200])
             req.events.put(None)
             self._active[slot] = False
             self._requests.pop(slot, None)
@@ -1238,6 +1274,7 @@ class ContinuousBatchingEngine:
                 # retirement is this whole chunk row
                 self._m_wasted_tokens.inc(self.chunk)
                 continue
+            self.flight.event(req.id, "chunk", seq=chunk.seq)
             self._emit(req, toks_host[slot].tolist())
 
     def _retire_cancelled(self) -> None:
@@ -1248,6 +1285,7 @@ class ContinuousBatchingEngine:
             if req.cancelled:
                 req.done = True
                 self._m_cancelled.inc()
+                self._retire_flight(req, "cancelled")
                 req.events.put(None)
                 self._active[slot] = False
                 self._requests.pop(slot, None)
@@ -1273,6 +1311,7 @@ class ContinuousBatchingEngine:
                 if req.cancelled:
                     # client went away while queued: don't pay the prefill
                     req.done = True
+                    self._retire_flight(req, "cancelled")
                     req.events.put(None)
                     continue
                 burst.append(req)
@@ -1292,6 +1331,7 @@ class ContinuousBatchingEngine:
                 except ValueError as e:
                     req.error = f"prefill failed: {e}"
                     req.done = True
+                    self._retire_flight(req, "failed", error=str(e)[:200])
                     req.events.put(None)
                     continue
                 if self._prefix_match_len(ids) > 0:
@@ -1306,6 +1346,7 @@ class ContinuousBatchingEngine:
                 except Exception as e:  # noqa: BLE001 — keep the loop alive
                     req.error = f"prefill failed: {e}"
                     req.done = True
+                    self._retire_flight(req, "failed", error=str(e)[:200])
                     req.events.put(None)
             for (row_cb, plan), reqs in groups.items():
                 # power-of-two sub-batches (largest first): the compile set
@@ -1326,6 +1367,7 @@ class ContinuousBatchingEngine:
                         for req in sub:
                             req.error = f"prefill failed: {e}"
                             req.done = True
+                            self._retire_flight(req, "failed", error=str(e)[:200])
                             req.events.put(None)
 
     def _prefill(self, req: EngineRequest, slot: int) -> None:
@@ -1339,14 +1381,20 @@ class ContinuousBatchingEngine:
         ids = req.prompt_ids
         t_start = time.monotonic()
         if req.submitted_at:
-            self._m_queue_wait.observe(t_start - req.submitted_at)
+            wait = t_start - req.submitted_at
+            self._m_queue_wait.observe(wait)
+            TRACER.emit("serve.queue_wait", wait, context=req.trace, request=req.id)
         req.admitted_at = t_start
+        self.flight.event(req.id, "admitted", slot=slot)
         row_cb = row_capacity_for(len(ids), self.prefill_chunk, self.capacity)
-        start, row = self._prefix_seed(ids, row_cb)
+        start, row = self._prefix_seed(ids, row_cb, ctx=req.trace)
         plan = chunk_plan(start, len(ids), self.prefill_chunk, row_cb)
         logits = None
         self._rng, rng = jax.random.split(self._rng)
-        with TRACER.span("serve.prefill", slot=slot, prompt_len=len(ids)), self._mesh_ctx():
+        with TRACER.span(
+            "serve.prefill", context=req.trace, slot=slot,
+            prompt_len=len(ids), request=req.id,
+        ), self._mesh_ctx():
             for off, size in plan:
                 chunk_ids = ids[off : off + size]
                 chunk_ids += [self.pad_id] * (size - len(chunk_ids))
@@ -1374,6 +1422,11 @@ class ContinuousBatchingEngine:
             )
         first = int(firsts[0])  # host sync: the prefill really finished here
         self._m_prefill_s.observe(time.monotonic() - t_start)
+        self.flight.event(
+            req.id, "prefill_done",
+            ms=round((time.monotonic() - t_start) * 1e3, 3),
+            prefix_hit_tokens=start,
+        )
         self._m_admit_batch.observe(1)
         self._store_prefix(ids, row)
         self._m_admitted.inc()
@@ -1411,10 +1464,13 @@ class ContinuousBatchingEngine:
             self._finalize_batch_fn = self._make_finalize_batch()
         n = len(reqs)
         t_start = time.monotonic()
-        for req in reqs:
+        for slot, req in zip(slots, reqs):
             if req.submitted_at:
-                self._m_queue_wait.observe(t_start - req.submitted_at)
+                wait = t_start - req.submitted_at
+                self._m_queue_wait.observe(wait)
+                TRACER.emit("serve.queue_wait", wait, context=req.trace, request=req.id)
             req.admitted_at = t_start
+            self.flight.event(req.id, "admitted", slot=slot, wave=n)
         self._rng, rng = jax.random.split(self._rng)
         row = init_cache(self.config, n, row_cb, dtype=self._dtype, quantized=self.kv_quant)
         logits = None
@@ -1449,7 +1505,18 @@ class ContinuousBatchingEngine:
         )
         self._store_prefix(reqs[0].prompt_ids, row0)
         firsts_host = [int(t) for t in np.asarray(firsts)]  # host sync
-        self._m_prefill_s.observe(time.monotonic() - t_start)
+        prefill_s = time.monotonic() - t_start
+        prefill_ms = round(prefill_s * 1e3, 3)
+        self._m_prefill_s.observe(prefill_s)
+        for req in reqs:
+            self.flight.event(req.id, "prefill_done", ms=prefill_ms, wave=n)
+            # per-request prefill attribution under each request's OWN trace
+            # (the batched wave span above is process-local): the wave's wall
+            # time is every member's prefill time — they shared the dispatch
+            TRACER.emit(
+                "serve.prefill", prefill_s, context=req.trace,
+                request=req.id, batch=n, prompt_len=len(req.prompt_ids),
+            )
         self._m_admit_batch.observe(n)
         self._m_admitted.inc(len(reqs))
         if n > 1:
@@ -1575,7 +1642,7 @@ class ContinuousBatchingEngine:
 
         return jax.jit(assemble, static_argnums=(1, 2))
 
-    def _prefix_seed(self, ids: list[int], row_cb: int):
+    def _prefix_seed(self, ids: list[int], row_cb: int, ctx: TraceContext | None = None):
         """Seed an admission's staging row: on a hit, ONE assemble_row
         dispatch splices every matched segment into a fresh row at ``row_cb``
         capacity and returns (start, row) with [0, start) already computed;
@@ -1594,7 +1661,7 @@ class ContinuousBatchingEngine:
             self._assemble_fn = self._make_assemble_row()
         try:
             with TRACER.span(
-                "serve.assemble", hit_tokens=match.length,
+                "serve.assemble", context=ctx, hit_tokens=match.length,
                 segments=len(match.entries), row_capacity=row_cb,
             ):
                 row = self._assemble_fn(match.segments(), match.takes(), row_cb)
@@ -1665,7 +1732,9 @@ class ContinuousBatchingEngine:
         self._m_decode_step_s.observe((time.monotonic() - t_start) / self.chunk)
         for slot in range(self.max_slots):
             if self._active[slot]:
-                self._emit(self._requests[slot], toks_host[slot].tolist())
+                req = self._requests[slot]
+                self.flight.event(req.id, "chunk")
+                self._emit(req, toks_host[slot].tolist())
 
     def _emit(self, req: EngineRequest, token_ids: list[int]) -> None:
         """Feed decoded ids to the request, honoring EOS and max_new_tokens;
@@ -1686,6 +1755,12 @@ class ContinuousBatchingEngine:
                 req.first_token_at = time.monotonic()
                 if req.submitted_at:
                     self._m_ttft.observe(req.first_token_at - req.submitted_at)
+                    self.flight.event(
+                        req.id, "first_token",
+                        ttft_ms=round(
+                            (req.first_token_at - req.submitted_at) * 1e3, 3
+                        ),
+                    )
         if req.done or req.emitted >= req.max_new_tokens:
             req.done = True
             self._m_completed.inc()
@@ -1693,6 +1768,7 @@ class ContinuousBatchingEngine:
                 self._m_tpot.observe(
                     (time.monotonic() - req.first_token_at) / (req.emitted - 1)
                 )
+            self._retire_flight(req, "completed")
             if req.slot >= 0:
                 self._active[req.slot] = False
                 self._requests.pop(req.slot, None)
@@ -1787,6 +1863,12 @@ class EngineBackend:
         the Prometheus exposition at /metrics?format=prometheus."""
         return self.engine.registry
 
+    @property
+    def flight(self):
+        """The engine's flight recorder — InferenceServer serves it at
+        GET /debug/requests[/{id}]."""
+        return self.engine.flight
+
     def submit_text(
         self,
         prompt: str,
@@ -1794,6 +1876,7 @@ class EngineBackend:
         temperature: float,
         top_p: float = 1.0,
         templated: bool = False,
+        trace: TraceContext | None = None,
     ) -> EngineRequest:
         ids = self.tokenizer.encode(prompt, add_special_tokens=not templated)
         # keep the tail if the prompt exceeds what the slot can hold
@@ -1807,7 +1890,7 @@ class EngineBackend:
             )
         return self.engine.submit(
             ids[-keep:], max_new_tokens=max_new_tokens,
-            temperature=temperature, top_p=top_p,
+            temperature=temperature, top_p=top_p, trace=trace,
         )
 
     def stream_text(self, req: EngineRequest, timeout: float | None = 120.0):
@@ -1838,9 +1921,10 @@ class EngineBackend:
         temperature: float,
         top_p: float = 1.0,
         templated: bool = False,
+        trace: TraceContext | None = None,
     ) -> list[str]:
         reqs = [
-            self.submit_text(p, max_new_tokens, temperature, top_p, templated)
+            self.submit_text(p, max_new_tokens, temperature, top_p, templated, trace)
             for p in prompts
         ]
         return [self.tokenizer.decode(r.all_tokens()) for r in reqs]

@@ -39,8 +39,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Iterable
 from urllib.parse import parse_qs, urlsplit
 
+from prime_tpu.obs.flight import FlightRecorder
 from prime_tpu.obs.metrics import Registry
-from prime_tpu.obs.trace import TRACER
+from prime_tpu.obs.trace import (
+    TRACEPARENT_HEADER,
+    TRACER,
+    TraceContext,
+    parse_traceparent,
+)
 from prime_tpu.serve.errors import backpressure_response
 from prime_tpu.serve.fleet.balancer import PrefixAffinityBalancer
 from prime_tpu.serve.fleet.membership import BREAKER_GAUGE, FleetMembership
@@ -71,6 +77,15 @@ def _forward_headers(headers) -> dict[str, str]:
     }
     out.setdefault("Content-Type", "application/json")
     return out
+
+
+def _flight_key(trace: TraceContext) -> str:
+    """Flight-recorder timeline key for one routed request. One W3C trace id
+    may legally cover several concurrent requests (a traced client fanning
+    out shares the trace id across calls), so the key qualifies it with the
+    parent span id; lookups by bare trace id still resolve through
+    FlightRecorder.get's trace-id fallback (newest match wins)."""
+    return f"{trace.trace_id}.{trace.span_id}"
 
 
 class _AdmissionGate:
@@ -160,6 +175,11 @@ class FleetRouter:
         self._read_timeout = read_timeout
         self._client = None
         self._client_lock = threading.Lock()
+        # router-hop flight recorder (obs/flight.py): one timeline per chat,
+        # keyed by trace id + parent span id (_flight_key) and carrying the
+        # trace id — GET /debug/requests/{id} merges it with the serving
+        # replica's own timeline for the same trace id
+        self.flight = FlightRecorder()
 
         self.registry = Registry()
         r = self.registry
@@ -252,6 +272,22 @@ class FleetRouter:
                         self._json(200, outer.stats())
                 elif path == "/admin/fleet":
                     self._json(200, {"replicas": outer.membership.snapshot()})
+                elif path.rstrip("/") == "/debug/requests" or path.startswith(
+                    "/debug/requests/"
+                ):
+                    # auth parity with the admin surface: timelines expose
+                    # replica ids and error strings
+                    if not outer._admin_authorized(self.headers):
+                        self._json(403, {"error": {"message": "admin token required"}})
+                        return
+                    request_id = path[len("/debug/requests/"):].strip("/") if (
+                        path.startswith("/debug/requests/")
+                    ) else ""
+                    if request_id:
+                        status, payload = outer.debug_request(request_id)
+                        self._json(status, payload)
+                    else:
+                        self._json(200, {"router": outer.flight.summaries()})
                 elif path.endswith("/models") or "/models/" in path:
                     status, payload = outer._proxy_models(path)
                     self._json(status, payload)
@@ -378,11 +414,29 @@ class FleetRouter:
             if isinstance(messages, list) and all(isinstance(m, dict) for m in messages)
             else None
         )
+        # join the client's distributed trace (or start one): the SAME trace
+        # id is forwarded to the replica and keys both processes' flight-
+        # recorder timelines, so /debug/requests/{id} works fleet-wide with
+        # or without a PRIME_TRACE sink. Header names are case-insensitive
+        # and _forward_headers preserved the client's casing — match any,
+        # and drop the inbound key so the forwarded request carries exactly
+        # one traceparent (the attempt span's).
+        inbound_tp = None
+        for name in [n for n in headers if n.lower() == TRACEPARENT_HEADER]:
+            value = headers.pop(name)
+            inbound_tp = inbound_tp or value
+        trace = parse_traceparent(inbound_tp)
+        if trace is None:
+            trace = TraceContext.generate()
+        fkey = _flight_key(trace)
+        self.flight.begin(fkey, trace_id=trace.trace_id)
         t_wait = time.monotonic()
         admitted = self._gate.acquire(timeout=self.queue_wait_s)
-        self._m_queue_wait.observe(time.monotonic() - t_wait)
+        wait_s = time.monotonic() - t_wait
+        self._m_queue_wait.observe(wait_s)
         if not admitted:
             self._m_rejected.inc()
+            self.flight.end(fkey, "rejected_429", wait_ms=round(wait_s * 1e3, 3))
             handler._json(
                 *backpressure_response(
                     "fleet saturated: router admission queue is full",
@@ -390,22 +444,37 @@ class FleetRouter:
                 )
             )
             return
+        self.flight.event(fkey, "admitted", wait_ms=round(wait_s * 1e3, 3))
         self._m_inflight.set(self._gate.inflight)
+        outcome = "error"
         try:
-            with TRACER.span("fleet.route"):
-                self._route_chat(handler, raw, prompt, headers)
+            with TRACER.span("fleet.route", context=trace):
+                outcome = self._route_chat(handler, raw, prompt, headers, trace)
         finally:
             self._gate.release()
             self._m_inflight.set(self._gate.inflight)
+            self.flight.end(fkey, outcome)
 
     def _route_chat(
-        self, handler, raw: bytes, prompt: str | None, headers: dict[str, str]
-    ) -> None:
+        self,
+        handler,
+        raw: bytes,
+        prompt: str | None,
+        headers: dict[str, str],
+        trace: TraceContext,
+    ) -> str:
         """Pick → forward → (maybe) retry elsewhere. Retries only ever happen
         before a single response byte reached the client, so the request is
-        replayable by construction."""
+        replayable by construction. Returns the flight-recorder outcome.
+
+        Each forward attempt opens a ``fleet.attempt`` span (child of
+        ``fleet.route``) and the replica receives THAT span's traceparent —
+        so a failover request's replica spans hang under the attempt that
+        actually reached them. With tracing off, the inbound/generated trace
+        context is forwarded verbatim so the ids still agree fleet-wide."""
         import httpx
 
+        fkey = _flight_key(trace)
         excluded: set[str] = set()
         upstream_429: tuple[int, dict, dict] | None = None
         first_attempt = True
@@ -430,56 +499,98 @@ class FleetRouter:
                     )
                 if pick.rerouted:
                     self._m_reroutes.inc(reason="saturated")
+                    self.flight.event(
+                        fkey, "reroute", reason="saturated"
+                    )
             url = f"{replica.url}/v1/chat/completions"
-            try:
-                with self._http().stream("POST", url, content=raw, headers=headers) as response:
-                    if response.status_code == 429:
-                        response.read()
-                        self.membership.note_success(replica.id)
-                        self._m_requests.inc(replica=replica.id, outcome="upstream_429")
-                        self._m_reroutes.inc(reason="upstream_429")
-                        upstream_429 = self._forwardable(response)
-                        excluded.add(replica.id)
-                        continue
-                    if response.status_code == 503:
-                        # loading or draining: the poller will learn the
-                        # state soon; this request goes elsewhere now
-                        response.read()
-                        self.membership.note_success(replica.id)
-                        self._m_requests.inc(replica=replica.id, outcome="upstream_503")
-                        self._m_reroutes.inc(reason="upstream_503")
-                        excluded.add(replica.id)
-                        continue
-                    self.membership.note_success(replica.id)
-                    self._forward_response(handler, replica, response)
-                    return
-            except (httpx.ConnectError, httpx.ConnectTimeout, httpx.RemoteProtocolError):
-                # connect refused/timed out, or the replica dropped the
-                # connection before a response (a dying server closing its
-                # pooled keep-alives looks like this): either way not one
-                # response byte reached the client, so the request is safely
-                # replayable elsewhere — and the breaker learns about the
-                # dead replica. Mid-SSE failures never take this path (they
-                # are contained in _forward_response after bytes flowed).
-                self.membership.note_failure(replica.id)
-                self._m_requests.inc(replica=replica.id, outcome="connect_error")
-                self._m_reroutes.inc(reason="connect_error")
-                excluded.add(replica.id)
-                continue
-            except httpx.HTTPError as e:
-                # transport died mid-request (headers or body partially
-                # exchanged): NOT replayable — surface a 502
-                self._m_requests.inc(replica=replica.id, outcome="transport_error")
-                handler._json(
-                    502, {"error": {"message": f"upstream {replica.id} failed: {e}"}}
+            self.flight.event(fkey, "attempt", replica=replica.id)
+            with TRACER.span("fleet.attempt", replica=replica.id) as attempt:
+                headers = dict(headers)
+                headers[TRACEPARENT_HEADER] = (
+                    attempt.traceparent() or trace.to_header()
                 )
-                return
+                try:
+                    with self._http().stream(
+                        "POST", url, content=raw, headers=headers
+                    ) as response:
+                        if response.status_code == 429:
+                            response.read()
+                            self.membership.note_success(replica.id)
+                            self._m_requests.inc(replica=replica.id, outcome="upstream_429")
+                            self._m_reroutes.inc(reason="upstream_429")
+                            attempt.set_attr("outcome", "upstream_429")
+                            self.flight.event(
+                                fkey, "reroute",
+                                reason="upstream_429", replica=replica.id,
+                            )
+                            upstream_429 = self._forwardable(response)
+                            excluded.add(replica.id)
+                            continue
+                        if response.status_code == 503:
+                            # loading or draining: the poller will learn the
+                            # state soon; this request goes elsewhere now
+                            response.read()
+                            self.membership.note_success(replica.id)
+                            self._m_requests.inc(replica=replica.id, outcome="upstream_503")
+                            self._m_reroutes.inc(reason="upstream_503")
+                            attempt.set_attr("outcome", "upstream_503")
+                            self.flight.event(
+                                fkey, "reroute",
+                                reason="upstream_503", replica=replica.id,
+                            )
+                            excluded.add(replica.id)
+                            continue
+                        self.membership.note_success(replica.id)
+                        attempt.set_attr("outcome", f"http_{response.status_code}")
+                        # the timeline remembers WHICH replica served it —
+                        # /debug/requests/{id} proxies that replica for its
+                        # engine-side view of the same trace id
+                        self.flight.annotate(fkey, replica=replica.id)
+                        self.flight.event(
+                            fkey, "forwarded",
+                            replica=replica.id, status=response.status_code,
+                        )
+                        self._forward_response(handler, replica, response)
+                        return (
+                            "ok"
+                            if response.status_code < 400
+                            else f"http_{response.status_code}"
+                        )
+                except (httpx.ConnectError, httpx.ConnectTimeout, httpx.RemoteProtocolError):
+                    # connect refused/timed out, or the replica dropped the
+                    # connection before a response (a dying server closing its
+                    # pooled keep-alives looks like this): either way not one
+                    # response byte reached the client, so the request is
+                    # safely replayable elsewhere — and the breaker learns
+                    # about the dead replica. Mid-SSE failures never take
+                    # this path (they are contained in _forward_response
+                    # after bytes flowed).
+                    self.membership.note_failure(replica.id)
+                    self._m_requests.inc(replica=replica.id, outcome="connect_error")
+                    self._m_reroutes.inc(reason="connect_error")
+                    attempt.set_attr("outcome", "connect_error")
+                    self.flight.event(
+                        fkey, "reroute",
+                        reason="connect_error", replica=replica.id,
+                    )
+                    excluded.add(replica.id)
+                    continue
+                except httpx.HTTPError as e:
+                    # transport died mid-request (headers or body partially
+                    # exchanged): NOT replayable — surface a 502
+                    self._m_requests.inc(replica=replica.id, outcome="transport_error")
+                    attempt.set_attr("outcome", "transport_error")
+                    handler._json(
+                        502, {"error": {"message": f"upstream {replica.id} failed: {e}"}}
+                    )
+                    return "transport_error"
         if upstream_429 is not None:
             # every replica is shedding load: propagate the 429 (+Retry-After)
             status, payload, headers = upstream_429
             handler._json(status, payload, headers)
-            return
+            return "upstream_429"
         handler._json(503, {"error": {"message": "no routable replica in the fleet"}})
+        return "no_replica"
 
     @staticmethod
     def _forwardable(response) -> tuple[int, dict, dict]:
@@ -545,6 +656,39 @@ class FleetRouter:
         )
 
     # ---- observability ---------------------------------------------------
+
+    def debug_request(self, request_id: str) -> tuple[int, dict]:
+        """GET /debug/requests/{id}: the router's hop timeline merged with
+        the serving replica's own flight-recorder view of the same id (the
+        shared trace id makes the cross-process lookup work). The replica
+        fetch is best-effort — a dead replica still leaves the router hop."""
+        import httpx
+
+        local = self.flight.get(request_id)
+        if local is None:
+            return 404, {"error": {"message": f"no request {request_id!r}"}}
+        payload: dict = {"router": local, "replica": None}
+        replica_id = local.get("replica")
+        with self.membership._lock:
+            replica = self.membership.replicas.get(replica_id)
+            url = replica.url if replica is not None else None
+        if url:
+            request_headers = (
+                {"Authorization": f"Bearer {self.admin_token}"}
+                if self.admin_token
+                else None
+            )
+            try:
+                response = self._http().get(
+                    f"{url}/debug/requests/{local.get('trace_id') or request_id}",
+                    headers=request_headers,
+                    timeout=self.membership.probe_timeout,
+                )
+                if response.status_code == 200:
+                    payload["replica"] = response.json()
+            except (httpx.HTTPError, ValueError):
+                pass
+        return 200, payload
 
     def healthz(self) -> dict:
         routable = self.membership.routable_replicas()

@@ -32,8 +32,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 from urllib.parse import parse_qs, urlsplit
 
+from prime_tpu.obs.flight import FlightRecorder
 from prime_tpu.obs.metrics import Registry
-from prime_tpu.obs.trace import TRACER
+from prime_tpu.obs.trace import (
+    TRACEPARENT_HEADER,
+    TRACER,
+    TraceContext,
+    parse_traceparent,
+)
 from prime_tpu.serve.errors import DrainingError, QueueFullError, backpressure_response
 
 CHAT_TEMPLATE = "{role}: {content}\n"
@@ -77,6 +83,8 @@ def _route_label(path: str) -> str:
         return "/healthz"
     if p.startswith("/admin/"):
         return "/admin"
+    if p.startswith("/debug/"):
+        return "/debug"
     return "other"
 
 
@@ -117,6 +125,10 @@ class InferenceServer:
         self._inflight_chats = 0
         self._inflight_lock = threading.Lock()
         self._lock = threading.Lock()  # one generation on the chip at a time
+        # flight recorder for backends without their own (the continuous-
+        # batching engine records richer timelines itself; the /debug
+        # endpoints prefer generator.flight when it exists)
+        self._own_flight = FlightRecorder()
         # server-side HTTP metrics live in the server's own registry; the
         # backing engine's registry (generator.registry, when present) is
         # rendered alongside it by the Prometheus exposition
@@ -202,6 +214,29 @@ class InferenceServer:
                     # the listener is up — loading and draining are healthy
                     # states for a process, just not routable ones
                     self._json(200, {"status": "ok"})
+                elif path.rstrip("/") == "/debug/requests" or path.startswith(
+                    "/debug/requests/"
+                ):
+                    # flight-recorder view: timelines carry prompt sizes and
+                    # error strings, so auth parity with the admin surface —
+                    # when an admin token gates /admin/drain it gates this too
+                    if not outer._admin_authorized(self.headers):
+                        self._json(403, {"error": {"message": "admin token required"}})
+                        return
+                    request_id = path[len("/debug/requests/"):].strip("/") if (
+                        path.startswith("/debug/requests/")
+                    ) else ""
+                    if request_id:
+                        timeline = outer.flight_recorder().get(request_id)
+                        if timeline is None:
+                            self._json(
+                                404,
+                                {"error": {"message": f"no request {request_id!r}"}},
+                            )
+                        else:
+                            self._json(200, timeline)
+                    else:
+                        self._json(200, outer.flight_recorder().summaries())
                 elif path.rstrip("/").endswith(f"/models/{outer.model_id}"):
                     self._json(200, {"id": outer.model_id, "object": "model"})
                 else:
@@ -218,10 +253,7 @@ class InferenceServer:
                 if urlsplit(self.path).path == "/admin/drain":
                     # graceful-drain hook (k8s preStop / fleet router): stop
                     # taking new work, finish in-flight, report progress
-                    if outer.admin_token is not None and (
-                        self.headers.get("Authorization", "")
-                        != f"Bearer {outer.admin_token}"
-                    ):
+                    if not outer._admin_authorized(self.headers):
                         self._json(403, {"error": {"message": "admin token required"}})
                         return
                     outer.drain()
@@ -240,13 +272,31 @@ class InferenceServer:
                     self._json(400, {"error": {"message": "request body must be an object"}})
                     return
                 want_stream = bool(request.get("stream"))
+                # one trace context per chat: extracted from the inbound
+                # traceparent (SDK/router hop) or generated here, so the
+                # flight recorder always has a cross-process correlation id
+                # — even when tracing itself is off
+                trace = parse_traceparent(self.headers.get(TRACEPARENT_HEADER))
+                if trace is None:
+                    trace = TraceContext.generate()
+                # engine backends record their own (richer) timelines from
+                # submit(); for everything else the server records the hop.
+                # One trace id may cover several concurrent client calls, so
+                # the timeline key qualifies it with the parent span id
+                # (bare-trace-id lookups resolve via FlightRecorder.get)
+                fkey = f"{trace.trace_id}.{trace.span_id}"
+                own_flight = outer.flight_recorder() is outer._own_flight
+                if own_flight:
+                    outer._own_flight.begin(
+                        fkey, trace_id=trace.trace_id, stream=want_stream
+                    )
                 # count the WHOLE chat lifetime (generation + streaming) so a
                 # drain only reports complete once live responses finished
                 with outer._inflight_lock:
                     outer._inflight_chats += 1
                 try:
                     try:
-                        response = outer._chat(request, stream=want_stream)
+                        response = outer._chat(request, stream=want_stream, trace=trace)
                     except Exception as e:  # noqa: BLE001 — a bad request must get a response
                         self._json(400, {"error": {"message": f"bad request: {e}"}})
                         return
@@ -260,6 +310,11 @@ class InferenceServer:
                     else:
                         self._json(200, response)
                 finally:
+                    if own_flight:
+                        outer._own_flight.end(
+                            fkey,
+                            f"http_{getattr(self, '_status_sent', 0)}",
+                        )
                     with outer._inflight_lock:
                         outer._inflight_chats -= 1
 
@@ -453,6 +508,20 @@ class InferenceServer:
         if callable(drain_fn):
             drain_fn()
 
+    def _admin_authorized(self, headers) -> bool:
+        """One gate for every admin-grade surface (/admin/drain,
+        /debug/requests) — mirrors FleetRouter._admin_authorized."""
+        if self.admin_token is None:
+            return True
+        return headers.get("Authorization", "") == f"Bearer {self.admin_token}"
+
+    def flight_recorder(self) -> FlightRecorder:
+        """The flight recorder behind GET /debug/requests: the backing
+        engine's (rich per-chunk timelines) when the generator exposes one,
+        else the server's own HTTP-level recorder."""
+        flight = getattr(self.generator, "flight", None)
+        return flight if isinstance(flight, FlightRecorder) else self._own_flight
+
     # -- request handling -----------------------------------------------------
 
     @staticmethod
@@ -462,7 +531,12 @@ class InferenceServer:
         fleet router treats it as a signal to try a less-loaded replica."""
         return backpressure_response(f"server overloaded: {e}", e.retry_after)
 
-    def _chat(self, request: dict, stream: bool = False):
+    def _chat(
+        self,
+        request: dict,
+        stream: bool = False,
+        trace: TraceContext | None = None,
+    ):
         if self.generator is None:
             return 503, {"error": {"message": "model is still loading"}}
         if self._draining:
@@ -506,13 +580,23 @@ class InferenceServer:
                 kwargs["templated"] = True
         else:
             prompt = render_chat_prompt(messages)
+        if trace is not None and _accepts_kwarg(self.generator.generate, "trace"):
+            # thread the distributed trace down to the engine: its queue-wait
+            # / prefill / per-request spans join the caller's trace id
+            kwargs["trace"] = trace
         # continuous-batching backends stream live and batch across requests
         # themselves — no lock, no whole-turn wait
         if stream and hasattr(self.generator, "submit_text"):
+            submit_kwargs = (
+                {"trace": trace}
+                if trace is not None
+                and _accepts_kwarg(self.generator.submit_text, "trace")
+                else {}
+            )
             try:
                 req = self.generator.submit_text(
                     prompt, max_new_tokens=max_tokens, temperature=temperature,
-                    top_p=top_p, templated=templated,
+                    top_p=top_p, templated=templated, **submit_kwargs,
                 )
             except QueueFullError as e:
                 return self._backpressure(e)
@@ -522,7 +606,10 @@ class InferenceServer:
                 return 500, {"error": {"message": f"generation failed: {e}"}}
             return _LiveStream(self.generator.stream_text(req), request=req)
         try:
-            with TRACER.span("serve.chat", model=self.model_id, max_tokens=max_tokens):
+            with TRACER.span(
+                "serve.chat", context=trace, model=self.model_id,
+                max_tokens=max_tokens,
+            ):
                 if getattr(self.generator, "concurrent", False):
                     completion = self.generator.generate(
                         [prompt], max_new_tokens=max_tokens, temperature=temperature, **kwargs

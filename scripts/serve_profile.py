@@ -17,7 +17,11 @@ Two modes:
   host spent enqueuing it (``serve.dispatch``), how long it later blocked
   fetching the tokens (``serve.sync``), and the host-stall fraction of the
   dispatch→sync window. A well-overlapped engine shows stall fractions near
-  zero; ~1.0 means the loop is effectively synchronous.
+  zero; ~1.0 means the loop is effectively synchronous. ``--trace`` repeats:
+  pass each process's JSONL (client, router, replicas) and spans sharing a
+  W3C trace id are merged into a per-request cross-process waterfall —
+  router queue → replica queue → prefill → decode, with parent→child gaps
+  called out (``--trace-id`` narrows to one request).
 - ``python scripts/serve_profile.py --fleet http://router:8080`` — scrape a
   running `prime serve fleet` router and print the routing report: request
   distribution and outcomes per replica, affinity hit ratio (the fraction of
@@ -59,14 +63,17 @@ def _wrap(obj, name: str) -> None:
     setattr(obj, name, timed)
 
 
-def overlap_report(path: str) -> None:
+def overlap_report(path: str, quiet: bool = False) -> None:
     """Pair serve.dispatch / serve.sync spans by chunk seq and print the
     per-chunk host-stall breakdown plus aggregates. One PRIME_TRACE file can
     hold several engines' spans back-to-back (bench.py builds a fresh engine
     per serve section, each restarting seq at 0): a dispatch whose seq was
     already seen starts a new run, so runs are reported separately instead
     of silently overwriting each other. Concurrent engines interleaving one
-    sink are not disambiguated."""
+    sink are not disambiguated. ``quiet`` suppresses the no-engine-spans
+    diagnostic — in multi-file waterfall mode, router/client files can never
+    contain dispatch/sync pairs, and the hint would read as a serving
+    misconfiguration that does not exist."""
     runs: list[tuple[dict[int, dict], dict[int, dict]]] = [({}, {})]
     with open(path) as f:
         for line in f:
@@ -87,8 +94,12 @@ def overlap_report(path: str) -> None:
                 sync[seq] = span
     runs = [(d, s) for d, s in runs if set(d) & set(s)]
     if not runs:
-        print(f"no paired serve.dispatch/serve.sync spans in {path}")
-        print("(synchronous loop? PRIME_SERVE_OVERLAP=0 emits serve.decode_chunk only)")
+        if not quiet:
+            print(f"no paired serve.dispatch/serve.sync spans in {path}")
+            print(
+                "(synchronous loop? PRIME_SERVE_OVERLAP=0 emits "
+                "serve.decode_chunk only)"
+            )
         return
     tot_stall = tot_window = 0.0
     for i, (dispatch, sync) in enumerate(runs):
@@ -115,6 +126,115 @@ def overlap_report(path: str) -> None:
         f"--- total: stall {tot_stall:.3f}s of {tot_window:.3f}s window "
         f"({frac:.1%} stalled, {1 - frac:.1%} overlapped)"
     )
+
+
+def _load_spans(paths: list[str]) -> list[dict]:
+    """Every parseable span from every file, tagged with its source file —
+    the waterfall marks parent→child edges that cross files as the
+    cross-process hops they are."""
+    spans: list[dict] = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    span = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(span, dict) and "name" in span:
+                    span["_source"] = os.path.basename(path)
+                    spans.append(span)
+    return spans
+
+
+def waterfall_report(paths: list[str], trace_id: str | None = None, limit: int = 5) -> None:
+    """Merge spans from N processes' JSONL files by trace id and print a
+    per-request waterfall: one indented tree per trace, offsets on the
+    shared wall clock (start_unix_s — the cross-process axis; the monotonic
+    start_s only orders within one process), durations, and the gap between
+    each child's start and its parent's, flagged ``[cross-process]`` when
+    the edge spans two files. Gaps are where a distributed request's time
+    goes missing: router queue → replica queue → prefill → decode should
+    tile the root span; a hole is a stall nobody's histogram attributes."""
+    spans = _load_spans(paths)
+    groups: dict[str, list[dict]] = defaultdict(list)
+    for span in spans:
+        tid = span.get("trace_id")
+        if tid:
+            groups[tid].append(span)
+    if trace_id is not None:
+        if trace_id not in groups:
+            print(f"no spans with trace id {trace_id}")
+            return
+        selected = [(trace_id, groups[trace_id])]
+    else:
+        multi = {tid: g for tid, g in groups.items() if len(g) >= 2}
+        if not multi:
+            if trace_id is None and not any(s.get("trace_id") for s in spans):
+                return  # single-process legacy file: the overlap report said it all
+            print("no multi-span traces to stitch (single-span traces only)")
+            return
+        # newest requests first, bounded — a long serve run holds thousands
+        newest = sorted(
+            multi.items(),
+            key=lambda item: max(s.get("start_unix_s", 0) for s in item[1]),
+            reverse=True,
+        )
+        selected = newest[:limit]
+        if len(newest) > limit:
+            print(
+                f"--- showing the {limit} newest of {len(newest)} stitched "
+                "traces (use --trace-id to pick one)"
+            )
+    for tid, group in selected:
+        sources = sorted({s["_source"] for s in group})
+        print(f"--- trace {tid}: {len(group)} spans from {', '.join(sources)}")
+        print(f"{'offset_ms':>10} {'dur_ms':>9}  span")
+        by_id = {s["span_id"]: s for s in group if s.get("span_id")}
+        children: dict[str, list[dict]] = defaultdict(list)
+        roots: list[dict] = []
+        for span in group:
+            parent = by_id.get(span.get("parent_id"))
+            if parent is not None and parent is not span:
+                children[span["parent_id"]].append(span)
+            else:
+                roots.append(span)
+        t0 = min(s.get("start_unix_s", 0.0) for s in group)
+
+        def emit(span: dict, depth: int, parent: dict | None) -> None:
+            start = span.get("start_unix_s", 0.0) - t0
+            dur = span.get("duration_s") or 0.0
+            notes = []
+            if parent is not None:
+                gap = span.get("start_unix_s", 0.0) - parent.get("start_unix_s", 0.0)
+                hop = span["_source"] != parent["_source"]
+                if hop or gap * 1e3 >= 1.0:
+                    notes.append(
+                        f"+{gap * 1e3:.2f} ms after parent"
+                        + (" [cross-process]" if hop else "")
+                    )
+            attrs = span.get("attrs") or {}
+            brief = ", ".join(
+                f"{k}={attrs[k]}"
+                for k in ("replica", "request", "outcome", "slot", "prompt_len", "tokens")
+                if k in attrs
+            )
+            line = f"{start * 1e3:>10.2f} {dur * 1e3:>9.2f}  {'  ' * depth}{span['name']}"
+            if brief:
+                line += f" ({brief})"
+            if notes:
+                line += "  " + " ".join(notes)
+            print(line)
+            for child in sorted(
+                children.get(span.get("span_id"), []),
+                key=lambda s: s.get("start_unix_s", 0.0),
+            ):
+                emit(child, depth + 1, span)
+
+        for root in sorted(roots, key=lambda s: s.get("start_unix_s", 0.0)):
+            emit(root, 0, None)
 
 
 def fleet_report(url: str) -> None:
@@ -161,9 +281,15 @@ def fleet_report(url: str) -> None:
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
-        "--trace", metavar="JSONL", default=None,
+        "--trace", metavar="JSONL", action="append", default=None,
         help="Print the dispatch-vs-sync overlap report from a PRIME_TRACE "
-             "JSONL instead of running the profile.",
+             "JSONL instead of running the profile. Repeatable: multiple "
+             "files (router + replicas) are also stitched by trace id into "
+             "per-request cross-process waterfalls.",
+    )
+    parser.add_argument(
+        "--trace-id", metavar="HEX", default=None,
+        help="With --trace: stitch only this W3C trace id's waterfall.",
     )
     parser.add_argument(
         "--fleet", metavar="ROUTER_URL", default=None,
@@ -172,7 +298,9 @@ def main() -> None:
     )
     args = parser.parse_args()
     if args.trace:
-        overlap_report(args.trace)
+        for path in args.trace:
+            overlap_report(path, quiet=len(args.trace) > 1)
+        waterfall_report(args.trace, trace_id=args.trace_id)
         return
     if args.fleet:
         fleet_report(args.fleet)

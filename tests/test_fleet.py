@@ -566,3 +566,204 @@ def test_router_healthz_unavailable_when_all_replicas_down():
         health = httpx.get(f"{router.url}/healthz", timeout=5)
         assert health.status_code == 503
         assert health.json()["state"] == "unavailable"
+
+
+# ---- distributed tracing & flight recorder ----------------------------------
+
+
+def test_traced_fleet_single_trace_id_and_exposition_lint(tmp_path):
+    """Tentpole acceptance over real HTTP: one traced request through a
+    2-replica fleet leaves router-hop AND replica spans sharing the inbound
+    trace id, parented across the hop — and both processes' Prometheus
+    endpoints pass the exposition lint. (This is the CI serve-smoke traced
+    request; PRIME_TRACE in the job environment exercises the import-time
+    sink path too.)"""
+    import json
+
+    from prime_tpu.obs import TRACER, lint_prometheus_text
+    from prime_tpu.obs.trace import new_traceparent, parse_traceparent
+
+    sink = tmp_path / "fleet-trace.jsonl"
+    prev = TRACER.reconfigure(enabled=True, sink_path=str(sink))
+    try:
+        a, b = FleetBackend("replica-a"), FleetBackend("replica-b")
+        with make_fleet([a, b]) as (router, servers):
+            header = new_traceparent()
+            ctx = parse_traceparent(header)
+            response = httpx.post(
+                f"{router.url}/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": f"{PREAMBLE} traced"}]},
+                headers={"traceparent": header},
+                timeout=30,
+            )
+            assert response.status_code == 200
+            for url in (router.url, servers[0].url, servers[1].url):
+                text = httpx.get(
+                    f"{url}/metrics", params={"format": "prometheus"}, timeout=5
+                ).text
+                assert lint_prometheus_text(text) == [], (url, text)
+    finally:
+        TRACER.reconfigure(**prev)
+    spans = [json.loads(line) for line in sink.read_text().splitlines()]
+    by_name = {s["name"]: s for s in spans}
+    assert {"fleet.route", "fleet.attempt", "serve.chat"} <= set(by_name)
+    # ONE trace id, router to replica, under the client's inbound context
+    assert {s["trace_id"] for s in spans} == {ctx.trace_id}
+    assert by_name["fleet.route"]["parent_id"] == ctx.span_id
+    assert by_name["fleet.attempt"]["parent_id"] == by_name["fleet.route"]["span_id"]
+    assert by_name["serve.chat"]["parent_id"] == by_name["fleet.attempt"]["span_id"]
+
+
+def test_untraced_fleet_still_propagates_ids_for_flight_recorder():
+    """With tracing off (the default), the router still forwards/generates a
+    traceparent so the router and replica flight recorders key the same id —
+    including when the client spells the header 'Traceparent' (header names
+    are case-insensitive; the router must match any casing and forward
+    exactly one copy)."""
+    from prime_tpu.obs.trace import new_traceparent, parse_traceparent
+
+    a = FleetBackend("replica-a")
+    with make_fleet([a]) as (router, servers):
+        header = new_traceparent()
+        response = httpx.post(
+            f"{router.url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": f"{PREAMBLE} x"}]},
+            headers={"Traceparent": header},
+            timeout=30,
+        )
+        assert response.status_code == 200
+        recent = httpx.get(f"{router.url}/debug/requests", timeout=5).json()[
+            "router"
+        ]["recent"]
+        assert recent, "router recorded no timeline"
+        trace_id = recent[0]["trace_id"]
+        assert trace_id == parse_traceparent(header).trace_id
+        # one W3C trace id may cover several requests (a traced client fans
+        # out, reusing the trace id with distinct parent span ids): each gets
+        # its OWN timeline, not a conflated one
+        sibling = f"00-{trace_id}-{'c' * 16}-01"
+        assert (
+            httpx.post(
+                f"{router.url}/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": f"{PREAMBLE} y"}]},
+                headers={"traceparent": sibling},
+                timeout=30,
+            ).status_code
+            == 200
+        )
+        recent = httpx.get(f"{router.url}/debug/requests", timeout=5).json()[
+            "router"
+        ]["recent"]
+        same_trace = [e for e in recent if e["trace_id"] == trace_id]
+        assert len(same_trace) == 2
+        assert len({e["id"] for e in same_trace}) == 2
+        replica_view = httpx.get(
+            f"{servers[0].url}/debug/requests/{trace_id}", timeout=5
+        )
+        assert replica_view.status_code == 200
+        assert replica_view.json()["trace_id"] == trace_id
+
+
+def test_debug_requests_router_merges_replica_timeline():
+    """GET /debug/requests/{id} on the router returns its hop timeline AND
+    the serving replica's own view of the same trace id."""
+    a, b = FleetBackend("replica-a"), FleetBackend("replica-b")
+    with make_fleet([a, b]) as (router, _servers):
+        assert chat(router.url, f"{PREAMBLE} merge me").status_code == 200
+        listing = httpx.get(f"{router.url}/debug/requests", timeout=5).json()
+        entry = listing["router"]["recent"][0]
+        assert entry["outcome"] == "ok" and entry["replica"]
+        merged = httpx.get(
+            f"{router.url}/debug/requests/{entry['id']}", timeout=5
+        ).json()
+        events = [e["event"] for e in merged["router"]["events"]]
+        assert events[0] == "admitted" and "forwarded" in events
+        assert merged["replica"] is not None
+        assert merged["replica"]["trace_id"] == entry["trace_id"]
+        missing = httpx.get(f"{router.url}/debug/requests/feedbeef", timeout=5)
+        assert missing.status_code == 404
+
+
+def test_debug_requests_auth_parity_with_admin_token():
+    """Satellite: /debug/requests honors the same --admin-token gate as the
+    admin surface, on the router and on the replica."""
+    a = FleetBackend("replica-a")
+    servers = [InferenceServer("tiny-test", a, port=0, admin_token="sekrit").start()]
+    from prime_tpu.serve.fleet import serve_fleet as _serve_fleet
+
+    router = _serve_fleet(
+        [servers[0].url], poll_interval=0.05, model_id="tiny-test",
+        admin_token="sekrit",
+    )
+    try:
+        assert chat(router.url, f"{PREAMBLE} x").status_code == 200  # data plane open
+        for url in (router.url, servers[0].url):
+            assert httpx.get(f"{url}/debug/requests", timeout=5).status_code == 403
+            ok = httpx.get(
+                f"{url}/debug/requests",
+                headers={"Authorization": "Bearer sekrit"},
+                timeout=5,
+            )
+            assert ok.status_code == 200
+        # the router's replica proxy carries the shared token: the merged
+        # view works even though the replica gates /debug
+        entry = httpx.get(
+            f"{router.url}/debug/requests",
+            headers={"Authorization": "Bearer sekrit"}, timeout=5,
+        ).json()["router"]["recent"][0]
+        merged = httpx.get(
+            f"{router.url}/debug/requests/{entry['id']}",
+            headers={"Authorization": "Bearer sekrit"}, timeout=5,
+        ).json()
+        assert merged["replica"] is not None
+    finally:
+        router.stop()
+        servers[0].stop()
+
+
+def test_serve_metrics_cli_against_fleet_router():
+    """Satellite: `prime serve metrics --url <router>` renders the router's
+    registry (fleet_requests_total, breaker gauges, affinity ratio) without
+    KeyErrors, plus the per-replica routing summary; --debug-url renders the
+    flight-recorder view."""
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.serve import serve_cmd
+
+    a, b = FleetBackend("replica-a"), FleetBackend("replica-b")
+    with make_fleet([a, b]) as (router, _servers):
+        for i in range(3):
+            assert chat(router.url, f"{PREAMBLE} cli {i}").status_code == 200
+        runner = CliRunner()
+        out = runner.invoke(serve_cmd, ["metrics", "--url", router.url, "--plain"])
+        assert out.exit_code == 0, out.output
+        for needle in (
+            "fleet_requests_total", "fleet_breaker_state",
+            "fleet_affinity_hit_ratio",
+        ):
+            assert needle in out.output
+        # the per-replica routing summary table rendered (breaker + outcomes)
+        assert "closed" in out.output and "ok=3" in out.output
+        debug = runner.invoke(
+            serve_cmd, ["metrics", "--debug-url", router.url, "--plain"]
+        )
+        assert debug.exit_code == 0, debug.output
+        assert "forwarded" in debug.output or "ok" in debug.output
+        # one-request timeline mode
+        import json as _json
+
+        rid = httpx.get(f"{router.url}/debug/requests", timeout=5).json()[
+            "router"
+        ]["recent"][0]["id"]
+        one = runner.invoke(
+            serve_cmd,
+            ["metrics", "--debug-url", router.url, "--request", rid, "--plain"],
+        )
+        assert one.exit_code == 0, one.output
+        assert "admitted" in one.output and "--- router:" in one.output
+        as_json = runner.invoke(
+            serve_cmd,
+            ["metrics", "--debug-url", router.url, "--output", "json"],
+        )
+        assert as_json.exit_code == 0
+        assert "router" in _json.loads(as_json.output)

@@ -14,8 +14,13 @@ import httpx
 import pytest
 
 from prime_tpu.obs import (
+    FlightRecorder,
     Registry,
+    TraceContext,
     Tracer,
+    lint_prometheus_text,
+    new_traceparent,
+    parse_traceparent,
     quantile_from_snapshot,
 )
 
@@ -136,6 +141,57 @@ def test_prometheus_histogram_rendering():
     assert "lat_seconds_count 3" in lines
 
 
+def test_prometheus_unobserved_and_nonfinite_are_well_formed():
+    """Satellite: a registered-but-never-observed label-less histogram must
+    emit zero-count bucket series (not a bare HELP/TYPE header), and NaN/Inf
+    gauges must use the text-format spellings — checked by the lint."""
+    r = Registry()
+    r.histogram("cold_seconds", "never observed", buckets=(0.5, 1.0))
+    r.counter("cold_total", "never incremented")
+    g = r.gauge("weird")
+    g.set(float("nan"))
+    g2 = r.gauge("hot")
+    g2.set(float("inf"))
+    text = r.render_prometheus()
+    assert 'cold_seconds_bucket{le="+Inf"} 0' in text
+    assert "cold_seconds_count 0" in text and "cold_seconds_sum 0" in text
+    assert "cold_total 0" in text
+    assert "weird NaN" in text and "nan" not in text
+    assert "hot +Inf" in text
+    assert lint_prometheus_text(text) == []
+
+
+def test_exposition_lint_catches_violations():
+    ok = (
+        "# TYPE h_seconds histogram\n"
+        'h_seconds_bucket{le="1"} 1\n'
+        'h_seconds_bucket{le="+Inf"} 2\n'
+        "h_seconds_sum 1.5\n"
+        "h_seconds_count 2\n"
+    )
+    assert lint_prometheus_text(ok) == []
+    # non-cumulative buckets
+    assert lint_prometheus_text(
+        '# TYPE h histogram\nh_bucket{le="1"} 5\nh_bucket{le="+Inf"} 2\nh_count 2\n'
+    )
+    # missing +Inf bucket
+    assert lint_prometheus_text('# TYPE h histogram\nh_bucket{le="1"} 1\nh_count 1\n')
+    # _count disagrees with the +Inf bucket
+    assert lint_prometheus_text(
+        '# TYPE h histogram\nh_bucket{le="+Inf"} 2\nh_count 3\n'
+    )
+    # duplicate series, bad value spelling, unparseable line
+    assert lint_prometheus_text("# TYPE c counter\nc 1\nc 2\n")
+    assert lint_prometheus_text("# TYPE g gauge\ng nan\n")
+    assert lint_prometheus_text("just not exposition\n")
+    # legal label values containing '}', ',' and escapes must NOT trip it
+    assert lint_prometheus_text(
+        '# TYPE c counter\nc{a="x,y",b="cl}osed",d="q\\"uo"} 1\n'
+    ) == []
+    assert lint_prometheus_text('# TYPE c counter\nc{a="trailing",} 1\n') == []
+    assert lint_prometheus_text('# TYPE c counter\nc{a=unquoted} 1\n')
+
+
 def test_snapshot_roundtrips_through_json():
     r = Registry()
     r.counter("c_total").inc()
@@ -183,7 +239,89 @@ def test_disabled_tracer_is_noop():
     tracer = Tracer(enabled=False)
     with tracer.span("x", a=1) as s:
         s.set_attr("b", 2)  # must not raise
+    assert s.traceparent() is None  # callers skip header injection
     assert tracer.drain() == []
+
+
+# ---- trace context propagation ----------------------------------------------
+
+
+def test_traceparent_roundtrip_valid():
+    header = new_traceparent()
+    ctx = parse_traceparent(header)
+    assert ctx is not None
+    assert ctx.to_header() == header
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    # whitespace/case tolerated (header values travel through proxies)
+    assert parse_traceparent(f"  {header.upper()}  ") == ctx
+    # future versions may carry extra fields — parse the known prefix
+    assert parse_traceparent("cf-" + "a" * 32 + "-" + "b" * 16 + "-01-extra") is not None
+
+
+@pytest.mark.parametrize(
+    "header",
+    [
+        None,
+        "",
+        "garbage",
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",  # short trace id
+        "00-" + "a" * 32 + "-" + "b" * 15 + "-01",  # short span id
+        "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",  # invalid version
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",  # all-zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "00-" + "a" * 32 + "-" + "b" * 16 + "-01-extra",  # v00 has no extras
+        "00-" + "A" * 32 + "-" + "b" * 16,  # missing flags
+    ],
+)
+def test_traceparent_malformed_or_absent(header):
+    assert parse_traceparent(header) is None
+
+
+def test_span_joins_inbound_context():
+    tracer = Tracer()
+    ctx = parse_traceparent(new_traceparent())
+    with tracer.span("server.hop", context=ctx) as root:
+        with tracer.span("inner") as inner:
+            pass
+    assert root.trace_id == ctx.trace_id
+    assert root.parent_id == ctx.span_id
+    assert inner.trace_id == ctx.trace_id and inner.parent_id == root.span_id
+    # the span's own traceparent parses back to (trace_id, span_id)
+    fwd = parse_traceparent(root.traceparent())
+    assert fwd.trace_id == ctx.trace_id and fwd.span_id == root.span_id
+    # explicit context beats the thread-local stack
+    other = TraceContext.generate()
+    with tracer.span("outer"):
+        with tracer.span("rebased", context=other) as rebased:
+            pass
+    assert rebased.trace_id == other.trace_id
+
+
+def test_tracer_emit_synthetic_span():
+    tracer = Tracer()
+    ctx = TraceContext.generate()
+    tracer.emit("serve.queue_wait", 0.25, context=ctx, request=7)
+    tracer.emit("rootless", 0.1)
+    spans = tracer.drain()
+    wait = next(s for s in spans if s["name"] == "serve.queue_wait")
+    assert wait["trace_id"] == ctx.trace_id and wait["parent_id"] == ctx.span_id
+    assert wait["duration_s"] == pytest.approx(0.25)
+    assert wait["attrs"] == {"request": 7}
+    # disabled tracer: emit is free
+    off = Tracer(enabled=False)
+    off.emit("x", 1.0)
+    assert off.drain() == []
+
+
+def test_tracer_reconfigure_roundtrip(tmp_path):
+    tracer = Tracer(enabled=False)
+    sink = tmp_path / "sink.jsonl"
+    prev = tracer.reconfigure(enabled=True, sink_path=str(sink))
+    with tracer.span("x"):
+        pass
+    tracer.reconfigure(**prev)
+    assert not tracer.enabled
+    assert len(sink.read_text().splitlines()) == 1
 
 
 # ---- serve wiring -----------------------------------------------------------
@@ -480,6 +618,208 @@ def test_int4_pallas_gate_under_mesh():
         out = qz.matmul(x, qw)
     assert not qz._mesh_context_active()
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+# ---- flight recorder --------------------------------------------------------
+
+
+def test_flight_recorder_ring_bounded_under_churn():
+    """Acceptance: the recorder's memory is strictly bounded no matter how
+    many requests/events churn through it, and truncation is counted."""
+    fr = FlightRecorder(capacity=8, max_events=4, max_inflight=3, slow_ms=0)
+    for i in range(100):
+        fr.begin(i, trace_id=f"{i:032x}", prompt_tokens=i)
+        for j in range(10):
+            fr.event(i, "chunk", seq=j)
+        if i % 2 == 0:
+            fr.end(i, "completed", tokens=3)
+    s = fr.summaries()
+    assert len(s["recent"]) <= 8
+    assert len(s["inflight"]) <= 3
+    full = fr.get(f"{98:032x}")  # lookup by trace id
+    assert full is not None and full["id"] == "98"
+    assert len(full["events"]) <= 4
+    assert full["events_dropped"] > 0
+    # evicted-over-inflight-bound timelines are retired, not leaked
+    assert any(t["outcome"] == "evicted" for t in s["recent"])
+    # unknown keys never raise (late events after retirement)
+    fr.event("nope", "chunk")
+    fr.end("nope", "completed")
+
+
+def test_flight_recorder_summary_and_timeline_shape():
+    fr = FlightRecorder(slow_ms=0)
+    fr.begin("r1", trace_id="t" * 32, prompt_tokens=5)
+    fr.event("r1", "admitted", slot=2)
+    fr.annotate("r1", replica="10.0.0.1:8000")
+    fr.end("r1", "completed", tokens=6)
+    summary = fr.summaries()["recent"][0]
+    assert summary["state"] == "done" and summary["outcome"] == "completed"
+    assert summary["replica"] == "10.0.0.1:8000"
+    timeline = fr.get("r1")
+    events = [e["event"] for e in timeline["events"]]
+    assert events == ["admitted", "completed"]
+    assert timeline["events"][0]["slot"] == 2
+    json.dumps(timeline)  # wire-able
+
+
+def test_flight_recorder_slow_capture_persists_to_tracer(monkeypatch):
+    from prime_tpu.obs import TRACER
+
+    prev = TRACER.reconfigure(enabled=True, sink_path=None)
+    try:
+        fr = FlightRecorder(slow_ms=0.0001)
+        fr.begin("slow", trace_id="a" * 32)
+        fr.event("slow", "chunk")
+        fr.end("slow", "completed")
+        spans = [s for s in TRACER.drain() if s["name"] == "flight.slow_request"]
+        assert spans and spans[-1]["trace_id"] == "a" * 32
+        assert spans[-1]["attrs"]["timeline"][0]["event"] == "chunk"
+    finally:
+        TRACER.reconfigure(**prev)
+
+
+def test_server_debug_requests_and_auth_parity():
+    """/debug/requests on a plain (non-engine) server records the HTTP hop
+    and honors the same admin-token gate as /admin/drain."""
+    from prime_tpu.serve import InferenceServer
+
+    with InferenceServer(
+        "tiny-test", EchoGenerator(), port=0, admin_token="sekrit"
+    ) as srv:
+        tp = new_traceparent()
+        ctx = parse_traceparent(tp)
+        httpx.post(
+            f"{srv.url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "hi"}]},
+            headers={"traceparent": tp},
+            timeout=30,
+        )
+        assert httpx.get(f"{srv.url}/debug/requests").status_code == 403
+        auth = {"Authorization": "Bearer sekrit"}
+        listing = httpx.get(f"{srv.url}/debug/requests", headers=auth).json()
+        assert listing["recent"][0]["trace_id"] == ctx.trace_id
+        timeline = httpx.get(
+            f"{srv.url}/debug/requests/{ctx.trace_id}", headers=auth
+        ).json()
+        assert timeline["outcome"] == "http_200"
+        assert (
+            httpx.get(f"{srv.url}/debug/requests/zzz", headers=auth).status_code
+            == 404
+        )
+    # no admin token -> open, like the admin surface
+    with InferenceServer("tiny-test", EchoGenerator(), port=0, admin_token="") as srv:
+        assert httpx.get(f"{srv.url}/debug/requests").status_code == 200
+
+
+def test_engine_trace_continuity_and_flight_timeline(tmp_path):
+    """Tentpole acceptance (replica half): a traced streamed request through
+    the engine leaves serve.queue_wait/serve.prefill/serve.request spans
+    sharing the INBOUND trace id, and /debug/requests/{trace_id} returns the
+    engine's per-chunk timeline."""
+    import jax
+    import jax.numpy as jnp
+
+    from prime_tpu.evals.tokenizer import ByteTokenizer
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.obs import TRACER
+    from prime_tpu.serve import InferenceServer
+    from prime_tpu.serve.engine import ContinuousBatchingEngine, EngineBackend
+
+    config = get_config("tiny-test")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    engine = ContinuousBatchingEngine(
+        params, config, max_slots=2, capacity=128, chunk=4, prefix_cache_mb=0
+    )
+    sink = tmp_path / "trace.jsonl"
+    prev = TRACER.reconfigure(enabled=True, sink_path=str(sink))
+    tp = new_traceparent()
+    ctx = parse_traceparent(tp)
+    try:
+        with engine:
+            backend = EngineBackend(engine, ByteTokenizer())
+            with InferenceServer("tiny-test", backend, port=0) as srv:
+                with httpx.stream(
+                    "POST",
+                    f"{srv.url}/v1/chat/completions",
+                    json={
+                        "messages": [{"role": "user", "content": "ab"}],
+                        "max_tokens": 6,
+                        "stream": True,
+                    },
+                    headers={"traceparent": tp},
+                    timeout=120,
+                ) as response:
+                    assert response.status_code == 200
+                    "".join(response.iter_lines())
+                timeline = httpx.get(
+                    f"{srv.url}/debug/requests/{ctx.trace_id}", timeout=5
+                ).json()
+    finally:
+        TRACER.reconfigure(**prev)
+    events = [e["event"] for e in timeline["events"]]
+    assert events[0] == "admitted"
+    for expected in ("prefill_done", "first_token", "chunk"):
+        assert expected in events, events
+    assert timeline["outcome"] == "completed"
+    spans = [json.loads(line) for line in sink.read_text().splitlines()]
+    mine = {s["name"] for s in spans if s["trace_id"] == ctx.trace_id}
+    assert {"serve.queue_wait", "serve.prefill", "serve.request"} <= mine
+    # batched device spans stay process-local (they cover many requests)
+    dispatch = next(s for s in spans if s["name"] == "serve.dispatch")
+    assert dispatch["trace_id"] != ctx.trace_id
+
+
+def test_serve_profile_waterfall_stitches_cross_process(tmp_path):
+    """serve_profile --trace A --trace B: spans sharing a W3C trace id merge
+    into one per-request waterfall with cross-process gaps called out."""
+    import pathlib
+    import subprocess
+    import sys
+
+    trace_id = "ab" * 16
+    router_spans = [
+        {"name": "fleet.route", "trace_id": trace_id, "span_id": "r" * 16,
+         "parent_id": None, "start_unix_s": 100.0, "start_s": 0.0,
+         "duration_s": 0.5, "attrs": {}},
+        {"name": "fleet.attempt", "trace_id": trace_id, "span_id": "a" * 16,
+         "parent_id": "r" * 16, "start_unix_s": 100.01, "start_s": 0.01,
+         "duration_s": 0.48, "attrs": {"replica": "rep-1"}},
+    ]
+    replica_spans = [
+        {"name": "serve.chat", "trace_id": trace_id, "span_id": "c" * 16,
+         "parent_id": "a" * 16, "start_unix_s": 100.06, "start_s": 7.0,
+         "duration_s": 0.4, "attrs": {}},
+        # an unrelated single-span trace: not stitched
+        {"name": "serve.request", "trace_id": "cd" * 16, "span_id": "d" * 16,
+         "parent_id": "e" * 16, "start_unix_s": 50.0, "start_s": 1.0,
+         "duration_s": 0.1, "attrs": {}},
+    ]
+    a = tmp_path / "router.jsonl"
+    b = tmp_path / "replica.jsonl"
+    a.write_text("".join(json.dumps(s) + "\n" for s in router_spans))
+    b.write_text("".join(json.dumps(s) + "\n" for s in replica_spans))
+    script = str(
+        pathlib.Path(__file__).resolve().parents[1] / "scripts" / "serve_profile.py"
+    )
+    out = subprocess.run(
+        [sys.executable, script, "--trace", str(a), "--trace", str(b)],
+        capture_output=True, text=True, timeout=60, check=True,
+    ).stdout
+    assert f"trace {trace_id}: 3 spans" in out
+    assert "router.jsonl" in out and "replica.jsonl" in out
+    # indentation encodes the parent chain; the replica hop calls out its gap
+    assert "fleet.route" in out and "fleet.attempt" in out and "serve.chat" in out
+    assert "[cross-process]" in out
+    assert "+50.00 ms after parent" in out
+    # --trace-id narrows to one request
+    picked = subprocess.run(
+        [sys.executable, script, "--trace", str(a), "--trace", str(b),
+         "--trace-id", "cd" * 16],
+        capture_output=True, text=True, timeout=60, check=True,
+    ).stdout
+    assert "serve.request" in picked and "fleet.route" not in picked
 
 
 def test_serve_profile_overlap_report(tmp_path):
