@@ -791,19 +791,21 @@ def main() -> None:
     # opens with the same system preamble and diverges after it. Reports the
     # prefix-hit ratio over the measured admissions (partial hits count),
     # mean admit (prefill) latency, and one assemble dispatch per hit.
+    # built OUTSIDE the try: the host-spill section below reuses these
+    # prompts and must not inherit a NameError from an unrelated failure here
+    pre_len = 16 if SMOKE else 64
+    preamble = [1] + [(5 * j) % (config.vocab_size - 3) + 3 for j in range(pre_len - 1)]
+    burst_prompts = [
+        preamble
+        + [
+            (13 * (i * 7 + j)) % (config.vocab_size - 3) + 3
+            for j in range(serve_prompt_len - pre_len)
+        ]
+        for i in range(n_req)
+    ]
     try:
         from prime_tpu.serve.engine import ContinuousBatchingEngine
 
-        pre_len = 16 if SMOKE else 64
-        preamble = [1] + [(5 * j) % (config.vocab_size - 3) + 3 for j in range(pre_len - 1)]
-        burst_prompts = [
-            preamble
-            + [
-                (13 * (i * 7 + j)) % (config.vocab_size - 3) + 3
-                for j in range(serve_prompt_len - pre_len)
-            ]
-            for i in range(n_req)
-        ]
         engine = ContinuousBatchingEngine(
             params, config, pad_id=0, max_slots=serve_slots,
             capacity=SERVE_CAPACITY, chunk=SERVE_CHUNK, prefix_cache_mb=256,
@@ -847,6 +849,15 @@ def main() -> None:
                 after["prefix_assembles"] - before["prefix_assembles"]
             )
             record["serve_prefixburst_cache_bytes"] = after["prefix_cache_bytes"]
+            # per-tier hit tokens (serve_prefix_hit_tokens{tier=...}): the
+            # 256 MiB device budget never pressures this burst, so host
+            # stays 0 here — the spill-tier section below applies pressure
+            hit_hist = engine.registry.get("serve_prefix_hit_tokens")
+            for tier in ("device", "host"):
+                snap = hit_hist.series_snapshot(tier=tier) or {"sum": 0.0}
+                record[f"serve_prefixburst_hit_tokens_{tier}"] = int(snap["sum"])
+            record["serve_prefixburst_spills"] = after["prefix_spills"]
+            record["serve_prefixburst_reuploads"] = after["prefix_reuploads"]
             engine.stats()  # refresh gauges for the snapshot
             record["serve_prefixburst_obs"] = engine.registry.snapshot()
             print(
@@ -862,6 +873,53 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         record["serve_prefixburst_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: serve shared-prefix section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
+
+    # ---- serve: host spill tier under device-budget pressure ----------------
+    # the two-tier prefix cache's reason to exist: a device budget too small
+    # for even one prompt's KV (1 KiB here — deliberate, deterministic
+    # pressure) forces every stored segment to demote to the host tier, so
+    # each later shared-preamble admission hits HOST-resident blocks and
+    # pays a re-upload instead of a recompute. Proves hit_tokens{tier=host}
+    # > 0 and the spill/re-upload counters move (ROADMAP Open item 3).
+    try:
+        from prime_tpu.serve.engine import ContinuousBatchingEngine
+
+        engine = ContinuousBatchingEngine(
+            params, config, pad_id=0, max_slots=serve_slots,
+            capacity=SERVE_CAPACITY, chunk=SERVE_CHUNK,
+            prefix_cache_mb=1 / 1024, prefix_cache_host_mb=64,
+        )
+        try:
+            for ids in burst_prompts[:3]:
+                req = engine.submit(list(ids), max_new_tokens=req_new)
+                while not req.done:
+                    engine.tick()
+            engine.tick()  # drain the lookahead chunk
+            tier_stats = engine.stats()
+            host_snap = engine.registry.get("serve_prefix_hit_tokens").series_snapshot(
+                tier="host"
+            ) or {"count": 0, "sum": 0.0}
+            record["serve_prefixhost_hit_tokens"] = int(host_snap["sum"])
+            record["serve_prefixhost_hits"] = int(host_snap["count"])
+            record["serve_prefixhost_spills"] = tier_stats["prefix_spills"]
+            record["serve_prefixhost_reuploads"] = tier_stats["prefix_reuploads"]
+            record["serve_prefixhost_host_bytes"] = tier_stats["prefix_cache_host_bytes"]
+            record["serve_prefixhost_obs"] = engine.registry.snapshot()
+            print(
+                f"# bench: serve host spill tier "
+                f"{record['serve_prefixhost_hit_tokens']} host-tier hit tokens "
+                f"over {record['serve_prefixhost_hits']} hits, "
+                f"{record['serve_prefixhost_spills']} spills, "
+                f"{record['serve_prefixhost_reuploads']} re-uploads, "
+                f"{record['serve_prefixhost_host_bytes']} host bytes",
+                flush=True,
+            )
+        finally:
+            del engine
+    except Exception as e:  # noqa: BLE001
+        record["serve_prefixhost_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: serve host spill tier section failed: {e}", flush=True)
     print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
     # ---- serve fleet: 2-replica router, shared-prefix burst -----------------
@@ -957,6 +1015,15 @@ def main() -> None:
             record["serve_fleet_tok_s"] = round(total / elapsed, 1)
             record["serve_fleet_affinity_ratio"] = stats["affinity_hit_ratio"]
             record["serve_fleet_reroutes"] = stats["reroutes"]
+            # placement split: requests landed by advertised cached prefix
+            # (digest-guided saturation fallback) vs by the consistent hash
+            # (affinity target or blind least-loaded). Both terms are
+            # per-PICK counters — requests_by_replica counts per forward
+            # attempt, which double-counts failover retries
+            record["serve_fleet_cache_routed"] = stats["cache_routed"]
+            record["serve_fleet_hash_routed"] = (
+                stats["affinity_requests"] - stats["cache_routed"]
+            )
             record["serve_fleet_requests_by_replica"] = {
                 rid: sum(outcomes.values())
                 for rid, outcomes in stats["requests_by_replica"].items()
@@ -979,6 +1046,87 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         record["serve_fleet_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: serve fleet section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
+
+    # ---- serve fleet: cache-aware vs blind routing (deterministic sim) ------
+    # pure balancer-level A/B — no sockets, no engines, no clocks — of the
+    # tentpole routing upgrade: the same saturating shared-preamble workload
+    # placed twice, once with replicas advertising their hot-prefix digests
+    # (saturation fallback diverts to the longest advertised cached prefix)
+    # and once blind (pre-digest least-loaded). Scores each placement by how
+    # many leading blocks of the request the chosen replica's ACTUAL cache
+    # held; the digest run must win the hit-token ratio.
+    try:
+        from collections import Counter as _Counter
+        from collections import deque as _deque
+
+        from prime_tpu.serve.digest import (
+            HotPrefixDigest,
+            longest_match_blocks,
+            prefix_hashes,
+        )
+        from prime_tpu.serve.fleet.balancer import PrefixAffinityBalancer
+        from prime_tpu.serve.fleet.membership import FleetMembership
+
+        # 12 tenant groups over 4 replicas in deterministic-but-irregular
+        # (LCG) arrival order: each preamble spans 3 digest blocks, each tail
+        # is request-unique. Replica retention is the REAL bounded
+        # HotPrefixDigest LRU (20 entries ~ a few groups' chains), so blind
+        # scattering churns a replica's hot set while cache-aware placement
+        # keeps re-landing a group where its preamble still survives.
+        sim_prompts, lcg = [], 1
+        for i in range(120):
+            lcg = (lcg * 1103515245 + 12345) % (1 << 31)
+            preamble = (f"tenant {lcg % 12} system preamble block " * 12)[:192]
+            sim_prompts.append(preamble + f" user question {i} " * 8)
+
+        def _route_sim(cache_aware: bool) -> tuple[float, int]:
+            membership = FleetMembership(
+                [f"http://10.0.0.{i}:9" for i in (1, 2, 3, 4)]
+            )
+            # saturation_depth=1: a backlog of one is tolerable, two diverts —
+            # leaves multiple unsaturated candidates at UNEQUAL loads, the
+            # regime where digest depth and least-loaded genuinely disagree
+            balancer = PrefixAffinityBalancer(membership, saturation_depth=1)
+            caches = {
+                rid: HotPrefixDigest(max_entries=20) for rid in membership.replicas
+            }
+            recent: _deque = _deque(maxlen=6)  # each request occupies its
+            # replica for the next 6 placements — emergent saturation
+            hit_blocks = total_blocks = cache_routed = 0
+            for prompt in sim_prompts:
+                depths = _Counter(recent)
+                for rid, replica in membership.replicas.items():
+                    replica.queue_depth = depths.get(rid, 0)
+                pick = balancer.pick(prompt)
+                chain = prefix_hashes(prompt)
+                hit_blocks += longest_match_blocks(
+                    chain, set(caches[pick.replica.id].hashes())
+                )
+                total_blocks += len(chain)
+                cache_routed += bool(pick.cache_routed)
+                caches[pick.replica.id].observe(prompt)
+                if cache_aware:
+                    pick.replica.digest = frozenset(caches[pick.replica.id].hashes())
+                recent.append(pick.replica.id)
+            return hit_blocks / total_blocks, cache_routed
+
+        aware_ratio, aware_cache_routed = _route_sim(cache_aware=True)
+        blind_ratio, _ = _route_sim(cache_aware=False)
+        record["serve_fleet_routesim_hit_ratio_cache_aware"] = round(aware_ratio, 4)
+        record["serve_fleet_routesim_hit_ratio_blind"] = round(blind_ratio, 4)
+        record["serve_fleet_routesim_cache_routed"] = aware_cache_routed
+        record["serve_fleet_routesim_requests"] = len(sim_prompts)
+        print(
+            f"# bench: fleet routing sim prefix-hit-token ratio "
+            f"{record['serve_fleet_routesim_hit_ratio_cache_aware']} cache-aware vs "
+            f"{record['serve_fleet_routesim_hit_ratio_blind']} blind "
+            f"({aware_cache_routed}/{len(sim_prompts)} cache-routed)",
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001
+        record["serve_fleet_routesim_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: fleet routing sim failed: {e}", flush=True)
     print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
     # ---- quant: int8 weights / int8 KV --------------------------------------

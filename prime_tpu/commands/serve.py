@@ -75,6 +75,14 @@ from prime_tpu.utils.render import Renderer, output_options
          "(--continuous). Default: 256 (PRIME_SERVE_PREFIX_CACHE_MB).",
 )
 @click.option(
+    "--prefix-cache-host-mb", type=float, default=None,
+    help="Byte budget (MiB) of the prefix cache's host-RAM spill tier "
+         "(--continuous): the device LRU demotes cold KV segments to pinned "
+         "host buffers instead of freeing them, and a later hit re-uploads "
+         "through the same one-dispatch assemble path; 0 disables. "
+         "Default: 0 (PRIME_SERVE_PREFIX_CACHE_HOST_MB).",
+)
+@click.option(
     "--max-queue", type=int, default=None,
     help="Bound the engine's pending queue (--continuous): submissions past "
          "it get 429 + Retry-After instead of queueing unboundedly. "
@@ -120,6 +128,7 @@ def serve_cmd(
     overlap: bool | None,
     warmup: bool | None,
     prefix_cache_mb: float | None,
+    prefix_cache_host_mb: float | None,
     max_queue: int | None,
     replica_of: str | None,
     advertise_url: str | None,
@@ -167,6 +176,7 @@ def serve_cmd(
             overlap=overlap,
             warmup=warmup,
             prefix_cache_mb=prefix_cache_mb,
+            prefix_cache_host_mb=prefix_cache_host_mb,
             max_queue=max_queue,
         )
     except (ValueError, OSError) as e:

@@ -1,0 +1,215 @@
+"""Hot-prefix digest: a compact, wire-friendly index of cached prefixes.
+
+The fleet's placement problem after PR 3+4: each replica's radix prefix-KV
+cache (serve/prefix_cache.py) makes requests sharing cached blocks decode
+markedly faster *on the replica that holds them*, and the router's
+consistent-hash affinity already concentrates shared prefixes — but when the
+affinity target is saturated, the fallback used to hash blind (least-loaded),
+landing requests on replicas that must recompute a prefix another replica
+holds. This module is the advertisement half of the fix: a replica publishes
+a bounded set of **block-aligned prefix hashes** in its ``/healthz`` payload,
+``membership.py`` retains it per replica, and ``balancer.py`` upgrades the
+saturation fallback to "longest advertised cached prefix among healthy,
+unsaturated replicas".
+
+The hash chain
+--------------
+
+``prefix_hashes(prompt)`` returns ``[h_1, h_2, …, h_k]`` where ``h_i`` covers
+the first ``i`` MIN_BUCKET-aligned blocks of the prompt — a *rolling* SHA-1,
+so ``h_i`` depends on every token/char before it, exactly like the radix
+tree's path-is-context invariant. Two key properties:
+
+- **Prefix-stable**: two prompts sharing their first ``i`` blocks share
+  ``h_1..h_i`` — a digest containing ``h_i`` advertises the whole prefix
+  chain up to block ``i``.
+- **Dual-keyed**: token-id sequences hash id blocks (``MIN_BUCKET`` tokens
+  per block — what the engine's radix tree indexes); text hashes
+  ``MIN_BUCKET * CHARS_PER_TOKEN``-char blocks (the same deterministic
+  length proxy ``balancer.affinity_key`` uses for routers that front an
+  upstream whose tokenizer they don't have). The two spaces are disjoint by
+  construction (seeded differently), so a replica can advertise both: exact
+  id hashes exported from its engine's radix tree plus text hashes of the
+  rendered chat prompts it recently served.
+
+Hashes are 63-bit ints (SHA-1 prefix, top bit cleared) — JSON-safe, compact,
+and deterministic across processes/Python versions (unlike builtin ``hash``
+under PYTHONHASHSEED).
+
+``HotPrefixDigest`` is the replica-side bounded LRU of those hashes (the
+server feeds it every rendered chat prompt it admits); the wire form is
+``{"version": 1, "block": 16, "chars_per_token": 4, "hashes": [...]}``,
+additive in /healthz so older routers ignore it and newer routers tolerate
+replicas that never send it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Iterable, Sequence
+
+# MUST equal serve.engine.MIN_BUCKET and balancer.MIN_BUCKET (pinned by
+# tests/test_fleet.py): digest blocks, affinity-key blocks, and radix-tree
+# edges all share one alignment so every prompt that could share cached KV
+# shares digest entries.
+MIN_BUCKET = 16
+# the same crude text->token proxy balancer.affinity_key uses; only block
+# *alignment* depends on it, and both sides of a text comparison (replica
+# digest, router probe) apply it identically
+CHARS_PER_TOKEN = 4
+
+DIGEST_VERSION = 1
+# deepest prefix hashed per prompt: beyond ~16 blocks (256 tokens) the
+# marginal routing value of distinguishing deeper prefixes is tiny and the
+# chain length is pure payload weight
+DEFAULT_MAX_PROMPT_BLOCKS = 16
+# replica-side advertisement bound (entries, not prompts)
+DEFAULT_MAX_ENTRIES = 512
+# router-side retention cap per replica: a malicious or buggy replica must
+# not be able to balloon router memory through its /healthz payload
+RETAIN_MAX_ENTRIES = 4096
+
+
+def _h63(h: "hashlib._Hash") -> int:
+    """63-bit int of a hash state's digest prefix: JSON round-trips exactly
+    (IEEE doubles hold 53 bits, but every JSON codec in this stack keeps
+    ints intact; the cleared top bit keeps any lossy intermediary safe)."""
+    return int.from_bytes(h.digest()[:8], "big") >> 1
+
+
+def prefix_hashes(
+    prompt: "Sequence[int] | str",
+    block: int = MIN_BUCKET,
+    max_blocks: int = DEFAULT_MAX_PROMPT_BLOCKS,
+) -> list[int]:
+    """The rolling prefix-hash chain of ``prompt`` (module docstring):
+    ``out[i-1]`` covers the first ``i`` blocks; short prompts (under one
+    block) have no chain. Token-id sequences and text hash into disjoint
+    spaces — compare like with like."""
+    out: list[int] = []
+    if isinstance(prompt, str):
+        unit = block * CHARS_PER_TOKEN
+        n = min(len(prompt) // unit, max_blocks)
+        h = hashlib.sha1(b"text:")
+        for i in range(n):
+            h.update(prompt[i * unit : (i + 1) * unit].encode("utf-8", "replace"))
+            out.append(_h63(h.copy()))
+    else:
+        n = min(len(prompt) // block, max_blocks)
+        h = hashlib.sha1(b"ids:")
+        for i in range(n):
+            h.update(
+                (",".join(str(t) for t in prompt[i * block : (i + 1) * block]) + ";").encode()
+            )
+            out.append(_h63(h.copy()))
+    return out
+
+
+def longest_match_blocks(hashes: Sequence[int], digest: "frozenset[int] | set[int]") -> int:
+    """How many leading blocks of a request (its ``prefix_hashes`` chain) a
+    replica's advertised digest covers: the DEEPEST advertised prefix, not
+    the first gap — retention caps may age out a mid-chain entry while a
+    deeper one (which implies the whole chain was cached) survives."""
+    depth = 0
+    for i, h in enumerate(hashes):
+        if h in digest:
+            depth = i + 1
+    return depth
+
+
+class HotPrefixDigest:
+    """Replica-side bounded LRU of advertised prefix hashes.
+
+    ``observe(prompt)`` records the prompt's whole chain (each hash is one
+    LRU entry — re-serving a hot preamble refreshes exactly its chain);
+    past ``max_entries`` the coldest hashes age out, so the advertisement
+    tracks what the replica's cache plausibly still holds without any
+    eviction callback from the engine. Approximate by design: a stale entry
+    costs one reroute to a replica that recomputes (correctness is never at
+    stake — routing is a hint, the radix tree is the truth), and a missing
+    entry costs the blind fallback this digest exists to improve on.
+
+    Thread-safe: the server's HTTP handler threads observe concurrently
+    with /healthz snapshots."""
+
+    def __init__(
+        self,
+        max_entries: int = DEFAULT_MAX_ENTRIES,
+        *,
+        block: int = MIN_BUCKET,
+        max_blocks: int = DEFAULT_MAX_PROMPT_BLOCKS,
+    ) -> None:
+        self.max_entries = max(1, int(max_entries))
+        self.block = block
+        self.max_blocks = max_blocks
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[int, None]" = OrderedDict()
+
+    def observe(self, prompt: "Sequence[int] | str") -> None:
+        chain = prefix_hashes(prompt, block=self.block, max_blocks=self.max_blocks)
+        if not chain:
+            return
+        with self._lock:
+            for h in chain:
+                if h in self._entries:
+                    self._entries.move_to_end(h)
+                else:
+                    self._entries[h] = None
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def hashes(self) -> list[int]:
+        with self._lock:
+            return list(self._entries)
+
+    def snapshot(self, extra: Iterable[int] = ()) -> dict:
+        """The /healthz wire form. ``extra`` merges additional hashes (the
+        engine's exact id-block export) under the same entry cap — OWN (text)
+        entries first: today's router probes with text-space hashes only
+        (it renders the chat itself, it has no tokenizer), so under
+        truncation the matchable text advertisement must survive; the
+        id-space truth fills whatever room remains for routers that can
+        probe in id space."""
+        merged: list[int] = []
+        seen: set[int] = set()
+        with self._lock:
+            own = list(self._entries)
+        for h in own + list(extra):
+            if h not in seen:
+                seen.add(h)
+                merged.append(h)
+            if len(merged) >= self.max_entries:
+                break
+        return {
+            "version": DIGEST_VERSION,
+            "block": self.block,
+            "chars_per_token": CHARS_PER_TOKEN,
+            "hashes": merged,
+        }
+
+
+def parse_digest(payload: object, cap: int = RETAIN_MAX_ENTRIES) -> frozenset[int]:
+    """Tolerant router-side parse of a /healthz ``prefix_digest`` field:
+    older replicas omit it entirely, partial rollouts may send malformed or
+    oversized payloads, and none of that may break health polling (the
+    digest degrades to empty = blind fallback, the pre-digest behavior).
+    Retention is capped at ``cap`` entries per replica."""
+    if not isinstance(payload, dict):
+        return frozenset()
+    hashes = payload.get("hashes")
+    if not isinstance(hashes, (list, tuple)):
+        return frozenset()
+    out: set[int] = set()
+    for h in hashes:
+        if isinstance(h, bool) or not isinstance(h, int):
+            continue  # junk entry: skip, keep the rest
+        out.add(h)
+        if len(out) >= cap:
+            break
+    return frozenset(out)

@@ -61,6 +61,7 @@ import queue
 import sys
 import threading
 import time
+import warnings
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any
@@ -78,6 +79,11 @@ NEG_INF = -1e30
 # default byte budget for the radix prefix-KV cache: roughly what the old
 # 4-entry whole-row list held for a 1B model at 2048-slot rows
 DEFAULT_PREFIX_CACHE_MB = 256.0
+# default host-RAM spill tier budget: 0 = disabled (device eviction deletes,
+# the single-tier behavior). Host RAM is typically an order of magnitude
+# larger than HBM, so deployments chasing millions-of-users prefix reuse set
+# this to several GB (--prefix-cache-host-mb / PRIME_SERVE_PREFIX_CACHE_HOST_MB)
+DEFAULT_PREFIX_CACHE_HOST_MB = 0.0
 # KVCache fields with a capacity axis (the segment/assemble unit); lengths is
 # capacity-free and rebuilt by init_cache at assemble time
 _CAPACITY_FIELDS = ("k", "v", "k_scale", "v_scale")
@@ -156,6 +162,26 @@ def _power_batches(n: int) -> list[int]:
         else:
             p //= 2
     return out
+
+
+def _segment_to_host(segment: Any) -> Any:
+    """Spill-tier demotion: device KV slices -> host-RAM copies. device_get
+    blocks until the segment's producing dispatch finishes and lands plain
+    numpy arrays in host memory (on runtimes with a pinned-host allocator the
+    transfer staging is pinned; the cache only needs the bytes off HBM)."""
+    import jax
+
+    return jax.device_get(segment)
+
+
+def _segment_to_device(segment: Any) -> Any:
+    """Spill-tier promotion: host copies -> device arrays, ready for the
+    jitted assemble_row dispatch (shapes/dtypes round-trip exactly, so the
+    assemble program cache keys are identical to never-spilled segments)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(jnp.asarray, segment)
 
 
 def _env_flag(name: str, default: bool) -> bool:
@@ -262,6 +288,7 @@ class ContinuousBatchingEngine:
         chunk: int = 8,
         prefill_chunk: int = 512,
         prefix_cache_mb: float | None = None,
+        prefix_cache_host_mb: float | None = None,
         min_prefix: int = MIN_BUCKET,
         mesh: Any = None,
         cache_spec: Any = None,
@@ -375,14 +402,39 @@ class ContinuousBatchingEngine:
         # whose prompt shares cached blocks assembles them into its staging
         # row with one jitted dispatch and only prefills the suffix.
         # prefix_cache_mb=0 disables; None reads PRIME_SERVE_PREFIX_CACHE_MB.
+        # prefix_cache_host_mb > 0 adds the host-RAM spill tier: the device
+        # LRU demotes segments to host buffers instead of freeing them, and a
+        # hit on a host-resident node re-uploads through the same one-dispatch
+        # assemble path (None reads PRIME_SERVE_PREFIX_CACHE_HOST_MB; 0 = off).
         self.prefill_chunk = max(MIN_BUCKET, prefill_chunk)
         self.min_prefix = max(min_prefix, MIN_BUCKET)
         if prefix_cache_mb is None:
             raw = os.environ.get("PRIME_SERVE_PREFIX_CACHE_MB", "").strip()
             prefix_cache_mb = float(raw) if raw else DEFAULT_PREFIX_CACHE_MB
         self.prefix_cache_mb = float(prefix_cache_mb)
+        if prefix_cache_host_mb is None:
+            raw = os.environ.get("PRIME_SERVE_PREFIX_CACHE_HOST_MB", "").strip()
+            prefix_cache_host_mb = float(raw) if raw else DEFAULT_PREFIX_CACHE_HOST_MB
+        self.prefix_cache_host_mb = float(prefix_cache_host_mb)
+        if self.prefix_cache_host_mb > 0 and mesh is not None and getattr(mesh, "size", 1) > 1:
+            # the spill tier's converters are not sharding-preserving:
+            # device_get raises on non-fully-addressable multi-host arrays,
+            # and a plain asarray re-upload would drop cache_spec (forcing a
+            # fresh assemble_row compile and an unconstrained seeded row).
+            # Until segments spill sharding-aware (ROADMAP Open item 1),
+            # multi-device engines keep the single-tier cache.
+            warnings.warn(
+                "prefix_cache_host_mb > 0 is not supported with a multi-device "
+                "mesh yet; disabling the host spill tier for this engine",
+                stacklevel=2,
+            )
+            self.prefix_cache_host_mb = 0.0
         self.prefix_cache: BlockPrefixCache | None = (
-            BlockPrefixCache(int(self.prefix_cache_mb * 2**20), block=MIN_BUCKET)
+            BlockPrefixCache(
+                int(self.prefix_cache_mb * 2**20), block=MIN_BUCKET,
+                host_budget_bytes=int(self.prefix_cache_host_mb * 2**20),
+                to_host=_segment_to_host, to_device=_segment_to_device,
+            )
             if self.prefix_cache_mb > 0
             else None
         )
@@ -413,22 +465,52 @@ class ContinuousBatchingEngine:
             "serve_prefix_hits_total", "Admissions seeded from the prefix-KV cache"
         )
         self._m_prefix_hit_tokens = r.histogram(
-            "serve_prefix_hit_tokens", "Cached tokens reused per prefix hit",
-            buckets=DEFAULT_TOKEN_BUCKETS,
+            "serve_prefix_hit_tokens",
+            "Cached tokens reused per prefix hit, by serving tier "
+            "(device = assembled from HBM, host = re-uploaded from the spill tier)",
+            buckets=DEFAULT_TOKEN_BUCKETS, labelnames=("tier",),
         )
         self._m_prefix_bytes = r.gauge(
             "serve_prefix_cache_bytes", "Device bytes held by cached KV segments"
         )
+        self._m_prefix_host_bytes = r.gauge(
+            "serve_prefix_cache_host_bytes",
+            "Host-RAM bytes held by spilled KV segments",
+        )
         self._m_prefix_nodes = r.gauge(
-            "serve_prefix_cache_nodes", "Segment nodes in the prefix radix tree"
+            "serve_prefix_cache_nodes", "Segment nodes in the prefix radix tree (both tiers)"
+        )
+        self._m_prefix_host_nodes = r.gauge(
+            "serve_prefix_cache_host_nodes", "Host-tier segment nodes in the radix tree"
         )
         self._m_prefix_evictions = r.counter(
-            "serve_prefix_evictions_total", "Segment nodes evicted by the byte-budget LRU"
+            "serve_prefix_evictions_total",
+            "Segment nodes deleted outright by the byte-budget LRU",
+        )
+        self._m_prefix_spills = r.counter(
+            "serve_prefix_spills_total",
+            "Segments demoted from device HBM to the host-RAM spill tier",
+        )
+        self._m_prefix_spilled_bytes = r.counter(
+            "serve_prefix_spilled_bytes_total", "Bytes demoted to the host spill tier"
+        )
+        self._m_prefix_reuploads = r.counter(
+            "serve_prefix_reuploads_total",
+            "Host-resident segments re-uploaded to device for a prefix hit",
+        )
+        self._m_prefix_reupload_bytes = r.counter(
+            "serve_prefix_reupload_bytes_total", "Bytes re-uploaded from the host spill tier"
         )
         self._m_prefix_assembles = r.counter(
             "serve_prefix_assembles_total",
             "assemble_row dispatches (one per prefix-seeded admission)",
         )
+        # last-seen cache counter values: the cache owns the monotonic truth,
+        # _sync_prefix_metrics publishes deltas into the registry counters
+        self._prefix_seen = {
+            "spills": 0, "spilled_bytes": 0, "reuploads": 0,
+            "reupload_bytes": 0, "evictions": 0,
+        }
         self._m_batched_waves = r.counter(
             "serve_batched_admission_waves_total", "Multi-request admission prefills"
         )
@@ -495,6 +577,12 @@ class ContinuousBatchingEngine:
         # /metrics response is cross-field consistent with the loop state
         self._stats_lock = threading.Lock()
         self._stats_snapshot: dict | None = None
+        # hot-prefix digest snapshot for /healthz advertisement: recomputed
+        # by the engine loop (never by HTTP threads — the radix tree is
+        # engine-thread-owned) at most every digest_refresh_s
+        self._digest_snapshot: list[int] = []
+        self._digest_at = 0.0
+        self.digest_refresh_s = 1.0
 
     # legacy counter attributes (bench.py and older callers read these as
     # plain ints) — now views over the registry-backed counters
@@ -1659,17 +1747,30 @@ class ContinuousBatchingEngine:
             )
         if self._assemble_fn is None:
             self._assemble_fn = self._make_assemble_row()
+        host_tokens = match.host_tokens
         try:
+            # tier annotates the span so trace evidence distinguishes a pure
+            # HBM hit from one that paid a host->device re-upload first
             with TRACER.span(
                 "serve.assemble", context=ctx, hit_tokens=match.length,
                 segments=len(match.entries), row_capacity=row_cb,
+                tier="host" if host_tokens else "device",
+                host_tokens=host_tokens,
             ):
+                if host_tokens:
+                    # re-upload the spilled segments in place (still pinned —
+                    # the rebalance this may trigger skips the match path)
+                    self.prefix_cache.promote(match)
                 row = self._assemble_fn(match.segments(), match.takes(), row_cb)
         finally:
             self.prefix_cache.release(match)
         self._m_prefix_hits.inc()
         self._m_prefix_assembles.inc()
-        self._m_prefix_hit_tokens.observe(match.length)
+        if match.device_tokens:
+            self._m_prefix_hit_tokens.observe(match.device_tokens, tier="device")
+        if host_tokens:
+            self._m_prefix_hit_tokens.observe(host_tokens, tier="host")
+        self._sync_prefix_metrics()
         return match.length, row
 
     def _row_slicer(self, row):
@@ -1705,13 +1806,70 @@ class ContinuousBatchingEngine:
         aligned = (len(ids) // MIN_BUCKET) * MIN_BUCKET
         if aligned < self.min_prefix:
             return
-        evictions_before = cache.evictions
+        spills_before = cache.spills
+        spilled_bytes_before = cache.spilled_bytes
+        spill_s_before = cache.spill_seconds
         cache.insert(list(ids[:aligned]), self._row_slicer(row))
-        evicted = cache.evictions - evictions_before
-        if evicted:
-            self._m_prefix_evictions.inc(evicted)
+        if cache.spills > spills_before:
+            # spills force a device sync (device_get) on the store path —
+            # leave trace evidence so the profiler's tier table can price
+            # them. Duration is the time inside to_host only (the cache
+            # accumulates it around the converter), not the whole insert.
+            TRACER.emit(
+                "serve.spill", cache.spill_seconds - spill_s_before,
+                segments=cache.spills - spills_before,
+                bytes=cache.spilled_bytes - spilled_bytes_before,
+            )
+        self._sync_prefix_metrics()
+
+    def _sync_prefix_metrics(self) -> None:
+        """Publish the cache's monotonic counters (spills, re-uploads,
+        deletions) into the registry as deltas since the last sync, and
+        refresh the per-tier footprint gauges. ONE owner of the cache->
+        registry translation, called from the seed/store paths and the
+        stats refresh."""
+        cache = self.prefix_cache
+        if cache is None:
+            return
+        for counter, attr in (
+            (self._m_prefix_spills, "spills"),
+            (self._m_prefix_spilled_bytes, "spilled_bytes"),
+            (self._m_prefix_reuploads, "reuploads"),
+            (self._m_prefix_reupload_bytes, "reupload_bytes"),
+            (self._m_prefix_evictions, "evictions"),
+        ):
+            current = getattr(cache, attr)
+            delta = current - self._prefix_seen[attr]
+            if delta > 0:
+                counter.inc(delta)
+                self._prefix_seen[attr] = current
         self._m_prefix_bytes.set(cache.bytes)
+        self._m_prefix_host_bytes.set(cache.host_bytes)
         self._m_prefix_nodes.set(cache.nodes)
+        self._m_prefix_host_nodes.set(cache.host_nodes)
+
+    def prefix_digest(self, max_entries: int = 256) -> list[int]:
+        """Exact hot-prefix advertisement from the radix tree: the rolling
+        block-hash chain (serve/digest.py) of every cached path, root-first
+        so truncation keeps the hottest shared preambles. The server merges
+        this into /healthz's ``prefix_digest`` field; the fleet balancer
+        uses it to route saturation fallbacks to the replica holding the
+        longest cached prefix. Reads engine-thread-owned structure — the
+        server calls it through the loop-ticked stats snapshot, never live."""
+        if self.prefix_cache is None:
+            return []
+        from prime_tpu.serve.digest import prefix_hashes
+
+        out: list[int] = []
+        seen: set[int] = set()
+        for path in self.prefix_cache.iter_prefixes(limit=max_entries):
+            for h in prefix_hashes(path):
+                if h not in seen:
+                    seen.add(h)
+                    out.append(h)
+            if len(out) >= max_entries:
+                break
+        return out[:max_entries]
 
     def _decode_chunk(self) -> None:
         import jax.numpy as jnp
@@ -1794,6 +1952,15 @@ class ContinuousBatchingEngine:
             return self._refresh_stats()
         return dict(snapshot)
 
+    def prefix_digest_snapshot(self) -> list[int]:
+        """Thread-safe read of the hot-prefix digest for /healthz: the
+        loop-ticked snapshot when the engine thread owns the tree, a fresh
+        walk when the caller does (synchronous tests/bench)."""
+        if self._thread is None or self._thread is threading.current_thread():
+            return self.prefix_digest()
+        with self._stats_lock:
+            return list(self._digest_snapshot)
+
     def _refresh_stats(self) -> dict:
         """Compute the full stats dict from live state and publish it as the
         snapshot stats() serves to other threads. Called at the end of every
@@ -1801,7 +1968,13 @@ class ContinuousBatchingEngine:
         self._m_active_slots.set(int(self._active.sum()))
         self._m_queue_depth.set(self._pending.qsize() + len(self._requeued))
         if self.prefix_cache is not None:
-            self._m_prefix_bytes.set(self.prefix_cache.bytes)
+            self._sync_prefix_metrics()
+            now = time.monotonic()
+            if now - self._digest_at >= self.digest_refresh_s:
+                digest = self.prefix_digest()
+                with self._stats_lock:
+                    self._digest_snapshot = digest
+                self._digest_at = now
         values = self.registry.values()
         stall = float(values["serve_host_stall_seconds_total"])
         window = float(values["serve_chunk_window_seconds_total"])
@@ -1831,8 +2004,11 @@ class ContinuousBatchingEngine:
             "wasted_decode_tokens": int(values["serve_wasted_decode_tokens_total"]),
             "warmup_programs": int(values["serve_warmup_programs"]),
             "prefix_cache_bytes": int(values["serve_prefix_cache_bytes"]),
+            "prefix_cache_host_bytes": int(values["serve_prefix_cache_host_bytes"]),
             "prefix_cache_nodes": int(values["serve_prefix_cache_nodes"]),
             "prefix_evictions": int(values["serve_prefix_evictions_total"]),
+            "prefix_spills": int(values["serve_prefix_spills_total"]),
+            "prefix_reuploads": int(values["serve_prefix_reuploads_total"]),
             "prefix_assembles": int(values["serve_prefix_assembles_total"]),
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
@@ -1856,6 +2032,17 @@ class EngineBackend:
     def stats(self) -> dict:
         """Forward the engine's observability counters (server /metrics)."""
         return self.engine.stats()
+
+    def prefix_digest(self) -> list[int]:
+        """The engine's hot-prefix advertisement (server /healthz)."""
+        return self.engine.prefix_digest_snapshot()
+
+    @property
+    def prefix_cache_enabled(self) -> bool:
+        """Whether /healthz should advertise a prefix digest at all: a
+        cacheless replica advertising prompts it cannot assemble would
+        steal cache-aware reroutes it then serves with a full recompute."""
+        return self.engine.prefix_cache is not None
 
     @property
     def registry(self):

@@ -20,14 +20,21 @@ cache indexes by:
   prefix's home and invalidate every replica's warm cache at once.
 - ``PrefixAffinityBalancer.pick`` walks the ring from the key's position and
   takes the first *routable* replica as the affinity target. A saturated
-  target (its /healthz-reported queue is backing up) falls back to the
-  least-loaded routable replica — queue depth + active slots, the same
-  fields the membership poller snapshots — because a cache hit is not worth
+  target (its /healthz-reported queue is backing up) no longer falls back
+  blind: among the healthy, UNSATURATED replicas, the balancer probes each
+  one's advertised hot-prefix digest (serve/digest.py, polled by
+  membership.py) with the request's own block-hash chain and diverts to the
+  replica advertising the **longest cached prefix** — the one that can
+  assemble the most KV instead of recomputing it. Only when no unsaturated
+  replica advertises any matching prefix (or none exists) does the old
+  least-loaded fallback apply — queue depth + active slots, the same fields
+  the membership poller snapshots — because a cache hit is not worth
   queueing behind a full box when an idle one can cold-prefill immediately.
 
-Dependency-light on purpose: hashlib + the membership module. MIN_BUCKET is
-redeclared from serve/engine.py (imported lazily there to keep this module
-jax-free) and pinned by a test so the two cannot drift.
+Dependency-light on purpose: hashlib + the membership/digest modules.
+MIN_BUCKET/CHARS_PER_TOKEN come from serve/digest.py (jax-free), which
+redeclares the engine's MIN_BUCKET; a test pins the pair so they cannot
+drift.
 """
 
 from __future__ import annotations
@@ -36,16 +43,18 @@ import bisect
 import hashlib
 from typing import Iterable, Sequence
 
+# the digest module owns the block size (= serve.engine.MIN_BUCKET, pinned
+# by tests/test_fleet.py) and the text->token length proxy: affinity keys,
+# digest chains, and radix-tree edges must all align to the same block
+# boundaries or no prompt that could share cached KV would share a routing
+# key or match an advertisement
+from prime_tpu.serve.digest import (
+    CHARS_PER_TOKEN,
+    MIN_BUCKET,
+    longest_match_blocks,
+    prefix_hashes,
+)
 from prime_tpu.serve.fleet.membership import BREAKER_CLOSED, Replica
-
-# MUST equal serve.engine.MIN_BUCKET (tests/test_fleet.py pins this): the
-# affinity key is aligned to the prefix cache's block size so every prompt
-# that could share cached KV blocks shares a routing key.
-MIN_BUCKET = 16
-# crude text->token length proxy for routers fronting upstreams whose
-# tokenizer they don't have; only the block *alignment* depends on it, and
-# alignment only affects which over-short prompts get no key
-CHARS_PER_TOKEN = 4
 
 
 def affinity_key(
@@ -122,15 +131,27 @@ class Pick:
     """One routing decision. ``affinity`` — the request had a usable prefix
     key; ``hit`` — it landed on its ring target (the replica most likely to
     hold its prefix KV); ``rerouted`` — it had a target but was diverted
-    (saturation or exclusion)."""
+    (saturation or exclusion); ``cache_routed`` — the diversion chose the
+    replica advertising the longest cached prefix (``cached_blocks`` blocks
+    deep) instead of falling back blind to least-loaded."""
 
-    __slots__ = ("replica", "affinity", "hit", "rerouted")
+    __slots__ = ("replica", "affinity", "hit", "rerouted", "cache_routed", "cached_blocks")
 
-    def __init__(self, replica: Replica, affinity: bool, hit: bool, rerouted: bool) -> None:
+    def __init__(
+        self,
+        replica: Replica,
+        affinity: bool,
+        hit: bool,
+        rerouted: bool,
+        cache_routed: bool = False,
+        cached_blocks: int = 0,
+    ) -> None:
         self.replica = replica
         self.affinity = affinity
         self.hit = hit
         self.rerouted = rerouted
+        self.cache_routed = cache_routed
+        self.cached_blocks = cached_blocks
 
 
 def _load(replica: Replica) -> tuple:
@@ -193,6 +214,28 @@ class PrefixAffinityBalancer:
         target = by_id[order[0]]
         if target.queue_depth <= self.saturation_depth:
             return Pick(target, affinity=True, hit=True, rerouted=False)
+        # saturated target: before falling back blind, probe the advertised
+        # hot-prefix digests of the UNSATURATED candidates — a replica that
+        # already holds this request's prefix KV serves it with an assemble
+        # instead of a recompute, which beats raw queue-depth arithmetic
+        unsaturated = [
+            r for r in pool
+            if r.id != target.id and r.queue_depth <= self.saturation_depth
+        ]
+        if unsaturated and any(r.digest for r in unsaturated):
+            chain = prefix_hashes(prompt, block=self.block)
+            best: Replica | None = None
+            best_depth = 0
+            # least-loaded-first scan makes ties deterministic AND load-aware
+            for r in sorted(unsaturated, key=_load):
+                depth = longest_match_blocks(chain, r.digest)
+                if depth > best_depth:
+                    best, best_depth = r, depth
+            if best is not None:
+                return Pick(
+                    best, affinity=True, hit=False, rerouted=True,
+                    cache_routed=True, cached_blocks=best_depth,
+                )
         least = min(pool, key=_load)
         return Pick(
             least, affinity=True, hit=least.id == target.id, rerouted=least.id != target.id

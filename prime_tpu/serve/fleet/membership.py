@@ -19,6 +19,14 @@ FleetRouter and the truth about which of them may receive traffic:
   standard three-state breaker — the half-open single-trial step is what
   stops a still-dead replica from eating a burst of real traffic every
   cooldown expiry.
+- **Hot-prefix digests.** A replica's /healthz may carry a ``prefix_digest``
+  field (serve/digest.py): the bounded block-hash advertisement of the
+  prefixes its KV cache holds. The poller retains it per replica — parsed
+  tolerantly (older replicas omit the field entirely, partial rollouts may
+  send junk; either degrades to an EMPTY digest, never a poll failure) and
+  capped at ``digest.RETAIN_MAX_ENTRIES`` hashes so a misbehaving replica
+  cannot balloon router memory. The balancer's saturation fallback reads it
+  to route toward the replica advertising the longest cached prefix.
 - **Drain.** ``drain(replica_id)`` marks the replica draining locally —
   routing excludes it immediately, so the consistent-hash ring rebalances
   its arcs — and (best-effort) POSTs the replica's ``/admin/drain`` so it
@@ -38,12 +46,26 @@ import time
 from typing import Any, Callable, Iterable
 from urllib.parse import urlsplit
 
+from prime_tpu.serve.digest import parse_digest
+
 BREAKER_CLOSED = "closed"
 BREAKER_OPEN = "open"
 BREAKER_HALF_OPEN = "half-open"
 
 # numeric encoding for the fleet_breaker_state gauge (docs "Serve fleet")
 BREAKER_GAUGE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1, BREAKER_OPEN: 2}
+
+
+def _as_int(value: Any) -> int:
+    """Load fields from /healthz coerced defensively: apply_health's no-raise
+    contract covers junk VALUES ("busy", a list), not just junk schemas —
+    anything non-numeric reads as 0, the same default as an absent field."""
+    try:
+        if isinstance(value, bool) or value is None:
+            return int(bool(value))
+        return int(value)
+    except (TypeError, ValueError):
+        return 0
 
 
 def replica_id_for(url: str) -> str:
@@ -74,6 +96,9 @@ class Replica:
         # un-drain = remove + re-join (or restart the replica)
         self.local_drain = False
         self.last_poll_at = 0.0
+        # hot-prefix advertisement (serve/digest.py) as last polled: empty
+        # for replicas that predate the field or sent a malformed one
+        self.digest: frozenset[int] = frozenset()
         # breaker
         self.breaker = BREAKER_CLOSED
         self.consecutive_failures = 0
@@ -89,6 +114,7 @@ class Replica:
             "active_slots": self.active_slots,
             "max_slots": self.max_slots,
             "consecutive_failures": self.consecutive_failures,
+            "digest_entries": len(self.digest),
             "last_poll_age_s": (
                 round(time.monotonic() - self.last_poll_at, 3) if self.last_poll_at else None
             ),
@@ -246,6 +272,31 @@ class FleetMembership:
                 )
             return self._client
 
+    def apply_health(self, replica: Replica, body: dict[str, Any], status_code: int) -> None:
+        """Snapshot one /healthz reply onto the replica. Split out of
+        poll_once so the payload-schema tolerance (older replicas without
+        the prefix-digest field, malformed or oversized digests) is testable
+        without sockets. Every field read is additive-with-default: a reply
+        from ANY schema generation must never raise."""
+        with self._lock:
+            replica.last_poll_at = time.monotonic()
+            if replica.local_drain:
+                # sticky: even if the upstream still says "ready" (the
+                # best-effort remote drain POST may have been lost), the
+                # router keeps it out of rotation
+                replica.state = "draining"
+            else:
+                replica.state = str(
+                    body.get("state", "ready" if status_code == 200 else "down")
+                )
+            replica.queue_depth = _as_int(body.get("queue_depth"))
+            replica.active_slots = _as_int(body.get("active_slots"))
+            replica.max_slots = _as_int(body.get("max_slots"))
+            replica.drained = bool(body.get("drained", False))
+            # absent/junk field -> empty digest (pre-digest replicas route
+            # exactly as before); retention capped inside parse_digest
+            replica.digest = parse_digest(body.get("prefix_digest"))
+
     def poll_once(self, replica: Replica) -> None:
         """One health probe: snapshot /healthz onto the replica, feed the
         breaker. In the half-open state this IS the trial request."""
@@ -263,21 +314,7 @@ class FleetMembership:
                 body = parsed
         except ValueError:
             pass
-        with self._lock:
-            replica.last_poll_at = time.monotonic()
-            if replica.local_drain:
-                # sticky: even if the upstream still says "ready" (the
-                # best-effort remote drain POST may have been lost), the
-                # router keeps it out of rotation
-                replica.state = "draining"
-            else:
-                replica.state = str(
-                    body.get("state", "ready" if response.status_code == 200 else "down")
-                )
-            replica.queue_depth = int(body.get("queue_depth", 0) or 0)
-            replica.active_slots = int(body.get("active_slots", 0) or 0)
-            replica.max_slots = int(body.get("max_slots", 0) or 0)
-            replica.drained = bool(body.get("drained", False))
+        self.apply_health(replica, body, response.status_code)
         self.note_success(replica.id)
 
     def poll_all(self) -> None:

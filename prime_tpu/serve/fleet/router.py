@@ -205,6 +205,11 @@ class FleetRouter:
             "Requests diverted from their first-choice replica, by reason",
             labelnames=("reason",),
         )
+        self._m_cache_routed = r.counter(
+            "fleet_cache_routed_total",
+            "Saturation fallbacks placed by advertised cached prefix "
+            "(longest hot-prefix digest match) instead of blind least-loaded",
+        )
         self._m_breaker = r.gauge(
             "fleet_breaker_state",
             "Circuit state per replica: 0=closed 1=half-open 2=open",
@@ -498,9 +503,16 @@ class FleetRouter:
                         self._m_affinity_hits.value() / total if total else 0.0
                     )
                 if pick.rerouted:
-                    self._m_reroutes.inc(reason="saturated")
+                    # "cache": the saturation fallback chose the replica
+                    # advertising the longest cached prefix (balancer.py);
+                    # "saturated": the blind least-loaded fallback
+                    reason = "cache" if pick.cache_routed else "saturated"
+                    self._m_reroutes.inc(reason=reason)
+                    if pick.cache_routed:
+                        self._m_cache_routed.inc()
                     self.flight.event(
-                        fkey, "reroute", reason="saturated"
+                        fkey, "reroute", reason=reason,
+                        cached_blocks=pick.cached_blocks,
                     )
             url = f"{replica.url}/v1/chat/completions"
             self.flight.event(fkey, "attempt", replica=replica.id)
@@ -723,6 +735,7 @@ class FleetRouter:
             "affinity_requests": int(values["fleet_affinity_requests_total"]),
             "affinity_hits": int(values["fleet_affinity_hits_total"]),
             "affinity_hit_ratio": round(values["fleet_affinity_hit_ratio"], 4),
+            "cache_routed": int(values["fleet_cache_routed_total"]),
             "admission_rejected": int(values["fleet_admission_rejected_total"]),
             "inflight": self._gate.inflight,
             "requests_by_replica": per_replica,

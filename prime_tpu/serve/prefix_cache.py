@@ -1,4 +1,4 @@
-"""Block-granular radix-tree prefix KV cache for the serving engine.
+"""Block-granular radix-tree prefix KV cache with a host-RAM spill tier.
 
 The engine's prompt-prefix reuse layer (serve/engine.py `_prefix_seed` /
 `_store_prefix`) used to be a flat newest-last list of at most N whole-prompt
@@ -27,32 +27,48 @@ cached bytes. This module replaces the storage side with a radix tree over
   program, not on the host). Correctness leans on the radix invariant: a
   segment is only reachable along the exact token path from the root, so the
   KV it holds was computed under precisely the context the new prompt shares.
-- **Byte-budget LRU.** The cache tracks the device bytes of every segment and
-  evicts least-recently-used *leaf* nodes (interior nodes are load-bearing
-  for their descendants' paths) until under ``budget_bytes``. ``match`` pins
-  its path (refcount) so a hit mid-assembly can never have a segment evicted
-  out from under it; callers release the pin once the assemble dispatch is
-  enqueued.
+- **Two tiers under two byte budgets.** Every node's segment lives on one of
+  two tiers: ``device`` (HBM — directly assemblable) or ``host`` (RAM — the
+  spill tier). When device bytes exceed ``budget_bytes`` and a host budget is
+  configured, the LRU *demotes* segments to host buffers (``to_host``, e.g.
+  ``jax.device_get``) instead of freeing them; a later hit on a host-resident
+  node *promotes* (re-uploads, ``to_device``) its segments and feeds them
+  through the same one-dispatch assemble path. Only when host bytes exceed
+  ``host_budget_bytes`` are LRU host **leaves** actually deleted (interior
+  nodes are load-bearing for their descendants' paths). With no host budget
+  the device LRU deletes leaves directly — the original single-tier behavior.
+- **Refcount pins span tiers.** ``match`` pins its path so a hit mid-assembly
+  can never have a segment evicted, demoted, or promoted-then-demoted out
+  from under it; callers release the pin once the assemble dispatch is
+  enqueued. ``promote`` on a pinned match flips its host entries to device in
+  place — the radix/refcount/split invariants are tier-agnostic.
 
 The tree is engine-thread-owned (like all engine device state): pin/release
 make the eviction invariant explicit, not the structure thread-safe. The
 module is deliberately jax-light — segments are opaque pytrees; only byte
-accounting walks their leaves — so it unit-tests with plain numpy arrays.
+accounting walks their leaves, and the tier converters are injected — so it
+unit-tests with plain numpy arrays and identity converters.
 """
 
 from __future__ import annotations
 
 import heapq
 import itertools
+import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable
+from typing import Any, Callable, Iterator
 
 __all__ = ["BlockPrefixCache", "PrefixMatch", "segment_nbytes"]
 
+TIER_DEVICE = "device"
+TIER_HOST = "host"
+
 
 def segment_nbytes(segment: Any) -> int:
-    """Device bytes of a segment pytree (sum over leaves of size*itemsize —
-    the same accounting for bf16/fp32 KV, int8 KV, and fp32 scales)."""
+    """Bytes of a segment pytree (sum over leaves of size*itemsize — the same
+    accounting for bf16/fp32 KV, int8 KV, and fp32 scales, and for device
+    arrays and their host copies, whose shapes/dtypes are identical)."""
     import jax
 
     return int(
@@ -70,11 +86,15 @@ def _common_len(a, b) -> int:
 
 class _Node:
     """One radix-tree edge+node: ``tokens`` is the edge label (length a
-    multiple of the cache block), ``segment`` the KV slices for those slots.
-    Children are keyed by the first block of their edge — siblings can never
-    share a first block (they would have been one edge split later)."""
+    multiple of the cache block), ``segment`` the KV slices for those slots,
+    ``tier`` where the segment currently lives. Children are keyed by the
+    first block of their edge — siblings can never share a first block (they
+    would have been one edge split later)."""
 
-    __slots__ = ("tokens", "segment", "children", "parent", "refs", "last_used", "nbytes")
+    __slots__ = (
+        "tokens", "segment", "children", "parent", "refs", "last_used",
+        "nbytes", "tier",
+    )
 
     def __init__(self, tokens: tuple[int, ...], segment: Any, parent: "_Node | None") -> None:
         self.tokens = tokens
@@ -84,17 +104,25 @@ class _Node:
         self.refs = 0
         self.last_used = 0
         self.nbytes = segment_nbytes(segment) if segment is not None else 0
+        self.tier = TIER_DEVICE
 
 
 @dataclass
 class PrefixMatch:
     """A pinned walk result: ``entries`` are (node, take) pairs root-to-deep;
     ``take`` is how many of the node's slots the match uses (a multiple of
-    the block; full except possibly the last entry). ``length`` is their sum.
+    the block; full except possibly the last entry). ``length`` is their sum
+    and ``host_tokens`` the portion resident on the host spill tier at match
+    time (``promote`` must run before ``segments()`` when it is non-zero).
     Callers MUST ``release()`` the match once its segments have been read."""
 
     length: int
     entries: list[tuple[_Node, int]] = field(default_factory=list)
+    host_tokens: int = 0
+
+    @property
+    def device_tokens(self) -> int:
+        return self.length - self.host_tokens
 
     def segments(self) -> tuple[Any, ...]:
         return tuple(node.segment for node, _ in self.entries)
@@ -104,25 +132,48 @@ class PrefixMatch:
 
 
 class BlockPrefixCache:
-    """Radix tree of block-aligned KV segments under a byte budget.
+    """Radix tree of block-aligned KV segments under per-tier byte budgets.
 
     ``block`` must match the engine's MIN_BUCKET (chunk_plan's alignment
     contract: a prefix hit becomes the ``start`` of a chunk plan, which must
     be block-aligned). ``budget_bytes <= 0`` means unbounded (the engine
     disables the cache entirely rather than passing 0 here).
+    ``host_budget_bytes <= 0`` disables the spill tier (device eviction
+    deletes, the original behavior); when positive, ``to_host`` /
+    ``to_device`` convert segments across tiers (default: identity, which
+    keeps the unit tests jax-free — the engine injects ``jax.device_get``
+    and a ``jnp.asarray`` tree-map).
     """
 
-    def __init__(self, budget_bytes: int, block: int = 16) -> None:
+    def __init__(
+        self,
+        budget_bytes: int,
+        block: int = 16,
+        *,
+        host_budget_bytes: int = 0,
+        to_host: Callable[[Any], Any] | None = None,
+        to_device: Callable[[Any], Any] | None = None,
+    ) -> None:
         if block <= 0:
             raise ValueError(f"block must be positive, got {block}")
         self.block = block
         self.budget_bytes = int(budget_bytes)
+        self.host_budget_bytes = int(host_budget_bytes)
+        self._to_host = to_host if to_host is not None else (lambda seg: seg)
+        self._to_device = to_device if to_device is not None else (lambda seg: seg)
         self._root = _Node((), None, None)
         self._clock = itertools.count(1)
-        self.bytes = 0
-        self.nodes = 0  # segment-owning nodes (root excluded), O(1) gauge read
-        self.evictions = 0  # nodes evicted (monotonic)
+        self.bytes = 0  # device-tier segment bytes
+        self.host_bytes = 0  # host-tier segment bytes
+        self.nodes = 0  # segment-owning nodes, both tiers (root excluded)
+        self.host_nodes = 0  # host-tier subset of ``nodes``
+        self.evictions = 0  # nodes DELETED from the tree (monotonic)
         self.evicted_bytes = 0
+        self.spills = 0  # device->host demotions (monotonic)
+        self.spilled_bytes = 0
+        self.spill_seconds = 0.0  # wall time inside to_host (a device sync)
+        self.reuploads = 0  # host->device promotions (monotonic)
+        self.reupload_bytes = 0
         self.dedup_tokens = 0  # insert tokens already present (stored once)
         self.stored_tokens = 0  # insert tokens that allocated new segments
 
@@ -168,14 +219,51 @@ class BlockPrefixCache:
         if not entries:
             return None
         stamp = next(self._clock)
-        for node, _ in entries:
+        host_tokens = 0
+        for node, take in entries:
             node.refs += 1
             node.last_used = stamp
-        return PrefixMatch(length=sum(t for _, t in entries), entries=entries)
+            if node.tier == TIER_HOST:
+                host_tokens += take
+        return PrefixMatch(
+            length=sum(t for _, t in entries), entries=entries,
+            host_tokens=host_tokens,
+        )
 
     def release(self, match: PrefixMatch) -> None:
         for node, _ in match.entries:
             node.refs -= 1
+
+    def promote(self, match: PrefixMatch) -> tuple[int, int]:
+        """Re-upload every host-resident segment on a PINNED match path back
+        to the device tier (in place — the path, refcounts, and byte totals
+        are preserved; only the tier accounting moves). Must run before
+        ``match.segments()`` is consumed when ``match.host_tokens > 0``: the
+        assemble dispatch needs device-tier leaves. Returns (segments
+        promoted, bytes promoted). Headroom is made BEFORE each re-upload —
+        colder unpinned device segments demote first — so a device tier
+        tuned near free HBM never transiently overshoots its budget on the
+        hot-prefix path (beyond what the pinned path itself requires); a
+        final rebalance settles the host tier the demotions grew."""
+        promoted = promoted_bytes = 0
+        heap: list[tuple[int, int, int, _Node]] | None = None
+        for node, _ in match.entries:
+            if node.tier != TIER_HOST:
+                continue
+            if self.budget_bytes > 0:
+                heap = self._demote_lru_until(self.budget_bytes - node.nbytes, heap)
+            node.segment = self._to_device(node.segment)
+            node.tier = TIER_DEVICE
+            self.host_bytes -= node.nbytes
+            self.host_nodes -= 1
+            self.bytes += node.nbytes
+            self.reuploads += 1
+            self.reupload_bytes += node.nbytes
+            promoted += 1
+            promoted_bytes += node.nbytes
+        if promoted:
+            self.evict_to_budget()
+        return promoted, promoted_bytes
 
     # ---- insert ----
 
@@ -185,7 +273,8 @@ class BlockPrefixCache:
         along the trie path. ``slicer(start, stop)`` returns the segment
         pytree for slots [start, stop) of the finalized staging row; it is
         only called for the genuinely new tail, so shared blocks cost
-        nothing. Returns the bytes added."""
+        nothing (a host-resident shared block stays on the host — the walk
+        just refreshes its stamp). Returns the bytes added."""
         block = self.block
         total = len(ids)
         if total == 0:
@@ -227,20 +316,34 @@ class BlockPrefixCache:
         """Split ``node``'s edge at slot ``m`` (block-aligned): the node
         keeps the first m tokens/slots (its parent key stays valid — the
         first block is unchanged); a new lower node takes the rest plus the
-        original children. Byte accounting is conserved: slot counts are
-        linear, so upper+lower bytes == the original."""
+        original children. Byte accounting is conserved on the node's OWN
+        tier: slot counts are linear, so upper+lower bytes == the original,
+        and both halves stay where the segment lives."""
         # a pinned node's segment must stay intact until release() — the pin
         # contract assemble relies on. The engine releases every pin before
         # its store-path insert (same thread), so this is unreachable there;
         # fail loudly rather than silently truncating a pinned segment.
         assert node.refs == 0, "cannot split a node on a pinned match path"
-        lower = _Node(node.tokens[m:], self._cut(node.segment, m, len(node.tokens)), node)
+        # host-resident segments are host arrays (e.g. device_get numpy),
+        # where a basic slice is a VIEW over the full base buffer: both
+        # halves must materialize copies or evicting one half later frees
+        # nothing (the survivor's view pins the whole buffer and the host
+        # byte budget silently stops bounding RSS). Device arrays slice into
+        # fresh buffers already; copying there would be pure waste.
+        copy = node.tier == TIER_HOST
+        lower = _Node(node.tokens[m:], self._cut(node.segment, m, len(node.tokens), copy=copy), node)
+        lower.tier = node.tier
         lower.children = node.children
         for c in lower.children.values():
             c.parent = lower
         lower.last_used = node.last_used
-        upper_seg = self._cut(node.segment, 0, m)
-        self.bytes += lower.nbytes + segment_nbytes(upper_seg) - node.nbytes
+        upper_seg = self._cut(node.segment, 0, m, copy=copy)
+        delta = lower.nbytes + segment_nbytes(upper_seg) - node.nbytes
+        if node.tier == TIER_HOST:
+            self.host_bytes += delta
+            self.host_nodes += 1
+        else:
+            self.bytes += delta
         self.nodes += 1
         node.segment = upper_seg
         node.nbytes = segment_nbytes(upper_seg)
@@ -248,53 +351,195 @@ class BlockPrefixCache:
         node.children = {lower.tokens[: self.block]: lower}
 
     @staticmethod
-    def _cut(segment: Any, start: int, stop: int) -> Any:
+    def _cut(segment: Any, start: int, stop: int, copy: bool = False) -> Any:
         """Re-slice an existing segment along the capacity axis (always the
         last axis of every segment leaf, by construction of the engine's
-        slicer)."""
+        slicer). ``copy`` materializes the slice (host arrays slice to
+        views; see _split) — device arrays already slice to new buffers."""
         import jax
 
+        if copy:
+            return jax.tree_util.tree_map(lambda x: x[..., start:stop].copy(), segment)
         return jax.tree_util.tree_map(lambda x: x[..., start:stop], segment)
 
-    # ---- eviction ----
+    # ---- digest export ----
+
+    def iter_prefixes(self, limit: int) -> Iterator[tuple[int, ...]]:
+        """Root-first (BFS) token paths of up to ``limit`` segment-owning
+        nodes, both tiers — shallow shared prefixes come first, so a
+        truncated walk keeps the hottest entries. The fleet's hot-prefix
+        digest (serve/digest.py) hashes these for /healthz advertisement."""
+        emitted = 0
+        queue: deque[tuple[_Node, tuple[int, ...]]] = deque([(self._root, ())])
+        while queue and emitted < limit:
+            node, base = queue.popleft()
+            for child in node.children.values():
+                path = base + child.tokens
+                yield path
+                emitted += 1
+                if emitted >= limit:
+                    return
+                queue.append((child, path))
+
+    # ---- eviction / demotion ----
+
+    def _collect_lru(self, want: Callable[[_Node], bool]) -> list[tuple[int, int, int, _Node]]:
+        """ONE tree walk collecting every node ``want`` accepts into a
+        min-heap ordered (last_used, -depth, id): coldest first, and on
+        stamp ties (one walk stamps its whole path with one clock tick) the
+        DEEPEST node first, so children demote/evict before the parents
+        that carry their paths."""
+        heap: list[tuple[int, int, int, _Node]] = []
+        stack: list[tuple[_Node, int]] = [(self._root, 0)]
+        while stack:
+            node, depth = stack.pop()
+            for child in node.children.values():
+                stack.append((child, depth + 1))
+                if want(child):
+                    heapq.heappush(heap, (child.last_used, -(depth + 1), id(child), child))
+        return heap
+
+    def _demote_lru_until(
+        self,
+        target_bytes: int,
+        heap: list[tuple[int, int, int, _Node]] | None = None,
+    ) -> list[tuple[int, int, int, _Node]]:
+        """Demote LRU unpinned device segments to the host tier until device
+        bytes fit ``target_bytes`` or candidates run out (pins can hold the
+        tier over target, which is transient and safe). Returns the heap so
+        repeated callers (promote's per-segment headroom) pay ONE walk."""
+        if heap is None:
+            heap = self._collect_lru(lambda n: n.tier == TIER_DEVICE)
+        while self.bytes > target_bytes and heap:
+            _, _, _, victim = heapq.heappop(heap)
+            if victim.refs > 0 or victim.tier != TIER_DEVICE:
+                continue  # pinned (incl. a match path mid-promote) or moved
+            self._spill(victim)
+        return heap
+
+    def _spill(self, node: _Node) -> None:
+        """Demote one device-tier segment to the host spill tier in place:
+        the tree shape, refcount, and LRU stamp are untouched — only the
+        segment's residency (and the per-tier byte totals) move."""
+        t0 = time.monotonic()
+        node.segment = self._to_host(node.segment)
+        self.spill_seconds += time.monotonic() - t0
+        node.tier = TIER_HOST
+        self.bytes -= node.nbytes
+        self.host_bytes += node.nbytes
+        self.host_nodes += 1
+        self.spills += 1
+        self.spilled_bytes += node.nbytes
 
     def evict_to_budget(self) -> int:
-        """Drop least-recently-used unpinned leaves until within budget: ONE
-        tree walk collects the current leaves into a min-heap by LRU stamp,
-        and a parent bared by its last child's eviction joins the heap (the
-        cascade stays local via parent pointers — no per-victim re-walk on
-        the engine thread). Pinned leaves are skipped; when only pinned or
-        interior nodes remain the cache may stay over budget, which is safe.
-        Returns the number of nodes evicted."""
-        if self.budget_bytes <= 0 or self.bytes <= self.budget_bytes:
-            return 0
-        heap: list[tuple[int, int, _Node]] = []
-        stack = [self._root]
-        while stack:
-            node = stack.pop()
-            for child in node.children.values():
-                if child.children:
-                    stack.append(child)
-                else:
-                    heapq.heappush(heap, (child.last_used, id(child), child))
+        """Rebalance both tiers. Device over budget: with a host tier, demote
+        least-recently-used unpinned device segments (ANY node — demotion
+        keeps the tree shape, so interior nodes are fair game and no cascade
+        is needed; the hot shared preambles have fresh stamps and naturally
+        stay resident); without one, drop LRU unpinned device leaves as
+        before. Host over budget: drop LRU unpinned host LEAVES, cascading
+        to a parent bared by its last child's eviction only when that parent
+        is itself host-resident; if host bytes remain only on interior
+        nodes (device tails planted under spilled parents), whole LRU
+        host-rooted subtrees go. Pinned nodes are skipped; only pins can
+        hold a tier over budget, which is transient and safe. Returns the
+        number of nodes DELETED (demotions are counted in ``spills``, not
+        here)."""
         evicted = 0
-        while self.bytes > self.budget_bytes and heap:
-            _, _, victim = heapq.heappop(heap)
-            if victim.refs > 0 or victim.children:
-                continue  # pinned, or became interior since collection
+        if self.budget_bytes > 0 and self.bytes > self.budget_bytes:
+            if self.host_budget_bytes > 0:
+                self._demote_lru_until(self.budget_bytes)
+            else:
+                evicted += self._evict_leaves(TIER_DEVICE)
+        if self.host_budget_bytes > 0 and self.host_bytes > self.host_budget_bytes:
+            evicted += self._evict_leaves(TIER_HOST)
+            if self.host_bytes > self.host_budget_bytes:
+                # leaf eviction ran dry with host bytes left: insert() can
+                # plant a fresh DEVICE tail under a spilled (host) parent,
+                # leaving host bytes only on interior nodes no leaf pass can
+                # delete — a RAM budget that HBM-resident children can pin
+                # open is not a budget, so fall back to whole subtrees
+                evicted += self._evict_host_subtrees()
+        return evicted
+
+    def _evict_leaves(self, tier: str) -> int:
+        """Drop least-recently-used unpinned leaves of ``tier`` until that
+        tier is within its budget: ONE tree walk collects the current leaves
+        into a min-heap by LRU stamp, and a parent bared by its last child's
+        eviction joins the heap if it shares the tier (the cascade stays
+        local via parent pointers — no per-victim re-walk on the engine
+        thread)."""
+        over = (
+            (lambda: self.bytes > self.budget_bytes)
+            if tier == TIER_DEVICE
+            else (lambda: self.host_bytes > self.host_budget_bytes)
+        )
+        heap = self._collect_lru(lambda n: not n.children and n.tier == tier)
+        evicted = 0
+        while over() and heap:
+            _, _, _, victim = heapq.heappop(heap)
+            if victim.refs > 0 or victim.children or victim.tier != tier:
+                continue  # pinned, became interior, or changed tier
             parent = victim.parent
             assert parent is not None
             del parent.children[victim.tokens[: self.block]]
-            self.bytes -= victim.nbytes
-            self.nodes -= 1
-            self.evicted_bytes += victim.nbytes
-            self.evictions += 1
+            self._forget(victim)
             evicted += 1
-            if parent is not self._root and not parent.children:
-                heapq.heappush(heap, (parent.last_used, id(parent), parent))
+            if parent is not self._root and not parent.children and parent.tier == tier:
+                depth, n = 0, parent
+                while n.parent is not None:
+                    depth, n = depth + 1, n.parent
+                heapq.heappush(heap, (parent.last_used, -depth, id(parent), parent))
+        return evicted
+
+    def _forget(self, node: _Node) -> None:
+        """Account one DETACHED node out of the cache (caller already
+        unlinked it from its parent)."""
+        if node.tier == TIER_HOST:
+            self.host_bytes -= node.nbytes
+            self.host_nodes -= 1
+        else:
+            self.bytes -= node.nbytes
+        self.nodes -= 1
+        self.evicted_bytes += node.nbytes
+        self.evictions += 1
+
+    def _evict_host_subtrees(self) -> int:
+        """Last resort for host-budget pressure: delete whole LRU
+        host-rooted subtrees, device-tier descendants included (hot tails
+        under a cold spilled preamble die with it — the alternative is a
+        host footprint no knob bounds). Subtrees containing a pinned node
+        are skipped; popped nodes already removed via an ancestor are
+        recognized by id."""
+        heap = self._collect_lru(lambda n: n.tier == TIER_HOST)
+        evicted = 0
+        gone: set[int] = set()
+        while self.host_bytes > self.host_budget_bytes and heap:
+            _, _, nid, victim = heapq.heappop(heap)
+            if nid in gone or victim.tier != TIER_HOST:
+                continue
+            stack, subtree, pinned = [victim], [], False
+            while stack:
+                n = stack.pop()
+                if n.refs > 0:
+                    pinned = True
+                    break
+                subtree.append(n)
+                stack.extend(n.children.values())
+            if pinned:
+                continue
+            parent = victim.parent
+            assert parent is not None
+            del parent.children[victim.tokens[: self.block]]
+            for n in subtree:
+                gone.add(id(n))
+                self._forget(n)
+                evicted += 1
         return evicted
 
     def clear(self) -> None:
         self._root = _Node((), None, None)
         self.bytes = 0
+        self.host_bytes = 0
         self.nodes = 0
+        self.host_nodes = 0

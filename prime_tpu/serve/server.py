@@ -40,6 +40,7 @@ from prime_tpu.obs.trace import (
     TraceContext,
     parse_traceparent,
 )
+from prime_tpu.serve.digest import HotPrefixDigest
 from prime_tpu.serve.errors import DrainingError, QueueFullError, backpressure_response
 
 CHAT_TEMPLATE = "{role}: {content}\n"
@@ -129,6 +130,14 @@ class InferenceServer:
         # batching engine records richer timelines itself; the /debug
         # endpoints prefer generator.flight when it exists)
         self._own_flight = FlightRecorder()
+        # hot-prefix digest (serve/digest.py): every admitted chat records
+        # its ROUTER-RENDERED prompt text's block-hash chain here, and
+        # /healthz advertises the bounded set (merged with the engine's
+        # exact id-block export when the backend has one) so a cache-aware
+        # fleet balancer can route saturation fallbacks to the replica
+        # holding the longest cached prefix. Only backends that declare
+        # prefix_cache_enabled (EngineBackend with a live cache) advertise.
+        self.prefix_digest = HotPrefixDigest()
         # server-side HTTP metrics live in the server's own registry; the
         # backing engine's registry (generator.registry, when present) is
         # rendered alongside it by the Prometheus exposition
@@ -480,6 +489,22 @@ class InferenceServer:
                     payload[key] = int(stats.get(key, 0))
             except Exception as e:  # noqa: BLE001 — health must never 500
                 payload["stats_error"] = str(e)[:200]
+        # ADDITIVE hot-prefix advertisement (serve/digest.py): text-proxy
+        # hashes of recently served chat prompts, merged with the engine's
+        # exact id-block export when the backend has one. Routers that
+        # predate the field ignore it; health must never 500 over it.
+        # Omitted entirely when the backend has no prefix cache — a
+        # cacheless replica must not attract cache-aware reroutes it would
+        # serve with a full recompute.
+        try:
+            if self._advertises_prefixes():
+                engine_hashes: list[int] = []
+                digest_fn = getattr(self.generator, "prefix_digest", None)
+                if callable(digest_fn):
+                    engine_hashes = list(digest_fn())
+                payload["prefix_digest"] = self.prefix_digest.snapshot(extra=engine_hashes)
+        except Exception as e:  # noqa: BLE001
+            payload["digest_error"] = str(e)[:200]
         if self._draining:
             # a drain is complete when nothing is queued or decoding — the
             # fleet router (and a preStop hook's poll loop) watch this flag.
@@ -507,6 +532,13 @@ class InferenceServer:
         drain_fn = getattr(self.generator, "drain", None)
         if callable(drain_fn):
             drain_fn()
+
+    def _advertises_prefixes(self) -> bool:
+        """Digest gate: only a backend that owns a live prefix cache
+        (EngineBackend.prefix_cache_enabled) records/advertises hot
+        prefixes — a cacheless replica advertising would steal cache-aware
+        reroutes it then serves with a full recompute."""
+        return bool(getattr(self.generator, "prefix_cache_enabled", False))
 
     def _admin_authorized(self, headers) -> bool:
         """One gate for every admin-grade surface (/admin/drain,
@@ -572,6 +604,16 @@ class InferenceServer:
             prompt = tokenizer.render_chat(messages)
         kwargs = {"top_p": top_p} if top_p < 1.0 else {}
         templated = prompt is not None
+        # the digest always hashes the ROUTER's rendering of the messages
+        # (not the tokenizer template) so the router's probe of the same
+        # request text produces identical digest entries; rendered at most
+        # once — it doubles as the prompt on the untemplated path, and a
+        # templated, non-advertising deployment skips the render entirely
+        routed_text = (
+            render_chat_prompt(messages)
+            if not templated or self._advertises_prefixes()
+            else None
+        )
         if templated:
             # the template already renders BOS/headers — the generator must
             # not add special tokens again (double BOS skews generation).
@@ -579,7 +621,7 @@ class InferenceServer:
             if _accepts_kwarg(self.generator.generate, "templated"):
                 kwargs["templated"] = True
         else:
-            prompt = render_chat_prompt(messages)
+            prompt = routed_text
         if trace is not None and _accepts_kwarg(self.generator.generate, "trace"):
             # thread the distributed trace down to the engine: its queue-wait
             # / prefill / per-request spans join the caller's trace id
@@ -604,6 +646,10 @@ class InferenceServer:
                 return 503, {"error": {"message": "server is draining", "type": "draining"}}
             except Exception as e:  # noqa: BLE001
                 return 500, {"error": {"message": f"generation failed: {e}"}}
+            # admitted: this prompt's prefix blocks are about to be cached —
+            # advertise them
+            if self._advertises_prefixes():
+                self.prefix_digest.observe(routed_text)
             return _LiveStream(self.generator.stream_text(req), request=req)
         try:
             with TRACER.span(
@@ -625,6 +671,10 @@ class InferenceServer:
             return 503, {"error": {"message": "server is draining", "type": "draining"}}
         except Exception as e:  # noqa: BLE001 — surface as an API error, keep serving
             return 500, {"error": {"message": f"generation failed: {e}"}}
+        # served: advertise the prompt's prefix chain (router-rendered text,
+        # matching the balancer's probe of the same messages)
+        if self._advertises_prefixes():
+            self.prefix_digest.observe(routed_text)
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
             "object": "chat.completion",
@@ -704,6 +754,7 @@ def serve_model(
     overlap: bool | None = None,
     warmup: bool | None = None,
     prefix_cache_mb: float | None = None,
+    prefix_cache_host_mb: float | None = None,
     max_queue: int | None = None,
     admin_token: str | None = None,
 ) -> InferenceServer:
@@ -717,7 +768,9 @@ def serve_model(
     control the engine's one-chunk-deep decode pipeline and its AOT warmup
     pass — docs/architecture.md "Engine pipeline". ``prefix_cache_mb``
     (None = the PRIME_SERVE_PREFIX_CACHE_MB env default, 0 = off) is the
-    byte budget of the radix prefix-KV cache — docs/architecture.md
+    byte budget of the radix prefix-KV cache, and ``prefix_cache_host_mb``
+    (None = PRIME_SERVE_PREFIX_CACHE_HOST_MB, 0 = off) the host-RAM spill
+    tier its device LRU demotes into — docs/architecture.md
     "Prefix cache". ``max_queue`` (None = the PRIME_SERVE_MAX_QUEUE env
     default, 0 = unbounded) bounds the engine's pending queue: submissions
     past it get 429 + Retry-After instead of queueing unboundedly — the
@@ -773,6 +826,7 @@ def serve_model(
                 overlap=overlap,
                 warmup=warmup,
                 prefix_cache_mb=prefix_cache_mb,
+                prefix_cache_host_mb=prefix_cache_host_mb,
                 max_queue=max_queue,
             )
             engine.start()

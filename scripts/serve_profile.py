@@ -17,7 +17,10 @@ Two modes:
   host spent enqueuing it (``serve.dispatch``), how long it later blocked
   fetching the tokens (``serve.sync``), and the host-stall fraction of the
   dispatch→sync window. A well-overlapped engine shows stall fractions near
-  zero; ~1.0 means the loop is effectively synchronous. ``--trace`` repeats:
+  zero; ~1.0 means the loop is effectively synchronous. When the run touched
+  the prefix cache's host spill tier, a tier report follows: spill (demotion)
+  timing from the store path and host-re-upload vs pure-HBM assemble costs
+  (``serve.assemble`` spans carry ``tier=device|host``). ``--trace`` repeats:
   pass each process's JSONL (client, router, replicas) and spans sharing a
   W3C trace id are merged into a per-request cross-process waterfall —
   router queue → replica queue → prefill → decode, with parent→child gaps
@@ -128,6 +131,51 @@ def overlap_report(path: str, quiet: bool = False) -> None:
     )
 
 
+def tier_report(paths: list[str]) -> None:
+    """Prefix-cache tier timing from the trace JSONL: what demotions to the
+    host spill tier cost on the store path (``serve.spill`` synthetic spans —
+    each is a forced device sync) and what a host-tier hit's re-upload added
+    to its assemble (``serve.assemble`` spans carry ``tier`` + token counts).
+    Silent when the run never touched the spill tier — single-tier traces
+    should not grow a table of zeros."""
+    spans = _load_spans(paths)
+    spills = [s for s in spans if s.get("name") == "serve.spill"]
+    assembles = [s for s in spans if s.get("name") == "serve.assemble"]
+    by_tier: dict[str, list[dict]] = defaultdict(list)
+    for span in assembles:
+        by_tier[(span.get("attrs") or {}).get("tier", "device")].append(span)
+    if not spills and not by_tier.get("host"):
+        return
+    print("--- prefix-cache tier report")
+    print(f"{'path':>22} {'count':>6} {'total_ms':>9} {'mean_ms':>8}  detail")
+    if spills:
+        total = sum(s.get("duration_s") or 0.0 for s in spills)
+        segments = sum((s.get("attrs") or {}).get("segments", 0) for s in spills)
+        nbytes = sum((s.get("attrs") or {}).get("bytes", 0) for s in spills)
+        print(
+            f"{'spill (store path)':>22} {len(spills):>6} {total * 1e3:>9.2f} "
+            f"{total / len(spills) * 1e3:>8.2f}  {segments} segments, "
+            f"{nbytes / 1e6:.2f} MB demoted"
+        )
+    for tier in ("host", "device"):
+        group = by_tier.get(tier)
+        if not group:
+            continue
+        total = sum(s.get("duration_s") or 0.0 for s in group)
+        tokens = sum((s.get("attrs") or {}).get("hit_tokens", 0) for s in group)
+        label = "assemble (re-upload)" if tier == "host" else "assemble (HBM hit)"
+        detail = f"{tokens} hit tokens"
+        if tier == "host":
+            host_tokens = sum(
+                (s.get("attrs") or {}).get("host_tokens", 0) for s in group
+            )
+            detail += f", {host_tokens} re-uploaded"
+        print(
+            f"{label:>22} {len(group):>6} {total * 1e3:>9.2f} "
+            f"{total / len(group) * 1e3:>8.2f}  {detail}"
+        )
+
+
 def _load_spans(paths: list[str]) -> list[dict]:
     """Every parseable span from every file, tagged with its source file —
     the waterfall marks parent→child edges that cross files as the
@@ -218,7 +266,10 @@ def waterfall_report(paths: list[str], trace_id: str | None = None, limit: int =
             attrs = span.get("attrs") or {}
             brief = ", ".join(
                 f"{k}={attrs[k]}"
-                for k in ("replica", "request", "outcome", "slot", "prompt_len", "tokens")
+                for k in (
+                    "replica", "request", "outcome", "slot", "prompt_len",
+                    "tokens", "tier", "hit_tokens", "host_tokens",
+                )
                 if k in attrs
             )
             line = f"{start * 1e3:>10.2f} {dur * 1e3:>9.2f}  {'  ' * depth}{span['name']}"
@@ -300,6 +351,7 @@ def main() -> None:
     if args.trace:
         for path in args.trace:
             overlap_report(path, quiet=len(args.trace) > 1)
+        tier_report(args.trace)
         waterfall_report(args.trace, trace_id=args.trace_id)
         return
     if args.fleet:
@@ -392,10 +444,12 @@ def main() -> None:
         f"wasted decode tokens {stats['wasted_decode_tokens']}"
     )
     print(
-        f"--- prefix cache: {stats['prefix_cache_bytes'] / 1e6:.1f} MB in "
+        f"--- prefix cache: {stats['prefix_cache_bytes'] / 1e6:.1f} MB device "
+        f"+ {stats['prefix_cache_host_bytes'] / 1e6:.1f} MB host in "
         f"{stats['prefix_cache_nodes']} nodes, {engine.prefix_hits} hits / "
         f"{stats['prefix_assembles']} assembles, "
-        f"{stats['prefix_evictions']} evictions"
+        f"{stats['prefix_spills']} spills / {stats['prefix_reuploads']} "
+        f"re-uploads, {stats['prefix_evictions']} evictions"
     )
     if os.environ.get("PRIME_TRACE"):
         print(f"--- spans at {os.environ['PRIME_TRACE']}: rerun with "

@@ -33,6 +33,7 @@ def _default_pipeline_env(monkeypatch):
     monkeypatch.delenv("PRIME_SERVE_OVERLAP", raising=False)
     monkeypatch.delenv("PRIME_SERVE_WARMUP", raising=False)
     monkeypatch.delenv("PRIME_SERVE_PREFIX_CACHE_MB", raising=False)
+    monkeypatch.delenv("PRIME_SERVE_PREFIX_CACHE_HOST_MB", raising=False)
 
 
 def reference_tokens(prompt_ids: list[int], n: int) -> list[int]:
@@ -354,7 +355,7 @@ def test_prefix_cache_partial_hit_and_block_dedup():
     rc = engine.submit(list(c), max_new_tokens=4)
     drain(engine, rc)
     assert engine.prefix_hits == 2
-    hit_hist = engine.registry.get("serve_prefix_hit_tokens").series_snapshot()
+    hit_hist = engine.registry.get("serve_prefix_hit_tokens").series_snapshot(tier="device")
     assert hit_hist["count"] == 2 and hit_hist["sum"] == 64.0  # 32 + 32
     for p, r in ((a, ra), (b, rb), (c, rc)):
         assert r.all_tokens(timeout=1) == reference_tokens(list(p), 4)
@@ -381,31 +382,78 @@ def test_prefix_cache_refcount_blocks_eviction():
 
 @pytest.mark.parametrize("overlap", [True, False], ids=["overlap", "sync"])
 def test_prefix_cache_bit_identity_on_off(overlap):
-    """Greedy outputs are bit-identical with the prefix cache enabled and
-    disabled, across the overlap and synchronous loops — the radix
-    cache/assemble path must be invisible in the emitted tokens. (CI runs
-    this matrix as the serve-engine smoke step.)"""
+    """Greedy outputs are bit-identical with the prefix cache disabled,
+    device-only, and two-tier under device-budget pressure (segments spill
+    to host RAM and hits re-upload), across the overlap and synchronous
+    loops — neither the radix cache/assemble path nor the spill tier may be
+    visible in the emitted tokens. (CI runs this matrix as the serve-engine
+    smoke step.)"""
     pre = [(i * 19) % 300 + 2 for i in range(32)]
+    alt = [(i * 23) % 300 + 2 for i in range(32)]  # disjoint preamble
     prompts = [
         pre + [7, 8, 9],
         pre + [100, 200],          # shares the full preamble with the first
         pre[:16] + [5, 5, 5, 5],   # shares only the first block
         [9, 8, 7],                 # no shared prefix at all
-        pre + [7, 8, 9],           # identical replay: full-length hit
+        alt + [1, 2],              # new preamble: under pressure, spills pre
+        pre + [7, 8, 9],           # identical replay: re-uploads from host
     ]
+    configs = {
+        "off": dict(prefix_cache_mb=0),
+        "device": dict(prefix_cache_mb=64),
+        "host": dict(prefix_cache_mb=64, prefix_cache_host_mb=64),
+    }
     outs = {}
-    for mb in (64, 0):
+    for name, kw in configs.items():
         engine = make_engine(capacity=128, prefill_chunk=32, min_prefix=16,
-                             prefix_cache_mb=mb, overlap=overlap)
+                             overlap=overlap, **kw)
         assert engine.overlap is overlap
-        outs[mb] = []
-        for p in prompts:
+        outs[name] = []
+        for i, p in enumerate(prompts):
             req = engine.submit(list(p), max_new_tokens=8)
             drain(engine, req)
-            outs[mb].append(req.all_tokens(timeout=1))
-        if mb:
+            outs[name].append(req.all_tokens(timeout=1))
+            if name == "host" and i == 0:
+                # squeeze the device budget to exactly the first stored
+                # prefix: the alt-preamble store must demote, the replay
+                # must re-upload (max(...,1): 0 would mean unbounded)
+                engine.prefix_cache.budget_bytes = max(engine.prefix_cache.bytes, 1)
+        if name != "off":
             assert engine.prefix_hits >= 3  # 2nd, 3rd, and replay prompts hit
-    assert outs[64] == outs[0]
+        if name == "host":
+            cache = engine.prefix_cache
+            assert cache.spills > 0, "device pressure never spilled"
+            assert cache.reuploads > 0, "replay never re-uploaded from host"
+            assert cache.evictions == 0  # spill tier absorbed the pressure
+            host_hist = engine.registry.get(
+                "serve_prefix_hit_tokens"
+            ).series_snapshot(tier="host")
+            assert host_hist is not None and host_hist["count"] >= 1
+    assert outs["device"] == outs["off"]
+    assert outs["host"] == outs["off"]
+
+
+def test_prefix_cache_host_env_wiring(monkeypatch):
+    """PRIME_SERVE_PREFIX_CACHE_HOST_MB and the kwarg both reach the cache as
+    a host byte budget with the engine's real tier converters installed; the
+    kwarg wins over the env, and the default is single-tier (0)."""
+    assert make_engine(prefix_cache_mb=1).prefix_cache.host_budget_bytes == 0
+    monkeypatch.setenv("PRIME_SERVE_PREFIX_CACHE_HOST_MB", "8")
+    cache = make_engine(prefix_cache_mb=1).prefix_cache
+    assert cache.host_budget_bytes == 8 * 2**20
+    from prime_tpu.serve.engine import _segment_to_device, _segment_to_host
+    assert cache._to_host is _segment_to_host
+    assert cache._to_device is _segment_to_device
+    kwarg = make_engine(prefix_cache_mb=1, prefix_cache_host_mb=2).prefix_cache
+    assert kwarg.host_budget_bytes == 2 * 2**20
+
+    class _FakeMesh:  # spill converters are not sharding-preserving yet
+        size = 8
+
+    with pytest.warns(UserWarning, match="host spill tier"):
+        gated = make_engine(prefix_cache_mb=1, prefix_cache_host_mb=2, mesh=_FakeMesh())
+    assert gated.prefix_cache.host_budget_bytes == 0
+    assert gated.prefix_cache_host_mb == 0.0
 
 
 def test_stats_snapshot_is_loop_ticked():

@@ -37,6 +37,10 @@ class FleetBackend:
     exactly where the router sent each request."""
 
     concurrent = True
+    # the scripted replica plays a cache-capable engine: without this the
+    # server suppresses /healthz prefix_digest (a cacheless replica must
+    # not attract cache-aware reroutes)
+    prefix_cache_enabled = True
 
     def __init__(self, name: str, delay: float = 0.0):
         self.name = name
@@ -152,6 +156,148 @@ def test_balancer_excludes_failed_replica():
     assert b.pick(PREAMBLE, exclude={r.id for r in m.replicas.values()}) is None
 
 
+def test_digest_block_pins_engine_and_balancer_alignment():
+    """The digest's block size and text proxy must equal the balancer's and
+    the engine's MIN_BUCKET: replica advertisement, router probe, and radix
+    tree all hash the same block boundaries or no chain ever matches."""
+    from prime_tpu.serve import digest
+    from prime_tpu.serve.engine import MIN_BUCKET
+
+    assert digest.MIN_BUCKET == balancer_mod.MIN_BUCKET == MIN_BUCKET
+    assert digest.CHARS_PER_TOKEN == balancer_mod.CHARS_PER_TOKEN
+
+
+def test_digest_hash_chain_prefix_stability():
+    from prime_tpu.serve.digest import longest_match_blocks, prefix_hashes
+
+    # suffixes long enough that both chains reach a block PAST the shared
+    # preamble — that block must diverge
+    a = prefix_hashes(PREAMBLE + "tail one " * 12)
+    b = prefix_hashes(PREAMBLE + "another ending " * 8)
+    shared = len(PREAMBLE) // 64  # full shared 64-char blocks
+    assert shared >= 2 and len(a) > shared and len(b) > shared
+    assert a[:shared] == b[:shared]
+    # deterministic; divergent suffix diverges the chain from there on
+    assert a == prefix_hashes(PREAMBLE + "tail one " * 12)
+    assert a[shared] != b[shared]
+    # ids and text hash into disjoint spaces: equal lengths never collide
+    ids = prefix_hashes(list(range(64)))
+    assert not set(ids) & set(prefix_hashes("x" * 64 * 4))
+    # under one block -> no chain
+    assert prefix_hashes("short") == [] and prefix_hashes([1, 2, 3]) == []
+    # the DEEPEST advertised entry wins, tolerating aged-out mid-chain gaps
+    assert longest_match_blocks(a, frozenset({a[0], a[2]})) == 3
+    assert longest_match_blocks(a, frozenset()) == 0
+
+
+def test_digest_lru_bound_and_snapshot_merge():
+    from prime_tpu.serve.digest import HotPrefixDigest, prefix_hashes
+
+    d = HotPrefixDigest(max_entries=4)
+    d.observe(PREAMBLE + "one")       # chain of >= 3 entries
+    d.observe("y" * 256)              # 4 more: the oldest age out
+    assert len(d) == 4
+    snap = d.snapshot(extra=[123, 456])
+    assert snap["version"] == 1 and snap["block"] == 16
+    # own text entries lead (the only space today's router can probe) and
+    # the id-space extras are truncated off a full advertisement
+    assert snap["hashes"] == d.hashes()[:4]
+    roomy = HotPrefixDigest(max_entries=8)
+    roomy.observe("y" * 256)  # 4 text entries: extras fit in the remainder
+    assert roomy.snapshot(extra=[123, 456])["hashes"][-2:] == [123, 456]
+    # a short prompt contributes nothing
+    d2 = HotPrefixDigest()
+    d2.observe("hi")
+    assert len(d2) == 0
+    assert prefix_hashes(PREAMBLE)[0] in HotPrefixDigest().snapshot(
+        extra=prefix_hashes(PREAMBLE)
+    )["hashes"]
+
+
+def test_membership_tolerates_pre_digest_and_malformed_healthz():
+    """Satellite: /healthz payloads from older replicas (no prefix_digest
+    field) or buggy ones (junk shapes, junk entries, oversized lists) must
+    parse to an empty/capped digest — never a KeyError, never a poll
+    failure."""
+    from prime_tpu.serve.digest import RETAIN_MAX_ENTRIES
+
+    m = FleetMembership(["http://127.0.0.1:1"])
+    replica = next(iter(m.replicas.values()))
+    # pre-digest schema: field absent entirely
+    m.apply_health(replica, {"state": "ready", "queue_depth": 2}, 200)
+    assert replica.digest == frozenset() and replica.state == "ready"
+    assert replica.queue_depth == 2
+    # junk shapes and junk entries degrade, never raise
+    for junk in ("nope", 7, ["h"], {"hashes": "nope"}, {"hashes": {"a": 1}}):
+        m.apply_health(replica, {"state": "ready", "prefix_digest": junk}, 200)
+        assert replica.digest == frozenset()
+    # junk load VALUES coerce to 0 instead of raising mid-update
+    m.apply_health(
+        replica,
+        {"state": "ready", "queue_depth": "busy", "active_slots": [1], "max_slots": None},
+        200,
+    )
+    assert (replica.queue_depth, replica.active_slots, replica.max_slots) == (0, 0, 0)
+    m.apply_health(
+        replica,
+        {"prefix_digest": {"hashes": [1, True, "x", 2.5, None, 2]}},
+        200,
+    )
+    assert replica.digest == frozenset({1, 2})
+    # oversized advertisement: retention capped per replica
+    m.apply_health(
+        replica,
+        {"prefix_digest": {"hashes": list(range(RETAIN_MAX_ENTRIES + 500))}},
+        200,
+    )
+    assert len(replica.digest) == RETAIN_MAX_ENTRIES
+    assert "digest_entries" in m.snapshot()[replica.id]
+
+
+def test_balancer_cache_aware_fallback_routes_to_longest_prefix():
+    """The tentpole routing upgrade: with the affinity target saturated, the
+    fallback diverts to the unsaturated replica advertising the LONGEST
+    cached prefix of this request — deterministically — and only falls back
+    to blind least-loaded when nobody advertises a match."""
+    from prime_tpu.serve.digest import prefix_hashes
+
+    urls = [f"http://127.0.0.1:{p}" for p in (1, 2, 3)]
+    m = FleetMembership(urls)
+    b = PrefixAffinityBalancer(m)
+    prompt = PREAMBLE + "the question"
+    target = b.pick(prompt).replica
+    others = [r for r in m.replicas.values() if r.id != target.id]
+    chain = prefix_hashes(prompt)
+    assert len(chain) >= 3
+    target.queue_depth = 5  # saturate the affinity target
+    # nobody advertises: blind least-loaded (not cache-routed)
+    pick = b.pick(prompt)
+    assert pick.rerouted and not pick.cache_routed
+    # shallow vs deep advertisement: the deeper one wins even when the
+    # shallow one is less loaded
+    others[0].digest = frozenset(chain[:1])
+    others[1].digest = frozenset(chain[:3])
+    others[1].active_slots = 3
+    for _ in range(3):  # deterministic across repeated picks
+        pick = b.pick(prompt)
+        assert pick.replica.id == others[1].id
+        assert pick.cache_routed and pick.rerouted and not pick.hit
+        assert pick.cached_blocks == 3
+    # a saturated advertiser is no candidate: divert to the shallow one
+    others[1].queue_depth = 9
+    pick = b.pick(prompt)
+    assert pick.replica.id == others[0].id and pick.cached_blocks == 1
+    # digests that match nothing -> blind least-loaded fallback
+    others[0].digest = frozenset({10, 11})
+    others[1].digest = frozenset({12})
+    pick = b.pick(prompt)
+    assert pick.rerouted and not pick.cache_routed
+    # target unsaturated again: affinity hit resumes, no probing
+    target.queue_depth = 0
+    pick = b.pick(prompt)
+    assert pick.hit and not pick.rerouted and not pick.cache_routed
+
+
 def test_router_side_drain_is_sticky_across_polls():
     """A drained replica must stay out of rotation even when the remote
     /admin/drain POST never landed and its /healthz keeps answering ready."""
@@ -221,6 +367,46 @@ def test_distinct_prefixes_spread_across_replicas():
             prefix = f"System prompt variant {i}: " + f"filler-{i} " * 12
             assert chat(router.url, prefix + "q").status_code == 200
         assert a.calls and b.calls  # consistent hashing spread the keys
+
+
+def test_cache_aware_reroute_e2e_over_healthz_digests():
+    """Tentpole e2e: both replicas have served (and therefore advertise) a
+    shared prefix; when the affinity target saturates, the router's next
+    request diverts to the OTHER replica because its polled /healthz digest
+    covers the prefix — visible as reroutes{reason="cache"} and
+    fleet_cache_routed_total, not a blind least-loaded divert."""
+    from prime_tpu.serve.server import render_chat_prompt
+
+    a, b = FleetBackend("replica-a"), FleetBackend("replica-b")
+    with make_fleet([a, b]) as (router, servers):
+        content = PREAMBLE + "what is the plan?"
+        # warm BOTH replicas directly (not via the router): each serves the
+        # prefix once and starts advertising its hash chain on /healthz
+        for srv in servers:
+            assert chat(srv.url, content).status_code == 200
+        router.membership.poll_all()
+        with router.membership._lock:
+            assert all(
+                r.digest for r in router.membership.replicas.values()
+            ), "healthz advertisement never reached the router"
+        # find and saturate the affinity target's backend
+        rendered = render_chat_prompt([{"role": "user", "content": content}])
+        target = router.balancer.pick(rendered).replica
+        target_backend = next(
+            be for be, srv in zip([a, b], servers) if srv.url == target.url
+        )
+        other_backend = a if target_backend is b else b
+        target_backend.queue_depth = 5
+        router.membership.poll_all()
+        reply = chat(router.url, content).json()["choices"][0]["message"]["content"]
+        assert reply == other_backend.name
+        stats = router.stats()
+        assert stats["cache_routed"] == 1
+        assert stats["reroutes"].get("cache") == 1
+        text = httpx.get(
+            f"{router.url}/metrics", params={"format": "prometheus"}
+        ).text
+        assert "fleet_cache_routed_total 1" in text
 
 
 def test_failover_mid_burst_loses_no_requests():

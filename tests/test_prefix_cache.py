@@ -135,3 +135,205 @@ def test_segment_nbytes_counts_every_leaf():
         "k_scale": np.zeros((2, 1, 16), dtype=np.int8),
     }
     assert segment_nbytes(seg) == 2 * 3 * 16 * 4 + 2 * 1 * 16
+
+
+# ---- host spill tier --------------------------------------------------------
+
+
+def tiered_cache(device_blocks: int, host_blocks: int) -> tuple[BlockPrefixCache, list]:
+    """A two-tier cache whose converters copy (like device_get / re-upload
+    do for real) and log every crossing, so tests can assert which segments
+    moved, that the roundtrip is byte-identical, and that the copies stay
+    tree-compatible (a host-resident edge can still be split/cut)."""
+    log: list[tuple[str, int]] = []
+
+    def to_host(seg):
+        log.append(("spill", segment_nbytes(seg)))
+        return {k: v.copy() for k, v in seg.items()}
+
+    def to_device(seg):
+        log.append(("upload", segment_nbytes(seg)))
+        return {k: v.copy() for k, v in seg.items()}
+
+    cache = BlockPrefixCache(
+        budget_bytes=device_blocks * 16 * SLOT_BYTES,
+        block=BLOCK,
+        host_budget_bytes=host_blocks * 16 * SLOT_BYTES,
+        to_host=to_host,
+        to_device=to_device,
+    )
+    return cache, log
+
+
+def test_device_pressure_spills_lru_to_host_instead_of_deleting():
+    cache, log = tiered_cache(device_blocks=2, host_blocks=8)
+    p1, p2, p3 = [[k] * 16 for k in (1, 2, 3)]
+    insert(cache, p1)
+    insert(cache, p2)
+    cache.release(cache.match(p1 + [9], limit=16))  # p2 is now LRU
+    insert(cache, p3)  # over device budget: p2 demotes, nothing is deleted
+    assert cache.spills == 1 and cache.evictions == 0
+    assert log == [("spill", 16 * SLOT_BYTES)]
+    assert cache.nodes == 3 and cache.host_nodes == 1
+    assert cache.bytes == 2 * 16 * SLOT_BYTES
+    assert cache.host_bytes == 16 * SLOT_BYTES
+    # the spilled prefix is still matchable — flagged host-resident
+    m = cache.match(p2 + [9], limit=16)
+    assert m is not None and m.length == 16 and m.host_tokens == 16
+    cache.release(m)
+
+
+def test_spill_reupload_roundtrip_preserves_bytes_and_refcounts():
+    cache, log = tiered_cache(device_blocks=2, host_blocks=8)
+    p1, p2, p3 = [[k] * 16 for k in (1, 2, 3)]
+    for p in (p1, p2, p3):
+        insert(cache, p)  # p1 demoted on the third insert
+    assert cache.spills == 1 and cache.host_nodes == 1
+    m = cache.match(p1 + [9], limit=16)
+    assert m is not None and m.host_tokens == 16 and m.device_tokens == 0
+    node = m.entries[0][0]
+    assert node.refs == 1
+    promoted, promoted_bytes = cache.promote(m)
+    assert (promoted, promoted_bytes) == (1, 16 * SLOT_BYTES)
+    assert cache.reuploads == 1 and cache.reupload_bytes == 16 * SLOT_BYTES
+    # headroom is made BEFORE the re-upload (spill precedes upload in the
+    # converter log), so the device tier never transiently overshoots its
+    # budget on the hot-prefix path
+    assert log[-2:] == [("spill", 16 * SLOT_BYTES), ("upload", 16 * SLOT_BYTES)]
+    # the roundtrip is byte-identical and the pin survived the promote —
+    # including the rebalance it triggered (device was full, so promoting
+    # p1 demoted the coldest UNPINNED segment, never the pinned path)
+    np.testing.assert_array_equal(m.segments()[0]["k"], make_row(p1))
+    assert node.refs == 1 and node.tier == "device"
+    assert cache.spills == 2  # p2 (now coldest) paid for p1's return
+    assert cache.bytes <= cache.budget_bytes
+    cache.release(m)
+    assert node.refs == 0
+    # accounting stayed conserved across the shuffle: 3 prefixes, 1 on host
+    assert cache.nodes == 3 and cache.host_nodes == 1
+    assert cache.bytes + cache.host_bytes == 3 * 16 * SLOT_BYTES
+
+
+def test_lru_order_and_byte_accounting_across_tiers():
+    cache, _ = tiered_cache(device_blocks=2, host_blocks=2)
+    prefixes = [[k] * 16 for k in (1, 2, 3, 4)]
+    for p in prefixes:
+        insert(cache, p)
+    # 4 inserts into 2+2 budgets: the two oldest (p1, p2) live on the host,
+    # the two newest (p3, p4) on the device; nothing deleted yet
+    assert cache.evictions == 0 and cache.spills == 2
+    assert cache.host_nodes == 2
+    assert cache.bytes == cache.host_bytes == 2 * 16 * SLOT_BYTES
+    insert(cache, [5] * 16)  # p3 spills; host over budget drops its LRU (p1)
+    assert cache.spills == 3 and cache.evictions == 1
+    assert cache.match_len(prefixes[0], limit=16) == 0  # p1 is gone
+    for p in prefixes[1:]:
+        assert cache.match_len(p, limit=16) == 16
+    assert cache.bytes <= cache.budget_bytes
+    assert cache.host_bytes <= cache.host_budget_bytes
+
+
+def test_host_budget_zero_keeps_single_tier_delete_behavior():
+    cache, log = tiered_cache(device_blocks=2, host_blocks=0)
+    for k in (1, 2, 3):
+        insert(cache, [k] * 16)
+    assert cache.spills == 0 and cache.evictions == 1 and log == []
+    assert cache.host_bytes == 0 and cache.host_nodes == 0
+
+
+def test_split_preserves_tier_and_host_accounting():
+    cache, _ = tiered_cache(device_blocks=1, host_blocks=8)
+    pre = list(range(32))
+    insert(cache, pre)  # 2 blocks > 1-block device budget: demoted whole
+    assert cache.host_nodes == 1 and cache.bytes == 0
+    # a sibling insert splits the host-resident edge: both halves stay on
+    # the host and host bytes are conserved (the new 1-block tail fills the
+    # device budget exactly and stays resident)
+    insert(cache, pre[:16] + [900 + i for i in range(16)])
+    assert cache.nodes == 3
+    assert cache.bytes + cache.host_bytes == 48 * SLOT_BYTES
+    m = cache.match(pre + [7], limit=32)
+    assert m is not None and m.length == 32 and m.host_tokens == 32
+    cache.promote(m)
+    got = np.concatenate(
+        [seg["k"][..., :take] for seg, take in zip(m.segments(), m.takes())], axis=-1
+    )
+    np.testing.assert_array_equal(got, make_row(pre))
+    cache.release(m)
+
+
+def test_split_of_host_node_copies_instead_of_viewing():
+    """Splitting a host-resident edge must materialize both halves: host
+    arrays (device_get numpy) slice to VIEWS, and a view would pin the whole
+    base buffer after the other half is evicted — the host byte budget would
+    stop bounding actual RSS."""
+    cache, _ = tiered_cache(device_blocks=1, host_blocks=8)
+    pre = list(range(32))
+    insert(cache, pre)  # demoted whole to host
+    node = next(iter(cache._root.children.values()))
+    base = node.segment["k"]
+    assert node.tier == "host"
+    insert(cache, pre[:16] + [900 + i for i in range(16)])  # splits the edge
+    upper = cache._root.children[tuple(pre[:BLOCK])]
+    lower = upper.children[tuple(pre[BLOCK : 2 * BLOCK])]
+    assert upper.tier == lower.tier == "host"
+    for half in (upper, lower):
+        assert not np.shares_memory(half.segment["k"], base)
+        assert half.segment["k"].base is None  # owns its buffer outright
+
+
+def test_host_budget_enforced_when_only_interiors_hold_host_bytes():
+    """insert() can plant a fresh DEVICE tail under a spilled (host) parent;
+    leaf eviction can never delete that parent, so without the subtree
+    fallback the host byte budget would be pinned open by HBM-resident
+    children — an unbounded RAM footprint behind a bounding knob."""
+    cache, _ = tiered_cache(device_blocks=8, host_blocks=1)
+    pre = list(range(32))
+    insert(cache, pre)
+    insert(cache, pre + [900 + i for i in range(16)])  # device tail child
+    parent = cache._root.children[tuple(pre[:BLOCK])]
+    assert parent.children and parent.tier == "device"
+    cache._spill(parent)  # as a past device-pressure demotion would
+    assert cache.host_bytes == 2 * 16 * SLOT_BYTES > cache.host_budget_bytes
+    evicted = cache.evict_to_budget()
+    # no host LEAF existed; the whole host-rooted subtree (device tail
+    # included) went, and both tiers' accounting drained with it
+    assert evicted == 2
+    assert cache.host_bytes <= cache.host_budget_bytes
+    assert cache.host_bytes == 0 and cache.host_nodes == 0
+    assert cache.bytes == 0 and cache.nodes == 0
+    assert cache.match(pre + [7], limit=16) is None
+    # a pinned path is never deleted, even by the subtree fallback
+    insert(cache, pre)
+    insert(cache, pre + [900 + i for i in range(16)])
+    parent = cache._root.children[tuple(pre[:BLOCK])]
+    cache._spill(parent)
+    m = cache.match(pre + [7], limit=16)  # pins the host-resident parent
+    assert m is not None and m.host_tokens == 16
+    assert cache.evict_to_budget() == 0  # over budget but pinned: skipped
+    assert cache.host_bytes > cache.host_budget_bytes
+    cache.release(m)
+    assert cache.evict_to_budget() == 2  # released: enforcement resumes
+
+
+def test_spill_seconds_accumulates_converter_time_only():
+    cache, _ = tiered_cache(device_blocks=1, host_blocks=8)
+    assert cache.spill_seconds == 0.0
+    insert(cache, [1] * 16)
+    insert(cache, [2] * 16)  # first insert's segment demotes
+    assert cache.spills == 1 and cache.spill_seconds >= 0.0
+
+
+def test_iter_prefixes_is_root_first_and_bounded():
+    cache, _ = tiered_cache(device_blocks=8, host_blocks=8)
+    pre = list(range(32))
+    a = pre + [500 + i for i in range(16)]
+    b = pre + [900 + i for i in range(16)]
+    insert(cache, a)
+    insert(cache, b)
+    paths = list(cache.iter_prefixes(limit=10))
+    # BFS: the shared preamble precedes both full paths; every path is a
+    # root-anchored token run
+    assert paths[0] == tuple(pre)
+    assert set(paths[1:]) == {tuple(a), tuple(b)}
+    assert list(cache.iter_prefixes(limit=1)) == [tuple(pre)]

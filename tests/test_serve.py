@@ -322,6 +322,10 @@ def test_healthz_states_gate_traffic():
         body = response.json()
         assert body["state"] == "ready"
         assert (body["queue_depth"], body["active_slots"], body["max_slots"]) == (3, 2, 8)
+        # a backend with no prefix cache must not advertise one: the field
+        # is absent so the fleet balancer never cache-routes toward a
+        # replica that would serve every "hit" with a full recompute
+        assert "prefix_digest" not in body
 
         # POST /admin/drain flips the state; in-flight finish, new work 503s
         drained = httpx.post(f"{srv.url}/admin/drain")
