@@ -1,0 +1,102 @@
+"""prime-lint: invariant checkers for the serving stack.
+
+Four AST-based checkers (stdlib-only, no third-party deps) enforce the
+contracts PRs 2-6 hardened by hand — see docs/analysis.md for the rule
+catalog and per-rule history:
+
+- ``lock-discipline`` (:mod:`.lock_discipline`) — attributes a class writes
+  under its own lock must never be touched off-lock;
+- ``jit-purity`` / ``jit-donation`` (:mod:`.jit_boundary`) — functions
+  handed to ``jax.jit`` stay host-state-free, and donated buffers are never
+  read after dispatch;
+- obs contract (:mod:`.obs_contract`) — metric and span names in code and
+  the docs/observability.md catalog agree bidirectionally;
+- knob registry (:mod:`.knob_registry`) — ``PRIME_*`` env reads go through
+  the core.config helpers, are documented in docs/architecture.md, and
+  agree with their paired CLI flag defaults.
+
+Run ``python -m prime_tpu.analysis`` (or ``scripts/prime_lint.py``) locally;
+CI runs ``--check`` as the ``analysis`` job. Accepted violations live in
+``prime_tpu/analysis/baseline.toml``, one justification per entry.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from prime_tpu.analysis import (
+    jit_boundary,
+    knob_registry,
+    lock_discipline,
+    obs_contract,
+)
+from prime_tpu.analysis.core import (
+    Finding,
+    Project,
+    Waiver,
+    apply_baseline,
+    load_baseline,
+)
+
+CHECKERS = {
+    "lock": lock_discipline.check,
+    "jit": jit_boundary.check,
+    "obs": obs_contract.check,
+    "knobs": knob_registry.check,
+}
+
+# every rule each checker can emit — `--rules` subsetting uses this to scope
+# stale-waiver detection to the checkers that actually ran (a waiver for an
+# unselected rule is dormant, not stale)
+RULES_BY_CHECKER = {
+    "lock": {"lock-discipline"},
+    "jit": {"jit-purity", "jit-donation"},
+    "obs": {
+        "obs-metric-undocumented",
+        "obs-metric-stale",
+        "obs-metric-kind-drift",
+        "obs-span-undocumented",
+        "obs-span-stale",
+        "obs-catalog-missing",
+    },
+    "knobs": {
+        "knob-direct-read",
+        "knob-undocumented",
+        "knob-stale-doc",
+        "knob-default-drift",
+        "knob-catalog-missing",
+    },
+}
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.toml"
+
+
+def run_checks(
+    project: Project, checkers: list[str] | None = None
+) -> list[Finding]:
+    """All findings (pre-baseline), parse errors included, stably ordered.
+    Inline ``# prime-lint: ignore[rule]`` pragmas are applied here, once,
+    for every checker — a finding whose flagged line carries a pragma for
+    its rule is dropped (doc-side findings have no source line to carry a
+    pragma and are baseline-only)."""
+    findings = list(project.parse_errors)
+    for name, checker in CHECKERS.items():
+        if checkers is None or name in checkers:
+            findings.extend(checker(project))
+    findings = [
+        f for f in findings if f.rule not in project.pragma_rules(f.path, f.line)
+    ]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.symbol))
+    return findings
+
+
+__all__ = [
+    "CHECKERS",
+    "DEFAULT_BASELINE",
+    "Finding",
+    "Project",
+    "Waiver",
+    "apply_baseline",
+    "load_baseline",
+    "run_checks",
+]

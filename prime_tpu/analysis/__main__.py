@@ -1,0 +1,140 @@
+"""CLI: ``python -m prime_tpu.analysis [--check] [...]``.
+
+Default mode prints every non-waived finding and exits 0 (exploration);
+``--check`` exits 1 on any non-waived finding OR any stale waiver — the CI
+contract: the tree is clean modulo a baseline that can only shrink.
+``--format github`` prints findings as workflow annotations so the CI job
+surfaces them inline on the PR diff.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from prime_tpu.analysis import (
+    CHECKERS,
+    DEFAULT_BASELINE,
+    RULES_BY_CHECKER,
+    Project,
+    apply_baseline,
+    load_baseline,
+    run_checks,
+)
+
+
+def _find_root(start: Path) -> Path:
+    for candidate in (start, *start.parents):
+        if (candidate / "prime_tpu").is_dir():
+            return candidate
+    return start
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m prime_tpu.analysis",
+        description="prime-lint: serving-stack invariant checkers "
+        "(lock discipline, jit boundaries, obs catalog, knob registry)",
+    )
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="exit non-zero on any non-waived finding or stale waiver (CI mode)",
+    )
+    parser.add_argument(
+        "--root",
+        default=None,
+        help="repo root (default: auto-detect the directory holding prime_tpu/)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help=f"waiver file (default: {DEFAULT_BASELINE.name} next to the package)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true", help="ignore the waiver file"
+    )
+    parser.add_argument(
+        "--rules",
+        default=None,
+        help=f"comma-separated checker subset from: {', '.join(CHECKERS)}",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "github"),
+        default="text",
+        help="'github' prints ::error workflow annotations",
+    )
+    args = parser.parse_args(argv)
+
+    root = Path(args.root) if args.root else _find_root(Path.cwd().resolve())
+    if not (root / "prime_tpu").is_dir():
+        print(f"error: no prime_tpu/ package under {root}", file=sys.stderr)
+        return 2
+    checkers = None
+    if args.rules:
+        checkers = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in checkers if r not in CHECKERS]
+        if unknown:
+            print(
+                f"error: unknown checker(s) {unknown}; valid: {sorted(CHECKERS)}",
+                file=sys.stderr,
+            )
+            return 2
+
+    project = Project.from_root(root)
+    findings = run_checks(project, checkers)
+
+    waivers = []
+    if not args.no_baseline:
+        baseline_path = Path(args.baseline) if args.baseline else DEFAULT_BASELINE
+        if baseline_path.exists():
+            try:
+                waivers = load_baseline(baseline_path)
+            except ValueError as e:
+                print(f"error: bad baseline: {e}", file=sys.stderr)
+                return 2
+    if checkers is not None:
+        # a --rules subset leaves the other checkers' waivers dormant, not
+        # stale: only waivers whose rule a selected checker can emit take
+        # part in matching (and in stale detection)
+        selected_rules = set().union(*(RULES_BY_CHECKER[c] for c in checkers))
+        waivers = [w for w in waivers if w.rule in selected_rules]
+    active, waived, stale = apply_baseline(findings, waivers)
+
+    for finding in active:
+        if args.format == "github":
+            print(
+                f"::error file={finding.path},line={finding.line},"
+                f"title=prime-lint[{finding.rule}]::{finding.message}"
+            )
+        else:
+            print(finding.render())
+    for waiver in stale:
+        msg = (
+            f"stale waiver: ({waiver.rule}, {waiver.path}, {waiver.symbol}) "
+            f"matched nothing — the violation it excused is gone; delete it "
+            f"(reason was: {waiver.reason})"
+        )
+        if args.format == "github":
+            print(
+                "::error file=prime_tpu/analysis/baseline.toml,"
+                f"title=prime-lint[stale-waiver]::{msg}"
+            )
+        else:
+            print(msg)
+
+    n_files = len(project.files)
+    print(
+        f"prime-lint: {n_files} files, {len(active)} finding(s), "
+        f"{len(waived)} waived, {len(stale)} stale waiver(s)",
+        file=sys.stderr,
+    )
+    if args.check and (active or stale):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,384 @@
+"""Checker 2 — jit-boundary purity & donation safety.
+
+Rule ``jit-purity``: a function handed to ``jax.jit`` is traced once and
+replayed as a device program — host effects inside it either run at trace
+time only (silently wrong: a ``time.monotonic()`` freezes to a constant, a
+metrics ``.inc()`` fires once per compile, not per step) or break tracing
+outright (lock acquisition under ``jax.checkpoint`` re-trace). The checker
+finds functions that are jitted — by ``jax.jit(fn, ...)`` call, ``@jax.jit``
+/ ``@partial(jax.jit, ...)`` decoration — and flags host-state touches in
+their bodies: ``time.*``, ``os.environ``/``os.getenv``, ``threading.*``,
+host ``random.*``, ``print``/``open``/``input``, lock use
+(``with self.<lock>`` / ``.acquire()``), and obs-layer calls (``TRACER``,
+``REGISTRY``, ``self.registry``, ``self._m_*`` metric handles) — metrics
+record *around* dispatches, never inside them (obs/metrics.py registry
+contract).
+
+Rule ``jit-donation``: an argument listed in ``donate_argnums`` is dead the
+moment the jitted call dispatches — XLA may alias its buffer for the output.
+Reading it afterwards returns poisoned memory on TPU (and works by accident
+on CPU, which is why reviews kept catching it late: PR 2's error-path
+``_fail_in_flight`` ordering was exactly this bug). The checker resolves
+donation positions through the repo's builder idiom —
+
+    def _make_decode(self):
+        def decode(params, cache, last): ...
+        return jax.jit(decode, donate_argnums=(1, 2))
+    ...
+    self._decode_fn = self._make_decode()
+
+— so a call ``self._decode_fn(p, cache, last)`` taints ``cache``/``last``
+(plain names or ``self.x`` attributes), and any later read of a tainted
+value in the same caller body, without an intervening rebind, is a finding.
+The same tracking covers locally-jitted functions
+(``f = jax.jit(g, donate_argnums=...)``) and decorated ones. Statement
+order is source order — good enough for the straight-line dispatch code
+this rule exists for; loop-carried resurrection is out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from prime_tpu.analysis.core import (
+    Finding,
+    Project,
+    SourceFile,
+    attr_root,
+    call_name,
+    self_attr,
+)
+
+PURITY_RULE = "jit-purity"
+DONATION_RULE = "jit-donation"
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+# dotted-prefix denylist for host state inside a traced function
+_IMPURE_PREFIXES = (
+    "time.",
+    "threading.",
+    "random.",
+    "os.environ",
+    "os.getenv",
+    "os.putenv",
+)
+_IMPURE_CALLS = {"print", "open", "input"}
+_OBS_NAMES = {"TRACER", "REGISTRY"}
+
+
+def _donate_positions(call: ast.Call) -> tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                        out.append(elt.value)
+                return tuple(out)
+    return ()
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    return call_name(call.func) in _JIT_NAMES
+
+
+# -- purity -------------------------------------------------------------------
+
+
+def _purity_offender(node: ast.AST) -> str | None:
+    """A host-state touch at this node, or None."""
+    if isinstance(node, ast.Call):
+        name = call_name(node.func)
+        if name is not None:
+            if name in _IMPURE_CALLS:
+                return name
+            for prefix in _IMPURE_PREFIXES:
+                if name == prefix.rstrip(".") or name.startswith(prefix):
+                    return name
+            root = name.split(".", 1)[0]
+            if root in _OBS_NAMES:
+                return name
+        if isinstance(node.func, ast.Attribute) and node.func.attr in (
+            "acquire",
+            "release",
+        ):
+            return f"{call_name(node.func) or node.func.attr}()"
+    if isinstance(node, ast.Attribute):
+        dotted = call_name(node)
+        if dotted in ("os.environ",):
+            return dotted
+        attr = self_attr(node)
+        if attr is not None and (attr.startswith("_m_") or attr == "registry"):
+            return f"self.{attr}"
+    if isinstance(node, ast.With):
+        for item in node.items:
+            attr = attr_root(item.context_expr)
+            if attr is not None and "lock" in attr.lower():
+                return f"with self.{attr}"
+    return None
+
+
+def _check_purity(src: SourceFile, fn: ast.FunctionDef, jit_site: int) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[str] = set()
+    for node in ast.walk(fn):
+        offender = _purity_offender(node)
+        if offender is None or offender in seen:
+            continue
+        # ast.walk yields a Call before its func chain: `os.environ.get(...)`
+        # already reported covers the bare `os.environ` attribute inside it
+        if any(prior.startswith(offender + ".") for prior in seen):
+            continue
+        seen.add(offender)
+        line = getattr(node, "lineno", fn.lineno)
+        findings.append(
+            Finding(
+                PURITY_RULE,
+                src.path,
+                line,
+                f"{fn.name}:{offender}",
+                f"`{fn.name}` is jitted (line {jit_site}) but touches host "
+                f"state: {offender} — effects inside a traced function run "
+                "at trace time, not per call",
+            )
+        )
+    return findings
+
+
+# -- collection of jitted functions and donation maps -------------------------
+
+
+class _FileJitIndex:
+    """Per-file: which local FunctionDefs are jitted, which class methods
+    build donating jitted callables, which self attrs hold them."""
+
+    def __init__(self) -> None:
+        self.jitted: list[tuple[ast.FunctionDef, int, tuple[int, ...]]] = []
+        # ClassName -> builder method name -> donate positions
+        self.builders: dict[str, dict[str, tuple[int, ...]]] = {}
+        # ClassName -> self attr name -> donate positions
+        self.attr_fns: dict[str, dict[str, tuple[int, ...]]] = {}
+        # plain local names bound to donating jitted callables:
+        # (scope id) -> name -> positions — handled inline per function
+
+
+def _local_defs(scope: ast.AST) -> dict[str, ast.FunctionDef]:
+    out: dict[str, ast.FunctionDef] = {}
+    body = getattr(scope, "body", [])
+    for stmt in body:
+        if isinstance(stmt, ast.FunctionDef):
+            out[stmt.name] = stmt
+    return out
+
+
+def _index_file(src: SourceFile) -> _FileJitIndex:
+    index = _FileJitIndex()
+
+    # decorated functions
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for dec in node.decorator_list:
+            donate: tuple[int, ...] = ()
+            is_jit = False
+            if call_name(dec) in _JIT_NAMES:
+                is_jit = True
+            elif isinstance(dec, ast.Call):
+                dec_name = call_name(dec.func)
+                if dec_name in _JIT_NAMES:
+                    is_jit = True
+                    donate = _donate_positions(dec)
+                elif dec_name in _PARTIAL_NAMES and dec.args:
+                    if call_name(dec.args[0]) in _JIT_NAMES:
+                        is_jit = True
+                        donate = _donate_positions(dec)
+            if is_jit:
+                index.jitted.append((node, node.lineno, donate))
+
+    # jax.jit(fn, ...) call sites whose first arg resolves to a local def in
+    # the enclosing scope (module, function, or method body)
+    def scan_scope(scope: ast.AST, class_name: str | None) -> None:
+        defs = _local_defs(scope)
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Call) and _is_jit_call(node) and node.args:
+                target = node.args[0]
+                if isinstance(target, ast.Name) and target.id in defs:
+                    index.jitted.append(
+                        (defs[target.id], node.lineno, _donate_positions(node))
+                    )
+
+    scan_scope(src.tree, None)
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_scope(node, None)
+
+    # builder methods: `return jax.jit(fn, donate_argnums=...)` inside a
+    # method -> the method's name maps to those donation positions
+    for cls in ast.walk(src.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        builders: dict[str, tuple[int, ...]] = {}
+        for fn in cls.body:
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Return)
+                    and isinstance(node.value, ast.Call)
+                    and _is_jit_call(node.value)
+                ):
+                    donate = _donate_positions(node.value)
+                    if donate:
+                        builders[fn.name] = donate
+        if not builders:
+            continue
+        index.builders[cls.name] = builders
+        # self.X = self._make_decode()  ->  attr X carries the donation map
+        attr_fns: dict[str, tuple[int, ...]] = {}
+        for node in ast.walk(cls):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and not node.value.args
+            ):
+                method = self_attr(node.value.func)
+                if method in builders:
+                    for target in node.targets:
+                        attr = self_attr(target)
+                        if attr is not None:
+                            attr_fns[attr] = builders[method]
+        index.attr_fns[cls.name] = attr_fns
+    return index
+
+
+# -- donation: use-after-donate in callers ------------------------------------
+
+
+def _expr_key(node: ast.expr) -> tuple[str, str] | None:
+    """Taintable argument forms: a plain name or an exact ``self.x``."""
+    if isinstance(node, ast.Name):
+        return ("name", node.id)
+    attr = self_attr(node)
+    if attr is not None:
+        return ("attr", attr)
+    return None
+
+
+def _check_donation_in_fn(
+    src: SourceFile,
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+    attr_fns: dict[str, tuple[int, ...]],
+) -> list[Finding]:
+    """Scan one caller body: jitted-call sites taint their donated args;
+    any later read of a tainted name/attr without a rebind is a finding."""
+    findings: list[Finding] = []
+
+    # local `f = jax.jit(g, donate_argnums=...)` bindings inside this fn
+    local_fns: dict[str, tuple[int, ...]] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _is_jit_call(node.value):
+                donate = _donate_positions(node.value)
+                if donate:
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            local_fns[target.id] = donate
+
+    # donating call sites in this fn
+    calls: list[tuple[ast.Call, tuple[int, ...], str]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        callee_attr = self_attr(node.func)
+        if callee_attr is not None and callee_attr in attr_fns:
+            calls.append((node, attr_fns[callee_attr], f"self.{callee_attr}"))
+        elif isinstance(node.func, ast.Name) and node.func.id in local_fns:
+            calls.append((node, local_fns[node.func.id], node.func.id))
+
+    if not calls:
+        return findings
+
+    # loads/stores of names and self attrs across the fn, with line numbers
+    loads: list[tuple[tuple[str, str], int]] = []
+    stores: list[tuple[tuple[str, str], int]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            key = ("name", node.id)
+            if isinstance(node.ctx, ast.Load):
+                loads.append((key, node.lineno))
+            else:
+                stores.append((key, node.lineno))
+        elif isinstance(node, ast.Attribute):
+            attr = self_attr(node)
+            if attr is None:
+                continue
+            key = ("attr", attr)
+            if isinstance(node.ctx, ast.Load):
+                loads.append((key, node.lineno))
+            else:
+                stores.append((key, node.lineno))
+
+    loads.sort(key=lambda pair: pair[1])
+    for call, positions, callee in calls:
+        call_end = getattr(call, "end_lineno", call.lineno)
+        for pos in positions:
+            if pos >= len(call.args):
+                continue
+            key = _expr_key(call.args[pos])
+            if key is None:
+                continue
+            # rebinding at/after the call (e.g. `x = f(x)`) clears the taint
+            # from that line on
+            clear_lines = sorted(
+                line for k, line in stores if k == key and line >= call.lineno
+            )
+            for load_key, line in loads:
+                if load_key != key or line <= call_end:
+                    continue
+                if any(s <= line for s in clear_lines):
+                    break  # rebound before (or at) this read
+                label = key[1] if key[0] == "name" else f"self.{key[1]}"
+                findings.append(
+                    Finding(
+                        DONATION_RULE,
+                        src.path,
+                        line,
+                        f"{fn.name}:{label}",
+                        f"`{label}` is donated to `{callee}` (donate_argnums "
+                        f"position {pos}, call at line {call.lineno}) but read "
+                        "afterwards — a donated buffer may be aliased by the "
+                        "output and is invalid after dispatch",
+                    )
+                )
+                break  # one finding per donated arg per call
+    return findings
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in project.files:
+        index = _index_file(src)
+        seen_fns: set[int] = set()
+        for fn, jit_line, _donate in index.jitted:
+            if id(fn) in seen_fns:
+                continue
+            seen_fns.add(id(fn))
+            findings.extend(_check_purity(src, fn, jit_line))
+        # donation checking inside every class that holds donating callables
+        for cls in ast.walk(src.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            attr_fns = index.attr_fns.get(cls.name, {})
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    findings.extend(_check_donation_in_fn(src, fn, attr_fns))
+        # module-level / free functions: local jit bindings only
+        for fn in src.tree.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(_check_donation_in_fn(src, fn, {}))
+    return findings

@@ -1,0 +1,353 @@
+"""Checker 4 — the ``PRIME_*`` environment-knob registry.
+
+A knob that three modules read three different ways, with three different
+defaults and no documentation, is how PR 6's review found
+``PRIME_SERVE_PREFIX_CACHE_HOST_MB`` wired but undescribed and this PR found
+``PRIME_TPU_FLASH_DECODE_MIN_C`` / ``PRIME_TPU_PALLAS_INTERPRET`` /
+``PRIME_NUM_WORKERS`` undocumented entirely. Four rules pin the contract to
+the "Environment knobs" table in docs/architecture.md:
+
+- ``knob-direct-read`` — a ``PRIME_*`` name read straight off
+  ``os.environ`` / ``os.getenv`` anywhere outside ``core/config.py``: all
+  reads go through the ``env_str``/``env_flag``/``env_int``/``env_float``
+  helpers (uniform unset/junk semantics, one grep-able surface). Writes
+  (exporting env for a child process) are fine.
+- ``knob-undocumented`` — a knob read in code with no row in the table.
+- ``knob-stale-doc`` — a table row naming a knob (or a paired CLI flag) the
+  code never mentions.
+- ``knob-default-drift`` — the helper-call default (literals and
+  module-level constants are resolved) disagrees with the table's default
+  column; likewise a paired CLI flag whose ``click.option`` declares a
+  literal non-None default that disagrees (the None-default "defer to env"
+  idiom is skipped on purpose — that pairing cannot drift).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from prime_tpu.analysis.core import Finding, Project, call_name, const_str
+
+DOC_PATH = "docs/architecture.md"
+HELPER_FILE = "prime_tpu/core/config.py"
+HELPERS = {"env_str", "env_flag", "env_int", "env_float"}
+
+_KNOB_RE = re.compile(r"^PRIME_[A-Z0-9_]+$")
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+
+_TRUE_WORDS = {"1", "true", "on", "yes"}
+_FALSE_WORDS = {"0", "false", "off", "no"}
+_UNSET_WORDS = {"", "unset", "-", "—", "none"}
+
+
+class _KnobUse:
+    def __init__(
+        self, name: str, path: str, line: int, direct: bool, default: object
+    ) -> None:
+        self.name = name
+        self.path = path
+        self.line = line
+        self.direct = direct
+        self.default = default  # resolved literal, or _UNRESOLVED
+
+
+_UNRESOLVED = object()
+
+
+def _module_constants(tree: ast.Module) -> dict[str, object]:
+    """Module-level ``NAME = <literal>`` bindings, for resolving helper
+    defaults like ``env_float("...", DEFAULT_PREFIX_CACHE_MB)``."""
+    out: dict[str, object] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Constant):
+            for target in stmt.targets:
+                if isinstance(target, ast.Name):
+                    out[target.id] = stmt.value.value
+    return out
+
+
+def _resolve_default(node: ast.expr | None, constants: dict[str, object]) -> object:
+    if node is None:
+        return _UNRESOLVED
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name) and node.id in constants:
+        return constants[node.id]
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _resolve_default(node.operand, constants)
+        if isinstance(inner, (int, float)):
+            return -inner
+    return _UNRESOLVED
+
+
+def _collect_uses(project: Project) -> tuple[list[_KnobUse], set[str]]:
+    """Knob read sites (helper + direct) and the set of every PRIME_* string
+    literal appearing anywhere — env *writes* and registry dicts count as
+    code sites for staleness, just not as reads."""
+    uses: list[_KnobUse] = []
+    mentioned: set[str] = set()
+    for src in project.files:
+        constants = _module_constants(src.tree)
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and _KNOB_RE.match(node.value)
+            ):
+                mentioned.add(node.value)
+            if not isinstance(node, (ast.Call, ast.Subscript)):
+                continue
+            # helper reads: env_str("PRIME_X", default) (bare or dotted)
+            if isinstance(node, ast.Call):
+                fn = call_name(node.func)
+                base = fn.rsplit(".", 1)[-1] if fn else None
+                if base in HELPERS and node.args:
+                    name = const_str(node.args[0])
+                    if name and _KNOB_RE.match(name):
+                        default_node = node.args[1] if len(node.args) > 1 else None
+                        if default_node is None:
+                            for kw in node.keywords:
+                                if kw.arg == "default":
+                                    default_node = kw.value
+                        uses.append(
+                            _KnobUse(
+                                name,
+                                src.path,
+                                node.lineno,
+                                direct=False,
+                                default=_resolve_default(default_node, constants),
+                            )
+                        )
+                        continue
+                # direct reads: os.environ.get / os.getenv
+                if fn in ("os.environ.get", "os.getenv", "environ.get") and node.args:
+                    name = const_str(node.args[0])
+                    if name and _KNOB_RE.match(name):
+                        uses.append(
+                            _KnobUse(name, src.path, node.lineno, True, _UNRESOLVED)
+                        )
+            else:  # Subscript: os.environ["PRIME_X"] loads only
+                if (
+                    isinstance(node.ctx, ast.Load)
+                    and call_name(node.value) in ("os.environ", "environ")
+                ):
+                    name = const_str(node.slice)
+                    if name and _KNOB_RE.match(name):
+                        uses.append(
+                            _KnobUse(name, src.path, node.lineno, True, _UNRESOLVED)
+                        )
+    return uses, mentioned
+
+
+# -- doc side -----------------------------------------------------------------
+
+
+class _DocKnob:
+    def __init__(self, name: str, flag: str | None, default: str, line: int) -> None:
+        self.name = name
+        self.flag = flag
+        self.default = default
+        self.line = line
+
+
+def _doc_knob_rows(doc_text: str) -> list[_DocKnob]:
+    """Rows of every architecture.md table with an ``env`` header column
+    (the consolidated knobs table; the per-subsystem mini-tables keep their
+    own shape and are ignored unless they adopt the header)."""
+    from prime_tpu.analysis.obs_contract import _parse_tables
+
+    out: list[_DocKnob] = []
+    for table in _parse_tables(doc_text):
+        headers = table["headers"]
+        if "env" not in headers or "default" not in headers:
+            continue
+        env_col = headers.index("env")
+        default_col = headers.index("default")
+        flag_col = headers.index("cli flag") if "cli flag" in headers else None
+        for line, cells in table["rows"]:
+            if len(cells) <= max(env_col, default_col):
+                continue
+            names = [
+                t for t in _BACKTICK_RE.findall(cells[env_col]) if _KNOB_RE.match(t)
+            ]
+            if not names:
+                continue
+            flag = None
+            if flag_col is not None and len(cells) > flag_col:
+                flags = [
+                    t
+                    for t in _BACKTICK_RE.findall(cells[flag_col])
+                    if t.startswith("--")
+                ]
+                flag = flags[0] if flags else None
+            default = cells[default_col].strip().strip("`")
+            # "256 (MiB)" -> "256"; "0 = off" -> "0"
+            default = re.split(r"[(=]", default)[0].strip().strip("`")
+            for name in names:
+                out.append(_DocKnob(name, flag, default, line))
+    return out
+
+
+def _defaults_agree(code_default: object, doc_default: str) -> bool:
+    doc = doc_default.strip().lower()
+    if code_default is _UNRESOLVED:
+        return True  # can't resolve -> can't drift-check; not a finding
+    if isinstance(code_default, bool):
+        return doc in (_TRUE_WORDS if code_default else _FALSE_WORDS | _UNSET_WORDS)
+    if isinstance(code_default, (int, float)):
+        try:
+            return float(doc) == float(code_default)
+        except ValueError:
+            return False
+    if code_default is None:
+        return doc in _UNSET_WORDS
+    if isinstance(code_default, str):
+        if code_default == "":
+            return doc in _UNSET_WORDS
+        return doc == code_default.lower()
+    return True
+
+
+def _cli_option_sites(project: Project) -> list[tuple[str, object, str, int]]:
+    """(flag-string, literal default or _UNRESOLVED, path, line) for every
+    ``click.option``/``option`` decorator call with a leading ``--flag``."""
+    out = []
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = call_name(node.func)
+            if fn not in ("click.option", "option", "click.argument"):
+                continue
+            flags = [
+                s
+                for s in (const_str(a) for a in node.args)
+                if s is not None and s.startswith("--")
+            ]
+            if not flags:
+                continue
+            default: object = _UNRESOLVED
+            for kw in node.keywords:
+                if kw.arg == "default" and isinstance(kw.value, ast.Constant):
+                    default = kw.value.value
+            for flag in flags:
+                out.append((flag, default, src.path, node.lineno))
+    return out
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    uses, mentioned = _collect_uses(project)
+
+    for use in uses:
+        if use.direct and use.path != HELPER_FILE:
+            findings.append(
+                Finding(
+                    "knob-direct-read",
+                    use.path,
+                    use.line,
+                    use.name,
+                    f"{use.name} is read directly from os.environ — route it "
+                    "through prime_tpu.core.config env_str/env_flag/env_int/"
+                    "env_float",
+                )
+            )
+
+    doc = project.doc(DOC_PATH)
+    if doc is None:
+        findings.append(
+            Finding(
+                "knob-catalog-missing",
+                DOC_PATH,
+                1,
+                DOC_PATH,
+                "docs/architecture.md not found — no knobs table to check "
+                "against",
+            )
+        )
+        return findings
+    rows = _doc_knob_rows(doc)
+    documented = {row.name for row in rows}
+    row_by_name = {row.name: row for row in rows}
+
+    seen_undoc: set[str] = set()
+    for use in uses:
+        if use.name not in documented and use.name not in seen_undoc:
+            seen_undoc.add(use.name)
+            findings.append(
+                Finding(
+                    "knob-undocumented",
+                    use.path,
+                    use.line,
+                    use.name,
+                    f"{use.name} is read here but has no row in the "
+                    f"{DOC_PATH} Environment knobs table",
+                )
+            )
+
+    cli_sites = _cli_option_sites(project)
+    for row in rows:
+        if row.name not in mentioned:
+            findings.append(
+                Finding(
+                    "knob-stale-doc",
+                    DOC_PATH,
+                    row.line,
+                    row.name,
+                    f"knobs table documents {row.name} but nothing in "
+                    "prime_tpu mentions it",
+                )
+            )
+            continue
+        # default drift vs every resolvable read site
+        for use in uses:
+            if use.name != row.name or use.default is _UNRESOLVED:
+                continue
+            if not _defaults_agree(use.default, row.default):
+                findings.append(
+                    Finding(
+                        "knob-default-drift",
+                        use.path,
+                        use.line,
+                        row.name,
+                        f"{row.name} default in code is {use.default!r} but "
+                        f"the knobs table says `{row.default}`",
+                    )
+                )
+        # paired CLI flag: must exist, and a literal non-None default must
+        # agree with the documented default
+        if row.flag:
+            matches = [
+                (flag, default, path, line)
+                for flag, default, path, line in cli_sites
+                if row.flag == flag or flag.startswith(row.flag + "/")
+            ]
+            if not matches:
+                findings.append(
+                    Finding(
+                        "knob-stale-doc",
+                        DOC_PATH,
+                        row.line,
+                        row.name,
+                        f"knobs table pairs {row.name} with `{row.flag}` but "
+                        "no click.option declares that flag",
+                    )
+                )
+            else:
+                for _flag, default, path, line in matches:
+                    if default is _UNRESOLVED or default is None:
+                        continue  # None = "defer to env", cannot drift
+                    if not _defaults_agree(default, row.default):
+                        findings.append(
+                            Finding(
+                                "knob-default-drift",
+                                path,
+                                line,
+                                row.name,
+                                f"`{row.flag}` default {default!r} disagrees "
+                                f"with the documented {row.name} default "
+                                f"`{row.default}`",
+                            )
+                        )
+    return findings

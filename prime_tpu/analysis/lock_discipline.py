@@ -1,0 +1,332 @@
+"""Checker 1 — lock discipline (rule ``lock-discipline``).
+
+For every class that owns a ``threading.Lock``/``RLock``/``Condition``, the
+set of attributes the class itself treats as lock-guarded is *inferred*: any
+``self.X`` that is written (assigned, augmented, subscript-stored, deleted,
+or mutated through a known container-mutator method) inside a
+``with self.<lock>:`` block, in any method. Every other access of those
+attributes — read or write — from a method of the same class that is not
+under the lock is a finding: the engine stats-snapshot lock, the membership
+shared-client, the flight-recorder rings, and the prefix-cache refcount
+hardening of PRs 3-6 were all hand-caught instances of exactly this drift.
+
+The ``outer = self`` closure idiom is understood: the serve server binds
+``outer = self`` and hands ``outer`` to a nested handler class whose methods
+run on HTTP threads — ``with outer._lock:`` acquires the same lock and
+``outer.attr`` accesses the same state, so those nested bodies are analyzed
+as the owning class's code (deferred: ``__init__``'s straight-line
+constructor statements stay exempt, but functions *defined* inside it run
+later, on other threads, and are checked).
+
+What the inference deliberately skips:
+
+- ``__init__``'s own statements (construction precedes sharing);
+- attributes holding intrinsically thread-safe objects (``queue.Queue``
+  family, ``threading.Event``/``Semaphore``, ``collections.deque`` — their
+  single-call operations are atomic), detected from their ``__init__``
+  assignment;
+- methods whose docstring declares the caller-holds-the-lock contract
+  (``"lock held"`` / ``"caller holds the lock"`` …): their bodies count as
+  under the lock for both inference and checking, so the repo's existing
+  ``_finish``/``_write_sink`` helper idiom is recognized, and the contract
+  doc-comment becomes machine-read instead of reviewer-read;
+- code inside nested ``def``/``lambda`` under a ``with`` block (it runs
+  later, when the lock is NOT held — textual nesting is not temporal
+  nesting).
+
+Intentionally lock-free sites (single-writer flags, GIL-atomic reads on hot
+paths) belong in ``baseline.toml`` with a one-line justification, or behind
+an inline ``# prime-lint: ignore[lock-discipline] why`` pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from prime_tpu.analysis.core import Finding, Project, SourceFile, call_name
+
+RULE = "lock-discipline"
+
+LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "Lock",
+    "RLock",
+    "Condition",
+}
+THREADSAFE_FACTORIES = {
+    "queue.Queue",
+    "queue.LifoQueue",
+    "queue.PriorityQueue",
+    "queue.SimpleQueue",
+    "Queue",
+    "SimpleQueue",
+    "threading.Event",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+    "threading.Barrier",
+    "Event",
+    "Semaphore",
+    "BoundedSemaphore",
+    "Barrier",
+    "collections.deque",
+    "deque",
+}
+# container methods that mutate their receiver — ``self.x.append(...)``
+# under the lock marks ``x`` guarded just like ``self.x = ...`` does
+MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "move_to_end", "rotate", "sort", "reverse",
+    "put", "put_nowait",
+}
+_LOCKISH_NAME = re.compile(r"lock|mutex|cond", re.IGNORECASE)
+_HELD_DOC = re.compile(
+    r"lock (?:is )?held|caller holds? the (?:\w+ )?lock|holding the (?:\w+ )?lock|"
+    r"called with the (?:\w+ )?lock",
+    re.IGNORECASE,
+)
+
+
+def _root(node: ast.AST, selves: set[str]) -> str | None:
+    """Attribute name an access roots at, when the receiver is ``self`` or
+    a known alias of it: ``self.x[k].y`` / ``outer.x`` -> ``"x"``."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in selves
+        ):
+            return node.attr
+        node = node.value
+    return None
+
+
+def _direct_attr(node: ast.AST, selves: set[str]) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id in selves
+    ):
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef) -> None:
+        self.node = node
+        self.selves: set[str] = {"self"}
+        self.lock_attrs: set[str] = set()
+        self.threadsafe_attrs: set[str] = set()
+
+
+def _collect_aliases(info: _ClassInfo) -> None:
+    """Names bound as plain aliases of ``self`` (``outer = self``)."""
+    for node in ast.walk(info.node):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    info.selves.add(target.id)
+
+
+def _classify_attrs(info: _ClassInfo) -> None:
+    """Which attrs hold locks, which hold intrinsically thread-safe
+    containers (from their constructor-call assignments anywhere in the
+    class), plus lock-ish names acquired via ``with``."""
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            factory = call_name(node.value.func)
+            if factory is None:
+                continue
+            for target in node.targets:
+                attr = _root(target, info.selves)
+                if attr is None:
+                    continue
+                if factory in LOCK_FACTORIES:
+                    info.lock_attrs.add(attr)
+                elif factory in THREADSAFE_FACTORIES:
+                    info.threadsafe_attrs.add(attr)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                attr = _root(item.context_expr, info.selves)
+                if attr is not None and _LOCKISH_NAME.search(attr):
+                    info.lock_attrs.add(attr)
+
+
+def _acquires(stmt: ast.With, lock_attrs: set[str], selves: set[str]) -> bool:
+    for item in stmt.items:
+        if isinstance(item.context_expr, ast.Attribute):
+            attr = _root(item.context_expr, selves)
+            if attr is not None and attr in lock_attrs:
+                return True
+    return False
+
+
+def _iter_with_lock_context(
+    body: list[ast.stmt], lock_attrs: set[str], selves: set[str], under_lock: bool
+) -> Iterator[tuple[ast.AST, bool]]:
+    """Yield every AST node in ``body`` exactly once, paired with whether
+    the class lock is held at that node. A ``with self.<lock>:`` body is
+    held; nested ``def``/``lambda`` bodies are NOT (they execute later) —
+    textual nesting is not temporal nesting."""
+
+    def visit(node: ast.AST, held: bool) -> Iterator[tuple[ast.AST, bool]]:
+        yield node, held
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            inner = node.body if isinstance(node.body, list) else [node.body]
+            for child in inner:
+                yield from visit(child, False)
+            return
+        if isinstance(node, ast.With) and _acquires(node, lock_attrs, selves):
+            for item in node.items:
+                yield from visit(item.context_expr, held)
+            for child in node.body:
+                yield from visit(child, True)
+            return
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, held)
+
+    for stmt in body:
+        yield from visit(stmt, under_lock)
+
+
+def _write_roots(node: ast.AST, selves: set[str]) -> list[str]:
+    """Attribute roots this single node writes/mutates (non-recursive:
+    the traversal visits children itself)."""
+    out: list[str] = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    elif isinstance(node, ast.Delete):
+        targets = node.targets
+    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if node.func.attr in MUTATORS:
+            attr = _root(node.func.value, selves)
+            if attr is not None:
+                out.append(attr)
+        return out
+    else:
+        return out
+    for target in targets:
+        attr = _root(target, selves)
+        if attr is not None:
+            out.append(attr)
+    return out
+
+
+def _method_holds_lock(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    doc = ast.get_docstring(fn) or ""
+    return bool(_HELD_DOC.search(doc))
+
+
+def _execution_units(
+    node: ast.ClassDef,
+) -> list[tuple[str, list[ast.stmt], bool, bool]]:
+    """(label, body, entry-lock-held, in-nested-class) triples to analyze.
+
+    Methods other than ``__init__`` are units as-is. ``__init__``'s
+    straight-line statements are construction (exempt), but every function
+    *defined* inside it — a closure, or a method of a nested handler class —
+    runs later on whatever thread calls it, so each top-most such def is its
+    own unit. Units inside a nested class have their own ``self`` (the
+    nested class's), so only the ``outer = self`` aliases reach back to the
+    owning instance there. (Defs nested inside other methods are handled in
+    place by the traversal's held=False descent.)"""
+    def collect_topmost_defs(
+        n: ast.AST, in_class: bool, out: list
+    ) -> None:
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((child, in_class))
+                continue  # inner defs handled by the unit's traversal
+            collect_topmost_defs(
+                child, in_class or isinstance(child, ast.ClassDef), out
+            )
+
+    units: list[tuple[str, list[ast.stmt], bool, bool]] = []
+    for fn in node.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name != "__init__":
+            units.append((fn.name, fn.body, _method_holds_lock(fn), False))
+            continue
+        # top-most defs within __init__ (not contained in another def)
+        defs: list[tuple[ast.FunctionDef | ast.AsyncFunctionDef, bool]] = []
+        collect_topmost_defs(fn, False, defs)
+        for sub, in_class in defs:
+            units.append(
+                (f"__init__.{sub.name}", sub.body, _method_holds_lock(sub), in_class)
+            )
+    return units
+
+
+def _check_class(src: SourceFile, node: ast.ClassDef) -> list[Finding]:
+    info = _ClassInfo(node)
+    _collect_aliases(info)
+    _classify_attrs(info)
+    if not info.lock_attrs:
+        return []
+    units = _execution_units(node)
+
+    def unit_selves(in_class: bool) -> set[str]:
+        return (info.selves - {"self"}) if in_class else info.selves
+
+    # pass 1: infer the guarded attribute set from writes under the lock
+    guarded: set[str] = set()
+    for _label, body, held0, in_class in units:
+        selves = unit_selves(in_class)
+        for sub, held in _iter_with_lock_context(body, info.lock_attrs, selves, held0):
+            if not held:
+                continue
+            for attr in _write_roots(sub, selves):
+                if attr not in info.lock_attrs and attr not in info.threadsafe_attrs:
+                    guarded.add(attr)
+    if not guarded:
+        return []
+
+    # pass 2: flag any unlocked access (read or write) of a guarded attr
+    findings: list[Finding] = []
+    lock_name = sorted(info.lock_attrs)[0]
+    for label, body, held0, in_class in units:
+        selves = unit_selves(in_class)
+        for sub, held in _iter_with_lock_context(body, info.lock_attrs, selves, held0):
+            if held:
+                continue
+            attr = _direct_attr(sub, selves)
+            if attr is not None and attr in guarded:
+                findings.append(
+                    Finding(
+                        RULE,
+                        src.path,
+                        sub.lineno,
+                        f"{node.name}.{attr}",
+                        f"{node.name}.{label} touches .{attr} outside the "
+                        f"lock, but the class writes it under "
+                        f"`with self.{lock_name}:` elsewhere",
+                    )
+                )
+    return findings
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple[str, int, str]] = set()
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for finding in _check_class(src, node):
+                key = (finding.path, finding.line, finding.symbol)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(finding)
+    return findings

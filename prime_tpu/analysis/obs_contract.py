@@ -1,0 +1,276 @@
+"""Checker 3 — observability contract (docs/observability.md is the truth).
+
+PR 1 established the rule that every telemetry surface is cataloged in one
+place; PRs 4-6 each grew the metric set and updated the catalog by hand — and
+review caught drift twice (stats keys vs catalog in PR 4, the tier-labeled
+histogram rename in PR 6). This checker makes the contract bidirectional and
+machine-checked:
+
+- ``obs-metric-undocumented`` — a metric family registered in code (a
+  literal-name ``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` on
+  any registry) that never appears in docs/observability.md;
+- ``obs-metric-kind-drift`` — the catalog row's *type* column disagrees with
+  the registration kind (the same drift :func:`lint_prometheus_text`'s
+  catalog mode catches at exposition time — see docs/analysis.md);
+- ``obs-metric-stale`` — a catalog table row naming a family no code
+  registers (a rename left the old row behind);
+- ``obs-span-undocumented`` / ``obs-span-stale`` — the same contract for
+  span names (``TRACER.span("x.y")`` / ``TRACER.emit("x.y", ...)`` sites vs
+  the "Span catalog" table).
+
+:func:`load_metrics_catalog` is the shared doc parser: the pytest suite
+feeds its output to ``lint_prometheus_text(text, catalog=...)`` so a live
+``/metrics`` exposition is held to the same document — code, docs, and
+exposition cannot drift pairwise-independently.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from prime_tpu.analysis.core import Finding, Project, const_str
+
+DOC_PATH = "docs/observability.md"
+
+_METRIC_KINDS = {"counter", "gauge", "histogram"}
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SPAN_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z0-9_.]+$")
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+# inline doc mentions like `client_http_requests_total{method,status}`;
+# at least one underscore so single backticked words ("tier", "device")
+# don't count as documented metric families
+_INLINE_METRIC_RE = re.compile(r"`([a-z][a-z0-9]*(?:_[a-z0-9]+)+)(?:\{[^}`]*\})?`")
+
+
+# -- code side ----------------------------------------------------------------
+
+
+def _metric_registrations(project: Project) -> list[tuple[str, str, str, int]]:
+    """(name, kind, path, line) for literal metric registrations."""
+    out = []
+    for src in project.files:
+        if src.path.endswith("obs/metrics.py"):
+            continue  # the registry itself, not a user of it
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_KINDS
+                and node.args
+            ):
+                name = const_str(node.args[0])
+                if name and _METRIC_NAME_RE.match(name):
+                    out.append((name, node.func.attr, src.path, node.lineno))
+    return out
+
+
+def _span_sites(project: Project) -> list[tuple[str, str, int]]:
+    """(name, path, line) for literal span/emit names."""
+    out = []
+    for src in project.files:
+        if src.path.endswith("obs/trace.py"):
+            continue  # the tracer itself
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            name = const_str(node.args[0])
+            if not name or not _SPAN_NAME_RE.match(name):
+                continue
+            func = node.func
+            is_span_call = (
+                isinstance(func, ast.Attribute) and func.attr in ("span", "emit")
+            ) or (isinstance(func, ast.Name) and func.id == "span")
+            if is_span_call:
+                out.append((name, src.path, node.lineno))
+    return out
+
+
+# -- doc side -----------------------------------------------------------------
+
+
+def _strip_fences(text: str) -> str:
+    """Drop fenced code blocks — a ``` fence's backticks would otherwise
+    pair with the next inline backtick and swallow whole prose regions."""
+    out: list[str] = []
+    fenced = False
+    for line in text.splitlines():
+        if line.strip().startswith("```"):
+            fenced = not fenced
+            continue
+        if not fenced:
+            out.append(line)
+    return "\n".join(out)
+
+
+def _parse_tables(text: str) -> list[dict]:
+    """Markdown tables as {headers: [...], rows: [(line, cells)]}."""
+    tables: list[dict] = []
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        if line.startswith("|") and i + 1 < len(lines):
+            sep = lines[i + 1].strip()
+            if sep.startswith("|") and set(sep) <= set("|-: "):
+                headers = [c.strip().lower() for c in line.strip("|").split("|")]
+                rows = []
+                j = i + 2
+                while j < len(lines) and lines[j].strip().startswith("|"):
+                    cells = [c.strip() for c in lines[j].strip().strip("|").split("|")]
+                    rows.append((j + 1, cells))
+                    j += 1
+                tables.append({"headers": headers, "rows": rows})
+                i = j
+                continue
+        i += 1
+    return tables
+
+
+def _names_in_cell(cell: str) -> list[str]:
+    """Backticked identifiers in a table cell, label-suffix stripped:
+    ```a_total` / `b_total``` -> [a_total, b_total]."""
+    out = []
+    for token in _BACKTICK_RE.findall(cell):
+        token = token.split("{")[0].strip()
+        if token:
+            out.append(token)
+    return out
+
+
+def load_metrics_catalog(doc_text: str) -> dict[str, str]:
+    """Metric family -> declared type, from every observability.md table
+    with ``metric`` and ``type`` header columns. This is the catalog the
+    exposition lint (``lint_prometheus_text(text, catalog=...)``) and the
+    static kind check both consume — one parse, two enforcement points."""
+    return {name: kind for name, kind, _line in _doc_metric_rows(doc_text)}
+
+
+def _doc_metric_rows(doc_text: str) -> list[tuple[str, str, int]]:
+    """(name, kind, doc line) per catalog table row entry."""
+    out = []
+    for table in _parse_tables(doc_text):
+        headers = table["headers"]
+        if "metric" not in headers or "type" not in headers:
+            continue
+        name_col = headers.index("metric")
+        type_col = headers.index("type")
+        for line, cells in table["rows"]:
+            if len(cells) <= max(name_col, type_col):
+                continue
+            kind = cells[type_col].strip().strip("`")
+            for name in _names_in_cell(cells[name_col]):
+                if _METRIC_NAME_RE.match(name):
+                    out.append((name, kind, line))
+    return out
+
+
+def _doc_span_rows(doc_text: str) -> list[tuple[str, int]]:
+    out = []
+    for table in _parse_tables(doc_text):
+        headers = table["headers"]
+        if "span" not in headers:
+            continue
+        name_col = headers.index("span")
+        for line, cells in table["rows"]:
+            if len(cells) > name_col:
+                for name in _names_in_cell(cells[name_col]):
+                    if _SPAN_NAME_RE.match(name):
+                        out.append((name, line))
+    return out
+
+
+def check(project: Project) -> list[Finding]:
+    doc = project.doc(DOC_PATH)
+    if doc is None:
+        return [
+            Finding(
+                "obs-catalog-missing",
+                DOC_PATH,
+                1,
+                DOC_PATH,
+                "docs/observability.md not found — the obs contract has no "
+                "catalog to check against",
+            )
+        ]
+    findings: list[Finding] = []
+
+    # any backticked mention anywhere in the doc counts as "documented"
+    # (prose and tables alike); STALENESS is judged on table rows only
+    prose = _strip_fences(doc)
+    documented_metrics = set(_INLINE_METRIC_RE.findall(prose))
+    documented_spans = {
+        t for t in _BACKTICK_RE.findall(prose) if _SPAN_NAME_RE.match(t)
+    }
+
+    regs = _metric_registrations(project)
+    reg_kinds: dict[str, set[str]] = {}
+    for name, kind, _path, _line in regs:
+        reg_kinds.setdefault(name, set()).add(kind)
+
+    seen_undocumented: set[str] = set()
+    for name, kind, path, line in regs:
+        if name not in documented_metrics and name not in seen_undocumented:
+            seen_undocumented.add(name)
+            findings.append(
+                Finding(
+                    "obs-metric-undocumented",
+                    path,
+                    line,
+                    name,
+                    f"metric `{name}` ({kind}) is registered here but has no "
+                    f"row in {DOC_PATH}",
+                )
+            )
+
+    for name, kind, line in _doc_metric_rows(doc):
+        if name not in reg_kinds:
+            findings.append(
+                Finding(
+                    "obs-metric-stale",
+                    DOC_PATH,
+                    line,
+                    name,
+                    f"catalog row documents `{name}` but no code registers it",
+                )
+            )
+        elif kind in _METRIC_KINDS and kind not in reg_kinds[name]:
+            findings.append(
+                Finding(
+                    "obs-metric-kind-drift",
+                    DOC_PATH,
+                    line,
+                    name,
+                    f"catalog says `{name}` is a {kind}, code registers "
+                    f"{'/'.join(sorted(reg_kinds[name]))}",
+                )
+            )
+
+    spans = _span_sites(project)
+    span_names = {name for name, _path, _line in spans}
+    seen_spans: set[str] = set()
+    for name, path, line in spans:
+        if name not in documented_spans and name not in seen_spans:
+            seen_spans.add(name)
+            findings.append(
+                Finding(
+                    "obs-span-undocumented",
+                    path,
+                    line,
+                    name,
+                    f"span `{name}` is emitted here but absent from the "
+                    f"{DOC_PATH} span catalog",
+                )
+            )
+    for name, line in _doc_span_rows(doc):
+        if name not in span_names:
+            findings.append(
+                Finding(
+                    "obs-span-stale",
+                    DOC_PATH,
+                    line,
+                    name,
+                    f"span catalog row documents `{name}` but no code emits it",
+                )
+            )
+    return findings

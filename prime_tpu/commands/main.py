@@ -15,6 +15,7 @@ import os
 import click
 
 import prime_tpu
+from prime_tpu.core.config import env_flag
 
 # command name → (module, attribute). Modules import only on dispatch.
 _LAZY_COMMANDS: dict[str, tuple[str, str]] = {
@@ -100,7 +101,7 @@ def cli(context: str | None) -> None:
     """
     if context:
         os.environ["PRIME_CONTEXT"] = context
-    if not os.environ.get("PRIME_DISABLE_VERSION_CHECK"):
+    if not env_flag("PRIME_DISABLE_VERSION_CHECK", False):
         from prime_tpu.utils.version_check import check_for_update
 
         newer = check_for_update(prime_tpu.__version__)

@@ -21,6 +21,22 @@ from typing import Any
 
 from pydantic import BaseModel, Field
 
+# Environment knobs: the one sanctioned way to read a ``PRIME_*`` knob.
+# Every knob must (a) be read through one of these helpers, (b) have a row
+# in the "Environment knobs" table in docs/architecture.md, and (c) agree
+# with its paired CLI flag's default — all three enforced by the
+# knob-registry checker in ``prime_tpu/analysis``. Direct ``os.environ``
+# reads of PRIME_* names anywhere else are lint findings. The implementation
+# lives in the stdlib-only leaf ``prime_tpu.utils.env`` so the obs layer can
+# read its knobs without pulling this module's pydantic dependency; this
+# re-export is the canonical import surface for everything else.
+from prime_tpu.utils.env import (  # noqa: F401
+    env_flag,
+    env_float,
+    env_int,
+    env_str,
+)
+
 DEFAULT_BASE_URL = "https://api.primeintellect.ai"
 DEFAULT_FRONTEND_URL = "https://app.primeintellect.ai"
 DEFAULT_INFERENCE_URL = "https://api.pinference.ai/api/v1"

@@ -2,7 +2,11 @@
 
 Dependency-free on purpose — ``obs`` sits below every other prime_tpu layer
 (core.client, serve, evals all record into it) so it must import nothing from
-them and nothing heavyweight (no jax, no httpx). Two halves:
+them and nothing heavyweight (no jax, no httpx, no pydantic). Knob reads go
+through the stdlib-only ``prime_tpu.utils.env`` leaf, which keeps that
+property while still satisfying the knob-registry lint (core.config
+re-exports the same helpers as the canonical surface for everything above
+this layer). Two halves:
 
 - :mod:`prime_tpu.obs.metrics` — ``Counter`` / ``Gauge`` / ``Histogram``
   families in a ``Registry`` with one lock per registry, so a snapshot (or a

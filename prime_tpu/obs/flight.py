@@ -28,7 +28,6 @@ the debug endpoint.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
@@ -36,6 +35,7 @@ from itertools import islice
 from typing import Any
 
 from prime_tpu.obs.trace import TRACER, TraceContext
+from prime_tpu.utils.env import env_float
 
 DEFAULT_CAPACITY = 256
 DEFAULT_MAX_EVENTS = 64
@@ -44,11 +44,7 @@ DEFAULT_MAX_INFLIGHT = 1024
 
 def slow_ms_from_env() -> float:
     """The ``PRIME_SERVE_SLOW_MS`` capture threshold; 0 = off."""
-    raw = os.environ.get("PRIME_SERVE_SLOW_MS", "").strip()
-    try:
-        return max(0.0, float(raw)) if raw else 0.0
-    except ValueError:
-        return 0.0
+    return max(0.0, env_float("PRIME_SERVE_SLOW_MS", 0.0))
 
 
 class _Timeline:

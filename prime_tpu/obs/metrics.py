@@ -386,16 +386,27 @@ _LABELS_RE = re.compile(rf"^{_LABEL_PAIR}(?:,{_LABEL_PAIR})*,?$")
 _LABEL_FIND_RE = re.compile(rf"([a-zA-Z_][a-zA-Z0-9_]*)=({_QUOTED})")
 
 
-def lint_prometheus_text(text: str) -> list[str]:
+def lint_prometheus_text(
+    text: str, catalog: Mapping[str, str] | None = None
+) -> list[str]:
     """Pure-python lint of Prometheus text exposition format 0.0.4. Returns
     a list of problems (empty = well-formed). Checked: sample-line syntax
     and label syntax, values parse (incl. +Inf/-Inf/NaN spellings — 'nan'
     is a violation), no duplicate series, TYPE declared at most once per
     family, and histogram invariants per series (cumulative non-decreasing
     buckets, a +Inf bucket, _count equal to the +Inf bucket). The tests and
-    the CI serve-smoke job run every /metrics endpoint through this."""
+    the CI serve-smoke job run every /metrics endpoint through this.
+
+    ``catalog`` (family name -> declared type, e.g. from
+    ``prime_tpu.analysis.obs_contract.load_metrics_catalog`` over the
+    docs/observability.md tables) additionally pins the exposition to the
+    documented contract: a family whose TYPE line disagrees with the catalog,
+    a family the catalog has never heard of, or a cataloged family exposed
+    without a HELP line are all problems — so the live exposition and the
+    operator docs cannot drift independently of each other."""
     problems: list[str] = []
     typed: dict[str, str] = {}
+    helped: set[str] = set()
     seen_samples: set[str] = set()
     # histogram accounting: series key -> list of (le, cumulative count)
     buckets: dict[str, list[tuple[float, float]]] = {}
@@ -414,7 +425,10 @@ def lint_prometheus_text(text: str) -> list[str]:
                     problems.append(f"line {lineno}: duplicate TYPE for {parts[2]}")
                 else:
                     typed[parts[2]] = parts[3]
-            elif len(parts) >= 2 and parts[1] not in ("HELP", "TYPE"):
+            elif len(parts) >= 2 and parts[1] == "HELP":
+                if len(parts) >= 3:
+                    helped.add(parts[2])
+            elif len(parts) >= 2:
                 problems.append(f"line {lineno}: unknown comment keyword: {line!r}")
             continue
         m = _SAMPLE_RE.match(line)
@@ -478,6 +492,23 @@ def lint_prometheus_text(text: str) -> list[str]:
                 f"{series_key}: _count {counts[series_key]} != +Inf bucket "
                 f"{entries[-1][1]}"
             )
+    if catalog is not None:
+        for family, kind in typed.items():
+            expected = catalog.get(family)
+            if expected is None:
+                problems.append(
+                    f"{family}: exposed but absent from the metrics catalog "
+                    "(docs/observability.md)"
+                )
+                continue
+            if expected in ("counter", "gauge", "histogram") and expected != kind:
+                problems.append(
+                    f"{family}: TYPE {kind} but the catalog documents {expected}"
+                )
+            if family not in helped:
+                problems.append(
+                    f"{family}: cataloged family exposed without a HELP line"
+                )
     return problems
 
 

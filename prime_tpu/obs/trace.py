@@ -360,11 +360,14 @@ class Tracer:
 
 
 # Global tracer: off unless PRIME_TRACE points at a JSONL sink, so untraced
-# runs pay one attribute check per span site.
-TRACER = Tracer(
-    enabled=bool(os.environ.get("PRIME_TRACE")),
-    sink_path=os.environ.get("PRIME_TRACE") or None,
-)
+# runs pay one attribute check per span site. The knob helper comes from the
+# stdlib-only utils.env leaf (NOT core.config, whose pydantic import the
+# dependency-free obs layer must not pull) and is imported here, next to the
+# one read that needs it.
+from prime_tpu.utils.env import env_str as _env_str  # noqa: E402
+
+_TRACE_SINK = _env_str("PRIME_TRACE")
+TRACER = Tracer(enabled=bool(_TRACE_SINK), sink_path=_TRACE_SINK or None)
 
 
 def span(name: str, **attrs: Any):

@@ -93,27 +93,15 @@ def _pallas_interpret() -> bool:
     """PRIME_TPU_PALLAS_INTERPRET=1 runs the kernels in interpret mode, so
     the pallas dispatch paths (incl. window/softcap/sink/int8 variants) can
     be validated off-TPU — bench.py's smoke mode sets it on CPU."""
-    import os
+    from prime_tpu.core.config import env_flag
 
-    return os.environ.get("PRIME_TPU_PALLAS_INTERPRET", "").lower() not in (
-        "", "0", "false", "no",
-    )
+    return env_flag("PRIME_TPU_PALLAS_INTERPRET", False)
 
 
 def _flash_decode_min_capacity() -> int:
-    import os
-    import warnings
+    from prime_tpu.core.config import env_int
 
-    raw = os.environ.get("PRIME_TPU_FLASH_DECODE_MIN_C", "2048")
-    try:
-        return int(raw)
-    except ValueError:
-        warnings.warn(
-            f"PRIME_TPU_FLASH_DECODE_MIN_C={raw!r} is not an integer; "
-            "using the default of 2048",
-            stacklevel=2,
-        )
-        return 2048
+    return env_int("PRIME_TPU_FLASH_DECODE_MIN_C", 2048)
 
 
 def _decode_pallas_eligible(k_cache: jnp.ndarray) -> bool:

@@ -9,8 +9,8 @@ DCN across slices. `prime pods connect --all-workers` is the launch fan-out.
 
 from __future__ import annotations
 
-import os
 
+from prime_tpu.core.config import env_flag
 from prime_tpu.parallel.topology import SliceSpec, parse_slice
 
 
@@ -61,5 +61,5 @@ def worker_env(worker_index: int, coordinator_host: str, num_workers: int) -> di
         "PRIME_WORKER_INDEX": str(worker_index),
         "PRIME_NUM_WORKERS": str(num_workers),
         "PRIME_COORDINATOR": f"{coordinator_host}:8476",
-        **({"TPU_STDERR_LOG_LEVEL": "0"} if os.environ.get("PRIME_DEBUG") else {}),
+        **({"TPU_STDERR_LOG_LEVEL": "0"} if env_flag("PRIME_DEBUG", False) else {}),
     }

@@ -19,13 +19,14 @@ import time
 from pathlib import Path
 from typing import Awaitable, Callable
 
+from prime_tpu.core.config import env_str
 from prime_tpu.sandboxes.models import SandboxAuth
 
 AUTH_REFRESH_MARGIN_S = 60.0
 
 
 def default_cache_path() -> Path:
-    env_dir = os.environ.get("PRIME_CONFIG_DIR")
+    env_dir = env_str("PRIME_CONFIG_DIR")
     base = Path(env_dir) if env_dir else Path.home() / ".prime"
     return base / "sandbox_auth_cache.json"
 

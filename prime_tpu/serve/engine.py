@@ -56,7 +56,6 @@ lock), so an HTTP scrape never reads live counters mid-tick.
 from __future__ import annotations
 
 import itertools
-import os
 import queue
 import sys
 import threading
@@ -68,6 +67,7 @@ from typing import Any
 
 import numpy as np
 
+from prime_tpu.core.config import env_flag, env_float, env_int
 from prime_tpu.obs.flight import FlightRecorder
 from prime_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS, DEFAULT_TOKEN_BUCKETS, Registry
 from prime_tpu.obs.trace import TRACER, TraceContext
@@ -182,13 +182,6 @@ def _segment_to_device(segment: Any) -> Any:
     import jax.numpy as jnp
 
     return jax.tree_util.tree_map(jnp.asarray, segment)
-
-
-def _env_flag(name: str, default: bool) -> bool:
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    return raw.strip().lower() not in ("", "0", "false", "off", "no")
 
 
 @dataclass
@@ -336,13 +329,13 @@ class ContinuousBatchingEngine:
         # needs chunk N's accepted tokens on the host, a data dependency the
         # pipeline cannot hide (pinned by test_spec_chunk_runs_synchronously).
         if overlap is None:
-            overlap = _env_flag("PRIME_SERVE_OVERLAP", True)
+            overlap = env_flag("PRIME_SERVE_OVERLAP", True)
         self.overlap = bool(overlap) and not speculative
         # AOT-style warmup (see warmup()): opt-in via PRIME_SERVE_WARMUP
         # because compiling the full program set up front trades startup
         # seconds for the guarantee that no cold compile lands mid-pipeline
         if warmup is None:
-            warmup = _env_flag("PRIME_SERVE_WARMUP", False)
+            warmup = env_flag("PRIME_SERVE_WARMUP", False)
         self.warmup_enabled = bool(warmup)
         # dispatched-but-unfetched decode chunks, oldest first (depth <= 1
         # outside tick(); owned by the engine thread)
@@ -377,8 +370,7 @@ class ContinuousBatchingEngine:
         # unbounded queue converts every request into a timeout, the worst of
         # both worlds. 0 = unbounded (the historical behavior).
         if max_queue is None:
-            raw_mq = os.environ.get("PRIME_SERVE_MAX_QUEUE", "").strip()
-            max_queue = int(raw_mq) if raw_mq else 0
+            max_queue = env_int("PRIME_SERVE_MAX_QUEUE", 0)
         self.max_queue = max(0, int(max_queue))
         # drain: set by drain(); submit() refuses new work (DrainingError)
         # while the loop keeps ticking until in-flight requests finish
@@ -409,12 +401,14 @@ class ContinuousBatchingEngine:
         self.prefill_chunk = max(MIN_BUCKET, prefill_chunk)
         self.min_prefix = max(min_prefix, MIN_BUCKET)
         if prefix_cache_mb is None:
-            raw = os.environ.get("PRIME_SERVE_PREFIX_CACHE_MB", "").strip()
-            prefix_cache_mb = float(raw) if raw else DEFAULT_PREFIX_CACHE_MB
+            prefix_cache_mb = env_float(
+                "PRIME_SERVE_PREFIX_CACHE_MB", DEFAULT_PREFIX_CACHE_MB
+            )
         self.prefix_cache_mb = float(prefix_cache_mb)
         if prefix_cache_host_mb is None:
-            raw = os.environ.get("PRIME_SERVE_PREFIX_CACHE_HOST_MB", "").strip()
-            prefix_cache_host_mb = float(raw) if raw else DEFAULT_PREFIX_CACHE_HOST_MB
+            prefix_cache_host_mb = env_float(
+                "PRIME_SERVE_PREFIX_CACHE_HOST_MB", DEFAULT_PREFIX_CACHE_HOST_MB
+            )
         self.prefix_cache_host_mb = float(prefix_cache_host_mb)
         if self.prefix_cache_host_mb > 0 and mesh is not None and getattr(mesh, "size", 1) > 1:
             # the spill tier's converters are not sharding-preserving:

@@ -98,12 +98,12 @@ class _AdmissionGate:
         self.max_inflight = max(1, max_inflight)
         self._cond = threading.Condition()
         self._inflight = 0
-        self.waiting = 0
+        self._waiting = 0
 
     def acquire(self, timeout: float) -> bool:
         deadline = time.monotonic() + timeout
         with self._cond:
-            self.waiting += 1
+            self._waiting += 1
             try:
                 while self._inflight >= self.max_inflight:
                     remaining = deadline - time.monotonic()
@@ -113,7 +113,7 @@ class _AdmissionGate:
                 self._inflight += 1
                 return True
             finally:
-                self.waiting -= 1
+                self._waiting -= 1
 
     def release(self) -> None:
         with self._cond:
@@ -122,7 +122,18 @@ class _AdmissionGate:
 
     @property
     def inflight(self) -> int:
-        return self._inflight
+        # under the condition lock: this feeds the fleet_inflight_requests
+        # gauge and the Retry-After estimate, and an unsynchronized read
+        # could see a torn admit/release pair (prime-lint lock-discipline)
+        with self._cond:
+            return self._inflight
+
+    @property
+    def waiting(self) -> int:
+        # same contract as `inflight`: the Retry-After estimate scales with
+        # the waiter count, so it reads under the lock too
+        with self._cond:
+            return self._waiting
 
 
 class FleetRouter:

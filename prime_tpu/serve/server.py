@@ -32,6 +32,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 from urllib.parse import parse_qs, urlsplit
 
+from prime_tpu.core.config import env_str
 from prime_tpu.obs.flight import FlightRecorder
 from prime_tpu.obs.metrics import Registry
 from prime_tpu.obs.trace import (
@@ -118,7 +119,7 @@ class InferenceServer:
         self._draining = False  # set by drain(): finish in-flight, refuse new
         self.generator = generator
         if admin_token is None:
-            admin_token = os.environ.get("PRIME_FLEET_ADMIN_TOKEN", "")
+            admin_token = env_str("PRIME_FLEET_ADMIN_TOKEN", "")
         self.admin_token = admin_token or None
         # chat requests currently generating/streaming in THIS server: the
         # drain-complete signal for backends without their own `drained`
@@ -520,7 +521,13 @@ class InferenceServer:
                 drained = (
                     payload["queue_depth"] == 0 and payload["active_slots"] == 0
                 )
-            payload["drained"] = bool(drained) and self._inflight_chats == 0
+            # read under the same lock the chat threads increment under:
+            # drained=true is the kill-is-safe signal, and an unlocked read
+            # could observe the count before a just-admitted chat's increment
+            # lands (prime-lint lock-discipline)
+            with self._inflight_lock:
+                inflight_chats = self._inflight_chats
+            payload["drained"] = bool(drained) and inflight_chats == 0
         return payload
 
     def drain(self) -> None:

@@ -9,11 +9,12 @@ copy exists.
 from __future__ import annotations
 
 import hashlib
-import os
 import platform
 import tarfile
 import tempfile
 from pathlib import Path
+
+from prime_tpu.core.config import env_str
 
 FRPC_VERSION = "0.66.0"
 # sha256 of the published fatedier/frp v0.66.0 release tarballs. These are
@@ -42,14 +43,14 @@ def _platform_key() -> str:
 
 
 def cache_dir() -> Path:
-    env_dir = os.environ.get("PRIME_CONFIG_DIR")
+    env_dir = env_str("PRIME_CONFIG_DIR")
     base = Path(env_dir) if env_dir else Path.home() / ".prime"
     return base / "bin"
 
 
 def get_frpc_path(download: bool = True) -> Path:
     """Resolve the frpc binary: override > cache > (optional) download."""
-    override = os.environ.get("PRIME_FRPC_PATH")
+    override = env_str("PRIME_FRPC_PATH")
     if override:
         path = Path(override)
         if not path.exists():

@@ -1,0 +1,66 @@
+"""``PRIME_*`` environment-knob readers — stdlib-only implementation.
+
+This is a dependency-free leaf so the obs layer (which must stay importable
+without pydantic/httpx) can read its knobs directly. The *canonical* import
+surface for product code is ``prime_tpu.core.config`` which re-exports these
+four names — the knob-registry checker in ``prime_tpu/analysis`` enforces
+that every ``PRIME_*`` read goes through them, has a row in the
+docs/architecture.md "Environment knobs" table, and agrees with its paired
+CLI flag default (see docs/analysis.md).
+
+Semantics are deliberately uniform: unset -> default; junk never raises
+(a malformed knob on a production replica must degrade to the default with
+a warning, not take the process down at import or construction time).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+_FALSE_WORDS = ("", "0", "false", "off", "no")
+
+
+def env_str(name: str, default: str = "") -> str:
+    """String knob: the raw value, or ``default`` when unset."""
+    raw = os.environ.get(name)
+    return default if raw is None else raw
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """Boolean knob: unset -> default; otherwise anything outside
+    {"", "0", "false", "off", "no"} (case-insensitive) is true."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in _FALSE_WORDS
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer knob: unset or blank -> default; junk warns and defaults."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        warnings.warn(
+            f"{name}={raw!r} is not an integer; using the default of {default}",
+            stacklevel=2,
+        )
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    """Float knob: unset or blank -> default; junk warns and defaults."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        warnings.warn(
+            f"{name}={raw!r} is not a number; using the default of {default}",
+            stacklevel=2,
+        )
+        return default

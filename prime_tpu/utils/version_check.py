@@ -7,16 +7,17 @@ silent (a version nag must never break the CLI).
 from __future__ import annotations
 
 import json
-import os
 import time
 from pathlib import Path
+
+from prime_tpu.core.config import env_str
 
 CACHE_TTL_S = 24 * 3600
 PYPI_URL = "https://pypi.org/pypi/prime-tpu/json"
 
 
 def _cache_path() -> Path:
-    env_dir = os.environ.get("PRIME_CONFIG_DIR")
+    env_dir = env_str("PRIME_CONFIG_DIR")
     base = Path(env_dir) if env_dir else Path.home() / ".prime"
     return base / "version_check.json"
 

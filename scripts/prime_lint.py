@@ -1,0 +1,21 @@
+#!/usr/bin/env python3
+"""Thin launcher for the prime-lint invariant suite.
+
+Equivalent to ``python -m prime_tpu.analysis``; exists so the repo's
+scripts/ directory has one obvious entry point (and so the suite runs from
+a checkout without an installed wheel: the repo root is prepended to
+sys.path). See docs/analysis.md for the rule catalog.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from prime_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

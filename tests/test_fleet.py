@@ -11,6 +11,7 @@ unbounded queueing.
 import threading
 import time
 from contextlib import contextmanager
+from pathlib import Path
 
 import httpx
 import pytest
@@ -766,9 +767,13 @@ def test_traced_fleet_single_trace_id_and_exposition_lint(tmp_path):
     sink path too.)"""
     import json
 
+    from prime_tpu.analysis.obs_contract import load_metrics_catalog
     from prime_tpu.obs import TRACER, lint_prometheus_text
     from prime_tpu.obs.trace import new_traceparent, parse_traceparent
 
+    catalog = load_metrics_catalog(
+        (Path(__file__).parent.parent / "docs" / "observability.md").read_text()
+    )
     sink = tmp_path / "fleet-trace.jsonl"
     prev = TRACER.reconfigure(enabled=True, sink_path=str(sink))
     try:
@@ -787,7 +792,7 @@ def test_traced_fleet_single_trace_id_and_exposition_lint(tmp_path):
                 text = httpx.get(
                     f"{url}/metrics", params={"format": "prometheus"}, timeout=5
                 ).text
-                assert lint_prometheus_text(text) == [], (url, text)
+                assert lint_prometheus_text(text, catalog=catalog) == [], (url, text)
     finally:
         TRACER.reconfigure(**prev)
     spans = [json.loads(line) for line in sink.read_text().splitlines()]
