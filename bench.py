@@ -34,6 +34,12 @@ import time
 # Round-1 anchor (v5e-1, this repo @ first bench). vs_baseline = value / this.
 PREV_DECODE_TOK_S = 1396.6
 
+# Record schema version. Schema 1: rounds 1-5 (implicit — no "schema" key;
+# scripts/perf_delta.py labels them on load). Schema 2: serve/fleet sections
+# are measured through prime_tpu.loadgen (registry-snapshot-derived numbers,
+# "loadgen" SLO report key) and the preflight is backend-conditional.
+SCHEMA_VERSION = 2
+
 # TPU v5e single-chip peaks for the roofline fields (VERDICT r4 #2): decode
 # is HBM-bound, so each section reports achieved GB/s and % of peak from a
 # bytes-moved model (weights + KV + scales); prefill is MXU-bound, so the
@@ -355,11 +361,26 @@ def _latest_opportunistic_record() -> tuple[str, dict] | None:
         except (OSError, ValueError):
             continue
         if isinstance(data, dict) and isinstance(data.get("value"), (int, float)):
+            # label the record's schema era explicitly (absent key = schema 1,
+            # the pre-loadgen rounds) so perf_delta.py and the carry-forward
+            # below never guess which fields can exist in it
+            data.setdefault("schema", 1)
             # newest by mtime, NOT lexicographic path order (r10 sorts
             # before r9 and would resurrect a stale round's number)
             if data["value"] > 0 and (best is None or mtime > best[0]):
                 best = (mtime, path, data)
     return (best[1], best[2]) if best else None
+
+
+def _cpu_only_backend() -> bool:
+    """True when this run is pinned to CPU (JAX_PLATFORMS=cpu — CI, the
+    loadgen smoke, a laptop). The axon-tunnel preflight exists to detect a
+    wedged TPU backend; on a CPU run it can only produce a false abort, so
+    the preflight is conditional on actually expecting an accelerator.
+    JAX_PLATFORMS is priority-ordered: only a CPU-FIRST list counts —
+    "tpu,cpu" (TPU preferred, CPU fallback) still wants the probe."""
+    platforms = os.environ.get("JAX_PLATFORMS", "").lower()
+    return platforms.split(",")[0].strip() == "cpu"
 
 
 def _preflight() -> dict:
@@ -376,6 +397,7 @@ def _preflight() -> dict:
     print(
         json.dumps(
             {
+                "schema": SCHEMA_VERSION,
                 "metric": "decode_tokens_per_sec (bench killed before preflight verdict)",
                 "value": 0.0,
                 "unit": "tokens/s",
@@ -427,6 +449,7 @@ def _preflight() -> dict:
             time.sleep(PROBE_WAITS_S[attempt])
     report["diagnosis"] = _diagnose()
     record = {
+        "schema": SCHEMA_VERSION,
         "metric": "decode_tokens_per_sec (bench aborted)",
         "value": 0.0,
         "unit": "tokens/s",
@@ -450,6 +473,9 @@ def _preflight() -> dict:
                 "unit": stale.get("unit", "tokens/s"),
                 "vs_baseline": stale.get("vs_baseline", 0.0),
                 "carried_from": path,
+                # the donor's own era, so a schema-2 consumer knows whether
+                # the carried fields follow schema-1 (pre-loadgen) shape
+                "carried_schema": stale.get("schema", 1),
             }
         )
         print(f"# bench: carrying forward {path} (value {stale['value']})", flush=True)
@@ -462,8 +488,15 @@ def main() -> None:
     # Smoke mode validates bench.py's own code paths, not the tunnel: skip
     # the preflight entirely — its sweep would SIGKILL the live watcher (and
     # any in-flight opportunistic bench), and its probes would burn ~7.5 min
-    # exiting(1) whenever the tunnel is down, which is exactly when smoke runs
-    preflight_report = None if SMOKE else _preflight()
+    # exiting(1) whenever the tunnel is down, which is exactly when smoke runs.
+    # A CPU-pinned run (CI loadgen smoke, laptop) skips it too: the axon
+    # probe can only false-abort a run that never wanted the accelerator.
+    if SMOKE or _cpu_only_backend():
+        preflight_report = None
+        if _cpu_only_backend() and not SMOKE:
+            print("# bench: CPU backend pinned — axon preflight skipped", flush=True)
+    else:
+        preflight_report = _preflight()
     import jax
     import jax.numpy as jnp
 
@@ -540,6 +573,7 @@ def main() -> None:
     decode_tok_s = BATCH * NEW_TOKENS / best
     param_bytes = _tree_bytes(params)
     record = {
+        "schema": SCHEMA_VERSION,
         "metric": f"decode_tokens_per_sec ({MODEL} bf16, b{BATCH}, p{PROMPT_LEN}+{NEW_TOKENS})",
         "value": round(decode_tok_s, 1),
         "unit": "tokens/s",
@@ -640,14 +674,30 @@ def main() -> None:
     print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
     # ---- serve: continuous-batching engine under concurrent load ------------
+    # Measured THROUGH prime_tpu.loadgen (schema 2): the prompt sets and
+    # engine configs are unchanged from schema 1, but the measured window is
+    # driven by the loadgen runner and every number comes from registry
+    # snapshot deltas (captured_at-bracketed) instead of a client stopwatch.
+    # Each section's RunResult also lands in the record's "loadgen" SLO
+    # report — the same artifact the CI smoke publishes.
+    from prime_tpu.loadgen import (
+        EngineTarget,
+        build_report,
+        run_schedule,
+        scenario_row,
+        schedule_from_prompts,
+    )
+
     n_req, req_new = SERVE_N_REQ, SERVE_NEW
     serve_prompt_len = SERVE_PROMPT_LEN
     serve_slots = SERVE_SLOTS
     serve_prompts = serve_prompts_for(config)
+    loadgen_results: list = []
 
     def run_serve(
         kv_quant: bool = False, speculative: bool = False, prompts=None,
         record_counters: bool = False, obs_key: str | None = None,
+        scenario: str = "serve",
     ) -> float:
         from prime_tpu.serve.engine import ContinuousBatchingEngine
 
@@ -692,13 +742,16 @@ def main() -> None:
             waves_before = engine.batched_waves
             hits_before = engine.prefix_hits
             stats_before = engine.stats()
-            t0 = time.perf_counter()
-            reqs = [engine.submit(ids, max_new_tokens=req_new) for ids in prompts]
-            while not all(r.done for r in reqs):
-                engine.tick()
-            elapsed = time.perf_counter() - t0
-            engine.tick()  # drain the lookahead chunk so waste/inflight settle
-            total = sum(len(r.all_tokens(timeout=1)) for r in reqs)
+            # the measured window: loadgen drives the burst (time_scale=0 —
+            # every arrival immediate, exactly the old submit-all loop) and
+            # brackets it with registry snapshots; tok/s comes from the
+            # token-counter delta over the captured_at window
+            schedule = schedule_from_prompts(scenario, prompts, req_new)
+            result = run_schedule(
+                schedule, EngineTarget(engine), scenario=scenario, time_scale=0.0,
+            )
+            loadgen_results.append(result)
+            row = scenario_row(result)
             if record_counters:
                 # evidence the batched-admission path carried the MEASURED
                 # window (deltas, not engine-lifetime totals — warmup hits
@@ -731,7 +784,7 @@ def main() -> None:
                 # the headline mean
                 engine.stats()  # refresh point-in-time gauges
                 record[obs_key] = engine.registry.snapshot()
-            return total / elapsed
+            return row["tok_s"]
         finally:
             del engine
 
@@ -763,7 +816,9 @@ def main() -> None:
     print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
     try:
         # int8-cache engine: same load, half the KV HBM traffic per step
-        record["serve_int8_tok_s"] = round(run_serve(kv_quant=True, obs_key="serve_int8_obs"), 1)
+        record["serve_int8_tok_s"] = round(
+            run_serve(kv_quant=True, obs_key="serve_int8_obs", scenario="serve_int8"), 1
+        )
         print(f"# bench: serve int8 {record['serve_int8_tok_s']} tok/s", flush=True)
     except Exception as e:  # noqa: BLE001
         record["serve_int8_error"] = f"{type(e).__name__}: {e}"[:200]
@@ -778,7 +833,10 @@ def main() -> None:
             [1] + list(range(3 + i, 11 + i)) * 12 for i in range(n_req)
         ]
         record["serve_spec_tok_s"] = round(
-            run_serve(speculative=True, prompts=periodic, obs_key="serve_spec_obs"), 1
+            run_serve(
+                speculative=True, prompts=periodic, obs_key="serve_spec_obs",
+                scenario="serve_spec",
+            ), 1
         )
         print(f"# bench: serve speculative {record['serve_spec_tok_s']} tok/s", flush=True)
     except Exception as e:  # noqa: BLE001
@@ -824,20 +882,23 @@ def main() -> None:
                 engine.registry.get("serve_prefill_seconds").series_snapshot()
                 or {"count": 0, "sum": 0.0}
             )
-            t0 = time.perf_counter()
-            reqs = [engine.submit(list(ids), max_new_tokens=req_new) for ids in burst_prompts]
-            while not all(r.done for r in reqs):
-                engine.tick()
-            elapsed = time.perf_counter() - t0
-            engine.tick()
-            total = sum(len(r.all_tokens(timeout=1)) for r in reqs)
+            # measured burst through loadgen (registry-windowed tok/s)
+            burst_schedule = schedule_from_prompts(
+                "serve_prefixburst", [list(ids) for ids in burst_prompts], req_new
+            )
+            burst_result = run_schedule(
+                burst_schedule, EngineTarget(engine),
+                scenario="serve_prefixburst", time_scale=0.0,
+            )
+            loadgen_results.append(burst_result)
+            burst_row = scenario_row(burst_result)
             after = engine.stats()
             prefill_after = engine.registry.get("serve_prefill_seconds").series_snapshot()
             hits = after["prefix_hits"] - before["prefix_hits"]
             admitted = after["requests_admitted"] - before["requests_admitted"]
             d_count = prefill_after["count"] - prefill_before["count"]
             d_sum = prefill_after["sum"] - prefill_before["sum"]
-            record["serve_prefixburst_tok_s"] = round(total / elapsed, 1)
+            record["serve_prefixburst_tok_s"] = burst_row["tok_s"]
             record["serve_prefixburst_hit_ratio"] = (
                 round(hits / admitted, 3) if admitted else 0.0
             )
@@ -929,27 +990,12 @@ def main() -> None:
     # tok/s and the affinity hit ratio — the fraction of keyed requests the
     # consistent-hash scheduler landed on their prefix-cache-warm replica.
     try:
-        import concurrent.futures
-
         import httpx
 
+        from prime_tpu.loadgen import HTTPTarget, NumericTokenizer
         from prime_tpu.serve.engine import ContinuousBatchingEngine, EngineBackend
         from prime_tpu.serve.fleet import serve_fleet
         from prime_tpu.serve.server import InferenceServer
-
-        class _NumTokenizer:
-            """Whitespace-number tokenizer: HTTP text round-trips to the same
-            int ids bench feeds engines directly (non-numeric template words
-            hash to stable small ids)."""
-
-            def encode(self, text, add_special_tokens=True):
-                return [
-                    int(tok) if tok.isdigit() else (sum(tok.encode()) % 97) + 3
-                    for tok in text.split()
-                ]
-
-            def decode(self, ids):
-                return " ".join(str(i) for i in ids)
 
         fleet_slots = max(2, serve_slots // 2)
         # construct INSIDE the guarded block: a failed second server or
@@ -968,51 +1014,66 @@ def main() -> None:
                 engines.append(engine)
                 servers.append(
                     InferenceServer(
-                        "bench-fleet", EngineBackend(engine, _NumTokenizer()), port=0
+                        "bench-fleet", EngineBackend(engine, NumericTokenizer()), port=0
                     ).start()
                 )
             router = serve_fleet(
                 [srv.url for srv in servers], poll_interval=0.2, model_id="bench-fleet",
             )
             pre_len = 16 if SMOKE else 64
-            preamble = " ".join(
-                str((5 * j) % (config.vocab_size - 3) + 3) for j in range(pre_len)
-            )
-            fleet_msgs = [
-                [{"role": "user", "content": preamble + " " + " ".join(
-                    str((13 * (i * 7 + j)) % (config.vocab_size - 3) + 3)
+            fleet_prompts = [
+                [(5 * j) % (config.vocab_size - 3) + 3 for j in range(pre_len)]
+                + [
+                    (13 * (i * 7 + j)) % (config.vocab_size - 3) + 3
                     for j in range(serve_prompt_len - pre_len)
-                )}]
+                ]
                 for i in range(n_req)
             ]
-
-            def fleet_post(messages, timeout=240.0):
-                response = httpx.post(
-                    f"{router.url}/v1/chat/completions",
-                    json={"messages": messages, "max_tokens": req_new, "temperature": 0.0},
-                    timeout=timeout,
-                )
-                response.raise_for_status()
-                return response.json()
+            # the measured burst goes over real HTTP through the router; the
+            # report scrapes BOTH replicas' engine registries plus the
+            # router's, so fleet tok/s aggregates server-side token counters
+            target = HTTPTarget(
+                router.url,
+                scrape_urls={
+                    "router": router.url,
+                    **{f"replica{i}": srv.url for i, srv in enumerate(servers)},
+                },
+                timeout_s=240.0,
+            )
 
             # warm each replica directly (compile prefill/decode/assemble off
             # the measured clock), then let the router's poller observe them
+            warm_body = {
+                "messages": [{"role": "user",
+                              "content": " ".join(str(t) for t in fleet_prompts[0])}],
+                "max_tokens": req_new, "temperature": 0.0,
+            }
             for srv in servers:
                 for _ in range(2):
                     httpx.post(
-                        f"{srv.url}/v1/chat/completions",
-                        json={"messages": fleet_msgs[0], "max_tokens": req_new,
-                              "temperature": 0.0},
-                        timeout=240.0,
+                        f"{srv.url}/v1/chat/completions", json=warm_body, timeout=240.0,
                     ).raise_for_status()
             time.sleep(0.5)
-            t0 = time.perf_counter()
-            with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
-                bodies = list(pool.map(fleet_post, fleet_msgs))
-            elapsed = time.perf_counter() - t0
-            total = sum(b["usage"]["completion_tokens"] for b in bodies)
+            fleet_schedule = schedule_from_prompts(
+                "serve_fleet", fleet_prompts, req_new
+            )
+            fleet_result = run_schedule(
+                fleet_schedule, target, scenario="serve_fleet", time_scale=0.0,
+                max_workers=8,
+            )
+            loadgen_results.append(fleet_result)
+            fleet_row = scenario_row(fleet_result)
             stats = router.stats()
-            record["serve_fleet_tok_s"] = round(total / elapsed, 1)
+            record["serve_fleet_tok_s"] = fleet_row["tok_s"]
+            # the old fleet_post raise_for_status aborted the section on any
+            # failed request; loadgen folds failures into outcomes instead —
+            # surface them at record level so a half-dead fleet's survivor
+            # throughput can never read as a healthy number
+            if fleet_result.outcomes.get("failed"):
+                record["serve_fleet_error"] = (
+                    f"{fleet_result.outcomes['failed']} of {len(fleet_schedule)} "
+                    "requests failed; tok_s covers survivors only"
+                )
             record["serve_fleet_affinity_ratio"] = stats["affinity_hit_ratio"]
             record["serve_fleet_reroutes"] = stats["reroutes"]
             # placement split: requests landed by advertised cached prefix
@@ -1046,6 +1107,29 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         record["serve_fleet_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: serve fleet section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
+
+    # ---- loadgen SLO report over every serve section ------------------------
+    # the schema-2 artifact: one row per driven scenario (serve, int8, spec,
+    # prefixburst, fleet) with registry-derived tok/s, TTFT/TPOT p50/p95,
+    # overlap and hit ratios — what scripts/perf_delta.py flattens into the
+    # per-PR trajectory and scripts/serve_profile.py --slo merges with traces
+    try:
+        if loadgen_results:
+            record["loadgen"] = build_report(
+                loadgen_results,
+                meta={"backend": record.get("backend", "unknown")},
+            )
+            headline = record["loadgen"]["headline"]
+            print(
+                f"# bench: loadgen SLO report — {len(loadgen_results)} scenarios, "
+                f"aggregate {headline['tok_s']} tok/s over "
+                f"{headline['requests']} requests",
+                flush=True,
+            )
+    except Exception as e:  # noqa: BLE001
+        record["loadgen_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: loadgen report assembly failed: {e}", flush=True)
     print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
     # ---- serve fleet: cache-aware vs blind routing (deterministic sim) ------

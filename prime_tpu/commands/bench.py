@@ -1,0 +1,62 @@
+"""`prime bench` — the perf trajectory and the loadgen harness from the CLI.
+
+`delta` renders the committed BENCH_*.json rounds into the per-PR table
+(stdlib-only — safe on machines without jax); `smoke` runs the CPU loadgen
+fleet smoke and writes its SLO report + BENCH-schema record (docs/
+benchmarking.md). The real TPU bench stays `python bench.py` — it manages
+accelerator preflight and stray-process sweeps no CLI should hide.
+"""
+
+from __future__ import annotations
+
+import json
+
+import click
+
+
+@click.group(name="bench")
+def bench_group() -> None:
+    """Benchmark trajectory tools (see docs/benchmarking.md)."""
+
+
+@bench_group.command("delta")
+@click.option("--root", default=".", help="Directory holding BENCH_*.json.")
+@click.option("--pattern", default="BENCH_*.json", help="Round file glob.")
+@click.option("--output", "as_json", is_flag=False, flag_value="json", default=None,
+              help="Set to 'json' for machine-readable output.")
+@click.option("--min-rounds", type=int, default=2,
+              help="Exit nonzero below this many parseable rounds.")
+def bench_delta(root: str, pattern: str, as_json: str | None, min_rounds: int) -> None:
+    """Render the per-PR perf delta table across committed bench rounds."""
+    from prime_tpu.loadgen.perf_delta import delta_json, delta_table, load_rounds
+
+    rounds = load_rounds(root, pattern)
+    if as_json == "json":
+        click.echo(json.dumps(delta_json(rounds), indent=2))
+    else:
+        click.echo(delta_table(rounds, min_rounds=min_rounds))
+    if len(rounds) < min_rounds:
+        raise SystemExit(1)
+
+
+@bench_group.command("smoke")
+@click.option("--output", default="loadgen-smoke", help="Artifact directory.")
+@click.option("--scenario", default="smoke",
+              help="Loadgen scenario name (prime_tpu.loadgen.SCENARIOS).")
+@click.option("--seed", type=int, default=None,
+              help="Schedule seed. Default: 0 (PRIME_LOADGEN_SEED).")
+@click.option("--replicas", type=int, default=2, help="In-process fleet size.")
+@click.option("--time-scale", type=float, default=1.0,
+              help="Multiplier on scheduled arrival/cancel offsets.")
+def bench_smoke(
+    output: str, scenario: str, seed: int | None, replicas: int, time_scale: float
+) -> None:
+    """Run the CPU loadgen fleet smoke end to end (no TPU required)."""
+    from prime_tpu.loadgen.smoke import run_smoke
+
+    outcome = run_smoke(
+        output, scenario=scenario, seed=seed, replicas=replicas,
+        time_scale=time_scale, log=click.echo,
+    )
+    if not outcome["ok"]:
+        raise SystemExit(1)

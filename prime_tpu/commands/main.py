@@ -29,6 +29,7 @@ _LAZY_COMMANDS: dict[str, tuple[str, str]] = {
     "registry": ("prime_tpu.commands.images", "registry_group"),
     "inference": ("prime_tpu.commands.inference", "inference_group"),
     "serve": ("prime_tpu.commands.serve", "serve_cmd"),
+    "bench": ("prime_tpu.commands.bench", "bench_group"),
     # Lab
     "env": ("prime_tpu.commands.env", "env_group"),
     "eval": ("prime_tpu.commands.evals", "eval_group"),

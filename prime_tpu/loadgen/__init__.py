@@ -1,0 +1,60 @@
+"""Deterministic load generation + SLO observatory (docs/benchmarking.md).
+
+The harness that makes the perf trajectory real (ROADMAP Open item 5): a
+seeded scenario DSL expands into byte-identical request schedules, backend
+adapters drive them against an in-process engine or any OpenAI-compatible
+HTTP surface (single server, fleet router — including ``JAX_PLATFORMS=cpu``
+in CI), and the SLO report derives every number from the obs registry
+snapshots and flight-recorder timelines the serving stack already keeps —
+never from client stopwatches. Recorded runs replay via
+:mod:`prime_tpu.loadgen.replay`; committed rounds diff via
+:mod:`prime_tpu.loadgen.perf_delta`.
+
+Import surface is lazy where it matters: the scenario/report/perf_delta
+layers are stdlib-only (the CLI imports them without jax); the backends
+pull httpx/engine modules only when constructed.
+"""
+
+from prime_tpu.loadgen.backends import (
+    EngineTarget,
+    HTTPTarget,
+    NumericTokenizer,
+    prompt_text,
+)
+from prime_tpu.loadgen.perf_delta import delta_json, delta_table, load_rounds
+from prime_tpu.loadgen.replay import schedule_from_flight, schedule_from_trace
+from prime_tpu.loadgen.report import SLO_SCHEMA, build_report, scenario_row
+from prime_tpu.loadgen.runner import RunResult, run_schedule
+from prime_tpu.loadgen.scenario import (
+    SCENARIOS,
+    Phase,
+    PlannedRequest,
+    Scenario,
+    build_schedule,
+    schedule_digest,
+    schedule_from_prompts,
+)
+
+__all__ = [
+    "SCENARIOS",
+    "SLO_SCHEMA",
+    "EngineTarget",
+    "HTTPTarget",
+    "NumericTokenizer",
+    "Phase",
+    "PlannedRequest",
+    "RunResult",
+    "Scenario",
+    "build_report",
+    "build_schedule",
+    "delta_json",
+    "delta_table",
+    "load_rounds",
+    "prompt_text",
+    "run_schedule",
+    "scenario_row",
+    "schedule_digest",
+    "schedule_from_flight",
+    "schedule_from_prompts",
+    "schedule_from_trace",
+]

@@ -1,0 +1,225 @@
+"""Per-PR perf trajectory: diff committed BENCH_*.json rounds into a table.
+
+Every bench round commits one JSON record (bench.py, last-JSON-line-wins).
+This module — stdlib-only, importable by both ``scripts/perf_delta.py`` and
+``prime bench delta`` — loads every committed round, labels each with its
+record schema (schema 1: the pre-loadgen rounds, headline-only fields;
+schema 2: adds the loadgen SLO report under ``loadgen``), and renders the
+metric-by-round delta table that answers the only question a perf PR has to
+answer: which headline moved, by how much, since the previous round.
+
+Zero-valued headlines are real data (five rounds of ``0.0 tok/s — backend
+unresponsive`` ARE the trajectory this tooling exists to end) and render as
+written; deltas are computed against the latest previous round with a
+usable value so one dead round doesn't blind the comparison.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any
+
+# record keys → table rows, in display order. Ratios render raw; everything
+# else is a rate where bigger is better.
+HEADLINE_METRICS: tuple[tuple[str, str], ...] = (
+    ("headline tok/s", "value"),
+    ("decode-only tok/s", "decode_only_tok_s"),
+    ("eval samples/s", "eval_samples_per_sec"),
+    ("serve tok/s", "serve_tok_s"),
+    ("serve overlap ratio", "serve_overlap_ratio"),
+    ("serve int8 tok/s", "serve_int8_tok_s"),
+    ("prefixburst tok/s", "serve_prefixburst_tok_s"),
+    ("prefixburst hit ratio", "serve_prefixburst_hit_ratio"),
+    ("fleet tok/s", "serve_fleet_tok_s"),
+    ("fleet affinity ratio", "serve_fleet_affinity_ratio"),
+    ("int8 tok/s", "int8_weights_tok_s"),
+    ("int4 tok/s", "int4_weights_tok_s"),
+    ("longctx pallas speedup", "longctx_pallas_speedup"),
+    ("trainstep tok/s", "trainstep_tok_s"),
+)
+
+_ROUND_RE = re.compile(r"BENCH_(?:(?P<kind>[a-z_]+)_)?r(?P<num>\d+)\.json$")
+
+
+@dataclass
+class Round:
+    label: str
+    path: str
+    order: tuple
+    schema: int
+    record: dict[str, Any]
+    metrics: dict[str, float] = field(default_factory=dict)
+
+    @property
+    def error(self) -> str | None:
+        return self.record.get("error")
+
+
+def _slo_metrics(report: dict) -> dict[str, float]:
+    """Flatten a loadgen SLO report (schema 2 records carry one under
+    ``loadgen``) into table rows: the aggregate headline plus per-scenario
+    throughput and TTFT p50/p95."""
+    out: dict[str, float] = {}
+    headline = report.get("headline") or {}
+    if isinstance(headline.get("tok_s"), (int, float)):
+        out["loadgen tok/s"] = float(headline["tok_s"])
+    for row in report.get("scenarios") or []:
+        # "slo:" prefix keeps SLO-row names disjoint from HEADLINE_METRICS
+        # labels — a scenario named "serve" must not silently overwrite the
+        # record-field "serve tok/s" cell (different rounding, different
+        # sourcing era)
+        name = f"slo:{row.get('scenario', '?')}"
+        if isinstance(row.get("tok_s"), (int, float)):
+            out[f"{name} tok/s"] = float(row["tok_s"])
+        for family, unit in (("ttft_s", "ttft"), ("tpot_s", "tpot")):
+            quantiles = row.get(family) or {}
+            for q in ("p50", "p95"):
+                value = quantiles.get(q)
+                if isinstance(value, (int, float)):
+                    out[f"{name} {unit} {q} ms"] = round(value * 1e3, 3)
+    return out
+
+
+def _round_from_record(path: str, record: dict[str, Any]) -> Round:
+    m = _ROUND_RE.search(os.path.basename(path))
+    kind = (m.group("kind") if m else None) or ""
+    # no r<N> in the name: sort AFTER every numbered round (it must never
+    # become r01's delta baseline) and label it by its filename stem
+    num = int(m.group("num")) if m else None
+    # the driver wraps each round's bench record: {"n", "cmd", "rc", "tail",
+    # "parsed": <last JSON line or null>}. Unwrap it; a null parse (the
+    # round-3 mid-preflight kill) becomes an explicit error record rather
+    # than a skipped round — a dead round is part of the trajectory.
+    if "parsed" in record and "rc" in record:
+        num = int(record.get("n") or num or 0)
+        parsed = record["parsed"]
+        if isinstance(parsed, dict):
+            record = parsed
+        else:
+            record = {
+                "value": 0.0,
+                "error": f"record unparseable (driver rc={record.get('rc')})",
+            }
+    if num is None:
+        label = os.path.basename(path)[: -len(".json")]
+        order: tuple = (float("inf"), label)
+    else:
+        label = f"r{num:02d}" + (f"-{kind}" if kind else "")
+        order = (num, kind)
+    # schema 1: every round before the loadgen era (no "schema" key). The
+    # labeling here is what lets a delta across nine historical rounds parse
+    # without guessing which fields can exist.
+    schema = int(record.get("schema", 1))
+    metrics: dict[str, float] = {}
+    for row_label, key in HEADLINE_METRICS:
+        value = record.get(key)
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            if key == "value" and not str(
+                record.get("metric", "decode_tokens_per_sec")
+            ).startswith("decode_tokens_per_sec"):
+                # a CPU loadgen smoke's headline is not the TPU decode
+                # headline — same row would render a nonsense cross-backend
+                # delta; give it its own trajectory row
+                row_label = "cpu-smoke tok/s"
+            metrics[row_label] = float(value)
+    if schema >= 2 and isinstance(record.get("loadgen"), dict):
+        metrics.update(_slo_metrics(record["loadgen"]))
+    # opportunistic/secondary records sort after the driver record of the
+    # same round number
+    return Round(
+        label=label, path=path, order=order, schema=schema,
+        record=record, metrics=metrics,
+    )
+
+
+def load_rounds(
+    root: str = ".", pattern: str = "BENCH_*.json"
+) -> list[Round]:
+    """Every parseable committed round under ``root``, oldest first.
+    Unparseable files are skipped (a half-written record must not take the
+    delta table down); files without a BENCH_r<N> name sort last by name."""
+    rounds: list[Round] = []
+    for path in sorted(glob.glob(os.path.join(root, pattern))):
+        try:
+            with open(path) as f:
+                record = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(record, dict):
+            rounds.append(_round_from_record(path, record))
+    rounds.sort(key=lambda r: (r.order, r.label))
+    return rounds
+
+
+def _fmt(value: float) -> str:
+    if value == int(value) and abs(value) >= 100:
+        return str(int(value))
+    return f"{value:.3g}" if abs(value) < 10 else f"{value:.1f}"
+
+
+def delta_table(rounds: list[Round], *, min_rounds: int = 2) -> str:
+    """Render the metric-by-round table with per-round deltas vs the latest
+    previous round that measured the same metric (Δ% for rates/ratios)."""
+    if len(rounds) < min_rounds:
+        return (
+            f"need at least {min_rounds} BENCH_*.json rounds for a delta "
+            f"table; found {len(rounds)}"
+        )
+    metric_names: list[str] = []
+    for r in rounds:
+        for name in r.metrics:
+            if name not in metric_names:
+                metric_names.append(name)
+    if not metric_names:
+        return "no numeric metrics found in any round"
+    label_w = max(len(n) for n in metric_names) + 2
+    headers = [
+        r.label + (f" (s{r.schema})" if r.schema == 1 else "") for r in rounds
+    ]
+    col_w = max(16, max(len(h) for h in headers) + 2)
+    lines = ["".join([" " * label_w] + [f"{h:>{col_w}}" for h in headers])]
+    for name in metric_names:
+        cells = [f"{name:<{label_w}}"]
+        prev: float | None = None
+        for r in rounds:
+            value = r.metrics.get(name)
+            if value is None:
+                cells.append(f"{'—':>{col_w}}")
+                continue
+            cell = _fmt(value)
+            if prev not in (None, 0.0):
+                pct = (value - prev) / prev * 100.0
+                cell += f" ({pct:+.0f}%)"
+            elif prev == 0.0 and value > 0:
+                cell += " (∅→live)"
+            cells.append(f"{cell:>{col_w}}")
+            prev = value
+        lines.append("".join(cells))
+    notes = [
+        f"{r.label}: {r.error}" for r in rounds if r.error
+    ]
+    if notes:
+        lines.append("")
+        lines.append("round errors:")
+        lines.extend(f"  {n}" for n in notes)
+    return "\n".join(lines)
+
+
+def delta_json(rounds: list[Round]) -> dict[str, Any]:
+    """Machine form of the same table (CI step summaries, dashboards)."""
+    return {
+        "rounds": [
+            {
+                "label": r.label,
+                "path": os.path.basename(r.path),
+                "schema": r.schema,
+                "error": r.error,
+                "metrics": r.metrics,
+            }
+            for r in rounds
+        ]
+    }

@@ -1,0 +1,177 @@
+"""Trace replay: re-drive request timelines the obs spine already recorded.
+
+Two recorded forms reconstruct a schedule:
+
+- **Flight recorder** (``GET /debug/requests`` on a server or router, or
+  ``FlightRecorder.summaries()`` in process): each summary carries
+  ``start_unix_s``, ``duration_s``, ``outcome``, and the admission metadata
+  (``prompt_tokens``, ``max_new_tokens``) the engine stamped at submit.
+- **PRIME_TRACE JSONL**: every retirement emits a ``serve.request`` span
+  whose start IS the submit time (duration = submit → retire), and the
+  ``serve.prefill`` span for the same request carries ``prompt_len``.
+
+Either way the reconstruction pins what a replay needs to reproduce load:
+arrival order and relative offsets, per-request prompt sizes, decode
+budgets, and cancel points (a ``cancelled`` timeline cancels at its
+recorded duration). Prompt *content* is synthesized deterministically from
+``seed`` — the recorders keep token counts, not tokens, by design (prompt
+text in a debug endpoint would be a data leak) — so a replayed schedule is
+shape-faithful and byte-reproducible, not content-identical.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from typing import Any, Iterable
+
+from prime_tpu.loadgen.scenario import RESERVED_IDS, PlannedRequest
+
+DEFAULT_PROMPT_TOKENS = 32
+DEFAULT_MAX_NEW_TOKENS = 16
+
+
+def _synth_prompt(seed: int, index: int, n_tokens: int, vocab: int) -> tuple[int, ...]:
+    # int mix, not a tuple seed: tuple seeding is deprecated and hash-based
+    rng = random.Random(seed * 1_000_003 + index * 8191 + n_tokens)
+    n_tokens = max(1, n_tokens)
+    return (1,) + tuple(
+        rng.randrange(RESERVED_IDS, vocab) for _ in range(n_tokens - 1)
+    )
+
+
+def _timelines_from_flight(payload: Any) -> list[dict]:
+    """Accept the several shapes the debug surfaces produce: a raw summary
+    list, a ``{"inflight": [...], "recent": [...]}`` dict, or the router's
+    ``{"router": {...}}`` wrapper. Completed timelines only — an in-flight
+    request has no outcome to replay yet."""
+    if isinstance(payload, dict) and "router" in payload and isinstance(payload["router"], dict):
+        payload = payload["router"]
+    if isinstance(payload, dict):
+        # "recent" only: an in-flight timeline has no outcome to replay yet
+        # (the state filter below is a guard for caller-provided lists)
+        entries = list(payload.get("recent", []))
+    else:
+        entries = list(payload)
+    return [
+        t for t in entries
+        if isinstance(t, dict) and t.get("start_unix_s") is not None
+        and t.get("state") != "inflight"
+    ]
+
+
+def schedule_from_flight(
+    payload: Any,
+    *,
+    seed: int = 0,
+    vocab: int = 1000,
+    max_prompt_tokens: int | None = None,
+) -> list[PlannedRequest]:
+    """Rebuild a schedule from flight-recorder summaries. Ordering follows
+    recorded submit times (``start_unix_s``), offsets are relative to the
+    earliest; ``max_prompt_tokens`` clamps outlier prompts so a replay fits
+    a smaller engine's slot capacity."""
+    timelines = _timelines_from_flight(payload)
+    if not timelines:
+        return []
+    timelines.sort(key=lambda t: (t["start_unix_s"], str(t.get("id"))))
+    t0 = timelines[0]["start_unix_s"]
+    out: list[PlannedRequest] = []
+    for index, timeline in enumerate(timelines):
+        arrival = round(float(timeline["start_unix_s"]) - t0, 6)
+        n_prompt = int(timeline.get("prompt_tokens") or DEFAULT_PROMPT_TOKENS)
+        if max_prompt_tokens is not None:
+            n_prompt = min(n_prompt, max_prompt_tokens)
+        cancel = None
+        if timeline.get("outcome") == "cancelled":
+            cancel = round(arrival + float(timeline.get("duration_s") or 0.0), 6)
+        out.append(
+            PlannedRequest(
+                index=index,
+                tenant=f"replay-{timeline.get('trace_id') or timeline.get('id')}",
+                arrival_s=arrival,
+                prompt_ids=_synth_prompt(seed, index, n_prompt, vocab),
+                max_new_tokens=int(
+                    timeline.get("max_new_tokens") or DEFAULT_MAX_NEW_TOKENS
+                ),
+                cancel_after_s=cancel,
+            )
+        )
+    return out
+
+
+def _iter_spans(path: str) -> Iterable[dict]:
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                span = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(span, dict) and "name" in span:
+                yield span
+
+
+def schedule_from_trace(
+    paths: str | list[str],
+    *,
+    seed: int = 0,
+    vocab: int = 1000,
+    max_prompt_tokens: int | None = None,
+) -> list[PlannedRequest]:
+    """Rebuild a schedule from PRIME_TRACE JSONL file(s). ``serve.request``
+    spans define the request set and timing (their start is the submit
+    time); ``serve.prefill`` spans sharing the request id + trace id supply
+    prompt lengths. Multiple files (router + replicas) merge naturally —
+    only the engine-side span names matter here."""
+    if isinstance(paths, str):
+        paths = [paths]
+    requests: list[dict] = []
+    prompt_lens: dict[tuple[str | None, Any], int] = {}
+    for path in paths:
+        for span in _iter_spans(path):
+            attrs = span.get("attrs") or {}
+            key = (span.get("trace_id"), attrs.get("request"))
+            if span["name"] == "serve.request":
+                submit_unix = float(span.get("start_unix_s") or 0.0)
+                requests.append(
+                    {
+                        "key": key,
+                        "submit_unix_s": submit_unix,
+                        "duration_s": float(span.get("duration_s") or 0.0),
+                        "outcome": attrs.get("outcome"),
+                        "tokens": int(attrs.get("tokens") or 0),
+                    }
+                )
+            elif span["name"] == "serve.prefill" and attrs.get("prompt_len"):
+                prompt_lens[key] = int(attrs["prompt_len"])
+    if not requests:
+        return []
+    requests.sort(key=lambda r: (r["submit_unix_s"], str(r["key"])))
+    t0 = requests[0]["submit_unix_s"]
+    out: list[PlannedRequest] = []
+    for index, rec in enumerate(requests):
+        arrival = round(rec["submit_unix_s"] - t0, 6)
+        n_prompt = prompt_lens.get(rec["key"], DEFAULT_PROMPT_TOKENS)
+        if max_prompt_tokens is not None:
+            n_prompt = min(n_prompt, max_prompt_tokens)
+        cancel = None
+        if rec["outcome"] == "cancelled":
+            cancel = round(arrival + rec["duration_s"], 6)
+        out.append(
+            PlannedRequest(
+                index=index,
+                tenant=f"replay-{rec['key'][0] or index}",
+                arrival_s=arrival,
+                prompt_ids=_synth_prompt(seed, index, n_prompt, vocab),
+                # the recorded emission is the floor for the decode budget:
+                # a completed request decoded exactly its `tokens`, so replay
+                # asks for that many (cancelled ones keep their recorded cap
+                # semantics via the cancel point)
+                max_new_tokens=max(1, rec["tokens"]) if rec["tokens"] else DEFAULT_MAX_NEW_TOKENS,
+                cancel_after_s=cancel,
+            )
+        )
+    return out

@@ -1,0 +1,341 @@
+"""Versioned SLO report assembled from registry snapshot deltas.
+
+The report's claim to honesty: every latency/throughput number is computed
+from the *serving stack's own* observability — deltas between the registry
+snapshots the runner took before and after the run (each stamped with the
+monotonic ``captured_at`` that :meth:`Registry.snapshot` embeds, so the
+throughput denominator is the same process's clock that counted the
+tokens), with percentiles interpolated from obs ``Histogram`` buckets via
+:func:`quantile_from_snapshot`. The client contributes only what no server
+registry can know: outcome counts (a 429-rejected request never reaches an
+engine histogram) and the schedule digest that pins what was asked.
+
+Schema: ``slo_schema`` versions the report; a consumer seeing a bigger
+number than it knows should fail loud, not guess. Field catalog in
+docs/benchmarking.md.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Iterable
+
+from prime_tpu.obs.metrics import quantile_from_snapshot
+
+SLO_SCHEMA = 1
+
+
+def _captured_at(snapshot: dict) -> float | None:
+    family = snapshot.get("captured_at")
+    if not isinstance(family, dict):
+        return None
+    series = family.get("series") or []
+    return float(series[0]["value"]) if series else None
+
+
+def _family(snapshot: dict, name: str) -> dict | None:
+    family = snapshot.get(name)
+    return family if isinstance(family, dict) else None
+
+
+def _scalar(snapshot: dict, name: str, labels: dict | None = None) -> float:
+    """A counter/gauge series value (0.0 when absent)."""
+    family = _family(snapshot, name)
+    if family is None:
+        return 0.0
+    want = labels or {}
+    for series in family.get("series", []):
+        if series.get("labels", {}) == want:
+            return float(series.get("value", 0.0))
+    return 0.0
+
+
+def _scalar_sum(snapshot: dict, name: str, **fixed: str) -> float:
+    """Sum of every series of a labeled counter matching ``fixed``."""
+    family = _family(snapshot, name)
+    if family is None:
+        return 0.0
+    total = 0.0
+    for series in family.get("series", []):
+        labels = series.get("labels", {})
+        if all(labels.get(k) == v for k, v in fixed.items()):
+            total += float(series.get("value", 0.0))
+    return total
+
+
+def _labeled_values(snapshot: dict, name: str, label: str) -> dict[str, float]:
+    family = _family(snapshot, name)
+    out: dict[str, float] = {}
+    if family is None:
+        return out
+    for series in family.get("series", []):
+        key = series.get("labels", {}).get(label)
+        if key is not None:
+            out[key] = out.get(key, 0.0) + float(series.get("value", 0.0))
+    return out
+
+
+def _hist_series(snapshot: dict, name: str, labels: dict | None = None) -> dict | None:
+    family = _family(snapshot, name)
+    if family is None:
+        return None
+    want = labels or {}
+    for series in family.get("series", []):
+        if series.get("labels", {}) == want:
+            return series
+    return None
+
+
+def _hist_delta(before: dict | None, after: dict | None) -> dict | None:
+    """after − before for one histogram series (same bucket layout)."""
+    if after is None:
+        return None
+    if before is None:
+        return {
+            "buckets": list(after["buckets"]),
+            "counts": list(after["counts"]),
+            "sum": after["sum"],
+            "count": after["count"],
+        }
+    return {
+        "buckets": list(after["buckets"]),
+        "counts": [a - b for a, b in zip(after["counts"], before["counts"])],
+        "sum": after["sum"] - before["sum"],
+        "count": after["count"] - before["count"],
+    }
+
+
+def _merge_hists(deltas: Iterable[dict | None]) -> dict | None:
+    """Pointwise sum of same-layout histogram deltas across components."""
+    merged: dict | None = None
+    for delta in deltas:
+        if delta is None:
+            continue
+        if merged is None:
+            merged = {
+                "buckets": list(delta["buckets"]),
+                "counts": list(delta["counts"]),
+                "sum": delta["sum"],
+                "count": delta["count"],
+            }
+        elif merged["buckets"] == delta["buckets"]:
+            merged["counts"] = [
+                a + b for a, b in zip(merged["counts"], delta["counts"])
+            ]
+            merged["sum"] += delta["sum"]
+            merged["count"] += delta["count"]
+    return merged
+
+
+def _quantiles(hist: dict | None, qs: tuple[float, ...] = (0.5, 0.95)) -> dict[str, float | None]:
+    out: dict[str, float | None] = {}
+    for q in qs:
+        key = f"p{int(q * 100)}"
+        if hist is None or hist["count"] <= 0:
+            out[key] = None
+        else:
+            value = quantile_from_snapshot(hist["buckets"], hist["counts"], q)
+            out[key] = None if math.isnan(value) else round(value, 6)
+    return out
+
+
+def snapshot_delta_seconds(before: dict, after: dict) -> float | None:
+    """Wall seconds between two snapshots of the SAME registry, from the
+    embedded monotonic ``captured_at`` — the report's only throughput
+    denominator (never a client stopwatch)."""
+    b, a = _captured_at(before), _captured_at(after)
+    if b is None or a is None:
+        return None
+    return max(0.0, a - b)
+
+
+def _engine_components(snapshots: dict[str, dict]) -> list[str]:
+    """Components holding engine registries: the in-process ``engine`` key
+    or any HTTP-scraped ``<label>.engine`` section."""
+    return [
+        name
+        for name in snapshots
+        if name == "engine" or name.endswith(".engine")
+    ]
+
+
+def _router_components(snapshots: dict[str, dict]) -> list[str]:
+    return [
+        name
+        for name in snapshots
+        if name == "router" or name.endswith(".router")
+    ]
+
+
+def scenario_row(result) -> dict[str, Any]:
+    """One scenario's SLO row from a :class:`RunResult`'s snapshot pair."""
+    before, after = result.before, result.after
+    engines = _engine_components(after)
+    routers = _router_components(after)
+    warnings: list[str] = []
+    if not engines:
+        # loud, not a silent 0.0: a router-only scrape has no token counters
+        # or latency histograms to window — the caller forgot the replica
+        # URLs in HTTPTarget(scrape_urls=...), and a zero here would be
+        # indistinguishable from the dead-backend trajectory
+        warnings.append(
+            "no engine registries in the scrape (pass replica URLs via "
+            "HTTPTarget scrape_urls) — tok_s/latency fields are undefined"
+        )
+    if getattr(result, "timed_out", False):
+        warnings.append(
+            "run hit its deadline and was truncated — numbers cover only "
+            "the completed portion of the schedule"
+        )
+
+    durations = [
+        snapshot_delta_seconds(before.get(name, {}), after[name])
+        for name in engines
+        if name in before
+    ]
+    durations = [d for d in durations if d]
+    duration_s = max(durations) if durations else None
+    if engines and duration_s is None:
+        warnings.append(
+            "engine snapshots carry no captured_at window (pre-schema "
+            "registry?) — tok_s is undefined, not zero"
+        )
+    if result.outcomes.get("failed", 0):
+        warnings.append(
+            f"{result.outcomes['failed']} request(s) FAILED client-side — "
+            "throughput covers only the survivors"
+        )
+
+    def edelta(metric: str, labels: dict | None = None) -> float:
+        return sum(
+            _scalar(after[name], metric, labels)
+            - _scalar(before.get(name, {}), metric, labels)
+            for name in engines
+        )
+
+    def ehist(metric: str, labels: dict | None = None) -> dict | None:
+        return _merge_hists(
+            _hist_delta(
+                _hist_series(before.get(name, {}), metric, labels),
+                _hist_series(after[name], metric, labels),
+            )
+            for name in engines
+        )
+
+    tokens = edelta("serve_tokens_emitted_total")
+    admitted = edelta("serve_requests_admitted_total")
+    hits = edelta("serve_prefix_hits_total")
+    stall = edelta("serve_host_stall_seconds_total")
+    window = edelta("serve_chunk_window_seconds_total")
+
+    row: dict[str, Any] = {
+        "scenario": result.scenario,
+        "seed": result.seed,
+        "schedule_digest": result.digest,
+        "requests": result.requests,
+        "outcomes": dict(result.outcomes),
+        "client_tokens": result.client_tokens,
+        "duration_s": round(duration_s, 6) if duration_s else None,
+        "tokens": int(tokens),
+        "tok_s": round(tokens / duration_s, 2) if duration_s else 0.0,
+        "admitted": int(admitted),
+        "completed": int(edelta("serve_requests_completed_total")),
+        "cancelled": int(edelta("serve_requests_cancelled_total")),
+        "failed": int(edelta("serve_requests_failed_total")),
+        "overlap_ratio": (
+            round(max(0.0, min(1.0, 1.0 - stall / window)), 4) if window > 0 else None
+        ),
+        "prefix_hit_ratio": round(hits / admitted, 4) if admitted else None,
+        "prefix_hit_tokens": {
+            tier: int(
+                sum(
+                    (_hist_series(after[name], "serve_prefix_hit_tokens", {"tier": tier}) or {"sum": 0.0})["sum"]
+                    - (_hist_series(before.get(name, {}), "serve_prefix_hit_tokens", {"tier": tier}) or {"sum": 0.0})["sum"]
+                    for name in engines
+                )
+            )
+            for tier in ("device", "host")
+        },
+        "prefix_spills": int(edelta("serve_prefix_spills_total")),
+        "prefix_reuploads": int(edelta("serve_prefix_reuploads_total")),
+        "wasted_decode_tokens": int(edelta("serve_wasted_decode_tokens_total")),
+        "ttft_s": _quantiles(ehist("serve_ttft_seconds")),
+        "tpot_s": _quantiles(ehist("serve_tpot_seconds")),
+        "queue_wait_s": _quantiles(ehist("serve_queue_wait_seconds")),
+        "rejected_429": int(result.outcomes.get("rejected_429", 0)),
+    }
+    if warnings:
+        row["warning"] = "; ".join(warnings)
+
+    if routers:
+        def rdelta(metric: str, **fixed: str) -> float:
+            return sum(
+                _scalar_sum(after[name], metric, **fixed)
+                - _scalar_sum(before.get(name, {}), metric, **fixed)
+                for name in routers
+            )
+
+        affinity_requests = rdelta("fleet_affinity_requests_total")
+        affinity_hits = rdelta("fleet_affinity_hits_total")
+        reroutes: dict[str, float] = {}
+        for name in routers:
+            for reason, value in _labeled_values(
+                after[name], "fleet_reroutes_total", "reason"
+            ).items():
+                prev = _labeled_values(
+                    before.get(name, {}), "fleet_reroutes_total", "reason"
+                ).get(reason, 0.0)
+                reroutes[reason] = reroutes.get(reason, 0.0) + value - prev
+        # per-replica split as a WINDOWED delta, like every other field in
+        # the row — a long-lived router's lifetime totals must not be
+        # misattributed to this scenario
+        by_replica: dict[str, float] = {}
+        for name in routers:
+            prev = _labeled_values(
+                before.get(name, {}), "fleet_requests_total", "replica"
+            )
+            for replica, value in _labeled_values(
+                after[name], "fleet_requests_total", "replica"
+            ).items():
+                by_replica[replica] = (
+                    by_replica.get(replica, 0.0) + value - prev.get(replica, 0.0)
+                )
+        row["fleet"] = {
+            "affinity_ratio": (
+                round(affinity_hits / affinity_requests, 4)
+                if affinity_requests
+                else None
+            ),
+            "cache_routed": int(rdelta("fleet_cache_routed_total")),
+            "reroutes": {k: int(v) for k, v in reroutes.items() if v},
+            "admission_rejected": int(rdelta("fleet_admission_rejected_total")),
+            "requests_by_replica": {
+                replica: int(value) for replica, value in by_replica.items() if value
+            },
+        }
+    return row
+
+
+def build_report(results, *, meta: dict | None = None) -> dict[str, Any]:
+    """The versioned SLO report: one row per scenario plus the aggregate
+    headline. ``meta`` merges into the top level (backend identity, git
+    rev, CI round)."""
+    if not isinstance(results, (list, tuple)):
+        results = [results]
+    rows = [scenario_row(r) for r in results]
+    total_tokens = sum(r["tokens"] for r in rows)
+    total_duration = sum(r["duration_s"] or 0.0 for r in rows)
+    report: dict[str, Any] = {
+        "slo_schema": SLO_SCHEMA,
+        "scenarios": rows,
+        "headline": {
+            "tok_s": round(total_tokens / total_duration, 2) if total_duration else 0.0,
+            "tokens": int(total_tokens),
+            "duration_s": round(total_duration, 6),
+            "requests": sum(r["requests"] for r in rows),
+            "rejected_429": sum(r["rejected_429"] for r in rows),
+        },
+    }
+    if meta:
+        report.update(meta)
+    return report

@@ -1,0 +1,202 @@
+"""Drive a schedule against a target and capture the evidence.
+
+The runner is deliberately thin: release each :class:`PlannedRequest` at its
+(time-scaled) arrival offset, apply its cancel point, record the
+client-observed outcome — and bracket the whole run with registry snapshots
+and a flight-recorder scrape. Everything quantitative in the SLO report
+comes from those brackets (:mod:`prime_tpu.loadgen.report`), not from
+anything timed here; the only client-side numbers kept are outcome counts,
+which no server-side registry can know (a rejected request never reaches
+an engine histogram).
+
+Two drive modes, chosen by the target:
+
+- ``EngineTarget`` → single-threaded tick loop (the runner owns the engine
+  clock), fully deterministic given a schedule — the mode tests and bench
+  sections use.
+- ``HTTPTarget`` → a worker pool issuing real HTTP at the scheduled
+  arrival times; server-side interleaving varies run to run, which is
+  precisely why the report reads the registry.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import Counter
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from prime_tpu.loadgen.backends import (
+    OUTCOME_CANCELLED,
+    OUTCOME_COMPLETED,
+    OUTCOME_FAILED,
+    OUTCOME_REJECTED,
+    EngineTarget,
+    HTTPTarget,
+)
+from prime_tpu.loadgen.scenario import PlannedRequest, schedule_digest
+from prime_tpu.serve.errors import DrainingError, QueueFullError
+
+
+@dataclass
+class RunResult:
+    """One scenario run's raw evidence, handed to ``build_report``."""
+
+    scenario: str
+    seed: int
+    digest: str
+    requests: int
+    outcomes: Counter = field(default_factory=Counter)
+    client_tokens: int = 0
+    before: dict[str, dict] = field(default_factory=dict)  # component -> snapshot
+    after: dict[str, dict] = field(default_factory=dict)
+    flight: dict = field(default_factory=dict)
+    time_scale: float = 1.0
+    # the engine drive hit deadline_s and abandoned work: the run's numbers
+    # cover a TRUNCATED window (scenario_row surfaces this as a warning)
+    timed_out: bool = False
+
+
+def run_schedule(
+    schedule: list[PlannedRequest],
+    target,
+    *,
+    scenario: str = "adhoc",
+    seed: int = 0,
+    time_scale: float = 1.0,
+    max_workers: int = 8,
+    deadline_s: float = 600.0,
+) -> RunResult:
+    """Run ``schedule`` against ``target`` and return the bracketed
+    evidence. ``time_scale`` multiplies every arrival/cancel offset (0 =
+    fire everything immediately); outcomes are counted client-side, all
+    latency/throughput evidence is the before/after snapshot pair.
+
+    ``time_scale`` compresses the ARRIVAL axis only; a request's cancel
+    DELAY (``cancel_after_s − arrival_s``, the client's patience) stays
+    unscaled — otherwise ``time_scale=0`` would degrade every cancellable
+    request to cancel-before-first-token and the run would measure an
+    all-cancelled no-op workload.
+
+    ``deadline_s`` is a whole-run safety net for the synchronous engine
+    drive: past it, live work is cancelled, the remainder counts under the
+    ``timeout`` outcome, and the result is flagged ``timed_out`` so the
+    report marks its window as truncated instead of publishing a
+    plausible-looking partial number. HTTP drives are bounded per-request
+    by ``HTTPTarget.timeout_s`` instead — a worker pool blocked on a live
+    upstream has no safe midpoint to abandon from."""
+    result = RunResult(
+        scenario=scenario,
+        seed=seed,
+        digest=schedule_digest(schedule),
+        requests=len(schedule),
+        time_scale=time_scale,
+    )
+    result.before = target.snapshots()
+    if isinstance(target, EngineTarget):
+        _drive_engine(schedule, target, result, time_scale, deadline_s)
+    else:
+        _drive_http(schedule, target, result, time_scale, max_workers)
+    result.after = target.snapshots()
+    try:
+        result.flight = target.flight_summaries(limit=max(len(schedule), 50))
+    except Exception as e:  # noqa: BLE001 — a missing debug surface must not void the run
+        result.flight = {"error": f"{type(e).__name__}: {e}"[:200]}
+    return result
+
+
+def _cancel_offset(planned: PlannedRequest, time_scale: float) -> float:
+    """Wall offset of a cancel point: scaled arrival + UNSCALED patience
+    (see run_schedule docstring)."""
+    return planned.arrival_s * time_scale + (
+        planned.cancel_after_s - planned.arrival_s
+    )
+
+
+def _drive_engine(
+    schedule: list[PlannedRequest],
+    target: EngineTarget,
+    result: RunResult,
+    time_scale: float,
+    deadline_s: float,
+) -> None:
+    pending = sorted(schedule, key=lambda r: (r.arrival_s, r.index))
+    live: list[tuple[PlannedRequest, object]] = []
+    t0 = time.monotonic()
+    deadline = t0 + deadline_s
+    while pending or live:
+        now = time.monotonic() - t0
+        while pending and pending[0].arrival_s * time_scale <= now:
+            planned = pending.pop(0)
+            try:
+                live.append((planned, target.submit(planned)))
+            except QueueFullError:
+                result.outcomes[OUTCOME_REJECTED] += 1
+            except (DrainingError, ValueError):
+                result.outcomes[OUTCOME_FAILED] += 1
+        for planned, req in live:
+            if (
+                planned.cancel_after_s is not None
+                and not req.done
+                and not req.cancelled
+                and now >= _cancel_offset(planned, time_scale)
+            ):
+                req.cancel()
+        target.tick()
+        still_live = []
+        for planned, req in live:
+            if req.done:
+                result.client_tokens += req.emitted
+                if req.error:
+                    result.outcomes[OUTCOME_FAILED] += 1
+                elif req.cancelled:
+                    result.outcomes[OUTCOME_CANCELLED] += 1
+                else:
+                    result.outcomes[OUTCOME_COMPLETED] += 1
+            else:
+                still_live.append((planned, req))
+        live = still_live
+        if not live and pending:
+            # idle gap before the next arrival: sleep it off instead of
+            # spinning ticks against an empty engine
+            gap = pending[0].arrival_s * time_scale - (time.monotonic() - t0)
+            if gap > 0:
+                time.sleep(min(gap, 0.05))
+        if time.monotonic() > deadline:
+            for _planned, req in live:
+                req.cancel()
+            result.outcomes["timeout"] += len(live) + len(pending)
+            result.timed_out = True
+            break
+    target.tick()  # drain the overlap pipeline's lookahead chunk
+
+
+def _drive_http(
+    schedule: list[PlannedRequest],
+    target: HTTPTarget,
+    result: RunResult,
+    time_scale: float,
+    max_workers: int,
+) -> None:
+    t0 = time.monotonic()
+
+    def issue(planned: PlannedRequest) -> tuple[str, int]:
+        cancel_at = (
+            t0 + _cancel_offset(planned, time_scale)
+            if planned.cancel_after_s is not None
+            else None
+        )
+        return target.perform(planned, cancel_at)
+
+    ordered = sorted(schedule, key=lambda r: (r.arrival_s, r.index))
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        futures = []
+        for planned in ordered:
+            delay = t0 + planned.arrival_s * time_scale - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            futures.append(pool.submit(issue, planned))
+        for future in futures:
+            outcome, tokens = future.result()
+            result.outcomes[outcome] += 1
+            result.client_tokens += tokens

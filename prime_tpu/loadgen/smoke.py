@@ -1,0 +1,183 @@
+"""The loadgen CPU smoke: an in-process 2-replica fleet, one tiny scenario,
+one nonzero headline.
+
+This is the run that ends the era of empty trajectories: no TPU, no axon
+tunnel, no checkpoint — two tiny continuous-batching engines behind real
+``InferenceServer`` processes-worth of HTTP and a real ``FleetRouter``,
+driven by the deterministic ``smoke`` scenario over the wire. It produces:
+
+- ``slo_report.json`` — the versioned SLO report (registry-derived tok/s,
+  TTFT/TPOT percentiles, hit/overlap/affinity ratios);
+- ``bench_record.json`` — the same headline in BENCH record schema 2, so
+  ``perf_delta.py`` folds CI smokes into the same trajectory as TPU rounds;
+- an exposition lint verdict over every surface's ``/metrics`` text
+  (checked against the docs/observability.md catalog);
+- the router's flight-recorder scrape (the replay seed).
+
+Shared by ``scripts/loadgen_smoke.py`` (CI job ``loadgen-smoke``) and
+``prime bench smoke``. Import cost: jax and the serve stack load inside
+:func:`run_smoke`, not at module import — the CLI stays light.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from prime_tpu.loadgen.scenario import SCENARIOS, loadgen_seed_default
+
+
+def run_smoke(
+    output_dir: str,
+    *,
+    scenario: str = "smoke",
+    seed: int | None = None,
+    replicas: int = 2,
+    time_scale: float = 1.0,
+    log=print,
+) -> dict[str, Any]:
+    """Run the CPU fleet smoke end to end; returns ``{"ok", "report",
+    "record", "lint"}`` and writes the artifacts into ``output_dir``.
+    ``ok`` is False when the headline is zero or any exposition fails lint —
+    the CI job exits nonzero on it."""
+    # CPU pin before jax initializes: the smoke must never touch (or wait
+    # for) an accelerator backend, exactly like bench.py's smoke mode
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    from prime_tpu.analysis.obs_contract import load_metrics_catalog
+    from prime_tpu.loadgen.backends import HTTPTarget, NumericTokenizer
+    from prime_tpu.loadgen.report import build_report
+    from prime_tpu.loadgen.runner import run_schedule
+    from prime_tpu.loadgen.scenario import build_schedule
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.obs.metrics import lint_prometheus_text
+    from prime_tpu.serve.engine import ContinuousBatchingEngine, EngineBackend
+    from prime_tpu.serve.fleet import serve_fleet
+    from prime_tpu.serve.server import InferenceServer
+
+    seed = loadgen_seed_default() if seed is None else seed
+    os.makedirs(output_dir, exist_ok=True)
+    config = get_config("tiny-test")
+    scenario_obj = SCENARIOS[scenario](seed)
+    schedule = build_schedule(scenario_obj, vocab=config.vocab_size)
+    log(
+        f"# loadgen-smoke: scenario {scenario!r} seed {seed} -> "
+        f"{len(schedule)} requests, {replicas} replicas"
+    )
+
+    engines: list = []
+    servers: list = []
+    router = None
+    try:
+        for i in range(replicas):
+            params = init_params(jax.random.PRNGKey(i), config, dtype=jnp.float32)
+            engine = ContinuousBatchingEngine(
+                params, config, pad_id=0, max_slots=4, capacity=128, chunk=4,
+                prefix_cache_mb=8, max_queue=16,
+            )
+            engine.start()
+            engines.append(engine)
+            servers.append(
+                InferenceServer(
+                    "loadgen-smoke", EngineBackend(engine, NumericTokenizer()), port=0
+                ).start()
+            )
+        router = serve_fleet(
+            [srv.url for srv in servers], poll_interval=0.2, model_id="loadgen-smoke",
+        )
+        target = HTTPTarget(
+            router.url,
+            scrape_urls={
+                "router": router.url,
+                **{f"replica{i}": srv.url for i, srv in enumerate(servers)},
+            },
+            timeout_s=120.0,
+        )
+        # warm every prompt-length bucket the schedule will hit, per
+        # replica: first-compile time belongs to startup, not to the
+        # measured window's TTFT histogram bracket — warming one token
+        # count would leave the other buckets' compiles inside the window
+        # and the percentiles would measure XLA, not serving
+        import httpx
+
+        warm_lens = sorted({len(r.prompt_ids) for r in schedule})
+        for srv in servers:
+            for n in warm_lens:
+                httpx.post(
+                    f"{srv.url}/v1/chat/completions",
+                    json={
+                        "messages": [{"role": "user",
+                                      "content": " ".join(["7"] * n)}],
+                        "max_tokens": 4, "temperature": 0.0,
+                    },
+                    timeout=120.0,
+                ).raise_for_status()
+
+        result = run_schedule(
+            schedule, target, scenario=scenario_obj.name, seed=seed,
+            time_scale=time_scale, max_workers=8,
+        )
+        report = build_report(
+            [result],
+            meta={"backend": jax.default_backend(), "mode": "cpu-smoke"},
+        )
+        headline = report["headline"]
+        log(
+            f"# loadgen-smoke: {headline['tok_s']} tok/s over "
+            f"{headline['requests']} requests "
+            f"(outcomes {dict(result.outcomes)})"
+        )
+
+        # exposition lint, pinned to the documented catalog: every /metrics
+        # surface the smoke stood up must be well-formed AND in-contract
+        doc_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            "docs", "observability.md",
+        )
+        catalog = None
+        if os.path.exists(doc_path):
+            with open(doc_path) as f:
+                catalog = load_metrics_catalog(f.read())
+        lint: dict[str, list[str]] = {}
+        for label, text in target.expositions().items():
+            problems = lint_prometheus_text(text, catalog=catalog)
+            if problems:
+                lint[label] = problems
+                log(f"# loadgen-smoke: exposition lint FAILED for {label}:")
+                for p in problems:
+                    log(f"#   {p}")
+
+        record = {
+            "schema": 2,
+            "metric": f"loadgen_smoke_tok_s (tiny-test, {replicas}-replica fleet, "
+                      f"scenario {scenario_obj.name})",
+            "value": headline["tok_s"],
+            "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "backend": jax.default_backend(),
+            "loadgen": report,
+        }
+        with open(os.path.join(output_dir, "slo_report.json"), "w") as f:
+            json.dump(report, f, indent=2)
+        with open(os.path.join(output_dir, "bench_record.json"), "w") as f:
+            json.dump(record, f, indent=2)
+        with open(os.path.join(output_dir, "flight.json"), "w") as f:
+            json.dump(result.flight, f, indent=2)
+        ok = headline["tok_s"] > 0 and not lint
+        log(
+            f"# loadgen-smoke: {'OK' if ok else 'FAILED'} — artifacts in "
+            f"{output_dir}"
+        )
+        return {"ok": ok, "report": report, "record": record, "lint": lint}
+    finally:
+        if router is not None:
+            router.stop()
+        for srv in servers:
+            srv.stop()  # also shuts down the backing engine
+        for engine in engines[len(servers):]:
+            engine.shutdown()

@@ -47,6 +47,18 @@ def slow_ms_from_env() -> float:
     return max(0.0, env_float("PRIME_SERVE_SLOW_MS", 0.0))
 
 
+def parse_summary_limit(raw: str | None, default: int = 50, cap: int = 10000) -> int:
+    """The ``?limit=`` knob on ``GET /debug/requests``, shared by the serve
+    server and the fleet router so their scrape windows cannot drift: junk
+    or absent -> ``default``, clamped into [1, cap] (a loadgen replay
+    capture raises it to fetch a whole run in one scrape)."""
+    try:
+        limit = int(raw) if raw is not None else default
+    except ValueError:
+        limit = default
+    return max(1, min(limit, cap))
+
+
 class _Timeline:
     __slots__ = (
         "id", "trace_id", "meta", "start_unix_s", "_t0", "events",

@@ -18,7 +18,14 @@ from __future__ import annotations
 import math
 import re
 import threading
+import time
 from typing import Any, Iterable, Mapping
+
+# Reserved snapshot key: Registry.snapshot() embeds the monotonic capture
+# instant under this name (family-shaped, so snapshot consumers that iterate
+# families keep working). Registering a real metric with this name is
+# refused — the two would collide in every snapshot.
+SNAPSHOT_CAPTURED_AT = "captured_at"
 
 # Prometheus-style latency buckets, widened past 10s because a first-compile
 # TTFT on a cold engine is legitimately minutes, not milliseconds.
@@ -242,6 +249,10 @@ class Registry:
         self._metrics: dict[str, _Metric] = {}
 
     def _register(self, cls, name: str, help: str, labelnames, **kw) -> Any:
+        if name == SNAPSHOT_CAPTURED_AT:
+            raise ValueError(
+                f"{name!r} is reserved for the snapshot capture timestamp"
+            )
         with self._lock:
             existing = self._metrics.get(name)
             if existing is not None:
@@ -287,9 +298,22 @@ class Registry:
 
     def snapshot(self) -> dict[str, dict]:
         """JSON-able dump of every family and series, taken under the one
-        lock (mutually consistent across metrics)."""
+        lock (mutually consistent across metrics). The reserved
+        ``captured_at`` entry stamps the capture instant on this process's
+        MONOTONIC clock (family-shaped so family-iterating consumers need no
+        special case): two snapshots of the same registry subtract to a
+        well-defined wall-seconds window, which is what the loadgen SLO
+        report divides token deltas by — a throughput whose numerator and
+        denominator come from the same process, immune to client clock skew
+        (docs/benchmarking.md)."""
         with self._lock:
-            out: dict[str, dict] = {}
+            out: dict[str, dict] = {
+                SNAPSHOT_CAPTURED_AT: {
+                    "type": "gauge",
+                    "help": "Monotonic capture instant of this snapshot (seconds)",
+                    "series": [{"labels": {}, "value": time.monotonic()}],
+                }
+            }
             for name, metric in self._metrics.items():
                 series_list = []
                 for key, series in metric._series.items():

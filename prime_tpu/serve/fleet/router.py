@@ -39,7 +39,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Iterable
 from urllib.parse import parse_qs, urlsplit
 
-from prime_tpu.obs.flight import FlightRecorder
+from prime_tpu.obs.flight import FlightRecorder, parse_summary_limit
 from prime_tpu.obs.metrics import Registry
 from prime_tpu.obs.trace import (
     TRACEPARENT_HEADER,
@@ -303,7 +303,16 @@ class FleetRouter:
                         status, payload = outer.debug_request(request_id)
                         self._json(status, payload)
                     else:
-                        self._json(200, {"router": outer.flight.summaries()})
+                        # ?limit= mirrors the replica servers' knob (shared
+                        # parse_summary_limit) so a loadgen replay capture
+                        # through the router sees the same window it would
+                        # see scraping a replica
+                        limit = parse_summary_limit(
+                            parse_qs(parts.query).get("limit", [None])[0]
+                        )
+                        self._json(
+                            200, {"router": outer.flight.summaries(limit=limit)}
+                        )
                 elif path.endswith("/models") or "/models/" in path:
                     status, payload = outer._proxy_models(path)
                     self._json(status, payload)
@@ -445,7 +454,17 @@ class FleetRouter:
         if trace is None:
             trace = TraceContext.generate()
         fkey = _flight_key(trace)
-        self.flight.begin(fkey, trace_id=trace.trace_id)
+        # admission meta mirrors what the engine stamps replica-side, so a
+        # loadgen replay seeded from THIS hop's /debug/requests scrape stays
+        # shape-faithful (prompt_tokens is a whitespace-token estimate of
+        # the rendered prompt — exact for the numeric bench tokenizer,
+        # approximate otherwise; the body was already parsed for routing)
+        meta: dict = {}
+        if prompt is not None:
+            meta["prompt_tokens"] = len(prompt.split())
+        if isinstance(request.get("max_tokens"), int):
+            meta["max_new_tokens"] = request["max_tokens"]
+        self.flight.begin(fkey, trace_id=trace.trace_id, **meta)
         t_wait = time.monotonic()
         admitted = self._gate.acquire(timeout=self.queue_wait_s)
         wait_s = time.monotonic() - t_wait

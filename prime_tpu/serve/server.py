@@ -33,7 +33,7 @@ from typing import Any
 from urllib.parse import parse_qs, urlsplit
 
 from prime_tpu.core.config import env_str
-from prime_tpu.obs.flight import FlightRecorder
+from prime_tpu.obs.flight import FlightRecorder, parse_summary_limit
 from prime_tpu.obs.metrics import Registry
 from prime_tpu.obs.trace import (
     TRACEPARENT_HEADER,
@@ -246,7 +246,16 @@ class InferenceServer:
                         else:
                             self._json(200, timeline)
                     else:
-                        self._json(200, outer.flight_recorder().summaries())
+                        # ?limit= raises the per-ring summary bound so a
+                        # loadgen replay capture fetches a whole run in one
+                        # scrape (parse_summary_limit is shared with the
+                        # fleet router so the two windows cannot drift)
+                        limit = parse_summary_limit(
+                            parse_qs(parts.query).get("limit", [None])[0]
+                        )
+                        self._json(
+                            200, outer.flight_recorder().summaries(limit=limit)
+                        )
                 elif path.rstrip("/").endswith(f"/models/{outer.model_id}"):
                     self._json(200, {"id": outer.model_id, "object": "model"})
                 else:

@@ -1,0 +1,41 @@
+"""CI entry for the loadgen CPU smoke (docs/benchmarking.md).
+
+Runs the deterministic ``smoke`` scenario against an in-process 2-replica
+fleet over real HTTP on ``JAX_PLATFORMS=cpu``, writes the SLO report +
+BENCH-schema record + flight scrape into ``--output``, lints every
+``/metrics`` exposition against the docs catalog, and exits nonzero unless
+the headline tok/s is positive and every exposition is clean — the CI job
+``loadgen-smoke`` gates on exactly that.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from prime_tpu.loadgen.smoke import run_smoke  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--output", default="loadgen-smoke", help="Artifact directory")
+    parser.add_argument("--scenario", default="smoke")
+    parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--time-scale", type=float, default=1.0)
+    args = parser.parse_args()
+    outcome = run_smoke(
+        args.output,
+        scenario=args.scenario,
+        seed=args.seed,
+        replicas=args.replicas,
+        time_scale=args.time_scale,
+    )
+    return 0 if outcome["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

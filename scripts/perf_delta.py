@@ -1,0 +1,46 @@
+"""Render the per-PR perf delta table from committed BENCH_*.json rounds.
+
+Usage:
+    python scripts/perf_delta.py [--root DIR] [--pattern GLOB] [--json]
+    python scripts/perf_delta.py --min-rounds 1   # CI smoke: never fail on a
+                                                  # fresh checkout with one round
+
+Thin wrapper over prime_tpu.loadgen.perf_delta (stdlib-only — no jax, no
+install) so the same table renders from CI, a laptop, and `prime bench
+delta`. Schema-1 records (rounds before the loadgen era) are labeled and
+parsed with headline fields only; schema-2 records additionally contribute
+their loadgen SLO rows (per-scenario tok/s and TTFT percentiles).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from prime_tpu.loadgen.perf_delta import delta_json, delta_table, load_rounds  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--root", default=".", help="Directory holding BENCH_*.json")
+    parser.add_argument("--pattern", default="BENCH_*.json")
+    parser.add_argument("--json", action="store_true", help="Machine-readable output")
+    parser.add_argument(
+        "--min-rounds", type=int, default=2,
+        help="Fail (exit 1) below this many parseable rounds.",
+    )
+    args = parser.parse_args()
+    rounds = load_rounds(args.root, args.pattern)
+    if args.json:
+        print(json.dumps(delta_json(rounds), indent=2))
+    else:
+        print(delta_table(rounds, min_rounds=args.min_rounds))
+    return 0 if len(rounds) >= args.min_rounds else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

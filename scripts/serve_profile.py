@@ -30,6 +30,11 @@ Two modes:
   distribution and outcomes per replica, affinity hit ratio (the fraction of
   keyed requests the consistent-hash scheduler landed on their prefix-warm
   replica), reroute reasons, breaker states, and admission-gate queue waits.
+- ``--slo report.json`` (with ``--trace``) — merge a loadgen SLO report's
+  per-scenario rows (docs/benchmarking.md) into the trace output: the
+  scenario table prints first (which scenario regressed), the waterfalls
+  below it say where inside a request the time went. Repeatable to compare
+  two reports side by side.
 """
 
 from __future__ import annotations
@@ -288,6 +293,52 @@ def waterfall_report(paths: list[str], trace_id: str | None = None, limit: int =
             emit(root, 0, None)
 
 
+def slo_report(paths: list[str]) -> None:
+    """Per-scenario SLO rows from loadgen report file(s) — printed above
+    the waterfalls so one invocation answers both 'which scenario
+    regressed' and 'where in the request did the time go'. Multiple
+    reports print in argument order (pass previous + current to eyeball
+    the delta; `prime bench delta` renders the committed trajectory)."""
+
+    def ms(quantiles: dict | None, key: str) -> str:
+        value = (quantiles or {}).get(key)
+        return f"{value * 1e3:.1f}" if isinstance(value, (int, float)) else "—"
+
+    for path in paths:
+        try:
+            with open(path) as f:
+                report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"--- SLO report {path}: unreadable ({e})")
+            continue
+        headline = report.get("headline", {})
+        print(
+            f"--- SLO report {path} (schema {report.get('slo_schema', '?')}): "
+            f"aggregate {headline.get('tok_s', '?')} tok/s, "
+            f"{headline.get('requests', '?')} requests, "
+            f"{headline.get('rejected_429', 0)} rejected"
+        )
+        print(
+            f"{'scenario':>18} {'tok/s':>8} {'ttft_p50':>9} {'ttft_p95':>9} "
+            f"{'tpot_p50':>9} {'overlap':>8} {'hit':>6} {'429s':>5}  outcomes"
+        )
+        for row in report.get("scenarios", []):
+            outcomes = ", ".join(
+                f"{k}={v}" for k, v in sorted((row.get("outcomes") or {}).items())
+            )
+            fleet = row.get("fleet") or {}
+            if fleet.get("affinity_ratio") is not None:
+                outcomes += f" | affinity {fleet['affinity_ratio']}"
+            print(
+                f"{row.get('scenario', '?'):>18} {row.get('tok_s', 0):>8} "
+                f"{ms(row.get('ttft_s'), 'p50'):>9} {ms(row.get('ttft_s'), 'p95'):>9} "
+                f"{ms(row.get('tpot_s'), 'p50'):>9} "
+                f"{row.get('overlap_ratio') if row.get('overlap_ratio') is not None else '—':>8} "
+                f"{row.get('prefix_hit_ratio') if row.get('prefix_hit_ratio') is not None else '—':>6} "
+                f"{row.get('rejected_429', 0):>5}  {outcomes}"
+            )
+
+
 def fleet_report(url: str) -> None:
     """Scrape a FleetRouter's /metrics and /admin/fleet and print where the
     traffic went and why — the first question when fleet throughput
@@ -347,7 +398,16 @@ def main() -> None:
         help="Print the routing report scraped from a running "
              "`prime serve fleet` router instead of running the profile.",
     )
+    parser.add_argument(
+        "--slo", metavar="REPORT_JSON", action="append", default=None,
+        help="Merge a loadgen SLO report's per-scenario rows into the "
+             "output (above the waterfalls). Repeatable.",
+    )
     args = parser.parse_args()
+    # --slo composes with every offline mode: scenario rows print first,
+    # then whichever detail view (--trace waterfalls / --fleet routing)
+    if args.slo:
+        slo_report(args.slo)
     if args.trace:
         for path in args.trace:
             overlap_report(path, quiet=len(args.trace) > 1)
@@ -356,6 +416,8 @@ def main() -> None:
         return
     if args.fleet:
         fleet_report(args.fleet)
+        return
+    if args.slo:
         return
 
     import jax
