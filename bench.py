@@ -697,7 +697,7 @@ def main() -> None:
     def run_serve(
         kv_quant: bool = False, speculative: bool = False, prompts=None,
         record_counters: bool = False, obs_key: str | None = None,
-        scenario: str = "serve",
+        scenario: str = "serve", mesh_config="",
     ) -> float:
         from prime_tpu.serve.engine import ContinuousBatchingEngine
 
@@ -705,7 +705,7 @@ def main() -> None:
         engine = ContinuousBatchingEngine(
             params, config, pad_id=0, max_slots=serve_slots,
             capacity=SERVE_CAPACITY, chunk=SERVE_CHUNK,
-            kv_quant=kv_quant, speculative=speculative,
+            kv_quant=kv_quant, speculative=speculative, mesh_config=mesh_config,
         )
         try:
             # warmup: compile prefill/decode/finalize for the buckets in play.
@@ -867,6 +867,8 @@ def main() -> None:
         engine = ContinuousBatchingEngine(
             params, config, pad_id=0, max_slots=serve_slots,
             capacity=SERVE_CAPACITY, chunk=SERVE_CHUNK, prefix_cache_mb=256,
+            mesh_config="",  # pin single-chip: an ambient PRIME_SERVE_MESH
+            # must not shard the single-chip trajectory sections
         )
         try:
             # warm twice: the first pass compiles the cold plan and stores
@@ -950,6 +952,7 @@ def main() -> None:
             params, config, pad_id=0, max_slots=serve_slots,
             capacity=SERVE_CAPACITY, chunk=SERVE_CHUNK,
             prefix_cache_mb=1 / 1024, prefix_cache_host_mb=64,
+            mesh_config="",  # pin single-chip (see the prefix section above)
         )
         try:
             for ids in burst_prompts[:3]:
@@ -1009,6 +1012,7 @@ def main() -> None:
                 engine = ContinuousBatchingEngine(
                     params, config, pad_id=0, max_slots=fleet_slots,
                     capacity=SERVE_CAPACITY, chunk=SERVE_CHUNK, prefix_cache_mb=256,
+                    mesh_config="",  # pin single-chip fleet replicas
                 )
                 engine.start()
                 engines.append(engine)
@@ -1107,6 +1111,45 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         record["serve_fleet_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: serve fleet section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
+
+    # ---- sharded replica serve section (the MULTICHIP serving number) -------
+    # ONE engine spanning every visible device (docs/architecture.md "Sharded
+    # replica"): the engine builds the (dp, fsdp, tp) mesh from a declarative
+    # spec, places params + paged KV as NamedSharding arrays, and is measured
+    # through the same loadgen path as the single-chip sections — so the
+    # tok/s is registry-windowed and the run lands in the SLO report as its
+    # own scenario. On the virtual-device CPU smoke this is the committed
+    # MULTICHIP trajectory's serving number; on a real slice it is the
+    # per-topology throughput PAPERS' Gemma-on-TPU serving table reports.
+    try:
+        import math as _math
+
+        n_dev = jax.device_count()
+        if n_dev > 1:
+            # tp over the kv heads it must divide; the rest of the slice
+            # becomes the fsdp data axis (batch = slots shard over it)
+            tp = _math.gcd(n_dev, config.n_kv_heads)
+            mesh_spec = f"dp=1,fsdp={n_dev // tp},tp={tp}"
+            record["serve_mesh"] = mesh_spec
+            record["serve_mesh_devices"] = n_dev
+            record["serve_sharded_tok_s"] = round(
+                run_serve(
+                    obs_key="serve_sharded_obs", scenario="serve_sharded",
+                    mesh_config=mesh_spec,
+                ),
+                1,
+            )
+            print(
+                f"# bench: serve sharded {record['serve_sharded_tok_s']} tok/s "
+                f"(one replica over mesh {mesh_spec}, {n_dev} devices)",
+                flush=True,
+            )
+        else:
+            print("# bench: serve sharded section skipped (single device)", flush=True)
+    except Exception as e:  # noqa: BLE001
+        record["serve_sharded_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: serve sharded section failed: {e}", flush=True)
     print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
     # ---- loadgen SLO report over every serve section ------------------------

@@ -21,16 +21,24 @@ def bench_group() -> None:
 
 @bench_group.command("delta")
 @click.option("--root", default=".", help="Directory holding BENCH_*.json.")
-@click.option("--pattern", default="BENCH_*.json", help="Round file glob.")
+@click.option("--pattern", default=None,
+              help="Restrict to one round-file glob (default: BENCH_*.json "
+                   "and MULTICHIP_*.json merged — multichip rounds render "
+                   "their own mc-prefixed rows, never cross-backend deltas).")
 @click.option("--output", "as_json", is_flag=False, flag_value="json", default=None,
               help="Set to 'json' for machine-readable output.")
 @click.option("--min-rounds", type=int, default=2,
               help="Exit nonzero below this many parseable rounds.")
-def bench_delta(root: str, pattern: str, as_json: str | None, min_rounds: int) -> None:
+def bench_delta(root: str, pattern: str | None, as_json: str | None, min_rounds: int) -> None:
     """Render the per-PR perf delta table across committed bench rounds."""
-    from prime_tpu.loadgen.perf_delta import delta_json, delta_table, load_rounds
+    from prime_tpu.loadgen.perf_delta import (
+        delta_json,
+        delta_table,
+        load_all_rounds,
+        load_rounds,
+    )
 
-    rounds = load_rounds(root, pattern)
+    rounds = load_rounds(root, pattern) if pattern else load_all_rounds(root)
     if as_json == "json":
         click.echo(json.dumps(delta_json(rounds), indent=2))
     else:
@@ -46,16 +54,20 @@ def bench_delta(root: str, pattern: str, as_json: str | None, min_rounds: int) -
 @click.option("--seed", type=int, default=None,
               help="Schedule seed. Default: 0 (PRIME_LOADGEN_SEED).")
 @click.option("--replicas", type=int, default=2, help="In-process fleet size.")
+@click.option("--mesh", default=None, metavar="SPEC",
+              help="Sharded-replica mesh spec (e.g. 'dp=1,fsdp=2,tp=2'): "
+                   "each replica spans that mesh (MULTICHIP rounds).")
 @click.option("--time-scale", type=float, default=1.0,
               help="Multiplier on scheduled arrival/cancel offsets.")
 def bench_smoke(
-    output: str, scenario: str, seed: int | None, replicas: int, time_scale: float
+    output: str, scenario: str, seed: int | None, replicas: int,
+    mesh: str | None, time_scale: float
 ) -> None:
     """Run the CPU loadgen fleet smoke end to end (no TPU required)."""
     from prime_tpu.loadgen.smoke import run_smoke
 
     outcome = run_smoke(
-        output, scenario=scenario, seed=seed, replicas=replicas,
+        output, scenario=scenario, seed=seed, replicas=replicas, mesh=mesh,
         time_scale=time_scale, log=click.echo,
     )
     if not outcome["ok"]:

@@ -19,6 +19,15 @@ from prime_tpu.utils.render import Renderer, output_options
 @click.option("--checkpoint", default=None, help="Local HF checkpoint dir for weights.")
 @click.option("--tokenizer", default=None)
 @click.option("--slice", "slice_name", default=None, help="Shard over this TPU slice's mesh.")
+@click.option(
+    "--mesh", "mesh_spec", default=None, metavar="SPEC",
+    help="Sharded replica (--continuous): declarative serving-mesh axes, "
+         "e.g. 'dp=1,fsdp=2,tp=2' or 'dp,fsdp,tp' (the last unsized axis "
+         "absorbs remaining devices). One engine spans the whole mesh: "
+         "params and paged KV shard onto it, decode runs the shard_mapped "
+         "flash kernel when eligible. Default: unset (PRIME_SERVE_MESH). "
+         "Mutually exclusive with --slice.",
+)
 @click.option("--tp", "tensor_parallel", type=int, default=None)
 @click.option("--sp", "sequence_parallel", type=click.IntRange(min=2), default=None,
               help="Sequence-parallel axis for --slice: shard the KV cache's "
@@ -111,6 +120,7 @@ def serve_cmd(
     checkpoint: str | None,
     tokenizer: str | None,
     slice_name: str | None,
+    mesh_spec: str | None,
     tensor_parallel: int | None,
     sequence_parallel: int | None,
     kv_quant: bool,
@@ -141,6 +151,12 @@ def serve_cmd(
         raise click.UsageError("Missing option '--model' / '-m'.")
     from prime_tpu.serve import serve_model
 
+    if mesh_spec and slice_name:
+        raise click.UsageError(
+            "--mesh and --slice both describe the serving mesh; pass one"
+        )
+    if mesh_spec and not continuous:
+        raise click.UsageError("--mesh requires --continuous (the sharded replica is engine-only)")
     if weight_bits == "4" and not weight_quant:
         # silently serving bf16 at 4x the expected HBM footprint would be a
         # nasty surprise; make the dependency explicit
@@ -168,6 +184,7 @@ def serve_cmd(
             host=host,
             port=port,
             continuous=continuous,
+            mesh=mesh_spec,
             max_slots=slots,
             slot_capacity=slot_capacity,
             chunk=chunk,

@@ -261,9 +261,9 @@ class JaxGenerator:
 
             from prime_tpu.parallel.sharding import (
                 batch_spec,
-                cache_spec_for,
                 lengths_spec,
                 prune_spec,
+                serving_cache_spec,
             )
 
             batch = jax.device_put(
@@ -274,11 +274,9 @@ class JaxGenerator:
             )
             # an sp axis shards the KV cache's SLOT dimension: a long-context
             # cache larger than one chip's HBM spreads across the slice.
-            # cache_spec_for keeps MLA's single-latent head axis replicated.
-            has_sp = self.mesh.shape.get("sp", 1) > 1
-            kw["cache_spec"] = prune_spec(
-                cache_spec_for(self.config, sp=has_sp), self.mesh
-            )
+            # serving_cache_spec keeps MLA's single-latent head axis
+            # replicated (one owner, shared with the serve engine/server)
+            kw["cache_spec"] = serving_cache_spec(self.config, self.mesh)
             if self.mesh.size > 1:
                 # pallas kernels are not SPMD-partitionable under jit; on a
                 # real multi-device mesh the XLA paths (which XLA shards) must

@@ -36,6 +36,7 @@ HEADLINE_METRICS: tuple[tuple[str, str], ...] = (
     ("prefixburst hit ratio", "serve_prefixburst_hit_ratio"),
     ("fleet tok/s", "serve_fleet_tok_s"),
     ("fleet affinity ratio", "serve_fleet_affinity_ratio"),
+    ("sharded tok/s", "serve_sharded_tok_s"),
     ("int8 tok/s", "int8_weights_tok_s"),
     ("int4 tok/s", "int4_weights_tok_s"),
     ("longctx pallas speedup", "longctx_pallas_speedup"),
@@ -43,6 +44,7 @@ HEADLINE_METRICS: tuple[tuple[str, str], ...] = (
 )
 
 _ROUND_RE = re.compile(r"BENCH_(?:(?P<kind>[a-z_]+)_)?r(?P<num>\d+)\.json$")
+_MULTICHIP_RE = re.compile(r"MULTICHIP_(?:(?P<kind>[a-z_]+)_)?r(?P<num>\d+)\.json$")
 
 
 @dataclass
@@ -84,7 +86,37 @@ def _slo_metrics(report: dict) -> dict[str, float]:
     return out
 
 
+def _is_sharded_smoke_record(record: dict[str, Any]) -> bool:
+    """The dedicated sharded loadgen smoke record (run_smoke --mesh) is
+    recognizable by its OWN evidence — top-level ``mesh_devices`` plus the
+    ``serve_sharded_tok_s`` metric name — so committing one under a
+    BENCH_*.json name still routes it to the mc rows instead of rendering
+    its sharded headline in the single-chip 'cpu-smoke tok/s' trajectory.
+    Full bench.py records are NOT matched (their sharded section fields are
+    ``serve_``-prefixed and their metric is the decode headline): those are
+    genuinely mixed records whose family the filename decides."""
+    inner = (
+        record.get("parsed")
+        if ("parsed" in record and "rc" in record)
+        else record
+    )
+    if not isinstance(inner, dict):
+        return False
+    return bool(inner.get("mesh_devices")) and str(
+        inner.get("metric", "")
+    ).startswith("serve_sharded_tok_s")
+
+
 def _round_from_record(path: str, record: dict[str, Any]) -> Round:
+    # family is inferred from the FILENAME or the record's own sharded
+    # stamps, not a caller flag: an explicit --pattern 'MULTICHIP_*.json'
+    # must parse multichip rounds identically to the merged default view,
+    # and a sharded smoke record committed under a BENCH name must not
+    # contaminate the single-chip rows
+    if os.path.basename(path).startswith("MULTICHIP_") or _is_sharded_smoke_record(
+        record
+    ):
+        return _multichip_round(path, record)
     m = _ROUND_RE.search(os.path.basename(path))
     kind = (m.group("kind") if m else None) or ""
     # no r<N> in the name: sort AFTER every numbered round (it must never
@@ -95,15 +127,7 @@ def _round_from_record(path: str, record: dict[str, Any]) -> Round:
     # round-3 mid-preflight kill) becomes an explicit error record rather
     # than a skipped round — a dead round is part of the trajectory.
     if "parsed" in record and "rc" in record:
-        num = int(record.get("n") or num or 0)
-        parsed = record["parsed"]
-        if isinstance(parsed, dict):
-            record = parsed
-        else:
-            record = {
-                "value": 0.0,
-                "error": f"record unparseable (driver rc={record.get('rc')})",
-            }
+        num, record = _unwrap_driver_record(num, record)
     if num is None:
         label = os.path.basename(path)[: -len(".json")]
         order: tuple = (float("inf"), label)
@@ -136,12 +160,96 @@ def _round_from_record(path: str, record: dict[str, Any]) -> Round:
     )
 
 
-def load_rounds(
-    root: str = ".", pattern: str = "BENCH_*.json"
-) -> list[Round]:
+def _unwrap_driver_record(
+    num: int | None, record: dict[str, Any]
+) -> tuple[int | None, dict[str, Any]]:
+    """Unwrap the driver's ``{"n", "cmd", "rc", "tail", "parsed"}`` wrapper
+    (shared by BENCH and MULTICHIP rounds). A null parse (e.g. a
+    mid-preflight kill) becomes an explicit error record rather than a
+    skipped round — a dead round is part of the trajectory."""
+    num = int(record.get("n") or num or 0)
+    parsed = record["parsed"]
+    if isinstance(parsed, dict):
+        return num, parsed
+    return num, {
+        "value": 0.0,
+        "error": f"record unparseable (driver rc={record.get('rc')})",
+    }
+
+
+def _multichip_round(path: str, record: dict[str, Any]) -> Round:
+    """A committed MULTICHIP_*.json round: the multi-chip trajectory rendered
+    NEXT TO the BENCH rounds, never against them. Every row name is
+    ``mc``-prefixed, so the delta math (which compares a metric against the
+    latest previous round carrying the same name) can never compute a
+    cross-backend delta between a TPU BENCH headline and a multichip round.
+
+    Two shapes exist: the historical dryrun wrapper (``{"n_devices", "rc",
+    "ok", "tail"}`` from the 8-virtual-device compile/execute smoke) renders
+    as a pass/fail row; schema-2 records (the sharded-replica loadgen smoke)
+    contribute a real throughput row plus their SLO scenario rows."""
+    # a sharded smoke record routed here by content may carry a BENCH_rNN
+    # name — fall back to the BENCH pattern so it keeps its round number
+    # (and its place in the timeline) instead of sorting last unnumbered
+    m = _MULTICHIP_RE.search(os.path.basename(path)) or _ROUND_RE.search(
+        os.path.basename(path)
+    )
+    kind = (m.group("kind") if m else None) or ""
+    num = int(m.group("num")) if m else None
+    if "parsed" in record and "rc" in record:  # driver wrapper, like BENCH
+        num, record = _unwrap_driver_record(num, record)
+    if num is None:
+        label = "mc-" + os.path.basename(path)[: -len(".json")]
+        order: tuple = (float("inf"), label)
+    else:
+        label = f"mc{num:02d}" + (f"-{kind}" if kind else "")
+        # "~" sorts after every [a-z_] kind: the multichip column of round N
+        # lands right of round N's BENCH columns
+        order = (num, "~" + kind)
+    metrics: dict[str, float] = {}
+    schema = int(record.get("schema", 1))
+    if "value" not in record and "n_devices" in record:
+        # legacy dryrun wrapper: no throughput was ever measured — the row
+        # records that the sharding programs compiled and executed
+        metrics["mc dryrun ok"] = 1.0 if record.get("ok") else 0.0
+        if not record.get("ok") and not record.get("error"):
+            record = {**record, "error": f"dryrun failed (rc={record.get('rc')})"}
+    else:
+        # the sharded headline: a full bench.py record committed as a
+        # MULTICHIP round carries it under serve_sharded_tok_s (its "value"
+        # is the single-chip decode headline — the wrong trajectory here);
+        # the dedicated loadgen --mesh smoke record carries it as "value"
+        # and stamps top-level mesh/mesh_devices as the evidence. A bench
+        # record whose sharded section failed has neither — no row, never
+        # the single-chip headline masquerading as the multichip number.
+        value = record.get("serve_sharded_tok_s")
+        if value is None and (record.get("mesh_devices") or record.get("mesh")):
+            value = record.get("value")
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            metrics["mc sharded tok/s"] = float(value)
+        devices = (
+            record.get("serve_mesh_devices")
+            or record.get("mesh_devices")
+            or record.get("n_devices")
+        )
+        if isinstance(devices, (int, float)) and not isinstance(devices, bool):
+            metrics["mc mesh devices"] = float(devices)
+        if schema >= 2 and isinstance(record.get("loadgen"), dict):
+            metrics.update(
+                {f"mc-{k}": v for k, v in _slo_metrics(record["loadgen"]).items()}
+            )
+    return Round(
+        label=label, path=path, order=order, schema=schema,
+        record=record, metrics=metrics,
+    )
+
+
+def load_rounds(root: str = ".", pattern: str = "BENCH_*.json") -> list[Round]:
     """Every parseable committed round under ``root``, oldest first.
     Unparseable files are skipped (a half-written record must not take the
-    delta table down); files without a BENCH_r<N> name sort last by name."""
+    delta table down); files without a BENCH_r<N> name sort last by name.
+    ``MULTICHIP_*``-named files parse as multichip rounds whatever the
+    pattern that matched them."""
     rounds: list[Round] = []
     for path in sorted(glob.glob(os.path.join(root, pattern))):
         try:
@@ -151,6 +259,16 @@ def load_rounds(
             continue
         if isinstance(record, dict):
             rounds.append(_round_from_record(path, record))
+    rounds.sort(key=lambda r: (r.order, r.label))
+    return rounds
+
+
+def load_all_rounds(root: str = ".") -> list[Round]:
+    """BENCH and MULTICHIP rounds merged into one timeline: multichip rounds
+    interleave by round number (sorting after the same-numbered BENCH round)
+    but keep disjoint ``mc``-prefixed metric rows — own rows, no
+    cross-backend deltas."""
+    rounds = load_rounds(root, "BENCH_*.json") + load_rounds(root, "MULTICHIP_*.json")
     rounds.sort(key=lambda r: (r.order, r.label))
     return rounds
 

@@ -34,13 +34,20 @@ def run_smoke(
     scenario: str = "smoke",
     seed: int | None = None,
     replicas: int = 2,
+    mesh: str | None = None,
     time_scale: float = 1.0,
     log=print,
 ) -> dict[str, Any]:
     """Run the CPU fleet smoke end to end; returns ``{"ok", "report",
     "record", "lint"}`` and writes the artifacts into ``output_dir``.
     ``ok`` is False when the headline is zero or any exposition fails lint —
-    the CI job exits nonzero on it."""
+    the CI job exits nonzero on it.
+
+    ``mesh`` (a ``"dp=1,fsdp=2,tp=2"``-style spec) builds each replica as a
+    SHARDED engine spanning that mesh (serve/mesh_config.py) — under
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` this measures the
+    multi-chip serving path on CPU and stamps the record with the mesh, the
+    shape a committed ``MULTICHIP_*.json`` round wants (docs/benchmarking.md)."""
     # CPU pin before jax initializes: the smoke must never touch (or wait
     # for) an accelerator backend, exactly like bench.py's smoke mode
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -68,18 +75,59 @@ def run_smoke(
     log(
         f"# loadgen-smoke: scenario {scenario!r} seed {seed} -> "
         f"{len(schedule)} requests, {replicas} replicas"
+        + (f", mesh {mesh}" if mesh else "")
     )
 
     engines: list = []
     servers: list = []
     router = None
     try:
+        # sharded replicas get DISJOINT device slices: N engines all built
+        # over jax.devices()[:k] would measure device contention (double-
+        # subscribed HBM on real chips) and stamp it into the committed
+        # MULTICHIP trajectory as a clean multichip number
+        mesh_cfg = None
+        if mesh:
+            from prime_tpu.serve.mesh_config import parse_mesh_spec
+
+            mesh_cfg = parse_mesh_spec(mesh, jax.device_count())
+        if mesh_cfg is not None and replicas * mesh_cfg.total_devices > jax.device_count():
+            raise ValueError(
+                f"mesh {mesh!r} x {replicas} replicas needs "
+                f"{replicas * mesh_cfg.total_devices} devices; only "
+                f"{jax.device_count()} are available (drop --replicas or "
+                "force more with --xla_force_host_platform_device_count)"
+            )
         for i in range(replicas):
             params = init_params(jax.random.PRNGKey(i), config, dtype=jnp.float32)
+            kw: dict = {"mesh_config": mesh}
+            if mesh_cfg is not None and replicas > 1:
+                # explicit surface: replica i's mesh over its own device
+                # slice, params/cache placed by the same one-owner specs the
+                # declarative path uses
+                from prime_tpu.parallel.sharding import serving_cache_spec, shard_params
+
+                need = mesh_cfg.total_devices
+                replica_mesh = mesh_cfg.build(jax.devices()[i * need : (i + 1) * need])
+                params = shard_params(params, replica_mesh, config)
+                kw = {
+                    "mesh": replica_mesh,
+                    "cache_spec": serving_cache_spec(config, replica_mesh),
+                }
             engine = ContinuousBatchingEngine(
                 params, config, pad_id=0, max_slots=4, capacity=128, chunk=4,
-                prefix_cache_mb=8, max_queue=16,
+                prefix_cache_mb=8, max_queue=16, **kw,
             )
+            if mesh_cfg is None and replicas > 1 and engine.mesh_devices > 1:
+                # PRIME_SERVE_MESH reached the engines without --mesh: every
+                # replica built over the SAME first-k devices — contention,
+                # not multichip serving. The explicit flag places disjointly.
+                engine.shutdown()
+                raise ValueError(
+                    "PRIME_SERVE_MESH sharded every replica over the same "
+                    "devices; pass --mesh explicitly (or --replicas 1) so "
+                    "replicas get disjoint device slices"
+                )
             engine.start()
             engines.append(engine)
             servers.append(
@@ -122,9 +170,22 @@ def run_smoke(
             schedule, target, scenario=scenario_obj.name, seed=seed,
             time_scale=time_scale, max_workers=8,
         )
+        # stamp from the engines' ACTUAL mesh state, not the `mesh` argument:
+        # PRIME_SERVE_MESH can shard the engines with mesh=None here, and a
+        # sharded run labeled as single-chip would land in the wrong
+        # perf-delta trajectory row (the mc-prefix design exists to prevent
+        # exactly that cross-backend contamination)
+        mesh_axes = engines[0].mesh_axes if engines else {}
+        mesh_devices = engines[0].mesh_devices if engines else 1
+        sharded = mesh_devices > 1
+        mesh_desc = ",".join(f"{k}={v}" for k, v in mesh_axes.items())
         report = build_report(
             [result],
-            meta={"backend": jax.default_backend(), "mode": "cpu-smoke"},
+            meta={
+                "backend": jax.default_backend(),
+                "mode": "cpu-mesh-smoke" if sharded else "cpu-smoke",
+                **({"mesh": mesh_axes, "mesh_devices": mesh_devices} if sharded else {}),
+            },
         )
         headline = report["headline"]
         log(
@@ -152,14 +213,21 @@ def run_smoke(
                 for p in problems:
                     log(f"#   {p}")
 
+        metric = (
+            f"serve_sharded_tok_s (tiny-test, {replicas}x sharded replica "
+            f"over mesh {mesh_desc}, scenario {scenario_obj.name})"
+            if sharded
+            else f"loadgen_smoke_tok_s (tiny-test, {replicas}-replica fleet, "
+                 f"scenario {scenario_obj.name})"
+        )
         record = {
             "schema": 2,
-            "metric": f"loadgen_smoke_tok_s (tiny-test, {replicas}-replica fleet, "
-                      f"scenario {scenario_obj.name})",
+            "metric": metric,
             "value": headline["tok_s"],
             "unit": "tokens/s",
             "vs_baseline": 0.0,
             "backend": jax.default_backend(),
+            **({"mesh": mesh_axes, "mesh_devices": mesh_devices} if sharded else {}),
             "loadgen": report,
         }
         with open(os.path.join(output_dir, "slo_report.json"), "w") as f:

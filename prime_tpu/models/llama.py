@@ -278,7 +278,8 @@ def _attention_block(
     prefill_offset: jnp.ndarray | None = None,  # () chunked prefill: write+attend at offset
     sliding: jnp.ndarray | None = None,  # () traced bool: this layer uses the window
     rope_tables_local: tuple[jnp.ndarray, jnp.ndarray] | None = None,
-    ring_mesh=None,  # mesh for attn_impl="ring" (context-parallel training)
+    mesh=None,  # mesh-aware impls: "ring" (context-parallel training),
+    #             "sharded" (serve decode: flash kernel under shard_map)
 ):
     batch, seq, _ = x.shape
     h, kh, hd = config.n_heads, config.n_kv_heads, config.head_dim
@@ -341,7 +342,7 @@ def _attention_block(
             new_v_cache = put(v_cache, v_col)
         attn = decode_attention(
             q, new_k_cache, new_v_cache, cache_lengths + 1, sm_scale, impl=attn_impl,
-            k_scale=new_k_scale, v_scale=new_v_scale, **gemma_kw,
+            k_scale=new_k_scale, v_scale=new_v_scale, mesh=mesh, **gemma_kw,
         )
     elif prefill_offset is not None:
         # chunked prefill: write this chunk's K/V into the cache at the
@@ -396,9 +397,9 @@ def _attention_block(
         from prime_tpu.parallel.ring_attention import ring_self_attention
         from prime_tpu.parallel.sharding import ring_qkv_axes
 
-        batch_axis, head_axis = ring_qkv_axes(ring_mesh, kh)
+        batch_axis, head_axis = ring_qkv_axes(mesh, kh)
         attn = ring_self_attention(
-            q, k, v, ring_mesh, seq_axis="sp", sm_scale=sm_scale,
+            q, k, v, mesh, seq_axis="sp", sm_scale=sm_scale,
             window=config.sliding_window, softcap=config.attn_softcap,
             sinks=lp.get("sinks"),
             batch_axis=batch_axis, head_axis=head_axis,
@@ -491,7 +492,9 @@ def forward(
     prefill_offset: jnp.ndarray | None = None,  # () traced; chunked prefill at offset
     remat: str = "none",  # "none" | "full" | "dots" — training-path rematerialization
     longrope_select: int | None = None,  # static run-length bound for LongRoPE
-    ring_mesh=None,  # attn_impl="ring": mesh whose `sp` axis shards the sequence
+    mesh=None,  # mesh-aware attn impls — "ring": mesh whose `sp` axis shards
+    #           the sequence; "sharded": serving mesh for the shard_mapped
+    #           flash-decode dispatch (parallel/decode_sharded.py)
     last_positions: jnp.ndarray | None = None,  # (B,) → logits only at these rows
 ):
     """Run the transformer. Returns (logits (B, S, V) fp32, updated cache),
@@ -530,8 +533,8 @@ def forward(
             )
         if cache is not None:
             raise ValueError("attn_impl='ring' serves the no-cache (training) path only")
-        if ring_mesh is None or "sp" not in ring_mesh.shape:
-            raise ValueError("attn_impl='ring' needs ring_mesh with an 'sp' axis")
+        if mesh is None or "sp" not in mesh.shape:
+            raise ValueError("attn_impl='ring' needs mesh with an 'sp' axis")
         if config.sliding_window and config.sliding_pattern != "uniform":
             raise ValueError(
                 "attn_impl='ring' supports uniform window schedules only "
@@ -609,6 +612,7 @@ def forward(
                 k_c, v_c, cache_lengths, decode, attn_impl,
                 k_scale=k_s, v_scale=v_s, prefill_offset=prefill_offset,
                 sliding=sliding, rope_tables_local=rope_tables_local,
+                mesh=mesh,
             )
         x, aux = _mlp_block(x, lp, config)
         ys = (new_k, new_v, new_ks, new_vs) if quantized else (new_k, new_v)
@@ -678,7 +682,7 @@ def forward(
                 x, _, _, _, _ = _attention_block(
                     x, lp, positions, rope_tables, config, None, None, None, False, attn_impl,
                     sliding=sliding, rope_tables_local=rope_tables_local,
-                    ring_mesh=ring_mesh,
+                    mesh=mesh,
                 )
             x, aux = _mlp_block(x, lp, config)
             return (x, aux_sum + aux), None

@@ -124,19 +124,37 @@ def _decode_pallas_eligible(k_cache: jnp.ndarray) -> bool:
     return capacity % BLOCK_C == 0
 
 
+def _sharded_decode_eligible(k_cache, mesh, quantized: bool) -> bool:
+    """Whether the shard_mapped flash-decode kernel can serve this step on
+    ``mesh``: the kernel itself must be worth it (_decode_pallas_eligible —
+    TPU backend, long aligned cache), the int8 scale epilogue is not plumbed
+    through the shard_map wrapper yet, and every device's shard must be
+    non-empty (batch divisible by the data axes, kv heads by tp)."""
+    if quantized or not _decode_pallas_eligible(k_cache):
+        return False
+    shape = getattr(mesh, "shape", {})
+    data = int(shape.get("dp", 1)) * int(shape.get("fsdp", 1))
+    tp = int(shape.get("tp", 1))
+    if int(shape.get("sp", 1)) > 1:
+        return False  # slot-sharded caches take the sp decode path, not the kernel
+    batch, kv_heads = k_cache.shape[0], k_cache.shape[1]
+    return batch % max(1, data) == 0 and kv_heads % max(1, tp) == 0
+
+
 def decode_attention(
     q: jnp.ndarray,          # (B, H, 1, D)
     k_cache: jnp.ndarray,    # (B, KH, D, C) feature-major (see models.llama.KVCache)
     v_cache: jnp.ndarray,    # (B, KH, D, C)
     cache_lengths: jnp.ndarray,  # (B,) number of valid cache entries
     sm_scale: float,
-    impl: str = "auto",      # auto | pallas | xla
+    impl: str = "auto",      # auto | pallas | xla | sharded
     k_scale: jnp.ndarray | None = None,  # (B, KH, 1, C) int8-cache dequant scales
     v_scale: jnp.ndarray | None = None,
     softcap: float = 0.0,                # Gemma2 score softcapping
     window: int = 0,                     # sliding-window size (0 = global)
     sliding: jnp.ndarray | None = None,  # traced per-layer bool for `window`
     sinks: jnp.ndarray | None = None,    # (H,) per-head sink logits (GPT-OSS)
+    mesh=None,                           # impl="sharded": the serving mesh
 ) -> jnp.ndarray:
     """One decode step against the cache, masking invalid (future) slots.
 
@@ -148,12 +166,29 @@ def decode_attention(
     _decode_pallas_eligible). The XLA path is a grouped einsum — GQA without
     jnp.repeat, so the cache is never materialized per-query-head.
 
-    Callers running under a multi-device mesh must pass ``impl="xla"``:
-    a pallas_call is not SPMD-partitionable, so the kernel is only valid when
-    each device sees the whole (or an explicitly shard_mapped) cache. The
-    eval runner does this automatically (evals/runner.py JaxGenerator).
+    A bare pallas_call is not SPMD-partitionable, so callers under a
+    multi-device mesh pass either ``impl="xla"`` (GSPMD partitions the
+    einsum path — the eval runner's choice, evals/runner.py JaxGenerator) or
+    ``impl="sharded"`` with ``mesh`` (the sharded-replica serve engine):
+    when the cache shape qualifies for the kernel, the decode runs it under
+    ``shard_map`` with the serving layout's specs — each device streams
+    exactly its local cache shard (parallel/decode_sharded.py) — and falls
+    back to the partitioned XLA path otherwise (short caches, int8 caches,
+    non-TPU backends, batch/head counts the mesh cannot divide).
     """
     quantized = k_scale is not None
+    if impl == "sharded":
+        if mesh is not None and _sharded_decode_eligible(
+            k_cache, mesh, quantized=quantized
+        ):
+            from prime_tpu.parallel.decode_sharded import flash_decode_sharded
+
+            return flash_decode_sharded(
+                q, k_cache, v_cache, cache_lengths, mesh, sm_scale=sm_scale,
+                softcap=softcap, window=window, sliding=sliding, sinks=sinks,
+                interpret=_pallas_interpret(),
+            )
+        impl = "xla"  # SPMD-safe einsum path, partitioned by GSPMD
     if impl == "pallas" or (impl == "auto" and _decode_pallas_eligible(k_cache)):
         from prime_tpu.ops.pallas_attention import flash_decode
 

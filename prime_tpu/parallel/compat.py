@@ -31,6 +31,19 @@ except AttributeError:  # jax 0.4.x
         return _experimental_shard_map(f, **kwargs)
 
 
+def enter_mesh(mesh):
+    """Context manager making ``mesh`` the ambient mesh for compiled calls
+    (bare-``PartitionSpec`` ``with_sharding_constraint`` sites resolve
+    against it). The toolchain spells this ``jax.set_mesh``; 0.4.x predates
+    it but a ``Mesh`` is itself a context manager with the same ambient
+    effect, so every dispatch site that wraps itself in ``enter_mesh`` runs
+    sharded on both builds instead of AttributeError-ing on the old one."""
+    try:
+        return jax.set_mesh(mesh)
+    except AttributeError:  # jax 0.4.x: Mesh.__enter__ sets the ambient mesh
+        return mesh
+
+
 try:
     pcast = jax.lax.pcast
 except AttributeError:  # jax 0.4.x
@@ -43,4 +56,4 @@ except AttributeError:  # jax 0.4.x
         return x
 
 
-__all__ = ["pcast", "shard_map"]
+__all__ = ["enter_mesh", "pcast", "shard_map"]

@@ -184,6 +184,16 @@ def cache_spec_for(config, sp: bool = False) -> P:
     return base
 
 
+def serving_cache_spec(config, mesh) -> P:
+    """THE serving KV-cache spec for ``mesh``: ``cache_spec_for`` (MLA keeps
+    its single-latent head axis replicated; an sp axis shards the slot
+    dimension for long-context serving) pruned to the axes the mesh actually
+    has. One owner for the derivation the engine, serve_model, and the eval
+    runner all need — a change to the MLA/sp rules lands in every consumer."""
+    has_sp = mesh.shape.get("sp", 1) > 1
+    return prune_spec(cache_spec_for(config, sp=has_sp), mesh)
+
+
 def sp_cache_spec() -> P:
     """KV cache (L, B, KH, hd, C) with the SLOT axis sharded over sp: a
     long-context cache larger than one chip's HBM spreads across the

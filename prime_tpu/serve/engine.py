@@ -33,9 +33,19 @@ compilation model:
   (drafts for chunk N+1 need chunk N's tokens on the host). See
   docs/architecture.md "Engine pipeline".
 
-Single-chip by default; pass ``mesh`` + ``cache_spec`` (from
-parallel.sharding) to run the same engine over a TPU slice — decode then
-takes the XLA attention path, which partitions under SPMD.
+Single-chip by default. A **sharded replica** spans a multi-chip slice from
+one declarative knob: ``mesh_config`` (a serve/mesh_config.ServeMeshConfig,
+a ``"dp=1,fsdp=2,tp=2"`` spec string, or the ``PRIME_SERVE_MESH`` env
+default behind ``prime serve --mesh``) makes the engine build the
+``(dp, fsdp, tp[, sp])`` mesh itself, place params and the paged KV cache
+as ``NamedSharding`` arrays, and pin staging rows/prefix segments to the
+same layout so cache hits assemble without a gather-to-host. Decode
+attention dispatches ``attn_impl="sharded"``: the flash kernel under
+``shard_map`` (parallel/decode_sharded.py) when the TPU cache shape is
+eligible, the SPMD-partitioned XLA path otherwise. The historical surface
+— caller-sharded params plus explicit ``mesh`` + ``cache_spec`` — still
+works and wins when both are given. See docs/architecture.md "Sharded
+replica".
 
 - **Block-granular prefix reuse.** Prompt prefixes are cached in a radix
   tree of MIN_BUCKET-aligned KV segments (serve/prefix_cache.py) under a
@@ -67,7 +77,7 @@ from typing import Any
 
 import numpy as np
 
-from prime_tpu.core.config import env_flag, env_float, env_int
+from prime_tpu.core.config import env_flag, env_float, env_int, env_str
 from prime_tpu.obs.flight import FlightRecorder
 from prime_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS, DEFAULT_TOKEN_BUCKETS, Registry
 from prime_tpu.obs.trace import TRACER, TraceContext
@@ -284,6 +294,7 @@ class ContinuousBatchingEngine:
         prefix_cache_host_mb: float | None = None,
         min_prefix: int = MIN_BUCKET,
         mesh: Any = None,
+        mesh_config: Any = None,
         cache_spec: Any = None,
         attn_impl: str = "auto",
         kv_quant: bool = False,
@@ -306,16 +317,52 @@ class ContinuousBatchingEngine:
         self.max_slots = max_slots
         self.capacity = capacity
         self.chunk = chunk
+        # declarative sharded replica (docs/architecture.md "Sharded
+        # replica"): a mesh_config — a ServeMeshConfig, a "--mesh"-style
+        # spec string, or the PRIME_SERVE_MESH env default — makes THIS
+        # engine span a multi-chip slice. The engine does the placement
+        # itself: params go down as NamedSharding-placed arrays
+        # (parallel.sharding.shard_params) and the cache spec derives from
+        # cache_spec_for pruned to the mesh, so callers declare a topology
+        # instead of pre-sharding pytrees. An explicit `mesh` kwarg (the
+        # historical surface: caller shards params, passes cache_spec) wins.
+        if mesh is None:
+            from prime_tpu.serve.mesh_config import ServeMeshConfig, parse_mesh_spec
+
+            if mesh_config is None:
+                mesh_config = env_str("PRIME_SERVE_MESH", "")
+            if isinstance(mesh_config, str):
+                mesh_config = parse_mesh_spec(mesh_config, jax.device_count())
+            if mesh_config is not None and not isinstance(mesh_config, ServeMeshConfig):
+                raise TypeError(
+                    "mesh_config must be a ServeMeshConfig or a spec string "
+                    f"like 'dp=1,fsdp=2,tp=2', got {type(mesh_config).__name__}"
+                )
+            if mesh_config is not None and mesh_config.total_devices > 1:
+                from prime_tpu.parallel.sharding import serving_cache_spec, shard_params
+
+                mesh = mesh_config.build()
+                params = shard_params(params, mesh, config)
+                self.params = params
+                if cache_spec is None:
+                    cache_spec = serving_cache_spec(config, mesh)
         self.mesh = mesh
         self.cache_spec = cache_spec
-        # a pallas_call cannot partition under SPMD jit: any multi-device mesh
-        # must take the XLA decode path (same rule as evals.runner.JaxGenerator)
+        self.mesh_devices = int(getattr(mesh, "size", 1) or 1) if mesh is not None else 1
+        self.mesh_axes: dict[str, int] = {
+            str(k): int(v) for k, v in dict(getattr(mesh, "shape", None) or {}).items()
+        }
+        # a pallas_call cannot partition under SPMD jit: a multi-device mesh
+        # takes the "sharded" dispatch — decode attention runs the flash
+        # kernel under shard_map (parallel/decode_sharded.py) when eligible
+        # and falls back to the SPMD-safe XLA einsum path everywhere else
+        # (same divisibility rules as evals.runner.JaxGenerator)
         if mesh is not None and getattr(mesh, "size", 1) > 1 and attn_impl == "auto":
-            attn_impl = "xla"
+            attn_impl = "sharded"
         # int8 caches ride the flash kernel on single-device engines (auto
-        # dispatch, round 4); the mesh>1 override above is what keeps
-        # multi-device engines on the SPMD-safe XLA path, independent of
-        # quantization
+        # dispatch, round 4); on meshes the "sharded" dispatch above falls
+        # back to the SPMD-safe XLA path for them (the shard_map wrapper
+        # does not plumb the scale epilogue yet)
         self.attn_impl = attn_impl
         self.kv_quant = kv_quant
         # prompt-lookup speculation: each tick proposes draft_len n-gram
@@ -410,6 +457,7 @@ class ContinuousBatchingEngine:
                 "PRIME_SERVE_PREFIX_CACHE_HOST_MB", DEFAULT_PREFIX_CACHE_HOST_MB
             )
         self.prefix_cache_host_mb = float(prefix_cache_host_mb)
+        self._host_tier_gated = False
         if self.prefix_cache_host_mb > 0 and mesh is not None and getattr(mesh, "size", 1) > 1:
             # the spill tier's converters are not sharding-preserving:
             # device_get raises on non-fully-addressable multi-host arrays,
@@ -423,6 +471,11 @@ class ContinuousBatchingEngine:
                 stacklevel=2,
             )
             self.prefix_cache_host_mb = 0.0
+            # remembered for the serve_prefix_host_tier_disabled gauge and
+            # the stats() key below (the registry doesn't exist yet here):
+            # an operator who configured a host tier must see the gate in
+            # metrics, not only in a startup log line that scrolled away
+            self._host_tier_gated = True
         self.prefix_cache: BlockPrefixCache | None = (
             BlockPrefixCache(
                 int(self.prefix_cache_mb * 2**20), block=MIN_BUCKET,
@@ -560,6 +613,27 @@ class ContinuousBatchingEngine:
         self._m_warmup_s = r.gauge(
             "serve_warmup_seconds", "Wall seconds the AOT warmup pass took"
         )
+        # sharded replica: how many devices this engine's mesh spans (1 =
+        # single-chip), and whether a configured prefix-cache host tier was
+        # gated off because the mesh makes the spill converters unsafe
+        self._m_mesh_devices = r.gauge(
+            "serve_mesh_devices", "Devices in this replica's serving mesh (1 = single-chip)"
+        )
+        self._m_mesh_devices.set(self.mesh_devices)
+        self._m_host_tier_disabled = r.gauge(
+            "serve_prefix_host_tier_disabled",
+            "1 when a configured prefix-cache host tier was disabled because "
+            "the engine runs on a multi-device mesh (spill converters are "
+            "not sharding-preserving yet)",
+        )
+        self._m_host_tier_disabled.set(1 if self._host_tier_gated else 0)
+        # sharded-dispatch trace evidence: device-program spans on a meshed
+        # engine carry the mesh width so a waterfall distinguishes a
+        # single-chip dispatch from one spanning the slice (single-chip
+        # span schemas stay byte-identical — the attr only exists on meshes)
+        self._span_mesh: dict[str, int] = (
+            {"mesh_devices": self.mesh_devices} if self.mesh_devices > 1 else {}
+        )
         # always-on flight recorder (obs/flight.py): bounded per-request
         # timelines readable at GET /debug/requests even with tracing off;
         # PRIME_SERVE_SLOW_MS auto-persists slow timelines to the trace sink
@@ -641,14 +715,66 @@ class ContinuousBatchingEngine:
 
     def _mesh_ctx(self):
         """Mesh context for compiled calls — the engine thread does not
-        inherit a caller's jax.set_mesh, so every dispatch site enters it."""
+        inherit a caller's jax.set_mesh, so every dispatch site enters it
+        (parallel.compat.enter_mesh: jax.set_mesh on the toolchain, the
+        Mesh's own context manager on 0.4.x builds)."""
         import contextlib
 
         if self.mesh is None:
             return contextlib.nullcontext()
+        from prime_tpu.parallel.compat import enter_mesh
+
+        return enter_mesh(self.mesh)
+
+    def _cache_constraint(self):
+        """The sharding constraint for the engine cache inside compiled
+        programs: a NamedSharding when a mesh is attached (resolves without
+        an ambient mesh — 0.4.x builds have no jax.set_mesh), else the raw
+        spec for historical callers that manage their own mesh context."""
+        if self.cache_spec is None:
+            return None
+        if self.mesh is None:
+            return self.cache_spec
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, self.cache_spec)
+
+    def _row_constraint(self):
+        """Sharding constraint for batch-1..N staging rows and assembled
+        prefix rows: the cache spec's layer/kv-head/head-dim placement with
+        the batch and capacity entries replicated (a staging row's batch is
+        a wave size that need not divide the data axes, and its capacity is
+        a power-of-two bucket the sp axis need not divide). Keeping rows —
+        and therefore the radix cache's stored segments, which are lazy
+        slices of them — tp-sharded is what lets a prefix hit feed
+        assemble_row without ever gathering KV to one device. None when
+        nothing would shard (single chip, or an MLA cache whose single
+        latent head stays replicated)."""
+        if self.mesh is None or self.cache_spec is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = tuple(self.cache_spec)
+        if len(spec) < 4:
+            return None
+        row_spec = PartitionSpec(spec[0], None, spec[2], spec[3], None)
+        if all(entry is None for entry in row_spec):
+            return None
+        return NamedSharding(self.mesh, row_spec)
+
+    def _constrain_row_fields(self, row, constraint):
+        """Apply ``constraint`` to a staging row's capacity-axis leaves
+        inside a traced program (lengths is capacity-free and skipped)."""
+        if constraint is None:
+            return row
         import jax
 
-        return jax.set_mesh(self.mesh)
+        updates = {}
+        for name in _CAPACITY_FIELDS:
+            leaf = getattr(row, name, None)
+            if leaf is not None:
+                updates[name] = jax.lax.with_sharding_constraint(leaf, constraint)
+        return row._replace(**updates) if updates else row
 
     # ---- compiled programs ----
 
@@ -657,7 +783,9 @@ class ContinuousBatchingEngine:
 
         from prime_tpu.models.llama import forward
 
-        config, attn_impl = self.config, self.attn_impl
+        config, attn_impl, mesh = self.config, self.attn_impl, self.mesh
+        row_constraint = self._row_constraint()
+        constrain = self._constrain_row_fields
 
         def chunk_prefill(params, row, tokens, offset, last_in_chunk):
             # write-at-offset + attend-over-row (models.llama chunked prefill):
@@ -670,9 +798,11 @@ class ContinuousBatchingEngine:
             logits, row = forward(
                 params, tokens, config, cache=row, decode=False,
                 attn_impl=attn_impl, prefill_offset=offset,
-                last_positions=last_in_chunk,
+                last_positions=last_in_chunk, mesh=mesh,
             )
-            return row, logits  # logits (1, 1, V): the gathered position only
+            # sharded replica: pin the staged row's kv-head/tp placement so
+            # the prefix segments sliced from it stay sharded in the cache
+            return constrain(row, row_constraint), logits
 
         return jax.jit(chunk_prefill, donate_argnums=(1,))
 
@@ -683,7 +813,8 @@ class ContinuousBatchingEngine:
         from prime_tpu.models.llama import forward
 
         config, attn_impl, chunk = self.config, self.attn_impl, self.chunk
-        cache_spec = self.cache_spec
+        mesh = self.mesh
+        cache_spec = self._cache_constraint()
 
         def decode(params, cache, last, temps, top_ps, active, rng):
             # neutralize retired slots' stale sampling params: a finished
@@ -703,6 +834,7 @@ class ContinuousBatchingEngine:
                     cache=cache,
                     decode=True,
                     attn_impl=attn_impl,
+                    mesh=mesh,
                 )
                 if cache_spec is not None:
                     new_cache = new_cache._replace(
@@ -742,7 +874,8 @@ class ContinuousBatchingEngine:
         from prime_tpu.models.speculative import verify_window_tokens
 
         config, attn_impl = self.config, self.attn_impl
-        cache_spec = self.cache_spec
+        mesh = self.mesh
+        cache_spec = self._cache_constraint()
 
         def spec_decode(params, cache, last, temps, top_ps, active, drafts, rng):
             """One verify pass over (B, D+1) windows at each slot's cache
@@ -756,7 +889,7 @@ class ContinuousBatchingEngine:
             window = jnp.concatenate([last[:, None], drafts], axis=1)  # (B, D+1)
             logits, new_cache = forward(
                 params, window, config, cache=cache, decode=False,
-                attn_impl=attn_impl, prefill_offset=offsets,
+                attn_impl=attn_impl, prefill_offset=offsets, mesh=mesh,
             )
             if cache_spec is not None:
                 constrained = {
@@ -1314,7 +1447,9 @@ class ContinuousBatchingEngine:
         self._rng, rng = jax.random.split(self._rng)
         mask = self._active.copy()
         seq = next(self._chunk_seq)
-        with TRACER.span("serve.dispatch", seq=seq, steps=self.chunk), self._mesh_ctx():
+        with TRACER.span(
+            "serve.dispatch", seq=seq, steps=self.chunk, **self._span_mesh
+        ), self._mesh_ctx():
             self._cache, self._last, toks = self._decode_fn(
                 self.params, self._cache, self._last,
                 self._temps, self._top_ps, jnp.asarray(mask), rng,
@@ -1475,7 +1610,7 @@ class ContinuousBatchingEngine:
         self._rng, rng = jax.random.split(self._rng)
         with TRACER.span(
             "serve.prefill", context=req.trace, slot=slot,
-            prompt_len=len(ids), request=req.id,
+            prompt_len=len(ids), request=req.id, **self._span_mesh,
         ), self._mesh_ctx():
             for off, size in plan:
                 chunk_ids = ids[off : off + size]
@@ -1556,7 +1691,9 @@ class ContinuousBatchingEngine:
         self._rng, rng = jax.random.split(self._rng)
         row = init_cache(self.config, n, row_cb, dtype=self._dtype, quantized=self.kv_quant)
         logits = None
-        with TRACER.span("serve.prefill_batch", batch=n, row_capacity=row_cb), self._mesh_ctx():
+        with TRACER.span(
+            "serve.prefill_batch", batch=n, row_capacity=row_cb, **self._span_mesh
+        ), self._mesh_ctx():
             for off, size in plan:
                 chunk_rows = []
                 rels = []
@@ -1598,6 +1735,7 @@ class ContinuousBatchingEngine:
             TRACER.emit(
                 "serve.prefill", prefill_s, context=req.trace,
                 request=req.id, batch=n, prompt_len=len(req.prompt_ids),
+                **self._span_mesh,
             )
         self._m_admit_batch.observe(n)
         self._m_admitted.inc(len(reqs))
@@ -1617,7 +1755,7 @@ class ContinuousBatchingEngine:
         import jax
         import jax.numpy as jnp
 
-        cache_spec = self.cache_spec
+        cache_spec = self._cache_constraint()
 
         def finalize_batch(
             cache, last, temps, top_ps, rows, logits, lengths, slots, temps_new,
@@ -1703,6 +1841,8 @@ class ContinuousBatchingEngine:
         from prime_tpu.models.llama import init_cache
 
         config, dtype, quantized = self.config, self._dtype, self.kv_quant
+        row_constraint = self._row_constraint()
+        constrain = self._constrain_row_fields
 
         def assemble(segments, takes, target_cb):
             row = init_cache(config, 1, target_cb, dtype=dtype, quantized=quantized)
@@ -1719,8 +1859,11 @@ class ContinuousBatchingEngine:
                     out[name] = jax.lax.dynamic_update_slice(out[name], piece, start)
                 off += take
             # lengths stay init_cache's zeros: chunked prefill masks via
-            # prefill_offset, and finalize sets slot lengths explicitly
-            return row._replace(**out)
+            # prefill_offset, and finalize sets slot lengths explicitly.
+            # Sharded replica: the assembled row keeps the segments' tp
+            # placement (cached segments were sliced from constrained rows),
+            # so a prefix hit never funnels KV through one device.
+            return constrain(row._replace(**out), row_constraint)
 
         return jax.jit(assemble, static_argnums=(1, 2))
 
@@ -1875,7 +2018,9 @@ class ContinuousBatchingEngine:
         self._rng, rng = jax.random.split(self._rng)
         active = jnp.asarray(self._active)
         t_start = time.monotonic()
-        with TRACER.span("serve.decode_chunk", steps=self.chunk), self._mesh_ctx():
+        with TRACER.span(
+            "serve.decode_chunk", steps=self.chunk, **self._span_mesh
+        ), self._mesh_ctx():
             self._cache, self._last, toks = self._decode_fn(
                 self.params, self._cache, self._last,
                 self._temps, self._top_ps, active, rng,
@@ -1989,6 +2134,8 @@ class ContinuousBatchingEngine:
             "queue_depth": int(values["serve_queue_depth"]),
             "max_slots": int(self.max_slots),
             "max_queue": int(self.max_queue),
+            "mesh_devices": int(self.mesh_devices),
+            "mesh_axes": dict(self.mesh_axes),
             "state": "draining" if self._draining else "running",
             "overlap": bool(self.overlap),
             "inflight_depth": int(values["serve_inflight_depth"]),
@@ -1999,6 +2146,7 @@ class ContinuousBatchingEngine:
             "warmup_programs": int(values["serve_warmup_programs"]),
             "prefix_cache_bytes": int(values["serve_prefix_cache_bytes"]),
             "prefix_cache_host_bytes": int(values["serve_prefix_cache_host_bytes"]),
+            "prefix_host_tier_disabled": int(values["serve_prefix_host_tier_disabled"]),
             "prefix_cache_nodes": int(values["serve_prefix_cache_nodes"]),
             "prefix_evictions": int(values["serve_prefix_evictions_total"]),
             "prefix_spills": int(values["serve_prefix_spills_total"]),

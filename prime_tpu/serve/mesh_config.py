@@ -1,0 +1,152 @@
+"""Declarative serving-mesh description for the sharded-replica engine.
+
+One replica of the continuous-batching engine can span a multi-chip slice:
+params and the paged KV cache live as ``NamedSharding``-placed arrays on a
+``(dp, fsdp, tp[, sp])`` mesh and the prefill/decode/finalize programs
+partition under SPMD (docs/architecture.md "Sharded replica"). This module
+is the declarative front door: a :class:`ServeMeshConfig` names the axes and
+their sizes, parses from the ``--mesh`` / ``PRIME_SERVE_MESH`` spec string,
+and builds the ``jax.sharding.Mesh`` lazily (the dataclass itself is
+jax-free so the CLI can validate a spec without initializing a backend).
+
+Spec grammar — comma-separated axis entries, each ``name`` or ``name=N``:
+
+- ``dp=1,fsdp=2,tp=2``  — explicit sizes (4 devices).
+- ``dp,fsdp,tp``        — unsized axes default to 1 except the LAST unsized
+  one, which absorbs every remaining device (8 devices → dp=1, fsdp=1, tp=8).
+- ``tp=4``              — a pure tensor-parallel replica on 4 chips.
+
+Axis names are the serving-layout vocabulary of ``parallel/sharding.py``
+(``dp``/``fsdp`` data axes, ``tp`` megatron tensor parallel, ``sp`` the
+slot-sharded long-context axis); order in the spec is mesh order, so put
+``tp`` last to keep tensor-parallel collectives on the fastest ICI dim
+(same convention as ``parallel.mesh.mesh_for_slice``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+AXIS_NAMES = ("dp", "fsdp", "tp", "sp")
+
+
+@dataclass(frozen=True)
+class ServeMeshConfig:
+    """Declarative mesh description: parallel to SNIPPETS [3] ``MeshConfig``
+    — axis names and lengths of equal rank, validated at construction."""
+
+    axis_names: tuple[str, ...]
+    axis_lengths: tuple[int, ...]
+
+    def __post_init__(self) -> None:
+        if len(self.axis_lengths) != len(self.axis_names):
+            raise ValueError(
+                f"axis_lengths ({self.axis_lengths}) and axis_names "
+                f"({self.axis_names}) must have equal rank"
+            )
+        if not self.axis_names:
+            raise ValueError("a mesh needs at least one axis")
+        if any(length <= 0 for length in self.axis_lengths):
+            raise ValueError(f"all axis lengths must be positive, got {self.axis_lengths}")
+        if len(set(self.axis_names)) != len(self.axis_names):
+            raise ValueError(f"duplicate axis name in {self.axis_names}")
+        for name in self.axis_names:
+            if name not in AXIS_NAMES:
+                raise ValueError(
+                    f"unknown mesh axis {name!r} (serving axes: {', '.join(AXIS_NAMES)})"
+                )
+
+    @property
+    def total_devices(self) -> int:
+        n = 1
+        for length in self.axis_lengths:
+            n *= length
+        return n
+
+    @property
+    def axes(self) -> dict[str, int]:
+        return dict(zip(self.axis_names, self.axis_lengths))
+
+    @property
+    def spec(self) -> str:
+        """Canonical spec string (round-trips through :func:`parse_mesh_spec`)."""
+        return ",".join(f"{n}={s}" for n, s in zip(self.axis_names, self.axis_lengths))
+
+    def build(self, devices=None):
+        """Materialize the ``jax.sharding.Mesh`` over the FIRST
+        ``total_devices`` of ``devices`` (default ``jax.devices()``) — a
+        4-device config on an 8-device host is a 4-device mesh, not an
+        error, so a forced-CPU test mesh and a sub-slice replica both work."""
+        import jax
+
+        from prime_tpu.parallel.mesh import make_mesh
+
+        devices = list(jax.devices() if devices is None else devices)
+        if self.total_devices > len(devices):
+            raise ValueError(
+                f"mesh {self.spec} needs {self.total_devices} devices; "
+                f"only {len(devices)} are available"
+            )
+        return make_mesh(self.axes, devices[: self.total_devices])
+
+
+def parse_mesh_spec(spec: str, device_count: int) -> ServeMeshConfig | None:
+    """Parse a ``--mesh`` / ``PRIME_SERVE_MESH`` spec into a
+    :class:`ServeMeshConfig`. Empty/blank specs mean "no mesh" (None).
+    Unsized axes default to 1, except the last unsized axis which absorbs
+    every device left after the sized ones — so ``dp,fsdp,tp`` spans the
+    whole host and ``fsdp=2,tp`` gives tp the other factor."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    names: list[str] = []
+    sizes: list[int | None] = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, eq, size = entry.partition("=")
+        name = name.strip()
+        if eq:
+            try:
+                parsed = int(size.strip())
+            except ValueError:
+                raise ValueError(
+                    f"mesh axis {entry!r}: size must be an integer"
+                ) from None
+            if parsed <= 0:
+                raise ValueError(f"mesh axis {entry!r}: size must be positive")
+            sizes.append(parsed)
+        else:
+            sizes.append(None)
+        names.append(name)
+    if not names:
+        return None
+    sized_product = 1
+    for s in sizes:
+        if s is not None:
+            sized_product *= s
+    # the LAST unsized axis absorbs the remaining factor; earlier ones are 1
+    last_unsized = max((i for i, s in enumerate(sizes) if s is None), default=None)
+    if last_unsized is None:
+        # fully sized: any sub-slice of the host is fine (build() takes the
+        # first total_devices devices) — only an absorbing axis needs the
+        # device count to factor cleanly
+        if sized_product > device_count:
+            raise ValueError(
+                f"mesh {spec!r}: sized axes multiply to {sized_product}, but "
+                f"only {device_count} devices are available"
+            )
+    elif device_count % max(1, sized_product) or sized_product > device_count:
+        raise ValueError(
+            f"mesh {spec!r}: sized axes multiply to {sized_product}, which "
+            f"does not divide the {device_count} available devices (needed "
+            "to resolve the unsized absorbing axis)"
+        )
+    resolved = [
+        (device_count // sized_product if i == last_unsized else 1)
+        if s is None
+        else s
+        for i, s in enumerate(sizes)
+    ]
+    return ServeMeshConfig(axis_names=tuple(names), axis_lengths=tuple(resolved))

@@ -28,6 +28,7 @@ import os
 import threading
 import time
 import uuid
+import warnings
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 from urllib.parse import parse_qs, urlsplit
@@ -497,6 +498,13 @@ class InferenceServer:
                 stats = stats_fn()
                 for key in ("queue_depth", "active_slots", "max_slots"):
                     payload[key] = int(stats.get(key, 0))
+                # sharded replica: advertise the mesh shape so operators and
+                # routers can tell a 4-chip replica from four 1-chip ones
+                # (additive — pre-mesh engines simply omit the keys)
+                if int(stats.get("mesh_devices", 0) or 0) > 1:
+                    payload["mesh_devices"] = int(stats["mesh_devices"])
+                    if isinstance(stats.get("mesh_axes"), dict):
+                        payload["mesh"] = dict(stats["mesh_axes"])
             except Exception as e:  # noqa: BLE001 — health must never 500
                 payload["stats_error"] = str(e)[:200]
         # ADDITIVE hot-prefix advertisement (serve/digest.py): text-proxy
@@ -762,6 +770,7 @@ def serve_model(
     host: str = "127.0.0.1",
     port: int = 8000,
     continuous: bool = False,
+    mesh: str | None = None,
     max_slots: int = 8,
     slot_capacity: int = 2048,
     chunk: int = 8,
@@ -790,9 +799,37 @@ def serve_model(
     "Prefix cache". ``max_queue`` (None = the PRIME_SERVE_MAX_QUEUE env
     default, 0 = unbounded) bounds the engine's pending queue: submissions
     past it get 429 + Retry-After instead of queueing unboundedly — the
-    admission-control half of docs/architecture.md "Serve fleet"."""
+    admission-control half of docs/architecture.md "Serve fleet".
+    ``mesh`` (None = the ``PRIME_SERVE_MESH`` env default) is the sharded-
+    replica spec string (``"dp=1,fsdp=2,tp=2"``): the continuous engine
+    builds the mesh, shards params and the paged KV cache onto it, and
+    serves one replica across the whole slice — docs/architecture.md
+    "Sharded replica". It is the declarative alternative to ``slice_name``
+    (which derives a mesh from a provisioned slice's topology); passing
+    both is an error."""
     from prime_tpu.evals.runner import JaxGenerator
 
+    if mesh and slice_name:
+        raise ValueError(
+            "mesh and slice_name both describe the serving mesh; pass one "
+            "(--mesh is the declarative axis spec, --slice derives it from "
+            "the slice topology)"
+        )
+    if mesh and not continuous:
+        raise ValueError("--mesh requires --continuous (the sharded replica is engine-only)")
+    if mesh is None and env_str("PRIME_SERVE_MESH", "").strip() and (
+        not continuous or slice_name
+    ):
+        # the env default only reaches the continuous engine (and a --slice
+        # mesh wins over it): an ambient PRIME_SERVE_MESH must not fail a
+        # plain serve the way the explicit flag does, but silently serving
+        # single-chip/slice-derived would be worse — say so once, loudly
+        warnings.warn(
+            "PRIME_SERVE_MESH is set but ignored: the sharded replica needs "
+            "continuous=True and no slice_name (pass --continuous / drop "
+            "--slice, or use --mesh to fail fast instead)",
+            stacklevel=2,
+        )
     # fail fast on EADDRINUSE; admin_token=None reads PRIME_FLEET_ADMIN_TOKEN
     server = InferenceServer(model, host=host, port=port, admin_token=admin_token)
     try:
@@ -816,16 +853,14 @@ def serve_model(
 
             cache_spec = None
             if generator.mesh is not None:
-                from prime_tpu.parallel.sharding import cache_spec_for, prune_spec
-
                 # an sp axis shards each slot's KV cache over the slice's
                 # slot dimension — long-context serving where one request's
-                # cache exceeds a single chip's HBM (mirrors evals/runner.py);
-                # MLA caches keep their single-latent head axis replicated
-                has_sp = generator.mesh.shape.get("sp", 1) > 1
-                cache_spec = prune_spec(
-                    cache_spec_for(generator.config, sp=has_sp), generator.mesh
-                )
+                # cache exceeds a single chip's HBM; MLA caches keep their
+                # single-latent head axis replicated (serving_cache_spec is
+                # the one owner, shared with the engine and evals/runner.py)
+                from prime_tpu.parallel.sharding import serving_cache_spec
+
+                cache_spec = serving_cache_spec(generator.config, generator.mesh)
             engine = ContinuousBatchingEngine(
                 generator.params,
                 generator.config,
@@ -835,6 +870,7 @@ def serve_model(
                 capacity=slot_capacity,
                 chunk=chunk,
                 mesh=generator.mesh,
+                mesh_config=mesh,
                 cache_spec=cache_spec,
                 kv_quant=kv_quant,
                 speculative=speculative,

@@ -131,12 +131,12 @@ def make_train_step(
         if config.is_moe:
             logits, _, aux = forward(
                 params, tokens, config, cache=None, attn_impl=attn_impl,
-                return_aux=True, remat=remat, ring_mesh=ring_mesh,
+                return_aux=True, remat=remat, mesh=ring_mesh,
             )
             return cross_entropy_loss(logits, targets, mask) + aux_weight * aux
         logits, _ = forward(
             params, tokens, config, cache=None, attn_impl=attn_impl, remat=remat,
-            ring_mesh=ring_mesh,
+            mesh=ring_mesh,
         )
         return cross_entropy_loss(logits, targets, mask)
 

@@ -25,6 +25,13 @@ def main() -> int:
     parser.add_argument("--scenario", default="smoke")
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument(
+        "--mesh", default=None,
+        help="Sharded-replica mesh spec (e.g. 'dp=1,fsdp=2,tp=2'): every "
+             "replica becomes ONE engine spanning that mesh. Run under "
+             "XLA_FLAGS=--xla_force_host_platform_device_count=N to measure "
+             "the multi-chip serving path on CPU (MULTICHIP_*.json rounds).",
+    )
     parser.add_argument("--time-scale", type=float, default=1.0)
     args = parser.parse_args()
     outcome = run_smoke(
@@ -32,6 +39,7 @@ def main() -> int:
         scenario=args.scenario,
         seed=args.seed,
         replicas=args.replicas,
+        mesh=args.mesh,
         time_scale=args.time_scale,
     )
     return 0 if outcome["ok"] else 1

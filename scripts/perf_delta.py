@@ -21,20 +21,33 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from prime_tpu.loadgen.perf_delta import delta_json, delta_table, load_rounds  # noqa: E402
+from prime_tpu.loadgen.perf_delta import (  # noqa: E402
+    delta_json,
+    delta_table,
+    load_all_rounds,
+    load_rounds,
+)
 
 
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--root", default=".", help="Directory holding BENCH_*.json")
-    parser.add_argument("--pattern", default="BENCH_*.json")
+    parser.add_argument(
+        "--pattern", default=None,
+        help="Restrict to one glob (default: BENCH_*.json + MULTICHIP_*.json "
+             "merged — multichip rounds render their own mc-prefixed rows).",
+    )
     parser.add_argument("--json", action="store_true", help="Machine-readable output")
     parser.add_argument(
         "--min-rounds", type=int, default=2,
         help="Fail (exit 1) below this many parseable rounds.",
     )
     args = parser.parse_args()
-    rounds = load_rounds(args.root, args.pattern)
+    rounds = (
+        load_rounds(args.root, args.pattern)
+        if args.pattern
+        else load_all_rounds(args.root)
+    )
     if args.json:
         print(json.dumps(delta_json(rounds), indent=2))
     else:

@@ -41,7 +41,7 @@ def test_cp_forward_matches_plain():
     tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0, CFG.vocab_size)
     ref, _ = forward(params, tokens, CFG, attn_impl="xla")
     out, _ = jax.jit(
-        lambda p, t: forward(p, t, CFG, attn_impl="ring", ring_mesh=mesh)
+        lambda p, t: forward(p, t, CFG, attn_impl="ring", mesh=mesh)
     )(params, _cp_put(tokens, mesh))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
@@ -55,7 +55,7 @@ def test_cp_forward_uniform_window_and_sinks():
     tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 128), 0, CFG.vocab_size)
     ref, _ = forward(params, tokens, windowed, attn_impl="xla")
     out, _ = jax.jit(
-        lambda p, t: forward(p, t, windowed, attn_impl="ring", ring_mesh=mesh)
+        lambda p, t: forward(p, t, windowed, attn_impl="ring", mesh=mesh)
     )(params, _cp_put(tokens, mesh))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
@@ -66,7 +66,7 @@ def test_cp_forward_uniform_window_and_sinks():
     toks = jax.random.randint(jax.random.PRNGKey(5), (2, 128), 1, sinky.vocab_size)
     ref, _ = forward(sp, toks, sinky, attn_impl="xla")
     out, _ = jax.jit(
-        lambda p, t: forward(p, t, sinky, attn_impl="ring", ring_mesh=mesh)
+        lambda p, t: forward(p, t, sinky, attn_impl="ring", mesh=mesh)
     )(sp, _cp_put(toks, mesh))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
@@ -81,7 +81,7 @@ def test_cp_forward_softcap():
     tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 128), 0, capped.vocab_size)
     ref, _ = forward(params, tokens, capped, attn_impl="xla")
     out, _ = jax.jit(
-        lambda p, t: forward(p, t, capped, attn_impl="ring", ring_mesh=mesh)
+        lambda p, t: forward(p, t, capped, attn_impl="ring", mesh=mesh)
     )(params, _cp_put(tokens, mesh))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
@@ -100,7 +100,7 @@ def test_cp_composes_with_tp_and_fsdp():
     ref, _ = forward(params, tokens, CFG, attn_impl="xla")
     sharded = shard_params(params, mesh, CFG)
     out, _ = jax.jit(
-        lambda p, t: forward(p, t, CFG, attn_impl="ring", ring_mesh=mesh)
+        lambda p, t: forward(p, t, CFG, attn_impl="ring", mesh=mesh)
     )(sharded, _cp_put(tokens, mesh))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
     # a tp degree the kv heads can't divide is an error, not replication
@@ -145,13 +145,13 @@ def test_cp_rejects_invalid_modes():
     tokens = jnp.zeros((2, 128), jnp.int32)
     with pytest.raises(ValueError, match="no-cache"):
         forward(
-            params, tokens, CFG, attn_impl="ring", ring_mesh=mesh,
+            params, tokens, CFG, attn_impl="ring", mesh=mesh,
             cache=init_cache(CFG, 2, 256, dtype=jnp.float32),
         )
     with pytest.raises(ValueError, match="'sp' axis"):
-        forward(params, tokens, CFG, attn_impl="ring", ring_mesh=make_mesh({"dp": 8}))
+        forward(params, tokens, CFG, attn_impl="ring", mesh=make_mesh({"dp": 8}))
     with pytest.raises(ValueError, match="uniform"):
         forward(
             params, tokens, CFG.scaled(sliding_window=16, sliding_pattern="even"),
-            attn_impl="ring", ring_mesh=mesh,
+            attn_impl="ring", mesh=mesh,
         )

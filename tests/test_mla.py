@@ -182,7 +182,7 @@ def test_ring_rejected_for_mla(tokens):
 
     mesh = make_mesh({"sp": 2}, devices=jax.devices()[:2])
     with pytest.raises(ValueError, match="ring"):
-        forward(PARAMS, tokens, CFG, attn_impl="ring", ring_mesh=mesh)
+        forward(PARAMS, tokens, CFG, attn_impl="ring", mesh=mesh)
 
 
 def test_param_count_matches_tree():
