@@ -697,11 +697,15 @@ def main() -> None:
     def run_serve(
         kv_quant: bool = False, speculative: bool = False, prompts=None,
         record_counters: bool = False, obs_key: str | None = None,
-        scenario: str = "serve", mesh_config="",
-    ) -> float:
+        scenario: str = "serve", mesh_config="", max_new: int | None = None,
+    ) -> dict:
+        """Drive one engine configuration through the loadgen runner and
+        return the registry-windowed SLO row (tok/s, TPOT quantiles,
+        accept ratio, ...)."""
         from prime_tpu.serve.engine import ContinuousBatchingEngine
 
         prompts = prompts or serve_prompts
+        new_tokens = max_new if max_new is not None else req_new
         engine = ContinuousBatchingEngine(
             params, config, pad_id=0, max_slots=serve_slots,
             capacity=SERVE_CAPACITY, chunk=SERVE_CHUNK,
@@ -714,7 +718,7 @@ def main() -> None:
             # DIFFERENT chunk shape whose first compile would otherwise land
             # mid-measurement (remote TPU compiles cost seconds each)
             for _ in range(2):
-                warm = engine.submit(prompts[0], max_new_tokens=req_new)
+                warm = engine.submit(prompts[0], max_new_tokens=new_tokens)
                 while not warm.done:
                     engine.tick()
             # burst warmup: distinct cold prompts (lead token 2+ so they
@@ -746,7 +750,7 @@ def main() -> None:
             # every arrival immediate, exactly the old submit-all loop) and
             # brackets it with registry snapshots; tok/s comes from the
             # token-counter delta over the captured_at window
-            schedule = schedule_from_prompts(scenario, prompts, req_new)
+            schedule = schedule_from_prompts(scenario, prompts, new_tokens)
             result = run_schedule(
                 schedule, EngineTarget(engine), scenario=scenario, time_scale=0.0,
             )
@@ -784,14 +788,14 @@ def main() -> None:
                 # the headline mean
                 engine.stats()  # refresh point-in-time gauges
                 record[obs_key] = engine.registry.snapshot()
-            return row["tok_s"]
+            return row
         finally:
             del engine
 
     # separate guards: an int8 failure must not mark the bf16 number failed
     try:
         record["serve_tok_s"] = round(
-            run_serve(kv_quant=False, record_counters=True, obs_key="serve_obs"), 1
+            run_serve(kv_quant=False, record_counters=True, obs_key="serve_obs")["tok_s"], 1
         )
         record["serve_requests"] = n_req
         # roofline approximation: with the queue longer than the slot count
@@ -817,7 +821,7 @@ def main() -> None:
     try:
         # int8-cache engine: same load, half the KV HBM traffic per step
         record["serve_int8_tok_s"] = round(
-            run_serve(kv_quant=True, obs_key="serve_int8_obs", scenario="serve_int8"), 1
+            run_serve(kv_quant=True, obs_key="serve_int8_obs", scenario="serve_int8")["tok_s"], 1
         )
         print(f"# bench: serve int8 {record['serve_int8_tok_s']} tok/s", flush=True)
     except Exception as e:  # noqa: BLE001
@@ -825,20 +829,38 @@ def main() -> None:
         print(f"# bench: serve int8 section failed: {e}", flush=True)
     print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
     try:
-        # speculative engine on genuinely PERIODIC prompts (the favorable
-        # regime: continuations repeat the cycle, so n-gram drafts land and
-        # each verify pass emits several tokens) — the default serve_prompts
-        # are an arithmetic progression with no repeated bigrams
-        periodic = [
-            [1] + list(range(3 + i, 11 + i)) * 12 for i in range(n_req)
-        ]
-        record["serve_spec_tok_s"] = round(
-            run_serve(
-                speculative=True, prompts=periodic, obs_key="serve_spec_obs",
-                scenario="serve_spec",
-            ), 1
+        # speculative on/off over the loadgen DSL's spec_friendly scenario
+        # (repetitive/templated completions — the favorable regime:
+        # continuations settle into loops, n-gram drafts land, and each
+        # fused propose+verify dispatch emits several tokens). BOTH legs run
+        # the same schedule through the registry-windowed runner, so the
+        # record carries the spec-on/off tok/s + TPOT delta and the accept
+        # ratio as SLO-report evidence, not stopwatch numbers. Speculation
+        # now rides the overlap pipeline and (in the sharded section's mesh
+        # runs) the multi-chip path — docs/architecture.md "Speculative
+        # decoding".
+        from prime_tpu.loadgen.report import spec_comparison_record
+        from prime_tpu.loadgen.scenario import SCENARIOS, build_schedule
+
+        spec_schedule = build_schedule(
+            SCENARIOS["spec_friendly"](0), vocab=config.vocab_size
         )
-        print(f"# bench: serve speculative {record['serve_spec_tok_s']} tok/s", flush=True)
+        spec_prompts = [list(r.prompt_ids) for r in spec_schedule]
+        spec_new = max(r.max_new_tokens for r in spec_schedule)
+        off_row = run_serve(
+            prompts=spec_prompts, max_new=spec_new, scenario="serve_spec_off",
+        )
+        on_row = run_serve(
+            speculative=True, prompts=spec_prompts, max_new=spec_new,
+            obs_key="serve_spec_obs", scenario="serve_spec",
+        )
+        record.update(spec_comparison_record(off_row, on_row, digits=1))
+        print(
+            f"# bench: serve speculative {record['serve_spec_tok_s']} tok/s "
+            f"(spec off {record['serve_spec_off_tok_s']}, accept ratio "
+            f"{record.get('serve_spec_accept_ratio')})",
+            flush=True,
+        )
     except Exception as e:  # noqa: BLE001
         record["serve_spec_error"] = f"{type(e).__name__}: {e}"[:200]
         print(f"# bench: serve speculative section failed: {e}", flush=True)
@@ -1137,7 +1159,7 @@ def main() -> None:
                 run_serve(
                     obs_key="serve_sharded_obs", scenario="serve_sharded",
                     mesh_config=mesh_spec,
-                ),
+                )["tok_s"],
                 1,
             )
             print(

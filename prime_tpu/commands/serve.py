@@ -58,13 +58,15 @@ from prime_tpu.utils.render import Renderer, output_options
     help="Decode steps per dispatch — lower admits new requests sooner (--continuous).",
 )
 @click.option(
-    "--speculative", is_flag=True,
+    "--speculative/--no-speculative", "speculative", default=None,
     help="Prompt-lookup speculative decoding (greedy: exact tokens; sampled: "
-         "exact distribution). With --continuous, per-slot drafts ride one "
-         "verify pass per tick.",
+         "exact distribution). With --continuous, draft proposal + verify "
+         "run device-resident and ride the overlap pipeline and the --mesh "
+         "sharded replica. Default: off (PRIME_SERVE_SPEC).",
 )
-@click.option("--draft-len", type=click.IntRange(min=1), default=4,
-              help="Speculative draft tokens per step.")
+@click.option("--draft-len", type=click.IntRange(min=1), default=None,
+              help="Speculative draft tokens per verify window. "
+                   "Default: 4 (PRIME_SERVE_DRAFT_LEN).")
 @click.option(
     "--overlap/--no-overlap", "overlap", default=None,
     help="Overlapped decode pipeline (--continuous): dispatch chunk N+1 "
@@ -133,8 +135,8 @@ def serve_cmd(
     slots: int,
     slot_capacity: int,
     chunk: int,
-    speculative: bool,
-    draft_len: int,
+    speculative: bool | None,
+    draft_len: int | None,
     overlap: bool | None,
     warmup: bool | None,
     prefix_cache_mb: float | None,

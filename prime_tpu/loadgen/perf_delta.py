@@ -32,6 +32,10 @@ HEADLINE_METRICS: tuple[tuple[str, str], ...] = (
     ("serve tok/s", "serve_tok_s"),
     ("serve overlap ratio", "serve_overlap_ratio"),
     ("serve int8 tok/s", "serve_int8_tok_s"),
+    ("serve spec tok/s", "serve_spec_tok_s"),
+    ("serve spec-off tok/s", "serve_spec_off_tok_s"),
+    ("serve spec speedup", "serve_spec_speedup"),
+    ("serve spec accept ratio", "serve_spec_accept_ratio"),
     ("prefixburst tok/s", "serve_prefixburst_tok_s"),
     ("prefixburst hit ratio", "serve_prefixburst_hit_ratio"),
     ("fleet tok/s", "serve_fleet_tok_s"),
@@ -83,6 +87,9 @@ def _slo_metrics(report: dict) -> dict[str, float]:
                 value = quantiles.get(q)
                 if isinstance(value, (int, float)):
                     out[f"{name} {unit} {q} ms"] = round(value * 1e3, 3)
+        ratio = row.get("spec_accept_ratio")
+        if isinstance(ratio, (int, float)) and not isinstance(ratio, bool):
+            out[f"{name} accept ratio"] = float(ratio)
     return out
 
 

@@ -227,6 +227,8 @@ def scenario_row(result) -> dict[str, Any]:
     hits = edelta("serve_prefix_hits_total")
     stall = edelta("serve_host_stall_seconds_total")
     window = edelta("serve_chunk_window_seconds_total")
+    spec_accepted = (ehist("serve_spec_accepted_tokens") or {"sum": 0.0})["sum"]
+    spec_proposed = edelta("serve_spec_draft_tokens_total")
 
     row: dict[str, Any] = {
         "scenario": result.scenario,
@@ -259,6 +261,14 @@ def scenario_row(result) -> dict[str, Any]:
         "prefix_spills": int(edelta("serve_prefix_spills_total")),
         "prefix_reuploads": int(edelta("serve_prefix_reuploads_total")),
         "wasted_decode_tokens": int(edelta("serve_wasted_decode_tokens_total")),
+        # speculative decoding (registry-windowed, like everything else):
+        # accepted drafts from the histogram's sum delta, the ratio against
+        # the proposed-draft counter delta. None when no verify window ran
+        # in this scenario's bracket (spec off, or an idle window).
+        "spec_accepted_tokens": int(spec_accepted),
+        "spec_accept_ratio": (
+            round(spec_accepted / spec_proposed, 4) if spec_proposed else None
+        ),
         "ttft_s": _quantiles(ehist("serve_ttft_seconds")),
         "tpot_s": _quantiles(ehist("serve_tpot_seconds")),
         "queue_wait_s": _quantiles(ehist("serve_queue_wait_seconds")),
@@ -314,6 +324,33 @@ def scenario_row(result) -> dict[str, Any]:
             },
         }
     return row
+
+
+def spec_comparison_record(
+    off_row: dict[str, Any], on_row: dict[str, Any], *, digits: int | None = None
+) -> dict[str, Any]:
+    """The ONE owner of the spec-on/off record keys both producers publish
+    (bench.py's spec section and the loadgen smoke's): spec-on/off tok/s,
+    the speedup, the accept ratio, and the TPOT p50 pair — computed from
+    two :func:`scenario_row` results over the same schedule. ``digits``
+    rounds the tok/s values (bench's historical 1-decimal style)."""
+    def _toks(row):
+        value = row["tok_s"]
+        return round(value, digits) if digits is not None else value
+
+    record: dict[str, Any] = {
+        "serve_spec_off_tok_s": _toks(off_row),
+        "serve_spec_tok_s": _toks(on_row),
+    }
+    if off_row["tok_s"]:
+        record["serve_spec_speedup"] = round(on_row["tok_s"] / off_row["tok_s"], 3)
+    if on_row.get("spec_accept_ratio") is not None:
+        record["serve_spec_accept_ratio"] = on_row["spec_accept_ratio"]
+    for key, row in (("serve_spec", on_row), ("serve_spec_off", off_row)):
+        p50 = (row.get("tpot_s") or {}).get("p50")
+        if isinstance(p50, (int, float)):
+            record[f"{key}_tpot_p50_ms"] = round(p50 * 1e3, 3)
+    return record
 
 
 def build_report(results, *, meta: dict | None = None) -> dict[str, Any]:

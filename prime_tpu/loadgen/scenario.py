@@ -38,6 +38,7 @@ PHASE_KINDS = (
     "cancel_storm",    # clients that abandon mid-decode
     "rate_storm",      # oversubscription wave aimed at the 429 admission gate
     "mixed",           # per-tenant adapters riding the OpenAI `model` field
+    "spec_friendly",   # repetitive/templated prompts where n-gram drafts accept
 )
 
 
@@ -94,6 +95,11 @@ class Phase:
     cancel_frac: float = 0.0
     cancel_after_s: float = 0.1
     adapters: tuple[str, ...] = ()
+    # > 0: each request's tail tiles a freshly drawn cycle of this many
+    # tokens instead of i.i.d. draws — the templated/repetitive shape where
+    # greedy continuations loop and prompt-lookup drafts accept (the
+    # spec_friendly phase kind's default; any kind may opt in)
+    cycle_tokens: int = 0
 
     def __post_init__(self) -> None:
         if self.kind not in PHASE_KINDS:
@@ -102,6 +108,10 @@ class Phase:
             raise ValueError("phase n must be positive")
         if self.shared_prefix >= self.prompt_tokens:
             raise ValueError("shared_prefix must leave room for a unique tail")
+        if self.cycle_tokens < 0:
+            raise ValueError("cycle_tokens must be non-negative")
+        if self.cycle_tokens >= self.prompt_tokens:
+            raise ValueError("cycle_tokens must be shorter than the prompt")
 
 
 @dataclass(frozen=True)
@@ -151,7 +161,14 @@ def build_schedule(
             tenant_slot = i % phase.tenants
             tenant = f"{phase.kind}-t{tenant_slot}"
             preamble = preambles[tenant_slot] if phase.shared_prefix else (1,)
-            tail = _draw_tokens(rng, phase.prompt_tokens - len(preamble), vocab)
+            need = phase.prompt_tokens - len(preamble)
+            if phase.cycle_tokens > 0:
+                # repetitive tail: one short cycle tiled to length, so the
+                # sequence's own history is full of repeated bigrams
+                cycle = _draw_tokens(rng, phase.cycle_tokens, vocab)
+                tail = (cycle * -(-need // len(cycle)))[:need]
+            else:
+                tail = _draw_tokens(rng, need, vocab)
             arrival = phase.start_s + (
                 rng.uniform(0.0, phase.spread_s) if phase.spread_s > 0 else 0.0
             )
@@ -304,6 +321,26 @@ def mixed_tenants(seed: int | None = None, **overrides) -> Scenario:
     )
 
 
+def spec_friendly(seed: int | None = None, **overrides) -> Scenario:
+    """Repetitive/templated completions — the favorable regime for
+    prompt-lookup speculative decoding: each prompt tiles a short token
+    cycle, so greedy continuations settle into loops the n-gram drafter
+    predicts and verify windows accept several tokens per dispatch. Run it
+    spec-on vs spec-off (bench.py's spec section, the loadgen smoke) to
+    publish the speedup and accept ratio."""
+    seed = loadgen_seed_default() if seed is None else seed
+    phase = dict(
+        kind="spec_friendly", n=_scale(4, 12), tenants=2,
+        cycle_tokens=8, prompt_tokens=_scale(49, 97),
+        max_new_tokens=_scale(24, 64), spread_s=0.1,
+    )
+    phase.update(overrides)
+    return Scenario(
+        "spec_friendly", seed, (Phase(**phase),),
+        description="repetitive/templated completions where n-gram drafts accept",
+    )
+
+
 def smoke(seed: int | None = None) -> Scenario:
     """The CI scenario: one tiny composite touching every phase kind in
     seconds on CPU — shared-prefix burst, one long outlier, a couple of
@@ -335,5 +372,6 @@ SCENARIOS = {
     "cancel_storm": cancel_storm,
     "rate_storm": rate_storm,
     "mixed_tenants": mixed_tenants,
+    "spec_friendly": spec_friendly,
     "smoke": smoke,
 }

@@ -28,6 +28,60 @@ from typing import Any
 from prime_tpu.loadgen.scenario import SCENARIOS, loadgen_seed_default
 
 
+def _spec_section(
+    config, params_fn, *, seed: int, mesh: str | None, log
+) -> tuple[dict[str, Any], list]:
+    """The speculative on/off comparison: drive the ``spec_friendly``
+    scenario (repetitive completions where n-gram drafts accept) through
+    one in-process engine with speculation off, then on — same schedule,
+    same registry-windowed measurement as every other section. Returns the
+    BENCH-record keys (spec on/off tok/s, TPOT deltas, accept ratio,
+    speedup) plus the two SLO scenario rows. With ``mesh`` set the engines
+    are SHARDED, so the committed MULTICHIP round carries the
+    spec × mesh evidence."""
+    from prime_tpu.loadgen.backends import EngineTarget
+    from prime_tpu.loadgen.report import scenario_row, spec_comparison_record
+    from prime_tpu.loadgen.runner import run_schedule
+    from prime_tpu.loadgen.scenario import SCENARIOS, build_schedule
+    from prime_tpu.serve.engine import ContinuousBatchingEngine
+
+    schedule = build_schedule(SCENARIOS["spec_friendly"](seed), vocab=config.vocab_size)
+    rows = []
+    for speculative in (False, True):
+        name = "spec_friendly" if speculative else "spec_friendly_off"
+        engine = ContinuousBatchingEngine(
+            params_fn(), config, pad_id=0, max_slots=4, capacity=256, chunk=4,
+            prefix_cache_mb=8, speculative=speculative, mesh_config=mesh or None,
+        )
+        try:
+            # warm the shapes in play (incl. the second-admission prefix
+            # hit), then measure through the registry-windowed runner —
+            # time_scale=0 drives the whole burst immediately
+            for _ in range(2):
+                warm = engine.submit(
+                    list(schedule[0].prompt_ids),
+                    max_new_tokens=schedule[0].max_new_tokens,
+                )
+                while not warm.done:
+                    engine.tick()
+            engine.tick()
+            result = run_schedule(
+                schedule, EngineTarget(engine), scenario=name, seed=seed,
+                time_scale=0.0,
+            )
+            rows.append(scenario_row(result))
+        finally:
+            engine.shutdown()
+    off_row, on_row = rows
+    record = spec_comparison_record(off_row, on_row)
+    log(
+        f"# loadgen-smoke: spec_friendly spec-on {record['serve_spec_tok_s']} "
+        f"vs spec-off {record['serve_spec_off_tok_s']} tok/s "
+        f"(accept ratio {record.get('serve_spec_accept_ratio')})"
+    )
+    return record, rows
+
+
 def run_smoke(
     output_dir: str,
     *,
@@ -194,6 +248,22 @@ def run_smoke(
             f"(outcomes {dict(result.outcomes)})"
         )
 
+        # speculative on/off section (spec_friendly scenario, in-process
+        # engines — sharded when --mesh is set). Appended to the report's
+        # scenario rows WITHOUT touching the headline: the headline gate
+        # stays the fleet scenario's, exactly as before.
+        spec_record: dict[str, Any] = {}
+        try:
+            spec_record, spec_rows = _spec_section(
+                config,
+                lambda: init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32),
+                seed=seed, mesh=mesh, log=log,
+            )
+            report["scenarios"].extend(spec_rows)
+        except Exception as e:  # noqa: BLE001 — the headline gate must survive
+            spec_record = {"serve_spec_error": f"{type(e).__name__}: {e}"[:200]}
+            log(f"# loadgen-smoke: spec section failed: {e}")
+
         # exposition lint, pinned to the documented catalog: every /metrics
         # surface the smoke stood up must be well-formed AND in-contract
         doc_path = os.path.join(
@@ -228,6 +298,7 @@ def run_smoke(
             "vs_baseline": 0.0,
             "backend": jax.default_backend(),
             **({"mesh": mesh_axes, "mesh_devices": mesh_devices} if sharded else {}),
+            **spec_record,
             "loadgen": report,
         }
         with open(os.path.join(output_dir, "slo_report.json"), "w") as f:

@@ -84,6 +84,13 @@ def propose_ngram_drafts(
         return jax.lax.dynamic_slice(row, (s,), (draft_len,))
 
     drafts = jax.vmap(gather_row)(history, start)
+    # a tail-adjacent match (e.g. a constant run, whose previous bigram sits
+    # one position back) reads past the row's valid length into the pad
+    # region — repeat the trailing token there instead, so the drafter
+    # predicts "the run continues" rather than proposing pads. Without this
+    # the MOST favorable regime (tight loops) capped acceptance at 1.
+    offsets = start[:, None] + jnp.arange(draft_len)[None, :]
+    drafts = jnp.where(offsets < lengths[:, None], drafts, t1)
     fallback = jnp.broadcast_to(t1, (batch, draft_len))
     return jnp.where((best >= 0)[:, None], drafts, fallback)
 

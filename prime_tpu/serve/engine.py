@@ -29,9 +29,18 @@ compilation model:
   Retirement takes effect at the next chunk boundary — a slot that finished
   in chunk N still decodes through chunk N+1 (bounded waste, counted by
   ``serve_wasted_decode_tokens_total``). ``PRIME_SERVE_OVERLAP=0`` restores
-  the strictly synchronous loop; speculative mode always runs synchronously
-  (drafts for chunk N+1 need chunk N's tokens on the host). See
-  docs/architecture.md "Engine pipeline".
+  the strictly synchronous loop. See docs/architecture.md "Engine pipeline".
+- **Device-resident speculative decoding.** ``speculative=True`` replaces
+  the decode chunk with ONE fused dispatch: n-gram draft proposal over a
+  per-slot device history ring (``models/speculative.propose_ngram_drafts``),
+  a (S, D+1) verify forward, acceptance bookkeeping, and the history-ring
+  update all execute inside the program — the host never reads tokens back
+  to draft, so spec mode composes with the overlap pipeline (a spec chunk is
+  dispatched on the last-known active mask exactly like a decode chunk) and
+  with the sharded mesh. A retired-but-lagged slot wastes at most one
+  accepted-length window (counted by ``serve_wasted_decode_tokens_total``),
+  which is why admission reserves ``2*(draft_len+1)`` verify slots per row
+  under overlap. See docs/architecture.md "Speculative decoding".
 
 Single-chip by default. A **sharded replica** spans a multi-chip slice from
 one declarative knob: ``mesh_config`` (a serve/mesh_config.ServeMeshConfig,
@@ -206,6 +215,10 @@ class _InflightChunk:
     mask: np.ndarray
     requests: dict[int, EngineRequest]
     dispatched_at: float
+    # speculative chunks only: the (S,) per-slot accepted-run lengths (device
+    # array, synced with toks). None marks a plain decode chunk whose every
+    # row holds `chunk` valid tokens.
+    run_len: Any = None
     # False once an admission prefill ran inside this chunk's window: its
     # dispatch-to-sync wall time then includes host prefill blocking and must
     # not feed the per-step decode histogram (it still counts toward the
@@ -298,8 +311,8 @@ class ContinuousBatchingEngine:
         cache_spec: Any = None,
         attn_impl: str = "auto",
         kv_quant: bool = False,
-        speculative: bool = False,
-        draft_len: int = 4,
+        speculative: bool | None = None,
+        draft_len: int | None = None,
         overlap: bool | None = None,
         warmup: bool | None = None,
         max_queue: int | None = None,
@@ -365,19 +378,26 @@ class ContinuousBatchingEngine:
         # does not plumb the scale epilogue yet)
         self.attn_impl = attn_impl
         self.kv_quant = kv_quant
-        # prompt-lookup speculation: each tick proposes draft_len n-gram
-        # drafts per slot (host-side, from the slot's own history) and one
-        # (B, D+1) verify forward replaces draft_len+1 single-token steps
-        self.speculative = speculative
-        self.draft_len = draft_len
+        # prompt-lookup speculation: each spec chunk is ONE fused dispatch —
+        # propose draft_len n-gram drafts per slot from the slot's device-
+        # resident history ring, run one (S, D+1) verify forward, and fold
+        # acceptance bookkeeping + the history update into the same program.
+        # The host only ever reads the RESULT (tokens + run lengths), never
+        # feeds drafts in, so speculation pipelines like a decode chunk.
+        if speculative is None:
+            speculative = env_flag("PRIME_SERVE_SPEC", False)
+        self.speculative = bool(speculative)
+        if draft_len is None:
+            draft_len = env_int("PRIME_SERVE_DRAFT_LEN", 4)
+        self.draft_len = max(1, int(draft_len))
         # overlapped decode pipeline (module docstring): on by default,
         # PRIME_SERVE_OVERLAP=0 restores the synchronous loop. Speculative
-        # mode is ALWAYS synchronous — proposing chunk N+1's n-gram drafts
-        # needs chunk N's accepted tokens on the host, a data dependency the
-        # pipeline cannot hide (pinned by test_spec_chunk_runs_synchronously).
+        # mode rides the same pipeline since drafting moved on-device (the
+        # historical serial-loop pin existed because drafts needed chunk N's
+        # tokens on the host).
         if overlap is None:
             overlap = env_flag("PRIME_SERVE_OVERLAP", True)
-        self.overlap = bool(overlap) and not speculative
+        self.overlap = bool(overlap)
         # AOT-style warmup (see warmup()): opt-in via PRIME_SERVE_WARMUP
         # because compiling the full program set up front trades startup
         # seconds for the guarantee that no cold compile lands mid-pipeline
@@ -388,14 +408,6 @@ class ContinuousBatchingEngine:
         # outside tick(); owned by the engine thread)
         self._inflight: list[_InflightChunk] = []
         self._chunk_seq = itertools.count()
-        self._histories: dict[int, list[int]] = {}  # slot -> prompt + decoded
-        # slot -> {(t0, t1) -> latest position p with history[p:p+2] == (t0,
-        # t1) and p <= len-3}: the prompt-lookup index, built once at admit
-        # and extended O(1) per emitted token — the previous per-tick
-        # backward scan was O(slots x full history) of host Python per
-        # verify dispatch and eroded the speculative speedup on long
-        # histories (advisor r3)
-        self._bigram_index: dict[int, dict[tuple[int, int], int]] = {}
 
         self._dtype = jax.tree_util.tree_leaves(params)[0].dtype
         self._requests: dict[int, EngineRequest] = {}  # slot -> request
@@ -435,6 +447,7 @@ class ContinuousBatchingEngine:
         self._finalize_batch_fn: Any = None
         self._decode_fn: Any = None
         self._spec_fn: Any = None
+        self._hist_seed_fn: Any = None
         self._assemble_fn: Any = None
         # prompt-prefix KV reuse: a radix tree of MIN_BUCKET-aligned KV
         # segments under a byte budget (serve/prefix_cache.py) — an admission
@@ -613,6 +626,27 @@ class ContinuousBatchingEngine:
         self._m_warmup_s = r.gauge(
             "serve_warmup_seconds", "Wall seconds the AOT warmup pass took"
         )
+        # speculative decoding: per-window acceptance evidence. The histogram
+        # observes the accepted DRAFT count per verify window per slot (the
+        # bonus/correction token is excluded — it arrives even at 0 accepts),
+        # the counter accumulates the proposed drafts (the denominator), and
+        # the gauge publishes the lifetime ratio for scrapes that cannot
+        # window deltas themselves.
+        self._m_spec_accepted = r.histogram(
+            "serve_spec_accepted_tokens",
+            "Draft tokens accepted per speculative verify window (per slot)",
+            buckets=DEFAULT_SIZE_BUCKETS,
+        )
+        self._m_spec_drafts = r.counter(
+            "serve_spec_draft_tokens_total",
+            "Draft tokens proposed by the device-side n-gram drafter",
+        )
+        self._m_spec_ratio = r.gauge(
+            "serve_spec_accept_ratio",
+            "Lifetime accepted/proposed draft-token ratio (0 until a verify window ran)",
+        )
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         # sharded replica: how many devices this engine's mesh spans (1 =
         # single-chip), and whether a configured prefix-cache host tier was
         # gated off because the mesh makes the spill converters unsafe
@@ -712,6 +746,36 @@ class ContinuousBatchingEngine:
         self._last = jnp.zeros((self.max_slots,), dtype=jnp.int32)
         self._temps = jnp.zeros((self.max_slots,), dtype=jnp.float32)
         self._top_ps = jnp.ones((self.max_slots,), dtype=jnp.float32)
+        # speculative decoding: the device-resident per-slot token history
+        # ring (prompt + decoded so far) the fused spec program drafts from —
+        # updated INSIDE the program, seeded at admission, never read back to
+        # the host. Padded past capacity so a (draft_len+1) scatter window
+        # starting at any valid length stays in bounds (mirrors
+        # spec_generate's history sizing).
+        self._hist = None
+        self._hist_len = None
+        if self.speculative:
+            self._alloc_hist()
+
+    def _alloc_hist(self) -> None:
+        """(Re)allocate the cold speculative history ring — shared by
+        construction, post-failure recovery, and the end-of-warmup reset."""
+        import jax
+        import jax.numpy as jnp
+
+        hist = jnp.full(
+            (self.max_slots, self.capacity + self.draft_len + 1),
+            self.pad_id, dtype=jnp.int32,
+        )
+        constraint = self._hist_constraint()
+        if constraint is not None:
+            # place the ring consistently with the paged KV's slot-axis
+            # layout up front — the fused program constrains it anyway, but
+            # an explicit placement avoids a reshard inside the first
+            # donated dispatch
+            hist = jax.device_put(hist, constraint)
+        self._hist = hist
+        self._hist_len = jnp.zeros((self.max_slots,), dtype=jnp.int32)
 
     def _mesh_ctx(self):
         """Mesh context for compiled calls — the engine thread does not
@@ -761,6 +825,36 @@ class ContinuousBatchingEngine:
         if all(entry is None for entry in row_spec):
             return None
         return NamedSharding(self.mesh, row_spec)
+
+    def _hist_constraint(self):
+        """Sharding constraint for the speculative history ring and its draft
+        buffers: the paged KV cache's SLOT-axis placement (entry 1 of the
+        cache spec — sharded only under an sp layout) with the token axis
+        replicated, so the ring lives wherever each slot's KV lives and the
+        fused propose+verify program never gathers history cross-device.
+        None when nothing would shard (single chip, or a layout whose slot
+        axis is replicated — the common (dp, fsdp, tp) case, where the tiny
+        int32 ring simply replicates like the sampling vectors)."""
+        if self.mesh is None or self.cache_spec is None:
+            return None
+        spec = tuple(self.cache_spec)
+        if len(spec) < 2 or spec[1] is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec(spec[1], None))
+
+    @property
+    def spec_overhead(self) -> int:
+        """Verify-window slots a speculative admission must reserve past
+        prompt + max_new_tokens: each window scribbles up to draft_len+1
+        positions beyond a row's valid length, and under the overlap pipeline
+        ONE stale in-flight window can still advance a just-retired slot by
+        another draft_len+1 before the host's retirement lands — so a slot
+        may hold up to 2*(draft_len+1) unretired token positions."""
+        if not self.speculative:
+            return 0
+        return (2 if self.overlap else 1) * (self.draft_len + 1)
 
     def _constrain_row_fields(self, row, constraint):
         """Apply ``constraint`` to a staging row's capacity-axis leaves
@@ -867,26 +961,38 @@ class ContinuousBatchingEngine:
         return jax.jit(decode, donate_argnums=(1, 2))
 
     def _make_spec_decode(self):
+        """The fused device-resident speculative step: n-gram draft proposal
+        over the per-slot history ring, one (S, D+1) verify forward, the
+        accept/correct math, the cache-length advance, AND the history-ring
+        update — one donated dispatch with no host data dependency, so spec
+        chunks pipeline exactly like decode chunks. Accept/correct math is
+        verify_window_tokens — the one owner shared with
+        models/speculative.spec_generate — with per-slot traced temps mixing
+        greedy and sampled slots in one program."""
         import jax
         import jax.numpy as jnp
 
         from prime_tpu.models.llama import forward
-        from prime_tpu.models.speculative import verify_window_tokens
+        from prime_tpu.models.speculative import (
+            propose_ngram_drafts,
+            verify_window_tokens,
+        )
 
         config, attn_impl = self.config, self.attn_impl
-        mesh = self.mesh
+        mesh, draft_len = self.mesh, self.draft_len
         cache_spec = self._cache_constraint()
+        hist_spec = self._hist_constraint()
 
-        def spec_decode(params, cache, last, temps, top_ps, active, drafts, rng):
-            """One verify pass over (B, D+1) windows at each slot's cache
-            length. Accept/correct math is verify_window_tokens — the one
-            owner shared with models/speculative.spec_generate — with
-            per-slot traced temps mixing greedy and sampled slots in one
-            program."""
+        def spec_decode(params, cache, hist, hist_len, last, temps, top_ps, active, rng):
             temps = jnp.where(active, temps, 0.0)
             top_ps = jnp.where(active, top_ps, 1.0)
+            # device-side prompt-lookup: copy the tokens after the most
+            # recent earlier occurrence of each slot's trailing bigram.
+            # Inactive rows propose garbage off their stale rings — their
+            # run_len is forced to 0 below, so nothing escapes.
+            drafts = propose_ngram_drafts(hist, hist_len, draft_len)  # (S, D)
             offsets = cache.lengths
-            window = jnp.concatenate([last[:, None], drafts], axis=1)  # (B, D+1)
+            window = jnp.concatenate([last[:, None], drafts], axis=1)  # (S, D+1)
             logits, new_cache = forward(
                 params, window, config, cache=cache, decode=False,
                 attn_impl=attn_impl, prefill_offset=offsets, mesh=mesh,
@@ -916,74 +1022,101 @@ class ContinuousBatchingEngine:
                 tokens_round, run_len
             )
             last_out = jnp.where(active, last_out, last)
-            return new_cache, last_out, tokens_round, run_len
+            # extend each slot's ring with this round's emissions (accepted
+            # drafts + bonus/correction) at its current length — tokens past
+            # run_len (incl. everything on inactive rows) merge the old
+            # window back, leaving the ring untouched there
+            emit_ids = jnp.arange(draft_len + 1)[None, :]
+            keep = emit_ids < run_len[:, None]
 
-        return jax.jit(spec_decode, donate_argnums=(1, 2))
+            def scatter_row(row, start, vals, m):
+                window_old = jax.lax.dynamic_slice(row, (start,), (draft_len + 1,))
+                merged = jnp.where(m, vals, window_old)
+                return jax.lax.dynamic_update_slice(row, merged, (start,))
 
-    def _index_bigrams(self, slot: int, old_len: int) -> None:
-        """Extend the slot's bigram index over tokens appended since
-        `old_len`. Indexable positions are 0..len-3 (the trailing bigram
-        itself is the lookup KEY, never a hit); later occurrences overwrite
-        earlier ones so lookups return the most recent match, identical to
-        the backward scan this replaces."""
-        history = self._histories[slot]
-        index = self._bigram_index.setdefault(slot, {})
-        for p in range(max(0, old_len - 2), len(history) - 2):
-            index[(history[p], history[p + 1])] = p
+            new_hist = jax.vmap(scatter_row)(hist, hist_len, tokens_round, keep)
+            if hist_spec is not None:
+                new_hist = jax.lax.with_sharding_constraint(new_hist, hist_spec)
+            new_hist_len = hist_len + run_len
+            return new_cache, new_hist, new_hist_len, last_out, tokens_round, run_len
 
-    def _propose_drafts(self, slot: int) -> list[int]:
-        """Host-side prompt-lookup: copy the tokens after the most recent
-        earlier occurrence of the slot's trailing bigram (n-gram drafting,
-        same scheme as models/speculative.propose_ngram_drafts), via the
-        incrementally maintained O(1) bigram index."""
-        history = self._histories.get(slot, [])
-        draft_len = self.draft_len
-        if len(history) < 2:
-            return (history[-1:] or [self.pad_id]) * draft_len
-        t0, t1 = history[-2], history[-1]
-        position = self._bigram_index.get(slot, {}).get((t0, t1))
-        if position is not None:
-            window = history[position + 2 : position + 2 + draft_len]
-            return window + [t1] * (draft_len - len(window))
-        return [t1] * draft_len
+        return jax.jit(spec_decode, donate_argnums=(1, 2, 3, 4))
 
-    def _spec_chunk(self) -> None:
+    def _make_hist_seed(self):
+        """One jitted program per admission-wave width: write each admitted
+        slot's full history row (prompt tokens + the finalize dispatch's
+        first sampled token at position ``length``) and reset its ring
+        length — the device-side counterpart of what finalize does for the
+        KV cache, keeping drafting fully device-resident."""
+        import jax
+
+        hist_spec = self._hist_constraint()
+
+        def seed(hist, hist_len, rows, lengths, slots, firsts):
+            rows = jax.vmap(lambda row, n, f: row.at[n].set(f))(rows, lengths, firsts)
+            hist = hist.at[slots].set(rows)
+            if hist_spec is not None:
+                hist = jax.lax.with_sharding_constraint(hist, hist_spec)
+            return hist, hist_len.at[slots].set(lengths + 1)
+
+        return jax.jit(seed, donate_argnums=(0, 1))
+
+    def _seed_hist(self, reqs, lengths, slots, firsts) -> None:
+        """Seed the device history ring for a just-finalized admission wave
+        (speculative engines only). ``firsts`` is the finalize dispatch's
+        device array — passing it through keeps the whole seed on-device,
+        ordered after finalize by dispatch order."""
+        import jax.numpy as jnp
+
+        if self._hist_seed_fn is None:
+            self._hist_seed_fn = self._make_hist_seed()
+        width = self._hist.shape[1]
+        rows = np.full((len(reqs), width), self.pad_id, dtype=np.int32)
+        for i, req in enumerate(reqs):
+            rows[i, : len(req.prompt_ids)] = req.prompt_ids
+        self._hist, self._hist_len = self._hist_seed_fn(
+            self._hist, self._hist_len, jnp.asarray(rows),
+            jnp.asarray(lengths, dtype=jnp.int32),
+            jnp.asarray(slots, dtype=jnp.int32), firsts,
+        )
+
+    def _dispatch_spec(self) -> None:
+        """Launch one fused speculative chunk on the last-known active mask
+        and return without waiting — the spec-mode twin of _dispatch_decode.
+        The run lengths ride the _InflightChunk as a device array; the sync
+        path slices each slot's emissions by them."""
         import jax
         import jax.numpy as jnp
 
         if self._spec_fn is None:
             self._spec_fn = self._make_spec_decode()
         self._rng, rng = jax.random.split(self._rng)
-        active = jnp.asarray(self._active)
-        # propose only for live slots — the bigram scan is host-side Python
-        # and inactive rows' drafts are ignored anyway (run_len forced to 0)
-        drafts = jnp.asarray(
-            [
-                self._propose_drafts(slot) if self._active[slot] else [self.pad_id] * self.draft_len
-                for slot in range(self.max_slots)
-            ],
-            dtype=jnp.int32,
-        )
-        t_start = time.monotonic()
-        with TRACER.span("serve.spec_verify", draft_len=self.draft_len), self._mesh_ctx():
-            self._cache, self._last, toks, run_len = self._spec_fn(
-                self.params, self._cache, self._last,
-                self._temps, self._top_ps, active, drafts, rng,
+        mask = self._active.copy()
+        seq = next(self._chunk_seq)
+        with TRACER.span(
+            "serve.spec_dispatch", seq=seq, draft_len=self.draft_len,
+            **self._span_mesh,
+        ), self._mesh_ctx():
+            (
+                self._cache, self._hist, self._hist_len, self._last, toks, run_len,
+            ) = self._spec_fn(
+                self.params, self._cache, self._hist, self._hist_len, self._last,
+                self._temps, self._top_ps, jnp.asarray(mask), rng,
             )
-            toks_host = np.asarray(toks)
-            runs = np.asarray(run_len)
-        # one verify pass advances each slot by >=1 token: charge it as one
-        # decode step (per-token attribution rides the request TPOT histogram)
-        self._m_decode_step_s.observe(time.monotonic() - t_start)
-        for slot in range(self.max_slots):
-            if self._active[slot]:
-                out = toks_host[slot][: int(runs[slot])].tolist()
-                old_len = len(self._histories[slot])
-                self._histories[slot].extend(out)
-                self._index_bigrams(slot, old_len)
-                req = self._requests[slot]
-                self.flight.event(req.id, "chunk", accepted=len(out))
-                self._emit(req, out)
+        self._inflight.append(
+            _InflightChunk(
+                seq=seq, toks=toks, mask=mask,
+                requests=dict(self._requests),
+                dispatched_at=time.monotonic(), run_len=run_len,
+            )
+        )
+        self._m_inflight_depth.set(len(self._inflight))
+
+    def _spec_chunk(self) -> None:
+        """Serial speculative step: the fused dispatch synced immediately —
+        the bit-identity reference the pipelined path is pinned against."""
+        self._dispatch_spec()
+        self._sync_decode()
 
     # ---- AOT warmup ----
 
@@ -1053,6 +1186,8 @@ class ContinuousBatchingEngine:
             self._decode_fn = self._make_decode()
         if self.speculative and self._spec_fn is None:
             self._spec_fn = self._make_spec_decode()
+        if self.speculative and self._hist_seed_fn is None:
+            self._hist_seed_fn = self._make_hist_seed()
         if self.prefix_cache is not None and self._assemble_fn is None:
             self._assemble_fn = self._make_assemble_row()
         dispatches = 0
@@ -1071,19 +1206,31 @@ class ContinuousBatchingEngine:
             jax.block_until_ready(toks)
             dispatches += 1
             if self.speculative:
-                drafts = jnp.full(
-                    (self.max_slots, self.draft_len), self.pad_id, dtype=jnp.int32
-                )
                 warm_rng, rng = jax.random.split(warm_rng)
-                self._cache, self._last, toks, _ = self._spec_fn(
-                    self.params, self._cache, self._last,
-                    self._temps, self._top_ps, inactive, drafts, rng,
+                (
+                    self._cache, self._hist, self._hist_len, self._last, toks, _,
+                ) = self._spec_fn(
+                    self.params, self._cache, self._hist, self._hist_len,
+                    self._last, self._temps, self._top_ps, inactive, rng,
                 )
                 jax.block_until_ready(toks)
                 dispatches += 1
             batch_sizes = [1]
             while batch_sizes[-1] * 2 <= self.max_slots:
                 batch_sizes.append(batch_sizes[-1] * 2)
+            if self.speculative:
+                # history-ring seed shapes: one program per admission-wave
+                # width (the same power-of-two set the finalize warmup runs)
+                for n in batch_sizes:
+                    self._hist, self._hist_len = self._hist_seed_fn(
+                        self._hist, self._hist_len,
+                        jnp.full((n, self._hist.shape[1]), self.pad_id, dtype=jnp.int32),
+                        jnp.zeros((n,), dtype=jnp.int32),
+                        jnp.arange(n, dtype=jnp.int32),
+                        jnp.zeros((n,), dtype=jnp.int32),
+                    )
+                    jax.block_until_ready(self._hist_len)
+                    dispatches += 1
             for row_cb in self._warmup_row_capacities():
                 cold_sizes = {s for _, s in chunk_plan(0, row_cb, self.prefill_chunk, row_cb)}
                 # prefix-hit suffixes admit singly with mid-prompt plans:
@@ -1148,6 +1295,11 @@ class ContinuousBatchingEngine:
                         jax.block_until_ready(assembled.k)
                         dispatches += 1
                         seg_len *= 2
+        if self.speculative:
+            # the hist-seed warmups scribbled slot rings (lengths 1, pad
+            # rows); restore exact cold history state so a warmed engine is
+            # indistinguishable from a cold one in EVERY device buffer
+            self._alloc_hist()
         self._m_warmup_programs.set(dispatches)
         self._m_warmup_s.set(time.monotonic() - t0)
         return dispatches
@@ -1174,8 +1326,11 @@ class ContinuousBatchingEngine:
                     retry_after=self.retry_after_estimate(depth),
                 )
         # speculation scribbles up to draft_len+1 verify slots past a row's
-        # valid length — the slot must hold them even when every draft lands
-        overhead = self.draft_len + 1 if self.speculative else 0
+        # valid length — and under the overlap pipeline one stale in-flight
+        # window can advance a just-retired slot by another draft_len+1
+        # before retirement lands, so the slot must hold 2*(draft_len+1)
+        # (spec_overhead owns the formula; pinned by the capacity test)
+        overhead = self.spec_overhead
         if len(prompt_ids) + max_new_tokens + overhead > self.capacity:
             raise ValueError(
                 f"prompt ({len(prompt_ids)}) + max_new_tokens ({max_new_tokens})"
@@ -1389,7 +1544,10 @@ class ContinuousBatchingEngine:
         did = False
         try:
             if any(self._active):
-                self._dispatch_decode()
+                if self.speculative:
+                    self._dispatch_spec()
+                else:
+                    self._dispatch_decode()
                 did = True
             # one-deep pipeline: with a fresh chunk dispatched, sync the
             # previous one now (its host work overlaps the new chunk's device
@@ -1415,15 +1573,15 @@ class ContinuousBatchingEngine:
         return admitted or did
 
     def _tick_sync(self) -> bool:
-        """The strictly serial loop: admit, then decode one chunk and block
-        for its tokens before any emit/admission work."""
+        """The strictly serial loop: admit, then decode (or speculate) one
+        chunk and block for its tokens before any emit/admission work."""
         admitted = self._admit()
         self._retire_cancelled()
         if not any(self._active):
             return admitted
         try:
             if self.speculative:
-                self._spec_chunk()
+                self._spec_chunk()  # fused dispatch, synced immediately
             else:
                 self._decode_chunk()
         except Exception as e:  # noqa: BLE001 — a dead engine hangs every client
@@ -1467,32 +1625,53 @@ class ContinuousBatchingEngine:
         """Fetch the oldest in-flight chunk's tokens and emit them. Tokens
         route via the dispatch-time request snapshot: a slot retired (and
         possibly re-admitted) after dispatch gets its whole chunk counted as
-        wasted decode instead of leaking old tokens into the new request."""
+        wasted decode instead of leaking old tokens into the new request.
+        Speculative chunks carry per-slot run lengths: each row emits only
+        its accepted run, acceptance feeds the spec metrics, and a stale
+        slot's waste is the accepted-length window it decoded for nobody."""
         chunk = self._inflight.pop(0)
+        spec = chunk.run_len is not None
         t_sync = time.monotonic()
         with TRACER.span("serve.sync", seq=chunk.seq):
             toks_host = np.asarray(chunk.toks)  # blocks until the chunk lands
+            runs = np.asarray(chunk.run_len) if spec else None
         t_done = time.monotonic()
         self._m_host_stall_s.inc(t_done - t_sync)
         self._m_chunk_window_s.inc(t_done - chunk.dispatched_at)
         if chunk.clean:
             # steady-state decode only: windows that contained an admission
             # prefill are dominated by host work already recorded in
-            # serve_prefill_seconds and would corrupt the per-step histogram
-            self._m_decode_step_s.observe((t_done - chunk.dispatched_at) / self.chunk)
+            # serve_prefill_seconds and would corrupt the per-step histogram.
+            # A verify window advances each slot by >=1 token: charge it as
+            # one step (per-token attribution rides the TPOT histogram).
+            self._m_decode_step_s.observe(
+                (t_done - chunk.dispatched_at) / (1 if spec else self.chunk)
+            )
         self._m_inflight_depth.set(len(self._inflight))
         for slot in range(self.max_slots):
             if not chunk.mask[slot]:
                 continue
+            accepted = 0
+            if spec:
+                accepted = max(0, int(runs[slot]) - 1)
+                self._spec_proposed += self.draft_len
+                self._spec_accepted += accepted
+                self._m_spec_drafts.inc(self.draft_len)
+                self._m_spec_accepted.observe(accepted)
             req = chunk.requests.get(slot)
             if req is None or req.done or req.cancelled:
                 # dispatched on a stale mask: the slot retired between
                 # dispatch and sync — the bounded cost of one-chunk-lag
-                # retirement is this whole chunk row
-                self._m_wasted_tokens.inc(self.chunk)
+                # retirement is this whole chunk row (for spec, the
+                # accepted-length window the device advanced it by)
+                self._m_wasted_tokens.inc(int(runs[slot]) if spec else self.chunk)
                 continue
-            self.flight.event(req.id, "chunk", seq=chunk.seq)
-            self._emit(req, toks_host[slot].tolist())
+            if spec:
+                self.flight.event(req.id, "chunk", seq=chunk.seq, accepted=accepted)
+                self._emit(req, toks_host[slot][: int(runs[slot])].tolist())
+            else:
+                self.flight.event(req.id, "chunk", seq=chunk.seq)
+                self._emit(req, toks_host[slot].tolist())
 
     def _retire_cancelled(self) -> None:
         """Free slots whose client abandoned the request (disconnected
@@ -1637,6 +1816,12 @@ class ContinuousBatchingEngine:
                 jnp.asarray([req.top_p], dtype=jnp.float32),
                 rng,
             )
+        if self.speculative:
+            # seed the device history ring before the host sync below — the
+            # seed dispatch rides the same device queue as finalize, so the
+            # first spec chunk can draft from the prompt immediately
+            with self._mesh_ctx():
+                self._seed_hist([req], [len(ids)], [slot], firsts)
         first = int(firsts[0])  # host sync: the prefill really finished here
         self._m_prefill_s.observe(time.monotonic() - t_start)
         self.flight.event(
@@ -1650,10 +1835,6 @@ class ContinuousBatchingEngine:
         req.slot = slot
         self._active[slot] = True
         self._requests[slot] = req
-        self._histories[slot] = list(ids) + [first]
-        if self.speculative:
-            self._bigram_index[slot] = {}
-            self._index_bigrams(slot, 0)
         self._emit(req, [first])
 
     def _prefill_batch(
@@ -1718,6 +1899,11 @@ class ContinuousBatchingEngine:
                 jnp.asarray([r.top_p for r in reqs], dtype=jnp.float32),
                 rng,
             )
+        if self.speculative:
+            with self._mesh_ctx():
+                self._seed_hist(
+                    reqs, [len(r.prompt_ids) for r in reqs], slots, firsts
+                )
         # lazy per-leaf slices of member 0: a handful of tiny ops per WAVE
         row0 = jax.tree_util.tree_map(
             lambda x: x[:, :1] if x.ndim >= 2 else x[:1], row
@@ -1745,10 +1931,6 @@ class ContinuousBatchingEngine:
             req.slot = slot
             self._active[slot] = True
             self._requests[slot] = req
-            self._histories[slot] = list(req.prompt_ids) + [first]
-            if self.speculative:
-                self._bigram_index[slot] = {}
-                self._index_bigrams(slot, 0)
             self._emit(req, [first])
 
     def _make_finalize_batch(self):
@@ -2069,8 +2251,6 @@ class ContinuousBatchingEngine:
             if req.slot >= 0:
                 self._active[req.slot] = False
                 self._requests.pop(req.slot, None)
-                self._histories.pop(req.slot, None)
-                self._bigram_index.pop(req.slot, None)
             req.events.put(None)
 
     def stats(self) -> dict:
@@ -2122,6 +2302,10 @@ class ContinuousBatchingEngine:
         # fully hide inside device compute
         ratio = max(0.0, min(1.0, 1.0 - stall / window)) if window > 0 else 0.0
         self._m_overlap_ratio.set(ratio)
+        spec_ratio = (
+            self._spec_accepted / self._spec_proposed if self._spec_proposed else 0.0
+        )
+        self._m_spec_ratio.set(spec_ratio)
         snapshot = {
             "requests_admitted": int(values["serve_requests_admitted_total"]),
             "requests_completed": int(values["serve_requests_completed_total"]),
@@ -2138,6 +2322,9 @@ class ContinuousBatchingEngine:
             "mesh_axes": dict(self.mesh_axes),
             "state": "draining" if self._draining else "running",
             "overlap": bool(self.overlap),
+            "speculative": bool(self.speculative),
+            "draft_len": int(self.draft_len) if self.speculative else 0,
+            "spec_accept_ratio": round(spec_ratio, 4),
             "inflight_depth": int(values["serve_inflight_depth"]),
             "host_stall_s": round(stall, 6),
             "chunk_window_s": round(window, 6),
@@ -2209,9 +2396,8 @@ class EngineBackend:
     ) -> EngineRequest:
         ids = self.tokenizer.encode(prompt, add_special_tokens=not templated)
         # keep the tail if the prompt exceeds what the slot can hold
-        # (speculation reserves draft_len+1 extra verify slots per row)
-        overhead = self.engine.draft_len + 1 if self.engine.speculative else 0
-        keep = self.engine.capacity - max_new_tokens - overhead
+        # (speculation reserves spec_overhead extra verify slots per row)
+        keep = self.engine.capacity - max_new_tokens - self.engine.spec_overhead
         if keep <= 0:
             raise ValueError(
                 f"max_new_tokens ({max_new_tokens}) leaves no room for a "

@@ -33,7 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 from urllib.parse import parse_qs, urlsplit
 
-from prime_tpu.core.config import env_str
+from prime_tpu.core.config import env_flag, env_int, env_str
 from prime_tpu.obs.flight import FlightRecorder, parse_summary_limit
 from prime_tpu.obs.metrics import Registry
 from prime_tpu.obs.trace import (
@@ -774,8 +774,8 @@ def serve_model(
     max_slots: int = 8,
     slot_capacity: int = 2048,
     chunk: int = 8,
-    speculative: bool = False,
-    draft_len: int = 4,
+    speculative: bool | None = None,
+    draft_len: int | None = None,
     overlap: bool | None = None,
     warmup: bool | None = None,
     prefix_cache_mb: float | None = None,
@@ -830,6 +830,16 @@ def serve_model(
             "--slice, or use --mesh to fail fast instead)",
             stacklevel=2,
         )
+    # speculative defaults defer to the env knobs (the same helpers the
+    # engine uses when constructed directly): the one-shot generator path
+    # below needs them resolved to a concrete bool/int
+    if speculative is None:
+        speculative = env_flag("PRIME_SERVE_SPEC", False)
+    if draft_len is None:
+        draft_len = env_int("PRIME_SERVE_DRAFT_LEN", 4)
+    # same clamp the engine applies: a junk env value must not crash the
+    # one-shot generator path while the continuous path silently clamps
+    draft_len = max(1, int(draft_len))
     # fail fast on EADDRINUSE; admin_token=None reads PRIME_FLEET_ADMIN_TOKEN
     server = InferenceServer(model, host=host, port=port, admin_token=admin_token)
     try:

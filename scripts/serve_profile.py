@@ -93,7 +93,9 @@ def overlap_report(path: str, quiet: bool = False) -> None:
             if seq is None:
                 continue
             dispatch, sync = runs[-1]
-            if span["name"] == "serve.dispatch":
+            if span["name"] in ("serve.dispatch", "serve.spec_dispatch"):
+                # spec chunks pipeline identically (one fused propose+verify
+                # dispatch per seq) — same pairing, same stall math
                 if seq in dispatch:  # seq restarted: a new engine's spans begin
                     dispatch, sync = {}, {}
                     runs.append((dispatch, sync))

@@ -32,6 +32,8 @@ def _default_pipeline_env(monkeypatch):
     monkeypatch.delenv("PRIME_SERVE_OVERLAP", raising=False)
     monkeypatch.delenv("PRIME_SERVE_WARMUP", raising=False)
     monkeypatch.delenv("PRIME_SERVE_MESH", raising=False)
+    monkeypatch.delenv("PRIME_SERVE_SPEC", raising=False)
+    monkeypatch.delenv("PRIME_SERVE_DRAFT_LEN", raising=False)
     monkeypatch.delenv("PRIME_SERVE_PREFIX_CACHE_MB", raising=False)
     monkeypatch.delenv("PRIME_SERVE_PREFIX_CACHE_HOST_MB", raising=False)
 
@@ -642,19 +644,34 @@ def test_shutdown_fails_waiting_requests_promptly():
 # -- overlapped decode pipeline -----------------------------------------------
 
 
-def test_overlap_default_env_and_spec_gating(monkeypatch):
+def test_overlap_default_env_and_spec_composes(monkeypatch):
     """Overlap is on by default, PRIME_SERVE_OVERLAP=0 switches it off, and
-    speculative mode forces the synchronous loop regardless (chunk N+1's
-    drafts need chunk N's tokens on the host — a data dependency the
-    pipeline cannot hide)."""
+    speculative mode now RIDES the pipeline (drafting moved on-device, so
+    the old serial-loop pin — drafts needing host tokens — is gone)."""
     assert make_engine().overlap
     monkeypatch.setenv("PRIME_SERVE_OVERLAP", "0")
     assert not make_engine().overlap
     monkeypatch.setenv("PRIME_SERVE_OVERLAP", "1")
-    assert not make_engine(speculative=True).overlap
-    assert not make_engine(speculative=True, overlap=True).overlap
+    assert make_engine(speculative=True).overlap
+    assert make_engine(speculative=True, overlap=True).overlap
     monkeypatch.delenv("PRIME_SERVE_OVERLAP")
     assert not make_engine(overlap=False).overlap
+    assert not make_engine(speculative=True, overlap=False).overlap
+
+
+def test_spec_env_knob_wiring(monkeypatch):
+    """PRIME_SERVE_SPEC / PRIME_SERVE_DRAFT_LEN drive the constructor
+    defaults through the env helpers; explicit kwargs beat the env."""
+    assert not make_engine().speculative
+    assert make_engine().draft_len == 4
+    monkeypatch.setenv("PRIME_SERVE_SPEC", "1")
+    monkeypatch.setenv("PRIME_SERVE_DRAFT_LEN", "6")
+    engine = make_engine()
+    assert engine.speculative and engine.draft_len == 6
+    assert not make_engine(speculative=False).speculative
+    assert make_engine(draft_len=3).draft_len == 3
+    monkeypatch.setenv("PRIME_SERVE_SPEC", "0")
+    assert not make_engine().speculative
 
 
 def test_overlap_dispatches_next_chunk_before_syncing_previous(monkeypatch):
@@ -766,16 +783,144 @@ def test_overlap_decode_failure_with_inflight_chunk_recovers():
     assert fresh.all_tokens(timeout=1) == reference_tokens([7, 8, 9], 4)
 
 
-def test_spec_chunk_runs_synchronously():
-    """Pin the shipped speculative behavior: spec mode always runs the
-    serial loop (overlap gated off at construction) and never leaves a
+def test_spec_serial_loop_reference(monkeypatch):
+    """The serial speculative loop (overlap=False) is the bit-identity
+    reference: the fused dispatch syncs immediately and never leaves a
     chunk in flight."""
-    engine = make_engine(speculative=True, draft_len=4)
+    engine = make_engine(speculative=True, draft_len=4, overlap=False)
     assert not engine.overlap
     req = engine.submit(list(range(1, 9)) * 2, max_new_tokens=12)
     drain(engine, req)
     assert not engine._inflight
     assert req.all_tokens(timeout=1) == reference_tokens(list(range(1, 9)) * 2, 12)
+
+
+def test_spec_overlap_pipelines_like_decode(monkeypatch):
+    """The tentpole property: speculative mode rides the one-chunk-deep
+    pipeline — spec chunk N+1's serve.spec_dispatch span finishes BEFORE
+    chunk N's serve.sync span (the host enqueued the next fused
+    propose+verify before blocking for the previous one's tokens), and the
+    emitted greedy tokens still match the reference exactly."""
+    from prime_tpu.obs.trace import Tracer
+    from prime_tpu.serve import engine as engine_mod
+
+    tracer = Tracer(enabled=True)
+    monkeypatch.setattr(engine_mod, "TRACER", tracer)
+    engine = make_engine(speculative=True, draft_len=4)
+    assert engine.overlap
+    prompt = [5, 9, 301, 42, 77]
+    req = engine.submit(prompt, max_new_tokens=16)
+    drain(engine, req)
+    engine.tick()  # drain the lookahead chunk
+    order = [
+        (s["name"], s["attrs"]["seq"])
+        for s in tracer.drain()
+        if s["name"] in ("serve.spec_dispatch", "serve.sync")
+    ]
+    assert ("serve.spec_dispatch", 1) in order and ("serve.sync", 0) in order
+    for name, seq in order:
+        if name == "serve.sync" and ("serve.spec_dispatch", seq + 1) in order:
+            assert order.index(("serve.spec_dispatch", seq + 1)) < order.index(
+                ("serve.sync", seq)
+            ), f"spec chunk {seq + 1} was not dispatched before chunk {seq}'s sync"
+    assert req.all_tokens(timeout=1) == reference_tokens(prompt, 16)
+
+
+@pytest.mark.parametrize("cache_mb", [0, 8], ids=["nocache", "prefixcache"])
+def test_spec_bit_identity_matrix(cache_mb):
+    """The acceptance matrix: greedy outputs with speculative mode on are
+    bit-identical to the serial spec loop AND to non-spec decode across
+    overlap x prefix-cache, including a second shared-prefix wave that
+    actually hits the cache when it is on."""
+    shared = list(range(5, 37))  # 32 tokens: two MIN_BUCKET blocks
+    prompts = [
+        list(range(1, 9)) * 2,            # periodic: drafts land
+        [7, 100, 23, 451, 88, 3],         # aperiodic: drafts mostly miss
+        shared + [61, 62],                # shared-prefix pair: wave 2 hits
+        shared + [63],
+    ]
+
+    def run(**kw):
+        engine = make_engine(prefix_cache_mb=cache_mb, min_prefix=16, **kw)
+        waves = []
+        for _ in range(2):
+            reqs = [engine.submit(list(p), max_new_tokens=10) for p in prompts]
+            drain(engine, *reqs)
+            engine.tick()  # drain any lookahead chunk
+            waves.append([r.all_tokens(timeout=1) for r in reqs])
+        return engine, waves
+
+    spec_overlap, out_spec_overlap = run(speculative=True, overlap=True)
+    spec_serial, out_spec_serial = run(speculative=True, overlap=False)
+    plain, out_plain = run(speculative=False, overlap=True)
+    assert out_spec_overlap == out_spec_serial == out_plain
+    for p, tokens in zip(prompts, out_spec_overlap[0]):
+        assert tokens == reference_tokens(list(p), 10)
+    if cache_mb:
+        # the prefix cache really served the second wave under speculation
+        assert spec_overlap.prefix_hits >= 2
+        assert spec_overlap.prefix_hits == spec_serial.prefix_hits == plain.prefix_hits
+    # acceptance evidence flowed: periodic prompts accept drafts
+    stats = spec_overlap.stats()
+    assert stats["speculative"] and stats["draft_len"] == 4
+    assert stats["spec_accept_ratio"] > 0
+
+
+def test_spec_acceptance_metrics_and_waste_accounting():
+    """Spec obs satellite: serve_spec_accepted_tokens observes per-window
+    accepted drafts, serve_spec_draft_tokens_total counts proposals, the
+    accept-ratio gauge publishes their quotient, and a retirement-lagged
+    spec window counts its accepted-length run as wasted decode."""
+    prompt = [5, 9, 301, 42, 77]
+    ref = reference_tokens(prompt, 12)
+    eos = ref[3]
+    engine = make_engine(speculative=True, draft_len=4, eos_id=eos)
+    assert engine.overlap
+    req = engine.submit(prompt, max_new_tokens=12)
+    drain(engine, req)
+    for _ in range(3):
+        engine.tick()  # drain the pipeline (stale lookahead window)
+    assert req.all_tokens(timeout=1) == ref[:3]
+    values = engine.registry.values()
+    proposed = values["serve_spec_draft_tokens_total"]
+    assert proposed > 0 and proposed % engine.draft_len == 0
+    hist = engine.registry.snapshot()["serve_spec_accepted_tokens"]["series"][0]
+    assert hist["count"] == proposed / engine.draft_len
+    expected_ratio = hist["sum"] / proposed
+    engine.stats()
+    assert engine.registry.values()["serve_spec_accept_ratio"] == pytest.approx(
+        expected_ratio
+    )
+    # the EOS-retired slot's stale in-flight window was counted as waste
+    assert engine.stats()["wasted_decode_tokens"] >= 1
+
+
+def test_spec_overlap_admission_overhead_capacity_pin():
+    """Satellite: with an in-flight spec chunk a slot can hold up to
+    2*(draft_len+1) unretired token positions, so admission reserves them —
+    a request at exactly the bound completes without any KV write past the
+    slot capacity, and one more token is refused at submit()."""
+    engine = make_engine(speculative=True, draft_len=4, capacity=64)
+    assert engine.overlap and engine.spec_overhead == 10
+    fits = 64 - 16 - engine.spec_overhead
+    prompt = list(range(1, 9)) * 2  # periodic 16 tokens: windows really run
+    req = engine.submit(prompt, max_new_tokens=fits)
+    with pytest.raises(ValueError, match="verify window"):
+        engine.submit(prompt, max_new_tokens=fits + 1)
+    drain(engine, req)
+    for _ in range(3):
+        engine.tick()  # let the stale lookahead window land
+    assert req.all_tokens(timeout=1) == reference_tokens(prompt, fits)
+    import numpy as np
+
+    # device truth: even after the stale lookahead window, no slot length
+    # escapes the row — every KV write a LIVE request saw landed unclamped
+    lengths = np.asarray(engine._cache.lengths)
+    assert int(lengths.max()) <= engine.capacity
+    # the serial loop reserves a single window
+    serial = make_engine(speculative=True, draft_len=4, capacity=64, overlap=False)
+    assert serial.spec_overhead == 5
+    serial.submit(prompt, max_new_tokens=64 - 16 - 5)
 
 
 def test_idle_burst_requeues_into_one_batched_wave():
@@ -864,12 +1009,22 @@ def test_warmup_failure_reallocates_state_and_serves():
     assert boomed
 
 
-def test_warmup_speculative_covers_verify_program():
-    engine = make_engine(
-        max_slots=2, capacity=64, prefill_chunk=16, speculative=True, draft_len=4
-    )
+def test_warmup_speculative_covers_spec_program_set():
+    """The spec program set is pinned relative to the plain engine: one
+    fused propose+verify program plus one history-seed program per
+    admission-wave width (powers of two up to max_slots). A drifting count
+    means a spec program real traffic compiles mid-pipeline that warmup
+    missed. Warmup must also leave the history ring cold: the first real
+    request still matches the reference."""
+    kw = dict(max_slots=2, capacity=64, prefill_chunk=16)
+    engine = make_engine(speculative=True, draft_len=4, **kw)
     programs = engine.warmup()
-    assert programs >= 2  # decode + spec-verify at minimum
+    plain_programs = make_engine(**kw).warmup()
+    # + fused spec dispatch + hist-seed at wave widths {1, 2}
+    assert programs == plain_programs + 1 + 2
+    import numpy as np
+
+    assert int(np.asarray(engine._hist_len).max()) == 0  # ring is cold
     prompt = list(range(1, 9)) * 2
     req = engine.submit(prompt, max_new_tokens=10)
     drain(engine, req)
@@ -1017,42 +1172,40 @@ def test_serve_model_accepts_sequence_parallel():
         assert response.status_code == 200
 
 
-def test_bigram_index_matches_backward_scan():
-    """The incremental prompt-lookup index must propose exactly what the
-    O(history) backward scan it replaced proposed, across random histories
-    and incremental extends (advisor r3: the per-tick scan was host-side
-    Python over the full history for every slot)."""
+def test_device_ngram_proposals_match_backward_scan():
+    """The device-resident drafter (propose_ngram_drafts over the history
+    ring — the one the fused spec program calls) must propose exactly what
+    the O(history) host backward scan it replaced proposed, across random
+    histories: most recent earlier bigram occurrence wins, fallbacks repeat
+    the trailing token."""
     import random
+
+    from prime_tpu.models.speculative import propose_ngram_drafts
 
     def scan_reference(history, draft_len, pad_id):
         if len(history) < 2:
-            return (history[-1:] or [pad_id]) * draft_len
+            return list(history[-1:]) * draft_len
         t0, t1 = history[-2], history[-1]
         for position in range(len(history) - 3, -1, -1):
             if history[position] == t0 and history[position + 1] == t1:
                 window = history[position + 2 : position + 2 + draft_len]
+                # a tail-adjacent match repeats the trailing token past the
+                # valid length — "the run continues", never ring pads
                 return window + [t1] * (draft_len - len(window))
         return [t1] * draft_len
 
-    engine = make_engine(speculative=True, draft_len=4)
     rng = random.Random(7)
-    for trial in range(40):
+    width, draft_len, pad_id = 40, 4, 0
+    for _ in range(40):
         # small alphabet → plenty of repeated bigrams
         history = [rng.randrange(1, 6) for _ in range(rng.randrange(1, 30))]
-        engine._histories[0] = list(history)
-        engine._bigram_index[0] = {}
-        engine._index_bigrams(0, 0)
-        assert engine._propose_drafts(0) == scan_reference(history, 4, engine.pad_id)
-        # grow incrementally, as _spec_chunk does after each verify round
-        for _ in range(6):
-            old_len = len(engine._histories[0])
-            engine._histories[0].extend(
-                rng.randrange(1, 6) for _ in range(rng.randrange(1, 4))
-            )
-            engine._index_bigrams(0, old_len)
-            assert engine._propose_drafts(0) == scan_reference(
-                engine._histories[0], 4, engine.pad_id
-            )
+        ring = history + [pad_id] * (width - len(history))
+        drafts = propose_ngram_drafts(
+            jnp.asarray([ring], dtype=jnp.int32),
+            jnp.asarray([len(history)], dtype=jnp.int32),
+            draft_len,
+        )
+        assert drafts[0].tolist() == scan_reference(history, draft_len, pad_id)
 
 
 def test_engine_gptoss_matches_sampler():

@@ -73,6 +73,24 @@ def test_shared_prefix_shared_within_tenant_only():
     assert p1 != p2  # distinct across tenants
 
 
+def test_spec_friendly_prompts_tile_a_cycle():
+    """The spec_friendly scenario's tails tile one short token cycle per
+    request (the repetitive shape n-gram drafts accept), deterministically
+    from the seed; cycle_tokens=0 keeps the historical i.i.d. draw for
+    every other kind."""
+    schedule = build_schedule(SCENARIOS["spec_friendly"](seed=3))
+    assert schedule, "spec_friendly produced no requests"
+    phase = SCENARIOS["spec_friendly"](seed=3).phases[0]
+    assert phase.cycle_tokens > 0
+    for r in schedule:
+        tail = r.prompt_ids[1:]  # [0] is the BOS stand-in
+        cycle = tail[: phase.cycle_tokens]
+        for i, tok in enumerate(tail):
+            assert tok == cycle[i % len(cycle)]
+    with pytest.raises(ValueError, match="cycle_tokens"):
+        Phase(kind="spec_friendly", n=1, prompt_tokens=8, cycle_tokens=8)
+
+
 def test_cancel_storm_pins_cancel_points():
     schedule = build_schedule(SCENARIOS["cancel_storm"](seed=5))
     cancels = [r for r in schedule if r.cancel_after_s is not None]
@@ -134,11 +152,17 @@ def test_captured_at_name_is_reserved():
 
 
 def _snap(captured_at, tokens, admitted=4, hits=1, ttft_counts=None,
-          stall=0.0, window=0.0):
+          stall=0.0, window=0.0, spec_accepted=0.0, spec_windows=0,
+          spec_drafts=0.0):
     """Hand-built registry snapshot: the report consumes plain dicts, so the
     arithmetic is testable without clocks."""
     ttft_counts = ttft_counts or [0, 0, 0]
     snap = {
+        "serve_spec_accepted_tokens": {"type": "histogram", "help": "", "series": [{
+            "labels": {}, "buckets": [1.0, 4.0], "counts": [0, 0, 0],
+            "sum": float(spec_accepted), "count": int(spec_windows)}]},
+        "serve_spec_draft_tokens_total": {"type": "counter", "help": "", "series": [
+            {"labels": {}, "value": float(spec_drafts)}]},
         "captured_at": {"type": "gauge", "help": "", "series": [
             {"labels": {}, "value": captured_at}]},
         "serve_tokens_emitted_total": {"type": "counter", "help": "", "series": [
@@ -208,6 +232,25 @@ def test_report_merges_multiple_engine_components():
     # 60 + 40 tokens over the (equal) 2 s windows
     assert row["tokens"] == 100
     assert row["tok_s"] == pytest.approx(50.0)
+
+
+def test_report_spec_fields_are_registry_windowed():
+    """spec_accepted_tokens / spec_accept_ratio come from the accepted-
+    tokens histogram's sum delta over the proposed-draft counter delta —
+    windowed like every other field, None when no verify window ran."""
+    before = {"engine": _snap(10.0, tokens=0, spec_accepted=12.0,
+                              spec_windows=6, spec_drafts=40.0)}
+    after = {"engine": _snap(12.0, tokens=80, spec_accepted=42.0,
+                             spec_windows=16, spec_drafts=80.0)}
+    row = scenario_row(_FakeResult(before, after))
+    assert row["spec_accepted_tokens"] == 30  # 42 - 12
+    assert row["spec_accept_ratio"] == pytest.approx(30.0 / 40.0)
+    # spec off: the counter never moves -> ratio is None, not 0.0
+    quiet = scenario_row(_FakeResult(
+        {"engine": _snap(1.0, tokens=0)}, {"engine": _snap(2.0, tokens=8)}
+    ))
+    assert quiet["spec_accept_ratio"] is None
+    assert quiet["spec_accepted_tokens"] == 0
 
 
 def test_report_field_set_is_stable():

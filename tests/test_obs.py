@@ -430,15 +430,16 @@ def test_engine_histograms_populate_through_streamed_completion():
             # legacy JSON: the pre-registry counter keys, plus the decode
             # pipeline fields (PR 2), the radix prefix-cache fields (PR 3),
             # the fleet admission/drain fields (PR 4), the host spill
-            # tier fields (PR 6), and the sharded-replica mesh fields —
-            # additive only
+            # tier fields (PR 6), the sharded-replica mesh fields, and the
+            # speculative-decoding fields — additive only
             engine_stats = httpx.get(f"{srv.url}/metrics").json()["engine"]
             assert set(engine_stats) == {
                 "requests_admitted", "requests_completed", "requests_cancelled",
                 "requests_failed", "tokens_emitted", "prefix_hits",
                 "batched_admission_waves", "active_slots", "queue_depth",
                 "max_slots", "max_queue", "mesh_devices", "mesh_axes", "state",
-                "overlap", "inflight_depth", "host_stall_s", "chunk_window_s",
+                "overlap", "speculative", "draft_len", "spec_accept_ratio",
+                "inflight_depth", "host_stall_s", "chunk_window_s",
                 "overlap_ratio", "wasted_decode_tokens", "warmup_programs",
                 "prefix_cache_bytes", "prefix_cache_host_bytes",
                 "prefix_host_tier_disabled",

@@ -36,6 +36,8 @@ def _default_serve_env(monkeypatch):
     monkeypatch.delenv("PRIME_SERVE_OVERLAP", raising=False)
     monkeypatch.delenv("PRIME_SERVE_WARMUP", raising=False)
     monkeypatch.delenv("PRIME_SERVE_MESH", raising=False)
+    monkeypatch.delenv("PRIME_SERVE_SPEC", raising=False)
+    monkeypatch.delenv("PRIME_SERVE_DRAFT_LEN", raising=False)
     monkeypatch.delenv("PRIME_SERVE_PREFIX_CACHE_MB", raising=False)
     monkeypatch.delenv("PRIME_SERVE_PREFIX_CACHE_HOST_MB", raising=False)
 
@@ -179,6 +181,97 @@ def test_sharded_warmup_program_set_pin():
     req = sharded.submit(WAVE_PROMPTS[2], max_new_tokens=6)
     drain(sharded, req)
     assert req.all_tokens(timeout=5) == reference_tokens(WAVE_PROMPTS[2], 6)
+
+
+# ---- speculative decoding on the mesh ----------------------------------------
+
+
+# periodic + aperiodic + the shared-prefix pair: drafts land on the first,
+# miss on the second, and the pair's second wave exercises spec + cache hit
+SPEC_PROMPTS = [
+    list(range(1, 9)) * 2,
+    [7, 100, 23, 451, 88, 3],
+    _PREAMBLE + [61, 62],
+    _PREAMBLE + [63],
+]
+
+
+@requires_multichip
+@pytest.mark.parametrize("overlap", [True, False], ids=["overlap", "sync"])
+def test_sharded_spec_bit_identity(overlap):
+    """Speculative decoding spans the mesh: the fused propose+verify program
+    (history ring + draft buffers placed with the (dp, fsdp, tp) layout)
+    emits greedy tokens bit-identical to the single-chip spec engine, to the
+    serial spec loop, and to non-spec decode — two waves with the prefix
+    cache on, so the second wave assembles from the sharded radix cache
+    while speculating."""
+
+    def run(**engine_kw):
+        engine = make_engine(
+            overlap=overlap, prefix_cache_mb=1, min_prefix=16, **engine_kw
+        )
+        waves = []
+        for _ in range(2):
+            reqs = [engine.submit(list(p), max_new_tokens=8) for p in SPEC_PROMPTS]
+            drain(engine, *reqs)
+            engine.tick()  # drain any lookahead chunk
+            waves.append([r.all_tokens(timeout=5) for r in reqs])
+        return engine, waves
+
+    sharded, sharded_out = run(speculative=True, mesh_config=MESH_SPEC)
+    assert sharded.mesh_devices == 4 and sharded.speculative
+    single, single_out = run(speculative=True)
+    plain, plain_out = run()
+    assert sharded_out == single_out == plain_out
+    for prompt, tokens in zip(SPEC_PROMPTS, sharded_out[0]):
+        assert tokens == reference_tokens(list(prompt), 8)
+    # the sharded cache served the second wave while speculating
+    assert sharded.prefix_hits >= 2
+    assert sharded.prefix_hits == single.prefix_hits
+    # acceptance evidence from the sharded verify windows
+    assert sharded.stats()["spec_accept_ratio"] > 0
+
+
+@requires_multichip
+def test_sharded_spec_warmup_program_set_pin():
+    """The spec program set is topology-independent too: a speculative
+    sharded engine executes exactly the speculative single-chip engine's
+    warmup program count (fused spec dispatch + hist-seed wave widths
+    included)."""
+    sharded = make_engine(
+        prefix_cache_mb=1, capacity=64, speculative=True, mesh_config=MESH_SPEC
+    )
+    single = make_engine(prefix_cache_mb=1, capacity=64, speculative=True)
+    assert sharded.warmup() == single.warmup()
+    req = sharded.submit(SPEC_PROMPTS[0], max_new_tokens=6)
+    drain(sharded, req)
+    assert req.all_tokens(timeout=5) == reference_tokens(SPEC_PROMPTS[0], 6)
+
+
+@requires_multichip
+def test_sharded_spec_dispatch_spans_carry_mesh_devices(tmp_path):
+    """The serve.spec_dispatch span (satellite obs) stamps mesh_devices on a
+    sharded engine, read back from a real JSONL sink."""
+    import json
+
+    from prime_tpu.obs.trace import TRACER
+
+    sink = tmp_path / "trace.jsonl"
+    engine = make_engine(speculative=True, mesh_config=MESH_SPEC)
+    prev = TRACER.reconfigure(enabled=True, sink_path=str(sink))
+    try:
+        req = engine.submit(SPEC_PROMPTS[0], max_new_tokens=4)
+        drain(engine, req)
+        engine.tick()
+    finally:
+        TRACER.reconfigure(**prev)
+    spans = [
+        json.loads(line)["attrs"]
+        for line in sink.read_text().splitlines()
+        if json.loads(line)["name"] == "serve.spec_dispatch"
+    ]
+    assert spans and all(a.get("mesh_devices") == 4 for a in spans)
+    assert all(a.get("draft_len") == 4 for a in spans)
 
 
 # ---- mesh observability ------------------------------------------------------
