@@ -693,6 +693,10 @@ def main() -> None:
     serve_slots = SERVE_SLOTS
     serve_prompts = serve_prompts_for(config)
     loadgen_results: list = []
+    # scenario rows produced by self-contained comparisons (the disagg
+    # section builds its own HTTP fleets and returns finished rows): appended
+    # to the SLO report's scenarios without touching its headline
+    loadgen_rows_extra: list = []
 
     def run_serve(
         kv_quant: bool = False, speculative: bool = False, prompts=None,
@@ -1135,6 +1139,50 @@ def main() -> None:
         print(f"# bench: serve fleet section failed: {e}", flush=True)
     print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
 
+    # ---- serve: disaggregated prefill/decode (phase-split fleet) ------------
+    # The long-prompt-heavy `disagg` scenario against the SAME two-engine
+    # device budget twice: colocated (two any-role replicas) vs phase-split
+    # (1 prefill + 1 decode replica, KV migrated over GET/PUT /admin/kv in
+    # the prefix-cache wire format). Both runs are registry-windowed through
+    # loadgen; the record carries both tok/s, both TTFT p95s, and the
+    # migration outcome/byte evidence — docs/architecture.md "Disaggregated
+    # serving". Engine warmup is off here (the direct + router warm passes
+    # inside the comparison cover the shapes in play; AOT warmup on a remote
+    # TPU costs minutes per engine).
+    try:
+        from prime_tpu.loadgen.scenario import loadgen_seed_default
+        from prime_tpu.loadgen.smoke import disagg_comparison
+
+        # smoke mode swaps the tiny bench model for debug-128m: at tiny-test
+        # scale the migration's fixed per-request cost dwarfs the prefill it
+        # offloads, so the comparison would measure the harness, not the
+        # architecture (same rule the loadgen smoke's disagg section follows)
+        # — a real-model bench round keeps the bench checkpoint
+        if SMOKE:
+            from prime_tpu.models import get_config as _get_config
+
+            disagg_config = _get_config("debug-128m")
+            disagg_params = init_params(
+                jax.random.PRNGKey(0), disagg_config, dtype=jnp.float32
+            )
+        else:
+            disagg_config, disagg_params = config, params
+        disagg_record, disagg_rows = disagg_comparison(
+            disagg_config, lambda i: disagg_params, seed=loadgen_seed_default(),
+            model_id="bench-disagg", max_slots=max(2, serve_slots // 2),
+            capacity=SERVE_CAPACITY, chunk=SERVE_CHUNK, warmup=False,
+            log=lambda msg: print(f"# bench: {msg.lstrip('# ')}", flush=True),
+        )
+        record.update(disagg_record)
+        # the comparison builds its own HTTP fleets, so its RunResults are
+        # already folded into the rows it returns — append them to the SLO
+        # report the same way the in-process sections' results are
+        loadgen_rows_extra.extend(disagg_rows)
+    except Exception as e:  # noqa: BLE001
+        record["serve_disagg_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(f"# bench: serve disagg section failed: {e}", flush=True)
+    print(json.dumps(record), flush=True)  # checkpoint: last JSON line wins
+
     # ---- sharded replica serve section (the MULTICHIP serving number) -------
     # ONE engine spanning every visible device (docs/architecture.md "Sharded
     # replica"): the engine builds the (dp, fsdp, tp) mesh from a declarative
@@ -1180,11 +1228,18 @@ def main() -> None:
     # overlap and hit ratios — what scripts/perf_delta.py flattens into the
     # per-PR trajectory and scripts/serve_profile.py --slo merges with traces
     try:
-        if loadgen_results:
+        if loadgen_results or loadgen_rows_extra:
+            # loadgen_rows_extra alone still produces a report: the disagg
+            # comparison builds its own fleets, so its rows must survive
+            # even a round where every in-process serve section failed
             record["loadgen"] = build_report(
                 loadgen_results,
                 meta={"backend": record.get("backend", "unknown")},
             )
+            # disagg-comparison rows ride along without joining the headline
+            # (their fleets are separate stacks; the headline stays the
+            # driven-engine sections' aggregate, exactly as before)
+            record["loadgen"]["scenarios"].extend(loadgen_rows_extra)
             headline = record["loadgen"]["headline"]
             print(
                 f"# bench: loadgen SLO report — {len(loadgen_results)} scenarios, "

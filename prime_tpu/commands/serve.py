@@ -100,6 +100,15 @@ from prime_tpu.utils.render import Renderer, output_options
          "0 = unbounded. Default: 0 (PRIME_SERVE_MAX_QUEUE).",
 )
 @click.option(
+    "--role", type=click.Choice(["prefill", "decode", "any"]), default=None,
+    help="Phase role in a disaggregated fleet, advertised in /healthz: a "
+         "`prime serve fleet` router with both explicit roles present "
+         "prefills on a prefill replica and migrates the KV to a decode "
+         "replica over /admin/kv. Pair with --mesh role:prefill / "
+         "role:decode for the role-preset mesh layout. Default: any "
+         "(PRIME_SERVE_ROLE).",
+)
+@click.option(
     "--replica-of", default=None, metavar="ROUTER_URL",
     help="Register this server with a running `prime serve fleet` router "
          "(POST ROUTER_URL/admin/join) once the model is loaded.",
@@ -142,6 +151,7 @@ def serve_cmd(
     prefix_cache_mb: float | None,
     prefix_cache_host_mb: float | None,
     max_queue: int | None,
+    role: str | None,
     replica_of: str | None,
     advertise_url: str | None,
     fleet_token: str | None,
@@ -197,6 +207,7 @@ def serve_cmd(
             prefix_cache_mb=prefix_cache_mb,
             prefix_cache_host_mb=prefix_cache_host_mb,
             max_queue=max_queue,
+            role=role,
         )
     except (ValueError, OSError) as e:
         raise click.ClickException(str(e)) from None

@@ -284,7 +284,17 @@ class JaxGenerator:
                 kw["attn_impl"] = "xla"
         import contextlib
 
-        ctx = jax.set_mesh(self.mesh) if self.mesh is not None else contextlib.nullcontext()
+        # enter_mesh, not jax.set_mesh directly: the toolchain spells the
+        # ambient-mesh context jax.set_mesh, but 0.4.x builds (the thin test
+        # containers, where bench.py's eval section used to die on the
+        # AttributeError) predate it — the compat shim falls back to the
+        # Mesh's own context manager, same as every engine dispatch site
+        if self.mesh is not None:
+            from prime_tpu.parallel.compat import enter_mesh
+
+            ctx = enter_mesh(self.mesh)
+        else:
+            ctx = contextlib.nullcontext()
         with ctx:
             if self.speculative:
                 from prime_tpu.models.speculative import spec_generate

@@ -40,6 +40,17 @@ HEADLINE_METRICS: tuple[tuple[str, str], ...] = (
     ("prefixburst hit ratio", "serve_prefixburst_hit_ratio"),
     ("fleet tok/s", "serve_fleet_tok_s"),
     ("fleet affinity ratio", "serve_fleet_affinity_ratio"),
+    # disaggregated prefill/decode (own keys, never folded into the serve/
+    # fleet rows above: the phase-split and colocated numbers come from a
+    # dedicated scenario and must only ever delta against themselves)
+    ("disagg tok/s", "serve_disagg_tok_s"),
+    ("disagg colocated tok/s", "serve_disagg_colo_tok_s"),
+    ("disagg speedup", "serve_disagg_speedup"),
+    ("disagg ttft p50 ms", "serve_disagg_ttft_p50_ms"),
+    ("disagg colocated ttft p50 ms", "serve_disagg_colo_ttft_p50_ms"),
+    ("disagg ttft p95 ms", "serve_disagg_ttft_p95_ms"),
+    ("disagg colocated ttft p95 ms", "serve_disagg_colo_ttft_p95_ms"),
+    ("disagg migrate bytes", "serve_disagg_migrate_bytes"),
     ("sharded tok/s", "serve_sharded_tok_s"),
     ("int8 tok/s", "int8_weights_tok_s"),
     ("int4 tok/s", "int4_weights_tok_s"),

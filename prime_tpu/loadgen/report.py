@@ -296,6 +296,20 @@ def scenario_row(result) -> dict[str, Any]:
                     before.get(name, {}), "fleet_reroutes_total", "reason"
                 ).get(reason, 0.0)
                 reroutes[reason] = reroutes.get(reason, 0.0) + value - prev
+        # phase-split migrations (disaggregated serving), windowed like the
+        # reroutes — a long-lived router's lifetime migration totals (warmup
+        # traffic included) must not be misattributed to this scenario
+        migrations: dict[str, float] = {}
+        for name in routers:
+            prev_m = _labeled_values(
+                before.get(name, {}), "fleet_migrations_total", "outcome"
+            )
+            for outcome, value in _labeled_values(
+                after[name], "fleet_migrations_total", "outcome"
+            ).items():
+                migrations[outcome] = (
+                    migrations.get(outcome, 0.0) + value - prev_m.get(outcome, 0.0)
+                )
         # per-replica split as a WINDOWED delta, like every other field in
         # the row — a long-lived router's lifetime totals must not be
         # misattributed to this scenario
@@ -317,6 +331,8 @@ def scenario_row(result) -> dict[str, Any]:
                 else None
             ),
             "cache_routed": int(rdelta("fleet_cache_routed_total")),
+            "migrations": {k: int(v) for k, v in migrations.items() if v},
+            "migrate_bytes": int(rdelta("fleet_migrate_bytes_total")),
             "reroutes": {k: int(v) for k, v in reroutes.items() if v},
             "admission_rejected": int(rdelta("fleet_admission_rejected_total")),
             "requests_by_replica": {

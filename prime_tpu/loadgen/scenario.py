@@ -341,6 +341,30 @@ def spec_friendly(seed: int | None = None, **overrides) -> Scenario:
     )
 
 
+def disagg(seed: int | None = None, **overrides) -> Scenario:
+    """Long-prompt-heavy traffic — the phase-split shape (ROADMAP Open item
+    4): a continuous wave of long-prefill requests with real decode tails.
+    On a colocated fleet every admission's long prefill blocks the engine
+    loop, stalling the decode ticks of every slot sharing the replica; a
+    disaggregated fleet prefills on one replica (whose slots free at
+    admission, so waves batch) and decodes on another (whose loop only ever
+    pays the assemble + unaligned-tail suffix per migrated request).
+    A short per-tenant preamble keeps the prefix-affinity/cache machinery
+    in play (each admission still prefills ≥ 95% of its prompt cold, so
+    the interference the scenario exists to create survives)."""
+    seed = loadgen_seed_default() if seed is None else seed
+    phase = dict(
+        kind="longctx", n=_scale(8, 16), tenants=2, shared_prefix=16,
+        prompt_tokens=_scale(192, 384), max_new_tokens=16,
+        spread_s=_scale(1, 2) * 1.0,
+    )
+    phase.update(overrides)
+    return Scenario(
+        "disagg", seed, (Phase(**phase),),
+        description="long-prompt-heavy wave for the phase-split fleet",
+    )
+
+
 def smoke(seed: int | None = None) -> Scenario:
     """The CI scenario: one tiny composite touching every phase kind in
     seconds on CPU — shared-prefix burst, one long outlier, a couple of
@@ -373,5 +397,6 @@ SCENARIOS = {
     "rate_storm": rate_storm,
     "mixed_tenants": mixed_tenants,
     "spec_friendly": spec_friendly,
+    "disagg": disagg,
     "smoke": smoke,
 }

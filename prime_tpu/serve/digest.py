@@ -194,6 +194,19 @@ class HotPrefixDigest:
         }
 
 
+REPLICA_ROLES = ("prefill", "decode", "any")
+
+
+def parse_role(value: object) -> str:
+    """Tolerant /healthz ``role`` parse (disaggregated serving): replicas
+    that predate the field omit it, partial rollouts may send junk — either
+    coerces to ``"any"`` (the every-phase role, the pre-disaggregation
+    behavior), never a poll failure. Same contract as :func:`parse_digest`:
+    the advertisement is a routing hint, degrading it must not take a
+    replica out of rotation."""
+    return value if isinstance(value, str) and value in REPLICA_ROLES else "any"
+
+
 def parse_digest(payload: object, cap: int = RETAIN_MAX_ENTRIES) -> frozenset[int]:
     """Tolerant router-side parse of a /healthz ``prefix_digest`` field:
     older replicas omit it entirely, partial rollouts may send malformed or

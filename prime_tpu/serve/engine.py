@@ -316,6 +316,7 @@ class ContinuousBatchingEngine:
         overlap: bool | None = None,
         warmup: bool | None = None,
         max_queue: int | None = None,
+        prefix_store_all: bool = False,
         registry: Registry | None = None,
     ) -> None:
         import jax
@@ -435,6 +436,11 @@ class ContinuousBatchingEngine:
         # while the loop keeps ticking until in-flight requests finish
         self._draining = False
         self._pending: queue.Queue[EngineRequest | None] = queue.Queue()
+        # prefix-KV wire jobs (export/import for disaggregated serving):
+        # HTTP handler threads enqueue, the engine loop executes — the radix
+        # tree is engine-thread-owned, so /admin/kv must marshal onto the
+        # loop instead of walking it cross-thread
+        self._kv_jobs: queue.Queue = queue.Queue()
         # requests the idle loop popped and handed back for batched
         # admission: consumed by _admit before _pending (engine thread only)
         self._requeued: deque[EngineRequest] = deque()
@@ -470,6 +476,15 @@ class ContinuousBatchingEngine:
                 "PRIME_SERVE_PREFIX_CACHE_HOST_MB", DEFAULT_PREFIX_CACHE_HOST_MB
             )
         self.prefix_cache_host_mb = float(prefix_cache_host_mb)
+        # role-tuned store policy (docs/architecture.md "Disaggregated
+        # serving"): batched admission waves store only member 0's prefix by
+        # default (slicing every member costs per-leaf tree ops per request,
+        # and colocated serving only needs the recurring-preamble hit). A
+        # PREFILL-role replica's whole job is producing exportable KV — with
+        # prefix_store_all every wave member's row is stored, so a migrated
+        # request's GET /admin/kv always finds its path whether admission
+        # batched it or not. serve_model flips this on for --role prefill.
+        self.prefix_store_all = bool(prefix_store_all)
         self._host_tier_gated = False
         if self.prefix_cache_host_mb > 0 and mesh is not None and getattr(mesh, "size", 1) > 1:
             # the spill tier's converters are not sharding-preserving:
@@ -564,6 +579,27 @@ class ContinuousBatchingEngine:
         self._m_prefix_assembles = r.counter(
             "serve_prefix_assembles_total",
             "assemble_row dispatches (one per prefix-seeded admission)",
+        )
+        # disaggregated serving (docs/architecture.md "Disaggregated
+        # serving"): prefix-KV segments shipped over the versioned wire
+        # format — exports serve GET /admin/kv on a prefill replica, imports
+        # land PUT /admin/kv payloads on a decode replica. Export bytes are
+        # payload bytes on the wire; import bytes are the KV bytes actually
+        # planted (shared blocks dedup to zero, exactly like a local insert).
+        self._m_kv_exports = r.counter(
+            "serve_kv_exports_total",
+            "Prefix-KV wire exports served (GET /admin/kv with a cached prefix)",
+        )
+        self._m_kv_export_bytes = r.counter(
+            "serve_kv_export_bytes_total", "Wire payload bytes exported"
+        )
+        self._m_kv_imports = r.counter(
+            "serve_kv_imports_total",
+            "Prefix-KV wire imports applied (PUT /admin/kv)",
+        )
+        self._m_kv_import_bytes = r.counter(
+            "serve_kv_import_bytes_total",
+            "KV bytes planted by wire imports (after radix dedup)",
         )
         # last-seen cache counter values: the cache owns the monotonic truth,
         # _sync_prefix_metrics publishes deltas into the registry counters
@@ -1533,7 +1569,8 @@ class ContinuousBatchingEngine:
         """
         self._tick_busy = True
         try:
-            return self._tick_inner()
+            serviced = self._service_kv_jobs()
+            return self._tick_inner() or serviced
         finally:
             self._tick_busy = False
             self._refresh_stats()
@@ -1848,9 +1885,11 @@ class ContinuousBatchingEngine:
         prefill: the chunk forwards run at batch N (weights stream once per
         wave, not once per request) and ONE finalize dispatch splices every
         staged row and samples every first token. The prefix cache is seeded
-        from the FIRST member's row only (slicing every member would cost a
-        dispatch per leaf per request) — enough that a recurring
-        shared-prefix burst prefix-hits from its second wave on."""
+        from the FIRST member's row only (slicing every member costs tree
+        ops per request) — enough that a recurring shared-prefix burst
+        prefix-hits from its second wave on — unless ``prefix_store_all``
+        (prefill-role replicas) asks for every member's path to be
+        exportable."""
         import jax
         import jax.numpy as jnp
 
@@ -1904,11 +1943,16 @@ class ContinuousBatchingEngine:
                 self._seed_hist(
                     reqs, [len(r.prompt_ids) for r in reqs], slots, firsts
                 )
-        # lazy per-leaf slices of member 0: a handful of tiny ops per WAVE
-        row0 = jax.tree_util.tree_map(
-            lambda x: x[:, :1] if x.ndim >= 2 else x[:1], row
-        )
-        self._store_prefix(reqs[0].prompt_ids, row0)
+        # lazy per-leaf slices: member 0 only by default (a handful of tiny
+        # ops per WAVE — enough that a recurring shared-prefix burst hits
+        # from its second wave on); EVERY member on a prefix_store_all
+        # (prefill-role) engine, whose exports must cover batched admissions
+        for i in range(n if self.prefix_store_all else 1):
+            row_i = jax.tree_util.tree_map(
+                lambda x, i=i: x[:, i : i + 1] if x.ndim >= 2 else x[i : i + 1],
+                row,
+            )
+            self._store_prefix(reqs[i].prompt_ids, row_i)
         firsts_host = [int(t) for t in np.asarray(firsts)]  # host sync
         prefill_s = time.monotonic() - t_start
         prefill_ms = round(prefill_s * 1e3, 3)
@@ -2190,6 +2234,98 @@ class ContinuousBatchingEngine:
                 break
         return out[:max_entries]
 
+    # ---- prefix-KV wire export/import (disaggregated serving) ----
+
+    def export_kv(self, ids: list[int], timeout: float = 30.0) -> bytes | None:
+        """Serialize the longest cached prefix of ``ids`` into the versioned
+        wire payload (prefix_cache.export_segments) — what a prefill
+        replica's GET /admin/kv serves. Thread-safe: callers off the engine
+        thread marshal the walk onto the loop (the radix tree is
+        engine-thread-owned); synchronous owners (tests, bench) run it
+        directly. Returns None when nothing usable is cached.
+
+        The WHOLE serialization (device_get + leaf copies) runs on the
+        loop, stalling co-resident decode for a multi-MB export. On a
+        prefill-role replica — the migration path's only export target —
+        there is no decode to stall; an ``any``-role exporter pays the
+        pause. Moving serialization off-loop needs pins that survive a
+        concurrent store-path insert (today ``_split`` asserts an unpinned
+        path, which the same-thread pin discipline guarantees) — a
+        follow-up, not a quick win."""
+        return self._kv_call("export", list(ids), timeout)
+
+    def import_kv(self, payload: bytes, timeout: float = 30.0) -> int:
+        """Apply a wire payload to this engine's prefix cache — what a
+        decode replica's PUT /admin/kv lands. The next admission whose
+        prompt shares the imported path seeds its staging row from the
+        planted segments (one assemble_row dispatch, zero prefix recompute).
+
+        The payload decode/validation (including the one big host-side
+        memcpy rebuilding the leaves) runs on the CALLING thread (an HTTP
+        handler); the loop pays only the radix insert, whose slicer uploads
+        JUST the genuinely new tail — a repeat migration of an
+        already-cached path (the shared-preamble case the balancer's
+        affinity concentrates) walks, dedups, and uploads nothing. Raises
+        ValueError on a version/shape mismatch (validated before the tree
+        is touched). Returns the KV bytes planted after dedup."""
+        if self.prefix_cache is None:
+            raise ValueError("prefix cache disabled; nothing to import into")
+        from prime_tpu.serve.prefix_cache import decode_wire_payload
+
+        tokens, leaves = decode_wire_payload(payload, self.prefix_cache.block)
+        return self._kv_call("import", (tokens, leaves), timeout)
+
+    def _kv_call(self, kind: str, arg: Any, timeout: float):
+        if self._thread is None or self._thread is threading.current_thread():
+            return self._kv_execute(kind, arg)
+        reply: queue.Queue = queue.Queue()
+        self._kv_jobs.put((kind, arg, reply))
+        self._wake.set()
+        try:
+            ok, value = reply.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"KV {kind} not serviced within {timeout}s (engine loop busy "
+                "or wedged)"
+            ) from None
+        if not ok:
+            raise value
+        return value
+
+    def _service_kv_jobs(self) -> bool:
+        """Drain pending /admin/kv jobs on the engine thread (start of every
+        tick — also reachable through the idle loop's wake). Failures travel
+        back to the waiting caller, never kill the loop."""
+        did = False
+        while True:
+            try:
+                kind, arg, reply = self._kv_jobs.get_nowait()
+            except queue.Empty:
+                return did
+            did = True
+            try:
+                reply.put((True, self._kv_execute(kind, arg)))
+            except Exception as e:  # noqa: BLE001 — the caller gets the error
+                reply.put((False, e))
+
+    def _kv_execute(self, kind: str, arg: Any):
+        if kind == "export":
+            if self.prefix_cache is None or len(arg) < self.min_prefix:
+                return None
+            payload = self.prefix_cache.export_segments(arg)
+            if payload is not None:
+                self._m_kv_exports.inc()
+                self._m_kv_export_bytes.inc(len(payload))
+            return payload
+        # import: arg is the pre-decoded host (tokens, leaves) pair from
+        # import_kv — the insert's slicer uploads only the new tail
+        tokens, leaves = arg
+        added = self.prefix_cache.insert_segments(tokens, leaves)
+        self._m_kv_imports.inc()
+        self._m_kv_import_bytes.inc(added)
+        self._sync_prefix_metrics()
+        return added
+
     def _decode_chunk(self) -> None:
         import jax.numpy as jnp
 
@@ -2339,6 +2475,8 @@ class ContinuousBatchingEngine:
             "prefix_spills": int(values["serve_prefix_spills_total"]),
             "prefix_reuploads": int(values["serve_prefix_reuploads_total"]),
             "prefix_assembles": int(values["serve_prefix_assembles_total"]),
+            "kv_exports": int(values["serve_kv_exports_total"]),
+            "kv_imports": int(values["serve_kv_imports_total"]),
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
         with self._stats_lock:
@@ -2372,6 +2510,47 @@ class EngineBackend:
         cacheless replica advertising prompts it cannot assemble would
         steal cache-aware reroutes it then serves with a full recompute."""
         return self.engine.prefix_cache is not None
+
+    def export_kv_text(self, prompt: str) -> bytes | None:
+        """GET /admin/kv?prompt=…: tokenize exactly like submit_text's
+        untemplated path (the router exports the same rendered prompt text
+        it forwards) and serialize the cached prefix over the wire format."""
+        ids = self.tokenizer.encode(prompt, add_special_tokens=True)
+        return self.engine.export_kv(ids)
+
+    def export_kv_messages(self, messages, max_new_tokens: int = 1) -> bytes | None:
+        """GET /admin/kv with a chat-request body: tokenize the messages
+        EXACTLY like a chat admission would — the tokenizer's own chat
+        template when it has one (the templated path adds no special
+        tokens), the generic role-tagged render otherwise, tail-kept like
+        submit_text — so the exported ids always name the radix path the
+        admission actually stored, whatever tokenizer the backend serves.
+        The text-query export above cannot promise that for templated
+        backends (the router's rendering differs from the template), which
+        is why the router's migration path exports through this."""
+        from prime_tpu.serve.server import render_chat_prompt
+
+        tokenizer = self.tokenizer
+        templated = hasattr(tokenizer, "render_chat")
+        prompt = (
+            tokenizer.render_chat(messages)
+            if templated
+            else render_chat_prompt(messages)
+        )
+        ids = tokenizer.encode(prompt, add_special_tokens=not templated)
+        keep = self.engine.capacity - max_new_tokens - self.engine.spec_overhead
+        if keep <= 0:
+            return None
+        return self.engine.export_kv(ids[-keep:])
+
+    def export_kv_ids(self, ids) -> bytes | None:
+        """GET /admin/kv?ids=…: exact id-space export for callers that share
+        the replica's tokenization."""
+        return self.engine.export_kv(list(ids))
+
+    def import_kv(self, payload: bytes) -> int:
+        """PUT /admin/kv: plant a wire payload in this replica's cache."""
+        return self.engine.import_kv(payload)
 
     @property
     def registry(self):

@@ -186,15 +186,24 @@ class PrefixAffinityBalancer:
         self,
         prompt: "Sequence[int] | str | None",
         exclude: "set[str] | None" = None,
+        role: str | None = None,
     ) -> Pick | None:
         """Choose a replica for one request. ``exclude`` holds replica ids
         this request already failed against (connect error / upstream 429) —
-        the retry must go elsewhere. Returns None when no routable replica
-        remains (the router then answers 503/429)."""
+        the retry must go elsewhere. ``role`` restricts the pool to replicas
+        advertising that phase role (``"any"`` replicas serve every phase,
+        so they always qualify) — the disaggregated router picks the prefill
+        and decode legs of a migration through this. Returns None when no
+        routable replica remains (the router then answers 503/429, or falls
+        back to colocated serving for a role-restricted pick)."""
         exclude = exclude or set()
         routable = [
             r for r in self.membership.routable_replicas() if r.id not in exclude
         ]
+        if role is not None:
+            routable = [
+                r for r in routable if getattr(r, "role", "any") in (role, "any")
+            ]
         if not routable:
             return None
         # prefer replicas with a closed breaker: a half-open one is a probe

@@ -27,6 +27,11 @@ FleetRouter and the truth about which of them may receive traffic:
   capped at ``digest.RETAIN_MAX_ENTRIES`` hashes so a misbehaving replica
   cannot balloon router memory. The balancer's saturation fallback reads it
   to route toward the replica advertising the longest cached prefix.
+- **Phase roles.** /healthz may also carry a ``role`` field (``prefill`` /
+  ``decode`` / ``any``, serve/digest.py ``parse_role``): the disaggregated
+  fleet's phase split. Parsed with the same tolerance as the digest —
+  unknown/absent coerces to ``any``, never a poll failure — so a mixed-
+  generation fleet routes exactly as before the field existed.
 - **Drain.** ``drain(replica_id)`` marks the replica draining locally —
   routing excludes it immediately, so the consistent-hash ring rebalances
   its arcs — and (best-effort) POSTs the replica's ``/admin/drain`` so it
@@ -46,7 +51,7 @@ import time
 from typing import Any, Callable, Iterable
 from urllib.parse import urlsplit
 
-from prime_tpu.serve.digest import parse_digest
+from prime_tpu.serve.digest import parse_digest, parse_role
 
 BREAKER_CLOSED = "closed"
 BREAKER_OPEN = "open"
@@ -99,6 +104,10 @@ class Replica:
         # hot-prefix advertisement (serve/digest.py) as last polled: empty
         # for replicas that predate the field or sent a malformed one
         self.digest: frozenset[int] = frozenset()
+        # phase role as last polled (disaggregated serving): "prefill" /
+        # "decode" / "any" — unknown/absent coerces to "any", the
+        # every-phase role every replica had before the field existed
+        self.role = "any"
         # breaker
         self.breaker = BREAKER_CLOSED
         self.consecutive_failures = 0
@@ -109,6 +118,7 @@ class Replica:
             "id": self.id,
             "url": self.url,
             "state": self.state,
+            "role": self.role,
             "breaker": self.breaker,
             "queue_depth": self.queue_depth,
             "active_slots": self.active_slots,
@@ -296,6 +306,11 @@ class FleetMembership:
             # absent/junk field -> empty digest (pre-digest replicas route
             # exactly as before); retention capped inside parse_digest
             replica.digest = parse_digest(body.get("prefix_digest"))
+            # phase role, same tolerance contract: unknown/absent/junk
+            # coerces to "any" (never a poll failure), and the value set is
+            # a closed vocabulary so a misbehaving replica cannot balloon
+            # router memory through it (parse_role mirrors parse_digest's cap)
+            replica.role = parse_role(body.get("role"))
 
     def poll_once(self, replica: Replica) -> None:
         """One health probe: snapshot /healthz onto the replica, feed the

@@ -21,6 +21,14 @@ tests and bench, arbitrary HTTP upstreams in production. Per request:
    excludes the replica and retries. Mid-stream failures are NOT retried —
    tokens already reached the client.
 
+When the fleet is phase-split (replicas advertising explicit ``prefill``
+and ``decode`` roles in /healthz), placement becomes a **migration**:
+admission routes to a prefill replica, its KV ships to a decode replica
+over the prefix-cache wire format (GET/PUT /admin/kv), and the untouched
+request resumes there with zero prefix recompute — docs/architecture.md
+"Disaggregated serving". Every pre-stream failure falls back to the
+colocated loop above.
+
 Observability: the router owns a metrics Registry (per-replica
 request/outcome counters, affinity hit counters + ratio gauge, reroute
 counters by reason, breaker-state gauges, queue-wait histogram) rendered at
@@ -47,6 +55,7 @@ from prime_tpu.obs.trace import (
     TraceContext,
     parse_traceparent,
 )
+from prime_tpu.serve.digest import CHARS_PER_TOKEN, MIN_BUCKET
 from prime_tpu.serve.errors import backpressure_response
 from prime_tpu.serve.fleet.balancer import PrefixAffinityBalancer
 from prime_tpu.serve.fleet.membership import BREAKER_GAUGE, FleetMembership
@@ -232,6 +241,26 @@ class FleetRouter:
         self._m_rejected = r.counter(
             "fleet_admission_rejected_total",
             "Chat requests answered 429 by the router's own admission gate",
+        )
+        # disaggregated serving (docs/architecture.md "Disaggregated
+        # serving"): phase-split migrations — prefill on a prefill-role
+        # replica, KV shipped over GET/PUT /admin/kv, decode resumed on a
+        # decode-role replica. "ok" = KV landed and the decode replica
+        # served; "cold" = it served but without the KV (export/import
+        # failed — correct, just a recompute); the *_failed outcomes fell
+        # back to colocated serving.
+        self._m_migrations = r.counter(
+            "fleet_migrations_total",
+            "Phase-split prefill→decode migrations, by outcome",
+            labelnames=("outcome",),
+        )
+        self._m_migrate_bytes = r.counter(
+            "fleet_migrate_bytes_total",
+            "KV wire-payload bytes shipped prefill→decode",
+        )
+        self._m_migrate_seconds = r.histogram(
+            "fleet_migrate_seconds",
+            "Prefill + KV export/import wall time per migrated request",
         )
         self._m_inflight = r.gauge(
             "fleet_inflight_requests", "Chat requests currently proxied upstream"
@@ -484,7 +513,7 @@ class FleetRouter:
         outcome = "error"
         try:
             with TRACER.span("fleet.route", context=trace):
-                outcome = self._route_chat(handler, raw, prompt, headers, trace)
+                outcome = self._route_chat(handler, raw, request, prompt, headers, trace)
         finally:
             self._gate.release()
             self._m_inflight.set(self._gate.inflight)
@@ -494,6 +523,7 @@ class FleetRouter:
         self,
         handler,
         raw: bytes,
+        request: dict,
         prompt: str | None,
         headers: dict[str, str],
         trace: TraceContext,
@@ -502,15 +532,26 @@ class FleetRouter:
         before a single response byte reached the client, so the request is
         replayable by construction. Returns the flight-recorder outcome.
 
-        Each forward attempt opens a ``fleet.attempt`` span (child of
-        ``fleet.route``) and the replica receives THAT span's traceparent —
-        so a failover request's replica spans hang under the attempt that
-        actually reached them. With tracing off, the inbound/generated trace
-        context is forwarded verbatim so the ids still agree fleet-wide."""
-        import httpx
-
+        When the fleet is phase-split (explicit prefill AND decode roles
+        among the routable replicas) and the request has migratable KV, the
+        disaggregated path runs first: prefill on a prefill replica, KV
+        migrated over the prefix-cache wire format, decode resumed on a
+        decode replica (``_migrate_chat``). Every migration failure mode
+        that leaves the client untouched falls back to this colocated loop."""
         fkey = _flight_key(trace)
         excluded: set[str] = set()
+        plan = self._disagg_plan(prompt)
+        if plan is not None:
+            outcome = self._migrate_chat(
+                handler, raw, request, prompt, headers, trace, *plan,
+                excluded=excluded,
+            )
+            if outcome is not None:
+                return outcome
+            # migration never streamed a byte: colocated serving takes over
+            # (a replica the migration saw die is already in ``excluded`` —
+            # the fallback must not re-pick it on the client's critical path
+            # while its breaker is still counting failures)
         upstream_429: tuple[int, dict, dict] | None = None
         first_attempt = True
         # one attempt per distinct replica, +1 for a half-open straggler that
@@ -544,88 +585,12 @@ class FleetRouter:
                         fkey, "reroute", reason=reason,
                         cached_blocks=pick.cached_blocks,
                     )
-            url = f"{replica.url}/v1/chat/completions"
-            self.flight.event(fkey, "attempt", replica=replica.id)
-            with TRACER.span("fleet.attempt", replica=replica.id) as attempt:
-                headers = dict(headers)
-                headers[TRACEPARENT_HEADER] = (
-                    attempt.traceparent() or trace.to_header()
-                )
-                try:
-                    with self._http().stream(
-                        "POST", url, content=raw, headers=headers
-                    ) as response:
-                        if response.status_code == 429:
-                            response.read()
-                            self.membership.note_success(replica.id)
-                            self._m_requests.inc(replica=replica.id, outcome="upstream_429")
-                            self._m_reroutes.inc(reason="upstream_429")
-                            attempt.set_attr("outcome", "upstream_429")
-                            self.flight.event(
-                                fkey, "reroute",
-                                reason="upstream_429", replica=replica.id,
-                            )
-                            upstream_429 = self._forwardable(response)
-                            excluded.add(replica.id)
-                            continue
-                        if response.status_code == 503:
-                            # loading or draining: the poller will learn the
-                            # state soon; this request goes elsewhere now
-                            response.read()
-                            self.membership.note_success(replica.id)
-                            self._m_requests.inc(replica=replica.id, outcome="upstream_503")
-                            self._m_reroutes.inc(reason="upstream_503")
-                            attempt.set_attr("outcome", "upstream_503")
-                            self.flight.event(
-                                fkey, "reroute",
-                                reason="upstream_503", replica=replica.id,
-                            )
-                            excluded.add(replica.id)
-                            continue
-                        self.membership.note_success(replica.id)
-                        attempt.set_attr("outcome", f"http_{response.status_code}")
-                        # the timeline remembers WHICH replica served it —
-                        # /debug/requests/{id} proxies that replica for its
-                        # engine-side view of the same trace id
-                        self.flight.annotate(fkey, replica=replica.id)
-                        self.flight.event(
-                            fkey, "forwarded",
-                            replica=replica.id, status=response.status_code,
-                        )
-                        self._forward_response(handler, replica, response)
-                        return (
-                            "ok"
-                            if response.status_code < 400
-                            else f"http_{response.status_code}"
-                        )
-                except (httpx.ConnectError, httpx.ConnectTimeout, httpx.RemoteProtocolError):
-                    # connect refused/timed out, or the replica dropped the
-                    # connection before a response (a dying server closing its
-                    # pooled keep-alives looks like this): either way not one
-                    # response byte reached the client, so the request is
-                    # safely replayable elsewhere — and the breaker learns
-                    # about the dead replica. Mid-SSE failures never take
-                    # this path (they are contained in _forward_response
-                    # after bytes flowed).
-                    self.membership.note_failure(replica.id)
-                    self._m_requests.inc(replica=replica.id, outcome="connect_error")
-                    self._m_reroutes.inc(reason="connect_error")
-                    attempt.set_attr("outcome", "connect_error")
-                    self.flight.event(
-                        fkey, "reroute",
-                        reason="connect_error", replica=replica.id,
-                    )
-                    excluded.add(replica.id)
-                    continue
-                except httpx.HTTPError as e:
-                    # transport died mid-request (headers or body partially
-                    # exchanged): NOT replayable — surface a 502
-                    self._m_requests.inc(replica=replica.id, outcome="transport_error")
-                    attempt.set_attr("outcome", "transport_error")
-                    handler._json(
-                        502, {"error": {"message": f"upstream {replica.id} failed: {e}"}}
-                    )
-                    return "transport_error"
+            kind, value = self._forward_once(handler, replica, raw, headers, trace, fkey)
+            if kind == "done":
+                return value
+            if kind == "upstream_429":
+                upstream_429 = value
+            excluded.add(replica.id)
         if upstream_429 is not None:
             # every replica is shedding load: propagate the 429 (+Retry-After)
             status, payload, headers = upstream_429
@@ -633,6 +598,329 @@ class FleetRouter:
             return "upstream_429"
         handler._json(503, {"error": {"message": "no routable replica in the fleet"}})
         return "no_replica"
+
+    def _forward_once(
+        self,
+        handler,
+        replica,
+        raw: bytes,
+        headers: dict[str, str],
+        trace: TraceContext,
+        fkey: str,
+    ) -> tuple[str, Any]:
+        """One forward attempt against a SPECIFIC replica — the one owner of
+        the proxy/outcome/breaker semantics, shared by the colocated retry
+        loop and the migration path's decode leg. Returns ``(kind, value)``:
+
+        - ``("done", outcome)`` — a response (or a fatal 502) reached the
+          client; ``outcome`` is the flight-recorder string.
+        - ``("upstream_429", forwardable)`` / ``("upstream_503", None)`` /
+          ``("connect_error", None)`` — not one byte reached the client; the
+          caller may retry elsewhere (the replica is already excluded from
+          breaker/metrics bookkeeping here).
+
+        Each attempt opens a ``fleet.attempt`` span (child of the ambient
+        ``fleet.route``/``fleet.migrate``) and the replica receives THAT
+        span's traceparent — so a failover request's replica spans hang
+        under the attempt that actually reached them. With tracing off, the
+        inbound/generated trace context is forwarded verbatim so the ids
+        still agree fleet-wide."""
+        import httpx
+
+        url = f"{replica.url}/v1/chat/completions"
+        self.flight.event(fkey, "attempt", replica=replica.id)
+        with TRACER.span("fleet.attempt", replica=replica.id) as attempt:
+            headers = dict(headers)
+            headers[TRACEPARENT_HEADER] = (
+                attempt.traceparent() or trace.to_header()
+            )
+            try:
+                with self._http().stream(
+                    "POST", url, content=raw, headers=headers
+                ) as response:
+                    if response.status_code == 429:
+                        response.read()
+                        self.membership.note_success(replica.id)
+                        self._m_requests.inc(replica=replica.id, outcome="upstream_429")
+                        self._m_reroutes.inc(reason="upstream_429")
+                        attempt.set_attr("outcome", "upstream_429")
+                        self.flight.event(
+                            fkey, "reroute",
+                            reason="upstream_429", replica=replica.id,
+                        )
+                        return "upstream_429", self._forwardable(response)
+                    if response.status_code == 503:
+                        # loading or draining: the poller will learn the
+                        # state soon; this request goes elsewhere now
+                        response.read()
+                        self.membership.note_success(replica.id)
+                        self._m_requests.inc(replica=replica.id, outcome="upstream_503")
+                        self._m_reroutes.inc(reason="upstream_503")
+                        attempt.set_attr("outcome", "upstream_503")
+                        self.flight.event(
+                            fkey, "reroute",
+                            reason="upstream_503", replica=replica.id,
+                        )
+                        return "upstream_503", None
+                    self.membership.note_success(replica.id)
+                    attempt.set_attr("outcome", f"http_{response.status_code}")
+                    # the timeline remembers WHICH replica served it —
+                    # /debug/requests/{id} proxies that replica for its
+                    # engine-side view of the same trace id
+                    self.flight.annotate(fkey, replica=replica.id)
+                    self.flight.event(
+                        fkey, "forwarded",
+                        replica=replica.id, status=response.status_code,
+                    )
+                    self._forward_response(handler, replica, response)
+                    return "done", (
+                        "ok"
+                        if response.status_code < 400
+                        else f"http_{response.status_code}"
+                    )
+            except (httpx.ConnectError, httpx.ConnectTimeout, httpx.RemoteProtocolError):
+                # connect refused/timed out, or the replica dropped the
+                # connection before a response (a dying server closing its
+                # pooled keep-alives looks like this): either way not one
+                # response byte reached the client, so the request is
+                # safely replayable elsewhere — and the breaker learns
+                # about the dead replica. Mid-SSE failures never take
+                # this path (they are contained in _forward_response
+                # after bytes flowed).
+                self.membership.note_failure(replica.id)
+                self._m_requests.inc(replica=replica.id, outcome="connect_error")
+                self._m_reroutes.inc(reason="connect_error")
+                attempt.set_attr("outcome", "connect_error")
+                self.flight.event(
+                    fkey, "reroute",
+                    reason="connect_error", replica=replica.id,
+                )
+                return "connect_error", None
+            except httpx.HTTPError as e:
+                # transport died mid-request (headers or body partially
+                # exchanged): NOT replayable — surface a 502
+                self._m_requests.inc(replica=replica.id, outcome="transport_error")
+                attempt.set_attr("outcome", "transport_error")
+                handler._json(
+                    502, {"error": {"message": f"upstream {replica.id} failed: {e}"}}
+                )
+                return "done", "transport_error"
+
+    # ---- disaggregated prefill/decode ------------------------------------
+
+    def _disagg_plan(self, prompt: str | None):
+        """(prefill replica, decode replica) when the fleet is phase-split
+        and this request has migratable KV; None keeps the colocated path.
+
+        The split triggers only on EXPLICIT roles: a fleet of ``any``
+        replicas (every deployment before --role existed) never migrates.
+        Prompts under one affinity block (MIN_BUCKET tokens in the text
+        proxy) have no cacheable prefix worth shipping — their prefill is
+        too cheap to phase-split. Both legs route through the balancer, so
+        shared-prefix traffic concentrates: the SAME preamble lands on the
+        same prefill replica (whose radix cache then serves it with an
+        assemble instead of a recompute) and migrates to the same decode
+        replica (whose import dedups to zero new bytes)."""
+        if prompt is None or len(prompt) < MIN_BUCKET * CHARS_PER_TOKEN:
+            return None
+        routable = self.membership.routable_replicas()
+        if not any(r.role == "prefill" for r in routable) or not any(
+            r.role == "decode" for r in routable
+        ):
+            return None
+        prefill = self.balancer.pick(prompt, role="prefill")
+        if prefill is None:
+            return None
+        decode = self.balancer.pick(prompt, {prefill.replica.id}, role="decode")
+        if decode is None:
+            # no decode replica healthy beyond the prefill target:
+            # colocated serving is the failover
+            return None
+        return prefill.replica, decode.replica
+
+    def _migrate_chat(
+        self,
+        handler,
+        raw: bytes,
+        request: dict,
+        prompt: str,
+        headers: dict[str, str],
+        trace: TraceContext,
+        prefill,
+        decode,
+        excluded: set[str] | None = None,
+    ) -> str | None:
+        """The migration state machine: prefill → export → import → resume.
+
+        1. The ORIGINAL request, clamped to ``max_tokens=1``, runs on the
+           prefill replica — its engine stores the prompt's KV into the
+           radix cache at admission, so the one sampled token is the
+           cheapest legal completion that guarantees the store landed.
+        2. ``GET /admin/kv?prompt=…`` on the prefill replica serializes the
+           cached prefix over the versioned wire format; ``PUT /admin/kv``
+           plants it on the decode replica.
+        3. The untouched original request forwards to the decode replica,
+           whose admission prefix-matches the imported segments —
+           ``assemble_row`` seeds the slot and only the unaligned tail
+           re-prefills, so greedy outputs are bit-identical to colocated
+           serving (the decode replica recomputes the final logits itself).
+
+        Returns the flight outcome once ANY byte reached the client, or
+        None for every failure mode that leaves the client untouched — the
+        caller then falls back to the colocated loop. A failed export or
+        import degrades to step 3 without KV (``outcome="cold"``): correct,
+        just a recompute, and cheaper than abandoning the routing decision."""
+        import httpx
+
+        fkey = _flight_key(trace)
+        t0 = time.monotonic()
+        admin_headers = (
+            {"Authorization": f"Bearer {self.admin_token}"}
+            if self.admin_token
+            else {}
+        )
+        with TRACER.span(
+            "fleet.migrate", context=trace, prefill=prefill.id, decode=decode.id
+        ) as span:
+            body = dict(request)
+            body["max_tokens"] = 1
+            body.pop("stream", None)
+            prefill_headers = dict(headers)
+            prefill_headers.pop("Content-Type", None)
+            prefill_headers[TRACEPARENT_HEADER] = (
+                span.traceparent() or trace.to_header()
+            )
+            try:
+                response = self._http().post(
+                    f"{prefill.url}/v1/chat/completions",
+                    json=body,
+                    headers=prefill_headers,
+                )
+            except (httpx.ConnectError, httpx.ConnectTimeout, httpx.RemoteProtocolError):
+                # connect-class death: same breaker semantics as
+                # _forward_once — the replica is provably unreachable
+                self.membership.note_failure(prefill.id)
+                if excluded is not None:
+                    excluded.add(prefill.id)
+                self._m_requests.inc(replica=prefill.id, outcome="connect_error")
+                self._m_migrations.inc(outcome="prefill_failed")
+                span.set_attr("outcome", "prefill_failed")
+                return None
+            except httpx.HTTPError:
+                # read timeout / mid-body death on a slow-but-alive replica:
+                # NOT a breaker failure (mirrors _forward_once's
+                # transport_error class — a loaded prefill replica must not
+                # get its breaker opened by its own queue depth)
+                self._m_requests.inc(replica=prefill.id, outcome="transport_error")
+                self._m_migrations.inc(outcome="prefill_failed")
+                span.set_attr("outcome", "prefill_failed")
+                return None
+            self.membership.note_success(prefill.id)
+            if response.status_code != 200:
+                # saturated/draining prefill replica: not an error worth a
+                # breaker trip (it answered), but no KV landed — colocated.
+                # 429/503 keep the upstream_* label vocabulary the rest of
+                # the router (and the docs catalog) uses for shed load
+                outcome_label = (
+                    f"upstream_{response.status_code}"
+                    if response.status_code in (429, 503)
+                    else f"http_{response.status_code}"
+                )
+                self._m_requests.inc(replica=prefill.id, outcome=outcome_label)
+                self._m_migrations.inc(outcome="prefill_failed")
+                span.set_attr("outcome", "prefill_failed")
+                return None
+            # per-replica visibility: the prefill leg bypasses _forward_once
+            # (its response is consumed, not proxied), so it must count its
+            # own fleet_requests_total series — a phase-split fleet's prefill
+            # replica otherwise reads as idle in every per-replica split
+            self._m_requests.inc(replica=prefill.id, outcome="migrate_prefill")
+            self.flight.event(fkey, "migrate_prefill", replica=prefill.id)
+            payload = None
+            try:
+                # messages ride the GET body (not a query string): the
+                # replica tokenizes them EXACTLY like its own admission did
+                # — template, special tokens, tail-keep — so the export
+                # matches the stored path on any tokenizer, and a
+                # long-context prompt never hits the request-line cap.
+                # max_tokens is the CLIENT's (server default when absent),
+                # not the prefill leg's clamped 1: the decode replica's
+                # admission trims its slot to the client budget, and a
+                # near-capacity prompt whose trimmed suffix no longer
+                # prefixes the stored path must export 204 (honest "cold")
+                # instead of shipping megabytes the resume can never match
+                raw_max = request.get("max_tokens")
+                kv = self._http().request(
+                    "GET",
+                    f"{prefill.url}/admin/kv",
+                    json={
+                        "messages": request.get("messages"),
+                        "max_tokens": raw_max if isinstance(raw_max, int) else 128,
+                    },
+                    headers=admin_headers,
+                )
+                export_status: Any = kv.status_code
+                if kv.status_code == 200 and kv.content:
+                    payload = kv.content
+            except httpx.HTTPError as e:
+                export_status = type(e).__name__
+            # the status rides the span/flight evidence so a 403 (admin-token
+            # mismatch: the fleet migrates cold FOREVER) or a 501/500 is
+            # distinguishable from a legitimate 204 cache miss
+            span.set_attr("export_status", export_status)
+            imported = False
+            if payload is not None:
+                try:
+                    put = self._http().put(
+                        f"{decode.url}/admin/kv",
+                        content=payload,
+                        headers={
+                            **admin_headers,
+                            "Content-Type": "application/octet-stream",
+                        },
+                    )
+                    imported = put.status_code == 200
+                except httpx.HTTPError:
+                    imported = False
+            migrate_s = time.monotonic() - t0
+            self._m_migrate_seconds.observe(migrate_s)
+            shipped = len(payload) if (imported and payload) else 0
+            if shipped:
+                self._m_migrate_bytes.inc(shipped)
+            span.set_attr("bytes", shipped)
+            self.flight.event(
+                fkey, "migrate_kv",
+                prefill=prefill.id, decode=decode.id,
+                bytes=shipped, imported=imported,
+                export_status=export_status,
+                ms=round(migrate_s * 1e3, 3),
+            )
+            kind, value = self._forward_once(
+                handler, decode, raw, headers, trace, fkey
+            )
+            if kind == "done":
+                # "ok"/"cold" only when the client got a real completion: a
+                # transport death or an upstream error status answered the
+                # client too (no fallback possible), but counting it as a
+                # successful migration would mask decode-replica failures
+                # behind a healthy-looking counter
+                if value == "ok":
+                    outcome = "ok" if imported else "cold"
+                else:
+                    outcome = "decode_error"
+                self._m_migrations.inc(outcome=outcome)
+                span.set_attr("outcome", outcome)
+                return value
+            # the decode replica refused/vanished before a byte reached the
+            # client: colocated fallback (its KV import stays — a later
+            # retry or affinity hit can still use it). The failed replica
+            # joins the caller's exclusion set so the fallback's first pick
+            # cannot be the replica that just refused.
+            if excluded is not None:
+                excluded.add(decode.id)
+            self._m_migrations.inc(outcome="decode_failed")
+            span.set_attr("outcome", "decode_failed")
+            return None
 
     @staticmethod
     def _forwardable(response) -> tuple[int, dict, dict]:
@@ -761,11 +1049,17 @@ class FleetRouter:
             series["labels"]["reason"]: int(series["value"])
             for series in snapshot["fleet_reroutes_total"]["series"]
         }
+        migrations = {
+            series["labels"]["outcome"]: int(series["value"])
+            for series in snapshot["fleet_migrations_total"]["series"]
+        }
         return {
             "affinity_requests": int(values["fleet_affinity_requests_total"]),
             "affinity_hits": int(values["fleet_affinity_hits_total"]),
             "affinity_hit_ratio": round(values["fleet_affinity_hit_ratio"], 4),
             "cache_routed": int(values["fleet_cache_routed_total"]),
+            "migrations": migrations,
+            "migrate_bytes": int(values["fleet_migrate_bytes_total"]),
             "admission_rejected": int(values["fleet_admission_rejected_total"]),
             "inflight": self._gate.inflight,
             "requests_by_replica": per_replica,

@@ -15,6 +15,9 @@ Spec grammar — comma-separated axis entries, each ``name`` or ``name=N``:
 - ``dp,fsdp,tp``        — unsized axes default to 1 except the LAST unsized
   one, which absorbs every remaining device (8 devices → dp=1, fsdp=1, tp=8).
 - ``tp=4``              — a pure tensor-parallel replica on 4 chips.
+- ``role:prefill`` / ``role:decode`` — the disaggregated fleet's role-preset
+  layouts (ROLE_MESH_PRESETS): tp-heavy for prefill replicas, dp-heavy for
+  decode replicas — one flag per role next to ``prime serve --role``.
 
 Axis names are the serving-layout vocabulary of ``parallel/sharding.py``
 (``dp``/``fsdp`` data axes, ``tp`` megatron tensor parallel, ``sp`` the
@@ -28,6 +31,21 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 AXIS_NAMES = ("dp", "fsdp", "tp", "sp")
+
+# Role-preset layouts for the disaggregated fleet (``--mesh role:prefill``,
+# docs/architecture.md "Disaggregated serving"): the per-topology serving
+# tables in PAPERS "Fine-Tuning and Serving Gemma on Cloud TPU" show
+# prefill-heavy and decode-heavy meshes wanting different shapes, and the
+# spec grammar's absorbing axis makes each a one-flag choice per role —
+# prefill is FLOPs-bound (long-prompt forwards), so the whole slice goes to
+# megatron tensor parallel (tp cuts per-prompt latency and keeps the MXU
+# fed); decode is capacity/batch-bound (many concurrent slots streaming the
+# weights), so the slice becomes a dp data axis (slots shard across it,
+# weights replicate — maximum concurrent decode batch per replica).
+ROLE_MESH_PRESETS: dict[str, str] = {
+    "prefill": "fsdp=1,tp",
+    "decode": "dp,tp=1",
+}
 
 
 @dataclass(frozen=True)
@@ -95,10 +113,26 @@ def parse_mesh_spec(spec: str, device_count: int) -> ServeMeshConfig | None:
     :class:`ServeMeshConfig`. Empty/blank specs mean "no mesh" (None).
     Unsized axes default to 1, except the last unsized axis which absorbs
     every device left after the sized ones — so ``dp,fsdp,tp`` spans the
-    whole host and ``fsdp=2,tp`` gives tp the other factor."""
+    whole host and ``fsdp=2,tp`` gives tp the other factor.
+
+    ``role:prefill`` / ``role:decode`` resolve to the matching
+    ROLE_MESH_PRESETS entry (the phase-split fleet's one-flag layout
+    choice); ``role:any`` means "no preset" (single-chip, like an empty
+    spec). Unknown role specs fail fast."""
     spec = (spec or "").strip()
     if not spec:
         return None
+    if spec.startswith("role:"):
+        role = spec[len("role:"):].strip()
+        if role == "any":
+            return None
+        preset = ROLE_MESH_PRESETS.get(role)
+        if preset is None:
+            raise ValueError(
+                f"unknown role preset {spec!r}; one of "
+                + ", ".join(f"role:{r}" for r in (*ROLE_MESH_PRESETS, "any"))
+            )
+        spec = preset
     names: list[str] = []
     sizes: list[int | None] = []
     for entry in spec.split(","):

@@ -54,15 +54,31 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import json
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator
 
-__all__ = ["BlockPrefixCache", "PrefixMatch", "segment_nbytes"]
+__all__ = [
+    "BlockPrefixCache",
+    "KV_WIRE_VERSION",
+    "PrefixMatch",
+    "decode_wire_payload",
+    "segment_nbytes",
+]
 
 TIER_DEVICE = "device"
 TIER_HOST = "host"
+
+# Versioned KV wire format (docs/architecture.md "Disaggregated serving"):
+# the host-tier segment layout promoted to an explicit cross-process
+# contract. A payload is one JSON header line (version, block size, token
+# path, per-segment leaf manifests) followed by the raw leaf bytes in
+# manifest order. import_segments REJECTS any version it does not speak —
+# a fleet mid-rollout must fail a migration cleanly (the router falls back
+# to colocated serving) rather than deserialize garbage KV.
+KV_WIRE_VERSION = 1
 
 
 def segment_nbytes(segment: Any) -> int:
@@ -74,6 +90,101 @@ def segment_nbytes(segment: Any) -> int:
     return int(
         sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(segment))
     )
+
+
+def decode_wire_payload(payload: bytes, block: int) -> tuple[list[int], dict]:
+    """Validate a KV wire payload and rebuild its leaves host-side:
+    ``(token path, {leaf name: full-length array})`` with every leaf's last
+    axis concatenated across segments. Raises ValueError — before any cache
+    is touched — on a version/block/shape/byte-count mismatch. Pure
+    function of the payload: safe on any thread (the engine runs it on the
+    HTTP handler thread so only the radix insert reaches its loop)."""
+    import numpy as np
+
+    header_raw, sep, raw = payload.partition(b"\n")
+    if not sep:
+        raise ValueError("KV wire payload has no header line")
+    try:
+        header = json.loads(header_raw)
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ValueError(f"KV wire header is not JSON: {e}") from None
+    if not isinstance(header, dict):
+        raise ValueError("KV wire header must be an object")
+    version = header.get("version")
+    if version != KV_WIRE_VERSION:
+        raise ValueError(
+            f"KV wire version {version!r} not supported (speak {KV_WIRE_VERSION})"
+        )
+    if header.get("block") != block:
+        raise ValueError(
+            f"KV wire block {header.get('block')!r} != cache block {block}"
+        )
+    tokens = header.get("tokens")
+    if (
+        not isinstance(tokens, list)
+        or not tokens
+        or not all(isinstance(t, int) and not isinstance(t, bool) for t in tokens)
+    ):
+        raise ValueError("KV wire tokens must be a non-empty int list")
+    if len(tokens) % block:
+        raise ValueError(
+            f"KV wire token path ({len(tokens)}) not aligned to block {block}"
+        )
+    manifests = header.get("segments")
+    if not isinstance(manifests, list) or not manifests:
+        raise ValueError("KV wire payload has no segment manifests")
+    # rebuild the per-segment leaf arrays from the raw byte stream
+    names: list[str] | None = None
+    parts: dict[str, list] = {}
+    takes: list[int] = []
+    offset = 0
+    for manifest in manifests:
+        if not isinstance(manifest, dict):
+            raise ValueError("KV wire segment manifest must be an object")
+        take = manifest.get("take")
+        leaves = manifest.get("leaves")
+        if not isinstance(take, int) or take <= 0 or not isinstance(leaves, list):
+            raise ValueError("KV wire segment manifest missing take/leaves")
+        takes.append(take)
+        seg_names = []
+        for leaf in leaves:
+            try:
+                name = leaf["name"]
+                dtype = np.dtype(leaf["dtype"])
+                shape = tuple(int(d) for d in leaf["shape"])
+                nbytes = int(leaf["nbytes"])
+            except (KeyError, TypeError, ValueError) as e:
+                raise ValueError(f"KV wire leaf manifest malformed: {e}") from None
+            if not shape or shape[-1] != take:
+                raise ValueError(
+                    f"KV wire leaf {name!r} shape {shape} does not end in "
+                    f"the segment take {take}"
+                )
+            count = 1
+            for d in shape:
+                count *= d
+            if count * dtype.itemsize != nbytes or offset + nbytes > len(raw):
+                raise ValueError("KV wire payload truncated or miscounted")
+            arr = np.frombuffer(raw, dtype=dtype, count=count, offset=offset)
+            offset += nbytes
+            parts.setdefault(name, []).append(arr.reshape(shape))
+            seg_names.append(name)
+        if names is None:
+            names = seg_names
+        elif names != seg_names:
+            raise ValueError("KV wire segments disagree on leaf names")
+    if offset != len(raw):
+        raise ValueError("KV wire payload has trailing bytes")
+    if sum(takes) != len(tokens):
+        raise ValueError(
+            f"KV wire takes sum to {sum(takes)} but the token path has "
+            f"{len(tokens)}"
+        )
+    full = {
+        name: np.concatenate(arrays, axis=-1) if len(arrays) > 1 else arrays[0]
+        for name, arrays in parts.items()
+    }
+    return list(tokens), full
 
 
 def _common_len(a, b) -> int:
@@ -380,6 +491,100 @@ class BlockPrefixCache:
                 if emitted >= limit:
                     return
                 queue.append((child, path))
+
+    # ---- KV wire format (export/import) ----
+
+    def export_segments(self, ids, limit: int | None = None) -> bytes | None:
+        """Serialize the longest cached prefix of ``ids`` into the versioned
+        wire payload (KV_WIRE_VERSION): one JSON header line — block size,
+        the matched token path, a per-segment manifest of (name, dtype,
+        shape, nbytes) — then the raw leaf bytes in manifest order.
+
+        The match path is REFCOUNT-PINNED for the whole serialization, so a
+        concurrent store's eviction/demotion can never free or split a
+        segment mid-read; the pin is released before returning. Export is
+        tier-aware: host-resident segments serialize straight from their RAM
+        buffers (no device round-trip), device segments pay one device_get
+        (``np.asarray``) — both produce identical bytes, since spill
+        converters round-trip shapes/dtypes exactly. int8 KV scales are
+        ordinary named leaves and ride along. Returns None when no full
+        block of ``ids`` is cached. Segments must be dict-of-array pytrees
+        (the engine's layout) or bare arrays."""
+        import numpy as np
+
+        limit = len(ids) if limit is None else limit
+        match = self.match(ids, limit=limit)
+        if match is None:
+            return None
+        try:
+            tokens: list[int] = []
+            manifests: list[dict] = []
+            blobs: list[bytes] = []
+            for node, take in match.entries:
+                tokens.extend(int(t) for t in node.tokens[:take])
+                segment = node.segment
+                items = (
+                    sorted(segment.items())
+                    if isinstance(segment, dict)
+                    else [("", segment)]
+                )
+                leaves = []
+                for name, leaf in items:
+                    arr = np.ascontiguousarray(np.asarray(leaf)[..., :take])
+                    leaves.append(
+                        {
+                            "name": name,
+                            "dtype": str(arr.dtype),
+                            "shape": list(arr.shape),
+                            "nbytes": int(arr.nbytes),
+                        }
+                    )
+                    blobs.append(arr.tobytes())
+                manifests.append({"take": int(take), "leaves": leaves})
+            header = {
+                "version": KV_WIRE_VERSION,
+                "block": self.block,
+                "tokens": tokens,
+                "segments": manifests,
+            }
+            return (
+                json.dumps(header, separators=(",", ":")).encode()
+                + b"\n"
+                + b"".join(blobs)
+            )
+        finally:
+            self.release(match)
+
+    def import_segments(self, payload: bytes) -> int:
+        """Insert a wire payload (``export_segments`` output, possibly from
+        another process/host) along the radix path. Validates version, block
+        size, token path, and byte counts BEFORE touching the tree — a
+        mismatched or truncated payload raises ValueError and leaves the
+        cache untouched. Leaves are rebuilt host-side and fed through
+        ``to_device`` only for the genuinely new tail (shared blocks dedup
+        exactly like a local insert). Returns the bytes added.
+
+        Engine note: the decode/validate half (``decode_wire_payload``) and
+        the upload are thread-free — the engine calls them on the HTTP
+        handler thread and marshals only ``insert_segments`` onto its loop,
+        so a multi-MB migration never stalls the decode pipeline behind a
+        payload parse."""
+        tokens, leaves = decode_wire_payload(payload, self.block)
+        return self.insert_segments(tokens, leaves)
+
+    def insert_segments(self, tokens, leaves) -> int:
+        """Insert pre-decoded wire leaves (name → full-length array, last
+        axis = the token path) along the radix path. Each new-tail slice
+        passes through ``to_device`` — a no-op for already-device arrays, an
+        upload for host arrays — so only genuinely new bytes ever move."""
+
+        def slicer(start: int, stop: int):
+            seg = {name: leaf[..., start:stop] for name, leaf in leaves.items()}
+            if "" in seg and len(seg) == 1:
+                return self._to_device(seg[""])
+            return self._to_device(seg)
+
+        return self.insert(list(tokens), slicer)
 
     # ---- eviction / demotion ----
 

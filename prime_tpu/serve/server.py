@@ -42,10 +42,17 @@ from prime_tpu.obs.trace import (
     TraceContext,
     parse_traceparent,
 )
-from prime_tpu.serve.digest import HotPrefixDigest
+from prime_tpu.serve.digest import REPLICA_ROLES, HotPrefixDigest
 from prime_tpu.serve.errors import DrainingError, QueueFullError, backpressure_response
 
 CHAT_TEMPLATE = "{role}: {content}\n"
+
+# PUT /admin/kv body bound: a real migration payload is the KV of one
+# prompt (hundreds of MB at 8B-model/long-context scale), but an unbounded
+# Content-Length would let one request allocate arbitrary memory before
+# validation runs — same cannot-balloon-memory contract as the digest
+# retention cap (serve/digest.py RETAIN_MAX_ENTRIES)
+MAX_KV_PAYLOAD_BYTES = 1 << 30
 
 
 @functools.lru_cache(maxsize=64)
@@ -109,19 +116,39 @@ class InferenceServer:
         host: str = "127.0.0.1",
         port: int = 0,
         admin_token: str | None = None,
+        role: str | None = None,
     ) -> None:
         """``generator=None`` binds the socket immediately and answers 503
         until one is assigned — serve_model uses this so a port conflict fails
         in milliseconds, not after minutes of checkpoint loading.
         ``admin_token`` (None = PRIME_FLEET_ADMIN_TOKEN env, "" = open) gates
         POST /admin/drain with `Authorization: Bearer <token>` — drain is
-        irreversible, so beyond loopback it must not be one anonymous packet."""
+        irreversible, so beyond loopback it must not be one anonymous packet.
+        ``role`` (None = PRIME_SERVE_ROLE env, default "any") is the
+        replica's phase role — ``prefill`` / ``decode`` / ``any`` — advertised
+        in /healthz so a fleet router can phase-split admission and migrate
+        requests over GET/PUT /admin/kv (docs/architecture.md "Disaggregated
+        serving")."""
         self.model_id = model_id
         self._draining = False  # set by drain(): finish in-flight, refuse new
         self.generator = generator
         if admin_token is None:
             admin_token = env_str("PRIME_FLEET_ADMIN_TOKEN", "")
         self.admin_token = admin_token or None
+        if role is None:
+            role = env_str("PRIME_SERVE_ROLE", "any")
+            if role not in REPLICA_ROLES:
+                # env junk degrades to the every-phase role, loudly — the
+                # constructor arg stays strict (a typo in code is a bug)
+                warnings.warn(
+                    f"PRIME_SERVE_ROLE={role!r} is not one of {REPLICA_ROLES}; "
+                    "serving as 'any'",
+                    stacklevel=2,
+                )
+                role = "any"
+        elif role not in REPLICA_ROLES:
+            raise ValueError(f"role must be one of {REPLICA_ROLES}, got {role!r}")
+        self.role = role
         # chat requests currently generating/streaming in THIS server: the
         # drain-complete signal for backends without their own `drained`
         # (the one-shot generator path has no engine to ask)
@@ -257,10 +284,73 @@ class InferenceServer:
                         self._json(
                             200, outer.flight_recorder().summaries(limit=limit)
                         )
+                elif path == "/admin/kv":
+                    # prefix-KV wire export (disaggregated serving): admin-
+                    # token parity with /admin/drain — a payload is raw KV
+                    # bytes of served prompts, not less sensitive than drain.
+                    # A JSON body (the router's migration path sends the
+                    # chat messages) rides the GET so arbitrarily long
+                    # prompts never hit the request-line length cap.
+                    if not outer._admin_authorized(self.headers):
+                        self._json(403, {"error": {"message": "admin token required"}})
+                        return
+                    try:
+                        # clamp: read(-1) would block until the client
+                        # closes, wedging the handler thread
+                        length = max(0, int(self.headers.get("Content-Length", 0)))
+                    except ValueError:
+                        length = 0
+                    raw = self.rfile.read(length) if length else b""
+                    status, body = outer.kv_export(parse_qs(parts.query), raw)
+                    if isinstance(body, bytes):
+                        self._status_sent = status
+                        self.send_response(status)
+                        self.send_header("Content-Type", "application/octet-stream")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    elif body is None:  # 204: no cached prefix to ship
+                        self._status_sent = status
+                        self.send_response(status)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                    else:
+                        self._json(status, body)
                 elif path.rstrip("/").endswith(f"/models/{outer.model_id}"):
                     self._json(200, {"id": outer.model_id, "object": "model"})
                 else:
                     self._json(404, {"error": {"message": f"no route {self.path}"}})
+
+            def do_PUT(self) -> None:
+                t0 = time.monotonic()
+                try:
+                    self._put()
+                finally:
+                    self._observe(t0)
+
+            def _put(self) -> None:
+                # prefix-KV wire import: the decode half of a migration
+                if urlsplit(self.path).path != "/admin/kv":
+                    self._json(404, {"error": {"message": f"no route {self.path}"}})
+                    return
+                if not outer._admin_authorized(self.headers):
+                    self._json(403, {"error": {"message": "admin token required"}})
+                    return
+                try:
+                    # clamp negatives: read(-1) blocks until the peer
+                    # closes, wedging the handler thread
+                    length = max(0, int(self.headers.get("Content-Length", 0)))
+                except ValueError:
+                    self._json(400, {"error": {"message": "bad Content-Length"}})
+                    return
+                if length > MAX_KV_PAYLOAD_BYTES:
+                    self._json(
+                        413,
+                        {"error": {"message": f"KV payload over {MAX_KV_PAYLOAD_BYTES} bytes"}},
+                    )
+                    return
+                payload = self.rfile.read(length) if length else b""
+                self._json(*outer.kv_import(payload))
 
             def do_POST(self) -> None:
                 t0 = time.monotonic()
@@ -486,6 +576,10 @@ class InferenceServer:
         payload = {
             "status": "ok",
             "state": state,
+            # phase role for the fleet router's disaggregated admission
+            # (ADDITIVE: routers that predate the field ignore it; newer
+            # routers parse it tolerantly — membership.apply_health)
+            "role": self.role,
             "loaded": self.generator is not None,
             "queue_depth": 0,
             "active_slots": 0,
@@ -556,6 +650,94 @@ class InferenceServer:
         drain_fn = getattr(self.generator, "drain", None)
         if callable(drain_fn):
             drain_fn()
+
+    def kv_export(self, query: dict[str, list[str]], raw: bytes = b"") -> tuple[int, Any]:
+        """GET /admin/kv: serialize the cached KV of a prompt's prefix over
+        the versioned wire format. Three request forms:
+
+        - a JSON body ``{"messages": […], "max_tokens": N}`` (what the
+          fleet router's migration path sends): the backend tokenizes the
+          chat EXACTLY like an admission — template, special tokens, and
+          tail-keep included — so the export matches the stored path for
+          ANY tokenizer, and the prompt length never hits the GET
+          request-line cap;
+        - ``?ids=1,2,3`` — exact id-space export;
+        - ``?prompt=<text>`` — the untemplated-path tokenization of raw
+          text (operator convenience; on a templated backend this cannot
+          match what admissions stored).
+
+        Returns (status, bytes payload) on a hit, (204, None) when nothing
+        usable is cached, (status, error dict) otherwise."""
+        if self.generator is None:
+            return 503, {"error": {"message": "model is still loading"}}
+        ids_raw = query.get("ids", [None])[0]
+        prompt = query.get("prompt", [None])[0]
+        messages = None
+        max_new = 1
+        if raw and not ids_raw and not prompt:
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError:
+                return 400, {"error": {"message": "invalid JSON body"}}
+            if isinstance(body, dict):
+                messages = body.get("messages")
+                if isinstance(body.get("max_tokens"), int):
+                    max_new = body["max_tokens"]
+            if not isinstance(messages, list) or not all(
+                isinstance(m, dict) for m in messages
+            ):
+                return 400, {"error": {"message": "body messages must be a list of objects"}}
+        try:
+            if messages is not None:
+                export_messages = getattr(self.generator, "export_kv_messages", None)
+                if not callable(export_messages):
+                    return 501, {"error": {"message": "backend has no KV export"}}
+                payload = export_messages(messages, max_new_tokens=max_new)
+            elif ids_raw:
+                export_ids = getattr(self.generator, "export_kv_ids", None)
+                if not callable(export_ids):
+                    return 501, {"error": {"message": "backend has no KV export"}}
+                try:
+                    ids = [int(t) for t in ids_raw.split(",") if t.strip()]
+                except ValueError:
+                    return 400, {"error": {"message": "ids must be comma-separated ints"}}
+                payload = export_ids(ids)
+            elif prompt:
+                export_text = getattr(self.generator, "export_kv_text", None)
+                if not callable(export_text):
+                    return 501, {"error": {"message": "backend has no KV export"}}
+                payload = export_text(prompt)
+            else:
+                return 400, {"error": {"message": "pass ?ids=… or ?prompt=…"}}
+        except TimeoutError as e:
+            return 503, {"error": {"message": str(e)}}
+        except Exception as e:  # noqa: BLE001 — an export bug must not 500 raw
+            return 500, {"error": {"message": f"KV export failed: {e}"}}
+        if not payload:
+            return 204, None
+        return 200, payload
+
+    def kv_import(self, payload: bytes) -> tuple[int, dict]:
+        """PUT /admin/kv: plant a wire payload in the backend's prefix
+        cache. A version/shape mismatch answers 400 (the payload was
+        validated before the cache was touched); backends without a prefix
+        cache answer 501 so the router's migration falls back cleanly."""
+        if self.generator is None:
+            return 503, {"error": {"message": "model is still loading"}}
+        import_fn = getattr(self.generator, "import_kv", None)
+        if not callable(import_fn):
+            return 501, {"error": {"message": "backend has no KV import"}}
+        if not payload:
+            return 400, {"error": {"message": "empty KV payload"}}
+        try:
+            added = import_fn(payload)
+        except ValueError as e:
+            return 400, {"error": {"message": f"KV payload rejected: {e}"}}
+        except TimeoutError as e:
+            return 503, {"error": {"message": str(e)}}
+        except Exception as e:  # noqa: BLE001
+            return 500, {"error": {"message": f"KV import failed: {e}"}}
+        return 200, {"imported_bytes": int(added)}
 
     def _advertises_prefixes(self) -> bool:
         """Digest gate: only a backend that owns a live prefix cache
@@ -782,6 +964,7 @@ def serve_model(
     prefix_cache_host_mb: float | None = None,
     max_queue: int | None = None,
     admin_token: str | None = None,
+    role: str | None = None,
 ) -> InferenceServer:
     """Bind the port, then build the (optionally sharded) generator.
 
@@ -806,7 +989,11 @@ def serve_model(
     serves one replica across the whole slice — docs/architecture.md
     "Sharded replica". It is the declarative alternative to ``slice_name``
     (which derives a mesh from a provisioned slice's topology); passing
-    both is an error."""
+    both is an error. ``role`` (None = the ``PRIME_SERVE_ROLE`` env default,
+    ``any``) declares the replica's phase in a disaggregated fleet —
+    advertised in /healthz and honored by the fleet router's migration path;
+    ``--mesh role:prefill`` / ``role:decode`` resolve to the matching
+    role-preset layouts (serve/mesh_config.py)."""
     from prime_tpu.evals.runner import JaxGenerator
 
     if mesh and slice_name:
@@ -840,8 +1027,11 @@ def serve_model(
     # same clamp the engine applies: a junk env value must not crash the
     # one-shot generator path while the continuous path silently clamps
     draft_len = max(1, int(draft_len))
-    # fail fast on EADDRINUSE; admin_token=None reads PRIME_FLEET_ADMIN_TOKEN
-    server = InferenceServer(model, host=host, port=port, admin_token=admin_token)
+    # fail fast on EADDRINUSE; admin_token=None reads PRIME_FLEET_ADMIN_TOKEN,
+    # role=None reads PRIME_SERVE_ROLE (the phase-split fleet's --role)
+    server = InferenceServer(
+        model, host=host, port=port, admin_token=admin_token, role=role
+    )
     try:
         generator = JaxGenerator(
             model,
@@ -890,6 +1080,11 @@ def serve_model(
                 prefix_cache_mb=prefix_cache_mb,
                 prefix_cache_host_mb=prefix_cache_host_mb,
                 max_queue=max_queue,
+                # a prefill-role replica's batched waves must store EVERY
+                # member's KV: its GET /admin/kv exports are the migration's
+                # whole point, and a batched admission that only stored
+                # member 0 would turn wave members' migrations cold
+                prefix_store_all=server.role == "prefill",
             )
             engine.start()
             server.generator = EngineBackend(engine, generator.tokenizer)

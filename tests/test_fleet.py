@@ -255,6 +255,30 @@ def test_membership_tolerates_pre_digest_and_malformed_healthz():
     assert "digest_entries" in m.snapshot()[replica.id]
 
 
+def test_membership_role_schema_tolerance():
+    """Satellite: the /healthz ``role`` field parses with the same tolerance
+    contract as the prefix digest — unknown/absent/junk coerces to ``any``
+    (never a poll failure), and the closed role vocabulary is the memory cap
+    (a replica cannot balloon router state through it the way an unbounded
+    digest could)."""
+    m = FleetMembership(["http://127.0.0.1:1"])
+    replica = next(iter(m.replicas.values()))
+    # pre-role schema: field absent entirely -> the every-phase role
+    m.apply_health(replica, {"state": "ready"}, 200)
+    assert replica.role == "any"
+    # explicit roles land
+    for role in ("prefill", "decode", "any"):
+        m.apply_health(replica, {"state": "ready", "role": role}, 200)
+        assert replica.role == role
+    # junk values/shapes degrade to "any", never raise — and never leave a
+    # stale explicit role behind (a replica that STOPS advertising must not
+    # keep attracting migrations)
+    for junk in (7, True, None, "", "PREFILL", "gpu", ["decode"], {"r": 1}, "x" * 4096):
+        m.apply_health(replica, {"state": "ready", "role": junk}, 200)
+        assert replica.role == "any", junk
+    assert m.snapshot()[replica.id]["role"] == "any"
+
+
 def test_balancer_cache_aware_fallback_routes_to_longest_prefix():
     """The tentpole routing upgrade: with the affinity target saturated, the
     fallback diverts to the unsaturated replica advertising the LONGEST
