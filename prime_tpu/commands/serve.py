@@ -41,6 +41,14 @@ from prime_tpu.utils.render import Renderer, output_options
 )
 @click.option("--adapter", default=None, type=click.Path(exists=True),
               help="LoRA adapter dir (from train local --lora) to merge into the model.")
+@click.option(
+    "--adapters", "adapters_spec", default=None, metavar="NAME=DIR,...",
+    help="Batched multi-LoRA serving (--continuous): comma-separated "
+         "name=artifact-dir entries loaded UNMERGED into a device-resident "
+         "bank — the OpenAI `model` field selects the adapter per request "
+         "and a mixed-adapter batch decodes as one program. "
+         "Default: unset (PRIME_SERVE_ADAPTERS).",
+)
 @click.option("--host", default="127.0.0.1")
 @click.option("--port", type=int, default=8000)
 @click.option(
@@ -94,6 +102,13 @@ from prime_tpu.utils.render import Renderer, output_options
          "Default: 0 (PRIME_SERVE_PREFIX_CACHE_HOST_MB).",
 )
 @click.option(
+    "--adapter-max-inflight", type=int, default=None,
+    help="Per-tenant fair admission (--adapters): max admitted slots one "
+         "adapter (base included) may hold; queued overflow waits in its "
+         "own bucket while other tenants admit. 0 = uncapped. "
+         "Default: 0 (PRIME_SERVE_ADAPTER_MAX_INFLIGHT).",
+)
+@click.option(
     "--max-queue", type=int, default=None,
     help="Bound the engine's pending queue (--continuous): submissions past "
          "it get 429 + Retry-After instead of queueing unboundedly. "
@@ -138,6 +153,7 @@ def serve_cmd(
     weight_quant: bool,
     weight_bits: str,
     adapter: str | None,
+    adapters_spec: str | None,
     host: str,
     port: int,
     continuous: bool,
@@ -150,6 +166,7 @@ def serve_cmd(
     warmup: bool | None,
     prefix_cache_mb: float | None,
     prefix_cache_host_mb: float | None,
+    adapter_max_inflight: int | None,
     max_queue: int | None,
     role: str | None,
     replica_of: str | None,
@@ -169,6 +186,16 @@ def serve_cmd(
         )
     if mesh_spec and not continuous:
         raise click.UsageError("--mesh requires --continuous (the sharded replica is engine-only)")
+    if adapters_spec and not continuous:
+        raise click.UsageError(
+            "--adapters requires --continuous (batched multi-LoRA serving "
+            "is engine-only; --adapter merges one adapter for the one-shot path)"
+        )
+    if adapters_spec and adapter:
+        raise click.UsageError(
+            "--adapter and --adapters are mutually exclusive (merged base "
+            "weights would fail the bank's base-fingerprint check)"
+        )
     if weight_bits == "4" and not weight_quant:
         # silently serving bf16 at 4x the expected HBM footprint would be a
         # nasty surprise; make the dependency explicit
@@ -193,6 +220,7 @@ def serve_cmd(
             kv_quant=kv_quant,
             weight_quant=("int4" if weight_bits == "4" else True) if weight_quant else False,
             adapter=adapter,
+            adapters=adapters_spec,
             host=host,
             port=port,
             continuous=continuous,
@@ -206,6 +234,7 @@ def serve_cmd(
             warmup=warmup,
             prefix_cache_mb=prefix_cache_mb,
             prefix_cache_host_mb=prefix_cache_host_mb,
+            adapter_max_inflight=adapter_max_inflight,
             max_queue=max_queue,
             role=role,
         )
@@ -283,6 +312,13 @@ def serve_cmd(
     help="Require `Authorization: Bearer <token>` on the mutating admin "
          "surface (/admin/join, /admin/drain). Unset = open (loopback only!).",
 )
+@click.option(
+    "--model-alias", "model_aliases", multiple=True, metavar="MODEL=ADAPTER",
+    help="Router model registry (repeatable): map an OpenAI `model` name to "
+         "an adapter id for multi-LoRA placement ('base' pins it to base "
+         "routing). Names not aliased resolve against what replicas "
+         "advertise in /healthz.",
+)
 def serve_fleet_cmd(
     replicas: tuple[str, ...],
     host: str,
@@ -295,6 +331,7 @@ def serve_fleet_cmd(
     fail_threshold: int,
     cooldown: float,
     admin_token: str | None,
+    model_aliases: tuple[str, ...],
 ) -> None:
     """Route an OpenAI-compatible endpoint across N engine replicas:
     prefix-affinity scheduling (shared-prefix traffic lands on the replica
@@ -303,6 +340,12 @@ def serve_fleet_cmd(
     "Serve fleet"."""
     from prime_tpu.serve.fleet import FleetRouter
 
+    registry: dict[str, str | None] = {}
+    for entry in model_aliases:
+        name, eq, target = entry.partition("=")
+        if not eq or not name or not target:
+            raise click.UsageError(f"--model-alias {entry!r} must be MODEL=ADAPTER")
+        registry[name] = None if target == "base" else target
     try:
         router = FleetRouter(
             replicas,
@@ -316,6 +359,7 @@ def serve_fleet_cmd(
             fail_threshold=fail_threshold,
             cooldown=cooldown,
             admin_token=admin_token,
+            model_registry=registry or None,
         )
     except OSError as e:
         raise click.ClickException(str(e)) from None

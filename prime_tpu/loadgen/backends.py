@@ -68,9 +68,15 @@ class EngineTarget:
     def submit(self, planned: PlannedRequest):
         """Submit one planned request; returns the live EngineRequest.
         Raises QueueFullError when the admission gate rejects (the runner
-        records the rejection — deliberately no retry)."""
+        records the rejection — deliberately no retry). A planned adapter
+        rides through to the engine's multi-LoRA bank (``"base"``/None both
+        mean the base model, matching the HTTP target's `model` field)."""
+        kwargs = {}
+        if planned.adapter and planned.adapter != "base":
+            kwargs["adapter"] = planned.adapter
         return self.engine.submit(
-            list(planned.prompt_ids), max_new_tokens=planned.max_new_tokens
+            list(planned.prompt_ids), max_new_tokens=planned.max_new_tokens,
+            **kwargs,
         )
 
     def tick(self) -> None:

@@ -40,6 +40,13 @@ HEADLINE_METRICS: tuple[tuple[str, str], ...] = (
     ("prefixburst hit ratio", "serve_prefixburst_hit_ratio"),
     ("fleet tok/s", "serve_fleet_tok_s"),
     ("fleet affinity ratio", "serve_fleet_affinity_ratio"),
+    # batched multi-LoRA serving (own keys: mixed-adapter and base-only
+    # numbers come from one dedicated comparison and only delta against
+    # themselves — the ratio row is the ≥0.8x acceptance gate's evidence)
+    ("multilora tok/s", "serve_multilora_tok_s"),
+    ("multilora base tok/s", "serve_multilora_base_tok_s"),
+    ("multilora ratio", "serve_multilora_ratio"),
+    ("multilora fairness", "serve_multilora_fairness"),
     # disaggregated prefill/decode (own keys, never folded into the serve/
     # fleet rows above: the phase-split and colocated numbers come from a
     # dedicated scenario and must only ever delta against themselves)
@@ -241,10 +248,34 @@ def _multichip_round(path: str, record: dict[str, Any]) -> Round:
         # record whose sharded section failed has neither — no row, never
         # the single-chip headline masquerading as the multichip number.
         value = record.get("serve_sharded_tok_s")
-        if value is None and (record.get("mesh_devices") or record.get("mesh")):
+        if (
+            value is None
+            and (record.get("mesh_devices") or record.get("mesh"))
+            and str(record.get("metric", "")).startswith("serve_sharded_tok_s")
+        ):
+            # only the dedicated sharded smoke's own headline may take this
+            # row — a mesh-stamped record measuring something else (e.g. the
+            # role-preset disagg round) must not masquerade as the sharded
+            # fleet number
             value = record.get("value")
         if isinstance(value, (int, float)) and not isinstance(value, bool):
             metrics["mc sharded tok/s"] = float(value)
+        # role-preset disaggregation rounds (run_disagg_mesh_round): their
+        # serve_disagg_* keys render as mc-prefixed rows, disjoint from the
+        # single-chip disagg rows exactly like every other mc metric
+        for row_label, key in (
+            ("mc disagg tok/s", "serve_disagg_tok_s"),
+            ("mc disagg colo tok/s", "serve_disagg_colo_tok_s"),
+            ("mc disagg speedup", "serve_disagg_speedup"),
+            ("mc disagg ttft p50 ms", "serve_disagg_ttft_p50_ms"),
+            ("mc disagg colo ttft p50 ms", "serve_disagg_colo_ttft_p50_ms"),
+            ("mc disagg ttft p95 ms", "serve_disagg_ttft_p95_ms"),
+            ("mc disagg colo ttft p95 ms", "serve_disagg_colo_ttft_p95_ms"),
+            ("mc disagg migrate bytes", "serve_disagg_migrate_bytes"),
+        ):
+            mc_value = record.get(key)
+            if isinstance(mc_value, (int, float)) and not isinstance(mc_value, bool):
+                metrics[row_label] = float(mc_value)
         devices = (
             record.get("serve_mesh_devices")
             or record.get("mesh_devices")

@@ -274,6 +274,36 @@ def scenario_row(result) -> dict[str, Any]:
         "queue_wait_s": _quantiles(ehist("serve_queue_wait_seconds")),
         "rejected_429": int(result.outcomes.get("rejected_429", 0)),
     }
+    # multi-LoRA splits (windowed like everything else): per-adapter token
+    # and TTFT attribution from the adapter-labeled engine families — the
+    # evidence the fairness ratio and the ≥0.8x-of-base acceptance gate are
+    # computed from. Absent entirely on bankless engines (no series).
+    adapter_tokens: dict[str, float] = {}
+    for name in engines:
+        prev = _labeled_values(
+            before.get(name, {}), "serve_adapter_tokens_total", "adapter"
+        )
+        for key, value in _labeled_values(
+            after[name], "serve_adapter_tokens_total", "adapter"
+        ).items():
+            adapter_tokens[key] = (
+                adapter_tokens.get(key, 0.0) + value - prev.get(key, 0.0)
+            )
+    adapter_tokens = {k: v for k, v in adapter_tokens.items() if v > 0}
+    if adapter_tokens:
+        row["adapters"] = {
+            key: {
+                "tokens": int(value),
+                "tok_s": round(value / duration_s, 2) if duration_s else 0.0,
+                "ttft_s": _quantiles(
+                    ehist("serve_adapter_ttft_seconds", {"adapter": key})
+                ),
+                "queue_wait_s": _quantiles(
+                    ehist("serve_adapter_queue_wait_seconds", {"adapter": key})
+                ),
+            }
+            for key, value in sorted(adapter_tokens.items())
+        }
     if warnings:
         row["warning"] = "; ".join(warnings)
 

@@ -82,6 +82,127 @@ def _spec_section(
     return record, rows
 
 
+def _multilora_section(
+    config, params_fn, *, seed: int, mesh: str | None, log
+) -> tuple[dict[str, Any], list]:
+    """The batched multi-LoRA comparison (docs/architecture.md "Multi-LoRA
+    serving"): the ``mixed_tenants`` scenario — tenants pinned to two LoRA
+    adapters plus base via the OpenAI ``model`` field — through ONE engine
+    holding the unmerged adapter bank, against the SAME schedule stripped to
+    base-only on a bankless engine (the single-checkpoint headline config).
+    Two throwaway adapter artifacts are trained-shaped (random factors,
+    base-fingerprinted) and saved through ``train/lora.save_adapters`` so
+    the load path exercised is the production one. Record keys:
+    ``serve_multilora_tok_s`` / ``serve_multilora_base_tok_s`` / their
+    ratio (the ≥0.8x acceptance gate reads it) and the per-adapter fairness
+    ratio (min/max delivered tokens across base + adapters — 1.0 = perfectly
+    even under the equal-demand mixed schedule).
+
+    Scale note: run this at debug-128m (like the disagg section), not
+    tiny-test — the gathered delta adds a fixed handful of small einsums
+    per projection, and against a tiny model's near-zero matmuls that
+    handful IS the runtime (the measured ratio would be an op-count
+    artifact); at 128m the base matmuls are real work and the measured
+    ratio reflects the architecture's actual multi-tenant cost."""
+    import contextlib
+    import dataclasses
+    import tempfile
+
+    import jax
+
+    from prime_tpu.loadgen.backends import EngineTarget
+    from prime_tpu.loadgen.report import scenario_row
+    from prime_tpu.loadgen.runner import run_schedule
+    from prime_tpu.loadgen.scenario import SCENARIOS, build_schedule
+    from prime_tpu.serve.engine import ContinuousBatchingEngine
+    from prime_tpu.train.lora import LoraConfig, init_lora_params, save_adapters
+
+    schedule = build_schedule(
+        SCENARIOS["mixed_tenants"](seed), vocab=config.vocab_size
+    )
+    base_schedule = [dataclasses.replace(r, adapter=None) for r in schedule]
+    params = params_fn()
+    lora = LoraConfig(r=8, alpha=16)
+    stack = contextlib.ExitStack()
+    tmp = stack.enter_context(
+        tempfile.TemporaryDirectory(prefix="prime-multilora-")
+    )
+    paths: dict[str, str] = {}
+    for i, name in enumerate(("adapter-a", "adapter-b")):
+        factors = init_lora_params(jax.random.PRNGKey(10 + i), config, lora)
+        # B is zero at init (a no-op adapter); give it small random values so
+        # the gathered matmuls measure real distinct fine-tunes, not zeros
+        factors["layers"] = {
+            t: {
+                "a": ab["a"],
+                "b": (
+                    jax.random.normal(jax.random.PRNGKey(20 + i), ab["b"].shape)
+                    * 0.02
+                ).astype(ab["b"].dtype),
+            }
+            for t, ab in factors["layers"].items()
+        }
+        path = os.path.join(tmp, name)
+        save_adapters(path, factors, lora, config, base_params=params)
+        paths[name] = path
+
+    rows = []
+    try:
+        for label, adapters, sched in (
+            ("multilora_base", None, base_schedule),
+            ("multilora", paths, schedule),
+        ):
+            engine = ContinuousBatchingEngine(
+                params, config, pad_id=0, max_slots=4, capacity=256, chunk=4,
+                prefix_cache_mb=8, adapters=adapters, mesh_config=mesh or None,
+            )
+            try:
+                # warm the shapes in play, then measure registry-windowed
+                for _ in range(2):
+                    warm = engine.submit(
+                        list(sched[0].prompt_ids),
+                        max_new_tokens=sched[0].max_new_tokens,
+                    )
+                    while not warm.done:
+                        engine.tick()
+                engine.tick()
+                result = run_schedule(
+                    sched, EngineTarget(engine), scenario=label, seed=seed,
+                    time_scale=0.0,
+                )
+                rows.append(scenario_row(result))
+            finally:
+                engine.shutdown()
+    finally:
+        stack.close()  # the artifact dir is throwaway — never leak it
+    base_row, mixed_row = rows
+    record: dict[str, Any] = {
+        "serve_multilora_base_tok_s": base_row["tok_s"],
+        "serve_multilora_tok_s": mixed_row["tok_s"],
+    }
+    if base_row["tok_s"]:
+        record["serve_multilora_ratio"] = round(
+            mixed_row["tok_s"] / base_row["tok_s"], 3
+        )
+    split = mixed_row.get("adapters") or {}
+    per_adapter = [entry["tokens"] for entry in split.values()]
+    if per_adapter and max(per_adapter) > 0:
+        record["serve_multilora_fairness"] = round(
+            min(per_adapter) / max(per_adapter), 3
+        )
+    record["serve_multilora_adapters"] = {
+        name: entry["tokens"] for name, entry in split.items()
+    }
+    log(
+        f"# loadgen-smoke: multilora mixed {record['serve_multilora_tok_s']} "
+        f"vs base-only {record['serve_multilora_base_tok_s']} tok/s "
+        f"(ratio {record.get('serve_multilora_ratio')}, fairness "
+        f"{record.get('serve_multilora_fairness')}, per-adapter "
+        f"{record['serve_multilora_adapters']})"
+    )
+    return record, rows
+
+
 def disagg_comparison(
     config,
     params_fn,
@@ -96,9 +217,19 @@ def disagg_comparison(
     max_queue: int = 64,
     time_scale: float = 1.0,
     warmup: bool = False,
+    mesh_roles: bool = False,
     log=print,
 ) -> tuple[dict[str, Any], list]:
     """Phase-split vs colocated, same device budget, same schedule.
+
+    ``mesh_roles=True`` is the MULTICHIP variant: every replica becomes a
+    SHARDED engine over a disjoint half of the available devices, laid out
+    by its role preset (``role:prefill`` = tp-absorbing, ``role:decode`` =
+    dp-absorbing, serve/mesh_config.ROLE_MESH_PRESETS; colocated ``any``
+    replicas take the prefill-shaped tp layout so both cells span identical
+    hardware). Run under ``XLA_FLAGS=--xla_force_host_platform_device_count=4``
+    this measures the role-preset disaggregation on a forced CPU mesh — the
+    open measurement from the PR 11 round.
 
     The long-prompt-heavy ``disagg`` scenario runs over real HTTP through a
     FleetRouter against (a) two colocated ``any``-role replicas on the
@@ -123,6 +254,7 @@ def disagg_comparison(
     import concurrent.futures
 
     import httpx
+    import jax
 
     from prime_tpu.loadgen.backends import HTTPTarget, NumericTokenizer
     from prime_tpu.loadgen.report import scenario_row
@@ -151,17 +283,51 @@ def disagg_comparison(
     # decode replica resumes under the SAME weights that computed it —
     # per-replica params would silently benchmark an incoherent fleet
     params = params_fn(0)
+    per_replica_devices = jax.device_count() // 2 if mesh_roles else 0
+    if mesh_roles and per_replica_devices < 2:
+        raise ValueError(
+            f"mesh_roles needs >= 4 devices (2 per replica); have "
+            f"{jax.device_count()} — force more with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=4"
+        )
     for mode, roles, chunks in cells:
         engines: list = []
         servers: list = []
         router = None
         try:
             for i, role in enumerate(roles):
+                mesh_kw: dict = {"mesh_config": ""}
+                engine_params = params
+                if mesh_roles:
+                    # role-preset layout over this replica's DISJOINT device
+                    # slice (same disjointness contract as run_smoke --mesh:
+                    # overlapping meshes would measure contention, not
+                    # disaggregation). "any" replicas take the prefill
+                    # (tp-absorbing) shape so the colocated cell spans the
+                    # same hardware as the phase-split one.
+                    from prime_tpu.parallel.sharding import (
+                        serving_cache_spec,
+                        shard_params,
+                    )
+                    from prime_tpu.serve.mesh_config import parse_mesh_spec
+
+                    spec = "role:prefill" if role in ("any", "prefill") else "role:decode"
+                    cfg = parse_mesh_spec(spec, per_replica_devices)
+                    replica_mesh = cfg.build(
+                        jax.devices()[
+                            i * per_replica_devices : (i + 1) * per_replica_devices
+                        ]
+                    )
+                    engine_params = shard_params(params, replica_mesh, config)
+                    mesh_kw = {
+                        "mesh": replica_mesh,
+                        "cache_spec": serving_cache_spec(config, replica_mesh),
+                    }
                 engine = ContinuousBatchingEngine(
-                    params, config, pad_id=0, max_slots=max_slots,
+                    engine_params, config, pad_id=0, max_slots=max_slots,
                     capacity=capacity, chunk=chunks[i],
                     prefix_cache_mb=prefix_cache_mb, max_queue=max_queue,
-                    mesh_config="", warmup=warmup,
+                    warmup=warmup, **mesh_kw,
                     # role-tuned store policy: the prefill replica's batched
                     # waves must leave every member exportable
                     prefix_store_all=role == "prefill",
@@ -271,6 +437,11 @@ def disagg_comparison(
     record["serve_disagg_migrate_bytes"] = int(fleet.get("migrate_bytes") or 0)
     record["serve_disagg_model"] = getattr(config, "name", "?")
     record["serve_disagg_chunks"] = {"colocated": chunk, "decode_role": decode_chunk}
+    if mesh_roles:
+        from prime_tpu.serve.mesh_config import ROLE_MESH_PRESETS
+
+        record["serve_disagg_mesh_roles"] = dict(ROLE_MESH_PRESETS)
+        record["serve_disagg_mesh_devices"] = per_replica_devices * 2
     if not int(migrations.get("ok", 0)):
         record["serve_disagg_error"] = (
             "no successful KV migration in the measured window — the "
@@ -469,6 +640,33 @@ def run_smoke(
             spec_record = {"serve_spec_error": f"{type(e).__name__}: {e}"[:200]}
             log(f"# loadgen-smoke: spec section failed: {e}")
 
+        # batched multi-LoRA section (mixed 3-adapter traffic through one
+        # engine vs the same schedule base-only): record keys
+        # serve_multilora_tok_s / _base_tok_s / _ratio / _fairness, rows
+        # appended WITHOUT touching the headline gate — like the spec
+        # section. Runs at debug-128m scale (see _multilora_section's scale
+        # note: at tiny-test the gathered-delta op count, not the
+        # architecture, is what a CPU ratio measures) and skips under --mesh
+        # like the disagg section (its extra engines would contend for the
+        # forced device set).
+        multilora_record: dict[str, Any] = {}
+        if not mesh:
+            try:
+                ml_config = get_config("debug-128m")
+                multilora_record, multilora_rows = _multilora_section(
+                    ml_config,
+                    lambda: init_params(
+                        jax.random.PRNGKey(0), ml_config, dtype=jnp.float32
+                    ),
+                    seed=seed, mesh=None, log=log,
+                )
+                report["scenarios"].extend(multilora_rows)
+            except Exception as e:  # noqa: BLE001 — the headline gate must survive
+                multilora_record = {
+                    "serve_multilora_error": f"{type(e).__name__}: {e}"[:200]
+                }
+                log(f"# loadgen-smoke: multilora section failed: {e}")
+
         # disaggregated prefill/decode section (phase-split vs colocated on
         # the long-prompt-heavy `disagg` scenario, real HTTP fleets both
         # ways). Runs at debug-128m scale, not tiny-test: the migration's
@@ -532,6 +730,7 @@ def run_smoke(
             "backend": jax.default_backend(),
             **({"mesh": mesh_axes, "mesh_devices": mesh_devices} if sharded else {}),
             **spec_record,
+            **multilora_record,
             **disagg_record,
             "loadgen": report,
         }
@@ -554,3 +753,72 @@ def run_smoke(
             srv.stop()  # also shuts down the backing engine
         for engine in engines[len(servers):]:
             engine.shutdown()
+
+
+def run_disagg_mesh_round(
+    output_dir: str,
+    *,
+    seed: int | None = None,
+    log=print,
+) -> dict[str, Any]:
+    """The MULTICHIP disaggregation round (the open measurement from the
+    disagg PR): :func:`disagg_comparison` with ``mesh_roles=True`` — every
+    replica a sharded engine over a disjoint half of the forced CPU device
+    set, laid out by its ``role:prefill`` / ``role:decode`` preset — at
+    debug-128m scale. Writes ``bench_record.json`` in the MULTICHIP record
+    shape (mesh-stamped schema 2, ``serve_disagg_*`` keys plus the SLO
+    scenario rows under ``loadgen``) for committing as
+    ``MULTICHIP_loadgen_cpu_rNN.json``. Run under
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=4``."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+
+    seed = loadgen_seed_default() if seed is None else seed
+    os.makedirs(output_dir, exist_ok=True)
+    config = get_config("debug-128m")
+    record, rows = disagg_comparison(
+        config,
+        lambda i: init_params(jax.random.PRNGKey(i), config, dtype=jnp.float32),
+        seed=seed, model_id="disagg-mesh", mesh_roles=True, log=log,
+    )
+    total = sum(r.get("tokens", 0) for r in rows)
+    duration = sum(r.get("duration_s") or 0.0 for r in rows)
+    report = {
+        "slo_schema": 1,
+        "scenarios": rows,
+        "headline": {
+            "tok_s": round(total / duration, 2) if duration else 0.0,
+            "tokens": int(total),
+            "duration_s": round(duration, 6),
+            "requests": sum(r.get("requests", 0) for r in rows),
+            "rejected_429": sum(r.get("rejected_429", 0) for r in rows),
+        },
+    }
+    out = {
+        "schema": 2,
+        "metric": (
+            "serve_disagg_mesh_tok_s (debug-128m, role-preset meshes — "
+            "prefill tp-absorbing / decode dp-absorbing — over "
+            f"{record.get('serve_disagg_mesh_devices')} forced CPU devices)"
+        ),
+        "value": record.get("serve_disagg_tok_s", 0.0),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "backend": jax.default_backend(),
+        "mesh": record.get("serve_disagg_mesh_roles", {}),
+        "mesh_devices": record.get("serve_disagg_mesh_devices", 0),
+        **record,
+        "loadgen": report,
+    }
+    with open(os.path.join(output_dir, "bench_record.json"), "w") as f:
+        json.dump(out, f, indent=2)
+    ok = bool(record.get("serve_disagg_tok_s", 0.0)) and not record.get(
+        "serve_disagg_error"
+    )
+    log(f"# disagg-mesh round: {'OK' if ok else 'FAILED'} — record in {output_dir}")
+    return {"ok": ok, "record": out}

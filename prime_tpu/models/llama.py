@@ -117,6 +117,49 @@ def _norm(x: jnp.ndarray, weight: jnp.ndarray, config: ModelConfig) -> jnp.ndarr
     return rms_norm(x, weight, config.rms_eps, plus_one=config.norm_plus_one)
 
 
+def _lora_mm(
+    x: jnp.ndarray,               # (B, S, d_in) projection input
+    lp: Params,                   # one layer's params (may carry lora stacks)
+    name: str,                    # target projection ("wq", "w_down", ...)
+    adapter_ids: jnp.ndarray | None,  # (B,) int32 per-row bank slots
+) -> jnp.ndarray:
+    """One adapted projection: ``x @ W`` plus, when the layer carries a
+    multi-LoRA bank stack for this target, the per-row gathered BGMV-style
+    delta ``(x @ A[idx]) @ B'[idx]`` (serve/adapters.py — B' has the LoRA
+    scale folded in; bank slot 0 is the all-zeros base adapter, so base rows
+    add an exact zero). Factor math runs in fp32 like ``merge_lora``'s delta
+    — the factors are tiny, no reason to round them — and the delta is added
+    in the activation dtype, mirroring the merged path's cast."""
+    y = _mm(x, lp[name])
+    a = lp.get(f"lora:{name}:a")  # (A, d_in, r) this layer's stacked A
+    if a is None or adapter_ids is None:
+        return y
+    b = lp[f"lora:{name}:b"]      # (A, r, d_out)
+    a_rows = a[adapter_ids].astype(jnp.float32)   # (B, d_in, r) row gather
+    b_rows = b[adapter_ids].astype(jnp.float32)   # (B, r, d_out)
+    h = jnp.einsum("bsd,bdr->bsr", x.astype(jnp.float32), a_rows)
+    delta = jnp.einsum("bsr,bro->bso", h, b_rows)
+    return y + delta.astype(y.dtype)
+
+
+def merge_adapter_stacks(stack: Params, adapters: dict | None, rows: slice) -> Params:
+    """Merge a multi-LoRA bank's per-target ``(L, A, ...)`` factor stacks
+    into a layer-param stack under reserved ``lora:<target>:a/b`` keys, so
+    the stacks scan with the layer params (one compiled layer body, adapters
+    included) — sliced by the same ``rows`` the layer stacks use. Targets
+    absent from this stack (e.g. attention keys of a different stack) are
+    skipped."""
+    if adapters is None:
+        return stack
+    merged = dict(stack)
+    for name, ab in adapters["layers"].items():
+        if name not in stack:
+            continue
+        merged[f"lora:{name}:a"] = ab["a"][rows]
+        merged[f"lora:{name}:b"] = ab["b"][rows]
+    return merged
+
+
 def init_params(rng: jax.Array, config: ModelConfig, dtype=jnp.bfloat16) -> Params:
     """Random init (truncated-normal-ish scaled); checkpoint loaders overwrite."""
     keys = jax.random.split(rng, 17)
@@ -280,6 +323,7 @@ def _attention_block(
     rope_tables_local: tuple[jnp.ndarray, jnp.ndarray] | None = None,
     mesh=None,  # mesh-aware impls: "ring" (context-parallel training),
     #             "sharded" (serve decode: flash kernel under shard_map)
+    adapter_ids: jnp.ndarray | None = None,  # (B,) multi-LoRA bank slots
 ):
     batch, seq, _ = x.shape
     h, kh, hd = config.n_heads, config.n_kv_heads, config.head_dim
@@ -299,7 +343,9 @@ def _attention_block(
 
     # OLMo-2 is post-norm only: no input norm param, the raw residual feeds in
     normed = _norm(x, lp["attn_norm"], config) if "attn_norm" in lp else x
-    q, k, v = _mm(normed, lp["wq"]), _mm(normed, lp["wk"]), _mm(normed, lp["wv"])
+    q = _lora_mm(normed, lp, "wq", adapter_ids)
+    k = _lora_mm(normed, lp, "wk", adapter_ids)
+    v = _lora_mm(normed, lp, "wv", adapter_ids)
     if "bq" in lp:  # Qwen2-style q/k/v biases
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     if "q_norm_full" in lp:  # OLMo-2: full-width RMSNorm before the head split
@@ -422,7 +468,7 @@ def _attention_block(
                 new_v_cache = jax.lax.dynamic_update_slice(v_cache, v_t, (0, 0, 0, 0))
 
     attn = attn.transpose(0, 2, 1, 3).reshape(batch, seq, h * hd)
-    out = _mm(attn, lp["wo"])
+    out = _lora_mm(attn, lp, "wo", adapter_ids)
     if "bo" in lp:  # Llama-arch attention_bias checkpoints bias o_proj too
         out = out + lp["bo"]
     if "attn_post_norm" in lp:  # Gemma2-style post-norm before the residual add
@@ -430,7 +476,10 @@ def _attention_block(
     return x + out, new_k_cache, new_v_cache, new_k_scale, new_v_scale
 
 
-def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> tuple[jnp.ndarray, jnp.ndarray]:
+def _mlp_block(
+    x: jnp.ndarray, lp: Params, config: ModelConfig,
+    adapter_ids: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Dense or sparse-MoE feed-forward. Returns (residual output, aux loss)."""
     normed = _norm(x, lp["mlp_norm"], config) if "mlp_norm" in lp else x
     # key-presence decides, not config.is_moe alone: a DeepSeek dense-prefix
@@ -467,9 +516,9 @@ def _mlp_block(x: jnp.ndarray, lp: Params, config: ModelConfig) -> tuple[jnp.nda
             y = _norm(y, lp["mlp_post_norm"], config)
         return x + y, aux
     act = jax.nn.silu if config.act == "silu" else _gelu_tanh
-    gate = act(_mm(normed, lp["w_gate"]))
-    up = _mm(normed, lp["w_up"])
-    y = _mm(gate * up, lp["w_down"])
+    gate = act(_lora_mm(normed, lp, "w_gate", adapter_ids))
+    up = _lora_mm(normed, lp, "w_up", adapter_ids)
+    y = _lora_mm(gate * up, lp, "w_down", adapter_ids)
     if "mlp_post_norm" in lp:  # Gemma2-style post-norm before the residual add
         y = _norm(y, lp["mlp_post_norm"], config)
     return x + y, jnp.zeros((), jnp.float32)
@@ -496,6 +545,8 @@ def forward(
     #           the sequence; "sharded": serving mesh for the shard_mapped
     #           flash-decode dispatch (parallel/decode_sharded.py)
     last_positions: jnp.ndarray | None = None,  # (B,) → logits only at these rows
+    adapters: dict | None = None,  # multi-LoRA bank stacks (serve/adapters.py)
+    adapter_ids: jnp.ndarray | None = None,  # (B,) int32 per-row bank slots
 ):
     """Run the transformer. Returns (logits (B, S, V) fp32, updated cache),
     plus the summed MoE load-balance aux loss when ``return_aux``.
@@ -612,9 +663,9 @@ def forward(
                 k_c, v_c, cache_lengths, decode, attn_impl,
                 k_scale=k_s, v_scale=v_s, prefill_offset=prefill_offset,
                 sliding=sliding, rope_tables_local=rope_tables_local,
-                mesh=mesh,
+                mesh=mesh, adapter_ids=adapter_ids,
             )
-        x, aux = _mlp_block(x, lp, config)
+        x, aux = _mlp_block(x, lp, config, adapter_ids=adapter_ids)
         ys = (new_k, new_v, new_ks, new_vs) if quantized else (new_k, new_v)
         return (x, aux_sum + aux), ys
 
@@ -632,6 +683,14 @@ def forward(
         if kd
         else [(layer_params, slice(0, None))]
     )
+    # multi-LoRA bank: the per-target (L, A, ...) factor stacks ride the
+    # layer scan under reserved lora:* keys, sliced by each stack's rows —
+    # _lora_mm gathers each batch row's factors by adapter_ids inside the
+    # scanned body (serve/adapters.py; no bank → byte-identical programs)
+    stacks = [
+        (merge_adapter_stacks(stack, adapters, rows), rows)
+        for stack, rows in stacks
+    ]
 
     if cache is not None:
         new_ks = new_vs = None
@@ -682,9 +741,9 @@ def forward(
                 x, _, _, _, _ = _attention_block(
                     x, lp, positions, rope_tables, config, None, None, None, False, attn_impl,
                     sliding=sliding, rope_tables_local=rope_tables_local,
-                    mesh=mesh,
+                    mesh=mesh, adapter_ids=adapter_ids,
                 )
-            x, aux = _mlp_block(x, lp, config)
+            x, aux = _mlp_block(x, lp, config, adapter_ids=adapter_ids)
             return (x, aux_sum + aux), None
 
         if remat not in ("none", "full", "dots"):

@@ -1,0 +1,274 @@
+"""Device-resident multi-LoRA adapter bank: hundreds of fine-tunes per chip.
+
+The serving half of ``train/lora.py``: instead of merging ONE adapter into
+the base weights at load (``merge_lora`` — one fleet per fine-tune), the
+engine loads a bounded registry of adapter artifacts into a stacked
+``(L, A, ...)`` A/B buffer bank and serves them all *unmerged* from one
+program. Every projection the bank adapts computes
+
+    y = x @ W + (x @ A[idx]) @ B'[idx]
+
+where ``idx`` is each batch row's int32 adapter index (a per-slot vector
+living next to the engine's paged KV state) and ``B' = B * (alpha/r)`` has
+the LoRA scale folded in at load time. Row gathers make the dispatch
+BGMV-style: a mixed-adapter decode wave runs as ONE program — no
+per-adapter sub-batching, no host gathers — and index 0 is the reserved
+all-zeros **base** adapter, so base-model requests ride the same gathered
+matmul with an exactly-zero delta (bit-identical to a bankless engine).
+
+Bank invariants:
+
+- **Slot 0 is base.** ``BASE_ADAPTER`` never loads from disk; its factors
+  are zeros, so ``(x @ 0) @ 0 == 0`` exactly and base traffic is unpolluted
+  by construction (the mixed-wave isolation the tests pin).
+- **Ranks pad to the bank max.** Adapters of different rank stack into one
+  buffer by zero-padding A's rank columns (zero columns contribute exactly
+  zero — padding is a no-op, not an approximation).
+- **Targets union.** An adapter that does not adapt a target contributes
+  zeros there. The union of targets decides which projections pay the
+  gathered matmul at all; untargeted projections stay the plain ``x @ W``.
+- **Base-fingerprint checked.** Each artifact's recorded ``base_model`` name
+  AND weight fingerprint (``train/lora.base_fingerprint``) must match the
+  engine's params — adapters trained over different base weights corrupt
+  every request that selects them, so the bank refuses at load, not at
+  decode.
+- **Sharded consistently with the wrapped projection.** ``bank_specs``
+  mirrors ``train/lora.lora_param_specs`` over the stacked layout: A takes
+  the base weight's input (fsdp) axis, B its output (tp) axis, the adapter
+  and rank axes replicate — so a ``(dp, fsdp, tp)`` replica's adapter
+  deltas partition exactly like the matmuls they ride.
+
+See docs/architecture.md "Multi-LoRA serving".
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+# the reserved index-0 adapter name: the base model itself (zero factors)
+BASE_ADAPTER = "base"
+
+# load-time bound on bank width: the bank is device-resident, and an operator
+# fat-fingering a glob into --adapters must fail loudly before the engine
+# tries to allocate an unbounded (A, L, d, r) buffer
+MAX_ADAPTERS = 1024
+
+
+def parse_adapter_spec(spec: str) -> dict[str, str]:
+    """Parse the ``--adapters`` / ``PRIME_SERVE_ADAPTERS`` value:
+    comma-separated ``name=path`` entries. Names must be unique, non-empty,
+    and not the reserved ``base``."""
+    out: dict[str, str] = {}
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, eq, path = entry.partition("=")
+        name, path = name.strip(), path.strip()
+        if not eq or not name or not path:
+            raise ValueError(
+                f"adapter spec entry {entry!r} must be name=path"
+            )
+        if name == BASE_ADAPTER:
+            raise ValueError(
+                f"adapter name {BASE_ADAPTER!r} is reserved for the base model"
+            )
+        if name in out:
+            raise ValueError(f"duplicate adapter name {name!r}")
+        out[name] = path
+    return out
+
+
+def bank_specs(config, targets: tuple[str, ...]) -> dict[str, Any]:
+    """PartitionSpecs for the stacked bank, mirroring each target's base
+    layout (train/lora.lora_param_specs over the (L, A, ...) stacking): A
+    inherits the input axis, B the output axis; layer/adapter/rank axes
+    replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    from prime_tpu.parallel.sharding import param_specs
+
+    base = param_specs(config)["layers"]
+    specs: dict[str, Any] = {}
+    for name in targets:
+        w = base[name]  # P(None, in_axis, out_axis)
+        specs[name] = {
+            "a": P(None, None, w[1], None),
+            "b": P(None, None, None, w[2]),
+        }
+    return {"layers": specs}
+
+
+class AdapterBank:
+    """The loaded registry: ``names`` in slot order (``names[0] == "base"``),
+    ``stacks`` the device pytree ``{"layers": {target: {"a": (L, A, d_in, R),
+    "b": (L, A, R, d_out)}}}`` the model forward gathers from."""
+
+    def __init__(self, names: tuple[str, ...], stacks: dict, rank: int) -> None:
+        self.names = names
+        self.stacks = stacks
+        self.rank = rank
+        self._index = {name: i for i, name in enumerate(names)}
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    @property
+    def adapter_names(self) -> tuple[str, ...]:
+        """Loaded adapter names, base excluded — what /healthz advertises."""
+        return self.names[1:]
+
+    def index_of(self, name: str | None) -> int:
+        """Resolve a request's adapter name to its bank slot. ``None`` and
+        ``"base"`` are the base model; unknown names raise KeyError (the
+        server maps it to a 404 on the OpenAI ``model`` field)."""
+        if name is None:
+            return 0
+        idx = self._index.get(name)
+        if idx is None:
+            raise KeyError(
+                f"unknown adapter {name!r} (loaded: {list(self.names)})"
+            )
+        return idx
+
+    def nbytes(self) -> int:
+        import jax
+
+        return int(
+            sum(
+                leaf.size * leaf.dtype.itemsize
+                for leaf in jax.tree_util.tree_leaves(self.stacks)
+            )
+        )
+
+
+def load_adapter_bank(
+    adapters: "dict[str, str | Path]",
+    params: dict,
+    config,
+    *,
+    mesh=None,
+    dtype=None,
+) -> AdapterBank:
+    """Load ``{name: artifact dir}`` (``train/lora.save_adapters`` output)
+    into a stacked device-resident bank. Validates each artifact's base-model
+    name and weight fingerprint against ``params`` before anything uploads;
+    with ``mesh`` the stacks are placed per :func:`bank_specs` so the deltas
+    shard like the projections they wrap. ``dtype`` defaults to the params'
+    dtype (the factors are tiny next to the KV cache either way)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from prime_tpu.train.lora import (
+        _TARGET_DIMS,
+        base_fingerprint,
+        fingerprints_match,
+        load_adapters,
+    )
+
+    if not adapters:
+        raise ValueError("adapter bank needs at least one name=path entry")
+    if BASE_ADAPTER in adapters:
+        raise ValueError(f"adapter name {BASE_ADAPTER!r} is reserved for the base model")
+    if len(adapters) + 1 > MAX_ADAPTERS:
+        raise ValueError(
+            f"{len(adapters)} adapters exceed the bank bound ({MAX_ADAPTERS - 1})"
+        )
+    if getattr(config, "first_k_dense", 0):
+        # the dense-prefix layer split slices attention stacks cleanly, but
+        # MLP stacks are tail-sized and the trainer's artifacts are not —
+        # reject until an artifact schema carries per-stack factors
+        raise NotImplementedError(
+            "multi-LoRA serving does not support first_k_dense configs yet"
+        )
+    if dtype is None:
+        dtype = jax.tree_util.tree_leaves(params)[0].dtype
+
+    loaded: list[tuple[str, dict, Any]] = []
+    fingerprint = None
+    for name, path in adapters.items():
+        factors, lora_cfg, meta = load_adapters(path)
+        if meta.get("base_model") != config.name:
+            raise ValueError(
+                f"adapter {name!r} ({path}) was trained on "
+                f"{meta.get('base_model')!r} but this engine serves "
+                f"{config.name!r} — serving it would corrupt every request "
+                "that selects it"
+            )
+        recorded = meta.get("base_fingerprint")
+        if recorded is not None:
+            if fingerprint is None:
+                try:
+                    fingerprint = base_fingerprint(params)
+                except (TypeError, AttributeError) as e:
+                    # quantized/transformed params (e.g. weight_quant turns
+                    # weight matrices into (int8, scale) tuples) cannot be
+                    # fingerprinted — refuse with the real reason instead of
+                    # an opaque indexing crash
+                    raise ValueError(
+                        "cannot fingerprint the base params (quantized or "
+                        "otherwise transformed weights?); load the adapter "
+                        f"bank against the raw checkpoint ({e})"
+                    ) from None
+            if not fingerprints_match(recorded, fingerprint):
+                raise ValueError(
+                    f"adapter {name!r} ({path}) was trained over DIFFERENT "
+                    f"base weights than this engine's (same config name "
+                    f"{config.name!r}, different weight fingerprint); "
+                    "re-train it against this checkpoint"
+                )
+        loaded.append((name, factors, lora_cfg))
+
+    targets = tuple(
+        sorted({t for _, factors, _ in loaded for t in factors["layers"]})
+    )
+    if config.is_moe:
+        mlp_targets = set(targets) & {"w_gate", "w_up", "w_down"}
+        if mlp_targets:
+            raise NotImplementedError(
+                f"multi-LoRA on MoE expert MLPs is not supported (targets "
+                f"{sorted(mlp_targets)} have a stacked expert axis)"
+            )
+    rank = max(lora_cfg.r for _, _, lora_cfg in loaded)
+    layers = config.n_layers
+    names = (BASE_ADAPTER,) + tuple(name for name, _, _ in loaded)
+    width = len(names)
+
+    stacks: dict[str, Any] = {}
+    for target in targets:
+        d_in, d_out = _TARGET_DIMS[target](config)
+        a_stack = np.zeros((layers, width, d_in, rank), dtype=np.float32)
+        b_stack = np.zeros((layers, width, rank, d_out), dtype=np.float32)
+        for slot, (name, factors, lora_cfg) in enumerate(loaded, start=1):
+            ab = factors["layers"].get(target)
+            if ab is None:
+                continue  # this adapter leaves the target unadapted: zeros
+            a = np.asarray(ab["a"], dtype=np.float32)
+            b = np.asarray(ab["b"], dtype=np.float32)
+            if a.shape != (layers, d_in, lora_cfg.r) or b.shape != (
+                layers, lora_cfg.r, d_out,
+            ):
+                raise ValueError(
+                    f"adapter {name!r} target {target!r} has factor shapes "
+                    f"{a.shape}/{b.shape}; this config wants "
+                    f"({layers}, {d_in}, r)/({layers}, r, {d_out})"
+                )
+            a_stack[:, slot, :, : lora_cfg.r] = a
+            # fold the LoRA scale into B once: the gathered matmul then never
+            # needs a per-adapter scale vector in the program
+            b_stack[:, slot, : lora_cfg.r, :] = b * lora_cfg.scale
+        stacks[target] = {
+            "a": jnp.asarray(a_stack, dtype=dtype),
+            "b": jnp.asarray(b_stack, dtype=dtype),
+        }
+
+    if mesh is not None and getattr(mesh, "size", 1) > 1:
+        from jax.sharding import NamedSharding
+
+        specs = bank_specs(config, targets)["layers"]
+        for target, ab in stacks.items():
+            ab["a"] = jax.device_put(ab["a"], NamedSharding(mesh, specs[target]["a"]))
+            ab["b"] = jax.device_put(ab["b"], NamedSharding(mesh, specs[target]["b"]))
+    return AdapterBank(names=names, stacks={"layers": stacks}, rank=rank)

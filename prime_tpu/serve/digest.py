@@ -196,6 +196,31 @@ class HotPrefixDigest:
 
 REPLICA_ROLES = ("prefill", "decode", "any")
 
+# router-side retention cap for advertised adapter names (multi-LoRA
+# serving): same cannot-balloon-memory contract as RETAIN_MAX_ENTRIES
+RETAIN_MAX_ADAPTERS = 1024
+# a name longer than this is junk, not an adapter id
+MAX_ADAPTER_NAME_LEN = 128
+
+
+def parse_adapters(value: object) -> frozenset[str]:
+    """Tolerant /healthz ``adapters`` parse (multi-LoRA serving): replicas
+    that predate the field omit it, partial rollouts may send junk — either
+    degrades to the empty set (the pre-multi-LoRA behavior: no adapter
+    affinity, base-only routing), never a poll failure. Junk entries are
+    skipped individually; retention is capped so a misbehaving replica
+    cannot balloon router memory through the advertisement."""
+    if not isinstance(value, (list, tuple)):
+        return frozenset()
+    out: set[str] = set()
+    for name in value:
+        if not isinstance(name, str) or not name or len(name) > MAX_ADAPTER_NAME_LEN:
+            continue
+        out.add(name)
+        if len(out) >= RETAIN_MAX_ADAPTERS:
+            break
+    return frozenset(out)
+
 
 def parse_role(value: object) -> str:
     """Tolerant /healthz ``role`` parse (disaggregated serving): replicas

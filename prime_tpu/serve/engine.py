@@ -56,6 +56,19 @@ eligible, the SPMD-partitioned XLA path otherwise. The historical surface
 works and wins when both are given. See docs/architecture.md "Sharded
 replica".
 
+- **Batched multi-LoRA serving.** ``adapters={name: artifact dir}`` (or
+  ``PRIME_SERVE_ADAPTERS``) loads a registry of LoRA adapters UNMERGED into
+  a stacked device-resident A/B bank (serve/adapters.py); each slot carries
+  an int32 adapter index next to the paged KV state, and every adapted
+  projection fuses the gathered ``y += (x @ A[idx]) @ B'[idx]`` delta into
+  the existing donated decode/spec/chunk-prefill dispatches — a
+  mixed-adapter wave runs as ONE program, riding the overlap pipeline,
+  speculative mode, and the sharded mesh unchanged. Admission is
+  per-tenant fair (round-robin across per-adapter buckets, optional
+  ``adapter_max_inflight`` cap), and the prefix cache keys each adapter's
+  paths in a salted token space so cross-adapter KV reuse is impossible.
+  See docs/architecture.md "Multi-LoRA serving".
+
 - **Block-granular prefix reuse.** Prompt prefixes are cached in a radix
   tree of MIN_BUCKET-aligned KV segments (serve/prefix_cache.py) under a
   byte budget (``--prefix-cache-mb`` / ``PRIME_SERVE_PREFIX_CACHE_MB``):
@@ -95,6 +108,12 @@ from prime_tpu.serve.prefix_cache import BlockPrefixCache
 
 MIN_BUCKET = 16
 NEG_INF = -1e30
+# multi-LoRA prefix-key salt: a cached KV segment is only valid under the
+# adapter that computed it, so non-base adapters store/match radix paths in
+# a disjoint token-key space (token + idx*STRIDE — vocab ids never reach the
+# stride, so adapters can never collide with each other or with base paths,
+# and base traffic keeps byte-identical cache keys to a bankless engine)
+ADAPTER_KEY_STRIDE = 1 << 32
 # default byte budget for the radix prefix-KV cache: roughly what the old
 # 4-entry whole-row list held for a 1B model at 2048-slot rows
 DEFAULT_PREFIX_CACHE_MB = 256.0
@@ -236,6 +255,10 @@ class EngineRequest:
     max_new_tokens: int
     temperature: float
     top_p: float
+    # multi-LoRA: the adapter this request selected (None = base) and its
+    # resolved bank slot (0 = base) — per-slot gathered matmuls key on it
+    adapter: str | None = None
+    adapter_idx: int = 0
     events: queue.Queue = field(default_factory=queue.Queue)
     emitted: int = 0
     slot: int = -1
@@ -317,6 +340,8 @@ class ContinuousBatchingEngine:
         warmup: bool | None = None,
         max_queue: int | None = None,
         prefix_store_all: bool = False,
+        adapters: Any = None,
+        adapter_max_inflight: int | None = None,
         registry: Registry | None = None,
     ) -> None:
         import jax
@@ -379,6 +404,55 @@ class ContinuousBatchingEngine:
         # does not plumb the scale epilogue yet)
         self.attn_impl = attn_impl
         self.kv_quant = kv_quant
+        # multi-LoRA adapter bank (serve/adapters.py, docs/architecture.md
+        # "Multi-LoRA serving"): a {name: artifact dir} registry (or a
+        # "name=path,..." spec string; None reads PRIME_SERVE_ADAPTERS)
+        # loads UNMERGED into stacked (L, A, ...) device buffers — every
+        # adapted projection runs y = x@W + (x@A[idx])@B'[idx] with idx the
+        # per-slot int32 adapter index living next to the paged KV state,
+        # so a mixed-adapter wave is ONE program. Bank slot 0 is the
+        # all-zeros base adapter: a bankless engine and base traffic on a
+        # banked engine emit bit-identical tokens. An AdapterBank instance
+        # passes through as-is (tests build tiny banks directly).
+        from prime_tpu.serve.adapters import AdapterBank, load_adapter_bank, parse_adapter_spec
+
+        if adapters is None:
+            adapters = env_str("PRIME_SERVE_ADAPTERS", "")
+        if isinstance(adapters, str):
+            adapters = parse_adapter_spec(adapters)
+        if isinstance(adapters, AdapterBank):
+            self.adapter_bank: AdapterBank | None = adapters
+        elif adapters:
+            self.adapter_bank = load_adapter_bank(
+                adapters, self.params, config, mesh=mesh,
+                dtype=jax.tree_util.tree_leaves(self.params)[0].dtype,
+            )
+        else:
+            self.adapter_bank = None
+        # the stacks pytree every compiled program takes next to params
+        # (None = empty pytree: the jitted signatures stay uniform and XLA
+        # prunes the unused adapter-id input on bankless engines)
+        self._adapters = self.adapter_bank.stacks if self.adapter_bank else None
+        # per-tenant fair admission (the PR 4 queue gates, one level down):
+        # with a bank, _pop_pending drains the ingress queue into per-adapter
+        # buckets and round-robins across them, skipping adapters already at
+        # adapter_max_inflight admitted slots (0 = uncapped). None reads
+        # PRIME_SERVE_ADAPTER_MAX_INFLIGHT.
+        if adapter_max_inflight is None:
+            adapter_max_inflight = env_int("PRIME_SERVE_ADAPTER_MAX_INFLIGHT", 0)
+        self.adapter_max_inflight = max(0, int(adapter_max_inflight))
+        # fairness buckets: adapter idx -> FIFO of popped-but-unadmitted
+        # requests, plus the round-robin cursor. The DICT is fixed at
+        # construction (one bucket per bank slot, never inserted into or
+        # deleted from): queue_depth()/drained read it from HTTP handler
+        # threads while the engine thread mutates the deques, and a
+        # size-stable dict is what makes those cross-thread iterations safe
+        # (deque append/popleft/len are atomic under the GIL).
+        self._fair: dict[int, deque[EngineRequest]] = {
+            i: deque() for i in range(len(self.adapter_bank or ()))
+        }
+        self._fair_rr = 0
+        self._burst_pops: dict[int, int] = {}  # reset per _admit wave
         # prompt-lookup speculation: each spec chunk is ONE fused dispatch —
         # propose draft_len n-gram drafts per slot from the slot's device-
         # resident history ring, run one (S, D+1) verify forward, and fold
@@ -610,6 +684,34 @@ class ContinuousBatchingEngine:
         self._m_batched_waves = r.counter(
             "serve_batched_admission_waves_total", "Multi-request admission prefills"
         )
+        # multi-LoRA serving (docs/architecture.md "Multi-LoRA serving"):
+        # bank width, per-tenant token attribution, and the per-tenant
+        # queue-wait/TTFT splits fair admission is judged by. The labeled
+        # families only ever grow series on engines that loaded a bank
+        # (label cardinality is the bank width, bounded at load).
+        self._m_adapters_loaded = r.gauge(
+            "serve_adapters_loaded",
+            "LoRA adapters resident in the serving bank (base excluded)",
+        )
+        self._m_adapters_loaded.set(
+            len(self.adapter_bank.adapter_names) if self.adapter_bank else 0
+        )
+        self._m_adapter_tokens = r.counter(
+            "serve_adapter_tokens_total",
+            "Decoded tokens delivered, by serving adapter (base included)",
+            labelnames=("adapter",),
+        )
+        self._m_adapter_queue_wait = r.histogram(
+            "serve_adapter_queue_wait_seconds",
+            "Submit to admission-start wait per request, by serving adapter "
+            "(the per-tenant fairness split of serve_queue_wait_seconds)",
+            labelnames=("adapter",),
+        )
+        self._m_adapter_ttft = r.histogram(
+            "serve_adapter_ttft_seconds",
+            "Submit to first emitted token per request, by serving adapter",
+            labelnames=("adapter",),
+        )
         self._m_active_slots = r.gauge("serve_active_slots", "Slots decoding right now")
         self._m_queue_depth = r.gauge("serve_queue_depth", "Requests waiting for a slot")
         self._m_queue_wait = r.histogram(
@@ -782,6 +884,11 @@ class ContinuousBatchingEngine:
         self._last = jnp.zeros((self.max_slots,), dtype=jnp.int32)
         self._temps = jnp.zeros((self.max_slots,), dtype=jnp.float32)
         self._top_ps = jnp.ones((self.max_slots,), dtype=jnp.float32)
+        # multi-LoRA: each slot's adapter bank index, updated by finalize
+        # exactly like the sampling vectors (0 = base; stale values on
+        # retired slots are harmless — their outputs are discarded and the
+        # next admission overwrites the slot)
+        self._adapter_slots = jnp.zeros((self.max_slots,), dtype=jnp.int32)
         # speculative decoding: the device-resident per-slot token history
         # ring (prompt + decoded so far) the fused spec program drafts from —
         # updated INSIDE the program, seeded at admission, never read back to
@@ -917,7 +1024,7 @@ class ContinuousBatchingEngine:
         row_constraint = self._row_constraint()
         constrain = self._constrain_row_fields
 
-        def chunk_prefill(params, row, tokens, offset, last_in_chunk):
+        def chunk_prefill(params, adapters, row, tokens, offset, last_in_chunk, wave_ids):
             # write-at-offset + attend-over-row (models.llama chunked prefill):
             # the staging row pytree is donated, so chunks update it in place
             # (scale leaves ride along on int8 caches). Only ONE position's
@@ -925,16 +1032,20 @@ class ContinuousBatchingEngine:
             # gather it before the unembedding: a (1, chunk, V) fp32 logits
             # buffer plus chunk x the head FLOPs per chunk would be pure waste
             # on the admission hot path (non-final chunks' logits are unused).
+            # wave_ids are the wave members' adapter bank slots: the staged
+            # KV is computed UNDER each request's adapter, which is why the
+            # prefix cache keys adapter paths in a salted token space.
             logits, row = forward(
                 params, tokens, config, cache=row, decode=False,
                 attn_impl=attn_impl, prefill_offset=offset,
                 last_positions=last_in_chunk, mesh=mesh,
+                adapters=adapters, adapter_ids=wave_ids,
             )
             # sharded replica: pin the staged row's kv-head/tp placement so
             # the prefix segments sliced from it stay sharded in the cache
             return constrain(row, row_constraint), logits
 
-        return jax.jit(chunk_prefill, donate_argnums=(1,))
+        return jax.jit(chunk_prefill, donate_argnums=(2,))
 
     def _make_decode(self):
         import jax
@@ -946,7 +1057,7 @@ class ContinuousBatchingEngine:
         mesh = self.mesh
         cache_spec = self._cache_constraint()
 
-        def decode(params, cache, last, temps, top_ps, active, rng):
+        def decode(params, adapters, cache, last, temps, top_ps, active, adapter_slots, rng):
             # neutralize retired slots' stale sampling params: a finished
             # nucleus request must not keep the vocab-sort branch live for
             # later greedy-only traffic (outputs of inactive slots are
@@ -965,6 +1076,8 @@ class ContinuousBatchingEngine:
                     decode=True,
                     attn_impl=attn_impl,
                     mesh=mesh,
+                    adapters=adapters,
+                    adapter_ids=adapter_slots,
                 )
                 if cache_spec is not None:
                     new_cache = new_cache._replace(
@@ -994,7 +1107,7 @@ class ContinuousBatchingEngine:
             )
             return cache, tok, toks.T  # toks (S, T)
 
-        return jax.jit(decode, donate_argnums=(1, 2))
+        return jax.jit(decode, donate_argnums=(2, 3))
 
     def _make_spec_decode(self):
         """The fused device-resident speculative step: n-gram draft proposal
@@ -1019,7 +1132,10 @@ class ContinuousBatchingEngine:
         cache_spec = self._cache_constraint()
         hist_spec = self._hist_constraint()
 
-        def spec_decode(params, cache, hist, hist_len, last, temps, top_ps, active, rng):
+        def spec_decode(
+            params, adapters, cache, hist, hist_len, last, temps, top_ps,
+            active, adapter_slots, rng,
+        ):
             temps = jnp.where(active, temps, 0.0)
             top_ps = jnp.where(active, top_ps, 1.0)
             # device-side prompt-lookup: copy the tokens after the most
@@ -1032,6 +1148,7 @@ class ContinuousBatchingEngine:
             logits, new_cache = forward(
                 params, window, config, cache=cache, decode=False,
                 attn_impl=attn_impl, prefill_offset=offsets, mesh=mesh,
+                adapters=adapters, adapter_ids=adapter_slots,
             )
             if cache_spec is not None:
                 constrained = {
@@ -1076,7 +1193,7 @@ class ContinuousBatchingEngine:
             new_hist_len = hist_len + run_len
             return new_cache, new_hist, new_hist_len, last_out, tokens_round, run_len
 
-        return jax.jit(spec_decode, donate_argnums=(1, 2, 3, 4))
+        return jax.jit(spec_decode, donate_argnums=(2, 3, 4, 5))
 
     def _make_hist_seed(self):
         """One jitted program per admission-wave width: write each admitted
@@ -1136,8 +1253,9 @@ class ContinuousBatchingEngine:
             (
                 self._cache, self._hist, self._hist_len, self._last, toks, run_len,
             ) = self._spec_fn(
-                self.params, self._cache, self._hist, self._hist_len, self._last,
-                self._temps, self._top_ps, jnp.asarray(mask), rng,
+                self.params, self._adapters, self._cache, self._hist,
+                self._hist_len, self._last, self._temps, self._top_ps,
+                jnp.asarray(mask), self._adapter_slots, rng,
             )
         self._inflight.append(
             _InflightChunk(
@@ -1236,8 +1354,8 @@ class ContinuousBatchingEngine:
             inactive = jnp.zeros((self.max_slots,), dtype=bool)
             warm_rng, rng = jax.random.split(warm_rng)
             self._cache, self._last, toks = self._decode_fn(
-                self.params, self._cache, self._last,
-                self._temps, self._top_ps, inactive, rng,
+                self.params, self._adapters, self._cache, self._last,
+                self._temps, self._top_ps, inactive, self._adapter_slots, rng,
             )
             jax.block_until_ready(toks)
             dispatches += 1
@@ -1246,8 +1364,9 @@ class ContinuousBatchingEngine:
                 (
                     self._cache, self._hist, self._hist_len, self._last, toks, _,
                 ) = self._spec_fn(
-                    self.params, self._cache, self._hist, self._hist_len,
-                    self._last, self._temps, self._top_ps, inactive, rng,
+                    self.params, self._adapters, self._cache, self._hist,
+                    self._hist_len, self._last, self._temps, self._top_ps,
+                    inactive, self._adapter_slots, rng,
                 )
                 jax.block_until_ready(toks)
                 dispatches += 1
@@ -1289,21 +1408,24 @@ class ContinuousBatchingEngine:
                         # same program every real plan offset hits
                         tokens = jnp.full((n, size), self.pad_id, dtype=jnp.int32)
                         row, logits = self._chunk_fn(
-                            self.params, row, tokens,
+                            self.params, self._adapters, row, tokens,
                             jnp.asarray(0, dtype=jnp.int32),
+                            jnp.zeros((n,), dtype=jnp.int32),
                             jnp.zeros((n,), dtype=jnp.int32),
                         )
                         dispatches += 1
                     warm_rng, rng = jax.random.split(warm_rng)
                     (
-                        self._cache, self._last, self._temps, self._top_ps, firsts,
+                        self._cache, self._last, self._temps, self._top_ps,
+                        self._adapter_slots, firsts,
                     ) = self._finalize_batch_fn(
                         self._cache, self._last, self._temps, self._top_ps,
-                        row, logits,
+                        self._adapter_slots, row, logits,
                         jnp.zeros((n,), dtype=jnp.int32),
                         jnp.arange(n, dtype=jnp.int32),
                         jnp.zeros((n,), dtype=jnp.float32),
                         jnp.ones((n,), dtype=jnp.float32),
+                        jnp.zeros((n,), dtype=jnp.int32),
                         rng,
                     )
                     jax.block_until_ready(firsts)
@@ -1349,13 +1471,27 @@ class ContinuousBatchingEngine:
         temperature: float = 0.0,
         top_p: float = 1.0,
         trace: TraceContext | None = None,
+        adapter: str | None = None,
     ) -> EngineRequest:
         if not prompt_ids:
             raise ValueError("empty prompt")
         if self._draining:
             raise DrainingError("engine is draining; not accepting new requests")
+        # multi-LoRA: resolve the adapter name to its bank slot up front so
+        # an unknown name fails on the submitting thread (the server maps it
+        # to a 404 on the OpenAI `model` field), never inside the loop
+        adapter_idx = 0
+        if adapter is not None and adapter != "base":
+            if self.adapter_bank is None:
+                raise ValueError(
+                    f"no adapter bank loaded; cannot serve adapter {adapter!r}"
+                )
+            try:
+                adapter_idx = self.adapter_bank.index_of(adapter)
+            except KeyError as e:
+                raise ValueError(str(e)) from None
         if self.max_queue:
-            depth = self._pending.qsize() + len(self._requeued)
+            depth = self.queue_depth()
             if depth >= self.max_queue:
                 raise QueueFullError(
                     f"pending queue is full ({depth}/{self.max_queue})",
@@ -1381,6 +1517,8 @@ class ContinuousBatchingEngine:
             max_new_tokens=max_new_tokens,
             temperature=temperature,
             top_p=top_p,
+            adapter=adapter if adapter_idx else None,
+            adapter_idx=adapter_idx,
             submitted_at=time.monotonic(),
             trace=trace,
         )
@@ -1389,6 +1527,7 @@ class ContinuousBatchingEngine:
             trace_id=trace.trace_id if trace is not None else None,
             prompt_tokens=len(prompt_ids),
             max_new_tokens=max_new_tokens,
+            **({"adapter": adapter} if adapter_idx else {}),
         )
         self._pending.put(req)
         self._wake.set()
@@ -1401,10 +1540,21 @@ class ContinuousBatchingEngine:
         usable Retry-After and a pathological backlog cannot tell clients to
         go away for an hour."""
         if depth is None:
-            depth = self._pending.qsize() + len(self._requeued)
+            depth = self.queue_depth()
         per_wave = self._m_queue_wait.mean(default=1.0)
         waves = (depth + 1) / max(1, self.max_slots)
         return max(0.1, min(60.0, per_wave * waves))
+
+    def queue_depth(self) -> int:
+        """Requests accepted but not yet admitted: the ingress queue, the
+        requeued head, and (multi-LoRA) the per-adapter fairness buckets the
+        engine thread drains the ingress into — all three must count, or a
+        bucketed burst would make max_queue/drained lie."""
+        return (
+            self._pending.qsize()
+            + len(self._requeued)
+            + sum(len(dq) for dq in self._fair.values())
+        )
 
     def drain(self) -> None:
         """Stop taking new work (submit() raises DrainingError) while the
@@ -1435,6 +1585,8 @@ class ContinuousBatchingEngine:
             return False
         if not self._pending.empty() or self._requeued:
             return False
+        if any(self._fair.values()):
+            return False  # fairness buckets hold popped-but-unadmitted work
         if self._requests or self._inflight:
             return False
         return not self._tick_busy
@@ -1467,13 +1619,26 @@ class ContinuousBatchingEngine:
             self._thread.join(timeout=60)
             self._thread = None
         # fail everything still waiting so clients get a prompt error instead
-        # of hanging until their events.get timeout
+        # of hanging until their events.get timeout. The flush bypasses the
+        # fair scheduler's caps: a capped tenant's bucketed backlog must be
+        # failed too, not leaked to its clients' timeouts.
         self._fail_in_flight("engine shut down")
+
+        def flush():
+            if self._requeued:
+                return self._requeued.popleft()
+            return self._pending.get_nowait()
+
+        pending_reqs: list[EngineRequest | None] = []
         while True:
             try:
-                req = self._pop_pending()
+                pending_reqs.append(flush())
             except queue.Empty:
                 break
+        for dq in self._fair.values():
+            pending_reqs.extend(dq)
+            dq.clear()  # empty the deques, never the dict (see _fair's note)
+        for req in pending_reqs:
             if req is not None:
                 req.error = "engine shut down"
                 req.done = True
@@ -1549,10 +1714,60 @@ class ContinuousBatchingEngine:
     def _pop_pending(self) -> EngineRequest | None:
         """The ONE owner of admission-drain order: requeued head first, then
         the pending queue. Raises queue.Empty when both are drained; may
-        return the None shutdown sentinel (callers skip it)."""
+        return the None shutdown sentinel (callers skip it).
+
+        Multi-LoRA engines interpose the per-tenant fair scheduler: the
+        ingress queue drains into per-adapter FIFO buckets (engine thread
+        only) and requests pop round-robin across adapters, skipping any
+        adapter already holding ``adapter_max_inflight`` admitted slots —
+        one tenant's burst can no longer starve every other tenant's
+        admission, and a capped tenant's backlog waits in its bucket
+        without blocking the rotation."""
         if self._requeued:
             return self._requeued.popleft()
-        return self._pending.get_nowait()
+        if self.adapter_bank is None:
+            return self._pending.get_nowait()
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            if req is None:
+                return None  # shutdown sentinel: callers skip it
+            self._fair[req.adapter_idx].append(req)
+        return self._fair_pop()
+
+    def _fair_pop(self) -> EngineRequest:
+        """Round-robin pop across the non-empty per-adapter buckets,
+        honoring the per-adapter inflight cap (0 = uncapped). Raises
+        queue.Empty when nothing is poppable — capped tenants' requests
+        stay bucketed (still counted by queue_depth/drained) until a
+        retirement frees their budget."""
+        order = sorted(idx for idx, dq in self._fair.items() if dq)
+        if not order:
+            raise queue.Empty
+        cap = self.adapter_max_inflight
+        inflight: dict[int, int] = {}
+        if cap:
+            # admitted slots PLUS pops earlier in this same admission burst
+            # (they are not in _requests yet but will be): without the
+            # burst-local counts, one _admit wave could blow past the cap
+            for live in self._requests.values():
+                inflight[live.adapter_idx] = inflight.get(live.adapter_idx, 0) + 1
+            for idx, count in self._burst_pops.items():
+                inflight[idx] = inflight.get(idx, 0) + count
+        n = len(order)
+        for i in range(n):
+            pos = (self._fair_rr + i) % n
+            idx = order[pos]
+            if cap and inflight.get(idx, 0) >= cap:
+                continue
+            self._fair_rr = pos + 1  # next pop starts past the served tenant
+            req = self._fair[idx].popleft()
+            if cap:
+                self._burst_pops[idx] = self._burst_pops.get(idx, 0) + 1
+            return req
+        raise queue.Empty
 
     def tick(self) -> bool:
         """One engine iteration. Returns False when there was nothing to do.
@@ -1646,8 +1861,9 @@ class ContinuousBatchingEngine:
             "serve.dispatch", seq=seq, steps=self.chunk, **self._span_mesh
         ), self._mesh_ctx():
             self._cache, self._last, toks = self._decode_fn(
-                self.params, self._cache, self._last,
-                self._temps, self._top_ps, jnp.asarray(mask), rng,
+                self.params, self._adapters, self._cache, self._last,
+                self._temps, self._top_ps, jnp.asarray(mask),
+                self._adapter_slots, rng,
             )
         self._inflight.append(
             _InflightChunk(
@@ -1725,6 +1941,7 @@ class ContinuousBatchingEngine:
 
     def _admit(self) -> bool:
         admitted = False
+        self._burst_pops = {}  # fairness cap: fresh burst-local counts
         while True:
             free = [s for s in range(self.max_slots) if not self._active[s]]
             if not free:
@@ -1767,7 +1984,7 @@ class ContinuousBatchingEngine:
                     self._retire_flight(req, "failed", error=str(e)[:200])
                     req.events.put(None)
                     continue
-                if self._prefix_match_len(ids) > 0:
+                if self._prefix_match_len(self._prefix_key(ids, req.adapter_idx)) > 0:
                     singles.append(req)
                 else:
                     plan = tuple(chunk_plan(0, len(ids), self.prefill_chunk, row_cb))
@@ -1816,11 +2033,17 @@ class ContinuousBatchingEngine:
         if req.submitted_at:
             wait = t_start - req.submitted_at
             self._m_queue_wait.observe(wait)
+            if self.adapter_bank is not None:
+                self._m_adapter_queue_wait.observe(
+                    wait, adapter=req.adapter or "base"
+                )
             TRACER.emit("serve.queue_wait", wait, context=req.trace, request=req.id)
         req.admitted_at = t_start
         self.flight.event(req.id, "admitted", slot=slot)
         row_cb = row_capacity_for(len(ids), self.prefill_chunk, self.capacity)
-        start, row = self._prefix_seed(ids, row_cb, ctx=req.trace)
+        start, row = self._prefix_seed(
+            self._prefix_key(ids, req.adapter_idx), row_cb, ctx=req.trace
+        )
         plan = chunk_plan(start, len(ids), self.prefill_chunk, row_cb)
         logits = None
         self._rng, rng = jax.random.split(self._rng)
@@ -1838,19 +2061,24 @@ class ContinuousBatchingEngine:
                 # chunks' gathers in bounds
                 rel = min(max(len(ids) - 1 - off, 0), size - 1)
                 row, logits = self._chunk_fn(
-                    self.params, row, tokens, jnp.asarray(off, dtype=jnp.int32),
+                    self.params, self._adapters, row, tokens,
+                    jnp.asarray(off, dtype=jnp.int32),
                     jnp.asarray([rel], dtype=jnp.int32),
+                    jnp.asarray([req.adapter_idx], dtype=jnp.int32),
                 )
             # the batch finalize IS the single finalize at n=1 — one owner
             # of the splice/sample/bookkeeping semantics
             (
-                self._cache, self._last, self._temps, self._top_ps, firsts,
+                self._cache, self._last, self._temps, self._top_ps,
+                self._adapter_slots, firsts,
             ) = self._finalize_batch_fn(
-                self._cache, self._last, self._temps, self._top_ps, row, logits,
+                self._cache, self._last, self._temps, self._top_ps,
+                self._adapter_slots, row, logits,
                 jnp.asarray([len(ids)], dtype=jnp.int32),
                 jnp.asarray([slot], dtype=jnp.int32),
                 jnp.asarray([req.temperature], dtype=jnp.float32),
                 jnp.asarray([req.top_p], dtype=jnp.float32),
+                jnp.asarray([req.adapter_idx], dtype=jnp.int32),
                 rng,
             )
         if self.speculative:
@@ -1867,7 +2095,7 @@ class ContinuousBatchingEngine:
             prefix_hit_tokens=start,
         )
         self._m_admit_batch.observe(1)
-        self._store_prefix(ids, row)
+        self._store_prefix(self._prefix_key(ids, req.adapter_idx), row)
         self._m_admitted.inc()
         req.slot = slot
         self._active[slot] = True
@@ -1905,6 +2133,10 @@ class ContinuousBatchingEngine:
             if req.submitted_at:
                 wait = t_start - req.submitted_at
                 self._m_queue_wait.observe(wait)
+                if self.adapter_bank is not None:
+                    self._m_adapter_queue_wait.observe(
+                        wait, adapter=req.adapter or "base"
+                    )
                 TRACER.emit("serve.queue_wait", wait, context=req.trace, request=req.id)
             req.admitted_at = t_start
             self.flight.event(req.id, "admitted", slot=slot, wave=n)
@@ -1925,17 +2157,22 @@ class ContinuousBatchingEngine:
                     rels.append(min(max(len(ids) - 1 - off, 0), size - 1))
                 tokens = jnp.asarray(chunk_rows, dtype=jnp.int32)
                 row, logits = self._chunk_fn(
-                    self.params, row, tokens, jnp.asarray(off, dtype=jnp.int32),
+                    self.params, self._adapters, row, tokens,
+                    jnp.asarray(off, dtype=jnp.int32),
                     jnp.asarray(rels, dtype=jnp.int32),
+                    jnp.asarray([r.adapter_idx for r in reqs], dtype=jnp.int32),
                 )
             (
-                self._cache, self._last, self._temps, self._top_ps, firsts,
+                self._cache, self._last, self._temps, self._top_ps,
+                self._adapter_slots, firsts,
             ) = self._finalize_batch_fn(
-                self._cache, self._last, self._temps, self._top_ps, row, logits,
+                self._cache, self._last, self._temps, self._top_ps,
+                self._adapter_slots, row, logits,
                 jnp.asarray([len(r.prompt_ids) for r in reqs], dtype=jnp.int32),
                 jnp.asarray(slots, dtype=jnp.int32),
                 jnp.asarray([r.temperature for r in reqs], dtype=jnp.float32),
                 jnp.asarray([r.top_p for r in reqs], dtype=jnp.float32),
+                jnp.asarray([r.adapter_idx for r in reqs], dtype=jnp.int32),
                 rng,
             )
         if self.speculative:
@@ -1952,7 +2189,9 @@ class ContinuousBatchingEngine:
                 lambda x, i=i: x[:, i : i + 1] if x.ndim >= 2 else x[i : i + 1],
                 row,
             )
-            self._store_prefix(reqs[i].prompt_ids, row_i)
+            self._store_prefix(
+                self._prefix_key(reqs[i].prompt_ids, reqs[i].adapter_idx), row_i
+            )
         firsts_host = [int(t) for t in np.asarray(firsts)]  # host sync
         prefill_s = time.monotonic() - t_start
         prefill_ms = round(prefill_s * 1e3, 3)
@@ -1984,8 +2223,8 @@ class ContinuousBatchingEngine:
         cache_spec = self._cache_constraint()
 
         def finalize_batch(
-            cache, last, temps, top_ps, rows, logits, lengths, slots, temps_new,
-            top_ps_new, rng,
+            cache, last, temps, top_ps, adapter_slots, rows, logits, lengths,
+            slots, temps_new, top_ps_new, adapter_ids_new, rng,
         ):
             # splice every staged row (batch axis N on the rows' slot dim)
             # into the engine cache and sample all first tokens — one
@@ -2025,12 +2264,27 @@ class ContinuousBatchingEngine:
                 last.at[slots].set(firsts),
                 temps.at[slots].set(temps_new),
                 top_ps.at[slots].set(top_ps_new),
+                adapter_slots.at[slots].set(adapter_ids_new),
                 firsts,
             )
 
-        return jax.jit(finalize_batch, donate_argnums=(0, 1, 2, 3))
+        return jax.jit(finalize_batch, donate_argnums=(0, 1, 2, 3, 4))
 
     # ---- prompt-prefix KV reuse (block radix tree, serve/prefix_cache.py) ----
+
+    def _prefix_key(self, ids: list[int], adapter_idx: int) -> list[int]:
+        """The radix-tree key space for a request's prompt: raw token ids
+        for base traffic (byte-identical to a bankless engine), salted by
+        ``adapter_idx * ADAPTER_KEY_STRIDE`` for adapter traffic — cached KV
+        is only valid under the adapter that computed it, so each adapter's
+        paths live in a disjoint key space and a cross-adapter prefix hit is
+        impossible by construction. /admin/kv export/import stays in the
+        base space (adapter paths never ship over the disagg wire — a
+        migrated adapter request degrades to an honest cold resume)."""
+        if not adapter_idx:
+            return list(ids)
+        off = adapter_idx * ADAPTER_KEY_STRIDE
+        return [t + off for t in ids]
 
     def _prefix_match(self, ids: list[int]):
         """ONE owner of the prefix-hit math (clamp to len-1 so at least one
@@ -2340,8 +2594,8 @@ class ContinuousBatchingEngine:
             "serve.decode_chunk", steps=self.chunk, **self._span_mesh
         ), self._mesh_ctx():
             self._cache, self._last, toks = self._decode_fn(
-                self.params, self._cache, self._last,
-                self._temps, self._top_ps, active, rng,
+                self.params, self._adapters, self._cache, self._last,
+                self._temps, self._top_ps, active, self._adapter_slots, rng,
             )
             toks_host = np.asarray(toks)  # (S, T) — host sync inside the span
         self._m_decode_step_s.observe((time.monotonic() - t_start) / self.chunk)
@@ -2366,10 +2620,17 @@ class ContinuousBatchingEngine:
         if out:
             req.events.put(out)
             self._m_tokens.inc(len(out))
+            if self.adapter_bank is not None:
+                self._m_adapter_tokens.inc(len(out), adapter=req.adapter or "base")
             if not req.first_token_at:
                 req.first_token_at = time.monotonic()
                 if req.submitted_at:
                     self._m_ttft.observe(req.first_token_at - req.submitted_at)
+                    if self.adapter_bank is not None:
+                        self._m_adapter_ttft.observe(
+                            req.first_token_at - req.submitted_at,
+                            adapter=req.adapter or "base",
+                        )
                     self.flight.event(
                         req.id, "first_token",
                         ttft_ms=round(
@@ -2421,7 +2682,7 @@ class ContinuousBatchingEngine:
         snapshot stats() serves to other threads. Called at the end of every
         tick() by the engine loop (and directly by synchronous owners)."""
         self._m_active_slots.set(int(self._active.sum()))
-        self._m_queue_depth.set(self._pending.qsize() + len(self._requeued))
+        self._m_queue_depth.set(self.queue_depth())
         if self.prefix_cache is not None:
             self._sync_prefix_metrics()
             now = time.monotonic()
@@ -2456,6 +2717,10 @@ class ContinuousBatchingEngine:
             "max_queue": int(self.max_queue),
             "mesh_devices": int(self.mesh_devices),
             "mesh_axes": dict(self.mesh_axes),
+            "adapters_loaded": int(values["serve_adapters_loaded"]),
+            "adapters": list(
+                self.adapter_bank.adapter_names if self.adapter_bank else ()
+            ),
             "state": "draining" if self._draining else "running",
             "overlap": bool(self.overlap),
             "speculative": bool(self.speculative),
@@ -2510,6 +2775,16 @@ class EngineBackend:
         cacheless replica advertising prompts it cannot assemble would
         steal cache-aware reroutes it then serves with a full recompute."""
         return self.engine.prefix_cache is not None
+
+    @property
+    def adapter_names(self) -> tuple[str, ...]:
+        """Loaded multi-LoRA adapter names (base excluded): the server's
+        model registry resolves the OpenAI ``model`` field against these,
+        /v1/models lists them, and /healthz advertises them so the fleet
+        balancer can route adapter traffic to a replica that holds the
+        adapter (docs/architecture.md "Multi-LoRA serving")."""
+        bank = self.engine.adapter_bank
+        return bank.adapter_names if bank is not None else ()
 
     def export_kv_text(self, prompt: str) -> bytes | None:
         """GET /admin/kv?prompt=…: tokenize exactly like submit_text's
@@ -2572,6 +2847,7 @@ class EngineBackend:
         top_p: float = 1.0,
         templated: bool = False,
         trace: TraceContext | None = None,
+        adapter: str | None = None,
     ) -> EngineRequest:
         ids = self.tokenizer.encode(prompt, add_special_tokens=not templated)
         # keep the tail if the prompt exceeds what the slot can hold
@@ -2584,7 +2860,7 @@ class EngineBackend:
             )
         return self.engine.submit(
             ids[-keep:], max_new_tokens=max_new_tokens,
-            temperature=temperature, top_p=top_p, trace=trace,
+            temperature=temperature, top_p=top_p, trace=trace, adapter=adapter,
         )
 
     def stream_text(self, req: EngineRequest, timeout: float | None = 120.0):
@@ -2616,9 +2892,12 @@ class EngineBackend:
         top_p: float = 1.0,
         templated: bool = False,
         trace: TraceContext | None = None,
+        adapter: str | None = None,
     ) -> list[str]:
         reqs = [
-            self.submit_text(p, max_new_tokens, temperature, top_p, templated, trace)
+            self.submit_text(
+                p, max_new_tokens, temperature, top_p, templated, trace, adapter
+            )
             for p in prompts
         ]
         return [self.tokenizer.decode(r.all_tokens()) for r in reqs]

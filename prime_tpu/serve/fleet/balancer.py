@@ -133,9 +133,14 @@ class Pick:
     hold its prefix KV); ``rerouted`` — it had a target but was diverted
     (saturation or exclusion); ``cache_routed`` — the diversion chose the
     replica advertising the longest cached prefix (``cached_blocks`` blocks
-    deep) instead of falling back blind to least-loaded."""
+    deep) instead of falling back blind to least-loaded; ``adapter_routed``
+    — the pool was narrowed to replicas advertising the request's adapter
+    (multi-LoRA affinity)."""
 
-    __slots__ = ("replica", "affinity", "hit", "rerouted", "cache_routed", "cached_blocks")
+    __slots__ = (
+        "replica", "affinity", "hit", "rerouted", "cache_routed",
+        "cached_blocks", "adapter_routed",
+    )
 
     def __init__(
         self,
@@ -145,6 +150,7 @@ class Pick:
         rerouted: bool,
         cache_routed: bool = False,
         cached_blocks: int = 0,
+        adapter_routed: bool = False,
     ) -> None:
         self.replica = replica
         self.affinity = affinity
@@ -152,6 +158,7 @@ class Pick:
         self.rerouted = rerouted
         self.cache_routed = cache_routed
         self.cached_blocks = cached_blocks
+        self.adapter_routed = adapter_routed
 
 
 def _load(replica: Replica) -> tuple:
@@ -187,15 +194,22 @@ class PrefixAffinityBalancer:
         prompt: "Sequence[int] | str | None",
         exclude: "set[str] | None" = None,
         role: str | None = None,
+        adapter: str | None = None,
     ) -> Pick | None:
         """Choose a replica for one request. ``exclude`` holds replica ids
         this request already failed against (connect error / upstream 429) —
         the retry must go elsewhere. ``role`` restricts the pool to replicas
         advertising that phase role (``"any"`` replicas serve every phase,
         so they always qualify) — the disaggregated router picks the prefill
-        and decode legs of a migration through this. Returns None when no
-        routable replica remains (the router then answers 503/429, or falls
-        back to colocated serving for a role-restricted pick)."""
+        and decode legs of a migration through this. ``adapter`` adds
+        multi-LoRA affinity NEXT TO prefix affinity: when any routable
+        replica advertises the adapter in /healthz, the pool narrows to
+        those replicas (a replica without the adapter would 404 the request;
+        when none advertises it, the pool stays whole so a heterogeneous
+        rollout degrades to upstream 404s rather than router 503s). Returns
+        None when no routable replica remains (the router then answers
+        503/429, or falls back to colocated serving for a role-restricted
+        pick)."""
         exclude = exclude or set()
         routable = [
             r for r in self.membership.routable_replicas() if r.id not in exclude
@@ -204,6 +218,14 @@ class PrefixAffinityBalancer:
             routable = [
                 r for r in routable if getattr(r, "role", "any") in (role, "any")
             ]
+        adapter_routed = False
+        if adapter is not None:
+            holders = [
+                r for r in routable if adapter in getattr(r, "adapters", ())
+            ]
+            if holders:
+                routable = holders
+                adapter_routed = True
         if not routable:
             return None
         # prefer replicas with a closed breaker: a half-open one is a probe
@@ -217,12 +239,18 @@ class PrefixAffinityBalancer:
             else None
         )
         if key is None:
-            return Pick(min(pool, key=_load), affinity=False, hit=False, rerouted=False)
+            return Pick(
+                min(pool, key=_load), affinity=False, hit=False, rerouted=False,
+                adapter_routed=adapter_routed,
+            )
         self._ring.build(by_id.keys())
         order = self._ring.candidates(key)
         target = by_id[order[0]]
         if target.queue_depth <= self.saturation_depth:
-            return Pick(target, affinity=True, hit=True, rerouted=False)
+            return Pick(
+                target, affinity=True, hit=True, rerouted=False,
+                adapter_routed=adapter_routed,
+            )
         # saturated target: before falling back blind, probe the advertised
         # hot-prefix digests of the UNSATURATED candidates — a replica that
         # already holds this request's prefix KV serves it with an assemble
@@ -244,8 +272,10 @@ class PrefixAffinityBalancer:
                 return Pick(
                     best, affinity=True, hit=False, rerouted=True,
                     cache_routed=True, cached_blocks=best_depth,
+                    adapter_routed=adapter_routed,
                 )
         least = min(pool, key=_load)
         return Pick(
-            least, affinity=True, hit=least.id == target.id, rerouted=least.id != target.id
+            least, affinity=True, hit=least.id == target.id,
+            rerouted=least.id != target.id, adapter_routed=adapter_routed,
         )

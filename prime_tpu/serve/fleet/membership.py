@@ -51,7 +51,7 @@ import time
 from typing import Any, Callable, Iterable
 from urllib.parse import urlsplit
 
-from prime_tpu.serve.digest import parse_digest, parse_role
+from prime_tpu.serve.digest import parse_adapters, parse_digest, parse_role
 
 BREAKER_CLOSED = "closed"
 BREAKER_OPEN = "open"
@@ -108,6 +108,10 @@ class Replica:
         # "decode" / "any" — unknown/absent coerces to "any", the
         # every-phase role every replica had before the field existed
         self.role = "any"
+        # multi-LoRA adapter names as last advertised in /healthz: empty
+        # for replicas that predate the field or serve base-only — the
+        # balancer's adapter-affinity filter reads this
+        self.adapters: frozenset[str] = frozenset()
         # breaker
         self.breaker = BREAKER_CLOSED
         self.consecutive_failures = 0
@@ -125,6 +129,7 @@ class Replica:
             "max_slots": self.max_slots,
             "consecutive_failures": self.consecutive_failures,
             "digest_entries": len(self.digest),
+            "adapters": len(self.adapters),
             "last_poll_age_s": (
                 round(time.monotonic() - self.last_poll_at, 3) if self.last_poll_at else None
             ),
@@ -311,6 +316,9 @@ class FleetMembership:
             # a closed vocabulary so a misbehaving replica cannot balloon
             # router memory through it (parse_role mirrors parse_digest's cap)
             replica.role = parse_role(body.get("role"))
+            # multi-LoRA advertisement, same tolerance contract: junk or
+            # absent coerces to empty (base-only routing), capped retention
+            replica.adapters = parse_adapters(body.get("adapters"))
 
     def poll_once(self, replica: Replica) -> None:
         """One health probe: snapshot /healthz onto the replica, feed the

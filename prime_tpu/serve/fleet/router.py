@@ -167,8 +167,16 @@ class FleetRouter:
         read_timeout: float = 600.0,
         admin_token: str | None = None,
         membership: FleetMembership | None = None,
+        model_registry: "dict[str, str | None] | None" = None,
     ) -> None:
         self.model_id = model_id
+        # multi-LoRA model registry: explicit OpenAI `model` field aliases —
+        # name -> adapter id (None = base). Names NOT in the registry still
+        # resolve dynamically against the adapters replicas advertise in
+        # /healthz, so a fleet whose replicas load adapters needs no router
+        # config at all; the registry exists for deployments that want to
+        # alias marketing names onto adapter ids (or pin one to base).
+        self.model_registry = dict(model_registry or {})
         # gate for the mutating admin surface (/admin/join registers an
         # upstream that will then receive forwarded Authorization headers
         # and prompt bodies; /admin/drain evicts replicas): when set, those
@@ -229,6 +237,12 @@ class FleetRouter:
             "fleet_cache_routed_total",
             "Saturation fallbacks placed by advertised cached prefix "
             "(longest hot-prefix digest match) instead of blind least-loaded",
+        )
+        self._m_adapter_routed = r.counter(
+            "fleet_adapter_routed_total",
+            "Chat requests placed by multi-LoRA adapter affinity (pool "
+            "narrowed to replicas advertising the requested adapter)",
+            labelnames=("adapter",),
         )
         self._m_breaker = r.gauge(
             "fleet_breaker_state",
@@ -468,6 +482,26 @@ class FleetRouter:
             if isinstance(messages, list) and all(isinstance(m, dict) for m in messages)
             else None
         )
+        # multi-LoRA: resolve the OpenAI `model` field to an adapter id —
+        # explicit registry aliases first, then the names replicas advertise.
+        # A REGISTRY alias must also rewrite the forwarded body: the replica
+        # resolves the model field against its own adapter list, which knows
+        # the adapter id, not the router-side alias (an unrewritten alias
+        # would 404 on every replica). Dynamically resolved names ARE the
+        # replica-side ids and forward verbatim.
+        adapter = self._resolve_adapter(request.get("model"))
+        if (
+            isinstance(request.get("model"), str)
+            and request["model"] in self.model_registry
+        ):
+            request = dict(request)
+            if adapter is None:
+                # aliased to base: drop the field so the replica serves its
+                # own base model id whatever that id is
+                request.pop("model", None)
+            else:
+                request["model"] = adapter
+            raw = json.dumps(request).encode()
         # join the client's distributed trace (or start one): the SAME trace
         # id is forwarded to the replica and keys both processes' flight-
         # recorder timelines, so /debug/requests/{id} works fleet-wide with
@@ -513,11 +547,29 @@ class FleetRouter:
         outcome = "error"
         try:
             with TRACER.span("fleet.route", context=trace):
-                outcome = self._route_chat(handler, raw, request, prompt, headers, trace)
+                outcome = self._route_chat(
+                    handler, raw, request, prompt, headers, trace, adapter
+                )
         finally:
             self._gate.release()
             self._m_inflight.set(self._gate.inflight)
             self.flight.end(fkey, outcome)
+
+    def _resolve_adapter(self, model: object) -> str | None:
+        """Map the OpenAI ``model`` field to an adapter id (None = base):
+        the explicit ``model_registry`` wins; otherwise any adapter name a
+        routable replica currently advertises resolves to itself. Unknown
+        names resolve to base routing — the serving replica answers the 404
+        (it owns the authoritative model list), the router only places."""
+        if not isinstance(model, str) or not model or model == self.model_id:
+            return None
+        if model in self.model_registry:
+            return self.model_registry[model]
+        with self.membership._lock:
+            for replica in self.membership.replicas.values():
+                if model in replica.adapters:
+                    return model
+        return None
 
     def _route_chat(
         self,
@@ -527,6 +579,7 @@ class FleetRouter:
         prompt: str | None,
         headers: dict[str, str],
         trace: TraceContext,
+        adapter: str | None = None,
     ) -> str:
         """Pick → forward → (maybe) retry elsewhere. Retries only ever happen
         before a single response byte reached the client, so the request is
@@ -540,7 +593,11 @@ class FleetRouter:
         that leaves the client untouched falls back to this colocated loop."""
         fkey = _flight_key(trace)
         excluded: set[str] = set()
-        plan = self._disagg_plan(prompt)
+        # adapter traffic never migrates: adapter KV paths live in a salted
+        # key space that does not ship over the /admin/kv wire, so a
+        # phase-split would only ever resume cold — colocated adapter
+        # serving on an adapter-affine replica is strictly better
+        plan = self._disagg_plan(prompt) if adapter is None else None
         if plan is not None:
             outcome = self._migrate_chat(
                 handler, raw, request, prompt, headers, trace, *plan,
@@ -557,7 +614,7 @@ class FleetRouter:
         # one attempt per distinct replica, +1 for a half-open straggler that
         # routable_replicas only exposes after a cooldown lapses mid-loop
         for _ in range(len(self.membership.replicas) + 1):
-            pick = self.balancer.pick(prompt, excluded)
+            pick = self.balancer.pick(prompt, excluded, adapter=adapter)
             if pick is None:
                 break
             replica = pick.replica
@@ -565,6 +622,12 @@ class FleetRouter:
                 # affinity accounting covers the *placement* decision, once
                 # per request — retries are failover, not placement
                 first_attempt = False
+                if pick.adapter_routed and adapter is not None:
+                    self._m_adapter_routed.inc(adapter=adapter)
+                    self.flight.event(
+                        fkey, "adapter_route", adapter=adapter,
+                        replica=replica.id,
+                    )
                 if pick.affinity:
                     self._m_affinity_requests.inc()
                     if pick.hit:
@@ -1053,11 +1116,16 @@ class FleetRouter:
             series["labels"]["outcome"]: int(series["value"])
             for series in snapshot["fleet_migrations_total"]["series"]
         }
+        adapter_routed = {
+            series["labels"]["adapter"]: int(series["value"])
+            for series in snapshot["fleet_adapter_routed_total"]["series"]
+        }
         return {
             "affinity_requests": int(values["fleet_affinity_requests_total"]),
             "affinity_hits": int(values["fleet_affinity_hits_total"]),
             "affinity_hit_ratio": round(values["fleet_affinity_hit_ratio"], 4),
             "cache_routed": int(values["fleet_cache_routed_total"]),
+            "adapter_routed": adapter_routed,
             "migrations": migrations,
             "migrate_bytes": int(values["fleet_migrate_bytes_total"]),
             "admission_rejected": int(values["fleet_admission_rejected_total"]),

@@ -225,18 +225,43 @@ class PrefixMatch:
     the block; full except possibly the last entry). ``length`` is their sum
     and ``host_tokens`` the portion resident on the host spill tier at match
     time (``promote`` must run before ``segments()`` when it is non-zero).
-    Callers MUST ``release()`` the match once its segments have been read."""
+    Callers MUST ``release()`` the match once its segments have been read.
+
+    ``segments()`` reads the SNAPSHOT captured at pin time (refreshed by
+    ``promote``), not the live nodes: a concurrent store-path insert may
+    split a pinned node (``_split`` transfers the pin to both halves), and
+    the snapshot keeps the match's view of every segment and token run
+    intact across the reshape — the invariant that lets KV export move off
+    the engine loop. ``extra_pins`` are the lower split halves this match's
+    ``release()`` must also unpin."""
 
     length: int
     entries: list[tuple[_Node, int]] = field(default_factory=list)
     host_tokens: int = 0
+    segments_snapshot: list = field(default_factory=list)
+    tokens_snapshot: list = field(default_factory=list)
+    extra_pins: list = field(default_factory=list)
 
     @property
     def device_tokens(self) -> int:
         return self.length - self.host_tokens
 
     def segments(self) -> tuple[Any, ...]:
+        if self.segments_snapshot:
+            return tuple(self.segments_snapshot)
         return tuple(node.segment for node, _ in self.entries)
+
+    def tokens(self) -> list[int]:
+        """The matched token path (snapshot — immune to later splits)."""
+        if self.tokens_snapshot:
+            return [
+                int(t)
+                for run, (_, take) in zip(self.tokens_snapshot, self.entries)
+                for t in run[:take]
+            ]
+        return [
+            int(t) for node, take in self.entries for t in node.tokens[:take]
+        ]
 
     def takes(self) -> tuple[int, ...]:
         return tuple(take for _, take in self.entries)
@@ -287,6 +312,10 @@ class BlockPrefixCache:
         self.reupload_bytes = 0
         self.dedup_tokens = 0  # insert tokens already present (stored once)
         self.stored_tokens = 0  # insert tokens that allocated new segments
+        # live (pinned, unreleased) matches: _split consults this to transfer
+        # pins onto the lower half when it splits a pinned node — the list is
+        # a handful of entries at most (one per concurrent match/export)
+        self._active_matches: list[PrefixMatch] = []
 
     # ---- lookup ----
 
@@ -336,14 +365,28 @@ class BlockPrefixCache:
             node.last_used = stamp
             if node.tier == TIER_HOST:
                 host_tokens += take
-        return PrefixMatch(
+        match = PrefixMatch(
             length=sum(t for _, t in entries), entries=entries,
             host_tokens=host_tokens,
+            # pin-time snapshot: segments() and tokens() read these, so a
+            # concurrent insert's _split of a pinned node cannot change what
+            # this match assembles/serializes
+            segments_snapshot=[node.segment for node, _ in entries],
+            tokens_snapshot=[node.tokens for node, _ in entries],
         )
+        self._active_matches.append(match)
+        return match
 
     def release(self, match: PrefixMatch) -> None:
         for node, _ in match.entries:
             node.refs -= 1
+        for node in match.extra_pins:
+            node.refs -= 1
+        match.extra_pins = []
+        try:
+            self._active_matches.remove(match)
+        except ValueError:
+            pass  # hand-built match (tests) or double release
 
     def promote(self, match: PrefixMatch) -> tuple[int, int]:
         """Re-upload every host-resident segment on a PINNED match path back
@@ -358,12 +401,16 @@ class BlockPrefixCache:
         final rebalance settles the host tier the demotions grew."""
         promoted = promoted_bytes = 0
         heap: list[tuple[int, int, int, _Node]] | None = None
-        for node, _ in match.entries:
+        for i, (node, _) in enumerate(match.entries):
             if node.tier != TIER_HOST:
                 continue
             if self.budget_bytes > 0:
                 heap = self._demote_lru_until(self.budget_bytes - node.nbytes, heap)
             node.segment = self._to_device(node.segment)
+            if i < len(match.segments_snapshot):
+                # the match's pin-time snapshot must serve the PROMOTED
+                # (device) leaves to the assemble dispatch
+                match.segments_snapshot[i] = node.segment
             node.tier = TIER_DEVICE
             self.host_bytes -= node.nbytes
             self.host_nodes -= 1
@@ -429,12 +476,17 @@ class BlockPrefixCache:
         first block is unchanged); a new lower node takes the rest plus the
         original children. Byte accounting is conserved on the node's OWN
         tier: slot counts are linear, so upper+lower bytes == the original,
-        and both halves stay where the segment lives."""
-        # a pinned node's segment must stay intact until release() — the pin
-        # contract assemble relies on. The engine releases every pin before
-        # its store-path insert (same thread), so this is unreachable there;
-        # fail loudly rather than silently truncating a pinned segment.
-        assert node.refs == 0, "cannot split a node on a pinned match path"
+        and both halves stay where the segment lives.
+
+        PIN-AWARE: splitting a node on a live match path is legal. Matches
+        read pin-time SNAPSHOTS (the original uncut segment/token arrays
+        stay alive through the snapshot references), and the pins transfer —
+        the upper half keeps the node's refcount (same object) and the lower
+        half inherits one pin per live match entry referencing the node, so
+        the byte-budget LRU keeps treating the WHOLE pinned run as
+        unevictable until release(). This is what lets a store-path insert
+        land concurrently with an off-loop KV export's pinned serialization
+        (the PR 11 follow-up)."""
         # host-resident segments are host arrays (e.g. device_get numpy),
         # where a basic slice is a VIEW over the full base buffer: both
         # halves must materialize copies or evicting one half later frees
@@ -444,6 +496,19 @@ class BlockPrefixCache:
         copy = node.tier == TIER_HOST
         lower = _Node(node.tokens[m:], self._cut(node.segment, m, len(node.tokens), copy=copy), node)
         lower.tier = node.tier
+        if node.refs:
+            # transfer pins: each live match pin on this node — whether it
+            # pinned it directly (entries) or inherited it from an EARLIER
+            # split (extra_pins: a second insert may re-split a lower half)
+            # — also pins the new lower half (its snapshot spans both), and
+            # records it so release() unpins exactly what was pinned
+            for match in self._active_matches:
+                count = sum(1 for n, _ in match.entries if n is node) + sum(
+                    1 for n in match.extra_pins if n is node
+                )
+                if count:
+                    lower.refs += count
+                    match.extra_pins.extend([lower] * count)
         lower.children = node.children
         for c in lower.children.values():
             c.parent = lower
@@ -520,9 +585,14 @@ class BlockPrefixCache:
             tokens: list[int] = []
             manifests: list[dict] = []
             blobs: list[bytes] = []
-            for node, take in match.entries:
-                tokens.extend(int(t) for t in node.tokens[:take])
-                segment = node.segment
+            # read the pin-time snapshots, not the live nodes: a concurrent
+            # insert may split a pinned node mid-serialization (off-loop
+            # export) — the snapshot keeps this read consistent
+            runs = match.tokens_snapshot or [n.tokens for n, _ in match.entries]
+            for (node, take), run, segment in zip(
+                match.entries, runs, match.segments()
+            ):
+                tokens.extend(int(t) for t in run[:take])
                 items = (
                     sorted(segment.items())
                     if isinstance(segment, dict)

@@ -228,7 +228,13 @@ class InferenceServer:
                 if path in ("/v1/models", "/api/v1/models"):
                     self._json(
                         200,
-                        {"object": "list", "data": [{"id": outer.model_id, "object": "model"}]},
+                        {
+                            "object": "list",
+                            "data": [
+                                {"id": model, "object": "model"}
+                                for model in outer.model_ids()
+                            ],
+                        },
                     )
                 elif path in ("/metrics", "/v1/metrics"):
                     fmt = parse_qs(parts.query).get("format", [""])[0]
@@ -316,8 +322,20 @@ class InferenceServer:
                         self.end_headers()
                     else:
                         self._json(status, body)
-                elif path.rstrip("/").endswith(f"/models/{outer.model_id}"):
-                    self._json(200, {"id": outer.model_id, "object": "model"})
+                elif (
+                    matched := next(
+                        (
+                            model
+                            for model in outer.model_ids()
+                            if path.rstrip("/").endswith(f"/models/{model}")
+                        ),
+                        None,
+                    )
+                ) is not None:
+                    # echo the FULL matched id: HF-style ids (and adapter
+                    # names) may contain "/", so the last path segment alone
+                    # would truncate them
+                    self._json(200, {"id": matched, "object": "model"})
                 else:
                     self._json(404, {"error": {"message": f"no route {self.path}"}})
 
@@ -505,6 +523,13 @@ class InferenceServer:
 
     # -- observability --------------------------------------------------------
 
+    def model_ids(self) -> list[str]:
+        """Every model id this server answers to: the base model plus each
+        loaded multi-LoRA adapter name (EngineBackend.adapter_names — the
+        OpenAI ``model`` field selects the adapter). One owner for
+        /v1/models, the per-model GET, and _chat's resolution."""
+        return [self.model_id, *getattr(self.generator, "adapter_names", ())]
+
     def metrics(self) -> dict:
         """GET /metrics: server identity + the backing engine's counters
         (admissions, completions, tokens, prefix hits, batched waves, active
@@ -601,6 +626,14 @@ class InferenceServer:
                         payload["mesh"] = dict(stats["mesh_axes"])
             except Exception as e:  # noqa: BLE001 — health must never 500
                 payload["stats_error"] = str(e)[:200]
+        # ADDITIVE multi-LoRA advertisement: the adapters this replica can
+        # serve unmerged — the fleet balancer narrows adapter traffic to
+        # replicas advertising the name (membership.parse_adapters on the
+        # consuming side is as tolerant as the digest parse). Omitted for
+        # base-only replicas, exactly like the digest for cacheless ones.
+        adapter_names = tuple(getattr(self.generator, "adapter_names", ()) or ())
+        if adapter_names:
+            payload["adapters"] = list(adapter_names)
         # ADDITIVE hot-prefix advertisement (serve/digest.py): text-proxy
         # hashes of recently served chat prompts, merged with the engine's
         # exact id-block export when the backend has one. Routers that
@@ -787,8 +820,19 @@ class InferenceServer:
         ):
             return 400, {"error": {"message": "messages must be a non-empty list of objects"}}
         model = request.get("model") or self.model_id
+        # multi-LoRA model registry: the OpenAI `model` field selects a
+        # loaded adapter by name; the base model id stays the base. Unknown
+        # names 404 with the authoritative list.
+        adapter: str | None = None
         if model != self.model_id:
-            return 404, {"error": {"message": f"model {model!r} not served (have {self.model_id})"}}
+            if model in getattr(self.generator, "adapter_names", ()):
+                adapter = model
+            else:
+                return 404, {
+                    "error": {
+                        "message": f"model {model!r} not served (have {self.model_ids()})"
+                    }
+                }
         try:
             raw_max = request.get("max_tokens")
             max_tokens = 128 if raw_max is None else int(raw_max)
@@ -832,6 +876,8 @@ class InferenceServer:
             # thread the distributed trace down to the engine: its queue-wait
             # / prefill / per-request spans join the caller's trace id
             kwargs["trace"] = trace
+        if adapter is not None and _accepts_kwarg(self.generator.generate, "adapter"):
+            kwargs["adapter"] = adapter
         # continuous-batching backends stream live and batch across requests
         # themselves — no lock, no whole-turn wait
         if stream and hasattr(self.generator, "submit_text"):
@@ -841,6 +887,10 @@ class InferenceServer:
                 and _accepts_kwarg(self.generator.submit_text, "trace")
                 else {}
             )
+            if adapter is not None and _accepts_kwarg(
+                self.generator.submit_text, "adapter"
+            ):
+                submit_kwargs["adapter"] = adapter
             try:
                 req = self.generator.submit_text(
                     prompt, max_new_tokens=max_tokens, temperature=temperature,
@@ -885,7 +935,7 @@ class InferenceServer:
             "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
             "object": "chat.completion",
             "created": int(time.time()),
-            "model": self.model_id,
+            "model": model,
             "choices": [
                 {
                     "index": 0,
@@ -949,6 +999,7 @@ def serve_model(
     kv_quant: bool = False,
     weight_quant: bool | str = False,  # True/'int8' -> W8A16; 'int4' -> W4A16
     adapter: str | None = None,
+    adapters: "str | dict | None" = None,
     host: str = "127.0.0.1",
     port: int = 8000,
     continuous: bool = False,
@@ -962,6 +1013,7 @@ def serve_model(
     warmup: bool | None = None,
     prefix_cache_mb: float | None = None,
     prefix_cache_host_mb: float | None = None,
+    adapter_max_inflight: int | None = None,
     max_queue: int | None = None,
     admin_token: str | None = None,
     role: str | None = None,
@@ -1004,6 +1056,24 @@ def serve_model(
         )
     if mesh and not continuous:
         raise ValueError("--mesh requires --continuous (the sharded replica is engine-only)")
+    if adapters and not continuous:
+        raise ValueError(
+            "--adapters requires --continuous (batched multi-LoRA serving "
+            "is engine-only; use --adapter to merge ONE adapter into the "
+            "one-shot generator)"
+        )
+    if adapters and adapter:
+        raise ValueError(
+            "--adapter merges one adapter into the base weights; --adapters "
+            "serves a bank unmerged — pass one (a merged base would corrupt "
+            "the bank's base-fingerprint check)"
+        )
+    if adapters and weight_quant:
+        raise ValueError(
+            "--adapters does not compose with --weight-quant yet: the bank's "
+            "base-fingerprint check (and the LoRA delta's reference layout) "
+            "need the unquantized base weights"
+        )
     if mesh is None and env_str("PRIME_SERVE_MESH", "").strip() and (
         not continuous or slice_name
     ):
@@ -1080,6 +1150,11 @@ def serve_model(
                 prefix_cache_mb=prefix_cache_mb,
                 prefix_cache_host_mb=prefix_cache_host_mb,
                 max_queue=max_queue,
+                # multi-LoRA bank: {name: dir} / "name=dir,..." / None
+                # (None reads PRIME_SERVE_ADAPTERS inside the engine); the
+                # inflight cap drives the per-tenant fair admission pop
+                adapters=adapters,
+                adapter_max_inflight=adapter_max_inflight,
                 # a prefill-role replica's batched waves must store EVERY
                 # member's KV: its GET /admin/kv exports are the migration's
                 # whole point, and a batched admission that only stored

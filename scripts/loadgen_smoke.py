@@ -33,7 +33,19 @@ def main() -> int:
              "the multi-chip serving path on CPU (MULTICHIP_*.json rounds).",
     )
     parser.add_argument("--time-scale", type=float, default=1.0)
+    parser.add_argument(
+        "--disagg-mesh", action="store_true",
+        help="Instead of the fleet smoke, run the MULTICHIP disaggregation "
+             "round: phase-split vs colocated with role-preset meshes "
+             "(role:prefill / role:decode) over disjoint halves of the "
+             "forced device set — the shape a committed "
+             "MULTICHIP_loadgen_cpu_rNN.json wants.",
+    )
     args = parser.parse_args()
+    if args.disagg_mesh:
+        from prime_tpu.loadgen.smoke import run_disagg_mesh_round
+
+        return 0 if run_disagg_mesh_round(args.output, seed=args.seed)["ok"] else 1
     outcome = run_smoke(
         args.output,
         scenario=args.scenario,

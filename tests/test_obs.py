@@ -438,7 +438,8 @@ def test_engine_histograms_populate_through_streamed_completion():
                 "requests_admitted", "requests_completed", "requests_cancelled",
                 "requests_failed", "tokens_emitted", "prefix_hits",
                 "batched_admission_waves", "active_slots", "queue_depth",
-                "max_slots", "max_queue", "mesh_devices", "mesh_axes", "state",
+                "max_slots", "max_queue", "mesh_devices", "mesh_axes",
+                "adapters_loaded", "adapters", "state",
                 "overlap", "speculative", "draft_len", "spec_accept_ratio",
                 "inflight_depth", "host_stall_s", "chunk_window_s",
                 "overlap_ratio", "wasted_decode_tokens", "warmup_programs",

@@ -337,3 +337,136 @@ def test_iter_prefixes_is_root_first_and_bounded():
     assert paths[0] == tuple(pre)
     assert set(paths[1:]) == {tuple(a), tuple(b)}
     assert list(cache.iter_prefixes(limit=1)) == [tuple(pre)]
+
+
+# -- pin-aware splits (off-loop export enabler) --------------------------------
+
+
+def test_split_of_pinned_node_preserves_match_view_and_pins():
+    """A store-path insert may split a node a live match has pinned (the
+    concurrent-insert case an off-loop KV export creates): the match's
+    snapshot must keep serving the FULL pre-split segment and token run, the
+    lower split half must inherit the pin (so the LRU cannot evict the tail
+    of a pinned path), and release() must return every refcount to zero."""
+    cache = BlockPrefixCache(budget_bytes=1 << 20, block=BLOCK)
+    tokens = list(range(100, 148))  # one 48-token edge
+    insert(cache, tokens)
+    m = cache.match(tokens + [7], limit=48)
+    assert m is not None and m.length == 48
+    pre_segments = m.segments()
+    # concurrent insert diverging after 16 tokens: splits the pinned edge
+    insert(cache, tokens[:16] + [900 + i for i in range(16)])
+    assert cache.nodes == 3  # upper (16) + lower (32) + new tail
+    # the match still reads the full uncut run (snapshot, not live nodes)
+    assert m.tokens() == tokens
+    got = np.concatenate(
+        [seg["k"][..., :take] for seg, take in zip(m.segments(), m.takes())],
+        axis=-1,
+    )
+    np.testing.assert_array_equal(got, make_row(tokens))
+    assert m.segments()[0]["k"].shape == pre_segments[0]["k"].shape
+    # both halves of the split are pinned: budget pressure cannot evict them
+    cache.budget_bytes = 1  # force pressure
+    cache.evict_to_budget()
+    assert cache.match_len(tokens, limit=48) == 48  # path intact
+    cache.budget_bytes = 1 << 20
+    # release returns every node to refs == 0
+    cache.release(m)
+
+    def walk(node):
+        yield node
+        for child in node.children.values():
+            yield from walk(child)
+
+    assert all(n.refs == 0 for n in walk(cache._root))
+
+
+def test_split_of_pinned_node_export_serializes_presplit_path():
+    """export_segments pins for the whole serialization; a split landing
+    mid-read (simulated by splitting between match and the byte walk) must
+    not change the exported tokens or bytes — the wire payload equals the
+    one serialized with no concurrent insert."""
+    from prime_tpu.serve.prefix_cache import decode_wire_payload
+
+    tokens = list(range(200, 248))
+    quiet = BlockPrefixCache(budget_bytes=1 << 20, block=BLOCK)
+    insert(quiet, tokens)
+    reference = quiet.export_segments(tokens)
+
+    cache = BlockPrefixCache(budget_bytes=1 << 20, block=BLOCK)
+    insert(cache, tokens)
+    m = cache.match(tokens, limit=48)  # pin like an in-flight export
+    insert(cache, tokens[:16] + [1] * 16)  # splits the pinned edge
+    cache.release(m)
+    payload = cache.export_segments(tokens)
+    assert payload is not None and reference is not None
+    ref_tokens, ref_leaves = decode_wire_payload(reference, BLOCK)
+    got_tokens, got_leaves = decode_wire_payload(payload, BLOCK)
+    assert got_tokens == ref_tokens == tokens
+    for name in ref_leaves:
+        np.testing.assert_array_equal(got_leaves[name], ref_leaves[name])
+
+
+def test_pinned_split_on_host_tier_keeps_byte_accounting():
+    """Splitting a pinned HOST-resident node conserves per-tier bytes and
+    the transferred pin blocks host-budget eviction of the lower half."""
+    cache = BlockPrefixCache(
+        budget_bytes=16 * SLOT_BYTES, block=BLOCK,
+        host_budget_bytes=1 << 20,
+    )
+    tokens = list(range(300, 332))  # 32 tokens, one edge
+    insert(cache, tokens)
+    # drive the edge to the host tier
+    insert(cache, [7000 + i for i in range(16)])
+    node = cache._root.children[tuple(tokens[:BLOCK])]
+    assert node.tier == "host"
+    before_total = cache.bytes + cache.host_bytes
+    m = cache.match(tokens, limit=32)
+    assert m is not None and m.host_tokens == 32
+    insert(cache, tokens[:16] + [8000 + i for i in range(16)])  # pinned split
+    assert cache.bytes + cache.host_bytes >= before_total  # conserved + new tail
+    # the lower (host) half is pinned: host-budget pressure skips it
+    cache.host_budget_bytes = 1
+    cache.evict_to_budget()
+    assert m.tokens() == tokens
+    got = np.concatenate(
+        [seg["k"][..., :take] for seg, take in zip(m.segments(), m.takes())],
+        axis=-1,
+    )
+    np.testing.assert_array_equal(got, make_row(tokens))
+    cache.release(m)
+
+
+def test_second_level_split_of_pin_inherited_lower_half():
+    """A lower half created by splitting a pinned node is itself pinned via
+    extra_pins; a SECOND insert splitting THAT half must transfer the pin
+    again — the whole original pinned run stays unevictable until release."""
+    cache = BlockPrefixCache(budget_bytes=1 << 20, block=BLOCK)
+    tokens = list(range(400, 464))  # one 64-token edge
+    insert(cache, tokens)
+    m = cache.match(tokens, limit=64)
+    assert m is not None and m.length == 64
+    # first split at 16 (pins transfer to the 48-token lower half)...
+    insert(cache, tokens[:16] + [900 + i for i in range(16)])
+    # ...second split at 32 overall: splits the PIN-INHERITED lower half
+    insert(cache, tokens[:32] + [800 + i for i in range(16)])
+    # every piece of the original 64-token run must be pinned: budget
+    # pressure cannot evict any of it while the match is live
+    cache.budget_bytes = 1
+    cache.evict_to_budget()
+    assert cache.match_len(tokens, limit=64) == 64
+    # the match's snapshot view is still the full pre-split run
+    got = np.concatenate(
+        [seg["k"][..., :take] for seg, take in zip(m.segments(), m.takes())],
+        axis=-1,
+    )
+    np.testing.assert_array_equal(got, make_row(tokens))
+    cache.budget_bytes = 1 << 20
+    cache.release(m)
+
+    def walk(node):
+        yield node
+        for child in node.children.values():
+            yield from walk(child)
+
+    assert all(n.refs == 0 for n in walk(cache._root))

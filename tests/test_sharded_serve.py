@@ -478,3 +478,89 @@ def test_perf_delta_multichip_rounds_own_rows(tmp_path):
     committed = load_all_rounds(repo_root)
     assert any(r.label.startswith("mc06") for r in committed)
     assert any(r.metrics.get("mc sharded tok/s", 0) > 0 for r in committed)
+
+
+# ---- multi-LoRA on the mesh --------------------------------------------------
+
+
+@requires_multichip
+def test_sharded_multilora_bit_identity(tmp_path):
+    """The multi-LoRA × mesh cell of the acceptance matrix: an adapter
+    request through a SHARDED engine's gathered path emits the same greedy
+    tokens as (a) the single-chip banked engine and (b) a merged-adapter
+    engine — and base traffic on the sharded banked engine stays
+    bit-identical to the plain sharded engine's."""
+    from prime_tpu.train.lora import (
+        LoraConfig,
+        init_lora_params,
+        merge_lora,
+        save_adapters,
+    )
+
+    lora = LoraConfig(r=4, alpha=8)
+    factors = init_lora_params(jax.random.PRNGKey(11), CONFIG, lora)
+    factors["layers"] = {
+        name: {
+            "a": ab["a"],
+            "b": (
+                jax.random.normal(jax.random.PRNGKey(12), ab["b"].shape) * 0.05
+            ).astype(ab["b"].dtype),
+        }
+        for name, ab in factors["layers"].items()
+    }
+    path = tmp_path / "tenant-a"
+    save_adapters(path, factors, lora, CONFIG, base_params=PARAMS)
+    prompt = WAVE_PROMPTS[0]
+
+    def run(engine, adapter=None):
+        req = engine.submit(prompt, max_new_tokens=10, adapter=adapter)
+        drain(engine, req)
+        toks = req.all_tokens(timeout=2)
+        engine.shutdown()
+        return toks
+
+    single = run(
+        make_engine(adapters={"tenant-a": str(path)}), adapter="tenant-a"
+    )
+    merged = run(
+        ContinuousBatchingEngine(
+            merge_lora(PARAMS, factors, lora), CONFIG,
+            max_slots=4, capacity=128, chunk=4, prefix_cache_mb=0,
+        )
+    )
+    assert single == merged
+    sharded = run(
+        make_engine(adapters={"tenant-a": str(path)}, mesh_config=MESH_SPEC),
+        adapter="tenant-a",
+    )
+    assert sharded == single
+    # base traffic: banked sharded == plain sharded (slot 0 is exact zero)
+    plain = run(make_engine(mesh_config=MESH_SPEC))
+    base_on_banked = run(
+        make_engine(adapters={"tenant-a": str(path)}, mesh_config=MESH_SPEC)
+    )
+    assert base_on_banked == plain
+
+
+@requires_multichip
+def test_sharded_bank_placement_follows_projection_axes(tmp_path):
+    """The bank shards consistently with the wrapped projections: A on the
+    base weight's input (fsdp) axis, B on its output (tp) axis."""
+    from prime_tpu.train.lora import LoraConfig, init_lora_params, save_adapters
+
+    lora = LoraConfig(r=4, alpha=8)
+    factors = init_lora_params(jax.random.PRNGKey(11), CONFIG, lora)
+    path = tmp_path / "tenant-a"
+    save_adapters(path, factors, lora, CONFIG, base_params=PARAMS)
+    engine = make_engine(
+        adapters={"tenant-a": str(path)}, mesh_config=MESH_SPEC
+    )
+    try:
+        stacks = engine.adapter_bank.stacks["layers"]
+        a_spec = stacks["wq"]["a"].sharding.spec
+        b_spec = stacks["wq"]["b"].sharding.spec
+        # (L, A, d_in, r): d_in on fsdp; (L, A, r, d_out): d_out on tp
+        assert tuple(a_spec) == (None, None, "fsdp", None)
+        assert tuple(b_spec) == (None, None, None, "tp")
+    finally:
+        engine.shutdown()
