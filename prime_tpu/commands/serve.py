@@ -375,6 +375,146 @@ def serve_fleet_cmd(
         router.stop()
 
 
+@serve_cmd.command(name="top")
+@click.option(
+    "--url", default="http://127.0.0.1:8080", show_default=True,
+    help="Base URL of a `prime serve fleet` router (or a single `prime "
+         "serve` instance — the single-replica view renders too).",
+)
+@click.option(
+    "--interval", type=click.FloatRange(min=0.1), default=2.0, show_default=True,
+    help="Seconds between refreshes (ignored with --once).",
+)
+@click.option(
+    "--once", is_flag=True,
+    help="Render one view and exit (with --output json: the raw view JSON, "
+         "for scripts).",
+)
+@click.option(
+    "--admin-token", default=None, envvar="PRIME_FLEET_ADMIN_TOKEN",
+    help="Bearer token when the target gates /admin/observatory.",
+)
+@output_options
+def serve_top_cmd(
+    render: "Renderer",
+    url: str,
+    interval: float,
+    once: bool,
+    admin_token: str | None,
+) -> None:
+    """Live fleet SLO view: GET /admin/observatory rendered as a plain-text
+    table — windowed rates/percentiles, burn alerts, the current scale
+    signal, and the per-replica split — refreshed every --interval seconds.
+    See docs/observability.md "Observatory"."""
+    import time as _time
+
+    import httpx
+
+    base = url.rstrip("/")
+    headers = {"Authorization": f"Bearer {admin_token}"} if admin_token else None
+    first = True
+    while True:
+        try:
+            response = httpx.get(
+                f"{base}/admin/observatory", headers=headers, timeout=10
+            )
+            if response.status_code == 403:
+                raise click.ClickException(
+                    f"{base}/admin/observatory requires an admin token "
+                    "(--admin-token / PRIME_FLEET_ADMIN_TOKEN)"
+                )
+            response.raise_for_status()
+            view = response.json()
+        except (httpx.HTTPError, ValueError) as e:
+            if once or first:
+                raise click.ClickException(
+                    f"could not read {base}/admin/observatory: {e}"
+                ) from None
+            # a live dashboard survives a router restart or one slow
+            # scrape: show the miss and retry at the next tick
+            click.echo(f"(scrape failed: {e}; retrying in {interval}s)", err=True)
+            _time.sleep(interval)
+            continue
+        if render.is_json:
+            render.json(view)
+            return  # one machine-readable view; scripts loop themselves
+        if not once and not first:
+            click.clear()
+        first = False
+        _render_observatory_view(render, view)
+        if once:
+            return
+        _time.sleep(interval)
+
+
+def _fmt(value, digits: int = 3) -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, float):
+        return f"{value:.{digits}f}".rstrip("0").rstrip(".") or "0"
+    return str(value)
+
+
+def _render_observatory_view(render: "Renderer", view: dict) -> None:
+    """Plain-text tables for one /admin/observatory payload — fleet-router
+    shape (``replicas``/``fleet``) and single-replica shape
+    (``replica``/``serving``) both render."""
+    signal = view.get("signal") or {}
+    click.echo(
+        f"signal: {signal.get('direction', '?')} — {signal.get('reason', '')}"
+    )
+    breached = [
+        v for v in view.get("slo", []) if isinstance(v, dict) and v.get("breached")
+    ]
+    for verdict in breached:
+        fast, slow = verdict.get("fast", {}), verdict.get("slow", {})
+        click.echo(
+            f"  BURN {verdict.get('policy')}: "
+            f"{_fmt(fast.get('burn'), 2)}x fast / {_fmt(slow.get('burn'), 2)}x slow "
+            f"(objective {_fmt(verdict.get('objective'))})"
+        )
+    windows = view.get("fleet") or view.get("serving") or {}
+    window_rows = [
+        [
+            f"{label} {int(entry.get('window_s', 0))}s",
+            _fmt(entry.get("span_s"), 1),
+            _fmt(entry.get("tok_s")),
+            _fmt(entry.get("admitted_per_s")),
+            _fmt(entry.get("ttft_p95_s")),
+            _fmt(entry.get("queue_wait_p95_s")),
+            _fmt(entry.get("reject_rate"), 4),
+        ]
+        for label, entry in windows.items()
+        if isinstance(entry, dict)
+    ]
+    render.table(
+        ["window", "span_s", "tok/s", "adm/s", "ttft p95", "queue p95", "429 rate"],
+        window_rows,
+        title="Fleet windows" if "fleet" in view else "Serving windows",
+    )
+    replicas = view.get("replicas")
+    if replicas is None and isinstance(view.get("replica"), dict):
+        replicas = [view["replica"]]
+    rows = [
+        [
+            r.get("id") or r.get("model", "?"),
+            r.get("state", "?"),
+            r.get("breaker", "-"),
+            r.get("queue_depth", 0),
+            f"{r.get('active_slots', 0)}/{r.get('max_slots', 0)}",
+            _fmt(r.get("tok_s")),
+            r.get("samples", 0),
+            r.get("resets", 0),
+        ]
+        for r in replicas or []
+    ]
+    render.table(
+        ["replica", "state", "breaker", "queue", "slots", "tok/s", "samples", "resets"],
+        rows,
+        title="Replicas",
+    )
+
+
 @serve_cmd.command(name="metrics")
 @click.option(
     "--url", default="http://127.0.0.1:8000", show_default=True,
@@ -399,6 +539,18 @@ def serve_fleet_cmd(
     "--admin-token", default=None, envvar="PRIME_FLEET_ADMIN_TOKEN",
     help="Bearer token for /debug/requests when the target gates it.",
 )
+@click.option(
+    "--watch", "watch_s", type=click.FloatRange(min=0.01), default=None,
+    metavar="SECONDS",
+    help="Repeat the scrape every SECONDS, adding a windowed per-second "
+         "rate column for every counter (computed through the observatory "
+         "timeseries ring, not ad-hoc subtraction — "
+         "docs/observability.md \"Observatory\").",
+)
+@click.option(
+    "--count", type=click.IntRange(min=0), default=0,
+    help="With --watch: refreshes before exiting (0 = until Ctrl-C).",
+)
 @output_options
 def serve_metrics_cmd(
     render: "Renderer",
@@ -407,14 +559,16 @@ def serve_metrics_cmd(
     debug_url: str | None,
     request_id: str | None,
     admin_token: str | None,
+    watch_s: float | None,
+    count: int,
 ) -> None:
     """Scrape a running server's metrics registry: counters, gauges, and
     latency histograms (TTFT, queue wait, prefill/decode) with estimated
     p50/p95 — or, with --debug-url, the flight-recorder request timelines.
     See docs/architecture.md "Observability" and docs/observability.md."""
-    import httpx
+    import time as _time
 
-    from prime_tpu.obs.metrics import quantile_from_snapshot
+    import httpx
 
     if prometheus and render.is_json:
         # the exposition IS a text format; silently emitting it where a
@@ -425,18 +579,59 @@ def serve_metrics_cmd(
         )
     if request_id and not debug_url:
         raise click.UsageError("--request requires --debug-url")
+    if watch_s is not None and (prometheus or debug_url or render.is_json):
+        raise click.UsageError(
+            "--watch renders live tables; it does not compose with "
+            "--prometheus, --debug-url, or --output json (scripts should "
+            "poll /metrics?format=registry, or `prime serve top --once`)"
+        )
     if debug_url:
         _render_flight_view(render, debug_url, request_id, admin_token)
         return
     base = url.rstrip("/")
-    try:
-        if prometheus:
+    if prometheus:
+        try:
             response = httpx.get(
                 f"{base}/metrics", params={"format": "prometheus"}, timeout=10
             )
             response.raise_for_status()
-            click.echo(response.text, nl=False)
-            return
+        except httpx.HTTPError as e:
+            raise click.ClickException(f"could not scrape {base}/metrics: {e}") from None
+        click.echo(response.text, nl=False)
+        return
+    if watch_s is not None:
+        from prime_tpu.obs.timeseries import SnapshotRing
+
+        # one client-side ring per scraped section: each refresh appends the
+        # scrape and reads the windowed rate back out — the SAME query the
+        # observatory serves, so the delta column can never drift from it
+        rings: dict[str, SnapshotRing] = {}
+        iteration = 0
+        while True:
+            payload = _scrape_registry(base)
+            for section, snapshot in payload.items():
+                rings.setdefault(section, SnapshotRing()).append(snapshot)
+            if iteration:
+                click.clear()
+            _render_registry_tables(
+                render, payload, rings=rings, rate_window_s=watch_s * 3
+            )
+            iteration += 1
+            if count and iteration >= count:
+                return
+            _time.sleep(watch_s)
+    payload = _scrape_registry(base)
+    if render.is_json:
+        render.json(payload)
+        return
+    _render_registry_tables(render, payload)
+
+
+def _scrape_registry(base: str) -> dict:
+    """GET ``/metrics?format=registry`` and validate the snapshot shape."""
+    import httpx
+
+    try:
         response = httpx.get(
             f"{base}/metrics", params={"format": "registry"}, timeout=10
         )
@@ -454,10 +649,24 @@ def serve_metrics_cmd(
             f"{base}/metrics?format=registry did not return registry snapshots "
             "(is the server running this repo's serve build?)"
         )
+    return payload
+
+
+def _render_registry_tables(
+    render: "Renderer",
+    payload: dict,
+    rings=None,
+    rate_window_s: float | None = None,
+) -> None:
+    """The registry scrape rendered as tables. With ``rings`` (watch mode),
+    counters gain a windowed per-second rate column read from the
+    per-section timeseries ring."""
+    from prime_tpu.obs.metrics import quantile_from_snapshot
 
     value_rows: list[list] = []
     hist_rows: list[list] = []
     for section, registry in payload.items():
+        ring = rings.get(section) if rings else None
         for name, family in registry.items():
             for series in family["series"]:
                 labels = ",".join(f"{k}={v}" for k, v in series["labels"].items())
@@ -471,16 +680,19 @@ def serve_metrics_cmd(
                          round(mean, 6), round(p50, 6), round(p95, 6)]
                     )
                 else:
-                    value_rows.append(
-                        [section, name, labels, family["type"], series["value"]]
-                    )
-    if render.is_json:
-        render.json(payload)
-        return
-    render.table(
-        ["section", "metric", "labels", "type", "value"], value_rows,
-        title="Counters & gauges",
-    )
+                    row = [section, name, labels, family["type"], series["value"]]
+                    if rings is not None:
+                        rate = None
+                        if family["type"] == "counter" and ring is not None:
+                            rate = ring.rate(
+                                name, rate_window_s or 1.0, series["labels"]
+                            )
+                        row.append(round(rate, 3) if rate is not None else "-")
+                    value_rows.append(row)
+    headers = ["section", "metric", "labels", "type", "value"]
+    if rings is not None:
+        headers.append("per_s")
+    render.table(headers, value_rows, title="Counters & gauges")
     render.table(
         ["section", "metric", "labels", "count", "mean", "p50", "p95"], hist_rows,
         title="Histograms (seconds unless named otherwise)",

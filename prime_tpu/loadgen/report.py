@@ -18,19 +18,26 @@ docs/benchmarking.md.
 from __future__ import annotations
 
 import math
-from typing import Any, Iterable
+from typing import Any
 
-from prime_tpu.obs.metrics import quantile_from_snapshot
+from prime_tpu.obs.metrics import (
+    counter_delta,
+    hist_delta,
+    hist_series_from_snapshot,
+    merge_hists,
+    quantile_from_snapshot,
+    snapshot_captured_at,
+)
 
 SLO_SCHEMA = 1
 
-
-def _captured_at(snapshot: dict) -> float | None:
-    family = snapshot.get("captured_at")
-    if not isinstance(family, dict):
-        return None
-    series = family.get("series") or []
-    return float(series[0]["value"]) if series else None
+# the delta/merge arithmetic itself lives in obs/metrics.py (shared with the
+# observatory time-series — one implementation, two consumers); this module
+# keeps only the report-shaped selection logic on top of it
+_captured_at = snapshot_captured_at
+_hist_series = hist_series_from_snapshot
+_hist_delta = hist_delta
+_merge_hists = merge_hists
 
 
 def _family(snapshot: dict, name: str) -> dict | None:
@@ -73,58 +80,6 @@ def _labeled_values(snapshot: dict, name: str, label: str) -> dict[str, float]:
         if key is not None:
             out[key] = out.get(key, 0.0) + float(series.get("value", 0.0))
     return out
-
-
-def _hist_series(snapshot: dict, name: str, labels: dict | None = None) -> dict | None:
-    family = _family(snapshot, name)
-    if family is None:
-        return None
-    want = labels or {}
-    for series in family.get("series", []):
-        if series.get("labels", {}) == want:
-            return series
-    return None
-
-
-def _hist_delta(before: dict | None, after: dict | None) -> dict | None:
-    """after − before for one histogram series (same bucket layout)."""
-    if after is None:
-        return None
-    if before is None:
-        return {
-            "buckets": list(after["buckets"]),
-            "counts": list(after["counts"]),
-            "sum": after["sum"],
-            "count": after["count"],
-        }
-    return {
-        "buckets": list(after["buckets"]),
-        "counts": [a - b for a, b in zip(after["counts"], before["counts"])],
-        "sum": after["sum"] - before["sum"],
-        "count": after["count"] - before["count"],
-    }
-
-
-def _merge_hists(deltas: Iterable[dict | None]) -> dict | None:
-    """Pointwise sum of same-layout histogram deltas across components."""
-    merged: dict | None = None
-    for delta in deltas:
-        if delta is None:
-            continue
-        if merged is None:
-            merged = {
-                "buckets": list(delta["buckets"]),
-                "counts": list(delta["counts"]),
-                "sum": delta["sum"],
-                "count": delta["count"],
-            }
-        elif merged["buckets"] == delta["buckets"]:
-            merged["counts"] = [
-                a + b for a, b in zip(merged["counts"], delta["counts"])
-            ]
-            merged["sum"] += delta["sum"]
-            merged["count"] += delta["count"]
-    return merged
 
 
 def _quantiles(hist: dict | None, qs: tuple[float, ...] = (0.5, 0.95)) -> dict[str, float | None]:
@@ -207,9 +162,13 @@ def scenario_row(result) -> dict[str, Any]:
         )
 
     def edelta(metric: str, labels: dict | None = None) -> float:
+        # reset-aware (obs/metrics.counter_delta): a replica restarting
+        # mid-run must clamp to its post-reset count, not subtract negative
         return sum(
-            _scalar(after[name], metric, labels)
-            - _scalar(before.get(name, {}), metric, labels)
+            counter_delta(
+                _scalar(before.get(name, {}), metric, labels),
+                _scalar(after[name], metric, labels),
+            )[0]
             for name in engines
         )
 

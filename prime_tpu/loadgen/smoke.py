@@ -624,6 +624,46 @@ def run_smoke(
             f"(outcomes {dict(result.outcomes)})"
         )
 
+        # observatory leg (docs/observability.md "Observatory"), captured
+        # RIGHT after the fleet run while the fast window still covers it:
+        # the live view must be well-formed — a valid scale signal, one row
+        # per replica — with a NONZERO windowed token rate for the run just
+        # driven (the observatory and the SLO report window the same
+        # counters; a zero here while the report is nonzero means the
+        # sensor layer is blind). The view JSON lands in the artifacts
+        # either way, so a CI failure uploads the evidence.
+        observatory: dict[str, Any] = {}
+        obs_ok = False
+        try:
+            router.membership.poll_all()  # trailing sample closes the run window
+            observatory = httpx.get(
+                f"{router.url}/admin/observatory", timeout=10
+            ).json()
+            fleet_fast = (observatory.get("fleet") or {}).get("fast") or {}
+            obs_ok = (
+                observatory.get("signal", {}).get("direction")
+                in ("up", "down", "hold")
+                and isinstance(observatory.get("replicas"), list)
+                and len(observatory["replicas"]) == replicas
+                and (fleet_fast.get("tok_s") or 0) > 0
+            )
+            if obs_ok:
+                log(
+                    f"# loadgen-smoke: observatory signal "
+                    f"{observatory['signal']['direction']} — fast-window "
+                    f"{fleet_fast.get('tok_s')} tok/s over "
+                    f"{fleet_fast.get('span_s')} s"
+                )
+            else:
+                log(
+                    "# loadgen-smoke: observatory view malformed or blind: "
+                    f"signal={observatory.get('signal')} fast={fleet_fast}"
+                )
+        except Exception as e:  # noqa: BLE001 — the artifact write below must run
+            log(f"# loadgen-smoke: observatory leg failed: {e}")
+        with open(os.path.join(output_dir, "observatory.json"), "w") as f:
+            json.dump(observatory, f, indent=2)
+
         # speculative on/off section (spec_friendly scenario, in-process
         # engines — sharded when --mesh is set). Appended to the report's
         # scenario rows WITHOUT touching the headline: the headline gate
@@ -740,12 +780,18 @@ def run_smoke(
             json.dump(record, f, indent=2)
         with open(os.path.join(output_dir, "flight.json"), "w") as f:
             json.dump(result.flight, f, indent=2)
-        ok = headline["tok_s"] > 0 and not lint
+        ok = headline["tok_s"] > 0 and not lint and obs_ok
         log(
             f"# loadgen-smoke: {'OK' if ok else 'FAILED'} — artifacts in "
             f"{output_dir}"
         )
-        return {"ok": ok, "report": report, "record": record, "lint": lint}
+        return {
+            "ok": ok,
+            "report": report,
+            "record": record,
+            "lint": lint,
+            "observatory": observatory,
+        }
     finally:
         if router is not None:
             router.stop()

@@ -14,6 +14,10 @@ this layer). Two halves:
 - :mod:`prime_tpu.obs.trace` — a lightweight span tracer
   (``span(name, **attrs)`` context manager) with monotonic-clock timing,
   thread-local parent/child nesting and JSONL export for offline analysis.
+- :mod:`prime_tpu.obs.timeseries` — rolling rings of registry snapshots
+  with windowed rate/quantile queries (the observatory's memory).
+- :mod:`prime_tpu.obs.slo` — declarative SLO policies evaluated with
+  multi-window burn rates into typed ``ScaleSignal`` recommendations.
 
 See docs/architecture.md "Observability" for the exposition endpoints
 (`GET /metrics?format=prometheus`, `/healthz`) and the trace JSONL schema.
@@ -28,9 +32,19 @@ from prime_tpu.obs.metrics import (
     Gauge,
     Histogram,
     Registry,
+    counter_delta,
+    hist_delta,
     lint_prometheus_text,
+    merge_hists,
     quantile_from_snapshot,
 )
+from prime_tpu.obs.slo import (
+    ScaleSignal,
+    SloEvaluator,
+    SloPolicy,
+    default_policies,
+)
+from prime_tpu.obs.timeseries import RegistrySampler, SnapshotRing
 from prime_tpu.obs.trace import (
     TRACEPARENT_HEADER,
     TRACER,
@@ -52,6 +66,15 @@ __all__ = [
     "DEFAULT_SIZE_BUCKETS",
     "lint_prometheus_text",
     "quantile_from_snapshot",
+    "counter_delta",
+    "hist_delta",
+    "merge_hists",
+    "RegistrySampler",
+    "ScaleSignal",
+    "SloEvaluator",
+    "SloPolicy",
+    "SnapshotRing",
+    "default_policies",
     "FlightRecorder",
     "Span",
     "TraceContext",

@@ -395,6 +395,120 @@ def quantile_from_snapshot(buckets: list[float], counts: list[int], q: float) ->
     return buckets[-1]
 
 
+# ---- snapshot arithmetic ----------------------------------------------------
+# The one shared implementation of delta/merge math over serialized
+# Registry.snapshot() data. The loadgen SLO report (loadgen/report.py) and
+# the observatory time-series (obs/timeseries.py) both window counters and
+# histograms through these — two diverging copies of the bucket arithmetic
+# is exactly the drift the obs contract exists to prevent.
+
+
+def snapshot_captured_at(snapshot: Mapping[str, Any]) -> float | None:
+    """The monotonic capture instant :meth:`Registry.snapshot` embeds under
+    the reserved ``captured_at`` family, or None on pre-schema snapshots."""
+    family = snapshot.get(SNAPSHOT_CAPTURED_AT)
+    if not isinstance(family, Mapping):
+        return None
+    series = family.get("series") or []
+    try:
+        return float(series[0]["value"]) if series else None
+    except (TypeError, KeyError, ValueError, IndexError):
+        return None
+
+
+def scalar_from_snapshot(
+    snapshot: Mapping[str, Any], name: str, labels: Mapping[str, str] | None = None
+) -> float:
+    """One counter/gauge series value out of a snapshot (0.0 when the family
+    or series is absent — the same "never existed = never incremented"
+    default the report has always used)."""
+    family = snapshot.get(name)
+    if not isinstance(family, Mapping):
+        return 0.0
+    want = dict(labels or {})
+    for series in family.get("series", []):
+        if series.get("labels", {}) == want:
+            try:
+                return float(series.get("value", 0.0))
+            except (TypeError, ValueError):
+                return 0.0
+    return 0.0
+
+
+def hist_series_from_snapshot(
+    snapshot: Mapping[str, Any], name: str, labels: Mapping[str, str] | None = None
+) -> dict | None:
+    """One histogram series (buckets/counts/sum/count) out of a snapshot."""
+    family = snapshot.get(name)
+    if not isinstance(family, Mapping):
+        return None
+    want = dict(labels or {})
+    for series in family.get("series", []):
+        if series.get("labels", {}) == want and "counts" in series:
+            return series
+    return None
+
+
+def counter_delta(before: float, after: float) -> tuple[float, bool]:
+    """``after − before`` for a monotonic counter, reset-aware: a replica
+    restart makes the raw subtraction negative, and a negative "rate" is a
+    lie no dashboard should ever render. On a reset the best unbiased
+    estimate of the window's traffic is the post-reset value itself (the
+    count since the restart — everything before it is unknowable).
+    Returns ``(delta, reset_detected)``."""
+    if after < before:
+        return after, True
+    return after - before, False
+
+
+def hist_delta(before: dict | None, after: dict | None) -> dict | None:
+    """``after − before`` for one histogram series (same bucket layout),
+    reset-aware like :func:`counter_delta`: a shrunk total count means the
+    process restarted, and the post-reset series IS the window's delta. A
+    missing ``before`` (new series mid-window) degrades the same way."""
+    if after is None:
+        return None
+    if before is None or after["count"] < before["count"] or any(
+        a < b for a, b in zip(after["counts"], before["counts"])
+    ):
+        return {
+            "buckets": list(after["buckets"]),
+            "counts": list(after["counts"]),
+            "sum": after["sum"],
+            "count": after["count"],
+        }
+    return {
+        "buckets": list(after["buckets"]),
+        "counts": [a - b for a, b in zip(after["counts"], before["counts"])],
+        "sum": after["sum"] - before["sum"],
+        "count": after["count"] - before["count"],
+    }
+
+
+def merge_hists(deltas: Iterable[dict | None]) -> dict | None:
+    """Pointwise sum of same-layout histogram series across components
+    (engines of a fleet, replicas of a ring) — mismatched bucket layouts are
+    skipped rather than summed into nonsense."""
+    merged: dict | None = None
+    for delta in deltas:
+        if delta is None:
+            continue
+        if merged is None:
+            merged = {
+                "buckets": list(delta["buckets"]),
+                "counts": list(delta["counts"]),
+                "sum": delta["sum"],
+                "count": delta["count"],
+            }
+        elif merged["buckets"] == delta["buckets"]:
+            merged["counts"] = [
+                a + b for a, b in zip(merged["counts"], delta["counts"])
+            ]
+            merged["sum"] += delta["sum"]
+            merged["count"] += delta["count"]
+    return merged
+
+
 # quoted label values may legally contain '}' and ','; only '"', '\' and
 # newline are escaped — so the labels block and the pair splitter must be
 # quote-aware, not delimiter-naive

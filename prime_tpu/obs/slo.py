@@ -1,0 +1,503 @@
+"""Declarative SLO policies with multi-window burn-rate evaluation.
+
+The observatory's judgment half (the sensing half is
+:mod:`prime_tpu.obs.timeseries`): a set of :class:`SloPolicy` objectives —
+TTFT p95, TPOT p95, queue-wait p95, 429 rate, a utilization floor — is
+evaluated over the rolling snapshot rings with Google-SRE-style
+**multi-window burn rates**: one fast window (30 s, catches a storm in
+seconds) and one slow window (5 min, filters blips), and a policy only
+*breaches* when BOTH windows burn past the policy's threshold. Burn rate is
+the classic definition: the fraction of the error budget being consumed,
+normalized so 1.0 = exactly on budget —
+
+- **latency** objectives ("p95 ≤ T"): budget is the ``1 − q`` tail, so
+  ``burn = frac_above_T / (1 − q)`` (p95 exactly at T burns 1.0);
+- **error-rate** objectives ("429 fraction ≤ F"): ``burn = observed / F``.
+
+Evaluation emits a typed :class:`ScaleSignal` — ``up`` / ``down`` / ``hold``
+plus a human-readable reason and the burn evidence — which is a
+*recommendation only*: nothing here touches ``/admin/join`` or ``/drain``
+(ROADMAP item 5's autoscaler will act on it; this PR builds the sensor).
+``up`` is level-triggered (an under-capacity fleet should keep shouting);
+``down`` is edge-triggered with a hold afterwards (a shrink recommendation
+repeated every poll would thrash whatever acts on it) — which is why an
+idle fixture replays as ``down`` → ``hold`` → ``hold``.
+
+Everything is deterministic over the ring contents — no wall clock, no
+randomness — so :func:`replay` can prove decisions on synthetic snapshot
+sequences (the PR 6 balancer-sim pattern) and two replays of one fixture
+produce byte-identical signals. Knob overrides for the default policy
+thresholds (``PRIME_SLO_*``) live in the architecture.md knobs table.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Mapping, Sequence
+
+from prime_tpu.obs.metrics import quantile_from_snapshot
+from prime_tpu.obs.timeseries import (
+    SnapshotRing,
+    fleet_window_hist,
+    fleet_window_span,
+)
+from prime_tpu.utils.env import env_float
+
+FAST_WINDOW_S = 30.0
+SLOW_WINDOW_S = 300.0
+
+# both windows must burn at this multiple of budget before a policy
+# breaches — the SRE books' "2x for the page" setting
+BURN_THRESHOLD = 2.0
+
+# a window's verdict only counts once its ring actually covers this much of
+# the asked span: on a young ring the "slow" window degenerates to the same
+# few seconds as the fast one, and the multi-window AND would collapse to a
+# single window (a warmup blip would page, the exact thing the slow window
+# exists to filter)
+MIN_SPAN_FRACTION = 0.5
+
+DEFAULT_TTFT_P95_S = 2.0
+DEFAULT_TPOT_P95_S = 0.5
+DEFAULT_QUEUE_WAIT_P95_S = 1.0
+DEFAULT_REJECT_RATE = 0.01
+DEFAULT_UTIL_FLOOR = 0.1
+
+
+@dataclass(frozen=True)
+class SloPolicy:
+    """One objective. ``kind`` selects the arithmetic:
+
+    - ``latency`` — ``metric`` is an engine histogram; objective is
+      "q-quantile ≤ threshold seconds".
+    - ``error_rate`` — ``numerator``/``denominator`` are counters on the
+      ``source`` ring (summed over all series); objective is
+      "numerator/denominator ≤ threshold fraction".
+    - ``utilization_floor`` — ``metric`` is a load gauge summed across
+      replica rings; ``down`` is only considered while the windowed mean
+      utilization (against the capacity the caller supplies) sits below
+      ``threshold``. Never breaches upward.
+    """
+
+    name: str
+    kind: str  # latency | error_rate | utilization_floor
+    threshold: float
+    metric: str = ""
+    q: float = 0.95
+    source: str = "engine"  # engine (replica rings) | router (router ring)
+    numerator: tuple[str, ...] = ()
+    denominator: tuple[str, ...] = ()
+    burn_threshold: float = BURN_THRESHOLD
+
+
+def default_policies() -> tuple[SloPolicy, ...]:
+    """The stock fleet objectives, thresholds overridable via PRIME_SLO_*
+    knobs (architecture.md "Environment knobs")."""
+    return (
+        SloPolicy(
+            name="ttft_p95",
+            kind="latency",
+            metric="serve_ttft_seconds",
+            threshold=env_float("PRIME_SLO_TTFT_P95_S", DEFAULT_TTFT_P95_S),
+        ),
+        SloPolicy(
+            name="tpot_p95",
+            kind="latency",
+            metric="serve_tpot_seconds",
+            threshold=env_float("PRIME_SLO_TPOT_P95_S", DEFAULT_TPOT_P95_S),
+        ),
+        SloPolicy(
+            name="queue_wait_p95",
+            kind="latency",
+            metric="serve_queue_wait_seconds",
+            threshold=env_float(
+                "PRIME_SLO_QUEUE_WAIT_P95_S", DEFAULT_QUEUE_WAIT_P95_S
+            ),
+        ),
+        SloPolicy(
+            name="reject_rate",
+            kind="error_rate",
+            source="router",
+            numerator=("fleet_admission_rejected_total",),
+            denominator=("fleet_admission_rejected_total", "fleet_requests_total"),
+            threshold=env_float("PRIME_SLO_REJECT_RATE", DEFAULT_REJECT_RATE),
+        ),
+        SloPolicy(
+            name="utilization_floor",
+            kind="utilization_floor",
+            metric="serve_active_slots",
+            threshold=env_float("PRIME_SLO_UTIL_FLOOR", DEFAULT_UTIL_FLOOR),
+        ),
+    )
+
+
+@dataclass
+class WindowSample:
+    """One policy evaluated over one window."""
+
+    window: str  # "fast" | "slow"
+    window_s: float = 0.0  # the asked span
+    span_s: float | None = None  # seconds the window actually covered
+    burn: float | None = None  # budget multiple (1.0 = on budget); None = no data
+    value: float | None = None  # observed quantile / fraction / utilization
+    total: float = 0.0  # observations (or denominator events) in the window
+
+    @property
+    def covered(self) -> bool:
+        """The ring actually covers enough of this window for its verdict
+        to mean what the window's name claims (MIN_SPAN_FRACTION)."""
+        return (
+            self.span_s is not None
+            and self.span_s >= MIN_SPAN_FRACTION * self.window_s
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "window": self.window,
+            "span_s": _r(self.span_s),
+            "burn": _r(self.burn),
+            "value": _r(self.value),
+            "total": _r(self.total),
+        }
+
+
+@dataclass
+class PolicyVerdict:
+    policy: SloPolicy
+    fast: WindowSample
+    slow: WindowSample
+
+    @property
+    def breached(self) -> bool:
+        """Both windows burning past the policy threshold, each over a ring
+        that genuinely COVERS it — the multi-window AND that keeps a
+        2-second blip from paging and a slow leak from hiding (on a young
+        ring the slow window would otherwise evaluate the same seconds as
+        the fast one; utilization floors never breach, they only argue
+        down)."""
+        if self.policy.kind == "utilization_floor":
+            return False
+        return all(
+            sample.covered
+            and sample.burn is not None
+            and sample.burn >= self.policy.burn_threshold
+            for sample in (self.fast, self.slow)
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "policy": self.policy.name,
+            "kind": self.policy.kind,
+            "objective": _r(self.policy.threshold),
+            "breached": self.breached,
+            "fast": self.fast.to_dict(),
+            "slow": self.slow.to_dict(),
+        }
+
+
+@dataclass
+class ScaleSignal:
+    """The observatory's recommendation. Pure data, no timestamps — two
+    evaluations over identical ring contents serialize byte-identically."""
+
+    direction: str  # up | down | hold
+    reason: str
+    evidence: dict[str, Any] = field(default_factory=dict)
+
+    # numeric encoding for the fleet_scale_signal gauge
+    GAUGE = {"down": -1, "hold": 0, "up": 1}
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "direction": self.direction,
+            "reason": self.reason,
+            "evidence": self.evidence,
+        }
+
+
+def _r(value: float | None, digits: int = 6) -> float | None:
+    return None if value is None else round(float(value), digits)
+
+
+def _frac_above(hist: Mapping[str, Any], threshold: float) -> float | None:
+    """Fraction of a windowed histogram's observations above ``threshold``,
+    interpolating inside the bucket the threshold falls in (the same linear
+    model :func:`quantile_from_snapshot` uses, inverted)."""
+    counts = hist.get("counts") or []
+    buckets = hist.get("buckets") or []
+    total = sum(counts)
+    if total <= 0:
+        return None
+    below = 0.0
+    lower = 0.0
+    for bound, in_bucket in zip(buckets, counts):
+        if threshold <= bound:
+            width = bound - lower
+            frac = (threshold - lower) / width if width > 0 else 1.0
+            below += in_bucket * min(max(frac, 0.0), 1.0)
+            return max(0.0, min(1.0, (total - below) / total))
+        below += in_bucket
+        lower = bound
+    # threshold beyond the last finite bound: only +Inf residents are above
+    return max(0.0, min(1.0, counts[-1] / total))
+
+
+def _ring_delta_sum(
+    ring: SnapshotRing | None, names: Sequence[str], window_s: float
+) -> float:
+    if ring is None:
+        return 0.0
+    return sum(
+        value
+        for name in names
+        if (value := ring.delta_sum(name, window_s)) is not None
+    )
+
+
+def _eval_window(
+    policy: SloPolicy,
+    label: str,
+    window_s: float,
+    engine_rings: Sequence[SnapshotRing],
+    router_ring: SnapshotRing | None,
+    capacity: float | None,
+) -> WindowSample:
+    sample = WindowSample(window=label, window_s=window_s)
+    if policy.kind == "latency":
+        sample.span_s = fleet_window_span(engine_rings, window_s)
+        hist = fleet_window_hist(engine_rings, policy.metric, window_s)
+        if hist is None or hist.get("count", 0) <= 0:
+            return sample
+        sample.total = float(hist["count"])
+        frac = _frac_above(hist, policy.threshold)
+        if frac is None:
+            return sample
+        budget = max(1e-9, 1.0 - policy.q)
+        sample.burn = frac / budget
+        # the quantile comes off the hist already merged above — a second
+        # fleet merge per window would double the ring-scan work per cycle
+        value = quantile_from_snapshot(hist["buckets"], hist["counts"], policy.q)
+        sample.value = None if value != value else value
+        return sample
+    if policy.kind == "error_rate":
+        ring = router_ring if policy.source == "router" else None
+        rings = [ring] if ring is not None else list(engine_rings)
+        if policy.source == "router":
+            sample.span_s = ring.span_s(window_s) if ring is not None else None
+            bad = _ring_delta_sum(ring, policy.numerator, window_s)
+            total = _ring_delta_sum(ring, policy.denominator, window_s)
+        else:
+            sample.span_s = fleet_window_span(rings, window_s)
+            bad = sum(_ring_delta_sum(r, policy.numerator, window_s) for r in rings)
+            total = sum(
+                _ring_delta_sum(r, policy.denominator, window_s) for r in rings
+            )
+        sample.total = total
+        if total <= 0:
+            return sample
+        fraction = max(0.0, min(1.0, bad / total))
+        sample.value = fraction
+        sample.burn = fraction / max(1e-9, policy.threshold)
+        return sample
+    if policy.kind == "utilization_floor":
+        sample.span_s = fleet_window_span(engine_rings, window_s)
+        if not sample.covered:
+            # young rings: an unmeasured fleet must never read as an idle
+            # one — shrinking is destructive, so the DOWN evidence demands
+            # the same genuine window coverage a breach does
+            return sample
+        if not capacity or capacity <= 0:
+            return sample
+        means = [
+            mean
+            for ring in engine_rings
+            if (mean := ring.gauge_mean(policy.metric, window_s)) is not None
+        ]
+        if not means:
+            return sample
+        sample.total = float(len(means))
+        sample.value = max(0.0, min(1.0, sum(means) / capacity))
+        return sample
+    raise ValueError(f"unknown policy kind {policy.kind!r}")
+
+
+def evaluate_policies(
+    engine_rings: Iterable[SnapshotRing],
+    router_ring: SnapshotRing | None = None,
+    policies: Sequence[SloPolicy] | None = None,
+    *,
+    fast_s: float = FAST_WINDOW_S,
+    slow_s: float = SLOW_WINDOW_S,
+    capacity: float | None = None,
+) -> list[PolicyVerdict]:
+    """Every policy over both windows. ``capacity`` is the fleet's total
+    slot capacity (sum of replica ``max_slots``) — the utilization floor's
+    denominator; None skips that policy's measurement."""
+    engine_rings = list(engine_rings)
+    out = []
+    for policy in policies if policies is not None else default_policies():
+        out.append(
+            PolicyVerdict(
+                policy=policy,
+                fast=_eval_window(
+                    policy, "fast", fast_s, engine_rings, router_ring, capacity
+                ),
+                slow=_eval_window(
+                    policy, "slow", slow_s, engine_rings, router_ring, capacity
+                ),
+            )
+        )
+    return out
+
+
+def idle_condition(verdicts: Sequence[PolicyVerdict]) -> bool:
+    """True when the fleet is measurably idle: the utilization floor holds
+    in BOTH windows and no latency/error policy is burning even singly."""
+    smoldering = any(
+        sample.burn is not None and sample.burn >= 1.0
+        for v in verdicts
+        if v.policy.kind != "utilization_floor"
+        for sample in (v.fast, v.slow)
+    )
+    floor = next(
+        (v for v in verdicts if v.policy.kind == "utilization_floor"), None
+    )
+    return (
+        floor is not None
+        and not smoldering
+        and floor.fast.value is not None
+        and floor.slow.value is not None
+        and floor.fast.value < floor.policy.threshold
+        and floor.slow.value < floor.policy.threshold
+    )
+
+
+def decide(
+    verdicts: Sequence[PolicyVerdict], down_latched: bool = False
+) -> ScaleSignal:
+    """Fold policy verdicts into one :class:`ScaleSignal`.
+
+    ``up`` when any latency/error policy breached (both windows burning) —
+    level-triggered, with the worst burner named. ``down`` only on the
+    EDGE of an idle episode (:func:`idle_condition` true and
+    ``down_latched`` false — the evaluator latches until the episode
+    clears, so a persistently idle fleet reads ``down`` once and ``hold``
+    after). Everything else ``hold``."""
+    breached = [v for v in verdicts if v.breached]
+    evidence = {
+        v.policy.name: v.to_dict()
+        for v in verdicts
+        if v.breached or v.policy.kind == "utilization_floor"
+    }
+    if breached:
+        worst = max(
+            breached,
+            key=lambda v: min(v.fast.burn or 0.0, v.slow.burn or 0.0),
+        )
+        return ScaleSignal(
+            direction="up",
+            reason=(
+                f"{worst.policy.name} burning "
+                f"{_r(worst.fast.burn, 2)}x budget over {worst.fast.window} / "
+                f"{_r(worst.slow.burn, 2)}x over {worst.slow.window} "
+                f"(objective {_r(worst.policy.threshold)})"
+            ),
+            evidence=evidence,
+        )
+    if idle_condition(verdicts):
+        floor = next(v for v in verdicts if v.policy.kind == "utilization_floor")
+        if not down_latched:
+            return ScaleSignal(
+                direction="down",
+                reason=(
+                    f"utilization {_r(floor.slow.value, 4)} below floor "
+                    f"{_r(floor.policy.threshold)} across both windows, "
+                    "no SLO burning"
+                ),
+                evidence=evidence,
+            )
+        return ScaleSignal(
+            direction="hold",
+            reason="down already recommended this episode; holding",
+            evidence=evidence,
+        )
+    return ScaleSignal(direction="hold", reason="all objectives on budget", evidence=evidence)
+
+
+class SloEvaluator:
+    """Stateful wrapper the router owns: policies + windows + the one bit
+    of episode state (the previous direction, for the down edge-trigger)."""
+
+    def __init__(
+        self,
+        policies: Sequence[SloPolicy] | None = None,
+        *,
+        fast_s: float = FAST_WINDOW_S,
+        slow_s: float = SLOW_WINDOW_S,
+    ) -> None:
+        self.policies = tuple(policies if policies is not None else default_policies())
+        self.fast_s = fast_s
+        self.slow_s = slow_s
+        self.last_signal: ScaleSignal | None = None
+        # one `down` per idle episode: latched at the recommendation, armed
+        # again only once the idle condition clears
+        self._down_latched = False
+
+    def evaluate(
+        self,
+        engine_rings: Iterable[SnapshotRing],
+        router_ring: SnapshotRing | None = None,
+        *,
+        capacity: float | None = None,
+    ) -> tuple[list[PolicyVerdict], ScaleSignal]:
+        verdicts = evaluate_policies(
+            engine_rings,
+            router_ring,
+            self.policies,
+            fast_s=self.fast_s,
+            slow_s=self.slow_s,
+            capacity=capacity,
+        )
+        signal = decide(verdicts, down_latched=self._down_latched)
+        if signal.direction == "down":
+            self._down_latched = True
+        elif not idle_condition(verdicts):
+            self._down_latched = False
+        self.last_signal = signal
+        return verdicts, signal
+
+
+def replay(
+    snapshot_sequences: Mapping[str, Sequence[Mapping[str, Any]]],
+    *,
+    router_sequence: Sequence[Mapping[str, Any]] = (),
+    policies: Sequence[SloPolicy] | None = None,
+    fast_s: float = FAST_WINDOW_S,
+    slow_s: float = SLOW_WINDOW_S,
+    capacity: float | None = None,
+) -> list[ScaleSignal]:
+    """The deterministic sim (PR 6 balancer-sim pattern): feed per-replica
+    synthetic snapshot sequences (and optionally a router sequence) through
+    fresh rings step by step, evaluating after every step — no sockets, no
+    sleeps, no wall clock. Returns the signal at each step; identical
+    fixtures produce byte-identical signal lists."""
+    evaluator = SloEvaluator(policies, fast_s=fast_s, slow_s=slow_s)
+    rings = {name: SnapshotRing() for name in snapshot_sequences}
+    router_ring = SnapshotRing() if router_sequence else None
+    steps = max(
+        [len(seq) for seq in snapshot_sequences.values()]
+        + [len(router_sequence)],
+        default=0,
+    )
+    signals: list[ScaleSignal] = []
+    for step in range(steps):
+        for name, seq in snapshot_sequences.items():
+            if step < len(seq):
+                rings[name].append(seq[step])
+        if router_ring is not None and step < len(router_sequence):
+            router_ring.append(router_sequence[step])
+        _, signal = evaluator.evaluate(
+            rings.values(), router_ring, capacity=capacity
+        )
+        signals.append(signal)
+    return signals

@@ -1,0 +1,487 @@
+"""Rolling snapshot time-series: the observatory's memory.
+
+A :class:`SnapshotRing` is a bounded ring of periodic
+:meth:`Registry.snapshot` captures, each stamped by the reserved
+``captured_at`` family the registry embeds. Two entries of the ring
+subtract to a well-defined window (same monotonic clock, same process), so
+the ring can answer the questions a *live* SLO evaluation needs — "what is
+the token rate over the last 30 s?", "what is TTFT p95 over the last
+5 min?" — with exactly the registry-delta arithmetic the loadgen SLO report
+uses post-hoc (`obs/metrics.py` ``hist_delta``/``merge_hists``/
+``quantile_from_snapshot``; docs/observability.md "Observatory").
+
+Counter resets are first-class: a replica restart makes ``after − before``
+negative, and the ring must never launder that into a negative rate.
+:meth:`SnapshotRing.append` detects the reset (any counter or histogram
+series that shrank), DROPS the pre-restart history (deltas across a restart
+are undefined — the old process's counters are gone), and reports it so the
+fleet layer can count ``fleet_replica_resets_total``; window queries clamp
+through :func:`prime_tpu.obs.metrics.counter_delta` besides.
+
+Fleet-wide views merge one window per replica ring with the same
+histogram-merge rules the report applies across engine components —
+:func:`fleet_window_hist` / :func:`fleet_window_delta` are those merges.
+
+Knobs (architecture.md "Environment knobs"): ``PRIME_OBS_RING_DEPTH`` bounds
+every ring, ``PRIME_OBS_SAMPLE_INTERVAL_S`` paces the server's
+:class:`RegistrySampler` (the fleet's rings sample on the membership health
+poll instead). Dependency-free like the rest of ``obs`` — stdlib only.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Iterable, Mapping
+
+from prime_tpu.obs.metrics import (
+    counter_delta,
+    hist_delta,
+    hist_series_from_snapshot,
+    merge_hists,
+    quantile_from_snapshot,
+    scalar_from_snapshot,
+    snapshot_captured_at,
+)
+from prime_tpu.utils.env import env_float, env_int
+
+DEFAULT_RING_DEPTH = 360
+DEFAULT_SAMPLE_INTERVAL_S = 1.0
+
+# a /metrics?format=registry reply bigger than this is not sampled: the ring
+# must not let one misbehaving replica balloon the poller's memory (same
+# cannot-balloon contract as the digest retention cap, serve/digest.py)
+MAX_SAMPLE_BYTES = 4 << 20
+
+
+def ring_depth_default() -> int:
+    """Snapshot entries per ring (PRIME_OBS_RING_DEPTH). At the fleet's
+    1 s health-poll cadence the default keeps 6 min of history — enough to
+    cover the SLO evaluator's slow (5 min) burn window with margin."""
+    return max(2, env_int("PRIME_OBS_RING_DEPTH", DEFAULT_RING_DEPTH))
+
+
+def sample_interval_default() -> float:
+    """Seconds between the server-side sampler's captures
+    (PRIME_OBS_SAMPLE_INTERVAL_S)."""
+    return max(0.05, env_float("PRIME_OBS_SAMPLE_INTERVAL_S", DEFAULT_SAMPLE_INTERVAL_S))
+
+
+def merge_registry_payload(payload: Mapping[str, Any]) -> dict | None:
+    """Flatten a ``/metrics?format=registry`` reply (``{"server": snap,
+    "engine": snap}`` on a replica, ``{"router": snap}`` on a router) into
+    ONE snapshot dict. Family names across sections are disjoint by
+    convention (``serve_*`` vs ``http_*`` vs ``fleet_*``); the reserved
+    ``captured_at`` appears once per section and the merged snapshot keeps
+    the newest (same process, same monotonic clock — they differ by the
+    microseconds between the two section snapshots). Junk shapes return
+    None instead of raising: the poller's no-raise contract covers the
+    whole payload, not just known fields."""
+    if not isinstance(payload, Mapping):
+        return None
+    merged: dict[str, Any] = {}
+    captured: float | None = None
+    for section in payload.values():
+        if not isinstance(section, Mapping):
+            continue
+        at = snapshot_captured_at(section)
+        if at is not None:
+            captured = at if captured is None else max(captured, at)
+        for name, family in section.items():
+            if name == "captured_at" or not isinstance(family, Mapping):
+                continue
+            merged.setdefault(name, family)
+    if not merged or captured is None:
+        return None
+    merged["captured_at"] = {
+        "type": "gauge",
+        "help": "Monotonic capture instant of this snapshot (seconds)",
+        "series": [{"labels": {}, "value": captured}],
+    }
+    return merged
+
+
+def _series_values(snapshot: Mapping[str, Any], kinds: tuple[str, ...]) -> dict:
+    """(family, label-tuple) -> value/count for reset detection."""
+    out: dict[tuple, float] = {}
+    for name, family in snapshot.items():
+        if name == "captured_at" or not isinstance(family, Mapping):
+            continue
+        if family.get("type") not in kinds:
+            continue
+        for series in family.get("series", []):
+            key = (name, tuple(sorted((series.get("labels") or {}).items())))
+            try:
+                out[key] = float(
+                    series["count"] if "counts" in series else series.get("value", 0.0)
+                )
+            except (TypeError, KeyError, ValueError):
+                continue
+    return out
+
+
+class SnapshotRing:
+    """Bounded ring of registry snapshots with windowed delta queries.
+
+    Thread-safe: the fleet poller appends from its poll threads while the
+    observatory endpoint reads from HTTP handler threads."""
+
+    def __init__(self, depth: int | None = None) -> None:
+        self.depth = ring_depth_default() if depth is None else max(2, int(depth))
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=self.depth)
+        self.resets = 0  # counter resets observed across the ring's lifetime
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def append(self, snapshot: Mapping[str, Any] | None) -> bool:
+        """Add one snapshot; returns True when a counter reset was detected
+        against the previous entry (the pre-reset history is dropped — a
+        delta across a process restart is undefined, and a window that
+        silently straddled one would under- or over-report forever).
+        Snapshots without a ``captured_at`` stamp are refused (no window
+        arithmetic is possible against them)."""
+        if not isinstance(snapshot, Mapping):
+            return False
+        at = snapshot_captured_at(snapshot)
+        if at is None:
+            return False
+        entry = dict(snapshot)
+        with self._lock:
+            prev = self._ring[-1] if self._ring else None
+            reset = False
+            if prev is not None:
+                prev_at = snapshot_captured_at(prev)
+                if prev_at is not None and at < prev_at:
+                    reset = True
+                else:
+                    before = _series_values(prev, ("counter", "histogram"))
+                    now = _series_values(entry, ("counter", "histogram"))
+                    reset = any(
+                        now[key] < value for key, value in before.items() if key in now
+                    )
+            if reset:
+                self._ring.clear()
+                self.resets += 1
+            self._ring.append(entry)
+            return reset
+
+    def latest(self) -> dict | None:
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+    def window(self, window_s: float) -> tuple[dict, dict] | None:
+        """(before, after) snapshot pair spanning up to ``window_s`` seconds
+        back from the newest capture: ``before`` is the newest entry at
+        least ``window_s`` old (so the window COVERS the asked span), or the
+        oldest entry when the ring is younger than the window. None until
+        two samples exist — a rate needs a denominator."""
+        with self._lock:
+            if len(self._ring) < 2:
+                return None
+            after = self._ring[-1]
+            end = snapshot_captured_at(after)
+            if end is None:
+                return None
+            before = self._ring[0]
+            for entry in reversed(self._ring):
+                at = snapshot_captured_at(entry)
+                if entry is not after and at is not None and end - at >= window_s:
+                    before = entry
+                    break
+            if before is after:
+                before = self._ring[0]
+            return before, after
+
+    def span_s(self, window_s: float) -> float | None:
+        """The seconds the :meth:`window` pair actually covers (≥ the asked
+        window once the ring is old enough, shorter on a young ring)."""
+        pair = self.window(window_s)
+        if pair is None:
+            return None
+        before, after = pair
+        b, a = snapshot_captured_at(before), snapshot_captured_at(after)
+        if b is None or a is None:
+            return None
+        return max(0.0, a - b)
+
+    def delta(
+        self, name: str, window_s: float, labels: Mapping[str, str] | None = None
+    ) -> float | None:
+        """Windowed counter delta, reset-clamped (never negative)."""
+        pair = self.window(window_s)
+        if pair is None:
+            return None
+        before, after = pair
+        value, _ = counter_delta(
+            scalar_from_snapshot(before, name, labels),
+            scalar_from_snapshot(after, name, labels),
+        )
+        return value
+
+    def delta_sum(self, name: str, window_s: float) -> float | None:
+        """Windowed delta of a labeled counter summed over ALL its series
+        (e.g. ``fleet_requests_total`` across replicas and outcomes),
+        reset-clamped on the total."""
+        pair = self.window(window_s)
+        if pair is None:
+            return None
+        before, after = pair
+
+        def total(snapshot: Mapping[str, Any]) -> float:
+            family = snapshot.get(name)
+            if not isinstance(family, Mapping):
+                return 0.0
+            out = 0.0
+            for series in family.get("series", []):
+                try:
+                    out += float(series.get("value", 0.0))
+                except (TypeError, ValueError):
+                    continue
+            return out
+
+        value, _ = counter_delta(total(before), total(after))
+        return value
+
+    def rate(
+        self, name: str, window_s: float, labels: Mapping[str, str] | None = None
+    ) -> float | None:
+        """Windowed per-second rate of a counter (e.g.
+        ``rate("serve_tokens_emitted_total", 30)``). None until the ring has
+        a window; never negative."""
+        pair = self.window(window_s)
+        if pair is None:
+            return None
+        before, after = pair
+        b, a = snapshot_captured_at(before), snapshot_captured_at(after)
+        if b is None or a is None or a <= b:
+            return None
+        value, _ = counter_delta(
+            scalar_from_snapshot(before, name, labels),
+            scalar_from_snapshot(after, name, labels),
+        )
+        return value / (a - b)
+
+    def hist_window(
+        self, name: str, window_s: float, labels: Mapping[str, str] | None = None
+    ) -> dict | None:
+        """Windowed histogram delta (buckets/counts/sum/count of just this
+        window's observations)."""
+        pair = self.window(window_s)
+        if pair is None:
+            return None
+        before, after = pair
+        return hist_delta(
+            hist_series_from_snapshot(before, name, labels),
+            hist_series_from_snapshot(after, name, labels),
+        )
+
+    def quantile(
+        self,
+        name: str,
+        q: float,
+        window_s: float,
+        labels: Mapping[str, str] | None = None,
+    ) -> float | None:
+        """Windowed quantile estimate (e.g.
+        ``quantile("serve_ttft_seconds", 0.95, 30)``) — the interpolation is
+        :func:`quantile_from_snapshot` over the window's bucket delta. None
+        when the window saw no observations."""
+        hist = self.hist_window(name, window_s, labels)
+        if hist is None or hist.get("count", 0) <= 0:
+            return None
+        value = quantile_from_snapshot(hist["buckets"], hist["counts"], q)
+        return None if value != value else value  # NaN -> None
+
+    def gauge_mean(
+        self, name: str, window_s: float, labels: Mapping[str, str] | None = None
+    ) -> float | None:
+        """Mean of a gauge's sampled values across the window's snapshots —
+        the utilization-floor policy reads load gauges through this (a
+        single point-in-time read would flap on every idle tick). Snapshots
+        that never carried the family contribute nothing: "no data" must
+        answer None, never a fabricated zero (a loading replica without the
+        gauge is not an idle one)."""
+        with self._lock:
+            entries = list(self._ring)
+        if not entries:
+            return None
+        end = snapshot_captured_at(entries[-1])
+        if end is None:
+            return None
+        values = [
+            scalar_from_snapshot(entry, name, labels)
+            for entry in entries
+            if name in entry
+            and (at := snapshot_captured_at(entry)) is not None
+            and end - at <= window_s
+        ]
+        if not values:
+            return None
+        return sum(values) / len(values)
+
+
+# ---- fleet merges -----------------------------------------------------------
+
+
+def fleet_window_hist(
+    rings: Iterable[SnapshotRing],
+    name: str,
+    window_s: float,
+    labels: Mapping[str, str] | None = None,
+) -> dict | None:
+    """One fleet-wide windowed histogram: each replica ring contributes its
+    own window delta, merged with the report's histogram-merge rules."""
+    return merge_hists(
+        ring.hist_window(name, window_s, labels) for ring in rings
+    )
+
+
+def fleet_window_delta(
+    rings: Iterable[SnapshotRing],
+    name: str,
+    window_s: float,
+    labels: Mapping[str, str] | None = None,
+) -> float:
+    """Sum of per-replica windowed counter deltas (each reset-clamped)."""
+    return sum(
+        value
+        for ring in rings
+        if (value := ring.delta(name, window_s, labels)) is not None
+    )
+
+
+def fleet_window_span(rings: Iterable[SnapshotRing], window_s: float) -> float | None:
+    """The widest span any replica's window actually covers — the
+    denominator for fleet-wide rates (replicas sample on the same poll
+    cadence, so spans agree to within one poll interval)."""
+    spans = [
+        span for ring in rings if (span := ring.span_s(window_s)) is not None
+    ]
+    return max(spans) if spans else None
+
+
+def fleet_rate(
+    rings: Iterable[SnapshotRing],
+    name: str,
+    window_s: float,
+    labels: Mapping[str, str] | None = None,
+) -> float | None:
+    """Fleet-wide windowed per-second rate of a counter."""
+    rings = list(rings)
+    span = fleet_window_span(rings, window_s)
+    if not span:
+        return None
+    return fleet_window_delta(rings, name, window_s, labels) / span
+
+
+def fleet_quantile(
+    rings: Iterable[SnapshotRing],
+    name: str,
+    q: float,
+    window_s: float,
+    labels: Mapping[str, str] | None = None,
+) -> float | None:
+    """Fleet-wide windowed quantile over the merged histogram delta."""
+    hist = fleet_window_hist(rings, name, window_s, labels)
+    if hist is None or hist.get("count", 0) <= 0:
+        return None
+    value = quantile_from_snapshot(hist["buckets"], hist["counts"], q)
+    return None if value != value else value
+
+
+# the observatory view's standard serving window: rates from the engine
+# token/request counters, percentiles from the latency histograms — the
+# same families the loadgen SLO report windows post-hoc
+SERVING_WINDOW_RATES: tuple[tuple[str, str], ...] = (
+    ("tok_s", "serve_tokens_emitted_total"),
+    ("admitted_per_s", "serve_requests_admitted_total"),
+    ("completed_per_s", "serve_requests_completed_total"),
+)
+SERVING_WINDOW_QUANTILES: tuple[tuple[str, str, float], ...] = (
+    ("ttft_p50_s", "serve_ttft_seconds", 0.5),
+    ("ttft_p95_s", "serve_ttft_seconds", 0.95),
+    ("tpot_p95_s", "serve_tpot_seconds", 0.95),
+    ("queue_wait_p95_s", "serve_queue_wait_seconds", 0.95),
+)
+
+
+def serving_window_view(
+    rings: Iterable[SnapshotRing], window_s: float
+) -> dict[str, Any]:
+    """One window's serving stats over a set of engine rings — the shared
+    shape inside ``GET /admin/observatory`` on both the fleet router (rings
+    = every replica's) and the single-replica server (one ring). ``None``
+    values mean "no data in this window", never zero-disguised-as-idle."""
+    rings = list(rings)
+    span = fleet_window_span(rings, window_s)  # computed once for all rates
+    view: dict[str, Any] = {
+        "window_s": window_s,
+        "span_s": round(span, 3) if span is not None else None,
+    }
+    for key, metric in SERVING_WINDOW_RATES:
+        view[key] = (
+            round(fleet_window_delta(rings, metric, window_s) / span, 3)
+            if span
+            else None
+        )
+    for key, metric, q in SERVING_WINDOW_QUANTILES:
+        value = fleet_quantile(rings, metric, q, window_s)
+        view[key] = round(value, 6) if value is not None else None
+    return view
+
+
+# ---- periodic capture -------------------------------------------------------
+
+
+class RegistrySampler:
+    """Background thread feeding a ring from a snapshot callable at a fixed
+    interval — the single-replica server's "periodic capture" (the fleet's
+    rings ride the membership health poll instead and need no extra thread).
+    ``sample_now()`` is the synchronous path tests and the observatory
+    endpoint use; the thread exists so an unwatched server still has history
+    when an operator first asks."""
+
+    def __init__(
+        self,
+        snapshot_fn: Callable[[], Mapping[str, Any] | None],
+        ring: SnapshotRing,
+        interval_s: float | None = None,
+    ) -> None:
+        self._snapshot_fn = snapshot_fn
+        self.ring = ring
+        self.interval_s = (
+            sample_interval_default() if interval_s is None else max(0.05, interval_s)
+        )
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def sample_now(self) -> bool:
+        """Capture one snapshot into the ring; returns the reset flag.
+        Never raises — a broken snapshot source must not take down the
+        sampler loop or an observatory request."""
+        try:
+            return self.ring.append(self._snapshot_fn())
+        except Exception:  # noqa: BLE001 — sampling must never break serving
+            return False
+
+    def start(self) -> "RegistrySampler":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="obs-sampler"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample_now()

@@ -32,6 +32,17 @@ FleetRouter and the truth about which of them may receive traffic:
   fleet's phase split. Parsed with the same tolerance as the digest —
   unknown/absent coerces to ``any``, never a poll failure — so a mixed-
   generation fleet routes exactly as before the field existed.
+- **Observatory sampling.** Alongside each successful /healthz probe the
+  poller captures the replica's ``/metrics?format=registry`` into a bounded
+  per-replica :class:`~prime_tpu.obs.timeseries.SnapshotRing` — the raw
+  material for the router's ``/admin/observatory`` fleet view (windowed
+  rates, burn-rate SLO evaluation; docs/observability.md "Observatory").
+  The capture shares the digest's tolerance contract: an absent endpoint,
+  junk JSON, a pre-observatory reply shape, or an oversized payload all
+  degrade to "no sample this cycle", never a poll failure — and a detected
+  counter reset (replica restart) drops the stale history and is reported
+  through the ``on_sample`` hook so the router can count
+  ``fleet_replica_resets_total``.
 - **Drain.** ``drain(replica_id)`` marks the replica draining locally —
   routing excludes it immediately, so the consistent-hash ring rebalances
   its arcs — and (best-effort) POSTs the replica's ``/admin/drain`` so it
@@ -46,11 +57,17 @@ balancer's heuristics, which tolerate a poll interval of staleness anyway.
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Any, Callable, Iterable
 from urllib.parse import urlsplit
 
+from prime_tpu.obs.timeseries import (
+    MAX_SAMPLE_BYTES,
+    SnapshotRing,
+    merge_registry_payload,
+)
 from prime_tpu.serve.digest import parse_adapters, parse_digest, parse_role
 
 BREAKER_CLOSED = "closed"
@@ -112,10 +129,19 @@ class Replica:
         # for replicas that predate the field or serve base-only — the
         # balancer's adapter-affinity filter reads this
         self.adapters: frozenset[str] = frozenset()
+        # observatory ring: this replica's registry snapshots as captured by
+        # the health poll (obs/timeseries.py)
+        self.ring = SnapshotRing()
         # breaker
         self.breaker = BREAKER_CLOSED
         self.consecutive_failures = 0
         self.open_until = 0.0
+
+    @property
+    def resets(self) -> int:
+        """Counter resets (replica restarts) the sampling detected — the
+        ring already counts them; a second mirror field could drift."""
+        return self.ring.resets
 
     def snapshot(self) -> dict[str, Any]:
         return {
@@ -130,6 +156,8 @@ class Replica:
             "consecutive_failures": self.consecutive_failures,
             "digest_entries": len(self.digest),
             "adapters": len(self.adapters),
+            "samples": len(self.ring),
+            "resets": self.resets,
             "last_poll_age_s": (
                 round(time.monotonic() - self.last_poll_at, 3) if self.last_poll_at else None
             ),
@@ -162,6 +190,12 @@ class FleetMembership:
         # router hook: bump gauges (breaker state, per-replica health) on any
         # transition without membership importing the metrics wiring
         self._on_change = on_change
+        # observatory hooks, same inversion: `_on_sample(replica, reset)`
+        # fires after a registry capture (reset=True on a detected counter
+        # reset), `_on_poll()` after every full poll cycle — the router
+        # hangs its own-registry sampling + SLO evaluation off it
+        self._on_sample: Callable[[Replica, bool], None] | None = None
+        self._on_poll: Callable[[], None] | None = None
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._client = None  # lazy httpx.Client (poller + drain POSTs only)
@@ -320,9 +354,34 @@ class FleetMembership:
             # absent coerces to empty (base-only routing), capped retention
             replica.adapters = parse_adapters(body.get("adapters"))
 
+    def apply_metrics(self, replica: Replica, payload: Any) -> bool:
+        """Capture one ``/metrics?format=registry`` payload into the
+        replica's observatory ring. Split out of poll_once (like
+        apply_health) so the schema tolerance is testable without sockets:
+        junk shapes, pre-observatory replies (no ``captured_at``), and
+        partial sections all degrade to "not sampled" — NEVER an exception,
+        never a poll failure. Returns True when a counter reset was
+        detected (the hook consumer counts it)."""
+        reset = False
+        try:
+            merged = merge_registry_payload(payload)
+            if merged is None:
+                return False
+            reset = replica.ring.append(merged)
+        except Exception:  # noqa: BLE001 — sampling must never fail a poll
+            return False
+        if self._on_sample is not None:
+            try:
+                self._on_sample(replica, reset)
+            except Exception:  # noqa: BLE001 — observer hook must not break polling
+                pass
+        return reset
+
     def poll_once(self, replica: Replica) -> None:
         """One health probe: snapshot /healthz onto the replica, feed the
-        breaker. In the half-open state this IS the trial request."""
+        breaker. In the half-open state this IS the trial request. A healthy
+        reply is followed by the observatory's registry capture (best
+        effort — see apply_metrics)."""
         import httpx
 
         try:
@@ -339,6 +398,42 @@ class FleetMembership:
             pass
         self.apply_health(replica, body, response.status_code)
         self.note_success(replica.id)
+        # observatory capture rides the same probe cycle: any failure mode —
+        # connect error, non-200, oversized body, junk JSON, a drip-fed body
+        # — skips the sample and nothing else (the health verdict above
+        # already stands). The body STREAMS against the size cap (buffering
+        # first would let one misbehaving replica balloon the poller's
+        # memory every cycle) AND against a wall-clock deadline: httpx's
+        # read timeout resets per chunk, so without the deadline a replica
+        # dripping one chunk per second could pin a poll worker for minutes
+        # — the 'each poll is probe_timeout-bounded' invariant poll_all's
+        # wait margin and pool sizing rely on.
+        raw = b""
+        deadline = time.monotonic() + self.probe_timeout
+        try:
+            with self._http().stream(
+                "GET", f"{replica.url}/metrics", params={"format": "registry"}
+            ) as metrics:
+                if metrics.status_code != 200:
+                    return
+                declared = metrics.headers.get("Content-Length", "0")
+                if declared.isdigit() and int(declared) > MAX_SAMPLE_BYTES:
+                    return
+                chunks: list[bytes] = []
+                total = 0
+                for chunk in metrics.iter_bytes():
+                    total += len(chunk)
+                    if total > MAX_SAMPLE_BYTES or time.monotonic() > deadline:
+                        return
+                    chunks.append(chunk)
+                raw = b"".join(chunks)
+        except httpx.HTTPError:
+            return
+        try:
+            payload = json.loads(raw)
+        except ValueError:
+            return
+        self.apply_metrics(replica, payload)
 
     def poll_all(self) -> None:
         """Probe every replica concurrently: a blackholed host (no RST, just
@@ -353,6 +448,7 @@ class FleetMembership:
         if len(replicas) <= 1:
             for replica in replicas:
                 self.poll_once(replica)
+            self._poll_cycle_done()
             return
         with self._lock:
             if self._poll_pool is None:
@@ -361,8 +457,17 @@ class FleetMembership:
                 )
             pool = self._poll_pool
         futures = [pool.submit(self.poll_once, replica) for replica in replicas]
-        # probe_timeout bounds each poll; the margin covers scheduling
-        concurrent.futures.wait(futures, timeout=self.probe_timeout + 1.0)
+        # each poll is two probe_timeout-bounded requests (healthz + the
+        # observatory's registry capture); the margin covers scheduling
+        concurrent.futures.wait(futures, timeout=2 * self.probe_timeout + 1.0)
+        self._poll_cycle_done()
+
+    def _poll_cycle_done(self) -> None:
+        if self._on_poll is not None:
+            try:
+                self._on_poll()
+            except Exception:  # noqa: BLE001 — observer hook must not break polling
+                pass
 
     def start(self) -> "FleetMembership":
         if self._thread is not None:

@@ -36,6 +36,15 @@ counters by reason, breaker-state gauges, queue-wait histogram) rendered at
 server. ``/admin/fleet`` dumps membership; ``POST /admin/drain`` starts a
 graceful drain; ``POST /admin/join`` registers a new replica (what
 ``prime serve --replica-of`` calls after binding).
+
+The router is also the fleet's **SLO observatory** (docs/observability.md
+"Observatory"): the health poll captures every replica's registry into
+rolling per-replica snapshot rings, each poll cycle evaluates burn-rate SLO
+policies (obs/slo.py) over them inside a ``fleet.observe`` span, and
+``GET /admin/observatory`` (admin-token parity) serves the merged fleet
+view — windowed rates/percentiles, active burn alerts, and the current
+``up``/``down``/``hold`` scale signal (recommendation only; the autoscaler
+that acts on it is ROADMAP item 5). `prime serve top` renders it live.
 """
 
 from __future__ import annotations
@@ -49,6 +58,8 @@ from urllib.parse import parse_qs, urlsplit
 
 from prime_tpu.obs.flight import FlightRecorder, parse_summary_limit
 from prime_tpu.obs.metrics import Registry
+from prime_tpu.obs.slo import ScaleSignal, SloEvaluator
+from prime_tpu.obs.timeseries import SnapshotRing, serving_window_view
 from prime_tpu.obs.trace import (
     TRACEPARENT_HEADER,
     TRACER,
@@ -279,6 +290,34 @@ class FleetRouter:
         self._m_inflight = r.gauge(
             "fleet_inflight_requests", "Chat requests currently proxied upstream"
         )
+        # SLO observatory (docs/observability.md "Observatory"): the health
+        # poll captures every replica's registry into per-replica rings; the
+        # router samples its OWN registry here and evaluates burn-rate SLO
+        # policies each poll cycle, publishing the recommendation
+        self._m_scale_signal = r.gauge(
+            "fleet_scale_signal",
+            "Current observatory scale recommendation: 1=up 0=hold -1=down",
+        )
+        self._m_slo_breach = r.counter(
+            "fleet_slo_breach_total",
+            "Observe cycles in which an SLO policy's window burned past its "
+            "threshold, by policy and window",
+            labelnames=("slo", "window"),
+        )
+        self._m_replica_resets = r.counter(
+            "fleet_replica_resets_total",
+            "Counter resets (replica restarts) detected by the observatory's "
+            "registry sampling, by replica",
+            labelnames=("replica",),
+        )
+        self.ring = SnapshotRing()  # the router's own registry history
+        self.slo = SloEvaluator()
+        # reentrant: observatory_view holds it across a nested observe_once
+        self._observe_lock = threading.RLock()
+        self._last_verdicts: list = []
+        self._last_signal: ScaleSignal | None = None
+        self.membership._on_sample = self._on_replica_sample
+        self.membership._on_poll = self._observe_safe
         self._t0 = time.monotonic()
 
         outer = self
@@ -331,6 +370,14 @@ class FleetRouter:
                         self._json(200, outer.stats())
                 elif path == "/admin/fleet":
                     self._json(200, {"replicas": outer.membership.snapshot()})
+                elif path == "/admin/observatory":
+                    # the fleet SLO view: windowed rates/percentiles, burn
+                    # evidence, the scale signal. Admin parity like
+                    # /debug/requests — it exposes replica ids and load.
+                    if not outer._admin_authorized(self.headers):
+                        self._json(403, {"error": {"message": "admin token required"}})
+                        return
+                    self._json(200, outer.observatory_view())
                 elif path.rstrip("/") == "/debug/requests" or path.startswith(
                     "/debug/requests/"
                 ):
@@ -1049,6 +1096,139 @@ class FleetRouter:
         )
 
     # ---- observability ---------------------------------------------------
+
+    def _on_replica_sample(self, replica, reset: bool) -> None:
+        """Membership hook: one registry capture landed on a replica ring;
+        a detected counter reset means the replica restarted."""
+        if reset:
+            self._m_replica_resets.inc(replica=replica.id)
+
+    def _observe_safe(self) -> None:
+        try:
+            self.observe_once()
+        except Exception:  # noqa: BLE001 — the poll loop must never die over SLO math
+            pass
+
+    def _fresh_replicas(self) -> list:
+        """Replicas whose rings may argue about the PRESENT: successfully
+        polled within the last few cycles. A dead replica's ring freezes
+        with its final windows intact (the ring anchors 'now' to its own
+        newest capture), so merging it forever would pin its last storm —
+        or its phantom idleness — into every future evaluation."""
+        horizon = max(3 * self.membership.poll_interval, self.membership.probe_timeout)
+        now = time.monotonic()
+        with self.membership._lock:
+            replicas = list(self.membership.replicas.values())
+        return [
+            r for r in replicas
+            if r.last_poll_at and now - r.last_poll_at <= horizon
+        ]
+
+    def observe_once(self):
+        """One observatory cycle (rides the membership poll): sample the
+        router's own registry into its ring, evaluate the SLO policies over
+        every replica's ring + the router's, publish the result
+        (``fleet_scale_signal`` gauge, ``fleet_slo_breach_total`` counters)
+        — all inside a ``fleet.observe`` span so the observatory itself is
+        observable. Returns (verdicts, signal)."""
+        with self._observe_lock:
+            with TRACER.span("fleet.observe") as span:
+                self.ring.append(self.registry.snapshot())
+                replicas = self._fresh_replicas()
+                rings = [replica.ring for replica in replicas]
+                capacity = sum(r.max_slots for r in replicas)
+                verdicts, signal = self.slo.evaluate(
+                    rings, self.ring, capacity=capacity or None
+                )
+                self._m_scale_signal.set(ScaleSignal.GAUGE[signal.direction])
+                for verdict in verdicts:
+                    if verdict.policy.kind == "utilization_floor":
+                        continue
+                    for sample in (verdict.fast, verdict.slow):
+                        if (
+                            sample.burn is not None
+                            and sample.burn >= verdict.policy.burn_threshold
+                        ):
+                            self._m_slo_breach.inc(
+                                slo=verdict.policy.name, window=sample.window
+                            )
+                span.set_attr("signal", signal.direction)
+                span.set_attr("replicas", len(replicas))
+                self._last_verdicts, self._last_signal = verdicts, signal
+                return verdicts, signal
+
+    def _router_window(self, window_s: float) -> dict:
+        """Router-side slice of one observatory window (429s, queue wait) —
+        called with the observe lock held (the SnapshotRing is internally
+        thread-safe besides; the lock keeps the view's windows mutually
+        consistent with the verdicts rendered next to them)."""
+        rejected = self.ring.delta("fleet_admission_rejected_total", window_s)
+        forwarded = self.ring.delta_sum("fleet_requests_total", window_s)
+        wait = self.ring.quantile("fleet_queue_wait_seconds", 0.95, window_s)
+        if rejected is None and forwarded is None:
+            # no router window yet: an unmeasured router must read as
+            # unmeasured, not as an idle one (None, never fabricated zeros)
+            return {
+                "requests": None,
+                "rejected_429": None,
+                "reject_rate": None,
+                "router_queue_wait_p95_s": (
+                    round(wait, 6) if wait is not None else None
+                ),
+            }
+        total = (rejected or 0.0) + (forwarded or 0.0)
+        return {
+            "requests": int(total),
+            "rejected_429": int(rejected) if rejected is not None else None,
+            "reject_rate": (
+                round((rejected or 0.0) / total, 4) if total else None
+            ),
+            "router_queue_wait_p95_s": (
+                round(wait, 6) if wait is not None else None
+            ),
+        }
+
+    def observatory_view(self) -> dict:
+        """GET /admin/observatory: the fleet SLO view. Replica table (live
+        load + sampling state + windowed token rate), fleet-wide windowed
+        rates/percentiles over fast and slow windows (same histogram-merge
+        rules as the loadgen report), the latest burn-rate verdicts, and
+        the current scale signal. Schema in docs/observability.md."""
+        with self._observe_lock:
+            if self._last_signal is None:
+                self.observe_once()
+            with self.membership._lock:
+                replicas = list(self.membership.replicas.values())
+            # the TABLE lists everyone (a dead replica should be visible);
+            # the merged windows only read freshly-sampled rings, matching
+            # what the SLO evaluation saw
+            rings = [replica.ring for replica in self._fresh_replicas()]
+            fast_s, slow_s = self.slo.fast_s, self.slo.slow_s
+            rows = []
+            for replica in replicas:
+                row = replica.snapshot()
+                rate = replica.ring.rate("serve_tokens_emitted_total", fast_s)
+                row["tok_s"] = round(rate, 3) if rate is not None else None
+                rows.append(row)
+            signal = self._last_signal or ScaleSignal("hold", "no evaluation yet")
+            return {
+                "windows": {"fast_s": fast_s, "slow_s": slow_s},
+                "signal": signal.to_dict(),
+                "slo": [verdict.to_dict() for verdict in self._last_verdicts],
+                "replicas": rows,
+                "fleet": {
+                    "fast": {
+                        **serving_window_view(rings, fast_s),
+                        **self._router_window(fast_s),
+                    },
+                    "slow": {
+                        **serving_window_view(rings, slow_s),
+                        **self._router_window(slow_s),
+                    },
+                },
+                "resets": int(sum(replica.resets for replica in replicas)),
+                "uptime_s": round(time.monotonic() - self._t0, 3),
+            }
 
     def debug_request(self, request_id: str) -> tuple[int, dict]:
         """GET /debug/requests/{id}: the router's hop timeline merged with

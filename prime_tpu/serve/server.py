@@ -36,6 +36,13 @@ from urllib.parse import parse_qs, urlsplit
 from prime_tpu.core.config import env_flag, env_int, env_str
 from prime_tpu.obs.flight import FlightRecorder, parse_summary_limit
 from prime_tpu.obs.metrics import Registry
+from prime_tpu.obs.slo import SloEvaluator
+from prime_tpu.obs.timeseries import (
+    RegistrySampler,
+    SnapshotRing,
+    merge_registry_payload,
+    serving_window_view,
+)
 from prime_tpu.obs.trace import (
     TRACEPARENT_HEADER,
     TRACER,
@@ -96,6 +103,17 @@ def _route_label(path: str) -> str:
     if p.startswith("/debug/"):
         return "/debug"
     return "other"
+
+
+def _as_nonneg_int(value: Any) -> int:
+    """Defensive int for stats()-sourced fields in the observatory view —
+    a backend's junk value must degrade to 0, not 500 the endpoint."""
+    try:
+        if isinstance(value, bool) or value is None:
+            return int(bool(value))
+        return max(0, int(value))
+    except (TypeError, ValueError):
+        return 0
 
 
 def render_chat_prompt(messages: list[dict[str, str]]) -> str:
@@ -178,6 +196,15 @@ class InferenceServer:
         self._m_http_latency = self.registry.histogram(
             "http_request_seconds", "HTTP request wall time", labelnames=("route",)
         )
+        # single-replica observatory (docs/observability.md "Observatory"):
+        # a rolling ring of this process's merged server+engine snapshots,
+        # fed by a periodic sampler (PRIME_OBS_SAMPLE_INTERVAL_S) so the
+        # windowed view at GET /admin/observatory has history even before
+        # anyone asks — the fleet router keeps its own per-replica rings
+        # through the health poll instead of scraping this one
+        self.obs_ring = SnapshotRing()
+        self._sampler = RegistrySampler(self._observatory_snapshot, self.obs_ring)
+        self._slo = SloEvaluator()
         self._t0 = time.monotonic()
         outer = self
 
@@ -290,6 +317,14 @@ class InferenceServer:
                         self._json(
                             200, outer.flight_recorder().summaries(limit=limit)
                         )
+                elif path == "/admin/observatory":
+                    # single-replica SLO view (windowed rates/percentiles +
+                    # burn verdicts over this process's own ring); admin
+                    # parity like the rest of /admin and /debug
+                    if not outer._admin_authorized(self.headers):
+                        self._json(403, {"error": {"message": "admin token required"}})
+                        return
+                    self._json(200, outer.observatory_view())
                 elif path == "/admin/kv":
                     # prefix-KV wire export (disaggregated serving): admin-
                     # token parity with /admin/drain — a payload is raw KV
@@ -582,6 +617,58 @@ class InferenceServer:
         if isinstance(engine_registry, Registry) and engine_registry is not self.registry:
             payload["engine"] = engine_registry.snapshot()
         return payload
+
+    def _observatory_snapshot(self) -> dict | None:
+        """One merged server+engine snapshot for the observatory ring —
+        the same payload shape ``/metrics?format=registry`` serves, flattened
+        the same way the fleet poller flattens its scrapes."""
+        return merge_registry_payload(self.metrics_registry())
+
+    def observatory_sample(self) -> bool:
+        """Capture one snapshot into the ring right now (the sampler thread
+        does this periodically; tests and the observatory endpoint call it
+        synchronously). Returns True when a counter reset was detected."""
+        return self._sampler.sample_now()
+
+    def observatory_view(self) -> dict:
+        """GET /admin/observatory: the single-replica twin of the fleet
+        router's view — windowed token/admission rates and latency
+        percentiles over this process's ring, the engine-side SLO verdicts,
+        and the resulting signal. Router-sourced policies (the 429-rate
+        objective reads the router registry) report no data here."""
+        self.observatory_sample()
+        stats: dict = {}
+        stats_fn = getattr(self.generator, "stats", None)
+        if callable(stats_fn):
+            try:
+                stats = stats_fn()
+            except Exception:  # noqa: BLE001 — the view must never 500
+                stats = {}
+        capacity = _as_nonneg_int(stats.get("max_slots"))
+        verdicts, signal = self._slo.evaluate(
+            [self.obs_ring], None, capacity=capacity or None
+        )
+        fast_s, slow_s = self._slo.fast_s, self._slo.slow_s
+        return {
+            "windows": {"fast_s": fast_s, "slow_s": slow_s},
+            "signal": signal.to_dict(),
+            "slo": [verdict.to_dict() for verdict in verdicts],
+            "replica": {
+                "model": self.model_id,
+                "role": self.role,
+                "state": self.healthz()["state"],
+                "queue_depth": _as_nonneg_int(stats.get("queue_depth")),
+                "active_slots": _as_nonneg_int(stats.get("active_slots")),
+                "max_slots": capacity,
+                "samples": len(self.obs_ring),
+                "resets": self.obs_ring.resets,
+            },
+            "serving": {
+                "fast": serving_window_view([self.obs_ring], fast_s),
+                "slow": serving_window_view([self.obs_ring], slow_s),
+            },
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+        }
 
     def healthz(self) -> dict:
         """GET /healthz: readiness for routers / k8s probes. ``state`` is the
@@ -964,15 +1051,18 @@ class InferenceServer:
 
     def start(self) -> "InferenceServer":
         self._serving = True
+        self._sampler.start()  # periodic observatory captures (daemon)
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._thread.start()
         return self
 
     def serve_forever(self) -> None:
         self._serving = True
+        self._sampler.start()
         self._server.serve_forever()
 
     def stop(self) -> None:
+        self._sampler.stop()
         # shutdown() handshakes with the serve_forever loop and DEADLOCKS if
         # that loop never started (e.g. model load failed right after bind)
         if getattr(self, "_serving", False):

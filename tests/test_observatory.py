@@ -1,0 +1,707 @@
+"""Fleet SLO observatory: rolling time-series, burn-rate evaluation, scale
+signals (docs/observability.md "Observatory").
+
+The load-bearing properties:
+
+1. the snapshot ring's windowed rate/quantile queries reproduce the loadgen
+   report's registry-delta arithmetic live, and NEVER emit a negative rate
+   across a replica restart (counter-reset clamp + ring drop + reset count);
+2. the burn-rate sim is deterministic: a rate_storm-shaped fixture replays
+   to `up`, an idle fixture to `down`→`hold`, byte-identically across
+   reruns — no sockets, no sleeps, no wall clock;
+3. the fleet poller's registry capture shares the digest's tolerance
+   contract (junk/absent/oversized never fails a poll);
+4. `GET /admin/observatory` (router and server, admin-token parity) reports
+   windowed tok/s agreeing with the loadgen SLO report for the same run.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import httpx
+import pytest
+
+from prime_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    Registry,
+    counter_delta,
+    hist_delta,
+)
+from prime_tpu.obs.slo import (
+    FAST_WINDOW_S,
+    SloEvaluator,
+    default_policies,
+    replay,
+)
+from prime_tpu.obs.timeseries import (
+    SnapshotRing,
+    merge_registry_payload,
+    serving_window_view,
+)
+from prime_tpu.serve.fleet import FleetMembership
+
+# ---- synthetic snapshot fixtures (pure dicts, hand-stamped clocks) ----------
+
+BUCKETS = list(DEFAULT_LATENCY_BUCKETS)
+
+
+def _hist(observations: list[float]) -> dict:
+    counts = [0] * (len(BUCKETS) + 1)
+    for value in observations:
+        for i, bound in enumerate(BUCKETS):
+            if value <= bound:
+                counts[i] += 1
+                break
+        else:
+            counts[-1] += 1
+    return {
+        "buckets": list(BUCKETS),
+        "counts": counts,
+        "sum": float(sum(observations)),
+        "count": len(observations),
+    }
+
+
+def snap(
+    t: float,
+    counters: dict | None = None,
+    hists: dict | None = None,
+    gauges: dict | None = None,
+) -> dict:
+    """A synthetic Registry.snapshot() with an explicit capture instant —
+    the sim's whole point is that no wall clock is involved."""
+    out: dict = {
+        "captured_at": {
+            "type": "gauge",
+            "help": "t",
+            "series": [{"labels": {}, "value": float(t)}],
+        }
+    }
+    for name, value in (counters or {}).items():
+        out[name] = {
+            "type": "counter",
+            "help": name,
+            "series": [{"labels": {}, "value": float(value)}],
+        }
+    for name, observations in (hists or {}).items():
+        out[name] = {"type": "histogram", "help": name, "series": [
+            {"labels": {}, **_hist(observations)}
+        ]}
+    for name, value in (gauges or {}).items():
+        out[name] = {
+            "type": "gauge",
+            "help": name,
+            "series": [{"labels": {}, "value": float(value)}],
+        }
+    return out
+
+
+# ---- ring arithmetic --------------------------------------------------------
+
+
+def test_ring_windowed_rate_and_quantile():
+    """rate()/quantile() answer over the asked window's delta only — the
+    pre-window history must not leak into the estimate."""
+    ring = SnapshotRing(depth=16)
+    # 60 s of history: slow tokens + slow TTFTs early, fast late
+    ring.append(snap(0, counters={"serve_tokens_emitted_total": 0},
+                     hists={"serve_ttft_seconds": []}))
+    ring.append(snap(30, counters={"serve_tokens_emitted_total": 300},
+                     hists={"serve_ttft_seconds": [8.0] * 10}))
+    ring.append(snap(60, counters={"serve_tokens_emitted_total": 1500},
+                     hists={"serve_ttft_seconds": [8.0] * 10 + [0.1] * 30}))
+    # last-30s window: 1200 tokens over 30 s
+    assert ring.rate("serve_tokens_emitted_total", 30) == pytest.approx(40.0)
+    # whole history: 1500 over 60 s
+    assert ring.rate("serve_tokens_emitted_total", 60) == pytest.approx(25.0)
+    # the last 30 s saw ONLY the 0.1 s TTFTs: p95 must not see the 8 s ones
+    q = ring.quantile("serve_ttft_seconds", 0.95, 30)
+    assert q is not None and q < 0.5
+    # over the full hour the 8 s observations surface again
+    q_all = ring.quantile("serve_ttft_seconds", 0.95, 120)
+    assert q_all is not None and q_all > 1.0
+    # a single-sample ring has no window
+    fresh = SnapshotRing(depth=4)
+    fresh.append(snap(0, counters={"serve_tokens_emitted_total": 5}))
+    assert fresh.rate("serve_tokens_emitted_total", 30) is None
+
+
+def test_ring_counter_reset_clamps_and_counts():
+    """Satellite: a replica restart (counters shrink) must clamp to the
+    post-reset value, count the reset, drop pre-restart history, and never
+    emit a negative rate."""
+    assert counter_delta(100.0, 40.0) == (40.0, True)
+    assert counter_delta(40.0, 100.0) == (60.0, False)
+    shrunk = hist_delta(_hist([1.0] * 5), _hist([1.0] * 2))
+    assert shrunk is not None and shrunk["count"] == 2  # post-reset series
+    ring = SnapshotRing(depth=8)
+    ring.append(snap(0, counters={"serve_tokens_emitted_total": 0}))
+    ring.append(snap(10, counters={"serve_tokens_emitted_total": 500}))
+    # restart: counter falls back toward zero
+    reset = ring.append(snap(20, counters={"serve_tokens_emitted_total": 30}))
+    assert reset and ring.resets == 1
+    assert len(ring) == 1  # pre-restart history dropped
+    ring.append(snap(30, counters={"serve_tokens_emitted_total": 90}))
+    rate = ring.rate("serve_tokens_emitted_total", 60)
+    assert rate is not None and rate == pytest.approx(6.0)  # 60 over 10 s
+    assert rate >= 0.0
+
+
+def test_merge_registry_payload_sections_and_junk():
+    engine = snap(5, counters={"serve_tokens_emitted_total": 10})
+    server = snap(5.001, counters={"http_requests_total": 3})
+    merged = merge_registry_payload({"server": server, "engine": engine})
+    assert merged is not None
+    assert "serve_tokens_emitted_total" in merged and "http_requests_total" in merged
+    assert merged["captured_at"]["series"][0]["value"] == pytest.approx(5.001)
+    # junk shapes degrade to None, never raise
+    for junk in (None, 7, "x", [], {"engine": "nope"}, {"engine": {}}):
+        assert merge_registry_payload(junk) is None
+
+
+def test_serving_window_view_shape():
+    ring = SnapshotRing(depth=8)
+    ring.append(snap(0, counters={"serve_tokens_emitted_total": 0,
+                                  "serve_requests_admitted_total": 0}))
+    ring.append(snap(10, counters={"serve_tokens_emitted_total": 120,
+                                   "serve_requests_admitted_total": 4},
+                     hists={"serve_ttft_seconds": [0.2] * 4}))
+    view = serving_window_view([ring], 30)
+    assert view["window_s"] == 30
+    assert view["span_s"] == pytest.approx(10.0)
+    assert view["tok_s"] == pytest.approx(12.0)
+    assert view["admitted_per_s"] == pytest.approx(0.4)
+    assert view["ttft_p95_s"] is not None
+    # an empty ring answers None everywhere, not fake zeros
+    empty = serving_window_view([SnapshotRing(depth=4)], 30)
+    assert empty["span_s"] is None and empty["tok_s"] is None
+
+
+# ---- burn-rate sim (the deterministic replay harness) -----------------------
+
+
+def _storm_sequences(steps: int = 24):
+    """A rate_storm-shaped fixture derived from the loadgen scenario: the
+    schedule's oversubscription wave arrives faster than a replica can
+    serve, TTFT observations blow past the objective, and the router sheds
+    the overflow as 429s. Snapshots are synthesized per 1 s step — same
+    registry families a real poll captures, no hardware."""
+    from prime_tpu.loadgen.scenario import SCENARIOS, build_schedule
+
+    schedule = build_schedule(SCENARIOS["rate_storm"](seed=7), vocab=101)
+    # rate_storm is an INSTANTANEOUS oversubscription burst aimed at the 429
+    # admission gate; under Retry-After its rejected clients come straight
+    # back, so the fixture re-releases the seeded burst every few steps —
+    # a sustained storm against a replica serving a fraction of it
+    burst = len(schedule)
+    serve_per_s = max(1, burst // 12)
+    tokens = admitted = rejected = forwarded = 0
+    backlog = 0.0
+    ttfts: list[float] = []
+    engine_seq, router_seq = [], []
+    for t in range(1, steps + 1):
+        arrived = burst if t % 3 == 1 else 0
+        served = min(serve_per_s, arrived + int(backlog))
+        overflow = max(0, int(backlog) + arrived - served - 8)  # queue cap 8
+        backlog = max(0.0, backlog + arrived - served - overflow)
+        rejected += overflow
+        forwarded += served
+        admitted += served
+        tokens += served * 16
+        # queueing delay grows with backlog: TTFTs land far over the 2 s
+        # objective for the storm's whole tail
+        ttfts.extend([0.5 + backlog] * served)
+        engine_seq.append(
+            snap(
+                t,
+                counters={
+                    "serve_tokens_emitted_total": tokens,
+                    "serve_requests_admitted_total": admitted,
+                    "serve_requests_completed_total": admitted,
+                },
+                hists={"serve_ttft_seconds": list(ttfts)},
+                gauges={"serve_active_slots": 8},
+            )
+        )
+        router_seq.append(
+            snap(
+                t,
+                counters={
+                    "fleet_admission_rejected_total": rejected,
+                    "fleet_requests_total": forwarded,
+                },
+            )
+        )
+    return engine_seq, router_seq
+
+
+def _idle_sequences(steps: int = 24):
+    """A post-storm idle fixture: counters flat, utilization on the floor."""
+    engine_seq = [
+        snap(
+            t,
+            counters={
+                "serve_tokens_emitted_total": 1000,
+                "serve_requests_admitted_total": 50,
+                "serve_requests_completed_total": 50,
+            },
+            hists={"serve_ttft_seconds": [0.1] * 50},
+            gauges={"serve_active_slots": 0},
+        )
+        for t in range(1, steps + 1)
+    ]
+    router_seq = [
+        snap(t, counters={"fleet_admission_rejected_total": 0,
+                          "fleet_requests_total": 50})
+        for t in range(1, steps + 1)
+    ]
+    return engine_seq, router_seq
+
+
+def _cancel_sequences(steps: int = 24):
+    """A cancel_storm-shaped fixture: clients abandon mid-decode (cancelled
+    counters climb) but latency stays on budget and the fleet is busy —
+    churn alone must neither page nor shrink the fleet."""
+    from prime_tpu.loadgen.scenario import SCENARIOS, build_schedule
+
+    schedule = build_schedule(SCENARIOS["cancel_storm"](seed=7), vocab=101)
+    cancelled_total = sum(1 for r in schedule if r.cancel_after_s is not None)
+    engine_seq, router_seq = [], []
+    for t in range(1, steps + 1):
+        served = 4 * t
+        engine_seq.append(
+            snap(
+                t,
+                counters={
+                    "serve_tokens_emitted_total": served * 8,
+                    "serve_requests_admitted_total": served,
+                    "serve_requests_completed_total": served // 2,
+                    "serve_requests_cancelled_total": min(cancelled_total, served // 2),
+                },
+                hists={"serve_ttft_seconds": [0.2] * served},
+                gauges={"serve_active_slots": 6},
+            )
+        )
+        router_seq.append(
+            snap(t, counters={"fleet_admission_rejected_total": 0,
+                              "fleet_requests_total": served})
+        )
+    return engine_seq, router_seq
+
+
+SIM_WINDOWS = {"fast_s": 5.0, "slow_s": 15.0}
+
+
+def test_replay_rate_storm_scales_up_byte_identically():
+    engine_seq, router_seq = _storm_sequences()
+    runs = []
+    for _ in range(2):
+        signals = replay(
+            {"replica0": engine_seq},
+            router_sequence=router_seq,
+            capacity=8,
+            **SIM_WINDOWS,
+        )
+        runs.append(json.dumps([s.to_dict() for s in signals], sort_keys=True))
+        assert signals[-1].direction == "up"
+        # the multi-window AND demands genuine slow-window coverage: the
+        # storm's first seconds must NOT page (on a young ring the slow
+        # window would evaluate the same seconds as the fast one)
+        assert all(s.direction == "hold" for s in signals[:4])
+        # the reason names the worst burner with its burn evidence
+        assert "burning" in signals[-1].reason
+        assert signals[-1].evidence
+    # acceptance: byte-identical signals across reruns
+    assert runs[0] == runs[1]
+
+
+def test_replay_idle_scales_down_once_then_holds():
+    engine_seq, router_seq = _idle_sequences()
+    signals = replay(
+        {"replica0": engine_seq},
+        router_sequence=router_seq,
+        capacity=16,
+        **SIM_WINDOWS,
+    )
+    directions = [s.direction for s in signals]
+    assert "up" not in directions
+    first_down = directions.index("down")
+    # before the slow window has history the evaluator must hold, not guess
+    assert all(d == "hold" for d in directions[:first_down])
+    # one recommendation per idle episode: down once, hold after
+    assert directions[first_down] == "down"
+    assert all(d == "hold" for d in directions[first_down + 1:])
+    again = replay(
+        {"replica0": engine_seq},
+        router_sequence=router_seq,
+        capacity=16,
+        **SIM_WINDOWS,
+    )
+    assert json.dumps([s.to_dict() for s in signals], sort_keys=True) == json.dumps(
+        [s.to_dict() for s in again], sort_keys=True
+    )
+
+
+def test_replay_cancel_storm_holds():
+    engine_seq, router_seq = _cancel_sequences()
+    signals = replay(
+        {"replica0": engine_seq},
+        router_sequence=router_seq,
+        capacity=8,
+        **SIM_WINDOWS,
+    )
+    assert {s.direction for s in signals} == {"hold"}
+
+
+def test_default_policies_env_overrides(monkeypatch):
+    monkeypatch.setenv("PRIME_SLO_TTFT_P95_S", "0.25")
+    monkeypatch.setenv("PRIME_SLO_REJECT_RATE", "0.5")
+    by_name = {p.name: p for p in default_policies()}
+    assert by_name["ttft_p95"].threshold == pytest.approx(0.25)
+    assert by_name["reject_rate"].threshold == pytest.approx(0.5)
+    assert by_name["tpot_p95"].threshold == pytest.approx(0.5)  # untouched default
+
+
+def test_evaluator_reports_no_data_without_windows():
+    evaluator = SloEvaluator()
+    verdicts, signal = evaluator.evaluate([SnapshotRing(depth=4)], None, capacity=8)
+    assert signal.direction == "hold"
+    assert all(v.fast.burn is None and not v.breached for v in verdicts)
+
+
+# ---- membership capture tolerance (satellite) -------------------------------
+
+
+def test_membership_apply_metrics_tolerance():
+    """The observatory-era registry payload parses with the digest's
+    tolerance contract: junk shapes, junk sections, pre-observatory replies
+    all degrade to 'not sampled' — never an exception."""
+    m = FleetMembership(["http://127.0.0.1:1"])
+    replica = next(iter(m.replicas.values()))
+    for junk in (
+        None, 7, "nope", [], {"engine": "nope"}, {"engine": {}},
+        {"engine": {"captured_at": "junk"}},
+        {"engine": {"captured_at": {"series": "x"}}},
+        {"engine": {"serve_tokens_emitted_total": {"series": [{"value": "NaNope"}]}}},
+    ):
+        assert m.apply_metrics(replica, junk) is False
+    assert len(replica.ring) <= 1 and replica.resets == 0
+    # a well-formed payload samples; a shrunk re-poll counts a reset and
+    # fires the hook the router counts fleet_replica_resets_total from
+    events = []
+    m._on_sample = lambda r, reset: events.append((r.id, reset))
+    assert m.apply_metrics(replica, {"engine": snap(10, counters={"c_total": 5})}) is False
+    assert m.apply_metrics(replica, {"engine": snap(20, counters={"c_total": 1})}) is True
+    assert replica.resets == 1
+    assert events == [(replica.id, False), (replica.id, True)]
+
+
+class _JunkMetricsHandler(BaseHTTPRequestHandler):
+    """A replica whose /healthz is fine but whose /metrics is hostile:
+    junk JSON or an oversized body. The poll must still succeed."""
+
+    payload = b"not json at all {{{"
+
+    def log_message(self, *args):  # noqa: D102 — quiet
+        pass
+
+    def do_GET(self):
+        if self.path.startswith("/healthz"):
+            body = json.dumps({"state": "ready", "queue_depth": 1}).encode()
+        else:
+            body = self.payload
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_poll_survives_junk_and_oversized_metrics_payloads():
+    from prime_tpu.obs.timeseries import MAX_SAMPLE_BYTES
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _JunkMetricsHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        m = FleetMembership([url])
+        replica = next(iter(m.replicas.values()))
+        m.poll_once(replica)
+        assert replica.state == "ready" and replica.queue_depth == 1
+        assert len(replica.ring) == 0  # junk skipped, poll intact
+        # oversized payload: skipped before parsing, poll still healthy
+        _JunkMetricsHandler.payload = b"[" + b"0," * (MAX_SAMPLE_BYTES // 2) + b"0]"
+        m.poll_once(replica)
+        assert replica.state == "ready"
+        assert len(replica.ring) == 0
+    finally:
+        _JunkMetricsHandler.payload = b"not json at all {{{"
+        server.shutdown()
+        server.server_close()
+
+
+# ---- live endpoints ---------------------------------------------------------
+
+
+class _ScriptedBackend:
+    concurrent = True
+
+    def __init__(self):
+        self.registry = Registry()
+        self._tokens = self.registry.counter(
+            "serve_tokens_emitted_total", "tokens")
+        self._ttft = self.registry.histogram("serve_ttft_seconds", "ttft")
+        self.registry.gauge("serve_active_slots", "slots").set(2)
+
+    def stats(self):
+        return {"queue_depth": 0, "active_slots": 2, "max_slots": 8}
+
+    def generate(self, prompts, max_new_tokens, temperature, top_p=1.0, templated=False):
+        self._tokens.inc(8)
+        self._ttft.observe(0.05)
+        return ["ok"] * len(prompts)
+
+
+@pytest.fixture
+def fleet():
+    from prime_tpu.serve import InferenceServer
+    from prime_tpu.serve.fleet import serve_fleet
+
+    backends = [_ScriptedBackend(), _ScriptedBackend()]
+    servers = [
+        InferenceServer("tiny-test", b, port=0, admin_token="obs-secret").start()
+        for b in backends
+    ]
+    router = serve_fleet(
+        [srv.url for srv in servers],
+        poll_interval=0.05,
+        model_id="tiny-test",
+        admin_token="obs-secret",
+    )
+    try:
+        yield router, servers
+    finally:
+        router.stop()
+        for srv in servers:
+            srv.stop()
+
+
+def test_gauge_mean_absent_family_is_none_not_zero():
+    """'No data' must never read as zero utilization: a ring whose
+    snapshots never carried the gauge answers None (a loading replica
+    without serve_active_slots is not an idle one)."""
+    ring = SnapshotRing(depth=4)
+    ring.append(snap(0, counters={"c_total": 1}))
+    ring.append(snap(10, counters={"c_total": 2}))
+    assert ring.gauge_mean("serve_active_slots", 30) is None
+    ring.append(snap(20, counters={"c_total": 3}, gauges={"serve_active_slots": 4}))
+    assert ring.gauge_mean("serve_active_slots", 30) == pytest.approx(4.0)
+
+
+def test_router_observatory_filters_stale_replica_rings(fleet):
+    """A dead replica's frozen ring must not pin its last windows into
+    every future evaluation: only freshly-polled replicas feed the merged
+    fleet view (the table still lists everyone)."""
+    router, _servers = fleet
+    router.membership.poll_all()
+    assert len(router._fresh_replicas()) == 2
+    stale = next(iter(router.membership.replicas.values()))
+    stale.last_poll_at -= 3600.0  # as if its last successful poll was an hour ago
+    fresh = router._fresh_replicas()
+    assert len(fresh) == 1 and fresh[0].id != stale.id
+    view = router.observatory_view()
+    assert len(view["replicas"]) == 2  # visibility is not freshness
+
+
+def test_router_observatory_endpoint_shape_and_auth(fleet):
+    router, servers = fleet
+    # chat traffic so the rings have token counters to window
+    for _ in range(3):
+        response = httpx.post(
+            f"{router.url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "hello observatory"}]},
+            timeout=30,
+        )
+        assert response.status_code == 200
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        router.membership.poll_all()
+        if all(len(r.ring) >= 2 for r in router.membership.replicas.values()):
+            break
+    # admin parity: no token -> 403, token -> the view
+    assert (
+        httpx.get(f"{router.url}/admin/observatory", timeout=5).status_code == 403
+    )
+    view = httpx.get(
+        f"{router.url}/admin/observatory",
+        headers={"Authorization": "Bearer obs-secret"},
+        timeout=5,
+    ).json()
+    assert set(view) >= {"windows", "signal", "slo", "replicas", "fleet", "resets"}
+    assert view["signal"]["direction"] in ("up", "down", "hold")
+    assert len(view["replicas"]) == 2
+    assert all(row["samples"] >= 2 for row in view["replicas"])
+    fast = view["fleet"]["fast"]
+    assert fast["span_s"] and fast["tok_s"] is not None and fast["tok_s"] > 0
+    policies = {entry["policy"] for entry in view["slo"]}
+    assert {"ttft_p95", "reject_rate", "utilization_floor"} <= policies
+    # the observatory observes itself: gauge exposed + catalog-clean text
+    from pathlib import Path
+
+    from prime_tpu.analysis.obs_contract import load_metrics_catalog
+    from prime_tpu.obs import lint_prometheus_text
+
+    catalog = load_metrics_catalog(
+        (Path(__file__).parent.parent / "docs" / "observability.md").read_text()
+    )
+    text = httpx.get(
+        f"{router.url}/metrics", params={"format": "prometheus"}, timeout=5
+    ).text
+    assert "fleet_scale_signal" in text
+    assert lint_prometheus_text(text, catalog=catalog) == []
+
+
+def test_server_observatory_endpoint(fleet):
+    _router, servers = fleet
+    httpx.post(
+        f"{servers[0].url}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": "hi"}]},
+        timeout=30,
+    ).raise_for_status()
+    servers[0].observatory_sample()
+    view = httpx.get(
+        f"{servers[0].url}/admin/observatory",
+        headers={"Authorization": "Bearer obs-secret"},
+        timeout=5,
+    ).json()
+    assert set(view) >= {"windows", "signal", "slo", "replica", "serving"}
+    assert view["replica"]["samples"] >= 1
+    assert view["serving"]["fast"]["window_s"] == FAST_WINDOW_S
+    # admin parity holds on the server too
+    assert (
+        httpx.get(f"{servers[0].url}/admin/observatory", timeout=5).status_code
+        == 403
+    )
+
+
+def test_serve_top_cli_once_and_json(fleet):
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.serve import serve_cmd
+
+    router, _servers = fleet
+    router.membership.poll_all()
+    result = CliRunner().invoke(
+        serve_cmd,
+        ["top", "--url", router.url, "--once", "--admin-token", "obs-secret"],
+    )
+    assert result.exit_code == 0, result.output
+    assert "signal:" in result.output and "Replicas" in result.output
+    as_json = CliRunner().invoke(
+        serve_cmd,
+        ["top", "--url", router.url, "--once", "--admin-token", "obs-secret",
+         "--output", "json"],
+    )
+    assert as_json.exit_code == 0, as_json.output
+    payload = json.loads(as_json.output)
+    assert payload["signal"]["direction"] in ("up", "down", "hold")
+    # a missing token is a clean error, not a stack trace
+    denied = CliRunner().invoke(serve_cmd, ["top", "--url", router.url, "--once"])
+    assert denied.exit_code != 0 and "admin token" in denied.output
+
+
+def test_serve_metrics_watch_cli(fleet):
+    from click.testing import CliRunner
+
+    from prime_tpu.commands.serve import serve_cmd
+
+    router, _servers = fleet
+    httpx.post(
+        f"{router.url}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": "hi"}]},
+        timeout=30,
+    ).raise_for_status()
+    result = CliRunner().invoke(
+        serve_cmd,
+        ["metrics", "--url", router.url, "--watch", "0.05", "--count", "2"],
+    )
+    assert result.exit_code == 0, result.output
+    assert "per_s" in result.output
+    # watch is a live table mode; machine formats must refuse loudly
+    bad = CliRunner().invoke(
+        serve_cmd,
+        ["metrics", "--url", router.url, "--watch", "1", "--output", "json"],
+    )
+    assert bad.exit_code != 0
+
+
+# ---- acceptance: observatory tok/s vs loadgen report ------------------------
+
+
+@pytest.mark.slow
+def test_observatory_tok_s_within_10pct_of_slo_report():
+    """Acceptance pin: GET /admin/observatory on a smoke-style fleet reports
+    windowed tok/s within 10% of the loadgen SLO report's registry-delta
+    tok/s for the same run — the two systems window the SAME counters, one
+    live, one post-hoc."""
+    import jax
+    import jax.numpy as jnp
+
+    from prime_tpu.loadgen.backends import HTTPTarget, NumericTokenizer
+    from prime_tpu.loadgen.report import scenario_row
+    from prime_tpu.loadgen.runner import run_schedule
+    from prime_tpu.loadgen.scenario import SCENARIOS, build_schedule
+    from prime_tpu.models import get_config
+    from prime_tpu.models.llama import init_params
+    from prime_tpu.serve import InferenceServer
+    from prime_tpu.serve.engine import ContinuousBatchingEngine, EngineBackend
+    from prime_tpu.serve.fleet import serve_fleet
+
+    config = get_config("tiny-test")
+    schedule = build_schedule(SCENARIOS["smoke"](seed=5), vocab=config.vocab_size)
+    engine = ContinuousBatchingEngine(
+        init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32),
+        config, pad_id=0, max_slots=4, capacity=128, chunk=4, prefix_cache_mb=8,
+    )
+    engine.start()
+    server = InferenceServer(
+        "tiny-test", EngineBackend(engine, NumericTokenizer()), port=0
+    ).start()
+    router = None
+    try:
+        # warm every prompt-length bucket BEFORE the router exists, so the
+        # replica ring's whole history is the measured run (the report's
+        # bracket and the ring's window must cover the same tokens)
+        for n in sorted({len(r.prompt_ids) for r in schedule}):
+            httpx.post(
+                f"{server.url}/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": " ".join(["7"] * n)}],
+                      "max_tokens": 4, "temperature": 0.0},
+                timeout=120.0,
+            ).raise_for_status()
+        router = serve_fleet([server.url], poll_interval=0.05, model_id="tiny-test")
+        target = HTTPTarget(
+            router.url,
+            scrape_urls={"router": router.url, "replica0": server.url},
+            timeout_s=120.0,
+        )
+        result = run_schedule(
+            schedule, target, scenario="smoke", seed=5, time_scale=0.5,
+        )
+        row = scenario_row(result)
+        assert row["tok_s"] > 0, row
+        router.membership.poll_all()  # a fresh trailing sample closes the window
+        view = router.observatory_view()
+        # the slow window covers the ring's whole (run-only) history
+        live = view["fleet"]["slow"]["tok_s"]
+        assert live is not None and live > 0
+        assert live == pytest.approx(row["tok_s"], rel=0.10), (live, row["tok_s"])
+        # token DELTAS agree exactly (same counters, same clamp rules)
+        span = view["fleet"]["slow"]["span_s"]
+        assert round(live * span) == pytest.approx(row["tokens"], rel=0.02)
+    finally:
+        if router is not None:
+            router.stop()
+        server.stop()  # shuts the engine down through the backend
