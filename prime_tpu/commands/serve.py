@@ -109,6 +109,13 @@ from prime_tpu.utils.render import Renderer, output_options
          "Default: 0 (PRIME_SERVE_ADAPTER_MAX_INFLIGHT).",
 )
 @click.option(
+    "--adapter-weight", "adapter_weight_entries", multiple=True, metavar="NAME=K",
+    help="Weighted admission shares (--adapters, repeatable): give tenant "
+         "NAME K admission slots per fair-rotation instead of 1 ('base' is "
+         "the base model's tenant). Unlisted tenants keep weight 1. "
+         "Default: uniform (PRIME_SERVE_ADAPTER_WEIGHTS).",
+)
+@click.option(
     "--max-queue", type=int, default=None,
     help="Bound the engine's pending queue (--continuous): submissions past "
          "it get 429 + Retry-After instead of queueing unboundedly. "
@@ -167,6 +174,7 @@ def serve_cmd(
     prefix_cache_mb: float | None,
     prefix_cache_host_mb: float | None,
     adapter_max_inflight: int | None,
+    adapter_weight_entries: tuple[str, ...],
     max_queue: int | None,
     role: str | None,
     replica_of: str | None,
@@ -235,6 +243,9 @@ def serve_cmd(
             prefix_cache_mb=prefix_cache_mb,
             prefix_cache_host_mb=prefix_cache_host_mb,
             adapter_max_inflight=adapter_max_inflight,
+            # joined back to the "name=K,..." env-spec shape; None defers
+            # to PRIME_SERVE_ADAPTER_WEIGHTS inside the engine
+            adapter_weights=",".join(adapter_weight_entries) or None,
             max_queue=max_queue,
             role=role,
         )
@@ -319,6 +330,38 @@ def serve_cmd(
          "routing). Names not aliased resolve against what replicas "
          "advertise in /healthz.",
 )
+@click.option(
+    "--autoscale/--no-autoscale", "autoscale", default=None,
+    help="Elastic fleet actuator (docs/architecture.md \"Elastic fleet\"): "
+         "consume the observatory's scale signals each poll cycle and "
+         "spawn/retire replicas via --launch, under the min/max bounds, "
+         "per-direction cooldowns, and safety interlocks (drain-before-"
+         "kill, inflight guard, breaker-storm pause). "
+         "Default: off (PRIME_FLEET_AUTOSCALE).",
+)
+@click.option(
+    "--min-replicas", type=click.IntRange(min=0), default=None,
+    help="Autoscale floor: never retire below this many replicas. "
+         "Default: 1 (PRIME_FLEET_AUTOSCALE_MIN).",
+)
+@click.option(
+    "--max-replicas", type=click.IntRange(min=1), default=None,
+    help="Autoscale ceiling: never spawn past this many replicas. "
+         "Default: 4 (PRIME_FLEET_AUTOSCALE_MAX).",
+)
+@click.option(
+    "--scale-cooldown", "scale_cooldown", type=click.FloatRange(min=0), default=None,
+    help="Seconds between scale-UP actions (scale-downs wait "
+         "PRIME_FLEET_AUTOSCALE_DOWN_COOLDOWN_S, 3x longer by default). "
+         "Default: 10 (PRIME_FLEET_AUTOSCALE_COOLDOWN_S).",
+)
+@click.option(
+    "--launch", "launch_cmd", default=None, metavar="CMD",
+    help="Replica launch command template for --autoscale, with {host} "
+         "{port} {router} placeholders — e.g. \"prime serve -m MODEL "
+         "--continuous --port {port} --replica-of {router}\". The spawned "
+         "process must answer /healthz on {host}:{port}.",
+)
 def serve_fleet_cmd(
     replicas: tuple[str, ...],
     host: str,
@@ -332,12 +375,18 @@ def serve_fleet_cmd(
     cooldown: float,
     admin_token: str | None,
     model_aliases: tuple[str, ...],
+    autoscale: bool | None,
+    min_replicas: int | None,
+    max_replicas: int | None,
+    scale_cooldown: float | None,
+    launch_cmd: str | None,
 ) -> None:
     """Route an OpenAI-compatible endpoint across N engine replicas:
     prefix-affinity scheduling (shared-prefix traffic lands on the replica
     whose KV cache is warm), health-gated failover with circuit breaking,
     and fleet-level admission control. See docs/architecture.md
     "Serve fleet"."""
+    from prime_tpu.core.config import env_flag
     from prime_tpu.serve.fleet import FleetRouter
 
     registry: dict[str, str | None] = {}
@@ -346,6 +395,15 @@ def serve_fleet_cmd(
         if not eq or not name or not target:
             raise click.UsageError(f"--model-alias {entry!r} must be MODEL=ADAPTER")
         registry[name] = None if target == "base" else target
+    if autoscale is None:
+        autoscale = env_flag("PRIME_FLEET_AUTOSCALE", False)
+    if autoscale and not launch_cmd:
+        # pure CLI-argument error: an actuator with no way to create
+        # capacity can only ever refuse its own decisions
+        raise click.UsageError(
+            "--autoscale needs --launch (the replica launch command "
+            "template the supervisor spawns scale-ups with)"
+        )
     try:
         router = FleetRouter(
             replicas,
@@ -363,11 +421,49 @@ def serve_fleet_cmd(
         )
     except OSError as e:
         raise click.ClickException(str(e)) from None
+    if autoscale:
+        from prime_tpu.serve.fleet import (
+            AutoscalerConfig,
+            FleetAutoscaler,
+            LocalProcessLauncher,
+            ReplicaSupervisor,
+        )
+
+        try:
+            config = AutoscalerConfig.from_env(
+                min_replicas=min_replicas,
+                max_replicas=max_replicas,
+                up_cooldown_s=scale_cooldown,
+            )
+        except ValueError as e:
+            raise click.UsageError(str(e)) from None
+        # replicas spawn on loopback: the launcher runs them on THIS host,
+        # and a 0.0.0.0 router bind is not a reachable replica address
+        launcher = LocalProcessLauncher(launch_cmd, router_url=router.url)
+        router.attach_autoscaler(
+            FleetAutoscaler(ReplicaSupervisor(launcher, membership=router.membership), config)
+        )
     click.echo(f"Fleet router at {router.url}/v1 over {len(replicas)} replica(s)")
+    if autoscale:
+        click.echo(
+            f"  autoscale: {router.autoscaler.config.min_replicas}"
+            f"..{router.autoscaler.config.max_replicas} replicas "
+            f"(status: GET {router.url}/admin/autoscaler, pause/resume: POST)"
+        )
     click.echo(f"  join:    POST {router.url}/admin/join  {{\"url\": ...}}")
     click.echo(f"  drain:   POST {router.url}/admin/drain?replica=<id>")
     click.echo(f"  fleet:   {router.url}/admin/fleet")
     click.echo(f"  metrics: {router.url}/metrics  (prometheus: {router.url}/metrics?format=prometheus)")
+    # SIGTERM (systemd/k8s stop) takes the same clean path as Ctrl-C: with
+    # an autoscaler attached, router.stop() must run so the supervisor
+    # reaps the replica subprocesses it launched — a bare SIGTERM death
+    # would orphan them
+    import signal
+
+    def _sigterm(_signo, _frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
     try:
         router.serve_forever()
     except KeyboardInterrupt:
@@ -463,6 +559,21 @@ def _render_observatory_view(render: "Renderer", view: dict) -> None:
     click.echo(
         f"signal: {signal.get('direction', '?')} — {signal.get('reason', '')}"
     )
+    autoscaler = view.get("autoscaler") or {}
+    if autoscaler.get("enabled"):
+        last = autoscaler.get("last_action") or {}
+        last_desc = (
+            f"{last.get('direction')}/{last.get('outcome')}"
+            + (f" x{last.get('count')}" if last.get("count") else "")
+            if last
+            else "none yet"
+        )
+        config = autoscaler.get("config") or {}
+        click.echo(
+            f"autoscaler: {autoscaler.get('state', '?')} "
+            f"[{config.get('min_replicas', '?')}..{config.get('max_replicas', '?')}] "
+            f"— last action: {last_desc}"
+        )
     breached = [
         v for v in view.get("slo", []) if isinstance(v, dict) and v.get("breached")
     ]
@@ -498,7 +609,11 @@ def _render_observatory_view(render: "Renderer", view: dict) -> None:
     rows = [
         [
             r.get("id") or r.get("model", "?"),
+            r.get("role", "-"),
             r.get("state", "?"),
+            # autoscaler lifecycle for supervisor-managed replicas;
+            # operator-joined rows show "-" (the actuator never touches them)
+            r.get("managed") or "-",
             r.get("breaker", "-"),
             r.get("queue_depth", 0),
             f"{r.get('active_slots', 0)}/{r.get('max_slots', 0)}",
@@ -509,7 +624,8 @@ def _render_observatory_view(render: "Renderer", view: dict) -> None:
         for r in replicas or []
     ]
     render.table(
-        ["replica", "state", "breaker", "queue", "slots", "tok/s", "samples", "resets"],
+        ["replica", "role", "state", "managed", "breaker", "queue", "slots",
+         "tok/s", "samples", "resets"],
         rows,
         title="Replicas",
     )

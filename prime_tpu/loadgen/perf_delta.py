@@ -47,6 +47,13 @@ HEADLINE_METRICS: tuple[tuple[str, str], ...] = (
     ("multilora base tok/s", "serve_multilora_base_tok_s"),
     ("multilora ratio", "serve_multilora_ratio"),
     ("multilora fairness", "serve_multilora_fairness"),
+    # elastic fleet (own keys: the autoscaler's live 1→N→1 rate_storm leg —
+    # peak/final counts are the control-loop evidence, tok/s the final
+    # post-scale round's throughput; only ever deltas against itself)
+    ("elastic tok/s", "serve_elastic_tok_s"),
+    ("elastic peak replicas", "serve_elastic_peak_replicas"),
+    ("elastic scale ups", "serve_elastic_scale_ups"),
+    ("elastic scale downs", "serve_elastic_scale_downs"),
     # disaggregated prefill/decode (own keys, never folded into the serve/
     # fleet rows above: the phase-split and colocated numbers come from a
     # dedicated scenario and must only ever delta against themselves)

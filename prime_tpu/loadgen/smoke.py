@@ -203,6 +203,195 @@ def _multilora_section(
     return record, rows
 
 
+def _elastic_section(
+    config, params_fn, *, seed: int, log
+) -> tuple[dict[str, Any], list]:
+    """The live elastic leg (docs/architecture.md "Elastic fleet"): ONE
+    tiny-engine replica behind a router whose autoscaler is armed with
+    smoke-scale windows/cooldowns, driven by repeated ``rate_storm`` bursts
+    over real HTTP. The storm must breach the tightened SLO policies, the
+    actuator must spawn real engine replicas (in-process launcher — the
+    same ``ReplicaLauncher`` seam the subprocess launcher plugs), and once
+    the storm ends the fleet must drain back down: replica count 1→N→1
+    with zero failed requests (429s are shed load, not failures) and every
+    drain completing in-flight work. Record keys ``serve_elastic_*``."""
+    import time
+
+    from prime_tpu.loadgen.backends import HTTPTarget, NumericTokenizer
+    from prime_tpu.loadgen.report import scenario_row
+    from prime_tpu.loadgen.runner import run_schedule
+    from prime_tpu.loadgen.scenario import SCENARIOS, build_schedule
+    from prime_tpu.obs.slo import SloEvaluator, SloPolicy
+    from prime_tpu.serve.engine import ContinuousBatchingEngine, EngineBackend
+    from prime_tpu.serve.fleet import (
+        AutoscalerConfig,
+        FleetAutoscaler,
+        ReplicaSupervisor,
+        serve_fleet,
+    )
+    from prime_tpu.serve.server import InferenceServer
+
+    class _ServerHandle:
+        def __init__(self, srv) -> None:
+            self.srv = srv
+            self.url = srv.url
+            self._alive = True
+
+        def alive(self) -> bool:
+            return self._alive
+
+        def terminate(self) -> None:
+            if self._alive:
+                self._alive = False
+                self.srv.stop()  # shuts the backing engine down too
+
+    class _EngineLauncher:
+        """In-process ReplicaLauncher: each spawn is a REAL engine behind a
+        REAL InferenceServer on a fresh port — the launch seam exercised
+        end to end without subprocess checkpoint loads."""
+
+        def __init__(self) -> None:
+            self.handles: list[_ServerHandle] = []
+
+        def spawn(self) -> _ServerHandle:
+            engine = ContinuousBatchingEngine(
+                params_fn(), config, pad_id=0, max_slots=4, capacity=128,
+                chunk=4, prefix_cache_mb=8, max_queue=16,
+            )
+            engine.start()
+            srv = InferenceServer(
+                "loadgen-smoke", EngineBackend(engine, NumericTokenizer()), port=0
+            ).start()
+            handle = _ServerHandle(srv)
+            self.handles.append(handle)
+            return handle
+
+    # smaller bursts than the CI rate_storm default: each round must finish
+    # in seconds on one tiny CPU engine so several rounds fit the smoke
+    schedule = build_schedule(
+        SCENARIOS["rate_storm"](seed, n=12, prompt_tokens=16, max_new_tokens=8),
+        vocab=config.vocab_size,
+    )
+    launcher = _EngineLauncher()
+    seed_handle = launcher.spawn()  # replica #1 (managed, so 1→N→1 can reap N-1)
+    router = serve_fleet(
+        [seed_handle.url], poll_interval=0.2, model_id="loadgen-smoke",
+    )
+    rows: list = []
+    record: dict[str, Any] = {}
+    try:
+        # smoke-scale observatory: tight windows + thresholds a tiny-engine
+        # storm actually breaches within seconds (the production defaults
+        # would need minutes of sustained burn — right for a fleet, wrong
+        # for a CI leg)
+        router.slo = SloEvaluator(
+            (
+                SloPolicy(name="ttft_p95", kind="latency",
+                          metric="serve_ttft_seconds", threshold=0.3),
+                SloPolicy(name="queue_wait_p95", kind="latency",
+                          metric="serve_queue_wait_seconds", threshold=0.2),
+                SloPolicy(
+                    name="reject_rate", kind="error_rate", source="router",
+                    numerator=("fleet_admission_rejected_total",),
+                    denominator=(
+                        "fleet_admission_rejected_total", "fleet_requests_total",
+                    ),
+                    threshold=0.05,
+                ),
+                SloPolicy(name="utilization_floor", kind="utilization_floor",
+                          metric="serve_active_slots", threshold=0.1),
+            ),
+            fast_s=1.5, slow_s=4.0,
+        )
+        supervisor = ReplicaSupervisor(launcher, membership=router.membership)
+        router.attach_autoscaler(
+            FleetAutoscaler(
+                supervisor,
+                AutoscalerConfig(
+                    min_replicas=1, max_replicas=3,
+                    up_cooldown_s=2.0, down_cooldown_s=3.0,
+                ),
+            )
+        )
+        # the seed replica is the one engine guaranteed alive all run
+        # (LIFO retirement keeps the oldest), so the registry-windowed
+        # tok_s scrapes it — spawned replicas' tokens are NOT counted
+        # (they come and go mid-run; the replica-count trajectory, not
+        # throughput, is this leg's headline)
+        target = HTTPTarget(
+            router.url,
+            scrape_urls={"router": router.url, "replica0": seed_handle.url},
+            timeout_s=120.0,
+        )
+        # warm the seed replica's shapes off the measured storm
+        import httpx
+
+        for n in sorted({len(r.prompt_ids) for r in schedule}):
+            httpx.post(
+                f"{seed_handle.url}/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": " ".join(["7"] * n)}],
+                      "max_tokens": 4, "temperature": 0.0},
+                timeout=120.0,
+            ).raise_for_status()
+        peak = 1
+        failed = 0
+        rounds = 6
+        for i in range(rounds):
+            result = run_schedule(
+                schedule, target, scenario="elastic", seed=seed, time_scale=0.0,
+            )
+            failed += result.outcomes.get("error", 0) + result.outcomes.get(
+                "timeout", 0
+            )
+            with router.membership._lock:
+                peak = max(peak, len(router.membership.replicas))
+            if i == rounds - 1:
+                rows.append(scenario_row(result))
+        # storm over: the idle fleet must shrink back to min (drains
+        # complete in-flight work first; the poll loop keeps actuating)
+        deadline = time.monotonic() + 60.0
+        final = peak
+        while time.monotonic() < deadline:
+            with router.membership._lock:
+                final = len(router.membership.replicas)
+            if final <= 1 and not supervisor.pending():
+                break
+            time.sleep(0.25)
+        # actuation counts come from the actions COUNTER, not the bounded
+        # journal tail (a long run's early spawns scroll out of the tail)
+        actions = {
+            (s["labels"]["direction"], s["labels"]["outcome"]): int(s["value"])
+            for s in router.registry.snapshot()["fleet_autoscale_actions_total"][
+                "series"
+            ]
+        }
+        ups = actions.get(("up", "spawned"), 0)
+        downs = actions.get(("down", "retired"), 0)
+        record = {
+            "serve_elastic_tok_s": rows[0]["tok_s"] if rows else 0.0,
+            "serve_elastic_peak_replicas": peak,
+            "serve_elastic_final_replicas": final,
+            "serve_elastic_scale_ups": ups,
+            "serve_elastic_scale_downs": downs,
+            "serve_elastic_failed_requests": failed,
+        }
+        if failed or peak < 2 or final > 1:
+            record["serve_elastic_error"] = (
+                f"elastic leg did not complete 1→N→1 cleanly: peak={peak} "
+                f"final={final} failed={failed}"
+            )
+        log(
+            f"# loadgen-smoke: elastic 1→{peak}→{final} "
+            f"({ups} scale-ups, {downs} scale-downs, {failed} failed requests, "
+            f"{record['serve_elastic_tok_s']} tok/s final round)"
+        )
+        return record, rows
+    finally:
+        router.stop()  # reaps the managed replicas through the supervisor
+        for handle in launcher.handles:
+            handle.terminate()
+
+
 def disagg_comparison(
     config,
     params_fn,
@@ -707,6 +896,29 @@ def run_smoke(
                 }
                 log(f"# loadgen-smoke: multilora section failed: {e}")
 
+        # elastic fleet section (the autoscaler's live 1→N→1 proof: real
+        # engines spawned and drained by the actuator under a sustained
+        # rate_storm; record keys serve_elastic_*). Runs at tiny-test scale
+        # — the leg measures the control loop, not matmuls — and skips
+        # under --mesh like the sections below (spawned replicas would
+        # contend for the forced device set).
+        elastic_record: dict[str, Any] = {}
+        if not mesh:
+            try:
+                elastic_record, elastic_rows = _elastic_section(
+                    config,
+                    lambda: init_params(
+                        jax.random.PRNGKey(3), config, dtype=jnp.float32
+                    ),
+                    seed=seed, log=log,
+                )
+                report["scenarios"].extend(elastic_rows)
+            except Exception as e:  # noqa: BLE001 — the headline gate must survive
+                elastic_record = {
+                    "serve_elastic_error": f"{type(e).__name__}: {e}"[:200]
+                }
+                log(f"# loadgen-smoke: elastic section failed: {e}")
+
         # disaggregated prefill/decode section (phase-split vs colocated on
         # the long-prompt-heavy `disagg` scenario, real HTTP fleets both
         # ways). Runs at debug-128m scale, not tiny-test: the migration's
@@ -771,6 +983,7 @@ def run_smoke(
             **({"mesh": mesh_axes, "mesh_devices": mesh_devices} if sharded else {}),
             **spec_record,
             **multilora_record,
+            **elastic_record,
             **disagg_record,
             "loadgen": report,
         }

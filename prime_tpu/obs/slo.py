@@ -466,6 +466,16 @@ class SloEvaluator:
         self.last_signal = signal
         return verdicts, signal
 
+    def rearm_down(self) -> None:
+        """Consume the current idle episode's ``down`` recommendation: the
+        autoscaler calls this after each down-signal cycle it actuated (or
+        deliberately refused), so a persistently idle fleet keeps
+        recommending ``down`` — effectively level-triggered once an actuator
+        owns the pacing (its per-direction cooldowns replace the latch's
+        anti-thrash role). Without an actuator the latch behaves exactly as
+        before: one ``down`` per idle episode."""
+        self._down_latched = False
+
 
 def replay(
     snapshot_sequences: Mapping[str, Sequence[Mapping[str, Any]]],

@@ -80,6 +80,35 @@ def parse_adapter_spec(spec: str) -> dict[str, str]:
     return out
 
 
+def parse_adapter_weights(spec: str) -> dict[str, int]:
+    """Parse the ``--adapter-weight`` / ``PRIME_SERVE_ADAPTER_WEIGHTS``
+    value: comma-separated ``name=K`` entries (K a positive int). Unlike
+    :func:`parse_adapter_spec`, ``base`` is a legal name here — the base
+    model is tenant 0 of the weighted round-robin and may carry its own
+    share. Unlisted tenants default to weight 1."""
+    out: dict[str, int] = {}
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, eq, weight = entry.partition("=")
+        name, weight = name.strip(), weight.strip()
+        if not eq or not name or not weight:
+            raise ValueError(f"adapter weight entry {entry!r} must be name=K")
+        try:
+            value = int(weight)
+        except ValueError:
+            raise ValueError(
+                f"adapter weight for {name!r} must be an int, got {weight!r}"
+            ) from None
+        if value < 1:
+            raise ValueError(f"adapter weight for {name!r} must be >= 1, got {value}")
+        if name in out:
+            raise ValueError(f"duplicate adapter weight for {name!r}")
+        out[name] = value
+    return out
+
+
 def bank_specs(config, targets: tuple[str, ...]) -> dict[str, Any]:
     """PartitionSpecs for the stacked bank, mirroring each target's base
     layout (train/lora.lora_param_specs over the (L, A, ...) stacking): A

@@ -342,6 +342,7 @@ class ContinuousBatchingEngine:
         prefix_store_all: bool = False,
         adapters: Any = None,
         adapter_max_inflight: int | None = None,
+        adapter_weights: Any = None,
         registry: Registry | None = None,
     ) -> None:
         import jax
@@ -451,7 +452,36 @@ class ContinuousBatchingEngine:
         self._fair: dict[int, deque[EngineRequest]] = {
             i: deque() for i in range(len(self.adapter_bank or ()))
         }
-        self._fair_rr = 0
+        # WEIGHTED shares (ROADMAP item 3 follow-up): "name=K,..." (or a
+        # {name: K} dict; None reads PRIME_SERVE_ADAPTER_WEIGHTS) gives a
+        # tenant K pops per rotation instead of 1 — `base` is tenant 0 and
+        # may carry its own share; unlisted tenants default to 1. The pop
+        # runs smooth weighted round-robin (nginx's algorithm): per-tenant
+        # credit accumulates by weight, the richest credit pops and pays
+        # the candidates' total back — deterministic, well-interleaved
+        # (weight 2 serves a-a-b never starves b), and with uniform weights
+        # it IS the plain rotation the unweighted engine ran.
+        from prime_tpu.serve.adapters import parse_adapter_weights
+
+        if adapter_weights is None:
+            adapter_weights = env_str("PRIME_SERVE_ADAPTER_WEIGHTS", "")
+        if isinstance(adapter_weights, str):
+            adapter_weights = parse_adapter_weights(adapter_weights)
+        self.adapter_weights: dict[str, int] = {}
+        self._fair_weights: dict[int, int] = {i: 1 for i in self._fair}
+        if adapter_weights:
+            if self.adapter_bank is None:
+                raise ValueError(
+                    "adapter_weights needs a multi-LoRA adapter bank "
+                    "(weighted shares split tenants; a bankless engine has one)"
+                )
+            for name, weight in adapter_weights.items():
+                # KeyError on an unknown name -> loud config error at
+                # construction, same as an unknown adapter path
+                idx = self.adapter_bank.index_of(None if name == "base" else name)
+                self._fair_weights[idx] = max(1, int(weight))
+                self.adapter_weights[name] = max(1, int(weight))
+        self._fair_credit: dict[int, int] = {i: 0 for i in self._fair}
         self._burst_pops: dict[int, int] = {}  # reset per _admit wave
         # prompt-lookup speculation: each spec chunk is ONE fused dispatch —
         # propose draft_len n-gram drafts per slot from the slot's device-
@@ -1738,36 +1768,43 @@ class ContinuousBatchingEngine:
         return self._fair_pop()
 
     def _fair_pop(self) -> EngineRequest:
-        """Round-robin pop across the non-empty per-adapter buckets,
-        honoring the per-adapter inflight cap (0 = uncapped). Raises
-        queue.Empty when nothing is poppable — capped tenants' requests
-        stay bucketed (still counted by queue_depth/drained) until a
-        retirement frees their budget."""
-        order = sorted(idx for idx, dq in self._fair.items() if dq)
-        if not order:
+        """WEIGHTED round-robin pop across the non-empty per-adapter
+        buckets, honoring the per-adapter inflight cap (0 = uncapped).
+        Smooth-WRR (constructor comment): each poppable tenant's credit
+        grows by its weight, the richest credit (lowest index on ties) pops
+        and pays back the candidates' total — so a weight-2 tenant admits
+        twice per rotation, interleaved (a,a,b... never a whole burst),
+        and uniform weights reproduce the historical plain rotation.
+        Raises queue.Empty when nothing is poppable — capped tenants'
+        requests stay bucketed (still counted by queue_depth/drained)
+        until a retirement frees their budget."""
+        candidates = sorted(idx for idx, dq in self._fair.items() if dq)
+        if not candidates:
             raise queue.Empty
         cap = self.adapter_max_inflight
-        inflight: dict[int, int] = {}
         if cap:
             # admitted slots PLUS pops earlier in this same admission burst
             # (they are not in _requests yet but will be): without the
             # burst-local counts, one _admit wave could blow past the cap
+            inflight: dict[int, int] = {}
             for live in self._requests.values():
                 inflight[live.adapter_idx] = inflight.get(live.adapter_idx, 0) + 1
             for idx, count in self._burst_pops.items():
                 inflight[idx] = inflight.get(idx, 0) + count
-        n = len(order)
-        for i in range(n):
-            pos = (self._fair_rr + i) % n
-            idx = order[pos]
-            if cap and inflight.get(idx, 0) >= cap:
-                continue
-            self._fair_rr = pos + 1  # next pop starts past the served tenant
-            req = self._fair[idx].popleft()
-            if cap:
-                self._burst_pops[idx] = self._burst_pops.get(idx, 0) + 1
-            return req
-        raise queue.Empty
+            candidates = [
+                idx for idx in candidates if inflight.get(idx, 0) < cap
+            ]
+            if not candidates:
+                raise queue.Empty
+        total = sum(self._fair_weights[idx] for idx in candidates)
+        for idx in candidates:
+            self._fair_credit[idx] += self._fair_weights[idx]
+        pick = max(candidates, key=lambda idx: (self._fair_credit[idx], -idx))
+        self._fair_credit[pick] -= total
+        req = self._fair[pick].popleft()
+        if cap:
+            self._burst_pops[pick] = self._burst_pops.get(pick, 0) + 1
+        return req
 
     def tick(self) -> bool:
         """One engine iteration. Returns False when there was nothing to do.
@@ -2492,21 +2529,38 @@ class ContinuousBatchingEngine:
 
     def export_kv(self, ids: list[int], timeout: float = 30.0) -> bytes | None:
         """Serialize the longest cached prefix of ``ids`` into the versioned
-        wire payload (prefix_cache.export_segments) — what a prefill
-        replica's GET /admin/kv serves. Thread-safe: callers off the engine
-        thread marshal the walk onto the loop (the radix tree is
-        engine-thread-owned); synchronous owners (tests, bench) run it
-        directly. Returns None when nothing usable is cached.
-
-        The WHOLE serialization (device_get + leaf copies) runs on the
-        loop, stalling co-resident decode for a multi-MB export. On a
-        prefill-role replica — the migration path's only export target —
-        there is no decode to stall; an ``any``-role exporter pays the
-        pause. Moving serialization off-loop needs pins that survive a
-        concurrent store-path insert (today ``_split`` asserts an unpinned
-        path, which the same-thread pin discipline guarantees) — a
-        follow-up, not a quick win."""
-        return self._kv_call("export", list(ids), timeout)
+        wire payload — what a prefill replica's GET /admin/kv serves.
+        Thread-safe, and the expensive half runs OFF the engine loop: only
+        the radix-tree walk that PINS the match path (and the final
+        release) marshal onto the loop as O(path-length) jobs; the
+        serialization itself — the per-leaf device_get + memcpy of a
+        potentially multi-MB payload — runs on the CALLING thread against
+        the match's pin-time snapshots (prefix_cache.serialize_match). The
+        pins survive concurrent store-path inserts (``_split`` transfers
+        them, the PR 12 enabler), so an ``any``-role exporter no longer
+        stalls its co-resident decode pipeline for the export's duration —
+        the loop pays two queue hops instead of the whole device_get.
+        Synchronous owners (tests, bench, the loop itself) keep the direct
+        one-shot path. Returns None when nothing usable is cached."""
+        if self.prefix_cache is None or len(ids) < self.min_prefix:
+            return None
+        if self._thread is None or self._thread is threading.current_thread():
+            return self._kv_execute("export", list(ids))
+        match = self._kv_call("pin", list(ids), timeout)
+        if match is None:
+            return None
+        try:
+            payload = self.prefix_cache.serialize_match(match)
+        finally:
+            # the release mutates tree refcounts -> engine-thread-owned,
+            # marshalled like the pin (a leaked pin would exempt the path
+            # from the byte-budget LRU forever)
+            self._kv_call("release", match, timeout)
+        # counters on the calling thread: the registry is thread-safe, and
+        # the direct path's _kv_execute owns its own increments
+        self._m_kv_exports.inc()
+        self._m_kv_export_bytes.inc(len(payload))
+        return payload
 
     def import_kv(self, payload: bytes, timeout: float = 30.0) -> int:
         """Apply a wire payload to this engine's prefix cache — what a
@@ -2571,6 +2625,14 @@ class ContinuousBatchingEngine:
                 self._m_kv_exports.inc()
                 self._m_kv_export_bytes.inc(len(payload))
             return payload
+        if kind == "pin":
+            # off-loop export, step 1: pin the match path on the loop (the
+            # walk touches LRU stamps and refcounts — tree-owner state);
+            # serialization then happens on the caller's thread
+            return self.prefix_cache.match(arg, limit=len(arg))
+        if kind == "release":
+            self.prefix_cache.release(arg)
+            return None
         # import: arg is the pre-decoded host (tokens, leaves) pair from
         # import_kv — the insert's slicer uploads only the new tail
         tokens, leaves = arg
@@ -2721,6 +2783,7 @@ class ContinuousBatchingEngine:
             "adapters": list(
                 self.adapter_bank.adapter_names if self.adapter_bank else ()
             ),
+            "adapter_weights": dict(self.adapter_weights),
             "state": "draining" if self._draining else "running",
             "overlap": bool(self.overlap),
             "speculative": bool(self.speculative),

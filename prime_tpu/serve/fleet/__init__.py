@@ -4,10 +4,18 @@ Podracer-style composition (PAPERS.md): each TPU slice runs the unchanged
 single-process serve stack (engine + InferenceServer), and this package adds
 the thin layer that makes N of them one endpoint — prefix-affinity routing
 (balancer.py), health-gated membership with circuit breaking and graceful
-drain (membership.py), and the OpenAI-compatible proxy with fleet-level
-admission control (router.py). See docs/architecture.md "Serve fleet".
+drain (membership.py), the OpenAI-compatible proxy with fleet-level
+admission control (router.py), and the elastic actuator that sizes N to the
+observatory's SLO evidence (autoscaler.py + supervisor.py). See
+docs/architecture.md "Serve fleet" and "Elastic fleet".
 """
 
+from prime_tpu.serve.fleet.autoscaler import (
+    AutoscalerConfig,
+    FleetAutoscaler,
+    FleetState,
+    closed_loop_replay,
+)
 from prime_tpu.serve.fleet.balancer import (
     HashRing,
     PrefixAffinityBalancer,
@@ -21,16 +29,28 @@ from prime_tpu.serve.fleet.membership import (
     Replica,
 )
 from prime_tpu.serve.fleet.router import FleetRouter, serve_fleet
+from prime_tpu.serve.fleet.supervisor import (
+    LocalProcessLauncher,
+    ReplicaSupervisor,
+    SimLauncher,
+)
 
 __all__ = [
+    "AutoscalerConfig",
     "BREAKER_CLOSED",
     "BREAKER_HALF_OPEN",
     "BREAKER_OPEN",
+    "FleetAutoscaler",
     "FleetMembership",
     "FleetRouter",
+    "FleetState",
     "HashRing",
+    "LocalProcessLauncher",
     "PrefixAffinityBalancer",
     "Replica",
+    "ReplicaSupervisor",
+    "SimLauncher",
     "affinity_key",
+    "closed_loop_replay",
     "serve_fleet",
 ]

@@ -1,0 +1,646 @@
+"""Elastic fleet actuator: the autoscaler control loop.
+
+PR 13's observatory closed the SENSE half of ROADMAP item 5 — per-replica
+snapshot rings, burn-rate SLO policies, a typed ``ScaleSignal``. This
+module closes the ACT half: each observe cycle the router feeds the fresh
+signal (plus a small :class:`FleetState` summary) into
+:meth:`FleetAutoscaler.step`, whose pure :func:`decide` core turns it into
+one of a bounded set of actions:
+
+- ``up`` → spawn ``step`` replicas through the
+  :class:`~prime_tpu.serve.fleet.supervisor.ReplicaSupervisor` (which
+  registers them via the membership — the same ``/admin/join`` path a
+  manually-started ``prime serve --replica-of`` takes);
+- ``down`` → retire ONE replica (drain-before-kill, always — the
+  supervisor reaps the process only after the replica reports drained);
+- ``hold`` → nothing.
+
+Every decision passes the **interlocks** first, in priority order:
+
+1. *paused* — the operator said stop (``POST /admin/autoscaler``).
+2. *bounds* — never below ``min_replicas`` or above ``max_replicas``.
+3. *pending* — one lifecycle operation at a time: while a spawn is loading
+   or a drain is completing, hold (acting on a fleet mid-transition
+   double-spends the same evidence).
+4. *breaker storm* — when ≥ ``breaker_storm_fraction`` of the fleet's
+   breakers are open the evidence is about replica death, not load;
+   actuation pauses until the breakers close (spawning into a correlated
+   failure makes it worse, retiring during one is how outages cascade).
+5. *cooldowns* — per-direction: scale-ups may repeat quickly (an
+   under-capacity fleet is actively failing its SLOs), scale-downs wait
+   longer (capacity is cheap to hold for a cooldown, expensive to miss).
+6. *inflight guard* (down only) — never retire below live demand: if the
+   remaining slots could not hold the work currently admitted + queued,
+   hold even though utilization argues down.
+
+Decisions are **deterministic** over their inputs — no wall clock inside
+``decide`` (the caller passes ``now``), no randomness — so
+:func:`closed_loop_replay` can drive the REAL autoscaler + supervisor
+(through a :class:`~prime_tpu.serve.fleet.supervisor.SimLauncher`) against
+replayed loadgen fixtures and produce byte-identical action sequences,
+the same way ``obs/slo.replay`` proves the sensor half. A bounded decision
+journal records every non-hold verdict for ``/admin/autoscaler``,
+``/admin/observatory`` and ``prime serve top``.
+
+Knobs: ``PRIME_FLEET_AUTOSCALE*`` (architecture.md "Environment knobs").
+Metrics: ``fleet_autoscale_actions_total{direction,outcome}``,
+``fleet_replicas{state}``; each step runs inside a ``fleet.scale`` span.
+See docs/architecture.md "Elastic fleet".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+from prime_tpu.obs.slo import ScaleSignal, SloEvaluator
+from prime_tpu.obs.timeseries import SnapshotRing
+from prime_tpu.serve.fleet.supervisor import ReplicaSupervisor, SimLauncher
+from prime_tpu.utils.env import env_float, env_int
+
+DEFAULT_MIN_REPLICAS = 1
+DEFAULT_MAX_REPLICAS = 4
+DEFAULT_STEP = 1
+DEFAULT_UP_COOLDOWN_S = 10.0
+DEFAULT_DOWN_COOLDOWN_S = 30.0
+
+# interlock 4: the open-breaker fraction past which actuation pauses
+BREAKER_STORM_FRACTION = 0.5
+
+# bounded action-outcome vocabulary (fleet_autoscale_actions_total labels)
+OUTCOMES = (
+    "spawned", "retired", "at_max", "at_min", "cooldown", "pending",
+    "breaker_storm", "inflight_guard", "paused", "no_retirable", "error",
+)
+
+
+@dataclass(frozen=True)
+class AutoscalerConfig:
+    """Actuation policy. ``from_env`` reads the PRIME_FLEET_AUTOSCALE*
+    knobs; explicit constructor args always win."""
+
+    min_replicas: int = DEFAULT_MIN_REPLICAS
+    max_replicas: int = DEFAULT_MAX_REPLICAS
+    step: int = DEFAULT_STEP  # replicas per scale-up (down always steps 1)
+    up_cooldown_s: float = DEFAULT_UP_COOLDOWN_S
+    down_cooldown_s: float = DEFAULT_DOWN_COOLDOWN_S
+    breaker_storm_fraction: float = BREAKER_STORM_FRACTION
+    journal_depth: int = 64
+
+    def __post_init__(self) -> None:
+        if self.min_replicas < 0 or self.max_replicas < max(1, self.min_replicas):
+            raise ValueError(
+                f"need 0 <= min_replicas <= max_replicas (>=1), got "
+                f"min={self.min_replicas} max={self.max_replicas}"
+            )
+        if self.step < 1:
+            raise ValueError(f"step must be >= 1, got {self.step}")
+
+    @classmethod
+    def from_env(cls, **overrides: Any) -> "AutoscalerConfig":
+        values: dict[str, Any] = {
+            "min_replicas": env_int("PRIME_FLEET_AUTOSCALE_MIN", DEFAULT_MIN_REPLICAS),
+            "max_replicas": env_int("PRIME_FLEET_AUTOSCALE_MAX", DEFAULT_MAX_REPLICAS),
+            "step": env_int("PRIME_FLEET_AUTOSCALE_STEP", DEFAULT_STEP),
+            "up_cooldown_s": env_float(
+                "PRIME_FLEET_AUTOSCALE_COOLDOWN_S", DEFAULT_UP_COOLDOWN_S
+            ),
+            "down_cooldown_s": env_float(
+                "PRIME_FLEET_AUTOSCALE_DOWN_COOLDOWN_S", DEFAULT_DOWN_COOLDOWN_S
+            ),
+        }
+        values.update({k: v for k, v in overrides.items() if v is not None})
+        return cls(**values)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "step": self.step,
+            "up_cooldown_s": self.up_cooldown_s,
+            "down_cooldown_s": self.down_cooldown_s,
+        }
+
+
+@dataclass(frozen=True)
+class FleetState:
+    """The decide inputs beyond the signal itself — a pure-data summary the
+    router (live) or the sim (replay) assembles each cycle."""
+
+    replicas: int  # serving-capable replicas counted against the bounds
+    retirable: int  # supervisor-managed ready replicas a down may target
+    demand_slots: int  # admitted + queued work across routable replicas
+    capacity_slots: int  # sum of routable replicas' max_slots
+    retire_slots: int  # slots the retirement candidate would take with it
+    breakers_open: int
+    breakers_total: int
+    pending: int  # lifecycle ops in flight (spawning/draining/restarting)
+
+
+@dataclass(frozen=True)
+class Decision:
+    """One autoscaler verdict. ``count`` is replicas actually actuated."""
+
+    direction: str  # up | down | hold
+    outcome: str  # OUTCOMES (hold decisions use "hold")
+    count: int = 0
+    reason: str = ""
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "direction": self.direction,
+            "outcome": self.outcome,
+            "count": self.count,
+            "reason": self.reason,
+        }
+
+
+def decide(
+    signal: ScaleSignal,
+    state: FleetState,
+    config: AutoscalerConfig,
+    *,
+    now: float,
+    paused: bool = False,
+    last_up_at: float = float("-inf"),
+    last_down_at: float = float("-inf"),
+) -> Decision:
+    """The pure decision core (module docstring's interlock ladder). No
+    side effects, no clock reads — the sim and the live loop share it."""
+    storm = (
+        state.breakers_total > 0
+        and state.breakers_open / state.breakers_total
+        >= config.breaker_storm_fraction
+    )
+    # floor enforcement runs BEFORE the signal: an empty (or crashed-below-
+    # min) fleet has no rings to argue `up` from, so `--autoscale
+    # --min-replicas N` must bootstrap to the floor on its own — this is a
+    # repair, not a scale decision, so it skips the up-cooldown (but still
+    # honors pause, one-op-at-a-time, and the breaker-storm interlock)
+    deficit = config.min_replicas - state.replicas
+    if deficit > 0:
+        if paused:
+            return Decision("up", "paused", reason="actuation paused by operator")
+        if state.pending > 0:
+            return Decision(
+                "up", "pending",
+                reason=f"{state.pending} lifecycle op(s) still in flight",
+            )
+        if storm:
+            return Decision(
+                "up", "breaker_storm",
+                reason=(
+                    f"{state.breakers_open}/{state.breakers_total} breakers "
+                    "open — not bootstrapping into a correlated failure"
+                ),
+            )
+        return Decision(
+            "up", "spawned", count=deficit,
+            reason=(
+                f"{state.replicas} replica(s) below the "
+                f"min_replicas={config.min_replicas} floor"
+            ),
+        )
+    if signal.direction not in ("up", "down"):
+        return Decision("hold", "hold", reason=signal.reason)
+    direction = signal.direction
+    if paused:
+        return Decision(direction, "paused", reason="actuation paused by operator")
+    if direction == "up":
+        if state.replicas >= config.max_replicas:
+            return Decision(
+                "up", "at_max",
+                reason=f"already at max_replicas={config.max_replicas}",
+            )
+        if state.pending > 0:
+            return Decision(
+                "up", "pending",
+                reason=f"{state.pending} lifecycle op(s) still in flight",
+            )
+        if storm:
+            return Decision(
+                "up", "breaker_storm",
+                reason=(
+                    f"{state.breakers_open}/{state.breakers_total} breakers "
+                    "open — evidence is replica death, not load"
+                ),
+            )
+        if now - last_up_at < config.up_cooldown_s:
+            return Decision(
+                "up", "cooldown",
+                reason=(
+                    f"last scale-up {now - last_up_at:.1f}s ago "
+                    f"(< {config.up_cooldown_s}s)"
+                ),
+            )
+        count = min(config.step, config.max_replicas - state.replicas)
+        return Decision("up", "spawned", count=count, reason=signal.reason)
+    # direction == "down"
+    if state.replicas <= config.min_replicas:
+        return Decision(
+            "down", "at_min", reason=f"already at min_replicas={config.min_replicas}"
+        )
+    if state.pending > 0:
+        return Decision(
+            "down", "pending",
+            reason=f"{state.pending} lifecycle op(s) still in flight",
+        )
+    if storm:
+        return Decision(
+            "down", "breaker_storm",
+            reason=(
+                f"{state.breakers_open}/{state.breakers_total} breakers open "
+                "— never shrink into a failure"
+            ),
+        )
+    if now - last_down_at < config.down_cooldown_s:
+        return Decision(
+            "down", "cooldown",
+            reason=(
+                f"last scale-down {now - last_down_at:.1f}s ago "
+                f"(< {config.down_cooldown_s}s)"
+            ),
+        )
+    if state.retirable < 1:
+        return Decision(
+            "down", "no_retirable",
+            reason="no supervisor-managed ready replica to retire",
+        )
+    if state.capacity_slots - state.retire_slots < state.demand_slots:
+        return Decision(
+            "down", "inflight_guard",
+            reason=(
+                f"retirement would leave {state.capacity_slots - state.retire_slots} "
+                f"slots under {state.demand_slots} in-flight/queued"
+            ),
+        )
+    return Decision("down", "retired", count=1, reason=signal.reason)
+
+
+class FleetAutoscaler:
+    """The stateful control loop: cooldown clocks, pause flag, journal, and
+    the execution half (supervisor calls). ``step`` is invoked by the
+    router's observe cycle (live) or by the sim (replay) — both paths run
+    identical code; only the clock source and the launcher differ."""
+
+    def __init__(
+        self,
+        supervisor: ReplicaSupervisor,
+        config: AutoscalerConfig | None = None,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.supervisor = supervisor
+        self.config = config or AutoscalerConfig.from_env()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._paused = False
+        self._last_up_at = float("-inf")
+        self._last_down_at = float("-inf")
+        self._seq = 0
+        self.journal: deque[dict] = deque(maxlen=self.config.journal_depth)
+        self._last_decision: Decision | None = None
+        # router hook: count fleet_autoscale_actions_total without this
+        # module importing the metrics wiring (the membership _on_change
+        # inversion, one layer up)
+        self._on_action: Callable[[Decision], None] | None = None
+
+    # ---- operator surface (POST /admin/autoscaler) -----------------------
+
+    def pause(self) -> None:
+        with self._lock:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._lock:
+            self._paused = False
+
+    @property
+    def paused(self) -> bool:
+        with self._lock:
+            return self._paused
+
+    def status(self) -> dict[str, Any]:
+        """GET /admin/autoscaler (and the observatory view's `autoscaler`
+        section): config, pause state, managed-replica snapshot, the last
+        decision, and the journal tail."""
+        with self._lock:
+            journal = list(self.journal)
+            last = self._last_decision.to_dict() if self._last_decision else None
+            paused = self._paused
+        return {
+            "enabled": True,
+            "state": "paused" if paused else "active",
+            "config": self.config.to_dict(),
+            "last_action": last,
+            "managed": self.supervisor.snapshot(),
+            "spawn_errors": self.supervisor.spawn_errors,
+            "restarts": self.supervisor.restarts_total,
+            "journal": journal[-16:],
+        }
+
+    # ---- the loop --------------------------------------------------------
+
+    def step(
+        self,
+        signal: ScaleSignal,
+        state: FleetState,
+        now: float | None = None,
+    ) -> Decision:
+        """One actuation cycle: supervise (reap drains, restart crashes),
+        decide, execute, journal. Never raises — a broken launcher must not
+        kill the router's poll loop (failures surface as outcome=error)."""
+        now = self._clock() if now is None else now
+        self.supervisor.check(now)
+        with self._lock:
+            paused = self._paused
+            last_up, last_down = self._last_up_at, self._last_down_at
+        decision = decide(
+            signal, state, self.config,
+            now=now, paused=paused, last_up_at=last_up, last_down_at=last_down,
+        )
+        if decision.outcome == "spawned":
+            try:
+                urls = self.supervisor.scale_up(decision.count)
+            except Exception as e:  # noqa: BLE001 — the loop must survive the launcher
+                urls = []
+                decision = Decision("up", "error", reason=f"{type(e).__name__}: {e}"[:200])
+            else:
+                if urls:
+                    decision = Decision(
+                        "up", "spawned", count=len(urls), reason=decision.reason
+                    )
+                    with self._lock:
+                        self._last_up_at = now
+                else:
+                    decision = Decision(
+                        "up", "error", reason="every spawn attempt failed"
+                    )
+        elif decision.outcome == "retired":
+            try:
+                retired = self.supervisor.retire_one(now)
+            except Exception as e:  # noqa: BLE001
+                retired = None
+                decision = Decision(
+                    "down", "error", reason=f"{type(e).__name__}: {e}"[:200]
+                )
+            else:
+                if retired is not None:
+                    decision = Decision(
+                        "down", "retired", count=1,
+                        reason=f"draining {retired}: {decision.reason}",
+                    )
+                    with self._lock:
+                        self._last_down_at = now
+                else:
+                    decision = Decision(
+                        "down", "no_retirable",
+                        reason="no supervisor-managed ready replica to retire",
+                    )
+        with self._lock:
+            self._last_decision = decision
+            if decision.direction != "hold":
+                last = self.journal[-1] if self.journal else None
+                if (
+                    last is not None
+                    and decision.outcome not in ("spawned", "retired")
+                    and last["direction"] == decision.direction
+                    and last["outcome"] == decision.outcome
+                ):
+                    # a refused decision repeating every poll cycle (at_max
+                    # during a sustained storm, at_min through a quiet
+                    # night) compresses onto its journal entry instead of
+                    # scrolling the actuation history out of the ring
+                    last["repeats"] = last.get("repeats", 1) + 1
+                else:
+                    self._seq += 1
+                    self.journal.append({"seq": self._seq, **decision.to_dict()})
+        if decision.direction != "hold" and self._on_action is not None:
+            try:
+                self._on_action(decision)
+            except Exception:  # noqa: BLE001 — metrics hook must not break the loop
+                pass
+        return decision
+
+
+# ---- deterministic closed-loop replay ---------------------------------------
+
+
+@dataclass
+class SimWorkload:
+    """A fluid-model serving fleet for the closed-loop sim: per-step request
+    ``arrivals`` against replicas that each serve ``serve_per_replica_s``
+    requests/second. Overflow past the shared ``queue_cap`` sheds as router
+    429s; queueing delay inflates the TTFT observations — the same causal
+    chain the live rate_storm smoke produces, with no sockets, sleeps, or
+    wall clock."""
+
+    arrivals: Sequence[int]
+    serve_per_replica_s: int = 4
+    max_slots: int = 8
+    queue_cap: int = 8
+    tokens_per_request: int = 16
+    base_ttft_s: float = 0.2
+
+
+@dataclass
+class _SimReplica:
+    """Cumulative counters for one sim replica (its registry, in effect)."""
+
+    name: str
+    ring: SnapshotRing
+    tokens: int = 0
+    admitted: int = 0
+    ttfts: list = field(default_factory=list)
+    active_slots: int = 0
+
+
+def _sim_snap(t: float, replica: _SimReplica) -> dict:
+    def family(kind: str, value: Any) -> dict:
+        return {"type": kind, "help": "sim", "series": [{"labels": {}, **value}]}
+
+    counts_buckets = [0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0]
+    counts = [0] * (len(counts_buckets) + 1)
+    for value in replica.ttfts:
+        for i, bound in enumerate(counts_buckets):
+            if value <= bound:
+                counts[i] += 1
+                break
+        else:
+            counts[-1] += 1
+    return {
+        "captured_at": family("gauge", {"value": float(t)}),
+        "serve_tokens_emitted_total": family("counter", {"value": float(replica.tokens)}),
+        "serve_requests_admitted_total": family(
+            "counter", {"value": float(replica.admitted)}
+        ),
+        "serve_requests_completed_total": family(
+            "counter", {"value": float(replica.admitted)}
+        ),
+        "serve_ttft_seconds": family(
+            "histogram",
+            {
+                "buckets": counts_buckets,
+                "counts": counts,
+                "sum": float(sum(replica.ttfts)),
+                "count": len(replica.ttfts),
+            },
+        ),
+        "serve_active_slots": family("gauge", {"value": float(replica.active_slots)}),
+    }
+
+
+def _router_snap(t: float, rejected: int, forwarded: int) -> dict:
+    return {
+        "captured_at": {
+            "type": "gauge", "help": "sim",
+            "series": [{"labels": {}, "value": float(t)}],
+        },
+        "fleet_admission_rejected_total": {
+            "type": "counter", "help": "sim",
+            "series": [{"labels": {}, "value": float(rejected)}],
+        },
+        "fleet_requests_total": {
+            "type": "counter", "help": "sim",
+            "series": [{"labels": {}, "value": float(forwarded)}],
+        },
+    }
+
+
+def closed_loop_replay(
+    workload: SimWorkload,
+    *,
+    config: AutoscalerConfig | None = None,
+    start_replicas: int = 1,
+    fast_s: float = 5.0,
+    slow_s: float = 15.0,
+    policies: Any = None,
+) -> dict[str, Any]:
+    """The sense→act loop replayed deterministically: the REAL evaluator,
+    autoscaler, and supervisor (over a :class:`SimLauncher`) against the
+    fluid workload — each step synthesizes per-replica registry snapshots,
+    evaluates the burn-rate policies over the rings, feeds the signal into
+    the autoscaler, and the resulting spawn/retire changes how the NEXT
+    step's arrivals are served. Two runs of one workload return
+    byte-identical dicts (the elastic-leg test pins the action sequence).
+
+    Returns ``{"actions", "decisions", "signals", "replicas"}`` — actions
+    is the non-hold decision list, replicas the per-step live count."""
+    config = config or AutoscalerConfig(
+        min_replicas=start_replicas,
+        max_replicas=max(4, start_replicas),
+        up_cooldown_s=4.0,
+        down_cooldown_s=8.0,
+    )
+    launcher = SimLauncher()
+    supervisor = ReplicaSupervisor(launcher, membership=None, clock=lambda: 0.0)
+    autoscaler = FleetAutoscaler(supervisor, config, clock=lambda: 0.0)
+    evaluator = SloEvaluator(policies, fast_s=fast_s, slow_s=slow_s)
+
+    replicas: dict[str, _SimReplica] = {}
+
+    def live() -> list[_SimReplica]:
+        by_url = {h.url: h for h in launcher.spawned}
+        return [r for name, r in replicas.items() if by_url[name].alive()]
+
+    for url in supervisor.scale_up(start_replicas):
+        replicas[url] = _SimReplica(url, SnapshotRing())
+
+    backlog = 0.0
+    rejected = forwarded = 0
+    router_ring = SnapshotRing()
+    signals: list[str] = []
+    decisions: list[dict] = []
+    replica_counts: list[int] = []
+    for step_idx, arrived in enumerate(workload.arrivals):
+        t = float(step_idx + 1)
+        pool = live()
+        capacity = len(pool) * workload.serve_per_replica_s
+        served = int(min(capacity, backlog + arrived))
+        overflow = max(0, int(backlog) + int(arrived) - served - workload.queue_cap)
+        backlog = max(0.0, backlog + arrived - served - overflow)
+        rejected += overflow
+        forwarded += served
+        # queueing delay inflates TTFT exactly while the fleet is
+        # under-provisioned; it relaxes as capacity catches up
+        ttft = workload.base_ttft_s + (backlog / capacity if capacity else 0.0)
+        for i, replica in enumerate(pool):
+            share = served // len(pool) + (1 if i < served % len(pool) else 0)
+            replica.admitted += share
+            replica.tokens += share * workload.tokens_per_request
+            replica.ttfts.extend([ttft] * share)
+            replica.active_slots = min(
+                workload.max_slots,
+                share + (int(backlog) // len(pool) if backlog else 0),
+            )
+            replica.ring.append(_sim_snap(t, replica))
+        router_ring.append(_router_snap(t, rejected, forwarded))
+        slot_capacity = len(pool) * workload.max_slots
+        _, signal = evaluator.evaluate(
+            [r.ring for r in pool], router_ring, capacity=slot_capacity or None
+        )
+        demand = int(min(slot_capacity, backlog)) + sum(
+            r.active_slots for r in pool
+        )
+        state = FleetState(
+            replicas=len(pool),
+            retirable=supervisor.retirable(),
+            demand_slots=min(demand, slot_capacity),
+            capacity_slots=slot_capacity,
+            retire_slots=workload.max_slots,
+            breakers_open=0,
+            breakers_total=len(pool),
+            pending=supervisor.pending(),
+        )
+        decision = autoscaler.step(signal, state, now=t)
+        if signal.direction == "down":
+            # the actuator consumed (or deliberately refused) this cycle's
+            # down recommendation; re-arm so a still-idle smaller fleet can
+            # recommend again — the autoscaler's cooldown paces it now
+            evaluator.rearm_down()
+        if decision.outcome == "spawned":
+            for handle in launcher.spawned:
+                if handle.url not in replicas:
+                    replicas[handle.url] = _SimReplica(handle.url, SnapshotRing())
+        signals.append(signal.direction)
+        decisions.append(decision.to_dict())
+        replica_counts.append(len(live()))
+    return {
+        "actions": [d for d in decisions if d["direction"] != "hold"],
+        "decisions": decisions,
+        "signals": signals,
+        "replicas": replica_counts,
+    }
+
+
+def storm_arrivals(steps: int = 48, *, seed: int = 7, quiet_tail: int = 24) -> list[int]:
+    """Per-step arrivals derived from the loadgen ``rate_storm`` schedule —
+    the same derivation the observatory's replay fixtures use: the seeded
+    burst re-releases every third second (Retry-After'd clients come
+    straight back) for ``steps - quiet_tail`` seconds, then goes quiet so
+    the idle half of the loop (down → hold) replays too. Deterministic:
+    one seed, one list."""
+    from prime_tpu.loadgen.scenario import SCENARIOS, build_schedule
+
+    burst = len(build_schedule(SCENARIOS["rate_storm"](seed=seed), vocab=101))
+    active = max(1, steps - quiet_tail)
+    return [
+        burst if (t % 3 == 0 and t < active) else 0 for t in range(steps)
+    ]
+
+
+def cancel_storm_arrivals(steps: int = 36, *, seed: int = 7) -> list[int]:
+    """Steady arrivals shaped from the ``cancel_storm`` schedule: client
+    churn without oversubscription — the fixture the loop must ride out
+    with zero actions (hold end to end)."""
+    from prime_tpu.loadgen.scenario import SCENARIOS, build_schedule
+
+    schedule = build_schedule(SCENARIOS["cancel_storm"](seed=seed), vocab=101)
+    # churn, not oversubscription: the storm's clients abandon mid-decode,
+    # they do not arrive faster than one replica serves — the loop must
+    # ride this out without a single action
+    steady = max(1, len(schedule) // 8)
+    return [steady] * steps

@@ -69,7 +69,11 @@ from prime_tpu.obs.trace import (
 from prime_tpu.serve.digest import CHARS_PER_TOKEN, MIN_BUCKET
 from prime_tpu.serve.errors import backpressure_response
 from prime_tpu.serve.fleet.balancer import PrefixAffinityBalancer
-from prime_tpu.serve.fleet.membership import BREAKER_GAUGE, FleetMembership
+from prime_tpu.serve.fleet.membership import (
+    BREAKER_GAUGE,
+    BREAKER_OPEN,
+    FleetMembership,
+)
 from prime_tpu.serve.server import render_chat_prompt
 
 CHAT_PATHS = ("/v1/chat/completions", "/api/v1/chat/completions")
@@ -310,6 +314,22 @@ class FleetRouter:
             "registry sampling, by replica",
             labelnames=("replica",),
         )
+        # elastic fleet actuator (docs/architecture.md "Elastic fleet"):
+        # autoscaler decisions by direction/outcome, and the replica count
+        # split by lifecycle state (membership states + the supervisor's
+        # crash-restart limbo state; each replica counts in exactly one)
+        self._m_autoscale_actions = r.counter(
+            "fleet_autoscale_actions_total",
+            "Autoscaler decisions, by direction and outcome (spawned/retired "
+            "are actuations; the rest are interlock refusals)",
+            labelnames=("direction", "outcome"),
+        )
+        self._m_replicas = r.gauge(
+            "fleet_replicas",
+            "Fleet replicas by lifecycle state (membership + supervisor "
+            "states; every replica counts in exactly one state)",
+            labelnames=("state",),
+        )
         self.ring = SnapshotRing()  # the router's own registry history
         self.slo = SloEvaluator()
         # reentrant: observatory_view holds it across a nested observe_once
@@ -318,6 +338,9 @@ class FleetRouter:
         self._last_signal: ScaleSignal | None = None
         self.membership._on_sample = self._on_replica_sample
         self.membership._on_poll = self._observe_safe
+        # elastic fleet actuator: attach_autoscaler() installs one; until
+        # then the observatory stays a recommendation-only sensor
+        self.autoscaler = None
         self._t0 = time.monotonic()
 
         outer = self
@@ -378,6 +401,14 @@ class FleetRouter:
                         self._json(403, {"error": {"message": "admin token required"}})
                         return
                     self._json(200, outer.observatory_view())
+                elif path == "/admin/autoscaler":
+                    # actuator status: config, pause state, managed
+                    # replicas, the decision journal. Admin parity — it
+                    # names replica urls and actuation history.
+                    if not outer._admin_authorized(self.headers):
+                        self._json(403, {"error": {"message": "admin token required"}})
+                        return
+                    self._json(200, outer.autoscaler_status())
                 elif path.rstrip("/") == "/debug/requests" or path.startswith(
                     "/debug/requests/"
                 ):
@@ -447,6 +478,27 @@ class FleetRouter:
                     outer.membership.poll_once(replica)
                     self._json(200, {"joined": replica.id})
                     return
+                if path == "/admin/autoscaler":
+                    # pause/resume the actuator (the admin-token gate above
+                    # already covered /admin/*): an operator fighting an
+                    # incident must be able to freeze actuation in one POST
+                    action = outer._json_field(raw, "action")
+                    if outer.autoscaler is None:
+                        self._json(
+                            404, {"error": {"message": "no autoscaler attached"}}
+                        )
+                    elif action == "pause":
+                        outer.autoscaler.pause()
+                        self._json(200, outer.autoscaler_status())
+                    elif action == "resume":
+                        outer.autoscaler.resume()
+                        self._json(200, outer.autoscaler_status())
+                    else:
+                        self._json(
+                            400,
+                            {"error": {"message": "action must be 'pause' or 'resume'"}},
+                        )
+                    return
                 if path not in CHAT_PATHS:
                     self._json(404, {"error": {"message": f"no route {self.path}"}})
                     return
@@ -482,11 +534,42 @@ class FleetRouter:
                 )
             return self._client
 
+    # fleet_replicas{state} label vocabulary: membership lifecycle states
+    # plus the supervisor's pre-membership ones — bounded, so a replica
+    # advertising a junk state cannot balloon series cardinality
+    _REPLICA_STATES = (
+        "ready", "draining", "loading", "down", "unknown", "restart_wait",
+        "other",
+    )
+
     def _sync_gauges(self) -> None:
         with self.membership._lock:
             states = {r.id: r.breaker for r in self.membership.replicas.values()}
         for rid, breaker in states.items():
             self._m_breaker.set(BREAKER_GAUGE[breaker], replica=rid)
+        self._sync_replica_gauge()
+
+    def _sync_replica_gauge(self) -> None:
+        """fleet_replicas{state}: every replica counted in exactly ONE state
+        — membership rows by their polled lifecycle state, plus supervisor-
+        managed replicas that are not in membership anymore because their
+        process crashed and is waiting out its restart backoff
+        (restart_wait). Every vocabulary state is set each sync (zeros
+        included) so a state a replica LEFT reads 0, not its stale count."""
+        with self.membership._lock:
+            member_states = [r.state for r in self.membership.replicas.values()]
+        counts = {state: 0 for state in self._REPLICA_STATES}
+        for state in member_states:
+            counts[state if state in counts else "other"] += 1
+        if self.autoscaler is not None:
+            # membership-visible managed states (ready/draining) were
+            # already counted from membership itself; only the crash-
+            # restart limbo state adds here
+            counts["restart_wait"] += self.autoscaler.supervisor.counts().get(
+                "restart_wait", 0
+            )
+        for state, n in counts.items():
+            self._m_replicas.set(n, state=state)
 
     def _retry_after(self) -> float:
         """Seconds a 429'd client should wait: the mean admission wait scaled
@@ -1124,13 +1207,16 @@ class FleetRouter:
             if r.last_poll_at and now - r.last_poll_at <= horizon
         ]
 
-    def observe_once(self):
+    def observe_once(self, actuate: bool = True):
         """One observatory cycle (rides the membership poll): sample the
         router's own registry into its ring, evaluate the SLO policies over
         every replica's ring + the router's, publish the result
         (``fleet_scale_signal`` gauge, ``fleet_slo_breach_total`` counters)
         — all inside a ``fleet.observe`` span so the observatory itself is
-        observable. Returns (verdicts, signal)."""
+        observable. Returns (verdicts, signal). ``actuate=False`` skips the
+        autoscaler step — the observatory_view's lazy first evaluation uses
+        it so a read-only GET can never spawn/retire replicas (and never
+        blocks a launch under the observe lock it holds)."""
         with self._observe_lock:
             with TRACER.span("fleet.observe") as span:
                 self.ring.append(self.registry.snapshot())
@@ -1155,7 +1241,97 @@ class FleetRouter:
                 span.set_attr("signal", signal.direction)
                 span.set_attr("replicas", len(replicas))
                 self._last_verdicts, self._last_signal = verdicts, signal
-                return verdicts, signal
+        # actuation runs OUTSIDE the observe lock: a spawn blocks for the
+        # new replica's readiness, and holding the lock through it would
+        # freeze /admin/observatory for the whole launch (the poll cycle
+        # that called us waits either way — the pending interlock keeps
+        # that to one launch at a time)
+        if actuate and self.autoscaler is not None:
+            self._actuate_safe(signal)
+        # re-derive fleet_replicas{state} every cycle: health polls move
+        # replicas between states without firing the membership _on_change
+        # hook (only breaker/membership transitions do)
+        self._sync_replica_gauge()
+        return verdicts, signal
+
+    def _actuate_safe(self, signal) -> None:
+        """One autoscaler step off the observe cycle, inside a
+        ``fleet.scale`` span. Never raises — actuation failure must not
+        kill the poll loop (the step itself already downgrades launcher
+        errors to outcome=error; this guards the state-gathering glue)."""
+        try:
+            with TRACER.span("fleet.scale") as span:
+                decision = self.autoscaler.step(signal, self._fleet_state())
+                span.set_attr("direction", decision.direction)
+                span.set_attr("outcome", decision.outcome)
+                if decision.count:
+                    span.set_attr("count", decision.count)
+            if signal.direction == "down":
+                # the actuator consumed (or refused) this cycle's down
+                # recommendation; re-arm the episode latch so a still-idle
+                # smaller fleet keeps recommending — the autoscaler's
+                # down-cooldown paces the shrink now (obs/slo.rearm_down)
+                self.slo.rearm_down()
+        except Exception:  # noqa: BLE001 — the poll loop must never die over actuation
+            pass
+
+    def _fleet_state(self):
+        """The decide inputs (autoscaler.FleetState) from live membership +
+        gate + supervisor state. ``demand_slots`` is the inflight guard's
+        evidence: work already admitted or queued on routable replicas,
+        floored by the router's own in-flight count (a just-forwarded
+        request may not show in a replica's last-polled queue_depth yet)."""
+        from prime_tpu.serve.fleet.autoscaler import FleetState
+
+        routable = self.membership.routable_replicas()
+        with self.membership._lock:
+            replicas = list(self.membership.replicas.values())
+        supervisor = self.autoscaler.supervisor
+        countable = sum(
+            1 for r in replicas if r.state in ("ready", "unknown", "loading")
+        )
+        restarting = supervisor.counts().get("restart_wait", 0)
+        demand = sum(r.active_slots + r.queue_depth for r in routable)
+        # size the inflight guard against the replica retire_one would
+        # ACTUALLY pick (supervisor order, not membership order — the two
+        # diverge after a crash-restart re-join)
+        retire_slots = 0
+        retirable = supervisor.retirable()
+        candidate_id = supervisor.retire_candidate()
+        if candidate_id is not None:
+            candidate = self.membership.get(candidate_id)
+            retire_slots = candidate.max_slots if candidate is not None else 0
+        open_breakers = sum(1 for r in replicas if r.breaker == BREAKER_OPEN)
+        draining = sum(1 for r in replicas if r.state == "draining")
+        return FleetState(
+            replicas=countable + restarting,
+            retirable=retirable,
+            demand_slots=max(demand, self._gate.inflight),
+            capacity_slots=sum(r.max_slots for r in routable),
+            retire_slots=retire_slots,
+            breakers_open=open_breakers,
+            breakers_total=len(replicas),
+            pending=supervisor.pending() + draining,
+        )
+
+    def attach_autoscaler(self, autoscaler) -> "FleetRouter":
+        """Install the elastic actuator (autoscaler.FleetAutoscaler): every
+        observe cycle feeds it the fresh scale signal, its decisions count
+        into ``fleet_autoscale_actions_total``, and its status joins the
+        observatory view + GET /admin/autoscaler."""
+        self.autoscaler = autoscaler
+        autoscaler._on_action = lambda decision: self._m_autoscale_actions.inc(
+            direction=decision.direction, outcome=decision.outcome
+        )
+        self._sync_replica_gauge()
+        return self
+
+    def autoscaler_status(self) -> dict:
+        """GET /admin/autoscaler payload (``{"enabled": false}`` when no
+        actuator is attached — the observatory stays a sensor)."""
+        if self.autoscaler is None:
+            return {"enabled": False, "state": "off"}
+        return self.autoscaler.status()
 
     def _router_window(self, window_s: float) -> dict:
         """Router-side slice of one observatory window (429s, queue wait) —
@@ -1196,7 +1372,9 @@ class FleetRouter:
         the current scale signal. Schema in docs/observability.md."""
         with self._observe_lock:
             if self._last_signal is None:
-                self.observe_once()
+                # evaluation only: a read-only GET must never actuate (nor
+                # hold this reentrant lock through a replica launch)
+                self.observe_once(actuate=False)
             with self.membership._lock:
                 replicas = list(self.membership.replicas.values())
             # the TABLE lists everyone (a dead replica should be visible);
@@ -1209,9 +1387,19 @@ class FleetRouter:
                 row = replica.snapshot()
                 rate = replica.ring.rate("serve_tokens_emitted_total", fast_s)
                 row["tok_s"] = round(rate, 3) if rate is not None else None
+                # autoscaler-managed replicas carry their supervisor
+                # lifecycle state; operator-joined ones read null (the
+                # actuator never touches them) — `prime serve top` renders
+                # the column either way
+                row["managed"] = (
+                    self.autoscaler.supervisor.managed_state(replica.id)
+                    if self.autoscaler is not None
+                    else None
+                )
                 rows.append(row)
             signal = self._last_signal or ScaleSignal("hold", "no evaluation yet")
             return {
+                "autoscaler": self.autoscaler_status(),
                 "windows": {"fast_s": fast_s, "slow_s": slow_s},
                 "signal": signal.to_dict(),
                 "slo": [verdict.to_dict() for verdict in self._last_verdicts],
@@ -1345,6 +1533,10 @@ class FleetRouter:
             self._server.shutdown()
             self._serving = False
         self._server.server_close()
+        if self.autoscaler is not None:
+            # reap managed replicas: the router going away must not leak
+            # the subprocesses it launched
+            self.autoscaler.supervisor.shutdown()
         self.membership.stop()
         with self._client_lock:
             if self._client is not None:

@@ -1,0 +1,473 @@
+"""Replica lifecycle supervisor: the autoscaler's hands.
+
+The autoscaler (autoscaler.py) decides *when* the fleet grows or shrinks;
+this module owns *how* a replica comes into and leaves existence:
+
+- **ReplicaLauncher** is the pluggable seam between the control loop and
+  whatever actually provisions serving capacity. The in-tree
+  :class:`LocalProcessLauncher` spawns `prime serve --replica-of`
+  subprocesses on this host and waits on ``/healthz`` readiness — the
+  single-machine proof. A TPU-slice launcher (allocate slice, boot the
+  serve image, same readiness contract) plugs in here later without the
+  supervisor or autoscaler changing; tests and the closed-loop sim plug in
+  :class:`SimLauncher` (no processes at all).
+- **ReplicaSupervisor** tracks every replica it launched through a small
+  lifecycle — ``ready → draining → retired`` with a crash →
+  ``restart_wait`` detour — and enforces the two safety rules the autoscaler
+  relies on: **drain-before-kill** (a retirement marks the replica draining
+  via the fleet membership, which excludes it from routing and POSTs its
+  own ``/admin/drain``; the process is only reaped once the replica reports
+  ``drained: true`` or the drain timeout lapses) and **crash-restart with
+  capped exponential backoff** (a managed replica whose process died
+  restarts after ``base * 2^restarts`` seconds, capped, so a crash-looping
+  checkpoint cannot hot-loop the host; a replica that stays healthy long
+  enough earns its backoff counter back).
+
+The supervisor only ever retires replicas *it* launched — an operator's
+statically-joined replica is never drained by the autoscaler. With
+``membership=None`` the supervisor runs in **sim mode** (no HTTP, drains
+complete instantly): the deterministic closed-loop replay drives exactly
+the same code the live fleet runs. See docs/architecture.md "Elastic
+fleet".
+"""
+
+from __future__ import annotations
+
+import shlex
+import socket
+import subprocess
+import threading
+import time
+from typing import Any, Callable, Protocol
+
+# managed-replica lifecycle states (the fleet_replicas{state} gauge's
+# supervisor-sourced vocabulary; membership supplies ready/draining/...).
+# There is no "spawning" state on purpose: spawn() blocks until the replica
+# answers its readiness probe, so an entry first exists as READY — a launch
+# in progress is visible as the pending interlock, not as a gauge state.
+STATE_READY = "ready"
+STATE_DRAINING = "draining"
+STATE_RETIRED = "retired"
+STATE_RESTART_WAIT = "restart_wait"
+
+
+class ReplicaHandle(Protocol):
+    """One launched replica, as the supervisor holds it."""
+
+    url: str
+
+    def alive(self) -> bool:
+        """Is the underlying process/instance still running?"""
+        ...
+
+    def terminate(self) -> None:
+        """Hard-stop and reap. Idempotent; called only after a drain
+        completed (or timed out) — never as the first resort."""
+        ...
+
+
+class ReplicaLauncher(Protocol):
+    """The provisioning seam (module docstring): produce one serving
+    replica, READY to register — ``spawn`` returns only once the replica
+    answers its readiness probe (or raises)."""
+
+    def spawn(self) -> ReplicaHandle: ...
+
+
+def _free_port(host: str) -> int:
+    """Bind-then-release port pick: the tiny race with another process is
+    acceptable for a launcher (a lost race fails readiness and surfaces as
+    a spawn error the autoscaler counts)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+class ProcessHandle:
+    """A subprocess-backed replica (LocalProcessLauncher's handles)."""
+
+    def __init__(self, url: str, process: Any) -> None:
+        self.url = url
+        self.process = process
+
+    def alive(self) -> bool:
+        return self.process.poll() is None
+
+    def terminate(self) -> None:
+        if self.process.poll() is None:
+            self.process.terminate()
+            try:
+                self.process.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — escalate rather than leak
+                try:
+                    self.process.kill()
+                    self.process.wait(timeout=5)
+                except Exception:  # noqa: BLE001 — nothing left to do
+                    pass
+        else:
+            # reap the zombie either way
+            try:
+                self.process.wait(timeout=0)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class LocalProcessLauncher:
+    """Spawn `prime serve` subprocesses on this host (module docstring).
+
+    ``command`` is a shell-style template whose tokens may use ``{host}``,
+    ``{port}`` and ``{router}`` placeholders, e.g.::
+
+        prime serve -m tiny-test --continuous --host {host} --port {port} \\
+            --replica-of {router}
+
+    ``spawn()`` picks a free port, launches the command, and polls the
+    replica's ``/healthz`` until it answers (any HTTP status counts as
+    alive — ``loading`` is a healthy launch in progress; readiness beyond
+    that is the membership poll's job once the replica registers). A spawn
+    that never answers within ``ready_timeout_s`` is terminated and raised.
+    ``popen_fn``/``probe_fn`` are injectable for tests."""
+
+    def __init__(
+        self,
+        command: str | list[str],
+        *,
+        router_url: str = "",
+        host: str = "127.0.0.1",
+        ready_timeout_s: float = 180.0,
+        probe_interval_s: float = 0.5,
+        popen_fn: Callable[..., Any] | None = None,
+        probe_fn: Callable[[str], bool] | None = None,
+    ) -> None:
+        self.command = shlex.split(command) if isinstance(command, str) else list(command)
+        if not self.command:
+            raise ValueError("launcher command must not be empty")
+        self.router_url = router_url.rstrip("/")
+        self.host = host
+        self.ready_timeout_s = ready_timeout_s
+        self.probe_interval_s = probe_interval_s
+        self._popen = popen_fn or subprocess.Popen
+        self._probe = probe_fn or self._http_probe
+
+    @staticmethod
+    def _http_probe(url: str) -> bool:
+        import httpx
+
+        try:
+            httpx.get(f"{url}/healthz", timeout=2.0)
+            return True  # any HTTP answer: the listener is up
+        except httpx.HTTPError:
+            return False
+
+    def spawn(self) -> ProcessHandle:
+        port = _free_port(self.host)
+        subs = {"host": self.host, "port": str(port), "router": self.router_url}
+        argv = [token.format(**subs) for token in self.command]
+        url = f"http://{self.host}:{port}"
+        process = self._popen(argv)
+        handle = ProcessHandle(url, process)
+        deadline = time.monotonic() + self.ready_timeout_s
+        while time.monotonic() < deadline:
+            if not handle.alive():
+                raise RuntimeError(
+                    f"replica process exited during launch: {' '.join(argv)}"
+                )
+            if self._probe(url):
+                return handle
+            time.sleep(self.probe_interval_s)
+        handle.terminate()
+        raise RuntimeError(
+            f"replica at {url} never answered /healthz within "
+            f"{self.ready_timeout_s}s"
+        )
+
+
+class SimHandle:
+    """An in-memory replica handle for the closed-loop sim and unit tests."""
+
+    def __init__(self, url: str) -> None:
+        self.url = url
+        self._alive = True
+
+    def alive(self) -> bool:
+        return self._alive
+
+    def terminate(self) -> None:
+        self._alive = False
+
+    def crash(self) -> None:
+        """Test/sim hook: the process died without anyone asking."""
+        self._alive = False
+
+
+class SimLauncher:
+    """Launcher that spawns nothing: handles are in-memory markers. The
+    deterministic closed-loop replay (autoscaler.closed_loop_replay) and
+    the supervisor unit tests drive the REAL supervisor through this."""
+
+    def __init__(self) -> None:
+        self.spawned: list[SimHandle] = []
+        self.fail_next = 0  # test hook: raise on the next N spawns
+
+    def spawn(self) -> SimHandle:
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise RuntimeError("sim spawn failure (injected)")
+        handle = SimHandle(f"sim://replica-{len(self.spawned)}")
+        self.spawned.append(handle)
+        return handle
+
+
+class ManagedReplica:
+    """One supervisor-launched replica's lifecycle record."""
+
+    def __init__(self, handle: ReplicaHandle, replica_id: str, now: float) -> None:
+        self.handle = handle
+        self.url = handle.url
+        self.replica_id = replica_id
+        self.state = STATE_READY
+        self.spawned_at = now
+        self.ready_at = now
+        self.restarts = 0
+        self.next_restart_at = 0.0
+        self.drain_deadline = 0.0
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "replica_id": self.replica_id,
+            "url": self.url,
+            "state": self.state,
+            "restarts": self.restarts,
+        }
+
+
+class ReplicaSupervisor:
+    """Launch, register, retire and resurrect managed replicas (module
+    docstring). All mutation happens under one lock; the callers are the
+    router's observe cycle (autoscaler step + periodic ``check``) and
+    ``shutdown()``."""
+
+    def __init__(
+        self,
+        launcher: ReplicaLauncher,
+        membership: Any = None,
+        *,
+        restart_backoff_s: float = 1.0,
+        restart_backoff_cap_s: float = 60.0,
+        backoff_reset_s: float = 120.0,
+        drain_timeout_s: float = 60.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.launcher = launcher
+        self.membership = membership
+        self.restart_backoff_s = max(0.0, restart_backoff_s)
+        self.restart_backoff_cap_s = max(self.restart_backoff_s, restart_backoff_cap_s)
+        self.backoff_reset_s = backoff_reset_s
+        self.drain_timeout_s = drain_timeout_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._managed: list[ManagedReplica] = []
+        self.spawn_errors = 0
+        self.restarts_total = 0
+
+    # ---- queries ---------------------------------------------------------
+
+    def _replica_id(self, url: str) -> str:
+        from prime_tpu.serve.fleet.membership import replica_id_for
+
+        return replica_id_for(url)
+
+    def counts(self) -> dict[str, int]:
+        """Managed replicas by lifecycle state (``retired`` excluded — they
+        no longer exist; crash/restart states surface so the
+        ``fleet_replicas`` gauge can show a resurrection in progress)."""
+        with self._lock:
+            out: dict[str, int] = {}
+            for entry in self._managed:
+                if entry.state == STATE_RETIRED:
+                    continue
+                out[entry.state] = out.get(entry.state, 0) + 1
+            return out
+
+    def managed_state(self, replica_id: str) -> str | None:
+        with self._lock:
+            for entry in self._managed:
+                if entry.replica_id == replica_id and entry.state != STATE_RETIRED:
+                    return entry.state
+        return None
+
+    def retirable(self) -> int:
+        """How many replicas a scale-down may currently target: managed AND
+        ready (a draining/restarting replica is already mid-transition —
+        one lifecycle operation per replica at a time)."""
+        with self._lock:
+            return sum(1 for e in self._managed if e.state == STATE_READY)
+
+    def retire_candidate(self) -> str | None:
+        """The replica id :meth:`retire_one` WOULD retire right now (newest
+        ready managed, same selection) — the autoscaler's inflight guard
+        sizes the retirement against THIS replica's slots, so the two must
+        never diverge."""
+        with self._lock:
+            entry = next(
+                (e for e in reversed(self._managed) if e.state == STATE_READY), None
+            )
+            return entry.replica_id if entry is not None else None
+
+    def pending(self) -> int:
+        """Lifecycle operations still in flight (draining replicas + crash
+        restarts waiting out their backoff): the autoscaler holds while any
+        are pending, so one decision's effect lands before the next."""
+        with self._lock:
+            return sum(
+                1
+                for e in self._managed
+                if e.state in (STATE_DRAINING, STATE_RESTART_WAIT)
+            )
+
+    def snapshot(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return [e.snapshot() for e in self._managed if e.state != STATE_RETIRED]
+
+    # ---- scale up --------------------------------------------------------
+
+    def scale_up(self, count: int = 1) -> list[str]:
+        """Spawn ``count`` replicas and register each with the fleet
+        membership (the local half of ``POST /admin/join``). Returns the
+        urls that actually came up; spawn failures are counted and swallowed
+        (the autoscaler's action outcome reports them)."""
+        urls: list[str] = []
+        now = self._clock()
+        for _ in range(max(0, count)):
+            try:
+                handle = self.launcher.spawn()
+            except Exception:  # noqa: BLE001 — a failed spawn must not kill the loop
+                with self._lock:
+                    self.spawn_errors += 1
+                continue
+            entry = ManagedReplica(handle, self._replica_id(handle.url), now)
+            with self._lock:
+                self._managed.append(entry)
+            self._join(entry)
+            urls.append(handle.url)
+        return urls
+
+    def _join(self, entry: ManagedReplica) -> None:
+        if self.membership is None:
+            return
+        replica = self.membership.add(entry.url)
+        entry.replica_id = replica.id
+        try:
+            self.membership.poll_once(replica)
+        except Exception:  # noqa: BLE001 — the next poll cycle covers it
+            pass
+
+    # ---- scale down (drain-before-kill) ----------------------------------
+
+    def retire_one(self, now: float | None = None) -> str | None:
+        """Begin retiring the NEWEST ready managed replica (LIFO keeps the
+        longest-lived — warmest-cached — replicas serving). Drain first,
+        always: membership.drain excludes it from routing and POSTs its
+        ``/admin/drain``; ``check()`` reaps the process once the replica
+        reports drained (or the timeout lapses). Returns the replica id, or
+        None when nothing is retirable."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            entry = next(
+                (e for e in reversed(self._managed) if e.state == STATE_READY), None
+            )
+            if entry is None:
+                return None
+            entry.state = STATE_DRAINING
+            entry.drain_deadline = now + self.drain_timeout_s
+        if self.membership is not None:
+            self.membership.drain(entry.replica_id)
+        else:
+            # sim mode: drains complete instantly (still drain-THEN-kill in
+            # state order — the sim fleet stops routing to it this step)
+            self._reap(entry)
+        return entry.replica_id
+
+    def _reap(self, entry: ManagedReplica) -> None:
+        try:
+            entry.handle.terminate()
+        except Exception:  # noqa: BLE001 — a zombie beats a dead supervisor
+            pass
+        if self.membership is not None:
+            self.membership.remove(entry.replica_id)
+        with self._lock:
+            entry.state = STATE_RETIRED
+
+    def _drained(self, entry: ManagedReplica) -> bool:
+        if self.membership is None:
+            return True
+        replica = self.membership.get(entry.replica_id)
+        # gone from membership (operator removed it) counts as drained; a
+        # dead process has nothing left in flight either
+        if replica is None or not entry.handle.alive():
+            return True
+        return bool(replica.drained)
+
+    # ---- crash restart ---------------------------------------------------
+
+    def check(self, now: float | None = None) -> None:
+        """One supervision pass (rides the router's observe cycle): reap
+        drain-complete retirements, detect crashed processes, and restart
+        them once their backoff lapses."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            entries = list(self._managed)
+        for entry in entries:
+            if entry.state == STATE_DRAINING:
+                if self._drained(entry) or now >= entry.drain_deadline:
+                    self._reap(entry)
+            elif entry.state == STATE_READY:
+                if not entry.handle.alive():
+                    with self._lock:
+                        # healthy long enough? the crash loop is over — the
+                        # backoff ladder starts from the bottom again
+                        if now - entry.ready_at >= self.backoff_reset_s:
+                            entry.restarts = 0
+                        entry.state = STATE_RESTART_WAIT
+                        entry.next_restart_at = now + min(
+                            self.restart_backoff_cap_s,
+                            self.restart_backoff_s * (2 ** entry.restarts),
+                        )
+                    if self.membership is not None:
+                        self.membership.remove(entry.replica_id)
+            elif entry.state == STATE_RESTART_WAIT:
+                if now >= entry.next_restart_at:
+                    self._restart(entry, now)
+
+    def _restart(self, entry: ManagedReplica, now: float) -> None:
+        try:
+            handle = self.launcher.spawn()
+        except Exception:  # noqa: BLE001 — climb the backoff ladder and retry
+            with self._lock:
+                self.spawn_errors += 1
+                entry.restarts += 1
+                entry.next_restart_at = now + min(
+                    self.restart_backoff_cap_s,
+                    self.restart_backoff_s * (2 ** entry.restarts),
+                )
+            return
+        with self._lock:
+            entry.handle = handle
+            entry.url = handle.url
+            entry.replica_id = self._replica_id(handle.url)
+            entry.state = STATE_READY
+            entry.ready_at = now
+            entry.restarts += 1
+            self.restarts_total += 1
+        self._join(entry)
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def shutdown(self) -> None:
+        """Terminate every managed replica (best-effort, no drain — this is
+        the router process going away, not a scale decision)."""
+        with self._lock:
+            entries = list(self._managed)
+        for entry in entries:
+            if entry.state != STATE_RETIRED:
+                self._reap(entry)

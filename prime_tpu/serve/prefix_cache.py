@@ -574,56 +574,72 @@ class BlockPrefixCache:
         converters round-trip shapes/dtypes exactly. int8 KV scales are
         ordinary named leaves and ride along. Returns None when no full
         block of ``ids`` is cached. Segments must be dict-of-array pytrees
-        (the engine's layout) or bare arrays."""
-        import numpy as np
+        (the engine's layout) or bare arrays.
 
+        Callers on the tree-owning thread use this one-shot form; the
+        engine's OFF-LOOP export marshals only ``match``/``release`` onto
+        its loop and runs :meth:`serialize_match` on the calling thread."""
         limit = len(ids) if limit is None else limit
         match = self.match(ids, limit=limit)
         if match is None:
             return None
         try:
-            tokens: list[int] = []
-            manifests: list[dict] = []
-            blobs: list[bytes] = []
-            # read the pin-time snapshots, not the live nodes: a concurrent
-            # insert may split a pinned node mid-serialization (off-loop
-            # export) — the snapshot keeps this read consistent
-            runs = match.tokens_snapshot or [n.tokens for n, _ in match.entries]
-            for (node, take), run, segment in zip(
-                match.entries, runs, match.segments()
-            ):
-                tokens.extend(int(t) for t in run[:take])
-                items = (
-                    sorted(segment.items())
-                    if isinstance(segment, dict)
-                    else [("", segment)]
-                )
-                leaves = []
-                for name, leaf in items:
-                    arr = np.ascontiguousarray(np.asarray(leaf)[..., :take])
-                    leaves.append(
-                        {
-                            "name": name,
-                            "dtype": str(arr.dtype),
-                            "shape": list(arr.shape),
-                            "nbytes": int(arr.nbytes),
-                        }
-                    )
-                    blobs.append(arr.tobytes())
-                manifests.append({"take": int(take), "leaves": leaves})
-            header = {
-                "version": KV_WIRE_VERSION,
-                "block": self.block,
-                "tokens": tokens,
-                "segments": manifests,
-            }
-            return (
-                json.dumps(header, separators=(",", ":")).encode()
-                + b"\n"
-                + b"".join(blobs)
-            )
+            return self.serialize_match(match)
         finally:
             self.release(match)
+
+    def serialize_match(self, match: PrefixMatch) -> bytes:
+        """Serialize a PINNED match into the wire payload. Thread-free by
+        construction: every read goes through the match's pin-time
+        SNAPSHOTS (segments/token runs captured when the pin landed,
+        refreshed only by promote), so this may run OFF the tree-owning
+        thread while concurrent inserts ``_split`` the pinned path — the
+        snapshot keeps the serialization consistent and the pin keeps the
+        byte-budget LRU from freeing or demoting anything mid-read. The
+        caller owns the pin lifecycle: ``match()`` before, ``release()``
+        after (both on the tree-owning thread)."""
+        import numpy as np
+
+        tokens: list[int] = []
+        manifests: list[dict] = []
+        blobs: list[bytes] = []
+        # read the pin-time snapshots, not the live nodes: a concurrent
+        # insert may split a pinned node mid-serialization (off-loop
+        # export) — the snapshot keeps this read consistent
+        runs = match.tokens_snapshot or [n.tokens for n, _ in match.entries]
+        for (node, take), run, segment in zip(
+            match.entries, runs, match.segments()
+        ):
+            tokens.extend(int(t) for t in run[:take])
+            items = (
+                sorted(segment.items())
+                if isinstance(segment, dict)
+                else [("", segment)]
+            )
+            leaves = []
+            for name, leaf in items:
+                arr = np.ascontiguousarray(np.asarray(leaf)[..., :take])
+                leaves.append(
+                    {
+                        "name": name,
+                        "dtype": str(arr.dtype),
+                        "shape": list(arr.shape),
+                        "nbytes": int(arr.nbytes),
+                    }
+                )
+                blobs.append(arr.tobytes())
+            manifests.append({"take": int(take), "leaves": leaves})
+        header = {
+            "version": KV_WIRE_VERSION,
+            "block": self.block,
+            "tokens": tokens,
+            "segments": manifests,
+        }
+        return (
+            json.dumps(header, separators=(",", ":")).encode()
+            + b"\n"
+            + b"".join(blobs)
+        )
 
     def import_segments(self, payload: bytes) -> int:
         """Insert a wire payload (``export_segments`` output, possibly from

@@ -1104,6 +1104,7 @@ def serve_model(
     prefix_cache_mb: float | None = None,
     prefix_cache_host_mb: float | None = None,
     adapter_max_inflight: int | None = None,
+    adapter_weights: "str | dict | None" = None,
     max_queue: int | None = None,
     admin_token: str | None = None,
     role: str | None = None,
@@ -1157,6 +1158,13 @@ def serve_model(
             "--adapter merges one adapter into the base weights; --adapters "
             "serves a bank unmerged — pass one (a merged base would corrupt "
             "the bank's base-fingerprint check)"
+        )
+    if adapter_weights and not continuous:
+        # the bank requirement itself is enforced by the engine (adapters
+        # may arrive via PRIME_SERVE_ADAPTERS rather than this argument)
+        raise ValueError(
+            "--adapter-weight requires --continuous (weighted shares split "
+            "the multi-LoRA engine's per-tenant admission)"
         )
     if adapters and weight_quant:
         raise ValueError(
@@ -1242,9 +1250,11 @@ def serve_model(
                 max_queue=max_queue,
                 # multi-LoRA bank: {name: dir} / "name=dir,..." / None
                 # (None reads PRIME_SERVE_ADAPTERS inside the engine); the
-                # inflight cap drives the per-tenant fair admission pop
+                # inflight cap and the weighted shares drive the per-tenant
+                # fair (weighted round-robin) admission pop
                 adapters=adapters,
                 adapter_max_inflight=adapter_max_inflight,
+                adapter_weights=adapter_weights,
                 # a prefill-role replica's batched waves must store EVERY
                 # member's KV: its GET /admin/kv exports are the migration's
                 # whole point, and a batched admission that only stored

@@ -250,6 +250,68 @@ def test_engine_kv_calls_marshal_onto_running_loop():
         engine.shutdown()
 
 
+def test_serialize_match_off_thread_survives_concurrent_split():
+    """Off-loop export (ROADMAP item 4 follow-up, enabled by PR 12's
+    pin-surviving snapshots): the tree owner pins the match, the expensive
+    serialization runs on ANOTHER thread, and an insert that _splits the
+    pinned path mid-flight must not change a byte — the pin-time snapshots
+    keep the read consistent."""
+    import threading
+
+    ids = list(range(64))
+    full = _leaves(64)
+    cache = _seeded_cache(ids, full)
+    want = cache.export_segments(ids)
+
+    match = cache.match(ids, limit=len(ids))  # tree-owner side: pin
+    # a diverging insert splits the pinned single-run node at token 32
+    other = _leaves(64)
+    cache.insert(
+        ids[:32] + [999 + i for i in range(32)],
+        lambda a, b: {k: v[..., a:b] for k, v in other.items()},
+    )
+    got: list = []
+    worker = threading.Thread(
+        target=lambda: got.append(cache.serialize_match(match))
+    )
+    worker.start()
+    worker.join(timeout=30)
+    cache.release(match)
+    assert got and got[0] == want
+    # the tree stayed coherent: a fresh export carries the SAME tokens and
+    # KV content (its manifest now shows the split's two segments, so the
+    # comparison is content-level through a round-trip import)
+    from prime_tpu.serve.prefix_cache import decode_wire_payload
+
+    tokens, leaves = decode_wire_payload(cache.export_segments(ids), 16)
+    assert tokens == ids
+    for name, want_arr in full.items():
+        assert np.array_equal(leaves[name], want_arr), name
+
+
+def test_engine_export_off_loop_bit_identical():
+    """export_kv from a non-engine thread (the running-loop path) must
+    produce the same bytes the direct synchronous path produces — the loop
+    only services the tiny pin/release jobs, the serialization runs on the
+    calling thread (the decode stall this kills on any-role exporters)."""
+    engine = make_engine()
+    _drain(engine, engine.submit(list(PROMPT), max_new_tokens=1))
+    direct = engine.export_kv(list(PROMPT))  # loop not started: direct path
+    assert direct is not None
+    engine.start()
+    try:
+        off_loop = engine.export_kv(list(PROMPT), timeout=30.0)
+    finally:
+        engine.shutdown()
+    assert off_loop == direct
+    assert engine.stats()["kv_exports"] == 2
+    # the pin was released: nothing on the exported path stays refcounted
+    match = engine.prefix_cache.match(list(PROMPT))
+    for node, _ in match.entries:
+        assert node.refs == 1  # exactly this fresh match's pin
+    engine.prefix_cache.release(match)
+
+
 def test_engine_without_prefix_cache_refuses_kv():
     engine = make_engine(prefix_cache_mb=0)
     assert engine.export_kv(list(PROMPT)) is None

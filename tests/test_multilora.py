@@ -43,7 +43,8 @@ def _default_env(monkeypatch):
     for knob in (
         "PRIME_SERVE_OVERLAP", "PRIME_SERVE_WARMUP", "PRIME_SERVE_MESH",
         "PRIME_SERVE_SPEC", "PRIME_SERVE_DRAFT_LEN", "PRIME_SERVE_ADAPTERS",
-        "PRIME_SERVE_ADAPTER_MAX_INFLIGHT", "PRIME_SERVE_PREFIX_CACHE_MB",
+        "PRIME_SERVE_ADAPTER_MAX_INFLIGHT", "PRIME_SERVE_ADAPTER_WEIGHTS",
+        "PRIME_SERVE_PREFIX_CACHE_MB",
     ):
         monkeypatch.delenv(knob, raising=False)
 
@@ -319,6 +320,65 @@ def test_adapter_max_inflight_caps_one_tenant(artifacts):
     assert engine.queue_depth() == 3
     drain(engine, *a_reqs, *base_reqs)
     assert engine.queue_depth() == 0
+    engine.shutdown()
+
+
+def test_weighted_shares_pop_order_pin(artifacts):
+    """WEIGHTED round-robin (ROADMAP item 3 follow-up): tenant-a at weight
+    2 pops twice per rotation, INTERLEAVED — the smooth-WRR sequence for
+    weights {base: 1, a: 2} with both backlogged is a, base, a, a, base, a
+    (never a-a back to back at a rotation boundary, never base starved)."""
+    engine = make_engine(
+        adapters={"tenant-a": artifacts["tenant-a"][0]},
+        adapter_weights={"tenant-a": 2},
+    )
+    assert engine.adapter_weights == {"tenant-a": 2}
+    for _ in range(4):
+        engine.submit(PROMPT, max_new_tokens=2, adapter="tenant-a")
+        engine.submit([9, 9, 9], max_new_tokens=2)
+    order = [engine._pop_pending().adapter_idx for _ in range(6)]
+    assert order == [1, 0, 1, 1, 0, 1]  # idx 1 = tenant-a, idx 0 = base
+    engine.shutdown()
+
+
+def test_weighted_shares_uniform_is_plain_round_robin(artifacts):
+    """Default (no weights) must reproduce the historical rotation: two
+    backlogged tenants alternate strictly."""
+    engine = make_engine(adapters={"tenant-a": artifacts["tenant-a"][0]})
+    for _ in range(3):
+        engine.submit(PROMPT, max_new_tokens=2, adapter="tenant-a")
+        engine.submit([9, 9, 9], max_new_tokens=2)
+    order = [engine._pop_pending().adapter_idx for _ in range(6)]
+    assert order in ([0, 1, 0, 1, 0, 1], [1, 0, 1, 0, 1, 0])
+    engine.shutdown()
+
+
+def test_weighted_shares_validation(artifacts):
+    from prime_tpu.serve.adapters import parse_adapter_weights
+
+    with pytest.raises(ValueError, match="name=K"):
+        parse_adapter_weights("broken")
+    with pytest.raises(ValueError, match=">= 1"):
+        parse_adapter_weights("a=0")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_adapter_weights("a=1,a=2")
+    # weights without a bank are a loud construction error
+    with pytest.raises(ValueError, match="bank"):
+        make_engine(adapter_weights={"tenant-a": 2})
+    # an unknown tenant name is a loud construction error too
+    with pytest.raises(KeyError):
+        make_engine(
+            adapters={"tenant-a": artifacts["tenant-a"][0]},
+            adapter_weights={"nope": 2},
+        )
+
+
+def test_weighted_shares_env_wiring(monkeypatch, artifacts):
+    monkeypatch.setenv("PRIME_SERVE_ADAPTER_WEIGHTS", "tenant-a=3,base=2")
+    engine = make_engine(adapters={"tenant-a": artifacts["tenant-a"][0]})
+    assert engine.adapter_weights == {"tenant-a": 3, "base": 2}
+    assert engine._fair_weights == {0: 2, 1: 3}
+    assert engine.stats()["adapter_weights"] == {"tenant-a": 3, "base": 2}
     engine.shutdown()
 
 

@@ -439,7 +439,7 @@ def test_engine_histograms_populate_through_streamed_completion():
                 "requests_failed", "tokens_emitted", "prefix_hits",
                 "batched_admission_waves", "active_slots", "queue_depth",
                 "max_slots", "max_queue", "mesh_devices", "mesh_axes",
-                "adapters_loaded", "adapters", "state",
+                "adapters_loaded", "adapters", "adapter_weights", "state",
                 "overlap", "speculative", "draft_len", "spec_accept_ratio",
                 "inflight_depth", "host_stall_s", "chunk_window_s",
                 "overlap_ratio", "wasted_decode_tokens", "warmup_programs",
