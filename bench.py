@@ -785,6 +785,27 @@ def main() -> None:
                 record["serve_wasted_decode_tokens"] = (
                     stats["wasted_decode_tokens"] - stats_before["wasted_decode_tokens"]
                 )
+            if record_counters:
+                # device-time capture AFTER the measured window (a capture
+                # fences every dispatch, which would perturb the headline
+                # tok/s): a short driven burst under an open capture yields
+                # the per-phase step clock + compile/MFU summary the record
+                # embeds as "device_profile" — perf_delta diffs it, and
+                # rounds without it stay comparable
+                try:
+                    engine.profiler.start_capture()
+                    profile_reqs = [
+                        engine.submit(p, max_new_tokens=min(8, new_tokens))
+                        for p in prompts[: min(4, len(prompts))]
+                    ]
+                    while not all(r.done for r in profile_reqs):
+                        engine.tick()
+                    engine.tick()  # retire the overlap lookahead chunk
+                    capture = engine.profiler.stop_capture()
+                    if capture:
+                        record["device_profile"] = capture["summary"]
+                except Exception as e:  # noqa: BLE001 — profiling is evidence, not the benchmark
+                    print(f"# bench: device-profile capture failed: {e}", flush=True)
             if obs_key:
                 # full metrics-registry snapshot (TTFT / queue-wait /
                 # prefill / decode-step histograms over the warmup+measured
@@ -1235,6 +1256,7 @@ def main() -> None:
             record["loadgen"] = build_report(
                 loadgen_results,
                 meta={"backend": record.get("backend", "unknown")},
+                device_profile=record.get("device_profile"),
             )
             # disagg-comparison rows ride along without joining the headline
             # (their fleets are separate stacks; the headline stays the

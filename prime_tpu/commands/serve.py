@@ -88,6 +88,14 @@ from prime_tpu.utils.render import Renderer, output_options
          "(PRIME_SERVE_WARMUP).",
 )
 @click.option(
+    "--profile/--no-profile", "profile", default=None,
+    help="Sampled device-time step clock (--continuous): fence 1-of-N "
+         "dispatches per phase into serve_device_step_seconds{phase=...} "
+         "plus XLA-compile, HBM, and cost-model MFU accounting; "
+         "/admin/profile and `prime serve profile` capture a Perfetto "
+         "trace window. Default: off (PRIME_SERVE_PROFILE).",
+)
+@click.option(
     "--prefix-cache-mb", type=float, default=None,
     help="Byte budget (MiB) of the radix prefix-KV cache: shared prompt "
          "blocks are cached once and reused across admissions; 0 disables "
@@ -171,6 +179,7 @@ def serve_cmd(
     draft_len: int | None,
     overlap: bool | None,
     warmup: bool | None,
+    profile: bool | None,
     prefix_cache_mb: float | None,
     prefix_cache_host_mb: float | None,
     adapter_max_inflight: int | None,
@@ -240,6 +249,7 @@ def serve_cmd(
             draft_len=draft_len,
             overlap=overlap,
             warmup=warmup,
+            profile=profile,
             prefix_cache_mb=prefix_cache_mb,
             prefix_cache_host_mb=prefix_cache_host_mb,
             adapter_max_inflight=adapter_max_inflight,
@@ -628,6 +638,172 @@ def _render_observatory_view(render: "Renderer", view: dict) -> None:
          "tok/s", "samples", "resets"],
         rows,
         title="Replicas",
+    )
+
+
+@serve_cmd.command(name="profile")
+@click.option(
+    "--url", default="http://127.0.0.1:8000", show_default=True,
+    help="Base URL of a running `prime serve --continuous` instance OR a "
+         "`prime serve fleet` router (the capture fans out to every "
+         "routable replica).",
+)
+@click.option(
+    "--seconds", type=click.FloatRange(min=0.1), default=2.0, show_default=True,
+    help="Capture window length: every dispatch in the window is fenced "
+         "and lands in the trace (sampling is bypassed while capturing).",
+)
+@click.option(
+    "--trace-out", default="trace.json", show_default=True,
+    type=click.Path(dir_okay=False, writable=True),
+    help="Where to write the merged Chrome-trace timeline (host spans + "
+         "device step samples + XLA compiles). Load it in Perfetto or "
+         "chrome://tracing. Router captures write one file per replica "
+         "(trace-<replica>.json).",
+)
+@click.option(
+    "--admin-token", default=None, envvar="PRIME_FLEET_ADMIN_TOKEN",
+    help="Bearer token when the target gates /admin/profile.",
+)
+@output_options
+def serve_profile_cmd(
+    render: "Renderer",
+    url: str,
+    seconds: float,
+    trace_out: str,
+    admin_token: str | None,
+) -> None:
+    """Capture a device-time window from a live server: POST /admin/profile
+    start, wait --seconds while traffic flows, stop, then render the
+    per-phase breakdown (step seconds, compiles, cost-model MFU) and write
+    the Perfetto-loadable trace.json. See docs/observability.md
+    "Device time"."""
+    import json as _json
+    import os as _os
+    import time as _time
+
+    import httpx
+
+    base = url.rstrip("/")
+    headers = {"Authorization": f"Bearer {admin_token}"} if admin_token else None
+
+    def _post(action: str) -> dict:
+        try:
+            response = httpx.post(
+                f"{base}/admin/profile",
+                json={"action": action},
+                headers=headers,
+                timeout=30,
+            )
+        except httpx.HTTPError as e:
+            raise click.ClickException(
+                f"could not reach {base}/admin/profile: {e}"
+            ) from None
+        if response.status_code == 403:
+            raise click.ClickException(
+                f"{base}/admin/profile requires an admin token "
+                "(--admin-token / PRIME_FLEET_ADMIN_TOKEN)"
+            )
+        if response.status_code == 404:
+            raise click.ClickException(
+                f"{base} has no device profiler (serve with --continuous)"
+            )
+        if response.status_code >= 400:
+            try:
+                message = response.json().get("error", {}).get("message", "")
+            except ValueError:
+                message = ""
+            raise click.ClickException(
+                f"{base}/admin/profile: {message or f'status {response.status_code}'}"
+            )
+        try:
+            return response.json()
+        except ValueError as e:
+            raise click.ClickException(
+                f"{base}/admin/profile returned non-JSON: {e}"
+            ) from None
+
+    _post("start")
+    click.echo(f"capturing {_fmt(seconds, 2)}s from {base} ...", err=True)
+    _time.sleep(seconds)
+    result = _post("stop")
+    if render.is_json:
+        render.json(result)
+    # single-replica stop returns the capture itself; the router returns
+    # {"replicas": {id: capture}} — normalize to one iterable shape
+    replicas = result.get("replicas")
+    captures = (
+        replicas.items() if isinstance(replicas, dict) else [("", result)]
+    )
+    stem, ext = _os.path.splitext(trace_out)
+    wrote_any = False
+    for rid, capture in captures:
+        if not isinstance(capture, dict) or "summary" not in capture:
+            message = "no capture"
+            if isinstance(capture, dict):
+                message = (capture.get("error") or {}).get("message", message)
+            click.echo(f"warning: {rid or base}: {message}", err=True)
+            continue
+        summary = capture.get("summary") or {}
+        if not render.is_json:
+            _render_profile_summary(render, capture, summary, rid or base)
+        trace = capture.get("trace")
+        if trace is not None:
+            path = f"{stem}-{rid}{ext or '.json'}" if rid else trace_out
+            with open(path, "w", encoding="utf-8") as f:
+                _json.dump(trace, f)
+            wrote_any = True
+            if not render.is_json:
+                click.echo(
+                    f"  trace: {path} (load in Perfetto / chrome://tracing)"
+                )
+    if not wrote_any and not render.is_json:
+        raise click.ClickException(
+            "no replica returned a capture (was any traffic flowing, and "
+            "was a capture already stopped?)"
+        )
+
+
+def _render_profile_summary(
+    render: "Renderer", capture: dict, summary: dict, target: str
+) -> None:
+    """The per-phase breakdown table for one /admin/profile stop payload."""
+    rows = [
+        [
+            phase,
+            entry.get("samples", 0),
+            _fmt(
+                entry.get("mean_s") * 1e3
+                if entry.get("mean_s") is not None
+                else None,
+            ),
+            _fmt(entry.get("total_s"), 4),
+            _fmt(entry.get("achieved_tflops"), 2),
+            _fmt(entry.get("mfu"), 4),
+            _fmt(entry.get("achieved_gbps"), 2),
+        ]
+        for phase, entry in sorted((summary.get("phases") or {}).items())
+    ]
+    render.table(
+        ["phase", "samples", "mean_ms", "total_s", "TFLOP/s", "MFU", "GB/s"],
+        rows,
+        title=f"Device time @ {target}",
+    )
+    compiles = summary.get("compiles") or {}
+    peak = summary.get("peak_tflops")
+    roofline = (
+        f"peak {_fmt(peak, 1)} bf16 TFLOP/s"
+        if peak is not None
+        # MFU needs a peak-FLOPs roofline; the table only knows TPU
+        # generations (docs/observability.md "Device time")
+        else "no roofline for this backend (MFU/TFLOP columns empty)"
+    )
+    click.echo(
+        f"  window {_fmt(capture.get('duration_s'), 2)}s: "
+        f"{capture.get('samples', 0)} device samples, "
+        f"{capture.get('host_spans', 0)} host spans, "
+        f"{compiles.get('total', 0)} compiles "
+        f"({_fmt(compiles.get('seconds'), 3)}s) — {roofline}"
     )
 
 

@@ -118,6 +118,36 @@ def _slo_metrics(report: dict) -> dict[str, float]:
     return out
 
 
+def _device_profile_metrics(profile: dict) -> dict[str, float]:
+    """Flatten a record's ``device_profile`` section (the profiler summary
+    bench.py embeds: per-phase step seconds, cost-model MFU, compile totals)
+    into "dp:"-prefixed rows — disjoint from HEADLINE_METRICS labels like
+    the "slo:" rows. Rounds without the section (every pre-profiler
+    baseline) simply render "—" for these rows, never an error."""
+    out: dict[str, float] = {}
+    for phase, entry in sorted((profile.get("phases") or {}).items()):
+        if not isinstance(entry, dict):
+            continue
+        mean = entry.get("mean_s")
+        if isinstance(mean, (int, float)) and not isinstance(mean, bool):
+            out[f"dp:{phase} step ms"] = round(float(mean) * 1e3, 3)
+        for key, label in (
+            ("mfu", "mfu"),
+            ("achieved_tflops", "tflops"),
+            ("achieved_gbps", "gb/s"),
+        ):
+            value = entry.get(key)
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                out[f"dp:{phase} {label}"] = float(value)
+    compiles = profile.get("compiles")
+    if isinstance(compiles, dict):
+        for key, label in (("total", "compiles"), ("seconds", "compile s")):
+            value = compiles.get(key)
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                out[f"dp:{label}"] = float(value)
+    return out
+
+
 def _is_sharded_smoke_record(record: dict[str, Any]) -> bool:
     """The dedicated sharded loadgen smoke record (run_smoke --mesh) is
     recognizable by its OWN evidence — top-level ``mesh_devices`` plus the
@@ -184,6 +214,8 @@ def _round_from_record(path: str, record: dict[str, Any]) -> Round:
             metrics[row_label] = float(value)
     if schema >= 2 and isinstance(record.get("loadgen"), dict):
         metrics.update(_slo_metrics(record["loadgen"]))
+    if isinstance(record.get("device_profile"), dict):
+        metrics.update(_device_profile_metrics(record["device_profile"]))
     # opportunistic/secondary records sort after the driver record of the
     # same round number
     return Round(
@@ -293,6 +325,15 @@ def _multichip_round(path: str, record: dict[str, Any]) -> Round:
         if schema >= 2 and isinstance(record.get("loadgen"), dict):
             metrics.update(
                 {f"mc-{k}": v for k, v in _slo_metrics(record["loadgen"]).items()}
+            )
+        if isinstance(record.get("device_profile"), dict):
+            metrics.update(
+                {
+                    f"mc-{k}": v
+                    for k, v in _device_profile_metrics(
+                        record["device_profile"]
+                    ).items()
+                }
             )
     return Round(
         label=label, path=path, order=order, schema=schema,

@@ -358,10 +358,14 @@ def spec_comparison_record(
     return record
 
 
-def build_report(results, *, meta: dict | None = None) -> dict[str, Any]:
+def build_report(
+    results, *, meta: dict | None = None, device_profile: dict | None = None
+) -> dict[str, Any]:
     """The versioned SLO report: one row per scenario plus the aggregate
     headline. ``meta`` merges into the top level (backend identity, git
-    rev, CI round)."""
+    rev, CI round). ``device_profile`` (a DeviceProfiler.summary() dict:
+    per-phase step seconds, compile totals, cost-model MFU) rides under its
+    own key — perf_delta tolerates rounds without it."""
     if not isinstance(results, (list, tuple)):
         results = [results]
     rows = [scenario_row(r) for r in results]
@@ -378,6 +382,8 @@ def build_report(results, *, meta: dict | None = None) -> dict[str, Any]:
             "rejected_429": sum(r["rejected_429"] for r in rows),
         },
     }
+    if device_profile:
+        report["device_profile"] = device_profile
     if meta:
         report.update(meta)
     return report

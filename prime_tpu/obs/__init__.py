@@ -18,6 +18,11 @@ this layer). Two halves:
   with windowed rate/quantile queries (the observatory's memory).
 - :mod:`prime_tpu.obs.slo` — declarative SLO policies evaluated with
   multi-window burn rates into typed ``ScaleSignal`` recommendations.
+- :mod:`prime_tpu.obs.profiler` — the device-time observatory: a sampled
+  ``block_until_ready`` step clock under the serving engine, XLA compile
+  and HBM accounting, cost-model MFU attribution, and Chrome-trace export
+  (``jax`` is imported lazily inside its fencing paths, so this package
+  stays importable without it).
 
 See docs/architecture.md "Observability" for the exposition endpoints
 (`GET /metrics?format=prometheus`, `/healthz`) and the trace JSONL schema.
@@ -44,6 +49,7 @@ from prime_tpu.obs.slo import (
     SloPolicy,
     default_policies,
 )
+from prime_tpu.obs.profiler import DeviceProfiler, chrome_trace
 from prime_tpu.obs.timeseries import RegistrySampler, SnapshotRing
 from prime_tpu.obs.trace import (
     TRACEPARENT_HEADER,
@@ -76,6 +82,8 @@ __all__ = [
     "SnapshotRing",
     "default_policies",
     "FlightRecorder",
+    "DeviceProfiler",
+    "chrome_trace",
     "Span",
     "TraceContext",
     "Tracer",

@@ -206,6 +206,8 @@ class Tracer:
         enabled: bool = True,
         sink_path: str | os.PathLike | None = None,
         max_spans: int = 4096,
+        max_mb: float | None = None,
+        keep: int | None = None,
     ) -> None:
         self.enabled = enabled
         self._local = threading.local()
@@ -213,6 +215,20 @@ class Tracer:
         self._finished: deque[Span] = deque(maxlen=max_spans)
         self._sink_path = os.fspath(sink_path) if sink_path is not None else None
         self._sink: TextIO | None = None
+        # size-capped sink rotation: a long-running replica's PRIME_TRACE
+        # JSONL must not grow unbounded. max_mb caps the live file (0 =
+        # unlimited, the historical behavior); on overflow the live file
+        # shifts to .1, .1 to .2, ... keeping `keep` rotated files. None
+        # defers to the PRIME_TRACE_MAX_MB / PRIME_TRACE_KEEP env knobs.
+        from prime_tpu.utils.env import env_float, env_int  # noqa: PLC0415
+
+        if max_mb is None:
+            max_mb = env_float("PRIME_TRACE_MAX_MB", 0.0)
+        if keep is None:
+            keep = env_int("PRIME_TRACE_KEEP", 3)
+        self._max_sink_bytes = max(0, int(max_mb * 1024 * 1024))
+        self._sink_keep = max(1, int(keep))
+        self._sink_bytes = 0
 
     # -- span lifecycle -------------------------------------------------------
 
@@ -325,7 +341,19 @@ class Tracer:
         try:
             if self._sink is None:
                 self._sink = open(self._sink_path, "a", buffering=1)
-            self._sink.write(json.dumps(span.to_dict(), default=str) + "\n")
+                try:
+                    self._sink_bytes = os.path.getsize(self._sink_path)
+                except OSError:
+                    self._sink_bytes = 0
+            line = json.dumps(span.to_dict(), default=str) + "\n"
+            if (
+                self._max_sink_bytes
+                and self._sink_bytes
+                and self._sink_bytes + len(line) > self._max_sink_bytes
+            ):
+                self._rotate_sink()
+            self._sink.write(line)
+            self._sink_bytes += len(line)
         except OSError as e:
             sys.stderr.write(
                 f"prime_tpu.obs.trace: disabling span sink "
@@ -333,6 +361,22 @@ class Tracer:
             )
             self._sink_path = None
             self._sink = None
+
+    def _rotate_sink(self) -> None:
+        """Shift the live sink to ``path.1`` (… up to ``path.keep``) and
+        reopen fresh (caller holds the lock; OSError propagates to
+        ``_write_sink``'s disable-on-error handling)."""
+        assert self._sink_path is not None
+        if self._sink is not None:
+            self._sink.close()
+            self._sink = None
+        for i in range(self._sink_keep - 1, 0, -1):
+            older = f"{self._sink_path}.{i}"
+            if os.path.exists(older):
+                os.replace(older, f"{self._sink_path}.{i + 1}")
+        os.replace(self._sink_path, f"{self._sink_path}.1")
+        self._sink = open(self._sink_path, "a", buffering=1)
+        self._sink_bytes = 0
 
     # -- export ---------------------------------------------------------------
 
@@ -342,6 +386,13 @@ class Tracer:
             spans = [s.to_dict() for s in self._finished]
             self._finished.clear()
         return spans
+
+    def tail(self) -> list[dict[str, Any]]:
+        """The finished-span buffer WITHOUT clearing it (newest last) — the
+        device profiler merges host spans into its capture timeline from
+        here, and must not steal them from the sink or other consumers."""
+        with self._lock:
+            return [s.to_dict() for s in self._finished]
 
     def export_jsonl(self, path: str | os.PathLike) -> int:
         """Append the finished-span buffer to ``path`` as JSONL; returns the

@@ -101,7 +101,13 @@ import numpy as np
 
 from prime_tpu.core.config import env_flag, env_float, env_int, env_str
 from prime_tpu.obs.flight import FlightRecorder
-from prime_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS, DEFAULT_TOKEN_BUCKETS, Registry
+from prime_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    DEFAULT_SIZE_BUCKETS,
+    DEFAULT_TOKEN_BUCKETS,
+    Registry,
+)
+from prime_tpu.obs.profiler import DeviceProfiler
 from prime_tpu.obs.trace import TRACER, TraceContext
 from prime_tpu.serve.errors import DrainingError, QueueFullError
 from prime_tpu.serve.prefix_cache import BlockPrefixCache
@@ -338,6 +344,7 @@ class ContinuousBatchingEngine:
         draft_len: int | None = None,
         overlap: bool | None = None,
         warmup: bool | None = None,
+        profile: bool | None = None,
         max_queue: int | None = None,
         prefix_store_all: bool = False,
         adapters: Any = None,
@@ -509,6 +516,14 @@ class ContinuousBatchingEngine:
         if warmup is None:
             warmup = env_flag("PRIME_SERVE_WARMUP", False)
         self.warmup_enabled = bool(warmup)
+        # device-time observatory (obs/profiler.py): opt-in via
+        # PRIME_SERVE_PROFILE because each step-clock sample fences the
+        # pipeline; off means the dispatch path gains zero syncs (the
+        # profiler object itself always exists so /admin/profile can open a
+        # capture window on a live engine)
+        if profile is None:
+            profile = env_flag("PRIME_SERVE_PROFILE", False)
+        self.profile_enabled = bool(profile)
         # dispatched-but-unfetched decode chunks, oldest first (depth <= 1
         # outside tick(); owned by the engine thread)
         self._inflight: list[_InflightChunk] = []
@@ -794,6 +809,16 @@ class ContinuousBatchingEngine:
         self._m_warmup_s = r.gauge(
             "serve_warmup_seconds", "Wall seconds the AOT warmup pass took"
         )
+        # cold-start attribution: the end-to-end gauge above says warmup was
+        # slow; this histogram says WHICH program family (decode / spec /
+        # hist_seed / chunk_prefill / finalize / assemble) ate the time —
+        # one observation per family block the pass executed
+        self._m_warmup_program_s = r.histogram(
+            "serve_warmup_program_seconds",
+            "Wall seconds of one AOT warmup block, by program family",
+            buckets=DEFAULT_LATENCY_BUCKETS,
+            labelnames=("program",),
+        )
         # speculative decoding: per-window acceptance evidence. The histogram
         # observes the accepted DRAFT count per verify window per slot (the
         # bonus/correction token is excluded — it arrives even at 0 accepts),
@@ -840,6 +865,15 @@ class ContinuousBatchingEngine:
         # timelines readable at GET /debug/requests even with tracing off;
         # PRIME_SERVE_SLOW_MS auto-persists slow timelines to the trace sink
         self.flight = FlightRecorder()
+        # device-time observatory: sampled step clock + compile/HBM/MFU
+        # accounting into this registry (docs/observability.md "Device
+        # time"). Constructed even when disabled so the metric families and
+        # the /admin/profile capture surface exist on every engine.
+        self.profiler = DeviceProfiler(
+            r,
+            enabled=self.profile_enabled,
+            mesh_devices=self.mesh_devices,
+        )
         self._t0 = time.monotonic()
         # stats() snapshot, ticked by the engine loop (ADVICE engine.py:1008):
         # HTTP handler threads read the last end-of-tick snapshot under this
@@ -1276,17 +1310,22 @@ class ContinuousBatchingEngine:
         self._rng, rng = jax.random.split(self._rng)
         mask = self._active.copy()
         seq = next(self._chunk_seq)
+        args = (
+            self.params, self._adapters, self._cache, self._hist,
+            self._hist_len, self._last, self._temps, self._top_ps,
+            jnp.asarray(mask), self._adapter_slots, rng,
+        )
         with TRACER.span(
             "serve.spec_dispatch", seq=seq, draft_len=self.draft_len,
             **self._span_mesh,
-        ), self._mesh_ctx():
+        ), self._mesh_ctx(), self.profiler.step(
+            "spec", pre=self._last, batch=int(mask.sum()),
+            steps=self.draft_len + 1, cost_fn=self._spec_fn, cost_args=args,
+        ) as prof_step:
             (
                 self._cache, self._hist, self._hist_len, self._last, toks, run_len,
-            ) = self._spec_fn(
-                self.params, self._adapters, self._cache, self._hist,
-                self._hist_len, self._last, self._temps, self._top_ps,
-                jnp.asarray(mask), self._adapter_slots, rng,
-            )
+            ) = self._spec_fn(*args)
+            prof_step.fence(toks)
         self._inflight.append(
             _InflightChunk(
                 seq=seq, toks=toks, mask=mask,
@@ -1376,11 +1415,23 @@ class ContinuousBatchingEngine:
             self._assemble_fn = self._make_assemble_row()
         dispatches = 0
         t0 = time.monotonic()
+        # per-family cold-start attribution: each block below compiles one
+        # program family; the wall time between block boundaries lands in
+        # serve_warmup_program_seconds{program=...} so a slow warmup names
+        # its culprit instead of reporting one opaque end-to-end gauge
+        family_t = t0
+
+        def _observe_family(program: str) -> None:
+            nonlocal family_t
+            now = time.monotonic()
+            self._m_warmup_program_s.observe(now - family_t, program=program)
+            family_t = now
+
         # throwaway rng stream: warmup outputs are discarded, and the
         # engine's own stream must stay untouched so a warmed engine's
         # sampled requests are bit-identical to a cold one's
         warm_rng = jax.random.PRNGKey(0)
-        with TRACER.span("serve.warmup"), self._mesh_ctx():
+        with TRACER.span("serve.warmup"), self._mesh_ctx(), self.profiler.mark("warmup"):
             inactive = jnp.zeros((self.max_slots,), dtype=bool)
             warm_rng, rng = jax.random.split(warm_rng)
             self._cache, self._last, toks = self._decode_fn(
@@ -1389,6 +1440,7 @@ class ContinuousBatchingEngine:
             )
             jax.block_until_ready(toks)
             dispatches += 1
+            _observe_family("decode")
             if self.speculative:
                 warm_rng, rng = jax.random.split(warm_rng)
                 (
@@ -1400,6 +1452,7 @@ class ContinuousBatchingEngine:
                 )
                 jax.block_until_ready(toks)
                 dispatches += 1
+                _observe_family("spec")
             batch_sizes = [1]
             while batch_sizes[-1] * 2 <= self.max_slots:
                 batch_sizes.append(batch_sizes[-1] * 2)
@@ -1416,6 +1469,7 @@ class ContinuousBatchingEngine:
                     )
                     jax.block_until_ready(self._hist_len)
                     dispatches += 1
+                _observe_family("hist_seed")
             for row_cb in self._warmup_row_capacities():
                 cold_sizes = {s for _, s in chunk_plan(0, row_cb, self.prefill_chunk, row_cb)}
                 # prefix-hit suffixes admit singly with mid-prompt plans:
@@ -1444,6 +1498,11 @@ class ContinuousBatchingEngine:
                             jnp.zeros((n,), dtype=jnp.int32),
                         )
                         dispatches += 1
+                    if logits is not None:
+                        # fence before finalize so the chunk-prefill compiles
+                        # are billed to their own family, not finalize's
+                        jax.block_until_ready(logits)
+                    _observe_family("chunk_prefill")
                     warm_rng, rng = jax.random.split(warm_rng)
                     (
                         self._cache, self._last, self._temps, self._top_ps,
@@ -1460,6 +1519,7 @@ class ContinuousBatchingEngine:
                     )
                     jax.block_until_ready(firsts)
                     dispatches += 1
+                    _observe_family("finalize")
                 if self.prefix_cache is not None:
                     # assemble_row coverage: the common single-segment hit
                     # (one donor path, no branch point) at every power-of-two
@@ -1483,6 +1543,7 @@ class ContinuousBatchingEngine:
                         jax.block_until_ready(assembled.k)
                         dispatches += 1
                         seg_len *= 2
+                    _observe_family("assemble")
         if self.speculative:
             # the hist-seed warmups scribbled slot rings (lengths 1, pad
             # rows); restore exact cold history state so a warmed engine is
@@ -1642,6 +1703,7 @@ class ContinuousBatchingEngine:
         self._thread.start()
 
     def shutdown(self) -> None:
+        self.profiler.close()
         self._running = False
         self._pending.put(None)  # sentinel: _pop_pending skips it
         self._wake.set()  # wake the engine thread
@@ -1894,14 +1956,23 @@ class ContinuousBatchingEngine:
         self._rng, rng = jax.random.split(self._rng)
         mask = self._active.copy()
         seq = next(self._chunk_seq)
+        args = (
+            self.params, self._adapters, self._cache, self._last,
+            self._temps, self._top_ps, jnp.asarray(mask),
+            self._adapter_slots, rng,
+        )
+        # step clock: a sampled dispatch drains the in-flight predecessor
+        # (pre=self._last syncs the pipeline), times this program to
+        # readiness, and captures its XLA cost analysis once. Inactive
+        # profiler -> shared no-op: zero added syncs on the overlap path.
         with TRACER.span(
             "serve.dispatch", seq=seq, steps=self.chunk, **self._span_mesh
-        ), self._mesh_ctx():
-            self._cache, self._last, toks = self._decode_fn(
-                self.params, self._adapters, self._cache, self._last,
-                self._temps, self._top_ps, jnp.asarray(mask),
-                self._adapter_slots, rng,
-            )
+        ), self._mesh_ctx(), self.profiler.step(
+            "decode", pre=self._last, batch=int(mask.sum()),
+            steps=self.chunk, cost_fn=self._decode_fn, cost_args=args,
+        ) as prof_step:
+            self._cache, self._last, toks = self._decode_fn(*args)
+            prof_step.fence(toks)
         self._inflight.append(
             _InflightChunk(
                 seq=seq, toks=toks, mask=mask,
@@ -2087,7 +2158,9 @@ class ContinuousBatchingEngine:
         with TRACER.span(
             "serve.prefill", context=req.trace, slot=slot,
             prompt_len=len(ids), request=req.id, **self._span_mesh,
-        ), self._mesh_ctx():
+        ), self._mesh_ctx(), self.profiler.step(
+            "prefill", pre=self._last, batch=1, steps=len(ids),
+        ) as prof_step:
             for off, size in plan:
                 chunk_ids = ids[off : off + size]
                 chunk_ids += [self.pad_id] * (size - len(chunk_ids))
@@ -2097,12 +2170,14 @@ class ContinuousBatchingEngine:
                 # (finalize consumes that one), clamping keeps earlier
                 # chunks' gathers in bounds
                 rel = min(max(len(ids) - 1 - off, 0), size - 1)
-                row, logits = self._chunk_fn(
+                chunk_args = (
                     self.params, self._adapters, row, tokens,
                     jnp.asarray(off, dtype=jnp.int32),
                     jnp.asarray([rel], dtype=jnp.int32),
                     jnp.asarray([req.adapter_idx], dtype=jnp.int32),
                 )
+                self.profiler.note_cost("prefill", self._chunk_fn, chunk_args)
+                row, logits = self._chunk_fn(*chunk_args)
             # the batch finalize IS the single finalize at n=1 — one owner
             # of the splice/sample/bookkeeping semantics
             (
@@ -2118,6 +2193,7 @@ class ContinuousBatchingEngine:
                 jnp.asarray([req.adapter_idx], dtype=jnp.int32),
                 rng,
             )
+            prof_step.fence(firsts)
         if self.speculative:
             # seed the device history ring before the host sync below — the
             # seed dispatch rides the same device queue as finalize, so the
@@ -2182,7 +2258,10 @@ class ContinuousBatchingEngine:
         logits = None
         with TRACER.span(
             "serve.prefill_batch", batch=n, row_capacity=row_cb, **self._span_mesh
-        ), self._mesh_ctx():
+        ), self._mesh_ctx(), self.profiler.step(
+            "prefill", pre=self._last, batch=n,
+            steps=max(len(r.prompt_ids) for r in reqs),
+        ) as prof_step:
             for off, size in plan:
                 chunk_rows = []
                 rels = []
@@ -2193,12 +2272,14 @@ class ContinuousBatchingEngine:
                     chunk_rows.append(chunk_ids)
                     rels.append(min(max(len(ids) - 1 - off, 0), size - 1))
                 tokens = jnp.asarray(chunk_rows, dtype=jnp.int32)
-                row, logits = self._chunk_fn(
+                chunk_args = (
                     self.params, self._adapters, row, tokens,
                     jnp.asarray(off, dtype=jnp.int32),
                     jnp.asarray(rels, dtype=jnp.int32),
                     jnp.asarray([r.adapter_idx for r in reqs], dtype=jnp.int32),
                 )
+                self.profiler.note_cost("prefill", self._chunk_fn, chunk_args)
+                row, logits = self._chunk_fn(*chunk_args)
             (
                 self._cache, self._last, self._temps, self._top_ps,
                 self._adapter_slots, firsts,
@@ -2212,6 +2293,7 @@ class ContinuousBatchingEngine:
                 jnp.asarray([r.adapter_idx for r in reqs], dtype=jnp.int32),
                 rng,
             )
+            prof_step.fence(firsts)
         if self.speculative:
             with self._mesh_ctx():
                 self._seed_hist(
@@ -2410,12 +2492,15 @@ class ContinuousBatchingEngine:
                 segments=len(match.entries), row_capacity=row_cb,
                 tier="host" if host_tokens else "device",
                 host_tokens=host_tokens,
-            ):
+            ), self.profiler.step(
+                "assemble", pre=self._last, batch=1, steps=match.length
+            ) as prof_step:
                 if host_tokens:
                     # re-upload the spilled segments in place (still pinned —
                     # the rebalance this may trigger skips the match path)
                     self.prefix_cache.promote(match)
                 row = self._assemble_fn(match.segments(), match.takes(), row_cb)
+                prof_step.fence(row.k)
         finally:
             self.prefix_cache.release(match)
         self._m_prefix_hits.inc()
@@ -2652,13 +2737,18 @@ class ContinuousBatchingEngine:
         self._rng, rng = jax.random.split(self._rng)
         active = jnp.asarray(self._active)
         t_start = time.monotonic()
+        args = (
+            self.params, self._adapters, self._cache, self._last,
+            self._temps, self._top_ps, active, self._adapter_slots, rng,
+        )
         with TRACER.span(
             "serve.decode_chunk", steps=self.chunk, **self._span_mesh
-        ), self._mesh_ctx():
-            self._cache, self._last, toks = self._decode_fn(
-                self.params, self._adapters, self._cache, self._last,
-                self._temps, self._top_ps, active, self._adapter_slots, rng,
-            )
+        ), self._mesh_ctx(), self.profiler.step(
+            "decode", batch=int(np.sum(self._active)), steps=self.chunk,
+            cost_fn=self._decode_fn, cost_args=args,
+        ) as prof_step:
+            self._cache, self._last, toks = self._decode_fn(*args)
+            prof_step.fence(toks)
             toks_host = np.asarray(toks)  # (S, T) — host sync inside the span
         self._m_decode_step_s.observe((time.monotonic() - t_start) / self.chunk)
         for slot in range(self.max_slots):
@@ -2745,6 +2835,10 @@ class ContinuousBatchingEngine:
         tick() by the engine loop (and directly by synchronous owners)."""
         self._m_active_slots.set(int(self._active.sum()))
         self._m_queue_depth.set(self.queue_depth())
+        # HBM/live-buffer gauges: rate-limited inside, no-op when the
+        # profiler is inactive, so steady state with profiling off stays
+        # untouched.
+        self.profiler.poll_memory()
         if self.prefix_cache is not None:
             self._sync_prefix_metrics()
             now = time.monotonic()
@@ -2901,6 +2995,12 @@ class EngineBackend:
         """The engine's flight recorder — InferenceServer serves it at
         GET /debug/requests[/{id}]."""
         return self.engine.flight
+
+    @property
+    def profiler(self):
+        """The engine's device-time profiler — InferenceServer drives it
+        from the /admin/profile start/stop capture endpoint."""
+        return self.engine.profiler
 
     def submit_text(
         self,

@@ -409,6 +409,13 @@ class FleetRouter:
                         self._json(403, {"error": {"message": "admin token required"}})
                         return
                     self._json(200, outer.autoscaler_status())
+                elif path == "/admin/profile":
+                    # device-profiler status fan-out (router admin parity
+                    # with the replica servers' /admin/profile)
+                    if not outer._admin_authorized(self.headers):
+                        self._json(403, {"error": {"message": "admin token required"}})
+                        return
+                    self._json(200, outer.profile_fanout())
                 elif path.rstrip("/") == "/debug/requests" or path.startswith(
                     "/debug/requests/"
                 ):
@@ -498,6 +505,18 @@ class FleetRouter:
                             400,
                             {"error": {"message": "action must be 'pause' or 'resume'"}},
                         )
+                    return
+                if path == "/admin/profile":
+                    # start/stop a capture window on every routable replica
+                    # (the admin-token gate above already covered /admin/*)
+                    action = outer._json_field(raw, "action")
+                    if action not in ("start", "stop"):
+                        self._json(
+                            400,
+                            {"error": {"message": "action must be 'start' or 'stop'"}},
+                        )
+                    else:
+                        self._json(200, outer.profile_fanout(action))
                     return
                 if path not in CHAT_PATHS:
                     self._json(404, {"error": {"message": f"no route {self.path}"}})
@@ -1332,6 +1351,40 @@ class FleetRouter:
         if self.autoscaler is None:
             return {"enabled": False, "state": "off"}
         return self.autoscaler.status()
+
+    def profile_fanout(self, action: str | None = None) -> dict:
+        """/admin/profile proxy: fan the status query (``action=None``) or a
+        start/stop capture action out to every routable replica and return
+        the per-replica payloads keyed by replica id. One unreachable
+        replica degrades to an error entry, never a router-level 5xx —
+        stopping a fleet-wide capture must return whatever was captured."""
+        admin_headers = (
+            {"Authorization": f"Bearer {self.admin_token}"}
+            if self.admin_token
+            else {}
+        )
+        replicas: dict[str, dict] = {}
+        for replica in self.membership.routable_replicas():
+            try:
+                if action is None:
+                    resp = self._http().get(
+                        f"{replica.url}/admin/profile", headers=admin_headers
+                    )
+                else:
+                    resp = self._http().post(
+                        f"{replica.url}/admin/profile",
+                        json={"action": action},
+                        headers=admin_headers,
+                    )
+                try:
+                    replicas[replica.id] = resp.json()
+                except ValueError:
+                    replicas[replica.id] = {
+                        "error": {"message": f"status {resp.status_code}"}
+                    }
+            except Exception as e:  # noqa: BLE001 — one dead replica must not kill the fan-out
+                replicas[replica.id] = {"error": {"message": str(e)}}
+        return {"replicas": replicas}
 
     def _router_window(self, window_s: float) -> dict:
         """Router-side slice of one observatory window (429s, queue wait) —

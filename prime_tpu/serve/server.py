@@ -325,6 +325,20 @@ class InferenceServer:
                         self._json(403, {"error": {"message": "admin token required"}})
                         return
                     self._json(200, outer.observatory_view())
+                elif path == "/admin/profile":
+                    # device-time profiler status (enabled/capturing/summary);
+                    # admin parity like the rest of /admin
+                    if not outer._admin_authorized(self.headers):
+                        self._json(403, {"error": {"message": "admin token required"}})
+                        return
+                    prof = outer.profiler()
+                    if prof is None:
+                        self._json(
+                            404,
+                            {"error": {"message": "no device profiler (continuous engine required)"}},
+                        )
+                        return
+                    self._json(200, prof.status())
                 elif path == "/admin/kv":
                     # prefix-KV wire export (disaggregated serving): admin-
                     # token parity with /admin/drain — a payload is raw KV
@@ -421,6 +435,47 @@ class InferenceServer:
                         return
                     outer.drain()
                     self._json(200, outer.healthz())
+                    return
+                if urlsplit(self.path).path == "/admin/profile":
+                    # start/stop a device-time capture window (same admin
+                    # parity as /admin/drain; the fleet router proxies this
+                    # path to every routable replica)
+                    if not outer._admin_authorized(self.headers):
+                        self._json(403, {"error": {"message": "admin token required"}})
+                        return
+                    prof = outer.profiler()
+                    if prof is None:
+                        self._json(
+                            404,
+                            {"error": {"message": "no device profiler (continuous engine required)"}},
+                        )
+                        return
+                    try:
+                        length = max(0, int(self.headers.get("Content-Length", 0)))
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                    except (ValueError, json.JSONDecodeError):
+                        self._json(400, {"error": {"message": "invalid JSON body"}})
+                        return
+                    action = body.get("action") if isinstance(body, dict) else None
+                    if action == "start":
+                        started = prof.start_capture()
+                        self._json(
+                            200, {"capturing": True, "started": bool(started)}
+                        )
+                    elif action == "stop":
+                        result = prof.stop_capture()
+                        if result is None:
+                            self._json(
+                                409,
+                                {"error": {"message": "no capture in progress"}},
+                            )
+                        else:
+                            self._json(200, result)
+                    else:
+                        self._json(
+                            400,
+                            {"error": {"message": "action must be 'start' or 'stop'"}},
+                        )
                     return
                 if self.path not in ("/v1/chat/completions", "/api/v1/chat/completions"):
                     self._json(404, {"error": {"message": f"no route {self.path}"}})
@@ -880,6 +935,11 @@ class InferenceServer:
         flight = getattr(self.generator, "flight", None)
         return flight if isinstance(flight, FlightRecorder) else self._own_flight
 
+    def profiler(self):
+        """The device-time profiler behind /admin/profile — present only when
+        the backend wraps a continuous engine (EngineBackend.profiler)."""
+        return getattr(self.generator, "profiler", None)
+
     # -- request handling -----------------------------------------------------
 
     @staticmethod
@@ -1101,6 +1161,7 @@ def serve_model(
     draft_len: int | None = None,
     overlap: bool | None = None,
     warmup: bool | None = None,
+    profile: bool | None = None,
     prefix_cache_mb: float | None = None,
     prefix_cache_host_mb: float | None = None,
     adapter_max_inflight: int | None = None,
@@ -1117,7 +1178,9 @@ def serve_model(
     whole-turn generation at a time behind a lock. ``overlap``/``warmup``
     (None = the PRIME_SERVE_OVERLAP / PRIME_SERVE_WARMUP env defaults)
     control the engine's one-chunk-deep decode pipeline and its AOT warmup
-    pass — docs/architecture.md "Engine pipeline". ``prefix_cache_mb``
+    pass — docs/architecture.md "Engine pipeline". ``profile`` (None = the
+    PRIME_SERVE_PROFILE env default, off) arms the sampled device-time step
+    clock — docs/observability.md "Device time". ``prefix_cache_mb``
     (None = the PRIME_SERVE_PREFIX_CACHE_MB env default, 0 = off) is the
     byte budget of the radix prefix-KV cache, and ``prefix_cache_host_mb``
     (None = PRIME_SERVE_PREFIX_CACHE_HOST_MB, 0 = off) the host-RAM spill
@@ -1245,6 +1308,7 @@ def serve_model(
                 draft_len=draft_len,
                 overlap=overlap,
                 warmup=warmup,
+                profile=profile,
                 prefix_cache_mb=prefix_cache_mb,
                 prefix_cache_host_mb=prefix_cache_host_mb,
                 max_queue=max_queue,
