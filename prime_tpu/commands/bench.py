@@ -72,3 +72,54 @@ def bench_smoke(
     )
     if not outcome["ok"]:
         raise SystemExit(1)
+
+
+@bench_group.command("autotune")
+@click.option("--kernel", "kernels", multiple=True,
+              help="Restrict the sweep to named kernels (repeatable; "
+                   "default: all of ops/autotune.CANDIDATES).")
+@click.option("--output", default=None, metavar="DIR",
+              help="Artifact directory (default: the kernel_configs "
+                   "resolution dir — PRIME_TPU_KERNEL_CONFIG_DIR or the "
+                   "in-package registry).")
+@click.option("--repeats", type=int, default=3,
+              help="Timed runs per candidate (best-of).")
+@click.option("--dry-run", is_flag=True,
+              help="Tiny shapes, interpret mode, trimmed grids: proves the "
+                   "sweep -> artifact -> resolution round-trip on CPU. "
+                   "Timings are meaningless; point --output somewhere "
+                   "disposable.")
+def bench_autotune(
+    kernels: tuple[str, ...], output: str | None, repeats: int, dry_run: bool
+) -> None:
+    """Time candidate pallas block configs and persist this device kind's
+    winners (docs/kernels.md "Kernel campaign & autotune")."""
+    from prime_tpu.ops import kernel_configs
+    from prime_tpu.ops.autotune import run_autotune
+
+    kind = kernel_configs.device_kind()
+    click.echo(f"autotune: device_kind={kind} dry_run={dry_run}")
+    winners = run_autotune(
+        kernels=list(kernels) or None, dry_run=dry_run, repeats=repeats,
+        log=click.echo,
+    )
+    if not winners:
+        click.echo("no kernel produced a viable candidate; nothing saved")
+        raise SystemExit(1)
+    path = kernel_configs.save_artifact(winners, directory=output, kind=kind)
+    click.echo(f"saved {len(winners)} kernel config(s) -> {path}")
+    # prove the artifact round-trips through the resolution path the
+    # kernels actually use (fails loudly here instead of silently
+    # degrading to defaults at first dispatch)
+    if output:
+        import os
+
+        os.environ["PRIME_TPU_KERNEL_CONFIG_DIR"] = output
+        kernel_configs.invalidate_cache()
+    loaded = kernel_configs.load_tuned(kind)
+    if loaded is None:
+        raise SystemExit("artifact failed to load back through kernel_configs")
+    for name, params in loaded.items():
+        resolved = {p: kernel_configs.resolve(name, p) for p in params}
+        click.echo(f"  {name}: resolves {resolved}")
+    click.echo(f"config source now: {kernel_configs.source()}")

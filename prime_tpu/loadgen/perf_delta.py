@@ -38,6 +38,16 @@ HEADLINE_METRICS: tuple[tuple[str, str], ...] = (
     ("serve spec accept ratio", "serve_spec_accept_ratio"),
     ("prefixburst tok/s", "serve_prefixburst_tok_s"),
     ("prefixburst hit ratio", "serve_prefixburst_hit_ratio"),
+    # paged-gather hit seeding (own keys: paged and copy numbers come from
+    # one dedicated longprefix comparison and only delta against
+    # themselves; seed-ms rows are the seeding-path wall time per hit)
+    ("longprefix tok/s", "serve_longprefix_tok_s"),
+    ("longprefix copy tok/s", "serve_longprefix_copy_tok_s"),
+    ("longprefix seed ms", "serve_longprefix_seed_ms"),
+    ("longprefix copy seed ms", "serve_longprefix_copy_seed_ms"),
+    # kernel autotune round-trip (kernels with a winner + sweep wall time)
+    ("autotune kernels", "autotune_kernels"),
+    ("autotune sweep s", "autotune_sweep_s"),
     ("fleet tok/s", "serve_fleet_tok_s"),
     ("fleet affinity ratio", "serve_fleet_affinity_ratio"),
     # batched multi-LoRA serving (own keys: mixed-adapter and base-only

@@ -365,6 +365,29 @@ def disagg(seed: int | None = None, **overrides) -> Scenario:
     )
 
 
+def longprefix(seed: int | None = None, **overrides) -> Scenario:
+    """Long-shared-prefix traffic — the paged-gather seeding shape
+    (docs/kernels.md "paged_gather"): each tenant's requests open with a
+    LONG common preamble (several radix blocks) and diverge only in a short
+    tail, so after the first admission per tenant every request is
+    dominated by hit seeding, not cold prefill. The arrival spread splits
+    the schedule into an effective seed wave (first request per tenant
+    stores the preamble) and a hit wave (everything after reuses it) —
+    run it paged vs copy (the loadgen smoke's longprefix section) to
+    publish the seeding-path comparison."""
+    seed = loadgen_seed_default() if seed is None else seed
+    phase = dict(
+        kind="chat_burst", n=_scale(8, 24), tenants=2,
+        shared_prefix=_scale(48, 192), prompt_tokens=_scale(56, 224),
+        max_new_tokens=_scale(4, 16), spread_s=0.6,
+    )
+    phase.update(overrides)
+    return Scenario(
+        "longprefix", seed, (Phase(**phase),),
+        description="long shared prefixes where hit seeding dominates",
+    )
+
+
 def smoke(seed: int | None = None) -> Scenario:
     """The CI scenario: one tiny composite touching every phase kind in
     seconds on CPU — shared-prefix burst, one long outlier, a couple of
@@ -398,5 +421,6 @@ SCENARIOS = {
     "mixed_tenants": mixed_tenants,
     "spec_friendly": spec_friendly,
     "disagg": disagg,
+    "longprefix": longprefix,
     "smoke": smoke,
 }

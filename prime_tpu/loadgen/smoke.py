@@ -82,6 +82,127 @@ def _spec_section(
     return record, rows
 
 
+def _longprefix_section(
+    config, params_fn, *, seed: int, mesh: str | None, log
+) -> tuple[dict[str, Any], list]:
+    """The paged-vs-copy seeding comparison (docs/kernels.md
+    "paged_gather"): the ``longprefix`` scenario — long shared preambles
+    where hit seeding dominates — through one engine seeding hits from the
+    page pool, then the SAME schedule through one seeding via the
+    contiguous copy path. Record keys: tok/s both ways plus the mean
+    hit-seed wall time per path straight from the
+    ``serve_prefix_seed_seconds{path=...}`` histogram — the paging win's
+    direct evidence (on CPU the gather runs the XLA fallback; the numbers
+    prove the path and its accounting, the TPU round proves the speed)."""
+    from prime_tpu.loadgen.backends import EngineTarget
+    from prime_tpu.loadgen.report import scenario_row
+    from prime_tpu.loadgen.runner import run_schedule
+    from prime_tpu.loadgen.scenario import SCENARIOS, build_schedule
+    from prime_tpu.serve.engine import ContinuousBatchingEngine
+
+    schedule = build_schedule(
+        SCENARIOS["longprefix"](seed), vocab=config.vocab_size
+    )
+    rows = []
+    record: dict[str, Any] = {}
+    for paged in (False, True):
+        name = "longprefix" if paged else "longprefix_copy"
+        engine = ContinuousBatchingEngine(
+            params_fn(), config, pad_id=0, max_slots=4, capacity=256, chunk=4,
+            prefix_cache_mb=8, paged_prefix=paged, mesh_config=mesh or None,
+        )
+        try:
+            # warm the shapes in play (incl. the second-admission hit-seed),
+            # then measure through the registry-windowed runner
+            for _ in range(2):
+                warm = engine.submit(
+                    list(schedule[0].prompt_ids),
+                    max_new_tokens=schedule[0].max_new_tokens,
+                )
+                while not warm.done:
+                    engine.tick()
+            engine.tick()
+            result = run_schedule(
+                schedule, EngineTarget(engine), scenario=name, seed=seed,
+                time_scale=0.0,
+            )
+            rows.append(scenario_row(result))
+            key = "serve_longprefix" if paged else "serve_longprefix_copy"
+            record[f"{key}_tok_s"] = rows[-1]["tok_s"]
+            path = "paged" if paged else "copy"
+            hist = engine.registry.get(
+                "serve_prefix_seed_seconds"
+            ).series_snapshot(path=path)
+            if hist and hist.get("count"):
+                record[f"{key}_seed_ms"] = round(
+                    hist["sum"] / hist["count"] * 1e3, 3
+                )
+            if paged:
+                record["serve_longprefix_paged_seeds"] = engine.stats()[
+                    "prefix_paged_seeds"
+                ]
+        finally:
+            engine.shutdown()
+    log(
+        f"# loadgen-smoke: longprefix paged {record.get('serve_longprefix_tok_s')} "
+        f"vs copy {record.get('serve_longprefix_copy_tok_s')} tok/s "
+        f"(seed-ms {record.get('serve_longprefix_seed_ms')} vs "
+        f"{record.get('serve_longprefix_copy_seed_ms')}, "
+        f"{record.get('serve_longprefix_paged_seeds')} paged seeds)"
+    )
+    return record, rows
+
+
+def _autotune_section(*, log) -> dict[str, Any]:
+    """The autotune round-trip leg (docs/kernels.md "Kernel campaign &
+    autotune"): a dry-run sweep over every kernel's trimmed candidate grid,
+    winners saved to a throwaway artifact dir and loaded back through the
+    production resolution path. Record keys ``autotune_kernels`` (kernels
+    that produced a winner) and ``autotune_sweep_s`` (sweep wall time) —
+    trajectory evidence that the sweep → artifact → resolve loop stays
+    alive on every push."""
+    import tempfile
+    import time
+
+    from prime_tpu.ops import kernel_configs
+    from prime_tpu.ops.autotune import run_autotune
+
+    t0 = time.perf_counter()
+    winners = run_autotune(dry_run=True, log=None)
+    sweep_s = time.perf_counter() - t0
+    with tempfile.TemporaryDirectory(prefix="prime-autotune-") as tmp:
+        kind = kernel_configs.device_kind()
+        kernel_configs.save_artifact(winners, directory=tmp, kind=kind)
+        # save/restore of the raw env var, not a config read: the section
+        # must leave the process knob exactly as it found it
+        saved = os.environ.get("PRIME_TPU_KERNEL_CONFIG_DIR")  # prime-lint: ignore[knob-direct-read] env save/restore, not a config read
+        os.environ["PRIME_TPU_KERNEL_CONFIG_DIR"] = tmp
+        kernel_configs.invalidate_cache()
+        try:
+            loaded = kernel_configs.load_tuned(kind)
+            source = kernel_configs.source()
+        finally:
+            if saved is None:
+                os.environ.pop("PRIME_TPU_KERNEL_CONFIG_DIR", None)
+            else:
+                os.environ["PRIME_TPU_KERNEL_CONFIG_DIR"] = saved
+            kernel_configs.invalidate_cache()
+    record: dict[str, Any] = {
+        "autotune_kernels": len(winners),
+        "autotune_sweep_s": round(sweep_s, 3),
+    }
+    if loaded is None or source != "tuned":
+        record["autotune_error"] = (
+            f"artifact failed to round-trip: loaded={loaded is not None} "
+            f"source={source}"
+        )
+    log(
+        f"# loadgen-smoke: autotune dry-run swept {record['autotune_kernels']} "
+        f"kernels in {record['autotune_sweep_s']}s (source after load: {source})"
+    )
+    return record
+
+
 def _multilora_section(
     config, params_fn, *, seed: int, mesh: str | None, log
 ) -> tuple[dict[str, Any], list]:
@@ -869,6 +990,40 @@ def run_smoke(
             spec_record = {"serve_spec_error": f"{type(e).__name__}: {e}"[:200]}
             log(f"# loadgen-smoke: spec section failed: {e}")
 
+        # paged-vs-copy seeding section (longprefix scenario, in-process
+        # tiny-test engines): record keys serve_longprefix_* — tok/s and
+        # mean hit-seed ms per seeding path. Skipped under --mesh: paged
+        # seeding is gated off on sharded engines (the comparison would be
+        # copy vs copy).
+        longprefix_record: dict[str, Any] = {}
+        if not mesh:
+            try:
+                longprefix_record, longprefix_rows = _longprefix_section(
+                    config,
+                    lambda: init_params(
+                        jax.random.PRNGKey(0), config, dtype=jnp.float32
+                    ),
+                    seed=seed, mesh=None, log=log,
+                )
+                report["scenarios"].extend(longprefix_rows)
+            except Exception as e:  # noqa: BLE001 — the headline gate must survive
+                longprefix_record = {
+                    "serve_longprefix_error": f"{type(e).__name__}: {e}"[:200]
+                }
+                log(f"# loadgen-smoke: longprefix section failed: {e}")
+
+        # autotune round-trip leg: dry-run sweep + artifact save/load
+        # through the production resolution path (record keys autotune_*)
+        autotune_record: dict[str, Any] = {}
+        if not mesh:
+            try:
+                autotune_record = _autotune_section(log=log)
+            except Exception as e:  # noqa: BLE001 — the headline gate must survive
+                autotune_record = {
+                    "autotune_error": f"{type(e).__name__}: {e}"[:200]
+                }
+                log(f"# loadgen-smoke: autotune section failed: {e}")
+
         # batched multi-LoRA section (mixed 3-adapter traffic through one
         # engine vs the same schedule base-only): record keys
         # serve_multilora_tok_s / _base_tok_s / _ratio / _fairness, rows
@@ -982,6 +1137,8 @@ def run_smoke(
             "backend": jax.default_backend(),
             **({"mesh": mesh_axes, "mesh_devices": mesh_devices} if sharded else {}),
             **spec_record,
+            **longprefix_record,
+            **autotune_record,
             **multilora_record,
             **elastic_record,
             **disagg_record,

@@ -117,6 +117,30 @@ def _norm(x: jnp.ndarray, weight: jnp.ndarray, config: ModelConfig) -> jnp.ndarr
     return rms_norm(x, weight, config.rms_eps, plus_one=config.norm_plus_one)
 
 
+def _lora_kernel_eligible(w: Any, x: jnp.ndarray, b: jnp.ndarray) -> bool:
+    """Gate for the fused gathered-LoRA pallas kernel (ops/pallas_lora.py):
+    plain (unquantized) 2-D base weight, single device (a bare pallas_call
+    cannot partition under SPMD jit — same rule as the quantized-matmul
+    kernels), and a TPU backend — or interpret mode, which is how the CPU
+    test matrix pins the kernel bit-identical to the einsum chain. Real
+    TPUs additionally need lane-aligned projection dims; interpret mode
+    relaxes that so tiny test models still exercise the kernel."""
+    from prime_tpu.models.quantize import _mesh_context_active
+    from prime_tpu.ops.attention import _pallas_interpret
+
+    if isinstance(w, tuple) or getattr(w, "ndim", 0) != 2:
+        return False
+    if _mesh_context_active():
+        return False
+    if _pallas_interpret():
+        return True
+    return (
+        jax.default_backend() == "tpu"
+        and x.shape[-1] % 128 == 0
+        and b.shape[-1] % 128 == 0
+    )
+
+
 def _lora_mm(
     x: jnp.ndarray,               # (B, S, d_in) projection input
     lp: Params,                   # one layer's params (may carry lora stacks)
@@ -129,12 +153,26 @@ def _lora_mm(
     scale folded in; bank slot 0 is the all-zeros base adapter, so base rows
     add an exact zero). Factor math runs in fp32 like ``merge_lora``'s delta
     — the factors are tiny, no reason to round them — and the delta is added
-    in the activation dtype, mirroring the merged path's cast."""
-    y = _mm(x, lp[name])
+    in the activation dtype, mirroring the merged path's cast.
+
+    When eligible, base + gather + delta run as ONE pallas program
+    (ops/pallas_lora.fused_lora_matmul — the adapter gather happens in the
+    kernel's BlockSpec index maps, so the stacked bank is never copied per
+    row); the kernel replicates this chain's rounding exactly and the einsum
+    path below stays the non-TPU/mesh reference."""
     a = lp.get(f"lora:{name}:a")  # (A, d_in, r) this layer's stacked A
     if a is None or adapter_ids is None:
-        return y
+        return _mm(x, lp[name])
     b = lp[f"lora:{name}:b"]      # (A, r, d_out)
+    w = lp[name]
+    if _lora_kernel_eligible(w, x, b):
+        from prime_tpu.ops.attention import _pallas_interpret
+        from prime_tpu.ops.pallas_lora import fused_lora_matmul
+
+        return fused_lora_matmul(
+            x, w, a, b, adapter_ids, interpret=_pallas_interpret()
+        )
+    y = _mm(x, w)
     a_rows = a[adapter_ids].astype(jnp.float32)   # (B, d_in, r) row gather
     b_rows = b[adapter_ids].astype(jnp.float32)   # (B, r, d_out)
     h = jnp.einsum("bsd,bdr->bsr", x.astype(jnp.float32), a_rows)

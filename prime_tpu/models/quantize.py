@@ -123,6 +123,36 @@ def quantize_params_int4(params: dict, group: int = INT4_GROUP) -> dict:
     return out
 
 
+def quantize_kv_int4(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-slot symmetric int4 for the KV cache, nibble-packed along
+    head_dim: x is (..., head_dim, S) feature-major; returns (packed uint8
+    (..., head_dim/2, S), scale fp32 (..., 1, S)). Same scales layout as the
+    int8 ``quantize_kv`` — the flash-decode kernel's scale plumbing is
+    shared; only the carrier (and the VMEM widening) differs. Low nibble =
+    features [0, D/2), high nibble = [D/2, D), matching the weight packing
+    convention so one unpack rule serves both."""
+    d = x.shape[-2]
+    if d % 2:
+        raise ValueError(f"head_dim {d} must be even to nibble-pack the KV cache")
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-2, keepdims=True)
+    scale = absmax / 7.0
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / jnp.maximum(scale, 1e-12)), -8, 7
+    ).astype(jnp.int8)
+    lo = q[..., : d // 2, :].astype(jnp.uint8) & 0xF
+    hi = q[..., d // 2 :, :].astype(jnp.uint8) & 0xF
+    return lo | (hi << 4), scale
+
+
+def unpack_kv_int4(packed: jnp.ndarray) -> jnp.ndarray:
+    """Widen a nibble-packed KV array (..., head_dim/2, S) back to fp32
+    (..., head_dim, S) — the XLA reference path's dequant (scales applied by
+    the caller) and the ground truth the pallas int4 decode is tested
+    against."""
+    lo, hi = _unpack_nibbles(packed)
+    return jnp.concatenate([lo, hi], axis=-2).astype(jnp.float32)
+
+
 def _unpack_nibbles(packed: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Sign-extend the two 4-bit values in each uint8 to int8 in [-8, 7]."""
     lo = ((packed & 0xF).astype(jnp.int8) ^ 8) - 8

@@ -20,9 +20,14 @@ def _pallas_eligible(q: jnp.ndarray, head_dim: int) -> bool:
     if jax.default_backend() != "tpu":
         return False
     seq_len = q.shape[2]
-    from prime_tpu.ops.pallas_attention import BLOCK_Q
+    from prime_tpu.ops.pallas_attention import BLOCK_Q, _resolve_block
 
-    return seq_len % BLOCK_Q == 0 and head_dim % 128 == 0
+    # the kernel's own divisibility fallback drops an ill-fitting resolved
+    # block back to the 128 default, so eligibility accepts either alignment
+    block_q = _resolve_block("flash_prefill", "block_q", BLOCK_Q)
+    return (
+        seq_len % block_q == 0 or seq_len % BLOCK_Q == 0
+    ) and head_dim % 128 == 0
 
 
 def _apply_softcap(scores: jnp.ndarray, softcap: float) -> jnp.ndarray:
@@ -104,6 +109,49 @@ def _flash_decode_min_capacity() -> int:
     return env_int("PRIME_TPU_FLASH_DECODE_MIN_C", 2048)
 
 
+def _decode_int4(
+    q, k_cache, v_cache, cache_lengths, sm_scale, impl,
+    k_scale, v_scale, softcap, window, sliding, sinks,
+):
+    """int4-KV decode dispatch: a nibble-packed uint8 cache (a QUARTER of
+    the bf16 bytes) rides the flash-decode kernel behind the same scales
+    plumbing as int8. The gate reuses the multi-device rule the int4 weight
+    kernel established (models/quantize.py ``_mesh_context_active``): a bare
+    pallas_call cannot partition under SPMD jit, so mesh callers — and
+    non-TPU backends outside interpret mode — take the XLA reference, which
+    widens the nibbles in-graph, folds the scales, and runs the standard
+    fp path (the ground truth the kernel is tested against, under the
+    documented int4 rounding tolerance, not bit-identity)."""
+    from prime_tpu.models.quantize import _mesh_context_active, unpack_kv_int4
+
+    interpret = _pallas_interpret()
+    capacity = k_cache.shape[3]
+    kernel_ok = (
+        not _mesh_context_active()
+        and (
+            interpret
+            or (
+                jax.default_backend() == "tpu"
+                and capacity >= _flash_decode_min_capacity()
+            )
+        )
+    )
+    if impl == "pallas" or (impl == "auto" and kernel_ok):
+        from prime_tpu.ops.pallas_attention import flash_decode
+
+        return flash_decode(
+            q, k_cache, v_cache, cache_lengths, sm_scale=sm_scale,
+            softcap=softcap, window=window, sliding=sliding, sinks=sinks,
+            k_scale=k_scale, v_scale=v_scale, interpret=interpret,
+        )
+    k_f = unpack_kv_int4(k_cache) * k_scale
+    v_f = unpack_kv_int4(v_cache) * v_scale
+    return decode_attention(
+        q, k_f, v_f, cache_lengths, sm_scale, impl="xla",
+        softcap=softcap, window=window, sliding=sliding, sinks=sinks,
+    ).astype(q.dtype)
+
+
 def _decode_pallas_eligible(k_cache: jnp.ndarray) -> bool:
     if jax.default_backend() != "tpu":
         return False
@@ -177,6 +225,13 @@ def decode_attention(
     non-TPU backends, batch/head counts the mesh cannot divide).
     """
     quantized = k_scale is not None
+    if quantized and k_cache.dtype == jnp.uint8:
+        # int4 cache (nibble-packed): its own dispatch — kernel when the
+        # multi-device gate allows, XLA widen-and-fold reference otherwise
+        return _decode_int4(
+            q, k_cache, v_cache, cache_lengths, sm_scale, impl,
+            k_scale, v_scale, softcap, window, sliding, sinks,
+        )
     if impl == "sharded":
         if mesh is not None and _sharded_decode_eligible(
             k_cache, mesh, quantized=quantized

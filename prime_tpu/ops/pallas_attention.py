@@ -35,10 +35,24 @@ from jax.experimental.pallas import tpu as pltpu
 # ships so the kernels lower under both toolchains
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
+# Built-in block defaults (measured on v5e-1). Call sites resolve the
+# ACTIVE blocks through ops/kernel_configs.py — env override, then a tuned
+# per-device-kind artifact, then these — with per-shape divisibility
+# fallbacks, so the constants remain the floor of the resolution chain, not
+# the tiling itself.
 BLOCK_Q = 128
 BLOCK_K = 128
 BLOCK_C = 128  # flash-decode cache-slot block (lane dimension of the kv cache)
 NEG_INF = -1e30
+
+
+def _resolve_block(kernel: str, param: str, default: int) -> int:
+    from prime_tpu.ops import kernel_configs
+
+    try:
+        return kernel_configs.resolve(kernel, param)
+    except KeyError:  # pragma: no cover — registry/kernel name drift
+        return default
 
 
 def _window_scalar(window: int, sliding) -> jnp.ndarray:
@@ -75,7 +89,7 @@ def _finalize_attention(acc, m, l, sink):
 
 
 
-def _prefill_band(qb, window_ref, block_k: int):
+def _prefill_band(qb, window_ref, block_q: int, block_k: int):
     """This query block's live kv-block range [band_start, causal_last]:
     causal cuts blocks strictly above the diagonal, a sliding window cuts
     blocks entirely before the band. Shared by the kernel's compute gate and
@@ -83,9 +97,9 @@ def _prefill_band(qb, window_ref, block_k: int):
     revisit a resident block so their copies are elided (see
     _decode_live_block for the mechanism)."""
     window = window_ref[0]
-    causal_last = (qb * BLOCK_Q + BLOCK_Q - 1) // block_k
+    causal_last = (qb * block_q + block_q - 1) // block_k
     band_start = jnp.where(
-        window > 0, jnp.maximum(qb * BLOCK_Q - window + 1, 0) // block_k, 0
+        window > 0, jnp.maximum(qb * block_q - window + 1, 0) // block_k, 0
     )
     return band_start, causal_last
 
@@ -102,6 +116,7 @@ def _flash_kernel(
     acc_scr,     # (BLOCK_Q, D) f32: output accumulator
     *,
     sm_scale: float,
+    block_q: int,
     block_k: int,
     softcap: float,
     use_sinks: bool,
@@ -113,7 +128,7 @@ def _flash_kernel(
     kb = pl.program_id(3)
     last_kb = pl.num_programs(3) - 1
     window = window_ref[0]
-    band_start, causal_last = _prefill_band(qb, window_ref, block_k)
+    band_start, causal_last = _prefill_band(qb, window_ref, block_q, block_k)
 
     @pl.when(kb == 0)
     def _init():
@@ -131,7 +146,7 @@ def _flash_kernel(
         )  # (BQ, BK)
         if softcap:
             scores = jnp.tanh(scores / softcap) * softcap
-        q_positions = qb * BLOCK_Q + jax.lax.broadcasted_iota(
+        q_positions = qb * block_q + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 0
         )
         kv_positions = kb * block_k + jax.lax.broadcasted_iota(
@@ -181,13 +196,24 @@ def _decode_live_block(b, cb, lengths_ref, window_ref, block_c: int):
     return jnp.clip(cb, first, jnp.maximum(num - 1, first))
 
 
+def _unpack_kv_nibbles(packed):
+    """Widen a nibble-packed (D/2, BLOCK_C) uint8 cache block to its fp32
+    (D, BLOCK_C) values in VMEM: low nibble = features [0, D/2), high
+    nibble = [D/2, D) — the models/quantize.py packing convention. The
+    packed bytes are what streamed from HBM; the widening is VMEM-local."""
+    lo = ((packed & 0xF).astype(jnp.int8) ^ 8) - 8
+    hi = ((packed >> 4).astype(jnp.int8) ^ 8) - 8
+    return jnp.concatenate([lo, hi], axis=0).astype(jnp.float32)
+
+
 def _decode_kernel(
     lengths_ref,  # (B,) scalar-prefetch, SMEM
     window_ref,   # (1,) scalar-prefetch: effective window (0 = global layer)
     q_ref,        # (1, 1, G, D)
     k_ref,        # (1, 1, D, BLOCK_C) the live cache block for this step
+                  # (int4: (1, 1, D/2, BLOCK_C) nibble-packed uint8)
     v_ref,        # (1, 1, D, BLOCK_C)
-    *rest,        # int8 path: k_scale_ref, v_scale_ref (1, 1, 1, BLOCK_C);
+    *rest,        # int8/int4 path: k_scale_ref, v_scale_ref (1, 1, 1, BLOCK_C);
                   # then sinks_ref (KH, G), o_ref (1, 1, G, D),
                   # scratch: m (G, 128), l (G, 128), acc (G, D) — all fp32,
                   # carried across the cache-block grid dimension
@@ -195,9 +221,9 @@ def _decode_kernel(
     block_c: int,
     softcap: float,
     use_sinks: bool,
-    quantized: bool,
+    quant: str | None,  # None | "int8" | "int4" cache carrier
 ):
-    if quantized:
+    if quant is not None:
         k_scale_ref, v_scale_ref, sinks_ref, o_ref, m_scr, l_scr, acc_scr = rest
     else:
         sinks_ref, o_ref, m_scr, l_scr, acc_scr = rest
@@ -226,14 +252,21 @@ def _decode_kernel(
     @pl.when((cb >= first) & (cb < num))
     def _accumulate():
         q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (G, D)
-        k = k_ref[0, 0].astype(jnp.float32)             # (D, BC)
-        v = v_ref[0, 0].astype(jnp.float32)
+        if quant == "int4":
+            # int4 streams a QUARTER of the bf16 bytes from HBM; the nibble
+            # widening happens on the VMEM-resident block, and the same
+            # per-slot scales the int8 path uses fold into the epilogues
+            k = _unpack_kv_nibbles(k_ref[0, 0])          # (D, BC)
+            v = _unpack_kv_nibbles(v_ref[0, 0])
+        else:
+            k = k_ref[0, 0].astype(jnp.float32)          # (D, BC)
+            v = v_ref[0, 0].astype(jnp.float32)
         scores = jax.lax.dot_general(
             q, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # (G, BC)
-        if quantized:
-            # int8 streams from HBM (half the bytes) and widens to fp32 in
-            # VMEM; the per-slot scales are column-constant so they fold
+        if quant is not None:
+            # int8/int4 stream from HBM at reduced bytes and widen to fp32
+            # in VMEM; the per-slot scales are column-constant so they fold
             # into the epilogues, no dequantized cache is materialized
             scores = scores * k_scale_ref[0, 0].astype(jnp.float32)  # (1, BC)
         if softcap:
@@ -248,7 +281,7 @@ def _decode_kernel(
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         weighted = (
-            p if not quantized else p * v_scale_ref[0, 0].astype(jnp.float32)
+            p if quant is None else p * v_scale_ref[0, 0].astype(jnp.float32)
         )
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             weighted, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -301,20 +334,41 @@ def flash_decode(
     adds each head's learned logit to the softmax denominator. With
     ``k_scale``/``v_scale`` the cache is int8: half the bytes stream from
     HBM (widened to fp32 in VMEM) and the per-slot scales fold into the
-    score/value epilogues, so no dequantized cache is ever materialized."""
+    score/value epilogues, so no dequantized cache is ever materialized.
+    A uint8 cache with scales is the int4 variant (models/quantize.py
+    nibble packing along head_dim — a QUARTER of the bf16 bytes): the
+    kernel widens the packed block in VMEM behind the same scales plumbing."""
     batch, num_heads, _, head_dim = q.shape
     kv_heads, capacity = k_cache.shape[1], k_cache.shape[3]
     assert num_heads % kv_heads == 0
     group = num_heads // kv_heads
     if sm_scale is None:
         sm_scale = head_dim**-0.5
-    # biggest supported block that divides the capacity: fewer, larger DMAs
-    block_c = next(
-        (b for b in (512, 256, BLOCK_C) if capacity % b == 0 and b <= capacity),
-        capacity,
-    )
     quantized = k_scale is not None
     assert quantized == (v_scale is not None), "k_scale and v_scale go together"
+    quant = None
+    if quantized:
+        quant = "int4" if k_cache.dtype == jnp.uint8 else "int8"
+    kv_dim = k_cache.shape[2]  # head_dim, or head_dim/2 nibble-packed
+    if quant == "int4":
+        assert kv_dim * 2 == head_dim, "int4 cache must be nibble-packed along head_dim"
+    else:
+        assert kv_dim == head_dim
+    # biggest supported block that divides the capacity: fewer, larger DMAs.
+    # The preference comes from the config registry (env override > tuned
+    # per-device-kind artifact > 128 default); the divisibility walk below
+    # is the fallback that keeps an ill-fitting tuned value harmless.
+    pref = _resolve_block(
+        "flash_decode" if quant is None else "flash_decode_int8", "block_c", BLOCK_C
+    )
+    block_c = next(
+        (
+            b
+            for b in dict.fromkeys((pref, 512, 256, BLOCK_C))
+            if capacity % b == 0 and b <= capacity
+        ),
+        capacity,
+    )
 
     window_arr = _window_scalar(window, sliding)
     use_sinks, sinks_arr = _sinks_operand(sinks, kv_heads, group)
@@ -326,8 +380,8 @@ def flash_decode(
 
     qkv_specs = [
         pl.BlockSpec((1, 1, group, head_dim), lambda b, h, cb, *_: (b, h, 0, 0)),
-        pl.BlockSpec((1, 1, head_dim, block_c), kv_map),
-        pl.BlockSpec((1, 1, head_dim, block_c), kv_map),
+        pl.BlockSpec((1, 1, kv_dim, block_c), kv_map),
+        pl.BlockSpec((1, 1, kv_dim, block_c), kv_map),
     ]
     scale_specs = [
         pl.BlockSpec((1, 1, 1, block_c), kv_map),
@@ -336,7 +390,7 @@ def flash_decode(
     sinks_spec = pl.BlockSpec((kv_heads, group), lambda b, h, cb, *_: (0, 0))
     kernel = functools.partial(
         _decode_kernel, sm_scale=sm_scale, block_c=block_c, softcap=softcap,
-        use_sinks=use_sinks, quantized=quantized,
+        use_sinks=use_sinks, quant=quant,
     )
     if quantized:
         in_specs = qkv_specs + scale_specs + [sinks_spec]
@@ -416,43 +470,48 @@ def flash_attention_causal(
     if sm_scale is None:
         sm_scale = head_dim**-0.5
 
-    block_k = min(BLOCK_K, seq_len)
+    # registry-resolved tiling (env > tuned artifact > 128 defaults), with
+    # the same shape fallbacks as before: a preferred block_q that doesn't
+    # divide the sequence drops back to the default
+    pref_q = _resolve_block("flash_prefill", "block_q", BLOCK_Q)
+    block_q = pref_q if seq_len % pref_q == 0 else BLOCK_Q
+    block_k = min(_resolve_block("flash_prefill", "block_k", BLOCK_K), seq_len)
     # the kv-block axis is a GRID dimension (see flash_decode): the index
     # map clips each step into the query block's live [band_start,
     # causal_last] range, so blocks above the diagonal — and, on a sliding
     # layer, before the band — are never read from HBM, not just skipped in
     # compute. Causal prefill reads ~half the k/v bytes; a sliding layer
     # reads O(S*window).
-    grid = (batch, num_heads, pl.cdiv(seq_len, BLOCK_Q), pl.cdiv(seq_len, block_k))
+    grid = (batch, num_heads, pl.cdiv(seq_len, block_q), pl.cdiv(seq_len, block_k))
 
     window_arr = _window_scalar(window, sliding)
     use_sinks, sinks_arr = _sinks_operand(sinks, num_heads, 1)
 
     def kv_map(b, h, qb, kb, win):
-        band_start, causal_last = _prefill_band(qb, win, block_k)
+        band_start, causal_last = _prefill_band(qb, win, block_q, block_k)
         last = jnp.minimum(causal_last, pl.cdiv(seq_len, block_k) - 1)
         return (b, h // group, jnp.clip(kb, band_start, last), 0)
 
     kernel = functools.partial(
-        _flash_kernel, sm_scale=sm_scale, block_k=block_k,
+        _flash_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
         softcap=softcap, use_sinks=use_sinks,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, BLOCK_Q, head_dim), lambda b, h, qb, kb, *_: (b, h, qb, 0)),
+            pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, qb, kb, *_: (b, h, qb, 0)),
             pl.BlockSpec((1, 1, block_k, head_dim), kv_map),
             pl.BlockSpec((1, 1, block_k, head_dim), kv_map),
             pl.BlockSpec((num_heads, 1), lambda b, h, qb, kb, *_: (0, 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, BLOCK_Q, head_dim), lambda b, h, qb, kb, *_: (b, h, qb, 0)
+            (1, 1, block_q, head_dim), lambda b, h, qb, kb, *_: (b, h, qb, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),      # running max
-            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),      # running denominator
-            pltpu.VMEM((BLOCK_Q, head_dim), jnp.float32),  # output accumulator
+            pltpu.VMEM((block_q, 128), jnp.float32),      # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),      # running denominator
+            pltpu.VMEM((block_q, head_dim), jnp.float32),  # output accumulator
         ],
     )
     return pl.pallas_call(

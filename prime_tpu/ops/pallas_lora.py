@@ -1,0 +1,120 @@
+"""Fused gathered-LoRA projection: ``x @ W + (x @ A[idx]) @ B'[idx]`` as ONE
+pallas program.
+
+The multi-LoRA serving path (models/llama._lora_mm) runs every adapted
+projection as a chain: the base matmul, a per-row gather of the A/B factor
+stacks, and two batched einsums for the delta. XLA materializes the gathered
+``(B, d_in, r)`` / ``(B, r, d_out)`` factor copies to HBM between those ops —
+per-wave traffic that scales with the batch even when every row uses the
+same adapter. Here the gather happens in the BlockSpec index maps: the
+per-row adapter id is scalar-prefetched and each program's A/B blocks are
+fetched straight from the stacked bank at ``ids[b]`` — the bank row is read,
+never copied out, and base + delta fuse into one output write.
+
+Rounding contract (bit-identity with the einsum path, pinned by
+tests/test_kernels.py and the engine matrix in tests/test_multilora.py):
+the reference computes the base in the activation dtype, the delta in fp32,
+casts the delta to the activation dtype, and adds in that dtype. The kernel
+replicates exactly that: one fp32-accumulated base dot rounded once to the
+activation dtype, fp32 factor dots, delta rounded once, then the add.
+
+Eligibility is the caller's job (models/llama._lora_kernel_eligible): plain
+(unquantized) 2-D base weight, single-device (a bare pallas_call cannot
+partition under SPMD jit), TPU backend or interpret mode, and on real TPUs
+128-aligned d_in/d_out. Everything else keeps the einsum chain as the
+reference path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from prime_tpu.ops.pallas_attention import _resolve_block
+
+BLOCK_OUT = 256
+
+
+def _lora_kernel(interpret, ids_ref, x_ref, w_ref, a_ref, b_ref, o_ref):
+    # x_ref (1, S, d_in); w_ref (d_in, block_out); a_ref (1, d_in, r) and
+    # b_ref (1, r, block_out) are THIS row's adapter, resolved by the index
+    # maps from ids_ref; o_ref (1, S, block_out).
+    x = x_ref[0]
+    base = jax.lax.dot_general(
+        x, w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(o_ref.dtype)
+    h = jax.lax.dot_general(
+        x.astype(jnp.float32), a_ref[0].astype(jnp.float32),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    delta = jax.lax.dot_general(
+        h, b_ref[0].astype(jnp.float32),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    if interpret:
+        # Interpret mode re-exposes this body to XLA, whose dot-merger pass
+        # fuses base and delta into one reduction over d_in + r — a rounding
+        # the real (Mosaic-compiled) kernel never produces. The barrier keeps
+        # CPU bit-identity runs on the same contract as the hardware kernel.
+        base, delta = jax.lax.optimization_barrier((base, delta))
+    o_ref[0] = base + delta.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_out", "interpret"))
+def fused_lora_matmul(
+    x: jnp.ndarray,            # (B, S, d_in) activations
+    w: jnp.ndarray,            # (d_in, d_out) base projection
+    a: jnp.ndarray,            # (A, d_in, r) stacked LoRA A factors
+    b: jnp.ndarray,            # (A, r, d_out) stacked B' (scale folded in)
+    adapter_ids: jnp.ndarray,  # (B,) int32 bank slots
+    block_out: int | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Per-row adapted projection in one pass; see module docstring for the
+    rounding/bit-identity contract. Output is (B, S, d_out) in x.dtype."""
+    batch, seq, d_in = x.shape
+    d_out = w.shape[1]
+    r = a.shape[2]
+    if block_out is None:
+        block_out = _resolve_block("lora_mm", "block_out", BLOCK_OUT)
+    block_out = next(
+        (bo for bo in dict.fromkeys((block_out, BLOCK_OUT, 128)) if d_out % bo == 0),
+        d_out,
+    )
+    grid = (batch, d_out // block_out)
+    return pl.pallas_call(
+        functools.partial(_lora_kernel, interpret),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, seq, d_in), lambda bi, oi, ids: (bi, 0, 0)),
+                pl.BlockSpec((d_in, block_out), lambda bi, oi, ids: (0, oi)),
+                pl.BlockSpec((1, d_in, r), lambda bi, oi, ids: (ids[bi], 0, 0)),
+                pl.BlockSpec((1, r, block_out), lambda bi, oi, ids: (ids[bi], 0, oi)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, seq, block_out), lambda bi, oi, ids: (bi, 0, oi)
+            ),
+        ),
+        out_shape=jax.ShapeDtypeStruct((batch, seq, d_out), x.dtype),
+        cost_estimate=pl.CostEstimate(
+            # per wave: the full W once per batch row's column sweep, ONE
+            # adapter row of A/B per batch row (the gather's whole point —
+            # the stacked bank is not read in full), x, and the output
+            flops=2 * batch * seq * d_in * (d_out + r) + 2 * batch * seq * r * d_out,
+            bytes_accessed=(
+                batch * w.size * w.dtype.itemsize
+                + batch * d_in * r * a.dtype.itemsize
+                + batch * r * d_out * b.dtype.itemsize
+                + 2 * x.size * x.dtype.itemsize
+            ),
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(adapter_ids.astype(jnp.int32), x, w, a, b)

@@ -1,0 +1,113 @@
+"""Paged-gather of radix-tree KV segments — BlockSpec index maps resolve the
+segment's page pointers, so seeding a prefix hit never runs ``assemble_row``'s
+contiguous copy.
+
+The serve engine's prefix cache stores matched KV as lists of fixed-size pages
+inside a pooled device buffer (serve/kv_pool.PagedKVPool). At hit-seeding
+time the decode row needs those pages laid out contiguously along the cache
+axis. The copy path does that with one `dynamic_update_slice` chain per
+(segment shape, take) pair — a compile-cache zoo and a full extra HBM
+round-trip of the prefix bytes. This kernel does it as ONE program per row
+capacity: a scalar-prefetched page table drives the pool BlockSpec's index
+map, so Mosaic's pipeline fetches each page of the pool directly into the
+output position — the gather IS the index map, there is no gather compute.
+
+Layout contract (must match serve/kv_pool):
+- pool leaf: ``(num_pages, R, page_tokens)`` where R is the product of the
+  cache leaf's non-capacity dims (e.g. L*KH*D for k/v, L*KH for scales).
+- table: ``(max_pages,) int32`` page ids, ``-1`` = past-the-end slot. The
+  kernel writes zeros there, matching the zeros `init_cache` seeds the copy
+  path's row with — bit-identity between the paths needs the tails equal too.
+- out: ``(R, max_pages * page_tokens)`` — reshaped by the pool back to the
+  cache leaf's natural shape with capacity last.
+
+Bit-identity: the kernel moves bytes, it computes nothing — the seeded row is
+element-for-element the same array either path builds, so greedy decode from
+a paged seed is bit-identical to the copy path by construction (pinned by
+tests/test_kernels.py and the engine matrix in tests/test_engine.py).
+
+``block_r`` (rows of R per program, tuned via the "paged_gather" registry
+entry) trades grid size against VMEM block footprint; the wrapper clamps it
+to the largest divisor of R.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from prime_tpu.ops.pallas_attention import _resolve_block
+
+BLOCK_R = 1024
+
+
+def _paged_gather_kernel(tbl_ref, p_ref, o_ref):
+    # tbl_ref: (max_pages,) int32 scalar-prefetch; p_ref: (1, block_r,
+    # page_tokens) — the page the index map resolved for this program;
+    # o_ref: (block_r, page_tokens) at column-block i of the output.
+    i = pl.program_id(0)
+    o_ref[...] = jnp.where(tbl_ref[i] >= 0, p_ref[0], jnp.zeros_like(o_ref))
+
+
+@functools.partial(jax.jit, static_argnames=("block_r", "interpret"))
+def paged_gather(
+    pool: jnp.ndarray,   # (num_pages, R, page_tokens)
+    table: jnp.ndarray,  # (max_pages,) int32, -1 = empty slot
+    block_r: int | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Gather ``pool[table]`` into a contiguous ``(R, max_pages*page_tokens)``
+    row, zeros where ``table < 0``. The page lookup happens in the pool
+    BlockSpec's index map — for an empty slot it clamps to page 0 and the
+    kernel masks the block to zeros (the fetch is wasted, not wrong)."""
+    num_pages, r_dim, page_tokens = pool.shape
+    max_pages = table.shape[0]
+    if block_r is None:
+        block_r = _resolve_block("paged_gather", "block_r", BLOCK_R)
+    block_r = min(block_r, r_dim)
+    while r_dim % block_r:
+        block_r -= 1
+    grid = (max_pages, r_dim // block_r)
+    return pl.pallas_call(
+        _paged_gather_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, block_r, page_tokens),
+                    lambda i, r, tbl: (jnp.maximum(tbl[i], 0), r, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (block_r, page_tokens), lambda i, r, tbl: (r, i)
+            ),
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (r_dim, max_pages * page_tokens), pool.dtype
+        ),
+        cost_estimate=pl.CostEstimate(
+            # reads only the referenced pages (+ the clamped wasted fetch for
+            # empty slots is not modeled — the table is usually near-full at
+            # seed time); writes the whole row.
+            flops=0,
+            bytes_accessed=2 * r_dim * max_pages * page_tokens * pool.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(table, pool)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def paged_gather_xla(pool: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
+    """XLA reference for :func:`paged_gather` — same contract, plain take.
+    The CPU serve path uses this directly; tests pin the pallas kernel
+    bit-identical to it."""
+    r_dim = pool.shape[1]
+    pages = pool[jnp.maximum(table, 0)]                  # (max_pages, R, PT)
+    pages = jnp.where((table >= 0)[:, None, None], pages, 0)
+    return jnp.swapaxes(pages, 0, 1).reshape(r_dim, -1)

@@ -82,8 +82,15 @@ def int4_matmul(
     g = d_in // groups
     # block size must DIVIDE d_out — a floor-divided grid would silently
     # leave tail columns unwritten (e.g. d_out=896: one 512 block covers
-    # only columns 0-511). Callers guarantee d_out % 128 == 0.
-    block_out = next(b for b in (BLOCK_OUT, 256, 128) if d_out % b == 0)
+    # only columns 0-511). Callers guarantee d_out % 128 == 0. The preferred
+    # block comes from the config registry (tuned per device kind); the
+    # divisibility walk keeps an ill-fitting value harmless.
+    from prime_tpu.ops.pallas_attention import _resolve_block
+
+    pref = _resolve_block("int4_matmul", "block_out", BLOCK_OUT)
+    block_out = next(
+        b for b in dict.fromkeys((pref, BLOCK_OUT, 256, 128)) if d_out % b == 0
+    )
     kernel = functools.partial(_int4_matmul_kernel, groups=groups, g=g)
     return pl.pallas_call(
         kernel,

@@ -212,9 +212,17 @@ def _segment_to_host(segment: Any) -> Any:
     """Spill-tier demotion: device KV slices -> host-RAM copies. device_get
     blocks until the segment's producing dispatch finishes and lands plain
     numpy arrays in host memory (on runtimes with a pinned-host allocator the
-    transfer staging is pinned; the cache only needs the bytes off HBM)."""
+    transfer staging is pinned; the cache only needs the bytes off HBM).
+    Paged segments materialize to a loose dict first and return their pages
+    to the pool — the host tier holds bytes, never page ids, so a later
+    promote comes back as a loose device segment (the copy seeding path),
+    matching the 'host-resident -> fallback' contract."""
     import jax
 
+    if hasattr(segment, "materialize"):
+        host = jax.device_get(segment.materialize())
+        segment.close()
+        return host
     return jax.device_get(segment)
 
 
@@ -347,6 +355,7 @@ class ContinuousBatchingEngine:
         profile: bool | None = None,
         max_queue: int | None = None,
         prefix_store_all: bool = False,
+        paged_prefix: bool | None = None,
         adapters: Any = None,
         adapter_max_inflight: int | None = None,
         adapter_weights: Any = None,
@@ -632,6 +641,22 @@ class ContinuousBatchingEngine:
             if self.prefix_cache_mb > 0
             else None
         )
+        # paged prefix KV (docs/kernels.md "Kernel campaign & autotune"):
+        # device-resident cached segments live as fixed MIN_BUCKET-token
+        # pages in a pooled buffer (serve/kv_pool.PagedKVPool); hit-seeding
+        # gathers the pages straight into the decode row via the paged-gather
+        # kernel's scalar-prefetched page table, skipping assemble_row's
+        # contiguous copy. Copy path remains the fallback for host-resident
+        # matches and segments the pool couldn't hold. Gated off under a
+        # mesh: the bare pallas_call cannot partition under SPMD and the
+        # gathered row would drop the cache_spec constraint (same rule as the
+        # flash-kernel dispatch above).
+        if paged_prefix is None:
+            paged_prefix = env_flag("PRIME_SERVE_PAGED_PREFIX", True)
+        self.paged_prefix = (
+            bool(paged_prefix) and self.prefix_cache is not None and mesh is None
+        )
+        self._kv_pool = None  # lazy: leaf specs known at first stored segment
         # observability: registry-backed counters + latency histograms
         # (surfaced by stats(), the server's /metrics JSON, and the
         # Prometheus exposition at /metrics?format=prometheus). One Registry
@@ -697,7 +722,31 @@ class ContinuousBatchingEngine:
         )
         self._m_prefix_assembles = r.counter(
             "serve_prefix_assembles_total",
-            "assemble_row dispatches (one per prefix-seeded admission)",
+            "assemble_row dispatches (one per COPY-path prefix-seeded admission)",
+        )
+        self._m_prefix_paged_seeds = r.counter(
+            "serve_prefix_paged_seeds_total",
+            "Prefix hits seeded by the paged-gather path (pool pages gathered "
+            "in place; no assemble_row copy)",
+        )
+        self._m_prefix_seed_s = r.histogram(
+            "serve_prefix_seed_seconds",
+            "Hit-seeding dispatch wall time by path (paged = pooled page "
+            "gather, copy = contiguous assemble_row)",
+            labelnames=("path",),
+        )
+        # which tier feeds pallas block-size resolution on this replica
+        # (ops/kernel_configs.py): 0 = built-in defaults, 1 = tuned
+        # per-device-kind artifact, 2 = a PRIME_TPU_BLOCK_* env override
+        from prime_tpu.ops import kernel_configs
+
+        self._m_kernel_config_source = r.gauge(
+            "serve_kernel_config_source",
+            "Kernel block-config resolution tier "
+            "(0=default, 1=tuned artifact, 2=env override)",
+        )
+        self._m_kernel_config_source.set(
+            {"default": 0, "tuned": 1, "env": 2}[kernel_configs.source()]
         )
         # disaggregated serving (docs/architecture.md "Disaggregated
         # serving"): prefix-KV segments shipped over the versioned wire
@@ -2481,9 +2530,14 @@ class ContinuousBatchingEngine:
             return 0, init_cache(
                 self.config, 1, row_cb, dtype=self._dtype, quantized=self.kv_quant
             )
-        if self._assemble_fn is None:
-            self._assemble_fn = self._make_assemble_row()
         host_tokens = match.host_tokens
+        # paged fast path: every matched segment device-resident as pool
+        # pages and the whole run fits the row — gather in place, no copy.
+        # Anything else (host-resident entries, loose fallback segments,
+        # over-long runs) takes the contiguous assemble as before.
+        table = self._paged_seed_table(match, row_cb)
+        path = "paged" if table is not None else "copy"
+        t_seed = time.monotonic()
         try:
             # tier annotates the span so trace evidence distinguishes a pure
             # HBM hit from one that paid a host->device re-upload first
@@ -2491,20 +2545,34 @@ class ContinuousBatchingEngine:
                 "serve.assemble", context=ctx, hit_tokens=match.length,
                 segments=len(match.entries), row_capacity=row_cb,
                 tier="host" if host_tokens else "device",
-                host_tokens=host_tokens,
+                host_tokens=host_tokens, path=path,
             ), self.profiler.step(
                 "assemble", pre=self._last, batch=1, steps=match.length
             ) as prof_step:
-                if host_tokens:
-                    # re-upload the spilled segments in place (still pinned —
-                    # the rebalance this may trigger skips the match path)
-                    self.prefix_cache.promote(match)
-                row = self._assemble_fn(match.segments(), match.takes(), row_cb)
+                if table is not None:
+                    row = self._paged_seed_row(table, row_cb)
+                else:
+                    if self._assemble_fn is None:
+                        self._assemble_fn = self._make_assemble_row()
+                    if host_tokens:
+                        # re-upload the spilled segments in place (still
+                        # pinned — the rebalance this may trigger skips the
+                        # match path)
+                        self.prefix_cache.promote(match)
+                    segments = [
+                        seg.materialize() if hasattr(seg, "materialize") else seg
+                        for seg in match.segments()
+                    ]
+                    row = self._assemble_fn(segments, match.takes(), row_cb)
                 prof_step.fence(row.k)
         finally:
             self.prefix_cache.release(match)
+        self._m_prefix_seed_s.observe(time.monotonic() - t_seed, path=path)
         self._m_prefix_hits.inc()
-        self._m_prefix_assembles.inc()
+        if table is not None:
+            self._m_prefix_paged_seeds.inc()
+        else:
+            self._m_prefix_assembles.inc()
         if match.device_tokens:
             self._m_prefix_hit_tokens.observe(match.device_tokens, tier="device")
         if host_tokens:
@@ -2512,12 +2580,75 @@ class ContinuousBatchingEngine:
         self._sync_prefix_metrics()
         return match.length, row
 
+    def _ensure_kv_pool(self):
+        """The engine's page pool, created on first use (leaf dtypes/shapes
+        are only known once a segment exists — the pool sizes itself from the
+        first store). None when paging is disabled for this engine."""
+        if not self.paged_prefix:
+            return None
+        if self._kv_pool is None:
+            from prime_tpu.serve.kv_pool import PagedKVPool
+
+            # the pool shares the device-tier byte budget: every page the
+            # pool holds is a byte the radix accounting already charges
+            # (PagedSegment.nbytes == the loose form's bytes), so the LRU
+            # keeps bounding the SUM of pooled and loose segments
+            self._kv_pool = PagedKVPool(
+                int(self.prefix_cache_mb * 2**20), page_tokens=MIN_BUCKET
+            )
+        return self._kv_pool
+
+    def _paged_seed_table(self, match, row_cb: int):
+        """The page-id table for a paged seed, or None when the match must
+        take the copy path: a host-resident entry, a loose (pool-full
+        fallback or imported) segment, a partial take that isn't
+        page-aligned, or a run longer than the row. Reads the pin-time
+        snapshots, like the assemble path."""
+        pool = self._kv_pool
+        if pool is None or match.host_tokens:
+            return None
+        page_tokens = pool.page_tokens
+        if row_cb % page_tokens:
+            return None
+        pages: list[int] = []
+        for seg, take in zip(match.segments_snapshot, match.takes()):
+            seg_pages = getattr(seg, "pages", None)
+            if seg_pages is None or take % page_tokens:
+                return None
+            pages.extend(seg_pages[: take // page_tokens])
+        if not pages or len(pages) > row_cb // page_tokens:
+            return None
+        table = np.full(row_cb // page_tokens, -1, dtype=np.int32)
+        table[: len(pages)] = pages
+        return table
+
+    def _paged_seed_row(self, table, row_cb: int):
+        """Seed a staging row by gathering pool pages in place: one
+        paged-gather dispatch per leaf, zeros past the table's sentinels —
+        element-for-element the row assemble_row would build (the bit-identity
+        tests/test_engine.py pins). Like assemble, lengths stay zeros:
+        chunked prefill masks via prefill_offset and finalize sets slot
+        lengths explicitly."""
+        import jax.numpy as jnp
+
+        from prime_tpu.models.llama import KVCache
+
+        out = self._kv_pool.gather_row(table)
+        return KVCache(
+            k=out["k"], v=out["v"],
+            lengths=jnp.zeros((1,), dtype=jnp.int32),
+            k_scale=out.get("k_scale"), v_scale=out.get("v_scale"),
+        )
+
     def _row_slicer(self, row):
         """Segment extractor for _store_prefix: slots [start, stop) of every
         capacity-axis leaf of a finalized batch-1 staging row, as a plain
         dict (lengths is capacity-free and dropped — assemble rebuilds it).
         Each call is one lazy jnp slice per leaf, and the cache only invokes
-        it for the genuinely new tail of the trie path."""
+        it for the genuinely new tail of the trie path. With paging enabled
+        the slice is stored into the page pool and a PagedSegment enters the
+        tree instead; a full (or disabled-by-budget) pool falls back to the
+        loose slice — that segment's future hits just take the copy path."""
         src_cb = row.capacity
 
         def slicer(start: int, stop: int):
@@ -2530,7 +2661,20 @@ class ContinuousBatchingEngine:
                 out[name] = leaf[..., start:stop]
             return out
 
-        return slicer
+        pool = self._ensure_kv_pool()
+        if pool is None:
+            return slicer
+
+        from prime_tpu.serve.kv_pool import PagedSegment
+
+        def paged_slicer(start: int, stop: int):
+            seg = slicer(start, stop)
+            pages = pool.store(seg)
+            if pages is None:
+                return seg
+            return PagedSegment(pool, pages, stop - start)
+
+        return paged_slicer
 
     def _store_prefix(self, ids: list[int], row) -> None:
         """Split the finalized staging row into block segments and insert
@@ -2714,7 +2858,16 @@ class ContinuousBatchingEngine:
             # off-loop export, step 1: pin the match path on the loop (the
             # walk touches LRU stamps and refcounts — tree-owner state);
             # serialization then happens on the caller's thread
-            return self.prefix_cache.match(arg, limit=len(arg))
+            match = self.prefix_cache.match(arg, limit=len(arg))
+            if match is not None:
+                # paged snapshots read the shared page pool, and the pool's
+                # donated store may retire its buffers under a concurrent
+                # off-loop reader — materialize them HERE, on the loop, so
+                # the caller-thread serialize only touches private arrays
+                for i, seg in enumerate(match.segments_snapshot):
+                    if hasattr(seg, "materialize"):
+                        match.segments_snapshot[i] = seg.materialize()
+            return match
         if kind == "release":
             self.prefix_cache.release(arg)
             return None
@@ -2897,6 +3050,7 @@ class ContinuousBatchingEngine:
             "prefix_spills": int(values["serve_prefix_spills_total"]),
             "prefix_reuploads": int(values["serve_prefix_reuploads_total"]),
             "prefix_assembles": int(values["serve_prefix_assembles_total"]),
+            "prefix_paged_seeds": int(values["serve_prefix_paged_seeds_total"]),
             "kv_exports": int(values["serve_kv_exports_total"]),
             "kv_imports": int(values["serve_kv_imports_total"]),
             "uptime_s": round(time.monotonic() - self._t0, 3),

@@ -84,7 +84,13 @@ KV_WIRE_VERSION = 1
 def segment_nbytes(segment: Any) -> int:
     """Bytes of a segment pytree (sum over leaves of size*itemsize — the same
     accounting for bf16/fp32 KV, int8 KV, and fp32 scales, and for device
-    arrays and their host copies, whose shapes/dtypes are identical)."""
+    arrays and their host copies, whose shapes/dtypes are identical). A
+    segment exposing an integer ``nbytes`` of its own (kv_pool.PagedSegment,
+    or a bare array) is taken at its word — the paged accounting counts the
+    same bytes the loose form would."""
+    nbytes = getattr(segment, "nbytes", None)
+    if isinstance(nbytes, int) and not isinstance(nbytes, bool):
+        return nbytes
     import jax
 
     return int(
@@ -494,7 +500,17 @@ class BlockPrefixCache:
         # byte budget silently stops bounding RSS). Device arrays slice into
         # fresh buffers already; copying there would be pure waste.
         copy = node.tier == TIER_HOST
-        lower = _Node(node.tokens[m:], self._cut(node.segment, m, len(node.tokens), copy=copy), node)
+        splitter = getattr(node.segment, "split", None)
+        if splitter is not None:
+            # paged segment (kv_pool.PagedSegment): the cut is a zero-copy
+            # page-list repartition — page size == block, so a block-aligned
+            # m is always a page boundary. Live snapshots keep reading the
+            # original object's pages; both halves stay pin-protected below.
+            upper_seg, lower_seg = splitter(m)
+        else:
+            upper_seg = None  # cut after the lower node exists, as before
+            lower_seg = self._cut(node.segment, m, len(node.tokens), copy=copy)
+        lower = _Node(node.tokens[m:], lower_seg, node)
         lower.tier = node.tier
         if node.refs:
             # transfer pins: each live match pin on this node — whether it
@@ -513,7 +529,8 @@ class BlockPrefixCache:
         for c in lower.children.values():
             c.parent = lower
         lower.last_used = node.last_used
-        upper_seg = self._cut(node.segment, 0, m, copy=copy)
+        if upper_seg is None:
+            upper_seg = self._cut(node.segment, 0, m, copy=copy)
         delta = lower.nbytes + segment_nbytes(upper_seg) - node.nbytes
         if node.tier == TIER_HOST:
             self.host_bytes += delta
@@ -611,6 +628,13 @@ class BlockPrefixCache:
             match.entries, runs, match.segments()
         ):
             tokens.extend(int(t) for t in run[:take])
+            if hasattr(segment, "materialize"):
+                # paged segment: gather its pages into a loose dict. NOTE
+                # this reads the shared pool, so it is only safe on the
+                # tree-owning (engine loop) thread — the engine materializes
+                # paged snapshots on the loop BEFORE handing a match to the
+                # off-loop exporter (engine._kv_execute's "pin" step).
+                segment = segment.materialize()
             items = (
                 sorted(segment.items())
                 if isinstance(segment, dict)
@@ -786,6 +810,9 @@ class BlockPrefixCache:
     def _forget(self, node: _Node) -> None:
         """Account one DETACHED node out of the cache (caller already
         unlinked it from its parent)."""
+        closer = getattr(node.segment, "close", None)
+        if closer is not None:
+            closer()  # paged segment: return its pages to the pool
         if node.tier == TIER_HOST:
             self.host_bytes -= node.nbytes
             self.host_nodes -= 1
@@ -829,6 +856,13 @@ class BlockPrefixCache:
         return evicted
 
     def clear(self) -> None:
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            closer = getattr(node.segment, "close", None)
+            if closer is not None:
+                closer()  # paged segments: pages back to the pool
+            stack.extend(node.children.values())
         self._root = _Node((), None, None)
         self.bytes = 0
         self.host_bytes = 0

@@ -435,6 +435,89 @@ def test_prefix_cache_bit_identity_on_off(overlap):
     assert outs["host"] == outs["off"]
 
 
+@pytest.mark.parametrize("speculative", [False, True], ids=["plain", "spec"])
+@pytest.mark.parametrize("overlap", [True, False], ids=["overlap", "sync"])
+def test_paged_seeding_bit_identity_vs_copy(overlap, speculative):
+    """Paged-gather hit seeding vs the contiguous assemble_row copy engine:
+    greedy outputs are bit-identical across the overlap x speculative matrix.
+    The paged engine must actually seed from the page pool (paged seeds
+    counted, zero copy assembles) and the copy engine must keep the old
+    path — the pool is pure data movement and may never show in tokens."""
+    pre = [(i * 19) % 300 + 2 for i in range(32)]
+    prompts = [
+        pre + [7, 8, 9],
+        pre + [100, 200],          # full-preamble hit
+        pre[:16] + [5, 5, 5, 5],   # partial (one-block) hit
+        [9, 8, 7],                 # cold, no prefix at all
+        pre + [7, 8, 9],           # identical replay
+    ]
+    outs = {}
+    for name, paged in (("paged", True), ("copy", False)):
+        engine = make_engine(capacity=128, prefill_chunk=32, min_prefix=16,
+                             prefix_cache_mb=64, overlap=overlap,
+                             speculative=speculative, paged_prefix=paged)
+        assert engine.paged_prefix is paged
+        outs[name] = []
+        for p in prompts:
+            req = engine.submit(list(p), max_new_tokens=8)
+            drain(engine, req)
+            outs[name].append(req.all_tokens(timeout=1))
+        assert engine.prefix_hits >= 3
+        stats = engine.stats()
+        if paged:
+            assert stats["prefix_paged_seeds"] >= 3
+            assert stats["prefix_assembles"] == 0
+        else:
+            assert stats["prefix_paged_seeds"] == 0
+            assert stats["prefix_assembles"] >= 3
+        hist = engine.registry.get("serve_prefix_seed_seconds").series_snapshot(
+            path="paged" if paged else "copy"
+        )
+        assert hist is not None and hist["count"] >= 3
+    assert outs["paged"] == outs["copy"]
+
+
+def test_paged_seeding_interpret_kernel_bit_identity(monkeypatch):
+    """The same paged seeding run through the actual pallas gather kernel
+    (interpret mode on CPU) instead of the XLA gather fallback — outputs
+    stay bit-identical to the copy engine (CI's kernels leg pins this)."""
+    pre = [(i * 19) % 300 + 2 for i in range(32)]
+    prompts = [pre + [7, 8, 9], pre + [100, 200], pre + [7, 8, 9]]
+
+    def run(paged):
+        engine = make_engine(capacity=128, prefill_chunk=32, min_prefix=16,
+                             prefix_cache_mb=64, paged_prefix=paged)
+        out = []
+        for p in prompts:
+            req = engine.submit(list(p), max_new_tokens=8)
+            drain(engine, req)
+            out.append(req.all_tokens(timeout=1))
+        return engine, out
+
+    copy_engine, copy_out = run(False)
+    monkeypatch.setenv("PRIME_TPU_PALLAS_INTERPRET", "1")
+    paged_engine, paged_out = run(True)
+    assert paged_engine.stats()["prefix_paged_seeds"] >= 2
+    assert paged_out == copy_out
+
+
+def test_paged_prefix_gating(monkeypatch):
+    """paged_prefix requires a prefix cache and a single device; the
+    PRIME_SERVE_PAGED_PREFIX env knob and the kwarg both gate it off."""
+
+    class _FakeMesh:
+        size = 8
+
+    assert make_engine(prefix_cache_mb=1).paged_prefix is True
+    assert make_engine(prefix_cache_mb=0).paged_prefix is False
+    assert make_engine(prefix_cache_mb=1, mesh=_FakeMesh()).paged_prefix is False
+    assert make_engine(prefix_cache_mb=1, paged_prefix=False).paged_prefix is False
+    monkeypatch.setenv("PRIME_SERVE_PAGED_PREFIX", "0")
+    assert make_engine(prefix_cache_mb=1).paged_prefix is False
+    monkeypatch.delenv("PRIME_SERVE_PAGED_PREFIX")
+    assert make_engine(prefix_cache_mb=1, paged_prefix=True).paged_prefix is True
+
+
 def test_prefix_cache_host_env_wiring(monkeypatch):
     """PRIME_SERVE_PREFIX_CACHE_HOST_MB and the kwarg both reach the cache as
     a host byte budget with the engine's real tier converters installed; the

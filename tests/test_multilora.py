@@ -241,6 +241,56 @@ def test_base_traffic_on_banked_engine_matches_bankless(artifacts):
     banked.shutdown()
 
 
+def test_lora_kernel_engine_bit_identity_vs_einsum(artifacts, monkeypatch):
+    """The fused gathered-LoRA pallas kernel vs the einsum reference chain
+    at ENGINE level: the same mixed-adapter wave with the kernel forced in
+    (interpret mode, CPU) emits bit-identical greedy tokens to the einsum
+    path. Only the LoRA projection is flipped — attention and everything
+    else stay on the exact same code — so any token drift is the kernel's
+    rounding contract breaking."""
+    from prime_tpu.models import llama
+    from prime_tpu.ops import pallas_lora
+
+    adapters = {name: p for name, (p, _, _) in artifacts.items()}
+    wave = [
+        (PROMPT, "tenant-a"),
+        (PROMPT, None),
+        (PROMPT, "tenant-b"),
+        ([7, 8, 9, 10, 11], "tenant-a"),
+    ]
+
+    def run():
+        engine = make_engine(adapters=adapters)
+        reqs = [
+            engine.submit(list(p), max_new_tokens=10, adapter=ad)
+            for p, ad in wave
+        ]
+        drain(engine, *reqs)
+        out = [r.all_tokens(timeout=2) for r in reqs]
+        engine.shutdown()
+        return out
+
+    einsum_out = run()
+
+    calls = []
+    real = pallas_lora.fused_lora_matmul
+
+    def forced(*args, **kw):
+        calls.append(1)
+        kw["interpret"] = True
+        return real(*args, **kw)
+
+    monkeypatch.setattr(llama, "_lora_kernel_eligible", lambda w, x, b: True)
+    monkeypatch.setattr(pallas_lora, "fused_lora_matmul", forced)
+    jax.clear_caches()  # the gate is trace-time: force a re-trace
+    try:
+        kernel_out = run()
+    finally:
+        jax.clear_caches()  # don't leak kernel-path traces past the patch
+    assert calls, "the fused kernel never dispatched"
+    assert kernel_out == einsum_out
+
+
 # ---- prefix-cache isolation --------------------------------------------------
 
 

@@ -431,8 +431,9 @@ def test_engine_histograms_populate_through_streamed_completion():
             # pipeline fields (PR 2), the radix prefix-cache fields (PR 3),
             # the fleet admission/drain fields (PR 4), the host spill
             # tier fields (PR 6), the sharded-replica mesh fields, the
-            # speculative-decoding fields, and the disaggregated-serving KV
-            # export/import counters — additive only
+            # speculative-decoding fields, the disaggregated-serving KV
+            # export/import counters, and the paged-seeding counter —
+            # additive only
             engine_stats = httpx.get(f"{srv.url}/metrics").json()["engine"]
             assert set(engine_stats) == {
                 "requests_admitted", "requests_completed", "requests_cancelled",
@@ -446,7 +447,7 @@ def test_engine_histograms_populate_through_streamed_completion():
                 "prefix_cache_bytes", "prefix_cache_host_bytes",
                 "prefix_host_tier_disabled",
                 "prefix_cache_nodes", "prefix_evictions", "prefix_spills",
-                "prefix_reuploads", "prefix_assembles",
+                "prefix_reuploads", "prefix_assembles", "prefix_paged_seeds",
                 "kv_exports", "kv_imports", "uptime_s",
             }
             assert engine_stats["requests_admitted"] == 1
