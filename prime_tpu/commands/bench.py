@@ -74,6 +74,67 @@ def bench_smoke(
         raise SystemExit(1)
 
 
+@bench_group.command("sentinel")
+@click.option("--root", default=".", help="Directory holding BENCH_*.json.")
+@click.option("--report", default=None, type=click.Path(exists=True),
+              help="Fresh loadgen SLO report (slo_report.json) appended as "
+                   "the candidate round — what CI gates before a record is "
+                   "committed.")
+@click.option("--band-pct", type=float, default=None,
+              help="Regression band in percent (default: "
+                   "PRIME_SENTINEL_BAND_PCT, 50).")
+@click.option("--min-history", type=int, default=None,
+              help="Prior rounds a metric needs before it gates (default: "
+                   "PRIME_SENTINEL_MIN_HISTORY, 3).")
+@click.option("--all-metrics", is_flag=True,
+              help="Gate every delta-table row instead of the curated "
+                   "headline set (CPU-smoke latency percentiles are noisy; "
+                   "see docs/observability.md).")
+@click.option("--output", "as_json", is_flag=False, flag_value="json", default=None,
+              help="Set to 'json' for machine-readable output.")
+def bench_sentinel(
+    root: str, report: str | None, band_pct: float | None,
+    min_history: int | None, all_metrics: bool, as_json: str | None,
+) -> None:
+    """Gate the perf trajectory: exit nonzero when the newest round (or a
+    fresh --report) regresses beyond the configured bands. Same
+    implementation as the delta table's `sentinel verdict` row
+    (obs/sentinel.trajectory_verdicts) — stdlib-only, no jax."""
+    from prime_tpu.loadgen.perf_delta import load_all_rounds, round_from_report
+    from prime_tpu.obs.sentinel import trajectory_gate
+
+    rounds: list = list(load_all_rounds(root))
+    if report is not None:
+        with open(report) as fh:
+            rounds.append(round_from_report(json.load(fh), label="candidate"))
+    gate = trajectory_gate(
+        rounds,
+        band_pct=band_pct,
+        min_history=min_history,
+        gate_metrics="all" if all_metrics else None,
+    )
+    if as_json == "json":
+        click.echo(json.dumps(gate, indent=2))
+    else:
+        for verdict in gate["verdicts"]:
+            line = f"{verdict['label']:<24} {verdict['verdict']}"
+            if verdict["checked"]:
+                line += f" ({verdict['checked']} gated metrics)"
+            click.echo(line)
+            for reg in verdict["regressions"]:
+                click.echo(
+                    f"    {reg['metric']}: {reg['value']:g} vs baseline "
+                    f"{reg['baseline']:g} ({reg['delta_pct']:+.1f}%)"
+                )
+        latest = gate["latest"]
+        click.echo(
+            "sentinel: "
+            + ("no rounds" if latest is None else f"latest={latest['label']} verdict={latest['verdict']}")
+        )
+    if not gate["ok"]:
+        raise SystemExit(1)
+
+
 @bench_group.command("autotune")
 @click.option("--kernel", "kernels", multiple=True,
               help="Restrict the sweep to named kernels (repeatable; "

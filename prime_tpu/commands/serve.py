@@ -594,6 +594,16 @@ def _render_observatory_view(render: "Renderer", view: dict) -> None:
             f"{_fmt(fast.get('burn'), 2)}x fast / {_fmt(slow.get('burn'), 2)}x slow "
             f"(objective {_fmt(verdict.get('objective'))})"
         )
+    incidents = view.get("incidents") or {}
+    if incidents.get("total"):
+        click.echo(f"incidents: {incidents.get('total')} recorded")
+        for row in (incidents.get("recent") or [])[:3]:
+            click.echo(
+                f"  INCIDENT {row.get('id')} {row.get('rule')} "
+                f"[{row.get('severity')}] scope={row.get('scope')} "
+                f"value={_fmt(row.get('value'))} "
+                f"baseline={_fmt(row.get('baseline'))}"
+            )
     windows = view.get("fleet") or view.get("serving") or {}
     window_rows = [
         [
@@ -762,6 +772,85 @@ def serve_profile_cmd(
             "no replica returned a capture (was any traffic flowing, and "
             "was a capture already stopped?)"
         )
+
+
+@serve_cmd.command(name="incidents")
+@click.option(
+    "--url", default="http://127.0.0.1:8080", show_default=True,
+    help="Base URL of a `prime serve fleet` router (merged fleet view) or "
+         "a single `prime serve` replica.",
+)
+@click.option(
+    "--id", "incident_id", default=None,
+    help="Fetch one incident bundle (full forensics: flight timelines, "
+         "registry deltas, journal tail) instead of the summary list.",
+)
+@click.option(
+    "--admin-token", default=None, envvar="PRIME_FLEET_ADMIN_TOKEN",
+    help="Bearer token when the target gates /admin/incidents.",
+)
+@output_options
+def serve_incidents_cmd(
+    render: "Renderer",
+    url: str,
+    incident_id: str | None,
+    admin_token: str | None,
+) -> None:
+    """Sentinel incidents: GET /admin/incidents[/{id}] rendered as a table
+    (or the full bundle JSON with --id / --output json). See
+    docs/observability.md "Sentinel & incidents"."""
+    import httpx
+
+    base = url.rstrip("/")
+    headers = {"Authorization": f"Bearer {admin_token}"} if admin_token else None
+    path = f"/admin/incidents/{incident_id}" if incident_id else "/admin/incidents"
+    try:
+        response = httpx.get(f"{base}{path}", headers=headers, timeout=10)
+    except httpx.HTTPError as e:
+        raise click.ClickException(f"could not reach {base}{path}: {e}") from None
+    if response.status_code == 403:
+        raise click.ClickException(
+            f"{base}{path} requires an admin token "
+            "(--admin-token / PRIME_FLEET_ADMIN_TOKEN)"
+        )
+    if response.status_code == 404:
+        raise click.ClickException(f"no incident {incident_id!r} at {base}")
+    response.raise_for_status()
+    try:
+        payload = response.json()
+    except ValueError as e:
+        raise click.ClickException(f"{base}{path} returned non-JSON: {e}") from None
+    if render.is_json or incident_id:
+        render.json(payload)
+        return
+    # fleet shape ({"router": [...], "replicas": {id: {...}}}) and
+    # single-replica shape ({"incidents": [...]}) both flatten to one table
+    rows = []
+    for scope, summaries in [("router", payload.get("router"))] + [
+        (rid, (entry or {}).get("incidents"))
+        for rid, entry in (payload.get("replicas") or {}).items()
+    ] + [("", payload.get("incidents"))]:
+        for row in summaries or []:
+            rows.append(
+                [
+                    row.get("id", "?"),
+                    scope or row.get("scope", "?"),
+                    row.get("rule", "?"),
+                    row.get("severity", "?"),
+                    _fmt(row.get("value")),
+                    _fmt(row.get("baseline")),
+                    _fmt(row.get("ratio"), 2),
+                    row.get("flights", 0),
+                ]
+            )
+    render.table(
+        ["id", "scope", "rule", "severity", "value", "baseline", "ratio",
+         "flights"],
+        rows,
+        title="Incidents",
+    )
+    if not rows:
+        click.echo("no incidents recorded")
 
 
 def _render_profile_summary(

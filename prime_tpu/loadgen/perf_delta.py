@@ -351,6 +351,19 @@ def _multichip_round(path: str, record: dict[str, Any]) -> Round:
     )
 
 
+def round_from_report(report: dict[str, Any], *, label: str = "candidate") -> Round:
+    """A synthetic Round from a fresh loadgen SLO report (smoke's
+    slo_report.json) — what `prime bench sentinel --report` appends as the
+    gate's candidate round before any record is committed. Carries the same
+    "loadgen tok/s" + "slo:" rows a committed schema-2 record would, so the
+    candidate gates against exactly the history those rows accumulated."""
+    metrics = _slo_metrics(report if isinstance(report, dict) else {})
+    return Round(
+        label=label, path="<report>", order=(float("inf"), label),
+        schema=2, record={"loadgen": report}, metrics=metrics,
+    )
+
+
 def load_rounds(root: str = ".", pattern: str = "BENCH_*.json") -> list[Round]:
     """Every parseable committed round under ``root``, oldest first.
     Unparseable files are skipped (a half-written record must not take the
@@ -401,7 +414,7 @@ def delta_table(rounds: list[Round], *, min_rounds: int = 2) -> str:
                 metric_names.append(name)
     if not metric_names:
         return "no numeric metrics found in any round"
-    label_w = max(len(n) for n in metric_names) + 2
+    label_w = max(len(n) for n in metric_names + ["sentinel verdict"]) + 2
     headers = [
         r.label + (f" (s{r.schema})" if r.schema == 1 else "") for r in rounds
     ]
@@ -424,6 +437,14 @@ def delta_table(rounds: list[Round], *, min_rounds: int = 2) -> str:
             cells.append(f"{cell:>{col_w}}")
             prev = value
         lines.append("".join(cells))
+    # sentinel verdict row: same implementation as the `prime bench
+    # sentinel` CI gate (obs/sentinel.trajectory_verdicts), so the table a
+    # human reads and the exit code CI trusts can never disagree
+    for verdict in _sentinel_rows(rounds):
+        cells = [f"{'sentinel verdict':<{label_w}}"]
+        for cell in verdict:
+            cells.append(f"{cell:>{col_w}}")
+        lines.append("".join(cells))
     notes = [
         f"{r.label}: {r.error}" for r in rounds if r.error
     ]
@@ -434,8 +455,40 @@ def delta_table(rounds: list[Round], *, min_rounds: int = 2) -> str:
     return "\n".join(lines)
 
 
+def _sentinel_verdicts(rounds: list[Round]) -> list[dict[str, Any]]:
+    """Per-round sentinel verdicts, or [] when the sentinel can't run
+    (import trouble must not take the delta table down)."""
+    try:
+        from prime_tpu.obs.sentinel import trajectory_verdicts
+    except Exception:  # noqa: BLE001 — the table renders without the row
+        return []
+    try:
+        return trajectory_verdicts(rounds)
+    except Exception:  # noqa: BLE001
+        return []
+
+
+def _sentinel_rows(rounds: list[Round]) -> list[list[str]]:
+    """The `sentinel verdict` table row (one cell per round) as a
+    single-row list, or [] when verdicts are unavailable."""
+    verdicts = _sentinel_verdicts(rounds)
+    if not verdicts:
+        return []
+    cells = []
+    for v in verdicts:
+        if v["verdict"] == "regressed":
+            cells.append(f"REGRESSED({len(v['regressions'])})")
+        elif v["verdict"] == "ok":
+            cells.append("ok")
+        else:
+            cells.append("no-history")
+    return [cells]
+
+
 def delta_json(rounds: list[Round]) -> dict[str, Any]:
     """Machine form of the same table (CI step summaries, dashboards)."""
+    verdicts = _sentinel_verdicts(rounds)
+    by_label: dict[str, dict[str, Any]] = {v["label"]: v for v in verdicts}
     return {
         "rounds": [
             {
@@ -444,6 +497,7 @@ def delta_json(rounds: list[Round]) -> dict[str, Any]:
                 "schema": r.schema,
                 "error": r.error,
                 "metrics": r.metrics,
+                "sentinel": by_label.get(r.label),
             }
             for r in rounds
         ]

@@ -513,6 +513,126 @@ def _elastic_section(
             handle.terminate()
 
 
+def _sentinel_section(config, params_fn, *, seed: int, log) -> dict[str, Any]:
+    """The sentinel's false-positive AND true-positive gate in one leg
+    (docs/observability.md "Sentinel & incidents"): a clean in-process
+    server driven with steady traffic must raise ZERO incidents, then the
+    same traffic against an engine with an env-injected dispatch delay
+    (``PRIME_SENTINEL_INJECT_MS``, armed to activate only after the clean
+    run's measured dispatch count — a genuine mid-run change-point) must
+    raise EXACTLY ONE incident whose bundle carries flight timelines and
+    registry deltas, fetchable over ``GET /admin/incidents``. Record keys
+    ``serve_sentinel_*``."""
+    import time
+
+    import httpx
+
+    from prime_tpu.loadgen.backends import NumericTokenizer
+    from prime_tpu.obs.sentinel import Sentinel, SentinelRule
+    from prime_tpu.serve.engine import ContinuousBatchingEngine, EngineBackend
+    from prime_tpu.serve.server import InferenceServer
+
+    # smoke-scale rule: tiny windows a seconds-long leg actually covers
+    # (production defaults need minutes of history), fast p95 vs slow
+    # MEDIAN so the slow window absorbing the regression's own samples
+    # doesn't erase the change-point, and a 20 ms absolute deadband so
+    # clean-run CPU timing jitter can't fire it (steps run ~1-5 ms/token;
+    # the planted 120 ms/dispatch delay lands ~30 ms/token)
+    rule = SentinelRule(
+        name="step_clock_regression", kind="quantile_regression",
+        metric="serve_decode_step_seconds", severity="critical",
+        q=0.95, baseline_q=0.5, ratio=3.0, min_value=0.02,
+    )
+    prompt = " ".join(["7"] * 12)
+
+    def _launch(inject: str | None):
+        saved = os.environ.pop("PRIME_SENTINEL_INJECT_MS", None)
+        if inject is not None:
+            os.environ["PRIME_SENTINEL_INJECT_MS"] = inject
+        try:
+            engine = ContinuousBatchingEngine(
+                params_fn(), config, pad_id=0, max_slots=4, capacity=128,
+                chunk=4, prefix_cache_mb=8, max_queue=16,
+            )
+        finally:
+            if saved is None:
+                os.environ.pop("PRIME_SENTINEL_INJECT_MS", None)
+            else:
+                os.environ["PRIME_SENTINEL_INJECT_MS"] = saved
+        engine.start()
+        srv = InferenceServer(
+            "loadgen-smoke", EngineBackend(engine, NumericTokenizer()), port=0
+        ).start()
+        srv.sentinel = Sentinel((rule,), fast_s=1.0, slow_s=3.2, min_samples=3)
+        return engine, srv
+
+    def _drive(srv, n: int, *, pause_s: float, stop_on_incident: bool) -> None:
+        for _ in range(n):
+            httpx.post(
+                f"{srv.url}/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": prompt}],
+                      "max_tokens": 8, "temperature": 0.0},
+                timeout=120.0,
+            ).raise_for_status()
+            srv.observatory_sample()
+            if stop_on_incident and len(srv.incidents):
+                return
+            if pause_s:
+                time.sleep(pause_s)
+
+    # ---- clean phase: steady traffic, zero incidents ----------------------
+    engine, srv = _launch(None)
+    try:
+        _drive(srv, 14, pause_s=0.14, stop_on_incident=False)
+        clean_incidents = len(srv.incidents)
+        # the planted run replays this exact request sequence, so this
+        # engine's dispatch count is where its delay should switch on
+        clean_dispatches = int(getattr(engine, "_dispatch_count", 0))
+    finally:
+        srv.stop()
+
+    # ---- planted phase: same traffic, delay arms mid-run ------------------
+    engine, srv = _launch(f"120@{max(1, clean_dispatches)}")
+    bundle: dict[str, Any] = {}
+    listing: dict[str, Any] = {}
+    try:
+        _drive(srv, 14, pause_s=0.14, stop_on_incident=True)  # clean baseline
+        deadline = time.monotonic() + 20.0
+        while not len(srv.incidents) and time.monotonic() < deadline:
+            _drive(srv, 4, pause_s=0.0, stop_on_incident=True)
+        planted_incidents = len(srv.incidents)
+        if planted_incidents:
+            # the bundle must round-trip over the admin surface, not just
+            # the in-process store
+            listing = httpx.get(f"{srv.url}/admin/incidents", timeout=10).json()
+            first = (listing.get("incidents") or [{}])[0]
+            bundle = httpx.get(
+                f"{srv.url}/admin/incidents/{first.get('id')}", timeout=10
+            ).json()
+    finally:
+        srv.stop()
+
+    bundle_ok = bool(bundle.get("flights")) and bool(bundle.get("metrics"))
+    record: dict[str, Any] = {
+        "serve_sentinel_clean_incidents": clean_incidents,
+        "serve_sentinel_planted_incidents": planted_incidents,
+        "serve_sentinel_bundle_flights": len(bundle.get("flights") or ()),
+        "serve_sentinel_bundle_metrics": len(bundle.get("metrics") or ()),
+    }
+    if clean_incidents != 0 or planted_incidents != 1 or not bundle_ok:
+        record["serve_sentinel_error"] = (
+            f"sentinel leg off-contract: clean={clean_incidents} (want 0) "
+            f"planted={planted_incidents} (want 1) bundle_ok={bundle_ok}"
+        )
+    log(
+        f"# loadgen-smoke: sentinel clean={clean_incidents} incidents, "
+        f"planted={planted_incidents} (rule={bundle.get('rule')}, "
+        f"{record['serve_sentinel_bundle_flights']} flight timelines, "
+        f"{record['serve_sentinel_bundle_metrics']} registry deltas)"
+    )
+    return record
+
+
 def disagg_comparison(
     config,
     params_fn,
@@ -1102,6 +1222,27 @@ def run_smoke(
                 }
                 log(f"# loadgen-smoke: disagg section failed: {e}")
 
+        # sentinel section (clean run quiet / planted env-injected dispatch
+        # delay raises exactly one incident with a complete bundle): record
+        # keys serve_sentinel_*. Skipped under --mesh like the sections
+        # above — its two extra engines would contend for the forced
+        # device set.
+        sentinel_record: dict[str, Any] = {}
+        if not mesh:
+            try:
+                sentinel_record = _sentinel_section(
+                    config,
+                    lambda: init_params(
+                        jax.random.PRNGKey(0), config, dtype=jnp.float32
+                    ),
+                    seed=seed, log=log,
+                )
+            except Exception as e:  # noqa: BLE001 — the headline gate must survive
+                sentinel_record = {
+                    "serve_sentinel_error": f"{type(e).__name__}: {e}"[:200]
+                }
+                log(f"# loadgen-smoke: sentinel section failed: {e}")
+
         # exposition lint, pinned to the documented catalog: every /metrics
         # surface the smoke stood up must be well-formed AND in-contract
         doc_path = os.path.join(
@@ -1142,6 +1283,7 @@ def run_smoke(
             **multilora_record,
             **elastic_record,
             **disagg_record,
+            **sentinel_record,
             "loadgen": report,
         }
         with open(os.path.join(output_dir, "slo_report.json"), "w") as f:

@@ -1,0 +1,593 @@
+"""Deterministic regression sentinel: change-point + baseline-band rules
+over :class:`~prime_tpu.obs.timeseries.SnapshotRing` captures and over the
+committed BENCH trajectory.
+
+Two halves, one contract:
+
+* the **live** half (`Sentinel.observe`) runs each observe cycle over the
+  same snapshot rings the observatory and SLO evaluator already read — the
+  decision core is a pure function of ring contents (timestamps come from
+  the snapshots' own ``captured_at`` stamps), so the same capture replayed
+  through :func:`replay` yields byte-identical detections, exactly like the
+  PR 13 SLO replay and the PR 15 autoscaler sim;
+* the **trajectory** half (:func:`trajectory_verdicts` /
+  :func:`trajectory_gate`) runs the same banded-regression idea over
+  committed ``BENCH_*``/``MULTICHIP_*`` rounds (``perf_delta.load_all_rounds``
+  shapes) so the delta table's ``sentinel verdict`` row and the
+  ``prime bench sentinel`` CI gate agree on one implementation.
+
+Stdlib + obs only: this module must import without jax (perf_delta and the
+CLI load it on dev laptops and CI runners with no accelerator stack).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from statistics import median
+from typing import Any, Iterable, Mapping, Sequence
+
+from prime_tpu.obs.metrics import scalar_from_snapshot
+from prime_tpu.obs.timeseries import SnapshotRing, snapshot_captured_at
+from prime_tpu.utils.env import env_float, env_int
+
+# mirror obs/slo.py: a window only counts when the ring actually covers at
+# least this fraction of it — a freshly started replica must not compare a
+# 2-second "slow window" against a 2-second "fast window" and call it drift
+MIN_SPAN_FRACTION = 0.5
+
+DEFAULT_FAST_WINDOW_S = 30.0
+DEFAULT_SLOW_WINDOW_S = 300.0
+DEFAULT_CHANGE_RATIO = 1.6
+DEFAULT_MIN_SAMPLES = 12
+DEFAULT_BAND_PCT = 50.0
+DEFAULT_MIN_HISTORY = 3
+
+
+def fast_window_default() -> float:
+    return max(0.1, env_float("PRIME_SENTINEL_FAST_S", DEFAULT_FAST_WINDOW_S))
+
+
+def slow_window_default() -> float:
+    return max(0.2, env_float("PRIME_SENTINEL_SLOW_S", DEFAULT_SLOW_WINDOW_S))
+
+
+def change_ratio_default() -> float:
+    return max(1.05, env_float("PRIME_SENTINEL_CHANGE_RATIO", DEFAULT_CHANGE_RATIO))
+
+
+def min_samples_default() -> int:
+    return max(1, env_int("PRIME_SENTINEL_MIN_SAMPLES", DEFAULT_MIN_SAMPLES))
+
+
+def band_pct_default() -> float:
+    return max(1.0, env_float("PRIME_SENTINEL_BAND_PCT", DEFAULT_BAND_PCT))
+
+
+def min_history_default() -> int:
+    return max(1, env_int("PRIME_SENTINEL_MIN_HISTORY", DEFAULT_MIN_HISTORY))
+
+
+# ---- rules ------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SentinelRule:
+    """One detection rule. ``kind`` picks the comparison:
+
+    * ``quantile_regression`` — windowed histogram quantile: fires when the
+      fast window's q-quantile exceeds the slow window's by ``ratio``×
+      (change-point on a latency stream: step clock, TTFT, TPOT);
+    * ``rate_collapse`` — windowed counter rate: fires when the fast rate
+      drops below the slow rate divided by ``ratio`` (throughput cliff);
+    * ``gauge_collapse`` — windowed gauge mean: same shape for sampled
+      gauges (speculative accept ratio, MFU);
+    * ``ratio_collapse`` — counter-delta share ``metric``/``denominator``
+      compared fast vs slow (prefix-hit rate, paged-seed share);
+    * ``gauge_shift`` — baseline-band on a configuration gauge: fires when
+      the value at the slow window's end differs from its start (the
+      kernel-config source gauge leaving its autotune-registry era).
+
+    ``floor`` arms the collapse rules only when the slow-window baseline is
+    itself above the floor — an idle stream reading 0 -> 0 is not a cliff.
+    ``baseline_q`` lets a quantile rule compare the fast window's ``q``
+    against a different slow-window quantile (fast p95 vs slow p50 keeps
+    the baseline robust while the slow window absorbs the regression's own
+    samples). ``min_value`` is an absolute floor on the triggering value —
+    a deadband against timing jitter on near-zero latencies.
+    """
+
+    name: str
+    kind: str
+    metric: str
+    severity: str = "warn"
+    q: float = 0.95
+    baseline_q: float | None = None
+    labels: tuple[tuple[str, str], ...] = ()
+    ratio: float | None = None
+    min_samples: int | None = None
+    denominator: str = ""
+    floor: float = 0.0
+    min_value: float = 0.0
+
+    def label_map(self) -> dict[str, str] | None:
+        return dict(self.labels) if self.labels else None
+
+
+def default_rules() -> tuple[SentinelRule, ...]:
+    """The shipped rule catalog (docs/observability.md "Sentinel &
+    incidents"). Rules whose families a ring never carried stay silent —
+    one catalog serves engine rings, the server's observatory ring, and the
+    router's per-replica rings alike."""
+    return (
+        SentinelRule(
+            name="step_clock_regression",
+            kind="quantile_regression",
+            metric="serve_decode_step_seconds",
+            severity="critical",
+        ),
+        SentinelRule(
+            name="ttft_regression",
+            kind="quantile_regression",
+            metric="serve_ttft_seconds",
+            severity="warn",
+        ),
+        SentinelRule(
+            name="tpot_regression",
+            kind="quantile_regression",
+            metric="serve_tpot_seconds",
+            severity="critical",
+        ),
+        SentinelRule(
+            name="token_rate_collapse",
+            kind="rate_collapse",
+            metric="serve_tokens_emitted_total",
+            severity="warn",
+            floor=1.0,
+        ),
+        SentinelRule(
+            name="accept_ratio_collapse",
+            kind="gauge_collapse",
+            metric="serve_spec_accept_ratio",
+            severity="warn",
+            floor=0.05,
+        ),
+        SentinelRule(
+            name="mfu_collapse",
+            kind="gauge_collapse",
+            metric="serve_mfu_ratio",
+            labels=(("phase", "decode"),),
+            severity="warn",
+            floor=0.01,
+        ),
+        SentinelRule(
+            name="prefix_hit_collapse",
+            kind="ratio_collapse",
+            metric="serve_prefix_hits_total",
+            denominator="serve_requests_admitted_total",
+            severity="warn",
+            floor=0.2,
+        ),
+        SentinelRule(
+            name="seed_path_shift",
+            kind="ratio_collapse",
+            metric="serve_prefix_paged_seeds_total",
+            denominator="serve_prefix_hits_total",
+            severity="warn",
+            floor=0.5,
+        ),
+        SentinelRule(
+            name="kernel_config_shift",
+            kind="gauge_shift",
+            metric="serve_kernel_config_source",
+            severity="warn",
+        ),
+    )
+
+
+@dataclass(frozen=True)
+class Detection:
+    """One rule firing on one scope. ``id`` is a content hash — no clock,
+    no RNG — so replays of the same capture mint identical ids."""
+
+    id: str
+    rule: str
+    severity: str
+    scope: str
+    metric: str
+    value: float
+    baseline: float
+    ratio: float
+    windows: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "rule": self.rule,
+            "severity": self.severity,
+            "scope": self.scope,
+            "metric": self.metric,
+            "value": self.value,
+            "baseline": self.baseline,
+            "ratio": self.ratio,
+            "windows": dict(self.windows),
+        }
+
+
+def _detection_id(rule: str, scope: str, end_at: float, value: float, baseline: float) -> str:
+    payload = f"{rule}|{scope}|{end_at:.3f}|{value:.9g}|{baseline:.9g}"
+    return hashlib.sha256(payload.encode()).hexdigest()[:12]
+
+
+def _windows_covered(ring: SnapshotRing, fast_s: float, slow_s: float) -> bool:
+    fast_span = ring.span_s(fast_s)
+    slow_span = ring.span_s(slow_s)
+    if fast_span is None or slow_span is None:
+        return False
+    return (
+        fast_span >= MIN_SPAN_FRACTION * fast_s
+        and slow_span >= MIN_SPAN_FRACTION * slow_s
+        # the slow window must actually extend past the fast one, or the
+        # "baseline" is just the fast window under another name
+        and slow_span > fast_span
+    )
+
+
+def _window_end_at(ring: SnapshotRing) -> float:
+    latest = ring.latest()
+    at = snapshot_captured_at(latest) if latest else None
+    return float(at) if at is not None else 0.0
+
+
+def evaluate_rule(
+    ring: SnapshotRing,
+    rule: SentinelRule,
+    *,
+    scope: str,
+    fast_s: float,
+    slow_s: float,
+    change_ratio: float,
+    min_samples: int,
+) -> Detection | None:
+    """Pure decision core: reads only ring contents (snapshot values and
+    their ``captured_at`` stamps). Returns a :class:`Detection` when the
+    rule's condition holds right now, else None."""
+    if not _windows_covered(ring, fast_s, slow_s):
+        return None
+    ratio = rule.ratio if rule.ratio is not None else change_ratio
+    need = rule.min_samples if rule.min_samples is not None else min_samples
+    labels = rule.label_map()
+    windows = {"fast_s": fast_s, "slow_s": slow_s, "end_at": _window_end_at(ring)}
+
+    value = baseline = None
+    if rule.kind == "quantile_regression":
+        fast_hist = ring.hist_window(rule.metric, fast_s, labels)
+        if fast_hist is None or fast_hist.get("count", 0) < need:
+            return None
+        fast_q = ring.quantile(rule.metric, rule.q, fast_s, labels)
+        slow_q = ring.quantile(
+            rule.metric,
+            rule.baseline_q if rule.baseline_q is not None else rule.q,
+            slow_s,
+            labels,
+        )
+        if fast_q is None or slow_q is None or slow_q <= 0:
+            return None
+        if fast_q < max(slow_q * ratio, rule.min_value):
+            return None
+        value, baseline = fast_q, slow_q
+    elif rule.kind == "rate_collapse":
+        fast_r = ring.rate(rule.metric, fast_s, labels)
+        slow_r = ring.rate(rule.metric, slow_s, labels)
+        if fast_r is None or slow_r is None or slow_r < max(rule.floor, 1e-9):
+            return None
+        if fast_r > slow_r / ratio:
+            return None
+        value, baseline = fast_r, slow_r
+    elif rule.kind == "gauge_collapse":
+        fast_m = ring.gauge_mean(rule.metric, fast_s, labels)
+        slow_m = ring.gauge_mean(rule.metric, slow_s, labels)
+        if fast_m is None or slow_m is None or slow_m < max(rule.floor, 1e-9):
+            return None
+        if fast_m > slow_m / ratio:
+            return None
+        value, baseline = fast_m, slow_m
+    elif rule.kind == "ratio_collapse":
+        fast_den = ring.delta_sum(rule.denominator, fast_s)
+        slow_den = ring.delta_sum(rule.denominator, slow_s)
+        if not fast_den or not slow_den or fast_den < need or slow_den < need:
+            return None
+        fast_share = (ring.delta_sum(rule.metric, fast_s) or 0.0) / fast_den
+        slow_share = (ring.delta_sum(rule.metric, slow_s) or 0.0) / slow_den
+        if slow_share < max(rule.floor, 1e-9):
+            return None
+        if fast_share > slow_share / ratio:
+            return None
+        value, baseline = fast_share, slow_share
+    elif rule.kind == "gauge_shift":
+        pair = ring.window(slow_s)
+        if pair is None:
+            return None
+        before, after = pair
+        b = scalar_from_snapshot(before, rule.metric, labels)
+        a = scalar_from_snapshot(after, rule.metric, labels)
+        if rule.metric not in before or rule.metric not in after:
+            return None
+        if a == b:
+            return None
+        value, baseline = a, b
+    else:  # unknown kind: a typo'd custom rule must not crash the loop
+        return None
+
+    return Detection(
+        id=_detection_id(rule.name, scope, windows["end_at"], value, baseline),
+        rule=rule.name,
+        severity=rule.severity,
+        scope=scope,
+        metric=rule.metric,
+        value=float(value),
+        baseline=float(baseline),
+        ratio=float(value / baseline) if baseline else 0.0,
+        windows=windows,
+    )
+
+
+class Sentinel:
+    """Edge-triggered detector over one or more snapshot rings.
+
+    ``observe`` evaluates every rule against every scope and returns only
+    the *new* detections — a rule+scope that keeps breaching stays latched
+    (one sustained regression is one incident, not one per observe tick)
+    and re-arms as soon as its condition clears. All state lives in the
+    latch set; the decision itself is a pure function of ring contents."""
+
+    def __init__(
+        self,
+        rules: Sequence[SentinelRule] | None = None,
+        *,
+        fast_s: float | None = None,
+        slow_s: float | None = None,
+        change_ratio: float | None = None,
+        min_samples: int | None = None,
+    ) -> None:
+        self.rules = tuple(rules) if rules is not None else default_rules()
+        self.fast_s = fast_s if fast_s is not None else fast_window_default()
+        self.slow_s = slow_s if slow_s is not None else slow_window_default()
+        self.change_ratio = (
+            change_ratio if change_ratio is not None else change_ratio_default()
+        )
+        self.min_samples = (
+            min_samples if min_samples is not None else min_samples_default()
+        )
+        self._active: set[tuple[str, str]] = set()
+        self.detections_total = 0
+
+    def active(self) -> list[tuple[str, str]]:
+        return sorted(self._active)
+
+    def observe(self, rings: Mapping[str, SnapshotRing]) -> list[Detection]:
+        """One observe cycle: scopes in sorted order, rules in catalog
+        order — deterministic output ordering for a deterministic input."""
+        new: list[Detection] = []
+        for scope in sorted(rings):
+            ring = rings[scope]
+            for rule in self.rules:
+                det = evaluate_rule(
+                    ring,
+                    rule,
+                    scope=scope,
+                    fast_s=self.fast_s,
+                    slow_s=self.slow_s,
+                    change_ratio=self.change_ratio,
+                    min_samples=self.min_samples,
+                )
+                key = (rule.name, scope)
+                if det is not None:
+                    if key not in self._active:
+                        self._active.add(key)
+                        new.append(det)
+                else:
+                    self._active.discard(key)
+        self.detections_total += len(new)
+        return new
+
+
+def replay(
+    snapshot_sequences: Mapping[str, Sequence[Mapping[str, Any]]],
+    *,
+    rules: Sequence[SentinelRule] | None = None,
+    fast_s: float = DEFAULT_FAST_WINDOW_S,
+    slow_s: float = DEFAULT_SLOW_WINDOW_S,
+    change_ratio: float = DEFAULT_CHANGE_RATIO,
+    min_samples: int = DEFAULT_MIN_SAMPLES,
+) -> list[list[dict[str, Any]]]:
+    """The deterministic sim (same shape as ``obs.slo.replay``): feed
+    per-scope snapshot sequences through fresh rings step by step, running
+    the sentinel after every step. Returns each step's new detections as
+    dicts; identical captures produce byte-identical detection lists
+    (pinned by test via ``json.dumps`` equality)."""
+    sentinel = Sentinel(
+        rules,
+        fast_s=fast_s,
+        slow_s=slow_s,
+        change_ratio=change_ratio,
+        min_samples=min_samples,
+    )
+    rings = {name: SnapshotRing() for name in snapshot_sequences}
+    steps = max((len(seq) for seq in snapshot_sequences.values()), default=0)
+    out: list[list[dict[str, Any]]] = []
+    for step in range(steps):
+        for name, seq in snapshot_sequences.items():
+            if step < len(seq):
+                rings[name].append(seq[step])
+        out.append([det.to_dict() for det in sentinel.observe(rings)])
+    return out
+
+
+def replay_digest(steps: Iterable[Iterable[Mapping[str, Any]]]) -> str:
+    """Stable digest of a replay's full detection stream — what the
+    byte-identity pin and the incident-forensics docs mean by "the same
+    capture detects identically"."""
+    blob = json.dumps([list(step) for step in steps], sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+# ---- trajectory gate (committed BENCH rounds + fresh loadgen reports) -------
+
+# metric-name direction: delta-table rows are rates/ratios (bigger is
+# better) except latency/wall-time rows. Structural counts below are
+# excluded from gating outright — "elastic scale ups went from 2 to 1" is a
+# scenario-shape change, not a perf regression.
+_SMALLER_BETTER_NAMES = frozenset({"autotune sweep s", "dp:compile s", "dp:compiles"})
+_UNGATED_NAMES = frozenset(
+    {
+        "autotune kernels",
+        "elastic peak replicas",
+        "elastic scale ups",
+        "elastic scale downs",
+        "disagg migrate bytes",
+    }
+)
+
+# the default gate covers the headline throughput/ratio rows the ROADMAP
+# actually targets. Per-scenario latency percentiles from CPU smoke rounds
+# are wall-clock-noise-dominated (a loaded CI runner triples a TTFT p95
+# without any code regressing — the committed trajectory demonstrates it),
+# so they only gate when explicitly opted in (``gate_metrics="all"`` /
+# ``prime bench sentinel --all-metrics``).
+DEFAULT_GATE_METRICS = frozenset(
+    {
+        "headline tok/s",
+        "decode-only tok/s",
+        "eval samples/s",
+        "trainstep tok/s",
+        "loadgen tok/s",
+        "serve tok/s",
+        "serve overlap ratio",
+        "fleet tok/s",
+        "serve spec accept ratio",
+        "serve spec speedup",
+        "prefixburst hit ratio",
+        "int8 tok/s",
+        "int4 tok/s",
+        "sharded tok/s",
+        "longctx pallas speedup",
+    }
+)
+
+
+def smaller_is_better(name: str) -> bool:
+    return (
+        name.endswith(" ms")
+        or name in _SMALLER_BETTER_NAMES
+        or "ttft" in name
+        or "tpot" in name
+    )
+
+
+def trajectory_verdicts(
+    rounds: Sequence[Any],
+    *,
+    band_pct: float | None = None,
+    min_history: int | None = None,
+    gate_metrics: Iterable[str] | str | None = None,
+) -> list[dict[str, Any]]:
+    """Banded-regression verdict per round, in round order. ``rounds`` are
+    ``perf_delta.Round``-shaped (``.label`` + ``.metrics``; plain dicts
+    with those keys work too). Each metric is compared against the median
+    of its own prior values once at least ``min_history`` rounds carried
+    it — the median keeps one dead round from poisoning the baseline, the
+    same reason perf_delta deltas against the latest *usable* value.
+
+    Verdicts: ``regressed`` (any gated metric moved beyond ``band_pct`` in
+    its bad direction), ``ok`` (at least one metric compared, none
+    regressed), ``insufficient-history`` (nothing had enough history).
+
+    ``gate_metrics`` selects the gated rows: None -> the curated
+    :data:`DEFAULT_GATE_METRICS`, ``"all"`` -> every row except the
+    structural counts, or an explicit name collection."""
+    band = band_pct if band_pct is not None else band_pct_default()
+    need = min_history if min_history is not None else min_history_default()
+    if gate_metrics is None:
+        gated = DEFAULT_GATE_METRICS
+    elif gate_metrics == "all":
+        gated = None  # everything except _UNGATED_NAMES
+    else:
+        gated = frozenset(gate_metrics)
+    history: dict[str, list[float]] = {}
+    out: list[dict[str, Any]] = []
+    for rnd in rounds:
+        label = rnd["label"] if isinstance(rnd, Mapping) else rnd.label
+        metrics = rnd["metrics"] if isinstance(rnd, Mapping) else rnd.metrics
+        regressions: list[dict[str, Any]] = []
+        checked = 0
+        for name in sorted(metrics):
+            value = metrics[name]
+            if not isinstance(value, (int, float)) or isinstance(value, bool):
+                continue
+            prior = history.setdefault(name, [])
+            in_gate = (
+                name not in _UNGATED_NAMES
+                if gated is None
+                else name in gated
+            )
+            if in_gate and len(prior) >= need:
+                baseline = median(prior)
+                checked += 1
+                if baseline:
+                    delta_pct = (value - baseline) / abs(baseline) * 100.0
+                    bad = (
+                        delta_pct > band
+                        if smaller_is_better(name)
+                        else delta_pct < -band
+                    )
+                    if bad:
+                        regressions.append(
+                            {
+                                "metric": name,
+                                "value": float(value),
+                                "baseline": float(baseline),
+                                "delta_pct": round(delta_pct, 2),
+                            }
+                        )
+            prior.append(float(value))
+        if regressions:
+            verdict = "regressed"
+        elif checked:
+            verdict = "ok"
+        else:
+            verdict = "insufficient-history"
+        out.append(
+            {
+                "label": label,
+                "verdict": verdict,
+                "checked": checked,
+                "regressions": regressions,
+            }
+        )
+    return out
+
+
+def trajectory_gate(
+    rounds: Sequence[Any],
+    *,
+    band_pct: float | None = None,
+    min_history: int | None = None,
+    gate_metrics: Iterable[str] | str | None = None,
+) -> dict[str, Any]:
+    """The CI gate: ok iff the NEWEST round is not ``regressed`` (history
+    rounds already shipped; the gate exists to stop the next one).
+    ``insufficient-history`` passes — a brand-new metric cannot regress."""
+    verdicts = trajectory_verdicts(
+        rounds,
+        band_pct=band_pct,
+        min_history=min_history,
+        gate_metrics=gate_metrics,
+    )
+    latest = verdicts[-1] if verdicts else None
+    return {
+        "ok": latest is None or latest["verdict"] != "regressed",
+        "latest": latest,
+        "verdicts": verdicts,
+    }

@@ -449,12 +449,17 @@ class RegistrySampler:
         snapshot_fn: Callable[[], Mapping[str, Any] | None],
         ring: SnapshotRing,
         interval_s: float | None = None,
+        on_sample: Callable[[bool], None] | None = None,
     ) -> None:
         self._snapshot_fn = snapshot_fn
         self.ring = ring
         self.interval_s = (
             sample_interval_default() if interval_s is None else max(0.05, interval_s)
         )
+        # fired after every successful append with the reset flag — the
+        # server's sentinel rides this so detection runs exactly once per
+        # capture, whichever path (thread or endpoint) triggered it
+        self.on_sample = on_sample
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -463,9 +468,15 @@ class RegistrySampler:
         Never raises — a broken snapshot source must not take down the
         sampler loop or an observatory request."""
         try:
-            return self.ring.append(self._snapshot_fn())
+            reset = self.ring.append(self._snapshot_fn())
         except Exception:  # noqa: BLE001 — sampling must never break serving
             return False
+        if self.on_sample is not None:
+            try:
+                self.on_sample(reset)
+            except Exception:  # noqa: BLE001 — same contract as sampling
+                pass
+        return reset
 
     def start(self) -> "RegistrySampler":
         if self._thread is None:

@@ -193,6 +193,23 @@ def chunk_plan(start: int, length: int, max_chunk: int, row_capacity: int) -> li
     return plan
 
 
+def _parse_inject_spec(raw: str) -> tuple[float, int]:
+    """Parse PRIME_SENTINEL_INJECT_MS: ``"MS"`` or ``"MS@AFTER"`` — a
+    per-dispatch delay in milliseconds and the dispatch count after which
+    it activates. Junk degrades to inactive (0.0, 0), matching utils/env
+    knob semantics: a malformed knob must not take the engine down."""
+    raw = raw.strip()
+    if not raw:
+        return 0.0, 0
+    ms, _, after = raw.partition("@")
+    try:
+        delay_s = max(0.0, float(ms)) / 1e3
+        start = max(0, int(after)) if after else 0
+    except ValueError:
+        return 0.0, 0
+    return delay_s, start
+
+
 def _power_batches(n: int) -> list[int]:
     """Greedy power-of-two decomposition, largest first: 7 -> [4, 2, 1]."""
     out = []
@@ -914,6 +931,16 @@ class ContinuousBatchingEngine:
         # timelines readable at GET /debug/requests even with tracing off;
         # PRIME_SERVE_SLOW_MS auto-persists slow timelines to the trace sink
         self.flight = FlightRecorder()
+        # deterministic latency injection for the sentinel's planted-
+        # regression e2e (loadgen/smoke.py _sentinel_section, CI serve-smoke
+        # sentinel leg): "MS@N" delays every dispatch by MS milliseconds
+        # once N dispatches have gone out, manufacturing a genuine mid-run
+        # change-point (an always-on delay would shift fast and slow
+        # windows alike and never look like one). Unset costs nothing.
+        self._inject_delay_s, self._inject_after = _parse_inject_spec(
+            env_str("PRIME_SENTINEL_INJECT_MS", "")
+        )
+        self._dispatch_count = 0
         # device-time observatory: sampled step clock + compile/HBM/MFU
         # accounting into this registry (docs/observability.md "Device
         # time"). Constructed even when disabled so the metric families and
@@ -1354,6 +1381,7 @@ class ContinuousBatchingEngine:
         import jax
         import jax.numpy as jnp
 
+        self._maybe_inject_delay()
         if self._spec_fn is None:
             self._spec_fn = self._make_spec_decode()
         self._rng, rng = jax.random.split(self._rng)
@@ -1992,6 +2020,16 @@ class ContinuousBatchingEngine:
             self._init_device_state()
         return True
 
+    def _maybe_inject_delay(self) -> None:
+        """PRIME_SENTINEL_INJECT_MS hook (all three dispatch paths): counts
+        dispatches and, once past the activation threshold, stalls the host
+        for the configured delay so the step clock and TPOT genuinely
+        regress mid-run. A no-op (one int increment) when the knob is
+        unset."""
+        self._dispatch_count += 1
+        if self._inject_delay_s and self._dispatch_count > self._inject_after:
+            time.sleep(self._inject_delay_s)
+
     def _dispatch_decode(self) -> None:
         """Launch one decode chunk and return WITHOUT waiting for it: the
         tokens stay on the device inside an _InflightChunk until
@@ -2000,6 +2038,7 @@ class ContinuousBatchingEngine:
         import jax
         import jax.numpy as jnp
 
+        self._maybe_inject_delay()
         if self._decode_fn is None:
             self._decode_fn = self._make_decode()
         self._rng, rng = jax.random.split(self._rng)
@@ -2885,6 +2924,7 @@ class ContinuousBatchingEngine:
 
         import jax
 
+        self._maybe_inject_delay()
         if self._decode_fn is None:
             self._decode_fn = self._make_decode()
         self._rng, rng = jax.random.split(self._rng)

@@ -1,0 +1,262 @@
+"""Incident bundles: the forensics the sentinel attaches to a detection.
+
+A detection alone ("tpot_regression fired on replica r2") answers *what*;
+the bundle answers *with what evidence*: the triggering rule + windows, a
+registry snapshot delta across the slow window, the slowest flight
+timelines from the same ``/debug/requests`` recorder operators would have
+queried by hand, the trace-span tail, and the autoscaler/supervisor journal
+tail. Bundles persist to a bounded on-disk ring (oldest pruned first) so a
+replica restart doesn't eat the evidence, and serve at
+``GET /admin/incidents[/{id}]`` on both server and router.
+
+Stdlib + obs only — the router imports this next to membership, the server
+imports it without the fleet stack, and tests replay bundles offline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from collections import OrderedDict
+from pathlib import Path
+from typing import Any, Mapping
+
+from prime_tpu.obs.timeseries import SnapshotRing
+from prime_tpu.utils.env import env_int, env_str
+
+DEFAULT_RING_DEPTH = 32
+
+# registry families worth a before/after in every bundle, whatever the
+# triggering rule — the "what else moved" an operator checks first
+EVIDENCE_FAMILIES = (
+    "serve_tokens_emitted_total",
+    "serve_requests_admitted_total",
+    "serve_requests_completed_total",
+    "serve_requests_failed_total",
+    "serve_prefix_hits_total",
+    "serve_prefix_paged_seeds_total",
+    "serve_spec_accept_ratio",
+    "serve_kernel_config_source",
+    "serve_active_slots",
+    "serve_queue_depth",
+    "fleet_requests_total",
+    "fleet_reroutes_total",
+    "fleet_inflight_requests",
+)
+
+_ID_RE = re.compile(r"^[0-9a-f]{6,64}$")
+
+
+def ring_depth_default() -> int:
+    return max(1, env_int("PRIME_SENTINEL_RING", DEFAULT_RING_DEPTH))
+
+
+def store_dir_default() -> str:
+    return env_str("PRIME_SENTINEL_DIR", "")
+
+
+def _family_total(snapshot: Mapping[str, Any], name: str) -> float | None:
+    family = snapshot.get(name)
+    if not isinstance(family, Mapping):
+        return None
+    total = 0.0
+    seen = False
+    for series in family.get("series", []):
+        try:
+            total += float(series.get("value", 0.0))
+            seen = True
+        except (TypeError, ValueError):
+            continue
+    return total if seen else None
+
+
+def snapshot_delta(
+    ring: SnapshotRing | None, window_s: float
+) -> dict[str, dict[str, float]]:
+    """Before/after totals for the evidence families plus the triggering
+    window span — the "registry snapshot deltas" section of a bundle."""
+    if ring is None:
+        return {}
+    pair = ring.window(window_s)
+    if pair is None:
+        return {}
+    before, after = pair
+    out: dict[str, dict[str, float]] = {}
+    for name in EVIDENCE_FAMILIES:
+        b, a = _family_total(before, name), _family_total(after, name)
+        if b is None and a is None:
+            continue
+        out[name] = {
+            "before": 0.0 if b is None else b,
+            "after": 0.0 if a is None else a,
+        }
+    return out
+
+
+def slowest_flights(flight: Any, limit: int = 3) -> list[dict[str, Any]]:
+    """Full timelines of the slowest in-flight + recent requests, straight
+    from the same recorder ``/debug/requests`` serves."""
+    if flight is None:
+        return []
+    try:
+        summaries = flight.summaries(limit=50)
+    except Exception:
+        return []
+    rows = list(summaries.get("inflight", [])) + list(summaries.get("recent", []))
+    rows.sort(key=lambda r: r.get("duration_s") or 0.0, reverse=True)
+    out = []
+    for row in rows[:limit]:
+        timeline = None
+        key = row.get("id")
+        if key:
+            try:
+                timeline = flight.get(key)
+            except Exception:
+                timeline = None
+        out.append(timeline or dict(row))
+    return out
+
+
+def build_bundle(
+    detection: Mapping[str, Any],
+    *,
+    ring: SnapshotRing | None = None,
+    flight: Any = None,
+    journal: Any = None,
+    spans: Any = None,
+    flight_limit: int = 3,
+    journal_tail: int = 8,
+    span_tail: int = 20,
+) -> dict[str, Any]:
+    """Assemble one incident bundle around a sentinel detection dict.
+
+    Every evidence source is optional and best-effort: a bundle with an
+    empty flights list is still an incident — forensics must never turn a
+    detection into an exception."""
+    windows = detection.get("windows") or {}
+    slow_s = float(windows.get("slow_s") or 300.0)
+    journal_rows: list[dict[str, Any]] = []
+    if journal:
+        try:
+            journal_rows = [dict(row) for row in list(journal)[-journal_tail:]]
+        except Exception:
+            journal_rows = []
+    span_rows: list[dict[str, Any]] = []
+    if spans is not None:
+        try:
+            tail = spans() if callable(spans) else list(spans)
+            span_rows = [dict(s) for s in tail[-span_tail:]]
+        except Exception:
+            span_rows = []
+    return {
+        **{k: detection[k] for k in sorted(detection)},
+        "metrics": snapshot_delta(ring, slow_s),
+        "flights": slowest_flights(flight, limit=flight_limit),
+        "journal": journal_rows,
+        "spans": span_rows,
+    }
+
+
+def bundle_summary(bundle: Mapping[str, Any]) -> dict[str, Any]:
+    return {
+        "id": bundle.get("id"),
+        "rule": bundle.get("rule"),
+        "severity": bundle.get("severity"),
+        "scope": bundle.get("scope"),
+        "metric": bundle.get("metric"),
+        "value": bundle.get("value"),
+        "baseline": bundle.get("baseline"),
+        "ratio": bundle.get("ratio"),
+        "end_at": (bundle.get("windows") or {}).get("end_at"),
+        "flights": len(bundle.get("flights") or ()),
+    }
+
+
+class IncidentStore:
+    """Bounded incident ring: newest-first in memory, mirrored to
+    ``<dir>/incident-<seq>-<id>.json`` files when a directory is
+    configured (``PRIME_SENTINEL_DIR``). On construction an on-disk store
+    reloads its surviving files so a restarted replica still serves the
+    incidents that preceded the restart — often exactly the ones that
+    matter."""
+
+    def __init__(self, directory: str | os.PathLike | None = None, depth: int | None = None):
+        raw_dir = store_dir_default() if directory is None else str(directory)
+        self._dir = Path(raw_dir) if raw_dir else None
+        self._depth = ring_depth_default() if depth is None else max(1, int(depth))
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[str, dict[str, Any]] = OrderedDict()
+        self._seq = 0
+        if self._dir is not None:
+            with self._lock:
+                self._load()
+
+    def _load(self) -> None:
+        """caller holds the lock (construction-time reload of the on-disk
+        ring before the store is shared)."""
+        try:
+            self._dir.mkdir(parents=True, exist_ok=True)
+            paths = sorted(self._dir.glob("incident-*.json"))
+        except OSError:
+            return
+        for path in paths[-self._depth :]:
+            try:
+                bundle = json.loads(path.read_text())
+            except (OSError, ValueError):
+                continue
+            bid = str(bundle.get("id") or "")
+            if bid:
+                self._entries[bid] = bundle
+            m = re.match(r"incident-(\d+)-", path.name)
+            if m:
+                self._seq = max(self._seq, int(m.group(1)))
+
+    def _prune_locked(self) -> None:
+        """caller holds the lock."""
+        while len(self._entries) > self._depth:
+            old_id, _ = self._entries.popitem(last=False)
+            if self._dir is not None:
+                for path in self._dir.glob(f"incident-*-{old_id}.json"):
+                    try:
+                        path.unlink()
+                    except OSError:
+                        pass
+
+    def add(self, bundle: Mapping[str, Any]) -> str:
+        bid = str(bundle.get("id") or "")
+        with self._lock:
+            self._seq += 1
+            bundle = {"seq": self._seq, **bundle}
+            if not bid:
+                bid = f"{self._seq:08d}"
+                bundle["id"] = bid
+            self._entries[bid] = dict(bundle)
+            self._entries.move_to_end(bid)
+            if self._dir is not None:
+                try:
+                    self._dir.mkdir(parents=True, exist_ok=True)
+                    path = self._dir / f"incident-{self._seq:08d}-{bid}.json"
+                    path.write_text(json.dumps(bundle, sort_keys=True, default=str))
+                except OSError:
+                    pass  # disk trouble must not break detection
+            self._prune_locked()
+        return bid
+
+    def list(self) -> list[dict[str, Any]]:
+        """Summaries, newest first."""
+        with self._lock:
+            bundles = list(self._entries.values())
+        return [bundle_summary(b) for b in reversed(bundles)]
+
+    def get(self, incident_id: str) -> dict[str, Any] | None:
+        if not _ID_RE.match(str(incident_id)):
+            return None
+        with self._lock:
+            bundle = self._entries.get(str(incident_id))
+        return dict(bundle) if bundle is not None else None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

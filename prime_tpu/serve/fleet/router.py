@@ -58,6 +58,7 @@ from urllib.parse import parse_qs, urlsplit
 
 from prime_tpu.obs.flight import FlightRecorder, parse_summary_limit
 from prime_tpu.obs.metrics import Registry
+from prime_tpu.obs.sentinel import Sentinel
 from prime_tpu.obs.slo import ScaleSignal, SloEvaluator
 from prime_tpu.obs.timeseries import SnapshotRing, serving_window_view
 from prime_tpu.obs.trace import (
@@ -69,6 +70,7 @@ from prime_tpu.obs.trace import (
 from prime_tpu.serve.digest import CHARS_PER_TOKEN, MIN_BUCKET
 from prime_tpu.serve.errors import backpressure_response
 from prime_tpu.serve.fleet.balancer import PrefixAffinityBalancer
+from prime_tpu.serve.fleet.incidents import IncidentStore, build_bundle
 from prime_tpu.serve.fleet.membership import (
     BREAKER_GAUGE,
     BREAKER_OPEN,
@@ -330,8 +332,20 @@ class FleetRouter:
             "states; every replica counts in exactly one state)",
             labelnames=("state",),
         )
+        self._m_incidents = r.counter(
+            "fleet_incidents_total",
+            "Sentinel incidents raised at the fleet level, by scope "
+            "(replica id or 'router') and rule",
+            labelnames=("replica", "rule"),
+        )
         self.ring = SnapshotRing()  # the router's own registry history
         self.slo = SloEvaluator()
+        # regression sentinel over the same per-replica rings the SLO
+        # evaluation reads, plus the router's own ring (scope "router");
+        # detections ride every observe cycle (docs/observability.md
+        # "Sentinel & incidents")
+        self.sentinel = Sentinel()
+        self.incidents = IncidentStore()
         # reentrant: observatory_view holds it across a nested observe_once
         self._observe_lock = threading.RLock()
         self._last_verdicts: list = []
@@ -416,6 +430,22 @@ class FleetRouter:
                         self._json(403, {"error": {"message": "admin token required"}})
                         return
                     self._json(200, outer.profile_fanout())
+                elif path.rstrip("/") == "/admin/incidents" or path.startswith(
+                    "/admin/incidents/"
+                ):
+                    # sentinel incidents: the fleet view merges per-replica
+                    # bundles; admin parity with the replica servers
+                    if not outer._admin_authorized(self.headers):
+                        self._json(403, {"error": {"message": "admin token required"}})
+                        return
+                    incident_id = path[len("/admin/incidents/"):].strip("/") if (
+                        path.startswith("/admin/incidents/")
+                    ) else ""
+                    if incident_id:
+                        status, payload = outer.incident_detail(incident_id)
+                        self._json(status, payload)
+                    else:
+                        self._json(200, outer.incidents_view())
                 elif path.rstrip("/") == "/debug/requests" or path.startswith(
                     "/debug/requests/"
                 ):
@@ -890,15 +920,24 @@ class FleetRouter:
                         if response.status_code < 400
                         else f"http_{response.status_code}"
                     )
-            except (httpx.ConnectError, httpx.ConnectTimeout, httpx.RemoteProtocolError):
+            except (
+                httpx.ConnectError,
+                httpx.ConnectTimeout,
+                httpx.RemoteProtocolError,
+                httpx.ReadError,
+            ):
                 # connect refused/timed out, or the replica dropped the
                 # connection before a response (a dying server closing its
-                # pooled keep-alives looks like this): either way not one
-                # response byte reached the client, so the request is
-                # safely replayable elsewhere — and the breaker learns
-                # about the dead replica. Mid-SSE failures never take
+                # pooled keep-alives looks like this — as a clean FIN
+                # [RemoteProtocolError] or a hard RST [ReadError], which is
+                # what a killed replica's half-open sockets produce): either
+                # way not one response byte reached the client, so the
+                # request is safely replayable elsewhere — and the breaker
+                # learns about the dead replica. Mid-SSE failures never take
                 # this path (they are contained in _forward_response
-                # after bytes flowed).
+                # after bytes flowed), and the non-streamed body is read in
+                # full before the first client byte, so a ReadError here is
+                # always pre-response.
                 self.membership.note_failure(replica.id)
                 self._m_requests.inc(replica=replica.id, outcome="connect_error")
                 self._m_reroutes.inc(reason="connect_error")
@@ -1257,9 +1296,22 @@ class FleetRouter:
                             self._m_slo_breach.inc(
                                 slo=verdict.policy.name, window=sample.window
                             )
+                # sentinel pass over the same rings the SLO evaluation just
+                # read — one observe cycle, one consistent set of windows
+                scopes = {replica.id: replica.ring for replica in replicas}
+                scopes["router"] = self.ring
+                detections = self.sentinel.observe(scopes)
                 span.set_attr("signal", signal.direction)
                 span.set_attr("replicas", len(replicas))
+                if detections:
+                    span.set_attr("incidents", len(detections))
                 self._last_verdicts, self._last_signal = verdicts, signal
+        # bundle assembly runs OUTSIDE the observe lock: it reads flight
+        # timelines and the autoscaler journal, neither of which needs the
+        # windows held consistent, and /admin/observatory must not wait on
+        # forensics
+        for det in detections:
+            self._raise_incident(det, scopes.get(det.scope))
         # actuation runs OUTSIDE the observe lock: a spawn blocks for the
         # new replica's readiness, and holding the lock through it would
         # freeze /admin/observatory for the whole launch (the poll cycle
@@ -1386,6 +1438,88 @@ class FleetRouter:
                 replicas[replica.id] = {"error": {"message": str(e)}}
         return {"replicas": replicas}
 
+    def _raise_incident(self, det, ring) -> None:
+        """One detection -> one persisted bundle + counter bump +
+        ``fleet.incident`` span. Never raises — forensics must not kill the
+        poll loop that hosts the observe cycle."""
+        try:
+            journal = (
+                self.autoscaler.journal if self.autoscaler is not None else None
+            )
+            bundle = build_bundle(
+                det.to_dict(),
+                ring=ring,
+                flight=self.flight,
+                journal=journal,
+                spans=TRACER.tail,
+            )
+            self.incidents.add(bundle)
+            self._m_incidents.inc(replica=det.scope, rule=det.rule)
+            TRACER.emit(
+                "fleet.incident",
+                0.0,
+                rule=det.rule,
+                severity=det.severity,
+                scope=det.scope,
+                incident_id=det.id,
+            )
+        except Exception:  # noqa: BLE001 — evidence collection is best-effort
+            pass
+
+    def incidents_view(self) -> dict:
+        """GET /admin/incidents: the fleet view — the router's own bundles
+        plus each routable replica's summaries fanned out over HTTP (same
+        shape as profile_fanout: one unreachable replica degrades to an
+        error entry, never a router 5xx)."""
+        admin_headers = (
+            {"Authorization": f"Bearer {self.admin_token}"}
+            if self.admin_token
+            else {}
+        )
+        replicas: dict[str, Any] = {}
+        for replica in self.membership.routable_replicas():
+            try:
+                resp = self._http().get(
+                    f"{replica.url}/admin/incidents", headers=admin_headers
+                )
+                try:
+                    replicas[replica.id] = resp.json()
+                except ValueError:
+                    replicas[replica.id] = {
+                        "error": {"message": f"status {resp.status_code}"}
+                    }
+            except Exception as e:  # noqa: BLE001 — one dead replica must not kill the fan-out
+                replicas[replica.id] = {"error": {"message": str(e)}}
+        return {
+            "router": self.incidents.list(),
+            "active": [list(pair) for pair in self.sentinel.active()],
+            "replicas": replicas,
+        }
+
+    def incident_detail(self, incident_id: str) -> tuple[int, dict]:
+        """GET /admin/incidents/{id}: the router's own bundle, or the first
+        routable replica's match (best-effort — ids are content hashes, so
+        a replica-raised incident only exists on that replica)."""
+        bundle = self.incidents.get(incident_id)
+        if bundle is not None:
+            return 200, bundle
+        admin_headers = (
+            {"Authorization": f"Bearer {self.admin_token}"}
+            if self.admin_token
+            else {}
+        )
+        for replica in self.membership.routable_replicas():
+            try:
+                resp = self._http().get(
+                    f"{replica.url}/admin/incidents/{incident_id}",
+                    headers=admin_headers,
+                )
+                if resp.status_code == 200:
+                    return 200, {**resp.json(), "replica": replica.id}
+            except Exception:  # noqa: BLE001 — keep trying the other replicas
+                continue
+        return 404, {"error": {"message": f"no incident {incident_id!r}"}}
+
     def _router_window(self, window_s: float) -> dict:
         """Router-side slice of one observatory window (429s, queue wait) —
         called with the observe lock held (the SnapshotRing is internally
@@ -1468,6 +1602,10 @@ class FleetRouter:
                     },
                 },
                 "resets": int(sum(replica.resets for replica in replicas)),
+                "incidents": {
+                    "total": len(self.incidents),
+                    "recent": self.incidents.list()[:5],
+                },
                 "uptime_s": round(time.monotonic() - self._t0, 3),
             }
 

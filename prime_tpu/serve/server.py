@@ -36,6 +36,7 @@ from urllib.parse import parse_qs, urlsplit
 from prime_tpu.core.config import env_flag, env_int, env_str
 from prime_tpu.obs.flight import FlightRecorder, parse_summary_limit
 from prime_tpu.obs.metrics import Registry
+from prime_tpu.obs.sentinel import Sentinel
 from prime_tpu.obs.slo import SloEvaluator
 from prime_tpu.obs.timeseries import (
     RegistrySampler,
@@ -203,8 +204,28 @@ class InferenceServer:
         # anyone asks — the fleet router keeps its own per-replica rings
         # through the health poll instead of scraping this one
         self.obs_ring = SnapshotRing()
-        self._sampler = RegistrySampler(self._observatory_snapshot, self.obs_ring)
+        self._sampler = RegistrySampler(
+            self._observatory_snapshot,
+            self.obs_ring,
+            on_sample=self._on_observatory_sample,
+        )
         self._slo = SloEvaluator()
+        # regression sentinel (docs/observability.md "Sentinel & incidents"):
+        # rides the sampler's on_sample hook so detection runs exactly once
+        # per capture; new detections become incident bundles (flight
+        # timelines + registry deltas) in the bounded store behind
+        # GET /admin/incidents[/{id}]
+        # local import: the fleet package pulls in router.py, which imports
+        # render_chat_prompt back from this module — a top-level import
+        # would be circular
+        from prime_tpu.serve.fleet.incidents import IncidentStore
+
+        self.sentinel = Sentinel()
+        self.incidents = IncidentStore()
+        self._m_incidents = self.registry.counter(
+            "serve_incidents_total", "Sentinel incidents raised",
+            labelnames=("rule", "severity"),
+        )
         self._t0 = time.monotonic()
         outer = self
 
@@ -325,6 +346,28 @@ class InferenceServer:
                         self._json(403, {"error": {"message": "admin token required"}})
                         return
                     self._json(200, outer.observatory_view())
+                elif path.rstrip("/") == "/admin/incidents" or path.startswith(
+                    "/admin/incidents/"
+                ):
+                    # sentinel incident bundles (flight timelines + registry
+                    # deltas carry prompt evidence): admin parity
+                    if not outer._admin_authorized(self.headers):
+                        self._json(403, {"error": {"message": "admin token required"}})
+                        return
+                    incident_id = path[len("/admin/incidents/"):].strip("/") if (
+                        path.startswith("/admin/incidents/")
+                    ) else ""
+                    if incident_id:
+                        bundle = outer.incidents.get(incident_id)
+                        if bundle is None:
+                            self._json(
+                                404,
+                                {"error": {"message": f"no incident {incident_id!r}"}},
+                            )
+                        else:
+                            self._json(200, bundle)
+                    else:
+                        self._json(200, outer.incidents_view())
                 elif path == "/admin/profile":
                     # device-time profiler status (enabled/capturing/summary);
                     # admin parity like the rest of /admin
@@ -685,6 +728,40 @@ class InferenceServer:
         synchronously). Returns True when a counter reset was detected."""
         return self._sampler.sample_now()
 
+    def _on_observatory_sample(self, reset: bool) -> None:
+        """Sentinel pass over the freshly captured snapshot (fires once per
+        sampler capture, whichever path triggered it). New detections become
+        incident bundles — flight timelines + registry deltas + span tail —
+        a ``serve_incidents_total`` bump, and a ``fleet.incident`` span."""
+        del reset  # the ring already cleared itself; windows restart clean
+        from prime_tpu.serve.fleet.incidents import build_bundle
+
+        for det in self.sentinel.observe({"server": self.obs_ring}):
+            bundle = build_bundle(
+                det.to_dict(),
+                ring=self.obs_ring,
+                flight=self.flight_recorder(),
+                spans=TRACER.tail,
+            )
+            self.incidents.add(bundle)
+            self._m_incidents.inc(rule=det.rule, severity=det.severity)
+            TRACER.emit(
+                "fleet.incident",
+                0.0,
+                rule=det.rule,
+                severity=det.severity,
+                scope=det.scope,
+                incident_id=det.id,
+            )
+
+    def incidents_view(self) -> dict:
+        """GET /admin/incidents: bundle summaries (newest first) plus the
+        currently latched rule+scope pairs."""
+        return {
+            "incidents": self.incidents.list(),
+            "active": [list(pair) for pair in self.sentinel.active()],
+        }
+
     def observatory_view(self) -> dict:
         """GET /admin/observatory: the single-replica twin of the fleet
         router's view — windowed token/admission rates and latency
@@ -721,6 +798,10 @@ class InferenceServer:
             "serving": {
                 "fast": serving_window_view([self.obs_ring], fast_s),
                 "slow": serving_window_view([self.obs_ring], slow_s),
+            },
+            "incidents": {
+                "total": len(self.incidents),
+                "recent": self.incidents.list()[:5],
             },
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }

@@ -336,6 +336,40 @@ def _stack(role: str, key: int = 0, **engine_kw):
     return engine, server
 
 
+def _wait_for_roles(router, expected: set[str], timeout_s: float = 30.0) -> None:
+    """Block until the router's health poller has learned every expected
+    replica role. The first routed chat races the initial poll cycle
+    otherwise: a router that still sees role 'any' plans a colocated path
+    and the migration-evidence asserts flake."""
+    deadline = time.monotonic() + timeout_s
+    seen: set[str] = set()
+    while time.monotonic() < deadline:
+        seen = {r.role for r in router.membership.routable_replicas()}
+        if expected <= seen:
+            return
+        router.membership.poll_all()
+        time.sleep(0.05)
+    raise AssertionError(f"router never learned roles {expected}: saw {seen}")
+
+
+def _wait_for_migrations(router, expected_ok: int, timeout_s: float = 30.0) -> dict:
+    """Poll the router's migration-outcome counters until ``ok`` reaches the
+    expected count. The counter increments AFTER the resume leg's last byte
+    reaches the client, so a stats read right after the chat returns races
+    it by design — the response is done, the bookkeeping is microseconds
+    behind."""
+    deadline = time.monotonic() + timeout_s
+    stats = router.stats()
+    while time.monotonic() < deadline:
+        stats = router.stats()
+        if stats["migrations"].get("ok", 0) >= expected_ok:
+            return stats
+        time.sleep(0.02)
+    raise AssertionError(
+        f"migrations never reached ok={expected_ok}: {stats['migrations']}"
+    )
+
+
 def _chat(url: str, ids, max_tokens: int = 12) -> httpx.Response:
     return httpx.post(
         f"{url}/v1/chat/completions",
@@ -358,11 +392,12 @@ def test_http_disagg_bit_identity_and_migration_evidence():
         model_id="tiny-test",
     )
     try:
+        _wait_for_roles(router, {"prefill", "decode"})
         reference = _chat(ref_server.url, PROMPT).json()["choices"][0]["message"]
         routed = _chat(router.url, PROMPT).json()["choices"][0]["message"]
         assert routed["content"] == reference["content"]
 
-        stats = router.stats()
+        stats = _wait_for_migrations(router, 1)
         assert stats["migrations"].get("ok") == 1
         assert stats["migrate_bytes"] > 0
         roles = {r["role"] for r in stats["replicas"].values()}
@@ -380,7 +415,7 @@ def test_http_disagg_bit_identity_and_migration_evidence():
         # bytes) and stays bit-identical
         again = _chat(router.url, PROMPT).json()["choices"][0]["message"]
         assert again["content"] == reference["content"]
-        assert router.stats()["migrations"].get("ok") == 2
+        assert _wait_for_migrations(router, 2)["migrations"].get("ok") == 2
     finally:
         router.stop()
         for server in (ref_server, prefill_server, decode_server):
@@ -396,6 +431,7 @@ def test_http_disagg_streaming_and_short_prompt_colocated():
         model_id="tiny-test",
     )
     try:
+        _wait_for_roles(router, {"prefill", "decode"})
         # streaming rides the migration path too (the decode leg streams)
         deltas = []
         with httpx.stream(
@@ -416,7 +452,7 @@ def test_http_disagg_streaming_and_short_prompt_colocated():
                 if line.startswith("data: ") and '"content"' in line:
                     deltas.append(line)
         assert deltas
-        assert router.stats()["migrations"].get("ok") == 1
+        assert _wait_for_migrations(router, 1)["migrations"].get("ok") == 1
         # a sub-block prompt has no migratable KV: colocated path, no new
         # migration recorded
         assert _chat(router.url, [1, 5, 9], max_tokens=4).status_code == 200

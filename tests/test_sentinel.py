@@ -1,0 +1,598 @@
+"""Regression sentinel + incident forensics (obs/sentinel.py,
+serve/fleet/incidents.py).
+
+The load-bearing properties: (1) detection is a pure function of ring
+contents — the same capture replayed twice yields BYTE-IDENTICAL detection
+streams with content-hash ids; (2) coverage gates keep short rings and
+reset windows quiet (a fresh replica is not a regression); (3) one
+sustained breach latches to exactly one detection; (4) incident bundles
+persist through a bounded on-disk ring and serve over /admin/incidents
+with admin-token parity; (5) the trajectory gate passes the real committed
+BENCH trajectory and fails a synthetic regressed round appended to it.
+"""
+
+import json
+
+import httpx
+import pytest
+
+from prime_tpu.obs.metrics import DEFAULT_LATENCY_BUCKETS
+from prime_tpu.obs.sentinel import (
+    Detection,
+    Sentinel,
+    SentinelRule,
+    default_rules,
+    evaluate_rule,
+    replay,
+    replay_digest,
+    smaller_is_better,
+    trajectory_gate,
+    trajectory_verdicts,
+)
+from prime_tpu.obs.timeseries import (
+    SnapshotRing,
+    fleet_rate,
+    fleet_window_span,
+    serving_window_view,
+)
+from prime_tpu.serve.fleet.incidents import (
+    IncidentStore,
+    build_bundle,
+    bundle_summary,
+    slowest_flights,
+    snapshot_delta,
+)
+
+# ---- synthetic snapshot fixtures (pure dicts, hand-stamped clocks) ----------
+
+BUCKETS = list(DEFAULT_LATENCY_BUCKETS)
+
+
+def _hist(observations: list[float]) -> dict:
+    counts = [0] * (len(BUCKETS) + 1)
+    for value in observations:
+        for i, bound in enumerate(BUCKETS):
+            if value <= bound:
+                counts[i] += 1
+                break
+        else:
+            counts[-1] += 1
+    return {
+        "buckets": list(BUCKETS),
+        "counts": counts,
+        "sum": float(sum(observations)),
+        "count": len(observations),
+    }
+
+
+def snap(
+    t: float,
+    counters: dict | None = None,
+    hists: dict | None = None,
+    gauges: dict | None = None,
+) -> dict:
+    """A synthetic Registry.snapshot() with an explicit capture instant —
+    the decision core must never consult a wall clock."""
+    out: dict = {
+        "captured_at": {
+            "type": "gauge",
+            "help": "t",
+            "series": [{"labels": {}, "value": float(t)}],
+        }
+    }
+    for name, value in (counters or {}).items():
+        out[name] = {
+            "type": "counter",
+            "help": name,
+            "series": [{"labels": {}, "value": float(value)}],
+        }
+    for name, observations in (hists or {}).items():
+        out[name] = {"type": "histogram", "help": name, "series": [
+            {"labels": {}, **_hist(observations)}
+        ]}
+    for name, value in (gauges or {}).items():
+        out[name] = {
+            "type": "gauge",
+            "help": name,
+            "series": [{"labels": {}, "value": float(value)}],
+        }
+    return out
+
+
+def _latency_timeline(clean_steps: int = 9, slow_steps: int = 2) -> list[dict]:
+    """15 s sampling cadence: `clean_steps` captures of 50 ms TTFTs, then
+    `slow_steps` captures where every new observation is 2 s. Histograms are
+    cumulative, exactly like Registry.snapshot()."""
+    seq = []
+    observations: list[float] = []
+    for i in range(clean_steps + slow_steps):
+        observations = observations + [0.05 if i < clean_steps else 2.0] * 6
+        seq.append(snap(i * 15.0, hists={"serve_ttft_seconds": list(observations)}))
+    return seq
+
+
+REPLAY_RULE = SentinelRule(
+    name="ttft_regression", kind="quantile_regression",
+    metric="serve_ttft_seconds", severity="warn",
+    q=0.95, baseline_q=0.5, ratio=3.0,
+)
+
+
+# ---- rule evaluation units --------------------------------------------------
+
+
+def test_quantile_regression_fires_on_change_point():
+    ring = SnapshotRing(depth=32)
+    for s in _latency_timeline():
+        ring.append(s)
+    det = evaluate_rule(
+        ring, REPLAY_RULE, scope="r0",
+        fast_s=30.0, slow_s=120.0, change_ratio=1.6, min_samples=4,
+    )
+    assert det is not None
+    assert det.metric == "serve_ttft_seconds"
+    assert det.value > det.baseline * 3.0
+    assert det.windows["end_at"] == pytest.approx(150.0)
+
+
+def test_quantile_regression_min_value_deadband():
+    """An absolute floor on the triggering value: the same relative jump
+    below the deadband stays quiet (CPU jitter on near-zero latencies)."""
+    ring = SnapshotRing(depth=32)
+    for s in _latency_timeline():
+        ring.append(s)
+    deadbanded = SentinelRule(
+        name="ttft_regression", kind="quantile_regression",
+        metric="serve_ttft_seconds", q=0.95, baseline_q=0.5, ratio=3.0,
+        min_value=10.0,  # above the 2 s regression
+    )
+    assert evaluate_rule(
+        ring, deadbanded, scope="r0",
+        fast_s=30.0, slow_s=120.0, change_ratio=1.6, min_samples=4,
+    ) is None
+
+
+def test_rate_collapse_fires_and_idle_floor_does_not():
+    ring = SnapshotRing(depth=32)
+    # 100 tok/s for 120 s, then the stream stalls
+    for i in range(9):
+        ring.append(snap(i * 15.0, counters={"serve_tokens_emitted_total": i * 1500}))
+    ring.append(snap(150.0, counters={"serve_tokens_emitted_total": 8 * 1500 + 10}))
+    rule = next(r for r in default_rules() if r.name == "token_rate_collapse")
+    det = evaluate_rule(
+        ring, rule, scope="r0",
+        fast_s=30.0, slow_s=120.0, change_ratio=1.6, min_samples=4,
+    )
+    assert det is not None and det.value < det.baseline
+    # an idle replica (0 -> 0) must never read as a cliff: baseline floor
+    idle = SnapshotRing(depth=32)
+    for i in range(11):
+        idle.append(snap(i * 15.0, counters={"serve_tokens_emitted_total": 0}))
+    assert evaluate_rule(
+        idle, rule, scope="r0",
+        fast_s=30.0, slow_s=120.0, change_ratio=1.6, min_samples=4,
+    ) is None
+
+
+def test_gauge_shift_on_kernel_config_source():
+    """The config-source gauge leaving its autotune-registry era (2 ->
+    env-forced 0) is a detection; a steady gauge is not."""
+    rule = next(r for r in default_rules() if r.name == "kernel_config_shift")
+    ring = SnapshotRing(depth=32)
+    for i in range(11):
+        ring.append(snap(
+            i * 15.0, gauges={"serve_kernel_config_source": 2.0 if i < 9 else 0.0}
+        ))
+    det = evaluate_rule(
+        ring, rule, scope="r0",
+        fast_s=30.0, slow_s=120.0, change_ratio=1.6, min_samples=4,
+    )
+    assert det is not None and det.value == 0.0 and det.baseline == 2.0
+    steady = SnapshotRing(depth=32)
+    for i in range(11):
+        steady.append(snap(i * 15.0, gauges={"serve_kernel_config_source": 2.0}))
+    assert evaluate_rule(
+        steady, rule, scope="r0",
+        fast_s=30.0, slow_s=120.0, change_ratio=1.6, min_samples=4,
+    ) is None
+
+
+def test_ratio_collapse_prefix_hit_rate():
+    rule = next(r for r in default_rules() if r.name == "prefix_hit_collapse")
+    ring = SnapshotRing(depth=32)
+    # 90% hit rate for 120 s, then hits stop while admissions continue
+    for i in range(9):
+        ring.append(snap(i * 15.0, counters={
+            "serve_requests_admitted_total": i * 20,
+            "serve_prefix_hits_total": i * 18,
+        }))
+    for j, t in enumerate((135.0, 150.0), start=1):
+        ring.append(snap(t, counters={
+            "serve_requests_admitted_total": 8 * 20 + j * 20,
+            "serve_prefix_hits_total": 8 * 18,
+        }))
+    det = evaluate_rule(
+        ring, rule, scope="r0",
+        fast_s=30.0, slow_s=120.0, change_ratio=1.6, min_samples=4,
+    )
+    assert det is not None
+    assert det.value == pytest.approx(0.0)
+    assert det.baseline > 0.5
+
+
+# ---- coverage gates (the satellite's SnapshotRing edge cases) ---------------
+
+
+def test_ring_shorter_than_detection_window_stays_quiet():
+    """A ring spanning 10 s must not evaluate 30/300 s windows, however
+    dramatic its contents — a fresh replica is not a regression."""
+    ring = SnapshotRing(depth=32)
+    obs: list[float] = []
+    for i in range(6):
+        obs = obs + ([0.05] * 6 if i < 4 else [5.0] * 6)
+        ring.append(snap(i * 2.0, hists={"serve_ttft_seconds": list(obs)}))
+    sentinel = Sentinel((REPLAY_RULE,))  # production 30/300 s windows
+    assert sentinel.observe({"r0": ring}) == []
+    # the same contents over a wide-enough span DO fire (the gate was the
+    # only thing holding the detection back)
+    assert evaluate_rule(
+        ring, REPLAY_RULE, scope="r0",
+        fast_s=2.0, slow_s=8.0, change_ratio=1.6, min_samples=4,
+    ) is not None
+
+
+def test_counter_reset_mid_window_clears_history_and_stays_quiet():
+    """A replica restart (counters shrink) drops pre-reset history: the
+    sentinel sees no covered window right after, and never a negative
+    rate-collapse verdict."""
+    ring = SnapshotRing(depth=32)
+    for i in range(9):
+        ring.append(snap(i * 15.0, counters={"serve_tokens_emitted_total": i * 1500}))
+    reset = ring.append(snap(135.0, counters={"serve_tokens_emitted_total": 30}))
+    assert reset and ring.resets == 1 and len(ring) == 1
+    rule = next(r for r in default_rules() if r.name == "token_rate_collapse")
+    assert evaluate_rule(
+        ring, rule, scope="r0",
+        fast_s=30.0, slow_s=120.0, change_ratio=1.6, min_samples=4,
+    ) is None
+    sentinel = Sentinel((rule,), fast_s=30.0, slow_s=120.0)
+    assert sentinel.observe({"r0": ring}) == []
+
+
+def test_fleet_merge_with_one_stale_replica():
+    """Fleet-wide windows over [fresh, fresh, just-restarted]: the stale
+    ring (single capture, no window) contributes nothing — no fabricated
+    zeros dragging the fleet rate down, no crash."""
+    fresh = []
+    for base in (0, 1):
+        ring = SnapshotRing(depth=8)
+        for i in range(3):
+            ring.append(snap(
+                i * 10.0, counters={"serve_tokens_emitted_total": (base + 1) * i * 500}
+            ))
+        fresh.append(ring)
+    stale = SnapshotRing(depth=8)
+    stale.append(snap(25.0, counters={"serve_tokens_emitted_total": 7}))
+    rings = [*fresh, stale]
+    span = fleet_window_span(rings, 20.0)
+    assert span == pytest.approx(20.0)
+    # 1000 + 2000 tokens over the 20 s window; the stale ring adds nothing
+    assert fleet_rate(rings, "serve_tokens_emitted_total", 20.0) == pytest.approx(150.0)
+    view = serving_window_view(rings, 20.0)
+    assert view["tok_s"] == pytest.approx(150.0)
+
+
+# ---- latch + replay determinism ---------------------------------------------
+
+
+def test_sustained_breach_latches_to_one_detection_then_rearms():
+    ring = SnapshotRing(depth=32)
+    sentinel = Sentinel((REPLAY_RULE,), fast_s=30.0, slow_s=120.0, min_samples=4)
+    obs: list[float] = []
+    fired = []
+    for i in range(12):
+        # 9 clean captures, then the regression holds for 3 more
+        obs = obs + [0.05 if i < 9 else 2.0] * 6
+        ring.append(snap(i * 15.0, hists={"serve_ttft_seconds": list(obs)}))
+        new = sentinel.observe({"r0": ring})
+        if new:
+            # edge-trigger: the breach latches the instant it fires
+            assert sentinel.active() == [("ttft_regression", "r0")]
+        fired.extend(new)
+    assert len(fired) == 1  # one sustained regression == one incident
+    assert sentinel.detections_total == 1
+    # the slow window absorbs the regression and the breach clears — the
+    # latch re-arms instead of re-firing on every observe cycle
+    for i in range(12, 22):
+        obs = obs + [2.0] * 6
+        ring.append(snap(i * 15.0, hists={"serve_ttft_seconds": list(obs)}))
+        fired.extend(sentinel.observe({"r0": ring}))
+    assert len(fired) == 1
+    assert sentinel.active() == []
+
+
+def test_replay_is_byte_identical_and_detects():
+    """The tentpole pin: identical fixtures through the replay sim produce
+    byte-identical detection streams — same dicts, same content-hash ids,
+    same digest. A second scope staying clean must stay silent."""
+    sequences = {
+        "replica0": _latency_timeline(),
+        "replica1": [
+            snap(i * 15.0, hists={"serve_ttft_seconds": [0.05] * 6 * (i + 1)})
+            for i in range(11)
+        ],
+    }
+    kwargs = dict(
+        rules=(REPLAY_RULE,), fast_s=30.0, slow_s=120.0,
+        change_ratio=1.6, min_samples=4,
+    )
+    first = replay(sequences, **kwargs)
+    second = replay(sequences, **kwargs)
+    assert json.dumps(first, sort_keys=True) == json.dumps(second, sort_keys=True)
+    assert replay_digest(first) == replay_digest(second)
+    detections = [d for step in first for d in step]
+    assert len(detections) == 1
+    assert detections[0]["scope"] == "replica0"
+    assert detections[0]["id"]  # content hash, minted identically both runs
+    assert all(d["scope"] != "replica1" for d in detections)
+
+
+def test_detection_id_is_a_content_hash():
+    ring = SnapshotRing(depth=32)
+    for s in _latency_timeline():
+        ring.append(s)
+    kwargs = dict(
+        scope="r0", fast_s=30.0, slow_s=120.0, change_ratio=1.6, min_samples=4
+    )
+    a = evaluate_rule(ring, REPLAY_RULE, **kwargs)
+    b = evaluate_rule(ring, REPLAY_RULE, **kwargs)
+    assert isinstance(a, Detection) and isinstance(b, Detection)
+    assert a.id == b.id  # same content, same id — no clock, no RNG
+    other = evaluate_rule(ring, REPLAY_RULE, **{**kwargs, "scope": "r1"})
+    assert other is not None and other.id != a.id
+
+
+# ---- incident bundles + store -----------------------------------------------
+
+
+def _detection_dict() -> dict:
+    return {
+        "id": "abc123def456",
+        "rule": "ttft_regression",
+        "severity": "warn",
+        "scope": "r0",
+        "metric": "serve_ttft_seconds",
+        "value": 2.0,
+        "baseline": 0.05,
+        "ratio": 40.0,
+        "windows": {"fast_s": 30.0, "slow_s": 120.0, "end_at": 150.0},
+    }
+
+
+def test_build_bundle_collects_evidence_and_never_raises():
+    ring = SnapshotRing(depth=32)
+    for i in range(11):
+        ring.append(snap(i * 15.0, counters={"serve_tokens_emitted_total": i * 1500}))
+
+    class Flight:
+        def summaries(self, limit=50):
+            return {
+                "inflight": [],
+                "recent": [
+                    {"id": "req-1", "duration_s": 3.0},
+                    {"id": "req-2", "duration_s": 0.5},
+                ],
+            }
+
+        def get(self, key):
+            return {"id": key, "events": [{"event": "admitted"}]}
+
+    bundle = build_bundle(
+        _detection_dict(),
+        ring=ring,
+        flight=Flight(),
+        journal=[{"direction": "up"}] * 12,
+        spans=lambda: [{"name": "fleet.observe"}] * 30,
+    )
+    assert bundle["metrics"]["serve_tokens_emitted_total"]["after"] == 15000.0
+    assert [f["id"] for f in bundle["flights"]] == ["req-1", "req-2"]
+    assert len(bundle["journal"]) == 8 and len(bundle["spans"]) == 20
+    assert bundle["rule"] == "ttft_regression"
+    summary = bundle_summary(bundle)
+    assert summary["id"] == "abc123def456" and summary["flights"] == 2
+    # every evidence source degrades, none raises
+    hostile = build_bundle(
+        _detection_dict(), ring=None, flight=object(), journal=7, spans=object()
+    )
+    assert hostile["metrics"] == {} and hostile["flights"] == []
+    assert snapshot_delta(None, 60.0) == {}
+    assert slowest_flights(None) == []
+
+
+def test_incident_store_persists_prunes_and_reloads(tmp_path):
+    store = IncidentStore(tmp_path, depth=2)
+    ids = []
+    for i in range(3):
+        det = {**_detection_dict(), "id": f"{i:012x}"}
+        ids.append(store.add(build_bundle(det)))
+    assert len(store) == 2  # oldest pruned
+    assert store.get(ids[0]) is None
+    assert store.get(ids[2])["rule"] == "ttft_regression"
+    files = sorted(p.name for p in tmp_path.glob("incident-*.json"))
+    assert len(files) == 2  # disk mirrors the ring
+    # a restarted replica reloads the surviving bundles AND keeps counting
+    # sequence numbers from where the dead process stopped
+    revived = IncidentStore(tmp_path, depth=2)
+    assert len(revived) == 2
+    assert revived.get(ids[1]) is not None
+    revived.add({**_detection_dict(), "id": "f" * 12})
+    assert revived.get(ids[1]) is None  # pruned as the ring advances
+    # id hygiene: traversal-shaped ids never touch the filesystem
+    assert revived.get("../../etc/passwd") is None
+    assert revived.get("not-hex!") is None
+
+
+# ---- /admin/incidents over HTTP (server + router parity) --------------------
+
+
+class _ScriptedBackend:
+    """Minimal generate-backend (the test_fleet pattern): enough for an
+    InferenceServer to boot without an engine."""
+
+    concurrent = True
+    prefix_cache_enabled = True
+
+    def __init__(self, name: str = "replica-a"):
+        self.name = name
+
+    def stats(self):
+        return {"queue_depth": 0, "active_slots": 0, "max_slots": 8}
+
+    def generate(self, prompts, max_new_tokens, temperature, top_p=1.0, templated=False):
+        return [self.name] * len(prompts)
+
+
+def test_admin_incidents_endpoint_auth_parity_and_detail():
+    from prime_tpu.serve import InferenceServer
+
+    srv = InferenceServer(
+        "tiny-test", _ScriptedBackend(), port=0, admin_token="sekrit"
+    ).start()
+    try:
+        bundle = build_bundle(_detection_dict())
+        srv.incidents.add(bundle)
+        url = f"{srv.url}/admin/incidents"
+        assert httpx.get(url, timeout=10).status_code == 403  # token parity
+        headers = {"Authorization": "Bearer sekrit"}
+        listing = httpx.get(url, headers=headers, timeout=10).json()
+        assert [i["id"] for i in listing["incidents"]] == ["abc123def456"]
+        detail = httpx.get(
+            f"{url}/abc123def456", headers=headers, timeout=10
+        ).json()
+        assert detail["rule"] == "ttft_regression" and "metrics" in detail
+        assert httpx.get(
+            f"{url}/000000000000", headers=headers, timeout=10
+        ).status_code == 404
+    finally:
+        srv.stop()
+
+
+def test_router_fleet_view_merges_replica_bundles():
+    from prime_tpu.serve import InferenceServer
+    from prime_tpu.serve.fleet import serve_fleet
+
+    srv = InferenceServer("tiny-test", _ScriptedBackend(), port=0).start()
+    router = serve_fleet([srv.url], poll_interval=0.1, model_id="tiny-test")
+    try:
+        srv.incidents.add(build_bundle(_detection_dict()))
+        view = httpx.get(f"{router.url}/admin/incidents", timeout=10).json()
+        assert view["router"] == []
+        merged = [
+            i["id"]
+            for replica in view["replicas"].values()
+            for i in replica.get("incidents", [])
+        ]
+        assert merged == ["abc123def456"]
+        # detail fan-out: the router doesn't own the bundle, a replica does
+        detail = httpx.get(
+            f"{router.url}/admin/incidents/abc123def456", timeout=10
+        ).json()
+        assert detail["rule"] == "ttft_regression" and detail.get("replica")
+    finally:
+        router.stop()
+        srv.stop()
+
+
+# ---- injected-delay knob (the planted-regression lever) ---------------------
+
+
+def test_parse_inject_spec_formats_and_junk():
+    from prime_tpu.serve.engine import _parse_inject_spec
+
+    assert _parse_inject_spec("120") == (0.12, 0)
+    assert _parse_inject_spec("60@40") == (0.06, 40)
+    assert _parse_inject_spec("  5@3  ") == (0.005, 3)
+    for junk in ("", "abc", "10@x", "@", "@5"):
+        assert _parse_inject_spec(junk) == (0.0, 0)
+    # negatives clamp to inactive rather than going back in time
+    assert _parse_inject_spec("-5") == (0.0, 0)
+
+
+# ---- trajectory gate --------------------------------------------------------
+
+
+def _round(label: str, metrics: dict) -> dict:
+    return {"label": label, "metrics": metrics}
+
+
+def test_trajectory_gate_passes_committed_history_fails_synthetic_regression():
+    """The CI contract: the real committed trajectory gates clean, and the
+    same history plus a synthetic collapsed round fails."""
+    from pathlib import Path
+
+    from prime_tpu.loadgen.perf_delta import Round, load_all_rounds
+
+    root = Path(__file__).resolve().parent.parent
+    rounds = load_all_rounds(str(root))
+    assert len(rounds) >= 3, "committed BENCH trajectory went missing"
+    gate = trajectory_gate(rounds)
+    assert gate["ok"], f"committed trajectory must gate clean: {gate['latest']}"
+    # synthetic regression: every gated metric of the last round collapses 10x
+    last = rounds[-1]
+    bad = Round(
+        label="synthetic-regressed", path="<test>", order=(9999, "z"),
+        schema=2, record={},
+        metrics={name: value / 10.0 for name, value in last.metrics.items()},
+    )
+    gate_bad = trajectory_gate([*rounds, bad])
+    assert not gate_bad["ok"]
+    assert gate_bad["latest"]["verdict"] == "regressed"
+    assert gate_bad["latest"]["regressions"]
+
+
+def test_trajectory_verdicts_bands_directions_and_history():
+    rounds = [
+        _round("r1", {"loadgen tok/s": 100.0, "slo:smoke ttft p95 ms": 50.0}),
+        _round("r2", {"loadgen tok/s": 105.0, "slo:smoke ttft p95 ms": 55.0}),
+        _round("r3", {"loadgen tok/s": 95.0, "slo:smoke ttft p95 ms": 45.0}),
+        _round("r4", {"loadgen tok/s": 20.0, "slo:smoke ttft p95 ms": 48.0}),
+    ]
+    verdicts = trajectory_verdicts(rounds, band_pct=50.0, min_history=3)
+    assert [v["verdict"] for v in verdicts] == [
+        "insufficient-history", "insufficient-history", "insufficient-history",
+        "regressed",
+    ]
+    assert verdicts[-1]["regressions"][0]["metric"] == "loadgen tok/s"
+    # latency rows are smaller-is-better and gate only when opted in
+    assert smaller_is_better("slo:smoke ttft p95 ms")
+    assert not smaller_is_better("loadgen tok/s")
+    lat = [
+        _round(f"r{i}", {"slo:smoke ttft p95 ms": 50.0}) for i in range(3)
+    ] + [_round("r4", {"slo:smoke ttft p95 ms": 500.0})]
+    assert trajectory_gate(lat)["ok"]  # curated default gate skips latency
+    assert not trajectory_gate(lat, gate_metrics="all")["ok"]
+
+
+def test_trajectory_gate_insufficient_history_passes():
+    rounds = [_round("r1", {"loadgen tok/s": 100.0})]
+    gate = trajectory_gate(rounds)
+    assert gate["ok"] and gate["latest"]["verdict"] == "insufficient-history"
+    assert trajectory_gate([])["ok"]
+
+
+def test_perf_delta_renders_sentinel_verdict_row():
+    """Satellite: the delta table and the CI gate share one implementation —
+    the table's `sentinel verdict` row must reflect trajectory_verdicts."""
+    from prime_tpu.loadgen.perf_delta import Round, delta_table
+
+    rounds = [
+        Round(
+            label=f"r{i}", path="<test>", order=(i, ""), schema=2, record={},
+            metrics={"loadgen tok/s": 100.0 if i < 4 else 10.0},
+        )
+        for i in range(5)
+    ]
+    table = delta_table(rounds)
+    assert "sentinel verdict" in table
+    assert "REGRESSED(1)" in table
+    assert "no-history" in table
